@@ -1,0 +1,78 @@
+"""paddle_tpu — a TPU-native deep-learning framework.
+
+A from-scratch re-design of the PaddlePaddle Fluid capability surface
+(reference: feitianyiren/Paddle) for TPU: programs are still built as
+Program/Block/Op IR with fluid-style layers, optimizers and executors, but
+execution is compile-first — blocks trace through JAX lowering rules into
+single XLA executables, autodiff is vjp-derived, parallelism is
+mesh+shardings (pjit/GSPMD) instead of NCCL op insertion, and hot kernels
+are Pallas.
+
+Typical use (same shape as fluid):
+
+    import paddle_tpu as fluid
+    x = fluid.layers.data("x", shape=[784])
+    y = fluid.layers.data("y", shape=[1], dtype="int64")
+    pred = fluid.layers.fc(x, size=10, act="softmax")
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, y))
+    fluid.optimizer.SGD(0.01).minimize(loss)
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(fluid.default_startup_program())
+    exe.run(feed={...}, fetch_list=[loss])
+"""
+
+from . import ops  # registers all op lowerings first
+from . import (
+    backward,
+    clip,
+    framework,
+    initializer,
+    layers,
+    lod,
+    nets,
+    optimizer,
+    param_attr,
+    places,
+    regularizer,
+    unique_name,
+)
+from .executor import Executor, global_scope, scope_guard, as_numpy
+from .framework import (
+    Program,
+    Block,
+    Operator,
+    Variable,
+    Parameter,
+    default_main_program,
+    default_startup_program,
+    program_guard,
+    name_scope,
+    cpu_places,
+    tpu_places,
+)
+from .core.scope import Scope
+from .lod import LoDTensor, create_lod_tensor
+from .param_attr import ParamAttr, WeightNormParamAttr
+from .places import (
+    CPUPlace,
+    CUDAPlace,
+    TPUPlace,
+    TPUPinnedPlace,
+    default_place,
+    is_compiled_with_cuda,
+    is_compiled_with_tpu,
+)
+from .data_feeder import DataFeeder
+from .io import (
+    save_vars,
+    save_params,
+    save_persistables,
+    load_vars,
+    load_params,
+    load_persistables,
+    save_inference_model,
+    load_inference_model,
+)
+from .parallel_executor import ParallelExecutor, BuildStrategy, ExecutionStrategy
+
+__version__ = "0.1.0"

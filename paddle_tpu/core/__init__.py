@@ -1,0 +1,4 @@
+"""Core execution engine: op registry, block tracer, scope, compile cache."""
+
+from . import registry, scope, trace
+from .scope import Scope, global_scope, scope_guard

@@ -1,0 +1,162 @@
+"""Op registry: op type -> JAX lowering rule.
+
+TPU-native analog of the reference's kernel registry
+(``paddle/fluid/framework/op_registry.h``).  Where the reference maps
+``op_type -> {OpKernelType -> kernel fn}`` and dispatches per-op at runtime,
+here each op registers one *lowering rule*: a pure function from traced JAX
+arrays (+ static attrs) to traced JAX arrays.  The executor composes these
+rules while tracing a Block and XLA compiles/fuses the whole block.
+
+Gradients come from the lowering itself: for any op ``foo``, the op
+``foo_grad`` is lowered generically via ``jax.vjp`` of foo's lowering — the
+TPU replacement for the reference's per-op ``GradOpDescMaker`` + hand-written
+grad kernels (``grad_op_desc_maker.h``).  XLA CSE merges the re-traced
+forward with the original, so no double compute survives compilation.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class OpDef:
+    def __init__(self, type, lower, no_grad_inputs=None, needs_rng=False):
+        self.type = type
+        self.lower = lower  # fn(ctx, ins: {slot: [arrays]}, attrs) -> {slot: [arrays]}
+        self.no_grad_inputs = set(no_grad_inputs or ())
+        self.needs_rng = needs_rng
+
+
+OPS = {}
+
+
+def register(type_, no_grad_inputs=None, needs_rng=False):
+    """Decorator: register a lowering rule for op `type_`."""
+
+    def deco(fn):
+        OPS[type_] = OpDef(type_, fn, no_grad_inputs, needs_rng)
+        return fn
+
+    return deco
+
+
+def get_op(type_):
+    if type_ not in OPS:
+        raise NotImplementedError(
+            "op '%s' has no TPU lowering registered (known: %d ops)"
+            % (type_, len(OPS))
+        )
+    return OPS[type_]
+
+
+def is_registered(type_):
+    return type_ in OPS
+
+
+class LowerCtx:
+    """Per-trace context handed to lowering rules.
+
+    Carries the step RNG key (ops fold in their op index for independent
+    streams — the analog of the reference's per-op seed attrs) and trace-wide
+    flags.
+    """
+
+    def __init__(self, rng_key=None, is_test=False, scope=None):
+        self.rng_key = rng_key
+        self.is_test = is_test
+        self.scope = scope
+        self.op_idx = 0
+        self.block = None
+        self.trace_block = None  # fn(block_idx, env) for control-flow ops
+
+    def rng(self, attrs=None, salt=0):
+        """Key for a randomness-consuming op. A nonzero `seed` attr pins the
+        stream (dropout determinism parity: operator-level seed attrs)."""
+        seed = int(attrs.get("seed", 0)) if attrs else 0
+        if seed:
+            key = jax.random.PRNGKey(seed)
+        elif self.rng_key is not None:
+            key = self.rng_key
+        else:
+            key = jax.random.PRNGKey(0)
+        return jax.random.fold_in(jax.random.fold_in(key, self.op_idx), salt)
+
+
+def _is_float(x):
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+
+
+def lower_grad_op(ctx, op, ins, attrs):
+    """Generic lowering for `<type>_grad` ops via jax.vjp of the forward rule.
+
+    The grad OpDesc (built by backward.py) carries bookkeeping attrs:
+      __fwd_type__     : forward op type
+      __fwd_attrs__    : forward attrs
+      __fwd_in_slots__ : forward input slot names present
+      __fwd_out_slots__: forward output slot names
+      __fwd_op_idx__   : forward op's index (for RNG parity, e.g. dropout)
+    Inputs: forward inputs under their slot names, plus `<out-slot>@GRAD`.
+    Outputs: `<in-slot>@GRAD` for differentiable (float) inputs.
+    """
+    fwd_type = attrs["__fwd_type__"]
+    fwd_attrs = attrs["__fwd_attrs__"]
+    in_slots = attrs["__fwd_in_slots__"]
+    out_slots = attrs["__fwd_out_slots__"]
+    opdef = get_op(fwd_type)
+
+    fwd_ins = {s: ins[s] for s in in_slots if s in ins}
+
+    # differentiable leaf positions: float-dtype arrays in forward inputs,
+    # minus slots the op marks non-differentiable (e.g. lookup_table Ids)
+    diff_pos = []  # (slot, idx)
+    for s in in_slots:
+        if s in opdef.no_grad_inputs or s not in fwd_ins:
+            continue
+        for i, v in enumerate(fwd_ins[s]):
+            if _is_float(v):
+                diff_pos.append((s, i))
+
+    sub_ctx = LowerCtx(ctx.rng_key, ctx.is_test, ctx.scope)
+    sub_ctx.op_idx = attrs.get("__fwd_op_idx__", ctx.op_idx)
+    sub_ctx.trace_block = ctx.trace_block
+
+    def fwd_fn(diff_vals):
+        merged = {s: list(v) for s, v in fwd_ins.items()}
+        for (s, i), v in zip(diff_pos, diff_vals):
+            merged[s][i] = v
+        outs = opdef.lower(sub_ctx, merged, fwd_attrs)
+        flat = []
+        for s in out_slots:
+            for o in outs.get(s, []):
+                flat.append(o)
+        return flat
+
+    primals = [fwd_ins[s][i] for (s, i) in diff_pos]
+    fwd_flat, vjp_fn = jax.vjp(fwd_fn, primals)
+
+    # cotangents: supplied grads or zeros
+    cots = []
+    k = 0
+    for s in out_slots:
+        n_out = len(attrs.get("__fwd_out_names__", {}).get(s, [None]))
+        gslot = ins.get(s + "@GRAD")
+        for i in range(n_out):
+            ref = fwd_flat[k]
+            k += 1
+            if gslot is not None and i < len(gslot) and gslot[i] is not None:
+                cots.append(jnp.asarray(gslot[i], dtype=ref.dtype).reshape(ref.shape))
+            else:
+                cots.append(jnp.zeros(ref.shape, ref.dtype))
+    (grads,) = vjp_fn(cots)
+
+    outs = {}
+    for (s, i), g in zip(diff_pos, grads):
+        outs.setdefault(s + "@GRAD", {})[i] = g
+    # normalize to lists
+    result = {}
+    for s, d in outs.items():
+        n = max(d.keys()) + 1
+        result[s] = [d.get(i) for i in range(n)]
+    return result

@@ -1,0 +1,101 @@
+"""Runtime Scope: name -> value store (scope.h:41 analog).
+
+The reference's Scope is a hierarchical map of type-erased Variables that the
+interpreting executor mutates in place.  Here values are JAX arrays living in
+TPU HBM (or host numpy); the executor functionalizes mutation — a step's
+updated state is written back here after the compiled function returns, with
+donation making the HBM update in-place.
+"""
+
+import numpy as np
+
+
+class Scope:
+    def __init__(self, parent=None):
+        self._vars = {}
+        self.parent = parent
+        self._kids = []
+
+    def var(self, name):
+        """Get-or-create (mirrors Scope::Var)."""
+        if name not in self._vars:
+            self._vars[name] = None
+        return name
+
+    def find_var(self, name):
+        s = self
+        while s is not None:
+            if name in s._vars:
+                return s._vars[name]
+            s = s.parent
+        return None
+
+    def has_var(self, name):
+        s = self
+        while s is not None:
+            if name in s._vars and s._vars[name] is not None:
+                return True
+            s = s.parent
+        return False
+
+    def get(self, name):
+        return self.find_var(name)
+
+    def set(self, name, value):
+        # write where the var already exists, else locally
+        s = self
+        while s is not None:
+            if name in s._vars:
+                s._vars[name] = value
+                return
+            s = s.parent
+        self._vars[name] = value
+
+    def erase(self, name):
+        self._vars.pop(name, None)
+
+    def new_scope(self):
+        kid = Scope(self)
+        self._kids.append(kid)
+        return kid
+
+    def drop_kids(self):
+        self._kids = []
+
+    def local_var_names(self):
+        return list(self._vars.keys())
+
+    def all_var_names(self):
+        names = []
+        s = self
+        while s is not None:
+            names.extend(s._vars.keys())
+            s = s.parent
+        return names
+
+
+_global_scope = Scope()
+_scope_stack = [_global_scope]
+
+
+def global_scope():
+    return _scope_stack[-1]
+
+
+def _switch_scope(scope):
+    global _scope_stack
+    prev = _scope_stack[-1]
+    _scope_stack[-1] = scope
+    return prev
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    prev = _switch_scope(scope)
+    try:
+        yield
+    finally:
+        _switch_scope(prev)

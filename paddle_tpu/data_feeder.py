@@ -1,0 +1,45 @@
+"""DataFeeder (python/paddle/fluid/data_feeder.py:83 analog): convert python
+minibatch rows into the feed dict of dense arrays (+ padded LoDTensors for
+ragged slots)."""
+
+import numpy as np
+
+from . import framework
+from .lod import create_lod_tensor
+
+__all__ = ["DataFeeder"]
+
+
+class DataFeeder:
+    def __init__(self, feed_list, place=None, program=None):
+        self.feed_dtypes = []
+        self.feed_names = []
+        self.feed_shapes = []
+        self.feed_lod_level = []
+        if program is None:
+            program = framework.default_main_program()
+        for each_var in feed_list:
+            if isinstance(each_var, str):
+                each_var = program.global_block().var(each_var)
+            self.feed_names.append(each_var.name)
+            self.feed_lod_level.append(each_var.lod_level)
+            self.feed_shapes.append(each_var.shape)
+            self.feed_dtypes.append(each_var.dtype)
+        self.place = place
+
+    def feed(self, iterable):
+        rows = list(iterable)
+        out = {}
+        for i, name in enumerate(self.feed_names):
+            col = [row[i] for row in rows]
+            if self.feed_lod_level[i] > 0:
+                out[name] = create_lod_tensor([np.asarray(c) for c in col])
+            else:
+                shape = self.feed_shapes[i]
+                arr = np.asarray(col, dtype=self.feed_dtypes[i])
+                if shape is not None:
+                    feat = [d for d in shape[1:]]
+                    if all(d is not None and d > 0 for d in feat):
+                        arr = arr.reshape([len(col)] + feat)
+                out[name] = arr
+        return out

@@ -1,0 +1,132 @@
+"""User-facing Executor (python/paddle/fluid/executor.py analog).
+
+``Executor(place).run(program, feed={...}, fetch_list=[...])`` keeps the
+reference's contract (executor.py:374) but executes by compiling the program
+block to one XLA computation (see core/trace.py) instead of interpreting ops.
+Feed dict entries become function arguments; fetch vars become outputs; no
+feed/fetch ops or feed-variable side channel are needed.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import framework
+from .core import scope as scope_mod
+from .core.trace import ExecutionCache
+from .places import CPUPlace, default_place
+
+__all__ = ["Executor", "global_scope", "scope_guard"]
+
+global_scope = scope_mod.global_scope
+scope_guard = scope_mod.scope_guard
+
+
+def as_numpy(value):
+    """Fetch result -> numpy (executor.py:66 analog)."""
+    from .lod import LoDTensor
+
+    if isinstance(value, LoDTensor):
+        return value
+    if isinstance(value, (list, tuple)):
+        return [as_numpy(v) for v in value]
+    return np.asarray(jax.device_get(value))
+
+
+class Executor:
+    def __init__(self, place=None):
+        self.place = place if place is not None else default_place()
+        self._cache = ExecutionCache()
+        self._step = 0
+        self._key_cache = {}
+        self._closed = False
+
+    def _rng_key(self, program):
+        # base key derives from the program's seed (per-program, so
+        # main_program.random_seed is honored even after the startup run);
+        # folding in the step counter advances streams across runs
+        seed = int(program.random_seed)
+        base = self._key_cache.get(seed)
+        if base is None:
+            base = jax.random.PRNGKey(seed if seed != 0 else 90157)
+            self._key_cache[seed] = base
+        key = jax.random.fold_in(base, self._step)
+        self._step += 1
+        return key
+
+    def run(
+        self,
+        program=None,
+        feed=None,
+        fetch_list=None,
+        feed_var_name="feed",
+        fetch_var_name="fetch",
+        scope=None,
+        return_numpy=True,
+        use_program_cache=True,
+    ):
+        if self._closed:
+            raise RuntimeError("Executor is closed")
+        if program is None:
+            program = framework.default_main_program()
+        if scope is None:
+            scope = global_scope()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        fetch_names = [
+            v.name if isinstance(v, framework.Variable) else str(v) for v in fetch_list
+        ]
+
+        device = self.place.jax_device()
+        feed_arrays = {}
+        from .lod import LoDTensor
+
+        for name, value in feed.items():
+            if isinstance(value, LoDTensor):
+                # ragged feed: pass the padded data; expose lengths as
+                # `<name>@SEQ_LEN` if the program wants them
+                feed_arrays[name] = jax.device_put(jnp.asarray(value.data), device)
+                feed_arrays[name + "@SEQ_LEN"] = jax.device_put(
+                    jnp.asarray(value.seq_lens()), device
+                )
+            else:
+                feed_arrays[name] = jax.device_put(jnp.asarray(value), device)
+
+        feed_sig = tuple(
+            sorted((n, tuple(a.shape), str(a.dtype)) for n, a in feed_arrays.items())
+        )
+        compiled = self._cache.get(program, 0, feed_sig, fetch_names, scope)
+        traced = compiled.traced
+
+        ro_state = {}
+        for n in traced.ro_names:
+            v = scope.find_var(n)
+            ro_state[n] = v
+        rw_state = {}
+        for n in traced.rw_names:
+            rw_state[n] = scope.find_var(n)
+
+        key = self._rng_key(program)
+        fetches, new_state = compiled(feed_arrays, ro_state, rw_state, key)
+
+        for n, v in new_state.items():
+            scope.set(n, v)
+
+        if return_numpy:
+            return [as_numpy(f) for f in fetches]
+        return list(fetches)
+
+    def close(self):
+        """Release cached executables (Executor::Close analog; the pserver
+        SendComplete goes through the distributed runtime when present)."""
+        self._cache.clear()
+        self._closed = True
+
+    # infer_* helpers used by contrib Trainer/Inferencer
+    def _run_startup(self, startup_program=None, scope=None):
+        self.run(
+            startup_program or framework.default_startup_program(),
+            feed={},
+            fetch_list=[],
+            scope=scope,
+        )

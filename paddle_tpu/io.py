@@ -1,0 +1,175 @@
+"""Model save/load (python/paddle/fluid/io.py analog).
+
+The reference emits save/load ops into programs (save_op.cc); here
+persistence is a host-side operation over the scope (values are pulled from
+HBM and written as .npy files; the serialized Program is JSON).  API parity:
+save/load_vars/params/persistables (io.py:89,204,252) and
+save/load_inference_model (io.py:544,674).
+"""
+
+import json
+import os
+
+import numpy as np
+import jax
+
+from . import framework
+from .executor import global_scope
+from .framework import Parameter, Program
+
+__all__ = [
+    "save_vars",
+    "save_params",
+    "save_persistables",
+    "load_vars",
+    "load_params",
+    "load_persistables",
+    "save_inference_model",
+    "load_inference_model",
+    "get_program_persistable_vars",
+]
+
+
+def _is_persistable(var):
+    return var.persistable
+
+
+def get_program_persistable_vars(program):
+    return [v for v in program.list_vars() if v.persistable]
+
+
+def _save_var(dirname, name, value):
+    path = os.path.join(dirname, name.replace("/", "%2F"))
+    np.save(path + ".npy", np.asarray(jax.device_get(value)))
+
+
+def _load_var(dirname, name):
+    path = os.path.join(dirname, name.replace("/", "%2F") + ".npy")
+    return np.load(path)
+
+
+def save_vars(executor, dirname, main_program=None, vars=None, predicate=None, filename=None):
+    if main_program is None:
+        main_program = framework.default_main_program()
+    if vars is None:
+        vars = [v for v in main_program.list_vars() if predicate is None or predicate(v)]
+    os.makedirs(dirname, exist_ok=True)
+    scope = global_scope()
+    if filename is not None:
+        blob = {}
+        for v in vars:
+            val = scope.find_var(v.name)
+            if val is None:
+                continue
+            blob[v.name] = np.asarray(jax.device_get(val))
+        np.savez(os.path.join(dirname, filename), **blob)
+        return
+    for v in vars:
+        val = scope.find_var(v.name)
+        if val is None:
+            continue
+        _save_var(dirname, v.name, val)
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    if main_program is None:
+        main_program = framework.default_main_program()
+    save_vars(
+        executor,
+        dirname,
+        main_program,
+        vars=[v for v in main_program.list_vars() if isinstance(v, Parameter)],
+        filename=filename,
+    )
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    if main_program is None:
+        main_program = framework.default_main_program()
+    save_vars(
+        executor,
+        dirname,
+        main_program,
+        vars=get_program_persistable_vars(main_program),
+        filename=filename,
+    )
+
+
+def load_vars(executor, dirname, main_program=None, vars=None, predicate=None, filename=None):
+    if main_program is None:
+        main_program = framework.default_main_program()
+    if vars is None:
+        vars = [v for v in main_program.list_vars() if predicate is None or predicate(v)]
+    scope = global_scope()
+    if filename is not None:
+        blob = np.load(os.path.join(dirname, filename))
+        for v in vars:
+            if v.name in blob:
+                scope.set(v.name, blob[v.name])
+        return
+    for v in vars:
+        try:
+            scope.set(v.name, _load_var(dirname, v.name))
+        except FileNotFoundError:
+            pass
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    if main_program is None:
+        main_program = framework.default_main_program()
+    load_vars(
+        executor,
+        dirname,
+        main_program,
+        vars=[v for v in main_program.list_vars() if isinstance(v, Parameter)],
+        filename=filename,
+    )
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    if main_program is None:
+        main_program = framework.default_main_program()
+    load_vars(
+        executor,
+        dirname,
+        main_program,
+        vars=get_program_persistable_vars(main_program),
+        filename=filename,
+    )
+
+
+def save_inference_model(
+    dirname,
+    feeded_var_names,
+    target_vars,
+    executor,
+    main_program=None,
+    model_filename=None,
+    params_filename=None,
+    export_for_deployment=True,
+):
+    """Prune to the inference slice + save program & params (io.py:544)."""
+    if main_program is None:
+        main_program = framework.default_main_program()
+    os.makedirs(dirname, exist_ok=True)
+    pruned = main_program.clone(for_test=True)._prune(target_vars)
+    meta = {
+        "program": pruned.to_json(),
+        "feed_names": list(feeded_var_names),
+        "fetch_names": [
+            t.name if isinstance(t, framework.Variable) else t for t in target_vars
+        ],
+    }
+    with open(os.path.join(dirname, model_filename or "__model__"), "w") as f:
+        json.dump(meta, f)
+    save_persistables(executor, dirname, pruned, filename=params_filename)
+    return meta["fetch_names"]
+
+
+def load_inference_model(dirname, executor, model_filename=None, params_filename=None):
+    with open(os.path.join(dirname, model_filename or "__model__")) as f:
+        meta = json.load(f)
+    program = Program.from_json(meta["program"])
+    load_persistables(executor, dirname, program, filename=params_filename)
+    fetch_vars = [program.global_block().var(n) for n in meta["fetch_names"]]
+    return program, meta["feed_names"], fetch_vars

@@ -1,0 +1,12 @@
+"""fluid.layers equivalent: op-emitting layer functions."""
+
+from . import nn, tensor, ops, io, control_flow, metric_op, math_op_patch, detection
+from . import sequence, learning_rate_scheduler
+from .nn import *  # noqa: F401,F403
+from .tensor import *  # noqa: F401,F403
+from .ops import *  # noqa: F401,F403
+from .io import *  # noqa: F401,F403
+from .control_flow import *  # noqa: F401,F403
+from .metric_op import *  # noqa: F401,F403
+from .sequence import *  # noqa: F401,F403
+from .learning_rate_scheduler import *  # noqa: F401,F403

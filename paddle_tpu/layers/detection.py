@@ -1,0 +1,131 @@
+"""Detection layers (layers/detection.py analog) — SSD/RCNN helpers.
+
+Round-1 subset: prior_box, box_coder, iou. NMS-family ops are
+dynamic-shape-heavy and pending a TPU-friendly (padded top-k) design.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.registry import register
+from ..layer_helper import LayerHelper
+
+__all__ = ["prior_box", "box_coder", "iou_similarity", "multiclass_nms", "ssd_loss"]
+
+
+@register("prior_box", no_grad_inputs=("Input", "Image"))
+def _prior_box(ctx, ins, attrs):
+    feat, img = ins["Input"][0], ins["Image"][0]
+    min_sizes = attrs["min_sizes"]
+    max_sizes = attrs.get("max_sizes", [])
+    ratios = attrs.get("aspect_ratios", [1.0])
+    flip = attrs.get("flip", False)
+    step_w = attrs.get("step_w", 0.0)
+    step_h = attrs.get("step_h", 0.0)
+    offset = attrs.get("offset", 0.5)
+    clip = attrs.get("clip", False)
+    h, w = feat.shape[2], feat.shape[3]
+    img_h, img_w = img.shape[2], img.shape[3]
+    sw = step_w or img_w / w
+    sh = step_h or img_h / h
+    ars = []
+    for r in ratios:
+        ars.append(r)
+        if flip and r != 1.0:
+            ars.append(1.0 / r)
+    boxes = []
+    variances = []
+    var = attrs.get("variances", [0.1, 0.1, 0.2, 0.2])
+    for y in range(h):
+        for x in range(w):
+            cx = (x + offset) * sw
+            cy = (y + offset) * sh
+            for k, ms in enumerate(min_sizes):
+                for ar in ars:
+                    bw = ms * np.sqrt(ar) / 2
+                    bh = ms / np.sqrt(ar) / 2
+                    boxes.append(
+                        [(cx - bw) / img_w, (cy - bh) / img_h, (cx + bw) / img_w, (cy + bh) / img_h]
+                    )
+                    variances.append(var)
+                if max_sizes:
+                    bs = np.sqrt(ms * max_sizes[k]) / 2
+                    boxes.append(
+                        [(cx - bs) / img_w, (cy - bs) / img_h, (cx + bs) / img_w, (cy + bs) / img_h]
+                    )
+                    variances.append(var)
+    boxes = np.array(boxes, np.float32).reshape(h, w, -1, 4)
+    variances = np.array(variances, np.float32).reshape(h, w, -1, 4)
+    if clip:
+        boxes = np.clip(boxes, 0, 1)
+    return {"Boxes": [jnp.asarray(boxes)], "Variances": [jnp.asarray(variances)]}
+
+
+@register("iou_similarity", no_grad_inputs=("X", "Y"))
+def _iou_similarity(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]  # [N,4],[M,4]
+    area_x = (x[:, 2] - x[:, 0]) * (x[:, 3] - x[:, 1])
+    area_y = (y[:, 2] - y[:, 0]) * (y[:, 3] - y[:, 1])
+    lt = jnp.maximum(x[:, None, :2], y[None, :, :2])
+    rb = jnp.minimum(x[:, None, 2:], y[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    return {"Out": [inter / (area_x[:, None] + area_y[None, :] - inter + 1e-10)]}
+
+
+def prior_box(
+    input,
+    image,
+    min_sizes,
+    max_sizes=None,
+    aspect_ratios=[1.0],
+    variance=[0.1, 0.1, 0.2, 0.2],
+    flip=False,
+    clip=False,
+    steps=[0.0, 0.0],
+    offset=0.5,
+    name=None,
+):
+    helper = LayerHelper("prior_box", name=name)
+    boxes = helper.create_variable_for_type_inference("float32")
+    variances = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        "prior_box",
+        inputs={"Input": [input], "Image": [image]},
+        outputs={"Boxes": [boxes], "Variances": [variances]},
+        attrs={
+            "min_sizes": list(min_sizes),
+            "max_sizes": list(max_sizes or []),
+            "aspect_ratios": list(aspect_ratios),
+            "variances": list(variance),
+            "flip": flip,
+            "clip": clip,
+            "step_w": steps[0],
+            "step_h": steps[1],
+            "offset": offset,
+        },
+    )
+    return boxes, variances
+
+
+def iou_similarity(x, y, name=None):
+    helper = LayerHelper("iou_similarity", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "iou_similarity", inputs={"X": [x], "Y": [y]}, outputs={"Out": [out]}
+    )
+    return out
+
+
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size", box_normalized=True, name=None):
+    raise NotImplementedError("box_coder pending")
+
+
+def multiclass_nms(*args, **kwargs):
+    raise NotImplementedError(
+        "multiclass_nms pending a padded-topk TPU design (detection phase)"
+    )
+
+
+def ssd_loss(*args, **kwargs):
+    raise NotImplementedError("ssd_loss pending (detection phase)")

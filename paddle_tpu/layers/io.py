@@ -1,0 +1,27 @@
+"""Input layers (python/paddle/fluid/layers/io.py analog): `data` declares a
+feed slot; py_reader/double-buffering live in paddle_tpu.reader (the TPU
+input pipeline is host-side prefetch + device_put, not reader ops)."""
+
+from .. import framework
+
+__all__ = ["data"]
+
+
+def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True, type=None, stop_gradient=True):
+    """Declare an input variable (io.py:39 parity).
+
+    `append_batch_size=True` prepends a -1 batch dim as in the reference.
+    """
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    block = framework.default_main_program().current_block()
+    var = block.create_var(
+        name=name,
+        shape=shape,
+        dtype=dtype,
+        lod_level=lod_level,
+        stop_gradient=stop_gradient,
+        is_data=True,
+    )
+    return var

@@ -1,0 +1,123 @@
+"""LR schedules (layers/learning_rate_scheduler.py analog).
+
+The reference emits decay as in-graph ops over a global step counter; same
+here — the counter is a persistable scalar incremented each step inside the
+compiled program, so schedules compile into the training executable.
+"""
+
+import math
+
+from .. import framework
+from ..initializer import Constant
+from ..layer_helper import LayerHelper
+from . import tensor, nn, ops
+
+__all__ = [
+    "exponential_decay",
+    "natural_exp_decay",
+    "inverse_time_decay",
+    "polynomial_decay",
+    "piecewise_decay",
+    "noam_decay",
+    "cosine_decay",
+    "linear_lr_warmup",
+]
+
+
+def _decay_step_counter(begin=0):
+    helper = LayerHelper("global_step_counter")
+    counter = helper.create_or_get_global_variable(
+        name="@LR_DECAY_COUNTER@", dtype="float32", shape=[1], persistable=True
+    )
+    if not getattr(counter, "_initialized", False):
+        helper.set_variable_initializer(counter, Constant(float(begin)))
+        counter._initialized = True
+        helper.append_op(
+            "increment",
+            inputs={"X": [counter]},
+            outputs={"Out": [counter]},
+            attrs={"step": 1.0},
+        )
+        counter.stop_gradient = True
+    return counter
+
+
+def noam_decay(d_model, warmup_steps):
+    step = _decay_step_counter(1)
+    a = step ** -0.5
+    b = (warmup_steps ** -1.5) * step
+    return (d_model ** -0.5) * nn.elementwise_min(a, b)
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    step = _decay_step_counter()
+    div = step / float(decay_steps)
+    if staircase:
+        div = ops.floor(div)
+    return learning_rate * (decay_rate ** div)
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    step = _decay_step_counter()
+    div = step / float(decay_steps)
+    if staircase:
+        div = ops.floor(div)
+    return learning_rate * ops.exp(-1 * decay_rate * div)
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    step = _decay_step_counter()
+    div = step / float(decay_steps)
+    if staircase:
+        div = ops.floor(div)
+    return learning_rate / (1 + decay_rate * div)
+
+
+def polynomial_decay(
+    learning_rate, decay_steps, end_learning_rate=0.0001, power=1.0, cycle=False
+):
+    step = _decay_step_counter()
+    if cycle:
+        div_res = ops.ceil(step / float(decay_steps))
+        decay_steps_var = float(decay_steps) * div_res
+        frac = step / decay_steps_var
+    else:
+        frac = nn.elementwise_min(
+            step / float(decay_steps), tensor.fill_constant([1], "float32", 1.0)
+        )
+    return (learning_rate - end_learning_rate) * ((1 - frac) ** power) + end_learning_rate
+
+
+def piecewise_decay(boundaries, values):
+    """lr = values[i] for step in [boundaries[i-1], boundaries[i]) —
+    computed branch-free as a sum of interval masks (compiles to select)."""
+    assert len(boundaries) + 1 == len(values)
+    step = _decay_step_counter()
+    lr = tensor.fill_constant([1], "float32", 0.0)
+    prev = None
+    for i, v in enumerate(values):
+        if i == 0:
+            m = ops.sigmoid((float(boundaries[0]) - step) * 1e6)
+        elif i < len(boundaries):
+            m = ops.sigmoid((float(boundaries[i]) - step) * 1e6) - ops.sigmoid(
+                (float(boundaries[i - 1]) - step) * 1e6
+            )
+        else:
+            m = 1.0 - ops.sigmoid((float(boundaries[-1]) - step) * 1e6)
+        lr = lr + m * v
+    return lr
+
+
+def cosine_decay(learning_rate, step_each_epoch, epochs):
+    step = _decay_step_counter()
+    epoch = ops.floor(step / step_each_epoch)
+    return 0.5 * learning_rate * (ops.cos(epoch * (math.pi / epochs)) + 1)
+
+
+def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
+    step = _decay_step_counter()
+    linear = start_lr + (end_lr - start_lr) * (step / float(warmup_steps))
+    m = ops.sigmoid((float(warmup_steps) - step) * 1e6)
+    if isinstance(learning_rate, float):
+        learning_rate = tensor.fill_constant([1], "float32", learning_rate)
+    return m * linear + (1.0 - m) * learning_rate

@@ -1,0 +1,66 @@
+"""Operator overloading on Variables (math_op_patch.py analog)."""
+
+import numpy as np
+
+from .. import framework
+from ..layer_helper import LayerHelper
+
+
+def _create_out(helper, dtype):
+    return helper.create_variable_for_type_inference(dtype)
+
+
+def scale(var, scale_val=1.0, bias=0.0):
+    helper = LayerHelper("scale")
+    out = _create_out(helper, var.dtype)
+    helper.append_op(
+        "scale",
+        inputs={"X": [var]},
+        outputs={"Out": [out]},
+        attrs={"scale": float(scale_val), "bias": float(bias)},
+    )
+    return out
+
+
+def _scalar_elementwise(var, op, scalar, reverse):
+    if op == "elementwise_add":
+        return scale(var, 1.0, scalar)
+    if op == "elementwise_sub":
+        if reverse:
+            return scale(var, -1.0, scalar)
+        return scale(var, 1.0, -scalar)
+    if op == "elementwise_mul":
+        return scale(var, scalar, 0.0)
+    if op == "elementwise_div" and not reverse:
+        return scale(var, 1.0 / scalar, 0.0)
+    # fall through: build constant tensor
+    return None
+
+
+def binary(var, other, op, reverse=False):
+    helper = LayerHelper(op)
+    if isinstance(other, (np.integer, np.floating)):
+        other = float(other)
+    if isinstance(other, (int, float)):
+        if op in ("elementwise_add", "elementwise_sub", "elementwise_mul") or (
+            op == "elementwise_div" and not reverse
+        ):
+            out = _scalar_elementwise(var, op, float(other), reverse)
+            if out is not None:
+                return out
+        # materialize a scalar tensor
+        from . import tensor as tensor_layers
+
+        other = tensor_layers.fill_constant([1], var.dtype, float(other))
+    x, y = (other, var) if reverse else (var, other)
+    compare = op in (
+        "less_than",
+        "less_equal",
+        "greater_than",
+        "greater_equal",
+        "equal",
+        "not_equal",
+    )
+    out = _create_out(helper, "bool" if compare else var.dtype)
+    helper.append_op(op, inputs={"X": [x], "Y": [y]}, outputs={"Out": [out]}, attrs={"axis": -1})
+    return out

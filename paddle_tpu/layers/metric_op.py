@@ -1,0 +1,27 @@
+"""In-graph metric layers (layers/metric_op.py analog)."""
+
+from ..layer_helper import LayerHelper
+from . import nn
+
+__all__ = ["accuracy", "auc"]
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    helper = LayerHelper("accuracy")
+    topk_out, topk_indices = nn.topk(input, k=k)
+    acc_out = helper.create_variable_for_type_inference("float32")
+    if correct is None:
+        correct = helper.create_variable_for_type_inference("int64")
+    if total is None:
+        total = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        "accuracy",
+        inputs={"Out": [topk_out], "Indices": [topk_indices], "Label": [label]},
+        outputs={"Accuracy": [acc_out], "Correct": [correct], "Total": [total]},
+    )
+    acc_out.stop_gradient = True
+    return acc_out
+
+
+def auc(input, label, curve="ROC", num_thresholds=200, topk=1, slide_steps=1):
+    raise NotImplementedError("auc layer pending (metrics.Auc available host-side)")

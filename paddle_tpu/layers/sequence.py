@@ -1,0 +1,214 @@
+"""Sequence layers over the padded+lengths representation.
+
+The reference's 46 LoD-aware sequence ops (operators/sequence_ops/) operate
+on concatenated ragged tensors.  TPU-natively, sequences are padded
+[batch, time, ...] arrays with an optional per-example length tensor; masks
+replace LoD offsets (SURVEY.md §5.7).  Layers accept an explicit `seq_len`
+variable; without one, the full time axis is used.
+"""
+
+import jax.numpy as jnp
+
+from ..core.registry import register
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "sequence_pool",
+    "sequence_softmax",
+    "sequence_expand",
+    "sequence_concat",
+    "sequence_reshape",
+    "sequence_pad",
+    "sequence_unpad",
+    "sequence_mask",
+    "sequence_first_step",
+    "sequence_last_step",
+    "sequence_reverse",
+    "sequence_slice",
+]
+
+
+# ---------------------------------------------------------------------------
+# op lowerings (registered here, close to the layers)
+# ---------------------------------------------------------------------------
+def _time_mask(x, seq_len):
+    """[B, T] float mask from lengths."""
+    t = x.shape[1]
+    ar = jnp.arange(t)[None, :]
+    return (ar < seq_len[:, None]).astype(x.dtype)
+
+
+@register("sequence_pool")
+def _sequence_pool(ctx, ins, attrs):
+    x = ins["X"][0]  # [B, T, ...]
+    ptype = attrs.get("pooltype", "AVERAGE").upper()
+    seq_len = ins["SeqLen"][0] if ins.get("SeqLen") else None
+    if seq_len is None:
+        mask = jnp.ones(x.shape[:2], x.dtype)
+    else:
+        mask = _time_mask(x, seq_len)
+    mshape = mask.shape + (1,) * (x.ndim - 2)
+    m = mask.reshape(mshape)
+    if ptype == "SUM":
+        out = jnp.sum(x * m, axis=1)
+    elif ptype == "AVERAGE":
+        out = jnp.sum(x * m, axis=1) / jnp.maximum(
+            jnp.sum(m, axis=1), 1.0
+        )
+    elif ptype == "SQRT":
+        out = jnp.sum(x * m, axis=1) / jnp.sqrt(
+            jnp.maximum(jnp.sum(m, axis=1), 1.0)
+        )
+    elif ptype == "MAX":
+        neg = jnp.finfo(x.dtype).min
+        out = jnp.max(jnp.where(m > 0, x, neg), axis=1)
+    elif ptype == "LAST":
+        if seq_len is None:
+            out = x[:, -1]
+        else:
+            idx = jnp.maximum(seq_len - 1, 0).astype(jnp.int32)
+            out = jnp.take_along_axis(
+                x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)), axis=1
+            )[:, 0]
+    elif ptype == "FIRST":
+        out = x[:, 0]
+    else:
+        raise NotImplementedError("sequence_pool type %s" % ptype)
+    return {"Out": [out]}
+
+
+@register("sequence_softmax")
+def _sequence_softmax(ctx, ins, attrs):
+    x = ins["X"][0]  # [B, T] or [B, T, 1]
+    seq_len = ins["SeqLen"][0] if ins.get("SeqLen") else None
+    squeeze = x.ndim == 3
+    xx = x[..., 0] if squeeze else x
+    if seq_len is not None:
+        mask = _time_mask(xx, seq_len)
+        xx = jnp.where(mask > 0, xx, jnp.finfo(xx.dtype).min)
+    out = jax_softmax(xx)
+    if seq_len is not None:
+        out = out * mask
+    if squeeze:
+        out = out[..., None]
+    return {"Out": [out]}
+
+
+def jax_softmax(x):
+    import jax
+
+    return jax.nn.softmax(x, axis=-1)
+
+
+@register("sequence_reverse")
+def _sequence_reverse(ctx, ins, attrs):
+    x = ins["X"][0]
+    seq_len = ins["SeqLen"][0] if ins.get("SeqLen") else None
+    if seq_len is None:
+        return {"Y": [jnp.flip(x, axis=1)]}
+    t = x.shape[1]
+    ar = jnp.arange(t)[None, :]
+    idx = jnp.where(ar < seq_len[:, None], seq_len[:, None] - 1 - ar, ar)
+    idx = idx.reshape(idx.shape + (1,) * (x.ndim - 2)).astype(jnp.int32)
+    return {"Y": [jnp.take_along_axis(x, jnp.broadcast_to(idx, x.shape), axis=1)]}
+
+
+@register("sequence_expand")
+def _sequence_expand(ctx, ins, attrs):
+    # padded semantics: broadcast x [B, ...] to y's time axis [B, T, ...]
+    x, y = ins["X"][0], ins["Y"][0]
+    if x.ndim == y.ndim:
+        return {"Out": [jnp.broadcast_to(x, y.shape)]}
+    out = jnp.broadcast_to(x[:, None], (x.shape[0], y.shape[1]) + x.shape[1:])
+    return {"Out": [out]}
+
+
+@register("sequence_mask", no_grad_inputs=("X",))
+def _sequence_mask(ctx, ins, attrs):
+    x = ins["X"][0]  # lengths
+    maxlen = attrs.get("maxlen", -1)
+    if maxlen < 0:
+        raise NotImplementedError("sequence_mask requires static maxlen on TPU")
+    ar = jnp.arange(maxlen)
+    mask = (ar[None, :] < x[:, None]).astype(jnp.float32)
+    return {"Y": [mask]}
+
+
+# ---------------------------------------------------------------------------
+# layers
+# ---------------------------------------------------------------------------
+def _seq_op(op_type, input, seq_len=None, attrs=None, out_slot="Out"):
+    helper = LayerHelper(op_type)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"X": [input]}
+    if seq_len is not None:
+        inputs["SeqLen"] = [seq_len]
+    helper.append_op(op_type, inputs=inputs, outputs={out_slot: [out]}, attrs=attrs or {})
+    return out
+
+
+def sequence_pool(input, pool_type, seq_len=None):
+    return _seq_op("sequence_pool", input, seq_len, {"pooltype": pool_type.upper()})
+
+
+def sequence_first_step(input, seq_len=None):
+    return sequence_pool(input, "FIRST", seq_len)
+
+
+def sequence_last_step(input, seq_len=None):
+    return sequence_pool(input, "LAST", seq_len)
+
+
+def sequence_softmax(input, seq_len=None, use_cudnn=False, name=None):
+    return _seq_op("sequence_softmax", input, seq_len)
+
+
+def sequence_reverse(x, seq_len=None, name=None):
+    return _seq_op("sequence_reverse", x, seq_len, out_slot="Y")
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    helper = LayerHelper("sequence_expand", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "sequence_expand", inputs={"X": [x], "Y": [y]}, outputs={"Out": [out]}
+    )
+    return out
+
+
+def sequence_concat(input, name=None):
+    from . import tensor as tensor_layers
+
+    return tensor_layers.concat(input, axis=1)
+
+
+def sequence_reshape(input, new_dim):
+    from . import nn
+
+    b = input.shape[0]
+    return nn.reshape(input, [b, -1, new_dim])
+
+
+def sequence_pad(x, pad_value, maxlen=None, name=None):
+    # already padded in this representation
+    return x, None
+
+
+def sequence_unpad(x, length, name=None):
+    return x
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    helper = LayerHelper("sequence_mask", name=name)
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        "sequence_mask",
+        inputs={"X": [x]},
+        outputs={"Y": [out]},
+        attrs={"maxlen": maxlen if maxlen is not None else -1},
+    )
+    return out
+
+
+def sequence_slice(input, offset, length, name=None):
+    raise NotImplementedError("sequence_slice pending")

@@ -1,0 +1,85 @@
+"""LoD (level-of-detail) ragged-sequence support, TPU-style.
+
+The reference packs variable-length sequences without padding and carries a
+nested offset index on every tensor (``lod_tensor.h:58,110``).  That layout
+is hostile to XLA's static shapes, so the TPU-native design re-expresses
+ragged batches as **padded dense data + per-sequence lengths** (equivalently
+segment ids), the representation every sequence op lowers against
+(SURVEY.md §5.7 "padded+masked or ragged-via-segment-ids").
+
+``LoDTensor`` here is a host-side container: it accepts reference-style LoD
+(offset lists) or raw nested python lists and materializes the padded array +
+lengths that actually flow to the device.
+"""
+
+import numpy as np
+
+__all__ = ["LoDTensor", "create_lod_tensor", "lengths_to_offsets", "offsets_to_lengths"]
+
+
+def lengths_to_offsets(lengths):
+    out = [0]
+    for l in lengths:
+        out.append(out[-1] + int(l))
+    return out
+
+
+def offsets_to_lengths(offsets):
+    return [offsets[i + 1] - offsets[i] for i in range(len(offsets) - 1)]
+
+
+class LoDTensor:
+    """Padded data + recursive sequence lengths.
+
+    `data`: np.ndarray of shape [batch, max_len, *feature] (level-1 LoD) or
+    the raw dense array for lod_level=0.
+    """
+
+    def __init__(self, data, lod=None):
+        self.data = np.asarray(data)
+        # reference-style offsets per level
+        self.lod = [list(l) for l in lod] if lod else []
+
+    def lod_level(self):
+        return len(self.lod)
+
+    def seq_lens(self, level=0):
+        if not self.lod:
+            return np.full((self.data.shape[0],), self.data.shape[1], dtype=np.int32)
+        return np.asarray(offsets_to_lengths(self.lod[level]), dtype=np.int32)
+
+    def set_lod(self, lod):
+        self.lod = [list(l) for l in lod]
+
+    def shape(self):
+        return self.data.shape
+
+    def __repr__(self):
+        return "LoDTensor(shape=%s, lod=%s)" % (self.data.shape, self.lod)
+
+
+def create_lod_tensor(data, recursive_seq_lens=None, place=None):
+    """Build a padded LoDTensor from flat data + sequence lengths, or from a
+    nested list of sequences (fluid.create_lod_tensor parity,
+    python/paddle/fluid/lod_tensor.py)."""
+    if isinstance(data, list) and data and isinstance(data[0], (list, np.ndarray)):
+        seqs = [np.asarray(s) for s in data]
+        lens = [len(s) for s in seqs]
+        max_len = max(lens) if lens else 0
+        feat = seqs[0].shape[1:] if seqs[0].ndim > 1 else ()
+        out = np.zeros((len(seqs), max_len) + tuple(feat), dtype=seqs[0].dtype)
+        for i, s in enumerate(seqs):
+            out[i, : len(s)] = s
+        return LoDTensor(out, [lengths_to_offsets(lens)])
+    data = np.asarray(data)
+    if recursive_seq_lens:
+        lens = list(recursive_seq_lens[-1])
+        max_len = max(lens)
+        feat = data.shape[1:]
+        out = np.zeros((len(lens), max_len) + tuple(feat), dtype=data.dtype)
+        ofs = 0
+        for i, l in enumerate(lens):
+            out[i, :l] = data[ofs : ofs + l]
+            ofs += l
+        return LoDTensor(out, [lengths_to_offsets(lens)])
+    return LoDTensor(data)

@@ -1,0 +1,1 @@
+"""paddle_tpu.models"""

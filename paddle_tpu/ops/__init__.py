@@ -1,0 +1,17 @@
+"""Op lowering registry population.
+
+Importing this package registers every op's JAX lowering rule (the analog of
+the reference's static-initializer REGISTER_OPERATOR/REGISTER_OP_*_KERNEL
+sites, op_registry.h).
+"""
+
+from ..core import registry
+from . import common  # noqa: F401
+from . import math_ops  # noqa: F401
+from . import tensor_ops  # noqa: F401
+from . import nn_ops  # noqa: F401
+from . import optimizer_ops  # noqa: F401
+
+get_op = registry.get_op
+is_registered = registry.is_registered
+register = registry.register

@@ -1,0 +1,65 @@
+"""Shared helpers for op lowerings."""
+
+import jax.numpy as jnp
+import numpy as np
+
+# Device dtype policy: TPU has no fast int64/float64 path; map them to 32-bit
+# (the analog of the reference's kernel dtype selection).
+_DTYPE_MAP = {
+    "float64": jnp.float32,
+    "int64": jnp.int32,
+    "float32": jnp.float32,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "int32": jnp.int32,
+    "int16": jnp.int16,
+    "int8": jnp.int8,
+    "uint8": jnp.uint8,
+    "bool": jnp.bool_,
+}
+
+# Paddle framework.proto VarType ids (framework.proto:105) for scripts that
+# pass numeric dtypes.
+_PROTO_DTYPE = {
+    0: "bool",
+    1: "int16",
+    2: "int32",
+    3: "int64",
+    4: "float16",
+    5: "float32",
+    6: "float64",
+    19: "uint8",
+    20: "int8",
+    21: "bfloat16",
+}
+
+
+def jdt(dtype):
+    """attr dtype (string / numpy / proto int) -> jnp dtype for device."""
+    if isinstance(dtype, (int, np.integer)):
+        dtype = _PROTO_DTYPE[int(dtype)]
+    if not isinstance(dtype, str):
+        dtype = np.dtype(dtype).name
+    if dtype in _DTYPE_MAP:
+        return _DTYPE_MAP[dtype]
+    return jnp.dtype(dtype)
+
+
+def bcast_y(x, y, axis):
+    """Paddle elementwise broadcast: Y's shape aligns to X starting at
+    `axis` (-1 = trailing). Reshape y so numpy broadcasting applies."""
+    if x.ndim == y.ndim:
+        return y
+    if axis == -1 or axis is None:
+        axis = x.ndim - y.ndim
+    # squeeze trailing 1s paddle allows
+    yshape = list(y.shape)
+    new_shape = [1] * axis + yshape + [1] * (x.ndim - axis - len(yshape))
+    return y.reshape(new_shape)
+
+
+def unary(fn):
+    def lower(ctx, ins, attrs):
+        return {"Out": [fn(ins["X"][0], attrs)]}
+
+    return lower

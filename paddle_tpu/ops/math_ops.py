@@ -1,0 +1,462 @@
+"""Math / elementwise / reduction / activation op lowerings.
+
+TPU-native re-expression of the reference's ``paddle/fluid/operators/``
+elementwise_*, activation, reduce_ops, matmul/mul, softmax and loss ops: each
+is one pure JAX rule that XLA fuses into neighboring ops (replacing the
+hand-fused mkldnn/cudnn kernels and ``math/`` functor library).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register
+from .common import bcast_y, jdt
+
+
+# ---------------------------------------------------------------------------
+# elementwise binary ops (operators/elementwise/*)
+# ---------------------------------------------------------------------------
+def _elementwise(fn):
+    def lower(ctx, ins, attrs):
+        x, y = ins["X"][0], ins["Y"][0]
+        yb = bcast_y(x, y, attrs.get("axis", -1))
+        out = fn(x, yb)
+        scale = attrs.get("scale", None)
+        if scale is not None and scale != 1.0:
+            out = out * scale
+        return {"Out": [out]}
+
+    return lower
+
+
+for name, fn in [
+    ("elementwise_add", jnp.add),
+    ("elementwise_sub", jnp.subtract),
+    ("elementwise_mul", jnp.multiply),
+    ("elementwise_div", jnp.divide),
+    ("elementwise_max", jnp.maximum),
+    ("elementwise_min", jnp.minimum),
+    ("elementwise_pow", jnp.power),
+    ("elementwise_mod", jnp.mod),
+    ("elementwise_floordiv", jnp.floor_divide),
+]:
+    register(name)(_elementwise(fn))
+
+
+# ---------------------------------------------------------------------------
+# comparison / logical (operators/controlflow/compare_op.cc, logical_op.cc)
+# ---------------------------------------------------------------------------
+def _compare(fn):
+    def lower(ctx, ins, attrs):
+        x, y = ins["X"][0], ins["Y"][0]
+        return {"Out": [fn(x, bcast_y(x, y, attrs.get("axis", -1)))]}
+
+    return lower
+
+
+for name, fn in [
+    ("less_than", jnp.less),
+    ("less_equal", jnp.less_equal),
+    ("greater_than", jnp.greater),
+    ("greater_equal", jnp.greater_equal),
+    ("equal", jnp.equal),
+    ("not_equal", jnp.not_equal),
+]:
+    register(name, no_grad_inputs=("X", "Y"))(_compare(fn))
+
+
+@register("logical_and", no_grad_inputs=("X", "Y"))
+def _logical_and(ctx, ins, attrs):
+    return {"Out": [jnp.logical_and(ins["X"][0], ins["Y"][0])]}
+
+
+@register("logical_or", no_grad_inputs=("X", "Y"))
+def _logical_or(ctx, ins, attrs):
+    return {"Out": [jnp.logical_or(ins["X"][0], ins["Y"][0])]}
+
+
+@register("logical_not", no_grad_inputs=("X",))
+def _logical_not(ctx, ins, attrs):
+    return {"Out": [jnp.logical_not(ins["X"][0])]}
+
+
+@register("logical_xor", no_grad_inputs=("X", "Y"))
+def _logical_xor(ctx, ins, attrs):
+    return {"Out": [jnp.logical_xor(ins["X"][0], ins["Y"][0])]}
+
+
+# ---------------------------------------------------------------------------
+# activations (operators/activation_op.*)
+# ---------------------------------------------------------------------------
+def _act(fn):
+    def lower(ctx, ins, attrs):
+        return {"Out": [fn(ins["X"][0], attrs)]}
+
+    return lower
+
+
+_ACTS = {
+    "relu": lambda x, a: jnp.maximum(x, 0),
+    "sigmoid": lambda x, a: jax.nn.sigmoid(x),
+    "tanh": lambda x, a: jnp.tanh(x),
+    "sqrt": lambda x, a: jnp.sqrt(x),
+    "rsqrt": lambda x, a: jax.lax.rsqrt(x),
+    "abs": lambda x, a: jnp.abs(x),
+    "ceil": lambda x, a: jnp.ceil(x),
+    "floor": lambda x, a: jnp.floor(x),
+    "round": lambda x, a: jnp.round(x),
+    "cos": lambda x, a: jnp.cos(x),
+    "sin": lambda x, a: jnp.sin(x),
+    "exp": lambda x, a: jnp.exp(x),
+    "log": lambda x, a: jnp.log(x),
+    "square": lambda x, a: jnp.square(x),
+    "reciprocal": lambda x, a: 1.0 / x,
+    "softplus": lambda x, a: jax.nn.softplus(x),
+    "softsign": lambda x, a: x / (1 + jnp.abs(x)),
+    "relu6": lambda x, a: jnp.clip(x, 0, a.get("threshold", 6.0)),
+    "leaky_relu": lambda x, a: jnp.where(x > 0, x, a.get("alpha", 0.02) * x),
+    "elu": lambda x, a: jnp.where(x > 0, x, a.get("alpha", 1.0) * (jnp.exp(x) - 1)),
+    "gelu": lambda x, a: jax.nn.gelu(x, approximate=a.get("approximate", False)),
+    "hard_sigmoid": lambda x, a: jnp.clip(
+        a.get("slope", 0.2) * x + a.get("offset", 0.5), 0, 1
+    ),
+    "swish": lambda x, a: x * jax.nn.sigmoid(a.get("beta", 1.0) * x),
+    "brelu": lambda x, a: jnp.clip(x, a.get("t_min", 0.0), a.get("t_max", 24.0)),
+    "soft_relu": lambda x, a: jnp.log(
+        1 + jnp.exp(jnp.clip(x, -a.get("threshold", 40.0), a.get("threshold", 40.0)))
+    ),
+    "stanh": lambda x, a: a.get("scale_b", 1.7159) * jnp.tanh(a.get("scale_a", 0.67) * x),
+    "thresholded_relu": lambda x, a: jnp.where(x > a.get("threshold", 1.0), x, 0.0),
+    "hard_shrink": lambda x, a: jnp.where(jnp.abs(x) > a.get("threshold", 0.5), x, 0.0),
+    "tanh_shrink": lambda x, a: x - jnp.tanh(x),
+    "logsigmoid": lambda x, a: jax.nn.log_sigmoid(x),
+    "sign": lambda x, a: jnp.sign(x),
+    "erf": lambda x, a: jax.lax.erf(x),
+}
+for name, fn in _ACTS.items():
+    register(name)(_act(fn))
+
+
+@register("prelu")
+def _prelu(ctx, ins, attrs):
+    x, alpha = ins["X"][0], ins["Alpha"][0]
+    mode = attrs.get("mode", "all")
+    if mode == "channel":
+        alpha = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    return {"Out": [jnp.where(x > 0, x, alpha * x)]}
+
+
+@register("pow")
+def _pow(ctx, ins, attrs):
+    return {"Out": [jnp.power(ins["X"][0], attrs.get("factor", 1.0))]}
+
+
+@register("scale")
+def _scale(ctx, ins, attrs):
+    x = ins["X"][0]
+    s = attrs.get("scale", 1.0)
+    b = attrs.get("bias", 0.0)
+    if attrs.get("bias_after_scale", True):
+        return {"Out": [x * s + b]}
+    return {"Out": [(x + b) * s]}
+
+
+@register("clip")
+def _clip(ctx, ins, attrs):
+    return {"Out": [jnp.clip(ins["X"][0], attrs["min"], attrs["max"])]}
+
+
+@register("clip_by_norm")
+def _clip_by_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    max_norm = attrs["max_norm"]
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    return {"Out": [jnp.where(norm > max_norm, x * (max_norm / norm), x)]}
+
+
+@register("isfinite", no_grad_inputs=("X",))
+def _isfinite(ctx, ins, attrs):
+    # reference isfinite reduces over all inputs to a single bool
+    ok = jnp.array(True)
+    for x in ins["X"]:
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(x)))
+    return {"Out": [ok]}
+
+
+# ---------------------------------------------------------------------------
+# matmul family (operators/mul_op.cc, matmul_op.cc)
+# ---------------------------------------------------------------------------
+def _flatten2(x, ncol):
+    lead = 1
+    for d in x.shape[:ncol]:
+        lead *= d
+    rest = 1
+    for d in x.shape[ncol:]:
+        rest *= d
+    return x.reshape(lead, rest)
+
+
+@register("mul")
+def _mul(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    xn = attrs.get("x_num_col_dims", 1)
+    yn = attrs.get("y_num_col_dims", 1)
+    x2 = _flatten2(x, xn)
+    y2 = _flatten2(y, yn)
+    out = x2 @ y2
+    out_shape = tuple(x.shape[:xn]) + tuple(y.shape[yn:])
+    return {"Out": [out.reshape(out_shape)]}
+
+
+@register("matmul")
+def _matmul(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    tx, ty = attrs.get("transpose_X", False), attrs.get("transpose_Y", False)
+    alpha = attrs.get("alpha", 1.0)
+    if x.ndim == 1:
+        x = x[None, :] if not tx else x[:, None]
+    if y.ndim == 1:
+        y = y[:, None] if not ty else y[None, :]
+    if tx:
+        x = jnp.swapaxes(x, -1, -2)
+    if ty:
+        y = jnp.swapaxes(y, -1, -2)
+    out = jnp.matmul(x, y)
+    if alpha != 1.0:
+        out = out * alpha
+    return {"Out": [out]}
+
+
+@register("dot")
+def _dot(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    return {"Out": [jnp.sum(x * y, axis=-1, keepdims=True)]}
+
+
+# ---------------------------------------------------------------------------
+# reductions (operators/reduce_ops/*)
+# ---------------------------------------------------------------------------
+def _reduce(fn):
+    def lower(ctx, ins, attrs):
+        x = ins["X"][0]
+        if attrs.get("reduce_all", False):
+            axis = None
+        else:
+            dim = attrs.get("dim", [0])
+            axis = tuple(d % x.ndim for d in (dim if isinstance(dim, (list, tuple)) else [dim]))
+        out = fn(x, axis=axis, keepdims=attrs.get("keep_dim", False))
+        return {"Out": [out]}
+
+    return lower
+
+
+for name, fn in [
+    ("reduce_sum", jnp.sum),
+    ("reduce_mean", jnp.mean),
+    ("reduce_max", jnp.max),
+    ("reduce_min", jnp.min),
+    ("reduce_prod", jnp.prod),
+]:
+    register(name)(_reduce(fn))
+
+
+@register("mean")
+def _mean(ctx, ins, attrs):
+    return {"Out": [jnp.mean(ins["X"][0]).reshape(1)]}
+
+
+@register("sum")
+def _sum_op(ctx, ins, attrs):
+    xs = ins["X"]
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return {"Out": [out]}
+
+
+@register("squared_l2_norm")
+def _squared_l2_norm(ctx, ins, attrs):
+    return {"Out": [jnp.sum(jnp.square(ins["X"][0])).reshape(1)]}
+
+
+@register("frobenius_norm")
+def _frobenius_norm(ctx, ins, attrs):
+    return {"Out": [jnp.sqrt(jnp.sum(jnp.square(ins["X"][0]))).reshape(1)]}
+
+
+@register("norm")
+def _norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    eps = attrs.get("epsilon", 1e-10)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+    return {"Out": [x / norm], "Norm": [norm]}
+
+
+# ---------------------------------------------------------------------------
+# softmax & losses (operators/softmax_op, cross_entropy_op,
+# softmax_with_cross_entropy_op)
+# ---------------------------------------------------------------------------
+@register("softmax")
+def _softmax(ctx, ins, attrs):
+    axis = attrs.get("axis", -1)
+    return {"Out": [jax.nn.softmax(ins["X"][0], axis=axis)]}
+
+
+@register("log_softmax")
+def _log_softmax(ctx, ins, attrs):
+    return {"Out": [jax.nn.log_softmax(ins["X"][0], axis=attrs.get("axis", -1))]}
+
+
+def _take_label(x, label):
+    """x[..., label] along last axis; label shape [..., 1] int."""
+    lbl = label.astype(jnp.int32)
+    if lbl.ndim == x.ndim:
+        lbl = lbl[..., 0]
+    return jnp.take_along_axis(x, lbl[..., None], axis=-1)
+
+
+@register("cross_entropy", no_grad_inputs=("Label",))
+def _cross_entropy(ctx, ins, attrs):
+    x, label = ins["X"][0], ins["Label"][0]
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * jnp.log(jnp.clip(x, 1e-20, None)), axis=-1, keepdims=True)
+    else:
+        p = _take_label(x, label)
+        loss = -jnp.log(jnp.clip(p, 1e-20, None))
+    return {"Y": [loss]}
+
+
+@register("softmax_with_cross_entropy", no_grad_inputs=("Label",))
+def _softmax_xent(ctx, ins, attrs):
+    logits, label = ins["Logits"][0], ins["Label"][0]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
+    else:
+        lp = _take_label(logp, label)
+        if attrs.get("ignore_index", -100) >= 0:
+            ig = attrs["ignore_index"]
+            lbl = label if label.ndim == logits.ndim else label[..., None]
+            mask = (lbl.astype(jnp.int32) != ig).astype(logp.dtype)
+            lp = lp * mask
+        loss = -lp
+    return {"Softmax": [jnp.exp(logp)], "Loss": [loss]}
+
+
+@register("sigmoid_cross_entropy_with_logits", no_grad_inputs=("Label",))
+def _sigmoid_xent(ctx, ins, attrs):
+    x, label = ins["X"][0], ins["Label"][0]
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    ignore = attrs.get("ignore_index", -100)
+    mask = (label != ignore).astype(x.dtype)
+    return {"Out": [loss * mask]}
+
+
+@register("square_error_cost", no_grad_inputs=("Y",))
+def _square_error(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    return {"Out": [jnp.square(x - y)]}
+
+
+@register("smooth_l1_loss", no_grad_inputs=("Y",))
+def _smooth_l1(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    sigma = attrs.get("sigma", 1.0)
+    s2 = sigma * sigma
+    diff = jnp.abs(x - y)
+    loss = jnp.where(diff < 1.0 / s2, 0.5 * s2 * diff * diff, diff - 0.5 / s2)
+    loss = jnp.sum(loss.reshape(loss.shape[0], -1), axis=1, keepdims=True)
+    return {"Out": [loss], "Diff": [x - y]}
+
+
+@register("huber_loss", no_grad_inputs=("Y",))
+def _huber(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    delta = attrs.get("delta", 1.0)
+    r = y - x
+    ar = jnp.abs(r)
+    loss = jnp.where(ar <= delta, 0.5 * r * r, delta * (ar - 0.5 * delta))
+    return {"Out": [loss], "Residual": [r]}
+
+
+@register("label_smooth", no_grad_inputs=("PriorDist",))
+def _label_smooth(ctx, ins, attrs):
+    x = ins["X"][0]
+    eps = attrs.get("epsilon", 0.0)
+    prior = ins.get("PriorDist", [None])[0]
+    if prior is None:
+        prior = 1.0 / x.shape[-1]
+    return {"Out": [(1 - eps) * x + eps * prior]}
+
+
+# ---------------------------------------------------------------------------
+# metrics (operators/metrics/*)
+# ---------------------------------------------------------------------------
+@register("top_k", no_grad_inputs=("X",))
+def _top_k(ctx, ins, attrs):
+    x = ins["X"][0]
+    k = attrs["k"]
+    vals, idx = jax.lax.top_k(x, k)
+    return {"Out": [vals], "Indices": [idx.astype(jnp.int32)]}
+
+
+@register("accuracy", no_grad_inputs=("Out", "Indices", "Label"))
+def _accuracy(ctx, ins, attrs):
+    idx = ins["Indices"][0]
+    label = ins["Label"][0]
+    if label.ndim < idx.ndim:
+        label = label[..., None]
+    correct = jnp.any(idx == label.astype(idx.dtype), axis=-1)
+    total = correct.shape[0]
+    num_correct = jnp.sum(correct.astype(jnp.int32))
+    acc = num_correct.astype(jnp.float32) / total
+    return {
+        "Accuracy": [acc.reshape(1)],
+        "Correct": [num_correct.reshape(1)],
+        "Total": [jnp.array([total], jnp.int32)],
+    }
+
+
+@register("arg_max", no_grad_inputs=("X",))
+def _arg_max(ctx, ins, attrs):
+    return {"Out": [jnp.argmax(ins["X"][0], axis=attrs.get("axis", -1)).astype(jnp.int32)]}
+
+
+@register("arg_min", no_grad_inputs=("X",))
+def _arg_min(ctx, ins, attrs):
+    return {"Out": [jnp.argmin(ins["X"][0], axis=attrs.get("axis", -1)).astype(jnp.int32)]}
+
+
+@register("argsort", no_grad_inputs=("X",))
+def _argsort(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    idx = jnp.argsort(x, axis=axis)
+    return {"Out": [jnp.sort(x, axis=axis)], "Indices": [idx.astype(jnp.int32)]}
+
+
+@register("cumsum")
+def _cumsum(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    if attrs.get("flatten", False):
+        x = x.reshape(-1)
+        axis = 0
+    out = jnp.cumsum(x, axis=axis)
+    if attrs.get("reverse", False):
+        out = jnp.flip(jnp.cumsum(jnp.flip(x, axis), axis=axis), axis)
+    if attrs.get("exclusive", False):
+        out = out - x
+    return {"Out": [out]}
+
+
+@register("maximum")
+def _maximum(ctx, ins, attrs):
+    return {"Out": [jnp.maximum(ins["X"][0], ins["Y"][0])]}
+
+
+@register("minimum")
+def _minimum(ctx, ins, attrs):
+    return {"Out": [jnp.minimum(ins["X"][0], ins["Y"][0])]}

@@ -1,0 +1,468 @@
+"""Neural-net op lowerings: conv, pool, norms, dropout, rnn blocks.
+
+TPU-native replacements for the reference's cudnn/mkldnn-backed kernels
+(``operators/conv_op.*``, ``pool_op.*``, ``batch_norm_op.*``,
+``layer_norm_op.*``, ``dropout_op.*``, ``lstm_op.*``, ``gru_op.*``): convs
+map to ``lax.conv_general_dilated`` (MXU), recurrences to ``lax.scan``
+(compiled control flow instead of the reference's per-step StepScopes
+interpreter), and gradients fall out of ``jax.vjp`` — including scan-based
+RNNs.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register
+from .common import jdt
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return list(v)
+    return [v, v]
+
+
+# ---------------------------------------------------------------------------
+# convolution family
+# ---------------------------------------------------------------------------
+def _conv2d_impl(x, w, attrs, groups=None):
+    strides = _pair(attrs.get("strides", [1, 1]))
+    paddings = _pair(attrs.get("paddings", [0, 0]))
+    dilations = _pair(attrs.get("dilations", [1, 1]))
+    groups = groups if groups is not None else attrs.get("groups", 1) or 1
+    pad = [(paddings[0], paddings[0]), (paddings[1], paddings[1])]
+    return jax.lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=strides,
+        padding=pad,
+        rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.float32 else None,
+    )
+
+
+@register("conv2d")
+def _conv2d(ctx, ins, attrs):
+    x, w = ins["Input"][0], ins["Filter"][0]
+    out = _conv2d_impl(x, w, attrs)
+    if ins.get("Bias"):
+        out = out + ins["Bias"][0].reshape(1, -1, 1, 1)
+    return {"Output": [out]}
+
+
+@register("depthwise_conv2d")
+def _depthwise_conv2d(ctx, ins, attrs):
+    x, w = ins["Input"][0], ins["Filter"][0]
+    out = _conv2d_impl(x, w, attrs, groups=x.shape[1])
+    return {"Output": [out]}
+
+
+@register("conv2d_transpose")
+def _conv2d_transpose(ctx, ins, attrs):
+    x, w = ins["Input"][0], ins["Filter"][0]
+    strides = _pair(attrs.get("strides", [1, 1]))
+    paddings = _pair(attrs.get("paddings", [0, 0]))
+    dilations = _pair(attrs.get("dilations", [1, 1]))
+    groups = attrs.get("groups", 1) or 1
+    pad = [(paddings[0], paddings[0]), (paddings[1], paddings[1])]
+    # w layout: [in_c, out_c/groups, kh, kw] (paddle conv_transpose filter)
+    out = jax.lax.conv_transpose(
+        x,
+        w,
+        strides=strides,
+        padding=pad,
+        rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "IOHW", "NCHW"),
+        transpose_kernel=True,
+    )
+    return {"Output": [out]}
+
+
+@register("conv3d")
+def _conv3d(ctx, ins, attrs):
+    x, w = ins["Input"][0], ins["Filter"][0]
+    strides = attrs.get("strides", [1, 1, 1])
+    paddings = attrs.get("paddings", [0, 0, 0])
+    dilations = attrs.get("dilations", [1, 1, 1])
+    pad = [(p, p) for p in paddings]
+    out = jax.lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=strides,
+        padding=pad,
+        rhs_dilation=dilations,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=attrs.get("groups", 1) or 1,
+    )
+    return {"Output": [out]}
+
+
+# ---------------------------------------------------------------------------
+# pooling (operators/pool_op.*)
+# ---------------------------------------------------------------------------
+@register("pool2d")
+def _pool2d(ctx, ins, attrs):
+    x = ins["X"][0]
+    ptype = attrs.get("pooling_type", "max")
+    ksize = _pair(attrs.get("ksize", [2, 2]))
+    strides = _pair(attrs.get("strides", [1, 1]))
+    paddings = _pair(attrs.get("paddings", [0, 0]))
+    if attrs.get("global_pooling", False) or attrs.get("adaptive", False) and list(
+        attrs.get("ksize")
+    ) == [1, 1]:
+        if ptype == "max":
+            out = jnp.max(x, axis=(2, 3), keepdims=True)
+        else:
+            out = jnp.mean(x, axis=(2, 3), keepdims=True)
+        return {"Out": [out]}
+    window = (1, 1, ksize[0], ksize[1])
+    strides_full = (1, 1, strides[0], strides[1])
+    pads = ((0, 0), (0, 0), (paddings[0], paddings[0]), (paddings[1], paddings[1]))
+    if attrs.get("ceil_mode", False):
+        # pad right/bottom so the window count rounds up
+        extra = []
+        for i, (dim, k, s, p) in enumerate(
+            zip(x.shape[2:], ksize, strides, paddings)
+        ):
+            total = dim + 2 * p
+            rem = (total - k) % s
+            extra.append((s - rem) % s if rem else 0)
+        pads = (
+            (0, 0),
+            (0, 0),
+            (paddings[0], paddings[0] + extra[0]),
+            (paddings[1], paddings[1] + extra[1]),
+        )
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        out = jax.lax.reduce_window(x, init, jax.lax.max, window, strides_full, pads)
+    else:
+        summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides_full, pads)
+        if attrs.get("exclusive", True) and (paddings[0] or paddings[1]):
+            ones = jnp.ones_like(x)
+            counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides_full, pads)
+            out = summed / counts
+        else:
+            out = summed / (ksize[0] * ksize[1])
+    return {"Out": [out]}
+
+
+@register("adaptive_pool2d")
+def _adaptive_pool2d(ctx, ins, attrs):
+    x = ins["X"][0]
+    oh, ow = attrs["pooling_size"] if "pooling_size" in attrs else attrs["ksize"]
+    n, c, h, w = x.shape
+    assert h % oh == 0 and w % ow == 0, "adaptive pool needs divisible dims"
+    x = x.reshape(n, c, oh, h // oh, ow, w // ow)
+    if attrs.get("pooling_type", "avg") == "max":
+        return {"Out": [jnp.max(x, axis=(3, 5))]}
+    return {"Out": [jnp.mean(x, axis=(3, 5))]}
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+@register("batch_norm", no_grad_inputs=("Mean", "Variance"))
+def _batch_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    scale, bias = ins["Scale"][0], ins["Bias"][0]
+    mean, var = ins["Mean"][0], ins["Variance"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    layout = attrs.get("data_layout", "NCHW")
+    is_test = attrs.get("is_test", False) or attrs.get("use_global_stats", False) or ctx.is_test
+    ch_axis = 1 if layout == "NCHW" else x.ndim - 1
+    red_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    bshape = [1] * x.ndim
+    bshape[ch_axis] = x.shape[ch_axis]
+
+    if is_test:
+        use_mean, use_var = mean, var
+        saved_mean, saved_var = mean, var
+        mean_out, var_out = mean, var
+    else:
+        use_mean = jnp.mean(x, axis=red_axes)
+        use_var = jnp.var(x, axis=red_axes)
+        saved_mean, saved_var = use_mean, use_var
+        mean_out = momentum * mean + (1 - momentum) * use_mean
+        var_out = momentum * var + (1 - momentum) * use_var
+        # running stats are pure state updates, not differentiated through
+        mean_out = jax.lax.stop_gradient(mean_out)
+        var_out = jax.lax.stop_gradient(var_out)
+
+    inv = jax.lax.rsqrt(use_var + eps)
+    y = (x - use_mean.reshape(bshape)) * inv.reshape(bshape) * scale.reshape(
+        bshape
+    ) + bias.reshape(bshape)
+    return {
+        "Y": [y],
+        "MeanOut": [mean_out],
+        "VarianceOut": [var_out],
+        "SavedMean": [saved_mean],
+        "SavedVariance": [jax.lax.stop_gradient(inv)],
+    }
+
+
+@register("layer_norm")
+def _layer_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    begin = attrs.get("begin_norm_axis", 1)
+    eps = attrs.get("epsilon", 1e-5)
+    axes = tuple(range(begin, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    inv = jax.lax.rsqrt(var + eps)
+    y = (x - mean) * inv
+    norm_shape = x.shape[begin:]
+    if ins.get("Scale"):
+        y = y * ins["Scale"][0].reshape(norm_shape)
+    if ins.get("Bias"):
+        y = y + ins["Bias"][0].reshape(norm_shape)
+    return {
+        "Y": [y],
+        "Mean": [jax.lax.stop_gradient(mean.reshape(mean.shape[:begin]))],
+        "Variance": [jax.lax.stop_gradient(var.reshape(var.shape[:begin]))],
+    }
+
+
+@register("group_norm")
+def _group_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    g = attrs.get("groups", 32)
+    eps = attrs.get("epsilon", 1e-5)
+    n, c = x.shape[0], x.shape[1]
+    xg = x.reshape(n, g, c // g, *x.shape[2:])
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    y = ((xg - mean) * jax.lax.rsqrt(var + eps)).reshape(x.shape)
+    shp = [1, c] + [1] * (x.ndim - 2)
+    if ins.get("Scale"):
+        y = y * ins["Scale"][0].reshape(shp)
+    if ins.get("Bias"):
+        y = y + ins["Bias"][0].reshape(shp)
+    return {"Y": [y], "Mean": [mean.reshape(n, g)], "Variance": [var.reshape(n, g)]}
+
+
+@register("instance_norm")
+def _instance_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    shp = [1, x.shape[1]] + [1] * (x.ndim - 2)
+    if ins.get("Scale"):
+        y = y * ins["Scale"][0].reshape(shp)
+    if ins.get("Bias"):
+        y = y + ins["Bias"][0].reshape(shp)
+    return {"Y": [y]}
+
+
+@register("l2_normalize")
+def _l2_normalize(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    eps = attrs.get("epsilon", 1e-12)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+    return {"Out": [x / norm], "Norm": [norm]}
+
+
+@register("lrn")
+def _lrn(ctx, ins, attrs):
+    x = ins["X"][0]
+    n = attrs.get("n", 5)
+    k, alpha, beta = attrs.get("k", 2.0), attrs.get("alpha", 1e-4), attrs.get("beta", 0.75)
+    sq = jnp.square(x)
+    pad = n // 2
+    sq_pad = jnp.pad(sq, ((0, 0), (pad, pad), (0, 0), (0, 0)))
+    acc = jnp.zeros_like(x)
+    for i in range(n):
+        acc = acc + sq_pad[:, i : i + x.shape[1]]
+    mid = jnp.power(k + alpha * acc, beta)
+    return {"Out": [x / mid], "MidOut": [mid]}
+
+
+# ---------------------------------------------------------------------------
+# dropout (operators/dropout_op.*)
+# ---------------------------------------------------------------------------
+@register("dropout", needs_rng=True)
+def _dropout(ctx, ins, attrs):
+    x = ins["X"][0]
+    p = attrs.get("dropout_prob", 0.5)
+    impl = attrs.get("dropout_implementation", "downgrade_in_infer")
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    if is_test:
+        if impl == "upscale_in_train":
+            return {"Out": [x], "Mask": [jnp.ones_like(x)]}
+        return {"Out": [x * (1.0 - p)], "Mask": [jnp.ones_like(x)]}
+    keep = jax.random.bernoulli(ctx.rng(attrs), 1.0 - p, x.shape)
+    mask = keep.astype(x.dtype)
+    if impl == "upscale_in_train":
+        out = jnp.where(keep, x / (1.0 - p), 0.0)
+    else:
+        out = x * mask
+    return {"Out": [out], "Mask": [mask]}
+
+
+# ---------------------------------------------------------------------------
+# recurrent blocks: lstm / gru as scan ops
+# ---------------------------------------------------------------------------
+def _lstm_cell(c_prev, h_prev, gates, forget_bias=0.0):
+    i, f, c_hat, o = jnp.split(gates, 4, axis=-1)
+    i = jax.nn.sigmoid(i)
+    f = jax.nn.sigmoid(f + forget_bias)
+    o = jax.nn.sigmoid(o)
+    c = f * c_prev + i * jnp.tanh(c_hat)
+    h = o * jnp.tanh(c)
+    return c, h
+
+
+@register("lstm_unit")
+def _lstm_unit(ctx, ins, attrs):
+    gates, c_prev = ins["X"][0], ins["C_prev"][0]
+    c, h = _lstm_cell(c_prev, None, gates, attrs.get("forget_bias", 0.0))
+    return {"C": [c], "H": [h]}
+
+
+@register("padded_lstm")
+def _padded_lstm(ctx, ins, attrs):
+    """TPU-native LSTM over padded [batch, time, 4*hidden] projected input.
+
+    Replaces the reference's LoD-reordered `lstm_op` (sequence2batch +
+    per-step gemm): here the input projection is done outside as one big
+    matmul and the recurrence is a lax.scan over time with a length mask.
+    Inputs: Input (projected gates), Weight [hidden, 4*hidden], Bias
+    [4*hidden], optional SeqLen [batch], optional H0/C0.
+    """
+    xproj = ins["Input"][0]  # [B, T, 4H]
+    w = ins["Weight"][0]  # [H, 4H]
+    b = ins["Bias"][0] if ins.get("Bias") else None
+    seq_len = ins["SeqLen"][0] if ins.get("SeqLen") else None
+    bsz, t, h4 = xproj.shape
+    hid = h4 // 4
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((bsz, hid), xproj.dtype)
+    c0 = ins["C0"][0] if ins.get("C0") else jnp.zeros((bsz, hid), xproj.dtype)
+    is_reverse = attrs.get("is_reverse", False)
+    xs = jnp.swapaxes(xproj, 0, 1)  # [T, B, 4H]
+    if is_reverse:
+        xs = jnp.flip(xs, 0)
+    steps = jnp.arange(t)
+    if is_reverse:
+        steps = jnp.flip(steps)
+
+    def step(carry, inp):
+        c_prev, h_prev = carry
+        x_t, t_idx = inp
+        gates = x_t + h_prev @ w
+        if b is not None:
+            gates = gates + b
+        c, h = _lstm_cell(c_prev, h_prev, gates)
+        if seq_len is not None:
+            m = (t_idx < seq_len).astype(h.dtype)[:, None]
+            c = m * c + (1 - m) * c_prev
+            h = m * h + (1 - m) * h_prev
+        return (c, h), h
+
+    (c_fin, h_fin), hs = jax.lax.scan(step, (c0, h0), (xs, steps))
+    if is_reverse:
+        hs = jnp.flip(hs, 0)
+    return {
+        "Hidden": [jnp.swapaxes(hs, 0, 1)],
+        "LastH": [h_fin],
+        "LastC": [c_fin],
+    }
+
+
+@register("padded_gru")
+def _padded_gru(ctx, ins, attrs):
+    """GRU over padded [batch, time, 3*hidden] projected input (gru_op analog)."""
+    xproj = ins["Input"][0]
+    w = ins["Weight"][0]  # [H, 3H] -> [update|reset, candidate]
+    seq_len = ins["SeqLen"][0] if ins.get("SeqLen") else None
+    bsz, t, h3 = xproj.shape
+    hid = h3 // 3
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((bsz, hid), xproj.dtype)
+    w_rz = w[:, : 2 * hid]
+    w_c = w[:, 2 * hid :]
+    is_reverse = attrs.get("is_reverse", False)
+    xs = jnp.swapaxes(xproj, 0, 1)
+    if is_reverse:
+        xs = jnp.flip(xs, 0)
+    steps = jnp.arange(t)
+    if is_reverse:
+        steps = jnp.flip(steps)
+
+    def step(h_prev, inp):
+        x_t, t_idx = inp
+        x_rz = x_t[:, : 2 * hid]
+        x_c = x_t[:, 2 * hid :]
+        rz = jax.nn.sigmoid(x_rz + h_prev @ w_rz)
+        r, z = jnp.split(rz, 2, axis=-1)
+        c = jnp.tanh(x_c + (r * h_prev) @ w_c)
+        h = z * h_prev + (1 - z) * c
+        if seq_len is not None:
+            m = (t_idx < seq_len).astype(h.dtype)[:, None]
+            h = m * h + (1 - m) * h_prev
+        return h, h
+
+    h_fin, hs = jax.lax.scan(step, h0, (xs, steps))
+    if is_reverse:
+        hs = jnp.flip(hs, 0)
+    return {"Hidden": [jnp.swapaxes(hs, 0, 1)], "LastH": [h_fin]}
+
+
+# ---------------------------------------------------------------------------
+# misc nn
+# ---------------------------------------------------------------------------
+@register("relu_grad_fused_placeholder")
+def _unused(ctx, ins, attrs):
+    raise NotImplementedError
+
+
+@register("im2sequence")
+def _im2sequence(ctx, ins, attrs):
+    raise NotImplementedError("im2sequence pending")
+
+
+@register("bilinear_interp")
+def _bilinear_interp(ctx, ins, attrs):
+    x = ins["X"][0]
+    oh, ow = attrs.get("out_h"), attrs.get("out_w")
+    out = jax.image.resize(x, (x.shape[0], x.shape[1], oh, ow), method="bilinear")
+    return {"Out": [out]}
+
+
+@register("nearest_interp")
+def _nearest_interp(ctx, ins, attrs):
+    x = ins["X"][0]
+    oh, ow = attrs.get("out_h"), attrs.get("out_w")
+    out = jax.image.resize(x, (x.shape[0], x.shape[1], oh, ow), method="nearest")
+    return {"Out": [out]}
+
+
+@register("grid_sampler")
+def _grid_sampler(ctx, ins, attrs):
+    raise NotImplementedError("grid_sampler pending")
+
+
+@register("maxout")
+def _maxout(ctx, ins, attrs):
+    x = ins["X"][0]
+    g = attrs["groups"]
+    n, c, h, w = x.shape
+    return {"Out": [jnp.max(x.reshape(n, c // g, g, h, w), axis=2)]}
+
+
+@register("pixel_shuffle")
+def _pixel_shuffle(ctx, ins, attrs):
+    x = ins["X"][0]
+    r = attrs.get("upscale_factor", 2)
+    n, c, h, w = x.shape
+    x = x.reshape(n, c // (r * r), r, r, h, w)
+    x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+    return {"Out": [x.reshape(n, c // (r * r), h * r, w * r)]}

@@ -1,0 +1,202 @@
+"""Optimizer op lowerings (operators/optimizers/*).
+
+Each optimizer step is an op over (param, grad, accumulators) -> updated
+tensors, matching the reference's per-param optimizer-op design
+(``sgd_op.cc``, ``momentum_op.cc``, ``adam_op.cc``...).  In the compiled
+step, XLA fuses all per-param updates into the training executable; donation
+makes them in-place in HBM.  None of these are differentiated
+(no_grad by construction: optimizer ops sit after backward).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register
+
+
+def _lr(ins):
+    lr = ins["LearningRate"][0]
+    return lr.reshape(()) if hasattr(lr, "reshape") else lr
+
+
+@register("sgd", no_grad_inputs=("Param", "Grad", "LearningRate"))
+def _sgd(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    return {"ParamOut": [p - _lr(ins) * g.astype(p.dtype)]}
+
+
+@register("momentum", no_grad_inputs=("Param", "Grad", "Velocity", "LearningRate"))
+def _momentum(ctx, ins, attrs):
+    p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
+    mu = attrs.get("mu", 0.9)
+    lr = _lr(ins)
+    v_out = mu * v + g
+    if attrs.get("use_nesterov", False):
+        p_out = p - (g + mu * v_out) * lr
+    else:
+        p_out = p - lr * v_out
+    return {"ParamOut": [p_out], "VelocityOut": [v_out]}
+
+
+@register(
+    "lars_momentum", no_grad_inputs=("Param", "Grad", "Velocity", "LearningRate")
+)
+def _lars_momentum(ctx, ins, attrs):
+    p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
+    mu = attrs.get("mu", 0.9)
+    coeff = attrs.get("lars_coeff", 0.001)
+    decay = attrs.get("lars_weight_decay", 0.0005)
+    lr = _lr(ins)
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+    g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    local_lr = lr * coeff * p_norm / (g_norm + decay * p_norm + 1e-15)
+    v_out = mu * v + local_lr * (g + decay * p)
+    return {"ParamOut": [p - v_out], "VelocityOut": [v_out]}
+
+
+@register(
+    "adam",
+    no_grad_inputs=(
+        "Param",
+        "Grad",
+        "Moment1",
+        "Moment2",
+        "Beta1Pow",
+        "Beta2Pow",
+        "LearningRate",
+    ),
+)
+def _adam(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
+    b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
+    beta1 = attrs.get("beta1", 0.9)
+    beta2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    lr = _lr(ins)
+    g = g.astype(p.dtype)
+    m1_out = beta1 * m1 + (1 - beta1) * g
+    m2_out = beta2 * m2 + (1 - beta2) * jnp.square(g)
+    lr_t = lr * jnp.sqrt(1 - b2p.reshape(())) / (1 - b1p.reshape(()))
+    p_out = p - lr_t * m1_out / (jnp.sqrt(m2_out) + eps)
+    return {
+        "ParamOut": [p_out],
+        "Moment1Out": [m1_out],
+        "Moment2Out": [m2_out],
+        "Beta1PowOut": [b1p * beta1],
+        "Beta2PowOut": [b2p * beta2],
+    }
+
+
+@register(
+    "adamax",
+    no_grad_inputs=("Param", "Grad", "Moment", "InfNorm", "Beta1Pow", "LearningRate"),
+)
+def _adamax(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m, u = ins["Moment"][0], ins["InfNorm"][0]
+    b1p = ins["Beta1Pow"][0]
+    beta1, beta2 = attrs.get("beta1", 0.9), attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    lr = _lr(ins)
+    m_out = beta1 * m + (1 - beta1) * g
+    u_out = jnp.maximum(beta2 * u, jnp.abs(g))
+    p_out = p - (lr / (1 - b1p.reshape(()))) * m_out / (u_out + eps)
+    return {"ParamOut": [p_out], "MomentOut": [m_out], "InfNormOut": [u_out]}
+
+
+@register("adagrad", no_grad_inputs=("Param", "Grad", "Moment", "LearningRate"))
+def _adagrad(ctx, ins, attrs):
+    p, g, m = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    eps = attrs.get("epsilon", 1e-6)
+    m_out = m + jnp.square(g)
+    p_out = p - _lr(ins) * g / (jnp.sqrt(m_out) + eps)
+    return {"ParamOut": [p_out], "MomentOut": [m_out]}
+
+
+@register(
+    "decayed_adagrad", no_grad_inputs=("Param", "Grad", "Moment", "LearningRate")
+)
+def _decayed_adagrad(ctx, ins, attrs):
+    p, g, m = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    decay = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    m_out = decay * m + (1 - decay) * jnp.square(g)
+    p_out = p - _lr(ins) * g / (jnp.sqrt(m_out) + eps)
+    return {"ParamOut": [p_out], "MomentOut": [m_out]}
+
+
+@register(
+    "adadelta", no_grad_inputs=("Param", "Grad", "AvgSquaredGrad", "AvgSquaredUpdate")
+)
+def _adadelta(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    asg, asu = ins["AvgSquaredGrad"][0], ins["AvgSquaredUpdate"][0]
+    rho = attrs.get("rho", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    asg_out = rho * asg + (1 - rho) * jnp.square(g)
+    update = -jnp.sqrt((asu + eps) / (asg_out + eps)) * g
+    asu_out = rho * asu + (1 - rho) * jnp.square(update)
+    return {
+        "ParamOut": [p + update],
+        "AvgSquaredGradOut": [asg_out],
+        "AvgSquaredUpdateOut": [asu_out],
+    }
+
+
+@register(
+    "rmsprop",
+    no_grad_inputs=("Param", "Grad", "Moment", "MeanSquare", "MeanGrad", "LearningRate"),
+)
+def _rmsprop(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    mom, ms = ins["Moment"][0], ins["MeanSquare"][0]
+    rho = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    momentum = attrs.get("momentum", 0.0)
+    lr = _lr(ins)
+    ms_out = rho * ms + (1 - rho) * jnp.square(g)
+    if attrs.get("centered", False):
+        mg = ins["MeanGrad"][0]
+        mg_out = rho * mg + (1 - rho) * g
+        denom = ms_out - jnp.square(mg_out) + eps
+    else:
+        mg_out = ins["MeanGrad"][0] if ins.get("MeanGrad") else jnp.zeros_like(p)
+        denom = ms_out + eps
+    mom_out = momentum * mom + lr * g / jnp.sqrt(denom)
+    return {
+        "ParamOut": [p - mom_out],
+        "MomentOut": [mom_out],
+        "MeanSquareOut": [ms_out],
+        "MeanGradOut": [mg_out],
+    }
+
+
+@register(
+    "ftrl",
+    no_grad_inputs=("Param", "Grad", "SquaredAccumulator", "LinearAccumulator", "LearningRate"),
+)
+def _ftrl(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    sq, lin = ins["SquaredAccumulator"][0], ins["LinearAccumulator"][0]
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    lr_power = attrs.get("lr_power", -0.5)
+    lr = _lr(ins)
+    new_sq = sq + jnp.square(g)
+    if lr_power == -0.5:
+        sigma = (jnp.sqrt(new_sq) - jnp.sqrt(sq)) / lr
+    else:
+        sigma = (jnp.power(new_sq, -lr_power) - jnp.power(sq, -lr_power)) / lr
+    new_lin = lin + g - sigma * p
+    if lr_power == -0.5:
+        denom = jnp.sqrt(new_sq) / lr + 2 * l2
+    else:
+        denom = jnp.power(new_sq, -lr_power) / lr + 2 * l2
+    pre = jnp.clip(new_lin, -l1, l1) - new_lin
+    p_out = pre / denom
+    return {
+        "ParamOut": [p_out],
+        "SquaredAccumOut": [new_sq],
+        "LinearAccumOut": [new_lin],
+    }

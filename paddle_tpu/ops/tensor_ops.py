@@ -1,0 +1,415 @@
+"""Tensor creation / manipulation op lowerings.
+
+Covers the reference's fill/rand init ops, reshape/transpose/concat/split/
+slice family, cast, gather/scatter, lookup_table (embedding), one_hot, etc.
+(various files under ``paddle/fluid/operators/``).  Random ops draw from the
+trace RNG key via ``ctx.rng`` — the functional replacement for the
+reference's per-op seed + global generator.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register
+from .common import jdt
+
+
+# ---------------------------------------------------------------------------
+# creation ops
+# ---------------------------------------------------------------------------
+@register("fill_constant")
+def _fill_constant(ctx, ins, attrs):
+    shape = attrs.get("shape", [1])
+    dtype = jdt(attrs.get("dtype", "float32"))
+    value = attrs.get("value", 0.0)
+    return {"Out": [jnp.full(tuple(int(s) for s in shape), value, dtype=dtype)]}
+
+
+@register("fill_constant_batch_size_like")
+def _fill_constant_bsl(ctx, ins, attrs):
+    x = ins["Input"][0]
+    shape = list(attrs.get("shape"))
+    in_dim = attrs.get("input_dim_idx", 0)
+    out_dim = attrs.get("output_dim_idx", 0)
+    shape[out_dim] = x.shape[in_dim]
+    dtype = jdt(attrs.get("dtype", "float32"))
+    return {"Out": [jnp.full(tuple(shape), attrs.get("value", 0.0), dtype=dtype)]}
+
+
+@register("fill_zeros_like")
+def _fill_zeros_like(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [jnp.zeros_like(x)]}
+
+
+@register("fill_any_like")
+def _fill_any_like(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [jnp.full_like(x, attrs.get("value", 0.0))]}
+
+
+@register("uniform_random", needs_rng=True)
+def _uniform_random(ctx, ins, attrs):
+    shape = tuple(int(s) for s in attrs["shape"])
+    dtype = jdt(attrs.get("dtype", "float32"))
+    lo, hi = attrs.get("min", -1.0), attrs.get("max", 1.0)
+    out = jax.random.uniform(ctx.rng(attrs), shape, dtype=jnp.float32, minval=lo, maxval=hi)
+    return {"Out": [out.astype(dtype)]}
+
+
+@register("gaussian_random", needs_rng=True)
+def _gaussian_random(ctx, ins, attrs):
+    shape = tuple(int(s) for s in attrs["shape"])
+    dtype = jdt(attrs.get("dtype", "float32"))
+    mean, std = attrs.get("mean", 0.0), attrs.get("std", 1.0)
+    out = jax.random.normal(ctx.rng(attrs), shape, dtype=jnp.float32) * std + mean
+    return {"Out": [out.astype(dtype)]}
+
+
+@register("truncated_gaussian_random", needs_rng=True)
+def _truncated_gaussian_random(ctx, ins, attrs):
+    shape = tuple(int(s) for s in attrs["shape"])
+    dtype = jdt(attrs.get("dtype", "float32"))
+    mean, std = attrs.get("mean", 0.0), attrs.get("std", 1.0)
+    out = jax.random.truncated_normal(ctx.rng(attrs), -2.0, 2.0, shape, jnp.float32)
+    return {"Out": [(out * std + mean).astype(dtype)]}
+
+
+@register("randint", needs_rng=True, no_grad_inputs=("X",))
+def _randint(ctx, ins, attrs):
+    shape = tuple(int(s) for s in attrs["shape"])
+    out = jax.random.randint(
+        ctx.rng(attrs), shape, attrs.get("low", 0), attrs.get("high", 100)
+    )
+    return {"Out": [out.astype(jdt(attrs.get("dtype", "int64")))]}
+
+
+@register("range", no_grad_inputs=("Start", "End", "Step"))
+def _range(ctx, ins, attrs):
+    # static variant: attrs carry values (layers.arange)
+    start = attrs.get("start", 0)
+    end = attrs.get("end")
+    step = attrs.get("step", 1)
+    dtype = jdt(attrs.get("dtype", "int64"))
+    return {"Out": [jnp.arange(start, end, step, dtype=dtype)]}
+
+
+@register("assign")
+def _assign(ctx, ins, attrs):
+    return {"Out": [ins["X"][0]]}
+
+
+@register("assign_value")
+def _assign_value(ctx, ins, attrs):
+    vals = np.array(attrs["values"], dtype=np.dtype(attrs.get("np_dtype", "float32")))
+    shape = attrs.get("shape", None)
+    if shape:
+        vals = vals.reshape(shape)
+    return {"Out": [jnp.asarray(vals, dtype=jdt(str(vals.dtype)))]}
+
+
+@register("shape", no_grad_inputs=("Input",))
+def _shape(ctx, ins, attrs):
+    x = ins["Input"][0]
+    return {"Out": [jnp.array(x.shape, dtype=jnp.int32)]}
+
+
+# ---------------------------------------------------------------------------
+# shape manipulation
+# ---------------------------------------------------------------------------
+def _resolve_reshape(x, shape):
+    shape = list(shape)
+    for i, s in enumerate(shape):
+        if s == 0:
+            shape[i] = x.shape[i]
+    if -1 in shape:
+        known = 1
+        for s in shape:
+            if s != -1:
+                known *= s
+        shape[shape.index(-1)] = int(np.prod(x.shape) // known)
+    return tuple(shape)
+
+
+@register("reshape")
+@register("reshape2")
+def _reshape(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [x.reshape(_resolve_reshape(x, attrs["shape"]))]}
+
+
+@register("transpose")
+@register("transpose2")
+def _transpose(ctx, ins, attrs):
+    return {"Out": [jnp.transpose(ins["X"][0], attrs["axis"])]}
+
+
+@register("flatten")
+@register("flatten2")
+def _flatten(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 1)
+    lead = int(np.prod(x.shape[:axis])) if axis > 0 else 1
+    return {"Out": [x.reshape(lead, -1)]}
+
+
+@register("squeeze")
+@register("squeeze2")
+def _squeeze(ctx, ins, attrs):
+    x = ins["X"][0]
+    axes = attrs.get("axes", [])
+    if not axes:
+        return {"Out": [jnp.squeeze(x)]}
+    return {"Out": [jnp.squeeze(x, axis=tuple(a % x.ndim for a in axes))]}
+
+
+@register("unsqueeze")
+@register("unsqueeze2")
+def _unsqueeze(ctx, ins, attrs):
+    x = ins["X"][0]
+    for a in sorted(attrs["axes"]):
+        x = jnp.expand_dims(x, a)
+    return {"Out": [x]}
+
+
+@register("concat")
+def _concat(ctx, ins, attrs):
+    return {"Out": [jnp.concatenate(ins["X"], axis=attrs.get("axis", 0))]}
+
+
+@register("split")
+def _split(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 0)
+    num = attrs.get("num", 0)
+    sections = attrs.get("sections", [])
+    if sections:
+        idx = np.cumsum(sections)[:-1].tolist()
+        outs = jnp.split(x, idx, axis=axis)
+    else:
+        outs = jnp.split(x, num, axis=axis)
+    return {"Out": list(outs)}
+
+
+@register("stack")
+def _stack(ctx, ins, attrs):
+    return {"Y": [jnp.stack(ins["X"], axis=attrs.get("axis", 0))]}
+
+
+@register("unstack")
+def _unstack(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 0)
+    outs = [jnp.squeeze(s, axis) for s in jnp.split(x, x.shape[axis], axis)]
+    return {"Y": outs}
+
+
+@register("slice")
+def _slice(ctx, ins, attrs):
+    x = ins["Input"][0]
+    axes, starts, ends = attrs["axes"], attrs["starts"], attrs["ends"]
+    idx = [slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        dim = x.shape[a]
+        s = max(s + dim, 0) if s < 0 else min(s, dim)
+        e = max(e + dim, 0) if e < 0 else min(e, dim)
+        idx[a] = slice(s, e)
+    out = x[tuple(idx)]
+    for a in sorted(attrs.get("decrease_axis", []), reverse=True):
+        out = jnp.squeeze(out, a)
+    return {"Out": [out]}
+
+
+@register("strided_slice")
+def _strided_slice(ctx, ins, attrs):
+    x = ins["Input"][0]
+    idx = [slice(None)] * x.ndim
+    for a, s, e, st in zip(attrs["axes"], attrs["starts"], attrs["ends"], attrs["strides"]):
+        idx[a] = slice(s, e, st)
+    return {"Out": [x[tuple(idx)]]}
+
+
+@register("expand")
+def _expand(ctx, ins, attrs):
+    x = ins["X"][0]
+    times = attrs["expand_times"]
+    return {"Out": [jnp.tile(x, times)]}
+
+
+@register("expand_as")
+def _expand_as(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["target_tensor"][0]
+    reps = [t // s for s, t in zip(x.shape, y.shape)]
+    return {"Out": [jnp.tile(x, reps)]}
+
+
+@register("tile")
+def _tile(ctx, ins, attrs):
+    return {"Out": [jnp.tile(ins["X"][0], attrs["repeat_times"])]}
+
+
+@register("cast")
+def _cast(ctx, ins, attrs):
+    out_dtype = jdt(attrs["out_dtype"])
+    return {"Out": [ins["X"][0].astype(out_dtype)]}
+
+
+@register("pad")
+def _pad(ctx, ins, attrs):
+    x = ins["X"][0]
+    paddings = attrs["paddings"]
+    pad_width = [(paddings[2 * i], paddings[2 * i + 1]) for i in range(x.ndim)]
+    return {
+        "Out": [jnp.pad(x, pad_width, constant_values=attrs.get("pad_value", 0.0))]
+    }
+
+
+@register("pad2d")
+def _pad2d(ctx, ins, attrs):
+    x = ins["X"][0]
+    p = attrs["paddings"]  # [top, bottom, left, right]
+    mode = attrs.get("mode", "constant")
+    pw = [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])]
+    if attrs.get("data_format", "NCHW") == "NHWC":
+        pw = [(0, 0), (p[0], p[1]), (p[2], p[3]), (0, 0)]
+    jmode = {"constant": "constant", "reflect": "reflect", "edge": "edge"}[mode]
+    kw = {"constant_values": attrs.get("pad_value", 0.0)} if mode == "constant" else {}
+    return {"Out": [jnp.pad(x, pw, mode=jmode, **kw)]}
+
+
+@register("reverse")
+def _reverse(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [jnp.flip(x, axis=tuple(attrs["axis"]))]}
+
+
+@register("roll")
+def _roll(ctx, ins, attrs):
+    return {"Out": [jnp.roll(ins["X"][0], attrs["shifts"], attrs.get("axis"))]}
+
+
+# ---------------------------------------------------------------------------
+# gather / scatter / embedding
+# ---------------------------------------------------------------------------
+@register("gather", no_grad_inputs=("Index",))
+def _gather(ctx, ins, attrs):
+    x, idx = ins["X"][0], ins["Index"][0]
+    return {"Out": [jnp.take(x, idx.astype(jnp.int32), axis=attrs.get("axis", 0))]}
+
+
+@register("gather_nd", no_grad_inputs=("Index",))
+def _gather_nd(ctx, ins, attrs):
+    x, idx = ins["X"][0], ins["Index"][0].astype(jnp.int32)
+    return {"Out": [x[tuple(jnp.moveaxis(idx, -1, 0))]]}
+
+
+@register("scatter", no_grad_inputs=("Ids",))
+def _scatter(ctx, ins, attrs):
+    x, ids, updates = ins["X"][0], ins["Ids"][0].astype(jnp.int32), ins["Updates"][0]
+    if attrs.get("overwrite", True):
+        return {"Out": [x.at[ids].set(updates)]}
+    return {"Out": [x.at[ids].add(updates)]}
+
+
+@register("lookup_table", no_grad_inputs=("Ids",))
+@register("lookup_table_v2", no_grad_inputs=("Ids",))
+def _lookup_table(ctx, ins, attrs):
+    w, ids = ins["W"][0], ins["Ids"][0]
+    ids = ids.astype(jnp.int32)
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+    out = jnp.take(w, ids, axis=0)
+    pad = attrs.get("padding_idx", -1)
+    if pad is not None and pad != -1:
+        mask = (ids != pad).astype(out.dtype)[..., None]
+        out = out * mask
+    return {"Out": [out]}
+
+
+@register("one_hot", no_grad_inputs=("X",))
+def _one_hot(ctx, ins, attrs):
+    x = ins["X"][0].astype(jnp.int32)
+    if x.ndim >= 2 and x.shape[-1] == 1:
+        x = x[..., 0]
+    return {"Out": [jax.nn.one_hot(x, attrs["depth"], dtype=jnp.float32)]}
+
+
+@register("index_select", no_grad_inputs=("Index",))
+def _index_select(ctx, ins, attrs):
+    x, idx = ins["X"][0], ins["Index"][0].astype(jnp.int32)
+    return {"Out": [jnp.take(x, idx, axis=attrs.get("dim", 0))]}
+
+
+@register("where", no_grad_inputs=("Condition",))
+def _where(ctx, ins, attrs):
+    return {"Out": [jnp.where(ins["Condition"][0], ins["X"][0], ins["Y"][0])]}
+
+
+@register("where_index", no_grad_inputs=("Condition",))
+def _where_index(ctx, ins, attrs):
+    # dynamic-size output: returns padded index list (size = numel)
+    cond = ins["Condition"][0]
+    idx = jnp.stack(jnp.nonzero(cond, size=cond.size, fill_value=-1), axis=-1)
+    return {"Out": [idx.astype(jnp.int32)]}
+
+
+@register("increment")
+def _increment(ctx, ins, attrs):
+    return {"Out": [ins["X"][0] + attrs.get("step", 1.0)]}
+
+
+@register("print", no_grad_inputs=("In",))
+def _print(ctx, ins, attrs):
+    x = ins["In"][0]
+    jax.debug.print(attrs.get("message", "") + " {}", x)
+    return {"Out": [x]}
+
+
+@register("linspace")
+def _linspace(ctx, ins, attrs):
+    return {
+        "Out": [
+            jnp.linspace(
+                attrs["start"], attrs["stop"], attrs["num"], dtype=jdt(attrs.get("dtype", "float32"))
+            )
+        ]
+    }
+
+
+@register("eye")
+def _eye(ctx, ins, attrs):
+    return {
+        "Out": [
+            jnp.eye(
+                attrs["num_rows"],
+                attrs.get("num_columns", None),
+                dtype=jdt(attrs.get("dtype", "float32")),
+            )
+        ]
+    }
+
+
+@register("diag")
+def _diag(ctx, ins, attrs):
+    return {"Out": [jnp.diag(ins["Diagonal"][0])]}
+
+
+@register("meshgrid")
+def _meshgrid(ctx, ins, attrs):
+    outs = jnp.meshgrid(*ins["X"], indexing="ij")
+    return {"Out": list(outs)}
+
+
+@register("uniform_random_batch_size_like", needs_rng=True)
+def _uniform_random_bsl(ctx, ins, attrs):
+    x = ins["Input"][0]
+    shape = list(attrs["shape"])
+    shape[attrs.get("output_dim_idx", 0)] = x.shape[attrs.get("input_dim_idx", 0)]
+    out = jax.random.uniform(
+        ctx.rng(attrs),
+        tuple(shape),
+        minval=attrs.get("min", -1.0),
+        maxval=attrs.get("max", 1.0),
+    )
+    return {"Out": [out.astype(jdt(attrs.get("dtype", "float32")))]}

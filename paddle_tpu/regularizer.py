@@ -1,0 +1,92 @@
+"""Weight-decay regularizers (python/paddle/fluid/regularizer.py analog):
+appended as ops onto gradients before the optimizer ops (regularizer.py:23)."""
+
+from . import framework
+
+__all__ = ["L1Decay", "L2Decay", "L1DecayRegularizer", "L2DecayRegularizer",
+           "append_regularization_ops"]
+
+
+class WeightDecayRegularizer:
+    def __call__(self, param, grad, block):
+        raise NotImplementedError
+
+
+class L2DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self._coeff = regularization_coeff
+
+    def __call__(self, param, grad, block):
+        decay = block.create_var(
+            name=framework.unique_name.generate(param.name + "_l2decay"),
+            shape=param.shape,
+            dtype=param.dtype,
+            stop_gradient=True,
+        )
+        block.append_op(
+            "scale",
+            inputs={"X": [param]},
+            outputs={"Out": [decay]},
+            attrs={"scale": self._coeff},
+        )
+        return decay
+
+
+class L1DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self._coeff = regularization_coeff
+
+    def __call__(self, param, grad, block):
+        sign = block.create_var(
+            name=framework.unique_name.generate(param.name + "_sign"),
+            shape=param.shape,
+            dtype=param.dtype,
+            stop_gradient=True,
+        )
+        block.append_op("sign", inputs={"X": [param]}, outputs={"Out": [sign]})
+        decay = block.create_var(
+            name=framework.unique_name.generate(param.name + "_l1decay"),
+            shape=param.shape,
+            dtype=param.dtype,
+            stop_gradient=True,
+        )
+        block.append_op(
+            "scale",
+            inputs={"X": [sign]},
+            outputs={"Out": [decay]},
+            attrs={"scale": self._coeff},
+        )
+        return decay
+
+
+def append_regularization_ops(parameters_and_grads, regularization=None):
+    params_and_grads = []
+    block = framework.default_main_program().global_block()
+    for param, grad in parameters_and_grads:
+        regularization_term = None
+        if grad is None:
+            params_and_grads.append((param, grad))
+            continue
+        reg = param.regularizer or regularization
+        if reg is not None:
+            regularization_term = reg(param, grad, block)
+        if regularization_term is None:
+            params_and_grads.append((param, grad))
+            continue
+        new_grad = block.create_var(
+            name=framework.unique_name.generate(grad.name + "_reg"),
+            shape=grad.shape,
+            dtype=grad.dtype,
+            stop_gradient=True,
+        )
+        block.append_op(
+            "sum",
+            inputs={"X": [grad, regularization_term]},
+            outputs={"Out": [new_grad]},
+        )
+        params_and_grads.append((param, new_grad))
+    return params_and_grads
+
+
+L1Decay = L1DecayRegularizer
+L2Decay = L2DecayRegularizer

@@ -1,0 +1,50 @@
+"""Unique name generator for variables/parameters.
+
+TPU-native re-implementation of the naming utility the reference keeps in
+``python/paddle/fluid/unique_name.py``: a process-wide counter per key plus a
+``guard`` that layers use so parameter names like ``fc_0.w_0`` are stable and
+collision-free across a program build.
+"""
+
+import contextlib
+import threading
+
+
+class UniqueNameGenerator:
+    def __init__(self, prefix=""):
+        self.ids = {}
+        self.prefix = prefix
+        self._lock = threading.Lock()
+
+    def __call__(self, key):
+        with self._lock:
+            if key not in self.ids:
+                self.ids[key] = 0
+            tmp = self.ids[key]
+            self.ids[key] += 1
+        return self.prefix + "_".join([key, str(tmp)])
+
+
+generator = UniqueNameGenerator()
+
+
+def generate(key):
+    return generator(key)
+
+
+def switch(new_generator=None):
+    global generator
+    old = generator
+    generator = new_generator if new_generator is not None else UniqueNameGenerator()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    if isinstance(new_generator, str):
+        new_generator = UniqueNameGenerator(new_generator)
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        switch(old)

@@ -1,0 +1,42 @@
+"""Test harness config: force an 8-device virtual CPU mesh (the TPU-sim
+test topology per the build plan) before JAX initializes.
+
+Note: the sandbox autoloads a TPU-tunnel PJRT plugin via sitecustomize that
+overrides jax_platforms; tests must run CPU-only, so we pin the config back
+to cpu and clear the plugin's env gate for any subprocesses.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # stop plugin load in subprocesses
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    """Each test gets fresh default programs, scope and name counters."""
+    import paddle_tpu as fluid
+    from paddle_tpu import framework, unique_name
+    from paddle_tpu.core import scope as scope_mod
+
+    old_main = framework.switch_main_program(fluid.Program())
+    old_startup = framework.switch_startup_program(fluid.Program())
+    old_gen = unique_name.switch()
+    old_scope = scope_mod._switch_scope(scope_mod.Scope())
+    yield
+    framework.switch_main_program(old_main)
+    framework.switch_startup_program(old_startup)
+    unique_name.switch(old_gen)
+    scope_mod._switch_scope(old_scope)

@@ -1,0 +1,59 @@
+"""Control flow: While -> lax.while_loop, cond -> lax.cond, calc_gradient."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def test_while_loop_sums_to_n():
+    i = layers.fill_constant([1], "float32", 0.0)
+    n = layers.fill_constant([1], "float32", 10.0)
+    total = layers.fill_constant([1], "float32", 0.0)
+    cond = layers.less_than(i, n)
+    w = layers.While(cond)
+    with w.block():
+        t2 = layers.elementwise_add(total, i)
+        layers.assign(t2, total)
+        layers.increment(i, 1.0)
+        layers.less_than(i, n, cond=cond)
+    exe = fluid.Executor(fluid.CPUPlace())
+    (out,) = exe.run(fetch_list=[total])
+    assert float(out[0]) == 45.0  # 0+1+..+9
+
+
+def test_cond_branches():
+    x = layers.data("x", shape=[1], append_batch_size=False)
+    pred = layers.greater_than(x, layers.fill_constant([1], "float32", 0.0))
+
+    def true_fn():
+        return layers.elementwise_mul(x, x)
+
+    def false_fn():
+        return layers.scale(x, -1.0)
+
+    out = fluid.layers.control_flow.cond(pred, true_fn, false_fn)
+    exe = fluid.Executor(fluid.CPUPlace())
+    (r,) = exe.run(feed={"x": np.array([3.0], "float32")}, fetch_list=[out])
+    assert float(r[0]) == 9.0
+    (r,) = exe.run(feed={"x": np.array([-4.0], "float32")}, fetch_list=[out])
+    assert float(r[0]) == 4.0
+
+
+def test_calc_gradient():
+    x = layers.data("x", shape=[4], append_batch_size=False, stop_gradient=False)
+    y = layers.reduce_sum(layers.square(x))
+    (gx,) = fluid.backward.calc_gradient(y, x)
+    assert gx is not None
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.array([1.0, 2.0, 3.0, 4.0], "float32")
+    (g,) = exe.run(feed={"x": xv}, fetch_list=[gx])
+    np.testing.assert_allclose(g, 2 * xv, rtol=1e-6)
+
+
+def test_numpy_scalar_operand():
+    x = layers.data("x", shape=[3], append_batch_size=False)
+    y = x * np.float32(2.0) + np.float32(1.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    (r,) = exe.run(feed={"x": np.ones(3, "float32")}, fetch_list=[y])
+    np.testing.assert_allclose(r, [3.0, 3.0, 3.0])

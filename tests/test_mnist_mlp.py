@@ -1,0 +1,90 @@
+"""End-to-end slice: MLP + conv-net training on synthetic MNIST-shaped data.
+
+Mirrors the reference's book test contract (tests/book/test_recognize_digits):
+build program -> startup -> train steps -> loss decreases -> save/load ->
+infer.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _synthetic_batch(bs=64, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(bs, 784).astype("float32")
+    # learnable mapping: label depends on mean of pixel blocks
+    y = (x[:, :10].sum(axis=1) * 10 % 10).astype("int64").reshape(bs, 1)
+    return x, y
+
+
+def test_mlp_train_loss_decreases():
+    img = fluid.layers.data("img", shape=[784])
+    label = fluid.layers.data("label", shape=[1], dtype="int64")
+    hidden = fluid.layers.fc(img, size=64, act="relu")
+    pred = fluid.layers.fc(hidden, size=10, act="softmax")
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+    acc = fluid.layers.accuracy(pred, label)
+    opt = fluid.optimizer.SGD(learning_rate=0.5)
+    opt.minimize(loss)
+
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+
+    losses = []
+    for i in range(30):
+        x, y = _synthetic_batch(seed=i % 5)
+        lv, av = exe.run(feed={"img": x, "label": y}, fetch_list=[loss, acc])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.9, losses
+    assert np.isfinite(losses).all()
+
+
+def test_conv_net_with_batchnorm_and_adam():
+    img = fluid.layers.data("img", shape=[1, 28, 28])
+    label = fluid.layers.data("label", shape=[1], dtype="int64")
+    c1 = fluid.layers.conv2d(img, num_filters=8, filter_size=3, padding=1, act=None)
+    b1 = fluid.layers.batch_norm(c1, act="relu")
+    p1 = fluid.layers.pool2d(b1, pool_size=2, pool_stride=2)
+    pred = fluid.layers.fc(p1, size=10, act="softmax")
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    losses = []
+    for i in range(15):
+        x = rng.rand(16, 1, 28, 28).astype("float32")
+        y = (x.mean(axis=(1, 2, 3)) * 30 % 10).astype("int64").reshape(16, 1)
+        (lv,) = exe.run(feed={"img": x, "label": y}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_save_load_inference_roundtrip(tmp_path):
+    img = fluid.layers.data("img", shape=[784])
+    label = fluid.layers.data("label", shape=[1], dtype="int64")
+    pred = fluid.layers.fc(img, size=10, act="softmax")
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+    test_program = fluid.default_main_program().clone(for_test=True)
+    fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    x, y = _synthetic_batch(8)
+    exe.run(feed={"img": x, "label": y}, fetch_list=[loss])
+    (before,) = exe.run(test_program, feed={"img": x}, fetch_list=[pred])
+
+    model_dir = str(tmp_path / "model")
+    fluid.save_inference_model(model_dir, ["img"], [pred], exe)
+
+    # fresh scope + program: load and compare
+    with fluid.scope_guard(fluid.Scope()):
+        infer_prog, feed_names, fetch_vars = fluid.load_inference_model(model_dir, exe)
+        (after,) = exe.run(
+            infer_prog, feed={feed_names[0]: x}, fetch_list=fetch_vars
+        )
+    np.testing.assert_allclose(before, after, rtol=1e-5, atol=1e-6)
