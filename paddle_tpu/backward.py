@@ -133,9 +133,17 @@ def append_backward(loss, parameter_list=None, no_grad_set=None, callbacks=None)
                     filled.append(g)
             gin[slot + "@GRAD"] = filled
 
-        # outputs: grads of differentiable float inputs
+        # outputs: grads of differentiable float inputs (slots the op's
+        # registry entry marks no-grad — e.g. lookup_table Ids, optimizer
+        # state — never get grad vars, matching what lower_grad_op produces)
+        from .core.registry import OPS
+
+        opdef = OPS.get(op.type)
+        no_grad_slots = opdef.no_grad_inputs if opdef else set()
         gout = {}
         for slot, names in op.inputs.items():
+            if slot in no_grad_slots:
+                continue
             outs = []
             produce = False
             for n in names:

@@ -72,16 +72,18 @@ class LowerCtx:
         self.trace_block = None  # fn(block_idx, env) for control-flow ops
 
     def rng(self, attrs=None, salt=0):
-        """Key for a randomness-consuming op. A nonzero `seed` attr pins the
-        stream (dropout determinism parity: operator-level seed attrs)."""
+        """Key for a randomness-consuming op.  The step key (rng_key, which
+        the executor advances every run) is always in the mix so seeded
+        dropout still varies per step; a nonzero `seed` attr replaces the
+        op-position fold so ops sharing a seed share a stream (reference
+        per-op seed-attr semantics)."""
         seed = int(attrs.get("seed", 0)) if attrs else 0
+        key = self.rng_key if self.rng_key is not None else jax.random.PRNGKey(0)
         if seed:
-            key = jax.random.PRNGKey(seed)
-        elif self.rng_key is not None:
-            key = self.rng_key
+            key = jax.random.fold_in(key, seed)
         else:
-            key = jax.random.PRNGKey(0)
-        return jax.random.fold_in(jax.random.fold_in(key, self.op_idx), salt)
+            key = jax.random.fold_in(key, self.op_idx)
+        return jax.random.fold_in(key, salt)
 
 
 def _is_float(x):

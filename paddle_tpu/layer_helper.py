@@ -20,9 +20,10 @@ from .initializer import Constant, Xavier
 from .param_attr import ParamAttr
 from .ops.common import jdt
 
-# sentinel for unknown (-1) dims during abstract shape inference; prime and
-# unlikely to collide with a computed static extent
-_DYN = 97
+# sentinel for unknown (-1) dims during abstract shape inference; a large
+# prime so collision with a real static extent is practically impossible
+# (abstract eval allocates nothing, so the size is free)
+_DYN = 1000003
 
 
 def _abstract_inputs(op, block):

@@ -88,22 +88,28 @@ def polynomial_decay(
     return (learning_rate - end_learning_rate) * ((1 - frac) ** power) + end_learning_rate
 
 
+def _step_lt(step, bound):
+    """exact float32 mask: 1.0 while step < bound, else 0.0 (branch-free,
+    compiles to a select — replaces per-step scalar control flow)."""
+    from . import control_flow
+
+    b = tensor.fill_constant([1], "float32", float(bound))
+    return tensor.cast(control_flow.less_than(step, b), "float32")
+
+
 def piecewise_decay(boundaries, values):
     """lr = values[i] for step in [boundaries[i-1], boundaries[i]) —
-    computed branch-free as a sum of interval masks (compiles to select)."""
+    computed branch-free as a sum of exact interval masks."""
     assert len(boundaries) + 1 == len(values)
     step = _decay_step_counter()
     lr = tensor.fill_constant([1], "float32", 0.0)
-    prev = None
     for i, v in enumerate(values):
         if i == 0:
-            m = ops.sigmoid((float(boundaries[0]) - step) * 1e6)
+            m = _step_lt(step, boundaries[0])
         elif i < len(boundaries):
-            m = ops.sigmoid((float(boundaries[i]) - step) * 1e6) - ops.sigmoid(
-                (float(boundaries[i - 1]) - step) * 1e6
-            )
+            m = _step_lt(step, boundaries[i]) - _step_lt(step, boundaries[i - 1])
         else:
-            m = 1.0 - ops.sigmoid((float(boundaries[-1]) - step) * 1e6)
+            m = 1.0 - _step_lt(step, boundaries[-1])
         lr = lr + m * v
     return lr
 
@@ -117,7 +123,7 @@ def cosine_decay(learning_rate, step_each_epoch, epochs):
 def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
     step = _decay_step_counter()
     linear = start_lr + (end_lr - start_lr) * (step / float(warmup_steps))
-    m = ops.sigmoid((float(warmup_steps) - step) * 1e6)
+    m = _step_lt(step, warmup_steps)
     if isinstance(learning_rate, float):
         learning_rate = tensor.fill_constant([1], "float32", learning_rate)
     return m * linear + (1.0 - m) * learning_rate

@@ -660,9 +660,14 @@ def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
     helper = LayerHelper("smooth_l1_loss")
     diff = helper.create_variable_for_type_inference(x.dtype)
     out = helper.create_variable_for_type_inference(x.dtype)
+    inputs = {"X": [x], "Y": [y]}
+    if inside_weight is not None:
+        inputs["InsideWeight"] = [inside_weight]
+    if outside_weight is not None:
+        inputs["OutsideWeight"] = [outside_weight]
     helper.append_op(
         "smooth_l1_loss",
-        inputs={"X": [x], "Y": [y]},
+        inputs=inputs,
         outputs={"Out": [out], "Diff": [diff]},
         attrs={"sigma": sigma or 1.0},
     )
@@ -1096,10 +1101,21 @@ def uniform_random_batch_size_like(
 def gaussian_random_batch_size_like(
     input, shape, input_dim_idx=0, output_dim_idx=0, mean=0.0, std=1.0, seed=0, dtype="float32"
 ):
-    # lower via gaussian + batch-size-like fill pattern
-    helper = LayerHelper("uniform_random_batch_size_like")
-    out = uniform_random_batch_size_like(
-        input, shape, dtype, input_dim_idx, output_dim_idx, 0.0, 1.0, seed
+    helper = LayerHelper("gaussian_random_batch_size_like")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "gaussian_random_batch_size_like",
+        inputs={"Input": [input]},
+        outputs={"Out": [out]},
+        attrs={
+            "shape": list(shape),
+            "input_dim_idx": input_dim_idx,
+            "output_dim_idx": output_dim_idx,
+            "mean": mean,
+            "std": std,
+            "seed": seed,
+            "dtype": dtype,
+        },
     )
     return out
 

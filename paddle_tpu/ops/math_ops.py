@@ -360,15 +360,20 @@ def _square_error(ctx, ins, attrs):
     return {"Out": [jnp.square(x - y)]}
 
 
-@register("smooth_l1_loss", no_grad_inputs=("Y",))
+@register("smooth_l1_loss", no_grad_inputs=("Y", "InsideWeight", "OutsideWeight"))
 def _smooth_l1(ctx, ins, attrs):
     x, y = ins["X"][0], ins["Y"][0]
     sigma = attrs.get("sigma", 1.0)
     s2 = sigma * sigma
-    diff = jnp.abs(x - y)
-    loss = jnp.where(diff < 1.0 / s2, 0.5 * s2 * diff * diff, diff - 0.5 / s2)
+    diff = x - y
+    if ins.get("InsideWeight"):
+        diff = diff * ins["InsideWeight"][0]
+    ad = jnp.abs(diff)
+    loss = jnp.where(ad < 1.0 / s2, 0.5 * s2 * ad * ad, ad - 0.5 / s2)
+    if ins.get("OutsideWeight"):
+        loss = loss * ins["OutsideWeight"][0]
     loss = jnp.sum(loss.reshape(loss.shape[0], -1), axis=1, keepdims=True)
-    return {"Out": [loss], "Diff": [x - y]}
+    return {"Out": [loss], "Diff": [diff]}
 
 
 @register("huber_loss", no_grad_inputs=("Y",))

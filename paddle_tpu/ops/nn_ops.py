@@ -68,16 +68,29 @@ def _conv2d_transpose(ctx, ins, attrs):
     dilations = _pair(attrs.get("dilations", [1, 1]))
     groups = attrs.get("groups", 1) or 1
     pad = [(paddings[0], paddings[0]), (paddings[1], paddings[1])]
-    # w layout: [in_c, out_c/groups, kh, kw] (paddle conv_transpose filter)
-    out = jax.lax.conv_transpose(
-        x,
-        w,
-        strides=strides,
-        padding=pad,
-        rhs_dilation=dilations,
-        dimension_numbers=("NCHW", "IOHW", "NCHW"),
-        transpose_kernel=True,
-    )
+
+    # w layout: [in_c, out_c/groups, kh, kw] (paddle conv_transpose filter);
+    # lax.conv_transpose has no group support, so groups unroll statically
+    def one(xg, wg):
+        return jax.lax.conv_transpose(
+            xg,
+            wg,
+            strides=strides,
+            padding=pad,
+            rhs_dilation=dilations,
+            dimension_numbers=("NCHW", "IOHW", "NCHW"),
+            transpose_kernel=True,
+        )
+
+    if groups == 1:
+        out = one(x, w)
+    else:
+        cin = x.shape[1] // groups
+        outs = [
+            one(x[:, g * cin : (g + 1) * cin], w[g * cin : (g + 1) * cin])
+            for g in range(groups)
+        ]
+        out = jnp.concatenate(outs, axis=1)
     return {"Output": [out]}
 
 
@@ -136,12 +149,15 @@ def _pool2d(ctx, ins, attrs):
             (paddings[0], paddings[0] + extra[0]),
             (paddings[1], paddings[1] + extra[1]),
         )
+    any_padding = any(p != (0, 0) for p in pads[2:])
     if ptype == "max":
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
         out = jax.lax.reduce_window(x, init, jax.lax.max, window, strides_full, pads)
     else:
         summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides_full, pads)
-        if attrs.get("exclusive", True) and (paddings[0] or paddings[1]):
+        if attrs.get("exclusive", True) and any_padding:
+            # divide by the count of valid (unpadded) elements per window —
+            # covers both explicit padding and ceil_mode's implicit padding
             ones = jnp.ones_like(x)
             counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides_full, pads)
             out = summed / counts

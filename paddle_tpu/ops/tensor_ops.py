@@ -401,6 +401,17 @@ def _meshgrid(ctx, ins, attrs):
     return {"Out": list(outs)}
 
 
+@register("gaussian_random_batch_size_like", needs_rng=True)
+def _gaussian_random_bsl(ctx, ins, attrs):
+    x = ins["Input"][0]
+    shape = list(attrs["shape"])
+    shape[attrs.get("output_dim_idx", 0)] = x.shape[attrs.get("input_dim_idx", 0)]
+    out = jax.random.normal(ctx.rng(attrs), tuple(shape)) * attrs.get(
+        "std", 1.0
+    ) + attrs.get("mean", 0.0)
+    return {"Out": [out.astype(jdt(attrs.get("dtype", "float32")))]}
+
+
 @register("uniform_random_batch_size_like", needs_rng=True)
 def _uniform_random_bsl(ctx, ins, attrs):
     x = ins["Input"][0]

@@ -1,0 +1,163 @@
+"""OpTest harness — the per-op test contract of the reference
+(python/paddle/fluid/tests/unittests/op_test.py:132): declare op_type /
+inputs / attrs / expected outputs in numpy, `check_output` runs the single
+op through a real program+executor and compares, `check_grad` compares the
+framework's analytic gradients (built via the real append_backward + vjp
+machinery) against numeric central-difference gradients.
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import framework, layers
+from paddle_tpu.core import scope as scope_mod
+
+
+class OpTest:
+    """Subclass contract: set self.op_type, self.inputs, self.attrs,
+    self.outputs in setup(); inputs/outputs map slot -> ndarray or
+    [(name, ndarray), ...] for multi-var slots."""
+
+    op_type = None
+    inputs = {}
+    outputs = {}
+    attrs = {}
+
+    def _norm_slot(self, slot_val, slot):
+        if isinstance(slot_val, (list, tuple)) and slot_val and isinstance(
+            slot_val[0], tuple
+        ):
+            return [(n, np.asarray(a)) for n, a in slot_val]
+        return [(slot.lower(), np.asarray(slot_val))]
+
+    def _build(self, stop_gradient=True):
+        """Fresh program with the single op; returns (program, feed, out_vars)."""
+        prog = fluid.Program()
+        startup = fluid.Program()
+        feed = {}
+        with framework.program_guard(prog, startup):
+            block = prog.global_block()
+            in_names = {}
+            for slot, val in self.inputs.items():
+                pairs = self._norm_slot(val, slot)
+                names = []
+                for n, arr in pairs:
+                    block.create_var(
+                        name=n,
+                        shape=arr.shape,
+                        dtype=str(arr.dtype),
+                        stop_gradient=stop_gradient,
+                        is_data=True,
+                    )
+                    feed[n] = arr
+                    names.append(n)
+                in_names[slot] = names
+            out_vars = {}
+            out_names = {}
+            for slot, val in self.outputs.items():
+                pairs = self._norm_slot(val, slot)
+                names = []
+                for n, arr in pairs:
+                    v = block.create_var(name=n + "@out", dtype="float32", shape=None)
+                    names.append(v.name)
+                    out_vars.setdefault(slot, []).append((v, arr))
+                out_names[slot] = names
+            block.append_op(
+                self.op_type, inputs=in_names, outputs=out_names, attrs=dict(self.attrs)
+            )
+        return prog, feed, out_vars
+
+    def check_output(self, atol=1e-5, rtol=1e-4, no_check_set=None):
+        self.setup()
+        prog, feed, out_vars = self._build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            for slot, pairs in out_vars.items():
+                if no_check_set and slot in no_check_set:
+                    continue
+                fetch = [v for v, _ in pairs]
+                got = exe.run(prog, feed=feed, fetch_list=fetch)
+                for (v, expect), actual in zip(pairs, got):
+                    np.testing.assert_allclose(
+                        np.asarray(actual).astype("float64"),
+                        np.asarray(expect).astype("float64"),
+                        atol=atol,
+                        rtol=rtol,
+                        err_msg="op %s output %s/%s mismatch"
+                        % (self.op_type, slot, v.name),
+                    )
+
+    def check_grad(
+        self,
+        inputs_to_check,
+        output_name,
+        max_relative_error=5e-3,
+        delta=5e-3,
+        no_grad_set=None,
+    ):
+        """Analytic (vjp-machinery) vs numeric central-difference grads of
+        loss = sum(output) w.r.t. each input in inputs_to_check."""
+        self.setup()
+        prog, feed, out_vars = self._build(stop_gradient=False)
+        startup = fluid.Program()
+        with framework.program_guard(prog, startup):
+            block = prog.global_block()
+            # find the output var for output_name (slot name or var name)
+            target = None
+            expect = None
+            for slot, pairs in out_vars.items():
+                for v, arr in pairs:
+                    if slot == output_name or v.name == output_name + "@out":
+                        target, expect = v, arr
+            assert target is not None, "output %s not found" % output_name
+            # loss = sum(out * W) with fixed random W — avoids degenerate
+            # constant losses (e.g. sum of softmax rows == N)
+            wname = "__grad_check_w__"
+            block.create_var(
+                name=wname,
+                shape=expect.shape,
+                dtype="float32",
+                stop_gradient=True,
+                is_data=True,
+            )
+            feed[wname] = np.random.RandomState(7).uniform(
+                0.5, 1.5, expect.shape
+            ).astype("float32")
+            weighted = layers.elementwise_mul(target, block.var(wname))
+            loss = layers.reduce_sum(weighted)
+            grads = fluid.backward.calc_gradient(
+                loss, [block.var(n) for n in inputs_to_check], no_grad_set=no_grad_set
+            )
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            analytic = exe.run(prog, feed=feed, fetch_list=[g for g in grads])
+
+            def loss_fn(feed_over):
+                (lv,) = exe.run(prog, feed=feed_over, fetch_list=[loss])
+                return float(np.asarray(lv).sum())
+
+            for name, ana in zip(inputs_to_check, analytic):
+                base = feed[name].astype("float64")
+                num = np.zeros_like(base)
+                flat = base.reshape(-1)
+                for i in range(flat.size):
+                    f2 = dict(feed)
+                    pert = flat.copy()
+                    pert[i] += delta
+                    f2[name] = pert.reshape(base.shape).astype(feed[name].dtype)
+                    up = loss_fn(f2)
+                    pert[i] -= 2 * delta
+                    f2[name] = pert.reshape(base.shape).astype(feed[name].dtype)
+                    down = loss_fn(f2)
+                    num.reshape(-1)[i] = (up - down) / (2 * delta)
+                ana = np.asarray(ana).astype("float64")
+                abs_err = np.abs(ana - num)
+                denom = np.maximum(np.maximum(np.abs(ana), np.abs(num)), 1e-3)
+                rel = (abs_err / denom).max()
+                assert rel < max_relative_error, (
+                    "op %s grad of %s: max rel err %.5f >= %.5f\nanalytic=%s\nnumeric=%s"
+                    % (self.op_type, name, rel, max_relative_error, ana, num)
+                )
+
+    def setup(self):
+        raise NotImplementedError

@@ -1,0 +1,369 @@
+"""Per-op checks (output + numeric-gradient parity) for the math/elementwise
+surface — the mirror of the reference's test_elementwise_*_op.py,
+test_mul_op.py, test_softmax_op.py, test_reduce_op.py contract."""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+rng = np.random.RandomState(42)
+
+
+class TestElementwiseAdd(OpTest):
+    def setup(self):
+        self.op_type = "elementwise_add"
+        x = rng.rand(3, 4).astype("float32")
+        y = rng.rand(3, 4).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {}
+        self.outputs = {"Out": x + y}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x", "y"], "Out")
+
+
+class TestElementwiseAddBroadcastAxis(OpTest):
+    def setup(self):
+        self.op_type = "elementwise_add"
+        x = rng.rand(2, 3, 4).astype("float32")
+        y = rng.rand(3).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": x + y.reshape(1, 3, 1)}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x", "y"], "Out")
+
+
+class TestElementwiseMulBroadcastTrailing(OpTest):
+    def setup(self):
+        self.op_type = "elementwise_mul"
+        x = rng.rand(2, 3, 4).astype("float32")
+        y = rng.rand(3, 4).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"axis": -1}
+        self.outputs = {"Out": x * y}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x", "y"], "Out")
+
+
+class TestElementwiseDiv(OpTest):
+    def setup(self):
+        self.op_type = "elementwise_div"
+        x = rng.rand(3, 4).astype("float32") + 0.5
+        y = rng.rand(3, 4).astype("float32") + 0.5
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {}
+        self.outputs = {"Out": x / y}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x", "y"], "Out", max_relative_error=1e-2)
+
+
+class TestMulOp(OpTest):
+    def setup(self):
+        self.op_type = "mul"
+        x = rng.rand(4, 6).astype("float32")
+        y = rng.rand(6, 5).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"x_num_col_dims": 1, "y_num_col_dims": 1}
+        self.outputs = {"Out": x @ y}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x", "y"], "Out", max_relative_error=1e-2)
+
+
+class TestMulOpFlatten(OpTest):
+    def setup(self):
+        self.op_type = "mul"
+        x = rng.rand(2, 3, 4).astype("float32")
+        y = rng.rand(4, 5).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"x_num_col_dims": 2, "y_num_col_dims": 1}
+        self.outputs = {"Out": (x.reshape(6, 4) @ y).reshape(2, 3, 5)}
+
+    def test(self):
+        self.check_output()
+
+
+class TestMatmulTranspose(OpTest):
+    def setup(self):
+        self.op_type = "matmul"
+        x = rng.rand(5, 3).astype("float32")
+        y = rng.rand(5, 4).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"transpose_X": True, "transpose_Y": False, "alpha": 2.0}
+        self.outputs = {"Out": 2.0 * (x.T @ y)}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x", "y"], "Out", max_relative_error=1e-2)
+
+
+class TestMatmulBatched(OpTest):
+    def setup(self):
+        self.op_type = "matmul"
+        x = rng.rand(2, 3, 4).astype("float32")
+        y = rng.rand(2, 4, 5).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {}
+        self.outputs = {"Out": np.matmul(x, y)}
+
+    def test(self):
+        self.check_output()
+
+
+class TestSoftmax(OpTest):
+    def setup(self):
+        self.op_type = "softmax"
+        x = rng.rand(4, 7).astype("float32")
+        e = np.exp(x - x.max(-1, keepdims=True))
+        self.inputs = {"X": x}
+        self.attrs = {}
+        self.outputs = {"Out": e / e.sum(-1, keepdims=True)}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x"], "Out", max_relative_error=5e-2)
+
+
+class TestSoftmaxWithCrossEntropy(OpTest):
+    def setup(self):
+        self.op_type = "softmax_with_cross_entropy"
+        logits = rng.rand(5, 8).astype("float32") * 3
+        label = rng.randint(0, 8, (5, 1)).astype("int64")
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        sm = e / e.sum(-1, keepdims=True)
+        loss = -np.log(sm[np.arange(5), label[:, 0]]).reshape(5, 1)
+        self.inputs = {"Logits": logits, "Label": label}
+        self.attrs = {}
+        self.outputs = {"Softmax": sm, "Loss": loss}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["logits"], "Loss", max_relative_error=1e-2)
+
+
+class TestReduceSum(OpTest):
+    def setup(self):
+        self.op_type = "reduce_sum"
+        x = rng.rand(3, 4, 5).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"dim": [1], "keep_dim": False}
+        self.outputs = {"Out": x.sum(axis=1)}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x"], "Out")
+
+
+class TestReduceMeanAll(OpTest):
+    def setup(self):
+        self.op_type = "reduce_mean"
+        x = rng.rand(3, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"reduce_all": True}
+        self.outputs = {"Out": np.asarray(x.mean())}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x"], "Out")
+
+
+class TestTanh(OpTest):
+    def setup(self):
+        self.op_type = "tanh"
+        x = rng.rand(3, 4).astype("float32") * 2 - 1
+        self.inputs = {"X": x}
+        self.attrs = {}
+        self.outputs = {"Out": np.tanh(x)}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x"], "Out")
+
+
+class TestSigmoid(OpTest):
+    def setup(self):
+        self.op_type = "sigmoid"
+        x = rng.rand(3, 4).astype("float32") * 2 - 1
+        self.inputs = {"X": x}
+        self.attrs = {}
+        self.outputs = {"Out": 1 / (1 + np.exp(-x))}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x"], "Out")
+
+
+class TestLayerNormOp(OpTest):
+    def setup(self):
+        self.op_type = "layer_norm"
+        x = rng.rand(4, 6).astype("float32")
+        scale = rng.rand(6).astype("float32")
+        bias = rng.rand(6).astype("float32")
+        mean = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        y = (x - mean) / np.sqrt(var + 1e-5) * scale + bias
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias}
+        self.attrs = {"begin_norm_axis": 1, "epsilon": 1e-5}
+        self.outputs = {"Y": y}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x", "scale", "bias"], "Y", max_relative_error=2e-2)
+
+
+class TestLookupTable(OpTest):
+    def setup(self):
+        self.op_type = "lookup_table"
+        w = rng.rand(10, 4).astype("float32")
+        ids = rng.randint(0, 10, (5, 1)).astype("int64")
+        self.inputs = {"W": w, "Ids": ids}
+        self.attrs = {}
+        self.outputs = {"Out": w[ids[:, 0]]}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["w"], "Out")
+
+
+class TestConcat(OpTest):
+    def setup(self):
+        self.op_type = "concat"
+        a = rng.rand(2, 3).astype("float32")
+        b = rng.rand(2, 4).astype("float32")
+        self.inputs = {"X": [("a", a), ("b", b)]}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": np.concatenate([a, b], axis=1)}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["a", "b"], "Out")
+
+
+class TestTranspose(OpTest):
+    def setup(self):
+        self.op_type = "transpose2"
+        x = rng.rand(2, 3, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"axis": [0, 2, 1]}
+        self.outputs = {"Out": x.transpose(0, 2, 1)}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x"], "Out")
+
+
+class TestReshape(OpTest):
+    def setup(self):
+        self.op_type = "reshape2"
+        x = rng.rand(2, 6).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"shape": [4, -1]}
+        self.outputs = {"Out": x.reshape(4, 3)}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x"], "Out")
+
+
+class TestSliceOp(OpTest):
+    def setup(self):
+        self.op_type = "slice"
+        x = rng.rand(4, 5, 6).astype("float32")
+        self.inputs = {"Input": x}
+        self.attrs = {"axes": [0, 2], "starts": [1, 2], "ends": [3, 5]}
+        self.outputs = {"Out": x[1:3, :, 2:5]}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["input"], "Out")
+
+
+class TestScale(OpTest):
+    def setup(self):
+        self.op_type = "scale"
+        x = rng.rand(3, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"scale": 2.5, "bias": 0.3}
+        self.outputs = {"Out": x * 2.5 + 0.3}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x"], "Out")
+
+
+class TestClip(OpTest):
+    def setup(self):
+        self.op_type = "clip"
+        x = (rng.rand(3, 4).astype("float32") - 0.5) * 4
+        self.inputs = {"X": x}
+        self.attrs = {"min": -1.0, "max": 1.0}
+        self.outputs = {"Out": np.clip(x, -1, 1)}
+
+    def test(self):
+        self.check_output()
+
+
+class TestTopK(OpTest):
+    def setup(self):
+        self.op_type = "top_k"
+        x = rng.rand(3, 6).astype("float32")
+        k = 2
+        idx = np.argsort(-x, axis=1)[:, :k]
+        vals = np.take_along_axis(x, idx, axis=1)
+        self.inputs = {"X": x}
+        self.attrs = {"k": k}
+        self.outputs = {"Out": vals, "Indices": idx.astype("int64")}
+
+    def test(self):
+        self.check_output()
+
+
+class TestSumOp(OpTest):
+    def setup(self):
+        self.op_type = "sum"
+        a = rng.rand(3, 4).astype("float32")
+        b = rng.rand(3, 4).astype("float32")
+        c = rng.rand(3, 4).astype("float32")
+        self.inputs = {"X": [("sa", a), ("sb", b), ("sc", c)]}
+        self.attrs = {}
+        self.outputs = {"Out": a + b + c}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["sa", "sb", "sc"], "Out")
+
+
+class TestCast(OpTest):
+    def setup(self):
+        self.op_type = "cast"
+        x = rng.rand(3, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"in_dtype": "float32", "out_dtype": "int32"}
+        self.outputs = {"Out": x.astype("int32")}
+
+    def test(self):
+        self.check_output()
+
+
+class TestOneHot(OpTest):
+    def setup(self):
+        self.op_type = "one_hot"
+        x = rng.randint(0, 5, (4, 1)).astype("int64")
+        out = np.zeros((4, 5), "float32")
+        out[np.arange(4), x[:, 0]] = 1
+        self.inputs = {"X": x}
+        self.attrs = {"depth": 5}
+        self.outputs = {"Out": out}
+
+    def test(self):
+        self.check_output()
