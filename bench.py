@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Benchmark driver: ResNet-50 training throughput (images/sec/chip).
+
+Mirrors the reference's benchmark harness role
+(benchmark/fluid/fluid_benchmark.py + models/resnet.py) on one TPU chip.
+Baseline anchor: the reference's best published ResNet-50 training number,
+82.35 images/sec (MKL-DNN, Xeon 6148 — benchmark/IntelOptimizedPaddle.md:39,
+see BASELINE.md; no GPU number is published in-tree).
+
+Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+
+BASELINE_IMAGES_PER_SEC = 82.35  # reference ResNet-50 train, bs128 (BASELINE.md)
+
+
+def main():
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models.resnet import build_resnet_train_program
+
+    platforms = {d.platform for d in jax.devices()}
+    on_tpu = bool(platforms & {"tpu", "axon"})
+    batch_size = int(os.environ.get("BENCH_BATCH", 128 if on_tpu else 8))
+    image_hw = int(os.environ.get("BENCH_IMAGE", 224 if on_tpu else 64))
+    steps = max(1, int(os.environ.get("BENCH_STEPS", 20 if on_tpu else 3)))
+    warmup = max(1, int(os.environ.get("BENCH_WARMUP", 3 if on_tpu else 1)))
+
+    main_prog, startup, feeds, fetches = build_resnet_train_program(
+        image_shape=(3, image_hw, image_hw), class_dim=1000, depth=50, lr=0.1
+    )
+    place = fluid.TPUPlace(0) if on_tpu else fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(startup)
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(batch_size, 3, image_hw, image_hw).astype("float32")
+    y = rng.randint(0, 1000, (batch_size, 1)).astype("int64")
+    feed = {"image": x, "label": y}
+
+    for _ in range(warmup):
+        out = exe.run(main_prog, feed=feed, fetch_list=fetches)
+    np.asarray(out[0])  # sync
+
+    t0 = time.time()
+    for _ in range(steps):
+        out = exe.run(main_prog, feed=feed, fetch_list=fetches)
+    np.asarray(out[0])  # sync on the final fetch
+    dt = time.time() - t0
+
+    ips = batch_size * steps / dt
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_train_images_per_sec_per_chip"
+                + ("" if on_tpu else "_cpufallback"),
+                "value": round(ips, 2),
+                "unit": "images/sec",
+                "vs_baseline": round(ips / BASELINE_IMAGES_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

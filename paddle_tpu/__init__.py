@@ -25,14 +25,19 @@ from . import ops  # registers all op lowerings first
 from . import (
     backward,
     clip,
+    dataset,
     framework,
     initializer,
     layers,
     lod,
+    metrics,
     nets,
     optimizer,
+    parallel,
     param_attr,
     places,
+    profiler,
+    reader,
     regularizer,
     unique_name,
 )

@@ -1,1 +1,4 @@
-"""paddle_tpu.dataset"""
+"""Dataset loaders (python/paddle/dataset API parity): local-cache loading
+with deterministic synthetic fallback (zero-egress; see common.py)."""
+
+from . import common, mnist, cifar, uci_housing, imdb, imikolov, wmt16

@@ -1,0 +1,64 @@
+"""CIFAR-10/100 readers (python/paddle/dataset/cifar.py API parity)."""
+
+import os
+import pickle
+import tarfile
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train10", "test10", "train100", "test100"]
+
+
+def _tar_reader(tar_name, sub_names):
+    path = common.data_path("cifar", tar_name)
+
+    def reader():
+        with tarfile.open(path) as tf:
+            for member in tf.getmembers():
+                if not any(s in member.name for s in sub_names):
+                    continue
+                batch = pickle.load(tf.extractfile(member), encoding="latin1")
+                data = batch["data"].astype("float32") / 127.5 - 1.0
+                labels = batch.get("labels", batch.get("fine_labels"))
+                for row, lbl in zip(data, labels):
+                    yield row, int(lbl)
+
+    return reader
+
+
+def _synthetic(n_classes, n, seed):
+    rng = np.random.RandomState(seed)
+
+    def reader():
+        for i in range(n):
+            label = i % n_classes
+            img = rng.rand(3072).astype("float32") * 0.2 - 1.0
+            img[(label * 293) % 2800 : (label * 293) % 2800 + 200] += 1.2
+            yield img, int(label)
+
+    return reader
+
+
+def _make(tar_name, subs, n_classes, n_synth, seed):
+    if common.have_file("cifar", tar_name):
+        return _tar_reader(tar_name, subs)
+    common.synthetic_note("cifar")
+    return _synthetic(n_classes, n_synth, seed)
+
+
+def train10():
+    return _make("cifar-10-python.tar.gz", ["data_batch"], 10, 5000, 0)
+
+
+def test10():
+    return _make("cifar-10-python.tar.gz", ["test_batch"], 10, 1000, 1)
+
+
+def train100():
+    return _make("cifar-100-python.tar.gz", ["train"], 100, 5000, 2)
+
+
+def test100():
+    return _make("cifar-100-python.tar.gz", ["test"], 100, 1000, 3)

@@ -1,0 +1,50 @@
+"""Dataset infra (python/paddle/dataset/common.py analog).
+
+The reference downloads real corpora with md5-checked caching.  This
+environment is zero-egress, so: datasets load from the local cache dir when
+the files are already present (same layout the reference uses, DATA_HOME),
+and otherwise fall back to deterministic synthetic data with the correct
+shapes/vocabulary so pipelines and models run end-to-end.  Swap in real data
+by dropping files into DATA_HOME.
+"""
+
+import hashlib
+import os
+
+import numpy as np
+
+__all__ = ["DATA_HOME", "md5file", "data_path", "have_file", "synthetic_note"]
+
+DATA_HOME = os.path.expanduser(os.environ.get("PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu/dataset"))
+
+
+def data_path(*parts):
+    return os.path.join(DATA_HOME, *parts)
+
+
+def have_file(*parts):
+    return os.path.exists(data_path(*parts))
+
+
+def md5file(fname):
+    hash_md5 = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            hash_md5.update(chunk)
+    return hash_md5.hexdigest()
+
+
+_warned = set()
+
+
+def synthetic_note(name):
+    if name not in _warned:
+        _warned.add(name)
+        import sys
+
+        print(
+            "[paddle_tpu.dataset] %s: no local data under %s — serving "
+            "deterministic synthetic samples (zero-egress environment)"
+            % (name, DATA_HOME),
+            file=sys.stderr,
+        )

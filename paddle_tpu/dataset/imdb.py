@@ -1,0 +1,39 @@
+"""IMDB sentiment reader (python/paddle/dataset/imdb.py parity): word-id
+sequences + binary label."""
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "word_dict"]
+
+_VOCAB = 5147  # mirrors the reference's imdb.word_dict() size magnitude
+
+
+def word_dict():
+    return {("w%d" % i).encode(): i for i in range(_VOCAB)}
+
+
+def _synthetic(n, seed):
+    rng = np.random.RandomState(seed)
+
+    def reader():
+        for i in range(n):
+            label = i % 2
+            length = rng.randint(8, 64)
+            # class-dependent token distribution
+            lo, hi = (0, _VOCAB // 2) if label == 0 else (_VOCAB // 2, _VOCAB)
+            ids = rng.randint(lo, hi, (length,)).tolist()
+            yield ids, int(label)
+
+    return reader
+
+
+def train(word_idx=None):
+    common.synthetic_note("imdb")
+    return _synthetic(2000, 0)
+
+
+def test(word_idx=None):
+    common.synthetic_note("imdb")
+    return _synthetic(400, 1)

@@ -1,0 +1,39 @@
+"""PTB/imikolov n-gram LM reader (python/paddle/dataset/imikolov.py parity)."""
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "build_dict"]
+
+_VOCAB = 2074
+
+
+def build_dict(min_word_freq=50):
+    return {("w%d" % i): i for i in range(_VOCAB)}
+
+
+def _synthetic(n, window, seed):
+    rng = np.random.RandomState(seed)
+
+    def reader():
+        # markov-ish chain so embeddings have learnable structure
+        state = rng.randint(0, _VOCAB)
+        for _ in range(n):
+            seq = []
+            for _ in range(window):
+                state = (state * 31 + rng.randint(0, 7)) % _VOCAB
+                seq.append(state)
+            yield tuple(seq)
+
+    return reader
+
+
+def train(word_idx=None, n=5):
+    common.synthetic_note("imikolov")
+    return _synthetic(4000, n, 0)
+
+
+def test(word_idx=None, n=5):
+    common.synthetic_note("imikolov")
+    return _synthetic(800, n, 1)

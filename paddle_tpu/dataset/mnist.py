@@ -1,0 +1,63 @@
+"""MNIST reader (python/paddle/dataset/mnist.py API parity).
+
+Loads the standard idx-format files from DATA_HOME/mnist when present;
+otherwise serves synthetic digit-like samples (see common.py)."""
+
+import gzip
+import struct
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test"]
+
+
+def _read_idx_images(path):
+    with gzip.open(path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        data = np.frombuffer(f.read(), dtype=np.uint8).reshape(n, rows * cols)
+    return data.astype("float32") / 127.5 - 1.0
+
+
+def _read_idx_labels(path):
+    with gzip.open(path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        return np.frombuffer(f.read(), dtype=np.uint8).astype("int64")
+
+
+def _synthetic(n, seed):
+    rng = np.random.RandomState(seed)
+
+    def reader():
+        for i in range(n):
+            label = i % 10
+            img = rng.rand(784).astype("float32") * 0.1 - 1.0
+            # a crude class-dependent blob so models can actually learn
+            img[label * 70 : label * 70 + 70] += 1.5
+            yield img, int(label)
+
+    return reader
+
+
+def _reader(images_file, labels_file, n_synth, seed):
+    img_path = common.data_path("mnist", images_file)
+    lbl_path = common.data_path("mnist", labels_file)
+    if common.have_file("mnist", images_file) and common.have_file("mnist", labels_file):
+        def reader():
+            images = _read_idx_images(img_path)
+            labels = _read_idx_labels(lbl_path)
+            for img, lbl in zip(images, labels):
+                yield img, int(lbl)
+
+        return reader
+    common.synthetic_note("mnist")
+    return _synthetic(n_synth, seed)
+
+
+def train():
+    return _reader("train-images-idx3-ubyte.gz", "train-labels-idx1-ubyte.gz", 6000, 0)
+
+
+def test():
+    return _reader("t10k-images-idx3-ubyte.gz", "t10k-labels-idx1-ubyte.gz", 1000, 1)

@@ -1,0 +1,46 @@
+"""UCI housing regression reader (python/paddle/dataset/uci_housing.py
+parity): 13 features -> price."""
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "feature_num"]
+
+feature_num = 13
+
+
+def _load():
+    path = common.data_path("uci_housing", "housing.data")
+    if common.have_file("uci_housing", "housing.data"):
+        data = np.loadtxt(path)
+    else:
+        common.synthetic_note("uci_housing")
+        rng = np.random.RandomState(0)
+        x = rng.rand(506, feature_num)
+        w = rng.rand(feature_num)
+        y = x @ w * 10 + rng.randn(506) * 0.5 + 10
+        data = np.concatenate([x, y[:, None]], axis=1)
+    feats = data[:, :-1]
+    feats = (feats - feats.mean(0)) / (feats.std(0) + 1e-6)
+    return feats.astype("float32"), data[:, -1:].astype("float32")
+
+
+def train():
+    def reader():
+        x, y = _load()
+        n = int(len(x) * 0.8)
+        for i in range(n):
+            yield x[i], y[i]
+
+    return reader
+
+
+def test():
+    def reader():
+        x, y = _load()
+        n = int(len(x) * 0.8)
+        for i in range(n, len(x)):
+            yield x[i], y[i]
+
+    return reader

@@ -1,0 +1,40 @@
+"""WMT16 en-de seq2seq reader (python/paddle/dataset/wmt16.py parity):
+(src_ids, trg_ids, trg_next_ids) triples."""
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "get_dict"]
+
+
+def get_dict(lang, dict_size, reverse=False):
+    d = {("%s_w%d" % (lang, i)): i for i in range(dict_size)}
+    if reverse:
+        return {v: k for k, v in d.items()}
+    return d
+
+
+def _synthetic(n, src_vocab, trg_vocab, seed):
+    rng = np.random.RandomState(seed)
+    bos, eos = 0, 1
+
+    def reader():
+        for _ in range(n):
+            slen = rng.randint(4, 30)
+            src = rng.randint(2, src_vocab, (slen,)).tolist()
+            # "translation": deterministic mapping + length jitter
+            trg = [(t * 7 + 3) % (trg_vocab - 2) + 2 for t in src][: max(3, slen - 2)]
+            yield src, [bos] + trg, trg + [eos]
+
+    return reader
+
+
+def train(src_dict_size=10000, trg_dict_size=10000, src_lang="en"):
+    common.synthetic_note("wmt16")
+    return _synthetic(4000, src_dict_size, trg_dict_size, 0)
+
+
+def test(src_dict_size=10000, trg_dict_size=10000, src_lang="en"):
+    common.synthetic_note("wmt16")
+    return _synthetic(500, src_dict_size, trg_dict_size, 1)

@@ -107,7 +107,10 @@ class Executor:
             rw_state[n] = scope.find_var(n)
 
         key = self._rng_key(program)
-        fetches, new_state = compiled(feed_arrays, ro_state, rw_state, key)
+        from .profiler import RecordEvent
+
+        with RecordEvent("executor_run"):
+            fetches, new_state = compiled(feed_arrays, ro_state, rw_state, key)
 
         for n, v in new_state.items():
             scope.set(n, v)

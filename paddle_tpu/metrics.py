@@ -1,0 +1,204 @@
+"""Host-side metric accumulators (python/paddle/fluid/metrics.py analog):
+update with per-batch numpy fetches, eval() aggregates across batches."""
+
+import numpy as np
+
+__all__ = [
+    "MetricBase",
+    "CompositeMetric",
+    "Precision",
+    "Recall",
+    "Accuracy",
+    "ChunkEvaluator",
+    "EditDistance",
+    "DetectionMAP",
+    "Auc",
+]
+
+
+class MetricBase:
+    def __init__(self, name=None):
+        self._name = str(name) if name is not None else self.__class__.__name__
+
+    def reset(self):
+        for attr, value in self.__dict__.items():
+            if not attr.startswith("_"):
+                if isinstance(value, (int, float)):
+                    setattr(self, attr, 0)
+                elif isinstance(value, (np.ndarray,)):
+                    setattr(self, attr, np.zeros_like(value))
+
+    def update(self, preds, labels):
+        raise NotImplementedError
+
+    def eval(self):
+        raise NotImplementedError
+
+
+class CompositeMetric(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._metrics = []
+
+    def add_metric(self, metric):
+        self._metrics.append(metric)
+
+    def update(self, preds, labels):
+        for m in self._metrics:
+            m.update(preds, labels)
+
+    def eval(self):
+        return [m.eval() for m in self._metrics]
+
+
+class Precision(MetricBase):
+    """Binary precision: preds are probabilities, labels {0,1}."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        preds = np.rint(np.asarray(preds)).astype(int).reshape(-1)
+        labels = np.asarray(labels).astype(int).reshape(-1)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fp += int(((preds == 1) & (labels == 0)).sum())
+
+    def eval(self):
+        ap = self.tp + self.fp
+        return float(self.tp) / ap if ap != 0 else 0.0
+
+
+class Recall(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        preds = np.rint(np.asarray(preds)).astype(int).reshape(-1)
+        labels = np.asarray(labels).astype(int).reshape(-1)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fn += int(((preds == 0) & (labels == 1)).sum())
+
+    def eval(self):
+        rc = self.tp + self.fn
+        return float(self.tp) / rc if rc != 0 else 0.0
+
+
+class Accuracy(MetricBase):
+    """Weighted running accuracy (feed per-batch acc + batch weight)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.value = 0.0
+        self.weight = 0.0
+
+    def update(self, value, weight):
+        self.value += float(np.asarray(value).reshape(-1)[0]) * weight
+        self.weight += weight
+
+    def eval(self):
+        if self.weight == 0:
+            raise ValueError("Accuracy: no batches accumulated")
+        return self.value / self.weight
+
+
+class ChunkEvaluator(MetricBase):
+    """F1 over chunk counts (feed num_infer/num_label/num_correct)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.num_infer_chunks = 0
+        self.num_label_chunks = 0
+        self.num_correct_chunks = 0
+
+    def update(self, num_infer_chunks, num_label_chunks, num_correct_chunks):
+        self.num_infer_chunks += int(np.asarray(num_infer_chunks).sum())
+        self.num_label_chunks += int(np.asarray(num_label_chunks).sum())
+        self.num_correct_chunks += int(np.asarray(num_correct_chunks).sum())
+
+    def eval(self):
+        precision = (
+            float(self.num_correct_chunks) / self.num_infer_chunks
+            if self.num_infer_chunks
+            else 0.0
+        )
+        recall = (
+            float(self.num_correct_chunks) / self.num_label_chunks
+            if self.num_label_chunks
+            else 0.0
+        )
+        f1 = (
+            2 * precision * recall / (precision + recall)
+            if self.num_correct_chunks
+            else 0.0
+        )
+        return precision, recall, f1
+
+
+class EditDistance(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.total_distance = 0.0
+        self.seq_num = 0
+        self.instance_error = 0
+
+    def update(self, distances, seq_num):
+        distances = np.asarray(distances)
+        self.instance_error += int((distances > 0).sum())
+        self.total_distance += float(distances.sum())
+        self.seq_num += int(seq_num)
+
+    def eval(self):
+        if self.seq_num == 0:
+            raise ValueError("EditDistance: no data")
+        return (
+            self.total_distance / self.seq_num,
+            float(self.instance_error) / self.seq_num,
+        )
+
+
+class Auc(MetricBase):
+    """ROC AUC via threshold histogram (metrics.py Auc parity)."""
+
+    def __init__(self, name=None, curve="ROC", num_thresholds=4095):
+        super().__init__(name)
+        self._num_thresholds = num_thresholds
+        self._stat_pos = np.zeros(num_thresholds + 1)
+        self._stat_neg = np.zeros(num_thresholds + 1)
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds)
+        labels = np.asarray(labels).reshape(-1)
+        pos_prob = preds[:, 1] if preds.ndim == 2 and preds.shape[1] > 1 else preds.reshape(-1)
+        bins = np.minimum(
+            (pos_prob * self._num_thresholds).astype(int), self._num_thresholds
+        )
+        for b, l in zip(bins, labels):
+            if l:
+                self._stat_pos[b] += 1
+            else:
+                self._stat_neg[b] += 1
+
+    @staticmethod
+    def trapezoid_area(x1, x2, y1, y2):
+        return abs(x1 - x2) * (y1 + y2) / 2.0
+
+    def eval(self):
+        tot_pos = tot_neg = auc = 0.0
+        idx = self._num_thresholds
+        while idx >= 0:
+            new_pos = tot_pos + self._stat_pos[idx]
+            new_neg = tot_neg + self._stat_neg[idx]
+            auc += self.trapezoid_area(tot_neg, new_neg, tot_pos, new_pos)
+            tot_pos, tot_neg = new_pos, new_neg
+            idx -= 1
+        return auc / (tot_pos * tot_neg) if tot_pos > 0 and tot_neg > 0 else 0.0
+
+
+class DetectionMAP(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        raise NotImplementedError("DetectionMAP pending the detection phase")

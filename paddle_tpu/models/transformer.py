@@ -1,0 +1,254 @@
+"""Transformer (base config) — encoder/decoder for WMT-style seq2seq.
+
+Capability mirror of the reference's benchmark transformer
+(`python/paddle/fluid/tests/unittests/dist_transformer.py:123`
+ModelHyperParams / transformer builder), re-designed for TPU: fixed-length
+padded batches with explicit attention masks (no LoD), all attention heads
+batched into single MXU matmuls, and the whole train step compiled as one
+XLA program.  Tensor-parallel sharding rules for the qkv/ffn weights live in
+paddle_tpu.parallel (GSPMD replaces the DistributeTranspiler).
+"""
+
+import numpy as np
+
+from .. import layers, unique_name
+from ..initializer import Normal
+from ..param_attr import ParamAttr
+
+
+def _pa(base):
+    """Named ParamAttr so parallel.transformer_tp_rules can target these
+    weights by regex (the GSPMD analog of the transpiler's param slicing)."""
+    return ParamAttr(name=unique_name.generate(base))
+
+__all__ = ["ModelHyperParams", "transformer", "wmt_transformer_program"]
+
+
+class ModelHyperParams:
+    """Transformer-base (dist_transformer.py ModelHyperParams parity)."""
+
+    src_vocab_size = 10000
+    trg_vocab_size = 10000
+    max_length = 256
+    d_model = 512
+    d_inner_hid = 2048
+    n_head = 8
+    n_layer = 6
+    dropout = 0.1
+    label_smooth_eps = 0.1
+
+
+def _pos_encoding_table(max_len, d_model):
+    pos = np.arange(max_len)[:, None].astype("float64")
+    i = np.arange(d_model)[None, :].astype("float64")
+    angle = pos / np.power(10000, 2 * (i // 2) / d_model)
+    table = np.zeros((max_len, d_model), dtype="float32")
+    table[:, 0::2] = np.sin(angle[:, 0::2])
+    table[:, 1::2] = np.cos(angle[:, 1::2])
+    return table
+
+
+def prepare_embedding(ids, vocab_size, d_model, max_len, dropout_rate, pos_name, is_test=False):
+    """Word + sinusoid position embedding (the reference's
+    prepare_encoder/decoder), position table as a frozen parameter."""
+    word_emb = layers.embedding(
+        ids,
+        size=[vocab_size, d_model],
+        param_attr=ParamAttr(initializer=Normal(0.0, d_model ** -0.5)),
+    )
+    word_emb = layers.scale(word_emb, scale=d_model ** 0.5)
+    pos_table = layers.create_parameter(
+        shape=[max_len, d_model],
+        dtype="float32",
+        name=pos_name,
+        default_initializer=None,
+        attr=ParamAttr(
+            name=pos_name,
+            trainable=False,
+            initializer=_NumpyInit(_pos_encoding_table(max_len, d_model)),
+        ),
+    )
+    seq_len = ids.shape[1]
+    pos_slice = layers.slice(pos_table, axes=[0], starts=[0], ends=[seq_len])
+    out = layers.elementwise_add(word_emb, pos_slice, axis=1)
+    if dropout_rate:
+        out = layers.dropout(out, dropout_rate, is_test=is_test)
+    return out
+
+
+class _NumpyInit:
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, var, block):
+        from ..initializer import NumpyArrayInitializer
+
+        return NumpyArrayInitializer(self.value)(var, block)
+
+
+def multi_head_attention(
+    queries, keys, values, attn_bias, d_model, n_head, dropout_rate=0.0, is_test=False, cache=None
+):
+    """All heads in one qkv projection + batched matmuls (MXU-shaped).
+    attn_bias: [B, 1 or H, Tq, Tk] additive mask (−1e9 at masked slots)."""
+    q = layers.fc(queries, size=d_model, num_flatten_dims=2, bias_attr=False,
+                  param_attr=_pa("mha_q.w"))
+    k = layers.fc(keys, size=d_model, num_flatten_dims=2, bias_attr=False,
+                  param_attr=_pa("mha_k.w"))
+    v = layers.fc(values, size=d_model, num_flatten_dims=2, bias_attr=False,
+                  param_attr=_pa("mha_v.w"))
+
+    def split_heads(x):
+        b, t = x.shape[0], x.shape[1]
+        x = layers.reshape(x, [b, t, n_head, d_model // n_head])
+        return layers.transpose(x, [0, 2, 1, 3])  # [B, H, T, Dh]
+
+    q, k, v = split_heads(q), split_heads(k), split_heads(v)
+    dh = d_model // n_head
+    product = layers.matmul(q, k, transpose_y=True, alpha=dh ** -0.5)
+    if attn_bias is not None:
+        product = layers.elementwise_add(product, attn_bias)
+    weights = layers.softmax(product)
+    if dropout_rate:
+        weights = layers.dropout(weights, dropout_rate, is_test=is_test)
+    ctx = layers.matmul(weights, v)  # [B, H, Tq, Dh]
+    ctx = layers.transpose(ctx, [0, 2, 1, 3])
+    b, t = ctx.shape[0], ctx.shape[1]
+    ctx = layers.reshape(ctx, [b, t, d_model])
+    return layers.fc(ctx, size=d_model, num_flatten_dims=2, bias_attr=False,
+                     param_attr=_pa("mha_o.w"))
+
+
+def positionwise_ffn(x, d_inner, d_model, dropout_rate=0.0, is_test=False):
+    hidden = layers.fc(x, size=d_inner, num_flatten_dims=2, act="relu",
+                       param_attr=_pa("ffn_in.w"), bias_attr=_pa("ffn_in.b"))
+    if dropout_rate:
+        hidden = layers.dropout(hidden, dropout_rate, is_test=is_test)
+    return layers.fc(hidden, size=d_model, num_flatten_dims=2,
+                     param_attr=_pa("ffn_out.w"))
+
+
+def pre_post_process(prev, out, dropout_rate=0.0, is_test=False):
+    """residual add + layer_norm (the reference's post_process_layer 'dan')."""
+    if dropout_rate:
+        out = layers.dropout(out, dropout_rate, is_test=is_test)
+    added = layers.elementwise_add(prev, out)
+    return layers.layer_norm(added, begin_norm_axis=2)
+
+
+def encoder_layer(x, attn_bias, hp, is_test=False):
+    attn = multi_head_attention(
+        x, x, x, attn_bias, hp.d_model, hp.n_head, hp.dropout, is_test
+    )
+    x = pre_post_process(x, attn, hp.dropout, is_test)
+    ffn = positionwise_ffn(x, hp.d_inner_hid, hp.d_model, hp.dropout, is_test)
+    return pre_post_process(x, ffn, hp.dropout, is_test)
+
+
+def decoder_layer(x, enc_out, self_bias, cross_bias, hp, is_test=False):
+    self_attn = multi_head_attention(
+        x, x, x, self_bias, hp.d_model, hp.n_head, hp.dropout, is_test
+    )
+    x = pre_post_process(x, self_attn, hp.dropout, is_test)
+    cross = multi_head_attention(
+        x, enc_out, enc_out, cross_bias, hp.d_model, hp.n_head, hp.dropout, is_test
+    )
+    x = pre_post_process(x, cross, hp.dropout, is_test)
+    ffn = positionwise_ffn(x, hp.d_inner_hid, hp.d_model, hp.dropout, is_test)
+    return pre_post_process(x, ffn, hp.dropout, is_test)
+
+
+def transformer(
+    src_ids, trg_ids, src_slf_attn_bias, trg_slf_attn_bias, trg_src_attn_bias,
+    hp=ModelHyperParams, is_test=False
+):
+    """Full encoder-decoder; returns [B, Tt, trg_vocab] logits."""
+    enc_in = prepare_embedding(
+        src_ids, hp.src_vocab_size, hp.d_model, hp.max_length, hp.dropout,
+        "src_pos_enc_table", is_test,
+    )
+    x = enc_in
+    for _ in range(hp.n_layer):
+        x = encoder_layer(x, src_slf_attn_bias, hp, is_test)
+    enc_out = x
+
+    dec_in = prepare_embedding(
+        trg_ids, hp.trg_vocab_size, hp.d_model, hp.max_length, hp.dropout,
+        "trg_pos_enc_table", is_test,
+    )
+    y = dec_in
+    for _ in range(hp.n_layer):
+        y = decoder_layer(y, enc_out, trg_slf_attn_bias, trg_src_attn_bias, hp, is_test)
+
+    logits = layers.fc(y, size=hp.trg_vocab_size, num_flatten_dims=2,
+                       bias_attr=False, param_attr=_pa("softmax_out.w"))
+    return logits
+
+
+def wmt_transformer_program(hp=ModelHyperParams, src_len=64, trg_len=64, learning_rate=2.0, warmup_steps=4000, is_test=False):
+    """Build (main, startup, feed names, [loss]) for training — the analog of
+    the reference's transformer train program w/ label smoothing + noam lr."""
+    import paddle_tpu as fluid
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        src = layers.data("src_word", shape=[src_len], dtype="int64")
+        trg = layers.data("trg_word", shape=[trg_len], dtype="int64")
+        lbl = layers.data("lbl_word", shape=[trg_len], dtype="int64")
+        src_bias = layers.data("src_slf_attn_bias", shape=[1, 1, src_len], dtype="float32")
+        trg_bias = layers.data("trg_slf_attn_bias", shape=[1, trg_len, trg_len], dtype="float32")
+        cross_bias = layers.data("trg_src_attn_bias", shape=[1, 1, src_len], dtype="float32")
+        weights = layers.data("lbl_weight", shape=[trg_len], dtype="float32")
+
+        logits = transformer(src, trg, src_bias, trg_bias, cross_bias, hp, is_test)
+        label_oh = layers.one_hot(lbl, hp.trg_vocab_size)
+        if hp.label_smooth_eps:
+            label_oh = layers.label_smooth(label_oh, epsilon=hp.label_smooth_eps)
+        cost = layers.softmax_with_cross_entropy(logits, label_oh, soft_label=True)
+        weighted = layers.elementwise_mul(cost, layers.unsqueeze(weights, [2]))
+        sum_cost = layers.reduce_sum(weighted)
+        token_count = layers.reduce_sum(weights)
+        avg_cost = layers.elementwise_div(sum_cost, token_count)
+
+        if not is_test:
+            lr = layers.learning_rate_scheduler.noam_decay(hp.d_model, warmup_steps)
+            lr = layers.scale(lr, scale=float(learning_rate))
+            opt = fluid.optimizer.Adam(learning_rate=lr, beta1=0.9, beta2=0.997, epsilon=1e-9)
+            opt.minimize(avg_cost)
+    feeds = [
+        "src_word", "trg_word", "lbl_word", "src_slf_attn_bias",
+        "trg_slf_attn_bias", "trg_src_attn_bias", "lbl_weight",
+    ]
+    return main, startup, feeds, [avg_cost, token_count]
+
+
+def make_fake_batch(batch_size, src_len, trg_len, hp=ModelHyperParams, seed=0):
+    """Synthetic padded batch + masks (host-side; analog of the data reader)."""
+    rng = np.random.RandomState(seed)
+    src = rng.randint(1, hp.src_vocab_size, (batch_size, src_len)).astype("int64")
+    trg = rng.randint(1, hp.trg_vocab_size, (batch_size, trg_len)).astype("int64")
+    lbl = rng.randint(1, hp.trg_vocab_size, (batch_size, trg_len)).astype("int64")
+    src_lens = rng.randint(src_len // 2, src_len + 1, (batch_size,))
+    trg_lens = rng.randint(trg_len // 2, trg_len + 1, (batch_size,))
+    neg = -1e9
+
+    src_pad = (np.arange(src_len)[None, :] >= src_lens[:, None])
+    src_bias = np.where(src_pad, neg, 0.0).astype("float32")[:, None, None, :]
+
+    causal = np.triu(np.ones((trg_len, trg_len)), k=1) * neg
+    trg_pad = (np.arange(trg_len)[None, :] >= trg_lens[:, None])
+    trg_bias = np.where(trg_pad[:, None, :], neg, 0.0) + causal[None, :, :]
+    trg_bias = trg_bias[:, None, :, :].astype("float32")
+
+    cross_bias = np.where(src_pad, neg, 0.0).astype("float32")[:, None, None, :]
+    weights = (~trg_pad).astype("float32")
+    return {
+        "src_word": src,
+        "trg_word": trg,
+        "lbl_word": lbl,
+        "src_slf_attn_bias": src_bias,
+        "trg_slf_attn_bias": trg_bias,
+        "trg_src_attn_bias": cross_bias,
+        "lbl_weight": weights,
+    }

@@ -1,1 +1,19 @@
-"""paddle_tpu.parallel"""
+"""Parallelism: mesh + sharding rules + collectives.
+
+This package replaces three reference subsystems with one mechanism
+(SPMD sharding over a jax Mesh):
+- ParallelExecutor's NCCL allreduce graph build (framework/details/,
+  multi_devices_graph_pass.cc) -> batch-sharded feeds + replicated params;
+  XLA inserts the gradient all-reduce over ICI.
+- DistributeTranspiler's program rewrite (transpiler/distribute_transpiler.py)
+  -> ShardingRules annotating parameter PartitionSpecs (tensor parallelism,
+  sharded embeddings) consumed by DistributedExecutor.
+- gen_nccl_id/gRPC bootstrap (distributed_ops/) -> jax.distributed.initialize
+  over DCN (collective.init_distributed_env).
+"""
+
+from .mesh import make_mesh, default_mesh, mesh_axis_sizes
+from .sharding import ShardingRules, data_parallel_rules, transformer_tp_rules
+from .executor import DistributedExecutor
+from . import ring
+from . import collective

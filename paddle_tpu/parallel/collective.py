@@ -1,0 +1,87 @@
+"""Distributed bootstrap + collective helpers.
+
+The reference bootstraps NCCL with an ad-hoc gRPC broadcast of the unique id
+(distributed_ops/gen_nccl_id_op.cc:31) and wires multi-node ranks through
+env vars (PADDLE_TRAINER_ID etc.).  TPU-natively the whole thing is
+jax.distributed.initialize over DCN; the same env-var contract is honored so
+reference launch scripts keep working.
+"""
+
+import os
+
+import jax
+
+__all__ = [
+    "init_distributed_env",
+    "all_reduce",
+    "all_gather",
+    "reduce_scatter",
+    "broadcast",
+    "barrier",
+    "trainer_id",
+    "num_trainers",
+]
+
+
+def trainer_id():
+    return int(os.environ.get("PADDLE_TRAINER_ID", os.environ.get("TRAINER_ID", 0)))
+
+
+def num_trainers():
+    return int(os.environ.get("PADDLE_TRAINERS", os.environ.get("TRAINERS", 1)))
+
+
+def init_distributed_env(coordinator_address=None, num_processes=None, process_id=None):
+    """Multi-host bootstrap (gen_nccl_id + NCCLContextMap analog).
+
+    coordinator defaults from PADDLE_PSERVER_IPS/PADDLE_CURRENT_IP-style env
+    or JAX defaults; call once per host before building executors."""
+    if coordinator_address is None:
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        if eps:
+            coordinator_address = eps.split(",")[0]
+    if num_processes is None:
+        num_processes = num_trainers()
+    if process_id is None:
+        process_id = trainer_id()
+    if num_processes <= 1:
+        return  # single-process: nothing to do
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+# thin named wrappers so user kernels/shard_map bodies read like the
+# reference's collective vocabulary
+def all_reduce(x, axis_name, op="sum"):
+    if op == "sum":
+        return jax.lax.psum(x, axis_name)
+    if op == "max":
+        return jax.lax.pmax(x, axis_name)
+    if op == "min":
+        return jax.lax.pmin(x, axis_name)
+    if op == "mean":
+        return jax.lax.pmean(x, axis_name)
+    raise ValueError(op)
+
+
+def all_gather(x, axis_name, axis=0, tiled=True):
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name, scatter_dimension=0):
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=scatter_dimension, tiled=True)
+
+
+def broadcast(x, axis_name, src=0):
+    idx = jax.lax.axis_index(axis_name)
+    import jax.numpy as jnp
+
+    sel = (idx == src).astype(x.dtype)
+    return jax.lax.psum(x * sel, axis_name)
+
+
+def barrier(axis_name):
+    jax.lax.psum(1, axis_name)

@@ -1,0 +1,34 @@
+"""Device-mesh helpers (the NCCLContextMap analog — nccl_helper.h:82 —
+except the 'communicators' are implicit in XLA collectives over the mesh)."""
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+__all__ = ["make_mesh", "default_mesh", "mesh_axis_sizes"]
+
+
+def make_mesh(axes, devices=None):
+    """axes: dict name->size in order, e.g. {"dp": 2, "mp": 4}. Use -1 for
+    one axis to absorb the remaining devices."""
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = n // known
+    total = int(np.prod(sizes))
+    if total > n:
+        raise ValueError("mesh %s needs %d devices, have %d" % (axes, total, n))
+    arr = np.array(devices[:total]).reshape(sizes)
+    return Mesh(arr, tuple(names))
+
+
+def default_mesh(axis_name="dp"):
+    return make_mesh({axis_name: -1})
+
+
+def mesh_axis_sizes(mesh):
+    return dict(zip(mesh.axis_names, mesh.devices.shape))

@@ -1,0 +1,100 @@
+"""Ring attention — sequence/context parallelism over a mesh axis.
+
+The reference has no long-context story (SURVEY.md §5.7 'Absent'); this is
+green-field TPU design: K/V blocks rotate around the `sp` axis ring via
+ppermute (one hop per step, riding ICI) while each device holds its local Q
+chunk and maintains flash-style running max/denominator — memory O(T_local),
+compute overlapped with the rotation by XLA's async collective scheduling.
+
+Use `ring_attention(...)` inside shard_map (see `ring_attention_sharded` for
+the wrapped convenience entry).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["ring_attention", "ring_attention_sharded"]
+
+
+def _block_attn(q, k, v, scale, bias=None):
+    """One q-block x k-block attention piece: returns (scores_max, exp_scores
+    @ v, exp row sums) for flash-style merging. q:[B,H,Tq,D] k,v:[B,H,Tk,D]."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if bias is not None:
+        s = s + bias
+    m = jnp.max(s, axis=-1)  # [B,H,Tq]
+    p = jnp.exp(s - m[..., None])
+    pv = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    l = jnp.sum(p, axis=-1)
+    return m, pv, l
+
+
+def ring_attention(q, k, v, axis_name, causal=False, scale=None):
+    """Per-shard attention with K/V ring rotation.
+
+    q, k, v: local chunks [B, H, T_local, D]; global sequence is the
+    concatenation over the `axis_name` ring in axis-index order.
+    Returns the local output chunk [B, H, T_local, D].
+    """
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    t_local = q.shape[2]
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    neg = jnp.asarray(-1e30, q.dtype)
+
+    q_pos = my * t_local + jnp.arange(t_local)  # global positions of local q
+
+    def step(i, carry):
+        k_blk, v_blk, m_acc, o_acc, l_acc = carry
+        src = (my - i) % n  # which rank's block we currently hold
+        bias = None
+        if causal:
+            k_pos = src * t_local + jnp.arange(t_local)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            bias = jnp.where(mask, 0.0, neg).astype(q.dtype)[None, None]
+        m_blk, pv_blk, l_blk = _block_attn(q, k_blk, v_blk, scale, bias)
+        # flash merge
+        m_new = jnp.maximum(m_acc, m_blk)
+        alpha = jnp.exp(m_acc - m_new)
+        beta = jnp.exp(m_blk - m_new)
+        o_new = o_acc * alpha[..., None] + pv_blk * beta[..., None]
+        l_new = l_acc * alpha + l_blk * beta
+        # rotate k/v to the next rank (ring over ICI)
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        k_nxt = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (k_nxt, v_nxt, m_new, o_new, l_new)
+
+    m0 = jnp.full(q.shape[:-1], -jnp.inf, q.dtype)
+    o0 = jnp.zeros_like(q)
+    l0 = jnp.zeros(q.shape[:-1], q.dtype)
+    # static ring length: unrolled python loop (n is a traced constant under
+    # shard_map; use fori_loop only when n is dynamic)
+    carry = (k, v, m0, o0, l0)
+    for i in range(int(n)):
+        carry = step(i, carry)
+    _, _, m_f, o_f, l_f = carry
+    return o_f / l_f[..., None]
+
+
+def ring_attention_sharded(q, k, v, mesh, axis_name="sp", causal=False):
+    """Convenience wrapper: shard q/k/v over `axis_name` on the time dim and
+    run ring_attention under shard_map.  q,k,v: [B, H, T, D] global."""
+    from jax import shard_map
+
+    spec = P(None, None, axis_name, None)
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    def inner(ql, kl, vl):
+        return ring_attention(ql, kl, vl, axis_name, causal=causal)
+
+    return inner(q, k, v)

@@ -1,0 +1,63 @@
+"""Parameter sharding rules — the GSPMD successor to DistributeTranspiler.
+
+The reference rewrites programs: slice params into blocks, route to pservers
+(`transpiler/distribute_transpiler.py:239`, slice_variable :80).  Here the
+*same program* runs everywhere; a rule list maps parameter names (regex) to
+PartitionSpecs, the executor places state with those shardings, and the XLA
+SPMD partitioner emits the collectives the transpiler used to hand-insert
+(send/recv -> all_gather/reduce_scatter over ICI).
+"""
+
+import re
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+__all__ = ["ShardingRules", "data_parallel_rules", "transformer_tp_rules", "P"]
+
+
+class ShardingRules:
+    """Ordered (regex, PartitionSpec) list; first match wins; default
+    replicated."""
+
+    def __init__(self, rules=None, default=P()):
+        self.rules = [(re.compile(pat), spec) for pat, spec in (rules or [])]
+        self.default = default
+
+    def spec_for(self, name, ndim=None):
+        for pat, spec in self.rules:
+            if pat.search(name):
+                # rank guard: optimizer scalars (beta_pow etc.) share the
+                # param's name prefix but not its rank — replicate those
+                if ndim is not None and len(spec) > ndim:
+                    return self.default
+                return spec
+        return self.default
+
+    def sharding_for(self, mesh, name, ndim=None):
+        return NamedSharding(mesh, self.spec_for(name, ndim))
+
+    def add(self, pattern, spec):
+        self.rules.append((re.compile(pattern), spec))
+        return self
+
+
+def data_parallel_rules():
+    """Pure DP: everything replicated; batch dim sharding comes from feeds."""
+    return ShardingRules()
+
+
+def transformer_tp_rules(mp_axis="mp"):
+    """Megatron-style tensor parallelism for the transformer model
+    (models/transformer.py parameter naming): qkv & ffn-in column-parallel,
+    attn-out & ffn-out row-parallel, embeddings vocab-sharded."""
+    return ShardingRules(
+        [
+            (r"mha_[qkv]\.w", P(None, mp_axis)),
+            (r"mha_o\.w", P(mp_axis, None)),
+            (r"ffn_in\.w", P(None, mp_axis)),
+            (r"ffn_in\.b", P(mp_axis)),
+            (r"ffn_out\.w", P(mp_axis, None)),
+            (r"embedding.*\.w|emb\.w", P(mp_axis, None)),
+            (r"softmax_out\.w", P(None, mp_axis)),
+        ]
+    )

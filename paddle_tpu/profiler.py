@@ -1,0 +1,138 @@
+"""Profiler (python/paddle/fluid/profiler.py + platform/profiler.{h,cc}
+analog).
+
+The reference wraps every op run in RecordEvent scopes and correlates CUPTI
+device activity into a chrome-trace timeline (tools/timeline.py).  Here host
+scopes are kept (RecordEvent spans around executor runs + user ranges) and
+device-side tracing delegates to jax.profiler (XLA/xplane — TensorBoard
+readable), with the host spans additionally dumped as chrome-trace JSON so
+`profiler(state)`-style workflows keep their artifact.
+"""
+
+import contextlib
+import json
+import os
+import threading
+import time
+
+__all__ = [
+    "RecordEvent",
+    "record_event",
+    "profiler",
+    "start_profiler",
+    "stop_profiler",
+    "reset_profiler",
+    "cuda_profiler",
+    "tpu_profiler",
+]
+
+_events = []
+_events_lock = threading.Lock()
+_enabled = False
+_trace_dir = None
+
+
+class RecordEvent:
+    """RAII span (platform/profiler.h:73 RecordEvent parity)."""
+
+    def __init__(self, name):
+        self.name = name
+        self.t0 = None
+
+    def __enter__(self):
+        self.t0 = time.time()
+        return self
+
+    def __exit__(self, *exc):
+        if _enabled:
+            t1 = time.time()
+            with _events_lock:
+                _events.append(
+                    {
+                        "name": self.name,
+                        "ph": "X",
+                        "ts": self.t0 * 1e6,
+                        "dur": (t1 - self.t0) * 1e6,
+                        "pid": os.getpid(),
+                        "tid": threading.get_ident() % 10000,
+                    }
+                )
+        return False
+
+
+@contextlib.contextmanager
+def record_event(name):
+    with RecordEvent(name):
+        yield
+
+
+def reset_profiler():
+    with _events_lock:
+        _events.clear()
+
+
+def start_profiler(state="All", trace_dir=None):
+    """state in {CPU, GPU/TPU, All} (API parity; device tracing is xplane)."""
+    global _enabled, _trace_dir
+    _enabled = True
+    _trace_dir = trace_dir
+    if state in ("GPU", "TPU", "All") and trace_dir:
+        import jax
+
+        jax.profiler.start_trace(trace_dir)
+
+
+def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
+    """Stop; write host spans as chrome trace json + stop device trace."""
+    global _enabled
+    _enabled = False
+    if _trace_dir:
+        import jax
+
+        try:
+            jax.profiler.stop_trace()
+        except RuntimeError:
+            pass
+    with _events_lock:
+        evs = list(_events)
+    if profile_path:
+        with open(profile_path + ".json" if not profile_path.endswith(".json") else profile_path, "w") as f:
+            json.dump({"traceEvents": evs}, f)
+    # aggregate table (EnableProfiler report parity)
+    agg = {}
+    for e in evs:
+        a = agg.setdefault(e["name"], [0, 0.0])
+        a[0] += 1
+        a[1] += e["dur"] / 1e3
+    rows = sorted(agg.items(), key=lambda kv: -kv[1][1])
+    if rows:
+        print("%-40s %8s %12s" % ("Event", "Calls", "Total(ms)"))
+        for name, (calls, total) in rows[:30]:
+            print("%-40s %8d %12.2f" % (name[:40], calls, total))
+    return rows
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path="/tmp/profile", trace_dir=None):
+    """`with profiler('All'):` context (fluid.profiler.profiler :221 parity)."""
+    reset_profiler()
+    start_profiler(state, trace_dir)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+@contextlib.contextmanager
+def tpu_profiler(output_dir):
+    """Device-side trace via jax.profiler (cuda_profiler :39 analog)."""
+    import jax
+
+    jax.profiler.start_trace(output_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+cuda_profiler = tpu_profiler  # API alias for reference scripts

@@ -1,1 +1,12 @@
-"""paddle_tpu.reader"""
+"""Input pipeline.
+
+Reader combinators (decorator.py) mirror python/paddle/reader; the device
+feeding path replaces the reference's reader-op stack (py_reader +
+LoDTensorBlockingQueue + double_buffer, operators/reader/) with a host-side
+prefetch thread that stages batches ahead with jax.device_put — the
+TPU-idiomatic equivalent of double buffering into device memory.
+"""
+
+from .decorator import *  # noqa: F401,F403
+from .decorator import batch
+from .pipeline import PyReader, DeviceFeeder

@@ -1,0 +1,281 @@
+"""Functional reader combinators (python/paddle/reader/decorator.py:36-215
+analog): a reader is a zero-arg callable returning a fresh iterator of
+samples; decorators compose readers."""
+
+import itertools
+import multiprocessing
+import queue
+import random
+import subprocess
+import threading
+
+__all__ = [
+    "map_readers",
+    "buffered",
+    "compose",
+    "chain",
+    "shuffle",
+    "firstn",
+    "xmap_readers",
+    "multiprocess_reader",
+    "cache",
+    "batch",
+    "PipeReader",
+]
+
+
+def map_readers(func, *readers):
+    def reader():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+
+    return reader
+
+
+def shuffle(reader, buf_size):
+    def data_reader():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                random.shuffle(buf)
+                for b in buf:
+                    yield b
+                buf = []
+        if buf:
+            random.shuffle(buf)
+            for b in buf:
+                yield b
+
+    return data_reader
+
+
+def chain(*readers):
+    def reader():
+        for r in readers:
+            for e in r():
+                yield e
+
+    return reader
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def compose(*readers, **kwargs):
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        if isinstance(x, tuple):
+            return x
+        return (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        _missing = object()
+        for outputs in itertools.zip_longest(*rs, fillvalue=_missing):
+            if any(x is _missing for x in outputs):
+                if check_alignment:
+                    raise ComposeNotAligned(
+                        "outputs of readers are not aligned (different lengths)"
+                    )
+                yield sum(
+                    (make_tuple(x) for x in outputs if x is not _missing), ()
+                )
+            else:
+                yield sum((make_tuple(x) for x in outputs), ())
+
+    return reader
+
+
+def buffered(reader, size):
+    """Prefetch `size` samples on a background thread."""
+
+    class _End:
+        pass
+
+    def data_reader():
+        r = reader()
+        q = queue.Queue(maxsize=size)
+
+        def fill():
+            try:
+                for d in r:
+                    q.put(d)
+            finally:
+                q.put(_End)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is _End:
+                break
+            yield e
+
+    return data_reader
+
+
+def firstn(reader, n):
+    def data_reader():
+        for i, item in enumerate(reader()):
+            if i == n:
+                break
+            yield item
+
+    return data_reader
+
+
+def cache(reader):
+    all_data = None
+
+    def data_reader():
+        nonlocal all_data
+        if all_data is None:
+            all_data = list(reader())
+        return iter(all_data)
+
+    return data_reader
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Group samples into lists of batch_size (paddle.batch analog)."""
+
+    def data_reader():
+        b = []
+        for item in reader():
+            b.append(item)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    return data_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map over samples with worker threads (decorator.py:243).
+    Exceptions in the source reader or mapper propagate to the consumer
+    (threads always post their end/error sentinel, so no deadlock)."""
+
+    _End = object()
+
+    def data_reader():
+        in_q = queue.Queue(buffer_size)
+        out_q = queue.Queue(buffer_size)
+
+        def feed():
+            try:
+                for i, d in enumerate(reader()):
+                    in_q.put((i, d))
+            except BaseException as e:  # propagate through the workers
+                out_q.put(("__exc__", e))
+            finally:
+                for _ in range(process_num):
+                    in_q.put(_End)
+
+        def work():
+            try:
+                while True:
+                    item = in_q.get()
+                    if item is _End:
+                        break
+                    i, d = item
+                    out_q.put((i, mapper(d)))
+            except BaseException as e:
+                out_q.put(("__exc__", e))
+            finally:
+                out_q.put(_End)
+
+        threading.Thread(target=feed, daemon=True).start()
+        workers = [threading.Thread(target=work, daemon=True) for _ in range(process_num)]
+        for w in workers:
+            w.start()
+        finished = 0
+        pending = {}
+        next_idx = 0
+        while finished < process_num:
+            item = out_q.get()
+            if item is _End:
+                finished += 1
+                continue
+            if item[0] == "__exc__":
+                raise item[1]
+            if not order:
+                yield item[1]
+                continue
+            pending[item[0]] = item[1]
+            while next_idx in pending:
+                yield pending.pop(next_idx)
+                next_idx += 1
+        if order:
+            while next_idx in pending:
+                yield pending.pop(next_idx)
+                next_idx += 1
+
+    return data_reader
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Run multiple readers in subprocesses (decorator.py:338).  As in the
+    reference, a sample of None is an error (None is reserved; a tagged
+    sentinel marks end-of-reader)."""
+
+    _END = ("__reader_end__",)
+
+    def data_reader():
+        q = multiprocessing.Queue(queue_size)
+
+        def work(r):
+            try:
+                for d in r():
+                    if d is None:
+                        raise ValueError("sample has None")
+                    q.put(d)
+            finally:
+                q.put(_END)
+
+        procs = [multiprocessing.Process(target=work, args=(r,)) for r in readers]
+        for p in procs:
+            p.daemon = True
+            p.start()
+        finished = 0
+        while finished < len(readers):
+            d = q.get()
+            if isinstance(d, tuple) and len(d) == 1 and d[0] == "__reader_end__":
+                finished += 1
+            else:
+                yield d
+
+    return data_reader
+
+
+class PipeReader:
+    """Stream samples from a shell command's stdout (decorator.py:438)."""
+
+    def __init__(self, command, bufsize=8192, file_type="plain"):
+        self.command = command
+        self.bufsize = bufsize
+        self.file_type = file_type
+
+    def get_line(self, cut_lines=True, line_break="\n"):
+        proc = subprocess.Popen(
+            self.command, shell=True, bufsize=self.bufsize, stdout=subprocess.PIPE
+        )
+        remained = b""
+        while True:
+            buf = proc.stdout.read(self.bufsize)
+            if not buf:
+                break
+            if cut_lines:
+                lines = (remained + buf).split(line_break.encode())
+                remained = lines.pop()
+                for line in lines:
+                    yield line.decode("utf-8", "ignore")
+            else:
+                yield buf
+        if remained:
+            yield remained.decode("utf-8", "ignore")

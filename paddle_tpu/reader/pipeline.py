@@ -1,0 +1,129 @@
+"""Device feeding pipeline — the py_reader/double_buffer analog.
+
+Reference: `layers/io.py:635 py_reader` + `operators/reader/
+create_double_buffer_reader_op.cc`: a blocking queue feeds a prefetching
+device reader so input upload overlaps compute.  Here a background thread
+converts host batches and `jax.device_put`s them ahead of use; the executor
+consumes ready-on-device arrays, so the step function never waits on H2D.
+"""
+
+import queue
+import threading
+
+import numpy as np
+
+__all__ = ["PyReader", "DeviceFeeder"]
+
+
+class _Stop:
+    pass
+
+
+class DeviceFeeder:
+    """Wrap an iterator of feed dicts; prefetch `capacity` batches to device."""
+
+    def __init__(self, place=None, capacity=2):
+        from ..places import default_place
+
+        self.place = place or default_place()
+        self.capacity = capacity
+
+    def __call__(self, batches):
+        import jax
+
+        device = self.place.jax_device()
+        q = queue.Queue(maxsize=self.capacity)
+        stop = threading.Event()
+
+        def work():
+            try:
+                for feed in batches:
+                    staged = {
+                        k: jax.device_put(np.asarray(v), device)
+                        for k, v in feed.items()
+                    }
+                    # bounded put that notices consumer shutdown — an
+                    # abandoned iterator must not pin staged device buffers
+                    while not stop.is_set():
+                        try:
+                            q.put(staged, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if stop.is_set():
+                        return
+            finally:
+                try:
+                    q.put_nowait(_Stop)
+                except queue.Full:
+                    pass
+
+        t = threading.Thread(target=work, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is _Stop:
+                    break
+                yield item
+        finally:
+            # consumer broke out early (or exhausted): release the producer
+            # and drop any staged batches so device memory is reclaimable
+            stop.set()
+            try:
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
+
+
+class PyReader:
+    """fluid.layers.py_reader-shaped API: decorate with a paddle-style batch
+    reader + feed var list; iterate trained steps off the prefetch queue.
+
+    Usage:
+        reader = PyReader(feed_list=[img, label], capacity=4)
+        reader.decorate_paddle_reader(paddle.batch(train_reader, 32))
+        for feed in reader():
+            exe.run(feed=feed, fetch_list=[loss])
+    """
+
+    def __init__(self, feed_list, capacity=4, place=None):
+        from ..framework import Variable
+
+        self.feed_names = [
+            v.name if isinstance(v, Variable) else str(v) for v in feed_list
+        ]
+        self.capacity = capacity
+        self._reader = None
+        self._feeder = DeviceFeeder(place, capacity)
+
+    def decorate_paddle_reader(self, reader):
+        self._reader = reader
+
+    decorate_sample_list_generator = decorate_paddle_reader
+
+    def decorate_batch_generator(self, generator):
+        self._reader = generator
+
+    def __call__(self):
+        assert self._reader is not None, "call decorate_paddle_reader first"
+
+        def to_feeds():
+            for batch_rows in self._reader():
+                if isinstance(batch_rows, dict):
+                    yield batch_rows
+                    continue
+                cols = list(zip(*batch_rows))
+                yield {
+                    name: np.asarray(col)
+                    for name, col in zip(self.feed_names, cols)
+                }
+
+        return self._feeder(to_feeds())
+
+    def start(self):
+        pass
+
+    def reset(self):
+        pass

@@ -1,0 +1,65 @@
+"""Model zoo smoke tests (tiny shapes): resnet cifar, mnist cnn, transformer."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.models import mnist as mnist_model
+from paddle_tpu.models import resnet as resnet_model
+from paddle_tpu.models import transformer as tfm
+
+
+def test_resnet_cifar_trains():
+    img = layers.data("image", shape=[3, 32, 32])
+    label = layers.data("label", shape=[1], dtype="int64")
+    pred = resnet_model.resnet_cifar10(img, class_dim=10, depth=8)
+    loss = layers.mean(layers.cross_entropy(pred, label))
+    fluid.optimizer.Momentum(0.05, 0.9).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    x = rng.rand(8, 3, 32, 32).astype("float32")
+    y = rng.randint(0, 10, (8, 1)).astype("int64")
+    losses = [
+        float(np.asarray(exe.run(feed={"image": x, "label": y}, fetch_list=[loss])[0])[0])
+        for _ in range(6)
+    ]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+
+def test_mnist_cnn_forward():
+    img = layers.data("image", shape=[1, 28, 28])
+    pred = mnist_model.cnn_model(img)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    out, = exe.run(feed={"image": np.random.rand(4, 1, 28, 28).astype("float32")},
+                   fetch_list=[pred])
+    assert np.asarray(out).shape == (4, 10)
+    np.testing.assert_allclose(np.asarray(out).sum(1), np.ones(4), rtol=1e-4)
+
+
+class TinyHP(tfm.ModelHyperParams):
+    src_vocab_size = 64
+    trg_vocab_size = 64
+    max_length = 16
+    d_model = 32
+    d_inner_hid = 64
+    n_head = 4
+    n_layer = 2
+    dropout = 0.1
+
+
+def test_transformer_trains():
+    main, startup, feeds, fetches = tfm.wmt_transformer_program(
+        TinyHP, src_len=8, trg_len=8, warmup_steps=10
+    )
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    losses = []
+    for i in range(8):
+        batch = tfm.make_fake_batch(4, 8, 8, TinyHP, seed=0)
+        out = exe.run(main, feed=batch, fetch_list=fetches)
+        losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
+    assert np.isfinite(losses).all(), losses
+    assert losses[-1] < losses[0], losses

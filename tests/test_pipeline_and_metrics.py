@@ -1,0 +1,107 @@
+"""Reader combinators, PyReader device pipeline, datasets, metrics, profiler."""
+
+import os
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, metrics, profiler, reader
+from paddle_tpu.dataset import mnist, uci_housing
+
+
+def test_reader_decorators():
+    r = lambda: iter(range(10))
+    assert list(reader.firstn(r, 3)()) == [0, 1, 2]
+    assert sorted(reader.shuffle(r, 5)()) == list(range(10))
+    assert list(reader.chain(r, r)()) == list(range(10)) * 2
+    assert list(reader.map_readers(lambda a: a * 2, r)()) == [i * 2 for i in range(10)]
+    assert list(reader.buffered(r, 2)()) == list(range(10))
+    batches = list(reader.batch(r, 4)())
+    assert batches == [[0, 1, 2, 3], [4, 5, 6, 7], [8, 9]]
+    assert list(reader.batch(r, 4, drop_last=True)()) == [[0, 1, 2, 3], [4, 5, 6, 7]]
+    mapped = sorted(reader.xmap_readers(lambda x: x + 1, r, 2, 4)())
+    assert mapped == [i + 1 for i in range(10)]
+    ordered = list(reader.xmap_readers(lambda x: x * 3, r, 3, 4, order=True)())
+    assert ordered == [i * 3 for i in range(10)]
+
+
+def test_pyreader_trains_mnist():
+    img = layers.data("img", shape=[784])
+    label = layers.data("label", shape=[1], dtype="int64")
+    pred = layers.fc(layers.fc(img, 64, act="relu"), 10, act="softmax")
+    loss = layers.mean(layers.cross_entropy(pred, label))
+    acc = layers.accuracy(pred, label)
+    fluid.optimizer.Adam(0.01).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    train_reader = reader.batch(mnist.train(), 64, drop_last=True)
+    pyreader = reader.PyReader(feed_list=[img, label], capacity=4, place=fluid.CPUPlace())
+
+    def to_cols():
+        for rows in train_reader():
+            xs = np.stack([r[0] for r in rows])
+            ys = np.array([[r[1]] for r in rows], "int64")
+            yield {"img": xs, "label": ys}
+
+    pyreader.decorate_batch_generator(to_cols)
+    accs = []
+    m = metrics.Accuracy()
+    for i, feed in enumerate(pyreader()):
+        lv, av = exe.run(feed=feed, fetch_list=[loss, acc])
+        m.update(av, 64)
+        accs.append(float(np.asarray(av)[0]))
+        if i >= 40:
+            break
+    # synthetic mnist is separable: accuracy should climb well past chance
+    assert np.mean(accs[-5:]) > 0.5, np.mean(accs[-5:])
+    assert 0 <= m.eval() <= 1
+
+
+def test_uci_housing_linear_regression():
+    x = layers.data("x", shape=[13])
+    y = layers.data("y", shape=[1])
+    pred = layers.fc(x, size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(0.01).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    losses = []
+    for epoch in range(4):
+        for rows in reader.batch(uci_housing.train(), 32)():
+            xs = np.stack([r[0] for r in rows])
+            ys = np.stack([r[1] for r in rows])
+            (lv,) = exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])
+            losses.append(float(np.asarray(lv)[0]))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_metrics_precision_recall_auc():
+    p = metrics.Precision()
+    p.update(np.array([1, 1, 0, 1]), np.array([1, 0, 0, 1]))
+    assert abs(p.eval() - 2 / 3) < 1e-6
+    r = metrics.Recall()
+    r.update(np.array([1, 0, 0, 1]), np.array([1, 1, 0, 1]))
+    assert abs(r.eval() - 2 / 3) < 1e-6
+    auc = metrics.Auc()
+    preds = np.array([[0.9, 0.1], [0.2, 0.8], [0.3, 0.7], [0.6, 0.4]])
+    labels = np.array([0, 1, 1, 0])
+    auc.update(preds, labels)
+    assert auc.eval() == 1.0
+
+
+def test_profiler_records(tmp_path):
+    path = str(tmp_path / "prof")
+    x = layers.data("x", shape=[4])
+    out = layers.fc(x, size=4)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    with profiler.profiler("CPU", profile_path=path):
+        for _ in range(3):
+            exe.run(feed={"x": np.ones((2, 4), "float32")}, fetch_list=[out])
+    import json
+
+    with open(path + ".json") as f:
+        trace = json.load(f)
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert "executor_run" in names
