@@ -11,6 +11,14 @@ from . import math_ops  # noqa: F401
 from . import tensor_ops  # noqa: F401
 from . import nn_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
+from . import loss_ops  # noqa: F401
+from . import image_ops  # noqa: F401
+from . import crf_ops  # noqa: F401
+from . import ctc_ops  # noqa: F401
+from . import search_ops  # noqa: F401
+from . import quant_ops  # noqa: F401
+from . import metric_ops  # noqa: F401
+from . import detection_ops  # noqa: F401
 
 get_op = registry.get_op
 is_registered = registry.is_registered

@@ -1,0 +1,257 @@
+"""Linear-chain CRF ops (operators/linear_chain_crf_op.cc,
+crf_decoding_op.cc, chunk_eval_op.cc).
+
+TPU design: the reference runs a per-sequence C++ forward/backward over LoD
+rows; here sequences arrive padded [B, T, N] + Length [B], the alpha
+recursion is a `lax.scan` over time (batched over B on the VPU), and the
+gradient of the log-likelihood falls out of vjp of the scan — no
+hand-written CRF backward.
+
+Transition layout matches the reference (linear_chain_crf_op.cc): row 0 =
+start weights a, row 1 = end weights b, rows 2.. = w[i][j] transition from
+tag i to tag j.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register
+
+
+def _crf_norm(emission, transition, length):
+    """log Z per sequence via forward algorithm. emission [B,T,N]."""
+    b, t, n = emission.shape
+    a = transition[0]
+    w = transition[2:]  # [N, N]
+    alpha0 = a[None, :] + emission[:, 0]  # [B, N]
+
+    def step(alpha, inp):
+        e_t, t_idx = inp  # [B, N], scalar
+        # logsumexp over prev tag: alpha[prev] + w[prev, cur]
+        nxt = jax.nn.logsumexp(alpha[:, :, None] + w[None], axis=1) + e_t
+        m = (t_idx < length)[:, None]
+        alpha = jnp.where(m, nxt, alpha)
+        return alpha, None
+
+    ts = jnp.arange(1, t)
+    alpha, _ = jax.lax.scan(step, alpha0, (jnp.swapaxes(emission, 0, 1)[1:], ts))
+    bvec = transition[1]
+    return jax.nn.logsumexp(alpha + bvec[None, :], axis=1)  # [B]
+
+
+def _crf_path_score(emission, transition, label, length):
+    b, t, n = emission.shape
+    a, bvec, w = transition[0], transition[1], transition[2:]
+    lab = label.astype(jnp.int32)
+    pos = jnp.arange(t)[None, :]
+    valid = pos < length[:, None]  # [B, T]
+    em = jnp.take_along_axis(emission, lab[:, :, None], axis=2)[..., 0]
+    score = jnp.sum(jnp.where(valid, em, 0.0), axis=1)
+    score = score + a[lab[:, 0]]
+    trans = w[lab[:, :-1], lab[:, 1:]]  # [B, T-1]
+    tvalid = (pos[:, 1:] < length[:, None])
+    score = score + jnp.sum(jnp.where(tvalid, trans, 0.0), axis=1)
+    last = jnp.take_along_axis(lab, (length - 1)[:, None], axis=1)[:, 0]
+    return score + bvec[last]
+
+
+@register("linear_chain_crf", no_grad_inputs=("Label", "Length"))
+def _linear_chain_crf(ctx, ins, attrs):
+    emission = ins["Emission"][0]  # [B, T, N]
+    transition = ins["Transition"][0]  # [N+2, N]
+    label = ins["Label"][0]
+    if label.ndim == 3:
+        label = label[..., 0]
+    if ins.get("Length"):
+        length = ins["Length"][0].reshape(-1).astype(jnp.int32)
+    else:
+        length = jnp.full((emission.shape[0],), emission.shape[1], jnp.int32)
+    logz = _crf_norm(emission, transition, length)
+    score = _crf_path_score(emission, transition, label, length)
+    ll = (logz - score).reshape(-1, 1)
+    return {
+        "LogLikelihood": [ll],
+        "Alpha": [jax.lax.stop_gradient(jnp.exp(emission))],
+        "EmissionExps": [jax.lax.stop_gradient(jnp.exp(emission))],
+        "TransitionExps": [jax.lax.stop_gradient(jnp.exp(transition))],
+    }
+
+
+@register("crf_decoding", no_grad_inputs=("Emission", "Transition", "Label", "Length"))
+def _crf_decoding(ctx, ins, attrs):
+    """Viterbi decode. Output ViterbiPath [B, T] (padded positions 0); if
+    Label is given, outputs 1 where decoded == label (the reference's
+    evaluation mode)."""
+    emission = ins["Emission"][0]
+    transition = ins["Transition"][0]
+    b, t, n = emission.shape
+    if ins.get("Length"):
+        length = ins["Length"][0].reshape(-1).astype(jnp.int32)
+    else:
+        length = jnp.full((b,), t, jnp.int32)
+    a, bvec, w = transition[0], transition[1], transition[2:]
+
+    alpha0 = a[None, :] + emission[:, 0]
+
+    def step(alpha, inp):
+        e_t, t_idx = inp
+        scores = alpha[:, :, None] + w[None]  # [B, prev, cur]
+        best_prev = jnp.argmax(scores, axis=1)  # [B, cur]
+        nxt = jnp.max(scores, axis=1) + e_t
+        m = (t_idx < length)[:, None]
+        alpha_new = jnp.where(m, nxt, alpha)
+        return alpha_new, best_prev
+
+    ts = jnp.arange(1, t)
+    alpha, backptr = jax.lax.scan(
+        step, alpha0, (jnp.swapaxes(emission, 0, 1)[1:], ts)
+    )  # backptr [T-1, B, N]
+
+    # add end weights at each sequence's true last step: emulate by adding b
+    # to alpha (alpha holds the last valid step's scores after masking)
+    alpha = alpha + bvec[None, :]
+    last_tag = jnp.argmax(alpha, axis=1)  # [B]
+
+    def back(tag, inp):
+        bp, t_idx = inp  # [B, N], scalar (time t_idx, pointer into t_idx+1)
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        # only follow pointers within the valid region
+        tag_new = jnp.where(t_idx + 1 < length, prev, tag)
+        return tag_new, tag_new
+
+    # walk backwards from t-2 .. 0 emitting the tag at each position
+    _, tags_rev = jax.lax.scan(
+        back, last_tag, (jnp.flip(backptr, 0), jnp.flip(ts - 1, 0))
+    )
+    path = jnp.concatenate(
+        [jnp.flip(tags_rev, 0), last_tag[None]], axis=0
+    )  # [T, B] -- position t holds tag chosen at t... need realign
+    path = jnp.swapaxes(path, 0, 1)  # [B, T]
+    pos = jnp.arange(t)[None, :]
+    path = jnp.where(pos < length[:, None], path, 0)
+    if ins.get("Label"):
+        label = ins["Label"][0]
+        if label.ndim == 3:
+            label = label[..., 0]
+        out = (path == label.astype(path.dtype)).astype(jnp.int32)
+        out = jnp.where(pos < length[:, None], out, 0)
+        return {"ViterbiPath": [out]}
+    return {"ViterbiPath": [path.astype(jnp.int32)]}
+
+
+@register("chunk_eval", no_grad_inputs=("Inference", "Label", "Length"))
+def _chunk_eval(ctx, ins, attrs):
+    """Chunk-level precision/recall/F1 for IOB/IOE/IOBES tagging
+    (chunk_eval_op.cc). Padded [B, T] int tags + Length.
+
+    Chunk identity = (start position, type). A chunk boundary is detected
+    from the tag scheme; implemented vectorized for the common IOB scheme
+    with num_chunk_types types: tag = type * tag_multiplier + {B=0, I=1}.
+    """
+    inference = ins["Inference"][0]
+    label = ins["Label"][0]
+    if inference.ndim == 3:
+        inference = inference[..., 0]
+    if label.ndim == 3:
+        label = label[..., 0]
+    b, t = inference.shape
+    if ins.get("Length"):
+        length = ins["Length"][0].reshape(-1).astype(jnp.int32)
+    else:
+        length = jnp.full((b,), t, jnp.int32)
+    scheme = attrs.get("chunk_scheme", "IOB")
+    num_types = attrs.get("num_chunk_types", 1)
+    excluded = attrs.get("excluded_chunk_types", []) or []
+    assert scheme == "IOB", "chunk_eval: IOB scheme supported"
+    ntag = 2  # B, I
+
+    def starts_types(tags, length):
+        pos = jnp.arange(t)[None, :]
+        valid = pos < length[:, None]
+        typ = tags // ntag
+        sub = tags % ntag  # 0=B, 1=I
+        prev_typ = jnp.concatenate([jnp.full((b, 1), -1, typ.dtype), typ[:, :-1]], 1)
+        prev_sub = jnp.concatenate([jnp.full((b, 1), -1, sub.dtype), sub[:, :-1]], 1)
+        outside = tags >= num_types * ntag  # O tag encoded past the range
+        prev_outside = jnp.concatenate(
+            [jnp.ones((b, 1), jnp.bool_), outside[:, :-1]], 1
+        )
+        is_start = (~outside) & (
+            (sub == 0) | prev_outside | (prev_typ != typ)
+        )
+        for e in excluded:
+            is_start = is_start & (typ != e)
+        return is_start & valid, typ, outside
+
+    inf_start, inf_typ, inf_out = starts_types(inference.astype(jnp.int32), length)
+    lab_start, lab_typ, lab_out = starts_types(label.astype(jnp.int32), length)
+
+    # chunk end mask: position where chunk continues no further
+    def ends(tags_start, outside, length):
+        pos = jnp.arange(t)[None, :]
+        valid = pos < length[:, None]
+        nxt_start = jnp.concatenate(
+            [tags_start[:, 1:], jnp.ones((b, 1), jnp.bool_)], 1
+        )
+        nxt_outside = jnp.concatenate(
+            [outside[:, 1:], jnp.ones((b, 1), jnp.bool_)], 1
+        )
+        nxt_invalid = jnp.concatenate(
+            [~valid[:, 1:], jnp.ones((b, 1), jnp.bool_)], 1
+        )
+        return (~outside) & valid & (nxt_start | nxt_outside | nxt_invalid)
+
+    inf_end = ends(inf_start, inf_out, length)
+    lab_end = ends(lab_start, lab_out, length)
+
+    num_inf = jnp.sum(inf_start)
+    num_lab = jnp.sum(lab_start)
+    # a correct chunk: same start, same end span and same type. Identify
+    # chunks by (start_pos); correct if inf and lab both start here with the
+    # same type and their ends match at the same position.
+    # compute end position per start: cumulative trick — for vectorization,
+    # use segment alignment: start positions align iff both start masks set.
+    both_start = inf_start & lab_start & (inf_typ == lab_typ)
+    # propagate "still matching" until both end: a chunk matches iff between
+    # start and end the start masks don't diverge. Simplify: chunk spans are
+    # delimited by start/end masks; ends must coincide.
+    # scan over time computing "open matched chunk" state
+    def match_scan(carry, xs):
+        open_m, count = carry
+        bs, ie, le, inext, lnext = xs
+        open_m = jnp.where(bs, True, open_m)
+        # divergence: one ends but not the other
+        diverge = (ie ^ le) | (inext ^ lnext)
+        closed_ok = open_m & ie & le
+        count = count + jnp.sum(closed_ok.astype(jnp.int32))
+        open_m = jnp.where(ie | le | diverge, False, open_m)
+        return (open_m, count), None
+
+    inf_start_t = jnp.swapaxes(inf_start, 0, 1)
+    (_, num_correct), _ = jax.lax.scan(
+        match_scan,
+        (jnp.zeros((b,), jnp.bool_), jnp.int32(0)),
+        (
+            jnp.swapaxes(both_start, 0, 1),
+            jnp.swapaxes(inf_end, 0, 1),
+            jnp.swapaxes(lab_end, 0, 1),
+            jnp.swapaxes(inf_start, 0, 1),
+            jnp.swapaxes(lab_start, 0, 1),
+        ),
+    )
+    num_inf_f = num_inf.astype(jnp.float32)
+    num_lab_f = num_lab.astype(jnp.float32)
+    num_cor_f = num_correct.astype(jnp.float32)
+    precision = jnp.where(num_inf_f > 0, num_cor_f / jnp.maximum(num_inf_f, 1), 0.0)
+    recall = jnp.where(num_lab_f > 0, num_cor_f / jnp.maximum(num_lab_f, 1), 0.0)
+    f1 = jnp.where(
+        precision + recall > 0, 2 * precision * recall / jnp.maximum(precision + recall, 1e-12), 0.0
+    )
+    return {
+        "Precision": [precision],
+        "Recall": [recall],
+        "F1-Score": [f1],
+        "NumInferChunks": [num_inf.astype(jnp.int32)],
+        "NumLabelChunks": [num_lab.astype(jnp.int32)],
+        "NumCorrectChunks": [num_correct],
+    }

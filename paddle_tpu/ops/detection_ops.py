@@ -1,0 +1,411 @@
+"""Detection op lowerings (operators/detection/): box_coder, anchor
+generators, bipartite matching, target assignment, RoI pooling, NMS.
+
+Padded design: the reference emits LoD-shaped variable-count outputs (e.g.
+NMS keeps a different number of boxes per image); on TPU every op returns
+fixed-shape padded results plus counts/masks, so the whole detection head
+stays inside one XLA program.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register
+
+
+@register("box_coder", no_grad_inputs=("PriorBox", "PriorBoxVar"))
+def _box_coder(ctx, ins, attrs):
+    """encode_center_size / decode_center_size (detection/box_coder_op.cc).
+    PriorBox [M, 4] (xmin,ymin,xmax,ymax), TargetBox encode: [N, 4],
+    decode: [N, M, 4] offsets."""
+    prior = ins["PriorBox"][0]
+    target = ins["TargetBox"][0]
+    pvar = ins["PriorBoxVar"][0] if ins.get("PriorBoxVar") else None
+    code_type = attrs.get("code_type", "encode_center_size")
+    normalized = attrs.get("box_normalized", True)
+    off = 0.0 if normalized else 1.0
+
+    pw = prior[:, 2] - prior[:, 0] + off
+    ph = prior[:, 3] - prior[:, 1] + off
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+
+    if code_type.startswith("encode"):
+        tw = target[:, 2] - target[:, 0] + off
+        th = target[:, 3] - target[:, 1] + off
+        tcx = target[:, 0] + tw * 0.5
+        tcy = target[:, 1] + th * 0.5
+        # broadcast: out[n, m]
+        ox = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        oy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        ow = jnp.log(tw[:, None] / pw[None, :])
+        oh = jnp.log(th[:, None] / ph[None, :])
+        out = jnp.stack([ox, oy, ow, oh], axis=2)
+        if pvar is not None:
+            out = out / pvar[None, :, :]
+    else:
+        t = target  # [N, M, 4]
+        if pvar is not None:
+            t = t * pvar[None, :, :]
+        dcx = t[..., 0] * pw[None, :] + pcx[None, :]
+        dcy = t[..., 1] * ph[None, :] + pcy[None, :]
+        dw = jnp.exp(t[..., 2]) * pw[None, :]
+        dh = jnp.exp(t[..., 3]) * ph[None, :]
+        out = jnp.stack(
+            [dcx - dw * 0.5, dcy - dh * 0.5, dcx + dw * 0.5 - off, dcy + dh * 0.5 - off],
+            axis=-1,
+        )
+    return {"OutputBox": [out]}
+
+
+@register("anchor_generator", no_grad_inputs=("Input",))
+def _anchor_generator(ctx, ins, attrs):
+    x = ins["Input"][0]  # feature map [N, C, H, W]
+    sizes = attrs["anchor_sizes"]
+    ratios = attrs["aspect_ratios"]
+    stride = attrs["stride"]  # [sw, sh]
+    offset = attrs.get("offset", 0.5)
+    variances = attrs.get("variances", [0.1, 0.1, 0.2, 0.2])
+    h, w = x.shape[2], x.shape[3]
+    num_anchors = len(sizes) * len(ratios)
+    anchors = []
+    for r in ratios:
+        for s in sizes:
+            aw = s * np.sqrt(r)
+            ah = s / np.sqrt(r)
+            anchors.append((aw, ah))
+    cx = (jnp.arange(w) + offset) * stride[0]
+    cy = (jnp.arange(h) + offset) * stride[1]
+    gx, gy = jnp.meshgrid(cx, cy)  # [H, W]
+    out = []
+    for aw, ah in anchors:
+        out.append(
+            jnp.stack(
+                [gx - aw / 2, gy - ah / 2, gx + aw / 2, gy + ah / 2], axis=-1
+            )
+        )
+    boxes = jnp.stack(out, axis=2)  # [H, W, A, 4]
+    var = jnp.broadcast_to(
+        jnp.asarray(variances, boxes.dtype), (h, w, num_anchors, 4)
+    )
+    return {"Anchors": [boxes], "Variances": [var]}
+
+
+@register("density_prior_box", no_grad_inputs=("Input", "Image"))
+def _density_prior_box(ctx, ins, attrs):
+    x = ins["Input"][0]
+    img = ins["Image"][0]
+    h, w = x.shape[2], x.shape[3]
+    ih, iw = img.shape[2], img.shape[3]
+    fixed_sizes = attrs.get("fixed_sizes", [])
+    fixed_ratios = attrs.get("fixed_ratios", [])
+    densities = attrs.get("densities", [])
+    offset = attrs.get("offset", 0.5)
+    variances = attrs.get("variances", [0.1, 0.1, 0.2, 0.2])
+    step_w = attrs.get("step_w", 0.0) or iw / w
+    step_h = attrs.get("step_h", 0.0) or ih / h
+    boxes_per_cell = []
+    for size, density in zip(fixed_sizes, densities):
+        for ratio in fixed_ratios:
+            bw = size * np.sqrt(ratio)
+            bh = size / np.sqrt(ratio)
+            step = size / density
+            for di in range(density):
+                for dj in range(density):
+                    sx = -size / 2.0 + step / 2.0 + dj * step
+                    sy = -size / 2.0 + step / 2.0 + di * step
+                    boxes_per_cell.append((sx, sy, bw, bh))
+    cx = (jnp.arange(w) + offset) * step_w
+    cy = (jnp.arange(h) + offset) * step_h
+    gx, gy = jnp.meshgrid(cx, cy)
+    outs = []
+    for sx, sy, bw, bh in boxes_per_cell:
+        bx = gx + sx
+        by = gy + sy
+        outs.append(
+            jnp.stack(
+                [
+                    (bx - bw / 2) / iw,
+                    (by - bh / 2) / ih,
+                    (bx + bw / 2) / iw,
+                    (by + bh / 2) / ih,
+                ],
+                axis=-1,
+            )
+        )
+    boxes = jnp.clip(jnp.stack(outs, axis=2), 0.0, 1.0)  # [H, W, A, 4]
+    a = boxes.shape[2]
+    var = jnp.broadcast_to(jnp.asarray(variances, boxes.dtype), (h, w, a, 4))
+    return {"Boxes": [boxes], "Variances": [var]}
+
+
+def _iou_matrix(a, b, off=0.0):
+    # a [N,4], b [M,4] -> [N,M]
+    area_a = jnp.maximum(a[:, 2] - a[:, 0] + off, 0) * jnp.maximum(
+        a[:, 3] - a[:, 1] + off, 0
+    )
+    area_b = jnp.maximum(b[:, 2] - b[:, 0] + off, 0) * jnp.maximum(
+        b[:, 3] - b[:, 1] + off, 0
+    )
+    ix1 = jnp.maximum(a[:, None, 0], b[None, :, 0])
+    iy1 = jnp.maximum(a[:, None, 1], b[None, :, 1])
+    ix2 = jnp.minimum(a[:, None, 2], b[None, :, 2])
+    iy2 = jnp.minimum(a[:, None, 3], b[None, :, 3])
+    iw = jnp.maximum(ix2 - ix1 + off, 0)
+    ih = jnp.maximum(iy2 - iy1 + off, 0)
+    inter = iw * ih
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / jnp.maximum(union, 1e-10), 0.0)
+
+
+@register("bipartite_match", no_grad_inputs=("DistMat",))
+def _bipartite_match(ctx, ins, attrs):
+    """Greedy bipartite matching (detection/bipartite_match_op.cc): N
+    rounds of global-argmax + row/col elimination, then (per_prediction)
+    fill unmatched cols above the overlap threshold."""
+    dist = ins["DistMat"][0]  # [N rows (gt), M cols (prior)]
+    match_type = attrs.get("match_type", "bipartite")
+    thresh = attrs.get("dist_threshold", 0.5)
+    n, m = dist.shape
+
+    def body(i, state):
+        d, row_of_col, dist_of_col = state
+        flat = jnp.argmax(d)
+        r, c = flat // m, flat % m
+        v = d[r, c]
+        ok = v > -1e9
+        row_of_col = jnp.where(
+            ok, row_of_col.at[c].set(r.astype(jnp.int32)), row_of_col
+        )
+        dist_of_col = jnp.where(ok, dist_of_col.at[c].set(v), dist_of_col)
+        d = jnp.where(ok, d.at[r, :].set(-1e10).at[:, c].set(-1e10), d)
+        return d, row_of_col, dist_of_col
+
+    row_of_col = jnp.full((m,), -1, jnp.int32)
+    dist_of_col = jnp.zeros((m,), dist.dtype)
+    _, row_of_col, dist_of_col = jax.lax.fori_loop(
+        0, min(n, m), body, (dist, row_of_col, dist_of_col)
+    )
+    if match_type == "per_prediction":
+        best_row = jnp.argmax(dist, axis=0).astype(jnp.int32)
+        best_val = jnp.max(dist, axis=0)
+        fill = (row_of_col < 0) & (best_val >= thresh)
+        row_of_col = jnp.where(fill, best_row, row_of_col)
+        dist_of_col = jnp.where(fill, best_val, dist_of_col)
+    return {
+        "ColToRowMatchIndices": [row_of_col.reshape(1, -1)],
+        "ColToRowMatchDist": [dist_of_col.reshape(1, -1)],
+    }
+
+
+@register("target_assign", no_grad_inputs=("X", "MatchIndices", "NegIndices"))
+def _target_assign(ctx, ins, attrs):
+    """Gather per-prior targets by match indices (target_assign_op.cc):
+    out[i, j] = x[match[i, j]] (per batch row i), weight 1 where matched."""
+    x = ins["X"][0]  # [P, K] entity table (gt boxes or labels), padded
+    match = ins["MatchIndices"][0].astype(jnp.int32)  # [N, M]
+    mismatch_value = attrs.get("mismatch_value", 0)
+    nbatch, m = match.shape
+    k = x.shape[-1]
+    safe = jnp.maximum(match, 0)
+    gathered = x[safe.reshape(-1)].reshape(nbatch, m, k)
+    matched = (match >= 0)[:, :, None]
+    out = jnp.where(matched, gathered, jnp.asarray(mismatch_value, x.dtype))
+    wt = matched.astype(jnp.float32)
+    return {"Out": [out], "OutWeight": [wt.astype(jnp.float32)]}
+
+
+@register("roi_pool", no_grad_inputs=("ROIs",))
+def _roi_pool(ctx, ins, attrs):
+    """RoI max pooling (detection-era roi_pool_op.cc): rois [R, 4] in image
+    coords + RoisBatch [R] image index (padded replacement for LoD)."""
+    x = ins["X"][0]  # [N, C, H, W]
+    rois = ins["ROIs"][0]  # [R, 4]
+    batch_idx = (
+        ins["RoisBatch"][0].reshape(-1).astype(jnp.int32)
+        if ins.get("RoisBatch")
+        else jnp.zeros((rois.shape[0],), jnp.int32)
+    )
+    ph = attrs["pooled_height"]
+    pw = attrs["pooled_width"]
+    spatial_scale = attrs.get("spatial_scale", 1.0)
+    n, c, h, w = x.shape
+
+    def pool_one(roi, bi):
+        x1 = jnp.round(roi[0] * spatial_scale).astype(jnp.int32)
+        y1 = jnp.round(roi[1] * spatial_scale).astype(jnp.int32)
+        x2 = jnp.round(roi[2] * spatial_scale).astype(jnp.int32)
+        y2 = jnp.round(roi[3] * spatial_scale).astype(jnp.int32)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+        img = x[bi]  # [C, H, W]
+        ys = jnp.arange(h)
+        xs = jnp.arange(w)
+
+        def cell(i, j):
+            ys0 = y1 + (i * rh) // ph
+            ys1 = y1 + ((i + 1) * rh + ph - 1) // ph
+            xs0 = x1 + (j * rw) // pw
+            xs1 = x1 + ((j + 1) * rw + pw - 1) // pw
+            mask = (
+                (ys[None, :, None] >= ys0)
+                & (ys[None, :, None] < jnp.maximum(ys1, ys0 + 1))
+                & (xs[None, None, :] >= xs0)
+                & (xs[None, None, :] < jnp.maximum(xs1, xs0 + 1))
+            )
+            return jnp.max(jnp.where(mask, img, -jnp.inf), axis=(1, 2))
+
+        cells = jnp.stack(
+            [jnp.stack([cell(i, j) for j in range(pw)], -1) for i in range(ph)], -2
+        )  # [C, ph, pw]
+        return jnp.where(jnp.isfinite(cells), cells, 0.0)
+
+    out = jax.vmap(pool_one)(rois, batch_idx)  # [R, C, ph, pw]
+    return {"Out": [out], "Argmax": [jnp.zeros(out.shape, jnp.int32)]}
+
+
+@register("roi_align", no_grad_inputs=("ROIs",))
+def _roi_align(ctx, ins, attrs):
+    x = ins["X"][0]
+    rois = ins["ROIs"][0]
+    batch_idx = (
+        ins["RoisBatch"][0].reshape(-1).astype(jnp.int32)
+        if ins.get("RoisBatch")
+        else jnp.zeros((rois.shape[0],), jnp.int32)
+    )
+    ph = attrs["pooled_height"]
+    pw = attrs["pooled_width"]
+    spatial_scale = attrs.get("spatial_scale", 1.0)
+    sampling = attrs.get("sampling_ratio", -1)
+    s = 2 if sampling <= 0 else sampling
+    n, c, h, w = x.shape
+
+    def bilinear(img, y, x_):
+        y0 = jnp.floor(y)
+        x0 = jnp.floor(x_)
+        wy = y - y0
+        wx = x_ - x0
+
+        def g(yy, xx):
+            yc = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+            xc = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+            return img[:, yc, xc]
+
+        return (
+            g(y0, x0) * (1 - wy) * (1 - wx)
+            + g(y0, x0 + 1) * (1 - wy) * wx
+            + g(y0 + 1, x0) * wy * (1 - wx)
+            + g(y0 + 1, x0 + 1) * wy * wx
+        )
+
+    def pool_one(roi, bi):
+        x1, y1, x2, y2 = (
+            roi[0] * spatial_scale,
+            roi[1] * spatial_scale,
+            roi[2] * spatial_scale,
+            roi[3] * spatial_scale,
+        )
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        img = x[bi]
+        vals = []
+        for i in range(ph):
+            row = []
+            for j in range(pw):
+                acc = 0.0
+                for si in range(s):
+                    for sj in range(s):
+                        yy = y1 + bin_h * (i + (si + 0.5) / s)
+                        xx = x1 + bin_w * (j + (sj + 0.5) / s)
+                        acc = acc + bilinear(img, yy, xx)
+                row.append(acc / (s * s))
+            vals.append(jnp.stack(row, -1))
+        return jnp.stack(vals, -2)  # [C, ph, pw]
+
+    out = jax.vmap(pool_one)(rois, batch_idx)
+    return {"Out": [out]}
+
+
+@register("multiclass_nms", no_grad_inputs=("BBoxes", "Scores"))
+def _multiclass_nms(ctx, ins, attrs):
+    """Per-class NMS + cross-class top-k (detection/multiclass_nms_op.cc).
+    Padded contract: BBoxes [N, M, 4], Scores [N, C, M]; output
+    Out [N, keep_top_k, 6] rows (label, score, x1, y1, x2, y2), padded with
+    label=-1, plus NmsRoisNum [N]."""
+    bboxes = ins["BBoxes"][0]
+    scores = ins["Scores"][0]
+    score_thresh = attrs.get("score_threshold", 0.01)
+    nms_thresh = attrs.get("nms_threshold", 0.3)
+    nms_top_k = attrs.get("nms_top_k", 400)
+    keep_top_k = attrs.get("keep_top_k", 200)
+    bg_label = attrs.get("background_label", 0)
+    nb, nc, m = scores.shape
+    nms_top_k = min(nms_top_k if nms_top_k > 0 else m, m)
+
+    def nms_class(box, sc):
+        # box [M, 4], sc [M] -> suppressed score vector [nms_top_k] + index
+        top_sc, top_idx = jax.lax.top_k(sc, nms_top_k)
+        top_box = box[top_idx]
+        iou = _iou_matrix(top_box, top_box)
+
+        def body(i, keep):
+            # suppress j>i overlapping too much with any kept i
+            cur_keep = keep[i] & (top_sc[i] > score_thresh)
+            over = (iou[i] > nms_thresh) & (jnp.arange(nms_top_k) > i)
+            keep = jnp.where(cur_keep, keep & ~over, keep)
+            return keep
+
+        keep = jnp.ones((nms_top_k,), jnp.bool_)
+        keep = jax.lax.fori_loop(0, nms_top_k, body, keep)
+        keep = keep & (top_sc > score_thresh)
+        return jnp.where(keep, top_sc, -1.0), top_idx
+
+    # single-class heads have no background column to skip
+    fg_classes = [c for c in range(nc) if c != bg_label] or list(range(nc))
+
+    def per_image(box, sc):
+        all_sc = []
+        all_idx = []
+        all_lab = []
+        for c in fg_classes:
+            s_c, i_c = nms_class(box, sc[c])
+            all_sc.append(s_c)
+            all_idx.append(i_c)
+            all_lab.append(jnp.full((nms_top_k,), c, jnp.int32))
+        cat_sc = jnp.concatenate(all_sc)
+        cat_idx = jnp.concatenate(all_idx)
+        cat_lab = jnp.concatenate(all_lab)
+        k = min(keep_top_k if keep_top_k > 0 else cat_sc.shape[0], cat_sc.shape[0])
+        fin_sc, fin_pos = jax.lax.top_k(cat_sc, k)
+        fin_idx = cat_idx[fin_pos]
+        fin_lab = jnp.where(fin_sc > 0, cat_lab[fin_pos], -1)
+        fin_box = box[fin_idx]
+        out = jnp.concatenate(
+            [fin_lab[:, None].astype(box.dtype), fin_sc[:, None], fin_box], axis=1
+        )
+        return out, jnp.sum((fin_sc > 0).astype(jnp.int32))
+
+    outs, counts = jax.vmap(per_image)(bboxes, scores)
+    return {"Out": [outs], "NmsRoisNum": [counts]}
+
+
+@register("polygon_box_transform", no_grad_inputs=("Input",))
+def _polygon_box_transform(ctx, ins, attrs):
+    x = ins["Input"][0]  # [N, G*2, H, W] offsets
+    n, g2, h, w = x.shape
+    gx = jnp.arange(w, dtype=x.dtype).reshape(1, 1, 1, w)
+    gy = jnp.arange(h, dtype=x.dtype).reshape(1, 1, h, 1)
+    idx = jnp.arange(g2) % 2
+    grid = jnp.where(idx.reshape(1, -1, 1, 1) == 0, gx * 4, gy * 4)
+    return {"Output": [jnp.where(x != 0, grid - x, x)]}
+
+
+@register("generate_proposal_labels_placeholder", no_grad_inputs=None)
+def _gpl(ctx, ins, attrs):
+    raise NotImplementedError(
+        "generate_proposal_labels: use the python-side sampler in "
+        "layers/detection.py (host pre-processing, not a TPU kernel)"
+    )

@@ -1,0 +1,363 @@
+"""Image / spatial op lowerings — the reference's misc vision op surface
+(operators/affine_channel_op.cc, affine_grid_op.cc, crop_op.cc,
+pad_constant_like_op.cc, multiplex_op.cc, space_to_depth_op.cc,
+pool_with_index (pool_with_index_op.cc), unpool_op.cc, spp_op.cc,
+pool3d (pool_op.cc), random_crop_op.cc, row_conv_op.cc, conv_shift_op.cc,
+mean_iou_op.cc, is_empty_op.cc, shuffle_channel, anchor-free misc).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register
+
+
+@register("affine_channel")
+def _affine_channel(ctx, ins, attrs):
+    x, scale, bias = ins["X"][0], ins["Scale"][0], ins["Bias"][0]
+    layout = attrs.get("data_layout", "NCHW")
+    shp = [1, -1, 1, 1] if layout == "NCHW" else [1, 1, 1, -1]
+    return {"Out": [x * scale.reshape(shp) + bias.reshape(shp)]}
+
+
+@register("affine_grid")
+def _affine_grid(ctx, ins, attrs):
+    # theta [N, 2, 3] -> sampling grid [N, H, W, 2] in [-1, 1] coords
+    theta = ins["Theta"][0]
+    if ins.get("OutputShape"):
+        raise NotImplementedError("dynamic output_shape not supported; pass attr")
+    n, c, h, w = attrs["output_shape"]
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xs = jnp.linspace(-1.0, 1.0, w)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [H, W, 3]
+    grid = jnp.einsum("hwk,njk->nhwj", base, theta)  # [N, H, W, 2]
+    return {"Output": [grid.astype(theta.dtype)]}
+
+
+@register("grid_sampler")
+def _grid_sampler(ctx, ins, attrs):
+    # bilinear sample x[N,C,H,W] at grid[N,Hg,Wg,2] (normalized [-1,1])
+    x, grid = ins["X"][0], ins["Grid"][0]
+    n, c, h, w = x.shape
+    gx = (grid[..., 0] + 1.0) * (w - 1) / 2.0  # [N, Hg, Wg]
+    gy = (grid[..., 1] + 1.0) * (h - 1) / 2.0
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    wx = gx - x0
+    wy = gy - y0
+
+    def gather(yy, xx):
+        valid = (yy >= 0) & (yy <= h - 1) & (xx >= 0) & (xx <= w - 1)
+        yc = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+        xc = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+        # batch gather: x[n, :, yc[n], xc[n]]
+        out = jax.vmap(lambda img, yi, xi: img[:, yi, xi])(x, yc, xc)  # [N,C,Hg,Wg]
+        return out * valid[:, None].astype(x.dtype)
+
+    v00 = gather(y0, x0)
+    v01 = gather(y0, x0 + 1)
+    v10 = gather(y0 + 1, x0)
+    v11 = gather(y0 + 1, x0 + 1)
+    wx_ = wx[:, None]
+    wy_ = wy[:, None]
+    out = (
+        v00 * (1 - wx_) * (1 - wy_)
+        + v01 * wx_ * (1 - wy_)
+        + v10 * (1 - wx_) * wy_
+        + v11 * wx_ * wy_
+    )
+    return {"Output": [out]}
+
+
+@register("crop")
+def _crop(ctx, ins, attrs):
+    x = ins["X"][0]
+    offsets = attrs.get("offsets")
+    shape = attrs.get("shape")
+    if ins.get("Y") is not None and ins.get("Y"):
+        shape = ins["Y"][0].shape
+    if ins.get("Offsets"):
+        raise NotImplementedError("tensor offsets unsupported (use attr)")
+    return {
+        "Out": [
+            jax.lax.dynamic_slice(x, [int(o) for o in offsets], [int(s) for s in shape])
+        ]
+    }
+
+
+@register("pad_constant_like")
+def _pad_constant_like(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    val = attrs.get("pad_value", 0.0)
+    pads = [(0, xs - ys) for xs, ys in zip(x.shape, y.shape)]
+    return {"Out": [jnp.pad(y, pads, constant_values=val)]}
+
+
+@register("multiplex", no_grad_inputs=("Ids",))
+def _multiplex(ctx, ins, attrs):
+    ids = ins["Ids"][0].reshape(-1).astype(jnp.int32)
+    stacked = jnp.stack(ins["X"], axis=0)  # [K, B, ...]
+    rows = jnp.arange(stacked.shape[1])
+    return {"Out": [stacked[ids, rows]]}
+
+
+@register("space_to_depth")
+def _space_to_depth(ctx, ins, attrs):
+    x = ins["X"][0]
+    bs = attrs.get("blocksize", 2)
+    n, c, h, w = x.shape
+    x = x.reshape(n, c, h // bs, bs, w // bs, bs)
+    x = jnp.transpose(x, (0, 3, 5, 1, 2, 4))
+    return {"Out": [x.reshape(n, c * bs * bs, h // bs, w // bs)]}
+
+
+@register("shuffle_channel")
+def _shuffle_channel(ctx, ins, attrs):
+    x = ins["X"][0]
+    g = attrs.get("group", 1)
+    n, c, h, w = x.shape
+    return {
+        "Out": [
+            jnp.transpose(x.reshape(n, g, c // g, h, w), (0, 2, 1, 3, 4)).reshape(
+                x.shape
+            )
+        ]
+    }
+
+
+@register("max_pool2d_with_index")
+def _max_pool2d_with_index(ctx, ins, attrs):
+    """Max pool that also returns the flat h*w index of each max — the
+    pool_with_index_op.cc contract consumed by unpool."""
+    x = ins["X"][0]
+    k = attrs.get("ksize", [2, 2])
+    s = attrs.get("strides", k)
+    p = attrs.get("paddings", [0, 0])
+    n, c, h, w = x.shape
+    if attrs.get("global_pooling", False):
+        k = [h, w]
+        p = [0, 0]
+    # index grid of flat positions
+    idx = jnp.arange(h * w, dtype=jnp.float32).reshape(1, 1, h, w)
+    idx = jnp.broadcast_to(idx, x.shape)
+    window = (1, 1, k[0], k[1])
+    strides = (1, 1, s[0], s[1])
+    pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
+    # argmax via reduce_window over (value, index) pairs
+    def sel(a, b):
+        av, ai = a
+        bv, bi = b
+        take_a = av >= bv
+        return jnp.where(take_a, av, bv), jnp.where(take_a, ai, bi)
+
+    out, oidx = jax.lax.reduce_window(
+        (x, idx),
+        (-jnp.inf, jnp.float32(-1)),
+        sel,
+        window,
+        strides,
+        pads,
+    )
+    return {"Out": [out], "Mask": [oidx.astype(jnp.int32)]}
+
+
+@register("unpool", no_grad_inputs=("Indices",))
+def _unpool(ctx, ins, attrs):
+    # scatter pooled values back to the argmax positions (unpool_op.cc)
+    x, indices = ins["X"][0], ins["Indices"][0]
+    n, c, h, w = x.shape
+    oh, ow = attrs.get("unpooled_size", [h * 2, w * 2])
+    flat = jnp.zeros((n, c, oh * ow), x.dtype)
+    idx = indices.reshape(n, c, h * w).astype(jnp.int32)
+    vals = x.reshape(n, c, h * w)
+    out = jax.vmap(jax.vmap(lambda f, i, v: f.at[i].set(v)))(flat, idx, vals)
+    return {"Out": [out.reshape(n, c, oh, ow)]}
+
+
+@register("spp")
+def _spp(ctx, ins, attrs):
+    """Spatial pyramid pooling (spp_op.cc): concat of pyramid_height
+    adaptive pools, flattened."""
+    x = ins["X"][0]
+    levels = attrs.get("pyramid_height", 3)
+    ptype = attrs.get("pooling_type", "max")
+    n, c, h, w = x.shape
+    outs = []
+    for lv in range(levels):
+        bins = 2**lv
+        kh, kw = int(np.ceil(h / bins)), int(np.ceil(w / bins))
+        ph = kh * bins - h
+        pw = kw * bins - w
+        xp = jnp.pad(
+            x,
+            ((0, 0), (0, 0), (0, ph), (0, pw)),
+            constant_values=-np.inf if ptype == "max" else 0.0,
+        )
+        xr = xp.reshape(n, c, bins, kh, bins, kw)
+        if ptype == "max":
+            pooled = jnp.max(xr, axis=(3, 5))
+        else:
+            pooled = jnp.sum(xr, axis=(3, 5)) / (kh * kw)
+        outs.append(pooled.reshape(n, -1))
+    return {"Out": [jnp.concatenate(outs, axis=1)]}
+
+
+@register("pool3d")
+def _pool3d(ctx, ins, attrs):
+    x = ins["X"][0]
+    ptype = attrs.get("pooling_type", "max")
+    k = attrs.get("ksize", [2, 2, 2])
+    s = attrs.get("strides", k)
+    p = attrs.get("paddings", [0, 0, 0])
+    if attrs.get("global_pooling", False):
+        axis = (2, 3, 4)
+        out = jnp.max(x, axis=axis, keepdims=True) if ptype == "max" else jnp.mean(
+            x, axis=axis, keepdims=True
+        )
+        return {"Out": [out]}
+    window = (1, 1, k[0], k[1], k[2])
+    strides = (1, 1, s[0], s[1], s[2])
+    pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]), (p[2], p[2]))
+    if ptype == "max":
+        out = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, window, strides, pads)
+    else:
+        out = (
+            jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides, pads)
+            / (k[0] * k[1] * k[2])
+        )
+    return {"Out": [out]}
+
+
+@register("random_crop", needs_rng=True, no_grad_inputs=("Seed",))
+def _random_crop(ctx, ins, attrs):
+    x = ins["X"][0]
+    shape = attrs["shape"]  # crop shape for trailing dims
+    lead = x.ndim - len(shape)
+    key = ctx.rng(attrs)
+    starts = []
+    for i, s in enumerate(shape):
+        key, sub = jax.random.split(key)
+        hi = x.shape[lead + i] - s + 1
+        starts.append(jax.random.randint(sub, (), 0, hi))
+    begin = [0] * lead + starts
+    sizes = list(x.shape[:lead]) + list(shape)
+    out = jax.lax.dynamic_slice(x, begin, sizes)
+    return {"Out": [out], "SeedOut": [jnp.zeros((1,), jnp.int32)]}
+
+
+@register("row_conv")
+def _row_conv(ctx, ins, attrs):
+    """Lookahead row convolution (row_conv_op.cc), padded layout
+    [B, T, D] with filter [future_context+1, D]:
+    out[b,t,d] = sum_{j} x[b,t+j,d] * w[j,d]."""
+    x, w = ins["X"][0], ins["Filter"][0]
+    k = w.shape[0]
+    b, t, d = x.shape
+    xp = jnp.pad(x, ((0, 0), (0, k - 1), (0, 0)))
+    out = jnp.zeros_like(x)
+    for j in range(k):
+        out = out + xp[:, j : j + t] * w[j][None, None, :]
+    return {"Out": [out]}
+
+
+@register("conv_shift")
+def _conv_shift(ctx, ins, attrs):
+    """Circular convolution (conv_shift_op.cc): x [B, N], y [B, M] (M odd),
+    out[b, i] = sum_j x[b, (i + j - M//2) mod N] * y[b, j]."""
+    x, y = ins["X"][0], ins["Y"][0]
+    n = x.shape[1]
+    m = y.shape[1]
+    half = m // 2
+    outs = []
+    for j in range(m):
+        outs.append(jnp.roll(x, half - j, axis=1) * y[:, j : j + 1])
+    return {"Out": [sum(outs)]}
+
+
+@register("mean_iou", no_grad_inputs=("Predictions", "Labels", "InWrongs", "InCorrects", "InMeanIou"))
+def _mean_iou(ctx, ins, attrs):
+    """Streaming mean IoU (mean_iou_op.h): per-class correct = intersection,
+    wrong = pred-area + label-area - 2*intersection (both sides of each
+    mismatch), accumulated with the In* carries; IoU per class =
+    correct / (wrong + correct)."""
+    pred = ins["Predictions"][0].reshape(-1).astype(jnp.int32)
+    label = ins["Labels"][0].reshape(-1).astype(jnp.int32)
+    nc = attrs["num_classes"]
+    inter = jnp.zeros((nc,), jnp.float32).at[
+        jnp.where(pred == label, pred, nc - 1)
+    ].add(jnp.where(pred == label, 1.0, 0.0))
+    area_p = jnp.zeros((nc,), jnp.float32).at[pred].add(1.0)
+    area_l = jnp.zeros((nc,), jnp.float32).at[label].add(1.0)
+    correct = inter
+    wrong = area_p + area_l - 2.0 * inter
+    for w in ins.get("InWrongs") or []:
+        wrong = wrong + w.astype(jnp.float32)
+    for c in ins.get("InCorrects") or []:
+        correct = correct + c.astype(jnp.float32)
+    union = wrong + correct
+    valid = union > 0
+    iou = jnp.where(valid, correct / jnp.maximum(union, 1.0), 0.0)
+    miou = jnp.sum(iou) / jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+    for m in ins.get("InMeanIou") or []:
+        miou = miou + m.reshape(())
+    return {
+        "OutMeanIou": [miou],
+        "OutWrong": [wrong.astype(jnp.int32)],
+        "OutCorrect": [correct.astype(jnp.int32)],
+    }
+
+
+@register("is_empty", no_grad_inputs=("X",))
+def _is_empty(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [jnp.asarray(x.size == 0)]}
+
+
+@register("selu")
+def _selu(ctx, ins, attrs):
+    x = ins["X"][0]
+    scale = attrs.get("scale", 1.0507009873554804934193349852946)
+    alpha = attrs.get("alpha", 1.6732632423543772848170429916717)
+    return {"Out": [scale * jnp.where(x > 0, x, alpha * (jnp.exp(x) - 1.0))]}
+
+
+@register("similarity_focus", no_grad_inputs=("X",))
+def _similarity_focus(ctx, ins, attrs):
+    # for each selected channel (axis=1 index), mark the max positions per
+    # row/col of the HxW map (similarity_focus_op.cc, simplified contract:
+    # output mask has 1 where the channel's value is a row-or-col max)
+    x = ins["X"][0]
+    axis = attrs.get("axis", 1)
+    idx = attrs.get("indexes", [0])
+    assert axis == 1, "similarity_focus supports channel axis only"
+    masks = jnp.zeros_like(x)
+    for ci in idx:
+        ch = x[:, ci]  # [N, H, W]
+        row_max = ch == jnp.max(ch, axis=2, keepdims=True)
+        col_max = ch == jnp.max(ch, axis=1, keepdims=True)
+        m = (row_max | col_max).astype(x.dtype)
+        masks = masks + m[:, None] * jax.nn.one_hot(
+            ci, x.shape[1], dtype=x.dtype
+        ).reshape(1, -1, 1, 1)
+    return {"Out": [jnp.clip(masks, 0.0, 1.0)]}
+
+
+@register("add_position_encoding")
+def _add_position_encoding(ctx, ins, attrs):
+    """Sinusoidal position encoding add (add_position_encoding_op.cc):
+    x [B, T, D]; out = alpha*x + beta*pos_enc."""
+    x = ins["X"][0]
+    alpha = attrs.get("alpha", 1.0)
+    beta = attrs.get("beta", 1.0)
+    b, t, d = x.shape
+    pos = jnp.arange(t, dtype=jnp.float32)[:, None]
+    half = d // 2
+    div = jnp.power(10000.0, jnp.arange(half, dtype=jnp.float32) / half)
+    ang = pos / div[None, :]
+    parts = [jnp.sin(ang), jnp.cos(ang)]
+    if d % 2:  # odd width: last column carries no encoding
+        parts.append(jnp.zeros((t, 1), jnp.float32))
+    enc = jnp.concatenate(parts, axis=1)  # [T, D]
+    return {"Out": [alpha * x + beta * enc[None].astype(x.dtype)]}

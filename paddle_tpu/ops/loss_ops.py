@@ -1,0 +1,211 @@
+"""Loss op lowerings — the long-tail loss surface of the reference
+(operators/hinge_loss_op.cc, log_loss_op.cc, modified_huber_loss_op.cc,
+rank_loss_op.cc, margin_rank_loss_op.cc, squared_l2_distance_op.cc,
+cos_sim_op.cc, bilinear_tensor_product_op.cc, nce_op.cc,
+hierarchical_sigmoid_op.cc, bpr_loss_op.cc).
+
+All are pure elementwise/matmul compositions that XLA fuses; gradients come
+from vjp of the lowering (no hand-written grad kernels needed).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register
+
+
+@register("hinge_loss")
+def _hinge_loss(ctx, ins, attrs):
+    # loss = max(0, 1 - (2*label - 1) * logits)   (hinge_loss_op.cc)
+    logits, labels = ins["Logits"][0], ins["Labels"][0]
+    y = 2.0 * labels - 1.0
+    return {"Loss": [jnp.maximum(0.0, 1.0 - y * logits)]}
+
+
+@register("log_loss")
+def _log_loss(ctx, ins, attrs):
+    # loss = -label*log(pred+eps) - (1-label)*log(1-pred+eps)
+    pred, label = ins["Predicted"][0], ins["Labels"][0]
+    eps = attrs.get("epsilon", 1e-4)
+    loss = -label * jnp.log(pred + eps) - (1.0 - label) * jnp.log(1.0 - pred + eps)
+    return {"Loss": [loss]}
+
+
+@register("modified_huber_loss")
+def _modified_huber_loss(ctx, ins, attrs):
+    # y' = 2y-1; z = y'*f;  z >= -1: max(0, 1-z)^2  else: -4z
+    x, y = ins["X"][0], ins["Y"][0]
+    yp = 2.0 * y - 1.0
+    z = yp * x
+    loss = jnp.where(z >= -1.0, jnp.square(jnp.maximum(0.0, 1.0 - z)), -4.0 * z)
+    return {"IntermediateVal": [z], "Out": [loss]}
+
+
+@register("rank_loss")
+def _rank_loss(ctx, ins, attrs):
+    # C = log(1 + exp(o_left - o_right)) - label * (o_left - o_right)
+    label, left, right = ins["Label"][0], ins["Left"][0], ins["Right"][0]
+    d = left - right
+    return {"Out": [jnp.logaddexp(0.0, d) - label * d]}
+
+
+@register("margin_rank_loss")
+def _margin_rank_loss(ctx, ins, attrs):
+    # out = max(0, -label*(x1 - x2) + margin)
+    label, x1, x2 = ins["Label"][0], ins["X1"][0], ins["X2"][0]
+    margin = attrs.get("margin", 0.0)
+    act = -label * (x1 - x2) + margin
+    out = jnp.maximum(0.0, act)
+    return {"Out": [out], "Activated": [(act > 0).astype(x1.dtype)]}
+
+
+@register("squared_l2_distance")
+def _squared_l2_distance(ctx, ins, attrs):
+    # sub = x - y (y may have batch 1); out[i] = sum_j sub[i,j]^2
+    x, y = ins["X"][0], ins["Y"][0]
+    sub = x - y
+    out = jnp.sum(jnp.square(sub), axis=tuple(range(1, sub.ndim))).reshape(-1, 1)
+    return {"sub_result": [sub], "Out": [out]}
+
+
+@register("cos_sim")
+def _cos_sim(ctx, ins, attrs):
+    # per-row cosine similarity; Y may have batch 1 (broadcast)
+    x, y = ins["X"][0], ins["Y"][0]
+    xn = jnp.sqrt(jnp.sum(jnp.square(x), axis=1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(jnp.square(y), axis=1, keepdims=True))
+    dot = jnp.sum(x * y, axis=1, keepdims=True)
+    return {"Out": [dot / (xn * yn)], "XNorm": [xn], "YNorm": [yn]}
+
+
+@register("bilinear_tensor_product")
+def _bilinear_tensor_product(ctx, ins, attrs):
+    # out[b, k] = x[b] @ W[k] @ y[b] + bias[k]
+    x, w, y = ins["X"][0], ins["Weight"][0], ins["Y"][0]
+    out = jnp.einsum("bi,kij,bj->bk", x, w, y)
+    if ins.get("Bias"):
+        out = out + ins["Bias"][0].reshape(1, -1)
+    return {"Out": [out]}
+
+
+@register("bpr_loss")
+def _bpr_loss(ctx, ins, attrs):
+    # Bayesian personalized ranking: for each row, label picks the positive
+    # logit; loss = mean over negatives of -log(sigmoid(pos - neg))
+    x, label = ins["X"][0], ins["Label"][0]
+    n, d = x.shape
+    lab = label.reshape(-1).astype(jnp.int32)
+    pos = jnp.take_along_axis(x, lab[:, None], axis=1)
+    diff = pos - x  # [n, d]; includes pos-pos = 0 term, excluded below
+    logloss = -jax.nn.log_sigmoid(diff)
+    mask = 1.0 - jax.nn.one_hot(lab, d, dtype=x.dtype)
+    loss = jnp.sum(logloss * mask, axis=1, keepdims=True) / (d - 1)
+    return {"Y": [loss]}
+
+
+@register("kldiv_loss")
+def _kldiv_loss(ctx, ins, attrs):
+    x, target = ins["X"][0], ins["Target"][0]
+    reduction = attrs.get("reduction", "mean")
+    loss = target * (jnp.where(target > 0, jnp.log(jnp.maximum(target, 1e-30)), 0.0) - x)
+    if reduction == "mean":
+        return {"Loss": [jnp.mean(loss)]}
+    if reduction == "sum":
+        return {"Loss": [jnp.sum(loss)]}
+    if reduction == "batchmean":
+        return {"Loss": [jnp.sum(loss) / x.shape[0]]}
+    return {"Loss": [loss]}
+
+
+# ---------------------------------------------------------------------------
+# sampled-softmax family (nce_op.cc, hierarchical_sigmoid_op.cc)
+# ---------------------------------------------------------------------------
+@register("nce", no_grad_inputs=("Label", "SampleWeight", "CustomDistProbs"), needs_rng=True)
+def _nce(ctx, ins, attrs):
+    """Noise-contrastive estimation (nce_op.cc): binary-logistic loss on the
+    true class vs `num_neg_samples` sampled noise classes.
+
+    TPU design: negatives are sampled once per batch (shared negatives, the
+    standard accelerator-friendly variant) with a uniform sampler, and all
+    logits come from one [B, 1+S] gather+matmul — no per-sample loops.
+    """
+    x = ins["Input"][0]  # [B, D]
+    w = ins["Weight"][0]  # [num_classes, D]
+    label = ins["Label"][0].reshape(x.shape[0], -1)  # [B, num_true]
+    num_classes = attrs["num_total_classes"]
+    s = attrs.get("num_neg_samples", 10)
+    num_true = label.shape[1]
+
+    neg = jax.random.randint(ctx.rng(attrs), (s,), 0, num_classes)  # shared
+    lab = label[:, 0].astype(jnp.int32)
+    # logits for true + sampled classes
+    w_true = w[lab]  # [B, D]
+    w_neg = w[neg]  # [S, D]
+    logit_true = jnp.sum(x * w_true, axis=1)  # [B]
+    logit_neg = x @ w_neg.T  # [B, S]
+    if ins.get("Bias"):
+        b = ins["Bias"][0].reshape(-1)
+        logit_true = logit_true + b[lab]
+        logit_neg = logit_neg + b[neg][None, :]
+    # P_noise uniform = 1/num_classes; nce logit corrections
+    log_noise = jnp.log(jnp.asarray(s / float(num_classes), x.dtype))
+    cost_true = -jax.nn.log_sigmoid(logit_true - log_noise)
+    cost_neg = -jax.nn.log_sigmoid(-(logit_neg - log_noise))
+    cost = cost_true + jnp.sum(cost_neg, axis=1)
+    sample_logits = jnp.concatenate([logit_true[:, None], logit_neg], axis=1)
+    sample_labels = jnp.concatenate(
+        [lab[:, None], jnp.broadcast_to(neg[None, :], (x.shape[0], s))], axis=1
+    )
+    return {
+        "Cost": [cost.reshape(-1, 1)],
+        "SampleLogits": [sample_logits],
+        "SampleLabels": [jax.lax.stop_gradient(sample_labels)],
+    }
+
+
+def _hsig_codes(num_classes, max_code_len):
+    """Path codes/bits of a complete binary tree over `num_classes` leaves
+    (the default coding of hierarchical_sigmoid_op.cc / matrix_bit_code.h):
+    leaf i has code (i + num_classes) whose binary digits (below the MSB)
+    give the left/right decisions; internal node index at each level is
+    (code >> (len-1-d)) - 1 clipped to num_classes-1 rows of W."""
+    codes = np.arange(num_classes) + num_classes
+    lens = np.floor(np.log2(codes)).astype(np.int64)  # code length per leaf
+    node_ids = np.zeros((num_classes, max_code_len), dtype=np.int64)
+    bits = np.zeros((num_classes, max_code_len), dtype=np.float32)
+    mask = np.zeros((num_classes, max_code_len), dtype=np.float32)
+    for i in range(num_classes):
+        c, l = int(codes[i]), int(lens[i])
+        for d in range(l):
+            node_ids[i, d] = (c >> (l - d)) - 1
+            bits[i, d] = float((c >> (l - 1 - d)) & 1)
+            mask[i, d] = 1.0
+    return node_ids, bits, mask
+
+
+@register("hierarchical_sigmoid", no_grad_inputs=("Label",))
+def _hierarchical_sigmoid(ctx, ins, attrs):
+    """Hierarchical sigmoid over a complete binary tree: O(log C) logistic
+    decisions per sample, batched as a [B, L] gather+einsum."""
+    x = ins["X"][0]  # [B, D]
+    w = ins["W"][0]  # [num_classes - 1, D]
+    label = ins["Label"][0].reshape(-1).astype(jnp.int32)
+    num_classes = attrs["num_classes"]
+    max_len = int(np.floor(np.log2(2 * num_classes - 1)))
+    node_ids, bits, mask = _hsig_codes(num_classes, max_len)
+    node_ids = jnp.asarray(node_ids)
+    bits = jnp.asarray(bits, x.dtype)
+    mask = jnp.asarray(mask, x.dtype)
+
+    ids = node_ids[label]  # [B, L]
+    bit = bits[label]
+    m = mask[label]
+    wsel = w[ids]  # [B, L, D]
+    pre = jnp.einsum("bld,bd->bl", wsel, x)
+    if ins.get("Bias"):
+        pre = pre + ins["Bias"][0].reshape(-1)[ids]
+    # label bit b: p = sigmoid(pre) if b==0 ... reference uses
+    # sum log(1 + exp(pre)) - bit*pre over the path
+    cost = jnp.sum((jnp.logaddexp(0.0, pre) - bit * pre) * m, axis=1)
+    return {"Out": [cost.reshape(-1, 1)], "PreOut": [pre]}

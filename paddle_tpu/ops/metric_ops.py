@@ -1,0 +1,136 @@
+"""In-graph metric ops (operators/metrics/auc_op.cc,
+precision_recall_op.cc; accuracy lives in math_ops).
+
+Stateful accumulators are expressed functionally: the running stat tensors
+come in as inputs and go out as outputs, threaded through the scope by the
+executor's state functionalization (the TPU analog of the reference's
+in-place Variable updates).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register
+
+
+@register("auc", no_grad_inputs=("Predict", "Label", "StatPos", "StatNeg"))
+def _auc(ctx, ins, attrs):
+    """Streaming AUC over threshold buckets (auc_op.cc): histogram positive
+    and negative scores into num_thresholds buckets, trapezoid-integrate."""
+    predict = ins["Predict"][0]
+    label = ins["Label"][0].reshape(-1)
+    num_thresholds = attrs.get("num_thresholds", 4095)
+    pos_score = predict[:, 1] if predict.ndim == 2 and predict.shape[1] == 2 else predict.reshape(-1)
+    stat_pos = ins["StatPos"][0].reshape(-1)
+    stat_neg = ins["StatNeg"][0].reshape(-1)
+    bucket = jnp.clip(
+        (pos_score * num_thresholds).astype(jnp.int32), 0, num_thresholds
+    )
+    is_pos = (label > 0).astype(stat_pos.dtype)
+    new_pos = stat_pos.at[bucket].add(is_pos)
+    new_neg = stat_neg.at[bucket].add(1.0 - is_pos)
+    # AUC = sum over buckets (descending threshold) of trapezoid areas
+    pos_flip = jnp.flip(new_pos)
+    neg_flip = jnp.flip(new_neg)
+    tp = jnp.cumsum(pos_flip)
+    fp = jnp.cumsum(neg_flip)
+    tot_pos = tp[-1]
+    tot_neg = fp[-1]
+    tp_prev = jnp.concatenate([jnp.zeros(1, tp.dtype), tp[:-1]])
+    fp_prev = jnp.concatenate([jnp.zeros(1, fp.dtype), fp[:-1]])
+    area = jnp.sum((fp - fp_prev) * (tp + tp_prev) / 2.0)
+    auc = jnp.where(tot_pos * tot_neg > 0, area / jnp.maximum(tot_pos * tot_neg, 1.0), 0.0)
+    return {
+        "AUC": [auc],
+        "StatPosOut": [new_pos],
+        "StatNegOut": [new_neg],
+    }
+
+
+@register(
+    "precision_recall",
+    no_grad_inputs=("MaxProbs", "Indices", "Labels", "Weights", "StatesInfo"),
+)
+def _precision_recall(ctx, ins, attrs):
+    """Multi-class precision/recall (precision_recall_op.cc): per-class
+    TP/FP/TN/FN accumulation + macro/micro averaged metrics."""
+    indices = ins["Indices"][0].reshape(-1).astype(jnp.int32)  # predicted class
+    labels = ins["Labels"][0].reshape(-1).astype(jnp.int32)
+    cls = attrs["class_number"]
+    states = ins["StatesInfo"][0] if ins.get("StatesInfo") else jnp.zeros((cls, 4))
+    correct = indices == labels
+    tp = jnp.zeros((cls,), jnp.float32).at[labels].add(correct.astype(jnp.float32))
+    fp = jnp.zeros((cls,), jnp.float32).at[indices].add((~correct).astype(jnp.float32))
+    fn = jnp.zeros((cls,), jnp.float32).at[labels].add((~correct).astype(jnp.float32))
+    n = indices.shape[0]
+    tn = jnp.full((cls,), float(n)) - tp - fp - fn
+    batch_states = jnp.stack([tp, fp, tn, fn], axis=1)
+    acc_states = states + batch_states
+
+    def metrics(s):
+        tp_, fp_, tn_, fn_ = s[:, 0], s[:, 1], s[:, 2], s[:, 3]
+        prec = jnp.where(tp_ + fp_ > 0, tp_ / jnp.maximum(tp_ + fp_, 1.0), 0.0)
+        rec = jnp.where(tp_ + fn_ > 0, tp_ / jnp.maximum(tp_ + fn_, 1.0), 0.0)
+        f1 = jnp.where(
+            prec + rec > 0, 2 * prec * rec / jnp.maximum(prec + rec, 1e-12), 0.0
+        )
+        macro = jnp.stack([jnp.mean(prec), jnp.mean(rec), jnp.mean(f1)])
+        tps, fps, fns = jnp.sum(tp_), jnp.sum(fp_), jnp.sum(fn_)
+        mprec = jnp.where(tps + fps > 0, tps / jnp.maximum(tps + fps, 1.0), 0.0)
+        mrec = jnp.where(tps + fns > 0, tps / jnp.maximum(tps + fns, 1.0), 0.0)
+        mf1 = jnp.where(
+            mprec + mrec > 0, 2 * mprec * mrec / jnp.maximum(mprec + mrec, 1e-12), 0.0
+        )
+        return jnp.concatenate([macro, jnp.stack([mprec, mrec, mf1])])
+
+    return {
+        "BatchMetrics": [metrics(batch_states)],
+        "AccumMetrics": [metrics(acc_states)],
+        "AccumStatesInfo": [acc_states],
+    }
+
+
+@register("average_accumulates", no_grad_inputs=None)
+def _average_accumulates(ctx, ins, attrs):
+    """Parameter-averaging accumulator step (average_accumulates_op.cc),
+    the engine under ModelAverage (optimizer.py:1365): maintains
+    sum_1/sum_2/sum_3 windows of parameter values and step counters."""
+    param = ins["param"][0]
+    sum1 = ins["in_sum_1"][0]
+    sum2 = ins["in_sum_2"][0]
+    sum3 = ins["in_sum_3"][0]
+    num_updates = ins["in_num_updates"][0].reshape(()).astype(jnp.int32)
+    num_accum = ins["in_num_accumulates"][0].reshape(()).astype(jnp.int32)
+    old_num_accum = ins["in_old_num_accumulates"][0].reshape(()).astype(jnp.int32)
+    avg_window = attrs.get("average_window", 10000.0)
+    max_avg_window = attrs.get("max_average_window", 10000)
+    min_avg_window = attrs.get("min_average_window", 10000)
+
+    k_max_num_accumulates = 16384  # kMaxNumAccumulates (average_accumulates_op.h)
+
+    num_updates = num_updates + 1
+    num_accum = num_accum + 1
+    sum1 = sum1 + param
+    # overflow guard: periodically shift sum1 into sum2
+    shift = (num_updates % k_max_num_accumulates) == 0
+    sum2 = jnp.where(shift, sum2 + sum1, sum2)
+    sum1 = jnp.where(shift, jnp.zeros_like(sum1), sum1)
+    # window roll: sum3 <- sum1 + sum2, counters move to old_num_accumulates
+    window = jnp.minimum(
+        (num_updates.astype(jnp.float32) * avg_window).astype(jnp.int32),
+        max_avg_window,
+    )
+    roll = (num_accum >= min_avg_window) & (num_accum >= window)
+    sum3 = jnp.where(roll, sum1 + sum2, sum3)
+    sum1 = jnp.where(roll, jnp.zeros_like(sum1), sum1)
+    sum2 = jnp.where(roll, jnp.zeros_like(sum2), sum2)
+    old_num_accum = jnp.where(roll, num_accum, old_num_accum)
+    num_accum = jnp.where(roll, jnp.int32(0), num_accum)
+    return {
+        "out_sum_1": [sum1],
+        "out_sum_2": [sum2],
+        "out_sum_3": [sum3],
+        "out_num_accumulates": [num_accum.reshape(1)],
+        "out_old_num_accumulates": [old_num_accum.reshape(1)],
+        "out_num_updates": [num_updates.reshape(1)],
+    }

@@ -435,14 +435,26 @@ def _padded_gru(ctx, ins, attrs):
 # ---------------------------------------------------------------------------
 # misc nn
 # ---------------------------------------------------------------------------
-@register("relu_grad_fused_placeholder")
-def _unused(ctx, ins, attrs):
-    raise NotImplementedError
-
-
 @register("im2sequence")
 def _im2sequence(ctx, ins, attrs):
-    raise NotImplementedError("im2sequence pending")
+    """Extract conv-style patches into a sequence (im2sequence_op.cc):
+    x [N, C, H, W] -> [N, OH*OW, C*kh*kw] (padded layout; the reference
+    emits LoD rows N*OH*OW x C*kh*kw)."""
+    x = ins["X"][0]
+    kh, kw = attrs["kernels"]
+    sh, sw = attrs.get("strides", [1, 1])
+    pads = attrs.get("paddings", [0, 0, 0, 0])  # up, left, down, right
+    n, c, h, w = x.shape
+    patches = jax.lax.conv_general_dilated_patches(
+        x,
+        filter_shape=(kh, kw),
+        window_strides=(sh, sw),
+        padding=((pads[0], pads[2]), (pads[1], pads[3])),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )  # [N, C*kh*kw, OH, OW]
+    ckk = patches.shape[1]
+    out = jnp.transpose(patches.reshape(n, ckk, -1), (0, 2, 1))
+    return {"Out": [out]}
 
 
 @register("bilinear_interp")
@@ -459,11 +471,6 @@ def _nearest_interp(ctx, ins, attrs):
     oh, ow = attrs.get("out_h"), attrs.get("out_w")
     out = jax.image.resize(x, (x.shape[0], x.shape[1], oh, ow), method="nearest")
     return {"Out": [out]}
-
-
-@register("grid_sampler")
-def _grid_sampler(ctx, ins, attrs):
-    raise NotImplementedError("grid_sampler pending")
 
 
 @register("maxout")

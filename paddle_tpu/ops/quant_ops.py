@@ -1,0 +1,97 @@
+"""Fake-quantization ops for QAT (operators/fake_quantize_op.cc,
+fake_dequantize_op.cc) — quantize-dequantize roundtrips with a
+straight-through estimator so XLA keeps the graph differentiable.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register
+
+
+def _ste_round(x):
+    # straight-through: round in fwd, identity grad
+    return x + jax.lax.stop_gradient(jnp.round(x) - x)
+
+
+def _quant_dequant(x, scale, bits):
+    rng = float(2 ** (bits - 1) - 1)
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(_ste_round(x / s * rng), -rng, rng)
+    return q * s / rng
+
+
+@register("fake_quantize_abs_max")
+def _fake_quantize_abs_max(ctx, ins, attrs):
+    x = ins["X"][0]
+    bits = attrs.get("bit_length", 8)
+    scale = jnp.max(jnp.abs(x))
+    return {
+        "Out": [_quant_dequant(x, scale, bits)],
+        "OutScale": [jax.lax.stop_gradient(scale.reshape(1))],
+    }
+
+
+@register("fake_quantize_range_abs_max", no_grad_inputs=("InScale", "InScales", "Iter"))
+def _fake_quantize_range_abs_max(ctx, ins, attrs):
+    """Sliding-window abs-max (fake_quantize_op.cc FindRangeAbsMaxFunctor):
+    InScales is a window_size ring buffer of recent batch maxima; the scale
+    is the max over the window, so an early outlier ages out."""
+    x = ins["X"][0]
+    in_scale = ins["InScale"][0].reshape(())
+    bits = attrs.get("bit_length", 8)
+    window = attrs.get("window_size", 10000)
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    cur = jnp.max(jnp.abs(x))
+    if ins.get("InScales"):
+        buf = ins["InScales"][0].reshape(-1)
+        it = ins["Iter"][0].reshape(()).astype(jnp.int32) if ins.get("Iter") else jnp.int32(0)
+        new_buf = jnp.where(is_test, buf, buf.at[it % buf.shape[0]].set(cur))
+        scale = jnp.where(is_test, in_scale, jnp.max(new_buf))
+        return {
+            "Out": [_quant_dequant(x, scale, bits)],
+            "OutScale": [jax.lax.stop_gradient(scale.reshape(1))],
+            "OutScales": [jax.lax.stop_gradient(new_buf)],
+        }
+    scale = jnp.where(is_test, in_scale, jnp.maximum(cur, in_scale))
+    return {"Out": [_quant_dequant(x, scale, bits)],
+            "OutScale": [jax.lax.stop_gradient(scale.reshape(1))]}
+
+
+@register("fake_quantize_moving_average_abs_max", no_grad_inputs=("InScale", "InAccum", "InState"))
+def _fake_quantize_moving_average_abs_max(ctx, ins, attrs):
+    x = ins["X"][0]
+    in_scale = ins["InScale"][0].reshape(())
+    bits = attrs.get("bit_length", 8)
+    rate = attrs.get("moving_rate", 0.9)
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    cur = jnp.max(jnp.abs(x))
+    state = ins["InState"][0].reshape(()) if ins.get("InState") else jnp.asarray(1.0)
+    accum = ins["InAccum"][0].reshape(()) if ins.get("InAccum") else in_scale
+    new_state = jnp.where(is_test, state, rate * state + 1.0)
+    new_accum = jnp.where(is_test, accum, rate * accum + cur)
+    scale = jnp.where(is_test, in_scale, new_accum / new_state)
+    return {
+        "Out": [_quant_dequant(x, scale, bits)],
+        "OutScale": [jax.lax.stop_gradient(scale.reshape(1))],
+        "OutState": [jax.lax.stop_gradient(new_state.reshape(1))],
+        "OutAccum": [jax.lax.stop_gradient(new_accum.reshape(1))],
+    }
+
+
+@register("fake_channel_wise_quantize_abs_max")
+def _fake_channel_wise_quantize_abs_max(ctx, ins, attrs):
+    x = ins["X"][0]
+    bits = attrs.get("bit_length", 8)
+    axes = tuple(range(1, x.ndim))
+    scale = jnp.max(jnp.abs(x), axis=axes)
+    shp = [-1] + [1] * (x.ndim - 1)
+    out = _quant_dequant(x, scale.reshape(shp), bits)
+    return {"Out": [out], "OutScale": [jax.lax.stop_gradient(scale)]}
+
+
+@register("fake_dequantize_max_abs", no_grad_inputs=("Scale",))
+def _fake_dequantize_max_abs(ctx, ins, attrs):
+    x, scale = ins["X"][0], ins["Scale"][0]
+    max_range = attrs.get("max_range", 127.0)
+    return {"Out": [x * scale.reshape(()) / max_range]}

@@ -1,0 +1,83 @@
+"""Beam-search ops (operators/beam_search_op.cc, beam_search_decode_op.cc,
+math/beam_search.cc).
+
+The reference's beam search walks LoD levels per step inside a While loop
+and decodes by joining LoD trees.  TPU-native contract: everything is
+padded and batched — one step selects top-k over [batch, beam*vocab] with a
+single jnp.top_k (MXU/VPU friendly), and decode is a reverse scan over the
+stored parent pointers (the classic backpointer trick) instead of LoD tree
+walking.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register
+
+
+@register("beam_search", no_grad_inputs=("pre_ids", "pre_scores", "ids", "scores"))
+def _beam_search(ctx, ins, attrs):
+    """One beam step. Inputs (padded):
+      pre_ids    [batch, beam] int   — tokens chosen last step
+      pre_scores [batch, beam] float — accumulated log-probs
+      scores     [batch, beam, vocab] — next-token log-probs
+    Outputs: selected_ids [batch, beam], selected_scores [batch, beam],
+    parent_idx [batch, beam] (beam index each new hypothesis came from).
+    Finished beams (pre_ids == end_id) are frozen: they only extend with
+    end_id at unchanged score."""
+    pre_ids = ins["pre_ids"][0].astype(jnp.int32)
+    pre_scores = ins["pre_scores"][0]
+    scores = ins["scores"][0]
+    beam_size = attrs.get("beam_size", pre_ids.shape[1])
+    end_id = attrs.get("end_id", 0)
+    batch, beam, vocab = scores.shape
+
+    finished = pre_ids == end_id  # [batch, beam]
+    # frozen beams: only the end_id continuation, at score 0 (keeps total)
+    cont = pre_scores[:, :, None] + scores  # [batch, beam, vocab]
+    neg_inf = jnp.asarray(-1e9, scores.dtype)
+    frozen = jnp.full_like(cont, neg_inf)
+    frozen = frozen.at[:, :, end_id].set(pre_scores)
+    total = jnp.where(finished[:, :, None], frozen, cont)
+
+    flat = total.reshape(batch, beam * vocab)
+    top_scores, top_idx = jax.lax.top_k(flat, beam_size)
+    parent = top_idx // vocab
+    token = top_idx % vocab
+    return {
+        "selected_ids": [token.astype(jnp.int32)],
+        "selected_scores": [top_scores],
+        "parent_idx": [parent.astype(jnp.int32)],
+    }
+
+
+@register(
+    "beam_search_decode",
+    no_grad_inputs=("Ids", "Scores", "ParentIdx", "SequenceLength"),
+)
+def _beam_search_decode(ctx, ins, attrs):
+    """Backtrack stored steps into full hypotheses.
+    Inputs: Ids [T, batch, beam], ParentIdx [T, batch, beam],
+    Scores [T, batch, beam]. Outputs SentenceIds [batch, beam, T] (padded
+    with end_id) and SentenceScores [batch, beam] (final accumulated)."""
+    ids = ins["Ids"][0].astype(jnp.int32)  # [T, B, K]
+    parents = ins["ParentIdx"][0].astype(jnp.int32)
+    scores = ins["Scores"][0]
+    t, b, k = ids.shape
+    end_id = attrs.get("end_id", 0)
+
+    # start from the final beam order (identity), walk backwards
+    def back(beam_idx, inp):
+        ids_t, par_t = inp  # [B, K] each
+        tok = jnp.take_along_axis(ids_t, beam_idx, axis=1)
+        beam_prev = jnp.take_along_axis(par_t, beam_idx, axis=1)
+        return beam_prev, tok
+
+    init = jnp.broadcast_to(jnp.arange(k)[None, :], (b, k))
+    _, toks_rev = jax.lax.scan(back, init, (jnp.flip(ids, 0), jnp.flip(parents, 0)))
+    sent = jnp.flip(jnp.transpose(toks_rev, (1, 2, 0)), axis=2)  # [B, K, T]
+    final_scores = scores[-1]  # [B, K]
+    return {
+        "SentenceIds": [sent],
+        "SentenceScores": [final_scores],
+    }

@@ -161,3 +161,42 @@ class OpTest:
 
     def setup(self):
         raise NotImplementedError
+
+
+def run_single_op(op_type, inputs, attrs, out_slots):
+    """Shared single-op driver for tests that don't fit the OpTest
+    declare-expected-outputs shape (multi-output probes, property tests).
+    inputs: slot -> ndarray (or [(name, ndarray), ...] for multi-var slots).
+    Returns the fetched outputs as numpy arrays, in out_slots order."""
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with framework.program_guard(prog, startup):
+        blk = prog.global_block()
+        in_names = {}
+        feed = {}
+        for slot, val in inputs.items():
+            pairs = (
+                [(n, np.asarray(a)) for n, a in val]
+                if isinstance(val, (list, tuple)) and val and isinstance(val[0], tuple)
+                else [("i_" + slot.lower(), np.asarray(val))]
+            )
+            names = []
+            for n, arr in pairs:
+                blk.create_var(
+                    name=n, shape=arr.shape, dtype=str(arr.dtype), is_data=True
+                )
+                feed[n] = arr
+                names.append(n)
+            in_names[slot] = names
+        out_names = {}
+        out_vars = []
+        for slot in out_slots:
+            v = blk.create_var(
+                name="o_" + slot.lower().replace("-", "_"), dtype="float32", shape=None
+            )
+            out_names[slot] = [v.name]
+            out_vars.append(v)
+        blk.append_op(op_type, inputs=in_names, outputs=out_names, attrs=attrs)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        return [np.asarray(r) for r in exe.run(prog, feed=feed, fetch_list=out_vars)]

@@ -1,0 +1,269 @@
+"""Per-op checks for the image/spatial op batch (test_affine_channel_op.py,
+test_crop_op.py, test_multiplex_op.py, test_space_to_depth_op.py,
+test_unpool_op.py, test_pool3d_op.py, test_row_conv_op.py, ... analogs)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from op_test import OpTest
+
+rng = np.random.RandomState(21)
+
+
+class TestAffineChannel(OpTest):
+    def setup(self):
+        self.op_type = "affine_channel"
+        x = rng.rand(2, 3, 4, 5).astype("float32")
+        s = rng.rand(3).astype("float32")
+        b = rng.rand(3).astype("float32")
+        self.inputs = {"X": x, "Scale": s, "Bias": b}
+        self.outputs = {"Out": x * s.reshape(1, 3, 1, 1) + b.reshape(1, 3, 1, 1)}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x"], "Out")
+
+
+class TestCrop(OpTest):
+    def setup(self):
+        self.op_type = "crop"
+        x = rng.rand(4, 6).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"offsets": [1, 2], "shape": [2, 3]}
+        self.outputs = {"Out": x[1:3, 2:5]}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x"], "Out")
+
+
+class TestPadConstantLike(OpTest):
+    def setup(self):
+        self.op_type = "pad_constant_like"
+        x = rng.rand(4, 5).astype("float32")
+        y = rng.rand(2, 3).astype("float32")
+        out = np.full((4, 5), 1.5, "float32")
+        out[:2, :3] = y
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"pad_value": 1.5}
+        self.outputs = {"Out": out}
+
+    def test(self):
+        self.check_output()
+
+
+class TestMultiplex(OpTest):
+    def setup(self):
+        self.op_type = "multiplex"
+        x1 = rng.rand(4, 3).astype("float32")
+        x2 = rng.rand(4, 3).astype("float32")
+        ids = np.array([[0], [1], [0], [1]], dtype="int32")
+        out = np.where(ids == 0, x1, x2)
+        self.inputs = {"Ids": ids, "X": [("x1", x1), ("x2", x2)]}
+        self.outputs = {"Out": out}
+
+    def test(self):
+        self.check_output()
+
+
+class TestSpaceToDepth(OpTest):
+    def setup(self):
+        self.op_type = "space_to_depth"
+        x = rng.rand(1, 2, 4, 4).astype("float32")
+        n, c, h, w = x.shape
+        bs = 2
+        ref = (
+            x.reshape(n, c, h // bs, bs, w // bs, bs)
+            .transpose(0, 3, 5, 1, 2, 4)
+            .reshape(n, c * bs * bs, h // bs, w // bs)
+        )
+        self.inputs = {"X": x}
+        self.attrs = {"blocksize": bs}
+        self.outputs = {"Out": ref}
+
+    def test(self):
+        self.check_output()
+
+
+class TestPool3d(OpTest):
+    def setup(self):
+        self.op_type = "pool3d"
+        x = rng.rand(1, 2, 4, 4, 4).astype("float32")
+        ref = np.zeros((1, 2, 2, 2, 2), "float32")
+        for i in range(2):
+            for j in range(2):
+                for k in range(2):
+                    ref[:, :, i, j, k] = x[
+                        :, :, 2 * i : 2 * i + 2, 2 * j : 2 * j + 2, 2 * k : 2 * k + 2
+                    ].max(axis=(2, 3, 4))
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "max", "ksize": [2, 2, 2], "strides": [2, 2, 2]}
+        self.outputs = {"Out": ref}
+
+    def test(self):
+        self.check_output()
+
+
+class TestRowConv(OpTest):
+    def setup(self):
+        self.op_type = "row_conv"
+        x = rng.rand(2, 6, 4).astype("float32")
+        w = rng.rand(3, 4).astype("float32")
+        b, t, d = x.shape
+        xp = np.pad(x, ((0, 0), (0, 2), (0, 0)))
+        ref = np.zeros_like(x)
+        for j in range(3):
+            ref += xp[:, j : j + t] * w[j][None, None]
+        self.inputs = {"X": x, "Filter": w}
+        self.outputs = {"Out": ref}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x", "filter"], "Out", max_relative_error=2e-2)
+
+
+class TestConvShift(OpTest):
+    def setup(self):
+        self.op_type = "conv_shift"
+        x = rng.rand(2, 7).astype("float32")
+        y = rng.rand(2, 3).astype("float32")
+        n, m = 7, 3
+        ref = np.zeros_like(x)
+        for b in range(2):
+            for i in range(n):
+                for j in range(m):
+                    ref[b, i] += x[b, (i + j - m // 2) % n] * y[b, j]
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": ref}
+
+    def test(self):
+        self.check_output(atol=1e-5)
+
+
+class TestMeanIou(OpTest):
+    def setup(self):
+        self.op_type = "mean_iou"
+        pred = np.array([0, 1, 1, 2, 2, 0], "int32")
+        label = np.array([0, 1, 2, 2, 1, 0], "int32")
+        # class 0: i=2 u=2 -> 1.0; class 1: i=1 u=3; class 2: i=1 u=3
+        miou = (1.0 + 1 / 3 + 1 / 3) / 3
+        self.inputs = {"Predictions": pred, "Labels": label}
+        self.attrs = {"num_classes": 3}
+        # wrong = area_pred + area_label - 2*inter (both sides of a mismatch)
+        self.outputs = {
+            "OutMeanIou": np.float32(miou),
+            "OutWrong": np.array([0, 2, 2], "int32"),
+            "OutCorrect": np.array([2, 1, 1], "int32"),
+        }
+
+    def test(self):
+        self.check_output()
+
+
+class TestSpp(OpTest):
+    def setup(self):
+        self.op_type = "spp"
+        x = rng.rand(2, 3, 8, 8).astype("float32")
+        outs = []
+        for lv in range(2):
+            bins = 2**lv
+            k = 8 // bins
+            r = x.reshape(2, 3, bins, k, bins, k).max(axis=(3, 5))
+            outs.append(r.reshape(2, -1))
+        self.inputs = {"X": x}
+        self.attrs = {"pyramid_height": 2, "pooling_type": "max"}
+        self.outputs = {"Out": np.concatenate(outs, axis=1)}
+
+    def test(self):
+        self.check_output()
+
+
+class TestAddPositionEncoding(OpTest):
+    def setup(self):
+        self.op_type = "add_position_encoding"
+        x = rng.rand(2, 4, 6).astype("float32")
+        b, t, d = x.shape
+        half = d // 2
+        enc = np.zeros((t, d), "float32")
+        for pos in range(t):
+            for i in range(half):
+                ang = pos / np.power(10000.0, i / half)
+                enc[pos, i] = np.sin(ang)
+                enc[pos, half + i] = np.cos(ang)
+        self.inputs = {"X": x}
+        self.attrs = {"alpha": 1.0, "beta": 1.0}
+        self.outputs = {"Out": x + enc[None]}
+
+    def test(self):
+        self.check_output(atol=1e-5)
+
+
+from op_test import run_single_op as _run_single_op
+
+
+def test_maxpool_with_index_unpool_roundtrip():
+    x = rng.rand(1, 2, 4, 4).astype("float32")
+    out, mask = _run_single_op(
+        "max_pool2d_with_index",
+        {"X": x},
+        {"ksize": [2, 2], "strides": [2, 2]},
+        ["Out", "Mask"],
+    )
+    assert out.shape == (1, 2, 2, 2)
+    # unpool scatters back: values land on argmax positions
+    rec = _run_single_op(
+        "unpool",
+        {"X": out.astype("float32"), "Indices": mask.astype("int32")},
+        {"unpooled_size": [4, 4]},
+        ["Out"],
+    )[0]
+    assert rec.shape == (1, 2, 4, 4)
+    # every pooled max value must appear in the reconstruction
+    for v in out.reshape(-1):
+        assert np.isclose(rec, v).any()
+    np.testing.assert_allclose(rec.sum(), out.sum(), rtol=1e-5)
+
+
+def test_grid_sampler_identity():
+    x = rng.rand(1, 1, 5, 5).astype("float32")
+    ys = np.linspace(-1, 1, 5)
+    xs = np.linspace(-1, 1, 5)
+    gy, gx = np.meshgrid(ys, xs, indexing="ij")
+    grid = np.stack([gx, gy], axis=-1)[None].astype("float32")
+    out = _run_single_op("grid_sampler", {"X": x, "Grid": grid}, {}, ["Output"])[0]
+    np.testing.assert_allclose(out, x, atol=1e-5)
+
+
+def test_affine_grid_identity():
+    theta = np.array([[[1.0, 0, 0], [0, 1.0, 0]]], "float32")
+    grid = _run_single_op(
+        "affine_grid", {"Theta": theta}, {"output_shape": [1, 1, 3, 3]}, ["Output"]
+    )[0]
+    assert grid.shape == (1, 3, 3, 2)
+    np.testing.assert_allclose(grid[0, 0, 0], [-1, -1], atol=1e-6)
+    np.testing.assert_allclose(grid[0, 2, 2], [1, 1], atol=1e-6)
+
+
+def test_im2sequence():
+    x = rng.rand(1, 1, 4, 4).astype("float32")
+    out = _run_single_op(
+        "im2sequence",
+        {"X": x},
+        {"kernels": [2, 2], "strides": [2, 2], "paddings": [0, 0, 0, 0]},
+        ["Out"],
+    )[0]
+    assert out.shape == (1, 4, 4)
+    np.testing.assert_allclose(out[0, 0], x[0, 0, :2, :2].reshape(-1), atol=1e-6)
+
+
+def test_shuffle_channel():
+    x = rng.rand(1, 4, 2, 2).astype("float32")
+    out = _run_single_op("shuffle_channel", {"X": x}, {"group": 2}, ["Out"])[0]
+    ref = x.reshape(1, 2, 2, 2, 2).transpose(0, 2, 1, 3, 4).reshape(1, 4, 2, 2)
+    np.testing.assert_allclose(out, ref, atol=1e-6)
+
+
+def test_is_empty():
+    x = rng.rand(2, 3).astype("float32")
+    out = _run_single_op("is_empty", {"X": x}, {}, ["Out"])[0]
+    assert not bool(out)

@@ -1,0 +1,260 @@
+"""Per-op checks for the loss-op batch (mirror of the reference's
+test_hinge_loss_op.py, test_log_loss_op.py, test_rank_loss_op.py, ...)."""
+
+import numpy as np
+
+from op_test import OpTest
+
+rng = np.random.RandomState(11)
+
+
+class TestHingeLoss(OpTest):
+    def setup(self):
+        self.op_type = "hinge_loss"
+        logits = rng.uniform(-1, 1, (10, 1)).astype("float32")
+        labels = rng.randint(0, 2, (10, 1)).astype("float32")
+        self.inputs = {"Logits": logits, "Labels": labels}
+        self.outputs = {
+            "Loss": np.maximum(0.0, 1.0 - (2 * labels - 1) * logits).astype("float32")
+        }
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["logits"], "Loss")
+
+
+class TestLogLoss(OpTest):
+    def setup(self):
+        self.op_type = "log_loss"
+        pred = rng.uniform(0.1, 0.9, (12, 1)).astype("float32")
+        label = rng.randint(0, 2, (12, 1)).astype("float32")
+        eps = 1e-4
+        self.inputs = {"Predicted": pred, "Labels": label}
+        self.attrs = {"epsilon": eps}
+        self.outputs = {
+            "Loss": (-label * np.log(pred + eps) - (1 - label) * np.log(1 - pred + eps))
+        }
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["predicted"], "Loss")
+
+
+class TestModifiedHuberLoss(OpTest):
+    def setup(self):
+        self.op_type = "modified_huber_loss"
+        x = rng.uniform(-2, 2, (14, 1)).astype("float32")
+        y = rng.randint(0, 2, (14, 1)).astype("float32")
+        z = (2 * y - 1) * x
+        loss = np.where(z >= -1, np.square(np.maximum(0, 1 - z)), -4 * z)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"IntermediateVal": z, "Out": loss.astype("float32")}
+
+    def test(self):
+        self.check_output()
+
+
+class TestRankLoss(OpTest):
+    def setup(self):
+        self.op_type = "rank_loss"
+        left = rng.uniform(-1, 1, (8, 1)).astype("float32")
+        right = rng.uniform(-1, 1, (8, 1)).astype("float32")
+        label = rng.randint(0, 2, (8, 1)).astype("float32")
+        d = left - right
+        self.inputs = {"Label": label, "Left": left, "Right": right}
+        self.outputs = {"Out": np.log(1 + np.exp(d)) - label * d}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["left", "right"], "Out")
+
+
+class TestMarginRankLoss(OpTest):
+    def setup(self):
+        self.op_type = "margin_rank_loss"
+        x1 = rng.uniform(-1, 1, (9, 1)).astype("float32")
+        x2 = rng.uniform(-1, 1, (9, 1)).astype("float32")
+        label = np.where(rng.rand(9, 1) > 0.5, 1.0, -1.0).astype("float32")
+        margin = 0.1
+        act = -label * (x1 - x2) + margin
+        self.inputs = {"Label": label, "X1": x1, "X2": x2}
+        self.attrs = {"margin": margin}
+        self.outputs = {
+            "Out": np.maximum(0, act),
+            "Activated": (act > 0).astype("float32"),
+        }
+
+    def test(self):
+        self.check_output()
+
+
+class TestSquaredL2Distance(OpTest):
+    def setup(self):
+        self.op_type = "squared_l2_distance"
+        x = rng.rand(5, 8).astype("float32")
+        y = rng.rand(5, 8).astype("float32")
+        sub = x - y
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {
+            "sub_result": sub,
+            "Out": np.sum(sub * sub, axis=1, keepdims=True),
+        }
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x"], "Out")
+
+
+class TestCosSimOp(OpTest):
+    def setup(self):
+        self.op_type = "cos_sim"
+        x = rng.rand(6, 10).astype("float32") + 0.1
+        y = rng.rand(6, 10).astype("float32") + 0.1
+        xn = np.linalg.norm(x, axis=1, keepdims=True)
+        yn = np.linalg.norm(y, axis=1, keepdims=True)
+        out = np.sum(x * y, axis=1, keepdims=True) / (xn * yn)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": out, "XNorm": xn, "YNorm": yn}
+
+    def test(self):
+        self.check_output(atol=1e-5)
+        self.check_grad(["x", "y"], "Out", max_relative_error=5e-2)
+
+
+class TestBilinearTensorProduct(OpTest):
+    def setup(self):
+        self.op_type = "bilinear_tensor_product"
+        x = rng.rand(4, 5).astype("float32")
+        y = rng.rand(4, 6).astype("float32")
+        w = rng.rand(3, 5, 6).astype("float32")
+        b = rng.rand(3).astype("float32")
+        out = np.einsum("bi,kij,bj->bk", x, w, y) + b[None]
+        self.inputs = {"X": x, "Weight": w, "Y": y, "Bias": b}
+        self.outputs = {"Out": out.astype("float32")}
+
+    def test(self):
+        self.check_output(atol=1e-4)
+        self.check_grad(["x", "weight"], "Out", max_relative_error=5e-2)
+
+
+class TestBprLoss(OpTest):
+    def setup(self):
+        self.op_type = "bpr_loss"
+        n, d = 5, 4
+        x = rng.rand(n, d).astype("float32")
+        label = rng.randint(0, d, (n, 1)).astype("int64")
+        loss = np.zeros((n, 1), "float32")
+        for i in range(n):
+            pos = x[i, label[i, 0]]
+            s = 0.0
+            for j in range(d):
+                if j == label[i, 0]:
+                    continue
+                s += np.log(1 + np.exp(-(pos - x[i, j])))
+            loss[i, 0] = s / (d - 1)
+        self.inputs = {"X": x, "Label": label}
+        self.outputs = {"Y": loss}
+
+    def test(self):
+        self.check_output(atol=1e-5)
+
+
+class TestKLDivLoss(OpTest):
+    def setup(self):
+        self.op_type = "kldiv_loss"
+        x = rng.uniform(-2, -0.5, (4, 6)).astype("float32")  # log-probs
+        target = rng.dirichlet(np.ones(6), 4).astype("float32")
+        loss = target * (np.log(np.maximum(target, 1e-30)) - x)
+        self.inputs = {"X": x, "Target": target}
+        self.attrs = {"reduction": "mean"}
+        self.outputs = {"Loss": np.mean(loss).astype("float32")}
+
+    def test(self):
+        self.check_output(atol=1e-5)
+
+
+class TestSelu(OpTest):
+    def setup(self):
+        self.op_type = "selu"
+        x = rng.uniform(-2, 2, (6, 7)).astype("float32")
+        scale = 1.0507009873554805
+        alpha = 1.6732632423543772
+        out = scale * np.where(x > 0, x, alpha * (np.exp(x) - 1))
+        self.inputs = {"X": x}
+        self.attrs = {"scale": scale, "alpha": alpha}
+        self.outputs = {"Out": out.astype("float32")}
+
+    def test(self):
+        self.check_output(atol=1e-5)
+        self.check_grad(["x"], "Out")
+
+
+def test_hsigmoid_probabilities_sum_to_one():
+    """Non-circular property check: p(class c) = prod of path sigmoid
+    decisions must form a distribution over classes."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    num_classes = 6
+    d = 4
+    x = rng.rand(2, d).astype("float32")
+    w = rng.rand(num_classes - 1, d).astype("float32") * 0.5
+
+    probs = np.zeros((2, num_classes))
+    for c in range(num_classes):
+        prog = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(prog, startup):
+            blk = prog.global_block()
+            for name, arr in [("x", x), ("w", w)]:
+                blk.create_var(name=name, shape=arr.shape, dtype="float32", is_data=True)
+            blk.create_var(name="label", shape=[2, 1], dtype="int64", is_data=True)
+            out = blk.create_var(name="cost", dtype="float32", shape=None)
+            pre = blk.create_var(name="pre", dtype="float32", shape=None)
+            blk.append_op(
+                "hierarchical_sigmoid",
+                inputs={"X": ["x"], "W": ["w"], "Label": ["label"]},
+                outputs={"Out": ["cost"], "PreOut": ["pre"]},
+                attrs={"num_classes": num_classes},
+            )
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            label = np.full((2, 1), c, "int64")
+            (cost,) = exe.run(
+                prog, feed={"x": x, "w": w, "label": label}, fetch_list=[out]
+            )
+        probs[:, c] = np.exp(-np.asarray(cost).reshape(-1))
+    np.testing.assert_allclose(probs.sum(axis=1), np.ones(2), atol=1e-4)
+
+
+def test_nce_shapes_and_positivity():
+    import paddle_tpu as fluid
+
+    b, d, nc, s = 4, 6, 20, 5
+    x = rng.rand(b, d).astype("float32")
+    w = rng.rand(nc, d).astype("float32") * 0.1
+    label = rng.randint(0, nc, (b, 1)).astype("int64")
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        blk = prog.global_block()
+        for name, arr in [("x", x), ("w", w)]:
+            blk.create_var(name=name, shape=arr.shape, dtype="float32", is_data=True)
+        blk.create_var(name="label", shape=[b, 1], dtype="int64", is_data=True)
+        cost = blk.create_var(name="cost", dtype="float32", shape=None)
+        sl = blk.create_var(name="sl", dtype="float32", shape=None)
+        slab = blk.create_var(name="slab", dtype="int32", shape=None)
+        blk.append_op(
+            "nce",
+            inputs={"Input": ["x"], "Weight": ["w"], "Label": ["label"]},
+            outputs={"Cost": ["cost"], "SampleLogits": ["sl"], "SampleLabels": ["slab"]},
+            attrs={"num_total_classes": nc, "num_neg_samples": s},
+        )
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        got_cost, got_sl = exe.run(
+            prog, feed={"x": x, "w": w, "label": label}, fetch_list=[cost, sl]
+        )
+    assert np.asarray(got_cost).shape == (b, 1)
+    assert np.asarray(got_sl).shape == (b, 1 + s)
+    assert (np.asarray(got_cost) > 0).all()

@@ -1,0 +1,423 @@
+"""Checks for structured-prediction ops: CRF (vs brute-force enumeration),
+CTC (vs brute-force alignment sum), edit distance (vs numpy DP), beam
+search (hand case), detection ops, quantize ops, metric ops — analogs of
+test_linear_chain_crf_op.py, test_warpctc_op.py, test_edit_distance_op.py,
+test_beam_search_op.py, test_bipartite_match_op.py, ..."""
+
+import itertools
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+rng = np.random.RandomState(31)
+
+
+from op_test import run_single_op as run_op
+
+
+# ---------------------------------------------------------------------------
+# CRF
+# ---------------------------------------------------------------------------
+def brute_crf(emission, transition, label, length):
+    """Enumerate all paths: returns log Z and the gold-path score."""
+    t_all, n = emission.shape[0], emission.shape[1]
+    a, b, w = transition[0], transition[1], transition[2:]
+    t = length
+    scores = []
+    for path in itertools.product(range(n), repeat=t):
+        s = a[path[0]] + b[path[-1]] + sum(emission[i, path[i]] for i in range(t))
+        s += sum(w[path[i], path[i + 1]] for i in range(t - 1))
+        scores.append(s)
+    logz = np.log(np.sum(np.exp(np.array(scores))))
+    gold = a[label[0]] + b[label[t - 1]] + sum(
+        emission[i, label[i]] for i in range(t)
+    ) + sum(w[label[i], label[i + 1]] for i in range(t - 1))
+    return logz, gold
+
+
+def test_linear_chain_crf_vs_bruteforce():
+    b, t, n = 2, 3, 3
+    emission = rng.uniform(-1, 1, (b, t, n)).astype("float32")
+    transition = rng.uniform(-0.5, 0.5, (n + 2, n)).astype("float32")
+    label = rng.randint(0, n, (b, t)).astype("int64")
+    length = np.array([3, 2], "int64")
+    (ll,) = run_op(
+        "linear_chain_crf",
+        {
+            "Emission": emission,
+            "Transition": transition,
+            "Label": label,
+            "Length": length,
+        },
+        {},
+        ["LogLikelihood"],
+    )
+    for i in range(b):
+        logz, gold = brute_crf(
+            emission[i].astype("float64"),
+            transition.astype("float64"),
+            label[i],
+            int(length[i]),
+        )
+        np.testing.assert_allclose(ll[i, 0], logz - gold, atol=1e-4)
+
+
+def test_crf_decoding_vs_bruteforce():
+    b, t, n = 2, 4, 3
+    emission = rng.uniform(-1, 1, (b, t, n)).astype("float32")
+    transition = rng.uniform(-0.5, 0.5, (n + 2, n)).astype("float32")
+    length = np.array([4, 3], "int64")
+    (path,) = run_op(
+        "crf_decoding",
+        {"Emission": emission, "Transition": transition, "Length": length},
+        {},
+        ["ViterbiPath"],
+    )
+    a, bv, w = transition[0], transition[1], transition[2:]
+    for i in range(b):
+        tl = int(length[i])
+        best, best_s = None, -np.inf
+        for p in itertools.product(range(n), repeat=tl):
+            s = a[p[0]] + bv[p[-1]] + sum(emission[i, j, p[j]] for j in range(tl))
+            s += sum(w[p[j], p[j + 1]] for j in range(tl - 1))
+            if s > best_s:
+                best, best_s = p, s
+        np.testing.assert_array_equal(path[i, :tl], np.array(best))
+        assert (path[i, tl:] == 0).all()
+
+
+# ---------------------------------------------------------------------------
+# CTC
+# ---------------------------------------------------------------------------
+def brute_ctc_loss(logits, labels, blank=0):
+    """-log p(labels | logits) by enumerating all alignments."""
+    t, c = logits.shape
+    logp = logits - np.log(np.sum(np.exp(logits), axis=1, keepdims=True))
+
+    def collapse(seq):
+        out = []
+        prev = None
+        for s in seq:
+            if s != prev:
+                prev = s
+                if s != blank:
+                    out.append(s)
+            # repeats collapse
+        return tuple(out)
+
+    total = 0.0
+    for align in itertools.product(range(c), repeat=t):
+        if collapse(align) == tuple(labels):
+            total += np.exp(sum(logp[i, align[i]] for i in range(t)))
+    return -np.log(total)
+
+
+def test_warpctc_vs_bruteforce():
+    t, c = 4, 3  # classes: blank=0, 1, 2
+    logits = rng.uniform(-1, 1, (1, t, c)).astype("float32")
+    label = np.array([[1, 2]], "int32")  # true label seq (1-based handled in op)
+    (loss,) = run_op(
+        "warpctc",
+        {
+            "Logits": logits,
+            "Label": label - 1,  # op contract: labels 0..C-2
+            "LogitsLength": np.array([t], "int64"),
+            "LabelLength": np.array([2], "int64"),
+        },
+        {"blank": 0, "norm_by_times": False},
+        ["Loss"],
+    )
+    ref = brute_ctc_loss(logits[0].astype("float64"), [1, 2])
+    np.testing.assert_allclose(loss[0, 0], ref, atol=1e-4)
+
+
+def test_warpctc_nonzero_blank():
+    t, c = 4, 3
+    blank = 1  # full classes {0, 2} compress to labels {0, 1}
+    logits = rng.uniform(-1, 1, (1, t, c)).astype("float32")
+    (loss,) = run_op(
+        "warpctc",
+        {
+            "Logits": logits,
+            "Label": np.array([[0, 1]], "int32"),  # full classes [0, 2]
+            "LogitsLength": np.array([t], "int64"),
+            "LabelLength": np.array([2], "int64"),
+        },
+        {"blank": blank, "norm_by_times": False},
+        ["Loss"],
+    )
+    ref = brute_ctc_loss(logits[0].astype("float64"), [0, 2], blank=1)
+    np.testing.assert_allclose(loss[0, 0], ref, atol=1e-4)
+
+
+def test_ctc_align():
+    x = np.array([[0, 1, 1, 0, 2, 2, 0, 3]], "int32")
+    out, olen = run_op(
+        "ctc_align",
+        {"Input": x, "InputLength": np.array([8], "int64")},
+        {"blank": 0, "padding_value": 0},
+        ["Output", "OutputLength"],
+    )
+    assert int(olen[0, 0]) == 3
+    np.testing.assert_array_equal(out[0, :3], [1, 2, 3])
+
+
+def test_edit_distance():
+    # "kitten" -> "sitting" = 3
+    def enc(s):
+        return np.array([[ord(c) for c in s]], "int64")
+
+    hyp = enc("kitten" + "\0")[:, :6]
+    ref = enc("sitting")
+    (d,) = run_op(
+        "edit_distance",
+        {
+            "Hyps": np.pad(hyp, ((0, 0), (0, 1))),
+            "Refs": ref,
+            "HypsLength": np.array([6], "int64"),
+            "RefsLength": np.array([7], "int64"),
+        },
+        {"normalized": False},
+        ["Out"],
+    )
+    np.testing.assert_allclose(d[0, 0], 3.0, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# beam search
+# ---------------------------------------------------------------------------
+def test_beam_search_step_and_decode():
+    batch, beam, vocab = 1, 2, 4
+    pre_ids = np.array([[1, 2]], "int32")
+    pre_scores = np.array([[-1.0, -2.0]], "float32")
+    scores = np.log(
+        np.array(
+            [[[0.1, 0.2, 0.3, 0.4], [0.4, 0.3, 0.2, 0.1]]],
+            "float32",
+        )
+    )
+    ids, sc, par = run_op(
+        "beam_search",
+        {"pre_ids": pre_ids, "pre_scores": pre_scores, "scores": scores},
+        {"beam_size": beam, "end_id": 0},
+        ["selected_ids", "selected_scores", "parent_idx"],
+    )
+    # totals: beam0: -1+log[.1..4]; beam1: -2+log[.4...]
+    total = pre_scores[0][:, None] + scores[0]
+    flat = total.reshape(-1)
+    top2 = np.sort(flat)[::-1][:2]
+    np.testing.assert_allclose(np.sort(sc[0])[::-1], top2, atol=1e-5)
+    # decode a 2-step hand case
+    ids_steps = np.array([[[1, 2]], [[3, 0]]], "int32").reshape(2, 1, 2)
+    parents = np.array([[[0, 0]], [[1, 0]]], "int32").reshape(2, 1, 2)
+    scores_steps = np.zeros((2, 1, 2), "float32")
+    sent, fin = run_op(
+        "beam_search_decode",
+        {"Ids": ids_steps, "ParentIdx": parents, "Scores": scores_steps},
+        {"end_id": 0},
+        ["SentenceIds", "SentenceScores"],
+    )
+    # beam 0 at t=1 came from parent 1 (token 2 at t=0), then token 3
+    np.testing.assert_array_equal(sent[0, 0], [2, 3])
+    np.testing.assert_array_equal(sent[0, 1], [1, 0])
+
+
+# ---------------------------------------------------------------------------
+# detection
+# ---------------------------------------------------------------------------
+def test_box_coder_roundtrip():
+    prior = np.array([[0.1, 0.1, 0.5, 0.5], [0.2, 0.2, 0.8, 0.9]], "float32")
+    pvar = np.full((2, 4), 0.1, "float32")
+    gt = np.array([[0.15, 0.2, 0.55, 0.7]], "float32")
+    (enc,) = run_op(
+        "box_coder",
+        {"PriorBox": prior, "PriorBoxVar": pvar, "TargetBox": gt},
+        {"code_type": "encode_center_size", "box_normalized": True},
+        ["OutputBox"],
+    )
+    (dec,) = run_op(
+        "box_coder",
+        {"PriorBox": prior, "PriorBoxVar": pvar, "TargetBox": enc.astype("float32")},
+        {"code_type": "decode_center_size", "box_normalized": True},
+        ["OutputBox"],
+    )
+    for m in range(2):
+        np.testing.assert_allclose(dec[0, m], gt[0], atol=1e-5)
+
+
+def test_bipartite_match():
+    dist = np.array(
+        [[0.9, 0.1, 0.3], [0.2, 0.8, 0.1]], "float32"
+    )  # 2 gt x 3 priors
+    idx, d = run_op(
+        "bipartite_match",
+        {"DistMat": dist},
+        {"match_type": "bipartite"},
+        ["ColToRowMatchIndices", "ColToRowMatchDist"],
+    )
+    np.testing.assert_array_equal(idx[0], [0, 1, -1])
+    np.testing.assert_allclose(d[0], [0.9, 0.8, 0.0], atol=1e-6)
+
+
+def test_target_assign():
+    x = np.array([[1.0, 2.0], [3.0, 4.0]], "float32")  # 2 gt entities
+    match = np.array([[0, -1, 1]], "int32")
+    out, wt = run_op(
+        "target_assign",
+        {"X": x, "MatchIndices": match},
+        {"mismatch_value": 0},
+        ["Out", "OutWeight"],
+    )
+    np.testing.assert_allclose(out[0, 0], [1, 2])
+    np.testing.assert_allclose(out[0, 1], [0, 0])
+    np.testing.assert_allclose(out[0, 2], [3, 4])
+    np.testing.assert_allclose(wt[0, :, 0], [1, 0, 1])
+
+
+def test_multiclass_nms():
+    # 1 image, 3 boxes, 2 classes (class 0 = background)
+    boxes = np.array(
+        [[[0, 0, 1, 1], [0, 0, 1.05, 1.05], [2, 2, 3, 3]]], "float32"
+    )
+    scores = np.array([[[0.1, 0.2, 0.3], [0.9, 0.85, 0.6]]], "float32")  # [N,C,M]
+    out, cnt = run_op(
+        "multiclass_nms",
+        {"BBoxes": boxes, "Scores": scores},
+        {
+            "score_threshold": 0.1,
+            "nms_threshold": 0.5,
+            "keep_top_k": 3,
+            "background_label": 0,
+        },
+        ["Out", "NmsRoisNum"],
+    )
+    # boxes 0 and 1 overlap heavily -> one suppressed; box 2 kept
+    assert int(cnt[0]) == 2
+    kept_scores = out[0][out[0][:, 0] >= 0][:, 1]
+    np.testing.assert_allclose(np.sort(kept_scores)[::-1], [0.9, 0.6], atol=1e-5)
+
+
+def test_roi_pool():
+    x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+    rois = np.array([[0, 0, 3, 3]], "float32")
+    (out,) = run_op(
+        "roi_pool",
+        {"X": x, "ROIs": rois},
+        {"pooled_height": 2, "pooled_width": 2, "spatial_scale": 1.0},
+        ["Out"],
+    )
+    np.testing.assert_allclose(out[0, 0], [[5, 7], [13, 15]], atol=1e-5)
+
+
+def test_roi_align_center():
+    x = np.ones((1, 1, 4, 4), "float32") * 2.0
+    rois = np.array([[0.5, 0.5, 2.5, 2.5]], "float32")
+    (out,) = run_op(
+        "roi_align",
+        {"X": x, "ROIs": rois},
+        {"pooled_height": 2, "pooled_width": 2, "spatial_scale": 1.0},
+        ["Out"],
+    )
+    np.testing.assert_allclose(out, np.full((1, 1, 2, 2), 2.0), atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# quantize
+# ---------------------------------------------------------------------------
+def test_fake_quantize_abs_max():
+    x = rng.uniform(-4, 4, (5, 6)).astype("float32")
+    out, scale = run_op(
+        "fake_quantize_abs_max", {"X": x}, {"bit_length": 8}, ["Out", "OutScale"]
+    )
+    s = np.abs(x).max()
+    ref = np.round(x / s * 127) * s / 127
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+    np.testing.assert_allclose(scale[0], s, atol=1e-6)
+
+
+def test_fake_dequantize_max_abs():
+    x = rng.randint(-127, 127, (4, 4)).astype("float32")
+    sc = np.array([3.5], "float32")
+    (out,) = run_op(
+        "fake_dequantize_max_abs",
+        {"X": x, "Scale": sc},
+        {"max_range": 127.0},
+        ["Out"],
+    )
+    np.testing.assert_allclose(out, x * 3.5 / 127, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+def test_auc_op():
+    # column 1 = positive-class score
+    pred = np.array([[0.9, 0.1], [0.2, 0.8], [0.4, 0.6], [0.7, 0.3]], "float32")
+    label = np.array([[0], [1], [1], [0]], "int64")
+    nt = 200
+    auc, sp, sn = run_op(
+        "auc",
+        {
+            "Predict": pred,
+            "Label": label,
+            "StatPos": np.zeros(nt + 1, "float32"),
+            "StatNeg": np.zeros(nt + 1, "float32"),
+        },
+        {"num_thresholds": nt},
+        ["AUC", "StatPosOut", "StatNegOut"],
+    )
+    # positives scores: 0.8, 0.6; negatives: 0.1, 0.3 -> perfect separation
+    np.testing.assert_allclose(float(auc), 1.0, atol=1e-2)
+
+
+def test_precision_recall():
+    indices = np.array([[0], [1], [1], [0]], "int64")
+    labels = np.array([[0], [1], [0], [1]], "int64")
+    batch, accum, states = run_op(
+        "precision_recall",
+        {"Indices": indices, "Labels": labels},
+        {"class_number": 2},
+        ["BatchMetrics", "AccumMetrics", "AccumStatesInfo"],
+    )
+    # per class: TP0=1 FP0=1 FN0=1; TP1=1 FP1=1 FN1=1 -> P=R=F1=0.5 all
+    np.testing.assert_allclose(batch, np.full(6, 0.5), atol=1e-6)
+
+
+def test_average_accumulates():
+    p = np.ones((3,), "float32") * 2.0
+    outs = run_op(
+        "average_accumulates",
+        {
+            "param": p,
+            "in_sum_1": np.zeros(3, "float32"),
+            "in_sum_2": np.zeros(3, "float32"),
+            "in_sum_3": np.zeros(3, "float32"),
+            "in_num_accumulates": np.array([0], "int64"),
+            "in_old_num_accumulates": np.array([0], "int64"),
+            "in_num_updates": np.array([0], "int64"),
+        },
+        {"average_window": 0.5, "max_average_window": 10, "min_average_window": 2},
+        ["out_sum_1", "out_sum_2", "out_sum_3", "out_num_accumulates",
+         "out_old_num_accumulates", "out_num_updates"],
+    )
+    np.testing.assert_allclose(outs[0], p)  # sum_1 accumulated
+    assert int(outs[5][0]) == 1
+
+
+def test_chunk_eval_iob():
+    # IOB, 1 type: B=0, I=1, O=2
+    # gold:  B I O B  (chunks: [0-1], [3])
+    # pred:  B I O O  (chunks: [0-1])
+    inf = np.array([[0, 1, 2, 2]], "int64")
+    lab = np.array([[0, 1, 2, 0]], "int64")
+    p, r, f1, ni, nl, nc = run_op(
+        "chunk_eval",
+        {"Inference": inf, "Label": lab, "Length": np.array([4], "int64")},
+        {"chunk_scheme": "IOB", "num_chunk_types": 1},
+        ["Precision", "Recall", "F1-Score", "NumInferChunks", "NumLabelChunks",
+         "NumCorrectChunks"],
+    )
+    assert int(ni) == 1 and int(nl) == 2 and int(nc) == 1
+    np.testing.assert_allclose(float(p), 1.0, atol=1e-6)
+    np.testing.assert_allclose(float(r), 0.5, atol=1e-6)
