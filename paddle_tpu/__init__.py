@@ -26,6 +26,7 @@ from . import (
     backward,
     clip,
     dataset,
+    distributed,
     framework,
     initializer,
     layers,
@@ -39,7 +40,15 @@ from . import (
     profiler,
     reader,
     regularizer,
+    transpiler,
     unique_name,
+)
+from .transpiler import (
+    DistributeTranspiler,
+    DistributeTranspilerConfig,
+    memory_optimize,
+    release_memory,
+    InferenceTranspiler,
 )
 from .executor import Executor, global_scope, scope_guard, as_numpy
 from .framework import (

@@ -40,6 +40,13 @@ def append_backward(loss, parameter_list=None, no_grad_set=None, callbacks=None)
     program = loss.block.program
     block = program.global_block()
     no_grad = set(no_grad_set or ())
+    with program._op_role_guard("backward"):
+        return _append_backward_impl(
+            loss, program, block, no_grad, parameter_list
+        )
+
+
+def _append_backward_impl(loss, program, block, no_grad, parameter_list):
 
     ops = block.ops
     n_fwd = len(ops)  # snapshot: ops appended below must not join the walk

@@ -22,21 +22,26 @@ import numpy as np
 
 
 class OpDef:
-    def __init__(self, type, lower, no_grad_inputs=None, needs_rng=False):
+    def __init__(
+        self, type, lower, no_grad_inputs=None, needs_rng=False, side_effect=False
+    ):
         self.type = type
         self.lower = lower  # fn(ctx, ins: {slot: [arrays]}, attrs) -> {slot: [arrays]}
         self.no_grad_inputs = set(no_grad_inputs or ())
         self.needs_rng = needs_rng
+        # side-effecting ops (network sends, barriers) survive DCE even when
+        # no fetch depends on their outputs
+        self.side_effect = side_effect
 
 
 OPS = {}
 
 
-def register(type_, no_grad_inputs=None, needs_rng=False):
+def register(type_, no_grad_inputs=None, needs_rng=False, side_effect=False):
     """Decorator: register a lowering rule for op `type_`."""
 
     def deco(fn):
-        OPS[type_] = OpDef(type_, fn, no_grad_inputs, needs_rng)
+        OPS[type_] = OpDef(type_, fn, no_grad_inputs, needs_rng, side_effect)
         return fn
 
     return deco

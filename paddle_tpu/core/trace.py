@@ -43,12 +43,19 @@ def dce_mask(program, block_idx, fetch_names):
         v = blk._find_var_recursive(name)
         return v is not None and v.persistable
 
+    from .registry import OPS
+
     needed = set(fetch_names)
     keep = [False] * len(blk.ops)
     for i in range(len(blk.ops) - 1, -1, -1):
         op = blk.ops[i]
         outs = op.output_arg_names()
-        if any(n in needed for n in outs) or any(is_persistable(n) for n in outs):
+        opdef = OPS.get(op.type)
+        if (
+            any(n in needed for n in outs)
+            or any(is_persistable(n) for n in outs)
+            or (opdef is not None and opdef.side_effect)
+        ):
             keep[i] = True
             needed.update(op.input_arg_names())
     return keep

@@ -1,0 +1,74 @@
+"""Distributed runtime: DCN control plane for the TPU framework.
+
+Two capability families from the reference (SURVEY §2.9):
+* parameter-server mode — rpc.py (var transport), ps_server.py
+  (listen_and_serv service); programs rewritten by
+  transpiler.DistributeTranspiler.
+* collective mode ("nccl2") — init_collective() wraps
+  jax.distributed.initialize: the NCCL-unique-id handshake
+  (gen_nccl_id_op.cc:31) is replaced by the JAX coordination service over
+  DCN, after which pjit/shard_map programs use ICI/DCN XLA collectives.
+"""
+
+import os
+
+from .rpc import RPCClient, VarServer
+from .ps_server import ParameterServer, run_pserver
+
+# (endpoint, trainer_id) pairs this process has sent grads to — used by
+# Executor.close() to emit SendComplete like the reference
+# (executor.h:91 Close -> SendComplete).
+_active_endpoints = set()
+
+
+def _note_endpoint(ep, trainer_id):
+    _active_endpoints.add((ep, int(trainer_id)))
+
+
+def send_complete_all():
+    for ep, tid in sorted(_active_endpoints):
+        try:
+            RPCClient.get(ep).complete(tid)
+        except Exception:
+            pass
+    _active_endpoints.clear()
+
+
+def init_collective(trainer_endpoints=None, current_endpoint=None, trainer_id=None):
+    """Multi-host collective bootstrap (nccl2-mode analog).
+
+    Reads the reference's cluster env contract when args are omitted:
+    PADDLE_TRAINER_ENDPOINTS / PADDLE_CURRENT_ENDPOINT / PADDLE_TRAINER_ID.
+    Calls jax.distributed.initialize(coordinator, num_processes, process_id)
+    with the rank-0 endpoint as coordinator — the gen_nccl_id handshake
+    re-expressed over the JAX coordination service.
+    """
+    import jax
+
+    if trainer_endpoints is None:
+        trainer_endpoints = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+    if isinstance(trainer_endpoints, str):
+        trainer_endpoints = trainer_endpoints.split(",")
+    trainer_endpoints = [e.strip() for e in trainer_endpoints if e.strip()]
+    if current_endpoint is None:
+        current_endpoint = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+    if trainer_id is None:
+        trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if len(trainer_endpoints) <= 1:
+        return  # single host: nothing to do
+    jax.distributed.initialize(
+        coordinator_address=trainer_endpoints[0],
+        num_processes=len(trainer_endpoints),
+        process_id=trainer_id,
+    )
+
+
+class TrainingRole:
+    """PADDLE_TRAINING_ROLE env contract (fluid_benchmark.py:63-100)."""
+
+    TRAINER = "TRAINER"
+    PSERVER = "PSERVER"
+
+    @staticmethod
+    def current():
+        return os.environ.get("PADDLE_TRAINING_ROLE", TrainingRole.TRAINER)

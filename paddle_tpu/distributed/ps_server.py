@@ -1,0 +1,237 @@
+"""Parameter-server runtime: the `listen_and_serv` service loop.
+
+TPU-native re-design of the reference pserver
+(operators/distributed_ops/listen_and_serv_op.cc — RunSyncLoop :106,
+RunAsyncLoop :216): a host-side service that owns a scope of parameter /
+optimizer-state *blocks* (1-D slices of the original variables, see the
+distribute transpiler) and applies optimizer shard programs built by the
+transpiler.  Each shard program is a tiny Program compiled once by the
+regular Executor (compile-first, like everything else) — the pserver's
+"optimize sub-blocks" of the reference become cached XLA CPU executables.
+
+Sync mode round protocol (reference barrier semantics):
+  1. every live trainer sends its grad blocks, then barrier("send")
+  2. when all send-barriers arrive: grads are summed per block, the lr
+     program (decay schedule) runs once, then every shard program runs
+  3. trainers issue get() for updated param blocks, then barrier("fetch")
+  4. round resets
+Async mode: each send applies its shard program immediately, gets are
+served from the live scope, no barriers.
+"""
+
+import threading
+
+import numpy as np
+
+from .. import framework
+from ..core.scope import Scope
+
+
+class ParameterServer:
+    """Service object plugged into rpc.VarServer."""
+
+    def __init__(
+        self,
+        shard_programs,
+        grad_to_shard,
+        lr_program=None,
+        num_trainers=1,
+        sync_mode=True,
+        scope=None,
+        sparse_tables=None,
+        sparse_lr=0.01,
+    ):
+        from ..executor import Executor
+        from ..places import CPUPlace
+
+        self.shard_programs = shard_programs  # list[Program]
+        self.grad_to_shard = grad_to_shard  # grad block name -> shard idx
+        self.lr_program = lr_program
+        self.num_trainers = num_trainers
+        self.sync_mode = sync_mode
+        self.scope = scope if scope is not None else Scope()
+        self.exe = Executor(CPUPlace())
+        # sparse embedding shards: table name -> 2-D np.ndarray (rows here
+        # belong to this server: global_row = row * nservers + server_idx
+        # routing is done client-side; we only see local row ids)
+        self.sparse_tables = dict(sparse_tables or {})
+        self.sparse_lr = sparse_lr
+
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._pending = {}  # grad block name -> {trainer_id: np.ndarray}
+        self._send_barriers = set()
+        self._fetch_barriers = set()
+        self._round = 0  # bumped after each optimize step
+        self._params_ready = not sync_mode
+        self._live_trainers = num_trainers
+        self._done = threading.Event()
+
+    # ---- verb dispatch ---------------------------------------------------
+    def handle(self, verb, **kw):
+        try:
+            return getattr(self, "_h_" + verb)(**kw)
+        except Exception as e:  # ship errors to the client
+            import traceback
+
+            return {"__error__": "%s\n%s" % (e, traceback.format_exc())}
+
+    # ---- optimize --------------------------------------------------------
+    def _apply_shard(self, shard_idx, feed):
+        prog = self.shard_programs[shard_idx]
+        self.exe.run(prog, feed=feed, fetch_list=[], scope=self.scope)
+
+    def _run_round(self):
+        """All send-barriers in: sum grads, run lr + all shard programs."""
+        if self.lr_program is not None:
+            self.exe.run(self.lr_program, feed={}, fetch_list=[], scope=self.scope)
+        for gname, per_trainer in sorted(self._pending.items()):
+            total = None
+            for v in per_trainer.values():
+                total = v if total is None else total + v
+            self._apply_shard(self.grad_to_shard[gname], {gname: total})
+        self._pending.clear()
+        self._send_barriers.clear()
+        self._params_ready = True
+        self._round += 1
+        self._cv.notify_all()
+
+    # ---- handlers --------------------------------------------------------
+    def _h_send(self, name, value, trainer_id=0):
+        value = np.asarray(value)
+        if not self.sync_mode:
+            with self._lock:
+                if self.lr_program is not None:
+                    self.exe.run(
+                        self.lr_program, feed={}, fetch_list=[], scope=self.scope
+                    )
+                self._apply_shard(self.grad_to_shard[name], {name: value})
+            return {"ok": True}
+        with self._lock:
+            self._pending.setdefault(name, {})[trainer_id] = value
+        return {"ok": True}
+
+    def _h_barrier(self, kind, trainer_id=0):
+        if not self.sync_mode:
+            return {"ok": True}
+        with self._cv:
+            if kind == "send":
+                self._send_barriers.add(trainer_id)
+                if len(self._send_barriers) >= self._live_trainers:
+                    self._run_round()
+                else:
+                    rnd = self._round
+                    self._cv.wait_for(
+                        lambda: self._round > rnd or self._done.is_set()
+                    )
+            elif kind == "fetch":
+                self._fetch_barriers.add(trainer_id)
+                if len(self._fetch_barriers) >= self._live_trainers:
+                    self._fetch_barriers.clear()
+                    self._params_ready = False
+                    self._cv.notify_all()
+        return {"ok": True}
+
+    def _h_get(self, name, trainer_id=0):
+        if self.sync_mode:
+            with self._cv:
+                self._cv.wait_for(
+                    lambda: self._params_ready or self._done.is_set()
+                )
+        var = self.scope.find_var(name)
+        if var is None:
+            raise KeyError("pserver has no var %s" % name)
+        return np.asarray(var)
+
+    # ---- sparse embedding shards (distributed lookup table) -------------
+    def _h_prefetch(self, table, ids, trainer_id=0):
+        """Serve embedding rows by local row id (prefetch_op analog)."""
+        tbl = self.sparse_tables[table]
+        ids = np.asarray(ids).reshape(-1)
+        ids = np.clip(ids, 0, tbl.shape[0] - 1)
+        return tbl[ids]
+
+    def _h_send_sparse(self, table, ids, rows, trainer_id=0):
+        """Sparse SGD update on this server's rows (SelectedRows grad)."""
+        tbl = self.sparse_tables[table]
+        ids = np.asarray(ids).reshape(-1)
+        rows = np.asarray(rows)
+        with self._lock:
+            np.subtract.at(tbl, ids, self.sparse_lr * rows)
+        return {"ok": True}
+
+    def _h_complete(self, trainer_id=0):
+        with self._cv:
+            self._live_trainers -= 1
+            if self._live_trainers <= 0:
+                self._done.set()
+            # a departing trainer may unblock a pending round
+            if (
+                self.sync_mode
+                and self._live_trainers > 0
+                and len(self._send_barriers) >= self._live_trainers
+            ):
+                self._run_round()
+            self._cv.notify_all()
+        return {"ok": True}
+
+    def wait_done(self, timeout=None):
+        return self._done.wait(timeout)
+
+
+def run_pserver(program, scope, executor=None):
+    """Execute a transpiled pserver program: start the VarServer on the
+    listen_and_serv op's endpoint, block until all trainers complete.
+
+    This is what Executor.run does when it sees a `listen_and_serv` op —
+    the analog of ListenAndServOp::RunImpl.
+    """
+    from .rpc import VarServer
+
+    listen_op = None
+    for op in program.global_block().ops:
+        if op.type == "listen_and_serv":
+            listen_op = op
+            break
+    assert listen_op is not None, "no listen_and_serv op in pserver program"
+    a = listen_op.attrs
+
+    shard_programs = [framework.Program.from_json(s) for s in a["optimize_programs"]]
+    lr_program = (
+        framework.Program.from_json(a["lr_program"]) if a.get("lr_program") else None
+    )
+
+    # materialize block vars from the full vars the startup program created
+    for src, block_name, begin, end in a["slice_plan"]:
+        var = scope.find_var(src)
+        if var is None:
+            raise RuntimeError(
+                "pserver startup did not create %s (run get_startup_program "
+                "through this executor first)" % src
+            )
+        flat = np.asarray(var).reshape(-1)
+        scope.set(block_name, np.ascontiguousarray(flat[begin:end]))
+    for name in a.get("whole_vars", []):
+        if scope.find_var(name) is None:
+            raise RuntimeError("pserver startup did not create %s" % name)
+
+    sparse_tables = {}
+    for tname in a.get("sparse_table_names", []):
+        var = scope.find_var(tname)
+        sparse_tables[tname] = np.array(var)
+
+    service = ParameterServer(
+        shard_programs,
+        dict(a["grad_to_shard"]),
+        lr_program=lr_program,
+        num_trainers=int(a["trainers"]),
+        sync_mode=bool(a["sync_mode"]),
+        scope=scope,
+        sparse_tables=sparse_tables,
+        sparse_lr=float(a.get("sparse_lr", 0.01)),
+    )
+    server = VarServer(a["endpoint"], service).start()
+    try:
+        service.wait_done()
+    finally:
+        server.shutdown()

@@ -1,0 +1,220 @@
+"""Variable-transport RPC for the parameter-server path.
+
+TPU-native re-design of the reference's gRPC var transport
+(paddle/fluid/operators/distributed/grpc_client.h:175, grpc_server.h:46,
+send_recv.proto.in): on TPU the data plane is ICI/XLA collectives, so this
+layer only carries the DCN-side control plane — param/grad blocks and sparse
+embedding rows between trainer hosts and parameter servers.  It is a
+length-prefixed binary protocol over TCP (no external deps): each message is
+
+    [8-byte big-endian length][pickled (verb, kwargs) payload]
+
+with numpy arrays shipped via pickle protocol 5 (zero-copy out-of-band
+buffers are unnecessary at control-plane rates).
+
+Verbs mirror the reference's SendRecvService (send_recv.proto.in:20-30):
+SendVariable / GetVariable / PrefetchVariable / Barrier / Complete.
+"""
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+
+_LEN = struct.Struct(">Q")
+
+
+def _send_msg(sock, obj):
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock, n):
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed connection")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_msg(sock):
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        server = self.server
+        service = server.service
+        try:
+            while True:
+                verb, kwargs, req_id = _recv_msg(self.request)
+                if verb == "__close__":
+                    return
+                # at-most-once execution: a client retry after a dropped
+                # reply must not re-apply non-idempotent verbs (grad sends,
+                # barriers) — replay the cached response instead
+                with server.dedup_lock:
+                    if req_id in server.dedup:
+                        result = server.dedup[req_id]
+                    else:
+                        result = None
+                if result is None:
+                    result = service.handle(verb, **kwargs)
+                    with server.dedup_lock:
+                        server.dedup[req_id] = result
+                        while len(server.dedup) > 4096:
+                            server.dedup.popitem(last=False)
+                _send_msg(self.request, result)
+        except (ConnectionError, EOFError):
+            return
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        import collections
+
+        self.dedup = collections.OrderedDict()  # req_id -> response
+        self.dedup_lock = threading.Lock()
+
+
+class VarServer:
+    """Threaded TCP server dispatching verbs to a service object
+    (AsyncGRPCServer + RequestHandler analog, request_handler.h:131)."""
+
+    def __init__(self, endpoint, service):
+        host, port = endpoint.rsplit(":", 1)
+        self._server = _Server((host or "127.0.0.1", int(port)), _Handler)
+        self._server.service = service
+        self._thread = None
+        self.endpoint = "%s:%d" % self._server.server_address
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def wait(self):
+        if self._thread is not None:
+            self._thread.join()
+
+    def shutdown(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class RPCClient:
+    """Blocking client with one cached connection per endpoint
+    (GRPCClient analog; retries replace FLAGS_max_retry)."""
+
+    _lock = threading.Lock()
+    _instances = {}
+
+    def __init__(self, endpoint, timeout=600.0, retries=30, retry_wait=0.3):
+        import uuid
+
+        self.endpoint = endpoint
+        self.timeout = timeout
+        self.retries = retries
+        self.retry_wait = retry_wait
+        self._sock = None
+        self._io_lock = threading.Lock()
+        self._token = uuid.uuid4().hex
+        self._req_counter = 0
+
+    @classmethod
+    def get(cls, endpoint):
+        with cls._lock:
+            cli = cls._instances.get(endpoint)
+            if cli is None:
+                cli = cls(endpoint)
+                cls._instances[endpoint] = cli
+            return cli
+
+    @classmethod
+    def reset_all(cls):
+        with cls._lock:
+            for cli in cls._instances.values():
+                cli.close()
+            cls._instances.clear()
+
+    def _connect(self):
+        import time
+
+        host, port = self.endpoint.rsplit(":", 1)
+        last = None
+        for _ in range(self.retries):
+            try:
+                sock = socket.create_connection(
+                    (host, int(port)), timeout=self.timeout
+                )
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                return sock
+            except OSError as e:
+                last = e
+                time.sleep(self.retry_wait)
+        raise ConnectionError(
+            "cannot reach %s after %d tries: %s"
+            % (self.endpoint, self.retries, last)
+        )
+
+    def call(self, verb, **kwargs):
+        with self._io_lock:
+            self._req_counter += 1
+            req_id = "%s:%d" % (self._token, self._req_counter)
+            if self._sock is None:
+                self._sock = self._connect()
+            try:
+                _send_msg(self._sock, (verb, kwargs, req_id))
+                result = _recv_msg(self._sock)
+            except (ConnectionError, OSError):
+                # reconnect + replay; the server's dedup cache makes the
+                # retry at-most-once even if the first copy was applied
+                self._sock = self._connect()
+                _send_msg(self._sock, (verb, kwargs, req_id))
+                result = _recv_msg(self._sock)
+        if isinstance(result, dict) and result.get("__error__"):
+            raise RuntimeError(
+                "remote error from %s: %s" % (self.endpoint, result["__error__"])
+            )
+        return result
+
+    # ---- SendRecvService verbs ------------------------------------------
+    def send_var(self, name, value, trainer_id=0):
+        return self.call("send", name=name, value=value, trainer_id=trainer_id)
+
+    def get_var(self, name, trainer_id=0):
+        return self.call("get", name=name, trainer_id=trainer_id)
+
+    def prefetch(self, table, ids, trainer_id=0):
+        return self.call("prefetch", table=table, ids=ids, trainer_id=trainer_id)
+
+    def send_sparse(self, table, ids, rows, trainer_id=0):
+        return self.call(
+            "send_sparse", table=table, ids=ids, rows=rows, trainer_id=trainer_id
+        )
+
+    def barrier(self, kind, trainer_id=0):
+        return self.call("barrier", kind=kind, trainer_id=trainer_id)
+
+    def complete(self, trainer_id=0):
+        return self.call("complete", trainer_id=trainer_id)
+
+    def close(self):
+        with self._io_lock:
+            if self._sock is not None:
+                try:
+                    _send_msg(self._sock, ("__close__", {}, ""))
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None

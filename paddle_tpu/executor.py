@@ -71,6 +71,15 @@ class Executor:
             program = framework.default_main_program()
         if scope is None:
             scope = global_scope()
+        # pserver program: block on the listen_and_serv service loop
+        # (ListenAndServOp::RunImpl analog) instead of compiling
+        if any(
+            op.type == "listen_and_serv" for op in program.global_block().ops
+        ):
+            from .distributed.ps_server import run_pserver
+
+            run_pserver(program, scope, self)
+            return []
         feed = feed or {}
         fetch_list = fetch_list or []
         fetch_names = [
@@ -120,8 +129,11 @@ class Executor:
         return list(fetches)
 
     def close(self):
-        """Release cached executables (Executor::Close analog; the pserver
-        SendComplete goes through the distributed runtime when present)."""
+        """Release cached executables and notify pservers this trainer is
+        done (Executor::Close -> SendComplete analog, executor.h:91)."""
+        from . import distributed
+
+        distributed.send_complete_all()
         self._cache.clear()
         self._closed = True
 

@@ -220,6 +220,14 @@ class Operator:
                     v.name if isinstance(v, Variable) else v for v in _as_list(vars_)
                 ]
         self.attrs = dict(attrs) if attrs else {}
+        # OpRole tagging (op_proto_maker.h:26-38 analog): the transpilers
+        # (distribute/memory/inference) key off these to classify ops.
+        if "op_role" not in self.attrs and block is not None:
+            prog = block.program
+            self.attrs["op_role"] = getattr(prog, "op_role", "forward")
+            rv = getattr(prog, "_op_role_var", None)
+            if rv:
+                self.attrs["op_role_var"] = list(rv)
 
     def input_arg_names(self):
         return [n for names in self.inputs.values() for n in names if n]
@@ -388,7 +396,29 @@ class Program:
         self._version = 0
         self._is_test = False
         self.op_role = "forward"
+        self._op_role_var = []
         self._appending_grad_times = 0
+
+    @contextlib.contextmanager
+    def _op_role_guard(self, role, role_var=None):
+        """Tag ops appended inside with an OpRole (and optional
+        op_role_var [param, grad] pair) — the op_proto_maker OpRole
+        mechanism the reference's transpilers are driven by."""
+        prev_role, prev_var = self.op_role, self._op_role_var
+        self.op_role = role
+        self._op_role_var = list(role_var or [])
+        try:
+            yield
+        finally:
+            self.op_role, self._op_role_var = prev_role, prev_var
+
+    def _optimized_guard(self, param_and_grad):
+        names = [
+            p.name if isinstance(p, Variable) else p
+            for p in param_and_grad
+            if p is not None
+        ]
+        return self._op_role_guard("optimize", names)
 
     # version is used as the executor's compile-cache key component
     def _bump_version(self):

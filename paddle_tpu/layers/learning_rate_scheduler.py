@@ -5,12 +5,27 @@ here — the counter is a persistable scalar incremented each step inside the
 compiled program, so schedules compile into the training executable.
 """
 
+import functools
 import math
 
 from .. import framework
 from ..initializer import Constant
 from ..layer_helper import LayerHelper
 from . import tensor, nn, ops
+
+
+def _lrsched(fn):
+    """Tag every op a schedule emits with the LRSched role
+    (op_proto_maker.h OpRole::kLRSched analog) so the distribute
+    transpiler can move the decay chain onto the pservers."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        prog = framework.default_main_program()
+        with prog._op_role_guard("lrsched"):
+            return fn(*args, **kwargs)
+
+    return wrapper
 
 __all__ = [
     "exponential_decay",
@@ -42,6 +57,7 @@ def _decay_step_counter(begin=0):
     return counter
 
 
+@_lrsched
 def noam_decay(d_model, warmup_steps):
     step = _decay_step_counter(1)
     a = step ** -0.5
@@ -49,6 +65,7 @@ def noam_decay(d_model, warmup_steps):
     return (d_model ** -0.5) * nn.elementwise_min(a, b)
 
 
+@_lrsched
 def exponential_decay(learning_rate, decay_steps, decay_rate, staircase=False):
     step = _decay_step_counter()
     div = step / float(decay_steps)
@@ -57,6 +74,7 @@ def exponential_decay(learning_rate, decay_steps, decay_rate, staircase=False):
     return learning_rate * (decay_rate ** div)
 
 
+@_lrsched
 def natural_exp_decay(learning_rate, decay_steps, decay_rate, staircase=False):
     step = _decay_step_counter()
     div = step / float(decay_steps)
@@ -65,6 +83,7 @@ def natural_exp_decay(learning_rate, decay_steps, decay_rate, staircase=False):
     return learning_rate * ops.exp(-1 * decay_rate * div)
 
 
+@_lrsched
 def inverse_time_decay(learning_rate, decay_steps, decay_rate, staircase=False):
     step = _decay_step_counter()
     div = step / float(decay_steps)
@@ -73,6 +92,7 @@ def inverse_time_decay(learning_rate, decay_steps, decay_rate, staircase=False):
     return learning_rate / (1 + decay_rate * div)
 
 
+@_lrsched
 def polynomial_decay(
     learning_rate, decay_steps, end_learning_rate=0.0001, power=1.0, cycle=False
 ):
@@ -97,6 +117,7 @@ def _step_lt(step, bound):
     return tensor.cast(control_flow.less_than(step, b), "float32")
 
 
+@_lrsched
 def piecewise_decay(boundaries, values):
     """lr = values[i] for step in [boundaries[i-1], boundaries[i]) —
     computed branch-free as a sum of exact interval masks."""
@@ -114,12 +135,14 @@ def piecewise_decay(boundaries, values):
     return lr
 
 
+@_lrsched
 def cosine_decay(learning_rate, step_each_epoch, epochs):
     step = _decay_step_counter()
     epoch = ops.floor(step / step_each_epoch)
     return 0.5 * learning_rate * (ops.cos(epoch * (math.pi / epochs)) + 1)
 
 
+@_lrsched
 def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
     step = _decay_step_counter()
     linear = start_lr + (end_lr - start_lr) * (step / float(warmup_steps))

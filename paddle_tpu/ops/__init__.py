@@ -19,6 +19,7 @@ from . import search_ops  # noqa: F401
 from . import quant_ops  # noqa: F401
 from . import metric_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
+from . import dist_ops  # noqa: F401
 
 get_op = registry.get_op
 is_registered = registry.is_registered

@@ -1,0 +1,169 @@
+"""Distributed op lowerings: send / recv / barriers / prefetch.
+
+The reference implements these as side-effecting runtime ops over gRPC
+(operators/distributed_ops/send_op.cc, recv_op.cc, send_barrier_op.cc,
+fetch_barrier_op.cc, prefetch_op.cc).  Here the whole training step is one
+XLA executable, so the DCN control plane rides **ordered host callbacks**
+(`jax.experimental.io_callback(ordered=True)`): XLA sequences them with the
+surrounding compute, giving exactly the reference's op-order semantics
+(grads computed → send → send_barrier → recv updated params →
+fetch_barrier) without leaving the compiled step.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import io_callback
+
+from .common import jdt
+from ..core.registry import register
+
+
+def _client(ep, trainer_id=None):
+    from .. import distributed
+    from ..distributed.rpc import RPCClient
+
+    if trainer_id is not None:
+        distributed._note_endpoint(ep, trainer_id)
+    return RPCClient.get(ep)
+
+
+@register("send", side_effect=True)
+def _send(ctx, ins, attrs):
+    """Split X flat into `sections`, ship block i to epmap[i] as
+    block_names[i].  One send op per original grad var."""
+    sections = [int(s) for s in attrs["sections"]]
+    epmap = list(attrs["epmap"])
+    block_names = list(attrs["block_names"])
+    trainer_id = int(attrs.get("trainer_id", 0))
+
+    def host_send(x):
+        flat = np.asarray(x).reshape(-1)
+        off = 0
+        for sec, ep, bname in zip(sections, epmap, block_names):
+            _client(ep, trainer_id).send_var(bname, flat[off : off + sec], trainer_id)
+            off += sec
+        return np.int32(0)
+
+    tok = io_callback(
+        host_send, jax.ShapeDtypeStruct((), jnp.int32), ins["X"][0], ordered=True
+    )
+    return {"Out": [tok]}
+
+
+@register("send_barrier", side_effect=True)
+def _send_barrier(ctx, ins, attrs):
+    endpoints = list(attrs["endpoints"])
+    trainer_id = int(attrs.get("trainer_id", 0))
+
+    def host_barrier():
+        for ep in endpoints:
+            _client(ep).barrier("send", trainer_id)
+        return np.int32(0)
+
+    tok = io_callback(host_barrier, jax.ShapeDtypeStruct((), jnp.int32), ordered=True)
+    return {"Out": [tok]}
+
+
+@register("recv", side_effect=True)
+def _recv(ctx, ins, attrs):
+    """Gather param blocks from epmap, concat + reshape to the param."""
+    sections = [int(s) for s in attrs["sections"]]
+    epmap = list(attrs["epmap"])
+    block_names = list(attrs["block_names"])
+    shape = [int(s) for s in attrs["shape"]]
+    dtype = jdt(attrs.get("dtype", "float32"))
+    trainer_id = int(attrs.get("trainer_id", 0))
+
+    def host_recv():
+        parts = [
+            np.asarray(_client(ep).get_var(bname, trainer_id)).reshape(-1)
+            for ep, bname in zip(epmap, block_names)
+        ]
+        out = np.concatenate(parts).reshape(shape)
+        return out.astype(np.dtype(dtype.name if hasattr(dtype, "name") else dtype))
+
+    out = io_callback(
+        host_recv, jax.ShapeDtypeStruct(tuple(shape), dtype), ordered=True
+    )
+    return {"Out": [out]}
+
+
+@register("fetch_barrier", side_effect=True)
+def _fetch_barrier(ctx, ins, attrs):
+    endpoints = list(attrs["endpoints"])
+    trainer_id = int(attrs.get("trainer_id", 0))
+
+    def host_barrier():
+        for ep in endpoints:
+            _client(ep).barrier("fetch", trainer_id)
+        return np.int32(0)
+
+    tok = io_callback(host_barrier, jax.ShapeDtypeStruct((), jnp.int32), ordered=True)
+    return {"Out": [tok]}
+
+
+@register("prefetch", no_grad_inputs={"Ids"}, side_effect=True)
+def _prefetch(ctx, ins, attrs):
+    """Distributed embedding lookup (prefetch_op / split_ids / merge_ids
+    analog): route each id to server id%nservers, fetch rows, merge back
+    in input order.  Fixed id-array shape keeps XLA happy; routing is
+    host-side."""
+    ids = ins["Ids"][0]
+    epmap = list(attrs["epmap"])
+    table_names = list(attrs["table_names"])
+    emb_dim = int(attrs["emb_dim"])
+    trainer_id = int(attrs.get("trainer_id", 0))
+    n = len(epmap)
+
+    id_shape = tuple(ids.shape)
+    out_shape = id_shape + (emb_dim,)
+
+    def host_prefetch(ids_v):
+        flat = np.asarray(ids_v).reshape(-1).astype(np.int64)
+        out = np.zeros((flat.size, emb_dim), dtype=np.float32)
+        for s in range(n):
+            mask = (flat % n) == s
+            if not mask.any():
+                continue
+            local = flat[mask] // n
+            rows = np.asarray(
+                _client(epmap[s]).prefetch(table_names[s], local, trainer_id)
+            )
+            out[mask] = rows
+        return out.reshape(out_shape)
+
+    out = io_callback(
+        host_prefetch,
+        jax.ShapeDtypeStruct(out_shape, jnp.float32),
+        ids,
+        ordered=True,
+    )
+    return {"Out": [out]}
+
+
+@register("send_sparse", no_grad_inputs={"Ids"}, side_effect=True)
+def _send_sparse(ctx, ins, attrs):
+    """Push sparse embedding grads (SelectedRows semantics): rows keyed by
+    Ids go back to their owning server for an immediate sparse update."""
+    ids, grad = ins["Ids"][0], ins["Grad"][0]
+    epmap = list(attrs["epmap"])
+    table_names = list(attrs["table_names"])
+    trainer_id = int(attrs.get("trainer_id", 0))
+    n = len(epmap)
+
+    def host_push(ids_v, grad_v):
+        flat = np.asarray(ids_v).reshape(-1).astype(np.int64)
+        g = np.asarray(grad_v).reshape(flat.size, -1)
+        for s in range(n):
+            mask = (flat % n) == s
+            if not mask.any():
+                continue
+            local = flat[mask] // n
+            _client(epmap[s]).send_sparse(table_names[s], local, g[mask], trainer_id)
+        return np.int32(0)
+
+    tok = io_callback(
+        host_push, jax.ShapeDtypeStruct((), jnp.int32), ids, grad, ordered=True
+    )
+    return {"Out": [tok]}

@@ -57,6 +57,8 @@ def _conv2d(ctx, ins, attrs):
 def _depthwise_conv2d(ctx, ins, attrs):
     x, w = ins["Input"][0], ins["Filter"][0]
     out = _conv2d_impl(x, w, attrs, groups=x.shape[1])
+    if ins.get("Bias"):
+        out = out + ins["Bias"][0].reshape(1, -1, 1, 1)
     return {"Output": [out]}
 
 

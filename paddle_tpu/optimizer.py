@@ -128,7 +128,10 @@ class Optimizer:
             if param_and_grad[1] is None:
                 continue
             if param_and_grad[0].trainable:
-                optimize_ops.append(self._append_optimize_op(block, param_and_grad))
+                with program._optimized_guard(list(param_and_grad)):
+                    optimize_ops.append(
+                        self._append_optimize_op(block, param_and_grad)
+                    )
         self._finish_update(block, parameters_and_grads)
         return optimize_ops
 

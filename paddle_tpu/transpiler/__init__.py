@@ -1,0 +1,21 @@
+"""Program -> Program transpilers (python/paddle/fluid/transpiler analog)."""
+
+from .distribute_transpiler import (
+    DistributeTranspiler,
+    DistributeTranspilerConfig,
+    slice_variable,
+)
+from .ps_dispatcher import HashName, RoundRobin
+from .memory_optimization_transpiler import memory_optimize, release_memory
+from .inference_transpiler import InferenceTranspiler
+
+__all__ = [
+    "DistributeTranspiler",
+    "DistributeTranspilerConfig",
+    "slice_variable",
+    "HashName",
+    "RoundRobin",
+    "memory_optimize",
+    "release_memory",
+    "InferenceTranspiler",
+]

@@ -1,0 +1,428 @@
+"""DistributeTranspiler: Program -> (trainer program, pserver programs).
+
+API-compatible re-design of the reference transpiler
+(python/paddle/fluid/transpiler/distribute_transpiler.py:239 transpile,
+:473 get_trainer_program, :592 get_pserver_program, :853 get_startup_program)
+for the TPU execution model:
+
+* Parameters/grads are sliced into flat blocks (slice_variable :80 analog)
+  and placed on pservers by a PSDispatcher (RoundRobin default).
+* The trainer program keeps forward+backward+clip/regularization, drops the
+  optimizer ops, and gains `send` / `send_barrier` / `recv` /
+  `fetch_barrier` ops — which lower to ordered host callbacks inside the
+  one compiled XLA step (see ops/dist_ops.py) instead of gRPC runtime ops.
+* Each pserver program is a single `listen_and_serv` op whose "optimize
+  sub-blocks" are serialized shard Programs (one per param block) that the
+  pserver compiles once and applies per round (see distributed/ps_server).
+* Grads are pre-scaled by 1/num_trainers on the trainer so that the
+  pserver's per-round sum equals the global-batch mean gradient: a sync
+  N-trainer run matches the equivalent local run exactly.
+* "nccl2" mode (collective DP over DCN, gen_nccl_id_op.cc analog) needs no
+  program rewrite here: transpile records the job layout and
+  distributed.init_collective / parallel.DistributedExecutor run the same
+  program under pjit with jax.distributed-initialized hosts.
+"""
+
+import math
+
+from .. import framework
+from ..framework import Program
+from .ps_dispatcher import RoundRobin, PSDispatcher
+
+
+class DistributeTranspilerConfig:
+    """Knob surface of the reference config (distribute_transpiler.py:126)."""
+
+    slice_var_up = True
+    split_method = RoundRobin
+    min_block_size = 8192
+    mode = "pserver"  # "pserver" | "nccl2"
+    print_log = False
+
+
+class VarBlock:
+    def __init__(self, varname, idx, begin, end):
+        self.varname = varname
+        self.idx = idx
+        self.begin = begin  # flat element offset
+        self.end = end
+
+    @property
+    def size(self):
+        return self.end - self.begin
+
+    @property
+    def block_name(self):
+        return "%s.block%d" % (self.varname, self.idx)
+
+
+def slice_variable(var_numels, slice_count, min_block_size=8192):
+    """Split each var's flat numel into at most `slice_count` blocks of at
+    least `min_block_size` elements (reference slice_variable :80)."""
+    out = {}
+    for name, numel in var_numels:
+        max_blocks = max(1, int(math.ceil(numel / float(min_block_size))))
+        split_count = max(1, min(slice_count, max_blocks))
+        block_size = int(math.ceil(numel / float(split_count)))
+        blocks = []
+        off = 0
+        idx = 0
+        while off < numel:
+            end = min(off + block_size, numel)
+            blocks.append(VarBlock(name, idx, off, end))
+            off = end
+            idx += 1
+        out[name] = blocks
+    return out
+
+
+class DistributeTranspiler:
+    def __init__(self, config=None):
+        self.config = config or DistributeTranspilerConfig()
+        if isinstance(self.config.split_method, type):
+            assert issubclass(self.config.split_method, PSDispatcher)
+
+    # ------------------------------------------------------------------
+    def transpile(
+        self,
+        trainer_id,
+        program=None,
+        pservers="127.0.0.1:6174",
+        trainers=1,
+        sync_mode=True,
+        startup_program=None,
+        current_endpoint="",
+    ):
+        self.trainer_id = trainer_id
+        self.trainer_num = trainers
+        self.sync_mode = sync_mode
+        self.origin_program = program or framework.default_main_program()
+        self.startup_program = (
+            startup_program or framework.default_startup_program()
+        )
+        if isinstance(pservers, str):
+            self.pserver_endpoints = [
+                ep.strip() for ep in pservers.split(",") if ep.strip()
+            ]
+        else:
+            self.pserver_endpoints = list(pservers)
+
+        if self.config.mode == "nccl2":
+            # collective mode: program unchanged; record layout for
+            # distributed.init_collective (gen_nccl_id handshake analog is
+            # jax.distributed.initialize over DCN)
+            self.nccl2_trainer_endpoints = self.pserver_endpoints
+            return
+
+        self._transpile_pserver_mode()
+
+    # ------------------------------------------------------------------
+    def _params_grads_from_roles(self):
+        """(param, grad) name pairs off the optimize ops' op_role_var tags
+        — the OpRole mechanism the reference transpiler is driven by."""
+        pairs = []
+        seen = set()
+        for op in self.origin_program.global_block().ops:
+            if op.attrs.get("op_role") != "optimize":
+                continue
+            rv = op.attrs.get("op_role_var")
+            if not rv or len(rv) < 2:
+                continue
+            if rv[0] not in seen:
+                seen.add(rv[0])
+                pairs.append((rv[0], rv[1]))
+        return pairs
+
+    def _transpile_pserver_mode(self):
+        block = self.origin_program.global_block()
+        eps = self.pserver_endpoints
+        self.params_grads = self._params_grads_from_roles()
+        if not self.params_grads:
+            raise ValueError(
+                "no optimizer ops found — call optimizer.minimize(loss) "
+                "before transpile()"
+            )
+
+        # ---- partition ------------------------------------------------
+        numels = []
+        self._param_vars = {}
+        for p, g in self.params_grads:
+            v = block._find_var_recursive(p)
+            self._param_vars[p] = v
+            numel = 1
+            for d in v.shape:
+                numel *= int(d)
+            numels.append((p, numel))
+        slice_count = len(eps) if self.config.slice_var_up else 1
+        self.param_blocks = slice_variable(
+            numels, slice_count, self.config.min_block_size
+        )
+
+        # dispatch grad blocks -> endpoints; param blocks follow grads
+        dispatcher = self.config.split_method(eps)
+        self.block_eps = {}  # (param, idx) -> endpoint
+        for p, g in self.params_grads:
+            blocks = self.param_blocks[p]
+            for blk, ep in zip(blocks, dispatcher.dispatch(blocks)):
+                self.block_eps[(p, blk.idx)] = ep
+
+        # ---- split optimizer ops off the trainer ----------------------
+        self.optimize_ops = [
+            op for op in block.ops if op.attrs.get("op_role") == "optimize"
+        ]
+        self.lr_ops = [
+            op for op in block.ops if op.attrs.get("op_role") == "lrsched"
+        ]
+        drop = set(id(op) for op in self.optimize_ops + self.lr_ops)
+        block.ops = [op for op in block.ops if id(op) not in drop]
+
+        # ---- append trainer-side rpc ops ------------------------------
+        with self.origin_program._op_role_guard("rpc"):
+            for p, g in self.params_grads:
+                blocks = self.param_blocks[p]
+                sections = [b.size for b in blocks]
+                epmap = [self.block_eps[(p, b.idx)] for b in blocks]
+                gblocks = ["%s.block%d" % (g, b.idx) for b in blocks]
+                scaled = block.create_var(
+                    name=g + "@DIST_SCALED",
+                    shape=block._find_var_recursive(g).shape
+                    if block._find_var_recursive(g)
+                    else self._param_vars[p].shape,
+                    dtype=self._param_vars[p].dtype,
+                )
+                block.append_op(
+                    "scale",
+                    inputs={"X": [g]},
+                    outputs={"Out": [scaled.name]},
+                    attrs={"scale": 1.0 / float(self.trainer_num)},
+                )
+                dummy = block.create_var(name=g + "@SEND_TOKEN", shape=[1])
+                block.append_op(
+                    "send",
+                    inputs={"X": [scaled.name]},
+                    outputs={"Out": [dummy.name]},
+                    attrs={
+                        "sections": sections,
+                        "epmap": epmap,
+                        "block_names": gblocks,
+                        "trainer_id": self.trainer_id,
+                    },
+                )
+            if self.sync_mode:
+                tok = block.create_var(name="@SEND_BARRIER_TOKEN", shape=[1])
+                block.append_op(
+                    "send_barrier",
+                    outputs={"Out": [tok.name]},
+                    attrs={"endpoints": eps, "trainer_id": self.trainer_id},
+                )
+            for p, g in self.params_grads:
+                blocks = self.param_blocks[p]
+                pv = self._param_vars[p]
+                block.append_op(
+                    "recv",
+                    outputs={"Out": [p]},
+                    attrs={
+                        "sections": [b.size for b in blocks],
+                        "epmap": [self.block_eps[(p, b.idx)] for b in blocks],
+                        "block_names": [b.block_name for b in blocks],
+                        "shape": [int(d) for d in pv.shape],
+                        "dtype": str(pv.dtype),
+                        "trainer_id": self.trainer_id,
+                    },
+                )
+            if self.sync_mode:
+                tok = block.create_var(name="@FETCH_BARRIER_TOKEN", shape=[1])
+                block.append_op(
+                    "fetch_barrier",
+                    outputs={"Out": [tok.name]},
+                    attrs={"endpoints": eps, "trainer_id": self.trainer_id},
+                )
+        self.origin_program._bump_version()
+
+    # ------------------------------------------------------------------
+    def get_trainer_program(self):
+        return self.origin_program
+
+    # ------------------------------------------------------------------
+    def _shard_program_for(self, p, g, blk, opt_ops):
+        """Build the per-block optimizer shard Program from ALL optimize
+        ops tagged for this param (per-param-lr `scale` helpers included) —
+        the reference's per-shard optimize sub-block
+        (get_pserver_program :592).  Var classification:
+          * param / grad            -> 1-D block slices
+          * full-numel accumulators -> sliced like the param (moments)
+          * mutated small state     -> per-block private copies (beta pows:
+                                       must advance once per shard, not once
+                                       per co-located shard)
+          * temps produced in-group -> local non-persistable vars
+          * everything else         -> shared whole vars (learning rate)
+        """
+        prog = Program()
+        b = prog.global_block()
+        pnumel = blk.end - blk.begin
+        pblock_name = blk.block_name
+        gblock_name = "%s.block%d" % (g, blk.idx)
+        pdtype = self._param_vars[p].dtype
+        full_numel = 1
+        for d in self._param_vars[p].shape:
+            full_numel *= int(d)
+
+        src_block = self.origin_program.global_block()
+        produced = set()
+        for op in opt_ops:
+            produced.update(op.output_arg_names())
+
+        rename = {p: pblock_name, g: gblock_name}
+        slice_srcs = {pblock_name: (p, blk.begin, blk.end, pdtype)}
+        whole = []
+        local_tmp = []
+
+        def classify(n):
+            if n in rename:
+                return
+            v = src_block._find_var_recursive(n)
+            numel = 1
+            for d in (v.shape if v is not None else [1]):
+                numel *= int(d)
+            dtype = v.dtype if v is not None else "float32"
+            if v is not None and numel == full_numel and full_numel > 1:
+                bn = "%s.block%d" % (n, blk.idx)
+                rename[n] = bn
+                slice_srcs[bn] = (n, blk.begin, blk.end, dtype)
+            elif n in produced and (v is None or v.persistable):
+                # mutated persistable state (beta pow accumulators)
+                bn = "%s.block%d" % (n, blk.idx)
+                rename[n] = bn
+                slice_srcs[bn] = (n, 0, numel, dtype)
+            elif n in produced:
+                rename[n] = n
+                local_tmp.append((n, v))
+            else:
+                rename[n] = n
+                whole.append(n)
+
+        for op in opt_ops:
+            for names in list(op.inputs.values()) + list(op.outputs.values()):
+                for n in names:
+                    classify(n)
+
+        # vars
+        b.create_var(name=gblock_name, shape=[pnumel], dtype=pdtype)
+        for new, (src, s, e, dtype) in slice_srcs.items():
+            b.create_var(name=new, shape=[e - s], dtype=dtype, persistable=True)
+        for n, v in local_tmp:
+            b.create_var(
+                name=n,
+                shape=[int(d) for d in (v.shape if v is not None else [1])],
+                dtype=(v.dtype if v is not None else "float32"),
+            )
+        for n in whole:
+            v = src_block._find_var_recursive(n)
+            b.create_var(
+                name=n,
+                shape=[int(d) for d in (v.shape if v is not None else [1])],
+                dtype=(v.dtype if v is not None else "float32"),
+                persistable=True,
+            )
+
+        for op in opt_ops:
+            new_op = framework.Operator(b, op.type, None, None, dict(op.attrs))
+            new_op.inputs = {
+                slot: [rename[n] for n in names]
+                for slot, names in op.inputs.items()
+            }
+            new_op.outputs = {
+                slot: [rename[n] for n in names]
+                for slot, names in op.outputs.items()
+            }
+            b.ops.append(new_op)
+        return prog, gblock_name, slice_srcs, whole
+
+    def get_pserver_program(self, endpoint):
+        """Program with one listen_and_serv op for this endpoint."""
+        opt_by_param = {}
+        for op in self.optimize_ops:
+            rv = op.attrs.get("op_role_var")
+            if rv:
+                opt_by_param.setdefault(rv[0], []).append(op)
+
+        shard_programs = []
+        grad_to_shard = {}
+        slice_plan = []
+        whole_vars = set()
+        for p, g in self.params_grads:
+            for blk in self.param_blocks[p]:
+                if self.block_eps[(p, blk.idx)] != endpoint:
+                    continue
+                ops = opt_by_param.get(p, [])
+                assert len(ops) >= 1, "no optimizer op for param %s" % p
+                prog, gblock_name, slice_srcs, whole = self._shard_program_for(
+                    p, g, blk, ops
+                )
+                grad_to_shard[gblock_name] = len(shard_programs)
+                shard_programs.append(prog)
+                for new, (src, s, e, _dt) in slice_srcs.items():
+                    slice_plan.append([src, new, s, e])
+                whole_vars.update(whole)
+
+        # lr decay ops run once per round on the pserver; their outputs are
+        # marked persistable inside lr_program so the computed lr lands in
+        # the server scope for the shard programs to read
+        lr_program = None
+        lr_produced = set()
+        if self.lr_ops:
+            lr_program = Program()
+            lb = lr_program.global_block()
+            src_block = self.origin_program.global_block()
+            for op in self.lr_ops:
+                lr_produced.update(op.output_arg_names())
+            names = set()
+            for op in self.lr_ops:
+                names.update(op.input_arg_names())
+                names.update(op.output_arg_names())
+            for n in names:
+                v = src_block._find_var_recursive(n)
+                lb.create_var(
+                    name=n,
+                    shape=[int(d) for d in (v.shape if v is not None else [1])],
+                    dtype=(v.dtype if v is not None else "float32"),
+                    persistable=True,
+                )
+                # only pre-existing persistable inputs (step counters) need
+                # the startup program to create them
+                if n not in lr_produced and v is not None and v.persistable:
+                    whole_vars.add(n)
+            for op in self.lr_ops:
+                new_op = framework.Operator(lb, op.type, None, None, dict(op.attrs))
+                new_op.inputs = {k: list(v) for k, v in op.inputs.items()}
+                new_op.outputs = {k: list(v) for k, v in op.outputs.items()}
+                lb.ops.append(new_op)
+        # vars the lr program computes are produced at runtime, not startup
+        whole_vars -= lr_produced
+
+        prog = Program()
+        b = prog.global_block()
+        b.append_op(
+            "listen_and_serv",
+            attrs={
+                "endpoint": endpoint,
+                "trainers": self.trainer_num,
+                "sync_mode": bool(self.sync_mode),
+                "optimize_programs": [sp.to_json() for sp in shard_programs],
+                "lr_program": lr_program.to_json() if lr_program else None,
+                "grad_to_shard": grad_to_shard,
+                "slice_plan": slice_plan,
+                "whole_vars": sorted(whole_vars),
+                "sparse_table_names": [],
+            },
+        )
+        return prog
+
+    # ------------------------------------------------------------------
+    def get_startup_program(self, endpoint=None, pserver_program=None):
+        """Pserver startup: run the ORIGINAL startup program (full shapes,
+        same program structure + seed == bit-identical init with the
+        trainers), then listen_and_serv slices this endpoint's blocks out
+        of the resulting scope (slice_plan).  Reference analog:
+        get_startup_program :853 re-runs initializers per shard."""
+        return self.startup_program.clone()

@@ -1,0 +1,162 @@
+"""Inference-time program rewrites
+(transpiler/inference_transpiler.py:24 analog).
+
+The reference folds conv+bn / conv+relu at the Python program level before
+handing to the executor.  XLA already fuses elementwise chains into the
+conv, so the transforms that still pay here are the *algebraic* ones:
+
+* fold batch_norm (inference form) into a preceding conv2d / fc / mul by
+  rewriting the weights and bias in the scope (:70-300 analog);
+* drop dropout ops (is_test identity) and other train-only ops.
+"""
+
+import numpy as np
+
+
+class InferenceTranspiler:
+    def transpile(self, program, place=None, scope=None):
+        from ..executor import global_scope
+
+        scope = scope if scope is not None else global_scope()
+        self._fold_batch_norm(program, scope)
+        self._drop_train_ops(program)
+        program._is_test = True
+        program._bump_version()
+        return program
+
+    # ------------------------------------------------------------------
+    def _drop_train_ops(self, program):
+        block = program.global_block()
+        consumers = self._consumer_count(block)
+        new_ops = []
+        alias = {}
+        for op in block.ops:
+            # rewrite inputs through accumulated aliases first
+            for slot, names in op.inputs.items():
+                op.inputs[slot] = [alias.get(n, n) for n in names]
+            if op.type == "dropout":
+                out, x = op.outputs["Out"][0], op.inputs["X"][0]
+                impl = op.attrs.get("dropout_implementation", "downgrade_in_infer")
+                if impl != "upscale_in_train":
+                    # inference semantics = x * (1-p): keep as a scale op
+                    # (which XLA fuses away) instead of an RNG mask
+                    from .. import framework as _fw
+
+                    sc = _fw.Operator(
+                        block,
+                        "scale",
+                        None,
+                        None,
+                        {"scale": 1.0 - float(op.attrs.get("dropout_prob", 0.5))},
+                    )
+                    sc.inputs = {"X": [x]}
+                    sc.outputs = {"Out": [out]}
+                    new_ops.append(sc)
+                    continue
+                if consumers.get(x, 0) == 1 and new_ops:
+                    # sole consumer: make the producer write the dropout's
+                    # output name so fetches of `out` keep working
+                    for prev in reversed(new_ops):
+                        renamed = False
+                        for slot, names in prev.outputs.items():
+                            if x in names:
+                                prev.outputs[slot] = [
+                                    out if n == x else n for n in names
+                                ]
+                                renamed = True
+                        if renamed:
+                            break
+                    else:
+                        alias[out] = x
+                else:
+                    alias[out] = x
+                continue
+            new_ops.append(op)
+        block.ops = new_ops
+
+    # ------------------------------------------------------------------
+    def _producer_map(self, block):
+        prod = {}
+        for i, op in enumerate(block.ops):
+            for n in op.output_arg_names():
+                prod[n] = i
+        return prod
+
+    def _consumer_count(self, block):
+        cnt = {}
+        for op in block.ops:
+            for n in op.input_arg_names():
+                cnt[n] = cnt.get(n, 0) + 1
+        return cnt
+
+    def _fold_batch_norm(self, program, scope):
+        """conv2d (no act) -> batch_norm  ==>  conv2d with W' = W*g/std,
+        b' = (b-mean)*g/std + beta."""
+        block = program.global_block()
+        prod = self._producer_map(block)
+        consumers = self._consumer_count(block)
+        drop = set()
+
+        for i, op in enumerate(block.ops):
+            if op.type != "batch_norm":
+                continue
+            x = op.inputs["X"][0]
+            if consumers.get(x, 0) != 1 or x not in prod:
+                continue
+            conv_idx = prod[x]
+            conv = block.ops[conv_idx]
+            bias_add = None
+            if conv.type == "elementwise_add":
+                # conv2d -> elementwise_add(bias) -> batch_norm chain (the
+                # layer helper emits bias as a separate op)
+                ax = conv.inputs["X"][0]
+                if consumers.get(ax, 0) != 1 or ax not in prod:
+                    continue
+                bias_add = conv
+                conv = block.ops[prod[ax]]
+            if conv.type not in ("conv2d", "depthwise_conv2d"):
+                continue
+
+            def val(slot):
+                v = scope.find_var(op.inputs[slot][0])
+                return None if v is None else np.array(v, dtype=np.float32)
+
+            gamma, beta = val("Scale"), val("Bias")
+            mean, var = val("Mean"), val("Variance")
+            if any(v is None for v in (gamma, beta, mean, var)):
+                continue
+            eps = float(op.attrs.get("epsilon", 1e-5))
+            std = np.sqrt(var + eps)
+
+            wname = conv.inputs["Filter"][0]
+            wvar = scope.find_var(wname)
+            if wvar is None:
+                continue
+            w = np.array(wvar, dtype=np.float32)
+            scope.set(wname, w * (gamma / std).reshape(-1, 1, 1, 1))
+
+            # fold the affine shift into the bias
+            if bias_add is not None:
+                bname = bias_add.inputs["Y"][0]
+                b = np.array(scope.find_var(bname), dtype=np.float32).reshape(-1)
+            elif conv.inputs.get("Bias"):
+                bname = conv.inputs["Bias"][0]
+                b = np.array(scope.find_var(bname), dtype=np.float32)
+            else:
+                bname = wname + "@BN_FOLDED_BIAS"
+                block.create_var(
+                    name=bname, shape=[int(w.shape[0])], dtype="float32",
+                    persistable=True,
+                )
+                b = np.zeros(w.shape[0], dtype=np.float32)
+                conv.inputs["Bias"] = [bname]
+            scope.set(bname, (b - mean) * gamma / std + beta)
+
+            # the op feeding bn now writes the bn output name directly
+            tail = bias_add if bias_add is not None else conv
+            out_slot = "Out" if tail.type == "elementwise_add" else "Output"
+            tail.outputs[out_slot] = [op.outputs["Y"][0]]
+            drop.add(i)
+
+        if drop:
+            block.ops = [op for j, op in enumerate(block.ops) if j not in drop]

@@ -1,0 +1,44 @@
+"""Parameter-block -> pserver placement policies
+(python/paddle/fluid/transpiler/ps_dispatcher.py analog)."""
+
+
+class PSDispatcher:
+    def __init__(self, pserver_endpoints):
+        self._eps = list(pserver_endpoints)
+        self._step = 0
+
+    @property
+    def eps(self):
+        return self._eps
+
+    def reset(self):
+        self._step = 0
+
+    def dispatch(self, varlist):
+        raise NotImplementedError
+
+
+class RoundRobin(PSDispatcher):
+    """Blocks go to endpoints cyclically (the reference default)."""
+
+    def dispatch(self, varlist):
+        out = []
+        for _ in varlist:
+            out.append(self._eps[self._step % len(self._eps)])
+            self._step += 1
+        return out
+
+
+class HashName(PSDispatcher):
+    """Stable name-hash placement — rerunning a job maps blocks to the
+    same servers (python-hash-free so it survives PYTHONHASHSEED)."""
+
+    @staticmethod
+    def _hash(s):
+        h = 5381
+        for ch in str(s):
+            h = ((h * 33) ^ ord(ch)) & 0xFFFFFFFF
+        return h
+
+    def dispatch(self, varlist):
+        return [self._eps[self._hash(v) % len(self._eps)] for v in varlist]

@@ -1,0 +1,124 @@
+"""Distributed-training runner: one process = one role (trainer/pserver).
+
+The model file of the reference's dist test harness (test_dist_base.py:34
+TestDistRunnerBase + dist_mnist.py): test_dist_train.py spawns this script
+as localhost subprocesses with the PADDLE_* env contract and compares
+trainer losses against a local run.
+
+Env contract (fluid_benchmark.py:63-100 analog):
+  PADDLE_TRAINING_ROLE = TRAINER | PSERVER | LOCAL
+  PADDLE_PSERVER_EPS   = "127.0.0.1:p1,127.0.0.1:p2"
+  PADDLE_CURRENT_ENDPOINT (pserver role)
+  PADDLE_TRAINERS, PADDLE_TRAINER_ID
+  DIST_SYNC_MODE = 1|0, DIST_STEPS, DIST_BATCH
+"""
+
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+SEED = 7
+
+
+def build_model():
+    x = layers.data("x", shape=[4])
+    y = layers.data("y", shape=[1])
+    h = layers.fc(x, size=8, act="relu")
+    # per-param lr exercises the optimize-role `scale` helper op path
+    pred = layers.fc(h, size=1, param_attr=fluid.ParamAttr(learning_rate=0.5))
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    if os.environ.get("DIST_OPTIMIZER", "sgd") == "adam_decay":
+        lr = layers.exponential_decay(0.05, decay_steps=2, decay_rate=0.9)
+        opt = fluid.optimizer.Adam(lr)
+    else:
+        opt = fluid.optimizer.SGD(0.1)
+    opt.minimize(loss)
+    return loss
+
+
+def gen_data(n=16):
+    rng = np.random.RandomState(3)
+    x = rng.rand(n, 4).astype("float32")
+    w = np.array([[1.0], [-2.0], [3.0], [0.5]], dtype=np.float32)
+    y = x @ w + 0.1 * rng.rand(n, 1).astype("float32")
+    return x, y
+
+
+def main():
+    role = os.environ.get("PADDLE_TRAINING_ROLE", "LOCAL")
+    eps = os.environ.get("PADDLE_PSERVER_EPS", "")
+    trainers = int(os.environ.get("PADDLE_TRAINERS", "1"))
+    trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    sync_mode = os.environ.get("DIST_SYNC_MODE", "1") == "1"
+    steps = int(os.environ.get("DIST_STEPS", "4"))
+    batch = int(os.environ.get("DIST_BATCH", "16"))
+
+    main_prog = fluid.default_main_program()
+    main_prog.random_seed = SEED
+    fluid.default_startup_program().random_seed = SEED
+    loss = build_model()
+    x, y = gen_data()
+
+    exe = fluid.Executor(fluid.CPUPlace())
+
+    if role == "LOCAL":
+        exe.run(fluid.default_startup_program())
+        losses = []
+        for _ in range(steps):
+            (lv,) = exe.run(feed={"x": x[:batch], "y": y[:batch]}, fetch_list=[loss])
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
+        print("LOSSES " + json.dumps(losses))
+        return
+
+    config = fluid.DistributeTranspilerConfig()
+    config.min_block_size = 4  # tiny model: force splitting across servers
+    t = fluid.DistributeTranspiler(config=config)
+    t.transpile(
+        trainer_id,
+        program=main_prog,
+        pservers=eps,
+        trainers=trainers,
+        sync_mode=sync_mode,
+    )
+
+    if role == "PSERVER":
+        cur = os.environ["PADDLE_CURRENT_ENDPOINT"]
+        pserver_prog = t.get_pserver_program(cur)
+        startup = t.get_startup_program(cur, pserver_prog)
+        scope = fluid.global_scope()
+        exe.run(startup, scope=scope)
+        print("PSERVER READY", flush=True)
+        exe.run(pserver_prog, scope=scope)  # blocks until trainers complete
+        print("PSERVER DONE")
+        return
+
+    # TRAINER
+    trainer_prog = t.get_trainer_program()
+    exe.run(fluid.default_startup_program())
+    # this trainer's shard of the global batch
+    shard = batch // trainers
+    lo, hi = trainer_id * shard, (trainer_id + 1) * shard
+    losses = []
+    for _ in range(steps):
+        (lv,) = exe.run(
+            program=trainer_prog,
+            feed={"x": x[lo:hi], "y": y[lo:hi]},
+            fetch_list=[loss],
+        )
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    exe.close()  # SendComplete to pservers
+    print("LOSSES " + json.dumps(losses))
+
+
+if __name__ == "__main__":
+    main()

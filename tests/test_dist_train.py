@@ -1,0 +1,146 @@
+"""Multi-process distributed training on localhost
+(test_dist_base.py:34 TestDistBase.check_with_place analog): spawn real
+pserver + trainer subprocesses, compare dist losses to a local run."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_RUNNER = os.path.join(_DIR, "dist_mlp.py")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn(env):
+    full = dict(os.environ)
+    full.update(env)
+    full["JAX_PLATFORMS"] = "cpu"
+    return subprocess.Popen(
+        [sys.executable, _RUNNER],
+        env=full,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+
+
+def _losses(proc, timeout=240):
+    out, err = proc.communicate(timeout=timeout)
+    assert proc.returncode == 0, "runner failed:\n%s\n%s" % (out, err)
+    for line in out.splitlines():
+        if line.startswith("LOSSES "):
+            return json.loads(line[len("LOSSES "):])
+    raise AssertionError("no LOSSES line in output:\n%s\n%s" % (out, err))
+
+
+def _wait_port(port, timeout=60):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        try:
+            socket.create_connection(("127.0.0.1", port), timeout=1).close()
+            return
+        except OSError:
+            time.sleep(0.2)
+    raise TimeoutError("pserver port %d never opened" % port)
+
+
+def _run_cluster(n_trainers, sync=True, steps=4, extra_env=None):
+    ports = [_free_port(), _free_port()]
+    eps = ",".join("127.0.0.1:%d" % p for p in ports)
+    common = {
+        "PADDLE_PSERVER_EPS": eps,
+        "PADDLE_TRAINERS": str(n_trainers),
+        "DIST_SYNC_MODE": "1" if sync else "0",
+        "DIST_STEPS": str(steps),
+    }
+    common.update(extra_env or {})
+    pservers = [
+        _spawn(
+            dict(
+                common,
+                PADDLE_TRAINING_ROLE="PSERVER",
+                PADDLE_CURRENT_ENDPOINT="127.0.0.1:%d" % p,
+            )
+        )
+        for p in ports
+    ]
+    try:
+        for p in ports:
+            _wait_port(p)
+        trainers = [
+            _spawn(
+                dict(
+                    common,
+                    PADDLE_TRAINING_ROLE="TRAINER",
+                    PADDLE_TRAINER_ID=str(i),
+                )
+            )
+            for i in range(n_trainers)
+        ]
+        losses = [_losses(t) for t in trainers]
+        for ps in pservers:
+            ps.communicate(timeout=90)
+        return losses
+    finally:
+        for ps in pservers:
+            if ps.poll() is None:
+                ps.kill()
+
+
+def _local_losses(steps=4, extra_env=None):
+    env = {"PADDLE_TRAINING_ROLE": "LOCAL", "DIST_STEPS": str(steps)}
+    env.update(extra_env or {})
+    proc = _spawn(env)
+    return _losses(proc)
+
+
+@pytest.mark.slow
+def test_dist_sync_1trainer_matches_local():
+    """1 trainer + 2 pservers sync == local run exactly (same data, same
+    init by construction: identical seeded startup on trainer & pservers)."""
+    local = _local_losses()
+    (dist,) = _run_cluster(1, sync=True)
+    np.testing.assert_allclose(dist, local, rtol=2e-4, atol=1e-5)
+
+
+@pytest.mark.slow
+def test_dist_sync_2trainers_matches_local_global_batch():
+    """2 trainers on half-batches, grads averaged on pservers == local
+    full-batch run: mean of the two trainers' losses equals the local loss
+    at every step."""
+    local = _local_losses()
+    l0, l1 = _run_cluster(2, sync=True)
+    merged = (np.array(l0) + np.array(l1)) / 2.0
+    np.testing.assert_allclose(merged, local, rtol=2e-3, atol=1e-4)
+
+
+@pytest.mark.slow
+def test_dist_adam_lr_decay_matches_local():
+    """Adam + exponential LR decay + per-param lr: the decay chain moves to
+    the pservers (lrsched role), moments are sliced per block, beta pows
+    are per-block copies — dist must still match local exactly."""
+    env = {"DIST_OPTIMIZER": "adam_decay"}
+    local = _local_losses(steps=5, extra_env=env)
+    (dist,) = _run_cluster(1, sync=True, steps=5, extra_env=env)
+    np.testing.assert_allclose(dist, local, rtol=2e-4, atol=1e-5)
+
+
+@pytest.mark.slow
+def test_dist_async_trains():
+    """Async mode: no barriers; loss must still go down."""
+    losses = _run_cluster(2, sync=False, steps=6)
+    for l in losses:
+        assert l[-1] < l[0]

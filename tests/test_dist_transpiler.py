@@ -1,0 +1,121 @@
+"""DistributeTranspiler unit tests (test_dist_transpiler.py analog):
+assert the exact op rewrite of trainer/pserver programs, no processes."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.transpiler.distribute_transpiler import slice_variable
+
+
+def _build(optimizer=None):
+    x = layers.data("x", shape=[16])
+    y = layers.data("y", shape=[1])
+    pred = layers.fc(x, size=4)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    (optimizer or fluid.optimizer.SGD(0.1)).minimize(loss)
+    return loss
+
+
+def _transpile(trainer_id=0, eps="127.0.0.1:6174,127.0.0.1:6175", **cfg_kw):
+    config = fluid.DistributeTranspilerConfig()
+    config.min_block_size = 4
+    for k, v in cfg_kw.items():
+        setattr(config, k, v)
+    t = fluid.DistributeTranspiler(config=config)
+    t.transpile(
+        trainer_id,
+        program=fluid.default_main_program(),
+        pservers=eps,
+        trainers=2,
+        sync_mode=True,
+    )
+    return t
+
+
+def test_slice_variable():
+    blocks = slice_variable([("w", 100)], 3, min_block_size=10)["w"]
+    assert sum(b.size for b in blocks) == 100
+    assert len(blocks) == 3
+    assert blocks[0].begin == 0 and blocks[-1].end == 100
+    # below min size: single block
+    blocks = slice_variable([("b", 8)], 3, min_block_size=10)["b"]
+    assert len(blocks) == 1 and blocks[0].size == 8
+
+
+def test_trainer_program_rewrite():
+    _build()
+    t = _transpile()
+    prog = t.get_trainer_program()
+    types = [op.type for op in prog.global_block().ops]
+    # optimizer ops moved off the trainer
+    assert "sgd" not in types
+    # rpc tail: scale+send per grad, one send_barrier, recv per param,
+    # one fetch_barrier, in that order
+    assert types.count("send") == 2  # fc w + b
+    assert types.count("recv") == 2
+    assert types.count("send_barrier") == 1
+    assert types.count("fetch_barrier") == 1
+    assert types.index("send_barrier") > max(
+        i for i, t_ in enumerate(types) if t_ == "send"
+    )
+    assert types.index("fetch_barrier") > max(
+        i for i, t_ in enumerate(types) if t_ == "recv"
+    )
+    # every rpc op is tagged with the rpc role
+    for op in prog.global_block().ops:
+        if op.type in ("send", "recv", "send_barrier", "fetch_barrier"):
+            assert op.attrs["op_role"] == "rpc"
+
+
+def test_pserver_program_shards():
+    _build()
+    t = _transpile()
+    eps = t.pserver_endpoints
+    progs = [t.get_pserver_program(ep) for ep in eps]
+    ops = [p.global_block().ops[0] for p in progs]
+    assert all(op.type == "listen_and_serv" for op in ops)
+    # the fc weight (16*4=64 elems) splits across both servers
+    n_shards = [len(op.attrs["optimize_programs"]) for op in ops]
+    assert sum(n_shards) >= 3  # w split in 2 + bias
+    assert all(n >= 1 for n in n_shards)
+    # slice plans reconstruct full params exactly
+    total = {}
+    for op in ops:
+        for src, blk, b, e in op.attrs["slice_plan"]:
+            total.setdefault(src, []).append((b, e))
+    w_ranges = sorted(total["fc_0.w_0"])
+    assert w_ranges[0][0] == 0 and w_ranges[-1][1] == 64
+    for (b1, e1), (b2, e2) in zip(w_ranges, w_ranges[1:]):
+        assert e1 == b2
+
+
+def test_adam_accumulators_sliced():
+    _build(fluid.optimizer.Adam(0.01))
+    t = _transpile()
+    import json
+
+    found_moment_slice = False
+    for ep in t.pserver_endpoints:
+        op = t.get_pserver_program(ep).global_block().ops[0]
+        for sp_json in op.attrs["optimize_programs"]:
+            sp = fluid.Program.from_json(sp_json)
+            adam = sp.global_block().ops[0]
+            assert adam.type == "adam"
+            for slot in ("Moment1", "Moment2"):
+                n = adam.inputs[slot][0]
+                if ".block" in n:
+                    found_moment_slice = True
+    assert found_moment_slice
+
+
+def test_memory_optimize_plan():
+    _build()
+    prog = fluid.default_main_program()
+    plan = fluid.memory_optimize(prog)
+    assert "reuse" in plan and plan["saved_bytes"] >= 0
+    # reused vars must be non-persistable temporaries
+    block = prog.global_block()
+    for var, cache in plan["reuse"].items():
+        v = block._find_var_recursive(var)
+        assert v is not None and not v.persistable

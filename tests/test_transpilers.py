@@ -1,0 +1,42 @@
+"""InferenceTranspiler + memory_optimize behavioral tests."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _build_conv_bn(dropout_impl):
+    img = layers.data("img", shape=[3, 8, 8])
+    c = layers.conv2d(img, num_filters=4, filter_size=3, act=None)
+    bn = layers.batch_norm(c, is_test=True)
+    d = layers.dropout(bn, dropout_prob=0.5, dropout_implementation=dropout_impl)
+    return d
+
+
+@pytest.mark.parametrize("impl", ["downgrade_in_infer", "upscale_in_train"])
+def test_inference_transpiler_conv_bn_fold(impl):
+    d = _build_conv_bn(impl)
+    main = fluid.default_main_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    # non-trivial running stats
+    fluid.global_scope().set(
+        "batch_norm_0.w_1", np.random.RandomState(1).rand(4).astype("float32")
+    )
+    fluid.global_scope().set(
+        "batch_norm_0.w_2", (np.random.RandomState(2).rand(4) + 0.5).astype("float32")
+    )
+    x = np.random.RandomState(0).rand(2, 3, 8, 8).astype("float32")
+    (ref,) = exe.run(
+        program=main.clone(for_test=True), feed={"img": x}, fetch_list=[d.name]
+    )
+    opt_prog = fluid.InferenceTranspiler().transpile(
+        main.clone(for_test=True), fluid.CPUPlace()
+    )
+    types = [op.type for op in opt_prog.global_block().ops]
+    assert "batch_norm" not in types
+    assert "dropout" not in types
+    (out,) = exe.run(program=opt_prog, feed={"img": x}, fetch_list=[d.name])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
