@@ -1,1 +1,32 @@
-"""paddle_tpu.contrib"""
+"""High-level / incubating APIs (python/paddle/fluid/contrib analog)."""
+
+from . import decoder, quantize
+from .memory_usage_calc import memory_usage
+from .op_frequence import op_freq_statistic
+from .trainer import (
+    BeginEpochEvent,
+    BeginStepEvent,
+    CheckpointConfig,
+    EndEpochEvent,
+    EndStepEvent,
+    Inferencer,
+    Trainer,
+    load_checkpoint,
+    save_checkpoint,
+)
+
+__all__ = [
+    "Trainer",
+    "Inferencer",
+    "CheckpointConfig",
+    "BeginEpochEvent",
+    "EndEpochEvent",
+    "BeginStepEvent",
+    "EndStepEvent",
+    "save_checkpoint",
+    "load_checkpoint",
+    "memory_usage",
+    "op_freq_statistic",
+    "decoder",
+    "quantize",
+]

@@ -1,0 +1,3 @@
+from .beam_search_decoder import BeamSearchDecoder
+
+__all__ = ["BeamSearchDecoder"]

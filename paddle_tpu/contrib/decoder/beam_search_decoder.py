@@ -1,0 +1,88 @@
+"""High-level beam-search decoder
+(contrib/decoder/beam_search_decoder.py analog).
+
+The reference builds a While program with StateCell/TrainingDecoder over
+LoD tensor arrays.  TPU-native form: the decode loop is a host-driven step
+loop over ONE compiled step program (compile once, run T times — the step
+is where the FLOPs are), with the backtrack done by the beam_search_decode
+op.  States are plain padded arrays [batch, beam, ...].
+"""
+
+import numpy as np
+
+
+class BeamSearchDecoder:
+    """Drives a user step function through beam search.
+
+    step_fn(token_ids [batch*beam], states) -> (log_probs [batch*beam, vocab],
+    new_states) — typically a compiled Executor.run over a step program.
+    """
+
+    def __init__(self, step_fn, beam_size, start_token, end_token, max_len=32):
+        self.step_fn = step_fn
+        self.beam_size = beam_size
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.max_len = int(max_len)
+
+    def decode(self, batch_size, init_states=None):
+        """Returns (sentence_ids [batch, beam, <=max_len], scores [batch, beam])."""
+        beam = self.beam_size
+        pre_ids = np.full((batch_size, beam), self.start_token, np.int32)
+        pre_scores = np.full((batch_size, beam), -1e9, np.float32)
+        pre_scores[:, 0] = 0.0  # only beam 0 is live initially
+        states = init_states
+
+        ids_steps, parent_steps, score_steps = [], [], []
+        for _ in range(self.max_len):
+            logp, states = self.step_fn(pre_ids.reshape(-1), states)
+            logp = np.asarray(logp, np.float32).reshape(batch_size, beam, -1)
+            vocab = logp.shape[-1]
+
+            finished = pre_ids == self.end_token
+            cont = pre_scores[:, :, None] + logp
+            frozen = np.full_like(cont, -1e9)
+            frozen[:, :, self.end_token] = pre_scores
+            total = np.where(finished[:, :, None], frozen, cont)
+
+            flat = total.reshape(batch_size, beam * vocab)
+            top_idx = np.argsort(-flat, axis=1)[:, :beam]
+            top_scores = np.take_along_axis(flat, top_idx, axis=1)
+            parent = (top_idx // vocab).astype(np.int32)
+            token = (top_idx % vocab).astype(np.int32)
+
+            ids_steps.append(token)
+            parent_steps.append(parent)
+            score_steps.append(top_scores)
+            pre_ids, pre_scores = token, top_scores
+            # states follow their beam's parent
+            if states is not None:
+                states = _reindex_states(states, parent, batch_size, beam)
+            if (token == self.end_token).all():
+                break
+
+        # backtrack
+        T = len(ids_steps)
+        out = np.zeros((batch_size, beam, T), np.int32)
+        ptr = np.tile(np.arange(beam, dtype=np.int32), (batch_size, 1))
+        rows = np.arange(batch_size)[:, None]
+        for t in range(T - 1, -1, -1):
+            out[:, :, t] = ids_steps[t][rows, ptr]
+            ptr = parent_steps[t][rows, ptr]
+        return out, score_steps[-1]
+
+
+def _reindex_states(states, parent, batch_size, beam):
+    """Gather each state along the beam dim by parent index."""
+    rows = np.arange(batch_size)[:, None]
+
+    def gather(s):
+        s = np.asarray(s)
+        shaped = s.reshape(batch_size, beam, *s.shape[1:])
+        return shaped[rows, parent].reshape(s.shape)
+
+    if isinstance(states, dict):
+        return {k: gather(v) for k, v in states.items()}
+    if isinstance(states, (list, tuple)):
+        return type(states)(gather(v) for v in states)
+    return gather(states)

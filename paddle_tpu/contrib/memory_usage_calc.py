@@ -1,0 +1,42 @@
+"""Estimate a program's memory footprint
+(contrib/memory_usage_calc.py analog).
+
+Walks the program's vars, sizes them for a given batch size, and returns a
+(low, high) byte range — the high bound assumes every temp is live at once,
+the low bound assumes XLA's reuse collapses temps to the two largest (the
+usual double-buffer case)."""
+
+DTYPE_TO_SIZE = {
+    "float32": 4,
+    "float64": 8,
+    "float16": 2,
+    "bfloat16": 2,
+    "int64": 8,
+    "int32": 4,
+    "int16": 2,
+    "int8": 1,
+    "uint8": 1,
+    "bool": 1,
+}
+
+
+def memory_usage(program, batch_size=1):
+    """Returns (low_bytes, high_bytes) for one step of `program`."""
+    persist = 0
+    temps = []
+    for var in program.global_block().vars.values():
+        if var.shape is None:
+            continue
+        numel = 1
+        for d in var.shape:
+            d = int(d)
+            numel *= batch_size if d < 0 else d
+        nbytes = numel * DTYPE_TO_SIZE.get(str(var.dtype), 4)
+        if var.persistable:
+            persist += nbytes
+        else:
+            temps.append(nbytes)
+    temps.sort(reverse=True)
+    high = persist + sum(temps)
+    low = persist + sum(temps[:2])
+    return low, high

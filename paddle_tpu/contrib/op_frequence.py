@@ -1,0 +1,19 @@
+"""Op-type histograms over programs (contrib/op_frequence.py analog)."""
+
+from collections import Counter, OrderedDict
+
+
+def op_freq_statistic(program):
+    """Returns (single_op_count, adjacent_pair_count) ordered by frequency."""
+    singles = Counter()
+    pairs = Counter()
+    prev = None
+    for block in program.blocks:
+        prev = None
+        for op in block.ops:
+            singles[op.type] += 1
+            if prev is not None:
+                pairs[prev + "," + op.type] += 1
+            prev = op.type
+    order = lambda c: OrderedDict(sorted(c.items(), key=lambda kv: -kv[1]))
+    return order(singles), order(pairs)

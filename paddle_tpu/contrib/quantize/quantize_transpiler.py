@@ -1,0 +1,200 @@
+"""Quantization-aware-training program rewrite
+(contrib/quantize/quantize_transpiler.py analog).
+
+training_transpile() inserts fake-quantize (quantize-dequantize roundtrip,
+straight-through gradient) ops on the activations and weights feeding
+matmul/conv ops.  The reference computes in the int8 domain and re-scales
+with a post-op dequantize (a cuDNN/GEMM-int8 detail); on TPU the QDQ form
+is the right representation — XLA keeps everything bf16/f32 and the
+simulated quantization error is identical.
+
+freeze_program() converts a trained program for int8 inference: weight
+quant ops are folded by pre-quantizing the scope weights, activation quant
+ops switch to their stored scales (is_test).
+"""
+
+import numpy as np
+
+from ... import framework
+from ...framework import Operator
+
+_QUANTIZABLE = ("conv2d", "depthwise_conv2d", "mul", "matmul")
+
+
+class QuantizeTranspiler:
+    def __init__(
+        self,
+        weight_bits=8,
+        activation_bits=8,
+        activation_quantize_type="abs_max",
+        weight_quantize_type="abs_max",
+        window_size=10000,
+        moving_rate=0.9,
+    ):
+        assert activation_quantize_type in (
+            "abs_max",
+            "range_abs_max",
+            "moving_average_abs_max",
+        )
+        assert weight_quantize_type in ("abs_max", "channel_wise_abs_max")
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.act_type = activation_quantize_type
+        self.weight_type = weight_quantize_type
+        self.window_size = window_size
+        self.moving_rate = moving_rate
+
+    # ------------------------------------------------------------------
+    def _quant_op_for(self, block, name, is_weight, startup=None):
+        """Append the fake-quant op quantizing var `name`; returns the
+        quantized var name."""
+        qname = name + ".quantized"
+        sname = name + ".scale"
+        bits = self.weight_bits if is_weight else self.activation_bits
+        v = block._find_var_recursive(name)
+        block.create_var(name=qname, shape=list(v.shape) if v else None, dtype="float32")
+
+        if is_weight and self.weight_type == "channel_wise_abs_max":
+            out_c = int(v.shape[0]) if v is not None and v.shape else 1
+            block.create_var(name=sname, shape=[out_c], dtype="float32")
+            block.append_op(
+                "fake_channel_wise_quantize_abs_max",
+                inputs={"X": [name]},
+                outputs={"Out": [qname], "OutScale": [sname]},
+                attrs={"bit_length": bits},
+            )
+            return qname
+
+        qtype = "abs_max" if is_weight else self.act_type
+        block.create_var(name=sname, shape=[1], dtype="float32", persistable=qtype != "abs_max")
+        if qtype == "abs_max":
+            block.append_op(
+                "fake_quantize_abs_max",
+                inputs={"X": [name]},
+                outputs={"Out": [qname], "OutScale": [sname]},
+                attrs={"bit_length": bits},
+            )
+        elif qtype == "moving_average_abs_max":
+            state, accum = sname + ".state", sname + ".accum"
+            for extra, fill in ((state, 1.0), (accum, 1e-7), (sname, 1e-7)):
+                block.create_var(name=extra, shape=[1], dtype="float32", persistable=True)
+                if startup is not None:
+                    sb = startup.global_block()
+                    sb.create_var(name=extra, shape=[1], dtype="float32", persistable=True)
+                    sb.append_op(
+                        "fill_constant",
+                        outputs={"Out": [extra]},
+                        attrs={"shape": [1], "dtype": "float32", "value": fill},
+                    )
+            block.append_op(
+                "fake_quantize_moving_average_abs_max",
+                inputs={"X": [name], "InScale": [sname], "InState": [state], "InAccum": [accum]},
+                outputs={"Out": [qname], "OutScale": [sname], "OutState": [state], "OutAccum": [accum]},
+                attrs={"bit_length": bits, "moving_rate": self.moving_rate},
+            )
+        else:  # range_abs_max
+            scales, it = sname + ".buf", sname + ".iter"
+            for extra, shape, fill in (
+                (sname, [1], 1e-7),
+                (scales, [min(self.window_size, 1024)], 0.0),
+                (it, [1], 0.0),
+            ):
+                block.create_var(name=extra, shape=shape, dtype="float32", persistable=True)
+                if startup is not None:
+                    sb = startup.global_block()
+                    sb.create_var(name=extra, shape=shape, dtype="float32", persistable=True)
+                    sb.append_op(
+                        "fill_constant",
+                        outputs={"Out": [extra]},
+                        attrs={"shape": shape, "dtype": "float32", "value": fill},
+                    )
+            block.append_op(
+                "fake_quantize_range_abs_max",
+                inputs={"X": [name], "InScale": [sname], "InScales": [scales], "Iter": [it]},
+                outputs={"Out": [qname], "OutScale": [sname], "OutScales": [scales]},
+                attrs={"bit_length": bits, "window_size": min(self.window_size, 1024)},
+            )
+            block.append_op(
+                "increment",
+                inputs={"X": [it]},
+                outputs={"Out": [it]},
+                attrs={"step": 1.0},
+            )
+        return qname
+
+    # ------------------------------------------------------------------
+    def training_transpile(self, program=None, startup_program=None):
+        """Insert QDQ ops before every quantizable op (in place)."""
+        program = program or framework.default_main_program()
+        startup_program = startup_program or framework.default_startup_program()
+        block = program.global_block()
+
+        params = set(
+            v.name for v in block.vars.values() if isinstance(v, framework.Parameter)
+        )
+        new_ops = []
+        quantized = {}  # var name -> quantized name within this program
+        for op in list(block.ops):
+            if op.type in _QUANTIZABLE and op.attrs.get("op_role", "forward") == "forward":
+                # stage the quant ops into new_ops via a scratch list
+                hold = block.ops
+                block.ops = new_ops
+                for slot, names in list(op.inputs.items()):
+                    renamed = []
+                    for n in names:
+                        if n not in quantized:
+                            quantized[n] = self._quant_op_for(
+                                block, n, n in params, startup_program
+                            )
+                        renamed.append(quantized[n])
+                    op.inputs[slot] = renamed
+                block.ops = hold
+            new_ops.append(op)
+        block.ops = new_ops
+        program._bump_version()
+        return program
+
+    # ------------------------------------------------------------------
+    def freeze_program(self, program, place=None, scope=None):
+        """Prepare a QAT program for inference: pre-quantize weights in the
+        scope (QDQ applied offline), remove their quant ops, and pin
+        activation quant ops to stored scales (is_test)."""
+        from ...executor import global_scope
+
+        scope = scope if scope is not None else global_scope()
+        block = program.global_block()
+        new_ops = []
+        for op in block.ops:
+            if op.type in (
+                "fake_quantize_abs_max",
+                "fake_channel_wise_quantize_abs_max",
+            ):
+                src = op.inputs["X"][0]
+                dst = op.outputs["Out"][0]
+                w = scope.find_var(src)
+                if w is not None:
+                    bits = op.attrs.get("bit_length", 8)
+                    rng = float(2 ** (bits - 1) - 1)
+                    wv = np.asarray(w, dtype=np.float32)
+                    if op.type == "fake_channel_wise_quantize_abs_max":
+                        axes = tuple(range(1, wv.ndim))
+                        scale = np.maximum(np.abs(wv).max(axis=axes, keepdims=True), 1e-8)
+                    else:
+                        scale = max(np.abs(wv).max(), 1e-8)
+                    q = np.clip(np.round(wv / scale * rng), -rng, rng)
+                    scope.set(dst, (q * scale / rng).astype(np.float32))
+                    # quantized weight becomes a persistable input
+                    v = block._find_var_recursive(dst)
+                    if v is not None:
+                        v.persistable = True
+                    continue
+            if op.type in (
+                "fake_quantize_range_abs_max",
+                "fake_quantize_moving_average_abs_max",
+            ):
+                op.attrs["is_test"] = True
+            new_ops.append(op)
+        block.ops = new_ops
+        program._is_test = True
+        program._bump_version()
+        return program

@@ -1,0 +1,276 @@
+"""High-level Trainer / Inferencer with checkpoint-resume
+(python/paddle/fluid/contrib/trainer.py analog: Trainer :170,
+CheckpointConfig :101, save_checkpoint :664, load_checkpoint :764).
+
+The event-driven train loop, serial-numbered checkpoint dirs with pruning,
+and trainer-state persistence are kept; execution is the compiled TPU
+executor underneath.
+"""
+
+import json
+import os
+import shutil
+
+import numpy as np
+
+from .. import framework, io
+from ..executor import Executor
+from ..core.scope import Scope
+from .. import core
+
+
+class BeginEpochEvent:
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class EndEpochEvent:
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class BeginStepEvent:
+    def __init__(self, epoch_id, step_id):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.fetch_metrics = True
+
+
+class EndStepEvent:
+    def __init__(self, epoch_id, step_id, metrics):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.metrics = metrics
+
+
+class CheckpointConfig:
+    """Checkpoint policy (contrib/trainer.py:101)."""
+
+    def __init__(
+        self,
+        checkpoint_dir=None,
+        max_num_checkpoints=3,
+        epoch_interval=1,
+        step_interval=10,
+    ):
+        self.checkpoint_dir = checkpoint_dir or "checkpoint"
+        self.max_num_checkpoints = max_num_checkpoints
+        self.epoch_interval = max(1, int(epoch_interval))
+        self.step_interval = max(1, int(step_interval))
+        # populated on resume
+        self.epoch_id = 0
+        self.step_id = 0
+
+
+_TRAINER_STATE_FILE = "TRAINER_STATE"
+_SERIAL_PREFIX = "checkpoint_"
+
+
+def _serial_dirs(root):
+    if not os.path.isdir(root):
+        return []
+    out = []
+    for d in os.listdir(root):
+        if d.startswith(_SERIAL_PREFIX):
+            try:
+                out.append((int(d[len(_SERIAL_PREFIX):]), os.path.join(root, d)))
+            except ValueError:
+                pass
+    return sorted(out)
+
+
+def save_checkpoint(
+    executor, checkpoint_dir, main_program, trainer_args=None,
+    max_num_checkpoints=3, scope=None,
+):
+    """Persistables + trainer state into the next serial dir; prune old
+    serials (save_checkpoint :664)."""
+    serials = _serial_dirs(checkpoint_dir)
+    serial = serials[-1][0] + 1 if serials else 0
+    cur = os.path.join(checkpoint_dir, _SERIAL_PREFIX + str(serial))
+    os.makedirs(cur, exist_ok=True)
+    io.save_persistables(executor, cur, main_program, scope=scope)
+    with open(os.path.join(cur, _TRAINER_STATE_FILE), "w") as f:
+        json.dump(trainer_args or {}, f)
+    for old_serial, path in _serial_dirs(checkpoint_dir)[:-max_num_checkpoints]:
+        shutil.rmtree(path, ignore_errors=True)
+    return serial
+
+
+def load_checkpoint(executor, checkpoint_dir, main_program, scope=None):
+    """Restore the newest serial; returns trainer state dict or None
+    (load_checkpoint :764)."""
+    serials = _serial_dirs(checkpoint_dir)
+    if not serials:
+        return None
+    _, cur = serials[-1]
+    io.load_persistables(executor, cur, main_program, scope=scope)
+    state_path = os.path.join(cur, _TRAINER_STATE_FILE)
+    if os.path.exists(state_path):
+        with open(state_path) as f:
+            return json.load(f)
+    return {}
+
+
+class Trainer:
+    """Event-driven trainer (contrib/trainer.py:170).
+
+    train_func() builds the model in the fresh default program and returns
+    the loss Variable (optionally [loss, ...metrics]); optimizer_func()
+    returns the Optimizer.
+    """
+
+    def __init__(
+        self,
+        train_func,
+        optimizer_func,
+        place=None,
+        param_path=None,
+        checkpoint_config=None,
+    ):
+        self.place = place
+        self.checkpoint_cfg = checkpoint_config
+        self.scope = Scope()
+        self.startup_program = framework.Program()
+        self.train_program = framework.Program()
+        from .. import unique_name
+
+        # fresh name generator: a re-created Trainer (checkpoint resume in a
+        # new process or the same one) must assign identical param names
+        with unique_name.guard(), framework.program_guard(
+            self.train_program, self.startup_program
+        ):
+            ret = train_func()
+            if isinstance(ret, (list, tuple)):
+                self.loss = ret[0]
+                self.metrics = list(ret)
+            else:
+                self.loss = ret
+                self.metrics = [ret]
+            optimizer = optimizer_func()
+            optimizer.minimize(self.loss)
+
+        self.exe = Executor(place)
+        self.exe.run(self.startup_program, scope=self.scope)
+        if param_path:
+            io.load_persistables(
+                self.exe, param_path, self.train_program, scope=self.scope
+            )
+        if self.checkpoint_cfg:
+            state = load_checkpoint(
+                self.exe,
+                self.checkpoint_cfg.checkpoint_dir,
+                self.train_program,
+                scope=self.scope,
+            )
+            if state is not None:
+                self.checkpoint_cfg.epoch_id = int(state.get("epoch_id", 0))
+                self.checkpoint_cfg.step_id = int(state.get("step_id", 0))
+        self._stop = False
+
+    def stop(self):
+        self._stop = True
+
+    def train(self, num_epochs, event_handler, reader, feed_order):
+        start_epoch = self.checkpoint_cfg.epoch_id if self.checkpoint_cfg else 0
+        step = self.checkpoint_cfg.step_id if self.checkpoint_cfg else 0
+        for epoch_id in range(start_epoch, num_epochs):
+            if self._stop:
+                break
+            event_handler(BeginEpochEvent(epoch_id))
+            for step_id, data in enumerate(reader()):
+                if self._stop:
+                    break
+                begin = BeginStepEvent(epoch_id, step_id)
+                event_handler(begin)
+                feed = self._feed_from(data, feed_order)
+                fetch = [m.name for m in self.metrics] if begin.fetch_metrics else []
+                metrics = self.exe.run(
+                    self.train_program, feed=feed, fetch_list=fetch, scope=self.scope
+                )
+                event_handler(EndStepEvent(epoch_id, step_id, metrics))
+                step += 1
+                if (
+                    self.checkpoint_cfg
+                    and step % self.checkpoint_cfg.step_interval == 0
+                ):
+                    self._checkpoint(epoch_id, step)
+            event_handler(EndEpochEvent(epoch_id))
+            if (
+                self.checkpoint_cfg
+                and (epoch_id + 1) % self.checkpoint_cfg.epoch_interval == 0
+            ):
+                self._checkpoint(epoch_id + 1, step)
+
+    def _feed_from(self, data, feed_order):
+        if isinstance(data, dict):
+            return data
+        feed = {}
+        for name, value in zip(feed_order, zip(*data) if _is_rows(data) else data):
+            feed[name] = np.asarray(value)
+        return feed
+
+    def _checkpoint(self, epoch_id, step_id):
+        save_checkpoint(
+            self.exe,
+            self.checkpoint_cfg.checkpoint_dir,
+            self.train_program,
+            trainer_args={"epoch_id": epoch_id, "step_id": step_id},
+            max_num_checkpoints=self.checkpoint_cfg.max_num_checkpoints,
+            scope=self.scope,
+        )
+
+    def save_params(self, param_path):
+        os.makedirs(param_path, exist_ok=True)
+        io.save_persistables(
+            self.exe, param_path, self.train_program, scope=self.scope
+        )
+
+    def save_inference_model(self, param_path, feeded_var_names, target_var_indexes):
+        targets = [self.metrics[i] for i in target_var_indexes]
+        io.save_inference_model(
+            param_path,
+            feeded_var_names,
+            targets,
+            self.exe,
+            main_program=self.train_program,
+            scope=self.scope,
+        )
+
+
+def _is_rows(data):
+    """True when `data` is a list of per-sample tuples (batched reader)."""
+    return (
+        isinstance(data, (list, tuple))
+        and data
+        and isinstance(data[0], (list, tuple))
+    )
+
+
+class Inferencer:
+    """Build-and-serve counterpart (contrib/inferencer.py analog)."""
+
+    def __init__(self, infer_func, param_path, place=None):
+        self.scope = Scope()
+        self.startup_program = framework.Program()
+        self.inference_program = framework.Program()
+        from .. import unique_name
+
+        with unique_name.guard(), framework.program_guard(
+            self.inference_program, self.startup_program
+        ):
+            self.predict_var = infer_func()
+        self.inference_program._is_test = True
+        self.exe = Executor(place)
+        self.exe.run(self.startup_program, scope=self.scope)
+        io.load_persistables(
+            self.exe, param_path, self.inference_program, scope=self.scope
+        )
+
+    def infer(self, inputs):
+        return self.exe.run(
+            self.inference_program,
+            feed=inputs,
+            fetch_list=[self.predict_var],
+            scope=self.scope,
+        )

@@ -45,10 +45,19 @@ def dce_mask(program, block_idx, fetch_names):
 
     from .registry import OPS
 
+    # test-mode programs (clone(for_test=True)) never run training-only
+    # ops, even though those write persistable state (fluid semantics:
+    # Program.clone strips nothing, but an is_test run must not step the
+    # optimizer or touch grads)
+    is_test = getattr(program, "_is_test", False)
+    train_roles = ("backward", "optimize", "lrsched", "loss", "rpc")
+
     needed = set(fetch_names)
     keep = [False] * len(blk.ops)
     for i in range(len(blk.ops) - 1, -1, -1):
         op = blk.ops[i]
+        if is_test and op.attrs.get("op_role") in train_roles:
+            continue
         outs = op.output_arg_names()
         opdef = OPS.get(op.type)
         if (

@@ -48,13 +48,13 @@ def _load_var(dirname, name):
     return np.load(path)
 
 
-def save_vars(executor, dirname, main_program=None, vars=None, predicate=None, filename=None):
+def save_vars(executor, dirname, main_program=None, vars=None, predicate=None, filename=None, scope=None):
     if main_program is None:
         main_program = framework.default_main_program()
     if vars is None:
         vars = [v for v in main_program.list_vars() if predicate is None or predicate(v)]
     os.makedirs(dirname, exist_ok=True)
-    scope = global_scope()
+    scope = scope if scope is not None else global_scope()
     if filename is not None:
         blob = {}
         for v in vars:
@@ -71,7 +71,7 @@ def save_vars(executor, dirname, main_program=None, vars=None, predicate=None, f
         _save_var(dirname, v.name, val)
 
 
-def save_params(executor, dirname, main_program=None, filename=None):
+def save_params(executor, dirname, main_program=None, filename=None, scope=None):
     if main_program is None:
         main_program = framework.default_main_program()
     save_vars(
@@ -80,10 +80,11 @@ def save_params(executor, dirname, main_program=None, filename=None):
         main_program,
         vars=[v for v in main_program.list_vars() if isinstance(v, Parameter)],
         filename=filename,
+        scope=scope,
     )
 
 
-def save_persistables(executor, dirname, main_program=None, filename=None):
+def save_persistables(executor, dirname, main_program=None, filename=None, scope=None):
     if main_program is None:
         main_program = framework.default_main_program()
     save_vars(
@@ -92,15 +93,16 @@ def save_persistables(executor, dirname, main_program=None, filename=None):
         main_program,
         vars=get_program_persistable_vars(main_program),
         filename=filename,
+        scope=scope,
     )
 
 
-def load_vars(executor, dirname, main_program=None, vars=None, predicate=None, filename=None):
+def load_vars(executor, dirname, main_program=None, vars=None, predicate=None, filename=None, scope=None):
     if main_program is None:
         main_program = framework.default_main_program()
     if vars is None:
         vars = [v for v in main_program.list_vars() if predicate is None or predicate(v)]
-    scope = global_scope()
+    scope = scope if scope is not None else global_scope()
     if filename is not None:
         blob = np.load(os.path.join(dirname, filename))
         for v in vars:
@@ -114,7 +116,7 @@ def load_vars(executor, dirname, main_program=None, vars=None, predicate=None, f
             pass
 
 
-def load_params(executor, dirname, main_program=None, filename=None):
+def load_params(executor, dirname, main_program=None, filename=None, scope=None):
     if main_program is None:
         main_program = framework.default_main_program()
     load_vars(
@@ -123,10 +125,11 @@ def load_params(executor, dirname, main_program=None, filename=None):
         main_program,
         vars=[v for v in main_program.list_vars() if isinstance(v, Parameter)],
         filename=filename,
+        scope=scope,
     )
 
 
-def load_persistables(executor, dirname, main_program=None, filename=None):
+def load_persistables(executor, dirname, main_program=None, filename=None, scope=None):
     if main_program is None:
         main_program = framework.default_main_program()
     load_vars(
@@ -135,6 +138,7 @@ def load_persistables(executor, dirname, main_program=None, filename=None):
         main_program,
         vars=get_program_persistable_vars(main_program),
         filename=filename,
+        scope=scope,
     )
 
 
@@ -147,6 +151,7 @@ def save_inference_model(
     model_filename=None,
     params_filename=None,
     export_for_deployment=True,
+    scope=None,
 ):
     """Prune to the inference slice + save program & params (io.py:544)."""
     if main_program is None:
@@ -162,14 +167,14 @@ def save_inference_model(
     }
     with open(os.path.join(dirname, model_filename or "__model__"), "w") as f:
         json.dump(meta, f)
-    save_persistables(executor, dirname, pruned, filename=params_filename)
+    save_persistables(executor, dirname, pruned, filename=params_filename, scope=scope)
     return meta["fetch_names"]
 
 
-def load_inference_model(dirname, executor, model_filename=None, params_filename=None):
+def load_inference_model(dirname, executor, model_filename=None, params_filename=None, scope=None):
     with open(os.path.join(dirname, model_filename or "__model__")) as f:
         meta = json.load(f)
     program = Program.from_json(meta["program"])
-    load_persistables(executor, dirname, program, filename=params_filename)
+    load_persistables(executor, dirname, program, filename=params_filename, scope=scope)
     fetch_vars = [program.global_block().var(n) for n in meta["fetch_names"]]
     return program, meta["feed_names"], fetch_vars

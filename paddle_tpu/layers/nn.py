@@ -15,6 +15,8 @@ from ..layer_helper import LayerHelper
 from ..param_attr import ParamAttr
 
 __all__ = [
+    "beam_search",
+    "beam_search_decode",
     "fc",
     "embedding",
     "conv2d",
@@ -1318,3 +1320,66 @@ def dynamic_gru(
 
 def gru(input, size, **kwargs):
     return dynamic_gru(input, size, **kwargs)
+
+
+def beam_search(
+    pre_ids,
+    pre_scores,
+    ids,
+    scores,
+    beam_size,
+    end_id,
+    level=0,
+    is_accumulated=True,
+    name=None,
+    return_parent_idx=False,
+):
+    """One beam-search step (layers/nn.py:3174 analog, padded-batch form).
+
+    Contract differs from the LoD reference: `scores` must be rank-3
+    [batch, beam, vocab] next-token log-probs (already accumulated with the
+    hypothesis history when is_accumulated=True, the default); `pre_ids` /
+    `pre_scores` are [batch, beam].  Selects the top `beam_size`
+    continuations over beam*vocab per batch row.
+    Returns (selected_ids, selected_scores[, parent_idx]), each
+    [batch, beam]."""
+    helper = LayerHelper("beam_search", **locals())
+    sel_ids = helper.create_variable_for_type_inference("int32")
+    sel_scores = helper.create_variable_for_type_inference("float32")
+    parent_idx = helper.create_variable_for_type_inference("int32")
+    inputs = {"pre_ids": [pre_ids], "pre_scores": [pre_scores], "scores": [scores]}
+    if ids is not None:
+        inputs["ids"] = [ids]
+    helper.append_op(
+        "beam_search",
+        inputs=inputs,
+        outputs={
+            "selected_ids": [sel_ids],
+            "selected_scores": [sel_scores],
+            "parent_idx": [parent_idx],
+        },
+        attrs={"beam_size": beam_size, "end_id": end_id, "level": level,
+               "is_accumulated": is_accumulated},
+    )
+    if return_parent_idx:
+        return sel_ids, sel_scores, parent_idx
+    return sel_ids, sel_scores
+
+
+def beam_search_decode(ids, scores, parent_idx=None, beam_size=None, end_id=0, name=None):
+    """Backtrack per-step beam choices into full hypotheses
+    (layers/nn.py beam_search_decode analog). `ids`/`scores`/`parent_idx`
+    are stacked per-step tensors [T, batch, beam]."""
+    helper = LayerHelper("beam_search_decode", **locals())
+    sent_ids = helper.create_variable_for_type_inference("int32")
+    sent_scores = helper.create_variable_for_type_inference("float32")
+    inputs = {"Ids": [ids], "Scores": [scores]}
+    if parent_idx is not None:
+        inputs["ParentIdx"] = [parent_idx]
+    helper.append_op(
+        "beam_search_decode",
+        inputs=inputs,
+        outputs={"SentenceIds": [sent_ids], "SentenceScores": [sent_scores]},
+        attrs={"end_id": end_id},
+    )
+    return sent_ids, sent_scores

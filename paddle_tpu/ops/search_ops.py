@@ -33,8 +33,13 @@ def _beam_search(ctx, ins, attrs):
     batch, beam, vocab = scores.shape
 
     finished = pre_ids == end_id  # [batch, beam]
-    # frozen beams: only the end_id continuation, at score 0 (keeps total)
-    cont = pre_scores[:, :, None] + scores  # [batch, beam, vocab]
+    # is_accumulated (layer default True): `scores` already contain the
+    # hypothesis history, so adding pre_scores would double-count; the raw
+    # op default (False) matches the step form used by the op tests
+    if attrs.get("is_accumulated", False):
+        cont = scores  # [batch, beam, vocab]
+    else:
+        cont = pre_scores[:, :, None] + scores
     neg_inf = jnp.asarray(-1e9, scores.dtype)
     frozen = jnp.full_like(cont, neg_inf)
     frozen = frozen.at[:, :, end_id].set(pre_scores)
@@ -61,7 +66,13 @@ def _beam_search_decode(ctx, ins, attrs):
     Scores [T, batch, beam]. Outputs SentenceIds [batch, beam, T] (padded
     with end_id) and SentenceScores [batch, beam] (final accumulated)."""
     ids = ins["Ids"][0].astype(jnp.int32)  # [T, B, K]
-    parents = ins["ParentIdx"][0].astype(jnp.int32)
+    if ins.get("ParentIdx"):
+        parents = ins["ParentIdx"][0].astype(jnp.int32)
+    else:
+        # no backpointers recorded: beams never re-ordered (greedy decode)
+        parents = jnp.broadcast_to(
+            jnp.arange(ids.shape[2], dtype=jnp.int32)[None, None, :], ids.shape
+        )
     scores = ins["Scores"][0]
     t, b, k = ids.shape
     end_id = attrs.get("end_id", 0)

@@ -1,0 +1,153 @@
+"""contrib: Trainer/Inferencer, checkpoint-resume, QAT transpiler,
+BeamSearchDecoder, memory/op-freq utilities."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.contrib import (
+    BeginStepEvent,
+    CheckpointConfig,
+    EndStepEvent,
+    Inferencer,
+    Trainer,
+    memory_usage,
+    op_freq_statistic,
+)
+from paddle_tpu.contrib.decoder import BeamSearchDecoder
+from paddle_tpu.contrib.quantize import QuantizeTranspiler
+
+
+def _train_func():
+    x = layers.data("x", shape=[4])
+    y = layers.data("y", shape=[1])
+    pred = layers.fc(layers.fc(x, size=8, act="relu"), size=1)
+    return layers.mean(layers.square_error_cost(pred, y))
+
+
+def _infer_func():
+    x = layers.data("x", shape=[4])
+    return layers.fc(layers.fc(x, size=8, act="relu"), size=1)
+
+
+def _reader():
+    rng = np.random.RandomState(3)
+    x = rng.rand(16, 4).astype("float32")
+    w = np.array([[1.0], [-2.0], [3.0], [0.5]], dtype=np.float32)
+    y = x @ w
+
+    def gen():
+        for _ in range(8):
+            yield {"x": x, "y": y}
+
+    return gen
+
+
+def test_trainer_events_and_infer(tmp_path):
+    events = []
+
+    def handler(ev):
+        events.append(type(ev).__name__)
+        if isinstance(ev, EndStepEvent):
+            events.append(float(np.ravel(ev.metrics[0])[0]))
+
+    trainer = Trainer(_train_func, lambda: fluid.optimizer.Adam(0.05))
+    trainer.train(num_epochs=2, event_handler=handler, reader=_reader(), feed_order=["x", "y"])
+    losses = [e for e in events if isinstance(e, float)]
+    assert losses[-1] < losses[0]
+    assert "BeginEpochEvent" in events and "EndEpochEvent" in events
+
+    param_path = str(tmp_path / "params")
+    trainer.save_params(param_path)
+    inferencer = Inferencer(_infer_func, param_path)
+    out = inferencer.infer({"x": np.ones((2, 4), "float32")})
+    assert np.asarray(out[0]).shape == (2, 1)
+
+
+def test_checkpoint_resume(tmp_path):
+    ckpt = str(tmp_path / "ckpt")
+
+    cfg = CheckpointConfig(ckpt, max_num_checkpoints=2, step_interval=3)
+    t1 = Trainer(_train_func, lambda: fluid.optimizer.SGD(0.1), checkpoint_config=cfg)
+    t1.train(2, lambda ev: None, _reader(), ["x", "y"])
+    serials = sorted(os.listdir(ckpt))
+    assert len(serials) <= 2  # pruning kept the max_num limit
+    w_after = np.array(t1.scope.find_var("fc_0.w_0"))
+
+    # a fresh trainer resumes from the newest serial: params match and the
+    # epoch pointer advanced past the completed epochs
+    cfg2 = CheckpointConfig(ckpt, max_num_checkpoints=2, step_interval=3)
+    t2 = Trainer(_train_func, lambda: fluid.optimizer.SGD(0.1), checkpoint_config=cfg2)
+    np.testing.assert_allclose(
+        np.array(t2.scope.find_var("fc_0.w_0")), w_after, rtol=1e-6
+    )
+    assert cfg2.epoch_id == 2
+    # training for the same num_epochs is a no-op (already done)
+    steps = []
+    t2.train(2, lambda ev: steps.append(ev), _reader(), ["x", "y"])
+    assert not any(isinstance(ev, EndStepEvent) for ev in steps)
+
+
+def test_quantize_transpiler_qat_and_freeze():
+    x = layers.data("x", shape=[8])
+    y = layers.data("y", shape=[1], dtype="int64")
+    pred = layers.fc(layers.fc(x, size=16, act="relu"), size=4, act="softmax")
+    loss = layers.mean(layers.cross_entropy(pred, y))
+    main = fluid.default_main_program()
+
+    qt = QuantizeTranspiler(activation_quantize_type="moving_average_abs_max")
+    qt.training_transpile(main)
+    types = [op.type for op in main.global_block().ops]
+    assert any(t.startswith("fake_quantize") for t in types)
+
+    fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    xv = rng.rand(32, 8).astype("float32")
+    yv = rng.randint(0, 4, (32, 1)).astype("int64")
+    l0 = exe.run(feed={"x": xv, "y": yv}, fetch_list=[loss])[0]
+    for _ in range(20):
+        l1 = exe.run(feed={"x": xv, "y": yv}, fetch_list=[loss])[0]
+    assert float(np.ravel(l1)[0]) < float(np.ravel(l0)[0])  # QAT still trains
+
+    # freeze for inference: weights pre-quantized, act scales pinned
+    test_prog = main.clone(for_test=True)
+    (q_ref,) = exe.run(program=test_prog, feed={"x": xv}, fetch_list=[pred.name])
+    frozen = qt.freeze_program(main.clone(for_test=True))
+    ftypes = [op.type for op in frozen.global_block().ops]
+    assert "fake_quantize_abs_max" not in ftypes  # weight quant folded
+    (q_frozen,) = exe.run(program=frozen, feed={"x": xv}, fetch_list=[pred.name])
+    np.testing.assert_allclose(
+        np.asarray(q_frozen), np.asarray(q_ref), rtol=1e-3, atol=1e-4
+    )
+
+
+def test_beam_search_decoder_toy():
+    """Deterministic toy LM: token t always followed by (t+1) % vocab with
+    prob ~1 -> greedy path from start=1 is 2,3,4,0(end)."""
+    vocab = 5
+
+    def step_fn(tokens, states):
+        logp = np.full((tokens.size, vocab), -10.0, np.float32)
+        nxt = (tokens + 1) % vocab
+        logp[np.arange(tokens.size), nxt] = -0.1
+        return logp, states
+
+    dec = BeamSearchDecoder(step_fn, beam_size=2, start_token=1, end_token=0, max_len=8)
+    out, scores = dec.decode(batch_size=2)
+    np.testing.assert_array_equal(out[0, 0], [2, 3, 4, 0])
+    np.testing.assert_array_equal(out[1, 0], [2, 3, 4, 0])
+    assert scores.shape == (2, 2)
+
+
+def test_memory_usage_and_op_freq():
+    _train_func()
+    prog = fluid.default_main_program()
+    low, high = memory_usage(prog, batch_size=32)
+    assert 0 < low <= high
+    singles, pairs = op_freq_statistic(prog)
+    assert singles.get("mul", 0) >= 2 or singles.get("matmul", 0) >= 2
