@@ -37,8 +37,10 @@ from . import (
     parallel,
     param_attr,
     places,
+    native,
     profiler,
     reader,
+    recordio,
     regularizer,
     transpiler,
     unique_name,

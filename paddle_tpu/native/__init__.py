@@ -1,0 +1,222 @@
+"""Native runtime bindings (ctypes over libpaddle_tpu_native.so).
+
+The C++ pieces mirror the reference's native runtime components
+(SURVEY §2.13 recordio, §2.6 reader/ runtime): chunked RecordIO with
+CRC+compression, a GIL-free bounded blocking queue, and a threaded
+prefetch loader.  The library is built on demand with the local toolchain
+(`make` in this directory); callers fall back to pure Python when
+unavailable (`available()` is False).
+"""
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libpaddle_tpu_native.so")
+
+_lib = None
+_build_lock = threading.Lock()
+_build_failed = False
+_build_error = None  # diagnostics when the toolchain/compile fails
+
+
+def _try_build():
+    global _build_failed, _build_error
+    try:
+        # `make -s` is a fast no-op when the .so is newer than the sources,
+        # and rebuilds after source edits (stale-library trap avoided)
+        subprocess.run(
+            ["make", "-s"],
+            cwd=_DIR,
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except subprocess.CalledProcessError as e:
+        _build_failed = True
+        _build_error = (e.stderr or e.stdout or b"").decode(errors="replace")
+        return False
+    except Exception as e:
+        _build_failed = True
+        _build_error = repr(e)
+        return False
+
+
+def build_error():
+    """Compiler/toolchain output from a failed native build, or None."""
+    return _build_error
+
+
+def get_lib():
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _build_lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed:
+            return None
+        if not _try_build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        # signatures
+        lib.rio_writer_open.restype = ctypes.c_void_p
+        lib.rio_writer_open.argtypes = [ctypes.c_char_p, ctypes.c_uint32, ctypes.c_uint32]
+        lib.rio_writer_write.restype = ctypes.c_int
+        lib.rio_writer_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32]
+        lib.rio_writer_close.restype = ctypes.c_int
+        lib.rio_writer_close.argtypes = [ctypes.c_void_p]
+        lib.rio_scanner_open.restype = ctypes.c_void_p
+        lib.rio_scanner_open.argtypes = [ctypes.c_char_p]
+        lib.rio_scanner_next.restype = ctypes.POINTER(ctypes.c_char)
+        lib.rio_scanner_next.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint32)]
+        lib.rio_scanner_close.argtypes = [ctypes.c_void_p]
+        lib.bq_create.restype = ctypes.c_void_p
+        lib.bq_create.argtypes = [ctypes.c_uint32]
+        lib.bq_push.restype = ctypes.c_int
+        lib.bq_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32, ctypes.c_int]
+        lib.bq_pop.restype = ctypes.c_int
+        lib.bq_pop.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_uint32,
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_int,
+        ]
+        lib.bq_size.restype = ctypes.c_uint32
+        lib.bq_size.argtypes = [ctypes.c_void_p]
+        lib.bq_close.argtypes = [ctypes.c_void_p]
+        lib.bq_destroy.argtypes = [ctypes.c_void_p]
+        lib.rio_loader_open.restype = ctypes.c_void_p
+        lib.rio_loader_open.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.c_uint32,
+            ctypes.c_uint32,
+            ctypes.c_uint32,
+        ]
+        lib.rio_loader_next.restype = ctypes.c_int
+        lib.rio_loader_next.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_uint32,
+            ctypes.POINTER(ctypes.c_uint32),
+        ]
+        lib.rio_loader_error.restype = ctypes.c_int
+        lib.rio_loader_error.argtypes = [ctypes.c_void_p]
+        lib.rio_scanner_error.restype = ctypes.c_int
+        lib.rio_scanner_error.argtypes = [ctypes.c_void_p]
+        lib.rio_loader_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available():
+    return get_lib() is not None
+
+
+class BlockingQueue:
+    """GIL-free bounded byte queue (lod_tensor_blocking_queue.h analog)."""
+
+    def __init__(self, capacity=64):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._h = lib.bq_create(capacity)
+
+    def push(self, data, timeout_ms=-1):
+        return self._lib.bq_push(self._h, bytes(data), len(data), timeout_ms) == 0
+
+    def pop(self, timeout_ms=-1):
+        """Returns bytes, or None on timeout / closed+drained."""
+        n = ctypes.c_uint32(4096)
+        buf = ctypes.create_string_buffer(n.value)
+        while True:
+            rc = self._lib.bq_pop(self._h, buf, len(buf), ctypes.byref(n), timeout_ms)
+            if rc < 0:
+                return None
+            if rc == 0:
+                return buf.raw[: n.value]
+            # rc == 1: another consumer may race us to the front item, so
+            # grow-and-retry until a copy succeeds
+            buf = ctypes.create_string_buffer(n.value)
+
+    def size(self):
+        return int(self._lib.bq_size(self._h))
+
+    def close(self):
+        self._lib.bq_close(self._h)
+
+    def destroy(self):
+        """Free the native queue.  Only call once no thread is blocked in
+        push/pop — freeing under a blocked waiter is use-after-free."""
+        if getattr(self, "_h", None):
+            self._lib.bq_close(self._h)
+            self._lib.bq_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        # close() only: it wakes blocked waiters safely; the handle itself
+        # is reclaimed at process exit (destroy() is explicit because a
+        # waiter could still be inside the native call)
+        try:
+            if getattr(self, "_h", None):
+                self._lib.bq_close(self._h)
+        except Exception:
+            pass
+
+
+class RecordIOLoader:
+    """Threaded prefetching reader over RecordIO files (open_files_op +
+    buffered_reader analog): C++ worker threads scan + decompress off the
+    GIL; iteration yields raw record bytes."""
+
+    def __init__(self, paths, capacity=256, n_threads=2):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        for p in paths:
+            if not os.path.exists(p):
+                raise IOError("recordio file not found: %s" % p)
+        self._lib = lib
+        arr = (ctypes.c_char_p * len(paths))(
+            *[p.encode() for p in paths]
+        )
+        self._h = lib.rio_loader_open(arr, len(paths), capacity, n_threads)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._h is None:
+            raise StopIteration
+        n = ctypes.c_uint32(4096)
+        buf = ctypes.create_string_buffer(n.value)
+        while True:
+            rc = self._lib.rio_loader_next(self._h, buf, len(buf), ctypes.byref(n))
+            if rc < 0:
+                if self._lib.rio_loader_error(self._h):
+                    self.close()
+                    raise IOError("recordio loader hit a corrupted file")
+                raise StopIteration
+            if rc == 0:
+                return buf.raw[: n.value]
+            buf = ctypes.create_string_buffer(n.value)
+
+    def close(self):
+        if self._h is not None:
+            self._lib.rio_loader_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

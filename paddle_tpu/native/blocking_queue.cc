@@ -1,0 +1,197 @@
+// Bounded MPMC blocking byte queue + threaded RecordIO prefetch loader.
+//
+// C++ re-design of the reference's reader runtime
+// (operators/reader/lod_tensor_blocking_queue.h, buffered_reader.cc,
+// open_files_op.cc): the Python->device feeding path keeps file IO,
+// decompression and queueing OFF the Python GIL — worker threads scan
+// RecordIO files and fill the queue; Python pops complete records.
+// Exposed as a C ABI for ctypes.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+// from recordio.cc
+void* rio_scanner_open(const char* path);
+const char* rio_scanner_next(void* h, uint32_t* len);
+int rio_scanner_error(void* h);
+void rio_scanner_close(void* h);
+}
+
+namespace {
+
+struct Queue {
+  size_t capacity;
+  std::deque<std::string> items;
+  std::mutex mu;
+  std::condition_variable not_full, not_empty;
+  bool closed = false;
+
+  explicit Queue(size_t cap) : capacity(cap ? cap : 1) {}
+
+  bool push(const char* data, uint32_t len, int timeout_ms) {
+    std::unique_lock<std::mutex> lk(mu);
+    auto pred = [&] { return closed || items.size() < capacity; };
+    if (timeout_ms < 0) {
+      not_full.wait(lk, pred);
+    } else if (!not_full.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                                  pred)) {
+      return false;
+    }
+    if (closed) return false;
+    items.emplace_back(data, len);
+    not_empty.notify_one();
+    return true;
+  }
+
+  // returns true + moves front into out; false on timeout or closed+empty
+  bool pop(std::string* out, int timeout_ms) {
+    std::unique_lock<std::mutex> lk(mu);
+    auto pred = [&] { return closed || !items.empty(); };
+    if (timeout_ms < 0) {
+      not_empty.wait(lk, pred);
+    } else if (!not_empty.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                                   pred)) {
+      return false;
+    }
+    if (items.empty()) return false;  // closed + drained
+    *out = std::move(items.front());
+    items.pop_front();
+    not_full.notify_one();
+    return true;
+  }
+
+  // single-call copy-out: 0 = copied, 1 = dst too small (*len = needed,
+  // item stays at the front — stateless probe, no cross-call latch),
+  // -1 = timeout or closed+drained
+  int pop_into(char* dst, uint32_t cap, uint32_t* len, int timeout_ms) {
+    std::unique_lock<std::mutex> lk(mu);
+    auto pred = [&] { return closed || !items.empty(); };
+    if (timeout_ms < 0) {
+      not_empty.wait(lk, pred);
+    } else if (!not_empty.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                                   pred)) {
+      *len = 0;
+      return -1;
+    }
+    if (items.empty()) {
+      *len = 0;
+      return -1;
+    }
+    const std::string& front = items.front();
+    *len = front.size();
+    if (dst == nullptr || cap < front.size()) return 1;
+    memcpy(dst, front.data(), front.size());
+    items.pop_front();
+    not_full.notify_one();
+    return 0;
+  }
+
+  void close() {
+    std::lock_guard<std::mutex> lk(mu);
+    closed = true;
+    not_full.notify_all();
+    not_empty.notify_all();
+  }
+};
+
+struct Loader {
+  Queue queue;
+  std::vector<std::string> files;
+  std::vector<std::thread> workers;
+  std::atomic<int> active{0};
+  std::atomic<size_t> next_file{0};
+  std::atomic<bool> stop{false};
+  std::atomic<int> err{0};  // open failure or corruption in any file
+
+  Loader(size_t cap) : queue(cap) {}
+
+  void work() {
+    for (;;) {
+      size_t i = next_file.fetch_add(1);
+      if (i >= files.size() || stop.load()) break;
+      void* sc = rio_scanner_open(files[i].c_str());
+      if (!sc) {
+        err.store(1);
+        continue;
+      }
+      uint32_t len;
+      const char* rec;
+      while (!stop.load() && (rec = rio_scanner_next(sc, &len)) != nullptr) {
+        if (!queue.push(rec, len, -1)) break;  // queue closed
+      }
+      if (rio_scanner_error(sc)) err.store(1);
+      rio_scanner_close(sc);
+    }
+    if (active.fetch_sub(1) == 1) queue.close();  // last worker out: EOF
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// ---- raw queue ---------------------------------------------------------
+void* bq_create(uint32_t capacity) { return new Queue(capacity); }
+
+int bq_push(void* h, const char* data, uint32_t len, int timeout_ms) {
+  return static_cast<Queue*>(h)->push(data, len, timeout_ms) ? 0 : -1;
+}
+
+// pop with length probe: dst=null (or too small) returns 1 and sets *len;
+// the item stays at the queue front, so callers loop until rc==0.
+int bq_pop(void* h, char* dst, uint32_t cap, uint32_t* len, int timeout_ms) {
+  return static_cast<Queue*>(h)->pop_into(dst, cap, len, timeout_ms);
+}
+
+uint32_t bq_size(void* h) {
+  auto* q = static_cast<Queue*>(h);
+  std::lock_guard<std::mutex> lk(q->mu);
+  return q->items.size();
+}
+
+void bq_close(void* h) { static_cast<Queue*>(h)->close(); }
+
+void bq_destroy(void* h) { delete static_cast<Queue*>(h); }
+
+// ---- prefetch loader ---------------------------------------------------
+void* rio_loader_open(const char** paths, uint32_t n_paths, uint32_t capacity,
+                      uint32_t n_threads) {
+  auto* l = new Loader(capacity);
+  for (uint32_t i = 0; i < n_paths; ++i) l->files.emplace_back(paths[i]);
+  if (n_threads == 0) n_threads = 1;
+  if (n_threads > l->files.size()) n_threads = l->files.size();
+  if (n_threads == 0) n_threads = 1;
+  l->active.store(static_cast<int>(n_threads));
+  for (uint32_t i = 0; i < n_threads; ++i)
+    l->workers.emplace_back([l] { l->work(); });
+  return l;
+}
+
+// copies the next record into dst: probe with dst=null for the length,
+// then call with a buffer (record stays at the queue front until copied)
+int rio_loader_next(void* h, char* dst, uint32_t cap, uint32_t* len) {
+  return static_cast<Loader*>(h)->queue.pop_into(dst, cap, len, -1);
+}
+
+// 1 when any file failed to open or stopped on corruption
+int rio_loader_error(void* h) {
+  return static_cast<Loader*>(h)->err.load();
+}
+
+void rio_loader_close(void* h) {
+  auto* l = static_cast<Loader*>(h);
+  l->stop.store(true);
+  l->queue.close();
+  for (auto& t : l->workers) t.join();
+  delete l;
+}
+
+}  // extern "C"

@@ -1,0 +1,203 @@
+// RecordIO: chunked record file format with CRC32 + zlib compression.
+//
+// C++ re-design of the reference's paddle/fluid/recordio/ (header.h:25
+// Compressor enum, chunk.cc, writer.h:22, scanner.h:26) for the TPU
+// framework's input pipeline: a file is a sequence of chunks
+//
+//   [magic u32][compressor u32][crc32 u32][compressed_len u32][num_records u32]
+//   [compressed payload: num_records x (u32 len + bytes)]
+//
+// (snappy in the reference -> zlib here: always present, similar ratio at
+// level 1 for tensor data).  Exposed as a C ABI consumed via ctypes; the
+// Python fallback in paddle_tpu/recordio.py writes the identical format.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <zlib.h>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x0A0B0C0Du;
+
+enum Compressor : uint32_t { kNone = 0, kZlib = 1 };
+
+struct Writer {
+  FILE* f = nullptr;
+  uint32_t compressor = kZlib;
+  uint32_t max_records = 1000;
+  size_t max_bytes = 4u << 20;
+  std::string buf;          // raw concatenated records
+  uint32_t num_records = 0;
+
+  bool flush_chunk() {
+    if (num_records == 0) return true;
+    std::string payload;
+    if (compressor == kZlib) {
+      uLongf dst_len = compressBound(buf.size());
+      payload.resize(dst_len);
+      if (compress2(reinterpret_cast<Bytef*>(&payload[0]), &dst_len,
+                    reinterpret_cast<const Bytef*>(buf.data()), buf.size(),
+                    /*level=*/1) != Z_OK)
+        return false;
+      payload.resize(dst_len);
+    } else {
+      payload = buf;
+    }
+    uint32_t crc = crc32(0L, reinterpret_cast<const Bytef*>(payload.data()),
+                         payload.size());
+    uint32_t hdr[5] = {kMagic, compressor, crc,
+                       static_cast<uint32_t>(payload.size()), num_records};
+    if (fwrite(hdr, sizeof(hdr), 1, f) != 1) return false;
+    if (!payload.empty() &&
+        fwrite(payload.data(), payload.size(), 1, f) != 1)
+      return false;
+    buf.clear();
+    num_records = 0;
+    return true;
+  }
+};
+
+struct Scanner {
+  FILE* f = nullptr;
+  std::string chunk;        // decompressed records of current chunk
+  size_t pos = 0;           // cursor into chunk
+  uint32_t remaining = 0;   // records left in current chunk
+  std::string record;       // last returned record
+  int err = 0;              // corruption seen (vs clean EOF)
+
+  bool load_chunk() {
+    uint32_t hdr[5];
+    size_t got = fread(hdr, 1, sizeof(hdr), f);
+    if (got == 0 && feof(f)) return false;  // clean EOF
+    if (got < sizeof(hdr)) {
+      err = 1;  // truncated header
+      return false;
+    }
+    if (hdr[0] != kMagic) {
+      err = 1;
+      return false;
+    }
+    std::string payload(hdr[3], '\0');
+    if (hdr[3] > 0 && fread(&payload[0], hdr[3], 1, f) != 1) {
+      err = 1;  // truncated chunk
+      return false;
+    }
+    uint32_t crc = crc32(0L, reinterpret_cast<const Bytef*>(payload.data()),
+                         payload.size());
+    if (crc != hdr[2]) {
+      err = 1;  // corrupted chunk
+      return false;
+    }
+    if (hdr[1] == kZlib) {
+      // records expand; grow until it fits
+      uLongf dst_len = payload.size() * 4 + 1024;
+      for (;;) {
+        chunk.resize(dst_len);
+        int rc = uncompress(reinterpret_cast<Bytef*>(&chunk[0]), &dst_len,
+                            reinterpret_cast<const Bytef*>(payload.data()),
+                            payload.size());
+        if (rc == Z_OK) break;
+        if (rc != Z_BUF_ERROR) {
+          err = 1;
+          return false;
+        }
+        dst_len *= 2;
+      }
+      chunk.resize(dst_len);
+    } else {
+      chunk = payload;
+    }
+    pos = 0;
+    remaining = hdr[4];
+    return true;
+  }
+
+  bool next() {
+    while (remaining == 0) {
+      if (!load_chunk()) return false;
+    }
+    if (pos + 4 > chunk.size()) {
+      err = 1;
+      return false;
+    }
+    uint32_t len;
+    memcpy(&len, chunk.data() + pos, 4);
+    pos += 4;
+    if (pos + len > chunk.size()) {
+      err = 1;
+      return false;
+    }
+    record.assign(chunk.data() + pos, len);
+    pos += len;
+    --remaining;
+    return true;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* rio_writer_open(const char* path, uint32_t compressor,
+                      uint32_t max_records_per_chunk) {
+  FILE* f = fopen(path, "wb");
+  if (!f) return nullptr;
+  auto* w = new Writer();
+  w->f = f;
+  w->compressor = compressor;
+  if (max_records_per_chunk) w->max_records = max_records_per_chunk;
+  return w;
+}
+
+int rio_writer_write(void* h, const char* data, uint32_t len) {
+  auto* w = static_cast<Writer*>(h);
+  uint32_t n = len;
+  w->buf.append(reinterpret_cast<const char*>(&n), 4);
+  w->buf.append(data, len);
+  ++w->num_records;
+  if (w->num_records >= w->max_records || w->buf.size() >= w->max_bytes)
+    return w->flush_chunk() ? 0 : -1;
+  return 0;
+}
+
+int rio_writer_close(void* h) {
+  auto* w = static_cast<Writer*>(h);
+  int rc = w->flush_chunk() ? 0 : -1;
+  fclose(w->f);
+  delete w;
+  return rc;
+}
+
+void* rio_scanner_open(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  auto* s = new Scanner();
+  s->f = f;
+  return s;
+}
+
+// returns pointer to the record (valid until the next call) or null at EOF
+const char* rio_scanner_next(void* h, uint32_t* len) {
+  auto* s = static_cast<Scanner*>(h);
+  if (!s->next()) {
+    *len = 0;
+    return nullptr;
+  }
+  *len = s->record.size();
+  return s->record.data();
+}
+
+// 1 when the scanner stopped on corruption rather than clean EOF
+int rio_scanner_error(void* h) { return static_cast<Scanner*>(h)->err; }
+
+void rio_scanner_close(void* h) {
+  auto* s = static_cast<Scanner*>(h);
+  fclose(s->f);
+  delete s;
+}
+
+}  // extern "C"

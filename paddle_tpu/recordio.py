@@ -1,0 +1,233 @@
+"""RecordIO: chunked record files for the fast input path.
+
+Python surface over the native C++ implementation (paddle_tpu/native/
+recordio.cc — the re-design of paddle/fluid/recordio/ writer.h:22,
+scanner.h:26 and python/paddle/fluid/recordio_writer.py), with a
+pure-Python fallback writing the IDENTICAL on-disk format (struct+zlib),
+so files interoperate regardless of which side wrote them.
+
+High-level helpers serialize feed samples (tuples of ndarrays) with
+np.savez, mirroring convert_reader_to_recordio_file.
+"""
+
+import io as _io
+import struct
+import zlib
+
+import numpy as np
+
+from . import native
+
+__all__ = [
+    "Writer",
+    "Scanner",
+    "convert_reader_to_recordio_file",
+    "recordio_reader",
+]
+
+_MAGIC = 0x0A0B0C0D
+_HDR = struct.Struct("<5I")
+_LEN = struct.Struct("<I")
+
+COMPRESSOR_NONE = 0
+COMPRESSOR_ZLIB = 1
+
+
+class _PyWriter:
+    def __init__(self, path, compressor=COMPRESSOR_ZLIB, max_records=1000):
+        self._f = open(path, "wb")
+        self._compressor = compressor
+        self._max = max_records
+        self._buf = []
+        self._n = 0
+
+    def write(self, data):
+        self._buf.append(_LEN.pack(len(data)) + bytes(data))
+        self._n += 1
+        if self._n >= self._max:
+            self._flush()
+
+    def _flush(self):
+        if not self._n:
+            return
+        payload = b"".join(self._buf)
+        if self._compressor == COMPRESSOR_ZLIB:
+            payload = zlib.compress(payload, 1)
+        crc = zlib.crc32(payload) & 0xFFFFFFFF
+        self._f.write(
+            _HDR.pack(_MAGIC, self._compressor, crc, len(payload), self._n)
+        )
+        self._f.write(payload)
+        self._buf = []
+        self._n = 0
+
+    def close(self):
+        self._flush()
+        self._f.close()
+
+
+class _PyScanner:
+    def __init__(self, path):
+        self._f = open(path, "rb")
+        self._records = iter(())
+
+    def _next_chunk(self):
+        hdr = self._f.read(_HDR.size)
+        if len(hdr) < _HDR.size:
+            return None
+        magic, comp, crc, plen, n = _HDR.unpack(hdr)
+        if magic != _MAGIC:
+            raise IOError("bad recordio magic")
+        payload = self._f.read(plen)
+        if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+            raise IOError("recordio chunk crc mismatch")
+        try:
+            if comp == COMPRESSOR_ZLIB:
+                payload = zlib.decompress(payload)
+            out = []
+            pos = 0
+            for _ in range(n):
+                (ln,) = _LEN.unpack_from(payload, pos)
+                pos += _LEN.size
+                if pos + ln > len(payload):
+                    raise IOError("recordio record overruns chunk")
+                out.append(payload[pos : pos + ln])
+                pos += ln
+        except (struct.error, zlib.error) as e:
+            raise IOError("recordio chunk corrupted: %s" % e)
+        return out
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        while True:
+            try:
+                return next(self._records)
+            except StopIteration:
+                chunk = self._next_chunk()
+                if chunk is None:
+                    self._f.close()
+                    raise
+                self._records = iter(chunk)
+
+    def close(self):
+        self._f.close()
+
+
+class _NativeWriter:
+    def __init__(self, path, compressor=COMPRESSOR_ZLIB, max_records=1000):
+        self._lib = native.get_lib()
+        self._h = self._lib.rio_writer_open(
+            path.encode(), compressor, max_records
+        )
+        if not self._h:
+            raise IOError("cannot open %s" % path)
+
+    def write(self, data):
+        if self._lib.rio_writer_write(self._h, bytes(data), len(data)) != 0:
+            raise IOError("recordio write failed")
+
+    def close(self):
+        if self._h:
+            rc = self._lib.rio_writer_close(self._h)
+            self._h = None
+            if rc != 0:
+                raise IOError("recordio writer: final chunk flush failed")
+
+
+class _NativeScanner:
+    def __init__(self, path):
+        import ctypes
+
+        self._ctypes = ctypes
+        self._lib = native.get_lib()
+        self._h = self._lib.rio_scanner_open(path.encode())
+        if not self._h:
+            raise IOError("cannot open %s" % path)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        ct = self._ctypes
+        n = ct.c_uint32()
+        ptr = self._lib.rio_scanner_next(self._h, ct.byref(n))
+        if not ptr:
+            corrupted = bool(self._lib.rio_scanner_error(self._h))
+            self.close()
+            if corrupted:
+                raise IOError("recordio chunk corrupted or truncated")
+            raise StopIteration
+        return ct.string_at(ptr, n.value)
+
+    def close(self):
+        if self._h:
+            self._lib.rio_scanner_close(self._h)
+            self._h = None
+
+
+def Writer(path, compressor=COMPRESSOR_ZLIB, max_records_per_chunk=1000):
+    if native.available():
+        return _NativeWriter(path, compressor, max_records_per_chunk)
+    return _PyWriter(path, compressor, max_records_per_chunk)
+
+
+def Scanner(path):
+    if native.available():
+        return _NativeScanner(path)
+    return _PyScanner(path)
+
+
+# ---- sample (de)serialization -------------------------------------------
+def pack_sample(sample):
+    """Tuple/list of array-likes -> bytes (np.savez, positional keys)."""
+    buf = _io.BytesIO()
+    arrays = {
+        "f%d" % i: np.asarray(v) for i, v in enumerate(sample)
+    }
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def unpack_sample(data):
+    blob = np.load(_io.BytesIO(data))
+    return tuple(blob["f%d" % i] for i in range(len(blob.files)))
+
+
+def convert_reader_to_recordio_file(
+    filename, reader_creator, compressor=COMPRESSOR_ZLIB, max_num_records=1000
+):
+    """Serialize every sample from the reader into a RecordIO file
+    (recordio_writer.py analog); returns the record count."""
+    w = Writer(filename, compressor, max_num_records)
+    count = 0
+    try:
+        for sample in reader_creator():
+            w.write(pack_sample(sample))
+            count += 1
+    finally:
+        w.close()
+    return count
+
+
+def recordio_reader(paths, use_native_loader=True, capacity=256, n_threads=2):
+    """Reader creator over RecordIO files; uses the C++ threaded prefetch
+    loader when available (the --use_reader_op fast path analog)."""
+    if isinstance(paths, str):
+        paths = [paths]
+
+    def reader():
+        if use_native_loader and native.available():
+            loader = native.RecordIOLoader(paths, capacity, n_threads)
+            try:
+                for rec in loader:
+                    yield unpack_sample(rec)
+            finally:
+                loader.close()
+        else:
+            for p in paths:
+                for rec in Scanner(p):
+                    yield unpack_sample(rec)
+
+    return reader

@@ -1,0 +1,125 @@
+"""Native C++ runtime: recordio roundtrip + C++/Python format interop,
+blocking queue concurrency, threaded prefetch loader."""
+
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import native, recordio
+
+
+def test_native_builds():
+    assert native.available(), "native library failed to build"
+
+
+def _write_with(writer_cls, path, records):
+    w = writer_cls(path, recordio.COMPRESSOR_ZLIB, 3)  # small chunks
+    for r in records:
+        w.write(r)
+    w.close()
+
+
+@pytest.mark.parametrize("writer_native", [True, False])
+@pytest.mark.parametrize("scanner_native", [True, False])
+def test_recordio_interop(tmp_path, writer_native, scanner_native):
+    """Files written by either side read back identically on either side."""
+    if (writer_native or scanner_native) and not native.available():
+        pytest.skip("no native lib")
+    path = str(tmp_path / "data.recordio")
+    records = [bytes([i]) * (i * 37 + 1) for i in range(10)]
+    wcls = recordio._NativeWriter if writer_native else recordio._PyWriter
+    scls = recordio._NativeScanner if scanner_native else recordio._PyScanner
+    _write_with(wcls, path, records)
+    got = list(scls(path))
+    assert got == records
+
+
+def test_recordio_sample_roundtrip(tmp_path):
+    path = str(tmp_path / "samples.recordio")
+    rng = np.random.RandomState(0)
+    samples = [(rng.rand(3, 4).astype("float32"), np.int64(i)) for i in range(7)]
+    n = recordio.convert_reader_to_recordio_file(path, lambda: iter(samples))
+    assert n == 7
+    back = list(recordio.recordio_reader(path)())
+    assert len(back) == 7
+    for (a, b), (a2, b2) in zip(samples, back):
+        np.testing.assert_array_equal(a, a2)
+        assert int(b) == int(b2)
+
+
+def test_blocking_queue_concurrent():
+    if not native.available():
+        pytest.skip("no native lib")
+    q = native.BlockingQueue(capacity=4)
+    items = [("item-%04d" % i).encode() for i in range(200)]
+    got = []
+
+    def producer():
+        for it in items:
+            assert q.push(it)
+        q.close()
+
+    def consumer():
+        while True:
+            v = q.pop()
+            if v is None:
+                return
+            got.append(v)
+
+    threads = [threading.Thread(target=producer)] + [
+        threading.Thread(target=consumer) for _ in range(3)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert sorted(got) == sorted(items)
+
+
+def test_blocking_queue_timeout():
+    if not native.available():
+        pytest.skip("no native lib")
+    q = native.BlockingQueue(capacity=1)
+    assert q.pop(timeout_ms=50) is None  # empty: times out, no deadlock
+    assert q.push(b"x")
+    assert not q.push(b"y", timeout_ms=50)  # full: times out
+
+
+def test_native_loader_multifile(tmp_path):
+    if not native.available():
+        pytest.skip("no native lib")
+    paths = []
+    expected = []
+    for f in range(3):
+        p = str(tmp_path / ("part-%d.recordio" % f))
+        recs = [("f%d-r%d" % (f, i)).encode() for i in range(25)]
+        _write_with(recordio._PyWriter, p, recs)
+        expected.extend(recs)
+        paths.append(p)
+    loader = native.RecordIOLoader(paths, capacity=8, n_threads=3)
+    got = list(loader)
+    assert sorted(got) == sorted(expected)
+
+
+@pytest.mark.parametrize("native_scanner", [True, False])
+def test_recordio_corruption_detected(tmp_path, native_scanner):
+    """Truncated/bit-flipped files raise IOError, never silent EOF."""
+    if native_scanner and not native.available():
+        pytest.skip("no native lib")
+    path = str(tmp_path / "c.recordio")
+    _write_with(recordio._PyWriter, path, [b"x" * 100 for _ in range(9)])
+    blob = bytearray(open(path, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF  # flip a payload bit
+    open(path, "wb").write(bytes(blob))
+    scls = recordio._NativeScanner if native_scanner else recordio._PyScanner
+    with pytest.raises(IOError):
+        list(scls(path))
+
+
+def test_native_loader_missing_file(tmp_path):
+    if not native.available():
+        pytest.skip("no native lib")
+    with pytest.raises(IOError):
+        native.RecordIOLoader([str(tmp_path / "nope.recordio")])
