@@ -28,6 +28,7 @@ from . import (
     dataset,
     distributed,
     framework,
+    inference,
     initializer,
     layers,
     lod,

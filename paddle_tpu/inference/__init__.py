@@ -1,0 +1,134 @@
+"""Inference engine (paddle/fluid/inference analog, SURVEY §2.10).
+
+The reference's serving stack is C++: NativePaddlePredictor (Scope +
+Executor + feed/fetch, api/api_impl.h:41) and AnalysisPredictor
+(ir fusion passes + NaiveExecutor, api/analysis_predictor.h:42).  Here the
+executor already compiles a program to ONE XLA executable, so the
+predictor's job is: load the saved model, run the analysis passes
+(program-level algebraic rewrites — conv+bn folding, dropout removal —
+XLA does the rest of the fusion), pin is_test, and serve through a cached
+compiled callable.
+
+    config = AnalysisConfig(model_dir)
+    predictor = create_paddle_predictor(config)
+    outs = predictor.run({"image": batch})
+"""
+
+import numpy as np
+
+from .. import framework, io
+from ..core.scope import Scope
+from ..executor import Executor
+
+
+class NativeConfig:
+    """Plain load-and-run config (NativeConfig analog)."""
+
+    def __init__(self, model_dir=None, place=None):
+        self.model_dir = model_dir
+        self.place = place
+        self.model_filename = None
+        self.params_filename = None
+        self.ir_optim = False
+
+
+class AnalysisConfig(NativeConfig):
+    """Adds the analysis/IR-pass pipeline (AnalysisConfig analog)."""
+
+    def __init__(self, model_dir=None, place=None):
+        super().__init__(model_dir, place)
+        self.ir_optim = True
+        self._passes = ["fold_batch_norm", "drop_train_ops", "memory_optimize"]
+
+    def switch_ir_optim(self, flag=True):
+        self.ir_optim = bool(flag)
+        return self
+
+    def pass_builder(self):
+        return self._passes
+
+
+class Predictor:
+    """Serving handle: owns a private scope + compiled program."""
+
+    def __init__(self, config):
+        self.config = config
+        self.scope = Scope()
+        self.exe = Executor(config.place)
+        (
+            self.program,
+            self.feed_names,
+            self.fetch_vars,
+        ) = io.load_inference_model(
+            config.model_dir,
+            self.exe,
+            model_filename=config.model_filename,
+            params_filename=config.params_filename,
+            scope=self.scope,
+        )
+        self.program._is_test = True
+        if config.ir_optim:
+            self._apply_analysis_passes()
+        self.fetch_names = [
+            v.name if isinstance(v, framework.Variable) else v
+            for v in self.fetch_vars
+        ]
+
+    def _apply_analysis_passes(self):
+        from ..transpiler import InferenceTranspiler, memory_optimize
+
+        passes = (
+            self.config.pass_builder()
+            if isinstance(self.config, AnalysisConfig)
+            else ["fold_batch_norm", "drop_train_ops"]
+        )
+        t = InferenceTranspiler()
+        if "fold_batch_norm" in passes or "drop_train_ops" in passes:
+            t.transpile(self.program, self.config.place, scope=self.scope)
+        if "memory_optimize" in passes:
+            memory_optimize(self.program)
+
+    def run(self, inputs):
+        """inputs: dict name->array, or list aligned with feed_names.
+        Returns list of np.ndarrays aligned with the fetch targets."""
+        if not isinstance(inputs, dict):
+            inputs = dict(zip(self.feed_names, inputs))
+        outs = self.exe.run(
+            self.program,
+            feed=inputs,
+            fetch_list=self.fetch_names,
+            scope=self.scope,
+        )
+        return [np.asarray(o) for o in outs]
+
+    def get_input_names(self):
+        return list(self.feed_names)
+
+    def get_output_names(self):
+        return list(self.fetch_names)
+
+    def clone(self):
+        """A predictor sharing this one's weights (zero-copy scope share),
+        with its own compile cache — the reference's thread-serving clone."""
+        cloned = Predictor.__new__(Predictor)
+        cloned.config = self.config
+        cloned.scope = self.scope
+        cloned.exe = Executor(self.config.place)
+        cloned.program = self.program
+        cloned.feed_names = list(self.feed_names)
+        cloned.fetch_vars = self.fetch_vars
+        cloned.fetch_names = list(self.fetch_names)
+        return cloned
+
+
+def create_paddle_predictor(config):
+    """CreatePaddlePredictor analog."""
+    return Predictor(config)
+
+
+__all__ = [
+    "NativeConfig",
+    "AnalysisConfig",
+    "Predictor",
+    "create_paddle_predictor",
+]

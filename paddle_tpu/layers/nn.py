@@ -15,6 +15,7 @@ from ..layer_helper import LayerHelper
 from ..param_attr import ParamAttr
 
 __all__ = [
+    "log_loss",
     "beam_search",
     "beam_search_decode",
     "fc",
@@ -1383,3 +1384,16 @@ def beam_search_decode(ids, scores, parent_idx=None, beam_size=None, end_id=0, n
         attrs={"end_id": end_id},
     )
     return sent_ids, sent_scores
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    """Negative-log-likelihood of a probability (log_loss_op.cc)."""
+    helper = LayerHelper("log_loss", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "log_loss",
+        inputs={"Predicted": [input], "Labels": [label]},
+        outputs={"Loss": [out]},
+        attrs={"epsilon": epsilon},
+    )
+    return out

@@ -1,1 +1,13 @@
-"""paddle_tpu.models"""
+"""Model zoo (benchmark/fluid/models + tests/book model roles)."""
+
+from . import (
+    ctr_deepfm,
+    machine_translation,
+    mnist,
+    resnet,
+    se_resnext,
+    sentiment,
+    transformer,
+    vgg,
+    word2vec,
+)

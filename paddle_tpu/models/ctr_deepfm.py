@@ -1,0 +1,72 @@
+"""DeepFM CTR model (dist_ctr.py / DeepFM benchmark role;
+BASELINE config 4 "DeepFM sparse CTR").
+
+Sparse id features -> (first-order weights) + (FM pairwise interactions
+via the sum-square trick) + (DNN over concatenated embeddings) -> sigmoid.
+Embedding lookups are the sparse path (lookup_table gather; SelectedRows-
+style segment-sum grads; is_distributed routes through the pserver
+prefetch ops when transpiled)."""
+
+from .. import ParamAttr, layers
+
+
+def deepfm(sparse_ids, dense_input, sparse_field_dims, embed_dim=8,
+           dnn_dims=(32, 32), is_sparse=False):
+    """sparse_ids: list of int64 [batch, 1] vars (one per field);
+    dense_input: [batch, D] float var or None.
+    Returns sigmoid CTR prediction [batch, 1]."""
+    # first order: per-field scalar weight
+    first = []
+    for i, (ids, dim) in enumerate(zip(sparse_ids, sparse_field_dims)):
+        w = layers.embedding(
+            ids, size=[dim, 1], dtype="float32", is_sparse=is_sparse,
+            param_attr=ParamAttr(name="fm_w1_%d" % i),
+        )
+        first.append(layers.reshape(w, [-1, 1]))
+    y_first = layers.sum(first)
+
+    # second order: FM sum-square trick over field embeddings
+    embs = []
+    for i, (ids, dim) in enumerate(zip(sparse_ids, sparse_field_dims)):
+        e = layers.embedding(
+            ids, size=[dim, embed_dim], dtype="float32", is_sparse=is_sparse,
+            param_attr=ParamAttr(name="fm_v_%d" % i),
+        )
+        embs.append(layers.reshape(e, [-1, 1, embed_dim]))
+    stacked = layers.concat(embs, axis=1)  # [b, fields, k]
+    sum_sq = layers.pow(layers.reduce_sum(stacked, dim=1), 2.0)
+    sq_sum = layers.reduce_sum(layers.pow(stacked, 2.0), dim=1)
+    y_second = layers.scale(
+        layers.reduce_sum(layers.elementwise_sub(sum_sq, sq_sum), dim=1,
+                          keep_dim=True),
+        scale=0.5,
+    )
+
+    # deep part
+    deep_in = layers.reshape(stacked, [-1, len(sparse_ids) * embed_dim])
+    if dense_input is not None:
+        deep_in = layers.concat([deep_in, dense_input], axis=1)
+    for d in dnn_dims:
+        deep_in = layers.fc(deep_in, size=d, act="relu")
+    y_deep = layers.fc(deep_in, size=1)
+
+    logit = layers.elementwise_add(
+        layers.elementwise_add(y_first, y_second), y_deep
+    )
+    return layers.sigmoid(logit)
+
+
+def build_deepfm_train(sparse_field_dims, dense_dim=4, embed_dim=8,
+                       is_sparse=False):
+    """Returns (feeds, avg_loss, auc_like_pred)."""
+    sparse_ids = [
+        layers.data("C%d" % i, shape=[1], dtype="int64")
+        for i in range(len(sparse_field_dims))
+    ]
+    dense = layers.data("dense", shape=[dense_dim]) if dense_dim else None
+    label = layers.data("click", shape=[1])
+    pred = deepfm(sparse_ids, dense, sparse_field_dims, embed_dim,
+                  is_sparse=is_sparse)
+    loss = layers.mean(layers.log_loss(pred, label, epsilon=1e-6))
+    feeds = sparse_ids + ([dense] if dense is not None else []) + [label]
+    return feeds, loss, pred

@@ -1,0 +1,45 @@
+"""Text-classification models (book/test_understand_sentiment +
+benchmark/fluid/models/stacked_dynamic_lstm roles): conv and stacked-LSTM
+nets over padded token sequences with length masks."""
+
+from .. import layers, nets
+
+
+def convolution_net(data, seq_len, input_dim, class_dim=2, emb_dim=32,
+                    hid_dim=32):
+    """Two parallel sequence-conv+pool branches -> softmax (book conv net)."""
+    emb = layers.embedding(data, size=[input_dim, emb_dim], dtype="float32")
+    conv_3 = nets.sequence_conv_pool(
+        emb, num_filters=hid_dim, filter_size=3, act="tanh", pool_type="sqrt",
+        seq_len=seq_len,
+    )
+    conv_4 = nets.sequence_conv_pool(
+        emb, num_filters=hid_dim, filter_size=4, act="tanh", pool_type="sqrt",
+        seq_len=seq_len,
+    )
+    return layers.fc([conv_3, conv_4], size=class_dim, act="softmax")
+
+
+def stacked_lstm_net(data, seq_len, input_dim, class_dim=2, emb_dim=32,
+                     hid_dim=32, stacked_num=3):
+    """Stacked bi-directional-ish LSTM (alternate reversed layers) with
+    max pooling over time (book stacked_lstm_net / stacked_dynamic_lstm)."""
+    assert stacked_num % 2 == 1
+    emb = layers.embedding(data, size=[input_dim, emb_dim], dtype="float32")
+
+    # fluid dynamic_lstm contract: input pre-projected to 4*hidden
+    fc1 = layers.fc(emb, size=hid_dim * 4, num_flatten_dims=2)
+    lstm1, _ = layers.dynamic_lstm(fc1, size=hid_dim * 4, seq_len=seq_len)
+    inputs = [fc1, lstm1]
+    for i in range(2, stacked_num + 1):
+        # multi-input fc == concat+fc (separate weights, summed)
+        fc = layers.fc(inputs, size=hid_dim * 4, num_flatten_dims=2)
+        lstm, _ = layers.dynamic_lstm(
+            fc, size=hid_dim * 4, is_reverse=(i % 2) == 0, seq_len=seq_len
+        )
+        inputs = [fc, lstm]
+
+    # max over time (padded positions masked to -inf by seq_len-aware pool)
+    fc_last = layers.sequence_pool(inputs[0], pool_type="max", seq_len=seq_len)
+    lstm_last = layers.sequence_pool(inputs[1], pool_type="max", seq_len=seq_len)
+    return layers.fc([fc_last, lstm_last], size=class_dim, act="softmax")

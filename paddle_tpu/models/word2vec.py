@@ -1,0 +1,40 @@
+"""N-gram word embedding model (book/test_word2vec role;
+benchmark word2vec / imikolov dataset shape).
+
+Four context words -> shared embedding -> concat -> hidden -> softmax over
+the vocabulary.  Embeddings share one table (param_attr name sharing, the
+is_sparse path exercises lookup_table's gather/segment-sum grads).
+"""
+
+from .. import ParamAttr, layers
+
+
+def ngram_model(words, dict_size, embed_size=32, hidden_size=256,
+                is_sparse=False):
+    """words: list of 4 int64 [batch, 1] vars (first/second/third/fourth).
+    Returns softmax predictions [batch, dict_size]."""
+    embeds = [
+        layers.embedding(
+            w,
+            size=[dict_size, embed_size],
+            dtype="float32",
+            is_sparse=is_sparse,
+            param_attr=ParamAttr(name="shared_w"),
+        )
+        for w in words
+    ]
+    concat = layers.concat(embeds, axis=-1)
+    concat = layers.reshape(concat, [0, len(words) * embed_size])
+    hidden = layers.fc(concat, size=hidden_size, act="sigmoid")
+    return layers.fc(hidden, size=dict_size, act="softmax")
+
+
+def build_word2vec_train(dict_size, embed_size=32, hidden_size=256,
+                         is_sparse=False):
+    """Returns (words, next_word, avg_loss, prediction)."""
+    names = ["firstw", "secondw", "thirdw", "fourthw"]
+    words = [layers.data(n, shape=[1], dtype="int64") for n in names]
+    next_word = layers.data("nextw", shape=[1], dtype="int64")
+    pred = ngram_model(words, dict_size, embed_size, hidden_size, is_sparse)
+    cost = layers.cross_entropy(pred, next_word)
+    return words, next_word, layers.mean(cost), pred

@@ -81,6 +81,15 @@ struct Scanner {
       err = 1;
       return false;
     }
+    // sanity-cap the chunk length BEFORE allocating: a corrupted length
+    // field (pre-CRC) must not drive a multi-GiB allocation whose
+    // bad_alloc would escape the C ABI and abort the host process.
+    // Writers cap chunks at ~4 MiB; 256 MiB is generously corrupt-proof.
+    constexpr uint32_t kMaxChunkBytes = 256u << 20;
+    if (hdr[3] > kMaxChunkBytes) {
+      err = 1;
+      return false;
+    }
     std::string payload(hdr[3], '\0');
     if (hdr[3] > 0 && fread(&payload[0], hdr[3], 1, f) != 1) {
       err = 1;  // truncated chunk

@@ -209,3 +209,12 @@ def _hierarchical_sigmoid(ctx, ins, attrs):
     # sum log(1 + exp(pre)) - bit*pre over the path
     cost = jnp.sum((jnp.logaddexp(0.0, pre) - bit * pre) * m, axis=1)
     return {"Out": [cost.reshape(-1, 1)], "PreOut": [pre]}
+
+
+@register("log_loss")
+def _log_loss(ctx, ins, attrs):
+    """operators/log_loss_op.cc: negative log likelihood of a probability."""
+    p, y = ins["Predicted"][0], ins["Labels"][0]
+    eps = attrs.get("epsilon", 1e-4)
+    out = -y * jnp.log(p + eps) - (1.0 - y) * jnp.log(1.0 - p + eps)
+    return {"Loss": [out]}

@@ -34,17 +34,22 @@ COMPRESSOR_ZLIB = 1
 
 
 class _PyWriter:
+    _MAX_BYTES = 4 << 20  # mirror the C++ writer's chunk byte cap
+
     def __init__(self, path, compressor=COMPRESSOR_ZLIB, max_records=1000):
         self._f = open(path, "wb")
         self._compressor = compressor
         self._max = max_records
         self._buf = []
         self._n = 0
+        self._nbytes = 0
 
     def write(self, data):
-        self._buf.append(_LEN.pack(len(data)) + bytes(data))
+        item = _LEN.pack(len(data)) + bytes(data)
+        self._buf.append(item)
         self._n += 1
-        if self._n >= self._max:
+        self._nbytes += len(item)
+        if self._n >= self._max or self._nbytes >= self._MAX_BYTES:
             self._flush()
 
     def _flush(self):
@@ -60,6 +65,7 @@ class _PyWriter:
         self._f.write(payload)
         self._buf = []
         self._n = 0
+        self._nbytes = 0
 
     def close(self):
         self._flush()
@@ -70,11 +76,14 @@ class _PyScanner:
     def __init__(self, path):
         self._f = open(path, "rb")
         self._records = iter(())
+        self._closed = False
 
     def _next_chunk(self):
         hdr = self._f.read(_HDR.size)
+        if len(hdr) == 0:
+            return None  # clean EOF
         if len(hdr) < _HDR.size:
-            return None
+            raise IOError("recordio file truncated mid-header")
         magic, comp, crc, plen, n = _HDR.unpack(hdr)
         if magic != _MAGIC:
             raise IOError("bad recordio magic")
@@ -101,17 +110,20 @@ class _PyScanner:
         return self
 
     def __next__(self):
+        if self._closed:
+            raise StopIteration
         while True:
             try:
                 return next(self._records)
             except StopIteration:
                 chunk = self._next_chunk()
                 if chunk is None:
-                    self._f.close()
+                    self.close()
                     raise
                 self._records = iter(chunk)
 
     def close(self):
+        self._closed = True
         self._f.close()
 
 

@@ -1,0 +1,213 @@
+"""Book-style integration tests (tests/book/test_* analogs): each model
+family trains on synthetic data, and where the book does, completes the
+full train -> save_inference_model -> load -> infer cycle."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.inference import AnalysisConfig, create_paddle_predictor
+
+
+def _exe():
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    return exe
+
+
+def test_fit_a_line_full_cycle(tmp_path):
+    """book/test_fit_a_line: linear regression, save + predictor parity."""
+    x = layers.data("x", shape=[13])
+    y = layers.data("y", shape=[1])
+    pred = layers.fc(x, size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(0.01).minimize(loss)
+
+    rng = np.random.RandomState(0)
+    xv = rng.rand(64, 13).astype("float32")
+    w_true = rng.rand(13, 1).astype("float32")
+    yv = xv @ w_true
+
+    exe = _exe()
+    losses = [
+        float(np.ravel(exe.run(feed={"x": xv, "y": yv}, fetch_list=[loss])[0])[0])
+        for _ in range(30)
+    ]
+    assert losses[-1] < losses[0] * 0.2
+
+    model_dir = str(tmp_path / "fit_a_line")
+    fluid.save_inference_model(model_dir, ["x"], [pred], exe)
+    predictor = create_paddle_predictor(AnalysisConfig(model_dir))
+    (out,) = predictor.run({"x": xv[:4]})
+    (ref,) = exe.run(
+        program=fluid.default_main_program().clone(for_test=True),
+        feed={"x": xv[:4]},
+        fetch_list=[pred],
+    )
+    np.testing.assert_allclose(out, np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+
+def test_word2vec_trains():
+    """book/test_word2vec: n-gram model on a tiny corpus."""
+    from paddle_tpu.models.word2vec import build_word2vec_train
+
+    dict_size = 30
+    words, next_word, loss, pred = build_word2vec_train(
+        dict_size, embed_size=8, hidden_size=16
+    )
+    fluid.optimizer.Adam(0.01).minimize(loss)
+    rng = np.random.RandomState(1)
+    feed = {
+        w.name: rng.randint(0, dict_size, (32, 1)).astype("int64")
+        for w in words
+    }
+    feed["nextw"] = rng.randint(0, dict_size, (32, 1)).astype("int64")
+    exe = _exe()
+    losses = [
+        float(np.ravel(exe.run(feed=feed, fetch_list=[loss])[0])[0])
+        for _ in range(15)
+    ]
+    assert losses[-1] < losses[0]
+
+
+@pytest.mark.parametrize("net", ["conv", "stacked_lstm"])
+def test_understand_sentiment(net):
+    """book/test_understand_sentiment: conv and stacked-LSTM variants."""
+    from paddle_tpu.models import sentiment
+
+    vocab, T = 50, 12
+    data = layers.data("words", shape=[T], dtype="int64")
+    seq_len = layers.data("seq_len", shape=[], dtype="int64")
+    label = layers.data("label", shape=[1], dtype="int64")
+    if net == "conv":
+        pred = sentiment.convolution_net(data, seq_len, vocab, hid_dim=16)
+    else:
+        pred = sentiment.stacked_lstm_net(
+            data, seq_len, vocab, hid_dim=16, stacked_num=3
+        )
+    loss = layers.mean(layers.cross_entropy(pred, label))
+    fluid.optimizer.Adam(0.02).minimize(loss)
+
+    rng = np.random.RandomState(2)
+    feed = {
+        "words": rng.randint(1, vocab, (16, T)).astype("int64"),
+        "seq_len": rng.randint(3, T, (16,)).astype("int64"),
+        "label": rng.randint(0, 2, (16, 1)).astype("int64"),
+    }
+    exe = _exe()
+    losses = [
+        float(np.ravel(exe.run(feed=feed, fetch_list=[loss])[0])[0])
+        for _ in range(10)
+    ]
+    assert losses[-1] < losses[0]
+
+
+def test_machine_translation_train_and_decode():
+    """book/test_machine_translation: seq2seq training + beam decode."""
+    from paddle_tpu.models.machine_translation import (
+        build_decode_step,
+        build_seq2seq_train,
+    )
+    from paddle_tpu.contrib.decoder import BeamSearchDecoder
+
+    src_vocab, tgt_vocab, Ts, Tt = 24, 20, 8, 8
+    feeds, loss = build_seq2seq_train(src_vocab, tgt_vocab, Ts, Tt,
+                                      embed_dim=8, hidden_dim=12)
+    fluid.optimizer.Adam(0.02).minimize(loss)
+    rng = np.random.RandomState(3)
+    feed = {
+        "src_word_id": rng.randint(1, src_vocab, (8, Ts)).astype("int64"),
+        "target_language_word": rng.randint(1, tgt_vocab, (8, Tt)).astype("int64"),
+        "target_language_next_word": rng.randint(1, tgt_vocab, (8, Tt)).astype("int64"),
+    }
+    exe = _exe()
+    losses = [
+        float(np.ravel(exe.run(feed=feed, fetch_list=[loss])[0])[0])
+        for _ in range(8)
+    ]
+    assert losses[-1] < losses[0]
+
+    # inference: one compiled decode step driven by the beam decoder
+    decode_prog = fluid.Program()
+    startup2 = fluid.Program()
+    with fluid.program_guard(decode_prog, startup2):
+        dfeeds, logp, new_h = build_decode_step(
+            src_vocab, tgt_vocab, Ts, embed_dim=8, hidden_dim=12
+        )
+    scope2 = fluid.Scope()
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    exe2.run(startup2, scope=scope2)
+
+    batch, beam, hid = 2, 3, 12
+    src = rng.randint(1, src_vocab, (batch, Ts)).astype("int64")
+    src_rep = np.repeat(src, beam, axis=0)
+
+    def step_fn(tokens, states):
+        lp, nh = exe2.run(
+            decode_prog,
+            feed={
+                "src_word_id": src_rep,
+                "cur_token": tokens.reshape(-1, 1).astype("int64"),
+                "prev_hidden": states,
+            },
+            fetch_list=[logp, new_h],
+            scope=scope2,
+        )
+        return np.asarray(lp), np.asarray(nh)
+
+    dec = BeamSearchDecoder(step_fn, beam, start_token=1, end_token=0, max_len=6)
+    out, scores = dec.decode(batch, init_states=np.zeros((batch * beam, hid), "float32"))
+    assert out.shape[0] == batch and out.shape[1] == beam
+    assert scores.shape == (batch, beam)
+    # repeatable: same inputs, same sequences
+    out2, _ = dec.decode(batch, init_states=np.zeros((batch * beam, hid), "float32"))
+    np.testing.assert_array_equal(out, out2)
+
+
+@pytest.mark.parametrize("is_sparse", [False, True])
+def test_deepfm_ctr_trains(is_sparse):
+    """DeepFM CTR (dist_ctr/DeepFM role) incl. the sparse lookup path."""
+    from paddle_tpu.models.ctr_deepfm import build_deepfm_train
+
+    field_dims = [17, 23, 11]
+    feeds, loss, pred = build_deepfm_train(field_dims, dense_dim=4,
+                                           embed_dim=4, is_sparse=is_sparse)
+    fluid.optimizer.Adam(0.02).minimize(loss)
+    rng = np.random.RandomState(4)
+    feed = {
+        "C%d" % i: rng.randint(0, d, (32, 1)).astype("int64")
+        for i, d in enumerate(field_dims)
+    }
+    feed["dense"] = rng.rand(32, 4).astype("float32")
+    feed["click"] = rng.randint(0, 2, (32, 1)).astype("float32")
+    exe = _exe()
+    losses = [
+        float(np.ravel(exe.run(feed=feed, fetch_list=[loss])[0])[0])
+        for _ in range(12)
+    ]
+    assert losses[-1] < losses[0]
+    (p,) = exe.run(feed=feed, fetch_list=[pred])
+    assert (np.asarray(p) >= 0).all() and (np.asarray(p) <= 1).all()
+
+
+def test_se_resnext_forward_backward():
+    """SE-ResNeXt block stack (tiny stage config) trains one step."""
+    from paddle_tpu.models.se_resnext import se_resnext
+
+    img = layers.data("img", shape=[3, 16, 16])
+    label = layers.data("label", shape=[1], dtype="int64")
+    pred = se_resnext(img, class_dim=4, stages=[1, 1], cardinality=4,
+                      num_filters=[8, 16])
+    loss = layers.mean(layers.cross_entropy(pred, label))
+    fluid.optimizer.SGD(0.05).minimize(loss)
+    rng = np.random.RandomState(5)
+    feed = {
+        "img": rng.rand(4, 3, 16, 16).astype("float32"),
+        "label": rng.randint(0, 4, (4, 1)).astype("int64"),
+    }
+    exe = _exe()
+    l0 = float(np.ravel(exe.run(feed=feed, fetch_list=[loss])[0])[0])
+    for _ in range(4):
+        l1 = float(np.ravel(exe.run(feed=feed, fetch_list=[loss])[0])[0])
+    assert np.isfinite(l1) and l1 < l0

@@ -1,0 +1,60 @@
+"""Inference predictor: save_inference_model -> Native/Analysis predictor
+parity with direct Executor runs (analyzer_*_tester.cc role)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.inference import (
+    AnalysisConfig,
+    NativeConfig,
+    create_paddle_predictor,
+)
+
+
+def _train_and_save(tmp_path):
+    img = layers.data("img", shape=[3, 8, 8])
+    label = layers.data("label", shape=[1], dtype="int64")
+    c = layers.conv2d(img, num_filters=4, filter_size=3, act=None)
+    bn = layers.batch_norm(c)
+    flat = layers.flatten(layers.relu(bn), axis=1)
+    pred = layers.fc(layers.dropout(flat, 0.3), size=10, act="softmax")
+    loss = layers.mean(layers.cross_entropy(pred, label))
+    fluid.optimizer.SGD(0.1).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    x = rng.rand(8, 3, 8, 8).astype("float32")
+    y = rng.randint(0, 10, (8, 1)).astype("int64")
+    for _ in range(3):
+        exe.run(feed={"img": x, "label": y}, fetch_list=[loss])
+
+    model_dir = str(tmp_path / "model")
+    fluid.save_inference_model(model_dir, ["img"], [pred], exe)
+    test_prog = fluid.default_main_program().clone(for_test=True)
+    (ref,) = exe.run(program=test_prog, feed={"img": x}, fetch_list=[pred])
+    return model_dir, x, np.asarray(ref)
+
+
+def test_native_predictor_parity(tmp_path):
+    model_dir, x, ref = _train_and_save(tmp_path)
+    pred = create_paddle_predictor(NativeConfig(model_dir))
+    (out,) = pred.run({"img": x})
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=1e-5)
+    assert pred.get_input_names() == ["img"]
+    assert len(pred.get_output_names()) == 1
+
+
+def test_analysis_predictor_parity_and_fusion(tmp_path):
+    model_dir, x, ref = _train_and_save(tmp_path)
+    pred = create_paddle_predictor(AnalysisConfig(model_dir))
+    types = [op.type for op in pred.program.global_block().ops]
+    assert "batch_norm" not in types  # folded by the analysis pass
+    assert "dropout" not in types
+    (out,) = pred.run({"img": x})
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+    # positional input form + clone sharing weights
+    clone = pred.clone()
+    (out2,) = clone.run([x])
+    np.testing.assert_allclose(out2, out, rtol=1e-6)
