@@ -25,6 +25,8 @@ from . import ops  # registers all op lowerings first
 from . import (
     backward,
     clip,
+    debugger,
+    flags,
     dataset,
     distributed,
     framework,

@@ -1,6 +1,6 @@
 """High-level / incubating APIs (python/paddle/fluid/contrib analog)."""
 
-from . import decoder, quantize
+from . import decoder, mixed_precision, quantize
 from .memory_usage_calc import memory_usage
 from .op_frequence import op_freq_statistic
 from .trainer import (
@@ -28,5 +28,6 @@ __all__ = [
     "memory_usage",
     "op_freq_statistic",
     "decoder",
+    "mixed_precision",
     "quantize",
 ]

@@ -1,0 +1,103 @@
+"""bfloat16 automatic mixed precision (contrib/float16 transpiler role,
+re-targeted at the TPU's native compute dtype).
+
+The reference's fp16 transpiler rewrites an inference program for half
+kernels; on TPU the MXU natively multiplies bf16 at full rate, so AMP is
+a training-time rewrite: cast the inputs of every matmul-class op
+(mul/matmul/conv2d/depthwise_conv2d) to bfloat16 and the result back to
+float32.  Master weights, accumulations, reductions, softmax and the
+optimizer all stay float32 — the standard bf16 recipe; no loss scaling is
+needed (bf16 has float32's exponent range).
+
+    loss = ...
+    rewrite_bf16(fluid.default_main_program())
+    opt.minimize(loss)      # grads flow through the casts
+"""
+
+from .. import framework
+
+_BF16_OPS = ("mul", "matmul", "conv2d", "depthwise_conv2d")
+
+
+def rewrite_bf16(program=None, ops=_BF16_OPS):
+    """Insert bf16 casts around matmul-class ops (in place).  Must run
+    BEFORE optimizer.minimize so the grad ops differentiate through the
+    casts.  Returns the count of rewritten ops."""
+    program = program or framework.default_main_program()
+    block = program.global_block()
+    new_ops = []
+    count = 0
+    cast_cache = {}  # var name -> bf16 var name (reuse within the block)
+
+    def cast_var(name, dst_dtype, tag):
+        key = (name, dst_dtype)
+        if key in cast_cache:
+            return cast_cache[key]
+        src = block._find_var_recursive(name)
+        out = block.create_var(
+            name="%s@%s" % (name, tag),
+            shape=list(src.shape) if src is not None and src.shape else None,
+            dtype=dst_dtype,
+        )
+        op = framework.Operator(
+            block,
+            "cast",
+            None,
+            None,
+            {"in_dtype": str(src.dtype) if src is not None else "float32",
+             "out_dtype": dst_dtype},
+        )
+        op.inputs = {"X": [name]}
+        op.outputs = {"Out": [out.name]}
+        new_ops.append(op)
+        cast_cache[key] = out.name
+        return out.name
+
+    for op in block.ops:
+        if (
+            op.type in ops
+            and op.attrs.get("op_role", "forward") == "forward"
+        ):
+            count += 1
+            for slot, names in list(op.inputs.items()):
+                op.inputs[slot] = [
+                    cast_var(n, "bfloat16", "BF16") for n in names
+                ]
+            new_ops.append(op)
+            # cast outputs back to f32, keeping downstream names intact:
+            # the op writes <out>@RAW_BF16 and a cast restores <out>
+            for slot, names in list(op.outputs.items()):
+                restored = []
+                for n in names:
+                    raw = n + "@RAW_BF16"
+                    v = block._find_var_recursive(n)
+                    block.create_var(
+                        name=raw,
+                        shape=list(v.shape) if v is not None and v.shape else None,
+                        dtype="bfloat16",
+                    )
+                    cast_back = framework.Operator(
+                        block,
+                        "cast",
+                        None,
+                        None,
+                        {"in_dtype": "bfloat16", "out_dtype": "float32"},
+                    )
+                    cast_back.inputs = {"X": [raw]}
+                    cast_back.outputs = {"Out": [n]}
+                    restored.append((slot, raw, cast_back))
+                op.outputs[slot] = [r[1] for r in restored]
+                for _, _, cb in restored:
+                    new_ops.append(cb)
+                    # cast-back redefines the original name: a later bf16
+                    # cast of it must re-derive from the new value
+                    cast_cache.pop((cb.outputs["Out"][0], "bfloat16"), None)
+        else:
+            new_ops.append(op)
+            # anything redefined later must not serve a stale cast
+            for names in op.outputs.values():
+                for n in names:
+                    cast_cache.pop((n, "bfloat16"), None)
+    block.ops = new_ops
+    program._bump_version()
+    return count

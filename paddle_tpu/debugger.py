@@ -1,0 +1,78 @@
+"""Program introspection: pretty-printer + graphviz dumps
+(python/paddle/fluid/debugger.py + net_drawer.py analogs)."""
+
+__all__ = ["pprint_program_codes", "pprint_block_codes", "draw_block_graphviz"]
+
+
+def _fmt_var(block, name):
+    v = block._find_var_recursive(name)
+    if v is None:
+        return name
+    return "%s[%s,%s]" % (name, "x".join(str(d) for d in (v.shape or [])), v.dtype)
+
+
+def pprint_block_codes(block, show_backward=True):
+    """One line per op: outs = op_type(ins) {attrs}."""
+    lines = []
+    for op in block.ops:
+        role = op.attrs.get("op_role", "forward")
+        if not show_backward and role in ("backward", "optimize"):
+            continue
+        outs = ", ".join(
+            _fmt_var(block, n) for names in op.outputs.values() for n in names
+        )
+        ins = ", ".join(
+            _fmt_var(block, n) for names in op.inputs.values() for n in names
+        )
+        attrs = {
+            k: v
+            for k, v in op.attrs.items()
+            if not k.startswith("__") and k not in ("op_role", "op_role_var")
+        }
+        attr_str = (" {%s}" % ", ".join("%s=%r" % kv for kv in sorted(attrs.items()))) if attrs else ""
+        lines.append("%s = %s(%s)%s  # %s" % (outs or "_", op.type, ins, attr_str, role))
+    return "\n".join(lines)
+
+
+def pprint_program_codes(program, show_backward=True):
+    out = []
+    for i, block in enumerate(program.blocks):
+        out.append("// block %d (parent %d)" % (block.idx, block.parent_idx))
+        out.append(pprint_block_codes(block, show_backward))
+    return "\n".join(out)
+
+
+def draw_block_graphviz(block, highlights=None, path="./graph.dot"):
+    """Emit a graphviz dot file: op nodes (boxes) + var nodes (ellipses),
+    edges by def/use (net_drawer.py / graph_viz_pass analog)."""
+    highlights = set(highlights or ())
+    lines = ["digraph G {", "  rankdir=TB;"]
+    var_ids = {}
+
+    def vid(name):
+        if name not in var_ids:
+            var_ids[name] = "var_%d" % len(var_ids)
+            color = ' style=filled fillcolor="lightcoral"' if name in highlights else ""
+            lines.append(
+                '  %s [label="%s" shape=ellipse%s];'
+                % (var_ids[name], _fmt_var(block, name), color)
+            )
+        return var_ids[name]
+
+    for i, op in enumerate(block.ops):
+        op_id = "op_%d" % i
+        lines.append(
+            '  %s [label="%s" shape=box style=filled fillcolor="lightblue"];'
+            % (op_id, op.type)
+        )
+        for names in op.inputs.values():
+            for n in names:
+                lines.append("  %s -> %s;" % (vid(n), op_id))
+        for names in op.outputs.values():
+            for n in names:
+                lines.append("  %s -> %s;" % (op_id, vid(n)))
+    lines.append("}")
+    text = "\n".join(lines)
+    with open(path, "w") as f:
+        f.write(text)
+    return text

@@ -13,6 +13,7 @@ Two capability families from the reference (SURVEY §2.9):
 import os
 
 from .rpc import RPCClient, VarServer
+from .master import Master, MasterClient, MasterService
 from .ps_server import ParameterServer, run_pserver
 
 # (endpoint, trainer_id) pairs this process has sent grads to — used by

@@ -1,0 +1,223 @@
+"""Fault-tolerant task-queue master (go/master/service.go re-design).
+
+The reference's Go master keeps a queue of data tasks in etcd: trainers
+GetTask/TaskFinished/TaskFailed, tasks time out and re-queue when a
+trainer dies, repeated failures discard a task, and state snapshots let a
+restarted master resume (SetDataset :280, GetTask :368, TaskFailed :455,
+timeout re-queue :341, snapshot :207).
+
+TPU-native re-homing (SURVEY §5.3): same protocol over the framework's
+TCP RPC with a JSON file snapshot standing in for etcd — the coordination
+backbone for elastic data dispatch across trainer hosts.
+"""
+
+import json
+import os
+import threading
+import time
+
+from .rpc import RPCClient, VarServer
+
+
+class Task:
+    def __init__(self, task_id, payload):
+        self.id = task_id
+        self.payload = payload
+        self.failures = 0
+        self.deadline = 0.0  # while pending
+
+    def to_dict(self):
+        return {"id": self.id, "payload": self.payload, "failures": self.failures}
+
+    @staticmethod
+    def from_dict(d):
+        t = Task(d["id"], d["payload"])
+        t.failures = d.get("failures", 0)
+        return t
+
+
+class MasterService:
+    """Service object for rpc.VarServer."""
+
+    def __init__(self, timeout_s=60.0, failure_max=3, snapshot_path=None,
+                 chunks_per_task=1):
+        self.timeout_s = timeout_s
+        self.failure_max = failure_max
+        self.snapshot_path = snapshot_path
+        self.chunks_per_task = max(1, chunks_per_task)
+        self._lock = threading.Lock()
+        self._todo = []      # [Task]
+        self._pending = {}   # task_id -> Task (leased)
+        self._done = []      # [Task]
+        self._next_id = 0
+        self._epoch_done = threading.Event()
+        if snapshot_path and os.path.exists(snapshot_path):
+            self._load_snapshot()
+
+    # ---- snapshot (etcd stand-in, service.go:207) ---------------------
+    def _save_snapshot(self):
+        if not self.snapshot_path:
+            return
+        state = {
+            "todo": [t.to_dict() for t in self._todo],
+            "pending": [t.to_dict() for t in self._pending.values()],
+            "done": [t.to_dict() for t in self._done],
+            "next_id": self._next_id,
+        }
+        tmp = self.snapshot_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, self.snapshot_path)
+
+    def _load_snapshot(self):
+        with open(self.snapshot_path) as f:
+            state = json.load(f)
+        # leased tasks from the dead master go back to todo
+        self._todo = [Task.from_dict(d) for d in state["todo"]] + [
+            Task.from_dict(d) for d in state["pending"]
+        ]
+        self._done = [Task.from_dict(d) for d in state["done"]]
+        self._next_id = state["next_id"]
+
+    # ---- verbs ---------------------------------------------------------
+    def handle(self, verb, **kw):
+        try:
+            return getattr(self, "_h_" + verb)(**kw)
+        except Exception as e:
+            import traceback
+
+            return {"__error__": "%s\n%s" % (e, traceback.format_exc())}
+
+    def _requeue_timeouts_locked(self):
+        now = time.time()
+        changed = False
+        for tid in [t for t, task in self._pending.items() if task.deadline < now]:
+            task = self._pending.pop(tid)
+            task.failures += 1
+            changed = True
+            if task.failures >= self.failure_max:
+                continue  # discarded (service.go failureMax)
+            self._todo.append(task)
+        return changed
+
+    def _h_set_dataset(self, chunks, trainer_id=0):
+        """Partition chunks into tasks (SetDataset :280)."""
+        with self._lock:
+            if self._todo or self._pending:
+                return {"ok": True, "already_set": True}
+            created = 0
+            group = []
+            for c in chunks:
+                group.append(c)
+                if len(group) >= self.chunks_per_task:
+                    self._todo.append(Task(self._next_id, group))
+                    self._next_id += 1
+                    created += 1
+                    group = []
+            if group:
+                self._todo.append(Task(self._next_id, group))
+                self._next_id += 1
+                created += 1
+            self._epoch_done.clear()
+            self._save_snapshot()
+        return {"ok": True, "num_tasks": created}
+
+    def _h_get_task(self, trainer_id=0):
+        """Lease a task (GetTask :368); {} when none available."""
+        with self._lock:
+            if self._requeue_timeouts_locked():
+                # timeouts/discards are durable state: persist them even on
+                # the empty-queue paths, or a master restart would resurrect
+                # discarded tasks from the stale snapshot
+                self._save_snapshot()
+            if not self._todo:
+                if not self._pending:
+                    self._epoch_done.set()
+                    return {"task": None, "epoch_done": True}
+                return {"task": None, "epoch_done": False}
+            task = self._todo.pop(0)
+            task.deadline = time.time() + self.timeout_s
+            self._pending[task.id] = task
+            self._save_snapshot()
+            return {"task": {"id": task.id, "payload": task.payload}}
+
+    def _h_task_finished(self, task_id, trainer_id=0):
+        with self._lock:
+            task = self._pending.pop(task_id, None)
+            if task is not None:
+                self._done.append(task)
+            if not self._todo and not self._pending:
+                self._epoch_done.set()
+            self._save_snapshot()
+        return {"ok": True}
+
+    def _h_task_failed(self, task_id, trainer_id=0):
+        """Explicit failure: requeue unless failure_max hit (TaskFailed :455)."""
+        with self._lock:
+            task = self._pending.pop(task_id, None)
+            if task is not None:
+                task.failures += 1
+                if task.failures < self.failure_max:
+                    self._todo.append(task)
+            self._save_snapshot()
+        return {"ok": True}
+
+    def _h_num_done(self, trainer_id=0):
+        with self._lock:
+            return {
+                "done": len(self._done),
+                "todo": len(self._todo),
+                "pending": len(self._pending),
+            }
+
+
+class Master:
+    """In-process master bootstrap: serve on an endpoint."""
+
+    def __init__(self, endpoint, timeout_s=60.0, failure_max=3,
+                 snapshot_path=None, chunks_per_task=1):
+        self.service = MasterService(
+            timeout_s, failure_max, snapshot_path, chunks_per_task
+        )
+        self.server = VarServer(endpoint, self.service).start()
+        self.endpoint = self.server.endpoint
+
+    def shutdown(self):
+        self.server.shutdown()
+
+
+class MasterClient:
+    """Trainer-side client (go/pserver/client role for the master)."""
+
+    def __init__(self, endpoint, trainer_id=0):
+        self._cli = RPCClient.get(endpoint)
+        self.trainer_id = trainer_id
+
+    def set_dataset(self, chunks):
+        return self._cli.call("set_dataset", chunks=list(chunks),
+                              trainer_id=self.trainer_id)
+
+    def get_task(self):
+        """Returns (task_id, payload), or (None, None) when nothing is
+        leasable right now; check epoch_done()/stats() to distinguish a
+        drained epoch from tasks pending on other trainers."""
+        r = self._cli.call("get_task", trainer_id=self.trainer_id)
+        self._last_epoch_done = bool(r.get("epoch_done", False))
+        if r.get("task") is None:
+            return None, None
+        return r["task"]["id"], r["task"]["payload"]
+
+    def epoch_done(self):
+        """True when the last get_task saw an empty queue with no leases."""
+        return getattr(self, "_last_epoch_done", False)
+
+    def task_finished(self, task_id):
+        return self._cli.call("task_finished", task_id=task_id,
+                              trainer_id=self.trainer_id)
+
+    def task_failed(self, task_id):
+        return self._cli.call("task_failed", task_id=task_id,
+                              trainer_id=self.trainer_id)
+
+    def stats(self):
+        return self._cli.call("num_done", trainer_id=self.trainer_id)

@@ -119,12 +119,17 @@ class RPCClient:
     _lock = threading.Lock()
     _instances = {}
 
-    def __init__(self, endpoint, timeout=600.0, retries=30, retry_wait=0.3):
+    def __init__(self, endpoint, timeout=None, retries=None, retry_wait=0.3):
         import uuid
 
+        from ..flags import get_flag
+
         self.endpoint = endpoint
-        self.timeout = timeout
-        self.retries = retries
+        # FLAGS_rpc_deadline (ms) / FLAGS_max_retry defaults
+        self.timeout = timeout if timeout is not None else get_flag("rpc_deadline") / 1000.0
+        # blocking verbs (barrier / sync get) wait on cluster progress
+        self.barrier_timeout = max(self.timeout, 1200.0)
+        self.retries = retries if retries is not None else get_flag("max_retry")
         self.retry_wait = retry_wait
         self._sock = None
         self._io_lock = threading.Lock()
@@ -167,21 +172,45 @@ class RPCClient:
             % (self.endpoint, self.retries, last)
         )
 
-    def call(self, verb, **kwargs):
+    def call(self, verb, timeout_s=None, **kwargs):
+        """One RPC round-trip.  `timeout_s` overrides the socket timeout
+        for this call — blocking verbs (sync barriers, gated gets) wait on
+        cluster progress, not network latency, and must not be bounded by
+        FLAGS_rpc_deadline."""
+        from ..flags import get_flag
+
+        if get_flag("enable_rpc_profiler"):
+            from ..profiler import RecordEvent
+
+            with RecordEvent("rpc_" + verb):
+                return self._call_locked(verb, timeout_s, kwargs)
+        return self._call_locked(verb, timeout_s, kwargs)
+
+    def _call_locked(self, verb, timeout_s, kwargs):
         with self._io_lock:
             self._req_counter += 1
             req_id = "%s:%d" % (self._token, self._req_counter)
             if self._sock is None:
                 self._sock = self._connect()
             try:
+                if timeout_s is not None:
+                    self._sock.settimeout(timeout_s)
                 _send_msg(self._sock, (verb, kwargs, req_id))
                 result = _recv_msg(self._sock)
             except (ConnectionError, OSError):
                 # reconnect + replay; the server's dedup cache makes the
                 # retry at-most-once even if the first copy was applied
                 self._sock = self._connect()
+                if timeout_s is not None:
+                    self._sock.settimeout(timeout_s)
                 _send_msg(self._sock, (verb, kwargs, req_id))
                 result = _recv_msg(self._sock)
+            finally:
+                if timeout_s is not None and self._sock is not None:
+                    try:
+                        self._sock.settimeout(self.timeout)
+                    except OSError:
+                        pass
         if isinstance(result, dict) and result.get("__error__"):
             raise RuntimeError(
                 "remote error from %s: %s" % (self.endpoint, result["__error__"])
@@ -193,7 +222,9 @@ class RPCClient:
         return self.call("send", name=name, value=value, trainer_id=trainer_id)
 
     def get_var(self, name, trainer_id=0):
-        return self.call("get", name=name, trainer_id=trainer_id)
+        # sync-mode gets block until the optimize round completes
+        return self.call("get", timeout_s=self.barrier_timeout,
+                         name=name, trainer_id=trainer_id)
 
     def prefetch(self, table, ids, trainer_id=0):
         return self.call("prefetch", table=table, ids=ids, trainer_id=trainer_id)
@@ -204,7 +235,10 @@ class RPCClient:
         )
 
     def barrier(self, kind, trainer_id=0):
-        return self.call("barrier", kind=kind, trainer_id=trainer_id)
+        # barriers wait for every live trainer: bounded by straggler time,
+        # not rpc_deadline
+        return self.call("barrier", timeout_s=self.barrier_timeout,
+                         kind=kind, trainer_id=trainer_id)
 
     def complete(self, trainer_id=0):
         return self.call("complete", trainer_id=trainer_id)

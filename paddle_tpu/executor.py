@@ -116,13 +116,42 @@ class Executor:
             rw_state[n] = scope.find_var(n)
 
         key = self._rng_key(program)
+        from .flags import get_flag
         from .profiler import RecordEvent
 
+        import time as _time
+
+        t0 = _time.time()
         with RecordEvent("executor_run"):
             fetches, new_state = compiled(feed_arrays, ro_state, rw_state, key)
+        if get_flag("benchmark"):
+            # FLAGS_benchmark contract: per-run timing log with a device
+            # barrier so the number is real
+            jax.block_until_ready(fetches if fetches else list(new_state.values()))
+            print("[benchmark] run %.3f ms" % ((_time.time() - t0) * 1e3))
 
         for n, v in new_state.items():
             scope.set(n, v)
+
+        if get_flag("check_nan_inf"):
+            # FLAGS_check_nan_inf contract (operator.cc:688): raise on any
+            # non-finite fetched value, naming the variable.  Materialize
+            # once and reuse for the return (no double device_get).
+            np_fetches = [np.asarray(jax.device_get(f)) for f in fetches]
+            for name, arr in zip(fetch_names, np_fetches):
+                if arr.dtype.kind == "i" or arr.dtype.kind == "b":
+                    continue
+                try:
+                    finite = np.isfinite(arr)  # works for f16/f32 AND
+                    # ml_dtypes bfloat16 (whose dtype.kind is 'V')
+                except TypeError:
+                    continue
+                if not finite.all():
+                    raise RuntimeError(
+                        "NaN/Inf detected in fetched var '%s'" % name
+                    )
+            if return_numpy:
+                return np_fetches
 
         if return_numpy:
             return [as_numpy(f) for f in fetches]

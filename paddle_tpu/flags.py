@@ -1,0 +1,105 @@
+"""Runtime flag registry (the gflags surface, SURVEY §5.6).
+
+The reference defines ~31 gflags in C++ and exports an allowlist to Python
+via __init__.py __bootstrap__ (:85) -> core.init_gflags.  Here the registry
+is the single source of truth; values load from the environment at import:
+
+* `FLAGS_<name>=value` env vars (the reference's exact contract), or
+* `PADDLE_TPU_FLAGS="--name=value --other=v"` batch form.
+
+Wired flags: check_nan_inf (executor fetch scan), benchmark (per-run
+timing log), rpc_deadline / max_retry (RPC client), enable_rpc_profiler
+(RecordEvent spans around RPC calls).  The remaining knobs are accepted
+for script compatibility and are no-ops under XLA (their help text says
+so) — memory budgeting belongs to PJRT and fusion to the compiler.
+"""
+
+import os
+
+__all__ = ["DEFINE_flag", "get_flag", "set_flags", "flag_items"]
+
+_flags = {}
+
+
+class _Flag:
+    __slots__ = ("name", "value", "default", "help")
+
+    def __init__(self, name, default, help):
+        self.name = name
+        self.default = default
+        self.value = default
+        self.help = help
+
+
+def _coerce(default, raw):
+    if isinstance(default, bool):
+        return str(raw).lower() in ("1", "true", "yes", "on")
+    if isinstance(default, int):
+        return int(raw)
+    if isinstance(default, float):
+        return float(raw)
+    return raw
+
+
+def DEFINE_flag(name, default, help=""):
+    f = _Flag(name, default, help)
+    env = os.environ.get("FLAGS_" + name)
+    if env is not None:
+        f.value = _coerce(default, env)
+    _flags[name] = f
+    return f
+
+
+def get_flag(name):
+    return _flags[name].value
+
+
+def set_flags(mapping):
+    """dict name->value, applied with type coercion (init_gflags analog)."""
+    for name, value in mapping.items():
+        key = name[len("FLAGS_"):] if name.startswith("FLAGS_") else name
+        if key not in _flags:
+            raise KeyError("unknown flag %s (known: %s)" % (key, sorted(_flags)))
+        f = _flags[key]
+        f.value = _coerce(f.default, value)
+
+
+def flag_items():
+    return {name: f.value for name, f in sorted(_flags.items())}
+
+
+def _parse_batch_env():
+    batch = os.environ.get("PADDLE_TPU_FLAGS", "")
+    for tok in batch.split():
+        if tok.startswith("--") and "=" in tok:
+            k, v = tok[2:].split("=", 1)
+            if k in _flags:
+                f = _flags[k]
+                f.value = _coerce(f.default, v)
+
+
+# ---- the reference's knob surface (CMakeLists/bootstrap allowlist) -------
+DEFINE_flag("check_nan_inf", False,
+            "scan every fetched value for NaN/Inf and raise (operator.cc:688)")
+DEFINE_flag("benchmark", False, "log wall time of every Executor.run")
+DEFINE_flag("eager_delete_tensor_gb", -1.0,
+            "compat no-op: XLA frees temps inside the step; rw state is "
+            "donated unconditionally")
+DEFINE_flag("fraction_of_gpu_memory_to_use", 0.92,
+            "accepted for compatibility; HBM budgeting is PJRT's")
+DEFINE_flag("init_allocated_mem", False, "compat no-op under XLA")
+DEFINE_flag("free_idle_memory", False, "compat no-op under XLA")
+DEFINE_flag("paddle_num_threads", 1, "compat no-op (XLA owns threading)")
+DEFINE_flag("dist_threadpool_size", 0,
+            "compat no-op (pserver threads are per-connection)")
+DEFINE_flag("rpc_deadline", 180000, "RPC timeout in ms (grpc deadline)")
+DEFINE_flag("max_retry", 30, "RPC connect retries")
+DEFINE_flag("enable_rpc_profiler", False, "RecordEvent spans around RPC")
+DEFINE_flag("cudnn_deterministic", False,
+            "compat; XLA compilation is deterministic already")
+DEFINE_flag("use_mkldnn", False, "compat no-op (XLA owns fusion)")
+DEFINE_flag("tpu_bf16_matmul", False,
+            "reserved: AMP is the explicit contrib.mixed_precision."
+            "rewrite_bf16() program rewrite, not a global flag yet")
+
+_parse_batch_env()

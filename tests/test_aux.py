@@ -1,0 +1,162 @@
+"""Aux subsystems: flags (+check_nan_inf), debugger dumps, fault-tolerant
+master task queue, bf16 AMP rewrite."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import flags, layers
+from paddle_tpu.contrib.mixed_precision import rewrite_bf16
+from paddle_tpu.distributed import Master, MasterClient
+from paddle_tpu.distributed.rpc import RPCClient
+
+
+def _mlp():
+    x = layers.data("x", shape=[4])
+    y = layers.data("y", shape=[1])
+    pred = layers.fc(layers.fc(x, size=8, act="relu"), size=1)
+    return layers.mean(layers.square_error_cost(pred, y))
+
+
+# ---------------------------------------------------------------------------
+def test_flags_registry_and_env():
+    assert flags.get_flag("rpc_deadline") == 180000
+    flags.set_flags({"FLAGS_rpc_deadline": "5000", "max_retry": 2})
+    assert flags.get_flag("rpc_deadline") == 5000
+    assert flags.get_flag("max_retry") == 2
+    with pytest.raises(KeyError):
+        flags.set_flags({"not_a_flag": 1})
+    flags.set_flags({"rpc_deadline": 180000, "max_retry": 30})
+    assert "check_nan_inf" in flags.flag_items()
+
+
+def test_check_nan_inf_flag():
+    loss = _mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    bad = np.full((4, 4), np.nan, "float32")
+    y = np.zeros((4, 1), "float32")
+    flags.set_flags({"check_nan_inf": True})
+    try:
+        with pytest.raises(RuntimeError, match="NaN/Inf"):
+            exe.run(feed={"x": bad, "y": y}, fetch_list=[loss])
+    finally:
+        flags.set_flags({"check_nan_inf": False})
+
+
+# ---------------------------------------------------------------------------
+def test_debugger_dumps(tmp_path):
+    from paddle_tpu import debugger
+
+    loss = _mlp()
+    prog = fluid.default_main_program()
+    text = debugger.pprint_program_codes(prog)
+    assert "mul(" in text and "x[-1x4,float32]" in text
+    dot_path = str(tmp_path / "g.dot")
+    dot = debugger.draw_block_graphviz(
+        prog.global_block(), highlights=[loss.name], path=dot_path
+    )
+    assert dot.startswith("digraph G {") and "lightcoral" in dot
+    assert os.path.exists(dot_path)
+
+
+# ---------------------------------------------------------------------------
+def test_master_task_queue_lease_finish_and_timeout(tmp_path):
+    snap = str(tmp_path / "master.json")
+    master = Master("127.0.0.1:0", timeout_s=0.5, failure_max=3,
+                    snapshot_path=snap, chunks_per_task=2)
+    try:
+        cli = MasterClient(master.endpoint, trainer_id=0)
+        cli.set_dataset(["c%d" % i for i in range(6)])  # 3 tasks of 2
+
+        t1, p1 = cli.get_task()
+        assert sorted(p1) == ["c0", "c1"]
+        cli.task_finished(t1)
+
+        # lease a task and let it time out (dead trainer)
+        t2, _ = cli.get_task()
+        time.sleep(0.7)
+        # after timeout the task re-queues; drain everything
+        seen = set()
+        while True:
+            tid, payload = cli.get_task()
+            if tid is None:
+                break
+            seen.add(tid)
+            cli.task_finished(tid)
+        assert t2 in seen  # the timed-out lease came back
+        assert cli.epoch_done()
+        s = cli.stats()
+        assert s["done"] == 3 and s["todo"] == 0 and s["pending"] == 0
+    finally:
+        master.shutdown()
+
+    # snapshot restore: a new master resumes with completed state
+    master2 = Master("127.0.0.1:0", snapshot_path=snap)
+    try:
+        RPCClient.reset_all()
+        cli2 = MasterClient(master2.endpoint)
+        s = cli2.stats()
+        assert s["done"] == 3 and s["todo"] == 0
+    finally:
+        master2.shutdown()
+        RPCClient.reset_all()
+
+
+def test_master_failure_max_discards(tmp_path):
+    master = Master("127.0.0.1:0", timeout_s=30, failure_max=2)
+    try:
+        RPCClient.reset_all()
+        cli = MasterClient(master.endpoint)
+        cli.set_dataset(["only"])
+        for _ in range(2):  # fail it failure_max times
+            tid, _ = cli.get_task()
+            assert tid is not None
+            cli.task_failed(tid)
+        tid, _ = cli.get_task()
+        assert tid is None and cli.epoch_done()  # discarded, not re-queued
+    finally:
+        master.shutdown()
+        RPCClient.reset_all()
+
+
+# ---------------------------------------------------------------------------
+def test_bf16_amp_rewrite_trains_and_matches_f32():
+    rng = np.random.RandomState(0)
+    xv = rng.rand(16, 4).astype("float32")
+    yv = (xv @ np.array([[1.0], [-2.0], [3.0], [0.5]], "float32"))
+
+    def run(amp):
+        import paddle_tpu.framework as fw
+        from paddle_tpu.core import scope as scope_mod
+        from paddle_tpu import unique_name
+
+        fw.switch_main_program(fluid.Program())
+        fw.switch_startup_program(fluid.Program())
+        unique_name.switch()
+        scope_mod._switch_scope(scope_mod.Scope())
+        fluid.default_main_program().random_seed = 7
+        fluid.default_startup_program().random_seed = 7
+        loss = _mlp()
+        n = rewrite_bf16() if amp else 0
+        fluid.optimizer.SGD(0.05).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        losses = [
+            float(np.ravel(exe.run(feed={"x": xv, "y": yv}, fetch_list=[loss])[0])[0])
+            for _ in range(10)
+        ]
+        return losses, n
+
+    f32_losses, _ = run(False)
+    amp_losses, n_rewritten = run(True)
+    assert n_rewritten == 2  # both fc muls
+    assert amp_losses[-1] < amp_losses[0] * 0.5  # trains
+    # bf16 has ~3 decimal digits: trajectories agree loosely
+    np.testing.assert_allclose(amp_losses, f32_losses, rtol=0.15, atol=0.02)
+    # and the rewritten program actually contains bf16 casts
+    types = [op.type for op in fluid.default_main_program().global_block().ops]
+    assert types.count("cast") >= 4
