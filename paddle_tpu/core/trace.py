@@ -261,6 +261,10 @@ class ExecutionCache:
         self._cache = {}
 
     def get(self, program, block_idx, feed_sig, fetch_names, scope, donate=True):
+        # flags that change lowering decisions are part of the compile key —
+        # toggling them must recompile, not hit a stale executable
+        from ..flags import get_flag
+
         key = (
             id(program),
             program._version,
@@ -268,6 +272,7 @@ class ExecutionCache:
             feed_sig,
             tuple(fetch_names),
             id(scope),
+            bool(get_flag("use_pallas")),
         )
         hit = self._cache.get(key)
         if hit is not None:

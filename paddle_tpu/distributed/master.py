@@ -40,11 +40,14 @@ class MasterService:
     """Service object for rpc.VarServer."""
 
     def __init__(self, timeout_s=60.0, failure_max=3, snapshot_path=None,
-                 chunks_per_task=1):
+                 chunks_per_task=1, snapshot_interval_s=1.0):
         self.timeout_s = timeout_s
         self.failure_max = failure_max
         self.snapshot_path = snapshot_path
         self.chunks_per_task = max(1, chunks_per_task)
+        self.snapshot_interval_s = snapshot_interval_s
+        self._last_snapshot = 0.0
+        self._dataset_set = False
         self._lock = threading.Lock()
         self._todo = []      # [Task]
         self._pending = {}   # task_id -> Task (leased)
@@ -55,14 +58,27 @@ class MasterService:
             self._load_snapshot()
 
     # ---- snapshot (etcd stand-in, service.go:207) ---------------------
-    def _save_snapshot(self):
+    def _save_snapshot(self, force=False):
+        """Throttled (ticker-style, like the reference master) — at most one
+        write per snapshot_interval_s unless `force` (epoch boundaries,
+        dataset set).  Worst case a restart replays < interval of leases."""
         if not self.snapshot_path:
             return
+        now = time.time()
+        epoch_boundary = not self._todo and not self._pending
+        if (
+            not force
+            and not epoch_boundary
+            and now - self._last_snapshot < self.snapshot_interval_s
+        ):
+            return
+        self._last_snapshot = now
         state = {
             "todo": [t.to_dict() for t in self._todo],
             "pending": [t.to_dict() for t in self._pending.values()],
             "done": [t.to_dict() for t in self._done],
             "next_id": self._next_id,
+            "dataset_set": self._dataset_set,
         }
         tmp = self.snapshot_path + ".tmp"
         with open(tmp, "w") as f:
@@ -78,6 +94,7 @@ class MasterService:
         ]
         self._done = [Task.from_dict(d) for d in state["done"]]
         self._next_id = state["next_id"]
+        self._dataset_set = state.get("dataset_set", bool(self._todo or self._done))
 
     # ---- verbs ---------------------------------------------------------
     def handle(self, verb, **kw):
@@ -101,10 +118,14 @@ class MasterService:
         return changed
 
     def _h_set_dataset(self, chunks, trainer_id=0):
-        """Partition chunks into tasks (SetDataset :280)."""
+        """Partition chunks into tasks (SetDataset :280).  Idempotent per
+        epoch: once a dataset is set, later set_dataset calls (slow-starting
+        trainers, retries — even after the epoch drained) are no-ops until
+        new_epoch() resets."""
         with self._lock:
-            if self._todo or self._pending:
+            if self._dataset_set or self._todo or self._pending:
                 return {"ok": True, "already_set": True}
+            self._dataset_set = True
             created = 0
             group = []
             for c in chunks:
@@ -119,7 +140,7 @@ class MasterService:
                 self._next_id += 1
                 created += 1
             self._epoch_done.clear()
-            self._save_snapshot()
+            self._save_snapshot(force=True)
         return {"ok": True, "num_tasks": created}
 
     def _h_get_task(self, trainer_id=0):
@@ -160,6 +181,17 @@ class MasterService:
                 if task.failures < self.failure_max:
                     self._todo.append(task)
             self._save_snapshot()
+        return {"ok": True}
+
+    def _h_new_epoch(self, trainer_id=0):
+        """Reset for the next epoch (rank-0 trainer calls this, then
+        set_dataset again)."""
+        with self._lock:
+            self._todo = []
+            self._pending = {}
+            self._done = []
+            self._dataset_set = False
+            self._save_snapshot(force=True)
         return {"ok": True}
 
     def _h_num_done(self, trainer_id=0):
@@ -214,6 +246,9 @@ class MasterClient:
     def task_finished(self, task_id):
         return self._cli.call("task_finished", task_id=task_id,
                               trainer_id=self.trainer_id)
+
+    def new_epoch(self):
+        return self._cli.call("new_epoch", trainer_id=self.trainer_id)
 
     def task_failed(self, task_id):
         return self._cli.call("task_failed", task_id=task_id,

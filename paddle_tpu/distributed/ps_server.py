@@ -51,11 +51,11 @@ class ParameterServer:
         self.sync_mode = sync_mode
         self.scope = scope if scope is not None else Scope()
         self.exe = Executor(CPUPlace())
-        # sparse embedding shards: table name -> 2-D np.ndarray (rows here
-        # belong to this server: global_row = row * nservers + server_idx
-        # routing is done client-side; we only see local row ids)
+        # sparse embedding shards: shard name -> (2-D np.ndarray, sgd_lr).
+        # Rows here belong to this server (global row g -> server g%N at
+        # local index g//N); id routing is client-side, we see local ids.
         self.sparse_tables = dict(sparse_tables or {})
-        self.sparse_lr = sparse_lr
+        self.sparse_lr = sparse_lr  # fallback for tables without own lr
 
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
@@ -146,18 +146,21 @@ class ParameterServer:
     # ---- sparse embedding shards (distributed lookup table) -------------
     def _h_prefetch(self, table, ids, trainer_id=0):
         """Serve embedding rows by local row id (prefetch_op analog)."""
-        tbl = self.sparse_tables[table]
+        tbl, _lr = self.sparse_tables[table]
         ids = np.asarray(ids).reshape(-1)
         ids = np.clip(ids, 0, tbl.shape[0] - 1)
-        return tbl[ids]
+        with self._lock:
+            return tbl[ids].copy()
 
     def _h_send_sparse(self, table, ids, rows, trainer_id=0):
-        """Sparse SGD update on this server's rows (SelectedRows grad)."""
-        tbl = self.sparse_tables[table]
+        """Sparse SGD update on this server's rows (SelectedRows grad):
+        applied immediately, even in sync mode (reference distributed
+        lookup-table semantics)."""
+        tbl, lr = self.sparse_tables[table]
         ids = np.asarray(ids).reshape(-1)
         rows = np.asarray(rows)
         with self._lock:
-            np.subtract.at(tbl, ids, self.sparse_lr * rows)
+            np.subtract.at(tbl, ids, lr * rows)
         return {"ok": True}
 
     def _h_complete(self, trainer_id=0):
@@ -215,10 +218,20 @@ def run_pserver(program, scope, executor=None):
         if scope.find_var(name) is None:
             raise RuntimeError("pserver startup did not create %s" % name)
 
+    # distributed lookup-table shards: slice this server's rows (g%N) out
+    # of the full table the startup program initialized
     sparse_tables = {}
-    for tname in a.get("sparse_table_names", []):
-        var = scope.find_var(tname)
-        sparse_tables[tname] = np.array(var)
+    for shard_name, src, server_idx, n_servers, lr in a.get("sparse_tables", []):
+        var = scope.find_var(src)
+        if var is None:
+            raise RuntimeError(
+                "pserver startup did not create lookup table %s" % src
+            )
+        full = np.array(var)
+        sparse_tables[shard_name] = (
+            np.ascontiguousarray(full[int(server_idx)::int(n_servers)]),
+            float(lr),
+        )
 
     service = ParameterServer(
         shard_programs,

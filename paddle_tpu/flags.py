@@ -98,6 +98,9 @@ DEFINE_flag("enable_rpc_profiler", False, "RecordEvent spans around RPC")
 DEFINE_flag("cudnn_deterministic", False,
             "compat; XLA compilation is deterministic already")
 DEFINE_flag("use_mkldnn", False, "compat no-op (XLA owns fusion)")
+DEFINE_flag("use_pallas", False,
+            "dispatch hot ops (attention, layer_norm) to the Pallas "
+            "kernel library instead of plain XLA lowerings")
 DEFINE_flag("tpu_bf16_matmul", False,
             "reserved: AMP is the explicit contrib.mixed_precision."
             "rewrite_bf16() program rewrite, not a global flag yet")

@@ -15,6 +15,7 @@ from ..layer_helper import LayerHelper
 from ..param_attr import ParamAttr
 
 __all__ = [
+    "fused_attention",
     "log_loss",
     "beam_search",
     "beam_search_decode",
@@ -190,7 +191,13 @@ def embedding(
         "lookup_table",
         inputs={"W": [w], "Ids": [input]},
         outputs={"Out": [tmp]},
-        attrs={"padding_idx": padding_idx},
+        attrs={
+            "padding_idx": padding_idx,
+            "is_sparse": bool(is_sparse),
+            # consumed by DistributeTranspiler._handle_distributed_lookup:
+            # rows shard over pservers, forward becomes a prefetch op
+            "is_distributed": bool(is_distributed),
+        },
     )
     return tmp
 
@@ -1395,5 +1402,19 @@ def log_loss(input, label, epsilon=1e-4, name=None):
         inputs={"Predicted": [input], "Labels": [label]},
         outputs={"Loss": [out]},
         attrs={"epsilon": epsilon},
+    )
+    return out
+
+
+def fused_attention(q, k, v, causal=False, scale=None, name=None):
+    """Fused scaled-dot-product attention over [batch, heads, T, d]
+    (flash-attention kernel under FLAGS_use_pallas)."""
+    helper = LayerHelper("fused_attention", **locals())
+    out = helper.create_variable_for_type_inference(q.dtype)
+    helper.append_op(
+        "fused_attention",
+        inputs={"Q": [q], "K": [k], "V": [v]},
+        outputs={"Out": [out]},
+        attrs={"causal": causal, "scale": scale},
     )
     return out

@@ -95,9 +95,12 @@ def build_resnet_train_program(
     lr=0.1,
     optimizer="momentum",
     dtype="float32",
+    use_bf16=False,
 ):
     """Build (main_program, startup_program, feeds, fetches) for training —
-    convenience mirroring the benchmark driver's model setup."""
+    convenience mirroring the benchmark driver's model setup.  use_bf16
+    applies the AMP rewrite (bf16 convs/matmuls on the MXU, f32 master
+    weights) before the optimizer pass."""
     import paddle_tpu as fluid
 
     main = fluid.Program()
@@ -109,6 +112,10 @@ def build_resnet_train_program(
         cost = layers.cross_entropy(input=predict, label=label)
         avg_cost = layers.mean(cost)
         acc = layers.accuracy(input=predict, label=label)
+        if use_bf16:
+            from paddle_tpu.contrib.mixed_precision import rewrite_bf16
+
+            rewrite_bf16(main)
         if optimizer == "momentum":
             opt = fluid.optimizer.Momentum(learning_rate=lr, momentum=0.9)
         else:

@@ -128,7 +128,9 @@ def _prefetch(ctx, ins, attrs):
                 continue
             local = flat[mask] // n
             rows = np.asarray(
-                _client(epmap[s]).prefetch(table_names[s], local, trainer_id)
+                _client(epmap[s], trainer_id).prefetch(
+                    table_names[s], local, trainer_id
+                )
             )
             out[mask] = rows
         return out.reshape(out_shape)
@@ -150,17 +152,20 @@ def _send_sparse(ctx, ins, attrs):
     epmap = list(attrs["epmap"])
     table_names = list(attrs["table_names"])
     trainer_id = int(attrs.get("trainer_id", 0))
+    scale = float(attrs.get("scale", 1.0))
     n = len(epmap)
 
     def host_push(ids_v, grad_v):
         flat = np.asarray(ids_v).reshape(-1).astype(np.int64)
-        g = np.asarray(grad_v).reshape(flat.size, -1)
+        g = np.asarray(grad_v).reshape(flat.size, -1) * scale
         for s in range(n):
             mask = (flat % n) == s
             if not mask.any():
                 continue
             local = flat[mask] // n
-            _client(epmap[s]).send_sparse(table_names[s], local, g[mask], trainer_id)
+            _client(epmap[s], trainer_id).send_sparse(
+                table_names[s], local, g[mask], trainer_id
+            )
         return np.int32(0)
 
     tok = io_callback(

@@ -229,6 +229,28 @@ def _layer_norm(ctx, ins, attrs):
     x = ins["X"][0]
     begin = attrs.get("begin_norm_axis", 1)
     eps = attrs.get("epsilon", 1e-5)
+    # pallas kernel override when the norm is over the last axis only
+    # (the transformer case) — FLAGS_use_pallas, library-override analog
+    from .pallas_kernels import fused_layer_norm, use_pallas
+
+    if (
+        use_pallas()
+        and begin == x.ndim - 1
+        and ins.get("Scale")
+        and ins.get("Bias")
+    ):
+        h = x.shape[-1]
+        x2d = x.reshape(-1, h)
+        y = fused_layer_norm(
+            x2d, ins["Scale"][0].reshape(h), ins["Bias"][0].reshape(h), eps
+        ).reshape(x.shape)
+        mean = jnp.mean(x, axis=-1)
+        var = jnp.var(x, axis=-1)
+        return {
+            "Y": [y],
+            "Mean": [jax.lax.stop_gradient(mean)],
+            "Variance": [jax.lax.stop_gradient(var)],
+        }
     axes = tuple(range(begin, x.ndim))
     mean = jnp.mean(x, axis=axes, keepdims=True)
     var = jnp.var(x, axis=axes, keepdims=True)
@@ -491,3 +513,32 @@ def _pixel_shuffle(ctx, ins, attrs):
     x = x.reshape(n, c // (r * r), r, r, h, w)
     x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
     return {"Out": [x.reshape(n, c // (r * r), h * r, w * r)]}
+
+
+@register("fused_attention")
+def _fused_attention(ctx, ins, attrs):
+    """Fused scaled-dot-product attention (the cuDNN-fused-kernel slot of
+    the reference, TPU-style): flash kernel under FLAGS_use_pallas, dense
+    XLA otherwise.  Q/K/V: [batch, heads, T, d]."""
+    from .pallas_kernels import (
+        _dense_attention,
+        flash_attention,
+        use_pallas,
+    )
+
+    q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
+    causal = bool(attrs.get("causal", False))
+    scale = attrs.get("scale") or 1.0 / (q.shape[-1] ** 0.5)
+    b, h, t, d = q.shape
+    qf = q.reshape(b * h, t, d)
+    kf = k.reshape(b * h, t, d)
+    vf = v.reshape(b * h, t, d)
+    if use_pallas() and t % 128 == 0:
+        out = flash_attention(qf, kf, vf, causal, float(scale))
+    elif use_pallas() and t >= 8 and t % 8 == 0:
+        out = flash_attention(
+            qf, kf, vf, causal, float(scale), block_q=8, block_k=8
+        )
+    else:
+        out = _dense_attention(qf, kf, vf, causal, float(scale))
+    return {"Out": [out.reshape(b, h, t, d)]}

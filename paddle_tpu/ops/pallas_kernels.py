@@ -1,0 +1,223 @@
+"""Pallas TPU kernels: the hand-fused hot-op layer.
+
+Role parity with the reference's specialized kernel libraries — the cuDNN
+kernel variants and operators/math/ JIT kernels (SURVEY §2.6 math/,
+fused/) — but written for the TPU memory hierarchy: q-blocked
+flash attention with online softmax (keeps the [T,T] score matrix out of
+HBM) and a row-blocked fused layer_norm.  Backward passes use custom_vjp
+with XLA-fused recompute (the standard memory-for-FLOPs trade on TPU).
+
+Kernels run compiled on TPU and in interpreter mode elsewhere, so the same
+code path is unit-testable on the CPU mesh.  Dispatch happens inside the
+regular op lowerings when FLAGS_use_pallas is on (the analog of the
+reference's OpKernelType.library_type kernel override).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _interpret():
+    return jax.default_backend() != "tpu"
+
+
+def _cdiv(a, b):
+    return (a + b - 1) // b
+
+
+# ---------------------------------------------------------------------------
+# flash attention
+# ---------------------------------------------------------------------------
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, scale,
+                      q_block):
+    """One (batch*head, q_block) cell: online softmax over k blocks.
+    q_ref: [bq, d]; k_ref/v_ref: [T, d] (whole sequence resident in VMEM)."""
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale  # block refs: [1, bq, d]
+    _, T, d = k_ref.shape
+    bq = q.shape[0]
+    nk = T // block_k
+
+    def body(ki, carry):
+        acc, m, l = carry
+        k = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [bq, bk]
+        if causal:
+            q_pos = qi * q_block + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0
+            )
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=1)
+        acc_new = acc * alpha[:, None] + jnp.dot(
+            p, v, preferred_element_type=jnp.float32
+        )
+        return acc_new, m_new, l_new
+
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m0 = jnp.full((bq,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, nk, body, (acc0, m0, l0))
+    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
+    """q/k/v: [BH, T, d] -> o [BH, T, d]."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    BH, T, d = q.shape
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    assert T % block_q == 0 and T % block_k == 0, (
+        "flash attention requires seq len %d divisible by block sizes "
+        "(%d, %d) — pad the sequence" % (T, block_q, block_k)
+    )
+    grid = (BH, T // block_q)
+    kernel = functools.partial(
+        _flash_fwd_kernel,
+        block_k=block_k,
+        causal=causal,
+        scale=scale,
+        q_block=block_q,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, T, d), lambda b, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, T, d), lambda b, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((BH, T, d), q.dtype),
+        interpret=_interpret(),
+    )(q, k, v)
+
+
+def _dense_attention(q, k, v, causal, scale):
+    """XLA reference implementation (used for the backward recompute)."""
+    s = jnp.einsum("bqd,bkd->bqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        T = q.shape[1]
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask[None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bqk,bkd->bqd", p.astype(q.dtype), v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
+                    block_k=128):
+    """Fused attention over [BH, T, d] (flash-style online softmax)."""
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    return _flash_fwd(q, k, v, causal, scale, block_q, block_k)
+
+
+def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k):
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    o = _flash_fwd(q, k, v, causal, scale, block_q, block_k)
+    return o, (q, k, v)
+
+
+def _flash_vjp_bwd(causal, scale, block_q, block_k, res, do):
+    q, k, v = res
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    # recompute-based backward: XLA fuses the re-derived softmax with the
+    # grad matmuls; trades FLOPs for never materializing fwd residuals
+    _, vjp = jax.vjp(lambda q, k, v: _dense_attention(q, k, v, causal, scale),
+                     q, k, v)
+    return vjp(do)
+
+
+flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+# ---------------------------------------------------------------------------
+# fused layer norm
+# ---------------------------------------------------------------------------
+def _ln_kernel(x_ref, g_ref, b_ref, o_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    o_ref[:] = (y * g_ref[:].astype(jnp.float32)
+                + b_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def _ln_fwd(x2d, gamma, beta, eps, block_rows=256):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    R, H = x2d.shape
+    block_rows = min(block_rows, R)
+    if R % block_rows != 0:
+        block_rows = 1 if R % 8 else 8
+    grid = (_cdiv(R, block_rows),)
+    return pl.pallas_call(
+        functools.partial(_ln_kernel, eps=eps),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_rows, H), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((H,), lambda i: (0,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((H,), lambda i: (0,), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((block_rows, H), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((R, H), x2d.dtype),
+        interpret=_interpret(),
+    )(x2d, gamma, beta)
+
+
+def _ln_dense(x2d, gamma, beta, eps):
+    x = x2d.astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    return (y * gamma + beta).astype(x2d.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def fused_layer_norm(x2d, gamma, beta, eps=1e-5):
+    """Row-fused layer norm over [rows, hidden]."""
+    return _ln_fwd(x2d, gamma, beta, eps)
+
+
+def _ln_vjp_fwd(x2d, gamma, beta, eps):
+    return _ln_fwd(x2d, gamma, beta, eps), (x2d, gamma, beta)
+
+
+def _ln_vjp_bwd(eps, res, dy):
+    x2d, gamma, beta = res
+    _, vjp = jax.vjp(lambda x, g, b: _ln_dense(x, g, b, eps), x2d, gamma, beta)
+    return vjp(dy)
+
+
+fused_layer_norm.defvjp(_ln_vjp_fwd, _ln_vjp_bwd)
+
+
+def use_pallas():
+    """Kernel-override dispatch switch (OpKernelType.library analog)."""
+    from ..flags import get_flag
+
+    return get_flag("use_pallas")

@@ -25,7 +25,7 @@ for the TPU execution model:
 
 import math
 
-from .. import framework
+from .. import framework, unique_name
 from ..framework import Program
 from .ps_dispatcher import RoundRobin, PSDispatcher
 
@@ -119,7 +119,10 @@ class DistributeTranspiler:
     # ------------------------------------------------------------------
     def _params_grads_from_roles(self):
         """(param, grad) name pairs off the optimize ops' op_role_var tags
-        — the OpRole mechanism the reference transpiler is driven by."""
+        — the OpRole mechanism the reference transpiler is driven by.
+        Distributed lookup tables are excluded (their ops were rewritten to
+        prefetch/send_sparse before this runs)."""
+        sparse = set(getattr(self, "sparse_tables", {}))
         pairs = []
         seen = set()
         for op in self.origin_program.global_block().ops:
@@ -128,14 +131,139 @@ class DistributeTranspiler:
             rv = op.attrs.get("op_role_var")
             if not rv or len(rv) < 2:
                 continue
-            if rv[0] not in seen:
+            if rv[0] not in seen and rv[0] not in sparse:
                 seen.add(rv[0])
                 pairs.append((rv[0], rv[1]))
         return pairs
 
+    # ------------------------------------------------------------------
+    def _handle_distributed_lookup(self):
+        """Distributed lookup table (§2.9 row 4: lookup_table with
+        is_distributed, prefetch_op + split/merge_ids analog).
+
+        The table's rows shard round-robin over the pservers (global row g
+        lives on server g%N at local index g//N).  Rewrite, in place:
+          * lookup_table{is_distributed} -> `prefetch` (host callback that
+            routes ids to their servers and merges rows back),
+          * lookup_table_grad            -> `send_sparse` (rows pushed back
+            for an immediate sparse SGD update — reference semantics:
+            sparse updates apply per-send even in sync mode),
+          * the table's dense optimizer op is dropped.
+        """
+        block = self.origin_program.global_block()
+        eps = self.pserver_endpoints
+        n = len(eps)
+        tables = set()
+        for op in block.ops:
+            if op.type == "lookup_table" and op.attrs.get("is_distributed"):
+                tables.add(op.inputs["W"][0])
+        self.sparse_tables = {}
+        if not tables:
+            return
+
+        # capture each table's SGD learning rate from its (dropped)
+        # optimizer op + the startup initializer of the lr var
+        startup_fills = {}
+        for op in self.startup_program.global_block().ops:
+            if op.type == "fill_constant":
+                for o in op.output_arg_names():
+                    startup_fills[o] = float(op.attrs.get("value", 0.0))
+        table_lr = {}
+        for op in block.ops:
+            rv = op.attrs.get("op_role_var")
+            if op.attrs.get("op_role") == "optimize" and rv and rv[0] in tables:
+                if op.type == "scale":
+                    continue  # per-param-lr helper; checked below
+                if op.type != "sgd":
+                    raise NotImplementedError(
+                        "distributed lookup table '%s' is optimized by '%s'; "
+                        "the pserver applies sparse SGD on its row shards — "
+                        "use SGD for is_distributed embeddings" % (rv[0], op.type)
+                    )
+                lr_names = op.inputs.get("LearningRate", [])
+                lr = startup_fills.get(lr_names[0] if lr_names else "")
+                if lr is None:
+                    raise NotImplementedError(
+                        "distributed lookup table '%s' needs a constant "
+                        "learning rate (schedules / per-param lr scales are "
+                        "not supported on the sparse pserver path)" % rv[0]
+                    )
+                table_lr[rv[0]] = lr
+
+        for w in tables:
+            v = block._find_var_recursive(w)
+            self.sparse_tables[w] = {
+                "shards": ["%s.shard%d" % (w, i) for i in range(n)],
+                "emb_dim": int(v.shape[1]),
+                "lr": table_lr.get(w, 0.01),
+            }
+
+        new_ops = []
+        for op in block.ops:
+            if (
+                op.type == "lookup_table"
+                and op.attrs.get("is_distributed")
+            ):
+                w = op.inputs["W"][0]
+                info = self.sparse_tables[w]
+                pre = framework.Operator(
+                    block,
+                    "prefetch",
+                    None,
+                    None,
+                    {
+                        "epmap": eps,
+                        "table_names": info["shards"],
+                        "emb_dim": info["emb_dim"],
+                        "trainer_id": self.trainer_id,
+                        "op_role": "rpc",
+                    },
+                )
+                pre.inputs = {"Ids": list(op.inputs["Ids"])}
+                pre.outputs = {"Out": list(op.outputs["Out"])}
+                new_ops.append(pre)
+            elif (
+                op.type == "lookup_table_grad"
+                and op.inputs.get("W", [None])[0] in tables
+            ):
+                w = op.inputs["W"][0]
+                info = self.sparse_tables[w]
+                dummy = block.create_var(
+                    name=unique_name.generate(w + "@SPARSE_TOKEN"), shape=[1]
+                )
+                ss = framework.Operator(
+                    block,
+                    "send_sparse",
+                    None,
+                    None,
+                    {
+                        "epmap": eps,
+                        "table_names": info["shards"],
+                        "trainer_id": self.trainer_id,
+                        "scale": 1.0 / float(self.trainer_num),
+                        "op_role": "rpc",
+                    },
+                )
+                ss.inputs = {
+                    "Ids": list(op.inputs["Ids"]),
+                    "Grad": list(op.inputs["Out@GRAD"]),
+                }
+                ss.outputs = {"Out": [dummy.name]}
+                new_ops.append(ss)
+            elif (
+                op.attrs.get("op_role") == "optimize"
+                and op.attrs.get("op_role_var")
+                and op.attrs["op_role_var"][0] in tables
+            ):
+                continue  # the sparse update happens server-side
+            else:
+                new_ops.append(op)
+        block.ops = new_ops
+
     def _transpile_pserver_mode(self):
         block = self.origin_program.global_block()
         eps = self.pserver_endpoints
+        self._handle_distributed_lookup()
         self.params_grads = self._params_grads_from_roles()
         if not self.params_grads:
             raise ValueError(
@@ -400,6 +528,15 @@ class DistributeTranspiler:
         # vars the lr program computes are produced at runtime, not startup
         whole_vars -= lr_produced
 
+        # this server's shard of each distributed lookup table:
+        # [shard_var_name, source_table, server_idx, n_servers, sgd_lr]
+        server_idx = self.pserver_endpoints.index(endpoint)
+        n_servers = len(self.pserver_endpoints)
+        sparse_specs = [
+            [info["shards"][server_idx], w, server_idx, n_servers, info["lr"]]
+            for w, info in sorted(getattr(self, "sparse_tables", {}).items())
+        ]
+
         prog = Program()
         b = prog.global_block()
         b.append_op(
@@ -413,7 +550,7 @@ class DistributeTranspiler:
                 "grad_to_shard": grad_to_shard,
                 "slice_plan": slice_plan,
                 "whole_vars": sorted(whole_vars),
-                "sparse_table_names": [],
+                "sparse_tables": sparse_specs,
             },
         )
         return prog

@@ -30,6 +30,28 @@ from paddle_tpu import layers
 SEED = 7
 
 
+def build_sparse_model(distributed):
+    """Distributed-lookup-table model (dist role passes distributed=True;
+    LOCAL runs the plain lookup so parity compares the two paths)."""
+    ids = layers.data("ids", shape=[1], dtype="int64")
+    y = layers.data("y", shape=[1])
+    emb = layers.embedding(
+        ids, size=[20, 8], dtype="float32", is_distributed=distributed
+    )
+    emb = layers.reshape(emb, [-1, 8])
+    pred = layers.fc(emb, size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(0.1).minimize(loss)
+    return loss
+
+
+def gen_sparse_data(n=16):
+    rng = np.random.RandomState(5)
+    ids = rng.randint(0, 20, (n, 1)).astype("int64")
+    y = (ids.astype("float32") / 10.0) - 1.0
+    return ids, y
+
+
 def build_model():
     x = layers.data("x", shape=[4])
     y = layers.data("y", shape=[1])
@@ -66,8 +88,15 @@ def main():
     main_prog = fluid.default_main_program()
     main_prog.random_seed = SEED
     fluid.default_startup_program().random_seed = SEED
-    loss = build_model()
-    x, y = gen_data()
+    sparse = os.environ.get("DIST_MODEL") == "sparse"
+    if sparse:
+        loss = build_sparse_model(distributed=(role != "LOCAL"))
+        x, y = gen_sparse_data()
+        feed_x = "ids"
+    else:
+        loss = build_model()
+        x, y = gen_data()
+        feed_x = "x"
 
     exe = fluid.Executor(fluid.CPUPlace())
 
@@ -75,7 +104,9 @@ def main():
         exe.run(fluid.default_startup_program())
         losses = []
         for _ in range(steps):
-            (lv,) = exe.run(feed={"x": x[:batch], "y": y[:batch]}, fetch_list=[loss])
+            (lv,) = exe.run(
+                feed={feed_x: x[:batch], "y": y[:batch]}, fetch_list=[loss]
+            )
             losses.append(float(np.asarray(lv).reshape(-1)[0]))
         print("LOSSES " + json.dumps(losses))
         return
@@ -112,7 +143,7 @@ def main():
     for _ in range(steps):
         (lv,) = exe.run(
             program=trainer_prog,
-            feed={"x": x[lo:hi], "y": y[lo:hi]},
+            feed={feed_x: x[lo:hi], "y": y[lo:hi]},
             fetch_list=[loss],
         )
         losses.append(float(np.asarray(lv).reshape(-1)[0]))

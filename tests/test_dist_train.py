@@ -144,3 +144,14 @@ def test_dist_async_trains():
     losses = _run_cluster(2, sync=False, steps=6)
     for l in losses:
         assert l[-1] < l[0]
+
+
+@pytest.mark.slow
+def test_dist_sparse_lookup_table_matches_local():
+    """Distributed lookup table: embedding rows sharded over pservers,
+    prefetch forward + immediate sparse SGD backward — 1-trainer run
+    matches the local plain-embedding run exactly."""
+    env = {"DIST_MODEL": "sparse"}
+    local = _local_losses(steps=5, extra_env=env)
+    (dist,) = _run_cluster(1, sync=True, steps=5, extra_env=env)
+    np.testing.assert_allclose(dist, local, rtol=2e-4, atol=1e-5)
