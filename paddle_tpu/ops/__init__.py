@@ -20,6 +20,8 @@ from . import quant_ops  # noqa: F401
 from . import metric_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
 from . import dist_ops  # noqa: F401
+from . import misc_ops  # noqa: F401
+from . import pallas_kernels  # noqa: F401
 
 get_op = registry.get_op
 is_registered = registry.is_registered

@@ -1,0 +1,291 @@
+"""Long-tail op lowerings closing the remaining REGISTER_OPERATOR gaps
+(SURVEY §2.6): misc math, sequence utilities in the padded representation,
+rnn units, metric ops, and op-level save/load (host callbacks)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register
+from .common import jdt
+
+
+# ---------------------------------------------------------------------------
+# misc math (minus_op.cc, l1_norm_op.cc, fill_op.cc, hash_op.cc)
+# ---------------------------------------------------------------------------
+@register("minus")
+def _minus(ctx, ins, attrs):
+    return {"Out": [ins["X"][0] - ins["Y"][0]]}
+
+
+@register("l1_norm")
+def _l1_norm(ctx, ins, attrs):
+    return {"Out": [jnp.sum(jnp.abs(ins["X"][0])).reshape(1)]}
+
+
+@register("fill")
+def _fill(ctx, ins, attrs):
+    """fill_op.cc: write a literal value list into a tensor."""
+    shape = [int(s) for s in attrs["shape"]]
+    dtype = jdt(attrs.get("dtype", "float32"))
+    value = np.asarray(attrs["value"], dtype=np.float64).reshape(shape)
+    return {"Out": [jnp.asarray(value).astype(dtype)]}
+
+
+@register("hash", no_grad_inputs=("X",))
+def _hash(ctx, ins, attrs):
+    """hash_op.cc: bucketed integer hashing for sparse id spaces — the
+    xxhash of the reference becomes a cheap mix hash (splitmix-style)
+    that XLA vectorizes; num_hash rows per input."""
+    x = ins["X"][0].astype(jnp.uint32)
+    num_hash = int(attrs.get("num_hash", 1))
+    mod_by = int(attrs.get("mod_by", 100000007))
+    outs = []
+    for i in range(num_hash):
+        # murmur3-style 32-bit finalizer, seeded per hash row (works in
+        # JAX's default 32-bit int mode; wraparound is the point)
+        h = x + jnp.uint32((i + 1) * 0x9E3779B9)
+        h = h ^ (h >> 16)
+        h = h * jnp.uint32(0x85EBCA6B)
+        h = h ^ (h >> 13)
+        h = h * jnp.uint32(0xC2B2AE35)
+        h = h ^ (h >> 16)
+        outs.append((h % jnp.uint32(mod_by)).astype(jnp.int32))
+    out = jnp.stack(outs, axis=-2) if num_hash > 1 else outs[0]
+    return {"Out": [out]}
+
+
+@register("pool2d_with_index")
+def _pool2d_with_index(ctx, ins, attrs):
+    """max_pool2d_with_index (pool_with_index_op.cc): max pool + argmax
+    mask (flat h*w index per window), used by unpooling nets."""
+    x = ins["X"][0]
+    ks = attrs.get("ksize", [2, 2])
+    st = attrs.get("strides", ks)
+    n, c, h, w = x.shape
+    kh, kw = int(ks[0]), int(ks[1])
+    sh, sw = int(st[0]), int(st[1])
+    oh, ow = (h - kh) // sh + 1, (w - kw) // sw + 1
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), "VALID", dimension_numbers=("NCHW", "OIHW", "NCHW")
+    )  # [n, c*kh*kw, oh, ow]
+    patches = patches.reshape(n, c, kh * kw, oh, ow)
+    out = jnp.max(patches, axis=2)
+    arg = jnp.argmax(patches, axis=2)  # index within window
+    # convert to flat input index (reference mask semantics)
+    wy, wx = arg // kw, arg % kw
+    oy = jnp.arange(oh).reshape(1, 1, -1, 1)
+    ox = jnp.arange(ow).reshape(1, 1, 1, -1)
+    flat = (oy * sh + wy) * w + (ox * sw + wx)
+    return {"Out": [out], "Mask": [flat.astype(jnp.int32)]}
+
+
+@register("lod_reset", no_grad_inputs=("Y",))
+def _lod_reset(ctx, ins, attrs):
+    """lod_reset_op.cc: in the padded representation the data is unchanged;
+    the new boundary info is the (optional) Y lengths tensor, which callers
+    thread as the new seq_len."""
+    return {"Out": [ins["X"][0]]}
+
+
+@register("delete_var", side_effect=True)
+def _delete_var(ctx, ins, attrs):
+    """delete_var_op.cc: explicit free — a no-op under XLA buffer liveness
+    (kept so transpiled reference programs run)."""
+    return {}
+
+
+# ---------------------------------------------------------------------------
+# sequence utilities (padded+lengths forms of sequence_ops/*)
+# ---------------------------------------------------------------------------
+@register("sequence_enumerate", no_grad_inputs=("X",))
+def _sequence_enumerate(ctx, ins, attrs):
+    """sequence_enumerate_op.cc: sliding win_size id windows per step,
+    pad_value beyond the end. X: [B, T] int -> Out: [B, T, win]."""
+    x = ins["X"][0]
+    win = int(attrs.get("win_size", 2))
+    pad = attrs.get("pad_value", 0)
+    b, t = x.shape[:2]
+    cols = []
+    for k in range(win):
+        shifted = jnp.concatenate(
+            [x[:, k:], jnp.full((b, k), pad, x.dtype)], axis=1
+        )
+        cols.append(shifted)
+    return {"Out": [jnp.stack(cols, axis=-1)]}
+
+
+@register("sequence_erase", no_grad_inputs=("X",))
+def _sequence_erase(ctx, ins, attrs):
+    """sequence_erase_op.cc re-expressed for static shapes: erased tokens
+    are masked to pad (0) and compacted to the front of each row, with the
+    new lengths emitted as OutLen."""
+    x = ins["X"][0]
+    tokens = jnp.asarray(list(attrs.get("tokens", [])), x.dtype)
+    keep = jnp.all(x[..., None] != tokens.reshape((1,) * x.ndim + (-1,)), axis=-1)
+    t = x.shape[1]
+    # stable compaction: order by (not keep, position)
+    order = jnp.argsort(jnp.where(keep, 0, 1) * t + jnp.arange(t)[None, :], axis=1)
+    compacted = jnp.take_along_axis(jnp.where(keep, x, 0), order, axis=1)
+    new_len = jnp.sum(keep.astype(jnp.int64), axis=1)
+    ar = jnp.arange(t)[None, :]
+    compacted = jnp.where(ar < new_len[:, None], compacted, 0)
+    return {"Out": [compacted], "OutLen": [new_len]}
+
+
+@register("sequence_expand_as")
+def _sequence_expand_as(ctx, ins, attrs):
+    """sequence_expand_as_op.cc: tile each row of X along Y's time axis."""
+    x, y = ins["X"][0], ins["Y"][0]
+    if x.ndim == y.ndim:
+        return {"Out": [jnp.broadcast_to(x, y.shape)]}
+    out = jnp.broadcast_to(x[:, None], (x.shape[0], y.shape[1]) + x.shape[1:])
+    return {"Out": [out]}
+
+
+@register("sequence_scatter", no_grad_inputs=("Ids",))
+def _sequence_scatter(ctx, ins, attrs):
+    """sequence_scatter_op.cc: scatter-add Updates rows into X at per-row
+    time indices Ids.  X: [B, T], Ids/Updates: [B, K]."""
+    x, ids, upd = ins["X"][0], ins["Ids"][0], ins["Updates"][0]
+    b = x.shape[0]
+    rows = jnp.arange(b)[:, None].astype(jnp.int32)
+    rows = jnp.broadcast_to(rows, ids.shape)
+    return {"Out": [x.at[rows, ids.astype(jnp.int32)].add(upd)]}
+
+
+# ---------------------------------------------------------------------------
+# rnn units (gru_unit_op.cc)
+# ---------------------------------------------------------------------------
+@register("gru_unit")
+def _gru_unit(ctx, ins, attrs):
+    """One GRU step: Input [B, 3H] (pre-projected), HiddenPrev [B, H],
+    Weight [H, 3H] (update|reset | candidate), optional Bias [3H]."""
+    x = ins["Input"][0]
+    h_prev = ins["HiddenPrev"][0]
+    w = ins["Weight"][0]
+    hdim = h_prev.shape[-1]
+    bias = ins["Bias"][0].reshape(-1) if ins.get("Bias") else None
+    if bias is not None:
+        x = x + bias
+    gate_w = w[:, : 2 * hdim]
+    cand_w = w[:, 2 * hdim :]
+    gates = x[:, : 2 * hdim] + h_prev @ gate_w
+    u = jax.nn.sigmoid(gates[:, :hdim])
+    r = jax.nn.sigmoid(gates[:, hdim:])
+    c = jnp.tanh(x[:, 2 * hdim :] + (r * h_prev) @ cand_w)
+    # paddle gru_unit: h = u * h_prev + (1-u) * c
+    h = u * h_prev + (1.0 - u) * c
+    return {"Gate": [gates], "ResetHiddenPrev": [r * h_prev], "Hidden": [h]}
+
+
+# ---------------------------------------------------------------------------
+# metric ops (detection_map_op.cc, positive_negative_pair_op.cc)
+# ---------------------------------------------------------------------------
+@register("positive_negative_pair", no_grad_inputs=("Score", "Label", "QueryID"))
+def _positive_negative_pair(ctx, ins, attrs):
+    """Pairwise ranking quality per query: counts of correctly/incorrectly
+    ordered pairs (+ties) — learning-to-rank eval."""
+    score = ins["Score"][0].reshape(-1)
+    label = ins["Label"][0].reshape(-1)
+    query = ins["QueryID"][0].reshape(-1)
+    n = score.shape[0]
+    same_q = query[:, None] == query[None, :]
+    li, lj = label[:, None], label[None, :]
+    si, sj = score[:, None], score[None, :]
+    valid = same_q & (li > lj)
+    pos = jnp.sum((valid & (si > sj)).astype(jnp.float32))
+    neg = jnp.sum((valid & (si < sj)).astype(jnp.float32))
+    neu = jnp.sum((valid & (si == sj)).astype(jnp.float32))
+    return {
+        "PositivePair": [pos.reshape(1)],
+        "NegativePair": [neg.reshape(1)],
+        "NeutralPair": [neu.reshape(1)],
+    }
+
+
+# ---------------------------------------------------------------------------
+# op-level save / load (save_op.cc, load_op.cc, *_combine): host callbacks
+# so reference-style programs that embed checkpoint ops run unchanged
+# ---------------------------------------------------------------------------
+def _save_path(attrs):
+    import os
+
+    path = attrs["file_path"]
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    return path
+
+
+@register("save", side_effect=True)
+def _save(ctx, ins, attrs):
+    from jax.experimental import io_callback
+
+    path = _save_path(attrs)
+
+    def host_save(x):
+        np.save(path + ".npy" if not path.endswith(".npy") else path, np.asarray(x))
+        return np.int32(0)
+
+    tok = io_callback(
+        host_save, jax.ShapeDtypeStruct((), jnp.int32), ins["X"][0], ordered=True
+    )
+    return {"Out": [tok]}
+
+
+@register("load", side_effect=True)
+def _load(ctx, ins, attrs):
+    from jax.experimental import io_callback
+
+    path = attrs["file_path"]
+    arr = np.load(path + ".npy" if not path.endswith(".npy") else path)
+
+    def host_load():
+        return np.load(path + ".npy" if not path.endswith(".npy") else path)
+
+    out = io_callback(
+        host_load, jax.ShapeDtypeStruct(arr.shape, arr.dtype), ordered=True
+    )
+    return {"Out": [out]}
+
+
+@register("save_combine", side_effect=True)
+def _save_combine(ctx, ins, attrs):
+    from jax.experimental import io_callback
+
+    path = _save_path(attrs)
+    names = list(attrs.get("var_names", [str(i) for i in range(len(ins["X"]))]))
+
+    def host_save(*arrs):
+        np.savez(path, **{n: np.asarray(a) for n, a in zip(names, arrs)})
+        return np.int32(0)
+
+    tok = io_callback(
+        host_save, jax.ShapeDtypeStruct((), jnp.int32), *ins["X"], ordered=True
+    )
+    return {"Out": [tok]}
+
+
+@register("load_combine", side_effect=True)
+def _load_combine(ctx, ins, attrs):
+    from jax.experimental import io_callback
+
+    path = attrs["file_path"]
+    if not path.endswith(".npz"):
+        path = path + ".npz"
+    blob = np.load(path)
+    names = list(attrs.get("var_names", list(blob.files)))
+    outs = []
+    for n in names:
+        arr = blob[n]
+
+        def host_load(n=n):
+            return np.load(path)[n]
+
+        outs.append(
+            io_callback(
+                host_load, jax.ShapeDtypeStruct(arr.shape, arr.dtype), ordered=True
+            )
+        )
+    return {"Out": outs}

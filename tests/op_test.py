@@ -191,11 +191,19 @@ def run_single_op(op_type, inputs, attrs, out_slots):
         out_names = {}
         out_vars = []
         for slot in out_slots:
-            v = blk.create_var(
-                name="o_" + slot.lower().replace("-", "_"), dtype="float32", shape=None
-            )
-            out_names[slot] = [v.name]
-            out_vars.append(v)
+            # (slot, n) requests an n-var output slot
+            slot, count = slot if isinstance(slot, tuple) else (slot, 1)
+            names = []
+            for i in range(count):
+                suffix = "" if count == 1 else "_%d" % i
+                v = blk.create_var(
+                    name="o_" + slot.lower().replace("-", "_") + suffix,
+                    dtype="float32",
+                    shape=None,
+                )
+                names.append(v.name)
+                out_vars.append(v)
+            out_names[slot] = names
         blk.append_op(op_type, inputs=in_names, outputs=out_names, attrs=attrs)
     exe = fluid.Executor(fluid.CPUPlace())
     with fluid.scope_guard(fluid.Scope()):

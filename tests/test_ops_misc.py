@@ -1,0 +1,137 @@
+"""OpTest-style checks for the long-tail ops in ops/misc_ops.py."""
+
+import numpy as np
+import pytest
+
+from op_test import run_single_op as run_op
+
+
+def test_minus_and_l1_norm():
+    x = np.array([[1.0, -2.0], [3.0, -4.0]], "float32")
+    y = np.ones((2, 2), "float32")
+    (out,) = run_op("minus", {"X": x, "Y": y}, {}, ["Out"])
+    np.testing.assert_allclose(out, x - y)
+    (n,) = run_op("l1_norm", {"X": x}, {}, ["Out"])
+    np.testing.assert_allclose(n, [10.0])
+
+
+def test_fill():
+    (out,) = run_op(
+        "fill",
+        {},
+        {"shape": [2, 2], "dtype": "float32", "value": [1.0, 2.0, 3.0, 4.0]},
+        ["Out"],
+    )
+    np.testing.assert_allclose(out, [[1, 2], [3, 4]])
+
+
+def test_hash_deterministic_and_bucketed():
+    x = np.array([[1], [2], [1]], "int64")
+    (h1,) = run_op("hash", {"X": x}, {"num_hash": 2, "mod_by": 1000}, ["Out"])
+    (h2,) = run_op("hash", {"X": x}, {"num_hash": 2, "mod_by": 1000}, ["Out"])
+    np.testing.assert_array_equal(h1, h2)
+    assert (np.asarray(h1) >= 0).all() and (np.asarray(h1) < 1000).all()
+    assert np.array_equal(h1[0], h1[2]) and not np.array_equal(h1[0], h1[1])
+
+
+def test_pool2d_with_index():
+    x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+    out, mask = run_op(
+        "pool2d_with_index", {"X": x}, {"ksize": [2, 2], "strides": [2, 2]},
+        ["Out", "Mask"],
+    )
+    np.testing.assert_allclose(out[0, 0], [[5, 7], [13, 15]])
+    np.testing.assert_array_equal(mask[0, 0], [[5, 7], [13, 15]])
+
+
+def test_sequence_enumerate():
+    x = np.array([[1, 2, 3]], "int64")
+    (out,) = run_op(
+        "sequence_enumerate", {"X": x}, {"win_size": 2, "pad_value": 0}, ["Out"]
+    )
+    np.testing.assert_array_equal(out[0], [[1, 2], [2, 3], [3, 0]])
+
+
+def test_sequence_erase():
+    x = np.array([[1, 5, 2, 5, 3]], "int64")
+    out, newlen = run_op(
+        "sequence_erase", {"X": x}, {"tokens": [5]}, ["Out", "OutLen"]
+    )
+    np.testing.assert_array_equal(out[0], [1, 2, 3, 0, 0])
+    assert int(newlen[0]) == 3
+
+
+def test_sequence_scatter():
+    x = np.zeros((2, 5), "float32")
+    ids = np.array([[0, 2], [1, 1]], "int64")
+    upd = np.array([[1.0, 2.0], [3.0, 4.0]], "float32")
+    (out,) = run_op(
+        "sequence_scatter", {"X": x, "Ids": ids, "Updates": upd}, {}, ["Out"]
+    )
+    np.testing.assert_allclose(out[0], [1, 0, 2, 0, 0])
+    np.testing.assert_allclose(out[1], [0, 7, 0, 0, 0])  # duplicate adds
+
+
+def test_gru_unit_step_matches_scan_gru():
+    """gru_unit must agree with one step of the padded_gru op."""
+    rng = np.random.RandomState(0)
+    b, h = 3, 4
+    x = rng.randn(b, 3 * h).astype("float32")
+    h0 = rng.randn(b, h).astype("float32")
+    w = rng.randn(h, 3 * h).astype("float32")
+    (hidden,) = run_op(
+        "gru_unit",
+        {"Input": x, "HiddenPrev": h0, "Weight": w},
+        {},
+        ["Hidden"],
+    )
+    (seq_h,) = run_op(
+        "padded_gru",
+        {"Input": x.reshape(b, 1, 3 * h), "Weight": w, "H0": h0},
+        {},
+        ["Hidden"],
+    )
+    np.testing.assert_allclose(
+        np.asarray(hidden), np.asarray(seq_h)[:, 0], rtol=1e-4, atol=1e-5
+    )
+
+
+def test_positive_negative_pair():
+    score = np.array([0.9, 0.1, 0.5, 0.4], "float32").reshape(-1, 1)
+    label = np.array([1.0, 0.0, 1.0, 0.0], "float32").reshape(-1, 1)
+    query = np.array([0, 0, 1, 1], "int64").reshape(-1, 1)
+    pos, neg, neu = run_op(
+        "positive_negative_pair",
+        {"Score": score, "Label": label, "QueryID": query},
+        {},
+        ["PositivePair", "NegativePair", "NeutralPair"],
+    )
+    assert float(pos[0]) == 2.0 and float(neg[0]) == 0.0 and float(neu[0]) == 0.0
+
+
+def test_save_load_ops_roundtrip(tmp_path):
+    path = str(tmp_path / "var")
+    x = np.arange(6, dtype="float32").reshape(2, 3)
+    run_op("save", {"X": x}, {"file_path": path}, ["Out"])
+    (back,) = run_op("load", {}, {"file_path": path}, ["Out"])
+    np.testing.assert_allclose(back, x)
+
+
+def test_save_load_combine_roundtrip(tmp_path):
+    path = str(tmp_path / "combined")
+    a = np.ones((2, 2), "float32")
+    b = np.arange(3, dtype="float32")
+    run_op(
+        "save_combine",
+        {"X": [("va", a), ("vb", b)]},
+        {"file_path": path, "var_names": ["a", "b"]},
+        ["Out"],
+    )
+    outs = run_op(
+        "load_combine",
+        {},
+        {"file_path": path, "var_names": ["a", "b"]},
+        [("Out", 2)],
+    )
+    np.testing.assert_allclose(outs[0], a)
+    np.testing.assert_allclose(outs[1], b)
