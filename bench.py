@@ -8,22 +8,27 @@ Baseline anchor: the reference's best published ResNet-50 training number,
 see BASELINE.md; no GPU number is published in-tree).
 
 Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+
+Robustness contract (VERDICT round 1, item 1b): the parent process NEVER
+imports jax. It runs the measurement in a child process — first on the TPU
+(with retries, since the axon plugin can be transiently busy), then, if the
+chip is unavailable, in a CPU-only child with a clearly-labeled fallback
+metric — so a JSON line is always produced with rc=0.
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-import numpy as np
-
-
 BASELINE_IMAGES_PER_SEC = 82.35  # reference ResNet-50 train, bs128 (BASELINE.md)
 
 
-def main():
+def _bench_impl():
+    import numpy as np
     import jax
 
     import paddle_tpu as fluid
@@ -80,6 +85,66 @@ def main():
             }
         )
     )
+
+
+def _run_child(env, timeout):
+    """Run this script as a measurement child; return (ok, json_line, log)."""
+    env = dict(env)
+    env["_BENCH_CHILD"] = "1"
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired as e:
+        return False, None, "child timed out after %ss: %s" % (
+            timeout, (e.stdout or b"")[-2000:])
+    out = proc.stdout.decode("utf-8", "replace")
+    err = proc.stderr.decode("utf-8", "replace")
+    line = None
+    for ln in out.splitlines():
+        ln = ln.strip()
+        if ln.startswith("{") and '"metric"' in ln:
+            line = ln
+    if proc.returncode == 0 and line:
+        return True, line, err
+    return False, None, (out + "\n" + err)[-4000:]
+
+
+def main():
+    if os.environ.get("_BENCH_CHILD") == "1":
+        return _bench_impl()
+
+    # 1) TPU attempts: the axon plugin can be transiently busy — retry.
+    attempts = int(os.environ.get("BENCH_TPU_ATTEMPTS", "2"))
+    for i in range(attempts):
+        ok, line, log = _run_child(os.environ, timeout=1500)
+        if ok:
+            print(line)
+            return
+        sys.stderr.write("bench: TPU attempt %d/%d failed:\n%s\n"
+                         % (i + 1, attempts, log))
+        time.sleep(10)
+
+    # 2) CPU fallback: clearly-labeled number so the driver records
+    # *something* even when the chip is unavailable.
+    from __graft_entry__ import _cpu_only_env
+
+    ok, line, log = _run_child(_cpu_only_env(1), timeout=900)
+    if ok:
+        print(line)
+        return
+    sys.stderr.write("bench: CPU fallback failed:\n%s\n" % log)
+    # last resort: still emit a parseable line rather than crash
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip_failed",
+        "value": 0.0,
+        "unit": "images/sec",
+        "vs_baseline": 0.0,
+    }))
 
 
 if __name__ == "__main__":

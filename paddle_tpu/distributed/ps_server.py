@@ -59,6 +59,11 @@ class ParameterServer:
 
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
+        # async mode: run the lr (decay) program once per logical trainer
+        # step, not once per grad-var send — trigger it on a single
+        # designated grad so a k-param model doesn't advance the schedule's
+        # step counter k times per step
+        self._lr_trigger = min(grad_to_shard) if grad_to_shard else None
         self._pending = {}  # grad block name -> {trainer_id: np.ndarray}
         self._send_barriers = set()
         self._fetch_barriers = set()
@@ -101,7 +106,7 @@ class ParameterServer:
         value = np.asarray(value)
         if not self.sync_mode:
             with self._lock:
-                if self.lr_program is not None:
+                if self.lr_program is not None and name == self._lr_trigger:
                     self.exe.run(
                         self.lr_program, feed={}, fetch_list=[], scope=self.scope
                     )

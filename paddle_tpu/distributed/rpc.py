@@ -45,6 +45,19 @@ def _recv_msg(sock):
     return pickle.loads(_recv_exact(sock, n))
 
 
+class _InFlight:
+    """Dedup-table entry: created before dispatch so a timed-out client's
+    retry waits on the original execution instead of re-executing a
+    non-idempotent verb concurrently (e.g. double-registering a trainer
+    into the next barrier round)."""
+
+    __slots__ = ("done", "result")
+
+    def __init__(self):
+        self.done = threading.Event()
+        self.result = None
+
+
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
         server = self.server
@@ -56,18 +69,30 @@ class _Handler(socketserver.BaseRequestHandler):
                     return
                 # at-most-once execution: a client retry after a dropped
                 # reply must not re-apply non-idempotent verbs (grad sends,
-                # barriers) — replay the cached response instead
+                # barriers) — the in-flight marker is recorded BEFORE
+                # dispatch, so a retry always finds it and waits for the
+                # original result instead of re-executing
                 with server.dedup_lock:
-                    if req_id in server.dedup:
-                        result = server.dedup[req_id]
-                    else:
-                        result = None
-                if result is None:
-                    result = service.handle(verb, **kwargs)
+                    entry = server.dedup.get(req_id)
+                    owner = entry is None
+                    if owner:
+                        entry = server.dedup[req_id] = _InFlight()
+                if owner:
+                    try:
+                        entry.result = service.handle(verb, **kwargs)
+                    finally:
+                        entry.done.set()
                     with server.dedup_lock:
-                        server.dedup[req_id] = result
-                        while len(server.dedup) > 4096:
-                            server.dedup.popitem(last=False)
+                        # trim oldest *completed* entries only
+                        if len(server.dedup) > 4096:
+                            for rid in list(server.dedup):
+                                if len(server.dedup) <= 4096:
+                                    break
+                                if server.dedup[rid].done.is_set():
+                                    del server.dedup[rid]
+                else:
+                    entry.done.wait()
+                result = entry.result
                 _send_msg(self.request, result)
         except (ConnectionError, EOFError):
             return
@@ -81,7 +106,7 @@ class _Server(socketserver.ThreadingTCPServer):
         super().__init__(*a, **kw)
         import collections
 
-        self.dedup = collections.OrderedDict()  # req_id -> response
+        self.dedup = collections.OrderedDict()  # req_id -> _InFlight
         self.dedup_lock = threading.Lock()
 
 

@@ -44,7 +44,7 @@ def _hash(ctx, ins, attrs):
     for i in range(num_hash):
         # murmur3-style 32-bit finalizer, seeded per hash row (works in
         # JAX's default 32-bit int mode; wraparound is the point)
-        h = x + jnp.uint32((i + 1) * 0x9E3779B9)
+        h = x + jnp.uint32(((i + 1) * 0x9E3779B9) & 0xFFFFFFFF)
         h = h ^ (h >> 16)
         h = h * jnp.uint32(0x85EBCA6B)
         h = h ^ (h >> 13)
@@ -174,8 +174,8 @@ def _gru_unit(ctx, ins, attrs):
     u = jax.nn.sigmoid(gates[:, :hdim])
     r = jax.nn.sigmoid(gates[:, hdim:])
     c = jnp.tanh(x[:, 2 * hdim :] + (r * h_prev) @ cand_w)
-    # paddle gru_unit: h = u * h_prev + (1-u) * c
-    h = u * h_prev + (1.0 - u) * c
+    # gru_unit_op.h:116: h = u * (c - h_prev) + h_prev = u*c + (1-u)*h_prev
+    h = u * c + (1.0 - u) * h_prev
     return {"Gate": [gates], "ResetHiddenPrev": [r * h_prev], "Hidden": [h]}
 
 

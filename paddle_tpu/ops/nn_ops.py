@@ -441,10 +441,12 @@ def _padded_gru(ctx, ins, attrs):
         x_t, t_idx = inp
         x_rz = x_t[:, : 2 * hid]
         x_c = x_t[:, 2 * hid :]
-        rz = jax.nn.sigmoid(x_rz + h_prev @ w_rz)
-        r, z = jnp.split(rz, 2, axis=-1)
+        # gate layout [update|reset|state], blend h = u*c + (1-u)*h_prev
+        # (math/detail/gru_kernel.h:58-63: out = prev - u*prev + u*state)
+        uz = jax.nn.sigmoid(x_rz + h_prev @ w_rz)
+        u, r = jnp.split(uz, 2, axis=-1)
         c = jnp.tanh(x_c + (r * h_prev) @ w_c)
-        h = z * h_prev + (1 - z) * c
+        h = u * c + (1 - u) * h_prev
         if seq_len is not None:
             m = (t_idx < seq_len).astype(h.dtype)[:, None]
             h = m * h + (1 - m) * h_prev
