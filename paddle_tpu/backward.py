@@ -21,7 +21,11 @@ _FLOAT_DTYPES = ("float16", "bfloat16", "float32", "float64")
 
 def _is_float_var(block, name):
     v = block._find_var_recursive(name)
-    return v is not None and v.dtype in _FLOAT_DTYPES
+    if v is None or v.dtype not in _FLOAT_DTYPES:
+        return False
+    # tensor arrays are opaque (TensorArray pytrees at trace time) — grads
+    # don't flow through them (use the `recurrent` op for trainable loops)
+    return getattr(v, "type", None) != framework.VarType.LOD_TENSOR_ARRAY
 
 
 def _create_grad_var(block, ref_name, grad_name):
@@ -115,6 +119,13 @@ def _append_backward_impl(loss, program, block, no_grad, parameter_list):
             out_grads[slot] = gs
         if not any_grad:
             continue
+        if op.type == "while":
+            raise RuntimeError(
+                "gradients cannot flow through an unbounded While "
+                "(lax.while_loop is not reverse-differentiable); construct "
+                "it as layers.While(cond, max_iters=N) to lower to a "
+                "differentiable masked scan, or use StaticRNN/DynamicRNN"
+            )
 
         # build grad op inputs: forward inputs + out-grads
         gin = {}
@@ -188,6 +199,27 @@ def _append_backward_impl(loss, program, block, no_grad, parameter_list):
                 "__fwd_op_idx__": i,
             },
         )
+
+        # in-place updates (a var both read and written by this op — loop
+        # carries, assign-into-existing) violate the one-writer assumption
+        # the name-keyed accumulator relies on: contributions gathered so
+        # far belong to the POST-op version and were just consumed as this
+        # op's output grad.  Earlier ops must see only the grad this op
+        # produced for its (pre-op) input version.
+        in_names = set(op.input_arg_names())
+        for n in set(op.output_arg_names()) & in_names:
+            if not _is_float_var(block, n):
+                continue
+            newg = None
+            for slot, names in op.inputs.items():
+                gnames = gout.get(slot + "@GRAD")
+                if not gnames:
+                    continue
+                for nm, g in zip(names, gnames):
+                    if nm == n and g:
+                        newg = g
+            contribs[n] = [newg] if newg else []
+            finalized.pop(n, None)
 
     # finalize every remaining accumulated grad and publish the name map so
     # calc_gradient (and debuggers) can find grads of arbitrary vars;

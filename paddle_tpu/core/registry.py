@@ -92,7 +92,10 @@ class LowerCtx:
 
 
 def _is_float(x):
-    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+    try:
+        return jnp.issubdtype(jnp.result_type(x), jnp.floating)
+    except TypeError:
+        return False  # opaque values (TensorArray) are not differentiable leaves
 
 
 def lower_grad_op(ctx, op, ins, attrs):
@@ -143,7 +146,8 @@ def lower_grad_op(ctx, op, ins, attrs):
     primals = [fwd_ins[s][i] for (s, i) in diff_pos]
     fwd_flat, vjp_fn = jax.vjp(fwd_fn, primals)
 
-    # cotangents: supplied grads or zeros
+    # cotangents: supplied grads or zeros; non-float outputs (indices, loop
+    # conditions) take symbolic-zero float0 cotangents per jax.vjp contract
     cots = []
     k = 0
     for s in out_slots:
@@ -152,7 +156,9 @@ def lower_grad_op(ctx, op, ins, attrs):
         for i in range(n_out):
             ref = fwd_flat[k]
             k += 1
-            if gslot is not None and i < len(gslot) and gslot[i] is not None:
+            if not jnp.issubdtype(jnp.result_type(ref), jnp.inexact):
+                cots.append(np.zeros(ref.shape, dtype=jax.dtypes.float0))
+            elif gslot is not None and i < len(gslot) and gslot[i] is not None:
                 cots.append(jnp.asarray(gslot[i], dtype=ref.dtype).reshape(ref.shape))
             else:
                 cots.append(jnp.zeros(ref.shape, ref.dtype))

@@ -93,7 +93,11 @@ def analyze_block(program, block_idx, feed_names, fetch_names, keep=None):
                     reads.append(name)
             for a, v in op.attrs.items():
                 if a.startswith("sub_block") and isinstance(v, int):
-                    visit_block(v, set(defined))
+                    # names the op's lowering binds into the sub-block env
+                    # (recurrent step slices, carried loop state) are defined
+                    # there, not external reads
+                    bound = op.attrs.get("__bound_names__", ())
+                    visit_block(v, set(defined) | set(bound))
             for name in op.output_arg_names():
                 defined.add(name)
                 if name not in writes_set:
@@ -189,6 +193,12 @@ def build_traced_function(program, block_idx, feed_names, fetch_names, scope):
                 env[n] = v
             return env
 
+        # pre-execution input snapshots for ops that overwrite their own
+        # inputs (loop carries, assign-into-existing): their grad ops re-run
+        # the forward lowering and MUST see the original inputs, not the
+        # post-op values the in-place write left in env
+        snapshots = {}
+
         def trace_ops(bidx, env):
             blk = program.block(bidx)
             for idx, op in enumerate(blk.ops):
@@ -204,10 +214,22 @@ def build_traced_function(program, block_idx, feed_names, fetch_names, scope):
                 if op.type == "cond":
                     env = trace_cond(op, env)
                     continue
+                is_grad = op.type.endswith("_grad") and "__fwd_type__" in op.attrs
+                snap = None
+                if is_grad:
+                    snap = snapshots.get((bidx, op.attrs.get("__fwd_op_idx__")))
+                elif set(op.output_arg_names()) & set(op.input_arg_names()):
+                    snapshots[(bidx, idx)] = {
+                        n: env[n] for n in op.input_arg_names() if n in env
+                    }
                 ins = {}
                 for slot, names in op.inputs.items():
                     vals = []
+                    use_snap = snap if not slot.endswith("@GRAD") else None
                     for n in names:
+                        if use_snap is not None and n in use_snap:
+                            vals.append(use_snap[n])
+                            continue
                         if n not in env:
                             raise RuntimeError(
                                 "op %s reads undefined var %s" % (op.type, n)

@@ -62,8 +62,8 @@ def infer_shape(op, block):
         if shapes is None:
             continue
         for n, s in zip(names, shapes):
-            if s is None:
-                continue
+            if s is None or not hasattr(s, "shape"):
+                continue  # opaque outputs (TensorArray pytrees) carry no shape
             v = block._find_var_recursive(n)
             if v is not None:
                 v.shape = tuple(-1 if d == _DYN else d for d in s.shape)

@@ -1,16 +1,27 @@
 """Control-flow layers (layers/control_flow.py analog).
 
 The reference runs sub-blocks through nested interpreters (while_op.cc:36
-with StepScopes).  TPU-natively, `While` builds a sub-block that the tracer
-lowers into one `lax.while_loop` (compiled, no per-step dispatch), and
-StaticRNN lowers to `lax.scan`.  Gradients of scan-backed RNN layers come
-from vjp of the lowering; grad-of-while is not yet supported (use StaticRNN
-or the padded rnn layers for trainable recurrences).
+with StepScopes).  TPU-natively:
+
+- ``While`` builds a sub-block the tracer lowers into one ``lax.while_loop``
+  (forward-only); with ``max_iters`` it becomes a ``bounded_while`` op — a
+  masked ``lax.scan`` that IS reverse-differentiable (SURVEY.md §7 hard
+  part 3).
+- ``StaticRNN`` (control_flow.py:429) and ``DynamicRNN`` (:1542) both emit a
+  single ``recurrent`` op (recurrent_op.cc analog) whose lowering is one
+  ``lax.scan`` over the step sub-block — gradients flow through the whole
+  recurrence via the generic vjp machinery, replacing the reference's
+  StepScopes + while_grad interpreter.
+- Tensor arrays (:825 lod_tensor_to_array etc.) are static-capacity
+  ``TensorArray`` pytrees (ops/control_ops.py).
+- ``IfElse`` (:1412) is re-expressed as compute-both + row-wise select
+  (static shapes; the reference's row splitting cannot compile on TPU).
+- ``Switch`` (:1286) traces every case block and merges first-true-wins.
 """
 
 import numpy as np
 
-from .. import framework
+from .. import framework, unique_name
 from ..framework import Variable
 from ..layer_helper import LayerHelper
 from . import tensor as tensor_layers
@@ -33,6 +44,11 @@ __all__ = [
     "IfElse",
     "StaticRNN",
     "DynamicRNN",
+    "lod_rank_table",
+    "max_sequence_len",
+    "lod_tensor_to_array",
+    "array_to_lod_tensor",
+    "shrink_memory",
 ]
 
 
@@ -78,8 +94,33 @@ def increment(x, value=1.0, in_place=True):
     return nn.increment(x, value, in_place)
 
 
+def _sub_block_externals(program, blk, bound):
+    """Outer-scope names a sub-block (and its nested sub-blocks) reads:
+    everything read before being written, minus names the emitting op's
+    lowering will bind (`bound`).  These become the op's Ext inputs so the
+    generic vjp grad path sees them as differentiable leaves."""
+    reads = []
+    seen = set()
+
+    def visit(b, defined):
+        for op in b.ops:
+            for n in op.input_arg_names():
+                if n and n not in defined and n not in seen:
+                    seen.add(n)
+                    reads.append(n)
+            for a, v in op.attrs.items():
+                if a.startswith("sub_block") and isinstance(v, int):
+                    nested_bound = op.attrs.get("__bound_names__", ())
+                    visit(program.block(v), set(defined) | set(nested_bound))
+            for n in op.output_arg_names():
+                defined.add(n)
+
+    visit(blk, set(bound))
+    return reads
+
+
 class While:
-    """while_op analog lowering to lax.while_loop.
+    """while_op analog.
 
     Usage parity with control_flow.py:655:
         cond = layers.less_than(i, n)
@@ -90,11 +131,15 @@ class While:
             layers.less_than(i, n, cond=cond)
 
     Loop-carried state = every outer var both read and written in the body.
+    With ``max_iters`` set the loop lowers to a masked, reverse-
+    differentiable ``lax.scan`` (bounded_while op) instead of
+    ``lax.while_loop`` — required when gradients must flow through the loop.
     """
 
-    def __init__(self, cond, is_test=False, name=None):
+    def __init__(self, cond, is_test=False, name=None, max_iters=None):
         self.helper = LayerHelper("while", name=name)
         self.cond_var = cond
+        self.max_iters = max_iters
 
     def block(self):
         return WhileGuard(self)
@@ -126,12 +171,41 @@ class WhileGuard:
                     seen.add(name)
                     carried.append(name)
         cond_name = self.while_op.cond_var.name
-        parent.append_op(
-            "while",
-            inputs={"Condition": [cond_name]},
-            outputs={"Out": list(carried)},
-            attrs={"sub_block_idx": sub_block.idx, "carried_vars": list(carried)},
-        )
+        if cond_name not in carried:
+            raise RuntimeError(
+                "While condition var '%s' is not updated in the loop body "
+                "(infinite loop); recompute it with layers.less_than(..., "
+                "cond=cond)" % cond_name
+            )
+        max_iters = self.while_op.max_iters
+        if max_iters is not None:
+            ext = [
+                n
+                for n in _sub_block_externals(
+                    self.main_program, sub_block, carried
+                )
+                if parent._find_var_recursive(n) is not None
+            ]
+            parent.append_op(
+                "bounded_while",
+                inputs={"Carried": list(carried), "Ext": ext},
+                outputs={"Out": list(carried)},
+                attrs={
+                    "sub_block_idx": sub_block.idx,
+                    "carried_vars": list(carried),
+                    "ext_names": ext,
+                    "cond_name": cond_name,
+                    "max_iters": int(max_iters),
+                    "__bound_names__": list(carried) + ext,
+                },
+            )
+        else:
+            parent.append_op(
+                "while",
+                inputs={"Condition": [cond_name]},
+                outputs={"Out": list(carried)},
+                attrs={"sub_block_idx": sub_block.idx, "carried_vars": list(carried)},
+            )
         return True
 
 
@@ -161,7 +235,7 @@ def cond(pred, true_fn=None, false_fn=None, name=None):
     parent = main.current_block()
     out_vars = [
         parent.create_var(
-            name=framework.unique_name.generate("cond_out"), dtype="float32", shape=None
+            name=unique_name.generate("cond_out"), dtype="float32", shape=None
         )
         for _ in touts
     ]
@@ -181,56 +255,647 @@ def cond(pred, true_fn=None, false_fn=None, name=None):
     return out_vars
 
 
+# ---------------------------------------------------------------------------
+# Switch (control_flow.py:1286): first-true-wins case assignment
+# ---------------------------------------------------------------------------
 class Switch:
-    """Switch/case built on nested cond (control_flow.py:1286 parity)."""
+    """Piecewise assignment (the lr-schedule workhorse):
+
+        with layers.Switch() as switch:
+            with switch.case(cond1):
+                tensor_layers.assign(v1, out)
+            with switch.default():
+                tensor_layers.assign(v2, out)
+
+    Every case body becomes a sub-block; the emitted `switch` op traces all
+    of them (pure under the functionalized scope) and merges the written
+    vars with a first-true-wins jnp.where chain.
+    """
 
     def __init__(self, name=None):
-        raise NotImplementedError("Switch pending; use layers.cond")
+        self.helper = LayerHelper("switch", name=name)
+        self.main_program = framework.default_main_program()
+        self.cases = []  # (cond_var_or_None, block, written_names)
+        self.inside = False
+
+    def case(self, condition):
+        if not self.inside:
+            raise ValueError("case() must be called inside `with Switch()`")
+        if self.cases and self.cases[-1][0] is None:
+            raise ValueError("default() must be the last branch of a Switch")
+        return _SwitchCaseGuard(self, condition)
+
+    def default(self):
+        if not self.inside:
+            raise ValueError("default() must be called inside `with Switch()`")
+        return _SwitchCaseGuard(self, None)
+
+    def __enter__(self):
+        self.inside = True
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if exc_type is not None:
+            return False
+        self.inside = False
+        self._complete()
+        return True
+
+    def _complete(self):
+        if not self.cases:
+            return
+        parent = self.main_program.current_block()
+        written = []
+        for _, _, wnames in self.cases:
+            for n in wnames:
+                if n not in written:
+                    written.append(n)
+        # prior values of written vars (fallthrough when no case matches and
+        # there is no default, and fill-in for cases that skip a var)
+        cur = []
+        for n in written:
+            v = parent._find_var_recursive(n)
+            if v is not None and (v.persistable or getattr(v, "op", None) is not None):
+                cur.append(n)
+        conds = [c for c, _, _ in self.cases if c is not None]
+        case_blocks = [b.idx for c, b, _ in self.cases if c is not None]
+        default_blocks = [b.idx for c, b, _ in self.cases if c is None]
+        ext = []
+        seen = set(written) | set(cur) | {c.name for c in conds}
+        for _, blk, _ in self.cases:
+            for n in _sub_block_externals(self.main_program, blk, cur):
+                if n not in seen and parent._find_var_recursive(n) is not None:
+                    seen.add(n)
+                    ext.append(n)
+        parent.append_op(
+            "switch",
+            inputs={"Cond": conds, "Cur": list(cur), "Ext": ext},
+            outputs={"Out": list(written)},
+            attrs={
+                "written_names": list(written),
+                "cur_names": list(cur),
+                "ext_names": ext,
+                "case_blocks": case_blocks,
+                "default_block_idx": default_blocks[0] if default_blocks else -1,
+                # keep sub-block attrs discoverable for analyze_block
+                "sub_block_idxs": case_blocks + default_blocks,
+                "__bound_names__": list(cur) + ext,
+            },
+        )
 
 
-class IfElse:
-    def __init__(self, cond, name=None):
-        raise NotImplementedError("IfElse pending; use layers.cond")
+class _SwitchCaseGuard:
+    def __init__(self, switch, condition):
+        self.switch = switch
+        self.condition = condition
+
+    def __enter__(self):
+        self.block = self.switch.main_program.create_block()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if exc_type is not None:
+            return False
+        blk = self.switch.main_program.current_block()
+        self.switch.main_program.rollback()
+        parent = self.switch.main_program.current_block()
+        written = []
+        for op in blk.ops:
+            for name in op.output_arg_names():
+                if (
+                    name
+                    and name not in written
+                    and not blk.has_var_local(name)
+                    and parent._find_var_recursive(name) is not None
+                ):
+                    written.append(name)
+        self.switch.cases.append((self.condition, blk, written))
+        return True
 
 
 # ---------------------------------------------------------------------------
-# tensor arrays (LOD_TENSOR_ARRAY analog, static-size on TPU)
+# IfElse (control_flow.py:1412): compute-both + row-select re-expression
+# ---------------------------------------------------------------------------
+class IfElse:
+    """Row-conditional computation:
+
+        ie = layers.IfElse(cond)          # cond: [batch, 1] bool
+        with ie.true_block():
+            ie.output(f(ie.input(x)))
+        with ie.false_block():
+            ie.output(g(ie.input(x)))
+        out, = ie()
+
+    The reference physically splits the batch by mask, runs each branch on
+    its subset, and merges rows back.  Static XLA shapes can't do that, so
+    both branches run on the FULL batch and the outputs merge with a
+    row-wise select — same math, dense execution (the standard TPU trade).
+    """
+
+    OUT_IF_ELSE_BLOCKS = 2
+
+    def __init__(self, cond, name=None):
+        if not isinstance(cond, Variable):
+            raise TypeError("IfElse cond must be a Variable")
+        self.cond = cond
+        self.helper = LayerHelper("ifelse", name=name)
+        self._outs = {True: [], False: []}
+        self._branch = None
+
+    def input(self, x):
+        if self._branch is None:
+            raise ValueError("IfElse.input() must be called inside a branch block")
+        return x
+
+    def output(self, *outs):
+        if self._branch is None:
+            raise ValueError("IfElse.output() must be called inside a branch block")
+        self._outs[self._branch].extend(outs)
+
+    def true_block(self):
+        return _IfElseBranch(self, True)
+
+    def false_block(self):
+        return _IfElseBranch(self, False)
+
+    def __call__(self):
+        t, f = self._outs[True], self._outs[False]
+        if len(t) != len(f):
+            raise ValueError(
+                "IfElse branches produced %d vs %d outputs" % (len(t), len(f))
+            )
+        merged = []
+        for tv, fv in zip(t, f):
+            out = self.helper.create_variable_for_type_inference(tv.dtype)
+            self.helper.append_op(
+                "ifelse_select",
+                inputs={"Cond": [self.cond], "X": [tv], "Y": [fv]},
+                outputs={"Out": [out]},
+            )
+            merged.append(out)
+        return merged
+
+
+class _IfElseBranch:
+    def __init__(self, ie, is_true):
+        self.ie = ie
+        self.is_true = is_true
+
+    def __enter__(self):
+        self.ie._branch = self.is_true
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.ie._branch = None
+        return exc_type is None
+
+
+# ---------------------------------------------------------------------------
+# tensor arrays (LOD_TENSOR_ARRAY analog, static capacity on TPU)
 # ---------------------------------------------------------------------------
 def create_array(dtype):
     helper = LayerHelper("array")
     return helper.create_variable(
-        name=framework.unique_name.generate("array"),
+        name=unique_name.generate("array"),
         dtype=dtype,
         shape=None,
         type=framework.VarType.LOD_TENSOR_ARRAY,
     )
 
 
-def array_write(x, i, array=None):
-    raise NotImplementedError(
-        "tensor arrays pending — use StaticRNN/scan-based recurrences"
+def array_write(x, i, array=None, capacity=128):
+    """write_to_array (tensor_array_read_write_op.cc).  The first write
+    allocates a static `capacity`-slot store; arrays used as loop-carried
+    state must be seeded with a write BEFORE the loop (so the carry has a
+    concrete shape entering lax.while_loop)."""
+    helper = LayerHelper("array_write")
+    if array is None:
+        array = create_array(x.dtype)
+    inputs = {"X": [x], "I": [i]}
+    if getattr(array, "_array_written", False):
+        inputs["Array"] = [array]
+    helper.append_op(
+        "write_to_array",
+        inputs=inputs,
+        outputs={"Out": [array]},
+        attrs={"capacity": int(capacity)},
     )
+    array._array_written = True
+    return array
 
 
 def array_read(array, i):
-    raise NotImplementedError(
-        "tensor arrays pending — use StaticRNN/scan-based recurrences"
+    helper = LayerHelper("array_read")
+    out = helper.create_variable_for_type_inference(array.dtype)
+    helper.append_op(
+        "read_from_array",
+        inputs={"X": [array], "I": [i]},
+        outputs={"Out": [out]},
     )
+    return out
 
 
 def array_length(array):
-    raise NotImplementedError("tensor arrays pending")
+    helper = LayerHelper("array_length")
+    out = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        "lod_array_length", inputs={"X": [array]}, outputs={"Out": [out]}
+    )
+    return out
+
+
+def lod_rank_table(x, level=0, seq_len=None):
+    """control_flow.py:741 — on TPU the rank table IS the per-sequence
+    length vector (see ops/control_ops.py)."""
+    helper = LayerHelper("lod_rank_table")
+    out = helper.create_variable_for_type_inference("int32")
+    inputs = {"X": [x]}
+    if seq_len is not None:
+        inputs["SeqLen"] = [seq_len]
+    helper.append_op("lod_rank_table", inputs=inputs, outputs={"Out": [out]})
+    return out
+
+
+def max_sequence_len(rank_table):
+    helper = LayerHelper("max_sequence_len")
+    out = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        "max_sequence_len",
+        inputs={"RankTable": [rank_table]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+def lod_tensor_to_array(x, table=None):
+    """control_flow.py:825: padded [B, T, ...] -> time-major TensorArray."""
+    helper = LayerHelper("lod_tensor_to_array")
+    arr = create_array(x.dtype)
+    inputs = {"X": [x]}
+    if table is not None:
+        inputs["RankTable"] = [table]
+    helper.append_op("lod_tensor_to_array", inputs=inputs, outputs={"Out": [arr]})
+    arr._array_written = True
+    return arr
+
+
+def array_to_lod_tensor(x, table=None):
+    helper = LayerHelper("array_to_lod_tensor")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    inputs = {"X": [x]}
+    if table is not None:
+        inputs["RankTable"] = [table]
+    helper.append_op("array_to_lod_tensor", inputs=inputs, outputs={"Out": [out]})
+    return out
+
+
+def shrink_memory(x, i, table):
+    """control_flow.py:1111 — zero-mask rows of sequences finished by step i
+    (the static-shape analog of dropping them)."""
+    helper = LayerHelper("shrink_memory")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "shrink_rnn_memory",
+        inputs={"X": [x], "I": [i], "RankTable": [table]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# StaticRNN / DynamicRNN on the `recurrent` op (one lax.scan)
+# ---------------------------------------------------------------------------
+class _MemoryLink:
+    def __init__(self, init, pre_mem):
+        self.init = init
+        self.pre_mem = pre_mem
+        self.mem = None
+
+
+class _RNNBlockGuard:
+    def __init__(self, rnn):
+        self.rnn = rnn
+        self.main_program = rnn.helper.main_program
+
+    def __enter__(self):
+        self.rnn.status = StaticRNN.IN_RNN_BLOCK
+        self.block = self.main_program.create_block()
+        self.rnn._sub_block = self.block
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if exc_type is not None:
+            return False
+        self.main_program.rollback()
+        self.rnn.status = StaticRNN.AFTER_RNN_BLOCK
+        self.rnn._complete()
+        return True
 
 
 class StaticRNN:
+    """StaticRNN (control_flow.py:429): fixed-length recurrence.
+
+    Step inputs are TIME-MAJOR ([T, batch, ...]) exactly like the
+    reference (`seq_len = x.shape[0]`); outputs come back [T, batch, ...].
+    The whole step block lowers to one differentiable lax.scan via the
+    `recurrent` op instead of the reference's recurrent_op StepScopes
+    interpreter.
+    """
+
+    BEFORE_RNN_BLOCK = 0
+    IN_RNN_BLOCK = 1
+    AFTER_RNN_BLOCK = 2
+
     def __init__(self, name=None):
-        raise NotImplementedError(
-            "StaticRNN pending — use layers.dynamic_lstm/dynamic_gru (scan ops)"
+        self.helper = LayerHelper("static_rnn", name=name)
+        self.memories = {}  # pre_mem.name -> _MemoryLink
+        self.inputs = []  # (outer var, in-block var)
+        self.statics = []  # (outer var, in-block var)
+        self.outputs = []  # outer stacked output vars
+        self._inner_outputs = []
+        self.seq_len = None
+        self.status = StaticRNN.BEFORE_RNN_BLOCK
+        self._sub_block = None
+        self._time_major = True
+        self._seq_len_var = None
+
+    def step(self):
+        return _RNNBlockGuard(self)
+
+    def _assert_in_rnn_block_(self, method):
+        if self.status != StaticRNN.IN_RNN_BLOCK:
+            raise ValueError("You must invoke {0} in rnn block".format(method))
+
+    def _parent_block(self):
+        prog = self.helper.main_program
+        parent_idx = prog.current_block().parent_idx
+        assert parent_idx >= 0
+        return prog.block(parent_idx)
+
+    def memory(
+        self,
+        init=None,
+        shape=None,
+        batch_ref=None,
+        init_value=0.0,
+        init_batch_dim_idx=0,
+        ref_batch_dim_idx=1,
+    ):
+        self._assert_in_rnn_block_("memory")
+        if init is None:
+            if shape is None or batch_ref is None:
+                raise ValueError(
+                    "if init is None, memory at least need shape and batch_ref"
+                )
+            # the boot op runs in the parent block — if batch_ref is an
+            # in-block step slice, reference the outer sequence instead
+            # (whose batch dim is ref_batch_dim_idx=1 in time-major layout,
+            # hence the reference's default)
+            for outer, inner in self.inputs:
+                if batch_ref.name == inner.name:
+                    batch_ref = outer
+                    break
+            parent = self._parent_block()
+            full_shape = list(shape)
+            if len(full_shape) < 2:
+                bdim = -1
+                if batch_ref.shape and len(batch_ref.shape) > ref_batch_dim_idx:
+                    bdim = batch_ref.shape[ref_batch_dim_idx] or -1
+                full_shape.insert(init_batch_dim_idx, bdim)
+            boot = parent.create_var(
+                name=unique_name.generate(
+                    "@".join([self.helper.name, "memory_boot"])
+                ),
+                shape=full_shape,
+                dtype=batch_ref.dtype,
+                persistable=False,
+            )
+            parent.append_op(
+                "fill_constant_batch_size_like",
+                inputs={"Input": [batch_ref]},
+                outputs={"Out": [boot]},
+                attrs={
+                    "value": init_value,
+                    "shape": [abs(d) if d != -1 else 1 for d in full_shape],
+                    "dtype": boot.dtype,
+                    "input_dim_idx": ref_batch_dim_idx,
+                    "output_dim_idx": init_batch_dim_idx,
+                },
+            )
+            return self.memory(init=boot)
+        pre_mem = self.helper.create_variable(
+            name=unique_name.generate("@".join([self.helper.name, "mem"])),
+            dtype=init.dtype,
+            shape=init.shape,
+        )
+        self.memories[pre_mem.name] = _MemoryLink(init=init, pre_mem=pre_mem)
+        return pre_mem
+
+    def step_input(self, x):
+        self._assert_in_rnn_block_("step_input")
+        if not isinstance(x, Variable):
+            raise TypeError("step input takes a Variable")
+        if self.seq_len is None:
+            self.seq_len = x.shape[0]
+        elif x.shape[0] not in (None, -1) and self.seq_len != x.shape[0]:
+            raise ValueError("Static RNN only take fix seq_len input")
+        ipt = self.helper.create_variable(
+            name=unique_name.generate("@".join([self.helper.name, "step_in"])),
+            dtype=x.dtype,
+            shape=list(x.shape[1:]),
+        )
+        self.inputs.append((x, ipt))
+        return ipt
+
+    def static_input(self, x):
+        self._assert_in_rnn_block_("static_input")
+        s = self.helper.create_variable(
+            name=unique_name.generate("@".join([self.helper.name, "static_in"])),
+            dtype=x.dtype,
+            shape=x.shape,
+        )
+        self.statics.append((x, s))
+        return s
+
+    def step_output(self, o):
+        self._assert_in_rnn_block_("step_output")
+        if not isinstance(o, Variable):
+            raise TypeError("step output takes a Variable")
+        self._inner_outputs.append(o)
+        out = self._parent_block().create_var(
+            name=unique_name.generate("@".join([self.helper.name, "out"])),
+            dtype=o.dtype,
+            shape=[self.seq_len] + list(o.shape or []),
+        )
+        self.outputs.append(out)
+
+    def output(self, *outputs):
+        for each in outputs:
+            self.step_output(each)
+
+    def update_memory(self, mem, var):
+        if not isinstance(mem, Variable) or not isinstance(var, Variable):
+            raise TypeError("update memory should take variables")
+        self.memories[mem.name].mem = var
+
+    def _complete(self):
+        prog = self.helper.main_program
+        parent = prog.current_block()
+        sub = self._sub_block
+        links = list(self.memories.values())
+        for l in links:
+            if l.mem is None:
+                raise ValueError(
+                    "memory %s never updated (call update_memory)" % l.pre_mem.name
+                )
+        x_names = [inner.name for _, inner in self.inputs]
+        pre_names = [l.pre_mem.name for l in links]
+        state_names = [l.mem.name for l in links]
+        static_names = [inner.name for _, inner in self.statics]
+        out_names = [o.name for o in self._inner_outputs]
+        bound = x_names + pre_names + static_names
+        ext = [
+            n
+            for n in _sub_block_externals(prog, sub, bound)
+            if parent._find_var_recursive(n) is not None
+        ]
+        last_vars = [
+            parent.create_var(
+                name=unique_name.generate("@".join([self.helper.name, "last"])),
+                dtype=l.init.dtype,
+                shape=l.init.shape,
+            )
+            for l in links
+        ]
+        self.last_states = last_vars
+        inputs = {
+            "X": [outer for outer, _ in self.inputs],
+            "InitState": [l.init for l in links],
+            "Static": [outer for outer, _ in self.statics],
+            "Ext": ext,
+        }
+        if self._seq_len_var is not None:
+            inputs["SeqLen"] = [self._seq_len_var]
+        parent.append_op(
+            "recurrent",
+            inputs=inputs,
+            outputs={"Out": self.outputs, "LastState": last_vars},
+            attrs={
+                "sub_block_idx": sub.idx,
+                "x_names": x_names,
+                "pre_state_names": pre_names,
+                "state_names": state_names,
+                "out_names": out_names,
+                "static_names": static_names,
+                "ext_names": ext,
+                "time_major": self._time_major,
+                "is_reverse": False,
+                "__bound_names__": bound,
+            },
         )
 
+    def __call__(self, *args, **kwargs):
+        if self.status != StaticRNN.AFTER_RNN_BLOCK:
+            raise ValueError("RNN output can only be retrieved after rnn block")
+        if len(self.outputs) == 0:
+            raise ValueError("RNN has no output")
+        if len(self.outputs) == 1:
+            return self.outputs[0]
+        return self.outputs
 
-class DynamicRNN:
+
+class DynamicRNN(StaticRNN):
+    """DynamicRNN (control_flow.py:1542): variable-length recurrence.
+
+    Padded re-expression of the reference's rank-table machinery: step
+    inputs are BATCH-MAJOR padded tensors [batch, T, ...] plus an optional
+    per-sequence length vector (`seq_len` on step_input); finished
+    sequences hold their memory and emit zero outputs (the masking analog
+    of shrink_rnn_memory + lod_tensor_to_array bucketing).
+    """
+
     def __init__(self, name=None):
-        raise NotImplementedError(
-            "DynamicRNN pending — use layers.dynamic_lstm/dynamic_gru (scan ops)"
+        super().__init__(name=name)
+        self.helper = LayerHelper("dynamic_rnn", name=name)
+        self._time_major = False
+
+    def block(self):
+        return _RNNBlockGuard(self)
+
+    def step_input(self, x, level=0, seq_len=None):
+        self._assert_in_rnn_block_("step_input")
+        if not isinstance(x, Variable):
+            raise TypeError("step input takes a Variable")
+        if seq_len is not None:
+            self._seq_len_var = seq_len
+        if self.seq_len is None:
+            self.seq_len = x.shape[1] if len(x.shape) > 1 else None
+        ipt = self.helper.create_variable(
+            name=unique_name.generate("@".join([self.helper.name, "step_in"])),
+            dtype=x.dtype,
+            shape=[x.shape[0]] + list(x.shape[2:]),
         )
+        self.inputs.append((x, ipt))
+        return ipt
+
+    def memory(
+        self,
+        init=None,
+        shape=None,
+        value=0.0,
+        need_reorder=False,
+        dtype="float32",
+        **kwargs
+    ):
+        self._assert_in_rnn_block_("memory")
+        if init is None:
+            if shape is None:
+                raise ValueError("memory needs init or shape")
+            if not self.inputs:
+                raise ValueError(
+                    "call step_input before a shape-initialized memory "
+                    "(it provides the batch reference)"
+                )
+            batch_ref = self.inputs[0][0]
+            parent = self._parent_block()
+            bdim = batch_ref.shape[0] if batch_ref.shape else -1
+            full_shape = [bdim if bdim not in (None,) else -1] + list(shape)
+            boot = parent.create_var(
+                name=unique_name.generate(
+                    "@".join([self.helper.name, "memory_boot"])
+                ),
+                shape=full_shape,
+                dtype=dtype,
+                persistable=False,
+            )
+            parent.append_op(
+                "fill_constant_batch_size_like",
+                inputs={"Input": [batch_ref]},
+                outputs={"Out": [boot]},
+                attrs={
+                    "value": value,
+                    "shape": [1] + [int(d) for d in shape],
+                    "dtype": dtype,
+                    "input_dim_idx": 0,
+                    "output_dim_idx": 0,
+                },
+            )
+            init = boot
+        pre_mem = self.helper.create_variable(
+            name=unique_name.generate("@".join([self.helper.name, "mem"])),
+            dtype=init.dtype,
+            shape=init.shape,
+        )
+        self.memories[pre_mem.name] = _MemoryLink(init=init, pre_mem=pre_mem)
+        return pre_mem
+
+    def step_output(self, o):
+        self._assert_in_rnn_block_("step_output")
+        self._inner_outputs.append(o)
+        out = self._parent_block().create_var(
+            name=unique_name.generate("@".join([self.helper.name, "out"])),
+            dtype=o.dtype,
+            shape=[o.shape[0] if o.shape else -1, self.seq_len]
+            + list(o.shape[1:] if o.shape else []),
+        )
+        self.outputs.append(out)

@@ -10,7 +10,24 @@ import jax.numpy as jnp
 from ..core.registry import register
 from ..layer_helper import LayerHelper
 
-__all__ = ["prior_box", "box_coder", "iou_similarity", "multiclass_nms", "ssd_loss"]
+__all__ = [
+    "prior_box",
+    "box_coder",
+    "iou_similarity",
+    "multiclass_nms",
+    "ssd_loss",
+    "detection_output",
+    "generate_proposals",
+    "rpn_target_assign",
+    "generate_proposal_labels",
+    "bipartite_match",
+    "target_assign",
+    "mine_hard_examples",
+    "anchor_generator",
+    "roi_pool",
+    "roi_align",
+    "roi_perspective_transform",
+]
 
 
 @register("prior_box", no_grad_inputs=("Input", "Image"))
@@ -117,15 +134,452 @@ def iou_similarity(x, y, name=None):
     return out
 
 
-def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size", box_normalized=True, name=None):
-    raise NotImplementedError("box_coder pending")
-
-
-def multiclass_nms(*args, **kwargs):
-    raise NotImplementedError(
-        "multiclass_nms pending a padded-topk TPU design (detection phase)"
+def box_coder(
+    prior_box,
+    prior_box_var,
+    target_box,
+    code_type="encode_center_size",
+    box_normalized=True,
+    name=None,
+):
+    """layers/detection.py box_coder parity (detection/box_coder_op.cc)."""
+    helper = LayerHelper("box_coder", name=name)
+    out = helper.create_variable_for_type_inference(target_box.dtype)
+    inputs = {"PriorBox": [prior_box], "TargetBox": [target_box]}
+    if prior_box_var is not None:
+        inputs["PriorBoxVar"] = [prior_box_var]
+    helper.append_op(
+        "box_coder",
+        inputs=inputs,
+        outputs={"OutputBox": [out]},
+        attrs={"code_type": code_type, "box_normalized": box_normalized},
     )
+    return out
 
 
-def ssd_loss(*args, **kwargs):
-    raise NotImplementedError("ssd_loss pending (detection phase)")
+def multiclass_nms(
+    bboxes,
+    scores,
+    score_threshold=0.01,
+    nms_top_k=400,
+    keep_top_k=200,
+    nms_threshold=0.3,
+    normalized=True,
+    nms_eta=1.0,
+    background_label=0,
+    name=None,
+):
+    """Padded NMS (multiclass_nms_op.cc): returns (out [N, keep_top_k, 6]
+    rows of (label, score, x1, y1, x2, y2) padded with label=-1,
+    rois_num [N]) — the fixed-shape re-expression of the reference's LoD
+    output."""
+    helper = LayerHelper("multiclass_nms", name=name)
+    out = helper.create_variable_for_type_inference(bboxes.dtype)
+    num = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        "multiclass_nms",
+        inputs={"BBoxes": [bboxes], "Scores": [scores]},
+        outputs={"Out": [out], "NmsRoisNum": [num]},
+        attrs={
+            "score_threshold": score_threshold,
+            "nms_top_k": nms_top_k,
+            "keep_top_k": keep_top_k,
+            "nms_threshold": nms_threshold,
+            "background_label": background_label,
+        },
+    )
+    return out, num
+
+
+def detection_output(
+    loc,
+    scores,
+    prior_box,
+    prior_box_var,
+    background_label=0,
+    nms_threshold=0.3,
+    nms_top_k=400,
+    keep_top_k=200,
+    score_threshold=0.01,
+    nms_eta=1.0,
+):
+    """SSD inference head (layers/detection.py detection_output):
+    decode predicted offsets onto priors, then multiclass NMS.
+    loc [N, P, 4], scores [N, P, C] (softmax-ed here), priors [P, 4]."""
+    from . import nn
+
+    decoded = box_coder(
+        prior_box, prior_box_var, loc, code_type="decode_center_size"
+    )
+    sm = nn.softmax(scores)
+    sm = nn.transpose(sm, [0, 2, 1])  # [N, C, P]
+    out, num = multiclass_nms(
+        decoded,
+        sm,
+        score_threshold=score_threshold,
+        nms_top_k=nms_top_k,
+        keep_top_k=keep_top_k,
+        nms_threshold=nms_threshold,
+        background_label=background_label,
+    )
+    return out
+
+
+def ssd_loss(
+    location,
+    confidence,
+    gt_box,
+    gt_label,
+    prior_box,
+    prior_box_var=None,
+    background_label=0,
+    overlap_threshold=0.5,
+    neg_pos_ratio=3.0,
+    neg_overlap=0.5,
+    loc_loss_weight=1.0,
+    conf_loss_weight=1.0,
+    match_type="per_prediction",
+    mining_type="max_negative",
+    normalize=True,
+    sample_size=None,
+    gt_num=None,
+    name=None,
+):
+    """SSD multibox loss (layers/detection.py ssd_loss parity).
+
+    Padded contract: location [N, P, 4], confidence [N, P, C],
+    gt_box [N, G, 4], gt_label [N, G, 1] (zero-padded; pass gt_num [N] for
+    real counts).  Returns per-prior loss [N, P] — the fused dense
+    re-expression of the reference's iou/match/assign/mine/loss pipeline
+    (one XLA kernel; see ops/detection_ops.py:_ssd_loss).
+    """
+    helper = LayerHelper("ssd_loss", name=name)
+    out = helper.create_variable_for_type_inference(location.dtype)
+    inputs = {
+        "Location": [location],
+        "Confidence": [confidence],
+        "GtBox": [gt_box],
+        "GtLabel": [gt_label],
+        "PriorBox": [prior_box],
+    }
+    if prior_box_var is not None:
+        inputs["PriorBoxVar"] = [prior_box_var]
+    if gt_num is not None:
+        inputs["GtNum"] = [gt_num]
+    helper.append_op(
+        "ssd_loss",
+        inputs=inputs,
+        outputs={"Loss": [out]},
+        attrs={
+            "overlap_threshold": overlap_threshold,
+            "neg_pos_ratio": neg_pos_ratio,
+            "background_label": background_label,
+            "loc_loss_weight": loc_loss_weight,
+            "conf_loss_weight": conf_loss_weight,
+            "normalize": normalize,
+        },
+    )
+    return out
+
+
+def generate_proposals(
+    scores,
+    bbox_deltas,
+    im_info,
+    anchors,
+    variances,
+    pre_nms_top_n=6000,
+    post_nms_top_n=1000,
+    nms_thresh=0.5,
+    min_size=0.1,
+    eta=1.0,
+    name=None,
+):
+    """RPN proposals (generate_proposals_op.cc): returns
+    (rois [N, post_nms_top_n, 4], roi_probs [N, post_nms_top_n, 1],
+    rois_num [N]) padded."""
+    helper = LayerHelper("generate_proposals", name=name)
+    rois = helper.create_variable_for_type_inference(scores.dtype)
+    probs = helper.create_variable_for_type_inference(scores.dtype)
+    num = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        "generate_proposals",
+        inputs={
+            "Scores": [scores],
+            "BboxDeltas": [bbox_deltas],
+            "ImInfo": [im_info],
+            "Anchors": [anchors],
+            "Variances": [variances],
+        },
+        outputs={"RpnRois": [rois], "RpnRoiProbs": [probs], "RpnRoisNum": [num]},
+        attrs={
+            "pre_nms_topN": pre_nms_top_n,
+            "post_nms_topN": post_nms_top_n,
+            "nms_thresh": nms_thresh,
+            "min_size": min_size,
+        },
+    )
+    return rois, probs, num
+
+
+def rpn_target_assign(
+    bbox_pred,
+    cls_logits,
+    anchor_box,
+    anchor_var,
+    gt_boxes,
+    is_crowd=None,
+    im_info=None,
+    rpn_batch_size_per_im=256,
+    rpn_straddle_thresh=0.0,
+    rpn_fg_fraction=0.5,
+    rpn_positive_overlap=0.7,
+    rpn_negative_overlap=0.3,
+    use_random=True,
+    gt_num=None,
+    name=None,
+):
+    """RPN target assignment (rpn_target_assign_op.cc).
+
+    Dense re-expression: instead of gathered index lists returns
+    (labels [N, A] with 1/0/-1, bbox_targets [N, A, 4],
+    bbox_inside_weights [N, A, 4]) — mask losses by label>=0 rather than
+    gathering (static shapes).
+    """
+    helper = LayerHelper("rpn_target_assign", name=name)
+    labels = helper.create_variable_for_type_inference("int32")
+    tgts = helper.create_variable_for_type_inference(gt_boxes.dtype)
+    inw = helper.create_variable_for_type_inference("float32")
+    inputs = {"Anchor": [anchor_box], "GtBoxes": [gt_boxes]}
+    if gt_num is not None:
+        inputs["GtNum"] = [gt_num]
+    helper.append_op(
+        "rpn_target_assign",
+        inputs=inputs,
+        outputs={
+            "TargetLabel": [labels],
+            "TargetBBox": [tgts],
+            "BBoxInsideWeight": [inw],
+        },
+        attrs={
+            "rpn_batch_size_per_im": rpn_batch_size_per_im,
+            "rpn_fg_fraction": rpn_fg_fraction,
+            "rpn_positive_overlap": rpn_positive_overlap,
+            "rpn_negative_overlap": rpn_negative_overlap,
+        },
+    )
+    return labels, tgts, inw
+
+
+def generate_proposal_labels(
+    rpn_rois,
+    gt_classes,
+    is_crowd=None,
+    gt_boxes=None,
+    im_info=None,
+    batch_size_per_im=512,
+    fg_fraction=0.25,
+    fg_thresh=0.5,
+    bg_thresh_hi=0.5,
+    bg_thresh_lo=0.0,
+    bbox_reg_weights=[0.1, 0.1, 0.2, 0.2],
+    class_nums=81,
+    use_random=True,
+    rois_num=None,
+    gt_num=None,
+    name=None,
+):
+    """Second-stage RoI sampling (generate_proposal_labels_op.cc) — dense
+    padded contract, see ops/detection_ops.py:_generate_proposal_labels."""
+    helper = LayerHelper("generate_proposal_labels", name=name)
+    rois = helper.create_variable_for_type_inference(rpn_rois.dtype)
+    labels = helper.create_variable_for_type_inference("int32")
+    tgts = helper.create_variable_for_type_inference(rpn_rois.dtype)
+    inw = helper.create_variable_for_type_inference("float32")
+    outw = helper.create_variable_for_type_inference("float32")
+    num = helper.create_variable_for_type_inference("int32")
+    inputs = {
+        "RpnRois": [rpn_rois],
+        "GtClasses": [gt_classes],
+        "GtBoxes": [gt_boxes],
+    }
+    if rois_num is not None:
+        inputs["RpnRoisNum"] = [rois_num]
+    if gt_num is not None:
+        inputs["GtNum"] = [gt_num]
+    helper.append_op(
+        "generate_proposal_labels",
+        inputs=inputs,
+        outputs={
+            "Rois": [rois],
+            "LabelsInt32": [labels],
+            "BboxTargets": [tgts],
+            "BboxInsideWeights": [inw],
+            "BboxOutsideWeights": [outw],
+            "RoisNum": [num],
+        },
+        attrs={
+            "batch_size_per_im": batch_size_per_im,
+            "fg_fraction": fg_fraction,
+            "fg_thresh": fg_thresh,
+            "bg_thresh_hi": bg_thresh_hi,
+            "bg_thresh_lo": bg_thresh_lo,
+            "class_nums": class_nums,
+            "bbox_reg_weights": list(bbox_reg_weights),
+        },
+    )
+    return rois, labels, tgts, inw, outw, num
+
+
+def bipartite_match(dist_matrix, match_type="bipartite", dist_threshold=0.5, name=None):
+    helper = LayerHelper("bipartite_match", name=name)
+    idx = helper.create_variable_for_type_inference("int32")
+    dist = helper.create_variable_for_type_inference(dist_matrix.dtype)
+    helper.append_op(
+        "bipartite_match",
+        inputs={"DistMat": [dist_matrix]},
+        outputs={"ColToRowMatchIndices": [idx], "ColToRowMatchDist": [dist]},
+        attrs={"match_type": match_type, "dist_threshold": dist_threshold},
+    )
+    return idx, dist
+
+
+def target_assign(input, matched_indices, negative_indices=None, mismatch_value=0, name=None):
+    helper = LayerHelper("target_assign", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    w = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        "target_assign",
+        inputs={"X": [input], "MatchIndices": [matched_indices]},
+        outputs={"Out": [out], "OutWeight": [w]},
+        attrs={"mismatch_value": mismatch_value},
+    )
+    return out, w
+
+
+def mine_hard_examples(
+    cls_loss,
+    match_indices,
+    loc_loss=None,
+    match_dist=None,
+    neg_pos_ratio=3.0,
+    neg_dist_threshold=0.5,
+    mining_type="max_negative",
+    name=None,
+):
+    """Dense hard-negative mining: returns (neg_mask [N, P], updated_match
+    [N, P]) — see ops/detection_ops.py:_mine_hard_examples."""
+    helper = LayerHelper("mine_hard_examples", name=name)
+    neg = helper.create_variable_for_type_inference("int32")
+    upd = helper.create_variable_for_type_inference("int32")
+    inputs = {"ClsLoss": [cls_loss], "MatchIndices": [match_indices]}
+    if loc_loss is not None:
+        inputs["LocLoss"] = [loc_loss]
+    if match_dist is not None:
+        inputs["MatchDist"] = [match_dist]
+    helper.append_op(
+        "mine_hard_examples",
+        inputs=inputs,
+        outputs={"NegMask": [neg], "UpdatedMatchIndices": [upd]},
+        attrs={
+            "neg_pos_ratio": neg_pos_ratio,
+            "neg_dist_threshold": neg_dist_threshold,
+        },
+    )
+    return neg, upd
+
+
+def anchor_generator(
+    input,
+    anchor_sizes=[64.0, 128.0, 256.0, 512.0],
+    aspect_ratios=[0.5, 1.0, 2.0],
+    variance=[0.1, 0.1, 0.2, 0.2],
+    stride=[16.0, 16.0],
+    offset=0.5,
+    name=None,
+):
+    helper = LayerHelper("anchor_generator", name=name)
+    anchors = helper.create_variable_for_type_inference("float32")
+    variances = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        "anchor_generator",
+        inputs={"Input": [input]},
+        outputs={"Anchors": [anchors], "Variances": [variances]},
+        attrs={
+            "anchor_sizes": list(anchor_sizes),
+            "aspect_ratios": list(aspect_ratios),
+            "variances": list(variance),
+            "stride": list(stride),
+            "offset": offset,
+        },
+    )
+    return anchors, variances
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1, spatial_scale=1.0, rois_batch=None):
+    helper = LayerHelper("roi_pool")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    argmax = helper.create_variable_for_type_inference("int32")
+    inputs = {"X": [input], "ROIs": [rois]}
+    if rois_batch is not None:
+        inputs["RoisBatch"] = [rois_batch]
+    helper.append_op(
+        "roi_pool",
+        inputs=inputs,
+        outputs={"Out": [out], "Argmax": [argmax]},
+        attrs={
+            "pooled_height": pooled_height,
+            "pooled_width": pooled_width,
+            "spatial_scale": spatial_scale,
+        },
+    )
+    return out
+
+
+def roi_align(
+    input,
+    rois,
+    pooled_height=1,
+    pooled_width=1,
+    spatial_scale=1.0,
+    sampling_ratio=-1,
+    rois_batch=None,
+):
+    helper = LayerHelper("roi_align")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"X": [input], "ROIs": [rois]}
+    if rois_batch is not None:
+        inputs["RoisBatch"] = [rois_batch]
+    helper.append_op(
+        "roi_align",
+        inputs=inputs,
+        outputs={"Out": [out]},
+        attrs={
+            "pooled_height": pooled_height,
+            "pooled_width": pooled_width,
+            "spatial_scale": spatial_scale,
+            "sampling_ratio": sampling_ratio,
+        },
+    )
+    return out
+
+
+def roi_perspective_transform(
+    input, rois, transformed_height, transformed_width, spatial_scale=1.0, rois_batch=None
+):
+    helper = LayerHelper("roi_perspective_transform")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"X": [input], "ROIs": [rois]}
+    if rois_batch is not None:
+        inputs["RoisBatch"] = [rois_batch]
+    helper.append_op(
+        "roi_perspective_transform",
+        inputs=inputs,
+        outputs={"Out": [out]},
+        attrs={
+            "transformed_height": transformed_height,
+            "transformed_width": transformed_width,
+            "spatial_scale": spatial_scale,
+        },
+    )
+    return out

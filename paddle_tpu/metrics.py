@@ -199,6 +199,84 @@ class Auc(MetricBase):
 
 
 class DetectionMAP(MetricBase):
-    def __init__(self, name=None):
+    """Mean average precision for detection (metrics.py DetectionMAP /
+    detection/detection_map_op.cc re-expressed as a host-side accumulator,
+    matching the other MetricBase evaluators).
+
+    update() takes per-image padded arrays:
+      detections: [K, 6] rows (label, score, x1, y1, x2, y2); label<0 = pad
+      gt_boxes:   [G, 4]; gt_labels: [G]; rows past gt_count are padding
+    eval() returns mAP over 11-point interpolated precision ("11point") or
+    the integral AP ("integral").
+    """
+
+    def __init__(self, name=None, overlap_threshold=0.5, ap_version="integral"):
         super().__init__(name)
-        raise NotImplementedError("DetectionMAP pending the detection phase")
+        self.overlap_threshold = overlap_threshold
+        self.ap_version = ap_version
+        self.reset()
+
+    def reset(self, executor=None, reset_program=None):
+        self._dets = {}  # class -> list of (score, tp)
+        self._npos = {}  # class -> #gt boxes
+
+    @staticmethod
+    def _iou(a, b):
+        ax1, ay1, ax2, ay2 = a
+        bx1, by1, bx2, by2 = b
+        iw = min(ax2, bx2) - max(ax1, bx1)
+        ih = min(ay2, by2) - max(ay1, by1)
+        if iw <= 0 or ih <= 0:
+            return 0.0
+        inter = iw * ih
+        ua = (ax2 - ax1) * (ay2 - ay1) + (bx2 - bx1) * (by2 - by1) - inter
+        return inter / ua if ua > 0 else 0.0
+
+    def update(self, detections, gt_boxes, gt_labels, gt_count=None):
+        detections = np.asarray(detections)
+        gt_boxes = np.asarray(gt_boxes)
+        gt_labels = np.asarray(gt_labels).reshape(-1)
+        n_gt = int(gt_count) if gt_count is not None else gt_boxes.shape[0]
+        for g in range(n_gt):
+            c = int(gt_labels[g])
+            self._npos[c] = self._npos.get(c, 0) + 1
+        used = np.zeros(n_gt, bool)
+        dets = detections[detections[:, 0] >= 0]
+        order = np.argsort(-dets[:, 1])
+        for d in dets[order]:
+            c = int(d[0])
+            best, best_g = 0.0, -1
+            for g in range(n_gt):
+                if int(gt_labels[g]) != c or used[g]:
+                    continue
+                ov = self._iou(d[2:6], gt_boxes[g])
+                if ov > best:
+                    best, best_g = ov, g
+            tp = best >= self.overlap_threshold and best_g >= 0
+            if tp:
+                used[best_g] = True
+            self._dets.setdefault(c, []).append((float(d[1]), bool(tp)))
+
+    def eval(self, executor=None, eval_program=None):
+        aps = []
+        for c, npos in self._npos.items():
+            recs = sorted(self._dets.get(c, []), key=lambda t: -t[0])
+            tps = np.cumsum([1.0 if tp else 0.0 for _, tp in recs])
+            fps = np.cumsum([0.0 if tp else 1.0 for _, tp in recs])
+            if len(recs) == 0 or npos == 0:
+                aps.append(0.0)
+                continue
+            rec = tps / npos
+            prec = tps / np.maximum(tps + fps, 1e-12)
+            if self.ap_version == "11point":
+                ap = 0.0
+                for t in np.arange(0.0, 1.01, 0.1):
+                    p = prec[rec >= t].max() if np.any(rec >= t) else 0.0
+                    ap += p / 11.0
+            else:  # integral
+                ap, prev_r = 0.0, 0.0
+                for r, p in zip(rec, prec):
+                    ap += (r - prev_r) * p
+                    prev_r = r
+            aps.append(ap)
+        return float(np.mean(aps)) if aps else 0.0

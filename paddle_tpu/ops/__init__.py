@@ -21,6 +21,7 @@ from . import metric_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
 from . import dist_ops  # noqa: F401
 from . import misc_ops  # noqa: F401
+from . import control_ops  # noqa: F401
 from . import pallas_kernels  # noqa: F401
 
 get_op = registry.get_op

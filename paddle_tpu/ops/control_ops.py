@@ -1,0 +1,350 @@
+"""Control-flow / tensor-array / recurrent op lowerings.
+
+TPU-native re-design of the reference's dynamic-RNN machinery:
+
+- ``recurrent`` — the engine behind StaticRNN/DynamicRNN
+  (operators/recurrent_op.cc + controlflow/while_op.cc:36 + StepScopes).
+  The reference interprets the step sub-block once per timestep in a fresh
+  scope; here the sub-block is traced ONCE and wrapped in ``lax.scan``, so
+  the whole recurrence is a single fused XLA loop and — unlike
+  ``lax.while_loop`` — is reverse-differentiable.  DynamicRNN's ragged
+  semantics (per-sequence lengths) become hold-state/zero-output masking
+  against a ``SeqLen`` vector instead of the reference's rank-table
+  batch-shrinking (lod_rank_table + shrink_rnn_memory), which XLA's static
+  shapes cannot express.
+
+- ``bounded_while`` — a gradient-capable While: a masked ``lax.scan`` over a
+  static trip-count bound, where iterations after the condition goes false
+  become no-ops (carry passthrough).  The unbounded forward-only ``while``
+  lowering (core/trace.py -> lax.while_loop) remains for inference loops.
+
+- tensor arrays (framework.proto LOD_TENSOR_ARRAY,
+  controlflow/tensor_array_read_write_op.cc) — a ``TensorArray`` pytree of
+  (stacked data, length) with static capacity, so arrays can be
+  loop-carried through XLA control flow.
+
+- ``switch`` — first-true-wins case selection (control_flow.py:1286): every
+  case sub-block is traced (they are pure), results merged with
+  ``jnp.where`` chains; the dominant use is piecewise lr schedules.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register
+
+
+# ---------------------------------------------------------------------------
+# TensorArray value (LOD_TENSOR_ARRAY analog): static-capacity stacked store
+# ---------------------------------------------------------------------------
+class TensorArray:
+    """(data [capacity, *elem], length int32) pytree so arrays can be
+    loop-carried through lax.while_loop / lax.scan."""
+
+    def __init__(self, data, length):
+        self.data = data
+        self.length = length
+
+    def tree_flatten(self):
+        return (self.data, self.length), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    def __repr__(self):
+        return "TensorArray(cap=%s, elem=%s)" % (
+            self.data.shape[0], self.data.shape[1:])
+
+
+jax.tree_util.register_pytree_node(
+    TensorArray,
+    lambda a: a.tree_flatten(),
+    TensorArray.tree_unflatten,
+)
+
+_ARRAY_SLOTS = ("Array", "X", "Out")  # slots that may carry TensorArray values
+
+
+def _scalar_i(i):
+    return jnp.reshape(jnp.asarray(i), ()).astype(jnp.int32)
+
+
+@register("write_to_array", no_grad_inputs=("I", "Array"))
+def _write_to_array(ctx, ins, attrs):
+    """tensor_array_read_write_op.cc WriteToArray: out[i] = x.  First write
+    allocates a static-capacity store (attr `capacity`); the reference grows
+    the vector dynamically, which XLA cannot."""
+    x = ins["X"][0]
+    i = _scalar_i(ins["I"][0])
+    arr = ins["Array"][0] if ins.get("Array") else None
+    if arr is None:
+        cap = int(attrs.get("capacity", 128))
+        data = jnp.zeros((cap,) + tuple(x.shape), x.dtype)
+        length = jnp.int32(0)
+    else:
+        data, length = arr.data, arr.length
+    data = jax.lax.dynamic_update_index_in_dim(
+        data, x.astype(data.dtype), i, 0
+    )
+    return {"Out": [TensorArray(data, jnp.maximum(length, i + 1))]}
+
+
+@register("read_from_array", no_grad_inputs=("X", "I"))
+def _read_from_array(ctx, ins, attrs):
+    arr = ins["X"][0]
+    i = _scalar_i(ins["I"][0])
+    out = jax.lax.dynamic_index_in_dim(arr.data, i, 0, keepdims=False)
+    return {"Out": [out]}
+
+
+@register("lod_array_length", no_grad_inputs=("X",))
+def _lod_array_length(ctx, ins, attrs):
+    return {"Out": [jnp.reshape(ins["X"][0].length, (1,)).astype(jnp.int32)]}
+
+
+@register("lod_tensor_to_array", no_grad_inputs=("RankTable",))
+def _lod_tensor_to_array(ctx, ins, attrs):
+    """control_flow.py:825 / lod_tensor_to_array_op.cc: ragged batch ->
+    per-timestep array.  Reference semantics: bucket by rank table (batch
+    shrinks as short sequences end).  Padded re-expression: time-major
+    stack (array[t] = full [B, ...] slice); consumers mask with SeqLen."""
+    x = ins["X"][0]  # [B, T, ...]
+    data = jnp.moveaxis(x, 1, 0)  # [T, B, ...]
+    return {"Out": [TensorArray(data, jnp.int32(x.shape[1]))]}
+
+
+@register("array_to_lod_tensor", no_grad_inputs=("RankTable",))
+def _array_to_lod_tensor(ctx, ins, attrs):
+    arr = ins["X"][0]
+    return {"Out": [jnp.moveaxis(arr.data, 0, 1)]}  # [B, cap, ...]
+
+
+@register("lod_rank_table", no_grad_inputs=("X", "SeqLen"))
+def _lod_rank_table(ctx, ins, attrs):
+    """control_flow.py:741: the rank table's payload on TPU is just the
+    per-sequence length vector (sorting by length is a GPU batch-shrinking
+    trick the padded representation doesn't need)."""
+    if ins.get("SeqLen"):
+        lens = ins["SeqLen"][0]
+    else:
+        x = ins["X"][0]
+        lens = jnp.full((x.shape[0],), x.shape[1], jnp.int32)
+    return {"Out": [lens.astype(jnp.int32)]}
+
+
+@register("max_sequence_len", no_grad_inputs=("RankTable",))
+def _max_sequence_len(ctx, ins, attrs):
+    return {"Out": [jnp.reshape(jnp.max(ins["RankTable"][0]), (1,)).astype(jnp.int32)]}
+
+
+@register("shrink_rnn_memory", no_grad_inputs=("I", "RankTable"))
+def _shrink_rnn_memory(ctx, ins, attrs):
+    """control_flow.py:1111 / shrink_memory_op: the reference drops rows of
+    finished sequences at step I.  Static-shape re-expression: zero-mask
+    those rows (differentiable; downstream ops see zeros instead of absent
+    rows)."""
+    x = ins["X"][0]
+    i = _scalar_i(ins["I"][0])
+    lens = ins["RankTable"][0]
+    active = (i < lens).astype(x.dtype)
+    return {"Out": [x * active.reshape((-1,) + (1,) * (x.ndim - 1))]}
+
+
+@register("rnn_memory_helper")
+def _rnn_memory_helper(ctx, ins, attrs):
+    return {"Out": [ins["X"][0]]}
+
+
+# ---------------------------------------------------------------------------
+# recurrent: the StaticRNN / DynamicRNN engine
+# ---------------------------------------------------------------------------
+def _bcast_mask(mask, ref):
+    """[B] bool -> broadcastable to ref (batch-leading)."""
+    return mask.reshape(mask.shape + (1,) * (ref.ndim - mask.ndim))
+
+
+@register("recurrent", no_grad_inputs=("SeqLen",))
+def _recurrent(ctx, ins, attrs):
+    """One lax.scan over the step sub-block (recurrent_op.cc analog).
+
+    attrs:
+      sub_block_idx     step block
+      x_names           in-block names bound to per-step slices of X
+      pre_state_names   in-block names bound to the carried state
+      state_names       in-block names holding the updated state
+      out_names         in-block names collected per step
+      static_names      in-block aliases of whole (non-sliced) inputs
+      ext_names         outer vars the sub-block reads (weights etc.)
+      time_major        True: X/Out are [T, ...] (StaticRNN layout);
+                        False: [B, T, ...] (DynamicRNN padded layout)
+      is_reverse        scan the sequence right-to-left
+    With SeqLen (DynamicRNN), finished sequences hold their state and emit
+    zero outputs — the masking analog of shrink_rnn_memory.
+    """
+    xs = list(ins.get("X", []))
+    inits = list(ins.get("InitState", []))
+    statics = list(ins.get("Static", []))
+    exts = list(ins.get("Ext", []))
+    seq_len = ins["SeqLen"][0] if ins.get("SeqLen") else None
+    time_major = bool(attrs.get("time_major", True))
+    reverse = bool(attrs.get("is_reverse", False))
+    sub = attrs["sub_block_idx"]
+
+    xs_t = [x if time_major else jnp.moveaxis(x, 0, 1) for x in xs]  # [T,...]
+    if xs_t:
+        T = xs_t[0].shape[0]
+    else:
+        T = int(attrs["max_len"])
+
+    base = {}
+    base.update(zip(attrs.get("ext_names", []), exts))
+    base.update(zip(attrs.get("static_names", []), statics))
+    x_names = list(attrs.get("x_names", []))
+    pre_names = list(attrs.get("pre_state_names", []))
+    state_names = list(attrs.get("state_names", []))
+    out_names = list(attrs.get("out_names", []))
+
+    steps = jnp.arange(T, dtype=jnp.int32)
+    if reverse:
+        steps = steps[::-1]
+        xs_t = [jnp.flip(x, 0) for x in xs_t]
+
+    def body(carry, sl):
+        t, xsl = sl
+        env = dict(base)
+        env.update(zip(x_names, xsl))
+        env.update(zip(pre_names, carry))
+        env = ctx.trace_block(sub, env)
+        new = [env[n] for n in state_names]
+        outs = [env[n] for n in out_names]
+        if seq_len is not None:
+            act = t < seq_len  # [B]
+            new = [
+                jnp.where(_bcast_mask(act, n_), n_, o_)
+                for n_, o_ in zip(new, carry)
+            ]
+            outs = [
+                jnp.where(_bcast_mask(act, o_), o_, jnp.zeros_like(o_))
+                for o_ in outs
+            ]
+        return tuple(new), tuple(outs)
+
+    carry, ys = jax.lax.scan(body, tuple(inits), (steps, tuple(xs_t)))
+    ys = list(ys)
+    if reverse:
+        ys = [jnp.flip(y, 0) for y in ys]
+    outs = [y if time_major else jnp.moveaxis(y, 0, 1) for y in ys]
+    return {"Out": outs, "LastState": list(carry)}
+
+
+# ---------------------------------------------------------------------------
+# bounded_while: gradient-capable loop (masked scan over a static bound)
+# ---------------------------------------------------------------------------
+@register("bounded_while")
+def _bounded_while(ctx, ins, attrs):
+    """while_op.cc:36 with a static trip bound: scan `max_iters` times;
+    once the condition var goes false the carry passes through unchanged.
+    Reverse-differentiable (lax.while_loop is not), at the cost of always
+    running max_iters steps — the classic TPU padding trade."""
+    carried_names = list(attrs["carried_vars"])
+    vals = list(ins["Carried"])
+    base = dict(zip(attrs.get("ext_names", []), ins.get("Ext", [])))
+    cond_idx = carried_names.index(attrs["cond_name"])
+    sub = attrs["sub_block_idx"]
+
+    def body(carry, _):
+        active = jnp.reshape(carry[cond_idx], ()).astype(bool)
+        env = dict(base)
+        env.update(zip(carried_names, carry))
+        env = ctx.trace_block(sub, env)
+        new = [env[n] for n in carried_names]
+        # tree_map so opaque carries (TensorArray pytrees) merge leaf-wise
+        merged = tuple(
+            jax.tree_util.tree_map(
+                lambda a, b: jnp.where(active, a, b), n_, o_
+            )
+            for n_, o_ in zip(new, carry)
+        )
+        return merged, None
+
+    max_iters = int(attrs["max_iters"])
+    out, _ = jax.lax.scan(body, tuple(vals), None, length=max_iters)
+    # surface silent truncation: the loop was supposed to run to cond=False
+    final_cond = jnp.reshape(out[cond_idx], ()).astype(bool)
+    jax.lax.cond(
+        final_cond,
+        lambda: jax.debug.print(
+            "WARNING: bounded_while exhausted max_iters={m} with the "
+            "condition still true — results are mid-loop state",
+            m=max_iters,
+        ),
+        lambda: None,
+    )
+    return {"Out": list(out)}
+
+
+# ---------------------------------------------------------------------------
+# ifelse_select: row-wise branch merge (IfElse re-expression)
+# ---------------------------------------------------------------------------
+@register("ifelse_select", no_grad_inputs=("Cond",))
+def _ifelse_select(ctx, ins, attrs):
+    """Merge per-row branch results: out[b] = cond[b] ? x[b] : y[b].
+    The dense re-expression of IfElse's split/merge (control_flow.py:1412):
+    both branches were computed on the full batch; select is free next to
+    the saved gather/scatter."""
+    c = ins["Cond"][0]
+    x = ins["X"][0]
+    y = ins["Y"][0]
+    c = jnp.reshape(c, (c.shape[0],) + (1,) * (x.ndim - 1)).astype(bool)
+    return {"Out": [jnp.where(c, x, y.astype(x.dtype))]}
+
+
+# ---------------------------------------------------------------------------
+# switch: first-true-wins case merge (piecewise lr schedules etc.)
+# ---------------------------------------------------------------------------
+@register("switch", no_grad_inputs=("Cond",))
+def _switch(ctx, ins, attrs):
+    """control_flow.py:1286: every case sub-block is traced (pure under
+    functionalized scope), then merged last-to-first with jnp.where so the
+    FIRST true condition wins; the default block (or the var's incoming
+    value) supplies the fallthrough."""
+    written = list(attrs["written_names"])
+    conds = list(ins.get("Cond", []))
+    base = dict(zip(attrs.get("ext_names", []), ins.get("Ext", [])))
+    cur = dict(zip(attrs.get("cur_names", []), ins.get("Cur", [])))
+
+    def run_block(bidx):
+        env = dict(base)
+        env.update(cur)
+        env = ctx.trace_block(bidx, env)
+        vals = []
+        for n in written:
+            if n in env:
+                vals.append(env[n])
+            elif n in cur:
+                vals.append(cur[n])
+            else:
+                raise RuntimeError(
+                    "switch: var %s not written by every case and has no "
+                    "prior value" % n
+                )
+        return vals
+
+    default_idx = int(attrs.get("default_block_idx", -1))
+    if default_idx >= 0:
+        vals = run_block(default_idx)
+    else:
+        missing = [n for n in written if n not in cur]
+        if missing:
+            raise RuntimeError(
+                "switch without default: vars %s need a prior value" % missing
+            )
+        vals = [cur[n] for n in written]
+
+    case_blocks = list(attrs["case_blocks"])
+    for ci in range(len(case_blocks) - 1, -1, -1):
+        cvals = run_block(case_blocks[ci])
+        c = jnp.reshape(conds[ci], ()).astype(bool)
+        vals = [jnp.where(c, cv, v) for cv, v in zip(cvals, vals)]
+    return {"Out": vals}

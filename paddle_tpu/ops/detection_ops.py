@@ -349,18 +349,7 @@ def _multiclass_nms(ctx, ins, attrs):
         # box [M, 4], sc [M] -> suppressed score vector [nms_top_k] + index
         top_sc, top_idx = jax.lax.top_k(sc, nms_top_k)
         top_box = box[top_idx]
-        iou = _iou_matrix(top_box, top_box)
-
-        def body(i, keep):
-            # suppress j>i overlapping too much with any kept i
-            cur_keep = keep[i] & (top_sc[i] > score_thresh)
-            over = (iou[i] > nms_thresh) & (jnp.arange(nms_top_k) > i)
-            keep = jnp.where(cur_keep, keep & ~over, keep)
-            return keep
-
-        keep = jnp.ones((nms_top_k,), jnp.bool_)
-        keep = jax.lax.fori_loop(0, nms_top_k, body, keep)
-        keep = keep & (top_sc > score_thresh)
+        keep = _nms_keep(top_box, top_sc, nms_thresh, score_thresh)
         return jnp.where(keep, top_sc, -1.0), top_idx
 
     # single-class heads have no background column to skip
@@ -403,9 +392,460 @@ def _polygon_box_transform(ctx, ins, attrs):
     return {"Output": [jnp.where(x != 0, grid - x, x)]}
 
 
-@register("generate_proposal_labels_placeholder", no_grad_inputs=None)
-def _gpl(ctx, ins, attrs):
-    raise NotImplementedError(
-        "generate_proposal_labels: use the python-side sampler in "
-        "layers/detection.py (host pre-processing, not a TPU kernel)"
+def _encode_center_size(gt, prior, pvar, off=0.0):
+    """Row-wise box encoding: gt [K, 4] against prior [K, 4] (aligned)."""
+    pw = prior[:, 2] - prior[:, 0] + off
+    ph = prior[:, 3] - prior[:, 1] + off
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+    tw = jnp.maximum(gt[:, 2] - gt[:, 0] + off, 1e-6)
+    th = jnp.maximum(gt[:, 3] - gt[:, 1] + off, 1e-6)
+    tcx = gt[:, 0] + tw * 0.5
+    tcy = gt[:, 1] + th * 0.5
+    out = jnp.stack(
+        [
+            (tcx - pcx) / pw,
+            (tcy - pcy) / ph,
+            jnp.log(tw / pw),
+            jnp.log(th / ph),
+        ],
+        axis=1,
     )
+    if pvar is not None:
+        out = out / pvar
+    return out
+
+
+def _decode_center_size(deltas, prior, pvar, off=0.0):
+    """Row-wise decode: deltas [K, 4] applied to prior [K, 4]."""
+    pw = prior[:, 2] - prior[:, 0] + off
+    ph = prior[:, 3] - prior[:, 1] + off
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+    d = deltas * pvar if pvar is not None else deltas
+    dcx = d[:, 0] * pw + pcx
+    dcy = d[:, 1] * ph + pcy
+    dw = jnp.exp(jnp.clip(d[:, 2], -10.0, 10.0)) * pw
+    dh = jnp.exp(jnp.clip(d[:, 3], -10.0, 10.0)) * ph
+    return jnp.stack(
+        [dcx - dw * 0.5, dcy - dh * 0.5, dcx + dw * 0.5 - off, dcy + dh * 0.5 - off],
+        axis=1,
+    )
+
+
+def _nms_keep(boxes, scores, nms_thresh, score_thresh=-jnp.inf):
+    """Dense greedy NMS on score-sorted boxes: returns keep mask [K]."""
+    k = boxes.shape[0]
+    iou = _iou_matrix(boxes, boxes)
+
+    def body(i, keep):
+        cur = keep[i] & (scores[i] > score_thresh)
+        over = (iou[i] > nms_thresh) & (jnp.arange(k) > i)
+        return jnp.where(cur, keep & ~over, keep)
+
+    keep = jax.lax.fori_loop(0, k, body, jnp.ones((k,), jnp.bool_))
+    return keep & (scores > score_thresh)
+
+
+@register("generate_proposals", no_grad_inputs=("Scores", "BboxDeltas", "ImInfo", "Anchors", "Variances"))
+def _generate_proposals(ctx, ins, attrs):
+    """RPN proposal generation (detection/generate_proposals_op.cc).
+
+    Padded contract: Scores [N, A, H, W], BboxDeltas [N, 4A, H, W],
+    Anchors [H, W, A, 4], ImInfo [N, 3] (h, w, scale).  Output
+    RpnRois [N, post_nms_topN, 4] + RpnRoiProbs + RpnRoisNum — fixed shapes
+    (the reference emits LoD var-count rois), invalid rows zeroed.
+    """
+    scores = ins["Scores"][0]
+    deltas = ins["BboxDeltas"][0]
+    im_info = ins["ImInfo"][0]
+    anchors = ins["Anchors"][0].reshape(-1, 4)
+    variances = (
+        ins["Variances"][0].reshape(-1, 4) if ins.get("Variances") else None
+    )
+    pre_n = int(attrs.get("pre_nms_topN", 6000))
+    post_n = int(attrs.get("post_nms_topN", 1000))
+    nms_thresh = float(attrs.get("nms_thresh", 0.7))
+    min_size = float(attrs.get("min_size", 0.1))
+    n, a, h, w = scores.shape
+    total = a * h * w
+    pre_n = min(pre_n, total)
+    post_n = min(post_n, pre_n)
+
+    def per_image(sc, dl, info):
+        sc = jnp.transpose(sc, (1, 2, 0)).reshape(-1)  # [H*W*A]
+        dl = jnp.transpose(dl.reshape(a, 4, h, w), (2, 3, 0, 1)).reshape(-1, 4)
+        # anchors [H, W, A, 4] were flattened to the same H*W*A row order
+        boxes = _decode_center_size(dl, anchors, variances)
+        ih, iw = info[0], info[1]
+        boxes = jnp.stack(
+            [
+                jnp.clip(boxes[:, 0], 0, iw - 1),
+                jnp.clip(boxes[:, 1], 0, ih - 1),
+                jnp.clip(boxes[:, 2], 0, iw - 1),
+                jnp.clip(boxes[:, 3], 0, ih - 1),
+            ],
+            axis=1,
+        )
+        ws = boxes[:, 2] - boxes[:, 0] + 1
+        hs = boxes[:, 3] - boxes[:, 1] + 1
+        ms = min_size * info[2]
+        valid = (ws >= ms) & (hs >= ms)
+        sc = jnp.where(valid, sc, -jnp.inf)
+        top_sc, top_idx = jax.lax.top_k(sc, pre_n)
+        top_box = boxes[top_idx]
+        keep = _nms_keep(top_box, top_sc, nms_thresh)
+        kept_sc = jnp.where(keep, top_sc, -jnp.inf)
+        fin_sc, fin_pos = jax.lax.top_k(kept_sc, post_n)
+        fin_box = top_box[fin_pos]
+        ok = jnp.isfinite(fin_sc)
+        fin_box = jnp.where(ok[:, None], fin_box, 0.0)
+        fin_sc = jnp.where(ok, fin_sc, 0.0)
+        return fin_box, fin_sc[:, None], jnp.sum(ok.astype(jnp.int32))
+
+    rois, probs, counts = jax.vmap(per_image)(scores, deltas, im_info)
+    return {"RpnRois": [rois], "RpnRoiProbs": [probs], "RpnRoisNum": [counts]}
+
+
+@register(
+    "rpn_target_assign",
+    no_grad_inputs=("Anchor", "GtBoxes", "IsCrowd", "ImInfo", "GtNum"),
+    needs_rng=True,
+)
+def _rpn_target_assign(ctx, ins, attrs):
+    """RPN anchor labeling + sampling (detection/rpn_target_assign_op.cc).
+
+    Dense re-expression: instead of the reference's gathered index lists
+    (dynamic length), emits per-anchor labels [N, A] (1 fg / 0 bg / -1
+    ignore, subsampled to rpn_batch_size_per_im with fg_fraction),
+    regression targets [N, A, 4] and inside weights [N, A, 4] — consumers
+    mask by label instead of gathering.
+    """
+    anchors = ins["Anchor"][0].reshape(-1, 4)  # [A, 4]
+    gts = ins["GtBoxes"][0]  # [N, G, 4] padded
+    gt_num = (
+        ins["GtNum"][0].reshape(-1).astype(jnp.int32)
+        if ins.get("GtNum")
+        else jnp.full((gts.shape[0],), gts.shape[1], jnp.int32)
+    )
+    batch = int(attrs.get("rpn_batch_size_per_im", 256))
+    fg_frac = float(attrs.get("rpn_fg_fraction", 0.5))
+    pos_ov = float(attrs.get("rpn_positive_overlap", 0.7))
+    neg_ov = float(attrs.get("rpn_negative_overlap", 0.3))
+    a = anchors.shape[0]
+    g = gts.shape[1]
+    key = ctx.rng(attrs)
+
+    def per_image(gt, cnt, k):
+        valid = jnp.arange(g) < cnt
+        iou = _iou_matrix(gt, anchors)  # [G, A]
+        iou = jnp.where(valid[:, None], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=0)  # per anchor
+        best_iou = jnp.max(iou, axis=0)
+        label = jnp.full((a,), -1, jnp.int32)
+        label = jnp.where(best_iou >= pos_ov, 1, label)
+        label = jnp.where((best_iou < neg_ov) & (best_iou >= 0), 0, label)
+        # force: the best anchor per valid gt is fg (tie contract of the
+        # reference's "anchor with highest overlap for each gt"); padded gt
+        # rows scatter out of range so they cannot clobber anchor 0
+        best_a_per_g = jnp.argmax(iou, axis=1)  # [G]
+        force_idx = jnp.where(valid, best_a_per_g, a)
+        force = jnp.zeros((a,), jnp.bool_).at[force_idx].set(True, mode="drop")
+        label = jnp.where(force, 1, label)
+        # subsample: random keep of at most fg_cap fg / rest bg
+        fg_cap = int(batch * fg_frac)
+        r = jax.random.uniform(k, (a,))
+        fg = label == 1
+        fg_rank = jnp.argsort(jnp.argsort(jnp.where(fg, r, 2.0)))
+        label = jnp.where(fg & (fg_rank >= fg_cap), -1, label)
+        n_fg = jnp.minimum(jnp.sum(fg), fg_cap)
+        bg_cap = batch - n_fg
+        bg = label == 0
+        bg_rank = jnp.argsort(jnp.argsort(jnp.where(bg, r, 2.0)))
+        label = jnp.where(bg & (bg_rank >= bg_cap), -1, label)
+        tgt = _encode_center_size(gt[best_gt], anchors, None)
+        tgt = jnp.where((label == 1)[:, None], tgt, 0.0)
+        inw = jnp.where((label == 1)[:, None], 1.0, 0.0)
+        return label, tgt, inw
+
+    keys = jax.random.split(key, gts.shape[0])
+    labels, tgts, inws = jax.vmap(per_image)(gts, gt_num, keys)
+    return {
+        "TargetLabel": [labels],
+        "TargetBBox": [tgts],
+        "BBoxInsideWeight": [inws.astype(jnp.float32)],
+    }
+
+
+@register(
+    "generate_proposal_labels",
+    no_grad_inputs=("RpnRois", "GtClasses", "IsCrowd", "GtBoxes", "ImInfo", "RpnRoisNum", "GtNum"),
+    needs_rng=True,
+)
+def _generate_proposal_labels(ctx, ins, attrs):
+    """Second-stage RoI sampling (detection/generate_proposal_labels_op.cc).
+
+    Dense padded contract: RpnRois [N, R, 4], GtBoxes [N, G, 4],
+    GtClasses [N, G]; outputs Rois [N, B, 4], LabelsInt32 [N, B],
+    BboxTargets [N, B, 4C], BboxInsideWeights / BboxOutsideWeights
+    [N, B, 4C] with B = batch_size_per_im (fg sampled to fg_fraction,
+    padding rows labeled -1).
+    """
+    rois = ins["RpnRois"][0]
+    gts = ins["GtBoxes"][0]
+    gtc = ins["GtClasses"][0]
+    gt_num = (
+        ins["GtNum"][0].reshape(-1).astype(jnp.int32)
+        if ins.get("GtNum")
+        else jnp.full((gts.shape[0],), gts.shape[1], jnp.int32)
+    )
+    roi_num = (
+        ins["RpnRoisNum"][0].reshape(-1).astype(jnp.int32)
+        if ins.get("RpnRoisNum")
+        else jnp.full((rois.shape[0],), rois.shape[1], jnp.int32)
+    )
+    bs = int(attrs.get("batch_size_per_im", 512))
+    fg_frac = float(attrs.get("fg_fraction", 0.25))
+    fg_thresh = float(attrs.get("fg_thresh", 0.5))
+    bg_hi = float(attrs.get("bg_thresh_hi", 0.5))
+    bg_lo = float(attrs.get("bg_thresh_lo", 0.0))
+    n_cls = int(attrs.get("class_nums", 81))
+    reg_w = jnp.asarray(
+        attrs.get("bbox_reg_weights", [0.1, 0.1, 0.2, 0.2]), jnp.float32
+    )[None, :]
+    g = gts.shape[1]
+    r_in = rois.shape[1]
+    key = ctx.rng(attrs)
+
+    def per_image(roi, rn, gt, gl, cnt, k):
+        # append gt boxes to the roi set (reference behavior)
+        allr = jnp.concatenate([roi, gt], axis=0)  # [R+G, 4]
+        roi_valid = jnp.concatenate(
+            [jnp.arange(r_in) < rn, jnp.arange(g) < cnt]
+        )
+        iou = _iou_matrix(gt, allr)  # [G, R+G]
+        iou = jnp.where((jnp.arange(g) < cnt)[:, None], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=0)
+        best_iou = jnp.max(iou, axis=0)
+        best_iou = jnp.where(roi_valid, best_iou, -1.0)
+        fg = best_iou >= fg_thresh
+        bg = (best_iou < bg_hi) & (best_iou >= bg_lo)
+        fg_cap = int(bs * fg_frac)
+        r = jax.random.uniform(k, (allr.shape[0],))
+        fg_rank = jnp.argsort(jnp.argsort(jnp.where(fg, r, 2.0)))
+        fg_sel = fg & (fg_rank < fg_cap)
+        n_fg = jnp.sum(fg_sel)
+        bg_cap = bs - n_fg
+        bg_rank = jnp.argsort(jnp.argsort(jnp.where(bg, r, 2.0)))
+        bg_sel = bg & (bg_rank < bg_cap)
+        sel = fg_sel | bg_sel
+        # stable gather of selected rows into the fixed bs-slot output
+        order = jnp.argsort(jnp.argsort(jnp.where(sel, r, 2.0)))
+        slot = jnp.where(sel, order, bs + 1)
+        out_roi = jnp.zeros((bs, 4), roi.dtype)
+        out_lab = jnp.full((bs,), -1, jnp.int32)
+        out_tgt = jnp.zeros((bs, 4), roi.dtype)
+        src_gt = gt[best_gt]
+        # regression targets divided by bbox_reg_weights (reference
+        # bbox_util BoxToDelta weights semantics)
+        enc = _encode_center_size(src_gt, allr, reg_w)
+        labs = jnp.where(
+            fg_sel, gl.reshape(-1)[best_gt].astype(jnp.int32), 0
+        )
+        # unselected rows carry slot bs+1 and fall off via mode="drop"
+        out_roi = out_roi.at[slot].set(allr, mode="drop")
+        out_lab = out_lab.at[slot].set(labs, mode="drop")
+        out_tgt = out_tgt.at[slot].set(
+            jnp.where(fg_sel[:, None], enc, 0.0), mode="drop"
+        )
+        # expand targets to per-class layout [B, 4*n_cls]
+        lab_idx = jnp.clip(out_lab, 0, n_cls - 1)
+        tgt_full = jnp.zeros((bs, n_cls, 4), roi.dtype)
+        tgt_full = tgt_full.at[jnp.arange(bs), lab_idx].set(out_tgt)
+        w_full = jnp.zeros((bs, n_cls, 4), jnp.float32)
+        w_full = w_full.at[jnp.arange(bs), lab_idx].set(
+            jnp.where((out_lab > 0)[:, None], 1.0, 0.0)
+        )
+        return (
+            out_roi,
+            out_lab,
+            tgt_full.reshape(bs, -1),
+            w_full.reshape(bs, -1),
+            jnp.sum(sel.astype(jnp.int32)),
+        )
+
+    keys = jax.random.split(key, rois.shape[0])
+    o_roi, o_lab, o_tgt, o_w, o_cnt = jax.vmap(per_image)(
+        rois, roi_num, gts, gtc, gt_num, keys
+    )
+    return {
+        "Rois": [o_roi],
+        "LabelsInt32": [o_lab],
+        "BboxTargets": [o_tgt],
+        "BboxInsideWeights": [o_w],
+        "BboxOutsideWeights": [o_w],
+        "RoisNum": [o_cnt],
+    }
+
+
+@register("mine_hard_examples", no_grad_inputs=("ClsLoss", "LocLoss", "MatchIndices", "MatchDist"))
+def _mine_hard_examples(ctx, ins, attrs):
+    """Hard-negative mining (detection/mine_hard_examples_op.cc).
+
+    Dense contract: ClsLoss [N, P], MatchIndices [N, P] (-1 = unmatched);
+    emits NegMask [N, P] (1 = selected hard negative, at most
+    neg_pos_ratio * num_pos per image, highest loss first) and
+    UpdatedMatchIndices (unselected negatives forced to -1 — parity with
+    the reference's output).
+    """
+    loss = ins["ClsLoss"][0]
+    if ins.get("LocLoss"):
+        loss = loss + ins["LocLoss"][0]
+    match = ins["MatchIndices"][0].astype(jnp.int32)
+    ratio = float(attrs.get("neg_pos_ratio", 3.0))
+    neg_dist = float(attrs.get("neg_dist_threshold", 0.5))
+    mdist = ins["MatchDist"][0] if ins.get("MatchDist") else None
+    p = match.shape[1]
+
+    def per_image(l, m, d):
+        pos = m >= 0
+        neg_cand = ~pos
+        if d is not None:
+            neg_cand = neg_cand & (d < neg_dist)
+        n_neg = jnp.minimum(
+            (ratio * jnp.sum(pos)).astype(jnp.int32), jnp.sum(neg_cand)
+        )
+        nl = jnp.where(neg_cand, l, -jnp.inf)
+        rank = jnp.argsort(jnp.argsort(-nl))
+        neg_sel = neg_cand & (rank < n_neg)
+        return neg_sel.astype(jnp.int32), jnp.where(pos | neg_sel, m, -1)
+
+    if mdist is not None:
+        neg, upd = jax.vmap(per_image)(loss, match, mdist)
+    else:
+        neg, upd = jax.vmap(lambda l, m: per_image(l, m, None))(loss, match)
+    return {"NegMask": [neg], "UpdatedMatchIndices": [upd]}
+
+
+@register("ssd_loss", no_grad_inputs=("GtBox", "GtLabel", "PriorBox", "PriorBoxVar", "GtNum"))
+def _ssd_loss(ctx, ins, attrs):
+    """Fused SSD multibox loss (layers/detection.py ssd_loss composition:
+    iou_similarity -> match -> target_assign -> mine_hard_examples ->
+    smooth_l1 + softmax CE).  One dense per-image kernel under vmap — the
+    TPU re-expression of the reference's 7-op LoD pipeline; differentiable
+    w.r.t. Location/Confidence (mining mask is stop-gradient).
+
+    Inputs: Location [N, P, 4], Confidence [N, P, C], GtBox [N, G, 4],
+    GtLabel [N, G, 1], PriorBox [P, 4], PriorBoxVar [P, 4], GtNum [N].
+    Output: Loss [N, P] per-prior weighted loss.
+    """
+    loc = ins["Location"][0]
+    conf = ins["Confidence"][0]
+    gts = ins["GtBox"][0]
+    gtl = ins["GtLabel"][0]
+    prior = ins["PriorBox"][0]
+    pvar = ins["PriorBoxVar"][0] if ins.get("PriorBoxVar") else None
+    gt_num = (
+        ins["GtNum"][0].reshape(-1).astype(jnp.int32)
+        if ins.get("GtNum")
+        else jnp.full((gts.shape[0],), gts.shape[1], jnp.int32)
+    )
+    ov_thresh = float(attrs.get("overlap_threshold", 0.5))
+    neg_ratio = float(attrs.get("neg_pos_ratio", 3.0))
+    bg_label = int(attrs.get("background_label", 0))
+    loc_w = float(attrs.get("loc_loss_weight", 1.0))
+    conf_w = float(attrs.get("conf_loss_weight", 1.0))
+    normalize = bool(attrs.get("normalize", True))
+    g = gts.shape[1]
+
+    def per_image(lc, cf, gt, gl, cnt):
+        valid = jnp.arange(g) < cnt
+        iou = _iou_matrix(gt, prior)  # [G, P]
+        iou = jnp.where(valid[:, None], iou, -1.0)
+        best_g = jnp.argmax(iou, axis=0)
+        best_v = jnp.max(iou, axis=0)
+        match = jnp.where(best_v >= ov_thresh, best_g.astype(jnp.int32), -1)
+        # force-match the best prior of every valid gt (bipartite step);
+        # padded gt rows scatter out of range instead of writing stale
+        # values at prior 0
+        best_p = jnp.argmax(iou, axis=1)  # [G]
+        p_total = match.shape[0]
+        force_idx = jnp.where(valid, best_p, p_total)
+        match = match.at[force_idx].set(
+            jnp.arange(g, dtype=jnp.int32), mode="drop"
+        )
+        fg = match >= 0
+        num_pos = jnp.sum(fg)
+        tgt_lab = jnp.where(fg, gl.reshape(-1)[jnp.maximum(match, 0)].astype(jnp.int32), bg_label)
+        logp = jax.nn.log_softmax(cf, axis=-1)
+        ce = -jnp.take_along_axis(logp, tgt_lab[:, None], axis=-1)[:, 0]
+        # hard-negative mining on the CE values (selection is constant)
+        ce_const = jax.lax.stop_gradient(ce)
+        n_neg = jnp.minimum(
+            (neg_ratio * num_pos).astype(jnp.int32), jnp.sum(~fg)
+        )
+        nl = jnp.where(~fg, ce_const, -jnp.inf)
+        rank = jnp.argsort(jnp.argsort(-nl))
+        neg_sel = (~fg) & (rank < n_neg)
+        conf_weight = fg | neg_sel
+        enc = _encode_center_size(gt[jnp.maximum(match, 0)], prior, pvar)
+        diff = lc - enc
+        ad = jnp.abs(diff)
+        sl1 = jnp.sum(jnp.where(ad < 1.0, 0.5 * diff * diff, ad - 0.5), axis=1)
+        loss = loc_w * sl1 * fg + conf_w * ce * conf_weight
+        if normalize:
+            loss = loss / jnp.maximum(num_pos.astype(loss.dtype), 1.0)
+        return loss
+
+    out = jax.vmap(per_image)(loc, conf, gts, gtl, gt_num)
+    return {"Loss": [out]}
+
+
+@register("roi_perspective_transform", no_grad_inputs=("ROIs",))
+def _roi_perspective_transform(ctx, ins, attrs):
+    """Perspective-warp quadrilateral RoIs to a fixed grid
+    (detection/roi_perspective_transform_op.cc): ROIs [R, 8] = 4 corners
+    (x1 y1 ... x4 y4, clockwise from top-left), bilinear sampling."""
+    x = ins["X"][0]  # [N, C, H, W]
+    rois = ins["ROIs"][0]
+    batch_idx = (
+        ins["RoisBatch"][0].reshape(-1).astype(jnp.int32)
+        if ins.get("RoisBatch")
+        else jnp.zeros((rois.shape[0],), jnp.int32)
+    )
+    th = int(attrs.get("transformed_height", 8))
+    tw = int(attrs.get("transformed_width", 8))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    n, c, h, w = x.shape
+
+    def warp_one(quad, bi):
+        q = quad.reshape(4, 2) * scale  # tl, tr, br, bl
+        # bilinear interpolation of the quad surface (projective-lite:
+        # exact for parallelograms, close for mild perspective)
+        u = (jnp.arange(tw) + 0.5) / tw
+        v = (jnp.arange(th) + 0.5) / th
+        uu, vv = jnp.meshgrid(u, v)  # [th, tw]
+        top = q[0][None, None, :] * (1 - uu[..., None]) + q[1][None, None, :] * uu[..., None]
+        bot = q[3][None, None, :] * (1 - uu[..., None]) + q[2][None, None, :] * uu[..., None]
+        pts = top * (1 - vv[..., None]) + bot * vv[..., None]  # [th, tw, 2]
+        px, py = pts[..., 0], pts[..., 1]
+        x0 = jnp.floor(px)
+        y0 = jnp.floor(py)
+        wx = px - x0
+        wy = py - y0
+        img = x[bi]
+
+        def g(yy, xx):
+            yc = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+            xc = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+            return img[:, yc, xc]  # [C, th, tw]
+
+        return (
+            g(y0, x0) * (1 - wy) * (1 - wx)
+            + g(y0, x0 + 1) * (1 - wy) * wx
+            + g(y0 + 1, x0) * wy * (1 - wx)
+            + g(y0 + 1, x0 + 1) * wy * wx
+        )
+
+    out = jax.vmap(warp_one)(rois, batch_idx)  # [R, C, th, tw]
+    return {"Out": [out]}

@@ -356,7 +356,9 @@ def _where_index(ctx, ins, attrs):
 
 @register("increment")
 def _increment(ctx, ins, attrs):
-    return {"Out": [ins["X"][0] + attrs.get("step", 1.0)]}
+    x = ins["X"][0]
+    # increment_op.cc keeps the input dtype (int step counters stay int)
+    return {"Out": [x + jnp.asarray(attrs.get("step", 1.0)).astype(x.dtype)]}
 
 
 @register("print", no_grad_inputs=("In",))

@@ -57,3 +57,280 @@ def test_numpy_scalar_operand():
     exe = fluid.Executor(fluid.CPUPlace())
     (r,) = exe.run(feed={"x": np.ones(3, "float32")}, fetch_list=[y])
     np.testing.assert_allclose(r, [3.0, 3.0, 3.0])
+
+
+def test_static_rnn_matches_numpy_and_numeric_grad():
+    """StaticRNN (time-major) == numpy scan; W grad == finite differences."""
+    T, B, D, H = 4, 3, 5, 6
+    rng = np.random.RandomState(0)
+    xv = rng.randn(T, B, D).astype("float32")
+    x = layers.data("x", shape=[T, B, D], append_batch_size=False, stop_gradient=False)
+    rnn = layers.StaticRNN()
+    with rnn.step():
+        xt = rnn.step_input(x)
+        h_prev = rnn.memory(shape=[H], batch_ref=xt, init_value=0.0)
+        h = layers.fc(xt, H, bias_attr=False, param_attr=fluid.ParamAttr(name="srnn_W"))
+        h2 = layers.fc(h_prev, H, bias_attr=False, param_attr=fluid.ParamAttr(name="srnn_U"))
+        hn = layers.tanh(layers.elementwise_add(h, h2))
+        rnn.update_memory(h_prev, hn)
+        rnn.output(hn)
+    out = rnn()
+    loss = layers.mean(out)
+    pg = fluid.backward.append_backward(loss)
+    gnames = {p.name: g.name for p, g in pg}
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    scope = fluid.global_scope()
+    res = exe.run(feed={"x": xv}, fetch_list=[out, gnames["srnn_W"]])
+    W = np.array(scope.find_var("srnn_W"))
+    U = np.array(scope.find_var("srnn_U"))
+    h = np.zeros((B, H), "float32")
+    outs = []
+    for t in range(T):
+        h = np.tanh(xv[t] @ W + h @ U)
+        outs.append(h)
+    np.testing.assert_allclose(res[0], np.stack(outs, 0), rtol=1e-5, atol=1e-5)
+
+    def lossf(Wv):
+        hh = np.zeros((B, H))
+        acc = []
+        for t in range(T):
+            hh = np.tanh(xv[t] @ Wv + hh @ U)
+            acc.append(hh)
+        return np.mean(np.stack(acc))
+
+    eps, gW = 1e-3, res[1]
+    for i in range(2):
+        for j in range(2):
+            Wp, Wm = W.copy(), W.copy()
+            Wp[i, j] += eps
+            Wm[i, j] -= eps
+            num = (lossf(Wp) - lossf(Wm)) / (2 * eps)
+            assert abs(gW[i, j] - num) < 1e-3, (i, j, gW[i, j], num)
+
+
+def test_dynamic_rnn_seq_len_masking_and_grads():
+    """DynamicRNN (batch-major padded) with ragged lengths == masked numpy
+    scan; gradients flow to in-loop parameters."""
+    B, T, D, H = 3, 5, 4, 6
+    rng = np.random.RandomState(1)
+    xv = rng.randn(B, T, D).astype("float32")
+    lens = np.array([5, 3, 2], "int32")
+    x = layers.data("x", shape=[B, T, D], append_batch_size=False, stop_gradient=False)
+    sl = layers.data("sl", shape=[B], append_batch_size=False, dtype="int32")
+    drnn = layers.DynamicRNN()
+    with drnn.block():
+        xt = drnn.step_input(x, seq_len=sl)
+        mem = drnn.memory(shape=[H], value=0.0)
+        h = layers.fc(xt, H, bias_attr=False, param_attr=fluid.ParamAttr(name="drnn_W"))
+        h2 = layers.fc(mem, H, bias_attr=False, param_attr=fluid.ParamAttr(name="drnn_U"))
+        hn = layers.tanh(layers.elementwise_add(h, h2))
+        drnn.update_memory(mem, hn)
+        drnn.output(hn)
+    out = drnn()
+    loss = layers.mean(out)
+    pg = fluid.backward.append_backward(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    scope = fluid.global_scope()
+    res = exe.run(feed={"x": xv, "sl": lens}, fetch_list=[out, pg[0][1].name])
+    W = np.array(scope.find_var("drnn_W"))
+    U = np.array(scope.find_var("drnn_U"))
+    ref = np.zeros((B, T, H), "float32")
+    h = np.zeros((B, H), "float32")
+    for t in range(T):
+        hn = np.tanh(xv[:, t] @ W + h @ U)
+        act = (t < lens)[:, None]
+        h = np.where(act, hn, h)
+        ref[:, t] = np.where(act, hn, 0.0)
+    np.testing.assert_allclose(res[0], ref, rtol=1e-4, atol=1e-5)
+    assert np.abs(res[1]).sum() > 0
+
+
+def test_dynamic_rnn_gru_matches_padded_gru_op():
+    """A DynamicRNN stepping gru_unit == the fused padded_gru scan op —
+    the VERDICT round-1 acceptance check (padded-scan parity within 1e-4)."""
+    B, T, H = 2, 4, 3
+    rng = np.random.RandomState(2)
+    xv = rng.randn(B, T, 3 * H).astype("float32")
+    wv = rng.randn(H, 3 * H).astype("float32")
+    x = layers.data("x", shape=[B, T, 3 * H], append_batch_size=False)
+    w = layers.data("w", shape=[H, 3 * H], append_batch_size=False)
+
+    drnn = layers.DynamicRNN()
+    with drnn.block():
+        xt = drnn.step_input(x)
+        mem = drnn.memory(shape=[H], value=0.0)
+        helper = fluid.layer_helper.LayerHelper("gru_step")
+        hidden = helper.create_variable_for_type_inference("float32")
+        gate = helper.create_variable_for_type_inference("float32")
+        rhp = helper.create_variable_for_type_inference("float32")
+        helper.append_op(
+            "gru_unit",
+            inputs={"Input": [xt], "HiddenPrev": [mem], "Weight": [w]},
+            outputs={"Gate": [gate], "ResetHiddenPrev": [rhp], "Hidden": [hidden]},
+        )
+        drnn.update_memory(mem, hidden)
+        drnn.output(hidden)
+    out = drnn()
+
+    helper = fluid.layer_helper.LayerHelper("padded_gru_ref")
+    ref_h = helper.create_variable_for_type_inference("float32")
+    ref_last = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        "padded_gru",
+        inputs={"Input": [x], "Weight": [w]},
+        outputs={"Hidden": [ref_h], "LastH": [ref_last]},
+    )
+    exe = fluid.Executor(fluid.CPUPlace())
+    r = exe.run(feed={"x": xv, "w": wv}, fetch_list=[out, ref_h])
+    np.testing.assert_allclose(r[0], r[1], rtol=1e-4, atol=1e-5)
+
+
+def test_bounded_while_gradient():
+    """While(max_iters=N) lowers to a masked scan and is differentiable:
+    acc doubles 4 times -> d(sum)/dx = 16 (unbounded While raises)."""
+    x = layers.data("x", shape=[3], append_batch_size=False, stop_gradient=False)
+    acc = layers.assign(x)
+    i = layers.fill_constant([1], "float32", 0.0)
+    n = layers.fill_constant([1], "float32", 4.0)
+    cond = layers.less_than(i, n)
+    w = layers.While(cond, max_iters=8)
+    with w.block():
+        layers.assign(layers.scale(acc, 2.0), acc)
+        layers.increment(i, 1.0)
+        layers.less_than(i, n, cond=cond)
+    s = layers.reduce_sum(acc)
+    (gx,) = fluid.backward.calc_gradient(s, x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.array([1.0, 2.0, 3.0], "float32")
+    r = exe.run(feed={"x": xv}, fetch_list=[acc, gx])
+    np.testing.assert_allclose(r[0], xv * 16, rtol=1e-6)
+    np.testing.assert_allclose(r[1], np.full(3, 16.0), rtol=1e-6)
+
+
+def test_unbounded_while_grad_raises():
+    x = layers.data("x", shape=[3], append_batch_size=False, stop_gradient=False)
+    acc = layers.assign(x)
+    i = layers.fill_constant([1], "float32", 0.0)
+    n = layers.fill_constant([1], "float32", 4.0)
+    cond = layers.less_than(i, n)
+    w = layers.While(cond)
+    with w.block():
+        layers.assign(layers.scale(acc, 2.0), acc)
+        layers.increment(i, 1.0)
+        layers.less_than(i, n, cond=cond)
+    s = layers.reduce_sum(acc)
+    import pytest
+
+    with pytest.raises(RuntimeError, match="max_iters"):
+        fluid.backward.calc_gradient(s, x)
+
+
+def test_tensor_array_write_read_in_while():
+    i = layers.fill_constant([1], "int32", 0)
+    n = layers.fill_constant([1], "int32", 5)
+    x0 = layers.fill_constant([2], "float32", 1.0)
+    arr = layers.array_write(x0, i, capacity=8)
+    cond = layers.less_than(i, n)
+    w = layers.While(cond)
+    with w.block():
+        v = layers.array_read(arr, i)
+        layers.increment(i, 1.0)
+        layers.array_write(layers.scale(v, 2.0), i, array=arr)
+        layers.less_than(i, n, cond=cond)
+    ln = layers.array_length(arr)
+    last = layers.array_read(arr, layers.fill_constant([1], "int32", 5))
+    exe = fluid.Executor(fluid.CPUPlace())
+    r = exe.run(fetch_list=[ln, last])
+    assert int(r[0][0]) == 6
+    np.testing.assert_allclose(r[1], [32.0, 32.0])
+
+
+def test_lod_tensor_to_array_roundtrip():
+    B, T, D = 2, 3, 4
+    xv = np.random.RandomState(3).randn(B, T, D).astype("float32")
+    x = layers.data("x", shape=[B, T, D], append_batch_size=False)
+    arr = layers.lod_tensor_to_array(x)
+    step1 = layers.array_read(arr, layers.fill_constant([1], "int32", 1))
+    back = layers.array_to_lod_tensor(arr)
+    exe = fluid.Executor(fluid.CPUPlace())
+    r = exe.run(feed={"x": xv}, fetch_list=[step1, back])
+    np.testing.assert_allclose(r[0], xv[:, 1])
+    np.testing.assert_allclose(r[1], xv)
+
+
+def test_ifelse_row_select():
+    xb = layers.data("xb", shape=[4, 2], append_batch_size=False)
+    zero = layers.fill_constant([4, 1], "float32", 0.0)
+    m = layers.reduce_sum(xb, dim=1, keep_dim=True)
+    c = layers.greater_than(m, zero)
+    ie = layers.IfElse(c)
+    with ie.true_block():
+        d = ie.input(xb)
+        ie.output(layers.scale(d, 10.0))
+    with ie.false_block():
+        d = ie.input(xb)
+        ie.output(layers.scale(d, -1.0))
+    (out,) = ie()
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.array([[1, 1], [-1, -2], [3, 0], [-1, 0.5]], "float32")
+    (r,) = exe.run(feed={"xb": xv}, fetch_list=[out])
+    np.testing.assert_allclose(r, np.where(xv.sum(1, keepdims=True) > 0, xv * 10, -xv))
+
+
+def test_switch_piecewise_lr():
+    step = layers.data("step", shape=[1], append_batch_size=False)
+    lr = layers.fill_constant([1], "float32", 0.0)
+    b1 = layers.fill_constant([1], "float32", 10.0)
+    b2 = layers.fill_constant([1], "float32", 100.0)
+    with layers.Switch() as sw:
+        with sw.case(layers.less_than(step, b1)):
+            layers.assign(layers.fill_constant([1], "float32", 0.1), lr)
+        with sw.case(layers.less_than(step, b2)):
+            layers.assign(layers.fill_constant([1], "float32", 0.01), lr)
+        with sw.default():
+            layers.assign(layers.fill_constant([1], "float32", 0.001), lr)
+    exe = fluid.Executor(fluid.CPUPlace())
+    for sv, expect in [(5.0, 0.1), (50.0, 0.01), (500.0, 0.001)]:
+        (r,) = exe.run(feed={"step": np.array([sv], "float32")}, fetch_list=[lr])
+        assert abs(float(r[0]) - expect) < 1e-8
+
+
+def test_dynamic_rnn_seq2seq_trains():
+    """Encoder-decoder built on DynamicRNN trains end-to-end (grads flow
+    through the recurrence into all parameters; loss decreases)."""
+    B, T, V, H = 4, 6, 20, 16
+    rng = np.random.RandomState(4)
+    src = rng.randint(0, V, (B, T)).astype("int64")
+    trg = rng.randint(0, V, (B, T)).astype("int64")
+    s = layers.data("src", shape=[B, T], append_batch_size=False, dtype="int64")
+    t = layers.data("trg", shape=[B, T], append_batch_size=False, dtype="int64")
+    semb = layers.embedding(s, size=[V, H], param_attr=fluid.ParamAttr(name="s2s_emb"))
+    ctx_vec = layers.reduce_mean(semb, dim=1)  # [B, H] encoder summary
+    temb = layers.embedding(t, size=[V, H], param_attr=fluid.ParamAttr(name="s2s_demb"))
+    drnn = layers.DynamicRNN()
+    with drnn.block():
+        xt = drnn.step_input(temb)
+        cvec = drnn.static_input(ctx_vec)
+        mem = drnn.memory(shape=[H], value=0.0)
+        cat = layers.concat([xt, cvec, mem], axis=1)
+        hn = layers.fc(cat, H, act="tanh", param_attr=fluid.ParamAttr(name="s2s_W"))
+        drnn.update_memory(mem, hn)
+        drnn.output(hn)
+    dec = drnn()  # [B, T, H]
+    logits = layers.fc(
+        layers.reshape(dec, [-1, H]), V, param_attr=fluid.ParamAttr(name="s2s_O")
+    )
+    label = layers.reshape(t, [-1, 1])
+    loss = layers.mean(
+        layers.softmax_with_cross_entropy(logits, label)
+    )
+    fluid.optimizer.SGD(0.5).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    losses = []
+    for _ in range(8):
+        (lv,) = exe.run(feed={"src": src, "trg": trg}, fetch_list=[loss])
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert losses[-1] < losses[0] - 0.1, losses

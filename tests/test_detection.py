@@ -1,0 +1,217 @@
+"""Detection suite: SSD loss / RPN / proposal sampling / NMS / mAP.
+
+Covers the VERDICT round-1 acceptance: an SSD-style and an RCNN-style toy
+train step, plus unit checks of the new dense padded detection ops
+(reference: paddle/fluid/operators/detection/*.cc,
+python/paddle/fluid/layers/detection.py).
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.layers import detection as det
+
+
+def _run(feed, fetches):
+    exe = fluid.Executor(fluid.CPUPlace())
+    return exe.run(feed=feed, fetch_list=fetches)
+
+
+def test_box_coder_layer_roundtrip():
+    P = 6
+    rng = np.random.RandomState(0)
+    prior = np.sort(rng.rand(P, 4).astype("float32"), axis=1)
+    pvar = np.full((P, 4), 0.1, "float32")
+    gt = np.sort(rng.rand(3, 4).astype("float32"), axis=1)
+    pb = layers.data("pb", shape=[P, 4], append_batch_size=False)
+    pv = layers.data("pv", shape=[P, 4], append_batch_size=False)
+    tb = layers.data("tb", shape=[3, 4], append_batch_size=False)
+    enc = det.box_coder(pb, pv, tb, code_type="encode_center_size")
+    dec = det.box_coder(pb, pv, enc, code_type="decode_center_size")
+    r = _run({"pb": prior, "pv": pvar, "tb": gt}, [enc, dec])
+    # decode(encode(gt)) == gt per prior row; row m decodes against prior m
+    for m in range(P):
+        np.testing.assert_allclose(r[1][:, m], gt, rtol=1e-4, atol=1e-4)
+
+
+def test_ssd_toy_train_step():
+    """SSD-style head: priors + loc/conf predictions -> ssd_loss trains."""
+    B, P, C, G = 2, 12, 4, 3
+    rng = np.random.RandomState(1)
+    feats = rng.rand(B, 8).astype("float32")
+    gt_box = np.sort(rng.rand(B, G, 4).astype("float32"), axis=2)
+    gt_label = rng.randint(1, C, (B, G, 1)).astype("int64")
+    prior = np.sort(rng.rand(P, 4).astype("float32"), axis=1)
+    pvar = np.full((P, 4), 0.1, "float32")
+
+    x = layers.data("x", shape=[B, 8], append_batch_size=False)
+    gb = layers.data("gb", shape=[B, G, 4], append_batch_size=False)
+    gl = layers.data("gl", shape=[B, G, 1], append_batch_size=False, dtype="int64")
+    pb = layers.data("pb", shape=[P, 4], append_batch_size=False)
+    pv = layers.data("pv", shape=[P, 4], append_batch_size=False)
+    h = layers.fc(x, 32, act="relu")
+    loc = layers.reshape(layers.fc(h, P * 4), [B, P, 4])
+    conf = layers.reshape(layers.fc(h, P * C), [B, P, C])
+    loss_map = det.ssd_loss(loc, conf, gb, gl, pb, pv, background_label=0)
+    loss = layers.mean(loss_map)
+    fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    feed = {"x": feats, "gb": gt_box, "gl": gt_label, "pb": prior, "pv": pvar}
+    losses = [float(np.asarray(exe.run(feed=feed, fetch_list=[loss])[0]).reshape(-1)[0])
+              for _ in range(6)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+
+def test_rcnn_toy_train_step():
+    """RCNN-style: anchors -> rpn targets -> proposals -> sampled RoIs ->
+    roi_align head trains (grads flow through roi features)."""
+    N, A, H, W, G = 1, 3, 4, 4, 2
+    rng = np.random.RandomState(2)
+    feat = rng.rand(N, 6, H, W).astype("float32")
+    gt = np.array([[[2.0, 2.0, 9.0, 9.0], [5.0, 5.0, 14.0, 14.0]]], "float32")
+    im_info = np.array([[16.0, 16.0, 1.0]], "float32")
+
+    x = layers.data("x", shape=[N, 6, H, W], append_batch_size=False)
+    gb = layers.data("gb", shape=[N, G, 4], append_batch_size=False)
+    info = layers.data("info", shape=[N, 3], append_batch_size=False)
+    anchors, avar = det.anchor_generator(
+        x, anchor_sizes=[4.0, 8.0, 12.0], aspect_ratios=[1.0], stride=[4.0, 4.0]
+    )
+    conv = layers.conv2d(x, 16, 1, act="relu")
+    scores = layers.conv2d(conv, A, 1)
+    deltas = layers.conv2d(conv, A * 4, 1)
+
+    # rpn targets (dense): labels [N, HWA], targets [N, HWA, 4]
+    labels, tgts, inw = det.rpn_target_assign(
+        deltas, scores, anchors, avar, gb,
+        rpn_positive_overlap=0.3, rpn_negative_overlap=0.1,
+    )
+    score_flat = layers.reshape(layers.transpose(scores, [0, 2, 3, 1]), [N, -1])
+    lab_f = layers.cast(labels, "float32")
+    valid = layers.cast(layers.greater_equal(lab_f, layers.fill_constant([1], "float32", 0.0)), "float32")
+    rpn_cls_loss = layers.reduce_sum(
+        layers.sigmoid_cross_entropy_with_logits(score_flat, lab_f) * valid
+    ) / (layers.reduce_sum(valid) + 1e-6)
+
+    rois, probs, rois_num = det.generate_proposals(
+        scores, deltas, info, anchors, avar,
+        pre_nms_top_n=24, post_nms_top_n=8, nms_thresh=0.7, min_size=1.0,
+    )
+    roi_feat = det.roi_align(
+        conv, layers.reshape(rois, [-1, 4]), pooled_height=2, pooled_width=2,
+        spatial_scale=0.25,
+    )
+    head = layers.fc(layers.reshape(roi_feat, [8, -1]), 4)
+    loss = layers.mean(head * head) + rpn_cls_loss
+    fluid.optimizer.SGD(0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    feed = {"x": feat, "gb": gt, "info": im_info}
+    losses = [float(np.asarray(exe.run(feed=feed, fetch_list=[loss])[0]).reshape(-1)[0])
+              for _ in range(4)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+
+def test_generate_proposal_labels_shapes_and_sampling():
+    N, R, G, BSZ = 1, 10, 2, 8
+    rng = np.random.RandomState(3)
+    rois = np.sort(rng.rand(N, R, 4).astype("float32") * 10, axis=2)
+    gt = np.array([[[1.0, 1.0, 5.0, 5.0], [6.0, 6.0, 9.0, 9.0]]], "float32")
+    gtc = np.array([[1, 2]], "int64")
+    rv = layers.data("rv", shape=[N, R, 4], append_batch_size=False)
+    gbv = layers.data("gbv", shape=[N, G, 4], append_batch_size=False)
+    gcv = layers.data("gcv", shape=[N, G], append_batch_size=False, dtype="int64")
+    out = det.generate_proposal_labels(
+        rv, gcv, gt_boxes=gbv, batch_size_per_im=BSZ, fg_fraction=0.5,
+        fg_thresh=0.5, class_nums=4,
+    )
+    r = _run({"rv": rois, "gbv": gt, "gcv": gtc}, list(out))
+    s_rois, s_lab, s_tgt, s_inw, s_outw, s_num = r
+    assert s_rois.shape == (N, BSZ, 4)
+    assert s_lab.shape == (N, BSZ)
+    assert s_tgt.shape == (N, BSZ, 16)
+    # gt boxes are appended to the roi set, so at least the gt rows are fg
+    assert (s_lab >= 1).sum() >= G
+    assert int(s_num[0]) <= BSZ
+
+
+def test_mine_hard_examples_selects_highest_loss():
+    cls_loss = np.array([[0.1, 0.9, 0.5, 0.7, 0.2]], "float32")
+    match = np.array([[0, -1, -1, -1, -1]], "int32")  # 1 positive
+    cl = layers.data("cl", shape=[1, 5], append_batch_size=False)
+    mi = layers.data("mi", shape=[1, 5], append_batch_size=False, dtype="int32")
+    neg, upd = det.mine_hard_examples(cl, mi, neg_pos_ratio=2.0)
+    r = _run({"cl": cls_loss, "mi": match}, [neg, upd])
+    # 1 pos -> 2 hard negatives: indices 1 (0.9) and 3 (0.7)
+    np.testing.assert_array_equal(r[0][0], [0, 1, 0, 1, 0])
+    np.testing.assert_array_equal(r[1][0], [0, -1, -1, -1, -1])
+
+
+def test_multiclass_nms_layer_suppresses_overlaps():
+    # two heavily-overlapping boxes + one separate, single class
+    boxes = np.array(
+        [[[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30]]], "float32"
+    )
+    scores = np.array([[[0.9, 0.8, 0.7]]], "float32")  # [N, C=1, M]
+    bv = layers.data("bv", shape=[1, 3, 4], append_batch_size=False)
+    sv = layers.data("sv", shape=[1, 1, 3], append_batch_size=False)
+    out, num = det.multiclass_nms(bv, sv, score_threshold=0.1, nms_threshold=0.5,
+                                  keep_top_k=3, background_label=-1)
+    r = _run({"bv": boxes, "sv": scores}, [out, num])
+    assert int(r[1][0]) == 2  # overlap suppressed
+    kept = r[0][0][r[0][0][:, 0] >= 0]
+    np.testing.assert_allclose(sorted(kept[:, 1], reverse=True), [0.9, 0.7], rtol=1e-6)
+
+
+def test_roi_perspective_transform_identity_quad():
+    # axis-aligned quad == crop+resize of the region
+    x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+    quad = np.array([[0.0, 0.0, 3.0, 0.0, 3.0, 3.0, 0.0, 3.0]], "float32")
+    xv = layers.data("xv", shape=[1, 1, 4, 4], append_batch_size=False)
+    qv = layers.data("qv", shape=[1, 8], append_batch_size=False)
+    out = det.roi_perspective_transform(xv, qv, 4, 4)
+    (r,) = _run({"xv": x, "qv": quad}, [out])
+    # sampling the full image at 4x4 grid centers ~ the image itself
+    assert r.shape == (1, 1, 4, 4)
+    np.testing.assert_allclose(r[0, 0, 1:3, 1:3], x[0, 0, 1:3, 1:3], atol=2.0)
+
+
+def test_detection_map_metric():
+    from paddle_tpu.metrics import DetectionMAP
+
+    m = DetectionMAP(overlap_threshold=0.5, ap_version="integral")
+    gt_boxes = np.array([[0, 0, 10, 10], [20, 20, 30, 30]], "float32")
+    gt_labels = np.array([1, 2])
+    dets = np.array(
+        [
+            [1, 0.9, 0, 0, 10, 10],     # TP class 1
+            [2, 0.8, 20, 20, 30, 30],   # TP class 2
+            [1, 0.7, 50, 50, 60, 60],   # FP class 1
+            [-1, 0.0, 0, 0, 0, 0],      # padding
+        ],
+        "float32",
+    )
+    m.update(dets, gt_boxes, gt_labels)
+    v = m.eval()
+    assert 0.9 <= v <= 1.0  # both gts found at rank 1
+
+
+def test_detection_output_end_to_end():
+    B, P, C = 1, 4, 3
+    rng = np.random.RandomState(5)
+    prior = np.sort(rng.rand(P, 4).astype("float32"), axis=1)
+    pvar = np.full((P, 4), 0.1, "float32")
+    loc = np.zeros((B, P, 4), "float32")
+    scores = rng.rand(B, P, C).astype("float32")
+    pb = layers.data("pb", shape=[P, 4], append_batch_size=False)
+    pv = layers.data("pv", shape=[P, 4], append_batch_size=False)
+    lv = layers.data("lv", shape=[B, P, 4], append_batch_size=False)
+    sv = layers.data("sv", shape=[B, P, C], append_batch_size=False)
+    out = det.detection_output(lv, sv, pb, pv, score_threshold=0.01)
+    (r,) = _run({"pb": prior, "pv": pvar, "lv": loc, "sv": scores}, [out])
+    assert r.shape[-1] == 6
+    assert np.isfinite(r).all()
