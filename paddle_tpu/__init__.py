@@ -23,9 +23,12 @@ Typical use (same shape as fluid):
 
 from . import ops  # registers all op lowerings first
 from . import (
+    average,
     backward,
     clip,
     debugger,
+    evaluator,
+    net_drawer,
     flags,
     dataset,
     distributed,

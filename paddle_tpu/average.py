@@ -1,0 +1,35 @@
+"""WeightedAverage accumulator (python/paddle/fluid/average.py parity)."""
+
+import numpy as np
+
+__all__ = ["WeightedAverage"]
+
+
+def _is_number_or_matrix(var):
+    return isinstance(var, (int, float, np.ndarray)) or np.isscalar(var)
+
+
+class WeightedAverage:
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.numerator = None
+        self.denominator = None
+
+    def add(self, value, weight):
+        if not _is_number_or_matrix(value):
+            raise ValueError("add() takes a number or numpy array")
+        if not _is_number_or_matrix(weight):
+            raise ValueError("weight must be a number or numpy array")
+        if self.numerator is None or self.denominator is None:
+            self.numerator = value * weight
+            self.denominator = weight
+        else:
+            self.numerator += value * weight
+            self.denominator += weight
+
+    def eval(self):
+        if self.numerator is None or self.denominator is None:
+            raise ValueError("eval() before any add()")
+        return self.numerator / self.denominator

@@ -2,3 +2,4 @@
 
 from . import registry, scope, trace
 from .scope import Scope, global_scope, scope_guard
+from ..reader.program_reader import EOFException  # fluid.core.EOFException parity

@@ -70,6 +70,53 @@ def dce_mask(program, block_idx, fetch_names):
     return keep
 
 
+def visit_reads_writes(program, bidx, defined, on_read, on_write=None, pre_op=None):
+    """Shared block traversal: report names read before being written
+    (recursing into sub_block attrs, whose `__bound_names__` — recurrent
+    step slices, carried loop state — are defined by the op's lowering,
+    not external reads).  `pre_op(bidx, i, op)` may return "skip" to drop
+    an op or "define" to treat its outputs as given (feed/read ops)."""
+    blk = program.block(bidx)
+    for i, op in enumerate(blk.ops):
+        if pre_op is not None:
+            action = pre_op(bidx, i, op)
+            if action == "skip":
+                continue
+            if action == "define":
+                for n in op.output_arg_names():
+                    defined.add(n)
+                continue
+        for name in op.input_arg_names():
+            if name and name not in defined:
+                on_read(name)
+        for a, v in op.attrs.items():
+            if a.startswith("sub_block") and isinstance(v, int):
+                bound = op.attrs.get("__bound_names__", ())
+                visit_reads_writes(
+                    program, v, set(defined) | set(bound), on_read, on_write, pre_op
+                )
+        for name in op.output_arg_names():
+            defined.add(name)
+            if on_write is not None:
+                on_write(name)
+
+
+def sub_block_external_reads(program, block, bound):
+    """Outer-scope names a sub-block (incl. nested) reads before writing —
+    what a sub-block-owning op must declare as inputs (layer-build-time
+    counterpart of analyze_block's trace-time discovery)."""
+    reads = []
+    seen = set()
+
+    def on_read(n):
+        if n not in seen:
+            seen.add(n)
+            reads.append(n)
+
+    visit_reads_writes(program, block.idx, set(bound), on_read)
+    return reads
+
+
 def analyze_block(program, block_idx, feed_names, fetch_names, keep=None):
     """Find external reads (scope state the block consumes) and all writes,
     across the block and its sub-blocks."""
@@ -78,33 +125,28 @@ def analyze_block(program, block_idx, feed_names, fetch_names, keep=None):
     writes = []
     writes_set = set()
 
-    def visit_block(bidx, defined):
-        blk = program.block(bidx)
-        for i, op in enumerate(blk.ops):
-            if keep is not None and bidx == block_idx and not keep[i]:
-                continue
-            if op.type == "feed":
-                for n in op.output_arg_names():
-                    defined.add(n)
-                continue
-            for name in op.input_arg_names():
-                if name not in defined and name not in reads_set:
-                    reads_set.add(name)
-                    reads.append(name)
-            for a, v in op.attrs.items():
-                if a.startswith("sub_block") and isinstance(v, int):
-                    # names the op's lowering binds into the sub-block env
-                    # (recurrent step slices, carried loop state) are defined
-                    # there, not external reads
-                    bound = op.attrs.get("__bound_names__", ())
-                    visit_block(v, set(defined) | set(bound))
-            for name in op.output_arg_names():
-                defined.add(name)
-                if name not in writes_set:
-                    writes_set.add(name)
-                    writes.append(name)
+    def on_read(name):
+        if name not in reads_set:
+            reads_set.add(name)
+            reads.append(name)
 
-    visit_block(block_idx, set(feed_names))
+    def on_write(name):
+        if name not in writes_set:
+            writes_set.add(name)
+            writes.append(name)
+
+    def pre_op(bidx, i, op):
+        if keep is not None and bidx == block_idx and not keep[i]:
+            return "skip"
+        if op.type in ("feed", "read"):
+            # read-op outputs arrive as implicit feeds (executor pops the
+            # reader queue); the Reader var itself is host state
+            return "define"
+        return None
+
+    visit_reads_writes(
+        program, block_idx, set(feed_names), on_read, on_write, pre_op
+    )
     for n in fetch_names:
         if n not in writes_set and n not in set(feed_names) and n not in reads_set:
             reads_set.add(n)
@@ -202,8 +244,8 @@ def build_traced_function(program, block_idx, feed_names, fetch_names, scope):
         def trace_ops(bidx, env):
             blk = program.block(bidx)
             for idx, op in enumerate(blk.ops):
-                if op.type in ("feed", "fetch"):
-                    continue
+                if op.type in ("feed", "fetch", "read", "create_py_reader"):
+                    continue  # satisfied as implicit feeds / host state
                 if bidx == block_idx and not keep[idx]:
                     continue
                 ctx.op_idx = (bidx << 20) | idx

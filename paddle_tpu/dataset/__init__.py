@@ -2,3 +2,4 @@
 with deterministic synthetic fallback (zero-egress; see common.py)."""
 
 from . import common, mnist, cifar, uci_housing, imdb, imikolov, wmt16
+from . import movielens, conll05, sentiment, flowers, voc2012, wmt14, mq2007

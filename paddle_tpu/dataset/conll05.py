@@ -1,0 +1,112 @@
+"""CoNLL-2005 SRL readers (python/paddle/dataset/conll05.py API parity).
+
+Real data: word/verb/target dicts + the test corpus under
+DATA_HOME/conll05st/.  Otherwise deterministic synthetic SRL sequences with
+the reference's 9-slot sample layout: (word, ctx_n2, ctx_n1, ctx_0, ctx_p1,
+ctx_p2, verb, mark, label) index lists.
+"""
+
+import gzip
+import os
+
+import numpy as np
+
+from . import common
+
+__all__ = ["get_dict", "get_embedding", "test"]
+
+UNK_IDX = 0
+
+_state = {}
+
+
+def _load_dict(path):
+    d = {}
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rt") as f:
+        for i, ln in enumerate(f):
+            d[ln.strip()] = i
+    return d
+
+
+def _load():
+    if _state:
+        return _state
+    base = common.data_path("conll05st")
+    wd = os.path.join(base, "wordDict.txt")
+    vd = os.path.join(base, "verbDict.txt")
+    td = os.path.join(base, "targetDict.txt")
+    if os.path.exists(wd):
+        word_dict = _load_dict(wd)
+        verb_dict = _load_dict(vd)
+        label_dict = _load_dict(td)
+    else:
+        common.synthetic_note("conll05")
+        word_dict = {"w%d" % i: i for i in range(200)}
+        verb_dict = {"v%d" % i: i for i in range(20)}
+        label_dict = {
+            l: i
+            for i, l in enumerate(
+                ["O", "B-A0", "I-A0", "B-A1", "I-A1", "B-V", "I-V"]
+            )
+        }
+    _state.update(word=word_dict, verb=verb_dict, label=label_dict)
+    return _state
+
+
+def get_dict():
+    """Returns (word_dict, verb_dict, label_dict)."""
+    st = _load()
+    return st["word"], st["verb"], st["label"]
+
+
+def get_embedding():
+    """Pretrained word embedding table [len(word_dict), 32] (the reference
+    ships emb.gz; synthetic mode derives a deterministic table)."""
+    st = _load()
+    path = common.data_path("conll05st", "emb")
+    if os.path.exists(path):
+        return np.loadtxt(path, dtype="float32")
+    rng = np.random.RandomState(11)
+    return rng.normal(0, 0.1, (len(st["word"]), 32)).astype("float32")
+
+
+def test():
+    """Reader over (word, 5 ctx windows, verb, mark, label) id sequences."""
+
+    def reader():
+        st = _load()
+        nw = len(st["word"])
+        nv = len(st["verb"])
+        nl = len(st["label"])
+        rng = np.random.RandomState(13)
+        for _ in range(200):
+            n = int(rng.randint(4, 12))
+            words = rng.randint(0, nw, n).tolist()
+            pred_pos = int(rng.randint(0, n))
+            verb = int(rng.randint(0, nv))
+
+            def ctx(off):
+                j = pred_pos + off
+                return words[j] if 0 <= j < n else UNK_IDX
+
+            labels = []
+            for i in range(n):
+                if i == pred_pos:
+                    labels.append(st["label"].get("B-V", 0))
+                else:
+                    labels.append(int(rng.randint(0, nl)))
+            mark = [1 if i == pred_pos else 0 for i in range(n)]
+            yield (
+                words,
+                [ctx(-2)] * n,
+                [ctx(-1)] * n,
+                [ctx(0)] * n,
+                [ctx(1)] * n,
+                [ctx(2)] * n,
+                [verb] * n,
+                mark,
+                labels,
+            )
+
+    return reader

@@ -1,0 +1,72 @@
+"""Oxford-102 flowers readers (python/paddle/dataset/flowers.py API parity).
+
+Real data: DATA_HOME/flowers/ with jpg images under jpg/ plus
+imagelabels.mat + setid.mat (needs scipy for the .mat files).  Otherwise
+deterministic synthetic images.  Samples: (flattened CHW float image in
+[0,1], int label in [0, 102)).
+"""
+
+import os
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "valid"]
+
+_HW = 32  # synthetic fallback resolution (reference crops 224; models
+# under test use small inputs — real data passes through untouched)
+
+
+def _real_reader(split_key):
+    base = common.data_path("flowers")
+
+    def reader():
+        from scipy.io import loadmat
+
+        labels = loadmat(os.path.join(base, "imagelabels.mat"))["labels"][0]
+        setid = loadmat(os.path.join(base, "setid.mat"))
+        ids = setid[split_key][0]
+        for i in ids:
+            path = os.path.join(base, "jpg", "image_%05d.jpg" % i)
+            try:
+                from PIL import Image
+
+                img = np.asarray(Image.open(path), dtype="float32") / 255.0
+            except ImportError:
+                continue
+            yield img.transpose(2, 0, 1).ravel(), int(labels[i - 1]) - 1
+
+    return reader
+
+
+def _synthetic(n, seed):
+    rng = np.random.RandomState(seed)
+
+    def reader():
+        for i in range(n):
+            label = i % 102
+            img = rng.rand(3 * _HW * _HW).astype("float32") * 0.2
+            img[(label * 29) % (3 * _HW * _HW - 64):][:64] += 0.7
+            yield img, label
+
+    return reader
+
+
+def _make(split_key, n, seed):
+    if common.have_file("flowers", "imagelabels.mat"):
+        return _real_reader(split_key)
+    common.synthetic_note("flowers")
+    return _synthetic(n, seed)
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=False):
+    return _make("trnid", 1020, 31)
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=False):
+    return _make("tstid", 512, 32)
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=False):
+    return _make("valid", 256, 33)

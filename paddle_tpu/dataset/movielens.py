@@ -1,0 +1,157 @@
+"""MovieLens-1M readers (python/paddle/dataset/movielens.py API parity).
+
+Real data: drop ml-1m.zip's extracted files under DATA_HOME/movielens/ml-1m/
+(movies.dat, users.dat, ratings.dat, '::'-separated).  Otherwise serves
+deterministic synthetic samples with the reference's feature layout:
+[user_id, gender, age, job, movie_id, categories, title] -> rating.
+"""
+
+import os
+import re
+
+import numpy as np
+
+from . import common
+
+__all__ = [
+    "train",
+    "test",
+    "get_movie_title_dict",
+    "max_movie_id",
+    "max_user_id",
+    "max_job_id",
+    "age_table",
+    "movie_categories",
+    "user_info",
+    "movie_info",
+]
+
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+_CATEGORIES = [
+    "Action", "Adventure", "Animation", "Children's", "Comedy", "Crime",
+    "Documentary", "Drama", "Fantasy", "Film-Noir", "Horror", "Musical",
+    "Mystery", "Romance", "Sci-Fi", "Thriller", "War", "Western",
+]
+
+
+class MovieInfo:
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self, cat_dict, title_dict):
+        return [
+            self.index,
+            [cat_dict[c] for c in self.categories],
+            [title_dict[w] for w in self.title.split()],
+        ]
+
+
+class UserInfo:
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == "M"
+        self.age = age_table.index(int(age))
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [self.index, 0 if self.is_male else 1, self.age, self.job_id]
+
+
+_state = {}
+
+
+def _load():
+    if _state:
+        return _state
+    base = common.data_path("movielens", "ml-1m")
+    movies, users, ratings = {}, {}, []
+    if os.path.exists(os.path.join(base, "ratings.dat")):
+        pat = re.compile(r"[^\w\s]")
+        with open(os.path.join(base, "movies.dat"), encoding="latin1") as f:
+            for ln in f:
+                mid, title, cats = ln.strip().split("::")
+                title = pat.sub("", title.lower())
+                movies[int(mid)] = MovieInfo(mid, cats.split("|"), title)
+        with open(os.path.join(base, "users.dat"), encoding="latin1") as f:
+            for ln in f:
+                uid, gender, age, job, _zip = ln.strip().split("::")
+                users[int(uid)] = UserInfo(uid, gender, age, job)
+        with open(os.path.join(base, "ratings.dat"), encoding="latin1") as f:
+            for ln in f:
+                uid, mid, rating, _ts = ln.strip().split("::")
+                ratings.append((int(uid), int(mid), float(rating)))
+    else:
+        common.synthetic_note("movielens")
+        rng = np.random.RandomState(7)
+        for mid in range(1, 201):
+            cats = [_CATEGORIES[mid % len(_CATEGORIES)]]
+            movies[mid] = MovieInfo(mid, cats, "title %d word%d" % (mid, mid % 37))
+        for uid in range(1, 101):
+            users[uid] = UserInfo(
+                uid, "M" if uid % 2 else "F", age_table[uid % 7], uid % 21
+            )
+        for _ in range(4000):
+            uid = int(rng.randint(1, 101))
+            mid = int(rng.randint(1, 201))
+            ratings.append((uid, mid, float(rng.randint(1, 6))))
+    cat_dict = {c: i for i, c in enumerate(_CATEGORIES)}
+    words = sorted({w for m in movies.values() for w in m.title.split()})
+    title_dict = {w: i for i, w in enumerate(words)}
+    _state.update(
+        movies=movies, users=users, ratings=ratings,
+        cat_dict=cat_dict, title_dict=title_dict,
+    )
+    return _state
+
+
+def _reader(is_test):
+    def reader():
+        st = _load()
+        for i, (uid, mid, rating) in enumerate(st["ratings"]):
+            in_test = i % 10 == 0
+            if in_test != is_test:
+                continue
+            usr = st["users"][uid].value()
+            mov = st["movies"][mid].value(st["cat_dict"], st["title_dict"])
+            yield usr + mov + [[rating]]
+
+    return reader
+
+
+def train():
+    return _reader(False)
+
+
+def test():
+    return _reader(True)
+
+
+def get_movie_title_dict():
+    return _load()["title_dict"]
+
+
+def movie_categories():
+    return _load()["cat_dict"]
+
+
+def max_movie_id():
+    return max(_load()["movies"])
+
+
+def max_user_id():
+    return max(_load()["users"])
+
+
+def max_job_id():
+    return max(u.job_id for u in _load()["users"].values())
+
+
+def user_info():
+    return list(_load()["users"].values())
+
+
+def movie_info():
+    return list(_load()["movies"].values())

@@ -1,0 +1,99 @@
+"""MQ2007 learning-to-rank readers (python/paddle/dataset/mq2007.py API
+parity): LETOR 4.0 format, pointwise / pairwise / listwise modes.
+
+Real data: DATA_HOME/MQ2007/{train,test}.txt lines
+  <rel> qid:<q> 1:<f1> 2:<f2> ... #docid...
+Otherwise deterministic synthetic queries with 46 features (the LETOR
+feature count).
+"""
+
+import os
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test"]
+
+_N_FEAT = 46
+
+
+def _parse_letor(path):
+    """-> {qid: [(rel, feature_vector)]}"""
+    queries = {}
+    with open(path) as f:
+        for ln in f:
+            ln = ln.split("#")[0].strip()
+            if not ln:
+                continue
+            parts = ln.split()
+            rel = int(parts[0])
+            qid = parts[1].split(":")[1]
+            feats = np.zeros(_N_FEAT, "float32")
+            for kv in parts[2:]:
+                k, v = kv.split(":")
+                idx = int(k) - 1
+                if 0 <= idx < _N_FEAT:
+                    feats[idx] = float(v)
+            queries.setdefault(qid, []).append((rel, feats))
+    return queries
+
+
+def _synthetic(n_queries, seed):
+    rng = np.random.RandomState(seed)
+    queries = {}
+    for q in range(n_queries):
+        docs = []
+        w = rng.rand(_N_FEAT)
+        for _ in range(int(rng.randint(5, 15))):
+            f = rng.rand(_N_FEAT).astype("float32")
+            rel = int(np.clip(np.floor(f @ w / (_N_FEAT / 6.0)), 0, 2))
+            docs.append((rel, f))
+        queries["q%d" % q] = docs
+    return queries
+
+
+def _load(split, seed):
+    path = common.data_path("MQ2007", split + ".txt")
+    if os.path.exists(path):
+        return _parse_letor(path)
+    common.synthetic_note("mq2007")
+    return _synthetic(60, seed)
+
+
+def _reader(split, format, seed):
+    def pointwise():
+        qs = _load(split, seed)
+        for qid in sorted(qs):
+            for rel, f in qs[qid]:
+                yield float(rel), f
+
+    def pairwise():
+        qs = _load(split, seed)
+        for qid in sorted(qs):
+            docs = qs[qid]
+            for i, (ri, fi) in enumerate(docs):
+                for rj, fj in docs[i + 1:]:
+                    if ri > rj:
+                        yield 1.0, fi, fj
+                    elif rj > ri:
+                        yield 1.0, fj, fi
+
+    def listwise():
+        qs = _load(split, seed)
+        for qid in sorted(qs):
+            rels = [float(r) for r, _ in qs[qid]]
+            feats = [f for _, f in qs[qid]]
+            yield rels, feats
+
+    return {"pointwise": pointwise, "pairwise": pairwise, "listwise": listwise}[
+        format
+    ]
+
+
+def train(format="pairwise"):
+    return _reader("train", format, 23)
+
+
+def test(format="pairwise"):
+    return _reader("test", format, 24)

@@ -1,0 +1,81 @@
+"""Movie-review sentiment readers (python/paddle/dataset/sentiment.py API
+parity — the reference wraps NLTK's movie_reviews corpus).
+
+Real data: pos/neg review text files under DATA_HOME/sentiment/{pos,neg}/.
+Otherwise deterministic synthetic reviews over a small polarity-biased
+vocabulary.  Samples: (word index list, label) with label 0=positive,
+1=negative (reference convention).
+"""
+
+import os
+
+import numpy as np
+
+from . import common
+
+__all__ = ["get_word_dict", "train", "test"]
+
+NUM_TRAINING_INSTANCES = 1600
+NUM_TOTAL_INSTANCES = 2000
+
+_state = {}
+
+
+def _load():
+    if _state:
+        return _state
+    base = common.data_path("sentiment")
+    docs = []  # (words, label)
+    if os.path.isdir(os.path.join(base, "pos")):
+        for label, sub in ((0, "pos"), (1, "neg")):
+            d = os.path.join(base, sub)
+            for fn in sorted(os.listdir(d)):
+                with open(os.path.join(d, fn), errors="ignore") as f:
+                    docs.append((f.read().lower().split(), label))
+    else:
+        common.synthetic_note("sentiment")
+        rng = np.random.RandomState(17)
+        pos_words = ["good", "great", "fine", "superb", "nice"]
+        neg_words = ["bad", "awful", "poor", "boring", "worse"]
+        neutral = ["movie", "plot", "actor", "scene", "film", "the", "a"]
+        for i in range(NUM_TOTAL_INSTANCES):
+            label = i % 2
+            bias = neg_words if label else pos_words
+            n = int(rng.randint(5, 30))
+            words = []
+            for _ in range(n):
+                pool = bias if rng.rand() < 0.4 else neutral
+                words.append(pool[int(rng.randint(0, len(pool)))])
+            docs.append((words, label))
+        rng.shuffle(docs)
+    freq = {}
+    for words, _ in docs:
+        for w in words:
+            freq[w] = freq.get(w, 0) + 1
+    ordered = sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))
+    word_dict = {w: i for i, (w, _) in enumerate(ordered)}
+    _state.update(docs=docs, word_dict=word_dict)
+    return _state
+
+
+def get_word_dict():
+    """word -> index sorted by corpus frequency (reference contract)."""
+    return _load()["word_dict"]
+
+
+def _reader(lo, hi):
+    def reader():
+        st = _load()
+        wd = st["word_dict"]
+        for words, label in st["docs"][lo:hi]:
+            yield [wd[w] for w in words if w in wd], label
+
+    return reader
+
+
+def train():
+    return _reader(0, NUM_TRAINING_INSTANCES)
+
+
+def test():
+    return _reader(NUM_TRAINING_INSTANCES, NUM_TOTAL_INSTANCES)

@@ -1,0 +1,79 @@
+"""Pascal VOC2012 segmentation readers (python/paddle/dataset/voc2012.py API
+parity).
+
+Real data: DATA_HOME/voc2012/VOCdevkit/VOC2012/ standard layout (JPEGImages,
+SegmentationClass, ImageSets/Segmentation/*.txt).  Otherwise deterministic
+synthetic (image, segmentation mask) pairs: image CHW float32, mask HW int32
+with 21 classes (20 + background).
+"""
+
+import os
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "val"]
+
+_HW = 24
+_N_CLASSES = 21
+
+
+def _real_reader(split):
+    root = common.data_path("voc2012", "VOCdevkit", "VOC2012")
+
+    def reader():
+        from PIL import Image
+
+        lst = os.path.join(root, "ImageSets", "Segmentation", split + ".txt")
+        with open(lst) as f:
+            names = [ln.strip() for ln in f if ln.strip()]
+        for name in names:
+            img = np.asarray(
+                Image.open(os.path.join(root, "JPEGImages", name + ".jpg")),
+                dtype="float32",
+            ) / 255.0
+            seg = np.asarray(
+                Image.open(
+                    os.path.join(root, "SegmentationClass", name + ".png")
+                ),
+                dtype="int32",
+            )
+            yield img.transpose(2, 0, 1), seg
+
+    return reader
+
+
+def _synthetic(n, seed):
+    rng = np.random.RandomState(seed)
+
+    def reader():
+        for i in range(n):
+            img = rng.rand(3, _HW, _HW).astype("float32")
+            seg = np.zeros((_HW, _HW), "int32")
+            c = i % (_N_CLASSES - 1) + 1
+            y, x = (i * 7) % (_HW - 8), (i * 11) % (_HW - 8)
+            seg[y:y + 8, x:x + 8] = c
+            yield img, seg
+
+    return reader
+
+
+def _make(split, n, seed):
+    if common.have_file("voc2012", "VOCdevkit", "VOC2012", "ImageSets",
+                        "Segmentation", split + ".txt"):
+        return _real_reader(split)
+    common.synthetic_note("voc2012")
+    return _synthetic(n, seed)
+
+
+def train():
+    return _make("train", 400, 41)
+
+
+def val():
+    return _make("val", 100, 42)
+
+
+def test():
+    return _make("val", 100, 43)

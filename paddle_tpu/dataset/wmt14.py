@@ -1,0 +1,94 @@
+"""WMT14 en-fr readers (python/paddle/dataset/wmt14.py API parity).
+
+Real data: DATA_HOME/wmt14/ with src.dict, trg.dict and train/test files of
+tab-separated parallel sentences.  Otherwise deterministic synthetic
+parallel id sequences.  Samples: (src_ids, trg_ids_with_<s>, trg_ids_with_<e>)
+— the reference's (source, target-input, target-label) triple.
+"""
+
+import os
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "get_dict"]
+
+START = "<s>"
+END = "<e>"
+UNK = "<unk>"
+START_ID, END_ID, UNK_ID = 0, 1, 2
+
+_state = {}
+
+
+def _load(dict_size):
+    key = int(dict_size)
+    if key in _state:
+        return _state[key]
+    base = common.data_path("wmt14")
+    if os.path.exists(os.path.join(base, "src.dict")):
+        def rd(fn):
+            d = {}
+            with open(os.path.join(base, fn), encoding="utf-8") as f:
+                for i, ln in enumerate(f):
+                    if i >= dict_size:
+                        break
+                    d[ln.strip()] = i
+            return d
+
+        src_dict, trg_dict = rd("src.dict"), rd("trg.dict")
+        pairs = []
+        with open(os.path.join(base, "train"), encoding="utf-8") as f:
+            for ln in f:
+                parts = ln.rstrip("\n").split("\t")
+                if len(parts) == 2:
+                    pairs.append((parts[0].split(), parts[1].split()))
+    else:
+        common.synthetic_note("wmt14")
+        src_dict = {START: 0, END: 1, UNK: 2}
+        trg_dict = {START: 0, END: 1, UNK: 2}
+        for i in range(3, dict_size):
+            src_dict["src%d" % i] = i
+            trg_dict["trg%d" % i] = i
+        rng = np.random.RandomState(19)
+        pairs = []
+        inv_s = list(src_dict)
+        inv_t = list(trg_dict)
+        for _ in range(500):
+            n = int(rng.randint(3, 10))
+            s = [inv_s[int(rng.randint(3, len(inv_s)))] for _ in range(n)]
+            t = [inv_t[int(rng.randint(3, len(inv_t)))] for _ in range(n)]
+            pairs.append((s, t))
+    _state[key] = (src_dict, trg_dict, pairs)
+    return _state[key]
+
+
+def _reader(dict_size, is_test):
+    def reader():
+        src_dict, trg_dict, pairs = _load(dict_size)
+        for i, (s, t) in enumerate(pairs):
+            if (i % 10 == 0) != is_test:
+                continue
+            src_ids = [src_dict.get(w, UNK_ID) for w in s]
+            t_ids = [trg_dict.get(w, UNK_ID) for w in t]
+            yield src_ids, [START_ID] + t_ids, t_ids + [END_ID]
+
+    return reader
+
+
+def train(dict_size=30000):
+    return _reader(dict_size, False)
+
+
+def test(dict_size=30000):
+    return _reader(dict_size, True)
+
+
+def get_dict(dict_size, reverse=False):
+    """(src_dict, trg_dict); reverse=True flips to id->word."""
+    src_dict, trg_dict, _ = _load(dict_size)
+    if reverse:
+        src_dict = {v: k for k, v in src_dict.items()}
+        trg_dict = {v: k for k, v in trg_dict.items()}
+    return src_dict, trg_dict

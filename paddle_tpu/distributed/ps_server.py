@@ -40,6 +40,9 @@ class ParameterServer:
         scope=None,
         sparse_tables=None,
         sparse_lr=0.01,
+        checkpoint_dir=None,
+        checkpoint_every=1,
+        server_idx=0,
     ):
         from ..executor import Executor
         from ..places import CPUPlace
@@ -71,6 +74,101 @@ class ParameterServer:
         self._params_ready = not sync_mode
         self._live_trainers = num_trainers
         self._done = threading.Event()
+        # shard checkpointing (go/pserver/service.go:346 Checkpoint +
+        # LoadCheckpoint :175 capability): periodic atomic snapshots of the
+        # shard scope + sparse tables, restored on restart
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_every = max(1, int(checkpoint_every))
+        self.server_idx = int(server_idx)
+        self._async_sends = 0
+        self._ckpt_write_lock = threading.Lock()  # serialize writer threads
+
+    # ---- checkpoint (fault tolerance) -----------------------------------
+    def _ckpt_path(self):
+        import os
+
+        return os.path.join(
+            self.checkpoint_dir, "pserver_%d.ckpt" % self.server_idx
+        )
+
+    def _snapshot(self):
+        """Copy shard state (called under the service lock; numpy copies so
+        later in-place updates can't tear the snapshot)."""
+        return {
+            "round": self._round,
+            "vars": {
+                n: np.array(self.scope.get(n))
+                for n in self.scope.local_var_names()
+            },
+            "sparse": {
+                k: np.array(t) for k, (t, _lr) in self.sparse_tables.items()
+            },
+        }
+
+    def _write_snapshot(self, data):
+        """Atomic write-tmp + rename (the Go pserver's crc+rename
+        discipline); runs OFF the service lock."""
+        import os
+        import pickle
+
+        os.makedirs(self.checkpoint_dir, exist_ok=True)
+        path = self._ckpt_path()
+        tmp = path + ".tmp"
+        with self._ckpt_write_lock:
+            with open(tmp, "wb") as f:
+                pickle.dump(data, f, protocol=pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp, path)
+
+    def save_checkpoint(self):
+        if not self.checkpoint_dir:
+            return False
+        self._write_snapshot(self._snapshot())
+        return True
+
+    def load_checkpoint(self):
+        """Restore shard state from the latest snapshot; returns the
+        restored round or None when no checkpoint exists."""
+        if not self.checkpoint_dir:
+            return None
+        import os
+        import pickle
+
+        path = self._ckpt_path()
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as f:
+            data = pickle.load(f)
+        for n, v in data["vars"].items():
+            self.scope.set(n, v)
+        for k, v in data["sparse"].items():
+            if k in self.sparse_tables:
+                _t, lr = self.sparse_tables[k]
+                self.sparse_tables[k] = (np.ascontiguousarray(v), lr)
+        self._round = int(data.get("round", 0))
+        return self._round
+
+    def _maybe_checkpoint(self):
+        """Called under the service lock: snapshot cheaply here, serialize
+        + write on a background thread so trainer RPCs never stall on disk."""
+        if not (self.checkpoint_dir and self._round % self.checkpoint_every == 0):
+            return
+        try:
+            data = self._snapshot()
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
+            return
+
+        def write():
+            try:
+                self._write_snapshot(data)
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+
+        threading.Thread(target=write, daemon=True).start()
 
     # ---- verb dispatch ---------------------------------------------------
     def handle(self, verb, **kw):
@@ -99,6 +197,7 @@ class ParameterServer:
         self._send_barriers.clear()
         self._params_ready = True
         self._round += 1
+        self._maybe_checkpoint()
         self._cv.notify_all()
 
     # ---- handlers --------------------------------------------------------
@@ -111,6 +210,15 @@ class ParameterServer:
                         self.lr_program, feed={}, fetch_list=[], scope=self.scope
                     )
                 self._apply_shard(self.grad_to_shard[name], {name: value})
+                self._async_sends += 1
+                if (
+                    self.checkpoint_dir
+                    and self._async_sends
+                    % (self.checkpoint_every * max(1, len(self.grad_to_shard)))
+                    == 0
+                ):
+                    self._round += 1
+                    self._maybe_checkpoint()
             return {"ok": True}
         with self._lock:
             self._pending.setdefault(name, {})[trainer_id] = value
@@ -167,6 +275,14 @@ class ParameterServer:
         with self._lock:
             np.subtract.at(tbl, ids, lr * rows)
         return {"ok": True}
+
+    def _h_checkpoint_notify(self, dir=None, trainer_id=0):
+        """Trainer-initiated checkpoint (checkpoint_notify_op.cc analog)."""
+        with self._lock:
+            if dir:
+                self.checkpoint_dir = dir
+            ok = self.save_checkpoint()
+        return {"ok": bool(ok), "round": self._round}
 
     def _h_complete(self, trainer_id=0):
         with self._cv:
@@ -238,6 +354,24 @@ def run_pserver(program, scope, executor=None):
             float(lr),
         )
 
+    import os as _os
+
+    # checkpoint wiring: attr from the transpiler config, else the
+    # PADDLE_PSERVER_CKPT_DIR env contract (test/ops harness)
+    ckpt_dir = a.get("checkpoint_dir") or _os.environ.get(
+        "PADDLE_PSERVER_CKPT_DIR"
+    )
+    ckpt_every = int(
+        a.get("checkpoint_every")
+        or _os.environ.get("PADDLE_PSERVER_CKPT_EVERY", 1)
+    )
+    try:
+        server_idx = [s.strip() for s in _os.environ.get(
+            "PADDLE_PSERVER_EPS", ""
+        ).split(",")].index(a["endpoint"])
+    except ValueError:
+        server_idx = 0
+
     service = ParameterServer(
         shard_programs,
         dict(a["grad_to_shard"]),
@@ -247,7 +381,13 @@ def run_pserver(program, scope, executor=None):
         scope=scope,
         sparse_tables=sparse_tables,
         sparse_lr=float(a.get("sparse_lr", 0.01)),
+        checkpoint_dir=ckpt_dir,
+        checkpoint_every=ckpt_every,
+        server_idx=server_idx,
     )
+    restored = service.load_checkpoint()
+    if restored is not None:
+        print("PSERVER RESTORED round=%d" % restored, flush=True)
     server = VarServer(a["endpoint"], service).start()
     try:
         service.wait_done()

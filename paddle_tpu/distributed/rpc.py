@@ -265,6 +265,10 @@ class RPCClient:
         return self.call("barrier", timeout_s=self.barrier_timeout,
                          kind=kind, trainer_id=trainer_id)
 
+    def checkpoint_notify(self, dir=None, trainer_id=0):
+        """Ask the pserver to snapshot its shard (checkpoint_notify_op.cc)."""
+        return self.call("checkpoint_notify", dir=dir, trainer_id=trainer_id)
+
     def complete(self, trainer_id=0):
         return self.call("complete", trainer_id=trainer_id)
 

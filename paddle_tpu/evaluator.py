@@ -1,0 +1,196 @@
+"""In-program evaluators (python/paddle/fluid/evaluator.py parity).
+
+An Evaluator owns persistable state vars in the main program, appends
+accumulation ops per minibatch, and reads the aggregate out of the scope
+in eval().  The reference marks this module deprecated in favor of
+fluid.metrics — both are provided here (metrics.py has the pure-python
+accumulators; these are the program-integrated versions).
+"""
+
+import numpy as np
+
+from . import framework, unique_name
+from .framework import Variable
+from .layer_helper import LayerHelper
+
+__all__ = ["ChunkEvaluator", "EditDistance"]
+
+
+class Evaluator:
+    """Base: create persistable zero-initialized state vars + reset()."""
+
+    def __init__(self, name):
+        self.helper = LayerHelper(name, name=name)
+        self.states = []
+        self.metrics = []
+
+    def _create_state(self, suffix, dtype, shape):
+        state = self.helper.create_variable(
+            name="_".join([unique_name.generate(self.helper.name), suffix]),
+            persistable=True,
+            dtype=dtype,
+            shape=shape,
+        )
+        self.states.append(state)
+        # zero-init in the startup program (reference resets via
+        # fill_constant in reset(); initial value must exist either way)
+        self.helper.set_variable_initializer(
+            state, initializer=__import__(
+                "paddle_tpu.initializer", fromlist=["Constant"]
+            ).Constant(0.0 if dtype.startswith("float") else 0)
+        )
+        return state
+
+    def reset(self, executor, reset_program=None):
+        """Zero all state vars (runs a tiny fill program)."""
+        if reset_program is None:
+            reset_program = framework.Program()
+        with framework.program_guard(reset_program):
+            for var in self.states:
+                blk = reset_program.global_block()
+                z = blk.create_var(name=var.name, shape=var.shape, dtype=var.dtype,
+                                   persistable=True)
+                blk.append_op(
+                    "fill_constant",
+                    outputs={"Out": [z]},
+                    attrs={"shape": list(var.shape or [1]),
+                           "dtype": var.dtype, "value": 0.0},
+                )
+        executor.run(reset_program, feed={}, fetch_list=[])
+
+    def eval(self, executor, eval_program=None):
+        raise NotImplementedError
+
+
+class ChunkEvaluator(Evaluator):
+    """Accumulated chunk F1 (evaluator.py ChunkEvaluator): wraps the
+    chunk_eval op and accumulates counts across minibatches."""
+
+    def __init__(self, input, label, chunk_scheme, num_chunk_types,
+                 excluded_chunk_types=None, seq_len=None):
+        super().__init__("chunk_evaluator")
+        main = framework.default_main_program()
+        self.num_infer_chunks = self._create_state("num_infer", "int64", [1])
+        self.num_label_chunks = self._create_state("num_label", "int64", [1])
+        self.num_correct_chunks = self._create_state("num_correct", "int64", [1])
+        from .layers import nn as nn_layers
+
+        helper = self.helper
+        precision = helper.create_variable_for_type_inference("float32")
+        recall = helper.create_variable_for_type_inference("float32")
+        f1 = helper.create_variable_for_type_inference("float32")
+        ni = helper.create_variable_for_type_inference("int64")
+        nl = helper.create_variable_for_type_inference("int64")
+        nc = helper.create_variable_for_type_inference("int64")
+        inputs = {"Inference": [input], "Label": [label]}
+        if seq_len is not None:
+            inputs["Length"] = [seq_len]
+        helper.append_op(
+            "chunk_eval",
+            inputs=inputs,
+            outputs={
+                "Precision": [precision],
+                "Recall": [recall],
+                "F1-Score": [f1],
+                "NumInferChunks": [ni],
+                "NumLabelChunks": [nl],
+                "NumCorrectChunks": [nc],
+            },
+            attrs={
+                "chunk_scheme": chunk_scheme,
+                "num_chunk_types": num_chunk_types,
+                "excluded_chunk_types": excluded_chunk_types or [],
+            },
+        )
+        # state += batch counts
+        for state, batch in [
+            (self.num_infer_chunks, ni),
+            (self.num_label_chunks, nl),
+            (self.num_correct_chunks, nc),
+        ]:
+            helper.append_op(
+                "elementwise_add",
+                inputs={"X": [state], "Y": [batch]},
+                outputs={"Out": [state]},
+            )
+        self.metrics = [precision, recall, f1]
+
+    def eval(self, executor, eval_program=None):
+        from .core.scope import global_scope
+
+        scope = global_scope()
+        ni = float(np.asarray(scope.get(self.num_infer_chunks.name)).reshape(-1)[0])
+        nl = float(np.asarray(scope.get(self.num_label_chunks.name)).reshape(-1)[0])
+        nc = float(np.asarray(scope.get(self.num_correct_chunks.name)).reshape(-1)[0])
+        precision = nc / ni if ni else 0.0
+        recall = nc / nl if nl else 0.0
+        f1 = 2 * precision * recall / (precision + recall) if nc else 0.0
+        return np.array([precision]), np.array([recall]), np.array([f1])
+
+
+class EditDistance(Evaluator):
+    """Accumulated average edit distance (evaluator.py EditDistance):
+    wraps the edit_distance op and tracks (total distance, #errors, #seqs)."""
+
+    def __init__(self, input, label, ignored_tokens=None, seq_len=None,
+                 label_len=None):
+        super().__init__("edit_distance_evaluator")
+        self.total_distance = self._create_state("total_dist", "float32", [1])
+        self.seq_num = self._create_state("seq_num", "int64", [1])
+        self.instance_error = self._create_state("inst_err", "int64", [1])
+        helper = self.helper
+        dist = helper.create_variable_for_type_inference("float32")
+        seq_num = helper.create_variable_for_type_inference("int64")
+        inputs = {"Hyps": [input], "Refs": [label]}
+        if seq_len is not None:
+            inputs["HypsLength"] = [seq_len]
+        if label_len is not None:
+            inputs["RefsLength"] = [label_len]
+        helper.append_op(
+            "edit_distance",
+            inputs=inputs,
+            outputs={"Out": [dist], "SequenceNum": [seq_num]},
+            attrs={"normalized": False},
+        )
+        batch_total = helper.create_variable_for_type_inference("float32")
+        helper.append_op(
+            "reduce_sum", inputs={"X": [dist]}, outputs={"Out": [batch_total]}
+        )
+        helper.append_op(
+            "elementwise_add",
+            inputs={"X": [self.total_distance], "Y": [batch_total]},
+            outputs={"Out": [self.total_distance]},
+        )
+        helper.append_op(
+            "elementwise_add",
+            inputs={"X": [self.seq_num], "Y": [seq_num]},
+            outputs={"Out": [self.seq_num]},
+        )
+        # instance errors = #sequences with distance > 0 (distances are
+        # non-negative, so sign() is the indicator)
+        sgn = helper.create_variable_for_type_inference("float32")
+        helper.append_op("sign", inputs={"X": [dist]}, outputs={"Out": [sgn]})
+        err = helper.create_variable_for_type_inference("float32")
+        helper.append_op(
+            "reduce_sum", inputs={"X": [sgn]}, outputs={"Out": [err]}
+        )
+        erri = helper.create_variable_for_type_inference("int64")
+        helper.append_op(
+            "cast", inputs={"X": [err]}, outputs={"Out": [erri]},
+            attrs={"out_dtype": "int64"},
+        )
+        helper.append_op(
+            "elementwise_add",
+            inputs={"X": [self.instance_error], "Y": [erri]},
+            outputs={"Out": [self.instance_error]},
+        )
+
+    def eval(self, executor, eval_program=None):
+        from .core.scope import global_scope
+
+        scope = global_scope()
+        total = float(np.asarray(scope.get(self.total_distance.name)).reshape(-1)[0])
+        n = float(np.asarray(scope.get(self.seq_num.name)).reshape(-1)[0])
+        err = float(np.asarray(scope.get(self.instance_error.name)).reshape(-1)[0])
+        avg = total / n if n else 0.0
+        return np.array([avg], "float32"), np.array([err / n if n else 0.0], "float32")

@@ -101,6 +101,28 @@ class Executor:
             else:
                 feed_arrays[name] = jax.device_put(jnp.asarray(value), device)
 
+        # in-program readers: satisfy `read` op outputs from the staged
+        # device queue (create_py_reader/double_buffer analog — host IO
+        # happens here at the executor boundary, not inside the XLA step)
+        readers = getattr(program, "_py_readers", None)
+        if readers:
+            for op in program.global_block().ops:
+                if op.type != "read":
+                    continue
+                state = readers[op.attrs["reader_name"]]
+                batch = state.next_feed()  # raises EOFException at end
+                for n in op.outputs["Out"]:
+                    key_name = n if n in batch else None
+                    if key_name is None:
+                        # dict batches may use positional order
+                        key_name = state.out_names[op.outputs["Out"].index(n)]
+                    val = batch[key_name]
+                    feed_arrays[n] = (
+                        val
+                        if hasattr(val, "devices") or hasattr(val, "device")
+                        else jax.device_put(jnp.asarray(val), device)
+                    )
+
         feed_sig = tuple(
             sorted((n, tuple(a.shape), str(a.dtype)) for n, a in feed_arrays.items())
         )

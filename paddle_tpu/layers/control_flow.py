@@ -95,28 +95,14 @@ def increment(x, value=1.0, in_place=True):
 
 
 def _sub_block_externals(program, blk, bound):
-    """Outer-scope names a sub-block (and its nested sub-blocks) reads:
-    everything read before being written, minus names the emitting op's
-    lowering will bind (`bound`).  These become the op's Ext inputs so the
-    generic vjp grad path sees them as differentiable leaves."""
-    reads = []
-    seen = set()
+    """Outer-scope names a sub-block reads before writing — these become
+    the op's Ext inputs so the generic vjp grad path sees them as
+    differentiable leaves.  Shares the traversal with the tracer
+    (core/trace.py) so build-time Ext lists and trace-time discovery can
+    never disagree."""
+    from ..core.trace import sub_block_external_reads
 
-    def visit(b, defined):
-        for op in b.ops:
-            for n in op.input_arg_names():
-                if n and n not in defined and n not in seen:
-                    seen.add(n)
-                    reads.append(n)
-            for a, v in op.attrs.items():
-                if a.startswith("sub_block") and isinstance(v, int):
-                    nested_bound = op.attrs.get("__bound_names__", ())
-                    visit(program.block(v), set(defined) | set(nested_bound))
-            for n in op.output_arg_names():
-                defined.add(n)
-
-    visit(blk, set(bound))
-    return reads
+    return sub_block_external_reads(program, blk, bound)
 
 
 class While:

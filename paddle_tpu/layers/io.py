@@ -1,10 +1,17 @@
 """Input layers (python/paddle/fluid/layers/io.py analog): `data` declares a
-feed slot; py_reader/double-buffering live in paddle_tpu.reader (the TPU
-input pipeline is host-side prefetch + device_put, not reader ops)."""
+feed slot; `py_reader` (io.py:635) gives a program its own input pipeline.
 
-from .. import framework
+TPU re-expression of the reader-op stack (create_py_reader_op.cc,
+create_double_buffer_reader_op.cc): the `read` op stays in the program as
+the declaration of in-program inputs, but its outputs are satisfied by the
+Executor from a native-blocking-queue-fed, device-prefetching pipeline
+(reader/program_reader.py) — host IO cannot live inside the compiled XLA
+step, so the executor boundary is where the queue is drained.
+"""
 
-__all__ = ["data"]
+from .. import framework, unique_name
+
+__all__ = ["data", "py_reader", "read_file", "double_buffer"]
 
 
 def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True, type=None, stop_gradient=True):
@@ -25,3 +32,102 @@ def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True, type
         is_data=True,
     )
     return var
+
+
+class PyReaderHandle:
+    """What `py_reader` returns: a READER-typed var handle whose
+    decoration/lifecycle methods proxy the runtime state
+    (reader/program_reader.py)."""
+
+    def __init__(self, var, state, out_vars):
+        self._var = var
+        self._state = state
+        self._out_vars = out_vars
+
+    @property
+    def name(self):
+        return self._var.name
+
+    @property
+    def out_names(self):
+        return list(self._state.out_names)
+
+    def decorate_paddle_reader(self, reader):
+        self._state.decorate_paddle_reader(reader)
+
+    decorate_sample_list_generator = decorate_paddle_reader
+
+    def decorate_batch_generator(self, generator):
+        self._state.decorate_batch_generator(generator)
+
+    decorate_tensor_provider = decorate_batch_generator
+
+    def start(self):
+        self._state.start()
+
+    def reset(self):
+        self._state.reset()
+
+
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None, use_double_buffer=True):
+    """In-program reader (io.py:635 parity): returns a reader handle;
+    `read_file(reader)` yields the data vars.  Usage:
+
+        reader = layers.py_reader(64, [[-1, 784], [-1, 1]], ['float32', 'int64'])
+        img, label = layers.read_file(reader)
+        ...
+        reader.decorate_paddle_reader(paddle.batch(mnist.train(), 32))
+        reader.start()
+        while True:
+            try:
+                exe.run(fetch_list=[loss])     # no feed: the program reads
+            except fluid.core.EOFException:
+                reader.reset()
+                break
+    """
+    from ..reader.program_reader import ProgramReader
+
+    main = framework.default_main_program()
+    block = main.current_block()
+    rname = name or unique_name.generate("py_reader")
+    reader_var = block.create_var(
+        name=rname, shape=None, dtype="float32", type=framework.VarType.READER
+    )
+    out_vars = []
+    for i, (shape, dtype) in enumerate(zip(shapes, dtypes)):
+        out_vars.append(
+            block.create_var(
+                name=unique_name.generate("%s_out%d" % (rname, i)),
+                shape=list(shape),
+                dtype=dtype,
+                stop_gradient=True,
+                is_data=True,
+            )
+        )
+    state = ProgramReader(
+        rname, [v.name for v in out_vars], shapes, dtypes, capacity
+    )
+    if not hasattr(main, "_py_readers"):
+        main._py_readers = {}
+    main._py_readers[rname] = state
+    return PyReaderHandle(reader_var, state, out_vars)
+
+
+def read_file(reader):
+    """Emit the `read` op binding the reader's staged batches to its data
+    vars (read_op.cc parity)."""
+    block = framework.default_main_program().current_block()
+    block.append_op(
+        "read",
+        inputs={"Reader": [reader.name]},
+        outputs={"Out": [v.name for v in reader._out_vars]},
+        attrs={"reader_name": reader.name},
+    )
+    outs = reader._out_vars
+    return outs[0] if len(outs) == 1 else outs
+
+
+def double_buffer(reader, place=None, name=None):
+    """Compat pass-through: device double-buffering is built into the
+    py_reader pipeline (stager thread prefetches to device)."""
+    return reader

@@ -8,13 +8,16 @@ MXU-friendly XLA ops.
 
 import numpy as np
 
-from .. import framework
+from .. import framework, unique_name
 from ..framework import Variable
 from ..initializer import Constant, Normal, Xavier
 from ..layer_helper import LayerHelper
 from ..param_attr import ParamAttr
 
 __all__ = [
+    "conv3d_transpose",
+    "data_norm",
+
     "fused_attention",
     "log_loss",
     "beam_search",
@@ -1130,10 +1133,6 @@ def gaussian_random_batch_size_like(
     return out
 
 
-def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="float32"):
-    raise NotImplementedError("sampling_id pending")
-
-
 def sum(x):
     helper = LayerHelper("sum")
     if isinstance(x, Variable):
@@ -1418,3 +1417,126 @@ def fused_attention(q, k, v, causal=False, scale=None, name=None):
         attrs={"causal": causal, "scale": scale},
     )
     return out
+
+
+def conv3d_transpose(
+    input,
+    num_filters,
+    output_size=None,
+    filter_size=None,
+    padding=0,
+    stride=1,
+    dilation=1,
+    groups=None,
+    param_attr=None,
+    bias_attr=None,
+    use_cudnn=True,
+    act=None,
+    name=None,
+):
+    """conv3d_transpose (nn.py conv3d_transpose parity): NCDHW transposed
+    convolution (ops/nn_ops.py _conv3d_transpose)."""
+    helper = LayerHelper("conv3d_transpose", **locals())
+    dtype = helper.input_dtype()
+    num_channels = input.shape[1]
+    groups = groups or 1
+
+    def _trip(v):
+        return [v] * 3 if isinstance(v, int) else list(v)
+
+    stride, padding, dilation = map(_trip, (stride, padding, dilation))
+    if filter_size is None:
+        if output_size is None:
+            raise ValueError(
+                "conv3d_transpose needs filter_size or output_size"
+            )
+        output_size = _trip(output_size)
+        # invert out = (in-1)*s - 2p + d*(k-1) + 1 per spatial dim
+        filter_size = [
+            (output_size[i] - (input.shape[2 + i] - 1) * stride[i]
+             + 2 * padding[i] - 1) // dilation[i] + 1
+            for i in range(3)
+        ]
+    else:
+        filter_size = _trip(filter_size)
+    filter_shape = [num_channels, num_filters // groups] + filter_size
+    w = helper.create_parameter(attr=helper.param_attr, shape=filter_shape, dtype=dtype)
+    pre_bias = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "conv3d_transpose",
+        inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [pre_bias]},
+        attrs={
+            "strides": stride,
+            "paddings": padding,
+            "dilations": dilation,
+            "groups": groups,
+        },
+    )
+    pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="int32"):
+    """Sample a category per row of a probability matrix
+    (nn.py sampling_id / sampling_id_op.cc)."""
+    helper = LayerHelper("sampling_id")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "sampling_id",
+        inputs={"X": [x]},
+        outputs={"Out": [out]},
+        attrs={"seed": seed},
+    )
+    return out
+
+
+def data_norm(
+    input,
+    act=None,
+    epsilon=1e-05,
+    param_attr=None,
+    data_layout="NCHW",
+    in_place=False,
+    name=None,
+    moving_mean_name=None,
+    moving_variance_name=None,
+    do_model_average_for_mean_and_var=False,
+):
+    """Batch-statistics normalization for CTR models (nn.py data_norm /
+    data_norm_op.cc): accumulators are persistable state the op updates
+    each step."""
+    helper = LayerHelper("data_norm", **locals())
+    dtype = helper.input_dtype()
+    d = input.shape[-1]
+    attr = param_attr or ParamAttr()
+    from ..initializer import Constant
+
+    bsz = helper.create_global_variable(
+        name=unique_name.generate("data_norm_batch_size"),
+        persistable=True, dtype=dtype, shape=[d],
+    )
+    bsum = helper.create_global_variable(
+        name=unique_name.generate("data_norm_batch_sum"),
+        persistable=True, dtype=dtype, shape=[d],
+    )
+    bsq = helper.create_global_variable(
+        name=unique_name.generate("data_norm_batch_square_sum"),
+        persistable=True, dtype=dtype, shape=[d],
+    )
+    helper.set_variable_initializer(bsz, Constant(1e4))
+    helper.set_variable_initializer(bsum, Constant(0.0))
+    helper.set_variable_initializer(bsq, Constant(1e4))
+    out = helper.create_variable_for_type_inference(dtype)
+    means = helper.create_variable_for_type_inference(dtype)
+    scales = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "data_norm",
+        inputs={"X": [input], "BatchSize": [bsz], "BatchSum": [bsum],
+                "BatchSquareSum": [bsq]},
+        outputs={"Y": [out], "Means": [means], "Scales": [scales],
+                 "BatchSizeOut": [bsz], "BatchSumOut": [bsum],
+                 "BatchSquareSumOut": [bsq]},
+        attrs={"epsilon": epsilon},
+    )
+    return helper.append_activation(out)

@@ -13,6 +13,8 @@ from ..core.registry import register
 from ..layer_helper import LayerHelper
 
 __all__ = [
+    "sequence_conv",
+
     "sequence_pool",
     "sequence_softmax",
     "sequence_expand",
@@ -212,3 +214,42 @@ def sequence_mask(x, maxlen=None, dtype="int64", name=None):
 
 def sequence_slice(input, offset, length, name=None):
     raise NotImplementedError("sequence_slice pending")
+
+
+def sequence_conv(
+    input,
+    num_filters,
+    filter_size=3,
+    filter_stride=1,
+    padding=None,
+    bias_attr=None,
+    param_attr=None,
+    act=None,
+    seq_len=None,
+    name=None,
+):
+    """Context-window sequence convolution (nn.py sequence_conv /
+    sequence_conv_op.cc) over the padded [B, T, D] representation."""
+    from ..layer_helper import LayerHelper
+
+    helper = LayerHelper("sequence_conv", **locals())
+    dtype = helper.input_dtype()
+    d = input.shape[-1]
+    filter_shape = [filter_size * d, num_filters]
+    w = helper.create_parameter(attr=helper.param_attr, shape=filter_shape, dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    inputs = {"X": [input], "Filter": [w]}
+    if seq_len is not None:
+        inputs["SeqLen"] = [seq_len]
+    helper.append_op(
+        "sequence_conv",
+        inputs=inputs,
+        outputs={"Out": [out]},
+        attrs={
+            "contextLength": filter_size,
+            "contextStart": -int(filter_size // 2),
+            "contextStride": filter_stride,
+        },
+    )
+    pre_act = helper.append_bias_op(out, dim_start=2)
+    return helper.append_activation(pre_act)

@@ -96,18 +96,31 @@ def build_resnet_train_program(
     optimizer="momentum",
     dtype="float32",
     use_bf16=False,
+    use_reader_op=False,
+    reader_capacity=8,
 ):
     """Build (main_program, startup_program, feeds, fetches) for training —
     convenience mirroring the benchmark driver's model setup.  use_bf16
     applies the AMP rewrite (bf16 convs/matmuls on the MXU, f32 master
-    weights) before the optimizer pass."""
+    weights) before the optimizer pass.  use_reader_op builds the
+    `--use_reader_op` fast path (fluid_benchmark.py): inputs come from an
+    in-program py_reader instead of feed, returned as a 5th element."""
     import paddle_tpu as fluid
 
     main = fluid.Program()
     startup = fluid.Program()
     with fluid.program_guard(main, startup):
-        img = layers.data("image", shape=list(image_shape), dtype=dtype)
-        label = layers.data("label", shape=[1], dtype="int64")
+        if use_reader_op:
+            reader = layers.py_reader(
+                capacity=reader_capacity,
+                shapes=[[-1] + list(image_shape), [-1, 1]],
+                dtypes=[dtype, "int64"],
+            )
+            img, label = layers.read_file(reader)
+        else:
+            reader = None
+            img = layers.data("image", shape=list(image_shape), dtype=dtype)
+            label = layers.data("label", shape=[1], dtype="int64")
         predict = resnet_imagenet(img, class_dim, depth)
         cost = layers.cross_entropy(input=predict, label=label)
         avg_cost = layers.mean(cost)
@@ -121,4 +134,6 @@ def build_resnet_train_program(
         else:
             opt = fluid.optimizer.SGD(learning_rate=lr)
         opt.minimize(avg_cost)
+    if use_reader_op:
+        return main, startup, [], [avg_cost, acc], reader
     return main, startup, ["image", "label"], [avg_cost, acc]

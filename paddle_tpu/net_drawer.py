@@ -1,0 +1,40 @@
+"""Program -> graphviz drawing (python/paddle/fluid/net_drawer.py parity).
+
+Thin CLI/API over debugger.draw_block_graphviz: `draw_graph(startup, main)`
+emits .dot files for both programs (the reference renders OpProto graphs
+with the graphviz python package; here the .dot text is written directly —
+no external dependency)."""
+
+import argparse
+
+from .debugger import draw_block_graphviz
+
+__all__ = ["draw_graph"]
+
+
+def draw_graph(startup_program, main_program, graph_path="./graph.dot", **kwargs):
+    paths = []
+    for tag, prog in (("startup", startup_program), ("main", main_program)):
+        if prog is None:
+            continue
+        path = graph_path.replace(".dot", ".%s.dot" % tag)
+        draw_block_graphviz(prog.global_block(), path=path)
+        paths.append(path)
+    return paths
+
+
+def main():
+    parser = argparse.ArgumentParser(description="draw a saved program")
+    parser.add_argument("--graphviz_path", default="./graph.dot")
+    args = parser.parse_args()
+    import paddle_tpu as fluid
+
+    draw_graph(
+        fluid.default_startup_program(),
+        fluid.default_main_program(),
+        args.graphviz_path,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -289,3 +289,15 @@ def _load_combine(ctx, ins, attrs):
             )
         )
     return {"Out": outs}
+
+
+@register("sampling_id", no_grad_inputs=("X",), needs_rng=True)
+def _sampling_id(ctx, ins, attrs):
+    """sampling_id_op.cc: sample one category id per row from a
+    probability matrix (device-side RNG instead of the reference's host
+    std::mt19937)."""
+    x = ins["X"][0]  # [B, C] probabilities
+    key = ctx.rng(attrs)
+    logits = jnp.log(jnp.maximum(x, 1e-20))
+    ids = jax.random.categorical(key, logits, axis=-1)
+    return {"Out": [ids.astype(jnp.int32)]}

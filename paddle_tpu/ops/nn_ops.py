@@ -69,10 +69,16 @@ def _conv2d_transpose(ctx, ins, attrs):
     paddings = _pair(attrs.get("paddings", [0, 0]))
     dilations = _pair(attrs.get("dilations", [1, 1]))
     groups = attrs.get("groups", 1) or 1
-    pad = [(paddings[0], paddings[0]), (paddings[1], paddings[1])]
+    # paddle semantics: out = (H-1)*s - 2p + k_eff.  jax applies `padding`
+    # to the stride-dilated input of a plain conv with the flipped kernel,
+    # so each side needs k_eff - 1 - p
+    k_eff = [dilations[i] * (w.shape[2 + i] - 1) + 1 for i in range(2)]
+    pad = [(k_eff[i] - 1 - paddings[i],) * 2 for i in range(2)]
 
     # w layout: [in_c, out_c/groups, kh, kw] (paddle conv_transpose filter);
-    # lax.conv_transpose has no group support, so groups unroll statically
+    # with transpose_kernel=True jax SWAPS the I/O labels, so the in_c dim
+    # must be labeled 'O' (it is the contraction side of the transposed
+    # conv); lax.conv_transpose has no group support, so groups unroll
     def one(xg, wg):
         return jax.lax.conv_transpose(
             xg,
@@ -80,7 +86,7 @@ def _conv2d_transpose(ctx, ins, attrs):
             strides=strides,
             padding=pad,
             rhs_dilation=dilations,
-            dimension_numbers=("NCHW", "IOHW", "NCHW"),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
             transpose_kernel=True,
         )
 
@@ -544,3 +550,181 @@ def _fused_attention(ctx, ins, attrs):
     else:
         out = _dense_attention(qf, kf, vf, causal, float(scale))
     return {"Out": [out.reshape(b, h, t, d)]}
+
+
+@register("sequence_conv")
+def _sequence_conv(ctx, ins, attrs):
+    """Context-window convolution over padded sequences
+    (sequence_ops/sequence_conv_op.cc): for each timestep concatenate
+    context_length steps starting at context_start, matmul with Filter
+    [ctx_len * D, out].  Positions outside the sequence contribute zeros
+    (the reference's zero-padded context rows)."""
+    x = ins["X"][0]  # [B, T, D]
+    w = ins["Filter"][0]
+    seq_len = ins["SeqLen"][0] if ins.get("SeqLen") else None
+    ctx_len = int(attrs.get("contextLength", attrs.get("context_length", 3)))
+    ctx_start = int(attrs.get("contextStart", attrs.get("context_start", -1)))
+    b, t, d = x.shape
+    if seq_len is not None:
+        mask = (jnp.arange(t)[None, :] < seq_len.reshape(-1, 1)).astype(x.dtype)
+        x = x * mask[:, :, None]
+    cols = []
+    for k in range(ctx_len):
+        off = ctx_start + k
+        shifted = jnp.roll(x, -off, axis=1)
+        pos = jnp.arange(t) + off
+        valid = ((pos >= 0) & (pos < t)).astype(x.dtype)
+        cols.append(shifted * valid[None, :, None])
+    ctx_mat = jnp.concatenate(cols, axis=-1)  # [B, T, ctx_len*D]
+    return {"Out": [ctx_mat @ w]}
+
+
+@register("attention_lstm")
+def _attention_lstm(ctx, ins, attrs):
+    """Fused attention LSTM (attention_lstm_op.cc): at every output step,
+    score each source position with fc([x_t_src ; h_prev]), softmax over
+    the (length-masked) sequence, take the context vector, run one LSTM
+    cell on it.  Padded [B, T, M] re-expression of the LoD original."""
+    x = ins["X"][0]  # [B, T, M]
+    h0 = ins["H0"][0] if ins.get("H0") else None
+    c0 = ins["C0"][0]
+    att_w = ins["AttentionWeight"][0]  # [M + D, 1]
+    att_b = ins["AttentionBias"][0] if ins.get("AttentionBias") else None
+    lstm_w = ins["LSTMWeight"][0]  # [M + D, 4D]
+    lstm_b = ins["LSTMBias"][0] if ins.get("LSTMBias") else None
+    seq_len = ins["SeqLen"][0] if ins.get("SeqLen") else None
+    b, t, m = x.shape
+    dd = c0.shape[-1]
+    if h0 is None:
+        h0 = jnp.zeros_like(c0)
+
+    neg = jnp.asarray(-1e9, x.dtype)
+    if seq_len is not None:
+        pad = jnp.arange(t)[None, :] >= seq_len.reshape(-1, 1)
+    else:
+        pad = jnp.zeros((b, t), bool)
+
+    def step(carry, _):
+        h, c = carry
+        # attention scores over all T positions given h
+        he = jnp.broadcast_to(h[:, None, :], (b, t, dd))
+        feat = jnp.concatenate([x, he], axis=-1)  # [B, T, M+D]
+        score = (feat @ att_w)[..., 0]
+        if att_b is not None:
+            score = score + att_b.reshape(-1)[0]
+        score = jnp.where(pad, neg, score)
+        alpha = jax.nn.softmax(score, axis=-1)
+        ctx_vec = jnp.einsum("bt,btm->bm", alpha, x)
+        gin = jnp.concatenate([ctx_vec, h], axis=-1) @ lstm_w
+        if lstm_b is not None:
+            gin = gin + lstm_b.reshape(1, -1)
+        i, f, cc, o = jnp.split(gin, 4, axis=-1)
+        c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(cc)
+        h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+        return (h_new, c_new), h_new
+
+    (h_fin, c_fin), hs = jax.lax.scan(step, (h0, c0), None, length=t)
+    return {
+        "Hidden": [jnp.swapaxes(hs, 0, 1)],  # [B, T, D]
+        "Cell": [c_fin],
+        "LastH": [h_fin],
+    }
+
+
+@register("conv3d_transpose")
+def _conv3d_transpose(ctx, ins, attrs):
+    """conv3d_transpose_op: NCDHW transposed convolution via
+    lax.conv_transpose (gradient-of-conv semantics on the MXU)."""
+    x = ins["Input"][0]  # [N, C, D, H, W]
+    w = ins["Filter"][0]  # [Cin, Cout, kD, kH, kW]
+    strides = tuple(attrs.get("strides", [1, 1, 1]))
+    pads = attrs.get("paddings", [0, 0, 0])
+    dilations = list(attrs.get("dilations", [1, 1, 1]))
+    groups = int(attrs.get("groups", 1) or 1)
+    # paddle out = (D-1)*s - 2p + d*(k-1) + 1: jax pads the dilated input,
+    # so each side takes d*(k-1) - p (see conv2d_transpose)
+    jpads = [
+        (dilations[i] * (w.shape[2 + i] - 1) - pads[i],) * 2 for i in range(3)
+    ]
+
+    def one(xg, wg):
+        return jax.lax.conv_transpose(
+            xg,
+            wg,  # [Cin, Cout/g, kD, kH, kW]; Cin labeled 'O'
+            strides,
+            jpads,
+            rhs_dilation=dilations,
+            dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+            transpose_kernel=True,
+        )
+
+    if groups == 1:
+        out = one(x, w)
+    else:
+        cin = x.shape[1] // groups
+        out = jnp.concatenate(
+            [
+                one(x[:, g * cin:(g + 1) * cin], w[g * cin:(g + 1) * cin])
+                for g in range(groups)
+            ],
+            axis=1,
+        )
+    return {"Output": [out]}
+
+
+@register("max_pool3d_with_index")
+def _max_pool3d_with_index(ctx, ins, attrs):
+    """pool_with_index_op 3-D variant: max pool + flat d*h*w argmax mask."""
+    x = ins["X"][0]  # [N, C, D, H, W]
+    ks = [int(k) for k in attrs.get("ksize", [2, 2, 2])]
+    st = [int(s) for s in attrs.get("strides", ks)]
+    n, c, d, h, w = x.shape
+    kd, kh, kw = ks
+    sd, sh, sw = st
+    od, oh, ow = (d - kd) // sd + 1, (h - kh) // sh + 1, (w - kw) // sw + 1
+    patches = jax.lax.conv_general_dilated_patches(
+        x.reshape(n * c, 1, d, h, w),
+        (kd, kh, kw),
+        (sd, sh, sw),
+        "VALID",
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+    )  # [n*c, kd*kh*kw, od, oh, ow]
+    patches = patches.reshape(n, c, kd * kh * kw, od, oh, ow)
+    out = jnp.max(patches, axis=2)
+    arg = jnp.argmax(patches, axis=2)
+    wd = arg // (kh * kw)
+    rem = arg % (kh * kw)
+    wy, wx = rem // kw, rem % kw
+    oz = jnp.arange(od).reshape(1, 1, -1, 1, 1)
+    oy = jnp.arange(oh).reshape(1, 1, 1, -1, 1)
+    ox = jnp.arange(ow).reshape(1, 1, 1, 1, -1)
+    flat = ((oz * sd + wd) * h + (oy * sh + wy)) * w + (ox * sw + wx)
+    return {"Out": [out], "Mask": [flat.astype(jnp.int32)]}
+
+
+@register("data_norm")
+def _data_norm(ctx, ins, attrs):
+    """data_norm_op.cc: normalization by accumulated batch statistics
+    (CTR models): means = BatchSum/BatchSize, scales =
+    sqrt(BatchSize / BatchSquareSum); training also emits updated
+    accumulators for the current minibatch."""
+    x = ins["X"][0]  # [B, D]
+    bsz = ins["BatchSize"][0]
+    bsum = ins["BatchSum"][0]
+    bsq = ins["BatchSquareSum"][0]
+    eps = float(attrs.get("epsilon", 1e-4))
+    means = bsum / jnp.maximum(bsz, 1.0)
+    scales = jnp.sqrt(jnp.maximum(bsz, 1.0) / jnp.maximum(bsq, eps))
+    out = (x - means.reshape(1, -1)) * scales.reshape(1, -1)
+    nb = x.shape[0]
+    upd_size = bsz + nb
+    upd_sum = bsum + jnp.sum(x, axis=0)
+    upd_sq = bsq + jnp.sum(x * x, axis=0)
+    return {
+        "Y": [out],
+        "Means": [means],
+        "Scales": [scales],
+        "BatchSizeOut": [upd_size],
+        "BatchSumOut": [upd_sum],
+        "BatchSquareSumOut": [upd_sq],
+    }

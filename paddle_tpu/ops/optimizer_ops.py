@@ -200,3 +200,26 @@ def _ftrl(ctx, ins, attrs):
         "SquaredAccumOut": [new_sq],
         "LinearAccumOut": [new_lin],
     }
+
+
+@register("model_average_accum", no_grad_inputs=("Param", "Sum", "Num", "NumUpdates"))
+def _model_average_accum(ctx, ins, attrs):
+    """ModelAverage accumulation (optimizer.py:1365): running param sum
+    with window restart — the single-op re-expression of the reference's
+    sum_1/sum_2/sum_3 rotation.  Reference restart rule: the window resets
+    once it exceeds min(max_average_window, max(min_average_window,
+    average_window_rate * total_updates))."""
+    p = ins["Param"][0]
+    s = ins["Sum"][0]
+    n = ins["Num"][0]
+    nu = ins["NumUpdates"][0] if ins.get("NumUpdates") else n
+    rate = float(attrs.get("average_window_rate", 0.15))
+    min_w = float(attrs.get("min_average_window", 10000))
+    max_w = float(attrs.get("max_average_window", 10000))
+    new_nu = nu + 1.0
+    threshold = jnp.minimum(max_w, jnp.maximum(min_w, rate * new_nu))
+    new_n = n + 1.0
+    restart = new_n > threshold
+    s_out = jnp.where(restart, p.astype(s.dtype), s + p.astype(s.dtype))
+    n_out = jnp.where(restart, jnp.ones_like(n), new_n)
+    return {"SumOut": [s_out], "NumOut": [n_out], "NumUpdatesOut": [new_nu]}

@@ -26,6 +26,7 @@ __all__ = [
     "Adadelta",
     "RMSProp",
     "Ftrl",
+    "ModelAverage",
     "SGDOptimizer",
     "MomentumOptimizer",
     "LarsMomentumOptimizer",
@@ -501,3 +502,116 @@ DecayedAdagrad = DecayedAdagradOptimizer
 Adadelta = AdadeltaOptimizer
 RMSProp = RMSPropOptimizer
 Ftrl = FtrlOptimizer
+
+
+class ModelAverage(Optimizer):
+    """Parameter averaging for evaluation (optimizer.py:1365 ModelAverage).
+
+    Accumulates a running sum of every trainable parameter after each
+    step (one fused `model_average_accum` op per param — the TPU
+    re-expression of the reference's sum_1/2/3 rotation: the window
+    restarts once num_updates exceeds max_average_window); `apply()`
+    swaps params for their windowed average, `restore()` puts the
+    trained values back.
+
+        opt.minimize(loss)
+        model_average = fluid.optimizer.ModelAverage(0.15)
+        ...train...
+        with model_average.apply(exe):
+            ...evaluate with averaged weights...
+    """
+
+    def __init__(
+        self,
+        average_window_rate,
+        min_average_window=10000,
+        max_average_window=10000,
+        regularization=None,
+        name=None,
+    ):
+        self.average_window = average_window_rate
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+        self._accums = {}  # param name -> (sum var, num var)
+        main = framework.default_main_program()
+        block = main.global_block()
+        with main._op_role_guard("optimize"):
+            for param in block.all_parameters():
+                if not param.trainable:
+                    continue
+                helper = LayerHelper("model_average")
+                psum = helper.create_global_variable(
+                    name=unique_name.generate(param.name + "_avg_sum"),
+                    persistable=True,
+                    dtype=param.dtype,
+                    shape=param.shape,
+                )
+                num = helper.create_global_variable(
+                    name=unique_name.generate(param.name + "_avg_num"),
+                    persistable=True,
+                    dtype="float32",
+                    shape=[1],
+                )
+                from .initializer import Constant
+
+                num_upd = helper.create_global_variable(
+                    name=unique_name.generate(param.name + "_avg_nupd"),
+                    persistable=True,
+                    dtype="float32",
+                    shape=[1],
+                )
+                helper.set_variable_initializer(psum, Constant(0.0))
+                helper.set_variable_initializer(num, Constant(0.0))
+                helper.set_variable_initializer(num_upd, Constant(0.0))
+                block.append_op(
+                    "model_average_accum",
+                    inputs={
+                        "Param": [param],
+                        "Sum": [psum],
+                        "Num": [num],
+                        "NumUpdates": [num_upd],
+                    },
+                    outputs={
+                        "SumOut": [psum],
+                        "NumOut": [num],
+                        "NumUpdatesOut": [num_upd],
+                    },
+                    attrs={
+                        "average_window_rate": float(average_window_rate),
+                        "min_average_window": int(min_average_window),
+                        "max_average_window": int(max_average_window),
+                    },
+                )
+                self._accums[param.name] = (psum, num)
+
+    def apply(self, executor, need_restore=True):
+        """Context manager: params := sum/num inside, restored after."""
+        import contextlib
+
+        from .core.scope import global_scope
+
+        outer = self
+
+        @contextlib.contextmanager
+        def ctx():
+            scope = global_scope()
+            backup = {}
+            for pname, (psum, num) in outer._accums.items():
+                backup[pname] = np.array(scope.get(pname))
+                s = np.asarray(scope.get(psum.name))
+                n = float(np.asarray(scope.get(num.name)).reshape(-1)[0])
+                if n > 0:
+                    scope.set(pname, (s / n).astype(backup[pname].dtype))
+            try:
+                yield
+            finally:
+                if need_restore:
+                    for pname, val in backup.items():
+                        scope.set(pname, val)
+
+        return ctx()
+
+    def restore(self, executor):
+        """No-op when apply() restored on exit (reference API parity)."""
+
+

@@ -1,0 +1,207 @@
+"""In-program reader state — the py_reader / double-buffer runtime.
+
+Reference: ``operators/reader/create_py_reader_op.cc`` +
+``lod_tensor_blocking_queue.h`` + ``create_double_buffer_reader_op.cc``:
+a program owns its input pipeline via reader ops; the executor's `read` op
+pops the next batch from a blocking queue that a Python thread fills, with
+a double-buffer reader prefetching to device.
+
+TPU re-expression: host IO cannot run inside a compiled XLA program, so
+the `read` op's outputs become implicit feeds that ``Executor.run``
+satisfies from this state object BEFORE invoking the compiled step.  The
+pipeline is two stages:
+
+  feeder thread:  user reader -> serialize -> native BlockingQueue
+                  (GIL-free C++ bounded queue, paddle_tpu/native)
+  stager thread:  pop -> deserialize -> jax.device_put -> small python
+                  queue of ready-on-device batches (the double buffer)
+
+so decode and H2D upload both overlap compute.  Without the native lib the
+first stage degrades to a python queue (same semantics).
+"""
+
+import pickle
+import queue
+import threading
+
+import numpy as np
+
+
+class EOFException(Exception):
+    """Raised by Executor.run when an in-program reader is exhausted
+    (fluid.core.EOFException parity); catch, then reader.reset() +
+    reader.start() for the next epoch."""
+
+
+class _EOF:
+    pass
+
+
+class ProgramReader:
+    """Runtime state behind one `read` op (keyed by reader name)."""
+
+    def __init__(self, name, out_names, shapes, dtypes, capacity=64, place=None):
+        self.name = name
+        self.out_names = list(out_names)
+        self.shapes = [list(s) for s in shapes]
+        self.dtypes = list(dtypes)
+        self.capacity = int(capacity)
+        self._place = place
+        self._gen = None
+        self._threads = []
+        self._out_q = None
+        self._nq = None
+        self._stop = threading.Event()
+        self._started = False
+
+    # ---- decoration (layers/io.py py_reader contract) -------------------
+    def decorate_paddle_reader(self, reader):
+        """reader() yields lists of row tuples (paddle.batch style)."""
+        self._gen = ("rows", reader)
+
+    decorate_sample_list_generator = decorate_paddle_reader
+
+    def decorate_batch_generator(self, generator):
+        """generator() yields feed dicts or tuples of column arrays."""
+        self._gen = ("batch", generator)
+
+    decorate_tensor_provider = decorate_batch_generator
+
+    # ---- lifecycle ------------------------------------------------------
+    def start(self):
+        if self._gen is None:
+            raise RuntimeError(
+                "py_reader '%s': call decorate_paddle_reader / "
+                "decorate_batch_generator before start()" % self.name
+            )
+        if self._started:
+            return
+        self._stop.clear()
+        self._out_q = queue.Queue(maxsize=2)  # the device double buffer
+        from ..native import available, BlockingQueue
+
+        self._nq = BlockingQueue(self.capacity) if available() else None
+        py_stage = queue.Queue(maxsize=self.capacity) if self._nq is None else None
+
+        kind, gen = self._gen
+
+        def to_columns(batch):
+            if isinstance(batch, dict):
+                return {k: np.asarray(v) for k, v in batch.items()}
+            if kind == "rows":
+                cols = list(zip(*batch))
+            else:
+                cols = list(batch)
+            return {
+                n: np.asarray(c)
+                for n, c in zip(self.out_names, cols)
+            }
+
+        def feeder():
+            try:
+                for batch in gen():
+                    cols = to_columns(batch)
+                    payload = pickle.dumps(cols, protocol=pickle.HIGHEST_PROTOCOL)
+                    while not self._stop.is_set():
+                        if self._nq is not None:
+                            if self._nq.push(payload, timeout_ms=100):
+                                break
+                        else:
+                            try:
+                                py_stage.put(payload, timeout=0.1)
+                                break
+                            except queue.Full:
+                                continue
+                    if self._stop.is_set():
+                        return
+            finally:
+                if self._nq is not None:
+                    self._nq.close()
+                else:
+                    try:
+                        py_stage.put(_EOF, timeout=1.0)
+                    except queue.Full:
+                        pass
+
+        def stager():
+            import jax
+
+            from ..places import default_place
+
+            device = (self._place or default_place()).jax_device()
+            while not self._stop.is_set():
+                if self._nq is not None:
+                    payload = self._nq.pop(timeout_ms=100)
+                    if payload is None:
+                        if self._nq.size() == 0 and not feeder_t.is_alive():
+                            break
+                        continue
+                else:
+                    try:
+                        payload = py_stage.get(timeout=0.1)
+                    except queue.Empty:
+                        if not feeder_t.is_alive():
+                            break
+                        continue
+                    if payload is _EOF:
+                        break
+                cols = pickle.loads(payload)
+                staged = {
+                    k: jax.device_put(v, device) for k, v in cols.items()
+                }
+                while not self._stop.is_set():
+                    try:
+                        self._out_q.put(staged, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+            # blocking put: the buffer may still hold staged batches the
+            # consumer hasn't drained — the EOF sentinel must not be lost
+            while not self._stop.is_set():
+                try:
+                    self._out_q.put(_EOF, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+
+        feeder_t = threading.Thread(target=feeder, daemon=True)
+        stager_t = threading.Thread(target=stager, daemon=True)
+        self._threads = [feeder_t, stager_t]
+        feeder_t.start()
+        stager_t.start()
+        self._started = True
+
+    def reset(self):
+        """Tear the pipeline down (end-of-epoch contract: catch
+        EOFException -> reset() -> start())."""
+        self._stop.set()
+        if self._nq is not None:
+            self._nq.close()
+        if self._out_q is not None:
+            try:
+                while True:
+                    self._out_q.get_nowait()
+            except queue.Empty:
+                pass
+        for t in self._threads:
+            t.join(timeout=5.0)
+        if self._nq is not None:
+            self._nq.destroy()
+            self._nq = None
+        self._threads = []
+        self._started = False
+
+    # ---- executor hook ---------------------------------------------------
+    def next_feed(self):
+        """Next ready-on-device batch as {var name: array}; raises
+        EOFException when the decorated reader is exhausted."""
+        if not self._started:
+            raise RuntimeError(
+                "py_reader '%s': start() must be called before exe.run"
+                % self.name
+            )
+        item = self._out_q.get()
+        if item is _EOF:
+            self._started = False
+            raise EOFException("py_reader '%s' exhausted" % self.name)
+        return item

@@ -1,0 +1,135 @@
+"""Analytic FLOPs accounting + chip peak lookup for MFU reporting.
+
+The reference had no MFU notion (its benchmarks report images/sec only,
+benchmark/IntelOptimizedPaddle.md); on TPU the north-star metric is model
+FLOPs utilization, so the bench harness walks the Program IR, sums the
+matmul/conv FLOPs from compile-time shapes, and divides achieved
+FLOPs/sec by the chip's peak (contrib/memory_usage_calc.py is the closest
+reference analog of this kind of static program accounting).
+"""
+
+import numpy as np
+
+__all__ = ["program_flops", "chip_peak_flops", "mfu"]
+
+
+def _shape(block, name, batch_hint):
+    v = block._find_var_recursive(name)
+    if v is None or v.shape is None:
+        return None
+    return tuple(
+        batch_hint if d in (-1, None) else int(d) for d in v.shape
+    )
+
+
+def _prod(xs):
+    out = 1
+    for x in xs:
+        out *= x
+    return out
+
+
+def program_flops(program, batch_hint=1):
+    """Analytic forward+backward FLOPs for one execution of the program.
+
+    Counts the matmul-class ops (where essentially all TPU FLOPs live:
+    conv2d, mul/fc, matmul) from IR shapes; elementwise/norm traffic is
+    bandwidth, not FLOPs, and is ignored.  Backward ops are counted as 2x
+    their forward op (the standard dL/dW + dL/dX accounting), so a training
+    program (which contains `*_grad` ops) lands at ~3x forward.
+    Unknown (-1) dims resolve to `batch_hint`.
+    """
+    total = 0.0
+    blk = program.global_block()
+    for op in blk.ops:
+        t = op.type
+        grad = False
+        if t.endswith("_grad"):
+            t = op.attrs.get("__fwd_type__", t[: -len("_grad")])
+            grad = True
+        factor = 2.0 if grad else 1.0
+        if t == "conv2d":
+            # grad ops carry the fwd output shape via the Output@GRAD input
+            out_names = (
+                op.outputs.get("Output")
+                or op.outputs.get("Out")
+                or op.inputs.get("Output@GRAD")
+                or op.inputs.get("Out@GRAD")
+                or [""]
+            )
+            out = _shape(blk, out_names[0], batch_hint)
+            flt = _shape(blk, op.inputs.get("Filter", [""])[0], batch_hint)
+            if not out or not flt or len(out) != 4 or len(flt) != 4:
+                continue
+            n, co, ho, wo = out
+            _, cin_g, kh, kw = flt
+            total += factor * 2.0 * n * co * ho * wo * cin_g * kh * kw
+        elif t == "conv2d_transpose":
+            inp = _shape(blk, op.inputs.get("Input", [""])[0], batch_hint)
+            flt = _shape(blk, op.inputs.get("Filter", [""])[0], batch_hint)
+            if not inp or not flt or len(inp) != 4 or len(flt) != 4:
+                continue
+            n, cin, hi, wi = inp
+            _, co_g, kh, kw = flt
+            total += factor * 2.0 * n * cin * hi * wi * co_g * kh * kw
+        elif t == "mul":
+            x = _shape(blk, op.inputs.get("X", [""])[0], batch_hint)
+            y = _shape(blk, op.inputs.get("Y", [""])[0], batch_hint)
+            if not x or not y:
+                continue
+            ncd = int(op.attrs.get("x_num_col_dims", 1))
+            m = _prod(x[:ncd])
+            k = _prod(x[ncd:])
+            n2 = _prod(y[1:]) if len(y) > 1 else 1
+            total += factor * 2.0 * m * k * n2
+        elif t == "matmul":
+            x = _shape(blk, op.inputs.get("X", [""])[0], batch_hint)
+            y = _shape(blk, op.inputs.get("Y", [""])[0], batch_hint)
+            if not x or not y:
+                continue
+            tx = bool(op.attrs.get("transpose_X", False))
+            ty = bool(op.attrs.get("transpose_Y", False))
+            m = x[-1] if tx else x[-2] if len(x) > 1 else 1
+            k = x[-2] if tx else x[-1]
+            n2 = y[-2] if ty else y[-1] if len(y) > 1 else 1
+            batch = _prod(x[:-2]) if len(x) > 2 else 1
+            total += factor * 2.0 * batch * m * k * n2
+    return total
+
+
+# bf16 peak FLOPs/sec per chip generation (public spec sheets)
+_PEAKS = {
+    "v2": 45e12,
+    "v3": 123e12,
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5 lite": 197e12,
+    "v5litepod": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+    "trillium": 918e12,
+}
+
+
+def chip_peak_flops(device=None):
+    """Peak bf16 FLOPs/sec of the attached chip, or None when unknown
+    (CPU fallback runs report raw throughput without an MFU claim)."""
+    import os
+
+    kind = ""
+    if device is not None:
+        kind = (getattr(device, "device_kind", "") or "").lower()
+        if getattr(device, "platform", "") == "cpu":
+            return None
+    hint = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
+    for key, peak in _PEAKS.items():
+        if key in kind or (hint and key == hint):
+            return peak
+    return None
+
+
+def mfu(flops_per_step, steps, seconds, device=None):
+    peak = chip_peak_flops(device)
+    if not peak or seconds <= 0:
+        return None
+    return flops_per_step * steps / seconds / peak

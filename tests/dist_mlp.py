@@ -139,14 +139,20 @@ def main():
     # this trainer's shard of the global batch
     shard = batch // trainers
     lo, hi = trainer_id * shard, (trainer_id + 1) * shard
+    step_sleep = float(os.environ.get("DIST_STEP_SLEEP", "0"))
     losses = []
-    for _ in range(steps):
+    for i in range(steps):
         (lv,) = exe.run(
             program=trainer_prog,
             feed={feed_x: x[lo:hi], "y": y[lo:hi]},
             fetch_list=[loss],
         )
         losses.append(float(np.asarray(lv).reshape(-1)[0]))
+        print("STEP %d" % i, flush=True)
+        if step_sleep:
+            import time
+
+            time.sleep(step_sleep)
     exe.close()  # SendComplete to pservers
     print("LOSSES " + json.dumps(losses))
 

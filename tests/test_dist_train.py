@@ -155,3 +155,106 @@ def test_dist_sparse_lookup_table_matches_local():
     local = _local_losses(steps=5, extra_env=env)
     (dist,) = _run_cluster(1, sync=True, steps=5, extra_env=env)
     np.testing.assert_allclose(dist, local, rtol=2e-4, atol=1e-5)
+
+
+_NCCL2_RUNNER = os.path.join(_DIR, "dist_nccl2.py")
+
+
+def _spawn_nccl2(env):
+    full = dict(os.environ)
+    full.update(env)
+    return subprocess.Popen(
+        [sys.executable, _NCCL2_RUNNER],
+        env=full,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+
+
+@pytest.mark.slow
+def test_nccl2_mode_2process_matches_local():
+    """nccl2 (multi-host collective DP) path: 2 localhost processes
+    bootstrap jax.distributed, psum-average grads over the cross-process
+    axis; losses match the 1-process full-batch run
+    (test_dist_base.py:34 nccl2 coverage)."""
+    port = _free_port()
+    coord = "127.0.0.1:%d" % port
+    common = {"COORDINATOR": coord, "DIST_STEPS": "4"}
+    procs = [
+        _spawn_nccl2(
+            dict(common, PADDLE_TRAINERS="2", PADDLE_TRAINER_ID=str(i))
+        )
+        for i in range(2)
+    ]
+    dist = [_losses(p, timeout=180) for p in procs]
+    # both replicas report the same (allreduced) loss
+    np.testing.assert_allclose(dist[0], dist[1], rtol=1e-6)
+
+    solo = _spawn_nccl2(
+        {
+            "COORDINATOR": "127.0.0.1:%d" % _free_port(),
+            "DIST_STEPS": "4",
+            "PADDLE_TRAINERS": "1",
+            "PADDLE_TRAINER_ID": "0",
+        }
+    )
+    local = _losses(solo, timeout=180)
+    np.testing.assert_allclose(dist[0], local, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.slow
+def test_pserver_checkpoint_kill_and_restart(tmp_path):
+    """Fault tolerance (go/pserver service.go:346 capability): async
+    pserver checkpoints every round; killing it mid-training and
+    restarting recovers from the snapshot (PSERVER RESTORED) and the
+    trainer — whose RPC layer retries through the outage — finishes all
+    steps with finite losses."""
+    port = _free_port()
+    eps = "127.0.0.1:%d" % port
+    ckpt = str(tmp_path / "ckpt")
+    common = {
+        "PADDLE_PSERVER_EPS": eps,
+        "PADDLE_TRAINERS": "1",
+        "DIST_SYNC_MODE": "0",
+        "DIST_STEPS": "14",
+        "DIST_STEP_SLEEP": "0.4",
+        "PADDLE_PSERVER_CKPT_DIR": ckpt,
+        "PADDLE_PSERVER_CKPT_EVERY": "1",
+        "FLAGS_max_retry": "200",
+    }
+    ps_env = dict(
+        common,
+        PADDLE_TRAINING_ROLE="PSERVER",
+        PADDLE_CURRENT_ENDPOINT=eps,
+    )
+    ps1 = _spawn(ps_env)
+    try:
+        _wait_port(port)
+        trainer = _spawn(
+            dict(common, PADDLE_TRAINING_ROLE="TRAINER", PADDLE_TRAINER_ID="0")
+        )
+        # wait until real progress exists: the first shard snapshot on disk
+        ckpt_file = os.path.join(ckpt, "pserver_0.ckpt")
+        t0 = time.time()
+        while time.time() - t0 < 90 and not os.path.exists(ckpt_file):
+            time.sleep(0.2)
+        assert os.path.exists(ckpt_file), "no checkpoint written before kill"
+        time.sleep(0.5)  # let a couple more rounds land
+        ps1.kill()
+        ps1.wait()
+        # restart on the same endpoint; must restore from the snapshot
+        ps2 = _spawn(ps_env)
+        try:
+            losses = _losses(trainer, timeout=240)
+            assert len(losses) == 14
+            assert np.isfinite(losses).all()
+            assert losses[-1] < losses[0]
+            out, err = ps2.communicate(timeout=90)
+            assert "PSERVER RESTORED" in out, (out, err)
+        finally:
+            if ps2.poll() is None:
+                ps2.kill()
+    finally:
+        if ps1.poll() is None:
+            ps1.kill()

@@ -105,3 +105,77 @@ def test_profiler_records(tmp_path):
         trace = json.load(f)
     names = {e["name"] for e in trace["traceEvents"]}
     assert "executor_run" in names
+
+
+def test_in_program_py_reader_epochs_and_eof():
+    """py_reader as program ops: read_file outputs feed the compiled step,
+    EOFException fires at exhaustion, reset()+start() gives a new epoch
+    (layers/io.py:635 + create_py_reader_op.cc contract)."""
+    reader = layers.py_reader(
+        capacity=8, shapes=[[-1, 10], [-1, 1]], dtypes=["float32", "int64"]
+    )
+    img, label = layers.read_file(reader)
+    pred = layers.fc(img, 4, act="softmax")
+    loss = layers.mean(layers.cross_entropy(pred, label))
+    fluid.optimizer.SGD(0.1).minimize(loss)
+
+    rng = np.random.RandomState(0)
+
+    def gen():
+        for i in range(5):
+            yield [
+                (rng.rand(10).astype("float32"), np.array([i % 4], "int64"))
+                for _ in range(8)
+            ]
+
+    reader.decorate_paddle_reader(lambda: gen())
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    for epoch in range(3):
+        reader.start()
+        n = 0
+        while True:
+            try:
+                exe.run(fetch_list=[loss])
+                n += 1
+            except fluid.core.EOFException:
+                reader.reset()
+                break
+        assert n == 5, n
+
+
+def test_py_reader_start_before_decorate_raises():
+    reader = layers.py_reader(capacity=4, shapes=[[-1, 3]], dtypes=["float32"])
+    import pytest
+
+    with pytest.raises(RuntimeError, match="decorate"):
+        reader.start()
+
+
+def test_program_flops_resnet_matches_known_count():
+    """Analytic FLOPs: ResNet-50 @224 is ~7.7 GFLOPs forward (2x MACs),
+    ~23 GFLOPs for a training step."""
+    from paddle_tpu.models.resnet import build_resnet_train_program
+    from paddle_tpu.utils import flops as fu
+
+    main, _, _, _ = build_resnet_train_program(
+        image_shape=(3, 224, 224), class_dim=1000, depth=50, lr=0.1
+    )
+    per_img = fu.program_flops(main, batch_hint=8) / 8
+    assert 20e9 < per_img < 26e9, per_img
+
+
+def test_chip_peak_flops_lookup():
+    from paddle_tpu.utils import flops as fu
+
+    class FakeDev:
+        platform = "tpu"
+        device_kind = "TPU v5 lite"
+
+    assert fu.chip_peak_flops(FakeDev()) == 197e12
+
+    class CpuDev:
+        platform = "cpu"
+        device_kind = "cpu"
+
+    assert fu.chip_peak_flops(CpuDev()) is None
