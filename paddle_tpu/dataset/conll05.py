@@ -71,8 +71,93 @@ def get_embedding():
     return rng.normal(0, 0.1, (len(st["word"]), 32)).astype("float32")
 
 
+def _parse_props_corpus(words_path, props_path):
+    """CoNLL-2005 words+props format -> one (tokens, pred_pos, iob_labels)
+    sample per (sentence, predicate).  Props columns hold span-parenthesis
+    tags like '(A0*', '*', '*)' per predicate."""
+
+    def lines(path):
+        op = gzip.open if path.endswith(".gz") else open
+        with op(path, "rt") as f:
+            sent = []
+            for ln in f:
+                ln = ln.strip()
+                if not ln:
+                    if sent:
+                        yield sent
+                        sent = []
+                    continue
+                sent.append(ln.split())
+            if sent:
+                yield sent
+
+    for wsent, psent in zip(lines(words_path), lines(props_path)):
+        tokens = [w[0] for w in wsent]
+        n_pred = len(psent[0]) - 1
+        preds = [row[0] for row in psent]
+        for k in range(n_pred):
+            labels = []
+            cur = None
+            pred_pos = 0
+            for i, row in enumerate(psent):
+                tag = row[1 + k]
+                if tag.startswith("("):
+                    cur = tag.strip("()*")
+                    labels.append("B-" + cur)
+                    if cur == "V":
+                        pred_pos = i
+                elif cur is not None:
+                    labels.append("I-" + cur)
+                else:
+                    labels.append("O")
+                if tag.endswith(")"):
+                    cur = None
+            if preds[pred_pos] == "-" and "V" not in [l[2:] for l in labels]:
+                continue
+            yield tokens, pred_pos, labels
+
+
 def test():
-    """Reader over (word, 5 ctx windows, verb, mark, label) id sequences."""
+    """Reader over (word, 5 ctx windows, verb, mark, label) id sequences.
+    Parses the real corpus (test.wsj.words + test.wsj.props under
+    DATA_HOME/conll05st/) when present; synthetic otherwise."""
+
+    st = _load()
+    words_path = None
+    for cand in ("test.wsj.words", "test.wsj.words.gz"):
+        p = common.data_path("conll05st", cand)
+        if os.path.exists(p):
+            words_path = p
+            break
+    if words_path is not None:
+        props_path = words_path.replace(".words", ".props")
+
+        def reader():
+            wd, vd, ld = st["word"], st["verb"], st["label"]
+            for tokens, pred_pos, labels in _parse_props_corpus(
+                words_path, props_path
+            ):
+                n = len(tokens)
+                ids = [wd.get(t.lower(), UNK_IDX) for t in tokens]
+
+                def ctx(off):
+                    j = pred_pos + off
+                    return ids[j] if 0 <= j < n else UNK_IDX
+
+                verb = vd.get(tokens[pred_pos].lower(), 0)
+                yield (
+                    ids,
+                    [ctx(-2)] * n,
+                    [ctx(-1)] * n,
+                    [ctx(0)] * n,
+                    [ctx(1)] * n,
+                    [ctx(2)] * n,
+                    [verb] * n,
+                    [1 if i == pred_pos else 0 for i in range(n)],
+                    [ld.get(l, 0) for l in labels],
+                )
+
+        return reader
 
     def reader():
         st = _load()

@@ -24,17 +24,20 @@ def _real_reader(split_key):
     def reader():
         from scipy.io import loadmat
 
+        try:
+            from PIL import Image
+        except ImportError as e:  # fail loudly, not a silent empty epoch
+            raise RuntimeError(
+                "flowers: real data found under %s but Pillow is not "
+                "installed (needed to decode jpgs)" % base
+            ) from e
+
         labels = loadmat(os.path.join(base, "imagelabels.mat"))["labels"][0]
         setid = loadmat(os.path.join(base, "setid.mat"))
         ids = setid[split_key][0]
         for i in ids:
             path = os.path.join(base, "jpg", "image_%05d.jpg" % i)
-            try:
-                from PIL import Image
-
-                img = np.asarray(Image.open(path), dtype="float32") / 255.0
-            except ImportError:
-                continue
+            img = np.asarray(Image.open(path), dtype="float32") / 255.0
             yield img.transpose(2, 0, 1).ravel(), int(labels[i - 1]) - 1
 
     return reader

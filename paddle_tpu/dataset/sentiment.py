@@ -32,6 +32,9 @@ def _load():
             for fn in sorted(os.listdir(d)):
                 with open(os.path.join(d, fn), errors="ignore") as f:
                     docs.append((f.read().lower().split(), label))
+        # deterministic shuffle so the index-based train/test split mixes
+        # classes (raw layout is all-pos-then-all-neg)
+        np.random.RandomState(2388).shuffle(docs)
     else:
         common.synthetic_note("sentiment")
         rng = np.random.RandomState(17)

@@ -38,12 +38,21 @@ def _load(dict_size):
             return d
 
         src_dict, trg_dict = rd("src.dict"), rd("trg.dict")
-        pairs = []
-        with open(os.path.join(base, "train"), encoding="utf-8") as f:
-            for ln in f:
-                parts = ln.rstrip("\n").split("\t")
-                if len(parts) == 2:
-                    pairs.append((parts[0].split(), parts[1].split()))
+
+        def rd_pairs(fn):
+            out = []
+            path = os.path.join(base, fn)
+            if not os.path.exists(path):
+                return None
+            with open(path, encoding="utf-8") as f:
+                for ln in f:
+                    parts = ln.rstrip("\n").split("\t")
+                    if len(parts) == 2:
+                        out.append((parts[0].split(), parts[1].split()))
+            return out
+
+        pairs = rd_pairs("train") or []
+        test_pairs = rd_pairs("test")  # real held-out set when shipped
     else:
         common.synthetic_note("wmt14")
         src_dict = {START: 0, END: 1, UNK: 2}
@@ -60,16 +69,26 @@ def _load(dict_size):
             s = [inv_s[int(rng.randint(3, len(inv_s)))] for _ in range(n)]
             t = [inv_t[int(rng.randint(3, len(inv_t)))] for _ in range(n)]
             pairs.append((s, t))
-    _state[key] = (src_dict, trg_dict, pairs)
+        test_pairs = None
+    _state[key] = (src_dict, trg_dict, pairs, test_pairs)
     return _state[key]
 
 
 def _reader(dict_size, is_test):
     def reader():
-        src_dict, trg_dict, pairs = _load(dict_size)
-        for i, (s, t) in enumerate(pairs):
-            if (i % 10 == 0) != is_test:
-                continue
+        src_dict, trg_dict, pairs, test_pairs = _load(dict_size)
+        if test_pairs is not None:
+            # real split files: train serves the whole train file, test the
+            # shipped held-out set (no leakage)
+            it = test_pairs if is_test else pairs
+            split = ((s, t) for s, t in it)
+        else:
+            split = (
+                (s, t)
+                for i, (s, t) in enumerate(pairs)
+                if (i % 10 == 0) == is_test
+            )
+        for s, t in split:
             src_ids = [src_dict.get(w, UNK_ID) for w in s]
             t_ids = [trg_dict.get(w, UNK_ID) for w in t]
             yield src_ids, [START_ID] + t_ids, t_ids + [END_ID]
@@ -87,7 +106,7 @@ def test(dict_size=30000):
 
 def get_dict(dict_size, reverse=False):
     """(src_dict, trg_dict); reverse=True flips to id->word."""
-    src_dict, trg_dict, _ = _load(dict_size)
+    src_dict, trg_dict, _pairs, _test = _load(dict_size)
     if reverse:
         src_dict = {v: k for k, v in src_dict.items()}
         trg_dict = {v: k for k, v in trg_dict.items()}

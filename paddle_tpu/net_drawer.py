@@ -13,11 +13,15 @@ __all__ = ["draw_graph"]
 
 
 def draw_graph(startup_program, main_program, graph_path="./graph.dot", **kwargs):
+    import os
+
+    base, ext = os.path.splitext(graph_path)
+    ext = ext or ".dot"
     paths = []
     for tag, prog in (("startup", startup_program), ("main", main_program)):
         if prog is None:
             continue
-        path = graph_path.replace(".dot", ".%s.dot" % tag)
+        path = "%s.%s%s" % (base, tag, ext)
         draw_block_graphviz(prog.global_block(), path=path)
         paths.append(path)
     return paths

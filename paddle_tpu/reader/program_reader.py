@@ -53,6 +53,7 @@ class ProgramReader:
         self._nq = None
         self._stop = threading.Event()
         self._started = False
+        self._error = None  # pipeline-thread exception, re-raised in next_feed
 
     # ---- decoration (layers/io.py py_reader contract) -------------------
     def decorate_paddle_reader(self, reader):
@@ -77,6 +78,7 @@ class ProgramReader:
         if self._started:
             return
         self._stop.clear()
+        self._error = None
         self._out_q = queue.Queue(maxsize=2)  # the device double buffer
         from ..native import available, BlockingQueue
 
@@ -98,22 +100,30 @@ class ProgramReader:
             }
 
         def feeder():
+            # serialization is only for the native (byte) queue; the
+            # python-queue fallback passes column dicts directly
             try:
                 for batch in gen():
                     cols = to_columns(batch)
-                    payload = pickle.dumps(cols, protocol=pickle.HIGHEST_PROTOCOL)
+                    item = (
+                        pickle.dumps(cols, protocol=pickle.HIGHEST_PROTOCOL)
+                        if self._nq is not None
+                        else cols
+                    )
                     while not self._stop.is_set():
                         if self._nq is not None:
-                            if self._nq.push(payload, timeout_ms=100):
+                            if self._nq.push(item, timeout_ms=100):
                                 break
                         else:
                             try:
-                                py_stage.put(payload, timeout=0.1)
+                                py_stage.put(item, timeout=0.1)
                                 break
                             except queue.Full:
                                 continue
                     if self._stop.is_set():
                         return
+            except Exception as e:  # surface to the training loop, not a
+                self._error = e  # silent truncated epoch
             finally:
                 if self._nq is not None:
                     self._nq.close()
@@ -124,45 +134,51 @@ class ProgramReader:
                         pass
 
         def stager():
-            import jax
+            try:
+                import jax
 
-            from ..places import default_place
+                from ..places import default_place
 
-            device = (self._place or default_place()).jax_device()
-            while not self._stop.is_set():
-                if self._nq is not None:
-                    payload = self._nq.pop(timeout_ms=100)
-                    if payload is None:
-                        if self._nq.size() == 0 and not feeder_t.is_alive():
+                device = (self._place or default_place()).jax_device()
+                while not self._stop.is_set():
+                    if self._nq is not None:
+                        payload = self._nq.pop(timeout_ms=100)
+                        if payload is None:
+                            if self._nq.size() == 0 and not feeder_t.is_alive():
+                                break
+                            continue
+                        cols = pickle.loads(payload)
+                    else:
+                        try:
+                            cols = py_stage.get(timeout=0.1)
+                        except queue.Empty:
+                            if not feeder_t.is_alive():
+                                break
+                            continue
+                        if cols is _EOF:
                             break
-                        continue
-                else:
-                    try:
-                        payload = py_stage.get(timeout=0.1)
-                    except queue.Empty:
-                        if not feeder_t.is_alive():
+                    staged = {
+                        k: jax.device_put(v, device) for k, v in cols.items()
+                    }
+                    while not self._stop.is_set():
+                        try:
+                            self._out_q.put(staged, timeout=0.1)
                             break
-                        continue
-                    if payload is _EOF:
-                        break
-                cols = pickle.loads(payload)
-                staged = {
-                    k: jax.device_put(v, device) for k, v in cols.items()
-                }
+                        except queue.Full:
+                            continue
+            except Exception as e:
+                self._error = e
+            finally:
+                # blocking put: the buffer may still hold staged batches the
+                # consumer hasn't drained — the EOF sentinel must not be
+                # lost, INCLUDING on the exception path (a dead stager with
+                # no sentinel would hang the executor forever)
                 while not self._stop.is_set():
                     try:
-                        self._out_q.put(staged, timeout=0.1)
+                        self._out_q.put(_EOF, timeout=0.1)
                         break
                     except queue.Full:
                         continue
-            # blocking put: the buffer may still hold staged batches the
-            # consumer hasn't drained — the EOF sentinel must not be lost
-            while not self._stop.is_set():
-                try:
-                    self._out_q.put(_EOF, timeout=0.1)
-                    break
-                except queue.Full:
-                    continue
 
         feeder_t = threading.Thread(target=feeder, daemon=True)
         stager_t = threading.Thread(target=stager, daemon=True)
@@ -203,5 +219,10 @@ class ProgramReader:
         item = self._out_q.get()
         if item is _EOF:
             self._started = False
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise RuntimeError(
+                    "py_reader '%s' pipeline failed" % self.name
+                ) from err
             raise EOFException("py_reader '%s' exhausted" % self.name)
         return item

@@ -179,3 +179,26 @@ def test_chip_peak_flops_lookup():
         device_kind = "cpu"
 
     assert fu.chip_peak_flops(CpuDev()) is None
+
+
+def test_py_reader_pipeline_error_surfaces():
+    """A generator exception must surface as an error, not a silent short
+    epoch (the reader records it and next_feed re-raises)."""
+    reader = layers.py_reader(capacity=4, shapes=[[-1, 3]], dtypes=["float32"])
+    (x,) = [layers.read_file(reader)]
+    out = layers.scale(x, 2.0)
+
+    def bad_gen():
+        yield [(np.ones(3, "float32"),)]
+        raise ValueError("boom in generator")
+
+    reader.decorate_paddle_reader(lambda: bad_gen())
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    reader.start()
+    exe.run(fetch_list=[out])  # first batch ok
+    import pytest
+
+    with pytest.raises(RuntimeError, match="pipeline failed"):
+        while True:
+            exe.run(fetch_list=[out])
