@@ -21,6 +21,12 @@ Typical use (same shape as fluid):
     exe.run(feed={...}, fetch_list=[loss])
 """
 
+# memory-fraction knob must land in the environment BEFORE any jax backend
+# init (see memory.apply_memory_fraction)
+from .memory import apply_memory_fraction as _amf
+
+_amf()
+
 from . import ops  # registers all op lowerings first
 from . import (
     average,
@@ -34,8 +40,10 @@ from . import (
     distributed,
     framework,
     inference,
+    device_info,
     initializer,
     layers,
+    memory,
     lod,
     metrics,
     nets,

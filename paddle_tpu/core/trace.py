@@ -22,6 +22,11 @@ import jax.numpy as jnp
 from .registry import LowerCtx, get_op, lower_grad_op
 
 
+class _TraceContextError(RuntimeError):
+    """Lowering failure annotated with op/block/shape context
+    (PADDLE_ENFORCE error-context discipline, platform/enforce.h)."""
+
+
 class TracedFunction:
     def __init__(self, fn, feed_names, ro_names, rw_names, fetch_names, updated):
         self.fn = fn
@@ -278,11 +283,28 @@ def build_traced_function(program, block_idx, feed_names, fetch_names, scope):
                             )
                         vals.append(env[n])
                     ins[slot] = vals
-                if op.type.endswith("_grad") and "__fwd_type__" in op.attrs:
-                    outs = lower_grad_op(ctx, op, ins, op.attrs)
-                else:
-                    opdef = get_op(op.type)
-                    outs = opdef.lower(ctx, ins, op.attrs)
+                try:
+                    if op.type.endswith("_grad") and "__fwd_type__" in op.attrs:
+                        outs = lower_grad_op(ctx, op, ins, op.attrs)
+                    else:
+                        opdef = get_op(op.type)
+                        outs = opdef.lower(ctx, ins, op.attrs)
+                except Exception as e:
+                    # PADDLE_ENFORCE-style error context (enforce.h): name
+                    # the op and its inputs so a shape/dtype error inside a
+                    # compiled block is attributable without reading XLA
+                    # internals.  Tracer-context errors pass through.
+                    if isinstance(e, _TraceContextError):
+                        raise
+                    shapes = {
+                        slot: [getattr(v, "shape", "?") for v in vals]
+                        for slot, vals in ins.items()
+                    }
+                    raise _TraceContextError(
+                        "while lowering op '%s' (block %d, op %d) with input "
+                        "shapes %s: %s: %s"
+                        % (op.type, bidx, idx, shapes, type(e).__name__, e)
+                    ) from e
                 for slot, names in op.outputs.items():
                     vals = outs.get(slot)
                     if vals is None:

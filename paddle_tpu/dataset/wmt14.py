@@ -51,7 +51,11 @@ def _load(dict_size):
                         out.append((parts[0].split(), parts[1].split()))
             return out
 
-        pairs = rd_pairs("train") or []
+        pairs = rd_pairs("train")
+        if pairs is None:  # dicts without corpus = broken download: be loud
+            raise FileNotFoundError(
+                "wmt14: dictionaries found under %s but no 'train' file" % base
+            )
         test_pairs = rd_pairs("test")  # real held-out set when shipped
     else:
         common.synthetic_note("wmt14")

@@ -86,7 +86,9 @@ DEFINE_flag("eager_delete_tensor_gb", -1.0,
             "compat no-op: XLA frees temps inside the step; rw state is "
             "donated unconditionally")
 DEFINE_flag("fraction_of_gpu_memory_to_use", 0.92,
-            "accepted for compatibility; HBM budgeting is PJRT's")
+            "HBM budget fraction: forwarded to the XLA client allocator "
+            "(memory.apply_memory_fraction) when set via FLAGS_... env "
+            "before the first backend init")
 DEFINE_flag("init_allocated_mem", False, "compat no-op under XLA")
 DEFINE_flag("free_idle_memory", False, "compat no-op under XLA")
 DEFINE_flag("paddle_num_threads", 1, "compat no-op (XLA owns threading)")

@@ -72,6 +72,38 @@ def create_lod_tensor(data, recursive_seq_lens=None, place=None):
             out[i, : len(s)] = s
         return LoDTensor(out, [lengths_to_offsets(lens)])
     data = np.asarray(data)
+    if recursive_seq_lens and len(recursive_seq_lens) == 2:
+        # nested (2-level) LoD: [doc -> #sentences, sentence -> #tokens]
+        # padded as [docs, max_sents, max_toks, *feat] + both length arrays
+        # (the re-expression of lod_tensor.h nested offsets; deeper nesting
+        # composes the same way)
+        doc_lens = list(recursive_seq_lens[0])
+        tok_lens = list(recursive_seq_lens[1])
+        assert sum(doc_lens) == len(tok_lens), (
+            "level-0 lengths must sum to the number of level-1 sequences"
+        )
+        max_sents = max(doc_lens) if doc_lens else 0
+        max_toks = max(tok_lens) if tok_lens else 0
+        feat = data.shape[1:]
+        out = np.zeros(
+            (len(doc_lens), max_sents, max_toks) + tuple(feat), dtype=data.dtype
+        )
+        tok_pad = np.zeros((len(doc_lens), max_sents), np.int32)
+        ofs = 0
+        si = 0
+        for d, nsent in enumerate(doc_lens):
+            for s in range(nsent):
+                tl = tok_lens[si]
+                out[d, s, :tl] = data[ofs:ofs + tl]
+                tok_pad[d, s] = tl
+                ofs += tl
+                si += 1
+        t = LoDTensor(
+            out,
+            [lengths_to_offsets(doc_lens), lengths_to_offsets(tok_lens)],
+        )
+        t.nested_seq_lens = tok_pad  # [docs, max_sents] per-sentence lengths
+        return t
     if recursive_seq_lens:
         lens = list(recursive_seq_lens[-1])
         max_len = max(lens)

@@ -1,0 +1,82 @@
+"""HBM budget/stats layer (paddle/fluid/memory/ §2.8 re-expression).
+
+The reference owns allocation through a BuddyAllocator with knobs like
+``FLAGS_fraction_of_gpu_memory_to_use`` (detail/system_allocator.cc).  On
+TPU, PJRT owns HBM — what survives is the *knob surface* and the *stats
+surface*:
+
+- ``apply_memory_fraction()`` translates the reference's memory-fraction
+  flag into XLA's client allocator budget (must run before backend init —
+  paddle_tpu/__init__ calls it on import).
+- ``memory_stats`` / ``memory_allocated`` / ``max_memory_allocated`` read
+  PJRT's live allocator counters (the memory::Used analog).
+- eager deletion (FLAGS_eager_delete_tensor_gb) is subsumed by buffer
+  donation + XLA liveness (core/trace.py donates rw state).
+"""
+
+import os
+
+__all__ = [
+    "apply_memory_fraction",
+    "memory_stats",
+    "memory_allocated",
+    "max_memory_allocated",
+    "memory_limit",
+]
+
+
+def apply_memory_fraction():
+    """FLAGS_fraction_of_gpu_memory_to_use -> XLA client mem fraction.
+
+    Reads the flag from the environment (FLAGS_... / PADDLE_TPU_FLAGS)
+    because it must take effect BEFORE the first jax backend init; a
+    fraction <= 0 keeps XLA's default behavior."""
+    frac = os.environ.get("FLAGS_fraction_of_gpu_memory_to_use")
+    if not frac:
+        # PADDLE_TPU_FLAGS batch form: "--fraction_of_gpu_memory_to_use=0.5"
+        for tok in os.environ.get("PADDLE_TPU_FLAGS", "").split():
+            if tok.startswith("--fraction_of_gpu_memory_to_use="):
+                frac = tok.split("=", 1)[1]
+                break
+    if not frac:
+        return
+    try:
+        val = float(frac)
+    except ValueError:
+        return
+    if 0.0 < val <= 1.0:
+        os.environ.setdefault("XLA_PYTHON_CLIENT_MEM_FRACTION", str(val))
+
+
+def _device(place=None):
+    if place is not None:
+        return place.jax_device()
+    import jax
+
+    return jax.devices()[0]
+
+
+def memory_stats(place=None):
+    """Raw PJRT allocator stats dict (bytes_in_use, peak_bytes_in_use,
+    bytes_limit, ...); {} when the backend exposes none (CPU)."""
+    d = _device(place)
+    try:
+        return dict(d.memory_stats() or {})
+    except Exception:
+        return {}
+
+
+def memory_allocated(place=None):
+    """Live allocated bytes on the device (memory::Used analog)."""
+    return int(memory_stats(place).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(place=None):
+    """High-water allocated bytes since process start."""
+    stats = memory_stats(place)
+    return int(stats.get("peak_bytes_in_use", stats.get("bytes_in_use", 0)))
+
+
+def memory_limit(place=None):
+    """Allocator budget in bytes (0 when unknown)."""
+    return int(memory_stats(place).get("bytes_limit", 0))

@@ -77,6 +77,12 @@ class ProgramReader:
             )
         if self._started:
             return
+        # ensure any previous epoch's threads have fully exited before the
+        # stop flag is cleared (an orphan feeder must not feed this epoch)
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5.0)
+        self._threads = []
         self._stop.clear()
         self._error = None
         self._out_q = queue.Queue(maxsize=2)  # the device double buffer
@@ -219,6 +225,12 @@ class ProgramReader:
         item = self._out_q.get()
         if item is _EOF:
             self._started = False
+            # stop surviving pipeline threads (on the error path the feeder
+            # may still be alive pushing stale batches; a later start()
+            # must not inherit them)
+            self._stop.set()
+            if self._nq is not None:
+                self._nq.close()
             if self._error is not None:
                 err, self._error = self._error, None
                 raise RuntimeError(

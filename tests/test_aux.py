@@ -160,3 +160,69 @@ def test_bf16_amp_rewrite_trains_and_matches_f32():
     # and the rewritten program actually contains bf16 casts
     types = [op.type for op in fluid.default_main_program().global_block().ops]
     assert types.count("cast") >= 4
+
+
+def test_memory_and_device_info_surfaces():
+    """HBM stats + device info layer (SURVEY §2.7/§2.8 re-expression)."""
+    import paddle_tpu as fluid
+
+    assert fluid.device_info.cpu_count() >= 1
+    assert fluid.device_info.device_count() >= 1
+    assert isinstance(fluid.device_info.device_kind(), str)
+    stats = fluid.memory.memory_stats()
+    assert isinstance(stats, dict)
+    assert fluid.memory.memory_allocated() >= 0
+    assert fluid.memory.max_memory_allocated() >= fluid.memory.memory_allocated() or not stats
+
+
+def test_memory_fraction_env_wiring(monkeypatch):
+    import paddle_tpu.memory as mem
+
+    monkeypatch.delenv("XLA_PYTHON_CLIENT_MEM_FRACTION", raising=False)
+    monkeypatch.setenv("FLAGS_fraction_of_gpu_memory_to_use", "0.5")
+    mem.apply_memory_fraction()
+    import os
+
+    assert os.environ["XLA_PYTHON_CLIENT_MEM_FRACTION"] == "0.5"
+
+
+def test_lowering_error_carries_op_context():
+    """enforce.h-style error context: a shape error inside the compiled
+    block names the op, block index, and input shapes."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    x = layers.data("ec_x", shape=[3, 4], append_batch_size=False)
+    y = layers.data("ec_y", shape=[5, 6], append_batch_size=False)
+    out = layers.matmul(x, y)
+    exe = fluid.Executor(fluid.CPUPlace())
+    import pytest
+
+    with pytest.raises(RuntimeError, match="lowering op 'matmul'.*shapes"):
+        exe.run(
+            feed={
+                "ec_x": np.ones((3, 4), "float32"),
+                "ec_y": np.ones((5, 6), "float32"),
+            },
+            fetch_list=[out],
+        )
+
+
+def test_nested_lod_two_levels():
+    """2-level LoD: [doc -> sents -> tokens] padded to
+    [docs, max_sents, max_toks] with per-sentence lengths."""
+    import numpy as np
+    from paddle_tpu.lod import create_lod_tensor
+
+    data = np.arange(10, dtype="float32").reshape(10, 1)
+    # doc0 has 2 sentences (3 + 2 tokens), doc1 has 1 sentence (5 tokens)
+    t = create_lod_tensor(data, recursive_seq_lens=[[2, 1], [3, 2, 5]])
+    assert t.lod_level() == 2
+    assert t.data.shape == (2, 2, 5, 1)
+    np.testing.assert_array_equal(t.nested_seq_lens, [[3, 2], [5, 0]])
+    np.testing.assert_allclose(t.data[0, 0, :3, 0], [0, 1, 2])
+    np.testing.assert_allclose(t.data[0, 1, :2, 0], [3, 4])
+    np.testing.assert_allclose(t.data[1, 0, :, 0], [5, 6, 7, 8, 9])
+    np.testing.assert_array_equal(t.seq_lens(0), [2, 1])
+    np.testing.assert_array_equal(t.seq_lens(1), [3, 2, 5])

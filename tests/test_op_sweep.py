@@ -859,17 +859,24 @@ adamax adadelta decayed_adagrad rmsprop ftrl lars_momentum
 
 
 def test_sweep_coverage_target():
-    """>= 200 registered ops have direct test coverage (VERDICT item 4)."""
+    """>= 200 registered ops have direct test coverage (VERDICT item 4).
+
+    Order-independent: op names are read statically from this module's
+    check()/probe() call sites plus the family tables, so the floor holds
+    under random/parallel test scheduling too."""
+    import os
+    import re
+
     from paddle_tpu.core.registry import OPS
 
-    # every case in this module ran before this test (alphabetical order
-    # puts test_sweep_coverage_target last within the file on -p no:randomly,
-    # but recompute defensively by simulating the tables)
+    src = open(os.path.abspath(__file__)).read()
+    called = set(
+        re.findall(r'(?:check|probe)\(\s*\n?\s*"([a-z0-9_]+)"', src)
+    )
     table_ops = (
         set(UNARY) | set(BINARY) | set(COMPARE) | set(LOGICAL) | set(REDUCE)
     )
-    direct = set(COVERED) | table_ops | set(COVERED_ELSEWHERE)
-    direct &= set(OPS)
+    direct = (set(COVERED) | called | table_ops | set(COVERED_ELSEWHERE)) & set(OPS)
     missing = sorted(set(OPS) - direct)
     assert len(direct) >= 200, (
         "only %d ops directly tested; missing e.g. %s"
