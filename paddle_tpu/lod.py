@@ -72,6 +72,12 @@ def create_lod_tensor(data, recursive_seq_lens=None, place=None):
             out[i, : len(s)] = s
         return LoDTensor(out, [lengths_to_offsets(lens)])
     data = np.asarray(data)
+    if recursive_seq_lens and len(recursive_seq_lens) > 2:
+        raise NotImplementedError(
+            "create_lod_tensor supports up to 2 LoD levels on TPU "
+            "(got %d); flatten the outer nesting or pad by hand"
+            % len(recursive_seq_lens)
+        )
     if recursive_seq_lens and len(recursive_seq_lens) == 2:
         # nested (2-level) LoD: [doc -> #sentences, sentence -> #tokens]
         # padded as [docs, max_sents, max_toks, *feat] + both length arrays
@@ -79,9 +85,16 @@ def create_lod_tensor(data, recursive_seq_lens=None, place=None):
         # composes the same way)
         doc_lens = list(recursive_seq_lens[0])
         tok_lens = list(recursive_seq_lens[1])
-        assert sum(doc_lens) == len(tok_lens), (
-            "level-0 lengths must sum to the number of level-1 sequences"
-        )
+        if sum(doc_lens) != len(tok_lens):
+            raise ValueError(
+                "level-0 lengths sum to %d but there are %d level-1 "
+                "sequences" % (sum(doc_lens), len(tok_lens))
+            )
+        if sum(tok_lens) != len(data):
+            raise ValueError(
+                "level-1 token lengths sum to %d but data has %d rows"
+                % (sum(tok_lens), len(data))
+            )
         max_sents = max(doc_lens) if doc_lens else 0
         max_toks = max(tok_lens) if tok_lens else 0
         feat = data.shape[1:]

@@ -32,12 +32,11 @@ def apply_memory_fraction():
     because it must take effect BEFORE the first jax backend init; a
     fraction <= 0 keeps XLA's default behavior."""
     frac = os.environ.get("FLAGS_fraction_of_gpu_memory_to_use")
-    if not frac:
-        # PADDLE_TPU_FLAGS batch form: "--fraction_of_gpu_memory_to_use=0.5"
-        for tok in os.environ.get("PADDLE_TPU_FLAGS", "").split():
-            if tok.startswith("--fraction_of_gpu_memory_to_use="):
-                frac = tok.split("=", 1)[1]
-                break
+    # PADDLE_TPU_FLAGS batch form overrides the single-var form — the same
+    # precedence flags.py applies (_parse_batch_env runs last there)
+    for tok in os.environ.get("PADDLE_TPU_FLAGS", "").split():
+        if tok.startswith("--fraction_of_gpu_memory_to_use="):
+            frac = tok.split("=", 1)[1]
     if not frac:
         return
     try:

@@ -78,11 +78,21 @@ class ProgramReader:
         if self._started:
             return
         # ensure any previous epoch's threads have fully exited before the
-        # stop flag is cleared (an orphan feeder must not feed this epoch)
+        # stop flag is cleared (an orphan feeder must not feed this epoch);
+        # an un-joinable thread (generator blocked in IO) keeps _stop set
         self._stop.set()
         for t in self._threads:
             t.join(timeout=5.0)
+            if t.is_alive():
+                raise RuntimeError(
+                    "py_reader '%s': previous epoch's pipeline thread is "
+                    "still running (generator blocked?); cannot restart"
+                    % self.name
+                )
         self._threads = []
+        if self._nq is not None:  # free the previous epoch's native queue
+            self._nq.destroy()
+            self._nq = None
         self._stop.clear()
         self._error = None
         self._out_q = queue.Queue(maxsize=2)  # the device double buffer

@@ -211,3 +211,228 @@ def test_se_resnext_forward_backward():
     for _ in range(4):
         l1 = float(np.ravel(exe.run(feed=feed, fetch_list=[loss])[0])[0])
     assert np.isfinite(l1) and l1 < l0
+
+
+def test_label_semantic_roles_crf_trains():
+    """book/test_label_semantic_roles: SRL tagger — per-feature embeddings
+    -> fc -> bidirectional GRU -> linear_chain_crf loss -> crf_decoding,
+    fed from the conll05 loader (padded ragged batches)."""
+    from paddle_tpu.dataset import conll05
+
+    word_dict, verb_dict, label_dict = conll05.get_dict()
+    V, NV, NL, T, B, H = len(word_dict), len(verb_dict), len(label_dict), 12, 8, 16
+
+    feats = []
+    for name in ("word", "ctxn1", "ctx0", "ctxp1", "verb"):
+        feats.append(layers.data(name, shape=[B, T], append_batch_size=False,
+                                 dtype="int64"))
+    mark = layers.data("mark", shape=[B, T], append_batch_size=False,
+                       dtype="int64")
+    lens = layers.data("lens", shape=[B], append_batch_size=False,
+                       dtype="int64")
+    target = layers.data("target", shape=[B, T], append_batch_size=False,
+                         dtype="int64")
+
+    embs = [
+        layers.embedding(f, size=[V if i < 4 else NV, 8])
+        for i, f in enumerate(feats)
+    ]
+    embs.append(layers.embedding(mark, size=[2, 4]))
+    feat = layers.concat(embs, axis=-1)
+    proj = layers.fc(feat, 3 * H, num_flatten_dims=2, bias_attr=False)
+    fwd = layers.dynamic_gru(proj, size=H, seq_len=lens)
+    bwd = layers.dynamic_gru(proj, size=H, seq_len=lens, is_reverse=True)
+    hidden = layers.concat([fwd, bwd], axis=-1)
+    emission = layers.fc(hidden, NL, num_flatten_dims=2)
+
+    helper = fluid.layer_helper.LayerHelper("crf")
+    transition = layers.create_parameter([NL + 2, NL], "float32",
+                                         name="crf_trans")
+    ll = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        "linear_chain_crf",
+        inputs={"Emission": [emission], "Transition": [transition],
+                "Label": [target], "Length": [lens]},
+        outputs={"LogLikelihood": [ll]},
+    )
+    loss = layers.mean(ll)
+    fluid.optimizer.SGD(0.05).minimize(loss)
+
+    decoded = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        "crf_decoding",
+        inputs={"Emission": [emission], "Transition": [transition],
+                "Length": [lens]},
+        outputs={"ViterbiPath": [decoded]},
+    )
+
+    def pad_batch():
+        rows = list(__import__("itertools").islice(conll05.test()(), B))
+        out = {k: np.zeros((B, T), "int64") for k in
+               ("word", "ctxn1", "ctx0", "ctxp1", "verb", "mark", "target")}
+        ln = np.zeros((B,), "int64")
+        for i, s in enumerate(rows):
+            words, cn2, cn1, c0, cp1, cp2, verb, mk, lab = s
+            n = min(len(words), T)
+            ln[i] = n
+            for key, vals in (("word", words), ("ctxn1", cn1), ("ctx0", c0),
+                              ("ctxp1", cp1), ("verb", verb), ("mark", mk),
+                              ("target", lab)):
+                out[key][i, :n] = vals[:n]
+        out["lens"] = ln
+        return out
+
+    feed = pad_batch()
+    exe = _exe()
+    losses = [
+        float(np.ravel(exe.run(feed=feed, fetch_list=[loss])[0])[0])
+        for _ in range(8)
+    ]
+    assert losses[-1] < losses[0], losses
+    (path,) = exe.run(feed=feed, fetch_list=[decoded])
+    assert path.shape == (B, T)
+
+
+def test_recommender_system_movielens_trains():
+    """book/test_recommender_system: two-tower user/movie model over the
+    movielens loader — embeddings + title sequence features -> cos_sim
+    -> squared error against the rating."""
+    from paddle_tpu.dataset import movielens
+
+    B, TT = 16, 6  # batch, padded title length
+    n_users = movielens.max_user_id() + 1
+    n_movies = movielens.max_movie_id() + 1
+    n_jobs = movielens.max_job_id() + 1
+    n_cat = len(movielens.movie_categories())
+    n_title = len(movielens.get_movie_title_dict()) + 1
+
+    usr = layers.data("usr", shape=[B], append_batch_size=False, dtype="int64")
+    gender = layers.data("gender", shape=[B], append_batch_size=False, dtype="int64")
+    age = layers.data("age", shape=[B], append_batch_size=False, dtype="int64")
+    job = layers.data("job", shape=[B], append_batch_size=False, dtype="int64")
+    mov = layers.data("mov", shape=[B], append_batch_size=False, dtype="int64")
+    cat = layers.data("cat", shape=[B], append_batch_size=False, dtype="int64")
+    title = layers.data("title", shape=[B, TT], append_batch_size=False, dtype="int64")
+    rating = layers.data("rating", shape=[B, 1], append_batch_size=False)
+
+    usr_feat = layers.concat(
+        [
+            layers.embedding(usr, size=[n_users, 16]),
+            layers.embedding(gender, size=[2, 4]),
+            layers.embedding(age, size=[len(movielens.age_table), 4]),
+            layers.embedding(job, size=[n_jobs, 8]),
+        ],
+        axis=-1,
+    )
+    usr_vec = layers.fc(usr_feat, 32, act="tanh")
+    title_emb = layers.embedding(title, size=[n_title, 16])
+    title_vec = layers.reduce_mean(title_emb, dim=1)
+    mov_feat = layers.concat(
+        [
+            layers.embedding(mov, size=[n_movies, 16]),
+            layers.embedding(cat, size=[n_cat, 8]),
+            title_vec,
+        ],
+        axis=-1,
+    )
+    mov_vec = layers.fc(mov_feat, 32, act="tanh")
+    sim = layers.cos_sim(usr_vec, mov_vec)
+    pred = layers.scale(sim, scale=5.0)
+    loss = layers.mean(layers.square_error_cost(pred, rating))
+    fluid.optimizer.Adam(0.01).minimize(loss)
+
+    rows = list(__import__("itertools").islice(movielens.train()(), B))
+    feed = {
+        "usr": np.array([r[0] for r in rows], "int64"),
+        "gender": np.array([r[1] for r in rows], "int64"),
+        "age": np.array([r[2] for r in rows], "int64"),
+        "job": np.array([r[3] for r in rows], "int64"),
+        "mov": np.array([r[4] for r in rows], "int64"),
+        "cat": np.array([r[5][0] for r in rows], "int64"),
+        "title": np.stack(
+            [np.pad(np.array(r[6][:TT], "int64"), (0, TT - min(len(r[6]), TT)))
+             for r in rows]
+        ),
+        "rating": np.array([r[7] for r in rows], "float32"),
+    }
+    exe = _exe()
+    losses = [
+        float(np.ravel(exe.run(feed=feed, fetch_list=[loss])[0])[0])
+        for _ in range(10)
+    ]
+    assert losses[-1] < losses[0], losses
+
+
+def test_rnn_encoder_decoder_trains():
+    """book/test_rnn_encoder_decoder: GRU encoder + DynamicRNN decoder with
+    additive attention over encoder states, on wmt14 batches — exercises
+    the recurrent op's static_input + seq-len masking end to end."""
+    from paddle_tpu.dataset import wmt14
+
+    DICT, B, TS, TD, H = 40, 8, 10, 10, 16
+    src_dict, trg_dict = wmt14.get_dict(DICT)
+
+    src = layers.data("src", shape=[B, TS], append_batch_size=False, dtype="int64")
+    src_len = layers.data("src_len", shape=[B], append_batch_size=False, dtype="int32")
+    trg_in = layers.data("trg_in", shape=[B, TD], append_batch_size=False, dtype="int64")
+    trg_out = layers.data("trg_out", shape=[B, TD], append_batch_size=False, dtype="int64")
+
+    src_emb = layers.embedding(src, size=[DICT, H])
+    enc_proj = layers.fc(src_emb, 3 * H, num_flatten_dims=2, bias_attr=False)
+    enc = layers.dynamic_gru(enc_proj, size=H, seq_len=src_len)  # [B, TS, H]
+
+    trg_emb = layers.embedding(trg_in, size=[DICT, H])
+    drnn = layers.DynamicRNN()
+    with drnn.block():
+        xt = drnn.step_input(trg_emb)
+        enc_states = drnn.static_input(enc)
+        mem = drnn.memory(shape=[H], value=0.0)
+        # additive attention over encoder states
+        scores = layers.fc(
+            layers.concat(
+                [enc_states,
+                 layers.expand(layers.unsqueeze(mem, axes=[1]),
+                               expand_times=[1, TS, 1])],
+                axis=-1,
+            ),
+            1,
+            num_flatten_dims=2,
+            bias_attr=False,
+        )
+        alpha = layers.softmax(layers.reshape(scores, [-1, TS]))
+        ctx_vec = layers.reshape(
+            layers.matmul(layers.unsqueeze(alpha, axes=[1]), enc_states),
+            [-1, H],
+        )
+        hn = layers.fc(layers.concat([xt, ctx_vec, mem], axis=1), H, act="tanh")
+        drnn.update_memory(mem, hn)
+        drnn.output(hn)
+    dec = drnn()
+    logits = layers.fc(layers.reshape(dec, [-1, H]), DICT)
+    loss = layers.mean(
+        layers.softmax_with_cross_entropy(
+            logits, layers.reshape(trg_out, [-1, 1])
+        )
+    )
+    fluid.optimizer.Adam(0.02).minimize(loss)
+
+    rows = list(__import__("itertools").islice(wmt14.train(DICT)(), B))
+    feed = {
+        "src": np.zeros((B, TS), "int64"),
+        "src_len": np.zeros((B,), "int32"),
+        "trg_in": np.zeros((B, TD), "int64"),
+        "trg_out": np.zeros((B, TD), "int64"),
+    }
+    for i, (s, tin, tout) in enumerate(rows):
+        n = min(len(s), TS)
+        feed["src"][i, :n] = s[:n]
+        feed["src_len"][i] = n
+        m = min(len(tin), TD)
+        feed["trg_in"][i, :m] = tin[:m]
+        feed["trg_out"][i, :m] = tout[:m]
+    exe = _exe()
+    losses = [
+        float(np.ravel(exe.run(feed=feed, fetch_list=[loss])[0])[0])
+        for _ in range(8)
+    ]
+    assert losses[-1] < losses[0], losses
