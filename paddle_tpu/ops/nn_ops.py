@@ -433,6 +433,23 @@ def _padded_gru(ctx, ins, attrs):
     bsz, t, h3 = xproj.shape
     hid = h3 // 3
     h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((bsz, hid), xproj.dtype)
+    from .pallas_kernels import fused_gru, use_pallas, _interpret
+
+    lane_ok = hid % (8 if _interpret() else 128) == 0
+    if use_pallas() and lane_ok and not attrs.get("is_reverse", False):
+        lens = (
+            seq_len.reshape(-1).astype(jnp.int32)
+            if seq_len is not None
+            else jnp.full((bsz,), t, jnp.int32)
+        )
+        hs = fused_gru(xproj, w, h0, lens)
+        last = hs[:, -1, :]
+        if seq_len is not None:
+            idx = jnp.clip(lens - 1, 0, t - 1)
+            last = jnp.take_along_axis(
+                hs, idx[:, None, None].astype(jnp.int32), axis=1
+            )[:, 0]
+        return {"Hidden": [hs], "LastH": [last]}
     w_rz = w[:, : 2 * hid]
     w_c = w[:, 2 * hid :]
     is_reverse = attrs.get("is_reverse", False)

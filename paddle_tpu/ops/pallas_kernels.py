@@ -29,6 +29,15 @@ def _cdiv(a, b):
     return (a + b - 1) // b
 
 
+def _row_block(n, default):
+    """Shared row/batch tiling heuristic: the default block when it
+    divides n, else the largest of (8, 1) that does."""
+    blk = min(default, n)
+    if n % blk != 0:
+        blk = 1 if n % 8 else 8
+    return blk
+
+
 # ---------------------------------------------------------------------------
 # flash attention
 # ---------------------------------------------------------------------------
@@ -169,9 +178,7 @@ def _ln_fwd(x2d, gamma, beta, eps, block_rows=256):
     from jax.experimental.pallas import tpu as pltpu
 
     R, H = x2d.shape
-    block_rows = min(block_rows, R)
-    if R % block_rows != 0:
-        block_rows = 1 if R % 8 else 8
+    block_rows = _row_block(R, block_rows)
     grid = (_cdiv(R, block_rows),)
     return pl.pallas_call(
         functools.partial(_ln_kernel, eps=eps),
@@ -221,3 +228,163 @@ def use_pallas():
     from ..flags import get_flag
 
     return get_flag("use_pallas")
+
+
+# ---------------------------------------------------------------------------
+# fused GRU sequence kernel (math/jit_kernel.h gru kernels + fused/fusion_gru
+# analog): the hidden state lives in VMEM across ALL timesteps, so the
+# recurrence reads/writes HBM once per sequence instead of once per step
+# ---------------------------------------------------------------------------
+def _gru_seq_kernel(x_ref, w_ref, h0_ref, len_ref, o_ref, *, hid, seq_len):
+    w = w_ref[:].astype(jnp.float32)  # [H, 3H]
+    w_uz = w[:, : 2 * hid]
+    w_c = w[:, 2 * hid:]
+    lens = len_ref[:].astype(jnp.int32)  # [Bblk]
+
+    def step(t, h):
+        xt = x_ref[:, t, :].astype(jnp.float32)  # [Bblk, 3H]
+        gates = xt[:, : 2 * hid] + jax.lax.dot(
+            h, w_uz, preferred_element_type=jnp.float32
+        )
+        u = jax.nn.sigmoid(gates[:, :hid])
+        r = jax.nn.sigmoid(gates[:, hid:])
+        c = jnp.tanh(
+            xt[:, 2 * hid:]
+            + jax.lax.dot(r * h, w_c, preferred_element_type=jnp.float32)
+        )
+        h_new = u * c + (1.0 - u) * h
+        active = (t < lens)[:, None].astype(jnp.float32)
+        h_new = active * h_new + (1.0 - active) * h
+        o_ref[:, t, :] = h_new.astype(o_ref.dtype)
+        return h_new
+
+    jax.lax.fori_loop(0, seq_len, step, h0_ref[:].astype(jnp.float32))
+
+
+def _gru_seq_fwd(xproj, w, h0, lens, block_b=8):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, T, H3 = xproj.shape
+    hid = H3 // 3
+    block_b = _row_block(B, block_b)
+    grid = (_cdiv(B, block_b),)
+    return pl.pallas_call(
+        functools.partial(_gru_seq_kernel, hid=hid, seq_len=T),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_b, T, H3), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((hid, H3), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_b, hid), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_b,), lambda i: (i,),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((block_b, T, hid), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B, T, hid), xproj.dtype),
+        interpret=_interpret(),
+    )(xproj, w, h0, lens)
+
+
+def _gru_seq_dense(xproj, w, h0, lens):
+    """Reference scan (also the recompute path for the backward pass)."""
+    hid = xproj.shape[-1] // 3
+    w_uz, w_c = w[:, : 2 * hid], w[:, 2 * hid:]
+
+    def step(h, inp):
+        xt, t = inp
+        gates = xt[:, : 2 * hid] + h @ w_uz
+        u = jax.nn.sigmoid(gates[:, :hid])
+        r = jax.nn.sigmoid(gates[:, hid:])
+        c = jnp.tanh(xt[:, 2 * hid:] + (r * h) @ w_c)
+        h_new = u * c + (1.0 - u) * h
+        act = (t < lens)[:, None].astype(h.dtype)
+        h_new = act * h_new + (1 - act) * h
+        return h_new, h_new
+
+    xs = jnp.swapaxes(xproj, 0, 1)
+    ts = jnp.arange(xproj.shape[1])
+    _, hs = jax.lax.scan(step, h0, (xs, ts))
+    return jnp.swapaxes(hs, 0, 1)
+
+
+@jax.custom_vjp
+def fused_gru(xproj, w, h0, lens):
+    """VMEM-resident GRU over padded [B, T, 3H] projected inputs."""
+    return _gru_seq_fwd(xproj, w, h0, lens)
+
+
+def _gru_vjp_fwd(xproj, w, h0, lens):
+    return _gru_seq_fwd(xproj, w, h0, lens), (xproj, w, h0, lens)
+
+
+def _gru_vjp_bwd(res, dy):
+    xproj, w, h0, lens = res
+    _, vjp = jax.vjp(lambda x, w_, h_: _gru_seq_dense(x, w_, h_, lens),
+                     xproj, w, h0)
+    dx, dw, dh0 = vjp(dy)
+    return dx, dw, dh0, None
+
+
+fused_gru.defvjp(_gru_vjp_fwd, _gru_vjp_bwd)
+
+
+# ---------------------------------------------------------------------------
+# fused softmax cross entropy (row-blocked logsumexp + label gather; the
+# backward is the analytic softmax(x) - onehot, no recompute needed)
+# ---------------------------------------------------------------------------
+def _sxent_kernel(x_ref, lbl_ref, o_ref):
+    x = x_ref[:].astype(jnp.float32)  # [Bblk, C]
+    m = jnp.max(x, axis=-1, keepdims=True)
+    lse = jnp.log(jnp.sum(jnp.exp(x - m), axis=-1, keepdims=True)) + m
+    lbl = lbl_ref[:].astype(jnp.int32)  # [Bblk]
+    cols = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    gold = jnp.sum(jnp.where(cols == lbl[:, None], x, 0.0), axis=-1,
+                   keepdims=True)
+    o_ref[:] = (lse - gold).astype(o_ref.dtype)
+
+
+def _sxent_fwd_call(logits, labels, block_rows=512):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    R, C = logits.shape
+    block_rows = _row_block(R, block_rows)
+    grid = (_cdiv(R, block_rows),)
+    return pl.pallas_call(
+        _sxent_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_rows, C), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_rows,), lambda i: (i,),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((block_rows, 1), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((R, 1), jnp.float32),
+        interpret=_interpret(),
+    )(logits, labels)
+
+
+@jax.custom_vjp
+def fused_softmax_xent(logits, labels):
+    """Per-row -log softmax[label] over [rows, classes] + int labels [rows]."""
+    return _sxent_fwd_call(logits, labels)
+
+
+def _sxent_vjp_fwd(logits, labels):
+    return _sxent_fwd_call(logits, labels), (logits, labels)
+
+
+def _sxent_vjp_bwd(res, dy):
+    logits, labels = res
+    p = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    onehot = jax.nn.one_hot(labels, logits.shape[-1], dtype=jnp.float32)
+    return ((p - onehot) * dy.astype(jnp.float32)).astype(logits.dtype), None
+
+
+fused_softmax_xent.defvjp(_sxent_vjp_fwd, _sxent_vjp_bwd)

@@ -2,6 +2,8 @@
 family trains on synthetic data, and where the book does, completes the
 full train -> save_inference_model -> load -> infer cycle."""
 
+import itertools
+
 import numpy as np
 import pytest
 
@@ -267,7 +269,7 @@ def test_label_semantic_roles_crf_trains():
     )
 
     def pad_batch():
-        rows = list(__import__("itertools").islice(conll05.test()(), B))
+        rows = list(itertools.islice(conll05.test()(), B))
         out = {k: np.zeros((B, T), "int64") for k in
                ("word", "ctxn1", "ctx0", "ctxp1", "verb", "mark", "target")}
         ln = np.zeros((B,), "int64")
@@ -341,7 +343,7 @@ def test_recommender_system_movielens_trains():
     loss = layers.mean(layers.square_error_cost(pred, rating))
     fluid.optimizer.Adam(0.01).minimize(loss)
 
-    rows = list(__import__("itertools").islice(movielens.train()(), B))
+    rows = list(itertools.islice(movielens.train()(), B))
     feed = {
         "usr": np.array([r[0] for r in rows], "int64"),
         "gender": np.array([r[1] for r in rows], "int64"),
@@ -416,7 +418,7 @@ def test_rnn_encoder_decoder_trains():
     )
     fluid.optimizer.Adam(0.02).minimize(loss)
 
-    rows = list(__import__("itertools").islice(wmt14.train(DICT)(), B))
+    rows = list(itertools.islice(wmt14.train(DICT)(), B))
     feed = {
         "src": np.zeros((B, TS), "int64"),
         "src_len": np.zeros((B,), "int32"),

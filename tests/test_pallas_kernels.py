@@ -132,3 +132,108 @@ def test_layer_norm_pallas_dispatch_matches():
         return np.asarray(out)
 
     np.testing.assert_allclose(run(True), run(False), rtol=2e-4, atol=2e-5)
+
+
+def test_fused_gru_matches_scan_gru_fwd_and_grad():
+    """fused_gru (VMEM-resident recurrence) == padded_gru scan, values and
+    gradients, incl. seq-len masking."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas_kernels import fused_gru, _gru_seq_dense
+
+    B, T, H = 4, 6, 8
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(B, T, 3 * H).astype("float32"))
+    w = jnp.asarray(rng.randn(H, 3 * H).astype("float32") * 0.3)
+    h0 = jnp.asarray(rng.randn(B, H).astype("float32"))
+    lens = jnp.asarray(np.array([6, 4, 2, 6], "int32"))
+
+    out = fused_gru(x, w, h0, lens)
+    ref = _gru_seq_dense(x, w, h0, lens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+    def loss_pallas(x_, w_):
+        return jnp.sum(fused_gru(x_, w_, h0, lens) ** 2)
+
+    def loss_ref(x_, w_):
+        return jnp.sum(_gru_seq_dense(x_, w_, h0, lens) ** 2)
+
+    gx, gw = jax.grad(loss_pallas, argnums=(0, 1))(x, w)
+    rx, rw = jax.grad(loss_ref, argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(rx), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(rw), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_fused_softmax_xent_matches_dense():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas_kernels import fused_softmax_xent
+
+    R, C = 16, 10
+    rng = np.random.RandomState(1)
+    logits = jnp.asarray(rng.randn(R, C).astype("float32"))
+    labels = jnp.asarray(rng.randint(0, C, (R,)).astype("int32"))
+    out = fused_softmax_xent(logits, labels)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ref = -jnp.take_along_axis(logp, labels[:, None].astype(jnp.int32), 1)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+    g = jax.grad(lambda l: jnp.sum(fused_softmax_xent(l, labels)))(logits)
+    rg = jax.grad(lambda l: jnp.sum(
+        -jnp.take_along_axis(jax.nn.log_softmax(l, -1),
+                             labels[:, None].astype(jnp.int32), 1)))(logits)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(rg), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_use_pallas_flag_dispatches_gru_and_xent():
+    """FLAGS_use_pallas routes padded_gru / softmax_with_cross_entropy to
+    the fused kernels with unchanged results (kernel-override contract)."""
+    from paddle_tpu.flags import set_flags
+
+    B, T, H, C = 2, 4, 8, 12
+    rng = np.random.RandomState(2)
+    xv = rng.randn(B, T, 3 * H).astype("float32")
+    wv = (rng.randn(H, 3 * H) * 0.3).astype("float32")
+    lg = rng.randn(B, C).astype("float32")
+    lb = rng.randint(0, C, (B, 1)).astype("int64")
+
+    def run():
+        prog = fluid.Program()
+        startup = fluid.Program()
+        with fluid.framework.program_guard(prog, startup):
+            blk = prog.global_block()
+            for n, a in [("px", xv), ("pw", wv), ("plg", lg), ("plb", lb)]:
+                blk.create_var(name=n, shape=a.shape, dtype=str(a.dtype),
+                               is_data=True)
+            h = blk.create_var(name="ph", dtype="float32", shape=None)
+            lh = blk.create_var(name="plh", dtype="float32", shape=None)
+            blk.append_op("padded_gru", inputs={"Input": ["px"], "Weight": ["pw"]},
+                          outputs={"Hidden": [h], "LastH": [lh]})
+            sm = blk.create_var(name="psm", dtype="float32", shape=None)
+            ls = blk.create_var(name="pls", dtype="float32", shape=None)
+            blk.append_op(
+                "softmax_with_cross_entropy",
+                inputs={"Logits": ["plg"], "Label": ["plb"]},
+                outputs={"Softmax": [sm], "Loss": [ls]},
+            )
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            return exe.run(prog, feed={"px": xv, "pw": wv, "plg": lg,
+                                       "plb": lb},
+                           fetch_list=[h, ls])
+
+    set_flags({"use_pallas": False})
+    plain = run()
+    set_flags({"use_pallas": True})
+    try:
+        fused = run()
+    finally:
+        set_flags({"use_pallas": False})
+    for a, b in zip(plain, fused):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
