@@ -50,6 +50,8 @@ def _conv2d(ctx, ins, attrs):
     out = _conv2d_impl(x, w, attrs)
     if ins.get("Bias"):
         out = out + ins["Bias"][0].reshape(1, -1, 1, 1)
+    if attrs.get("fuse_relu"):  # fuse_relu_into_conv_pass epilogue
+        out = jnp.maximum(out, 0)
     return {"Output": [out]}
 
 
@@ -433,23 +435,33 @@ def _padded_gru(ctx, ins, attrs):
     bsz, t, h3 = xproj.shape
     hid = h3 // 3
     h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((bsz, hid), xproj.dtype)
-    from .pallas_kernels import fused_gru, use_pallas, _interpret
+    from .pallas_kernels import (
+        _gru_seq_dense,
+        _interpret,
+        _row_block,
+        fused_gru,
+        use_pallas,
+    )
 
-    lane_ok = hid % (8 if _interpret() else 128) == 0
-    if use_pallas() and lane_ok and not attrs.get("is_reverse", False):
+    if not attrs.get("is_reverse", False):
         lens = (
             seq_len.reshape(-1).astype(jnp.int32)
             if seq_len is not None
             else jnp.full((bsz,), t, jnp.int32)
         )
-        hs = fused_gru(xproj, w, h0, lens)
-        last = hs[:, -1, :]
-        if seq_len is not None:
-            idx = jnp.clip(lens - 1, 0, t - 1)
-            last = jnp.take_along_axis(
-                hs, idx[:, None, None].astype(jnp.int32), axis=1
-            )[:, 0]
-        return {"Hidden": [hs], "LastH": [last]}
+        lane_ok = hid % (8 if _interpret() else 128) == 0
+        # the whole [block_b, T, 4H] working set must fit in VMEM
+        blk = _row_block(bsz, 8)
+        vmem_bytes = blk * t * 4 * hid * 4 + hid * 3 * hid * 4
+        if use_pallas() and lane_ok and vmem_bytes < 10 * 2 ** 20:
+            hs = fused_gru(xproj, w, h0, lens)
+        else:
+            # one shared cell implementation (also the fused path's
+            # backward recompute) — no formula triplication
+            hs = _gru_seq_dense(xproj, w, h0, lens)
+        # masking holds h past each row's length, so the final step IS the
+        # last valid hidden state (lens==0 rows yield h0)
+        return {"Hidden": [hs], "LastH": [hs[:, -1, :]]}
     w_rz = w[:, : 2 * hid]
     w_c = w[:, 2 * hid :]
     is_reverse = attrs.get("is_reverse", False)

@@ -8,6 +8,14 @@ from .distribute_transpiler import (
 from .ps_dispatcher import HashName, RoundRobin
 from .memory_optimization_transpiler import memory_optimize, release_memory
 from .inference_transpiler import InferenceTranspiler
+from .pass_registry import (
+    OpPattern,
+    Pass,
+    apply_pass,
+    get_pass,
+    list_passes,
+    register_pass,
+)
 
 __all__ = [
     "DistributeTranspiler",
@@ -18,4 +26,10 @@ __all__ = [
     "memory_optimize",
     "release_memory",
     "InferenceTranspiler",
+    "OpPattern",
+    "Pass",
+    "apply_pass",
+    "get_pass",
+    "list_passes",
+    "register_pass",
 ]

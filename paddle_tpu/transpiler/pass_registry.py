@@ -1,0 +1,217 @@
+"""Program pass infrastructure (framework/ir/pass.h + PassRegistry +
+GraphPatternDetector analog).
+
+The reference's IR layer exposes passes as registered, composable
+Program-graph rewrites with a declarative subgraph matcher; XLA already
+owns low-level fusion on TPU, but the *extension point* — registering a
+named Program->Program rewrite and matching op patterns declaratively —
+is framework surface users build on (custom quantization, fusion, layout
+rewrites).  This module provides:
+
+- ``Pass`` / ``register_pass`` / ``get_pass`` / ``apply_pass`` — the
+  PassRegistry contract (ir/pass.h:Pass::Apply, PassRegistry).
+- ``OpPattern.match`` — a GraphPatternDetector-lite: matches a linear
+  producer chain of op types through the program's def-use graph and
+  hands each occurrence to a rewrite callback.
+- Built-in registrations for the existing rewrites (bn fold, train-op
+  drop, memory plan, bf16 AMP) so ``apply_pass(prog, name)`` works the
+  way ``PassBuilder`` exposes passes to Python (pybind.cc:664).
+"""
+
+__all__ = [
+    "Pass",
+    "register_pass",
+    "get_pass",
+    "list_passes",
+    "apply_pass",
+    "OpPattern",
+]
+
+_PASSES = {}
+
+
+class Pass:
+    """Base class: subclasses implement apply(program, scope=None)."""
+
+    name = None
+
+    def apply(self, program, scope=None):
+        raise NotImplementedError
+
+    def __call__(self, program, scope=None):
+        return self.apply(program, scope=scope)
+
+
+def register_pass(name):
+    """Decorator registering a Pass subclass or a function
+    program -> program under `name` (REGISTER_PASS analog)."""
+
+    def deco(obj):
+        if isinstance(obj, type) and issubclass(obj, Pass):
+            inst = obj()
+            inst.name = name
+            _PASSES[name] = inst
+        else:
+            p = Pass()
+            p.name = name
+            p.apply = lambda program, scope=None, _f=obj: _f(program, scope)
+            _PASSES[name] = p
+        return obj
+
+    return deco
+
+
+def get_pass(name):
+    if name not in _PASSES:
+        raise KeyError(
+            "no pass '%s' registered (known: %s)" % (name, sorted(_PASSES))
+        )
+    return _PASSES[name]
+
+
+def list_passes():
+    return sorted(_PASSES)
+
+
+def apply_pass(program, name, scope=None):
+    """Apply one registered pass; returns the (possibly same) program."""
+    out = get_pass(name).apply(program, scope=scope)
+    return out if out is not None else program
+
+
+class OpPattern:
+    """GraphPatternDetector-lite: a linear chain of op types connected by
+    def-use edges.
+
+        n = OpPattern(["mul", "elementwise_add", "relu"]).rewrite(
+                block, lambda ops: fuse(ops))
+
+    The matcher walks the block once, following single-consumer def-use
+    links; `rewrite` calls the callback with each matched op list (in
+    chain order) and lets it mutate the block (return True to count a
+    rewrite)."""
+
+    def __init__(self, op_types):
+        self.op_types = list(op_types)
+
+    def _consumer_map(self, block):
+        consumers = {}
+        for i, op in enumerate(block.ops):
+            for name in op.input_arg_names():
+                consumers.setdefault(name, []).append(i)
+        return consumers
+
+    def match(self, block):
+        """Yield lists of Operators matching the chain."""
+        consumers = self._consumer_map(block)
+        for i, op in enumerate(block.ops):
+            if op.type != self.op_types[0]:
+                continue
+            chain = [op]
+            ok = True
+            cur = op
+            for want in self.op_types[1:]:
+                outs = cur.output_arg_names()
+                nxt = None
+                for name in outs:
+                    cs = consumers.get(name, [])
+                    # single-consumer edge keeps the rewrite sound (the
+                    # intermediate value must not be used elsewhere)
+                    if len(cs) == 1 and block.ops[cs[0]].type == want:
+                        nxt = block.ops[cs[0]]
+                        break
+                if nxt is None:
+                    ok = False
+                    break
+                chain.append(nxt)
+                cur = nxt
+            if ok:
+                yield chain
+
+    def rewrite(self, block, fn):
+        """Apply fn(list of ops) -> bool to every match; returns count of
+        rewrites.  Matches are re-scanned after each mutation, but a chain
+        already handed to fn is never re-offered — so attr-tagging
+        rewrites that leave the match intact still terminate."""
+        count = 0
+        seen = set()
+        changed = True
+        while changed:
+            changed = False
+            for chain in self.match(block):
+                key = tuple(id(op) for op in chain)
+                if key in seen:
+                    continue
+                seen.add(key)
+                if fn(chain):
+                    count += 1
+                    changed = True
+                    break  # ops list mutated: re-scan
+        return count
+
+
+# ---------------------------------------------------------------------------
+# built-in pass registrations (the PassBuilder default pipeline analog)
+# ---------------------------------------------------------------------------
+@register_pass("conv_bn_fuse_pass")
+def _conv_bn_fuse(program, scope):
+    from .inference_transpiler import InferenceTranspiler
+
+    if scope is None:
+        raise ValueError(
+            "conv_bn_fuse_pass folds BN statistics into conv weights and "
+            "needs the scope holding them: apply_pass(prog, "
+            "'conv_bn_fuse_pass', scope=...)"
+        )
+    t = InferenceTranspiler()
+    t._fold_batch_norm(program, scope)
+    return program
+
+
+@register_pass("is_test_pass")
+def _is_test(program, scope):
+    from .inference_transpiler import InferenceTranspiler
+
+    t = InferenceTranspiler()
+    t._drop_train_ops(program)
+    return program
+
+
+@register_pass("memory_optimize_pass")
+def _memory_optimize(program, scope):
+    from .memory_optimization_transpiler import memory_optimize
+
+    memory_optimize(program)
+    return program
+
+
+@register_pass("bf16_amp_pass")
+def _bf16_amp(program, scope):
+    from ..contrib.mixed_precision import rewrite_bf16
+
+    rewrite_bf16(program)
+    return program
+
+
+@register_pass("fuse_relu_into_conv_pass")
+class FuseReluIntoConv(Pass):
+    """Example fusion built on OpPattern: conv2d followed by a
+    single-consumer relu becomes conv2d(act=relu) via the fused-activation
+    attr the lowering honors (fuse_elewise_add_act_pass spirit — XLA would
+    fuse these anyway; the pass exists as the extension-point demo and to
+    shrink the traced op count)."""
+
+    def apply(self, program, scope=None):
+        block = program.global_block()
+
+        def fuse(chain):
+            conv, relu = chain
+            out_name = relu.outputs["Out"][0]
+            conv.outputs["Output"] = [out_name]
+            conv.attrs["fuse_relu"] = True
+            block.ops.remove(relu)
+            program._bump_version()
+            return True
+
+        OpPattern(["conv2d", "relu"]).rewrite(block, fuse)
+        return program

@@ -40,3 +40,64 @@ def test_inference_transpiler_conv_bn_fold(impl):
     assert "dropout" not in types
     (out,) = exe.run(program=opt_prog, feed={"img": x}, fetch_list=[d.name])
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+
+def test_pass_registry_and_builder_surface():
+    """ir/pass.h + PassRegistry analog: named passes resolve and apply."""
+    from paddle_tpu.transpiler import apply_pass, get_pass, list_passes
+
+    names = list_passes()
+    for expected in ("conv_bn_fuse_pass", "is_test_pass",
+                     "memory_optimize_pass", "fuse_relu_into_conv_pass"):
+        assert expected in names
+    assert get_pass("is_test_pass").name == "is_test_pass"
+    import pytest
+
+    with pytest.raises(KeyError, match="no pass"):
+        get_pass("nonexistent_pass")
+
+
+def test_op_pattern_matcher_single_consumer_rule():
+    from paddle_tpu.transpiler import OpPattern
+
+    prog = fluid.Program()
+    with fluid.framework.program_guard(prog, fluid.Program()):
+        x = layers.data("pm_x", shape=[2, 3], append_batch_size=False)
+        h = layers.relu(x)
+        layers.relu(h)      # chain: relu -> relu (single consumer)
+        layers.scale(h, 2.0)  # second consumer of h breaks the chain
+    blk = prog.global_block()
+    matches = list(OpPattern(["relu", "relu"]).match(blk))
+    assert matches == []  # h has two consumers -> unsound to fuse
+
+    prog2 = fluid.Program()
+    with fluid.framework.program_guard(prog2, fluid.Program()):
+        x = layers.data("pm_x2", shape=[2, 3], append_batch_size=False)
+        layers.relu(layers.relu(x))
+    matches = list(OpPattern(["relu", "relu"]).match(prog2.global_block()))
+    assert len(matches) == 1
+    assert [o.type for o in matches[0]] == ["relu", "relu"]
+
+
+def test_fuse_relu_into_conv_pass_preserves_output():
+    from paddle_tpu.transpiler import apply_pass
+
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.framework.program_guard(prog, startup):
+        img = layers.data("fp_img", shape=[1, 2, 6, 6], append_batch_size=False)
+        conv = layers.conv2d(img, 3, 3, bias_attr=False)
+        out = layers.relu(conv)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        feed = {"fp_img": np.random.RandomState(0).randn(1, 2, 6, 6).astype("float32")}
+        (ref,) = exe.run(prog, feed=feed, fetch_list=[out], scope=scope)
+        n_before = len(prog.global_block().ops)
+        apply_pass(prog, "fuse_relu_into_conv_pass")
+        assert len(prog.global_block().ops) == n_before - 1
+        assert prog.global_block().ops[-1].attrs.get("fuse_relu") is True
+        (got,) = exe.run(prog, feed=feed, fetch_list=[out], scope=scope)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    assert (np.asarray(got) >= 0).all()
