@@ -1,1 +1,12 @@
-"""paddle_tpu.incubate"""
+"""paddle_tpu.incubate — namespace parity.
+
+The reference snapshot (Fluid ~1.x, late 2018) predates the fleet /
+incubate API surface; this package exists so `import paddle_tpu.incubate`
+resolves for forward-compatible user code. The capabilities that later
+moved here already live elsewhere in this framework:
+
+- high-level trainer with checkpointing  -> paddle_tpu.contrib.trainer
+- distributed roles/transpile           -> paddle_tpu.transpiler +
+                                           paddle_tpu.distributed
+- mixed precision                       -> paddle_tpu.contrib.mixed_precision
+"""
