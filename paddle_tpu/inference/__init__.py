@@ -74,19 +74,29 @@ class Predictor:
             for v in self.fetch_vars
         ]
 
+    # legacy pass_builder names -> registered pass names
+    _PASS_ALIASES = {
+        "fold_batch_norm": "conv_bn_fuse_pass",
+        "drop_train_ops": "is_test_pass",
+        "memory_optimize": "memory_optimize_pass",
+    }
+
     def _apply_analysis_passes(self):
-        from ..transpiler import InferenceTranspiler, memory_optimize
+        """IRPassManager analog: resolve the config's pass list through the
+        pass registry, so user-registered passes (transpiler.register_pass)
+        run inside the predictor like built-ins."""
+        from ..transpiler import apply_pass, get_pass
 
         passes = (
             self.config.pass_builder()
             if isinstance(self.config, AnalysisConfig)
             else ["fold_batch_norm", "drop_train_ops"]
         )
-        t = InferenceTranspiler()
-        if "fold_batch_norm" in passes or "drop_train_ops" in passes:
-            t.transpile(self.program, self.config.place, scope=self.scope)
-        if "memory_optimize" in passes:
-            memory_optimize(self.program)
+        resolved = [self._PASS_ALIASES.get(n, n) for n in passes]
+        for name in resolved:
+            get_pass(name)  # validate the whole list before ANY mutation
+        for name in resolved:
+            apply_pass(self.program, name, scope=self.scope)
 
     def run(self, inputs):
         """inputs: dict name->array, or list aligned with feed_names.

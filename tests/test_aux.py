@@ -226,3 +226,21 @@ def test_nested_lod_two_levels():
     np.testing.assert_allclose(t.data[1, 0, :, 0], [5, 6, 7, 8, 9])
     np.testing.assert_array_equal(t.seq_lens(0), [2, 1])
     np.testing.assert_array_equal(t.seq_lens(1), [3, 2, 5])
+
+
+def test_api_spec_stability():
+    """tools/diff_api.py CI contract: the live public API covers the
+    committed API.spec snapshot (removals/re-signatures fail)."""
+    import subprocess
+    import sys
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "diff_api.py"),
+         os.path.join(root, "API.spec")],
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -58,3 +58,30 @@ def test_analysis_predictor_parity_and_fusion(tmp_path):
     clone = pred.clone()
     (out2,) = clone.run([x])
     np.testing.assert_allclose(out2, out, rtol=1e-6)
+
+
+def test_predictor_runs_user_registered_pass(tmp_path):
+    """IRPassManager analog: a pass registered via transpiler.register_pass
+    participates in the predictor's analysis pipeline by name."""
+    from paddle_tpu.transpiler import register_pass
+
+    calls = []
+
+    @register_pass("test_probe_pass")
+    def _probe(program, scope):
+        calls.append(len(program.global_block().ops))
+        return program
+
+    x = layers.data("upx", shape=[4])
+    pred = layers.fc(x, 3, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    model_dir = str(tmp_path / "up_model")
+    fluid.save_inference_model(model_dir, ["upx"], [pred], exe)
+
+    cfg = AnalysisConfig(model_dir)
+    cfg.pass_builder().append("test_probe_pass")
+    predictor = create_paddle_predictor(cfg)
+    assert calls, "registered pass did not run in the predictor"
+    (out,) = predictor.run({"upx": np.ones((2, 4), "float32")})
+    assert out.shape == (2, 3)

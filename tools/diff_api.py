@@ -1,0 +1,54 @@
+"""API-stability check (tools/diff_api.py analog): compare the live public
+API against a committed snapshot; REMOVED or re-signatured symbols fail
+(additions are allowed — the reference's CI contract).
+
+Usage: python tools/diff_api.py API.spec
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def load_spec(path):
+    out = {}
+    with open(path) as f:
+        for ln in f:
+            ln = ln.rstrip("\n")
+            if not ln:
+                continue
+            name, _, sig = ln.partition(" ")
+            out[name] = sig
+    return out
+
+
+def main():
+    from print_signatures import iter_signatures
+
+    spec_path = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "API.spec",
+    )
+    want = load_spec(spec_path)
+    have = {}
+    for ln in iter_signatures():
+        name, _, sig = ln.partition(" ")
+        have[name] = sig
+    broken = []
+    for name, sig in sorted(want.items()):
+        if name not in have:
+            broken.append("REMOVED  %s" % name)
+        elif have[name] != sig:
+            broken.append("CHANGED  %s: %s -> %s" % (name, sig, have[name]))
+    if broken:
+        print("\n".join(broken))
+        print("\n%d public API break(s) vs %s" % (len(broken), spec_path))
+        return 1
+    added = sorted(set(have) - set(want))
+    print("API stable (%d symbols, %d new)" % (len(want), len(added)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
