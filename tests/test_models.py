@@ -63,3 +63,50 @@ def test_transformer_trains():
         losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
     assert np.isfinite(losses).all(), losses
     assert losses[-1] < losses[0], losses
+
+
+def test_vgg16_trains():
+    """benchmark/fluid/models/vgg.py capability: tiny VGG-16 train step."""
+    from paddle_tpu.models.vgg import vgg16
+
+    img = layers.data("vimg", shape=[3, 32, 32])
+    label = layers.data("vlabel", shape=[1], dtype="int64")
+    pred = vgg16(img, class_dim=10)
+    loss = layers.mean(layers.cross_entropy(pred, label))
+    fluid.optimizer.SGD(0.01).minimize(loss)
+    rng = np.random.RandomState(0)
+    feed = {
+        "vimg": rng.rand(2, 3, 32, 32).astype("float32"),
+        "vlabel": rng.randint(0, 10, (2, 1)).astype("int64"),
+    }
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    vals = [
+        float(np.ravel(exe.run(feed=feed, fetch_list=[loss])[0])[0])
+        for _ in range(3)
+    ]
+    assert np.isfinite(vals).all()
+    assert vals[-1] < vals[0]
+
+
+def test_stacked_dynamic_lstm_benchmark_model():
+    """benchmark/fluid/models/stacked_dynamic_lstm.py capability mirror."""
+    from paddle_tpu.models.stacked_dynamic_lstm import build_stacked_lstm_train
+
+    feeds, loss, acc = build_stacked_lstm_train(
+        dict_size=40, seq_len_max=10, emb_dim=16, hidden_dim=16, stacked_num=3
+    )
+    fluid.optimizer.Adam(0.02).minimize(loss)
+    rng = np.random.RandomState(1)
+    feed = {
+        "words": rng.randint(1, 40, (8, 10)).astype("int64"),
+        "seq_len": rng.randint(3, 10, (8,)).astype("int64"),
+        "label": rng.randint(0, 2, (8, 1)).astype("int64"),
+    }
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    vals = [
+        float(np.ravel(exe.run(feed=feed, fetch_list=[loss])[0])[0])
+        for _ in range(8)
+    ]
+    assert vals[-1] < vals[0], vals
