@@ -212,24 +212,56 @@ class RPCClient:
         return self._call_locked(verb, timeout_s, kwargs)
 
     def _call_locked(self, verb, timeout_s, kwargs):
+        import time
+
         with self._io_lock:
             self._req_counter += 1
             req_id = "%s:%d" % (self._token, self._req_counter)
-            if self._sock is None:
-                self._sock = self._connect()
+            # reconnect + replay the round-trip a FEW times: a restarting
+            # peer can accept a connection from its dying listener's
+            # backlog and reset it, so one reconnect is not enough to ride
+            # out a kill-and-restart window.  Connect-level persistence
+            # lives in _connect() (which already loops max_retry times) —
+            # keeping the outer count small avoids squaring the retries.
+            # The server's dedup cache keeps replays at-most-once even if
+            # an earlier copy was applied.  A genuine recv timeout (peer
+            # alive but slow) is replayed at most once, then surfaces.
+            ROUND_TRIPS = 3
+            last = None
+            result = None
+
+            def drop_sock():
+                if self._sock is not None:
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    self._sock = None
+
             try:
-                if timeout_s is not None:
-                    self._sock.settimeout(timeout_s)
-                _send_msg(self._sock, (verb, kwargs, req_id))
-                result = _recv_msg(self._sock)
-            except (ConnectionError, OSError):
-                # reconnect + replay; the server's dedup cache makes the
-                # retry at-most-once even if the first copy was applied
-                self._sock = self._connect()
-                if timeout_s is not None:
-                    self._sock.settimeout(timeout_s)
-                _send_msg(self._sock, (verb, kwargs, req_id))
-                result = _recv_msg(self._sock)
+                for attempt in range(ROUND_TRIPS):
+                    try:
+                        if self._sock is None:
+                            self._sock = self._connect()
+                        if timeout_s is not None:
+                            self._sock.settimeout(timeout_s)
+                        _send_msg(self._sock, (verb, kwargs, req_id))
+                        result = _recv_msg(self._sock)
+                        break
+                    except socket.timeout:
+                        drop_sock()
+                        if attempt >= 1:
+                            raise
+                    except (ConnectionError, OSError) as e:
+                        last = e
+                        drop_sock()
+                        if attempt + 1 < ROUND_TRIPS:
+                            time.sleep(self.retry_wait)
+                else:
+                    raise ConnectionError(
+                        "rpc %s to %s failed after %d round-trip attempts: %s"
+                        % (verb, self.endpoint, ROUND_TRIPS, last)
+                    )
             finally:
                 if timeout_s is not None and self._sock is not None:
                     try:

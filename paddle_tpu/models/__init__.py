@@ -7,6 +7,7 @@ from . import (
     resnet,
     se_resnext,
     sentiment,
+    stacked_dynamic_lstm,
     transformer,
     vgg,
     word2vec,
