@@ -24,4 +24,52 @@ def accuracy(input, label, k=1, correct=None, total=None):
 
 
 def auc(input, label, curve="ROC", num_thresholds=200, topk=1, slide_steps=1):
-    raise NotImplementedError("auc layer pending (metrics.Auc available host-side)")
+    """Streaming in-graph AUC (metric_op.py auc / auc_op.cc): threshold
+    buckets accumulate in persistable stat tensors threaded through the
+    functionalized scope state; returns (auc_out, [stat_pos, stat_neg])
+    like the reference.  curve is ROC or PR; the reference's topk>1 and
+    sliding-window modes are not supported (explicit error, never a
+    silently-different metric)."""
+    from ..initializer import Constant
+    from .. import unique_name
+
+    if topk != 1:
+        raise NotImplementedError("auc: only topk=1 is supported")
+    if slide_steps not in (0, 1):
+        raise NotImplementedError(
+            "auc: sliding-window accumulation (slide_steps=%r) is not "
+            "supported; use slide_steps=0/1 for global accumulation" % slide_steps
+        )
+    helper = LayerHelper("auc")
+    stat_pos = helper.create_global_variable(
+        persistable=True,
+        name=unique_name.generate("auc_stat_pos"),
+        shape=[num_thresholds + 1],
+        dtype="float32",
+    )
+    stat_neg = helper.create_global_variable(
+        persistable=True,
+        name=unique_name.generate("auc_stat_neg"),
+        shape=[num_thresholds + 1],
+        dtype="float32",
+    )
+    for v in (stat_pos, stat_neg):
+        helper.set_variable_initializer(v, Constant(0.0))
+    auc_out = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        "auc",
+        inputs={
+            "Predict": [input],
+            "Label": [label],
+            "StatPos": [stat_pos],
+            "StatNeg": [stat_neg],
+        },
+        outputs={
+            "AUC": [auc_out],
+            "StatPosOut": [stat_pos],
+            "StatNegOut": [stat_neg],
+        },
+        attrs={"num_thresholds": num_thresholds, "curve": curve},
+    )
+    auc_out.stop_gradient = True
+    return auc_out, [stat_pos, stat_neg]

@@ -1214,11 +1214,41 @@ def where(condition, x=None, y=None):
 
 
 def cond_take(x, mask):
-    raise NotImplementedError("cond_take pending")
+    """Masked take with static shapes: values of ``x`` where ``mask`` is
+    true, stably compacted to the front of a zero-padded full-size buffer,
+    plus the true count (the TPU-shaped CondOp/masked-select)."""
+    helper = LayerHelper("cond_take")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    count = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        "cond_take",
+        inputs={"X": [x], "Mask": [mask]},
+        outputs={"Out": [out], "Count": [count]},
+    )
+    return out, count
 
 
 def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
-    raise NotImplementedError("unfold pending")
+    """im2col as a layer: NCHW -> [N, C*kh*kw, L] sliding patches
+    (unfold_op; the host im2col of the reference's math/im2col.h becomes
+    one fused XLA gather)."""
+    def _pair(v):
+        return [v, v] if isinstance(v, int) else [int(i) for i in v]
+
+    helper = LayerHelper("unfold", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "unfold",
+        inputs={"X": [x]},
+        outputs={"Y": [out]},
+        attrs={
+            "kernel_sizes": _pair(kernel_sizes),
+            "strides": _pair(strides),
+            "paddings": _pair(paddings),
+            "dilations": _pair(dilations),
+        },
+    )
+    return out
 
 
 def increment(x, value=1.0, in_place=True):

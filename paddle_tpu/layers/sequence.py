@@ -213,7 +213,20 @@ def sequence_mask(x, maxlen=None, dtype="int64", name=None):
 
 
 def sequence_slice(input, offset, length, name=None):
-    raise NotImplementedError("sequence_slice pending")
+    """Per-sequence window slice (sequence_slice_op.cc): keep the window
+    [offset, offset+length) of each row, front-aligned in the padded
+    representation.  Returns the sliced tensor; the new lengths tensor is
+    available as ``out.seq_len``."""
+    helper = LayerHelper("sequence_slice", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out_len = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        "sequence_slice",
+        inputs={"X": [input], "Offset": [offset], "Length": [length]},
+        outputs={"Out": [out], "OutLen": [out_len]},
+    )
+    out.seq_len = out_len
+    return out
 
 
 def sequence_conv(

@@ -20,6 +20,14 @@ def _auc(ctx, ins, attrs):
     predict = ins["Predict"][0]
     label = ins["Label"][0].reshape(-1)
     num_thresholds = attrs.get("num_thresholds", 4095)
+    curve = str(attrs.get("curve", "ROC")).upper()
+    if curve not in ("ROC", "PR"):
+        raise ValueError("auc: unsupported curve %r (ROC or PR)" % curve)
+    if predict.ndim > 2 or (predict.ndim == 2 and predict.shape[1] > 2):
+        raise ValueError(
+            "auc: Predict must be [N] scores or [N, 2] binary probabilities, "
+            "got %s" % (predict.shape,)
+        )
     pos_score = predict[:, 1] if predict.ndim == 2 and predict.shape[1] == 2 else predict.reshape(-1)
     stat_pos = ins["StatPos"][0].reshape(-1)
     stat_neg = ins["StatNeg"][0].reshape(-1)
@@ -29,7 +37,7 @@ def _auc(ctx, ins, attrs):
     is_pos = (label > 0).astype(stat_pos.dtype)
     new_pos = stat_pos.at[bucket].add(is_pos)
     new_neg = stat_neg.at[bucket].add(1.0 - is_pos)
-    # AUC = sum over buckets (descending threshold) of trapezoid areas
+    # trapezoid integration over buckets in descending-threshold order
     pos_flip = jnp.flip(new_pos)
     neg_flip = jnp.flip(new_neg)
     tp = jnp.cumsum(pos_flip)
@@ -38,8 +46,21 @@ def _auc(ctx, ins, attrs):
     tot_neg = fp[-1]
     tp_prev = jnp.concatenate([jnp.zeros(1, tp.dtype), tp[:-1]])
     fp_prev = jnp.concatenate([jnp.zeros(1, fp.dtype), fp[:-1]])
-    area = jnp.sum((fp - fp_prev) * (tp + tp_prev) / 2.0)
-    auc = jnp.where(tot_pos * tot_neg > 0, area / jnp.maximum(tot_pos * tot_neg, 1.0), 0.0)
+    if curve == "PR":
+        # precision-recall area, right-endpoint (step) integration à la
+        # average precision: sum over thresholds of d(recall) * precision.
+        # (Trapezoids would need precision at tp_prev+fp_prev==0 and bias
+        # the first bucket low for sharp classifiers.)
+        recall = tp / jnp.maximum(tot_pos, 1.0)
+        recall_prev = tp_prev / jnp.maximum(tot_pos, 1.0)
+        precision = tp / jnp.maximum(tp + fp, 1.0)
+        area = jnp.sum((recall - recall_prev) * precision)
+        auc = jnp.where(tot_pos > 0, area, 0.0)
+    else:
+        area = jnp.sum((fp - fp_prev) * (tp + tp_prev) / 2.0)
+        auc = jnp.where(
+            tot_pos * tot_neg > 0, area / jnp.maximum(tot_pos * tot_neg, 1.0), 0.0
+        )
     return {
         "AUC": [auc],
         "StatPosOut": [new_pos],

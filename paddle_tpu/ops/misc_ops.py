@@ -301,3 +301,75 @@ def _sampling_id(ctx, ins, attrs):
     logits = jnp.log(jnp.maximum(x, 1e-20))
     ids = jax.random.categorical(key, logits, axis=-1)
     return {"Out": [ids.astype(jnp.int32)]}
+
+
+@register("sequence_slice", no_grad_inputs=("Offset", "Length"))
+def _sequence_slice(ctx, ins, attrs):
+    """sequence_slice_op.cc re-expressed for the padded representation:
+    each row b of X keeps the window [Offset[b], Offset[b]+Length[b]) of
+    its time axis, shifted to the front; positions past the new length are
+    zeroed.  New per-row lengths are emitted as OutLen (the LoD analog)."""
+    x = ins["X"][0]
+    offset = ins["Offset"][0].reshape(-1).astype(jnp.int32)
+    length = ins["Length"][0].reshape(-1).astype(jnp.int32)
+    t = x.shape[1]
+    # truncate out-of-range windows at the tensor bound (the reference
+    # enforces offset+length <= seq_len; here the honest equivalent is a
+    # clamped window with the clamped length reported in OutLen, never
+    # duplicated frames presented as valid data)
+    offset = jnp.clip(offset, 0, t)
+    eff_len = jnp.clip(length, 0, t - offset)
+    idx = offset[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]  # [B, T]
+    idx = jnp.clip(idx, 0, t - 1)
+    gather_idx = idx.reshape(idx.shape + (1,) * (x.ndim - 2))
+    gather_idx = jnp.broadcast_to(gather_idx, (x.shape[0], t) + x.shape[2:])
+    out = jnp.take_along_axis(x, gather_idx, axis=1)
+    mask = jnp.arange(t, dtype=jnp.int32)[None, :] < eff_len[:, None]
+    out = jnp.where(mask.reshape(mask.shape + (1,) * (x.ndim - 2)), out, 0)
+    return {"Out": [out], "OutLen": [eff_len.astype(jnp.int64)]}
+
+
+@register("unfold")
+def _unfold(ctx, ins, attrs):
+    """unfold_op (im2col as an op): NCHW -> [N, C*kh*kw, L] sliding-window
+    patches.  The reference does explicit im2col on the host kernel; on TPU
+    XLA's conv_general_dilated_patches keeps it one fused gather."""
+    x = ins["X"][0]
+    ksizes = [int(k) for k in attrs["kernel_sizes"]]
+    strides = [int(s) for s in attrs.get("strides", [1, 1])]
+    paddings = [int(p) for p in attrs.get("paddings", [0, 0])]
+    dilations = [int(d) for d in attrs.get("dilations", [1, 1])]
+    if len(paddings) == 2:
+        pad = [(paddings[0], paddings[0]), (paddings[1], paddings[1])]
+    else:  # [top, left, bottom, right] per the reference attr layout
+        pad = [(paddings[0], paddings[2]), (paddings[1], paddings[3])]
+    patches = jax.lax.conv_general_dilated_patches(
+        x,
+        filter_shape=ksizes,
+        window_strides=strides,
+        padding=pad,
+        rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )  # [N, C*kh*kw, H', W']
+    n, ckk = patches.shape[:2]
+    return {"Y": [patches.reshape(n, ckk, -1)]}
+
+
+@register("cond_take", no_grad_inputs=("Mask",))
+def _cond_take(ctx, ins, attrs):
+    """cond_op-style masked take with static shapes: elements of X where
+    Mask is true, stably compacted to the front of a full-size buffer
+    (zero-padded), plus the true count — the TPU answer to the
+    dynamic-output-size CondOp/masked-select pattern."""
+    x = ins["X"][0].reshape(-1)
+    mask = ins["Mask"][0].reshape(-1)
+    n = x.shape[0]
+    keep = mask.astype(bool)
+    order = jnp.argsort(
+        jnp.where(keep, 0, 1) * n + jnp.arange(n, dtype=jnp.int32)
+    )
+    taken = jnp.where(
+        jnp.arange(n) < jnp.sum(keep.astype(jnp.int32)), x[order], 0
+    )
+    count = jnp.sum(keep.astype(jnp.int64)).reshape(1)
+    return {"Out": [taken], "Count": [count]}

@@ -622,6 +622,93 @@ def test_sequence_ops_padded():
     assert se.shape[0] == 2
 
 
+def test_sequence_slice_unfold_cond_take():
+    x = _r(2, 5, 3, seed=91)
+    off = np.array([1, 0], "int64")
+    ln = np.array([3, 2], "int64")
+    out, outlen = probe(
+        "sequence_slice", {"X": x, "Offset": off, "Length": ln}, {},
+        ["Out", "OutLen"],
+    )
+    np.testing.assert_allclose(out[0, :3], x[0, 1:4], rtol=1e-6)
+    np.testing.assert_allclose(out[1, :2], x[1, :2], rtol=1e-6)
+    assert np.all(out[0, 3:] == 0) and np.all(out[1, 2:] == 0)
+    np.testing.assert_array_equal(outlen, ln)
+
+    img = _r(1, 2, 4, 4, seed=92)
+    (y,) = probe(
+        "unfold", {"X": img},
+        {"kernel_sizes": [2, 2], "strides": [1, 1], "paddings": [0, 0],
+         "dilations": [1, 1]},
+        ["Y"],
+    )
+    assert y.shape == (1, 2 * 2 * 2, 9)
+    # first patch = top-left 2x2 window, channel-major
+    np.testing.assert_allclose(
+        y[0, :, 0],
+        img[0, :, :2, :2].reshape(2, -1).reshape(-1),
+        rtol=1e-6,
+    )
+
+    v = np.array([3.0, -1.0, 4.0, -2.0], "float32")
+    mask = np.array([1, 0, 1, 0], "int32")
+    taken, count = probe("cond_take", {"X": v, "Mask": mask}, {},
+                         ["Out", "Count"])
+    np.testing.assert_allclose(taken, [3.0, 4.0, 0.0, 0.0])
+    assert int(count[0]) == 2
+
+    # out-of-range window: clamped at the tensor bound, truncated length
+    # reported (never duplicated frames presented as valid data)
+    out2, outlen2 = probe(
+        "sequence_slice",
+        {"X": x, "Offset": np.array([3, 0], "int64"),
+         "Length": np.array([4, 2], "int64")}, {},
+        ["Out", "OutLen"],
+    )
+    np.testing.assert_array_equal(outlen2, [2, 2])
+    np.testing.assert_allclose(out2[0, :2], x[0, 3:5], rtol=1e-6)
+    assert np.all(out2[0, 2:] == 0)
+
+
+def test_auc_pr_curve_and_guards():
+    rng = np.random.RandomState(3)
+    n, nt = 64, 200
+    score = rng.rand(n).astype("float32")
+    label = (rng.rand(n) < score).astype("int64")  # informative scores
+    z = np.zeros(nt + 1, "float32")
+    (roc, sp, sn) = probe(
+        "auc", {"Predict": score, "Label": label, "StatPos": z, "StatNeg": z},
+        {"num_thresholds": nt, "curve": "ROC"},
+        ["AUC", "StatPosOut", "StatNegOut"],
+    )
+    (pr, _, _) = probe(
+        "auc", {"Predict": score, "Label": label, "StatPos": z, "StatNeg": z},
+        {"num_thresholds": nt, "curve": "PR"},
+        ["AUC", "StatPosOut", "StatNegOut"],
+    )
+    # sklearn-free sanity: informative scores => both areas well above chance
+    assert 0.6 < float(roc) <= 1.0
+    base_rate = label.mean()
+    assert base_rate < float(pr) <= 1.0
+    # perfect classifier: every positive in the top bucket — PR area must be 1
+    perf_score = label.astype("float32")
+    (pr1, _, _) = probe(
+        "auc", {"Predict": perf_score, "Label": label, "StatPos": z,
+                "StatNeg": z},
+        {"num_thresholds": nt, "curve": "PR"},
+        ["AUC", "StatPosOut", "StatNegOut"],
+    )
+    assert abs(float(pr1) - 1.0) < 1e-6
+    with pytest.raises(Exception, match="curve"):
+        probe("auc", {"Predict": score, "Label": label, "StatPos": z,
+                      "StatNeg": z}, {"curve": "XYZ", "num_thresholds": nt},
+              ["AUC", "StatPosOut", "StatNegOut"])
+    with pytest.raises(Exception, match="Predict"):
+        probe("auc", {"Predict": rng.rand(8, 3).astype("float32"),
+                      "Label": label[:8], "StatPos": z, "StatNeg": z},
+              {"num_thresholds": nt}, ["AUC", "StatPosOut", "StatNegOut"])
+
+
 def test_position_encoding_and_interp_extras():
     x = _r(1, 4, 6, seed=83)
     (out,) = probe("add_position_encoding", {"X": x},

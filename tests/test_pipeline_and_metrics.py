@@ -90,6 +90,36 @@ def test_metrics_precision_recall_auc():
     assert auc.eval() == 1.0
 
 
+def test_auc_layer_streams_batches():
+    """In-graph layers.auc accumulates stat tensors across runs and matches
+    the host-side metrics.Auc on the union of the batches."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        pred = layers.data("pred", shape=[2], dtype="float32")
+        label = layers.data("label", shape=[1], dtype="int64")
+        auc_out, _states = layers.auc(pred, label, num_thresholds=1000)
+
+    exe = fluid.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(7)
+    all_p, all_l = [], []
+    for _ in range(3):
+        p1 = rng.rand(8, 1).astype("float32")
+        p = np.concatenate([1 - p1, p1], axis=1)
+        l = rng.randint(0, 2, (8, 1)).astype("int64")
+        all_p.append(p)
+        all_l.append(l)
+        (got,) = exe.run(main, feed={"pred": p, "label": l},
+                         fetch_list=[auc_out])
+    ref = metrics.Auc(num_thresholds=1000)
+    ref.update(np.concatenate(all_p), np.concatenate(all_l).reshape(-1))
+    assert abs(float(got) - ref.eval()) < 5e-2
+
+
 def test_profiler_records(tmp_path):
     path = str(tmp_path / "prof")
     x = layers.data("x", shape=[4])
