@@ -137,12 +137,19 @@ def _transformer_bench(on_tpu, device):
     seq = int(os.environ.get("BENCH_TFM_SEQ", 64 if on_tpu else 16))
     steps = max(1, int(os.environ.get("BENCH_TFM_STEPS", 10 if on_tpu else 2)))
     warmup = 2 if on_tpu else 1
+    # bf16 matmuls (MXU) + fused attention by default on the chip; the
+    # fused op runs the flash pallas kernel only under FLAGS_use_pallas
+    # (kept off over the tunnel — remote Mosaic compiles blow the budget),
+    # so here it is the fused-XLA attention path.
+    use_bf16 = os.environ.get("BENCH_TFM_BF16", "1" if on_tpu else "0") == "1"
+    use_fused = os.environ.get("BENCH_TFM_FUSED", "1") == "1"
 
     class HP(tfm.ModelHyperParams):
         max_length = max(seq, tfm.ModelHyperParams.max_length)
+        fused_attn = use_fused
 
     main, startup, feeds, fetches = tfm.wmt_transformer_program(
-        HP, src_len=seq, trg_len=seq
+        HP, src_len=seq, trg_len=seq, use_bf16=use_bf16
     )
     scope = fluid.Scope()
     with fluid.scope_guard(scope):

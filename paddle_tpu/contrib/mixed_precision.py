@@ -16,7 +16,12 @@ needed (bf16 has float32's exponent range).
 
 from .. import framework
 
-_BF16_OPS = ("mul", "matmul", "conv2d", "depthwise_conv2d")
+_BF16_OPS = ("mul", "matmul", "conv2d", "depthwise_conv2d", "fused_attention")
+
+# input slots that must stay float32 even when the op is rewritten
+# (additive -1e9 padding masks lose nothing in bf16, but keeping them f32
+# costs nothing and avoids surprises with user-supplied biases)
+_KEEP_F32_SLOTS = {"fused_attention": ("Bias",)}
 
 
 def rewrite_bf16(program=None, ops=_BF16_OPS):
@@ -59,7 +64,10 @@ def rewrite_bf16(program=None, ops=_BF16_OPS):
             and op.attrs.get("op_role", "forward") == "forward"
         ):
             count += 1
+            keep_f32 = _KEEP_F32_SLOTS.get(op.type, ())
             for slot, names in list(op.inputs.items()):
+                if slot in keep_f32:
+                    continue
                 op.inputs[slot] = [
                     cast_var(n, "bfloat16", "BF16") for n in names
                 ]

@@ -1435,14 +1435,20 @@ def log_loss(input, label, epsilon=1e-4, name=None):
     return out
 
 
-def fused_attention(q, k, v, causal=False, scale=None, name=None):
+def fused_attention(q, k, v, causal=False, scale=None, bias=None, name=None):
     """Fused scaled-dot-product attention over [batch, heads, T, d]
-    (flash-attention kernel under FLAGS_use_pallas)."""
+    (flash-attention kernel under FLAGS_use_pallas).  bias: optional
+    additive key-padding bias, rank-1 in the key axis ([B, Tk] or
+    [B, 1, 1, Tk]) — covers padding masks without a [Tq, Tk] tensor;
+    combine with causal=True for decoder self-attention."""
     helper = LayerHelper("fused_attention", **locals())
     out = helper.create_variable_for_type_inference(q.dtype)
+    inputs = {"Q": [q], "K": [k], "V": [v]}
+    if bias is not None:
+        inputs["Bias"] = [bias]
     helper.append_op(
         "fused_attention",
-        inputs={"Q": [q], "K": [k], "V": [v]},
+        inputs=inputs,
         outputs={"Out": [out]},
         attrs={"causal": causal, "scale": scale},
     )

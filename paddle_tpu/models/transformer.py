@@ -87,10 +87,18 @@ class _NumpyInit:
 
 
 def multi_head_attention(
-    queries, keys, values, attn_bias, d_model, n_head, dropout_rate=0.0, is_test=False, cache=None
+    queries, keys, values, attn_bias, d_model, n_head, dropout_rate=0.0,
+    is_test=False, cache=None, fused=False, kpad_bias=None, causal=False,
 ):
     """All heads in one qkv projection + batched matmuls (MXU-shaped).
-    attn_bias: [B, 1 or H, Tq, Tk] additive mask (−1e9 at masked slots)."""
+    attn_bias: [B, 1 or H, Tq, Tk] additive mask (−1e9 at masked slots).
+
+    fused=True routes through the fused_attention op (flash kernel under
+    FLAGS_use_pallas, fused XLA otherwise): padding is expressed as the
+    rank-1 kpad_bias [B, Tk] and causality as a flag, so the [Tq, Tk]
+    score matrix never hits HBM.  Attention-prob dropout is folded away on
+    this path (the probs are never materialized) — standard flash-attention
+    practice; residual/ffn dropout still applies."""
     q = layers.fc(queries, size=d_model, num_flatten_dims=2, bias_attr=False,
                   param_attr=_pa("mha_q.w"))
     k = layers.fc(keys, size=d_model, num_flatten_dims=2, bias_attr=False,
@@ -105,13 +113,18 @@ def multi_head_attention(
 
     q, k, v = split_heads(q), split_heads(k), split_heads(v)
     dh = d_model // n_head
-    product = layers.matmul(q, k, transpose_y=True, alpha=dh ** -0.5)
-    if attn_bias is not None:
-        product = layers.elementwise_add(product, attn_bias)
-    weights = layers.softmax(product)
-    if dropout_rate:
-        weights = layers.dropout(weights, dropout_rate, is_test=is_test)
-    ctx = layers.matmul(weights, v)  # [B, H, Tq, Dh]
+    if fused:
+        ctx = layers.fused_attention(
+            q, k, v, bias=kpad_bias, causal=causal, scale=dh ** -0.5
+        )  # [B, H, Tq, Dh]
+    else:
+        product = layers.matmul(q, k, transpose_y=True, alpha=dh ** -0.5)
+        if attn_bias is not None:
+            product = layers.elementwise_add(product, attn_bias)
+        weights = layers.softmax(product)
+        if dropout_rate:
+            weights = layers.dropout(weights, dropout_rate, is_test=is_test)
+        ctx = layers.matmul(weights, v)  # [B, H, Tq, Dh]
     ctx = layers.transpose(ctx, [0, 2, 1, 3])
     b, t = ctx.shape[0], ctx.shape[1]
     ctx = layers.reshape(ctx, [b, t, d_model])
@@ -136,22 +149,28 @@ def pre_post_process(prev, out, dropout_rate=0.0, is_test=False):
     return layers.layer_norm(added, begin_norm_axis=2)
 
 
-def encoder_layer(x, attn_bias, hp, is_test=False):
+def encoder_layer(x, attn_bias, hp, is_test=False, kpad_bias=None):
+    fused = getattr(hp, "fused_attn", False)
     attn = multi_head_attention(
-        x, x, x, attn_bias, hp.d_model, hp.n_head, hp.dropout, is_test
+        x, x, x, attn_bias, hp.d_model, hp.n_head, hp.dropout, is_test,
+        fused=fused, kpad_bias=kpad_bias,
     )
     x = pre_post_process(x, attn, hp.dropout, is_test)
     ffn = positionwise_ffn(x, hp.d_inner_hid, hp.d_model, hp.dropout, is_test)
     return pre_post_process(x, ffn, hp.dropout, is_test)
 
 
-def decoder_layer(x, enc_out, self_bias, cross_bias, hp, is_test=False):
+def decoder_layer(x, enc_out, self_bias, cross_bias, hp, is_test=False,
+                  self_kpad=None, cross_kpad=None):
+    fused = getattr(hp, "fused_attn", False)
     self_attn = multi_head_attention(
-        x, x, x, self_bias, hp.d_model, hp.n_head, hp.dropout, is_test
+        x, x, x, self_bias, hp.d_model, hp.n_head, hp.dropout, is_test,
+        fused=fused, kpad_bias=self_kpad, causal=fused,
     )
     x = pre_post_process(x, self_attn, hp.dropout, is_test)
     cross = multi_head_attention(
-        x, enc_out, enc_out, cross_bias, hp.d_model, hp.n_head, hp.dropout, is_test
+        x, enc_out, enc_out, cross_bias, hp.d_model, hp.n_head, hp.dropout,
+        is_test, fused=fused, kpad_bias=cross_kpad,
     )
     x = pre_post_process(x, cross, hp.dropout, is_test)
     ffn = positionwise_ffn(x, hp.d_inner_hid, hp.d_model, hp.dropout, is_test)
@@ -160,16 +179,32 @@ def decoder_layer(x, enc_out, self_bias, cross_bias, hp, is_test=False):
 
 def transformer(
     src_ids, trg_ids, src_slf_attn_bias, trg_slf_attn_bias, trg_src_attn_bias,
-    hp=ModelHyperParams, is_test=False
+    hp=ModelHyperParams, is_test=False, trg_kpad_bias=None
 ):
-    """Full encoder-decoder; returns [B, Tt, trg_vocab] logits."""
+    """Full encoder-decoder; returns [B, Tt, trg_vocab] logits.
+
+    When hp.fused_attn is set, attention runs through the fused_attention
+    op: the rank-1 key-padding rows are derived in-graph from the
+    [B, 1, 1, Tk] bias feeds (same feed contract), and decoder causality
+    comes from the kernel's causal flag instead of the dense
+    trg_slf_attn_bias — which requires trg_kpad_bias ([B, Tt], e.g. built
+    from the token-weight feed) since the dense [B, 1, Tt, Tt] bias cannot
+    be passed rank-1."""
+    fused = getattr(hp, "fused_attn", False)
+    src_kpad = cross_kpad = None
+    if fused:
+        src_len = int(src_slf_attn_bias.shape[-1])
+        src_kpad = layers.reshape(src_slf_attn_bias, [-1, src_len])
+        cross_kpad = layers.reshape(trg_src_attn_bias, [-1, src_len])
+        if trg_kpad_bias is None:
+            raise ValueError("hp.fused_attn requires trg_kpad_bias")
     enc_in = prepare_embedding(
         src_ids, hp.src_vocab_size, hp.d_model, hp.max_length, hp.dropout,
         "src_pos_enc_table", is_test,
     )
     x = enc_in
     for _ in range(hp.n_layer):
-        x = encoder_layer(x, src_slf_attn_bias, hp, is_test)
+        x = encoder_layer(x, src_slf_attn_bias, hp, is_test, kpad_bias=src_kpad)
     enc_out = x
 
     dec_in = prepare_embedding(
@@ -178,16 +213,25 @@ def transformer(
     )
     y = dec_in
     for _ in range(hp.n_layer):
-        y = decoder_layer(y, enc_out, trg_slf_attn_bias, trg_src_attn_bias, hp, is_test)
+        y = decoder_layer(
+            y, enc_out, trg_slf_attn_bias, trg_src_attn_bias, hp, is_test,
+            self_kpad=trg_kpad_bias, cross_kpad=cross_kpad,
+        )
 
     logits = layers.fc(y, size=hp.trg_vocab_size, num_flatten_dims=2,
                        bias_attr=False, param_attr=_pa("softmax_out.w"))
     return logits
 
 
-def wmt_transformer_program(hp=ModelHyperParams, src_len=64, trg_len=64, learning_rate=2.0, warmup_steps=4000, is_test=False):
+def wmt_transformer_program(hp=ModelHyperParams, src_len=64, trg_len=64, learning_rate=2.0, warmup_steps=4000, is_test=False, use_bf16=False):
     """Build (main, startup, feed names, [loss]) for training — the analog of
-    the reference's transformer train program w/ label smoothing + noam lr."""
+    the reference's transformer train program w/ label smoothing + noam lr.
+
+    use_bf16 applies the AMP rewrite (bf16 matmuls on the MXU, f32 master
+    weights) before minimize so grads differentiate through the casts.
+    hp.fused_attn additionally routes attention through the fused op; the
+    decoder key-padding row is derived in-graph from the lbl_weight feed
+    (weight 1 = real token), so the feed contract is unchanged."""
     import paddle_tpu as fluid
 
     main = fluid.Program()
@@ -201,7 +245,13 @@ def wmt_transformer_program(hp=ModelHyperParams, src_len=64, trg_len=64, learnin
         cross_bias = layers.data("trg_src_attn_bias", shape=[1, 1, src_len], dtype="float32")
         weights = layers.data("lbl_weight", shape=[trg_len], dtype="float32")
 
-        logits = transformer(src, trg, src_bias, trg_bias, cross_bias, hp, is_test)
+        trg_kpad = None
+        if getattr(hp, "fused_attn", False):
+            # weight w ∈ {0,1} -> bias 0 at real tokens, -1e9 at padding
+            trg_kpad = layers.scale(weights, scale=1e9, bias=-1e9)
+            trg_kpad.stop_gradient = True
+        logits = transformer(src, trg, src_bias, trg_bias, cross_bias, hp,
+                             is_test, trg_kpad_bias=trg_kpad)
         label_oh = layers.one_hot(lbl, hp.trg_vocab_size)
         if hp.label_smooth_eps:
             label_oh = layers.label_smooth(label_oh, epsilon=hp.label_smooth_eps)
@@ -211,6 +261,10 @@ def wmt_transformer_program(hp=ModelHyperParams, src_len=64, trg_len=64, learnin
         token_count = layers.reduce_sum(weights)
         avg_cost = layers.elementwise_div(sum_cost, token_count)
 
+        if use_bf16:
+            from paddle_tpu.contrib.mixed_precision import rewrite_bf16
+
+            rewrite_bf16(main)
         if not is_test:
             lr = layers.learning_rate_scheduler.noam_decay(hp.d_model, warmup_steps)
             lr = layers.scale(lr, scale=float(learning_rate))

@@ -567,17 +567,30 @@ def _fused_attention(ctx, ins, attrs):
     causal = bool(attrs.get("causal", False))
     scale = attrs.get("scale") or 1.0 / (q.shape[-1] ** 0.5)
     b, h, t, d = q.shape
+    tk = k.shape[2]
+    if causal and t != tk:
+        raise ValueError(
+            "fused_attention: causal requires Tq == Tk, got %d vs %d" % (t, tk)
+        )
     qf = q.reshape(b * h, t, d)
-    kf = k.reshape(b * h, t, d)
-    vf = v.reshape(b * h, t, d)
-    if use_pallas() and t % 128 == 0:
-        out = flash_attention(qf, kf, vf, causal, float(scale))
-    elif use_pallas() and t >= 8 and t % 8 == 0:
+    kf = k.reshape(b * h, tk, d)
+    vf = v.reshape(b * h, tk, d)
+    kbias = None
+    if ins.get("Bias"):
+        # additive key-padding bias, rank-1 in the key axis: [B, Tk] (or any
+        # shape squeezing to it, e.g. the reference-style [B, 1, 1, Tk]);
+        # broadcast over heads and query rows without ever materializing
+        # the [Tq, Tk] score matrix
+        kbias = ins["Bias"][0].reshape(b, tk).astype(jnp.float32)
+        kbias = jnp.broadcast_to(kbias[:, None, :], (b, h, tk)).reshape(b * h, tk)
+    if use_pallas() and t == tk and t % 128 == 0:
+        out = flash_attention(qf, kf, vf, kbias, causal, float(scale))
+    elif use_pallas() and t == tk and t >= 8 and t % 8 == 0:
         out = flash_attention(
-            qf, kf, vf, causal, float(scale), block_q=8, block_k=8
+            qf, kf, vf, kbias, causal, float(scale), block_q=8, block_k=8
         )
     else:
-        out = _dense_attention(qf, kf, vf, causal, float(scale))
+        out = _dense_attention(qf, kf, vf, causal, float(scale), kbias)
     return {"Out": [out.reshape(b, h, t, d)]}
 
 

@@ -41,10 +41,12 @@ def _row_block(n, default):
 # ---------------------------------------------------------------------------
 # flash attention
 # ---------------------------------------------------------------------------
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, scale,
-                      q_block):
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, kb_ref, o_ref, *, block_k, causal,
+                      scale, q_block):
     """One (batch*head, q_block) cell: online softmax over k blocks.
-    q_ref: [bq, d]; k_ref/v_ref: [T, d] (whole sequence resident in VMEM)."""
+    q_ref: [bq, d]; k_ref/v_ref: [T, d] (whole sequence resident in VMEM);
+    kb_ref: [1, T] additive key bias (the padding-mask row, broadcast over
+    q rows — rank-1 in T so it never re-materializes the [T,T] scores)."""
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
@@ -58,6 +60,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, scale,
         k = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
         v = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [bq, bk]
+        kb = kb_ref[0, 0, pl.ds(ki * block_k, block_k)].astype(jnp.float32)
+        s = s + kb[None, :]
         if causal:
             q_pos = qi * q_block + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 0
@@ -82,8 +86,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, scale,
     o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
-    """q/k/v: [BH, T, d] -> o [BH, T, d]."""
+def _flash_fwd(q, k, v, kbias, causal, scale, block_q, block_k):
+    """q/k/v: [BH, T, d], kbias: [BH, T] additive key bias -> o [BH, T, d]."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -112,17 +116,21 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, T, d), lambda b, i: (b, 0, 0),
                          memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, T), lambda b, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((BH, T, d), q.dtype),
         interpret=_interpret(),
-    )(q, k, v)
+    )(q, k, v, kbias.reshape(BH, 1, T))
 
 
-def _dense_attention(q, k, v, causal, scale):
+def _dense_attention(q, k, v, causal, scale, kbias=None):
     """XLA reference implementation (used for the backward recompute)."""
     s = jnp.einsum("bqd,bkd->bqk", q, k).astype(jnp.float32) * scale
+    if kbias is not None:
+        s = s + kbias[:, None, :].astype(jnp.float32)
     if causal:
         T = q.shape[1]
         mask = jnp.tril(jnp.ones((T, T), bool))
@@ -131,30 +139,40 @@ def _dense_attention(q, k, v, causal, scale):
     return jnp.einsum("bqk,bkd->bqd", p.astype(q.dtype), v)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
-                    block_k=128):
-    """Fused attention over [BH, T, d] (flash-style online softmax)."""
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def flash_attention(q, k, v, kbias=None, causal=False, scale=None,
+                    block_q=128, block_k=128):
+    """Fused attention over [BH, T, d] (flash-style online softmax).
+    kbias: optional [BH, T] additive key bias (padding mask row)."""
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
-    return _flash_fwd(q, k, v, causal, scale, block_q, block_k)
+    kb = kbias if kbias is not None else jnp.zeros(q.shape[:2], jnp.float32)
+    return _flash_fwd(q, k, v, kb, causal, scale, block_q, block_k)
 
 
-def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k):
+def _flash_vjp_fwd(q, k, v, kbias, causal, scale, block_q, block_k):
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
-    o = _flash_fwd(q, k, v, causal, scale, block_q, block_k)
-    return o, (q, k, v)
+    kb = kbias if kbias is not None else jnp.zeros(q.shape[:2], jnp.float32)
+    o = _flash_fwd(q, k, v, kb, causal, scale, block_q, block_k)
+    return o, (q, k, v, kbias)
 
 
 def _flash_vjp_bwd(causal, scale, block_q, block_k, res, do):
-    q, k, v = res
+    q, k, v, kbias = res
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     # recompute-based backward: XLA fuses the re-derived softmax with the
     # grad matmuls; trades FLOPs for never materializing fwd residuals
-    _, vjp = jax.vjp(lambda q, k, v: _dense_attention(q, k, v, causal, scale),
-                     q, k, v)
+    if kbias is None:
+        _, vjp = jax.vjp(
+            lambda q, k, v: _dense_attention(q, k, v, causal, scale), q, k, v
+        )
+        return vjp(do) + (None,)
+    _, vjp = jax.vjp(
+        lambda q, k, v, kb: _dense_attention(q, k, v, causal, scale, kb),
+        q, k, v, kbias,
+    )
     return vjp(do)
 
 

@@ -17,3 +17,5 @@ from .sharding import ShardingRules, data_parallel_rules, transformer_tp_rules
 from .executor import DistributedExecutor
 from . import ring
 from . import collective
+from . import pipeline
+from . import moe

@@ -1,0 +1,128 @@
+"""Pipeline parallelism (pp axis): GPipe-style fill-drain schedule as pure
+SPMD over a mesh axis.
+
+The reference framework has no pipeline engine (its multi-device story is
+data-parallel only — SURVEY §2.9); this is the TPU-native extension that
+completes the dp/mp/pp/sp/ep parallelism set.  Design: every pipeline
+stage lives on one slice of the `pp` mesh axis, activations hop stage to
+stage over ICI with `ppermute`, and the whole schedule is a `lax.scan`
+inside one `shard_map` — so XLA sees a single static program, and
+`jax.grad` differentiates straight through it (the transpose of ppermute
+is the reverse-direction ppermute, which yields the backward pipeline for
+free — no hand-written 1F1B needed).
+
+Schedule: classic GPipe fill-drain over M microbatches and S stages
+(M + S - 1 ticks).  Bubble fraction (S-1)/(M+S-1) shrinks as M grows;
+choose M a multiple of S where possible.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def stack_stage_params(params_list):
+    """[per-stage pytree, ...] -> one pytree with a leading stage dim,
+    ready to shard along the pp axis (each device holds its stage's
+    slice)."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves, axis=0), *params_list
+    )
+
+
+def gpipe(stage_fn, mesh, axis="pp", n_microbatches=None):
+    """Build a pipelined apply: fn(stacked_params, x) -> y.
+
+    stage_fn(params, x_mb) -> y_mb computes ONE stage on ONE microbatch;
+    all stages must map equal shapes (x_mb and y_mb shapes match across
+    stages).  stacked_params: pytree with leading stage dim S == mesh
+    size along `axis` (see stack_stage_params).  x: [B, ...] global
+    batch; B must divide into n_microbatches (default: S).
+
+    Returns the full [B, ...] output replicated along `axis` (the last
+    stage's result is broadcast back with a psum, one small collective).
+    """
+    S = mesh.shape[axis]
+
+    def _pipelined(stacked_params, x):
+        M = n_microbatches or S
+        B = x.shape[0]
+        assert B % M == 0, "batch %d must divide microbatches %d" % (B, M)
+        mb = B // M
+        xm = x.reshape((M, mb) + x.shape[1:])
+
+        def per_device(params, xm_local):
+            # params leaves arrive as [1, ...] (this device's stage slice)
+            params = jax.tree_util.tree_map(lambda p: p[0], params)
+            idx = jax.lax.axis_index(axis)
+            ticks = M + S - 1
+            zero = jnp.zeros_like(xm_local[0])
+            fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+
+            def tick(carry, t):
+                recv = carry
+                # stage 0 injects microbatch t during the fill phase;
+                # later stages consume what arrived from the left
+                inject = xm_local[jnp.minimum(t, M - 1)]
+                use_inject = jnp.logical_and(idx == 0, t < M)
+                inp = jnp.where(use_inject, inject, recv)
+                out = stage_fn(params, inp)
+                nxt = jax.lax.ppermute(out, axis, fwd_perm)
+                # last stage emits microbatch t-(S-1) at tick t
+                emit = jnp.where(
+                    jnp.logical_and(idx == S - 1, t >= S - 1), out, zero
+                )
+                return nxt, emit
+
+            _, emitted = jax.lax.scan(tick, zero, jnp.arange(ticks))
+            # emitted: [ticks, mb, ...]; microbatch m sits at tick m+S-1
+            ym = emitted[S - 1 :]
+            # broadcast the last stage's result to every pp slice so the
+            # caller sees a replicated [B, ...] output
+            ym = jax.lax.psum(
+                jnp.where(idx == S - 1, ym, jnp.zeros_like(ym)), axis
+            )
+            return ym.reshape((M * mb,) + ym.shape[2:])
+
+        from jax.experimental.shard_map import shard_map
+
+        spec_params = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+        return shard_map(
+            per_device,
+            mesh=mesh,
+            in_specs=(spec_params, P()),
+            out_specs=P(),
+            check_rep=False,
+        )(stacked_params, xm)
+
+    return _pipelined
+
+
+def pipeline_mlp_stages(widths, dtype=jnp.float32):
+    """Convenience: equal-width MLP stages for tests/dryrun.  widths is the
+    shared layer width; returns (stage_fn, params_list builder output)."""
+
+    def stage_fn(params, x):
+        h = jnp.tanh(x @ params["w1"] + params["b1"])
+        return h @ params["w2"] + params["b2"]
+
+    def init_stage(k):
+        k1, k2 = jax.random.split(k)
+        scale = 1.0 / jnp.sqrt(widths)
+        return {
+            "w1": jax.random.normal(k1, (widths, widths), dtype) * scale,
+            "w2": jax.random.normal(k2, (widths, widths), dtype) * scale,
+            "b1": jnp.zeros((widths,), dtype),
+            "b2": jnp.zeros((widths,), dtype),
+        }
+
+    return stage_fn, init_stage
+
+
+def sequential_reference(stage_fn, params_list, x):
+    """Single-device reference: apply stages in order (for parity tests)."""
+    for p in params_list:
+        x = stage_fn(p, x)
+    return x

@@ -94,6 +94,15 @@ def program_flops(program, batch_hint=1):
             n2 = y[-2] if ty else y[-1] if len(y) > 1 else 1
             batch = _prod(x[:-2]) if len(x) > 2 else 1
             total += factor * 2.0 * batch * m * k * n2
+        elif t == "fused_attention":
+            # QK^T + PV: 2 matmuls of [B*H, Tq, d] x [B*H, d, Tk]
+            q = _shape(blk, op.inputs.get("Q", [""])[0], batch_hint)
+            k = _shape(blk, op.inputs.get("K", [""])[0], batch_hint)
+            if not q or not k or len(q) != 4:
+                continue
+            b, h, tq, d = q
+            tk = k[2]
+            total += factor * 2.0 * 2.0 * b * h * tq * tk * d
     return total
 
 

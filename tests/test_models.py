@@ -65,6 +65,60 @@ def test_transformer_trains():
     assert losses[-1] < losses[0], losses
 
 
+def test_transformer_fused_attention_matches_dense():
+    """hp.fused_attn (flash-style fused attention + in-graph key-pad bias
+    derivation) gives the same loss as the dense-bias path with identical
+    weights (dropout off so both paths are deterministic), and trains."""
+    import paddle_tpu.framework as fw
+    from paddle_tpu import unique_name
+    from paddle_tpu.core import scope as scope_mod
+
+    class DetHP(TinyHP):
+        dropout = 0.0
+
+    class FusedHP(DetHP):
+        fused_attn = True
+
+    def run(hp, steps=3):
+        fw.switch_main_program(fluid.Program())
+        fw.switch_startup_program(fluid.Program())
+        unique_name.switch()
+        scope_mod._switch_scope(scope_mod.Scope())
+        main, startup, feeds, fetches = tfm.wmt_transformer_program(
+            hp, src_len=8, trg_len=8, warmup_steps=10
+        )
+        startup.random_seed = 11
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        losses = []
+        for i in range(steps):
+            batch = tfm.make_fake_batch(4, 8, 8, hp, seed=i)
+            out = exe.run(main, feed=batch, fetch_list=fetches)
+            losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
+        return losses
+
+    dense = run(DetHP)
+    fused = run(FusedHP)
+    np.testing.assert_allclose(fused, dense, rtol=2e-3, atol=2e-4)
+
+
+def test_transformer_bf16_trains():
+    """use_bf16 AMP rewrite on the transformer program still trains to a
+    finite, decreasing loss."""
+    main, startup, feeds, fetches = tfm.wmt_transformer_program(
+        TinyHP, src_len=8, trg_len=8, warmup_steps=10, use_bf16=True
+    )
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    losses = []
+    for i in range(6):
+        batch = tfm.make_fake_batch(4, 8, 8, TinyHP, seed=0)
+        out = exe.run(main, feed=batch, fetch_list=fetches)
+        losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
+    assert np.isfinite(losses).all(), losses
+    assert losses[-1] < losses[0], losses
+
+
 def test_vgg16_trains():
     """benchmark/fluid/models/vgg.py capability: tiny VGG-16 train step."""
     from paddle_tpu.models.vgg import vgg16

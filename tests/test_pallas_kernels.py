@@ -24,9 +24,21 @@ def test_flash_attention_matches_dense(causal):
     k = jnp.asarray(rng.randn(bh, t, d).astype("float32"))
     v = jnp.asarray(rng.randn(bh, t, d).astype("float32"))
     scale = 1.0 / np.sqrt(d)
-    out = flash_attention(q, k, v, causal, scale, 8, 8)
+    out = flash_attention(q, k, v, None, causal, scale, 8, 8)
     ref = _dense_attention(q, k, v, causal, scale)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+    # key-padding bias path: mask out the tail keys of each row
+    kbias = np.zeros((bh, t), "float32")
+    kbias[:, t - 5:] = -1e9
+    kbias = jnp.asarray(kbias)
+    out_b = flash_attention(q, k, v, kbias, causal, scale, 8, 8)
+    ref_b = _dense_attention(q, k, v, causal, scale, kbias)
+    np.testing.assert_allclose(np.asarray(out_b), np.asarray(ref_b), rtol=2e-4, atol=2e-5)
+    # masked keys must not influence the output: perturbing them is a no-op
+    v_pert = v.at[:, t - 5:, :].add(7.0)
+    out_p = flash_attention(q, k, v_pert, kbias, causal, scale, 8, 8)
+    np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_b), rtol=2e-4, atol=2e-5)
 
 
 def test_flash_attention_grads_match_dense():
@@ -38,7 +50,7 @@ def test_flash_attention_grads_match_dense():
     scale = 1.0 / np.sqrt(d)
 
     def loss_flash(q, k, v):
-        return jnp.sum(flash_attention(q, k, v, True, scale, 8, 8) ** 2)
+        return jnp.sum(flash_attention(q, k, v, None, True, scale, 8, 8) ** 2)
 
     def loss_dense(q, k, v):
         return jnp.sum(_dense_attention(q, k, v, True, scale) ** 2)

@@ -195,6 +195,44 @@ def test_program_flops_resnet_matches_known_count():
     assert 20e9 < per_img < 26e9, per_img
 
 
+def test_program_flops_counts_fused_attention():
+    """The fused_attention op contributes its QK^T+PV FLOPs, so the fused
+    transformer program counts within ~2% of the dense-bias one (the dense
+    path's extra elementwise bias-add is not FLOPs-counted)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.models import transformer as tfm
+    from paddle_tpu.utils import flops as flops_util
+
+    def build(fused):
+        import paddle_tpu.framework as fw
+        from paddle_tpu import unique_name
+        from paddle_tpu.core import scope as scope_mod
+
+        fw.switch_main_program(fluid.Program())
+        fw.switch_startup_program(fluid.Program())
+        unique_name.switch()
+        scope_mod._switch_scope(scope_mod.Scope())
+
+        class HP(tfm.ModelHyperParams):
+            src_vocab_size = 64
+            trg_vocab_size = 64
+            max_length = 16
+            d_model = 32
+            d_inner_hid = 64
+            n_head = 4
+            n_layer = 2
+            dropout = 0.0
+            fused_attn = fused
+
+        main, _, _, _ = tfm.wmt_transformer_program(HP, src_len=8, trg_len=8)
+        return flops_util.program_flops(main, batch_hint=4)
+
+    dense = build(False)
+    fused = build(True)
+    assert dense > 0 and fused > 0
+    assert abs(fused - dense) / dense < 0.02, (fused, dense)
+
+
 def test_chip_peak_flops_lookup():
     from paddle_tpu.utils import flops as fu
 
