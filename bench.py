@@ -121,6 +121,7 @@ def _bench_impl():
             result["transformer"] = _transformer_bench(on_tpu, device)
         except Exception as e:  # the headline number must still land
             sys.stderr.write("transformer bench failed: %r\n" % (e,))
+            result["transformer_error"] = repr(e)[:300]
     print(json.dumps(result))
 
 

@@ -1,0 +1,200 @@
+"""Multi-process training launcher — the TPU-native analog of the
+reference's cluster tooling (`paddle/scripts/submit_local.sh.in` `paddle`
+CLI wrapper and `paddle/scripts/cluster_train/` fabric launchers): one
+command that spawns a local cluster with the PADDLE_* env contract wired.
+
+Two modes:
+
+- collective (default, the "nccl2"/multi-host DP path):
+    python -m paddle_tpu.distributed.launch --nproc 2 train.py [args...]
+  Each rank gets PADDLE_TRAINER_ID / PADDLE_TRAINERS /
+  PADDLE_TRAINER_ENDPOINTS / PADDLE_CURRENT_ENDPOINT; scripts call
+  `paddle_tpu.distributed.init_collective()` (rank-0 endpoint is the
+  jax.distributed coordinator).
+
+- pserver (the transpiler's parameter-server path):
+    python -m paddle_tpu.distributed.launch --mode pserver \
+        --nproc 2 --pservers 2 train.py [args...]
+  Spawns pserver roles first (PADDLE_TRAINING_ROLE=PSERVER with
+  PADDLE_CURRENT_ENDPOINT), waits for their ports, then trainer roles
+  (PADDLE_TRAINING_ROLE=TRAINER with PADDLE_TRAINER_ID); all share
+  PADDLE_PSERVER_EPS / PADDLE_TRAINERS.
+
+Output is streamed line-by-line with a [role.rank] prefix.  The first
+non-zero child exit kills the whole cluster (exception_holder.h's
+fail-fast contract, process-level); the launcher returns that code.
+"""
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait_port(endpoint, timeout=60):
+    host, port = endpoint.rsplit(":", 1)
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        try:
+            socket.create_connection((host, int(port)), timeout=1).close()
+            return True
+        except OSError:
+            time.sleep(0.2)
+    return False
+
+
+class _Cluster:
+    """Spawned children with streamed output and fail-fast teardown."""
+
+    def __init__(self):
+        self.procs = []  # (tag, Popen)
+        self._lock = threading.Lock()
+        self.failed_rc = None
+
+    def spawn(self, tag, cmd, env):
+        proc = subprocess.Popen(
+            cmd,
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            bufsize=1,
+        )
+        t = threading.Thread(target=self._pump, args=(tag, proc), daemon=True)
+        t.start()
+        self.procs.append((tag, proc, t))
+        return proc
+
+    def _pump(self, tag, proc):
+        for line in proc.stdout:
+            sys.stdout.write("[%s] %s" % (tag, line))
+            sys.stdout.flush()
+        rc = proc.wait()
+        if rc != 0:
+            with self._lock:
+                if self.failed_rc is None:
+                    self.failed_rc = rc
+                    sys.stderr.write(
+                        "[launch] %s exited rc=%d — stopping cluster\n" % (tag, rc)
+                    )
+
+    def wait(self, poll=0.2):
+        """Wait for all children; kill everything on first failure."""
+        while True:
+            with self._lock:
+                failed = self.failed_rc
+            if failed is not None:
+                self.kill()
+                return failed
+            if all(p.poll() is not None for _, p, _ in self.procs):
+                for _, _, t in self.procs:
+                    t.join(timeout=5)
+                rcs = [p.returncode for _, p, _ in self.procs]
+                return max(rcs) if rcs else 0
+            time.sleep(poll)
+
+    def kill(self):
+        for _, p, _ in self.procs:
+            if p.poll() is None:
+                p.kill()
+        for _, p, t in self.procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+            t.join(timeout=5)
+
+
+def launch_collective(script_argv, nproc, base_env=None):
+    eps = ",".join("127.0.0.1:%d" % free_port() for _ in range(nproc))
+    cluster = _Cluster()
+    ep_list = eps.split(",")
+    for rank in range(nproc):
+        env = dict(base_env or os.environ)
+        env.update(
+            PADDLE_TRAINER_ID=str(rank),
+            PADDLE_TRAINERS=str(nproc),
+            PADDLE_TRAINER_ENDPOINTS=eps,
+            PADDLE_CURRENT_ENDPOINT=ep_list[rank],
+        )
+        cluster.spawn(
+            "trainer.%d" % rank, [sys.executable, "-u"] + script_argv, env
+        )
+    return cluster.wait()
+
+
+def launch_pserver(script_argv, nproc, n_pservers, base_env=None, sync=True):
+    ports = [free_port() for _ in range(n_pservers)]
+    eps = ",".join("127.0.0.1:%d" % p for p in ports)
+    common = dict(base_env or os.environ)
+    common.update(
+        PADDLE_PSERVER_EPS=eps,
+        PADDLE_TRAINERS=str(nproc),
+        DIST_SYNC_MODE="1" if sync else "0",
+    )
+    cluster = _Cluster()
+    for i, p in enumerate(ports):
+        env = dict(common)
+        env.update(
+            PADDLE_TRAINING_ROLE="PSERVER",
+            PADDLE_CURRENT_ENDPOINT="127.0.0.1:%d" % p,
+        )
+        cluster.spawn("pserver.%d" % i, [sys.executable, "-u"] + script_argv, env)
+    for p in ports:
+        if not _wait_port("127.0.0.1:%d" % p):
+            sys.stderr.write("[launch] pserver port %d never opened\n" % p)
+            cluster.kill()
+            return 1
+    for rank in range(nproc):
+        env = dict(common)
+        env.update(
+            PADDLE_TRAINING_ROLE="TRAINER",
+            PADDLE_TRAINER_ID=str(rank),
+        )
+        cluster.spawn("trainer.%d" % rank, [sys.executable, "-u"] + script_argv, env)
+    return cluster.wait()
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="spawn a local training cluster with the PADDLE_* env contract",
+    )
+    parser.add_argument("--nproc", type=int, default=2, help="trainer count")
+    parser.add_argument(
+        "--mode", choices=("collective", "pserver"), default="collective"
+    )
+    parser.add_argument(
+        "--pservers", type=int, default=2, help="pserver count (pserver mode)"
+    )
+    parser.add_argument(
+        "--async-mode", action="store_true",
+        help="pserver mode: async updates (no barriers)",
+    )
+    parser.add_argument("script", help="training script")
+    parser.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+
+    script_argv = [args.script] + args.script_args
+    if args.mode == "collective":
+        rc = launch_collective(script_argv, args.nproc)
+    else:
+        rc = launch_pserver(
+            script_argv, args.nproc, args.pservers, sync=not args.async_mode
+        )
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

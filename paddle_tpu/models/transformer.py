@@ -114,6 +114,13 @@ def multi_head_attention(
     q, k, v = split_heads(q), split_heads(k), split_heads(v)
     dh = d_model // n_head
     if fused:
+        if attn_bias is not None and kpad_bias is None:
+            raise ValueError(
+                "fused attention cannot consume the dense [B,H,Tq,Tk] "
+                "attn_bias — pass its rank-1 key-padding row as kpad_bias "
+                "(plus causal=True for decoder self-attention) or use "
+                "fused=False"
+            )
         ctx = layers.fused_attention(
             q, k, v, bias=kpad_bias, causal=causal, scale=dh ** -0.5
         )  # [B, H, Tq, Dh]

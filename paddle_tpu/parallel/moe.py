@@ -22,30 +22,33 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 
-def stack_expert_params(params_list):
-    """[per-expert pytree, ...] -> pytree with leading expert dim E."""
-    return jax.tree_util.tree_map(
-        lambda *leaves: jnp.stack(leaves, axis=0), *params_list
-    )
+from .pipeline import stack_stage_params as _stack_params
+
+# same leading-dim stacking as pipeline stages, one shared body
+stack_expert_params = _stack_params
 
 
 def _dispatch_tensors(xl, gate_w, n_experts, capacity):
     """Top-1 routing of local tokens: returns (dispatch [B,E,C] one-hot,
-    combine [B,E,C] prob-weighted, aux load-balance loss)."""
-    logits = xl @ gate_w  # [B, E]
+    combine [B,E,C] prob-weighted, aux load-balance loss).
+
+    Routing bookkeeping (one-hots, cumsum positions) runs in float32
+    regardless of the activation dtype: a bf16 cumsum goes inexact past
+    256 tokens-per-expert and would silently double-book bucket slots."""
+    logits = (xl @ gate_w).astype(jnp.float32)  # [B, E]
     probs = jax.nn.softmax(logits, axis=-1)
     expert = jnp.argmax(probs, axis=-1)  # [B]
     gate = jnp.max(probs, axis=-1)  # [B]
-    onehot = jax.nn.one_hot(expert, n_experts, dtype=xl.dtype)  # [B, E]
+    onehot = jax.nn.one_hot(expert, n_experts, dtype=jnp.float32)  # [B, E]
     # position of each token inside its expert's bucket (among local tokens)
     pos = jnp.cumsum(onehot, axis=0) * onehot - onehot  # [B, E], int-valued
-    in_cap = (pos < capacity).astype(xl.dtype) * onehot
+    in_cap = (pos < capacity).astype(jnp.float32) * onehot
     pos_oh = jax.nn.one_hot(
         jnp.sum(pos * onehot, axis=-1).astype(jnp.int32), capacity,
-        dtype=xl.dtype,
+        dtype=jnp.float32,
     )  # [B, C]
-    dispatch = in_cap[:, :, None] * pos_oh[:, None, :]  # [B, E, C]
-    combine = dispatch * gate[:, None, None]
+    dispatch = (in_cap[:, :, None] * pos_oh[:, None, :]).astype(xl.dtype)
+    combine = dispatch * gate[:, None, None].astype(xl.dtype)
     # Switch aux loss: E * sum_e fraction_routed_e * mean_prob_e
     frac = jnp.mean(onehot, axis=0)
     mean_p = jnp.mean(probs, axis=0)

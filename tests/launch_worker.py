@@ -1,0 +1,63 @@
+"""Worker for the launcher test: bootstrap via the launcher-provided
+PADDLE_* env (init_collective), then psum the ranks across processes."""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+_flags = [
+    f
+    for f in os.environ.get("XLA_FLAGS", "").split()
+    if not f.startswith("--xla_force_host_platform_device_count")
+]
+_flags.append("--xla_force_host_platform_device_count=1")
+os.environ["XLA_FLAGS"] = " ".join(_flags)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from paddle_tpu import distributed
+
+    if os.environ.get("LAUNCH_WORKER_FAIL_RANK") == os.environ.get(
+        "PADDLE_TRAINER_ID"
+    ):
+        sys.exit(3)
+
+    distributed.init_collective()
+    nproc = int(os.environ["PADDLE_TRAINERS"])
+    assert jax.process_count() == nproc, jax.process_count()
+
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+    from jax.sharding import NamedSharding
+
+    mesh = Mesh(np.array(jax.devices()), ("x",))
+    rank_local = np.asarray([float(jax.process_index())], np.float32)
+    ranks = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("x")), rank_local, (nproc,)
+    )
+
+    f = jax.jit(
+        shard_map(
+            lambda r: jax.lax.psum(r, "x"),
+            mesh=mesh,
+            in_specs=P("x"),
+            out_specs=P("x"),
+        )
+    )
+    local = np.asarray(f(ranks).addressable_data(0))
+    print("PSUM %.1f" % float(local[0]), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,6 +1,7 @@
 """Model zoo smoke tests (tiny shapes): resnet cifar, mnist cnn, transformer."""
 
 import numpy as np
+import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu import layers
@@ -101,12 +102,23 @@ def test_transformer_fused_attention_matches_dense():
     fused = run(FusedHP)
     np.testing.assert_allclose(fused, dense, rtol=2e-3, atol=2e-4)
 
+    # the fused path must refuse to silently drop a dense attn_bias
+    q = layers.data("guard_q", shape=[4, 32])
+    bias = layers.data("guard_b", shape=[1, 4, 4])
+    with pytest.raises(ValueError, match="kpad_bias"):
+        tfm.multi_head_attention(q, q, q, bias, 32, 4, fused=True)
+
 
 def test_transformer_bf16_trains():
     """use_bf16 AMP rewrite on the transformer program still trains to a
-    finite, decreasing loss."""
+    finite, decreasing loss — with fused_attn on, i.e. the exact on-TPU
+    bench default (exercises the Bias-stays-f32 slot handling)."""
+
+    class FusedBF16HP(TinyHP):
+        fused_attn = True
+
     main, startup, feeds, fetches = tfm.wmt_transformer_program(
-        TinyHP, src_len=8, trg_len=8, warmup_steps=10, use_bf16=True
+        FusedBF16HP, src_len=8, trg_len=8, warmup_steps=10, use_bf16=True
     )
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(startup)
