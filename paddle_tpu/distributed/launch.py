@@ -42,7 +42,10 @@ def free_port():
     return port
 
 
-def _wait_port(endpoint, timeout=60):
+def _wait_port(endpoint, timeout=60, cluster=None):
+    """Poll until the endpoint accepts connections; abort early (False)
+    if any already-spawned child has died — waiting out the full timeout
+    on a crashed pserver would mask its exit code."""
     host, port = endpoint.rsplit(":", 1)
     t0 = time.time()
     while time.time() - t0 < timeout:
@@ -50,6 +53,10 @@ def _wait_port(endpoint, timeout=60):
             socket.create_connection((host, int(port)), timeout=1).close()
             return True
         except OSError:
+            if cluster is not None and any(
+                p.poll() not in (None, 0) for _, p, _ in cluster.procs
+            ):
+                return False
             time.sleep(0.2)
     return False
 
@@ -100,8 +107,12 @@ class _Cluster:
             if all(p.poll() is not None for _, p, _ in self.procs):
                 for _, _, t in self.procs:
                     t.join(timeout=5)
-                rcs = [p.returncode for _, p, _ in self.procs]
-                return max(rcs) if rcs else 0
+                # first nonzero (incl. negative signal-kill codes) wins —
+                # max() would mask a SIGKILLed child behind a clean peer
+                for _, p, _ in self.procs:
+                    if p.returncode != 0:
+                        return p.returncode
+                return 0
             time.sleep(poll)
 
     def kill(self):
@@ -152,10 +163,12 @@ def launch_pserver(script_argv, nproc, n_pservers, base_env=None, sync=True):
         )
         cluster.spawn("pserver.%d" % i, [sys.executable, "-u"] + script_argv, env)
     for p in ports:
-        if not _wait_port("127.0.0.1:%d" % p):
+        if not _wait_port("127.0.0.1:%d" % p, cluster=cluster):
             sys.stderr.write("[launch] pserver port %d never opened\n" % p)
             cluster.kill()
-            return 1
+            dead = [pr.returncode for _, pr, _ in cluster.procs
+                    if pr.returncode not in (None, 0)]
+            return dead[0] if dead else 1
     for rank in range(nproc):
         env = dict(common)
         env.update(

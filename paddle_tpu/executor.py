@@ -33,6 +33,14 @@ def as_numpy(value):
     return np.asarray(jax.device_get(value))
 
 
+def _dtype_kind(dt):
+    """numpy kind with bfloat16/ml_dtypes ('V') treated as float."""
+    if str(dt) == "bfloat16":
+        return "f"
+    k = np.dtype(str(dt)).kind
+    return "f" if k == "V" else k
+
+
 class Executor:
     def __init__(self, place=None):
         self.place = place if place is not None else default_place()
@@ -99,7 +107,23 @@ class Executor:
                     jnp.asarray(value.seq_lens()), device
                 )
             else:
-                feed_arrays[name] = jax.device_put(jnp.asarray(value), device)
+                arr = jnp.asarray(value)
+                var = program.global_block()._find_var_recursive(name)
+                if var is not None and var.dtype:
+                    # kind-level check (int vs float vs bool): silently
+                    # flooring float ids into an embedding lookup is the
+                    # classic garbage-in bug the reference's DataFeeder
+                    # enforce guards against; width-only differences
+                    # (int32/int64, f32/f64) stay allowed
+                    want = _dtype_kind(var.dtype)
+                    got = _dtype_kind(arr.dtype)
+                    if want != got and {want, got} != {"i", "u"}:
+                        raise TypeError(
+                            "feed '%s' has dtype %s but the program declares "
+                            "%s — cast the feed (DataFeeder does this) or fix "
+                            "the data layer dtype" % (name, arr.dtype, var.dtype)
+                        )
+                feed_arrays[name] = jax.device_put(arr, device)
 
         # in-program readers: satisfy `read` op outputs from the staged
         # device queue (create_py_reader/double_buffer analog — host IO

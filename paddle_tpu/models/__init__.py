@@ -1,7 +1,9 @@
 """Model zoo (benchmark/fluid/models + tests/book model roles)."""
 
 from . import (
+    bert,
     ctr_deepfm,
+    gpt2,
     machine_translation,
     mnist,
     resnet,

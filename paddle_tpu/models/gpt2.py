@@ -1,0 +1,127 @@
+"""GPT-2-style decoder-only LM (BASELINE.json config 5: "ERNIE / GPT-2
+345M (TP+DP on TPU mesh via DistributeTranspiler->GSPMD)").
+
+Pre-LN causal transformer: x + attn(ln(x)), x + ffn(ln(x)) with GELU,
+final ln, untied LM head.  Attention always goes through the
+fused_attention op with causal=True — no [T, T] mask tensor ever exists
+in the program (the op's flash kernel runs under FLAGS_use_pallas, fused
+XLA otherwise).  Parameter names reuse the transformer TP patterns
+(mha_[qkv].w / mha_o.w / ffn_in.w / ffn_out.w / emb.w / softmax_out.w) so
+`parallel.transformer_tp_rules` shards this model unchanged on a
+{dp, mp} mesh.
+"""
+
+import numpy as np
+
+from .. import layers, unique_name
+from ..initializer import Normal
+from ..param_attr import ParamAttr
+
+__all__ = ["GPT2Config", "gpt2_lm", "gpt2_lm_program", "make_fake_lm_batch"]
+
+
+class GPT2Config:
+    """gpt2-small shape defaults (345M config: d_model=1024, n_layer=24,
+    n_head=16); subclass to shrink for tests."""
+
+    vocab_size = 50257
+    n_ctx = 1024
+    d_model = 768
+    n_layer = 12
+    n_head = 12
+    dropout = 0.1
+
+
+def _pa(base, std=0.02):
+    return ParamAttr(
+        name=unique_name.generate(base), initializer=Normal(0.0, std)
+    )
+
+
+def _attn(x, hp, is_test):
+    """Causal self-attention via the shared transformer block (same graph,
+    same mha_* param names, one fused-path implementation to maintain)."""
+    from . import transformer as tfm
+
+    return tfm.multi_head_attention(
+        x, x, x, None, hp.d_model, hp.n_head, dropout_rate=0.0,
+        is_test=is_test, fused=True, causal=True,
+    )
+
+
+def _block(x, hp, is_test):
+    a = _attn(layers.layer_norm(x, begin_norm_axis=2), hp, is_test)
+    if hp.dropout and not is_test:
+        a = layers.dropout(a, hp.dropout, is_test=is_test)
+    x = layers.elementwise_add(x, a)
+    h = layers.fc(
+        layers.layer_norm(x, begin_norm_axis=2), size=4 * hp.d_model,
+        num_flatten_dims=2, act="gelu",
+        param_attr=_pa("ffn_in.w"), bias_attr=_pa("ffn_in.b"),
+    )
+    h = layers.fc(h, size=hp.d_model, num_flatten_dims=2,
+                  param_attr=_pa("ffn_out.w"))
+    if hp.dropout and not is_test:
+        h = layers.dropout(h, hp.dropout, is_test=is_test)
+    return layers.elementwise_add(x, h)
+
+
+def gpt2_lm(ids, hp=GPT2Config, is_test=False):
+    """[B, T] token ids -> [B, T, vocab] next-token logits."""
+    tok = layers.embedding(
+        ids, size=[hp.vocab_size, hp.d_model], param_attr=_pa("emb.w")
+    )
+    pos_table = layers.create_parameter(
+        shape=[hp.n_ctx, hp.d_model], dtype="float32", attr=_pa("pos_emb.w", 0.01)
+    )
+    T = ids.shape[1]
+    pos = layers.slice(pos_table, axes=[0], starts=[0], ends=[T])
+    x = layers.elementwise_add(tok, pos, axis=1)
+    if hp.dropout and not is_test:
+        x = layers.dropout(x, hp.dropout, is_test=is_test)
+    for _ in range(hp.n_layer):
+        x = _block(x, hp, is_test)
+    x = layers.layer_norm(x, begin_norm_axis=2)
+    return layers.fc(x, size=hp.vocab_size, num_flatten_dims=2,
+                     bias_attr=False, param_attr=_pa("softmax_out.w"))
+
+
+def gpt2_lm_program(hp=GPT2Config, seq_len=128, lr=3e-4, is_test=False,
+                    use_bf16=False):
+    """Build (main, startup, feeds, [loss, token_count]) for causal-LM
+    training.  Feeds: ids/labels [B, T] int64, loss_weight [B, T] float."""
+    import paddle_tpu as fluid
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", shape=[seq_len], dtype="int64")
+        lbl = layers.data("labels", shape=[seq_len], dtype="int64")
+        w = layers.data("loss_weight", shape=[seq_len], dtype="float32")
+
+        logits = gpt2_lm(ids, hp, is_test)
+        cost = layers.softmax_with_cross_entropy(
+            logits, layers.unsqueeze(lbl, [2])
+        )
+        cost = layers.elementwise_mul(cost, layers.unsqueeze(w, [2]))
+        tokens = layers.reduce_sum(w)
+        loss = layers.elementwise_div(layers.reduce_sum(cost), tokens)
+
+        if use_bf16:
+            from paddle_tpu.contrib.mixed_precision import rewrite_bf16
+
+            rewrite_bf16(main)
+        if not is_test:
+            fluid.optimizer.Adam(learning_rate=lr).minimize(loss)
+
+    return main, startup, ["ids", "labels", "loss_weight"], [loss, tokens]
+
+
+def make_fake_lm_batch(batch_size, seq_len, hp=GPT2Config, seed=0):
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(1, hp.vocab_size, (batch_size, seq_len + 1)).astype("int64")
+    return {
+        "ids": ids[:, :-1],
+        "labels": ids[:, 1:],
+        "loss_weight": np.ones((batch_size, seq_len), "float32"),
+    }

@@ -88,3 +88,21 @@ def test_save_load_inference_roundtrip(tmp_path):
             infer_prog, feed={feed_names[0]: x}, fetch_list=fetch_vars
         )
     np.testing.assert_allclose(before, after, rtol=1e-5, atol=1e-6)
+
+
+def test_feed_dtype_kind_mismatch_raises():
+    """Float feed into an int64 data slot errors clearly instead of
+    silently flooring ids (the DataFeeder enforce contract)."""
+    import pytest
+
+    ids = fluid.layers.data("dt_ids", shape=[1], dtype="int64")
+    emb = fluid.layers.embedding(ids, size=[10, 4])
+    out = fluid.layers.mean(emb)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    with pytest.raises(TypeError, match="dtype"):
+        exe.run(feed={"dt_ids": np.random.rand(4, 1).astype("float32")},
+                fetch_list=[out])
+    # int32 into int64 stays allowed (width-only difference)
+    (v,) = exe.run(feed={"dt_ids": np.zeros((4, 1), "int32")}, fetch_list=[out])
+    assert np.isfinite(np.asarray(v)).all()
