@@ -43,6 +43,21 @@ def _bench_impl():
     steps = max(1, int(os.environ.get("BENCH_STEPS", 20 if on_tpu else 3)))
     warmup = max(1, int(os.environ.get("BENCH_WARMUP", 3 if on_tpu else 1)))
 
+    # BENCH_ONLY=transformer: diagnostic mode — skip the ResNet leg and
+    # emit just the transformer result (not a driver-format headline)
+    if os.environ.get("BENCH_ONLY") == "transformer":
+        diag_place = fluid.TPUPlace(0) if on_tpu else fluid.CPUPlace()
+        out = {"metric": "transformer_only_diag"}
+        try:
+            out["transformer"] = _transformer_bench(on_tpu, diag_place.jax_device())
+        except Exception as e:
+            import traceback
+
+            traceback.print_exc()
+            out["transformer_error"] = repr(e)[:300]
+        print(json.dumps(out))
+        return
+
     use_bf16 = os.environ.get("BENCH_BF16", "1" if on_tpu else "0") == "1"
     # BENCH_READER=1 measures the --use_reader_op path (in-program
     # py_reader, H2D overlapped).  Default is the once-staged device batch:

@@ -53,8 +53,11 @@ def _wait_port(endpoint, timeout=60, cluster=None):
             socket.create_connection((host, int(port)), timeout=1).close()
             return True
         except OSError:
+            # any exit — clean or not — before the port binds means this
+            # cluster can never come up; abort instead of burning the
+            # timeout (no pserver legitimately exits before listening)
             if cluster is not None and any(
-                p.poll() not in (None, 0) for _, p, _ in cluster.procs
+                p.poll() is not None for _, p, _ in cluster.procs
             ):
                 return False
             time.sleep(0.2)
@@ -165,10 +168,13 @@ def launch_pserver(script_argv, nproc, n_pservers, base_env=None, sync=True):
     for p in ports:
         if not _wait_port("127.0.0.1:%d" % p, cluster=cluster):
             sys.stderr.write("[launch] pserver port %d never opened\n" % p)
+            # snapshot BEFORE kill(): the launcher's own SIGKILL of healthy
+            # peers (-9) must not mask the original crash code
+            dead = [pr.poll() for _, pr, _ in cluster.procs
+                    if pr.poll() is not None]
             cluster.kill()
-            dead = [pr.returncode for _, pr, _ in cluster.procs
-                    if pr.returncode not in (None, 0)]
-            return dead[0] if dead else 1
+            bad = [rc for rc in dead if rc != 0]
+            return bad[0] if bad else 1
     for rank in range(nproc):
         env = dict(common)
         env.update(

@@ -116,7 +116,9 @@ def bert_pretrain_program(hp=BertConfig, seq_len=128, lr=1e-4, is_test=False,
             mlm_logits, layers.unsqueeze(mlm_lbl, [2])
         )
         mlm_cost = layers.elementwise_mul(mlm_cost, layers.unsqueeze(mlm_w, [2]))
-        denom = layers.reduce_sum(mlm_w)
+        # epsilon-guarded denominator: a batch with zero masked slots must
+        # yield loss 0, not 0/0 = NaN poisoning every weight
+        denom = layers.clip(layers.reduce_sum(mlm_w), 1e-5, 1e30)
         mlm_loss = layers.elementwise_div(
             layers.reduce_sum(mlm_cost), denom
         )

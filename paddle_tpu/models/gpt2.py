@@ -105,7 +105,10 @@ def gpt2_lm_program(hp=GPT2Config, seq_len=128, lr=3e-4, is_test=False,
         )
         cost = layers.elementwise_mul(cost, layers.unsqueeze(w, [2]))
         tokens = layers.reduce_sum(w)
-        loss = layers.elementwise_div(layers.reduce_sum(cost), tokens)
+        # epsilon guard: an all-pad batch yields loss 0, never 0/0 NaN
+        loss = layers.elementwise_div(
+            layers.reduce_sum(cost), layers.clip(tokens, 1e-5, 1e30)
+        )
 
         if use_bf16:
             from paddle_tpu.contrib.mixed_precision import rewrite_bf16

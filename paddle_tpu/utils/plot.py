@@ -1,0 +1,76 @@
+"""Training-curve plotting (python/paddle/utils/plot.py Ploter analog).
+
+The reference's Ploter draws matplotlib curves inline (notebook-era book
+examples).  Same API here; when matplotlib is unavailable (headless TPU
+pods) it degrades to appending CSV rows so curves are still recoverable.
+"""
+
+import os
+
+
+class PlotData:
+    def __init__(self):
+        self.step = []
+        self.value = []
+
+    def append(self, step, value):
+        self.step.append(step)
+        self.value.append(value)
+
+    def reset(self):
+        self.step = []
+        self.value = []
+
+
+class Ploter:
+    def __init__(self, *args):
+        self.__args__ = args
+        self.__plot_data__ = {t: PlotData() for t in args}
+        self.__disable_plot__ = os.environ.get("DISABLE_PLOT", "")
+        try:
+            import matplotlib.pyplot as plt  # noqa: F401
+
+            self._has_mpl = True
+        except Exception:
+            self._has_mpl = False
+
+    def __plot_is_disabled__(self):
+        return self.__disable_plot__ == "True"
+
+    def append(self, title, step, value):
+        assert title in self.__plot_data__, (
+            "title %s not initialized (Ploter(%s))" % (title, self.__args__)
+        )
+        self.__plot_data__[title].append(step, value)
+
+    def plot(self, path=None):
+        if self.__plot_is_disabled__():
+            return
+        if self._has_mpl:
+            import matplotlib.pyplot as plt
+
+            titles = []
+            for title in self.__args__:
+                data = self.__plot_data__[title]
+                if len(data.step) > 0:
+                    plt.plot(data.step, data.value)
+                    titles.append(title)
+            plt.legend(titles, loc="upper left")
+            if path is None:
+                plt.show()
+            else:
+                plt.savefig(path)
+            plt.clf()
+        elif path is not None:
+            # CSV fallback: one file per curve next to the requested path
+            base, _ = os.path.splitext(path)
+            for title in self.__args__:
+                data = self.__plot_data__[title]
+                with open("%s.%s.csv" % (base, title.replace(" ", "_")), "w") as f:
+                    f.write("step,value\n")
+                    for s, v in zip(data.step, data.value):
+                        f.write("%s,%s\n" % (s, v))
+
+    def reset(self):
+        for data in self.__plot_data__.values():
+            data.reset()

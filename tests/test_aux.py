@@ -244,3 +244,21 @@ def test_api_spec_stability():
         timeout=300,
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_ploter_csv_fallback_and_api(tmp_path):
+    """utils.plot.Ploter (python/paddle/utils/plot.py parity): append/plot/
+    reset; files land whether or not matplotlib exists."""
+    from paddle_tpu.utils.plot import Ploter
+
+    p = Ploter("train cost", "test cost")
+    for i in range(5):
+        p.append("train cost", i, 1.0 / (i + 1))
+    p.append("test cost", 0, 0.5)
+    out = str(tmp_path / "curve.png")
+    p.plot(out)
+    import os
+    produced = os.listdir(str(tmp_path))
+    assert produced, "plot() wrote nothing"
+    p.reset()
+    assert p.__plot_data__["train cost"].step == []

@@ -294,3 +294,48 @@ def test_gpt2_trains():
     b1["ids"] = b1["ids"].copy(); b1["ids"][:, -1] = 5
     l2 = float(np.asarray(eexe.run(emain, feed=b1, fetch_list=efetches)[0]).reshape(-1)[0])
     assert abs(l1 - l2) < 1e-6, (l1, l2)
+
+
+def test_zero_weight_batches_stay_finite():
+    """All-pad / zero-masked batches produce loss 0, never NaN (guarded
+    denominators in BERT MLM and GPT-2 LM losses)."""
+    import paddle_tpu.framework as fw
+    from paddle_tpu.core import scope as scope_mod
+    from paddle_tpu.models import bert, gpt2
+
+    class BHP(bert.BertConfig):
+        vocab_size = 64
+        max_position = 12
+        d_model = 32
+        d_inner_hid = 64
+        n_head = 4
+        n_layer = 1
+        dropout = 0.0
+
+    main, startup, feeds, fetches = bert.bert_pretrain_program(BHP, seq_len=8)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    b = bert.make_fake_bert_batch(2, 8, BHP, seed=0)
+    b["mlm_weight"] = np.zeros_like(b["mlm_weight"])
+    out = exe.run(main, feed=b, fetch_list=fetches)
+    assert np.isfinite(np.asarray(out[0])).all()
+
+    fw.switch_main_program(fluid.Program())
+    fw.switch_startup_program(fluid.Program())
+    scope_mod._switch_scope(scope_mod.Scope())
+
+    class GHP(gpt2.GPT2Config):
+        vocab_size = 64
+        n_ctx = 12
+        d_model = 32
+        n_layer = 1
+        n_head = 4
+        dropout = 0.0
+
+    gmain, gstartup, _, gfetches = gpt2.gpt2_lm_program(GHP, seq_len=8)
+    gexe = fluid.Executor(fluid.CPUPlace())
+    gexe.run(gstartup)
+    gb = gpt2.make_fake_lm_batch(2, 8, GHP, seed=0)
+    gb["loss_weight"] = np.zeros_like(gb["loss_weight"])
+    gout = gexe.run(gmain, feed=gb, fetch_list=gfetches)
+    assert np.isfinite(np.asarray(gout[0])).all()
