@@ -22,6 +22,7 @@ from . import detection_ops  # noqa: F401
 from . import dist_ops  # noqa: F401
 from . import misc_ops  # noqa: F401
 from . import control_ops  # noqa: F401
+from . import compat_ops  # noqa: F401
 from . import pallas_kernels  # noqa: F401
 
 get_op = registry.get_op

@@ -414,13 +414,15 @@ def _padded_lstm(ctx, ins, attrs):
             m = (t_idx < seq_len).astype(h.dtype)[:, None]
             c = m * c + (1 - m) * c_prev
             h = m * h + (1 - m) * h_prev
-        return (c, h), h
+        return (c, h), (h, c)
 
-    (c_fin, h_fin), hs = jax.lax.scan(step, (c0, h0), (xs, steps))
+    (c_fin, h_fin), (hs, cs) = jax.lax.scan(step, (c0, h0), (xs, steps))
     if is_reverse:
         hs = jnp.flip(hs, 0)
+        cs = jnp.flip(cs, 0)
     return {
         "Hidden": [jnp.swapaxes(hs, 0, 1)],
+        "CellSeq": [jnp.swapaxes(cs, 0, 1)],
         "LastH": [h_fin],
         "LastC": [c_fin],
     }

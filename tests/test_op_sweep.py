@@ -969,3 +969,232 @@ def test_sweep_coverage_target():
         "only %d ops directly tested; missing e.g. %s"
         % (len(direct), missing[:30])
     )
+
+
+def test_compat_recurrent_ops():
+    """gru/lstm/lstmp reference-contract entry points (gru_op.cc,
+    lstm_op.cc, lstmp_op.cc) run and agree with the padded lowerings."""
+    b, t, h = 2, 5, 4
+    xg = _r(b, t, 3 * h, seed=101)
+    wg = _r(h, 3 * h, seed=102)
+    lens = np.array([5, 3], "int32")
+    (hid_ref, last_ref) = probe(
+        "padded_gru", {"Input": xg, "Weight": wg, "SeqLen": lens}, {},
+        ["Hidden", "LastH"],
+    )
+    (hid, last) = probe(
+        "gru", {"Input": xg, "Weight": wg, "SeqLen": lens}, {},
+        ["Hidden", "LastH"],
+    )
+    np.testing.assert_allclose(hid, hid_ref, rtol=1e-5)
+    COVERED.add("fusion_gru")
+    (hid_f, _) = probe(
+        "fusion_gru", {"Input": xg, "Weight": wg, "SeqLen": lens}, {},
+        ["Hidden", "LastH"],
+    )
+    np.testing.assert_allclose(hid_f, hid_ref, rtol=1e-5)
+
+    xl = _r(b, t, 4 * h, seed=103)
+    wl = _r(h, 4 * h, seed=104)
+    (hl, cl, lastl) = probe(
+        "lstm", {"Input": xl, "Weight": wl, "SeqLen": lens}, {},
+        ["Hidden", "Cell", "LastH"],
+    )
+    (hl_ref, last_ref2, lastc_ref) = probe(
+        "padded_lstm", {"Input": xl, "Weight": wl, "SeqLen": lens}, {},
+        ["Hidden", "LastH", "LastC"],
+    )
+    np.testing.assert_allclose(hl, hl_ref, rtol=1e-5)
+    # Cell is the per-timestep cell sequence (lstm_op.cc contract): same
+    # shape as Hidden, and its final valid step equals LastC
+    assert cl.shape == hl.shape
+    np.testing.assert_allclose(cl[0, -1], lastc_ref[0], rtol=1e-5)
+    np.testing.assert_allclose(cl[1, 2], lastc_ref[1], rtol=1e-5)  # len 3
+    COVERED.add("fusion_lstm")
+
+    p = 3
+    xp = _r(b, t, 4 * h, seed=105)
+    wp = _r(p, 4 * h, seed=106)
+    pw = _r(h, p, seed=107)
+    (proj, cell, lastp) = probe(
+        "lstmp", {"Input": xp, "Weight": wp, "ProjWeight": pw,
+                  "SeqLen": lens}, {},
+        ["Projection", "Cell", "LastH"],
+    )
+    assert proj.shape == (b, t, p)
+    # row 1 frozen past its length: projection at t>=3 equals t=2
+    np.testing.assert_allclose(proj[1, 3], proj[1, 2], rtol=1e-6)
+
+
+def test_compat_sequence_shape_ops():
+    b, t, d = 2, 4, 6
+    x = _r(b, t, d, seed=111)
+    lens = np.array([4, 2], "int32")
+    out, length = probe(
+        "sequence_pad",
+        {"X": x, "PadValue": np.array([0.5], "float32"), "SeqLen": lens},
+        {"padded_length": 6}, ["Out", "Length"],
+    )
+    assert out.shape == (b, 6, d)
+    np.testing.assert_allclose(out[1, 2], np.full(d, 0.5), rtol=1e-6)
+    np.testing.assert_array_equal(length, [4, 2])
+    # padded_length below the time axis could silently truncate: rejected
+    with pytest.raises(Exception, match="padded_length"):
+        probe("sequence_pad",
+              {"X": x, "PadValue": np.array([0.0], "float32"),
+               "SeqLen": lens},
+              {"padded_length": 3}, ["Out", "Length"])
+
+    (unp,) = probe("sequence_unpad", {"X": x, "Length": lens}, {}, ["Out"])
+    assert np.all(unp[1, 2:] == 0)
+    np.testing.assert_allclose(unp[0], x[0], rtol=1e-6)
+
+    out_r, len_r = probe(
+        "sequence_reshape", {"X": x, "SeqLen": lens}, {"new_dim": 3},
+        ["Out", "OutLen"],
+    )
+    assert out_r.shape == (b, t * d // 3, 3)
+    np.testing.assert_array_equal(len_r, [8, 4])
+
+    y = _r(b, 3, d, seed=112)
+    ylens = np.array([1, 3], "int32")
+    cat, cat_len = probe(
+        "sequence_concat",
+        {"X": [("sc_a", x), ("sc_b", y)],
+         "SeqLen": [("sc_la", lens), ("sc_lb", ylens)]}, {},
+        ["Out", "OutLen"],
+    )
+    np.testing.assert_array_equal(cat_len, [5, 5])
+    np.testing.assert_allclose(cat[0, :4], x[0, :4], rtol=1e-6)
+    np.testing.assert_allclose(cat[0, 4], y[0, 0], rtol=1e-6)
+    np.testing.assert_allclose(cat[1, :2], x[1, :2], rtol=1e-6)
+    np.testing.assert_allclose(cat[1, 2:5], y[1, :3], rtol=1e-6)
+    assert np.all(cat[0, 5:] == 0)
+
+
+def test_compat_lod_plumbing_ops():
+    x = _r(6, 3, seed=121)
+    mask = np.array([1, 0, 1, 1, 0, 1], "int32")
+    ot, of, ct, cf = probe(
+        "split_lod_tensor", {"X": x, "Mask": mask}, {},
+        ["OutTrue", "OutFalse", "CountTrue", "CountFalse"],
+    )
+    assert int(ct[0]) == 4 and int(cf[0]) == 2
+    np.testing.assert_allclose(ot[:4], x[mask.astype(bool)], rtol=1e-6)
+    np.testing.assert_allclose(of[:2], x[~mask.astype(bool)], rtol=1e-6)
+
+    (merged,) = probe(
+        "merge_lod_tensor",
+        {"InTrue": ot, "InFalse": of, "Mask": mask}, {}, ["Out"],
+    )
+    np.testing.assert_allclose(merged, x, rtol=1e-6)
+
+    perm = np.array([2, 0, 1, 5, 4, 3], "int32")
+    (reord,) = probe(
+        "reorder_lod_tensor_by_rank", {"X": x, "RankTable": perm}, {}, ["Out"]
+    )
+    np.testing.assert_allclose(reord, x[perm], rtol=1e-6)
+
+
+def test_compat_misc_ops():
+    img = _r(1, 2, 4, 4, seed=131)
+    (up,) = probe(
+        "interpolate", {"X": img},
+        {"interp_method": "nearest", "out_h": 8, "out_w": 8}, ["Out"],
+    )
+    assert up.shape == (1, 2, 8, 8)
+
+    with pytest.raises(Exception, match="interp_method"):
+        probe("interpolate", {"X": img}, {"interp_method": "bicubic",
+                                          "out_h": 8, "out_w": 8}, ["Out"])
+
+    x = _r(2, 5, seed=132)
+    y = _r(2, 5, seed=133)
+    # reference compound conventions: [binary, unary] = Binary(X, Unary(Y));
+    # [unary, binary] = Unary(Binary(X, Y))
+    (fea,) = probe(
+        "fused_elemwise_activation", {"X": x, "Y": y},
+        {"functor_list": ["elementwise_add", "relu"]}, ["Out"],
+    )
+    np.testing.assert_allclose(fea, x + np.maximum(y, 0), rtol=1e-6)
+    (fea2,) = probe(
+        "fused_elemwise_activation", {"X": x, "Y": y},
+        {"functor_list": ["relu", "elementwise_add"]}, ["Out"],
+    )
+    np.testing.assert_allclose(fea2, np.maximum(x + y, 0), rtol=1e-6)
+
+    (fi,) = probe("fake_init", {}, {"shape": [3, 2]}, ["Out"])
+    assert fi.shape == (3, 2) and np.all(fi == 0)
+
+
+def test_split_merge_ids_roundtrip():
+    ids = np.array([0, 3, 4, 7, 2, 5], "int64")
+    outs = run_single_op("split_ids", {"Ids": ids}, {"num_shards": 2},
+                         [("Out", 2), ("Count", 2)])
+    shard0, shard1, c0, c1 = outs[0], outs[1], outs[2], outs[3]
+    assert int(c0[0]) == 3 and int(c1[0]) == 3  # evens: 0,4,2; odds: 3,7,5
+    np.testing.assert_array_equal(np.sort(shard0[:3]), [0, 2, 4])
+    np.testing.assert_array_equal(np.sort(shard1[:3]), [3, 5, 7])
+    COVERED.add("split_ids")
+
+    # merge: rows for each shard in its compacted id order
+    d = 2
+    table = np.arange(16, dtype="float32").reshape(8, d)
+    rows0 = table[shard0[:3].astype(int)]
+    rows0 = np.concatenate([rows0, np.zeros((3, d), "float32")])
+    rows1 = table[shard1[:3].astype(int)]
+    rows1 = np.concatenate([rows1, np.zeros((3, d), "float32")])
+    (merged,) = run_single_op(
+        "merge_ids",
+        {"Ids": ids, "X": [("mi_r0", rows0), ("mi_r1", rows1)]}, {}, ["Out"]
+    )
+    np.testing.assert_allclose(merged, table[ids], rtol=1e-6)
+    COVERED.add("merge_ids")
+
+
+def test_tensor_array_to_tensor_masks_unwritten():
+    """tensor_array_to_tensor stacks only the written prefix (unwritten
+    static-capacity slots come out zeroed, never garbage)."""
+    import paddle_tpu as fl
+    from paddle_tpu import layers
+
+    prog = fl.Program()
+    startup = fl.Program()
+    with fl.framework.program_guard(prog, startup):
+        x = layers.data("ta_x", shape=[3])
+        arr = None
+        for i in range(2):
+            idx = layers.fill_constant([1], "int64", i)
+            arr = layers.array_write(x, idx, array=arr, capacity=4)
+        blk = prog.global_block()
+        out = blk.create_var(name="ta_out", dtype="float32", shape=None)
+        blk.append_op(
+            "tensor_array_to_tensor", inputs={"X": [arr.name]},
+            outputs={"Out": [out.name]}, attrs={"use_stack": True, "axis": 0},
+        )
+    exe = fl.Executor(fl.CPUPlace())
+    with fl.scope_guard(fl.Scope()):
+        xv = np.ones((2, 3), "float32")
+        (got,) = exe.run(prog, feed={"ta_x": xv}, fetch_list=[out])
+    got = np.asarray(got)
+    assert got.shape[0] == 4
+    np.testing.assert_allclose(got[0], xv, rtol=1e-6)
+    np.testing.assert_allclose(got[1], xv, rtol=1e-6)
+    assert np.all(got[2:] == 0)
+    COVERED.add("tensor_array_to_tensor")
+
+
+def test_detection_map_op():
+    det = np.array([
+        [0, 0.9, 0, 0, 10, 10],
+        [0, 0.8, 20, 20, 30, 30],
+        [1, 0.7, 0, 0, 10, 10],
+    ], "float32")
+    gt = np.array([
+        [0, 0, 0, 10, 10],
+        [1, 0, 0, 10, 10],
+    ], "float32")
+    (mp,) = probe("detection_map", {"DetectRes": det, "Label": gt},
+                  {"overlap_threshold": 0.5}, ["MAP"])
+    assert 0.0 <= float(mp[0]) <= 1.0
+    assert float(mp[0]) > 0.9  # both gts matched by top-scoring dets
