@@ -1,0 +1,72 @@
+"""Python side of the C++ demo trainer (train/demo_trainer.cc analog).
+
+The C++ binary (`native/demo_trainer.cc`) embeds CPython, constructs a
+DemoTrainer from an exported program directory, and owns the training
+loop — the framework supplies exactly one `step()` per iteration, the way
+the reference's demo_trainer drives Executor::Run per batch.
+
+Export side: ``export_train_program(dir, main, startup, feeds)`` writes
+main.json / startup.json / feeds.json (name, shape, dtype per feed and
+the fetch names) so a program built in Python can be trained from C++
+with no Python script involved at run time.
+"""
+
+import json
+import os
+
+import numpy as np
+
+
+def export_train_program(path, main, startup, feed_specs, fetch_names):
+    """feed_specs: [{"name", "shape" (w/o batch), "dtype"}, ...]."""
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "main.json"), "w") as f:
+        f.write(main.to_json())
+    with open(os.path.join(path, "startup.json"), "w") as f:
+        f.write(startup.to_json())
+    with open(os.path.join(path, "feeds.json"), "w") as f:
+        json.dump({"feeds": feed_specs, "fetches": list(fetch_names)}, f)
+
+
+class DemoTrainer:
+    """Loads an exported training program; each step() runs one iteration
+    on synthetic data shaped by the feed spec and returns the first fetch
+    (the loss) as a float."""
+
+    def __init__(self, path, batch_size=16, seed=0):
+        import paddle_tpu as fluid
+        from paddle_tpu import framework
+
+        self._fluid = fluid
+        with open(os.path.join(path, "main.json")) as f:
+            self.main = framework.Program.from_json(f.read())
+        with open(os.path.join(path, "startup.json")) as f:
+            self.startup = framework.Program.from_json(f.read())
+        with open(os.path.join(path, "feeds.json")) as f:
+            spec = json.load(f)
+        self.feed_specs = spec["feeds"]
+        self.fetch_names = spec["fetches"]
+        self.batch_size = batch_size
+        self.rng = np.random.RandomState(seed)
+        self.scope = fluid.Scope()
+        with fluid.scope_guard(self.scope):
+            self.exe = fluid.Executor()
+            self.exe.run(self.startup)
+
+    def _batch(self):
+        feed = {}
+        for fs in self.feed_specs:
+            shape = [self.batch_size] + [int(s) for s in fs["shape"]]
+            if fs["dtype"].startswith("int"):
+                hi = int(fs.get("max", 10))
+                feed[fs["name"]] = self.rng.randint(0, hi, shape).astype(fs["dtype"])
+            else:
+                feed[fs["name"]] = self.rng.rand(*shape).astype(fs["dtype"])
+        return feed
+
+    def step(self):
+        with self._fluid.scope_guard(self.scope):
+            out = self.exe.run(
+                self.main, feed=self._batch(), fetch_list=self.fetch_names
+            )
+        return float(np.asarray(out[0]).reshape(-1)[0])

@@ -63,3 +63,28 @@ def unary(fn):
         return {"Out": [fn(ins["X"][0], attrs)]}
 
     return lower
+
+
+def stable_compact(valid, x, axis=0):
+    """Stably move the slots where ``valid`` is True to the front of
+    ``x`` along ``axis``, zero the rest, and return (compacted, counts).
+
+    The shared front-compaction idiom (argsort on the (invalid, position)
+    key) behind the static-shape re-expressions of the reference's
+    dynamic-size ops (cond_take, sequence_erase, sequence_concat,
+    split_lod_tensor, split_ids).  valid: bool, shape x.shape[:axis+1];
+    counts: valid count along ``axis`` (shape valid.shape[:-1]).
+    """
+    n = x.shape[axis]
+    pos = jnp.arange(n, dtype=jnp.int32)
+    pos = pos.reshape((1,) * axis + (n,))
+    key = jnp.where(valid, 0, 1) * n + jnp.broadcast_to(pos, valid.shape)
+    order = jnp.argsort(key, axis=axis)
+    gidx = order.reshape(order.shape + (1,) * (x.ndim - axis - 1))
+    gidx = jnp.broadcast_to(gidx, x.shape)
+    compacted = jnp.take_along_axis(x, gidx, axis=axis)
+    counts = jnp.sum(valid.astype(jnp.int32), axis=axis)
+    live = jnp.broadcast_to(pos, valid.shape) < jnp.expand_dims(counts, axis)
+    live = live.reshape(live.shape + (1,) * (x.ndim - axis - 1))
+    compacted = jnp.where(live, compacted, 0)
+    return compacted, counts

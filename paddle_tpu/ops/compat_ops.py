@@ -14,7 +14,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.registry import get_op, register
-from .common import jdt
+from .common import jdt, stable_compact
 
 
 def _delegate(type_, ctx, ins, attrs):
@@ -83,11 +83,13 @@ def _lstmp(ctx, ins, attrs):
             m = (t_idx < seq_len).astype(h.dtype)[:, None]
             c = m * c + (1 - m) * c_prev
             r = m * r + (1 - m) * r_prev
-        return (c, r), r
+        return (c, r), (r, c)
 
-    (c_fin, r_fin), rs = jax.lax.scan(step, (c0, h0), (xs, steps))
-    return {"Projection": [jnp.swapaxes(rs, 0, 1)], "Cell": [c_fin],
-            "LastH": [r_fin]}
+    (c_fin, r_fin), (rs, cs) = jax.lax.scan(step, (c0, h0), (xs, steps))
+    # Cell is the per-timestep cell sequence (lstmp_op.cc contract)
+    return {"Projection": [jnp.swapaxes(rs, 0, 1)],
+            "Cell": [jnp.swapaxes(cs, 0, 1)],
+            "LastC": [c_fin], "LastH": [r_fin]}
 
 
 # ---------------------------------------------------------------------------
@@ -145,7 +147,14 @@ def _sequence_reshape(ctx, ins, attrs):
     x = ins["X"][0]
     new_dim = int(attrs["new_dim"])
     b, t, d = x.shape
-    assert (t * d) % new_dim == 0, (t, d, new_dim)
+    if d % new_dim != 0:
+        # a non-divisible feature dim would smear valid elements across
+        # the padding boundary of shorter rows (the reference rejects
+        # per-sequence non-divisible reshapes)
+        raise ValueError(
+            "sequence_reshape: feature dim %d not divisible by new_dim %d"
+            % (d, new_dim)
+        )
     out = x.reshape(b, t * d // new_dim, new_dim)
     outs = {"Out": [out]}
     if ins.get("SeqLen"):
@@ -162,32 +171,20 @@ def _sequence_concat(ctx, ins, attrs):
     xs = ins["X"]
     lens = ins.get("SeqLen")
     b = xs[0].shape[0]
-    total_t = sum(x.shape[1] for x in xs)
-    feat = xs[0].shape[2:]
     if lens:
         lens = [l.reshape(-1).astype(jnp.int32) for l in lens]
     else:
         lens = [jnp.full((b,), x.shape[1], jnp.int32) for x in xs]
     # big concat along time, then per-row stable compaction of valid slots
     data = jnp.concatenate(xs, axis=1)  # [B, total_t, ...]
-    valid_parts = [
-        jnp.arange(x.shape[1], dtype=jnp.int32)[None, :] < l[:, None]
-        for x, l in zip(xs, lens)
-    ]
-    valid = jnp.concatenate(valid_parts, axis=1)  # [B, total_t]
-    order = jnp.argsort(
-        jnp.where(valid, 0, 1) * total_t
-        + jnp.broadcast_to(jnp.arange(total_t, dtype=jnp.int32), (b, total_t)),
+    valid = jnp.concatenate(
+        [
+            jnp.arange(x.shape[1], dtype=jnp.int32)[None, :] < l[:, None]
+            for x, l in zip(xs, lens)
+        ],
         axis=1,
     )
-    gidx = order.reshape(order.shape + (1,) * len(feat))
-    gidx = jnp.broadcast_to(gidx, (b, total_t) + feat)
-    compacted = jnp.take_along_axis(data, gidx, axis=1)
-    out_len = sum(lens)
-    tail = jnp.arange(total_t, dtype=jnp.int32)[None, :] >= out_len[:, None]
-    compacted = jnp.where(
-        tail.reshape(tail.shape + (1,) * len(feat)), 0, compacted
-    )
+    compacted, out_len = stable_compact(valid, data, axis=1)
     return {"Out": [compacted], "OutLen": [out_len.astype(jnp.int64)]}
 
 
@@ -203,15 +200,9 @@ def _split_lod_tensor(ctx, ins, attrs):
     reference's dynamic row split."""
     x = ins["X"][0]
     mask = ins["Mask"][0].reshape(-1).astype(bool)
-    n = x.shape[0]
-    idx = jnp.arange(n, dtype=jnp.int32)
 
     def take(cond):
-        order = jnp.argsort(jnp.where(cond, 0, 1) * n + idx)
-        cnt = jnp.sum(cond.astype(jnp.int32))
-        sel = x[order]
-        live = idx < cnt
-        sel = jnp.where(live.reshape((n,) + (1,) * (x.ndim - 1)), sel, 0)
+        sel, cnt = stable_compact(cond, x, axis=0)
         return sel, cnt.astype(jnp.int64).reshape(1)
 
     out_true, cnt_t = take(mask)
@@ -363,14 +354,9 @@ def _split_ids(ctx, ins, attrs):
     reference re-expressed)."""
     ids = ins["Ids"][0].reshape(-1)
     n_shards = len(attrs.get("shard_names", [])) or int(attrs.get("num_shards", 2))
-    n = ids.shape[0]
-    idx = jnp.arange(n, dtype=jnp.int32)
     outs, counts = [], []
     for s in range(n_shards):
-        sel = (ids % n_shards) == s
-        order = jnp.argsort(jnp.where(sel, 0, 1) * n + idx)
-        cnt = jnp.sum(sel.astype(jnp.int32))
-        shard = jnp.where(idx < cnt, ids[order], 0)
+        shard, cnt = stable_compact((ids % n_shards) == s, ids, axis=0)
         outs.append(shard)
         counts.append(cnt.astype(jnp.int64).reshape(1))
     return {"Out": outs, "Count": counts}

@@ -120,17 +120,13 @@ def _sequence_erase(ctx, ins, attrs):
     """sequence_erase_op.cc re-expressed for static shapes: erased tokens
     are masked to pad (0) and compacted to the front of each row, with the
     new lengths emitted as OutLen."""
+    from .common import stable_compact
+
     x = ins["X"][0]
     tokens = jnp.asarray(list(attrs.get("tokens", [])), x.dtype)
     keep = jnp.all(x[..., None] != tokens.reshape((1,) * x.ndim + (-1,)), axis=-1)
-    t = x.shape[1]
-    # stable compaction: order by (not keep, position)
-    order = jnp.argsort(jnp.where(keep, 0, 1) * t + jnp.arange(t)[None, :], axis=1)
-    compacted = jnp.take_along_axis(jnp.where(keep, x, 0), order, axis=1)
-    new_len = jnp.sum(keep.astype(jnp.int64), axis=1)
-    ar = jnp.arange(t)[None, :]
-    compacted = jnp.where(ar < new_len[:, None], compacted, 0)
-    return {"Out": [compacted], "OutLen": [new_len]}
+    compacted, new_len = stable_compact(keep, x, axis=1)
+    return {"Out": [compacted], "OutLen": [new_len.astype(jnp.int64)]}
 
 
 @register("sequence_expand_as")
@@ -361,15 +357,9 @@ def _cond_take(ctx, ins, attrs):
     Mask is true, stably compacted to the front of a full-size buffer
     (zero-padded), plus the true count — the TPU answer to the
     dynamic-output-size CondOp/masked-select pattern."""
+    from .common import stable_compact
+
     x = ins["X"][0].reshape(-1)
-    mask = ins["Mask"][0].reshape(-1)
-    n = x.shape[0]
-    keep = mask.astype(bool)
-    order = jnp.argsort(
-        jnp.where(keep, 0, 1) * n + jnp.arange(n, dtype=jnp.int32)
-    )
-    taken = jnp.where(
-        jnp.arange(n) < jnp.sum(keep.astype(jnp.int32)), x[order], 0
-    )
-    count = jnp.sum(keep.astype(jnp.int64)).reshape(1)
-    return {"Out": [taken], "Count": [count]}
+    keep = ins["Mask"][0].reshape(-1).astype(bool)
+    taken, count = stable_compact(keep, x, axis=0)
+    return {"Out": [taken], "Count": [count.astype(jnp.int64).reshape(1)]}

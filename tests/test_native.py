@@ -123,3 +123,48 @@ def test_native_loader_missing_file(tmp_path):
         pytest.skip("no native lib")
     with pytest.raises(IOError):
         native.RecordIOLoader([str(tmp_path / "nope.recordio")])
+
+
+def test_demo_trainer_cpp_binary(tmp_path):
+    """train/demo_trainer.cc analog: build the CPython-embedding binary,
+    export a tiny train program, and run the training loop from C++."""
+    import os
+    import shutil
+    import subprocess
+    import sys
+
+    import sysconfig
+
+    native_dir = os.path.join(os.path.dirname(fluid.__file__), "native")
+    py_h = os.path.join(sysconfig.get_paths()["include"], "Python.h")
+    if shutil.which("g++") is None or not os.path.exists(py_h):
+        pytest.skip("no C++ toolchain / Python headers (%s)" % py_h)
+    subprocess.run(["make", "demo_trainer"], cwd=native_dir, check=True,
+                   capture_output=True)
+
+    from paddle_tpu import layers
+    from paddle_tpu.native.demo_driver import export_train_program
+
+    img = layers.data("dt_img", shape=[16])
+    label = layers.data("dt_label", shape=[1], dtype="int64")
+    pred = layers.fc(layers.fc(img, 32, act="relu"), 4, act="softmax")
+    loss = layers.mean(layers.cross_entropy(pred, label))
+    fluid.optimizer.SGD(0.5).minimize(loss)
+    export_train_program(
+        str(tmp_path), fluid.default_main_program(),
+        fluid.default_startup_program(),
+        [{"name": "dt_img", "shape": [16], "dtype": "float32"},
+         {"name": "dt_label", "shape": [1], "dtype": "int64", "max": 4}],
+        [loss.name],
+    )
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["PADDLE_TPU_ROOT"] = os.path.dirname(os.path.dirname(fluid.__file__))
+    proc = subprocess.run(
+        [os.path.join(native_dir, "demo_trainer"), str(tmp_path), "8", "16"],
+        env=env, capture_output=True, text=True, timeout=240,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "improved=true" in proc.stdout, proc.stdout

@@ -1016,12 +1016,15 @@ def test_compat_recurrent_ops():
     xp = _r(b, t, 4 * h, seed=105)
     wp = _r(p, 4 * h, seed=106)
     pw = _r(h, p, seed=107)
-    (proj, cell, lastp) = probe(
+    (proj, cell, lastc) = probe(
         "lstmp", {"Input": xp, "Weight": wp, "ProjWeight": pw,
                   "SeqLen": lens}, {},
-        ["Projection", "Cell", "LastH"],
+        ["Projection", "Cell", "LastC"],
     )
     assert proj.shape == (b, t, p)
+    # Cell is the per-timestep cell sequence; its last step == LastC
+    assert cell.shape == (b, t, h)
+    np.testing.assert_allclose(cell[0, -1], lastc[0], rtol=1e-6)
     # row 1 frozen past its length: projection at t>=3 equals t=2
     np.testing.assert_allclose(proj[1, 3], proj[1, 2], rtol=1e-6)
 
@@ -1055,6 +1058,10 @@ def test_compat_sequence_shape_ops():
     )
     assert out_r.shape == (b, t * d // 3, 3)
     np.testing.assert_array_equal(len_r, [8, 4])
+    # non-divisible feature dim would smear valid data into padding: reject
+    with pytest.raises(Exception, match="divisible"):
+        probe("sequence_reshape", {"X": x, "SeqLen": lens}, {"new_dim": 4},
+              ["Out", "OutLen"])
 
     y = _r(b, 3, d, seed=112)
     ylens = np.array([1, 3], "int32")
