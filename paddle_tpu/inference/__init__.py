@@ -38,7 +38,15 @@ class AnalysisConfig(NativeConfig):
     def __init__(self, model_dir=None, place=None):
         super().__init__(model_dir, place)
         self.ir_optim = True
-        self._passes = ["fold_batch_norm", "drop_train_ops", "memory_optimize"]
+        # attention fusion runs BEFORE drop_train_ops: the dropout-aware
+        # attention patterns must see the original dropout op (is_test
+        # rewriting turns it into a scale op the matcher doesn't target)
+        self._passes = [
+            "fold_batch_norm",
+            "attention_fuse_pass",
+            "drop_train_ops",
+            "memory_optimize",
+        ]
 
     def switch_ir_optim(self, flag=True):
         self.ir_optim = bool(flag)

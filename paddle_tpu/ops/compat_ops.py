@@ -147,13 +147,19 @@ def _sequence_reshape(ctx, ins, attrs):
     x = ins["X"][0]
     new_dim = int(attrs["new_dim"])
     b, t, d = x.shape
-    if d % new_dim != 0:
-        # a non-divisible feature dim would smear valid elements across
-        # the padding boundary of shorter rows (the reference rejects
-        # per-sequence non-divisible reshapes)
+    if (t * d) % new_dim != 0:
         raise ValueError(
-            "sequence_reshape: feature dim %d not divisible by new_dim %d"
-            % (d, new_dim)
+            "sequence_reshape: %d elements per row not divisible by "
+            "new_dim %d" % (t * d, new_dim)
+        )
+    if ins.get("SeqLen") and d % new_dim != 0:
+        # with ragged rows a non-divisible feature dim would smear valid
+        # elements across the padding boundary (the reference rejects
+        # per-sequence non-divisible reshapes); dense full-length rows
+        # have no boundary and stay allowed
+        raise ValueError(
+            "sequence_reshape: feature dim %d not divisible by new_dim %d "
+            "with ragged rows (SeqLen present)" % (d, new_dim)
         )
     out = x.reshape(b, t * d // new_dim, new_dim)
     outs = {"Out": [out]}

@@ -7,7 +7,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.registry import register
-from .common import jdt
+from .common import jdt, stable_compact
 
 
 # ---------------------------------------------------------------------------
@@ -120,7 +120,6 @@ def _sequence_erase(ctx, ins, attrs):
     """sequence_erase_op.cc re-expressed for static shapes: erased tokens
     are masked to pad (0) and compacted to the front of each row, with the
     new lengths emitted as OutLen."""
-    from .common import stable_compact
 
     x = ins["X"][0]
     tokens = jnp.asarray(list(attrs.get("tokens", [])), x.dtype)
@@ -357,7 +356,6 @@ def _cond_take(ctx, ins, attrs):
     Mask is true, stably compacted to the front of a full-size buffer
     (zero-padded), plus the true count — the TPU answer to the
     dynamic-output-size CondOp/masked-select pattern."""
-    from .common import stable_compact
 
     x = ins["X"][0].reshape(-1)
     keep = ins["Mask"][0].reshape(-1).astype(bool)

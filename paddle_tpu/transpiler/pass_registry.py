@@ -215,3 +215,140 @@ class FuseReluIntoConv(Pass):
 
         OpPattern(["conv2d", "relu"]).rewrite(block, fuse)
         return program
+
+
+@register_pass("attention_fuse_pass")
+class AttentionFusePass(Pass):
+    """Scaled-dot-product attention fusion (the attention_lstm_fuse_pass
+    family analog, aimed at the one pattern XLA cannot collapse into an
+    O(T)-memory kernel by itself):
+
+        matmul(Q, K, transpose_Y, alpha)
+          [-> elementwise_add(rank-1-in-Tk bias)]
+          -> softmax [-> dropout(is_test)]
+          -> matmul(weights, V)
+
+    becomes ONE fused_attention op — flash kernel under FLAGS_use_pallas,
+    fused XLA otherwise.  Conservative conditions: single-consumer chain
+    (the matcher guarantees it), Q rank-4 [B, H, Tq, Dh], bias with key
+    axis only (shape [..., 1, Tk]), softmax over the default last axis,
+    inference-mode dropout only.
+    """
+
+    def apply(self, program, scope=None):
+        block = program.global_block()
+
+        def fuse(chain):
+            m1 = chain[0]
+            m2 = chain[-1]
+            mid = chain[1:-1]
+            add = next((o for o in mid if o.type == "elementwise_add"), None)
+            sm = next((o for o in mid if o.type == "softmax"), None)
+            drop = next((o for o in mid if o.type == "dropout"), None)
+            if sm is None:
+                return False
+            if not m1.attrs.get("transpose_Y", False) or m1.attrs.get(
+                "transpose_X", False
+            ):
+                return False
+            if m2.attrs.get("transpose_X") or m2.attrs.get("transpose_Y"):
+                return False
+            # the probabilities must be matmul2's LHS (weights @ V)
+            prob_name = (drop or sm).outputs["Out"][0]
+            if m2.inputs.get("X", [None])[0] != prob_name:
+                return False
+            if sm.attrs.get("axis", -1) not in (-1,):
+                return False
+            if drop is not None and not drop.attrs.get("is_test", False):
+                return False
+            # downgrade_in_infer scales the probabilities by (1-p) at
+            # inference — fold that into a scale op after the fused kernel
+            post_scale = 1.0
+            if drop is not None and drop.attrs.get(
+                "dropout_implementation", "downgrade_in_infer"
+            ) == "downgrade_in_infer":
+                post_scale = 1.0 - float(drop.attrs.get("dropout_prob", 0.0))
+            qvar = block._find_var_recursive(m1.inputs["X"][0])
+            if qvar is None or qvar.shape is None or len(qvar.shape) != 4:
+                return False
+            inputs = {
+                "Q": m1.inputs["X"],
+                "K": m1.inputs["Y"],
+                "V": m2.inputs["Y"],
+            }
+            if add is not None:
+                # the bias is whichever add operand is NOT the QK^T product
+                prod_name = m1.outputs["Out"][0]
+                add_ins = add.inputs.get("X", []) + add.inputs.get("Y", [])
+                others = [n for n in add_ins if n != prod_name]
+                if prod_name not in add_ins or len(others) != 1:
+                    return False
+                bname = others[0]
+                bvar = block._find_var_recursive(bname)
+                # fused Bias contract: reshapeable to [B, Tk] — require
+                # [B, 1, 1, Tk] with a per-example batch (dynamic or equal
+                # to Q's); broadcast ([1,1,1,Tk]) or per-head biases would
+                # crash the fused reshape, leave those graphs alone
+                if (
+                    bvar is None
+                    or bvar.shape is None
+                    or len(bvar.shape) != 4
+                    or int(bvar.shape[1]) != 1
+                    or int(bvar.shape[2]) != 1
+                    or (int(bvar.shape[0]) not in (-1,)
+                        and int(bvar.shape[0]) != int(qvar.shape[0]))
+                ):
+                    return False
+                inputs["Bias"] = [bname]
+            import paddle_tpu.framework as _fw
+
+            fused = _fw.Operator(
+                block,
+                "fused_attention",
+                None,
+                None,
+                {
+                    "causal": False,
+                    "scale": float(m1.attrs.get("alpha", 1.0)),
+                },
+            )
+            fused.inputs = inputs
+            out_name = m2.outputs["Out"][0]
+            idx = block.ops.index(m1)
+            new_ops = [fused]
+            if post_scale != 1.0:
+                raw = out_name + "@ATTN_RAW"
+                ov = block._find_var_recursive(out_name)
+                block.create_var(
+                    name=raw,
+                    shape=list(ov.shape) if ov is not None and ov.shape else None,
+                    dtype=ov.dtype if ov is not None else "float32",
+                )
+                fused.outputs = {"Out": [raw]}
+                scale_op = _fw.Operator(
+                    block, "scale", None, None,
+                    {"scale": post_scale, "bias": 0.0,
+                     "bias_after_scale": True},
+                )
+                scale_op.inputs = {"X": [raw]}
+                scale_op.outputs = {"Out": [out_name]}
+                new_ops.append(scale_op)
+            else:
+                fused.outputs = {"Out": [out_name]}
+            for op in chain:
+                block.ops.remove(op)
+            for j, op in enumerate(new_ops):
+                block.ops.insert(idx + j, op)
+            program._bump_version()
+            return True
+
+        n = 0
+        for pat in (
+            ["matmul", "elementwise_add", "softmax", "dropout", "matmul"],
+            ["matmul", "elementwise_add", "softmax", "matmul"],
+            ["matmul", "softmax", "dropout", "matmul"],
+            ["matmul", "softmax", "matmul"],
+        ):
+            n += OpPattern(pat).rewrite(block, fuse)
+        program._attention_fused_count = n
+        return program

@@ -1058,10 +1058,15 @@ def test_compat_sequence_shape_ops():
     )
     assert out_r.shape == (b, t * d // 3, 3)
     np.testing.assert_array_equal(len_r, [8, 4])
-    # non-divisible feature dim would smear valid data into padding: reject
+    # non-divisible feature dim with ragged rows would smear valid data
+    # into padding: reject
     with pytest.raises(Exception, match="divisible"):
         probe("sequence_reshape", {"X": x, "SeqLen": lens}, {"new_dim": 4},
               ["Out", "OutLen"])
+    # dense (no SeqLen) rows have no padding boundary: allowed
+    (dense_r,) = probe("sequence_reshape", {"X": _r(2, 8, 2, seed=113)},
+                       {"new_dim": 4}, ["Out"])
+    assert dense_r.shape == (2, 4, 4)
 
     y = _r(b, 3, d, seed=112)
     ylens = np.array([1, 3], "int32")

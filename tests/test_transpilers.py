@@ -101,3 +101,46 @@ def test_fuse_relu_into_conv_pass_preserves_output():
         (got,) = exe.run(prog, feed=feed, fetch_list=[out], scope=scope)
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
     assert (np.asarray(got) >= 0).all()
+
+
+def test_attention_fuse_pass_rewrites_and_matches():
+    """attention_fuse_pass collapses matmul->(+bias)->softmax->matmul into
+    one fused_attention op with identical numerics; the causal decoder
+    bias ([B,1,Tq,Tk]) is conservatively left alone."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.models import transformer as tfm
+    from paddle_tpu.transpiler.pass_registry import apply_pass
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        q = layers.data("afq", shape=[8, 32])   # [B, T, d_model]
+        kbias = layers.data("afb", shape=[1, 1, 8])  # rank-1 in Tk
+        att = tfm.multi_head_attention(
+            q, q, q, kbias, d_model=32, n_head=2,
+            dropout_rate=0.1, is_test=True,
+        )
+        out = layers.reduce_sum(att)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    feed = {
+        "afq": rng.rand(3, 8, 32).astype("float32"),
+        "afb": np.where(rng.rand(3, 1, 1, 8) > 0.3, 0.0, -1e9).astype("float32"),
+    }
+    (before,) = exe.run(main, feed=feed, fetch_list=[out])
+
+    n_matmul_before = sum(1 for op in main.global_block().ops
+                          if op.type == "matmul")
+    apply_pass(main, "attention_fuse_pass")
+    types = [op.type for op in main.global_block().ops]
+    assert "fused_attention" in types, types
+    # the QK^T and PV matmuls are gone (the projection fc 'mul' ops remain)
+    assert sum(1 for t in types if t == "matmul") <= n_matmul_before - 2
+
+    (after,) = exe.run(main, feed=feed, fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(after), np.asarray(before),
+                               rtol=2e-4, atol=2e-5)
