@@ -1,0 +1,138 @@
+"""Image preprocessing helpers (python/paddle/dataset/image.py parity).
+
+The reference shells out to cv2 for decode/resize; here decode uses PIL
+when available (cv2/PIL are IO conveniences, not framework core) and the
+geometric transforms are pure numpy, so the training-path functions
+(resize_short, crops, flip, to_chw, simple_transform) work in any
+environment.  Interpolation is bilinear via numpy gather — host-side prep
+work; on-device resize lives in the bilinear_interp/nearest_interp ops.
+"""
+
+import numpy as np
+
+__all__ = [
+    "load_image",
+    "load_image_bytes",
+    "resize_short",
+    "to_chw",
+    "center_crop",
+    "random_crop",
+    "left_right_flip",
+    "simple_transform",
+    "load_and_transform",
+]
+
+
+def _decode(data):
+    try:
+        import io
+
+        from PIL import Image
+    except ImportError as e:  # pragma: no cover - PIL present in this env
+        raise RuntimeError(
+            "image decode needs PIL (install pillow) — the numpy transforms "
+            "below work on already-decoded arrays"
+        ) from e
+    return Image.open(io.BytesIO(data))
+
+
+def load_image_bytes(data, is_color=True):
+    """Decode an encoded image buffer to HWC uint8 (RGB) or HW (gray)."""
+    img = _decode(data)
+    img = img.convert("RGB" if is_color else "L")
+    return np.asarray(img)
+
+
+def load_image(path, is_color=True):
+    with open(path, "rb") as f:
+        return load_image_bytes(f.read(), is_color)
+
+
+def _bilinear_resize(im, out_h, out_w):
+    """Pure-numpy bilinear resize over the first two (H, W) axes."""
+    h, w = im.shape[:2]
+    if (h, w) == (out_h, out_w):
+        return im
+    ys = (np.arange(out_h) + 0.5) * h / out_h - 0.5
+    xs = (np.arange(out_w) + 0.5) * w / out_w - 0.5
+    y0 = np.clip(np.floor(ys).astype(int), 0, h - 1)
+    x0 = np.clip(np.floor(xs).astype(int), 0, w - 1)
+    y1 = np.clip(y0 + 1, 0, h - 1)
+    x1 = np.clip(x0 + 1, 0, w - 1)
+    wy = np.clip(ys - y0, 0.0, 1.0)
+    wx = np.clip(xs - x0, 0.0, 1.0)
+    trail = (1,) * (im.ndim - 2)  # broadcast over an optional channel axis
+    wx_row = wx.reshape((1, -1) + trail)
+    wy_col = wy.reshape((-1, 1) + trail)
+    im_f = im.astype(np.float64)
+    # single row-gather per source row set, then column-gathers on the
+    # already-shrunk [out_h, w, C] arrays
+    rows0 = im_f[y0]
+    rows1 = im_f[y1]
+    top = rows0[:, x0] * (1 - wx_row) + rows0[:, x1] * wx_row
+    bot = rows1[:, x0] * (1 - wx_row) + rows1[:, x1] * wx_row
+    out = top * (1 - wy_col) + bot * wy_col
+    return out.astype(im.dtype) if np.issubdtype(im.dtype, np.integer) else out
+
+
+def resize_short(im, size):
+    """Scale so the SHORTER edge becomes `size` (aspect preserved)."""
+    h, w = im.shape[:2]
+    if h < w:
+        return _bilinear_resize(im, size, int(round(w * size / h)))
+    return _bilinear_resize(im, int(round(h * size / w)), size)
+
+
+def to_chw(im, order=(2, 0, 1)):
+    """HWC -> CHW (the framework's conv layout)."""
+    return im.transpose(order)
+
+
+def center_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    h_start = (h - size) // 2
+    w_start = (w - size) // 2
+    return im[h_start:h_start + size, w_start:w_start + size]
+
+
+def random_crop(im, size, is_color=True, rng=None):
+    rng = rng or np.random
+    h, w = im.shape[:2]
+    h_start = rng.randint(0, h - size + 1)
+    w_start = rng.randint(0, w - size + 1)
+    return im[h_start:h_start + size, w_start:w_start + size]
+
+
+def left_right_flip(im, is_color=True):
+    return im[:, ::-1]
+
+
+def simple_transform(im, resize_size, crop_size, is_train, is_color=True,
+                     mean=None, rng=None):
+    """resize_short -> (random|center) crop -> (train) random flip ->
+    CHW float32 -> optional mean subtraction (per-channel or full array)."""
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size, rng=rng)
+        rng_ = rng or np.random
+        if rng_.randint(2) == 1:
+            im = left_right_flip(im)
+    else:
+        im = center_crop(im, crop_size)
+    if im.ndim == 3:
+        im = to_chw(im)
+    im = im.astype("float32")
+    if mean is not None:
+        mean = np.asarray(mean, "float32")
+        if mean.ndim == 1 and im.ndim == 3:
+            mean = mean[:, None, None]
+        im -= mean
+    return im
+
+
+def load_and_transform(filename, resize_size, crop_size, is_train,
+                       is_color=True, mean=None):
+    return simple_transform(
+        load_image(filename, is_color), resize_size, crop_size, is_train,
+        is_color, mean,
+    )

@@ -269,7 +269,25 @@ class AttentionFusePass(Pass):
             ) == "downgrade_in_infer":
                 post_scale = 1.0 - float(drop.attrs.get("dropout_prob", 0.0))
             qvar = block._find_var_recursive(m1.inputs["X"][0])
-            if qvar is None or qvar.shape is None or len(qvar.shape) != 4:
+            kvar = block._find_var_recursive(m1.inputs["Y"][0])
+            vvar = block._find_var_recursive(m2.inputs["Y"][0])
+            if any(
+                v is None or v.shape is None or len(v.shape) != 4
+                for v in (qvar, kvar, vvar)
+            ):
+                return False
+
+            def _dim(v, i):
+                return int(v.shape[i])
+
+            # kernel contract: K/V share Q's head-feature dim and each
+            # other's Tk (ops/nn_ops fused_attention reshapes with Q's d)
+            if (
+                _dim(kvar, 3) != _dim(qvar, 3)
+                or _dim(vvar, 3) != _dim(qvar, 3)
+                or (_dim(kvar, 2) != -1 and _dim(vvar, 2) != -1
+                    and _dim(kvar, 2) != _dim(vvar, 2))
+            ):
                 return False
             inputs = {
                 "Q": m1.inputs["X"],
@@ -297,6 +315,8 @@ class AttentionFusePass(Pass):
                     or int(bvar.shape[2]) != 1
                     or (int(bvar.shape[0]) not in (-1,)
                         and int(bvar.shape[0]) != int(qvar.shape[0]))
+                    or (int(bvar.shape[3]) != -1 and _dim(kvar, 2) != -1
+                        and int(bvar.shape[3]) != _dim(kvar, 2))
                 ):
                     return False
                 inputs["Bias"] = [bname]
@@ -314,7 +334,10 @@ class AttentionFusePass(Pass):
             )
             fused.inputs = inputs
             out_name = m2.outputs["Out"][0]
-            idx = block.ops.index(m1)
+            # insert where the SECOND matmul sat: every fused input (incl.
+            # a V/Bias produced between the two matmuls) is defined there;
+            # the executor runs block.ops strictly in list order
+            idx = block.ops.index(m2) - (len(chain) - 1)
             new_ops = [fused]
             if post_scale != 1.0:
                 raw = out_name + "@ATTN_RAW"

@@ -144,3 +144,40 @@ def test_attention_fuse_pass_rewrites_and_matches():
     (after,) = exe.run(main, feed=feed, fetch_list=[out])
     np.testing.assert_allclose(np.asarray(after), np.asarray(before),
                                rtol=2e-4, atol=2e-5)
+
+
+def test_attention_fuse_pass_v_produced_between_matmuls():
+    """The fused op must land where the SECOND matmul sat: a V projection
+    emitted between the two matmuls (legal topological order) stays
+    defined before its consumer."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.transpiler.pass_registry import apply_pass
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        q = layers.data("vq", shape=[2, 6, 8])     # [B, H, T, D] pre-split
+        vsrc = layers.data("vv", shape=[2, 6, 8])
+        prod = layers.matmul(q, q, transpose_y=True, alpha=8 ** -0.5)
+        v = layers.scale(vsrc, scale=2.0)          # V producer BETWEEN matmuls
+        probs = layers.softmax(prod)
+        ctx = layers.matmul(probs, v)
+        out = layers.reduce_sum(ctx)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(2)
+    feed = {"vq": rng.rand(2, 2, 6, 8).astype("float32"),
+            "vv": rng.rand(2, 2, 6, 8).astype("float32")}
+    (before,) = exe.run(main, feed=feed, fetch_list=[out])
+
+    apply_pass(main, "attention_fuse_pass")
+    types = [op.type for op in main.global_block().ops]
+    assert "fused_attention" in types, types
+    assert types.index("scale") < types.index("fused_attention"), types
+
+    (after,) = exe.run(main, feed=feed, fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(after), np.asarray(before),
+                               rtol=2e-4, atol=2e-5)
