@@ -64,15 +64,16 @@ def _bilinear_resize(im, out_h, out_w):
     trail = (1,) * (im.ndim - 2)  # broadcast over an optional channel axis
     wx_row = wx.reshape((1, -1) + trail)
     wy_col = wy.reshape((-1, 1) + trail)
-    im_f = im.astype(np.float64)
-    # single row-gather per source row set, then column-gathers on the
-    # already-shrunk [out_h, w, C] arrays
-    rows0 = im_f[y0]
-    rows1 = im_f[y1]
+    # gather the needed source rows FIRST, then convert — the float copy
+    # is [out_h, w, C], never the full source image
+    rows0 = im[y0].astype(np.float64)
+    rows1 = im[y1].astype(np.float64)
     top = rows0[:, x0] * (1 - wx_row) + rows0[:, x1] * wx_row
     bot = rows1[:, x0] * (1 - wx_row) + rows1[:, x1] * wx_row
     out = top * (1 - wy_col) + bot * wy_col
-    return out.astype(im.dtype) if np.issubdtype(im.dtype, np.integer) else out
+    if np.issubdtype(im.dtype, np.integer):
+        return np.rint(out).astype(im.dtype)  # round, don't truncate-darken
+    return out
 
 
 def resize_short(im, size):

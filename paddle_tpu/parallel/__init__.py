@@ -16,6 +16,7 @@ from .mesh import make_mesh, default_mesh, mesh_axis_sizes
 from .sharding import ShardingRules, data_parallel_rules, transformer_tp_rules
 from .executor import DistributedExecutor
 from . import ring
+from . import ulysses
 from . import collective
 from . import pipeline
 from . import moe

@@ -280,10 +280,15 @@ class AttentionFusePass(Pass):
             def _dim(v, i):
                 return int(v.shape[i])
 
-            # kernel contract: K/V share Q's head-feature dim and each
-            # other's Tk (ops/nn_ops fused_attention reshapes with Q's d)
+            # kernel contract: K/V share Q's batch/head/feature dims and
+            # each other's Tk (fused_attention reshapes K/V with Q's b, h,
+            # d — MQA-style broadcastable K/V must stay on the matmul path)
             if (
-                _dim(kvar, 3) != _dim(qvar, 3)
+                _dim(kvar, 0) != _dim(qvar, 0)
+                or _dim(vvar, 0) != _dim(qvar, 0)
+                or _dim(kvar, 1) != _dim(qvar, 1)
+                or _dim(vvar, 1) != _dim(qvar, 1)
+                or _dim(kvar, 3) != _dim(qvar, 3)
                 or _dim(vvar, 3) != _dim(qvar, 3)
                 or (_dim(kvar, 2) != -1 and _dim(vvar, 2) != -1
                     and _dim(kvar, 2) != _dim(vvar, 2))

@@ -326,3 +326,20 @@ def test_dataset_image_decode_roundtrip(tmp_path):
     np.testing.assert_array_equal(got, arr)
     gray = img.load_image(str(p), is_color=False)
     assert gray.shape == (8, 9)
+
+
+def test_image_resize_rounds_not_truncates():
+    """uint8 bilinear resize rounds to nearest (PIL/cv2 parity) instead of
+    truncation-darkening."""
+    from paddle_tpu.dataset import image as img
+
+    im = np.full((4, 6, 3), 201, "uint8")
+    im[::2] = 202  # interpolated rows land at ~201.5
+    out = img.resize_short(im, 3)
+    assert out.dtype == np.uint8
+    # every output pixel must be one of the neighbors or the ROUNDED mid
+    assert set(np.unique(out)) <= {201, 202}
+    mid = img._bilinear_resize(
+        np.array([[100, 101]], "uint8").reshape(1, 2), 1, 3
+    )
+    assert mid.flatten().tolist()[1] in (100, 101)  # rounded, never 99

@@ -181,3 +181,35 @@ def test_attention_fuse_pass_v_produced_between_matmuls():
     (after,) = exe.run(main, feed=feed, fetch_list=[out])
     np.testing.assert_allclose(np.asarray(after), np.asarray(before),
                                rtol=2e-4, atol=2e-5)
+
+
+def test_attention_fuse_pass_leaves_mqa_alone():
+    """Broadcastable (MQA-style) K/V run fine on the matmul path but would
+    crash the fused kernel's reshape — the pass must skip them."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.transpiler.pass_registry import apply_pass
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        q = layers.data("mq", shape=[4, 8, 16])   # [B, 4 heads, T, D]
+        kv = layers.data("mkv", shape=[1, 8, 16])  # [B, 1 head, T, D]
+        prod = layers.matmul(q, kv, transpose_y=True, alpha=16 ** -0.5)
+        probs = layers.softmax(prod)
+        ctx = layers.matmul(probs, kv)
+        out = layers.reduce_sum(ctx)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(4)
+    feed = {"mq": rng.rand(2, 4, 8, 16).astype("float32"),
+            "mkv": rng.rand(2, 1, 8, 16).astype("float32")}
+    (before,) = exe.run(main, feed=feed, fetch_list=[out])
+
+    apply_pass(main, "attention_fuse_pass")
+    types = [op.type for op in main.global_block().ops]
+    assert "fused_attention" not in types, types
+    (after,) = exe.run(main, feed=feed, fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(after), np.asarray(before), rtol=1e-6)
