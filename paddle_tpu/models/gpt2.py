@@ -17,7 +17,14 @@ from .. import layers, unique_name
 from ..initializer import Normal
 from ..param_attr import ParamAttr
 
-__all__ = ["GPT2Config", "gpt2_lm", "gpt2_lm_program", "make_fake_lm_batch"]
+__all__ = [
+    "GPT2Config",
+    "gpt2_lm",
+    "gpt2_lm_program",
+    "gpt2_logits_program",
+    "greedy_generate",
+    "make_fake_lm_batch",
+]
 
 
 class GPT2Config:
@@ -89,12 +96,16 @@ def gpt2_lm(ids, hp=GPT2Config, is_test=False):
 def gpt2_lm_program(hp=GPT2Config, seq_len=128, lr=3e-4, is_test=False,
                     use_bf16=False):
     """Build (main, startup, feeds, [loss, token_count]) for causal-LM
-    training.  Feeds: ids/labels [B, T] int64, loss_weight [B, T] float."""
+    training.  Feeds: ids/labels [B, T] int64, loss_weight [B, T] float.
+
+    Built under unique_name.guard(): parameter names are deterministic, so
+    a logits program built later in the same process shares weights with
+    this one through the scope by name (the train->generate workflow)."""
     import paddle_tpu as fluid
 
     main = fluid.Program()
     startup = fluid.Program()
-    with fluid.program_guard(main, startup):
+    with fluid.program_guard(main, startup), unique_name.guard():
         ids = layers.data("ids", shape=[seq_len], dtype="int64")
         lbl = layers.data("labels", shape=[seq_len], dtype="int64")
         w = layers.data("loss_weight", shape=[seq_len], dtype="float32")
@@ -128,3 +139,45 @@ def make_fake_lm_batch(batch_size, seq_len, hp=GPT2Config, seed=0):
         "labels": ids[:, 1:],
         "loss_weight": np.ones((batch_size, seq_len), "float32"),
     }
+
+
+def gpt2_logits_program(hp=GPT2Config, seq_len=128):
+    """Inference program fetching the full [B, T, vocab] logits (the
+    decode-step workhorse: static shapes, one compile for any prompt
+    length <= seq_len)."""
+    import paddle_tpu as fluid
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup), unique_name.guard():
+        ids = layers.data("ids", shape=[seq_len], dtype="int64")
+        logits = gpt2_lm(ids, hp, is_test=True)
+    return main, startup, ["ids"], [logits]
+
+
+def greedy_generate(exe, main, fetches, prompt_ids, max_new_tokens,
+                    pad_id=0):
+    """Greedy decoding on a fixed-shape logits program: the prompt is
+    right-padded to the program's T, each step feeds the updated ids and
+    reads the logits at the last real position.  One XLA compile total
+    (static shapes); causal masking makes the padded tail invisible.
+
+    prompt_ids: [B, P] int64.  Returns [B, P + max_new_tokens] int64.
+    """
+    ids_var = main.global_block().vars["ids"]
+    T = int(ids_var.shape[1])
+    prompt_ids = np.asarray(prompt_ids, "int64")
+    b, p = prompt_ids.shape
+    assert p >= 1, "empty prompt: seed generation with at least a BOS token"
+    assert p + max_new_tokens <= T, (
+        "program seq_len %d < prompt %d + new %d" % (T, p, max_new_tokens)
+    )
+    buf = np.full((b, T), pad_id, "int64")
+    buf[:, :p] = prompt_ids
+    cur = p
+    for _ in range(max_new_tokens):
+        (logits,) = exe.run(main, feed={"ids": buf}, fetch_list=fetches)
+        nxt = np.asarray(logits)[:, cur - 1, :].argmax(axis=-1)
+        buf[:, cur] = nxt
+        cur += 1
+    return buf[:, :cur]
