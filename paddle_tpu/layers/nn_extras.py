@@ -1,0 +1,644 @@
+"""Layer wrappers completing the reference nn.py __all__ surface
+(python/paddle/fluid/layers/nn.py) over already-registered lowerings.
+
+Parameters (nce/hsigmoid tables, row_conv filters, bilinear products,
+gru_unit gates) are created through LayerHelper exactly like the
+hand-written layers; everything else is slot wiring."""
+
+
+from ..layer_helper import LayerHelper
+from . import nn as _nn
+from .tensor import concat as _concat
+
+__all__ = [
+    "add_position_encoding",
+    "affine_channel",
+    "affine_grid",
+    "autoincreased_step_counter",
+    "bilinear_tensor_product",
+    "chunk_eval",
+    "crf_decoding",
+    "crop",
+    "ctc_greedy_decoder",
+    "dice_loss",
+    "dynamic_lstmp",
+    "edit_distance",
+    "grid_sampler",
+    "gru_unit",
+    "hash",
+    "hsigmoid",
+    "im2sequence",
+    "image_resize_short",
+    "linear_chain_crf",
+    "lod_reset",
+    "logical_and",
+    "logical_not",
+    "logical_or",
+    "logical_xor",
+    "lstm_unit",
+    "margin_rank_loss",
+    "mean_iou",
+    "multiplex",
+    "nce",
+    "pad_constant_like",
+    "pool3d",
+    "random_crop",
+    "rank_loss",
+    "roi_align",
+    "roi_pool",
+    "row_conv",
+    "sequence_enumerate",
+    "sequence_expand_as",
+    "sequence_scatter",
+    "similarity_focus",
+    "space_to_depth",
+    "warpctc",
+]
+
+
+def _simple(op_type, inputs, n_out=1, dtype=None, attrs=None, out_slots=None):
+    helper = LayerHelper(op_type)
+    first = next(iter(inputs.values()))[0]
+    dtype = dtype or getattr(first, "dtype", "float32")
+    outs = [
+        helper.create_variable_for_type_inference(dtype) for _ in range(n_out)
+    ]
+    slots = out_slots or (["Out"] if n_out == 1 else None)
+    helper.append_op(
+        op_type,
+        inputs=inputs,
+        outputs={s: [o] for s, o in zip(slots, outs)},
+        attrs=attrs or {},
+    )
+    return outs[0] if n_out == 1 else tuple(outs)
+
+
+def add_position_encoding(input, alpha=1.0, beta=1.0, name=None):
+    return _simple("add_position_encoding", {"X": [input]},
+                   attrs={"alpha": float(alpha), "beta": float(beta)})
+
+
+def affine_channel(x, scale, bias, data_layout="NCHW", name=None):
+    return _simple(
+        "affine_channel", {"X": [x], "Scale": [scale], "Bias": [bias]},
+        attrs={"data_layout": data_layout},
+    )
+
+
+def affine_grid(theta, out_shape, name=None):
+    attrs = {}
+    inputs = {"Theta": [theta]}
+    if isinstance(out_shape, (list, tuple)):
+        attrs["output_shape"] = [int(s) for s in out_shape]
+    else:
+        inputs["OutputShape"] = [out_shape]
+    return _simple("affine_grid", inputs, attrs=attrs, out_slots=["Output"])
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """Own int64 counter honoring counter_name/begin/step — NOT the LR
+    scheduler's shared float32 '@LR_DECAY_COUNTER@' (sharing it would let
+    whichever caller ran first clobber the other's begin/step)."""
+    from ..initializer import Constant
+
+    helper = LayerHelper("global_step_counter")
+    name = counter_name or "@STEP_COUNTER@"
+    counter = helper.create_or_get_global_variable(
+        name=name, dtype="int64", shape=[1], persistable=True
+    )
+    if not getattr(counter, "_step_initialized", False):
+        # initialize one step back so the first fetch reads `begin`
+        helper.set_variable_initializer(counter, Constant(begin - step))
+        counter._step_initialized = True
+        helper.append_op(
+            "increment",
+            inputs={"X": [counter]},
+            outputs={"Out": [counter]},
+            attrs={"step": float(step)},
+        )
+        counter.stop_gradient = True
+    return counter
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    helper = LayerHelper("bilinear_tensor_product", name=name,
+                         param_attr=param_attr, bias_attr=bias_attr,
+                         act=act)
+    w = helper.create_parameter(
+        attr=helper.param_attr,
+        shape=[size, int(x.shape[1]), int(y.shape[1])],
+        dtype=x.dtype,
+    )
+    inputs = {"X": [x], "Y": [y], "Weight": [w]}
+    if bias_attr is not False:
+        b = helper.create_parameter(
+            attr=helper.bias_attr, shape=[1, size], dtype=x.dtype,
+            is_bias=True,
+        )
+        inputs["Bias"] = [b]
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("bilinear_tensor_product", inputs=inputs,
+                     outputs={"Out": [out]})
+    return helper.append_activation(out) if act else out
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, seq_length=None):
+    helper = LayerHelper("chunk_eval")
+    outs = [helper.create_variable_for_type_inference("float32")
+            for _ in range(3)]
+    counts = [helper.create_variable_for_type_inference("int64")
+              for _ in range(3)]
+    inputs = {"Inference": [input], "Label": [label]}
+    if seq_length is not None:
+        inputs["Length"] = [seq_length]
+    helper.append_op(
+        "chunk_eval",
+        inputs=inputs,
+        outputs={
+            "Precision": [outs[0]],
+            "Recall": [outs[1]],
+            "F1-Score": [outs[2]],
+            "NumInferChunks": [counts[0]],
+            "NumLabelChunks": [counts[1]],
+            "NumCorrectChunks": [counts[2]],
+        },
+        attrs={
+            "chunk_scheme": chunk_scheme,
+            "num_chunk_types": num_chunk_types,
+            "excluded_chunk_types": excluded_chunk_types or [],
+        },
+    )
+    return tuple(outs) + tuple(counts)
+
+
+def crf_decoding(input, param_attr, label=None, length=None):
+    """Viterbi decode with the transition table trained by
+    linear_chain_crf (looked up by the shared param_attr name)."""
+    helper = LayerHelper("crf_decoding", param_attr=param_attr)
+    transition = helper.main_program.global_block()._find_var_recursive(
+        param_attr.name
+    )
+    if transition is None:
+        raise ValueError(
+            "crf_decoding: transition parameter %r not found — train with "
+            "linear_chain_crf(param_attr=ParamAttr(name=...)) first"
+            % param_attr.name
+        )
+    inputs = {"Emission": [input], "Transition": [transition]}
+    if label is not None:
+        inputs["Label"] = [label]
+    if length is not None:
+        inputs["Length"] = [length]
+    out = helper.create_variable_for_type_inference("int64")
+    helper.append_op("crf_decoding", inputs=inputs,
+                     outputs={"ViterbiPath": [out]})
+    return out
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    inputs = {"X": [x]}
+    attrs = {}
+    if isinstance(shape, (list, tuple)):
+        attrs["shape"] = [int(s) for s in shape]
+    elif shape is not None:
+        inputs["Y"] = [shape]
+    if isinstance(offsets, (list, tuple)):
+        attrs["offsets"] = [int(o) for o in offsets]
+    elif offsets is not None:
+        inputs["Offsets"] = [offsets]
+    return _simple("crop", inputs, attrs=attrs)
+
+
+def ctc_greedy_decoder(input, blank, input_length=None, name=None):
+    helper = LayerHelper("ctc_align", name=name)
+    out = helper.create_variable_for_type_inference("int64")
+    out_len = helper.create_variable_for_type_inference("int64")
+    inputs = {"Input": [input]}
+    if input_length is not None:
+        inputs["InputLength"] = [input_length]
+    helper.append_op(
+        "ctc_align", inputs=inputs,
+        outputs={"Output": [out], "OutputLength": [out_len]},
+        attrs={"blank": int(blank), "merge_repeated": True},
+    )
+    out.seq_len = out_len
+    return out
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    """Reference composition: mean over the batch of
+    1 - 2|X∩Y| / (|X|+|Y|+eps) — a scalar loss fit for minimize()."""
+    label = _nn.one_hot(label, int(input.shape[-1]))
+    intersect = _nn.reduce_sum(_nn.elementwise_mul(input, label), dim=-1)
+    denom = _nn.elementwise_add(
+        _nn.reduce_sum(input, dim=-1), _nn.reduce_sum(label, dim=-1)
+    )
+    num = _nn.scale(intersect, scale=2.0)
+    den = _nn.scale(denom, scale=1.0, bias=float(epsilon))
+    per_sample = _nn.scale(
+        _nn.elementwise_div(num, den), scale=-1.0, bias=1.0
+    )
+    return _nn.reduce_mean(per_sample)
+
+
+def dynamic_lstmp(input, size, proj_size, seq_len=None, h0=None, c0=None,
+                  param_attr=None, bias_attr=None, is_reverse=False,
+                  name=None):
+    """LSTM with recurrent projection (lstmp_op): input is the
+    pre-projected [B, T, 4*size] gates (use an fc, as dynamic_lstm)."""
+    helper = LayerHelper("lstmp", name=name, param_attr=param_attr,
+                         bias_attr=bias_attr)
+    hidden = size // 4
+    w = helper.create_parameter(
+        attr=helper.param_attr, shape=[proj_size, size], dtype=input.dtype
+    )
+    proj_w = helper.create_parameter(
+        attr=None, shape=[hidden, proj_size], dtype=input.dtype
+    )
+    inputs = {"Input": [input], "Weight": [w], "ProjWeight": [proj_w]}
+    if bias_attr is not False:
+        b = helper.create_parameter(
+            attr=helper.bias_attr, shape=[size], dtype=input.dtype,
+            is_bias=True,
+        )
+        inputs["Bias"] = [b]
+    if seq_len is not None:
+        inputs["SeqLen"] = [seq_len]
+    if h0 is not None:
+        inputs["H0"] = [h0]
+    if c0 is not None:
+        inputs["C0"] = [c0]
+    proj = helper.create_variable_for_type_inference(input.dtype)
+    cell = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "lstmp", inputs=inputs,
+        outputs={"Projection": [proj], "Cell": [cell]},
+        attrs={"is_reverse": is_reverse},
+    )
+    return proj, cell
+
+
+def edit_distance(input, label, normalized=True, input_length=None,
+                  label_length=None):
+    helper = LayerHelper("edit_distance")
+    out = helper.create_variable_for_type_inference("float32")
+    seq_num = helper.create_variable_for_type_inference("int64")
+    inputs = {"Hyps": [input], "Refs": [label]}
+    if input_length is not None:
+        inputs["HypsLength"] = [input_length]
+    if label_length is not None:
+        inputs["RefsLength"] = [label_length]
+    helper.append_op(
+        "edit_distance", inputs=inputs,
+        outputs={"Out": [out], "SequenceNum": [seq_num]},
+        attrs={"normalized": normalized},
+    )
+    return out, seq_num
+
+
+def grid_sampler(x, grid, name=None):
+    return _simple("grid_sampler", {"X": [x], "Grid": [grid]},
+                   out_slots=["Output"])
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid"):
+    helper = LayerHelper("gru_unit", param_attr=param_attr,
+                         bias_attr=bias_attr)
+    hid = size // 3
+    w = helper.create_parameter(
+        attr=helper.param_attr, shape=[hid, size], dtype=input.dtype
+    )
+    inputs = {"Input": [input], "HiddenPrev": [hidden], "Weight": [w]}
+    if bias_attr is not False:
+        b = helper.create_parameter(
+            attr=helper.bias_attr, shape=[size], dtype=input.dtype,
+            is_bias=True,
+        )
+        inputs["Bias"] = [b]
+    gate = helper.create_variable_for_type_inference(input.dtype)
+    reset = helper.create_variable_for_type_inference(input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "gru_unit", inputs=inputs,
+        outputs={"Gate": [gate], "ResetHiddenPrev": [reset], "Hidden": [out]},
+        attrs={"activation": activation, "gate_activation": gate_activation},
+    )
+    return out, reset, gate
+
+
+def hash(input, hash_size, num_hash=1, name=None):
+    return _simple("hash", {"X": [input]}, dtype="int32",
+                   attrs={"mod_by": int(hash_size), "num_hash": int(num_hash)})
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None):
+    helper = LayerHelper("hierarchical_sigmoid", param_attr=param_attr,
+                         bias_attr=bias_attr)
+    dim = int(input.shape[1])
+    w = helper.create_parameter(
+        attr=helper.param_attr, shape=[num_classes - 1, dim],
+        dtype=input.dtype,
+    )
+    inputs = {"X": [input], "Label": [label], "W": [w]}
+    if bias_attr is not False:
+        b = helper.create_parameter(
+            attr=helper.bias_attr, shape=[num_classes - 1, 1],
+            dtype=input.dtype, is_bias=True,
+        )
+        inputs["Bias"] = [b]
+    out = helper.create_variable_for_type_inference(input.dtype)
+    pre = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "hierarchical_sigmoid", inputs=inputs,
+        outputs={"Out": [out], "PreOut": [pre]},
+        attrs={"num_classes": int(num_classes)},
+    )
+    return out
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, name=None):
+    def _pair(v):
+        return [v, v] if isinstance(v, int) else [int(i) for i in v]
+
+    pad = _pair(padding)
+    if len(pad) == 2:
+        pad = pad + pad
+    return _simple(
+        "im2sequence", {"X": [input]},
+        attrs={"kernels": _pair(filter_size), "strides": _pair(stride),
+               "paddings": pad},
+    )
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    """Resize so the SHORTER spatial edge equals out_short_len (reference
+    nn.image_resize_short composition)."""
+    h, w = int(input.shape[2]), int(input.shape[3])
+    if h < w:
+        oh, ow = out_short_len, int(round(w * out_short_len / h))
+    else:
+        oh, ow = int(round(h * out_short_len / w)), out_short_len
+    return _nn.image_resize(input, out_shape=[oh, ow], resample=resample)
+
+
+def linear_chain_crf(input, label, param_attr=None, length=None):
+    helper = LayerHelper("linear_chain_crf", param_attr=param_attr)
+    n_tags = int(input.shape[-1])
+    transition = helper.create_parameter(
+        attr=helper.param_attr, shape=[n_tags + 2, n_tags],
+        dtype=input.dtype,
+    )
+    inputs = {"Emission": [input], "Transition": [transition],
+              "Label": [label]}
+    if length is not None:
+        inputs["Length"] = [length]
+    outs = [helper.create_variable_for_type_inference(input.dtype)
+            for _ in range(4)]
+    helper.append_op(
+        "linear_chain_crf", inputs=inputs,
+        outputs={"Alpha": [outs[0]], "EmissionExps": [outs[1]],
+                 "TransitionExps": [outs[2]], "LogLikelihood": [outs[3]]},
+    )
+    return outs[3]
+
+
+def lod_reset(x, y=None, target_lod=None):
+    inputs = {"X": [x]}
+    if y is not None:
+        inputs["Y"] = [y]
+    return _simple("lod_reset", inputs,
+                   attrs={"target_lod": target_lod or []})
+
+
+def _logical(op_type, x, y=None, out=None, name=None):
+    inputs = {"X": [x]}
+    if y is not None:
+        inputs["Y"] = [y]
+    return _simple(op_type, inputs, dtype="bool")
+
+
+def logical_and(x, y, out=None, name=None):
+    return _logical("logical_and", x, y, out, name)
+
+
+def logical_or(x, y, out=None, name=None):
+    return _logical("logical_or", x, y, out, name)
+
+
+def logical_xor(x, y, out=None, name=None):
+    return _logical("logical_xor", x, y, out, name)
+
+
+def logical_not(x, out=None, name=None):
+    return _logical("logical_not", x, None, out, name)
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """One LSTM step (lstm_unit_op): gates come from an fc over
+    [x_t, h_prev] like the reference composition."""
+    concat = _concat([x_t, hidden_t_prev], axis=1)
+    size = 4 * int(cell_t_prev.shape[1])
+    gates = _nn.fc(concat, size=size, param_attr=param_attr,
+                   bias_attr=bias_attr)
+    helper = LayerHelper("lstm_unit", name=name)
+    c = helper.create_variable_for_type_inference(x_t.dtype)
+    h = helper.create_variable_for_type_inference(x_t.dtype)
+    helper.append_op(
+        "lstm_unit", inputs={"X": [gates], "C_prev": [cell_t_prev]},
+        outputs={"C": [c], "H": [h]},
+        attrs={"forget_bias": float(forget_bias)},
+    )
+    return h, c
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    helper = LayerHelper("margin_rank_loss", name=name)
+    out = helper.create_variable_for_type_inference(left.dtype)
+    act = helper.create_variable_for_type_inference(left.dtype)
+    helper.append_op(
+        "margin_rank_loss",
+        inputs={"Label": [label], "X1": [left], "X2": [right]},
+        outputs={"Out": [out], "Activated": [act]},
+        attrs={"margin": float(margin)},
+    )
+    return out
+
+
+def mean_iou(input, label, num_classes):
+    helper = LayerHelper("mean_iou")
+    miou = helper.create_variable_for_type_inference("float32")
+    wrong = helper.create_variable_for_type_inference("int32")
+    correct = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        "mean_iou",
+        inputs={"Predictions": [input], "Labels": [label]},
+        outputs={"OutMeanIou": [miou], "OutWrong": [wrong],
+                 "OutCorrect": [correct]},
+        attrs={"num_classes": int(num_classes)},
+    )
+    return miou, wrong, correct
+
+
+def multiplex(inputs, index):
+    helper = LayerHelper("multiplex")
+    out = helper.create_variable_for_type_inference(inputs[0].dtype)
+    helper.append_op(
+        "multiplex", inputs={"X": list(inputs), "Ids": [index]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=None, name=None):
+    helper = LayerHelper("nce", param_attr=param_attr, bias_attr=bias_attr)
+    dim = int(input.shape[1])
+    w = helper.create_parameter(
+        attr=helper.param_attr, shape=[num_total_classes, dim],
+        dtype=input.dtype,
+    )
+    inputs = {"Input": [input], "Label": [label], "Weight": [w]}
+    if sample_weight is not None:
+        inputs["SampleWeight"] = [sample_weight]
+    if bias_attr is not False:
+        b = helper.create_parameter(
+            attr=helper.bias_attr, shape=[num_total_classes, 1],
+            dtype=input.dtype, is_bias=True,
+        )
+        inputs["Bias"] = [b]
+    cost = helper.create_variable_for_type_inference(input.dtype)
+    sl = helper.create_variable_for_type_inference(input.dtype)
+    sll = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        "nce", inputs=inputs,
+        outputs={"Cost": [cost], "SampleLogits": [sl], "SampleLabels": [sll]},
+        attrs={"num_total_classes": int(num_total_classes),
+               "num_neg_samples": int(num_neg_samples or 10)},
+    )
+    return cost
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    return _simple("pad_constant_like", {"X": [x], "Y": [y]},
+                   attrs={"pad_value": float(pad_value)})
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, name=None):
+    def _trip(v):
+        return [v, v, v] if isinstance(v, int) else [int(i) for i in v]
+
+    return _simple(
+        "pool3d", {"X": [input]},
+        attrs={"ksize": _trip(pool_size), "pooling_type": pool_type,
+               "strides": _trip(pool_stride), "paddings": _trip(pool_padding),
+               "global_pooling": bool(global_pooling)},
+    )
+
+
+def random_crop(x, shape, seed=None):
+    helper = LayerHelper("random_crop")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    seed_out = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        "random_crop", inputs={"X": [x]},
+        outputs={"Out": [out], "SeedOut": [seed_out]},
+        attrs={"shape": [int(s) for s in shape],
+               "startup_seed": int(seed or 0)},
+    )
+    return out
+
+
+def rank_loss(label, left, right, name=None):
+    return _simple("rank_loss",
+                   {"Label": [label], "Left": [left], "Right": [right]})
+
+
+def _roi(op_type, input, rois, pooled_height, pooled_width, spatial_scale,
+         rois_batch=None, extra_attrs=None, n_out=1, out_slots=None):
+    inputs = {"X": [input], "ROIs": [rois]}
+    if rois_batch is not None:
+        inputs["RoisBatch"] = [rois_batch]
+    attrs = {"pooled_height": int(pooled_height),
+             "pooled_width": int(pooled_width),
+             "spatial_scale": float(spatial_scale)}
+    attrs.update(extra_attrs or {})
+    return _simple(op_type, inputs, n_out=n_out, attrs=attrs,
+                   out_slots=out_slots)
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0, rois_batch=None):
+    out = _roi("roi_pool", input, rois, pooled_height, pooled_width,
+               spatial_scale, rois_batch, n_out=2,
+               out_slots=["Out", "Argmax"])
+    return out[0]
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, rois_batch=None):
+    return _roi("roi_align", input, rois, pooled_height, pooled_width,
+                spatial_scale, rois_batch,
+                extra_attrs={"sampling_ratio": int(sampling_ratio)})
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    helper = LayerHelper("row_conv", param_attr=param_attr, act=act)
+    filt = helper.create_parameter(
+        attr=helper.param_attr,
+        shape=[future_context_size + 1, int(input.shape[-1])],
+        dtype=input.dtype,
+    )
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("row_conv", inputs={"X": [input], "Filter": [filt]},
+                     outputs={"Out": [out]})
+    return helper.append_activation(out) if act else out
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    return _simple("sequence_enumerate", {"X": [input]}, dtype=input.dtype,
+                   attrs={"win_size": int(win_size),
+                          "pad_value": int(pad_value)})
+
+
+def sequence_expand_as(x, y, name=None):
+    return _simple("sequence_expand_as", {"X": [x], "Y": [y]})
+
+
+def sequence_scatter(input, index, updates, name=None):
+    return _simple("sequence_scatter",
+                   {"X": [input], "Ids": [index], "Updates": [updates]})
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    return _simple("similarity_focus", {"X": [input]},
+                   attrs={"axis": int(axis),
+                          "indexes": [int(i) for i in indexes]})
+
+
+def space_to_depth(x, blocksize, name=None):
+    return _simple("space_to_depth", {"X": [x]},
+                   attrs={"blocksize": int(blocksize)})
+
+
+def warpctc(input, label, blank=0, norm_by_times=False,
+            input_length=None, label_length=None):
+    helper = LayerHelper("warpctc")
+    loss = helper.create_variable_for_type_inference(input.dtype)
+    grad = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"Logits": [input], "Label": [label]}
+    if input_length is not None:
+        inputs["LogitsLength"] = [input_length]
+    if label_length is not None:
+        inputs["LabelLength"] = [label_length]
+    helper.append_op(
+        "warpctc", inputs=inputs,
+        outputs={"Loss": [loss], "WarpCTCGrad": [grad]},
+        attrs={"blank": int(blank), "norm_by_times": bool(norm_by_times)},
+    )
+    return loss

@@ -153,6 +153,8 @@ def _nce(ctx, ins, attrs):
     cost_true = -jax.nn.log_sigmoid(logit_true - log_noise)
     cost_neg = -jax.nn.log_sigmoid(-(logit_neg - log_noise))
     cost = cost_true + jnp.sum(cost_neg, axis=1)
+    if ins.get("SampleWeight"):
+        cost = cost * ins["SampleWeight"][0].reshape(-1)
     sample_logits = jnp.concatenate([logit_true[:, None], logit_neg], axis=1)
     sample_labels = jnp.concatenate(
         [lab[:, None], jnp.broadcast_to(neg[None, :], (x.shape[0], s))], axis=1

@@ -163,12 +163,21 @@ def _gru_unit(ctx, ins, attrs):
     bias = ins["Bias"][0].reshape(-1) if ins.get("Bias") else None
     if bias is not None:
         x = x + bias
+    acts = {
+        "sigmoid": jax.nn.sigmoid,
+        "tanh": jnp.tanh,
+        "relu": jax.nn.relu,
+        "identity": lambda v: v,
+        "hard_sigmoid": lambda v: jnp.clip(0.2 * v + 0.5, 0.0, 1.0),
+    }
+    gate_act = acts[attrs.get("gate_activation", "sigmoid")]
+    cand_act = acts[attrs.get("activation", "tanh")]
     gate_w = w[:, : 2 * hdim]
     cand_w = w[:, 2 * hdim :]
     gates = x[:, : 2 * hdim] + h_prev @ gate_w
-    u = jax.nn.sigmoid(gates[:, :hdim])
-    r = jax.nn.sigmoid(gates[:, hdim:])
-    c = jnp.tanh(x[:, 2 * hdim :] + (r * h_prev) @ cand_w)
+    u = gate_act(gates[:, :hdim])
+    r = gate_act(gates[:, hdim:])
+    c = cand_act(x[:, 2 * hdim :] + (r * h_prev) @ cand_w)
     # gru_unit_op.h:116: h = u * (c - h_prev) + h_prev = u*c + (1-u)*h_prev
     h = u * c + (1.0 - u) * h_prev
     return {"Gate": [gates], "ResetHiddenPrev": [r * h_prev], "Hidden": [h]}

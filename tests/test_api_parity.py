@@ -215,3 +215,145 @@ def test_conv2d_transpose_matches_numpy():
                     x[0, ci, i, j] * w[ci]
                 )
     np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_nn_extras_layer_surface_runs():
+    """Every reference nn.py __all__ function now present runs end-to-end
+    through a program (thin-wrapper batch over registered lowerings)."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.param_attr import ParamAttr
+
+    rng = np.random.RandomState(0)
+    main = fluid.Program()
+    startup = fluid.Program()
+    fetches = {}
+    with fluid.framework.program_guard(main, startup):
+        x4 = layers.data("x4", shape=[3, 8, 8])          # NCHW
+        xs = layers.data("xs", shape=[6, 4])             # [B, T, D]
+        xi = layers.data("xi", shape=[6], dtype="int64") # [B, T] ids
+        x2 = layers.data("x2", shape=[4])                # [B, D]
+        lbl = layers.data("lbl", shape=[1], dtype="int64")
+        lens = layers.data("lens", shape=[], dtype="int64")
+
+        fetches["ape"] = layers.add_position_encoding(xs)
+        sc = layers.create_parameter([3], "float32", name="ac_s")
+        bi = layers.create_parameter([3], "float32", name="ac_b")
+        fetches["ac"] = layers.affine_channel(x4, sc, bi)
+        theta = layers.fc(x2, size=6)
+        theta = layers.reshape(theta, [-1, 2, 3])
+        fetches["ag"] = layers.affine_grid(theta, [0, 3, 4, 4])
+        fetches["btp"] = layers.bilinear_tensor_product(x2, x2, 5)
+        fetches["dice"] = layers.dice_loss(layers.softmax(x2), lbl)
+        fetches["hash"] = layers.hash(xi, hash_size=97, num_hash=2)
+        fetches["hs"] = layers.hsigmoid(x2, lbl, num_classes=6)
+        fetches["i2s"] = layers.im2sequence(x4, filter_size=2, stride=2)
+        fetches["irs"] = layers.image_resize_short(x4, 6)
+        fetches["lr"] = layers.lod_reset(xs)
+        la = layers.less_than(x2, layers.scale(x2, 2.0))
+        fetches["land"] = layers.logical_and(la, la)
+        fetches["lnot"] = layers.logical_not(la)
+        fetches["lor"] = layers.logical_or(la, la)
+        fetches["lxor"] = layers.logical_xor(la, la)
+        fetches["mrl"] = layers.margin_rank_loss(
+            layers.cast(lbl, "float32"), layers.fc(x2, 1), layers.fc(x2, 1)
+        )
+        miou, _, _ = layers.mean_iou(
+            layers.cast(lbl, "int32"), layers.cast(lbl, "int32"), 4
+        )
+        fetches["miou"] = miou
+        idx = layers.cast(lbl, "int32")
+        fetches["mux"] = layers.multiplex([x2, layers.scale(x2, 2.0)], idx)
+        fetches["nce"] = layers.nce(x2, lbl, num_total_classes=8,
+                                    num_neg_samples=3)
+        fetches["pcl"] = layers.pad_constant_like(x4, layers.slice(
+            x4, axes=[2, 3], starts=[0, 0], ends=[4, 4]), 0.5)
+        fetches["p3"] = layers.pool3d(
+            layers.reshape(x4, [-1, 3, 2, 4, 8]), pool_size=2, pool_stride=2)
+        fetches["rc"] = layers.random_crop(x4, shape=[3, 6, 6], seed=1)
+        fetches["rl"] = layers.rank_loss(
+            layers.cast(lbl, "float32"), layers.fc(x2, 1), layers.fc(x2, 1))
+        fetches["sen"] = layers.sequence_enumerate(xi, win_size=2)
+        fetches["sea"] = layers.sequence_expand_as(x2, xs)
+        fetches["sf"] = layers.similarity_focus(x4, axis=1, indexes=[0])
+        fetches["s2d"] = layers.space_to_depth(x4, 2)
+        fetches["rowc"] = layers.row_conv(xs, future_context_size=2)
+        fetches["gu_h"], _, _ = layers.gru_unit(
+            layers.fc(x2, 12), layers.fc(x2, 4), size=12)
+        h, c = layers.lstm_unit(x2, layers.fc(x2, 4), layers.fc(x2, 4))
+        fetches["lu"] = h
+        proj, cell = layers.dynamic_lstmp(layers.fc(xs, 16,
+                                          num_flatten_dims=2), 16, 3)
+        fetches["lstmp"] = proj
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        feed = {
+            "x4": rng.rand(2, 3, 8, 8).astype("float32"),
+            "xs": rng.rand(2, 6, 4).astype("float32"),
+            "xi": rng.randint(0, 50, (2, 6)).astype("int64"),
+            "x2": rng.rand(2, 4).astype("float32"),
+            "lbl": rng.randint(0, 2, (2, 1)).astype("int64"),
+            "lens": np.array([6, 4], "int64"),
+        }
+        names = sorted(fetches)
+        outs = exe.run(main, feed=feed,
+                       fetch_list=[fetches[n] for n in names])
+        for n, o in zip(names, outs):
+            assert np.asarray(o) is not None and np.asarray(o).size > 0, n
+            if np.asarray(o).dtype.kind == "f":
+                assert np.isfinite(np.asarray(o)).all(), n
+
+
+def test_nn_extras_semantics():
+    """Behavioral checks for the review-hardened wrappers: own step
+    counter, scalar dice loss, honored gru activations, effective nce
+    sample_weight."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.framework.program_guard(main, startup):
+        x2 = layers.data("sx", shape=[4])
+        lbl = layers.data("slbl", shape=[1], dtype="int64")
+        ctr = layers.autoincreased_step_counter(
+            counter_name="@MY_STEP@", begin=10, step=5)
+        lr = layers.learning_rate_scheduler.exponential_decay(0.1, 100, 0.9)
+        dice = layers.dice_loss(layers.softmax(x2), lbl)
+        gh_tanh, _, _ = layers.gru_unit(layers.fc(x2, 12), layers.fc(x2, 4), 12)
+        gh_relu, _, _ = layers.gru_unit(
+            layers.fc(x2, 12), layers.fc(x2, 4), 12, activation="relu")
+        sw = layers.data("sw", shape=[], dtype="float32")
+        ncew = layers.nce(x2, lbl, num_total_classes=8, sample_weight=sw,
+                          num_neg_samples=3)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        feed = {
+            "sx": rng.rand(2, 4).astype("float32"),
+            "slbl": rng.randint(0, 2, (2, 1)).astype("int64"),
+            "sw": np.array([1.0, 0.0], "float32"),
+        }
+        c1, l1, d, g_t, g_r, nw = exe.run(
+            main, feed=feed,
+            fetch_list=[ctr, lr, dice, gh_tanh, gh_relu, ncew])
+        c2 = exe.run(main, feed=feed, fetch_list=[ctr])[0]
+    # own counter: starts at begin, advances by step; the LR schedule's
+    # counter is independent (its own step 1 on first run, NOT begin=10)
+    assert int(np.asarray(c1)[0]) == 10 and int(np.asarray(c2)[0]) == 15
+    lr1 = float(np.asarray(l1).reshape(-1)[0])
+    assert abs(lr1 - 0.1 * 0.9 ** (1 / 100)) < 1e-6, lr1
+    # dice: scalar in [0, 1]
+    d = np.asarray(d)
+    assert d.size == 1 and 0.0 <= float(d) <= 1.0
+    # activations actually change the computation
+    assert not np.allclose(np.asarray(g_t), np.asarray(g_r))
+    # zero sample_weight zeroes that sample's cost
+    nw = np.asarray(nw).reshape(-1)
+    assert nw[1] == 0.0 and nw[0] != 0.0
