@@ -4,6 +4,7 @@ from . import nn, tensor, ops, io, control_flow, metric_op, math_op_patch, detec
 from . import sequence, learning_rate_scheduler, nn_extras
 from .nn import *  # noqa: F401,F403
 from .nn_extras import *  # noqa: F401,F403
+from .detection import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403
 from .io import *  # noqa: F401,F403

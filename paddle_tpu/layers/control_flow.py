@@ -885,3 +885,42 @@ class DynamicRNN(StaticRNN):
             + list(o.shape[1:] if o.shape else []),
         )
         self.outputs.append(out)
+
+
+def Print(input, first_n=-1, message=None, summarize=-1,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase="both"):
+    """print_op: passes input through and prints it at execution time
+    (jax.debug.print on device)."""
+    helper = LayerHelper("print")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "print", inputs={"In": [input]}, outputs={"Out": [out]},
+        attrs={"message": message or input.name},
+    )
+    return out
+
+
+def is_empty(x, name=None):
+    """is_empty_op: [1] bool, true when x has zero elements."""
+    helper = LayerHelper("is_empty", name=name)
+    out = helper.create_variable_for_type_inference("bool")
+    helper.append_op("is_empty", inputs={"X": [x]}, outputs={"Out": [out]})
+    return out
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    """Permute batch rows into the rank table's order
+    (reorder_lod_tensor_by_rank_op.cc)."""
+    helper = LayerHelper("reorder_lod_tensor_by_rank")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "reorder_lod_tensor_by_rank",
+        inputs={"X": [x], "RankTable": [rank_table]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+__all__ += ["Print", "is_empty", "reorder_lod_tensor_by_rank"]

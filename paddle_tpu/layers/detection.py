@@ -1,8 +1,7 @@
-"""Detection layers (layers/detection.py analog) — SSD/RCNN helpers.
-
-Round-1 subset: prior_box, box_coder, iou. NMS-family ops are
-dynamic-shape-heavy and pending a TPU-friendly (padded top-k) design.
-"""
+"""Detection layers (layers/detection.py analog) — the SSD and RCNN
+helper surface: priors (incl. density), box codecs, NMS/matching in the
+padded static-shape form, proposal generation/labeling, roi pooling,
+losses, mAP, and the multi_box_head composition."""
 
 import numpy as np
 import jax.numpy as jnp
@@ -583,3 +582,120 @@ def roi_perspective_transform(
         },
     )
     return out
+
+
+def density_prior_box(
+    input, image, densities=None, fixed_sizes=None, fixed_ratios=None,
+    variance=[0.1, 0.1, 0.2, 0.2], clip=False, steps=[0.0, 0.0], offset=0.5,
+    name=None,
+):
+    """density_prior_box_op.cc: dense multi-scale prior grid per cell."""
+    helper = LayerHelper("density_prior_box", name=name)
+    boxes = helper.create_variable_for_type_inference("float32")
+    variances = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        "density_prior_box",
+        inputs={"Input": [input], "Image": [image]},
+        outputs={"Boxes": [boxes], "Variances": [variances]},
+        attrs={
+            "densities": list(densities or []),
+            "fixed_sizes": list(fixed_sizes or []),
+            "fixed_ratios": list(fixed_ratios or []),
+            "variances": list(variance),
+            "clip": clip,
+            "step_w": steps[0],
+            "step_h": steps[1],
+            "offset": offset,
+        },
+    )
+    return boxes, variances
+
+
+def polygon_box_transform(input, name=None):
+    """polygon_box_transform_op.cc (EAST-style geometry decode)."""
+    helper = LayerHelper("polygon_box_transform", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "polygon_box_transform", inputs={"Input": [input]},
+        outputs={"Output": [out]},
+    )
+    return out
+
+
+def detection_map(detect_res, label, overlap_threshold=0.5, name=None):
+    """detection_map_op.cc: single-batch mAP (host-callback evaluator).
+    detect_res: [N, 6] (label, score, box); label: [G, 5] (label, box)."""
+    helper = LayerHelper("detection_map", name=name)
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        "detection_map",
+        inputs={"DetectRes": [detect_res], "Label": [label]},
+        outputs={"MAP": [out]},
+        attrs={"overlap_threshold": float(overlap_threshold)},
+    )
+    return out
+
+
+def multi_box_head(
+    inputs, image, base_size, num_classes, aspect_ratios, min_ratio=None,
+    max_ratio=None, min_sizes=None, max_sizes=None, flip=True, clip=False,
+    name=None,
+):
+    """SSD detection head (the reference's multi_box_head composition):
+    per feature map, a 3x3 conv predicts per-prior box offsets and class
+    scores; priors come from prior_box on the same map.  Returns
+    (mbox_locs [B, P, 4], mbox_confs [B, P, C], boxes [P, 4],
+    variances [P, 4])."""
+    from . import nn as _nn
+
+    if min_sizes is None:
+        # the reference's ratio schedule between min_ratio and max_ratio
+        n = len(inputs)
+        min_sizes, max_sizes = [], []
+        step = int((max_ratio - min_ratio) / max(n - 2, 1))
+        for ratio in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.1] + min_sizes[: n - 1]
+        max_sizes = [base_size * 0.2] + max_sizes[: n - 1]
+
+    locs, confs, all_boxes, all_vars = [], [], [], []
+    for i, feat in enumerate(inputs):
+        ar = aspect_ratios[i] if isinstance(aspect_ratios[0], (list, tuple)) else aspect_ratios
+        mins = min_sizes[i] if isinstance(min_sizes, (list, tuple)) else min_sizes
+        maxs = max_sizes[i] if isinstance(max_sizes, (list, tuple)) else max_sizes
+        mins = [mins] if not isinstance(mins, (list, tuple)) else list(mins)
+        maxs = [maxs] if not isinstance(maxs, (list, tuple)) else list(maxs)
+        boxes, variances = prior_box(
+            feat, image, mins, maxs, list(ar), flip=flip, clip=clip
+        )
+        # priors per cell = boxes.shape[2] after [H, W, P, 4]
+        num_priors = int(boxes.shape[2])
+        loc = _nn.conv2d(feat, num_priors * 4, 3, padding=1)
+        conf = _nn.conv2d(feat, num_priors * num_classes, 3, padding=1)
+        # reshape dim 0 = 0 keeps the (dynamic) batch dim as-is
+        loc = _nn.transpose(loc, [0, 2, 3, 1])
+        loc = _nn.reshape(loc, [0, -1, 4])
+        conf = _nn.transpose(conf, [0, 2, 3, 1])
+        conf = _nn.reshape(conf, [0, -1, num_classes])
+        locs.append(loc)
+        confs.append(conf)
+        all_boxes.append(_nn.reshape(boxes, [-1, 4]))
+        all_vars.append(_nn.reshape(variances, [-1, 4]))
+
+    from .tensor import concat
+
+    return (
+        concat(locs, axis=1),
+        concat(confs, axis=1),
+        concat(all_boxes, axis=0),
+        concat(all_vars, axis=0),
+    )
+
+
+__all__ += [
+    "density_prior_box",
+    "polygon_box_transform",
+    "detection_map",
+    "multi_box_head",
+]

@@ -131,3 +131,186 @@ def double_buffer(reader, place=None, name=None):
     """Compat pass-through: device double-buffering is built into the
     py_reader pipeline (stager thread prefetches to device)."""
     return reader
+
+
+def batch(reader, batch_size):
+    """layers/io.py batch: alias of the reader-decorator batcher (the
+    in-program reader variant batches at the py_reader boundary)."""
+    from ..reader.decorator import batch as _batch
+
+    return _batch(reader, batch_size)
+
+
+def shuffle(reader, buffer_size):
+    """layers/io.py shuffle: alias of the reader-decorator shuffler."""
+    from ..reader.decorator import shuffle as _shuffle
+
+    return _shuffle(reader, buffer_size)
+
+
+def load(out, file_path, load_as_fp16=False):
+    """load_op: read a saved variable into `out` at execution time."""
+    from ..layer_helper import LayerHelper
+
+    helper = LayerHelper("load")
+    helper.append_op(
+        "load", inputs={}, outputs={"Out": [out]},
+        attrs={"file_path": file_path},
+    )
+    return out
+
+
+__all__ += ["batch", "shuffle", "load"]
+
+
+def create_py_reader_by_data(capacity, feed_list, name=None,
+                             use_double_buffer=True):
+    """py_reader variant shaped by existing data vars (io.py
+    create_py_reader_by_data): shapes/dtypes come from feed_list."""
+    return py_reader(
+        capacity,
+        [list(v.shape) for v in feed_list],
+        [v.dtype for v in feed_list],
+        name=name,
+        use_double_buffer=use_double_buffer,
+    )
+
+
+def random_data_generator(low, high, shapes, lod_levels=None, for_parallel=True):
+    """random_data_generator_op analog: an in-program reader whose batches
+    are uniform noise in [low, high) — the reference's synthetic-input
+    benchmark path."""
+    import numpy as np
+
+    reader = py_reader(
+        capacity=8,
+        shapes=shapes,
+        dtypes=["float32"] * len(shapes),
+        name=unique_name.generate("random_data_reader"),
+    )
+
+    def gen():
+        rng = np.random.RandomState(0)
+        while True:
+            yield tuple(
+                (rng.rand(*[abs(int(s)) for s in shape]) * (high - low) + low)
+                .astype("float32")
+                for shape in shapes
+            )
+
+    reader.decorate_batch_generator(gen)
+    return reader
+
+
+def open_files(filenames, shapes, dtypes, lod_levels=None, pass_num=1,
+               thread_num=None, buffer_size=None, name=None):
+    """open_files_op + recordio reader analog: an in-program reader fed by
+    the native RecordIO scanner over `filenames` (each record a pickled
+    tuple of arrays, as written by recordio_writer helpers)."""
+    import pickle
+
+    from .. import recordio as _recordio
+
+    reader = py_reader(
+        capacity=buffer_size or 64, shapes=shapes, dtypes=dtypes, name=name
+    )
+
+    def gen():
+        for _ in range(pass_num):
+            for fn in filenames:
+                for rec in _recordio.Scanner(fn):
+                    yield pickle.loads(rec)
+
+    reader.decorate_batch_generator(gen)
+    return reader
+
+
+class Preprocessor:
+    """layers/io.py Preprocessor analog: a host-side transform stage on a
+    reader's batches (the reference builds a sub-block of ops; here the
+    transform is a python callable applied in the feeder thread — same
+    contract: reader in, transformed reader out).
+
+        p = Preprocessor(reader)
+        with p.block():
+            p.set_transform(lambda img, lbl: ((img - 0.5) / 0.5, lbl))
+    """
+
+    def __init__(self, reader, name=None):
+        self.reader = reader
+        self._fn = None
+
+    class _Block:
+        def __init__(self, outer):
+            self.outer = outer
+
+        def __enter__(self):
+            return self.outer
+
+        def __exit__(self, *exc):
+            return False
+
+    def block(self):
+        return Preprocessor._Block(self)
+
+    def set_transform(self, fn):
+        import numpy as np
+
+        self._fn = fn
+
+        def wrap_batch(gen):
+            def wrapped():
+                for batch in gen():
+                    vals = (
+                        tuple(batch.values())
+                        if isinstance(batch, dict)
+                        else batch if isinstance(batch, (tuple, list))
+                        else (batch,)
+                    )
+                    out = fn(*vals)
+                    yield out if isinstance(out, (tuple, list)) else (out,)
+
+            return wrapped
+
+        def wrap_rows(gen):
+            # rows-style generators (decorate_paddle_reader) yield lists
+            # of per-sample tuples: columnize so fn sees batched tensors
+            # (the reference Preprocessor's contract), then emit
+            # batch-style columns
+            def wrapped():
+                for rows in gen():
+                    cols = tuple(
+                        np.stack([np.asarray(r[i]) for r in rows])
+                        for i in range(len(rows[0]))
+                    )
+                    out = fn(*cols)
+                    yield out if isinstance(out, (tuple, list)) else (out,)
+
+            return wrapped
+
+        def rewrap(kind, gen):
+            return ("batch", wrap_rows(gen) if kind == "rows" else wrap_batch(gen))
+
+        # wrap the generator already installed on the reader's runtime
+        # state (PyReaderHandle proxies to ProgramReader), and keep
+        # wrapping anything installed later through EITHER decorator
+        state = getattr(self.reader, "_state", self.reader)
+        kind_gen = getattr(state, "_gen", None)
+        if kind_gen is not None:
+            state._gen = rewrap(*kind_gen)
+
+        def set_batch(gen):
+            state._gen = rewrap("batch", gen)
+
+        def set_rows(gen):
+            state._gen = rewrap("rows", gen)
+
+        self.reader.decorate_batch_generator = set_batch
+        self.reader.decorate_tensor_provider = set_batch
+        self.reader.decorate_paddle_reader = set_rows
+        self.reader.decorate_sample_list_generator = set_rows
+        return self.reader
+
+
+__all__ += ["create_py_reader_by_data", "random_data_generator",
+            "open_files", "Preprocessor"]

@@ -44,8 +44,6 @@ __all__ = [
     "pool3d",
     "random_crop",
     "rank_loss",
-    "roi_align",
-    "roi_pool",
     "row_conv",
     "sequence_enumerate",
     "sequence_expand_as",
@@ -229,11 +227,17 @@ def ctc_greedy_decoder(input, blank, input_length=None, name=None):
 
 def dice_loss(input, label, epsilon=1e-5):
     """Reference composition: mean over the batch of
-    1 - 2|X∩Y| / (|X|+|Y|+eps) — a scalar loss fit for minimize()."""
+    1 - 2|X∩Y| / (|X|+|Y|+eps), the sums taken over ALL non-batch dims
+    (one ratio per sample — mean-of-per-pixel-ratios would diverge for
+    segmentation inputs).  Scalar loss fit for minimize()."""
     label = _nn.one_hot(label, int(input.shape[-1]))
-    intersect = _nn.reduce_sum(_nn.elementwise_mul(input, label), dim=-1)
+    reduce_dims = list(range(1, len(input.shape)))
+    intersect = _nn.reduce_sum(
+        _nn.elementwise_mul(input, label), dim=reduce_dims
+    )
     denom = _nn.elementwise_add(
-        _nn.reduce_sum(input, dim=-1), _nn.reduce_sum(label, dim=-1)
+        _nn.reduce_sum(input, dim=reduce_dims),
+        _nn.reduce_sum(label, dim=reduce_dims),
     )
     num = _nn.scale(intersect, scale=2.0)
     den = _nn.scale(denom, scale=1.0, bias=float(epsilon))
@@ -418,6 +422,10 @@ def _logical(op_type, x, y=None, out=None, name=None):
     inputs = {"X": [x]}
     if y is not None:
         inputs["Y"] = [y]
+    if out is not None:
+        helper = LayerHelper(op_type, name=name)
+        helper.append_op(op_type, inputs=inputs, outputs={"Out": [out]})
+        return out
     return _simple(op_type, inputs, dtype="bool")
 
 
@@ -557,34 +565,6 @@ def random_crop(x, shape, seed=None):
 def rank_loss(label, left, right, name=None):
     return _simple("rank_loss",
                    {"Label": [label], "Left": [left], "Right": [right]})
-
-
-def _roi(op_type, input, rois, pooled_height, pooled_width, spatial_scale,
-         rois_batch=None, extra_attrs=None, n_out=1, out_slots=None):
-    inputs = {"X": [input], "ROIs": [rois]}
-    if rois_batch is not None:
-        inputs["RoisBatch"] = [rois_batch]
-    attrs = {"pooled_height": int(pooled_height),
-             "pooled_width": int(pooled_width),
-             "spatial_scale": float(spatial_scale)}
-    attrs.update(extra_attrs or {})
-    return _simple(op_type, inputs, n_out=n_out, attrs=attrs,
-                   out_slots=out_slots)
-
-
-def roi_pool(input, rois, pooled_height=1, pooled_width=1,
-             spatial_scale=1.0, rois_batch=None):
-    out = _roi("roi_pool", input, rois, pooled_height, pooled_width,
-               spatial_scale, rois_batch, n_out=2,
-               out_slots=["Out", "Argmax"])
-    return out[0]
-
-
-def roi_align(input, rois, pooled_height=1, pooled_width=1,
-              spatial_scale=1.0, sampling_ratio=-1, rois_batch=None):
-    return _roi("roi_align", input, rois, pooled_height, pooled_width,
-                spatial_scale, rois_batch,
-                extra_attrs={"sampling_ratio": int(sampling_ratio)})
 
 
 def row_conv(input, future_context_size, param_attr=None, act=None):

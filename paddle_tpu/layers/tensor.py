@@ -253,3 +253,36 @@ def eye(num_rows, num_columns=None, dtype="float32"):
         },
     )
     return out
+
+
+def _overflow_check(op_type):
+    """isfinite_op.cc OverflowOp family: one [1]-bool reduction per op."""
+
+    def layer(x):
+        helper = LayerHelper(op_type)
+        out = helper.create_variable_for_type_inference("bool")
+        helper.append_op(op_type, inputs={"X": [x]}, outputs={"Out": [out]})
+        return out
+
+    layer.__name__ = op_type
+    return layer
+
+
+has_inf = _overflow_check("has_inf")
+has_nan = _overflow_check("has_nan")
+isfinite = _overflow_check("isfinite")
+
+
+def tensor_array_to_tensor(input, axis=1, use_stack=False, name=None):
+    """Stack/concat a TensorArray's written prefix (tensor_array_to_tensor_op)."""
+    helper = LayerHelper("tensor_array_to_tensor", name=name)
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        "tensor_array_to_tensor", inputs={"X": [input]},
+        outputs={"Out": [out]},
+        attrs={"axis": int(axis), "use_stack": bool(use_stack)},
+    )
+    return out
+
+
+__all__ += ["has_inf", "has_nan", "isfinite", "tensor_array_to_tensor"]

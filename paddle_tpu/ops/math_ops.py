@@ -184,6 +184,23 @@ def _isfinite(ctx, ins, attrs):
     return {"Out": [ok]}
 
 
+@register("has_inf", no_grad_inputs=("X",))
+def _has_inf(ctx, ins, attrs):
+    """isfinite_op.cc OverflowOp family: any(isinf) over all inputs."""
+    bad = jnp.array(False)
+    for x in ins["X"]:
+        bad = jnp.logical_or(bad, jnp.any(jnp.isinf(x)))
+    return {"Out": [bad]}
+
+
+@register("has_nan", no_grad_inputs=("X",))
+def _has_nan(ctx, ins, attrs):
+    bad = jnp.array(False)
+    for x in ins["X"]:
+        bad = jnp.logical_or(bad, jnp.any(jnp.isnan(x)))
+    return {"Out": [bad]}
+
+
 # ---------------------------------------------------------------------------
 # matmul family (operators/mul_op.cc, matmul_op.cc)
 # ---------------------------------------------------------------------------

@@ -361,7 +361,7 @@ def _increment(ctx, ins, attrs):
     return {"Out": [x + jnp.asarray(attrs.get("step", 1.0)).astype(x.dtype)]}
 
 
-@register("print", no_grad_inputs=("In",))
+@register("print", no_grad_inputs=("In",), side_effect=True)
 def _print(ctx, ins, attrs):
     x = ins["In"][0]
     jax.debug.print(attrs.get("message", "") + " {}", x)

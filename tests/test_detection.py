@@ -215,3 +215,34 @@ def test_detection_output_end_to_end():
     (r,) = _run({"pb": prior, "pv": pvar, "lv": loc, "sv": scores}, [out])
     assert r.shape[-1] == 6
     assert np.isfinite(r).all()
+
+
+def test_multi_box_head_ssd_composition():
+    """multi_box_head over two feature maps with a dynamic batch: aligned
+    loc/conf/prior counts, run end-to-end."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.framework.program_guard(main, startup):
+        img = layers.data("mb_img", shape=[3, 32, 32])
+        f1 = layers.conv2d(img, 8, 3, stride=2, padding=1)
+        f2 = layers.conv2d(f1, 8, 3, stride=2, padding=1)
+        locs, confs, boxes, vars_ = layers.multi_box_head(
+            [f1, f2], img, base_size=32, num_classes=4,
+            aspect_ratios=[[2.0], [2.0]], min_ratio=20, max_ratio=90,
+        )
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        lv, cv, bv, vv = exe.run(
+            main, feed={"mb_img": rng.rand(2, 3, 32, 32).astype("float32")},
+            fetch_list=[locs, confs, boxes, vars_],
+        )
+    lv, cv, bv, vv = map(np.asarray, (lv, cv, bv, vv))
+    assert lv.shape[0] == 2 and cv.shape[0] == 2
+    assert lv.shape[1] == cv.shape[1] == bv.shape[0] == vv.shape[0]
+    assert lv.shape[2] == 4 and cv.shape[2] == 4  # 4 coords / 4 classes
+    assert np.isfinite(lv).all() and np.isfinite(bv).all()

@@ -270,3 +270,121 @@ def test_py_reader_pipeline_error_surfaces():
     with pytest.raises(RuntimeError, match="pipeline failed"):
         while True:
             exe.run(fetch_list=[out])
+
+
+def test_io_reader_surface_parity(tmp_path):
+    """create_py_reader_by_data / random_data_generator / open_files /
+    Preprocessor complete the layers.io surface; each feeds a program."""
+    import pickle
+
+    import paddle_tpu as fluid
+    from paddle_tpu import layers, recordio
+
+    # open_files over a native recordio file of pickled (x, y) tuples
+    path = str(tmp_path / "data.recordio")
+    w = recordio.Writer(path)
+    rng = np.random.RandomState(0)
+    for i in range(3):
+        w.write(pickle.dumps(
+            (rng.rand(4, 6).astype("float32"),
+             rng.randint(0, 3, (4, 1)).astype("int64"))))
+    w.close()
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.framework.program_guard(main, startup):
+        reader = layers.open_files(
+            [path], shapes=[[-1, 6], [-1, 1]], dtypes=["float32", "int64"])
+        x, y = layers.read_file(reader)
+        out = layers.reduce_sum(x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        reader.start()
+        seen = 0
+        while True:
+            try:
+                exe.run(main, fetch_list=[out])
+                seen += 1
+            except Exception:
+                break
+        assert seen == 3, seen
+
+    # random_data_generator + Preprocessor (transform visible in outputs)
+    main2 = fluid.Program()
+    startup2 = fluid.Program()
+    with fluid.framework.program_guard(main2, startup2):
+        r2 = layers.random_data_generator(0.0, 1.0, shapes=[[-1, 4]])
+        p = layers.Preprocessor(r2)
+        with p.block():
+            p.set_transform(lambda a: a + 100.0)
+        xv = layers.read_file(r2)
+        m = layers.reduce_min(xv)
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe2.run(startup2)
+        r2.start()
+        (mn,) = exe2.run(main2, fetch_list=[m])
+        assert float(np.asarray(mn)) >= 100.0  # transform applied
+        r2.reset()
+
+    # create_py_reader_by_data mirrors data-var shapes
+    main3 = fluid.Program()
+    startup3 = fluid.Program()
+    with fluid.framework.program_guard(main3, startup3):
+        dx = layers.data("cprd_x", shape=[5])
+        r3 = layers.create_py_reader_by_data(8, [dx])
+        x3 = layers.read_file(r3)
+        assert tuple(x3.shape[1:]) == (5,)
+
+
+def test_preprocessor_rows_reader_path():
+    """Preprocessor also transforms decorate_paddle_reader (rows-style)
+    inputs — columnized before fn, never silently dropped."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.framework.program_guard(main, startup):
+        r = layers.py_reader(capacity=4, shapes=[[-1, 3]], dtypes=["float32"])
+        p = layers.Preprocessor(r)
+        with p.block():
+            p.set_transform(lambda a: a + 100.0)
+        xv = layers.read_file(r)
+        m = layers.reduce_min(xv)
+
+    def rows():
+        rng = np.random.RandomState(0)
+        for _ in range(2):
+            yield [(rng.rand(3).astype("float32"),) for _ in range(4)]
+
+    r.decorate_paddle_reader(rows)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        r.start()
+        (mn,) = exe.run(main, fetch_list=[m])
+        assert float(np.asarray(mn)) >= 100.0
+        r.reset()
+
+
+def test_print_layer_survives_dce(capfd):
+    """layers.Print with a discarded return still prints (print op is a
+    side effect, never pruned)."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.framework.program_guard(main, startup):
+        x = layers.data("pr_x", shape=[2])
+        layers.Print(x, message="PRINTME")
+        out = layers.reduce_sum(x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        exe.run(main, feed={"pr_x": np.ones((1, 2), "float32")},
+                fetch_list=[out])
+    captured = capfd.readouterr()
+    assert "PRINTME" in captured.out + captured.err
