@@ -652,7 +652,7 @@ def multi_box_head(
         # the reference's ratio schedule between min_ratio and max_ratio
         n = len(inputs)
         min_sizes, max_sizes = [], []
-        step = int((max_ratio - min_ratio) / max(n - 2, 1))
+        step = max(int((max_ratio - min_ratio) / max(n - 2, 1)), 1)
         for ratio in range(min_ratio, max_ratio + 1, step):
             min_sizes.append(base_size * ratio / 100.0)
             max_sizes.append(base_size * (ratio + step) / 100.0)
@@ -661,7 +661,11 @@ def multi_box_head(
 
     locs, confs, all_boxes, all_vars = [], [], [], []
     for i, feat in enumerate(inputs):
-        ar = aspect_ratios[i] if isinstance(aspect_ratios[0], (list, tuple)) else aspect_ratios
+        # the reference indexes aspect_ratios PER LAYER — a flat list means
+        # one ratio per feature map, never "all ratios everywhere"
+        ar = aspect_ratios[i]
+        if not isinstance(ar, (list, tuple)):
+            ar = [ar]
         mins = min_sizes[i] if isinstance(min_sizes, (list, tuple)) else min_sizes
         maxs = max_sizes[i] if isinstance(max_sizes, (list, tuple)) else max_sizes
         mins = [mins] if not isinstance(mins, (list, tuple)) else list(mins)

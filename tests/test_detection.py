@@ -231,7 +231,8 @@ def test_multi_box_head_ssd_composition():
         f2 = layers.conv2d(f1, 8, 3, stride=2, padding=1)
         locs, confs, boxes, vars_ = layers.multi_box_head(
             [f1, f2], img, base_size=32, num_classes=4,
-            aspect_ratios=[[2.0], [2.0]], min_ratio=20, max_ratio=90,
+            aspect_ratios=[2.0, 3.0],  # flat list = one ratio PER LAYER
+            min_ratio=20, max_ratio=90,
         )
     exe = fluid.Executor(fluid.CPUPlace())
     with fluid.scope_guard(fluid.Scope()):

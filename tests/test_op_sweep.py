@@ -946,7 +946,7 @@ adamax adadelta decayed_adagrad rmsprop ftrl lars_momentum
 
 
 def test_sweep_coverage_target():
-    """>= 200 registered ops have direct test coverage (VERDICT item 4).
+    """>= 300 registered ops have direct test coverage (VERDICT item 4).
 
     Order-independent: op names are read statically from this module's
     check()/probe() call sites plus the family tables, so the floor holds
@@ -965,7 +965,7 @@ def test_sweep_coverage_target():
     )
     direct = (set(COVERED) | called | table_ops | set(COVERED_ELSEWHERE)) & set(OPS)
     missing = sorted(set(OPS) - direct)
-    assert len(direct) >= 200, (
+    assert len(direct) >= 300, (
         "only %d ops directly tested; missing e.g. %s"
         % (len(direct), missing[:30])
     )
@@ -1162,6 +1162,37 @@ def test_split_merge_ids_roundtrip():
     )
     np.testing.assert_allclose(merged, table[ids], rtol=1e-6)
     COVERED.add("merge_ids")
+
+
+def test_overflow_checks_and_remaining_delegates():
+    """has_inf/has_nan and the delegate compat ops get direct probes (no
+    coverage-by-claim: every name in the floor count has a real test)."""
+    x = np.array([[1.0, 2.0], [3.0, 4.0]], "float32")
+    (hi,) = probe("has_inf", {"X": x}, {}, ["Out"])
+    (hn,) = probe("has_nan", {"X": x}, {}, ["Out"])
+    assert not bool(hi) and not bool(hn)
+    (hi2,) = probe("has_inf", {"X": np.array([np.inf], "float32")}, {}, ["Out"])
+    (hn2,) = probe("has_nan", {"X": np.array([np.nan], "float32")}, {}, ["Out"])
+    assert bool(hi2) and bool(hn2)
+
+    # depthwise transpose == conv2d_transpose with groups=C
+    xdw = _r(1, 2, 3, 3, seed=141)
+    wdw = _r(2, 1, 2, 2, seed=142)
+    (dt,) = probe(
+        "depthwise_conv2d_transpose", {"Input": xdw, "Filter": wdw},
+        {"strides": [1, 1], "paddings": [0, 0]}, ["Output"],
+    )
+    (ref_dt,) = probe(
+        "conv2d_transpose", {"Input": xdw, "Filter": wdw},
+        {"strides": [1, 1], "paddings": [0, 0], "groups": 2}, ["Output"],
+    )
+    np.testing.assert_allclose(dt, ref_dt, rtol=1e-5)
+
+    table = _r(10, 4, seed=143)
+    ids = np.array([[1], [7]], "int64")
+    (lst,) = probe("lookup_sparse_table", {"W": table, "Ids": ids}, {}, ["Out"])
+    np.testing.assert_allclose(np.asarray(lst).reshape(2, 4), table[[1, 7]],
+                               rtol=1e-6)
 
 
 def test_tensor_array_to_tensor_masks_unwritten():
