@@ -248,15 +248,75 @@ def _run_child(env, timeout):
     return False, None, (out + "\n" + err)[-4000:]
 
 
+def _probe_impl():
+    import jax
+
+    print("PROBE_DEVICES %s" % jax.devices())
+
+
+def _tpu_reachable(timeout):
+    """Cheap pre-flight: a group-killable child that only initializes the
+    backend.  A wedged tunnel hangs jax.devices() for the FULL bench
+    timeout otherwise (observed: chip claimed by a killed process stays
+    stuck for hours) — this caps the cost of a dead chip at `timeout`."""
+    env = dict(os.environ)
+    env["_BENCH_PROBE"] = "1"
+    import signal
+
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        start_new_session=True,
+    )
+
+    def kill_group():
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+        return proc.returncode == 0 and b"PROBE_DEVICES" in out
+    except subprocess.TimeoutExpired:
+        kill_group()
+        proc.communicate()
+        return False
+    except BaseException:  # never orphan a child holding the chip
+        kill_group()
+        raise
+
+
 def main():
+    if os.environ.get("_BENCH_PROBE") == "1":
+        return _probe_impl()
     if os.environ.get("_BENCH_CHILD") == "1":
         return _bench_impl()
+
+    # 0) pre-flight: skip the expensive TPU attempt entirely when the
+    # tunnel cannot even enumerate devices — probed up to BENCH_TPU_ATTEMPTS
+    # times so the flaky-chip retry knob keeps its meaning
+    probe_timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT", "180"))
+    attempts = int(os.environ.get("BENCH_TPU_ATTEMPTS", "1"))
+    if probe_timeout > 0:
+        for i in range(attempts):
+            if _tpu_reachable(probe_timeout):
+                break
+            sys.stderr.write(
+                "bench: TPU probe %d/%d failed (%ss)\n"
+                % (i + 1, attempts, probe_timeout)
+            )
+        else:
+            sys.stderr.write(
+                "bench: TPU backend unreachable — going straight to the "
+                "CPU fallback\n"
+            )
+            attempts = 0
 
     # 1) TPU attempt(s): one by default — a down tunnel hangs the full
     # child timeout, and the CPU fallback must still land within the
     # driver's budget (raise BENCH_TPU_ATTEMPTS when the chip is flaky
     # rather than absent).
-    attempts = int(os.environ.get("BENCH_TPU_ATTEMPTS", "1"))
     tpu_timeout = int(os.environ.get("BENCH_TPU_TIMEOUT", "1500"))
     for i in range(attempts):
         ok, line, log = _run_child(os.environ, timeout=tpu_timeout)

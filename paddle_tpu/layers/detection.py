@@ -656,6 +656,11 @@ def multi_box_head(
         for ratio in range(min_ratio, max_ratio + 1, step):
             min_sizes.append(base_size * ratio / 100.0)
             max_sizes.append(base_size * (ratio + step) / 100.0)
+        # narrow ranges can yield fewer entries than layers: extend with
+        # the last size so every feature map gets a schedule entry
+        while len(min_sizes) < n - 1:
+            min_sizes.append(min_sizes[-1])
+            max_sizes.append(max_sizes[-1])
         min_sizes = [base_size * 0.1] + min_sizes[: n - 1]
         max_sizes = [base_size * 0.2] + max_sizes[: n - 1]
 

@@ -247,3 +247,24 @@ def test_multi_box_head_ssd_composition():
     assert lv.shape[1] == cv.shape[1] == bv.shape[0] == vv.shape[0]
     assert lv.shape[2] == 4 and cv.shape[2] == 4  # 4 coords / 4 classes
     assert np.isfinite(lv).all() and np.isfinite(bv).all()
+
+
+def test_multi_box_head_narrow_ratio_range():
+    """A ratio range narrower than the layer count pads the schedule
+    instead of crashing (6 maps, 2-point range)."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.framework.program_guard(main, startup):
+        img = layers.data("nr_img", shape=[3, 32, 32])
+        feats, f = [], img
+        for _ in range(6):
+            f = layers.conv2d(f, 4, 3, stride=1, padding=1)
+            feats.append(f)
+        locs, confs, boxes, vars_ = layers.multi_box_head(
+            feats, img, base_size=32, num_classes=3,
+            aspect_ratios=[2.0] * 6, min_ratio=20, max_ratio=22,
+        )
+    assert locs is not None and boxes is not None
