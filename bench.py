@@ -280,7 +280,12 @@ def _tpu_reachable(timeout):
         return proc.returncode == 0 and b"PROBE_DEVICES" in out
     except subprocess.TimeoutExpired:
         kill_group()
-        proc.communicate()
+        try:
+            # a child stuck in an uninterruptible driver call can survive
+            # SIGKILL for a while — never let the reap block the driver
+            proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
         return False
     except BaseException:  # never orphan a child holding the chip
         kill_group()

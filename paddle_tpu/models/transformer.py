@@ -21,7 +21,13 @@ def _pa(base):
     weights by regex (the GSPMD analog of the transpiler's param slicing)."""
     return ParamAttr(name=unique_name.generate(base))
 
-__all__ = ["ModelHyperParams", "transformer", "wmt_transformer_program"]
+__all__ = [
+    "ModelHyperParams",
+    "transformer",
+    "wmt_transformer_program",
+    "transformer_logits_program",
+    "greedy_translate",
+]
 
 
 class ModelHyperParams:
@@ -243,7 +249,7 @@ def wmt_transformer_program(hp=ModelHyperParams, src_len=64, trg_len=64, learnin
 
     main = fluid.Program()
     startup = fluid.Program()
-    with fluid.program_guard(main, startup):
+    with fluid.program_guard(main, startup), unique_name.guard():
         src = layers.data("src_word", shape=[src_len], dtype="int64")
         trg = layers.data("trg_word", shape=[trg_len], dtype="int64")
         lbl = layers.data("lbl_word", shape=[trg_len], dtype="int64")
@@ -284,6 +290,25 @@ def wmt_transformer_program(hp=ModelHyperParams, src_len=64, trg_len=64, learnin
     return main, startup, feeds, [avg_cost, token_count]
 
 
+NEG_BIAS = -1e9  # the shared "masked" sentinel across train/infer masks
+
+
+def pad_bias(lens, max_len):
+    """[B] lengths -> [B, 1, 1, max_len] additive key-padding bias."""
+    lens = np.asarray(lens).reshape(-1)
+    pad = np.arange(max_len)[None, :] >= lens[:, None]
+    return np.where(pad, NEG_BIAS, 0.0).astype("float32")[:, None, None, :]
+
+
+def causal_plus_pad_bias(lens, max_len):
+    """[B] lengths -> [B, 1, T, T] causal + key-padding decoder bias."""
+    lens = np.asarray(lens).reshape(-1)
+    causal = np.triu(np.ones((max_len, max_len)), k=1) * NEG_BIAS
+    pad = np.arange(max_len)[None, :] >= lens[:, None]
+    bias = np.where(pad[:, None, :], NEG_BIAS, 0.0) + causal[None, :, :]
+    return bias[:, None, :, :].astype("float32")
+
+
 def make_fake_batch(batch_size, src_len, trg_len, hp=ModelHyperParams, seed=0):
     """Synthetic padded batch + masks (host-side; analog of the data reader)."""
     rng = np.random.RandomState(seed)
@@ -292,18 +317,11 @@ def make_fake_batch(batch_size, src_len, trg_len, hp=ModelHyperParams, seed=0):
     lbl = rng.randint(1, hp.trg_vocab_size, (batch_size, trg_len)).astype("int64")
     src_lens = rng.randint(src_len // 2, src_len + 1, (batch_size,))
     trg_lens = rng.randint(trg_len // 2, trg_len + 1, (batch_size,))
-    neg = -1e9
 
-    src_pad = (np.arange(src_len)[None, :] >= src_lens[:, None])
-    src_bias = np.where(src_pad, neg, 0.0).astype("float32")[:, None, None, :]
-
-    causal = np.triu(np.ones((trg_len, trg_len)), k=1) * neg
-    trg_pad = (np.arange(trg_len)[None, :] >= trg_lens[:, None])
-    trg_bias = np.where(trg_pad[:, None, :], neg, 0.0) + causal[None, :, :]
-    trg_bias = trg_bias[:, None, :, :].astype("float32")
-
-    cross_bias = np.where(src_pad, neg, 0.0).astype("float32")[:, None, None, :]
-    weights = (~trg_pad).astype("float32")
+    src_bias = pad_bias(src_lens, src_len)
+    trg_bias = causal_plus_pad_bias(trg_lens, trg_len)
+    cross_bias = pad_bias(src_lens, src_len)
+    weights = (np.arange(trg_len)[None, :] < trg_lens[:, None]).astype("float32")
     return {
         "src_word": src,
         "trg_word": trg,
@@ -313,3 +331,76 @@ def make_fake_batch(batch_size, src_len, trg_len, hp=ModelHyperParams, seed=0):
         "trg_src_attn_bias": cross_bias,
         "lbl_weight": weights,
     }
+
+
+def transformer_logits_program(hp=ModelHyperParams, src_len=64, trg_len=64):
+    """Inference program fetching [B, Tt, trg_vocab] logits — the
+    greedy/beam decode-step workhorse (static shapes, one compile).
+    Built under unique_name.guard() so it shares weights by name with a
+    wmt_transformer_program trained earlier in the same scope."""
+    import paddle_tpu as fluid
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup), unique_name.guard():
+        src = layers.data("src_word", shape=[src_len], dtype="int64")
+        trg = layers.data("trg_word", shape=[trg_len], dtype="int64")
+        src_bias = layers.data("src_slf_attn_bias", shape=[1, 1, src_len], dtype="float32")
+        trg_bias = layers.data("trg_slf_attn_bias", shape=[1, trg_len, trg_len], dtype="float32")
+        cross_bias = layers.data("trg_src_attn_bias", shape=[1, 1, src_len], dtype="float32")
+        trg_kpad = None
+        if getattr(hp, "fused_attn", False):
+            # the dense decoder bias's LAST causal row is pure key-padding
+            # (causal contributes 0 there): extract it as the rank-1 bias
+            # the fused path needs
+            last_row = layers.slice(
+                trg_bias, axes=[2], starts=[trg_len - 1], ends=[trg_len]
+            )
+            trg_kpad = layers.reshape(last_row, [-1, trg_len])
+            trg_kpad.stop_gradient = True
+        logits = transformer(src, trg, src_bias, trg_bias, cross_bias, hp,
+                             is_test=True, trg_kpad_bias=trg_kpad)
+    feeds = ["src_word", "trg_word", "src_slf_attn_bias",
+             "trg_slf_attn_bias", "trg_src_attn_bias"]
+    return main, startup, feeds, [logits]
+
+
+def greedy_translate(exe, main, fetches, src_ids, src_lens, bos_id, eos_id,
+                     max_out_len=None, pad_id=0):
+    """Greedy decoding on a fixed-shape logits program (the reference
+    transformer's inference role, TPU-style: static shapes, one compile;
+    causal masking hides the padded target tail each step).
+
+    src_ids [B, Ts] int64, src_lens [B] — returns [B, T_out] int64 rows
+    starting with bos_id; generation stops early once every row emitted
+    eos_id."""
+    blk = main.global_block()
+    src_len = int(blk.vars["src_word"].shape[1])
+    trg_len = int(blk.vars["trg_word"].shape[1])
+    max_out_len = min(max_out_len or trg_len, trg_len)
+    src_ids = np.asarray(src_ids, "int64")
+    b, p = src_ids.shape
+    assert p == src_len, "src must be padded to the program's %d" % src_len
+    src_lens = np.asarray(src_lens).reshape(-1)
+
+    src_bias = pad_bias(src_lens, src_len)
+    trg = np.full((b, trg_len), pad_id, "int64")
+    trg[:, 0] = bos_id
+    done = np.zeros(b, bool)
+    cur = 1
+    while cur < max_out_len and not done.all():
+        trg_bias = causal_plus_pad_bias(np.full(b, cur), trg_len)
+        feed = {
+            "src_word": src_ids,
+            "trg_word": trg,
+            "src_slf_attn_bias": src_bias,
+            "trg_slf_attn_bias": trg_bias,
+            "trg_src_attn_bias": src_bias,
+        }
+        (logits,) = exe.run(main, feed=feed, fetch_list=fetches)
+        nxt = np.asarray(logits)[:, cur - 1, :].argmax(axis=-1)
+        nxt = np.where(done, pad_id, nxt)
+        trg[:, cur] = nxt
+        done |= nxt == eos_id
+        cur += 1
+    return trg[:, :cur]
