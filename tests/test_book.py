@@ -18,6 +18,23 @@ def _exe():
     return exe
 
 
+def _save_and_check_parity(tmp_path, name, feed_name, xs, pred, exe,
+                           rtol=1e-4, atol=1e-5):
+    """Shared book-chapter epilogue: save_inference_model -> predictor ->
+    output parity against the for_test clone.  Returns the predictor."""
+    model_dir = str(tmp_path / name)
+    fluid.save_inference_model(model_dir, [feed_name], [pred], exe)
+    predictor = create_paddle_predictor(AnalysisConfig(model_dir))
+    (out,) = predictor.run({feed_name: xs})
+    (ref,) = exe.run(
+        program=fluid.default_main_program().clone(for_test=True),
+        feed={feed_name: xs},
+        fetch_list=[pred],
+    )
+    np.testing.assert_allclose(out, np.asarray(ref), rtol=rtol, atol=atol)
+    return predictor
+
+
 def test_fit_a_line_full_cycle(tmp_path):
     """book/test_fit_a_line: linear regression, save + predictor parity."""
     x = layers.data("x", shape=[13])
@@ -38,16 +55,7 @@ def test_fit_a_line_full_cycle(tmp_path):
     ]
     assert losses[-1] < losses[0] * 0.2
 
-    model_dir = str(tmp_path / "fit_a_line")
-    fluid.save_inference_model(model_dir, ["x"], [pred], exe)
-    predictor = create_paddle_predictor(AnalysisConfig(model_dir))
-    (out,) = predictor.run({"x": xv[:4]})
-    (ref,) = exe.run(
-        program=fluid.default_main_program().clone(for_test=True),
-        feed={"x": xv[:4]},
-        fetch_list=[pred],
-    )
-    np.testing.assert_allclose(out, np.asarray(ref), rtol=1e-4, atol=1e-5)
+    _save_and_check_parity(tmp_path, "fit_a_line", "x", xv[:4], pred, exe)
 
 
 def test_word2vec_trains():
@@ -438,3 +446,61 @@ def test_rnn_encoder_decoder_trains():
         for _ in range(8)
     ]
     assert losses[-1] < losses[0], losses
+
+
+def test_recognize_digits_full_cycle(tmp_path):
+    """book/test_recognize_digits: mnist CNN train on synthetic digits,
+    save_inference_model, predictor parity (the conv book chapter)."""
+    from paddle_tpu.dataset import mnist as mnist_ds
+    from paddle_tpu.models.mnist import cnn_model
+
+    img = layers.data("img", shape=[1, 28, 28])
+    label = layers.data("label", shape=[1], dtype="int64")
+    pred = cnn_model(img)
+    loss = layers.mean(layers.cross_entropy(pred, label))
+    acc = layers.accuracy(pred, label)
+    fluid.optimizer.Adam(0.01).minimize(loss)
+
+    exe = _exe()
+    from paddle_tpu import reader as rdr
+
+    accs = []
+    for i, rows in enumerate(rdr.batch(mnist_ds.train(), 32)()):
+        xs = np.stack([r[0] for r in rows]).reshape(-1, 1, 28, 28)
+        ys = np.array([[r[1]] for r in rows], "int64")
+        _, av = exe.run(feed={"img": xs, "label": ys}, fetch_list=[loss, acc])
+        accs.append(float(np.asarray(av)[0]))
+        if i >= 30:
+            break
+    assert np.mean(accs[-5:]) > 0.5, np.mean(accs[-5:])
+
+    _save_and_check_parity(tmp_path, "digits", "img", xs[:4], pred, exe,
+                           rtol=2e-4, atol=2e-5)
+
+
+def test_image_classification_full_cycle(tmp_path):
+    """book/test_image_classification: cifar-style resnet train step +
+    save/predict cycle (conv+bn folding exercised by the predictor)."""
+    from paddle_tpu.models.resnet import resnet_cifar10
+
+    img = layers.data("cimg", shape=[3, 32, 32])
+    label = layers.data("clabel", shape=[1], dtype="int64")
+    pred = resnet_cifar10(img, class_dim=10, depth=8)
+    loss = layers.mean(layers.cross_entropy(pred, label))
+    fluid.optimizer.Momentum(0.05, 0.9).minimize(loss)
+
+    rng = np.random.RandomState(0)
+    xv = rng.rand(8, 3, 32, 32).astype("float32")
+    yv = rng.randint(0, 10, (8, 1)).astype("int64")
+    exe = _exe()
+    losses = [
+        float(np.ravel(exe.run(feed={"cimg": xv, "clabel": yv},
+                               fetch_list=[loss])[0])[0])
+        for _ in range(5)
+    ]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+    predictor = _save_and_check_parity(tmp_path, "cifar", "cimg", xv[:2],
+                                       pred, exe, rtol=2e-3, atol=2e-4)
+    types = [op.type for op in predictor.program.global_block().ops]
+    assert "batch_norm" not in types  # conv+bn folded by the analysis pass
