@@ -11,6 +11,19 @@ op.  States are plain padded arrays [batch, beam, ...].
 import numpy as np
 
 
+def _beam_topk(total, beam):
+    """Shared beam-step selection: flatten [B, beam, V] candidate scores,
+    take the top `beam` per example, split back into (parent beam, token,
+    score) — the one top-k core behind both decoders."""
+    b, _, vocab = total.shape
+    flat = total.reshape(b, -1)
+    top_idx = np.argsort(-flat, axis=1)[:, :beam]
+    top_scores = np.take_along_axis(flat, top_idx, axis=1)
+    parent = (top_idx // vocab).astype(np.int32)
+    token = (top_idx % vocab).astype(np.int32)
+    return parent, token, top_scores
+
+
 class BeamSearchDecoder:
     """Drives a user step function through beam search.
 
@@ -45,11 +58,7 @@ class BeamSearchDecoder:
             frozen[:, :, self.end_token] = pre_scores
             total = np.where(finished[:, :, None], frozen, cont)
 
-            flat = total.reshape(batch_size, beam * vocab)
-            top_idx = np.argsort(-flat, axis=1)[:, :beam]
-            top_scores = np.take_along_axis(flat, top_idx, axis=1)
-            parent = (top_idx // vocab).astype(np.int32)
-            token = (top_idx % vocab).astype(np.int32)
+            parent, token, top_scores = _beam_topk(total, beam)
 
             ids_steps.append(token)
             parent_steps.append(parent)
@@ -86,3 +95,56 @@ def _reindex_states(states, parent, batch_size, beam):
     if isinstance(states, (list, tuple)):
         return type(states)(gather(v) for v in states)
     return gather(states)
+
+
+def full_sequence_beam_search(logits_fn, prompt_buf, prompt_len, beam_size,
+                              max_out_len, eos_id, pad_id=0,
+                              length_penalty=0.0):
+    """Beam search over a fixed-shape full-sequence logits program.
+
+    logits_fn(buf [R, T], cur) -> [R, vocab] next-token logits at position
+    cur-1 for every row (R = batch*beam; typically one Executor.run of a
+    gpt2_logits_program / transformer_logits_program).  prompt_buf [B, T]
+    holds the prompts left-aligned (padded with pad_id); decoding starts
+    at prompt_len.  Returns (ids [B, T_out], scores [B]) for the best beam
+    per example; finished beams (emitted eos_id) carry their score
+    unchanged, optionally normalized by length**length_penalty.
+    """
+    prompt_buf = np.asarray(prompt_buf)
+    b, t = prompt_buf.shape
+    limit = min(max_out_len, t)
+    buf = np.repeat(prompt_buf, beam_size, axis=0)  # [B*beam, T]
+    scores = np.full((b, beam_size), -1e9, np.float32)
+    scores[:, 0] = 0.0
+    finished = np.zeros((b, beam_size), bool)
+    lengths = np.full((b, beam_size), prompt_len, np.int64)
+    cur = prompt_len
+    while cur < limit and not finished.all():
+        logits = np.asarray(logits_fn(buf, cur), np.float32)
+        logp = logits - _logsumexp(logits)
+        v = logp.shape[-1]
+        logp = logp.reshape(b, beam_size, v)
+        # finished beams only "emit" pad at zero cost (score frozen)
+        fin = finished
+        logp[fin] = -1e9
+        logp[fin, pad_id] = 0.0
+        cand = scores[:, :, None] + logp  # [B, beam, V]
+        parent, tok, scores = _beam_topk(cand, beam_size)
+        rows = (np.arange(b)[:, None] * beam_size + parent).reshape(-1)
+        buf = buf[rows]
+        newly = tok == eos_id
+        was_fin = np.take_along_axis(finished, parent, axis=1)
+        buf[:, cur] = np.where(was_fin.reshape(-1), pad_id, tok.reshape(-1))
+        lengths = np.take_along_axis(lengths, parent, axis=1) + (~was_fin)
+        finished = was_fin | newly
+        cur += 1
+    if length_penalty:
+        scores = scores / (lengths.astype(np.float32) ** length_penalty)
+    best = np.argmax(scores, axis=1)
+    rows = np.arange(b) * beam_size + best
+    return buf[rows][:, :cur], scores[np.arange(b), best]
+
+
+def _logsumexp(x):
+    m = x.max(axis=-1, keepdims=True)
+    return m + np.log(np.exp(x - m).sum(axis=-1, keepdims=True))

@@ -23,6 +23,7 @@ __all__ = [
     "gpt2_lm_program",
     "gpt2_logits_program",
     "greedy_generate",
+    "beam_generate",
     "make_fake_lm_batch",
 ]
 
@@ -181,3 +182,28 @@ def greedy_generate(exe, main, fetches, prompt_ids, max_new_tokens,
         buf[:, cur] = nxt
         cur += 1
     return buf[:, :cur]
+
+
+def beam_generate(exe, main, fetches, prompt_ids, max_new_tokens,
+                  beam_size=4, eos_id=None, pad_id=0, length_penalty=0.0):
+    """Beam-search decoding on the same fixed-shape logits program as
+    greedy_generate.  Returns (ids [B, T_out], scores [B])."""
+    from ..contrib.decoder.beam_search_decoder import full_sequence_beam_search
+
+    ids_var = main.global_block().vars["ids"]
+    T = int(ids_var.shape[1])
+    prompt_ids = np.asarray(prompt_ids, "int64")
+    b, p = prompt_ids.shape
+    assert p >= 1, "empty prompt: seed generation with at least a BOS token"
+    assert p + max_new_tokens <= T
+    buf = np.full((b, T), pad_id, "int64")
+    buf[:, :p] = prompt_ids
+
+    def logits_fn(rows, cur):
+        (logits,) = exe.run(main, feed={"ids": rows}, fetch_list=fetches)
+        return np.asarray(logits)[:, cur - 1, :]
+
+    return full_sequence_beam_search(
+        logits_fn, buf, p, beam_size, p + max_new_tokens,
+        eos_id if eos_id is not None else -1, pad_id, length_penalty,
+    )

@@ -27,6 +27,7 @@ __all__ = [
     "wmt_transformer_program",
     "transformer_logits_program",
     "greedy_translate",
+    "beam_translate",
 ]
 
 
@@ -404,3 +405,44 @@ def greedy_translate(exe, main, fetches, src_ids, src_lens, bos_id, eos_id,
         done |= nxt == eos_id
         cur += 1
     return trg[:, :cur]
+
+
+def beam_translate(exe, main, fetches, src_ids, src_lens, bos_id, eos_id,
+                   beam_size=4, max_out_len=None, pad_id=0,
+                   length_penalty=0.0):
+    """Beam-search decoding on the transformer_logits_program (same feed
+    contract as greedy_translate).  Returns (ids [B, T_out], scores [B])."""
+    from ..contrib.decoder.beam_search_decoder import full_sequence_beam_search
+
+    blk = main.global_block()
+    src_len = int(blk.vars["src_word"].shape[1])
+    trg_len = int(blk.vars["trg_word"].shape[1])
+    max_out_len = min(max_out_len or trg_len, trg_len)
+    src_ids = np.asarray(src_ids, "int64")
+    b, p = src_ids.shape
+    assert p == src_len, "src must be padded to the program's %d" % src_len
+    src_lens = np.asarray(src_lens).reshape(-1)
+    src_bias = pad_bias(src_lens, src_len)
+    src_rep = np.repeat(src_ids, beam_size, axis=0)
+    src_bias_rep = np.repeat(src_bias, beam_size, axis=0)
+
+    trg0 = np.full((b, trg_len), pad_id, "int64")
+    trg0[:, 0] = bos_id
+
+    def logits_fn(rows, cur):
+        feed = {
+            "src_word": src_rep,
+            "trg_word": rows,
+            "src_slf_attn_bias": src_bias_rep,
+            "trg_slf_attn_bias": causal_plus_pad_bias(
+                np.full(rows.shape[0], cur), trg_len
+            ),
+            "trg_src_attn_bias": src_bias_rep,
+        }
+        (logits,) = exe.run(main, feed=feed, fetch_list=fetches)
+        return np.asarray(logits)[:, cur - 1, :]
+
+    return full_sequence_beam_search(
+        logits_fn, trg0, 1, beam_size, max_out_len, eos_id, pad_id,
+        length_penalty,
+    )

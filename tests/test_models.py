@@ -379,6 +379,14 @@ def test_gpt2_greedy_generate_learns_pattern():
     np.testing.assert_array_equal(got[0], expect)
     np.testing.assert_array_equal(got[1], expect)
 
+    # beam search on the overfit model agrees with greedy (the mode is
+    # sharp) and returns finite scores
+    beam_ids, beam_scores = gpt2.beam_generate(
+        exe, imain, ifetches, prompt, 6, beam_size=3
+    )
+    np.testing.assert_array_equal(beam_ids[:, :11], got)
+    assert np.isfinite(beam_scores).all()
+
 
 def test_transformer_greedy_translate_learns_copy():
     """End-to-end translation: overfit a tiny transformer on a copy task
@@ -452,6 +460,37 @@ def test_transformer_greedy_translate_learns_copy():
         assert row[0] == BOS
         assert row[1:6] == src[r, :5].tolist(), (row, src[r])
         assert EOS in row[6:], row
+
+    # beam search: its best score must dominate the greedy path's total
+    # logprob (on repeat-ambiguous rows beam may legitimately pick a
+    # different, higher-probability sequence — that's the point of beam)
+    beam_ids, beam_scores = tfm.beam_translate(
+        exe, imain, ifetches, src, src_lens, bos_id=BOS, eos_id=EOS,
+        beam_size=3,
+    )
+    assert np.isfinite(beam_scores).all()
+
+    # teacher-force the greedy outputs in ONE forward: logits at position
+    # i score token got[:, i+1] (causal masking makes this exact)
+    buf = np.zeros((4, T), "int64")
+    n_tok = got.shape[1] - 1
+    buf[:, : n_tok + 1] = got
+    feed = {
+        "src_word": src, "trg_word": buf,
+        "src_slf_attn_bias": tfm.pad_bias(src_lens, S),
+        "trg_slf_attn_bias": tfm.causal_plus_pad_bias(
+            np.full(4, n_tok + 1), T),
+        "trg_src_attn_bias": tfm.pad_bias(src_lens, S),
+    }
+    (lg,) = exe.run(imain, feed=feed, fetch_list=ifetches)
+    lg = np.asarray(lg)[:, :n_tok, :]
+    lp = lg - (np.log(np.sum(np.exp(lg - lg.max(-1, keepdims=True)), -1,
+                             keepdims=True)) + lg.max(-1, keepdims=True))
+    greedy_lp = np.take_along_axis(
+        lp, got[:, 1:, None], axis=2
+    ).squeeze(-1).sum(axis=1)
+    for r in range(4):
+        assert beam_scores[r] >= greedy_lp[r] - 1e-4, (r, beam_scores[r], greedy_lp[r])
 
     # the fused_attn variant of the logits program must also build (the
     # bench's on-TPU default config trains fused; translate must work)
