@@ -17,8 +17,14 @@ def _beam_topk(total, beam):
     score) — the one top-k core behind both decoders."""
     b, _, vocab = total.shape
     flat = total.reshape(b, -1)
-    top_idx = np.argsort(-flat, axis=1)[:, :beam]
-    top_scores = np.take_along_axis(flat, top_idx, axis=1)
+    # argpartition: O(beam*V) select, then sort only the `beam` survivors
+    # (a full argsort of beam*vocab candidates per token is the hot-path
+    # host cost for large vocabs)
+    part = np.argpartition(-flat, beam - 1, axis=1)[:, :beam]
+    part_scores = np.take_along_axis(flat, part, axis=1)
+    order = np.argsort(-part_scores, axis=1)
+    top_idx = np.take_along_axis(part, order, axis=1)
+    top_scores = np.take_along_axis(part_scores, order, axis=1)
     parent = (top_idx // vocab).astype(np.int32)
     token = (top_idx % vocab).astype(np.int32)
     return parent, token, top_scores

@@ -156,6 +156,21 @@ def gpt2_logits_program(hp=GPT2Config, seq_len=128):
     return main, startup, ["ids"], [logits]
 
 
+def _prompt_buffer(main, prompt_ids, max_new_tokens, pad_id):
+    """Shared decode prologue: validate the prompt against the program's
+    width and left-align it in a pad-filled [B, T] buffer."""
+    T = int(main.global_block().vars["ids"].shape[1])
+    prompt_ids = np.asarray(prompt_ids, "int64")
+    b, p = prompt_ids.shape
+    assert p >= 1, "empty prompt: seed generation with at least a BOS token"
+    assert p + max_new_tokens <= T, (
+        "program seq_len %d < prompt %d + new %d" % (T, p, max_new_tokens)
+    )
+    buf = np.full((b, T), pad_id, "int64")
+    buf[:, :p] = prompt_ids
+    return buf, p
+
+
 def greedy_generate(exe, main, fetches, prompt_ids, max_new_tokens,
                     pad_id=0):
     """Greedy decoding on a fixed-shape logits program: the prompt is
@@ -165,16 +180,7 @@ def greedy_generate(exe, main, fetches, prompt_ids, max_new_tokens,
 
     prompt_ids: [B, P] int64.  Returns [B, P + max_new_tokens] int64.
     """
-    ids_var = main.global_block().vars["ids"]
-    T = int(ids_var.shape[1])
-    prompt_ids = np.asarray(prompt_ids, "int64")
-    b, p = prompt_ids.shape
-    assert p >= 1, "empty prompt: seed generation with at least a BOS token"
-    assert p + max_new_tokens <= T, (
-        "program seq_len %d < prompt %d + new %d" % (T, p, max_new_tokens)
-    )
-    buf = np.full((b, T), pad_id, "int64")
-    buf[:, :p] = prompt_ids
+    buf, p = _prompt_buffer(main, prompt_ids, max_new_tokens, pad_id)
     cur = p
     for _ in range(max_new_tokens):
         (logits,) = exe.run(main, feed={"ids": buf}, fetch_list=fetches)
@@ -190,14 +196,7 @@ def beam_generate(exe, main, fetches, prompt_ids, max_new_tokens,
     greedy_generate.  Returns (ids [B, T_out], scores [B])."""
     from ..contrib.decoder.beam_search_decoder import full_sequence_beam_search
 
-    ids_var = main.global_block().vars["ids"]
-    T = int(ids_var.shape[1])
-    prompt_ids = np.asarray(prompt_ids, "int64")
-    b, p = prompt_ids.shape
-    assert p >= 1, "empty prompt: seed generation with at least a BOS token"
-    assert p + max_new_tokens <= T
-    buf = np.full((b, T), pad_id, "int64")
-    buf[:, :p] = prompt_ids
+    buf, p = _prompt_buffer(main, prompt_ids, max_new_tokens, pad_id)
 
     def logits_fn(rows, cur):
         (logits,) = exe.run(main, feed={"ids": rows}, fetch_list=fetches)

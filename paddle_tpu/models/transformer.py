@@ -366,6 +366,19 @@ def transformer_logits_program(hp=ModelHyperParams, src_len=64, trg_len=64):
     return main, startup, feeds, [logits]
 
 
+def _translate_prologue(main, src_ids, src_lens, max_out_len):
+    """Shared decode prologue: program widths, src validation, padding bias."""
+    blk = main.global_block()
+    src_len = int(blk.vars["src_word"].shape[1])
+    trg_len = int(blk.vars["trg_word"].shape[1])
+    max_out_len = min(max_out_len or trg_len, trg_len)
+    src_ids = np.asarray(src_ids, "int64")
+    b, p = src_ids.shape
+    assert p == src_len, "src must be padded to the program's %d" % src_len
+    src_lens = np.asarray(src_lens).reshape(-1)
+    return src_ids, src_lens, pad_bias(src_lens, src_len), trg_len, max_out_len, b
+
+
 def greedy_translate(exe, main, fetches, src_ids, src_lens, bos_id, eos_id,
                      max_out_len=None, pad_id=0):
     """Greedy decoding on a fixed-shape logits program (the reference
@@ -375,16 +388,9 @@ def greedy_translate(exe, main, fetches, src_ids, src_lens, bos_id, eos_id,
     src_ids [B, Ts] int64, src_lens [B] — returns [B, T_out] int64 rows
     starting with bos_id; generation stops early once every row emitted
     eos_id."""
-    blk = main.global_block()
-    src_len = int(blk.vars["src_word"].shape[1])
-    trg_len = int(blk.vars["trg_word"].shape[1])
-    max_out_len = min(max_out_len or trg_len, trg_len)
-    src_ids = np.asarray(src_ids, "int64")
-    b, p = src_ids.shape
-    assert p == src_len, "src must be padded to the program's %d" % src_len
-    src_lens = np.asarray(src_lens).reshape(-1)
-
-    src_bias = pad_bias(src_lens, src_len)
+    src_ids, src_lens, src_bias, trg_len, max_out_len, b = _translate_prologue(
+        main, src_ids, src_lens, max_out_len
+    )
     trg = np.full((b, trg_len), pad_id, "int64")
     trg[:, 0] = bos_id
     done = np.zeros(b, bool)
@@ -414,15 +420,9 @@ def beam_translate(exe, main, fetches, src_ids, src_lens, bos_id, eos_id,
     contract as greedy_translate).  Returns (ids [B, T_out], scores [B])."""
     from ..contrib.decoder.beam_search_decoder import full_sequence_beam_search
 
-    blk = main.global_block()
-    src_len = int(blk.vars["src_word"].shape[1])
-    trg_len = int(blk.vars["trg_word"].shape[1])
-    max_out_len = min(max_out_len or trg_len, trg_len)
-    src_ids = np.asarray(src_ids, "int64")
-    b, p = src_ids.shape
-    assert p == src_len, "src must be padded to the program's %d" % src_len
-    src_lens = np.asarray(src_lens).reshape(-1)
-    src_bias = pad_bias(src_lens, src_len)
+    src_ids, src_lens, src_bias, trg_len, max_out_len, b = _translate_prologue(
+        main, src_ids, src_lens, max_out_len
+    )
     src_rep = np.repeat(src_ids, beam_size, axis=0)
     src_bias_rep = np.repeat(src_bias, beam_size, axis=0)
 

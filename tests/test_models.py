@@ -482,15 +482,21 @@ def test_transformer_greedy_translate_learns_copy():
             np.full(4, n_tok + 1), T),
         "trg_src_attn_bias": tfm.pad_bias(src_lens, S),
     }
+    from paddle_tpu.contrib.decoder.beam_search_decoder import _logsumexp
+
     (lg,) = exe.run(imain, feed=feed, fetch_list=ifetches)
     lg = np.asarray(lg)[:, :n_tok, :]
-    lp = lg - (np.log(np.sum(np.exp(lg - lg.max(-1, keepdims=True)), -1,
-                             keepdims=True)) + lg.max(-1, keepdims=True))
+    lp = lg - _logsumexp(lg)
     greedy_lp = np.take_along_axis(
         lp, got[:, 1:, None], axis=2
     ).squeeze(-1).sum(axis=1)
+    # beam usually dominates greedy, but beam search is not monotone (the
+    # greedy prefix can be pruned mid-decode): allow a small slack so seed
+    # drift can't flake the test while broken scoring ((-1e9)-scale gaps)
+    # still fails loudly
     for r in range(4):
-        assert beam_scores[r] >= greedy_lp[r] - 1e-4, (r, beam_scores[r], greedy_lp[r])
+        assert beam_scores[r] >= greedy_lp[r] - 0.5, (
+            r, beam_scores[r], greedy_lp[r])
 
     # the fused_attn variant of the logits program must also build (the
     # bench's on-TPU default config trains fused; translate must work)
