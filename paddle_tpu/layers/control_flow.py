@@ -924,3 +924,56 @@ def reorder_lod_tensor_by_rank(x, rank_table):
 
 
 __all__ += ["Print", "is_empty", "reorder_lod_tensor_by_rank"]
+
+
+def recompute(fn, *args):
+    """Run ``fn(*args)`` in a rematerialization scope: every layer built
+    inside contributes to ONE `recompute` op whose activations are
+    recomputed during backward instead of stored (jax.checkpoint under the
+    hood) — the standard memory-for-FLOPs trade for deep stacks:
+
+        def block(x):
+            h = layers.fc(x, 4*d, act="gelu")
+            return layers.fc(h, d)
+        y = layers.recompute(block, x)
+
+    Returns fn's output variable(s), re-homed in the enclosing block."""
+    from ..framework import default_main_program
+
+    main = default_main_program()
+    parent = main.current_block()
+    sub = main.create_block()
+    outs = fn(*args)
+    main.rollback()
+    out_list = [outs] if isinstance(outs, Variable) else list(outs)
+
+    arg_names = [a.name for a in args if isinstance(a, Variable)]
+    # parameters and other outer vars the scope reads are inputs too
+    ext = _sub_block_externals(main, sub, set(arg_names))
+    in_names = arg_names + ext
+    parent_outs = []
+    for o in out_list:
+        v = parent.create_var(
+            name=unique_name.generate(o.name + ".remat"),
+            dtype=o.dtype,
+            shape=o.shape,
+        )
+        parent_outs.append(v)
+    # output shapes/dtypes copied from the sub-block vars above — the
+    # abstract-eval infer_shape path can't run this op (it needs the
+    # tracer's trace_block), and doesn't need to
+    parent.append_op(
+        "recompute",
+        inputs={"X": list(in_names)},
+        outputs={"Out": [v.name for v in parent_outs]},
+        attrs={
+            "sub_block_idx": sub.idx,
+            "in_names": list(in_names),
+            "out_names": [o.name for o in out_list],
+            "__bound_names__": list(in_names),
+        },
+    )
+    return parent_outs[0] if isinstance(outs, Variable) else tuple(parent_outs)
+
+
+__all__ += ["recompute"]

@@ -32,6 +32,7 @@ class BertConfig:
     n_layer = 12
     dropout = 0.1
     fused_attn = False
+    recompute = False  # rematerialize each encoder layer in backward
     label_smooth_eps = 0.0  # encoder reuses tfm blocks; unused here
 
 
@@ -66,7 +67,16 @@ def bert_encoder(src_ids, seg_ids, attn_bias, hp, is_test=False, kpad_bias=None)
     if hp.dropout and not is_test:
         x = layers.dropout(x, hp.dropout, is_test=is_test)
     for _ in range(hp.n_layer):
-        x = tfm.encoder_layer(x, attn_bias, hp, is_test, kpad_bias=kpad_bias)
+        if getattr(hp, "recompute", False) and not is_test:
+            x = layers.recompute(
+                lambda h: tfm.encoder_layer(
+                    h, attn_bias, hp, is_test, kpad_bias=kpad_bias
+                ),
+                x,
+            )
+        else:
+            x = tfm.encoder_layer(x, attn_bias, hp, is_test,
+                                  kpad_bias=kpad_bias)
     return x
 
 

@@ -38,6 +38,7 @@ class GPT2Config:
     n_layer = 12
     n_head = 12
     dropout = 0.1
+    recompute = False  # rematerialize each block's activations in backward
 
 
 def _pa(base, std=0.02):
@@ -88,7 +89,10 @@ def gpt2_lm(ids, hp=GPT2Config, is_test=False):
     if hp.dropout and not is_test:
         x = layers.dropout(x, hp.dropout, is_test=is_test)
     for _ in range(hp.n_layer):
-        x = _block(x, hp, is_test)
+        if getattr(hp, "recompute", False) and not is_test:
+            x = layers.recompute(lambda h: _block(h, hp, is_test), x)
+        else:
+            x = _block(x, hp, is_test)
     x = layers.layer_norm(x, begin_norm_axis=2)
     return layers.fc(x, size=hp.vocab_size, num_flatten_dims=2,
                      bias_attr=False, param_attr=_pa("softmax_out.w"))

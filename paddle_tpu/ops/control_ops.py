@@ -348,3 +348,29 @@ def _switch(ctx, ins, attrs):
         c = jnp.reshape(conds[ci], ()).astype(bool)
         vals = [jnp.where(c, cv, v) for cv, v in zip(cvals, vals)]
     return {"Out": vals}
+
+
+@register("recompute")
+def _recompute(ctx, ins, attrs):
+    """Rematerialization scope: run a sub-block under jax.checkpoint so
+    its internal activations are recomputed in the backward pass instead
+    of saved — the jax.checkpoint FLOPs-for-HBM trade as an IR construct.
+    (The reference era predates RecomputeOptimizer; this is the TPU-native
+    form: one op, grads via the generic vjp of the checkpointed region.)
+
+    attrs: sub_block_idx, in_names (sub-block names for the X inputs, in
+    order — also __bound_names__ for the read analysis), out_names
+    (sub-block names emitted as Out)."""
+    sub = attrs["sub_block_idx"]
+    in_names = list(attrs["in_names"])
+    out_names = list(attrs["out_names"])
+    vals = list(ins["X"])
+
+    @jax.checkpoint
+    def run(*args):
+        env = dict(zip(in_names, args))
+        env = ctx.trace_block(sub, env)
+        return tuple(env[n] for n in out_names)
+
+    outs = run(*vals)
+    return {"Out": list(outs)}

@@ -334,3 +334,59 @@ def test_dynamic_rnn_seq2seq_trains():
         (lv,) = exe.run(feed={"src": src, "trg": trg}, fetch_list=[loss])
         losses.append(float(np.asarray(lv).reshape(-1)[0]))
     assert losses[-1] < losses[0] - 0.1, losses
+
+
+def test_recompute_scope_matches_plain_forward_and_grads():
+    """layers.recompute: identical forward AND parameter gradients vs the
+    plain graph (jax.checkpoint only trades memory for FLOPs), grads flow
+    into both the scope's inputs and the parameters created inside."""
+    import paddle_tpu.framework as fw
+    from paddle_tpu import unique_name
+    from paddle_tpu.core import scope as scope_mod
+
+    rng = np.random.RandomState(0)
+    xv = rng.rand(4, 8).astype("float32")
+    yv = rng.randint(0, 3, (4, 1)).astype("int64")
+
+    def build(use_remat):
+        fw.switch_main_program(fluid.Program())
+        fw.switch_startup_program(fluid.Program())
+        unique_name.switch()
+        scope_mod._switch_scope(scope_mod.Scope())
+        x = layers.data("rx", shape=[8])
+        y = layers.data("ry", shape=[1], dtype="int64")
+
+        def block(h):
+            h = layers.fc(h, 16, act="gelu",
+                          param_attr=fluid.ParamAttr(name="rc_w1"))
+            return layers.fc(h, 8, param_attr=fluid.ParamAttr(name="rc_w2"))
+
+        h = layers.recompute(block, x) if use_remat else block(x)
+        pred = layers.fc(h, 3, act="softmax",
+                         param_attr=fluid.ParamAttr(name="rc_w3"))
+        loss = layers.mean(layers.cross_entropy(pred, y))
+        fluid.optimizer.SGD(0.5).minimize(loss)
+        main = fluid.default_main_program()
+        main.random_seed = 7
+        fluid.default_startup_program().random_seed = 7
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        losses = []
+        for _ in range(4):
+            (lv,) = exe.run(main, feed={"rx": xv, "ry": yv},
+                            fetch_list=[loss])
+            losses.append(float(np.ravel(lv)[0]))
+        weights = {
+            n: np.asarray(fluid.global_scope().get(n))
+            for n in ("rc_w1", "rc_w2", "rc_w3")
+        }
+        return losses, weights
+
+    plain_losses, plain_w = build(False)
+    remat_losses, remat_w = build(True)
+    # identical math: losses and post-SGD weights match step for step
+    np.testing.assert_allclose(remat_losses, plain_losses, rtol=1e-5)
+    for n in plain_w:
+        np.testing.assert_allclose(remat_w[n], plain_w[n], rtol=1e-5,
+                                   atol=1e-6, err_msg=n)
+    assert plain_losses[-1] < plain_losses[0]
