@@ -951,6 +951,27 @@ def recompute(fn, *args):
     # parameters and other outer vars the scope reads are inputs too
     ext = _sub_block_externals(main, sub, set(arg_names))
     in_names = arg_names + ext
+
+    # reject writes to OUTER variables that aren't returned: the scope's
+    # env is private, so e.g. batch_norm moving-stat updates or assigns
+    # into an outer var would be silently discarded (and the tracer would
+    # then write back stale state) — fail loudly at build time instead
+    out_name_set = {o.name for o in out_list}
+    for op in sub.ops:
+        for n in op.output_arg_names():
+            if (
+                n not in out_name_set
+                and not sub.has_var_local(n)
+                and parent._find_var_recursive(n) is not None
+            ):
+                raise ValueError(
+                    "recompute scope writes outer variable '%s' (op '%s') "
+                    "without returning it — stateful updates (batch_norm "
+                    "moving stats, assigns into outer vars) cannot cross a "
+                    "rematerialization boundary; return the value from fn "
+                    "or move the stateful op outside the scope"
+                    % (n, op.type)
+                )
     parent_outs = []
     for o in out_list:
         v = parent.create_var(

@@ -43,6 +43,7 @@ class ModelHyperParams:
     n_layer = 6
     dropout = 0.1
     label_smooth_eps = 0.1
+    recompute = False  # rematerialize each enc/dec layer in backward
 
 
 def _pos_encoding_table(max_len, d_model):
@@ -216,9 +217,16 @@ def transformer(
         src_ids, hp.src_vocab_size, hp.d_model, hp.max_length, hp.dropout,
         "src_pos_enc_table", is_test,
     )
+    remat = getattr(hp, "recompute", False) and not is_test
     x = enc_in
     for _ in range(hp.n_layer):
-        x = encoder_layer(x, src_slf_attn_bias, hp, is_test, kpad_bias=src_kpad)
+        if remat:
+            x = layers.recompute(
+                lambda h: encoder_layer(h, src_slf_attn_bias, hp, is_test,
+                                        kpad_bias=src_kpad), x)
+        else:
+            x = encoder_layer(x, src_slf_attn_bias, hp, is_test,
+                              kpad_bias=src_kpad)
     enc_out = x
 
     dec_in = prepare_embedding(
@@ -227,10 +235,17 @@ def transformer(
     )
     y = dec_in
     for _ in range(hp.n_layer):
-        y = decoder_layer(
-            y, enc_out, trg_slf_attn_bias, trg_src_attn_bias, hp, is_test,
-            self_kpad=trg_kpad_bias, cross_kpad=cross_kpad,
-        )
+        if remat:
+            y = layers.recompute(
+                lambda h: decoder_layer(
+                    h, enc_out, trg_slf_attn_bias, trg_src_attn_bias, hp,
+                    is_test, self_kpad=trg_kpad_bias, cross_kpad=cross_kpad),
+                y)
+        else:
+            y = decoder_layer(
+                y, enc_out, trg_slf_attn_bias, trg_src_attn_bias, hp, is_test,
+                self_kpad=trg_kpad_bias, cross_kpad=cross_kpad,
+            )
 
     logits = layers.fc(y, size=hp.trg_vocab_size, num_flatten_dims=2,
                        bias_attr=False, param_attr=_pa("softmax_out.w"))

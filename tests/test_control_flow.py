@@ -390,3 +390,18 @@ def test_recompute_scope_matches_plain_forward_and_grads():
         np.testing.assert_allclose(remat_w[n], plain_w[n], rtol=1e-5,
                                    atol=1e-6, err_msg=n)
     assert plain_losses[-1] < plain_losses[0]
+
+
+def test_recompute_rejects_unreturned_outer_writes():
+    """Stateful updates crossing the remat boundary (batch_norm moving
+    stats) fail loudly at build time instead of silently freezing."""
+    import pytest
+
+    x = layers.data("rj_x", shape=[3, 8, 8])
+
+    def block(h):
+        c = layers.conv2d(h, 4, 3)
+        return layers.batch_norm(c)  # writes moving stats to outer vars
+
+    with pytest.raises(ValueError, match="outer variable"):
+        layers.recompute(block, x)

@@ -620,3 +620,37 @@ def test_recompute_with_dropout_and_bert():
     plain = run_bert(False)
     remat = run_bert(True)
     np.testing.assert_allclose(remat, plain, rtol=1e-5)
+
+
+def test_transformer_recompute_matches_plain():
+    """hp.recompute on the full encoder-decoder matches the plain graph
+    step for step (dropout 0)."""
+    import paddle_tpu.framework as fw
+    from paddle_tpu import unique_name
+    from paddle_tpu.core import scope as scope_mod
+
+    def run(remat):
+        fw.switch_main_program(fluid.Program())
+        fw.switch_startup_program(fluid.Program())
+        unique_name.switch()
+        scope_mod._switch_scope(scope_mod.Scope())
+
+        class HP(TinyHP):
+            dropout = 0.0
+            recompute = remat
+
+        main, startup, feeds, fetches = tfm.wmt_transformer_program(
+            HP, src_len=8, trg_len=8, warmup_steps=10)
+        startup.random_seed = 19
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        vals = []
+        for i in range(3):
+            batch = tfm.make_fake_batch(4, 8, 8, HP, seed=i)
+            out = exe.run(main, feed=batch, fetch_list=fetches)
+            vals.append(float(np.asarray(out[0]).reshape(-1)[0]))
+        return vals
+
+    plain = run(False)
+    remat = run(True)
+    np.testing.assert_allclose(remat, plain, rtol=1e-5)
