@@ -1,0 +1,235 @@
+// C inference ABI (paddle_fluid C API / capi.h analog): opaque predictor
+// handles over the AnalysisConfig predictor, consumable from any language
+// with a C FFI.  The runtime underneath is the embedded CPython + XLA
+// stack (the reference links libpaddle_fluid; here the framework IS the
+// embedded runtime — same deployment shape, TPU-native execution).
+//
+// Surface (see capi.h):
+//   pd_init(repo_root)                     — start the runtime (once)
+//   pd_create_predictor(model_dir)        -> handle (NULL on error)
+//   pd_predictor_run(handle, name, data, ndim, dims, out, out_cap,
+//                    out_ndim, out_dims)  -> 0 on success
+//   pd_destroy_predictor(handle)
+//   pd_shutdown()
+//   pd_last_error()                       -> static error string
+
+#include <Python.h>
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+namespace {
+
+std::string g_error;
+bool g_inited = false;
+bool g_finalized = false;
+PyThreadState* g_main_tstate = nullptr;
+
+// RAII GIL guard: every entry point may be called from any host thread
+class GilGuard {
+ public:
+  GilGuard() : state_(PyGILState_Ensure()) {}
+  ~GilGuard() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+void set_error_from_python(const char* what) {
+  g_error = what;
+  if (PyErr_Occurred()) {
+    PyObject *type, *value, *tb;
+    PyErr_Fetch(&type, &value, &tb);
+    if (value != nullptr) {
+      PyObject* s = PyObject_Str(value);
+      if (s != nullptr) {
+        g_error += ": ";
+        g_error += PyUnicode_AsUTF8(s);
+        Py_DECREF(s);
+      }
+    }
+    Py_XDECREF(type);
+    Py_XDECREF(value);
+    Py_XDECREF(tb);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* pd_last_error() { return g_error.c_str(); }
+
+int pd_init(const char* repo_root) {
+  if (g_inited) return 0;
+  if (g_finalized) {
+    g_error = "pd_init: the embedded interpreter cannot be restarted "
+              "after pd_shutdown (numpy does not survive re-init); keep "
+              "the runtime alive for the process lifetime";
+    return 1;
+  }
+  Py_Initialize();
+  PyObject* sys_path = PySys_GetObject("path");
+  if (repo_root != nullptr) {
+    PyObject* p = PyUnicode_FromString(repo_root);
+    PyList_Insert(sys_path, 0, p);
+    Py_DECREF(p);
+  }
+  PyObject* mod = PyImport_ImportModule("paddle_tpu.inference");
+  if (mod == nullptr) {
+    set_error_from_python("import paddle_tpu.inference");
+    return 1;
+  }
+  Py_DECREF(mod);
+  g_inited = true;
+  // release the GIL so any host thread can enter via PyGILState_Ensure
+  g_main_tstate = PyEval_SaveThread();
+  return 0;
+}
+
+void* pd_create_predictor(const char* model_dir) {
+  GilGuard gil;
+  PyObject* mod = PyImport_ImportModule("paddle_tpu.inference");
+  if (mod == nullptr) {
+    set_error_from_python("import paddle_tpu.inference");
+    return nullptr;
+  }
+  PyObject* cfg_cls = PyObject_GetAttrString(mod, "AnalysisConfig");
+  PyObject* create = PyObject_GetAttrString(mod, "create_paddle_predictor");
+  Py_DECREF(mod);
+  if (cfg_cls == nullptr || create == nullptr) {
+    set_error_from_python("predictor API lookup");
+    Py_XDECREF(cfg_cls);
+    Py_XDECREF(create);
+    return nullptr;
+  }
+  PyObject* cfg = PyObject_CallFunction(cfg_cls, "s", model_dir);
+  Py_DECREF(cfg_cls);
+  if (cfg == nullptr) {
+    set_error_from_python("AnalysisConfig");
+    Py_DECREF(create);
+    return nullptr;
+  }
+  PyObject* pred = PyObject_CallFunctionObjArgs(create, cfg, nullptr);
+  Py_DECREF(cfg);
+  Py_DECREF(create);
+  if (pred == nullptr) {
+    set_error_from_python("create_paddle_predictor");
+    return nullptr;
+  }
+  return pred;  // owned reference handed to the caller as an opaque handle
+}
+
+int pd_predictor_run(void* handle, const char* input_name,
+                     const float* data, int ndim, const long* dims,
+                     float* out, long out_capacity, int* out_ndim,
+                     long* out_dims /* caller-sized, >= 8 */) {
+  GilGuard gil;
+  PyObject* pred = static_cast<PyObject*>(handle);
+
+  // build a nested-list feed via numpy (frombuffer + reshape)
+  PyObject* np = PyImport_ImportModule("numpy");
+  if (np == nullptr) {
+    set_error_from_python("import numpy");
+    return 1;
+  }
+  long total = 1;
+  for (int i = 0; i < ndim; ++i) total *= dims[i];
+  PyObject* bytes = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(data), total * sizeof(float));
+  PyObject* arr = PyObject_CallMethod(np, "frombuffer", "Os", bytes, "float32");
+  Py_DECREF(bytes);
+  if (arr == nullptr) {
+    set_error_from_python("np.frombuffer");
+    Py_DECREF(np);
+    return 1;
+  }
+  PyObject* shape = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i) {
+    PyTuple_SET_ITEM(shape, i, PyLong_FromLong(dims[i]));
+  }
+  PyObject* reshaped = PyObject_CallMethod(arr, "reshape", "O", shape);
+  Py_DECREF(arr);
+  Py_DECREF(shape);
+  if (reshaped == nullptr) {
+    set_error_from_python("reshape");
+    Py_DECREF(np);
+    return 1;
+  }
+
+  PyObject* feed = PyDict_New();
+  PyDict_SetItemString(feed, input_name, reshaped);
+  Py_DECREF(reshaped);
+  PyObject* outs = PyObject_CallMethod(pred, "run", "O", feed);
+  Py_DECREF(feed);
+  if (outs == nullptr) {
+    set_error_from_python("predictor.run");
+    Py_DECREF(np);
+    return 1;
+  }
+  PyObject* first = PySequence_GetItem(outs, 0);
+  Py_DECREF(outs);
+  if (first == nullptr) {
+    set_error_from_python("no outputs");
+    Py_DECREF(np);
+    return 1;
+  }
+  PyObject* as_np = PyObject_CallMethod(np, "ascontiguousarray", "Os", first,
+                                        "float32");
+  Py_DECREF(first);
+  Py_DECREF(np);
+  if (as_np == nullptr) {
+    set_error_from_python("ascontiguousarray");
+    return 1;
+  }
+  PyObject* shp = PyObject_GetAttrString(as_np, "shape");
+  Py_ssize_t rank = PyTuple_Size(shp);
+  if (rank > 8) {
+    g_error = "output rank > 8 exceeds the C ABI dims buffer";
+    Py_DECREF(shp);
+    Py_DECREF(as_np);
+    return 1;
+  }
+  long n = 1;
+  *out_ndim = static_cast<int>(rank);
+  for (Py_ssize_t i = 0; i < rank; ++i) {
+    out_dims[i] = PyLong_AsLong(PyTuple_GetItem(shp, i));
+    n *= out_dims[i];
+  }
+  Py_DECREF(shp);
+  if (n > out_capacity) {
+    g_error = "output buffer too small";
+    Py_DECREF(as_np);
+    return 1;
+  }
+  PyObject* tob = PyObject_CallMethod(as_np, "tobytes", nullptr);
+  Py_DECREF(as_np);
+  if (tob == nullptr) {
+    set_error_from_python("tobytes");
+    return 1;
+  }
+  std::memcpy(out, PyBytes_AsString(tob), n * sizeof(float));
+  Py_DECREF(tob);
+  return 0;
+}
+
+void pd_destroy_predictor(void* handle) {
+  if (handle == nullptr) return;
+  GilGuard gil;
+  Py_XDECREF(static_cast<PyObject*>(handle));
+}
+
+void pd_shutdown() {
+  if (g_inited) {
+    if (g_main_tstate != nullptr) {
+      PyEval_RestoreThread(g_main_tstate);
+      g_main_tstate = nullptr;
+    }
+    Py_Finalize();
+    g_inited = false;
+    g_finalized = true;  // re-init is refused (numpy can't re-init)
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,31 @@
+/* C inference ABI (paddle_fluid C API analog) — see capi.cc. */
+#ifndef PADDLE_TPU_NATIVE_CAPI_H_
+#define PADDLE_TPU_NATIVE_CAPI_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Start the embedded runtime (idempotent).  repo_root goes on sys.path.
+ * After pd_shutdown the runtime CANNOT be restarted in this process. */
+int pd_init(const char* repo_root);
+
+/* Load a save_inference_model directory; NULL on error (pd_last_error). */
+void* pd_create_predictor(const char* model_dir);
+
+/* Run one float input through the predictor.  out_dims must hold >= 8
+ * longs; returns 0 on success. */
+int pd_predictor_run(void* handle, const char* input_name,
+                     const float* data, int ndim, const long* dims,
+                     float* out, long out_capacity, int* out_ndim,
+                     long* out_dims);
+
+void pd_destroy_predictor(void* handle);
+void pd_shutdown();
+const char* pd_last_error();
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* PADDLE_TPU_NATIVE_CAPI_H_ */

@@ -1,0 +1,64 @@
+/* C-only inference demo: load a saved model through the C ABI and run a
+ * batch (inference/api/demo_ci analog).  Usage:
+ *   capi_demo <repo_root> <model_dir> <input_name> <ndim> <d0> <d1> ...
+ * Feeds ones; prints the first few outputs and OK/ERR. */
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "capi.h"
+
+int main(int argc, char** argv) {
+  if (argc < 6) {
+    fprintf(stderr, "usage: %s <repo_root> <model_dir> <input> <ndim> <dims...>\n",
+            argv[0]);
+    return 2;
+  }
+  const char* root = argv[1];
+  const char* model_dir = argv[2];
+  const char* input = argv[3];
+  int ndim = atoi(argv[4]);
+  if (ndim < 1 || ndim > 8 || argc < 5 + ndim) {
+    fprintf(stderr, "ndim must be 1..8 with that many dims supplied\n");
+    return 2;
+  }
+  long dims[8];
+  long total = 1;
+  for (int i = 0; i < ndim; ++i) {
+    dims[i] = atol(argv[5 + i]);
+    total *= dims[i];
+  }
+
+  if (pd_init(root) != 0) {
+    fprintf(stderr, "pd_init: %s\n", pd_last_error());
+    return 1;
+  }
+  void* pred = pd_create_predictor(model_dir);
+  if (pred == NULL) {
+    fprintf(stderr, "pd_create_predictor: %s\n", pd_last_error());
+    return 1;
+  }
+  float* in = malloc(total * sizeof(float));
+  for (long i = 0; i < total; ++i) in[i] = 1.0f;
+  float out[4096];
+  long out_dims[8];
+  int out_ndim = 0;
+  if (pd_predictor_run(pred, input, in, ndim, dims, out, 4096, &out_ndim,
+                       out_dims) != 0) {
+    fprintf(stderr, "pd_predictor_run: %s\n", pd_last_error());
+    return 1;
+  }
+  long n = 1;
+  printf("out_ndim=%d dims=", out_ndim);
+  for (int i = 0; i < out_ndim; ++i) {
+    printf("%ld%s", out_dims[i], i + 1 < out_ndim ? "x" : "");
+    n *= out_dims[i];
+  }
+  printf(" first=[");
+  for (long i = 0; i < n && i < 4; ++i) printf("%s%.6f", i ? ", " : "", out[i]);
+  printf("]\n");
+  free(in);
+  pd_destroy_predictor(pred);
+  pd_shutdown();
+  printf("CAPI_OK\n");
+  return 0;
+}

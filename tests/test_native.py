@@ -168,3 +168,48 @@ def test_demo_trainer_cpp_binary(tmp_path):
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "improved=true" in proc.stdout, proc.stdout
+
+
+def test_c_inference_abi(tmp_path):
+    """inference C ABI (paddle_fluid C API analog): build the .so + demo,
+    export a model, run it from C, and match Python's outputs."""
+    import os
+    import shutil
+    import subprocess
+    import sysconfig
+
+    native_dir = os.path.join(os.path.dirname(fluid.__file__), "native")
+    py_h = os.path.join(sysconfig.get_paths()["include"], "Python.h")
+    if shutil.which("g++") is None or not os.path.exists(py_h):
+        pytest.skip("no C++ toolchain / Python headers")
+    subprocess.run(["make", "capi_demo"], cwd=native_dir, check=True,
+                   capture_output=True)
+
+    from paddle_tpu import layers
+
+    x = layers.data("cax", shape=[8])
+    pred = layers.fc(layers.fc(x, 16, act="relu"), 4, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    model_dir = str(tmp_path / "capi_model")
+    fluid.save_inference_model(model_dir, ["cax"], [pred], exe)
+    (ref,) = exe.run(
+        program=fluid.default_main_program().clone(for_test=True),
+        feed={"cax": np.ones((2, 8), "float32")}, fetch_list=[pred],
+    )
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.run(
+        [os.path.join(native_dir, "capi_demo"),
+         os.path.dirname(os.path.dirname(fluid.__file__)),
+         model_dir, "cax", "2", "2", "8"],
+        env=env, capture_output=True, text=True, timeout=240,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "CAPI_OK" in proc.stdout
+    line = [l for l in proc.stdout.splitlines() if "first=" in l][0]
+    got = [float(v) for v in
+           line.split("first=[")[1].rstrip("]").split(",")]
+    np.testing.assert_allclose(got, np.asarray(ref)[0][:4], rtol=1e-4)
