@@ -17,10 +17,12 @@
 
 #include <cstdio>
 #include <cstring>
+#include <mutex>
 #include <string>
 
 namespace {
 
+std::mutex g_err_mu;
 std::string g_error;
 bool g_inited = false;
 bool g_finalized = false;
@@ -36,16 +38,21 @@ class GilGuard {
   PyGILState_STATE state_;
 };
 
+void set_error(const std::string& msg) {
+  std::lock_guard<std::mutex> lk(g_err_mu);
+  g_error = msg;
+}
+
 void set_error_from_python(const char* what) {
-  g_error = what;
+  std::string msg = what;
   if (PyErr_Occurred()) {
     PyObject *type, *value, *tb;
     PyErr_Fetch(&type, &value, &tb);
     if (value != nullptr) {
       PyObject* s = PyObject_Str(value);
       if (s != nullptr) {
-        g_error += ": ";
-        g_error += PyUnicode_AsUTF8(s);
+        msg += ": ";
+        msg += PyUnicode_AsUTF8(s);
         Py_DECREF(s);
       }
     }
@@ -53,23 +60,48 @@ void set_error_from_python(const char* what) {
     Py_XDECREF(value);
     Py_XDECREF(tb);
   }
+  set_error(msg);
+}
+
+// entry-point precondition: the runtime must be alive (calling
+// PyGILState_Ensure on a finalized/uninitialized interpreter aborts)
+bool runtime_alive(const char* who) {
+  if (g_inited) return true;
+  set_error(std::string(who) +
+            ": runtime not initialized (call pd_init; after pd_shutdown "
+            "the runtime cannot be used)");
+  return false;
 }
 
 }  // namespace
 
 extern "C" {
 
-const char* pd_last_error() { return g_error.c_str(); }
+const char* pd_last_error() {
+  // copy under the lock into a thread-local buffer: g_error may be
+  // rewritten concurrently by another thread's failing call
+  thread_local static char buf[1024];
+  std::lock_guard<std::mutex> lk(g_err_mu);
+  std::snprintf(buf, sizeof(buf), "%s", g_error.c_str());
+  return buf;
+}
 
 int pd_init(const char* repo_root) {
   if (g_inited) return 0;
   if (g_finalized) {
-    g_error = "pd_init: the embedded interpreter cannot be restarted "
+    set_error("pd_init: the embedded interpreter cannot be restarted "
               "after pd_shutdown (numpy does not survive re-init); keep "
-              "the runtime alive for the process lifetime";
+              "the runtime alive for the process lifetime");
     return 1;
   }
-  Py_Initialize();
+  const bool first = !Py_IsInitialized();
+  PyGILState_STATE st = PyGILState_LOCKED;
+  if (first) {
+    Py_Initialize();  // holds the GIL
+  } else {
+    st = PyGILState_Ensure();  // retry after a failed first pd_init
+  }
+  int rc = 0;
   PyObject* sys_path = PySys_GetObject("path");
   if (repo_root != nullptr) {
     PyObject* p = PyUnicode_FromString(repo_root);
@@ -79,16 +111,23 @@ int pd_init(const char* repo_root) {
   PyObject* mod = PyImport_ImportModule("paddle_tpu.inference");
   if (mod == nullptr) {
     set_error_from_python("import paddle_tpu.inference");
-    return 1;
+    rc = 1;
+  } else {
+    Py_DECREF(mod);
+    g_inited = true;
   }
-  Py_DECREF(mod);
-  g_inited = true;
-  // release the GIL so any host thread can enter via PyGILState_Ensure
-  g_main_tstate = PyEval_SaveThread();
-  return 0;
+  // ALWAYS release the GIL — a failure path that kept it would deadlock
+  // every later call from any thread
+  if (first) {
+    g_main_tstate = PyEval_SaveThread();
+  } else {
+    PyGILState_Release(st);
+  }
+  return rc;
 }
 
 void* pd_create_predictor(const char* model_dir) {
+  if (!runtime_alive("pd_create_predictor")) return nullptr;
   GilGuard gil;
   PyObject* mod = PyImport_ImportModule("paddle_tpu.inference");
   if (mod == nullptr) {
@@ -125,6 +164,7 @@ int pd_predictor_run(void* handle, const char* input_name,
                      const float* data, int ndim, const long* dims,
                      float* out, long out_capacity, int* out_ndim,
                      long* out_dims /* caller-sized, >= 8 */) {
+  if (!runtime_alive("pd_predictor_run")) return 1;
   GilGuard gil;
   PyObject* pred = static_cast<PyObject*>(handle);
 
@@ -135,9 +175,21 @@ int pd_predictor_run(void* handle, const char* input_name,
     return 1;
   }
   long total = 1;
-  for (int i = 0; i < ndim; ++i) total *= dims[i];
+  for (int i = 0; i < ndim; ++i) {
+    if (dims[i] <= 0) {
+      set_error("pd_predictor_run: dims must be positive");
+      Py_DECREF(np);
+      return 1;
+    }
+    total *= dims[i];
+  }
   PyObject* bytes = PyBytes_FromStringAndSize(
       reinterpret_cast<const char*>(data), total * sizeof(float));
+  if (bytes == nullptr) {
+    set_error_from_python("input buffer");
+    Py_DECREF(np);
+    return 1;
+  }
   PyObject* arr = PyObject_CallMethod(np, "frombuffer", "Os", bytes, "float32");
   Py_DECREF(bytes);
   if (arr == nullptr) {
@@ -186,7 +238,7 @@ int pd_predictor_run(void* handle, const char* input_name,
   PyObject* shp = PyObject_GetAttrString(as_np, "shape");
   Py_ssize_t rank = PyTuple_Size(shp);
   if (rank > 8) {
-    g_error = "output rank > 8 exceeds the C ABI dims buffer";
+    set_error("output rank > 8 exceeds the C ABI dims buffer");
     Py_DECREF(shp);
     Py_DECREF(as_np);
     return 1;
@@ -199,7 +251,7 @@ int pd_predictor_run(void* handle, const char* input_name,
   }
   Py_DECREF(shp);
   if (n > out_capacity) {
-    g_error = "output buffer too small";
+    set_error("output buffer too small");
     Py_DECREF(as_np);
     return 1;
   }
@@ -216,6 +268,7 @@ int pd_predictor_run(void* handle, const char* input_name,
 
 void pd_destroy_predictor(void* handle) {
   if (handle == nullptr) return;
+  if (!g_inited) return;  // after shutdown the ref died with the runtime
   GilGuard gil;
   Py_XDECREF(static_cast<PyObject*>(handle));
 }

@@ -12,7 +12,7 @@ import re
 
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-__all__ = ["ShardingRules", "data_parallel_rules", "transformer_tp_rules", "P"]
+__all__ = ["ShardingRules", "data_parallel_rules", "transformer_tp_rules", "zero1_rules", "P"]
 
 
 class ShardingRules:
@@ -61,3 +61,31 @@ def transformer_tp_rules(mp_axis="mp"):
             (r"softmax_out\.w", P(None, mp_axis)),
         ]
     )
+
+
+def zero1_rules(dp_axis="dp", base=None):
+    """ZeRO stage-1: shard OPTIMIZER STATE over the data-parallel axis
+    while parameters stay replicated (or follow `base`'s TP specs).
+
+    Accumulator tensors (moments, velocities, averaged squares — named
+    `<param>_<kind>` by Optimizer._add_accumulator) get their leading dim
+    sharded over `dp_axis`; the executor's divisibility guard replicates
+    any state whose dim 0 doesn't divide, and the rank guard keeps
+    `*_pow_acc` scalars replicated.  XLA inserts the gather/scatter
+    collectives around the update — the declarative form of ZeRO's
+    reduce-scatter + all-gather choreography.
+    """
+    # the exact Optimizer._add_accumulator kinds (var name is
+    # <param>_<kind>_<n>); *_pow_acc scalars are deliberately absent
+    state_pats = [
+        (r"_(moment[12]?|momentum|velocity|inf_norm|_avg_squared_grad|"
+         r"_avg_squared_update|mean_square|mean_grad|squared|linear)"
+         r"(_\d+)?$",
+         P(dp_axis)),
+    ]
+    rules = ShardingRules(state_pats)
+    if base is not None:
+        # base.rules entries are already (compiled_pattern, spec)
+        rules.rules = rules.rules + list(base.rules)
+        rules.default = base.default
+    return rules
