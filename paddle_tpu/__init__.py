@@ -105,4 +105,4 @@ from .io import (
 )
 from .parallel_executor import ParallelExecutor, BuildStrategy, ExecutionStrategy
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"

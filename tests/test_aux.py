@@ -343,3 +343,27 @@ def test_image_resize_rounds_not_truncates():
         np.array([[100, 101]], "uint8").reshape(1, 2), 1, 3
     )
     assert mid.flatten().tolist()[1] in (100, 101)  # rounded, never 99
+
+
+def test_packaging_metadata_builds():
+    """pyproject.toml is a valid setuptools package definition: the
+    package set resolves to paddle_tpu.* with the native sources included
+    (the reference's wheel/cmake packaging role, python-side)."""
+    import os
+
+    import setuptools
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    assert os.path.exists(os.path.join(repo, "pyproject.toml"))
+    try:
+        import tomllib
+    except ImportError:
+        import tomli as tomllib
+    with open(os.path.join(repo, "pyproject.toml"), "rb") as f:
+        cfg = tomllib.load(f)
+    assert cfg["project"]["name"] == "paddle-tpu"
+    pkgs = setuptools.find_packages(repo, include=["paddle_tpu*"])
+    assert "paddle_tpu" in pkgs and "paddle_tpu.ops" in pkgs
+    assert "tests" not in pkgs
+    data = cfg["tool"]["setuptools"]["package-data"]["paddle_tpu.native"]
+    assert "*.cc" in data and "Makefile" in data
