@@ -94,7 +94,7 @@ def switch_moe(expert_fn, mesh, axis="ep", capacity_factor=1.0):
             aux = jax.lax.pmean(aux, axis)
             return yl, aux
 
-        from jax.experimental.shard_map import shard_map
+        from jax import shard_map
 
         spec_params = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
         y, aux = shard_map(
@@ -102,7 +102,6 @@ def switch_moe(expert_fn, mesh, axis="ep", capacity_factor=1.0):
             mesh=mesh,
             in_specs=(P(), spec_params, P(axis)),
             out_specs=(P(axis), P()),
-            check_rep=False,
         )(gate_w, stacked_params, x)
         return y, aux
 

@@ -76,7 +76,11 @@ def gpipe(stage_fn, mesh, axis="pp", n_microbatches=None):
                 )
                 return nxt, emit
 
-            _, emitted = jax.lax.scan(tick, zero, jnp.arange(ticks))
+            # the scan carry crosses ppermute, so its type is
+            # device-varying over `axis`; the stable shard_map tracks this
+            # in types — cast the replicated init to varying to match
+            init = jax.lax.pcast(zero, axis, to="varying")
+            _, emitted = jax.lax.scan(tick, init, jnp.arange(ticks))
             # emitted: [ticks, mb, ...]; microbatch m sits at tick m+S-1
             ym = emitted[S - 1 :]
             # broadcast the last stage's result to every pp slice so the
@@ -86,7 +90,7 @@ def gpipe(stage_fn, mesh, axis="pp", n_microbatches=None):
             )
             return ym.reshape((M * mb,) + ym.shape[2:])
 
-        from jax.experimental.shard_map import shard_map
+        from jax import shard_map
 
         spec_params = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
         return shard_map(
@@ -94,7 +98,6 @@ def gpipe(stage_fn, mesh, axis="pp", n_microbatches=None):
             mesh=mesh,
             in_specs=(spec_params, P()),
             out_specs=P(),
-            check_rep=False,
         )(stacked_params, xm)
 
     return _pipelined
