@@ -34,10 +34,7 @@ def main():
     nproc = int(os.environ["PADDLE_TRAINERS"])
     assert jax.process_count() == nproc, jax.process_count()
 
-    try:
-        from jax import shard_map
-    except ImportError:
-        from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     from jax.sharding import NamedSharding
 
