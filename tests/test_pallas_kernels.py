@@ -249,3 +249,20 @@ def test_use_pallas_flag_dispatches_gru_and_xent():
     for a, b in zip(plain, fused):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-5)
+
+
+def test_flash_attention_bf16_inputs():
+    """bf16 q/k/v (the on-TPU AMP regime): kernel accumulates in f32 and
+    matches the dense reference at bf16 tolerance, output dtype preserved."""
+    rng = np.random.RandomState(5)
+    bh, t, d = 2, 16, 8
+    mk = lambda s: jnp.asarray(rng.randn(bh, t, d).astype("float32")).astype(
+        jnp.bfloat16)
+    q, k, v = mk(1), mk(2), mk(3)
+    out = flash_attention(q, k, v, None, True, None, 8, 8)
+    assert out.dtype == jnp.bfloat16
+    ref = _dense_attention(q, k, v, True, 1.0 / np.sqrt(d))
+    np.testing.assert_allclose(
+        np.asarray(out, dtype=np.float32), np.asarray(ref, dtype=np.float32),
+        rtol=3e-2, atol=3e-2,
+    )
