@@ -14,7 +14,6 @@ grad kernels (``grad_op_desc_maker.h``).  XLA CSE merges the re-traced
 forward with the original, so no double compute survives compilation.
 """
 
-import functools
 
 import jax
 import jax.numpy as jnp

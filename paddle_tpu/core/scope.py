@@ -7,7 +7,6 @@ updated state is written back here after the compiled function returns, with
 donation making the HBM update in-place.
 """
 
-import numpy as np
 
 
 class Scope:

@@ -1,6 +1,5 @@
 """CIFAR-10/100 readers (python/paddle/dataset/cifar.py API parity)."""
 
-import os
 import pickle
 import tarfile
 

@@ -11,7 +11,6 @@ by dropping files into DATA_HOME.
 import hashlib
 import os
 
-import numpy as np
 
 __all__ = ["DATA_HOME", "md5file", "data_path", "have_file", "synthetic_note"]
 

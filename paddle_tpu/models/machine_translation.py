@@ -8,7 +8,6 @@ BeamSearchDecoder (host loop over one compiled step) — the TPU
 re-expression of the reference's While/DynamicRNN decode.
 """
 
-import numpy as np
 
 from .. import layers
 

@@ -16,8 +16,6 @@ Schedule: classic GPipe fill-drain over M microbatches and S stages
 choose M a multiple of S where possible.
 """
 
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P

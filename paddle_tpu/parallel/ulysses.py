@@ -18,7 +18,6 @@ transposes to the reverse all_to_all).
 import functools
 
 import jax
-import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 
