@@ -1,0 +1,42 @@
+"""Data-parallel training over every attached device (8-way virtual CPU
+mesh works too):
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/distributed_dp.py
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, parallel
+
+
+def main():
+    img = layers.data("img", shape=[32])
+    label = layers.data("label", shape=[1], dtype="int64")
+    pred = layers.fc(layers.fc(img, 64, act="relu"), 4, act="softmax")
+    loss = layers.mean(layers.cross_entropy(pred, label))
+    fluid.optimizer.Adam(0.01).minimize(loss)
+
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+
+    import jax
+
+    mesh = parallel.make_mesh({"dp": len(jax.devices())})
+    # ZeRO-1: optimizer state shards over dp, params stay replicated
+    dexe = parallel.DistributedExecutor(
+        mesh, parallel.zero1_rules("dp"),
+        main_program=fluid.default_main_program())
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(64, 32).astype("float32")
+    y = rng.randint(0, 4, (64, 1)).astype("int64")
+    for i in range(20):
+        (lv,) = dexe.run([loss], feed={"img": x, "label": y})
+        if i % 5 == 0:
+            print("step %d loss %.4f" % (i, float(np.asarray(lv).reshape(-1)[0])))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,47 @@
+"""Train a tiny GPT-2 on a toy cyclic corpus, then generate greedily and
+with beam search:
+
+    python examples/generate_text.py
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.models import gpt2
+
+
+class HP(gpt2.GPT2Config):
+    vocab_size = 16
+    n_ctx = 32
+    d_model = 64
+    n_layer = 2
+    n_head = 4
+    dropout = 0.0
+
+
+def main():
+    main_prog, startup, feeds, fetches = gpt2.gpt2_lm_program(
+        HP, seq_len=16, lr=1e-2)
+    exe = fluid.Executor()
+    exe.run(startup)
+
+    seq = np.arange(17) % 5  # the "language": 0 1 2 3 4 0 1 ...
+    batch = {
+        "ids": np.tile(seq[:-1], (8, 1)).astype("int64"),
+        "labels": np.tile(seq[1:], (8, 1)).astype("int64"),
+        "loss_weight": np.ones((8, 16), "float32"),
+    }
+    for i in range(80):
+        out = exe.run(main_prog, feed=batch, fetch_list=fetches)
+        if i % 20 == 0:
+            print("step %d loss %.4f" % (i, float(np.asarray(out[0]).reshape(-1)[0])))
+
+    imain, _, _, ifetches = gpt2.gpt2_logits_program(HP, seq_len=16)
+    prompt = np.array([[0, 1, 2]], "int64")
+    print("greedy:", gpt2.greedy_generate(exe, imain, ifetches, prompt, 8)[0].tolist())
+    ids, scores = gpt2.beam_generate(exe, imain, ifetches, prompt, 8, beam_size=4)
+    print("beam:  ", ids[0].tolist(), "score %.3f" % scores[0])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,44 @@
+"""Train a CNN on (synthetic) MNIST and save an inference model — the
+recognize_digits book example, runnable:
+
+    python examples/train_mnist.py [output_dir]
+"""
+
+import sys
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, reader
+from paddle_tpu.dataset import mnist
+from paddle_tpu.models.mnist import cnn_model
+
+
+def main(out_dir="/tmp/mnist_model"):
+    img = layers.data("img", shape=[1, 28, 28])
+    label = layers.data("label", shape=[1], dtype="int64")
+    pred = cnn_model(img)
+    loss = layers.mean(layers.cross_entropy(pred, label))
+    acc = layers.accuracy(pred, label)
+    fluid.optimizer.Adam(0.01).minimize(loss)
+
+    exe = fluid.Executor()  # TPUPlace when a chip is attached, else CPU
+    exe.run(fluid.default_startup_program())
+
+    for i, rows in enumerate(reader.batch(mnist.train(), 64)()):
+        xs = np.stack([r[0] for r in rows]).reshape(-1, 1, 28, 28)
+        ys = np.array([[r[1]] for r in rows], "int64")
+        lv, av = exe.run(feed={"img": xs, "label": ys},
+                         fetch_list=[loss, acc])
+        if i % 10 == 0:
+            print("step %d loss %.4f acc %.3f"
+                  % (i, float(np.ravel(lv)[0]), float(np.ravel(av)[0])))
+        if i >= 50:
+            break
+
+    fluid.save_inference_model(out_dir, ["img"], [pred], exe)
+    print("saved inference model to", out_dir)
+
+
+if __name__ == "__main__":
+    main(*sys.argv[1:2])
