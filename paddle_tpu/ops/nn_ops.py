@@ -585,9 +585,9 @@ def _fused_attention(ctx, ins, attrs):
         # the [Tq, Tk] score matrix
         kbias = ins["Bias"][0].reshape(b, tk).astype(jnp.float32)
         kbias = jnp.broadcast_to(kbias[:, None, :], (b, h, tk)).reshape(b * h, tk)
-    if use_pallas() and t == tk and t % 128 == 0:
+    if use_pallas() and t % 128 == 0 and tk % 128 == 0:
         out = flash_attention(qf, kf, vf, kbias, causal, float(scale))
-    elif use_pallas() and t == tk and t >= 8 and t % 8 == 0:
+    elif use_pallas() and min(t, tk) >= 8 and t % 8 == 0 and tk % 8 == 0:
         out = flash_attention(
             qf, kf, vf, kbias, causal, float(scale), block_q=8, block_k=8
         )

@@ -25,6 +25,15 @@ def _interpret():
     return jax.default_backend() != "tpu"
 
 
+def _vma(*xs):
+    """Union of the inputs' varying-mesh-axes so pallas_call out_shapes
+    type-check inside shard_map (empty set outside)."""
+    out = frozenset()
+    for x in xs:
+        out = out | getattr(jax.typeof(x), "vma", frozenset())
+    return out
+
+
 def _cdiv(a, b):
     return (a + b - 1) // b
 
@@ -40,90 +49,276 @@ def _row_block(n, default):
 
 # ---------------------------------------------------------------------------
 # flash attention
+#
+# Blocked over BOTH q and k: grid (BH, nq, nk) with the k index innermost
+# (sequential on a TPU core), carrying the online-softmax state (acc, m, l)
+# in VMEM scratch across k steps.  Only [block, d] tiles of K/V are ever
+# resident, so sequence length is bounded by HBM, not VMEM.  The forward
+# saves the per-row logsumexp; the backward is two Pallas kernels (dq and
+# dk/dv/dkbias) that rebuild [block_q, block_k] probability tiles from the
+# saved lse — the [T, T] score matrix never exists in HBM in either pass.
+# Role parity: the cuDNN fused-attention kernels of SURVEY §2.6.
 # ---------------------------------------------------------------------------
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, kb_ref, o_ref, *, block_k, causal,
-                      scale, q_block):
-    """One (batch*head, q_block) cell: online softmax over k blocks.
-    q_ref: [bq, d]; k_ref/v_ref: [T, d] (whole sequence resident in VMEM);
-    kb_ref: [1, T] additive key bias (the padding-mask row, broadcast over
-    q rows — rank-1 in T so it never re-materializes the [T,T] scores)."""
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, kb_ref, o_ref, lse_ref,
+                      acc_ref, m_ref, l_ref, *, block_q, block_k, nk,
+                      causal, scale):
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale  # block refs: [1, bq, d]
-    _, T, d = k_ref.shape
-    bq = q.shape[0]
-    nk = T // block_k
+    ki = pl.program_id(2)
 
-    def body(ki, carry):
-        acc, m, l = carry
-        k = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # causal: blocks entirely above the diagonal contribute nothing
+    run = (ki * block_k < (qi + 1) * block_q) if causal else (ki >= 0)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale  # [bq, d]
+        k = k_ref[0].astype(jnp.float32)  # [bk, d]
+        v = v_ref[0].astype(jnp.float32)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [bq, bk]
-        kb = kb_ref[0, 0, pl.ds(ki * block_k, block_k)].astype(jnp.float32)
-        s = s + kb[None, :]
+        s = s + kb_ref[0].astype(jnp.float32)  # [1, bk] broadcast
         if causal:
-            q_pos = qi * q_block + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 0
-            )
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1
-            )
+                jnp.int32, s.shape, 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=1))
-        p = jnp.exp(s - m_new[:, None])
-        alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=1)
-        acc_new = acc * alpha[:, None] + jnp.dot(
-            p, v, preferred_element_type=jnp.float32
-        )
-        return acc_new, m_new, l_new
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
+        m_ref[:] = m_new
 
-    acc0 = jnp.zeros((bq, d), jnp.float32)
-    m0 = jnp.full((bq,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bq,), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, nk, body, (acc0, m0, l0))
-    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+    @pl.when(ki == nk - 1)
+    def _write():
+        l = l_ref[:]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+        lse_ref[0] = (m_ref[:] + jnp.log(safe_l)).reshape(-1)
+
+
+def _flash_blocks(Tq, Tk, block_q, block_k, causal):
+    block_q = min(block_q, Tq)
+    block_k = min(block_k, Tk)
+    assert Tq % block_q == 0 and Tk % block_k == 0, (
+        "flash attention requires seq lens (%d, %d) divisible by block "
+        "sizes (%d, %d) — pad the sequence" % (Tq, Tk, block_q, block_k)
+    )
+    assert not (causal and Tq != Tk), "causal requires Tq == Tk"
+    return block_q, block_k
 
 
 def _flash_fwd(q, k, v, kbias, causal, scale, block_q, block_k):
-    """q/k/v: [BH, T, d], kbias: [BH, T] additive key bias -> o [BH, T, d]."""
+    """q: [BH, Tq, d], k/v: [BH, Tk, d], kbias: [BH, Tk] additive key bias.
+    Returns (o [BH, Tq, d], lse [BH, Tq] float32)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     BH, T, d = q.shape
-    block_q = min(block_q, T)
-    block_k = min(block_k, T)
-    assert T % block_q == 0 and T % block_k == 0, (
-        "flash attention requires seq len %d divisible by block sizes "
-        "(%d, %d) — pad the sequence" % (T, block_q, block_k)
-    )
-    grid = (BH, T // block_q)
+    Tk = k.shape[1]
+    block_q, block_k = _flash_blocks(T, Tk, block_q, block_k, causal)
+    nq, nk = T // block_q, Tk // block_k
     kernel = functools.partial(
-        _flash_fwd_kernel,
-        block_k=block_k,
-        causal=causal,
-        scale=scale,
-        q_block=block_q,
+        _flash_fwd_kernel, block_q=block_q, block_k=block_k, nk=nk,
+        causal=causal, scale=scale,
     )
     return pl.pallas_call(
         kernel,
-        grid=grid,
+        grid=(BH, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, T, d), lambda b, i: (b, 0, 0),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, T, d), lambda b, i: (b, 0, 0),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, T), lambda b, i: (b, 0, 0),
+            pl.BlockSpec((1, block_k), lambda b, i, j: (b, j),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((BH, T, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, T, d), q.dtype, vma=_vma(q, k, v)),
+            jax.ShapeDtypeStruct((BH, T), jnp.float32, vma=_vma(q, k, v)),
+        ],  # lse is over q rows; k-side shapes use Tk
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
         interpret=_interpret(),
-    )(q, k, v, kbias.reshape(BH, 1, T))
+    )(q, k, v, kbias)
+
+
+def _flash_dq_kernel(q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref, delta_ref,
+                     dq_ref, dq_acc, *, block_q, block_k, nk, causal, scale):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    run = (ki * block_k < (qi + 1) * block_q) if causal else (ki >= 0)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0].reshape(-1, 1)  # [bq, 1]
+        delta = delta_ref[0].reshape(-1, 1)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        s = s + kb_ref[0].astype(jnp.float32)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)  # [bq, bk]
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dq_acc[:] = dq_acc[:] + scale * jnp.dot(
+            ds, k, preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _write():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref, delta_ref,
+                      dk_ref, dv_ref, dkb_ref, dk_acc, dv_acc, dkb_acc, *,
+                      block_q, block_k, nq, causal, scale):
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+        dkb_acc[:] = jnp.zeros_like(dkb_acc)
+
+    run = (ki * block_k < (qi + 1) * block_q) if causal else (qi >= 0)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0].reshape(-1, 1)
+        delta = delta_ref[0].reshape(-1, 1)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        s = s + kb_ref[0].astype(jnp.float32)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)  # [bq, bk]
+        dv_acc[:] = dv_acc[:] + jnp.dot(
+            p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk_acc[:] = dk_acc[:] + scale * jnp.dot(
+            ds.T, q, preferred_element_type=jnp.float32)
+        dkb_acc[:] = dkb_acc[:] + jnp.sum(ds, axis=0, keepdims=True)
+
+    @pl.when(qi == nq - 1)
+    def _write():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+        dkb_ref[0] = dkb_acc[:].reshape(-1)
+
+
+def _flash_bwd(q, k, v, kbias, o, lse, do, causal, scale, block_q, block_k,
+               dlse=None):
+    """Blocked backward: returns (dq, dk, dv, dkbias[BH,Tk] f32).
+
+    dlse: optional cotangent of the lse output (the chunk-merge path of
+    ring attention differentiates through lse).  d lse / d s_ij = p_ij, so
+    it folds into the delta term: ds = p * (dp - (delta - dlse))."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    BH, T, d = q.shape
+    Tk = k.shape[1]
+    block_q, block_k = _flash_blocks(T, Tk, block_q, block_k, causal)
+    nq, nk = T // block_q, Tk // block_k
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
+
+    q_spec_q = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                            memory_space=pltpu.VMEM)
+    k_spec_q = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
+                            memory_space=pltpu.VMEM)
+    kb_spec_q = pl.BlockSpec((1, block_k), lambda b, i, j: (b, j),
+                             memory_space=pltpu.VMEM)
+    row_spec_q = pl.BlockSpec((1, block_q), lambda b, i, j: (b, i),
+                              memory_space=pltpu.VMEM)
+    dq = pl.pallas_call(
+        functools.partial(_flash_dq_kernel, block_q=block_q, block_k=block_k,
+                          nk=nk, causal=causal, scale=scale),
+        grid=(BH, nq, nk),
+        in_specs=[q_spec_q, k_spec_q, k_spec_q, kb_spec_q, q_spec_q,
+                  row_spec_q, row_spec_q],
+        out_specs=q_spec_q,
+        out_shape=jax.ShapeDtypeStruct((BH, T, d), q.dtype,
+                                       vma=_vma(q, k, v, do)),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=_interpret(),
+    )(q, k, v, kbias, do, lse, delta)
+
+    # dk/dv pass: grid iterates q blocks innermost for each k block
+    q_spec_k = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0),
+                            memory_space=pltpu.VMEM)
+    k_spec_k = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0),
+                            memory_space=pltpu.VMEM)
+    kb_spec_k = pl.BlockSpec((1, block_k), lambda b, i, j: (b, i),
+                             memory_space=pltpu.VMEM)
+    row_spec_k = pl.BlockSpec((1, block_q), lambda b, i, j: (b, j),
+                              memory_space=pltpu.VMEM)
+    dk, dv, dkb = pl.pallas_call(
+        functools.partial(_flash_dkv_kernel, block_q=block_q, block_k=block_k,
+                          nq=nq, causal=causal, scale=scale),
+        grid=(BH, nk, nq),
+        in_specs=[q_spec_k, k_spec_k, k_spec_k, kb_spec_k, q_spec_k,
+                  row_spec_k, row_spec_k],
+        out_specs=[k_spec_k, k_spec_k, kb_spec_k],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Tk, d), k.dtype, vma=_vma(q, k, v, do)),
+            jax.ShapeDtypeStruct((BH, Tk, d), v.dtype, vma=_vma(q, k, v, do)),
+            jax.ShapeDtypeStruct((BH, Tk), jnp.float32, vma=_vma(q, k, v, do)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((1, block_k), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, kbias, do, lse, delta)
+    return dq, dk, dv, dkb
 
 
 def _dense_attention(q, k, v, causal, scale, kbias=None):
@@ -142,41 +337,74 @@ def _dense_attention(q, k, v, causal, scale, kbias=None):
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
 def flash_attention(q, k, v, kbias=None, causal=False, scale=None,
                     block_q=128, block_k=128):
-    """Fused attention over [BH, T, d] (flash-style online softmax).
-    kbias: optional [BH, T] additive key bias (padding mask row)."""
+    """Fused attention, q: [BH, Tq, d], k/v: [BH, Tk, d] (flash-style
+    online softmax).  kbias: optional [BH, Tk] additive key bias (the
+    padding-mask row, indexed by key position)."""
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
-    kb = kbias if kbias is not None else jnp.zeros(q.shape[:2], jnp.float32)
-    return _flash_fwd(q, k, v, kb, causal, scale, block_q, block_k)
+    kb = kbias if kbias is not None else jnp.zeros(k.shape[:2], jnp.float32)
+    o, _ = _flash_fwd(q, k, v, kb, causal, scale, block_q, block_k)
+    return o
 
 
 def _flash_vjp_fwd(q, k, v, kbias, causal, scale, block_q, block_k):
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
-    kb = kbias if kbias is not None else jnp.zeros(q.shape[:2], jnp.float32)
-    o = _flash_fwd(q, k, v, kb, causal, scale, block_q, block_k)
-    return o, (q, k, v, kbias)
+    kb = kbias if kbias is not None else jnp.zeros(k.shape[:2], jnp.float32)
+    o, lse = _flash_fwd(q, k, v, kb, causal, scale, block_q, block_k)
+    return o, (q, k, v, kbias, o, lse)
 
 
 def _flash_vjp_bwd(causal, scale, block_q, block_k, res, do):
-    q, k, v, kbias = res
+    q, k, v, kbias, o, lse = res
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
-    # recompute-based backward: XLA fuses the re-derived softmax with the
-    # grad matmuls; trades FLOPs for never materializing fwd residuals
+    kb = kbias if kbias is not None else jnp.zeros(k.shape[:2], jnp.float32)
+    dq, dk, dv, dkb = _flash_bwd(
+        q, k, v, kb, o, lse, do, causal, scale, block_q, block_k)
     if kbias is None:
-        _, vjp = jax.vjp(
-            lambda q, k, v: _dense_attention(q, k, v, causal, scale), q, k, v
-        )
-        return vjp(do) + (None,)
-    _, vjp = jax.vjp(
-        lambda q, k, v, kb: _dense_attention(q, k, v, causal, scale, kb),
-        q, k, v, kbias,
-    )
-    return vjp(do)
+        return dq, dk, dv, None
+    return dq, dk, dv, dkb.astype(kbias.dtype)
 
 
 flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention_piece(q, k, v, causal=False, scale=None,
+                          block_q=128, block_k=128):
+    """Unmerged attention piece for ring/Ulysses sequence parallelism:
+    returns (o, lse) where o is softmax-normalized within this K/V chunk
+    and lse is the per-row logsumexp.  Two pieces merge exactly via
+    lse = logaddexp(lse1, lse2); o = o1*exp(lse1-lse) + o2*exp(lse2-lse)
+    (see parallel/ring.py).  Differentiable in q/k/v including through the
+    lse output (its cotangent folds into the backward's delta term)."""
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    kb = jnp.zeros(k.shape[:2], jnp.float32)
+    return _flash_fwd(q, k, v, kb, causal, scale, block_q, block_k)
+
+
+def _piece_vjp_fwd(q, k, v, causal, scale, block_q, block_k):
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    kb = jnp.zeros(k.shape[:2], jnp.float32)
+    o, lse = _flash_fwd(q, k, v, kb, causal, scale, block_q, block_k)
+    return (o, lse), (q, k, v, o, lse)
+
+
+def _piece_vjp_bwd(causal, scale, block_q, block_k, res, cts):
+    q, k, v, o, lse = res
+    do, dlse = cts
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    kb = jnp.zeros(k.shape[:2], jnp.float32)
+    dq, dk, dv, _ = _flash_bwd(
+        q, k, v, kb, o, lse, do, causal, scale, block_q, block_k, dlse=dlse)
+    return dq, dk, dv
+
+
+flash_attention_piece.defvjp(_piece_vjp_fwd, _piece_vjp_bwd)
 
 
 # ---------------------------------------------------------------------------

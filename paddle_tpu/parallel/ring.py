@@ -3,11 +3,17 @@
 The reference has no long-context story (SURVEY.md §5.7 'Absent'); this is
 green-field TPU design: K/V blocks rotate around the `sp` axis ring via
 ppermute (one hop per step, riding ICI) while each device holds its local Q
-chunk and maintains flash-style running max/denominator — memory O(T_local),
+chunk and maintains a flash-style running logsumexp — memory O(T_local),
 compute overlapped with the rotation by XLA's async collective scheduling.
 
-Use `ring_attention(...)` inside shard_map (see `ring_attention_sharded` for
-the wrapped convenience entry).
+The ring is a `lax.scan` (HLO size is O(1) in ring size, unlike an
+unrolled loop), and each chunk-vs-chunk piece runs through the Pallas
+flash kernel when FLAGS_use_pallas is on — so neither the per-chunk
+[T_local, T_local] score matrix nor the fwd residuals ever hit HBM.
+Differentiable end-to-end (scan + ppermute + custom-vjp flash piece).
+
+Use `ring_attention(...)` inside shard_map (see `ring_attention_sharded`
+for the wrapped convenience entry).
 """
 
 import functools
@@ -18,21 +24,47 @@ from jax.sharding import PartitionSpec as P
 
 __all__ = ["ring_attention", "ring_attention_sharded"]
 
+from ..ops.pallas_kernels import NEG_INF as _NEG
 
-def _block_attn(q, k, v, scale, bias=None):
-    """One q-block x k-block attention piece: returns (scores_max, exp_scores
-    @ v, exp row sums) for flash-style merging. q:[B,H,Tq,D] k,v:[B,H,Tk,D]."""
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+
+def _dense_piece(q, k, v, scale, bias=None):
+    """One q-chunk x k-chunk attention piece -> (o_norm, lse), f32 lse.
+    q:[B,H,Tq,D] k,v:[B,H,Tk,D]; bias broadcastable to [B,H,Tq,Tk]."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
     if bias is not None:
         s = s + bias
     m = jnp.max(s, axis=-1)  # [B,H,Tq]
     p = jnp.exp(s - m[..., None])
-    pv = jnp.einsum("bhqk,bhkd->bhqd", p, v)
     l = jnp.sum(p, axis=-1)
-    return m, pv, l
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)) / safe_l[..., None]
+    return o, m + jnp.log(safe_l)
 
 
-def ring_attention(q, k, v, axis_name, causal=False, scale=None):
+def _flash_piece_bhtd(q, k, v, causal, scale):
+    """Pallas flash piece over [B,H,T,D] (kernel wants [BH,T,D])."""
+    from ..ops.pallas_kernels import flash_attention_piece
+
+    B, H, T, D = q.shape
+    Tk = k.shape[2]
+    blk = 128 if (T % 128 == 0 and Tk % 128 == 0) else 8
+    o, lse = flash_attention_piece(
+        q.reshape(B * H, T, D), k.reshape(B * H, Tk, D),
+        v.reshape(B * H, Tk, D), causal, scale, blk, blk)
+    return (o.astype(jnp.float32).reshape(B, H, T, D),
+            lse.reshape(B, H, T))
+
+
+def _use_flash(t_local, flag=None):
+    if flag is None:
+        from ..ops.pallas_kernels import use_pallas
+
+        flag = use_pallas()
+    return flag and t_local >= 8 and t_local % 8 == 0
+
+
+def ring_attention(q, k, v, axis_name, causal=False, scale=None,
+                   use_flash=None):
     """Per-shard attention with K/V ring rotation.
 
     q, k, v: local chunks [B, H, T_local, D]; global sequence is the
@@ -44,44 +76,64 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None):
     t_local = q.shape[2]
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    neg = jnp.asarray(-1e30, q.dtype)
-
+    scale = float(scale)
+    flash = _use_flash(t_local, use_flash)
     q_pos = my * t_local + jnp.arange(t_local)  # global positions of local q
 
-    def step(i, carry):
-        k_blk, v_blk, m_acc, o_acc, l_acc = carry
-        src = (my - i) % n  # which rank's block we currently hold
-        bias = None
-        if causal:
-            k_pos = src * t_local + jnp.arange(t_local)
-            mask = q_pos[:, None] >= k_pos[None, :]
-            bias = jnp.where(mask, 0.0, neg).astype(q.dtype)[None, None]
-        m_blk, pv_blk, l_blk = _block_attn(q, k_blk, v_blk, scale, bias)
-        # flash merge
-        m_new = jnp.maximum(m_acc, m_blk)
-        alpha = jnp.exp(m_acc - m_new)
-        beta = jnp.exp(m_blk - m_new)
-        o_new = o_acc * alpha[..., None] + pv_blk * beta[..., None]
-        l_new = l_acc * alpha + l_blk * beta
+    def piece(k_blk, v_blk, src):
+        """(o, lse) of local q vs the chunk originating at rank `src`."""
+        if not causal:
+            if flash:
+                return _flash_piece_bhtd(q, k_blk, v_blk, False, scale)
+            return _dense_piece(q, k_blk, v_blk, scale)
+        if flash:
+            # src == my: the diagonal chunk (causal within); src < my:
+            # fully visible; src > my: fully masked (skip — contributes
+            # exp(-1e30) ≈ 0 through the lse merge)
+            skip_o = jax.lax.pcast(
+                jnp.zeros(q.shape, jnp.float32), (axis_name,), to="varying")
+            skip_lse = jax.lax.pcast(
+                jnp.full(q.shape[:-1], _NEG, jnp.float32), (axis_name,), to="varying")
+            return jax.lax.cond(
+                src == my,
+                lambda: _flash_piece_bhtd(q, k_blk, v_blk, True, scale),
+                lambda: jax.lax.cond(
+                    src < my,
+                    lambda: _flash_piece_bhtd(q, k_blk, v_blk, False, scale),
+                    lambda: (skip_o, skip_lse),
+                ),
+            )
+        k_pos = src * t_local + jnp.arange(t_local)
+        mask = q_pos[:, None] >= k_pos[None, :]
+        bias = jnp.where(mask, 0.0, _NEG).astype(jnp.float32)[None, None]
+        return _dense_piece(q, k_blk, v_blk, scale, bias)
+
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def step(carry, i):
+        k_blk, v_blk, o_acc, lse_acc = carry
+        src = (my - i) % n  # which rank's chunk we currently hold
+        o_blk, lse_blk = piece(k_blk, v_blk, src)
+        lse_new = jnp.logaddexp(lse_acc, lse_blk)
+        o_new = (o_acc * jnp.exp(lse_acc - lse_new)[..., None]
+                 + o_blk * jnp.exp(lse_blk - lse_new)[..., None])
         # rotate k/v to the next rank (ring over ICI)
-        perm = [(j, (j + 1) % n) for j in range(n)]
         k_nxt = jax.lax.ppermute(k_blk, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_blk, axis_name, perm)
-        return (k_nxt, v_nxt, m_new, o_new, l_new)
+        return (k_nxt, v_nxt, o_new, lse_new), None
 
-    m0 = jnp.full(q.shape[:-1], -jnp.inf, q.dtype)
-    o0 = jnp.zeros_like(q)
-    l0 = jnp.zeros(q.shape[:-1], q.dtype)
-    # static ring length: unrolled python loop (n is a traced constant under
-    # shard_map; use fori_loop only when n is dynamic)
-    carry = (k, v, m0, o0, l0)
-    for i in range(int(n)):
-        carry = step(i, carry)
-    _, _, m_f, o_f, l_f = carry
-    return o_f / l_f[..., None]
+    # mark the accumulators device-varying over the ring axis so the scan
+    # carry type matches the body output under shard_map
+    o0 = jax.lax.pcast(jnp.zeros(q.shape, jnp.float32), (axis_name,), to="varying")
+    lse0 = jax.lax.pcast(
+        jnp.full(q.shape[:-1], -jnp.inf, jnp.float32), (axis_name,), to="varying")
+    (_, _, o_f, _), _ = jax.lax.scan(
+        step, (k, v, o0, lse0), jnp.arange(n))
+    return o_f.astype(q.dtype)
 
 
-def ring_attention_sharded(q, k, v, mesh, axis_name="sp", causal=False):
+def ring_attention_sharded(q, k, v, mesh, axis_name="sp", causal=False,
+                           use_flash=None):
     """Convenience wrapper: shard q/k/v over `axis_name` on the time dim and
     run ring_attention under shard_map.  q,k,v: [B, H, T, D] global."""
     from jax import shard_map
@@ -95,6 +147,7 @@ def ring_attention_sharded(q, k, v, mesh, axis_name="sp", causal=False):
         out_specs=spec,
     )
     def inner(ql, kl, vl):
-        return ring_attention(ql, kl, vl, axis_name, causal=causal)
+        return ring_attention(ql, kl, vl, axis_name, causal=causal,
+                              use_flash=use_flash)
 
     return inner(q, k, v)
