@@ -22,7 +22,8 @@ import numpy as np
 
 class OpDef:
     def __init__(
-        self, type, lower, no_grad_inputs=None, needs_rng=False, side_effect=False
+        self, type, lower, no_grad_inputs=None, needs_rng=False,
+        side_effect=False, handles_selected_rows=False,
     ):
         self.type = type
         self.lower = lower  # fn(ctx, ins: {slot: [arrays]}, attrs) -> {slot: [arrays]}
@@ -31,16 +32,22 @@ class OpDef:
         # side-effecting ops (network sends, barriers) survive DCE even when
         # no fetch depends on their outputs
         self.side_effect = side_effect
+        # ops that natively consume SelectedRows sparse grads (the analog of
+        # the reference kernels specialized on the SELECTED_ROWS var type);
+        # all other ops get inputs densified by the tracer
+        self.handles_selected_rows = handles_selected_rows
 
 
 OPS = {}
 
 
-def register(type_, no_grad_inputs=None, needs_rng=False, side_effect=False):
+def register(type_, no_grad_inputs=None, needs_rng=False, side_effect=False,
+             handles_selected_rows=False):
     """Decorator: register a lowering rule for op `type_`."""
 
     def deco(fn):
-        OPS[type_] = OpDef(type_, fn, no_grad_inputs, needs_rng, side_effect)
+        OPS[type_] = OpDef(type_, fn, no_grad_inputs, needs_rng, side_effect,
+                           handles_selected_rows)
         return fn
 
     return deco

@@ -1,0 +1,78 @@
+"""SelectedRows — the sparse row-subset gradient representation.
+
+TPU-native analog of the reference's ``SelectedRows``
+(``paddle/fluid/framework/selected_rows.h:32``): a (rows, value) pair
+standing for a ``[height, ...]`` tensor that is zero outside ``rows``.
+``lookup_table_grad`` emits one (as ``lookup_table_op.cc`` does), and the
+sparse-aware optimizer lowerings (sgd/adam/adagrad — the reference's
+``operators/optimizers/adam_op.h``/``sgd_op.h`` SelectedRows branches)
+apply segment updates to just the touched rows, so a word2vec/CTR-scale
+vocab never materializes a ``[vocab, dim]`` gradient in HBM.
+
+Registered as a JAX pytree, so it flows through jit/trace like any array
+pair.  Ops that don't declare ``handles_selected_rows`` receive the
+densified tensor automatically (trace-time fallback).
+
+Duplicate ids are legal in ``rows`` (one occurrence per lookup position);
+``merged()`` combines duplicates by summation — required before any
+non-linear optimizer math.  Padding slots use row index == height and are
+dropped by the ``mode="drop"`` scatters.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+class SelectedRows:
+    def __init__(self, rows, value, height):
+        self.rows = rows  # int32 [N]
+        self.value = value  # [N, d...]
+        self.height = int(height)  # static: the dense leading dim
+
+    def tree_flatten(self):
+        return (self.rows, self.value), self.height
+
+    @classmethod
+    def tree_unflatten(cls, height, children):
+        return cls(children[0], children[1], height)
+
+    @property
+    def dtype(self):
+        return self.value.dtype
+
+    @property
+    def dense_shape(self):
+        return (self.height,) + tuple(self.value.shape[1:])
+
+    def densify(self):
+        """Scatter-add into the full [height, ...] tensor."""
+        out = jnp.zeros(self.dense_shape, self.value.dtype)
+        return out.at[self.rows].add(self.value, mode="drop")
+
+    def scaled(self, s):
+        return SelectedRows(self.rows, self.value * s, self.height)
+
+    def astype(self, dtype):
+        return SelectedRows(self.rows, self.value.astype(dtype), self.height)
+
+    def merged(self):
+        """Combine duplicate rows by summation (static [N] shapes: sort,
+        segment-sum into compacted slots; tail padding rows get index ==
+        height, which every consumer scatters with mode='drop')."""
+        n = self.rows.shape[0]
+        if n == 0:
+            return self
+        order = jnp.argsort(self.rows)
+        r = self.rows[order]
+        v = self.value[order]
+        is_new = jnp.concatenate(
+            [jnp.ones((1,), bool), r[1:] != r[:-1]])
+        seg = jnp.cumsum(is_new) - 1  # compacted slot per entry
+        mv = jax.ops.segment_sum(v, seg, num_segments=n)
+        mr = jnp.full((n,), self.height, jnp.int32).at[seg].set(r)
+        return SelectedRows(mr, mv, self.height)
+
+
+def densify_maybe(x):
+    return x.densify() if isinstance(x, SelectedRows) else x

@@ -19,7 +19,8 @@ reads (``ro_state``) are not donated and stay valid across steps.
 import jax
 import jax.numpy as jnp
 
-from .registry import LowerCtx, get_op, lower_grad_op
+from .registry import OPS, LowerCtx, get_op, lower_grad_op
+from .selected_rows import SelectedRows, densify_maybe
 
 
 class _TraceContextError(RuntimeError):
@@ -47,8 +48,6 @@ def dce_mask(program, block_idx, fetch_names):
     def is_persistable(name):
         v = blk._find_var_recursive(name)
         return v is not None and v.persistable
-
-    from .registry import OPS
 
     # test-mode programs (clone(for_test=True)) never run training-only
     # ops, even though those write persistable state (fluid semantics:
@@ -284,11 +283,26 @@ def build_traced_function(program, block_idx, feed_names, fetch_names, scope):
                         vals.append(env[n])
                     ins[slot] = vals
                 try:
-                    if op.type.endswith("_grad") and "__fwd_type__" in op.attrs:
+                    opdef = OPS.get(op.type)
+                    # SelectedRows inputs densify automatically for ops that
+                    # don't declare native support (reference: kernels not
+                    # specialized on SELECTED_ROWS see a dense tensor)
+                    if any(
+                        isinstance(v, SelectedRows)
+                        for vals in ins.values() for v in vals
+                    ) and not (opdef is not None
+                               and opdef.handles_selected_rows):
+                        ins = {
+                            s: [densify_maybe(v) for v in vals]
+                            for s, vals in ins.items()
+                        }
+                    if opdef is not None:
+                        outs = opdef.lower(ctx, ins, op.attrs)
+                    elif (op.type.endswith("_grad")
+                          and "__fwd_type__" in op.attrs):
                         outs = lower_grad_op(ctx, op, ins, op.attrs)
                     else:
-                        opdef = get_op(op.type)
-                        outs = opdef.lower(ctx, ins, op.attrs)
+                        outs = get_op(op.type).lower(ctx, ins, op.attrs)
                 except Exception as e:
                     # PADDLE_ENFORCE-style error context (enforce.h): name
                     # the op and its inputs so a shape/dtype error inside a
@@ -321,8 +335,8 @@ def build_traced_function(program, block_idx, feed_names, fetch_names, scope):
         for n in fetch_names:
             if n not in env:
                 raise RuntimeError("fetch var %s was never produced" % n)
-            fetches.append(env[n])
-        new_state = {n: env[n] for n in updated if n in env}
+            fetches.append(densify_maybe(env[n]))
+        new_state = {n: densify_maybe(env[n]) for n in updated if n in env}
         return fetches, new_state
 
     return TracedFunction(fn, list(feed_names), ro_names, rw_names, fetch_names, updated)

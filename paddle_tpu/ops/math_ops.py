@@ -152,11 +152,18 @@ def _pow(ctx, ins, attrs):
     return {"Out": [jnp.power(ins["X"][0], attrs.get("factor", 1.0))]}
 
 
-@register("scale")
+@register("scale", handles_selected_rows=True)
 def _scale(ctx, ins, attrs):
+    from ..core.selected_rows import SelectedRows
+
     x = ins["X"][0]
     s = attrs.get("scale", 1.0)
     b = attrs.get("bias", 0.0)
+    if isinstance(x, SelectedRows):
+        if b:  # a bias densifies by definition
+            x = x.densify()
+        else:
+            return {"Out": [x.scaled(s)]}
     if attrs.get("bias_after_scale", True):
         return {"Out": [x * s + b]}
     return {"Out": [(x + b) * s]}
@@ -283,9 +290,18 @@ def _mean(ctx, ins, attrs):
     return {"Out": [jnp.mean(ins["X"][0]).reshape(1)]}
 
 
-@register("sum")
+@register("sum", handles_selected_rows=True)
 def _sum_op(ctx, ins, attrs):
+    from ..core.selected_rows import SelectedRows, densify_maybe
+
     xs = ins["X"]
+    if xs and all(isinstance(x, SelectedRows) for x in xs):
+        # grad fan-in of sparse grads stays sparse: concatenate the row
+        # sets (duplicates are fine — consumers merge or scatter-add)
+        rows = jnp.concatenate([x.rows for x in xs])
+        vals = jnp.concatenate([x.value for x in xs])
+        return {"Out": [SelectedRows(rows, vals, xs[0].height)]}
+    xs = [densify_maybe(x) for x in xs]
     out = xs[0]
     for x in xs[1:]:
         out = out + x

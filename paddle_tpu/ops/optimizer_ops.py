@@ -12,6 +12,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.registry import register
+from ..core.selected_rows import SelectedRows
 
 
 def _lr(ins):
@@ -19,9 +20,15 @@ def _lr(ins):
     return lr.reshape(()) if hasattr(lr, "reshape") else lr
 
 
-@register("sgd", no_grad_inputs=("Param", "Grad", "LearningRate"))
+@register("sgd", no_grad_inputs=("Param", "Grad", "LearningRate"),
+          handles_selected_rows=True)
 def _sgd(ctx, ins, attrs):
     p, g = ins["Param"][0], ins["Grad"][0]
+    if isinstance(g, SelectedRows):
+        # sparse branch (sgd_op.h SelectedRows kernel): scatter-add only
+        # the touched rows; duplicates sum linearly so no merge needed
+        upd = -_lr(ins) * g.value.astype(p.dtype)
+        return {"ParamOut": [p.at[g.rows].add(upd, mode="drop")]}
     return {"ParamOut": [p - _lr(ins) * g.astype(p.dtype)]}
 
 
@@ -65,6 +72,7 @@ def _lars_momentum(ctx, ins, attrs):
         "Beta2Pow",
         "LearningRate",
     ),
+    handles_selected_rows=True,
 )
 def _adam(ctx, ins, attrs):
     p, g = ins["Param"][0], ins["Grad"][0]
@@ -74,10 +82,28 @@ def _adam(ctx, ins, attrs):
     beta2 = attrs.get("beta2", 0.999)
     eps = attrs.get("epsilon", 1e-8)
     lr = _lr(ins)
+    lr_t = lr * jnp.sqrt(1 - b2p.reshape(())) / (1 - b1p.reshape(()))
+    if isinstance(g, SelectedRows):
+        # sparse/lazy branch (adam_op.h SelectedRows kernel): moments decay
+        # and update only on the touched rows; duplicates merged first
+        # (non-linear in g).  Padding slots carry row == height -> dropped.
+        mer = g.merged()
+        rows, gv = mer.rows, mer.value.astype(p.dtype)
+        m1r, m2r = m1[rows], m2[rows]
+        m1n = beta1 * m1r + (1 - beta1) * gv
+        m2n = beta2 * m2r + (1 - beta2) * jnp.square(gv)
+        p_out = p.at[rows].add(-lr_t * m1n / (jnp.sqrt(m2n) + eps),
+                               mode="drop")
+        return {
+            "ParamOut": [p_out],
+            "Moment1Out": [m1.at[rows].set(m1n, mode="drop")],
+            "Moment2Out": [m2.at[rows].set(m2n, mode="drop")],
+            "Beta1PowOut": [b1p * beta1],
+            "Beta2PowOut": [b2p * beta2],
+        }
     g = g.astype(p.dtype)
     m1_out = beta1 * m1 + (1 - beta1) * g
     m2_out = beta2 * m2 + (1 - beta2) * jnp.square(g)
-    lr_t = lr * jnp.sqrt(1 - b2p.reshape(())) / (1 - b1p.reshape(()))
     p_out = p - lr_t * m1_out / (jnp.sqrt(m2_out) + eps)
     return {
         "ParamOut": [p_out],
@@ -105,12 +131,24 @@ def _adamax(ctx, ins, attrs):
     return {"ParamOut": [p_out], "MomentOut": [m_out], "InfNormOut": [u_out]}
 
 
-@register("adagrad", no_grad_inputs=("Param", "Grad", "Moment", "LearningRate"))
+@register("adagrad", no_grad_inputs=("Param", "Grad", "Moment", "LearningRate"),
+          handles_selected_rows=True)
 def _adagrad(ctx, ins, attrs):
     p, g, m = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
     eps = attrs.get("epsilon", 1e-6)
+    lr = _lr(ins)
+    if isinstance(g, SelectedRows):
+        # sparse branch (adagrad_op.h SelectedRows kernel): merge duplicate
+        # rows (m update is non-linear), then touch only those rows
+        mer = g.merged()
+        rows, gv = mer.rows, mer.value.astype(p.dtype)
+        m_new = m[rows] + jnp.square(gv)
+        p_out = p.at[rows].add(-lr * gv / (jnp.sqrt(m_new) + eps),
+                               mode="drop")
+        return {"ParamOut": [p_out],
+                "MomentOut": [m.at[rows].set(m_new, mode="drop")]}
     m_out = m + jnp.square(g)
-    p_out = p - _lr(ins) * g / (jnp.sqrt(m_out) + eps)
+    p_out = p - lr * g / (jnp.sqrt(m_out) + eps)
     return {"ParamOut": [p_out], "MomentOut": [m_out]}
 
 

@@ -327,6 +327,33 @@ def _lookup_table(ctx, ins, attrs):
     return {"Out": [out]}
 
 
+@register("lookup_table_grad", handles_selected_rows=True)
+@register("lookup_table_v2_grad", handles_selected_rows=True)
+def _lookup_table_grad(ctx, ins, attrs):
+    """Sparse-aware embedding grad (lookup_table_op.cc grad kernel): with
+    is_sparse the W gradient is emitted as SelectedRows (ids, rows) —
+    never a [vocab, dim] dense tensor — exactly the reference's
+    SELECTED_ROWS output var type (selected_rows.h:32).  Dense mode
+    falls back to the generic vjp lowering."""
+    from ..core.registry import lower_grad_op
+    from ..core.selected_rows import SelectedRows
+
+    fwd_attrs = attrs.get("__fwd_attrs__", {})
+    if not fwd_attrs.get("is_sparse", False):
+        return lower_grad_op(ctx, None, ins, attrs)
+
+    w, ids, og = ins["W"][0], ins["Ids"][0], ins["Out@GRAD"][0]
+    ids = ids.astype(jnp.int32)
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+    rows = ids.reshape(-1)
+    vals = og.reshape(-1, og.shape[-1]).astype(w.dtype)
+    pad = fwd_attrs.get("padding_idx", -1)
+    if pad is not None and pad != -1:
+        vals = jnp.where((rows == pad)[:, None], 0.0, vals)
+    return {"W@GRAD": [SelectedRows(rows, vals, w.shape[0])]}
+
+
 @register("one_hot", no_grad_inputs=("X",))
 def _one_hot(ctx, ins, attrs):
     x = ins["X"][0].astype(jnp.int32)

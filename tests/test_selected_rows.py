@@ -1,0 +1,129 @@
+"""SelectedRows sparse-gradient path (selected_rows.h:32 analog):
+lookup_table_grad emits (rows, values) when is_sparse=True, sgd/adam/
+adagrad consume it via row scatter-updates, and no [vocab, dim] dense
+gradient is ever formed between them."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core.registry import OPS
+from paddle_tpu.core.selected_rows import SelectedRows
+
+
+def test_selected_rows_densify_and_merge():
+    rows = jnp.asarray([2, 0, 2, 5], jnp.int32)
+    vals = jnp.asarray([[1.0, 1.0], [2.0, 2.0], [3.0, 3.0], [4.0, 4.0]])
+    sr = SelectedRows(rows, vals, 6)
+    dense = np.asarray(sr.densify())
+    assert dense.shape == (6, 2)
+    np.testing.assert_allclose(dense[2], [4.0, 4.0])  # duplicates summed
+    np.testing.assert_allclose(dense[0], [2.0, 2.0])
+    np.testing.assert_allclose(dense[5], [4.0, 4.0])
+    np.testing.assert_allclose(dense[1], [0.0, 0.0])
+
+    mer = sr.merged()
+    np.testing.assert_allclose(np.asarray(mer.densify()), dense)
+    # merged has unique real rows; padding slots use index == height
+    r = np.asarray(mer.rows)
+    real = r[r < 6]
+    assert len(real) == len(set(real.tolist())) == 3
+
+
+def _train_embedding(optimizer_ctor, is_sparse, ids_np, vocab, dim, steps=4):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.framework.program_guard(main, startup):
+        startup.random_seed = 7
+        ids = layers.data("ids", shape=[ids_np.shape[1]], dtype="int64")
+        emb = layers.embedding(ids, size=[vocab, dim], is_sparse=is_sparse)
+        loss = layers.mean(layers.pow(layers.reduce_sum(emb, dim=-1), 2.0))
+        optimizer_ctor().minimize(loss)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        losses = []
+        for _ in range(steps):
+            (lv,) = exe.run(main, feed={"ids": ids_np}, fetch_list=[loss])
+            losses.append(float(np.ravel(lv)[0]))
+        w_name = [v.name for v in main.list_vars() if "emb" in v.name.lower()
+                  or "w_0" in v.name][0]
+        w = np.asarray(scope.find_var(w_name))
+    return losses, w
+
+
+@pytest.mark.parametrize("opt", ["sgd", "adagrad"])
+def test_sparse_matches_dense_training(opt):
+    """is_sparse=True trains identically to dense for sgd/adagrad —
+    including duplicate ids in the batch (merge-then-update semantics)."""
+    ctor = {
+        "sgd": lambda: fluid.optimizer.SGD(0.1),
+        "adagrad": lambda: fluid.optimizer.Adagrad(0.1),
+    }[opt]
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 16, (8, 3)).astype("int64")
+    ids[0, :] = 5  # duplicates within one batch
+    l_dense, w_dense = _train_embedding(ctor, False, ids, 16, 4)
+    l_sparse, w_sparse = _train_embedding(ctor, True, ids, 16, 4)
+    np.testing.assert_allclose(l_sparse, l_dense, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(w_sparse, w_dense, rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_adam_matches_dense_when_all_rows_touched():
+    """Sparse adam is the reference's lazy kernel: moments update only on
+    touched rows, so it equals dense adam exactly when every row is hit."""
+    rng = np.random.RandomState(1)
+    vocab = 6
+    ids = np.tile(np.arange(vocab), (4, 1)).astype("int64")  # all rows, dups
+    ctor = lambda: fluid.optimizer.Adam(0.05)
+    l_dense, w_dense = _train_embedding(ctor, False, ids, vocab, 4)
+    l_sparse, w_sparse = _train_embedding(ctor, True, ids, vocab, 4)
+    np.testing.assert_allclose(l_sparse, l_dense, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(w_sparse, w_dense, rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_adam_lazy_rows_untouched():
+    """Rows absent from the batch keep their adam moments (lazy semantics,
+    adam_op.h SelectedRows branch) — and their weights stay put."""
+    ids = np.full((4, 2), 3, "int64")  # only row 3 ever touched
+    _, w = _train_embedding(lambda: fluid.optimizer.Adam(0.1), True, ids,
+                            8, 4, steps=4)
+    _, w0 = _train_embedding(lambda: fluid.optimizer.Adam(0.1), True, ids,
+                             8, 4, steps=0)
+    np.testing.assert_allclose(np.delete(w, 3, axis=0),
+                               np.delete(w0, 3, axis=0))
+    assert not np.allclose(w[3], w0[3])
+
+
+def test_optimizer_receives_selected_rows_not_dense(monkeypatch):
+    """The gradient reaching sgd IS a SelectedRows — i.e. the path
+    lookup_table_grad -> (scale/sum) -> optimizer never densified, so the
+    step graph holds no [vocab, dim] gradient tensor."""
+    seen = []
+    orig = OPS["sgd"].lower
+
+    def probe(ctx, ins, attrs):
+        seen.append(type(ins["Grad"][0]).__name__)
+        return orig(ctx, ins, attrs)
+
+    monkeypatch.setattr(OPS["sgd"], "lower", probe)
+    ids = np.random.RandomState(2).randint(0, 32, (4, 2)).astype("int64")
+    _train_embedding(lambda: fluid.optimizer.SGD(0.1), True, ids, 32, 4,
+                     steps=1)
+    assert "SelectedRows" in seen, seen
+
+
+def test_dense_fallback_for_unaware_optimizer():
+    """An optimizer without a sparse kernel (momentum) still trains via the
+    automatic densify fallback."""
+    ids = np.random.RandomState(3).randint(0, 12, (4, 2)).astype("int64")
+    losses, _ = _train_embedding(
+        lambda: fluid.optimizer.Momentum(0.05, momentum=0.9), True, ids,
+        12, 4)
+    assert all(np.isfinite(losses)), losses
+    l_d, _ = _train_embedding(
+        lambda: fluid.optimizer.Momentum(0.05, momentum=0.9), False, ids,
+        12, 4)
+    np.testing.assert_allclose(losses, l_d, rtol=1e-5, atol=1e-6)
