@@ -17,6 +17,18 @@ from . import framework
 from .executor import global_scope
 from .framework import Parameter, Program
 
+# Serialized-program format version (framework.proto:24 `Version` +
+# framework/version.h analog).  Bump on incompatible __model__ layout
+# changes; the loader accepts every version <= current and refuses newer
+# ones (IsProgramVersionSupported semantics).  Version history:
+#   0 — pre-versioning era (no "version" field in __model__)
+#   1 — adds the version field itself
+PROGRAM_FORMAT_VERSION = 1
+
+
+def is_program_version_supported(version):
+    return 0 <= int(version) <= PROGRAM_FORMAT_VERSION
+
 __all__ = [
     "save_vars",
     "save_params",
@@ -159,6 +171,7 @@ def save_inference_model(
     os.makedirs(dirname, exist_ok=True)
     pruned = main_program.clone(for_test=True)._prune(target_vars)
     meta = {
+        "version": PROGRAM_FORMAT_VERSION,
         "program": pruned.to_json(),
         "feed_names": list(feeded_var_names),
         "fetch_names": [
@@ -174,6 +187,13 @@ def save_inference_model(
 def load_inference_model(dirname, executor, model_filename=None, params_filename=None, scope=None):
     with open(os.path.join(dirname, model_filename or "__model__")) as f:
         meta = json.load(f)
+    version = meta.get("version", 0)  # pre-versioning models load as v0
+    if not is_program_version_supported(version):
+        raise RuntimeError(
+            "saved model format version %s is newer than this build "
+            "supports (<= %d) — upgrade paddle_tpu to load it"
+            % (version, PROGRAM_FORMAT_VERSION)
+        )
     program = Program.from_json(meta["program"])
     load_persistables(executor, dirname, program, filename=params_filename, scope=scope)
     fetch_vars = [program.global_block().var(n) for n in meta["fetch_names"]]

@@ -1,0 +1,64 @@
+"""Serialized-program versioning (framework.proto:24 Version +
+framework/version.h analog): __model__ carries a format version; the
+loader accepts <= current (including the version-less round-2 era as v0)
+and refuses newer formats.  The committed r2-era fixture must keep
+loading in every future round (compat contract)."""
+
+import json
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.io import PROGRAM_FORMAT_VERSION, is_program_version_supported
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "saved_model_r2")
+
+
+def test_version_field_written_and_roundtrips(tmp_path):
+    from paddle_tpu import layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.framework.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        y = layers.fc(x, 2)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        d = str(tmp_path / "m")
+        fluid.save_inference_model(d, ["x"], [y], exe, main_program=main)
+        meta = json.load(open(os.path.join(d, "__model__")))
+        assert meta["version"] == PROGRAM_FORMAT_VERSION
+        prog, feeds, fetches = fluid.load_inference_model(d, exe)
+        out = exe.run(prog, feed={"x": np.ones((1, 4), "float32")},
+                      fetch_list=fetches)
+        assert np.asarray(out[0]).shape == (1, 2)
+
+
+def test_r2_era_versionless_fixture_still_loads():
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        prog, feeds, fetches = fluid.load_inference_model(FIXTURE, exe)
+        xin = np.arange(8, dtype="float32").reshape(2, 4) / 10.0
+        out = exe.run(prog, feed={feeds[0]: xin}, fetch_list=fetches)
+    expected = np.load(FIXTURE + "_expected.npy")
+    np.testing.assert_allclose(np.asarray(out[0]), expected,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_future_version_refused(tmp_path):
+    d = str(tmp_path / "future")
+    shutil.copytree(FIXTURE, d)
+    p = os.path.join(d, "__model__")
+    meta = json.load(open(p))
+    meta["version"] = PROGRAM_FORMAT_VERSION + 1
+    json.dump(meta, open(p, "w"))
+    exe = fluid.Executor(fluid.CPUPlace())
+    with pytest.raises(RuntimeError, match="newer than this build"):
+        fluid.load_inference_model(d, exe)
+    assert not is_program_version_supported(PROGRAM_FORMAT_VERSION + 1)
+    assert is_program_version_supported(0)
