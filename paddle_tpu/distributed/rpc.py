@@ -5,29 +5,167 @@ TPU-native re-design of the reference's gRPC var transport
 send_recv.proto.in): on TPU the data plane is ICI/XLA collectives, so this
 layer only carries the DCN-side control plane — param/grad blocks and sparse
 embedding rows between trainer hosts and parameter servers.  It is a
-length-prefixed binary protocol over TCP (no external deps): each message is
+length-prefixed TYPED binary protocol over TCP (no external deps and no
+arbitrary deserialization — the grpc_serde.cc / send_recv.proto.in role):
 
-    [8-byte big-endian length][pickled (verb, kwargs) payload]
+    [8B big-endian length][1B protocol version][optional 32B HMAC][payload]
 
-with numpy arrays shipped via pickle protocol 5 (zero-copy out-of-band
-buffers are unnecessary at control-plane rates).
+The payload is a closed, recursively-typed encoding (tag byte per value:
+none/bool/int/float/str/bytes/ndarray/list/tuple/dict).  ndarrays ship as
+dtype-string + dims + raw bytes with an allowlisted dtype kind — nothing
+on the wire can name a Python object, so a hostile peer gets a parse
+error, not code execution.  Unknown tags, unknown versions, oversized
+frames, and (when a shared secret is configured via
+``PADDLE_TPU_RPC_HMAC_KEY``) bad MACs are all rejected.
 
 Verbs mirror the reference's SendRecvService (send_recv.proto.in:20-30):
 SendVariable / GetVariable / PrefetchVariable / Barrier / Complete.
 """
 
-import pickle
+import hashlib
+import hmac as hmac_mod
+import os
 import socket
 import socketserver
 import struct
 import threading
 
+import numpy as np
+
 _LEN = struct.Struct(">Q")
+_I64 = struct.Struct(">q")
+_F64 = struct.Struct(">d")
+_U32 = struct.Struct(">I")
+
+PROTO_VERSION = 1
+MAX_FRAME = 1 << 33  # 8 GiB: far above any param block; rejects length bombs
+
+_T_NONE, _T_TRUE, _T_FALSE, _T_INT, _T_FLOAT = b"N", b"T", b"F", b"I", b"D"
+_T_STR, _T_BYTES, _T_ARRAY, _T_LIST, _T_TUPLE, _T_DICT = (
+    b"S", b"B", b"A", b"L", b"U", b"M")
+
+# dtype kinds a peer may ship: bool, (u)int, float, complex — never object
+_DTYPE_KINDS = frozenset("biufc")
+
+
+def _hmac_key():
+    key = os.environ.get("PADDLE_TPU_RPC_HMAC_KEY", "")
+    return key.encode() if key else None
+
+
+def _encode(obj, out):
+    if obj is None:
+        out += _T_NONE
+    elif obj is True:
+        out += _T_TRUE
+    elif obj is False:
+        out += _T_FALSE
+    elif isinstance(obj, (int, np.integer)):
+        try:
+            out += _T_INT + _I64.pack(int(obj))
+        except struct.error:
+            raise TypeError("rpc int %r exceeds 64 bits" % (obj,))
+    elif isinstance(obj, (float, np.floating)):
+        out += _T_FLOAT + _F64.pack(float(obj))
+    elif isinstance(obj, str):
+        b = obj.encode("utf-8")
+        out += _T_STR + _U32.pack(len(b)) + b
+    elif isinstance(obj, bytes):
+        out += _T_BYTES + _U32.pack(len(obj)) + obj
+    elif isinstance(obj, (list, tuple)):
+        out += (_T_LIST if isinstance(obj, list) else _T_TUPLE)
+        out += _U32.pack(len(obj))
+        for v in obj:
+            _encode(v, out)
+    elif isinstance(obj, dict):
+        out += _T_DICT + _U32.pack(len(obj))
+        for k, v in obj.items():
+            if not isinstance(k, str):
+                raise TypeError("rpc dict keys must be str, got %r" % (k,))
+            _encode(k, out)
+            _encode(v, out)
+    else:
+        # arrays last: jax/np duck-typed values normalize through asarray
+        arr = np.ascontiguousarray(np.asarray(obj))
+        if arr.dtype.kind not in _DTYPE_KINDS:
+            raise TypeError(
+                "rpc cannot ship dtype %s (kind %r)" % (arr.dtype, arr.dtype.kind))
+        ds = arr.dtype.str.encode("ascii")
+        out += _T_ARRAY + _U32.pack(len(ds)) + ds + bytes([arr.ndim])
+        for d in arr.shape:
+            out += _I64.pack(d)
+        out += _LEN.pack(arr.nbytes)  # u64: param blocks can exceed 4 GiB
+        out += arr.tobytes()
+    return out
+
+
+class _Reader:
+    __slots__ = ("buf", "pos")
+
+    def __init__(self, buf):
+        self.buf = memoryview(buf)
+        self.pos = 0
+
+    def take(self, n):
+        if self.pos + n > len(self.buf):
+            raise ValueError("rpc frame truncated")
+        v = self.buf[self.pos:self.pos + n]
+        self.pos += n
+        return v
+
+    def decode(self):
+        tag = bytes(self.take(1))
+        if tag == _T_NONE:
+            return None
+        if tag == _T_TRUE:
+            return True
+        if tag == _T_FALSE:
+            return False
+        if tag == _T_INT:
+            return _I64.unpack(self.take(8))[0]
+        if tag == _T_FLOAT:
+            return _F64.unpack(self.take(8))[0]
+        if tag == _T_STR:
+            (n,) = _U32.unpack(self.take(4))
+            return bytes(self.take(n)).decode("utf-8")
+        if tag == _T_BYTES:
+            (n,) = _U32.unpack(self.take(4))
+            return bytes(self.take(n))
+        if tag in (_T_LIST, _T_TUPLE):
+            (n,) = _U32.unpack(self.take(4))
+            items = [self.decode() for _ in range(n)]
+            return items if tag == _T_LIST else tuple(items)
+        if tag == _T_DICT:
+            (n,) = _U32.unpack(self.take(4))
+            out = {}
+            for _ in range(n):
+                k = self.decode()
+                if not isinstance(k, str):
+                    raise ValueError("rpc dict key must decode to str")
+                out[k] = self.decode()
+            return out
+        if tag == _T_ARRAY:
+            (dn,) = _U32.unpack(self.take(4))
+            dtype = np.dtype(bytes(self.take(dn)).decode("ascii"))
+            if dtype.kind not in _DTYPE_KINDS:
+                raise ValueError("rpc refuses dtype %s" % dtype)
+            ndim = bytes(self.take(1))[0]
+            shape = tuple(_I64.unpack(self.take(8))[0] for _ in range(ndim))
+            (nbytes,) = _LEN.unpack(self.take(8))
+            expect = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+            if nbytes != expect:
+                raise ValueError("rpc array payload size mismatch")
+            data = self.take(nbytes)
+            return np.frombuffer(data, dtype=dtype).reshape(shape).copy()
+        raise ValueError("rpc unknown type tag %r" % tag)
 
 
 def _send_msg(sock, obj):
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(_LEN.pack(len(payload)) + payload)
+    payload = bytes(_encode(obj, bytearray()))
+    key = _hmac_key()
+    mac = hmac_mod.new(key, payload, hashlib.sha256).digest() if key else b""
+    head = bytes([PROTO_VERSION]) + mac
+    sock.sendall(_LEN.pack(len(head) + len(payload)) + head + payload)
 
 
 def _recv_exact(sock, n):
@@ -42,7 +180,29 @@ def _recv_exact(sock, n):
 
 def _recv_msg(sock):
     (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
-    return pickle.loads(_recv_exact(sock, n))
+    if n < 1 or n > MAX_FRAME:
+        raise ValueError("rpc frame length %d out of bounds" % n)
+    frame = _recv_exact(sock, n)
+    version = frame[0]
+    if version != PROTO_VERSION:
+        raise ValueError(
+            "rpc protocol version %d unsupported (want %d)"
+            % (version, PROTO_VERSION))
+    body = frame[1:]
+    key = _hmac_key()
+    if key:
+        if len(body) < 32:
+            raise ValueError("rpc frame missing MAC")
+        mac, body = body[:32], body[32:]
+        want = hmac_mod.new(key, body, hashlib.sha256).digest()
+        if not hmac_mod.compare_digest(mac, want):
+            raise ValueError("rpc MAC verification failed")
+    r = _Reader(body)
+    obj = r.decode()
+    if r.pos != len(r.buf):
+        raise ValueError("rpc frame has %d trailing bytes"
+                         % (len(r.buf) - r.pos))
+    return obj
 
 
 class _InFlight:
@@ -94,7 +254,10 @@ class _Handler(socketserver.BaseRequestHandler):
                     entry.done.wait()
                 result = entry.result
                 _send_msg(self.request, result)
-        except (ConnectionError, EOFError):
+        except (ConnectionError, EOFError, ValueError):
+            # ValueError = malformed/hostile frame (bad tag, bad version,
+            # bad MAC, length bomb): the framing can no longer be trusted,
+            # so drop this connection; the server keeps serving others
             return
 
 
@@ -252,6 +415,13 @@ class RPCClient:
                         drop_sock()
                         if attempt >= 1:
                             raise
+                    except ValueError:
+                        # protocol violation (bad version/tag/length from
+                        # the peer): the stream may be mid-frame, so the
+                        # cached connection is desynced — drop it and
+                        # surface immediately (not transient, no retry)
+                        drop_sock()
+                        raise
                     except (ConnectionError, OSError) as e:
                         last = e
                         drop_sock()
