@@ -101,6 +101,118 @@ def gpipe(stage_fn, mesh, axis="pp", n_microbatches=None):
     return _pipelined
 
 
+def one_f_one_b(stage_fn, loss_fn, mesh, axis="pp", n_microbatches=None):
+    """1F1B pipelined TRAIN step: fn(stacked_params, x, targets) ->
+    (loss, stacked_grads).
+
+    Where `gpipe` + jax.grad stashes every microbatch's activations
+    (O(M) per stage), this hand-scheduled 1F1B runs each microbatch's
+    backward as soon as its forward has cleared the pipe, keeping only a
+    circular buffer of 2S-1 in-flight stage inputs (O(S), independent of
+    M).  Schedule (per device `s`, microbatch `m`, both slots every tick):
+
+        forward  F(s, m) at tick m + s
+        backward B(s, m) at tick m + 2S - 1 - s    (warmup, steady, drain)
+
+    so ticks = M + 2S - 1 and stage s holds at most 2(S-s)-1 in-flight
+    microbatches.  stage_fn(params, x_mb) -> y_mb as in `gpipe`;
+    loss_fn(y_mb, target_mb) -> scalar (per-microbatch; the step returns
+    their mean and grads of that mean).  Gradients accumulate across
+    microbatches on each stage's device; the return is a pytree shaped
+    like stacked_params (leading stage dim, sharded over `axis`).
+    """
+    S = mesh.shape[axis]
+
+    def _step(stacked_params, x, targets):
+        M = n_microbatches or S
+        B = x.shape[0]
+        assert B % M == 0, "batch %d must divide microbatches %d" % (B, M)
+        mb = B // M
+        xm = x.reshape((M, mb) + x.shape[1:])
+        tm = targets.reshape((M, mb) + targets.shape[1:])
+        buf_n = 2 * S - 1
+
+        def per_device(params, xm_local, tm_local):
+            params = jax.tree_util.tree_map(lambda p: p[0], params)
+            idx = jax.lax.axis_index(axis)
+            ticks = M + 2 * S - 1
+            zero = jnp.zeros_like(xm_local[0])
+            fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+            bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+
+            def vary(v):
+                if axis in getattr(jax.typeof(v), "vma", frozenset()):
+                    return v  # already device-varying (e.g. from params)
+                return jax.lax.pcast(v, axis, to="varying")
+
+            grad0 = jax.tree_util.tree_map(jnp.zeros_like, params)
+            act_buf0 = jnp.zeros((buf_n,) + zero.shape, zero.dtype)
+
+            def tick(carry, t):
+                fwd_recv, bwd_recv, act_buf, grad_acc, loss_acc = carry
+
+                # backward residual must be read BEFORE the forward slot
+                # writes: for stage 0, B(0, m) and F(0, m + 2S-1) share a
+                # tick and a buffer slot (in-flight count == buf size)
+                m_b = t - (2 * S - 1 - idx)
+                do_b = jnp.logical_and(m_b >= 0, m_b < M)
+                slot_b = jnp.clip(m_b, 0, M - 1) % buf_n
+                x_res = act_buf[slot_b]
+
+                # ---- forward slot: F(idx, m_f) at t = m_f + idx ----
+                m_f = t - idx
+                do_f = jnp.logical_and(m_f >= 0, m_f < M)
+                inject = xm_local[jnp.clip(m_f, 0, M - 1)]
+                x_in = jnp.where(idx == 0, inject, fwd_recv)
+                y = stage_fn(params, x_in)
+                # stash this stage's input for the microbatch's backward
+                slot_f = jnp.clip(m_f, 0, M - 1) % buf_n
+                act_buf = jnp.where(
+                    do_f, act_buf.at[slot_f].set(x_in), act_buf)
+                fwd_send = jax.lax.ppermute(y, axis, fwd_perm)
+
+                # ---- backward slot: B(idx, m_b) at t = m_b + 2S-1-idx ----
+                y_res, vjp = jax.vjp(lambda p, xx: stage_fn(p, xx),
+                                     params, x_res)
+                tgt = tm_local[jnp.clip(m_b, 0, M - 1)]
+                loss_mb, dloss = jax.value_and_grad(
+                    lambda yy: loss_fn(yy, tgt))(y_res)
+                dy = jnp.where(idx == S - 1, dloss / M, bwd_recv)
+                dparams, dx = vjp(dy)
+                # jnp.where (not a mask multiply) so each leaf keeps its own
+                # dtype — mixed-precision params must not promote the carry
+                grad_acc = jax.tree_util.tree_map(
+                    lambda a, d: jnp.where(do_b, a + d, a), grad_acc, dparams)
+                loss_acc = loss_acc + jnp.where(
+                    jnp.logical_and(do_b, idx == S - 1), loss_mb, 0.0)
+                bwd_send = jax.lax.ppermute(
+                    jnp.where(do_b, dx, jnp.zeros_like(dx)), axis, bwd_perm)
+
+                return (fwd_send, bwd_send, act_buf, grad_acc, loss_acc), None
+
+            init = (vary(zero), vary(zero), vary(act_buf0),
+                    jax.tree_util.tree_map(vary, grad0),
+                    vary(jnp.zeros((), zero.dtype)))
+            (_, _, _, grad_acc, loss_acc), _ = jax.lax.scan(
+                tick, init, jnp.arange(ticks))
+            # mean loss lives on the last stage; broadcast to all
+            loss = jax.lax.psum(loss_acc, axis) / M
+            grads = jax.tree_util.tree_map(lambda g: g[None], grad_acc)
+            return loss, grads
+
+        from jax import shard_map
+
+        spec_params = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+        return shard_map(
+            per_device,
+            mesh=mesh,
+            in_specs=(spec_params, P(), P()),
+            out_specs=(P(), spec_params),
+        )(stacked_params, xm, tm)
+
+    return _step
+
+
 def pipeline_mlp_stages(widths, dtype=jnp.float32):
     """Convenience: equal-width MLP stages for tests/dryrun.  widths is the
     shared layer width; returns (stage_fn, params_list builder output)."""
