@@ -52,11 +52,15 @@ class CheckpointConfig:
         max_num_checkpoints=3,
         epoch_interval=1,
         step_interval=10,
+        pserver_endpoints=None,
     ):
         self.checkpoint_dir = checkpoint_dir or "checkpoint"
         self.max_num_checkpoints = max_num_checkpoints
         self.epoch_interval = max(1, int(epoch_interval))
         self.step_interval = max(1, int(step_interval))
+        # pserver-mode training: endpoints to checkpoint_notify in step
+        # with trainer checkpoints (checkpoint_notify_op.cc analog)
+        self.pserver_endpoints = list(pserver_endpoints or ())
         # populated on resume
         self.epoch_id = 0
         self.step_id = 0
@@ -81,10 +85,17 @@ def _serial_dirs(root):
 
 def save_checkpoint(
     executor, checkpoint_dir, main_program, trainer_args=None,
-    max_num_checkpoints=3, scope=None,
+    max_num_checkpoints=3, scope=None, pserver_endpoints=None,
 ):
     """Persistables + trainer state into the next serial dir; prune old
-    serials (save_checkpoint :664)."""
+    serials (save_checkpoint :664).
+
+    pserver_endpoints: when training in pserver mode, the trainer asks
+    every parameter server to snapshot its shard into this serial's
+    directory in the same call — the checkpoint_notify path
+    (checkpoint_notify_op.cc; reference contrib/trainer.py:1013
+    _save_pserver_vars_by_notify) — so trainer and pserver state stay
+    consistent instead of relying on the pservers' own timers."""
     serials = _serial_dirs(checkpoint_dir)
     serial = serials[-1][0] + 1 if serials else 0
     cur = os.path.join(checkpoint_dir, _SERIAL_PREFIX + str(serial))
@@ -92,6 +103,10 @@ def save_checkpoint(
     io.save_persistables(executor, cur, main_program, scope=scope)
     with open(os.path.join(cur, _TRAINER_STATE_FILE), "w") as f:
         json.dump(trainer_args or {}, f)
+    for ep in pserver_endpoints or ():
+        from ..distributed.rpc import RPCClient
+
+        RPCClient.get(ep).checkpoint_notify(dir=os.path.abspath(cur))
     for old_serial, path in _serial_dirs(checkpoint_dir)[:-max_num_checkpoints]:
         shutil.rmtree(path, ignore_errors=True)
     return serial
@@ -218,6 +233,7 @@ class Trainer:
             trainer_args={"epoch_id": epoch_id, "step_id": step_id},
             max_num_checkpoints=self.checkpoint_cfg.max_num_checkpoints,
             scope=self.scope,
+            pserver_endpoints=self.checkpoint_cfg.pserver_endpoints,
         )
 
     def save_params(self, param_path):

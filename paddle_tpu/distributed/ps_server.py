@@ -105,24 +105,26 @@ class ParameterServer:
             },
         }
 
-    def _write_snapshot(self, data):
+    def _write_snapshot(self, data, dir=None):
         """Atomic write-tmp + rename (the Go pserver's crc+rename
-        discipline); runs OFF the service lock."""
+        discipline); runs OFF the service lock.  `dir` overrides the
+        server's own checkpoint_dir for trainer-requested snapshots."""
         import os
         import pickle
 
-        os.makedirs(self.checkpoint_dir, exist_ok=True)
-        path = self._ckpt_path()
+        target = dir or self.checkpoint_dir
+        os.makedirs(target, exist_ok=True)
+        path = os.path.join(target, "pserver_%d.ckpt" % self.server_idx)
         tmp = path + ".tmp"
         with self._ckpt_write_lock:
             with open(tmp, "wb") as f:
                 pickle.dump(data, f, protocol=pickle.HIGHEST_PROTOCOL)
             os.replace(tmp, path)
 
-    def save_checkpoint(self):
-        if not self.checkpoint_dir:
+    def save_checkpoint(self, dir=None):
+        if not (dir or self.checkpoint_dir):
             return False
-        self._write_snapshot(self._snapshot())
+        self._write_snapshot(self._snapshot(), dir=dir)
         return True
 
     def load_checkpoint(self):
@@ -277,11 +279,12 @@ class ParameterServer:
         return {"ok": True}
 
     def _h_checkpoint_notify(self, dir=None, trainer_id=0):
-        """Trainer-initiated checkpoint (checkpoint_notify_op.cc analog)."""
+        """Trainer-initiated checkpoint (checkpoint_notify_op.cc analog).
+        Snapshots into the REQUESTED dir without adopting it — the
+        server's own periodic checkpoints keep their configured home, so
+        they never overwrite (or resurrect) a trainer serial dir."""
         with self._lock:
-            if dir:
-                self.checkpoint_dir = dir
-            ok = self.save_checkpoint()
+            ok = self.save_checkpoint(dir=dir)
         return {"ok": bool(ok), "round": self._round}
 
     def _h_complete(self, trainer_id=0):

@@ -1,5 +1,5 @@
-"""Expert parallelism (ep axis): Switch-style top-1 MoE with dense
-capacity-bucketed dispatch and all-to-all expert exchange.
+"""Expert parallelism (ep axis): Switch (top-1) / GShard (top-2) MoE with
+dense capacity-bucketed dispatch and all-to-all expert exchange.
 
 The reference has no MoE; this completes the parallelism set (dp/mp/pp/
 sp/ep) the TPU-native way: gating and dispatch are dense one-hot einsums
@@ -14,7 +14,10 @@ routes its local tokens into per-expert capacity buckets [E, C, D], the
 all-to-all regroups to [E_local, S*C, D] so every device runs only its
 experts, and the reverse all-to-all + combine einsum scatter the results
 back to token order.  Tokens over capacity are dropped (standard; raise
-capacity_factor to trade memory for coverage).
+capacity_factor to trade memory for coverage) and the DROPPED FRACTION is
+returned as a metric so silent drops are observable.  top_k=2 gives
+GShard gating: second-choice routing with gates renormalized over the
+chosen pair and capacity positions assigned first-choice-first.
 """
 
 import jax
@@ -28,43 +31,70 @@ from .pipeline import stack_stage_params as _stack_params
 stack_expert_params = _stack_params
 
 
-def _dispatch_tensors(xl, gate_w, n_experts, capacity):
-    """Top-1 routing of local tokens: returns (dispatch [B,E,C] one-hot,
-    combine [B,E,C] prob-weighted, aux load-balance loss).
+def _dispatch_tensors(xl, gate_w, n_experts, capacity, top_k=1):
+    """Top-k routing of local tokens: returns (dispatch [B,E,C] one-hot,
+    combine [B,E,C] prob-weighted, aux load-balance loss, dropped
+    fraction of routing decisions).
 
     Routing bookkeeping (one-hots, cumsum positions) runs in float32
     regardless of the activation dtype: a bf16 cumsum goes inexact past
     256 tokens-per-expert and would silently double-book bucket slots."""
     logits = (xl @ gate_w).astype(jnp.float32)  # [B, E]
     probs = jax.nn.softmax(logits, axis=-1)
-    expert = jnp.argmax(probs, axis=-1)  # [B]
-    gate = jnp.max(probs, axis=-1)  # [B]
-    onehot = jax.nn.one_hot(expert, n_experts, dtype=jnp.float32)  # [B, E]
-    # position of each token inside its expert's bucket (among local tokens)
-    pos = jnp.cumsum(onehot, axis=0) * onehot - onehot  # [B, E], int-valued
-    in_cap = (pos < capacity).astype(jnp.float32) * onehot
-    pos_oh = jax.nn.one_hot(
-        jnp.sum(pos * onehot, axis=-1).astype(jnp.int32), capacity,
-        dtype=jnp.float32,
-    )  # [B, C]
-    dispatch = (in_cap[:, :, None] * pos_oh[:, None, :]).astype(xl.dtype)
-    combine = dispatch * gate[:, None, None].astype(xl.dtype)
-    # Switch aux loss: E * sum_e fraction_routed_e * mean_prob_e
-    frac = jnp.mean(onehot, axis=0)
+
+    onehots, gates = [], []
+    masked = probs
+    for _ in range(top_k):
+        expert = jnp.argmax(masked, axis=-1)  # [B]
+        oh = jax.nn.one_hot(expert, n_experts, dtype=jnp.float32)
+        gates.append(jnp.sum(probs * oh, axis=-1))
+        onehots.append(oh)
+        masked = masked * (1.0 - oh)
+    if top_k > 1:  # GShard: renormalize gates over the selected experts
+        denom = sum(gates) + 1e-9
+        gates = [g / denom for g in gates]
+
+    dispatch = jnp.zeros((xl.shape[0], n_experts, capacity), jnp.float32)
+    combine = jnp.zeros_like(dispatch)
+    counts = jnp.zeros((n_experts,), jnp.float32)
+    routed = kept = 0.0
+    for oh, gate in zip(onehots, gates):
+        # bucket positions: later choices queue behind every earlier
+        # choice's assignments for that expert (GShard priority order)
+        pos = jnp.cumsum(oh, axis=0) * oh - oh + counts[None, :] * oh
+        in_cap = (pos < capacity).astype(jnp.float32) * oh
+        pos_oh = jax.nn.one_hot(
+            jnp.sum(pos * oh, axis=-1).astype(jnp.int32), capacity,
+            dtype=jnp.float32,
+        )  # [B, C]
+        d = in_cap[:, :, None] * pos_oh[:, None, :]
+        dispatch = dispatch + d
+        combine = combine + d * gate[:, None, None]
+        counts = counts + jnp.sum(oh, axis=0)
+        routed = routed + jnp.sum(oh)
+        kept = kept + jnp.sum(in_cap)
+    dropped = 1.0 - kept / jnp.maximum(routed, 1.0)
+
+    # Switch aux loss on first-choice routing:
+    # E * sum_e fraction_routed_e * mean_prob_e
+    frac = jnp.mean(onehots[0], axis=0)
     mean_p = jnp.mean(probs, axis=0)
     aux = n_experts * jnp.sum(frac * mean_p)
-    return dispatch, combine, aux
+    return dispatch.astype(xl.dtype), combine.astype(xl.dtype), aux, dropped
 
 
-def switch_moe(expert_fn, mesh, axis="ep", capacity_factor=1.0):
+def switch_moe(expert_fn, mesh, axis="ep", capacity_factor=1.0, top_k=1):
     """Build an expert-parallel MoE apply:
-    fn(gate_w, stacked_expert_params, x) -> (y, aux_loss).
+    fn(gate_w, stacked_expert_params, x) -> (y, aux_loss, dropped_frac).
 
     expert_fn(params, h) -> h' applies ONE expert to a [N, D] token block.
     gate_w: [D, E] router weights (replicated).  stacked_expert_params:
     leaves [E, ...] (see stack_expert_params), sharded over `axis` so each
     device holds E/S experts.  x: [B, D] global tokens, sharded over
     `axis` on the batch dim (data-parallel across the expert group).
+    top_k=1 is Switch routing; top_k=2 is GShard.  dropped_frac is the
+    mesh-mean fraction of routing decisions that overflowed capacity —
+    fetch it alongside aux_loss to see silent drops.
     """
     S = mesh.shape[axis]
 
@@ -74,10 +104,11 @@ def switch_moe(expert_fn, mesh, axis="ep", capacity_factor=1.0):
         B = x.shape[0]
         assert B % S == 0, "tokens %d must divide ep axis %d" % (B, S)
         Bl = B // S
-        capacity = max(1, int(capacity_factor * Bl / E + 0.9999))
+        capacity = max(1, int(capacity_factor * top_k * Bl / E + 0.9999))
 
         def per_device(gate_w, params_local, xl):
-            dispatch, combine, aux = _dispatch_tensors(xl, gate_w, E, capacity)
+            dispatch, combine, aux, dropped = _dispatch_tensors(
+                xl, gate_w, E, capacity, top_k)
             # bucket local tokens per expert: [E, C, D]
             expert_in = jnp.einsum("bec,bd->ecd", dispatch, xl)
             # all-to-all: every device keeps only its experts' buckets and
@@ -92,29 +123,31 @@ def switch_moe(expert_fn, mesh, axis="ep", capacity_factor=1.0):
             )
             yl = jnp.einsum("bec,ecd->bd", combine, out)
             aux = jax.lax.pmean(aux, axis)
-            return yl, aux
+            dropped = jax.lax.pmean(dropped, axis)
+            return yl, aux, dropped
 
         from jax import shard_map
 
         spec_params = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
-        y, aux = shard_map(
+        y, aux, dropped = shard_map(
             per_device,
             mesh=mesh,
             in_specs=(P(), spec_params, P(axis)),
-            out_specs=(P(axis), P()),
+            out_specs=(P(axis), P(), P()),
         )(gate_w, stacked_params, x)
-        return y, aux
+        return y, aux, dropped
 
     return _apply
 
 
-def moe_reference(expert_fn, gate_w, params_list, x, capacity):
+def moe_reference(expert_fn, gate_w, params_list, x, capacity, top_k=1):
     """Single-device reference with identical routing/capacity semantics
     (for parity tests): same dense dispatch, no collectives."""
     E = gate_w.shape[-1]
-    dispatch, combine, aux = _dispatch_tensors(x, gate_w, E, capacity)
+    dispatch, combine, aux, dropped = _dispatch_tensors(
+        x, gate_w, E, capacity, top_k)
     expert_in = jnp.einsum("bec,bd->ecd", dispatch, x)
     outs = jnp.stack(
         [expert_fn(p, expert_in[e]) for e, p in enumerate(params_list)], 0
     )
-    return jnp.einsum("bec,ecd->bd", combine, outs), aux
+    return jnp.einsum("bec,ecd->bd", combine, outs), aux, dropped

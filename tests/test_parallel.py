@@ -213,7 +213,7 @@ def test_switch_moe_matches_reference_and_balances():
     x = jax.random.normal(jax.random.PRNGKey(6), (B, D))
 
     run = moe_mod.switch_moe(expert_fn, mesh, "ep", capacity_factor=2.0)
-    y, aux = run(gate_w, stacked, x)
+    y, aux, dropped = run(gate_w, stacked, x)
     assert y.shape == (B, D)
     assert np.isfinite(np.asarray(y)).all()
     assert 0.5 < float(aux) < 4.0
@@ -222,17 +222,20 @@ def test_switch_moe_matches_reference_and_balances():
     # (each B/4 token slice routes independently with the same capacity)
     Bl = B // 4
     capacity = max(1, int(2.0 * Bl / E + 0.9999))
-    outs = []
+    outs, drops = [], []
     for s in range(4):
-        ys, _ = moe_mod.moe_reference(
+        ys, _, dr = moe_mod.moe_reference(
             expert_fn, gate_w, params_list, x[s * Bl:(s + 1) * Bl], capacity
         )
         outs.append(ys)
+        drops.append(float(dr))
     ref = jnp.concatenate(outs, axis=0)
     np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=2e-4, atol=2e-5)
+    # the surfaced dropped fraction is the mesh-mean of per-shard drops
+    np.testing.assert_allclose(float(dropped), np.mean(drops), atol=1e-6)
 
     def loss(gw, sp):
-        yy, aa = run(gw, sp, x)
+        yy, aa, _ = run(gw, sp, x)
         return jnp.sum(yy ** 2) + 0.01 * aa
 
     g_gate, g_exp = jax.grad(loss, argnums=(0, 1))(gate_w, stacked)
@@ -258,7 +261,9 @@ def test_switch_moe_capacity_drops_tokens():
     gate_w = jnp.tile(jnp.array([[5.0, -5.0]]), (D, 1))
     x = jnp.ones((B, D))
     run = moe_mod.switch_moe(expert_fn, mesh, "ep", capacity_factor=0.5)
-    y, _ = run(gate_w, stacked, x)
+    y, _, dropped = run(gate_w, stacked, x)
+    # 4 of 16 routing decisions survive -> dropped fraction 0.75, surfaced
+    np.testing.assert_allclose(float(dropped), 0.75, atol=1e-6)
     y = np.asarray(y)
     # capacity = ceil(0.5 * 8 / 2) = 2 per expert per shard: 2 tokens per
     # shard survive, the rest are dropped to exact zeros
@@ -460,3 +465,127 @@ def test_ring_attention_grads_dense_path():
     for a, b in zip(gf, gd):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-3, atol=2e-4)
+
+
+def test_one_f_one_b_matches_sequential_and_gpipe():
+    """1F1B train step: loss + stacked grads match the sequential reference
+    (and therefore gpipe+jax.grad) exactly."""
+    from paddle_tpu.parallel import pipeline as pp
+
+    mesh = parallel.make_mesh({"pp": 4})
+    S, M, mb, d = 4, 8, 2, 8
+    stage_fn, init_stage = pp.pipeline_mlp_stages(d)
+    keys = jax.random.split(jax.random.PRNGKey(0), S)
+    params_list = [init_stage(k) for k in keys]
+    stacked = pp.stack_stage_params(params_list)
+    x = jnp.asarray(np.random.RandomState(1).rand(M * mb, d).astype("float32"))
+    t = jnp.asarray(np.random.RandomState(2).rand(M * mb, d).astype("float32"))
+
+    def loss_fn(y_mb, t_mb):
+        return jnp.sum((y_mb - t_mb) ** 2)
+
+    step = pp.one_f_one_b(stage_fn, loss_fn, mesh, "pp", n_microbatches=M)
+    loss_pp, grads_pp = step(stacked, x, t)
+
+    def ref(stacked, x, t):
+        y = x
+        for s in range(S):
+            p = jax.tree_util.tree_map(lambda v: v[s], stacked)
+            y = stage_fn(p, y)
+        return jnp.sum((y - t) ** 2) / M
+
+    loss_ref, grads_ref = jax.value_and_grad(ref)(stacked, x, t)
+    np.testing.assert_allclose(float(loss_pp), float(loss_ref),
+                               rtol=1e-5, atol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(grads_pp),
+                    jax.tree_util.tree_leaves(grads_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_one_f_one_b_lower_activation_memory_than_gpipe():
+    """The 1F1B step's compiled peak/temp memory stays flat as M grows,
+    while gpipe+jax.grad stashes O(M) activations."""
+    from paddle_tpu.parallel import pipeline as pp
+
+    mesh = parallel.make_mesh({"pp": 4})
+    S, mb, d = 4, 4, 32
+    stage_fn, init_stage = pp.pipeline_mlp_stages(d)
+    stacked = pp.stack_stage_params(
+        [init_stage(k) for k in jax.random.split(jax.random.PRNGKey(0), S)])
+
+    def loss_fn(y_mb, t_mb):
+        return jnp.sum((y_mb - t_mb) ** 2)
+
+    def temp_bytes(M):
+        x = jnp.zeros((M * mb, d), jnp.float32)
+        step = pp.one_f_one_b(stage_fn, loss_fn, mesh, "pp",
+                              n_microbatches=M)
+        c = jax.jit(step).lower(stacked, x, x).compile()
+        ma = c.memory_analysis()
+        if ma is None:
+            pytest.skip("backend exposes no memory analysis")
+        return ma.temp_size_in_bytes
+
+    def temp_bytes_gpipe(M):
+        x = jnp.zeros((M * mb, d), jnp.float32)
+        fwd = pp.gpipe(stage_fn, mesh, "pp", n_microbatches=M)
+
+        def step(stacked, x, t):
+            return jnp.sum((fwd(stacked, x) - t) ** 2) / M
+
+        c = jax.jit(jax.value_and_grad(step)).lower(stacked, x, x).compile()
+        ma = c.memory_analysis()
+        if ma is None:
+            pytest.skip("backend exposes no memory analysis")
+        return ma.temp_size_in_bytes
+
+    # growth factor from M=8 to M=32: 1F1B should stay ~flat; gpipe grows
+    f1 = temp_bytes(32) / max(temp_bytes(8), 1)
+    gp = temp_bytes_gpipe(32) / max(temp_bytes_gpipe(8), 1)
+    assert f1 < gp, (f1, gp)
+    assert f1 < 2.0, f1  # flat-ish in M
+
+
+def test_gshard_top2_moe_matches_reference_and_reports_drops():
+    """top_k=2 (GShard) routing: expert-parallel output matches the dense
+    reference per shard; gates renormalize over the chosen pair; the
+    dropped-fraction metric is exact."""
+    from paddle_tpu.parallel import moe as moe_mod
+
+    mesh = parallel.make_mesh({"ep": 4}, devices=jax.devices()[:4])
+    E, D, B = 8, 16, 32
+
+    def expert_fn(p, h):
+        return jnp.tanh(h @ p["w"]) @ p["wo"]
+
+    keys = jax.random.split(jax.random.PRNGKey(14), E)
+    params_list = [
+        {"w": jax.random.normal(k, (D, 32)) * 0.25,
+         "wo": jax.random.normal(jax.random.fold_in(k, 1), (32, D)) * 0.25}
+        for k in keys
+    ]
+    stacked = moe_mod.stack_expert_params(params_list)
+    gate_w = jax.random.normal(jax.random.PRNGKey(15), (D, E)) * 0.1
+    x = jax.random.normal(jax.random.PRNGKey(16), (B, D))
+
+    run = moe_mod.switch_moe(expert_fn, mesh, "ep", capacity_factor=2.0,
+                             top_k=2)
+    y, aux, dropped = run(gate_w, stacked, x)
+    assert np.isfinite(np.asarray(y)).all() and 0.0 <= float(dropped) <= 1.0
+
+    Bl = B // 4
+    capacity = max(1, int(2.0 * 2 * Bl / E + 0.9999))
+    outs = []
+    for s in range(4):
+        ys, _, _ = moe_mod.moe_reference(
+            expert_fn, gate_w, params_list, x[s * Bl:(s + 1) * Bl],
+            capacity, top_k=2)
+        outs.append(ys)
+    np.testing.assert_allclose(np.asarray(y),
+                               np.asarray(jnp.concatenate(outs, 0)),
+                               rtol=2e-4, atol=2e-5)
+
+    # grads flow through the top-2 dispatch
+    g = jax.grad(lambda gw: jnp.sum(run(gw, stacked, x)[0] ** 2))(gate_w)
+    assert np.isfinite(np.asarray(g)).all()
