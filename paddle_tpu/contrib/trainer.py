@@ -103,10 +103,25 @@ def save_checkpoint(
     io.save_persistables(executor, cur, main_program, scope=scope)
     with open(os.path.join(cur, _TRAINER_STATE_FILE), "w") as f:
         json.dump(trainer_args or {}, f)
-    for ep in pserver_endpoints or ():
+    if pserver_endpoints:
+        import threading
+        import warnings
+
         from ..distributed.rpc import RPCClient
 
-        RPCClient.get(ep).checkpoint_notify(dir=os.path.abspath(cur))
+        def notify(ep):
+            try:
+                RPCClient.get(ep).checkpoint_notify(dir=os.path.abspath(cur))
+            except Exception as e:  # a transient RPC hiccup must not kill
+                warnings.warn(  # training or skip serial pruning below
+                    "checkpoint_notify to %s failed: %s" % (ep, e))
+
+        ts = [threading.Thread(target=notify, args=(ep,))
+              for ep in pserver_endpoints]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
     for old_serial, path in _serial_dirs(checkpoint_dir)[:-max_num_checkpoints]:
         shutil.rmtree(path, ignore_errors=True)
     return serial

@@ -84,11 +84,11 @@ class ParameterServer:
         self._ckpt_write_lock = threading.Lock()  # serialize writer threads
 
     # ---- checkpoint (fault tolerance) -----------------------------------
-    def _ckpt_path(self):
+    def _ckpt_path(self, dir=None):
         import os
 
         return os.path.join(
-            self.checkpoint_dir, "pserver_%d.ckpt" % self.server_idx
+            dir or self.checkpoint_dir, "pserver_%d.ckpt" % self.server_idx
         )
 
     def _snapshot(self):
@@ -114,7 +114,7 @@ class ParameterServer:
 
         target = dir or self.checkpoint_dir
         os.makedirs(target, exist_ok=True)
-        path = os.path.join(target, "pserver_%d.ckpt" % self.server_idx)
+        path = self._ckpt_path(dir=target)
         tmp = path + ".tmp"
         with self._ckpt_write_lock:
             with open(tmp, "wb") as f:

@@ -44,6 +44,14 @@ class AnalysisConfig(NativeConfig):
         self._passes = [
             "fold_batch_norm",
             "attention_fuse_pass",
+            # fc_fuse first: the recurrent/embedding fuses match its output
+            "fc_fuse_pass",
+            "embedding_fc_lstm_fuse_pass",
+            "fc_gru_fuse_pass",
+            "fc_lstm_fuse_pass",
+            "conv_eltadd_relu_fuse_pass",
+            "seqconv_eltadd_relu_fuse_pass",
+            "fuse_elewise_add_act_pass",
             "drop_train_ops",
             "memory_optimize",
         ]
@@ -103,6 +111,11 @@ class Predictor:
         resolved = [self._PASS_ALIASES.get(n, n) for n in passes]
         for name in resolved:
             get_pass(name)  # validate the whole list before ANY mutation
+        # fusion passes must not delete the model's fetch targets
+        self.program._protected_fetch_names = {
+            v.name if isinstance(v, framework.Variable) else v
+            for v in self.fetch_vars
+        }
         for name in resolved:
             apply_pass(self.program, name, scope=self.scope)
 

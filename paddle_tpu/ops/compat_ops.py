@@ -21,6 +21,18 @@ def _delegate(type_, ctx, ins, attrs):
     return get_op(type_).lower(ctx, ins, attrs)
 
 
+def project_input_maybe(ins):
+    """fusion_gru/fusion_lstm/fused_embedding_fc_lstm shared in-op fc:
+    with WeightX present, Input is the raw [B, T, D] sequence and the
+    projection x @ WeightX (+ BiasX) happens inside the fused op."""
+    if not ins.get("WeightX"):
+        return ins
+    xproj = ins["Input"][0] @ ins["WeightX"][0]
+    if ins.get("BiasX"):
+        xproj = xproj + ins["BiasX"][0].reshape(1, 1, -1)
+    return dict(ins, Input=[xproj])
+
+
 # ---------------------------------------------------------------------------
 # full-sequence recurrent ops (gru_op.cc, lstm_op.cc, lstmp_op.cc,
 # fused/fusion_gru_op.cc, fused/fusion_lstm_op.cc)
@@ -31,7 +43,10 @@ def _gru(ctx, ins, attrs):
     """gru_op.cc contract on the padded representation: Input is the
     projected gates [B, T, 3H]; emits Hidden (+ LastH).  The reference's
     LoD sequence2batch reordering has no analog — the time axis is
-    explicit."""
+    explicit.  fusion_gru form (fused/fusion_gru_op.cc, the
+    fc_gru_fuse_pass target): when WeightX [D, 3H] is given, Input is the
+    RAW [B, T, D] sequence and the fc projection happens inside the op."""
+    ins = project_input_maybe(ins)
     out = _delegate("padded_gru", ctx, ins, attrs)
     return {"Hidden": out["Hidden"], "LastH": out.get("LastH", [])}
 
@@ -42,7 +57,10 @@ def _lstm(ctx, ins, attrs):
     """lstm_op.cc contract: Input [B, T, 4H] projected gates -> Hidden and
     Cell, both [B, T, H] per-timestep sequences (the reference's
     BatchGate/BatchCellPreAct batch-reorder scratch outputs have no
-    padded-representation analog)."""
+    padded-representation analog).  fusion_lstm form
+    (fused/fusion_lstm_op.cc, fc_lstm_fuse_pass target): with WeightX
+    given, Input is the raw [B, T, D] sequence, projected in-op."""
+    ins = project_input_maybe(ins)
     out = _delegate("padded_lstm", ctx, ins, attrs)
     return {
         "Hidden": out["Hidden"],

@@ -377,6 +377,67 @@ def _lstm_unit(ctx, ins, attrs):
     return {"C": [c], "H": [h]}
 
 
+@register("fc")
+def _fc(ctx, ins, attrs):
+    """Fused fully-connected (fc_op of fc_fuse_pass.cc): mul + bias-add +
+    activation in one op — one MXU matmul with XLA-fused epilogue."""
+    x, w = ins["Input"][0], ins["W"][0]
+    k = int(attrs.get("in_num_col_dims", 1))
+    x2 = x.reshape((int(np.prod(x.shape[:k])), -1))
+    out = x2 @ w
+    out = out.reshape(tuple(x.shape[:k]) + (w.shape[-1],))
+    if ins.get("Bias"):
+        out = out + ins["Bias"][0].reshape((1,) * k + (-1,))
+    act = attrs.get("activation_type", "")
+    if act:
+        out = {"relu": jax.nn.relu, "tanh": jnp.tanh,
+               "sigmoid": jax.nn.sigmoid}[act](out)
+    return {"Out": [out]}
+
+
+@register("fusion_seqconv_eltadd_relu")
+def _fusion_seqconv_eltadd_relu(ctx, ins, attrs):
+    """fused/fusion_seqconv_eltadd_relu_op.cc: sequence_conv + bias + relu
+    as one op (seqconv_eltadd_relu_fuse_pass target)."""
+    from ..core.registry import get_op
+
+    conv_ins = {"X": ins["X"], "Filter": ins["Filter"]}
+    if ins.get("SeqLen"):
+        conv_ins["SeqLen"] = ins["SeqLen"]
+    out = get_op("sequence_conv").lower(ctx, conv_ins, attrs)["Out"][0]
+    out = out + ins["Bias"][0].reshape((1,) * (out.ndim - 1) + (-1,))
+    return {"Out": [jnp.maximum(out, 0)]}
+
+
+@register("fused_embedding_fc_lstm", no_grad_inputs=("Ids",))
+def _fused_embedding_fc_lstm(ctx, ins, attrs):
+    """fused/fused_embedding_fc_lstm_op.cc capability: embedding lookup +
+    input projection + LSTM recurrence as one op
+    (embedding_fc_lstm_fuse_pass target).  Inputs: Ids, Embeddings
+    [vocab, D], WeightX [D, 4H], WeightH [H, 4H], optional BiasX/Bias,
+    optional SeqLen/H0/C0; same outputs as `lstm`."""
+    from ..core.registry import get_op
+
+    ids = ins["Ids"][0].astype(jnp.int32)
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+    from .compat_ops import project_input_maybe
+
+    emb = jnp.take(ins["Embeddings"][0], ids, axis=0)  # [B, T, D]
+    xproj = project_input_maybe(dict(ins, Input=[emb]))["Input"][0]
+    lstm_ins = {"Input": [xproj], "Weight": ins["WeightH"]}
+    for slot in ("Bias", "SeqLen", "H0", "C0"):
+        if ins.get(slot):
+            lstm_ins[slot] = ins[slot]
+    out = get_op("padded_lstm").lower(ctx, lstm_ins, attrs)
+    return {
+        "Hidden": out["Hidden"],
+        "Cell": out["CellSeq"],
+        "LastH": out["LastH"],
+        "LastC": out["LastC"],
+    }
+
+
 @register("padded_lstm")
 def _padded_lstm(ctx, ins, attrs):
     """TPU-native LSTM over padded [batch, time, 4*hidden] projected input.

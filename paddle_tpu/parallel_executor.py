@@ -104,9 +104,23 @@ class ParallelExecutor:
         hit = self._cache.get(key)
         if hit is not None:
             return hit
+        program = self._program
+        # train-safe fusion subset, applied pre-compile when the
+        # BuildStrategy asks (details/build_strategy.h fuse_elewise_add_act
+        # knob — a real Program rewrite; the fused op differentiates
+        # through the generic vjp machinery).  The rewrite runs on a CLONE
+        # with this run's fetch targets protected, so the user's program
+        # stays pristine and a later fetch of any intermediate still works.
+        if self.build_strategy.fuse_elewise_add_act_ops:
+            from .transpiler import apply_pass
+
+            program = self._program.clone()
+            program._protected_fetch_names = set(fetch_names)
+            apply_pass(program, "fuse_elewise_add_act_pass")
+            self._last_fused_program = program
         feed_names = tuple(n for n, _, _ in feed_sig)
         traced = build_traced_function(
-            self._program, 0, feed_names, fetch_names, self._scope
+            program, 0, feed_names, fetch_names, self._scope
         )
         repl = NamedSharding(self._mesh, P())
         data = NamedSharding(self._mesh, P("dp"))

@@ -1,0 +1,359 @@
+"""Fusion-pass suite on the OpPattern detector — the parity sweep over the
+reference's ir/ fuse passes (framework/ir/fc_fuse_pass.cc,
+fuse_elewise_add_act_pass.cc, conv_elementwise_add*_mkldnn_fuse_pass,
+seqconv_eltadd_relu_fuse_pass.cc, fc_gru_fuse_pass.cc,
+fc_lstm_fuse_pass.cc, embedding_fc_lstm_fuse_pass.cc).
+
+Each pass is an op-level Program rewrite into a fused op whose lowering
+already exists — changing WHICH HLO is emitted (fewer, bigger ops with
+epilogues attached to the matmul/conv), the same lever the reference's
+inference-perf story pulls.  All rewrites are conservative: they require
+the exact single-consumer chains the OpPattern matcher guarantees plus
+local shape/attr conditions, and leave anything else untouched.  Every
+fused target op is differentiable through the generic vjp machinery, so
+the fc/elewise passes are train-safe (BuildStrategy.fuse_elewise_add_act_ops).
+"""
+
+import paddle_tpu.framework as _fw
+
+from .pass_registry import OpPattern, Pass, register_pass
+
+_ACTS = ("relu", "tanh", "sigmoid")
+
+
+def _mk_op(block, type_, inputs, outputs, attrs):
+    op = _fw.Operator(block, type_, None, None, dict(attrs))
+    op.inputs = inputs
+    op.outputs = outputs
+    return op
+
+
+def _chain_safe(program, chain):
+    """A fuse rewrite deletes every intermediate output of the chain; names
+    the caller wants fetchable (program._protected_fetch_names, set by the
+    ParallelExecutor / predictor before applying passes) must survive."""
+    protected = getattr(program, "_protected_fetch_names", None)
+    if not protected:
+        return True
+    for op in chain[:-1]:
+        if any(n in protected for n in op.output_arg_names()):
+            return False
+    return True
+
+
+def _replace_chain(block, program, chain, new_ops):
+    """Swap a matched chain for new ops at the position of the LAST chain
+    op (all producers of the fused inputs are defined by then)."""
+    idx = block.ops.index(chain[-1]) - (len(chain) - 1)
+    for op in chain:
+        block.ops.remove(op)
+    for j, op in enumerate(new_ops):
+        block.ops.insert(idx + j, op)
+    program._bump_version()
+
+
+def _bias_of_add(block, add, producer_out):
+    """The add operand that is NOT `producer_out`, or None."""
+    add_ins = add.inputs.get("X", []) + add.inputs.get("Y", [])
+    others = [n for n in add_ins if n != producer_out]
+    if producer_out not in add_ins or len(others) != 1:
+        return None
+    return others[0]
+
+
+def _is_bias_vector(block, name, want, channel_axis_from_end):
+    """True when the var is a length-`want` vector laid out so broadcasting
+    against the producer's output applies it along the intended axis: all
+    dims 1 except the one `channel_axis_from_end` positions from the end
+    (rank may be anything <= that+1).  A numel-only check would accept
+    e.g. a [1,1,H,W] positional bias as a per-channel one."""
+    v = block._find_var_recursive(name)
+    if v is None or v.shape is None:
+        return False
+    dims = [int(d) for d in v.shape]
+    if any(d < 0 for d in dims):
+        return False
+    n = 1
+    for d in dims:
+        n *= d
+    if n != int(want):
+        return False
+    # locate the channel axis from the right; a bare [C] vector counts
+    # only for k == 0 (it right-broadcasts onto the last axis)
+    k = channel_axis_from_end
+    if len(dims) <= k:
+        return k == 0 and len(dims) == 1
+    return dims[len(dims) - 1 - k] == int(want) and all(
+        d == 1 for i, d in enumerate(dims) if i != len(dims) - 1 - k)
+
+
+@register_pass("fc_fuse_pass")
+class FcFusePass(Pass):
+    """mul + elementwise_add [+ relu/tanh/sigmoid] -> fc
+    (ir/fc_fuse_pass.cc)."""
+
+    def apply(self, program, scope=None):
+        block = program.global_block()
+
+        def fuse(chain):
+            mul, add = chain[0], chain[1]
+            act = chain[2].type if len(chain) == 3 else ""
+            if int(mul.attrs.get("y_num_col_dims", 1)) != 1:
+                return False
+            w = block._find_var_recursive(mul.inputs["Y"][0])
+            if w is None or w.shape is None:
+                return False
+            size = int(w.shape[-1])
+            bname = _bias_of_add(block, add, mul.outputs["Out"][0])
+            if bname is None or not _is_bias_vector(block, bname, size, 0):
+                return False
+            if not _chain_safe(program, chain):
+                return False
+            fc = _mk_op(
+                block, "fc",
+                {"Input": mul.inputs["X"], "W": mul.inputs["Y"],
+                 "Bias": [bname]},
+                {"Out": [chain[-1].outputs["Out"][0]]},
+                {"in_num_col_dims": int(mul.attrs.get("x_num_col_dims", 1)),
+                 "activation_type": act},
+            )
+            _replace_chain(block, program, chain, [fc])
+            return True
+
+        n = 0
+        for pat in ([["mul", "elementwise_add", a] for a in _ACTS]
+                    + [["mul", "elementwise_add"]]):
+            n += OpPattern(pat).rewrite(block, fuse)
+        program._fc_fused_count = n
+        return program
+
+
+@register_pass("fuse_elewise_add_act_pass")
+class FuseElewiseAddActPass(Pass):
+    """elementwise_add + activation -> fused_elemwise_activation
+    (ir/fuse_elewise_add_act_pass.cc; Unary(Binary(x, y)) convention)."""
+
+    def apply(self, program, scope=None):
+        block = program.global_block()
+
+        def fuse(chain):
+            add, act = chain
+            if int(add.attrs.get("axis", -1)) != -1:
+                return False  # the fused lowering applies plain + only
+            if not _chain_safe(program, chain):
+                return False
+            fused = _mk_op(
+                block, "fused_elemwise_activation",
+                {"X": [add.inputs["X"][0]], "Y": [add.inputs["Y"][0]]},
+                {"Out": [act.outputs["Out"][0]]},
+                {"functor_list": [act.type, "elementwise_add"]},
+            )
+            _replace_chain(block, program, chain, [fused])
+            return True
+
+        n = 0
+        for a in _ACTS:
+            n += OpPattern(["elementwise_add", a]).rewrite(block, fuse)
+        program._elewise_act_fused_count = n
+        return program
+
+
+@register_pass("conv_eltadd_relu_fuse_pass")
+class ConvEltaddReluFusePass(Pass):
+    """conv2d + elementwise_add(per-channel bias) [+ relu] -> conv2d with
+    Bias input and fuse_relu epilogue (conv_bias/conv_relu mkldnn passes
+    + fuse_relu_into_conv_pass combined)."""
+
+    def apply(self, program, scope=None):
+        block = program.global_block()
+
+        def fuse(chain):
+            conv, add = chain[0], chain[1]
+            relu = chain[2] if len(chain) == 3 else None
+            if conv.inputs.get("Bias"):
+                return False  # already biased
+            f = block._find_var_recursive(conv.inputs["Filter"][0])
+            if f is None or f.shape is None:
+                return False
+            cout = int(f.shape[0])
+            bname = _bias_of_add(block, add, conv.outputs["Output"][0])
+            if bname is None:
+                return False
+            # NCHW channel bias arrives either as [*,C,1,1] under plain
+            # broadcasting, or as a bare [C] with fluid's axis=1 add
+            axis = int(add.attrs.get("axis", -1))
+            if axis == 1:
+                bv = block._find_var_recursive(bname)
+                if (bv is None or bv.shape is None
+                        or [int(d) for d in bv.shape] != [cout]):
+                    return False
+            elif not _is_bias_vector(block, bname, cout, 2):
+                return False
+            if not _chain_safe(program, chain):
+                return False
+            conv.inputs["Bias"] = [bname]
+            conv.outputs["Output"] = [chain[-1].outputs["Out"][0]]
+            if relu is not None:
+                conv.attrs["fuse_relu"] = True
+            # reposition the conv to the chain tail: its new Bias input may
+            # be produced between the conv and the add (e.g. a reshape)
+            _replace_chain(block, program, chain, [conv])
+            return True
+
+        n = 0
+        for pat in (["conv2d", "elementwise_add", "relu"],
+                    ["conv2d", "elementwise_add"]):
+            n += OpPattern(pat).rewrite(block, fuse)
+        program._conv_eltadd_fused_count = n
+        return program
+
+
+@register_pass("seqconv_eltadd_relu_fuse_pass")
+class SeqconvEltaddReluFusePass(Pass):
+    """sequence_conv + elementwise_add + relu ->
+    fusion_seqconv_eltadd_relu (ir/seqconv_eltadd_relu_fuse_pass.cc)."""
+
+    def apply(self, program, scope=None):
+        block = program.global_block()
+
+        def fuse(chain):
+            sc, add, relu = chain
+            f = block._find_var_recursive(sc.inputs["Filter"][0])
+            if f is None or f.shape is None:
+                return False
+            nfilt = int(f.shape[-1])
+            bname = _bias_of_add(block, add, sc.outputs["Out"][0])
+            if bname is None or not _is_bias_vector(block, bname, nfilt, 0):
+                return False
+            if not _chain_safe(program, chain):
+                return False
+            inputs = {"X": sc.inputs["X"], "Filter": sc.inputs["Filter"],
+                      "Bias": [bname]}
+            if sc.inputs.get("SeqLen"):
+                inputs["SeqLen"] = sc.inputs["SeqLen"]
+            fused = _mk_op(
+                block, "fusion_seqconv_eltadd_relu", inputs,
+                {"Out": [relu.outputs["Out"][0]]}, sc.attrs,
+            )
+            _replace_chain(block, program, chain, [fused])
+            return True
+
+        n = OpPattern(["sequence_conv", "elementwise_add", "relu"]).rewrite(
+            block, fuse)
+        program._seqconv_fused_count = n
+        return program
+
+
+def _fuse_fc_into_recurrent(program, rec_types, fused_type):
+    """Shared body of fc_gru_fuse_pass / fc_lstm_fuse_pass: an fc (or bare
+    mul) producing the recurrent op's Input becomes the WeightX/BiasX
+    in-op projection."""
+    block = program.global_block()
+
+    def fuse(chain):
+        proj, rec = chain
+        if rec.inputs.get("WeightX"):
+            return False
+        if proj.outputs["Out"][0] != rec.inputs["Input"][0]:
+            return False
+        x_in = proj.inputs["Input" if proj.type == "fc" else "X"][0]
+        xv = block._find_var_recursive(x_in)
+        if xv is None or xv.shape is None or len(xv.shape) != 3:
+            return False  # in-op projection is [B, T, D] @ [D, kH]
+        if proj.type == "fc":
+            if proj.attrs.get("activation_type"):
+                return False
+            if int(proj.attrs.get("in_num_col_dims", 1)) != 2:
+                return False
+            rec.inputs["WeightX"] = proj.inputs["W"]
+            if proj.inputs.get("Bias"):
+                rec.inputs["BiasX"] = proj.inputs["Bias"]
+        else:  # bare mul
+            if int(proj.attrs.get("x_num_col_dims", 1)) != 2:
+                return False
+            rec.inputs["WeightX"] = proj.inputs["Y"]
+        rec.inputs["Input"] = [x_in]
+        rec.type = fused_type
+        block.ops.remove(proj)
+        program._bump_version()
+        return True
+
+    n = 0
+    for rec_type in rec_types:
+        for head in ("fc", "mul"):
+            n += OpPattern([head, rec_type]).rewrite(block, fuse)
+    return n
+
+
+@register_pass("fc_gru_fuse_pass")
+def _fc_gru_fuse(program, scope):
+    """fc/mul + gru -> fusion_gru (ir/fc_gru_fuse_pass.cc)."""
+    program._fc_gru_fused_count = _fuse_fc_into_recurrent(
+        program, ("gru", "padded_gru"), "fusion_gru")
+    return program
+
+
+@register_pass("fc_lstm_fuse_pass")
+def _fc_lstm_fuse(program, scope):
+    """fc/mul + lstm -> fusion_lstm (ir/fc_lstm_fuse_pass.cc)."""
+    program._fc_lstm_fused_count = _fuse_fc_into_recurrent(
+        program, ("lstm", "padded_lstm"), "fusion_lstm")
+    return program
+
+
+@register_pass("embedding_fc_lstm_fuse_pass")
+class EmbeddingFcLstmFusePass(Pass):
+    """lookup_table + fc/mul + lstm -> fused_embedding_fc_lstm
+    (ir/embedding_fc_lstm_fuse_pass.cc)."""
+
+    def apply(self, program, scope=None):
+        block = program.global_block()
+
+        def fuse(chain):
+            lt, proj, lstm = chain
+            if proj.outputs["Out"][0] != lstm.inputs["Input"][0]:
+                return False
+            if lt.attrs.get("padding_idx", -1) not in (-1, None):
+                return False
+            # the embedding output must be the projection's DATA side —
+            # a lookup feeding the weight operand is a different graph
+            emb_out = lt.outputs["Out"][0]
+            data_slot = "Input" if proj.type == "fc" else "X"
+            if proj.inputs.get(data_slot, [None])[0] != emb_out:
+                return False
+            inputs = {
+                "Ids": lt.inputs["Ids"],
+                "Embeddings": lt.inputs["W"],
+                "WeightH": lstm.inputs["Weight"],
+            }
+            if proj.type == "fc":
+                if proj.attrs.get("activation_type"):
+                    return False
+                if int(proj.attrs.get("in_num_col_dims", 1)) != 2:
+                    return False
+                inputs["WeightX"] = proj.inputs["W"]
+                if proj.inputs.get("Bias"):
+                    inputs["BiasX"] = proj.inputs["Bias"]
+            else:
+                if int(proj.attrs.get("x_num_col_dims", 1)) != 2:
+                    return False
+                inputs["WeightX"] = proj.inputs["Y"]
+            if not _chain_safe(program, chain):
+                return False
+            for slot in ("Bias", "SeqLen", "H0", "C0"):
+                if lstm.inputs.get(slot):
+                    inputs[slot] = lstm.inputs[slot]
+            fused = _mk_op(
+                block, "fused_embedding_fc_lstm", inputs,
+                dict(lstm.outputs), lstm.attrs,
+            )
+            _replace_chain(block, program, chain, [fused])
+            return True
+
+        n = 0
+        for rec in ("lstm", "padded_lstm"):
+            for head in ("fc", "mul"):
+                n += OpPattern(["lookup_table", head, rec]).rewrite(
+                    block, fuse)
+        program._emb_fc_lstm_fused_count = n
+        return program

@@ -1,0 +1,257 @@
+"""Fusion-pass parity sweep (ir/*fuse_pass* analogs): each pass must (a)
+fire on its pattern — rewriting the op sequence — and (b) leave outputs
+numerically identical; train programs (whose grad ops make intermediates
+multi-consumer) must be left untouched."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.transpiler import apply_pass
+
+
+def _fresh():
+    main, startup = fluid.Program(), fluid.Program()
+    return main, startup
+
+
+def _run(main, startup, feed, fetch, scope=None):
+    scope = scope or fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        out = exe.run(main, feed=feed, fetch_list=fetch)
+    return [np.asarray(o) for o in out], scope
+
+
+def _op_types(prog):
+    return [op.type for op in prog.global_block().ops]
+
+
+def test_fc_fuse_pass_fires_and_matches():
+    main, startup = _fresh()
+    with fluid.framework.program_guard(main, startup):
+        startup.random_seed = 3
+        x = layers.data("x", shape=[8])
+        y = layers.fc(x, 6, act="relu")
+    xv = np.random.RandomState(0).rand(4, 8).astype("float32")
+    scope = fluid.Scope()
+    before, scope = _run(main, startup, {"x": xv}, [y], scope)
+    assert "mul" in _op_types(main) and "relu" in _op_types(main)
+
+    apply_pass(main, "fc_fuse_pass")
+    assert main._fc_fused_count == 1
+    types = _op_types(main)
+    assert "fc" in types and "mul" not in types and "relu" not in types
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        after = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    np.testing.assert_allclose(before[0], np.asarray(after[0]),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_fc_fuse_pass_leaves_train_programs_alone():
+    main, startup = _fresh()
+    with fluid.framework.program_guard(main, startup):
+        x = layers.data("x", shape=[8])
+        y = layers.fc(x, 6, act="relu")
+        loss = layers.mean(y)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    apply_pass(main, "fc_fuse_pass")
+    # grad ops consume the mul/add intermediates -> no single-consumer
+    # chain -> the rewrite must not fire (train safety)
+    assert main._fc_fused_count == 0
+    assert "mul" in _op_types(main)
+
+
+def test_fuse_elewise_add_act_pass():
+    main, startup = _fresh()
+    with fluid.framework.program_guard(main, startup):
+        a = layers.data("a", shape=[6])
+        b = layers.data("b", shape=[6])
+        s = layers.elementwise_add(a, b)
+        y = layers.tanh(s)
+    av = np.random.RandomState(1).rand(3, 6).astype("float32")
+    bv = np.random.RandomState(2).rand(3, 6).astype("float32")
+    before, scope = _run(main, startup, {"a": av, "b": bv}, [y])
+
+    apply_pass(main, "fuse_elewise_add_act_pass")
+    assert main._elewise_act_fused_count == 1
+    assert "fused_elemwise_activation" in _op_types(main)
+    assert "elementwise_add" not in _op_types(main)
+    after, _ = _run(main, startup, {"a": av, "b": bv}, [y])
+    np.testing.assert_allclose(before[0], after[0], rtol=1e-5, atol=1e-6)
+
+
+def test_conv_eltadd_relu_fuse_pass():
+    main, startup = _fresh()
+    with fluid.framework.program_guard(main, startup):
+        startup.random_seed = 5
+        x = layers.data("x", shape=[3, 8, 8])
+        c = layers.conv2d(x, num_filters=4, filter_size=3, bias_attr=False)
+        bias = layers.create_parameter([4], "float32", name="cb")
+        s = layers.elementwise_add(c, layers.reshape(bias, shape=[1, 4, 1, 1]))
+        y = layers.relu(s)
+    xv = np.random.RandomState(3).rand(2, 3, 8, 8).astype("float32")
+    scope = fluid.Scope()
+    before, scope = _run(main, startup, {"x": xv}, [y], scope)
+
+    apply_pass(main, "conv_eltadd_relu_fuse_pass")
+    assert main._conv_eltadd_fused_count == 1
+    types = _op_types(main)
+    assert "relu" not in types and "elementwise_add" not in types
+    conv = [op for op in main.global_block().ops if op.type == "conv2d"][0]
+    assert conv.attrs.get("fuse_relu") and conv.inputs.get("Bias")
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        after = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    np.testing.assert_allclose(before[0], np.asarray(after[0]),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_seqconv_eltadd_relu_fuse_pass():
+    main, startup = _fresh()
+    with fluid.framework.program_guard(main, startup):
+        startup.random_seed = 7
+        x = layers.data("x", shape=[5, 6])  # [B, T, D]
+        sc = layers.sequence_conv(x, num_filters=4, filter_size=3,
+                                  bias_attr=False)
+        bias = layers.create_parameter([4], "float32", name="scb")
+        y = layers.relu(layers.elementwise_add(sc, bias))
+    xv = np.random.RandomState(4).rand(2, 5, 6).astype("float32")
+    scope = fluid.Scope()
+    before, scope = _run(main, startup, {"x": xv}, [y], scope)
+
+    apply_pass(main, "seqconv_eltadd_relu_fuse_pass")
+    assert main._seqconv_fused_count == 1
+    assert "fusion_seqconv_eltadd_relu" in _op_types(main)
+    assert "sequence_conv" not in _op_types(main)
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        after = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    np.testing.assert_allclose(before[0], np.asarray(after[0]),
+                               rtol=1e-4, atol=1e-5)
+
+
+def _gru_program():
+    main, startup = _fresh()
+    with fluid.framework.program_guard(main, startup):
+        startup.random_seed = 9
+        x = layers.data("x", shape=[5, 6])
+        proj = layers.fc(x, 3 * 4, num_flatten_dims=2, bias_attr=False)
+        h = layers.dynamic_gru(proj, size=4)
+    return main, startup, h
+
+
+def test_fc_gru_fuse_pass():
+    main, startup, h = _gru_program()
+    xv = np.random.RandomState(5).rand(2, 5, 6).astype("float32")
+    scope = fluid.Scope()
+    before, scope = _run(main, startup, {"x": xv}, [h], scope)
+
+    apply_pass(main, "fc_fuse_pass")  # no bias -> fc pass leaves bare mul
+    apply_pass(main, "fc_gru_fuse_pass")
+    assert main._fc_gru_fused_count == 1
+    types = _op_types(main)
+    assert "fusion_gru" in types and "mul" not in types
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        after = exe.run(main, feed={"x": xv}, fetch_list=[h])
+    np.testing.assert_allclose(before[0], np.asarray(after[0]),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_embedding_fc_lstm_fuse_pass():
+    main, startup = _fresh()
+    with fluid.framework.program_guard(main, startup):
+        startup.random_seed = 11
+        ids = layers.data("ids", shape=[7], dtype="int64")
+        emb = layers.embedding(ids, size=[30, 6])
+        proj = layers.fc(emb, 4 * 4, num_flatten_dims=2, bias_attr=False)
+        h, c = layers.dynamic_lstm(proj, size=4 * 4)
+    iv = np.random.RandomState(6).randint(0, 30, (2, 7)).astype("int64")
+    scope = fluid.Scope()
+    before, scope = _run(main, startup, {"ids": iv}, [h], scope)
+
+    apply_pass(main, "embedding_fc_lstm_fuse_pass")
+    assert main._emb_fc_lstm_fused_count == 1
+    types = _op_types(main)
+    assert "fused_embedding_fc_lstm" in types
+    assert "lookup_table" not in types and "mul" not in types
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        after = exe.run(main, feed={"ids": iv}, fetch_list=[h])
+    np.testing.assert_allclose(before[0], np.asarray(after[0]),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_analysis_predictor_runs_fuse_pipeline(tmp_path):
+    """The AnalysisConfig default pipeline applies the fusion suite to a
+    saved model and predictions stay identical to the Native predictor."""
+    from paddle_tpu.inference import (
+        AnalysisConfig,
+        NativeConfig,
+        create_paddle_predictor,
+    )
+
+    main, startup = _fresh()
+    with fluid.framework.program_guard(main, startup):
+        startup.random_seed = 13
+        x = layers.data("x", shape=[8])
+        y = layers.fc(layers.fc(x, 16, act="relu"), 4, act="softmax")
+    scope = fluid.Scope()
+    d = str(tmp_path / "m")
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        fluid.save_inference_model(d, ["x"], [y], exe, main_program=main)
+
+    xv = np.random.RandomState(7).rand(3, 8).astype("float32")
+    native = create_paddle_predictor(NativeConfig(model_dir=d))
+    analysis = create_paddle_predictor(AnalysisConfig(model_dir=d))
+    out_n = native.run({"x": xv})
+    out_a = analysis.run({"x": xv})
+    np.testing.assert_allclose(np.asarray(out_n[0]), np.asarray(out_a[0]),
+                               rtol=1e-5, atol=1e-6)
+    assert "fc" in [op.type for op in analysis.program.global_block().ops]
+
+
+def test_build_strategy_fuse_knob_applies_pass():
+    """BuildStrategy.fuse_elewise_add_act_ops=True rewrites the PE's
+    forward program pre-compile with unchanged results."""
+    from paddle_tpu.parallel_executor import BuildStrategy, ParallelExecutor
+
+    main, startup = _fresh()
+    with fluid.framework.program_guard(main, startup):
+        a = layers.data("a", shape=[6])
+        b = layers.data("b", shape=[6])
+        y = layers.relu(layers.elementwise_add(a, b))
+    av = np.random.RandomState(8).rand(8, 6).astype("float32")
+    bv = np.random.RandomState(9).rand(8, 6).astype("float32")
+    ref, _ = _run(main, startup, {"a": av, "b": bv}, [y])
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        bs = BuildStrategy()
+        bs.fuse_elewise_add_act_ops = True
+        pe = ParallelExecutor(use_cuda=False, main_program=main,
+                              build_strategy=bs, scope=scope)
+        out = pe.run(feed={"a": av, "b": bv}, fetch_list=[y.name])
+        # the fusion ran on a clone; the user's program stays pristine
+        assert "elementwise_add" in _op_types(main)
+        fused_types = [op.type for op in
+                       pe._last_fused_program.global_block().ops]
+        assert "fused_elemwise_activation" in fused_types
+        np.testing.assert_allclose(
+            ref[0], np.asarray(out[0]).reshape(ref[0].shape),
+            rtol=1e-5, atol=1e-6)
+        # fetching the fused-away intermediate still works: that fetch
+        # set's clone protects the chain from fusing
+        s_name = [op.outputs["Out"][0] for op in main.global_block().ops
+                  if op.type == "elementwise_add"][0]
+        mid = pe.run(feed={"a": av, "b": bv}, fetch_list=[s_name])
+        np.testing.assert_allclose(np.asarray(mid[0]).reshape(av.shape),
+                                   av + bv, rtol=1e-5, atol=1e-6)
