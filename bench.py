@@ -137,7 +137,142 @@ def _bench_impl():
         except Exception as e:  # the headline number must still land
             sys.stderr.write("transformer bench failed: %r\n" % (e,))
             result["transformer_error"] = repr(e)[:300]
+    # model-breadth diagnostics (fluid_benchmark.py model matrix): off by
+    # default — the vgg/se_resnext shapes roughly double tunnel time
+    if os.environ.get("BENCH_MODELS", "0") == "1":
+        result["models"] = {}
+        for name in ("vgg16", "se_resnext50", "stacked_lstm"):
+            try:
+                result["models"][name] = _model_bench(name, on_tpu, device)
+            except Exception as e:
+                sys.stderr.write("%s bench failed: %r\n" % (name, e))
+                result["models"][name] = {"error": repr(e)[:200]}
     print(json.dumps(result))
+
+
+def _time_program(exe, prog, feed, fetches, warmup, steps):
+    import time as _t
+
+    import jax
+    import numpy as np
+
+    for _ in range(warmup):
+        out = exe.run(prog, feed=feed, fetch_list=fetches)
+    np.asarray(out[0])
+    t0 = _t.time()
+    for _ in range(steps):
+        out = exe.run(prog, feed=feed, fetch_list=fetches, return_numpy=False)
+    jax.block_until_ready(out)
+    return _t.time() - t0
+
+
+def _model_bench(name, on_tpu, device):
+    """One benchmark/fluid/models/* leg: images|examples/sec + MFU."""
+    import numpy as np
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.utils import flops as flops_util
+
+    steps = max(1, int(os.environ.get("BENCH_MODEL_STEPS", 10 if on_tpu else 2)))
+    warmup = 2 if on_tpu else 1
+    rng = np.random.RandomState(0)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.framework.program_guard(main, startup):
+        if name in ("vgg16", "se_resnext50"):
+            bs = int(os.environ.get("BENCH_MODEL_BATCH", 32 if on_tpu else 2))
+            hw = 224 if on_tpu else 32
+            img = layers.data("image", shape=[3, hw, hw])
+            label = layers.data("label", shape=[1], dtype="int64")
+            if name == "vgg16":
+                from paddle_tpu.models.vgg import vgg16
+
+                pred = vgg16(img, class_dim=1000 if on_tpu else 10)
+            else:
+                from paddle_tpu.models.se_resnext import se_resnext
+
+                pred = se_resnext(img, class_dim=1000 if on_tpu else 10,
+                                  depth=50)
+            loss = layers.mean(layers.cross_entropy(pred, label))
+            fluid.optimizer.Momentum(0.01, momentum=0.9).minimize(loss)
+            feed_np = {
+                "image": rng.rand(bs, 3, hw, hw).astype("float32"),
+                "label": rng.randint(0, 10, (bs, 1)).astype("int64"),
+            }
+            unit, per_step = "images/sec", bs
+        else:
+            from paddle_tpu.models.stacked_dynamic_lstm import (
+                build_stacked_lstm_train,
+            )
+
+            bs = int(os.environ.get("BENCH_MODEL_BATCH", 32 if on_tpu else 4))
+            seq = 64 if on_tpu else 16
+            feeds, loss, _acc = build_stacked_lstm_train(
+                dict_size=10000 if on_tpu else 500, seq_len_max=seq)
+            fluid.optimizer.Adam(0.001).minimize(loss)
+            feed_np = {
+                "words": rng.randint(0, 500, (bs, seq)).astype("int64"),
+                "seq_len": np.full((bs,), seq, "int64"),
+                "label": rng.randint(0, 2, (bs, 1)).astype("int64"),
+            }
+            unit, per_step = "examples/sec", bs
+    import jax as _jax
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.TPUPlace(0) if on_tpu else fluid.CPUPlace())
+        exe.run(startup)
+        feed = {k: _jax.device_put(v, device) for k, v in feed_np.items()}
+        dt = _time_program(exe, main, feed, [loss], warmup, steps)
+    out = {
+        "value": round(per_step * steps / dt, 2),
+        "unit": unit + ("" if on_tpu else " (cpufallback)"),
+    }
+    step_flops = flops_util.program_flops(main, batch_hint=bs)
+    mfu = flops_util.mfu(step_flops, steps, dt, device)
+    if mfu is not None:
+        out["mfu"] = round(mfu, 4)
+    return out
+
+
+def _dist_smokes():
+    """pserver-mode and collective (nccl2-analog) throughput smokes on
+    localhost CPU subprocesses (fluid_benchmark.py --update_method
+    pserver|nccl2 matrix).  Wall-clock steps/sec including transport."""
+    import time as _t
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    steps = int(os.environ.get("BENCH_DIST_STEPS", "8"))
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+                "DIST_STEPS": str(steps)})
+    out = {}
+    legs = {
+        "pserver_2x2": [sys.executable, "-m", "paddle_tpu.distributed.launch",
+                        "--mode", "pserver", "--nproc", "2",
+                        "--pservers", "2", "tests/dist_mlp.py"],
+        "collective_2": [sys.executable, "-m", "paddle_tpu.distributed.launch",
+                         "--nproc", "2", "tests/launch_worker.py"],
+    }
+    for name, cmd in legs.items():
+        t0 = _t.time()
+        try:
+            proc = subprocess.run(
+                cmd, cwd=here, env=env, timeout=600,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            )
+            dt = _t.time() - t0
+            if proc.returncode != 0:
+                out[name] = {"error": "rc=%d: %s" % (
+                    proc.returncode,
+                    proc.stdout[-300:].decode("utf-8", "replace"))}
+            else:
+                out[name] = {"value": round(steps / dt, 3),
+                             "unit": "steps/sec (localhost cpu)"}
+        except subprocess.TimeoutExpired:
+            out[name] = {"error": "timeout"}
+    return out
 
 
 def _transformer_bench(on_tpu, device):
@@ -323,10 +458,20 @@ def main():
     # driver's budget (raise BENCH_TPU_ATTEMPTS when the chip is flaky
     # rather than absent).
     tpu_timeout = int(os.environ.get("BENCH_TPU_TIMEOUT", "1500"))
+
+    def emit(line):
+        # distributed-mode smokes run OUTSIDE the measurement child (they
+        # spawn their own CPU subprocesses); merge into the one JSON line
+        if os.environ.get("BENCH_DIST", "0") == "1":
+            obj = json.loads(line)
+            obj["dist"] = _dist_smokes()
+            line = json.dumps(obj)
+        print(line)
+
     for i in range(attempts):
         ok, line, log = _run_child(os.environ, timeout=tpu_timeout)
         if ok:
-            print(line)
+            emit(line)
             return
         sys.stderr.write("bench: TPU attempt %d/%d failed:\n%s\n"
                          % (i + 1, attempts, log))
@@ -339,7 +484,7 @@ def main():
 
     ok, line, log = _run_child(_cpu_only_env(1), timeout=900)
     if ok:
-        print(line)
+        emit(line)
         return
     sys.stderr.write("bench: CPU fallback failed:\n%s\n" % log)
     # last resort: still emit a parseable line rather than crash

@@ -118,6 +118,11 @@ class ParallelExecutor:
             program._protected_fetch_names = set(fetch_names)
             apply_pass(program, "fuse_elewise_add_act_pass")
             self._last_fused_program = program
+        if self.build_strategy.debug_graphviz_path:
+            from .transpiler import apply_pass
+
+            program._graph_viz_path = self.build_strategy.debug_graphviz_path
+            apply_pass(program, "graph_viz_pass")
         feed_names = tuple(n for n, _, _ in feed_sig)
         traced = build_traced_function(
             program, 0, feed_names, fetch_names, self._scope

@@ -24,6 +24,7 @@ __all__ = [
     "reset_profiler",
     "cuda_profiler",
     "tpu_profiler",
+    "per_op_timeline",
 ]
 
 _events = []
@@ -121,6 +122,110 @@ def profiler(state="All", sorted_key=None, profile_path="/tmp/profile", trace_di
         yield
     finally:
         stop_profiler(sorted_key, profile_path)
+
+
+def per_op_timeline(program, feed, scope=None, path=None, warmup=1,
+                    block_idx=0):
+    """Per-op host/device correlated timeline (device_tracer.h:26,49 +
+    tools/timeline.py:160 capability, re-expressed for a compile-first
+    engine).
+
+    The compiled path fuses the whole block into one XLA executable, so
+    per-op device attribution needs a diagnostic interpretation pass: each
+    op's lowering runs eagerly on concrete arrays, timed twice — cold
+    (host dispatch + compile + device) and warm (device-dominated re-run
+    under block_until_ready).  Both spans share a correlation id per op
+    (the reference's CUPTI correlation contract) and land in ONE
+    chrome-trace JSON with separate host/device tracks.  Returns the rows
+    [(op_type, idx, host_ms, device_ms)] sorted by device time.
+
+    Flat blocks only (while/cond sub-blocks time as their parent op would
+    under the real executor — use the aggregate profiler for those).
+    """
+    import jax
+    import numpy as np
+
+    from .core.registry import OPS, LowerCtx, get_op, lower_grad_op
+    from .core.scope import global_scope
+
+    scope = scope or global_scope()
+    blk = program.block(block_idx)
+    env = {}
+    for k, v in (feed or {}).items():
+        env[k] = jax.numpy.asarray(np.asarray(v))
+    ctx = LowerCtx(rng_key=jax.random.PRNGKey(0), scope=scope)
+    events = []
+    rows = []
+    t_base = time.time()
+
+    for idx, op in enumerate(blk.ops):
+        if op.type in ("feed", "fetch", "read", "create_py_reader"):
+            continue
+        if op.type in ("while", "cond"):
+            raise ValueError(
+                "per_op_timeline supports flat blocks; '%s' at op %d owns "
+                "a sub-block" % (op.type, idx))
+        ctx.op_idx = idx
+        ctx.block = blk
+        ins = {}
+        for slot, names in op.inputs.items():
+            vals = []
+            for n in names:
+                if n in env:
+                    vals.append(env[n])
+                elif scope.has_var(n):
+                    vals.append(jax.numpy.asarray(scope.find_var(n)))
+                else:
+                    raise RuntimeError(
+                        "per_op_timeline: op %s reads %s which is neither "
+                        "fed nor in scope" % (op.type, n))
+            ins[slot] = vals
+
+        def run_once():
+            if op.type.endswith("_grad") and "__fwd_type__" in op.attrs \
+                    and op.type not in OPS:
+                out = lower_grad_op(ctx, op, ins, op.attrs)
+            else:
+                out = get_op(op.type).lower(ctx, ins, op.attrs)
+            jax.block_until_ready(
+                [v for vs in out.values() for v in vs if v is not None])
+            return out
+
+        t0 = time.time()
+        outs = run_once()
+        host_ms = (time.time() - t0) * 1e3
+        dev_ms = host_ms
+        if warmup:
+            t0 = time.time()
+            for _ in range(warmup):
+                outs = run_once()
+            dev_ms = (time.time() - t0) * 1e3 / warmup
+        ts = (time.time() - t_base) * 1e6
+        for tid, name, dur in ((1, "host", host_ms), (2, "device", dev_ms)):
+            events.append({
+                "name": "%s#%d" % (op.type, idx), "ph": "X",
+                "ts": ts, "dur": dur * 1e3, "pid": os.getpid(), "tid": tid,
+                "args": {"correlation": idx, "track": name},
+            })
+        rows.append((op.type, idx, host_ms, dev_ms))
+        for slot, names in op.outputs.items():
+            vals = outs.get(slot)
+            if vals is None:
+                continue
+            for n, v in zip(names, vals):
+                if n and v is not None:
+                    env[n] = v
+
+    if path:
+        meta = [
+            {"ph": "M", "pid": os.getpid(), "tid": 1, "name": "thread_name",
+             "args": {"name": "host (dispatch+compile)"}},
+            {"ph": "M", "pid": os.getpid(), "tid": 2, "name": "thread_name",
+             "args": {"name": "device (warm re-run)"}},
+        ]
+        with open(path, "w") as f:
+            json.dump({"traceEvents": meta + events}, f)
+    return sorted(rows, key=lambda r: -r[3])
 
 
 @contextlib.contextmanager

@@ -1,0 +1,82 @@
+"""`paddle_trainer`-style command line (legacy TrainerMain.cpp + the
+`paddle train` wrapper of scripts/submit_local.sh.in, re-homed):
+
+    python -m paddle_tpu.trainer_cli --program_dir DIR --steps N \
+        [--batch_size B] [--checkpoint_dir CK --checkpoint_every K] \
+        [--save_dir OUT] [--log_every L]
+
+Trains an exported program directory (native/demo_driver.py
+export_train_program format — the same artifact the C++ demo_trainer
+consumes) with no model script: synthetic batches shaped by the feed
+spec, serial-numbered checkpoints with resume (contrib CheckpointConfig
+semantics), and a final persistables save.  Exits non-zero if the loss
+failed to improve (the demo_trainer.cc contract).
+"""
+
+import argparse
+import sys
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="paddle_tpu.trainer_cli")
+    ap.add_argument("--program_dir", required=True,
+                    help="export_train_program output directory")
+    ap.add_argument("--steps", type=int, default=100)
+    ap.add_argument("--batch_size", type=int, default=16)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--log_every", type=int, default=10)
+    ap.add_argument("--checkpoint_dir", default=None)
+    ap.add_argument("--checkpoint_every", type=int, default=50)
+    ap.add_argument("--save_dir", default=None,
+                    help="save persistables here after training")
+    args = ap.parse_args(argv)
+
+    import paddle_tpu as fluid
+    from paddle_tpu.contrib.trainer import load_checkpoint, save_checkpoint
+    from paddle_tpu.native.demo_driver import DemoTrainer
+
+    t = DemoTrainer(args.program_dir, batch_size=args.batch_size,
+                    seed=args.seed)
+    start_step = 0
+    if args.checkpoint_dir:
+        with fluid.scope_guard(t.scope):
+            state = load_checkpoint(t.exe, args.checkpoint_dir, t.main)
+        if state is not None:
+            start_step = int(state.get("step_id", 0))
+            print("resumed from checkpoint at step %d" % start_step)
+
+    first = last = None
+    last_saved = start_step
+    for step in range(start_step, args.steps):
+        loss = t.step()
+        if first is None:
+            first = loss
+        last = loss
+        if args.log_every and (step + 1) % args.log_every == 0:
+            print("step %d loss %.6f" % (step + 1, loss))
+        if (args.checkpoint_dir
+                and (step + 1) % args.checkpoint_every == 0):
+            with fluid.scope_guard(t.scope):
+                save_checkpoint(t.exe, args.checkpoint_dir, t.main,
+                                trainer_args={"step_id": step + 1})
+            last_saved = step + 1
+    if args.checkpoint_dir and last_saved < args.steps:
+        with fluid.scope_guard(t.scope):
+            save_checkpoint(t.exe, args.checkpoint_dir, t.main,
+                            trainer_args={"step_id": args.steps})
+
+    if args.save_dir:
+        with fluid.scope_guard(t.scope):
+            fluid.io.save_persistables(t.exe, args.save_dir, t.main)
+        print("saved persistables to %s" % args.save_dir)
+
+    if first is None:
+        print("nothing to do: start step %d >= steps %d"
+              % (start_step, args.steps))
+        return 0
+    print("first loss %.6f last loss %.6f" % (first, last))
+    return 0 if last < first else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -101,8 +101,8 @@ class FcFusePass(Pass):
             if int(mul.attrs.get("y_num_col_dims", 1)) != 1:
                 return False
             w = block._find_var_recursive(mul.inputs["Y"][0])
-            if w is None or w.shape is None:
-                return False
+            if w is None or w.shape is None or len(w.shape) != 2:
+                return False  # fc lowering matmuls Y as-is (no flattening)
             size = int(w.shape[-1])
             bname = _bias_of_add(block, add, mul.outputs["Out"][0])
             if bname is None or not _is_bias_vector(block, bname, size, 0):
@@ -255,6 +255,8 @@ def _fuse_fc_into_recurrent(program, rec_types, fused_type):
         if rec.inputs.get("WeightX"):
             return False
         if proj.outputs["Out"][0] != rec.inputs["Input"][0]:
+            return False
+        if not _chain_safe(program, chain):
             return False
         x_in = proj.inputs["Input" if proj.type == "fc" else "X"][0]
         xv = block._find_var_recursive(x_in)

@@ -193,6 +193,18 @@ def _bf16_amp(program, scope):
     return program
 
 
+@register_pass("graph_viz_pass")
+def _graph_viz(program, scope):
+    """ir/graph_viz_pass.cc analog: dump the program's def-use graph as
+    graphviz dot.  Output path via program._graph_viz_path (the
+    BuildStrategy.debug_graphviz_path plumbing) or ./graph.dot."""
+    from ..debugger import draw_block_graphviz
+
+    path = getattr(program, "_graph_viz_path", "") or "./graph.dot"
+    draw_block_graphviz(program.global_block(), path=path)
+    return program
+
+
 @register_pass("fuse_relu_into_conv_pass")
 class FuseReluIntoConv(Pass):
     """Example fusion built on OpPattern: conv2d followed by a

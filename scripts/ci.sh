@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# CI driver (paddle/scripts/paddle_build.sh role): gate = compile check,
+# API-surface diff, fast test suite, multichip dryrun.  The full suite
+# (incl. slow-marked multi-process/book tests) runs with --full.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export PALLAS_AXON_POOL_IPS=
+export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+
+echo "== byte-compile check =="
+python -m compileall -q paddle_tpu tools examples bench.py __graft_entry__.py
+
+echo "== public API surface check (tools/diff_api.py) =="
+python tools/print_signatures.py paddle_tpu > /tmp/api_actual.spec
+python tools/diff_api.py API.spec /tmp/api_actual.spec
+
+echo "== test suite =="
+if [ "${1:-}" = "--full" ]; then
+    python -m pytest tests/ -q -m ""   # override the fast-run deselect
+else
+    python -m pytest tests/ -q         # pytest.ini addopts: -m "not slow"
+fi
+
+echo "== multichip dryrun (8-device virtual mesh) =="
+python __graft_entry__.py 8
+
+echo "CI OK"

@@ -52,7 +52,12 @@ def main():
             out_specs=P("x"),
         )
     )
-    local = np.asarray(f(ranks).addressable_data(0))
+    # DIST_STEPS: the bench dist-smoke times N collective steps; the
+    # launcher tests leave it at 1 and just check the value
+    steps = max(1, int(os.environ.get("DIST_STEPS", "1")))
+    for _ in range(steps):
+        out = f(ranks)
+    local = np.asarray(out.addressable_data(0))
     print("PSUM %.1f" % float(local[0]), flush=True)
 
 

@@ -367,3 +367,80 @@ def test_packaging_metadata_builds():
     assert "tests" not in pkgs
     data = cfg["tool"]["setuptools"]["package-data"]["paddle_tpu.native"]
     assert "*.cc" in data and "Makefile" in data
+
+
+def test_per_op_timeline_correlated_tracks(tmp_path):
+    """per_op_timeline (device_tracer + tools/timeline.py capability): one
+    chrome trace with host+device tracks sharing a correlation id per op,
+    and a per-op table sorted by device time."""
+    import json
+
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu import layers, profiler
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.framework.program_guard(main, startup):
+        x = layers.data("x", shape=[16])
+        y = layers.fc(layers.fc(x, 32, act="relu"), 4)
+        loss = layers.mean(y)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        path = str(tmp_path / "perop.json")
+        rows = profiler.per_op_timeline(
+            main, {"x": np.random.rand(4, 16).astype("float32")},
+            scope=scope, path=path)
+    assert rows and all(len(r) == 4 for r in rows)
+    types = {r[0] for r in rows}
+    assert "mul" in types and "mean" in types
+    trace = json.load(open(path))["traceEvents"]
+    spans = [e for e in trace if e.get("ph") == "X"]
+    host = {e["args"]["correlation"] for e in spans if e["tid"] == 1}
+    dev = {e["args"]["correlation"] for e in spans if e["tid"] == 2}
+    assert host == dev and len(host) == len(rows)
+    # device rows are the sort key
+    assert rows == sorted(rows, key=lambda r: -r[3])
+
+
+def test_timeline_tool_merges_worker_profiles(tmp_path):
+    """tools/timeline.py (reference tools/timeline.py:160 role): merge
+    per-worker profiler JSONs into one trace with per-process lanes."""
+    import json
+    import subprocess
+    import sys
+
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu import layers, profiler
+
+    paths = []
+    for i in range(2):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.framework.program_guard(main, startup):
+            x = layers.data("x", shape=[4])
+            y = layers.fc(x, 2)
+        scope = fluid.Scope()
+        p = str(tmp_path / ("w%d.json" % i))
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            with profiler.profiler("CPU", profile_path=p):
+                exe.run(main, feed={"x": np.ones((2, 4), "float32")},
+                        fetch_list=[y])
+        paths.append(p)
+
+    out = str(tmp_path / "merged.json")
+    r = subprocess.run(
+        [sys.executable, "tools/timeline.py", "--out", out,
+         "trainer0=%s" % paths[0], "pserver0=%s" % paths[1]],
+        cwd="/root/repo", timeout=120,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    assert r.returncode == 0, r.stdout.decode()
+    trace = json.load(open(out))["traceEvents"]
+    names = {e["args"]["name"] for e in trace if e.get("ph") == "M"}
+    assert {"trainer0", "pserver0"} <= names
+    pids = {e["pid"] for e in trace}
+    assert pids == {0, 1}
+    assert any(e.get("ph") == "X" for e in trace)

@@ -11,6 +11,10 @@ import paddle_tpu as fluid
 from paddle_tpu import layers
 from paddle_tpu.inference import AnalysisConfig, create_paddle_predictor
 
+# multi-process / full-train-cycle integration tests: excluded from the
+# default fast run (pytest.ini addopts -m "not slow"); run with -m "" 
+pytestmark = pytest.mark.slow
+
 
 def _exe():
     exe = fluid.Executor(fluid.CPUPlace())

@@ -10,6 +10,10 @@ import sys
 import numpy as np
 import pytest
 
+# multi-process / full-train-cycle integration tests: excluded from the
+# default fast run (pytest.ini addopts -m "not slow"); run with -m "" 
+pytestmark = pytest.mark.slow
+
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _REPO = os.path.dirname(_DIR)
 

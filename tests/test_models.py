@@ -66,6 +66,7 @@ def test_transformer_trains():
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow  # heavy leg; fast run keeps a sibling cover
 def test_transformer_fused_attention_matches_dense():
     """hp.fused_attn (flash-style fused attention + in-graph key-pad bias
     derivation) gives the same loss as the dense-bias path with identical
@@ -109,6 +110,7 @@ def test_transformer_fused_attention_matches_dense():
         tfm.multi_head_attention(q, q, q, bias, 32, 4, fused=True)
 
 
+@pytest.mark.slow  # heavy leg; fast run keeps a sibling cover
 def test_transformer_bf16_trains():
     """use_bf16 AMP rewrite on the transformer program still trains to a
     finite, decreasing loss — with fused_attn on, i.e. the exact on-TPU
@@ -388,6 +390,7 @@ def test_gpt2_greedy_generate_learns_pattern():
     assert np.isfinite(beam_scores).all()
 
 
+@pytest.mark.slow  # heavy leg; fast run keeps a sibling cover
 def test_transformer_greedy_translate_learns_copy():
     """End-to-end translation: overfit a tiny transformer on a copy task
     (target = source), then greedy_translate reproduces the source."""
@@ -558,6 +561,7 @@ def test_gpt2_recompute_matches_plain():
     assert plain[-1] < plain[0]
 
 
+@pytest.mark.slow  # heavy leg; fast run keeps a sibling cover
 def test_recompute_with_dropout_and_bert():
     """Recompute + RNG-consuming ops: GPT-2 with dropout>0 under remat
     trains to a decreasing finite loss (jax.checkpoint replays the same
@@ -622,6 +626,7 @@ def test_recompute_with_dropout_and_bert():
     np.testing.assert_allclose(remat, plain, rtol=1e-5)
 
 
+@pytest.mark.slow  # heavy leg; fast run keeps a sibling cover
 def test_transformer_recompute_matches_plain():
     """hp.recompute on the full encoder-decoder matches the plain graph
     step for step (dropout 0)."""

@@ -9,6 +9,10 @@ import pytest
 import paddle_tpu as fluid
 from paddle_tpu import native, recordio
 
+# multi-process / full-train-cycle integration tests: excluded from the
+# default fast run (pytest.ini addopts -m "not slow"); run with -m "" 
+pytestmark = pytest.mark.slow
+
 
 def test_native_builds():
     assert native.available(), "native library failed to build"
@@ -213,3 +217,57 @@ def test_c_inference_abi(tmp_path):
     got = [float(v) for v in
            line.split("first=[")[1].rstrip("]").split(",")]
     np.testing.assert_allclose(got, np.asarray(ref)[0][:4], rtol=1e-4)
+
+
+def test_trainer_cli_trains_checkpoints_and_resumes(tmp_path):
+    """paddle_trainer-binary capability (TrainerMain.cpp / `paddle train`):
+    the CLI trains an exported program dir, writes serial checkpoints,
+    resumes from them, and saves persistables; rc=0 iff loss improved."""
+    import os
+    import subprocess
+    import sys
+
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.native.demo_driver import export_train_program
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.framework.program_guard(main, startup):
+        x = layers.data("x", shape=[8])
+        label = layers.data("label", shape=[1], dtype="int64")
+        pred = layers.fc(x, 4, act="softmax")
+        loss = layers.mean(layers.cross_entropy(pred, label))
+        fluid.optimizer.SGD(0.5).minimize(loss)
+    d = str(tmp_path / "prog")
+    export_train_program(
+        d, main, startup,
+        [{"name": "x", "shape": [8], "dtype": "float32"},
+         {"name": "label", "shape": [1], "dtype": "int64", "max": 4}],
+        [loss.name])
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    ck = str(tmp_path / "ck")
+    out_dir = str(tmp_path / "params")
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.trainer_cli", "--program_dir", d,
+         "--steps", "6", "--checkpoint_dir", ck, "--checkpoint_every", "3",
+         "--save_dir", out_dir, "--log_every", "2"],
+        cwd="/root/repo", env=env, timeout=300,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    text = r.stdout.decode()
+    assert r.returncode == 0, text
+    assert "first loss" in text and os.path.isdir(out_dir), text
+    serials = [p for p in os.listdir(ck) if p.startswith("checkpoint_")]
+    assert serials, os.listdir(ck)
+
+    # resume: the saved step counter short-circuits already-done steps
+    r2 = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.trainer_cli", "--program_dir", d,
+         "--steps", "6", "--checkpoint_dir", ck],
+        cwd="/root/repo", env=env, timeout=300,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    text2 = r2.stdout.decode()
+    assert r2.returncode == 0, text2
+    assert "resumed from checkpoint at step 6" in text2, text2
+    assert "nothing to do" in text2, text2

@@ -1,0 +1,54 @@
+#!/usr/bin/env python
+"""Merge per-worker profiler artifacts into one chrome-trace timeline
+(tools/timeline.py:160 role: the reference turns per-device Profile
+protos into a single chrome trace; here the inputs are the chrome-trace
+JSONs the paddle_tpu profiler writes — one per process/worker).
+
+    python tools/timeline.py --out merged.json \
+        trainer0=/tmp/profile_t0.json pserver0=/tmp/profile_ps0.json
+
+Each input gets its own pid lane with a process_name metadata row, so a
+distributed run's trainers and pservers line up on one timeline in
+chrome://tracing / perfetto.
+"""
+
+import argparse
+import json
+
+
+def merge(named_paths):
+    events = []
+    for pid, (name, path) in enumerate(named_paths):
+        with open(path) as f:
+            data = json.load(f)
+        events.append({
+            "ph": "M", "pid": pid, "tid": 0, "name": "process_name",
+            "args": {"name": name},
+        })
+        for e in data.get("traceEvents", []):
+            e = dict(e)
+            e["pid"] = pid
+            events.append(e)
+    return {"traceEvents": events}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", required=True)
+    ap.add_argument("inputs", nargs="+",
+                    help="name=path pairs (or bare paths)")
+    args = ap.parse_args()
+    named = []
+    for item in args.inputs:
+        if "=" in item:
+            name, path = item.split("=", 1)
+        else:
+            name, path = item, item
+        named.append((name, path))
+    with open(args.out, "w") as f:
+        json.dump(merge(named), f)
+    print("wrote %s (%d workers)" % (args.out, len(named)))
+
+
+if __name__ == "__main__":
+    main()
