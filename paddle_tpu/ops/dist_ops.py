@@ -172,3 +172,23 @@ def _send_sparse(ctx, ins, attrs):
         host_push, jax.ShapeDtypeStruct((), jnp.int32), ids, grad, ordered=True
     )
     return {"Out": [tok]}
+
+
+@register("checkpoint_notify", side_effect=True)
+def _checkpoint_notify(ctx, ins, attrs):
+    """distributed_ops/checkpoint_notify_op.cc: in-program trigger asking
+    every pserver in `epmap` to snapshot its shard into `dir` (host
+    callback, ordered with the surrounding sends/barriers)."""
+    epmap = list(attrs.get("epmap", []))
+    ckpt_dir = attrs.get("dir") or None
+    trainer_id = int(attrs.get("trainer_id", 0))
+
+    def host_notify():
+        for ep in epmap:
+            _client(ep, trainer_id).checkpoint_notify(
+                dir=ckpt_dir, trainer_id=trainer_id)
+        return np.int32(0)
+
+    tok = io_callback(
+        host_notify, jax.ShapeDtypeStruct((), jnp.int32), ordered=True)
+    return {"Out": [tok]}

@@ -409,6 +409,27 @@ def _fusion_seqconv_eltadd_relu(ctx, ins, attrs):
     return {"Out": [jnp.maximum(out, 0)]}
 
 
+@register("fusion_seqexpand_concat_fc")
+def _fusion_seqexpand_concat_fc(ctx, ins, attrs):
+    """fused/fusion_seqexpand_concat_fc_op.cc: X[0] is a [B, T, D0]
+    sequence; every further X[i] is a per-example [B, Di] vector expanded
+    to all T steps; features concat and feed one fc (+ activation)."""
+    xs = ins["X"]
+    seq = xs[0]
+    b, t = seq.shape[0], seq.shape[1]
+    parts = [seq] + [
+        jnp.broadcast_to(v[:, None, :], (b, t, v.shape[-1])) for v in xs[1:]
+    ]
+    cat = jnp.concatenate(parts, axis=-1)
+    out = cat @ ins["FCWeight"][0]
+    if ins.get("FCBias"):
+        out = out + ins["FCBias"][0].reshape(1, 1, -1)
+    act = attrs.get("fc_activation", "identity")
+    fn = {"identity": lambda x: x, "relu": jax.nn.relu, "tanh": jnp.tanh,
+          "sigmoid": jax.nn.sigmoid}[act]
+    return {"Out": [fn(out)]}
+
+
 @register("fused_embedding_fc_lstm", no_grad_inputs=("Ids",))
 def _fused_embedding_fc_lstm(ctx, ins, attrs):
     """fused/fused_embedding_fc_lstm_op.cc capability: embedding lookup +

@@ -178,6 +178,7 @@ def _concat(ctx, ins, attrs):
     return {"Out": [jnp.concatenate(ins["X"], axis=attrs.get("axis", 0))]}
 
 
+@register("split_byref")
 @register("split")
 def _split(ctx, ins, attrs):
     x = ins["X"][0]
@@ -352,6 +353,32 @@ def _lookup_table_grad(ctx, ins, attrs):
     if pad is not None and pad != -1:
         vals = jnp.where((rows == pad)[:, None], 0.0, vals)
     return {"W@GRAD": [SelectedRows(rows, vals, w.shape[0])]}
+
+
+@register("split_selected_rows", handles_selected_rows=True)
+def _split_selected_rows(ctx, ins, attrs):
+    """split_selected_rows_op.cc: route a SelectedRows' rows into
+    height_sections buckets (the pserver param-shard scatter).  Static
+    shapes: every output keeps the full row list, with rows outside its
+    section remapped to the out-of-range sentinel (height), which every
+    consumer drops; in-section rows are rebased to the section-local
+    index, matching the reference's per-shard row numbering."""
+    from ..core.selected_rows import SelectedRows
+
+    x = ins["X"][0]
+    sections = [int(s) for s in attrs.get("height_sections", [])]
+    if not isinstance(x, SelectedRows):
+        idx = np.cumsum(sections)[:-1].tolist()
+        return {"Out": list(jnp.split(x, idx, axis=0))}
+    outs = []
+    offset = 0
+    for h in sections:
+        in_sec = (x.rows >= offset) & (x.rows < offset + h)
+        rows = jnp.where(in_sec, x.rows - offset, h)
+        vals = jnp.where(in_sec[:, None], x.value, 0)
+        outs.append(SelectedRows(rows, vals, h))
+        offset += h
+    return {"Out": outs}
 
 
 @register("one_hot", no_grad_inputs=("X",))

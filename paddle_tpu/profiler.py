@@ -147,6 +147,7 @@ def per_op_timeline(program, feed, scope=None, path=None, warmup=1,
 
     from .core.registry import OPS, LowerCtx, get_op, lower_grad_op
     from .core.scope import global_scope
+    from .core.selected_rows import SelectedRows, densify_maybe
 
     scope = scope or global_scope()
     blk = program.block(block_idx)
@@ -167,6 +168,7 @@ def per_op_timeline(program, feed, scope=None, path=None, warmup=1,
                 "a sub-block" % (op.type, idx))
         ctx.op_idx = idx
         ctx.block = blk
+        opdef = OPS.get(op.type)
         ins = {}
         for slot, names in op.inputs.items():
             vals = []
@@ -180,6 +182,13 @@ def per_op_timeline(program, feed, scope=None, path=None, warmup=1,
                         "per_op_timeline: op %s reads %s which is neither "
                         "fed nor in scope" % (op.type, n))
             ins[slot] = vals
+        # mirror the executor's SelectedRows contract: non-aware ops see
+        # the densified tensor
+        if any(isinstance(v, SelectedRows)
+               for vs in ins.values() for v in vs) and not (
+                   opdef is not None and opdef.handles_selected_rows):
+            ins = {s_: [densify_maybe(v) for v in vs]
+                   for s_, vs in ins.items()}
 
         def run_once():
             if op.type.endswith("_grad") and "__fwd_type__" in op.attrs \
@@ -195,7 +204,9 @@ def per_op_timeline(program, feed, scope=None, path=None, warmup=1,
         outs = run_once()
         host_ms = (time.time() - t0) * 1e3
         dev_ms = host_ms
-        if warmup:
+        # side-effect ops (RPC sends, barriers, checkpoint notifies) must
+        # run exactly once — a warm re-run would duplicate the effect
+        if warmup and not (opdef is not None and opdef.side_effect):
             t0 = time.time()
             for _ in range(warmup):
                 outs = run_once()

@@ -195,3 +195,31 @@ def test_trainer_checkpoint_notifies_pservers(tmp_path):
     finally:
         srv.shutdown()
         RPCClient.reset_all()
+
+
+def test_checkpoint_notify_op_in_program(tmp_path):
+    """The in-program checkpoint_notify op (checkpoint_notify_op.cc):
+    running a program containing it makes the pserver snapshot."""
+    import os
+
+    import paddle_tpu as fluid
+    from paddle_tpu.distributed.ps_server import ParameterServer
+
+    ps = ParameterServer({}, {}, num_trainers=1, sync_mode=False,
+                         server_idx=0)
+    srv = VarServer("127.0.0.1:0", ps).start()
+    try:
+        target = str(tmp_path / "snap")
+        main = fluid.Program()
+        blk = main.global_block()
+        tok = blk.create_var(name="ck_tok", dtype="int32", shape=[])
+        blk.append_op("checkpoint_notify", inputs={},
+                      outputs={"Out": ["ck_tok"]},
+                      attrs={"epmap": [srv.endpoint], "dir": target})
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(main, feed={}, fetch_list=[tok])
+        assert os.path.exists(os.path.join(target, "pserver_0.ckpt"))
+    finally:
+        srv.shutdown()
+        RPCClient.reset_all()

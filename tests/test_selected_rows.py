@@ -127,3 +127,40 @@ def test_dense_fallback_for_unaware_optimizer():
         lambda: fluid.optimizer.Momentum(0.05, momentum=0.9), False, ids,
         12, 4)
     np.testing.assert_allclose(losses, l_d, rtol=1e-5, atol=1e-6)
+
+
+def test_split_selected_rows_routes_sections():
+    """split_selected_rows (split_selected_rows_op.cc): rows route to
+    height_sections shards with section-local indices; out-of-section
+    slots use the drop sentinel.  Dense inputs split by rows."""
+    from paddle_tpu.core.registry import get_op
+    from paddle_tpu.core.registry import LowerCtx
+
+    rows = jnp.asarray([0, 4, 5, 11, 7], jnp.int32)
+    vals = jnp.arange(10, dtype=jnp.float32).reshape(5, 2)
+    sr = SelectedRows(rows, vals, 12)
+    out = get_op("split_selected_rows").lower(
+        LowerCtx(), {"X": [sr]}, {"height_sections": [4, 8]})["Out"]
+    assert len(out) == 2 and out[0].height == 4 and out[1].height == 8
+    d0, d1 = np.asarray(out[0].densify()), np.asarray(out[1].densify())
+    full = np.asarray(sr.densify())
+    np.testing.assert_allclose(d0, full[:4])
+    np.testing.assert_allclose(d1, full[4:])
+
+
+def test_fusion_seqexpand_concat_fc_matches_manual():
+    from paddle_tpu.core.registry import LowerCtx, get_op
+
+    rng = np.random.RandomState(0)
+    seq = jnp.asarray(rng.rand(2, 3, 4).astype("float32"))
+    v1 = jnp.asarray(rng.rand(2, 5).astype("float32"))
+    w = jnp.asarray(rng.rand(9, 6).astype("float32"))
+    b = jnp.asarray(rng.rand(6).astype("float32"))
+    out = get_op("fusion_seqexpand_concat_fc").lower(
+        LowerCtx(), {"X": [seq, v1], "FCWeight": [w], "FCBias": [b]},
+        {"fc_activation": "relu"})["Out"][0]
+    cat = np.concatenate(
+        [np.asarray(seq), np.tile(np.asarray(v1)[:, None, :], (1, 3, 1))],
+        axis=-1)
+    ref = np.maximum(cat @ np.asarray(w) + np.asarray(b), 0)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-6)
