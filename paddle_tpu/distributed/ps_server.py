@@ -313,7 +313,7 @@ def run_pserver(program, scope, executor=None):
     This is what Executor.run does when it sees a `listen_and_serv` op —
     the analog of ListenAndServOp::RunImpl.
     """
-    from .rpc import VarServer
+    from .rpc import make_var_server
 
     listen_op = None
     for op in program.global_block().ops:
@@ -391,7 +391,7 @@ def run_pserver(program, scope, executor=None):
     restored = service.load_checkpoint()
     if restored is not None:
         print("PSERVER RESTORED round=%d" % restored, flush=True)
-    server = VarServer(a["endpoint"], service).start()
+    server = make_var_server(a["endpoint"], service).start()
     try:
         service.wait_done()
     finally:

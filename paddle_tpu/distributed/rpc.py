@@ -218,6 +218,34 @@ class _InFlight:
         self.result = None
 
 
+def _execute_once(dedup, dedup_lock, service, verb, kwargs, req_id):
+    """At-most-once dispatch shared by both transports: a client retry
+    after a dropped reply must not re-apply non-idempotent verbs (grad
+    sends, barriers) — the in-flight marker is recorded BEFORE dispatch,
+    so a retry always finds it and waits for the original result instead
+    of re-executing.  Completed entries trim oldest-first past 4096."""
+    with dedup_lock:
+        entry = dedup.get(req_id)
+        owner = entry is None
+        if owner:
+            entry = dedup[req_id] = _InFlight()
+    if owner:
+        try:
+            entry.result = service.handle(verb, **kwargs)
+        finally:
+            entry.done.set()
+        with dedup_lock:
+            if len(dedup) > 4096:
+                for rid in list(dedup):
+                    if len(dedup) <= 4096:
+                        break
+                    if dedup[rid].done.is_set():
+                        del dedup[rid]
+    else:
+        entry.done.wait()
+    return entry.result
+
+
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
         server = self.server
@@ -227,32 +255,8 @@ class _Handler(socketserver.BaseRequestHandler):
                 verb, kwargs, req_id = _recv_msg(self.request)
                 if verb == "__close__":
                     return
-                # at-most-once execution: a client retry after a dropped
-                # reply must not re-apply non-idempotent verbs (grad sends,
-                # barriers) — the in-flight marker is recorded BEFORE
-                # dispatch, so a retry always finds it and waits for the
-                # original result instead of re-executing
-                with server.dedup_lock:
-                    entry = server.dedup.get(req_id)
-                    owner = entry is None
-                    if owner:
-                        entry = server.dedup[req_id] = _InFlight()
-                if owner:
-                    try:
-                        entry.result = service.handle(verb, **kwargs)
-                    finally:
-                        entry.done.set()
-                    with server.dedup_lock:
-                        # trim oldest *completed* entries only
-                        if len(server.dedup) > 4096:
-                            for rid in list(server.dedup):
-                                if len(server.dedup) <= 4096:
-                                    break
-                                if server.dedup[rid].done.is_set():
-                                    del server.dedup[rid]
-                else:
-                    entry.done.wait()
-                result = entry.result
+                result = _execute_once(server.dedup, server.dedup_lock,
+                                       service, verb, kwargs, req_id)
                 _send_msg(self.request, result)
         except (ConnectionError, EOFError, ValueError):
             # ValueError = malformed/hostile frame (bad tag, bad version,
@@ -298,6 +302,121 @@ class VarServer:
     def shutdown(self):
         self._server.shutdown()
         self._server.server_close()
+
+
+class NativeVarServer:
+    """C++-transport variant of VarServer (native/frame_server.cc): socket
+    accept, frame validation, HMAC checking and reply writes run on C++
+    threads with no GIL; Python worker threads only decode validated
+    payloads and run the service verbs (the reference's split between the
+    C++ AsyncGRPCServer and its RequestHandlers).  Same wire protocol,
+    same dedup/at-most-once semantics, drop-in for VarServer."""
+
+    def __init__(self, endpoint, service):
+        from ..native import get_lib as _load_native
+
+        lib = _load_native()
+        if lib is None:
+            raise RuntimeError(
+                "native frame server unavailable (libpaddle_tpu_native.so "
+                "failed to build) — use VarServer")
+        self._lib = lib
+        host, port = endpoint.rsplit(":", 1)
+        key = _hmac_key() or b""
+        self._h = lib.fs_create((host or "127.0.0.1").encode(), int(port),
+                                key)
+        if not self._h:
+            raise OSError("fs_create failed for %s" % endpoint)
+        self.endpoint = "%s:%d" % (host or "127.0.0.1", lib.fs_port(self._h))
+        self.service = service
+        self._threads = []
+        self._closing = threading.Event()
+        import collections
+
+        self.dedup = collections.OrderedDict()
+        self.dedup_lock = threading.Lock()
+        self._h_lock = threading.Lock()
+
+    def _pop_loop(self):
+        """Single popper: drains validated requests from C++ and hands each
+        to its own handler thread — blocking verbs (sync barriers waiting
+        on all trainers) must never starve the pop loop, mirroring the
+        Python transport's thread-per-connection behavior."""
+        import ctypes
+
+        lib = self._lib
+        while not self._closing.is_set():
+            req = lib.fs_next(self._h, 200)
+            if not req:
+                continue
+            try:
+                n = ctypes.c_uint64()
+                ptr = lib.fs_req_data(req, ctypes.byref(n))
+                body = ctypes.string_at(ptr, n.value)
+                conn = lib.fs_req_conn(req)
+            finally:
+                lib.fs_req_free(req)
+            t = threading.Thread(target=self._handle_one, args=(body, conn),
+                                 daemon=True)
+            t.start()
+
+    def _handle_one(self, body, conn):
+        try:
+            r = _Reader(body)
+            msg = r.decode()
+            if r.pos != len(r.buf):  # same trailing-bytes rule as _recv_msg
+                return
+            verb, kwargs, req_id = msg
+        except (ValueError, TypeError):
+            return  # C++ validated framing; a bad payload is dropped
+        if verb == "__close__":
+            return
+        result = _execute_once(self.dedup, self.dedup_lock, self.service,
+                               verb, kwargs, req_id)
+        payload = bytes(_encode(result, bytearray()))
+        # a handler can outlive shutdown(): only touch the C++ server
+        # while the handle is still alive, under the lifecycle lock
+        with self._h_lock:
+            if self._h:
+                self._lib.fs_send(self._h, conn, payload, len(payload))
+
+    def start(self):
+        t = threading.Thread(target=self._pop_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def wait(self):
+        for t in self._threads:
+            t.join()
+
+    def shutdown(self):
+        self._closing.set()
+        for t in self._threads:  # popper exits within its 200ms poll
+            t.join(timeout=5)
+        with self._h_lock:
+            h, self._h = self._h, None
+        if h:
+            self._lib.fs_close(h)
+
+
+def make_var_server(endpoint, service):
+    """Transport selector: the C++ frame server when
+    PADDLE_TPU_NATIVE_RPC=1 and the native lib builds, else the Python
+    socketserver transport.  Both speak the identical wire protocol."""
+    if os.environ.get("PADDLE_TPU_NATIVE_RPC", "0") == "1":
+        try:
+            return NativeVarServer(endpoint, service)
+        except (RuntimeError, OSError) as e:
+            import sys
+
+            # the operator explicitly opted in — a silent fallback would
+            # fake the transport they asked for
+            sys.stderr.write(
+                "WARNING: PADDLE_TPU_NATIVE_RPC=1 but the native frame "
+                "server is unavailable (%s); falling back to the Python "
+                "transport\n" % e)
+    return VarServer(endpoint, service)
 
 
 class RPCClient:

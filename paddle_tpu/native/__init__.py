@@ -113,6 +113,24 @@ def get_lib():
         lib.rio_scanner_error.restype = ctypes.c_int
         lib.rio_scanner_error.argtypes = [ctypes.c_void_p]
         lib.rio_loader_close.argtypes = [ctypes.c_void_p]
+        # frame_server.cc (native RPC transport)
+        lib.fs_create.restype = ctypes.c_void_p
+        lib.fs_create.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                  ctypes.c_char_p]
+        lib.fs_port.restype = ctypes.c_int
+        lib.fs_port.argtypes = [ctypes.c_void_p]
+        lib.fs_next.restype = ctypes.c_void_p
+        lib.fs_next.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.fs_req_data.restype = ctypes.POINTER(ctypes.c_char)
+        lib.fs_req_data.argtypes = [ctypes.c_void_p,
+                                    ctypes.POINTER(ctypes.c_uint64)]
+        lib.fs_req_conn.restype = ctypes.c_uint64
+        lib.fs_req_conn.argtypes = [ctypes.c_void_p]
+        lib.fs_req_free.argtypes = [ctypes.c_void_p]
+        lib.fs_send.restype = ctypes.c_int
+        lib.fs_send.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                ctypes.c_char_p, ctypes.c_uint64]
+        lib.fs_close.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
 

@@ -1,0 +1,370 @@
+// GIL-free TCP transport for the typed-frame RPC protocol.
+//
+// Native counterpart of the reference's C++ variable-transport server
+// (operators/distributed/grpc_server.h:46 AsyncGRPCServer + the legacy
+// epoll LightNetwork.cpp): socket accept/read/frame-validation/HMAC and
+// reply writes all run on C++ threads with no Python involvement; decoded
+// request payloads flow to Python workers (the RequestHandler role) over
+// a blocking queue via ctypes.  The wire format is exactly
+// distributed/rpc.py's: [8B BE length][1B version][optional 32B
+// HMAC-SHA256][typed payload].  Malformed frames (bad length/version/MAC)
+// drop the connection in C++ — hostile bytes never reach Python.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// --------------------------------------------------------------------------
+// compact SHA-256 (public-domain style implementation) + HMAC
+// --------------------------------------------------------------------------
+struct Sha256 {
+  uint32_t h[8];
+  uint64_t len = 0;
+  uint8_t buf[64];
+  size_t buflen = 0;
+
+  Sha256() {
+    static const uint32_t init[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372,
+                                     0xa54ff53a, 0x510e527f, 0x9b05688c,
+                                     0x1f83d9ab, 0x5be0cd19};
+    memcpy(h, init, sizeof(h));
+  }
+
+  static uint32_t rotr(uint32_t x, int n) { return (x >> n) | (x << (32 - n)); }
+
+  void block(const uint8_t* p) {
+    static const uint32_t k[64] = {
+        0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b,
+        0x59f111f1, 0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01,
+        0x243185be, 0x550c7dc3, 0x72be5d74, 0x80deb1fe, 0x9bdc06a7,
+        0xc19bf174, 0xe49b69c1, 0xefbe4786, 0x0fc19dc6, 0x240ca1cc,
+        0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da, 0x983e5152,
+        0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+        0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc,
+        0x53380d13, 0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85,
+        0xa2bfe8a1, 0xa81a664b, 0xc24b8b70, 0xc76c51a3, 0xd192e819,
+        0xd6990624, 0xf40e3585, 0x106aa070, 0x19a4c116, 0x1e376c08,
+        0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a, 0x5b9cca4f,
+        0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+        0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+    uint32_t w[64];
+    for (int i = 0; i < 16; i++)
+      w[i] = (uint32_t(p[i * 4]) << 24) | (uint32_t(p[i * 4 + 1]) << 16) |
+             (uint32_t(p[i * 4 + 2]) << 8) | uint32_t(p[i * 4 + 3]);
+    for (int i = 16; i < 64; i++) {
+      uint32_t s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+      uint32_t s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+      w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint32_t a = h[0], b = h[1], c = h[2], d = h[3], e = h[4], f = h[5],
+             g = h[6], hh = h[7];
+    for (int i = 0; i < 64; i++) {
+      uint32_t S1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+      uint32_t ch = (e & f) ^ (~e & g);
+      uint32_t t1 = hh + S1 + ch + k[i] + w[i];
+      uint32_t S0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+      uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+      uint32_t t2 = S0 + maj;
+      hh = g; g = f; f = e; e = d + t1;
+      d = c; c = b; b = a; a = t1 + t2;
+    }
+    h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+    h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+  }
+
+  void update(const uint8_t* p, size_t n) {
+    len += n;
+    while (n) {
+      size_t take = std::min(n, sizeof(buf) - buflen);
+      memcpy(buf + buflen, p, take);
+      buflen += take; p += take; n -= take;
+      if (buflen == 64) { block(buf); buflen = 0; }
+    }
+  }
+
+  void final(uint8_t out[32]) {
+    uint64_t bits = len * 8;
+    uint8_t pad = 0x80;
+    update(&pad, 1);
+    uint8_t z = 0;
+    while (buflen != 56) update(&z, 1);
+    uint8_t lb[8];
+    for (int i = 0; i < 8; i++) lb[i] = uint8_t(bits >> (56 - 8 * i));
+    update(lb, 8);
+    for (int i = 0; i < 8; i++) {
+      out[i * 4] = uint8_t(h[i] >> 24);
+      out[i * 4 + 1] = uint8_t(h[i] >> 16);
+      out[i * 4 + 2] = uint8_t(h[i] >> 8);
+      out[i * 4 + 3] = uint8_t(h[i]);
+    }
+  }
+};
+
+void hmac_sha256(const std::string& key, const uint8_t* msg, size_t n,
+                 uint8_t out[32]) {
+  uint8_t k[64] = {0};
+  if (key.size() > 64) {
+    Sha256 kh;
+    kh.update(reinterpret_cast<const uint8_t*>(key.data()), key.size());
+    kh.final(k);
+  } else {
+    memcpy(k, key.data(), key.size());
+  }
+  uint8_t ipad[64], opad[64];
+  for (int i = 0; i < 64; i++) { ipad[i] = k[i] ^ 0x36; opad[i] = k[i] ^ 0x5c; }
+  uint8_t inner[32];
+  Sha256 hi;
+  hi.update(ipad, 64); hi.update(msg, n); hi.final(inner);
+  Sha256 ho;
+  ho.update(opad, 64); ho.update(inner, 32); ho.final(out);
+}
+
+bool const_time_eq(const uint8_t* a, const uint8_t* b, size_t n) {
+  uint8_t d = 0;
+  for (size_t i = 0; i < n; i++) d |= a[i] ^ b[i];
+  return d == 0;
+}
+
+// --------------------------------------------------------------------------
+// server
+// --------------------------------------------------------------------------
+constexpr uint8_t kProtoVersion = 1;
+constexpr uint64_t kMaxFrame = 1ull << 33;
+
+struct Request {
+  uint64_t conn_id;
+  std::string body;  // payload with version+mac stripped
+};
+
+struct Conn {
+  int fd;
+  std::mutex write_mu;
+};
+
+struct Server {
+  int listen_fd = -1;
+  int port = 0;
+  std::string hmac_key;
+  std::atomic<bool> closing{false};
+  std::thread accept_thread;
+  // readers detach themselves (no per-connection thread handle kept, so
+  // reconnect churn cannot grow memory); fs_close waits on this count
+  std::atomic<int> active_readers{0};
+  std::mutex reap_mu;
+  std::condition_variable reap_cv;
+  std::mutex conns_mu;
+  std::map<uint64_t, std::shared_ptr<Conn>> conns;
+  std::atomic<uint64_t> next_id{1};
+
+  std::mutex q_mu;
+  std::condition_variable q_cv;
+  std::deque<Request*> queue;
+
+  bool read_exact(int fd, uint8_t* p, size_t n) {
+    while (n) {
+      ssize_t r = recv(fd, p, n, 0);
+      if (r <= 0) return false;
+      p += r; n -= size_t(r);
+    }
+    return true;
+  }
+
+  void reader_loop(uint64_t id, std::shared_ptr<Conn> c) {
+    active_readers++;
+    for (;;) {
+      uint8_t lb[8];
+      if (!read_exact(c->fd, lb, 8)) break;
+      uint64_t n = 0;
+      for (int i = 0; i < 8; i++) n = (n << 8) | lb[i];
+      if (n < 1 || n > kMaxFrame) break;  // length bomb / nonsense
+      std::string frame(n, '\0');
+      if (!read_exact(c->fd, reinterpret_cast<uint8_t*>(&frame[0]), n)) break;
+      if (uint8_t(frame[0]) != kProtoVersion) break;  // version mismatch
+      const uint8_t* body = reinterpret_cast<const uint8_t*>(frame.data()) + 1;
+      size_t blen = n - 1;
+      if (!hmac_key.empty()) {
+        if (blen < 32) break;
+        uint8_t want[32];
+        hmac_sha256(hmac_key, body + 32, blen - 32, want);
+        if (!const_time_eq(body, want, 32)) break;  // forged MAC
+        body += 32; blen -= 32;
+      }
+      auto* req = new Request{id, std::string(
+          reinterpret_cast<const char*>(body), blen)};
+      {
+        std::lock_guard<std::mutex> lk(q_mu);
+        queue.push_back(req);
+      }
+      q_cv.notify_one();
+    }
+    {
+      std::lock_guard<std::mutex> lk(conns_mu);
+      conns.erase(id);
+    }
+    {
+      // fs_send may hold the Conn shared_ptr: mark it dead UNDER the
+      // write lock before close so no reply is ever written to a closed
+      // (possibly kernel-reused) fd
+      std::lock_guard<std::mutex> lk(c->write_mu);
+      close(c->fd);
+      c->fd = -1;
+    }
+    active_readers--;
+    reap_cv.notify_all();
+  }
+
+  void accept_loop() {
+    for (;;) {
+      int fd = accept(listen_fd, nullptr, nullptr);
+      if (fd < 0) {
+        if (closing) return;
+        continue;
+      }
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      auto c = std::make_shared<Conn>();
+      c->fd = fd;
+      uint64_t id = next_id++;
+      {
+        std::lock_guard<std::mutex> lk(conns_mu);
+        conns[id] = c;
+      }
+      std::thread([this, id, c] { reader_loop(id, c); }).detach();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* fs_create(const char* host, int port, const char* hmac_key) {
+  auto* s = new Server();
+  if (hmac_key && hmac_key[0]) s->hmac_key = hmac_key;
+  s->listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (s->listen_fd < 0) { delete s; return nullptr; }
+  int one = 1;
+  setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(uint16_t(port));
+  addr.sin_addr.s_addr = host && host[0] ? inet_addr(host)
+                                         : htonl(INADDR_LOOPBACK);
+  if (bind(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) ||
+      listen(s->listen_fd, 128)) {
+    close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  s->port = ntohs(addr.sin_port);
+  s->accept_thread = std::thread([s] { s->accept_loop(); });
+  return s;
+}
+
+int fs_port(void* h) { return static_cast<Server*>(h)->port; }
+
+// Pop the next validated request; returns an opaque handle or NULL on
+// timeout/shutdown.
+void* fs_next(void* h, int timeout_ms) {
+  auto* s = static_cast<Server*>(h);
+  std::unique_lock<std::mutex> lk(s->q_mu);
+  auto pred = [&] { return s->closing || !s->queue.empty(); };
+  if (timeout_ms < 0) {
+    s->q_cv.wait(lk, pred);
+  } else if (!s->q_cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                               pred)) {
+    return nullptr;
+  }
+  if (s->queue.empty()) return nullptr;
+  Request* r = s->queue.front();
+  s->queue.pop_front();
+  return r;
+}
+
+const char* fs_req_data(void* req, uint64_t* len) {
+  auto* r = static_cast<Request*>(req);
+  *len = r->body.size();
+  return r->body.data();
+}
+
+uint64_t fs_req_conn(void* req) { return static_cast<Request*>(req)->conn_id; }
+
+void fs_req_free(void* req) { delete static_cast<Request*>(req); }
+
+// Frame (length+version+mac) and write a reply payload to a connection.
+int fs_send(void* h, uint64_t conn_id, const char* data, uint64_t len) {
+  auto* s = static_cast<Server*>(h);
+  std::shared_ptr<Conn> c;
+  {
+    std::lock_guard<std::mutex> lk(s->conns_mu);
+    auto it = s->conns.find(conn_id);
+    if (it == s->conns.end()) return -1;
+    c = it->second;
+  }
+  std::string mac;
+  if (!s->hmac_key.empty()) {
+    uint8_t m[32];
+    hmac_sha256(s->hmac_key, reinterpret_cast<const uint8_t*>(data), len, m);
+    mac.assign(reinterpret_cast<char*>(m), 32);
+  }
+  uint64_t n = 1 + mac.size() + len;
+  std::string head(9 + mac.size(), '\0');
+  for (int i = 0; i < 8; i++) head[i] = char(n >> (56 - 8 * i));
+  head[8] = char(kProtoVersion);
+  memcpy(&head[9], mac.data(), mac.size());
+  std::lock_guard<std::mutex> lk(c->write_mu);
+  if (c->fd < 0) return -1;  // reader closed it (peer gone)
+  if (send(c->fd, head.data(), head.size(), MSG_NOSIGNAL) !=
+      ssize_t(head.size()))
+    return -1;
+  uint64_t off = 0;
+  while (off < len) {
+    ssize_t w = send(c->fd, data + off, len - off, MSG_NOSIGNAL);
+    if (w <= 0) return -1;
+    off += uint64_t(w);
+  }
+  return 0;
+}
+
+void fs_close(void* h) {
+  auto* s = static_cast<Server*>(h);
+  s->closing = true;
+  shutdown(s->listen_fd, SHUT_RDWR);
+  close(s->listen_fd);
+  {
+    std::lock_guard<std::mutex> lk(s->conns_mu);
+    for (auto& kv : s->conns) shutdown(kv.second->fd, SHUT_RDWR);
+  }
+  s->q_cv.notify_all();
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  {
+    std::unique_lock<std::mutex> lk(s->reap_mu);
+    s->reap_cv.wait(lk, [&] { return s->active_readers.load() == 0; });
+  }
+  {
+    std::lock_guard<std::mutex> lk(s->q_mu);
+    for (auto* r : s->queue) delete r;
+    s->queue.clear();
+  }
+  delete s;
+}
+
+}  // extern "C"
