@@ -336,6 +336,8 @@ class NativeVarServer:
         self.dedup = collections.OrderedDict()
         self.dedup_lock = threading.Lock()
         self._h_lock = threading.Lock()
+        self._h_cv = threading.Condition(self._h_lock)
+        self._inflight_sends = 0
 
     def _pop_loop(self):
         """Single popper: drains validated requests from C++ and hands each
@@ -374,11 +376,21 @@ class NativeVarServer:
         result = _execute_once(self.dedup, self.dedup_lock, self.service,
                                verb, kwargs, req_id)
         payload = bytes(_encode(result, bytearray()))
-        # a handler can outlive shutdown(): only touch the C++ server
-        # while the handle is still alive, under the lifecycle lock
-        with self._h_lock:
-            if self._h:
-                self._lib.fs_send(self._h, conn, payload, len(payload))
+        # a handler can outlive shutdown(): take an in-flight ticket under
+        # the lifecycle lock, but run the (possibly blocking) TCP write
+        # OUTSIDE it — one stalled peer must not freeze other replies.
+        # shutdown() waits for in-flight sends before freeing the server.
+        with self._h_cv:
+            h = self._h
+            if not h:
+                return
+            self._inflight_sends += 1
+        try:
+            self._lib.fs_send(h, conn, payload, len(payload))
+        finally:
+            with self._h_cv:
+                self._inflight_sends -= 1
+                self._h_cv.notify_all()
 
     def start(self):
         t = threading.Thread(target=self._pop_loop, daemon=True)
@@ -394,8 +406,12 @@ class NativeVarServer:
         self._closing.set()
         for t in self._threads:  # popper exits within its 200ms poll
             t.join(timeout=5)
-        with self._h_lock:
+        with self._h_cv:
             h, self._h = self._h, None
+            # wait out in-flight replies; fs_close also closes every
+            # connection, which unblocks any send stalled on a dead peer
+            self._h_cv.wait_for(lambda: self._inflight_sends == 0,
+                                timeout=10)
         if h:
             self._lib.fs_close(h)
 

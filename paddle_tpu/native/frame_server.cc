@@ -11,6 +11,7 @@
 // drop the connection in C++ — hostile bytes never reach Python.
 
 #include <arpa/inet.h>
+#include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
@@ -186,7 +187,8 @@ struct Server {
   }
 
   void reader_loop(uint64_t id, std::shared_ptr<Conn> c) {
-    active_readers++;
+    // active_readers was incremented by accept_loop BEFORE this thread
+    // was spawned, so fs_close can never miss a just-accepted reader
     for (;;) {
       uint8_t lb[8];
       if (!read_exact(c->fd, lb, 8)) break;
@@ -225,7 +227,12 @@ struct Server {
       close(c->fd);
       c->fd = -1;
     }
-    active_readers--;
+    {
+      // decrement + notify under reap_mu: without the lock the wakeup
+      // can land in fs_close's predicate-check window and be lost
+      std::lock_guard<std::mutex> lk(reap_mu);
+      active_readers--;
+    }
     reap_cv.notify_all();
   }
 
@@ -244,6 +251,10 @@ struct Server {
       {
         std::lock_guard<std::mutex> lk(conns_mu);
         conns[id] = c;
+      }
+      {
+        std::lock_guard<std::mutex> lk(reap_mu);
+        active_readers++;
       }
       std::thread([this, id, c] { reader_loop(id, c); }).detach();
     }
@@ -264,8 +275,21 @@ void* fs_create(const char* host, int port, const char* hmac_key) {
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_port = htons(uint16_t(port));
-  addr.sin_addr.s_addr = host && host[0] ? inet_addr(host)
-                                         : htonl(INADDR_LOOPBACK);
+  if (host && host[0]) {
+    // hostname-capable resolution (inet_addr only parses dotted quads)
+    addrinfo hints{}, *res = nullptr;
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    if (getaddrinfo(host, nullptr, &hints, &res) != 0 || res == nullptr) {
+      close(s->listen_fd);
+      delete s;
+      return nullptr;
+    }
+    addr.sin_addr = reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr;
+    freeaddrinfo(res);
+  } else {
+    addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  }
   if (bind(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) ||
       listen(s->listen_fd, 128)) {
     close(s->listen_fd);

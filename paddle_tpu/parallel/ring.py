@@ -90,10 +90,12 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None,
             # src == my: the diagonal chunk (causal within); src < my:
             # fully visible; src > my: fully masked (skip — contributes
             # exp(-1e30) ≈ 0 through the lse merge)
+            vma = tuple(
+                getattr(jax.typeof(q), "vma", frozenset()) | {axis_name})
             skip_o = jax.lax.pcast(
-                jnp.zeros(q.shape, jnp.float32), (axis_name,), to="varying")
+                jnp.zeros(q.shape, jnp.float32), vma, to="varying")
             skip_lse = jax.lax.pcast(
-                jnp.full(q.shape[:-1], _NEG, jnp.float32), (axis_name,), to="varying")
+                jnp.full(q.shape[:-1], _NEG, jnp.float32), vma, to="varying")
             return jax.lax.cond(
                 src == my,
                 lambda: _flash_piece_bhtd(q, k_blk, v_blk, True, scale),
@@ -122,11 +124,13 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None,
         v_nxt = jax.lax.ppermute(v_blk, axis_name, perm)
         return (k_nxt, v_nxt, o_new, lse_new), None
 
-    # mark the accumulators device-varying over the ring axis so the scan
-    # carry type matches the body output under shard_map
-    o0 = jax.lax.pcast(jnp.zeros(q.shape, jnp.float32), (axis_name,), to="varying")
+    # mark the accumulators device-varying over every axis the inputs vary
+    # on (the ring axis, plus e.g. a dp axis on a composite mesh) so the
+    # scan carry type matches the body output under shard_map
+    vma = tuple(getattr(jax.typeof(q), "vma", frozenset()) | {axis_name})
+    o0 = jax.lax.pcast(jnp.zeros(q.shape, jnp.float32), vma, to="varying")
     lse0 = jax.lax.pcast(
-        jnp.full(q.shape[:-1], -jnp.inf, jnp.float32), (axis_name,), to="varying")
+        jnp.full(q.shape[:-1], -jnp.inf, jnp.float32), vma, to="varying")
     (_, _, o_f, _), _ = jax.lax.scan(
         step, (k, v, o0, lse0), jnp.arange(n))
     return o_f.astype(q.dtype)
