@@ -104,12 +104,24 @@ def _bench_impl():
         out = exe.run(main_prog, feed=feed, fetch_list=fetches)
     np.asarray(out[0])  # sync
 
-    t0 = time.time()
-    for _ in range(steps):
-        out = exe.run(main_prog, feed=feed, fetch_list=fetches,
-                      return_numpy=False)
-    jax.block_until_ready(out)  # sync on the final step
-    dt = time.time() - t0
+    # BENCH_PROFILE=<dir>: capture a device trace (xplane) of the timed
+    # steps for MFU attribution — TensorBoard/xprof readable
+    profile_dir = os.environ.get("BENCH_PROFILE", "")
+    if profile_dir:
+        jax.profiler.start_trace(profile_dir)
+    try:
+        t0 = time.time()
+        for _ in range(steps):
+            out = exe.run(main_prog, feed=feed, fetch_list=fetches,
+                          return_numpy=False)
+        jax.block_until_ready(out)  # sync on the final step
+        dt = time.time() - t0
+    finally:
+        if profile_dir:
+            try:
+                jax.profiler.stop_trace()
+            except RuntimeError as e:
+                sys.stderr.write("BENCH_PROFILE trace not written: %r\n" % e)
     if use_reader:
         reader.reset()
 

@@ -24,11 +24,14 @@ _BF16_OPS = ("mul", "matmul", "conv2d", "depthwise_conv2d", "fused_attention")
 _KEEP_F32_SLOTS = {"fused_attention": ("Bias",)}
 
 
-def rewrite_bf16(program=None, ops=_BF16_OPS):
-    """Insert bf16 casts around matmul-class ops (in place).  Must run
-    BEFORE optimizer.minimize so the grad ops differentiate through the
-    casts.  Returns the count of rewritten ops."""
+def rewrite_bf16(program=None, ops=_BF16_OPS, dtype="bfloat16"):
+    """Insert half-precision casts around matmul-class ops (in place).
+    Must run BEFORE optimizer.minimize so the grad ops differentiate
+    through the casts.  Returns the count of rewritten ops.  dtype
+    "bfloat16" is the TPU-native training regime; "float16" mirrors the
+    reference's fp16 inference transpiler (paddle/contrib/float16)."""
     program = program or framework.default_main_program()
+    tag = "BF16" if dtype == "bfloat16" else "FP16"
     block = program.global_block()
     new_ops = []
     count = 0
@@ -69,7 +72,7 @@ def rewrite_bf16(program=None, ops=_BF16_OPS):
                 if slot in keep_f32:
                     continue
                 op.inputs[slot] = [
-                    cast_var(n, "bfloat16", "BF16") for n in names
+                    cast_var(n, dtype, tag) for n in names
                 ]
             new_ops.append(op)
             # cast outputs back to f32, keeping downstream names intact:
@@ -77,19 +80,19 @@ def rewrite_bf16(program=None, ops=_BF16_OPS):
             for slot, names in list(op.outputs.items()):
                 restored = []
                 for n in names:
-                    raw = n + "@RAW_BF16"
+                    raw = n + "@RAW_" + tag
                     v = block._find_var_recursive(n)
                     block.create_var(
                         name=raw,
                         shape=list(v.shape) if v is not None and v.shape else None,
-                        dtype="bfloat16",
+                        dtype=dtype,
                     )
                     cast_back = framework.Operator(
                         block,
                         "cast",
                         None,
                         None,
-                        {"in_dtype": "bfloat16", "out_dtype": "float32"},
+                        {"in_dtype": dtype, "out_dtype": "float32"},
                     )
                     cast_back.inputs = {"X": [raw]}
                     cast_back.outputs = {"Out": [n]}
@@ -99,13 +102,20 @@ def rewrite_bf16(program=None, ops=_BF16_OPS):
                     new_ops.append(cb)
                     # cast-back redefines the original name: a later bf16
                     # cast of it must re-derive from the new value
-                    cast_cache.pop((cb.outputs["Out"][0], "bfloat16"), None)
+                    cast_cache.pop((cb.outputs["Out"][0], dtype), None)
         else:
             new_ops.append(op)
             # anything redefined later must not serve a stale cast
             for names in op.outputs.values():
                 for n in names:
-                    cast_cache.pop((n, "bfloat16"), None)
+                    cast_cache.pop((n, dtype), None)
     block.ops = new_ops
     program._bump_version()
     return count
+
+
+def rewrite_fp16(program=None, ops=_BF16_OPS):
+    """float16 inference rewrite (paddle/contrib/float16 transpiler
+    parity): same cast insertion with IEEE fp16.  Prefer bf16 for
+    training on TPU (fp16's 5-bit exponent underflows grads)."""
+    return rewrite_bf16(program, ops, dtype="float16")

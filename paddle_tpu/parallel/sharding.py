@@ -12,7 +12,8 @@ import re
 
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-__all__ = ["ShardingRules", "data_parallel_rules", "transformer_tp_rules", "zero1_rules", "P"]
+__all__ = ["ShardingRules", "data_parallel_rules",
+           "transformer_tp_rules", "zero1_rules", "zero3_rules", "P"]
 
 
 class ShardingRules:
@@ -24,14 +25,18 @@ class ShardingRules:
         self.default = default
 
     def spec_for(self, name, ndim=None):
+        def guard(spec):
+            # rank guard: a spec with more named axes than the value has
+            # dims (optimizer beta_pow scalars, 0-d counters) replicates —
+            # including when the DEFAULT itself shards (zero3_rules)
+            if ndim is not None and len(spec) > ndim:
+                return P()
+            return spec
+
         for pat, spec in self.rules:
             if pat.search(name):
-                # rank guard: optimizer scalars (beta_pow etc.) share the
-                # param's name prefix but not its rank — replicate those
-                if ndim is not None and len(spec) > ndim:
-                    return self.default
-                return spec
-        return self.default
+                return guard(spec)
+        return guard(self.default)
 
     def sharding_for(self, mesh, name, ndim=None):
         return NamedSharding(mesh, self.spec_for(name, ndim))
@@ -61,6 +66,24 @@ def transformer_tp_rules(mp_axis="mp"):
             (r"softmax_out\.w", P(None, mp_axis)),
         ]
     )
+
+
+def zero3_rules(dp_axis="dp", base=None):
+    """ZeRO stage-3 capability, declaratively: PARAMETERS (and their
+    optimizer state, via the stacked zero1 rules) shard their leading dim
+    over the data-parallel axis.  XLA's SPMD partitioner inserts the
+    per-use all-gather of each weight and the reduce-scatter of its
+    gradient — the collective choreography ZeRO-3 hand-schedules.  The
+    executor's divisibility guard keeps small/indivisible tensors
+    replicated, so any model compiles.  Compose with TP via `base`.
+    """
+    rules = zero1_rules(dp_axis)
+    # params: anything not matching the accumulator patterns falls through
+    # to the default — shard dim 0 over dp (guards replicate misfits)
+    rules.default = P(dp_axis)
+    if base is not None:
+        rules.rules = rules.rules + list(base.rules)
+    return rules
 
 
 def zero1_rules(dp_axis="dp", base=None):

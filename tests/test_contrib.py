@@ -151,3 +151,31 @@ def test_memory_usage_and_op_freq():
     assert 0 < low <= high
     singles, pairs = op_freq_statistic(prog)
     assert singles.get("mul", 0) >= 2 or singles.get("matmul", 0) >= 2
+
+
+def test_fp16_inference_rewrite_matches_f32():
+    """rewrite_fp16 (contrib/float16 transpiler parity): fp16-cast
+    inference program stays close to the f32 reference."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.contrib.mixed_precision import rewrite_fp16
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.framework.program_guard(main, startup):
+        startup.random_seed = 3
+        x = layers.data("x", shape=[16])
+        y = layers.fc(layers.fc(x, 32, act="relu"), 4, act="softmax")
+    xv = np.random.RandomState(0).rand(4, 16).astype("float32")
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        (ref,) = exe.run(main, feed={"x": xv}, fetch_list=[y])
+        n = rewrite_fp16(main)
+        assert n >= 2
+        (out,) = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-2, atol=2e-3)
+    assert any("@FP16" in op.outputs.get("Out", [""])[0]
+               for op in main.global_block().ops if op.type == "cast")

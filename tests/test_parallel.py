@@ -349,40 +349,48 @@ def test_ulysses_attention_matches_dense():
                                atol=5e-5)
 
 
-def test_zero1_optimizer_state_sharding():
-    """ZeRO-1 rules: Adam moments shard over dp, params stay replicated,
-    and training matches the all-replicated run step for step."""
+def _zero_rules_train(rules):
+    """Shared harness for the ZeRO rules tests: fresh programs/scope, a
+    2-layer fc + Adam model, 5 steps on a dp=8 mesh; returns (losses,
+    scope) for sharding introspection."""
     import paddle_tpu.framework as fw
     from paddle_tpu import unique_name
     from paddle_tpu.core import scope as scope_mod
 
+    fw.switch_main_program(fluid.Program())
+    fw.switch_startup_program(fluid.Program())
+    unique_name.switch()
+    scope_mod._switch_scope(scope_mod.Scope())
+    img = layers.data("zimg", shape=[32])
+    label = layers.data("zlabel", shape=[1], dtype="int64")
+    hidden = layers.fc(img, size=64, act="relu")
+    pred = layers.fc(hidden, size=4, act="softmax")
+    loss = layers.mean(layers.cross_entropy(pred, label))
+    fluid.optimizer.Adam(0.01).minimize(loss)
+    prog = fluid.default_main_program()
+    prog.random_seed = 5
+    fluid.default_startup_program().random_seed = 5
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    mesh = parallel.make_mesh({"dp": 8})
+    dexe = parallel.DistributedExecutor(mesh, rules, main_program=prog)
+    rng = np.random.RandomState(0)
+    x = rng.rand(32, 32).astype("float32")
+    y = rng.randint(0, 4, (32, 1)).astype("int64")
+    losses = [
+        float(np.asarray(dexe.run([loss], feed={"zimg": x,
+                                                "zlabel": y})[0]).reshape(-1)[0])
+        for _ in range(5)
+    ]
+    return losses, fluid.global_scope()
+
+
+def test_zero1_optimizer_state_sharding():
+    """ZeRO-1 rules: Adam moments shard over dp, params stay replicated,
+    and training matches the all-replicated run step for step."""
+
     def run(rules):
-        fw.switch_main_program(fluid.Program())
-        fw.switch_startup_program(fluid.Program())
-        unique_name.switch()
-        scope_mod._switch_scope(scope_mod.Scope())
-        img = layers.data("zimg", shape=[32])
-        label = layers.data("zlabel", shape=[1], dtype="int64")
-        hidden = layers.fc(img, size=64, act="relu")
-        pred = layers.fc(hidden, size=4, act="softmax")
-        loss = layers.mean(layers.cross_entropy(pred, label))
-        fluid.optimizer.Adam(0.01).minimize(loss)
-        prog = fluid.default_main_program()
-        prog.random_seed = 5
-        fluid.default_startup_program().random_seed = 5
-        exe = fluid.Executor(fluid.CPUPlace())
-        exe.run(fluid.default_startup_program())
-        mesh = parallel.make_mesh({"dp": 8})
-        dexe = parallel.DistributedExecutor(mesh, rules,
-                                            main_program=prog)
-        rng = np.random.RandomState(0)
-        x = rng.rand(32, 32).astype("float32")
-        y = rng.randint(0, 4, (32, 1)).astype("int64")
-        losses = [
-            float(np.asarray(dexe.run([loss], feed={"zimg": x, "zlabel": y})[0]).reshape(-1)[0])
-            for _ in range(5)
-        ]
-        scope = fluid.global_scope()
+        losses, scope = _zero_rules_train(rules)
         moments = [n for n in scope.local_var_names() if "_moment1" in n]
         assert moments
         shardings = {n: str(scope.find_var(n).sharding.spec) for n in moments}
@@ -589,3 +597,21 @@ def test_gshard_top2_moe_matches_reference_and_reports_drops():
     # grads flow through the top-2 dispatch
     g = jax.grad(lambda gw: jnp.sum(run(gw, stacked, x)[0] ** 2))(gate_w)
     assert np.isfinite(np.asarray(g)).all()
+
+
+def test_zero3_parameter_sharding_matches_replicated():
+    """ZeRO-3 rules: weights themselves shard over dp (XLA inserts the
+    per-use all-gathers), training matches the replicated run."""
+
+    def run(rules):
+        losses, scope = _zero_rules_train(rules)
+        params = [n for n in scope.local_var_names()
+                  if n.endswith(".w_0") and "moment" not in n]
+        pspecs = {n: str(scope.find_var(n).sharding.spec) for n in params}
+        return losses, pspecs
+
+    plain_losses, _ = run(parallel.data_parallel_rules())
+    z_losses, z_params = run(parallel.zero3_rules("dp"))
+    np.testing.assert_allclose(z_losses, plain_losses, rtol=1e-4, atol=1e-6)
+    # at least one weight actually sharded over dp
+    assert any("dp" in s for s in z_params.values()), z_params
