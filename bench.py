@@ -108,7 +108,11 @@ def _bench_impl():
     # steps for MFU attribution — TensorBoard/xprof readable
     profile_dir = os.environ.get("BENCH_PROFILE", "")
     if profile_dir:
-        jax.profiler.start_trace(profile_dir)
+        try:
+            jax.profiler.start_trace(profile_dir)
+        except (RuntimeError, OSError) as e:
+            sys.stderr.write("BENCH_PROFILE disabled (%r)\n" % (e,))
+            profile_dir = ""
     try:
         t0 = time.time()
         for _ in range(steps):

@@ -110,8 +110,51 @@ def rewrite_bf16(program=None, ops=_BF16_OPS, dtype="bfloat16"):
                 for n in names:
                     cast_cache.pop((n, dtype), None)
     block.ops = new_ops
+    collapse_redundant_casts(program, dtype)
     program._bump_version()
     return count
+
+
+def collapse_redundant_casts(program, dtype="bfloat16"):
+    """Peephole: when a half->f32 cast-back feeds an f32->half re-cast,
+    the re-cast collapses — its consumers read the original half tensor
+    directly.  Numerically identical (half->f32->half is exact), but
+    consecutive matmul-class ops stop bouncing activations through f32 in
+    HBM (matmul->matmul chains in transformer blocks).
+
+    The cast-back itself is KEPT: it still defines the original f32 name,
+    which may be a fetch target or a sub-block read the global-block
+    consumer scan cannot see.  When nothing ends up using it, trace-time
+    DCE drops it per fetch set — so the collapse is always safe and the
+    HBM win materializes exactly when the f32 value is unused.
+    Returns the number of collapsed re-casts."""
+    block = program.global_block()
+    by_idx = list(block.ops)
+    # name -> producing cast-back op (half->f32)
+    castback_src = {}
+    for op in by_idx:
+        if (op.type == "cast" and op.attrs.get("out_dtype") == "float32"
+                and op.attrs.get("in_dtype") == dtype):
+            castback_src[op.outputs["Out"][0]] = op.inputs["X"][0]
+    drop = set()
+    renames = {}  # re-cast output -> original half name
+    for i, op in enumerate(by_idx):
+        if (op.type == "cast" and op.attrs.get("out_dtype") == dtype
+                and op.inputs["X"][0] in castback_src):
+            drop.add(i)
+            renames[op.outputs["Out"][0]] = castback_src[op.inputs["X"][0]]
+    if not drop:
+        return 0
+    kept = []
+    for i, op in enumerate(by_idx):
+        if i in drop:
+            continue
+        for slot, names in op.inputs.items():
+            op.inputs[slot] = [renames.get(n, n) for n in names]
+        kept.append(op)
+    block.ops = kept
+    program._bump_version()
+    return len(drop)
 
 
 def rewrite_fp16(program=None, ops=_BF16_OPS):

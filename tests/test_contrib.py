@@ -179,3 +179,53 @@ def test_fp16_inference_rewrite_matches_f32():
                                rtol=2e-2, atol=2e-3)
     assert any("@FP16" in op.outputs.get("Out", [""])[0]
                for op in main.global_block().ops if op.type == "cast")
+
+
+def test_amp_collapses_redundant_cast_roundtrips():
+    """Consecutive matmul-class ops stop bouncing through f32: the
+    bf16->f32->bf16 pair between two fc matmuls collapses with IDENTICAL
+    numerics (half->f32->half is exact)."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.contrib.mixed_precision import rewrite_bf16
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.framework.program_guard(main, startup):
+            startup.random_seed = 9
+            x = layers.data("x", shape=[16])
+            y = layers.fc(layers.fc(x, 32, bias_attr=False), 4,
+                          bias_attr=False)
+        return main, startup, y
+
+    xv = np.random.RandomState(1).rand(4, 16).astype("float32")
+
+    main, startup, y = build()
+    rewrite_bf16(main)
+    casts = [op for op in main.global_block().ops if op.type == "cast"]
+    # 2 muls: without collapsing there would be 2 in-casts + 2 out-casts
+    # + 1 weight cast each = 6; the roundtrip between the muls collapses
+    f32_to_bf16_of_raw = [
+        op for op in casts
+        if op.attrs.get("out_dtype") == "bfloat16"
+        and "@RAW_BF16" in op.inputs["X"][0]]
+    assert not f32_to_bf16_of_raw, [op.inputs for op in casts]
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        (out,) = exe.run(main, feed={"x": xv}, fetch_list=[y])
+
+    # reference: same seeds, uncollapsed semantics == plain bf16 math
+    main2, startup2, y2 = build()
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup2)
+        (ref,) = exe.run(main2, feed={"x": xv}, fetch_list=[y2])
+    # bf16 fc chain vs f32 chain: close but not equal; the collapsed
+    # program must match the f32 reference at bf16 tolerance
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-2, atol=2e-2)
