@@ -130,12 +130,11 @@ def collapse_redundant_casts(program, dtype="bfloat16"):
     Returns the number of collapsed re-casts."""
     block = program.global_block()
     by_idx = list(block.ops)
-    # name -> producing cast-back op (half->f32)
+    # position-aware single pass: castback_src maps an f32 name to its
+    # half source ONLY while both definitions are current — an op that
+    # redefines either name (non-SSA programs) invalidates the entry, so
+    # a consumer can never be rewired across a redefinition
     castback_src = {}
-    for op in by_idx:
-        if (op.type == "cast" and op.attrs.get("out_dtype") == "float32"
-                and op.attrs.get("in_dtype") == dtype):
-            castback_src[op.outputs["Out"][0]] = op.inputs["X"][0]
     drop = set()
     renames = {}  # re-cast output -> original half name
     for i, op in enumerate(by_idx):
@@ -143,6 +142,16 @@ def collapse_redundant_casts(program, dtype="bfloat16"):
                 and op.inputs["X"][0] in castback_src):
             drop.add(i)
             renames[op.outputs["Out"][0]] = castback_src[op.inputs["X"][0]]
+        is_castback = (op.type == "cast"
+                       and op.attrs.get("out_dtype") == "float32"
+                       and op.attrs.get("in_dtype") == dtype)
+        outs = op.output_arg_names()
+        for n in outs:
+            castback_src.pop(n, None)  # f32 name redefined
+            for f32n in [f for f, h in castback_src.items() if h == n]:
+                castback_src.pop(f32n, None)  # half source redefined
+        if is_castback:
+            castback_src[op.outputs["Out"][0]] = op.inputs["X"][0]
     if not drop:
         return 0
     kept = []

@@ -1435,12 +1435,21 @@ def log_loss(input, label, epsilon=1e-4, name=None):
     return out
 
 
-def fused_attention(q, k, v, causal=False, scale=None, bias=None, name=None):
+def fused_attention(q, k, v, causal=False, scale=None, bias=None,
+                    window=0, name=None):
     """Fused scaled-dot-product attention over [batch, heads, T, d]
     (flash-attention kernel under FLAGS_use_pallas).  bias: optional
     additive key-padding bias, rank-1 in the key axis ([B, Tk] or
     [B, 1, 1, Tk]) — covers padding masks without a [Tq, Tk] tensor;
-    combine with causal=True for decoder self-attention."""
+    combine with causal=True for decoder self-attention.  window > 0
+    (requires causal): sliding-window local attention — each query
+    attends only the last `window` positions, and fully-out-of-window
+    blocks are skipped in the flash kernels."""
+    window = int(window)
+    if window < 0:
+        raise ValueError("fused_attention: window must be >= 0")
+    if window and not causal:
+        raise ValueError("fused_attention: window requires causal=True")
     helper = LayerHelper("fused_attention", **locals())
     out = helper.create_variable_for_type_inference(q.dtype)
     inputs = {"Q": [q], "K": [k], "V": [v]}
@@ -1450,7 +1459,7 @@ def fused_attention(q, k, v, causal=False, scale=None, bias=None, name=None):
         "fused_attention",
         inputs=inputs,
         outputs={"Out": [out]},
-        attrs={"causal": causal, "scale": scale},
+        attrs={"causal": causal, "scale": scale, "window": int(window)},
     )
     return out
 

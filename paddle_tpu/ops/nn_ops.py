@@ -649,6 +649,13 @@ def _fused_attention(ctx, ins, attrs):
 
     q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
     causal = bool(attrs.get("causal", False))
+    window = int(attrs.get("window", 0) or 0)  # sliding-window (causal)
+    if window < 0:
+        raise ValueError("fused_attention: window must be >= 0")
+    if window and not causal:
+        raise ValueError(
+            "fused_attention: window requires causal=True (consistent "
+            "across the pallas and dense paths)")
     scale = attrs.get("scale") or 1.0 / (q.shape[-1] ** 0.5)
     b, h, t, d = q.shape
     tk = k.shape[2]
@@ -668,13 +675,16 @@ def _fused_attention(ctx, ins, attrs):
         kbias = ins["Bias"][0].reshape(b, tk).astype(jnp.float32)
         kbias = jnp.broadcast_to(kbias[:, None, :], (b, h, tk)).reshape(b * h, tk)
     if use_pallas() and t % 128 == 0 and tk % 128 == 0:
-        out = flash_attention(qf, kf, vf, kbias, causal, float(scale))
+        out = flash_attention(qf, kf, vf, kbias, causal, float(scale),
+                              window=window)
     elif use_pallas() and min(t, tk) >= 8 and t % 8 == 0 and tk % 8 == 0:
         out = flash_attention(
-            qf, kf, vf, kbias, causal, float(scale), block_q=8, block_k=8
+            qf, kf, vf, kbias, causal, float(scale), block_q=8, block_k=8,
+            window=window
         )
     else:
-        out = _dense_attention(qf, kf, vf, causal, float(scale), kbias)
+        out = _dense_attention(qf, kf, vf, causal, float(scale), kbias,
+                               window=window)
     return {"Out": [out.reshape(b, h, t, d)]}
 
 

@@ -61,7 +61,7 @@ def _row_block(n, default):
 # ---------------------------------------------------------------------------
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, kb_ref, o_ref, lse_ref,
                       acc_ref, m_ref, l_ref, *, block_q, block_k, nk,
-                      causal, scale):
+                      causal, scale, window=0):
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
@@ -75,6 +75,11 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, kb_ref, o_ref, lse_ref,
 
     # causal: blocks entirely above the diagonal contribute nothing
     run = (ki * block_k < (qi + 1) * block_q) if causal else (ki >= 0)
+    if window:
+        # sliding window: blocks entirely older than q_min - window + 1
+        # contribute nothing
+        run = run & (ki * block_k + block_k - 1
+                     >= qi * block_q - window + 1)
 
     @pl.when(run)
     def _compute():
@@ -88,7 +93,10 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, kb_ref, o_ref, lse_ref,
                 jnp.int32, s.shape, 0)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            keep = q_pos >= k_pos
+            if window:  # sliding window: only the last `window` positions
+                keep = keep & (q_pos - k_pos < window)
+            s = jnp.where(keep, s, NEG_INF)
         m_prev = m_ref[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -117,19 +125,21 @@ def _flash_blocks(Tq, Tk, block_q, block_k, causal):
     return block_q, block_k
 
 
-def _flash_fwd(q, k, v, kbias, causal, scale, block_q, block_k):
+def _flash_fwd(q, k, v, kbias, causal, scale, block_q, block_k, window=0):
     """q: [BH, Tq, d], k/v: [BH, Tk, d], kbias: [BH, Tk] additive key bias.
-    Returns (o [BH, Tq, d], lse [BH, Tq] float32)."""
+    window > 0 (causal only): sliding-window attention — each query sees
+    only the last `window` key positions.  Returns (o, lse)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     BH, T, d = q.shape
     Tk = k.shape[1]
     block_q, block_k = _flash_blocks(T, Tk, block_q, block_k, causal)
+    assert not (window and not causal), "window attention requires causal"
     nq, nk = T // block_q, Tk // block_k
     kernel = functools.partial(
         _flash_fwd_kernel, block_q=block_q, block_k=block_k, nk=nk,
-        causal=causal, scale=scale,
+        causal=causal, scale=scale, window=int(window),
     )
     return pl.pallas_call(
         kernel,
@@ -164,7 +174,8 @@ def _flash_fwd(q, k, v, kbias, causal, scale, block_q, block_k):
 
 
 def _flash_dq_kernel(q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref, delta_ref,
-                     dq_ref, dq_acc, *, block_q, block_k, nk, causal, scale):
+                     dq_ref, dq_acc, *, block_q, block_k, nk, causal, scale,
+                     window=0):
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
@@ -175,6 +186,9 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref, delta_ref,
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
     run = (ki * block_k < (qi + 1) * block_q) if causal else (ki >= 0)
+    if window:
+        run = run & (ki * block_k + block_k - 1
+                     >= qi * block_q - window + 1)
 
     @pl.when(run)
     def _compute():
@@ -191,7 +205,10 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref, delta_ref,
                 jnp.int32, s.shape, 0)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            keep = q_pos >= k_pos
+            if window:  # sliding window: only the last `window` positions
+                keep = keep & (q_pos - k_pos < window)
+            s = jnp.where(keep, s, NEG_INF)
         p = jnp.exp(s - lse)  # [bq, bk]
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
@@ -205,7 +222,7 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_dkv_kernel(q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref, delta_ref,
                       dk_ref, dv_ref, dkb_ref, dk_acc, dv_acc, dkb_acc, *,
-                      block_q, block_k, nq, causal, scale):
+                      block_q, block_k, nq, causal, scale, window=0):
     from jax.experimental import pallas as pl
 
     ki = pl.program_id(1)
@@ -218,6 +235,9 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref, delta_ref,
         dkb_acc[:] = jnp.zeros_like(dkb_acc)
 
     run = (ki * block_k < (qi + 1) * block_q) if causal else (qi >= 0)
+    if window:
+        run = run & (ki * block_k + block_k - 1
+                     >= qi * block_q - window + 1)
 
     @pl.when(run)
     def _compute():
@@ -234,7 +254,10 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref, delta_ref,
                 jnp.int32, s.shape, 0)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            keep = q_pos >= k_pos
+            if window:  # sliding window: only the last `window` positions
+                keep = keep & (q_pos - k_pos < window)
+            s = jnp.where(keep, s, NEG_INF)
         p = jnp.exp(s - lse)  # [bq, bk]
         dv_acc[:] = dv_acc[:] + jnp.dot(
             p.T, do, preferred_element_type=jnp.float32)
@@ -252,7 +275,7 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd(q, k, v, kbias, o, lse, do, causal, scale, block_q, block_k,
-               dlse=None):
+               dlse=None, window=0):
     """Blocked backward: returns (dq, dk, dv, dkbias[BH,Tk] f32).
 
     dlse: optional cotangent of the lse output (the chunk-merge path of
@@ -279,7 +302,8 @@ def _flash_bwd(q, k, v, kbias, o, lse, do, causal, scale, block_q, block_k,
                               memory_space=pltpu.VMEM)
     dq = pl.pallas_call(
         functools.partial(_flash_dq_kernel, block_q=block_q, block_k=block_k,
-                          nk=nk, causal=causal, scale=scale),
+                          nk=nk, causal=causal, scale=scale,
+                          window=int(window)),
         grid=(BH, nq, nk),
         in_specs=[q_spec_q, k_spec_q, k_spec_q, kb_spec_q, q_spec_q,
                   row_spec_q, row_spec_q],
@@ -301,7 +325,8 @@ def _flash_bwd(q, k, v, kbias, o, lse, do, causal, scale, block_q, block_k,
                               memory_space=pltpu.VMEM)
     dk, dv, dkb = pl.pallas_call(
         functools.partial(_flash_dkv_kernel, block_q=block_q, block_k=block_k,
-                          nq=nq, causal=causal, scale=scale),
+                          nq=nq, causal=causal, scale=scale,
+                          window=int(window)),
         grid=(BH, nk, nq),
         in_specs=[q_spec_k, k_spec_k, k_spec_k, kb_spec_k, q_spec_k,
                   row_spec_k, row_spec_k],
@@ -321,47 +346,53 @@ def _flash_bwd(q, k, v, kbias, o, lse, do, causal, scale, block_q, block_k,
     return dq, dk, dv, dkb
 
 
-def _dense_attention(q, k, v, causal, scale, kbias=None):
-    """XLA reference implementation (used for the backward recompute)."""
+def _dense_attention(q, k, v, causal, scale, kbias=None, window=0):
+    """XLA reference implementation (used as the non-pallas fallback)."""
     s = jnp.einsum("bqd,bkd->bqk", q, k).astype(jnp.float32) * scale
     if kbias is not None:
         s = s + kbias[:, None, :].astype(jnp.float32)
     if causal:
         T = q.shape[1]
         mask = jnp.tril(jnp.ones((T, T), bool))
+        if window:
+            mask = mask & ~jnp.tril(jnp.ones((T, T), bool), -int(window))
         s = jnp.where(mask[None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bqk,bkd->bqd", p.astype(q.dtype), v)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
 def flash_attention(q, k, v, kbias=None, causal=False, scale=None,
-                    block_q=128, block_k=128):
+                    block_q=128, block_k=128, window=0):
     """Fused attention, q: [BH, Tq, d], k/v: [BH, Tk, d] (flash-style
     online softmax).  kbias: optional [BH, Tk] additive key bias (the
-    padding-mask row, indexed by key position)."""
+    padding-mask row, indexed by key position).  window > 0 (causal):
+    sliding-window local attention over the last `window` positions —
+    fully-out-of-window blocks are skipped in all three kernels, so
+    compute scales with window, not T."""
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     kb = kbias if kbias is not None else jnp.zeros(k.shape[:2], jnp.float32)
-    o, _ = _flash_fwd(q, k, v, kb, causal, scale, block_q, block_k)
+    o, _ = _flash_fwd(q, k, v, kb, causal, scale, block_q, block_k, window)
     return o
 
 
-def _flash_vjp_fwd(q, k, v, kbias, causal, scale, block_q, block_k):
+def _flash_vjp_fwd(q, k, v, kbias, causal, scale, block_q, block_k, window=0):
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     kb = kbias if kbias is not None else jnp.zeros(k.shape[:2], jnp.float32)
-    o, lse = _flash_fwd(q, k, v, kb, causal, scale, block_q, block_k)
+    o, lse = _flash_fwd(q, k, v, kb, causal, scale, block_q, block_k, window)
     return o, (q, k, v, kbias, o, lse)
 
 
-def _flash_vjp_bwd(causal, scale, block_q, block_k, res, do):
+def _flash_vjp_bwd(causal, scale, block_q, block_k, window, res, do):
     q, k, v, kbias, o, lse = res
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     kb = kbias if kbias is not None else jnp.zeros(k.shape[:2], jnp.float32)
     dq, dk, dv, dkb = _flash_bwd(
-        q, k, v, kb, o, lse, do, causal, scale, block_q, block_k)
+        q, k, v, kb, o, lse, do, causal, scale, block_q, block_k,
+        window=window)
     if kbias is None:
         return dq, dk, dv, None
     return dq, dk, dv, dkb.astype(kbias.dtype)

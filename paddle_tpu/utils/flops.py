@@ -102,6 +102,9 @@ def program_flops(program, batch_hint=1):
                 continue
             b, h, tq, d = q
             tk = k[2]
+            window = int(op.attrs.get("window", 0) or 0)
+            if window:  # sliding window: compute scales with the band
+                tk = min(tk, window)
             total += factor * 2.0 * 2.0 * b * h * tq * tk * d
     return total
 

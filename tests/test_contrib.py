@@ -203,14 +203,11 @@ def test_amp_collapses_redundant_cast_roundtrips():
 
     main, startup, y = build()
     rewrite_bf16(main)
-    casts = [op for op in main.global_block().ops if op.type == "cast"]
-    # 2 muls: without collapsing there would be 2 in-casts + 2 out-casts
-    # + 1 weight cast each = 6; the roundtrip between the muls collapses
-    f32_to_bf16_of_raw = [
-        op for op in casts
-        if op.attrs.get("out_dtype") == "bfloat16"
-        and "@RAW_BF16" in op.inputs["X"][0]]
-    assert not f32_to_bf16_of_raw, [op.inputs for op in casts]
+    # the second mul's data input must read the FIRST mul's raw bf16
+    # output directly (the f32 roundtrip between the two muls collapsed)
+    muls = [op for op in main.global_block().ops if op.type == "mul"]
+    assert len(muls) == 2
+    assert muls[1].inputs["X"][0].endswith("@RAW_BF16"), muls[1].inputs
 
     scope = fluid.Scope()
     with fluid.scope_guard(scope):

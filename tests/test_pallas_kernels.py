@@ -345,3 +345,50 @@ def test_flash_attention_piece_merge_matches_full():
     ref = _dense_attention(q, k, v, False, scale)
     np.testing.assert_allclose(np.asarray(merged), np.asarray(ref),
                                rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("window", [4, 16])
+def test_flash_attention_sliding_window_matches_dense(window):
+    """window attention: values and grads match the dense banded-mask
+    reference; out-of-window blocks are skipped (Mistral-style SWA)."""
+    rng = np.random.RandomState(11)
+    bh, t, d = 2, 32, 8
+    q = jnp.asarray(rng.randn(bh, t, d).astype("float32"))
+    k = jnp.asarray(rng.randn(bh, t, d).astype("float32"))
+    v = jnp.asarray(rng.randn(bh, t, d).astype("float32"))
+    scale = 1.0 / np.sqrt(d)
+
+    out = flash_attention(q, k, v, None, True, scale, 8, 8, window)
+    ref = _dense_attention(q, k, v, True, scale, window=window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+    gf = jax.grad(lambda q, k, v: jnp.sum(
+        flash_attention(q, k, v, None, True, scale, 8, 8, window) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(lambda q, k, v: jnp.sum(
+        _dense_attention(q, k, v, True, scale, window=window) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
+
+
+def test_fused_attention_layer_window():
+    """The window attr flows through the op and layer (dense path here;
+    the pallas path shares the masks by the kernel test above)."""
+    from paddle_tpu import layers
+
+    rng = np.random.RandomState(12)
+    xv = rng.rand(2, 2, 16, 8).astype("float32")
+    q = layers.data("qw", shape=[2, 16, 8])
+    att = layers.fused_attention(q, q, q, causal=True, window=4)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    (out,) = exe.run(feed={"qw": xv}, fetch_list=[att])
+    qf = jnp.asarray(xv.reshape(4, 16, 8))
+    ref = _dense_attention(qf, qf, qf, True, 1.0 / np.sqrt(8), window=4)
+    np.testing.assert_allclose(np.asarray(out).reshape(4, 16, 8),
+                               np.asarray(ref), rtol=2e-4, atol=2e-5)
+    with pytest.raises(ValueError, match="window requires causal"):
+        layers.fused_attention(q, q, q, causal=False, window=4)
