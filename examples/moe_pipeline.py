@@ -1,0 +1,68 @@
+"""Expert and pipeline parallelism: a GShard top-2 MoE layer over an
+`ep` axis, and a 1F1B-scheduled pipeline train step over a `pp` axis.
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/moe_pipeline.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import parallel
+from paddle_tpu.parallel import moe, pipeline
+
+
+def gshard_moe():
+    n = min(4, len(jax.devices()))
+    mesh = parallel.make_mesh({"ep": n}, devices=jax.devices()[:n])
+    E, D, B = 2 * n, 32, 16 * n
+
+    def expert_fn(p, h):
+        return jnp.tanh(h @ p["w"]) @ p["wo"]
+
+    experts = [
+        {"w": jax.random.normal(k, (D, 64)) * 0.2,
+         "wo": jax.random.normal(jax.random.fold_in(k, 1), (64, D)) * 0.2}
+        for k in jax.random.split(jax.random.PRNGKey(0), E)
+    ]
+    gate_w = jax.random.normal(jax.random.PRNGKey(1), (D, E)) * 0.1
+    x = jax.random.normal(jax.random.PRNGKey(2), (B, D))
+
+    run = moe.switch_moe(expert_fn, mesh, "ep", capacity_factor=1.25,
+                         top_k=2)
+    y, aux, dropped = jax.jit(run)(
+        gate_w, moe.stack_expert_params(experts), x)
+    print("gshard top-2: aux=%.3f dropped=%.1f%% out=%s"
+          % (float(aux), 100 * float(dropped), y.shape))
+
+
+def one_f_one_b_pipeline():
+    n = min(4, len(jax.devices()))
+    mesh = parallel.make_mesh({"pp": n}, devices=jax.devices()[:n])
+    stage_fn, init_stage = pipeline.pipeline_mlp_stages(32)
+    stacked = pipeline.stack_stage_params(
+        [init_stage(k) for k in jax.random.split(jax.random.PRNGKey(3), n)])
+    M, mb = 8, 4
+    x = jax.random.normal(jax.random.PRNGKey(4), (M * mb, 32))
+    t = jax.random.normal(jax.random.PRNGKey(5), (M * mb, 32))
+
+    step = pipeline.one_f_one_b(
+        stage_fn, lambda y, tt: jnp.sum((y - tt) ** 2), mesh, "pp",
+        n_microbatches=M)
+    loss, grads = jax.jit(step)(stacked, x, t)
+    gnorm = sum(float(jnp.sum(g ** 2)) for g in
+                jax.tree_util.tree_leaves(grads)) ** 0.5
+    print("1f1b loss=%.4f grad-norm=%.4f over pp=%d, %d microbatches"
+          % (float(loss), gnorm, n, M))
+
+
+if __name__ == "__main__":
+    gshard_moe()
+    one_f_one_b_pipeline()

@@ -129,41 +129,42 @@ def collapse_redundant_casts(program, dtype="bfloat16"):
     HBM win materializes exactly when the f32 value is unused.
     Returns the number of collapsed re-casts."""
     block = program.global_block()
-    by_idx = list(block.ops)
-    # position-aware single pass: castback_src maps an f32 name to its
-    # half source ONLY while both definitions are current — an op that
-    # redefines either name (non-SSA programs) invalidates the entry, so
-    # a consumer can never be rewired across a redefinition
-    castback_src = {}
-    drop = set()
-    renames = {}  # re-cast output -> original half name
-    for i, op in enumerate(by_idx):
+    # ONE ordered pass doing rewrite + drop together, so both the drop
+    # decision and every consumer rewrite see only definitions that are
+    # current at that position (non-SSA safe), and chained collapses
+    # resolve transitively at record time.
+    castback_src = {}   # f32 name -> half name (current definitions only)
+    active = {}         # dropped re-cast output -> surviving half name
+    kept = []
+    dropped = 0
+    for op in block.ops:
+        # consumers first: rewrite inputs with the renames active HERE
+        for slot, names in op.inputs.items():
+            op.inputs[slot] = [active.get(n, n) for n in names]
         if (op.type == "cast" and op.attrs.get("out_dtype") == dtype
                 and op.inputs["X"][0] in castback_src):
-            drop.add(i)
-            renames[op.outputs["Out"][0]] = castback_src[op.inputs["X"][0]]
+            src = castback_src[op.inputs["X"][0]]
+            # chase chains: src may itself be a dropped re-cast's name
+            active[op.outputs["Out"][0]] = active.get(src, src)
+            dropped += 1
+            continue  # op dropped
         is_castback = (op.type == "cast"
                        and op.attrs.get("out_dtype") == "float32"
                        and op.attrs.get("in_dtype") == dtype)
-        outs = op.output_arg_names()
-        for n in outs:
-            castback_src.pop(n, None)  # f32 name redefined
+        for n in op.output_arg_names():
+            # any redefinition supersedes earlier renames/cast-backs of n
+            active.pop(n, None)
+            castback_src.pop(n, None)
             for f32n in [f for f, h in castback_src.items() if h == n]:
-                castback_src.pop(f32n, None)  # half source redefined
+                castback_src.pop(f32n, None)
         if is_castback:
             castback_src[op.outputs["Out"][0]] = op.inputs["X"][0]
-    if not drop:
-        return 0
-    kept = []
-    for i, op in enumerate(by_idx):
-        if i in drop:
-            continue
-        for slot, names in op.inputs.items():
-            op.inputs[slot] = [renames.get(n, n) for n in names]
         kept.append(op)
+    if not dropped:
+        return 0
     block.ops = kept
     program._bump_version()
-    return len(drop)
+    return dropped
 
 
 def rewrite_fp16(program=None, ops=_BF16_OPS):
