@@ -144,8 +144,14 @@ def collapse_redundant_casts(program, dtype="bfloat16"):
         if (op.type == "cast" and op.attrs.get("out_dtype") == dtype
                 and op.inputs["X"][0] in castback_src):
             src = castback_src[op.inputs["X"][0]]
+            out_n = op.outputs["Out"][0]
             # chase chains: src may itself be a dropped re-cast's name
-            active[op.outputs["Out"][0]] = active.get(src, src)
+            active[out_n] = active.get(src, src)
+            # the drop still REDEFINES out_n: stale cast-back entries
+            # keyed by or valued at out_n must not survive it
+            castback_src.pop(out_n, None)
+            for f32n in [f for f, h in castback_src.items() if h == out_n]:
+                castback_src.pop(f32n, None)
             dropped += 1
             continue  # op dropped
         is_castback = (op.type == "cast"

@@ -23,6 +23,8 @@ __all__ = [
     "gpt2_lm_program",
     "gpt2_logits_program",
     "greedy_generate",
+    "greedy_generate_cached",
+    "gpt2_decode_step_program",
     "beam_generate",
     "make_fake_lm_batch",
 ]
@@ -47,19 +49,24 @@ def _pa(base, std=0.02):
     )
 
 
-def _attn(x, hp, is_test):
+def _attn(x, hp, is_test, cache=None):
     """Causal self-attention via the shared transformer block (same graph,
-    same mha_* param names, one fused-path implementation to maintain)."""
+    same mha_* param names, one fused-path implementation to maintain).
+    With `cache`, x is the single current token and causality comes from
+    the cache's <=pos mask instead of the causal flag."""
     from . import transformer as tfm
 
     return tfm.multi_head_attention(
         x, x, x, None, hp.d_model, hp.n_head, dropout_rate=0.0,
-        is_test=is_test, fused=True, causal=True,
+        is_test=is_test, fused=True, causal=cache is None, cache=cache,
     )
 
 
-def _block(x, hp, is_test):
-    a = _attn(layers.layer_norm(x, begin_norm_axis=2), hp, is_test)
+def _block(x, hp, is_test, cache=None):
+    """One decoder block — the SAME function builds the training graph and
+    the KV-cached decode step, so the parameter-creation order (and with
+    it, weight sharing by name) holds by construction."""
+    a = _attn(layers.layer_norm(x, begin_norm_axis=2), hp, is_test, cache)
     if hp.dropout and not is_test:
         a = layers.dropout(a, hp.dropout, is_test=is_test)
     x = layers.elementwise_add(x, a)
@@ -158,6 +165,121 @@ def gpt2_logits_program(hp=GPT2Config, seq_len=128):
         ids = layers.data("ids", shape=[seq_len], dtype="int64")
         logits = gpt2_lm(ids, hp, is_test=True)
     return main, startup, ["ids"], [logits]
+
+
+def gpt2_decode_step_program(hp=GPT2Config, batch=1, t_max=None):
+    """One-token KV-cached decode step (the incremental-decoding engine
+    the reference's beam-search cache plumbing approximates):
+
+        feeds:  step_ids [B, 1] int64, pos [1] int64
+        fetch:  next-token logits [B, vocab]
+        state:  per-layer kcache/vcache [B, H, T_max, Dh] persistable vars
+
+    Per generated token this runs O(T_max * d) work instead of the full
+    re-encode's O(T_max^2 * d) — the cache vars live donated in HBM and
+    the step compiles ONCE.  Returns (main, cache_startup, feeds,
+    fetches, cache_names); run `cache_startup` to (re)zero the caches
+    before each generation.  Built under unique_name.guard(), so weights
+    are shared by name with gpt2_lm_program / gpt2_logits_program built
+    in the same process."""
+    import paddle_tpu as fluid
+
+    t_max = t_max or hp.n_ctx
+    assert t_max <= hp.n_ctx, (
+        "t_max %d exceeds the position table n_ctx %d" % (t_max, hp.n_ctx))
+    dh = hp.d_model // hp.n_head
+    main = fluid.Program()
+    cache_startup = fluid.Program()  # ONLY cache zeroing lands here
+    throwaway_startup = fluid.Program()  # param inits (weights come from
+    # the training/logits program's startup via shared names)
+    cache_names = []
+    with fluid.program_guard(main, throwaway_startup), unique_name.guard():
+        # static batch: the caches are [batch, ...] state, so the whole
+        # step graph keeps concrete shapes (one compile, no DYN dims)
+        ids = layers.data("step_ids", shape=[batch, 1], dtype="int64",
+                          append_batch_size=False)
+        pos = layers.data("pos", shape=[1], dtype="int64",
+                          append_batch_size=False)
+        tok = layers.embedding(
+            ids, size=[hp.vocab_size, hp.d_model], param_attr=_pa("emb.w")
+        )  # [B, D] (the T=1 axis squeezes in the lookup)
+        tok = layers.reshape(tok, shape=[batch, 1, hp.d_model])
+        pos_table = layers.create_parameter(
+            shape=[hp.n_ctx, hp.d_model], dtype="float32",
+            attr=_pa("pos_emb.w", 0.01),
+        )
+        pos_row = layers.reshape(layers.gather(pos_table, pos),
+                                 shape=[1, 1, hp.d_model])
+        x = layers.elementwise_add(tok, pos_row)
+        blk = main.global_block()
+        for li in range(hp.n_layer):
+            cache = {"pos": pos}
+            for nm in ("k", "v"):
+                cname = "gpt2_%scache_%d" % (nm, li)
+                cvar = blk.create_var(
+                    name=cname, shape=[batch, hp.n_head, t_max, dh],
+                    dtype="float32", persistable=True,
+                )
+                with fluid.program_guard(cache_startup):
+                    layers.fill_constant(
+                        [batch, hp.n_head, t_max, dh], "float32", 0.0,
+                        out=cache_startup.global_block().create_var(
+                            name=cname, shape=[batch, hp.n_head, t_max, dh],
+                            dtype="float32", persistable=True,
+                        ),
+                    )
+                cache[nm] = cvar
+                cache_names.append(cname)
+            x = _block(x, hp, is_test=True, cache=cache)
+        x = layers.layer_norm(x, begin_norm_axis=2)
+        logits = layers.fc(x, size=hp.vocab_size, num_flatten_dims=2,
+                           bias_attr=False, param_attr=_pa("softmax_out.w"))
+        logits = layers.reshape(logits, shape=[batch, hp.vocab_size])
+    return main, cache_startup, ["step_ids", "pos"], [logits], cache_names
+
+
+def greedy_generate_cached(exe, step_main, cache_startup, fetches,
+                           prompt_ids, max_new_tokens):
+    """Greedy decoding through the KV-cached step program: prefill feeds
+    the prompt one token at a time (filling the caches), then each new
+    token costs one O(T_max * d) step.  Matches greedy_generate
+    token-for-token."""
+    prompt_ids = np.asarray(prompt_ids, "int64")
+    b, p = prompt_ids.shape
+    assert p >= 1, "empty prompt: seed generation with at least a BOS token"
+    step_b = int(step_main.global_block().vars["step_ids"].shape[0])
+    assert b == step_b, (
+        "prompt batch %d != decode program's static batch %d" % (b, step_b))
+    t_cache = None
+    for v in step_main.global_block().vars.values():
+        if v.name.startswith("gpt2_kcache_"):
+            t_cache = int(v.shape[2])
+            break
+    if t_cache is not None:
+        assert p + max_new_tokens <= t_cache + 1, (
+            "prompt %d + new %d exceeds cache length %d"
+            % (p, max_new_tokens, t_cache))
+    exe.run(cache_startup)  # (re)zero the caches for this generation
+    out = [prompt_ids[:, i] for i in range(p)]
+    logits = None
+    for t in range(p):
+        (logits,) = exe.run(
+            step_main,
+            feed={"step_ids": prompt_ids[:, t:t + 1],
+                  "pos": np.array([t], "int64")},
+            fetch_list=fetches,
+        )
+    for t in range(p, p + max_new_tokens):
+        nxt = np.asarray(logits).argmax(axis=-1).astype("int64")
+        out.append(nxt)
+        if t + 1 >= p + max_new_tokens:
+            break
+        (logits,) = exe.run(
+            step_main,
+            feed={"step_ids": nxt[:, None], "pos": np.array([t], "int64")},
+            fetch_list=fetches,
+        )
+    return np.stack(out, axis=1)
 
 
 def _prompt_buffer(main, prompt_ids, max_new_tokens, pad_id):

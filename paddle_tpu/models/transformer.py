@@ -121,7 +121,51 @@ def multi_head_attention(
 
     q, k, v = split_heads(q), split_heads(k), split_heads(v)
     dh = d_model // n_head
-    if fused:
+    if cache is not None:
+        if attn_bias is not None or kpad_bias is not None:
+            raise ValueError(
+                "cached attention owns its <=pos mask; attn_bias/kpad_bias "
+                "are not supported on the cache path")
+        if causal:
+            raise ValueError(
+                "cached attention handles causality via the cache mask — "
+                "pass causal=False with cache")
+        if dropout_rate:
+            raise ValueError("cached decode is inference-only: "
+                             "dropout_rate must be 0")
+        # incremental KV-cached decode: q/k/v are the ONE current token's
+        # projections; k/v land in the [B, H, T_max, Dh] cache vars at
+        # cache["pos"], and q attends over the cache with a <=pos mask.
+        # The cache vars are persistable scope state — the executor's
+        # functionalization threads the update back (donated in HBM).
+        from ..layer_helper import LayerHelper
+
+        helper = LayerHelper("cached_attention")
+        k_full, v_full = [], []
+        for name, new in (("k", k), ("v", v)):
+            cvar = cache[name]
+            out = helper.create_variable_for_type_inference(cvar.dtype)
+            helper.append_op(
+                "seq_cache_write",
+                inputs={"Cache": [cvar], "New": [new], "Pos": [cache["pos"]]},
+                outputs={"Out": [out]},
+            )
+            # write-back: assign the updated cache into the persistable var
+            helper.append_op("assign", inputs={"X": [out]},
+                             outputs={"Out": [cvar]})
+            (k_full if name == "k" else v_full).append(out)
+        t_max = int(cache["k"].shape[2])
+        bsz = int(cache["k"].shape[0])
+        bias = helper.create_variable_for_type_inference("float32")
+        helper.append_op(
+            "decode_pos_mask", inputs={"Pos": [cache["pos"]]},
+            outputs={"Out": [bias]}, attrs={"t_max": t_max, "batch": bsz},
+        )
+        ctx = layers.fused_attention(
+            q, k_full[0], v_full[0], bias=bias, causal=False,
+            scale=dh ** -0.5,
+        )  # [B, H, 1, Dh]
+    elif fused:
         if attn_bias is not None and kpad_bias is None:
             raise ValueError(
                 "fused attention cannot consume the dense [B,H,Tq,Tk] "

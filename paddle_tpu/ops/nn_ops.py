@@ -864,3 +864,28 @@ def _data_norm(ctx, ins, attrs):
         "BatchSumOut": [upd_sum],
         "BatchSquareSumOut": [upd_sq],
     }
+
+
+@register("seq_cache_write", no_grad_inputs=("Pos",))
+def _seq_cache_write(ctx, ins, attrs):
+    """KV-cache update for incremental decode: write the current token's
+    [B, H, 1, D] projection into the [B, H, T, D] cache at time index
+    Pos (the one-token analog of the reference's beam-search cache
+    shuffling; static shapes — a where over the time axis)."""
+    cache, new, pos = ins["Cache"][0], ins["New"][0], ins["Pos"][0]
+    t = cache.shape[2]
+    pos = pos.reshape(()).astype(jnp.int32)
+    at = (jnp.arange(t, dtype=jnp.int32) == pos)[None, None, :, None]
+    return {"Out": [jnp.where(at, new.astype(cache.dtype), cache)]}
+
+
+@register("decode_pos_mask", no_grad_inputs=("Pos",))
+def _decode_pos_mask(ctx, ins, attrs):
+    """[B, T] additive key bias for cached decode: 0 for key positions
+    <= Pos, -1e30 beyond — the dynamic-length mask fused_attention's
+    rank-1 Bias slot consumes."""
+    pos = ins["Pos"][0].reshape(()).astype(jnp.int32)
+    t = int(attrs["t_max"])
+    b = int(attrs["batch"])
+    row = jnp.where(jnp.arange(t, dtype=jnp.int32) <= pos, 0.0, -1e30)
+    return {"Out": [jnp.broadcast_to(row[None, :], (b, t)).astype(jnp.float32)]}

@@ -659,3 +659,52 @@ def test_transformer_recompute_matches_plain():
     plain = run(False)
     remat = run(True)
     np.testing.assert_allclose(remat, plain, rtol=1e-5)
+
+
+def test_gpt2_kv_cached_decode_matches_full_reencode():
+    """The KV-cached decode step (O(T d) per token) produces exactly the
+    tokens the full-re-encode greedy_generate produces, and its per-step
+    logits match the full program's at every position."""
+    from paddle_tpu.models import gpt2
+
+    class HP(gpt2.GPT2Config):
+        vocab_size = 50
+        n_ctx = 16
+        d_model = 16
+        n_layer = 2
+        n_head = 2
+        dropout = 0.0
+
+    B, T = 2, 16
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        full_main, full_startup, _, full_fetch = gpt2.gpt2_logits_program(
+            HP, seq_len=T)
+        step_main, cache_startup, _, step_fetch, cache_names = \
+            gpt2.gpt2_decode_step_program(HP, batch=B, t_max=T)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(full_startup)  # weights shared by name
+
+        rng = np.random.RandomState(0)
+        prompt = rng.randint(1, 50, (B, 4)).astype("int64")
+
+        ref = gpt2.greedy_generate(exe, full_main, full_fetch, prompt, 6)
+        out = gpt2.greedy_generate_cached(
+            exe, step_main, cache_startup, step_fetch, prompt, 6)
+        np.testing.assert_array_equal(out, ref)
+
+        # per-position logits parity: feed the ref sequence through both
+        exe.run(cache_startup)
+        seq = ref
+        buf = np.zeros((B, T), "int64")
+        buf[:, :seq.shape[1]] = seq
+        (full_logits,) = exe.run(full_main, feed={"ids": buf},
+                                 fetch_list=full_fetch)
+        for t in range(seq.shape[1]):
+            (lg,) = exe.run(step_main,
+                            feed={"step_ids": seq[:, t:t + 1],
+                                  "pos": np.array([t], "int64")},
+                            fetch_list=step_fetch)
+            np.testing.assert_allclose(
+                np.asarray(lg), np.asarray(full_logits)[:, t, :],
+                rtol=1e-4, atol=1e-5)
