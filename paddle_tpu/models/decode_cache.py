@@ -1,0 +1,48 @@
+"""Shared KV-cache scaffolding for incremental decode (used by gpt2's
+decode step and the transformer's seq2seq decode programs): per-layer
+cache variable creation, the zeroing program, and capacity probing."""
+
+import numpy as np
+
+from .. import layers
+
+__all__ = ["create_kv_caches", "add_cache_zero_fills", "probe_cache_len"]
+
+
+def create_kv_caches(block, prefix, n_layer, batch, n_head, t_max, dh):
+    """Create per-layer persistable [batch, n_head, t_max, dh] K/V cache
+    vars named `<prefix>_{k,v}cache_<layer>`.  Returns (per-layer cache
+    dicts without 'pos', all names)."""
+    caches, names = [], []
+    for li in range(n_layer):
+        cache = {}
+        for nm in ("k", "v"):
+            cname = "%s_%scache_%d" % (prefix, nm, li)
+            cache[nm] = block.create_var(
+                name=cname, shape=[batch, n_head, t_max, dh],
+                dtype="float32", persistable=True)
+            names.append(cname)
+        caches.append(cache)
+    return caches, names
+
+
+def add_cache_zero_fills(zero_program, named_shapes):
+    """Append fill_constant ops zeroing each (name, shape) persistable
+    into `zero_program` (run it to reset decode state per generation)."""
+    import paddle_tpu as fluid
+
+    with fluid.program_guard(zero_program, fluid.Program()):
+        blk = zero_program.global_block()
+        for cname, shape in named_shapes:
+            layers.fill_constant(
+                list(shape), "float32", 0.0,
+                out=blk.create_var(name=cname, shape=list(shape),
+                                   dtype="float32", persistable=True))
+
+
+def probe_cache_len(step_main, prefix):
+    """The decode capacity (cache time axis) of a step program."""
+    for n, v in step_main.global_block().vars.items():
+        if n.startswith(prefix + "_kcache_"):
+            return int(v.shape[2])
+    raise ValueError("no %s_kcache_* vars in the step program" % prefix)

@@ -211,25 +211,16 @@ def gpt2_decode_step_program(hp=GPT2Config, batch=1, t_max=None):
         pos_row = layers.reshape(layers.gather(pos_table, pos),
                                  shape=[1, 1, hp.d_model])
         x = layers.elementwise_add(tok, pos_row)
+        from .decode_cache import add_cache_zero_fills, create_kv_caches
+
         blk = main.global_block()
-        for li in range(hp.n_layer):
-            cache = {"pos": pos}
-            for nm in ("k", "v"):
-                cname = "gpt2_%scache_%d" % (nm, li)
-                cvar = blk.create_var(
-                    name=cname, shape=[batch, hp.n_head, t_max, dh],
-                    dtype="float32", persistable=True,
-                )
-                with fluid.program_guard(cache_startup):
-                    layers.fill_constant(
-                        [batch, hp.n_head, t_max, dh], "float32", 0.0,
-                        out=cache_startup.global_block().create_var(
-                            name=cname, shape=[batch, hp.n_head, t_max, dh],
-                            dtype="float32", persistable=True,
-                        ),
-                    )
-                cache[nm] = cvar
-                cache_names.append(cname)
+        kv_caches, cache_names = create_kv_caches(
+            blk, "gpt2", hp.n_layer, batch, hp.n_head, t_max, dh)
+        add_cache_zero_fills(
+            cache_startup,
+            [(n, (batch, hp.n_head, t_max, dh)) for n in cache_names])
+        for cache in kv_caches:
+            cache["pos"] = pos
             x = _block(x, hp, is_test=True, cache=cache)
         x = layers.layer_norm(x, begin_norm_axis=2)
         logits = layers.fc(x, size=hp.vocab_size, num_flatten_dims=2,
@@ -250,15 +241,12 @@ def greedy_generate_cached(exe, step_main, cache_startup, fetches,
     step_b = int(step_main.global_block().vars["step_ids"].shape[0])
     assert b == step_b, (
         "prompt batch %d != decode program's static batch %d" % (b, step_b))
-    t_cache = None
-    for v in step_main.global_block().vars.values():
-        if v.name.startswith("gpt2_kcache_"):
-            t_cache = int(v.shape[2])
-            break
-    if t_cache is not None:
-        assert p + max_new_tokens <= t_cache + 1, (
-            "prompt %d + new %d exceeds cache length %d"
-            % (p, max_new_tokens, t_cache))
+    from .decode_cache import probe_cache_len
+
+    t_cache = probe_cache_len(step_main, "gpt2")
+    assert p + max_new_tokens <= t_cache + 1, (
+        "prompt %d + new %d exceeds cache length %d"
+        % (p, max_new_tokens, t_cache))
     exe.run(cache_startup)  # (re)zero the caches for this generation
     out = [prompt_ids[:, i] for i in range(p)]
     logits = None

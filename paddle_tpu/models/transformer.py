@@ -27,6 +27,8 @@ __all__ = [
     "wmt_transformer_program",
     "transformer_logits_program",
     "greedy_translate",
+    "greedy_translate_cached",
+    "transformer_decode_programs",
     "beam_translate",
 ]
 
@@ -141,19 +143,22 @@ def multi_head_attention(
         from ..layer_helper import LayerHelper
 
         helper = LayerHelper("cached_attention")
-        k_full, v_full = [], []
-        for name, new in (("k", k), ("v", v)):
-            cvar = cache[name]
+
+        def write_cache(cvar, new):
+            """Updated full-length cache tensor; also assigns it back into
+            the persistable var (state threads through the executor)."""
             out = helper.create_variable_for_type_inference(cvar.dtype)
             helper.append_op(
                 "seq_cache_write",
                 inputs={"Cache": [cvar], "New": [new], "Pos": [cache["pos"]]},
                 outputs={"Out": [out]},
             )
-            # write-back: assign the updated cache into the persistable var
             helper.append_op("assign", inputs={"X": [out]},
                              outputs={"Out": [cvar]})
-            (k_full if name == "k" else v_full).append(out)
+            return out
+
+        k_full = write_cache(cache["k"], k)
+        v_full = write_cache(cache["v"], v)
         t_max = int(cache["k"].shape[2])
         bsz = int(cache["k"].shape[0])
         bias = helper.create_variable_for_type_inference("float32")
@@ -162,8 +167,7 @@ def multi_head_attention(
             outputs={"Out": [bias]}, attrs={"t_max": t_max, "batch": bsz},
         )
         ctx = layers.fused_attention(
-            q, k_full[0], v_full[0], bias=bias, causal=False,
-            scale=dh ** -0.5,
+            q, k_full, v_full, bias=bias, causal=False, scale=dh ** -0.5,
         )  # [B, H, 1, Dh]
     elif fused:
         if attn_bias is not None and kpad_bias is None:
@@ -220,16 +224,26 @@ def encoder_layer(x, attn_bias, hp, is_test=False, kpad_bias=None):
 
 
 def decoder_layer(x, enc_out, self_bias, cross_bias, hp, is_test=False,
-                  self_kpad=None, cross_kpad=None):
+                  self_kpad=None, cross_kpad=None, cache=None):
+    """With `cache` ({"k","v","pos"}), x is ONE current target token:
+    self-attention runs KV-cached (same machinery as gpt2's decode step)
+    and cross-attention attends the full enc_out with a one-token query.
+    The SAME function builds training and decode-step graphs, so the
+    parameter-creation order (weight sharing by name) holds by
+    construction."""
     fused = getattr(hp, "fused_attn", False)
     self_attn = multi_head_attention(
-        x, x, x, self_bias, hp.d_model, hp.n_head, hp.dropout, is_test,
-        fused=fused, kpad_bias=self_kpad, causal=fused,
+        x, x, x, self_bias if cache is None else None, hp.d_model,
+        hp.n_head, 0.0 if cache is not None else hp.dropout, is_test,
+        fused=fused or cache is not None,
+        kpad_bias=self_kpad if cache is None else None,
+        causal=fused and cache is None, cache=cache,
     )
     x = pre_post_process(x, self_attn, hp.dropout, is_test)
     cross = multi_head_attention(
-        x, enc_out, enc_out, cross_bias, hp.d_model, hp.n_head, hp.dropout,
-        is_test, fused=fused, kpad_bias=cross_kpad,
+        x, enc_out, enc_out, cross_bias, hp.d_model, hp.n_head,
+        0.0 if cache is not None else hp.dropout, is_test,
+        fused=fused or cache is not None, kpad_bias=cross_kpad,
     )
     x = pre_post_process(x, cross, hp.dropout, is_test)
     ffn = positionwise_ffn(x, hp.d_inner_hid, hp.d_model, hp.dropout, is_test)
@@ -505,3 +519,162 @@ def beam_translate(exe, main, fetches, src_ids, src_lens, bos_id, eos_id,
         logits_fn, trg0, 1, beam_size, max_out_len, eos_id, pad_id,
         length_penalty,
     )
+
+
+def transformer_decode_programs(hp=ModelHyperParams, batch=1, src_len=64,
+                                t_max=None):
+    """KV-cached seq2seq decoding, split into two programs sharing
+    persistable state (and weight names with wmt_transformer_program /
+    transformer_logits_program built in the same process):
+
+      enc_main:  feeds src_word [B, Ts] + src_slf_attn_bias [B,1,1,Ts];
+                 runs the encoder ONCE, persisting enc_out and the
+                 cross-attention key-padding row as scope state.
+      step_main: feeds trg_tok [B, 1] + pos [1]; one cached decoder step
+                 (self-attention over per-layer K/V caches, one-token
+                 cross-attention over the persisted enc_out);
+                 fetches next-token logits [B, trg_vocab].
+      cache_startup: zeroes all the persistable decode state.
+
+    Per generated token this is O((t_max + src_len) d) work instead of
+    the full re-decode's O(t_max^2 d).  Returns (enc_main, step_main,
+    cache_startup, enc_feeds, step_feeds, enc_fetch, step_fetch)."""
+    import paddle_tpu as fluid
+
+    t_max = t_max or hp.max_length
+    assert t_max <= hp.max_length, (
+        "t_max %d exceeds hp.max_length %d" % (t_max, hp.max_length))
+    dh = hp.d_model // hp.n_head
+    enc_main = fluid.Program()
+    step_main = fluid.Program()
+    cache_startup = fluid.Program()
+    throwaway = fluid.Program()
+
+    with unique_name.guard():
+        # ---- encoder program (parameter names: src emb + enc layers) ----
+        with fluid.program_guard(enc_main, throwaway):
+            src = layers.data("src_word", shape=[batch, src_len],
+                              dtype="int64", append_batch_size=False)
+            src_bias = layers.data(
+                "src_slf_attn_bias", shape=[batch, 1, 1, src_len],
+                dtype="float32", append_batch_size=False)
+            src_kpad = layers.reshape(src_bias, [-1, src_len])
+            x = prepare_embedding(
+                src, hp.src_vocab_size, hp.d_model, hp.max_length, 0.0,
+                "src_pos_enc_table", is_test=True)
+            for _ in range(hp.n_layer):
+                x = encoder_layer(x, src_bias, hp, is_test=True,
+                                  kpad_bias=src_kpad)
+            eb = enc_main.global_block()
+            enc_cache = eb.create_var(
+                name="tfm_enc_out_cache", shape=[batch, src_len, hp.d_model],
+                dtype="float32", persistable=True)
+            kpad_cache = eb.create_var(
+                name="tfm_cross_kpad_cache", shape=[batch, src_len],
+                dtype="float32", persistable=True)
+            eb.append_op("assign", inputs={"X": [x]},
+                         outputs={"Out": [enc_cache]})
+            eb.append_op("assign", inputs={"X": [src_kpad]},
+                         outputs={"Out": [kpad_cache]})
+
+        # ---- decode-step program (names continue: trg emb + dec layers) --
+        with fluid.program_guard(step_main, throwaway):
+            tok = layers.data("trg_tok", shape=[batch, 1], dtype="int64",
+                              append_batch_size=False)
+            pos = layers.data("pos", shape=[1], dtype="int64",
+                              append_batch_size=False)
+            word = layers.embedding(
+                tok, size=[hp.trg_vocab_size, hp.d_model],
+                param_attr=ParamAttr(initializer=Normal(0.0, hp.d_model ** -0.5)),
+            )  # [B, D] (T=1 squeezes in the lookup)
+            word = layers.scale(
+                layers.reshape(word, shape=[batch, 1, hp.d_model]),
+                scale=hp.d_model ** 0.5)
+            pos_table = layers.create_parameter(
+                shape=[hp.max_length, hp.d_model], dtype="float32",
+                name="trg_pos_enc_table",
+                attr=ParamAttr(
+                    name="trg_pos_enc_table", trainable=False,
+                    initializer=_NumpyInit(
+                        _pos_encoding_table(hp.max_length, hp.d_model))),
+            )
+            pos_row = layers.reshape(layers.gather(pos_table, pos),
+                                     shape=[1, 1, hp.d_model])
+            y = layers.elementwise_add(word, pos_row)
+            sb = step_main.global_block()
+            enc_ref = sb.create_var(
+                name="tfm_enc_out_cache", shape=[batch, src_len, hp.d_model],
+                dtype="float32", persistable=True)
+            kpad_ref = sb.create_var(
+                name="tfm_cross_kpad_cache", shape=[batch, src_len],
+                dtype="float32", persistable=True)
+            from .decode_cache import create_kv_caches
+
+            cache_names = ["tfm_enc_out_cache", "tfm_cross_kpad_cache"]
+            kv_caches, kv_names = create_kv_caches(
+                sb, "tfm", hp.n_layer, batch, hp.n_head, t_max, dh)
+            cache_names += kv_names
+            for cache in kv_caches:
+                cache["pos"] = pos
+                y = decoder_layer(y, enc_ref, None, None, hp, is_test=True,
+                                  cross_kpad=kpad_ref, cache=cache)
+            logits = layers.fc(y, size=hp.trg_vocab_size, num_flatten_dims=2,
+                               bias_attr=False, param_attr=_pa("softmax_out.w"))
+            logits = layers.reshape(logits, shape=[batch, hp.trg_vocab_size])
+
+        # ---- cache zeroing program --------------------------------------
+        from .decode_cache import add_cache_zero_fills
+
+        add_cache_zero_fills(cache_startup, [
+            (cname, (enc_main.global_block()._find_var_recursive(cname)
+                     or step_main.global_block()._find_var_recursive(cname)
+                     ).shape)
+            for cname in cache_names])
+
+    return (enc_main, step_main, cache_startup,
+            ["src_word", "src_slf_attn_bias"], ["trg_tok", "pos"],
+            ["tfm_enc_out_cache"], [logits])
+
+
+def greedy_translate_cached(exe, programs, src_ids, src_lens, bos_id, eos_id,
+                            max_out_len=None, pad_id=0):
+    """Greedy decoding through the KV-cached decode programs (the output
+    contract of greedy_translate, at O((t_max + Ts) d) per token).
+    `programs` is transformer_decode_programs' return tuple."""
+    (enc_main, step_main, cache_startup, enc_feeds, step_feeds,
+     enc_fetch, step_fetch) = programs
+    src_ids = np.asarray(src_ids, "int64")
+    b, p = src_ids.shape
+    sb = step_main.global_block()
+    step_b = int(sb.vars["trg_tok"].shape[0])
+    assert b == step_b, (
+        "src batch %d != decode programs' static batch %d" % (b, step_b))
+    from .decode_cache import probe_cache_len
+
+    t_max = probe_cache_len(step_main, "tfm")
+    max_out_len = min(max_out_len or t_max, t_max)
+    src_lens = np.asarray(src_lens).reshape(-1)
+
+    exe.run(cache_startup)
+    # no fetch: the encoder's persistable writes survive DCE, and fetching
+    # the [B, Ts, D] activation would be a pure wasted D2H transfer
+    exe.run(enc_main, feed={
+        "src_word": src_ids,
+        "src_slf_attn_bias": pad_bias(src_lens, src_ids.shape[1]),
+    }, fetch_list=[])
+
+    trg = np.full((b, max_out_len), pad_id, "int64")
+    trg[:, 0] = bos_id
+    done = np.zeros(b, bool)
+    cur = 1
+    while cur < max_out_len and not done.all():
+        (logits,) = exe.run(step_main, feed={
+            "trg_tok": trg[:, cur - 1:cur],
+            "pos": np.array([cur - 1], "int64"),
+        }, fetch_list=step_fetch)
+        nxt = np.asarray(logits).argmax(axis=-1)
+        nxt = np.where(done, pad_id, nxt)
+        trg[:, cur] = nxt
+        done |= nxt == eos_id
+        cur += 1
+    return trg[:, :cur]
