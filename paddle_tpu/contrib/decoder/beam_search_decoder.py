@@ -127,22 +127,10 @@ def full_sequence_beam_search(logits_fn, prompt_buf, prompt_len, beam_size,
     cur = prompt_len
     while cur < limit and not finished.all():
         logits = np.asarray(logits_fn(buf, cur), np.float32)
-        logp = logits - _logsumexp(logits)
-        v = logp.shape[-1]
-        logp = logp.reshape(b, beam_size, v)
-        # finished beams only "emit" pad at zero cost (score frozen)
-        fin = finished
-        logp[fin] = -1e9
-        logp[fin, pad_id] = 0.0
-        cand = scores[:, :, None] + logp  # [B, beam, V]
-        parent, tok, scores = _beam_topk(cand, beam_size)
-        rows = (np.arange(b)[:, None] * beam_size + parent).reshape(-1)
+        (rows, step_tok, scores, lengths, finished) = _beam_step(
+            logits, scores, finished, lengths, beam_size, eos_id, pad_id)
         buf = buf[rows]
-        newly = tok == eos_id
-        was_fin = np.take_along_axis(finished, parent, axis=1)
-        buf[:, cur] = np.where(was_fin.reshape(-1), pad_id, tok.reshape(-1))
-        lengths = np.take_along_axis(lengths, parent, axis=1) + (~was_fin)
-        finished = was_fin | newly
+        buf[:, cur] = step_tok
         cur += 1
     if length_penalty:
         scores = scores / (lengths.astype(np.float32) ** length_penalty)
@@ -154,3 +142,69 @@ def full_sequence_beam_search(logits_fn, prompt_buf, prompt_len, beam_size,
 def _logsumexp(x):
     m = x.max(axis=-1, keepdims=True)
     return m + np.log(np.exp(x - m).sum(axis=-1, keepdims=True))
+
+
+def _beam_step(logits, scores, finished, lengths, beam_size, eos_id, pad_id):
+    """One beam expansion shared by the full-sequence and incremental
+    searches: finished beams emit pad at zero cost (score frozen), top-k
+    over (scores + logp), parent gather.  Returns (flat parent rows,
+    flat step tokens, scores, lengths, finished)."""
+    b = scores.shape[0]
+    logp = logits - _logsumexp(logits)
+    v = logp.shape[-1]
+    logp = logp.reshape(b, beam_size, v)
+    fin = finished
+    logp[fin] = -1e9
+    logp[fin, pad_id] = 0.0
+    cand = scores[:, :, None] + logp  # [B, beam, V]
+    parent, tok, scores = _beam_topk(cand, beam_size)
+    rows = (np.arange(b)[:, None] * beam_size + parent).reshape(-1)
+    was_fin = np.take_along_axis(finished, parent, axis=1)
+    step_tok = np.where(was_fin.reshape(-1), pad_id, tok.reshape(-1))
+    lengths = np.take_along_axis(lengths, parent, axis=1) + (~was_fin)
+    finished = was_fin | (tok == eos_id)
+    return rows, step_tok, scores, lengths, finished
+
+
+def incremental_beam_search(step_fn, reorder_fn, first_logits, prompt_buf,
+                            prompt_len, beam_size, max_total_len, eos_id,
+                            pad_id=0, length_penalty=0.0):
+    """Beam search over a KV-CACHED one-token decode step.
+
+    step_fn(tokens [R, 1], pos) -> [R, vocab] logits for the NEXT
+    position; reorder_fn(rows [R]) shuffles the decoder's cache state to
+    the selected parent rows BEFORE the next step (the reference's
+    beam-search cache-shuffling contract); first_logits [R, vocab] are
+    the logits after prefilling the prompt (R = batch*beam, prompt rows
+    repeated per beam).  Scoring/finish semantics match
+    full_sequence_beam_search; returns (ids [B, T_out], scores [B])."""
+    prompt_buf = np.asarray(prompt_buf)
+    b, p = prompt_buf.shape
+    assert p == prompt_len
+    limit = max_total_len
+    buf = np.full((b * beam_size, limit), pad_id, np.int64)
+    buf[:, :p] = np.repeat(prompt_buf, beam_size, axis=0)
+    scores = np.full((b, beam_size), -1e9, np.float32)
+    scores[:, 0] = 0.0
+    finished = np.zeros((b, beam_size), bool)
+    lengths = np.full((b, beam_size), prompt_len, np.int64)
+    logits = np.asarray(first_logits, np.float32)
+    cur = prompt_len
+    while cur < limit and not finished.all():
+        (rows, step_tok, scores, lengths, finished) = _beam_step(
+            logits, scores, finished, lengths, beam_size, eos_id, pad_id)
+        buf = buf[rows]
+        buf[:, cur] = step_tok
+        cur += 1
+        if cur < limit and not finished.all():
+            # caches follow the surviving beams — skipped on the final
+            # pass, whose shuffle no further step would read
+            reorder_fn(rows)
+            logits = np.asarray(
+                step_fn(step_tok[:, None].astype(np.int64), cur - 1),
+                np.float32)
+    if length_penalty:
+        scores = scores / (lengths.astype(np.float32) ** length_penalty)
+    best = np.argmax(scores, axis=1)
+    rows = np.arange(b) * beam_size + best
+    return buf[rows][:, :cur], scores[np.arange(b), best]

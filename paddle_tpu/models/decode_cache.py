@@ -46,3 +46,23 @@ def probe_cache_len(step_main, prefix):
         if n.startswith(prefix + "_kcache_"):
             return int(v.shape[2])
     raise ValueError("no %s_kcache_* vars in the step program" % prefix)
+
+
+def make_cache_reorder_program(named_shapes, batch):
+    """Program that gathers every named persistable cache along its batch
+    axis by the fed `parents` [batch] row ids and assigns it back — the
+    beam-search cache-shuffling step (run with fetch_list=[])."""
+    import paddle_tpu as fluid
+
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        parents = layers.data("parents", shape=[batch], dtype="int64",
+                              append_batch_size=False)
+        blk = prog.global_block()
+        for cname, shape in named_shapes:
+            cvar = blk.create_var(name=cname, shape=list(shape),
+                                  dtype="float32", persistable=True)
+            g = layers.gather(cvar, parents)
+            blk.append_op("assign", inputs={"X": [g]},
+                          outputs={"Out": [cvar]})
+    return prog

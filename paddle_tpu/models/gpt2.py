@@ -24,6 +24,7 @@ __all__ = [
     "gpt2_logits_program",
     "greedy_generate",
     "greedy_generate_cached",
+    "beam_generate_cached",
     "gpt2_decode_step_program",
     "beam_generate",
     "make_fake_lm_batch",
@@ -229,6 +230,20 @@ def gpt2_decode_step_program(hp=GPT2Config, batch=1, t_max=None):
     return main, cache_startup, ["step_ids", "pos"], [logits], cache_names
 
 
+def _prefill_cached(exe, step_main, fetches, ids):
+    """Feed the prompt one token at a time (filling the caches); returns
+    the logits after the last prompt token (they predict position p)."""
+    logits = None
+    for t in range(ids.shape[1]):
+        (logits,) = exe.run(
+            step_main,
+            feed={"step_ids": ids[:, t:t + 1],
+                  "pos": np.array([t], "int64")},
+            fetch_list=fetches,
+        )
+    return logits
+
+
 def greedy_generate_cached(exe, step_main, cache_startup, fetches,
                            prompt_ids, max_new_tokens):
     """Greedy decoding through the KV-cached step program: prefill feeds
@@ -249,14 +264,7 @@ def greedy_generate_cached(exe, step_main, cache_startup, fetches,
         % (p, max_new_tokens, t_cache))
     exe.run(cache_startup)  # (re)zero the caches for this generation
     out = [prompt_ids[:, i] for i in range(p)]
-    logits = None
-    for t in range(p):
-        (logits,) = exe.run(
-            step_main,
-            feed={"step_ids": prompt_ids[:, t:t + 1],
-                  "pos": np.array([t], "int64")},
-            fetch_list=fetches,
-        )
+    logits = _prefill_cached(exe, step_main, fetches, prompt_ids)
     for t in range(p, p + max_new_tokens):
         nxt = np.asarray(logits).argmax(axis=-1).astype("int64")
         out.append(nxt)
@@ -320,3 +328,53 @@ def beam_generate(exe, main, fetches, prompt_ids, max_new_tokens,
         logits_fn, buf, p, beam_size, p + max_new_tokens,
         eos_id if eos_id is not None else -1, pad_id, length_penalty,
     )
+
+
+def beam_generate_cached(exe, step_main, cache_startup, fetches, prompt_ids,
+                         max_new_tokens, beam_size=4, eos_id=None, pad_id=0,
+                         length_penalty=0.0):
+    """Beam-search decoding through the KV-cached step program: the step
+    program must be built with batch = B * beam_size; surviving beams'
+    caches shuffle via a gather/assign reorder program each step (the
+    reference's beam-search cache plumbing).  Returns (ids [B, T_out],
+    scores [B])."""
+    from ..contrib.decoder.beam_search_decoder import incremental_beam_search
+    from .decode_cache import make_cache_reorder_program, probe_cache_len
+
+    prompt_ids = np.asarray(prompt_ids, "int64")
+    b, p = prompt_ids.shape
+    assert p >= 1, "empty prompt: seed generation with at least a BOS token"
+    sb = step_main.global_block()
+    r = int(sb.vars["step_ids"].shape[0])
+    assert r == b * beam_size, (
+        "decode program batch %d != prompt batch %d * beam %d"
+        % (r, b, beam_size))
+    t_cache = probe_cache_len(step_main, "gpt2")
+    assert p + max_new_tokens <= t_cache + 1, (
+        "prompt %d + new %d exceeds cache length %d"
+        % (p, max_new_tokens, t_cache))
+    cache_shapes = [
+        (n, v.shape) for n, v in sb.vars.items()
+        if n.startswith(("gpt2_kcache_", "gpt2_vcache_"))
+    ]
+    reorder = make_cache_reorder_program(cache_shapes, r)
+
+    exe.run(cache_startup)
+    rep = np.repeat(prompt_ids, beam_size, axis=0)
+    logits = _prefill_cached(exe, step_main, fetches, rep)
+
+    def step_fn(tokens, pos):
+        (lg,) = exe.run(step_main,
+                        feed={"step_ids": tokens,
+                              "pos": np.array([pos], "int64")},
+                        fetch_list=fetches)
+        return lg
+
+    def reorder_fn(rows):
+        exe.run(reorder, feed={"parents": rows.astype("int64")},
+                fetch_list=[])
+
+    return incremental_beam_search(
+        step_fn, reorder_fn, logits, prompt_ids, p, beam_size,
+        p + max_new_tokens, eos_id if eos_id is not None else -1, pad_id,
+        length_penalty)

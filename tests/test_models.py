@@ -759,3 +759,39 @@ def test_transformer_kv_cached_translate_matches_full():
             exe, programs, src, src_lens, bos_id=1, eos_id=39,
             max_out_len=Tt)
         np.testing.assert_array_equal(out[:, :ref.shape[1]], ref)
+
+
+def test_gpt2_cached_beam_search_matches_full_beam():
+    """Cached beam search (with per-step cache reordering) returns the
+    same sequences and scores as the full-re-encode beam_generate."""
+    from paddle_tpu.models import gpt2
+
+    class HP(gpt2.GPT2Config):
+        vocab_size = 30
+        n_ctx = 16
+        d_model = 16
+        n_layer = 2
+        n_head = 2
+        dropout = 0.0
+
+    B, beam, T = 2, 3, 16
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        full_main, full_startup, _, full_fetch = gpt2.gpt2_logits_program(
+            HP, seq_len=T)
+        step_main, cache_startup, _, step_fetch, _ = \
+            gpt2.gpt2_decode_step_program(HP, batch=B * beam, t_max=T)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(full_startup)
+        rng = np.random.RandomState(5)
+        prompt = rng.randint(1, 30, (B, 3)).astype("int64")
+
+        ref_ids, ref_scores = gpt2.beam_generate(
+            exe, full_main, full_fetch, prompt, 6, beam_size=beam,
+            eos_id=29)
+        out_ids, out_scores = gpt2.beam_generate_cached(
+            exe, step_main, cache_startup, step_fetch, prompt, 6,
+            beam_size=beam, eos_id=29)
+        np.testing.assert_array_equal(out_ids, ref_ids)
+        np.testing.assert_allclose(out_scores, ref_scores, rtol=1e-4,
+                                   atol=1e-5)
