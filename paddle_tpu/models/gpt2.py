@@ -25,6 +25,7 @@ __all__ = [
     "greedy_generate",
     "greedy_generate_cached",
     "beam_generate_cached",
+    "sample_generate_cached",
     "gpt2_decode_step_program",
     "beam_generate",
     "make_fake_lm_batch",
@@ -378,3 +379,60 @@ def beam_generate_cached(exe, step_main, cache_startup, fetches, prompt_ids,
         step_fn, reorder_fn, logits, prompt_ids, p, beam_size,
         p + max_new_tokens, eos_id if eos_id is not None else -1, pad_id,
         length_penalty)
+
+
+def sample_generate_cached(exe, step_main, cache_startup, fetches,
+                           prompt_ids, max_new_tokens, temperature=1.0,
+                           top_k=0, top_p=1.0, seed=None, eos_id=None,
+                           pad_id=0):
+    """Stochastic decoding through the KV-cached step: temperature
+    scaling, top-k and/or nucleus (top-p) filtering, seeded numpy
+    sampling.  top_k=1 reduces to greedy.  Returns [B, P + new] int64."""
+    from .decode_cache import probe_cache_len
+
+    prompt_ids = np.asarray(prompt_ids, "int64")
+    b, p = prompt_ids.shape
+    assert p >= 1, "empty prompt: seed generation with at least a BOS token"
+    step_b = int(step_main.global_block().vars["step_ids"].shape[0])
+    assert b == step_b, (
+        "prompt batch %d != decode program's static batch %d" % (b, step_b))
+    t_cache = probe_cache_len(step_main, "gpt2")
+    assert p + max_new_tokens <= t_cache + 1, (
+        "prompt %d + new %d exceeds cache length %d"
+        % (p, max_new_tokens, t_cache))
+    rng = np.random.RandomState(seed)
+    exe.run(cache_startup)
+    logits = _prefill_cached(exe, step_main, fetches, prompt_ids)
+    out = [prompt_ids[:, i] for i in range(p)]
+    done = np.zeros(b, bool)
+    for t in range(p, p + max_new_tokens):
+        lg = np.asarray(logits, np.float64) / max(temperature, 1e-6)
+        if top_k:
+            k_eff = min(int(top_k), lg.shape[-1])  # top_k >= vocab: no-op
+            kth = np.sort(lg, axis=-1)[:, -k_eff][:, None]
+            lg = np.where(lg < kth, -np.inf, lg)
+        probs = np.exp(lg - lg.max(-1, keepdims=True))
+        probs /= probs.sum(-1, keepdims=True)
+        if top_p < 1.0:
+            order = np.argsort(-probs, axis=-1)
+            sorted_p = np.take_along_axis(probs, order, -1)
+            keep_sorted = np.cumsum(sorted_p, -1) - sorted_p < top_p
+            keep = np.zeros_like(probs, bool)
+            np.put_along_axis(keep, order, keep_sorted, -1)
+            probs = np.where(keep, probs, 0.0)
+            probs /= probs.sum(-1, keepdims=True)
+        nxt = np.array([rng.choice(probs.shape[-1], p=probs[i])
+                        for i in range(b)], "int64")
+        if eos_id is not None:
+            nxt = np.where(done, pad_id, nxt)
+            done |= nxt == eos_id
+        out.append(nxt)
+        if t + 1 >= p + max_new_tokens or (eos_id is not None and done.all()):
+            break
+        (logits,) = exe.run(step_main, feed={
+            "step_ids": nxt[:, None], "pos": np.array([t], "int64")},
+            fetch_list=fetches)
+    # early all-eos exit: pad to the documented [B, P + new] width
+    while len(out) < p + max_new_tokens:
+        out.append(np.full(b, pad_id, "int64"))
+    return np.stack(out, axis=1)

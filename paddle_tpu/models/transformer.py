@@ -28,6 +28,7 @@ __all__ = [
     "transformer_logits_program",
     "greedy_translate",
     "greedy_translate_cached",
+    "beam_translate_cached",
     "transformer_decode_programs",
     "beam_translate",
 ]
@@ -678,3 +679,59 @@ def greedy_translate_cached(exe, programs, src_ids, src_lens, bos_id, eos_id,
         done |= nxt == eos_id
         cur += 1
     return trg[:, :cur]
+
+
+def beam_translate_cached(exe, programs, src_ids, src_lens, bos_id, eos_id,
+                          beam_size=4, max_out_len=None, pad_id=0,
+                          length_penalty=0.0):
+    """Beam-search decoding through the KV-cached decode programs (built
+    with batch = B * beam_size).  Self-attention caches shuffle to the
+    surviving beams each step; the encoder state is beam-replicated at
+    encode time and invariant under the shuffle.  Output contract of
+    beam_translate.  Returns (ids [B, T_out], scores [B])."""
+    from ..contrib.decoder.beam_search_decoder import incremental_beam_search
+    from .decode_cache import make_cache_reorder_program, probe_cache_len
+
+    (enc_main, step_main, cache_startup, enc_feeds, step_feeds,
+     enc_fetch, step_fetch) = programs
+    src_ids = np.asarray(src_ids, "int64")
+    b, _ = src_ids.shape
+    sb = step_main.global_block()
+    r = int(sb.vars["trg_tok"].shape[0])
+    assert r == b * beam_size, (
+        "decode programs' batch %d != src batch %d * beam %d"
+        % (r, b, beam_size))
+    t_max = probe_cache_len(step_main, "tfm")
+    max_out_len = min(max_out_len or t_max, t_max)
+    src_lens = np.asarray(src_lens).reshape(-1)
+
+    exe.run(cache_startup)
+    exe.run(enc_main, feed={
+        "src_word": np.repeat(src_ids, beam_size, axis=0),
+        "src_slf_attn_bias": np.repeat(
+            pad_bias(src_lens, src_ids.shape[1]), beam_size, axis=0),
+    }, fetch_list=[])
+
+    # only the per-layer self-attention caches follow the beams
+    reorder = make_cache_reorder_program(
+        [(n, v.shape) for n, v in sb.vars.items()
+         if n.startswith(("tfm_kcache_", "tfm_vcache_"))], r)
+
+    bos = np.full((r, 1), bos_id, "int64")
+    (first,) = exe.run(step_main, feed={
+        "trg_tok": bos, "pos": np.array([0], "int64")}, fetch_list=step_fetch)
+
+    def step_fn(tokens, pos):
+        (lg,) = exe.run(step_main, feed={
+            "trg_tok": tokens, "pos": np.array([pos], "int64")},
+            fetch_list=step_fetch)
+        return lg
+
+    def reorder_fn(rows):
+        exe.run(reorder, feed={"parents": rows.astype("int64")},
+                fetch_list=[])
+
+    prompt = np.full((b, 1), bos_id, "int64")
+    return incremental_beam_search(
+        step_fn, reorder_fn, first, prompt, 1, beam_size, max_out_len,
+        eos_id, pad_id, length_penalty)

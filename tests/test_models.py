@@ -795,3 +795,86 @@ def test_gpt2_cached_beam_search_matches_full_beam():
         np.testing.assert_array_equal(out_ids, ref_ids)
         np.testing.assert_allclose(out_scores, ref_scores, rtol=1e-4,
                                    atol=1e-5)
+
+
+def test_transformer_cached_beam_translate_matches_full_beam():
+    """Cached seq2seq beam search == full-re-decode beam_translate
+    (ids and scores), with self caches shuffling per step."""
+    from paddle_tpu.models import transformer as tfm
+
+    class HP(tfm.ModelHyperParams):
+        src_vocab_size = 30
+        trg_vocab_size = 30
+        max_length = 16
+        d_model = 16
+        d_inner_hid = 32
+        n_head = 2
+        n_layer = 2
+        dropout = 0.0
+        fused_attn = True
+
+    B, beam, Ts, Tt = 2, 3, 8, 10
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        full_main, full_startup, _, full_fetch = \
+            tfm.transformer_logits_program(HP, src_len=Ts, trg_len=Tt)
+        programs = tfm.transformer_decode_programs(
+            HP, batch=B * beam, src_len=Ts, t_max=Tt)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(full_startup)
+        rng = np.random.RandomState(7)
+        src = rng.randint(2, 30, (B, Ts)).astype("int64")
+        lens = np.array([Ts, Ts - 2]); src[1, Ts - 2:] = 0
+
+        ref_ids, ref_sc = tfm.beam_translate(
+            exe, full_main, full_fetch, src, lens, bos_id=1, eos_id=29,
+            beam_size=beam, max_out_len=Tt)
+        out_ids, out_sc = tfm.beam_translate_cached(
+            exe, programs, src, lens, bos_id=1, eos_id=29,
+            beam_size=beam, max_out_len=Tt)
+        # same width AND same tokens: a late-termination regression in the
+        # cached path must not hide behind truncation
+        assert out_ids.shape == ref_ids.shape, (out_ids.shape, ref_ids.shape)
+        np.testing.assert_array_equal(out_ids, ref_ids)
+        np.testing.assert_allclose(out_sc, ref_sc, rtol=1e-4, atol=1e-5)
+
+
+def test_gpt2_sample_generate_cached():
+    """Sampling decode: seeded determinism, top_k=1 == greedy, nucleus
+    filtering keeps outputs in-vocab."""
+    from paddle_tpu.models import gpt2
+
+    class HP(gpt2.GPT2Config):
+        vocab_size = 30
+        n_ctx = 16
+        d_model = 16
+        n_layer = 1
+        n_head = 2
+        dropout = 0.0
+
+    B, T = 2, 16
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        full_main, full_startup, _, full_fetch = gpt2.gpt2_logits_program(
+            HP, seq_len=T)
+        step_main, cache_startup, _, step_fetch, _ = \
+            gpt2.gpt2_decode_step_program(HP, batch=B, t_max=T)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(full_startup)
+        prompt = np.random.RandomState(8).randint(1, 30, (B, 3)).astype("int64")
+
+        a = gpt2.sample_generate_cached(exe, step_main, cache_startup,
+                                        step_fetch, prompt, 5, seed=11,
+                                        top_k=5, top_p=0.9)
+        b2 = gpt2.sample_generate_cached(exe, step_main, cache_startup,
+                                         step_fetch, prompt, 5, seed=11,
+                                         top_k=5, top_p=0.9)
+        np.testing.assert_array_equal(a, b2)  # seeded determinism
+        assert a.shape == (B, 8) and (a >= 0).all() and (a < 30).all()
+
+        greedy = gpt2.greedy_generate_cached(
+            exe, step_main, cache_startup, step_fetch, prompt, 5)
+        k1 = gpt2.sample_generate_cached(exe, step_main, cache_startup,
+                                         step_fetch, prompt, 5, seed=0,
+                                         top_k=1)
+        np.testing.assert_array_equal(k1, greedy)  # top_k=1 == greedy
