@@ -153,6 +153,13 @@ def _bench_impl():
         except Exception as e:  # the headline number must still land
             sys.stderr.write("transformer bench failed: %r\n" % (e,))
             result["transformer_error"] = repr(e)[:300]
+    # decode-throughput diagnostic: cached vs full-re-encode generation
+    if os.environ.get("BENCH_DECODE", "0") == "1":
+        try:
+            result["decode"] = _decode_bench(on_tpu, device)
+        except Exception as e:
+            sys.stderr.write("decode bench failed: %r\n" % (e,))
+            result["decode"] = {"error": repr(e)[:200]}
     # model-breadth diagnostics (fluid_benchmark.py model matrix): off by
     # default — the vgg/se_resnext shapes roughly double tunnel time
     if os.environ.get("BENCH_MODELS", "0") == "1":
@@ -249,6 +256,54 @@ def _model_bench(name, on_tpu, device):
     mfu = flops_util.mfu(step_flops, steps, dt, device)
     if mfu is not None:
         out["mfu"] = round(mfu, 4)
+    return out
+
+
+def _decode_bench(on_tpu, device):
+    """Generation throughput: KV-cached incremental decode vs the full
+    re-encode path on a small GPT-2 (tokens/sec of NEW tokens)."""
+    import time as _t
+
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models import gpt2
+
+    class HP(gpt2.GPT2Config):
+        vocab_size = 8000 if on_tpu else 200
+        n_ctx = 256 if on_tpu else 64
+        d_model = 256 if on_tpu else 64
+        n_layer = 4 if on_tpu else 2
+        n_head = 4 if on_tpu else 2
+        dropout = 0.0
+
+    B = int(os.environ.get("BENCH_DECODE_BATCH", 8 if on_tpu else 2))
+    T = HP.n_ctx
+    new = int(os.environ.get("BENCH_DECODE_TOKENS", T // 2))
+    scope = fluid.Scope()
+    out = {}
+    with fluid.scope_guard(scope):
+        full_main, full_startup, _, full_fetch = gpt2.gpt2_logits_program(
+            HP, seq_len=T)
+        step_main, cache_startup, _, step_fetch, _ = \
+            gpt2.gpt2_decode_step_program(HP, batch=B, t_max=T)
+        exe = fluid.Executor(fluid.TPUPlace(0) if on_tpu else fluid.CPUPlace())
+        exe.run(full_startup)
+        prompt = np.random.RandomState(0).randint(
+            1, HP.vocab_size, (B, 4)).astype("int64")
+        for name, fn in (
+            ("full_reencode", lambda: gpt2.greedy_generate(
+                exe, full_main, full_fetch, prompt, new)),
+            ("kv_cached", lambda: gpt2.greedy_generate_cached(
+                exe, step_main, cache_startup, step_fetch, prompt, new)),
+        ):
+            fn()  # warm compile
+            t0 = _t.time()
+            fn()
+            dt = _t.time() - t0
+            out[name] = {"value": round(B * new / dt, 1),
+                         "unit": "new tokens/sec"
+                         + ("" if on_tpu else " (cpufallback)")}
     return out
 
 

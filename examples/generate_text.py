@@ -42,6 +42,16 @@ def main():
     ids, scores = gpt2.beam_generate(exe, imain, ifetches, prompt, 8, beam_size=4)
     print("beam:  ", ids[0].tolist(), "score %.3f" % scores[0])
 
+    # KV-cached incremental decoding: O(T d) per token instead of the
+    # full re-encode — same tokens, plus seeded nucleus sampling
+    step, cache0, _, sfetch, _ = gpt2.gpt2_decode_step_program(
+        HP, batch=1, t_max=16)
+    print("cached:", gpt2.greedy_generate_cached(
+        exe, step, cache0, sfetch, prompt, 8)[0].tolist())
+    print("sample:", gpt2.sample_generate_cached(
+        exe, step, cache0, sfetch, prompt, 8, temperature=0.5, top_p=0.9,
+        seed=0)[0].tolist())
+
 
 if __name__ == "__main__":
     main()

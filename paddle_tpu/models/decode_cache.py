@@ -6,7 +6,8 @@ import numpy as np
 
 from .. import layers
 
-__all__ = ["create_kv_caches", "add_cache_zero_fills", "probe_cache_len"]
+__all__ = ["create_kv_caches", "add_cache_zero_fills", "probe_cache_len",
+           "make_cache_reorder_program", "validate_cached_call"]
 
 
 def create_kv_caches(block, prefix, n_layer, batch, n_head, t_max, dh):
@@ -66,3 +67,22 @@ def make_cache_reorder_program(named_shapes, batch):
             blk.append_op("assign", inputs={"X": [g]},
                           outputs={"Out": [cvar]})
     return prog
+
+
+def validate_cached_call(step_main, prefix, ids_var, batch, prompt_len,
+                         new_tokens, beams=1):
+    """Shared prologue checks for every cached-decode entry point: a
+    non-empty prompt, the step program's static batch, and the cache
+    capacity bound (the last generated token is never fed back, hence
+    the +1)."""
+    assert prompt_len >= 1, (
+        "empty prompt: seed generation with at least a BOS token")
+    step_b = int(step_main.global_block().vars[ids_var].shape[0])
+    assert batch * beams == step_b, (
+        "prompt batch %d x beams %d != decode program's static batch %d"
+        % (batch, beams, step_b))
+    t_cache = probe_cache_len(step_main, prefix)
+    assert prompt_len + new_tokens <= t_cache + 1, (
+        "prompt %d + new %d exceeds cache length %d"
+        % (prompt_len, new_tokens, t_cache))
+    return t_cache

@@ -251,18 +251,12 @@ def greedy_generate_cached(exe, step_main, cache_startup, fetches,
     the prompt one token at a time (filling the caches), then each new
     token costs one O(T_max * d) step.  Matches greedy_generate
     token-for-token."""
+    from .decode_cache import validate_cached_call
+
     prompt_ids = np.asarray(prompt_ids, "int64")
     b, p = prompt_ids.shape
-    assert p >= 1, "empty prompt: seed generation with at least a BOS token"
-    step_b = int(step_main.global_block().vars["step_ids"].shape[0])
-    assert b == step_b, (
-        "prompt batch %d != decode program's static batch %d" % (b, step_b))
-    from .decode_cache import probe_cache_len
-
-    t_cache = probe_cache_len(step_main, "gpt2")
-    assert p + max_new_tokens <= t_cache + 1, (
-        "prompt %d + new %d exceeds cache length %d"
-        % (p, max_new_tokens, t_cache))
+    validate_cached_call(step_main, "gpt2", "step_ids", b, p,
+                         max_new_tokens)
     exe.run(cache_startup)  # (re)zero the caches for this generation
     out = [prompt_ids[:, i] for i in range(p)]
     logits = _prefill_cached(exe, step_main, fetches, prompt_ids)
@@ -340,20 +334,17 @@ def beam_generate_cached(exe, step_main, cache_startup, fetches, prompt_ids,
     reference's beam-search cache plumbing).  Returns (ids [B, T_out],
     scores [B])."""
     from ..contrib.decoder.beam_search_decoder import incremental_beam_search
-    from .decode_cache import make_cache_reorder_program, probe_cache_len
+    from .decode_cache import (
+        make_cache_reorder_program,
+        validate_cached_call,
+    )
 
     prompt_ids = np.asarray(prompt_ids, "int64")
     b, p = prompt_ids.shape
-    assert p >= 1, "empty prompt: seed generation with at least a BOS token"
+    validate_cached_call(step_main, "gpt2", "step_ids", b, p,
+                         max_new_tokens, beams=beam_size)
     sb = step_main.global_block()
-    r = int(sb.vars["step_ids"].shape[0])
-    assert r == b * beam_size, (
-        "decode program batch %d != prompt batch %d * beam %d"
-        % (r, b, beam_size))
-    t_cache = probe_cache_len(step_main, "gpt2")
-    assert p + max_new_tokens <= t_cache + 1, (
-        "prompt %d + new %d exceeds cache length %d"
-        % (p, max_new_tokens, t_cache))
+    r = b * beam_size
     cache_shapes = [
         (n, v.shape) for n, v in sb.vars.items()
         if n.startswith(("gpt2_kcache_", "gpt2_vcache_"))
@@ -388,18 +379,12 @@ def sample_generate_cached(exe, step_main, cache_startup, fetches,
     """Stochastic decoding through the KV-cached step: temperature
     scaling, top-k and/or nucleus (top-p) filtering, seeded numpy
     sampling.  top_k=1 reduces to greedy.  Returns [B, P + new] int64."""
-    from .decode_cache import probe_cache_len
+    from .decode_cache import validate_cached_call
 
     prompt_ids = np.asarray(prompt_ids, "int64")
     b, p = prompt_ids.shape
-    assert p >= 1, "empty prompt: seed generation with at least a BOS token"
-    step_b = int(step_main.global_block().vars["step_ids"].shape[0])
-    assert b == step_b, (
-        "prompt batch %d != decode program's static batch %d" % (b, step_b))
-    t_cache = probe_cache_len(step_main, "gpt2")
-    assert p + max_new_tokens <= t_cache + 1, (
-        "prompt %d + new %d exceeds cache length %d"
-        % (p, max_new_tokens, t_cache))
+    validate_cached_call(step_main, "gpt2", "step_ids", b, p,
+                         max_new_tokens)
     rng = np.random.RandomState(seed)
     exe.run(cache_startup)
     logits = _prefill_cached(exe, step_main, fetches, prompt_ids)
