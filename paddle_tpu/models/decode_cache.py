@@ -7,7 +7,8 @@ import numpy as np
 from .. import layers
 
 __all__ = ["create_kv_caches", "add_cache_zero_fills", "probe_cache_len",
-           "make_cache_reorder_program", "validate_cached_call"]
+           "make_cache_reorder_program", "validate_cached_call",
+           "sample_from_logits"]
 
 
 def create_kv_caches(block, prefix, n_layer, batch, n_head, t_max, dh):
@@ -86,3 +87,25 @@ def validate_cached_call(step_main, prefix, ids_var, batch, prompt_len,
         "prompt %d + new %d exceeds cache length %d"
         % (prompt_len, new_tokens, t_cache))
     return t_cache
+
+
+def sample_from_logits(logits, rng, temperature=1.0, top_k=0, top_p=1.0):
+    """Temperature / top-k / nucleus (top-p) filtered categorical sampling
+    shared by the gpt2 and transformer samplers.  logits [B, V] -> [B]."""
+    lg = np.asarray(logits, np.float64) / max(temperature, 1e-6)
+    if top_k:
+        k_eff = min(int(top_k), lg.shape[-1])  # top_k >= vocab: no-op
+        kth = np.sort(lg, axis=-1)[:, -k_eff][:, None]
+        lg = np.where(lg < kth, -np.inf, lg)
+    probs = np.exp(lg - lg.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    if top_p < 1.0:
+        order = np.argsort(-probs, axis=-1)
+        sorted_p = np.take_along_axis(probs, order, -1)
+        keep_sorted = np.cumsum(sorted_p, -1) - sorted_p < top_p
+        keep = np.zeros_like(probs, bool)
+        np.put_along_axis(keep, order, keep_sorted, -1)
+        probs = np.where(keep, probs, 0.0)
+        probs /= probs.sum(-1, keepdims=True)
+    return np.array([rng.choice(probs.shape[-1], p=probs[i])
+                     for i in range(probs.shape[0])], "int64")

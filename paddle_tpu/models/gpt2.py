@@ -379,7 +379,7 @@ def sample_generate_cached(exe, step_main, cache_startup, fetches,
     """Stochastic decoding through the KV-cached step: temperature
     scaling, top-k and/or nucleus (top-p) filtering, seeded numpy
     sampling.  top_k=1 reduces to greedy.  Returns [B, P + new] int64."""
-    from .decode_cache import validate_cached_call
+    from .decode_cache import sample_from_logits, validate_cached_call
 
     prompt_ids = np.asarray(prompt_ids, "int64")
     b, p = prompt_ids.shape
@@ -391,23 +391,7 @@ def sample_generate_cached(exe, step_main, cache_startup, fetches,
     out = [prompt_ids[:, i] for i in range(p)]
     done = np.zeros(b, bool)
     for t in range(p, p + max_new_tokens):
-        lg = np.asarray(logits, np.float64) / max(temperature, 1e-6)
-        if top_k:
-            k_eff = min(int(top_k), lg.shape[-1])  # top_k >= vocab: no-op
-            kth = np.sort(lg, axis=-1)[:, -k_eff][:, None]
-            lg = np.where(lg < kth, -np.inf, lg)
-        probs = np.exp(lg - lg.max(-1, keepdims=True))
-        probs /= probs.sum(-1, keepdims=True)
-        if top_p < 1.0:
-            order = np.argsort(-probs, axis=-1)
-            sorted_p = np.take_along_axis(probs, order, -1)
-            keep_sorted = np.cumsum(sorted_p, -1) - sorted_p < top_p
-            keep = np.zeros_like(probs, bool)
-            np.put_along_axis(keep, order, keep_sorted, -1)
-            probs = np.where(keep, probs, 0.0)
-            probs /= probs.sum(-1, keepdims=True)
-        nxt = np.array([rng.choice(probs.shape[-1], p=probs[i])
-                        for i in range(b)], "int64")
+        nxt = sample_from_logits(logits, rng, temperature, top_k, top_p)
         if eos_id is not None:
             nxt = np.where(done, pad_id, nxt)
             done |= nxt == eos_id

@@ -29,6 +29,7 @@ __all__ = [
     "greedy_translate",
     "greedy_translate_cached",
     "beam_translate_cached",
+    "sample_translate_cached",
     "transformer_decode_programs",
     "beam_translate",
 ]
@@ -637,21 +638,21 @@ def transformer_decode_programs(hp=ModelHyperParams, batch=1, src_len=64,
             ["tfm_enc_out_cache"], [logits])
 
 
-def greedy_translate_cached(exe, programs, src_ids, src_lens, bos_id, eos_id,
-                            max_out_len=None, pad_id=0):
-    """Greedy decoding through the KV-cached decode programs (the output
-    contract of greedy_translate, at O((t_max + Ts) d) per token).
-    `programs` is transformer_decode_programs' return tuple."""
+def _translate_cached_loop(exe, programs, src_ids, src_lens, bos_id,
+                           eos_id, max_out_len, pad_id, pick_fn):
+    """Shared driver for cached seq2seq decoding: validate, zero caches,
+    run the encoder once, then step the cached decoder; pick_fn(logits
+    [B, V]) -> [B] chooses each next token (argmax or sampler)."""
+    from .decode_cache import probe_cache_len
+
     (enc_main, step_main, cache_startup, enc_feeds, step_feeds,
      enc_fetch, step_fetch) = programs
     src_ids = np.asarray(src_ids, "int64")
-    b, p = src_ids.shape
+    b, _ = src_ids.shape
     sb = step_main.global_block()
     step_b = int(sb.vars["trg_tok"].shape[0])
     assert b == step_b, (
         "src batch %d != decode programs' static batch %d" % (b, step_b))
-    from .decode_cache import probe_cache_len
-
     t_max = probe_cache_len(step_main, "tfm")
     max_out_len = min(max_out_len or t_max, t_max)
     src_lens = np.asarray(src_lens).reshape(-1)
@@ -673,12 +674,21 @@ def greedy_translate_cached(exe, programs, src_ids, src_lens, bos_id, eos_id,
             "trg_tok": trg[:, cur - 1:cur],
             "pos": np.array([cur - 1], "int64"),
         }, fetch_list=step_fetch)
-        nxt = np.asarray(logits).argmax(axis=-1)
-        nxt = np.where(done, pad_id, nxt)
+        nxt = np.where(done, pad_id, pick_fn(logits))
         trg[:, cur] = nxt
         done |= nxt == eos_id
         cur += 1
     return trg[:, :cur]
+
+
+def greedy_translate_cached(exe, programs, src_ids, src_lens, bos_id, eos_id,
+                            max_out_len=None, pad_id=0):
+    """Greedy decoding through the KV-cached decode programs (the output
+    contract of greedy_translate, at O((t_max + Ts) d) per token).
+    `programs` is transformer_decode_programs' return tuple."""
+    return _translate_cached_loop(
+        exe, programs, src_ids, src_lens, bos_id, eos_id, max_out_len,
+        pad_id, lambda lg: np.asarray(lg).argmax(axis=-1).astype("int64"))
 
 
 def beam_translate_cached(exe, programs, src_ids, src_lens, bos_id, eos_id,
@@ -735,3 +745,18 @@ def beam_translate_cached(exe, programs, src_ids, src_lens, bos_id, eos_id,
     return incremental_beam_search(
         step_fn, reorder_fn, first, prompt, 1, beam_size, max_out_len,
         eos_id, pad_id, length_penalty)
+
+
+def sample_translate_cached(exe, programs, src_ids, src_lens, bos_id,
+                            eos_id, max_out_len=None, temperature=1.0,
+                            top_k=0, top_p=1.0, seed=None, pad_id=0):
+    """Stochastic seq2seq decoding through the KV-cached programs:
+    temperature / top-k / nucleus filtering with seeded numpy sampling
+    (the sampling twin of greedy_translate_cached)."""
+    from .decode_cache import sample_from_logits
+
+    rng = np.random.RandomState(seed)
+    return _translate_cached_loop(
+        exe, programs, src_ids, src_lens, bos_id, eos_id, max_out_len,
+        pad_id,
+        lambda lg: sample_from_logits(lg, rng, temperature, top_k, top_p))

@@ -879,3 +879,40 @@ def test_gpt2_sample_generate_cached():
                                          step_fetch, prompt, 5, seed=0,
                                          top_k=1)
         np.testing.assert_array_equal(k1, greedy)  # top_k=1 == greedy
+
+
+def test_transformer_sample_translate_cached():
+    """Seeded sampling through the cached seq2seq decoder: deterministic
+    per seed, in-vocab, bos-prefixed."""
+    from paddle_tpu.models import transformer as tfm
+
+    class HP(tfm.ModelHyperParams):
+        src_vocab_size = 30
+        trg_vocab_size = 30
+        max_length = 16
+        d_model = 16
+        d_inner_hid = 32
+        n_head = 2
+        n_layer = 1
+        dropout = 0.0
+        fused_attn = True
+
+    B, Ts, Tt = 2, 8, 10
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        _, full_startup, _, _ = tfm.transformer_logits_program(
+            HP, src_len=Ts, trg_len=Tt)
+        programs = tfm.transformer_decode_programs(
+            HP, batch=B, src_len=Ts, t_max=Tt)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(full_startup)
+        src = np.random.RandomState(9).randint(2, 30, (B, Ts)).astype("int64")
+        lens = np.array([Ts, Ts])
+        a = tfm.sample_translate_cached(exe, programs, src, lens, bos_id=1,
+                                        eos_id=29, max_out_len=Tt, seed=3,
+                                        temperature=0.8, top_k=10)
+        b2 = tfm.sample_translate_cached(exe, programs, src, lens, bos_id=1,
+                                         eos_id=29, max_out_len=Tt, seed=3,
+                                         temperature=0.8, top_k=10)
+        np.testing.assert_array_equal(a, b2)
+        assert (a[:, 0] == 1).all() and (a >= 0).all() and (a < 30).all()
