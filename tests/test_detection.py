@@ -7,6 +7,7 @@ python/paddle/fluid/layers/detection.py).
 """
 
 import numpy as np
+import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu import layers
@@ -65,6 +66,7 @@ def test_ssd_toy_train_step():
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow  # heavy leg; fast run keeps sibling coverage
 def test_rcnn_toy_train_step():
     """RCNN-style: anchors -> rpn targets -> proposals -> sampled RoIs ->
     roi_align head trains (grads flow through roi features)."""

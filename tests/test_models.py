@@ -133,6 +133,7 @@ def test_transformer_bf16_trains():
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow  # heavy leg; fast run keeps sibling coverage
 def test_vgg16_trains():
     """benchmark/fluid/models/vgg.py capability: tiny VGG-16 train step."""
     from paddle_tpu.models.vgg import vgg16
@@ -208,6 +209,7 @@ def test_bert_pretrain_trains():
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow  # heavy leg; fast run keeps sibling coverage
 def test_bert_fused_attention_matches_dense():
     """BERT with hp.fused_attn == dense-mask BERT (same weights, dropout
     off): the key-padding fused path preserves masked-attention semantics
@@ -520,6 +522,7 @@ def test_transformer_greedy_translate_learns_copy():
     assert got_f.shape[1] == 4  # runs end-to-end (fresh weights, no claim)
 
 
+@pytest.mark.slow  # heavy leg; fast run keeps sibling coverage
 def test_gpt2_recompute_matches_plain():
     """hp.recompute (per-block jax.checkpoint) is numerically identical to
     the plain graph across training steps."""
