@@ -54,6 +54,12 @@ def sequence_parallel_ring_and_ulysses():
                                rtol=2e-4, atol=2e-5)
     print("ring == ulysses over sp=%d, T=%d" % (n, T))
 
+    # global sliding window ACROSS the ring: out-of-window chunks skip
+    win = parallel.ring.ring_attention_sharded(
+        q, q, q, mesh, "sp", causal=True, window=16)
+    print("windowed ring over sp=%d: out %s finite=%s"
+          % (n, win.shape, bool(np.isfinite(np.asarray(win)).all())))
+
 
 if __name__ == "__main__":
     single_chip_sliding_window()

@@ -64,21 +64,41 @@ def _use_flash(t_local, flag=None):
 
 
 def ring_attention(q, k, v, axis_name, causal=False, scale=None,
-                   use_flash=None):
+                   use_flash=None, window=0):
     """Per-shard attention with K/V ring rotation.
 
     q, k, v: local chunks [B, H, T_local, D]; global sequence is the
     concatenation over the `axis_name` ring in axis-index order.
     Returns the local output chunk [B, H, T_local, D].
+
+    window > 0 (requires causal): GLOBAL sliding-window attention across
+    the ring — each query sees the last `window` global positions, and
+    chunks entirely outside every local query's window are skipped
+    whole, so per-device compute scales with the window, not the global
+    sequence.  (Windowed pieces run on the dense chunk path: the banded
+    mask depends on the traced ring offset.)
     """
+    window = int(window)
+    assert window >= 0, "window must be >= 0"
+    assert not (window and not causal), "window attention requires causal"
     n = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
     t_local = q.shape[2]
     if scale is None:
         scale = q.shape[-1] ** -0.5
     scale = float(scale)
-    flash = _use_flash(t_local, use_flash)
+    flash = _use_flash(t_local, use_flash) and not window
     q_pos = my * t_local + jnp.arange(t_local)  # global positions of local q
+    # device-varying types for anything a cond/scan branch must produce
+    vma = tuple(getattr(jax.typeof(q), "vma", frozenset()) | {axis_name})
+
+    def skip_piece():
+        """A chunk contributing nothing: lse = -1e30 washes out of the
+        merge."""
+        return (jax.lax.pcast(jnp.zeros(q.shape, jnp.float32), vma,
+                              to="varying"),
+                jax.lax.pcast(jnp.full(q.shape[:-1], _NEG, jnp.float32),
+                              vma, to="varying"))
 
     def piece(k_blk, v_blk, src):
         """(o, lse) of local q vs the chunk originating at rank `src`."""
@@ -88,26 +108,32 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None,
             return _dense_piece(q, k_blk, v_blk, scale)
         if flash:
             # src == my: the diagonal chunk (causal within); src < my:
-            # fully visible; src > my: fully masked (skip — contributes
-            # exp(-1e30) ≈ 0 through the lse merge)
-            vma = tuple(
-                getattr(jax.typeof(q), "vma", frozenset()) | {axis_name})
-            skip_o = jax.lax.pcast(
-                jnp.zeros(q.shape, jnp.float32), vma, to="varying")
-            skip_lse = jax.lax.pcast(
-                jnp.full(q.shape[:-1], _NEG, jnp.float32), vma, to="varying")
+            # fully visible; src > my: fully masked (skipped)
             return jax.lax.cond(
                 src == my,
                 lambda: _flash_piece_bhtd(q, k_blk, v_blk, True, scale),
                 lambda: jax.lax.cond(
                     src < my,
                     lambda: _flash_piece_bhtd(q, k_blk, v_blk, False, scale),
-                    lambda: (skip_o, skip_lse),
+                    skip_piece,
                 ),
             )
         k_pos = src * t_local + jnp.arange(t_local)
         mask = q_pos[:, None] >= k_pos[None, :]
+        if window:
+            mask = mask & (q_pos[:, None] - k_pos[None, :] < window)
         bias = jnp.where(mask, 0.0, _NEG).astype(jnp.float32)[None, None]
+        if window:
+            # skip chunks entirely older than every local query's window:
+            # the closest (q, k) pair of chunks (my, src<my) sits
+            # (my-src-1)*T_local + 1 positions apart
+            contributes = (src == my) | (
+                (src < my) & ((my - src - 1) * t_local + 1 < window))
+            return jax.lax.cond(
+                contributes,
+                lambda: _dense_piece(q, k_blk, v_blk, scale, bias),
+                skip_piece,
+            )
         return _dense_piece(q, k_blk, v_blk, scale, bias)
 
     perm = [(j, (j + 1) % n) for j in range(n)]
@@ -127,7 +153,6 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None,
     # mark the accumulators device-varying over every axis the inputs vary
     # on (the ring axis, plus e.g. a dp axis on a composite mesh) so the
     # scan carry type matches the body output under shard_map
-    vma = tuple(getattr(jax.typeof(q), "vma", frozenset()) | {axis_name})
     o0 = jax.lax.pcast(jnp.zeros(q.shape, jnp.float32), vma, to="varying")
     lse0 = jax.lax.pcast(
         jnp.full(q.shape[:-1], -jnp.inf, jnp.float32), vma, to="varying")
@@ -137,7 +162,7 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None,
 
 
 def ring_attention_sharded(q, k, v, mesh, axis_name="sp", causal=False,
-                           use_flash=None):
+                           use_flash=None, window=0):
     """Convenience wrapper: shard q/k/v over `axis_name` on the time dim and
     run ring_attention under shard_map.  q,k,v: [B, H, T, D] global."""
     from jax import shard_map
@@ -152,6 +177,6 @@ def ring_attention_sharded(q, k, v, mesh, axis_name="sp", causal=False,
     )
     def inner(ql, kl, vl):
         return ring_attention(ql, kl, vl, axis_name, causal=causal,
-                              use_flash=use_flash)
+                              use_flash=use_flash, window=window)
 
     return inner(q, k, v)
