@@ -401,37 +401,41 @@ def _flash_vjp_bwd(causal, scale, block_q, block_k, window, res, do):
 flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention_piece(q, k, v, causal=False, scale=None,
-                          block_q=128, block_k=128):
+                          block_q=128, block_k=128, window=0):
     """Unmerged attention piece for ring/Ulysses sequence parallelism:
     returns (o, lse) where o is softmax-normalized within this K/V chunk
     and lse is the per-row logsumexp.  Two pieces merge exactly via
     lse = logaddexp(lse1, lse2); o = o1*exp(lse1-lse) + o2*exp(lse2-lse)
     (see parallel/ring.py).  Differentiable in q/k/v including through the
-    lse output (its cotangent folds into the backward's delta term)."""
+    lse output (its cotangent folds into the backward's delta term).
+    window: sliding-window masking in LOCAL positions (a ring caller may
+    use it only where its global offsets cancel, i.e. the diagonal
+    chunk)."""
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     kb = jnp.zeros(k.shape[:2], jnp.float32)
-    return _flash_fwd(q, k, v, kb, causal, scale, block_q, block_k)
+    return _flash_fwd(q, k, v, kb, causal, scale, block_q, block_k, window)
 
 
-def _piece_vjp_fwd(q, k, v, causal, scale, block_q, block_k):
+def _piece_vjp_fwd(q, k, v, causal, scale, block_q, block_k, window=0):
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     kb = jnp.zeros(k.shape[:2], jnp.float32)
-    o, lse = _flash_fwd(q, k, v, kb, causal, scale, block_q, block_k)
+    o, lse = _flash_fwd(q, k, v, kb, causal, scale, block_q, block_k, window)
     return (o, lse), (q, k, v, o, lse)
 
 
-def _piece_vjp_bwd(causal, scale, block_q, block_k, res, cts):
+def _piece_vjp_bwd(causal, scale, block_q, block_k, window, res, cts):
     q, k, v, o, lse = res
     do, dlse = cts
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     kb = jnp.zeros(k.shape[:2], jnp.float32)
     dq, dk, dv, _ = _flash_bwd(
-        q, k, v, kb, o, lse, do, causal, scale, block_q, block_k, dlse=dlse)
+        q, k, v, kb, o, lse, do, causal, scale, block_q, block_k, dlse=dlse,
+        window=window)
     return dq, dk, dv
 
 

@@ -41,7 +41,7 @@ def _dense_piece(q, k, v, scale, bias=None):
     return o, m + jnp.log(safe_l)
 
 
-def _flash_piece_bhtd(q, k, v, causal, scale):
+def _flash_piece_bhtd(q, k, v, causal, scale, window=0):
     """Pallas flash piece over [B,H,T,D] (kernel wants [BH,T,D])."""
     from ..ops.pallas_kernels import flash_attention_piece
 
@@ -50,7 +50,7 @@ def _flash_piece_bhtd(q, k, v, causal, scale):
     blk = 128 if (T % 128 == 0 and Tk % 128 == 0) else 8
     o, lse = flash_attention_piece(
         q.reshape(B * H, T, D), k.reshape(B * H, Tk, D),
-        v.reshape(B * H, Tk, D), causal, scale, blk, blk)
+        v.reshape(B * H, Tk, D), causal, scale, blk, blk, window)
     return (o.astype(jnp.float32).reshape(B, H, T, D),
             lse.reshape(B, H, T))
 
@@ -79,15 +79,17 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None,
     mask depends on the traced ring offset.)
     """
     window = int(window)
-    assert window >= 0, "window must be >= 0"
-    assert not (window and not causal), "window attention requires causal"
+    if window < 0:
+        raise ValueError("ring_attention: window must be >= 0")
+    if window and not causal:
+        raise ValueError("ring_attention: window requires causal=True")
     n = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
     t_local = q.shape[2]
     if scale is None:
         scale = q.shape[-1] ** -0.5
     scale = float(scale)
-    flash = _use_flash(t_local, use_flash) and not window
+    flash = _use_flash(t_local, use_flash)
     q_pos = my * t_local + jnp.arange(t_local)  # global positions of local q
     # device-varying types for anything a cond/scan branch must produce
     vma = tuple(getattr(jax.typeof(q), "vma", frozenset()) | {axis_name})
@@ -107,16 +109,30 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None,
                 return _flash_piece_bhtd(q, k_blk, v_blk, False, scale)
             return _dense_piece(q, k_blk, v_blk, scale)
         if flash:
-            # src == my: the diagonal chunk (causal within); src < my:
-            # fully visible; src > my: fully masked (skipped)
+            # src == my: the diagonal chunk — causal within, and the ring
+            # offsets cancel so the kernel's LOCAL window mask is exact;
+            # src < my: visible (band-masked off-diagonal when windowed —
+            # dense, since that mask depends on the traced offset);
+            # src > my: fully masked (skipped)
+            def offdiag():
+                if not window:
+                    return _flash_piece_bhtd(q, k_blk, v_blk, False, scale)
+                k_pos_od = src * t_local + jnp.arange(t_local)
+                m = ((q_pos[:, None] >= k_pos_od[None, :])
+                     & (q_pos[:, None] - k_pos_od[None, :] < window))
+                bias_od = jnp.where(m, 0.0, _NEG).astype(
+                    jnp.float32)[None, None]
+                contributes = (my - src - 1) * t_local + 1 < window
+                return jax.lax.cond(
+                    contributes,
+                    lambda: _dense_piece(q, k_blk, v_blk, scale, bias_od),
+                    skip_piece,
+                )
             return jax.lax.cond(
                 src == my,
-                lambda: _flash_piece_bhtd(q, k_blk, v_blk, True, scale),
-                lambda: jax.lax.cond(
-                    src < my,
-                    lambda: _flash_piece_bhtd(q, k_blk, v_blk, False, scale),
-                    skip_piece,
-                ),
+                lambda: _flash_piece_bhtd(q, k_blk, v_blk, True, scale,
+                                          window),
+                lambda: jax.lax.cond(src < my, offdiag, skip_piece),
             )
         k_pos = src * t_local + jnp.arange(t_local)
         mask = q_pos[:, None] >= k_pos[None, :]

@@ -21,9 +21,11 @@ import jax
 from jax.sharding import PartitionSpec as P
 
 
-def _attention(q, k, v, causal, scale):
+def _attention(q, k, v, causal, scale, window=0):
     """Full-sequence attention on local heads [B, h, T, D] — flash kernel
-    under FLAGS_use_pallas via the shared fused-attention dispatch."""
+    under FLAGS_use_pallas via the shared fused-attention dispatch
+    (window: sliding-window masking, since every head sees the FULL
+    sequence here the op's banded mask applies globally)."""
     from ..ops import nn_ops  # noqa: F401  (registers fused_attention)
     from ..core.registry import get_op
 
@@ -35,12 +37,13 @@ def _attention(q, k, v, causal, scale):
 
     out = get_op("fused_attention").lower(
         _Ctx(), {"Q": [q], "K": [k], "V": [v]},
-        {"causal": causal, "scale": scale},
+        {"causal": causal, "scale": scale, "window": int(window)},
     )
     return out["Out"][0]
 
 
-def ulysses_attention(q, k, v, axis_name, causal=False, scale=None):
+def ulysses_attention(q, k, v, axis_name, causal=False, scale=None,
+                      window=0):
     """Per-device body (call under shard_map): q/k/v [B, H, T_local, D]
     sharded on time -> output [B, H, T_local, D] sharded on time.
 
@@ -67,11 +70,12 @@ def ulysses_attention(q, k, v, axis_name, causal=False, scale=None):
         )
 
     qh, kh, vh = scatter_heads(q), scatter_heads(k), scatter_heads(v)
-    out = _attention(qh, kh, vh, causal, scale)
+    out = _attention(qh, kh, vh, causal, scale, window)
     return scatter_time(out)
 
 
-def ulysses_attention_sharded(q, k, v, mesh, axis_name="sp", causal=False):
+def ulysses_attention_sharded(q, k, v, mesh, axis_name="sp", causal=False,
+                              window=0):
     """Convenience wrapper mirroring ring_attention_sharded: q/k/v
     [B, H, T, D] global, sharded over `axis_name` on the time dim."""
     from jax import shard_map
@@ -85,6 +89,7 @@ def ulysses_attention_sharded(q, k, v, mesh, axis_name="sp", causal=False):
         out_specs=spec,
     )
     def inner(ql, kl, vl):
-        return ulysses_attention(ql, kl, vl, axis_name, causal=causal)
+        return ulysses_attention(ql, kl, vl, axis_name, causal=causal,
+                                 window=window)
 
     return inner(q, k, v)
