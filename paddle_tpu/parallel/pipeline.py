@@ -239,3 +239,52 @@ def sequential_reference(stage_fn, params_list, x):
     for p in params_list:
         x = stage_fn(p, x)
     return x
+
+
+def pipeline_transformer_stages(d_model, n_head, d_inner=None,
+                                dtype=jnp.float32):
+    """Transformer-encoder-block stages for pipeline tests/demos: each
+    stage is pre-LN self-attention + FFN on [B, T, D] (uniform shapes, so
+    stages map onto the `pp` axis like the MLP demo).  Returns
+    (stage_fn, init_stage)."""
+    d_inner = d_inner or 4 * d_model
+    dh = d_model // n_head
+
+    def _ln(x, g, b):
+        m = jnp.mean(x, axis=-1, keepdims=True)
+        v = jnp.var(x, axis=-1, keepdims=True)
+        return (x - m) * jax.lax.rsqrt(v + 1e-5) * g + b
+
+    def stage_fn(p, x):
+        h = _ln(x, p["ln1_g"], p["ln1_b"])
+        B, T, _ = h.shape
+        q = (h @ p["wq"]).reshape(B, T, n_head, dh).transpose(0, 2, 1, 3)
+        k = (h @ p["wk"]).reshape(B, T, n_head, dh).transpose(0, 2, 1, 3)
+        v = (h @ p["wv"]).reshape(B, T, n_head, dh).transpose(0, 2, 1, 3)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (dh ** -0.5)
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        a = jax.nn.softmax(jnp.where(mask[None, None], s, -1e30), axis=-1)
+        ctx = jnp.einsum("bhqk,bhkd->bhqd", a, v)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(B, T, d_model)
+        x = x + ctx @ p["wo"]
+        h = _ln(x, p["ln2_g"], p["ln2_b"])
+        return x + jax.nn.gelu(h @ p["w1"]) @ p["w2"]
+
+    def init_stage(key):
+        ks = jax.random.split(key, 6)
+        s = d_model ** -0.5
+        return {
+            "wq": jax.random.normal(ks[0], (d_model, d_model), dtype) * s,
+            "wk": jax.random.normal(ks[1], (d_model, d_model), dtype) * s,
+            "wv": jax.random.normal(ks[2], (d_model, d_model), dtype) * s,
+            "wo": jax.random.normal(ks[3], (d_model, d_model), dtype) * s,
+            "w1": jax.random.normal(ks[4], (d_model, d_inner), dtype) * s,
+            "w2": jax.random.normal(ks[5], (d_inner, d_model), dtype)
+                  * (d_inner ** -0.5),
+            "ln1_g": jnp.ones((d_model,), dtype),
+            "ln1_b": jnp.zeros((d_model,), dtype),
+            "ln2_g": jnp.ones((d_model,), dtype),
+            "ln2_b": jnp.zeros((d_model,), dtype),
+        }
+
+    return stage_fn, init_stage

@@ -324,3 +324,21 @@ def test_native_transport_hmac(monkeypatch):
     finally:
         srv.shutdown()
         RPCClient.reset_all()
+
+
+def test_wire_decoder_fuzz_never_crashes():
+    """Property check: random byte soup either decodes to a value or
+    raises ValueError/UnicodeDecodeError — never any other exception and
+    never code execution (the closed-type-system guarantee)."""
+    import random
+
+    rnd = random.Random(1234)
+    tags = b"NTFIDSBALUMZ\x00\xff"
+    for trial in range(300):
+        n = rnd.randrange(0, 64)
+        buf = bytes(rnd.choice(tags) if rnd.random() < 0.3
+                    else rnd.randrange(256) for _ in range(n))
+        try:
+            _Reader(buf).decode()
+        except (ValueError, UnicodeDecodeError, OverflowError):
+            pass
