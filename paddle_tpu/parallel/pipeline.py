@@ -1,19 +1,20 @@
-"""Pipeline parallelism (pp axis): GPipe-style fill-drain schedule as pure
-SPMD over a mesh axis.
+"""Pipeline parallelism (pp axis) over a mesh axis, two schedules:
+
+- `gpipe`: classic fill-drain over M microbatches and S stages (M+S-1
+  ticks); `jax.grad` differentiates straight through the scanned
+  ppermute hops (the transpose is the reverse ring), at the cost of
+  stashing O(M) activations per stage.
+- `one_f_one_b`: hand-scheduled 1F1B train step — each microbatch's
+  backward runs as soon as its forward clears the pipe, holding only a
+  2S-1 circular buffer of stage inputs (O(S) activation memory,
+  independent of M).
 
 The reference framework has no pipeline engine (its multi-device story is
 data-parallel only — SURVEY §2.9); this is the TPU-native extension that
-completes the dp/mp/pp/sp/ep parallelism set.  Design: every pipeline
-stage lives on one slice of the `pp` mesh axis, activations hop stage to
-stage over ICI with `ppermute`, and the whole schedule is a `lax.scan`
-inside one `shard_map` — so XLA sees a single static program, and
-`jax.grad` differentiates straight through it (the transpose of ppermute
-is the reverse-direction ppermute, which yields the backward pipeline for
-free — no hand-written 1F1B needed).
-
-Schedule: classic GPipe fill-drain over M microbatches and S stages
-(M + S - 1 ticks).  Bubble fraction (S-1)/(M+S-1) shrinks as M grows;
-choose M a multiple of S where possible.
+completes the dp/mp/pp/sp/ep parallelism set.  Every stage lives on one
+slice of the `pp` mesh axis, activations hop stage to stage over ICI
+with `ppermute`, and each schedule is a `lax.scan` inside one
+`shard_map` — XLA sees a single static program.
 """
 
 import jax
