@@ -59,11 +59,18 @@ def _row_block(n, default):
 # saved lse — the [T, T] score matrix never exists in HBM in either pass.
 # Role parity: the cuDNN fused-attention kernels of SURVEY §2.6.
 # ---------------------------------------------------------------------------
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, kb_ref, o_ref, lse_ref,
-                      acc_ref, m_ref, l_ref, *, block_q, block_k, nk,
-                      causal, scale, window=0):
+def _flash_fwd_kernel(*refs, block_q, block_k, nk,
+                      causal, scale, window=0, has_qoff=False):
     from jax.experimental import pallas as pl
 
+    if has_qoff:
+        (qoff_ref, q_ref, k_ref, v_ref, kb_ref, o_ref, lse_ref,
+         acc_ref, m_ref, l_ref) = refs
+        qo = qoff_ref[0]  # global q-position base minus k base (SMEM)
+    else:
+        (q_ref, k_ref, v_ref, kb_ref, o_ref, lse_ref,
+         acc_ref, m_ref, l_ref) = refs
+        qo = 0
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -73,13 +80,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, kb_ref, o_ref, lse_ref,
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    # causal: blocks entirely above the diagonal contribute nothing
-    run = (ki * block_k < (qi + 1) * block_q) if causal else (ki >= 0)
-    if window:
-        # sliding window: blocks entirely older than q_min - window + 1
-        # contribute nothing
-        run = run & (ki * block_k + block_k - 1
-                     >= qi * block_q - window + 1)
+    run, keep_fn = _band(qi, ki, qo, block_q, block_k, causal, window)
 
     @pl.when(run)
     def _compute():
@@ -88,15 +89,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, kb_ref, o_ref, lse_ref,
         v = v_ref[0].astype(jnp.float32)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [bq, bk]
         s = s + kb_ref[0].astype(jnp.float32)  # [1, bk] broadcast
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 0)
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 1)
-            keep = q_pos >= k_pos
-            if window:  # sliding window: only the last `window` positions
-                keep = keep & (q_pos - k_pos < window)
-            s = jnp.where(keep, s, NEG_INF)
+        s = keep_fn(s)
         m_prev = m_ref[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -114,6 +107,30 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, kb_ref, o_ref, lse_ref,
         lse_ref[0] = (m_ref[:] + jnp.log(safe_l)).reshape(-1)
 
 
+def _band(qi, ki, qo, block_q, block_k, causal, window):
+    """Shared causal/window band logic for the three flash kernels:
+    returns (run, keep_fn) — the block-skip predicate and a function
+    masking an [bq, bk] score tile in GLOBAL positions (q base = qo)."""
+    run = (ki * block_k < (qi + 1) * block_q + qo) if causal else (ki >= 0)
+    if window:
+        run = run & (ki * block_k + block_k - 1
+                     >= qi * block_q + qo - window + 1)
+
+    def keep_fn(s):
+        if not causal:
+            return s
+        q_pos = qo + qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 0)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        keep = q_pos >= k_pos
+        if window:
+            keep = keep & (q_pos - k_pos < window)
+        return jnp.where(keep, s, NEG_INF)
+
+    return run, keep_fn
+
+
 def _flash_blocks(Tq, Tk, block_q, block_k, causal):
     block_q = min(block_q, Tq)
     block_k = min(block_k, Tk)
@@ -125,35 +142,46 @@ def _flash_blocks(Tq, Tk, block_q, block_k, causal):
     return block_q, block_k
 
 
-def _flash_fwd(q, k, v, kbias, causal, scale, block_q, block_k, window=0):
+def _flash_fwd(q, k, v, kbias, causal, scale, block_q, block_k, window=0,
+               qoff=None):
     """q: [BH, Tq, d], k/v: [BH, Tk, d], kbias: [BH, Tk] additive key bias.
     window > 0 (causal only): sliding-window attention — each query sees
-    only the last `window` key positions.  Returns (o, lse)."""
+    only the last `window` key positions.  qoff: optional [1] int32 GLOBAL
+    q-position base relative to k's (traced; SMEM scalar) — the ring
+    passes its chunk offset so causal/window masks apply in global
+    positions.  Returns (o, lse)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     BH, T, d = q.shape
     Tk = k.shape[1]
-    block_q, block_k = _flash_blocks(T, Tk, block_q, block_k, causal)
+    block_q, block_k = _flash_blocks(T, Tk, block_q, block_k,
+                                     causal and qoff is None)
     assert not (window and not causal), "window attention requires causal"
     nq, nk = T // block_q, Tk // block_k
     kernel = functools.partial(
         _flash_fwd_kernel, block_q=block_q, block_k=block_k, nk=nk,
         causal=causal, scale=scale, window=int(window),
+        has_qoff=qoff is not None,
     )
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k), lambda b, i, j: (b, j),
+                     memory_space=pltpu.VMEM),
+    ]
+    args = [q, k, v, kbias]
+    if qoff is not None:
+        in_specs.insert(0, pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.insert(0, qoff.astype(jnp.int32).reshape(1))
     return pl.pallas_call(
         kernel,
         grid=(BH, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k), lambda b, i, j: (b, j),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
@@ -170,14 +198,21 @@ def _flash_fwd(q, k, v, kbias, causal, scale, block_q, block_k, window=0):
             pltpu.VMEM((block_q, 1), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v, kbias)
+    )(*args)
 
 
-def _flash_dq_kernel(q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref, delta_ref,
-                     dq_ref, dq_acc, *, block_q, block_k, nk, causal, scale,
-                     window=0):
+def _flash_dq_kernel(*refs, block_q, block_k, nk, causal, scale,
+                     window=0, has_qoff=False):
     from jax.experimental import pallas as pl
 
+    if has_qoff:
+        (qoff_ref, q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dq_acc) = refs
+        qo = qoff_ref[0]
+    else:
+        (q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dq_acc) = refs
+        qo = 0
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -185,10 +220,10 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref, delta_ref,
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    run = (ki * block_k < (qi + 1) * block_q) if causal else (ki >= 0)
+    run = (ki * block_k < (qi + 1) * block_q + qo) if causal else (ki >= 0)
     if window:
         run = run & (ki * block_k + block_k - 1
-                     >= qi * block_q - window + 1)
+                     >= qi * block_q + qo - window + 1)
 
     @pl.when(run)
     def _compute():
@@ -201,7 +236,7 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref, delta_ref,
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         s = s + kb_ref[0].astype(jnp.float32)
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            q_pos = qo + qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 0)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 1)
@@ -220,11 +255,18 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _flash_dkv_kernel(q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref, delta_ref,
-                      dk_ref, dv_ref, dkb_ref, dk_acc, dv_acc, dkb_acc, *,
-                      block_q, block_k, nq, causal, scale, window=0):
+def _flash_dkv_kernel(*refs, block_q, block_k, nq, causal, scale,
+                      window=0, has_qoff=False):
     from jax.experimental import pallas as pl
 
+    if has_qoff:
+        (qoff_ref, q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dkb_ref, dk_acc, dv_acc, dkb_acc) = refs
+        qo = qoff_ref[0]
+    else:
+        (q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dkb_ref, dk_acc, dv_acc, dkb_acc) = refs
+        qo = 0
     ki = pl.program_id(1)
     qi = pl.program_id(2)
 
@@ -234,10 +276,10 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref, delta_ref,
         dv_acc[:] = jnp.zeros_like(dv_acc)
         dkb_acc[:] = jnp.zeros_like(dkb_acc)
 
-    run = (ki * block_k < (qi + 1) * block_q) if causal else (qi >= 0)
+    run = (ki * block_k < (qi + 1) * block_q + qo) if causal else (qi >= 0)
     if window:
         run = run & (ki * block_k + block_k - 1
-                     >= qi * block_q - window + 1)
+                     >= qi * block_q + qo - window + 1)
 
     @pl.when(run)
     def _compute():
@@ -250,7 +292,7 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref, delta_ref,
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         s = s + kb_ref[0].astype(jnp.float32)
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            q_pos = qo + qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 0)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 1)
@@ -275,7 +317,7 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd(q, k, v, kbias, o, lse, do, causal, scale, block_q, block_k,
-               dlse=None, window=0):
+               dlse=None, window=0, qoff=None):
     """Blocked backward: returns (dq, dk, dv, dkbias[BH,Tk] f32).
 
     dlse: optional cotangent of the lse output (the chunk-merge path of
@@ -286,11 +328,14 @@ def _flash_bwd(q, k, v, kbias, o, lse, do, causal, scale, block_q, block_k,
 
     BH, T, d = q.shape
     Tk = k.shape[1]
-    block_q, block_k = _flash_blocks(T, Tk, block_q, block_k, causal)
+    block_q, block_k = _flash_blocks(T, Tk, block_q, block_k,
+                                     causal and qoff is None)
     nq, nk = T // block_q, Tk // block_k
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     if dlse is not None:
         delta = delta - dlse.astype(jnp.float32)
+    qoff_arg = (
+        [qoff.astype(jnp.int32).reshape(1)] if qoff is not None else [])
 
     q_spec_q = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
                             memory_space=pltpu.VMEM)
@@ -300,19 +345,21 @@ def _flash_bwd(q, k, v, kbias, o, lse, do, causal, scale, block_q, block_k,
                              memory_space=pltpu.VMEM)
     row_spec_q = pl.BlockSpec((1, block_q), lambda b, i, j: (b, i),
                               memory_space=pltpu.VMEM)
+    smem = ([pl.BlockSpec(memory_space=pltpu.SMEM)]
+            if qoff is not None else [])
     dq = pl.pallas_call(
         functools.partial(_flash_dq_kernel, block_q=block_q, block_k=block_k,
                           nk=nk, causal=causal, scale=scale,
-                          window=int(window)),
+                          window=int(window), has_qoff=qoff is not None),
         grid=(BH, nq, nk),
-        in_specs=[q_spec_q, k_spec_q, k_spec_q, kb_spec_q, q_spec_q,
-                  row_spec_q, row_spec_q],
+        in_specs=smem + [q_spec_q, k_spec_q, k_spec_q, kb_spec_q, q_spec_q,
+                         row_spec_q, row_spec_q],
         out_specs=q_spec_q,
         out_shape=jax.ShapeDtypeStruct((BH, T, d), q.dtype,
                                        vma=_vma(q, k, v, do)),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_interpret(),
-    )(q, k, v, kbias, do, lse, delta)
+    )(*(qoff_arg + [q, k, v, kbias, do, lse, delta]))
 
     # dk/dv pass: grid iterates q blocks innermost for each k block
     q_spec_k = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0),
@@ -326,10 +373,10 @@ def _flash_bwd(q, k, v, kbias, o, lse, do, causal, scale, block_q, block_k,
     dk, dv, dkb = pl.pallas_call(
         functools.partial(_flash_dkv_kernel, block_q=block_q, block_k=block_k,
                           nq=nq, causal=causal, scale=scale,
-                          window=int(window)),
+                          window=int(window), has_qoff=qoff is not None),
         grid=(BH, nk, nq),
-        in_specs=[q_spec_k, k_spec_k, k_spec_k, kb_spec_k, q_spec_k,
-                  row_spec_k, row_spec_k],
+        in_specs=smem + [q_spec_k, k_spec_k, k_spec_k, kb_spec_k, q_spec_k,
+                         row_spec_k, row_spec_k],
         out_specs=[k_spec_k, k_spec_k, kb_spec_k],
         out_shape=[
             jax.ShapeDtypeStruct((BH, Tk, d), k.dtype, vma=_vma(q, k, v, do)),
@@ -342,7 +389,7 @@ def _flash_bwd(q, k, v, kbias, o, lse, do, causal, scale, block_q, block_k,
             pltpu.VMEM((1, block_k), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v, kbias, do, lse, delta)
+    )(*(qoff_arg + [q, k, v, kbias, do, lse, delta]))
     return dq, dk, dv, dkb
 
 
@@ -403,40 +450,43 @@ flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention_piece(q, k, v, causal=False, scale=None,
-                          block_q=128, block_k=128, window=0):
+                          block_q=128, block_k=128, window=0, qoff=None):
     """Unmerged attention piece for ring/Ulysses sequence parallelism:
     returns (o, lse) where o is softmax-normalized within this K/V chunk
     and lse is the per-row logsumexp.  Two pieces merge exactly via
     lse = logaddexp(lse1, lse2); o = o1*exp(lse1-lse) + o2*exp(lse2-lse)
     (see parallel/ring.py).  Differentiable in q/k/v including through the
     lse output (its cotangent folds into the backward's delta term).
-    window: sliding-window masking in LOCAL positions (a ring caller may
-    use it only where its global offsets cancel, i.e. the diagonal
-    chunk)."""
+    window/qoff: sliding-window masking and a traced GLOBAL q-position
+    offset (SMEM scalar), so ring callers mask diagonal AND off-diagonal
+    chunks exactly in global positions."""
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     kb = jnp.zeros(k.shape[:2], jnp.float32)
-    return _flash_fwd(q, k, v, kb, causal, scale, block_q, block_k, window)
+    return _flash_fwd(q, k, v, kb, causal, scale, block_q, block_k, window,
+                      qoff)
 
 
-def _piece_vjp_fwd(q, k, v, causal, scale, block_q, block_k, window=0):
+def _piece_vjp_fwd(q, k, v, causal, scale, block_q, block_k, window=0,
+                   qoff=None):
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     kb = jnp.zeros(k.shape[:2], jnp.float32)
-    o, lse = _flash_fwd(q, k, v, kb, causal, scale, block_q, block_k, window)
-    return (o, lse), (q, k, v, o, lse)
+    o, lse = _flash_fwd(q, k, v, kb, causal, scale, block_q, block_k, window,
+                        qoff)
+    return (o, lse), (q, k, v, o, lse, qoff)
 
 
 def _piece_vjp_bwd(causal, scale, block_q, block_k, window, res, cts):
-    q, k, v, o, lse = res
+    q, k, v, o, lse, qoff = res
     do, dlse = cts
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     kb = jnp.zeros(k.shape[:2], jnp.float32)
     dq, dk, dv, _ = _flash_bwd(
         q, k, v, kb, o, lse, do, causal, scale, block_q, block_k, dlse=dlse,
-        window=window)
-    return dq, dk, dv
+        window=window, qoff=qoff)
+    return dq, dk, dv, None
 
 
 flash_attention_piece.defvjp(_piece_vjp_fwd, _piece_vjp_bwd)

@@ -392,3 +392,83 @@ def test_fused_attention_layer_window():
                                np.asarray(ref), rtol=2e-4, atol=2e-5)
     with pytest.raises(ValueError, match="window requires causal"):
         layers.fused_attention(q, q, q, causal=False, window=4)
+
+
+def test_flash_attention_piece_qoff_matches_global_band():
+    """The traced q-position offset (SMEM scalar): a chunk pair with
+    global offset D behaves exactly like the corresponding rows of a
+    global causal/windowed attention — values and q/k/v grads.  (The
+    ring's off-diagonal chunks will ride this on-chip; under shard_map
+    interpret mode the varying-SMEM operand trips jax's vma typing, so
+    the ring currently uses the dense band off-diagonal on CPU.)"""
+    from paddle_tpu.ops.pallas_kernels import flash_attention_piece
+
+    rng = np.random.RandomState(13)
+    bh, t, d, W = 2, 16, 8, 12
+    # global sequence of 2 chunks: q is chunk 1, k/v are chunk 0
+    q = jnp.asarray(rng.randn(bh, t, d).astype("float32"))
+    k = jnp.asarray(rng.randn(bh, t, d).astype("float32"))
+    v = jnp.asarray(rng.randn(bh, t, d).astype("float32"))
+    scale = 1.0 / np.sqrt(d)
+    qoff = jnp.asarray([t], jnp.int32)  # q global base = t, k base = 0
+
+    def ref(q, k, v):
+        s = jnp.einsum("bqd,bkd->bqk", q, k).astype(jnp.float32) * scale
+        qp = t + np.arange(t)[:, None]
+        kp = np.arange(t)[None, :]
+        mask = (qp >= kp) & (qp - kp < W)
+        m = jnp.max(jnp.where(jnp.asarray(mask), s, -1e30), -1)
+        p = jnp.exp(jnp.where(jnp.asarray(mask), s, -1e30) - m[..., None])
+        l = jnp.sum(p, -1)
+        return (jnp.einsum("bqk,bkd->bqd", p, v) / l[..., None],
+                m + jnp.log(l))
+
+    o, lse = flash_attention_piece(q, k, v, True, scale, 8, 8, W, qoff)
+    o_ref, lse_ref = ref(q, k, v)
+    # rows with NO in-window key are undefined garbage by contract (the
+    # ring merge washes them out via lse ~ -1e30) — compare defined rows
+    qp = t + np.arange(t)
+    valid = (qp[:, None] >= np.arange(t)[None, :])         & (qp[:, None] - np.arange(t)[None, :] < W)
+    rows = valid.any(axis=1)
+    np.testing.assert_allclose(np.asarray(o)[:, rows],
+                               np.asarray(o_ref)[:, rows],
+                               rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(lse)[:, rows],
+                               np.asarray(lse_ref)[:, rows],
+                               rtol=2e-4, atol=2e-4)
+    # undefined rows still wash out of a merge: lse must be tiny
+    assert (np.asarray(lse)[:, ~rows] < -1e29).all()
+
+    mask_rows = jnp.asarray(rows)
+
+    gf = jax.grad(lambda q, k, v: jnp.sum(jnp.where(
+        mask_rows[None, :, None], flash_attention_piece(
+            q, k, v, True, scale, 8, 8, W, qoff)[0], 0.0) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(lambda q, k, v: jnp.sum(jnp.where(
+        mask_rows[None, :, None], ref(q, k, v)[0], 0.0) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
+
+
+def test_flash_qoff_undefined_rows_zero_grads():
+    """Rows with no visible key (possible under qoff+window) contribute
+    ZERO gradients even when the loss touches them — the backward guards
+    p by the row's lse sentinel instead of trusting callers to mask do."""
+    from paddle_tpu.ops.pallas_kernels import flash_attention_piece
+
+    rng = np.random.RandomState(14)
+    bh, t, d, W = 1, 16, 8, 12
+    q = jnp.asarray(rng.randn(bh, t, d).astype("float32"))
+    k = jnp.asarray(rng.randn(bh, t, d).astype("float32"))
+    v = jnp.asarray(rng.randn(bh, t, d).astype("float32"))
+    qoff = jnp.asarray([t], jnp.int32)
+    g = jax.grad(lambda q, k, v: jnp.sum(flash_attention_piece(
+        q, k, v, True, 1 / np.sqrt(d), 8, 8, W, qoff)[0]),
+        argnums=(0, 1, 2))(q, k, v)
+    for a in g:
+        assert np.isfinite(np.asarray(a)).all()
+    # q global rows 27..31 see no key within the window -> zero dq
+    assert np.abs(np.asarray(g[0])[0, 11:]).max() == 0.0
