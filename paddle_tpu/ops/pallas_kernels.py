@@ -220,10 +220,7 @@ def _flash_dq_kernel(*refs, block_q, block_k, nk, causal, scale,
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    run = (ki * block_k < (qi + 1) * block_q + qo) if causal else (ki >= 0)
-    if window:
-        run = run & (ki * block_k + block_k - 1
-                     >= qi * block_q + qo - window + 1)
+    run, keep_fn = _band(qi, ki, qo, block_q, block_k, causal, window)
 
     @pl.when(run)
     def _compute():
@@ -235,16 +232,12 @@ def _flash_dq_kernel(*refs, block_q, block_k, nk, causal, scale,
         delta = delta_ref[0].reshape(-1, 1)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         s = s + kb_ref[0].astype(jnp.float32)
-        if causal:
-            q_pos = qo + qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 0)
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 1)
-            keep = q_pos >= k_pos
-            if window:  # sliding window: only the last `window` positions
-                keep = keep & (q_pos - k_pos < window)
-            s = jnp.where(keep, s, NEG_INF)
-        p = jnp.exp(s - lse)  # [bq, bk]
+        s = keep_fn(s)
+        # rows with NO visible key (possible under qoff+window) carry the
+        # lse sentinel: their forward output is defined-garbage by
+        # contract, so their grads are 0 — without this guard
+        # exp(s - lse) would be 1 on every masked entry of such rows
+        p = jnp.where(lse <= NEG_INF / 2, 0.0, jnp.exp(s - lse))
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
         dq_acc[:] = dq_acc[:] + scale * jnp.dot(
@@ -276,10 +269,9 @@ def _flash_dkv_kernel(*refs, block_q, block_k, nq, causal, scale,
         dv_acc[:] = jnp.zeros_like(dv_acc)
         dkb_acc[:] = jnp.zeros_like(dkb_acc)
 
-    run = (ki * block_k < (qi + 1) * block_q + qo) if causal else (qi >= 0)
-    if window:
-        run = run & (ki * block_k + block_k - 1
-                     >= qi * block_q + qo - window + 1)
+    run, keep_fn = _band(qi, ki, qo, block_q, block_k, causal, window)
+    if not causal:
+        run = qi >= 0  # this grid iterates q innermost
 
     @pl.when(run)
     def _compute():
@@ -291,16 +283,9 @@ def _flash_dkv_kernel(*refs, block_q, block_k, nq, causal, scale,
         delta = delta_ref[0].reshape(-1, 1)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         s = s + kb_ref[0].astype(jnp.float32)
-        if causal:
-            q_pos = qo + qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 0)
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 1)
-            keep = q_pos >= k_pos
-            if window:  # sliding window: only the last `window` positions
-                keep = keep & (q_pos - k_pos < window)
-            s = jnp.where(keep, s, NEG_INF)
-        p = jnp.exp(s - lse)  # [bq, bk]
+        s = keep_fn(s)
+        # undefined-row grad guard (see _flash_dq_kernel)
+        p = jnp.where(lse <= NEG_INF / 2, 0.0, jnp.exp(s - lse))
         dv_acc[:] = dv_acc[:] + jnp.dot(
             p.T, do, preferred_element_type=jnp.float32)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
