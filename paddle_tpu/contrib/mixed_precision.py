@@ -23,6 +23,49 @@ _BF16_OPS = ("mul", "matmul", "conv2d", "depthwise_conv2d", "fused_attention")
 # costs nothing and avoids surprises with user-supplied biases)
 _KEEP_F32_SLOTS = {"fused_attention": ("Bias",)}
 
+# dtype-transparent trunk ops: (data input slots, flippable output slots).
+# When every data input of one of these is available in half precision,
+# the op itself runs in half — its lowering preserves the input dtype
+# (batch_norm computes statistics in f32 internally, nn_ops.py) — so the
+# conv->bn->relu->residual-add->pool trunk of a convnet stays bf16 in HBM
+# instead of bouncing through f32 between every pair of matmul-class ops.
+# Parameter/state slots (Scale/Bias/Mean/Variance) and state outputs
+# (MeanOut/Saved*) keep f32.
+_TRANSPARENT_OPS = {
+    "relu": (("X",), ("Out",)),
+    "pool2d": (("X",), ("Out",)),
+    "batch_norm": (("X",), ("Y",)),
+    "elementwise_add": (("X", "Y"), ("Out",)),
+}
+
+
+def _tag_for(dtype):
+    return "BF16" if dtype == "bfloat16" else "FP16"
+
+
+def _emit_raw_and_castback(block, name, dtype, tag):
+    """Create the half var `<name>@RAW_<tag>` plus the half->f32 cast op
+    restoring `name`; returns (raw_name, cast_back_op).  The caller wires
+    the producing op to write the raw var and appends the cast-back after
+    it — the shared emission step of both AMP passes."""
+    raw = name + "@RAW_" + tag
+    v = block._find_var_recursive(name)
+    block.create_var(
+        name=raw,
+        shape=list(v.shape) if v is not None and v.shape else None,
+        dtype=dtype,
+    )
+    cast_back = framework.Operator(
+        block,
+        "cast",
+        None,
+        None,
+        {"in_dtype": dtype, "out_dtype": "float32"},
+    )
+    cast_back.inputs = {"X": [raw]}
+    cast_back.outputs = {"Out": [name]}
+    return raw, cast_back
+
 
 def rewrite_bf16(program=None, ops=_BF16_OPS, dtype="bfloat16"):
     """Insert half-precision casts around matmul-class ops (in place).
@@ -31,7 +74,7 @@ def rewrite_bf16(program=None, ops=_BF16_OPS, dtype="bfloat16"):
     "bfloat16" is the TPU-native training regime; "float16" mirrors the
     reference's fp16 inference transpiler (paddle/contrib/float16)."""
     program = program or framework.default_main_program()
-    tag = "BF16" if dtype == "bfloat16" else "FP16"
+    tag = _tag_for(dtype)
     block = program.global_block()
     new_ops = []
     count = 0
@@ -80,22 +123,8 @@ def rewrite_bf16(program=None, ops=_BF16_OPS, dtype="bfloat16"):
             for slot, names in list(op.outputs.items()):
                 restored = []
                 for n in names:
-                    raw = n + "@RAW_" + tag
-                    v = block._find_var_recursive(n)
-                    block.create_var(
-                        name=raw,
-                        shape=list(v.shape) if v is not None and v.shape else None,
-                        dtype=dtype,
-                    )
-                    cast_back = framework.Operator(
-                        block,
-                        "cast",
-                        None,
-                        None,
-                        {"in_dtype": dtype, "out_dtype": "float32"},
-                    )
-                    cast_back.inputs = {"X": [raw]}
-                    cast_back.outputs = {"Out": [n]}
+                    raw, cast_back = _emit_raw_and_castback(
+                        block, n, dtype, tag)
                     restored.append((slot, raw, cast_back))
                 op.outputs[slot] = [r[1] for r in restored]
                 for _, _, cb in restored:
@@ -110,9 +139,75 @@ def rewrite_bf16(program=None, ops=_BF16_OPS, dtype="bfloat16"):
                 for n in names:
                     cast_cache.pop((n, dtype), None)
     block.ops = new_ops
+    propagate_half_through_trunk(program, dtype)
     collapse_redundant_casts(program, dtype)
     program._bump_version()
     return count
+
+
+def propagate_half_through_trunk(program, dtype="bfloat16"):
+    """Flip dtype-transparent trunk ops (_TRANSPARENT_OPS) to half.
+
+    An op whose every data input is the f32 cast-back of a half tensor is
+    rewired to read the half tensor directly; its data output becomes a
+    NEW half var, and a cast-back op re-defines the original f32 name so
+    every other consumer (fetches, non-transparent ops, sub-blocks) is
+    untouched.  Unused cast-backs are dropped by trace-time DCE, and the
+    downstream f32->half re-casts collapse in collapse_redundant_casts —
+    net effect: the conv/BN/relu/add/pool trunk runs half end-to-end.
+    Returns the number of flipped ops."""
+    tag = _tag_for(dtype)
+    block = program.global_block()
+    castback_src = {}  # f32 name -> half name, current definitions only
+    new_ops = []
+    flipped = 0
+    for op in block.ops:
+        spec = _TRANSPARENT_OPS.get(op.type)
+        halves = None
+        if spec is not None:
+            in_slots, out_slots = spec
+            names = [n for s in in_slots for n in op.inputs.get(s, [])]
+            if names and all(n in castback_src for n in names):
+                if op.type == "elementwise_add":
+                    # same-shape operands only: axis-broadcast adds (bias
+                    # adds) keep their f32 contract
+                    vs = [block._find_var_recursive(n) for n in names]
+                    if any(
+                        v is None or v.shape is None for v in vs
+                    ) or len({tuple(v.shape) for v in vs}) != 1:
+                        names = None
+                if names:
+                    halves = {n: castback_src[n] for n in names}
+        if halves is not None:
+            for s in in_slots:
+                if s in op.inputs:
+                    op.inputs[s] = [halves.get(n, n) for n in op.inputs[s]]
+            new_ops.append(op)
+            flipped += 1
+            for s in out_slots:
+                for i, n in enumerate(list(op.outputs.get(s, []))):
+                    raw, cb = _emit_raw_and_castback(block, n, dtype, tag)
+                    op.outputs[s][i] = raw
+                    new_ops.append(cb)
+                    castback_src[n] = raw
+            # non-flipped outputs (MeanOut/Saved*) redefine their names
+            for s, ns in op.outputs.items():
+                if s not in out_slots:
+                    for n in ns:
+                        castback_src.pop(n, None)
+            continue
+        is_castback = (op.type == "cast"
+                       and op.attrs.get("out_dtype") == "float32"
+                       and op.attrs.get("in_dtype") == dtype)
+        for n in op.output_arg_names():
+            castback_src.pop(n, None)
+        if is_castback:
+            castback_src[op.outputs["Out"][0]] = op.inputs["X"][0]
+        new_ops.append(op)
+    if flipped:
+        block.ops = new_ops
+        program._bump_version()
+    return flipped
 
 
 def collapse_redundant_casts(program, dtype="bfloat16"):

@@ -205,13 +205,18 @@ def _batch_norm(ctx, ins, attrs):
     bshape = [1] * x.ndim
     bshape[ch_axis] = x.shape[ch_axis]
 
+    # statistics and normalization run in f32 even for bf16 activations
+    # (the AMP trunk keeps x bf16 in HBM; the f32 upcast fuses into the
+    # same loop, so the reduce accumulates at full precision for free) —
+    # Y comes back in x's dtype, running stats/Saved* stay f32
+    xs = x if x.dtype == jnp.float32 else x.astype(jnp.float32)
     if is_test:
         use_mean, use_var = mean, var
         saved_mean, saved_var = mean, var
         mean_out, var_out = mean, var
     else:
-        use_mean = jnp.mean(x, axis=red_axes)
-        use_var = jnp.var(x, axis=red_axes)
+        use_mean = jnp.mean(xs, axis=red_axes)
+        use_var = jnp.var(xs, axis=red_axes)
         saved_mean, saved_var = use_mean, use_var
         mean_out = momentum * mean + (1 - momentum) * use_mean
         var_out = momentum * var + (1 - momentum) * use_var
@@ -220,9 +225,10 @@ def _batch_norm(ctx, ins, attrs):
         var_out = jax.lax.stop_gradient(var_out)
 
     inv = jax.lax.rsqrt(use_var + eps)
-    y = (x - use_mean.reshape(bshape)) * inv.reshape(bshape) * scale.reshape(
+    y = (xs - use_mean.reshape(bshape)) * inv.reshape(bshape) * scale.reshape(
         bshape
     ) + bias.reshape(bshape)
+    y = y.astype(x.dtype)
     return {
         "Y": [y],
         "MeanOut": [mean_out],

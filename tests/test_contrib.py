@@ -226,3 +226,70 @@ def test_amp_collapses_redundant_cast_roundtrips():
     # program must match the f32 reference at bf16 tolerance
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-2, atol=2e-2)
+
+
+def test_amp_trunk_keeps_bf16_through_bn_relu_pool():
+    """propagate_half_through_trunk: dtype-transparent ops (batch_norm /
+    relu / pool2d / same-shape elementwise_add) run in bf16 when fed from
+    half cast-backs, BN statistics stay f32, and training parity with the
+    f32 program holds at bf16 tolerance."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.contrib.mixed_precision import rewrite_bf16
+
+    def run(amp):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.framework.program_guard(main, startup):
+            startup.random_seed = 11
+            img = layers.data("img", shape=[3, 16, 16])
+            label = layers.data("label", shape=[1], dtype="int64")
+            c1 = layers.conv2d(img, 8, 3, padding=1, act=None,
+                               bias_attr=False)
+            b1 = layers.batch_norm(c1, act="relu")
+            c2 = layers.conv2d(b1, 8, 3, padding=1, act=None,
+                               bias_attr=False)
+            b2 = layers.batch_norm(c2, act=None)
+            res = layers.elementwise_add(b1, b2, act="relu")
+            p = layers.pool2d(res, pool_size=2, pool_type="avg",
+                              global_pooling=True)
+            pred = layers.fc(p, 10, act="softmax")
+            loss = layers.mean(layers.cross_entropy(pred, label))
+            if amp:
+                rewrite_bf16(main)
+                blk = main.global_block()
+                for t, slot in (("batch_norm", "X"), ("relu", "X"),
+                                ("pool2d", "X"), ("elementwise_add", "X")):
+                    flips = [op for op in blk.ops if op.type == t
+                             and "@RAW_BF16" in op.inputs[slot][0]]
+                    assert flips, "no %s flipped to bf16" % t
+                # BN running-stat outputs stay on their f32 names
+                bn = [op for op in blk.ops if op.type == "batch_norm"][0]
+                assert not bn.outputs["MeanOut"][0].endswith("@RAW_BF16")
+            fluid.optimizer.Momentum(0.05, momentum=0.9).minimize(loss)
+        rng = np.random.RandomState(3)
+        x = rng.rand(16, 3, 16, 16).astype("float32")
+        y = rng.randint(0, 10, (16, 1)).astype("int64")
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            losses = [
+                float(np.ravel(exe.run(
+                    main, feed={"img": x, "label": y},
+                    fetch_list=[loss])[0])[0])
+                for _ in range(6)
+            ]
+            # moving mean updated, in f32, through the flipped BN
+            # (resolve the name from the op: unique suffixes differ
+            # between the two runs sharing this process)
+            bn0 = [op for op in main.global_block().ops
+                   if op.type == "batch_norm"][0]
+            mm = np.asarray(scope.find_var(bn0.inputs["Mean"][0]))
+        assert mm.dtype == np.float32 and np.any(mm != 0)
+        return losses
+
+    f32 = run(False)
+    amp = run(True)
+    assert amp[-1] < amp[0]
+    np.testing.assert_allclose(amp, f32, rtol=0.2, atol=0.05)
