@@ -1,0 +1,306 @@
+"""Binary ProgramDesc codec: Program <-> desc.proto protobuf bytes.
+
+The compact cross-language `__model__` form (framework.proto:184 /
+program_desc.h role).  JSON (`Program.to_json`) stays the human-readable
+default; this module provides the lossless binary alternative plus ctypes
+access to the native C++ codec (`native/desc_codec.cc`) for validation
+and JSON<->binary transcode outside the Python runtime.
+
+Save/load integration: `io.save_inference_model(..., model_format="pb")`
+writes `__model__` as validated binary protobuf; `io.load_inference_model`
+sniffs the format, so callers never name it.
+"""
+
+import ctypes
+
+import numpy as np
+
+from . import framework
+from .framework import Block, Operator, Parameter, Program
+
+__all__ = [
+    "program_to_bytes",
+    "program_from_bytes",
+    "model_from_bytes",
+    "looks_like_pb",
+    "native_validate",
+    "native_summary",
+    "native_to_json",
+    "native_max_version",
+]
+
+
+def _pb2():
+    from .native import desc_pb2
+
+    return desc_pb2
+
+
+# ---------------------------------------------------------------------------
+# attr value encoding (AttrValue oneof)
+# ---------------------------------------------------------------------------
+def _attr_to_pb(value, msg):
+    if value is None:
+        msg.none = True
+    elif isinstance(value, np.ndarray):
+        # raw little-endian C-order bytes; '>'-endian arrays are byteswapped
+        arr = np.ascontiguousarray(value)
+        if arr.dtype.byteorder == ">":
+            arr = arr.astype(arr.dtype.newbyteorder("<"))
+        msg.nd.dtype = arr.dtype.name
+        msg.nd.shape.extend(int(d) for d in arr.shape)
+        msg.nd.data = arr.tobytes()
+    elif isinstance(value, (bool, np.bool_)):  # before int: bool < int
+        msg.b = bool(value)
+    elif isinstance(value, (int, np.integer)):
+        msg.i = int(value)
+    elif isinstance(value, (float, np.floating)):
+        msg.f = float(value)
+    elif isinstance(value, str):
+        msg.s = value
+    elif isinstance(value, (list, tuple)):
+        msg.list.SetInParent()  # empty list must still select the oneof
+        for item in value:
+            _attr_to_pb(item, msg.list.v.add())
+    elif isinstance(value, dict):
+        msg.dict.SetInParent()
+        for k, v in value.items():
+            _attr_to_pb(v, msg.dict.v[str(k)])
+    else:
+        raise TypeError(
+            "attr value of type %s cannot be serialized to the binary "
+            "__model__ format" % type(value).__name__
+        )
+
+
+def _attr_from_pb(msg):
+    kind = msg.WhichOneof("value")
+    if kind is None or kind == "none":
+        return None
+    if kind == "i":
+        return int(msg.i)
+    if kind == "f":
+        return float(msg.f)
+    if kind == "s":
+        return msg.s
+    if kind == "b":
+        return bool(msg.b)
+    if kind == "nd":
+        arr = np.frombuffer(msg.nd.data, dtype=np.dtype(msg.nd.dtype))
+        return arr.reshape(tuple(msg.nd.shape)).copy()
+    if kind == "list":
+        return [_attr_from_pb(v) for v in msg.list.v]
+    if kind == "dict":
+        return {k: _attr_from_pb(v) for k, v in msg.dict.v.items()}
+    raise ValueError("unknown attr kind %r" % kind)
+
+
+# ---------------------------------------------------------------------------
+# program encoding
+# ---------------------------------------------------------------------------
+def program_to_bytes(program, feed_names=(), fetch_names=(), format_version=None):
+    """Serialize a Program (+ optional feed/fetch metadata) to binary
+    ProgramDesc bytes."""
+    from . import io as io_mod
+
+    pb2 = _pb2()
+    prog = pb2.ProgramDesc()
+    prog.format_version = (
+        io_mod.PROGRAM_FORMAT_VERSION if format_version is None else int(format_version)
+    )
+    prog.random_seed = int(program.random_seed)
+    prog.feed_names.extend(feed_names)
+    prog.fetch_names.extend(fetch_names)
+    for block in program.blocks:
+        b = prog.blocks.add()
+        b.idx = block.idx
+        b.parent_idx = block.parent_idx
+        for var in block.vars.values():
+            v = b.vars.add()
+            v.name = var.name
+            if var.shape is not None:
+                v.has_shape = True
+                v.shape.extend(-1 if d is None else int(d) for d in var.shape)
+            v.dtype = var.dtype or ""
+            v.lod_level = int(var.lod_level or 0)
+            v.persistable = bool(var.persistable)
+            v.stop_gradient = bool(var.stop_gradient)
+            v.var_type = str(var.type)
+            v.is_data = bool(var.is_data)
+            if isinstance(var, Parameter):
+                v.is_parameter = True
+                v.trainable = bool(var.trainable)
+                v.optimize_attr.SetInParent()
+                for k, val in (var.optimize_attr or {}).items():
+                    _attr_to_pb(val, v.optimize_attr.v[str(k)])
+        for op in block.ops:
+            o = b.ops.add()
+            o.type = op.type
+            for slot, names in op.inputs.items():
+                o.inputs[slot].v.extend(names)
+            for slot, names in op.outputs.items():
+                o.outputs[slot].v.extend(names)
+            for k, val in op.attrs.items():
+                _attr_to_pb(val, o.attrs[k])
+    return prog.SerializeToString()
+
+
+def model_from_bytes(data):
+    """Parse binary `__model__` bytes: (Program, feed_names, fetch_names)."""
+    program, msg = _parse_bytes(data)
+    return program, list(msg.feed_names), list(msg.fetch_names)
+
+
+def program_from_bytes(data):
+    """Parse binary ProgramDesc bytes into a Program."""
+    return _parse_bytes(data)[0]
+
+
+def _parse_bytes(data):
+    """Shared parse path.
+
+    Raises RuntimeError on a newer-than-supported format_version (the
+    version.h compat gate, same contract as the JSON loader)."""
+    from . import io as io_mod
+
+    pb2 = _pb2()
+    msg = pb2.ProgramDesc()
+    try:
+        msg.ParseFromString(bytes(data))
+    except Exception as e:
+        raise ValueError("not a valid binary ProgramDesc: %s" % (e,))
+    if not msg.blocks:
+        # an empty/truncated file parses as an empty message — fail HERE
+        # with a load-time error, not later with a bare IndexError
+        raise ValueError(
+            "not a valid binary ProgramDesc: no blocks (empty or truncated "
+            "__model__ file)"
+        )
+    if not io_mod.is_program_version_supported(msg.format_version):
+        raise RuntimeError(
+            "saved model format version %s is newer than this build "
+            "supports (max %s) — upgrade paddle_tpu to load it"
+            % (msg.format_version, io_mod.PROGRAM_FORMAT_VERSION)
+        )
+    program = Program()
+    program._seed = int(msg.random_seed)
+    program.blocks = []
+    for bd in msg.blocks:
+        blk = Block(program, bd.idx, bd.parent_idx)
+        program.blocks.append(blk)
+        for vd in bd.vars:
+            shape = (
+                tuple(int(d) for d in vd.shape) if vd.has_shape else None
+            )
+            common = dict(
+                shape=shape,
+                dtype=vd.dtype or None,
+                lod_level=int(vd.lod_level),
+                persistable=vd.persistable,
+                stop_gradient=vd.stop_gradient,
+                type=vd.var_type,
+                is_data=vd.is_data,
+            )
+            if vd.is_parameter:
+                p = Parameter(blk, name=vd.name, **common)
+                p.trainable = vd.trainable
+                p.optimize_attr = {
+                    k: _attr_from_pb(v) for k, v in vd.optimize_attr.v.items()
+                }
+                blk.vars[vd.name] = p
+            else:
+                blk.create_var(name=vd.name, **common)
+        for od in bd.ops:
+            op = Operator(blk, od.type, None, None,
+                          {k: _attr_from_pb(v) for k, v in od.attrs.items()})
+            op.inputs = {slot: list(nl.v) for slot, nl in od.inputs.items()}
+            op.outputs = {slot: list(nl.v) for slot, nl in od.outputs.items()}
+            blk.ops.append(op)
+    program.current_block_idx = 0
+    return program, msg
+
+
+def looks_like_pb(data):
+    """Format sniff for `__model__`: the JSON form starts with '{'
+    (optionally after whitespace); anything else is the binary form."""
+    head = bytes(data[:16]).lstrip()
+    return not head.startswith(b"{")
+
+
+# ---------------------------------------------------------------------------
+# native codec access (desc_codec.cc via ctypes)
+# ---------------------------------------------------------------------------
+def _native_lib():
+    from . import native
+
+    lib = native.get_lib()
+    if lib is None or not hasattr(lib, "pt_desc_validate"):
+        return None
+    if getattr(lib, "_desc_sigs", False) is False:
+        lib.pt_desc_max_version.restype = ctypes.c_uint
+        lib.pt_desc_validate.restype = ctypes.c_int
+        lib.pt_desc_validate.argtypes = [
+            ctypes.c_char_p, ctypes.c_long, ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.pt_desc_summary.restype = ctypes.c_int
+        lib.pt_desc_summary.argtypes = [
+            ctypes.c_char_p, ctypes.c_long, ctypes.POINTER(ctypes.c_long),
+        ]
+        lib.pt_desc_to_json.restype = ctypes.c_int
+        lib.pt_desc_to_json.argtypes = [
+            ctypes.c_char_p, ctypes.c_long,
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.pt_desc_free.argtypes = [ctypes.c_char_p]
+        lib._desc_sigs = True
+    return lib
+
+
+def native_max_version():
+    """kMaxVersion of the C++ codec, or None without the native lib."""
+    lib = _native_lib()
+    return None if lib is None else int(lib.pt_desc_max_version())
+
+
+def native_validate(data):
+    """(ok, error_message) from the C++ validator; (None, reason) when the
+    native library is unavailable."""
+    lib = _native_lib()
+    if lib is None:
+        return None, "native library unavailable"
+    err = ctypes.create_string_buffer(512)
+    rc = lib.pt_desc_validate(bytes(data), len(data), err, len(err))
+    return rc == 0, err.value.decode("utf-8", "replace")
+
+
+def native_summary(data):
+    """{'blocks': n, 'vars': n, 'ops': n, 'version': n} via C++, or None."""
+    lib = _native_lib()
+    if lib is None:
+        return None
+    out = (ctypes.c_long * 4)()
+    if lib.pt_desc_summary(bytes(data), len(data), out) != 0:
+        return None
+    return {
+        "blocks": int(out[0]),
+        "vars": int(out[1]),
+        "ops": int(out[2]),
+        "version": int(out[3]),
+    }
+
+
+def native_to_json(data):
+    """Binary -> protobuf-JSON transcode via C++ (tool-facing; the
+    runtime loader uses program_from_bytes).  None when unavailable."""
+    lib = _native_lib()
+    if lib is None:
+        return None
+    out = ctypes.c_char_p()
+    err = ctypes.create_string_buffer(512)
+    rc = lib.pt_desc_to_json(bytes(data), len(data), ctypes.byref(out), err, len(err))
+    if rc != 0:
+        raise ValueError(err.value.decode("utf-8", "replace"))
+    try:
+        return out.value.decode("utf-8")
+    finally:
+        lib.pt_desc_free(out)

@@ -164,37 +164,66 @@ def save_inference_model(
     params_filename=None,
     export_for_deployment=True,
     scope=None,
+    model_format="json",
 ):
-    """Prune to the inference slice + save program & params (io.py:544)."""
+    """Prune to the inference slice + save program & params (io.py:544).
+
+    `model_format`: "json" (human-readable, default) or "pb" — the binary
+    protobuf ProgramDesc (native/desc.proto), validated by the C++ codec
+    when available.  The loader sniffs the format, so consumers are
+    format-agnostic."""
     if main_program is None:
         main_program = framework.default_main_program()
     os.makedirs(dirname, exist_ok=True)
     pruned = main_program.clone(for_test=True)._prune(target_vars)
-    meta = {
-        "version": PROGRAM_FORMAT_VERSION,
-        "program": pruned.to_json(),
-        "feed_names": list(feeded_var_names),
-        "fetch_names": [
-            t.name if isinstance(t, framework.Variable) else t for t in target_vars
-        ],
-    }
-    with open(os.path.join(dirname, model_filename or "__model__"), "w") as f:
-        json.dump(meta, f)
+    feed_names = list(feeded_var_names)
+    fetch_names = [
+        t.name if isinstance(t, framework.Variable) else t for t in target_vars
+    ]
+    path = os.path.join(dirname, model_filename or "__model__")
+    if model_format == "pb":
+        from . import desc_codec
+
+        data = desc_codec.program_to_bytes(pruned, feed_names, fetch_names)
+        ok, msg = desc_codec.native_validate(data)
+        if ok is False:  # None = native codec unavailable, skip the check
+            raise RuntimeError("binary __model__ failed validation: " + msg)
+        with open(path, "wb") as f:
+            f.write(data)
+    elif model_format == "json":
+        meta = {
+            "version": PROGRAM_FORMAT_VERSION,
+            "program": pruned.to_json(),
+            "feed_names": feed_names,
+            "fetch_names": fetch_names,
+        }
+        with open(path, "w") as f:
+            json.dump(meta, f)
+    else:
+        raise ValueError("model_format must be 'json' or 'pb', got %r" % model_format)
     save_persistables(executor, dirname, pruned, filename=params_filename, scope=scope)
-    return meta["fetch_names"]
+    return fetch_names
 
 
 def load_inference_model(dirname, executor, model_filename=None, params_filename=None, scope=None):
-    with open(os.path.join(dirname, model_filename or "__model__")) as f:
-        meta = json.load(f)
-    version = meta.get("version", 0)  # pre-versioning models load as v0
-    if not is_program_version_supported(version):
-        raise RuntimeError(
-            "saved model format version %s is newer than this build "
-            "supports (<= %d) — upgrade paddle_tpu to load it"
-            % (version, PROGRAM_FORMAT_VERSION)
-        )
-    program = Program.from_json(meta["program"])
+    from . import desc_codec
+
+    path = os.path.join(dirname, model_filename or "__model__")
+    with open(path, "rb") as f:
+        raw = f.read()
+    if desc_codec.looks_like_pb(raw):
+        program, feed_names, fetch_names = desc_codec.model_from_bytes(raw)
+    else:
+        meta = json.loads(raw.decode("utf-8"))
+        version = meta.get("version", 0)  # pre-versioning models load as v0
+        if not is_program_version_supported(version):
+            raise RuntimeError(
+                "saved model format version %s is newer than this build "
+                "supports (<= %d) — upgrade paddle_tpu to load it"
+                % (version, PROGRAM_FORMAT_VERSION)
+            )
+        program = Program.from_json(meta["program"])
+        feed_names, fetch_names = meta["feed_names"], meta["fetch_names"]
     load_persistables(executor, dirname, program, filename=params_filename, scope=scope)
-    fetch_vars = [program.global_block().var(n) for n in meta["fetch_names"]]
-    return program, meta["feed_names"], fetch_vars
+    fetch_vars = [program.global_block().var(n) for n in fetch_names]
+    return program, feed_names, fetch_vars

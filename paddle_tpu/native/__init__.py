@@ -24,25 +24,26 @@ _build_error = None  # diagnostics when the toolchain/compile fails
 
 def _try_build():
     global _build_failed, _build_error
-    try:
-        # `make -s` is a fast no-op when the .so is newer than the sources,
-        # and rebuilds after source edits (stale-library trap avoided)
-        subprocess.run(
-            ["make", "-s"],
-            cwd=_DIR,
-            check=True,
-            capture_output=True,
-            timeout=120,
-        )
-        return True
-    except subprocess.CalledProcessError as e:
-        _build_failed = True
-        _build_error = (e.stderr or e.stdout or b"").decode(errors="replace")
-        return False
-    except Exception as e:
-        _build_failed = True
-        _build_error = repr(e)
-        return False
+    # `make -s` is a fast no-op when the .so is newer than the sources,
+    # and rebuilds after source edits (stale-library trap avoided).
+    # Hosts without libprotobuf/protoc fall back to the `nodesc` target:
+    # every native piece except the desc codec.
+    for target in ([], ["nodesc"]):
+        try:
+            subprocess.run(
+                ["make", "-s"] + target,
+                cwd=_DIR,
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+            return True
+        except subprocess.CalledProcessError as e:
+            _build_error = (e.stderr or e.stdout or b"").decode(errors="replace")
+        except Exception as e:
+            _build_error = repr(e)
+    _build_failed = True
+    return False
 
 
 def build_error():

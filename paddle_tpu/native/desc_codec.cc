@@ -1,0 +1,248 @@
+// Native ProgramDesc codec (the C++ desc-core slot of SURVEY §2.1:
+// program_desc.h/version.h/prune.cc roles, re-designed for the binary
+// `__model__` format defined in desc.proto).
+//
+// What lives here (and NOT in Python): parsing + semantic validation of
+// serialized programs (version gate, block tree integrity, name
+// resolution of every op input/output through the block-parent chain,
+// sub-block attr range checks) and lossless JSON <-> binary transcode so
+// any tool can inspect a saved model without the Python runtime.
+//
+// C ABI (ctypes-consumed, see native/__init__.py):
+//   pt_desc_max_version()                         -> newest readable version
+//   pt_desc_validate(buf, len, err, errcap)       -> 0 ok / 1 error
+//   pt_desc_summary(buf, len, long out[4])        -> 0 ok; out = {blocks,
+//                                                    vars, ops, version}
+//   pt_desc_to_json(buf, len, &out, err, errcap)  -> 0 ok; free w/ pt_desc_free
+//   pt_desc_from_json(json, &out, &len, err, errcap)
+//   pt_desc_free(ptr)
+
+#include <cstdio>
+#include <cstring>
+#include <set>
+#include <string>
+#include <vector>
+
+#include <google/protobuf/util/json_util.h>
+
+#include "desc.pb.h"
+
+namespace {
+
+using paddle_tpu::desc::AttrValue;
+using paddle_tpu::desc::BlockDesc;
+using paddle_tpu::desc::OpDesc;
+using paddle_tpu::desc::ProgramDesc;
+
+// Newest __model__ format this build reads; mirrors
+// io.PROGRAM_FORMAT_VERSION (a unit test asserts the two stay equal).
+constexpr unsigned kMaxVersion = 1;
+
+void put_err(char* err, int errcap, const std::string& msg) {
+  if (err != nullptr && errcap > 0) {
+    std::snprintf(err, errcap, "%s", msg.c_str());
+  }
+}
+
+bool parse(const char* buf, long len, ProgramDesc* prog, char* err,
+           int errcap) {
+  if (buf == nullptr || len <= 0) {
+    put_err(err, errcap, "empty buffer");
+    return false;
+  }
+  if (!prog->ParseFromArray(buf, static_cast<int>(len))) {
+    put_err(err, errcap, "not a valid ProgramDesc protobuf");
+    return false;
+  }
+  return true;
+}
+
+// Resolve `name` in block `bidx`'s var table or any ancestor's
+// (Scope-chain semantics: sub-block ops may use enclosing-block vars).
+bool resolves(const ProgramDesc& prog,
+              const std::vector<std::set<std::string>>& tables, int bidx,
+              const std::string& name) {
+  int guard = 0;
+  while (bidx >= 0 && bidx < prog.blocks_size() && guard++ < 1024) {
+    if (tables[bidx].count(name)) return true;
+    bidx = prog.blocks(bidx).parent_idx();
+  }
+  return false;
+}
+
+// attr names whose integer payload references a sub-block index:
+// "sub_block"/"block_idx" or a "*_block" suffix (true suffix match only —
+// names like "num_blocks" must not be treated as references)
+bool is_block_ref_attr(const std::string& key) {
+  if (key == "sub_block" || key == "block_idx") return true;
+  constexpr const char kSuffix[] = "_block";
+  constexpr size_t kLen = sizeof(kSuffix) - 1;
+  return key.size() >= kLen &&
+         key.compare(key.size() - kLen, kLen, kSuffix) == 0;
+}
+
+bool validate(const ProgramDesc& prog, char* err, int errcap) {
+  if (prog.format_version() > kMaxVersion) {
+    put_err(err, errcap,
+            "format_version " + std::to_string(prog.format_version()) +
+                " is newer than this build reads (max " +
+                std::to_string(kMaxVersion) + ")");
+    return false;
+  }
+  if (prog.blocks_size() == 0) {
+    put_err(err, errcap, "program has no blocks");
+    return false;
+  }
+  const int nb = prog.blocks_size();
+  std::vector<std::set<std::string>> tables(nb);
+  for (int i = 0; i < nb; ++i) {
+    const BlockDesc& b = prog.blocks(i);
+    if (b.idx() != i) {
+      put_err(err, errcap,
+              "block " + std::to_string(i) + " carries idx " +
+                  std::to_string(b.idx()) + " (blocks must be stored in "
+                  "index order)");
+      return false;
+    }
+    if (i == 0 && b.parent_idx() != -1) {
+      put_err(err, errcap, "global block must have parent_idx -1");
+      return false;
+    }
+    if (i > 0 && (b.parent_idx() < 0 || b.parent_idx() >= i)) {
+      put_err(err, errcap,
+              "block " + std::to_string(i) + " parent_idx " +
+                  std::to_string(b.parent_idx()) +
+                  " must name an earlier block");
+      return false;
+    }
+    for (const auto& v : b.vars()) {
+      if (v.name().empty()) {
+        put_err(err, errcap,
+                "block " + std::to_string(i) + " has an unnamed var");
+        return false;
+      }
+      tables[i].insert(v.name());
+    }
+  }
+  for (int i = 0; i < nb; ++i) {
+    const BlockDesc& b = prog.blocks(i);
+    for (int oi = 0; oi < b.ops_size(); ++oi) {
+      const OpDesc& op = b.ops(oi);
+      if (op.type().empty()) {
+        put_err(err, errcap, "block " + std::to_string(i) + " op #" +
+                                 std::to_string(oi) + " has empty type");
+        return false;
+      }
+      for (const auto& dir : {op.inputs(), op.outputs()}) {
+        for (const auto& slot : dir) {
+          for (const auto& name : slot.second.v()) {
+            if (name.empty()) continue;  // optional slot placeholder
+            if (!resolves(prog, tables, i, name)) {
+              put_err(err, errcap,
+                      "op '" + op.type() + "' (block " + std::to_string(i) +
+                          " #" + std::to_string(oi) + ") references var '" +
+                          name + "' declared in no reachable block");
+              return false;
+            }
+          }
+        }
+      }
+      for (const auto& at : op.attrs()) {
+        if (is_block_ref_attr(at.first) &&
+            at.second.value_case() == AttrValue::kI) {
+          long ref = static_cast<long>(at.second.i());
+          if (ref < 0 || ref >= nb) {
+            put_err(err, errcap,
+                    "op '" + op.type() + "' attr '" + at.first +
+                        "' references block " + std::to_string(ref) +
+                        " of " + std::to_string(nb));
+            return false;
+          }
+        }
+      }
+    }
+  }
+  return true;
+}
+
+char* dup_out(const std::string& s) {
+  char* out = static_cast<char*>(std::malloc(s.size() + 1));
+  if (out != nullptr) {
+    std::memcpy(out, s.data(), s.size());
+    out[s.size()] = '\0';
+  }
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+unsigned pt_desc_max_version() { return kMaxVersion; }
+
+int pt_desc_validate(const char* buf, long len, char* err, int errcap) {
+  ProgramDesc prog;
+  if (!parse(buf, len, &prog, err, errcap)) return 1;
+  return validate(prog, err, errcap) ? 0 : 1;
+}
+
+int pt_desc_summary(const char* buf, long len, long* out /* [4] */) {
+  ProgramDesc prog;
+  if (out == nullptr || !parse(buf, len, &prog, nullptr, 0)) return 1;
+  long vars = 0, ops = 0;
+  for (const auto& b : prog.blocks()) {
+    vars += b.vars_size();
+    ops += b.ops_size();
+  }
+  out[0] = prog.blocks_size();
+  out[1] = vars;
+  out[2] = ops;
+  out[3] = prog.format_version();
+  return 0;
+}
+
+int pt_desc_to_json(const char* buf, long len, char** out, char* err,
+                    int errcap) {
+  ProgramDesc prog;
+  if (out == nullptr) return 1;
+  if (!parse(buf, len, &prog, err, errcap)) return 1;
+  std::string json;
+  google::protobuf::util::JsonPrintOptions opts;
+  opts.add_whitespace = false;
+  opts.always_print_primitive_fields = false;
+  auto st = google::protobuf::util::MessageToJsonString(prog, &json, opts);
+  if (!st.ok()) {
+    put_err(err, errcap, std::string("json encode: ") +
+                             std::string(st.message()));
+    return 1;
+  }
+  *out = dup_out(json);
+  return *out == nullptr;
+}
+
+int pt_desc_from_json(const char* json, char** out, long* out_len, char* err,
+                      int errcap) {
+  if (json == nullptr || out == nullptr || out_len == nullptr) return 1;
+  ProgramDesc prog;
+  auto st = google::protobuf::util::JsonStringToMessage(json, &prog);
+  if (!st.ok()) {
+    put_err(err, errcap, std::string("json parse: ") +
+                             std::string(st.message()));
+    return 1;
+  }
+  if (!validate(prog, err, errcap)) return 1;
+  std::string bin;
+  if (!prog.SerializeToString(&bin)) {
+    put_err(err, errcap, "serialize failed");
+    return 1;
+  }
+  *out = static_cast<char*>(std::malloc(bin.size() ? bin.size() : 1));
+  if (*out == nullptr) return 1;
+  std::memcpy(*out, bin.data(), bin.size());
+  *out_len = static_cast<long>(bin.size());
+  return 0;
+}
+
+void pt_desc_free(char* ptr) { std::free(ptr); }
+
+}  // extern "C"
