@@ -104,7 +104,7 @@ def _flash_fwd_kernel(*refs, block_q, block_k, nk,
         l = l_ref[:]
         safe_l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
-        lse_ref[0] = (m_ref[:] + jnp.log(safe_l)).reshape(-1)
+        lse_ref[0] = (m_ref[:] + jnp.log(safe_l)).reshape(1, -1)
 
 
 def _band(qi, ki, qo, block_q, block_k, causal, window):
@@ -164,6 +164,9 @@ def _flash_fwd(q, k, v, kbias, causal, scale, block_q, block_k, window=0,
         causal=causal, scale=scale, window=int(window),
         has_qoff=qoff is not None,
     )
+    # 2D [BH, X] operands ride as [BH, 1, X] so every block keeps a
+    # Mosaic-legal last-two-dims shape ((1, blk): second-minor equals the
+    # array dim, minor is the 128-multiple block)
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
                      memory_space=pltpu.VMEM),
@@ -171,26 +174,26 @@ def _flash_fwd(q, k, v, kbias, causal, scale, block_q, block_k, window=0,
                      memory_space=pltpu.VMEM),
         pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
                      memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, block_k), lambda b, i, j: (b, j),
+        pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b, 0, j),
                      memory_space=pltpu.VMEM),
     ]
-    args = [q, k, v, kbias]
+    args = [q, k, v, kbias.reshape(BH, 1, Tk)]
     if qoff is not None:
         in_specs.insert(0, pl.BlockSpec(memory_space=pltpu.SMEM))
         args.insert(0, qoff.astype(jnp.int32).reshape(1))
-    return pl.pallas_call(
+    o, lse = pl.pallas_call(
         kernel,
         grid=(BH, nq, nk),
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, T, d), q.dtype, vma=_vma(q, k, v)),
-            jax.ShapeDtypeStruct((BH, T), jnp.float32, vma=_vma(q, k, v)),
+            jax.ShapeDtypeStruct((BH, 1, T), jnp.float32, vma=_vma(q, k, v)),
         ],  # lse is over q rows; k-side shapes use Tk
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
@@ -199,6 +202,7 @@ def _flash_fwd(q, k, v, kbias, causal, scale, block_q, block_k, window=0,
         ],
         interpret=_interpret(),
     )(*args)
+    return o, lse.reshape(BH, T)
 
 
 def _flash_dq_kernel(*refs, block_q, block_k, nk, causal, scale,
@@ -298,7 +302,7 @@ def _flash_dkv_kernel(*refs, block_q, block_k, nq, causal, scale,
     def _write():
         dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
-        dkb_ref[0] = dkb_acc[:].reshape(-1)
+        dkb_ref[0] = dkb_acc[:]  # [1, block_k] both sides
 
 
 def _flash_bwd(q, k, v, kbias, o, lse, do, causal, scale, block_q, block_k,
@@ -321,14 +325,19 @@ def _flash_bwd(q, k, v, kbias, o, lse, do, causal, scale, block_q, block_k,
         delta = delta - dlse.astype(jnp.float32)
     qoff_arg = (
         [qoff.astype(jnp.int32).reshape(1)] if qoff is not None else [])
+    # 2D [BH, X] operands ride as [BH, 1, X] (Mosaic-legal blocks; see
+    # _flash_fwd)
+    kb3 = kbias.reshape(BH, 1, Tk)
+    lse3 = lse.reshape(BH, 1, T)
+    delta3 = delta.reshape(BH, 1, T)
 
     q_spec_q = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
                             memory_space=pltpu.VMEM)
     k_spec_q = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
                             memory_space=pltpu.VMEM)
-    kb_spec_q = pl.BlockSpec((1, block_k), lambda b, i, j: (b, j),
+    kb_spec_q = pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b, 0, j),
                              memory_space=pltpu.VMEM)
-    row_spec_q = pl.BlockSpec((1, block_q), lambda b, i, j: (b, i),
+    row_spec_q = pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i),
                               memory_space=pltpu.VMEM)
     smem = ([pl.BlockSpec(memory_space=pltpu.SMEM)]
             if qoff is not None else [])
@@ -344,16 +353,16 @@ def _flash_bwd(q, k, v, kbias, o, lse, do, causal, scale, block_q, block_k,
                                        vma=_vma(q, k, v, do)),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_interpret(),
-    )(*(qoff_arg + [q, k, v, kbias, do, lse, delta]))
+    )(*(qoff_arg + [q, k, v, kb3, do, lse3, delta3]))
 
     # dk/dv pass: grid iterates q blocks innermost for each k block
     q_spec_k = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0),
                             memory_space=pltpu.VMEM)
     k_spec_k = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0),
                             memory_space=pltpu.VMEM)
-    kb_spec_k = pl.BlockSpec((1, block_k), lambda b, i, j: (b, i),
+    kb_spec_k = pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b, 0, i),
                              memory_space=pltpu.VMEM)
-    row_spec_k = pl.BlockSpec((1, block_q), lambda b, i, j: (b, j),
+    row_spec_k = pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, j),
                               memory_space=pltpu.VMEM)
     dk, dv, dkb = pl.pallas_call(
         functools.partial(_flash_dkv_kernel, block_q=block_q, block_k=block_k,
@@ -366,7 +375,8 @@ def _flash_bwd(q, k, v, kbias, o, lse, do, causal, scale, block_q, block_k,
         out_shape=[
             jax.ShapeDtypeStruct((BH, Tk, d), k.dtype, vma=_vma(q, k, v, do)),
             jax.ShapeDtypeStruct((BH, Tk, d), v.dtype, vma=_vma(q, k, v, do)),
-            jax.ShapeDtypeStruct((BH, Tk), jnp.float32, vma=_vma(q, k, v, do)),
+            jax.ShapeDtypeStruct((BH, 1, Tk), jnp.float32,
+                                 vma=_vma(q, k, v, do)),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -374,8 +384,8 @@ def _flash_bwd(q, k, v, kbias, o, lse, do, causal, scale, block_q, block_k,
             pltpu.VMEM((1, block_k), jnp.float32),
         ],
         interpret=_interpret(),
-    )(*(qoff_arg + [q, k, v, kbias, do, lse, delta]))
-    return dq, dk, dv, dkb
+    )(*(qoff_arg + [q, k, v, kb3, do, lse3, delta3]))
+    return dq, dk, dv, dkb.reshape(BH, Tk)
 
 
 def _dense_attention(q, k, v, causal, scale, kbias=None, window=0):
