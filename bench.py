@@ -355,8 +355,11 @@ def _transformer_bench(on_tpu, device):
     from paddle_tpu.models import transformer as tfm
     from paddle_tpu.utils import flops as flops_util
 
-    batch = int(os.environ.get("BENCH_TFM_BATCH", 32 if on_tpu else 4))
-    seq = int(os.environ.get("BENCH_TFM_SEQ", 64 if on_tpu else 16))
+    # bs128 x seq256 = 32k tokens/step (10.6 TFLOP): measured 3.4x the MFU
+    # of the old bs32/seq64 diagnostic config, which at 2k tokens/step
+    # never filled the chip (bs256 gave no further gain)
+    batch = int(os.environ.get("BENCH_TFM_BATCH", 128 if on_tpu else 4))
+    seq = int(os.environ.get("BENCH_TFM_SEQ", 256 if on_tpu else 16))
     steps = max(1, int(os.environ.get("BENCH_TFM_STEPS", 10 if on_tpu else 2)))
     warmup = 2 if on_tpu else 1
     # bf16 matmuls (MXU) + fused attention by default on the chip; the

@@ -26,21 +26,56 @@ _KEEP_F32_SLOTS = {"fused_attention": ("Bias",)}
 # dtype-transparent trunk ops: (data input slots, flippable output slots).
 # When every data input of one of these is available in half precision,
 # the op itself runs in half — its lowering preserves the input dtype
-# (batch_norm computes statistics in f32 internally, nn_ops.py) — so the
-# conv->bn->relu->residual-add->pool trunk of a convnet stays bf16 in HBM
-# instead of bouncing through f32 between every pair of matmul-class ops.
-# Parameter/state slots (Scale/Bias/Mean/Variance) and state outputs
-# (MeanOut/Saved*) keep f32.
+# (batch_norm/layer_norm compute statistics in f32 internally, nn_ops.py)
+# — so the conv->bn->relu->residual-add->pool trunk of a convnet AND the
+# mul->bias-add->reshape->transpose->dropout->layer_norm chains of a
+# transformer block stay bf16 in HBM instead of bouncing through f32
+# between every pair of matmul-class ops.  Parameter/state slots
+# (Scale/Bias/Mean/Variance) and state outputs (MeanOut/Saved*/Mask's
+# XShape) keep f32.
 _TRANSPARENT_OPS = {
     "relu": (("X",), ("Out",)),
+    "gelu": (("X",), ("Out",)),
     "pool2d": (("X",), ("Out",)),
     "batch_norm": (("X",), ("Y",)),
+    "layer_norm": (("X",), ("Y",)),
+    "dropout": (("X",), ("Out",)),
+    "reshape2": (("X",), ("Out",)),
+    "reshape": (("X",), ("Out",)),
+    "transpose2": (("X",), ("Out",)),
+    "transpose": (("X",), ("Out",)),
+    "scale": (("X",), ("Out",)),
     "elementwise_add": (("X", "Y"), ("Out",)),
 }
 
 
 def _tag_for(dtype):
     return "BF16" if dtype == "bfloat16" else "FP16"
+
+
+def _emit_cast(block, new_ops, src_name, dst_dtype, out_name):
+    """Shared cast-op emitter: create `out_name` in `dst_dtype` (shape
+    mirrored from the source var), append the cast op to `new_ops`, and
+    return the new name.  in_dtype derives from the source var's declared
+    dtype (f32 default)."""
+    src = block._find_var_recursive(src_name)
+    out = block.create_var(
+        name=out_name,
+        shape=list(src.shape) if src is not None and src.shape else None,
+        dtype=dst_dtype,
+    )
+    op = framework.Operator(
+        block,
+        "cast",
+        None,
+        None,
+        {"in_dtype": str(src.dtype) if src is not None else "float32",
+         "out_dtype": dst_dtype},
+    )
+    op.inputs = {"X": [src_name]}
+    op.outputs = {"Out": [out.name]}
+    new_ops.append(op)
+    return out.name
 
 
 def _emit_raw_and_castback(block, name, dtype, tag):
@@ -82,27 +117,10 @@ def rewrite_bf16(program=None, ops=_BF16_OPS, dtype="bfloat16"):
 
     def cast_var(name, dst_dtype, tag):
         key = (name, dst_dtype)
-        if key in cast_cache:
-            return cast_cache[key]
-        src = block._find_var_recursive(name)
-        out = block.create_var(
-            name="%s@%s" % (name, tag),
-            shape=list(src.shape) if src is not None and src.shape else None,
-            dtype=dst_dtype,
-        )
-        op = framework.Operator(
-            block,
-            "cast",
-            None,
-            None,
-            {"in_dtype": str(src.dtype) if src is not None else "float32",
-             "out_dtype": dst_dtype},
-        )
-        op.inputs = {"X": [name]}
-        op.outputs = {"Out": [out.name]}
-        new_ops.append(op)
-        cast_cache[key] = out.name
-        return out.name
+        if key not in cast_cache:
+            cast_cache[key] = _emit_cast(
+                block, new_ops, name, dst_dtype, "%s@%s" % (name, tag))
+        return cast_cache[key]
 
     for op in block.ops:
         if (
@@ -161,23 +179,53 @@ def propagate_half_through_trunk(program, dtype="bfloat16"):
     castback_src = {}  # f32 name -> half name, current definitions only
     new_ops = []
     flipped = 0
+    bias_cast_cache = {}  # f32 bias name -> half name
+
+    def half_bias(name):
+        """f32->half cast for a BIAS-LIKE elementwise_add Y operand that
+        is not itself half-sourced: standard AMP runs the bias add in
+        half; bf16 keeps f32's exponent range so small biases round, not
+        underflow.  Callers gate on the operand being a true broadcast
+        bias — full-shape f32 activations keep their f32 contract.
+        Cached per current definition."""
+        if name not in bias_cast_cache:
+            bias_cast_cache[name] = _emit_cast(
+                block, new_ops, name, dtype, "%s@BIAS_%s" % (name, tag))
+        return bias_cast_cache[name]
+
+    def _is_broadcast_bias(xn, yn):
+        """True when Y is a strictly-smaller operand broadcast onto X
+        (FC/conv bias adds) — NOT a same-shape f32 activation."""
+        xv = block._find_var_recursive(xn)
+        yv = block._find_var_recursive(yn)
+        if xv is None or yv is None or xv.shape is None or yv.shape is None:
+            return False
+        return len(yv.shape) < len(xv.shape) or (
+            tuple(yv.shape) != tuple(xv.shape)
+        )
+
     for op in block.ops:
         spec = _TRANSPARENT_OPS.get(op.type)
         halves = None
         if spec is not None:
             in_slots, out_slots = spec
             names = [n for s in in_slots for n in op.inputs.get(s, [])]
-            if names and all(n in castback_src for n in names):
-                if op.type == "elementwise_add":
-                    # same-shape operands only: axis-broadcast adds (bias
-                    # adds) keep their f32 contract
-                    vs = [block._find_var_recursive(n) for n in names]
-                    if any(
-                        v is None or v.shape is None for v in vs
-                    ) or len({tuple(v.shape) for v in vs}) != 1:
-                        names = None
-                if names:
-                    halves = {n: castback_src[n] for n in names}
+            if op.type == "elementwise_add":
+                # X must be half-sourced; Y joins from castback_src when
+                # it is too (residual adds), else only a strictly-smaller
+                # broadcast operand (bias add) is cast to half in place —
+                # a same-shape f32 activation keeps the add in f32
+                xn = op.inputs.get("X", [None])[0]
+                yn = op.inputs.get("Y", [None])[0]
+                if xn in castback_src and yn is not None:
+                    if yn in castback_src:
+                        halves = {xn: castback_src[xn],
+                                  yn: castback_src[yn]}
+                    elif _is_broadcast_bias(xn, yn):
+                        halves = {xn: castback_src[xn],
+                                  yn: half_bias(yn)}
+            elif names and all(n in castback_src for n in names):
+                halves = {n: castback_src[n] for n in names}
         if halves is not None:
             for s in in_slots:
                 if s in op.inputs:
@@ -195,12 +243,21 @@ def propagate_half_through_trunk(program, dtype="bfloat16"):
                 if s not in out_slots:
                     for n in ns:
                         castback_src.pop(n, None)
+                        bias_cast_cache.pop(n, None)
+            if op.type == "dropout":
+                # the lowering emits Mask in X's dtype (nn_ops._dropout):
+                # keep the declaration truthful for fetches/saves
+                for n in op.outputs.get("Mask", []):
+                    mv = block._find_var_recursive(n)
+                    if mv is not None:
+                        mv.dtype = dtype
             continue
         is_castback = (op.type == "cast"
                        and op.attrs.get("out_dtype") == "float32"
                        and op.attrs.get("in_dtype") == dtype)
         for n in op.output_arg_names():
             castback_src.pop(n, None)
+            bias_cast_cache.pop(n, None)
         if is_castback:
             castback_src[op.outputs["Out"][0]] = op.inputs["X"][0]
         new_ops.append(op)

@@ -258,25 +258,33 @@ def _layer_norm(ctx, ins, attrs):
         y = fused_layer_norm(
             x2d, ins["Scale"][0].reshape(h), ins["Bias"][0].reshape(h), eps
         ).reshape(x.shape)
-        mean = jnp.mean(x, axis=-1)
-        var = jnp.var(x, axis=-1)
+        # stats in f32 regardless of input dtype (same invariant as the
+        # fallback path below; the kernel already normalizes in f32)
+        xf32 = x.astype(jnp.float32)
+        mean = jnp.mean(xf32, axis=-1)
+        var = jnp.var(xf32, axis=-1)
         return {
             "Y": [y],
             "Mean": [jax.lax.stop_gradient(mean)],
             "Variance": [jax.lax.stop_gradient(var)],
         }
+    # statistics + normalization in f32 regardless of input dtype (bf16
+    # inputs under AMP keep f32-quality stats; the upcast fuses into the
+    # same loop), Y returned in the input dtype so the op is
+    # dtype-transparent for the AMP trunk pass
     axes = tuple(range(begin, x.ndim))
-    mean = jnp.mean(x, axis=axes, keepdims=True)
-    var = jnp.var(x, axis=axes, keepdims=True)
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
     inv = jax.lax.rsqrt(var + eps)
-    y = (x - mean) * inv
+    y = (xf - mean) * inv
     norm_shape = x.shape[begin:]
     if ins.get("Scale"):
-        y = y * ins["Scale"][0].reshape(norm_shape)
+        y = y * ins["Scale"][0].reshape(norm_shape).astype(jnp.float32)
     if ins.get("Bias"):
-        y = y + ins["Bias"][0].reshape(norm_shape)
+        y = y + ins["Bias"][0].reshape(norm_shape).astype(jnp.float32)
     return {
-        "Y": [y],
+        "Y": [y.astype(x.dtype)],
         "Mean": [jax.lax.stop_gradient(mean.reshape(mean.shape[:begin]))],
         "Variance": [jax.lax.stop_gradient(var.reshape(var.shape[:begin]))],
     }

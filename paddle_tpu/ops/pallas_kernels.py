@@ -565,7 +565,7 @@ def _gru_seq_kernel(x_ref, w_ref, h0_ref, len_ref, o_ref, *, hid, seq_len):
     w = w_ref[:].astype(jnp.float32)  # [H, 3H]
     w_uz = w[:, : 2 * hid]
     w_c = w[:, 2 * hid:]
-    lens = len_ref[:].astype(jnp.int32)  # [Bblk]
+    lens = len_ref[:].astype(jnp.int32).reshape(-1)  # [Bblk, 1] -> [Bblk]
 
     def step(t, h):
         xt = x_ref[:, t, :].astype(jnp.float32)  # [Bblk, 3H]
@@ -605,14 +605,16 @@ def _gru_seq_fwd(xproj, w, h0, lens, block_b=8):
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((block_b, hid), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((block_b,), lambda i: (i,),
+            # lens rides as [B, 1]: a 1D (block_b,) block is Mosaic-illegal
+            # for block_b < 128; (block_b, 1) matches the array's last dim
+            pl.BlockSpec((block_b, 1), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((block_b, T, hid), lambda i: (i, 0, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((B, T, hid), xproj.dtype),
         interpret=_interpret(),
-    )(xproj, w, h0, lens)
+    )(xproj, w, h0, lens.reshape(B, 1))
 
 
 def _gru_seq_dense(xproj, w, h0, lens):
@@ -666,7 +668,7 @@ def _sxent_kernel(x_ref, lbl_ref, o_ref):
     x = x_ref[:].astype(jnp.float32)  # [Bblk, C]
     m = jnp.max(x, axis=-1, keepdims=True)
     lse = jnp.log(jnp.sum(jnp.exp(x - m), axis=-1, keepdims=True)) + m
-    lbl = lbl_ref[:].astype(jnp.int32)  # [Bblk]
+    lbl = lbl_ref[:].astype(jnp.int32).reshape(-1)  # [Bblk, 1] -> [Bblk]
     cols = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
     gold = jnp.sum(jnp.where(cols == lbl[:, None], x, 0.0), axis=-1,
                    keepdims=True)
@@ -686,14 +688,15 @@ def _sxent_fwd_call(logits, labels, block_rows=512):
         in_specs=[
             pl.BlockSpec((block_rows, C), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((block_rows,), lambda i: (i,),
+            # labels ride as [R, 1] (1D sub-128 blocks are Mosaic-illegal)
+            pl.BlockSpec((block_rows, 1), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((block_rows, 1), lambda i: (i, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((R, 1), jnp.float32),
         interpret=_interpret(),
-    )(logits, labels)
+    )(logits, labels.reshape(R, 1))
 
 
 @jax.custom_vjp

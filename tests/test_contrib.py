@@ -293,3 +293,80 @@ def test_amp_trunk_keeps_bf16_through_bn_relu_pool():
     amp = run(True)
     assert amp[-1] < amp[0]
     np.testing.assert_allclose(amp, f32, rtol=0.2, atol=0.05)
+
+
+def test_amp_trunk_keeps_bf16_through_transformer_chain():
+    """The transformer-block chain (mul -> broadcast bias add -> reshape2
+    -> transpose2 -> dropout -> layer_norm -> residual add) stays bf16:
+    bias adds flip with the bias cast to half in place, layer_norm flips
+    with f32-internal statistics, and a same-shape f32 activation add
+    does NOT flip (keeps the f32 contract)."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.contrib.mixed_precision import rewrite_bf16
+
+    def run(amp):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.framework.program_guard(main, startup):
+            startup.random_seed = 21
+            x = layers.data("x", shape=[8, 32])  # [B, T, D]
+            label = layers.data("label", shape=[8, 1], dtype="int64")
+            h = layers.fc(x, 32, num_flatten_dims=2, act=None)  # bias add
+            h = layers.reshape(h, [-1, 8, 4, 8])
+            h = layers.transpose(h, [0, 2, 1, 3])
+            h = layers.transpose(h, [0, 2, 1, 3])
+            h = layers.reshape(h, [-1, 8, 32])
+            h = layers.dropout(h, dropout_prob=0.1, seed=5)
+            h = layers.layer_norm(h)
+            # sigmoid is NOT dtype-transparent: its f32 output feeding an
+            # add must keep the add f32 (no silent activation truncation)
+            gate = layers.sigmoid(layers.fc(x, 32, num_flatten_dims=2,
+                                            bias_attr=False))
+            h = layers.elementwise_add(h, gate)
+            logits = layers.fc(h, 10, num_flatten_dims=2)
+            loss = layers.mean(
+                layers.softmax_with_cross_entropy(logits, label))
+            if amp:
+                rewrite_bf16(main)
+                blk = main.global_block()
+                for t, slot in (("reshape2", "X"), ("transpose2", "X"),
+                                ("dropout", "X"), ("layer_norm", "X")):
+                    flips = [op for op in blk.ops if op.type == t
+                             and "@RAW_BF16" in op.inputs[slot][0]]
+                    assert flips, "no %s flipped to bf16" % t
+                # the FC bias add flipped, reading the bias through an
+                # in-place half cast
+                bias_adds = [
+                    op for op in blk.ops if op.type == "elementwise_add"
+                    and op.inputs["Y"][0].endswith("@BIAS_BF16")
+                ]
+                assert bias_adds, "no bias add flipped"
+                # the sigmoid-gate add stayed f32 (Y is a same-shape f32
+                # activation, not a bias)
+                gate_adds = [
+                    op for op in blk.ops if op.type == "elementwise_add"
+                    and not op.inputs["Y"][0].endswith("@BIAS_BF16")
+                    and not op.inputs["Y"][0].endswith("@RAW_BF16")
+                    and "@" not in op.inputs["X"][0]
+                ]
+                assert gate_adds, "gate add was wrongly flipped"
+            fluid.optimizer.SGD(0.05).minimize(loss)
+        rng = np.random.RandomState(7)
+        xv = rng.rand(4, 8, 32).astype("float32")
+        yv = rng.randint(0, 10, (4, 8, 1)).astype("int64")
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            return [
+                float(np.ravel(exe.run(
+                    main, feed={"x": xv, "label": yv},
+                    fetch_list=[loss])[0])[0])
+                for _ in range(5)
+            ]
+
+    f32 = run(False)
+    amp = run(True)
+    assert amp[-1] < amp[0]
+    np.testing.assert_allclose(amp, f32, rtol=0.1, atol=0.05)
