@@ -492,6 +492,43 @@ def _padded_lstm(ctx, ins, attrs):
     h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((bsz, hid), xproj.dtype)
     c0 = ins["C0"][0] if ins.get("C0") else jnp.zeros((bsz, hid), xproj.dtype)
     is_reverse = attrs.get("is_reverse", False)
+
+    # forward direction: one shared masked recurrence (_lstm_seq_dense,
+    # also the fused path's backward recompute — the GRU pattern, no
+    # formula triplication), with the VMEM-resident fused kernel
+    # (jit_kernel lstm / fusion_lstm slot) when eligible: lane-aligned
+    # hidden, working set within VMEM.  Bias folds into the projected
+    # gates either way.
+    from .pallas_kernels import (
+        _interpret,
+        _lstm_seq_dense,
+        _row_block,
+        fused_lstm,
+        use_pallas,
+    )
+
+    if not is_reverse:
+        lens = (
+            seq_len.reshape(-1).astype(jnp.int32)
+            if seq_len is not None
+            else jnp.full((bsz,), t, jnp.int32)
+        )
+        xg = xproj if b is None else xproj + b.reshape(1, 1, -1)
+        lane_ok = hid % (8 if _interpret() else 128) == 0
+        blk = _row_block(bsz, 8)
+        vmem_bytes = blk * t * (4 + 2) * hid * 4 + hid * 4 * hid * 4
+        if use_pallas() and lane_ok and vmem_bytes < 10 * 2 ** 20:
+            hs, cs = fused_lstm(xg, w, h0, c0, lens)
+        else:
+            hs, cs = _lstm_seq_dense(xg, w, h0, c0, lens)
+        # masking holds state past each row's length: the final step IS
+        # the last valid h/c
+        return {
+            "Hidden": [hs],
+            "CellSeq": [cs],
+            "LastH": [hs[:, -1, :]],
+            "LastC": [cs[:, -1, :]],
+        }
     xs = jnp.swapaxes(xproj, 0, 1)  # [T, B, 4H]
     if is_reverse:
         xs = jnp.flip(xs, 0)

@@ -661,6 +661,125 @@ fused_gru.defvjp(_gru_vjp_fwd, _gru_vjp_bwd)
 
 
 # ---------------------------------------------------------------------------
+# fused LSTM sequence kernel (math/jit_kernel.h lstm kernels +
+# fused/fusion_lstm analog): hidden AND cell state live in VMEM across all
+# timesteps — one HBM read of the projected gates and one write of each
+# output sequence per batch block, instead of per-step round trips
+# ---------------------------------------------------------------------------
+def _lstm_seq_kernel(x_ref, w_ref, h0_ref, c0_ref, len_ref, o_ref, cell_ref,
+                     *, hid, seq_len):
+    w = w_ref[:].astype(jnp.float32)  # [H, 4H]
+    lens = len_ref[:].astype(jnp.int32).reshape(-1)  # [Bblk, 1] -> [Bblk]
+
+    def step(t, hc):
+        h, c = hc
+        xt = x_ref[:, t, :].astype(jnp.float32)  # [Bblk, 4H]
+        gates = xt + jax.lax.dot(h, w, preferred_element_type=jnp.float32)
+        # gate order i|f|c_hat|o (lstm_op.cc / _lstm_cell layout)
+        i = jax.nn.sigmoid(gates[:, :hid])
+        f = jax.nn.sigmoid(gates[:, hid: 2 * hid])
+        c_hat = jnp.tanh(gates[:, 2 * hid: 3 * hid])
+        o = jax.nn.sigmoid(gates[:, 3 * hid:])
+        c_new = f * c + i * c_hat
+        h_new = o * jnp.tanh(c_new)
+        active = (t < lens)[:, None].astype(jnp.float32)
+        c_new = active * c_new + (1.0 - active) * c
+        h_new = active * h_new + (1.0 - active) * h
+        o_ref[:, t, :] = h_new.astype(o_ref.dtype)
+        cell_ref[:, t, :] = c_new.astype(cell_ref.dtype)
+        return (h_new, c_new)
+
+    jax.lax.fori_loop(
+        0, seq_len, step,
+        (h0_ref[:].astype(jnp.float32), c0_ref[:].astype(jnp.float32)),
+    )
+
+
+def _lstm_seq_fwd(xproj, w, h0, c0, lens, block_b=8):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, T, H4 = xproj.shape
+    hid = H4 // 4
+    block_b = _row_block(B, block_b)
+    grid = (_cdiv(B, block_b),)
+    state_spec = pl.BlockSpec((block_b, hid), lambda i: (i, 0),
+                              memory_space=pltpu.VMEM)
+    seq_spec = pl.BlockSpec((block_b, T, hid), lambda i: (i, 0, 0),
+                            memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        functools.partial(_lstm_seq_kernel, hid=hid, seq_len=T),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_b, T, H4), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((hid, H4), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            state_spec,
+            state_spec,
+            # lens rides as [B, 1] (1D sub-128 blocks are Mosaic-illegal)
+            pl.BlockSpec((block_b, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[seq_spec, seq_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, T, hid), xproj.dtype),
+            jax.ShapeDtypeStruct((B, T, hid), xproj.dtype),
+        ],
+        interpret=_interpret(),
+    )(xproj, w, h0, c0, lens.reshape(B, 1))
+
+
+def _lstm_seq_dense(xproj, w, h0, c0, lens):
+    """Reference scan (also the recompute path for the backward pass)."""
+    hid = xproj.shape[-1] // 4
+
+    def step(carry, inp):
+        h, c = carry
+        xt, t = inp
+        gates = xt + h @ w
+        i = jax.nn.sigmoid(gates[:, :hid])
+        f = jax.nn.sigmoid(gates[:, hid: 2 * hid])
+        c_hat = jnp.tanh(gates[:, 2 * hid: 3 * hid])
+        o = jax.nn.sigmoid(gates[:, 3 * hid:])
+        c_new = f * c + i * c_hat
+        h_new = o * jnp.tanh(c_new)
+        act = (t < lens)[:, None].astype(h.dtype)
+        c_new = act * c_new + (1 - act) * c
+        h_new = act * h_new + (1 - act) * h
+        return (h_new, c_new), (h_new, c_new)
+
+    xs = jnp.swapaxes(xproj, 0, 1)
+    ts = jnp.arange(xproj.shape[1])
+    _, (hs, cs) = jax.lax.scan(step, (h0, c0), (xs, ts))
+    return jnp.swapaxes(hs, 0, 1), jnp.swapaxes(cs, 0, 1)
+
+
+@jax.custom_vjp
+def fused_lstm(xproj, w, h0, c0, lens):
+    """VMEM-resident LSTM over padded [B, T, 4H] projected inputs;
+    returns (hidden_seq, cell_seq), each [B, T, H]."""
+    return _lstm_seq_fwd(xproj, w, h0, c0, lens)
+
+
+def _lstm_vjp_fwd(xproj, w, h0, c0, lens):
+    return _lstm_seq_fwd(xproj, w, h0, c0, lens), (xproj, w, h0, c0, lens)
+
+
+def _lstm_vjp_bwd(res, dy):
+    xproj, w, h0, c0, lens = res
+    _, vjp = jax.vjp(
+        lambda x, w_, h_, c_: _lstm_seq_dense(x, w_, h_, c_, lens),
+        xproj, w, h0, c0,
+    )
+    dx, dw, dh0, dc0 = vjp(dy)
+    return dx, dw, dh0, dc0, None
+
+
+fused_lstm.defvjp(_lstm_vjp_fwd, _lstm_vjp_bwd)
+
+
+# ---------------------------------------------------------------------------
 # fused softmax cross entropy (row-blocked logsumexp + label gather; the
 # backward is the analytic softmax(x) - onehot, no recompute needed)
 # ---------------------------------------------------------------------------

@@ -179,6 +179,78 @@ def test_fused_gru_matches_scan_gru_fwd_and_grad():
                                atol=1e-4)
 
 
+def test_fused_lstm_matches_scan_lstm_fwd_and_grad():
+    """fused_lstm (VMEM-resident h+c recurrence) == padded_lstm scan,
+    values and gradients for both output sequences, incl. seq-len
+    masking."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas_kernels import fused_lstm, _lstm_seq_dense
+
+    B, T, H = 4, 6, 8
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(B, T, 4 * H).astype("float32"))
+    w = jnp.asarray(rng.randn(H, 4 * H).astype("float32") * 0.3)
+    h0 = jnp.asarray(rng.randn(B, H).astype("float32"))
+    c0 = jnp.asarray(rng.randn(B, H).astype("float32"))
+    lens = jnp.asarray(np.array([6, 4, 2, 6], "int32"))
+
+    hs, cs = fused_lstm(x, w, h0, c0, lens)
+    rh, rc = _lstm_seq_dense(x, w, h0, c0, lens)
+    np.testing.assert_allclose(np.asarray(hs), np.asarray(rh),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(cs), np.asarray(rc),
+                               rtol=1e-5, atol=1e-5)
+    # masked rows carry state forward: last step == last valid state
+    np.testing.assert_allclose(np.asarray(hs[1, -1]), np.asarray(hs[1, 3]))
+
+    def loss(fn):
+        def f(x_, w_, h_, c_):
+            a, b = fn(x_, w_, h_, c_, lens)
+            return jnp.sum(a ** 2) + jnp.sum(b * 0.5)
+        return f
+
+    gp = jax.grad(loss(fused_lstm), argnums=(0, 1, 2, 3))(x, w, h0, c0)
+    gr = jax.grad(loss(_lstm_seq_dense), argnums=(0, 1, 2, 3))(x, w, h0, c0)
+    for a, b in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_use_pallas_flag_dispatches_lstm():
+    """FLAGS_use_pallas routes the lstm op (via padded_lstm) to fused_lstm
+    with results matching the scan path, including Cell/LastH/LastC."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.flags import set_flags
+
+    def run():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.framework.program_guard(main, startup):
+            startup.random_seed = 5
+            x = layers.data("x", shape=[6, 16])  # [B, T, D]
+            xproj = layers.fc(x, 4 * 8, num_flatten_dims=2, bias_attr=False)
+            h, c = layers.dynamic_lstm(xproj, size=4 * 8,
+                                       use_peepholes=False)
+            loss = layers.mean(h) + layers.mean(c)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            xv = np.random.RandomState(4).rand(3, 6, 16).astype("float32")
+            return np.asarray(
+                exe.run(main, feed={"x": xv}, fetch_list=[loss])[0])
+
+    base = run()
+    set_flags({"use_pallas": True})
+    try:
+        fused = run()
+    finally:
+        set_flags({"use_pallas": False})
+    np.testing.assert_allclose(base, fused, rtol=1e-5, atol=1e-6)
+
+
 def test_fused_softmax_xent_matches_dense():
     import jax
     import jax.numpy as jnp
