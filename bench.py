@@ -164,7 +164,8 @@ def _bench_impl():
     # default — the vgg/se_resnext shapes roughly double tunnel time
     if os.environ.get("BENCH_MODELS", "0") == "1":
         result["models"] = {}
-        for name in ("vgg16", "se_resnext50", "stacked_lstm"):
+        for name in ("vgg16", "se_resnext50", "stacked_lstm", "bert_base",
+                     "deepfm"):
             try:
                 result["models"][name] = _model_bench(name, on_tpu, device)
             except Exception as e:
@@ -224,6 +225,42 @@ def _model_bench(name, on_tpu, device):
                 "label": rng.randint(0, 10, (bs, 1)).astype("int64"),
             }
             unit, per_step = "images/sec", bs
+        elif name == "bert_base":
+            # BASELINE config 3: BERT-base pretraining, fused attention
+            from paddle_tpu.models import bert
+
+            bs = int(os.environ.get("BENCH_MODEL_BATCH", 32 if on_tpu else 2))
+            seq = 128 if on_tpu else 16
+
+            class HP(bert.BertConfig):
+                fused_attn = True
+                n_layer = bert.BertConfig.n_layer if on_tpu else 2
+                vocab_size = bert.BertConfig.vocab_size if on_tpu else 500
+
+            main_b, startup_b, _feeds, fetches_b = bert.bert_pretrain_program(
+                HP, seq_len=seq, use_bf16=on_tpu)
+            feed_np = bert.make_fake_bert_batch(bs, seq, HP, seed=0)
+            unit, per_step = "examples/sec", bs
+            main, startup, loss = main_b, startup_b, fetches_b[0]
+        elif name == "deepfm":
+            # BASELINE config 4: DeepFM CTR, sparse embeddings
+            from paddle_tpu.models.ctr_deepfm import build_deepfm_train
+
+            bs = int(os.environ.get("BENCH_MODEL_BATCH",
+                                    4096 if on_tpu else 64))
+            fields = [1000] * 26 if on_tpu else [50] * 4
+            feeds, loss, _pred = build_deepfm_train(
+                fields, dense_dim=13 if on_tpu else 4, embed_dim=16,
+                is_sparse=True)
+            fluid.optimizer.Adagrad(0.01).minimize(loss)
+            feed_np = {}
+            for i, dim in enumerate(fields):
+                feed_np["C%d" % i] = rng.randint(
+                    0, dim, (bs, 1)).astype("int64")
+            feed_np["dense"] = rng.rand(
+                bs, 13 if on_tpu else 4).astype("float32")
+            feed_np["click"] = rng.randint(0, 2, (bs, 1)).astype("float32")
+            unit, per_step = "examples/sec", bs
         else:
             from paddle_tpu.models.stacked_dynamic_lstm import (
                 build_stacked_lstm_train,

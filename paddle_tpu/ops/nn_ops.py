@@ -529,12 +529,10 @@ def _padded_lstm(ctx, ins, attrs):
             "LastH": [hs[:, -1, :]],
             "LastC": [cs[:, -1, :]],
         }
-    xs = jnp.swapaxes(xproj, 0, 1)  # [T, B, 4H]
-    if is_reverse:
-        xs = jnp.flip(xs, 0)
-    steps = jnp.arange(t)
-    if is_reverse:
-        steps = jnp.flip(steps)
+    # reverse direction only from here (the forward path returned above):
+    # scan the flipped sequence, flip the outputs back
+    xs = jnp.flip(jnp.swapaxes(xproj, 0, 1), 0)  # [T, B, 4H]
+    steps = jnp.flip(jnp.arange(t))
 
     def step(carry, inp):
         c_prev, h_prev = carry
@@ -550,9 +548,8 @@ def _padded_lstm(ctx, ins, attrs):
         return (c, h), (h, c)
 
     (c_fin, h_fin), (hs, cs) = jax.lax.scan(step, (c0, h0), (xs, steps))
-    if is_reverse:
-        hs = jnp.flip(hs, 0)
-        cs = jnp.flip(cs, 0)
+    hs = jnp.flip(hs, 0)
+    cs = jnp.flip(cs, 0)
     return {
         "Hidden": [jnp.swapaxes(hs, 0, 1)],
         "CellSeq": [jnp.swapaxes(cs, 0, 1)],

@@ -731,19 +731,16 @@ def _lstm_seq_fwd(xproj, w, h0, c0, lens, block_b=8):
 
 
 def _lstm_seq_dense(xproj, w, h0, c0, lens):
-    """Reference scan (also the recompute path for the backward pass)."""
-    hid = xproj.shape[-1] // 4
+    """Reference scan (also the recompute path for the backward pass).
+    Reuses nn_ops._lstm_cell — one copy of the gate math outside the
+    hand-tiled kernel (which must slice refs explicitly)."""
+    from .nn_ops import _lstm_cell  # lazy: nn_ops imports this module
 
     def step(carry, inp):
         h, c = carry
         xt, t = inp
         gates = xt + h @ w
-        i = jax.nn.sigmoid(gates[:, :hid])
-        f = jax.nn.sigmoid(gates[:, hid: 2 * hid])
-        c_hat = jnp.tanh(gates[:, 2 * hid: 3 * hid])
-        o = jax.nn.sigmoid(gates[:, 3 * hid:])
-        c_new = f * c + i * c_hat
-        h_new = o * jnp.tanh(c_new)
+        c_new, h_new = _lstm_cell(c, h, gates)
         act = (t < lens)[:, None].astype(h.dtype)
         c_new = act * c_new + (1 - act) * c
         h_new = act * h_new + (1 - act) * h
