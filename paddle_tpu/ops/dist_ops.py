@@ -192,3 +192,28 @@ def _checkpoint_notify(ctx, ins, attrs):
     tok = io_callback(
         host_notify, jax.ShapeDtypeStruct((), jnp.int32), ordered=True)
     return {"Out": [tok]}
+
+
+@register("ref_by_trainer_id", no_grad_inputs=("TrainerId",))
+def _ref_by_trainer_id(ctx, ins, attrs):
+    """distributed_ops/ref_by_trainer_id_op.h: select X[trainer_id] from
+    the input list.  The trainer id is a host-known scalar in every real
+    program (wired by the transpiler from the env contract), so the
+    selection happens at trace time when possible; a traced id falls back
+    to lax.switch over the (equal-shaped) candidates."""
+    import jax.core
+
+    xs = ins["X"]
+    tid = ins["TrainerId"][0]
+    if not isinstance(tid, jax.core.Tracer):
+        idx = int(np.asarray(tid).reshape(-1)[0])
+        if idx < 0 or idx >= len(xs):
+            raise IndexError(
+                "ref_by_trainer_id: trainer id %d out of range (%d inputs)"
+                % (idx, len(xs)))
+        return {"Out": [xs[idx]]}
+    import jax.lax as lax
+
+    return {"Out": [lax.switch(
+        jnp.clip(tid.reshape(()).astype(jnp.int32), 0, len(xs) - 1),
+        [lambda i=i: xs[i] for i in range(len(xs))])]}

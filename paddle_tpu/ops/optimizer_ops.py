@@ -152,6 +152,45 @@ def _adagrad(ctx, ins, attrs):
     return {"ParamOut": [p_out], "MomentOut": [m_out]}
 
 
+def _prox(x, lr, l1, l2):
+    """Proximal operator for l1/l2 regularization
+    (optimizers/proximal_gd_op.h update rule): soft-threshold by lr*l1,
+    shrink by 1/(1 + lr*l2)."""
+    if l1 > 0:
+        x = jnp.sign(x) * jnp.maximum(jnp.abs(x) - lr * l1, 0.0)
+    return x / (1.0 + lr * l2)
+
+
+@register("proximal_gd", no_grad_inputs=("Param", "Grad", "LearningRate"))
+def _proximal_gd(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    lr = _lr(ins)
+    l1 = float(attrs.get("l1", 0.0))
+    l2 = float(attrs.get("l2", 0.0))
+    return {"ParamOut": [_prox(p - lr * g.astype(p.dtype), lr, l1, l2)]}
+
+
+@register(
+    "proximal_adagrad",
+    no_grad_inputs=("Param", "Grad", "Moment", "LearningRate"),
+)
+def _proximal_adagrad(ctx, ins, attrs):
+    """optimizers/proximal_adagrad_op.h: adagrad prospective step
+    (p - lr*g/sqrt(m+g^2)), then the proximal projection with the PLAIN
+    lr (threshold lr*l1, shrink 1/(1+lr*l2)) — the reference applies the
+    scalar lr in the prox, not the per-element adaptive step.  The g==0,
+    m==0 corner returns a 0 step instead of the reference's 0/0."""
+    p, g, m = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    lr = _lr(ins)
+    l1 = float(attrs.get("l1", 0.0))
+    l2 = float(attrs.get("l2", 0.0))
+    m_out = m + jnp.square(g)
+    denom = jnp.sqrt(m_out)
+    upd = jnp.where(denom > 0, g.astype(p.dtype) / denom, 0.0)
+    return {"ParamOut": [_prox(p - lr * upd, lr, l1, l2)],
+            "MomentOut": [m_out]}
+
+
 @register(
     "decayed_adagrad", no_grad_inputs=("Param", "Grad", "Moment", "LearningRate")
 )

@@ -444,3 +444,25 @@ def test_timeline_tool_merges_worker_profiles(tmp_path):
     pids = {e["pid"] for e in trace}
     assert pids == {0, 1}
     assert any(e.get("ph") == "X" for e in trace)
+
+
+def test_op_coverage_vs_reference():
+    """Every reference REGISTER_OPERATOR type is lowered, generically
+    derived, or on the documented structural/N-A list
+    (tools/check_op_coverage.py — the op-level diff_api.py sibling)."""
+    import os
+    import subprocess
+    import sys
+
+    if not os.path.isdir("/root/reference"):
+        import pytest
+
+        pytest.skip("reference tree unavailable")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "check_op_coverage.py")],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH", "")},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr

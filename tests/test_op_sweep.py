@@ -1244,3 +1244,50 @@ def test_detection_map_op():
                   {"overlap_threshold": 0.5}, ["MAP"])
     assert 0.0 <= float(mp[0]) <= 1.0
     assert float(mp[0]) > 0.9  # both gts matched by top-scoring dets
+
+
+def test_proximal_optimizer_ops_match_reference_math():
+    """proximal_gd / proximal_adagrad (optimizers/proximal_*_op.h): the
+    prox step soft-thresholds by lr*l1 and shrinks by 1/(1+lr*l2)."""
+    import numpy as np
+
+    from paddle_tpu.core.registry import get_op
+
+    rng = np.random.RandomState(0)
+    p = rng.randn(6).astype("float32")
+    g = rng.randn(6).astype("float32")
+    lr, l1, l2 = 0.1, 0.05, 0.2
+
+    out = get_op("proximal_gd").lower(
+        None,
+        {"Param": [p], "Grad": [g], "LearningRate": [np.float32(lr)]},
+        {"l1": l1, "l2": l2},
+    )
+    prox = p - lr * g
+    want = np.sign(prox) * np.maximum(np.abs(prox) - lr * l1, 0) / (1 + lr * l2)
+    np.testing.assert_allclose(np.asarray(out["ParamOut"][0]), want, rtol=1e-6)
+
+    m = np.abs(rng.randn(6)).astype("float32")
+    out = get_op("proximal_adagrad").lower(
+        None,
+        {"Param": [p], "Grad": [g], "Moment": [m],
+         "LearningRate": [np.float32(lr)]},
+        {"l1": l1, "l2": l2},
+    )
+    m_new = m + g * g
+    prox = p - lr * g / np.sqrt(m_new)
+    want = np.sign(prox) * np.maximum(np.abs(prox) - lr * l1, 0) / (1 + lr * l2)
+    np.testing.assert_allclose(np.asarray(out["ParamOut"][0]), want, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(out["MomentOut"][0]), m_new, rtol=1e-6)
+
+
+def test_ref_by_trainer_id_selects_input():
+    import numpy as np
+
+    from paddle_tpu.core.registry import get_op
+
+    xs = [np.full((2, 2), i, "float32") for i in range(3)]
+    out = get_op("ref_by_trainer_id").lower(
+        None, {"X": xs, "TrainerId": [np.array([1], "int64")]}, {}
+    )
+    np.testing.assert_array_equal(np.asarray(out["Out"][0]), xs[1])
