@@ -51,6 +51,7 @@ class AnalysisConfig(NativeConfig):
             "fc_lstm_fuse_pass",
             "conv_eltadd_relu_fuse_pass",
             "seqconv_eltadd_relu_fuse_pass",
+            "seqexpand_concat_fc_fuse_pass",
             "fuse_elewise_add_act_pass",
             "drop_train_ops",
             "memory_optimize",

@@ -303,6 +303,116 @@ def _fc_lstm_fuse(program, scope):
     return program
 
 
+@register_pass("seqexpand_concat_fc_fuse_pass")
+class SeqexpandConcatFcFusePass(Pass):
+    """sequence_expand(s) + concat + fc/mul -> fusion_seqexpand_concat_fc
+    (ir/seq_concat_fc_fuse_pass.cc role on the padded representation).
+
+    Run AFTER fc_fuse_pass: mul+bias+act chains have already collapsed to
+    fc, so matching fc (or a bare mul) here covers the general pattern.
+    The concat's first input is the [B, T, D] sequence; every further
+    input must be a single-consumer sequence_expand of a [B, Di] vector.
+    """
+
+    def apply(self, program, scope=None):
+        block = program.global_block()
+        n = 0
+        changed = True
+        while changed:
+            changed = False
+            producers, consumers = {}, {}
+            for op in block.ops:
+                for name in op.input_arg_names():
+                    consumers.setdefault(name, []).append(op)
+                for name in op.output_arg_names():
+                    producers[name] = op
+            for cat in list(block.ops):
+                if cat.type != "concat":
+                    continue
+                if int(cat.attrs.get("axis", 0)) not in (2, -1):
+                    continue
+                xs = cat.inputs.get("X", [])
+                if len(xs) < 2:
+                    continue
+                sv = block._find_var_recursive(xs[0])
+                if sv is None or sv.shape is None or len(sv.shape) != 3:
+                    continue
+                expands = []
+                for name in xs[1:]:
+                    p = producers.get(name)
+                    xv = (
+                        block._find_var_recursive(p.inputs["X"][0])
+                        if p is not None and p.type == "sequence_expand"
+                        else None
+                    )
+                    if (
+                        p is None or p.type != "sequence_expand"
+                        or xv is None or xv.shape is None
+                        or len(xv.shape) != 2
+                        or len(consumers.get(name, [])) != 1
+                    ):
+                        expands = None
+                        break
+                    expands.append(p)
+                if not expands:
+                    continue
+                cat_out = cat.outputs["Out"][0]
+                cons = consumers.get(cat_out, [])
+                if len(cons) != 1:
+                    continue
+                proj = cons[0]
+                if proj.type == "fc":
+                    if int(proj.attrs.get("in_num_col_dims", 1)) != 2:
+                        continue
+                    if proj.inputs.get("Input", [None])[0] != cat_out:
+                        continue
+                    weight = proj.inputs["W"]
+                    bias = proj.inputs.get("Bias")
+                    act = proj.attrs.get("activation_type") or "identity"
+                elif proj.type == "mul":
+                    if int(proj.attrs.get("x_num_col_dims", 1)) != 2:
+                        continue
+                    if int(proj.attrs.get("y_num_col_dims", 1)) != 1:
+                        continue
+                    if proj.inputs.get("X", [None])[0] != cat_out:
+                        continue
+                    wv = block._find_var_recursive(proj.inputs["Y"][0])
+                    if wv is None or wv.shape is None or len(wv.shape) != 2:
+                        continue  # fused lowering matmuls FCWeight as-is
+                    weight = proj.inputs["Y"]
+                    bias = None
+                    act = "identity"
+                else:
+                    continue
+                if act not in ("identity", "relu", "tanh", "sigmoid"):
+                    continue
+                chain = expands + [cat, proj]
+                if not _chain_safe(program, chain):
+                    continue
+                inputs = {
+                    "X": [xs[0]] + [e.inputs["X"][0] for e in expands],
+                    "FCWeight": weight,
+                }
+                if bias:
+                    inputs["FCBias"] = bias
+                fused = _mk_op(
+                    block, "fusion_seqexpand_concat_fc", inputs,
+                    {"Out": [proj.outputs["Out"][0]]},
+                    {"fc_activation": act},
+                )
+                # insert at the projection's position (all fused inputs
+                # are defined by then); the chain need not be contiguous
+                block.ops.insert(block.ops.index(proj), fused)
+                for op in chain:
+                    block.ops.remove(op)
+                program._bump_version()
+                n += 1
+                changed = True
+                break
+        program._seqexpand_concat_fc_fused_count = n
+        return program
+
+
 @register_pass("embedding_fc_lstm_fuse_pass")
 class EmbeddingFcLstmFusePass(Pass):
     """lookup_table + fc/mul + lstm -> fused_embedding_fc_lstm

@@ -162,6 +162,50 @@ def test_fc_gru_fuse_pass():
                                rtol=1e-4, atol=1e-5)
 
 
+def test_seqexpand_concat_fc_fuse_pass():
+    """sequence_expand + concat + fc -> fusion_seqexpand_concat_fc
+    (seq_concat_fc_fuse_pass role): fires after fc_fuse, matches
+    numerically, and leaves train programs alone."""
+    def build():
+        main, startup = _fresh()
+        with fluid.framework.program_guard(main, startup):
+            startup.random_seed = 7
+            seq = layers.data("seq", shape=[5, 12])  # [B, T, D]
+            vec = layers.data("vec", shape=[6])      # [B, D1]
+            exp = layers.sequence_expand(vec, seq)
+            cat = layers.concat([seq, exp], axis=2)
+            out = layers.fc(cat, 10, num_flatten_dims=2, act="relu")
+        return main, startup, out
+
+    rng = np.random.RandomState(1)
+    feed = {"seq": rng.rand(3, 5, 12).astype("float32"),
+            "vec": rng.rand(3, 6).astype("float32")}
+
+    main, startup, out = build()
+    scope = fluid.Scope()
+    before, scope = _run(main, startup, feed, [out], scope)
+    apply_pass(main, "fc_fuse_pass")
+    apply_pass(main, "seqexpand_concat_fc_fuse_pass")
+    assert main._seqexpand_concat_fc_fused_count == 1
+    types = _op_types(main)
+    assert "fusion_seqexpand_concat_fc" in types
+    assert "sequence_expand" not in types and "concat" not in types
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        after = exe.run(main, feed=feed, fetch_list=[out])
+    np.testing.assert_allclose(before[0], np.asarray(after[0]),
+                               rtol=1e-5, atol=1e-6)
+
+    # train program: grad ops consume the intermediates -> must not fire
+    main2, startup2, out2 = build()
+    with fluid.framework.program_guard(main2, startup2):
+        loss = layers.mean(out2)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    apply_pass(main2, "seqexpand_concat_fc_fuse_pass")
+    assert main2._seqexpand_concat_fc_fused_count == 0
+    assert "sequence_expand" in _op_types(main2)
+
+
 def test_embedding_fc_lstm_fuse_pass():
     main, startup = _fresh()
     with fluid.framework.program_guard(main, startup):
