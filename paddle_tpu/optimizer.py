@@ -27,6 +27,7 @@ __all__ = [
     "RMSProp",
     "Ftrl",
     "ModelAverage",
+    "GradientMergeOptimizer",
     "SGDOptimizer",
     "MomentumOptimizer",
     "LarsMomentumOptimizer",
@@ -615,3 +616,112 @@ class ModelAverage(Optimizer):
         """No-op when apply() restored on exit (reference API parity)."""
 
 
+
+
+class GradientMergeOptimizer:
+    """Gradient accumulation over k micro-batches (the capability of the
+    reference's ir/multi_batch_merge_pass, re-designed compile-first).
+
+    Where the reference rewrites the graph into N forward/backward copies
+    per step, here `minimize` splits training into TWO compiled programs
+    with static shapes and no data-dependent control flow:
+
+      * the MAIN program accumulates grads into persistable buffers
+        (`<param>@GRAD@MERGED`) each `exe.run(main)` — no weight update;
+      * `apply_program` applies the inner optimizer on the averaged
+        buffers and zeroes them — run it every k-th micro-batch.
+
+        opt = fluid.optimizer.GradientMergeOptimizer(
+            fluid.optimizer.Adam(1e-3), k_steps=4)
+        apply_prog = opt.minimize(loss)
+        exe.run(fluid.default_startup_program())
+        for i, batch in enumerate(batches):
+            exe.run(feed=batch, fetch_list=[loss])
+            if (i + 1) % 4 == 0:
+                exe.run(apply_prog)
+
+    Gradient clip / regularization configured on the inner optimizer
+    apply at merge time (on the averaged grad), matching the reference's
+    once-per-merged-batch semantics.
+    """
+
+    def __init__(self, inner_optimizer, k_steps=1, avg=True):
+        if k_steps < 1:
+            raise ValueError("k_steps must be >= 1")
+        self.inner = inner_optimizer
+        self.k_steps = int(k_steps)
+        self.avg = bool(avg)
+        self.apply_program = None
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from .initializer import Constant
+        from .layers import nn as _nn
+
+        main = framework.default_main_program()
+        startup = startup_program or framework.default_startup_program()
+        block = main.global_block()
+        params_grads = self.inner.backward(
+            loss, startup, parameter_list, no_grad_set)
+
+        merged = []  # (param, acc var)
+        with main._op_role_guard("optimize"):
+            for param, grad in params_grads:
+                if grad is None or not param.trainable:
+                    continue
+                acc = block.create_var(
+                    name=param.name + "@GRAD@MERGED",
+                    shape=list(param.shape),
+                    dtype=param.dtype,
+                    persistable=True,
+                    stop_gradient=True,
+                )
+                sb = startup.global_block()
+                sv = sb.create_var(name=acc.name, shape=list(param.shape),
+                                   dtype=param.dtype, persistable=True)
+                Constant(0.0)(sv, sb)
+                # acc += grad, in place on the persistable name
+                block.append_op(
+                    "elementwise_add",
+                    inputs={"X": [acc.name], "Y": [grad.name]},
+                    outputs={"Out": [acc.name]},
+                    attrs={"axis": -1},
+                )
+                merged.append((param, acc))
+
+        # the apply program: shares the scope by NAME with main
+        apply_prog = framework.Program()
+        with framework.program_guard(apply_prog, startup):
+            ablock = apply_prog.global_block()
+            pg = []
+            for param, acc in merged:
+                p2 = framework.Parameter(
+                    ablock, list(param.shape), param.dtype, name=param.name)
+                p2.trainable = True
+                p2.optimize_attr = param.optimize_attr
+                # per-param decay/clip must survive into merge-time
+                # apply_gradients (regularizer.py / clip.py read these)
+                p2.regularizer = param.regularizer
+                p2.gradient_clip_attr = param.gradient_clip_attr
+                ablock.vars[param.name] = p2
+                a2 = ablock.create_var(
+                    name=acc.name, shape=list(param.shape), dtype=param.dtype,
+                    persistable=True, stop_gradient=True)
+                g = (
+                    _nn.scale(a2, scale=1.0 / self.k_steps)
+                    if self.avg and self.k_steps > 1 else a2
+                )
+                pg.append((p2, g))
+            self.inner.apply_gradients(pg)
+            # zero the buffers for the next merge window
+            with apply_prog._op_role_guard("optimize"):
+                for param, acc in merged:
+                    ablock.append_op(
+                        "fill_constant",
+                        inputs={},
+                        outputs={"Out": [acc.name]},
+                        attrs={"shape": list(acc.shape),
+                               "dtype": param.dtype, "value": 0.0},
+                    )
+        self.apply_program = apply_prog
+        return apply_prog

@@ -1,0 +1,65 @@
+"""Optimizer-level features beyond the per-op sweep: gradient merge
+(multi_batch_merge_pass capability).
+"""
+
+
+def test_gradient_merge_matches_big_batch():
+    """GradientMergeOptimizer (multi_batch_merge_pass capability): k
+    accumulated micro-batches + one apply == one big-batch SGD step."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    rng = np.random.RandomState(0)
+    xs = rng.rand(8, 6).astype("float32")
+    ys = rng.rand(8, 1).astype("float32")
+
+    def build(seed):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.framework.program_guard(main, startup):
+            startup.random_seed = seed
+            x = layers.data("x", shape=[6])
+            y = layers.data("y", shape=[1])
+            pred = layers.fc(x, 1, bias_attr=False)
+            # sum (not mean) loss so micro-batch grads ADD exactly like
+            # the big batch's
+            loss = layers.reduce_sum(layers.square_error_cost(pred, y))
+        return main, startup, loss
+
+    # reference: one big-batch step
+    main, startup, loss = build(3)
+    with fluid.framework.program_guard(main, startup):
+        fluid.optimizer.SGD(0.01).minimize(loss)
+    pname = main.all_parameters()[0].name
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        w_big = np.asarray(scope.find_var(pname))
+
+    # merged: 4 micro-batches of 2 + one apply (avg=False: grads sum)
+    main2, startup2, loss2 = build(3)
+    with fluid.framework.program_guard(main2, startup2):
+        opt = fluid.optimizer.GradientMergeOptimizer(
+            fluid.optimizer.SGD(0.01), k_steps=4, avg=False)
+        apply_prog = opt.minimize(loss2)
+    pname2 = main2.all_parameters()[0].name
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup2)
+        w0 = np.asarray(scope2.find_var(pname2)).copy()
+        for i in range(4):
+            exe.run(main2, feed={"x": xs[2 * i: 2 * i + 2],
+                                 "y": ys[2 * i: 2 * i + 2]},
+                    fetch_list=[loss2])
+        # params must be untouched until apply
+        np.testing.assert_array_equal(
+            np.asarray(scope2.find_var(pname2)), w0)
+        exe.run(apply_prog)
+        w_merged = np.asarray(scope2.find_var(pname2))
+        # buffers zeroed for the next window
+        acc = np.asarray(scope2.find_var(pname2 + "@GRAD@MERGED"))
+    np.testing.assert_allclose(w_merged, w_big, rtol=1e-5, atol=1e-6)
+    np.testing.assert_array_equal(acc, np.zeros_like(acc))
