@@ -1,12 +1,41 @@
-"""paddle_tpu.incubate — namespace parity.
+"""paddle_tpu.incubate — the experimental/advanced surface.
 
 The reference snapshot (Fluid ~1.x, late 2018) predates the fleet /
-incubate API surface; this package exists so `import paddle_tpu.incubate`
-resolves for forward-compatible user code. The capabilities that later
-moved here already live elsewhere in this framework:
+incubate API; this package is where the TPU-native capabilities that
+later Paddle generations homed under `paddle.incubate` live, re-exported
+from their implementation modules:
 
-- high-level trainer with checkpointing  -> paddle_tpu.contrib.trainer
-- distributed roles/transpile           -> paddle_tpu.transpiler +
-                                           paddle_tpu.distributed
-- mixed precision                       -> paddle_tpu.contrib.mixed_precision
+- gradient merge / accumulation     -> GradientMergeOptimizer
+- sequence/context parallelism      -> ring_attention, ulysses_attention
+- expert parallelism                -> switch_moe (top-1/top-2 GShard)
+- pipeline parallelism              -> pipeline (GPipe + 1F1B schedules)
+- ZeRO-1/3 parameter sharding       -> zero1_rules / zero3_rules
+- mixed precision                   -> rewrite_bf16 / rewrite_fp16
+- high-level trainer w/ checkpoints -> paddle_tpu.contrib.trainer
+- distributed roles/transpile       -> paddle_tpu.transpiler +
+                                       paddle_tpu.distributed
 """
+
+from ..contrib.mixed_precision import rewrite_bf16, rewrite_fp16
+from ..optimizer import GradientMergeOptimizer
+from ..parallel import moe, pipeline, ring, sharding, ulysses
+from ..parallel.sharding import zero1_rules, zero3_rules
+from ..parallel.moe import switch_moe
+from ..parallel.ring import ring_attention
+from ..parallel.ulysses import ulysses_attention
+
+__all__ = [
+    "GradientMergeOptimizer",
+    "rewrite_bf16",
+    "rewrite_fp16",
+    "ring_attention",
+    "ulysses_attention",
+    "switch_moe",
+    "moe",
+    "pipeline",
+    "ring",
+    "ulysses",
+    "sharding",
+    "zero1_rules",
+    "zero3_rules",
+]
