@@ -268,8 +268,13 @@ def _model_bench(name, on_tpu, device):
 
             bs = int(os.environ.get("BENCH_MODEL_BATCH", 32 if on_tpu else 4))
             seq = 64 if on_tpu else 16
+            # lstm_size=512 matches the reference benchmark config
+            # (benchmark/fluid/models/stacked_dynamic_lstm.py:94) and makes
+            # the fused VMEM-resident LSTM kernel lane-eligible
             feeds, loss, _acc = build_stacked_lstm_train(
-                dict_size=10000 if on_tpu else 500, seq_len_max=seq)
+                dict_size=10000 if on_tpu else 500, seq_len_max=seq,
+                emb_dim=512 if on_tpu else 64,
+                hidden_dim=512 if on_tpu else 64)
             fluid.optimizer.Adam(0.001).minimize(loss)
             feed_np = {
                 "words": rng.randint(0, 500, (bs, seq)).astype("int64"),
