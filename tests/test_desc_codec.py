@@ -123,6 +123,47 @@ def test_save_load_inference_model_pb_exec_parity(tmp_path):
     assert desc_codec.looks_like_pb(raw)
 
 
+def test_roundtrip_multiblock_while_program_executes():
+    """Sub-block serialization (the control-flow case): a While program
+    round-trips through the binary codec and still executes to the same
+    result."""
+    import paddle_tpu.layers as layers
+
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        i = layers.fill_constant(shape=[1], dtype="int64", value=0)
+        n = layers.fill_constant(shape=[1], dtype="int64", value=5)
+        acc = layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+        i.stop_gradient = True
+        cond = layers.less_than(i, n)
+        w = layers.While(cond)
+        with w.block():
+            layers.assign(acc + 2.0, acc)
+            layers.increment(i)
+            layers.less_than(i, n, cond=cond)
+    assert main.num_blocks > 1  # the while body is a real sub-block
+
+    data = desc_codec.program_to_bytes(main)
+    back = desc_codec.program_from_bytes(data)
+    assert back.num_blocks == main.num_blocks
+    sub = back.blocks[1]
+    assert [op.type for op in sub.ops] == [
+        op.type for op in main.blocks[1].ops]
+
+    def run(prog):
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            return np.asarray(exe.run(prog, fetch_list=[acc.name])[0])
+
+    np.testing.assert_allclose(run(main), run(back))
+    np.testing.assert_allclose(run(back), [10.0])
+
+    if desc_codec.native_max_version() is not None:
+        ok, msg = desc_codec.native_validate(data)
+        assert ok, msg  # sub-block attr + parent-chain name resolution
+
+
 def test_empty_or_truncated_model_rejected():
     with pytest.raises(ValueError, match="no blocks"):
         desc_codec.program_from_bytes(b"")
