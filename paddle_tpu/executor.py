@@ -153,13 +153,31 @@ class Executor:
         compiled = self._cache.get(program, 0, feed_sig, fetch_names, scope)
         traced = compiled.traced
 
+        def _committed(n, v):
+            # Normalize state to a COMMITTED on-device array.  Startup
+            # outputs are uncommitted (no committed inputs feed them) while
+            # train feeds are device_put -> committed; without this the
+            # first train run flips every param to committed and the jit
+            # cache misses, silently COMPILING THE WHOLE PROGRAM TWICE
+            # (minutes through a TPU tunnel).  Committed same-device
+            # arrays pass through untouched; numpy state (checkpoint
+            # loads) uploads once — the device array is written back to
+            # the scope so read-only weights are not re-uploaded per step.
+            if isinstance(v, jax.Array):
+                if getattr(v, "committed", True) and device in v.devices():
+                    return v
+            elif not isinstance(v, np.ndarray):
+                return v
+            arr = jax.device_put(v, device)
+            scope.set(n, arr)
+            return arr
+
         ro_state = {}
         for n in traced.ro_names:
-            v = scope.find_var(n)
-            ro_state[n] = v
+            ro_state[n] = _committed(n, scope.find_var(n))
         rw_state = {}
         for n in traced.rw_names:
-            rw_state[n] = scope.find_var(n)
+            rw_state[n] = _committed(n, scope.find_var(n))
 
         key = self._rng_key(program)
         from .flags import get_flag

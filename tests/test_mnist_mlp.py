@@ -106,3 +106,33 @@ def test_feed_dtype_kind_mismatch_raises():
     # int32 into int64 stays allowed (width-only difference)
     (v,) = exe.run(feed={"dt_ids": np.zeros((4, 1), "int32")}, fetch_list=[out])
     assert np.isfinite(np.asarray(v)).all()
+
+
+def test_no_hidden_recompile_across_steps():
+    """Each (program, signature) must compile its XLA executable exactly
+    ONCE.  Regression: startup outputs were uncommitted while train feeds
+    were committed, so run 2 flipped every param's committedness and the
+    jit cache silently recompiled the whole program (minutes through a
+    TPU tunnel)."""
+    import numpy as np
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.framework.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[6])
+        y = fluid.layers.data("y", shape=[1], dtype="int64")
+        pred = fluid.layers.fc(x, 4, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, y))
+        fluid.optimizer.Momentum(0.1, momentum=0.9).minimize(loss)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        xv = np.random.rand(3, 6).astype("float32")
+        yv = np.random.randint(0, 4, (3, 1)).astype("int64")
+        for _ in range(3):
+            exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+    for compiled in exe._cache._cache.values():
+        assert compiled.jitted._cache_size() == 1, (
+            "hidden recompile: one ExecutionCache entry compiled %d times"
+            % compiled.jitted._cache_size())
