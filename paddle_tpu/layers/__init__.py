@@ -2,6 +2,7 @@
 
 from . import nn, tensor, ops, io, control_flow, metric_op, math_op_patch, detection
 from . import sequence, learning_rate_scheduler, nn_extras
+from . import layer_function_generator
 from .nn import *  # noqa: F401,F403
 from .nn_extras import *  # noqa: F401,F403
 from .detection import *  # noqa: F401,F403
@@ -12,3 +13,4 @@ from .control_flow import *  # noqa: F401,F403
 from .metric_op import *  # noqa: F401,F403
 from .sequence import *  # noqa: F401,F403
 from .learning_rate_scheduler import *  # noqa: F401,F403
+from .layer_function_generator import *  # noqa: F401,F403

@@ -36,6 +36,7 @@ __all__ = [
     "noam_decay",
     "cosine_decay",
     "linear_lr_warmup",
+    "append_LARS",
 ]
 
 
@@ -150,3 +151,41 @@ def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
     if isinstance(learning_rate, float):
         learning_rate = tensor.fill_constant([1], "float32", learning_rate)
     return m * linear + (1.0 - m) * learning_rate
+
+
+def append_LARS(params_grads, learning_rate, weight_decay):
+    """Layer-wise adaptive rate scaling
+    (learning_rate_scheduler.py:310): per parameter,
+
+        lr_p = lr * |param| / (|grad| + weight_decay * |param|)
+
+    written into `param.optimize_attr["learning_rate"]` as a Variable so
+    the optimizer's per-param LR path picks it up.  Prefer
+    fluid.optimizer.LarsMomentum (the fused momentum+LARS op) for
+    training; this function is the reference-parity scheduler form."""
+    from . import nn
+
+    def _balanced_weight(param_norm, grad_norm):
+        if weight_decay == 1.0:
+            return grad_norm + param_norm
+        return grad_norm + weight_decay * param_norm
+
+    out = []
+    for param, grad in params_grads:
+        if grad is None:
+            continue
+        prog = param.block.program
+        with prog._optimized_guard([param, grad]):
+            param_lr = (param.optimize_attr or {}).get("learning_rate", 1.0)
+            param_norm = ops.sqrt(nn.reduce_sum(ops.square(param)))
+            grad_norm = ops.sqrt(nn.reduce_sum(ops.square(grad)))
+            base = (
+                learning_rate
+                if isinstance(param_lr, float) and param_lr == 1.0
+                else learning_rate * param_lr
+            )
+            decayed_lr = base * param_norm / _balanced_weight(
+                param_norm, grad_norm)
+            param.optimize_attr["learning_rate"] = decayed_lr
+            out.append(decayed_lr)
+    return out

@@ -80,6 +80,10 @@ class Optimizer:
     def _create_param_lr(self, param_and_grad):
         param = param_and_grad[0]
         param_lr = (param.optimize_attr or {}).get("learning_rate", 1.0)
+        if isinstance(param_lr, Variable):
+            # a scheduler wrote a per-param LR variable (append_LARS):
+            # use it directly (optimizer.py reference behavior)
+            return param_lr
         base = self._global_learning_rate()
         if param_lr == 1.0:
             return base
