@@ -131,7 +131,9 @@ def program_to_bytes(program, feed_names=(), fetch_names=(), format_version=None
                 v.is_parameter = True
                 v.trainable = bool(var.trainable)
                 v.optimize_attr.SetInParent()
-                for k, val in (var.optimize_attr or {}).items():
+                ser_attr = framework._serializable_optimize_attr(
+                    var.optimize_attr) or {}
+                for k, val in ser_attr.items():
                     _attr_to_pb(val, v.optimize_attr.v[str(k)])
         for op in block.ops:
             o = b.ops.add()
@@ -210,6 +212,10 @@ def _parse_bytes(data):
                 blk.vars[vd.name] = p
             else:
                 blk.create_var(name=vd.name, **common)
+        for v in blk.vars.values():
+            if isinstance(v, Parameter):
+                v.optimize_attr = framework._resolve_optimize_attr(
+                    v.optimize_attr, blk)
         for od in bd.ops:
             op = Operator(blk, od.type, None, None,
                           {k: _attr_from_pb(v) for k, v in od.attrs.items()})

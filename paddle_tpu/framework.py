@@ -196,7 +196,7 @@ class Parameter(Variable):
         d = super().to_dict()
         d["is_parameter"] = True
         d["trainable"] = self.trainable
-        d["optimize_attr"] = self.optimize_attr
+        d["optimize_attr"] = _serializable_optimize_attr(self.optimize_attr)
         return d
 
 
@@ -284,6 +284,34 @@ def _as_list(x):
     if isinstance(x, (list, tuple)):
         return list(x)
     return [x]
+
+
+def _serializable_optimize_attr(attr):
+    """optimize_attr may hold a Variable (append_LARS writes a per-param
+    LR var): serialize it as a {"__var__": name} marker so to_json and
+    the binary desc codec stay closed over JSON-able values."""
+    if not attr:
+        return attr
+    return {
+        k: {"__var__": v.name} if isinstance(v, Variable) else v
+        for k, v in attr.items()
+    }
+
+
+def _resolve_optimize_attr(attr, block):
+    """Inverse of _serializable_optimize_attr: markers resolve back to
+    the block's Variable once all vars exist (or stay markers when the
+    referenced var was pruned away)."""
+    if not attr:
+        return attr
+    out = {}
+    for k, v in attr.items():
+        if isinstance(v, dict) and set(v) == {"__var__"}:
+            resolved = block._find_var_recursive(v["__var__"])
+            out[k] = resolved if resolved is not None else v
+        else:
+            out[k] = v
+    return out
 
 
 class Block:
@@ -535,6 +563,10 @@ class Program:
                     blk.vars[name] = p
                 else:
                     blk.create_var(name=name, shape=shape, **vd)
+            for v in blk.vars.values():
+                if isinstance(v, Parameter):
+                    v.optimize_attr = _resolve_optimize_attr(
+                        v.optimize_attr, blk)
             for od in bd["ops"]:
                 attrs = {}
                 for k, v in od["attrs"].items():

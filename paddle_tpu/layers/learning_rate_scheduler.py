@@ -163,13 +163,6 @@ def append_LARS(params_grads, learning_rate, weight_decay):
     the optimizer's per-param LR path picks it up.  Prefer
     fluid.optimizer.LarsMomentum (the fused momentum+LARS op) for
     training; this function is the reference-parity scheduler form."""
-    from . import nn
-
-    def _balanced_weight(param_norm, grad_norm):
-        if weight_decay == 1.0:
-            return grad_norm + param_norm
-        return grad_norm + weight_decay * param_norm
-
     out = []
     for param, grad in params_grads:
         if grad is None:
@@ -184,8 +177,8 @@ def append_LARS(params_grads, learning_rate, weight_decay):
                 if isinstance(param_lr, float) and param_lr == 1.0
                 else learning_rate * param_lr
             )
-            decayed_lr = base * param_norm / _balanced_weight(
-                param_norm, grad_norm)
+            decayed_lr = base * param_norm / (
+                grad_norm + weight_decay * param_norm)
             param.optimize_attr["learning_rate"] = decayed_lr
             out.append(decayed_lr)
     return out

@@ -102,6 +102,19 @@ def test_append_lars_per_param_lr():
             for _ in range(5)
         ]
     assert losses[-1] < losses[0]
+    # a Variable in optimize_attr must not poison serialization: to_json
+    # and the binary codec serialize it as a {"__var__": name} marker
+    # that resolves back to the block's Variable on load
+    from paddle_tpu import desc_codec
+
+    back = fluid.Program.from_json(main.to_json())
+    p = back.all_parameters()[0]
+    assert isinstance(p.optimize_attr["learning_rate"],
+                      fluid.framework.Variable)
+    back2 = desc_codec.program_from_bytes(desc_codec.program_to_bytes(main))
+    p2 = back2.all_parameters()[0]
+    assert isinstance(p2.optimize_attr["learning_rate"],
+                      fluid.framework.Variable)
 
 
 def test_generate_layer_fn_builds_working_layers():
