@@ -62,3 +62,24 @@ def test_future_version_refused(tmp_path):
         fluid.load_inference_model(d, exe)
     assert not is_program_version_supported(PROGRAM_FORMAT_VERSION + 1)
     assert is_program_version_supported(0)
+
+
+def test_r3_era_binary_fixture_still_loads():
+    """The committed round-3 binary (protobuf) __model__ must keep
+    loading in every future build — the format-compat contract of the
+    pb path (native/desc.proto), sibling of the JSON r2 fixture."""
+    fixture = os.path.join(os.path.dirname(__file__), "fixtures",
+                           "saved_model_r3_pb")
+    from paddle_tpu import desc_codec
+
+    raw = open(os.path.join(fixture, "__model__"), "rb").read()
+    assert desc_codec.looks_like_pb(raw)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        prog, feeds, fetches = fluid.load_inference_model(fixture, exe)
+        xin = np.arange(8, dtype="float32").reshape(2, 4) / 10.0
+        out = exe.run(prog, feed={feeds[0]: xin}, fetch_list=fetches)
+    expected = np.load(fixture + "_expected.npy")
+    np.testing.assert_allclose(np.asarray(out[0]), expected,
+                               rtol=1e-5, atol=1e-6)
