@@ -46,6 +46,30 @@ def test_native_predictor_parity(tmp_path):
     assert len(pred.get_output_names()) == 1
 
 
+def test_analysis_predictor_serves_binary_model(tmp_path):
+    """The serving path is format-agnostic: a binary (protobuf) __model__
+    loads through the same predictor API with identical outputs."""
+    import paddle_tpu.layers as layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.framework.program_guard(main, startup):
+        startup.random_seed = 5
+        img = layers.data("img", shape=[6])
+        pred = layers.fc(layers.fc(img, 8, act="relu"), 3, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        d = str(tmp_path / "pbm")
+        fluid.save_inference_model(d, ["img"], [pred], exe,
+                                   main_program=main, model_format="pb")
+        x = np.random.RandomState(2).rand(4, 6).astype("float32")
+        (ref,) = exe.run(main, feed={"img": x}, fetch_list=[pred])
+    p = create_paddle_predictor(AnalysisConfig(d))
+    (out,) = p.run({"img": x})
+    np.testing.assert_allclose(out, np.asarray(ref), rtol=1e-5, atol=1e-6)
+
+
 def test_analysis_predictor_parity_and_fusion(tmp_path):
     model_dir, x, ref = _train_and_save(tmp_path)
     pred = create_paddle_predictor(AnalysisConfig(model_dir))
