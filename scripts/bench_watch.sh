@@ -1,0 +1,35 @@
+#!/bin/bash
+# TPU tunnel watcher: probe every 8 min; on recovery run (1) the default
+# full bench -> BENCH_R03_TPU.json, (2) the pallas-flash transformer diag.
+cd /root/repo
+for i in $(seq 1 60); do
+  if env BENCH_PROBE_TIMEOUT=120 python - <<'EOF' 2>/dev/null
+import os, sys, subprocess, signal
+proc = subprocess.Popen(["python", "bench.py"],
+    env=dict(os.environ, _BENCH_PROBE="1"),
+    stdout=subprocess.PIPE, stderr=subprocess.STDOUT, start_new_session=True)
+try:
+    out, _ = proc.communicate(timeout=120)
+    sys.exit(0 if b"PROBE_DEVICES" in out else 1)
+except subprocess.TimeoutExpired:
+    try: os.killpg(proc.pid, signal.SIGKILL)
+    except Exception: pass
+    try: proc.communicate(timeout=10)
+    except Exception: pass
+    sys.exit(1)
+EOF
+  then
+    echo "$(date -u +%H:%M) tunnel alive - capturing" >> /tmp/tpu_watch.log
+    python bench.py > /tmp/bench_full_new.out 2>> /tmp/tpu_watch.log
+    if grep -q '"mfu"' /tmp/bench_full_new.out; then
+      cp /tmp/bench_full_new.out /root/repo/BENCH_R03_TPU.json
+      echo "$(date -u +%H:%M) BENCH_R03_TPU.json updated" >> /tmp/tpu_watch.log
+    fi
+    env BENCH_ONLY=transformer FLAGS_use_pallas=1 python bench.py \
+      > /tmp/tfm_flash_watch.out 2>> /tmp/tpu_watch.log
+    echo "$(date -u +%H:%M) flash diag done" >> /tmp/tpu_watch.log
+    exit 0
+  fi
+  echo "$(date -u +%H:%M) probe $i failed" >> /tmp/tpu_watch.log
+  sleep 480
+done

@@ -1,0 +1,57 @@
+"""NCHW vs NHWC conv-trunk micro-benchmark on the real chip.
+
+Times a ResNet-ish conv+BN+relu stack (fwd+bwd) in both layouts at
+bs128/224px bf16. If NHWC wins decisively, a layout pass (transpose at
+program boundaries, NHWC dimension_numbers inside) is worth building.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+dev = jax.devices()[0]
+print("device:", dev)
+
+
+def conv_stack(layout):
+    dn = (layout, "OIHW" if layout == "NCHW" else "HWIO", layout)
+
+    def f(x, ws):
+        for w in ws:
+            x = jax.lax.conv_general_dilated(
+                x, w, (1, 1), "SAME", dimension_numbers=dn)
+            # BN-ish: per-channel scale + relu (bandwidth term)
+            x = jax.nn.relu(x * 1.01 + 0.01)
+        return jnp.sum(x.astype(jnp.float32))
+
+    return f
+
+
+def bench(layout, ch=128, depth=8, bs=64, hw=112):
+    rng = np.random.RandomState(0)
+    if layout == "NCHW":
+        x = jnp.asarray(rng.randn(bs, ch, hw, hw), jnp.bfloat16)
+        ws = [jnp.asarray(rng.randn(ch, ch, 3, 3) * 0.05, jnp.bfloat16)
+              for _ in range(depth)]
+    else:
+        x = jnp.asarray(rng.randn(bs, hw, hw, ch), jnp.bfloat16)
+        ws = [jnp.asarray(rng.randn(3, 3, ch, ch) * 0.05, jnp.bfloat16)
+              for _ in range(depth)]
+    f = conv_stack(layout)
+    g = jax.jit(jax.grad(f, argnums=0))
+    out = g(x, ws)
+    jax.block_until_ready(out)
+    t0 = time.time()
+    for _ in range(10):
+        out = g(x, ws)
+    jax.block_until_ready(out)
+    dt = (time.time() - t0) / 10
+    flops = 2 * 3 * bs * hw * hw * ch * ch * 3 * 3 * depth  # fwd+2x bwd
+    print("%s: %.1f ms/step  %.1f TFLOP/s" % (layout, dt * 1e3, flops / dt / 1e12))
+    return dt
+
+
+d1 = bench("NCHW")
+d2 = bench("NHWC")
+print("NHWC speedup: %.2fx" % (d1 / d2))
