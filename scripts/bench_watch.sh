@@ -1,33 +1,29 @@
 #!/bin/bash
-# TPU tunnel watcher: probe every 8 min; on recovery run (1) the default
-# full bench -> BENCH_R03_TPU.json, (2) the pallas-flash transformer diag.
-cd /root/repo
-for i in $(seq 1 60); do
-  if env BENCH_PROBE_TIMEOUT=120 python - <<'EOF' 2>/dev/null
-import os, sys, subprocess, signal
-proc = subprocess.Popen(["python", "bench.py"],
-    env=dict(os.environ, _BENCH_PROBE="1"),
-    stdout=subprocess.PIPE, stderr=subprocess.STDOUT, start_new_session=True)
-try:
-    out, _ = proc.communicate(timeout=120)
-    sys.exit(0 if b"PROBE_DEVICES" in out else 1)
-except subprocess.TimeoutExpired:
-    try: os.killpg(proc.pid, signal.SIGKILL)
-    except Exception: pass
-    try: proc.communicate(timeout=10)
-    except Exception: pass
-    sys.exit(1)
-EOF
-  then
+# TPU tunnel watcher: probe every 8 min; on recovery capture in order:
+# (1) default full bench -> BENCH_R03_TPU.json, (2) pallas-flash
+# transformer diag, (3) reader-overlap resnet, (4) bs256 resnet,
+# (5) NHWC conv-layout micro-trial.  The probe reuses bench.py's
+# group-killable probe child (_BENCH_PROBE=1) under timeout(1) so a
+# wedged tunnel costs 120s per attempt and never leaves a child
+# holding the chip.
+cd "$(dirname "$0")/.."
+for i in $(seq 1 70); do
+  if env _BENCH_PROBE=1 timeout -k 10 120 python bench.py 2>/dev/null | grep -q PROBE_DEVICES; then
     echo "$(date -u +%H:%M) tunnel alive - capturing" >> /tmp/tpu_watch.log
     python bench.py > /tmp/bench_full_new.out 2>> /tmp/tpu_watch.log
     if grep -q '"mfu"' /tmp/bench_full_new.out; then
-      cp /tmp/bench_full_new.out /root/repo/BENCH_R03_TPU.json
+      cp /tmp/bench_full_new.out BENCH_R03_TPU.json
       echo "$(date -u +%H:%M) BENCH_R03_TPU.json updated" >> /tmp/tpu_watch.log
     fi
     env BENCH_ONLY=transformer FLAGS_use_pallas=1 python bench.py \
       > /tmp/tfm_flash_watch.out 2>> /tmp/tpu_watch.log
     echo "$(date -u +%H:%M) flash diag done" >> /tmp/tpu_watch.log
+    env BENCH_READER=1 python bench.py > /tmp/bench_reader.out 2>> /tmp/tpu_watch.log
+    echo "$(date -u +%H:%M) reader leg done" >> /tmp/tpu_watch.log
+    env BENCH_BATCH=256 python bench.py > /tmp/bench_bs256.out 2>> /tmp/tpu_watch.log
+    echo "$(date -u +%H:%M) bs256 leg done" >> /tmp/tpu_watch.log
+    timeout -k 10 900 python scripts/nhwc_trial.py > /tmp/nhwc_trial.out 2>&1
+    echo "$(date -u +%H:%M) nhwc trial done - watcher exiting" >> /tmp/tpu_watch.log
     exit 0
   fi
   echo "$(date -u +%H:%M) probe $i failed" >> /tmp/tpu_watch.log

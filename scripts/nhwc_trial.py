@@ -1,8 +1,11 @@
 """NCHW vs NHWC conv-trunk micro-benchmark on the real chip.
 
-Times a ResNet-ish conv+BN+relu stack (fwd+bwd) in both layouts at
-bs128/224px bf16. If NHWC wins decisively, a layout pass (transpose at
-program boundaries, NHWC dimension_numbers inside) is worth building.
+Times a ResNet-ish conv+BN+relu stack (fwd + input-grad bwd) in both
+layouts at bs64/112px/ch128 bf16. If NHWC wins decisively, a layout pass
+(transpose at program boundaries, NHWC dimension_numbers inside) is
+worth building.  Only the dx convolutions run in the backward (grad wrt
+the input alone; the dw convs are dead-code-eliminated), so each layer
+executes 2 convs per step and the FLOPs formula uses factor 2.
 """
 import time
 
@@ -47,7 +50,7 @@ def bench(layout, ch=128, depth=8, bs=64, hw=112):
         out = g(x, ws)
     jax.block_until_ready(out)
     dt = (time.time() - t0) / 10
-    flops = 2 * 3 * bs * hw * hw * ch * ch * 3 * 3 * depth  # fwd+2x bwd
+    flops = 2 * 2 * bs * hw * hw * ch * ch * 3 * 3 * depth  # fwd + dx bwd
     print("%s: %.1f ms/step  %.1f TFLOP/s" % (layout, dt * 1e3, flops / dt / 1e12))
     return dt
 

@@ -728,3 +728,93 @@ def test_transformer_block_pipeline_1f1b():
                     jax.tree_util.tree_leaves(grads_ref)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-3, atol=2e-4)
+
+
+def test_sharded_checkpoint_save_restore_and_reshard(tmp_path):
+    """DistributedExecutor.save_sharded/load_sharded (the ICI-path analog
+    of pserver shard checkpoints): per-shard files, no host gather;
+    restore resumes training exactly, INCLUDING onto a different mesh
+    layout (resharding assembly path)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.framework.program_guard(main, startup):
+        startup.random_seed = 31
+        img = layers.data("img", shape=[32])
+        label = layers.data("label", shape=[1], dtype="int64")
+        hidden = layers.fc(img, size=64, act="relu")
+        pred = layers.fc(hidden, size=4, act="softmax")
+        loss = layers.mean(layers.cross_entropy(pred, label))
+        fluid.optimizer.Momentum(0.1, 0.9).minimize(loss)
+
+    rng = np.random.RandomState(1)
+    x = rng.rand(16, 32).astype("float32")
+    y = rng.randint(0, 4, (16, 1)).astype("int64")
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        mesh = parallel.make_mesh({"dp": 2, "mp": 4})
+        rules = parallel.zero3_rules("mp")
+        dexe = parallel.DistributedExecutor(
+            mesh, rules, main_program=main, scope=scope)
+        for _ in range(2):
+            dexe.run([loss], feed={"img": x, "label": y})
+        ckpt = str(tmp_path / "ck")
+        saved = dexe.save_sharded(ckpt)
+        assert saved  # persistables written
+        # a sharded param must be stored as multiple shard files
+        import json as _json
+        index = _json.load(open(ckpt + "/index.0.json"))
+        w_entries = [e for n, e in index.items() if "fc" in n and ".w_" in n]
+        assert any(len(e["shards"]) > 1 for e in w_entries), (
+            "expected at least one param stored as true shards")
+        ref = [float(np.asarray(dexe.run(
+            [loss], feed={"img": x, "label": y})[0]).ravel()[0])
+            for _ in range(2)]
+
+    # restore into a FRESH scope on the same layout: training resumes
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        mesh2 = parallel.make_mesh({"dp": 2, "mp": 4})
+        dexe2 = parallel.DistributedExecutor(
+            mesh2, parallel.zero3_rules("mp"), main_program=main,
+            scope=scope2)
+        dexe2.load_sharded(ckpt)
+        got = [float(np.asarray(dexe2.run(
+            [loss], feed={"img": x, "label": y})[0]).ravel()[0])
+            for _ in range(2)]
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+    # resharding restore: different mesh split (mp=2) reads the same
+    # checkpoint through the assembly fallback
+    scope3 = fluid.Scope()
+    with fluid.scope_guard(scope3):
+        mesh3 = parallel.make_mesh({"dp": 4, "mp": 2})
+        dexe3 = parallel.DistributedExecutor(
+            mesh3, parallel.zero3_rules("mp"), main_program=main,
+            scope=scope3)
+        dexe3.load_sharded(ckpt)
+        got3 = [float(np.asarray(dexe3.run(
+            [loss], feed={"img": x, "label": y})[0]).ravel()[0])
+            for _ in range(2)]
+    np.testing.assert_allclose(got3, ref, rtol=1e-4, atol=1e-5)
+
+    # an incomplete checkpoint must raise, never restore zero-filled
+    # weights: delete one shard of a truly-sharded param and reshard-load
+    import os as _os
+    victim = None
+    for n, e in index.items():
+        if len(e["shards"]) > 1:
+            victim = e["shards"][0]["file"]
+            break
+    assert victim is not None
+    _os.remove(_os.path.join(ckpt, victim))
+    scope4 = fluid.Scope()
+    with fluid.scope_guard(scope4):
+        mesh4 = parallel.make_mesh({"dp": 4, "mp": 2})
+        dexe4 = parallel.DistributedExecutor(
+            mesh4, parallel.zero3_rules("mp"), main_program=main,
+            scope=scope4)
+        with pytest.raises(IOError):
+            dexe4.load_sharded(ckpt)
+            dexe4.run([loss], feed={"img": x, "label": y})
