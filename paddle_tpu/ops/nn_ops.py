@@ -722,7 +722,27 @@ def _fused_attention(ctx, ins, attrs):
         # the [Tq, Tk] score matrix
         kbias = ins["Bias"][0].reshape(b, tk).astype(jnp.float32)
         kbias = jnp.broadcast_to(kbias[:, None, :], (b, h, tk)).reshape(b * h, tk)
-    if use_pallas() and t % 128 == 0 and tk % 128 == 0:
+    from ..flags import get_flag
+
+    bq_flag = int(get_flag("flash_block_q") or 0)
+    bk_flag = int(get_flag("flash_block_k") or 0)
+    if use_pallas() and (bq_flag or bk_flag):
+        # explicit sweep knobs: validate loudly — a silently-ignored
+        # flag would attribute block-8 timings to the requested size
+        bq = bq_flag or 128
+        bk = bk_flag or 128
+        if bq <= 0 or bq % 8 != 0 or bk <= 0 or bk % 128 != 0:
+            raise ValueError(
+                "FLAGS_flash_block_q must be a positive multiple of 8 and "
+                "FLAGS_flash_block_k a positive multiple of 128 (got %d, %d)"
+                % (bq, bk))
+        if t % bq != 0 or tk % bk != 0:
+            raise ValueError(
+                "flash block sizes (%d, %d) must divide the sequence "
+                "lengths (%d, %d)" % (bq, bk, t, tk))
+        out = flash_attention(qf, kf, vf, kbias, causal, float(scale),
+                              block_q=bq, block_k=bk, window=window)
+    elif use_pallas() and t % 128 == 0 and tk % 128 == 0:
         out = flash_attention(qf, kf, vf, kbias, causal, float(scale),
                               window=window)
     elif use_pallas() and min(t, tk) >= 8 and t % 8 == 0 and tk % 8 == 0:
