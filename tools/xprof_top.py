@@ -1,0 +1,101 @@
+"""Offline xplane-trace analyzer: top ops by device time.
+
+The on-chip attribution step of the MFU plan (docs/PERF.md): run the
+bench with `BENCH_PROFILE=/tmp/xprof`, then
+
+    PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python \
+        python tools/xprof_top.py /tmp/xprof [-n 20]
+
+No tensorboard server needed — parses the raw `*.xplane.pb` with the
+bundled tsl proto (tools/timeline.py's device-side sibling; the
+device_tracer.h 'which kernels ate the step' role).
+"""
+
+import argparse
+import collections
+import glob
+import os
+import sys
+
+
+def load_xspaces(path):
+    os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    files = sorted(glob.glob(os.path.join(path, "**", "*.xplane.pb"),
+                             recursive=True))
+    if not files and os.path.isfile(path):
+        files = [path]
+    spaces = []
+    for f in files:
+        xs = xplane_pb2.XSpace()
+        with open(f, "rb") as fh:
+            xs.ParseFromString(fh.read())
+        spaces.append((f, xs))
+    return spaces
+
+
+def _plane_totals(plane):
+    totals = collections.Counter()
+    span_ps = 0
+    meta = {m_id: m.name for m_id, m in plane.event_metadata.items()}
+    for line in plane.lines:
+        for ev in line.events:
+            totals[meta.get(ev.metadata_id, "?")] += ev.duration_ps
+            span_ps = max(span_ps, ev.offset_ps + ev.duration_ps)
+    return totals, span_ps
+
+
+def device_op_totals(xspace):
+    """{op name: total_ps} summed over device-plane lines (XLA ops);
+    falls back to the busiest plane when no TPU/GPU plane exists (CPU
+    traces)."""
+    totals = collections.Counter()
+    device_ps = 0
+    for plane in xspace.planes:
+        name = plane.name.lower()
+        if not ("tpu" in name or "/device:" in name or "gpu" in name):
+            continue
+        t, s = _plane_totals(plane)
+        totals.update(t)
+        device_ps = max(device_ps, s)
+    if not totals:
+        best = None
+        for plane in xspace.planes:
+            t, s = _plane_totals(plane)
+            if best is None or sum(t.values()) > sum(best[0].values()):
+                best = (t, s, plane.name)
+        if best and sum(best[0].values()):
+            print("(no device plane; using busiest plane %r)" % best[2])
+            return best[0], best[1]
+    return totals, device_ps
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("path", help="profile dir (or one .xplane.pb file)")
+    ap.add_argument("-n", type=int, default=20, help="top-N ops")
+    args = ap.parse_args(argv)
+
+    spaces = load_xspaces(args.path)
+    if not spaces:
+        print("no *.xplane.pb under %s" % args.path)
+        return 1
+    for fname, xs in spaces:
+        totals, span_ps = device_op_totals(xs)
+        if not totals:
+            continue
+        busy_ps = sum(totals.values())
+        print("== %s" % os.path.basename(fname))
+        print("device busy %.2f ms over a %.2f ms span (%.0f%% occupancy)"
+              % (busy_ps / 1e9, span_ps / 1e9,
+                 100.0 * busy_ps / span_ps if span_ps else 0.0))
+        width = max(len(n) for n, _ in totals.most_common(args.n))
+        for name, ps in totals.most_common(args.n):
+            print("  %-*s %9.3f ms  %5.1f%%"
+                  % (width, name, ps / 1e9, 100.0 * ps / busy_ps))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
