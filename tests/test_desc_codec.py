@@ -89,6 +89,23 @@ def test_roundtrip_attr_kinds():
     assert isinstance(attrs["b_true"], bool)
 
 
+def test_roundtrip_nonnative_dtype_ndarray_attr():
+    """bfloat16 ndarray attrs ride the raw-bytes path with the dtype name
+    (np.save/np.load would void-ify them; the codec must not)."""
+    import ml_dtypes
+
+    prog = Program()
+    blk = prog.global_block()
+    blk.create_var(name="x", shape=[2], dtype="float32")
+    arr = np.arange(4, dtype=ml_dtypes.bfloat16).reshape(2, 2) * 0.5
+    blk.append_op("fake", {"X": ["x"]}, {"Out": ["x"]}, {"w": arr})
+    back = desc_codec.program_from_bytes(desc_codec.program_to_bytes(prog))
+    got = back.global_block().ops[0].attrs["w"]
+    assert got.dtype == arr.dtype
+    np.testing.assert_array_equal(got.astype("float32"),
+                                  arr.astype("float32"))
+
+
 def test_save_load_inference_model_pb_exec_parity(tmp_path):
     main, startup, loss = _build_train_program()
     scope = fluid.Scope()
