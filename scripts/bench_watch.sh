@@ -18,6 +18,12 @@ for i in $(seq 1 70); do
     env BENCH_ONLY=transformer FLAGS_use_pallas=1 python bench.py \
       > /tmp/tfm_flash_watch.out 2>> /tmp/tpu_watch.log
     echo "$(date -u +%H:%M) flash diag done" >> /tmp/tpu_watch.log
+    env BENCH_PROFILE=/tmp/xprof_tpu python bench.py \
+      > /tmp/bench_profiled.out 2>> /tmp/tpu_watch.log
+    env PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python \
+      python tools/xprof_top.py /tmp/xprof_tpu -n 25 \
+      > /tmp/xprof_top.out 2>&1
+    echo "$(date -u +%H:%M) profiled capture done" >> /tmp/tpu_watch.log
     env BENCH_READER=1 python bench.py > /tmp/bench_reader.out 2>> /tmp/tpu_watch.log
     echo "$(date -u +%H:%M) reader leg done" >> /tmp/tpu_watch.log
     env BENCH_BATCH=256 python bench.py > /tmp/bench_bs256.out 2>> /tmp/tpu_watch.log
