@@ -171,18 +171,21 @@ def _parse_bytes(data):
         msg.ParseFromString(bytes(data))
     except Exception as e:
         raise ValueError("not a valid binary ProgramDesc: %s" % (e,))
+    # version gate FIRST (matching desc_codec.cc's order): a future
+    # format that moved/changed the blocks field must report "newer than
+    # this build supports", not "empty or truncated"
+    if not io_mod.is_program_version_supported(msg.format_version):
+        raise RuntimeError(
+            "saved model format version %s is newer than this build "
+            "supports (max %s) — upgrade paddle_tpu to load it"
+            % (msg.format_version, io_mod.PROGRAM_FORMAT_VERSION)
+        )
     if not msg.blocks:
         # an empty/truncated file parses as an empty message — fail HERE
         # with a load-time error, not later with a bare IndexError
         raise ValueError(
             "not a valid binary ProgramDesc: no blocks (empty or truncated "
             "__model__ file)"
-        )
-    if not io_mod.is_program_version_supported(msg.format_version):
-        raise RuntimeError(
-            "saved model format version %s is newer than this build "
-            "supports (max %s) — upgrade paddle_tpu to load it"
-            % (msg.format_version, io_mod.PROGRAM_FORMAT_VERSION)
         )
     program = Program()
     program._seed = int(msg.random_seed)

@@ -22,13 +22,48 @@ _build_failed = False
 _build_error = None  # diagnostics when the toolchain/compile fails
 
 
+def _missing_protobuf(err):
+    """True when a full-build failure looks like an absent protobuf
+    toolchain (the one condition the `nodesc` fallback exists for) —
+    NOT a genuine compile error in the codec sources, which must
+    surface instead of silently shipping a library without the codec."""
+    low = (err or "").lower()
+    # missing-toolchain-specific patterns only: a genuine codec compile
+    # error also mentions protobuf headers (g++ notes cite
+    # google/protobuf/*.h), so bare substrings would misclassify it
+    return any(
+        s in low
+        for s in (
+            "protoc: not found",
+            "protoc: command not found",
+            "protoc: no such file",
+            "fatal error: google/protobuf",  # header include missing
+            "cannot find -lprotobuf",  # linker: library missing
+        )
+    )
+
+
 def _try_build():
     global _build_failed, _build_error
     # `make -s` is a fast no-op when the .so is newer than the sources,
     # and rebuilds after source edits (stale-library trap avoided).
     # Hosts without libprotobuf/protoc fall back to the `nodesc` target:
     # every native piece except the desc codec.
+    compile_failed = False
     for target in ([], ["nodesc"]):
+        if target:
+            if compile_failed and not _missing_protobuf(_build_error):
+                break  # real compile error — don't mask it with nodesc
+            if _missing_protobuf(_build_error):
+                import warnings
+
+                warnings.warn(
+                    "paddle_tpu.native: protobuf toolchain missing — "
+                    "building without the desc codec (nodesc)",
+                    RuntimeWarning)
+            # non-compile failures (timeout, missing make) still retry
+            # nodesc: the smaller target may succeed where the full one
+            # didn't, matching the pre-guard behavior
         try:
             subprocess.run(
                 ["make", "-s"] + target,
@@ -37,11 +72,14 @@ def _try_build():
                 capture_output=True,
                 timeout=120,
             )
+            _build_error = None  # success: drop the failed-attempt log
             return True
         except subprocess.CalledProcessError as e:
             _build_error = (e.stderr or e.stdout or b"").decode(errors="replace")
+            compile_failed = True
         except Exception as e:
             _build_error = repr(e)
+            compile_failed = False
     _build_failed = True
     return False
 

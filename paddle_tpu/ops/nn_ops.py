@@ -726,30 +726,45 @@ def _fused_attention(ctx, ins, attrs):
 
     bq_flag = int(get_flag("flash_block_q") or 0)
     bk_flag = int(get_flag("flash_block_k") or 0)
+
+    def _mosaic_legal(bq, bk):
+        # Mosaic BlockSpec rule: a block lands in the MINOR dim of the
+        # lifted [BH, 1, X] lse/delta specs ((1, 1, block_q)) and the
+        # kbias spec ((1, 1, block_k)), where it must be a multiple of
+        # 128 or cover the full dimension.  (Interpret mode does not
+        # enforce this; only a real-chip compile does.)
+        return ((bq % 128 == 0 or bq == t) and t % bq == 0
+                and (bk % 128 == 0 or bk == tk) and tk % bk == 0)
+
     if use_pallas() and (bq_flag or bk_flag):
         # explicit sweep knobs: validate loudly — a silently-ignored
-        # flag would attribute block-8 timings to the requested size
+        # flag would attribute fallback timings to the requested size
         bq = bq_flag or 128
         bk = bk_flag or 128
-        if bq <= 0 or bq % 8 != 0 or bk <= 0 or bk % 128 != 0:
+        if bq <= 0 or bk <= 0 or not _mosaic_legal(bq, bk):
             raise ValueError(
-                "FLAGS_flash_block_q must be a positive multiple of 8 and "
-                "FLAGS_flash_block_k a positive multiple of 128 (got %d, %d)"
-                % (bq, bk))
-        if t % bq != 0 or tk % bk != 0:
-            raise ValueError(
-                "flash block sizes (%d, %d) must divide the sequence "
-                "lengths (%d, %d)" % (bq, bk, t, tk))
+                "FLAGS_flash_block_q/k (%d, %d) are not Mosaic-legal for "
+                "Tq=%d, Tk=%d: each block must divide its sequence length "
+                "and be a multiple of 128 (or equal the full length) — "
+                "the lse/delta/kbias BlockSpecs place the block in the "
+                "minor dim" % (bq, bk, t, tk))
         out = flash_attention(qf, kf, vf, kbias, causal, float(scale),
                               block_q=bq, block_k=bk, window=window)
-    elif use_pallas() and t % 128 == 0 and tk % 128 == 0:
-        out = flash_attention(qf, kf, vf, kbias, causal, float(scale),
-                              window=window)
-    elif use_pallas() and min(t, tk) >= 8 and t % 8 == 0 and tk % 8 == 0:
-        out = flash_attention(
-            qf, kf, vf, kbias, causal, float(scale), block_q=8, block_k=8,
-            window=window
-        )
+    elif use_pallas():
+        # auto path: 128-blocks when the lengths tile; otherwise a
+        # single full-dim block is still Mosaic-legal, so short or odd
+        # lengths ride flash too as long as the [bq, bk] score tile
+        # stays VMEM-friendly.  Anything else goes dense.
+        bq = 128 if t % 128 == 0 else t
+        bk = 128 if tk % 128 == 0 else tk
+        # this derivation is Mosaic-legal by construction (each block is
+        # 128-tiling or full-dim); only the VMEM score-tile budget gates
+        if bq <= 512 and bk <= 1024:
+            out = flash_attention(qf, kf, vf, kbias, causal, float(scale),
+                                  block_q=bq, block_k=bk, window=window)
+        else:
+            out = _dense_attention(qf, kf, vf, causal, float(scale), kbias,
+                                   window=window)
     else:
         out = _dense_attention(qf, kf, vf, causal, float(scale), kbias,
                                window=window)
