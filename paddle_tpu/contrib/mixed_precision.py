@@ -196,10 +196,11 @@ def propagate_half_through_trunk(program, dtype="bfloat16"):
     def _is_broadcast_bias(xn, yn):
         """True when Y is a true bias operand broadcast onto X: lower
         rank (fluid-style axis-broadcast FC/conv bias, e.g. [D] or [C]),
-        or same rank with at most ONE non-1 dim and every dim either 1
-        or matching X (channel bias [1,C,1,1], feature bias [1,1,D]).
-        Partially-broadcast f32 ACTIVATIONS — a [B,T,1] gate or [B,1,D]
-        mask has two or more non-1 dims — keep their f32 contract."""
+        or same rank with at most ONE non-1 dim — which must not be the
+        batch dim — and every dim either 1 or matching X (channel bias
+        [1,C,1,1], feature bias [1,1,D]).  Partially-broadcast f32
+        ACTIVATIONS — a [B,T,1] gate, [B,1,D] mask, or [B,1,1]
+        per-sample scalar — keep their f32 contract."""
         xv = block._find_var_recursive(xn)
         yv = block._find_var_recursive(yn)
         if xv is None or yv is None or xv.shape is None or yv.shape is None:
@@ -213,7 +214,8 @@ def propagate_half_through_trunk(program, dtype="bfloat16"):
             return False
         if any(yd not in (1, xd) for yd, xd in zip(ys, xs)):
             return False
-        return sum(1 for yd in ys if yd != 1) <= 1
+        non1 = [i for i, yd in enumerate(ys) if yd != 1]
+        return len(non1) <= 1 and 0 not in non1
 
     for op in block.ops:
         spec = _TRANSPARENT_OPS.get(op.type)

@@ -105,11 +105,14 @@ DEFINE_flag("use_pallas", False,
             "kernel library instead of plain XLA lowerings")
 DEFINE_flag("flash_block_q", 0,
             "flash-attention q-block rows (0 = the kernel default 128); "
-            "on-chip sweep knob: a positive multiple of 8 that divides "
-            "the q sequence length (invalid values raise)")
+            "on-chip sweep knob: a multiple of 128 (or the full q "
+            "length) that divides the q sequence length — the Mosaic "
+            "minor-dim rule for the lse/delta specs (invalid values "
+            "raise)")
 DEFINE_flag("flash_block_k", 0,
             "flash-attention k-block columns (0 = default 128); a "
-            "positive multiple of 128 dividing the k sequence length")
+            "multiple of 128 (or the full k length) dividing the k "
+            "sequence length")
 DEFINE_flag("tpu_bf16_matmul", False,
             "reserved: AMP is the explicit contrib.mixed_precision."
             "rewrite_bf16() program rewrite, not a global flag yet")

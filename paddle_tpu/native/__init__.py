@@ -53,7 +53,16 @@ def _try_build():
     for target in ([], ["nodesc"]):
         if target:
             if compile_failed and not _missing_protobuf(_build_error):
-                break  # real compile error — don't mask it with nodesc
+                # real compile error — don't mask it with nodesc, but
+                # don't fail silently either: callers only see
+                # available()==False unless told to check build_error()
+                import warnings
+
+                warnings.warn(
+                    "paddle_tpu.native: native build failed with a "
+                    "compile error (see paddle_tpu.native.build_error())"
+                    " — native features disabled", RuntimeWarning)
+                break
             if _missing_protobuf(_build_error):
                 import warnings
 

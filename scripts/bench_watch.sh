@@ -1,35 +1,37 @@
 #!/bin/bash
-# TPU tunnel watcher: probe every 8 min; on recovery capture in order:
-# (1) default full bench -> BENCH_R03_TPU.json, (2) pallas-flash
-# transformer diag, (3) reader-overlap resnet, (4) bs256 resnet,
-# (5) NHWC conv-layout micro-trial.  The probe reuses bench.py's
-# group-killable probe child (_BENCH_PROBE=1) under timeout(1) so a
-# wedged tunnel costs 120s per attempt and never leaves a child
-# holding the chip.
+# TPU tunnel watcher (round 4): probe every 8 min; on recovery capture
+# in order: (1) default full bench -> BENCH_R04_TPU.json, (2) pallas-
+# flash transformer A/B, (3) profiled run + top-ops dump, (4) reader-
+# overlap resnet, (5) bs256 resnet, (6) NHWC conv-layout micro-trial.
+# The probe reuses bench.py's group-killable probe child (_BENCH_PROBE=1)
+# under timeout(1) so a wedged tunnel costs 120s per attempt and never
+# leaves a child holding the chip.  Writes /tmp/r04_capture_done when
+# the whole sequence finished so follow-up sweeps know to start.
 cd "$(dirname "$0")/.."
-for i in $(seq 1 70); do
+for i in $(seq 1 85); do
   if env _BENCH_PROBE=1 timeout -k 10 120 python bench.py 2>/dev/null | grep -q PROBE_DEVICES; then
     echo "$(date -u +%H:%M) tunnel alive - capturing" >> /tmp/tpu_watch.log
     python bench.py > /tmp/bench_full_new.out 2>> /tmp/tpu_watch.log
     if grep -q '"mfu"' /tmp/bench_full_new.out; then
-      cp /tmp/bench_full_new.out BENCH_R03_TPU.json
-      echo "$(date -u +%H:%M) BENCH_R03_TPU.json updated" >> /tmp/tpu_watch.log
+      cp /tmp/bench_full_new.out BENCH_R04_TPU.json
+      echo "$(date -u +%H:%M) BENCH_R04_TPU.json updated" >> /tmp/tpu_watch.log
     fi
     env BENCH_ONLY=transformer FLAGS_use_pallas=1 python bench.py \
-      > /tmp/tfm_flash_watch.out 2>> /tmp/tpu_watch.log
-    echo "$(date -u +%H:%M) flash diag done" >> /tmp/tpu_watch.log
+      > /tmp/r04_tfm_flash.out 2>> /tmp/tpu_watch.log
+    echo "$(date -u +%H:%M) flash A/B done" >> /tmp/tpu_watch.log
     env BENCH_PROFILE=/tmp/xprof_tpu python bench.py \
-      > /tmp/bench_profiled.out 2>> /tmp/tpu_watch.log
+      > /tmp/r04_profiled.out 2>> /tmp/tpu_watch.log
     env PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python \
       python tools/xprof_top.py /tmp/xprof_tpu -n 25 \
-      > /tmp/xprof_top.out 2>&1
+      > /tmp/r04_xprof_top.out 2>&1
     echo "$(date -u +%H:%M) profiled capture done" >> /tmp/tpu_watch.log
-    env BENCH_READER=1 python bench.py > /tmp/bench_reader.out 2>> /tmp/tpu_watch.log
+    env BENCH_READER=1 python bench.py > /tmp/r04_reader.out 2>> /tmp/tpu_watch.log
     echo "$(date -u +%H:%M) reader leg done" >> /tmp/tpu_watch.log
-    env BENCH_BATCH=256 python bench.py > /tmp/bench_bs256.out 2>> /tmp/tpu_watch.log
+    env BENCH_BATCH=256 python bench.py > /tmp/r04_bs256.out 2>> /tmp/tpu_watch.log
     echo "$(date -u +%H:%M) bs256 leg done" >> /tmp/tpu_watch.log
-    timeout -k 10 900 python scripts/nhwc_trial.py > /tmp/nhwc_trial.out 2>&1
+    timeout -k 10 900 python scripts/nhwc_trial.py > /tmp/r04_nhwc.out 2>&1
     echo "$(date -u +%H:%M) nhwc trial done - watcher exiting" >> /tmp/tpu_watch.log
+    touch /tmp/r04_capture_done
     exit 0
   fi
   echo "$(date -u +%H:%M) probe $i failed" >> /tmp/tpu_watch.log
