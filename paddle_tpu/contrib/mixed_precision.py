@@ -193,14 +193,15 @@ def propagate_half_through_trunk(program, dtype="bfloat16"):
                 block, new_ops, name, dtype, "%s@BIAS_%s" % (name, tag))
         return bias_cast_cache[name]
 
-    def _is_broadcast_bias(xn, yn):
+    def _is_broadcast_bias(xn, yn, axis=-1):
         """True when Y is a true bias operand broadcast onto X: lower
-        rank (fluid-style axis-broadcast FC/conv bias, e.g. [D] or [C]),
-        or same rank with at most ONE non-1 dim — which must not be the
-        batch dim — and every dim either 1 or matching X (channel bias
-        [1,C,1,1], feature bias [1,1,D]).  Partially-broadcast f32
-        ACTIVATIONS — a [B,T,1] gate, [B,1,D] mask, or [B,1,1]
-        per-sample scalar — keep their f32 contract."""
+        rank (fluid-style axis-broadcast FC/conv bias, e.g. [D] or [C])
+        NOT aligned to the batch dim, or same rank with at most ONE
+        non-1 dim — which must not be the batch dim — and every dim
+        either 1 or matching X (channel bias [1,C,1,1], feature bias
+        [1,1,D]).  Per-sample/partially-broadcast f32 ACTIVATIONS — a
+        [B,T,1] gate, [B,1,D] mask, [B,1,1] scalar, or axis=0 [B]
+        operand — keep their f32 contract."""
         xv = block._find_var_recursive(xn)
         yv = block._find_var_recursive(yn)
         if xv is None or yv is None or xv.shape is None or yv.shape is None:
@@ -209,7 +210,12 @@ def propagate_half_through_trunk(program, dtype="bfloat16"):
         if xs == ys:
             return False
         if len(ys) < len(xs):
-            return True
+            # elementwise axis semantics: y aligns to x starting at
+            # `axis` (default: trailing).  A y whose first dim rides the
+            # batch dim (axis==0 and not a broadcast-1) is per-sample
+            # data, not a bias.
+            eff_axis = axis if axis >= 0 else len(xs) - len(ys)
+            return not (eff_axis == 0 and ys and ys[0] != 1)
         if len(ys) > len(xs):
             return False
         if any(yd not in (1, xd) for yd, xd in zip(ys, xs)):
@@ -234,7 +240,8 @@ def propagate_half_through_trunk(program, dtype="bfloat16"):
                     if yn in castback_src:
                         halves = {xn: castback_src[xn],
                                   yn: castback_src[yn]}
-                    elif _is_broadcast_bias(xn, yn):
+                    elif _is_broadcast_bias(
+                            xn, yn, int(op.attrs.get("axis", -1))):
                         halves = {xn: castback_src[xn],
                                   yn: half_bias(yn)}
             elif names and all(n in castback_src for n in names):

@@ -8,6 +8,7 @@
 # leaves a child holding the chip.  Writes /tmp/r04_capture_done when
 # the whole sequence finished so follow-up sweeps know to start.
 cd "$(dirname "$0")/.."
+rm -f /tmp/r04_capture_done  # a restarted watcher must not expose a stale marker
 for i in $(seq 1 85); do
   if env _BENCH_PROBE=1 timeout -k 10 120 python bench.py 2>/dev/null | grep -q PROBE_DEVICES; then
     echo "$(date -u +%H:%M) tunnel alive - capturing" >> /tmp/tpu_watch.log
