@@ -423,17 +423,79 @@ class IfElse:
 
 
 class _IfElseBranch:
+    """Branch scope.  Ops are appended INLINE (compute-both lowering), so
+    any op with effects beyond its dataflow outputs would fire regardless
+    of the row condition — unlike the reference, which executes only the
+    taken branch on its row subset (control_flow.py:1412).  The exit hook
+    therefore REJECTS side-effecting ops (print, save, RPC sends) and
+    persistable writes inside a branch with a clear error; pure RNG ops
+    (dropout etc.) are fine — draws are per-row selected by the merge and
+    advance no global state."""
+
     def __init__(self, ie, is_true):
         self.ie = ie
         self.is_true = is_true
 
     def __enter__(self):
         self.ie._branch = self.is_true
+        block = self.ie.helper.main_program.current_block()
+        self._block = block
+        self._start = len(block.ops)
         return self
 
     def __exit__(self, exc_type, exc_val, exc_tb):
         self.ie._branch = None
-        return exc_type is None
+        if exc_type is not None:
+            return False
+        from ..core.registry import OPS
+
+        prog = self.ie.helper.main_program
+        label = "true_block" if self.is_true else "false_block"
+
+        def check_ops(ops, block):
+            for op in ops:
+                opdef = OPS.get(op.type)
+                if opdef is not None and opdef.side_effect:
+                    raise ValueError(
+                        "IfElse %s contains side-effecting op '%s': "
+                        "branches run under the compute-both lowering, so "
+                        "its effect would fire for EVERY row regardless of "
+                        "the condition — hoist it out of the branch (e.g. "
+                        "Print the merged output instead)"
+                        % (label, op.type)
+                    )
+                # an op whose persistable 'write' is a no-op in inference
+                # mode (batch_norm's MeanOut/VarianceOut with is_test) is
+                # fine; a genuinely mutating write is not
+                if not bool(op.attrs.get("is_test", False)):
+                    for name in op.output_arg_names():
+                        v = block._find_var_recursive(name)
+                        if v is not None and getattr(v, "persistable",
+                                                     False):
+                            raise ValueError(
+                                "IfElse %s writes persistable var '%s': "
+                                "the compute-both lowering would apply "
+                                "the write unconditionally — return the "
+                                "value via ie.output() and assign it "
+                                "after the merge, or use layers.Switch "
+                                "(whose case writes merge by condition)"
+                                % (label, name)
+                            )
+                # recurse into sub-blocks (While bodies, Switch cases):
+                # their effects are just as unconditional w.r.t. the
+                # IfElse row condition
+                subs = []
+                si = op.attrs.get("sub_block_idx")
+                if si is not None:
+                    subs.append(int(si))
+                subs.extend(int(i) for i in op.attrs.get(
+                    "sub_block_idxs", []) or [])
+                for bidx in subs:
+                    sub = prog.blocks[bidx]
+                    check_ops(sub.ops, sub)
+
+        check_ops(self._block.ops[self._start:], self._block)
+        return True
 
 
 # ---------------------------------------------------------------------------

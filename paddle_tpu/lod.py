@@ -58,73 +58,64 @@ class LoDTensor:
         return "LoDTensor(shape=%s, lod=%s)" % (self.data.shape, self.lod)
 
 
+def _pad_ragged(flat, lens):
+    """Pack consecutive groups of `flat`'s rows into a new padded axis:
+    returns ([len(lens), max(lens), *flat.shape[1:]] zero-padded array,
+    int32 lengths array).  This is the single primitive N-level LoD
+    composition is built from — each application folds one offset level
+    of lod_tensor.h's recursive index into a dense axis."""
+    lens = [int(l) for l in lens]
+    max_len = max(lens) if lens else 0
+    out = np.zeros((len(lens), max_len) + flat.shape[1:], dtype=flat.dtype)
+    ofs = 0
+    for i, l in enumerate(lens):
+        out[i, :l] = flat[ofs:ofs + l]
+        ofs += l
+    return out, np.asarray(lens, dtype=np.int32)
+
+
 def create_lod_tensor(data, recursive_seq_lens=None, place=None):
     """Build a padded LoDTensor from flat data + sequence lengths, or from a
     nested list of sequences (fluid.create_lod_tensor parity,
-    python/paddle/fluid/lod_tensor.py)."""
+    python/paddle/fluid/lod_tensor.py).
+
+    LoD nesting is ARBITRARY depth, matching the reference's recursive
+    offset index (lod_tensor.h:58): N levels pad to an [n0, max_1, ...,
+    max_N, *feat] dense array by applying `_pad_ragged` innermost-first
+    — level i's padded per-unit lengths land in `padded_lens[i]` (shape
+    [n0, max_1, ..., max_i]), the mask source for sequence ops.  The
+    2-level case keeps its `nested_seq_lens` alias ([docs, max_sents]
+    sentence lengths)."""
     if isinstance(data, list) and data and isinstance(data[0], (list, np.ndarray)):
         seqs = [np.asarray(s) for s in data]
         lens = [len(s) for s in seqs]
-        max_len = max(lens) if lens else 0
-        feat = seqs[0].shape[1:] if seqs[0].ndim > 1 else ()
-        out = np.zeros((len(seqs), max_len) + tuple(feat), dtype=seqs[0].dtype)
-        for i, s in enumerate(seqs):
-            out[i, : len(s)] = s
+        out, _ = _pad_ragged(np.concatenate(seqs, axis=0), lens)
         return LoDTensor(out, [lengths_to_offsets(lens)])
     data = np.asarray(data)
-    if recursive_seq_lens and len(recursive_seq_lens) > 2:
-        raise NotImplementedError(
-            "create_lod_tensor supports up to 2 LoD levels on TPU "
-            "(got %d); flatten the outer nesting or pad by hand"
-            % len(recursive_seq_lens)
-        )
-    if recursive_seq_lens and len(recursive_seq_lens) == 2:
-        # nested (2-level) LoD: [doc -> #sentences, sentence -> #tokens]
-        # padded as [docs, max_sents, max_toks, *feat] + both length arrays
-        # (the re-expression of lod_tensor.h nested offsets; deeper nesting
-        # composes the same way)
-        doc_lens = list(recursive_seq_lens[0])
-        tok_lens = list(recursive_seq_lens[1])
-        if sum(doc_lens) != len(tok_lens):
+    if not recursive_seq_lens:
+        return LoDTensor(data)
+    levels = [[int(l) for l in lev] for lev in recursive_seq_lens]
+    for i in range(len(levels) - 1):
+        if sum(levels[i]) != len(levels[i + 1]):
             raise ValueError(
-                "level-0 lengths sum to %d but there are %d level-1 "
-                "sequences" % (sum(doc_lens), len(tok_lens))
+                "level-%d lengths sum to %d but there are %d level-%d "
+                "sequences" % (i, sum(levels[i]), len(levels[i + 1]), i + 1)
             )
-        if sum(tok_lens) != len(data):
-            raise ValueError(
-                "level-1 token lengths sum to %d but data has %d rows"
-                % (sum(tok_lens), len(data))
-            )
-        max_sents = max(doc_lens) if doc_lens else 0
-        max_toks = max(tok_lens) if tok_lens else 0
-        feat = data.shape[1:]
-        out = np.zeros(
-            (len(doc_lens), max_sents, max_toks) + tuple(feat), dtype=data.dtype
+    if sum(levels[-1]) != len(data):
+        raise ValueError(
+            "level-%d token lengths sum to %d but data has %d rows"
+            % (len(levels) - 1, sum(levels[-1]), len(data))
         )
-        tok_pad = np.zeros((len(doc_lens), max_sents), np.int32)
-        ofs = 0
-        si = 0
-        for d, nsent in enumerate(doc_lens):
-            for s in range(nsent):
-                tl = tok_lens[si]
-                out[d, s, :tl] = data[ofs:ofs + tl]
-                tok_pad[d, s] = tl
-                ofs += tl
-                si += 1
-        t = LoDTensor(
-            out,
-            [lengths_to_offsets(doc_lens), lengths_to_offsets(tok_lens)],
-        )
-        t.nested_seq_lens = tok_pad  # [docs, max_sents] per-sentence lengths
-        return t
-    if recursive_seq_lens:
-        lens = list(recursive_seq_lens[-1])
-        max_len = max(lens)
-        feat = data.shape[1:]
-        out = np.zeros((len(lens), max_len) + tuple(feat), dtype=data.dtype)
-        ofs = 0
-        for i, l in enumerate(lens):
-            out[i, :l] = data[ofs : ofs + l]
-            ofs += l
-        return LoDTensor(out, [lengths_to_offsets(lens)])
-    return LoDTensor(data)
+    # innermost first: fold token rows into sequences, then fold each
+    # outer level around BOTH the data and every carried lengths array
+    cur, lens_arr = _pad_ragged(data, levels[-1])
+    carried = [lens_arr]  # first dim of each == #units at current level
+    for lev in reversed(levels[:-1]):
+        cur, lens_arr = _pad_ragged(cur, lev)
+        carried = [_pad_ragged(a, lev)[0] for a in carried]
+        carried.insert(0, lens_arr)
+    t = LoDTensor(cur, [lengths_to_offsets(lev) for lev in levels])
+    t.padded_lens = carried
+    if len(levels) == 2:
+        t.nested_seq_lens = carried[1]  # [docs, max_sents] back-compat
+    return t

@@ -228,6 +228,49 @@ def test_nested_lod_two_levels():
     np.testing.assert_array_equal(t.seq_lens(1), [3, 2, 5])
 
 
+def test_nested_lod_three_levels():
+    """N-level LoD composition (lod_tensor.h:58's arbitrary recursion):
+    3 levels [corpus -> docs -> sents -> tokens] pad to
+    [corpora, max_docs, max_sents, max_toks, *feat] with a per-level
+    padded lengths pyramid in `padded_lens`."""
+    import numpy as np
+    from paddle_tpu.lod import create_lod_tensor
+
+    data = np.arange(12, dtype="float32").reshape(12, 1)
+    # corpus0: 2 docs (doc0: 2 sents of 2+1 toks; doc1: 1 sent of 3)
+    # corpus1: 1 doc  (doc2: 2 sents of 4+2 toks)
+    t = create_lod_tensor(
+        data,
+        recursive_seq_lens=[[2, 1], [2, 1, 2], [2, 1, 3, 4, 2]],
+    )
+    assert t.lod_level() == 3
+    assert t.data.shape == (2, 2, 2, 4, 1)
+    # level-0: docs per corpus
+    np.testing.assert_array_equal(t.padded_lens[0], [2, 1])
+    # level-1: sents per doc, padded to [corpora, max_docs]
+    np.testing.assert_array_equal(t.padded_lens[1], [[2, 1], [2, 0]])
+    # level-2: tokens per sent, padded to [corpora, max_docs, max_sents]
+    np.testing.assert_array_equal(
+        t.padded_lens[2],
+        [[[2, 1], [3, 0]], [[4, 2], [0, 0]]],
+    )
+    np.testing.assert_allclose(t.data[0, 0, 0, :2, 0], [0, 1])
+    np.testing.assert_allclose(t.data[0, 0, 1, :1, 0], [2])
+    np.testing.assert_allclose(t.data[0, 1, 0, :3, 0], [3, 4, 5])
+    np.testing.assert_allclose(t.data[1, 0, 0, :4, 0], [6, 7, 8, 9])
+    np.testing.assert_allclose(t.data[1, 0, 1, :2, 0], [10, 11])
+    # untouched slots are zero padding
+    assert float(np.abs(t.data[1, 1]).sum()) == 0.0
+    np.testing.assert_array_equal(t.seq_lens(0), [2, 1])
+    np.testing.assert_array_equal(t.seq_lens(2), [2, 1, 3, 4, 2])
+    # mismatched level sums still raise
+    import pytest
+
+    with pytest.raises(ValueError, match="level-0"):
+        create_lod_tensor(data, recursive_seq_lens=[[2], [2, 1, 2],
+                                                    [2, 1, 3, 4, 2]])
+
+
 def test_api_spec_stability():
     """tools/diff_api.py CI contract: the live public API covers the
     committed API.spec snapshot (removals/re-signatures fail)."""

@@ -297,6 +297,147 @@ def test_switch_piecewise_lr():
         assert abs(float(r[0]) - expect) < 1e-8
 
 
+def test_ifelse_side_effecting_op_rejected():
+    """IfElse branches run compute-both, so a print op inside a branch
+    would fire for every row regardless of cond — the branch guard must
+    reject it with a clear error (the reference executes only the taken
+    branch: control_flow.py:1412)."""
+    import pytest
+
+    xb = layers.data("sex", shape=[4, 2], append_batch_size=False)
+    zero = layers.fill_constant([4, 1], "float32", 0.0)
+    c = layers.greater_than(layers.reduce_sum(xb, dim=1, keep_dim=True), zero)
+    ie = layers.IfElse(c)
+    with pytest.raises(ValueError, match="side-effecting op 'print'"):
+        with ie.true_block():
+            d = ie.input(xb)
+            layers.Print(d, message="branch")
+            ie.output(d)
+
+
+def test_ifelse_persistable_write_rejected():
+    """A persistable write inside an IfElse branch would apply
+    unconditionally under the compute-both lowering — rejected, with the
+    Switch-based alternative named in the error."""
+    import pytest
+
+    xb = layers.data("pwx", shape=[4, 2], append_batch_size=False)
+    zero = layers.fill_constant([4, 1], "float32", 0.0)
+    c = layers.greater_than(layers.reduce_sum(xb, dim=1, keep_dim=True), zero)
+    gstate = layers.create_global_var([4, 2], 0.0, "float32",
+                                      persistable=True, name="pw_gstate")
+    ie = layers.IfElse(c)
+    with pytest.raises(ValueError, match="persistable var 'pw_gstate'"):
+        with ie.true_block():
+            d = ie.input(xb)
+            layers.assign(layers.scale(d, 2.0), gstate)
+            ie.output(d)
+
+
+def test_ifelse_branch_batch_norm_inference_ok_training_rejected():
+    """batch_norm lists its persistable moving stats as outputs even in
+    is_test mode where no update occurs — the guard must allow the
+    inference form and reject only the genuinely mutating train form."""
+    import pytest
+
+    xb = layers.data("bnx", shape=[4, 6], append_batch_size=False)
+    zero = layers.fill_constant([4, 1], "float32", 0.0)
+    c = layers.greater_than(layers.reduce_sum(xb, dim=1, keep_dim=True), zero)
+    ie = layers.IfElse(c)
+    with ie.true_block():
+        d = ie.input(xb)
+        ie.output(layers.batch_norm(d, is_test=True))  # allowed: no-op write
+    with ie.false_block():
+        ie.output(ie.input(xb))
+    ie()
+
+    ie2 = layers.IfElse(c)
+    with pytest.raises(ValueError, match="persistable"):
+        with ie2.true_block():
+            d = ie2.input(xb)
+            ie2.output(layers.batch_norm(d))  # train mode mutates stats
+
+
+def test_ifelse_nested_sub_block_side_effect_rejected():
+    """Effects hidden in a nested sub-block (a Switch case inside the
+    branch) are just as unconditional — the guard recurses into
+    sub_block attrs and rejects them too."""
+    import pytest
+
+    xb = layers.data("nsx", shape=[4, 2], append_batch_size=False)
+    zero = layers.fill_constant([4, 1], "float32", 0.0)
+    c = layers.greater_than(layers.reduce_sum(xb, dim=1, keep_dim=True), zero)
+    g = layers.create_global_var([1], 0.0, "float32", persistable=True,
+                                 name="ns_gvar")
+    ie = layers.IfElse(c)
+    with pytest.raises(ValueError, match="persistable var 'ns_gvar'"):
+        with ie.true_block():
+            d = ie.input(xb)
+            one = layers.fill_constant([1], "float32", 1.0)
+            with layers.Switch() as sw:
+                with sw.case(layers.less_than(one, one)):
+                    layers.assign(layers.fill_constant([1], "float32", 2.0),
+                                  g)
+                with sw.default():
+                    layers.assign(layers.fill_constant([1], "float32", 3.0),
+                                  g)
+            ie.output(d)
+
+
+def test_ifelse_rng_branch_is_pure_row_select():
+    """RNG ops ARE allowed in IfElse branches: the per-run key is
+    threaded functionally by the executor (fresh masks each run, as
+    training needs), and the row merge keeps only the taken branch's
+    values per row — the untaken branch's draws never leak into
+    cond-false rows."""
+    xb = layers.data("irx", shape=[4, 2], append_batch_size=False)
+    zero = layers.fill_constant([4, 1], "float32", 0.0)
+    c = layers.greater_than(layers.reduce_sum(xb, dim=1, keep_dim=True), zero)
+    ie = layers.IfElse(c)
+    with ie.true_block():
+        d = ie.input(xb)
+        ie.output(layers.dropout(layers.scale(d, 10.0), 0.5, seed=11))
+    with ie.false_block():
+        d = ie.input(xb)
+        ie.output(layers.scale(d, -1.0))
+    (out,) = ie()
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.array([[1, 1], [-1, -2], [3, 0.5], [-1, 0.5]], "float32")
+    mask = xv.sum(1, keepdims=True) > 0
+    for _ in range(2):  # fresh dropout key each run; invariants hold always
+        (r,) = exe.run(feed={"irx": xv}, fetch_list=[out])
+        r = np.asarray(r)
+        # cond-false rows never see the true branch's draws
+        np.testing.assert_allclose(np.where(mask, 0, r),
+                                   np.where(mask, 0, -xv))
+        # cond-true rows: dropout kept (10x) or dropped (0), elementwise
+        tr = r[mask[:, 0]]
+        tx = xv[mask[:, 0]]
+        assert np.all(
+            (np.abs(tr) < 1e-6) | (np.abs(tr - tx * 10.0) < 1e-4)), tr
+
+
+def test_switch_case_write_only_lands_when_taken():
+    """Contrast with IfElse: Switch case sub-blocks ARE the sanctioned
+    place for conditional persistable writes — the trace merges every
+    case's writes by condition, so only the taken case's value lands."""
+    step = layers.data("swp", shape=[1], append_batch_size=False)
+    g = layers.create_global_var([1], -1.0, "float32", persistable=True,
+                                 name="sw_gvar")
+    b1 = layers.fill_constant([1], "float32", 10.0)
+    with layers.Switch() as sw:
+        with sw.case(layers.less_than(step, b1)):
+            layers.assign(layers.fill_constant([1], "float32", 7.0), g)
+        with sw.default():
+            layers.assign(layers.fill_constant([1], "float32", 9.0), g)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    (r,) = exe.run(feed={"swp": np.array([5.0], "float32")}, fetch_list=[g])
+    assert abs(float(r[0]) - 7.0) < 1e-8
+    (r,) = exe.run(feed={"swp": np.array([50.0], "float32")}, fetch_list=[g])
+    assert abs(float(r[0]) - 9.0) < 1e-8
+
+
 def test_dynamic_rnn_seq2seq_trains():
     """Encoder-decoder built on DynamicRNN trains end-to-end (grads flow
     through the recurrence into all parameters; loss decreases)."""

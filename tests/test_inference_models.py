@@ -89,7 +89,8 @@ def test_analyzer_resnet50_c_abi(tmp_path):
 
     native_dir = os.path.join(os.path.dirname(fluid.__file__), "native")
     py_h = os.path.join(sysconfig.get_paths()["include"], "Python.h")
-    if shutil.which("g++") is None or not os.path.exists(py_h):
+    if (shutil.which("g++") is None or shutil.which("make") is None
+            or not os.path.exists(py_h)):
         pytest.skip("no C++ toolchain / Python headers")
     subprocess.run(["make", "capi_demo"], cwd=native_dir, check=True,
                    capture_output=True)
