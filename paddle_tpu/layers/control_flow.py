@@ -481,15 +481,19 @@ class _IfElseBranch:
                                 "(whose case writes merge by condition)"
                                 % (label, name)
                             )
-                # recurse into sub-blocks (While bodies, Switch cases):
-                # their effects are just as unconditional w.r.t. the
-                # IfElse row condition
+                # recurse into sub-blocks (While bodies, Switch cases,
+                # cond true/false blocks): their effects are just as
+                # unconditional w.r.t. the IfElse row condition.  Same
+                # generic discovery as trace.analyze_block — any
+                # sub_block* attr, int or list.
                 subs = []
-                si = op.attrs.get("sub_block_idx")
-                if si is not None:
-                    subs.append(int(si))
-                subs.extend(int(i) for i in op.attrs.get(
-                    "sub_block_idxs", []) or [])
+                for a, v in op.attrs.items():
+                    if not a.startswith("sub_block"):
+                        continue
+                    if isinstance(v, int):
+                        subs.append(v)
+                    elif isinstance(v, (list, tuple)):
+                        subs.extend(int(i) for i in v)
                 for bidx in subs:
                     sub = prog.blocks[bidx]
                     check_ops(sub.ops, sub)

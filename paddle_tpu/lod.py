@@ -89,7 +89,10 @@ def create_lod_tensor(data, recursive_seq_lens=None, place=None):
     if isinstance(data, list) and data and isinstance(data[0], (list, np.ndarray)):
         seqs = [np.asarray(s) for s in data]
         lens = [len(s) for s in seqs]
-        out, _ = _pad_ragged(np.concatenate(seqs, axis=0), lens)
+        # keep seqs[0]'s dtype: an empty sequence concatenates as float64
+        # and must not silently promote integer data
+        flat = np.concatenate(seqs, axis=0).astype(seqs[0].dtype, copy=False)
+        out, _ = _pad_ragged(flat, lens)
         return LoDTensor(out, [lengths_to_offsets(lens)])
     data = np.asarray(data)
     if not recursive_seq_lens:
