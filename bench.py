@@ -543,6 +543,42 @@ def _tpu_reachable(timeout):
         raise
 
 
+def _latest_tpu_capture():
+    """Most recent committed BENCH_R<N>_TPU.json (driver-format on-chip
+    capture), ordered by the ROUND NUMBER in the filename — file mtime is
+    checkout time after a fresh clone, so it is only reported as
+    `capture_file_mtime`, never used for ordering.  Returns None if no
+    capture exists."""
+    import glob
+    import re
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    best = None
+    for p in glob.glob(os.path.join(root, "BENCH_R*_TPU.json")):
+        name = os.path.basename(p)
+        m = re.match(r"BENCH_R(\d+)_TPU\.json$", name)
+        if m is None:
+            continue
+        try:
+            with open(p) as f:
+                obj = json.loads(f.read().strip() or "null")
+            if not isinstance(obj, dict):
+                continue
+            rank = int(m.group(1))
+            if best is None or rank > best[0]:
+                best = (rank, name, os.path.getmtime(p), obj)
+        except Exception:
+            continue
+    if best is None:
+        return None
+    _, name, mt, obj = best
+    obj = dict(obj)
+    obj["capture_file"] = name
+    obj["capture_file_mtime"] = time.strftime(
+        "%Y-%m-%dT%H:%M:%SZ", time.gmtime(mt))
+    return obj
+
+
 def main():
     if os.environ.get("_BENCH_PROBE") == "1":
         return _probe_impl()
@@ -575,12 +611,21 @@ def main():
     # rather than absent).
     tpu_timeout = int(os.environ.get("BENCH_TPU_TIMEOUT", "1500"))
 
-    def emit(line):
+    def emit(line, cpu_fallback=False):
         # distributed-mode smokes run OUTSIDE the measurement child (they
         # spawn their own CPU subprocesses); merge into the one JSON line
-        if os.environ.get("BENCH_DIST", "0") == "1":
+        if os.environ.get("BENCH_DIST", "0") == "1" or cpu_fallback:
             obj = json.loads(line)
-            obj["dist"] = _dist_smokes()
+            if os.environ.get("BENCH_DIST", "0") == "1":
+                obj["dist"] = _dist_smokes()
+            if cpu_fallback:
+                # a wedged tunnel at driver time must not erase the
+                # on-chip evidence: embed the most recent committed TPU
+                # capture (clearly labeled with its capture time) so the
+                # driver artifact always carries it
+                cap = _latest_tpu_capture()
+                if cap is not None:
+                    obj["last_tpu_capture"] = cap
             line = json.dumps(obj)
         print(line)
 
@@ -600,7 +645,7 @@ def main():
 
     ok, line, log = _run_child(_cpu_only_env(1), timeout=900)
     if ok:
-        emit(line)
+        emit(line, cpu_fallback=True)
         return
     sys.stderr.write("bench: CPU fallback failed:\n%s\n" % log)
     # last resort: still emit a parseable line rather than crash

@@ -74,6 +74,22 @@ def dce_mask(program, block_idx, fetch_names):
     return keep
 
 
+def op_sub_blocks(op):
+    """Sub-block indices owned by an op — THE discovery primitive every
+    block analyzer shares (visit_reads_writes, the IfElse branch-effect
+    guard): any `sub_block*` attr, int-valued (while/cond/recurrent) or
+    list-valued (switch's sub_block_idxs)."""
+    out = []
+    for a, v in op.attrs.items():
+        if not a.startswith("sub_block"):
+            continue
+        if isinstance(v, int):
+            out.append(v)
+        elif isinstance(v, (list, tuple)):
+            out.extend(int(i) for i in v)
+    return out
+
+
 def visit_reads_writes(program, bidx, defined, on_read, on_write=None, pre_op=None):
     """Shared block traversal: report names read before being written
     (recursing into sub_block attrs, whose `__bound_names__` — recurrent
@@ -93,12 +109,12 @@ def visit_reads_writes(program, bidx, defined, on_read, on_write=None, pre_op=No
         for name in op.input_arg_names():
             if name and name not in defined:
                 on_read(name)
-        for a, v in op.attrs.items():
-            if a.startswith("sub_block") and isinstance(v, int):
-                bound = op.attrs.get("__bound_names__", ())
-                visit_reads_writes(
-                    program, v, set(defined) | set(bound), on_read, on_write, pre_op
-                )
+        for sub_idx in op_sub_blocks(op):
+            bound = op.attrs.get("__bound_names__", ())
+            visit_reads_writes(
+                program, sub_idx, set(defined) | set(bound), on_read,
+                on_write, pre_op
+            )
         for name in op.output_arg_names():
             defined.add(name)
             if on_write is not None:

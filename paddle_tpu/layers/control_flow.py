@@ -483,18 +483,10 @@ class _IfElseBranch:
                             )
                 # recurse into sub-blocks (While bodies, Switch cases,
                 # cond true/false blocks): their effects are just as
-                # unconditional w.r.t. the IfElse row condition.  Same
-                # generic discovery as trace.analyze_block — any
-                # sub_block* attr, int or list.
-                subs = []
-                for a, v in op.attrs.items():
-                    if not a.startswith("sub_block"):
-                        continue
-                    if isinstance(v, int):
-                        subs.append(v)
-                    elif isinstance(v, (list, tuple)):
-                        subs.extend(int(i) for i in v)
-                for bidx in subs:
+                # unconditional w.r.t. the IfElse row condition
+                from ..core.trace import op_sub_blocks
+
+                for bidx in op_sub_blocks(op):
                     sub = prog.blocks[bidx]
                     check_ops(sub.ops, sub)
 

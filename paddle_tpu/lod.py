@@ -89,9 +89,12 @@ def create_lod_tensor(data, recursive_seq_lens=None, place=None):
     if isinstance(data, list) and data and isinstance(data[0], (list, np.ndarray)):
         seqs = [np.asarray(s) for s in data]
         lens = [len(s) for s in seqs]
-        # keep seqs[0]'s dtype: an empty sequence concatenates as float64
-        # and must not silently promote integer data
-        flat = np.concatenate(seqs, axis=0).astype(seqs[0].dtype, copy=False)
+        # dtype = promotion over the NON-empty sequences: an empty
+        # sequence (float64 from np.asarray([])) must not promote
+        # integer data, and genuine mixed dtypes still promote
+        non_empty = [s for s in seqs if s.size]
+        dt = np.result_type(*non_empty) if non_empty else seqs[0].dtype
+        flat = np.concatenate(seqs, axis=0).astype(dt, copy=False)
         out, _ = _pad_ragged(flat, lens)
         return LoDTensor(out, [lengths_to_offsets(lens)])
     data = np.asarray(data)

@@ -818,3 +818,34 @@ def test_sharded_checkpoint_save_restore_and_reshard(tmp_path):
         with pytest.raises(IOError):
             dexe4.load_sharded(ckpt)
             dexe4.run([loss], feed={"img": x, "label": y})
+
+
+def test_compile_count_constant_across_device_counts():
+    """Scaling invariant (VERDICT r3 item 8): growing the mesh 1->2->4->8
+    must NOT grow the number of compiled executables — one traced
+    function per (program, signature) regardless of device count, and no
+    hidden re-compile inside the jit cache across steps (the
+    committedness trap regression, executor.py `_committed`)."""
+    from paddle_tpu.core import scope as scope_mod
+    from paddle_tpu.parallel.mesh import make_mesh
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(32, 32).astype("float32")
+    y = rng.randint(0, 4, (32, 1)).astype("int64")
+
+    for n in (1, 2, 4, 8):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.framework.program_guard(main, startup):
+            loss = _build_mlp()
+        scope = scope_mod.Scope()
+        fluid.Executor(fluid.CPUPlace()).run(startup, scope=scope)
+        mesh = make_mesh({"dp": n}, devices=jax.devices()[:n])
+        dexe = parallel.DistributedExecutor(mesh, main_program=main,
+                                            scope=scope)
+        vals = [float(np.asarray(
+            dexe.run([loss], feed={"img": x, "label": y})[0]).reshape(-1)[0])
+            for _ in range(3)]
+        assert vals[-1] < vals[0]  # actually training
+        assert len(dexe._cache) == 1, (n, len(dexe._cache))
+        ((_, jitted),) = dexe._cache.values()
+        assert jitted._cache_size() == 1, (n, jitted._cache_size())

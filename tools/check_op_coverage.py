@@ -25,7 +25,7 @@ STRUCTURAL = {
     "while": "lowered to lax.while_loop by core/trace.py",
     "conditional_block": "lowered to lax.cond by core/trace.py",
     "read": "reader boundary op satisfied by the executor (program_reader)",
-    "create_custom_reader": "reader decorators subsume (reader/decorator.py)",
+    "create_custom_reader": "reader decorators + layers.Preprocessor subsume; PROVEN by tests/test_pipeline_and_metrics.py::test_create_custom_reader_semantics_via_decorators",
     "listen_and_serv": "pserver service loop (distributed/ps_server.py)",
     "gen_nccl_id": "jax.distributed.initialize bootstrap (distributed)",
     "ncclInit": "ICI collectives need no communicator init",
