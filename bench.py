@@ -648,13 +648,14 @@ def main():
         emit(line, cpu_fallback=True)
         return
     sys.stderr.write("bench: CPU fallback failed:\n%s\n" % log)
-    # last resort: still emit a parseable line rather than crash
-    print(json.dumps({
+    # last resort: still emit a parseable line rather than crash — and
+    # still carry the on-chip evidence (emit embeds last_tpu_capture)
+    emit(json.dumps({
         "metric": "resnet50_train_images_per_sec_per_chip_failed",
         "value": 0.0,
         "unit": "images/sec",
         "vs_baseline": 0.0,
-    }))
+    }), cpu_fallback=True)
 
 
 if __name__ == "__main__":

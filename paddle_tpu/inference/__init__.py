@@ -71,6 +71,7 @@ class Predictor:
     def __init__(self, config):
         self.config = config
         self.scope = Scope()
+        self._zero_copy_outputs = {}
         self.exe = Executor(config.place)
         (
             self.program,
@@ -121,17 +122,71 @@ class Predictor:
             apply_pass(self.program, name, scope=self.scope)
 
     def run(self, inputs):
-        """inputs: dict name->array, or list aligned with feed_names.
+        """inputs: dict name->array, list aligned with feed_names, or a
+        list of PaddleTensor (api_impl.h Run contract — returns
+        PaddleTensor outputs in that case).
         Returns list of np.ndarrays aligned with the fetch targets."""
-        if not isinstance(inputs, dict):
-            inputs = dict(zip(self.feed_names, inputs))
+        tensor_mode = (
+            isinstance(inputs, (list, tuple)) and inputs
+            and isinstance(inputs[0], PaddleTensor)
+        )
+        if tensor_mode:
+            feed = {t.name or n: t.data
+                    for t, n in zip(inputs, self.feed_names)}
+        elif not isinstance(inputs, dict):
+            feed = dict(zip(self.feed_names, inputs))
+        else:
+            feed = inputs
         outs = self.exe.run(
             self.program,
-            feed=inputs,
+            feed=feed,
             fetch_list=self.fetch_names,
             scope=self.scope,
         )
+        if tensor_mode:
+            return [PaddleTensor(np.asarray(o), name=n)
+                    for o, n in zip(outs, self.fetch_names)]
         return [np.asarray(o) for o in outs]
+
+    # ---- zero-copy serving (paddle_api.h:98 ZeroCopyTensor /
+    # analysis_predictor.h:53 GetInput/OutputTensor + ZeroCopyRun) ----
+    def get_input_tensor(self, name):
+        if name not in self.feed_names:
+            raise KeyError("unknown input '%s' (have %s)"
+                           % (name, self.feed_names))
+        handles = getattr(self, "_zero_copy_inputs", None)
+        if handles is None:
+            handles = self._zero_copy_inputs = {}
+        if name not in handles:
+            handles[name] = ZeroCopyTensor(self, name, is_input=True)
+        return handles[name]
+
+    def get_output_tensor(self, name):
+        if name not in self.fetch_names:
+            raise KeyError("unknown output '%s' (have %s)"
+                           % (name, self.fetch_names))
+        return ZeroCopyTensor(self, name, is_input=False)
+
+    def zero_copy_run(self):
+        """Run from the bound input buffers; outputs readable through
+        get_output_tensor(...).copy_to_cpu()."""
+        handles = getattr(self, "_zero_copy_inputs", {})
+        missing = [n for n in self.feed_names if n not in handles
+                   or handles[n]._buf is None]
+        if missing:
+            raise RuntimeError(
+                "zero_copy_run: inputs %s not bound — get_input_tensor + "
+                "reshape/copy_from_cpu first" % missing)
+        feed = {n: handles[n]._buf for n in self.feed_names}
+        outs = self.exe.run(
+            self.program,
+            feed=feed,
+            fetch_list=self.fetch_names,
+            scope=self.scope,
+            return_numpy=False,
+        )
+        self._zero_copy_outputs = dict(zip(self.fetch_names, outs))
+        return True
 
     def get_input_names(self):
         return list(self.feed_names)
@@ -144,6 +199,7 @@ class Predictor:
         with its own compile cache — the reference's thread-serving clone."""
         cloned = Predictor.__new__(Predictor)
         cloned.config = self.config
+        cloned._zero_copy_outputs = {}
         cloned.scope = self.scope
         cloned.exe = Executor(self.config.place)
         cloned.program = self.program
@@ -151,6 +207,92 @@ class Predictor:
         cloned.fetch_vars = self.fetch_vars
         cloned.fetch_names = list(self.fetch_names)
         return cloned
+
+
+class PaddleTensor:
+    """Named host tensor for the classic Run(inputs)->outputs serving call
+    (paddle_api.h:87 PaddleTensor: name + shape + data blob + lod).
+    `data` is a numpy array; `lod` is reference-style offset lists."""
+
+    def __init__(self, data=None, name="", lod=None):
+        self.name = name
+        self.data = None if data is None else np.asarray(data)
+        self.lod = [list(l) for l in (lod or [])]
+
+    @property
+    def shape(self):
+        return [] if self.data is None else list(self.data.shape)
+
+    @property
+    def dtype(self):
+        return None if self.data is None else str(self.data.dtype)
+
+
+class ZeroCopyTensor:
+    """Scope-bound tensor handle (paddle_api.h:98): write inputs in place
+    and read outputs without intermediate staging buffers.
+
+    TPU reading of "zero copy": the EXACT ndarray the caller fills via
+    `mutable_data()`/`copy_from_cpu()` is what the executor device_puts —
+    no feed-dict marshalling copy in between — and `copy_to_cpu()` is the
+    single device→host materialization of the executor's output buffer.
+    """
+
+    def __init__(self, predictor, name, is_input):
+        self._pred = predictor
+        self._name = name
+        self._is_input = is_input
+        self._buf = None
+
+    def name(self):
+        return self._name
+
+    def reshape(self, shape):
+        """Allocate (or reuse) the host-side input buffer — the
+        mutable_data contract: Reshape first, then write."""
+        shape = tuple(int(d) for d in shape)
+        if self._buf is None or self._buf.shape != shape:
+            dtype = self._buf.dtype if self._buf is not None else np.float32
+            self._buf = np.zeros(shape, dtype)
+        return self
+
+    def mutable_data(self, dtype="float32"):
+        """Writable ndarray backing this input (call reshape first)."""
+        if not self._is_input:
+            raise RuntimeError("mutable_data is for input tensors")
+        if self._buf is None:
+            raise RuntimeError("call reshape(shape) before mutable_data()")
+        if str(self._buf.dtype) != str(np.dtype(dtype)):
+            self._buf = self._buf.astype(dtype)
+        return self._buf
+
+    def copy_from_cpu(self, arr):
+        if not self._is_input:
+            raise RuntimeError("copy_from_cpu is for input tensors")
+        self._buf = np.ascontiguousarray(arr)
+        return self
+
+    def copy_to_cpu(self):
+        if self._is_input:
+            return np.asarray(self._buf)
+        out = self._pred._zero_copy_outputs.get(self._name)
+        if out is None:
+            raise RuntimeError(
+                "no output for '%s' yet — call zero_copy_run() first"
+                % self._name)
+        return np.asarray(out)
+
+    def shape(self):
+        if self._is_input:
+            return [] if self._buf is None else list(self._buf.shape)
+        out = self._pred._zero_copy_outputs.get(self._name)
+        return [] if out is None else list(np.asarray(out).shape)
+
+    def set_lod(self, lod):
+        self._lod = [list(l) for l in lod]
+
+    def lod(self):
+        return getattr(self, "_lod", [])
 
 
 def create_paddle_predictor(config):
@@ -162,5 +304,7 @@ __all__ = [
     "NativeConfig",
     "AnalysisConfig",
     "Predictor",
+    "PaddleTensor",
+    "ZeroCopyTensor",
     "create_paddle_predictor",
 ]

@@ -1,0 +1,9 @@
+#!/bin/bash
+# Build the paddle_tpu wheel (docs/BUILD.md).  Offline-friendly:
+# --no-isolation uses the installed setuptools/wheel; the native runtime
+# ships as sources and compiles on first import.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+rm -rf build dist *.egg-info
+python -m build --no-isolation --wheel -o dist .
+ls -l dist/*.whl

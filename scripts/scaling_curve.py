@@ -42,6 +42,10 @@ import jax.numpy as jnp  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")  # pin past the axon plugin
 
+assert len(jax.devices()) >= 8, (
+    "need >= 8 virtual devices; inherited XLA_FLAGS pinned a smaller "
+    "xla_force_host_platform_device_count: %r" % os.environ.get("XLA_FLAGS"))
+
 GLOBAL_BATCH = 256
 STEPS = 20
 
@@ -96,7 +100,9 @@ def pp_leg(n):
     stage_fn, init_stage = pipeline_mlp_stages(512)
     keys = jax.random.split(jax.random.PRNGKey(0), n)
     params = stack_stage_params([init_stage(k) for k in keys])
-    run = gpipe(stage_fn, mesh, n_microbatches=2 * n)
+    # gpipe returns the raw shard_map callable; jit it so steady-state
+    # steps measure execution, not per-call retracing
+    run = jax.jit(gpipe(stage_fn, mesh, n_microbatches=2 * n))
     x = jnp.asarray(np.random.RandomState(1).rand(
         GLOBAL_BATCH, 512).astype("float32"))
     out = run(params, x)
@@ -106,6 +112,7 @@ def pp_leg(n):
         out = run(params, x)
     jax.block_until_ready(out)
     dt = time.perf_counter() - t0
+    assert run._cache_size() == 1, run._cache_size()  # no retrace per step
     return STEPS / dt
 
 

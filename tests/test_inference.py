@@ -109,3 +109,57 @@ def test_predictor_runs_user_registered_pass(tmp_path):
     assert calls, "registered pass did not run in the predictor"
     (out,) = predictor.run({"upx": np.ones((2, 4), "float32")})
     assert out.shape == (2, 3)
+
+
+def test_zero_copy_tensor_serving(tmp_path):
+    """ZeroCopyTensor cycle (paddle_api.h:98, analysis_predictor.h:53):
+    bind input buffers once, write in place, zero_copy_run, read outputs
+    — identical results to the feed-dict path; rebinding data without
+    reallocation also matches."""
+    model_dir, x, ref = _train_and_save(tmp_path)
+    pred = create_paddle_predictor(AnalysisConfig(model_dir))
+
+    inp = pred.get_input_tensor("img")
+    inp.reshape(x.shape)
+    buf = inp.mutable_data("float32")
+    buf[...] = x
+    assert pred.zero_copy_run()
+    out = pred.get_output_tensor(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=1e-5)
+
+    # in-place rewrite of the SAME buffer (the zero-copy contract)
+    buf[...] = x * 0.0
+    assert pred.zero_copy_run()
+    out0 = pred.get_output_tensor(pred.get_output_names()[0]).copy_to_cpu()
+    assert not np.allclose(out0, out)
+
+    # copy_from_cpu path + error contracts
+    inp.copy_from_cpu(x)
+    pred.zero_copy_run()
+    out2 = pred.get_output_tensor(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out2, ref, rtol=2e-4, atol=1e-5)
+    import pytest
+
+    with pytest.raises(KeyError):
+        pred.get_input_tensor("nope")
+    with pytest.raises(RuntimeError, match="for input tensors"):
+        pred.get_output_tensor(pred.get_output_names()[0]).mutable_data()
+    pred2 = create_paddle_predictor(NativeConfig(model_dir))
+    with pytest.raises(RuntimeError, match="reshape"):
+        pred2.get_input_tensor("img").mutable_data()
+    with pytest.raises(RuntimeError, match="zero_copy_run"):
+        pred2.get_output_tensor(pred2.get_output_names()[0]).copy_to_cpu()
+
+
+def test_paddle_tensor_run_mode(tmp_path):
+    """PaddleTensor list in -> PaddleTensor list out (api_impl.h Run
+    contract), matching the dict path."""
+    from paddle_tpu.inference import PaddleTensor
+
+    model_dir, x, ref = _train_and_save(tmp_path)
+    pred = create_paddle_predictor(NativeConfig(model_dir))
+    (out_t,) = pred.run([PaddleTensor(x, name="img")])
+    assert isinstance(out_t, PaddleTensor)
+    assert out_t.name == pred.get_output_names()[0]
+    assert out_t.dtype == "float32" and out_t.shape == list(ref.shape)
+    np.testing.assert_allclose(out_t.data, ref, rtol=2e-4, atol=1e-5)
