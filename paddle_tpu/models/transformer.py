@@ -350,6 +350,14 @@ def wmt_transformer_program(hp=ModelHyperParams, src_len=64, trg_len=64, learnin
         token_count = layers.reduce_sum(weights)
         avg_cost = layers.elementwise_div(sum_cost, token_count)
 
+        # fold the one_hot -> label_smooth -> soft-label-xent chain into
+        # the closed-form smooth_label_xent op: at bench config the chain
+        # materializes three [B*T, V] f32 arrays (~4 GB/step) for a
+        # quantity computable from logits + int labels alone
+        from ..transpiler.pass_registry import apply_pass
+
+        apply_pass(main, "smooth_label_xent_fuse_pass")
+
         if use_bf16:
             from paddle_tpu.contrib.mixed_precision import rewrite_bf16
 

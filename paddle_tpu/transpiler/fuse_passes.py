@@ -469,3 +469,74 @@ class EmbeddingFcLstmFusePass(Pass):
                     block, fuse)
         program._emb_fc_lstm_fused_count = n
         return program
+
+
+@register_pass("smooth_label_xent_fuse_pass")
+class SmoothLabelXentFusePass(Pass):
+    """one_hot -> label_smooth -> softmax_with_cross_entropy(soft_label)
+    => ONE smooth_label_xent op reading the raw int labels.
+
+    The reference training-loss idiom (dist_transformer.py builds exactly
+    this chain) materializes three [N, V] float arrays — one-hot labels,
+    smoothed labels, log-softmax — purely to compute a closed-form
+    quantity; on TPU that is pure HBM traffic.  Conservative conditions:
+    uniform prior only (no PriorDist), soft_label=True, no ignore_index,
+    the xent's Softmax output unused, depth == one_hot attr, and the
+    usual single-consumer chain + protected-fetch safety.  Train-safe:
+    smooth_label_xent differentiates through the generic vjp."""
+
+    def apply(self, program, scope=None):
+        block = program.global_block()
+
+        def consumers_of(name, exclude):
+            # scan EVERY block: a sub-block (While body, cond branch)
+            # reading the var is just as much a consumer as a top-level
+            # op — the while op itself only lists 'Condition' as input
+            return [
+                op
+                for blk in program.blocks
+                for op in blk.ops
+                if op is not exclude and name in op.input_arg_names()
+            ]
+
+        def fuse(chain):
+            oh, smooth, xent = chain
+            if not bool(xent.attrs.get("soft_label", False)):
+                return False
+            if int(xent.attrs.get("ignore_index", -100)) >= 0:
+                return False
+            if smooth.inputs.get("PriorDist"):
+                return False  # closed form assumes the uniform prior
+            if not _chain_safe(program, chain):
+                return False
+            softmax_out = xent.outputs.get("Softmax", [None])[0]
+            if softmax_out:
+                protected = getattr(program, "_protected_fetch_names", ())
+                if softmax_out in protected or consumers_of(softmax_out,
+                                                            xent):
+                    return False
+            label_name = oh.inputs["X"][0]
+            logits_name = xent.inputs["Logits"][0]
+            lv = block._find_var_recursive(logits_name)
+            # default CLOSED on missing shape info, like every pass here:
+            # the unfused chain fails loudly on a depth mismatch; the
+            # fused form would compute a plausible wrong loss silently
+            if lv is None or lv.shape is None:
+                return False
+            if int(lv.shape[-1]) != int(oh.attrs.get("depth", -1)):
+                return False
+            fused = _mk_op(
+                block,
+                "smooth_label_xent",
+                {"Logits": [logits_name], "Label": [label_name]},
+                {"Loss": list(xent.outputs["Loss"])},
+                {"epsilon": float(smooth.attrs.get("epsilon", 0.0))},
+            )
+            _replace_chain(block, program, chain, [fused])
+            return True
+
+        n = OpPattern(
+            ["one_hot", "label_smooth", "softmax_with_cross_entropy"]
+        ).rewrite(block, fuse)
+        program._smooth_xent_fused_count = n
+        return program

@@ -299,3 +299,140 @@ def test_build_strategy_fuse_knob_applies_pass():
         mid = pe.run(feed={"a": av, "b": bv}, fetch_list=[s_name])
         np.testing.assert_allclose(np.asarray(mid[0]).reshape(av.shape),
                                    av + bv, rtol=1e-5, atol=1e-6)
+
+
+def test_smooth_label_xent_fuse_numeric_and_grads():
+    """one_hot->label_smooth->soft-label-xent folds into ONE
+    smooth_label_xent op with identical loss AND parameter grads (closed
+    form, no [N,V] label arrays; dist_transformer.py loss idiom)."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.transpiler.pass_registry import apply_pass
+
+    B, T, V = 3, 5, 17
+    rng = np.random.RandomState(0)
+    xv = rng.randn(B, T, 8).astype("float32")
+    yv = rng.randint(0, V, (B, T)).astype("int64")
+
+    def build(fuse):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.framework.program_guard(main, startup):
+            startup.random_seed = 11
+            x = layers.data("sx3", shape=[B, T, 8], append_batch_size=False)
+            lbl = layers.data("sy", shape=[B, T], append_batch_size=False,
+                              dtype="int64")
+            logits = layers.fc(x, V, num_flatten_dims=2,
+                               param_attr=fluid.ParamAttr(name="slx_w"))
+            oh = layers.one_hot(lbl, V)
+            sm = layers.label_smooth(oh, epsilon=0.1)
+            cost = layers.softmax_with_cross_entropy(logits, sm,
+                                                     soft_label=True)
+            loss = layers.reduce_mean(cost)
+            if fuse:
+                apply_pass(main, "smooth_label_xent_fuse_pass")
+                types = [op.type for op in main.global_block().ops]
+                assert "smooth_label_xent" in types, types
+                assert "one_hot" not in types and "label_smooth" not in types
+                assert main._smooth_xent_fused_count == 1
+            fluid.optimizer.SGD(0.5).minimize(loss)
+        return main, startup, loss
+
+    results = {}
+    for fuse in (False, True):
+        main, startup, loss = build(fuse)
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            vals = [float(np.asarray(exe.run(
+                main, feed={"sx3": xv, "sy": yv}, fetch_list=[loss])[0]))
+                for _ in range(3)]
+            w = np.array(scope.get("slx_w"))
+        results[fuse] = (vals, w)
+
+    np.testing.assert_allclose(results[False][0], results[True][0],
+                               rtol=1e-5, atol=1e-6)
+    # identical trained weights => identical grads through the fused op
+    np.testing.assert_allclose(results[False][1], results[True][1],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_smooth_label_xent_fuse_guards():
+    """Conservative guards: a consumed Softmax output or a PriorDist
+    input must block the rewrite."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.transpiler.pass_registry import apply_pass
+
+    B, V = 4, 7
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.framework.program_guard(main, startup):
+        x = layers.data("gx", shape=[B, 8], append_batch_size=False)
+        lbl = layers.data("gy", shape=[B, 1], append_batch_size=False,
+                          dtype="int64")
+        logits = layers.fc(x, V)
+        oh = layers.one_hot(lbl, V)
+        sm = layers.label_smooth(oh, epsilon=0.1)
+        cost, softmax = layers.softmax_with_cross_entropy(
+            logits, sm, soft_label=True, return_softmax=True)
+        out = layers.reduce_mean(cost) + layers.reduce_mean(softmax)
+    apply_pass(main, "smooth_label_xent_fuse_pass")
+    types = [op.type for op in main.global_block().ops]
+    assert "smooth_label_xent" not in types  # Softmax consumed -> no fuse
+    assert main._smooth_xent_fused_count == 0
+
+    # PriorDist guard: a non-uniform prior blocks the uniform closed form
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.framework.program_guard(main2, startup2):
+        x = layers.data("gx2", shape=[B, 8], append_batch_size=False)
+        lbl = layers.data("gy2", shape=[B, 1], append_batch_size=False,
+                          dtype="int64")
+        prior = layers.data("gp2", shape=[V], append_batch_size=False)
+        logits = layers.fc(x, V)
+        oh = layers.one_hot(lbl, V)
+        sm = layers.label_smooth(oh, prior_dist=prior, epsilon=0.1)
+        cost = layers.softmax_with_cross_entropy(logits, sm, soft_label=True)
+        layers.reduce_mean(cost)
+    apply_pass(main2, "smooth_label_xent_fuse_pass")
+    types2 = [op.type for op in main2.global_block().ops]
+    assert "smooth_label_xent" not in types2, types2
+    assert main2._smooth_xent_fused_count == 0
+
+
+def test_smooth_label_xent_out_of_range_labels_match_unfused():
+    """-1 padding label ids: one_hot emits an all-zero row, so the loss
+    there is only the smoothing term — the fused op must match exactly
+    (take_along_axis would otherwise wrap to the last vocab entry)."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.transpiler.pass_registry import apply_pass
+
+    N, V = 6, 9
+    rng = np.random.RandomState(3)
+    xv = rng.randn(N, V).astype("float32")
+    yv = rng.randint(0, V, (N, 1)).astype("int64")
+    yv[1, 0] = -1
+    yv[4, 0] = V + 3
+
+    def run(fuse):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.framework.program_guard(main, startup):
+            lg = layers.data("ox", shape=[N, V], append_batch_size=False)
+            lbl = layers.data("oy", shape=[N, 1], append_batch_size=False,
+                              dtype="int64")
+            oh = layers.one_hot(lbl, V)
+            sm = layers.label_smooth(oh, epsilon=0.1)
+            cost = layers.softmax_with_cross_entropy(lg, sm, soft_label=True)
+            if fuse:
+                apply_pass(main, "smooth_label_xent_fuse_pass")
+                assert main._smooth_xent_fused_count == 1
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            return np.asarray(exe.run(main, feed={"ox": xv, "oy": yv},
+                                      fetch_list=[cost])[0])
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-5, atol=1e-6)
