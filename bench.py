@@ -147,6 +147,29 @@ def _bench_impl():
     if mfu is not None:
         result["mfu"] = round(mfu, 4)
 
+    # BENCH_INNER=K: also time K steps inside ONE compiled lax.scan
+    # (Executor.run_loop) — separates device throughput from per-step
+    # host/tunnel dispatch; the delta vs the headline IS the dispatch tax
+    inner = int(os.environ.get("BENCH_INNER", "0"))
+    if inner > 0 and not use_reader:
+        out = exe.run_loop(inner, main_prog, feed=feed,
+                           fetch_list=fetches, return_numpy=False)
+        jax.block_until_ready(out)  # compile + warm
+        t0 = time.time()
+        out = exe.run_loop(inner, main_prog, feed=feed,
+                           fetch_list=fetches, return_numpy=False)
+        jax.block_until_ready(out)
+        dt_in = time.time() - t0
+        ips_in = batch_size * inner / dt_in
+        result["inner_loop"] = {
+            "iters": inner,
+            "images_per_sec": round(ips_in, 2),
+            "dispatch_tax_pct": round(max(0.0, 1 - ips / ips_in) * 100, 1),
+        }
+        m_in = flops_util.mfu(step_flops, inner, dt_in, device)
+        if m_in is not None:
+            result["inner_loop"]["mfu"] = round(m_in, 4)
+
     if os.environ.get("BENCH_TRANSFORMER", "1") == "1":
         try:
             result["transformer"] = _transformer_bench(on_tpu, device)

@@ -49,18 +49,76 @@ class Executor:
         self._key_cache = {}
         self._closed = False
 
-    def _rng_key(self, program):
+    def _commit_state(self, n, v, device, scope):
+        """Normalize state to a COMMITTED on-device array.  Startup
+        outputs are uncommitted (no committed inputs feed them) while
+        train feeds are device_put -> committed; without this the first
+        train run flips every param to committed and the jit cache
+        misses, silently COMPILING THE WHOLE PROGRAM TWICE (minutes
+        through a TPU tunnel).  Committed same-device arrays pass through
+        untouched; numpy state (checkpoint loads) uploads once — the
+        device array is written back to the scope so read-only weights
+        are not re-uploaded per step."""
+        if isinstance(v, jax.Array):
+            if getattr(v, "committed", True) and device in v.devices():
+                return v
+        elif not isinstance(v, np.ndarray):
+            return v
+        arr = jax.device_put(v, device)
+        scope.set(n, arr)
+        return arr
+
+    def _rng_base(self, program):
         # base key derives from the program's seed (per-program, so
-        # main_program.random_seed is honored even after the startup run);
-        # folding in the step counter advances streams across runs
+        # main_program.random_seed is honored even after the startup run)
         seed = int(program.random_seed)
         base = self._key_cache.get(seed)
         if base is None:
             base = jax.random.PRNGKey(seed if seed != 0 else 90157)
             self._key_cache[seed] = base
-        key = jax.random.fold_in(base, self._step)
+        return base
+
+    def _rng_key(self, program):
+        # folding in the step counter advances streams across runs
+        key = jax.random.fold_in(self._rng_base(program), self._step)
         self._step += 1
         return key
+
+    def _prepare_feed(self, program, feed, device):
+        """device_put feeds with the LoDTensor padded+lengths expansion
+        and the kind-level dtype guard (DataFeeder enforce analog) —
+        shared by run() and run_loop()."""
+        from .lod import LoDTensor
+
+        feed_arrays = {}
+        for name, value in feed.items():
+            if isinstance(value, LoDTensor):
+                # ragged feed: pass the padded data; expose lengths as
+                # `<name>@SEQ_LEN` if the program wants them
+                feed_arrays[name] = jax.device_put(
+                    jnp.asarray(value.data), device)
+                feed_arrays[name + "@SEQ_LEN"] = jax.device_put(
+                    jnp.asarray(value.seq_lens()), device
+                )
+                continue
+            arr = jnp.asarray(value)
+            var = program.global_block()._find_var_recursive(name)
+            if var is not None and var.dtype:
+                # kind-level check (int vs float vs bool): silently
+                # flooring float ids into an embedding lookup is the
+                # classic garbage-in bug the reference's DataFeeder
+                # enforce guards against; width-only differences
+                # (int32/int64, f32/f64) stay allowed
+                want = _dtype_kind(var.dtype)
+                got = _dtype_kind(arr.dtype)
+                if want != got and {want, got} != {"i", "u"}:
+                    raise TypeError(
+                        "feed '%s' has dtype %s but the program declares "
+                        "%s — cast the feed (DataFeeder does this) or fix "
+                        "the data layer dtype" % (name, arr.dtype, var.dtype)
+                    )
+            feed_arrays[name] = jax.device_put(arr, device)
+        return feed_arrays
 
     def run(
         self,
@@ -95,35 +153,7 @@ class Executor:
         ]
 
         device = self.place.jax_device()
-        feed_arrays = {}
-        from .lod import LoDTensor
-
-        for name, value in feed.items():
-            if isinstance(value, LoDTensor):
-                # ragged feed: pass the padded data; expose lengths as
-                # `<name>@SEQ_LEN` if the program wants them
-                feed_arrays[name] = jax.device_put(jnp.asarray(value.data), device)
-                feed_arrays[name + "@SEQ_LEN"] = jax.device_put(
-                    jnp.asarray(value.seq_lens()), device
-                )
-            else:
-                arr = jnp.asarray(value)
-                var = program.global_block()._find_var_recursive(name)
-                if var is not None and var.dtype:
-                    # kind-level check (int vs float vs bool): silently
-                    # flooring float ids into an embedding lookup is the
-                    # classic garbage-in bug the reference's DataFeeder
-                    # enforce guards against; width-only differences
-                    # (int32/int64, f32/f64) stay allowed
-                    want = _dtype_kind(var.dtype)
-                    got = _dtype_kind(arr.dtype)
-                    if want != got and {want, got} != {"i", "u"}:
-                        raise TypeError(
-                            "feed '%s' has dtype %s but the program declares "
-                            "%s — cast the feed (DataFeeder does this) or fix "
-                            "the data layer dtype" % (name, arr.dtype, var.dtype)
-                        )
-                feed_arrays[name] = jax.device_put(arr, device)
+        feed_arrays = self._prepare_feed(program, feed, device)
 
         # in-program readers: satisfy `read` op outputs from the staged
         # device queue (create_py_reader/double_buffer analog — host IO
@@ -153,31 +183,14 @@ class Executor:
         compiled = self._cache.get(program, 0, feed_sig, fetch_names, scope)
         traced = compiled.traced
 
-        def _committed(n, v):
-            # Normalize state to a COMMITTED on-device array.  Startup
-            # outputs are uncommitted (no committed inputs feed them) while
-            # train feeds are device_put -> committed; without this the
-            # first train run flips every param to committed and the jit
-            # cache misses, silently COMPILING THE WHOLE PROGRAM TWICE
-            # (minutes through a TPU tunnel).  Committed same-device
-            # arrays pass through untouched; numpy state (checkpoint
-            # loads) uploads once — the device array is written back to
-            # the scope so read-only weights are not re-uploaded per step.
-            if isinstance(v, jax.Array):
-                if getattr(v, "committed", True) and device in v.devices():
-                    return v
-            elif not isinstance(v, np.ndarray):
-                return v
-            arr = jax.device_put(v, device)
-            scope.set(n, arr)
-            return arr
-
         ro_state = {}
         for n in traced.ro_names:
-            ro_state[n] = _committed(n, scope.find_var(n))
+            ro_state[n] = self._commit_state(n, scope.find_var(n), device,
+                                             scope)
         rw_state = {}
         for n in traced.rw_names:
-            rw_state[n] = _committed(n, scope.find_var(n))
+            rw_state[n] = self._commit_state(n, scope.find_var(n), device,
+                                             scope)
 
         key = self._rng_key(program)
         from .flags import get_flag
@@ -221,6 +234,132 @@ class Executor:
             return [as_numpy(f) for f in fetches]
         return list(fetches)
 
+    def run_loop(
+        self,
+        iters,
+        program=None,
+        feed=None,
+        fetch_list=None,
+        scope=None,
+        return_numpy=True,
+    ):
+        """Run `iters` steps of `program` as ONE compiled device call —
+        a lax.scan over the traced step with all read-write state (params,
+        optimizer moments, BN stats) threaded through the carry.
+
+        The per-step host dispatch of run() disappears entirely: one
+        launch executes the whole window on-device (the TPU-first form of
+        the reference benchmark's iters-per-Run loop, and the tool that
+        separates device throughput from host/tunnel dispatch overhead).
+        Feeds stay CONSTANT across iterations — this is the steady-state
+        benchmark/fixed-batch shape; for data iteration use run() or the
+        in-program py_reader path.  RNG advances per iteration (each step
+        folds its loop index), matching run()'s stream contract.
+
+        Returns the LAST iteration's fetches; scope state afterwards is
+        exactly as after `iters` sequential run() calls."""
+        iters = int(iters)
+        if iters <= 0:
+            raise ValueError("run_loop: iters must be positive")
+        if self._closed:
+            raise RuntimeError("Executor is closed")
+        if program is None:
+            program = framework.default_main_program()
+        if scope is None:
+            scope = global_scope()
+        ops = program.global_block().ops
+        if any(op.type in ("listen_and_serv", "read") for op in ops):
+            raise ValueError(
+                "run_loop cannot iterate programs with host-boundary ops "
+                "(py_reader 'read' / listen_and_serv) — their IO happens "
+                "at the executor boundary, outside the compiled loop"
+            )
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        fetch_names = [
+            v.name if isinstance(v, framework.Variable) else str(v)
+            for v in fetch_list
+        ]
+        device = self.place.jax_device()
+        feed_arrays = self._prepare_feed(program, feed, device)
+        feed_sig = tuple(
+            sorted((n, tuple(a.shape), str(a.dtype))
+                   for n, a in feed_arrays.items())
+        )
+        from .flags import get_flag
+
+        cache_key = (
+            id(program), program._version, feed_sig, tuple(fetch_names),
+            iters, id(scope), bool(get_flag("use_pallas")),
+        )
+        hit = getattr(self, "_loop_cache", None)
+        if hit is None:
+            hit = self._loop_cache = {}
+        entry = hit.get(cache_key)
+        if entry is None:
+            from .core.trace import build_traced_function
+
+            traced = build_traced_function(
+                program, 0, tuple(n for n, _, _ in feed_sig), fetch_names,
+                scope
+            )
+            rw_set = set(traced.rw_names)
+            fresh = [n for n in traced.updated if n not in rw_set]
+
+            def loop_fn(feeds, ro_state, rw_state, keys):
+                # first iteration outside the scan establishes the carry
+                # shapes for fetches/fresh state; the rest thread through
+                # the carry (O(1) HBM — nothing is stacked over iters)
+                f0, n0 = traced.fn(feeds, ro_state, rw_state, keys[0])
+                carry0 = (
+                    {n: n0[n] for n in traced.rw_names},
+                    tuple(f0),
+                    {n: n0[n] for n in fresh},
+                )
+
+                def body(carry, key):
+                    rw, _, _ = carry
+                    f, ns = traced.fn(feeds, ro_state, rw, key)
+                    return (
+                        {n: ns[n] for n in traced.rw_names},
+                        tuple(f),
+                        {n: ns[n] for n in fresh},
+                    ), None
+
+                (rw, fetches, extra), _ = jax.lax.scan(
+                    body, carry0, keys[1:]
+                )
+                final_state = dict(rw)
+                final_state.update(extra)
+                return list(fetches), final_state
+
+            jitted = jax.jit(loop_fn, donate_argnums=(2,))
+            entry = hit[cache_key] = (traced, jitted)
+        traced, jitted = entry
+
+        ro_state = {
+            n: self._commit_state(n, scope.find_var(n), device, scope)
+            for n in traced.ro_names
+        }
+        rw_state = {
+            n: self._commit_state(n, scope.find_var(n), device, scope)
+            for n in traced.rw_names
+        }
+        # EXACT run() stream parity: iteration i uses fold_in(base,
+        # step0 + i) — the same key i sequential run() calls would draw
+        base = self._rng_base(program)
+        step0 = self._step
+        self._step += iters
+        keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
+            jnp.arange(step0, step0 + iters)
+        )
+        fetches, new_state = jitted(feed_arrays, ro_state, rw_state, keys)
+        for n, v in new_state.items():
+            scope.set(n, v)
+        if return_numpy:
+            return [as_numpy(f) for f in fetches]
+        return list(fetches)
+
     def close(self):
         """Release cached executables and notify pservers this trainer is
         done (Executor::Close -> SendComplete analog, executor.h:91)."""
@@ -228,6 +367,8 @@ class Executor:
 
         distributed.send_complete_all()
         self._cache.clear()
+        if getattr(self, "_loop_cache", None):
+            self._loop_cache.clear()
         self._closed = True
 
     # infer_* helpers used by contrib Trainer/Inferencer

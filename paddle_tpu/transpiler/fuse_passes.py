@@ -515,6 +515,15 @@ class SmoothLabelXentFusePass(Pass):
                 if softmax_out in protected or consumers_of(softmax_out,
                                                             xent):
                     return False
+            # OpPattern's single-consumer scan only covers the global
+            # block: a sub-block reading an intermediate would be left
+            # dangling by the rewrite
+            oh_out = oh.outputs["Out"][0]
+            sm_out = smooth.outputs["Out"][0]
+            if any(c is not smooth for c in consumers_of(oh_out, oh)):
+                return False
+            if any(c is not xent for c in consumers_of(sm_out, smooth)):
+                return False
             label_name = oh.inputs["X"][0]
             logits_name = xent.inputs["Logits"][0]
             lv = block._find_var_recursive(logits_name)

@@ -136,3 +136,80 @@ def test_no_hidden_recompile_across_steps():
         assert compiled.jitted._cache_size() == 1, (
             "hidden recompile: one ExecutionCache entry compiled %d times"
             % compiled.jitted._cache_size())
+
+
+def test_run_loop_matches_sequential_runs():
+    """Executor.run_loop(K): ONE compiled lax.scan call == K sequential
+    run() calls — identical final weights and identical last-step loss
+    (deterministic program), and the loop executable compiles once."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    rng = np.random.RandomState(0)
+    xv = rng.rand(16, 12).astype("float32")
+    yv = rng.randint(0, 3, (16, 1)).astype("int64")
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.framework.program_guard(main, startup):
+            startup.random_seed = 7
+            main.random_seed = 7
+            x = layers.data("rlx", shape=[12])
+            y = layers.data("rly", shape=[1], dtype="int64")
+            h = layers.fc(x, 16, act="relu",
+                          param_attr=fluid.ParamAttr(name="rl_w1"))
+            # dropout makes the test ALSO pin exact RNG-stream parity:
+            # iteration i of the loop must draw run()'s step-i keys
+            h = layers.dropout(h, 0.3)
+            p = layers.fc(h, 3, act="softmax",
+                          param_attr=fluid.ParamAttr(name="rl_w2"))
+            loss = layers.mean(layers.cross_entropy(p, y))
+            fluid.optimizer.Momentum(0.1, momentum=0.9).minimize(loss)
+        return main, startup, loss
+
+    K = 5
+    # sequential reference
+    main, startup, loss = build()
+    s1 = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(s1):
+        exe.run(startup)
+        for _ in range(K):
+            (seq_loss,) = exe.run(main, feed={"rlx": xv, "rly": yv},
+                                  fetch_list=[loss])
+        w_seq = np.array(s1.get("rl_w1"))
+
+    # one compiled loop
+    main2, startup2, loss2 = build()
+    s2 = fluid.Scope()
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(s2):
+        exe2.run(startup2)
+        (loop_loss,) = exe2.run_loop(K, main2,
+                                     feed={"rlx": xv, "rly": yv},
+                                     fetch_list=[loss2])
+        w_loop = np.array(s2.get("rl_w1"))
+        # repeat from the updated state: cache hit, state threads on
+        (loop_loss2,) = exe2.run_loop(K, main2,
+                                      feed={"rlx": xv, "rly": yv},
+                                      fetch_list=[loss2])
+        assert len(exe2._loop_cache) == 1
+        (_, jitted), = exe2._loop_cache.values()
+        assert jitted._cache_size() == 1, jitted._cache_size()
+
+    np.testing.assert_allclose(np.asarray(loop_loss),
+                               np.asarray(seq_loss), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(w_loop, w_seq, rtol=1e-5, atol=1e-6)
+    assert float(np.asarray(loop_loss2)) < float(np.asarray(loop_loss))
+
+    # host-boundary ops are rejected
+    import pytest
+
+    mainr, startupr = fluid.Program(), fluid.Program()
+    with fluid.framework.program_guard(mainr, startupr):
+        r = layers.py_reader(capacity=2, shapes=[[-1, 4]], dtypes=["float32"])
+        xr = layers.read_file(r)
+        layers.reduce_sum(xr)
+    with pytest.raises(ValueError, match="host-boundary"):
+        exe2.run_loop(2, mainr)
