@@ -28,6 +28,91 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 BASELINE_IMAGES_PER_SEC = 82.35  # reference ResNet-50 train, bs128 (BASELINE.md)
 
+# Advisory single-chip lock: a probe/bench while ANOTHER bench holds the
+# chip makes both look wedged (each other's children time out), so every
+# top-level bench.py serializes on this pidfile — the watcher's capture
+# legs and the driver's round-end run interleave instead of colliding.
+# The file stores "pid starttime" (the /proc birth tick), so a recycled
+# PID never masquerades as a live holder; children of a locked bench see
+# _BENCH_LOCK_OWNER in their env and are exempt (the parent's own probe
+# must not be blocked by the parent's own lock).
+_LOCK_PATH = "/tmp/paddle_tpu_bench.lock"
+
+
+def _proc_start(pid):
+    """Process birth tick from /proc (field 22), or None if not alive."""
+    try:
+        with open("/proc/%d/stat" % pid, "rb") as f:
+            after_comm = f.read().split(b")")[-1].split()
+        return after_comm[19].decode()
+    except (OSError, IndexError, ValueError):
+        return None
+
+
+def _lock_holder():
+    """PID of a LIVE other bench holding the lock, else None (absent,
+    stale-dead, or PID-recycled locks all count as unheld)."""
+    try:
+        with open(_LOCK_PATH) as f:
+            parts = f.read().split()
+        pid = int(parts[0])
+        start = parts[1] if len(parts) > 1 else None
+    except (OSError, ValueError, IndexError):
+        return None
+    if pid <= 0 or pid == os.getpid():
+        return None
+    live_start = _proc_start(pid)
+    if live_start is None or (start and start != live_start):
+        return None  # dead, or the PID was recycled by another process
+    return pid
+
+
+def _acquire_lock(wait_s):
+    """Serialize on the pidfile (O_EXCL create).  Returns True when the
+    lock is ours; False when we proceed WITHOUT it (timeout or an
+    unwritable lock path — both loudly logged, never silent)."""
+    deadline = time.time() + wait_s
+    token = "%d %s" % (os.getpid(), _proc_start(os.getpid()) or "?")
+    while True:
+        holder = _lock_holder()
+        if holder is None:
+            if os.path.exists(_LOCK_PATH):
+                try:  # verified-stale file blocks O_EXCL: clear it
+                    os.remove(_LOCK_PATH)
+                except OSError:
+                    pass
+            try:
+                fd = os.open(_LOCK_PATH,
+                             os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            except FileExistsError:
+                time.sleep(1)  # lost the creation race; re-check holder
+                continue
+            except OSError as e:
+                sys.stderr.write(
+                    "bench: cannot create lock file (%r) — running "
+                    "UNSERIALIZED\n" % (e,))
+                return False
+            os.write(fd, token.encode())
+            os.close(fd)
+            os.environ["_BENCH_LOCK_OWNER"] = str(os.getpid())
+            return True
+        if time.time() >= deadline:
+            sys.stderr.write(
+                "bench: lock still held by pid %d after %ds — proceeding "
+                "anyway\n" % (holder, wait_s))
+            os.environ["_BENCH_LOCK_OWNER"] = str(holder)
+            return False
+        time.sleep(15)
+
+
+def _release_lock():
+    try:
+        with open(_LOCK_PATH) as f:
+            if int(f.read().split()[0]) == os.getpid():
+                os.remove(_LOCK_PATH)
+    except (OSError, ValueError, IndexError):
+        pass
+
 
 def _bench_impl():
     import numpy as np
@@ -604,9 +689,30 @@ def _latest_tpu_capture():
 
 def main():
     if os.environ.get("_BENCH_PROBE") == "1":
+        holder = _lock_holder()
+        if holder is not None and str(holder) != os.environ.get(
+                "_BENCH_LOCK_OWNER"):
+            # another bench owns the chip: probing now would both fail
+            # AND disturb its timing — report unreachable instead.  (A
+            # probe spawned BY the lock-holding bench is exempt via
+            # _BENCH_LOCK_OWNER, else every locked run would self-block.)
+            sys.stderr.write("bench: probe skipped, lock held\n")
+            return
         return _probe_impl()
     if os.environ.get("_BENCH_CHILD") == "1":
-        return _bench_impl()
+        return _bench_impl()  # children run under the parent's lock
+
+    # serialize whole-bench runs (watcher legs vs the driver's round-end
+    # run); each leg releases on exit, so a waiting run proceeds within
+    # one leg's duration
+    _acquire_lock(int(os.environ.get("BENCH_LOCK_WAIT", "2700")))
+    try:
+        return _main_locked()
+    finally:
+        _release_lock()
+
+
+def _main_locked():
 
     # 0) pre-flight: skip the expensive TPU attempt entirely when the
     # tunnel cannot even enumerate devices — probed up to BENCH_TPU_ATTEMPTS
