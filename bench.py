@@ -49,15 +49,14 @@ def _proc_start(pid):
         return None
 
 
-def _lock_holder():
-    """PID of a LIVE other bench holding the lock, else None (absent,
-    stale-dead, or PID-recycled locks all count as unheld)."""
+def _holder_of(content):
+    """PID of a LIVE other bench named by lock `content`, else None
+    (malformed, dead, or PID-recycled tokens all count as unheld)."""
     try:
-        with open(_LOCK_PATH) as f:
-            parts = f.read().split()
+        parts = content.split()
         pid = int(parts[0])
         start = parts[1] if len(parts) > 1 else None
-    except (OSError, ValueError, IndexError):
+    except (ValueError, IndexError):
         return None
     if pid <= 0 or pid == os.getpid():
         return None
@@ -65,6 +64,49 @@ def _lock_holder():
     if live_start is None or (start and start != live_start):
         return None  # dead, or the PID was recycled by another process
     return pid
+
+
+def _lock_holder():
+    try:
+        with open(_LOCK_PATH) as f:
+            return _holder_of(f.read())
+    except OSError:
+        return None
+
+
+def _try_clear_stale():
+    """Remove the lock file iff it still holds the dead token we just
+    judged stale.  The atomic rename claims the file so only one
+    contender clears it; the content re-check (plus no-clobber restore)
+    closes the race where another bench replaced the stale file with its
+    own fresh lock between our read and our rename."""
+    try:
+        with open(_LOCK_PATH) as f:
+            content = f.read()
+    except OSError:
+        return
+    if _holder_of(content) is not None:
+        return  # became live again — leave it
+    claimed = "%s.stale.%d" % (_LOCK_PATH, os.getpid())
+    try:
+        os.rename(_LOCK_PATH, claimed)
+    except OSError:
+        return  # someone else claimed or removed it first
+    try:
+        with open(claimed) as f:
+            now = f.read()
+    except OSError:
+        return
+    if now != content and _holder_of(now) is not None:
+        try:  # we stole a FRESH lock: restore it (no-clobber via link)
+            os.link(claimed, _LOCK_PATH)
+        except OSError:
+            sys.stderr.write("bench: lock takeover race — a live lock "
+                             "was displaced and could not be restored\n")
+    try:
+        os.remove(claimed)
+    except OSError:
+        pass
 
 
 def _acquire_lock(wait_s):
@@ -77,32 +119,32 @@ def _acquire_lock(wait_s):
         holder = _lock_holder()
         if holder is None:
             if os.path.exists(_LOCK_PATH):
-                try:  # verified-stale file blocks O_EXCL: clear it
-                    os.remove(_LOCK_PATH)
-                except OSError:
-                    pass
+                _try_clear_stale()  # verified-stale file blocks O_EXCL
             try:
                 fd = os.open(_LOCK_PATH,
                              os.O_CREAT | os.O_EXCL | os.O_WRONLY)
             except FileExistsError:
-                time.sleep(1)  # lost the creation race; re-check holder
-                continue
+                pass  # lost the creation race; deadline check below
             except OSError as e:
                 sys.stderr.write(
                     "bench: cannot create lock file (%r) — running "
                     "UNSERIALIZED\n" % (e,))
                 return False
-            os.write(fd, token.encode())
-            os.close(fd)
-            os.environ["_BENCH_LOCK_OWNER"] = str(os.getpid())
-            return True
+            else:
+                os.write(fd, token.encode())
+                os.close(fd)
+                os.environ["_BENCH_LOCK_OWNER"] = str(os.getpid())
+                return True
         if time.time() >= deadline:
             sys.stderr.write(
-                "bench: lock still held by pid %d after %ds — proceeding "
-                "anyway\n" % (holder, wait_s))
-            os.environ["_BENCH_LOCK_OWNER"] = str(holder)
+                "bench: lock still held%s after %ds — proceeding "
+                "anyway\n" % (
+                    " by pid %d" % holder if holder else "", wait_s))
+            # "*" = unserialized: our own probes must never self-skip,
+            # whoever holds the lock now or later
+            os.environ["_BENCH_LOCK_OWNER"] = "*"
             return False
-        time.sleep(15)
+        time.sleep(1 if holder is None else 15)
 
 
 def _release_lock():
@@ -151,6 +193,9 @@ def _bench_impl():
     # have PCIe/DMA feeding; the reader path is correctness-covered in
     # tests/test_pipeline_and_metrics.py).
     use_reader = os.environ.get("BENCH_READER", "0") == "1"
+    # BENCH_LAYOUT=NHWC runs the conv trunk channels-last via the
+    # nhwc_layout_pass (transposes only at trunk boundaries)
+    use_nhwc = os.environ.get("BENCH_LAYOUT", "NCHW").upper() == "NHWC"
     place = fluid.TPUPlace(0) if on_tpu else fluid.CPUPlace()
     device = place.jax_device()
 
@@ -161,7 +206,7 @@ def _bench_impl():
     if use_reader:
         main_prog, startup, feeds, fetches, reader = build_resnet_train_program(
             image_shape=(3, image_hw, image_hw), class_dim=1000, depth=50,
-            lr=0.1, use_bf16=use_bf16, use_reader_op=True,
+            lr=0.1, use_bf16=use_bf16, use_nhwc=use_nhwc, use_reader_op=True,
         )
 
         def batches():
@@ -176,7 +221,7 @@ def _bench_impl():
     else:
         main_prog, startup, feeds, fetches = build_resnet_train_program(
             image_shape=(3, image_hw, image_hw), class_dim=1000, depth=50,
-            lr=0.1, use_bf16=use_bf16,
+            lr=0.1, use_bf16=use_bf16, use_nhwc=use_nhwc,
         )
         exe = fluid.Executor(place)
         exe.run(startup)
@@ -690,8 +735,8 @@ def _latest_tpu_capture():
 def main():
     if os.environ.get("_BENCH_PROBE") == "1":
         holder = _lock_holder()
-        if holder is not None and str(holder) != os.environ.get(
-                "_BENCH_LOCK_OWNER"):
+        owner = os.environ.get("_BENCH_LOCK_OWNER")
+        if holder is not None and owner != "*" and str(holder) != owner:
             # another bench owns the chip: probing now would both fail
             # AND disturb its timing — report unreachable instead.  (A
             # probe spawned BY the lock-holding bench is exempt via

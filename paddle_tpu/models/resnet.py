@@ -96,13 +96,16 @@ def build_resnet_train_program(
     optimizer="momentum",
     dtype="float32",
     use_bf16=False,
+    use_nhwc=False,
     use_reader_op=False,
     reader_capacity=8,
 ):
     """Build (main_program, startup_program, feeds, fetches) for training —
     convenience mirroring the benchmark driver's model setup.  use_bf16
     applies the AMP rewrite (bf16 convs/matmuls on the MXU, f32 master
-    weights) before the optimizer pass.  use_reader_op builds the
+    weights) before the optimizer pass.  use_nhwc converts the conv trunk
+    to channels-last via the nhwc_layout_pass (run first, so the inserted
+    transposes ride the AMP trunk propagation).  use_reader_op builds the
     `--use_reader_op` fast path (fluid_benchmark.py): inputs come from an
     in-program py_reader instead of feed, returned as a 5th element."""
     import paddle_tpu as fluid
@@ -125,6 +128,10 @@ def build_resnet_train_program(
         cost = layers.cross_entropy(input=predict, label=label)
         avg_cost = layers.mean(cost)
         acc = layers.accuracy(input=predict, label=label)
+        if use_nhwc:
+            from paddle_tpu.transpiler.layout_transpiler import rewrite_nhwc
+
+            rewrite_nhwc(main)
         if use_bf16:
             from paddle_tpu.contrib.mixed_precision import rewrite_bf16
 

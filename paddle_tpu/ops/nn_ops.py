@@ -31,6 +31,7 @@ def _conv2d_impl(x, w, attrs, groups=None):
     paddings = _pair(attrs.get("paddings", [0, 0]))
     dilations = _pair(attrs.get("dilations", [1, 1]))
     groups = groups if groups is not None else attrs.get("groups", 1) or 1
+    fmt = attrs.get("data_format", "NCHW")  # nhwc_layout_pass sets NHWC
     pad = [(paddings[0], paddings[0]), (paddings[1], paddings[1])]
     return jax.lax.conv_general_dilated(
         x,
@@ -38,10 +39,16 @@ def _conv2d_impl(x, w, attrs, groups=None):
         window_strides=strides,
         padding=pad,
         rhs_dilation=dilations,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        dimension_numbers=(fmt, "OIHW", fmt),
         feature_group_count=groups,
         preferred_element_type=jnp.float32 if x.dtype == jnp.float32 else None,
     )
+
+
+def _bias_shape(attrs, ndim=4):
+    shape = [1] * ndim
+    shape[1 if attrs.get("data_format", "NCHW") == "NCHW" else ndim - 1] = -1
+    return shape
 
 
 @register("conv2d")
@@ -49,7 +56,7 @@ def _conv2d(ctx, ins, attrs):
     x, w = ins["Input"][0], ins["Filter"][0]
     out = _conv2d_impl(x, w, attrs)
     if ins.get("Bias"):
-        out = out + ins["Bias"][0].reshape(1, -1, 1, 1)
+        out = out + ins["Bias"][0].reshape(_bias_shape(attrs))
     if attrs.get("fuse_relu"):  # fuse_relu_into_conv_pass epilogue
         out = jnp.maximum(out, 0)
     return {"Output": [out]}
@@ -58,9 +65,10 @@ def _conv2d(ctx, ins, attrs):
 @register("depthwise_conv2d")
 def _depthwise_conv2d(ctx, ins, attrs):
     x, w = ins["Input"][0], ins["Filter"][0]
-    out = _conv2d_impl(x, w, attrs, groups=x.shape[1])
+    ch = x.shape[1 if attrs.get("data_format", "NCHW") == "NCHW" else -1]
+    out = _conv2d_impl(x, w, attrs, groups=ch)
     if ins.get("Bias"):
-        out = out + ins["Bias"][0].reshape(1, -1, 1, 1)
+        out = out + ins["Bias"][0].reshape(_bias_shape(attrs))
     return {"Output": [out]}
 
 
@@ -133,33 +141,39 @@ def _pool2d(ctx, ins, attrs):
     ksize = _pair(attrs.get("ksize", [2, 2]))
     strides = _pair(attrs.get("strides", [1, 1]))
     paddings = _pair(attrs.get("paddings", [0, 0]))
+    nhwc = attrs.get("data_format", "NCHW") == "NHWC"
+    sp = (1, 2) if nhwc else (2, 3)  # spatial axes
     if attrs.get("global_pooling", False) or attrs.get("adaptive", False) and list(
         attrs.get("ksize")
     ) == [1, 1]:
         if ptype == "max":
-            out = jnp.max(x, axis=(2, 3), keepdims=True)
+            out = jnp.max(x, axis=sp, keepdims=True)
         else:
-            out = jnp.mean(x, axis=(2, 3), keepdims=True)
+            out = jnp.mean(x, axis=sp, keepdims=True)
         return {"Out": [out]}
-    window = (1, 1, ksize[0], ksize[1])
-    strides_full = (1, 1, strides[0], strides[1])
-    pads = ((0, 0), (0, 0), (paddings[0], paddings[0]), (paddings[1], paddings[1]))
+
+    def _full(h, w_):
+        # place the spatial (h, w) values on the spatial axes, 1 elsewhere
+        full = [1, 1, 1, 1]
+        full[sp[0]], full[sp[1]] = h, w_
+        return tuple(full)
+
+    window = _full(ksize[0], ksize[1])
+    strides_full = _full(strides[0], strides[1])
+    extra = [0, 0]
     if attrs.get("ceil_mode", False):
         # pad right/bottom so the window count rounds up
-        extra = []
         for i, (dim, k, s, p) in enumerate(
-            zip(x.shape[2:], ksize, strides, paddings)
+            zip((x.shape[sp[0]], x.shape[sp[1]]), ksize, strides, paddings)
         ):
             total = dim + 2 * p
             rem = (total - k) % s
-            extra.append((s - rem) % s if rem else 0)
-        pads = (
-            (0, 0),
-            (0, 0),
-            (paddings[0], paddings[0] + extra[0]),
-            (paddings[1], paddings[1] + extra[1]),
-        )
-    any_padding = any(p != (0, 0) for p in pads[2:])
+            extra[i] = (s - rem) % s if rem else 0
+    pads = [(0, 0)] * 4
+    pads[sp[0]] = (paddings[0], paddings[0] + extra[0])
+    pads[sp[1]] = (paddings[1], paddings[1] + extra[1])
+    pads = tuple(pads)
+    any_padding = any(pads[a] != (0, 0) for a in sp)
     if ptype == "max":
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
         out = jax.lax.reduce_window(x, init, jax.lax.max, window, strides_full, pads)

@@ -8,6 +8,7 @@ from .distribute_transpiler import (
 from .ps_dispatcher import HashName, RoundRobin
 from .memory_optimization_transpiler import memory_optimize, release_memory
 from .inference_transpiler import InferenceTranspiler
+from .layout_transpiler import rewrite_nhwc
 from . import fuse_passes  # noqa: F401  (registers the fusion-pass suite)
 from .pass_registry import (
     OpPattern,

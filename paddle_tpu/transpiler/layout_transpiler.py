@@ -1,0 +1,196 @@
+"""NHWC layout rewrite for convolution trunks.
+
+Reference precedent: ``paddle/fluid/framework/data_layout_transform.*``
+and the mkldnn placement passes (``ir/mkldnn_placement_pass`` family)
+rewrite a program so layout-sensitive ops run in the library's preferred
+layout, with layout transforms only at domain boundaries.  The TPU analog:
+XLA tiles the minor-most dimension onto the 128-wide lane axis, so convs
+whose channel dim is minor (NHWC) avoid the relayout/transpose traffic
+that NCHW operands incur around every conv.  This pass converts every
+conv/pool/BN/activation/residual-add trunk to NHWC:
+
+- one ``transpose2`` where an NCHW var enters a conv,
+- trunk ops propagate NHWC via their ``data_format``/``data_layout``
+  attr (conv2d, depthwise_conv2d, pool2d, batch_norm) or are
+  layout-agnostic (activations, dropout, cast, same-shape
+  elementwise_add),
+- one ``transpose2`` back to NCHW at each exit to a layout-sensitive
+  consumer (reshape/fc/...), emitted lazily only where actually needed.
+
+Run BEFORE ``optimizer.minimize`` (like ``rewrite_bf16``) so the grad ops
+differentiate through the transposes; when combining with AMP, run this
+pass first — the inserted transposes are dtype-transparent trunk ops for
+the AMP propagation.
+
+Caveat (documented in docs/MIGRATION.md): after the rewrite, trunk
+intermediates are produced only as their ``@NHWC`` aliases; fetching one
+of them by name from ``exe.run`` requires fetching the alias (or leaving
+that var out of the trunk).  Vars read by sub-block ops are materialized
+in NCHW automatically.
+"""
+
+from .. import framework
+from ..core.trace import op_sub_blocks
+
+NHWC_PERM = (0, 2, 3, 1)
+NCHW_PERM = (0, 3, 1, 2)
+
+# unary ops whose lowering is elementwise over X -> Out and therefore
+# layout-agnostic (resnet trunks use relu; the rest ride along free)
+_UNARY = ("relu", "relu6", "leaky_relu", "gelu", "sigmoid", "tanh", "sqrt", "abs")
+
+
+def _permuted(shape):
+    if shape and len(shape) == 4:
+        return [shape[i] for i in NHWC_PERM]
+    return list(shape) if shape else shape
+
+
+def _names_read_in_subblocks(block):
+    """Var names referenced by ops living in any sub-block of `block`'s
+    ops — those must keep their NCHW materialization."""
+    names = set()
+    program = block.program
+
+    def visit(b):
+        for op in b.ops:
+            for idx in op_sub_blocks(op):
+                sub = program.block(idx)
+                for sop in sub.ops:
+                    names.update(sop.input_arg_names())
+                    names.update(sop.output_arg_names())
+                visit(sub)
+
+    visit(block)
+    return names
+
+
+def rewrite_nhwc(program=None):
+    """Rewrite (in place) the conv trunk of `program`'s global block to
+    NHWC.  Returns the number of ops flipped to NHWC.  Must run before
+    ``optimizer.minimize`` (and before ``rewrite_bf16`` when combining)."""
+    program = program or framework.default_main_program()
+    block = program.global_block()
+    subblock_reads = _names_read_in_subblocks(block)
+
+    new_ops = []
+    nhwc = {}  # orig var name -> @NHWC alias name
+    materialized = set()  # orig names also produced in NCHW
+    count = 0
+
+    def alias_for(name):
+        """Create (once) the NHWC alias var of `name`."""
+        if name in nhwc:
+            return nhwc[name]
+        v = block._find_var_recursive(name)
+        alias = name + "@NHWC"
+        block.create_var(
+            name=alias,
+            shape=_permuted(list(v.shape)) if v is not None and v.shape else None,
+            dtype=str(v.dtype) if v is not None else "float32",
+        )
+        nhwc[name] = alias
+        return alias
+
+    def _transpose(src, dst, perm):
+        op = framework.Operator(block, "transpose2", None, None, {"axis": list(perm)})
+        op.inputs = {"X": [src]}
+        op.outputs = {"Out": [dst]}
+        new_ops.append(op)
+
+    def to_nhwc(name):
+        """NHWC view of `name`, inserting an entry transpose if needed."""
+        if name in nhwc and _produced_nhwc.get(name):
+            return nhwc[name]
+        alias = alias_for(name)
+        _transpose(name, alias, NHWC_PERM)
+        _produced_nhwc[name] = True
+        return alias
+
+    def to_nchw(name):
+        """Materialize the original NCHW `name` from its NHWC alias (once)."""
+        if name not in nhwc or name in materialized:
+            return
+        _transpose(nhwc[name], name, NCHW_PERM)
+        materialized.add(name)
+
+    # whether the alias var has actually been written in the new op stream
+    _produced_nhwc = {}
+
+    def rewire_out(op, slot):
+        out = op.outputs[slot][0]
+        alias = alias_for(out)
+        op.outputs[slot] = [alias]
+        _produced_nhwc[out] = True
+        return out
+
+    def finish(op, out_name):
+        new_ops.append(op)
+        if out_name in subblock_reads:
+            to_nchw(out_name)
+
+    def var_shape(name):
+        v = block._find_var_recursive(name)
+        return list(v.shape) if v is not None and v.shape else None
+
+    for op in list(block.ops):
+        t = op.type
+        if t in ("conv2d", "depthwise_conv2d") and op.attrs.get("data_format", "NCHW") == "NCHW":
+            x = op.inputs["Input"][0]
+            op.inputs["Input"] = [to_nhwc(x)]
+            op.attrs["data_format"] = "NHWC"
+            out = rewire_out(op, "Output")
+            count += 1
+            finish(op, out)
+            continue
+        if t == "pool2d" and op.inputs["X"][0] in nhwc and op.attrs.get("data_format", "NCHW") == "NCHW":
+            op.inputs["X"] = [to_nhwc(op.inputs["X"][0])]
+            op.attrs["data_format"] = "NHWC"
+            out = rewire_out(op, "Out")
+            count += 1
+            finish(op, out)
+            continue
+        if t == "batch_norm" and op.inputs["X"][0] in nhwc:
+            op.inputs["X"] = [to_nhwc(op.inputs["X"][0])]
+            op.attrs["data_layout"] = "NHWC"
+            out = rewire_out(op, "Y")
+            count += 1
+            finish(op, out)
+            continue
+        if t in _UNARY and op.inputs["X"][0] in nhwc:
+            op.inputs["X"] = [to_nhwc(op.inputs["X"][0])]
+            out = rewire_out(op, "Out")
+            finish(op, out)
+            continue
+        if t == "cast" and op.inputs["X"][0] in nhwc:
+            x = op.inputs["X"][0]
+            op.inputs["X"] = [to_nhwc(x)]
+            out = rewire_out(op, "Out")
+            finish(op, out)
+            continue
+        if t == "dropout" and op.inputs["X"][0] in nhwc:
+            op.inputs["X"] = [to_nhwc(op.inputs["X"][0])]
+            out = rewire_out(op, "Out")
+            if op.outputs.get("Mask"):
+                rewire_out(op, "Mask")
+            finish(op, out)
+            continue
+        if t == "elementwise_add":
+            x, y = op.inputs["X"][0], op.inputs["Y"][0]
+            if (
+                (x in nhwc or y in nhwc)
+                and op.attrs.get("axis", -1) in (-1, 0)
+                and var_shape(x) == var_shape(y)
+            ):
+                op.inputs["X"] = [to_nhwc(x)]
+                op.inputs["Y"] = [to_nhwc(y)]
+                out = rewire_out(op, "Out")
+                finish(op, out)
+                continue
+        # any other consumer needs the original NCHW materialization
+        for name in op.input_arg_names():
+            to_nchw(name)
+        new_ops.append(op)
+
+    block.ops = new_ops
+    return count

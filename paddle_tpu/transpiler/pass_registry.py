@@ -193,6 +193,14 @@ def _bf16_amp(program, scope):
     return program
 
 
+@register_pass("nhwc_layout_pass")
+def _nhwc_layout(program, scope):
+    from .layout_transpiler import rewrite_nhwc
+
+    rewrite_nhwc(program)
+    return program
+
+
 @register_pass("graph_viz_pass")
 def _graph_viz(program, scope):
     """ir/graph_viz_pass.cc analog: dump the program's def-use graph as

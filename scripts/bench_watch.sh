@@ -30,6 +30,9 @@ for i in $(seq 1 85); do
     echo "$(date -u +%H:%M) reader leg done" >> /tmp/tpu_watch.log
     env BENCH_BATCH=256 python bench.py > /tmp/r04_bs256.out 2>> /tmp/tpu_watch.log
     echo "$(date -u +%H:%M) bs256 leg done" >> /tmp/tpu_watch.log
+    env BENCH_LAYOUT=NHWC BENCH_TRANSFORMER=0 python bench.py \
+      > /tmp/r04_nhwc_model.out 2>> /tmp/tpu_watch.log
+    echo "$(date -u +%H:%M) full-model NHWC leg done" >> /tmp/tpu_watch.log
     timeout -k 10 900 python scripts/nhwc_trial.py > /tmp/r04_nhwc.out 2>&1
     echo "$(date -u +%H:%M) nhwc trial done - watcher exiting" >> /tmp/tpu_watch.log
     touch /tmp/r04_capture_done

@@ -1,0 +1,175 @@
+"""NHWC layout pass (transpiler/layout_transpiler.py).
+
+The TPU analog of the reference's data_layout_transform + mkldnn
+placement passes (`paddle/fluid/framework/data_layout_transform.*`):
+conv trunks rewritten to channels-last with transposes only at the
+boundaries, exact-parity with the NCHW program (same math, different
+operand layouts).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.transpiler.layout_transpiler import rewrite_nhwc
+
+
+def _build_trunk(seed=7):
+    """conv -> BN(relu) -> maxpool -> conv -> residual add(relu) ->
+    global avgpool -> fc(softmax) -> xent loss: every trunk op kind the
+    pass handles, ending at a layout-sensitive consumer (fc)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.framework.program_guard(main, startup):
+        startup.random_seed = seed
+        img = layers.data("image", shape=[3, 16, 16], dtype="float32")
+        label = layers.data("label", shape=[1], dtype="int64")
+        conv1 = layers.conv2d(input=img, num_filters=8, filter_size=3,
+                              stride=1, padding=1, bias_attr=False)
+        bn1 = layers.batch_norm(input=conv1, act="relu")
+        pool1 = layers.pool2d(bn1, pool_size=2, pool_stride=2, pool_type="max")
+        conv2 = layers.conv2d(input=pool1, num_filters=8, filter_size=3,
+                              stride=1, padding=1, bias_attr=False)
+        bn2 = layers.batch_norm(input=conv2)
+        res = layers.elementwise_add(pool1, bn2, act="relu")
+        gap = layers.pool2d(res, pool_type="avg", global_pooling=True)
+        predict = layers.fc(input=gap, size=10, act="softmax")
+        cost = layers.cross_entropy(input=predict, label=label)
+        loss = layers.mean(cost)
+    return main, startup, loss
+
+
+def _train(main, startup, loss, steps=3, lr=0.1, minimize=True):
+    if minimize:
+        with fluid.framework.program_guard(main, startup):
+            opt = fluid.optimizer.Momentum(learning_rate=lr, momentum=0.9)
+            opt.minimize(loss)
+    rng = np.random.RandomState(0)
+    x = rng.rand(4, 3, 16, 16).astype("float32")
+    y = rng.randint(0, 10, (4, 1)).astype("int64")
+    losses = []
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for _ in range(steps):
+            (lv,) = exe.run(main, feed={"image": x, "label": y},
+                            fetch_list=[loss])
+            losses.append(float(np.asarray(lv).ravel()[0]))
+    return losses
+
+
+def test_nhwc_rewrite_structure():
+    main, startup, loss = _build_trunk()
+    n = rewrite_nhwc(main)
+    blk = main.global_block()
+    convs = [op for op in blk.ops if op.type == "conv2d"]
+    pools = [op for op in blk.ops if op.type == "pool2d"]
+    bns = [op for op in blk.ops if op.type == "batch_norm"]
+    assert n == len(convs) + len(pools) + len(bns) == 6
+    assert all(op.attrs["data_format"] == "NHWC" for op in convs + pools)
+    assert all(op.attrs["data_layout"] == "NHWC" for op in bns)
+    # exactly ONE entry transpose (the image) and ONE exit transpose
+    # (global-pool output into fc); the trunk itself carries no transposes
+    tps = [op for op in blk.ops if op.type == "transpose2"]
+    assert len(tps) == 2, [str(op) for op in tps]
+    assert tps[0].attrs["axis"] == [0, 2, 3, 1]
+    assert tps[-1].attrs["axis"] == [0, 3, 1, 2]
+    # alias vars carry the permuted static shape
+    conv1_alias = convs[0].outputs["Output"][0]
+    assert conv1_alias.endswith("@NHWC")
+    assert list(blk.var(conv1_alias).shape)[-1] == 8  # channels minor
+
+
+def test_nhwc_training_parity():
+    """3 momentum steps: NHWC program matches NCHW losses (same math,
+    different layout — only reduction-order noise allowed)."""
+    ref = _train(*_build_trunk())
+    main, startup, loss = _build_trunk()
+    rewrite_nhwc(main)
+    got = _train(main, startup, loss)
+    assert not np.allclose(ref, [ref[0]] * len(ref)), "loss must move"
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-6)
+
+
+def test_nhwc_plus_bf16_amp_parity():
+    """Layout pass then AMP: the inserted transposes are dtype-
+    transparent, so the NHWC+bf16 trunk trains at bf16 tolerance of the
+    plain f32 NCHW program."""
+    from paddle_tpu.contrib.mixed_precision import rewrite_bf16
+
+    ref = _train(*_build_trunk())
+    main, startup, loss = _build_trunk()
+    rewrite_nhwc(main)
+    rewrite_bf16(main)
+    got = _train(main, startup, loss)
+    np.testing.assert_allclose(got, ref, rtol=5e-2, atol=5e-2)
+
+
+def test_nhwc_boundary_consumer_gets_nchw():
+    """A non-trunk consumer (reshape) of a conv output forces a lazy
+    transpose back to the ORIGINAL var name; values match NCHW exactly."""
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.framework.program_guard(main, startup):
+            startup.random_seed = 3
+            img = layers.data("image", shape=[3, 8, 8], dtype="float32")
+            conv = layers.conv2d(input=img, num_filters=4, filter_size=3,
+                                 stride=1, padding=1, bias_attr=False)
+            flat = layers.reshape(conv, shape=[0, -1])
+            out = layers.reduce_sum(flat, dim=1)
+        return main, startup, out
+
+    x = np.random.RandomState(5).rand(2, 3, 8, 8).astype("float32")
+
+    def run(rewrite):
+        main, startup, out = build()
+        if rewrite:
+            rewrite_nhwc(main)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            (v,) = exe.run(main, feed={"image": x}, fetch_list=[out])
+        return np.asarray(v)
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-6)
+
+
+def test_nhwc_via_pass_registry():
+    from paddle_tpu.transpiler import apply_pass
+
+    main, startup, loss = _build_trunk()
+    apply_pass(main, "nhwc_layout_pass")
+    assert any(op.type == "transpose2" for op in main.global_block().ops)
+
+
+def test_depthwise_and_ceil_pool_nhwc_parity():
+    """depthwise conv + ceil_mode/exclusive avg pool in NHWC vs NCHW."""
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.framework.program_guard(main, startup):
+            startup.random_seed = 11
+            img = layers.data("image", shape=[6, 9, 9], dtype="float32")
+            conv = layers.conv2d(input=img, num_filters=6, filter_size=3,
+                                 stride=1, padding=1, groups=6,
+                                 bias_attr=False)
+            pool = layers.pool2d(conv, pool_size=2, pool_stride=2,
+                                 pool_type="avg", ceil_mode=True)
+            out = layers.reduce_sum(pool)
+        return main, startup, out
+
+    x = np.random.RandomState(2).rand(2, 6, 9, 9).astype("float32")
+
+    def run(rewrite):
+        main, startup, out = build()
+        if rewrite:
+            rewrite_nhwc(main)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            (v,) = exe.run(main, feed={"image": x}, fetch_list=[out])
+        return np.asarray(v)
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-6)
