@@ -586,6 +586,21 @@ def _transformer_bench(on_tpu, device):
         jax.block_until_ready(out)
         dt = time.time() - t0
 
+        # BENCH_INNER=K: K steps in ONE compiled lax.scan — the delta vs
+        # the headline is the per-step host/tunnel dispatch tax (same
+        # diagnostic as the resnet leg)
+        inner = int(os.environ.get("BENCH_INNER", "0"))
+        dt_in = None
+        if inner > 0:
+            o = exe.run_loop(inner, main, feed=feed, fetch_list=fetches,
+                             return_numpy=False)
+            jax.block_until_ready(o)  # compile + warm
+            t0 = time.time()
+            o = exe.run_loop(inner, main, feed=feed, fetch_list=fetches,
+                             return_numpy=False)
+            jax.block_until_ready(o)
+            dt_in = time.time() - t0
+
     tokens = batch * seq * steps / dt
     step_flops = flops_util.program_flops(main, batch_hint=batch)
     mfu = flops_util.mfu(step_flops, steps, dt, device)
@@ -598,6 +613,17 @@ def _transformer_bench(on_tpu, device):
     }
     if mfu is not None:
         out["mfu"] = round(mfu, 4)
+    if dt_in is not None:
+        tokens_in = batch * seq * inner / dt_in
+        out["inner_loop"] = {
+            "iters": inner,
+            "tokens_per_sec": round(tokens_in, 1),
+            "dispatch_tax_pct": round(
+                max(0.0, 1 - tokens / tokens_in) * 100, 1),
+        }
+        m_in = flops_util.mfu(step_flops, inner, dt_in, device)
+        if m_in is not None:
+            out["inner_loop"]["mfu"] = round(m_in, 4)
     return out
 
 

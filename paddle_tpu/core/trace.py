@@ -389,6 +389,7 @@ class ExecutionCache:
             tuple(fetch_names),
             id(scope),
             bool(get_flag("use_pallas")),
+            get_flag("prng_impl"),
         )
         hit = self._cache.get(key)
         if hit is not None:

@@ -70,12 +70,22 @@ class Executor:
 
     def _rng_base(self, program):
         # base key derives from the program's seed (per-program, so
-        # main_program.random_seed is honored even after the startup run)
+        # main_program.random_seed is honored even after the startup run).
+        # FLAGS_prng_impl=rbg swaps the generator for the TPU-cheap
+        # hardware RBG (typed key so fold_in/bernoulli work unchanged);
+        # the default stays raw threefry for exact stream back-compat.
+        from .flags import get_flag
+
         seed = int(program.random_seed)
-        base = self._key_cache.get(seed)
+        impl = get_flag("prng_impl")
+        base = self._key_cache.get((seed, impl))
         if base is None:
-            base = jax.random.PRNGKey(seed if seed != 0 else 90157)
-            self._key_cache[seed] = base
+            s = seed if seed != 0 else 90157
+            if impl == "threefry":
+                base = jax.random.PRNGKey(s)
+            else:
+                base = jax.random.key(s, impl=impl)
+            self._key_cache[(seed, impl)] = base
         return base
 
     def _rng_key(self, program):
@@ -291,6 +301,7 @@ class Executor:
         cache_key = (
             id(program), program._version, feed_sig, tuple(fetch_names),
             iters, id(scope), bool(get_flag("use_pallas")),
+            get_flag("prng_impl"),
         )
         hit = getattr(self, "_loop_cache", None)
         if hit is None:

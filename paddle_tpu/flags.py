@@ -113,6 +113,12 @@ DEFINE_flag("flash_block_k", 0,
             "flash-attention k-block columns (0 = default 128); a "
             "multiple of 128 (or the full k length) dividing the k "
             "sequence length")
+DEFINE_flag("prng_impl", "threefry",
+            "JAX PRNG for in-program randomness (dropout, *_random, "
+            "sampling): 'threefry' (default; splittable counter stream, "
+            "exact back-compat) or 'rbg' (TPU hardware generator — much "
+            "cheaper mask generation in dropout-heavy models; different "
+            "stream, same distribution).  Toggling recompiles.")
 DEFINE_flag("tpu_bf16_matmul", False,
             "reserved: AMP is the explicit contrib.mixed_precision."
             "rewrite_bf16() program rewrite, not a global flag yet")
