@@ -95,3 +95,106 @@ def _fake_dequantize_max_abs(ctx, ins, attrs):
     x, scale = ins["X"][0], ins["Scale"][0]
     max_range = attrs.get("max_range", 127.0)
     return {"Out": [x * scale.reshape(()) / max_range]}
+
+
+# ---------------------------------------------------------------------------
+# Real-int8 inference ops (the TensorRT-int8 capability, TPU-native:
+# inference/tensorrt/convert/*.cc precedent).  Produced by
+# QuantizeTranspiler.convert_to_int8 from a frozen QAT program: the
+# weight arrives pre-quantized int8 with its scale, the activation is
+# quantized in-op (stored scale when the QAT type kept one, dynamic
+# abs-max otherwise), and the integer accumulation runs at int32 before
+# one fused dequant rescale.
+# ---------------------------------------------------------------------------
+def _act_to_int8(x, ins, rng):
+    """Quantize the f32 activation: InScale (frozen range/moving scale)
+    when present, else dynamic abs-max.  Returns (int8 x, f32 scale)."""
+    if ins.get("InScale"):
+        s = ins["InScale"][0].reshape(())
+    else:
+        s = jnp.max(jnp.abs(x))
+    s = jnp.maximum(s.astype(jnp.float32), 1e-8)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / s * rng), -rng, rng)
+    return q.astype(jnp.int8), s
+
+
+@register("quantized_mul")
+def _quantized_mul(ctx, ins, attrs):
+    x, w = ins["X"][0], ins["Y"][0]  # w: int8 [K, N]
+    rng = float(2 ** (attrs.get("bit_length", 8) - 1) - 1)
+    xn = attrs.get("x_num_col_dims", 1)
+    lead = 1
+    for d in x.shape[:xn]:
+        lead *= d
+    x2 = x.reshape(lead, -1)
+    xq, sx = _act_to_int8(x2, ins, rng)
+    acc = jax.lax.dot_general(
+        xq, w, (((1,), (0,)), ((), ())), preferred_element_type=jnp.int32
+    )
+    sw = ins["WScale"][0].reshape(())  # scalar weight scale (abs_max)
+    out = acc.astype(jnp.float32) * (sx / rng) * (sw / rng)
+    out_shape = tuple(x.shape[:xn]) + tuple(w.shape[1:])
+    return {"Out": [out.reshape(out_shape)]}
+
+
+@register("quantized_matmul")
+def _quantized_matmul(ctx, ins, attrs):
+    x, w = ins["X"][0], ins["Y"][0]
+    rng = float(2 ** (attrs.get("bit_length", 8) - 1) - 1)
+    if attrs.get("transpose_Y", False):
+        w = jnp.swapaxes(w, -1, -2)
+    xq, sx = _act_to_int8(x, ins, rng)
+    if attrs.get("transpose_X", False):
+        xq = jnp.swapaxes(xq, -1, -2)
+    acc = jax.lax.dot_general(
+        xq, w,
+        (((xq.ndim - 1,), (w.ndim - 2,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    sw = ins["WScale"][0].reshape(())
+    alpha = attrs.get("alpha", 1.0)
+    out = acc.astype(jnp.float32) * (alpha * sx / rng) * (sw / rng)
+    return {"Out": [out]}
+
+
+def _quantized_conv_impl(ctx, ins, attrs, groups=None):
+    from .nn_ops import _pair
+
+    x, w = ins["Input"][0], ins["Filter"][0]  # w: int8 OIHW
+    rng = float(2 ** (attrs.get("bit_length", 8) - 1) - 1)
+    fmt = attrs.get("data_format", "NCHW")
+    ch_axis = 1 if fmt == "NCHW" else x.ndim - 1
+    if groups == "depthwise":
+        groups = x.shape[ch_axis]
+    strides = _pair(attrs.get("strides", [1, 1]))
+    paddings = _pair(attrs.get("paddings", [0, 0]))
+    dilations = _pair(attrs.get("dilations", [1, 1]))
+    xq, sx = _act_to_int8(x, ins, rng)
+    acc = jax.lax.conv_general_dilated(
+        xq,
+        w,
+        window_strides=strides,
+        padding=[(paddings[0], paddings[0]), (paddings[1], paddings[1])],
+        rhs_dilation=dilations,
+        dimension_numbers=(fmt, "OIHW", fmt),
+        feature_group_count=groups or attrs.get("groups", 1) or 1,
+        preferred_element_type=jnp.int32,
+    )
+    # weight scale: [1] (abs_max) or [Co] (channel-wise), broadcast on
+    # the out-channel axis
+    sw = ins["WScale"][0]
+    bshape = [1] * acc.ndim
+    if int(sw.size) > 1:
+        bshape[ch_axis] = int(sw.size)
+    out = acc.astype(jnp.float32) * (sx / rng) * (sw.reshape(bshape) / rng)
+    return {"Output": [out]}
+
+
+@register("quantized_conv2d")
+def _quantized_conv2d(ctx, ins, attrs):
+    return _quantized_conv_impl(ctx, ins, attrs)
+
+
+@register("quantized_depthwise_conv2d")
+def _quantized_depthwise_conv2d(ctx, ins, attrs):
+    return _quantized_conv_impl(ctx, ins, attrs, groups="depthwise")

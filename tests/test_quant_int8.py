@@ -1,0 +1,111 @@
+"""Real-int8 inference (QuantizeTranspiler.convert_to_int8 +
+quantized_* ops) — the reference's TensorRT-int8 serving capability
+(`inference/tensorrt/convert/*.cc`), TPU-native: int8 weights in the
+scope, in-op activation quantization, int32 accumulation, fused dequant.
+Parity oracle: the frozen QDQ program computes the SAME quantized
+values in f32, so int8 outputs must match it tightly.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.contrib.quantize import QuantizeTranspiler
+
+
+def _train_qat_fc(act_type, steps=15):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.framework.program_guard(main, startup):
+        startup.random_seed = 5
+        x = layers.data("x", shape=[8])
+        y = layers.data("y", shape=[1], dtype="int64")
+        pred = layers.fc(layers.fc(x, size=16, act="relu"), size=4,
+                         act="softmax")
+        loss = layers.mean(layers.cross_entropy(pred, y))
+        qt = QuantizeTranspiler(activation_quantize_type=act_type)
+        qt.training_transpile(main, startup)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+
+    rng = np.random.RandomState(0)
+    xv = rng.rand(32, 8).astype("float32")
+    yv = rng.randint(0, 4, (32, 1)).astype("int64")
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for _ in range(steps):
+            exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+    return main, scope, qt, xv, pred.name
+
+
+@pytest.mark.parametrize("act_type", ["moving_average_abs_max", "abs_max"])
+def test_int8_mul_matches_frozen_qdq(act_type):
+    """fc chain: frozen-QDQ f32 vs real-int8 — same quantized math, so
+    outputs agree to accumulation rounding; program/scope really hold
+    int8."""
+    main, scope, qt, xv, pred = _train_qat_fc(act_type)
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        # inference flow: prune to the prediction (drops the training
+        # section), then freeze, then int8-convert
+        infer = main.clone(for_test=True)._prune(pred)
+        frozen = qt.freeze_program(infer, scope=scope)
+        (ref,) = exe.run(program=frozen, feed={"x": xv}, fetch_list=[pred])
+
+        n = qt.convert_to_int8(frozen, scope=scope)
+        assert n == 2, n
+        types = [op.type for op in frozen.global_block().ops]
+        assert types.count("quantized_mul") == 2
+        # the activation fake-quant ops were absorbed into the int8 ops
+        assert not any(t.startswith("fake_quantize") for t in types), types
+        w8 = np.asarray(scope.find_var("fc_0.w_0.quantized.int8"))
+        assert w8.dtype == np.int8
+        (got,) = exe.run(program=frozen, feed={"x": xv}, fetch_list=[pred])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_int8_conv_channelwise_matches_frozen_qdq():
+    """conv trunk with channel-wise weight scales: conv converts to
+    quantized_conv2d with a [Co] scale vector; the fc stays QDQ (per-row
+    scales can't leave the contraction) — and parity still holds."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.framework.program_guard(main, startup):
+        startup.random_seed = 9
+        img = layers.data("image", shape=[3, 8, 8])
+        y = layers.data("y", shape=[1], dtype="int64")
+        conv = layers.conv2d(input=img, num_filters=4, filter_size=3,
+                             padding=1, act="relu")
+        pred = layers.fc(input=conv, size=4, act="softmax")
+        loss = layers.mean(layers.cross_entropy(pred, y))
+        qt = QuantizeTranspiler(
+            activation_quantize_type="moving_average_abs_max",
+            weight_quantize_type="channel_wise_abs_max")
+        qt.training_transpile(main, startup)
+        fluid.optimizer.SGD(0.05).minimize(loss)
+
+    rng = np.random.RandomState(1)
+    xv = rng.rand(8, 3, 8, 8).astype("float32")
+    yv = rng.randint(0, 4, (8, 1)).astype("int64")
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for _ in range(10):
+            exe.run(main, feed={"image": xv, "y": yv}, fetch_list=[loss])
+
+        infer = main.clone(for_test=True)._prune(pred.name)
+        frozen = qt.freeze_program(infer, scope=scope)
+        (ref,) = exe.run(program=frozen, feed={"image": xv},
+                         fetch_list=[pred.name])
+        n = qt.convert_to_int8(frozen, scope=scope)
+        types = [op.type for op in frozen.global_block().ops]
+        assert n == 1 and "quantized_conv2d" in types
+        assert "mul" in types  # fc left in QDQ form under channel-wise
+        sw = np.asarray(scope.find_var("conv2d_0.w_0.quantized.wscale"))
+        assert sw.shape == (4,)  # per-out-channel scales
+        (got,) = exe.run(program=frozen, feed={"image": xv},
+                         fetch_list=[pred.name])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
