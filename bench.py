@@ -306,6 +306,13 @@ def _bench_impl():
         except Exception as e:  # the headline number must still land
             sys.stderr.write("transformer bench failed: %r\n" % (e,))
             result["transformer_error"] = repr(e)[:300]
+    # serving throughput: ResNet-50 inference f32/bf16/int8
+    if os.environ.get("BENCH_INFER", "0") == "1":
+        try:
+            result["infer"] = _infer_bench(on_tpu, device)
+        except Exception as e:
+            sys.stderr.write("infer bench failed: %r\n" % (e,))
+            result["infer"] = {"error": repr(e)[:200]}
     # decode-throughput diagnostic: cached vs full-re-encode generation
     if os.environ.get("BENCH_DECODE", "0") == "1":
         try:
@@ -451,6 +458,67 @@ def _model_bench(name, on_tpu, device):
     mfu = flops_util.mfu(step_flops, steps, dt, device)
     if mfu is not None:
         out["mfu"] = round(mfu, 4)
+    return out
+
+
+def _infer_bench(on_tpu, device):
+    """ResNet-50 INFERENCE throughput at the reference's bs16 config
+    (IntelOptimizedPaddle.md: 217.69 img/s best published) in three
+    regimes: f32, bf16 (AMP rewrite), int8 (QAT-transpiled -> frozen ->
+    convert_to_int8; dynamic abs-max activation scales so no training is
+    needed — throughput, not accuracy, is measured)."""
+    import numpy as np
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.models.resnet import resnet_imagenet
+    from paddle_tpu.contrib.quantize import QuantizeTranspiler
+
+    bs = int(os.environ.get("BENCH_INFER_BATCH", 16 if on_tpu else 2))
+    hw = 224 if on_tpu else 64
+    steps = int(os.environ.get("BENCH_INFER_STEPS", 30 if on_tpu else 2))
+    warmup = 3 if on_tpu else 1
+    rng = np.random.RandomState(0)
+    x = rng.rand(bs, 3, hw, hw).astype("float32")
+    out = {}
+
+    def leg(regime):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.framework.program_guard(main, startup):
+            img = layers.data("image", shape=[3, hw, hw])
+            pred = resnet_imagenet(img, class_dim=1000, depth=50,
+                                   is_test=regime != "int8")
+            if regime == "int8":
+                qt = QuantizeTranspiler(activation_quantize_type="abs_max")
+                qt.training_transpile(main, startup)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(
+                fluid.TPUPlace(0) if on_tpu else fluid.CPUPlace())
+            exe.run(startup)
+            prog = main.clone(for_test=True)._prune(pred.name)
+            if regime == "int8":
+                qt.freeze_program(prog, scope=scope)
+                n = qt.convert_to_int8(prog, scope=scope)
+                if not n:
+                    raise RuntimeError("no ops converted to int8")
+            elif regime == "bf16":
+                from paddle_tpu.contrib.mixed_precision import rewrite_bf16
+
+                rewrite_bf16(prog)
+            feed = {"image": jax.device_put(x, device)}
+            dt = _time_program(exe, prog, feed, [pred.name], warmup, steps)
+        return {"value": round(bs * steps / dt, 2),
+                "unit": "images/sec" + ("" if on_tpu else " (cpufallback)")}
+
+    for regime in ("f32", "bf16", "int8"):
+        try:
+            out[regime] = leg(regime)
+        except Exception as e:
+            sys.stderr.write("infer %s leg failed: %r\n" % (regime, e))
+            out[regime] = {"error": repr(e)[:200]}
+    out["batch_size"] = bs
     return out
 
 
