@@ -215,6 +215,13 @@ class QuantizeTranspiler:
         channel_wise.  Returns the count of converted ops."""
         from ...executor import global_scope
 
+        if self.weight_bits != 8 or self.activation_bits != 8:
+            raise ValueError(
+                "convert_to_int8 requires weight_bits=8 and "
+                "activation_bits=8 (got %d/%d): the int8 tensors and "
+                "int32 MXU accumulation are 8-bit by construction — wider "
+                "QAT configs stay in QDQ form (freeze_program only)"
+                % (self.weight_bits, self.activation_bits))
         scope = scope if scope is not None else global_scope()
         block = program.global_block()
 
@@ -240,6 +247,7 @@ class QuantizeTranspiler:
                    "conv2d": "Input", "depthwise_conv2d": "Input"}
         count = 0
         used_quant_outs = set()
+        converted_weights = set()
         for op in block.ops:
             if op.type not in _W_SLOT:
                 continue
@@ -280,6 +288,7 @@ class QuantizeTranspiler:
                 op.inputs["InScale"] = [info["scale"]]
             op.attrs["bit_length"] = bits
             used_quant_outs.add(xname)
+            converted_weights.add(wname)
             count += 1
 
         # drop activation quant ops whose output no other op still reads
@@ -295,5 +304,16 @@ class QuantizeTranspiler:
                 and op.outputs["Out"][0] not in still_read
             )
         ]
+        # the folded f32 weights are dead once their int8 copy exists —
+        # dropping them halves+ the persistable footprint (the point of
+        # int8 serving); keep any still read by a non-converted op
+        still_read = set()
+        for op in block.ops:
+            for n in op.input_arg_names():
+                still_read.add(n)
+        for wname in converted_weights:
+            if wname not in still_read:
+                scope.erase(wname)
+                block.vars.pop(wname, None)
         program._bump_version()
         return count

@@ -187,6 +187,15 @@ def _quantized_conv_impl(ctx, ins, attrs, groups=None):
     if int(sw.size) > 1:
         bshape[ch_axis] = int(sw.size)
     out = acc.astype(jnp.float32) * (sx / rng) * (sw.reshape(bshape) / rng)
+    # conv epilogue parity with _conv2d (nn_ops.py): a fused bias add
+    # and/or relu (conv_eltadd_relu_fuse_pass output) must survive the
+    # int8 rewrite
+    if ins.get("Bias"):
+        bb = [1] * acc.ndim
+        bb[ch_axis] = -1
+        out = out + ins["Bias"][0].reshape(bb)
+    if attrs.get("fuse_relu"):
+        out = jnp.maximum(out, 0)
     return {"Output": [out]}
 
 

@@ -61,6 +61,8 @@ def test_int8_mul_matches_frozen_qdq(act_type):
         assert not any(t.startswith("fake_quantize") for t in types), types
         w8 = np.asarray(scope.find_var("fc_0.w_0.quantized.int8"))
         assert w8.dtype == np.int8
+        # the folded f32 weights are dead after conversion and dropped
+        assert scope.find_var("fc_0.w_0.quantized") is None
         (got,) = exe.run(program=frozen, feed={"x": xv}, fetch_list=[pred])
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=1e-4, atol=1e-5)
@@ -109,3 +111,47 @@ def test_int8_conv_channelwise_matches_frozen_qdq():
                          fetch_list=[pred.name])
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_int8_conv_keeps_fused_bias_and_relu():
+    """conv_eltadd_relu_fuse_pass then convert_to_int8: the quantized
+    conv must still apply the fused Bias add and relu epilogue."""
+    from paddle_tpu.transpiler import apply_pass
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.framework.program_guard(main, startup):
+        startup.random_seed = 13
+        img = layers.data("image", shape=[3, 8, 8])
+        conv = layers.conv2d(input=img, num_filters=4, filter_size=3,
+                             padding=1, act="relu", bias_attr=True)
+        pred = layers.reduce_sum(conv, dim=[1, 2, 3])
+        qt = QuantizeTranspiler(activation_quantize_type="abs_max")
+        qt.training_transpile(main, startup)
+
+    xv = np.random.RandomState(3).rand(4, 3, 8, 8).astype("float32") - 0.5
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        infer = main.clone(for_test=True)._prune(pred.name)
+        frozen = qt.freeze_program(infer, scope=scope)
+        apply_pass(frozen, "conv_eltadd_relu_fuse_pass")
+        fused = [op for op in frozen.global_block().ops
+                 if op.type == "conv2d" and op.attrs.get("fuse_relu")]
+        assert fused and fused[0].inputs.get("Bias"), "fusion must fire"
+        (ref,) = exe.run(program=frozen, feed={"image": xv},
+                         fetch_list=[pred.name])
+        n = qt.convert_to_int8(frozen, scope=scope)
+        assert n == 1
+        (got,) = exe.run(program=frozen, feed={"image": xv},
+                         fetch_list=[pred.name])
+    # relu must actually bite (negative pre-activations exist)
+    assert (np.asarray(ref) >= 0).all()
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_int8_requires_8_bits():
+    qt = QuantizeTranspiler(weight_bits=6)
+    with pytest.raises(ValueError, match="convert_to_int8 requires"):
+        qt.convert_to_int8(fluid.Program(), scope=fluid.Scope())
