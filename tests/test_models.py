@@ -919,3 +919,32 @@ def test_transformer_sample_translate_cached():
                                          temperature=0.8, top_k=10)
         np.testing.assert_array_equal(a, b2)
         assert (a[:, 0] == 1).all() and (a >= 0).all() and (a < 30).all()
+
+
+def test_resnet_preprocess_model_trains_uint8():
+    """resnet_with_preprocess matrix cell: uint8 HWC feed, in-graph
+    random_crop/cast/transpose/normalize, loss moves; the uint8 bytes
+    are all the host sends (H2D = 1/4 of f32)."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu.models.resnet import build_resnet_preprocess_train_program
+
+    main, startup, feeds, fetches = build_resnet_preprocess_train_program(
+        image_shape=(32, 32, 3), class_dim=5, lr=0.001)
+    assert [op.type for op in main.global_block().ops].count("random_crop") == 1
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, 256, (4, 32, 32, 3)).astype("uint8")
+    y = rng.randint(0, 5, (4, 1)).astype("int64")
+    scope = fluid.Scope()
+    losses = []
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for _ in range(3):
+            out = exe.run(main, feed={"image": x, "label": y},
+                          fetch_list=fetches)
+            losses.append(float(np.asarray(out[0]).ravel()[0]))
+    # the preprocessing chain is the subject: uint8 in, finite f32 loss
+    # out, and the parameters actually update (losses move)
+    assert all(np.isfinite(losses)), losses
+    assert len(set(losses)) == len(losses), losses
