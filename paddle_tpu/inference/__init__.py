@@ -38,6 +38,7 @@ class AnalysisConfig(NativeConfig):
     def __init__(self, model_dir=None, place=None):
         super().__init__(model_dir, place)
         self.ir_optim = True
+        self.int8 = False
         # attention fusion runs BEFORE drop_train_ops: the dropout-aware
         # attention patterns must see the original dropout op (is_test
         # rewriting turns it into a scale op the matcher doesn't target)
@@ -59,6 +60,18 @@ class AnalysisConfig(NativeConfig):
 
     def switch_ir_optim(self, flag=True):
         self.ir_optim = bool(flag)
+        return self
+
+    def enable_int8(self, quantize_transpiler=None):
+        """Serve a QAT-saved model with REAL int8 compute (the
+        ``EnableTensorRtEngine(precision=Int8)`` analog,
+        paddle_inference_api.h): at load the predictor runs
+        ``freeze_program`` + ``convert_to_int8`` on the loaded program —
+        int8 weights, int32 MXU accumulation, fused dequant.  Pass a
+        configured ``QuantizeTranspiler`` when the model was QAT-trained
+        with non-default types (e.g. channel-wise weights)."""
+        self._int8_transpiler = quantize_transpiler
+        self.int8 = True
         return self
 
     def pass_builder(self):
@@ -85,6 +98,18 @@ class Predictor:
             scope=self.scope,
         )
         self.program._is_test = True
+        if getattr(config, "int8", False):
+            from ..contrib.quantize import QuantizeTranspiler
+
+            qt = getattr(config, "_int8_transpiler", None) or QuantizeTranspiler()
+            qt.freeze_program(self.program, scope=self.scope)
+            if not qt.convert_to_int8(self.program, scope=self.scope):
+                raise ValueError(
+                    "enable_int8: no quantizable ops converted — the "
+                    "saved model has no QAT fake-quantize ops (train "
+                    "with QuantizeTranspiler.training_transpile before "
+                    "save_inference_model)"
+                )
         if config.ir_optim:
             self._apply_analysis_passes()
         self.fetch_names = [

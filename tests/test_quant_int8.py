@@ -155,3 +155,64 @@ def test_int8_requires_8_bits():
     qt = QuantizeTranspiler(weight_bits=6)
     with pytest.raises(ValueError, match="convert_to_int8 requires"):
         qt.convert_to_int8(fluid.Program(), scope=fluid.Scope())
+
+
+def test_analysis_config_enable_int8_serving(tmp_path):
+    """Full serving cycle: QAT train -> save_inference_model -> load via
+    AnalysisConfig.enable_int8() -> predictor runs real int8, parity with
+    the plain (QDQ) predictor."""
+    from paddle_tpu import io
+    from paddle_tpu.inference import AnalysisConfig, create_paddle_predictor
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.framework.program_guard(main, startup):
+        startup.random_seed = 21
+        x = layers.data("x", shape=[8])
+        y = layers.data("y", shape=[1], dtype="int64")
+        pred = layers.fc(layers.fc(x, size=16, act="relu"), size=4,
+                         act="softmax")
+        loss = layers.mean(layers.cross_entropy(pred, y))
+        qt = QuantizeTranspiler(
+            activation_quantize_type="moving_average_abs_max")
+        qt.training_transpile(main, startup)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+
+    rng = np.random.RandomState(0)
+    xv = rng.rand(16, 8).astype("float32")
+    yv = rng.randint(0, 4, (16, 1)).astype("int64")
+    model_dir = str(tmp_path / "qat_model")
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for _ in range(10):
+            exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+        io.save_inference_model(model_dir, ["x"], [pred], exe,
+                                main_program=main)
+
+    plain = create_paddle_predictor(AnalysisConfig(model_dir))
+    (ref,) = plain.run({"x": xv})
+
+    cfg = AnalysisConfig(model_dir).enable_int8(
+        QuantizeTranspiler(
+            activation_quantize_type="moving_average_abs_max"))
+    p8 = create_paddle_predictor(cfg)
+    types = [op.type for op in p8.program.global_block().ops]
+    assert "quantized_mul" in types, types
+    (got,) = p8.run({"x": xv})
+    np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
+
+    # a non-QAT model must fail loudly, not serve silently un-quantized
+    plain_dir = str(tmp_path / "plain_model")
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.framework.program_guard(main2, startup2):
+        x2 = layers.data("x", shape=[8])
+        p2 = layers.fc(x2, size=4, act="softmax")
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        exe2.run(startup2)
+        io.save_inference_model(plain_dir, ["x"], [p2], exe2,
+                                main_program=main2)
+    with pytest.raises(ValueError, match="no quantizable ops converted"):
+        create_paddle_predictor(AnalysisConfig(plain_dir).enable_int8())
