@@ -116,14 +116,80 @@ def pp_leg(n):
     return STEPS / dt
 
 
+def sp_leg(n):
+    """Ring attention over an {sp: n} mesh: the SAME global sequence
+    (B2 H4 T1024 D64) sharded on time; grad included (fwd+bwd is the
+    training-relevant path)."""
+    import functools
+
+    from paddle_tpu.parallel.mesh import make_mesh
+    from paddle_tpu.parallel.ring import ring_attention_sharded
+
+    mesh = make_mesh({"sp": n}, devices=jax.devices()[:n])
+    rng = np.random.RandomState(2)
+    q, k, v = (jnp.asarray(rng.rand(2, 4, 1024, 64).astype("float32"))
+               for _ in range(3))
+
+    @jax.jit
+    def step(q, k, v):
+        def loss(q):
+            o = ring_attention_sharded(q, k, v, mesh, causal=True)
+            return jnp.sum(o * o)
+
+        return jax.grad(loss)(q)
+
+    out = step(q, k, v)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        out = step(q, k, v)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    assert step._cache_size() == 1, step._cache_size()
+    return STEPS / dt
+
+
+def ep_leg(n):
+    """Switch-MoE dispatch over an {ep: n} mesh: same global token batch,
+    n experts (one per device)."""
+    from paddle_tpu.parallel.mesh import make_mesh
+    from paddle_tpu.parallel.moe import switch_moe
+
+    mesh = make_mesh({"ep": n}, devices=jax.devices()[:n])
+    d = 128
+    rng = np.random.RandomState(3)
+
+    def expert_fn(params, x):
+        return jnp.tanh(x @ params)
+
+    gate_w = jnp.asarray(rng.rand(d, n).astype("float32") * 0.1)
+    params = jnp.asarray(rng.rand(n, d, d).astype("float32") * 0.05)
+    x = jnp.asarray(rng.rand(GLOBAL_BATCH, d).astype("float32"))
+    moe = switch_moe(expert_fn, mesh)
+    run = jax.jit(lambda gw, p, x: moe(gw, p, x)[0])
+    out = run(gate_w, params, x)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        out = run(gate_w, params, x)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    assert run._cache_size() == 1, run._cache_size()
+    return STEPS / dt
+
+
 def main():
     print("| devices | dp steps/s (MLP bs%d) | pp steps/s (gpipe fwd) |"
+          " sp steps/s (ring attn grad T1024) | ep steps/s (switch moe) |"
           % GLOBAL_BATCH)
-    print("|---|---|---|")
+    print("|---|---|---|---|---|")
     for n in (1, 2, 4, 8):
         dp = dp_leg(n)
         pp = pp_leg(n)
-        print("| %d | %.2f | %.2f |" % (n, dp, pp), flush=True)
+        sp = sp_leg(n)
+        ep = ep_leg(n)
+        print("| %d | %.2f | %.2f | %.2f | %.2f |" % (n, dp, pp, sp, ep),
+              flush=True)
 
 
 if __name__ == "__main__":
