@@ -33,6 +33,12 @@ for i in $(seq 1 85); do
     env BENCH_LAYOUT=NHWC BENCH_TRANSFORMER=0 python bench.py \
       > /tmp/r04_nhwc_model.out 2>> /tmp/tpu_watch.log
     echo "$(date -u +%H:%M) full-model NHWC leg done" >> /tmp/tpu_watch.log
+    env FLAGS_prng_impl=rbg BENCH_ONLY=transformer python bench.py \
+      > /tmp/r04_tfm_rbg.out 2>> /tmp/tpu_watch.log
+    echo "$(date -u +%H:%M) rbg prng leg done" >> /tmp/tpu_watch.log
+    env BENCH_INFER=1 BENCH_TRANSFORMER=0 python bench.py \
+      > /tmp/r04_infer.out 2>> /tmp/tpu_watch.log
+    echo "$(date -u +%H:%M) serving (f32/bf16/int8) leg done" >> /tmp/tpu_watch.log
     timeout -k 10 900 python scripts/nhwc_trial.py > /tmp/r04_nhwc.out 2>&1
     echo "$(date -u +%H:%M) nhwc trial done - watcher exiting" >> /tmp/tpu_watch.log
     touch /tmp/r04_capture_done
