@@ -1436,7 +1436,7 @@ def log_loss(input, label, epsilon=1e-4, name=None):
 
 
 def fused_attention(q, k, v, causal=False, scale=None, bias=None,
-                    window=0, name=None):
+                    window=0, segment_ids=None, name=None):
     """Fused scaled-dot-product attention over [batch, heads, T, d]
     (flash-attention kernel under FLAGS_use_pallas).  bias: optional
     additive key-padding bias, rank-1 in the key axis ([B, Tk] or
@@ -1444,7 +1444,10 @@ def fused_attention(q, k, v, causal=False, scale=None, bias=None,
     combine with causal=True for decoder self-attention.  window > 0
     (requires causal): sliding-window local attention — each query
     attends only the last `window` positions, and fully-out-of-window
-    blocks are skipped in the flash kernels."""
+    blocks are skipped in the flash kernels.  segment_ids: optional
+    [B, T] int ids from sequence packing (reader.packing) — attention
+    stays within each packed segment (ids compared on the fly, no
+    [T, T] mask tensor; currently routed to the dense-XLA path)."""
     window = int(window)
     if window < 0:
         raise ValueError("fused_attention: window must be >= 0")
@@ -1455,6 +1458,8 @@ def fused_attention(q, k, v, causal=False, scale=None, bias=None,
     inputs = {"Q": [q], "K": [k], "V": [v]}
     if bias is not None:
         inputs["Bias"] = [bias]
+    if segment_ids is not None:
+        inputs["SegmentIds"] = [segment_ids]
     helper.append_op(
         "fused_attention",
         inputs=inputs,

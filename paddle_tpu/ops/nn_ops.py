@@ -736,6 +736,18 @@ def _fused_attention(ctx, ins, attrs):
         # the [Tq, Tk] score matrix
         kbias = ins["Bias"][0].reshape(b, tk).astype(jnp.float32)
         kbias = jnp.broadcast_to(kbias[:, None, :], (b, h, tk)).reshape(b * h, tk)
+    seg = None
+    if ins.get("SegmentIds"):
+        # sequence packing (reader.packing): [B, T] int ids; query i sees
+        # key j iff the ids match.  Dense path only for now — the flash
+        # kernels take the kbias-style rank-1 plumbing but the masking
+        # compare is not implemented there yet.
+        if t != tk:
+            raise ValueError(
+                "fused_attention: SegmentIds requires Tq == Tk "
+                "(self-attention over one packed row)")
+        seg = ins["SegmentIds"][0].reshape(b, t)
+        seg = jnp.broadcast_to(seg[:, None, :], (b, h, t)).reshape(b * h, t)
     from ..flags import get_flag
 
     bq_flag = int(get_flag("flash_block_q") or 0)
@@ -750,7 +762,10 @@ def _fused_attention(ctx, ins, attrs):
         return ((bq % 128 == 0 or bq == t) and t % bq == 0
                 and (bk % 128 == 0 or bk == tk) and tk % bk == 0)
 
-    if use_pallas() and (bq_flag or bk_flag):
+    if seg is not None:
+        out = _dense_attention(qf, kf, vf, causal, float(scale), kbias,
+                               window=window, seg=seg)
+    elif use_pallas() and (bq_flag or bk_flag):
         # explicit sweep knobs: validate loudly — a silently-ignored
         # flag would attribute fallback timings to the requested size
         bq = bq_flag or 128

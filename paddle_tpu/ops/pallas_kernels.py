@@ -388,11 +388,17 @@ def _flash_bwd(q, k, v, kbias, o, lse, do, causal, scale, block_q, block_k,
     return dq, dk, dv, dkb.reshape(BH, Tk)
 
 
-def _dense_attention(q, k, v, causal, scale, kbias=None, window=0):
-    """XLA reference implementation (used as the non-pallas fallback)."""
+def _dense_attention(q, k, v, causal, scale, kbias=None, window=0,
+                     seg=None):
+    """XLA reference implementation (used as the non-pallas fallback).
+    seg: optional [BH, T] int segment ids (sequence packing) — query i
+    may attend key j only when seg[i] == seg[j]; the compare fuses into
+    the softmax, no mask tensor lives in HBM."""
     s = jnp.einsum("bqd,bkd->bqk", q, k).astype(jnp.float32) * scale
     if kbias is not None:
         s = s + kbias[:, None, :].astype(jnp.float32)
+    if seg is not None:
+        s = jnp.where(seg[:, :, None] == seg[:, None, :], s, NEG_INF)
     if causal:
         T = q.shape[1]
         mask = jnp.tril(jnp.ones((T, T), bool))

@@ -10,3 +10,4 @@ TPU-idiomatic equivalent of double buffering into device memory.
 from .decorator import *  # noqa: F401,F403
 from .decorator import batch
 from .pipeline import PyReader, DeviceFeeder
+from .packing import pack_sequences
