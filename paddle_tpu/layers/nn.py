@@ -1447,7 +1447,8 @@ def fused_attention(q, k, v, causal=False, scale=None, bias=None,
     blocks are skipped in the flash kernels.  segment_ids: optional
     [B, T] int ids from sequence packing (reader.packing) — attention
     stays within each packed segment (ids compared on the fly, no
-    [T, T] mask tensor; currently routed to the dense-XLA path)."""
+    [T, T] mask tensor; rides the flash kernels under FLAGS_use_pallas
+    as two extra rank-1 operands, dense-XLA otherwise)."""
     window = int(window)
     if window < 0:
         raise ValueError("fused_attention: window must be >= 0")

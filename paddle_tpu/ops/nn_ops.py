@@ -739,14 +739,14 @@ def _fused_attention(ctx, ins, attrs):
     seg = None
     if ins.get("SegmentIds"):
         # sequence packing (reader.packing): [B, T] int ids; query i sees
-        # key j iff the ids match.  Dense path only for now — the flash
-        # kernels take the kbias-style rank-1 plumbing but the masking
-        # compare is not implemented there yet.
+        # key j iff the ids match.  Rides the flash kernels as two more
+        # rank-1 [BH, T] operands (compared per score tile), dense
+        # otherwise.
         if t != tk:
             raise ValueError(
                 "fused_attention: SegmentIds requires Tq == Tk "
                 "(self-attention over one packed row)")
-        seg = ins["SegmentIds"][0].reshape(b, t)
+        seg = ins["SegmentIds"][0].reshape(b, t).astype(jnp.int32)
         seg = jnp.broadcast_to(seg[:, None, :], (b, h, t)).reshape(b * h, t)
     from ..flags import get_flag
 
@@ -763,8 +763,17 @@ def _fused_attention(ctx, ins, attrs):
                 and (bk % 128 == 0 or bk == tk) and tk % bk == 0)
 
     if seg is not None:
-        out = _dense_attention(qf, kf, vf, causal, float(scale), kbias,
-                               window=window, seg=seg)
+        # auto-blocked flash when legal (same derivation as the auto
+        # path below), dense otherwise
+        bq = 128 if t % 128 == 0 else t
+        bk = 128 if tk % 128 == 0 else tk
+        if use_pallas() and bq <= 512 and bk <= 1024:
+            out = flash_attention(qf, kf, vf, kbias, causal, float(scale),
+                                  block_q=bq, block_k=bk, window=window,
+                                  seg=seg)
+        else:
+            out = _dense_attention(qf, kf, vf, causal, float(scale), kbias,
+                                   window=window, seg=seg)
     elif use_pallas() and (bq_flag or bk_flag):
         # explicit sweep knobs: validate loudly — a silently-ignored
         # flag would attribute fallback timings to the requested size

@@ -17,6 +17,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 NEG_INF = -1e30
 
@@ -60,17 +61,18 @@ def _row_block(n, default):
 # Role parity: the cuDNN fused-attention kernels of SURVEY §2.6.
 # ---------------------------------------------------------------------------
 def _flash_fwd_kernel(*refs, block_q, block_k, nk,
-                      causal, scale, window=0, has_qoff=False):
+                      causal, scale, window=0, has_qoff=False,
+                      has_seg=False):
     from jax.experimental import pallas as pl
 
-    if has_qoff:
-        (qoff_ref, q_ref, k_ref, v_ref, kb_ref, o_ref, lse_ref,
-         acc_ref, m_ref, l_ref) = refs
-        qo = qoff_ref[0]  # global q-position base minus k base (SMEM)
-    else:
-        (q_ref, k_ref, v_ref, kb_ref, o_ref, lse_ref,
-         acc_ref, m_ref, l_ref) = refs
-        qo = 0
+    refs = list(refs)
+    qo = refs.pop(0)[0] if has_qoff else 0  # global q base (SMEM scalar)
+    q_ref, k_ref, v_ref, kb_ref = refs[:4]
+    del refs[:4]
+    sq_ref, sk_ref = (refs[:2] if has_seg else (None, None))
+    if has_seg:
+        del refs[:2]
+    o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -89,6 +91,9 @@ def _flash_fwd_kernel(*refs, block_q, block_k, nk,
         v = v_ref[0].astype(jnp.float32)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [bq, bk]
         s = s + kb_ref[0].astype(jnp.float32)  # [1, bk] broadcast
+        if has_seg:  # packing: keep within-segment scores only
+            s = jnp.where(
+                sq_ref[0].reshape(-1, 1) == sk_ref[0], s, NEG_INF)
         s = keep_fn(s)
         m_prev = m_ref[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -143,13 +148,15 @@ def _flash_blocks(Tq, Tk, block_q, block_k, causal):
 
 
 def _flash_fwd(q, k, v, kbias, causal, scale, block_q, block_k, window=0,
-               qoff=None):
+               qoff=None, seg=None):
     """q: [BH, Tq, d], k/v: [BH, Tk, d], kbias: [BH, Tk] additive key bias.
     window > 0 (causal only): sliding-window attention — each query sees
     only the last `window` key positions.  qoff: optional [1] int32 GLOBAL
     q-position base relative to k's (traced; SMEM scalar) — the ring
     passes its chunk offset so causal/window masks apply in global
-    positions.  Returns (o, lse)."""
+    positions.  seg: optional [BH, T] int32 segment ids (sequence
+    packing; requires Tq == Tk) — rides as two more [BH, 1, X] rank-1
+    operands, compared per score tile.  Returns (o, lse)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -158,11 +165,12 @@ def _flash_fwd(q, k, v, kbias, causal, scale, block_q, block_k, window=0,
     block_q, block_k = _flash_blocks(T, Tk, block_q, block_k,
                                      causal and qoff is None)
     assert not (window and not causal), "window attention requires causal"
+    assert seg is None or T == Tk, "segment ids require Tq == Tk"
     nq, nk = T // block_q, Tk // block_k
     kernel = functools.partial(
         _flash_fwd_kernel, block_q=block_q, block_k=block_k, nk=nk,
         causal=causal, scale=scale, window=int(window),
-        has_qoff=qoff is not None,
+        has_qoff=qoff is not None, has_seg=seg is not None,
     )
     # 2D [BH, X] operands ride as [BH, 1, X] so every block keeps a
     # Mosaic-legal last-two-dims shape ((1, blk): second-minor equals the
@@ -178,6 +186,15 @@ def _flash_fwd(q, k, v, kbias, causal, scale, block_q, block_k, window=0,
                      memory_space=pltpu.VMEM),
     ]
     args = [q, k, v, kbias.reshape(BH, 1, Tk)]
+    if seg is not None:
+        seg3 = seg.astype(jnp.int32).reshape(BH, 1, T)
+        in_specs += [
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b, 0, j),
+                         memory_space=pltpu.VMEM),
+        ]
+        args += [seg3, seg3]
     if qoff is not None:
         in_specs.insert(0, pl.BlockSpec(memory_space=pltpu.SMEM))
         args.insert(0, qoff.astype(jnp.int32).reshape(1))
@@ -206,17 +223,17 @@ def _flash_fwd(q, k, v, kbias, causal, scale, block_q, block_k, window=0,
 
 
 def _flash_dq_kernel(*refs, block_q, block_k, nk, causal, scale,
-                     window=0, has_qoff=False):
+                     window=0, has_qoff=False, has_seg=False):
     from jax.experimental import pallas as pl
 
-    if has_qoff:
-        (qoff_ref, q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref, delta_ref,
-         dq_ref, dq_acc) = refs
-        qo = qoff_ref[0]
-    else:
-        (q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref, delta_ref,
-         dq_ref, dq_acc) = refs
-        qo = 0
+    refs = list(refs)
+    qo = refs.pop(0)[0] if has_qoff else 0
+    q_ref, k_ref, v_ref, kb_ref = refs[:4]
+    del refs[:4]
+    sq_ref, sk_ref = (refs[:2] if has_seg else (None, None))
+    if has_seg:
+        del refs[:2]
+    do_ref, lse_ref, delta_ref, dq_ref, dq_acc = refs
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -236,6 +253,9 @@ def _flash_dq_kernel(*refs, block_q, block_k, nk, causal, scale,
         delta = delta_ref[0].reshape(-1, 1)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         s = s + kb_ref[0].astype(jnp.float32)
+        if has_seg:
+            s = jnp.where(
+                sq_ref[0].reshape(-1, 1) == sk_ref[0], s, NEG_INF)
         s = keep_fn(s)
         # rows with NO visible key (possible under qoff+window) carry the
         # lse sentinel: their forward output is defined-garbage by
@@ -253,17 +273,18 @@ def _flash_dq_kernel(*refs, block_q, block_k, nk, causal, scale,
 
 
 def _flash_dkv_kernel(*refs, block_q, block_k, nq, causal, scale,
-                      window=0, has_qoff=False):
+                      window=0, has_qoff=False, has_seg=False):
     from jax.experimental import pallas as pl
 
-    if has_qoff:
-        (qoff_ref, q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref, delta_ref,
-         dk_ref, dv_ref, dkb_ref, dk_acc, dv_acc, dkb_acc) = refs
-        qo = qoff_ref[0]
-    else:
-        (q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref, delta_ref,
-         dk_ref, dv_ref, dkb_ref, dk_acc, dv_acc, dkb_acc) = refs
-        qo = 0
+    refs = list(refs)
+    qo = refs.pop(0)[0] if has_qoff else 0
+    q_ref, k_ref, v_ref, kb_ref = refs[:4]
+    del refs[:4]
+    sq_ref, sk_ref = (refs[:2] if has_seg else (None, None))
+    if has_seg:
+        del refs[:2]
+    (do_ref, lse_ref, delta_ref,
+     dk_ref, dv_ref, dkb_ref, dk_acc, dv_acc, dkb_acc) = refs
     ki = pl.program_id(1)
     qi = pl.program_id(2)
 
@@ -287,6 +308,9 @@ def _flash_dkv_kernel(*refs, block_q, block_k, nq, causal, scale,
         delta = delta_ref[0].reshape(-1, 1)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         s = s + kb_ref[0].astype(jnp.float32)
+        if has_seg:
+            s = jnp.where(
+                sq_ref[0].reshape(-1, 1) == sk_ref[0], s, NEG_INF)
         s = keep_fn(s)
         # undefined-row grad guard (see _flash_dq_kernel)
         p = jnp.where(lse <= NEG_INF / 2, 0.0, jnp.exp(s - lse))
@@ -306,7 +330,7 @@ def _flash_dkv_kernel(*refs, block_q, block_k, nq, causal, scale,
 
 
 def _flash_bwd(q, k, v, kbias, o, lse, do, causal, scale, block_q, block_k,
-               dlse=None, window=0, qoff=None):
+               dlse=None, window=0, qoff=None, seg=None):
     """Blocked backward: returns (dq, dk, dv, dkbias[BH,Tk] f32).
 
     dlse: optional cotangent of the lse output (the chunk-merge path of
@@ -330,6 +354,8 @@ def _flash_bwd(q, k, v, kbias, o, lse, do, causal, scale, block_q, block_k,
     kb3 = kbias.reshape(BH, 1, Tk)
     lse3 = lse.reshape(BH, 1, T)
     delta3 = delta.reshape(BH, 1, T)
+    seg3 = (seg.astype(jnp.int32).reshape(BH, 1, T)
+            if seg is not None else None)
 
     q_spec_q = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
                             memory_space=pltpu.VMEM)
@@ -341,19 +367,22 @@ def _flash_bwd(q, k, v, kbias, o, lse, do, causal, scale, block_q, block_k,
                               memory_space=pltpu.VMEM)
     smem = ([pl.BlockSpec(memory_space=pltpu.SMEM)]
             if qoff is not None else [])
+    seg_specs_q = ([row_spec_q, kb_spec_q] if seg is not None else [])
+    seg_args = ([seg3, seg3] if seg is not None else [])
     dq = pl.pallas_call(
         functools.partial(_flash_dq_kernel, block_q=block_q, block_k=block_k,
                           nk=nk, causal=causal, scale=scale,
-                          window=int(window), has_qoff=qoff is not None),
+                          window=int(window), has_qoff=qoff is not None,
+                          has_seg=seg is not None),
         grid=(BH, nq, nk),
-        in_specs=smem + [q_spec_q, k_spec_q, k_spec_q, kb_spec_q, q_spec_q,
-                         row_spec_q, row_spec_q],
+        in_specs=smem + [q_spec_q, k_spec_q, k_spec_q, kb_spec_q]
+        + seg_specs_q + [q_spec_q, row_spec_q, row_spec_q],
         out_specs=q_spec_q,
         out_shape=jax.ShapeDtypeStruct((BH, T, d), q.dtype,
                                        vma=_vma(q, k, v, do)),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_interpret(),
-    )(*(qoff_arg + [q, k, v, kb3, do, lse3, delta3]))
+    )(*(qoff_arg + [q, k, v, kb3] + seg_args + [do, lse3, delta3]))
 
     # dk/dv pass: grid iterates q blocks innermost for each k block
     q_spec_k = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0),
@@ -364,13 +393,15 @@ def _flash_bwd(q, k, v, kbias, o, lse, do, causal, scale, block_q, block_k,
                              memory_space=pltpu.VMEM)
     row_spec_k = pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, j),
                               memory_space=pltpu.VMEM)
+    seg_specs_k = ([row_spec_k, kb_spec_k] if seg is not None else [])
     dk, dv, dkb = pl.pallas_call(
         functools.partial(_flash_dkv_kernel, block_q=block_q, block_k=block_k,
                           nq=nq, causal=causal, scale=scale,
-                          window=int(window), has_qoff=qoff is not None),
+                          window=int(window), has_qoff=qoff is not None,
+                          has_seg=seg is not None),
         grid=(BH, nk, nq),
-        in_specs=smem + [q_spec_k, k_spec_k, k_spec_k, kb_spec_k, q_spec_k,
-                         row_spec_k, row_spec_k],
+        in_specs=smem + [q_spec_k, k_spec_k, k_spec_k, kb_spec_k]
+        + seg_specs_k + [q_spec_k, row_spec_k, row_spec_k],
         out_specs=[k_spec_k, k_spec_k, kb_spec_k],
         out_shape=[
             jax.ShapeDtypeStruct((BH, Tk, d), k.dtype, vma=_vma(q, k, v, do)),
@@ -384,7 +415,7 @@ def _flash_bwd(q, k, v, kbias, o, lse, do, causal, scale, block_q, block_k,
             pltpu.VMEM((1, block_k), jnp.float32),
         ],
         interpret=_interpret(),
-    )(*(qoff_arg + [q, k, v, kb3, do, lse3, delta3]))
+    )(*(qoff_arg + [q, k, v, kb3] + seg_args + [do, lse3, delta3]))
     return dq, dk, dv, dkb.reshape(BH, Tk)
 
 
@@ -411,39 +442,47 @@ def _dense_attention(q, k, v, causal, scale, kbias=None, window=0,
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
 def flash_attention(q, k, v, kbias=None, causal=False, scale=None,
-                    block_q=128, block_k=128, window=0):
+                    block_q=128, block_k=128, window=0, seg=None):
     """Fused attention, q: [BH, Tq, d], k/v: [BH, Tk, d] (flash-style
     online softmax).  kbias: optional [BH, Tk] additive key bias (the
     padding-mask row, indexed by key position).  window > 0 (causal):
     sliding-window local attention over the last `window` positions —
     fully-out-of-window blocks are skipped in all three kernels, so
-    compute scales with window, not T."""
+    compute scales with window, not T.  seg: optional [BH, T] int
+    segment ids (sequence packing, Tq == Tk): scores cross segment
+    boundaries are masked inside every kernel — rank-1 operands only,
+    no [T, T] mask."""
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     kb = kbias if kbias is not None else jnp.zeros(k.shape[:2], jnp.float32)
-    o, _ = _flash_fwd(q, k, v, kb, causal, scale, block_q, block_k, window)
+    o, _ = _flash_fwd(q, k, v, kb, causal, scale, block_q, block_k, window,
+                      seg=seg)
     return o
 
 
-def _flash_vjp_fwd(q, k, v, kbias, causal, scale, block_q, block_k, window=0):
+def _flash_vjp_fwd(q, k, v, kbias, causal, scale, block_q, block_k,
+                   window=0, seg=None):
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     kb = kbias if kbias is not None else jnp.zeros(k.shape[:2], jnp.float32)
-    o, lse = _flash_fwd(q, k, v, kb, causal, scale, block_q, block_k, window)
-    return o, (q, k, v, kbias, o, lse)
+    o, lse = _flash_fwd(q, k, v, kb, causal, scale, block_q, block_k, window,
+                        seg=seg)
+    return o, (q, k, v, kbias, seg, o, lse)
 
 
 def _flash_vjp_bwd(causal, scale, block_q, block_k, window, res, do):
-    q, k, v, kbias, o, lse = res
+    q, k, v, kbias, seg, o, lse = res
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     kb = kbias if kbias is not None else jnp.zeros(k.shape[:2], jnp.float32)
     dq, dk, dv, dkb = _flash_bwd(
         q, k, v, kb, o, lse, do, causal, scale, block_q, block_k,
-        window=window)
-    if kbias is None:
-        return dq, dk, dv, None
-    return dq, dk, dv, dkb.astype(kbias.dtype)
+        window=window, seg=seg)
+    # integer segment ids get the mandatory float0 cotangent
+    dseg = (None if seg is None
+            else np.zeros(seg.shape, dtype=jax.dtypes.float0))
+    dkb_out = None if kbias is None else dkb.astype(kbias.dtype)
+    return dq, dk, dv, dkb_out, dseg
 
 
 flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)

@@ -106,3 +106,81 @@ def test_segment_attention_grads_flow():
             "x": rng.rand(2, 2, 8, 8).astype("float32"), "seg": sv},
             fetch_list=[loss])
     assert np.isfinite(np.asarray(lv)).all()
+
+
+def test_flash_segment_ids_match_dense():
+    """Flash kernels with segment ids (interpret mode) == dense-XLA
+    segment masking: forward and all grads, causal and bidirectional,
+    at both single-block and multi-block sizes."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas_kernels import (
+        _dense_attention, flash_attention)
+
+    rng = np.random.RandomState(4)
+    for T, bq, bk in ((16, 16, 16), (256, 128, 128)):
+        BH, d = 2, 8
+        q, k, v = (jnp.asarray(rng.rand(BH, T, d).astype("float32"))
+                   for _ in range(3))
+        seg = np.ones((BH, T), np.int32)
+        seg[:, T // 3:] = 2
+        seg[:, 2 * T // 3:] = 3
+        seg = jnp.asarray(seg)
+        for causal in (False, True):
+            def f_flash(q, k, v):
+                o = flash_attention(q, k, v, None, causal, None,
+                                    bq, bk, 0, seg)
+                return o, jnp.sum(o * o)
+
+            def f_dense(q, k, v):
+                o = _dense_attention(q, k, v, causal, 1.0 / d ** 0.5,
+                                     seg=seg)
+                return o, jnp.sum(o * o)
+
+            of, _ = f_flash(q, k, v)
+            od, _ = f_dense(q, k, v)
+            np.testing.assert_allclose(np.asarray(of), np.asarray(od),
+                                       rtol=2e-5, atol=2e-6)
+            gf = jax.grad(lambda *a: f_flash(*a)[1], argnums=(0, 1, 2))(
+                q, k, v)
+            gd = jax.grad(lambda *a: f_dense(*a)[1], argnums=(0, 1, 2))(
+                q, k, v)
+            for a, b in zip(gf, gd):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           rtol=2e-4, atol=2e-5)
+
+
+def test_op_segment_ids_ride_flash_under_pallas_flag():
+    """FLAGS_use_pallas=1: the fused_attention op with SegmentIds routes
+    through the flash kernels (interpret mode on CPU) and matches the
+    dense path bit-for-tolerance."""
+    from paddle_tpu import flags
+
+    rng = np.random.RandomState(5)
+    h, t, d = 2, 16, 8
+    qv = rng.rand(2, h, t, d).astype("float32")
+    sv = np.ones((2, t), np.int32)
+    sv[:, t // 2:] = 2
+
+    def run():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.framework.program_guard(main, startup):
+            q = layers.data("q", shape=[h, t, d])
+            seg = layers.data("seg", shape=[t], dtype="int32")
+            out = layers.fused_attention(q, q, q, causal=True,
+                                         segment_ids=seg)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            (o,) = exe.run(main, feed={"q": qv, "seg": sv},
+                           fetch_list=[out])
+        return np.asarray(o)
+
+    dense = run()
+    flags.set_flags({"use_pallas": True})
+    try:
+        flash = run()
+    finally:
+        flags.set_flags({"use_pallas": False})
+    np.testing.assert_allclose(flash, dense, rtol=2e-5, atol=2e-6)
