@@ -152,6 +152,7 @@ def build_resnet_preprocess_train_program(
     class_dim=1000,
     depth=50,
     lr=0.1,
+    raw_margin=32,
     use_bf16=False,
     use_nhwc=False,
 ):
@@ -161,15 +162,20 @@ def build_resnet_preprocess_train_program(
     HWC input, random_crop -> cast -> HWC->CHW transpose -> /255 ->
     per-channel mean/std normalize, all compiled into the train step (on
     TPU the whole chain fuses into the first conv's input read, so the
-    host feeds raw uint8 bytes — 4x less H2D traffic than f32)."""
+    host feeds raw uint8 bytes — 4x less H2D traffic than f32).  The
+    feed is `raw_margin` pixels larger than `image_shape` on each
+    spatial dim, so the random crop actually augments (the reference
+    crops a larger decoded image)."""
     import numpy as np
 
     import paddle_tpu as fluid
 
+    raw_shape = [image_shape[0] + raw_margin, image_shape[1] + raw_margin,
+                 image_shape[2]]
     main = fluid.Program()
     startup = fluid.Program()
     with fluid.program_guard(main, startup):
-        img = layers.data("image", shape=list(image_shape), dtype="uint8")
+        img = layers.data("image", shape=raw_shape, dtype="uint8")
         label = layers.data("label", shape=[1], dtype="int64")
         crop = layers.random_crop(img, shape=list(image_shape))
         casted = layers.cast(crop, "float32")

@@ -762,19 +762,7 @@ def _fused_attention(ctx, ins, attrs):
         return ((bq % 128 == 0 or bq == t) and t % bq == 0
                 and (bk % 128 == 0 or bk == tk) and tk % bk == 0)
 
-    if seg is not None:
-        # auto-blocked flash when legal (same derivation as the auto
-        # path below), dense otherwise
-        bq = 128 if t % 128 == 0 else t
-        bk = 128 if tk % 128 == 0 else tk
-        if use_pallas() and bq <= 512 and bk <= 1024:
-            out = flash_attention(qf, kf, vf, kbias, causal, float(scale),
-                                  block_q=bq, block_k=bk, window=window,
-                                  seg=seg)
-        else:
-            out = _dense_attention(qf, kf, vf, causal, float(scale), kbias,
-                                   window=window, seg=seg)
-    elif use_pallas() and (bq_flag or bk_flag):
+    if use_pallas() and (bq_flag or bk_flag):
         # explicit sweep knobs: validate loudly — a silently-ignored
         # flag would attribute fallback timings to the requested size
         bq = bq_flag or 128
@@ -787,7 +775,8 @@ def _fused_attention(ctx, ins, attrs):
                 "the lse/delta/kbias BlockSpecs place the block in the "
                 "minor dim" % (bq, bk, t, tk))
         out = flash_attention(qf, kf, vf, kbias, causal, float(scale),
-                              block_q=bq, block_k=bk, window=window)
+                              block_q=bq, block_k=bk, window=window,
+                              seg=seg)
     elif use_pallas():
         # auto path: 128-blocks when the lengths tile; otherwise a
         # single full-dim block is still Mosaic-legal, so short or odd
@@ -799,13 +788,14 @@ def _fused_attention(ctx, ins, attrs):
         # 128-tiling or full-dim); only the VMEM score-tile budget gates
         if bq <= 512 and bk <= 1024:
             out = flash_attention(qf, kf, vf, kbias, causal, float(scale),
-                                  block_q=bq, block_k=bk, window=window)
+                                  block_q=bq, block_k=bk, window=window,
+                                  seg=seg)
         else:
             out = _dense_attention(qf, kf, vf, causal, float(scale), kbias,
-                                   window=window)
+                                   window=window, seg=seg)
     else:
         out = _dense_attention(qf, kf, vf, causal, float(scale), kbias,
-                               window=window)
+                               window=window, seg=seg)
     return {"Out": [out.reshape(b, h, t, d)]}
 
 

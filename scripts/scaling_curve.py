@@ -120,8 +120,6 @@ def sp_leg(n):
     """Ring attention over an {sp: n} mesh: the SAME global sequence
     (B2 H4 T1024 D64) sharded on time; grad included (fwd+bwd is the
     training-relevant path)."""
-    import functools
-
     from paddle_tpu.parallel.mesh import make_mesh
     from paddle_tpu.parallel.ring import ring_attention_sharded
 

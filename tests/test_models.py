@@ -930,10 +930,11 @@ def test_resnet_preprocess_model_trains_uint8():
     from paddle_tpu.models.resnet import build_resnet_preprocess_train_program
 
     main, startup, feeds, fetches = build_resnet_preprocess_train_program(
-        image_shape=(32, 32, 3), class_dim=5, lr=0.001)
+        image_shape=(32, 32, 3), class_dim=5, lr=0.001, raw_margin=8)
     assert [op.type for op in main.global_block().ops].count("random_crop") == 1
     rng = np.random.RandomState(0)
-    x = rng.randint(0, 256, (4, 32, 32, 3)).astype("uint8")
+    # the feed is LARGER than the model's input: the crop actually crops
+    x = rng.randint(0, 256, (4, 40, 40, 3)).astype("uint8")
     y = rng.randint(0, 5, (4, 1)).astype("int64")
     scope = fluid.Scope()
     losses = []
