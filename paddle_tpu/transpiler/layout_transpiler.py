@@ -22,11 +22,14 @@ differentiate through the transposes; when combining with AMP, run this
 pass first — the inserted transposes are dtype-transparent trunk ops for
 the AMP propagation.
 
-Caveat (documented in docs/MIGRATION.md): after the rewrite, trunk
+Caveats (documented in docs/MIGRATION.md): after the rewrite, trunk
 intermediates are produced only as their ``@NHWC`` aliases; fetching one
 of them by name from ``exe.run`` requires fetching the alias (or leaving
 that var out of the trunk).  Vars read by sub-block ops are materialized
-in NCHW automatically.
+in NCHW automatically.  RNG-consuming trunk ops (dropout) keep their
+distribution but not their exact stream — the inserted transposes shift
+op indices, and the per-op RNG folds in the op position (give the op a
+``seed`` attr for a layout-independent stream).
 """
 
 from .. import framework

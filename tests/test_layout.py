@@ -173,3 +173,50 @@ def test_depthwise_and_ceil_pool_nhwc_parity():
         return np.asarray(v)
 
     np.testing.assert_allclose(run(True), run(False), rtol=1e-6)
+
+
+def test_nhwc_grouped_conv_se_resnext_parity():
+    """The pass generalizes past plain convs: se_resnext's grouped convs
+    (cardinality), SE squeeze (global pool -> fc -> scale) and ceil-mode
+    pools produce identical losses under NHWC."""
+    from paddle_tpu.models.se_resnext import se_resnext
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.framework.program_guard(main, startup):
+            startup.random_seed = 17
+            img = layers.data("image", shape=[3, 32, 32], dtype="float32")
+            label = layers.data("label", shape=[1], dtype="int64")
+            # is_test=True: the head dropout otherwise draws a DIFFERENT
+            # position-seeded RNG stream in the rewritten program (the
+            # inserted transposes shift op indices) — same distribution,
+            # but not bit-parity; the layout pass's parity contract is
+            # over deterministic programs
+            pred = se_resnext(img, class_dim=5, depth=50, is_test=True)
+            loss = layers.mean(
+                layers.cross_entropy(input=pred, label=label))
+        return main, startup, loss
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(2, 3, 32, 32).astype("float32")
+    y = rng.randint(0, 5, (2, 1)).astype("int64")
+
+    def run(rewrite):
+        main, startup, loss = build()
+        if rewrite:
+            n = rewrite_nhwc(main)
+            assert n > 30, n  # the deep trunk actually converted
+        with fluid.framework.program_guard(main, startup):
+            fluid.optimizer.Momentum(0.01, momentum=0.9).minimize(loss)
+        scope = fluid.Scope()
+        out = []
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            for _ in range(2):
+                (lv,) = exe.run(main, feed={"image": x, "label": y},
+                                fetch_list=[loss])
+                out.append(float(np.asarray(lv).ravel()[0]))
+        return out
+
+    np.testing.assert_allclose(run(True), run(False), rtol=2e-5, atol=2e-6)
