@@ -538,6 +538,9 @@ def _decode_bench(on_tpu, device):
         d_model = 256 if on_tpu else 64
         n_layer = 4 if on_tpu else 2
         n_head = 4 if on_tpu else 2
+        # BENCH_DECODE_KV=k: grouped-query attention with k kv heads —
+        # the KV cache (decode's HBM traffic) shrinks n_head/k-fold
+        n_kv_head = int(os.environ.get("BENCH_DECODE_KV", "0")) or None
         dropout = 0.0
 
     B = int(os.environ.get("BENCH_DECODE_BATCH", 8 if on_tpu else 2))

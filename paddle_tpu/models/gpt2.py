@@ -41,6 +41,7 @@ class GPT2Config:
     d_model = 768
     n_layer = 12
     n_head = 12
+    n_kv_head = None  # < n_head enables grouped-query attention (MQA at 1)
     dropout = 0.1
     recompute = False  # rematerialize each block's activations in backward
 
@@ -61,6 +62,7 @@ def _attn(x, hp, is_test, cache=None):
     return tfm.multi_head_attention(
         x, x, x, None, hp.d_model, hp.n_head, dropout_rate=0.0,
         is_test=is_test, fused=True, causal=cache is None, cache=cache,
+        n_kv_head=getattr(hp, "n_kv_head", None),
     )
 
 
@@ -216,11 +218,12 @@ def gpt2_decode_step_program(hp=GPT2Config, batch=1, t_max=None):
         from .decode_cache import add_cache_zero_fills, create_kv_caches
 
         blk = main.global_block()
+        n_kv = getattr(hp, "n_kv_head", None) or hp.n_head
         kv_caches, cache_names = create_kv_caches(
-            blk, "gpt2", hp.n_layer, batch, hp.n_head, t_max, dh)
+            blk, "gpt2", hp.n_layer, batch, n_kv, t_max, dh)
         add_cache_zero_fills(
             cache_startup,
-            [(n, (batch, hp.n_head, t_max, dh)) for n in cache_names])
+            [(n, (batch, n_kv, t_max, dh)) for n in cache_names])
         for cache in kv_caches:
             cache["pos"] = pos
             x = _block(x, hp, is_test=True, cache=cache)

@@ -101,6 +101,7 @@ class _NumpyInit:
 def multi_head_attention(
     queries, keys, values, attn_bias, d_model, n_head, dropout_rate=0.0,
     is_test=False, cache=None, fused=False, kpad_bias=None, causal=False,
+    n_kv_head=None,
 ):
     """All heads in one qkv projection + batched matmuls (MXU-shaped).
     attn_bias: [B, 1 or H, Tq, Tk] additive mask (−1e9 at masked slots).
@@ -110,21 +111,42 @@ def multi_head_attention(
     rank-1 kpad_bias [B, Tk] and causality as a flag, so the [Tq, Tk]
     score matrix never hits HBM.  Attention-prob dropout is folded away on
     this path (the probs are never materialized) — standard flash-attention
-    practice; residual/ffn dropout still applies."""
+    practice; residual/ffn dropout still applies.
+
+    n_kv_head < n_head enables grouped-query attention (MQA at 1): k/v
+    project to n_kv_head heads shared by n_head/n_kv_head query groups —
+    the KV cache (and decode HBM traffic) shrinks by that factor; the kv
+    heads are broadcast to the query heads at compute time."""
+    dh = d_model // n_head
+    n_kv = n_kv_head or n_head
+    if n_head % n_kv:
+        raise ValueError(
+            "n_kv_head (%d) must divide n_head (%d)" % (n_kv, n_head))
     q = layers.fc(queries, size=d_model, num_flatten_dims=2, bias_attr=False,
                   param_attr=_pa("mha_q.w"))
-    k = layers.fc(keys, size=d_model, num_flatten_dims=2, bias_attr=False,
+    k = layers.fc(keys, size=n_kv * dh, num_flatten_dims=2, bias_attr=False,
                   param_attr=_pa("mha_k.w"))
-    v = layers.fc(values, size=d_model, num_flatten_dims=2, bias_attr=False,
+    v = layers.fc(values, size=n_kv * dh, num_flatten_dims=2, bias_attr=False,
                   param_attr=_pa("mha_v.w"))
 
-    def split_heads(x):
+    def split_heads(x, heads):
         b, t = x.shape[0], x.shape[1]
-        x = layers.reshape(x, [b, t, n_head, d_model // n_head])
-        return layers.transpose(x, [0, 2, 1, 3])  # [B, H, T, Dh]
+        x = layers.reshape(x, [b, t, heads, dh])
+        return layers.transpose(x, [0, 2, 1, 3])  # [B, heads, T, Dh]
 
-    q, k, v = split_heads(q), split_heads(k), split_heads(v)
-    dh = d_model // n_head
+    def repeat_kv(x):
+        """[B, n_kv, T, Dh] -> [B, n_head, T, Dh]: each kv head serves a
+        contiguous group of query heads."""
+        if n_kv == n_head:
+            return x
+        g = n_head // n_kv
+        b, _, t, _ = x.shape
+        x = layers.reshape(x, [b, n_kv, 1, t, dh])
+        x = layers.expand(x, [1, 1, g, 1, 1])
+        return layers.reshape(x, [b, n_head, t, dh])
+
+    q = split_heads(q, n_head)
+    k, v = split_heads(k, n_kv), split_heads(v, n_kv)
     if cache is not None:
         if attn_bias is not None or kpad_bias is not None:
             raise ValueError(
@@ -159,6 +181,11 @@ def multi_head_attention(
                              outputs={"Out": [cvar]})
             return out
 
+        if int(cache["k"].shape[1]) != n_kv:
+            raise ValueError(
+                "cache has %d kv heads but n_kv_head is %d — create the "
+                "caches with the model's kv head count"
+                % (int(cache["k"].shape[1]), n_kv))
         k_full = write_cache(cache["k"], k)
         v_full = write_cache(cache["v"], v)
         t_max = int(cache["k"].shape[2])
@@ -169,7 +196,8 @@ def multi_head_attention(
             outputs={"Out": [bias]}, attrs={"t_max": t_max, "batch": bsz},
         )
         ctx = layers.fused_attention(
-            q, k_full, v_full, bias=bias, causal=False, scale=dh ** -0.5,
+            q, repeat_kv(k_full), repeat_kv(v_full), bias=bias,
+            causal=False, scale=dh ** -0.5,
         )  # [B, H, 1, Dh]
     elif fused:
         if attn_bias is not None and kpad_bias is None:
@@ -180,9 +208,11 @@ def multi_head_attention(
                 "fused=False"
             )
         ctx = layers.fused_attention(
-            q, k, v, bias=kpad_bias, causal=causal, scale=dh ** -0.5
+            q, repeat_kv(k), repeat_kv(v), bias=kpad_bias, causal=causal,
+            scale=dh ** -0.5
         )  # [B, H, Tq, Dh]
     else:
+        k, v = repeat_kv(k), repeat_kv(v)
         product = layers.matmul(q, k, transpose_y=True, alpha=dh ** -0.5)
         if attn_bias is not None:
             product = layers.elementwise_add(product, attn_bias)

@@ -949,3 +949,44 @@ def test_resnet_preprocess_model_trains_uint8():
     # out, and the parameters actually update (losses move)
     assert all(np.isfinite(losses)), losses
     assert len(set(losses)) == len(losses), losses
+
+
+def test_gpt2_gqa_cached_decode_matches_full():
+    """Grouped-query attention (n_kv_head < n_head): the KV caches shrink
+    to n_kv heads, and the cached incremental decode still reproduces the
+    full-program greedy output and per-step logits."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu.models import gpt2
+
+    class HP(gpt2.GPT2Config):
+        vocab_size = 50
+        n_ctx = 16
+        d_model = 16
+        n_layer = 2
+        n_head = 4
+        n_kv_head = 2
+        dropout = 0.0
+
+    B, T = 2, 16
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        full_main, full_startup, _, full_fetch = gpt2.gpt2_logits_program(
+            HP, seq_len=T)
+        step_main, cache_startup, _, step_fetch, cache_names = \
+            gpt2.gpt2_decode_step_program(HP, batch=B, t_max=T)
+        # the k/v weights and caches really are half-size
+        kw = scope_var = None
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(full_startup)
+        exe.run(cache_startup)
+        for n in cache_names:
+            assert tuple(np.asarray(scope.find_var(n)).shape) == (
+                B, 2, T, 16 // 4), n
+
+        rng = np.random.RandomState(0)
+        prompt = rng.randint(1, 50, (B, 4)).astype("int64")
+        ref = gpt2.greedy_generate(exe, full_main, full_fetch, prompt, 6)
+        out = gpt2.greedy_generate_cached(
+            exe, step_main, cache_startup, step_fetch, prompt, 6)
+        np.testing.assert_array_equal(out, ref)
