@@ -19,6 +19,7 @@ __all__ = [
     "data_norm",
 
     "fused_attention",
+    "rotary_embed",
     "log_loss",
     "beam_search",
     "beam_search_decode",
@@ -1467,6 +1468,21 @@ def fused_attention(q, k, v, causal=False, scale=None, bias=None,
         outputs={"Out": [out]},
         attrs={"causal": causal, "scale": scale, "window": int(window)},
     )
+    return out
+
+
+def rotary_embed(x, pos=None, base=10000.0, name=None):
+    """Rotary position embedding over per-head projections [B, H, T, Dh]
+    (rotate-half).  pos: optional int positions [T] — the KV-cached
+    decode path passes the current position so cached keys are stored
+    pre-rotated; default arange(T)."""
+    helper = LayerHelper("rotary_embed", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    inputs = {"X": [x]}
+    if pos is not None:
+        inputs["Pos"] = [pos]
+    helper.append_op("rotary_embed", inputs=inputs,
+                     outputs={"Out": [out]}, attrs={"base": base})
     return out
 
 

@@ -42,6 +42,7 @@ class GPT2Config:
     n_layer = 12
     n_head = 12
     n_kv_head = None  # < n_head enables grouped-query attention (MQA at 1)
+    use_rotary = False  # RoPE on q/k instead of the learned position table
     dropout = 0.1
     recompute = False  # rematerialize each block's activations in backward
 
@@ -63,6 +64,7 @@ def _attn(x, hp, is_test, cache=None):
         x, x, x, None, hp.d_model, hp.n_head, dropout_rate=0.0,
         is_test=is_test, fused=True, causal=cache is None, cache=cache,
         n_kv_head=getattr(hp, "n_kv_head", None),
+        rotary=getattr(hp, "use_rotary", False),
     )
 
 
@@ -91,12 +93,16 @@ def gpt2_lm(ids, hp=GPT2Config, is_test=False):
     tok = layers.embedding(
         ids, size=[hp.vocab_size, hp.d_model], param_attr=_pa("emb.w")
     )
-    pos_table = layers.create_parameter(
-        shape=[hp.n_ctx, hp.d_model], dtype="float32", attr=_pa("pos_emb.w", 0.01)
-    )
-    T = ids.shape[1]
-    pos = layers.slice(pos_table, axes=[0], starts=[0], ends=[T])
-    x = layers.elementwise_add(tok, pos, axis=1)
+    if getattr(hp, "use_rotary", False):
+        x = tok  # positions enter via RoPE on q/k inside attention
+    else:
+        pos_table = layers.create_parameter(
+            shape=[hp.n_ctx, hp.d_model], dtype="float32",
+            attr=_pa("pos_emb.w", 0.01)
+        )
+        T = ids.shape[1]
+        pos = layers.slice(pos_table, axes=[0], starts=[0], ends=[T])
+        x = layers.elementwise_add(tok, pos, axis=1)
     if hp.dropout and not is_test:
         x = layers.dropout(x, hp.dropout, is_test=is_test)
     for _ in range(hp.n_layer):
@@ -208,13 +214,16 @@ def gpt2_decode_step_program(hp=GPT2Config, batch=1, t_max=None):
             ids, size=[hp.vocab_size, hp.d_model], param_attr=_pa("emb.w")
         )  # [B, D] (the T=1 axis squeezes in the lookup)
         tok = layers.reshape(tok, shape=[batch, 1, hp.d_model])
-        pos_table = layers.create_parameter(
-            shape=[hp.n_ctx, hp.d_model], dtype="float32",
-            attr=_pa("pos_emb.w", 0.01),
-        )
-        pos_row = layers.reshape(layers.gather(pos_table, pos),
-                                 shape=[1, 1, hp.d_model])
-        x = layers.elementwise_add(tok, pos_row)
+        if getattr(hp, "use_rotary", False):
+            x = tok  # RoPE rotates q/k by `pos` inside cached attention
+        else:
+            pos_table = layers.create_parameter(
+                shape=[hp.n_ctx, hp.d_model], dtype="float32",
+                attr=_pa("pos_emb.w", 0.01),
+            )
+            pos_row = layers.reshape(layers.gather(pos_table, pos),
+                                     shape=[1, 1, hp.d_model])
+            x = layers.elementwise_add(tok, pos_row)
         from .decode_cache import add_cache_zero_fills, create_kv_caches
 
         blk = main.global_block()

@@ -101,7 +101,7 @@ class _NumpyInit:
 def multi_head_attention(
     queries, keys, values, attn_bias, d_model, n_head, dropout_rate=0.0,
     is_test=False, cache=None, fused=False, kpad_bias=None, causal=False,
-    n_kv_head=None,
+    n_kv_head=None, rotary=False,
 ):
     """All heads in one qkv projection + batched matmuls (MXU-shaped).
     attn_bias: [B, 1 or H, Tq, Tk] additive mask (−1e9 at masked slots).
@@ -116,7 +116,12 @@ def multi_head_attention(
     n_kv_head < n_head enables grouped-query attention (MQA at 1): k/v
     project to n_kv_head heads shared by n_head/n_kv_head query groups —
     the KV cache (and decode HBM traffic) shrinks by that factor; the kv
-    heads are broadcast to the query heads at compute time."""
+    heads are broadcast to the query heads at compute time.
+
+    rotary=True applies rotary position embedding (RoPE) to q and k after
+    the head split — full-sequence positions arange(T), or the cache's
+    current position on the decode path (cached keys store pre-rotated,
+    so relative rotations stay exact across steps)."""
     dh = d_model // n_head
     n_kv = n_kv_head or n_head
     if n_head % n_kv:
@@ -147,6 +152,10 @@ def multi_head_attention(
 
     q = split_heads(q, n_head)
     k, v = split_heads(k, n_kv), split_heads(v, n_kv)
+    if rotary:
+        rpos = cache["pos"] if cache is not None else None
+        q = layers.rotary_embed(q, pos=rpos)
+        k = layers.rotary_embed(k, pos=rpos)
     if cache is not None:
         if attn_bias is not None or kpad_bias is not None:
             raise ValueError(

@@ -1000,3 +1000,28 @@ def _decode_pos_mask(ctx, ins, attrs):
     b = int(attrs["batch"])
     row = jnp.where(jnp.arange(t, dtype=jnp.int32) <= pos, 0.0, -1e30)
     return {"Out": [jnp.broadcast_to(row[None, :], (b, t)).astype(jnp.float32)]}
+
+
+@register("rotary_embed", no_grad_inputs=("Pos",))
+def _rotary_embed(ctx, ins, attrs):
+    """Rotary position embedding (RoPE, rotate-half convention) applied
+    to per-head projections [B, H, T, Dh].  Pos: optional int positions
+    [T] (defaults to arange(T)); the cached decode path feeds the single
+    current position so cache-resident keys are stored pre-rotated.
+    Beyond-reference (the reference era used learned/sinusoid absolute
+    positions); standard in modern decoder LMs."""
+    x = ins["X"][0]
+    base = float(attrs.get("base", 10000.0))
+    t = x.shape[2]
+    half = x.shape[-1] // 2
+    if ins.get("Pos"):
+        pos = ins["Pos"][0].reshape(-1).astype(jnp.float32)
+    else:
+        pos = jnp.arange(t, dtype=jnp.float32)
+    freq = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    ang = pos[:, None] * freq[None, :]  # [T, half]
+    sin = jnp.sin(ang)[None, None].astype(x.dtype)
+    cos = jnp.cos(ang)[None, None].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1)
+    return {"Out": [out]}

@@ -990,3 +990,74 @@ def test_gpt2_gqa_cached_decode_matches_full():
         out = gpt2.greedy_generate_cached(
             exe, step_main, cache_startup, step_fetch, prompt, 6)
         np.testing.assert_array_equal(out, ref)
+
+
+def test_rotary_embed_numeric_reference():
+    """rotary_embed == the rotate-half RoPE formula at explicit
+    positions."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    B, H, T, Dh = 2, 2, 5, 8
+    rng = np.random.RandomState(0)
+    xv = rng.rand(B, H, T, Dh).astype("float32")
+    pv = np.array([3, 0, 7, 1, 2], "int64")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.framework.program_guard(main, startup):
+        x = layers.data("x", shape=[B, H, T, Dh], dtype="float32",
+                        append_batch_size=False)
+        p = layers.data("p", shape=[T], dtype="int64",
+                        append_batch_size=False)
+        out = layers.rotary_embed(x, pos=p)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        (got,) = exe.run(main, feed={"x": xv, "p": pv}, fetch_list=[out])
+
+    half = Dh // 2
+    freq = 10000.0 ** (-np.arange(half) / half)
+    ang = pv[:, None].astype("float64") * freq[None, :]
+    sin, cos = np.sin(ang), np.cos(ang)
+    x1, x2 = xv[..., :half], xv[..., half:]
+    ref = np.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1)
+    np.testing.assert_allclose(np.asarray(got), ref.astype("float32"),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_gpt2_rotary_cached_decode_matches_full():
+    """use_rotary=True (no learned position table): cached decode stores
+    PRE-ROTATED keys and still reproduces the full program's greedy
+    output — the relative-rotation bookkeeping across steps is exact."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu.models import gpt2
+
+    class HP(gpt2.GPT2Config):
+        vocab_size = 50
+        n_ctx = 16
+        d_model = 16
+        n_layer = 2
+        n_head = 2
+        use_rotary = True
+        dropout = 0.0
+
+    B, T = 2, 16
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        full_main, full_startup, _, full_fetch = gpt2.gpt2_logits_program(
+            HP, seq_len=T)
+        step_main, cache_startup, _, step_fetch, _ = \
+            gpt2.gpt2_decode_step_program(HP, batch=B, t_max=T)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(full_startup)
+        assert scope.find_var("pos_emb.w") is None  # no absolute table
+
+        rng = np.random.RandomState(0)
+        prompt = rng.randint(1, 50, (B, 4)).astype("int64")
+        ref = gpt2.greedy_generate(exe, full_main, full_fetch, prompt, 6)
+        out = gpt2.greedy_generate_cached(
+            exe, step_main, cache_startup, step_fetch, prompt, 6)
+        np.testing.assert_array_equal(out, ref)
