@@ -57,8 +57,9 @@ def build(n_rows):
         logits = layers.fc(ctx, size=VOCAB, num_flatten_dims=2)
         loss_tok = layers.softmax_with_cross_entropy(
             logits, layers.unsqueeze(labels, axes=[2]))
+        # the mask derives from integer data, so no gradient flows
+        # through it (nothing to stop-gradient)
         mask = layers.cast(layers.unsqueeze(seg, axes=[2]) > 0, "float32")
-        # stop-gradient on the mask denominator: it is data, not a weight
         denom = layers.reduce_sum(mask)
         loss = layers.reduce_sum(loss_tok * mask) / denom
         fluid.optimizer.Adam(3e-3).minimize(loss)

@@ -114,9 +114,12 @@ def multi_head_attention(
     practice; residual/ffn dropout still applies.
 
     n_kv_head < n_head enables grouped-query attention (MQA at 1): k/v
-    project to n_kv_head heads shared by n_head/n_kv_head query groups —
-    the KV cache (and decode HBM traffic) shrinks by that factor; the kv
-    heads are broadcast to the query heads at compute time.
+    project to n_kv_head heads shared by n_head/n_kv_head query groups.
+    On the cached decode path the KV cache AND the per-step K/V reads
+    shrink by that factor (query groups fold onto the length-1 time
+    axis, no tiling).  On the training paths the kv heads are broadcast
+    back to n_head before attention — there the win is parameters and
+    kv-projection FLOPs, not attention reads.
 
     rotary=True applies rotary position embedding (RoPE) to q and k after
     the head split — full-sequence positions arange(T), or the cache's
@@ -204,10 +207,25 @@ def multi_head_attention(
             "decode_pos_mask", inputs={"Pos": [cache["pos"]]},
             outputs={"Out": [bias]}, attrs={"t_max": t_max, "batch": bsz},
         )
-        ctx = layers.fused_attention(
-            q, repeat_kv(k_full), repeat_kv(v_full), bias=bias,
-            causal=False, scale=dh ** -0.5,
-        )  # [B, H, 1, Dh]
+        if n_kv == n_head:
+            ctx = layers.fused_attention(
+                q, k_full, v_full, bias=bias, causal=False,
+                scale=dh ** -0.5,
+            )  # [B, H, 1, Dh]
+        else:
+            # GQA decode WITHOUT tiling K/V back to n_head: the g query
+            # heads of a group all attend the same kv head, so fold the
+            # group onto the (length-1) query-time axis — heads = n_kv,
+            # Tq = g.  The rank-1 key bias broadcasts over the g rows;
+            # per-step K/V reads really are n_kv-sized.
+            g = n_head // n_kv
+            bsz = int(cache["k"].shape[0])
+            q_g = layers.reshape(q, [bsz, n_kv, g, dh])
+            ctx = layers.fused_attention(
+                q_g, k_full, v_full, bias=bias, causal=False,
+                scale=dh ** -0.5,
+            )  # [B, n_kv, g, Dh]
+            ctx = layers.reshape(ctx, [bsz, n_head, 1, dh])
     elif fused:
         if attn_bias is not None and kpad_bias is None:
             raise ValueError(

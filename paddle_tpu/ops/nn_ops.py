@@ -1013,6 +1013,10 @@ def _rotary_embed(ctx, ins, attrs):
     x = ins["X"][0]
     base = float(attrs.get("base", 10000.0))
     t = x.shape[2]
+    if x.shape[-1] % 2:
+        raise ValueError(
+            "rotary_embed: head dim must be even (rotate-half pairs), "
+            "got %d" % x.shape[-1])
     half = x.shape[-1] // 2
     if ins.get("Pos"):
         pos = ins["Pos"][0].reshape(-1).astype(jnp.float32)

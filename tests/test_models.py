@@ -953,8 +953,8 @@ def test_resnet_preprocess_model_trains_uint8():
 
 def test_gpt2_gqa_cached_decode_matches_full():
     """Grouped-query attention (n_kv_head < n_head): the KV caches shrink
-    to n_kv heads, and the cached incremental decode still reproduces the
-    full-program greedy output and per-step logits."""
+    to n_kv heads, and the cached incremental decode reproduces the
+    full program's greedy output AND its per-position logits."""
     import numpy as np
     import paddle_tpu as fluid
     from paddle_tpu.models import gpt2
@@ -975,11 +975,10 @@ def test_gpt2_gqa_cached_decode_matches_full():
             HP, seq_len=T)
         step_main, cache_startup, _, step_fetch, cache_names = \
             gpt2.gpt2_decode_step_program(HP, batch=B, t_max=T)
-        # the k/v weights and caches really are half-size
-        kw = scope_var = None
         exe = fluid.Executor(fluid.CPUPlace())
         exe.run(full_startup)
         exe.run(cache_startup)
+        # the caches really are n_kv-sized
         for n in cache_names:
             assert tuple(np.asarray(scope.find_var(n)).shape) == (
                 B, 2, T, 16 // 4), n
@@ -990,6 +989,25 @@ def test_gpt2_gqa_cached_decode_matches_full():
         out = gpt2.greedy_generate_cached(
             exe, step_main, cache_startup, step_fetch, prompt, 6)
         np.testing.assert_array_equal(out, ref)
+
+        # per-position LOGITS parity, not just argmax: feed the ref
+        # sequence through both programs step by step
+        exe.run(cache_startup)
+        seq = ref
+        buf = np.zeros((B, T), "int64")
+        buf[:, :seq.shape[1]] = seq
+        (full_logits,) = exe.run(full_main, feed={"ids": buf},
+                                 fetch_list=full_fetch)
+        full_logits = np.asarray(full_logits)
+        for t in range(seq.shape[1]):
+            (step_logits,) = exe.run(
+                step_main,
+                feed={"step_ids": seq[:, t:t + 1],
+                      "pos": np.array([t], "int64")},
+                fetch_list=step_fetch)
+            np.testing.assert_allclose(
+                np.asarray(step_logits), full_logits[:, t], rtol=2e-4,
+                atol=2e-5)
 
 
 def test_rotary_embed_numeric_reference():
@@ -1060,4 +1078,38 @@ def test_gpt2_rotary_cached_decode_matches_full():
         ref = gpt2.greedy_generate(exe, full_main, full_fetch, prompt, 6)
         out = gpt2.greedy_generate_cached(
             exe, step_main, cache_startup, step_fetch, prompt, 6)
+        np.testing.assert_array_equal(out, ref)
+
+
+def test_gpt2_gqa_plus_rotary_cached_decode_matches_full():
+    """The modern-decoder combination — grouped-query attention AND
+    rotary positions — through the folded-group cached decode path."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu.models import gpt2
+
+    class HP(gpt2.GPT2Config):
+        vocab_size = 50
+        n_ctx = 16
+        d_model = 16
+        n_layer = 2
+        n_head = 4
+        n_kv_head = 2
+        use_rotary = True
+        dropout = 0.0
+
+    B, T = 2, 16
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        full_main, full_startup, _, full_fetch = gpt2.gpt2_logits_program(
+            HP, seq_len=T)
+        step_main, cache_startup, _, step_fetch, _ = \
+            gpt2.gpt2_decode_step_program(HP, batch=B, t_max=T)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(full_startup)
+        rng = np.random.RandomState(1)
+        prompt = rng.randint(1, 50, (B, 3)).astype("int64")
+        ref = gpt2.greedy_generate(exe, full_main, full_fetch, prompt, 7)
+        out = gpt2.greedy_generate_cached(
+            exe, step_main, cache_startup, step_fetch, prompt, 7)
         np.testing.assert_array_equal(out, ref)
