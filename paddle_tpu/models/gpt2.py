@@ -43,6 +43,7 @@ class GPT2Config:
     n_head = 12
     n_kv_head = None  # < n_head enables grouped-query attention (MQA at 1)
     use_rotary = False  # RoPE on q/k instead of the learned position table
+    use_swiglu = False  # gated SiLU FFN (2/3 width) instead of gelu MLP
     dropout = 0.1
     recompute = False  # rematerialize each block's activations in backward
 
@@ -76,11 +77,22 @@ def _block(x, hp, is_test, cache=None):
     if hp.dropout and not is_test:
         a = layers.dropout(a, hp.dropout, is_test=is_test)
     x = layers.elementwise_add(x, a)
-    h = layers.fc(
-        layers.layer_norm(x, begin_norm_axis=2), size=4 * hp.d_model,
-        num_flatten_dims=2, act="gelu",
-        param_attr=_pa("ffn_in.w"), bias_attr=_pa("ffn_in.b"),
-    )
+    ln = layers.layer_norm(x, begin_norm_axis=2)
+    if getattr(hp, "use_swiglu", False):
+        # SwiGLU: silu(xW_g) * xW_u -> W_out, hidden at 2/3 of 4*d so
+        # the parameter count matches the gelu MLP (the standard sizing)
+        hid = int(4 * hp.d_model * 2 // 3)
+        gate = layers.fc(ln, size=hid, num_flatten_dims=2,
+                         act="swish", bias_attr=False,
+                         param_attr=_pa("ffn_gate.w"))
+        up = layers.fc(ln, size=hid, num_flatten_dims=2, bias_attr=False,
+                       param_attr=_pa("ffn_up.w"))
+        h = layers.elementwise_mul(gate, up)
+    else:
+        h = layers.fc(
+            ln, size=4 * hp.d_model, num_flatten_dims=2, act="gelu",
+            param_attr=_pa("ffn_in.w"), bias_attr=_pa("ffn_in.b"),
+        )
     h = layers.fc(h, size=hp.d_model, num_flatten_dims=2,
                   param_attr=_pa("ffn_out.w"))
     if hp.dropout and not is_test:
