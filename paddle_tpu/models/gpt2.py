@@ -44,6 +44,7 @@ class GPT2Config:
     n_kv_head = None  # < n_head enables grouped-query attention (MQA at 1)
     use_rotary = False  # RoPE on q/k instead of the learned position table
     use_swiglu = False  # gated SiLU FFN (2/3 width) instead of gelu MLP
+    tie_embeddings = False  # output logits reuse emb.w (x @ emb.w^T)
     dropout = 0.1
     recompute = False  # rematerialize each block's activations in backward
 
@@ -100,10 +101,24 @@ def _block(x, hp, is_test, cache=None):
     return layers.elementwise_add(x, h)
 
 
+def _tied_logits(x, hp, emb_name):
+    """Output projection: x @ emb.w^T when tie_embeddings (saves the
+    [vocab, d] output matrix and couples input/output token geometry),
+    else a separate softmax_out.w."""
+    if getattr(hp, "tie_embeddings", False):
+        from .. import framework
+
+        w = framework.default_main_program().global_block().var(emb_name)
+        return layers.matmul(x, w, transpose_y=True)
+    return layers.fc(x, size=hp.vocab_size, num_flatten_dims=2,
+                     bias_attr=False, param_attr=_pa("softmax_out.w"))
+
+
 def gpt2_lm(ids, hp=GPT2Config, is_test=False):
     """[B, T] token ids -> [B, T, vocab] next-token logits."""
+    emb_attr = _pa("emb.w")
     tok = layers.embedding(
-        ids, size=[hp.vocab_size, hp.d_model], param_attr=_pa("emb.w")
+        ids, size=[hp.vocab_size, hp.d_model], param_attr=emb_attr
     )
     if getattr(hp, "use_rotary", False):
         x = tok  # positions enter via RoPE on q/k inside attention
@@ -123,8 +138,7 @@ def gpt2_lm(ids, hp=GPT2Config, is_test=False):
         else:
             x = _block(x, hp, is_test)
     x = layers.layer_norm(x, begin_norm_axis=2)
-    return layers.fc(x, size=hp.vocab_size, num_flatten_dims=2,
-                     bias_attr=False, param_attr=_pa("softmax_out.w"))
+    return _tied_logits(x, hp, emb_attr.name)
 
 
 def gpt2_lm_program(hp=GPT2Config, seq_len=128, lr=3e-4, is_test=False,
@@ -222,8 +236,9 @@ def gpt2_decode_step_program(hp=GPT2Config, batch=1, t_max=None):
                           append_batch_size=False)
         pos = layers.data("pos", shape=[1], dtype="int64",
                           append_batch_size=False)
+        emb_attr = _pa("emb.w")
         tok = layers.embedding(
-            ids, size=[hp.vocab_size, hp.d_model], param_attr=_pa("emb.w")
+            ids, size=[hp.vocab_size, hp.d_model], param_attr=emb_attr
         )  # [B, D] (the T=1 axis squeezes in the lookup)
         tok = layers.reshape(tok, shape=[batch, 1, hp.d_model])
         if getattr(hp, "use_rotary", False):
@@ -249,8 +264,7 @@ def gpt2_decode_step_program(hp=GPT2Config, batch=1, t_max=None):
             cache["pos"] = pos
             x = _block(x, hp, is_test=True, cache=cache)
         x = layers.layer_norm(x, begin_norm_axis=2)
-        logits = layers.fc(x, size=hp.vocab_size, num_flatten_dims=2,
-                           bias_attr=False, param_attr=_pa("softmax_out.w"))
+        logits = _tied_logits(x, hp, emb_attr.name)
         logits = layers.reshape(logits, shape=[batch, hp.vocab_size])
     return main, cache_startup, ["step_ids", "pos"], [logits], cache_names
 

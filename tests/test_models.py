@@ -1158,3 +1158,46 @@ def test_gpt2_swiglu_trains_and_cached_decode_matches():
         out = gpt2.greedy_generate_cached(
             exe, step_main, cache_startup, step_fetch, prompt, 6)
         np.testing.assert_array_equal(out, ref)
+
+
+def test_gpt2_tied_embeddings_trains_and_decodes():
+    """tie_embeddings: no separate softmax_out.w — logits reuse emb.w
+    transposed; trains, and cached decode matches the full program."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu.models import gpt2
+
+    class HP(gpt2.GPT2Config):
+        vocab_size = 50
+        n_ctx = 16
+        d_model = 16
+        n_layer = 2
+        n_head = 2
+        tie_embeddings = True
+        dropout = 0.0
+
+    B, T = 2, 16
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        main, startup, feeds, fetches = gpt2.gpt2_lm_program(
+            HP, seq_len=8, lr=3e-3)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        assert not any(n.startswith("softmax_out")
+                       for n in scope.all_var_names())
+        batch = gpt2.make_fake_lm_batch(4, 8, HP, seed=0)
+        losses = []
+        for _ in range(8):
+            out = exe.run(main, feed=batch, fetch_list=fetches)
+            losses.append(float(np.ravel(np.asarray(out[0]))[0]))
+        assert losses[-1] < losses[0], losses
+
+        full_main, _, _, full_fetch = gpt2.gpt2_logits_program(HP, seq_len=T)
+        step_main, cache_startup, _, step_fetch, _ = \
+            gpt2.gpt2_decode_step_program(HP, batch=B, t_max=T)
+        rng = np.random.RandomState(3)
+        prompt = rng.randint(1, 50, (B, 3)).astype("int64")
+        ref = gpt2.greedy_generate(exe, full_main, full_fetch, prompt, 6)
+        out = gpt2.greedy_generate_cached(
+            exe, step_main, cache_startup, step_fetch, prompt, 6)
+        np.testing.assert_array_equal(out, ref)
