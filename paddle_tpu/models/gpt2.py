@@ -1,14 +1,18 @@
 """GPT-2-style decoder-only LM (BASELINE.json config 5: "ERNIE / GPT-2
 345M (TP+DP on TPU mesh via DistributeTranspiler->GSPMD)").
 
-Pre-LN causal transformer: x + attn(ln(x)), x + ffn(ln(x)) with GELU,
-final ln, untied LM head.  Attention always goes through the
+Pre-LN causal transformer: x + attn(ln(x)), x + ffn(ln(x)); by default a
+GELU MLP, learned positions, untied LM head — with modern-decoder
+options on GPT2Config: n_kv_head (grouped-query attention), use_rotary
+(RoPE instead of the position table), use_swiglu (gated SiLU FFN:
+ffn_gate.w/ffn_up.w replace ffn_in.w), tie_embeddings (logits reuse
+emb.w; no softmax_out.w exists).  Attention always goes through the
 fused_attention op with causal=True — no [T, T] mask tensor ever exists
 in the program (the op's flash kernel runs under FLAGS_use_pallas, fused
 XLA otherwise).  Parameter names reuse the transformer TP patterns
-(mha_[qkv].w / mha_o.w / ffn_in.w / ffn_out.w / emb.w / softmax_out.w) so
-`parallel.transformer_tp_rules` shards this model unchanged on a
-{dp, mp} mesh.
+(mha_[qkv].w / mha_o.w / ffn_in.w or ffn_gate.w+ffn_up.w / ffn_out.w /
+emb.w / softmax_out.w) so `parallel.transformer_tp_rules` shards every
+option combination unchanged on a {dp, mp} mesh.
 """
 
 import numpy as np

@@ -61,6 +61,9 @@ def transformer_tp_rules(mp_axis="mp"):
             (r"mha_o\.w", P(mp_axis, None)),
             (r"ffn_in\.w", P(None, mp_axis)),
             (r"ffn_in\.b", P(mp_axis)),
+            # SwiGLU variant (gpt2 use_swiglu): both gate and up are
+            # column-parallel like ffn_in
+            (r"ffn_(gate|up)\.w", P(None, mp_axis)),
             (r"ffn_out\.w", P(mp_axis, None)),
             (r"embedding.*\.w|emb\.w", P(mp_axis, None)),
             (r"softmax_out\.w", P(None, mp_axis)),

@@ -849,3 +849,15 @@ def test_compile_count_constant_across_device_counts():
         assert len(dexe._cache) == 1, (n, len(dexe._cache))
         ((_, jitted),) = dexe._cache.values()
         assert jitted._cache_size() == 1, (n, jitted._cache_size())
+
+
+def test_tp_rules_cover_swiglu_params():
+    """The SwiGLU FFN params shard column-parallel like ffn_in — a
+    use_swiglu model must not silently fall back to replicated FFN
+    weights under TP."""
+    from jax.sharding import PartitionSpec as P
+
+    rules = parallel.transformer_tp_rules("mp")
+    assert rules.spec_for("ffn_gate.w_3", 2) == P(None, "mp")
+    assert rules.spec_for("ffn_up.w_0", 2) == P(None, "mp")
+    assert rules.spec_for("ffn_out.w_1", 2) == P("mp", None)
