@@ -48,6 +48,8 @@ class GPT2Config:
     n_kv_head = None  # < n_head enables grouped-query attention (MQA at 1)
     use_rotary = False  # RoPE on q/k instead of the learned position table
     use_swiglu = False  # gated SiLU FFN (2/3 width) instead of gelu MLP
+    ffn_multiple_of = 1  # round the SwiGLU hidden up (128/256 aligns
+    # the lane dim and keeps TP divisibility; 1 = exact 2/3 sizing)
     tie_embeddings = False  # output logits reuse emb.w (x @ emb.w^T)
     dropout = 0.1
     recompute = False  # rematerialize each block's activations in backward
@@ -87,6 +89,8 @@ def _block(x, hp, is_test, cache=None):
         # SwiGLU: silu(xW_g) * xW_u -> W_out, hidden at 2/3 of 4*d so
         # the parameter count matches the gelu MLP (the standard sizing)
         hid = int(4 * hp.d_model * 2 // 3)
+        mult = int(getattr(hp, "ffn_multiple_of", 1) or 1)
+        hid = ((hid + mult - 1) // mult) * mult
         gate = layers.fc(ln, size=hid, num_flatten_dims=2,
                          act="swish", bias_attr=False,
                          param_attr=_pa("ffn_gate.w"))
