@@ -1,3 +1,3 @@
-from .quantize_transpiler import QuantizeTranspiler
+from .quantize_transpiler import QuantizeTranspiler, quantize_weights_int8
 
-__all__ = ["QuantizeTranspiler"]
+__all__ = ["QuantizeTranspiler", "quantize_weights_int8"]

@@ -317,3 +317,75 @@ class QuantizeTranspiler:
                 block.vars.pop(wname, None)
         program._bump_version()
         return count
+
+
+def quantize_weights_int8(program, scope=None, min_elems=1024):
+    """POST-TRAINING weight-only int8 (no QAT required): every
+    mul/matmul/conv2d weight parameter >= min_elems becomes an int8
+    tensor + scale in the scope, and the op dequantizes at compute time
+    (XLA fuses the dequant into the matmul read) — activations stay
+    full precision, so there is no activation-quantization error and no
+    calibration step.  Halves weight HBM/footprint: the standard
+    serving recipe for embedding/vocab-heavy LLM decode.  Weights are
+    per-out-channel scaled for conv2d, per-tensor otherwise.  Shared
+    weights (tied embeddings) convert once.  Returns converted-op
+    count."""
+    from ...executor import global_scope
+    from ... import framework
+
+    scope = scope if scope is not None else global_scope()
+    block = program.global_block()
+    _W_SLOT = {"mul": "Y", "matmul": "Y",
+               "conv2d": "Filter", "depthwise_conv2d": "Filter",
+               "lookup_table": "W", "lookup_table_v2": "W"}
+    done = {}  # weight name -> (int8 name, scale name)
+    count = 0
+    for op in block.ops:
+        slot = _W_SLOT.get(op.type)
+        if slot is None:
+            continue
+        wname = op.inputs[slot][0]
+        v = block._find_var_recursive(wname)
+        wv = scope.find_var(wname)
+        if (wv is None or v is None or not getattr(v, "persistable", False)):
+            continue
+        wv = np.asarray(wv, dtype=np.float32)
+        if wv.size < min_elems:
+            continue
+        rng = 127.0
+        if wname not in done:
+            if op.type.endswith("conv2d"):
+                axes = tuple(range(1, wv.ndim))
+                scale = np.maximum(np.abs(wv).max(axis=axes), 1e-8)
+                q = wv / scale.reshape((-1,) + (1,) * (wv.ndim - 1)) * rng
+            else:
+                scale = np.array([max(float(np.abs(wv).max()), 1e-8)],
+                                 np.float32)
+                q = wv / scale[0] * rng
+            w_int8 = np.clip(np.round(q), -rng, rng).astype(np.int8)
+            iname, sname = wname + ".w8", wname + ".w8scale"
+            for nm, val in ((iname, w_int8),
+                            (sname, scale.astype(np.float32))):
+                block.create_var(name=nm, shape=list(val.shape),
+                                 dtype=str(val.dtype), persistable=True)
+                scope.set(nm, val)
+            done[wname] = (iname, sname)
+        iname, sname = done[wname]
+        op.type = ("quantized_lookup_table"
+                   if op.type.startswith("lookup_table")
+                   else "quantized_" + op.type)
+        op.inputs[slot] = [iname]
+        op.inputs["WScale"] = [sname]
+        op.attrs["bit_length"] = 8
+        op.attrs["weight_only"] = True
+        count += 1
+    # drop the f32 originals that no remaining op reads
+    still_read = set()
+    for op in block.ops:
+        still_read.update(op.input_arg_names())
+    for wname in done:
+        if wname not in still_read:
+            scope.erase(wname)
+            block.vars.pop(wname, None)
+    program._bump_version()
+    return count

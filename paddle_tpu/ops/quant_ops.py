@@ -118,11 +118,30 @@ def _act_to_int8(x, ins, rng):
     return q.astype(jnp.int8), s
 
 
+def _dequant_w(ins, attrs, rng, ch_axis=0):
+    """Weight-only mode: reconstruct the float weight from int8 + scale
+    (XLA fuses this dequant into the consuming matmul/conv read)."""
+    w = ins["Y" if "Y" in ins else "Filter"][0]
+    sw = ins["WScale"][0]
+    if int(sw.size) > 1:  # per-out-channel (conv)
+        bshape = [1] * w.ndim
+        bshape[ch_axis] = int(sw.size)
+        return w.astype(jnp.float32) * (sw.reshape(bshape) / rng)
+    return w.astype(jnp.float32) * (sw.reshape(()) / rng)
+
+
 @register("quantized_mul")
 def _quantized_mul(ctx, ins, attrs):
     x, w = ins["X"][0], ins["Y"][0]  # w: int8 [K, N]
     rng = float(2 ** (attrs.get("bit_length", 8) - 1) - 1)
     xn = attrs.get("x_num_col_dims", 1)
+    if attrs.get("weight_only"):
+        wf = _dequant_w(ins, attrs, rng).astype(x.dtype)
+        lead = 1
+        for d in x.shape[:xn]:
+            lead *= d
+        out = x.reshape(lead, -1) @ wf
+        return {"Out": [out.reshape(tuple(x.shape[:xn]) + tuple(w.shape[1:]))]}
     lead = 1
     for d in x.shape[:xn]:
         lead *= d
@@ -141,6 +160,11 @@ def _quantized_mul(ctx, ins, attrs):
 def _quantized_matmul(ctx, ins, attrs):
     x, w = ins["X"][0], ins["Y"][0]
     rng = float(2 ** (attrs.get("bit_length", 8) - 1) - 1)
+    if attrs.get("weight_only"):
+        from .math_ops import _matmul
+
+        wf = _dequant_w(ins, attrs, rng).astype(x.dtype)
+        return _matmul(ctx, {"X": [x], "Y": [wf]}, attrs)
     if attrs.get("transpose_Y", False):
         w = jnp.swapaxes(w, -1, -2)
     xq, sx = _act_to_int8(x, ins, rng)
@@ -162,6 +186,14 @@ def _quantized_conv_impl(ctx, ins, attrs, groups=None):
 
     x, w = ins["Input"][0], ins["Filter"][0]  # w: int8 OIHW
     rng = float(2 ** (attrs.get("bit_length", 8) - 1) - 1)
+    if attrs.get("weight_only"):
+        from .nn_ops import _conv2d, _depthwise_conv2d
+
+        wf = _dequant_w(ins, attrs, rng, ch_axis=0).astype(x.dtype)
+        sub = dict(ins)
+        sub["Filter"] = [wf]
+        fn = _depthwise_conv2d if groups == "depthwise" else _conv2d
+        return fn(ctx, sub, attrs)
     fmt = attrs.get("data_format", "NCHW")
     ch_axis = 1 if fmt == "NCHW" else x.ndim - 1
     if groups == "depthwise":
@@ -207,3 +239,21 @@ def _quantized_conv2d(ctx, ins, attrs):
 @register("quantized_depthwise_conv2d")
 def _quantized_depthwise_conv2d(ctx, ins, attrs):
     return _quantized_conv_impl(ctx, ins, attrs, groups="depthwise")
+
+
+@register("quantized_lookup_table", no_grad_inputs=("Ids", "W", "WScale"))
+def _quantized_lookup_table(ctx, ins, attrs):
+    """Weight-only int8 embedding lookup: gather int8 rows, dequant by
+    the per-tensor scale — the gather reads 1/4 the HBM of f32 rows."""
+    w, ids = ins["W"][0], ins["Ids"][0]
+    rng = float(2 ** (attrs.get("bit_length", 8) - 1) - 1)
+    sw = ins["WScale"][0].reshape(())
+    ids = ids.astype(jnp.int32)
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+    out = jnp.take(w, ids, axis=0).astype(jnp.float32) * (sw / rng)
+    pad = attrs.get("padding_idx", -1)
+    if pad is not None and pad != -1:
+        mask = (ids != pad).astype(out.dtype)[..., None]
+        out = out * mask
+    return {"Out": [out]}

@@ -216,3 +216,53 @@ def test_analysis_config_enable_int8_serving(tmp_path):
                                 main_program=main2)
     with pytest.raises(ValueError, match="no quantizable ops converted"):
         create_paddle_predictor(AnalysisConfig(plain_dir).enable_int8())
+
+
+def test_weight_only_int8_gpt2_logits_close():
+    """Post-training weight-only int8 (no QAT): a trained GPT-2 logits
+    program quantizes its matmul weights to int8+scale, outputs stay
+    close (weight rounding is the only error source), f32 originals are
+    dropped, and the tied embedding converts ONCE for both uses."""
+    from paddle_tpu.contrib.quantize import quantize_weights_int8
+    from paddle_tpu.models import gpt2
+
+    class HP(gpt2.GPT2Config):
+        vocab_size = 64
+        n_ctx = 16
+        d_model = 32
+        n_layer = 2
+        n_head = 2
+        tie_embeddings = True
+        dropout = 0.0
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        main, startup, feeds, fetches = gpt2.gpt2_lm_program(
+            HP, seq_len=8, lr=3e-3)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        batch = gpt2.make_fake_lm_batch(4, 8, HP, seed=0)
+        for _ in range(5):
+            exe.run(main, feed=batch, fetch_list=fetches)
+
+        lmain, _, _, lfetch = gpt2.gpt2_logits_program(HP, seq_len=8)
+        ids = batch["ids"]
+        (ref,) = exe.run(lmain, feed={"ids": ids}, fetch_list=lfetch)
+
+        n = quantize_weights_int8(lmain, scope=scope, min_elems=64)
+        types = [op.type for op in lmain.global_block().ops]
+        assert n >= 2 and any(t.startswith("quantized_") for t in types)
+        assert "quantized_lookup_table" in types  # embedding gathers int8
+        # tied embedding: ONE int8 copy serves lookup + logits matmul,
+        # and the f32 original is gone
+        w8_names = [nm for nm in scope.all_var_names() if nm.endswith(".w8")]
+        emb8 = [nm for nm in w8_names if "emb.w" in nm]
+        assert len(emb8) == 1
+        assert scope.find_var(emb8[0][:-3]) is None
+        (got,) = exe.run(lmain, feed={"ids": ids}, fetch_list=lfetch)
+    ref, got = np.asarray(ref), np.asarray(got)
+    # logits shift by weight-rounding only: close in absolute terms at
+    # this scale, and argmax (the serving decision) is near-identical
+    np.testing.assert_allclose(got, ref, atol=0.1)
+    agree = (got.argmax(-1) == ref.argmax(-1)).mean()
+    assert agree > 0.95, agree
