@@ -200,7 +200,7 @@ class QuantizeTranspiler:
         return program
 
     # ------------------------------------------------------------------
-    def convert_to_int8(self, program, scope=None):
+    def convert_to_int8(self, program, place=None, scope=None):
         """Convert a FROZEN QAT program to REAL int8 compute (the
         reference's TensorRT-int8 serving capability,
         inference/tensorrt/convert precedent, re-done TPU-native): each
@@ -212,7 +212,9 @@ class QuantizeTranspiler:
         MXU, one fused dequant rescale.  mul/matmul weights must be
         abs_max-quantized (scalar scale — per-row scales cannot be
         factored out of the contraction); conv weights may be abs_max or
-        channel_wise.  Returns the count of converted ops."""
+        channel_wise.  ``place`` is accepted for reference-signature
+        compat and ignored (XLA owns placement).  Returns the count of
+        converted ops."""
         from ...executor import global_scope
 
         if self.weight_bits != 8 or self.activation_bits != 8:
@@ -327,9 +329,13 @@ def quantize_weights_int8(program, scope=None, min_elems=1024):
     full precision, so there is no activation-quantization error and no
     calibration step.  Halves weight HBM/footprint: the standard
     serving recipe for embedding/vocab-heavy LLM decode.  Weights are
-    per-out-channel scaled for conv2d, per-tensor otherwise.  Shared
-    weights (tied embeddings) convert once.  Returns converted-op
-    count."""
+    per-out-channel scaled for conv2d, per-row (axis 0) for embedding
+    tables whose every consumer is a lookup — a few outlier rows must
+    not crush the precision of the whole vocab — and per-tensor
+    otherwise.  Shared weights (tied embeddings, where a matmul also
+    reads the table) convert once, per-tensor, since per-row scales
+    cannot be factored out of the tied projection's contraction.
+    Returns converted-op count."""
     from ...executor import global_scope
     from ... import framework
 
@@ -338,6 +344,13 @@ def quantize_weights_int8(program, scope=None, min_elems=1024):
     _W_SLOT = {"mul": "Y", "matmul": "Y",
                "conv2d": "Filter", "depthwise_conv2d": "Filter",
                "lookup_table": "W", "lookup_table_v2": "W"}
+    # weight -> set of consumer op types (per-row scales are only legal
+    # when the table is exclusively gathered, never contracted)
+    consumers = {}
+    for op in block.ops:
+        slot = _W_SLOT.get(op.type)
+        if slot is not None:
+            consumers.setdefault(op.inputs[slot][0], set()).add(op.type)
     done = {}  # weight name -> (int8 name, scale name)
     count = 0
     for op in block.ops:
@@ -354,7 +367,11 @@ def quantize_weights_int8(program, scope=None, min_elems=1024):
             continue
         rng = 127.0
         if wname not in done:
-            if op.type.endswith("conv2d"):
+            lookup_only = all(
+                t.startswith("lookup_table") for t in consumers[wname])
+            if op.type.endswith("conv2d") or (
+                    op.type.startswith("lookup_table") and lookup_only
+                    and wv.ndim >= 2):
                 axes = tuple(range(1, wv.ndim))
                 scale = np.maximum(np.abs(wv).max(axis=axes), 1e-8)
                 q = wv / scale.reshape((-1,) + (1,) * (wv.ndim - 1)) * rng

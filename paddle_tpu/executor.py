@@ -364,7 +364,27 @@ class Executor:
         keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
             jnp.arange(step0, step0 + iters)
         )
-        fetches, new_state = jitted(feed_arrays, ro_state, rw_state, keys)
+        try:
+            fetches, new_state = jitted(feed_arrays, ro_state, rw_state, keys)
+        except TypeError:
+            # jit argument validation fails BEFORE dispatch: nothing was
+            # donated, the scope is intact — surface the plain error
+            self._step = step0
+            raise
+        except Exception as e:
+            # rw_state was donated (donate_argnums=(2,)): a failure
+            # mid-call (device OOM, ...) leaves the scope holding
+            # deleted buffers and every later run() would die with an
+            # opaque deleted-buffer error — fail loudly instead.
+            self._step = step0
+            raise RuntimeError(
+                "Executor.run_loop: the compiled loop failed after its "
+                "read-write state was donated to the device; the scope "
+                "state for %s is invalidated. Re-run the startup "
+                "program or reload a checkpoint before calling run()/"
+                "run_loop() on this scope again. Original error: %r"
+                % (sorted(traced.rw_names)[:8], e)
+            ) from e
         for n, v in new_state.items():
             scope.set(n, v)
         if return_numpy:

@@ -244,14 +244,20 @@ def _quantized_depthwise_conv2d(ctx, ins, attrs):
 @register("quantized_lookup_table", no_grad_inputs=("Ids", "W", "WScale"))
 def _quantized_lookup_table(ctx, ins, attrs):
     """Weight-only int8 embedding lookup: gather int8 rows, dequant by
-    the per-tensor scale — the gather reads 1/4 the HBM of f32 rows."""
+    the scale — per-row (WScale shape [V], gathered alongside the rows
+    so no extra HBM traffic beyond 4 bytes/id) or per-tensor
+    (scalar).  The gather reads ~1/4 the HBM of f32 rows."""
     w, ids = ins["W"][0], ins["Ids"][0]
     rng = float(2 ** (attrs.get("bit_length", 8) - 1) - 1)
-    sw = ins["WScale"][0].reshape(())
+    sw = ins["WScale"][0]
     ids = ids.astype(jnp.int32)
     if ids.ndim >= 2 and ids.shape[-1] == 1:
         ids = ids[..., 0]
-    out = jnp.take(w, ids, axis=0).astype(jnp.float32) * (sw / rng)
+    rows = jnp.take(w, ids, axis=0).astype(jnp.float32)
+    if sw.ndim >= 1 and sw.size > 1:  # per-row scales
+        out = rows * (jnp.take(sw, ids, axis=0)[..., None] / rng)
+    else:
+        out = rows * (sw.reshape(()) / rng)
     pad = attrs.get("padding_idx", -1)
     if pad is not None and pad != -1:
         mask = (ids != pad).astype(out.dtype)[..., None]

@@ -24,8 +24,10 @@ the AMP propagation.
 
 Caveats (documented in docs/MIGRATION.md): after the rewrite, trunk
 intermediates are produced only as their ``@NHWC`` aliases; fetching one
-of them by name from ``exe.run`` requires fetching the alias (or leaving
-that var out of the trunk).  Vars read by sub-block ops are materialized
+of them by name from ``exe.run`` requires fetching the alias, listing it
+in ``program._protected_fetch_names`` before the pass (those stay
+materialized in NCHW, same contract as the fuse passes), or leaving
+that var out of the trunk.  Vars read by sub-block ops are materialized
 in NCHW automatically.  RNG-consuming trunk ops (dropout) keep their
 distribution but not their exact stream — the inserted transposes shift
 op indices, and the per-op RNG folds in the op position (give the op a
@@ -194,6 +196,12 @@ def rewrite_nhwc(program=None):
         for name in op.input_arg_names():
             to_nchw(name)
         new_ops.append(op)
+
+    # protected fetch targets (program._protected_fetch_names, same
+    # contract as the fuse passes) must stay materialized in NCHW even
+    # when every remaining consumer was rewired to the @NHWC alias
+    for name in getattr(program, "_protected_fetch_names", ()):
+        to_nchw(name)
 
     block.ops = new_ops
     return count

@@ -220,3 +220,40 @@ def test_nhwc_grouped_conv_se_resnext_parity():
         return out
 
     np.testing.assert_allclose(run(True), run(False), rtol=2e-5, atol=2e-6)
+
+
+def test_nhwc_protected_fetch_materialized():
+    """ADVICE r4 (low): a trunk intermediate listed in
+    program._protected_fetch_names stays materialized in NCHW after
+    rewrite_nhwc (same default-closed contract as the fuse passes), even
+    when its every consumer was rewired to the @NHWC alias."""
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.framework.program_guard(main, startup):
+            startup.random_seed = 11
+            img = layers.data("image", shape=[3, 8, 8], dtype="float32")
+            conv = layers.conv2d(input=img, num_filters=4, filter_size=3,
+                                 stride=1, padding=1, bias_attr=False)
+            act = layers.relu(conv)
+            out = layers.reduce_sum(act, dim=[1, 2, 3])
+        return main, startup, conv.name, out
+
+    x = np.random.RandomState(2).rand(2, 3, 8, 8).astype("float32")
+
+    def run(rewrite):
+        main, startup, conv_name, out = build()
+        if rewrite:
+            main._protected_fetch_names = {conv_name}
+            rewrite_nhwc(main)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            vals = exe.run(main, feed={"image": x},
+                           fetch_list=[conv_name, out])
+        return [np.asarray(v) for v in vals]
+
+    got, ref = run(True), run(False)
+    assert got[0].shape == ref[0].shape  # NCHW, not the NHWC alias
+    np.testing.assert_allclose(got[0], ref[0], rtol=1e-6)
+    np.testing.assert_allclose(got[1], ref[1], rtol=1e-6)

@@ -213,3 +213,41 @@ def test_run_loop_matches_sequential_runs():
         layers.reduce_sum(xr)
     with pytest.raises(ValueError, match="host-boundary"):
         exe2.run_loop(2, mainr)
+
+
+def test_run_loop_failure_reports_invalidated_scope():
+    """ADVICE r4 (low): run_loop donates the rw state to the device; if
+    the compiled call fails mid-flight the executor must raise a CLEAR
+    error naming the invalidated scope state (not a later opaque
+    deleted-buffer error), and must roll back its RNG step counter."""
+    import numpy as np
+    import pytest
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.framework.program_guard(main, startup):
+        x = layers.data("dlx", shape=[4])
+        p = layers.fc(x, 2, param_attr=fluid.ParamAttr(name="dl_w"))
+        loss = layers.mean(p)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+
+    xv = np.random.RandomState(0).rand(8, 4).astype("float32")
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run_loop(2, main, feed={"dlx": xv}, fetch_list=[loss])
+        step_before = exe._step
+
+        def boom(*a, **k):
+            raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+
+        exe._loop_cache = {
+            k: (traced, boom) for k, (traced, jitted)
+            in exe._loop_cache.items()
+        }
+        with pytest.raises(RuntimeError, match="scope state .* invalidated"
+                           "|state was donated"):
+            exe.run_loop(2, main, feed={"dlx": xv}, fetch_list=[loss])
+        assert exe._step == step_before  # rolled back

@@ -266,3 +266,111 @@ def test_weight_only_int8_gpt2_logits_close():
     np.testing.assert_allclose(got, ref, atol=0.1)
     agree = (got.argmax(-1) == ref.argmax(-1)).mean()
     assert agree > 0.95, agree
+
+
+def test_weight_only_int8_per_row_embedding_scales():
+    """ADVICE r4 (medium): a lookup-only embedding table quantizes with
+    per-ROW (axis-0) scales, so one outlier row cannot crush the
+    precision of the whole vocab; dequant gathers the scale alongside
+    the rows."""
+    from paddle_tpu.contrib.quantize import quantize_weights_int8
+
+    V, D = 32, 16
+    rng = np.random.RandomState(7)
+    table = (rng.rand(V, D).astype("float32") - 0.5) * 0.2
+    table[3] *= 500.0  # the outlier row
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.framework.program_guard(main, startup):
+        ids = layers.data("ids", shape=[1], dtype="int64")
+        emb = layers.embedding(ids, size=[V, D],
+                               param_attr=fluid.ParamAttr(name="emb_tbl"))
+
+    idv = np.arange(V, dtype="int64").reshape(V, 1)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        scope.set("emb_tbl", table)
+        (ref,) = exe.run(main, feed={"ids": idv}, fetch_list=[emb])
+        n = quantize_weights_int8(main, scope=scope, min_elems=64)
+        assert n == 1
+        sw = np.asarray(scope.find_var("emb_tbl.w8scale"))
+        assert sw.shape == (V,)  # per-row, NOT a scalar
+        (got,) = exe.run(main, feed={"ids": idv}, fetch_list=[emb])
+    ref, got = np.asarray(ref), np.asarray(got)
+    # per-tensor scale would give worst-case error ~ max|table|/127 ~ 0.4
+    # on every non-outlier row; per-row keeps them at ~ 0.1/127
+    non_outlier = [i for i in range(V) if i != 3]
+    np.testing.assert_allclose(got[non_outlier], ref[non_outlier],
+                               atol=2e-3)
+    np.testing.assert_allclose(got[3], ref[3], atol=0.5)
+
+
+def test_convert_to_int8_accepts_positional_place():
+    """ADVICE r4 (low): reference signature is convert_to_int8(program,
+    place, scope=None) — a positional place must not bind to scope."""
+    main, scope, qt, xv, pred = _train_qat_fc("abs_max", steps=3)
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        infer = main.clone(for_test=True)._prune(pred)
+        frozen = qt.freeze_program(infer, scope=scope)
+        n = qt.convert_to_int8(frozen, fluid.CPUPlace(), scope=scope)
+        assert n == 2
+
+
+def test_quantized_ops_compile_to_integer_hlo():
+    """VERDICT r4 item 7: prove int8 is int8 — the COMPILED HLO of the
+    quantized ops must contain an s32-accumulating dot/convolution over
+    s8 operands, not a silent f32 upcast."""
+    import re
+
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.registry import get_op
+
+    class Ctx:
+        is_test = True
+
+    rng = np.random.RandomState(0)
+
+    def lowered_text(fn, *args):
+        low = jax.jit(fn).lower(*args)
+        return low.as_text(), low.compile().as_text()
+
+    x = jnp.asarray(rng.rand(4, 8).astype("float32"))
+    w8 = jnp.asarray(rng.randint(-127, 127, (8, 16)).astype("int8"))
+    sw = jnp.asarray(np.array([0.5], np.float32))
+
+    def f_mul(x, w8, sw):
+        return get_op("quantized_mul").lower(
+            Ctx(), {"X": [x], "Y": [w8], "WScale": [sw]},
+            {"bit_length": 8})["Out"][0]
+
+    shlo, hlo = lowered_text(f_mul, x, w8, sw)
+    assert re.search(r"dot_general.*i8.*i8.*->.*i32", shlo), shlo
+    assert re.search(r"= s32\[[^\]]*\]\S* dot\(", hlo), hlo
+
+    def f_matmul(x, w8, sw):
+        return get_op("quantized_matmul").lower(
+            Ctx(), {"X": [x], "Y": [w8], "WScale": [sw]},
+            {"bit_length": 8})["Out"][0]
+
+    shlo, hlo = lowered_text(f_matmul, x, w8, sw)
+    assert re.search(r"dot_general.*i8.*i8.*->.*i32", shlo), shlo
+    assert re.search(r"= s32\[[^\]]*\]\S* dot\(", hlo), hlo
+
+    xc = jnp.asarray(rng.rand(2, 3, 8, 8).astype("float32"))
+    wc = jnp.asarray(rng.randint(-127, 127, (4, 3, 3, 3)).astype("int8"))
+    sc = jnp.asarray(np.full((4,), 0.5, np.float32))
+
+    def f_conv(x, w8, sw):
+        return get_op("quantized_conv2d").lower(
+            Ctx(), {"Input": [x], "Filter": [w8], "WScale": [sw]},
+            {"bit_length": 8, "strides": [1, 1], "paddings": [1, 1],
+             "dilations": [1, 1]})["Output"][0]
+
+    shlo, hlo = lowered_text(f_conv, xc, wc, sc)
+    assert re.search(r"convolution.*i8.*i8.*->.*i32", shlo), shlo
+    assert re.search(r"= s32\[[^\]]*\]\S* convolution\(", hlo), hlo
