@@ -31,7 +31,8 @@ def stack_stage_params(params_list):
     )
 
 
-def gpipe(stage_fn, mesh, axis="pp", n_microbatches=None):
+def gpipe(stage_fn, mesh, axis="pp", n_microbatches=None,
+          param_specs=None, batch_axis=None):
     """Build a pipelined apply: fn(stacked_params, x) -> y.
 
     stage_fn(params, x_mb) -> y_mb computes ONE stage on ONE microbatch;
@@ -39,6 +40,15 @@ def gpipe(stage_fn, mesh, axis="pp", n_microbatches=None):
     stages).  stacked_params: pytree with leading stage dim S == mesh
     size along `axis` (see stack_stage_params).  x: [B, ...] global
     batch; B must divide into n_microbatches (default: S).
+
+    Composes with the other mesh axes for 3-axis dp x pp x tp:
+
+    - `param_specs`: optional pytree of PartitionSpecs for the stacked
+      params (leading dim MUST be `axis`); shard the tensor dims over a
+      tp axis and have stage_fn reduce with ``jax.lax.psum(.., tp)``
+      (megatron column/row-parallel inside each pipeline stage).
+    - `batch_axis`: optional mesh axis sharding the batch dim of x/y —
+      each dp slice runs its own fill-drain pipeline.
 
     Returns the full [B, ...] output replicated along `axis` (the last
     stage's result is broadcast back with a psum, one small collective).
@@ -87,17 +97,38 @@ def gpipe(stage_fn, mesh, axis="pp", n_microbatches=None):
             ym = jax.lax.psum(
                 jnp.where(idx == S - 1, ym, jnp.zeros_like(ym)), axis
             )
-            return ym.reshape((M * mb,) + ym.shape[2:])
+            # keep [M, mb_local, ...]: flattening per-shard would permute
+            # the global batch order once batch_axis concatenation applies
+            return ym
 
         from jax import shard_map
 
-        spec_params = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
-        return shard_map(
+        if param_specs is not None:
+            for spec in jax.tree_util.tree_leaves(
+                    param_specs, is_leaf=lambda s: isinstance(s, P)):
+                if not (len(spec) >= 1 and spec[0] == axis):
+                    # without the leading stage-dim shard, per_device's
+                    # p[0] silently computes every stage with stage-0
+                    # weights — fail loudly instead
+                    raise ValueError(
+                        "gpipe param_specs: every leaf spec must shard "
+                        "its leading (stage) dim over %r, got %s"
+                        % (axis, spec))
+        spec_params = (
+            param_specs if param_specs is not None
+            else jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+        )
+        # microbatches are reshaped to [M, mb, ...]: the batch axis (if
+        # any) shards the per-microbatch dim, position 1 — in AND out, so
+        # the global microbatch interleaving survives the concatenation
+        x_spec = P(None, batch_axis) if batch_axis else P()
+        ym = shard_map(
             per_device,
             mesh=mesh,
-            in_specs=(spec_params, P()),
-            out_specs=P(),
+            in_specs=(spec_params, x_spec),
+            out_specs=x_spec,
         )(stacked_params, xm)
+        return ym.reshape((B,) + ym.shape[2:])
 
     return _pipelined
 

@@ -176,6 +176,53 @@ def test_gpipe_pipeline_matches_sequential():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-5)
 
 
+def test_gpipe_three_axis_dp_pp_tp_train_grad_parity():
+    """dp x pp x tp in ONE mesh (VERDICT r4 #5): batch shards over dp,
+    stages over pp, each stage's FFN megatron column/row-parallel over
+    mp — value AND grad parity with the sequential unsharded reference."""
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.parallel import pipeline as pp
+
+    mesh = parallel.make_mesh({"dp": 2, "pp": 2, "mp": 2})
+    Din, Hid = 8, 16
+
+    def stage_tp(p, x):
+        h = jnp.tanh(x @ p["w1"] + p["b1"])  # w1 column-parallel over mp
+        return jax.lax.psum(h @ p["w2"], "mp") + p["b2"]  # w2 row-parallel
+
+    def stage_ref(p, x):
+        return jnp.tanh(x @ p["w1"] + p["b1"]) @ p["w2"] + p["b2"]
+
+    def init(k):
+        k1, k2 = jax.random.split(k)
+        return {"w1": jax.random.normal(k1, (Din, Hid)) / np.sqrt(Din),
+                "b1": jnp.zeros((Hid,)),
+                "w2": jax.random.normal(k2, (Hid, Din)) / np.sqrt(Hid),
+                "b2": jnp.zeros((Din,))}
+
+    stages = [init(k) for k in jax.random.split(jax.random.PRNGKey(5), 2)]
+    stacked = pp.stack_stage_params(stages)
+    specs = {"w1": P("pp", None, "mp"), "b1": P("pp", "mp"),
+             "w2": P("pp", "mp", None), "b2": P("pp", None)}
+    x = jax.random.normal(jax.random.PRNGKey(6), (8, Din))
+    t = jax.random.normal(jax.random.PRNGKey(7), (8, Din))
+    run = pp.gpipe(stage_tp, mesh, "pp", n_microbatches=4,
+                   param_specs=specs, batch_axis="dp")
+
+    lv, g = jax.jit(jax.value_and_grad(
+        lambda sp: jnp.mean((run(sp, x) - t) ** 2)))(stacked)
+    lr, gr = jax.value_and_grad(lambda sp: jnp.mean(
+        (pp.sequential_reference(
+            stage_ref, [jax.tree_util.tree_map(lambda q: q[i], sp)
+                        for i in range(2)], x) - t) ** 2))(stacked)
+    np.testing.assert_allclose(float(lv), float(lr), rtol=2e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(g),
+                    jax.tree_util.tree_leaves(gr)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
+
+
 def test_gpipe_microbatch_count_variants():
     from paddle_tpu.parallel import pipeline as pp
 
