@@ -54,11 +54,22 @@ class ParameterServer:
         self.sync_mode = sync_mode
         self.scope = scope if scope is not None else Scope()
         self.exe = Executor(CPUPlace())
-        # sparse embedding shards: shard name -> (2-D np.ndarray, sgd_lr).
-        # Rows here belong to this server (global row g -> server g%N at
-        # local index g//N); id routing is client-side, we see local ids.
-        self.sparse_tables = dict(sparse_tables or {})
+        # sparse embedding shards: shard name -> dict with "tbl" (2-D
+        # np.ndarray), "lr" (constant fallback), "opt" ({type, attrs,
+        # lr_name, lr_scale}) and lazily-created slot state (moment*,
+        # beta*_pow).  Rows here belong to this server (global row g ->
+        # server g%N at local index g//N); id routing is client-side, we
+        # see local ids.  Legacy (tbl, lr) tuples are normalized.
+        self.sparse_tables = {
+            k: (v if isinstance(v, dict) else {"tbl": v[0], "lr": v[1]})
+            for k, v in dict(sparse_tables or {}).items()
+        }
         self.sparse_lr = sparse_lr  # fallback for tables without own lr
+        # sync mode queues sparse grads and applies them at round time,
+        # AFTER the lr_program run — exactly the reference's
+        # optimizer-sub-block-at-barrier semantics (async applies on
+        # arrival with the current lr)
+        self._pending_sparse = []
 
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
@@ -101,7 +112,12 @@ class ParameterServer:
                 for n in self.scope.local_var_names()
             },
             "sparse": {
-                k: np.array(t) for k, (t, _lr) in self.sparse_tables.items()
+                k: {
+                    kk: (np.array(vv) if isinstance(vv, np.ndarray) else vv)
+                    for kk, vv in info.items()
+                    if kk == "tbl" or kk.startswith(("moment", "beta"))
+                }
+                for k, info in self.sparse_tables.items()
             },
         }
 
@@ -143,9 +159,15 @@ class ParameterServer:
         for n, v in data["vars"].items():
             self.scope.set(n, v)
         for k, v in data["sparse"].items():
-            if k in self.sparse_tables:
-                _t, lr = self.sparse_tables[k]
-                self.sparse_tables[k] = (np.ascontiguousarray(v), lr)
+            if k not in self.sparse_tables:
+                continue
+            info = self.sparse_tables[k]
+            if isinstance(v, dict):  # current format: tbl + slot state
+                for kk, vv in v.items():
+                    info[kk] = (np.ascontiguousarray(vv)
+                                if isinstance(vv, np.ndarray) else vv)
+            else:  # legacy checkpoint: bare table array
+                info["tbl"] = np.ascontiguousarray(v)
         self._round = int(data.get("round", 0))
         return self._round
 
@@ -187,7 +209,9 @@ class ParameterServer:
         self.exe.run(prog, feed=feed, fetch_list=[], scope=self.scope)
 
     def _run_round(self):
-        """All send-barriers in: sum grads, run lr + all shard programs."""
+        """All send-barriers in: sum grads, run lr + all shard programs
+        + the queued sparse updates (after lr, so a scheduled lr is this
+        round's decayed value — the order the local program runs in)."""
         if self.lr_program is not None:
             self.exe.run(self.lr_program, feed={}, fetch_list=[], scope=self.scope)
         for gname, per_trainer in sorted(self._pending.items()):
@@ -195,6 +219,23 @@ class ParameterServer:
             for v in per_trainer.values():
                 total = v if total is None else total + v
             self._apply_shard(self.grad_to_shard[gname], {gname: total})
+        by_table = {}
+        for t, ids, rows in self._pending_sparse:
+            by_table.setdefault(t, []).append((ids, rows))
+        for t, chunks in sorted(by_table.items()):
+            self._apply_sparse(
+                t,
+                np.concatenate([c[0] for c in chunks]),
+                np.concatenate([c[1] for c in chunks], axis=0),
+                advance_pows=False,
+            )
+        self._pending_sparse = []
+        # adam beta pows advance once per ROUND for every adam table —
+        # the local adam op advances them every step even when this
+        # shard received no rows (ops/optimizer_ops.py Beta1PowOut),
+        # so a shard missed by one batch's id hashing must not stall
+        for info in self.sparse_tables.values():
+            self._advance_pows(info)
         self._pending.clear()
         self._send_barriers.clear()
         self._params_ready = True
@@ -261,21 +302,109 @@ class ParameterServer:
     # ---- sparse embedding shards (distributed lookup table) -------------
     def _h_prefetch(self, table, ids, trainer_id=0):
         """Serve embedding rows by local row id (prefetch_op analog)."""
-        tbl, _lr = self.sparse_tables[table]
+        tbl = self.sparse_tables[table]["tbl"]
         ids = np.asarray(ids).reshape(-1)
         ids = np.clip(ids, 0, tbl.shape[0] - 1)
         with self._lock:
             return tbl[ids].copy()
 
+    def _sparse_lr_value(self, info):
+        """Current learning rate for a sparse table: the scheduled lr var
+        from the pserver scope (decayed by lr_program) when named, else
+        the captured constant, else the server-wide fallback.  A
+        SCHEDULED lr (named var, no constant) whose var is missing is an
+        error — silently training at a stale constant is the failure the
+        old NotImplementedError guard existed to prevent."""
+        opt = info.get("opt") or {}
+        name = opt.get("lr_name")
+        if name:
+            var = self.scope.find_var(name)
+            if var is not None:
+                return (float(np.asarray(var).reshape(-1)[0])
+                        * float(opt.get("lr_scale", 1.0)))
+            if info.get("lr") is None:
+                raise RuntimeError(
+                    "sparse table optimizer needs scheduled lr var %r but "
+                    "the pserver scope does not hold it (lr_program split "
+                    "miss?) and no constant fallback was captured" % name)
+        if info.get("lr") is not None:
+            return float(info["lr"])
+        return float(self.sparse_lr)
+
+    def _advance_pows(self, info):
+        """Advance an adam table's beta pows by one step (no-op for
+        non-adam tables or before the first application created them)."""
+        opt = info.get("opt") or {}
+        if opt.get("type") != "adam":
+            return
+        at = opt.get("attrs") or {}
+        b1 = float(at.get("beta1", 0.9))
+        b2 = float(at.get("beta2", 0.999))
+        info["beta1_pow"] = info.get("beta1_pow", b1) * b1
+        info["beta2_pow"] = info.get("beta2_pow", b2) * b2
+
+    def _apply_sparse(self, table, ids, rows, advance_pows=True):
+        """One optimizer application on this shard's touched rows
+        (SelectedRows semantics: duplicates merged first — the moment
+        updates are non-linear in g).  Mirrors the lazy/sparse branches
+        of ops/optimizer_ops.py so a dist run matches the local
+        is_sparse run row for row.  Called under self._lock.
+        advance_pows=False defers the adam beta-pow advance to the
+        caller (sync rounds advance once per round for EVERY table via
+        _advance_pows, even row-less ones)."""
+        info = self.sparse_tables[table]
+        tbl = info["tbl"]
+        opt = info.get("opt") or {}
+        typ = opt.get("type", "sgd")
+        at = opt.get("attrs") or {}
+        ids = np.asarray(ids).reshape(-1)
+        rows = np.asarray(rows, dtype=tbl.dtype).reshape(ids.size, -1)
+        uids, inv = np.unique(ids, return_inverse=True)
+        g = np.zeros((uids.size, tbl.shape[1]), tbl.dtype)
+        np.add.at(g, inv, rows)
+        lr = self._sparse_lr_value(info)
+        if typ == "sgd":
+            tbl[uids] -= lr * g
+        elif typ == "adagrad":
+            eps = float(at.get("epsilon", 1e-6))
+            m = info.setdefault("moment", np.zeros_like(tbl))
+            mn = m[uids] + g * g
+            m[uids] = mn
+            tbl[uids] -= lr * g / (np.sqrt(mn) + eps)
+        elif typ == "adam":
+            b1 = float(at.get("beta1", 0.9))
+            b2 = float(at.get("beta2", 0.999))
+            eps = float(at.get("epsilon", 1e-8))
+            m1 = info.setdefault("moment1", np.zeros_like(tbl))
+            m2 = info.setdefault("moment2", np.zeros_like(tbl))
+            b1p = info.setdefault("beta1_pow", b1)
+            b2p = info.setdefault("beta2_pow", b2)
+            lr_t = lr * np.sqrt(1.0 - b2p) / (1.0 - b1p)
+            m1n = b1 * m1[uids] + (1.0 - b1) * g
+            m2n = b2 * m2[uids] + (1.0 - b2) * g * g
+            m1[uids], m2[uids] = m1n, m2n
+            tbl[uids] -= lr_t * m1n / (np.sqrt(m2n) + eps)
+            if advance_pows:
+                # async mode: global beta pows advance per application
+                # (the lazy adam rule, adam_op.h SelectedRows branch)
+                info["beta1_pow"] = b1p * b1
+                info["beta2_pow"] = b2p * b2
+        else:
+            raise ValueError("unknown sparse optimizer %r" % typ)
+
     def _h_send_sparse(self, table, ids, rows, trainer_id=0):
-        """Sparse SGD update on this server's rows (SelectedRows grad):
-        applied immediately, even in sync mode (reference distributed
-        lookup-table semantics)."""
-        tbl, lr = self.sparse_tables[table]
+        """Sparse optimizer update on this server's rows (SelectedRows
+        grad).  Sync mode queues until the round barrier so the update
+        sees this round's scheduled lr and all trainers' rows merge into
+        ONE application (the reference's optimizer-sub-block-at-barrier
+        semantics); async applies immediately."""
         ids = np.asarray(ids).reshape(-1)
         rows = np.asarray(rows)
         with self._lock:
-            np.subtract.at(tbl, ids, lr * rows)
+            if self.sync_mode:
+                self._pending_sparse.append((table, ids, rows))
+            else:
+                self._apply_sparse(table, ids, rows)
         return {"ok": True}
 
     def _h_checkpoint_notify(self, dir=None, trainer_id=0):
@@ -343,19 +472,23 @@ def run_pserver(program, scope, executor=None):
             raise RuntimeError("pserver startup did not create %s" % name)
 
     # distributed lookup-table shards: slice this server's rows (g%N) out
-    # of the full table the startup program initialized
+    # of the full table the startup program initialized.  Spec row:
+    # [shard, src, server_idx, n_servers, lr] (+ optional opt dict)
     sparse_tables = {}
-    for shard_name, src, server_idx, n_servers, lr in a.get("sparse_tables", []):
+    for spec in a.get("sparse_tables", []):
+        shard_name, src, server_idx, n_servers, lr = spec[:5]
+        opt = spec[5] if len(spec) > 5 else None
         var = scope.find_var(src)
         if var is None:
             raise RuntimeError(
                 "pserver startup did not create lookup table %s" % src
             )
         full = np.array(var)
-        sparse_tables[shard_name] = (
-            np.ascontiguousarray(full[int(server_idx)::int(n_servers)]),
-            float(lr),
-        )
+        sparse_tables[shard_name] = {
+            "tbl": np.ascontiguousarray(full[int(server_idx)::int(n_servers)]),
+            "lr": float(lr) if lr is not None else None,
+            "opt": dict(opt) if opt else {"type": "sgd", "attrs": {}},
+        }
 
     import os as _os
 

@@ -147,7 +147,8 @@ def _prefetch(ctx, ins, attrs):
 @register("send_sparse", no_grad_inputs={"Ids"}, side_effect=True)
 def _send_sparse(ctx, ins, attrs):
     """Push sparse embedding grads (SelectedRows semantics): rows keyed by
-    Ids go back to their owning server for an immediate sparse update."""
+    Ids go back to their owning server — applied at the round barrier in
+    sync mode, immediately in async (see ps_server._h_send_sparse)."""
     ids, grad = ins["Ids"][0], ins["Grad"][0]
     epmap = list(attrs["epmap"])
     table_names = list(attrs["table_names"])

@@ -146,9 +146,13 @@ class DistributeTranspiler:
           * lookup_table{is_distributed} -> `prefetch` (host callback that
             routes ids to their servers and merges rows back),
           * lookup_table_grad            -> `send_sparse` (rows pushed back
-            for an immediate sparse SGD update — reference semantics:
-            sparse updates apply per-send even in sync mode),
-          * the table's dense optimizer op is dropped.
+            to the owning server; in SYNC mode the server queues them and
+            applies ONE merged optimizer update at the round barrier —
+            the reference's optimizer-sub-block-at-barrier semantics —
+            while ASYNC mode applies on arrival),
+          * the table's optimizer op is dropped here and replayed
+            server-side per shard (sgd/adagrad/adam, see
+            ps_server._apply_sparse).
         """
         block = self.origin_program.global_block()
         eps = self.pserver_endpoints
@@ -161,41 +165,77 @@ class DistributeTranspiler:
         if not tables:
             return
 
-        # capture each table's SGD learning rate from its (dropped)
-        # optimizer op + the startup initializer of the lr var
+        # capture each table's (dropped) optimizer op: type + hyperparams
+        # + learning rate.  The pserver replays the same sparse update
+        # rule per shard (the reference runs the full optimizer sub-block
+        # on the pserver, sparse rows included —
+        # distribute_transpiler.py:592 get_pserver_program,
+        # listen_and_serv_op.cc:106).  lr may be a startup constant, a
+        # per-param `scale` of one, or a SCHEDULED var — schedules move to
+        # the pserver's lr_program, so the sparse update reads the decayed
+        # value from the pserver scope at apply time (lr_name).
         startup_fills = {}
         for op in self.startup_program.global_block().ops:
             if op.type == "fill_constant":
                 for o in op.output_arg_names():
                     startup_fills[o] = float(op.attrs.get("value", 0.0))
-        table_lr = {}
+        # per-param-lr helper: scaled-lr var -> (base lr var, factor)
+        scale_map = {}
+        for op in block.ops:
+            if op.type == "scale" and op.attrs.get("op_role") == "optimize":
+                scale_map[op.outputs["Out"][0]] = (
+                    op.inputs["X"][0], float(op.attrs.get("scale", 1.0)))
+        _SPARSE_OPT_DEFAULTS = {
+            "sgd": {},
+            "adagrad": {"epsilon": 1e-6},
+            "adam": {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8},
+        }
+        table_opt = {}
         for op in block.ops:
             rv = op.attrs.get("op_role_var")
             if op.attrs.get("op_role") == "optimize" and rv and rv[0] in tables:
                 if op.type == "scale":
-                    continue  # per-param-lr helper; checked below
-                if op.type != "sgd":
+                    continue  # handled via scale_map
+                if op.type not in _SPARSE_OPT_DEFAULTS:
                     raise NotImplementedError(
                         "distributed lookup table '%s' is optimized by '%s'; "
-                        "the pserver applies sparse SGD on its row shards — "
-                        "use SGD for is_distributed embeddings" % (rv[0], op.type)
+                        "the pserver applies sparse sgd/adagrad/adam on its "
+                        "row shards — use one of those for is_distributed "
+                        "embeddings" % (rv[0], op.type)
                     )
                 lr_names = op.inputs.get("LearningRate", [])
-                lr = startup_fills.get(lr_names[0] if lr_names else "")
-                if lr is None:
-                    raise NotImplementedError(
-                        "distributed lookup table '%s' needs a constant "
-                        "learning rate (schedules / per-param lr scales are "
-                        "not supported on the sparse pserver path)" % rv[0]
-                    )
-                table_lr[rv[0]] = lr
+                lr_name = lr_names[0] if lr_names else None
+                lr_scale = 1.0
+                if lr_name in scale_map:
+                    lr_name, lr_scale = scale_map[lr_name]
+                lr_const = startup_fills.get(lr_name or "")
+                oattrs = {
+                    k: float(op.attrs.get(k, d))
+                    for k, d in _SPARSE_OPT_DEFAULTS[op.type].items()
+                }
+                table_opt[rv[0]] = {
+                    "type": op.type,
+                    "attrs": oattrs,
+                    "lr_name": lr_name,
+                    "lr_scale": lr_scale,
+                    "lr_const": (lr_const * lr_scale
+                                 if lr_const is not None else None),
+                }
 
         for w in tables:
             v = block._find_var_recursive(w)
+            opt = table_opt.get(
+                w, {"type": "sgd", "attrs": {}, "lr_name": None,
+                    "lr_scale": 1.0, "lr_const": 0.01})
+            # lr stays None for a SCHEDULED lr (named var, no startup
+            # constant): the pserver must read the decayed var and is
+            # required to fail loudly if it ever goes missing, never
+            # silently train at a stale constant
             self.sparse_tables[w] = {
                 "shards": ["%s.shard%d" % (w, i) for i in range(n)],
                 "emb_dim": int(v.shape[1]),
-                "lr": table_lr.get(w, 0.01),
+                "lr": opt["lr_const"],
+                "opt": opt,
             }
 
         new_ops = []
@@ -529,11 +569,14 @@ class DistributeTranspiler:
         whole_vars -= lr_produced
 
         # this server's shard of each distributed lookup table:
-        # [shard_var_name, source_table, server_idx, n_servers, sgd_lr]
+        # [shard_var_name, source_table, server_idx, n_servers, lr_const,
+        #  opt_spec] — opt_spec carries the optimizer type/hyperparams
+        # captured from the table's dropped optimizer op
         server_idx = self.pserver_endpoints.index(endpoint)
         n_servers = len(self.pserver_endpoints)
         sparse_specs = [
-            [info["shards"][server_idx], w, server_idx, n_servers, info["lr"]]
+            [info["shards"][server_idx], w, server_idx, n_servers,
+             info["lr"], info.get("opt")]
             for w, info in sorted(getattr(self, "sparse_tables", {}).items())
         ]
 

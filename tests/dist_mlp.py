@@ -32,16 +32,25 @@ SEED = 7
 
 def build_sparse_model(distributed):
     """Distributed-lookup-table model (dist role passes distributed=True;
-    LOCAL runs the plain lookup so parity compares the two paths)."""
+    LOCAL runs the plain lookup so parity compares the two paths).
+    DIST_OPTIMIZER=adam_decay swaps in Adam + exponential lr decay with
+    is_sparse=True, so the LOCAL reference runs the lazy SelectedRows
+    adam branch — the exact rule the pserver replays per shard."""
+    adam = os.environ.get("DIST_OPTIMIZER") == "adam_decay"
     ids = layers.data("ids", shape=[1], dtype="int64")
     y = layers.data("y", shape=[1])
     emb = layers.embedding(
-        ids, size=[20, 8], dtype="float32", is_distributed=distributed
+        ids, size=[20, 8], dtype="float32", is_sparse=adam,
+        is_distributed=distributed
     )
     emb = layers.reshape(emb, [-1, 8])
     pred = layers.fc(emb, size=1)
     loss = layers.mean(layers.square_error_cost(pred, y))
-    fluid.optimizer.SGD(0.1).minimize(loss)
+    if adam:
+        lr = layers.exponential_decay(0.05, decay_steps=2, decay_rate=0.9)
+        fluid.optimizer.Adam(lr).minimize(loss)
+    else:
+        fluid.optimizer.SGD(0.1).minimize(loss)
     return loss
 
 

@@ -153,11 +153,23 @@ def test_dist_async_trains():
 @pytest.mark.slow
 def test_dist_sparse_lookup_table_matches_local():
     """Distributed lookup table: embedding rows sharded over pservers,
-    prefetch forward + immediate sparse SGD backward — 1-trainer run
-    matches the local plain-embedding run exactly."""
+    prefetch forward + sparse SGD backward at the round barrier —
+    1-trainer run matches the local plain-embedding run exactly."""
     env = {"DIST_MODEL": "sparse"}
     local = _local_losses(steps=5, extra_env=env)
     (dist,) = _run_cluster(1, sync=True, steps=5, extra_env=env)
+    np.testing.assert_allclose(dist, local, rtol=2e-4, atol=1e-5)
+
+
+@pytest.mark.slow
+def test_dist_sparse_lookup_adam_decay_matches_local():
+    """VERDICT r4 #6: the sparse pserver path beyond SGD — the table's
+    ADAM slot state (moments + beta pows) lives per shard on the
+    pserver, the lr comes DECAYED from the pserver's lr_program, and the
+    dist run matches the local lazy-adam (is_sparse) run exactly."""
+    env = {"DIST_MODEL": "sparse", "DIST_OPTIMIZER": "adam_decay"}
+    local = _local_losses(steps=6, extra_env=env)
+    (dist,) = _run_cluster(1, sync=True, steps=6, extra_env=env)
     np.testing.assert_allclose(dist, local, rtol=2e-4, atol=1e-5)
 
 

@@ -342,3 +342,31 @@ def test_wire_decoder_fuzz_never_crashes():
             _Reader(buf).decode()
         except (ValueError, UnicodeDecodeError, OverflowError):
             pass
+
+
+def test_pserver_adam_beta_pows_advance_on_rowless_rounds():
+    """Code-review r5: a sync round in which a shard receives NO rows for
+    an adam table must still advance that table's beta pows — the local
+    adam op advances them every step regardless of touched rows, and a
+    shard missed by one batch's id hashing must not fall out of parity."""
+    import numpy as np
+
+    from paddle_tpu.distributed.ps_server import ParameterServer
+
+    ps = ParameterServer(
+        {}, {}, num_trainers=1, sync_mode=True,
+        sparse_tables={"t.shard0": {
+            "tbl": np.zeros((4, 2), np.float32), "lr": 0.1,
+            "opt": {"type": "adam",
+                    "attrs": {"beta1": 0.9, "beta2": 0.999}},
+        }})
+    ps._h_send_sparse("t.shard0", np.array([1]), np.ones((1, 2), np.float32))
+    with ps._cv:
+        ps._run_round()  # round with rows
+    info = ps.sparse_tables["t.shard0"]
+    b1p_1, b2p_1 = info["beta1_pow"], info["beta2_pow"]
+    assert abs(b1p_1 - 0.9 ** 2) < 1e-12  # used 0.9, then advanced
+    with ps._cv:
+        ps._run_round()  # ROWLESS round: pows must still advance
+    assert abs(info["beta1_pow"] - b1p_1 * 0.9) < 1e-12
+    assert abs(info["beta2_pow"] - b2p_1 * 0.999) < 1e-12
