@@ -325,7 +325,7 @@ def _bench_impl():
     if os.environ.get("BENCH_MODELS", "0") == "1":
         result["models"] = {}
         for name in ("vgg16", "se_resnext50", "stacked_lstm", "bert_base",
-                     "deepfm"):
+                     "deepfm", "gpt2_345m"):
             try:
                 result["models"][name] = _model_bench(name, on_tpu, device)
             except Exception as e:
@@ -402,6 +402,28 @@ def _model_bench(name, on_tpu, device):
             feed_np = bert.make_fake_bert_batch(bs, seq, HP, seed=0)
             unit, per_step = "examples/sec", bs
             main, startup, loss = main_b, startup_b, fetches_b[0]
+        elif name == "gpt2_345m":
+            # BASELINE config 5: GPT-2 345M causal-LM train, single chip
+            # (the TP+DP step is measured separately on the virtual mesh
+            # by the gpt2_tp dist leg — one real chip here)
+            from paddle_tpu.models import gpt2
+
+            bs = int(os.environ.get("BENCH_MODEL_BATCH", 8 if on_tpu else 2))
+            seq = int(os.environ.get("BENCH_GPT2_SEQ", 512 if on_tpu else 16))
+
+            class HP(gpt2.GPT2Config):
+                # the 345M shape (gpt2-medium): d_model=1024 x 24 layers
+                d_model = 1024 if on_tpu else 64
+                n_layer = 24 if on_tpu else 2
+                n_head = 16 if on_tpu else 2
+                n_ctx = max(1024, seq)
+                vocab_size = 50257 if on_tpu else 500
+
+            main_g, startup_g, _feeds, fetches_g = gpt2.gpt2_lm_program(
+                HP, seq_len=seq, use_bf16=on_tpu)
+            feed_np = gpt2.make_fake_lm_batch(bs, seq, HP, seed=0)
+            unit, per_step = "examples/sec", bs
+            main, startup, loss = main_g, startup_g, fetches_g[0]
         elif name == "deepfm":
             # BASELINE config 4: DeepFM CTR, sparse embeddings
             from paddle_tpu.models.ctr_deepfm import build_deepfm_train
@@ -609,6 +631,42 @@ def _dist_smokes():
                              "unit": "steps/sec (localhost cpu)"}
         except subprocess.TimeoutExpired:
             out[name] = {"error": "timeout"}
+    # BASELINE config 5 dist leg: GPT-2 TP+DP step over the 8-device
+    # virtual mesh (one process; a step-time artifact, not a scaling claim)
+    env_tp = dict(env)
+    flags = [f for f in env_tp.get("XLA_FLAGS", "").split()
+             if not f.startswith("--xla_force_host_platform_device_count")]
+    flags.append("--xla_force_host_platform_device_count=8")
+    env_tp["XLA_FLAGS"] = " ".join(flags)
+    try:
+        proc = subprocess.run(
+            [sys.executable, "scripts/gpt2_tp_step.py"], cwd=here,
+            env=env_tp, timeout=600,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        lines = proc.stdout.decode("utf-8", "replace").strip().splitlines()
+        # stderr is merged in: scan backwards for the JSON line instead
+        # of trusting the tail (a trailing warning must not kill the run)
+        parsed = None
+        if proc.returncode == 0:
+            for ln in reversed(lines):
+                if not ln.strip().startswith("{"):
+                    continue  # bare numbers / NaN also parse as JSON
+                try:
+                    cand = json.loads(ln)
+                except ValueError:
+                    continue
+                if isinstance(cand, dict):
+                    parsed = cand
+                    break
+        if parsed is not None:
+            out["gpt2_tp_dp2xmp4"] = parsed
+        else:
+            out["gpt2_tp_dp2xmp4"] = {"error": "rc=%d: %s" % (
+                proc.returncode,
+                proc.stdout[-300:].decode("utf-8", "replace"))}
+    except subprocess.TimeoutExpired:
+        out["gpt2_tp_dp2xmp4"] = {"error": "timeout"}
     return out
 
 
