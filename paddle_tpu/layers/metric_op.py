@@ -23,53 +23,63 @@ def accuracy(input, label, k=1, correct=None, total=None):
     return acc_out
 
 
-def auc(input, label, curve="ROC", num_thresholds=200, topk=1, slide_steps=1):
-    """Streaming in-graph AUC (metric_op.py auc / auc_op.cc): threshold
-    buckets accumulate in persistable stat tensors threaded through the
-    functionalized scope state; returns (auc_out, [stat_pos, stat_neg])
-    like the reference.  curve is ROC or PR; the reference's topk>1 and
-    sliding-window modes are not supported (explicit error, never a
-    silently-different metric)."""
+def auc(input, label, curve="ROC", num_thresholds=2 ** 12 - 1, topk=1,
+        slide_steps=1):
+    """Streaming in-graph AUC (metric_op.py:81 auc / auc_op.cc): two auc
+    ops share the batch histogram work — a GLOBAL accumulator
+    (slide_steps=0) and a sliding-window BATCH accumulator over the last
+    `slide_steps` batches (auc_op.h statAuc shift register).  Returns
+    (auc_out, batch_auc_out, [batch_stat_pos, batch_stat_neg, stat_pos,
+    stat_neg]) exactly like the reference.  `topk` is accepted for
+    signature parity and unused — the reference layer never reads it
+    either (metric_op.py:126)."""
     from ..initializer import Constant
     from .. import unique_name
 
-    if topk != 1:
-        raise NotImplementedError("auc: only topk=1 is supported")
-    if slide_steps not in (0, 1):
-        raise NotImplementedError(
-            "auc: sliding-window accumulation (slide_steps=%r) is not "
-            "supported; use slide_steps=0/1 for global accumulation" % slide_steps
-        )
+    # slide_steps=0 means the batch accumulator ALSO accumulates over all
+    # batches (reference semantics: batch_auc == global auc then)
+    slide_steps = max(0, int(slide_steps))
     helper = LayerHelper("auc")
-    stat_pos = helper.create_global_variable(
-        persistable=True,
-        name=unique_name.generate("auc_stat_pos"),
-        shape=[num_thresholds + 1],
-        dtype="float32",
-    )
-    stat_neg = helper.create_global_variable(
-        persistable=True,
-        name=unique_name.generate("auc_stat_neg"),
-        shape=[num_thresholds + 1],
-        dtype="float32",
-    )
-    for v in (stat_pos, stat_neg):
+
+    def _stat(name, shape):
+        v = helper.create_global_variable(
+            persistable=True,
+            name=unique_name.generate(name),
+            shape=shape,
+            dtype="float32",
+        )
         helper.set_variable_initializer(v, Constant(0.0))
-    auc_out = helper.create_variable_for_type_inference("float32")
-    helper.append_op(
-        "auc",
-        inputs={
-            "Predict": [input],
-            "Label": [label],
-            "StatPos": [stat_pos],
-            "StatNeg": [stat_neg],
-        },
-        outputs={
-            "AUC": [auc_out],
-            "StatPosOut": [stat_pos],
-            "StatNegOut": [stat_neg],
-        },
-        attrs={"num_thresholds": num_thresholds, "curve": curve},
-    )
-    auc_out.stop_gradient = True
-    return auc_out, [stat_pos, stat_neg]
+        return v
+
+    nb = num_thresholds + 1
+    batch_stat_pos = _stat("auc_batch_stat_pos", [max(1, slide_steps), nb])
+    batch_stat_neg = _stat("auc_batch_stat_neg", [max(1, slide_steps), nb])
+    stat_pos = _stat("auc_stat_pos", [1, nb])
+    stat_neg = _stat("auc_stat_neg", [1, nb])
+
+    def _auc_op(sp, sn, steps):
+        out = helper.create_variable_for_type_inference("float32")
+        helper.append_op(
+            "auc",
+            inputs={
+                "Predict": [input],
+                "Label": [label],
+                "StatPos": [sp],
+                "StatNeg": [sn],
+            },
+            outputs={
+                "AUC": [out],
+                "StatPosOut": [sp],
+                "StatNegOut": [sn],
+            },
+            attrs={"num_thresholds": num_thresholds, "curve": curve,
+                   "slide_steps": steps},
+        )
+        out.stop_gradient = True
+        return out
+
+    batch_auc_out = _auc_op(batch_stat_pos, batch_stat_neg, slide_steps)
+    auc_out = _auc_op(stat_pos, stat_neg, 0)
+    return auc_out, batch_auc_out, [
+        batch_stat_pos, batch_stat_neg, stat_pos, stat_neg
+    ]

@@ -16,10 +16,14 @@ from ..core.registry import register
 @register("auc", no_grad_inputs=("Predict", "Label", "StatPos", "StatNeg"))
 def _auc(ctx, ins, attrs):
     """Streaming AUC over threshold buckets (auc_op.cc): histogram positive
-    and negative scores into num_thresholds buckets, trapezoid-integrate."""
+    and negative scores into num_thresholds buckets, trapezoid-integrate.
+    slide_steps=0 accumulates globally; slide_steps=S keeps a shift
+    register of the last S batch histograms (auc_op.h statAuc) and the
+    AUC is computed from the window sum — the reference's batch AUC."""
     predict = ins["Predict"][0]
     label = ins["Label"][0].reshape(-1)
     num_thresholds = attrs.get("num_thresholds", 4095)
+    slide_steps = int(attrs.get("slide_steps", 0))
     curve = str(attrs.get("curve", "ROC")).upper()
     if curve not in ("ROC", "PR"):
         raise ValueError("auc: unsupported curve %r (ROC or PR)" % curve)
@@ -29,14 +33,26 @@ def _auc(ctx, ins, attrs):
             "got %s" % (predict.shape,)
         )
     pos_score = predict[:, 1] if predict.ndim == 2 and predict.shape[1] == 2 else predict.reshape(-1)
-    stat_pos = ins["StatPos"][0].reshape(-1)
-    stat_neg = ins["StatNeg"][0].reshape(-1)
+    stat_pos = ins["StatPos"][0]
+    stat_neg = ins["StatNeg"][0]
     bucket = jnp.clip(
         (pos_score * num_thresholds).astype(jnp.int32), 0, num_thresholds
     )
     is_pos = (label > 0).astype(stat_pos.dtype)
-    new_pos = stat_pos.at[bucket].add(is_pos)
-    new_neg = stat_neg.at[bucket].add(1.0 - is_pos)
+    nb = num_thresholds + 1
+    cur_pos = jnp.zeros((nb,), stat_pos.dtype).at[bucket].add(is_pos)
+    cur_neg = jnp.zeros((nb,), stat_neg.dtype).at[bucket].add(1.0 - is_pos)
+    if slide_steps > 0:
+        # [S, nb] shift register: drop the oldest row, append this batch
+        sp = stat_pos.reshape(slide_steps, nb)
+        sn = stat_neg.reshape(slide_steps, nb)
+        new_pos_state = jnp.concatenate([sp[1:], cur_pos[None]], axis=0)
+        new_neg_state = jnp.concatenate([sn[1:], cur_neg[None]], axis=0)
+        new_pos = jnp.sum(new_pos_state, axis=0)
+        new_neg = jnp.sum(new_neg_state, axis=0)
+    else:
+        new_pos_state = new_pos = stat_pos.reshape(-1) + cur_pos
+        new_neg_state = new_neg = stat_neg.reshape(-1) + cur_neg
     # trapezoid integration over buckets in descending-threshold order
     pos_flip = jnp.flip(new_pos)
     neg_flip = jnp.flip(new_neg)
@@ -63,8 +79,8 @@ def _auc(ctx, ins, attrs):
         )
     return {
         "AUC": [auc],
-        "StatPosOut": [new_pos],
-        "StatNegOut": [new_neg],
+        "StatPosOut": [new_pos_state.reshape(ins["StatPos"][0].shape)],
+        "StatNegOut": [new_neg_state.reshape(ins["StatNeg"][0].shape)],
     }
 
 

@@ -384,13 +384,14 @@ def test_restrictions_appendix_is_synced():
                     "interpolate", "distributed lookup table"):
         assert surface in doc, surface
 
-    # the documented guards raise loudly
+    # layers.auc reached full parity in r5: the reference 3-tuple return,
+    # topk accepted-and-unused (the reference layer never reads it), and
+    # slide_steps>1 builds the [S, nb] sliding-window stat register
     pred = layers.data("rx_pred", shape=[2])
     lbl = layers.data("rx_lbl", shape=[1], dtype="int64")
-    with pytest.raises(NotImplementedError, match="topk"):
-        layers.auc(pred, lbl, topk=2)
-    with pytest.raises(NotImplementedError, match="slide"):
-        layers.auc(pred, lbl, slide_steps=5)
+    a_out, b_out, stats = layers.auc(pred, lbl, topk=2, slide_steps=5)
+    assert len(stats) == 4
+    assert tuple(stats[0].shape) == (5, 2 ** 12)  # [slide_steps, nb]
     # lowering-time guards surface wrapped in the enforce-style trace
     # context error (a RuntimeError naming the op and shapes)
     with pytest.raises(RuntimeError, match="functor_list"):

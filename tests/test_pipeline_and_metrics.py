@@ -101,7 +101,8 @@ def test_auc_layer_streams_batches():
     with fluid.program_guard(main, startup):
         pred = layers.data("pred", shape=[2], dtype="float32")
         label = layers.data("label", shape=[1], dtype="int64")
-        auc_out, _states = layers.auc(pred, label, num_thresholds=1000)
+        auc_out, batch_auc_out, _states = layers.auc(
+            pred, label, num_thresholds=1000, slide_steps=2)
 
     exe = fluid.Executor()
     exe.run(startup)
@@ -113,11 +114,32 @@ def test_auc_layer_streams_batches():
         l = rng.randint(0, 2, (8, 1)).astype("int64")
         all_p.append(p)
         all_l.append(l)
-        (got,) = exe.run(main, feed={"pred": p, "label": l},
-                         fetch_list=[auc_out])
+        got, got_batch = exe.run(main, feed={"pred": p, "label": l},
+                                 fetch_list=[auc_out, batch_auc_out])
     ref = metrics.Auc(num_thresholds=1000)
     ref.update(np.concatenate(all_p), np.concatenate(all_l).reshape(-1))
     assert abs(float(got) - ref.eval()) < 5e-2
+    # batch AUC with slide_steps=2 covers only the LAST TWO batches
+    ref2 = metrics.Auc(num_thresholds=1000)
+    ref2.update(np.concatenate(all_p[1:]), np.concatenate(all_l[1:]).reshape(-1))
+    assert abs(float(got_batch) - ref2.eval()) < 5e-2
+
+    # slide_steps=0: the batch accumulator ALSO runs global (reference
+    # semantics — batch_auc == global auc every step)
+    main0 = fluid.Program()
+    startup0 = fluid.Program()
+    with fluid.program_guard(main0, startup0):
+        pred0 = layers.data("pred0", shape=[2], dtype="float32")
+        label0 = layers.data("label0", shape=[1], dtype="int64")
+        g0, b0, _ = layers.auc(pred0, label0, num_thresholds=1000,
+                               slide_steps=0)
+    exe0 = fluid.Executor()
+    exe0.run(startup0)
+    for p, l in zip(all_p, all_l):
+        gg, bb = exe0.run(main0, feed={"pred0": p, "label0": l},
+                          fetch_list=[g0, b0])
+        np.testing.assert_allclose(np.asarray(gg), np.asarray(bb),
+                                   rtol=1e-6)
 
 
 def test_profiler_records(tmp_path):
