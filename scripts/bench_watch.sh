@@ -1,49 +1,58 @@
 #!/bin/bash
-# TPU tunnel watcher (round 4): probe every 8 min; on recovery capture
-# in order: (1) default full bench -> BENCH_R04_TPU.json, (2) pallas-
-# flash transformer A/B, (3) profiled run + top-ops dump, (4) reader-
-# overlap resnet, (5) bs256 resnet, (6) NHWC conv-layout micro-trial.
+# TPU tunnel watcher (round 5): probe every 8 min; on recovery capture,
+# in value order:
+#   (1) default full bench          -> BENCH_R05_TPU.json
+#   (2) flash transformer A/B       (FLAGS_use_pallas=1)
+#   (3) transformer BENCH_INNER=10  (dispatch-tax split)
+#   (4) profiled run + xprof top-25
+#   (5) model matrix (BENCH_MODELS: vgg/se_resnext/lstm/bert/deepfm/gpt2-345M)
+#   (6) NHWC full-model A/B
+#   (7) bs256 resnet
+#   (8) reader-overlap resnet
+#   (9) serving f32/bf16/int8       (BENCH_INFER)
+#  (10) decode cached-vs-reencode   (BENCH_DECODE)
+#  (11) rbg PRNG transformer A/B
+#  (12) long-context flash 8k/16k/32k + dense OOM point
 # The probe reuses bench.py's group-killable probe child (_BENCH_PROBE=1)
 # under timeout(1) so a wedged tunnel costs 120s per attempt and never
-# leaves a child holding the chip.  Writes /tmp/r04_capture_done when
-# the whole sequence finished so follow-up sweeps know to start.
+# leaves a child holding the chip.  Every leg is timeout-bounded.  Writes
+# /tmp/r05_capture_done when the sequence finishes.
 cd "$(dirname "$0")/.."
-rm -f /tmp/r04_capture_done  # a restarted watcher must not expose a stale marker
-for i in $(seq 1 85); do
+rm -f /tmp/r05_capture_done  # a restarted watcher must not expose a stale marker
+LOG=/tmp/tpu_watch.log
+leg() {  # leg <name> <outfile> <timeout_s> env... -- handles logging
+  local name="$1" out="$2" to="$3"; shift 3
+  timeout -k 15 "$to" env "$@" python bench.py > "$out" 2>> "$LOG"
+  local rc=$?  # capture BEFORE the $(date) substitution resets $?
+  echo "$(date -u +%H:%M) $name done (rc=$rc)" >> "$LOG"
+}
+for i in $(seq 1 88); do
   if env _BENCH_PROBE=1 timeout -k 10 120 python bench.py 2>/dev/null | grep -q PROBE_DEVICES; then
-    echo "$(date -u +%H:%M) tunnel alive - capturing" >> /tmp/tpu_watch.log
-    python bench.py > /tmp/bench_full_new.out 2>> /tmp/tpu_watch.log
+    echo "$(date -u +%H:%M) tunnel alive - r05 capture starting" >> "$LOG"
+    timeout -k 15 2400 python bench.py > /tmp/bench_full_new.out 2>> "$LOG"
     if grep -q '"mfu"' /tmp/bench_full_new.out; then
-      cp /tmp/bench_full_new.out BENCH_R04_TPU.json
-      echo "$(date -u +%H:%M) BENCH_R04_TPU.json updated" >> /tmp/tpu_watch.log
+      cp /tmp/bench_full_new.out BENCH_R05_TPU.json
+      echo "$(date -u +%H:%M) BENCH_R05_TPU.json updated" >> "$LOG"
     fi
-    env BENCH_ONLY=transformer FLAGS_use_pallas=1 python bench.py \
-      > /tmp/r04_tfm_flash.out 2>> /tmp/tpu_watch.log
-    echo "$(date -u +%H:%M) flash A/B done" >> /tmp/tpu_watch.log
-    env BENCH_PROFILE=/tmp/xprof_tpu python bench.py \
-      > /tmp/r04_profiled.out 2>> /tmp/tpu_watch.log
+    leg "flash A/B"    /tmp/r05_tfm_flash.out 1800 BENCH_ONLY=transformer FLAGS_use_pallas=1
+    leg "inner loop"   /tmp/r05_tfm_inner.out 1800 BENCH_ONLY=transformer BENCH_INNER=10
+    leg "profiled"     /tmp/r05_profiled.out  2400 BENCH_PROFILE=/tmp/xprof_tpu
     env PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python \
       python tools/xprof_top.py /tmp/xprof_tpu -n 25 \
-      > /tmp/r04_xprof_top.out 2>&1
-    echo "$(date -u +%H:%M) profiled capture done" >> /tmp/tpu_watch.log
-    env BENCH_READER=1 python bench.py > /tmp/r04_reader.out 2>> /tmp/tpu_watch.log
-    echo "$(date -u +%H:%M) reader leg done" >> /tmp/tpu_watch.log
-    env BENCH_BATCH=256 python bench.py > /tmp/r04_bs256.out 2>> /tmp/tpu_watch.log
-    echo "$(date -u +%H:%M) bs256 leg done" >> /tmp/tpu_watch.log
-    env BENCH_LAYOUT=NHWC BENCH_TRANSFORMER=0 python bench.py \
-      > /tmp/r04_nhwc_model.out 2>> /tmp/tpu_watch.log
-    echo "$(date -u +%H:%M) full-model NHWC leg done" >> /tmp/tpu_watch.log
-    env FLAGS_prng_impl=rbg BENCH_ONLY=transformer python bench.py \
-      > /tmp/r04_tfm_rbg.out 2>> /tmp/tpu_watch.log
-    echo "$(date -u +%H:%M) rbg prng leg done" >> /tmp/tpu_watch.log
-    env BENCH_INFER=1 BENCH_TRANSFORMER=0 python bench.py \
-      > /tmp/r04_infer.out 2>> /tmp/tpu_watch.log
-    echo "$(date -u +%H:%M) serving (f32/bf16/int8) leg done" >> /tmp/tpu_watch.log
-    timeout -k 10 900 python scripts/nhwc_trial.py > /tmp/r04_nhwc.out 2>&1
-    echo "$(date -u +%H:%M) nhwc trial done - watcher exiting" >> /tmp/tpu_watch.log
-    touch /tmp/r04_capture_done
+      > /tmp/r05_xprof_top.out 2>&1
+    echo "$(date -u +%H:%M) xprof top-25 done" >> "$LOG"
+    leg "model matrix" /tmp/r05_models.out    3600 BENCH_MODELS=1 BENCH_TRANSFORMER=0
+    leg "NHWC model"   /tmp/r05_nhwc.out      1800 BENCH_LAYOUT=NHWC BENCH_TRANSFORMER=0
+    leg "bs256"        /tmp/r05_bs256.out     1800 BENCH_BATCH=256 BENCH_TRANSFORMER=0
+    leg "reader"       /tmp/r05_reader.out    1800 BENCH_READER=1 BENCH_TRANSFORMER=0
+    leg "serving"      /tmp/r05_infer.out     2400 BENCH_INFER=1 BENCH_TRANSFORMER=0
+    leg "decode"       /tmp/r05_decode.out    2400 BENCH_DECODE=1 BENCH_TRANSFORMER=0
+    leg "rbg prng"     /tmp/r05_tfm_rbg.out   1800 BENCH_ONLY=transformer FLAGS_prng_impl=rbg
+    timeout -k 15 2400 python scripts/longctx_bench.py > /tmp/r05_longctx.out 2>&1
+    echo "$(date -u +%H:%M) long-context leg done - watcher exiting" >> "$LOG"
+    touch /tmp/r05_capture_done
     exit 0
   fi
-  echo "$(date -u +%H:%M) probe $i failed" >> /tmp/tpu_watch.log
+  echo "$(date -u +%H:%M) probe $i failed" >> "$LOG"
   sleep 480
 done
