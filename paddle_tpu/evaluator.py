@@ -7,13 +7,17 @@ fluid.metrics — both are provided here (metrics.py has the pure-python
 accumulators; these are the program-integrated versions).
 """
 
+import itertools
+import os
+import weakref
+
 import numpy as np
 
 from . import framework, unique_name
 from .framework import Variable
 from .layer_helper import LayerHelper
 
-__all__ = ["ChunkEvaluator", "EditDistance"]
+__all__ = ["ChunkEvaluator", "EditDistance", "DetectionMAP"]
 
 
 class Evaluator:
@@ -194,3 +198,71 @@ class EditDistance(Evaluator):
         err = float(np.asarray(scope.get(self.instance_error.name)).reshape(-1)[0])
         avg = total / n if n else 0.0
         return np.array([avg], "float32"), np.array([err / n if n else 0.0], "float32")
+
+
+_detmap_instance_counter = itertools.count()
+
+
+class DetectionMAP(Evaluator):
+    """Streaming detection mAP (evaluator.py:298 DetectionMAP parity).
+
+    Appends two detection_map ops to the current main program: one
+    computing the CURRENT batch's mAP and one computing the ACCUMULATED
+    mAP over every batch since the last reset().  The reference threads
+    Accum{PosCount,TruePos,FalsePos} LoD state tensors through the op;
+    detection eval state is ragged per-class score lists, so here the
+    accumulating op owns a persistent host-side accumulator behind its
+    `accum_key` (sequenced with io_callback(ordered=True) — see
+    ops/compat_ops.py).  Fetch BOTH metrics each run (the accumulated
+    map is updated by running its op).
+
+    input: [N, 6] detections (label, score, x1, y1, x2, y2; label < 0 =
+    padding); gt_label [G, 1], gt_box [G, 4], optional gt_difficult
+    [G, 1] (the reference's concat layout is rebuilt internally).
+    class_num / background_label are accepted for signature parity —
+    the host evaluator derives classes from the data and detections
+    never carry the background label (multiclass_nms strips it).
+    """
+
+    def __init__(self, input, gt_label, gt_box, gt_difficult=None,
+                 class_num=None, background_label=0,
+                 overlap_threshold=0.5, evaluate_difficult=True,
+                 ap_version="integral"):
+        super().__init__("map_eval")
+        from .layers import detection as _det
+        from .layers import tensor as _tensor
+
+        parts = ([gt_label, gt_difficult, gt_box]
+                 if gt_difficult is not None else [gt_label, gt_box])
+        parts = [_tensor.cast(p, "float32") for p in parts]
+        label = _tensor.concat(parts, axis=1)
+        self.cur_map = _det.detection_map(
+            input, label, overlap_threshold,
+            ap_version=ap_version, evaluate_difficult=evaluate_difficult)
+        # key must be guard-INDEPENDENT: unique_name.guard() resets its
+        # counters, so two evaluators built in separate guard scopes
+        # would otherwise share (and cross-contaminate) one accumulator
+        self._accum_key = "detmap_accum_%d_%d" % (
+            os.getpid(), next(_detmap_instance_counter))
+        self.accum_map = _det.detection_map(
+            input, label, overlap_threshold,
+            ap_version=ap_version, evaluate_difficult=evaluate_difficult,
+            accum_key=self._accum_key)
+        self.metrics = [self.cur_map, self.accum_map]
+        # free the host accumulator (full per-detection score lists) when
+        # the evaluator itself is collected — rebuilt-per-epoch
+        # evaluators must not leak every past epoch's stream
+        from .ops.compat_ops import reset_detection_map_accum
+
+        self._finalizer = weakref.finalize(
+            self, reset_detection_map_accum, self._accum_key)
+
+    def get_map_var(self):
+        """Reference API: returns (cur_map, accum_map) variables."""
+        return self.cur_map, self.accum_map
+
+    def reset(self, executor=None, reset_program=None):
+        """Clear the streaming accumulator (host state — no program)."""
+        from .ops.compat_ops import reset_detection_map_accum
+
+        reset_detection_map_accum(self._accum_key)

@@ -622,16 +622,33 @@ def polygon_box_transform(input, name=None):
     return out
 
 
-def detection_map(detect_res, label, overlap_threshold=0.5, name=None):
-    """detection_map_op.cc: single-batch mAP (host-callback evaluator).
-    detect_res: [N, 6] (label, score, box); label: [G, 5] (label, box)."""
+def detection_map(detect_res, label, overlap_threshold=0.5, name=None,
+                  ap_version="integral", evaluate_difficult=True,
+                  accum_key=None):
+    """detection_map_op.cc: mAP (host-callback evaluator).
+    detect_res: [N, 6] (label, score, box); label: [G, 5] (label, box)
+    or [G, 6] (label, difficult, box).  accum_key (evaluator.DetectionMAP
+    plumbing): names a persistent host accumulator — the op then returns
+    the STREAMING mAP over every batch fed since the last reset."""
     helper = LayerHelper("detection_map", name=name)
     out = helper.create_variable_for_type_inference("float32")
+    attrs = {
+        "overlap_threshold": float(overlap_threshold),
+        "ap_version": str(ap_version),
+        "evaluate_difficult": bool(evaluate_difficult),
+    }
+    op_type = "detection_map"
+    if accum_key:
+        # the streaming variant is a SIDE-EFFECTING op type: dead-op
+        # pruning must never drop an unfetched accumulation and the
+        # profiler must never warm-rerun (double-feed) one
+        attrs["accum_key"] = str(accum_key)
+        op_type = "detection_map_accum"
     helper.append_op(
-        "detection_map",
+        op_type,
         inputs={"DetectRes": [detect_res], "Label": [label]},
         outputs={"MAP": [out]},
-        attrs={"overlap_threshold": float(overlap_threshold)},
+        attrs=attrs,
     )
     return out
 

@@ -232,13 +232,22 @@ class DetectionMAP(MetricBase):
         ua = (ax2 - ax1) * (ay2 - ay1) + (bx2 - bx1) * (by2 - by1) - inter
         return inter / ua if ua > 0 else 0.0
 
-    def update(self, detections, gt_boxes, gt_labels, gt_count=None):
+    def update(self, detections, gt_boxes, gt_labels, gt_count=None,
+               difficult=None):
+        """difficult: optional [G] 0/1 flags — VOC convention: difficult
+        ground truths are excluded from the positive count and a
+        detection matched to one is neither TP nor FP.  Ground-truth
+        rows with label < 0 are padding and skipped."""
         detections = np.asarray(detections)
         gt_boxes = np.asarray(gt_boxes)
         gt_labels = np.asarray(gt_labels).reshape(-1)
         n_gt = int(gt_count) if gt_count is not None else gt_boxes.shape[0]
+        diff = (np.asarray(difficult).reshape(-1).astype(bool)
+                if difficult is not None else np.zeros(n_gt, bool))
         for g in range(n_gt):
             c = int(gt_labels[g])
+            if c < 0 or diff[g]:
+                continue
             self._npos[c] = self._npos.get(c, 0) + 1
         used = np.zeros(n_gt, bool)
         dets = detections[detections[:, 0] >= 0]
@@ -254,6 +263,9 @@ class DetectionMAP(MetricBase):
                     best, best_g = ov, g
             tp = best >= self.overlap_threshold and best_g >= 0
             if tp:
+                if diff[best_g]:
+                    # matched a difficult gt: ignore this detection
+                    continue
                 used[best_g] = True
             self._dets.setdefault(c, []).append((float(d[1]), bool(tp)))
 

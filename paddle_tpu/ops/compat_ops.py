@@ -406,24 +406,98 @@ def _merge_ids(ctx, ins, attrs):
     return {"Out": [out]}
 
 
+# streaming detection-mAP accumulators (evaluator.DetectionMAP state):
+# detection eval state is ragged per-class score lists — host state is
+# the TPU-native seam, matching the op's host-callback design
+_DETMAP_ACCUMS = {}
+
+
+def reset_detection_map_accum(key):
+    """Clear the streaming accumulator behind an `accum_key` detection_map
+    op (evaluator.DetectionMAP.reset)."""
+    _DETMAP_ACCUMS.pop(key, None)
+
+
+def _detmap_feed(m, det_np, gt_np, evaluate_difficult):
+    """One batch into a metrics.DetectionMAP: gt rows are [label, box]
+    (width 5) or [label, difficult, box] (width 6, the reference's
+    concat(gt_label, gt_difficult, gt_box) layout)."""
+    gt_np = np.asarray(gt_np)
+    if gt_np.ndim == 2 and gt_np.shape[1] == 6:
+        labels, diff, boxes = gt_np[:, 0], gt_np[:, 1], gt_np[:, 2:6]
+    else:
+        labels, diff, boxes = gt_np[:, 0], None, gt_np[:, 1:5]
+    m.update(np.asarray(det_np), boxes, labels,
+             difficult=None if evaluate_difficult else diff)
+    return np.float32(m.eval())
+
+
 @register("detection_map", no_grad_inputs=("DetectRes", "Label"))
 def _detection_map(ctx, ins, attrs):
-    """detection/detection_map_op.cc: single-batch mAP via a host
-    callback onto the same numpy evaluator that backs metrics.DetectionMAP
-    (sorting/greedy matching is host work, not MXU work)."""
+    """detection/detection_map_op.cc: mAP via a host callback onto the
+    same numpy evaluator that backs metrics.DetectionMAP (sorting/greedy
+    matching is host work, not MXU work).  Without `accum_key`: the
+    single-batch mAP (pure).  With `accum_key`: the STREAMING mAP — the
+    callback owns a persistent accumulator under that key (the
+    reference's Accum* state tensors re-homed host-side), sequenced with
+    io_callback(ordered=True) so XLA can neither elide nor reorder the
+    state update."""
     det = ins["DetectRes"][0]  # [N, 6] (label, score, x1, y1, x2, y2)
-    gt = ins["Label"][0]  # [M, 5] (label, x1, y1, x2, y2)
+    gt = ins["Label"][0]  # [M, 5|6] (label[, difficult], x1, y1, x2, y2)
     overlap = float(attrs.get("overlap_threshold", 0.5))
+    ap_version = str(attrs.get("ap_version", "integral"))
+    ev_diff = bool(attrs.get("evaluate_difficult", True))
+    accum_key = attrs.get("accum_key")
+
+    from ..metrics import DetectionMAP
+
+    if accum_key:
+        raise ValueError(
+            "detection_map with accum_key must be emitted as the "
+            "side-effecting 'detection_map_accum' op type (DCE and the "
+            "profiler's warm re-runs would corrupt the stream otherwise) "
+            "— use layers.detection_map(accum_key=...)")
 
     def host_map(det_np, gt_np):
-        from ..metrics import DetectionMAP
-
-        gt_np = np.asarray(gt_np)
-        m = DetectionMAP(overlap_threshold=overlap)
-        m.update(np.asarray(det_np), gt_np[:, 1:5], gt_np[:, 0])
-        return np.float32(m.eval())
+        m = DetectionMAP(overlap_threshold=overlap, ap_version=ap_version)
+        return _detmap_feed(m, det_np, gt_np, ev_diff)
 
     out = jax.pure_callback(
         host_map, jax.ShapeDtypeStruct((), jnp.float32), det, gt
+    )
+    return {"MAP": [out.reshape(1)]}
+
+
+@register("detection_map_accum", no_grad_inputs=("DetectRes", "Label"),
+          side_effect=True)
+def _detection_map_accum(ctx, ins, attrs):
+    """STREAMING detection mAP (the accumulating detection_map variant):
+    the host callback owns a persistent accumulator under `accum_key` —
+    the reference's Accum* state tensors re-homed host-side.  A separate
+    side-effecting op type so the executor's dead-op pruning never drops
+    an unfetched accumulation and the profiler's warm re-runs never
+    double-feed a batch; io_callback(ordered=True) stops XLA from
+    eliding or reordering the update."""
+    from jax.experimental import io_callback
+
+    from ..metrics import DetectionMAP
+
+    det = ins["DetectRes"][0]
+    gt = ins["Label"][0]
+    overlap = float(attrs.get("overlap_threshold", 0.5))
+    ap_version = str(attrs.get("ap_version", "integral"))
+    ev_diff = bool(attrs.get("evaluate_difficult", True))
+    accum_key = str(attrs["accum_key"])
+
+    def host_accum(det_np, gt_np):
+        m = _DETMAP_ACCUMS.get(accum_key)
+        if m is None:
+            m = _DETMAP_ACCUMS[accum_key] = DetectionMAP(
+                overlap_threshold=overlap, ap_version=ap_version)
+        return _detmap_feed(m, det_np, gt_np, ev_diff)
+
+    out = io_callback(
+        host_accum, jax.ShapeDtypeStruct((), jnp.float32), det, gt,
+        ordered=True,
     )
     return {"MAP": [out.reshape(1)]}

@@ -1,0 +1,110 @@
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu import layers, evaluator, metrics
+
+def test_detection_map_evaluator_streams_and_resets():
+    """evaluator.DetectionMAP (evaluator.py:298 parity): cur_map is the
+    batch mAP, accum_map streams across runs, reset() clears the
+    accumulator; difficult gts excluded when evaluate_difficult=False."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.framework.program_guard(main, startup):
+        det = layers.data("det", shape=[4, 6], dtype="float32")
+        gl = layers.data("gl", shape=[2, 1], dtype="float32")
+        gb = layers.data("gb", shape=[2, 4], dtype="float32")
+        ev = evaluator.DetectionMAP(
+            layers.reshape(det, [-1, 6]),
+            layers.reshape(gl, [-1, 1]),
+            layers.reshape(gb, [-1, 4]))
+        cur, acc = ev.get_map_var()
+
+    def batch(seed):
+        rng = np.random.RandomState(seed)
+        gbx = np.zeros((1, 2, 4), "float32")
+        gbx[0, :, :2] = rng.rand(2, 2) * 4
+        gbx[0, :, 2:] = gbx[0, :, :2] + 1.0 + rng.rand(2, 2)
+        gl = rng.randint(0, 3, (1, 2, 1)).astype("float32")
+        d = np.full((1, 4, 6), -1, "float32")
+        # two detections: one matching gt 0 exactly, one garbage box
+        d[0, 0] = [gl[0, 0, 0], 0.9, *gbx[0, 0]]
+        d[0, 1] = [gl[0, 1, 0], 0.7, *(gbx[0, 1] + 3.0)]
+        return {"det": d, "gl": gl, "gb": gbx}
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        b1, b2 = batch(1), batch(2)
+        c1, a1 = exe.run(main, feed=b1, fetch_list=[cur, acc])
+        c2, a2 = exe.run(main, feed=b2, fetch_list=[cur, acc])
+
+    # host-side reference on the union vs each batch
+    def ref(batches):
+        m = metrics.DetectionMAP()
+        for b in batches:
+            m.update(b["det"][0], b["gb"][0], b["gl"][0].reshape(-1))
+        return m.eval()
+
+    assert abs(float(np.asarray(c1)) - ref([b1])) < 1e-6
+    assert abs(float(np.asarray(a1)) - ref([b1])) < 1e-6
+    assert abs(float(np.asarray(c2)) - ref([b2])) < 1e-6
+    assert abs(float(np.asarray(a2)) - ref([b1, b2])) < 1e-6
+
+    # reset clears the stream: next accum == that batch alone
+    ev.reset()
+    with fluid.scope_guard(scope):
+        c3, a3 = exe.run(main, feed=b1, fetch_list=[cur, acc])
+    assert abs(float(np.asarray(a3)) - ref([b1])) < 1e-6
+
+
+def test_detection_map_difficult_gts_excluded():
+    """VOC difficult convention: with evaluate_difficult=False a
+    difficult gt leaves npos and its matches are neither tp nor fp."""
+    det = np.array([[0, 0.9, 0, 0, 1, 1], [0, 0.8, 2, 2, 3, 3]], "float32")
+    gb = np.array([[0, 0, 1, 1], [2, 2, 3, 3]], "float32")
+    gl = np.array([0, 0], "float32")
+    hard = np.array([0, 1], "float32")
+    m_all = metrics.DetectionMAP()
+    m_all.update(det, gb, gl)
+    m_excl = metrics.DetectionMAP()
+    m_excl.update(det, gb, gl, difficult=hard)
+    assert abs(m_all.eval() - 1.0) < 1e-6
+    # difficult gt excluded: only 1 positive, its detection matches -> 1.0
+    assert abs(m_excl.eval() - 1.0) < 1e-6
+    # but npos differs: only one class-0 positive counted
+    assert m_excl._npos == {0: 1}
+
+
+def test_detection_map_accum_survives_unfetched_runs():
+    """The streaming op is side-effecting: a run that fetches ONLY
+    cur_map (reference training-loop pattern) must still feed the
+    accumulator — dead-op pruning may not drop it."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.framework.program_guard(main, startup):
+        det = layers.data("det", shape=[2, 6], dtype="float32")
+        gl = layers.data("gl", shape=[1, 1], dtype="float32")
+        gb = layers.data("gb", shape=[1, 4], dtype="float32")
+        ev = evaluator.DetectionMAP(
+            layers.reshape(det, [-1, 6]), layers.reshape(gl, [-1, 1]),
+            layers.reshape(gb, [-1, 4]))
+        cur, acc = ev.get_map_var()
+    feed_hit = {
+        "det": np.array([[[0, .9, 0, 0, 1, 1], [-1, 0, 0, 0, 0, 0]]], "float32"),
+        "gl": np.array([[[0]]], "float32"),
+        "gb": np.array([[[0, 0, 1, 1]]], "float32"),
+    }
+    feed_miss = {
+        "det": np.array([[[0, .9, 5, 5, 6, 6], [-1, 0, 0, 0, 0, 0]]], "float32"),
+        "gl": np.array([[[0]]], "float32"),
+        "gb": np.array([[[0, 0, 1, 1]]], "float32"),
+    }
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        # two runs fetching only cur_map: one hit, one miss
+        exe.run(main, feed=feed_hit, fetch_list=[cur])
+        exe.run(main, feed=feed_miss, fetch_list=[cur])
+        _, a = exe.run(main, feed=feed_hit, fetch_list=[cur, acc])
+    # stream saw hit, miss, hit: 2 tp + 1 fp over 3 positives
+    got = float(np.asarray(a))
+    assert 0.0 < got < 1.0, got  # unfetched runs WERE accumulated
