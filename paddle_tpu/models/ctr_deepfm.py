@@ -57,8 +57,11 @@ def deepfm(sparse_ids, dense_input, sparse_field_dims, embed_dim=8,
 
 
 def build_deepfm_train(sparse_field_dims, dense_dim=4, embed_dim=8,
-                       is_sparse=False):
-    """Returns (feeds, avg_loss, auc_like_pred)."""
+                       is_sparse=False, with_auc=False):
+    """Returns (feeds, avg_loss, pred) — or, with_auc=True, (feeds,
+    avg_loss, pred, auc, batch_auc): the reference CTR-eval workflow
+    (dist_ctr.py) with the in-graph streaming layers.auc — global AUC
+    plus the sliding-window batch AUC over the last 20 batches."""
     sparse_ids = [
         layers.data("C%d" % i, shape=[1], dtype="int64")
         for i in range(len(sparse_field_dims))
@@ -69,4 +72,9 @@ def build_deepfm_train(sparse_field_dims, dense_dim=4, embed_dim=8,
                   is_sparse=is_sparse)
     loss = layers.mean(layers.log_loss(pred, label, epsilon=1e-6))
     feeds = sparse_ids + ([dense] if dense is not None else []) + [label]
+    if with_auc:
+        auc_var, batch_auc, _states = layers.auc(
+            layers.reshape(pred, [-1]), layers.cast(label, "int64"),
+            num_thresholds=2 ** 12 - 1, slide_steps=20)
+        return feeds, loss, pred, auc_var, batch_auc
     return feeds, loss, pred
