@@ -115,7 +115,8 @@ class ParameterServer:
                 k: {
                     kk: (np.array(vv) if isinstance(vv, np.ndarray) else vv)
                     for kk, vv in info.items()
-                    if kk == "tbl" or kk.startswith(("moment", "beta"))
+                    if kk == "tbl"
+                    or kk.startswith(("moment", "beta", "velocity"))
                 }
                 for k, info in self.sparse_tables.items()
             },
@@ -230,12 +231,19 @@ class ParameterServer:
                 advance_pows=False,
             )
         self._pending_sparse = []
-        # adam beta pows advance once per ROUND for every adam table —
-        # the local adam op advances them every step even when this
-        # shard received no rows (ops/optimizer_ops.py Beta1PowOut),
-        # so a shard missed by one batch's id hashing must not stall
-        for info in self.sparse_tables.values():
+        # per-round state that advances even on ROWLESS rounds: the
+        # local op runs every step regardless of which rows a shard's id
+        # hashing happened to receive — adam beta pows advance
+        # (ops/optimizer_ops.py Beta1PowOut) and momentum velocity
+        # decays (the densified SparseMomentumFunctor covers every row)
+        for t, info in sorted(self.sparse_tables.items()):
             self._advance_pows(info)
+            if t not in by_table and (
+                    (info.get("opt") or {}).get("type") == "momentum"):
+                self._apply_sparse(t, np.zeros((0,), np.int64),
+                                   np.zeros((0, info["tbl"].shape[1]),
+                                            info["tbl"].dtype),
+                                   advance_pows=False)
         self._pending.clear()
         self._send_barriers.clear()
         self._params_ready = True
@@ -358,7 +366,10 @@ class ParameterServer:
         typ = opt.get("type", "sgd")
         at = opt.get("attrs") or {}
         ids = np.asarray(ids).reshape(-1)
-        rows = np.asarray(rows, dtype=tbl.dtype).reshape(ids.size, -1)
+        # explicit second dim: -1 is ambiguous (ValueError) for 0 rows,
+        # and rowless momentum decay feeds exactly that
+        rows = np.asarray(rows, dtype=tbl.dtype).reshape(
+            ids.size, tbl.shape[1])
         uids, inv = np.unique(ids, return_inverse=True)
         g = np.zeros((uids.size, tbl.shape[1]), tbl.dtype)
         np.add.at(g, inv, rows)
@@ -371,6 +382,19 @@ class ParameterServer:
             mn = m[uids] + g * g
             m[uids] = mn
             tbl[uids] -= lr * g / (np.sqrt(mn) + eps)
+        elif typ == "momentum":
+            # momentum_op.h SparseMomentumFunctor: densified rule over
+            # EVERY shard row — untouched rows' velocity still decays
+            mu = float(at.get("mu", 0.9))
+            v = info.setdefault("velocity", np.zeros_like(tbl))
+            g_dense = np.zeros_like(tbl)
+            g_dense[uids] = g
+            v *= mu
+            v += g_dense
+            if at.get("use_nesterov"):
+                tbl -= lr * (g_dense + mu * v)
+            else:
+                tbl -= lr * v
         elif typ == "adam":
             b1 = float(at.get("beta1", 0.9))
             b2 = float(at.get("beta2", 0.999))

@@ -5,6 +5,7 @@ startup program once materializes parameters in the scope — same two-program
 contract as the reference.  Random inits lower to jax.random draws.
 """
 
+import contextlib
 import math
 
 import numpy as np
@@ -21,11 +22,22 @@ __all__ = [
     "Bilinear",
     "NumpyArrayInitializer",
     "force_init_on_cpu",
+    "init_on_cpu",
 ]
 
 
 def force_init_on_cpu():
     return False
+
+
+@contextlib.contextmanager
+def init_on_cpu():
+    """initializer.py init_on_cpu parity: the reference forces wrapped
+    initializers (lr-scheduler counters) onto CPU via force_cpu attrs.
+    Under XLA the executor owns placement — host-side scalars stay host
+    scalars until fed — so this is an accepted no-op context for
+    migrating code."""
+    yield
 
 
 class Initializer:

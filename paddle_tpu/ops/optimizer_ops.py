@@ -32,11 +32,21 @@ def _sgd(ctx, ins, attrs):
     return {"ParamOut": [p - _lr(ins) * g.astype(p.dtype)]}
 
 
-@register("momentum", no_grad_inputs=("Param", "Grad", "Velocity", "LearningRate"))
+@register("momentum", no_grad_inputs=("Param", "Grad", "Velocity", "LearningRate"),
+          handles_selected_rows=True)
 def _momentum(ctx, ins, attrs):
     p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
     mu = attrs.get("mu", 0.9)
     lr = _lr(ins)
+    if isinstance(g, SelectedRows):
+        # sparse branch (momentum_op.h SparseMomentumFunctor): the
+        # reference densifies the merged rows (g=0 elsewhere) and runs
+        # the dense rule over EVERY row — untouched rows still decay
+        mer = g.merged()
+        g = jnp.zeros_like(p).at[mer.rows].add(
+            mer.value.astype(p.dtype), mode="drop")
+    else:
+        g = g.astype(p.dtype)
     v_out = mu * v + g
     if attrs.get("use_nesterov", False):
         p_out = p - (g + mu * v_out) * lr

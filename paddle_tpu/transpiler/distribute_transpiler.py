@@ -189,6 +189,7 @@ class DistributeTranspiler:
             "sgd": {},
             "adagrad": {"epsilon": 1e-6},
             "adam": {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8},
+            "momentum": {"mu": 0.9, "use_nesterov": False},
         }
         table_opt = {}
         for op in block.ops:
@@ -199,9 +200,9 @@ class DistributeTranspiler:
                 if op.type not in _SPARSE_OPT_DEFAULTS:
                     raise NotImplementedError(
                         "distributed lookup table '%s' is optimized by '%s'; "
-                        "the pserver applies sparse sgd/adagrad/adam on its "
-                        "row shards — use one of those for is_distributed "
-                        "embeddings" % (rv[0], op.type)
+                        "the pserver applies sparse sgd/momentum/adagrad/adam "
+                        "on its row shards — use one of those for "
+                        "is_distributed embeddings" % (rv[0], op.type)
                     )
                 lr_names = op.inputs.get("LearningRate", [])
                 lr_name = lr_names[0] if lr_names else None

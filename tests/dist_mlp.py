@@ -36,19 +36,22 @@ def build_sparse_model(distributed):
     DIST_OPTIMIZER=adam_decay swaps in Adam + exponential lr decay with
     is_sparse=True, so the LOCAL reference runs the lazy SelectedRows
     adam branch — the exact rule the pserver replays per shard."""
-    adam = os.environ.get("DIST_OPTIMIZER") == "adam_decay"
+    opt_kind = os.environ.get("DIST_OPTIMIZER", "sgd")
+    lazy = opt_kind in ("adam_decay", "momentum")
     ids = layers.data("ids", shape=[1], dtype="int64")
     y = layers.data("y", shape=[1])
     emb = layers.embedding(
-        ids, size=[20, 8], dtype="float32", is_sparse=adam,
+        ids, size=[20, 8], dtype="float32", is_sparse=lazy,
         is_distributed=distributed
     )
     emb = layers.reshape(emb, [-1, 8])
     pred = layers.fc(emb, size=1)
     loss = layers.mean(layers.square_error_cost(pred, y))
-    if adam:
+    if opt_kind == "adam_decay":
         lr = layers.exponential_decay(0.05, decay_steps=2, decay_rate=0.9)
         fluid.optimizer.Adam(lr).minimize(loss)
+    elif opt_kind == "momentum":
+        fluid.optimizer.Momentum(0.05, momentum=0.9).minimize(loss)
     else:
         fluid.optimizer.SGD(0.1).minimize(loss)
     return loss

@@ -162,6 +162,18 @@ def test_dist_sparse_lookup_table_matches_local():
 
 
 @pytest.mark.slow
+def test_dist_sparse_lookup_momentum_matches_local():
+    """Sparse momentum on the pserver: the densified
+    SparseMomentumFunctor rule per shard (every row's velocity decays
+    each round, momentum_op.h:343) — dist matches the local is_sparse
+    momentum run exactly."""
+    env = {"DIST_MODEL": "sparse", "DIST_OPTIMIZER": "momentum"}
+    local = _local_losses(steps=6, extra_env=env)
+    (dist,) = _run_cluster(1, sync=True, steps=6, extra_env=env)
+    np.testing.assert_allclose(dist, local, rtol=2e-4, atol=1e-5)
+
+
+@pytest.mark.slow
 def test_dist_sparse_lookup_adam_decay_matches_local():
     """VERDICT r4 #6: the sparse pserver path beyond SGD — the table's
     ADAM slot state (moments + beta pows) lives per shard on the

@@ -370,3 +370,34 @@ def test_pserver_adam_beta_pows_advance_on_rowless_rounds():
         ps._run_round()  # ROWLESS round: pows must still advance
     assert abs(info["beta1_pow"] - b1p_1 * 0.9) < 1e-12
     assert abs(info["beta2_pow"] - b2p_1 * 0.999) < 1e-12
+
+
+def test_pserver_momentum_rowless_round_decays_velocity():
+    """Code-review r5: a sync round where a momentum table receives NO
+    rows must still decay every row's velocity (the densified
+    SparseMomentumFunctor covers all rows each step) — and must not
+    crash on the empty-rows reshape."""
+    import numpy as np
+
+    from paddle_tpu.distributed.ps_server import ParameterServer
+
+    tbl = np.ones((4, 2), np.float32)
+    ps = ParameterServer(
+        {}, {}, num_trainers=1, sync_mode=True,
+        sparse_tables={"m.shard0": {
+            "tbl": tbl, "lr": 0.1,
+            "opt": {"type": "momentum", "attrs": {"mu": 0.5}},
+        }})
+    ps._h_send_sparse("m.shard0", np.array([0]), np.ones((1, 2), np.float32))
+    with ps._cv:
+        ps._run_round()  # round WITH rows: v[0] = 1, others 0
+    info = ps.sparse_tables["m.shard0"]
+    v1 = info["velocity"].copy()
+    assert v1[0, 0] == 1.0 and v1[1, 0] == 0.0
+    with ps._cv:
+        ps._run_round()  # ROWLESS round: v *= mu, p -= lr*v
+    np.testing.assert_allclose(info["velocity"], v1 * 0.5)
+
+    # velocity must survive a checkpoint roundtrip (snapshot key filter)
+    snap = ps._snapshot()
+    assert "velocity" in snap["sparse"]["m.shard0"], snap["sparse"].keys()

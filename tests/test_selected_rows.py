@@ -54,13 +54,16 @@ def _train_embedding(optimizer_ctor, is_sparse, ids_np, vocab, dim, steps=4):
     return losses, w
 
 
-@pytest.mark.parametrize("opt", ["sgd", "adagrad"])
+@pytest.mark.parametrize("opt", ["sgd", "adagrad", "momentum"])
 def test_sparse_matches_dense_training(opt):
-    """is_sparse=True trains identically to dense for sgd/adagrad —
-    including duplicate ids in the batch (merge-then-update semantics)."""
+    """is_sparse=True trains identically to dense for sgd/adagrad/
+    momentum — including duplicate ids in the batch (merge-then-update
+    semantics; momentum densifies, so untouched rows' velocity decays
+    exactly like the dense run — momentum_op.h SparseMomentumFunctor)."""
     ctor = {
         "sgd": lambda: fluid.optimizer.SGD(0.1),
         "adagrad": lambda: fluid.optimizer.Adagrad(0.1),
+        "momentum": lambda: fluid.optimizer.Momentum(0.1, momentum=0.9),
     }[opt]
     rng = np.random.RandomState(0)
     ids = rng.randint(0, 16, (8, 3)).astype("int64")
