@@ -592,6 +592,35 @@ def _decode_bench(on_tpu, device):
             out[name] = {"value": round(B * new / dt, 1),
                          "unit": "new tokens/sec"
                          + ("" if on_tpu else " (cpufallback)")}
+
+        # prefill-dominated workload: long prompt, few new tokens — the
+        # W-wide chunked prefill collapses P dispatches into ceil(P/W)
+        # MXU-shaped ones (value = processed prompt+new tokens/sec)
+        Wp = int(os.environ.get("BENCH_DECODE_PREFILL_W",
+                                32 if on_tpu else 8))
+        long_prompt = np.random.RandomState(1).randint(
+            1, HP.vocab_size, (B, T // 2)).astype("int64")
+        new2 = max(4, T // 8)
+        wide_main, _, _, wide_fetch, _ = gpt2.gpt2_decode_step_program(
+            HP, batch=B, t_max=T, width=Wp)
+        for name, pf in (
+            ("long_prompt_onetoken_prefill", None),
+            ("long_prompt_chunked_prefill", (wide_main, wide_fetch, Wp, T)),
+        ):
+            gpt2.greedy_generate_cached(
+                exe, step_main, cache_startup, step_fetch, long_prompt,
+                new2, prefill=pf)  # warm compile
+            t0 = _t.time()
+            gpt2.greedy_generate_cached(
+                exe, step_main, cache_startup, step_fetch, long_prompt,
+                new2, prefill=pf)
+            dt = _t.time() - t0
+            out[name] = {
+                "value": round(B * (T // 2 + new2) / dt, 1),
+                "unit": "prompt+new tokens/sec"
+                + ("" if on_tpu else " (cpufallback)"),
+                "prefill_width": Wp if pf else 1,
+            }
     return out
 
 

@@ -1437,7 +1437,7 @@ def log_loss(input, label, epsilon=1e-4, name=None):
 
 
 def fused_attention(q, k, v, causal=False, scale=None, bias=None,
-                    window=0, segment_ids=None, name=None):
+                    window=0, segment_ids=None, qstart=None, name=None):
     """Fused scaled-dot-product attention over [batch, heads, T, d]
     (flash-attention kernel under FLAGS_use_pallas).  bias: optional
     additive key-padding bias, rank-1 in the key axis ([B, Tk] or
@@ -1449,12 +1449,19 @@ def fused_attention(q, k, v, causal=False, scale=None, bias=None,
     [B, T] int ids from sequence packing (reader.packing) — attention
     stays within each packed segment (ids compared on the fly, no
     [T, T] mask tensor; rides the flash kernels under FLAGS_use_pallas
-    as two extra rank-1 operands, dense-XLA otherwise)."""
+    as two extra rank-1 operands, dense-XLA otherwise).  qstart:
+    optional [1] int var (chunked KV-cached decode): query i sits at
+    GLOBAL position qstart + i while keys sit at their cache indices —
+    causal masking applies in global positions and Tq may differ from
+    Tk (requires causal=True)."""
     window = int(window)
     if window < 0:
         raise ValueError("fused_attention: window must be >= 0")
     if window and not causal:
         raise ValueError("fused_attention: window requires causal=True")
+    if qstart is not None and not causal:
+        raise ValueError("fused_attention: qstart requires causal=True "
+                         "(it defines the global causal cutoffs)")
     helper = LayerHelper("fused_attention", **locals())
     out = helper.create_variable_for_type_inference(q.dtype)
     inputs = {"Q": [q], "K": [k], "V": [v]}
@@ -1462,6 +1469,8 @@ def fused_attention(q, k, v, causal=False, scale=None, bias=None,
         inputs["Bias"] = [bias]
     if segment_ids is not None:
         inputs["SegmentIds"] = [segment_ids]
+    if qstart is not None:
+        inputs["QStart"] = [qstart]
     helper.append_op(
         "fused_attention",
         inputs=inputs,

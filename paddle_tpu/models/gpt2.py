@@ -31,6 +31,7 @@ __all__ = [
     "beam_generate_cached",
     "sample_generate_cached",
     "gpt2_decode_step_program",
+    "prefill_cached_chunked",
     "beam_generate",
     "make_fake_lm_batch",
 ]
@@ -211,26 +212,33 @@ def gpt2_logits_program(hp=GPT2Config, seq_len=128):
     return main, startup, ["ids"], [logits]
 
 
-def gpt2_decode_step_program(hp=GPT2Config, batch=1, t_max=None):
-    """One-token KV-cached decode step (the incremental-decoding engine
-    the reference's beam-search cache plumbing approximates):
+def gpt2_decode_step_program(hp=GPT2Config, batch=1, t_max=None, width=1):
+    """KV-cached decode step (the incremental-decoding engine the
+    reference's beam-search cache plumbing approximates):
 
-        feeds:  step_ids [B, 1] int64, pos [1] int64
-        fetch:  next-token logits [B, vocab]
+        feeds:  step_ids [B, W] int64, pos [1] int64
+                (+ pos_vec [W] int64 when W > 1: positions pos..pos+W-1)
+        fetch:  next-token logits — [B, vocab] (W == 1) or
+                [B, W, vocab] (W > 1; row i predicts position pos+i+1)
         state:  per-layer kcache/vcache [B, H, T_max, Dh] persistable vars
 
-    Per generated token this runs O(T_max * d) work instead of the full
-    re-encode's O(T_max^2 * d) — the cache vars live donated in HBM and
-    the step compiles ONCE.  Returns (main, cache_startup, feeds,
-    fetches, cache_names); run `cache_startup` to (re)zero the caches
-    before each generation.  Built under unique_name.guard(), so weights
-    are shared by name with gpt2_lm_program / gpt2_logits_program built
-    in the same process."""
+    width == 1 is the classic one-token step: O(T_max * d) per token.
+    width > 1 is the CHUNKED step (prefill / speculative verify): one
+    dispatch writes W cache slots and scores W positions with
+    offset-causal attention (fused_attention qstart) — prompt prefill
+    drops from P dispatches to ceil(P/W) MXU-shaped ones.  The cache
+    vars live donated in HBM and the step compiles ONCE.  Returns
+    (main, cache_startup, feeds, fetches, cache_names); run
+    `cache_startup` to (re)zero the caches before each generation.
+    Built under unique_name.guard(), so weights are shared by name with
+    gpt2_lm_program / gpt2_logits_program built in the same process."""
     import paddle_tpu as fluid
 
     t_max = t_max or hp.n_ctx
     assert t_max <= hp.n_ctx, (
         "t_max %d exceeds the position table n_ctx %d" % (t_max, hp.n_ctx))
+    width = int(width)
+    assert 1 <= width <= t_max, (width, t_max)
     dh = hp.d_model // hp.n_head
     main = fluid.Program()
     cache_startup = fluid.Program()  # ONLY cache zeroing lands here
@@ -240,25 +248,33 @@ def gpt2_decode_step_program(hp=GPT2Config, batch=1, t_max=None):
     with fluid.program_guard(main, throwaway_startup), unique_name.guard():
         # static batch: the caches are [batch, ...] state, so the whole
         # step graph keeps concrete shapes (one compile, no DYN dims)
-        ids = layers.data("step_ids", shape=[batch, 1], dtype="int64",
+        ids = layers.data("step_ids", shape=[batch, width], dtype="int64",
                           append_batch_size=False)
         pos = layers.data("pos", shape=[1], dtype="int64",
                           append_batch_size=False)
+        pos_vec = None
+        if width > 1:
+            pos_vec = layers.data("pos_vec", shape=[width], dtype="int64",
+                                  append_batch_size=False)
         emb_attr = _pa("emb.w")
         tok = layers.embedding(
             ids, size=[hp.vocab_size, hp.d_model], param_attr=emb_attr
-        )  # [B, D] (the T=1 axis squeezes in the lookup)
-        tok = layers.reshape(tok, shape=[batch, 1, hp.d_model])
+        )  # [B, W, D] (W == 1 squeezes in the lookup)
+        tok = layers.reshape(tok, shape=[batch, width, hp.d_model])
         if getattr(hp, "use_rotary", False):
-            x = tok  # RoPE rotates q/k by `pos` inside cached attention
+            x = tok  # RoPE rotates q/k by position inside cached attention
         else:
             pos_table = layers.create_parameter(
                 shape=[hp.n_ctx, hp.d_model], dtype="float32",
                 attr=_pa("pos_emb.w", 0.01),
             )
-            pos_row = layers.reshape(layers.gather(pos_table, pos),
-                                     shape=[1, 1, hp.d_model])
-            x = layers.elementwise_add(tok, pos_row)
+            if width == 1:
+                pos_row = layers.reshape(layers.gather(pos_table, pos),
+                                         shape=[1, 1, hp.d_model])
+                x = layers.elementwise_add(tok, pos_row)
+            else:
+                pos_rows = layers.gather(pos_table, pos_vec)  # [W, D]
+                x = layers.elementwise_add(tok, pos_rows, axis=1)
         from .decode_cache import add_cache_zero_fills, create_kv_caches
 
         blk = main.global_block()
@@ -270,11 +286,16 @@ def gpt2_decode_step_program(hp=GPT2Config, batch=1, t_max=None):
             [(n, (batch, n_kv, t_max, dh)) for n in cache_names])
         for cache in kv_caches:
             cache["pos"] = pos
+            if pos_vec is not None:
+                cache["pos_vec"] = pos_vec
             x = _block(x, hp, is_test=True, cache=cache)
         x = layers.layer_norm(x, begin_norm_axis=2)
         logits = _tied_logits(x, hp, emb_attr.name)
-        logits = layers.reshape(logits, shape=[batch, hp.vocab_size])
-    return main, cache_startup, ["step_ids", "pos"], [logits], cache_names
+        if width == 1:
+            logits = layers.reshape(logits, shape=[batch, hp.vocab_size])
+        feeds = ["step_ids", "pos"] + (["pos_vec"] if pos_vec is not None
+                                       else [])
+    return main, cache_startup, feeds, [logits], cache_names
 
 
 def _prefill_cached(exe, step_main, fetches, ids):
@@ -291,12 +312,48 @@ def _prefill_cached(exe, step_main, fetches, ids):
     return logits
 
 
+def prefill_cached_chunked(exe, wide_main, wide_fetches, ids, width,
+                           t_max):
+    """Fill the caches with the prompt in ceil(P/W) width-W dispatches
+    (gpt2_decode_step_program(width=W)) instead of P one-token steps;
+    returns the logits predicting position P (identical to one-token
+    prefill).  The last chunk re-anchors to t_max - W when it would
+    write past the cache (rewriting earlier slots with the same tokens
+    is idempotent); pad rows beyond the prompt land in slots the
+    generation loop overwrites before ever attending them."""
+    ids = np.asarray(ids, "int64")
+    b, p = ids.shape
+    width = int(width)
+    starts = list(range(0, p, width)) or [0]
+    if starts[-1] + width > t_max:
+        starts[-1] = max(0, t_max - width)
+    logits = last_c0 = None
+    for c0 in starts:
+        chunk = ids[:, c0:c0 + width]
+        if chunk.shape[1] < width:
+            chunk = np.pad(chunk, ((0, 0), (0, width - chunk.shape[1])))
+        (logits,) = exe.run(
+            wide_main,
+            feed={
+                "step_ids": chunk,
+                "pos": np.array([c0], "int64"),
+                "pos_vec": np.minimum(
+                    np.arange(c0, c0 + width, dtype="int64"), t_max - 1),
+            },
+            fetch_list=wide_fetches,
+        )
+        last_c0 = c0
+    return np.asarray(logits)[:, (p - 1) - last_c0]
+
+
 def greedy_generate_cached(exe, step_main, cache_startup, fetches,
-                           prompt_ids, max_new_tokens):
-    """Greedy decoding through the KV-cached step program: prefill feeds
-    the prompt one token at a time (filling the caches), then each new
-    token costs one O(T_max * d) step.  Matches greedy_generate
-    token-for-token."""
+                           prompt_ids, max_new_tokens, prefill=None):
+    """Greedy decoding through the KV-cached step program: prefill fills
+    the caches from the prompt, then each new token costs one
+    O(T_max * d) step.  Matches greedy_generate token-for-token.
+    prefill: optional (wide_main, wide_fetches, width, t_max) from
+    gpt2_decode_step_program(width=W) — chunked prefill in ceil(P/W)
+    dispatches instead of P."""
     from .decode_cache import validate_cached_call
 
     prompt_ids = np.asarray(prompt_ids, "int64")
@@ -305,7 +362,12 @@ def greedy_generate_cached(exe, step_main, cache_startup, fetches,
                          max_new_tokens)
     exe.run(cache_startup)  # (re)zero the caches for this generation
     out = [prompt_ids[:, i] for i in range(p)]
-    logits = _prefill_cached(exe, step_main, fetches, prompt_ids)
+    if prefill is not None:
+        wide_main, wide_fetches, width, t_max = prefill
+        logits = prefill_cached_chunked(
+            exe, wide_main, wide_fetches, prompt_ids, width, t_max)
+    else:
+        logits = _prefill_cached(exe, step_main, fetches, prompt_ids)
     for t in range(p, p + max_new_tokens):
         nxt = np.asarray(logits).argmax(axis=-1).astype("int64")
         out.append(nxt)

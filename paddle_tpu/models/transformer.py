@@ -156,7 +156,10 @@ def multi_head_attention(
     q = split_heads(q, n_head)
     k, v = split_heads(k, n_kv), split_heads(v, n_kv)
     if rotary:
-        rpos = cache["pos"] if cache is not None else None
+        # chunked decode feeds pos_vec (positions pos..pos+W-1); the
+        # one-token step feeds the scalar pos
+        rpos = (cache.get("pos_vec", cache["pos"])
+                if cache is not None else None)
         q = layers.rotary_embed(q, pos=rpos)
         k = layers.rotary_embed(k, pos=rpos)
     if cache is not None:
@@ -202,12 +205,30 @@ def multi_head_attention(
         v_full = write_cache(cache["v"], v)
         t_max = int(cache["k"].shape[2])
         bsz = int(cache["k"].shape[0])
-        bias = helper.create_variable_for_type_inference("float32")
-        helper.append_op(
-            "decode_pos_mask", inputs={"Pos": [cache["pos"]]},
-            outputs={"Out": [bias]}, attrs={"t_max": t_max, "batch": bsz},
-        )
-        if n_kv == n_head:
+        width = int(q.shape[2])
+        if width == 1:
+            # one-token steps mask via the rank-1 <=pos key bias
+            bias = helper.create_variable_for_type_inference("float32")
+            helper.append_op(
+                "decode_pos_mask", inputs={"Pos": [cache["pos"]]},
+                outputs={"Out": [bias]}, attrs={"t_max": t_max, "batch": bsz},
+            )
+        if width > 1:
+            # CHUNKED decode/prefill: W queries at global positions
+            # pos..pos+W-1 against the whole cache — offset-causal
+            # masking (fused_attention qstart) gives each chunk row its
+            # own cutoff, so one dispatch fills W cache slots.  GQA
+            # tiles K/V back to n_head here (accepted tradeoff: the
+            # one-token group fold puts the g query heads on the time
+            # axis, which cannot carry W per-row causal cutoffs at the
+            # same time; chunked steps are compute-bound MXU work, so
+            # the n_head/n_kv-fold cache read costs little where the
+            # fold matters most — the HBM-bound one-token step keeps it)
+            ctx = layers.fused_attention(
+                q, repeat_kv(k_full), repeat_kv(v_full), causal=True,
+                qstart=cache["pos"], scale=dh ** -0.5,
+            )  # [B, H, W, Dh]
+        elif n_kv == n_head:
             ctx = layers.fused_attention(
                 q, k_full, v_full, bias=bias, causal=False,
                 scale=dh ** -0.5,
@@ -219,7 +240,6 @@ def multi_head_attention(
             # Tq = g.  The rank-1 key bias broadcasts over the g rows;
             # per-step K/V reads really are n_kv-sized.
             g = n_head // n_kv
-            bsz = int(cache["k"].shape[0])
             q_g = layers.reshape(q, [bsz, n_kv, g, dh])
             ctx = layers.fused_attention(
                 q_g, k_full, v_full, bias=bias, causal=False,

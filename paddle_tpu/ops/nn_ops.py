@@ -698,7 +698,7 @@ def _pixel_shuffle(ctx, ins, attrs):
     return {"Out": [x.reshape(n, c // (r * r), h * r, w * r)]}
 
 
-@register("fused_attention")
+@register("fused_attention", no_grad_inputs=("QStart",))
 def _fused_attention(ctx, ins, attrs):
     """Fused scaled-dot-product attention (the cuDNN-fused-kernel slot of
     the reference, TPU-style): flash kernel under FLAGS_use_pallas, dense
@@ -721,7 +721,17 @@ def _fused_attention(ctx, ins, attrs):
     scale = attrs.get("scale") or 1.0 / (q.shape[-1] ** 0.5)
     b, h, t, d = q.shape
     tk = k.shape[2]
-    if causal and t != tk:
+    # chunked-decode global query offset: query i at position QStart+i,
+    # keys at their cache indices — Tq may differ from Tk
+    qstart = ins["QStart"][0].reshape(()) if ins.get("QStart") else None
+    if qstart is not None:
+        if not causal:
+            raise ValueError("fused_attention: QStart requires causal=True")
+        if ins.get("Bias") or ins.get("SegmentIds"):
+            raise ValueError(
+                "fused_attention: QStart owns the causal cutoffs — "
+                "Bias/SegmentIds are not combinable with it")
+    elif causal and t != tk:
         raise ValueError(
             "fused_attention: causal requires Tq == Tk, got %d vs %d" % (t, tk)
         )
@@ -762,6 +772,37 @@ def _fused_attention(ctx, ins, attrs):
         return ((bq % 128 == 0 or bq == t) and t % bq == 0
                 and (bk % 128 == 0 or bk == tk) and tk % bk == 0)
 
+    if qstart is not None:
+        from .pallas_kernels import flash_attention_piece
+
+        if use_pallas() and (bq_flag or bk_flag):
+            # sweep knobs apply here too: validate loudly and USE them —
+            # silently benchmarking auto blocks (or the dense fallback)
+            # under the requested label is the misattribution the
+            # explicit-flag path exists to prevent
+            bq, bk = bq_flag or 128, bk_flag or 128
+            if bq <= 0 or bk <= 0 or not _mosaic_legal(bq, bk):
+                raise ValueError(
+                    "FLAGS_flash_block_q/k (%d, %d) are not Mosaic-legal "
+                    "for the chunked-decode shapes Tq=%d, Tk=%d"
+                    % (bq, bk, t, tk))
+            out, _lse = flash_attention_piece(
+                qf, kf, vf, True, float(scale), bq, bk, window,
+                qstart.astype(jnp.int32))
+            return {"Out": [out.reshape(b, h, t, d)]}
+        bq = 128 if t % 128 == 0 else t
+        bk = 128 if tk % 128 == 0 else tk
+        if use_pallas() and bq <= 512 and bk <= 1024:
+            # the ring's offset-causal piece IS chunked decode: the
+            # piece is softmax-normalized within its kv, and here the
+            # kv is the whole cache
+            out, _lse = flash_attention_piece(
+                qf, kf, vf, True, float(scale), bq, bk, window,
+                qstart.astype(jnp.int32))
+        else:
+            out = _dense_attention(qf, kf, vf, True, float(scale),
+                                   window=window, qoff=qstart)
+        return {"Out": [out.reshape(b, h, t, d)]}
     if use_pallas() and (bq_flag or bk_flag):
         # explicit sweep knobs: validate loudly — a silently-ignored
         # flag would attribute fallback timings to the requested size
@@ -979,15 +1020,17 @@ def _data_norm(ctx, ins, attrs):
 
 @register("seq_cache_write", no_grad_inputs=("Pos",))
 def _seq_cache_write(ctx, ins, attrs):
-    """KV-cache update for incremental decode: write the current token's
-    [B, H, 1, D] projection into the [B, H, T, D] cache at time index
-    Pos (the one-token analog of the reference's beam-search cache
-    shuffling; static shapes — a where over the time axis)."""
+    """KV-cache update for incremental decode: write the current chunk's
+    [B, H, W, D] projections into the [B, H, T, D] cache at time indices
+    Pos..Pos+W-1 (W == 1 is the classic one-token step; W > 1 is the
+    chunked-prefill write).  Static shapes — one dynamic_update_slice on
+    the time axis.  NB dynamic_update_slice CLAMPS Pos to T-W; callers
+    validate lengths up front (decode_cache.validate_cached_call)."""
     cache, new, pos = ins["Cache"][0], ins["New"][0], ins["Pos"][0]
-    t = cache.shape[2]
     pos = pos.reshape(()).astype(jnp.int32)
-    at = (jnp.arange(t, dtype=jnp.int32) == pos)[None, None, :, None]
-    return {"Out": [jnp.where(at, new.astype(cache.dtype), cache)]}
+    zero = jnp.int32(0)
+    return {"Out": [jax.lax.dynamic_update_slice(
+        cache, new.astype(cache.dtype), (zero, zero, pos, zero))]}
 
 
 @register("decode_pos_mask", no_grad_inputs=("Pos",))

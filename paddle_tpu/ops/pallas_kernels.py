@@ -420,22 +420,34 @@ def _flash_bwd(q, k, v, kbias, o, lse, do, causal, scale, block_q, block_k,
 
 
 def _dense_attention(q, k, v, causal, scale, kbias=None, window=0,
-                     seg=None):
+                     seg=None, qoff=None):
     """XLA reference implementation (used as the non-pallas fallback).
     seg: optional [BH, T] int segment ids (sequence packing) — query i
     may attend key j only when seg[i] == seg[j]; the compare fuses into
-    the softmax, no mask tensor lives in HBM."""
+    the softmax, no mask tensor lives in HBM.  qoff: optional traced
+    GLOBAL q-position base (chunked decode): query i sits at global
+    position qoff + i, keys at their indices — Tq may differ from Tk."""
     s = jnp.einsum("bqd,bkd->bqk", q, k).astype(jnp.float32) * scale
     if kbias is not None:
         s = s + kbias[:, None, :].astype(jnp.float32)
     if seg is not None:
         s = jnp.where(seg[:, :, None] == seg[:, None, :], s, NEG_INF)
     if causal:
-        T = q.shape[1]
-        mask = jnp.tril(jnp.ones((T, T), bool))
-        if window:
-            mask = mask & ~jnp.tril(jnp.ones((T, T), bool), -int(window))
-        s = jnp.where(mask[None], s, NEG_INF)
+        Tq, Tk = q.shape[1], k.shape[1]
+        if qoff is not None:
+            q_pos = (jnp.asarray(qoff).reshape(()).astype(jnp.int32)
+                     + jnp.arange(Tq, dtype=jnp.int32))
+            k_pos = jnp.arange(Tk, dtype=jnp.int32)
+            keep = q_pos[:, None] >= k_pos[None, :]
+            if window:
+                keep = keep & (q_pos[:, None] - k_pos[None, :] < int(window))
+            s = jnp.where(keep[None], s, NEG_INF)
+        else:
+            mask = jnp.tril(jnp.ones((Tq, Tq), bool))
+            if window:
+                mask = mask & ~jnp.tril(jnp.ones((Tq, Tq), bool),
+                                        -int(window))
+            s = jnp.where(mask[None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bqk,bkd->bqd", p.astype(q.dtype), v)
 

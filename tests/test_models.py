@@ -1201,3 +1201,58 @@ def test_gpt2_tied_embeddings_trains_and_decodes():
         out = gpt2.greedy_generate_cached(
             exe, step_main, cache_startup, step_fetch, prompt, 6)
         np.testing.assert_array_equal(out, ref)
+
+
+def test_gpt2_chunked_prefill_matches_onetoken_prefill():
+    """gpt2_decode_step_program(width=W): chunked prefill fills the
+    caches in ceil(P/W) offset-causal dispatches (fused_attention
+    qstart + W-wide seq_cache_write) and generation matches BOTH the
+    one-token prefill and the full re-encode — including the
+    padded-final-chunk case, the re-anchored-overlap case (last chunk
+    would write past the cache), and the GQA+RoPE variant."""
+    from paddle_tpu.models import gpt2
+
+    cases = [
+        # (hp overrides, T, prompt_len, width, max_new)
+        ({}, 16, 5, 3, 6),             # final chunk padded (5 -> 6 slots)
+        ({}, 10, 9, 4, 1),             # starts [0,4,8]: 8+4>10 re-anchors
+        # REAL GQA (n_kv < n_head): the width>1 branch's repeat_kv
+        # expansion over the cache must be exercised, not an identity
+        ({"n_head": 4, "n_kv_head": 2, "use_rotary": True}, 16, 6, 4, 5),
+    ]
+    for hp_kw, T, P, W, new in cases:
+        class HP(gpt2.GPT2Config):
+            vocab_size = 50
+            n_ctx = 16
+            d_model = 16
+            n_layer = 2
+            n_head = 2
+            dropout = 0.0
+
+        for k, v in hp_kw.items():
+            setattr(HP, k, v)
+        B = 2
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            full_main, full_startup, _, full_fetch = gpt2.gpt2_logits_program(
+                HP, seq_len=T)
+            step_main, cache_startup, _, step_fetch, _ = \
+                gpt2.gpt2_decode_step_program(HP, batch=B, t_max=T)
+            wide_main, _, wide_feeds, wide_fetch, _ = \
+                gpt2.gpt2_decode_step_program(HP, batch=B, t_max=T, width=W)
+            assert "pos_vec" in wide_feeds
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(full_startup)  # weights shared by name
+            prompt = np.random.RandomState(3).randint(
+                1, 50, (B, P)).astype("int64")
+
+            ref = gpt2.greedy_generate(exe, full_main, full_fetch, prompt,
+                                       new)
+            out1 = gpt2.greedy_generate_cached(
+                exe, step_main, cache_startup, step_fetch, prompt, new)
+            out_chunked = gpt2.greedy_generate_cached(
+                exe, step_main, cache_startup, step_fetch, prompt, new,
+                prefill=(wide_main, wide_fetch, W, T))
+        np.testing.assert_array_equal(out1, ref, err_msg=str((hp_kw, W)))
+        np.testing.assert_array_equal(out_chunked, ref,
+                                      err_msg=str((hp_kw, W)))
