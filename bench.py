@@ -576,6 +576,7 @@ def _decode_bench(on_tpu, device):
         step_main, cache_startup, _, step_fetch, _ = \
             gpt2.gpt2_decode_step_program(HP, batch=B, t_max=T)
         exe = fluid.Executor(fluid.TPUPlace(0) if on_tpu else fluid.CPUPlace())
+        full_startup.random_seed = 23  # shared with the self-draft copy
         exe.run(full_startup)
         prompt = np.random.RandomState(0).randint(
             1, HP.vocab_size, (B, 4)).astype("int64")
@@ -621,6 +622,44 @@ def _decode_bench(on_tpu, device):
                 + ("" if on_tpu else " (cpufallback)"),
                 "prefill_width": Wp if pf else 1,
             }
+
+        # speculative decode CEILING: a self-copy draft accepts every
+        # proposal (same weights), so this measures the best-case
+        # tokens/sec when target dispatches amortize over k+1 tokens —
+        # the realistic number interpolates toward kv_cached with the
+        # real draft's acceptance rate
+        K = max(2, int(os.environ.get("BENCH_DECODE_SPEC_K", "4")))
+        spec_wide, _, _, spec_wide_fetch, _ = gpt2.gpt2_decode_step_program(
+            HP, batch=B, t_max=T, width=K)
+        copy_scope = fluid.Scope()
+        with fluid.scope_guard(copy_scope):
+            _, c_startup, _, _ = gpt2.gpt2_logits_program(HP, seq_len=T)
+            c_step, c_cache_startup, _, c_step_fetch, _ = \
+                gpt2.gpt2_decode_step_program(HP, batch=B, t_max=T)
+        c_startup.random_seed = full_startup.random_seed
+        fluid.Executor(
+            fluid.TPUPlace(0) if on_tpu else fluid.CPUPlace()
+        ).run(c_startup, scope=copy_scope)
+
+        def spec():
+            return gpt2.speculative_generate_cached(
+                exe, step_main, cache_startup, step_fetch,
+                spec_wide, spec_wide_fetch, K,
+                c_step, c_cache_startup, c_step_fetch,
+                prompt, new, draft_scope=copy_scope)
+
+        spec()  # warm compile
+        t0 = _t.time()
+        _, stats = spec()
+        dt = _t.time() - t0
+        out["speculative_selfdraft"] = {
+            "value": round(B * new / dt, 1),
+            "unit": "new tokens/sec"
+            + ("" if on_tpu else " (cpufallback)"),
+            "spec_k": K,
+            "accept_rate": round(stats["accept_rate"], 3),
+            "target_dispatches": stats["rounds"],
+        }
     return out
 
 

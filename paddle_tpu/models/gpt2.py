@@ -32,6 +32,7 @@ __all__ = [
     "sample_generate_cached",
     "gpt2_decode_step_program",
     "prefill_cached_chunked",
+    "speculative_generate_cached",
     "beam_generate",
     "make_fake_lm_batch",
 ]
@@ -310,6 +311,138 @@ def _prefill_cached(exe, step_main, fetches, ids):
             fetch_list=fetches,
         )
     return logits
+
+
+def speculative_generate_cached(
+        exe, tgt_step_main, tgt_cache_startup, tgt_step_fetch,
+        tgt_wide_main, tgt_wide_fetch, spec_k,
+        draft_step_main, draft_cache_startup, draft_step_fetch,
+        prompt_ids, max_new_tokens, draft_scope=None):
+    """Speculative GREEDY decoding: a cheap draft model proposes spec_k
+    tokens one-step-at-a-time, the target model scores all of them in
+    ONE width-spec_k chunked dispatch (gpt2_decode_step_program
+    width=spec_k — the verifier), and the longest agreeing prefix is
+    accepted plus the target's one bonus/correction token.  Output is
+    EXACTLY the target's own greedy_generate_cached sequence for any
+    draft — the draft only changes how many target dispatches it takes
+    (>= 1 + ceil(new/(k+1)) at full acceptance vs `new`).
+
+    Rollback is free by construction: rejected draft tokens' K/V sit in
+    cache slots beyond the accepted position, which the <=pos
+    offset-causal masking never attends and later chunks overwrite
+    before first use (the same invariant chunked prefill relies on).
+    Beyond-reference (the reference era predates speculative decoding);
+    the standard TPU serving recipe for dispatch-bound decode.
+
+    draft_scope: the draft model's own fluid.Scope (separate weights +
+    caches); defaults to the CURRENT scope, i.e. a self-draft.  When the
+    cache has fewer than spec_k free slots left, the tail falls back to
+    plain one-token target steps (a fixed-width verify write near the
+    capacity edge would clamp and clobber valid slots).  Returns
+    (tokens [B, P+new], accept_stats dict)."""
+    from ..core.scope import global_scope
+    from .decode_cache import probe_cache_len, validate_cached_call
+
+    prompt_ids = np.asarray(prompt_ids, "int64")
+    b, p = prompt_ids.shape
+    spec_k = int(spec_k)
+    if spec_k < 2:
+        raise ValueError(
+            "speculative_generate_cached: spec_k must be >= 2 (the wide "
+            "verify program needs width > 1; spec_k == 1 is just "
+            "greedy_generate_cached)")
+    validate_cached_call(tgt_step_main, "gpt2", "step_ids", b, p,
+                         max_new_tokens)
+    draft_scope = draft_scope if draft_scope is not None else global_scope()
+
+    def run_draft(main, feed, fetches):
+        return exe.run(main, feed=feed, fetch_list=fetches,
+                       scope=draft_scope)
+
+    # prefill BOTH caches with the prompt; target via its wide program
+    exe.run(tgt_cache_startup)
+    run_draft(draft_cache_startup, {}, [])
+    t_max = probe_cache_len(tgt_wide_main, "gpt2")
+    tgt_logits = prefill_cached_chunked(
+        exe, tgt_wide_main, tgt_wide_fetch, prompt_ids, spec_k, t_max)
+    d_logits = None
+    for t in range(p):
+        (d_logits,) = run_draft(
+            draft_step_main,
+            feed={"step_ids": prompt_ids[:, t:t + 1],
+                  "pos": np.array([t], "int64")},
+            fetches=draft_step_fetch)
+
+    out = [prompt_ids[:, i] for i in range(p)]
+    # batch rows advance in lockstep on the SLOWEST row's acceptance —
+    # exactness first (every row's tokens match its own greedy chain)
+    cur = np.asarray(tgt_logits).argmax(-1).astype("int64")  # token @ p
+    pos = p  # next position to fill (cur goes there)
+    proposals = accepted_total = rounds = 0
+    while pos < p + max_new_tokens:
+        out.append(cur)
+        if pos + 1 >= p + max_new_tokens:
+            break
+        if pos + spec_k > t_max:
+            # capacity tail: a fixed-width verify write at pos would be
+            # clamped by dynamic_update_slice onto VALID earlier slots —
+            # finish with plain one-token target steps instead
+            (tl,) = exe.run(
+                tgt_step_main,
+                feed={"step_ids": cur[:, None],
+                      "pos": np.array([pos], "int64")},
+                fetch_list=tgt_step_fetch)
+            cur = np.asarray(tl).argmax(-1).astype("int64")
+            pos += 1
+            continue
+        k = min(spec_k - 1, p + max_new_tokens - pos - 2)
+        # draft chain: re-sync on the accepted token, then propose k
+        drafts = []
+        (d_logits,) = run_draft(
+            draft_step_main,
+            feed={"step_ids": cur[:, None], "pos": np.array([pos], "int64")},
+            fetches=draft_step_fetch)
+        for i in range(k):
+            nxt = np.asarray(d_logits).argmax(-1).astype("int64")
+            drafts.append(nxt)
+            (d_logits,) = run_draft(
+                draft_step_main,
+                feed={"step_ids": nxt[:, None],
+                      "pos": np.array([pos + 1 + i], "int64")},
+                fetches=draft_step_fetch)
+        # ONE target dispatch verifies cur + the k draft tokens: row i
+        # predicts position pos+i+1
+        chunk = np.stack([cur] + drafts, axis=1)
+        if chunk.shape[1] < spec_k:
+            chunk = np.pad(chunk, ((0, 0), (0, spec_k - chunk.shape[1])))
+        (wl,) = exe.run(
+            tgt_wide_main,
+            feed={"step_ids": chunk,
+                  "pos": np.array([pos], "int64"),
+                  "pos_vec": np.minimum(
+                      np.arange(pos, pos + spec_k, dtype="int64"),
+                      t_max - 1)},
+            fetch_list=tgt_wide_fetch)
+        tgt_next = np.asarray(wl).argmax(-1).astype("int64")  # [B, spec_k]
+        rounds += 1
+        proposals += k
+        # longest prefix where every batch row's draft agrees with the
+        # target's greedy choice
+        j = 0
+        while j < k and bool((drafts[j] == tgt_next[:, j]).all()):
+            out.append(drafts[j])
+            j += 1
+        accepted_total += j
+        cur = tgt_next[:, j]  # bonus (all accepted) or correction
+        pos = pos + 1 + j
+    tokens = np.stack(out, axis=1)[:, :p + max_new_tokens]
+    stats = {
+        "rounds": rounds,
+        "proposed": proposals,
+        "accepted": accepted_total,
+        "accept_rate": (accepted_total / proposals) if proposals else 1.0,
+    }
+    return tokens, stats
 
 
 def prefill_cached_chunked(exe, wide_main, wide_fetches, ids, width,

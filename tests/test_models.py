@@ -1256,3 +1256,103 @@ def test_gpt2_chunked_prefill_matches_onetoken_prefill():
         np.testing.assert_array_equal(out1, ref, err_msg=str((hp_kw, W)))
         np.testing.assert_array_equal(out_chunked, ref,
                                       err_msg=str((hp_kw, W)))
+
+
+def test_gpt2_speculative_decode_matches_greedy():
+    """Speculative greedy decoding == the target's own greedy chain
+    EXACTLY, for any draft: (a) an unrelated (differently-seeded,
+    smaller) draft — low acceptance but identical output; (b) a
+    self-copy draft (same seed) — acceptance rate 1.0 and far fewer
+    target dispatches.  Rejected draft tokens' cache slots are beyond
+    the accepted position, so the <=pos masking makes rollback free."""
+    from paddle_tpu.models import gpt2
+
+    class HP(gpt2.GPT2Config):
+        vocab_size = 60
+        n_ctx = 24
+        d_model = 16
+        n_layer = 2
+        n_head = 2
+        dropout = 0.0
+
+    class DraftHP(HP):
+        d_model = 8
+        n_layer = 1
+
+    B, T, P, NEW, K = 2, 24, 4, 12, 4
+    tgt_scope = fluid.Scope()
+    with fluid.scope_guard(tgt_scope):
+        full_main, full_startup, _, full_fetch = gpt2.gpt2_logits_program(
+            HP, seq_len=T)
+        step_main, cache_startup, _, step_fetch, _ = \
+            gpt2.gpt2_decode_step_program(HP, batch=B, t_max=T)
+        wide_main, _, _, wide_fetch, _ = gpt2.gpt2_decode_step_program(
+            HP, batch=B, t_max=T, width=K)
+        exe = fluid.Executor(fluid.CPUPlace())
+        full_startup.random_seed = 11
+        exe.run(full_startup)
+        prompt = np.random.RandomState(6).randint(
+            1, 60, (B, P)).astype("int64")
+        ref = gpt2.greedy_generate_cached(
+            exe, step_main, cache_startup, step_fetch, prompt, NEW)
+
+        # (a) unrelated small draft in its own scope
+        draft_scope = fluid.Scope()
+        with fluid.scope_guard(draft_scope):
+            d_main, d_startup, _, d_fetch = gpt2.gpt2_logits_program(
+                DraftHP, seq_len=T)
+            d_step, d_cache_startup, _, d_step_fetch, _ = \
+                gpt2.gpt2_decode_step_program(DraftHP, batch=B, t_max=T)
+        with fluid.scope_guard(tgt_scope):
+            exe.run(d_startup, scope=draft_scope)
+            out_a, stats_a = gpt2.speculative_generate_cached(
+                exe, step_main, cache_startup, step_fetch,
+                wide_main, wide_fetch, K,
+                d_step, d_cache_startup, d_step_fetch,
+                prompt, NEW, draft_scope=draft_scope)
+        np.testing.assert_array_equal(out_a, ref)
+
+        # (b) self-copy draft: same config + same startup seed ->
+        # identical weights -> every proposal accepted
+        copy_scope = fluid.Scope()
+        with fluid.scope_guard(copy_scope):
+            c_full, c_startup, _, _ = gpt2.gpt2_logits_program(HP, seq_len=T)
+            c_step, c_cache_startup, _, c_step_fetch, _ = \
+                gpt2.gpt2_decode_step_program(HP, batch=B, t_max=T)
+        with fluid.scope_guard(tgt_scope):
+            c_startup.random_seed = 11
+            # fresh executor: run() RNG folds in the step counter, so a
+            # reused executor would draw different init values
+            fluid.Executor(fluid.CPUPlace()).run(c_startup,
+                                                 scope=copy_scope)
+            out_b, stats_b = gpt2.speculative_generate_cached(
+                exe, step_main, cache_startup, step_fetch,
+                wide_main, wide_fetch, K,
+                c_step, c_cache_startup, c_step_fetch,
+                prompt, NEW, draft_scope=copy_scope)
+        np.testing.assert_array_equal(out_b, ref)
+    assert stats_b["accept_rate"] == 1.0, stats_b
+    assert stats_b["rounds"] < NEW, stats_b  # fewer target dispatches
+    assert 0.0 <= stats_a["accept_rate"] <= 1.0
+
+    # capacity-edge case: generation budget runs the cache to its very
+    # last slot (P + NEW == t_max + 1 passes validation); the verify
+    # dispatch near the edge must fall back to one-token steps instead
+    # of letting dynamic_update_slice clamp onto valid slots
+    NEW_EDGE = T + 1 - P  # 21
+    with fluid.scope_guard(tgt_scope):
+        ref_edge = gpt2.greedy_generate_cached(
+            exe, step_main, cache_startup, step_fetch, prompt, NEW_EDGE)
+        out_edge, _ = gpt2.speculative_generate_cached(
+            exe, step_main, cache_startup, step_fetch,
+            wide_main, wide_fetch, K,
+            c_step, c_cache_startup, c_step_fetch,
+            prompt, NEW_EDGE, draft_scope=copy_scope)
+    np.testing.assert_array_equal(out_edge, ref_edge)
+
+    # spec_k == 1 is rejected loudly (it is just greedy decoding)
+    with pytest.raises(ValueError, match="spec_k"):
+        gpt2.speculative_generate_cached(
+            exe, step_main, cache_startup, step_fetch,
+            wide_main, wide_fetch, 1,
+            c_step, c_cache_startup, c_step_fetch, prompt, 2)
