@@ -445,6 +445,32 @@ def speculative_generate_cached(
     return tokens, stats
 
 
+def _dispatch_prefill(exe, step_main, fetches, ids, prefill):
+    """Prefill the caches with `ids`: chunked through the wide program
+    when `prefill` = (wide_main, wide_fetches, width[, t_max]) is given,
+    one-token steps otherwise.  The wide program's cache length and
+    static batch are VALIDATED here — a wrong t_max would let the
+    chunked writes clamp onto valid slots, and a beam path needs the
+    wide program built with batch = B * beam_size."""
+    if prefill is None:
+        return _prefill_cached(exe, step_main, fetches, ids)
+    from .decode_cache import probe_cache_len
+
+    wm, wf, width = prefill[0], prefill[1], int(prefill[2])
+    t_max = probe_cache_len(wm, "gpt2")
+    if len(prefill) > 3 and int(prefill[3]) != t_max:
+        raise ValueError(
+            "prefill t_max %d does not match the wide program's cache "
+            "length %d" % (int(prefill[3]), t_max))
+    wb = int(wm.global_block().var("step_ids").shape[0])
+    if wb != ids.shape[0]:
+        raise ValueError(
+            "prefill wide program batch %d != %d rows to prefill (beam "
+            "paths need the wide program built with batch = B * "
+            "beam_size)" % (wb, ids.shape[0]))
+    return prefill_cached_chunked(exe, wm, wf, ids, width, t_max)
+
+
 def prefill_cached_chunked(exe, wide_main, wide_fetches, ids, width,
                            t_max):
     """Fill the caches with the prompt in ceil(P/W) width-W dispatches
@@ -495,12 +521,8 @@ def greedy_generate_cached(exe, step_main, cache_startup, fetches,
                          max_new_tokens)
     exe.run(cache_startup)  # (re)zero the caches for this generation
     out = [prompt_ids[:, i] for i in range(p)]
-    if prefill is not None:
-        wide_main, wide_fetches, width, t_max = prefill
-        logits = prefill_cached_chunked(
-            exe, wide_main, wide_fetches, prompt_ids, width, t_max)
-    else:
-        logits = _prefill_cached(exe, step_main, fetches, prompt_ids)
+    logits = _dispatch_prefill(exe, step_main, fetches, prompt_ids,
+                               prefill)
     for t in range(p, p + max_new_tokens):
         nxt = np.asarray(logits).argmax(axis=-1).astype("int64")
         out.append(nxt)
@@ -568,12 +590,14 @@ def beam_generate(exe, main, fetches, prompt_ids, max_new_tokens,
 
 def beam_generate_cached(exe, step_main, cache_startup, fetches, prompt_ids,
                          max_new_tokens, beam_size=4, eos_id=None, pad_id=0,
-                         length_penalty=0.0):
+                         length_penalty=0.0, prefill=None):
     """Beam-search decoding through the KV-cached step program: the step
     program must be built with batch = B * beam_size; surviving beams'
     caches shuffle via a gather/assign reorder program each step (the
-    reference's beam-search cache plumbing).  Returns (ids [B, T_out],
-    scores [B])."""
+    reference's beam-search cache plumbing).  prefill: optional
+    (wide_main, wide_fetches, width, t_max) chunked prompt prefill —
+    the wide program must ALSO be built with batch = B * beam_size.
+    Returns (ids [B, T_out], scores [B])."""
     from ..contrib.decoder.beam_search_decoder import incremental_beam_search
     from .decode_cache import (
         make_cache_reorder_program,
@@ -594,7 +618,7 @@ def beam_generate_cached(exe, step_main, cache_startup, fetches, prompt_ids,
 
     exe.run(cache_startup)
     rep = np.repeat(prompt_ids, beam_size, axis=0)
-    logits = _prefill_cached(exe, step_main, fetches, rep)
+    logits = _dispatch_prefill(exe, step_main, fetches, rep, prefill)
 
     def step_fn(tokens, pos):
         (lg,) = exe.run(step_main,
@@ -616,10 +640,12 @@ def beam_generate_cached(exe, step_main, cache_startup, fetches, prompt_ids,
 def sample_generate_cached(exe, step_main, cache_startup, fetches,
                            prompt_ids, max_new_tokens, temperature=1.0,
                            top_k=0, top_p=1.0, seed=None, eos_id=None,
-                           pad_id=0):
+                           pad_id=0, prefill=None):
     """Stochastic decoding through the KV-cached step: temperature
     scaling, top-k and/or nucleus (top-p) filtering, seeded numpy
-    sampling.  top_k=1 reduces to greedy.  Returns [B, P + new] int64."""
+    sampling.  top_k=1 reduces to greedy.  prefill: optional
+    (wide_main, wide_fetches, width, t_max) — chunked prompt prefill in
+    ceil(P/W) dispatches.  Returns [B, P + new] int64."""
     from .decode_cache import sample_from_logits, validate_cached_call
 
     prompt_ids = np.asarray(prompt_ids, "int64")
@@ -628,7 +654,8 @@ def sample_generate_cached(exe, step_main, cache_startup, fetches,
                          max_new_tokens)
     rng = np.random.RandomState(seed)
     exe.run(cache_startup)
-    logits = _prefill_cached(exe, step_main, fetches, prompt_ids)
+    logits = _dispatch_prefill(exe, step_main, fetches, prompt_ids,
+                               prefill)
     out = [prompt_ids[:, i] for i in range(p)]
     done = np.zeros(b, bool)
     for t in range(p, p + max_new_tokens):

@@ -797,6 +797,17 @@ def test_gpt2_cached_beam_search_matches_full_beam():
             exe, step_main, cache_startup, step_fetch, prompt, 6,
             beam_size=beam, eos_id=29)
         np.testing.assert_array_equal(out_ids, ref_ids)
+
+        # chunked prefill over the beam-replicated rows (batch B*beam)
+        wide_main, _, _, wide_fetch, _ = gpt2.gpt2_decode_step_program(
+            HP, batch=B * beam, t_max=T, width=2)
+        pf_ids, pf_scores = gpt2.beam_generate_cached(
+            exe, step_main, cache_startup, step_fetch, prompt, 6,
+            beam_size=beam, eos_id=29,
+            prefill=(wide_main, wide_fetch, 2, T))
+        np.testing.assert_array_equal(pf_ids, ref_ids)
+        np.testing.assert_allclose(pf_scores, ref_scores, rtol=1e-4,
+                                   atol=1e-5)
         np.testing.assert_allclose(out_scores, ref_scores, rtol=1e-4,
                                    atol=1e-5)
 
@@ -882,6 +893,14 @@ def test_gpt2_sample_generate_cached():
                                          step_fetch, prompt, 5, seed=0,
                                          top_k=1)
         np.testing.assert_array_equal(k1, greedy)  # top_k=1 == greedy
+
+        # chunked prefill: same logits -> bitwise-identical samples
+        wide_main, _, _, wide_fetch, _ = gpt2.gpt2_decode_step_program(
+            HP, batch=B, t_max=T, width=2)
+        a_pf = gpt2.sample_generate_cached(
+            exe, step_main, cache_startup, step_fetch, prompt, 5, seed=11,
+            top_k=5, top_p=0.9, prefill=(wide_main, wide_fetch, 2, T))
+        np.testing.assert_array_equal(a_pf, a)
 
 
 def test_transformer_sample_translate_cached():
