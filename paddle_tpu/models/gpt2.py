@@ -458,11 +458,22 @@ def _dispatch_prefill(exe, step_main, fetches, ids, prefill):
 
     wm, wf, width = prefill[0], prefill[1], int(prefill[2])
     t_max = probe_cache_len(wm, "gpt2")
+    step_t_max = probe_cache_len(step_main, "gpt2")
+    if t_max != step_t_max:
+        raise ValueError(
+            "prefill wide program cache length %d != the step program's "
+            "%d — both must address the SAME cache capacity or the "
+            "chunked writes land on wrong slots" % (t_max, step_t_max))
     if len(prefill) > 3 and int(prefill[3]) != t_max:
         raise ValueError(
             "prefill t_max %d does not match the wide program's cache "
             "length %d" % (int(prefill[3]), t_max))
-    wb = int(wm.global_block().var("step_ids").shape[0])
+    ids_var = wm.global_block().var("step_ids")
+    wb, ww = int(ids_var.shape[0]), int(ids_var.shape[1])
+    if ww != width:
+        raise ValueError(
+            "prefill width %d != the wide program's step_ids width %d"
+            % (width, ww))
     if wb != ids.shape[0]:
         raise ValueError(
             "prefill wide program batch %d != %d rows to prefill (beam "
