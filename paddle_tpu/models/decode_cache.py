@@ -8,7 +8,7 @@ from .. import layers
 
 __all__ = ["create_kv_caches", "add_cache_zero_fills", "probe_cache_len",
            "make_cache_reorder_program", "validate_cached_call",
-           "sample_from_logits"]
+           "sample_from_logits", "filtered_probs", "sample_rows"]
 
 
 def create_kv_caches(block, prefix, n_layer, batch, n_head, t_max, dh):
@@ -89,9 +89,10 @@ def validate_cached_call(step_main, prefix, ids_var, batch, prompt_len,
     return t_cache
 
 
-def sample_from_logits(logits, rng, temperature=1.0, top_k=0, top_p=1.0):
-    """Temperature / top-k / nucleus (top-p) filtered categorical sampling
-    shared by the gpt2 and transformer samplers.  logits [B, V] -> [B]."""
+def filtered_probs(logits, temperature=1.0, top_k=0, top_p=1.0):
+    """[B, V] -> the temperature / top-k / nucleus filtered probability
+    rows that sample_from_logits draws from (exposed separately for the
+    speculative-sampling accept/residual math)."""
     lg = np.asarray(logits, np.float64) / max(temperature, 1e-6)
     if top_k:
         k_eff = min(int(top_k), lg.shape[-1])  # top_k >= vocab: no-op
@@ -107,5 +108,17 @@ def sample_from_logits(logits, rng, temperature=1.0, top_k=0, top_p=1.0):
         np.put_along_axis(keep, order, keep_sorted, -1)
         probs = np.where(keep, probs, 0.0)
         probs /= probs.sum(-1, keepdims=True)
+    return probs
+
+
+def sample_rows(probs, rng):
+    """Categorical draw per row of a [B, V] probability matrix."""
     return np.array([rng.choice(probs.shape[-1], p=probs[i])
                      for i in range(probs.shape[0])], "int64")
+
+
+def sample_from_logits(logits, rng, temperature=1.0, top_k=0, top_p=1.0):
+    """Temperature / top-k / nucleus (top-p) filtered categorical sampling
+    shared by the gpt2 and transformer samplers.  logits [B, V] -> [B]."""
+    return sample_rows(
+        filtered_probs(logits, temperature, top_k, top_p), rng)

@@ -33,6 +33,7 @@ __all__ = [
     "gpt2_decode_step_program",
     "prefill_cached_chunked",
     "speculative_generate_cached",
+    "speculative_sample_generate_cached",
     "beam_generate",
     "make_fake_lm_batch",
 ]
@@ -313,33 +314,30 @@ def _prefill_cached(exe, step_main, fetches, ids):
     return logits
 
 
-def speculative_generate_cached(
+def _speculative_core(
         exe, tgt_step_main, tgt_cache_startup, tgt_step_fetch,
         tgt_wide_main, tgt_wide_fetch, spec_k,
         draft_step_main, draft_cache_startup, draft_step_fetch,
-        prompt_ids, max_new_tokens, draft_scope=None):
-    """Speculative GREEDY decoding: a cheap draft model proposes spec_k
-    tokens one-step-at-a-time, the target model scores all of them in
-    ONE width-spec_k chunked dispatch (gpt2_decode_step_program
-    width=spec_k — the verifier), and the longest agreeing prefix is
-    accepted plus the target's one bonus/correction token.  Output is
-    EXACTLY the target's own greedy_generate_cached sequence for any
-    draft — the draft only changes how many target dispatches it takes
-    (>= 1 + ceil(new/(k+1)) at full acceptance vs `new`).
+        prompt_ids, max_new_tokens, draft_scope,
+        target_pick, draft_pick, resolve_round):
+    """Shared speculative round machinery (greedy and sampling variants
+    plug in their token rules):
 
-    Rollback is free by construction: rejected draft tokens' K/V sit in
-    cache slots beyond the accepted position, which the <=pos
-    offset-causal masking never attends and later chunks overwrite
-    before first use (the same invariant chunked prefill relies on).
-    Beyond-reference (the reference era predates speculative decoding);
-    the standard TPU serving recipe for dispatch-bound decode.
+    - target_pick(logits [B, V]) -> [B] token (prefill / capacity-tail)
+    - draft_pick(logits [B, V]) -> ([B] token, aux) — aux rides to the
+      resolver (the sampling variant records the draft's filtered probs)
+    - resolve_round(wl [B, spec_k, V], drafts, aux) ->
+      (accepted token list, cur [B], j) — wl row i is the target
+      distribution at position pos+i+1 conditioned on chunk[:, :i+1];
+      j tokens were accepted, `cur` goes to position pos+j+1
 
-    draft_scope: the draft model's own fluid.Scope (separate weights +
-    caches); defaults to the CURRENT scope, i.e. a self-draft.  When the
-    cache has fewer than spec_k free slots left, the tail falls back to
-    plain one-token target steps (a fixed-width verify write near the
-    capacity edge would clamp and clobber valid slots).  Returns
-    (tokens [B, P+new], accept_stats dict)."""
+    Round shape: the draft proposes k = spec_k-1 tokens one-step-at-a-
+    time, ONE width-spec_k target dispatch scores anchor+drafts, the
+    resolver keeps the longest valid prefix.  Rollback is free by
+    construction: rejected tokens' K/V sit beyond the accepted position,
+    never attended (<=pos masking) and overwritten before first use.
+    Near cache capacity the tail falls back to one-token target steps (a
+    fixed-width verify write would clamp onto valid slots)."""
     from ..core.scope import global_scope
     from .decode_cache import probe_cache_len, validate_cached_call
 
@@ -348,11 +346,18 @@ def speculative_generate_cached(
     spec_k = int(spec_k)
     if spec_k < 2:
         raise ValueError(
-            "speculative_generate_cached: spec_k must be >= 2 (the wide "
-            "verify program needs width > 1; spec_k == 1 is just "
-            "greedy_generate_cached)")
+            "speculative decoding needs spec_k >= 2 (the wide verify "
+            "program needs width > 1; spec_k == 1 is just the plain "
+            "cached generator)")
     validate_cached_call(tgt_step_main, "gpt2", "step_ids", b, p,
                          max_new_tokens)
+    t_max = probe_cache_len(tgt_wide_main, "gpt2")
+    step_t_max = probe_cache_len(tgt_step_main, "gpt2")
+    if t_max != step_t_max:
+        raise ValueError(
+            "speculative decode: wide program cache length %d != step "
+            "program's %d — both must address the SAME cache"
+            % (t_max, step_t_max))
     draft_scope = draft_scope if draft_scope is not None else global_scope()
 
     def run_draft(main, feed, fetches):
@@ -362,12 +367,10 @@ def speculative_generate_cached(
     # prefill BOTH caches with the prompt; target via its wide program
     exe.run(tgt_cache_startup)
     run_draft(draft_cache_startup, {}, [])
-    t_max = probe_cache_len(tgt_wide_main, "gpt2")
     tgt_logits = prefill_cached_chunked(
         exe, tgt_wide_main, tgt_wide_fetch, prompt_ids, spec_k, t_max)
-    d_logits = None
     for t in range(p):
-        (d_logits,) = run_draft(
+        run_draft(
             draft_step_main,
             feed={"step_ids": prompt_ids[:, t:t + 1],
                   "pos": np.array([t], "int64")},
@@ -375,43 +378,41 @@ def speculative_generate_cached(
 
     out = [prompt_ids[:, i] for i in range(p)]
     # batch rows advance in lockstep on the SLOWEST row's acceptance —
-    # exactness first (every row's tokens match its own greedy chain)
-    cur = np.asarray(tgt_logits).argmax(-1).astype("int64")  # token @ p
-    pos = p  # next position to fill (cur goes there)
+    # every row's tokens stay valid under its own rule regardless
+    cur = target_pick(tgt_logits)  # token @ position p
+    pos = p
     proposals = accepted_total = rounds = 0
     while pos < p + max_new_tokens:
         out.append(cur)
         if pos + 1 >= p + max_new_tokens:
             break
         if pos + spec_k > t_max:
-            # capacity tail: a fixed-width verify write at pos would be
-            # clamped by dynamic_update_slice onto VALID earlier slots —
-            # finish with plain one-token target steps instead
+            # capacity tail: one-token target steps
             (tl,) = exe.run(
                 tgt_step_main,
                 feed={"step_ids": cur[:, None],
                       "pos": np.array([pos], "int64")},
                 fetch_list=tgt_step_fetch)
-            cur = np.asarray(tl).argmax(-1).astype("int64")
+            cur = target_pick(tl)
             pos += 1
             continue
         k = min(spec_k - 1, p + max_new_tokens - pos - 2)
-        # draft chain: re-sync on the accepted token, then propose k
-        drafts = []
-        (d_logits,) = run_draft(
+        drafts, aux = [], []
+        (dl,) = run_draft(
             draft_step_main,
             feed={"step_ids": cur[:, None], "pos": np.array([pos], "int64")},
             fetches=draft_step_fetch)
         for i in range(k):
-            nxt = np.asarray(d_logits).argmax(-1).astype("int64")
-            drafts.append(nxt)
-            (d_logits,) = run_draft(
+            tok, a = draft_pick(dl)
+            drafts.append(tok)
+            aux.append(a)
+            (dl,) = run_draft(
                 draft_step_main,
-                feed={"step_ids": nxt[:, None],
+                feed={"step_ids": tok[:, None],
                       "pos": np.array([pos + 1 + i], "int64")},
                 fetches=draft_step_fetch)
-        # ONE target dispatch verifies cur + the k draft tokens: row i
-        # predicts position pos+i+1
+        # ONE target dispatch scores cur + the k draft tokens: row i is
+        # the target distribution at position pos+i+1
         chunk = np.stack([cur] + drafts, axis=1)
         if chunk.shape[1] < spec_k:
             chunk = np.pad(chunk, ((0, 0), (0, spec_k - chunk.shape[1])))
@@ -423,17 +424,11 @@ def speculative_generate_cached(
                       np.arange(pos, pos + spec_k, dtype="int64"),
                       t_max - 1)},
             fetch_list=tgt_wide_fetch)
-        tgt_next = np.asarray(wl).argmax(-1).astype("int64")  # [B, spec_k]
         rounds += 1
         proposals += k
-        # longest prefix where every batch row's draft agrees with the
-        # target's greedy choice
-        j = 0
-        while j < k and bool((drafts[j] == tgt_next[:, j]).all()):
-            out.append(drafts[j])
-            j += 1
+        acc, cur, j = resolve_round(np.asarray(wl), drafts, aux)
+        out.extend(acc)
         accepted_total += j
-        cur = tgt_next[:, j]  # bonus (all accepted) or correction
         pos = pos + 1 + j
     tokens = np.stack(out, axis=1)[:, :p + max_new_tokens]
     stats = {
@@ -443,6 +438,112 @@ def speculative_generate_cached(
         "accept_rate": (accepted_total / proposals) if proposals else 1.0,
     }
     return tokens, stats
+
+
+def speculative_generate_cached(
+        exe, tgt_step_main, tgt_cache_startup, tgt_step_fetch,
+        tgt_wide_main, tgt_wide_fetch, spec_k,
+        draft_step_main, draft_cache_startup, draft_step_fetch,
+        prompt_ids, max_new_tokens, draft_scope=None):
+    """Speculative GREEDY decoding: the resolver keeps the longest
+    prefix where every batch row's draft equals the target's argmax,
+    then takes the target's bonus/correction token.  Output is EXACTLY
+    the target's own greedy_generate_cached sequence for any draft —
+    the draft only changes how many target dispatches it takes
+    (>= 1 + ceil(new/(k+1)) at full acceptance vs `new`).
+    Beyond-reference (the reference era predates speculative decoding);
+    the standard TPU serving recipe for dispatch-bound decode.
+    draft_scope: the draft model's own fluid.Scope (separate weights +
+    caches); defaults to the CURRENT scope (self-draft).  Returns
+    (tokens [B, P+new], accept_stats dict)."""
+
+    def target_pick(logits):
+        return np.asarray(logits).argmax(-1).astype("int64")
+
+    def draft_pick(logits):
+        return np.asarray(logits).argmax(-1).astype("int64"), None
+
+    def resolve(wl, drafts, aux):
+        tgt_next = wl.argmax(-1).astype("int64")  # [B, spec_k]
+        j, acc = 0, []
+        while j < len(drafts) and bool(
+                (drafts[j] == tgt_next[:, j]).all()):
+            acc.append(drafts[j])
+            j += 1
+        # bonus (all accepted) or correction (first mismatch)
+        return acc, tgt_next[:, j], j
+
+    return _speculative_core(
+        exe, tgt_step_main, tgt_cache_startup, tgt_step_fetch,
+        tgt_wide_main, tgt_wide_fetch, spec_k,
+        draft_step_main, draft_cache_startup, draft_step_fetch,
+        prompt_ids, max_new_tokens, draft_scope,
+        target_pick, draft_pick, resolve)
+
+
+def speculative_sample_generate_cached(
+        exe, tgt_step_main, tgt_cache_startup, tgt_step_fetch,
+        tgt_wide_main, tgt_wide_fetch, spec_k,
+        draft_step_main, draft_cache_startup, draft_step_fetch,
+        prompt_ids, max_new_tokens, temperature=1.0, top_k=0, top_p=1.0,
+        seed=None, draft_scope=None):
+    """Speculative SAMPLING (the rejection-sampling scheme): the draft
+    proposes d ~ p_d, accepted with prob min(1, p_t(d)/p_d(d)); on
+    rejection the token re-samples from normalize(max(p_t - p_d, 0)).
+    The output distribution is EXACTLY the target's filtered sampling
+    distribution (same temperature/top_k/top_p applied to both models'
+    logits) for ANY draft.  A round stops at the first index where ANY
+    batch row rejects — earlier acceptances stand (valid draws
+    regardless of other rows); at the stop index accepted rows keep
+    their draft token and rejected rows draw the residual.  Returns
+    (tokens [B, P+new], accept_stats dict)."""
+    from .decode_cache import filtered_probs, sample_rows
+
+    rng = np.random.RandomState(seed)
+    b = np.asarray(prompt_ids).shape[0]
+
+    def probs(logits):
+        return filtered_probs(logits, temperature, top_k, top_p)
+
+    def target_pick(logits):
+        return sample_rows(probs(logits), rng)
+
+    def draft_pick(logits):
+        pd = probs(logits)
+        return sample_rows(pd, rng), pd
+
+    def resolve(wl, drafts, aux):
+        j, acc = 0, []
+        while j < len(drafts):
+            pt = probs(wl[:, j])
+            pd = aux[j]
+            d = drafts[j]
+            ratio = (np.take_along_axis(pt, d[:, None], 1).reshape(-1)
+                     / np.maximum(
+                         np.take_along_axis(pd, d[:, None], 1).reshape(-1),
+                         1e-12))
+            reject = rng.rand(b) > ratio
+            if not reject.any():
+                acc.append(d)
+                j += 1
+                continue
+            # stop: rejected rows draw from normalize(max(pt - pd, 0));
+            # accepted rows keep d (a valid draw regardless of others)
+            resid = np.maximum(pt - pd, 0.0)
+            rs = resid.sum(-1, keepdims=True)
+            # pt == pd exactly -> empty residual; fall back to pt
+            resid = np.where(rs > 1e-12, resid / np.maximum(rs, 1e-12), pt)
+            repl = sample_rows(resid, rng)
+            return acc, np.where(reject, repl, d).astype("int64"), j
+        # every draft accepted: bonus from the target's last row
+        return acc, sample_rows(probs(wl[:, len(drafts)]), rng), j
+
+    return _speculative_core(
+        exe, tgt_step_main, tgt_cache_startup, tgt_step_fetch,
+        tgt_wide_main, tgt_wide_fetch, spec_k,
+        draft_step_main, draft_cache_startup, draft_step_fetch,
+        prompt_ids, max_new_tokens, draft_scope,
+        target_pick, draft_pick, resolve)
 
 
 def _dispatch_prefill(exe, step_main, fetches, ids, prefill):

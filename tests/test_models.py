@@ -1375,3 +1375,78 @@ def test_gpt2_speculative_decode_matches_greedy():
             exe, step_main, cache_startup, step_fetch,
             wide_main, wide_fetch, 1,
             c_step, c_cache_startup, c_step_fetch, prompt, 2)
+
+
+def test_gpt2_speculative_sampling_distribution_and_ceiling():
+    """Speculative SAMPLING: (a) with an unrelated draft, the sampled
+    next-token distribution matches plain target sampling (the
+    rejection-sampling scheme is distribution-exact); (b) a self-copy
+    draft accepts ~always."""
+    from paddle_tpu.models import gpt2
+
+    class HP(gpt2.GPT2Config):
+        vocab_size = 20
+        n_ctx = 8
+        d_model = 16
+        n_layer = 1
+        n_head = 2
+        dropout = 0.0
+
+    class DraftHP(HP):
+        d_model = 8
+
+    B, T, P, K = 400, 8, 2, 2
+    tgt_scope = fluid.Scope()
+    with fluid.scope_guard(tgt_scope):
+        full_main, full_startup, _, _ = gpt2.gpt2_logits_program(HP, seq_len=T)
+        step_main, cache_startup, _, step_fetch, _ = \
+            gpt2.gpt2_decode_step_program(HP, batch=B, t_max=T)
+        wide_main, _, _, wide_fetch, _ = gpt2.gpt2_decode_step_program(
+            HP, batch=B, t_max=T, width=K)
+        exe = fluid.Executor(fluid.CPUPlace())
+        full_startup.random_seed = 3
+        exe.run(full_startup)
+        prompt = np.tile(np.array([[3, 7]], "int64"), (B, 1))  # iid rows
+
+        draft_scope = fluid.Scope()
+        with fluid.scope_guard(draft_scope):
+            _, d_startup, _, _ = gpt2.gpt2_logits_program(DraftHP, seq_len=T)
+            d_step, d_cache_startup, _, d_step_fetch, _ = \
+                gpt2.gpt2_decode_step_program(DraftHP, batch=B, t_max=T)
+        exe.run(d_startup, scope=draft_scope)
+
+        spec_toks, stats = gpt2.speculative_sample_generate_cached(
+            exe, step_main, cache_startup, step_fetch,
+            wide_main, wide_fetch, K,
+            d_step, d_cache_startup, d_step_fetch,
+            prompt, 3, temperature=1.0, top_k=8, seed=5,
+            draft_scope=draft_scope)
+        plain_toks = gpt2.sample_generate_cached(
+            exe, step_main, cache_startup, step_fetch, prompt, 3,
+            temperature=1.0, top_k=8, seed=99)
+
+        # per-position marginal over the B iid rows: total-variation
+        # distance must be small (exact scheme; finite-sample noise only)
+        for t in range(P, P + 3):
+            h_spec = np.bincount(spec_toks[:, t], minlength=20) / B
+            h_plain = np.bincount(plain_toks[:, t], minlength=20) / B
+            tv = 0.5 * np.abs(h_spec - h_plain).sum()
+            assert tv < 0.15, (t, tv, h_spec, h_plain)
+        assert 0.0 <= stats["accept_rate"] <= 1.0
+
+        # self-copy draft: p_d == p_t (up to W=1-vs-W=K float noise) ->
+        # near-total acceptance
+        copy_scope = fluid.Scope()
+        with fluid.scope_guard(copy_scope):
+            _, c_startup, _, _ = gpt2.gpt2_logits_program(HP, seq_len=T)
+            c_step, c_cache_startup, _, c_step_fetch, _ = \
+                gpt2.gpt2_decode_step_program(HP, batch=B, t_max=T)
+        c_startup.random_seed = 3
+        fluid.Executor(fluid.CPUPlace()).run(c_startup, scope=copy_scope)
+        _, stats_c = gpt2.speculative_sample_generate_cached(
+            exe, step_main, cache_startup, step_fetch,
+            wide_main, wide_fetch, K,
+            c_step, c_cache_startup, c_step_fetch,
+            prompt, 3, temperature=1.0, top_k=8, seed=5,
+            draft_scope=copy_scope)
+    assert stats_c["accept_rate"] > 0.9, stats_c
