@@ -1450,3 +1450,68 @@ def test_gpt2_speculative_sampling_distribution_and_ceiling():
             prompt, 3, temperature=1.0, top_k=8, seed=5,
             draft_scope=copy_scope)
     assert stats_c["accept_rate"] > 0.9, stats_c
+
+
+def test_gpt2_speculative_trained_draft_high_acceptance():
+    """The real-world speculation economics: target AND a smaller draft
+    both trained on the same cyclic data — the draft proposes correctly,
+    acceptance is high, and target dispatches drop well below the token
+    count (while output still exactly equals the target's greedy
+    chain)."""
+    from paddle_tpu.models import gpt2
+
+    class HP(gpt2.GPT2Config):
+        vocab_size = 8
+        n_ctx = 24
+        d_model = 32
+        n_layer = 2
+        n_head = 4
+        dropout = 0.0
+
+    class DraftHP(HP):
+        d_model = 16
+        n_layer = 1
+        n_head = 2
+
+    period, B, T, K, NEW = 4, 2, 24, 4, 14
+    seq = np.arange(13) % period
+    batch = {
+        "ids": np.tile(seq[:-1], (4, 1)).astype("int64"),
+        "labels": np.tile(seq[1:], (4, 1)).astype("int64"),
+        "loss_weight": np.ones((4, 12), "float32"),
+    }
+
+    def train(hp, scope, steps):
+        with fluid.scope_guard(scope):
+            main, startup, _, fetches = gpt2.gpt2_lm_program(
+                hp, seq_len=12, lr=1e-2)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            for _ in range(steps):
+                exe.run(main, feed=batch, fetch_list=fetches)
+        return exe
+
+    tgt_scope, draft_scope = fluid.Scope(), fluid.Scope()
+    exe = train(HP, tgt_scope, 60)
+    train(DraftHP, draft_scope, 80)
+
+    with fluid.scope_guard(tgt_scope):
+        step_main, cache_startup, _, step_fetch, _ = \
+            gpt2.gpt2_decode_step_program(HP, batch=B, t_max=T)
+        wide_main, _, _, wide_fetch, _ = gpt2.gpt2_decode_step_program(
+            HP, batch=B, t_max=T, width=K)
+        with fluid.scope_guard(draft_scope):
+            d_step, d_cache_startup, _, d_step_fetch, _ = \
+                gpt2.gpt2_decode_step_program(DraftHP, batch=B, t_max=T)
+        prompt = np.tile(np.arange(5) % period, (B, 1)).astype("int64")
+        ref = gpt2.greedy_generate_cached(
+            exe, step_main, cache_startup, step_fetch, prompt, NEW)
+        out, stats = gpt2.speculative_generate_cached(
+            exe, step_main, cache_startup, step_fetch,
+            wide_main, wide_fetch, K,
+            d_step, d_cache_startup, d_step_fetch,
+            prompt, NEW, draft_scope=draft_scope)
+    np.testing.assert_array_equal(out, ref)
+    # both models learned the cycle: the draft's proposals are right
+    assert stats["accept_rate"] > 0.8, stats
+    assert stats["rounds"] <= (NEW + K - 1) // K + 1, stats
