@@ -14,7 +14,8 @@ This package replaces three reference subsystems with one mechanism
 
 from .mesh import make_mesh, default_mesh, mesh_axis_sizes
 from .sharding import (ShardingRules, data_parallel_rules,
-                       transformer_tp_rules, zero1_rules, zero3_rules)
+                       kv_cache_sp_rules, transformer_tp_rules,
+                       zero1_rules, zero3_rules)
 from .executor import DistributedExecutor
 from . import ring
 from . import ulysses

@@ -13,7 +13,7 @@ import re
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 __all__ = ["ShardingRules", "data_parallel_rules",
-           "transformer_tp_rules", "zero1_rules", "zero3_rules", "P"]
+           "transformer_tp_rules", "kv_cache_sp_rules", "zero1_rules", "zero3_rules", "P"]
 
 
 class ShardingRules:
@@ -71,6 +71,30 @@ def transformer_tp_rules(mp_axis="mp"):
     )
 
 
+def _stack_base(rules, base, inherit_default=True):
+    """Append `base`'s rules after `rules`' own (first match wins, so the
+    factory's patterns take precedence) and optionally adopt its
+    default.  zero3 keeps its OWN sharded default, hence the flag."""
+    if base is not None:
+        rules.rules = rules.rules + list(base.rules)
+        if inherit_default:
+            rules.default = base.default
+    return rules
+
+
+def kv_cache_sp_rules(sp_axis="sp", base=None):
+    """Distributed KV-cache serving: the decode step programs' per-layer
+    `*_{k,v}cache_*` persistables shard their TIME axis over `sp_axis`,
+    so a long-context cache that exceeds one chip's HBM spreads across
+    the mesh — XLA's SPMD partitioner inserts the attention-merge
+    collectives (GSPMD-first; no custom kernel).  Decode parity with the
+    unsharded cache is exact (tests/test_parallel.py).  Compose with
+    tensor parallelism via `base` (weights on mp, caches on sp)."""
+    return _stack_base(
+        ShardingRules([(r"_(k|v)cache_\d+$", P(None, None, sp_axis, None))]),
+        base)
+
+
 def zero3_rules(dp_axis="dp", base=None):
     """ZeRO stage-3 capability, declaratively: PARAMETERS (and their
     optimizer state, via the stacked zero1 rules) shard their leading dim
@@ -82,11 +106,10 @@ def zero3_rules(dp_axis="dp", base=None):
     """
     rules = zero1_rules(dp_axis)
     # params: anything not matching the accumulator patterns falls through
-    # to the default — shard dim 0 over dp (guards replicate misfits)
+    # to the default — shard dim 0 over dp (guards replicate misfits);
+    # the sharded default deliberately survives composition
     rules.default = P(dp_axis)
-    if base is not None:
-        rules.rules = rules.rules + list(base.rules)
-    return rules
+    return _stack_base(rules, base, inherit_default=False)
 
 
 def zero1_rules(dp_axis="dp", base=None):
@@ -109,9 +132,4 @@ def zero1_rules(dp_axis="dp", base=None):
          r"(_\d+)?$",
          P(dp_axis)),
     ]
-    rules = ShardingRules(state_pats)
-    if base is not None:
-        # base.rules entries are already (compiled_pattern, spec)
-        rules.rules = rules.rules + list(base.rules)
-        rules.default = base.default
-    return rules
+    return _stack_base(ShardingRules(state_pats), base)
