@@ -8,13 +8,17 @@ from .. import layers
 
 __all__ = ["create_kv_caches", "add_cache_zero_fills", "probe_cache_len",
            "make_cache_reorder_program", "validate_cached_call",
-           "sample_from_logits", "filtered_probs", "sample_rows"]
+           "probe_cache_dtype", "sample_from_logits", "filtered_probs",
+           "sample_rows"]
 
 
-def create_kv_caches(block, prefix, n_layer, batch, n_head, t_max, dh):
+def create_kv_caches(block, prefix, n_layer, batch, n_head, t_max, dh,
+                     dtype="float32"):
     """Create per-layer persistable [batch, n_head, t_max, dh] K/V cache
     vars named `<prefix>_{k,v}cache_<layer>`.  Returns (per-layer cache
-    dicts without 'pos', all names)."""
+    dicts without 'pos', all names).  dtype="bfloat16" halves decode's
+    dominant HBM tenant (seq_cache_write casts on write; attention
+    math promotes back to f32)."""
     caches, names = [], []
     for li in range(n_layer):
         cache = {}
@@ -22,13 +26,13 @@ def create_kv_caches(block, prefix, n_layer, batch, n_head, t_max, dh):
             cname = "%s_%scache_%d" % (prefix, nm, li)
             cache[nm] = block.create_var(
                 name=cname, shape=[batch, n_head, t_max, dh],
-                dtype="float32", persistable=True)
+                dtype=dtype, persistable=True)
             names.append(cname)
         caches.append(cache)
     return caches, names
 
 
-def add_cache_zero_fills(zero_program, named_shapes):
+def add_cache_zero_fills(zero_program, named_shapes, dtype="float32"):
     """Append fill_constant ops zeroing each (name, shape) persistable
     into `zero_program` (run it to reset decode state per generation)."""
     import paddle_tpu as fluid
@@ -37,9 +41,9 @@ def add_cache_zero_fills(zero_program, named_shapes):
         blk = zero_program.global_block()
         for cname, shape in named_shapes:
             layers.fill_constant(
-                list(shape), "float32", 0.0,
+                list(shape), dtype, 0.0,
                 out=blk.create_var(name=cname, shape=list(shape),
-                                   dtype="float32", persistable=True))
+                                   dtype=dtype, persistable=True))
 
 
 def probe_cache_len(step_main, prefix):
@@ -50,10 +54,21 @@ def probe_cache_len(step_main, prefix):
     raise ValueError("no %s_kcache_* vars in the step program" % prefix)
 
 
+def probe_cache_dtype(step_main, prefix):
+    """The declared cache dtype of a step program (programs sharing one
+    scope's cache vars must agree, or writes silently land in whichever
+    dtype the executed startup created)."""
+    for n, v in step_main.global_block().vars.items():
+        if n.startswith(prefix + "_kcache_"):
+            return str(v.dtype)
+    raise ValueError("no %s_kcache_* vars in the step program" % prefix)
+
+
 def make_cache_reorder_program(named_shapes, batch):
     """Program that gathers every named persistable cache along its batch
     axis by the fed `parents` [batch] row ids and assigns it back — the
-    beam-search cache-shuffling step (run with fetch_list=[])."""
+    beam-search cache-shuffling step (run with fetch_list=[]).
+    named_shapes entries: (name, shape) or (name, shape, dtype)."""
     import paddle_tpu as fluid
 
     prog = fluid.Program()
@@ -61,9 +76,11 @@ def make_cache_reorder_program(named_shapes, batch):
         parents = layers.data("parents", shape=[batch], dtype="int64",
                               append_batch_size=False)
         blk = prog.global_block()
-        for cname, shape in named_shapes:
+        for entry in named_shapes:
+            cname, shape = entry[0], entry[1]
+            dtype = entry[2] if len(entry) > 2 else "float32"
             cvar = blk.create_var(name=cname, shape=list(shape),
-                                  dtype="float32", persistable=True)
+                                  dtype=dtype, persistable=True)
             g = layers.gather(cvar, parents)
             blk.append_op("assign", inputs={"X": [g]},
                           outputs={"Out": [cvar]})

@@ -214,7 +214,8 @@ def gpt2_logits_program(hp=GPT2Config, seq_len=128):
     return main, startup, ["ids"], [logits]
 
 
-def gpt2_decode_step_program(hp=GPT2Config, batch=1, t_max=None, width=1):
+def gpt2_decode_step_program(hp=GPT2Config, batch=1, t_max=None, width=1,
+                             cache_dtype="float32"):
     """KV-cached decode step (the incremental-decoding engine the
     reference's beam-search cache plumbing approximates):
 
@@ -224,6 +225,8 @@ def gpt2_decode_step_program(hp=GPT2Config, batch=1, t_max=None, width=1):
                 [B, W, vocab] (W > 1; row i predicts position pos+i+1)
         state:  per-layer kcache/vcache [B, H, T_max, Dh] persistable vars
 
+    cache_dtype="bfloat16" halves decode's dominant HBM tenant (writes
+    cast in seq_cache_write; attention math promotes back to f32).
     width == 1 is the classic one-token step: O(T_max * d) per token.
     width > 1 is the CHUNKED step (prefill / speculative verify): one
     dispatch writes W cache slots and scores W positions with
@@ -282,10 +285,12 @@ def gpt2_decode_step_program(hp=GPT2Config, batch=1, t_max=None, width=1):
         blk = main.global_block()
         n_kv = getattr(hp, "n_kv_head", None) or hp.n_head
         kv_caches, cache_names = create_kv_caches(
-            blk, "gpt2", hp.n_layer, batch, n_kv, t_max, dh)
+            blk, "gpt2", hp.n_layer, batch, n_kv, t_max, dh,
+            dtype=cache_dtype)
         add_cache_zero_fills(
             cache_startup,
-            [(n, (batch, n_kv, t_max, dh)) for n in cache_names])
+            [(n, (batch, n_kv, t_max, dh)) for n in cache_names],
+            dtype=cache_dtype)
         for cache in kv_caches:
             cache["pos"] = pos
             if pos_vec is not None:
@@ -358,6 +363,15 @@ def _speculative_core(
             "speculative decode: wide program cache length %d != step "
             "program's %d — both must address the SAME cache"
             % (t_max, step_t_max))
+    from .decode_cache import probe_cache_dtype
+
+    wd = probe_cache_dtype(tgt_wide_main, "gpt2")
+    sd = probe_cache_dtype(tgt_step_main, "gpt2")
+    if wd != sd:
+        raise ValueError(
+            "speculative decode: wide program cache dtype %s != step "
+            "program's %s — build both with the same cache_dtype"
+            % (wd, sd))
     draft_scope = draft_scope if draft_scope is not None else global_scope()
 
     def run_draft(main, feed, fetches):
@@ -557,6 +571,8 @@ def _dispatch_prefill(exe, step_main, fetches, ids, prefill):
         return _prefill_cached(exe, step_main, fetches, ids)
     from .decode_cache import probe_cache_len
 
+    from .decode_cache import probe_cache_dtype
+
     wm, wf, width = prefill[0], prefill[1], int(prefill[2])
     t_max = probe_cache_len(wm, "gpt2")
     step_t_max = probe_cache_len(step_main, "gpt2")
@@ -565,6 +581,12 @@ def _dispatch_prefill(exe, step_main, fetches, ids, prefill):
             "prefill wide program cache length %d != the step program's "
             "%d — both must address the SAME cache capacity or the "
             "chunked writes land on wrong slots" % (t_max, step_t_max))
+    wd, sd = probe_cache_dtype(wm, "gpt2"), probe_cache_dtype(step_main,
+                                                             "gpt2")
+    if wd != sd:
+        raise ValueError(
+            "prefill wide program cache dtype %s != the step program's "
+            "%s — build both with the same cache_dtype" % (wd, sd))
     if len(prefill) > 3 and int(prefill[3]) != t_max:
         raise ValueError(
             "prefill t_max %d does not match the wide program's cache "
@@ -723,7 +745,7 @@ def beam_generate_cached(exe, step_main, cache_startup, fetches, prompt_ids,
     sb = step_main.global_block()
     r = b * beam_size
     cache_shapes = [
-        (n, v.shape) for n, v in sb.vars.items()
+        (n, v.shape, v.dtype) for n, v in sb.vars.items()
         if n.startswith(("gpt2_kcache_", "gpt2_vcache_"))
     ]
     reorder = make_cache_reorder_program(cache_shapes, r)

@@ -1515,3 +1515,57 @@ def test_gpt2_speculative_trained_draft_high_acceptance():
     # both models learned the cycle: the draft's proposals are right
     assert stats["accept_rate"] > 0.8, stats
     assert stats["rounds"] <= (NEW + K - 1) // K + 1, stats
+
+
+def test_gpt2_bf16_kv_cache_decode_matches_f32():
+    """cache_dtype="bfloat16": the decode caches (decode's dominant HBM
+    tenant) store bf16 — on a trained (peaky) model the generated tokens
+    match the f32-cache chain exactly, and the scope really holds bf16."""
+    from paddle_tpu.models import gpt2
+
+    class HP(gpt2.GPT2Config):
+        vocab_size = 8
+        n_ctx = 16
+        d_model = 32
+        n_layer = 2
+        n_head = 4
+        dropout = 0.0
+
+    period, B = 4, 2
+    main, startup, _, fetches = gpt2.gpt2_lm_program(HP, seq_len=12, lr=1e-2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    seq = np.arange(13) % period
+    batch = {
+        "ids": np.tile(seq[:-1], (4, 1)).astype("int64"),
+        "labels": np.tile(seq[1:], (4, 1)).astype("int64"),
+        "loss_weight": np.ones((4, 12), "float32"),
+    }
+    for _ in range(60):
+        exe.run(main, feed=batch, fetch_list=fetches)
+
+    prompt = np.tile(np.arange(5) % period, (B, 1)).astype("int64")
+    outs = {}
+    for dt in ("float32", "bfloat16"):
+        step_main, cache_startup, _, step_fetch, _ = \
+            gpt2.gpt2_decode_step_program(HP, batch=B, t_max=16,
+                                          cache_dtype=dt)
+        outs[dt] = gpt2.greedy_generate_cached(
+            exe, step_main, cache_startup, step_fetch, prompt, 8)
+        if dt == "bfloat16":
+            kc = np.asarray(fluid.global_scope().find_var("gpt2_kcache_0"))
+            assert str(kc.dtype) == "bfloat16", kc.dtype
+    np.testing.assert_array_equal(outs["bfloat16"], outs["float32"])
+    expect = np.arange(13) % period
+    np.testing.assert_array_equal(outs["float32"][0], expect)
+
+    # bf16 cache through BEAM search exercises the dtype-aware cache
+    # reorder program (gather/assign on bfloat16 persistables)
+    beam_step, beam_cache_startup, _, beam_fetch, _ = \
+        gpt2.gpt2_decode_step_program(HP, batch=B * 2, t_max=16,
+                                      cache_dtype="bfloat16")
+    bids, bscores = gpt2.beam_generate_cached(
+        exe, beam_step, beam_cache_startup, beam_fetch, prompt, 6,
+        beam_size=2)
+    np.testing.assert_array_equal(bids[0, :11], expect[:11])
+    assert np.isfinite(bscores).all()
