@@ -328,6 +328,11 @@ def _bench_impl():
                      "deepfm", "gpt2_345m"):
             try:
                 result["models"][name] = _model_bench(name, on_tpu, device)
+                # incremental record: a timeout-killed run must not lose
+                # the models already measured (stderr lands in the
+                # watcher log even when the final JSON line never prints)
+                sys.stderr.write("MODEL_RESULT %s %s\n" % (
+                    name, json.dumps(result["models"][name])))
             except Exception as e:
                 sys.stderr.write("%s bench failed: %r\n" % (name, e))
                 result["models"][name] = {"error": repr(e)[:200]}
@@ -593,6 +598,8 @@ def _decode_bench(on_tpu, device):
             out[name] = {"value": round(B * new / dt, 1),
                          "unit": "new tokens/sec"
                          + ("" if on_tpu else " (cpufallback)")}
+            sys.stderr.write("DECODE_RESULT %s %s\n" % (
+                name, json.dumps(out[name])))
 
         # prefill-dominated workload: long prompt, few new tokens — the
         # W-wide chunked prefill collapses P dispatches into ceil(P/W)
@@ -622,6 +629,8 @@ def _decode_bench(on_tpu, device):
                 + ("" if on_tpu else " (cpufallback)"),
                 "prefill_width": Wp if pf else 1,
             }
+            sys.stderr.write("DECODE_RESULT %s %s\n" % (
+                name, json.dumps(out[name])))
 
         # speculative decode CEILING: a self-copy draft accepts every
         # proposal (same weights), so this measures the best-case
