@@ -1569,3 +1569,46 @@ def test_gpt2_bf16_kv_cache_decode_matches_f32():
         beam_size=2)
     np.testing.assert_array_equal(bids[0, :11], expect[:11])
     assert np.isfinite(bscores).all()
+
+
+@pytest.mark.slow
+def test_gpt2_chunked_prefill_randomized_sweep():
+    """Property sweep: random (t_max, prompt, width, new) combinations —
+    chunked prefill must equal the one-token chain for EVERY legal
+    geometry (pad chunks, re-anchored overlaps, width > prompt, budget
+    to the last cache slot)."""
+    from paddle_tpu.models import gpt2
+
+    class HP(gpt2.GPT2Config):
+        vocab_size = 40
+        n_ctx = 32
+        d_model = 16
+        n_layer = 1
+        n_head = 2
+        dropout = 0.0
+
+    rng = np.random.RandomState(123)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        _, full_startup, _, _ = gpt2.gpt2_logits_program(HP, seq_len=32)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(full_startup)
+        for trial in range(6):
+            T = int(rng.choice([8, 12, 16, 32]))
+            P = int(rng.randint(1, T - 1))
+            W = int(rng.randint(2, min(T, 7)))
+            new = int(rng.randint(1, T + 1 - P)) + 1
+            new = min(new, T + 1 - P)
+            B = 2
+            step_main, cache_startup, _, step_fetch, _ = \
+                gpt2.gpt2_decode_step_program(HP, batch=B, t_max=T)
+            wide_main, _, _, wide_fetch, _ = gpt2.gpt2_decode_step_program(
+                HP, batch=B, t_max=T, width=W)
+            prompt = rng.randint(1, 40, (B, P)).astype("int64")
+            ref = gpt2.greedy_generate_cached(
+                exe, step_main, cache_startup, step_fetch, prompt, new)
+            got = gpt2.greedy_generate_cached(
+                exe, step_main, cache_startup, step_fetch, prompt, new,
+                prefill=(wide_main, wide_fetch, W))
+            np.testing.assert_array_equal(
+                got, ref, err_msg="T=%d P=%d W=%d new=%d" % (T, P, W, new))
