@@ -60,6 +60,11 @@ def build_sparse_model(distributed):
 def gen_sparse_data(n=16):
     rng = np.random.RandomState(5)
     ids = rng.randint(0, 20, (n, 1)).astype("int64")
+    if os.environ.get("DIST_SPARSE_IDS") == "even":
+        # every id lands on pserver 0 (id % 2 == 0): shard 1 sees
+        # ROWLESS rounds only — the adam beta-pow / momentum-decay
+        # advance-on-empty path end to end
+        ids = (ids // 2) * 2
     y = (ids.astype("float32") / 10.0) - 1.0
     return ids, y
 

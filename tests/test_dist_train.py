@@ -185,6 +185,19 @@ def test_dist_sparse_lookup_adam_decay_matches_local():
     np.testing.assert_allclose(dist, local, rtol=2e-4, atol=1e-5)
 
 
+@pytest.mark.slow
+def test_dist_sparse_adam_skewed_shard_matches_local():
+    """Code-review r5 E2E: ids chosen so EVERY row hashes to pserver 0 —
+    pserver 1's shard sees only rowless rounds, whose adam beta pows
+    must still advance in lockstep with the local run (the stall the
+    per-round advance exists to prevent)."""
+    env = {"DIST_MODEL": "sparse", "DIST_OPTIMIZER": "adam_decay",
+           "DIST_SPARSE_IDS": "even"}
+    local = _local_losses(steps=6, extra_env=env)
+    (dist,) = _run_cluster(1, sync=True, steps=6, extra_env=env)
+    np.testing.assert_allclose(dist, local, rtol=2e-4, atol=1e-5)
+
+
 _NCCL2_RUNNER = os.path.join(_DIR, "dist_nccl2.py")
 
 

@@ -1593,12 +1593,21 @@ def test_gpt2_chunked_prefill_randomized_sweep():
         _, full_startup, _, _ = gpt2.gpt2_logits_program(HP, seq_len=32)
         exe = fluid.Executor(fluid.CPUPlace())
         exe.run(full_startup)
-        for trial in range(6):
-            T = int(rng.choice([8, 12, 16, 32]))
-            P = int(rng.randint(1, T - 1))
-            W = int(rng.randint(2, min(T, 7)))
-            new = int(rng.randint(1, T + 1 - P)) + 1
-            new = min(new, T + 1 - P)
+        # the claimed edge cases FORCED deterministically, then random
+        geoms = [
+            (16, 5, 7, 3),    # width > prompt (single padded chunk)
+            (10, 9, 4, 2),    # re-anchored overlap (8 + 4 > 10)
+            (16, 4, 4, 13),   # budget to the last slot: P + new == T + 1
+        ]
+        geoms += [None] * 4
+        for geom in geoms:
+            if geom is not None:
+                T, P, W, new = geom
+            else:
+                T = int(rng.choice([8, 12, 16, 32]))
+                P = int(rng.randint(1, T - 1))
+                W = int(rng.randint(2, min(T, 7)))
+                new = int(rng.randint(2, T + 2 - P))
             B = 2
             step_main, cache_startup, _, step_fetch, _ = \
                 gpt2.gpt2_decode_step_program(HP, batch=B, t_max=T)
