@@ -684,18 +684,27 @@ def _dist_smokes():
     env.update({"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
                 "DIST_STEPS": str(steps)})
     out = {}
+    pserver_cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+                   "--mode", "pserver", "--nproc", "2",
+                   "--pservers", "2", "tests/dist_mlp.py"]
     legs = {
-        "pserver_2x2": [sys.executable, "-m", "paddle_tpu.distributed.launch",
-                        "--mode", "pserver", "--nproc", "2",
-                        "--pservers", "2", "tests/dist_mlp.py"],
-        "collective_2": [sys.executable, "-m", "paddle_tpu.distributed.launch",
-                         "--nproc", "2", "tests/launch_worker.py"],
+        "pserver_2x2": (pserver_cmd, {"DIST_MODEL": ""}),
+        # distributed lookup table: prefetch + sparse-update RPC path
+        "pserver_sparse_2x2": (pserver_cmd, {"DIST_MODEL": "sparse"}),
+        "collective_2": ([sys.executable, "-m",
+                          "paddle_tpu.distributed.launch",
+                          "--nproc", "2", "tests/launch_worker.py"], {}),
     }
-    for name, cmd in legs.items():
+    for name, (cmd, overrides) in legs.items():
         t0 = _t.time()
+        leg_env = dict(env)
+        # stray shell vars must not silently flip a leg's model
+        for k in ("DIST_MODEL", "DIST_SPARSE_IDS", "DIST_OPTIMIZER"):
+            leg_env.pop(k, None)
+        leg_env.update({k: v for k, v in overrides.items() if v})
         try:
             proc = subprocess.run(
-                cmd, cwd=here, env=env, timeout=600,
+                cmd, cwd=here, env=leg_env, timeout=600,
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             )
             dt = _t.time() - t0
