@@ -8,8 +8,8 @@ from .. import layers
 
 __all__ = ["create_kv_caches", "add_cache_zero_fills", "probe_cache_len",
            "make_cache_reorder_program", "validate_cached_call",
-           "probe_cache_dtype", "sample_from_logits", "filtered_probs",
-           "sample_rows"]
+           "probe_cache_dtype", "run_chunked_ids", "sample_from_logits",
+           "filtered_probs", "sample_rows"]
 
 
 def create_kv_caches(block, prefix, n_layer, batch, n_head, t_max, dh,
@@ -104,6 +104,32 @@ def validate_cached_call(step_main, prefix, ids_var, batch, prompt_len,
         "prompt %d + new %d exceeds cache length %d"
         % (prompt_len, new_tokens, t_cache))
     return t_cache
+
+
+def run_chunked_ids(exe, main, fetches, ids, width, t_max, ids_feed,
+                    has_pos_vec):
+    """Shared chunk driver for the width-W cached programs (gpt2 prefill
+    and seq2seq force-decode): yields (c0, chunk_logits) per dispatch.
+    The last chunk re-anchors to t_max - W when it would write past the
+    cache (rewriting identical slots is idempotent) and short chunks
+    zero-pad (pad rows' K/V land in slots overwritten before first
+    attention; pad output rows are the caller's to ignore)."""
+    ids = np.asarray(ids, "int64")
+    _b, T = ids.shape
+    width = int(width)
+    starts = list(range(0, T, width)) or [0]
+    if starts[-1] + width > t_max:
+        starts[-1] = max(0, t_max - width)
+    for c0 in starts:
+        chunk = ids[:, c0:c0 + width]
+        if chunk.shape[1] < width:
+            chunk = np.pad(chunk, ((0, 0), (0, width - chunk.shape[1])))
+        feed = {ids_feed: chunk, "pos": np.array([c0], "int64")}
+        if has_pos_vec:
+            feed["pos_vec"] = np.minimum(
+                np.arange(c0, c0 + width, dtype="int64"), t_max - 1)
+        (lg,) = exe.run(main, feed=feed, fetch_list=fetches)
+        yield c0, np.asarray(lg)
 
 
 def filtered_probs(logits, temperature=1.0, top_k=0, top_p=1.0):

@@ -614,29 +614,16 @@ def prefill_cached_chunked(exe, wide_main, wide_fetches, ids, width,
     write past the cache (rewriting earlier slots with the same tokens
     is idempotent); pad rows beyond the prompt land in slots the
     generation loop overwrites before ever attending them."""
+    from .decode_cache import run_chunked_ids
+
     ids = np.asarray(ids, "int64")
-    b, p = ids.shape
-    width = int(width)
-    starts = list(range(0, p, width)) or [0]
-    if starts[-1] + width > t_max:
-        starts[-1] = max(0, t_max - width)
+    _b, p = ids.shape
     logits = last_c0 = None
-    for c0 in starts:
-        chunk = ids[:, c0:c0 + width]
-        if chunk.shape[1] < width:
-            chunk = np.pad(chunk, ((0, 0), (0, width - chunk.shape[1])))
-        (logits,) = exe.run(
-            wide_main,
-            feed={
-                "step_ids": chunk,
-                "pos": np.array([c0], "int64"),
-                "pos_vec": np.minimum(
-                    np.arange(c0, c0 + width, dtype="int64"), t_max - 1),
-            },
-            fetch_list=wide_fetches,
-        )
-        last_c0 = c0
-    return np.asarray(logits)[:, (p - 1) - last_c0]
+    for c0, lg in run_chunked_ids(exe, wide_main, wide_fetches, ids,
+                                  width, t_max, "step_ids",
+                                  has_pos_vec=True):
+        logits, last_c0 = lg, c0
+    return logits[:, (p - 1) - last_c0]
 
 
 def greedy_generate_cached(exe, step_main, cache_startup, fetches,

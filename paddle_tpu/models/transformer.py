@@ -31,6 +31,7 @@ __all__ = [
     "beam_translate_cached",
     "sample_translate_cached",
     "transformer_decode_programs",
+    "force_decode_logits_cached",
     "beam_translate",
 ]
 
@@ -609,7 +610,7 @@ def beam_translate(exe, main, fetches, src_ids, src_lens, bos_id, eos_id,
 
 
 def transformer_decode_programs(hp=ModelHyperParams, batch=1, src_len=64,
-                                t_max=None):
+                                t_max=None, width=1):
     """KV-cached seq2seq decoding, split into two programs sharing
     persistable state (and weight names with wmt_transformer_program /
     transformer_logits_program built in the same process):
@@ -617,20 +618,27 @@ def transformer_decode_programs(hp=ModelHyperParams, batch=1, src_len=64,
       enc_main:  feeds src_word [B, Ts] + src_slf_attn_bias [B,1,1,Ts];
                  runs the encoder ONCE, persisting enc_out and the
                  cross-attention key-padding row as scope state.
-      step_main: feeds trg_tok [B, 1] + pos [1]; one cached decoder step
-                 (self-attention over per-layer K/V caches, one-token
-                 cross-attention over the persisted enc_out);
-                 fetches next-token logits [B, trg_vocab].
+      step_main: feeds trg_tok [B, W] + pos [1] (+ pos_vec [W] when
+                 width W > 1); one cached decoder step (self-attention
+                 over per-layer K/V caches — offset-causal for W > 1 —
+                 and W-query cross-attention over the persisted
+                 enc_out); fetches logits [B, trg_vocab] (W == 1) or
+                 [B, W, trg_vocab].
       cache_startup: zeroes all the persistable decode state.
 
     Per generated token this is O((t_max + src_len) d) work instead of
-    the full re-decode's O(t_max^2 d).  Returns (enc_main, step_main,
-    cache_startup, enc_feeds, step_feeds, enc_fetch, step_fetch)."""
+    the full re-decode's O(t_max^2 d); width > 1 scores W known target
+    positions per dispatch — the candidate-RESCORING workhorse (force-
+    decode a hypothesis in ceil(T/W) MXU-shaped dispatches).  Returns
+    (enc_main, step_main, cache_startup, enc_feeds, step_feeds,
+    enc_fetch, step_fetch)."""
     import paddle_tpu as fluid
 
     t_max = t_max or hp.max_length
     assert t_max <= hp.max_length, (
         "t_max %d exceeds hp.max_length %d" % (t_max, hp.max_length))
+    width = int(width)
+    assert 1 <= width <= t_max, (width, t_max)
     dh = hp.d_model // hp.n_head
     enc_main = fluid.Program()
     step_main = fluid.Program()
@@ -666,16 +674,21 @@ def transformer_decode_programs(hp=ModelHyperParams, batch=1, src_len=64,
 
         # ---- decode-step program (names continue: trg emb + dec layers) --
         with fluid.program_guard(step_main, throwaway):
-            tok = layers.data("trg_tok", shape=[batch, 1], dtype="int64",
+            tok = layers.data("trg_tok", shape=[batch, width], dtype="int64",
                               append_batch_size=False)
             pos = layers.data("pos", shape=[1], dtype="int64",
                               append_batch_size=False)
+            pos_vec = None
+            if width > 1:
+                pos_vec = layers.data("pos_vec", shape=[width],
+                                      dtype="int64",
+                                      append_batch_size=False)
             word = layers.embedding(
                 tok, size=[hp.trg_vocab_size, hp.d_model],
                 param_attr=ParamAttr(initializer=Normal(0.0, hp.d_model ** -0.5)),
-            )  # [B, D] (T=1 squeezes in the lookup)
+            )  # [B, W, D] (W == 1 squeezes in the lookup)
             word = layers.scale(
-                layers.reshape(word, shape=[batch, 1, hp.d_model]),
+                layers.reshape(word, shape=[batch, width, hp.d_model]),
                 scale=hp.d_model ** 0.5)
             pos_table = layers.create_parameter(
                 shape=[hp.max_length, hp.d_model], dtype="float32",
@@ -685,9 +698,13 @@ def transformer_decode_programs(hp=ModelHyperParams, batch=1, src_len=64,
                     initializer=_NumpyInit(
                         _pos_encoding_table(hp.max_length, hp.d_model))),
             )
-            pos_row = layers.reshape(layers.gather(pos_table, pos),
-                                     shape=[1, 1, hp.d_model])
-            y = layers.elementwise_add(word, pos_row)
+            if width == 1:
+                pos_row = layers.reshape(layers.gather(pos_table, pos),
+                                         shape=[1, 1, hp.d_model])
+                y = layers.elementwise_add(word, pos_row)
+            else:
+                pos_rows = layers.gather(pos_table, pos_vec)  # [W, D]
+                y = layers.elementwise_add(word, pos_rows, axis=1)
             sb = step_main.global_block()
             enc_ref = sb.create_var(
                 name="tfm_enc_out_cache", shape=[batch, src_len, hp.d_model],
@@ -703,11 +720,15 @@ def transformer_decode_programs(hp=ModelHyperParams, batch=1, src_len=64,
             cache_names += kv_names
             for cache in kv_caches:
                 cache["pos"] = pos
+                if pos_vec is not None:
+                    cache["pos_vec"] = pos_vec
                 y = decoder_layer(y, enc_ref, None, None, hp, is_test=True,
                                   cross_kpad=kpad_ref, cache=cache)
             logits = layers.fc(y, size=hp.trg_vocab_size, num_flatten_dims=2,
                                bias_attr=False, param_attr=_pa("softmax_out.w"))
-            logits = layers.reshape(logits, shape=[batch, hp.trg_vocab_size])
+            if width == 1:
+                logits = layers.reshape(logits,
+                                        shape=[batch, hp.trg_vocab_size])
 
         # ---- cache zeroing program --------------------------------------
         from .decode_cache import add_cache_zero_fills
@@ -718,9 +739,54 @@ def transformer_decode_programs(hp=ModelHyperParams, batch=1, src_len=64,
                      ).shape)
             for cname in cache_names])
 
+    step_feeds = ["trg_tok", "pos"] + (["pos_vec"] if width > 1 else [])
     return (enc_main, step_main, cache_startup,
-            ["src_word", "src_slf_attn_bias"], ["trg_tok", "pos"],
+            ["src_word", "src_slf_attn_bias"], step_feeds,
             ["tfm_enc_out_cache"], [logits])
+
+
+def force_decode_logits_cached(exe, programs, src_ids, src_lens, trg_ids):
+    """Teacher-forced scoring through the cached decoder: run the
+    encoder once, then feed the GIVEN target tokens in ceil(T/W)
+    width-W dispatches (programs from transformer_decode_programs
+    (width=W)); returns [B, T, V] logits where row t is the
+    next-token distribution after trg_ids[:, t] — the candidate-
+    RESCORING workhorse (log-prob of a hypothesis without a token
+    loop).  The last chunk re-anchors inside the cache bound
+    (rewriting identical slots is idempotent)."""
+    from .decode_cache import probe_cache_len
+
+    (enc_main, step_main, cache_startup, _enc_feeds, step_feeds,
+     _enc_fetch, step_fetch) = programs
+    src_ids = np.asarray(src_ids, "int64")
+    trg_ids = np.asarray(trg_ids, "int64")
+    b, T = trg_ids.shape
+    sb = step_main.global_block()
+    step_b, width = (int(sb.vars["trg_tok"].shape[0]),
+                     int(sb.vars["trg_tok"].shape[1]))
+    assert b == step_b, (b, step_b)
+    t_max = probe_cache_len(step_main, "tfm")
+    assert T <= t_max, (T, t_max)
+    src_lens = np.asarray(src_lens).reshape(-1)
+
+    exe.run(cache_startup)
+    exe.run(enc_main, feed={
+        "src_word": src_ids,
+        "src_slf_attn_bias": pad_bias(src_lens, src_ids.shape[1]),
+    }, fetch_list=[])
+
+    from .decode_cache import run_chunked_ids
+
+    out = None
+    for c0, lg in run_chunked_ids(exe, step_main, step_fetch, trg_ids,
+                                  width, t_max, "trg_tok",
+                                  has_pos_vec="pos_vec" in step_feeds):
+        lg = lg.reshape(b, width, -1)
+        if out is None:
+            out = np.zeros((b, T, lg.shape[-1]), lg.dtype)
+        hi = min(c0 + width, T)
+        out[:, c0:hi] = lg[:, :hi - c0]
+    return out
 
 
 def _translate_cached_loop(exe, programs, src_ids, src_lens, bos_id,

@@ -1621,3 +1621,59 @@ def test_gpt2_chunked_prefill_randomized_sweep():
                 prefill=(wide_main, wide_fetch, W))
             np.testing.assert_array_equal(
                 got, ref, err_msg="T=%d P=%d W=%d new=%d" % (T, P, W, new))
+
+
+def test_transformer_wide_decode_rescoring_matches_stepwise():
+    """transformer_decode_programs(width=W): teacher-forced chunked
+    scoring (force_decode_logits_cached) returns per-position logits
+    identical to one-token cached stepping — seq2seq candidate
+    rescoring in ceil(T/W) dispatches, incl. the padded-final-chunk and
+    re-anchored cases."""
+    from paddle_tpu.models import transformer as tfm
+
+    class HP(tfm.ModelHyperParams):
+        src_vocab_size = 30
+        trg_vocab_size = 30
+        max_length = 12
+        d_model = 16
+        d_inner_hid = 32
+        n_head = 2
+        n_layer = 2
+        dropout = 0.0
+
+    B, Ts, T = 2, 6, 10
+    # (W, t_max): T == t_max re-anchors the last chunk; t_max > T pads it
+    for W, t_max in ((3, T), (4, T), (4, 12)):
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            full_main, full_startup, _, _ = tfm.transformer_logits_program(
+                HP, src_len=Ts, trg_len=t_max)
+            step_prog = tfm.transformer_decode_programs(
+                HP, batch=B, src_len=Ts, t_max=t_max)
+            wide_prog = tfm.transformer_decode_programs(
+                HP, batch=B, src_len=Ts, t_max=t_max, width=W)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(full_startup)
+            rng = np.random.RandomState(4)
+            src = rng.randint(1, 30, (B, Ts)).astype("int64")
+            src_lens = np.array([Ts, Ts - 2], "int64")
+            trg = rng.randint(1, 30, (B, T)).astype("int64")
+
+            got = tfm.force_decode_logits_cached(
+                exe, wide_prog, src, src_lens, trg)
+
+            # one-token reference through the SAME cached machinery
+            (enc_main, step_main, cache_startup, _, _, _, step_fetch) = \
+                step_prog
+            exe.run(cache_startup)
+            exe.run(enc_main, feed={
+                "src_word": src,
+                "src_slf_attn_bias": tfm.pad_bias(src_lens, Ts),
+            }, fetch_list=[])
+            for t in range(T):
+                (lg,) = exe.run(step_main, feed={
+                    "trg_tok": trg[:, t:t + 1],
+                    "pos": np.array([t], "int64")}, fetch_list=step_fetch)
+                np.testing.assert_allclose(
+                    got[:, t], np.asarray(lg), rtol=2e-4, atol=2e-5,
+                    err_msg="W=%d t=%d" % (W, t))
