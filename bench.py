@@ -695,28 +695,48 @@ def _dist_smokes():
                           "paddle_tpu.distributed.launch",
                           "--nproc", "2", "tests/launch_worker.py"], {}),
     }
+    # VERDICT weak #5: one-shot wall-clock on a noisy localhost made the
+    # pserver legs unreproducible — pin the step count, run N repeats,
+    # report the MEDIAN with the spread so a regression is a signal, not
+    # a coin flip
+    repeats = max(1, int(os.environ.get("BENCH_DIST_REPEATS", "3")))
     for name, (cmd, overrides) in legs.items():
-        t0 = _t.time()
         leg_env = dict(env)
         # stray shell vars must not silently flip a leg's model
         for k in ("DIST_MODEL", "DIST_SPARSE_IDS", "DIST_OPTIMIZER"):
             leg_env.pop(k, None)
         leg_env.update({k: v for k, v in overrides.items() if v})
-        try:
-            proc = subprocess.run(
-                cmd, cwd=here, env=leg_env, timeout=600,
-                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            )
-            dt = _t.time() - t0
-            if proc.returncode != 0:
-                out[name] = {"error": "rc=%d: %s" % (
-                    proc.returncode,
-                    proc.stdout[-300:].decode("utf-8", "replace"))}
-            else:
-                out[name] = {"value": round(steps / dt, 3),
-                             "unit": "steps/sec (localhost cpu)"}
-        except subprocess.TimeoutExpired:
-            out[name] = {"error": "timeout"}
+        vals, err = [], None
+        for _rep in range(repeats):
+            t0 = _t.time()
+            try:
+                proc = subprocess.run(
+                    cmd, cwd=here, env=leg_env, timeout=600,
+                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                )
+                dt = _t.time() - t0
+                if proc.returncode != 0:
+                    err = {"error": "rc=%d: %s" % (
+                        proc.returncode,
+                        proc.stdout[-300:].decode("utf-8", "replace"))}
+                    break
+                vals.append(steps / dt)
+            except subprocess.TimeoutExpired:
+                err = {"error": "timeout"}
+                break
+        if err is not None:
+            out[name] = err
+        else:
+            import statistics
+
+            out[name] = {
+                "value": round(statistics.median(vals), 3),
+                "unit": "steps/sec (localhost cpu, median of %d)" % repeats,
+                "steps": steps,
+                "repeats": repeats,
+                "spread": round(max(vals) - min(vals), 3),
+                "samples": [round(v, 3) for v in vals],
+            }
     # BASELINE config 5 dist leg: GPT-2 TP+DP step over the 8-device
     # virtual mesh (one process; a step-time artifact, not a scaling claim)
     env_tp = dict(env)

@@ -24,9 +24,18 @@ _active_endpoints = set()
 
 def _note_endpoint(ep, trainer_id):
     _active_endpoints.add((ep, int(trainer_id)))
+    # first pserver contact also starts this trainer's liveness sender so
+    # a mid-round crash is detectable (and a live-but-slow trainer never
+    # trips the pserver's eviction deadline)
+    from .rpc import ensure_heartbeat
+
+    ensure_heartbeat(ep, trainer_id)
 
 
 def send_complete_all():
+    from .rpc import stop_heartbeats
+
+    stop_heartbeats()  # fall silent BEFORE complete: no post-exit beats
     for ep, tid in sorted(_active_endpoints):
         try:
             RPCClient.get(ep).complete(tid)

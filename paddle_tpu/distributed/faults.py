@@ -1,0 +1,259 @@
+"""Deterministic fault injection for the RPC control plane.
+
+The chaos harness behind tests/test_fault_tolerance.py: a TCP proxy that
+sits between an RPCClient and a VarServer speaking the framework's
+length-prefixed frame protocol (rpc.py) and injects faults FRAME-wise —
+whole requests / replies are dropped, delayed, duplicated, or truncated,
+which is how real networks and dying peers actually misbehave at this
+layer.  Faults follow either an explicit per-frame schedule or a seeded
+random schedule, so every chaos test is reproducible bit-for-bit.
+
+This is the measured-evidence half of the fault-tolerance story (the TVM
+lesson, PAPERS.md): liveness/eviction, retry/dedup, and checkpoint-resume
+are only *claimed* capabilities until a deterministic fault schedule
+exercises them.
+
+    chan = FaultyChannel(server.endpoint,
+                         schedule={"s2c": {1: "drop"}}).start()
+    cli = RPCClient(chan.endpoint, timeout=1, retries=3)
+    ... client transparently retries; server dedup keeps at-most-once ...
+    chan.stop()
+
+Actions (per frame index, counted per direction across the proxy's whole
+lifetime so reconnects keep the schedule deterministic):
+
+* ``pass``      — forward unchanged (the default)
+* ``drop``      — swallow the frame; the peer sees silence (client
+                  retries on timeout; at-most-once dedup is exercised)
+* ``delay``     — forward after ``delay_s`` (reordering pressure /
+                  deadline pressure)
+* ``dup``       — forward the frame twice (duplicate req_id at the
+                  server: dedup must execute once and replay the reply)
+* ``truncate``  — forward roughly half the frame, then kill the
+                  connection (both directions): a peer dying mid-write
+
+Process-level chaos (SIGKILL of cluster children) lives in launch.py's
+kill helpers; this module only does wire-level faults.
+"""
+
+import socket
+import struct
+import threading
+
+_LEN = struct.Struct(">Q")
+
+ACTIONS = ("pass", "drop", "delay", "dup", "truncate")
+
+
+class FaultSchedule:
+    """Maps (direction, frame_index) -> action.
+
+    Two layers, explicit first: ``schedule={"c2s": {3: "drop"}, "s2c":
+    {...}}`` pins exact frames; anything unpinned falls through to the
+    seeded random rates (``drop=0.1, dup=0.05, ...`` with ``seed``), and
+    with no rates to "pass".  Frame indices count per direction from 0
+    over the channel's lifetime, across reconnects."""
+
+    def __init__(self, schedule=None, seed=0, drop=0.0, delay=0.0,
+                 dup=0.0, truncate=0.0):
+        import random
+
+        self._explicit = {"c2s": {}, "s2c": {}}
+        for direction, frames in (schedule or {}).items():
+            if direction not in self._explicit:
+                raise ValueError("direction must be c2s|s2c, got %r"
+                                 % direction)
+            for idx, action in frames.items():
+                if action not in ACTIONS:
+                    raise ValueError("unknown fault action %r" % action)
+                self._explicit[direction][int(idx)] = action
+        self._rates = (
+            ("drop", float(drop)), ("delay", float(delay)),
+            ("dup", float(dup)), ("truncate", float(truncate)),
+        )
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._counters = {"c2s": 0, "s2c": 0}
+
+    def next_action(self, direction):
+        """Consume one frame slot in `direction`, return its action."""
+        with self._lock:
+            idx = self._counters[direction]
+            self._counters[direction] += 1
+            action = self._explicit[direction].get(idx)
+            if action is not None:
+                return idx, action
+            # seeded draws happen in a single global order (under the
+            # lock), so a fixed seed + a single-connection client gives a
+            # reproducible fault sequence
+            roll = self._rng.random()
+            acc = 0.0
+            for name, rate in self._rates:
+                acc += rate
+                if roll < acc:
+                    return idx, name
+            return idx, "pass"
+
+
+def _recv_frame(sock):
+    """Read one length-prefixed frame (prefix + body) or None on EOF."""
+    head = b""
+    while len(head) < _LEN.size:
+        chunk = sock.recv(_LEN.size - len(head))
+        if not chunk:
+            return None
+        head += chunk
+    (n,) = _LEN.unpack(head)
+    if n > (1 << 33):
+        # length bomb from a hostile peer: forward the prefix verbatim and
+        # let the real server's MAX_FRAME guard reject it
+        return head
+    body = bytearray()
+    while len(body) < n:
+        chunk = sock.recv(min(1 << 20, n - len(body)))
+        if not chunk:
+            return None  # peer died mid-frame: drop the partial
+        body.extend(chunk)
+    return head + bytes(body)
+
+
+class FaultyChannel:
+    """Frame-aware TCP fault-injection proxy (client <-> server).
+
+    start() listens on 127.0.0.1:<port or 0>; point the RPCClient at
+    ``chan.endpoint`` instead of the real server.  stats[] counts applied
+    actions per direction for asserting a schedule actually fired."""
+
+    def __init__(self, target_endpoint, listen="127.0.0.1:0",
+                 schedule=None, seed=0, drop=0.0, delay=0.0, dup=0.0,
+                 truncate=0.0, delay_s=0.05):
+        self.target = target_endpoint
+        self._listen = listen
+        self.sched = FaultSchedule(schedule, seed=seed, drop=drop,
+                                   delay=delay, dup=dup, truncate=truncate)
+        self.delay_s = float(delay_s)
+        self.stats = {"c2s": {a: 0 for a in ACTIONS},
+                      "s2c": {a: 0 for a in ACTIONS}}
+        self._stats_lock = threading.Lock()
+        self._srv = None
+        self._accept_thread = None
+        self._closing = threading.Event()
+        self._conns = []  # live (client_sock, server_sock) pairs
+        self._conns_lock = threading.Lock()
+        self.endpoint = None
+
+    # ---- lifecycle -----------------------------------------------------
+    def start(self):
+        host, port = self._listen.rsplit(":", 1)
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host or "127.0.0.1", int(port)))
+        self._srv.listen(16)
+        # closing a listener does NOT wake a thread blocked in accept()
+        # on Linux: poll instead, so stop() returns promptly
+        self._srv.settimeout(0.1)
+        self.endpoint = "%s:%d" % self._srv.getsockname()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name="faultychannel-%s" % self.endpoint)
+        self._accept_thread.start()
+        return self
+
+    def stop(self):
+        self._closing.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        with self._conns_lock:
+            conns, self._conns = self._conns[:], []
+        for pair in conns:
+            self._kill_pair(pair)
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5)
+
+    def _kill_pair(self, pair):
+        for s in pair:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    # ---- data path -----------------------------------------------------
+    def _accept_loop(self):
+        while not self._closing.is_set():
+            try:
+                client, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            client.settimeout(None)  # listener timeout must not inherit
+            try:
+                host, port = self.target.rsplit(":", 1)
+                server = socket.create_connection((host, int(port)),
+                                                  timeout=10)
+            except OSError:
+                client.close()
+                continue
+            for s in (client, server):
+                try:
+                    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                except OSError:
+                    pass
+            pair = (client, server)
+            with self._conns_lock:
+                self._conns.append(pair)
+            for direction, src, dst in (("c2s", client, server),
+                                        ("s2c", server, client)):
+                threading.Thread(
+                    target=self._pump, args=(direction, src, dst, pair),
+                    daemon=True).start()
+
+    def _note(self, direction, action):
+        with self._stats_lock:
+            self.stats[direction][action] += 1
+
+    def _pump(self, direction, src, dst, pair):
+        import time
+
+        try:
+            while not self._closing.is_set():
+                frame = _recv_frame(src)
+                if frame is None:
+                    break
+                idx, action = self.sched.next_action(direction)
+                self._note(direction, action)
+                if action == "drop":
+                    continue
+                if action == "delay":
+                    time.sleep(self.delay_s)
+                    dst.sendall(frame)
+                elif action == "dup":
+                    dst.sendall(frame)
+                    dst.sendall(frame)
+                elif action == "truncate":
+                    # half a frame, then a dead peer: the reader sees a
+                    # mid-frame EOF (ConnectionError / dropped conn)
+                    dst.sendall(frame[: max(1, len(frame) // 2)])
+                    break
+                else:
+                    dst.sendall(frame)
+        except OSError:
+            pass
+        finally:
+            self._kill_pair(pair)
+            with self._conns_lock:
+                if pair in self._conns:
+                    self._conns.remove(pair)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

@@ -65,12 +65,23 @@ def _wait_port(endpoint, timeout=60, cluster=None):
 
 
 class _Cluster:
-    """Spawned children with streamed output and fail-fast teardown."""
+    """Spawned children with streamed output and fail-fast teardown.
+
+    Chaos hooks: `kill_one(tag)` / `schedule_kill(tag, after_s)` SIGKILL a
+    single child, and tags passed to `expect_failure()` don't trip the
+    fail-fast teardown — the point of a chaos run is that the SURVIVORS
+    finish after a deliberate kill."""
 
     def __init__(self):
-        self.procs = []  # (tag, Popen)
+        self.procs = []  # (tag, Popen, pump-thread)
         self._lock = threading.Lock()
         self.failed_rc = None
+        self._expected_failures = set()  # tags whose death is deliberate
+        # called as (tag, rc) when a child exits nonzero — pserver mode
+        # uses it to report trainer deaths to the control plane, closing
+        # the window where a trainer dies BEFORE its first heartbeat
+        # (never tracked, so never evicted) and would hang the sync round
+        self.on_child_death = None
 
     def spawn(self, tag, cmd, env):
         proc = subprocess.Popen(
@@ -92,15 +103,32 @@ class _Cluster:
             sys.stdout.flush()
         rc = proc.wait()
         if rc != 0:
+            # record the failure FIRST so fail-fast teardown isn't
+            # delayed behind the (best-effort, up-to-seconds) death
+            # notification RPCs
             with self._lock:
-                if self.failed_rc is None:
+                if tag in self._expected_failures:
+                    sys.stderr.write(
+                        "[launch] %s exited rc=%d (expected chaos kill)\n"
+                        % (tag, rc)
+                    )
+                elif self.failed_rc is None:
                     self.failed_rc = rc
                     sys.stderr.write(
                         "[launch] %s exited rc=%d — stopping cluster\n" % (tag, rc)
                     )
+            cb = self.on_child_death
+            if cb is not None:
+                try:
+                    cb(tag, rc)
+                except Exception as e:
+                    sys.stderr.write(
+                        "[launch] death notification for %s failed: %s\n"
+                        % (tag, e))
 
     def wait(self, poll=0.2):
-        """Wait for all children; kill everything on first failure."""
+        """Wait for all children; kill everything on first (unexpected)
+        failure."""
         while True:
             with self._lock:
                 failed = self.failed_rc
@@ -111,9 +139,11 @@ class _Cluster:
                 for _, _, t in self.procs:
                     t.join(timeout=5)
                 # first nonzero (incl. negative signal-kill codes) wins —
-                # max() would mask a SIGKILLed child behind a clean peer
-                for _, p, _ in self.procs:
-                    if p.returncode != 0:
+                # max() would mask a SIGKILLed child behind a clean peer —
+                # but a deliberately killed child doesn't count
+                for tag, p, _ in self.procs:
+                    if (p.returncode != 0
+                            and tag not in self._expected_failures):
                         return p.returncode
                 return 0
             time.sleep(poll)
@@ -129,8 +159,55 @@ class _Cluster:
                 pass
             t.join(timeout=5)
 
+    # ---- chaos helpers (fault-injection harness) ----------------------
+    def proc(self, tag):
+        """The Popen for one child by its [role.rank] tag."""
+        for t, p, _ in self.procs:
+            if t == tag:
+                return p
+        raise KeyError("no child tagged %r (have %s)"
+                       % (tag, [t for t, _, _ in self.procs]))
 
-def launch_collective(script_argv, nproc, base_env=None):
+    def expect_failure(self, tag):
+        """Mark a child's death as deliberate: its nonzero exit neither
+        tears the cluster down nor fails wait()."""
+        with self._lock:
+            self._expected_failures.add(tag)
+
+    def kill_one(self, tag, sig=None):
+        """SIGKILL (or `sig`) one child — simulated process death.  The
+        tag is auto-marked as an expected failure."""
+        import signal as _signal
+
+        self.expect_failure(tag)
+        p = self.proc(tag)
+        if p.poll() is None:
+            if sig is None or sig == _signal.SIGKILL:
+                p.kill()
+            else:
+                p.send_signal(sig)
+        return p
+
+    def schedule_kill(self, tag, after_s, sig=None):
+        """Arm a timer that kill_one()s `tag` after `after_s` seconds —
+        the deterministic "trainer dies mid-round" chaos trigger."""
+        self.proc(tag)  # a typo'd tag must fail NOW, not silently never
+        # fire from the timer thread (rc=0 would read as "survivors rode
+        # out the kill" when no fault was injected at all)
+        self.expect_failure(tag)  # arm BEFORE the timer can race _pump
+        t = threading.Timer(after_s, self.kill_one, args=(tag, sig))
+        t.daemon = True
+        t.start()
+        return t
+
+
+def _arm_chaos(cluster, chaos_kills):
+    """chaos_kills: [(tag, after_s), ...] — arm deliberate child kills."""
+    for tag, after_s in chaos_kills or []:
+        cluster.schedule_kill(tag, after_s)
+
+
+def launch_collective(script_argv, nproc, base_env=None, chaos_kills=None):
     eps = ",".join("127.0.0.1:%d" % free_port() for _ in range(nproc))
     cluster = _Cluster()
     ep_list = eps.split(",")
@@ -145,10 +222,12 @@ def launch_collective(script_argv, nproc, base_env=None):
         cluster.spawn(
             "trainer.%d" % rank, [sys.executable, "-u"] + script_argv, env
         )
+    _arm_chaos(cluster, chaos_kills)
     return cluster.wait()
 
 
-def launch_pserver(script_argv, nproc, n_pservers, base_env=None, sync=True):
+def launch_pserver(script_argv, nproc, n_pservers, base_env=None, sync=True,
+                   chaos_kills=None):
     ports = [free_port() for _ in range(n_pservers)]
     eps = ",".join("127.0.0.1:%d" % p for p in ports)
     common = dict(base_env or os.environ)
@@ -158,6 +237,29 @@ def launch_pserver(script_argv, nproc, n_pservers, base_env=None, sync=True):
         DIST_SYNC_MODE="1" if sync else "0",
     )
     cluster = _Cluster()
+
+    def notify_trainer_death(tag, rc):
+        """Tell every pserver a trainer child died (the `evict` verb): a
+        trainer SIGKILLed before its first heartbeat was never tracked,
+        so liveness eviction can't see it — but the LAUNCHER can, and
+        the report unhangs any sync barrier waiting on the ghost while
+        dropping its partial round contribution (unlike `complete`).
+        Best-effort with short deadlines; re-evicting is a no-op."""
+        if not tag.startswith("trainer."):
+            return
+        from .rpc import RPCClient
+
+        tid = int(tag.split(".", 1)[1])
+        for ep in eps.split(","):
+            cli = RPCClient(ep, timeout=2, retries=2, retry_wait=0.1)
+            try:
+                cli.call("evict", trainer_id=tid, deadline_s=5.0)
+            except Exception:
+                pass  # pserver may be gone too; fail-fast handles that
+            finally:
+                cli.close()
+
+    cluster.on_child_death = notify_trainer_death
     for i, p in enumerate(ports):
         env = dict(common)
         env.update(
@@ -182,6 +284,7 @@ def launch_pserver(script_argv, nproc, n_pservers, base_env=None, sync=True):
             PADDLE_TRAINER_ID=str(rank),
         )
         cluster.spawn("trainer.%d" % rank, [sys.executable, "-u"] + script_argv, env)
+    _arm_chaos(cluster, chaos_kills)
     return cluster.wait()
 
 
@@ -201,16 +304,35 @@ def main(argv=None):
         "--async-mode", action="store_true",
         help="pserver mode: async updates (no barriers)",
     )
+    parser.add_argument(
+        "--chaos-kill", action="append", default=[], metavar="TAG:SECONDS",
+        help="fault injection: SIGKILL child TAG (e.g. trainer.1) after "
+        "SECONDS; the kill is an expected failure — the run succeeds if "
+        "the survivors finish (repeatable)",
+    )
     parser.add_argument("script", help="training script")
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
 
+    chaos_kills = []
+    for spec in args.chaos_kill:
+        tag, _, after = spec.rpartition(":")
+        try:
+            after_s = float(after)
+        except ValueError:
+            tag = ""
+        if not tag:
+            parser.error("--chaos-kill wants TAG:SECONDS, got %r" % spec)
+        chaos_kills.append((tag, after_s))
+
     script_argv = [args.script] + args.script_args
     if args.mode == "collective":
-        rc = launch_collective(script_argv, args.nproc)
+        rc = launch_collective(script_argv, args.nproc,
+                               chaos_kills=chaos_kills)
     else:
         rc = launch_pserver(
-            script_argv, args.nproc, args.pservers, sync=not args.async_mode
+            script_argv, args.nproc, args.pservers, sync=not args.async_mode,
+            chaos_kills=chaos_kills,
         )
     return rc
 

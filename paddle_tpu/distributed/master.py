@@ -83,17 +83,36 @@ class MasterService:
         tmp = self.snapshot_path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(state, f)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, self.snapshot_path)
 
     def _load_snapshot(self):
-        with open(self.snapshot_path) as f:
-            state = json.load(f)
+        """Resume from the etcd-stand-in snapshot; a corrupt/unreadable
+        file means a COLD start with a warning — a restarting master must
+        come up, never crash-loop on a torn write (the same discipline as
+        the pserver's crc-checked checkpoints)."""
+        import sys
+
+        try:
+            with open(self.snapshot_path) as f:
+                state = json.load(f)
+            todo = [Task.from_dict(d) for d in state["todo"]]
+            pending = [Task.from_dict(d) for d in state["pending"]]
+            done = [Task.from_dict(d) for d in state["done"]]
+            next_id = state["next_id"]
+        except Exception as e:
+            # not just JSON errors: valid-but-wrong-shaped JSON raises
+            # TypeError/AttributeError in Task.from_dict — any failure
+            # here must mean a cold start, never a crash loop
+            sys.stderr.write(
+                "MASTER snapshot %s unusable, starting cold: %s\n"
+                % (self.snapshot_path, e))
+            return
         # leased tasks from the dead master go back to todo
-        self._todo = [Task.from_dict(d) for d in state["todo"]] + [
-            Task.from_dict(d) for d in state["pending"]
-        ]
-        self._done = [Task.from_dict(d) for d in state["done"]]
-        self._next_id = state["next_id"]
+        self._todo = todo + pending
+        self._done = done
+        self._next_id = next_id
         self._dataset_set = state.get("dataset_set", bool(self._todo or self._done))
 
     # ---- verbs ---------------------------------------------------------

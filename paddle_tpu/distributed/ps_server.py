@@ -17,6 +17,29 @@ Sync mode round protocol (reference barrier semantics):
   4. round resets
 Async mode: each send applies its shard program immediately, gets are
 served from the live scope, no barriers.
+
+Fault tolerance (docs/FAULT_TOLERANCE.md):
+  * liveness — trainers send a ``heartbeat`` verb from a background
+    sender (rpc.ensure_heartbeat); a heartbeat-TRACKED trainer that goes
+    silent past FLAGS_eviction_deadline is evicted: removed from the
+    live set, its unsummed grads and queued sparse rows dropped, and any
+    pending barrier re-evaluates against the survivors so the round
+    completes instead of deadlocking.  Trainers that never heartbeat are
+    never evicted (exactly the pre-liveness behavior), and eviction only
+    runs in SYNC mode — async has no barrier a ghost can hang.
+  * checkpoints — atomic tmp+rename snapshots plus a crc-carrying
+    manifest; a torn or corrupt snapshot is skipped on restart, never a
+    crash.
+
+Async-mode sparse slot-state approximation (ADVICE r5): tables touched
+by a send advance their adam beta-pows per APPLICATION (the lazy-adam
+rule); tables receiving no rows between two lr-trigger sends advance
+pows / decay momentum velocity once per trigger so an unlucky shard
+cannot stall forever.  The residual gap vs the sync schedule: touched
+tables advance per-application rather than per-step, each trainer's own
+trigger fires the catch-up (so N async trainers advance untouched
+tables ~N times per global step), and a pure-sparse model (no dense
+grad, hence no lr trigger) keeps the legacy per-application-only rule.
 """
 
 import threading
@@ -43,6 +66,7 @@ class ParameterServer:
         checkpoint_dir=None,
         checkpoint_every=1,
         server_idx=0,
+        eviction_deadline=None,
     ):
         from ..executor import Executor
         from ..places import CPUPlace
@@ -83,7 +107,24 @@ class ParameterServer:
         self._fetch_barriers = set()
         self._round = 0  # bumped after each optimize step
         self._params_ready = not sync_mode
-        self._live_trainers = num_trainers
+        # liveness: the explicit live set replaces the old bare count so
+        # eviction can target ONE trainer's pending state.  _tracked maps
+        # heartbeat-reporting trainers to their last-contact time; only
+        # tracked trainers are ever evicted (no heartbeats => the exact
+        # pre-liveness behavior, nothing times out).
+        self._live = set(range(num_trainers))
+        self._tracked = {}  # trainer_id -> time.monotonic() of last contact
+        self._evicted = set()
+        self._completed = set()  # clean departures (dedups repeat completes)
+        if eviction_deadline is None:
+            from ..flags import get_flag
+
+            eviction_deadline = float(get_flag("eviction_deadline"))
+        self.eviction_deadline = max(0.1, float(eviction_deadline))
+        self._reaper = None
+        # async mode: sparse tables touched since the last lr-trigger send
+        # (per-step catch-up for rowless shards, see module docstring)
+        self._async_touched = set()
         self._done = threading.Event()
         # shard checkpointing (go/pserver/service.go:346 Checkpoint +
         # LoadCheckpoint :175 capability): periodic atomic snapshots of the
@@ -122,21 +163,52 @@ class ParameterServer:
             },
         }
 
+    def _manifest_path(self, dir=None):
+        import os
+
+        return os.path.join(
+            dir or self.checkpoint_dir,
+            "pserver_%d.manifest.json" % self.server_idx,
+        )
+
     def _write_snapshot(self, data, dir=None):
         """Atomic write-tmp + rename (the Go pserver's crc+rename
-        discipline); runs OFF the service lock.  `dir` overrides the
-        server's own checkpoint_dir for trainer-requested snapshots."""
+        discipline, service.go:346); runs OFF the service lock.  `dir`
+        overrides the server's own checkpoint_dir for trainer-requested
+        snapshots.  A crc-carrying manifest lands (atomically) AFTER the
+        snapshot: restore verifies the crc, so silent corruption is
+        detected; a crash between the two renames leaves a stale manifest
+        over a complete snapshot, which restore recognizes and repairs
+        (see load_checkpoint)."""
+        import json
         import os
         import pickle
+        import zlib
 
         target = dir or self.checkpoint_dir
         os.makedirs(target, exist_ok=True)
         path = self._ckpt_path(dir=target)
         tmp = path + ".tmp"
         with self._ckpt_write_lock:
+            payload = pickle.dumps(data, protocol=pickle.HIGHEST_PROTOCOL)
             with open(tmp, "wb") as f:
-                pickle.dump(data, f, protocol=pickle.HIGHEST_PROTOCOL)
+                f.write(payload)
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(tmp, path)
+            manifest = {
+                "round": int(data.get("round", 0)),
+                "file": os.path.basename(path),
+                "nbytes": len(payload),
+                "crc32": zlib.crc32(payload) & 0xFFFFFFFF,
+                "server_idx": self.server_idx,
+            }
+            mtmp = self._manifest_path(dir=target) + ".tmp"
+            with open(mtmp, "w") as f:
+                json.dump(manifest, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(mtmp, self._manifest_path(dir=target))
 
     def save_checkpoint(self, dir=None):
         if not (dir or self.checkpoint_dir):
@@ -146,17 +218,63 @@ class ParameterServer:
 
     def load_checkpoint(self):
         """Restore shard state from the latest snapshot; returns the
-        restored round or None when no checkpoint exists."""
+        restored round, or None when no (usable) checkpoint exists.  A
+        corrupt / truncated snapshot is reported and SKIPPED — a
+        restarting pserver must come up (cold) rather than crash-loop on
+        a bad file.  A crc MISMATCH alone is not fatal when the snapshot
+        itself parses cleanly: a kill between the snapshot rename and the
+        manifest rename leaves a STALE manifest next to a complete,
+        atomically-renamed snapshot — that window must stay recoverable
+        (the manifest is rewritten to match)."""
         if not self.checkpoint_dir:
             return None
+        import json
         import os
         import pickle
+        import sys
+        import zlib
 
         path = self._ckpt_path()
         if not os.path.exists(path):
             return None
-        with open(path, "rb") as f:
-            data = pickle.load(f)
+        try:
+            with open(path, "rb") as f:
+                payload = f.read()
+            mpath = self._manifest_path()
+            crc_note = None
+            if os.path.exists(mpath):
+                try:
+                    with open(mpath) as f:
+                        manifest = json.load(f)
+                    crc = zlib.crc32(payload) & 0xFFFFFFFF
+                    if (len(payload) != int(manifest["nbytes"])
+                            or crc != int(manifest["crc32"])):
+                        crc_note = (
+                            "manifest says %s bytes crc %08x, file is %d "
+                            "bytes crc %08x" % (manifest["nbytes"],
+                                                int(manifest["crc32"]),
+                                                len(payload), crc))
+                except (ValueError, KeyError, OSError) as e:
+                    crc_note = "manifest unreadable: %s" % e
+            data = pickle.loads(payload)
+            if not (isinstance(data, dict) and "vars" in data):
+                raise ValueError("snapshot has no vars table")
+        except Exception as e:
+            sys.stderr.write(
+                "PSERVER checkpoint %s unusable, starting cold: %s\n"
+                % (path, e))
+            return None
+        if crc_note is not None:
+            # stale manifest (crash landed between the two renames) over
+            # a snapshot that parses cleanly: recover, re-manifest
+            sys.stderr.write(
+                "PSERVER checkpoint %s: stale/mismatched manifest (%s); "
+                "snapshot parsed cleanly — restoring and rewriting the "
+                "manifest\n" % (path, crc_note))
+            try:
+                self._write_snapshot(data)
+            except OSError:
+                pass
         for n, v in data["vars"].items():
             self.scope.set(n, v)
         for k, v in data["sparse"].items():
@@ -195,8 +313,126 @@ class ParameterServer:
 
         threading.Thread(target=write, daemon=True).start()
 
+    # ---- liveness / eviction --------------------------------------------
+    def _touch(self, trainer_id):
+        """Any verb from a tracked trainer counts as contact — a trainer
+        mid-barrier is provably alive even if a heartbeat got delayed."""
+        import time
+
+        tid = int(trainer_id)
+        if tid in self._tracked:
+            self._tracked[tid] = time.monotonic()
+
+    def _h_heartbeat(self, trainer_id=0):
+        import time
+
+        with self._cv:
+            tid = int(trainer_id)
+            live = tid in self._live
+            if live:
+                # first beat makes the trainer evictable from here on
+                self._tracked[tid] = time.monotonic()
+                self._ensure_reaper_locked()
+            # an evicted trainer is NOT re-admitted: its grads were
+            # dropped mid-round, re-joining would corrupt barrier math —
+            # it learns it is dead from live=False and should exit
+            return {"ok": True, "live": live, "round": self._round}
+
+    def _h_evict(self, trainer_id=0):
+        """Out-of-band death report (the launcher's supervisor role): a
+        trainer that died before its first heartbeat was never tracked,
+        so the reaper can't see it — whoever reaped the process tells us.
+        Unlike `complete`, this drops the ghost's pending grads / queued
+        sparse rows and stale barrier entries (the full _evict_locked
+        cleanup), so a partial round contribution never leaks."""
+        with self._cv:
+            self._evict_locked(int(trainer_id), "reported dead")
+            return {"ok": True, "live": len(self._live)}
+
+    def _ensure_reaper_locked(self):
+        # eviction is a SYNC-mode concept: async mode has no barrier a
+        # ghost can hang, and evicting a merely-partitioned async trainer
+        # would reject its (harmless) updates when it heals — so the
+        # reaper only runs for sync servers
+        if (self._reaper is not None or self._done.is_set()
+                or not self.sync_mode):
+            return
+        t = threading.Thread(target=self._reaper_loop, daemon=True,
+                             name="pserver-reaper-%d" % self.server_idx)
+        self._reaper = t
+        t.start()
+
+    def _reaper_loop(self):
+        """Evict tracked trainers that miss the deadline.  Polls at a
+        fraction of the deadline so eviction lands within ~1.25x of it.
+        One eviction's round re-evaluation failing must not kill the
+        reaper — a dead reaper silently re-introduces the barrier
+        deadlock this thread exists to break."""
+        import time
+
+        period = max(0.05, self.eviction_deadline / 4.0)
+        while not self._done.wait(period):
+            try:
+                with self._cv:
+                    now = time.monotonic()
+                    dead = [
+                        t for t, seen in self._tracked.items()
+                        if t in self._live
+                        and now - seen > self.eviction_deadline
+                    ]
+                    for t in dead:
+                        self._evict_locked(
+                            t, "missed liveness deadline (%.1fs)"
+                            % self.eviction_deadline)
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+
+    def _evict_locked(self, trainer_id, why):
+        """Remove a dead trainer from the round (called under self._cv):
+        drop its unsummed dense grads and queued sparse rows, then
+        re-evaluate pending barriers against the surviving live set — the
+        round must complete instead of hanging on a ghost."""
+        tid = int(trainer_id)
+        if tid not in self._live:
+            return
+        self._live.discard(tid)
+        self._tracked.pop(tid, None)
+        self._evicted.add(tid)
+        print("PSERVER EVICT trainer=%d round=%d: %s"
+              % (tid, self._round, why), flush=True)
+        for per_trainer in self._pending.values():
+            per_trainer.pop(tid, None)
+        self._pending_sparse = [
+            p for p in self._pending_sparse if p[3] != tid
+        ]
+        self._send_barriers.discard(tid)
+        self._fetch_barriers.discard(tid)
+        if not self._live:
+            self._done.set()
+        elif self.sync_mode:
+            if (self._send_barriers
+                    and len(self._send_barriers) >= len(self._live)):
+                self._run_round()
+            if (self._fetch_barriers
+                    and len(self._fetch_barriers) >= len(self._live)):
+                self._fetch_barriers.clear()
+                self._params_ready = False
+        self._cv.notify_all()
+
     # ---- verb dispatch ---------------------------------------------------
     def handle(self, verb, **kw):
+        tid = kw.get("trainer_id")
+        if isinstance(tid, int) and tid in self._tracked:
+            # lock-free liveness stamp at RECEIVE time (dict assignment
+            # is GIL-atomic): a handler queued behind the round lock
+            # while _run_round executes a long optimize step must not go
+            # stale waiting — the reaper would mass-evict healthy
+            # trainers the instant the round releases the lock
+            import time
+
+            self._tracked[int(tid)] = time.monotonic()
         try:
             return getattr(self, "_h_" + verb)(**kw)
         except Exception as e:  # ship errors to the client
@@ -221,7 +457,7 @@ class ParameterServer:
                 total = v if total is None else total + v
             self._apply_shard(self.grad_to_shard[gname], {gname: total})
         by_table = {}
-        for t, ids, rows in self._pending_sparse:
+        for t, ids, rows, _tid in self._pending_sparse:
             by_table.setdefault(t, []).append((ids, rows))
         for t, chunks in sorted(by_table.items()):
             self._apply_sparse(
@@ -256,10 +492,31 @@ class ParameterServer:
         value = np.asarray(value)
         if not self.sync_mode:
             with self._lock:
-                if self.lr_program is not None and name == self._lr_trigger:
-                    self.exe.run(
-                        self.lr_program, feed={}, fetch_list=[], scope=self.scope
-                    )
+                self._touch(trainer_id)
+                if name == self._lr_trigger:
+                    if self.lr_program is not None:
+                        self.exe.run(
+                            self.lr_program, feed={}, fetch_list=[],
+                            scope=self.scope
+                        )
+                    # per-step catch-up for sparse tables that saw NO rows
+                    # since the last trigger: their adam beta-pows advance
+                    # and momentum velocity decays exactly as a sync
+                    # rowless round would (ADVICE r5; module docstring
+                    # documents the residual approximation)
+                    for t, info in sorted(self.sparse_tables.items()):
+                        if t in self._async_touched:
+                            continue
+                        typ = (info.get("opt") or {}).get("type")
+                        if typ == "adam":
+                            self._advance_pows(info)
+                        elif typ == "momentum":
+                            self._apply_sparse(
+                                t, np.zeros((0,), np.int64),
+                                np.zeros((0, info["tbl"].shape[1]),
+                                         info["tbl"].dtype),
+                                advance_pows=False)
+                    self._async_touched.clear()
                 self._apply_shard(self.grad_to_shard[name], {name: value})
                 self._async_sends += 1
                 if (
@@ -272,6 +529,10 @@ class ParameterServer:
                     self._maybe_checkpoint()
             return {"ok": True}
         with self._lock:
+            self._touch(trainer_id)
+            if int(trainer_id) in self._evicted:
+                # a ghost's late grads must not leak into a future round
+                return {"ok": False, "evicted": True}
             self._pending.setdefault(name, {})[trainer_id] = value
         return {"ok": True}
 
@@ -279,18 +540,28 @@ class ParameterServer:
         if not self.sync_mode:
             return {"ok": True}
         with self._cv:
+            self._touch(trainer_id)
+            if int(trainer_id) in self._evicted:
+                return {"ok": False, "evicted": True}
             if kind == "send":
                 self._send_barriers.add(trainer_id)
-                if len(self._send_barriers) >= self._live_trainers:
+                if len(self._send_barriers) >= len(self._live):
                     self._run_round()
                 else:
                     rnd = self._round
+                    tid = int(trainer_id)
                     self._cv.wait_for(
                         lambda: self._round > rnd or self._done.is_set()
+                        or tid in self._evicted
                     )
+                    if tid in self._evicted:
+                        # evicted WHILE blocked here (round moved on, or
+                        # will, without our grads): report it now, not
+                        # one stale step later
+                        return {"ok": False, "evicted": True}
             elif kind == "fetch":
                 self._fetch_barriers.add(trainer_id)
-                if len(self._fetch_barriers) >= self._live_trainers:
+                if len(self._fetch_barriers) >= len(self._live):
                     self._fetch_barriers.clear()
                     self._params_ready = False
                     self._cv.notify_all()
@@ -299,9 +570,16 @@ class ParameterServer:
     def _h_get(self, name, trainer_id=0):
         if self.sync_mode:
             with self._cv:
+                self._touch(trainer_id)
                 self._cv.wait_for(
                     lambda: self._params_ready or self._done.is_set()
                 )
+                if int(trainer_id) in self._evicted:
+                    raise RuntimeError(
+                        "trainer %s was evicted from the sync round; "
+                        "params reflect a round it did not participate "
+                        "in — restart the trainer to rejoin"
+                        % (trainer_id,))
         var = self.scope.find_var(name)
         if var is None:
             raise KeyError("pserver has no var %s" % name)
@@ -425,9 +703,14 @@ class ParameterServer:
         ids = np.asarray(ids).reshape(-1)
         rows = np.asarray(rows)
         with self._lock:
+            self._touch(trainer_id)
+            if int(trainer_id) in self._evicted:
+                return {"ok": False, "evicted": True}
             if self.sync_mode:
-                self._pending_sparse.append((table, ids, rows))
+                self._pending_sparse.append(
+                    (table, ids, rows, int(trainer_id)))
             else:
+                self._async_touched.add(table)
                 self._apply_sparse(table, ids, rows)
         return {"ok": True}
 
@@ -442,18 +725,39 @@ class ParameterServer:
 
     def _h_complete(self, trainer_id=0):
         with self._cv:
-            self._live_trainers -= 1
-            if self._live_trainers <= 0:
+            tid = int(trainer_id)
+            if tid in self._live:
+                self._live.discard(tid)
+                self._completed.add(tid)
+            elif (tid not in self._evicted and tid not in self._completed
+                    and self._live):
+                # genuinely unknown id (legacy callers used a bare
+                # count): treat it as one departure so done-detection
+                # still converges.  A REPEATED complete (trainer exits
+                # after send_complete_all, launcher also notifies) and an
+                # evicted trainer's complete are already accounted for —
+                # popping an arbitrary survivor would corrupt the barrier
+                # denominator.
+                self._live.pop()
+                self._completed.add(tid)  # once: repeats must not re-pop
+            self._tracked.pop(tid, None)
+            if not self._live:
                 self._done.set()
             # a departing trainer may unblock a pending round
             if (
                 self.sync_mode
-                and self._live_trainers > 0
-                and len(self._send_barriers) >= self._live_trainers
+                and self._live
+                and self._send_barriers
+                and len(self._send_barriers) >= len(self._live)
             ):
                 self._run_round()
             self._cv.notify_all()
         return {"ok": True}
+
+    @property
+    def _live_trainers(self):
+        """Back-compat count view of the live set."""
+        return len(self._live)
 
     def wait_done(self, timeout=None):
         return self._done.wait(timeout)

@@ -257,7 +257,11 @@ class _Handler(socketserver.BaseRequestHandler):
                     return
                 result = _execute_once(server.dedup, server.dedup_lock,
                                        service, verb, kwargs, req_id)
-                _send_msg(self.request, result)
+                # replies carry the req_id: a duplicated request frame (a
+                # retransmitting network / fault injection) produces an
+                # EXTRA reply, and without the id the client would pair it
+                # with its next request and read results off-by-one
+                _send_msg(self.request, ("__reply__", req_id, result))
         except (ConnectionError, EOFError, ValueError):
             # ValueError = malformed/hostile frame (bad tag, bad version,
             # bad MAC, length bomb): the framing can no longer be trusted,
@@ -375,7 +379,8 @@ class NativeVarServer:
             return
         result = _execute_once(self.dedup, self.dedup_lock, self.service,
                                verb, kwargs, req_id)
-        payload = bytes(_encode(result, bytearray()))
+        # same reply envelope as the Python transport (see _Handler)
+        payload = bytes(_encode(("__reply__", req_id, result), bytearray()))
         # a handler can outlive shutdown(): take an in-flight ticket under
         # the lifecycle lock, but run the (possibly blocking) TCP write
         # OUTSIDE it — one stalled peer must not freeze other replies.
@@ -435,6 +440,17 @@ def make_var_server(endpoint, service):
     return VarServer(endpoint, service)
 
 
+def _backoff_wait(attempt, base, cap=5.0):
+    """Exponential backoff with jitter (AWS half-jitter rule): sleep in
+    [span/2, span] where span doubles per attempt up to `cap`.  Fixed
+    waits synchronize retry storms — every trainer hammering a restarting
+    pserver at the same instant; the jitter decorrelates them."""
+    import random
+
+    span = min(cap, base * (2.0 ** attempt))
+    return span * (0.5 + 0.5 * random.random())
+
+
 class RPCClient:
     """Blocking client with one cached connection per endpoint
     (GRPCClient analog; retries replace FLAGS_max_retry)."""
@@ -442,7 +458,7 @@ class RPCClient:
     _lock = threading.Lock()
     _instances = {}
 
-    def __init__(self, endpoint, timeout=None, retries=None, retry_wait=0.3):
+    def __init__(self, endpoint, timeout=None, retries=None, retry_wait=0.1):
         import uuid
 
         from ..flags import get_flag
@@ -453,7 +469,7 @@ class RPCClient:
         # blocking verbs (barrier / sync get) wait on cluster progress
         self.barrier_timeout = max(self.timeout, 1200.0)
         self.retries = retries if retries is not None else get_flag("max_retry")
-        self.retry_wait = retry_wait
+        self.retry_wait = retry_wait  # backoff BASE (grows exponentially)
         self._sock = None
         self._io_lock = threading.Lock()
         self._token = uuid.uuid4().hex
@@ -470,46 +486,65 @@ class RPCClient:
 
     @classmethod
     def reset_all(cls):
+        stop_heartbeats()
         with cls._lock:
             for cli in cls._instances.values():
                 cli.close()
             cls._instances.clear()
 
-    def _connect(self):
+    def _connect(self, deadline=None):
+        """Connect with exponential backoff + jitter; `deadline` (absolute
+        time.monotonic value) bounds the WHOLE loop — a per-call deadline
+        must cover connect retries too, not just round-trips."""
         import time
 
         host, port = self.endpoint.rsplit(":", 1)
         last = None
-        for _ in range(self.retries):
+        for attempt in range(self.retries):
+            if deadline is not None and time.monotonic() >= deadline:
+                break
             try:
+                timeout = self.timeout
+                if deadline is not None:
+                    timeout = max(0.05, min(timeout,
+                                            deadline - time.monotonic()))
                 sock = socket.create_connection(
-                    (host, int(port)), timeout=self.timeout
+                    (host, int(port)), timeout=timeout
                 )
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 return sock
             except OSError as e:
                 last = e
-                time.sleep(self.retry_wait)
+                # cap connect backoff at 1s: with the default max_retry
+                # (30) a dead, non-restarting endpoint fails in ~20s —
+                # persistence for real restart windows comes from raising
+                # FLAGS_max_retry, not from ballooning every failure
+                wait = _backoff_wait(attempt, self.retry_wait, cap=1.0)
+                if deadline is not None:
+                    wait = min(wait, max(0.0, deadline - time.monotonic()))
+                time.sleep(wait)
         raise ConnectionError(
             "cannot reach %s after %d tries: %s"
             % (self.endpoint, self.retries, last)
         )
 
-    def call(self, verb, timeout_s=None, **kwargs):
+    def call(self, verb, timeout_s=None, deadline_s=None, **kwargs):
         """One RPC round-trip.  `timeout_s` overrides the socket timeout
         for this call — blocking verbs (sync barriers, gated gets) wait on
         cluster progress, not network latency, and must not be bounded by
-        FLAGS_rpc_deadline."""
+        FLAGS_rpc_deadline.  `deadline_s` bounds the TOTAL call including
+        every connect retry and round-trip replay (per-call deadline
+        propagation: without it a call can take retries x timeout)."""
         from ..flags import get_flag
 
         if get_flag("enable_rpc_profiler"):
             from ..profiler import RecordEvent
 
             with RecordEvent("rpc_" + verb):
-                return self._call_locked(verb, timeout_s, kwargs)
-        return self._call_locked(verb, timeout_s, kwargs)
+                return self._call_locked(verb, timeout_s, kwargs, deadline_s)
+        return self._call_locked(verb, timeout_s, kwargs, deadline_s)
 
-    def _call_locked(self, verb, timeout_s, kwargs):
+    def _call_locked(self, verb, timeout_s, kwargs, deadline_s=None):
         import time
 
         with self._io_lock:
@@ -519,12 +554,17 @@ class RPCClient:
             # peer can accept a connection from its dying listener's
             # backlog and reset it, so one reconnect is not enough to ride
             # out a kill-and-restart window.  Connect-level persistence
-            # lives in _connect() (which already loops max_retry times) —
-            # keeping the outer count small avoids squaring the retries.
-            # The server's dedup cache keeps replays at-most-once even if
-            # an earlier copy was applied.  A genuine recv timeout (peer
-            # alive but slow) is replayed at most once, then surfaces.
+            # lives in _connect() (which already loops max_retry times with
+            # exponential backoff) — keeping the outer count small avoids
+            # squaring the retries.  The server's dedup cache keeps replays
+            # at-most-once even if an earlier copy was applied.  A genuine
+            # recv timeout (peer alive but slow) is replayed at most once,
+            # then surfaces.
             ROUND_TRIPS = 3
+            deadline = (
+                time.monotonic() + deadline_s if deadline_s is not None
+                else None
+            )
             last = None
             result = None
 
@@ -538,13 +578,41 @@ class RPCClient:
 
             try:
                 for attempt in range(ROUND_TRIPS):
+                    if (deadline is not None and attempt
+                            and time.monotonic() >= deadline):
+                        raise ConnectionError(
+                            "rpc %s to %s deadline (%.1fs) exceeded after "
+                            "%d attempts: %s"
+                            % (verb, self.endpoint, deadline_s, attempt,
+                               last))
                     try:
                         if self._sock is None:
-                            self._sock = self._connect()
-                        if timeout_s is not None:
-                            self._sock.settimeout(timeout_s)
+                            # a fresh connection means the peer may have
+                            # RESTARTED: the server re-resolves every var
+                            # name against its restored scope, so a replay
+                            # after reconnect picks up checkpointed state
+                            self._sock = self._connect(deadline=deadline)
+                        eff = timeout_s
+                        if deadline is not None:
+                            left = max(0.05, deadline - time.monotonic())
+                            eff = min(eff, left) if eff is not None else \
+                                min(self.timeout, left)
+                        if eff is not None:
+                            self._sock.settimeout(eff)
                         _send_msg(self._sock, (verb, kwargs, req_id))
                         result = _recv_msg(self._sock)
+                        # unwrap the reply envelope, discarding STALE
+                        # replies: a duplicated request frame yields an
+                        # extra reply whose req_id pairs it with a past
+                        # call, not this one
+                        while (isinstance(result, tuple)
+                               and len(result) == 3
+                               and result[0] == "__reply__"
+                               and result[1] != req_id):
+                            result = _recv_msg(self._sock)
+                        if (isinstance(result, tuple) and len(result) == 3
+                                and result[0] == "__reply__"):
+                            result = result[2]
                         break
                     except socket.timeout:
                         drop_sock()
@@ -561,14 +629,20 @@ class RPCClient:
                         last = e
                         drop_sock()
                         if attempt + 1 < ROUND_TRIPS:
-                            time.sleep(self.retry_wait)
+                            wait = _backoff_wait(attempt, self.retry_wait)
+                            if deadline is not None:
+                                wait = min(
+                                    wait,
+                                    max(0.0, deadline - time.monotonic()))
+                            time.sleep(wait)
                 else:
                     raise ConnectionError(
                         "rpc %s to %s failed after %d round-trip attempts: %s"
                         % (verb, self.endpoint, ROUND_TRIPS, last)
                     )
             finally:
-                if timeout_s is not None and self._sock is not None:
+                if (timeout_s is not None or deadline is not None) \
+                        and self._sock is not None:
                     try:
                         self._sock.settimeout(self.timeout)
                     except OSError:
@@ -606,6 +680,13 @@ class RPCClient:
         """Ask the pserver to snapshot its shard (checkpoint_notify_op.cc)."""
         return self.call("checkpoint_notify", dir=dir, trainer_id=trainer_id)
 
+    def heartbeat(self, trainer_id=0, deadline_s=None):
+        """Liveness ping: tells the pserver this trainer is alive so it
+        is not evicted from the sync round (go/master trainer-lease
+        analog, inverted: the SERVER tracks trainer leases here)."""
+        return self.call("heartbeat", deadline_s=deadline_s,
+                         trainer_id=trainer_id)
+
     def complete(self, trainer_id=0):
         return self.call("complete", trainer_id=trainer_id)
 
@@ -618,3 +699,75 @@ class RPCClient:
                 except OSError:
                     pass
                 self._sock = None
+
+
+# ---- trainer liveness heartbeats --------------------------------------
+# One background sender per (endpoint, trainer_id): beats every
+# FLAGS_heartbeat_interval seconds on its OWN connection — the shared
+# RPCClient serializes calls under _io_lock, so a heartbeat riding it
+# would queue behind a blocking sync barrier and the pserver would see
+# exactly the silence it is trying to detect.
+_hb_lock = threading.Lock()
+_hb_senders = {}  # (endpoint, trainer_id) -> (threading.Event, Thread)
+
+
+def ensure_heartbeat(endpoint, trainer_id=0):
+    """Idempotently start the liveness sender for one pserver endpoint.
+    Called from the trainer-side dist ops on first contact; a no-op when
+    FLAGS_heartbeat_interval is 0."""
+    from ..flags import get_flag
+
+    interval = float(get_flag("heartbeat_interval"))
+    if interval <= 0:
+        return None
+    key = (endpoint, int(trainer_id))
+    with _hb_lock:
+        if key in _hb_senders:
+            return _hb_senders[key][1]
+        stop = threading.Event()
+
+        def beat():
+            # private client: small retry budget, short deadlines — a
+            # down pserver must not back the sender up past its period
+            cli = RPCClient(endpoint, timeout=max(1.0, interval),
+                            retries=2, retry_wait=min(0.1, interval / 4))
+            try:
+                while True:
+                    try:
+                        r = cli.heartbeat(trainer_id=int(trainer_id),
+                                          deadline_s=2 * interval)
+                        if isinstance(r, dict) and r.get("live") is False:
+                            # the pserver evicted this trainer and will
+                            # never re-admit it: stop wasting beats (the
+                            # next data verb raises the evicted error)
+                            return
+                    except Exception:
+                        # unreachable / restarting peer: keep beating —
+                        # the reconnect inside call() rides out restarts
+                        pass
+                    if stop.wait(interval):
+                        return
+            finally:
+                try:
+                    cli.close()
+                except Exception:
+                    pass
+
+        t = threading.Thread(target=beat, daemon=True,
+                             name="heartbeat-%s-%s" % (endpoint, trainer_id))
+        _hb_senders[key] = (stop, t)
+        t.start()
+        return t
+
+
+def stop_heartbeats():
+    """Stop every liveness sender (trainer exit / Executor.close path —
+    a completed trainer must fall silent so tests and restarts start
+    clean; the pserver already removed it from the live set)."""
+    with _hb_lock:
+        senders = list(_hb_senders.values())
+        _hb_senders.clear()
+    for stop, t in senders:
+        stop.set()
+    for _, t in senders:
+        t.join(timeout=5)

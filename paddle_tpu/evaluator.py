@@ -249,13 +249,28 @@ class DetectionMAP(Evaluator):
             ap_version=ap_version, evaluate_difficult=evaluate_difficult,
             accum_key=self._accum_key)
         self.metrics = [self.cur_map, self.accum_map]
+        # the PROGRAM holds a strong reference to this evaluator (keyed
+        # by accum_key, so rebuilding never pins duplicates): the ops
+        # stay runnable exactly as long as the program lives, so a user
+        # dropping their evaluator variable mid-run cannot silently reset
+        # the stream (ADVICE r5).  The finalizer below therefore fires
+        # only once the program itself is collected — an evaluator built
+        # per-epoch into one LONG-LIVED program keeps each old stream
+        # alive with its still-runnable ops; call reset() on the old
+        # evaluator (or build into a fresh program) to release the data.
+        prog = self.accum_map.block.program
+        if not hasattr(prog, "_detmap_keepalive"):
+            prog._detmap_keepalive = {}
+        prog._detmap_keepalive[self._accum_key] = self
         # free the host accumulator (full per-detection score lists) when
-        # the evaluator itself is collected — rebuilt-per-epoch
-        # evaluators must not leak every past epoch's stream
-        from .ops.compat_ops import reset_detection_map_accum
+        # the evaluator (with its program) is collected — rebuilt-per-
+        # epoch evaluators must not leak every past epoch's stream.  The
+        # finalize variant flags the key so any orphaned program copy
+        # still running the op warns instead of restarting silently.
+        from .ops.compat_ops import finalize_detection_map_accum
 
         self._finalizer = weakref.finalize(
-            self, reset_detection_map_accum, self._accum_key)
+            self, finalize_detection_map_accum, self._accum_key)
 
     def get_map_var(self):
         """Reference API: returns (cur_map, accum_map) variables."""

@@ -366,17 +366,26 @@ class Executor:
         )
         try:
             fetches, new_state = jitted(feed_arrays, ro_state, rw_state, keys)
-        except TypeError:
-            # jit argument validation fails BEFORE dispatch: nothing was
-            # donated, the scope is intact — surface the plain error
-            self._step = step0
-            raise
         except Exception as e:
-            # rw_state was donated (donate_argnums=(2,)): a failure
-            # mid-call (device OOM, ...) leaves the scope holding
-            # deleted buffers and every later run() would die with an
-            # opaque deleted-buffer error — fail loudly instead.
             self._step = step0
+            # Don't classify by exception TYPE (a TypeError can also come
+            # from a host callback AFTER dispatch) — check what actually
+            # matters: were the rw_state buffers donated?  jit argument
+            # validation fails BEFORE dispatch, leaving every donated-arg
+            # buffer alive; any failure after dispatch leaves them
+            # deleted (donate_argnums=(2,)).
+            donated = any(
+                getattr(v, "is_deleted", lambda: False)()
+                for v in rw_state.values()
+            )
+            if not donated:
+                # nothing was donated, the scope is intact — surface the
+                # plain error
+                raise
+            # a failure mid-call (device OOM, callback error, ...) leaves
+            # the scope holding deleted buffers and every later run()
+            # would die with an opaque deleted-buffer error — fail loudly
+            # instead.
             raise RuntimeError(
                 "Executor.run_loop: the compiled loop failed after its "
                 "read-write state was donated to the device; the scope "

@@ -9,7 +9,9 @@ is the single source of truth; values load from the environment at import:
 
 Wired flags: check_nan_inf (executor fetch scan), benchmark (per-run
 timing log), rpc_deadline / max_retry (RPC client), enable_rpc_profiler
-(RecordEvent spans around RPC calls).  The remaining knobs are accepted
+(RecordEvent spans around RPC calls), heartbeat_interval /
+eviction_deadline (trainer liveness + pserver barrier eviction,
+docs/FAULT_TOLERANCE.md).  The remaining knobs are accepted
 for script compatibility and are no-ops under XLA (their help text says
 so) — memory budgeting belongs to PJRT and fusion to the compiler.
 """
@@ -96,6 +98,15 @@ DEFINE_flag("dist_threadpool_size", 0,
             "compat no-op (pserver threads are per-connection)")
 DEFINE_flag("rpc_deadline", 180000, "RPC timeout in ms (grpc deadline)")
 DEFINE_flag("max_retry", 30, "RPC connect retries")
+DEFINE_flag("heartbeat_interval", 2.0,
+            "trainer->pserver liveness heartbeat period in seconds; a "
+            "background sender starts with the first pserver RPC "
+            "(0 disables heartbeats and therefore eviction)")
+DEFINE_flag("eviction_deadline", 20.0,
+            "seconds without any contact (heartbeat or verb) after which "
+            "a heartbeat-tracked trainer is declared dead and evicted "
+            "from the sync round — pending barriers re-evaluate against "
+            "the surviving live set instead of hanging forever")
 DEFINE_flag("enable_rpc_profiler", False, "RecordEvent spans around RPC")
 DEFINE_flag("cudnn_deterministic", False,
             "compat; XLA compilation is deterministic already")

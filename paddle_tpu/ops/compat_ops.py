@@ -410,12 +410,25 @@ def _merge_ids(ctx, ins, attrs):
 # detection eval state is ragged per-class score lists — host state is
 # the TPU-native seam, matching the op's host-callback design
 _DETMAP_ACCUMS = {}
+# keys whose accumulator was torn down by the OWNER's GC finalizer (not an
+# explicit reset): a program that still runs the op afterwards is silently
+# restarting its stream from empty — warn instead of hiding it
+_DETMAP_FINALIZED = set()
 
 
 def reset_detection_map_accum(key):
     """Clear the streaming accumulator behind an `accum_key` detection_map
-    op (evaluator.DetectionMAP.reset)."""
+    op (evaluator.DetectionMAP.reset) — an INTENTIONAL stream restart."""
     _DETMAP_ACCUMS.pop(key, None)
+    _DETMAP_FINALIZED.discard(key)
+
+
+def finalize_detection_map_accum(key):
+    """GC-finalizer variant of reset: frees the accumulator AND remembers
+    the key so a program that keeps running the op gets a warning when the
+    stream silently restarts (ADVICE r5)."""
+    _DETMAP_ACCUMS.pop(key, None)
+    _DETMAP_FINALIZED.add(key)
 
 
 def _detmap_feed(m, det_np, gt_np, evaluate_difficult):
@@ -492,6 +505,16 @@ def _detection_map_accum(ctx, ins, attrs):
     def host_accum(det_np, gt_np):
         m = _DETMAP_ACCUMS.get(accum_key)
         if m is None:
+            if accum_key in _DETMAP_FINALIZED:
+                import warnings
+
+                _DETMAP_FINALIZED.discard(accum_key)  # warn once per key
+                warnings.warn(
+                    "detection_map_accum %r: its DetectionMAP evaluator "
+                    "was garbage-collected, so the streaming accumulator "
+                    "restarts EMPTY mid-run — keep the evaluator (or the "
+                    "program that owns it) alive for the accumulated mAP "
+                    "to mean anything" % accum_key, RuntimeWarning)
             m = _DETMAP_ACCUMS[accum_key] = DetectionMAP(
                 overlap_threshold=overlap, ap_version=ap_version)
         return _detmap_feed(m, det_np, gt_np, ev_diff)

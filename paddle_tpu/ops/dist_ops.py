@@ -28,6 +28,17 @@ def _client(ep, trainer_id=None):
     return RPCClient.get(ep)
 
 
+def _check_not_evicted(result, ep, trainer_id):
+    """A pserver answers evicted=True to a trainer it declared dead (its
+    grads were dropped mid-round).  Training on silently-stale params
+    would diverge without a trace — fail fast and loudly instead."""
+    if isinstance(result, dict) and result.get("evicted"):
+        raise RuntimeError(
+            "trainer %s was evicted by pserver %s (missed the liveness "
+            "deadline); its sync round moved on without it — restart the "
+            "trainer to rejoin" % (trainer_id, ep))
+
+
 @register("send", side_effect=True)
 def _send(ctx, ins, attrs):
     """Split X flat into `sections`, ship block i to epmap[i] as
@@ -41,7 +52,9 @@ def _send(ctx, ins, attrs):
         flat = np.asarray(x).reshape(-1)
         off = 0
         for sec, ep, bname in zip(sections, epmap, block_names):
-            _client(ep, trainer_id).send_var(bname, flat[off : off + sec], trainer_id)
+            r = _client(ep, trainer_id).send_var(
+                bname, flat[off : off + sec], trainer_id)
+            _check_not_evicted(r, ep, trainer_id)
             off += sec
         return np.int32(0)
 
@@ -58,7 +71,8 @@ def _send_barrier(ctx, ins, attrs):
 
     def host_barrier():
         for ep in endpoints:
-            _client(ep).barrier("send", trainer_id)
+            r = _client(ep).barrier("send", trainer_id)
+            _check_not_evicted(r, ep, trainer_id)
         return np.int32(0)
 
     tok = io_callback(host_barrier, jax.ShapeDtypeStruct((), jnp.int32), ordered=True)
@@ -164,9 +178,10 @@ def _send_sparse(ctx, ins, attrs):
             if not mask.any():
                 continue
             local = flat[mask] // n
-            _client(epmap[s], trainer_id).send_sparse(
+            r = _client(epmap[s], trainer_id).send_sparse(
                 table_names[s], local, g[mask], trainer_id
             )
+            _check_not_evicted(r, epmap[s], trainer_id)
         return np.int32(0)
 
     tok = io_callback(

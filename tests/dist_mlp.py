@@ -157,6 +157,10 @@ def main():
     shard = batch // trainers
     lo, hi = trainer_id * shard, (trainer_id + 1) * shard
     step_sleep = float(os.environ.get("DIST_STEP_SLEEP", "0"))
+    # chaos hook (tests/test_fault_tolerance.py): SIGKILL this rank after
+    # step N — a real mid-training process death, no cleanup, no complete
+    crash_rank = int(os.environ.get("DIST_CRASH_RANK", "-1"))
+    crash_after = int(os.environ.get("DIST_CRASH_AFTER_STEP", "-1"))
     losses = []
     for i in range(steps):
         (lv,) = exe.run(
@@ -166,6 +170,12 @@ def main():
         )
         losses.append(float(np.asarray(lv).reshape(-1)[0]))
         print("STEP %d" % i, flush=True)
+        if trainer_id == crash_rank and i == crash_after:
+            import signal
+
+            print("CRASHING trainer %d after step %d" % (trainer_id, i),
+                  flush=True)
+            os.kill(os.getpid(), signal.SIGKILL)
         if step_sleep:
             import time
 

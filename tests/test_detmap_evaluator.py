@@ -74,6 +74,59 @@ def test_detection_map_difficult_gts_excluded():
     assert m_excl._npos == {0: 1}
 
 
+def test_detmap_accumulator_outlives_dropped_evaluator_var():
+    """ADVICE r5: the program holds a strong ref to its DetectionMAP
+    evaluator, so a user dropping the evaluator variable mid-run cannot
+    GC-reset the stream; and an op that DOES recreate a finalized key
+    (orphaned program copy) warns instead of silently restarting."""
+    import gc
+    import warnings
+
+    import pytest
+
+    from paddle_tpu.ops import compat_ops
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.framework.program_guard(main, startup):
+        det = layers.data("det", shape=[2, 6], dtype="float32")
+        gl = layers.data("gl", shape=[1, 1], dtype="float32")
+        gb = layers.data("gb", shape=[1, 4], dtype="float32")
+        ev = evaluator.DetectionMAP(
+            layers.reshape(det, [-1, 6]), layers.reshape(gl, [-1, 1]),
+            layers.reshape(gb, [-1, 4]))
+        cur, acc = ev.get_map_var()
+    key = ev._accum_key
+    assert main._detmap_keepalive[key] is ev
+    del ev
+    gc.collect()
+    # the program still anchors the evaluator: no finalization happened
+    assert key not in compat_ops._DETMAP_FINALIZED
+
+    feed = {
+        "det": np.array([[[0, .9, 0, 0, 1, 1], [-1, 0, 0, 0, 0, 0]]],
+                        "float32"),
+        "gl": np.array([[[0]]], "float32"),
+        "gb": np.array([[[0, 0, 1, 1]]], "float32"),
+    }
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed=feed, fetch_list=[cur, acc])
+        assert key in compat_ops._DETMAP_ACCUMS  # stream is live
+        # now simulate the program itself dying: the finalizer fires...
+        compat_ops.finalize_detection_map_accum(key)
+        assert key not in compat_ops._DETMAP_ACCUMS
+        # ...and a still-runnable copy of the op warns on the silent
+        # stream restart instead of hiding it
+        with pytest.warns(RuntimeWarning, match="garbage-collected"):
+            exe.run(main, feed=feed, fetch_list=[cur, acc])
+        # warn once per key: the next run is quiet
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            exe.run(main, feed=feed, fetch_list=[cur, acc])
+
+
 def test_detection_map_accum_survives_unfetched_runs():
     """The streaming op is side-effecting: a run that fetches ONLY
     cur_map (reference training-loop pattern) must still feed the

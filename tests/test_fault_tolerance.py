@@ -1,0 +1,708 @@
+"""Deterministic chaos suite for the fault-tolerant distribution layer
+(docs/FAULT_TOLERANCE.md): trainer liveness + barrier eviction on the
+pserver, at-most-once RPC under injected wire faults (FaultyChannel),
+crash-safe checkpoint/restore, master lease expiry, and real SIGKILL
+process-death end-to-end.  Everything here is tier-1 (NOT `slow`): the
+fault schedules are seeded/explicit, so each run exercises the identical
+failure sequence."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.faults import FaultSchedule, FaultyChannel
+from paddle_tpu.distributed.master import MasterService
+from paddle_tpu.distributed.ps_server import ParameterServer
+from paddle_tpu.distributed.rpc import RPCClient, VarServer, _backoff_wait
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_RUNNER = os.path.join(_DIR, "dist_mlp.py")
+
+
+class _CountingService:
+    """Parameter-state stand-in: every EXECUTION of `add` mutates state.
+    Dedup holding means state == sum of logical calls, no matter how the
+    wire mangled the frames."""
+
+    def __init__(self):
+        self.executions = 0
+        self.state = 0.0
+        self._lock = threading.Lock()
+
+    def handle(self, verb, **kw):
+        if verb == "add":
+            with self._lock:
+                self.executions += 1
+                self.state += float(kw["value"])
+                return {"ok": True, "state": self.state}
+        if verb == "ping":
+            return {"ok": True}
+        return {"__error__": "unknown verb %s" % verb}
+
+
+def _mk(service=None, **chan_kw):
+    """VarServer + FaultyChannel in front of it."""
+    svc = service if service is not None else _CountingService()
+    srv = VarServer("127.0.0.1:0", svc).start()
+    chan = FaultyChannel(srv.endpoint, **chan_kw).start()
+    return svc, srv, chan
+
+
+# ---------------------------------------------------------------------------
+# wire-fault injection: at-most-once must hold under drop/dup/truncate
+# ---------------------------------------------------------------------------
+
+def test_fault_schedule_is_deterministic():
+    a = FaultSchedule(seed=7, drop=0.3, dup=0.2)
+    b = FaultSchedule(seed=7, drop=0.3, dup=0.2)
+    seq_a = [a.next_action("c2s") for _ in range(50)]
+    assert seq_a == [b.next_action("c2s") for _ in range(50)]
+    # explicit pins override the random layer
+    c = FaultSchedule({"c2s": {3: "truncate"}}, seed=7, drop=1.0)
+    assert c.next_action("c2s")[1] == "drop"
+    c.next_action("c2s"), c.next_action("c2s")
+    assert c.next_action("c2s") == (3, "truncate")
+
+
+def test_dup_request_executes_once_and_replies_stay_paired():
+    """A duplicated request frame: the server's dedup executes ONCE, and
+    the extra (req_id-tagged) reply must not shift later calls off by
+    one."""
+    svc, srv, chan = _mk(schedule={"c2s": {0: "dup"}})
+    try:
+        cli = RPCClient(chan.endpoint, timeout=5, retries=3, retry_wait=0.05)
+        r1 = cli.call("add", value=10.0)
+        assert r1["state"] == 10.0
+        # the NEXT call must see its own reply, not the duplicate's
+        r2 = cli.call("add", value=5.0)
+        assert r2["state"] == 15.0
+        assert svc.executions == 2 and svc.state == 15.0
+        assert chan.stats["c2s"]["dup"] == 1
+        cli.close()
+    finally:
+        chan.stop()
+        srv.shutdown()
+
+
+def test_dropped_request_is_retried_and_applied_once():
+    svc, srv, chan = _mk(schedule={"c2s": {0: "drop"}})
+    try:
+        cli = RPCClient(chan.endpoint, timeout=0.5, retries=3,
+                        retry_wait=0.05)
+        assert cli.call("add", value=3.0)["state"] == 3.0
+        assert svc.executions == 1 and svc.state == 3.0
+        assert chan.stats["c2s"]["drop"] == 1
+        cli.close()
+    finally:
+        chan.stop()
+        srv.shutdown()
+
+
+def test_dropped_reply_retry_hits_dedup_not_reexecution():
+    """The at-most-once core: the server EXECUTED but its reply vanished;
+    the client's replay must get the original result, not a double
+    apply."""
+    svc, srv, chan = _mk(schedule={"s2c": {0: "drop"}})
+    try:
+        cli = RPCClient(chan.endpoint, timeout=0.5, retries=3,
+                        retry_wait=0.05)
+        r = cli.call("add", value=7.0)
+        assert r["state"] == 7.0
+        assert svc.executions == 1, "retry re-executed a completed verb"
+        assert svc.state == 7.0
+        cli.close()
+    finally:
+        chan.stop()
+        srv.shutdown()
+
+
+def test_truncated_reply_mid_frame_retries_cleanly():
+    """Peer dies mid-write: client sees a dead connection inside a frame,
+    reconnects, replays — dedup keeps it at-most-once."""
+    svc, srv, chan = _mk(schedule={"s2c": {0: "truncate"}})
+    try:
+        cli = RPCClient(chan.endpoint, timeout=2, retries=3, retry_wait=0.05)
+        assert cli.call("add", value=2.0)["state"] == 2.0
+        assert svc.executions == 1 and svc.state == 2.0
+        assert chan.stats["s2c"]["truncate"] == 1
+        cli.close()
+    finally:
+        chan.stop()
+        srv.shutdown()
+
+
+def test_param_state_survives_seeded_fault_soup():
+    """20 logical sends through a channel randomly dropping/duplicating/
+    delaying/truncating frames (seeded): the accumulated 'parameter'
+    must equal the exact sum — no lost and no double-applied update."""
+    # seed 5 verified deterministic: 5 drops + 6 dups + 9 delays injected,
+    # identical stats run over run (the schedule is consumed in the
+    # client's serial request/reply order)
+    svc, srv, chan = _mk(seed=5, drop=0.12, dup=0.15, truncate=0.05,
+                         delay=0.1, delay_s=0.02)
+    try:
+        cli = RPCClient(chan.endpoint, timeout=0.4, retries=6,
+                        retry_wait=0.05)
+        total = 0.0
+        for i in range(20):
+            v = float(i + 1)
+            total += v
+            cli.call("add", value=v)
+        assert svc.state == total, (svc.state, total, chan.stats)
+        assert svc.executions == 20, (svc.executions, chan.stats)
+        # the schedule really fired: at least one injected fault
+        injected = sum(
+            chan.stats[d][a]
+            for d in ("c2s", "s2c") for a in ("drop", "dup", "truncate"))
+        assert injected > 0, chan.stats
+        cli.close()
+    finally:
+        chan.stop()
+        srv.shutdown()
+
+
+def test_pserver_async_grads_exact_under_wire_faults():
+    """The real ParameterServer verb path (async sends) behind a faulty
+    wire: every grad applies exactly once, in order."""
+    ps = ParameterServer([None], {"g": 0}, num_trainers=1, sync_mode=False)
+    applied = []
+    ps._apply_shard = lambda idx, feed: applied.append(
+        float(np.asarray(feed["g"]).reshape(-1)[0]))
+    srv = VarServer("127.0.0.1:0", ps).start()
+    chan = FaultyChannel(srv.endpoint,
+                         schedule={"c2s": {1: "dup"}, "s2c": {3: "drop"}},
+                         ).start()
+    try:
+        cli = RPCClient(chan.endpoint, timeout=0.75, retries=5,
+                        retry_wait=0.05)
+        for i in range(6):
+            cli.send_var("g", np.full((1,), float(i)), trainer_id=0)
+        assert applied == [0.0, 1.0, 2.0, 3.0, 4.0, 5.0], (
+            applied, chan.stats)
+        cli.close()
+    finally:
+        chan.stop()
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# client hardening: backoff + per-call deadline
+# ---------------------------------------------------------------------------
+
+def test_backoff_grows_exponentially_with_jitter():
+    lows = [_backoff_wait(a, 0.1) for a in range(4)]
+    for a, w in enumerate(lows):
+        span = min(5.0, 0.1 * 2 ** a)
+        assert span / 2 <= w <= span, (a, w)
+    # cap: huge attempts stay bounded
+    assert _backoff_wait(30, 0.1) <= 5.0
+
+
+def test_call_deadline_bounds_connect_retries():
+    """deadline_s bounds the WHOLE call: a dead endpoint with a huge
+    retry budget must fail within the deadline, not retries x timeout."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    ep = "127.0.0.1:%d" % s.getsockname()[1]
+    s.close()  # nothing listens here now
+    cli = RPCClient(ep, timeout=5, retries=1000, retry_wait=0.05)
+    t0 = time.monotonic()
+    with pytest.raises((ConnectionError, OSError)):
+        cli.call("ping", deadline_s=1.0)
+    assert time.monotonic() - t0 < 5.0
+    cli.close()
+
+
+def test_client_survives_server_restart_on_same_port():
+    """Kill-and-restart window: the cached connection dies, the client
+    reconnects against the RESTARTED server and the verb resolves against
+    its (restored) state."""
+    svc1 = _CountingService()
+    srv1 = VarServer("127.0.0.1:0", svc1).start()
+    ep = srv1.endpoint
+    cli = RPCClient(ep, timeout=2, retries=20, retry_wait=0.05)
+    try:
+        assert cli.call("add", value=1.0)["ok"]
+        srv1.shutdown()
+        # restart on the SAME endpoint with restored state
+        svc2 = _CountingService()
+        svc2.state = svc1.state  # the "checkpoint restore"
+        srv2 = VarServer(ep, svc2).start()
+        try:
+            r = cli.call("add", value=2.0)
+            assert r["state"] == 3.0  # resumed from restored state
+        finally:
+            srv2.shutdown()
+    finally:
+        cli.close()
+
+
+# ---------------------------------------------------------------------------
+# liveness + eviction (in-process)
+# ---------------------------------------------------------------------------
+
+def test_dead_trainer_evicted_and_sync_round_completes():
+    """THE deadlock the liveness layer exists to break: trainer 1 is
+    heartbeat-tracked, then goes silent mid-round; trainer 0's send
+    barrier must complete within the eviction deadline instead of
+    hanging forever."""
+    ps = ParameterServer([None], {"g0": 0}, num_trainers=2, sync_mode=True,
+                         eviction_deadline=0.6)
+    applied = []
+    ps._apply_shard = lambda idx, feed: applied.append(
+        np.asarray(feed["g0"]).copy())
+    srv = VarServer("127.0.0.1:0", ps).start()
+    try:
+        cli = RPCClient(srv.endpoint, timeout=30, retries=3)
+        # trainer 1: alive long enough to be tracked and contribute a
+        # grad... then dies (no more heartbeats, no barrier)
+        cli.call("heartbeat", trainer_id=1)
+        cli.send_var("g0", np.full((2,), 100.0), trainer_id=1)
+        # trainer 0: sends its grad and enters the barrier
+        cli.send_var("g0", np.full((2,), 3.0), trainer_id=0)
+        t0 = time.monotonic()
+        r = cli.barrier("send", trainer_id=0)
+        elapsed = time.monotonic() - t0
+        assert r["ok"] is True
+        assert elapsed < 5.0, "barrier hung %.1fs — eviction failed" % elapsed
+        # round ran with ONLY the survivor's grad: the ghost's unsummed
+        # contribution was dropped, not averaged in
+        assert len(applied) == 1
+        np.testing.assert_array_equal(applied[0], np.full((2,), 3.0))
+        assert ps._round == 1
+        assert ps._live == {0} and 1 in ps._evicted
+        # fetch barrier now needs only the survivor
+        assert cli.barrier("fetch", trainer_id=0)["ok"] is True
+        # the ghost coming back learns it is dead (and is NOT re-admitted)
+        hb = cli.call("heartbeat", trainer_id=1)
+        assert hb["live"] is False
+        assert cli.call("barrier", kind="send", trainer_id=1)["evicted"]
+        # the ghost's exit-path complete() is already accounted for by
+        # the eviction: it must NOT pop the survivor from the live set
+        cli.call("complete", trainer_id=1)
+        assert ps._live == {0} and not ps._done.is_set()
+        cli.close()
+    finally:
+        srv.shutdown()
+
+
+def test_trainer_evicted_while_blocked_in_barrier_learns_immediately():
+    """A tracked trainer that goes silent WHILE parked inside the send
+    barrier must be woken by its own eviction with evicted=True — not
+    handed {ok: True} for a round it was removed from, and not left
+    blocked until some other trainer completes a round."""
+    ps = ParameterServer({}, {}, num_trainers=2, sync_mode=True,
+                         eviction_deadline=0.5)
+    srv = VarServer("127.0.0.1:0", ps).start()
+    try:
+        cli = RPCClient(srv.endpoint, timeout=30, retries=3)
+        cli.call("heartbeat", trainer_id=1)  # tracked...
+        out = []
+
+        def ghost_barrier():
+            # ...then its heartbeat thread dies while it waits here
+            out.append(cli.call("barrier", kind="send", trainer_id=1))
+
+        th = threading.Thread(target=ghost_barrier, daemon=True)
+        th.start()
+        th.join(timeout=10)
+        assert not th.is_alive(), "evicted trainer still parked in barrier"
+        assert out and out[0] == {"ok": False, "evicted": True}, out
+        assert ps._live == {0}
+        cli.close()
+    finally:
+        srv.shutdown()
+
+
+def test_untracked_trainers_are_never_evicted():
+    """No heartbeats => the exact pre-liveness contract: nothing times
+    out, the barrier waits for everyone."""
+    ps = ParameterServer({}, {}, num_trainers=2, sync_mode=True,
+                         eviction_deadline=0.2)
+    srv = VarServer("127.0.0.1:0", ps).start()
+    try:
+        cli0 = RPCClient(srv.endpoint, timeout=10, retries=3)
+        done = []
+
+        def t0_barrier():
+            done.append(cli0.call("barrier", kind="send", trainer_id=0))
+
+        th = threading.Thread(target=t0_barrier, daemon=True)
+        th.start()
+        time.sleep(0.6)  # 3x the deadline: nobody tracked, nobody evicted
+        assert not done and ps._live == {0, 1} and not ps._evicted
+        # trainer 1 arrives late and the round completes normally
+        cli1 = RPCClient(srv.endpoint, timeout=10, retries=3)
+        cli1.call("barrier", kind="send", trainer_id=1)
+        th.join(timeout=10)
+        assert done and done[0]["ok"] is True and ps._round == 1
+        cli0.close()
+        cli1.close()
+    finally:
+        srv.shutdown()
+
+
+def test_eviction_drops_queued_sparse_rows():
+    ps = ParameterServer(
+        {}, {}, num_trainers=2, sync_mode=True, eviction_deadline=0.5,
+        sparse_tables={"t0": {"tbl": np.zeros((4, 2), np.float32),
+                              "lr": 0.1,
+                              "opt": {"type": "sgd", "attrs": {}}}})
+    ps._h_heartbeat(trainer_id=1)
+    ps._h_send_sparse("t0", np.array([1]),
+                      np.full((1, 2), 100.0, np.float32), trainer_id=1)
+    ps._h_send_sparse("t0", np.array([2]),
+                      np.ones((1, 2), np.float32), trainer_id=0)
+    with ps._cv:
+        ps._evict_locked(1, "test")
+    assert [p[3] for p in ps._pending_sparse] == [0]
+    with ps._cv:
+        ps._run_round()
+    tbl = ps.sparse_tables["t0"]["tbl"]
+    np.testing.assert_allclose(tbl[2], -0.1 * np.ones(2), rtol=1e-6)
+    np.testing.assert_allclose(tbl[1], np.zeros(2))  # ghost's row dropped
+
+
+def test_all_trainers_dead_sets_done():
+    ps = ParameterServer({}, {}, num_trainers=1, sync_mode=True,
+                         eviction_deadline=0.3)
+    ps._h_heartbeat(trainer_id=0)
+    t0 = time.monotonic()
+    assert ps.wait_done(timeout=5), "done never set after last eviction"
+    assert time.monotonic() - t0 < 5.0
+
+
+# ---------------------------------------------------------------------------
+# crash-safe checkpoints
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_writes_manifest_and_restores(tmp_path):
+    ps = ParameterServer({}, {}, num_trainers=1, sync_mode=False,
+                         checkpoint_dir=str(tmp_path), server_idx=0)
+    ps.scope.set("w.block0", np.arange(4, dtype=np.float32))
+    ps._round = 7
+    assert ps.save_checkpoint()
+    mpath = tmp_path / "pserver_0.manifest.json"
+    assert mpath.exists()
+    manifest = json.loads(mpath.read_text())
+    assert manifest["round"] == 7
+    assert manifest["file"] == "pserver_0.ckpt"
+    # a fresh server restores round + vars
+    ps2 = ParameterServer({}, {}, num_trainers=1, sync_mode=False,
+                          checkpoint_dir=str(tmp_path), server_idx=0)
+    assert ps2.load_checkpoint() == 7
+    np.testing.assert_array_equal(
+        np.asarray(ps2.scope.find_var("w.block0")),
+        np.arange(4, dtype=np.float32))
+
+
+def test_stale_manifest_over_complete_snapshot_recovers(tmp_path):
+    """The routine SIGKILL window: the kill lands between the snapshot
+    rename and the manifest rename, leaving the PREVIOUS round's manifest
+    next to a complete new snapshot.  Restore must recognize this (the
+    snapshot parses cleanly), restore from it, and repair the manifest —
+    not throw away good state."""
+    ps = ParameterServer({}, {}, num_trainers=1, sync_mode=False,
+                         checkpoint_dir=str(tmp_path), server_idx=0)
+    ps.scope.set("v", np.ones(2, np.float32))
+    ps._round = 3
+    assert ps.save_checkpoint()
+    stale_manifest = (tmp_path / "pserver_0.manifest.json").read_bytes()
+    ps.scope.set("v", np.full(2, 9.0, np.float32))
+    ps._round = 5
+    assert ps.save_checkpoint()
+    # simulate the crash: new snapshot on disk, OLD manifest beside it
+    (tmp_path / "pserver_0.manifest.json").write_bytes(stale_manifest)
+    ps2 = ParameterServer({}, {}, num_trainers=1, sync_mode=False,
+                          checkpoint_dir=str(tmp_path), server_idx=0)
+    assert ps2.load_checkpoint() == 5
+    np.testing.assert_array_equal(np.asarray(ps2.scope.find_var("v")),
+                                  np.full(2, 9.0, np.float32))
+    # the manifest was repaired to match the snapshot it sits beside
+    fixed = json.loads((tmp_path / "pserver_0.manifest.json").read_text())
+    assert fixed["round"] == 5
+
+
+@pytest.mark.parametrize("corruption", ["truncate", "garbage", "empty"])
+def test_corrupt_checkpoint_is_skipped_not_fatal(tmp_path, corruption):
+    """A torn/corrupt snapshot must produce a COLD start (None), never a
+    crash-looping pserver."""
+    ps = ParameterServer({}, {}, num_trainers=1, sync_mode=False,
+                         checkpoint_dir=str(tmp_path), server_idx=0)
+    ps.scope.set("v", np.ones(3, np.float32))
+    ps._round = 3
+    assert ps.save_checkpoint()
+    path = tmp_path / "pserver_0.ckpt"
+    raw = path.read_bytes()
+    if corruption == "truncate":
+        path.write_bytes(raw[: len(raw) // 2])
+    elif corruption == "garbage":
+        path.write_bytes(b"\x00" * len(raw))
+    else:
+        path.write_bytes(b"")
+    ps2 = ParameterServer({}, {}, num_trainers=1, sync_mode=False,
+                          checkpoint_dir=str(tmp_path), server_idx=0)
+    assert ps2.load_checkpoint() is None
+
+
+# ---------------------------------------------------------------------------
+# master: lease expiry + dedup under injected faults
+# ---------------------------------------------------------------------------
+
+def test_master_lease_expiry_under_injected_faults():
+    """A trainer leases a task and dies; the lease times out and the task
+    goes back to the queue for the survivor — all through a wire that
+    duplicates and drops frames (retries + the master's own idempotency
+    must absorb them)."""
+    svc = MasterService(timeout_s=0.5, failure_max=3, chunks_per_task=1)
+    srv = VarServer("127.0.0.1:0", svc).start()
+    chan = FaultyChannel(srv.endpoint,
+                         schedule={"c2s": {1: "dup"},
+                                   "s2c": {2: "drop"}}).start()
+    try:
+        cli = RPCClient(chan.endpoint, timeout=0.75, retries=6,
+                        retry_wait=0.05)
+        r = cli.call("set_dataset", chunks=["c0", "c1"], trainer_id=0)
+        assert r["ok"]
+        # trainer 0 leases a task... and dies without finishing it
+        lease = cli.call("get_task", trainer_id=0)
+        assert lease["task"] is not None
+        dead_tid = lease["task"]["id"]
+        # survivor drains the queue; the expired lease must come back
+        got, deadline = [], time.monotonic() + 10
+        while len(got) < 2 and time.monotonic() < deadline:
+            r = cli.call("get_task", trainer_id=1)
+            if r.get("task") is None:
+                time.sleep(0.1)
+                continue
+            got.append(r["task"]["id"])
+            cli.call("task_finished", task_id=r["task"]["id"], trainer_id=1)
+        assert sorted(got).count(dead_tid) == 1, got
+        assert len(got) == 2, "lease never expired back to the queue"
+        stats = cli.call("num_done", trainer_id=1)
+        assert stats == {"done": 2, "todo": 0, "pending": 0}
+        # lease-expiry bumped the failure count exactly once
+        assert svc._done[-1].failures + svc._done[-2].failures == 1
+        cli.close()
+    finally:
+        chan.stop()
+        srv.shutdown()
+
+
+def test_master_restart_requeues_leases_and_survives_corrupt_snapshot(
+        tmp_path):
+    snap = str(tmp_path / "master.json")
+    svc = MasterService(timeout_s=60, snapshot_path=snap)
+    svc._h_set_dataset(chunks=["a", "b"])
+    lease = svc._h_get_task(trainer_id=0)
+    assert lease["task"] is not None
+    # master "dies"; the restart folds the leased task back into todo
+    svc2 = MasterService(timeout_s=60, snapshot_path=snap)
+    assert len(svc2._todo) == 2 and not svc2._pending
+    # a torn snapshot file must mean a cold start, not a crash loop
+    with open(snap, "w") as f:
+        f.write('{"todo": [tor')
+    svc3 = MasterService(timeout_s=60, snapshot_path=snap)
+    assert svc3._todo == [] and svc3._done == [] and not svc3._dataset_set
+
+
+# ---------------------------------------------------------------------------
+# launch.py chaos helpers
+# ---------------------------------------------------------------------------
+
+def test_cluster_kill_one_is_expected_failure():
+    from paddle_tpu.distributed.launch import _Cluster
+
+    cluster = _Cluster()
+    env = dict(os.environ)
+    cluster.spawn("victim", [sys.executable, "-c",
+                             "import time; time.sleep(60)"], env)
+    cluster.spawn("survivor", [sys.executable, "-c",
+                               "print('fine')"], env)
+    cluster.schedule_kill("victim", 0.2)
+    rc = cluster.wait()
+    assert rc == 0, "deliberate SIGKILL leaked into the cluster exit code"
+    assert cluster.proc("victim").returncode != 0
+
+
+def test_launcher_reports_trainer_death_to_pserver():
+    """The pre-heartbeat kill window: a trainer that dies BEFORE its
+    first pserver contact was never tracked, so liveness eviction can't
+    see it — the LAUNCHER's death report (the `evict` verb) must shrink
+    the live set AND drop the ghost's partial round contribution so the
+    sync round completes cleanly."""
+    from paddle_tpu.distributed.launch import _Cluster
+
+    ps = ParameterServer([None], {"g0": 0}, num_trainers=2, sync_mode=True)
+    applied = []
+    ps._apply_shard = lambda idx, feed: applied.append(
+        np.asarray(feed["g0"]).copy())
+    srv = VarServer("127.0.0.1:0", ps).start()
+    try:
+        cli = RPCClient(srv.endpoint, timeout=10, retries=3)
+        # the doomed trainer got HALF its state out before dying: one
+        # grad and its barrier, which must NOT count toward the round
+        cli.send_var("g0", np.full((2,), 100.0), trainer_id=1)
+        cli.call("barrier", kind="fetch", trainer_id=1)  # stale entry
+        cluster = _Cluster()
+
+        # the launch_pserver wiring, minus the jax-importing children
+        def notify(tag, rc):
+            if tag.startswith("trainer."):
+                RPCClient(srv.endpoint, timeout=2, retries=2).call(
+                    "evict", trainer_id=int(tag.split(".", 1)[1]),
+                    deadline_s=5.0)
+
+        cluster.on_child_death = notify
+        cluster.spawn("trainer.1", [sys.executable, "-c",
+                                    "import sys; sys.exit(3)"],
+                      dict(os.environ))
+        cluster.expect_failure("trainer.1")
+        assert cluster.wait() == 0
+        assert ps._live == {0}, "death report never reached pserver"
+        # the survivor's round uses ONLY its own grads
+        cli.send_var("g0", np.full((2,), 3.0), trainer_id=0)
+        assert cli.call("barrier", kind="send", trainer_id=0)["ok"]
+        assert ps._round == 1
+        assert len(applied) == 1
+        np.testing.assert_array_equal(applied[0], np.full((2,), 3.0))
+        cli.close()
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end process death (real SIGKILL, real cluster)
+# ---------------------------------------------------------------------------
+
+def _spawn(env):
+    full = dict(os.environ)
+    full.update(env)
+    full["JAX_PLATFORMS"] = "cpu"
+    full.pop("PALLAS_AXON_POOL_IPS", None)
+    return subprocess.Popen(
+        [sys.executable, _RUNNER], env=full,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+
+
+def _losses(proc, timeout=240):
+    out, err = proc.communicate(timeout=timeout)
+    assert proc.returncode == 0, "runner failed:\n%s\n%s" % (out, err)
+    for line in out.splitlines():
+        if line.startswith("LOSSES "):
+            return json.loads(line[len("LOSSES "):]), out
+    raise AssertionError("no LOSSES line in output:\n%s\n%s" % (out, err))
+
+
+def _wait_port(port, timeout=60):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        try:
+            socket.create_connection(("127.0.0.1", port), timeout=1).close()
+            return
+        except OSError:
+            time.sleep(0.2)
+    raise TimeoutError("pserver port %d never opened" % port)
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_sigkilled_trainer_is_evicted_and_survivor_finishes():
+    """Acceptance: 2 sync trainers, trainer 1 SIGKILLs itself after step
+    1; the pserver evicts it on the liveness deadline and trainer 0
+    completes ALL its steps (the barrier un-hangs) with finite losses."""
+    port = _free_port()
+    eps = "127.0.0.1:%d" % port
+    steps = 4
+    common = {
+        "PADDLE_PSERVER_EPS": eps,
+        "PADDLE_TRAINERS": "2",
+        "DIST_SYNC_MODE": "1",
+        "DIST_STEPS": str(steps),
+        "FLAGS_heartbeat_interval": "0.2",
+        "FLAGS_eviction_deadline": "2.0",
+    }
+    ps = _spawn(dict(common, PADDLE_TRAINING_ROLE="PSERVER",
+                     PADDLE_CURRENT_ENDPOINT=eps))
+    victim = survivor = None
+    try:
+        _wait_port(port)
+        survivor = _spawn(dict(common, PADDLE_TRAINING_ROLE="TRAINER",
+                               PADDLE_TRAINER_ID="0"))
+        victim = _spawn(dict(common, PADDLE_TRAINING_ROLE="TRAINER",
+                             PADDLE_TRAINER_ID="1",
+                             DIST_CRASH_RANK="1",
+                             DIST_CRASH_AFTER_STEP="1"))
+        losses, _ = _losses(survivor, timeout=180)
+        assert len(losses) == steps
+        assert np.isfinite(losses).all(), losses
+        victim.wait(timeout=30)
+        assert victim.returncode != 0  # it really died by SIGKILL
+        ps_out, ps_err = ps.communicate(timeout=60)
+        assert "PSERVER EVICT trainer=1" in ps_out, (ps_out, ps_err)
+    finally:
+        for p in (ps, victim, survivor):
+            if p is not None and p.poll() is None:
+                p.kill()
+
+
+def test_pserver_kill_restart_resumes_from_manifest_checkpoint(tmp_path):
+    """Acceptance: the pserver is SIGKILLed mid-training and restarted on
+    the same port; it restores from the atomic checkpoint (manifest crc
+    verified) and the trainer — retrying with backoff through the outage
+    — finishes every step."""
+    port = _free_port()
+    eps = "127.0.0.1:%d" % port
+    ckpt = str(tmp_path / "ckpt")
+    common = {
+        "PADDLE_PSERVER_EPS": eps,
+        "PADDLE_TRAINERS": "1",
+        "DIST_SYNC_MODE": "0",
+        "DIST_STEPS": "8",
+        "DIST_STEP_SLEEP": "0.2",
+        "PADDLE_PSERVER_CKPT_DIR": ckpt,
+        "PADDLE_PSERVER_CKPT_EVERY": "1",
+        "FLAGS_max_retry": "120",
+    }
+    ps_env = dict(common, PADDLE_TRAINING_ROLE="PSERVER",
+                  PADDLE_CURRENT_ENDPOINT=eps)
+    ps1 = _spawn(ps_env)
+    trainer = ps2 = None
+    try:
+        _wait_port(port)
+        trainer = _spawn(dict(common, PADDLE_TRAINING_ROLE="TRAINER",
+                              PADDLE_TRAINER_ID="0"))
+        ckpt_file = os.path.join(ckpt, "pserver_0.ckpt")
+        manifest = os.path.join(ckpt, "pserver_0.manifest.json")
+        t0 = time.time()
+        while time.time() - t0 < 90 and not (
+                os.path.exists(ckpt_file) and os.path.exists(manifest)):
+            time.sleep(0.1)
+        assert os.path.exists(ckpt_file), "no checkpoint before the kill"
+        assert os.path.exists(manifest), "no manifest before the kill"
+        time.sleep(0.4)  # a couple more rounds land
+        ps1.kill()
+        ps1.wait()
+        ps2 = _spawn(ps_env)
+        losses, _ = _losses(trainer, timeout=240)
+        assert len(losses) == 8
+        assert np.isfinite(losses).all(), losses
+        out, err = ps2.communicate(timeout=90)
+        assert "PSERVER RESTORED" in out, (out, err)
+    finally:
+        for p in (ps1, ps2, trainer):
+            if p is not None and p.poll() is None:
+                p.kill()

@@ -216,10 +216,14 @@ def test_run_loop_matches_sequential_runs():
 
 
 def test_run_loop_failure_reports_invalidated_scope():
-    """ADVICE r4 (low): run_loop donates the rw state to the device; if
-    the compiled call fails mid-flight the executor must raise a CLEAR
-    error naming the invalidated scope state (not a later opaque
-    deleted-buffer error), and must roll back its RNG step counter."""
+    """ADVICE r4 (low) + r5: run_loop donates the rw state to the device;
+    if the compiled call fails AFTER donation (buffers deleted) the
+    executor must raise a CLEAR error naming the invalidated scope state
+    (not a later opaque deleted-buffer error) and roll back its RNG step
+    counter — detected by inspecting the donated buffers themselves, not
+    by classifying the exception type.  A failure that leaves the
+    buffers ALIVE (pre-dispatch argument validation, whatever its
+    exception class) must surface plainly: the scope is intact."""
     import numpy as np
     import pytest
     import paddle_tpu as fluid
@@ -240,14 +244,45 @@ def test_run_loop_failure_reports_invalidated_scope():
         exe.run_loop(2, main, feed={"dlx": xv}, fetch_list=[loss])
         step_before = exe._step
 
-        def boom(*a, **k):
-            raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+        def boom_donated(feeds, ro_state, rw_state, keys):
+            # model a mid-flight device failure: by then the donated rw
+            # buffers are already consumed (deleted)
+            for v in rw_state.values():
+                if hasattr(v, "delete"):
+                    v.delete()
+            raise TypeError("callback exploded after dispatch")
 
+        real_cache = dict(exe._loop_cache)
         exe._loop_cache = {
-            k: (traced, boom) for k, (traced, jitted)
-            in exe._loop_cache.items()
+            k: (traced, boom_donated) for k, (traced, _)
+            in real_cache.items()
         }
+        # a TypeError AFTER donation still gets the clear diagnostic
         with pytest.raises(RuntimeError, match="scope state .* invalidated"
                            "|state was donated"):
             exe.run_loop(2, main, feed={"dlx": xv}, fetch_list=[loss])
         assert exe._step == step_before  # rolled back
+
+    # fresh state: a failure BEFORE donation (buffers left alive) must
+    # surface the original error, whatever its class
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        exe2.run(startup)
+        exe2.run_loop(2, main, feed={"dlx": xv}, fetch_list=[loss])
+        step2 = exe2._step
+
+        def boom_predispatch(*a, **k):
+            raise RuntimeError("RESOURCE_EXHAUSTED: argument mismatch "
+                               "before dispatch")
+
+        exe2._loop_cache = {
+            k: (traced, boom_predispatch) for k, (traced, _)
+            in exe2._loop_cache.items()
+        }
+        with pytest.raises(RuntimeError, match="before dispatch"):
+            exe2.run_loop(2, main, feed={"dlx": xv}, fetch_list=[loss])
+        assert exe2._step == step2  # still rolled back
+        # and the scope really is intact: a fixed cache lets it run again
+        exe2._loop_cache = {}
+        exe2.run_loop(2, main, feed={"dlx": xv}, fetch_list=[loss])

@@ -326,6 +326,111 @@ def test_native_transport_hmac(monkeypatch):
         RPCClient.reset_all()
 
 
+def test_decoder_oversized_inner_lengths_rejected():
+    """Length fields INSIDE the payload claiming more bytes than the
+    frame holds must raise a parse error, never read past the buffer or
+    allocate the claimed size."""
+    # str claiming 4 GiB
+    bad = b"S" + struct.pack(">I", 0xFFFFFFFF) + b"abc"
+    with pytest.raises(ValueError, match="truncated"):
+        _Reader(bad).decode()
+    # bytes claiming far more than present
+    bad = b"B" + struct.pack(">I", 1 << 30) + b"x"
+    with pytest.raises(ValueError, match="truncated"):
+        _Reader(bad).decode()
+    # list claiming 2**32-1 elements backed by nothing
+    bad = b"L" + struct.pack(">I", 0xFFFFFFFF)
+    with pytest.raises(ValueError, match="truncated"):
+        _Reader(bad).decode()
+    # array header promising 255 dims, then EOF
+    bad = b"A" + struct.pack(">I", 3) + b"<f4" + bytes([255])
+    with pytest.raises(ValueError, match="truncated"):
+        _Reader(bad).decode()
+
+
+def test_decoder_rejects_non_str_dict_keys():
+    bad = b"M" + struct.pack(">I", 1) + b"I" + struct.pack(">q", 1) + b"N"
+    with pytest.raises(ValueError, match="dict key"):
+        _Reader(bad).decode()
+
+
+def test_decoder_array_size_mismatch_rejected():
+    """An array frame whose nbytes field disagrees with shape*itemsize is
+    refused (a lying peer can't make frombuffer mis-slice)."""
+    ds = b"<f4"
+    bad = bytearray()
+    bad += b"A" + struct.pack(">I", len(ds)) + ds + bytes([1])
+    bad += struct.pack(">q", 2)  # shape (2,) => expect 8 bytes
+    bad += struct.pack(">Q", 4) + b"\x00" * 4  # claims (and ships) 4
+    with pytest.raises(ValueError, match="size mismatch"):
+        _Reader(bytes(bad)).decode()
+
+
+def test_partial_frame_then_close_leaves_server_alive():
+    """A peer that promises a frame and dies mid-payload (the truncation
+    chaos case): the server's reader sees EOF, drops the connection, and
+    keeps serving well-formed clients — it must never hang waiting."""
+    srv, ep = _mk_server()
+    try:
+        host, port = ep.rsplit(":", 1)
+        payload = bytes(_encode(("ping", {}, "trunc1"), bytearray()))
+        frame = bytes([PROTO_VERSION]) + payload
+        s = socket.create_connection((host, int(port)), timeout=5)
+        s.sendall(struct.pack(">Q", len(frame)) + frame[: len(frame) // 2])
+        s.close()  # die mid-frame
+        # zero-length frame is also rejected (length must be >= 1)
+        s2 = socket.create_connection((host, int(port)), timeout=5)
+        s2.sendall(struct.pack(">Q", 0))
+        s2.settimeout(5)
+        assert s2.recv(1) == b""
+        s2.close()
+        cli = RPCClient(ep, timeout=5, retries=2)
+        assert cli.call("ping")["ok"] is True
+        cli.close()
+    finally:
+        srv.shutdown()
+
+
+def test_client_truncated_reply_raises_not_hangs():
+    """A 'server' that replies with half a frame then closes: the client
+    must surface a connection/parse error promptly — with retries
+    exhausted it raises instead of hanging or trusting the partial."""
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(1)
+    ep = "127.0.0.1:%d" % lsock.getsockname()[1]
+
+    def evil_server():
+        for _ in range(3):  # one per client round-trip attempt
+            try:
+                conn, _ = lsock.accept()
+            except OSError:
+                return
+            try:
+                conn.settimeout(5)
+                _recv_msg(conn)  # read the request fully
+                reply = bytes(_encode(("__reply__", "x", {"ok": True}),
+                                      bytearray()))
+                frame = bytes([PROTO_VERSION]) + reply
+                conn.sendall(struct.pack(">Q", len(frame))
+                             + frame[: len(frame) // 2])
+            except (OSError, ValueError):
+                pass
+            finally:
+                conn.close()
+
+    t = threading.Thread(target=evil_server, daemon=True)
+    t.start()
+    try:
+        cli = RPCClient(ep, timeout=5, retries=2, retry_wait=0.05)
+        with pytest.raises((ConnectionError, OSError)):
+            cli.call("ping")
+        cli.close()
+    finally:
+        lsock.close()
+        t.join(timeout=5)
+
+
 def test_wire_decoder_fuzz_never_crashes():
     """Property check: random byte soup either decodes to a value or
     raises ValueError/UnicodeDecodeError — never any other exception and
@@ -370,6 +475,47 @@ def test_pserver_adam_beta_pows_advance_on_rowless_rounds():
         ps._run_round()  # ROWLESS round: pows must still advance
     assert abs(info["beta1_pow"] - b1p_1 * 0.9) < 1e-12
     assert abs(info["beta2_pow"] - b2p_1 * 0.999) < 1e-12
+
+
+def test_pserver_async_rowless_tables_advance_on_lr_trigger():
+    """ADVICE r5: in ASYNC mode a sparse table that receives no rows must
+    still advance its slot state — caught up once per lr-trigger send
+    (the per-step marker).  Touched tables keep the per-application
+    lazy-adam rule and are NOT double-advanced by the trigger."""
+    import numpy as np
+
+    from paddle_tpu.distributed.ps_server import ParameterServer
+
+    adam = {"type": "adam", "attrs": {"beta1": 0.9, "beta2": 0.999}}
+    ps = ParameterServer(
+        [None], {"g": 0}, num_trainers=1, sync_mode=False,
+        sparse_tables={
+            "touched": {"tbl": np.zeros((4, 2), np.float32), "lr": 0.1,
+                        "opt": dict(adam)},
+            "idle": {"tbl": np.zeros((4, 2), np.float32), "lr": 0.1,
+                     "opt": dict(adam)},
+            "idle_m": {"tbl": np.ones((4, 2), np.float32), "lr": 0.1,
+                       "opt": {"type": "momentum", "attrs": {"mu": 0.5}}},
+        })
+    ps._apply_shard = lambda idx, feed: None
+    ps.sparse_tables["idle_m"]["velocity"] = np.ones((4, 2), np.float32)
+
+    # step 1: rows for "touched" only, then the dense lr-trigger send
+    ps._h_send_sparse("touched", np.array([1]),
+                      np.ones((1, 2), np.float32))
+    ps._h_send("g", np.zeros((1,), np.float32))
+    t, i = ps.sparse_tables["touched"], ps.sparse_tables["idle"]
+    assert abs(t["beta1_pow"] - 0.9 ** 2) < 1e-12  # one application
+    assert abs(i["beta1_pow"] - 0.9 ** 2) < 1e-12  # trigger catch-up
+    np.testing.assert_allclose(ps.sparse_tables["idle_m"]["velocity"],
+                               0.5 * np.ones((4, 2)))  # decayed once
+
+    # step 2: NO sparse rows at all; the trigger advances everything once
+    ps._h_send("g", np.zeros((1,), np.float32))
+    assert abs(t["beta1_pow"] - 0.9 ** 3) < 1e-12
+    assert abs(i["beta1_pow"] - 0.9 ** 3) < 1e-12
+    np.testing.assert_allclose(ps.sparse_tables["idle_m"]["velocity"],
+                               0.25 * np.ones((4, 2)))
 
 
 def test_pserver_momentum_rowless_round_decays_velocity():
