@@ -706,7 +706,7 @@ def _dist_smokes():
         for k in ("DIST_MODEL", "DIST_SPARSE_IDS", "DIST_OPTIMIZER"):
             leg_env.pop(k, None)
         leg_env.update({k: v for k, v in overrides.items() if v})
-        vals, err = [], None
+        vals, err, counters = [], None, None
         for _rep in range(repeats):
             t0 = _t.time()
             try:
@@ -721,6 +721,24 @@ def _dist_smokes():
                         proc.stdout[-300:].decode("utf-8", "replace"))}
                     break
                 vals.append(steps / dt)
+                # deterministic comm evidence: every trainer prints a
+                # COUNTERS json line (round trips / bytes / feed ms) —
+                # summed across trainers, they are a property of the op
+                # plan, so a regression shows without wall-clock noise
+                agg = {}
+                for ln in proc.stdout.decode("utf-8", "replace").splitlines():
+                    # launch.py prefixes child lines with "[trainer.N] "
+                    pos = ln.find("COUNTERS ")
+                    if pos < 0:
+                        continue
+                    try:
+                        c = json.loads(ln[pos + len("COUNTERS "):])
+                    except ValueError:
+                        continue
+                    for k, v in c.items():
+                        agg[k] = round(agg.get(k, 0) + v, 3)
+                if agg:
+                    counters = agg
             except subprocess.TimeoutExpired:
                 err = {"error": "timeout"}
                 break
@@ -737,6 +755,8 @@ def _dist_smokes():
                 "spread": round(max(vals) - min(vals), 3),
                 "samples": [round(v, 3) for v in vals],
             }
+            if counters is not None:
+                out[name]["counters"] = counters
     # BASELINE config 5 dist leg: GPT-2 TP+DP step over the 8-device
     # virtual mesh (one process; a step-time artifact, not a scaling claim)
     env_tp = dict(env)

@@ -40,6 +40,11 @@ tables advance per-application rather than per-step, each trainer's own
 trigger fires the catch-up (so N async trainers advance untouched
 tables ~N times per global step), and a pure-sparse model (no dense
 grad, hence no lr trigger) keeps the legacy per-application-only rule.
+With bucketed comm (FLAGS_comm_bucket_bytes) and comm_inflight > 1, an
+async step spanning several buckets per endpoint may also interleave
+the lr-trigger bucket with another bucket's applications — arrival
+order across the in-flight window is free.  Sync mode is unaffected:
+its application order comes from the round barrier, not arrival.
 """
 
 import threading
@@ -105,6 +110,10 @@ class ParameterServer:
         self._pending = {}  # grad block name -> {trainer_id: np.ndarray}
         self._send_barriers = set()
         self._fetch_barriers = set()
+        # folded-barrier bookkeeping (bucketed wire path): how many of a
+        # trainer's declared per-step buckets this server has seen
+        self._send_bucket_counts = {}  # trainer_id -> buckets this round
+        self._fetch_bucket_counts = {}
         self._round = 0  # bumped after each optimize step
         self._params_ready = not sync_mode
         # liveness: the explicit live set replaces the old bare count so
@@ -409,17 +418,28 @@ class ParameterServer:
         ]
         self._send_barriers.discard(tid)
         self._fetch_barriers.discard(tid)
+        self._send_bucket_counts.pop(tid, None)
+        self._fetch_bucket_counts.pop(tid, None)
         if not self._live:
             self._done.set()
         elif self.sync_mode:
-            if (self._send_barriers
-                    and len(self._send_barriers) >= len(self._live)):
-                self._run_round()
-            if (self._fetch_barriers
-                    and len(self._fetch_barriers) >= len(self._live)):
-                self._fetch_barriers.clear()
-                self._params_ready = False
+            self._reeval_barriers_locked()
         self._cv.notify_all()
+
+    def _reeval_barriers_locked(self):
+        """The live set shrank (eviction / clean departure): pending
+        barriers re-evaluate against the survivors.  FETCH first — a
+        pending fetch barrier belongs to the round already SERVED, and
+        re-evaluating it after _run_round would flip the fresh round's
+        params_ready back off, hanging every surviving get on a flag
+        nothing will set again."""
+        if (self._fetch_barriers
+                and len(self._fetch_barriers) >= len(self._live)):
+            self._fetch_barriers.clear()
+            self._params_ready = False
+        if (self._send_barriers
+                and len(self._send_barriers) >= len(self._live)):
+            self._run_round()
 
     # ---- verb dispatch ---------------------------------------------------
     def handle(self, verb, **kw):
@@ -535,6 +555,110 @@ class ParameterServer:
                 return {"ok": False, "evicted": True}
             self._pending.setdefault(name, {})[trainer_id] = value
         return {"ok": True}
+
+    def _h_send_bucket(self, blocks, trainer_id=0, seq_total=None):
+        """Coalesced grad frame: `blocks` maps grad block name -> value,
+        shipped as ONE rpc round trip (see ops/dist_ops.py send_bucket).
+        Server-side the bucket is unpacked into exactly the per-block
+        paths _h_send uses — pending tables in sync mode, immediate shard
+        application (with the lr-trigger bookkeeping) in async — so
+        optimizer slot logic never sees the difference.
+
+        `seq_total` (sync mode) folds the send barrier into the bucket
+        stream: the trainer declares how many buckets it ships to THIS
+        server per step, and the arrival of the last one (arrival ORDER
+        is free — the window delivers out of order) counts as the
+        trainer's send barrier, saving a dedicated blocking round trip.
+        That last call blocks until the round runs, exactly like the
+        explicit barrier verb it replaces."""
+        if not self.sync_mode:
+            # sorted order keeps the lr trigger (min grad name) firing
+            # before the other shards of the same logical step WITHIN a
+            # bucket.  Across buckets, comm_inflight > 1 can reorder
+            # arrivals, so a multi-bucket async step may interleave the
+            # trigger with another bucket's grads — one more term of the
+            # documented async approximation (module docstring); sync
+            # mode is exact, its ordering comes from the round barrier.
+            for name in sorted(blocks):
+                r = self._h_send(name, blocks[name], trainer_id)
+                if isinstance(r, dict) and r.get("evicted"):
+                    return r
+            return {"ok": True}
+        with self._cv:
+            self._touch(trainer_id)
+            tid = int(trainer_id)
+            if tid in self._evicted:
+                return {"ok": False, "evicted": True}
+            for name, value in blocks.items():
+                self._pending.setdefault(name, {})[trainer_id] = \
+                    np.asarray(value)
+            if not seq_total:
+                return {"ok": True}
+            c = self._send_bucket_counts.get(tid, 0) + 1
+            if c < int(seq_total):
+                self._send_bucket_counts[tid] = c
+                return {"ok": True}
+            # last bucket of this trainer's step: its send barrier
+            self._send_bucket_counts[tid] = 0
+            self._send_barriers.add(trainer_id)
+            if len(self._send_barriers) >= len(self._live):
+                self._run_round()
+            else:
+                rnd = self._round
+                self._cv.wait_for(
+                    lambda: self._round > rnd or self._done.is_set()
+                    or tid in self._evicted
+                )
+                if tid in self._evicted:
+                    return {"ok": False, "evicted": True}
+        return {"ok": True}
+
+    def _h_get_bucket(self, names, trainer_id=0, fetch_total=None):
+        """Coalesced param fetch: one frame returns every requested block
+        — and in sync mode ONE params-ready wait covers the whole bucket
+        instead of one blocking round trip per variable.  `fetch_total`
+        folds the fetch barrier in: when this trainer's last declared
+        bucket has been served (any arrival order) it counts as the
+        trainer's fetch barrier, and the round resets once every live
+        trainer got theirs."""
+        if self.sync_mode:
+            with self._cv:
+                self._touch(trainer_id)
+                self._cv.wait_for(
+                    lambda: self._params_ready or self._done.is_set()
+                )
+                if int(trainer_id) in self._evicted:
+                    raise RuntimeError(
+                        "trainer %s was evicted from the sync round; "
+                        "params reflect a round it did not participate "
+                        "in — restart the trainer to rejoin"
+                        % (trainer_id,))
+        out = {}
+        for name in names:
+            var = self.scope.find_var(name)
+            if var is None:
+                raise KeyError("pserver has no var %s" % name)
+            out[name] = np.asarray(var)
+        if self.sync_mode and fetch_total:
+            with self._cv:
+                tid = int(trainer_id)
+                if tid in self._evicted:
+                    # evicted between the params wait and here: a ghost
+                    # must not count toward the survivors' fetch barrier
+                    raise RuntimeError(
+                        "trainer %s was evicted from the sync round"
+                        % (trainer_id,))
+                c = self._fetch_bucket_counts.get(tid, 0) + 1
+                if c < int(fetch_total):
+                    self._fetch_bucket_counts[tid] = c
+                else:
+                    self._fetch_bucket_counts[tid] = 0
+                    self._fetch_barriers.add(trainer_id)
+                    if len(self._fetch_barriers) >= len(self._live):
+                        self._fetch_barriers.clear()
+                        self._params_ready = False
+                        self._cv.notify_all()
+        return out
 
     def _h_barrier(self, kind, trainer_id=0):
         if not self.sync_mode:
@@ -743,14 +867,17 @@ class ParameterServer:
             self._tracked.pop(tid, None)
             if not self._live:
                 self._done.set()
-            # a departing trainer may unblock a pending round
-            if (
-                self.sync_mode
-                and self._live
-                and self._send_barriers
-                and len(self._send_barriers) >= len(self._live)
-            ):
-                self._run_round()
+            # a departing trainer may unblock a pending round.  Its SEND
+            # entry is kept (a clean departure's grads still count toward
+            # the round it joined) but its FETCH entry is dropped: "I
+            # already fetched" must not complete the fetch count while
+            # survivors are still mid-fetch — that would reset
+            # params_ready under their remaining gets
+            self._fetch_barriers.discard(tid)
+            self._send_bucket_counts.pop(tid, None)
+            self._fetch_bucket_counts.pop(tid, None)
+            if self.sync_mode and self._live:
+                self._reeval_barriers_locked()
             self._cv.notify_all()
         return {"ok": True}
 

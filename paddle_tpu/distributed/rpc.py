@@ -53,6 +53,38 @@ def _hmac_key():
     return key.encode() if key else None
 
 
+# ---- client-side comm counters ------------------------------------------
+# Deterministic evidence for the bucketing/pipelining work: round trips and
+# bytes are a property of the op plan (not wall clock), so bench and the
+# tier-1 smoke can assert on them without timing flakiness.  Counted in
+# RPCClient._call_locked only — server handlers share _send_msg/_recv_msg,
+# and counting both sides would double every in-process test.
+_comm_lock = threading.Lock()
+_comm_stats = {"rpc_round_trips": 0, "comm_bytes_sent": 0,
+               "comm_bytes_recv": 0}
+
+
+def _bump_comm(trips=0, sent=0, recv=0):
+    with _comm_lock:
+        _comm_stats["rpc_round_trips"] += trips
+        _comm_stats["comm_bytes_sent"] += sent
+        _comm_stats["comm_bytes_recv"] += recv
+
+
+def get_comm_stats():
+    """Snapshot of this process's client-side RPC counters (heartbeat
+    traffic excluded — it is wall-clock-paced, and these counters exist
+    to be a deterministic property of the op plan)."""
+    with _comm_lock:
+        return dict(_comm_stats)
+
+
+def reset_comm_stats():
+    with _comm_lock:
+        for k in _comm_stats:
+            _comm_stats[k] = 0
+
+
 def _encode(obj, out):
     if obj is None:
         out += _T_NONE
@@ -165,7 +197,9 @@ def _send_msg(sock, obj):
     key = _hmac_key()
     mac = hmac_mod.new(key, payload, hashlib.sha256).digest() if key else b""
     head = bytes([PROTO_VERSION]) + mac
-    sock.sendall(_LEN.pack(len(head) + len(payload)) + head + payload)
+    frame = _LEN.pack(len(head) + len(payload)) + head + payload
+    sock.sendall(frame)
+    return len(frame)
 
 
 def _recv_exact(sock, n):
@@ -179,6 +213,10 @@ def _recv_exact(sock, n):
 
 
 def _recv_msg(sock):
+    return _recv_msg_sized(sock)[0]
+
+
+def _recv_msg_sized(sock):
     (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
     if n < 1 or n > MAX_FRAME:
         raise ValueError("rpc frame length %d out of bounds" % n)
@@ -202,7 +240,7 @@ def _recv_msg(sock):
     if r.pos != len(r.buf):
         raise ValueError("rpc frame has %d trailing bytes"
                          % (len(r.buf) - r.pos))
-    return obj
+    return obj, _LEN.size + n
 
 
 class _InFlight:
@@ -487,6 +525,7 @@ class RPCClient:
     @classmethod
     def reset_all(cls):
         stop_heartbeats()
+        PipelinedClient.reset_all()
         with cls._lock:
             for cli in cls._instances.values():
                 cli.close()
@@ -540,7 +579,7 @@ class RPCClient:
         if get_flag("enable_rpc_profiler"):
             from ..profiler import RecordEvent
 
-            with RecordEvent("rpc_" + verb):
+            with RecordEvent("rpc_" + verb, cat="comm"):
                 return self._call_locked(verb, timeout_s, kwargs, deadline_s)
         return self._call_locked(verb, timeout_s, kwargs, deadline_s)
 
@@ -599,8 +638,8 @@ class RPCClient:
                                 min(self.timeout, left)
                         if eff is not None:
                             self._sock.settimeout(eff)
-                        _send_msg(self._sock, (verb, kwargs, req_id))
-                        result = _recv_msg(self._sock)
+                        sent = _send_msg(self._sock, (verb, kwargs, req_id))
+                        result, recvd = _recv_msg_sized(self._sock)
                         # unwrap the reply envelope, discarding STALE
                         # replies: a duplicated request frame yields an
                         # extra reply whose req_id pairs it with a past
@@ -609,10 +648,17 @@ class RPCClient:
                                and len(result) == 3
                                and result[0] == "__reply__"
                                and result[1] != req_id):
-                            result = _recv_msg(self._sock)
+                            result, more = _recv_msg_sized(self._sock)
+                            recvd += more
                         if (isinstance(result, tuple) and len(result) == 3
                                 and result[0] == "__reply__"):
                             result = result[2]
+                        # heartbeats are wall-clock-paced background
+                        # liveness, not op-plan traffic: counting them
+                        # would make the "deterministic" counters vary
+                        # with run duration
+                        if verb != "heartbeat":
+                            _bump_comm(trips=1, sent=sent, recv=recvd)
                         break
                     except socket.timeout:
                         drop_sock()
@@ -699,6 +745,125 @@ class RPCClient:
                 except OSError:
                     pass
                 self._sock = None
+
+
+class PipelinedClient:
+    """Windowed in-flight RPC to one endpoint (the async gRPC completion
+    queue role, grpc_client.h AsyncSendVar/Wait): up to
+    FLAGS_comm_inflight calls outstanding at once, each on its OWN
+    connection+worker so bucket N+1 serializes and ships while bucket N
+    is on the wire.  submit() returns a future; drain() joins every
+    outstanding call and surfaces the first failure.
+
+    Each worker is a full RPCClient, so per-call retry/backoff/deadline
+    hardening and the server's req_id dedup (at-most-once) hold exactly
+    as on the serial path — pipelining changes WHEN calls overlap, not
+    their delivery semantics.  Call-completion ORDER across the window is
+    unspecified; callers that need a happens-before edge (barriers, gets
+    after sends) drain first."""
+
+    _lock = threading.Lock()
+    _instances = {}
+
+    def __init__(self, endpoint, window=None, timeout=None, retries=None,
+                 retry_wait=0.1):
+        from ..flags import get_flag
+
+        self.endpoint = endpoint
+        w = window if window is not None else get_flag("comm_inflight")
+        self.window = max(1, int(w))
+        # worker-client knobs (tests pin small timeouts under fault
+        # injection); None = the RPCClient flag defaults
+        self._client_opts = (timeout, retries, retry_wait)
+        self._pool = None
+        self._pool_lock = threading.Lock()
+        self._pending = []
+        self._clients = []  # worker-thread RPCClients, for close()
+        self._tls = threading.local()
+
+    @classmethod
+    def get(cls, endpoint):
+        with cls._lock:
+            cli = cls._instances.get(endpoint)
+            if cli is None:
+                cli = cls._instances[endpoint] = cls(endpoint)
+            return cli
+
+    @classmethod
+    def reset_all(cls):
+        with cls._lock:
+            insts = list(cls._instances.values())
+            cls._instances.clear()
+        for inst in insts:
+            inst.close()
+
+    def _worker_client(self):
+        cli = getattr(self._tls, "cli", None)
+        if cli is None:
+            timeout, retries, retry_wait = self._client_opts
+            cli = self._tls.cli = RPCClient(
+                self.endpoint, timeout=timeout, retries=retries,
+                retry_wait=retry_wait)
+            with self._pool_lock:
+                self._clients.append(cli)
+        return cli
+
+    def _ensure_pool(self):
+        with self._pool_lock:
+            if self._pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._pool = ThreadPoolExecutor(
+                    max_workers=self.window,
+                    thread_name_prefix="rpc-inflight-%s" % self.endpoint)
+            return self._pool
+
+    def submit(self, verb, timeout_s=None, **kwargs):
+        """Queue one call into the window; returns a Future.  With the
+        window full the pool queues it (still submitted, just not yet on
+        the wire) — the cap bounds CONCURRENCY (connections + frames
+        being serialized at once), not memory: queued tasks keep their
+        payload arrays alive until a worker picks them up."""
+        pool = self._ensure_pool()
+        fut = pool.submit(self._run_one, verb, timeout_s, kwargs)
+        with self._pool_lock:
+            self._pending.append(fut)
+        return fut
+
+    def _run_one(self, verb, timeout_s, kwargs):
+        return self._worker_client().call(verb, timeout_s=timeout_s,
+                                          **kwargs)
+
+    def drain(self):
+        """Wait out every outstanding call; returns their results in
+        submit order and raises the FIRST failure (after letting the rest
+        finish, so a retrying straggler can't leak into the next round)."""
+        with self._pool_lock:
+            pending, self._pending = self._pending, []
+        err = None
+        results = []
+        for fut in pending:
+            try:
+                results.append(fut.result())
+            except BaseException as e:
+                if err is None:
+                    err = e
+        if err is not None:
+            raise err
+        return results
+
+    def close(self):
+        with self._pool_lock:
+            pool, self._pool = self._pool, None
+            clients, self._clients = self._clients, []
+            self._pending = []
+        if pool is not None:
+            pool.shutdown(wait=True)
+        for cli in clients:
+            try:
+                cli.close()
+            except Exception:
+                pass
 
 
 # ---- trainer liveness heartbeats --------------------------------------

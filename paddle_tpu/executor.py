@@ -15,11 +15,14 @@ from . import framework
 from .core import scope as scope_mod
 from .core.trace import ExecutionCache
 from .places import CPUPlace, default_place
+from .profiler import RecordEvent
 
 __all__ = ["Executor", "global_scope", "scope_guard"]
 
 global_scope = scope_mod.global_scope
 scope_guard = scope_mod.scope_guard
+
+_FAST_MISS = object()  # sentinel: fast-path preconditions broke, go slow
 
 
 def as_numpy(value):
@@ -48,6 +51,17 @@ class Executor:
         self._step = 0
         self._key_cache = {}
         self._closed = False
+        # steady-state run() memo: (program, feed-keys, fetches, scope) ->
+        # everything the slow path re-derives per step (compiled
+        # executable, feed spec, state classification).  See run().
+        self._run_cache = {}
+        self._host_feed_ms = 0.0  # cumulative feed-upload wall time
+
+    @property
+    def host_feed_ms(self):
+        """Cumulative milliseconds run() spent staging feeds onto the
+        device (the host_feed_ms bench counter)."""
+        return self._host_feed_ms
 
     def _commit_state(self, n, v, device, scope):
         """Normalize state to a COMMITTED on-device array.  Startup
@@ -89,8 +103,17 @@ class Executor:
         return base
 
     def _rng_key(self, program):
-        # folding in the step counter advances streams across runs
-        key = jax.random.fold_in(self._rng_base(program), self._step)
+        # folding in the step counter advances streams across runs.  The
+        # fold is jitted: eagerly it binds ~6 primitives of host dispatch
+        # per step (profiled at ~1ms on CPU — comparable to the whole
+        # compiled step for small models); jitted it is one cached-
+        # executable dispatch.  The step rides in as a fixed-dtype array
+        # so every step hits the same executable.
+        fold = getattr(self, "_fold_fn", None)
+        if fold is None:
+            fold = self._fold_fn = jax.jit(
+                lambda k, s: jax.random.fold_in(k, s))
+        key = fold(self._rng_base(program), np.uint32(self._step))
         self._step += 1
         return key
 
@@ -147,6 +170,76 @@ class Executor:
             program = framework.default_main_program()
         if scope is None:
             scope = global_scope()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        fetch_names = [
+            v.name if isinstance(v, framework.Variable) else str(v) for v in fetch_list
+        ]
+        # steady-state fast path: everything the slow path re-derives per
+        # step — the listen_and_serv/reader op scans, per-feed var lookup
+        # + dtype-kind guard, the sorted feed-signature tuple, and the
+        # compile-cache hash — is memoized per (program version,
+        # feed-keys, fetches, scope).  The memo only validates that each
+        # feed still matches the recorded (shape, dtype); any surprise
+        # falls back to the full path, which refreshes the memo.
+        fast_key = (id(program), program._version, id(scope),
+                    tuple(fetch_names), tuple(sorted(feed)))
+        entry = self._run_cache.get(fast_key)
+        if entry is not None:
+            out = self._run_fast(entry, program, feed, fetch_names, scope,
+                                 return_numpy)
+            if out is not _FAST_MISS:
+                return out
+        return self._run_slow(program, feed, fetch_names, scope,
+                              return_numpy, fast_key)
+
+    def _run_fast(self, entry, program, feed, fetch_names, scope,
+                  return_numpy):
+        from .flags import get_flag
+
+        if (bool(get_flag("use_pallas")),
+                get_flag("prng_impl")) != entry["flags"]:
+            return _FAST_MISS  # lowering flags flipped: recompile path
+        device = entry["device"]
+        spec = entry["feed_spec"]
+        import time as _time
+
+        t0 = _time.perf_counter()
+        feed_arrays = {}
+        with RecordEvent("feed_upload", cat="feed"):
+            for name, value in feed.items():
+                want = spec.get(name)
+                shape = getattr(value, "shape", None)
+                dtype = getattr(value, "dtype", None)
+                if (want is None or shape is None or dtype is None
+                        or (tuple(shape), str(dtype)) != want):
+                    return _FAST_MISS
+                if isinstance(value, jax.Array):
+                    if (getattr(value, "committed", True)
+                            and device in value.devices()):
+                        feed_arrays[name] = value  # pre-staged (prefetch)
+                    else:
+                        feed_arrays[name] = jax.device_put(value, device)
+                elif isinstance(value, np.ndarray):
+                    feed_arrays[name] = jax.device_put(value, device)
+                else:
+                    return _FAST_MISS  # LoDTensor / list feeds: slow path
+        self._host_feed_ms += (_time.perf_counter() - t0) * 1e3
+        compiled = entry["compiled"]
+        traced = compiled.traced
+        ro_state = {}
+        for n in traced.ro_names:
+            ro_state[n] = self._commit_state(n, scope.find_var(n), device,
+                                             scope)
+        rw_state = {}
+        for n in traced.rw_names:
+            rw_state[n] = self._commit_state(n, scope.find_var(n), device,
+                                             scope)
+        return self._finish_run(compiled, feed_arrays, ro_state, rw_state,
+                                program, fetch_names, scope, return_numpy)
+
+    def _run_slow(self, program, feed, fetch_names, scope, return_numpy,
+                  fast_key):
         # pserver program: block on the listen_and_serv service loop
         # (ListenAndServOp::RunImpl analog) instead of compiling
         if any(
@@ -156,14 +249,14 @@ class Executor:
 
             run_pserver(program, scope, self)
             return []
-        feed = feed or {}
-        fetch_list = fetch_list or []
-        fetch_names = [
-            v.name if isinstance(v, framework.Variable) else str(v) for v in fetch_list
-        ]
 
         device = self.place.jax_device()
-        feed_arrays = self._prepare_feed(program, feed, device)
+        import time as _time
+
+        t0 = _time.perf_counter()
+        with RecordEvent("feed_upload", cat="feed"):
+            feed_arrays = self._prepare_feed(program, feed, device)
+        self._host_feed_ms += (_time.perf_counter() - t0) * 1e3
 
         # in-program readers: satisfy `read` op outputs from the staged
         # device queue (create_py_reader/double_buffer analog — host IO
@@ -202,10 +295,36 @@ class Executor:
             rw_state[n] = self._commit_state(n, scope.find_var(n), device,
                                              scope)
 
-        key = self._rng_key(program)
-        from .flags import get_flag
-        from .profiler import RecordEvent
+        # memoize for the steady-state fast path — only shapes the fast
+        # path can fully re-validate (plain array feeds, no reader ops)
+        if not readers and all(
+            isinstance(v, (np.ndarray, jax.Array)) for v in feed.values()
+        ):
+            from .flags import get_flag
 
+            # spec records the RAW feed's (shape, dtype) — a float64
+            # numpy feed canonicalizes to f32 on staging, and matching
+            # against the staged dtype would miss the fast path on every
+            # step (device_put canonicalizes identically on both paths)
+            self._run_cache[fast_key] = {
+                "compiled": compiled,
+                "device": device,
+                "feed_spec": {
+                    n: (tuple(v.shape), str(v.dtype))
+                    for n, v in feed.items()
+                },
+                "flags": (bool(get_flag("use_pallas")),
+                          get_flag("prng_impl")),
+            }
+
+        return self._finish_run(compiled, feed_arrays, ro_state, rw_state,
+                                program, fetch_names, scope, return_numpy)
+
+    def _finish_run(self, compiled, feed_arrays, ro_state, rw_state,
+                    program, fetch_names, scope, return_numpy):
+        from .flags import get_flag
+
+        key = self._rng_key(program)
         import time as _time
 
         t0 = _time.time()
@@ -407,6 +526,7 @@ class Executor:
 
         distributed.send_complete_all()
         self._cache.clear()
+        self._run_cache.clear()
         if getattr(self, "_loop_cache", None):
             self._loop_cache.clear()
         self._closed = True

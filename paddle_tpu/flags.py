@@ -108,6 +108,21 @@ DEFINE_flag("eviction_deadline", 20.0,
             "from the sync round — pending barriers re-evaluate against "
             "the surviving live set instead of hanging forever")
 DEFINE_flag("enable_rpc_profiler", False, "RecordEvent spans around RPC")
+DEFINE_flag("comm_bucket_bytes", 4 * 1024 * 1024,
+            "size cap (bytes) for coalesced grad/param buckets in pserver "
+            "mode: DistributeTranspiler groups small blocks into buckets "
+            "and each bucket ships as ONE rpc frame per pserver instead "
+            "of one round trip per variable (0 restores the legacy "
+            "per-variable send/recv ops)")
+DEFINE_flag("comm_inflight", 4,
+            "window of in-flight bucket RPCs per pserver endpoint: bucket "
+            "N+1 serializes and sends while bucket N is on the wire; "
+            "send_barrier / the next recv drains the window (1 = fully "
+            "serial, the pre-pipelining behavior)")
+DEFINE_flag("feed_prefetch", 2,
+            "depth of the reader.feed_prefetch double buffer: batch N+1 "
+            "is device_put on a background thread while step N computes "
+            "(0 disables staging; the decorator passes batches through)")
 DEFINE_flag("cudnn_deterministic", False,
             "compat; XLA compilation is deterministic already")
 DEFINE_flag("use_mkldnn", False, "compat no-op (XLA owns fusion)")

@@ -28,6 +28,48 @@ def _client(ep, trainer_id=None):
     return RPCClient.get(ep)
 
 
+def _client_map(trainer_id):
+    """Per-op client memo: each lowering's host callback runs once per
+    STEP per VARIABLE, and `_client` re-takes the registry lock and
+    re-fires the endpoint/heartbeat registration every call.  The memo
+    resolves each endpoint once per op (lazily, at the first step, when
+    the servers are definitely up) and hands back the cached client from
+    then on — the per-step hot path is one dict hit."""
+    cache = {}
+
+    def get(ep):
+        cli = cache.get(ep)
+        if cli is None:
+            cli = cache[ep] = _client(ep, trainer_id)
+        return cli
+
+    return get
+
+
+def _pipelined(trainer_id):
+    """Like _client_map but for the windowed in-flight client (bucketed
+    sends/gets); endpoint registration still runs once so completes and
+    heartbeats cover pipelined-only endpoints."""
+    from .. import distributed
+    from ..distributed.rpc import PipelinedClient
+
+    cache = {}
+
+    def get(ep):
+        cli = cache.get(ep)
+        if cli is None:
+            distributed._note_endpoint(ep, trainer_id)
+            cli = cache[ep] = PipelinedClient.get(ep)
+        return cli
+
+    return get
+
+
+# blocking verbs (sync-mode gets, barriers) wait on cluster progress, not
+# network latency — mirror RPCClient.barrier_timeout for pipelined calls
+_BLOCKING_TIMEOUT = 1200.0
+
+
 def _check_not_evicted(result, ep, trainer_id):
     """A pserver answers evicted=True to a trainer it declared dead (its
     grads were dropped mid-round).  Training on silently-stale params
@@ -47,13 +89,13 @@ def _send(ctx, ins, attrs):
     epmap = list(attrs["epmap"])
     block_names = list(attrs["block_names"])
     trainer_id = int(attrs.get("trainer_id", 0))
+    cli = _client_map(trainer_id)
 
     def host_send(x):
         flat = np.asarray(x).reshape(-1)
         off = 0
         for sec, ep, bname in zip(sections, epmap, block_names):
-            r = _client(ep, trainer_id).send_var(
-                bname, flat[off : off + sec], trainer_id)
+            r = cli(ep).send_var(bname, flat[off : off + sec], trainer_id)
             _check_not_evicted(r, ep, trainer_id)
             off += sec
         return np.int32(0)
@@ -66,13 +108,25 @@ def _send(ctx, ins, attrs):
 
 @register("send_barrier", side_effect=True)
 def _send_barrier(ctx, ins, attrs):
+    """Round edge: drain the in-flight send window (bucketed sends are
+    submitted async — THIS is where their results, eviction included,
+    surface), then barrier every pserver.  The barrier verbs themselves
+    ride the window so N pservers round-trip concurrently instead of
+    serializing one blocked barrier behind another."""
     endpoints = list(attrs["endpoints"])
     trainer_id = int(attrs.get("trainer_id", 0))
+    pipe = _pipelined(trainer_id)
 
     def host_barrier():
         for ep in endpoints:
-            r = _client(ep).barrier("send", trainer_id)
-            _check_not_evicted(r, ep, trainer_id)
+            for r in pipe(ep).drain():
+                _check_not_evicted(r, ep, trainer_id)
+        for ep in endpoints:  # all submitted before any is waited on
+            pipe(ep).submit("barrier", timeout_s=_BLOCKING_TIMEOUT,
+                            kind="send", trainer_id=trainer_id)
+        for ep in endpoints:
+            for r in pipe(ep).drain():
+                _check_not_evicted(r, ep, trainer_id)
         return np.int32(0)
 
     tok = io_callback(host_barrier, jax.ShapeDtypeStruct((), jnp.int32), ordered=True)
@@ -88,14 +142,17 @@ def _recv(ctx, ins, attrs):
     shape = [int(s) for s in attrs["shape"]]
     dtype = jdt(attrs.get("dtype", "float32"))
     trainer_id = int(attrs.get("trainer_id", 0))
+    cli = _client_map(trainer_id)
 
     def host_recv():
         parts = [
-            np.asarray(_client(ep).get_var(bname, trainer_id)).reshape(-1)
+            np.asarray(cli(ep).get_var(bname, trainer_id)).reshape(-1)
             for ep, bname in zip(epmap, block_names)
         ]
         out = np.concatenate(parts).reshape(shape)
-        return out.astype(np.dtype(dtype.name if hasattr(dtype, "name") else dtype))
+        return out.astype(
+            np.dtype(dtype.name if hasattr(dtype, "name") else dtype),
+            copy=False)
 
     out = io_callback(
         host_recv, jax.ShapeDtypeStruct(tuple(shape), dtype), ordered=True
@@ -107,14 +164,108 @@ def _recv(ctx, ins, attrs):
 def _fetch_barrier(ctx, ins, attrs):
     endpoints = list(attrs["endpoints"])
     trainer_id = int(attrs.get("trainer_id", 0))
+    pipe = _pipelined(trainer_id)
 
     def host_barrier():
+        for ep in endpoints:  # concurrent across pservers (see send_barrier)
+            pipe(ep).submit("barrier", timeout_s=_BLOCKING_TIMEOUT,
+                            kind="fetch", trainer_id=trainer_id)
         for ep in endpoints:
-            _client(ep).barrier("fetch", trainer_id)
+            pipe(ep).drain()
         return np.int32(0)
 
     tok = io_callback(host_barrier, jax.ShapeDtypeStruct((), jnp.int32), ordered=True)
     return {"Out": [tok]}
+
+
+@register("send_bucket", side_effect=True)
+def _send_bucket(ctx, ins, attrs):
+    """Coalesced, pipelined grad push.  The transpiler's bucket plan maps
+    flat slices of the input grads into size-capped per-endpoint buckets
+    (attrs['buckets'] = [[endpoint, [[x_idx, begin, end, block_name],
+    ...]], ...]); each bucket ships as ONE send_bucket frame through the
+    windowed PipelinedClient, so bucket N+1 serializes while bucket N is
+    on the wire.  Results (including eviction notices) surface at the
+    window drain: send_barrier in sync mode, the next recv_bucket in
+    async."""
+    plan = [(ep, [(int(xi), int(b), int(e), bn) for xi, b, e, bn in entries])
+            for ep, entries in attrs["buckets"]]
+    trainer_id = int(attrs.get("trainer_id", 0))
+    # sync mode: per-endpoint bucket counts — the server folds the send
+    # barrier into the arrival of the LAST bucket (ps_server), so that
+    # submit may block round-long and gets the blocking timeout
+    totals = {ep: int(n) for ep, n in (attrs.get("sync_totals") or {}).items()}
+    pipe = _pipelined(trainer_id)
+
+    def host_send(*grads):
+        flats = [np.asarray(g).reshape(-1) for g in grads]
+        for ep, entries in plan:
+            blocks = {bn: flats[xi][b:e] for xi, b, e, bn in entries}
+            pipe(ep).submit(
+                "send_bucket",
+                timeout_s=_BLOCKING_TIMEOUT if totals.get(ep) else None,
+                blocks=blocks, trainer_id=trainer_id,
+                seq_total=totals.get(ep))
+        return np.int32(0)
+
+    tok = io_callback(
+        host_send, jax.ShapeDtypeStruct((), jnp.int32), *ins["X"],
+        ordered=True)
+    return {"Out": [tok]}
+
+
+@register("recv_bucket", side_effect=True)
+def _recv_bucket(ctx, ins, attrs):
+    """Coalesced, pipelined param pull: one get_bucket frame per
+    (endpoint, bucket) — submitted for every pserver BEFORE any reply is
+    awaited, so N pservers serve concurrently — then each param is
+    reassembled host-side from its block slices.  Drains the send window
+    first: in async mode (no send_barrier) the gets must not overtake
+    this step's own grads."""
+    buckets = [(ep, [str(n) for n in names]) for ep, names in
+               attrs["buckets"]]
+    params = [(p, [int(d) for d in shape], str(dtype), list(bnames))
+              for p, shape, dtype, bnames in attrs["params"]]
+    trainer_id = int(attrs.get("trainer_id", 0))
+    # sync mode: the server folds the fetch barrier into the last served
+    # bucket per endpoint (see ps_server._h_get_bucket)
+    totals = {ep: int(n) for ep, n in (attrs.get("fetch_totals") or {}).items()}
+    pipe = _pipelined(trainer_id)
+    out_structs = [
+        jax.ShapeDtypeStruct(tuple(shape), jdt(dtype))
+        for _, shape, dtype, _ in params
+    ]
+
+    def host_recv():
+        for ep in {ep for ep, _ in buckets}:
+            for r in pipe(ep).drain():
+                _check_not_evicted(r, ep, trainer_id)
+        futs = [(ep, pipe(ep).submit("get_bucket",
+                                     timeout_s=_BLOCKING_TIMEOUT,
+                                     names=names, trainer_id=trainer_id,
+                                     fetch_total=totals.get(ep)))
+                for ep, names in buckets]
+        block_vals = {}
+        for ep, f in futs:
+            got = f.result()
+            if not isinstance(got, dict):
+                raise RuntimeError(
+                    "get_bucket from %s returned %r" % (ep, type(got)))
+            block_vals.update(got)
+        for ep in {ep for ep, _ in futs}:
+            pipe(ep).drain()  # clear resolved futures off the window
+        outs = []
+        for p, shape, dtype, bnames in params:
+            flat = np.concatenate(
+                [np.asarray(block_vals[bn]).reshape(-1) for bn in bnames])
+            dt = jdt(dtype)
+            outs.append(flat.reshape(shape).astype(
+                np.dtype(dt.name if hasattr(dt, "name") else dt),
+                copy=False))
+        return tuple(outs)
+
+    outs = io_callback(host_recv, tuple(out_structs), ordered=True)
+    return {"Out": list(outs)}
 
 
 @register("prefetch", no_grad_inputs={"Ids"}, side_effect=True)
@@ -129,6 +280,7 @@ def _prefetch(ctx, ins, attrs):
     emb_dim = int(attrs["emb_dim"])
     trainer_id = int(attrs.get("trainer_id", 0))
     n = len(epmap)
+    cli = _client_map(trainer_id)
 
     id_shape = tuple(ids.shape)
     out_shape = id_shape + (emb_dim,)
@@ -142,9 +294,7 @@ def _prefetch(ctx, ins, attrs):
                 continue
             local = flat[mask] // n
             rows = np.asarray(
-                _client(epmap[s], trainer_id).prefetch(
-                    table_names[s], local, trainer_id
-                )
+                cli(epmap[s]).prefetch(table_names[s], local, trainer_id)
             )
             out[mask] = rows
         return out.reshape(out_shape)
@@ -169,6 +319,7 @@ def _send_sparse(ctx, ins, attrs):
     trainer_id = int(attrs.get("trainer_id", 0))
     scale = float(attrs.get("scale", 1.0))
     n = len(epmap)
+    cli = _client_map(trainer_id)
 
     def host_push(ids_v, grad_v):
         flat = np.asarray(ids_v).reshape(-1).astype(np.int64)
@@ -178,7 +329,7 @@ def _send_sparse(ctx, ins, attrs):
             if not mask.any():
                 continue
             local = flat[mask] // n
-            r = _client(epmap[s], trainer_id).send_sparse(
+            r = cli(epmap[s]).send_sparse(
                 table_names[s], local, g[mask], trainer_id
             )
             _check_not_evicted(r, epmap[s], trainer_id)
@@ -198,11 +349,11 @@ def _checkpoint_notify(ctx, ins, attrs):
     epmap = list(attrs.get("epmap", []))
     ckpt_dir = attrs.get("dir") or None
     trainer_id = int(attrs.get("trainer_id", 0))
+    cli = _client_map(trainer_id)
 
     def host_notify():
         for ep in epmap:
-            _client(ep, trainer_id).checkpoint_notify(
-                dir=ckpt_dir, trainer_id=trainer_id)
+            cli(ep).checkpoint_notify(dir=ckpt_dir, trainer_id=trainer_id)
         return np.int32(0)
 
     tok = io_callback(

@@ -25,6 +25,8 @@ __all__ = [
     "cuda_profiler",
     "tpu_profiler",
     "per_op_timeline",
+    "comm_compute_split",
+    "COMM_OPS",
 ]
 
 _events = []
@@ -32,12 +34,24 @@ _events_lock = threading.Lock()
 _enabled = False
 _trace_dir = None
 
+# op types whose host time is DCN communication, not compute — the
+# per_op_timeline comm/compute split (RPC sends/recvs/barriers plus the
+# bucketed/pipelined variants and the sparse-table verbs)
+COMM_OPS = frozenset((
+    "send", "recv", "send_bucket", "recv_bucket", "send_barrier",
+    "fetch_barrier", "prefetch", "send_sparse", "checkpoint_notify",
+))
+
 
 class RecordEvent:
-    """RAII span (platform/profiler.h:73 RecordEvent parity)."""
+    """RAII span (platform/profiler.h:73 RecordEvent parity).  `cat`
+    categorizes the span for comm-vs-compute attribution in the chrome
+    trace ("comm" for RPC sends/recvs, "feed" for host->device uploads;
+    unset spans are compute/host work)."""
 
-    def __init__(self, name):
+    def __init__(self, name, cat=None):
         self.name = name
+        self.cat = cat
         self.t0 = None
 
     def __enter__(self):
@@ -47,23 +61,24 @@ class RecordEvent:
     def __exit__(self, *exc):
         if _enabled:
             t1 = time.time()
+            ev = {
+                "name": self.name,
+                "ph": "X",
+                "ts": self.t0 * 1e6,
+                "dur": (t1 - self.t0) * 1e6,
+                "pid": os.getpid(),
+                "tid": threading.get_ident() % 10000,
+            }
+            if self.cat:
+                ev["cat"] = self.cat
             with _events_lock:
-                _events.append(
-                    {
-                        "name": self.name,
-                        "ph": "X",
-                        "ts": self.t0 * 1e6,
-                        "dur": (t1 - self.t0) * 1e6,
-                        "pid": os.getpid(),
-                        "tid": threading.get_ident() % 10000,
-                    }
-                )
+                _events.append(ev)
         return False
 
 
 @contextlib.contextmanager
-def record_event(name):
-    with RecordEvent(name):
+def record_event(name, cat=None):
+    with RecordEvent(name, cat=cat):
         yield
 
 
@@ -212,9 +227,10 @@ def per_op_timeline(program, feed, scope=None, path=None, warmup=1,
                 outs = run_once()
             dev_ms = (time.time() - t0) * 1e3 / warmup
         ts = (time.time() - t_base) * 1e6
+        cat = "comm" if op.type in COMM_OPS else "compute"
         for tid, name, dur in ((1, "host", host_ms), (2, "device", dev_ms)):
             events.append({
-                "name": "%s#%d" % (op.type, idx), "ph": "X",
+                "name": "%s#%d" % (op.type, idx), "ph": "X", "cat": cat,
                 "ts": ts, "dur": dur * 1e3, "pid": os.getpid(), "tid": tid,
                 "args": {"correlation": idx, "track": name},
             })
@@ -237,6 +253,21 @@ def per_op_timeline(program, feed, scope=None, path=None, warmup=1,
         with open(path, "w") as f:
             json.dump({"traceEvents": meta + events}, f)
     return sorted(rows, key=lambda r: -r[3])
+
+
+def comm_compute_split(rows):
+    """Attribute per_op_timeline rows to DCN communication vs compute:
+    returns {"comm_ms", "compute_ms", "comm_fraction"} over the host
+    track — where the step's wall time actually goes when deciding
+    whether bucketing/overlap or kernels are the bottleneck."""
+    comm = sum(r[2] for r in rows if r[0] in COMM_OPS)
+    compute = sum(r[2] for r in rows if r[0] not in COMM_OPS)
+    total = comm + compute
+    return {
+        "comm_ms": round(comm, 3),
+        "compute_ms": round(compute, 3),
+        "comm_fraction": round(comm / total, 4) if total else 0.0,
+    }
 
 
 @contextlib.contextmanager

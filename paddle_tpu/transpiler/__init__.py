@@ -5,7 +5,7 @@ from .distribute_transpiler import (
     DistributeTranspilerConfig,
     slice_variable,
 )
-from .ps_dispatcher import HashName, RoundRobin
+from .ps_dispatcher import HashName, RoundRobin, SizeWeighted
 from .memory_optimization_transpiler import memory_optimize, release_memory
 from .inference_transpiler import InferenceTranspiler
 from .layout_transpiler import rewrite_nhwc
@@ -25,6 +25,7 @@ __all__ = [
     "slice_variable",
     "HashName",
     "RoundRobin",
+    "SizeWeighted",
     "memory_optimize",
     "release_memory",
     "InferenceTranspiler",

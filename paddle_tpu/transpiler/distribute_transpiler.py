@@ -27,17 +27,22 @@ import math
 
 from .. import framework, unique_name
 from ..framework import Program
-from .ps_dispatcher import RoundRobin, PSDispatcher
+from .ps_dispatcher import RoundRobin, SizeWeighted, PSDispatcher
 
 
 class DistributeTranspilerConfig:
     """Knob surface of the reference config (distribute_transpiler.py:126)."""
 
     slice_var_up = True
-    split_method = RoundRobin
+    # size-weighted greedy bin-pack: uneven param sizes spread by load,
+    # not by position (RoundRobin / HashName stay selectable)
+    split_method = SizeWeighted
     min_block_size = 8192
     mode = "pserver"  # "pserver" | "nccl2"
     print_log = False
+    # byte cap per coalesced comm bucket; None defers to
+    # FLAGS_comm_bucket_bytes, 0 restores per-variable send/recv ops
+    comm_bucket_bytes = None
 
 
 class VarBlock:
@@ -54,6 +59,36 @@ class VarBlock:
     @property
     def block_name(self):
         return "%s.block%d" % (self.varname, self.idx)
+
+
+def _dtype_nbytes(dtype):
+    """Per-element bytes for bucket budgeting (bf16 and friends whose
+    dtype string numpy can't parse budget as 4 — a cap heuristic, not a
+    wire format)."""
+    import numpy as np
+
+    try:
+        return int(np.dtype(str(dtype)).itemsize)
+    except TypeError:
+        return 4
+
+
+def pack_buckets(entries, cap_bytes):
+    """Greedy size-capped packing: `entries` is [(nbytes, payload), ...];
+    returns a list of buckets (lists of payloads), each bucket's total
+    ≤ cap_bytes except when a single entry alone exceeds the cap (it gets
+    its own bucket — a block is never split below the slice plan)."""
+    buckets = []
+    cur, cur_bytes = [], 0
+    for nbytes, payload in entries:
+        if cur and cur_bytes + nbytes > cap_bytes:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(payload)
+        cur_bytes += nbytes
+    if cur:
+        buckets.append(cur)
+    return buckets
 
 
 def slice_variable(var_numels, slice_count, min_block_size=8192):
@@ -346,12 +381,20 @@ class DistributeTranspiler:
         block.ops = [op for op in block.ops if id(op) not in drop]
 
         # ---- append trainer-side rpc ops ------------------------------
+        # bucketed path (default): one size-capped coalesced frame per
+        # bucket per pserver + windowed in-flight RPC, instead of one
+        # round trip per variable.  comm_bucket_bytes=0 (config or flag)
+        # restores the legacy per-var send/recv ops.
+        bucket_bytes = self.config.comm_bucket_bytes
+        if bucket_bytes is None:
+            from ..flags import get_flag
+
+            bucket_bytes = get_flag("comm_bucket_bytes")
+        self.comm_bucket_bytes = int(bucket_bytes)
+
         with self.origin_program._op_role_guard("rpc"):
+            scaled_names = []
             for p, g in self.params_grads:
-                blocks = self.param_blocks[p]
-                sections = [b.size for b in blocks]
-                epmap = [self.block_eps[(p, b.idx)] for b in blocks]
-                gblocks = ["%s.block%d" % (g, b.idx) for b in blocks]
                 scaled = block.create_var(
                     name=g + "@DIST_SCALED",
                     shape=block._find_var_recursive(g).shape
@@ -365,41 +408,90 @@ class DistributeTranspiler:
                     outputs={"Out": [scaled.name]},
                     attrs={"scale": 1.0 / float(self.trainer_num)},
                 )
-                dummy = block.create_var(name=g + "@SEND_TOKEN", shape=[1])
+                scaled_names.append(scaled.name)
+            if self.comm_bucket_bytes > 0:
+                self.send_bucket_plan = self._plan_send_buckets()
+                # sync mode folds the barriers into the bucket stream:
+                # the server treats a trainer's LAST send bucket as its
+                # send barrier and the last served get bucket as its
+                # fetch barrier, so no dedicated barrier round trips
+                sync_totals = {}
+                for ep, _entries in self.send_bucket_plan:
+                    sync_totals[ep] = sync_totals.get(ep, 0) + 1
+                dummy = block.create_var(name="@SEND_BUCKET_TOKEN",
+                                         shape=[1])
                 block.append_op(
-                    "send",
-                    inputs={"X": [scaled.name]},
+                    "send_bucket",
+                    inputs={"X": scaled_names},
                     outputs={"Out": [dummy.name]},
                     attrs={
-                        "sections": sections,
-                        "epmap": epmap,
-                        "block_names": gblocks,
+                        "buckets": self.send_bucket_plan,
+                        "sync_totals": sync_totals if self.sync_mode
+                        else {},
                         "trainer_id": self.trainer_id,
                     },
                 )
-            if self.sync_mode:
+            else:
+                for (p, g), sname in zip(self.params_grads, scaled_names):
+                    blocks = self.param_blocks[p]
+                    sections = [b.size for b in blocks]
+                    epmap = [self.block_eps[(p, b.idx)] for b in blocks]
+                    gblocks = ["%s.block%d" % (g, b.idx) for b in blocks]
+                    dummy = block.create_var(name=g + "@SEND_TOKEN",
+                                             shape=[1])
+                    block.append_op(
+                        "send",
+                        inputs={"X": [sname]},
+                        outputs={"Out": [dummy.name]},
+                        attrs={
+                            "sections": sections,
+                            "epmap": epmap,
+                            "block_names": gblocks,
+                            "trainer_id": self.trainer_id,
+                        },
+                    )
+            if self.sync_mode and not self.comm_bucket_bytes > 0:
                 tok = block.create_var(name="@SEND_BARRIER_TOKEN", shape=[1])
                 block.append_op(
                     "send_barrier",
                     outputs={"Out": [tok.name]},
                     attrs={"endpoints": eps, "trainer_id": self.trainer_id},
                 )
-            for p, g in self.params_grads:
-                blocks = self.param_blocks[p]
-                pv = self._param_vars[p]
+            if self.comm_bucket_bytes > 0:
+                params_spec, recv_buckets = self._plan_recv_buckets()
+                self.recv_bucket_plan = recv_buckets
+                fetch_totals = {}
+                for ep, _names in recv_buckets:
+                    fetch_totals[ep] = fetch_totals.get(ep, 0) + 1
                 block.append_op(
-                    "recv",
-                    outputs={"Out": [p]},
+                    "recv_bucket",
+                    outputs={"Out": [p for p, _g in self.params_grads]},
                     attrs={
-                        "sections": [b.size for b in blocks],
-                        "epmap": [self.block_eps[(p, b.idx)] for b in blocks],
-                        "block_names": [b.block_name for b in blocks],
-                        "shape": [int(d) for d in pv.shape],
-                        "dtype": str(pv.dtype),
+                        "params": params_spec,
+                        "buckets": recv_buckets,
+                        "fetch_totals": fetch_totals if self.sync_mode
+                        else {},
                         "trainer_id": self.trainer_id,
                     },
                 )
-            if self.sync_mode:
+            else:
+                for p, g in self.params_grads:
+                    blocks = self.param_blocks[p]
+                    pv = self._param_vars[p]
+                    block.append_op(
+                        "recv",
+                        outputs={"Out": [p]},
+                        attrs={
+                            "sections": [b.size for b in blocks],
+                            "epmap": [self.block_eps[(p, b.idx)]
+                                      for b in blocks],
+                            "block_names": [b.block_name for b in blocks],
+                            "shape": [int(d) for d in pv.shape],
+                            "dtype": str(pv.dtype),
+                            "trainer_id": self.trainer_id,
+                        },
+                    )
+            if self.sync_mode and not self.comm_bucket_bytes > 0:
                 tok = block.create_var(name="@FETCH_BARRIER_TOKEN", shape=[1])
                 block.append_op(
                     "fetch_barrier",
@@ -407,6 +499,55 @@ class DistributeTranspiler:
                     attrs={"endpoints": eps, "trainer_id": self.trainer_id},
                 )
         self.origin_program._bump_version()
+
+    # ------------------------------------------------------------------
+    def _plan_send_buckets(self):
+        """Coalesce grad blocks into size-capped per-endpoint buckets:
+        [[endpoint, [[x_idx, begin, end, grad_block_name], ...]], ...]
+        in deterministic (endpoint, param) order — every role replans the
+        identical layout from the same program."""
+        per_ep = {ep: [] for ep in self.pserver_endpoints}
+        for xi, (p, g) in enumerate(self.params_grads):
+            isz = _dtype_nbytes(self._param_vars[p].dtype)
+            for blk in self.param_blocks[p]:
+                ep = self.block_eps[(p, blk.idx)]
+                per_ep[ep].append(
+                    (blk.size * isz,
+                     [xi, blk.begin, blk.end,
+                      "%s.block%d" % (g, blk.idx)]))
+        plan = []
+        for ep in self.pserver_endpoints:
+            buckets = pack_buckets(per_ep[ep], self.comm_bucket_bytes)
+            # an endpoint that received no blocks still gets one EMPTY
+            # bucket: it carries the folded barrier, registers the
+            # endpoint for heartbeats/complete, and so a zero-block
+            # pserver participates in rounds and terminates at job end
+            # instead of waiting forever on contact that never comes
+            for bucket in buckets or [[]]:
+                plan.append([ep, bucket])
+        return plan
+
+    def _plan_recv_buckets(self):
+        """Param-side bucket plan: per-param reassembly spec plus
+        size-capped per-endpoint name buckets for coalesced gets."""
+        per_ep = {ep: [] for ep in self.pserver_endpoints}
+        params_spec = []
+        for p, _g in self.params_grads:
+            pv = self._param_vars[p]
+            isz = _dtype_nbytes(pv.dtype)
+            bnames = []
+            for blk in self.param_blocks[p]:
+                ep = self.block_eps[(p, blk.idx)]
+                per_ep[ep].append((blk.size * isz, blk.block_name))
+                bnames.append(blk.block_name)
+            params_spec.append(
+                [p, [int(d) for d in pv.shape], str(pv.dtype), bnames])
+        buckets = []
+        for ep in self.pserver_endpoints:
+            got = pack_buckets(per_ep[ep], self.comm_bucket_bytes)
+            for bucket in got or [[]]:  # empty bucket = folded fetch
+                buckets.append([ep, bucket])  # barrier for block-less eps
+        return params_spec, buckets
 
     # ------------------------------------------------------------------
     def get_trainer_program(self):

@@ -40,5 +40,41 @@ class HashName(PSDispatcher):
             h = ((h * 33) ^ ord(ch)) & 0xFFFFFFFF
         return h
 
+    @staticmethod
+    def _key(v):
+        # VarBlocks hash by their stable block name, never by repr (which
+        # would bake a memory address into placement and desync the
+        # trainers' plan from the pservers')
+        return getattr(v, "block_name", v)
+
     def dispatch(self, varlist):
-        return [self._eps[self._hash(v) % len(self._eps)] for v in varlist]
+        return [self._eps[self._hash(self._key(v)) % len(self._eps)]
+                for v in varlist]
+
+
+class SizeWeighted(PSDispatcher):
+    """Greedy bin-pack by block size: each block lands on the currently
+    least-loaded endpoint (stable tie-break = endpoint order), with load
+    accumulated across dispatch() calls.  Position-based RoundRobin can
+    pile every large block of a skewed model onto one server (k params
+    each split across k servers stripe identically); weighting by size
+    keeps per-server bytes — and therefore per-round optimize+transport
+    work — balanced.  Deterministic for a fixed program, so every role
+    replans the same placement."""
+
+    def __init__(self, pserver_endpoints):
+        super().__init__(pserver_endpoints)
+        self._load = [0] * len(self._eps)
+
+    def reset(self):
+        super().reset()
+        self._load = [0] * len(self._eps)
+
+    def dispatch(self, varlist):
+        out = []
+        for v in varlist:
+            size = int(getattr(v, "size", 1) or 1)
+            i = min(range(len(self._eps)), key=lambda j: (self._load[j], j))
+            self._load[i] += size
+            out.append(self._eps[i])
+        return out

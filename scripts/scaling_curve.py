@@ -237,10 +237,14 @@ def pserver_leg(n_trainers=2, n_pservers=2, steps=12):
                     for i in range(n_trainers)]
         # time from first STEP line to trainer exit: excludes startup +
         # compile, measures the steady-state round loop
-        t_first, saw_losses = None, False
+        t_first, saw_losses, counters = None, False, None
         for line in trainers[0].stdout:
             if line.startswith("STEP ") and t_first is None:
                 t_first = time.time()
+            if line.startswith("COUNTERS "):
+                import json
+
+                counters = json.loads(line[len("COUNTERS "):])
             if line.startswith("LOSSES"):
                 saw_losses = True
                 break
@@ -254,7 +258,7 @@ def pserver_leg(n_trainers=2, n_pservers=2, steps=12):
             t.wait(timeout=120)
         for ps in pservers:
             ps.wait(timeout=90)
-        return (steps - 1) / max(dt, 1e-9)
+        return (steps - 1) / max(dt, 1e-9), counters
     finally:
         for proc in pservers + trainers:
             if proc.poll() is None:
@@ -273,10 +277,12 @@ def main():
         ep = ep_leg(n)
         print("| %d | %.2f | %.2f | %.2f | %.2f |" % (n, dp, pp, sp, ep),
               flush=True)
-    ps_rate = pserver_leg()
+    ps_rate, counters = pserver_leg()
     print("\npserver mode (REAL subprocesses, localhost rpc): "
           "2 pservers x 2 trainers sync = %.2f steps/s "
           "(wall-clock incl. transport + barriers)" % ps_rate, flush=True)
+    if counters:
+        print("pserver trainer-0 comm counters: %s" % counters, flush=True)
 
 
 if __name__ == "__main__":

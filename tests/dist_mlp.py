@@ -180,7 +180,14 @@ def main():
             import time
 
             time.sleep(step_sleep)
+    # comm evidence: client-side round trips / bytes plus feed-upload
+    # time — deterministic counters bench.py and the smoke tests read
+    from paddle_tpu.distributed import rpc as _rpc
+
+    counters = _rpc.get_comm_stats()
+    counters["host_feed_ms"] = round(exe.host_feed_ms, 3)
     exe.close()  # SendComplete to pservers
+    print("COUNTERS " + json.dumps(counters))
     print("LOSSES " + json.dumps(losses))
 
 

@@ -20,7 +20,12 @@ import pytest
 from paddle_tpu.distributed.faults import FaultSchedule, FaultyChannel
 from paddle_tpu.distributed.master import MasterService
 from paddle_tpu.distributed.ps_server import ParameterServer
-from paddle_tpu.distributed.rpc import RPCClient, VarServer, _backoff_wait
+from paddle_tpu.distributed.rpc import (
+    PipelinedClient,
+    RPCClient,
+    VarServer,
+    _backoff_wait,
+)
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _RUNNER = os.path.join(_DIR, "dist_mlp.py")
@@ -192,6 +197,127 @@ def test_pserver_async_grads_exact_under_wire_faults():
         srv.shutdown()
 
 
+def test_pipelined_window_at_most_once_under_fault_soup():
+    """comm_inflight > 1: four calls in flight at once through a wire
+    duplicating and delaying frames (the faults that stress DEDUP and
+    REORDERING under overlap — a dup'd request must apply once even
+    while three other calls race it; delays shuffle completion order) —
+    every logical add still applies exactly once.  Destructive faults
+    (drop/truncate) are call-fatal only after the replay budget and the
+    schedule's frame->call mapping races across workers, so they are
+    exercised through the window serially below, where the schedule is
+    deterministic."""
+    svc, srv, chan = _mk(seed=11, dup=0.2, delay=0.15, delay_s=0.02)
+    pipe = PipelinedClient(chan.endpoint, window=4, timeout=2, retries=6,
+                           retry_wait=0.05)
+    try:
+        total = 0.0
+        for i in range(24):
+            v = float(i + 1)
+            total += v
+            pipe.submit("add", value=v)
+        results = pipe.drain()
+        assert len(results) == 24
+        assert svc.state == total, (svc.state, total, chan.stats)
+        assert svc.executions == 24, (svc.executions, chan.stats)
+        injected = chan.stats["c2s"]["dup"] + chan.stats["s2c"]["dup"]
+        assert injected > 0, chan.stats
+    finally:
+        pipe.close()
+        chan.stop()
+        srv.shutdown()
+
+
+def test_pipelined_interface_survives_destructive_faults_serially():
+    """Same submit/drain machinery, window=1 (one worker consumes the
+    schedule serially, so the pinned drop/truncate land deterministically):
+    a dropped request, a dropped reply, and a truncated frame each retry
+    through the window client and apply exactly once."""
+    svc, srv, chan = _mk(schedule={"c2s": {1: "truncate"},
+                                   "s2c": {5: "drop"}})
+    pipe = PipelinedClient(chan.endpoint, window=1, timeout=0.5, retries=6,
+                           retry_wait=0.05)
+    try:
+        total = 0.0
+        for i in range(8):
+            v = float(i + 1)
+            total += v
+            pipe.submit("add", value=v)
+        results = pipe.drain()
+        assert len(results) == 8
+        assert svc.state == total, (svc.state, total, chan.stats)
+        assert svc.executions == 8, (svc.executions, chan.stats)
+        assert chan.stats["c2s"]["truncate"] == 1
+        assert chan.stats["s2c"]["drop"] == 1
+    finally:
+        pipe.close()
+        chan.stop()
+        srv.shutdown()
+
+
+def test_pipelined_drain_surfaces_failure_after_letting_rest_finish():
+    """One call in the window dies (unknown verb -> remote error): drain
+    must raise it, and the other in-flight calls still complete."""
+    svc, srv, chan = _mk()
+    pipe = PipelinedClient(chan.endpoint, window=3, timeout=2, retries=3)
+    try:
+        pipe.submit("add", value=1.0)
+        pipe.submit("no_such_verb")
+        pipe.submit("add", value=2.0)
+        with pytest.raises(RuntimeError):
+            pipe.drain()
+        assert svc.state == 3.0 and svc.executions == 2
+        assert pipe.drain() == []  # window is clean afterwards
+    finally:
+        pipe.close()
+        chan.stop()
+        srv.shutdown()
+
+
+def test_bucketed_sync_round_with_folded_barrier_and_eviction():
+    """The bucketed wire path under the liveness layer: trainer 1 ships
+    one of its two declared buckets then dies; the reaper evicts it, the
+    survivor's folded barrier (last-bucket arrival) completes the round
+    with ONLY the survivor's grads, and the ghost's partial bucket is
+    dropped."""
+    ps = ParameterServer([None, None], {"g0": 0, "g1": 1}, num_trainers=2,
+                         sync_mode=True, eviction_deadline=0.6)
+    applied = []
+    ps._apply_shard = lambda idx, feed: applied.append(
+        {k: np.asarray(v).copy() for k, v in feed.items()})
+    srv = VarServer("127.0.0.1:0", ps).start()
+    try:
+        cli = RPCClient(srv.endpoint, timeout=30, retries=3)
+        # trainer 1 heartbeats (tracked), ships bucket 1 of 2... and dies
+        cli.call("heartbeat", trainer_id=1)
+        cli.call("send_bucket", blocks={"g0": np.full((2,), 100.0)},
+                 trainer_id=1, seq_total=2)
+        # trainer 0 ships both buckets; the second is its send barrier
+        cli.call("send_bucket", blocks={"g0": np.full((2,), 3.0)},
+                 trainer_id=0, seq_total=2)
+        t0 = time.monotonic()
+        r = cli.call("send_bucket", blocks={"g1": np.full((2,), 5.0)},
+                     trainer_id=0, seq_total=2)
+        assert r == {"ok": True}
+        assert time.monotonic() - t0 < 5.0, "folded barrier hung"
+        assert ps._round == 1 and ps._live == {0} and 1 in ps._evicted
+        merged = {}
+        for d in applied:
+            merged.update(d)
+        np.testing.assert_array_equal(merged["g0"], np.full((2,), 3.0))
+        np.testing.assert_array_equal(merged["g1"], np.full((2,), 5.0))
+        # the ghost's next bucket is told it is dead
+        assert cli.call("send_bucket", blocks={"g0": np.zeros(2)},
+                        trainer_id=1, seq_total=2)["evicted"]
+        # bucketed fetch with folded fetch barrier resets the round
+        out = cli.call("get_bucket", names=[], trainer_id=0, fetch_total=1)
+        assert out == {}
+        assert ps._params_ready is False
+        cli.close()
+    finally:
+        srv.shutdown()
+
+
 # ---------------------------------------------------------------------------
 # client hardening: backoff + per-call deadline
 # ---------------------------------------------------------------------------
@@ -317,6 +443,60 @@ def test_trainer_evicted_while_blocked_in_barrier_learns_immediately():
         assert out and out[0] == {"ok": False, "evicted": True}, out
         assert ps._live == {0}
         cli.close()
+    finally:
+        srv.shutdown()
+
+
+def test_eviction_with_stale_fetch_barrier_does_not_hang_survivor():
+    """Re-evaluation ORDER bug: the survivor fetched round R (its fetch
+    barrier pends on the ghost) and is parked in its round-R+1 send
+    barrier when the ghost is evicted.  Re-evaluating the stale fetch
+    barrier AFTER _run_round would flip the fresh round's params_ready
+    back off and hang the survivor's next get forever — fetch must
+    re-evaluate first."""
+    ps = ParameterServer([None], {"g0": 0}, num_trainers=2, sync_mode=True,
+                         eviction_deadline=0.5)
+    ps._apply_shard = lambda idx, feed: None
+    ps.scope.set("p.block0", np.zeros(2, np.float32))
+    srv = VarServer("127.0.0.1:0", ps).start()
+    try:
+        cli = RPCClient(srv.endpoint, timeout=30, retries=3)
+        # round 1: both trainers send + barrier, then trainer 0 fetches
+        cli.call("heartbeat", trainer_id=1)
+        for tid in (0, 1):
+            cli.send_var("g0", np.ones(2), trainer_id=tid)
+        done = []
+        t = threading.Thread(target=lambda: done.append(
+            cli.call("barrier", kind="send", trainer_id=0)), daemon=True)
+        t.start()
+        cli2 = RPCClient(srv.endpoint, timeout=30, retries=3)
+        cli2.call("barrier", kind="send", trainer_id=1)
+        t.join(10)
+        assert done and ps._round == 1
+        cli.get_var("p.block0", trainer_id=0)
+        cli.call("barrier", kind="fetch", trainer_id=0)  # pends on ghost
+        # round 2: trainer 0 sends and parks in its send barrier; the
+        # ghost (trainer 1) has gone silent and gets evicted meanwhile
+        cli.send_var("g0", np.ones(2), trainer_id=0)
+        t0 = time.monotonic()
+        r = cli.barrier("send", trainer_id=0)
+        assert r["ok"] is True and time.monotonic() - t0 < 10
+        assert ps._round == 2 and ps._live == {0}
+        # THE regression: round 2's params must be fetchable — before the
+        # ordering fix the stale round-1 fetch barrier reset params_ready
+        # after round 2 ran, and this get blocked forever (threaded with
+        # a bounded join so a regression fails fast instead of hanging)
+        got = []
+        g = threading.Thread(target=lambda: got.append(
+            cli.get_var("p.block0", trainer_id=0)), daemon=True)
+        g.start()
+        g.join(10)
+        assert got, "round-2 get hung: stale fetch barrier reset " \
+            "params_ready after the eviction round ran"
+        assert np.asarray(got[0]).shape == (2,)
+        assert ps._params_ready is True
+        cli.close()
+        cli2.close()
     finally:
         srv.shutdown()
 

@@ -941,6 +941,7 @@ fill_constant_batch_size_like lod_rank_table max_sequence_len
 shrink_rnn_memory rnn_memory_helper sequence_expand_as lod_reset
 fused_attention im2sequence unpool similarity_focus polygon_box_transform
 send recv prefetch send_barrier fetch_barrier send_sparse print delete_var
+send_bucket recv_bucket
 adamax adadelta decayed_adagrad rmsprop ftrl lars_momentum
 fc fusion_seqconv_eltadd_relu fused_embedding_fc_lstm
 fusion_seqexpand_concat_fc split_selected_rows split_byref

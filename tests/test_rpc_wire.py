@@ -63,6 +63,94 @@ def test_roundtrip_all_types():
     np.testing.assert_array_equal(out["arr_i64"], vals["arr_i64"])
 
 
+def test_bucket_frame_roundtrip_mixed_dtypes():
+    """Bucketed wire format: a send_bucket payload is ONE dict frame of
+    mixed-dtype block arrays; pack/unpack must round-trip every block
+    bit-exactly, in one frame, through the real server."""
+    blocks = {
+        "w.block0": np.arange(12, dtype="float32") * 0.5,
+        "w.block1": np.arange(5, dtype="float64") - 2.5,
+        "emb.block0": np.array([3, -1, 7], dtype="int64"),
+        "mask.block0": np.array([True, False, True]),
+        "half.block0": np.arange(4, dtype="float16"),
+    }
+    buf = bytes(_encode(blocks, bytearray()))
+    out = _Reader(buf).decode()
+    assert sorted(out) == sorted(blocks)
+    for k in blocks:
+        np.testing.assert_array_equal(out[k], blocks[k])
+        assert out[k].dtype == blocks[k].dtype
+    # through a live server: one round trip carries the whole bucket
+    srv, ep = _mk_server()
+    try:
+        before = rpc.get_comm_stats()["rpc_round_trips"]
+        cli = RPCClient(ep, timeout=5, retries=2)
+        echoed = cli.call("echo", blocks=blocks)["blocks"]
+        assert rpc.get_comm_stats()["rpc_round_trips"] == before + 1
+        for k in blocks:
+            np.testing.assert_array_equal(echoed[k], blocks[k])
+            assert echoed[k].dtype == blocks[k].dtype
+        cli.close()
+    finally:
+        srv.shutdown()
+
+
+def test_bucket_truncation_midframe_retries_once_applied():
+    """A bucket frame truncated mid-wire (FaultyChannel): the client
+    reconnects and replays; the pserver's dedup applies the bucket
+    exactly once and the pending table holds every block of the
+    coalesced frame."""
+    from paddle_tpu.distributed.faults import FaultyChannel
+    from paddle_tpu.distributed.ps_server import ParameterServer
+
+    ps = ParameterServer([None, None], {"g0": 0, "g1": 1}, num_trainers=2,
+                         sync_mode=True)
+    srv = VarServer("127.0.0.1:0", ps).start()
+    chan = FaultyChannel(srv.endpoint,
+                         schedule={"c2s": {0: "truncate"}}).start()
+    try:
+        cli = RPCClient(chan.endpoint, timeout=2, retries=4,
+                        retry_wait=0.05)
+        blocks = {"g0": np.full((3,), 2.0, np.float32),
+                  "g1": np.arange(4, dtype=np.float32)}
+        r = cli.call("send_bucket", blocks=blocks, trainer_id=0)
+        assert r == {"ok": True}
+        assert chan.stats["c2s"]["truncate"] == 1
+        assert sorted(ps._pending) == ["g0", "g1"]
+        for name, want in blocks.items():
+            per_trainer = ps._pending[name]
+            assert list(per_trainer) == [0]  # applied once, one trainer
+            np.testing.assert_array_equal(per_trainer[0], want)
+        cli.close()
+    finally:
+        chan.stop()
+        srv.shutdown()
+
+
+def test_get_bucket_returns_all_blocks_one_frame():
+    from paddle_tpu.distributed.ps_server import ParameterServer
+
+    ps = ParameterServer([], {}, num_trainers=1, sync_mode=False)
+    ps.scope.set("p.block0", np.arange(4, dtype=np.float32))
+    ps.scope.set("p.block1", np.arange(3, dtype=np.float32) + 10)
+    srv = VarServer("127.0.0.1:0", ps).start()
+    try:
+        cli = RPCClient(srv.endpoint, timeout=5, retries=2)
+        before = rpc.get_comm_stats()["rpc_round_trips"]
+        out = cli.call("get_bucket", names=["p.block0", "p.block1"],
+                       trainer_id=0)
+        assert rpc.get_comm_stats()["rpc_round_trips"] == before + 1
+        np.testing.assert_array_equal(out["p.block0"],
+                                      np.arange(4, dtype=np.float32))
+        np.testing.assert_array_equal(out["p.block1"],
+                                      np.arange(3, dtype=np.float32) + 10)
+        with pytest.raises(RuntimeError):
+            cli.call("get_bucket", names=["missing"], trainer_id=0)
+        cli.close()
+    finally:
+        srv.shutdown()
+
+
 def test_no_pickle_in_rpc_module():
     import inspect
 
