@@ -23,7 +23,34 @@ _active_endpoints = set()
 
 
 def _note_endpoint(ep, trainer_id):
-    _active_endpoints.add((ep, int(trainer_id)))
+    key = (ep, int(trainer_id))
+    first_contact = key not in _active_endpoints
+    _active_endpoints.add(key)
+    if first_contact:
+        # register handshake: declares this FRESH trainer incarnation to
+        # the pserver (resetting its per-step fold fences), seeds the
+        # client-side incarnation-fence baseline from the reply envelope,
+        # and — if this id was previously evicted — blocks until the
+        # pserver readmits it at a round boundary (elastic rejoin,
+        # docs/FAULT_TOLERANCE.md).  Best-effort against services that
+        # predate the verb.
+        from .rpc import RPCClient
+
+        try:
+            r = RPCClient.get(ep).register(trainer_id=int(trainer_id))
+        except RuntimeError as e:
+            if "_h_register" not in str(e):  # real rejection, not
+                raise                        # an unknown-verb service
+        else:
+            if isinstance(r, dict) and r.get("ok") is False:
+                # parked for a round boundary that never came: the job
+                # completed while this joiner waited.  Terminal — with
+                # the live set empty its sends would each run a "round"
+                # alone, silently training the final checkpointed params
+                raise RuntimeError(
+                    "trainer %s cannot join pserver %s: the job already "
+                    "completed while the register waited for a round "
+                    "boundary — nothing to rejoin" % (trainer_id, ep))
     # first pserver contact also starts this trainer's liveness sender so
     # a mid-round crash is detectable (and a live-but-slow trainer never
     # trips the pserver's eviction deadline)

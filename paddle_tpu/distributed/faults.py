@@ -52,12 +52,19 @@ class FaultSchedule:
     {...}}`` pins exact frames; anything unpinned falls through to the
     seeded random rates (``drop=0.1, dup=0.05, ...`` with ``seed``), and
     with no rates to "pass".  Frame indices count per direction from 0
-    over the channel's lifetime, across reconnects."""
+    over the channel's lifetime, across reconnects.
 
-    def __init__(self, schedule=None, seed=0, drop=0.0, delay=0.0,
+    ``seed=None`` resolves from the ``PADDLE_TPU_FAULT_SEED`` env var
+    (falling back to 0): CI pins the whole chaos subset to one seed so a
+    red run reproduces bit-for-bit (scripts/ci.sh)."""
+
+    def __init__(self, schedule=None, seed=None, drop=0.0, delay=0.0,
                  dup=0.0, truncate=0.0):
+        import os
         import random
 
+        if seed is None:
+            seed = int(os.environ.get("PADDLE_TPU_FAULT_SEED", "0"))
         self._explicit = {"c2s": {}, "s2c": {}}
         for direction, frames in (schedule or {}).items():
             if direction not in self._explicit:
@@ -125,7 +132,7 @@ class FaultyChannel:
     actions per direction for asserting a schedule actually fired."""
 
     def __init__(self, target_endpoint, listen="127.0.0.1:0",
-                 schedule=None, seed=0, drop=0.0, delay=0.0, dup=0.0,
+                 schedule=None, seed=None, drop=0.0, delay=0.0, dup=0.0,
                  truncate=0.0, delay_s=0.05):
         self.target = target_endpoint
         self._listen = listen

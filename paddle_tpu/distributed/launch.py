@@ -64,24 +64,68 @@ def _wait_port(endpoint, timeout=60, cluster=None):
     return False
 
 
+class _RestartPolicy:
+    """Supervisor restart budget: at most `max_restarts` within a sliding
+    `window_s`, with exponential backoff between attempts.  next_delay()
+    returns the backoff for one more restart, or None when the budget is
+    exhausted (the death is then a real failure)."""
+
+    def __init__(self, max_restarts=3, window_s=60.0, backoff_s=0.5):
+        self.max_restarts = int(max_restarts)
+        self.window_s = float(window_s)
+        self.backoff_s = float(backoff_s)
+        self._history = []
+
+    def next_delay(self):
+        now = time.monotonic()
+        self._history = [t for t in self._history
+                         if now - t < self.window_s]
+        if len(self._history) >= self.max_restarts:
+            return None
+        delay = self.backoff_s * (2.0 ** len(self._history))
+        self._history.append(now)
+        return delay
+
+
 class _Cluster:
     """Spawned children with streamed output and fail-fast teardown.
 
     Chaos hooks: `kill_one(tag)` / `schedule_kill(tag, after_s)` SIGKILL a
     single child, and tags passed to `expect_failure()` don't trip the
     fail-fast teardown — the point of a chaos run is that the SURVIVORS
-    finish after a deliberate kill."""
+    finish after a deliberate kill.
+
+    Supervision (`supervise(tag, cmd, env, policy)`): a registered child
+    that dies nonzero is RELAUNCHED with the same command and env (after
+    the policy's backoff), instead of failing the cluster — the
+    self-healing loop: a restarted pserver restores its checkpoint and
+    peers re-fence; a restarted trainer re-registers and rejoins.  The
+    death notification (`on_child_death`) always fires BEFORE the
+    respawn, so a trainer ghost is evicted before its replacement
+    registers."""
 
     def __init__(self):
         self.procs = []  # (tag, Popen, pump-thread)
         self._lock = threading.Lock()
         self.failed_rc = None
         self._expected_failures = set()  # tags whose death is deliberate
+        self._excused = set()  # individual Popens excused by a respawn
+        self._supervised = {}  # tag -> {"cmd": [...], "env": {...},
+        #                                "policy": _RestartPolicy}
+        self.restarts = {}  # tag -> respawn count (observability)
+        self._respawns_pending = 0  # respawn backoffs in flight
+        self._closing = threading.Event()
         # called as (tag, rc) when a child exits nonzero — pserver mode
         # uses it to report trainer deaths to the control plane, closing
         # the window where a trainer dies BEFORE its first heartbeat
         # (never tracked, so never evicted) and would hang the sync round
         self.on_child_death = None
+        # called as (tag) when the supervisor has DECIDED to respawn,
+        # before the backoff/spawn — pserver mode pre-registers a dying
+        # trainer's id so the job is not declared done while its
+        # replacement is still booting.  Returning False cancels the
+        # respawn (the job already completed without the child).
+        self.on_respawn = None
 
     def spawn(self, tag, cmd, env):
         proc = subprocess.Popen(
@@ -93,38 +137,134 @@ class _Cluster:
             bufsize=1,
         )
         t = threading.Thread(target=self._pump, args=(tag, proc), daemon=True)
+        with self._lock:
+            self.procs.append((tag, proc, t))
+            closing = self._closing.is_set()
+        if closing:
+            # teardown raced this spawn (a supervised respawn slipping
+            # past kill()'s proc snapshot): the child must not outlive
+            # the launcher — it is registered above, so kill()/wait()
+            # bookkeeping still sees it
+            proc.kill()
         t.start()
-        self.procs.append((tag, proc, t))
         return proc
 
+    def supervise(self, tag, cmd, env, policy=None):
+        """Register `tag` for supervised restarts (see class docstring).
+        Call after (or before) spawn(); the cmd/env given here are what a
+        respawn uses."""
+        self._supervised[tag] = {
+            "cmd": list(cmd), "env": dict(env),
+            "policy": policy or _RestartPolicy()}
+
     def _pump(self, tag, proc):
-        for line in proc.stdout:
-            sys.stdout.write("[%s] %s" % (tag, line))
-            sys.stdout.flush()
-        rc = proc.wait()
-        if rc != 0:
+        try:
+            for line in proc.stdout:
+                sys.stdout.write("[%s] %s" % (tag, line))
+                sys.stdout.flush()
+            rc = proc.wait()
+        finally:
+            try:
+                proc.stdout.close()  # reap the pipe fd with the child
+            except OSError:
+                pass
+        if rc == 0:
+            return
+        supervised = (tag in self._supervised
+                      and not self._closing.is_set())
+        if not supervised:
             # record the failure FIRST so fail-fast teardown isn't
             # delayed behind the (best-effort, up-to-seconds) death
             # notification RPCs
+            self._record_failure(tag, rc)
+            self._notify_death(tag, rc)
+            return
+        # supervised: death notification BEFORE the respawn — eviction
+        # must land before the replacement registers, so the pserver
+        # never sees the fresh incarnation and then an out-of-order
+        # ghost report
+        self._notify_death(tag, rc)
+        if not self._respawn(tag, proc, rc):
+            self._record_failure(tag, rc)
+
+    def _record_failure(self, tag, rc):
+        with self._lock:
+            if tag in self._expected_failures:
+                sys.stderr.write(
+                    "[launch] %s exited rc=%d (expected chaos kill)\n"
+                    % (tag, rc)
+                )
+            elif self.failed_rc is None:
+                self.failed_rc = rc
+                sys.stderr.write(
+                    "[launch] %s exited rc=%d — stopping cluster\n" % (tag, rc)
+                )
+
+    def _notify_death(self, tag, rc):
+        cb = self.on_child_death
+        if cb is not None:
+            try:
+                cb(tag, rc)
+            except Exception as e:
+                sys.stderr.write(
+                    "[launch] death notification for %s failed: %s\n"
+                    % (tag, e))
+
+    def _respawn(self, tag, dead_proc, rc):
+        """Supervised-restart path: returns True when the death was
+        absorbed by a respawn (the dead Popen is excused from the exit
+        scan)."""
+        spec = self._supervised.get(tag)
+        if spec is None or self._closing.is_set():
+            return False
+        delay = spec["policy"].next_delay()
+        if delay is None:
+            sys.stderr.write(
+                "[launch] %s exited rc=%d — restart budget exhausted "
+                "(max %d per %.0fs)\n"
+                % (tag, rc, spec["policy"].max_restarts,
+                   spec["policy"].window_s))
+            return False
+        hook = self.on_respawn
+        if hook is not None:
+            try:
+                if hook(tag) is False:
+                    sys.stderr.write(
+                        "[launch] %s not respawned — the job completed "
+                        "without it\n" % tag)
+                    with self._lock:
+                        self._excused.add(dead_proc)
+                    return True
+            except Exception as e:
+                sys.stderr.write(
+                    "[launch] respawn announcement for %s failed: %s\n"
+                    % (tag, e))
+        with self._lock:
+            self._excused.add(dead_proc)
+            self._respawns_pending += 1
+            n = self.restarts[tag] = self.restarts.get(tag, 0) + 1
+        try:
+            sys.stderr.write(
+                "[launch] supervisor restarting %s (rc=%d, restart #%d, "
+                "backoff %.2fs)\n" % (tag, rc, n, delay))
+            if self._closing.wait(delay):
+                return True  # teardown raced the backoff: stay down
+            try:
+                self.spawn(tag, spec["cmd"], spec["env"])
+            except Exception as e:
+                # the replacement never started: this is a REAL failure,
+                # not an absorbed death — without recording it, wait()
+                # would skip the excused Popen and report success with
+                # the child permanently missing
+                sys.stderr.write(
+                    "[launch] respawn of %s failed: %s\n" % (tag, e))
+                with self._lock:
+                    if self.failed_rc is None:
+                        self.failed_rc = rc if rc != 0 else 1
+        finally:
             with self._lock:
-                if tag in self._expected_failures:
-                    sys.stderr.write(
-                        "[launch] %s exited rc=%d (expected chaos kill)\n"
-                        % (tag, rc)
-                    )
-                elif self.failed_rc is None:
-                    self.failed_rc = rc
-                    sys.stderr.write(
-                        "[launch] %s exited rc=%d — stopping cluster\n" % (tag, rc)
-                    )
-            cb = self.on_child_death
-            if cb is not None:
-                try:
-                    cb(tag, rc)
-                except Exception as e:
-                    sys.stderr.write(
-                        "[launch] death notification for %s failed: %s\n"
-                        % (tag, e))
+                self._respawns_pending -= 1
+        return True
 
     def wait(self, poll=0.2):
         """Wait for all children; kill everything on first (unexpected)
@@ -132,39 +272,62 @@ class _Cluster:
         while True:
             with self._lock:
                 failed = self.failed_rc
+                procs = list(self.procs)
+                respawning = self._respawns_pending
             if failed is not None:
                 self.kill()
                 return failed
-            if all(p.poll() is not None for _, p, _ in self.procs):
-                for _, _, t in self.procs:
+            # conclusion needs every pump thread DEAD, not just every
+            # child exited: a pump mid death-processing (notification
+            # RPCs, respawn decision) hasn't excused its Popen yet, and
+            # concluding in that window would misread a supervised death
+            # as a cluster failure
+            if (not respawning
+                    and all(p.poll() is not None for _, p, _ in procs)
+                    and all(not t.is_alive() for _, _, t in procs)):
+                for _, _, t in procs:
                     t.join(timeout=5)
                 # first nonzero (incl. negative signal-kill codes) wins —
                 # max() would mask a SIGKILLed child behind a clean peer —
-                # but a deliberately killed child doesn't count
-                for tag, p, _ in self.procs:
+                # but a deliberately killed or respawned child doesn't
+                # count
+                for tag, p, _ in procs:
                     if (p.returncode != 0
-                            and tag not in self._expected_failures):
+                            and tag not in self._expected_failures
+                            and p not in self._excused):
                         return p.returncode
                 return 0
             time.sleep(poll)
 
     def kill(self):
-        for _, p, _ in self.procs:
+        self._closing.set()  # cancel pending supervised respawns
+        with self._lock:
+            procs = list(self.procs)
+        for _, p, _ in procs:
             if p.poll() is None:
                 p.kill()
-        for _, p, t in self.procs:
+        for _, p, t in procs:
             try:
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 pass
             t.join(timeout=5)
+            # the pump normally closes the pipe at EOF; make teardown
+            # idempotent so repeated chaos tests never leak fds
+            if p.stdout is not None:
+                try:
+                    p.stdout.close()
+                except OSError:
+                    pass
 
     # ---- chaos helpers (fault-injection harness) ----------------------
     def proc(self, tag):
-        """The Popen for one child by its [role.rank] tag."""
-        for t, p, _ in self.procs:
-            if t == tag:
-                return p
+        """The Popen for one child by its [role.rank] tag (the LATEST
+        incarnation when the supervisor has respawned it)."""
+        with self._lock:
+            for t, p, _ in reversed(self.procs):
+                if t == tag:
+                    return p
         raise KeyError("no child tagged %r (have %s)"
                        % (tag, [t for t, _, _ in self.procs]))
 
@@ -227,7 +390,8 @@ def launch_collective(script_argv, nproc, base_env=None, chaos_kills=None):
 
 
 def launch_pserver(script_argv, nproc, n_pservers, base_env=None, sync=True,
-                   chaos_kills=None):
+                   chaos_kills=None, supervise=False, max_restarts=3,
+                   restart_window=60.0, restart_backoff=0.5, ckpt_dir=None):
     ports = [free_port() for _ in range(n_pservers)]
     eps = ",".join("127.0.0.1:%d" % p for p in ports)
     common = dict(base_env or os.environ)
@@ -236,7 +400,28 @@ def launch_pserver(script_argv, nproc, n_pservers, base_env=None, sync=True,
         PADDLE_TRAINERS=str(nproc),
         DIST_SYNC_MODE="1" if sync else "0",
     )
+    if ckpt_dir:
+        common["PADDLE_PSERVER_CKPT_DIR"] = ckpt_dir
+    if supervise and not common.get("PADDLE_PSERVER_CKPT_DIR"):
+        sys.stderr.write(
+            "[launch] WARNING: --supervise without a checkpoint dir "
+            "(--ckpt-dir / PADDLE_PSERVER_CKPT_DIR): a restarted pserver "
+            "comes up COLD and the job's optimizer state on that shard "
+            "is lost\n")
+
+    def _policy():
+        return _RestartPolicy(max_restarts=max_restarts,
+                              window_s=restart_window,
+                              backoff_s=restart_backoff)
+
     cluster = _Cluster()
+
+    # trainer ids the launcher has seen die and NOT (yet) respawned: a
+    # supervised pserver restart is re-briefed about them, because its
+    # restored snapshot may predate the eviction (the ghost never
+    # heartbeats the new incarnation, so liveness alone can't see it)
+    dead_trainers = set()
+    dead_lock = threading.Lock()
 
     def notify_trainer_death(tag, rc):
         """Tell every pserver a trainer child died (the `evict` verb): a
@@ -244,29 +429,118 @@ def launch_pserver(script_argv, nproc, n_pservers, base_env=None, sync=True,
         so liveness eviction can't see it — but the LAUNCHER can, and
         the report unhangs any sync barrier waiting on the ghost while
         dropping its partial round contribution (unlike `complete`).
+        When the supervisor will relaunch the child, the evict carries
+        respawn=True so the pserver parks the id for readmission instead
+        of declaring the job done — the death of the SOLE trainer must
+        not take the pserver down under its booting replacement.
         Best-effort with short deadlines; re-evicting is a no-op."""
         if not tag.startswith("trainer."):
             return
         from .rpc import RPCClient
 
         tid = int(tag.split(".", 1)[1])
+        respawning = _will_respawn(tag)
+        with dead_lock:
+            dead_trainers.add(tid)
         for ep in eps.split(","):
             cli = RPCClient(ep, timeout=2, retries=2, retry_wait=0.1)
             try:
-                cli.call("evict", trainer_id=tid, deadline_s=5.0)
+                cli.call("evict", trainer_id=tid, deadline_s=5.0,
+                         respawn=respawning)
             except Exception:
                 pass  # pserver may be gone too; fail-fast handles that
             finally:
                 cli.close()
 
+    def _will_respawn(tag):
+        """True when the supervisor is going to relaunch this child (it
+        is registered for supervision and teardown hasn't started) —
+        budget exhaustion later fails the whole cluster anyway, so a
+        parked join on that path dies with everything else."""
+        return (tag in cluster._supervised
+                and not cluster._closing.is_set())
+
     cluster.on_child_death = notify_trainer_death
+
+    def prepare_respawn(tag):
+        """Supervisor pre-respawn hook.  For a dying TRAINER, pre-register
+        its id on its behalf (runs AFTER the evict notification, BEFORE
+        the respawn): the pserver readmits the id at the next round
+        boundary and keeps the job alive while the replacement process
+        boots — without this, the last survivor completing would declare
+        the job done under the booting rejoiner.
+        Returns False (skip the respawn) when every pserver says the job
+        already finished.
+
+        For a restarting PSERVER, re-briefs the new incarnation about
+        trainers that are still dead: its restored snapshot may predate
+        their eviction, and a ghost never heartbeats the new server, so
+        without the report the restored barrier would wait on it
+        forever."""
+        from .rpc import RPCClient
+
+        if tag.startswith("pserver."):
+            idx = int(tag.split(".", 1)[1])
+            ep = "127.0.0.1:%d" % ports[idx]
+
+            def rebrief():
+                if not _wait_port(ep, timeout=120):
+                    return
+                with dead_lock:
+                    dead = sorted(dead_trainers)
+                for tid in dead:
+                    cli = RPCClient(ep, timeout=2, retries=3,
+                                    retry_wait=0.1)
+                    try:
+                        cli.call("evict", trainer_id=tid, deadline_s=5.0,
+                                 respawn=_will_respawn("trainer.%d" % tid))
+                    except Exception:
+                        pass
+                    finally:
+                        cli.close()
+
+            threading.Thread(target=rebrief, daemon=True,
+                             name="rebrief-%s" % tag).start()
+            return True
+        if not tag.startswith("trainer."):
+            return True
+
+        tid = int(tag.split(".", 1)[1])
+        with dead_lock:
+            dead_trainers.discard(tid)  # it is coming back
+        admitted = reachable = 0
+        for ep in eps.split(","):
+            cli = RPCClient(ep, timeout=5, retries=3, retry_wait=0.1)
+            try:
+                # register() carries the stack-wide blocking budget
+                # (barrier_timeout): a round boundary is cluster
+                # progress, not network latency
+                r = cli.register(trainer_id=tid)
+                reachable += 1
+                if isinstance(r, dict) and r.get("ok"):
+                    admitted += 1
+            except Exception:
+                pass  # pserver down/restarting: its own recovery covers it
+            finally:
+                cli.close()
+        # unreachable pservers don't veto the respawn — only an explicit
+        # "done" consensus from every reachable one does
+        return admitted > 0 or reachable == 0
+
+    cluster.on_respawn = prepare_respawn
     for i, p in enumerate(ports):
         env = dict(common)
         env.update(
             PADDLE_TRAINING_ROLE="PSERVER",
             PADDLE_CURRENT_ENDPOINT="127.0.0.1:%d" % p,
         )
-        cluster.spawn("pserver.%d" % i, [sys.executable, "-u"] + script_argv, env)
+        cmd = [sys.executable, "-u"] + script_argv
+        if supervise:
+            # the respawn reuses the SAME endpoint + checkpoint env: the
+            # restarted shard restores from its manifest checkpoint and
+            # trainers re-fence on the incarnation bump
+            cluster.supervise("pserver.%d" % i, cmd, env, _policy())
+        cluster.spawn("pserver.%d" % i, cmd, env)
     for p in ports:
         if not _wait_port("127.0.0.1:%d" % p, cluster=cluster):
             sys.stderr.write("[launch] pserver port %d never opened\n" % p)
@@ -283,7 +557,14 @@ def launch_pserver(script_argv, nproc, n_pservers, base_env=None, sync=True,
             PADDLE_TRAINING_ROLE="TRAINER",
             PADDLE_TRAINER_ID=str(rank),
         )
-        cluster.spawn("trainer.%d" % rank, [sys.executable, "-u"] + script_argv, env)
+        cmd = [sys.executable, "-u"] + script_argv
+        if supervise:
+            # a relaunched trainer is a fresh process: the launcher's
+            # death report evicted the ghost first (_pump ordering), the
+            # replacement re-registers and is readmitted at the next
+            # round boundary (elastic rejoin)
+            cluster.supervise("trainer.%d" % rank, cmd, env, _policy())
+        cluster.spawn("trainer.%d" % rank, cmd, env)
     _arm_chaos(cluster, chaos_kills)
     return cluster.wait()
 
@@ -310,6 +591,33 @@ def main(argv=None):
         "SECONDS; the kill is an expected failure — the run succeeds if "
         "the survivors finish (repeatable)",
     )
+    parser.add_argument(
+        "--supervise", action="store_true",
+        help="pserver mode: relaunch children that die nonzero — a "
+        "restarted pserver restores its checkpoint (trainers re-fence on "
+        "the incarnation bump), a restarted trainer re-registers and "
+        "rejoins at a round boundary (docs/FAULT_TOLERANCE.md)",
+    )
+    parser.add_argument(
+        "--max-restarts", type=int, default=3,
+        help="supervised restart budget per child within --restart-window "
+        "seconds (exhausting it makes the next death a real failure)",
+    )
+    parser.add_argument(
+        "--restart-window", type=float, default=60.0,
+        help="sliding window (seconds) for the --max-restarts budget",
+    )
+    parser.add_argument(
+        "--restart-backoff", type=float, default=0.5,
+        help="base supervised-restart backoff in seconds (doubles per "
+        "restart within the window)",
+    )
+    parser.add_argument(
+        "--ckpt-dir", default=None,
+        help="pserver mode: sets PADDLE_PSERVER_CKPT_DIR for the "
+        "children so supervised pserver restarts restore instead of "
+        "starting cold",
+    )
     parser.add_argument("script", help="training script")
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
@@ -332,7 +640,10 @@ def main(argv=None):
     else:
         rc = launch_pserver(
             script_argv, args.nproc, args.pservers, sync=not args.async_mode,
-            chaos_kills=chaos_kills,
+            chaos_kills=chaos_kills, supervise=args.supervise,
+            max_restarts=args.max_restarts,
+            restart_window=args.restart_window,
+            restart_backoff=args.restart_backoff, ckpt_dir=args.ckpt_dir,
         )
     return rc
 

@@ -97,8 +97,11 @@ class ParameterServer:
         # sync mode queues sparse grads and applies them at round time,
         # AFTER the lr_program run — exactly the reference's
         # optimizer-sub-block-at-barrier semantics (async applies on
-        # arrival with the current lr)
-        self._pending_sparse = []
+        # arrival with the current lr).  Keyed (trainer_id, table) so a
+        # fenced replay after a pserver restart overwrites rather than
+        # double-queues (each trainer ships at most one chunk per table
+        # per step — see ops/dist_ops.py _send_sparse)
+        self._pending_sparse = {}
 
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
@@ -114,6 +117,22 @@ class ParameterServer:
         # trainer's declared per-step buckets this server has seen
         self._send_bucket_counts = {}  # trainer_id -> buckets this round
         self._fetch_bucket_counts = {}
+        # incarnation-fenced stream bookkeeping (docs/FAULT_TOLERANCE.md):
+        # buckets carrying a (step, seq_idx) pair are counted by SET so a
+        # fenced replay after a pserver restart is idempotent — a
+        # re-delivered bucket overwrites its keyed pending slot and cannot
+        # advance the fold count twice.  _folded_send/_folded_fetch record
+        # the last step token each trainer FOLDED; they ride the
+        # checkpoint snapshot, so after a restore they fence exactly the
+        # rounds the restored params already contain (replays of those
+        # rounds are dropped, in-flight rounds are re-assembled).
+        self._send_step = {}     # tid -> step token being assembled
+        self._send_seen = {}     # tid -> set of seq_idx seen for that step
+        self._fetch_step = {}
+        self._fetch_seen = {}
+        self._folded_send = {}   # tid -> last folded send step (ckpt'd)
+        self._folded_fetch = {}  # tid -> last folded fetch step (ckpt'd)
+        self._pending_joins = set()  # tids waiting for a round boundary
         self._round = 0  # bumped after each optimize step
         self._params_ready = not sync_mode
         # liveness: the explicit live set replaces the old bare count so
@@ -143,6 +162,42 @@ class ParameterServer:
         self.server_idx = int(server_idx)
         self._async_sends = 0
         self._ckpt_write_lock = threading.Lock()  # serialize writer threads
+        # recovery observability (bench / smoke COUNTERS evidence)
+        self.counters = {"evictions": 0, "readmissions": 0,
+                         "registrations": 0, "dup_round_drops": 0,
+                         "lost_rounds": 0}
+        # every pserver start — cold or restored — is a new INCARNATION;
+        # the number rides every rpc reply envelope so trainers can fence
+        # a restart (see rpc.py incarnation registry)
+        self.incarnation = self._mint_incarnation()
+
+    def _mint_incarnation(self):
+        """Monotonic per-start incarnation: a counter persisted next to
+        the checkpoint when there is a durable home, else time-derived
+        (still distinct across restarts).  Best-effort — fencing needs
+        the number to CHANGE per start, nothing stronger."""
+        import os
+        import time
+
+        if self.checkpoint_dir:
+            try:
+                os.makedirs(self.checkpoint_dir, exist_ok=True)
+                path = os.path.join(
+                    self.checkpoint_dir,
+                    "pserver_%d.incarnation" % self.server_idx)
+                prev = 0
+                if os.path.exists(path):
+                    with open(path) as f:
+                        prev = int(f.read().strip() or 0)
+                inc = prev + 1
+                tmp = path + ".tmp"
+                with open(tmp, "w") as f:
+                    f.write(str(inc))
+                os.replace(tmp, path)
+                return inc
+            except (OSError, ValueError):
+                pass
+        return int(time.time() * 1000) & 0x7FFFFFFFFFFF
 
     # ---- checkpoint (fault tolerance) -----------------------------------
     def _ckpt_path(self, dir=None):
@@ -157,6 +212,18 @@ class ParameterServer:
         later in-place updates can't tear the snapshot)."""
         return {
             "round": self._round,
+            # per-trainer fold fences ride the SAME snapshot as the
+            # params: after a restore, replayed buckets for rounds the
+            # restored state already contains are dropped, rounds the
+            # snapshot missed are re-assembled (incarnation fencing)
+            "folded": {"send": dict(self._folded_send),
+                       "fetch": dict(self._folded_fetch)},
+            # departed trainers ride the snapshot too: a restored sync
+            # server must not rebuild its live set around ghosts it
+            # already evicted — their folds would never arrive and every
+            # restored barrier would hang (register still readmits them)
+            "departed": {"evicted": sorted(self._evicted),
+                         "completed": sorted(self._completed)},
             "vars": {
                 n: np.array(self.scope.get(n))
                 for n in self.scope.local_var_names()
@@ -265,6 +332,8 @@ class ParameterServer:
                                                 len(payload), crc))
                 except (ValueError, KeyError, OSError) as e:
                     crc_note = "manifest unreadable: %s" % e
+            else:
+                crc_note = "no manifest (pre-manifest-era checkpoint)"
             data = pickle.loads(payload)
             if not (isinstance(data, dict) and "vars" in data):
                 raise ValueError("snapshot has no vars table")
@@ -273,13 +342,26 @@ class ParameterServer:
                 "PSERVER checkpoint %s unusable, starting cold: %s\n"
                 % (path, e))
             return None
-        if crc_note is not None:
-            # stale manifest (crash landed between the two renames) over
-            # a snapshot that parses cleanly: recover, re-manifest
+        # legacy bare-array sparse entries (pre-slot-state checkpoints):
+        # upgrade in the loaded data itself so the rewrite below lands a
+        # MODERN snapshot + crc manifest on disk
+        sparse = data.get("sparse", {})
+        legacy = any(not isinstance(v, dict) for v in sparse.values())
+        if legacy:
+            data = dict(data)
+            data["sparse"] = {
+                k: (v if isinstance(v, dict)
+                    else {"tbl": np.ascontiguousarray(v)})
+                for k, v in sparse.items()}
+        if crc_note is not None or legacy:
+            # stale/missing manifest (crash landed between the two
+            # renames, or a pre-manifest/legacy-format checkpoint) over a
+            # snapshot that parses cleanly: recover, rewrite both files
+            # in the modern format
             sys.stderr.write(
-                "PSERVER checkpoint %s: stale/mismatched manifest (%s); "
-                "snapshot parsed cleanly — restoring and rewriting the "
-                "manifest\n" % (path, crc_note))
+                "PSERVER checkpoint %s: %s; snapshot parsed cleanly — "
+                "restoring and rewriting snapshot + manifest\n"
+                % (path, crc_note or "legacy sparse format"))
             try:
                 self._write_snapshot(data)
             except OSError:
@@ -290,13 +372,29 @@ class ParameterServer:
             if k not in self.sparse_tables:
                 continue
             info = self.sparse_tables[k]
-            if isinstance(v, dict):  # current format: tbl + slot state
-                for kk, vv in v.items():
-                    info[kk] = (np.ascontiguousarray(vv)
-                                if isinstance(vv, np.ndarray) else vv)
-            else:  # legacy checkpoint: bare table array
-                info["tbl"] = np.ascontiguousarray(v)
+            for kk, vv in v.items():
+                info[kk] = (np.ascontiguousarray(vv)
+                            if isinstance(vv, np.ndarray) else vv)
         self._round = int(data.get("round", 0))
+        folded = data.get("folded") or {}
+        self._folded_send = {int(t): int(s)
+                             for t, s in (folded.get("send") or {}).items()}
+        self._folded_fetch = {int(t): int(s)
+                              for t, s in (folded.get("fetch") or {}).items()}
+        departed = data.get("departed") or {}
+        self._evicted |= {int(t) for t in departed.get("evicted", [])}
+        self._completed |= {int(t) for t in departed.get("completed", [])}
+        self._live -= (self._evicted | self._completed)
+        if not self._live:
+            # everyone the snapshot knew is gone: nothing left to serve
+            # (a rejoin would re-arm via register/_admit_locked)
+            self._done.set()
+        if self.sync_mode and self._round > 0:
+            # the restored params ARE a completed round's output: serve
+            # them.  Leaving params_ready False would park every
+            # replaying get on a flag only the NEXT round sets — a
+            # restart during the fetch phase would deadlock the job.
+            self._params_ready = True
         return self._round
 
     def _maybe_checkpoint(self):
@@ -347,15 +445,33 @@ class ParameterServer:
             # it learns it is dead from live=False and should exit
             return {"ok": True, "live": live, "round": self._round}
 
-    def _h_evict(self, trainer_id=0):
+    def _h_evict(self, trainer_id=0, respawn=False):
         """Out-of-band death report (the launcher's supervisor role): a
         trainer that died before its first heartbeat was never tracked,
         so the reaper can't see it — whoever reaped the process tells us.
         Unlike `complete`, this drops the ghost's pending grads / queued
         sparse rows and stale barrier entries (the full _evict_locked
-        cleanup), so a partial round contribution never leaks."""
+        cleanup), so a partial round contribution never leaks.
+
+        `respawn=True` (a supervised child: its replacement IS coming)
+        parks the id as a pending join BEFORE the eviction, so the
+        eviction's own boundary re-check readmits it — without this, the
+        sole trainer's death would empty the live set and declare the
+        job done while the supervisor is still booting the replacement,
+        and the exiting pserver would strand that replacement forever."""
         with self._cv:
-            self._evict_locked(int(trainer_id), "reported dead")
+            tid = int(trainer_id)
+            if respawn:
+                # parked in BOTH modes: async has no barriers, so the
+                # boundary check admits immediately — but without the
+                # park an async sole-trainer death would still empty the
+                # live set and exit the pserver under the replacement
+                self._pending_joins.add(tid)
+            self._evict_locked(tid, "reported dead")
+            # _evict_locked early-returns for an id not in the live set
+            # (already evicted / completed): a parked respawn join must
+            # still admit if the server sits at a boundary
+            self._admit_pending_joins_locked()
             return {"ok": True, "live": len(self._live)}
 
     def _ensure_reaper_locked(self):
@@ -398,6 +514,40 @@ class ParameterServer:
 
                 traceback.print_exc()
 
+    def _clear_round_state_locked(self, tid):
+        """Drop one trainer's partial contribution to the CURRENT round:
+        unsummed dense grads, queued sparse rows, stale barrier entries
+        and in-progress bucket-stream counts.  Shared by eviction (the
+        ghost's state must not leak) and re-registration (a fresh trainer
+        incarnation restarts its stream from scratch)."""
+        for per_trainer in self._pending.values():
+            per_trainer.pop(tid, None)
+        # prune grads left with NO contributors: an empty inner dict
+        # would keep _mid_round_locked() True forever, so the round
+        # boundary (and with it every parked rejoin) would never arrive
+        self._pending = {g: per for g, per in self._pending.items() if per}
+        self._pending_sparse = {
+            k: v for k, v in self._pending_sparse.items() if k[0] != tid
+        }
+        self._send_barriers.discard(tid)
+        self._fetch_barriers.discard(tid)
+        self._send_bucket_counts.pop(tid, None)
+        self._fetch_bucket_counts.pop(tid, None)
+        self._send_step.pop(tid, None)
+        self._send_seen.pop(tid, None)
+        self._fetch_step.pop(tid, None)
+        self._fetch_seen.pop(tid, None)
+
+    def _reset_stream_locked(self, tid):
+        """Full per-trainer stream reset: round state PLUS the fold
+        fences.  For any transition that starts a FRESH incarnation
+        lineage for the id (eviction, admission, re-registration) — a
+        stale fold fence would drop the new process's first rounds as
+        replays, since its step tokens restart at 1."""
+        self._clear_round_state_locked(tid)
+        self._folded_send.pop(tid, None)
+        self._folded_fetch.pop(tid, None)
+
     def _evict_locked(self, trainer_id, why):
         """Remove a dead trainer from the round (called under self._cv):
         drop its unsummed dense grads and queued sparse rows, then
@@ -409,21 +559,123 @@ class ParameterServer:
         self._live.discard(tid)
         self._tracked.pop(tid, None)
         self._evicted.add(tid)
+        self.counters["evictions"] += 1
         print("PSERVER EVICT trainer=%d round=%d: %s"
               % (tid, self._round, why), flush=True)
-        for per_trainer in self._pending.values():
-            per_trainer.pop(tid, None)
-        self._pending_sparse = [
-            p for p in self._pending_sparse if p[3] != tid
-        ]
-        self._send_barriers.discard(tid)
-        self._fetch_barriers.discard(tid)
-        self._send_bucket_counts.pop(tid, None)
-        self._fetch_bucket_counts.pop(tid, None)
+        self._reset_stream_locked(tid)
+        # a joiner parked in `register` is ALIVE: an eviction that
+        # exposed a round boundary admits it (and an empty live set must
+        # admit rather than declare the job done)
+        self._admit_pending_joins_locked()
         if not self._live:
             self._done.set()
         elif self.sync_mode:
             self._reeval_barriers_locked()
+        self._cv.notify_all()
+
+    # ---- elastic rejoin --------------------------------------------------
+    def _admit_locked(self, tid):
+        """Admit a (re)joining trainer into the live set.  ONLY called at
+        a round boundary: the barrier denominator must never grow while a
+        round is being assembled, or survivors would wait on a joiner
+        that was never part of the round."""
+        was_evicted = tid in self._evicted
+        self._live.add(tid)
+        self._evicted.discard(tid)
+        self._completed.discard(tid)
+        self._reset_stream_locked(tid)
+        self._done.clear()
+        if was_evicted:
+            self.counters["readmissions"] += 1
+            print("PSERVER READMIT trainer=%d round=%d" % (tid, self._round),
+                  flush=True)
+
+    def _admit_pending_joins_locked(self):
+        """Admit parked joins IF the server is at a round boundary —
+        self-guarded, so it is safe (and necessary) to call from every
+        state transition that can CREATE a boundary: _run_round, the
+        fetch-barrier clears, eviction and completion."""
+        if not self._pending_joins or not self._at_boundary_locked():
+            return
+        for tid in sorted(self._pending_joins):
+            self._admit_locked(tid)
+        self._pending_joins.clear()
+        self._cv.notify_all()
+
+    def _mid_round_locked(self):
+        """True while the current round is being ASSEMBLED (some trainer
+        has contributed grads or entered a barrier): admission now would
+        change the barrier denominator under the survivors."""
+        return bool(
+            self._send_barriers or any(self._pending.values())
+            or self._pending_sparse or self._send_seen
+            or any(self._send_bucket_counts.values()))
+
+    def _at_boundary_locked(self):
+        """The round boundary: no round being assembled AND no fetch of
+        the previously-served round still draining.  Admission while
+        _fetch_barriers pends would grow the fetch denominator under the
+        survivors — the stale entries could later complete with the
+        joiner's first fetch and flip params_ready off while survivors
+        still hold un-served gets (the _h_complete hazard, but
+        re-introduced by growth instead of shrinkage)."""
+        return not (self._mid_round_locked() or self._fetch_barriers
+                    or self._fetch_seen
+                    or any(self._fetch_bucket_counts.values()))
+
+    def _h_register(self, trainer_id=0):
+        """Trainer handshake + elastic (re)join.  A fresh trainer process
+        declares itself: its per-step fold fences reset (its stream
+        restarts at step 1), and if the id was evicted or completed it is
+        readmitted — at a ROUND BOUNDARY only, blocking until the
+        in-flight round completes so barrier totals stay consistent for
+        both the joiner and the survivors (a fence, not a delay)."""
+        import time
+
+        with self._cv:
+            tid = int(trainer_id)
+            self.counters["registrations"] += 1
+            if tid in self._live:
+                # fast relaunch reusing a live id (died and came back
+                # before eviction noticed): drop the old incarnation's
+                # partial round state and stale fold fences
+                self._reset_stream_locked(tid)
+            elif not self.sync_mode or self._at_boundary_locked():
+                self._admit_locked(tid)
+            else:
+                self._pending_joins.add(tid)
+                self._cv.wait_for(
+                    lambda: tid in self._live or self._done.is_set())
+                self._pending_joins.discard(tid)
+                if tid not in self._live:
+                    return {"ok": False, "done": True,
+                            "round": self._round}
+            if tid in self._tracked:
+                self._tracked[tid] = time.monotonic()
+            self._cv.notify_all()
+            return {"ok": True, "live": True, "round": self._round,
+                    "incarnation": self.incarnation}
+
+    def _h_stats(self, trainer_id=0):
+        """Recovery observability: incarnation, round, live/evicted sets
+        and the eviction/readmission counters (rpc.get_comm_stats's
+        server-side sibling)."""
+        with self._cv:
+            out = {"round": self._round, "incarnation": self.incarnation,
+                   "live": sorted(self._live),
+                   "evicted": sorted(self._evicted)}
+            out.update(self.counters)
+            return out
+
+    def _complete_fetch_barrier_locked(self):
+        """Every live trainer folded its fetch: reset the serve epoch.
+        The single home for the clear/flip/admit sequence — the fenced
+        fold, the legacy fold, the explicit barrier verb and eviction
+        re-evaluation all converge here."""
+        self._fetch_barriers.clear()
+        self._params_ready = False
+        # fetch drained: a round boundary — parked joins admit
+        self._admit_pending_joins_locked()
         self._cv.notify_all()
 
     def _reeval_barriers_locked(self):
@@ -435,11 +687,13 @@ class ParameterServer:
         nothing will set again."""
         if (self._fetch_barriers
                 and len(self._fetch_barriers) >= len(self._live)):
-            self._fetch_barriers.clear()
-            self._params_ready = False
+            self._complete_fetch_barrier_locked()
         if (self._send_barriers
                 and len(self._send_barriers) >= len(self._live)):
             self._run_round()
+        else:
+            # the shrink itself may have exposed a round boundary
+            self._admit_pending_joins_locked()
 
     # ---- verb dispatch ---------------------------------------------------
     def handle(self, verb, **kw):
@@ -477,8 +731,8 @@ class ParameterServer:
                 total = v if total is None else total + v
             self._apply_shard(self.grad_to_shard[gname], {gname: total})
         by_table = {}
-        for t, ids, rows, _tid in self._pending_sparse:
-            by_table.setdefault(t, []).append((ids, rows))
+        for (tid, t) in sorted(self._pending_sparse):
+            by_table.setdefault(t, []).append(self._pending_sparse[(tid, t)])
         for t, chunks in sorted(by_table.items()):
             self._apply_sparse(
                 t,
@@ -486,7 +740,7 @@ class ParameterServer:
                 np.concatenate([c[1] for c in chunks], axis=0),
                 advance_pows=False,
             )
-        self._pending_sparse = []
+        self._pending_sparse = {}
         # per-round state that advances even on ROWLESS rounds: the
         # local op runs every step regardless of which rows a shard's id
         # hashing happened to receive — adam beta pows advance
@@ -502,9 +756,19 @@ class ParameterServer:
                                    advance_pows=False)
         self._pending.clear()
         self._send_barriers.clear()
+        # fetch-barrier stragglers from the PREVIOUS serve epoch (a
+        # fenced replay's re-fold of a round its peers already finished
+        # fetching — no survivor will ever complete that barrier) must
+        # not carry into the new round: a leftover entry would let the
+        # next round's fetch barrier complete one fold early, flipping
+        # params_ready off under a trainer's still-unserved get
+        self._fetch_barriers.clear()
         self._params_ready = True
         self._round += 1
         self._maybe_checkpoint()
+        # round boundary: admit trainers parked in `register` — the NEXT
+        # round's barrier totals include them from its very first bucket
+        self._admit_pending_joins_locked()
         self._cv.notify_all()
 
     # ---- handlers --------------------------------------------------------
@@ -556,7 +820,8 @@ class ParameterServer:
             self._pending.setdefault(name, {})[trainer_id] = value
         return {"ok": True}
 
-    def _h_send_bucket(self, blocks, trainer_id=0, seq_total=None):
+    def _h_send_bucket(self, blocks, trainer_id=0, seq_total=None,
+                       step=None, seq_idx=None, sparse_tables=None):
         """Coalesced grad frame: `blocks` maps grad block name -> value,
         shipped as ONE rpc round trip (see ops/dist_ops.py send_bucket).
         Server-side the bucket is unpacked into exactly the per-block
@@ -570,7 +835,15 @@ class ParameterServer:
         is free — the window delivers out of order) counts as the
         trainer's send barrier, saving a dedicated blocking round trip.
         That last call blocks until the round runs, exactly like the
-        explicit barrier verb it replaces."""
+        explicit barrier verb it replaces.
+
+        `step`/`seq_idx` (incarnation fencing) make the stream
+        replay-safe: buckets are counted by (step, seq_idx) SET, so a
+        trainer that re-ships its whole round after observing a pserver
+        restart cannot advance the fold twice (pending slots are keyed —
+        overwrite, not accumulate), and a replay of a step this server
+        already FOLDED (it survived in the restored snapshot) is dropped
+        at the `_folded_send` fence instead of double-applying a round."""
         if not self.sync_mode:
             # sorted order keeps the lr trigger (min grad name) firing
             # before the other shards of the same logical step WITHIN a
@@ -589,17 +862,92 @@ class ParameterServer:
             tid = int(trainer_id)
             if tid in self._evicted:
                 return {"ok": False, "evicted": True}
+            if seq_total and step is not None:
+                step = int(step)
+                if step <= self._folded_send.get(tid, -1):
+                    # fenced replay of a round the restored state already
+                    # contains: the fold record rode the same snapshot as
+                    # the params, so applying again would double the round
+                    self.counters["dup_round_drops"] += 1
+                    return {"ok": True, "dup_round": True}
+                prev = self._folded_send.get(tid)
+                if prev is not None and step > prev + 1:
+                    # the trainer replays only its CURRENT round, so any
+                    # round between the restored snapshot and the stream
+                    # is unrecoverable.  A gap of exactly ONE round is
+                    # the unavoidable async-write race (the kill landed
+                    # after _run_round but before its background
+                    # snapshot hit disk): tolerate it LOUDLY — counted
+                    # and printed, never silent.  A wider gap means the
+                    # configuration itself discards rounds on every
+                    # restore (checkpoint_every > 1, or snapshots
+                    # repeatedly failing to land) — fail the job rather
+                    # than quietly train past several lost updates.
+                    lost = step - prev - 1
+                    if lost > 1:
+                        raise RuntimeError(
+                            "incarnation fence gap: trainer %d is at "
+                            "step %d but this server last folded step %d "
+                            "— the restored checkpoint is missing %d "
+                            "intermediate rounds that cannot be replayed "
+                            "(trainers only record the current round); "
+                            "refusing to silently drop them.  Lower "
+                            "checkpoint_every so restores stay within "
+                            "one round of the stream." % (tid, step, prev,
+                                                          lost))
+                    if self._send_step.get(tid) != step:
+                        # count once per lost round, not once per
+                        # arriving bucket of the gapped step (the reset
+                        # below stamps _send_step before bucket 2)
+                        self.counters["lost_rounds"] += 1
+                        print("PSERVER LOST-ROUND trainer=%d step=%d "
+                              "folded=%d: the kill raced the background "
+                              "checkpoint write; one round's update is "
+                              "lost" % (tid, step, prev), flush=True)
+                if self._send_step.get(tid) != step:
+                    self._send_step[tid] = step
+                    self._send_seen[tid] = set()
             for name, value in blocks.items():
                 self._pending.setdefault(name, {})[trainer_id] = \
                     np.asarray(value)
             if not seq_total:
                 return {"ok": True}
-            c = self._send_bucket_counts.get(tid, 0) + 1
-            if c < int(seq_total):
-                self._send_bucket_counts[tid] = c
-                return {"ok": True}
+            if step is not None:
+                seen = self._send_seen[tid]
+                seen.add(int(seq_idx or 0))
+                if len(seen) < int(seq_total):
+                    return {"ok": True}
+                if sparse_tables:
+                    # the trainer declared sparse chunks for this step:
+                    # every one must be PENDING before the fold may run
+                    # the round.  A crash between the sparse acks and
+                    # the dense folds re-delivers only the (unacked)
+                    # dense buckets via RPC retries — folding then would
+                    # run the round without its sparse rows and the
+                    # fence would drop the corrective replay as
+                    # dup_round.  Refuse (keeping the assembled set);
+                    # the fenced replay re-queues sparse first, and its
+                    # re-shipped dense buckets re-trigger this check.
+                    unknown = [t for t in sparse_tables
+                               if t not in self.sparse_tables]
+                    if unknown:
+                        raise KeyError(
+                            "send_bucket declares sparse tables this "
+                            "server does not shard: %s" % unknown)
+                    missing = [t for t in sparse_tables
+                               if (tid, t) not in self._pending_sparse]
+                    if missing:
+                        return {"ok": True, "need_sparse": missing}
+                self._folded_send[tid] = step
+                self._send_step.pop(tid, None)
+                self._send_seen.pop(tid, None)
+            else:  # legacy count-based fold (pre-fencing callers)
+                c = self._send_bucket_counts.get(tid, 0) + 1
+                if c < int(seq_total):
+                    self._send_bucket_counts[tid] = c
+                    return {"ok": True}
+                self._send_bucket_counts[tid] = 0
             # last bucket of this trainer's step: its send barrier
-            self._send_bucket_counts[tid] = 0
             self._send_barriers.add(trainer_id)
             if len(self._send_barriers) >= len(self._live):
                 self._run_round()
@@ -613,20 +961,34 @@ class ParameterServer:
                     return {"ok": False, "evicted": True}
         return {"ok": True}
 
-    def _h_get_bucket(self, names, trainer_id=0, fetch_total=None):
+    def _h_get_bucket(self, names, trainer_id=0, fetch_total=None,
+                      step=None, seq_idx=None):
         """Coalesced param fetch: one frame returns every requested block
         — and in sync mode ONE params-ready wait covers the whole bucket
         instead of one blocking round trip per variable.  `fetch_total`
         folds the fetch barrier in: when this trainer's last declared
         bucket has been served (any arrival order) it counts as the
         trainer's fetch barrier, and the round resets once every live
-        trainer got theirs."""
+        trainer got theirs.  `step`/`seq_idx` mirror _h_send_bucket's
+        fencing: a replayed fetch stream counts by set (never double-
+        folds), and a fetch step this server already folded is served
+        (reads are harmless) without counting."""
         if self.sync_mode:
             with self._cv:
                 self._touch(trainer_id)
-                self._cv.wait_for(
-                    lambda: self._params_ready or self._done.is_set()
-                )
+                # a REPLAYED fetch of a step this trainer already folded
+                # (restart recovery) is served from the current params
+                # without waiting: its own fold may have flipped
+                # params_ready off, and parking here would deadlock the
+                # replay on a flag only the next round sets
+                already_folded = (
+                    step is not None
+                    and int(step) <= self._folded_fetch.get(
+                        int(trainer_id), -1))
+                if not already_folded:
+                    self._cv.wait_for(
+                        lambda: self._params_ready or self._done.is_set()
+                    )
                 if int(trainer_id) in self._evicted:
                     raise RuntimeError(
                         "trainer %s was evicted from the sync round; "
@@ -648,16 +1010,29 @@ class ParameterServer:
                     raise RuntimeError(
                         "trainer %s was evicted from the sync round"
                         % (trainer_id,))
-                c = self._fetch_bucket_counts.get(tid, 0) + 1
-                if c < int(fetch_total):
-                    self._fetch_bucket_counts[tid] = c
-                else:
+                if step is not None:
+                    step = int(step)
+                    if step <= self._folded_fetch.get(tid, -1):
+                        return out  # replay of a folded fetch: serve only
+                    if self._fetch_step.get(tid) != step:
+                        self._fetch_step[tid] = step
+                        self._fetch_seen[tid] = set()
+                    seen = self._fetch_seen[tid]
+                    seen.add(int(seq_idx or 0))
+                    if len(seen) < int(fetch_total):
+                        return out
+                    self._folded_fetch[tid] = step
+                    self._fetch_step.pop(tid, None)
+                    self._fetch_seen.pop(tid, None)
+                else:  # legacy count-based fold
+                    c = self._fetch_bucket_counts.get(tid, 0) + 1
+                    if c < int(fetch_total):
+                        self._fetch_bucket_counts[tid] = c
+                        return out
                     self._fetch_bucket_counts[tid] = 0
-                    self._fetch_barriers.add(trainer_id)
-                    if len(self._fetch_barriers) >= len(self._live):
-                        self._fetch_barriers.clear()
-                        self._params_ready = False
-                        self._cv.notify_all()
+                self._fetch_barriers.add(trainer_id)
+                if len(self._fetch_barriers) >= len(self._live):
+                    self._complete_fetch_barrier_locked()
         return out
 
     def _h_barrier(self, kind, trainer_id=0):
@@ -686,9 +1061,7 @@ class ParameterServer:
             elif kind == "fetch":
                 self._fetch_barriers.add(trainer_id)
                 if len(self._fetch_barriers) >= len(self._live):
-                    self._fetch_barriers.clear()
-                    self._params_ready = False
-                    self._cv.notify_all()
+                    self._complete_fetch_barrier_locked()
         return {"ok": True}
 
     def _h_get(self, name, trainer_id=0):
@@ -818,21 +1191,31 @@ class ParameterServer:
         else:
             raise ValueError("unknown sparse optimizer %r" % typ)
 
-    def _h_send_sparse(self, table, ids, rows, trainer_id=0):
+    def _h_send_sparse(self, table, ids, rows, trainer_id=0, step=None):
         """Sparse optimizer update on this server's rows (SelectedRows
         grad).  Sync mode queues until the round barrier so the update
         sees this round's scheduled lr and all trainers' rows merge into
         ONE application (the reference's optimizer-sub-block-at-barrier
-        semantics); async applies immediately."""
+        semantics); async applies immediately.  `step` is the dense
+        stream's fence token: a fenced replay of a round this server
+        already folded (it survived in the restored snapshot) is dropped
+        so its rows cannot leak into the NEXT round."""
         ids = np.asarray(ids).reshape(-1)
         rows = np.asarray(rows)
         with self._lock:
             self._touch(trainer_id)
             if int(trainer_id) in self._evicted:
                 return {"ok": False, "evicted": True}
+            if (self.sync_mode and step is not None
+                    and int(step) <= self._folded_send.get(
+                        int(trainer_id), -1)):
+                self.counters["dup_round_drops"] += 1
+                return {"ok": True, "dup_round": True}
             if self.sync_mode:
-                self._pending_sparse.append(
-                    (table, ids, rows, int(trainer_id)))
+                # keyed overwrite: a fenced replay of this round's chunk
+                # replaces rather than double-queues (dist_ops ships one
+                # chunk per (table, server) per step)
+                self._pending_sparse[(int(trainer_id), table)] = (ids, rows)
             else:
                 self._async_touched.add(table)
                 self._apply_sparse(table, ids, rows)
@@ -865,8 +1248,6 @@ class ParameterServer:
                 self._live.pop()
                 self._completed.add(tid)  # once: repeats must not re-pop
             self._tracked.pop(tid, None)
-            if not self._live:
-                self._done.set()
             # a departing trainer may unblock a pending round.  Its SEND
             # entry is kept (a clean departure's grads still count toward
             # the round it joined) but its FETCH entry is dropped: "I
@@ -876,6 +1257,16 @@ class ParameterServer:
             self._fetch_barriers.discard(tid)
             self._send_bucket_counts.pop(tid, None)
             self._fetch_bucket_counts.pop(tid, None)
+            self._send_step.pop(tid, None)
+            self._send_seen.pop(tid, None)
+            self._fetch_step.pop(tid, None)
+            self._fetch_seen.pop(tid, None)
+            # a parked joiner admits (boundary-guarded) before the
+            # done-check: a completing survivor must not declare the job
+            # over under a rejoiner
+            self._admit_pending_joins_locked()
+            if not self._live:
+                self._done.set()
             if self.sync_mode and self._live:
                 self._reeval_barriers_locked()
             self._cv.notify_all()
@@ -978,9 +1369,18 @@ def run_pserver(program, scope, executor=None):
     )
     restored = service.load_checkpoint()
     if restored is not None:
-        print("PSERVER RESTORED round=%d" % restored, flush=True)
+        print("PSERVER RESTORED round=%d incarnation=%d"
+              % (restored, service.incarnation), flush=True)
     server = make_var_server(a["endpoint"], service).start()
     try:
         service.wait_done()
     finally:
         server.shutdown()
+        # recovery observability: the server-side sibling of the
+        # trainers' COUNTERS line (distinct prefix — bench.py sums
+        # trainer COUNTERS lines and must not fold these in)
+        import json as _json
+
+        print("PSERVER-STATS " + _json.dumps(
+            dict(service.counters, round=service._round,
+                 incarnation=service.incarnation)), flush=True)

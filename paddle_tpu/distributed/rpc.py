@@ -59,9 +59,13 @@ def _hmac_key():
 # tier-1 smoke can assert on them without timing flakiness.  Counted in
 # RPCClient._call_locked only — server handlers share _send_msg/_recv_msg,
 # and counting both sides would double every in-process test.
+# pserver_restarts_seen / recoveries / recovery_ms are the recovery
+# observability counters (docs/FAULT_TOLERANCE.md): incarnation bumps
+# observed, fenced round replays performed, and total time-to-recover.
 _comm_lock = threading.Lock()
 _comm_stats = {"rpc_round_trips": 0, "comm_bytes_sent": 0,
-               "comm_bytes_recv": 0}
+               "comm_bytes_recv": 0, "pserver_restarts_seen": 0,
+               "recoveries": 0, "recovery_ms": 0.0}
 
 
 def _bump_comm(trips=0, sent=0, recv=0):
@@ -69,6 +73,16 @@ def _bump_comm(trips=0, sent=0, recv=0):
         _comm_stats["rpc_round_trips"] += trips
         _comm_stats["comm_bytes_sent"] += sent
         _comm_stats["comm_bytes_recv"] += recv
+
+
+def note_recovery(ms):
+    """One fenced round replay completed after a pserver incarnation bump
+    (ops/dist_ops.py): time-to-recover accumulates so bench dist legs and
+    the smoke COUNTERS line surface restart cost."""
+    with _comm_lock:
+        _comm_stats["recoveries"] += 1
+        _comm_stats["recovery_ms"] = round(
+            _comm_stats["recovery_ms"] + ms, 3)
 
 
 def get_comm_stats():
@@ -82,7 +96,48 @@ def get_comm_stats():
 def reset_comm_stats():
     with _comm_lock:
         for k in _comm_stats:
-            _comm_stats[k] = 0
+            _comm_stats[k] = 0 if not isinstance(_comm_stats[k], float) \
+                else 0.0
+
+
+# ---- pserver incarnation registry ---------------------------------------
+# Every reply envelope carries the serving process's incarnation number
+# (minted per pserver start, cold or restored — ps_server.py).  The
+# registry records the latest incarnation observed per endpoint across
+# EVERY client in this process (serial, pipelined, heartbeat senders), so
+# the trainer-side dist ops can fence a sync round: a bump between a
+# round's sends and its gets means the server restarted mid-round and the
+# round's buckets must be replayed from the round boundary
+# (docs/FAULT_TOLERANCE.md, incarnation fencing).
+_incar_lock = threading.Lock()
+_incarnations = {}  # endpoint -> last incarnation observed
+
+
+def _note_incarnation(endpoint, inc):
+    """Record an observed incarnation; returns True when it CHANGED from
+    a previously-observed value (a restart was witnessed)."""
+    if inc is None:
+        return False
+    with _incar_lock:
+        prev = _incarnations.get(endpoint)
+        _incarnations[endpoint] = inc
+    if prev is not None and prev != inc:
+        with _comm_lock:
+            _comm_stats["pserver_restarts_seen"] += 1
+        return True
+    return False
+
+
+def incarnation_of(endpoint):
+    """Latest incarnation observed from `endpoint`, or None before any
+    reply has been seen."""
+    with _incar_lock:
+        return _incarnations.get(endpoint)
+
+
+def reset_incarnations():
+    with _incar_lock:
+        _incarnations.clear()
 
 
 def _encode(obj, out):
@@ -298,8 +353,12 @@ class _Handler(socketserver.BaseRequestHandler):
                 # replies carry the req_id: a duplicated request frame (a
                 # retransmitting network / fault injection) produces an
                 # EXTRA reply, and without the id the client would pair it
-                # with its next request and read results off-by-one
-                _send_msg(self.request, ("__reply__", req_id, result))
+                # with its next request and read results off-by-one.
+                # They also carry the service's incarnation (0 for
+                # services without one) so clients can fence restarts.
+                _send_msg(self.request,
+                          ("__reply__", req_id, result,
+                           getattr(service, "incarnation", 0)))
         except (ConnectionError, EOFError, ValueError):
             # ValueError = malformed/hostile frame (bad tag, bad version,
             # bad MAC, length bomb): the framing can no longer be trusted,
@@ -418,7 +477,9 @@ class NativeVarServer:
         result = _execute_once(self.dedup, self.dedup_lock, self.service,
                                verb, kwargs, req_id)
         # same reply envelope as the Python transport (see _Handler)
-        payload = bytes(_encode(("__reply__", req_id, result), bytearray()))
+        payload = bytes(_encode(
+            ("__reply__", req_id, result,
+             getattr(self.service, "incarnation", 0)), bytearray()))
         # a handler can outlive shutdown(): take an in-flight ticket under
         # the lifecycle lock, but run the (possibly blocking) TCP write
         # OUTSIDE it — one stalled peer must not freeze other replies.
@@ -526,6 +587,7 @@ class RPCClient:
     def reset_all(cls):
         stop_heartbeats()
         PipelinedClient.reset_all()
+        reset_incarnations()
         with cls._lock:
             for cli in cls._instances.values():
                 cli.close()
@@ -643,21 +705,28 @@ class RPCClient:
                         # unwrap the reply envelope, discarding STALE
                         # replies: a duplicated request frame yields an
                         # extra reply whose req_id pairs it with a past
-                        # call, not this one
+                        # call, not this one.  Envelopes are
+                        # (__reply__, req_id, result[, incarnation]) — the
+                        # 3-tuple form is the pre-incarnation wire format.
                         while (isinstance(result, tuple)
-                               and len(result) == 3
+                               and len(result) in (3, 4)
                                and result[0] == "__reply__"
                                and result[1] != req_id):
                             result, more = _recv_msg_sized(self._sock)
                             recvd += more
-                        if (isinstance(result, tuple) and len(result) == 3
+                        if (isinstance(result, tuple)
+                                and len(result) in (3, 4)
                                 and result[0] == "__reply__"):
+                            if len(result) == 4:
+                                _note_incarnation(self.endpoint, result[3])
                             result = result[2]
                         # heartbeats are wall-clock-paced background
-                        # liveness, not op-plan traffic: counting them
-                        # would make the "deterministic" counters vary
-                        # with run duration
-                        if verb != "heartbeat":
+                        # liveness and register is once-per-contact
+                        # control traffic — neither is op-plan traffic,
+                        # and counting them would make the
+                        # "deterministic" counters vary with run
+                        # duration / restart history
+                        if verb not in ("heartbeat", "register"):
                             _bump_comm(trips=1, sent=sent, recv=recvd)
                         break
                     except socket.timeout:
@@ -731,6 +800,16 @@ class RPCClient:
         is not evicted from the sync round (go/master trainer-lease
         analog, inverted: the SERVER tracks trainer leases here)."""
         return self.call("heartbeat", deadline_s=deadline_s,
+                         trainer_id=trainer_id)
+
+    def register(self, trainer_id=0):
+        """Handshake + elastic (re)join: declare a FRESH trainer
+        incarnation to the pserver.  The server resets this trainer's
+        per-step fold fences; an evicted/completed id is readmitted into
+        the live set — blocking until the next round boundary so barrier
+        totals never change mid-round.  The reply's envelope incarnation
+        seeds the client-side fence baseline."""
+        return self.call("register", timeout_s=self.barrier_timeout,
                          trainer_id=trainer_id)
 
     def complete(self, trainer_id=0):

@@ -14,6 +14,18 @@ eviction_deadline (trainer liveness + pserver barrier eviction,
 docs/FAULT_TOLERANCE.md).  The remaining knobs are accepted
 for script compatibility and are no-ops under XLA (their help text says
 so) — memory budgeting belongs to PJRT and fusion to the compiler.
+
+Liveness-pair validation: eviction_deadline must exceed
+heartbeat_interval, or every healthy trainer would miss its own liveness
+deadline between beats (a self-evicting job).  The registry validates the
+pair at load time and on set_flags(), warning and CLAMPING the deadline
+to 3x the interval instead of silently configuring a broken job.
+
+Self-healing knobs that are NOT FLAGS_: the supervisor restart policy
+(--supervise / --max-restarts / --restart-window / --restart-backoff /
+--ckpt-dir) is per-launch CLI surface on paddle_tpu.distributed.launch,
+and pserver incarnation numbers are minted automatically per start
+(persisted next to the checkpoint) — see docs/FAULT_TOLERANCE.md.
 """
 
 import os
@@ -64,6 +76,29 @@ def set_flags(mapping):
             raise KeyError("unknown flag %s (known: %s)" % (key, sorted(_flags)))
         f = _flags[key]
         f.value = _coerce(f.default, value)
+    _validate_liveness_pair()
+
+
+def _validate_liveness_pair():
+    """eviction_deadline <= heartbeat_interval configures a SELF-EVICTING
+    job: a healthy trainer goes 'silent' for one full interval between
+    beats, so the deadline must comfortably exceed it.  Warn and clamp
+    to 3x the interval (one lost beat + scheduling slack) rather than
+    letting the misconfiguration eat the cluster at the first barrier."""
+    if "eviction_deadline" not in _flags or "heartbeat_interval" not in _flags:
+        return  # registry still loading
+    hb = _flags["heartbeat_interval"].value
+    ev = _flags["eviction_deadline"]
+    if hb > 0 and ev.value <= hb:
+        import sys
+
+        clamped = 3.0 * float(hb)
+        sys.stderr.write(
+            "WARNING: FLAGS_eviction_deadline=%.3g <= "
+            "FLAGS_heartbeat_interval=%.3g would evict healthy trainers "
+            "between beats; clamping eviction_deadline to %.3g\n"
+            % (ev.value, hb, clamped))
+        ev.value = clamped
 
 
 def flag_items():
@@ -150,3 +185,4 @@ DEFINE_flag("tpu_bf16_matmul", False,
             "rewrite_bf16() program rewrite, not a global flag yet")
 
 _parse_batch_env()
+_validate_liveness_pair()

@@ -70,6 +70,86 @@ def _pipelined(trainer_id):
 _BLOCKING_TIMEOUT = 1200.0
 
 
+# ---- incarnation fencing (sync bucketed path) ---------------------------
+# Per-endpoint replay state (docs/FAULT_TOLERANCE.md): every reply
+# envelope carries the pserver's incarnation; a CHANGE between the
+# incarnation this round's sends landed on and a later observation means
+# the server restarted mid-round from its round-boundary checkpoint, so
+# the current round's bucket stream (sparse chunks first, then dense
+# buckets — the last dense bucket is the folded barrier) is re-shipped.
+# Server-side set counting + the checkpointed fold fence make the replay
+# idempotent: rounds the restored snapshot already contains are dropped,
+# rounds it missed are re-assembled exactly once.  Ordered host callbacks
+# mean one thread mutates this state; the dict is module-level so the
+# send ops (which record) and recv_bucket (which detects and replays)
+# share it.
+_fences = {}  # endpoint -> {"inc", "step", "fstep", "sends", "sparse"}
+_MAX_ROUND_REPLAYS = 6
+
+
+def _fence(ep):
+    st = _fences.get(ep)
+    if st is None:
+        st = _fences[ep] = {"inc": None, "step": 0, "fstep": 0,
+                            "sends": [], "sparse": {}}
+    return st
+
+
+def reset_fences():
+    """Test isolation hook (mirrors rpc.reset_comm_stats)."""
+    _fences.clear()
+
+
+def _stale_endpoints(eps):
+    """Endpoints whose observed incarnation moved past the fence
+    baseline.  First observation just seeds the baseline (the register
+    handshake at first contact has usually seeded the registry)."""
+    from ..distributed import rpc as _rpc
+
+    out = []
+    for ep in eps:
+        st = _fence(ep)
+        cur = _rpc.incarnation_of(ep)
+        if st["inc"] is None:
+            st["inc"] = cur
+        elif cur is not None and cur != st["inc"]:
+            out.append(ep)
+    return out
+
+
+def _replay_round_sends(pipe, trainer_id, eps):
+    """Re-ship the recorded current-round stream to restarted endpoints:
+    queued sparse chunks first (they must be pending BEFORE the dense
+    fold triggers the round), then the dense buckets.  The submit that
+    completes the server's set blocks until the replayed round runs —
+    the happens-before edge that makes recovery a fence, not a sleep."""
+    import time
+
+    from ..distributed import rpc as _rpc
+    from ..distributed.rpc import RPCClient
+
+    t0 = time.perf_counter()
+    # the incarnation each replay is ADDRESSED to, captured up front:
+    # re-baselining to whatever the drain last observed would mask a
+    # SECOND restart landing mid-replay (part of the stream lost again),
+    # and the post-fetch staleness check would wrongly see calm
+    targets = {ep: _rpc.incarnation_of(ep) for ep in eps}
+    for ep in eps:
+        st = _fence(ep)
+        cli = RPCClient.get(ep)
+        for kw in st["sparse"].values():
+            r = cli.call("send_sparse", **kw)
+            _check_not_evicted(r, ep, trainer_id)
+        for kw in st["sends"]:
+            pipe(ep).submit("send_bucket", timeout_s=_BLOCKING_TIMEOUT,
+                            **kw)
+    for ep in eps:
+        for r in pipe(ep).drain():
+            _check_not_evicted(r, ep, trainer_id)
+        _fence(ep)["inc"] = targets[ep]
+    _rpc.note_recovery((time.perf_counter() - t0) * 1e3)
+
+
 def _check_not_evicted(result, ep, trainer_id):
     """A pserver answers evicted=True to a trainer it declared dead (its
     grads were dropped mid-round).  Training on silently-stale params
@@ -199,13 +279,45 @@ def _send_bucket(ctx, ins, attrs):
 
     def host_send(*grads):
         flats = [np.asarray(g).reshape(-1) for g in grads]
+        per_ep = {}
         for ep, entries in plan:
             blocks = {bn: flats[xi][b:e] for xi, b, e, bn in entries}
-            pipe(ep).submit(
-                "send_bucket",
-                timeout_s=_BLOCKING_TIMEOUT if totals.get(ep) else None,
-                blocks=blocks, trainer_id=trainer_id,
-                seq_total=totals.get(ep))
+            per_ep.setdefault(ep, []).append(blocks)
+        for ep, blist in per_ep.items():
+            total = totals.get(ep)
+            if not total:
+                for blocks in blist:  # async: no folding, no fencing
+                    pipe(ep).submit("send_bucket", blocks=blocks,
+                                    trainer_id=trainer_id, seq_total=None)
+                continue
+            # sync: mint this round's step token, record the stream for
+            # incarnation-fenced replay, stamp each bucket's seq_idx so
+            # the server counts arrivals by SET (replay-idempotent)
+            st = _fence(ep)
+            if st["inc"] is None:
+                # baseline = the incarnation the register handshake saw:
+                # a restart during even the FIRST round must be fenced
+                from ..distributed import rpc as _rpc
+
+                st["inc"] = _rpc.incarnation_of(ep)
+            st["step"] += 1
+            # declare this step's sparse manifest on every dense bucket:
+            # the server must not fold (and run the round) until each
+            # declared chunk is pending.  Without this, a crash after
+            # the sparse acks lets RPC-level retries of the UNACKED
+            # dense buckets assemble the round on the restarted server
+            # with the sparse rows lost in the dead incarnation's
+            # memory — and the fold fence would then drop the fenced
+            # replay's corrective chunks as dup_round.
+            declared = (sorted(st["sparse"])
+                        if st.get("sparse_step") == st["step"] else [])
+            st["sends"] = [
+                dict(blocks=blocks, trainer_id=trainer_id, seq_total=total,
+                     step=st["step"], seq_idx=i, sparse_tables=declared)
+                for i, blocks in enumerate(blist)]
+            for kw in st["sends"]:
+                pipe(ep).submit("send_bucket", timeout_s=_BLOCKING_TIMEOUT,
+                                **kw)
         return np.int32(0)
 
     tok = io_callback(
@@ -237,23 +349,63 @@ def _recv_bucket(ctx, ins, attrs):
     ]
 
     def host_recv():
-        for ep in {ep for ep, _ in buckets}:
+        eps_here = sorted({ep for ep, _ in buckets})
+        for ep in eps_here:
             for r in pipe(ep).drain():
                 _check_not_evicted(r, ep, trainer_id)
-        futs = [(ep, pipe(ep).submit("get_bucket",
-                                     timeout_s=_BLOCKING_TIMEOUT,
-                                     names=names, trainer_id=trainer_id,
-                                     fetch_total=totals.get(ep)))
-                for ep, names in buckets]
+        fenced = bool(totals)
+        per_ep_names = {}
+        for ep, names in buckets:
+            per_ep_names.setdefault(ep, []).append(names)
+        if fenced:
+            # one fetch step token per logical step; replays inside this
+            # invocation reuse it (the server dedups by set / fold fence)
+            for ep in eps_here:
+                st = _fence(ep)
+                st["fstep"] += 1
         block_vals = {}
-        for ep, f in futs:
-            got = f.result()
-            if not isinstance(got, dict):
-                raise RuntimeError(
-                    "get_bucket from %s returned %r" % (ep, type(got)))
-            block_vals.update(got)
-        for ep in {ep for ep, _ in futs}:
-            pipe(ep).drain()  # clear resolved futures off the window
+        to_fetch = list(eps_here)
+        for _attempt in range(_MAX_ROUND_REPLAYS):
+            if fenced:
+                # a bump between this round's sends and here means the
+                # server restarted from its round-boundary checkpoint:
+                # re-ship the round's stream before pulling params
+                stale = _stale_endpoints(eps_here)
+                if stale:
+                    _replay_round_sends(pipe, trainer_id, stale)
+            futs = []
+            for ep in to_fetch:
+                for i, names in enumerate(per_ep_names.get(ep, [])):
+                    futs.append((ep, pipe(ep).submit(
+                        "get_bucket", timeout_s=_BLOCKING_TIMEOUT,
+                        names=names, trainer_id=trainer_id,
+                        fetch_total=totals.get(ep),
+                        step=_fence(ep)["fstep"] if fenced else None,
+                        seq_idx=i)))
+            for ep, f in futs:
+                got = f.result()
+                if not isinstance(got, dict):
+                    raise RuntimeError(
+                        "get_bucket from %s returned %r" % (ep, type(got)))
+                block_vals.update(got)
+            for ep in to_fetch:
+                pipe(ep).drain()  # clear resolved futures off the window
+            if not fenced:
+                break
+            # a restart DURING the fetch served params from a snapshot
+            # that may predate this round: replay + re-pull — but ONLY
+            # from the stale endpoints.  A healthy peer whose fetch
+            # barrier already drained has params_ready off, and a
+            # redundant re-pull there would park on a flag only the
+            # NEXT round sets
+            stale = _stale_endpoints(eps_here)
+            if not stale:
+                break
+            to_fetch = stale
+        else:
+            raise RuntimeError(
+                "sync round could not complete: pserver(s) restarted "
+                "faster than %d fenced replays" % _MAX_ROUND_REPLAYS)
         outs = []
         for p, shape, dtype, bnames in params:
             flat = np.concatenate(
@@ -318,6 +470,7 @@ def _send_sparse(ctx, ins, attrs):
     table_names = list(attrs["table_names"])
     trainer_id = int(attrs.get("trainer_id", 0))
     scale = float(attrs.get("scale", 1.0))
+    sync_mode = bool(attrs.get("sync_mode", False))
     n = len(epmap)
     cli = _client_map(trainer_id)
 
@@ -329,10 +482,26 @@ def _send_sparse(ctx, ins, attrs):
             if not mask.any():
                 continue
             local = flat[mask] // n
-            r = cli(epmap[s]).send_sparse(
-                table_names[s], local, g[mask], trainer_id
-            )
-            _check_not_evicted(r, epmap[s], trainer_id)
+            ep = epmap[s]
+            kw = dict(table=table_names[s], ids=local, rows=g[mask],
+                      trainer_id=trainer_id)
+            if sync_mode:
+                # stamp the chunk with the UPCOMING dense step token
+                # (this training step's send_bucket mints step+1) and
+                # record it for incarnation-fenced replay — the server's
+                # keyed pending slot + fold fence keep replays idempotent.
+                # Keyed by TABLE so the record stays bounded even on the
+                # legacy per-var path, where no send_bucket advances the
+                # step token and the reset-on-new-step never fires
+                st = _fence(ep)
+                step = st["step"] + 1
+                kw["step"] = step
+                if st.get("sparse_step") != step:
+                    st["sparse_step"] = step
+                    st["sparse"] = {}
+                st["sparse"][table_names[s]] = kw
+            r = cli(ep).call("send_sparse", **kw)
+            _check_not_evicted(r, ep, trainer_id)
         return np.int32(0)
 
     tok = io_callback(

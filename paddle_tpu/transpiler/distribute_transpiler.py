@@ -317,6 +317,9 @@ class DistributeTranspiler:
                         "table_names": info["shards"],
                         "trainer_id": self.trainer_id,
                         "scale": 1.0 / float(self.trainer_num),
+                        # sync rounds fence sparse chunks with the dense
+                        # step token for restart replay (dist_ops)
+                        "sync_mode": self.sync_mode,
                         "op_role": "rpc",
                     },
                 )

@@ -158,9 +158,16 @@ def main():
     lo, hi = trainer_id * shard, (trainer_id + 1) * shard
     step_sleep = float(os.environ.get("DIST_STEP_SLEEP", "0"))
     # chaos hook (tests/test_fault_tolerance.py): SIGKILL this rank after
-    # step N — a real mid-training process death, no cleanup, no complete
+    # step N — a real mid-training process death, no cleanup, no complete.
+    # DIST_CRASH_ONCE names a marker file: the crash fires only while the
+    # marker is absent (created just before the kill), so a SUPERVISED
+    # relaunch of the same rank runs clean instead of crash-looping —
+    # the deterministic "die once, rejoin" fence for the elastic tests.
     crash_rank = int(os.environ.get("DIST_CRASH_RANK", "-1"))
     crash_after = int(os.environ.get("DIST_CRASH_AFTER_STEP", "-1"))
+    crash_once = os.environ.get("DIST_CRASH_ONCE", "")
+    if crash_once and os.path.exists(crash_once):
+        crash_rank = -1  # this incarnation already died once
     losses = []
     for i in range(steps):
         (lv,) = exe.run(
@@ -173,6 +180,9 @@ def main():
         if trainer_id == crash_rank and i == crash_after:
             import signal
 
+            if crash_once:
+                with open(crash_once, "w") as f:
+                    f.write("crashed\n")
             print("CRASHING trainer %d after step %d" % (trainer_id, i),
                   flush=True)
             os.kill(os.getpid(), signal.SIGKILL)

@@ -542,7 +542,7 @@ def test_eviction_drops_queued_sparse_rows():
                       np.ones((1, 2), np.float32), trainer_id=0)
     with ps._cv:
         ps._evict_locked(1, "test")
-    assert [p[3] for p in ps._pending_sparse] == [0]
+    assert [tid for tid, _tbl in ps._pending_sparse] == [0]
     with ps._cv:
         ps._run_round()
     tbl = ps.sparse_tables["t0"]["tbl"]
@@ -800,6 +800,161 @@ def _free_port():
     return port
 
 
+def _trainer_losses(out, tag):
+    """Parse one trainer's LOSSES line out of [tag]-prefixed cluster
+    output."""
+    for ln in out.splitlines():
+        if ln.startswith("[%s] LOSSES " % tag):
+            return json.loads(ln[len("[%s] LOSSES " % tag):])
+    raise AssertionError("no LOSSES line for %s in:\n%s" % (tag, out))
+
+
+def test_supervised_pserver_sigkill_restores_and_job_completes(
+        tmp_path, capfd):
+    """ACCEPTANCE (tentpole): a SIGKILL'd pserver under supervision
+    restarts from its manifest checkpoint, mints a new incarnation, the
+    trainer fences the restart (replaying the in-flight round), and the
+    sync dist MLP job runs to completion with finite loss.  The kill
+    trigger is a FENCE — the first checkpointed round's manifest exists
+    — not a timer."""
+    from paddle_tpu.distributed.launch import _Cluster, _RestartPolicy
+
+    port = _free_port()
+    eps = "127.0.0.1:%d" % port
+    ckpt = str(tmp_path / "ckpt")
+    steps = 8
+    full = dict(os.environ)
+    full.update({
+        "PADDLE_PSERVER_EPS": eps,
+        "PADDLE_TRAINERS": "1",
+        "DIST_SYNC_MODE": "1",
+        "DIST_STEPS": str(steps),
+        "DIST_STEP_SLEEP": "0.2",
+        "PADDLE_PSERVER_CKPT_DIR": ckpt,
+        "PADDLE_PSERVER_CKPT_EVERY": "1",
+        "FLAGS_max_retry": "120",
+        "JAX_PLATFORMS": "cpu",
+    })
+    full.pop("PALLAS_AXON_POOL_IPS", None)
+    cmd = [sys.executable, "-u", _RUNNER]
+    ps_env = dict(full, PADDLE_TRAINING_ROLE="PSERVER",
+                  PADDLE_CURRENT_ENDPOINT=eps)
+    cluster = _Cluster()
+    cluster.supervise("pserver.0", cmd, ps_env,
+                      _RestartPolicy(max_restarts=3, backoff_s=0.2))
+    cluster.spawn("pserver.0", cmd, ps_env)
+    try:
+        _wait_port(port)
+        cluster.spawn("trainer.0", cmd,
+                      dict(full, PADDLE_TRAINING_ROLE="TRAINER",
+                           PADDLE_TRAINER_ID="0"))
+        # FENCE: round >= 1 has been checkpointed (manifest landed) —
+        # any kill from here on must be recoverable
+        manifest = os.path.join(ckpt, "pserver_0.manifest.json")
+        t0 = time.time()
+        while time.time() - t0 < 120 and not os.path.exists(manifest):
+            time.sleep(0.05)
+        assert os.path.exists(manifest), "no checkpoint before the kill"
+        cluster.proc("pserver.0").kill()  # real mid-job SIGKILL
+        rc = cluster.wait()
+    finally:
+        cluster.kill()
+    out = capfd.readouterr().out
+    assert rc == 0, out
+    assert cluster.restarts.get("pserver.0", 0) >= 1, \
+        "supervisor never restarted the killed pserver"
+    assert "PSERVER RESTORED" in out, out
+    losses = _trainer_losses(out, "trainer.0")
+    assert len(losses) == steps
+    assert np.isfinite(losses).all(), losses
+    # recovery observability: the trainer witnessed the restart
+    for ln in out.splitlines():
+        if ln.startswith("[trainer.0] COUNTERS "):
+            c = json.loads(ln[len("[trainer.0] COUNTERS "):])
+            assert c["pserver_restarts_seen"] >= 1, c
+            break
+    else:
+        raise AssertionError("no COUNTERS line:\n%s" % out)
+
+
+def test_supervised_trainer_relaunch_rejoins_at_round_boundary(
+        tmp_path, capfd):
+    """ACCEPTANCE (tentpole): a killed trainer under supervision
+    relaunches, the launcher evicts the ghost THEN pre-registers the id
+    (so the job survives the boot window), the pserver readmits it at a
+    round boundary, and BOTH trainers finish with finite losses.  The
+    crash trigger is a fence (self-SIGKILL after step 1, once — marker
+    file), not a timer."""
+    from paddle_tpu.distributed.launch import launch_pserver
+
+    marker = str(tmp_path / "crash_once")
+    env = dict(os.environ)
+    steps = 6
+    env.update({
+        "DIST_STEPS": str(steps),
+        "DIST_STEP_SLEEP": "0.25",
+        "DIST_CRASH_RANK": "1",
+        "DIST_CRASH_AFTER_STEP": "1",
+        "DIST_CRASH_ONCE": marker,
+        "FLAGS_max_retry": "120",
+        "JAX_PLATFORMS": "cpu",
+    })
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    rc = launch_pserver([_RUNNER], nproc=2, n_pservers=1, base_env=env,
+                        sync=True, supervise=True, restart_backoff=0.2)
+    out = capfd.readouterr().out
+    assert rc == 0, out
+    assert os.path.exists(marker), "the chaos crash never fired"
+    assert "PSERVER EVICT trainer=1" in out, out
+    assert "PSERVER READMIT trainer=1" in out, out
+    l0 = _trainer_losses(out, "trainer.0")
+    l1 = _trainer_losses(out, "trainer.1")
+    assert len(l0) == steps and np.isfinite(l0).all(), l0
+    assert len(l1) == steps and np.isfinite(l1).all(), l1
+    # the pserver's final stats agree: one eviction, one readmission
+    for ln in out.splitlines():
+        if ln.startswith("[pserver.0] PSERVER-STATS "):
+            s = json.loads(ln[len("[pserver.0] PSERVER-STATS "):])
+            assert s["evictions"] == 1 and s["readmissions"] == 1, s
+            break
+    else:
+        raise AssertionError("no PSERVER-STATS line:\n%s" % out)
+
+
+def test_supervised_sole_trainer_relaunch_completes_the_job(
+        tmp_path, capfd):
+    """The nproc=1 corner of supervised trainer recovery: the death
+    notification must NOT let the pserver declare the job done (empty
+    live set) before the replacement boots — the respawn-aware evict
+    parks the id and the eviction's own boundary readmits it, so the
+    pserver outlives its only trainer's death and the relaunched
+    process finishes every step."""
+    from paddle_tpu.distributed.launch import launch_pserver
+
+    marker = str(tmp_path / "crash_once")
+    env = dict(os.environ)
+    steps = 4
+    env.update({
+        "DIST_STEPS": str(steps),
+        "DIST_STEP_SLEEP": "0.25",
+        "DIST_CRASH_RANK": "0",
+        "DIST_CRASH_AFTER_STEP": "1",
+        "DIST_CRASH_ONCE": marker,
+        "FLAGS_max_retry": "120",
+        "JAX_PLATFORMS": "cpu",
+    })
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    rc = launch_pserver([_RUNNER], nproc=1, n_pservers=1, base_env=env,
+                        sync=True, supervise=True, restart_backoff=0.2)
+    out = capfd.readouterr().out
+    assert rc == 0, out
+    assert os.path.exists(marker), "the chaos crash never fired"
+    assert "PSERVER EVICT trainer=0" in out, out
+    assert "PSERVER READMIT trainer=0" in out, out
+    losses = _trainer_losses(out, "trainer.0")
+    assert len(losses) == steps and np.isfinite(losses).all(), losses
+
+
 def test_sigkilled_trainer_is_evicted_and_survivor_finishes():
     """Acceptance: 2 sync trainers, trainer 1 SIGKILLs itself after step
     1; the pserver evicts it on the liveness deadline and trainer 0
@@ -837,6 +992,693 @@ def test_sigkilled_trainer_is_evicted_and_survivor_finishes():
         for p in (ps, victim, survivor):
             if p is not None and p.poll() is None:
                 p.kill()
+
+
+# ---------------------------------------------------------------------------
+# incarnation fencing: minting, envelope, replay idempotency, restore fences
+# ---------------------------------------------------------------------------
+
+def test_incarnation_persists_and_increments_per_start(tmp_path):
+    """Every pserver start in the same checkpoint home mints a HIGHER
+    incarnation; without a durable home the numbers still differ."""
+    ps1 = ParameterServer({}, {}, num_trainers=1,
+                          checkpoint_dir=str(tmp_path), server_idx=0)
+    ps2 = ParameterServer({}, {}, num_trainers=1,
+                          checkpoint_dir=str(tmp_path), server_idx=0)
+    assert ps2.incarnation == ps1.incarnation + 1
+    # a different shard index has its own counter
+    other = ParameterServer({}, {}, num_trainers=1,
+                            checkpoint_dir=str(tmp_path), server_idx=1)
+    assert other.incarnation == 1
+
+
+def test_reply_envelope_carries_incarnation_to_client_registry():
+    from paddle_tpu.distributed import rpc as rpc_mod
+
+    ps = ParameterServer({}, {}, num_trainers=1, sync_mode=False)
+    ps.incarnation = 41
+    srv = VarServer("127.0.0.1:0", ps).start()
+    try:
+        cli = RPCClient(srv.endpoint, timeout=5, retries=3)
+        cli.call("heartbeat", trainer_id=0)
+        assert rpc_mod.incarnation_of(srv.endpoint) == 41
+        before = rpc_mod.get_comm_stats()["pserver_restarts_seen"]
+        ps.incarnation = 42  # the "restart"
+        cli.call("heartbeat", trainer_id=0)
+        assert rpc_mod.incarnation_of(srv.endpoint) == 42
+        assert rpc_mod.get_comm_stats()["pserver_restarts_seen"] \
+            == before + 1
+        cli.close()
+    finally:
+        srv.shutdown()
+
+
+def test_fenced_send_stream_counts_by_set_and_drops_folded_replays():
+    """The replay-idempotency core: (step, seq_idx)-stamped buckets fold
+    by SET (a duplicated bucket cannot advance the count), and once a
+    step folded, replaying its whole stream is dropped at the fold fence
+    instead of double-running the round."""
+    ps = ParameterServer([None, None], {"g0": 0, "g1": 1}, num_trainers=1,
+                         sync_mode=True)
+    rounds = []
+    ps._apply_shard = lambda idx, feed: rounds.append(
+        {k: np.asarray(v).copy() for k, v in feed.items()})
+    # bucket 0 of 2 arrives, then is REPLAYED (spurious): set semantics
+    # keep the fold count at 1
+    r = ps._h_send_bucket({"g0": np.full(2, 1.0)}, trainer_id=0,
+                          seq_total=2, step=1, seq_idx=0)
+    assert r == {"ok": True} and ps._round == 0
+    r = ps._h_send_bucket({"g0": np.full(2, 1.0)}, trainer_id=0,
+                          seq_total=2, step=1, seq_idx=0)
+    assert r == {"ok": True} and ps._round == 0, "dup bucket advanced fold"
+    # bucket 1 completes the set: the round runs exactly once
+    r = ps._h_send_bucket({"g1": np.full(2, 5.0)}, trainer_id=0,
+                          seq_total=2, step=1, seq_idx=1)
+    assert r == {"ok": True} and ps._round == 1
+    assert ps._folded_send[0] == 1
+    # a full replay of the folded step (the restart path when the
+    # snapshot already contained the round) is dropped, not re-run
+    for i in range(2):
+        r = ps._h_send_bucket({"g0": np.full(2, 9.0)}, trainer_id=0,
+                              seq_total=2, step=1, seq_idx=i)
+        assert r.get("dup_round"), r
+    assert ps._round == 1 and len(rounds) == 2  # g0+g1 applied once each
+    assert ps.counters["dup_round_drops"] == 2
+
+
+def test_fenced_sparse_replay_dropped_after_fold():
+    """A replayed sparse chunk stamped with an already-folded step must
+    not leak into the next round's queue."""
+    ps = ParameterServer(
+        [None], {"g0": 0}, num_trainers=1, sync_mode=True,
+        sparse_tables={"t0": {"tbl": np.zeros((4, 2), np.float32),
+                              "lr": 0.1,
+                              "opt": {"type": "sgd", "attrs": {}}}})
+    ps._apply_shard = lambda idx, feed: None
+    ps._h_send_sparse("t0", np.array([1]), np.ones((1, 2), np.float32),
+                      trainer_id=0, step=1)
+    ps._h_send_bucket({"g0": np.zeros(2)}, trainer_id=0, seq_total=1,
+                      step=1, seq_idx=0)
+    assert ps._round == 1 and not ps._pending_sparse
+    # the fenced replay of step 1's sparse chunk after the fold
+    r = ps._h_send_sparse("t0", np.array([1]), np.ones((1, 2), np.float32),
+                          trainer_id=0, step=1)
+    assert r.get("dup_round"), r
+    assert not ps._pending_sparse, "replayed rows leaked into next round"
+
+
+def test_send_fold_waits_for_declared_sparse_chunks():
+    """A crash between the sparse acks and the dense folds re-delivers
+    only the (unacked) dense buckets via RPC retries: the restarted
+    server must NOT run the round without the sparse rows the dead
+    incarnation had only queued in memory — the dense fold refuses
+    (need_sparse) until the fenced replay re-queues every declared
+    chunk, then applies the round exactly once WITH them."""
+    ps = ParameterServer(
+        [None], {"g0": 0}, num_trainers=1, sync_mode=True,
+        sparse_tables={"t0": {"tbl": np.zeros((4, 2), np.float32),
+                              "lr": 0.1,
+                              "opt": {"type": "sgd", "attrs": {}}}})
+    applied = []
+    ps._apply_shard = lambda idx, feed: applied.append(
+        np.asarray(feed["g0"]).copy())
+    # the retried dense bucket arrives first (fresh post-restart server,
+    # sparse chunk lost with the old incarnation's memory)
+    r = ps._h_send_bucket({"g0": np.full(2, 1.0)}, trainer_id=0,
+                          seq_total=1, step=1, seq_idx=0,
+                          sparse_tables=["t0"])
+    assert r.get("need_sparse") == ["t0"], r
+    assert ps._round == 0 and not applied, \
+        "round ran without its declared sparse rows"
+    # the fenced replay ships sparse FIRST, then the dense buckets
+    ps._h_send_sparse("t0", np.array([1]), np.ones((1, 2), np.float32),
+                      trainer_id=0, step=1)
+    r = ps._h_send_bucket({"g0": np.full(2, 1.0)}, trainer_id=0,
+                          seq_total=1, step=1, seq_idx=0,
+                          sparse_tables=["t0"])
+    assert r == {"ok": True} and ps._round == 1
+    assert len(applied) == 1
+    np.testing.assert_allclose(
+        ps.sparse_tables["t0"]["tbl"][1], np.full(2, -0.1), atol=1e-6)
+
+
+def test_restored_server_serves_params_and_fences_folded_rounds(tmp_path):
+    """The restart seam end-to-end, in-process: a sync server folds a
+    fenced round and checkpoints; the RESTORED server (a) serves params
+    immediately (params_ready — a restart during the fetch phase must
+    not deadlock), (b) restores the fold fence so a replay of the
+    checkpointed round is dropped, and (c) re-assembles a round the
+    snapshot never saw."""
+    ps = ParameterServer([None], {"g0": 0}, num_trainers=1, sync_mode=True,
+                         checkpoint_dir=str(tmp_path), server_idx=0,
+                         checkpoint_every=1)
+    applied = []
+    ps._apply_shard = lambda idx, feed: applied.append(
+        np.asarray(feed["g0"]).copy())
+    ps.scope.set("p.block0", np.zeros(2, np.float32))
+    ps._h_send_bucket({"g0": np.full(2, 3.0)}, trainer_id=0, seq_total=1,
+                      step=1, seq_idx=0)
+    assert ps._round == 1
+    # the checkpoint writer runs on a background thread: wait for the
+    # manifest (existence is the fence, not a fixed sleep)
+    deadline = time.monotonic() + 30
+    mpath = tmp_path / "pserver_0.manifest.json"
+    while time.monotonic() < deadline and not (
+            mpath.exists() and json.loads(mpath.read_text())["round"] == 1):
+        time.sleep(0.05)
+    assert mpath.exists()
+
+    ps2 = ParameterServer([None], {"g0": 0}, num_trainers=1, sync_mode=True,
+                          checkpoint_dir=str(tmp_path), server_idx=0)
+    applied2 = []
+    ps2._apply_shard = lambda idx, feed: applied2.append(
+        np.asarray(feed["g0"]).copy())
+    assert ps2.load_checkpoint() == 1
+    assert ps2.incarnation > ps.incarnation
+    assert ps2._params_ready is True, \
+        "restored sync server must serve the checkpointed round's params"
+    assert ps2._folded_send == {0: 1}
+    # (b) replaying the checkpointed round: dropped
+    r = ps2._h_send_bucket({"g0": np.full(2, 3.0)}, trainer_id=0,
+                           seq_total=1, step=1, seq_idx=0)
+    assert r.get("dup_round") and ps2._round == 1 and not applied2
+    # (c) the NEXT round (which the snapshot never saw) re-assembles
+    r = ps2._h_send_bucket({"g0": np.full(2, 7.0)}, trainer_id=0,
+                           seq_total=1, step=2, seq_idx=0)
+    assert r == {"ok": True} and ps2._round == 2
+    np.testing.assert_array_equal(applied2[0], np.full(2, 7.0))
+
+
+def test_send_fence_gap_one_round_tolerated_wider_gap_fails():
+    """The trainer replays only its CURRENT round, so a restore behind
+    the stream loses the rounds in between.  A ONE-round gap (the kill
+    raced the async checkpoint write) proceeds loudly — counted, never
+    silent; a wider gap (checkpoint_every > 1 discarding rounds on
+    every restore) must fail the job instead of quietly training past
+    several lost updates."""
+    ps = ParameterServer([None, None], {"g0": 0, "g1": 1}, num_trainers=1,
+                         sync_mode=True)
+    applied = []
+    ps._apply_shard = lambda idx, feed: applied.append(dict(feed))
+    # restored fence: the snapshot last folded step 1 for trainer 0
+    ps._folded_send[0] = 1
+    # step 3 arrives over TWO buckets (step 2 unrecoverable): tolerated,
+    # and counted ONCE per lost round, not once per arriving bucket
+    r = ps._h_send_bucket({"g0": np.full(1, 3.0)}, trainer_id=0,
+                          seq_total=2, step=3, seq_idx=0)
+    assert r == {"ok": True} and ps._round == 0
+    r = ps._h_send_bucket({"g1": np.full(1, 3.0)}, trainer_id=0,
+                          seq_total=2, step=3, seq_idx=1)
+    assert r == {"ok": True} and ps._round == 1
+    assert ps.counters["lost_rounds"] == 1
+    # step 6 arrives next (steps 4 AND 5 lost): refuse loudly.  handle()
+    # wraps the raise into the error envelope the client re-raises from.
+    r = ps.handle("send_bucket", blocks={"g0": np.full(2, 9.0)},
+                  trainer_id=0, seq_total=1, step=6, seq_idx=0)
+    assert "incarnation fence gap" in r.get("__error__", ""), r
+    assert ps._round == 1 and len(applied) == 2, \
+        "a refused gap must not fold or run a round"
+
+
+def test_restored_server_remembers_departed_trainers(tmp_path):
+    """A restored sync server must not rebuild its live set around
+    ghosts it evicted before the restart — their folds would never
+    arrive and every restored barrier would hang.  The departed sets
+    ride the snapshot; register still readmits."""
+    ps = ParameterServer([None], {"g0": 0}, num_trainers=2, sync_mode=True,
+                         checkpoint_dir=str(tmp_path), server_idx=0,
+                         checkpoint_every=1)
+    ps._apply_shard = lambda idx, feed: None
+    with ps._cv:
+        ps._evict_locked(1, "test")
+    # survivor's round runs and checkpoints (manifest = the fence)
+    ps._h_send_bucket({"g0": np.ones(2)}, trainer_id=0, seq_total=1,
+                      step=1, seq_idx=0)
+    mpath = tmp_path / "pserver_0.manifest.json"
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and not mpath.exists():
+        time.sleep(0.05)
+    assert mpath.exists()
+    ps2 = ParameterServer([None], {"g0": 0}, num_trainers=2, sync_mode=True,
+                          checkpoint_dir=str(tmp_path), server_idx=0)
+    ps2._apply_shard = lambda idx, feed: None
+    assert ps2.load_checkpoint() == 1
+    assert ps2._live == {0} and 1 in ps2._evicted, \
+        "restored server forgot the eviction"
+    # the survivor's next round completes ALONE on the restored server
+    r = ps2._h_send_bucket({"g0": np.ones(2)}, trainer_id=0, seq_total=1,
+                           step=2, seq_idx=0)
+    assert r == {"ok": True} and ps2._round == 2
+    # and the ghost can still come back through register
+    assert ps2._h_register(trainer_id=1)["ok"]
+    assert ps2._live == {0, 1}
+
+
+def test_legacy_bare_array_checkpoint_upgrades_and_rewrites_manifest(
+        tmp_path):
+    """Satellite: a legacy checkpoint (bare sparse table arrays, no
+    manifest) loads, upgrades the in-memory layout, and rewrites BOTH
+    files in the modern format — snapshot with dict-shaped sparse state
+    plus a crc-carrying manifest that verifies."""
+    import pickle
+    import zlib
+
+    legacy = {
+        "round": 4,
+        "vars": {"w.block0": np.arange(3, dtype=np.float32)},
+        "sparse": {"t0": np.full((4, 2), 2.0, np.float32)},  # bare array
+    }
+    path = tmp_path / "pserver_0.ckpt"
+    path.write_bytes(pickle.dumps(legacy, protocol=pickle.HIGHEST_PROTOCOL))
+    ps = ParameterServer(
+        {}, {}, num_trainers=1, sync_mode=False,
+        checkpoint_dir=str(tmp_path), server_idx=0,
+        sparse_tables={"t0": {"tbl": np.zeros((4, 2), np.float32),
+                              "lr": 0.1,
+                              "opt": {"type": "sgd", "attrs": {}}}})
+    assert ps.load_checkpoint() == 4
+    np.testing.assert_array_equal(
+        np.asarray(ps.scope.find_var("w.block0")),
+        np.arange(3, dtype=np.float32))
+    np.testing.assert_array_equal(ps.sparse_tables["t0"]["tbl"],
+                                  np.full((4, 2), 2.0, np.float32))
+    # the rewrite landed a modern crc manifest over a modern snapshot
+    mpath = tmp_path / "pserver_0.manifest.json"
+    assert mpath.exists(), "upgrade did not write a manifest"
+    manifest = json.loads(mpath.read_text())
+    payload = path.read_bytes()
+    assert manifest["round"] == 4
+    assert manifest["nbytes"] == len(payload)
+    assert manifest["crc32"] == (zlib.crc32(payload) & 0xFFFFFFFF)
+    upgraded = pickle.loads(payload)
+    assert isinstance(upgraded["sparse"]["t0"], dict)
+    np.testing.assert_array_equal(upgraded["sparse"]["t0"]["tbl"],
+                                  np.full((4, 2), 2.0, np.float32))
+    # and a THIRD server restores cleanly from the rewritten pair
+    ps3 = ParameterServer(
+        {}, {}, num_trainers=1, sync_mode=False,
+        checkpoint_dir=str(tmp_path), server_idx=0,
+        sparse_tables={"t0": {"tbl": np.zeros((4, 2), np.float32),
+                              "lr": 0.1,
+                              "opt": {"type": "sgd", "attrs": {}}}})
+    assert ps3.load_checkpoint() == 4
+
+
+# ---------------------------------------------------------------------------
+# elastic trainer rejoin (register verb)
+# ---------------------------------------------------------------------------
+
+def test_register_readmits_evicted_trainer_and_barrier_totals_grow():
+    """The rejoin core: an evicted id re-registers, is readmitted at the
+    round boundary, and the NEXT round's barrier denominator includes it
+    — the survivor's fold alone no longer runs the round."""
+    ps = ParameterServer([None], {"g0": 0}, num_trainers=2, sync_mode=True)
+    applied = []
+    ps._apply_shard = lambda idx, feed: applied.append(
+        np.asarray(feed["g0"]).copy())
+    srv = VarServer("127.0.0.1:0", ps).start()
+    try:
+        cli = RPCClient(srv.endpoint, timeout=30, retries=3)
+        with ps._cv:
+            ps._evict_locked(1, "test")
+        assert ps._live == {0}
+        # round boundary (nothing pending): register readmits immediately
+        r = cli.register(trainer_id=1)
+        assert r["ok"] and r["incarnation"] == ps.incarnation
+        assert ps._live == {0, 1} and 1 not in ps._evicted
+        assert ps.counters["readmissions"] == 1
+        # barrier totals reflect the rejoin: the survivor's fold no
+        # longer completes the round by itself — it PARKS waiting on the
+        # readmitted trainer...
+        survivor = []
+        th0 = threading.Thread(target=lambda: survivor.append(
+            cli.call("send_bucket", blocks={"g0": np.full(2, 3.0)},
+                     trainer_id=0, seq_total=1, step=1, seq_idx=0)),
+            daemon=True)
+        th0.start()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and 0 not in ps._send_barriers:
+            time.sleep(0.01)
+        assert 0 in ps._send_barriers and ps._round == 0, \
+            "round ran without the readmitted trainer"
+        # ...until the joiner's stream folds too (its step tokens restart
+        # at 1 — the admission cleared any stale fold fence)
+        done = []
+        cli1 = RPCClient(srv.endpoint, timeout=30, retries=3)
+        th = threading.Thread(target=lambda: done.append(
+            cli1.call("send_bucket", blocks={"g0": np.full(2, 5.0)},
+                     trainer_id=1, seq_total=1, step=1, seq_idx=0)),
+            daemon=True)
+        th.start()
+        th.join(timeout=10)
+        th0.join(timeout=10)
+        assert done and done[0] == {"ok": True}
+        assert survivor and survivor[0] == {"ok": True}
+        assert ps._round == 1
+        cli1.close()
+        assert len(applied) == 1
+        np.testing.assert_array_equal(applied[0], np.full(2, 8.0))
+        cli.close()
+    finally:
+        srv.shutdown()
+
+
+def test_register_midround_waits_for_the_boundary():
+    """Admission is a FENCE on the round boundary: a register arriving
+    while a round is being assembled parks until that round completes,
+    so the in-flight denominator never changes under the survivors."""
+    ps = ParameterServer([None], {"g0": 0}, num_trainers=2, sync_mode=True)
+    ps._apply_shard = lambda idx, feed: None
+    srv = VarServer("127.0.0.1:0", ps).start()
+    try:
+        cli = RPCClient(srv.endpoint, timeout=30, retries=3)
+        with ps._cv:
+            ps._evict_locked(1, "test")
+        # survivor starts assembling a 2-bucket round: mid-round now
+        cli.call("send_bucket", blocks={"g0": np.full(2, 1.0)},
+                 trainer_id=0, seq_total=2, step=1, seq_idx=0)
+        got = []
+        cli2 = RPCClient(srv.endpoint, timeout=30, retries=3)
+        th = threading.Thread(
+            target=lambda: got.append(cli2.register(trainer_id=1)),
+            daemon=True)
+        th.start()
+        # fence, not delay: the register is parked in _pending_joins
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and 1 not in ps._pending_joins:
+            time.sleep(0.01)
+        assert 1 in ps._pending_joins, "register was not queued mid-round"
+        assert 1 not in ps._live
+        # the round completes -> the joiner is admitted at its boundary
+        cli.call("send_bucket", blocks={"g0": np.full(2, 1.0)},
+                 trainer_id=0, seq_total=2, step=1, seq_idx=1)
+        th.join(timeout=10)
+        assert got and got[0]["ok"] and got[0]["round"] == 1
+        assert ps._live == {0, 1}
+        cli.close()
+        cli2.close()
+    finally:
+        srv.shutdown()
+
+
+def test_respawn_evict_of_sole_trainer_keeps_the_job_alive():
+    """A supervised child's death report carries respawn=True: evicting
+    the SOLE trainer must park + readmit the id instead of declaring
+    the job done — the pserver has to outlive the boot window of the
+    replacement the supervisor is about to spawn."""
+    ps = ParameterServer([None], {"g0": 0}, num_trainers=1, sync_mode=True)
+    applied = []
+    ps._apply_shard = lambda idx, feed: applied.append(
+        np.asarray(feed["g0"]).copy())
+    r = ps._h_evict(trainer_id=0, respawn=True)
+    assert r["ok"] and r["live"] == 1
+    assert not ps._done.is_set(), \
+        "job declared done under the booting replacement"
+    assert ps._live == {0} and ps.counters["readmissions"] == 1
+    # the replacement arrives: registers (fresh stream) and trains
+    assert ps._h_register(trainer_id=0)["ok"]
+    ps._h_send_bucket({"g0": np.full(2, 2.0)}, trainer_id=0, seq_total=1,
+                      step=1, seq_idx=0)
+    assert ps._round == 1 and len(applied) == 1
+    ps._h_complete(trainer_id=0)
+    assert ps._done.is_set()
+    # contrast: an UNSUPERVISED sole-trainer death still ends the job
+    ps2 = ParameterServer([None], {"g0": 0}, num_trainers=1,
+                          sync_mode=True)
+    ps2._h_evict(trainer_id=0)
+    assert ps2._done.is_set()
+    # async mode parks + readmits too (no barriers, so the boundary
+    # admits immediately) — the async pserver must equally outlive its
+    # sole trainer's supervised death
+    ps3 = ParameterServer([None], {"g0": 0}, num_trainers=1,
+                          sync_mode=False)
+    ps3._h_evict(trainer_id=0, respawn=True)
+    assert not ps3._done.is_set() and ps3._live == {0}
+
+
+def test_register_rejection_is_terminal_for_the_trainer():
+    """A joiner parked in `register` while the job completes gets
+    ok:False back — and the trainer-side handshake must treat that as
+    TERMINAL: with the live set empty, its sends would each run a
+    "round" alone, silently training the final checkpointed params."""
+    from paddle_tpu import distributed
+
+    ps = ParameterServer([None], {"g0": 0}, num_trainers=2, sync_mode=True)
+    ps._apply_shard = lambda idx, feed: None
+    srv = VarServer("127.0.0.1:0", ps).start()
+    ep = srv.endpoint
+    key = (ep, 1)
+    try:
+        with ps._cv:
+            ps._evict_locked(1, "test")
+        cli = RPCClient(ep, timeout=30, retries=3)
+        # survivor mid-round (1 of 2 buckets): the rejoin must park
+        cli.call("send_bucket", blocks={"g0": np.full(2, 1.0)},
+                 trainer_id=0, seq_total=2, step=1, seq_idx=0)
+        err = []
+
+        def join():
+            try:
+                distributed._note_endpoint(ep, 1)
+                err.append(None)
+            except RuntimeError as e:
+                err.append(e)
+
+        th = threading.Thread(target=join, daemon=True)
+        th.start()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and 1 not in ps._pending_joins:
+            time.sleep(0.01)
+        assert 1 in ps._pending_joins, "register was not queued mid-round"
+        # the survivor departs mid-round: job done, joiner rejected
+        cli.call("complete", trainer_id=0)
+        th.join(timeout=10)
+        assert err and isinstance(err[0], RuntimeError), \
+            "rejected register must raise, not fall through to training"
+        assert "already completed" in str(err[0])
+        cli.close()
+    finally:
+        distributed._active_endpoints.discard(key)
+        with RPCClient._lock:
+            RPCClient._instances.pop(ep, None)
+        srv.shutdown()
+
+
+def test_eviction_of_sole_midround_contributor_restores_the_boundary():
+    """Regression: evicting the only trainer that had contributed grads
+    must leave NO empty per-grad dicts behind in _pending — a leftover
+    {} kept _mid_round_locked() True forever, so a rejoining trainer
+    could never be admitted and the job was wrongly declared done."""
+    ps = ParameterServer([None, None], {"g0": 0, "g1": 1}, num_trainers=2,
+                         sync_mode=True)
+    ps._apply_shard = lambda idx, feed: None
+    # trainer 1 ships bucket 0 of 2 (mid-round now) and dies
+    ps._h_send_bucket({"g0": np.ones(2)}, trainer_id=1, seq_total=2,
+                      step=1, seq_idx=0)
+    assert ps._mid_round_locked()
+    with ps._cv:
+        ps._evict_locked(1, "test")
+    assert not ps._mid_round_locked(), \
+        "empty pending dict kept the server mid-round forever"
+    assert ps._at_boundary_locked()
+    # a rejoin is admitted immediately at the restored boundary
+    assert ps._h_register(trainer_id=1)["ok"]
+    assert ps._live == {0, 1}
+
+
+def test_register_waits_out_pending_fetch_barrier():
+    """Admission must respect the FETCH phase too: a join admitted while
+    the served round's fetch barrier still pends would grow the fetch
+    denominator under the survivors — the stale entries could later
+    complete with the joiner's first fetch and flip params_ready off
+    while survivors still hold un-served gets.  The join parks until the
+    fetch drains."""
+    ps = ParameterServer([None], {"g0": 0}, num_trainers=2, sync_mode=True)
+    ps._apply_shard = lambda idx, feed: None
+    # post-round state: params served, trainer 0 folded its fetch,
+    # trainer 1 still fetching
+    ps._params_ready = True
+    ps._fetch_barriers = {0}
+    srv = VarServer("127.0.0.1:0", ps).start()
+    try:
+        cli = RPCClient(srv.endpoint, timeout=30, retries=3)
+        got = []
+        th = threading.Thread(
+            target=lambda: got.append(cli.register(trainer_id=2)),
+            daemon=True)
+        th.start()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and 2 not in ps._pending_joins:
+            time.sleep(0.01)
+        assert 2 in ps._pending_joins and 2 not in ps._live, \
+            "join admitted while the fetch barrier still pends"
+        # trainer 1 folds its fetch: the barrier drains -> boundary ->
+        # the joiner is admitted and params_ready was reset exactly once
+        cli2 = RPCClient(srv.endpoint, timeout=30, retries=3)
+        assert cli2.call("barrier", kind="fetch", trainer_id=1)["ok"]
+        th.join(timeout=10)
+        assert got and got[0]["ok"]
+        assert ps._live == {0, 1, 2}
+        assert ps._params_ready is False and not ps._fetch_barriers
+        cli.close()
+        cli2.close()
+    finally:
+        srv.shutdown()
+
+
+def test_register_of_live_id_resets_its_partial_round_state():
+    """A fast relaunch (died and came back before eviction noticed): the
+    fresh incarnation's register drops the ghost's partial stream and
+    fold fences so its restarted step tokens count from scratch."""
+    ps = ParameterServer([None], {"g0": 0}, num_trainers=2, sync_mode=True)
+    ps._apply_shard = lambda idx, feed: None
+    # ghost shipped bucket 0 of 2 at step 5, then died silently
+    ps._h_send_bucket({"g0": np.ones(2)}, trainer_id=1, seq_total=2,
+                      step=5, seq_idx=0)
+    ps._folded_send[1] = 4
+    assert ps._send_seen.get(1) == {0}
+    r = ps._h_register(trainer_id=1)
+    assert r["ok"]
+    assert 1 not in ps._send_seen and 1 not in ps._send_step
+    assert 1 not in ps._folded_send, "stale fold fence would drop the " \
+        "fresh process's restarted stream"
+    assert all(1 not in per for per in ps._pending.values())
+
+
+# ---------------------------------------------------------------------------
+# flags: liveness-pair validation (satellite)
+# ---------------------------------------------------------------------------
+
+def test_eviction_deadline_clamped_when_not_above_heartbeat(capsys):
+    from paddle_tpu import flags
+
+    orig_hb = flags.get_flag("heartbeat_interval")
+    orig_ev = flags.get_flag("eviction_deadline")
+    try:
+        flags.set_flags({"heartbeat_interval": 5.0,
+                         "eviction_deadline": 2.0})
+        assert flags.get_flag("eviction_deadline") == 15.0, \
+            "self-evicting pair must clamp to 3x the interval"
+        err = capsys.readouterr().err
+        assert "clamping eviction_deadline" in err
+        # a sane pair passes through untouched
+        flags.set_flags({"heartbeat_interval": 1.0,
+                         "eviction_deadline": 30.0})
+        assert flags.get_flag("eviction_deadline") == 30.0
+        # heartbeats disabled: no eviction, nothing to validate
+        flags.set_flags({"heartbeat_interval": 0.0,
+                         "eviction_deadline": 0.5})
+        assert flags.get_flag("eviction_deadline") == 0.5
+    finally:
+        flags.set_flags({"heartbeat_interval": orig_hb,
+                         "eviction_deadline": orig_ev})
+
+
+# ---------------------------------------------------------------------------
+# launch.py: supervisor + resource reaping (satellites)
+# ---------------------------------------------------------------------------
+
+def test_restart_policy_budget_and_backoff():
+    from paddle_tpu.distributed.launch import _RestartPolicy
+
+    pol = _RestartPolicy(max_restarts=2, window_s=60.0, backoff_s=0.5)
+    assert pol.next_delay() == 0.5
+    assert pol.next_delay() == 1.0  # exponential
+    assert pol.next_delay() is None, "budget must exhaust"
+
+
+def test_cluster_reaps_pipes_and_threads_on_kill():
+    """Satellite: kill() must leave no live pump threads and no open
+    child stdout pipes, so repeated chaos tests don't leak fds."""
+    from paddle_tpu.distributed.launch import _Cluster
+
+    cluster = _Cluster()
+    env = dict(os.environ)
+    for i in range(3):
+        cluster.spawn("sleeper.%d" % i,
+                      [sys.executable, "-c", "import time; time.sleep(60)"],
+                      env)
+    cluster.kill()
+    for _tag, p, t in cluster.procs:
+        assert p.poll() is not None
+        assert not t.is_alive(), "pump thread leaked past kill()"
+        assert p.stdout.closed, "child stdout pipe leaked past kill()"
+
+
+def test_cluster_wait_reaps_pipes_on_clean_exit():
+    from paddle_tpu.distributed.launch import _Cluster
+
+    cluster = _Cluster()
+    cluster.spawn("ok", [sys.executable, "-c", "print('fine')"],
+                  dict(os.environ))
+    assert cluster.wait() == 0
+    for _tag, p, t in cluster.procs:
+        t.join(timeout=5)
+        assert not t.is_alive()
+        assert p.stdout.closed
+
+
+def test_supervisor_respawns_until_budget_then_fails():
+    """A supervised child that keeps dying is restarted with backoff
+    until the budget runs out; the FINAL death is a real failure."""
+    from paddle_tpu.distributed.launch import _Cluster, _RestartPolicy
+
+    cluster = _Cluster()
+    env = dict(os.environ)
+    cmd = [sys.executable, "-c", "import sys; sys.exit(3)"]
+    cluster.supervise("flaky", cmd, env,
+                      _RestartPolicy(max_restarts=2, window_s=60.0,
+                                     backoff_s=0.05))
+    cluster.spawn("flaky", cmd, env)
+    rc = cluster.wait()
+    assert rc == 3, "budget-exhausted death must surface as failure"
+    assert cluster.restarts["flaky"] == 2
+    # 3 incarnations total: original + 2 respawns, all reaped
+    assert len([1 for t, _, _ in cluster.procs if t == "flaky"]) == 3
+
+
+def test_supervisor_respawn_recovers_crash_once_child(tmp_path):
+    """The self-healing happy path: a child that dies once (marker file
+    = the fence) is respawned and its second incarnation exits clean —
+    the cluster reports success and the dead Popen is excused."""
+    from paddle_tpu.distributed.launch import _Cluster, _RestartPolicy
+
+    marker = str(tmp_path / "crashed_once")
+    code = ("import os, sys\n"
+            "m = %r\n"
+            "if os.path.exists(m):\n"
+            "    sys.exit(0)\n"
+            "open(m, 'w').close()\n"
+            "sys.exit(7)\n" % marker)
+    cluster = _Cluster()
+    env = dict(os.environ)
+    cmd = [sys.executable, "-c", code]
+    cluster.supervise("once", cmd, env,
+                      _RestartPolicy(max_restarts=3, backoff_s=0.05))
+    cluster.spawn("once", cmd, env)
+    assert cluster.wait() == 0
+    assert cluster.restarts["once"] == 1
+    assert os.path.exists(marker)
+
+
+def test_supervisor_on_respawn_hook_can_cancel():
+    from paddle_tpu.distributed.launch import _Cluster, _RestartPolicy
+
+    cluster = _Cluster()
+    env = dict(os.environ)
+    cmd = [sys.executable, "-c", "import sys; sys.exit(9)"]
+    seen = []
+
+    def hook(tag):
+        seen.append(tag)
+        return False  # "the job already completed without it"
+
+    cluster.on_respawn = hook
+    cluster.supervise("late", cmd, env, _RestartPolicy(backoff_s=0.05))
+    cluster.spawn("late", cmd, env)
+    assert cluster.wait() == 0, "cancelled respawn must not fail the run"
+    assert seen == ["late"]
+    assert cluster.restarts.get("late") is None
 
 
 def test_pserver_kill_restart_resumes_from_manifest_checkpoint(tmp_path):
