@@ -736,7 +736,11 @@ def _dist_smokes():
                     except ValueError:
                         continue
                     for k, v in c.items():
-                        agg[k] = round(agg.get(k, 0) + v, 3)
+                        if isinstance(v, (int, float)):
+                            agg[k] = round(agg.get(k, 0) + v, 3)
+                        else:
+                            # tags (wire_dtype) ride along un-summed
+                            agg.setdefault(k, v)
                 if agg:
                     counters = agg
             except subprocess.TimeoutExpired:

@@ -1,0 +1,320 @@
+"""Jitted fused pserver optimize path (the TVM operator-fusion argument
+applied to the sync round's host dispatch).
+
+``ParameterServer._run_round`` used to run one op-by-op executor program
+per param BLOCK while holding the round lock: for an N-block shard that
+is N executor dispatches, N feed-signature checks and N tiny XLA calls
+per round — pure host overhead for what is an elementwise update rule.
+
+This module replaces that loop, when it can prove equivalence, with ONE
+compiled call per (optimizer rule, hyperparams, dtype) GROUP of blocks:
+every block in a group is padded to the group's longest block and
+stacked into a ``[n_blocks, max_len]`` batch, the learning rate is read
+from the pserver scope ONCE per round per lr variable (per-param lr
+``scale`` helpers fold into a float32 factor — the same IEEE multiply
+the scale op performs), and a single jitted kernel applies the rule
+across the whole stack.  The rules themselves mirror
+``ops/optimizer_ops.py`` exactly — elementwise math, so padding cannot
+change any real element and the default-path results stay bit-identical
+to the per-block executor programs.
+
+Shard programs the analyzer cannot prove equivalent (unknown optimizer
+types, scale ops feeding anything but the lr chain, mismatched in-place
+output wiring) simply stay on the per-block executor path — fusion is
+an optimization, never a semantics change.  ``FLAGS_ps_fused_apply=0``
+disables the whole path.
+"""
+
+import numpy as np
+
+# optimizer op types with a fused batched kernel below; everything else
+# falls back to the per-block executor program
+_SUPPORTED = ("sgd", "momentum", "adagrad", "adam")
+
+# hyperparams per rule, with the SAME defaults as ops/optimizer_ops.py —
+# the kernel must compute exactly what the shard program would
+_HYPER_DEFAULTS = {
+    "sgd": {},
+    "momentum": {"mu": 0.9, "use_nesterov": False},
+    "adagrad": {"epsilon": 1e-6},
+    "adam": {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8},
+}
+
+# per-rule slot slots: (vector slots sliced like the param, scalar slots
+# — per-block [1] accumulators)
+_VEC_SLOTS = {
+    "sgd": (),
+    "momentum": ("Velocity",),
+    "adagrad": ("Moment",),
+    "adam": ("Moment1", "Moment2"),
+}
+_SCALAR_SLOTS = {
+    "sgd": (),
+    "momentum": (),
+    "adagrad": (),
+    "adam": ("Beta1Pow", "Beta2Pow"),
+}
+# in-place contract the shard programs rely on: OutSlot -> InSlot
+_INPLACE = {
+    "ParamOut": "Param",
+    "VelocityOut": "Velocity",
+    "MomentOut": "Moment",
+    "Moment1Out": "Moment1",
+    "Moment2Out": "Moment2",
+    "Beta1PowOut": "Beta1Pow",
+    "Beta2PowOut": "Beta2Pow",
+}
+
+
+class ShardSpec:
+    """One fusable shard program, reduced to its data-plane facts."""
+
+    __slots__ = ("opt_type", "hyper", "param", "grad", "vec_slots",
+                 "scalar_slots", "lr_name", "lr_factor", "numel", "dtype",
+                 "key")
+
+    def __init__(self, opt_type, hyper, param, grad, vec_slots,
+                 scalar_slots, lr_name, lr_factor, numel, dtype):
+        self.opt_type = opt_type
+        self.hyper = hyper
+        self.param = param
+        self.grad = grad
+        self.vec_slots = vec_slots
+        self.scalar_slots = scalar_slots
+        self.lr_name = lr_name
+        self.lr_factor = lr_factor
+        self.numel = numel
+        self.dtype = dtype
+        # blocks sharing (rule, hyperparams, dtype) stack into one kernel
+        # call; lr differences ride in as data ([B] vector), not the key
+        self.key = (opt_type, tuple(sorted(hyper.items())), dtype)
+
+
+def analyze_shard(prog, grad_name):
+    """Reduce one shard Program to a ShardSpec, or None when the program
+    is anything but the provable pattern: optional ``scale`` ops forming
+    the LearningRate chain (per-param lr), plus exactly ONE supported
+    optimizer op whose outputs alias its inputs (the in-place update
+    contract the executor path honors)."""
+    try:
+        ops = list(prog.global_block().ops)
+    except Exception:
+        return None
+    scales = {}  # out name -> (in name, factor)
+    main = None
+    for op in ops:
+        if op.type == "scale":
+            outs = op.outputs.get("Out") or []
+            ins = op.inputs.get("X") or []
+            if len(outs) != 1 or len(ins) != 1:
+                return None
+            if float(op.attrs.get("bias", 0.0)) != 0.0:
+                # scale computes scale*x + bias; the factor fold below
+                # is multiply-only, so a biased scale is NOT provable
+                return None
+            scales[outs[0]] = (ins[0], float(op.attrs.get("scale", 1.0)))
+        elif op.type in _SUPPORTED and main is None:
+            main = op
+        else:
+            return None
+    if main is None:
+        return None
+    # outputs must write back onto their inputs (scope in-place update)
+    for oslot, islot in _INPLACE.items():
+        onames = main.outputs.get(oslot)
+        if not onames:
+            continue
+        inames = main.inputs.get(islot) or []
+        if inames != onames:
+            return None
+    # walk the lr chain through the scale helpers; every scale op must
+    # sit ON that chain (a scale mutating optimizer state is not ours)
+    lr = (main.inputs.get("LearningRate") or [None])[0]
+    if lr is None:
+        return None
+    factor = 1.0
+    chain_outs = set()
+    while lr in scales:
+        if lr in chain_outs:  # in-place / cyclic scale: not an lr helper
+            return None
+        chain_outs.add(lr)
+        src, f = scales[lr]
+        factor *= f
+        lr = src
+    if len(chain_outs) > 1:
+        # chained scales: folding f1*f2 host-side then ONE f32 multiply
+        # is not bit-identical to the executor's sequential f32
+        # multiplies — today's codegen emits at most one per param, so
+        # refuse rather than weaken the bit-identity contract
+        return None
+    if set(scales) - chain_outs:
+        return None
+    param = (main.inputs.get("Param") or [None])[0]
+    grad = (main.inputs.get("Grad") or [None])[0]
+    if param is None or grad != grad_name:
+        return None
+    vec_slots, scalar_slots = [], []
+    for slot in _VEC_SLOTS[main.type]:
+        names = main.inputs.get(slot) or []
+        if len(names) != 1:
+            return None
+        vec_slots.append(names[0])
+    for slot in _SCALAR_SLOTS[main.type]:
+        names = main.inputs.get(slot) or []
+        if len(names) != 1:
+            return None
+        scalar_slots.append(names[0])
+    pv = prog.global_block()._find_var_recursive(param)
+    if pv is None:
+        return None
+    numel = 1
+    for d in pv.shape:
+        numel *= int(d)
+    hyper = {k: (bool(main.attrs.get(k, d)) if isinstance(d, bool)
+                 else float(main.attrs.get(k, d)))
+             for k, d in _HYPER_DEFAULTS[main.type].items()}
+    return ShardSpec(main.type, hyper, param, grad, tuple(vec_slots),
+                     tuple(scalar_slots), lr, float(factor), numel,
+                     str(pv.dtype))
+
+
+# ---- batched kernels ------------------------------------------------------
+# one jitted callable per (rule, hyperparams); jax re-specializes per
+# stack shape automatically.  All inputs [B, L] except lr (and the adam
+# pows) which are [B].  The math tracks ops/optimizer_ops.py line for
+# line so fused and per-block results agree bitwise.
+_kernels = {}
+
+
+def _get_kernel(opt_type, hyper_items):
+    key = (opt_type, hyper_items)
+    fn = _kernels.get(key)
+    if fn is not None:
+        return fn
+    import jax
+    import jax.numpy as jnp
+
+    hyper = dict(hyper_items)
+    if opt_type == "sgd":
+        def k(p, g, lr):
+            return (p - lr[:, None] * g,)
+    elif opt_type == "momentum":
+        mu, nesterov = hyper["mu"], hyper["use_nesterov"]
+
+        def k(p, g, v, lr):
+            v_out = mu * v + g
+            if nesterov:
+                p_out = p - (g + mu * v_out) * lr[:, None]
+            else:
+                p_out = p - lr[:, None] * v_out
+            return (p_out, v_out)
+    elif opt_type == "adagrad":
+        eps = hyper["epsilon"]
+
+        def k(p, g, m, lr):
+            m_out = m + jnp.square(g)
+            return (p - lr[:, None] * g / (jnp.sqrt(m_out) + eps), m_out)
+    elif opt_type == "adam":
+        b1, b2, eps = hyper["beta1"], hyper["beta2"], hyper["epsilon"]
+
+        def k(p, g, m1, m2, b1p, b2p, lr):
+            lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+            m1_out = b1 * m1 + (1 - b1) * g
+            m2_out = b2 * m2 + (1 - b2) * jnp.square(g)
+            p_out = p - lr_t[:, None] * m1_out / (jnp.sqrt(m2_out) + eps)
+            return (p_out, m1_out, m2_out, b1p * b1, b2p * b2)
+    else:  # pragma: no cover - guarded by _SUPPORTED
+        raise ValueError(opt_type)
+    fn = _kernels[key] = jax.jit(k)
+    return fn
+
+
+class FusedApply:
+    """Per-server fused plan: built once from the shard programs, applied
+    every sync round.  ``apply`` consumes the round's per-grad totals and
+    returns whatever it could NOT fuse (the caller runs those through the
+    per-block executor path)."""
+
+    def __init__(self, shard_programs, grad_to_shard, scope):
+        self.scope = scope
+        self.specs = {}  # grad block name -> ShardSpec
+        self.n_fallback = 0
+        for gname, idx in grad_to_shard.items():
+            spec = None
+            if 0 <= idx < len(shard_programs):
+                prog = shard_programs[idx]
+                if prog is not None:
+                    spec = analyze_shard(prog, gname)
+            if spec is not None:
+                self.specs[gname] = spec
+            else:
+                self.n_fallback += 1
+
+    def _lr_value(self, spec, lr_cache):
+        """Scheduled/constant lr read ONCE per round per lr var; the
+        per-param factor multiplies in the param dtype (the exact IEEE
+        multiply the dropped ``scale`` op performed)."""
+        val = lr_cache.get(spec.lr_name)
+        if val is None:
+            var = self.scope.find_var(spec.lr_name)
+            if var is None:
+                raise KeyError(
+                    "pserver scope has no lr var %s" % spec.lr_name)
+            val = lr_cache[spec.lr_name] = np.asarray(var).reshape(-1)[0]
+        dt = np.dtype(spec.dtype)
+        lr = dt.type(val)
+        if spec.lr_factor != 1.0:
+            lr = lr * dt.type(spec.lr_factor)
+        return lr
+
+    def apply(self, totals):
+        """Run the fused update for every fusable grad in `totals`
+        (dict grad block name -> summed grad); returns the unfusable
+        remainder.  Must be called with the server lock held (it mutates
+        the scope), exactly like the per-block path it replaces."""
+        rest = {}
+        groups = {}
+        for gname in sorted(totals):
+            spec = self.specs.get(gname)
+            if spec is None:
+                rest[gname] = totals[gname]
+            else:
+                groups.setdefault(spec.key, []).append(
+                    (spec, totals[gname]))
+        lr_cache = {}
+        for key in sorted(groups, key=repr):
+            self._apply_group(key, groups[key], lr_cache)
+        return rest
+
+    def _apply_group(self, key, items, lr_cache):
+        opt_type, hyper_items, dtype = key
+        dt = np.dtype(dtype)
+        n_vec = len(items[0][0].vec_slots)
+        n_scalar = len(items[0][0].scalar_slots)
+        B = len(items)
+        L = max(spec.numel for spec, _ in items)
+        stacks = [np.zeros((B, L), dt) for _ in range(2 + n_vec)]
+        scalars = [np.zeros((B,), dt) for _ in range(n_scalar)]
+        lr = np.zeros((B,), dt)
+        for i, (spec, g) in enumerate(items):
+            n = spec.numel
+            stacks[0][i, :n] = np.asarray(
+                self.scope.get(spec.param), dtype=dt).reshape(-1)
+            stacks[1][i, :n] = np.asarray(g, dtype=dt).reshape(-1)
+            for j, slot in enumerate(spec.vec_slots):
+                stacks[2 + j][i, :n] = np.asarray(
+                    self.scope.get(slot), dtype=dt).reshape(-1)
+            for j, slot in enumerate(spec.scalar_slots):
+                scalars[j][i] = np.asarray(
+                    self.scope.get(slot)).reshape(-1)[0]
+            lr[i] = self._lr_value(spec, lr_cache)
+        kernel = _get_kernel(opt_type, hyper_items)
+        outs = [np.asarray(o) for o in kernel(*stacks, *scalars, lr)]
+        for i, (spec, _g) in enumerate(items):
+            n = spec.numel
+            self.scope.set(spec.param, outs[0][i, :n].copy())
+            for j, slot in enumerate(spec.vec_slots):
+                self.scope.set(slot, outs[1 + j][i, :n].copy())
+            for j, slot in enumerate(spec.scalar_slots):
+                self.scope.set(
+                    slot, outs[1 + n_vec + j][i:i + 1].copy())

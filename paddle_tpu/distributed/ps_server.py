@@ -10,9 +10,13 @@ regular Executor (compile-first, like everything else) — the pserver's
 "optimize sub-blocks" of the reference become cached XLA CPU executables.
 
 Sync mode round protocol (reference barrier semantics):
-  1. every live trainer sends its grad blocks, then barrier("send")
-  2. when all send-barriers arrive: grads are summed per block, the lr
-     program (decay schedule) runs once, then every shard program runs
+  1. every live trainer sends its grad blocks, then barrier("send");
+     each arrival folds into a running per-grad partial sum immediately
+     (overlapped with the wire — the round holds no summation loop)
+  2. when all send-barriers arrive: the lr program (decay schedule) runs
+     once, then the folded sums apply through ONE jitted fused call per
+     optimizer group (fused_apply.py; unfusable shards keep their
+     per-block executor programs)
   3. trainers issue get() for updated param blocks, then barrier("fetch")
   4. round resets
 Async mode: each send applies its shard program immediately, gets are
@@ -111,6 +115,19 @@ class ParameterServer:
         # step counter k times per step
         self._lr_trigger = min(grad_to_shard) if grad_to_shard else None
         self._pending = {}  # grad block name -> {trainer_id: np.ndarray}
+        # incremental fold: each trainer's dense contribution is added
+        # into a running per-grad partial sum AT ARRIVAL (overlapped with
+        # the wire) so _run_round no longer sums per-trainer temps while
+        # holding the round lock.  _pending stays the authoritative
+        # per-trainer record — overwrites (fenced replays) and evictions
+        # rebuild the affected partials from it, in arrival order, so
+        # the fold is bit-identical to the old round-time sum.
+        self._partial = {}  # grad block name -> running sum ndarray
+        # jitted fused optimize path (fused_apply.py), built lazily at
+        # the first round so in-process tests with stub shard programs
+        # never pay (or crash on) the analysis
+        self._fused = None
+        self._fused_ready = False
         self._send_barriers = set()
         self._fetch_barriers = set()
         # folded-barrier bookkeeping (bucketed wire path): how many of a
@@ -520,12 +537,18 @@ class ParameterServer:
         and in-progress bucket-stream counts.  Shared by eviction (the
         ghost's state must not leak) and re-registration (a fresh trainer
         incarnation restarts its stream from scratch)."""
-        for per_trainer in self._pending.values():
-            per_trainer.pop(tid, None)
+        for gname, per_trainer in self._pending.items():
+            if per_trainer.pop(tid, None) is not None:
+                # the ghost's grads were already folded into the running
+                # partial: rebuild that grad's sum from the survivors
+                # (in arrival order — same float result as a fresh fold)
+                self._refold_partial_locked(gname)
         # prune grads left with NO contributors: an empty inner dict
         # would keep _mid_round_locked() True forever, so the round
         # boundary (and with it every parked rejoin) would never arrive
         self._pending = {g: per for g, per in self._pending.items() if per}
+        self._partial = {g: t for g, t in self._partial.items()
+                         if g in self._pending}
         self._pending_sparse = {
             k: v for k, v in self._pending_sparse.items() if k[0] != tid
         }
@@ -537,6 +560,34 @@ class ParameterServer:
         self._send_seen.pop(tid, None)
         self._fetch_step.pop(tid, None)
         self._fetch_seen.pop(tid, None)
+
+    def _refold_partial_locked(self, gname):
+        """Recompute one grad's running partial from its per-trainer
+        record (rare paths only: eviction, a fenced replay overwriting a
+        slot).  Insertion order == arrival order, so the rebuilt sum is
+        float-identical to an uninterrupted incremental fold."""
+        total = None
+        for v in self._pending.get(gname, {}).values():
+            total = v if total is None else total + v
+        if total is None:
+            self._partial.pop(gname, None)
+        else:
+            self._partial[gname] = total
+
+    def _fold_pending_locked(self, gname, tid, value):
+        """Record one trainer's dense contribution AND fold it into the
+        running partial sum at arrival time — the round-time per-trainer
+        summation loop becomes a dict pop in _run_round."""
+        per = self._pending.setdefault(gname, {})
+        if tid in per:
+            # fenced replay re-delivering a slot it already filled:
+            # overwrite (never accumulate) and rebuild this partial
+            per[tid] = value
+            self._refold_partial_locked(gname)
+            return
+        per[tid] = value
+        cur = self._partial.get(gname)
+        self._partial[gname] = value if cur is None else cur + value
 
     def _reset_stream_locked(self, tid):
         """Full per-trainer stream reset: round state PLUS the fold
@@ -719,17 +770,55 @@ class ParameterServer:
         prog = self.shard_programs[shard_idx]
         self.exe.run(prog, feed=feed, fetch_list=[], scope=self.scope)
 
+    def _ensure_fused_locked(self):
+        """Build the fused-apply plan on the first round (lazy: stub
+        shard programs in unit tests must not crash the constructor).
+        Any analysis surprise degrades to the per-block path, loudly."""
+        if self._fused_ready:
+            return self._fused
+        self._fused_ready = True
+        from ..flags import get_flag
+
+        if not get_flag("ps_fused_apply"):
+            return None
+        try:
+            from .fused_apply import FusedApply
+
+            fused = FusedApply(self.shard_programs, self.grad_to_shard,
+                               self.scope)
+            if fused.specs:
+                self._fused = fused
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
+        return self._fused
+
     def _run_round(self):
-        """All send-barriers in: sum grads, run lr + all shard programs
-        + the queued sparse updates (after lr, so a scheduled lr is this
-        round's decayed value — the order the local program runs in)."""
+        """All send-barriers in: run lr, apply the (arrival-time-folded)
+        grad sums — one jitted fused call per optimizer group, per-block
+        executor programs for anything unfusable — then the queued
+        sparse updates (after lr, so a scheduled lr is this round's
+        decayed value — the order the local program runs in)."""
+        from ..profiler import RecordEvent
+
         if self.lr_program is not None:
             self.exe.run(self.lr_program, feed={}, fetch_list=[], scope=self.scope)
+        totals = {}
         for gname, per_trainer in sorted(self._pending.items()):
-            total = None
-            for v in per_trainer.values():
-                total = v if total is None else total + v
-            self._apply_shard(self.grad_to_shard[gname], {gname: total})
+            total = self._partial.get(gname)
+            if total is None:  # defensive: fold record missing
+                for v in per_trainer.values():
+                    total = v if total is None else total + v
+            totals[gname] = total
+        fused = self._ensure_fused_locked()
+        with RecordEvent("ps_apply_round", cat="apply"):
+            if fused is not None:
+                totals = fused.apply(totals)
+            for gname in sorted(totals):
+                self._apply_shard(self.grad_to_shard[gname],
+                                  {gname: totals[gname]})
+        self._partial.clear()
         by_table = {}
         for (tid, t) in sorted(self._pending_sparse):
             by_table.setdefault(t, []).append(self._pending_sparse[(tid, t)])
@@ -817,7 +906,7 @@ class ParameterServer:
             if int(trainer_id) in self._evicted:
                 # a ghost's late grads must not leak into a future round
                 return {"ok": False, "evicted": True}
-            self._pending.setdefault(name, {})[trainer_id] = value
+            self._fold_pending_locked(name, int(trainer_id), value)
         return {"ok": True}
 
     def _h_send_bucket(self, blocks, trainer_id=0, seq_total=None,
@@ -908,8 +997,7 @@ class ParameterServer:
                     self._send_step[tid] = step
                     self._send_seen[tid] = set()
             for name, value in blocks.items():
-                self._pending.setdefault(name, {})[trainer_id] = \
-                    np.asarray(value)
+                self._fold_pending_locked(name, tid, np.asarray(value))
             if not seq_total:
                 return {"ok": True}
             if step is not None:
@@ -962,7 +1050,7 @@ class ParameterServer:
         return {"ok": True}
 
     def _h_get_bucket(self, names, trainer_id=0, fetch_total=None,
-                      step=None, seq_idx=None):
+                      step=None, seq_idx=None, wire_dtype=None):
         """Coalesced param fetch: one frame returns every requested block
         — and in sync mode ONE params-ready wait covers the whole bucket
         instead of one blocking round trip per variable.  `fetch_total`
@@ -972,7 +1060,11 @@ class ParameterServer:
         trainer got theirs.  `step`/`seq_idx` mirror _h_send_bucket's
         fencing: a replayed fetch stream counts by set (never double-
         folds), and a fetch step this server already folded is served
-        (reads are harmless) without counting."""
+        (reads are harmless) without counting.  `wire_dtype` (the
+        REQUESTER's declaration, stamped into its bucket plan by the
+        transpiler) compresses float blocks in the reply —
+        'bfloat16' halves every param frame; the client decodes back
+        to the original dtype (rpc.Bf16Wire)."""
         if self.sync_mode:
             with self._cv:
                 self._touch(trainer_id)
@@ -1001,6 +1093,14 @@ class ParameterServer:
             if var is None:
                 raise KeyError("pserver has no var %s" % name)
             out[name] = np.asarray(var)
+        if wire_dtype not in (None, "float32", "bfloat16"):
+            raise ValueError(
+                "get_bucket: unknown wire_dtype %r" % (wire_dtype,))
+        if wire_dtype == "bfloat16":
+            from .rpc import Bf16Wire
+
+            out = {n: (Bf16Wire(v) if v.dtype.kind == "f" else v)
+                   for n, v in out.items()}
         if self.sync_mode and fetch_total:
             with self._cv:
                 tid = int(trainer_id)

@@ -18,6 +18,22 @@ error, not code execution.  Unknown tags, unknown versions, oversized
 frames, and (when a shared secret is configured via
 ``PADDLE_TPU_RPC_HMAC_KEY``) bad MACs are all rejected.
 
+Wire compression (FLAGS_comm_wire_dtype / FLAGS_comm_grad_int8): float
+arrays a caller explicitly wraps in ``Bf16Wire`` / ``Int8Wire`` ship
+under two additional array tags — bf16-cast payload, and int8 payload
+with a per-array dequantization scale.  Both tags carry the ORIGINAL
+dtype and decode straight back to it, so services never see a wire
+dtype; both keep the closed-type-system contract (a garbage header is a
+parse error).  The default float32 path never emits the new tags, so
+its frames stay byte-identical to the pre-compression protocol and an
+old-tag peer still parses them.
+
+Zero-copy framing: the encoder can emit a SCATTER-GATHER segment list —
+large array payloads ride as raw memoryviews handed to
+``socket.sendmsg`` instead of being copied into an intermediate bytes —
+and the receive path fills one preallocated buffer via ``recv_into``.
+The byte stream is identical to the copying encoder's.
+
 Verbs mirror the reference's SendRecvService (send_recv.proto.in:20-30):
 SendVariable / GetVariable / PrefetchVariable / Barrier / Complete.
 """
@@ -43,9 +59,75 @@ MAX_FRAME = 1 << 33  # 8 GiB: far above any param block; rejects length bombs
 _T_NONE, _T_TRUE, _T_FALSE, _T_INT, _T_FLOAT = b"N", b"T", b"F", b"I", b"D"
 _T_STR, _T_BYTES, _T_ARRAY, _T_LIST, _T_TUPLE, _T_DICT = (
     b"S", b"B", b"A", b"L", b"U", b"M")
+# compressed-array tags (wire compression): payload is bf16-cast /
+# int8-quantized, header carries the ORIGINAL float dtype it decodes
+# back to.  Never emitted unless a caller wraps the value explicitly.
+_T_ARRAY_BF16, _T_ARRAY_I8 = b"h", b"q"
 
 # dtype kinds a peer may ship: bool, (u)int, float, complex — never object
 _DTYPE_KINDS = frozenset("biufc")
+
+# payloads at least this large ride as their own sendmsg segment
+# (zero-copy); smaller ones inline into the header bytearray where the
+# iovec bookkeeping would cost more than the copy
+_SG_MIN_BYTES = 2048
+# sendmsg iovec batch (safely under every platform's IOV_MAX)
+_IOV_BATCH = 64
+
+
+_BF16_UNSET = object()
+_BF16_CACHE = _BF16_UNSET  # resolves to np.dtype or None once
+
+
+def _bf16():
+    """The ml_dtypes bfloat16 dtype (ships with jax), resolved ONCE —
+    this sits on the per-array encode/decode hot path.  None when absent:
+    bf16 wire frames then fail loudly instead of mis-decoding."""
+    global _BF16_CACHE
+    if _BF16_CACHE is _BF16_UNSET:
+        try:
+            import ml_dtypes
+
+            _BF16_CACHE = np.dtype(ml_dtypes.bfloat16)
+        except ImportError:  # pragma: no cover - ml_dtypes rides with jax
+            _BF16_CACHE = None
+    return _BF16_CACHE
+
+
+class Bf16Wire:
+    """Explicit marker: ship this float array bf16-cast on the wire
+    (decodes back to its original dtype on the other side).  Compression
+    is always caller-opt-in — the encoder never downcasts silently."""
+
+    __slots__ = ("arr",)
+
+    def __init__(self, arr):
+        arr = np.ascontiguousarray(np.asarray(arr))
+        if arr.dtype.kind != "f":
+            raise TypeError(
+                "Bf16Wire wraps float arrays, got %s" % arr.dtype)
+        self.arr = arr
+
+
+class Int8Wire:
+    """Explicit marker: ship this pre-quantized int8 array with its
+    dequantization scale; decodes to ``scale * q`` in ``orig_dtype``.
+    Quantization (and the error-feedback residual) happens at the
+    CALLER so the residual can stay trainer-side (ops/dist_ops.py)."""
+
+    __slots__ = ("q", "scale", "orig_dtype")
+
+    def __init__(self, q, scale, orig_dtype="<f4"):
+        q = np.ascontiguousarray(np.asarray(q))
+        if q.dtype != np.int8:
+            raise TypeError("Int8Wire wraps int8 arrays, got %s" % q.dtype)
+        od = np.dtype(orig_dtype)
+        if od.kind != "f":
+            raise TypeError(
+                "Int8Wire original dtype must be float, got %s" % od)
+        self.q = q
+        self.scale = float(scale)
+        self.orig_dtype = od
 
 
 def _hmac_key():
@@ -64,7 +146,8 @@ def _hmac_key():
 # observed, fenced round replays performed, and total time-to-recover.
 _comm_lock = threading.Lock()
 _comm_stats = {"rpc_round_trips": 0, "comm_bytes_sent": 0,
-               "comm_bytes_recv": 0, "pserver_restarts_seen": 0,
+               "comm_bytes_recv": 0, "comm_bytes_saved": 0,
+               "pserver_restarts_seen": 0,
                "recoveries": 0, "recovery_ms": 0.0}
 
 
@@ -85,19 +168,58 @@ def note_recovery(ms):
             _comm_stats["recovery_ms"] + ms, 3)
 
 
+def note_bytes_saved(n):
+    """Wire-compression evidence: bytes a compressed frame did NOT ship
+    vs full precision.  Counted at the sites that CHOOSE compression
+    (trainer-side dist ops), never in the shared codec — the codec runs
+    on both ends and counting there would double every in-process test."""
+    with _comm_lock:
+        _comm_stats["comm_bytes_saved"] += int(n)
+
+
+# the wire dtype this process's bucket ops actually USE — recorded by
+# the dist-op lowerings from the transpile plan (which may override the
+# flag via DistributeTranspilerConfig), so the COUNTERS tag describes
+# the wire the byte counts were measured on, not whatever the global
+# flag happens to say
+_wire_dtype_used = None
+
+
+def note_wire_dtype(wd):
+    global _wire_dtype_used
+    with _comm_lock:
+        _wire_dtype_used = str(wd)
+
+
 def get_comm_stats():
     """Snapshot of this process's client-side RPC counters (heartbeat
     traffic excluded — it is wall-clock-paced, and these counters exist
-    to be a deterministic property of the op plan)."""
+    to be a deterministic property of the op plan).  The snapshot also
+    carries a ``wire_dtype`` TAG (a string, not a counter): the wire
+    the bucket ops were PLANNED with when a dist program has run
+    (note_wire_dtype), else the FLAGS_comm_wire_dtype value."""
     with _comm_lock:
-        return dict(_comm_stats)
+        out = dict(_comm_stats)
+        wd = _wire_dtype_used
+    if wd is None:
+        try:
+            from ..flags import get_flag
+
+            wd = str(get_flag("comm_wire_dtype"))
+        except Exception:
+            wd = None
+    if wd is not None:
+        out["wire_dtype"] = wd
+    return out
 
 
 def reset_comm_stats():
+    global _wire_dtype_used
     with _comm_lock:
         for k in _comm_stats:
             _comm_stats[k] = 0 if not isinstance(_comm_stats[k], float) \
                 else 0.0
+        _wire_dtype_used = None
 
 
 # ---- pserver incarnation registry ---------------------------------------
@@ -140,6 +262,63 @@ def reset_incarnations():
         _incarnations.clear()
 
 
+class _SegWriter:
+    """Scatter-gather sink for ``_encode``: header bytes accumulate in a
+    bytearray, large array payloads land as their own memoryview segment
+    (no intermediate ``bytes`` copy).  ``segments()`` returns the frame
+    body as an ordered buffer list for ``socket.sendmsg``; joining the
+    segments reproduces the bytearray encoder's output byte for byte."""
+
+    __slots__ = ("_segs", "_cur")
+
+    def __init__(self):
+        self._segs = []
+        self._cur = bytearray()
+
+    def __iadd__(self, b):
+        self._cur += b
+        return self
+
+    def add_payload(self, arr):
+        """Append a contiguous ndarray's raw bytes: zero-copy memoryview
+        segment when large enough, inline copy otherwise."""
+        if arr.nbytes >= _SG_MIN_BYTES:
+            if len(self._cur):
+                self._segs.append(self._cur)
+                self._cur = bytearray()
+            # custom dtypes (bf16) refuse the buffer protocol: view the
+            # raw bytes through a same-width integer lane first
+            if arr.dtype.kind not in _DTYPE_KINDS:
+                arr = arr.view(np.uint8 if arr.dtype.itemsize == 1
+                               else np.dtype("<u%d" % arr.dtype.itemsize))
+            self._segs.append(memoryview(arr).cast("B"))
+        else:
+            self._cur += arr.tobytes()
+
+    def segments(self):
+        if len(self._cur):
+            self._segs.append(self._cur)
+            self._cur = bytearray()
+        return self._segs
+
+
+def _emit_payload(out, arr):
+    """Raw array bytes into either sink (bytearray or _SegWriter)."""
+    if isinstance(out, _SegWriter):
+        out.add_payload(arr)
+    else:
+        out += arr.tobytes()
+
+
+def _encode_array_header(out, tag, dtype_str, arr, nbytes):
+    ds = dtype_str.encode("ascii")
+    out += tag + _U32.pack(len(ds)) + ds + bytes([arr.ndim])
+    for d in arr.shape:
+        out += _I64.pack(d)
+    out += _LEN.pack(nbytes)  # u64: param blocks can exceed 4 GiB
+    return out
+
+
 def _encode(obj, out):
     if obj is None:
         out += _T_NONE
@@ -171,18 +350,27 @@ def _encode(obj, out):
                 raise TypeError("rpc dict keys must be str, got %r" % (k,))
             _encode(k, out)
             _encode(v, out)
+    elif isinstance(obj, Bf16Wire):
+        bf = _bf16()
+        if bf is None:
+            raise TypeError("bf16 wire compression needs ml_dtypes")
+        wire = np.ascontiguousarray(obj.arr.astype(bf))
+        _encode_array_header(out, _T_ARRAY_BF16, obj.arr.dtype.str,
+                             wire, wire.nbytes)
+        _emit_payload(out, wire)
+    elif isinstance(obj, Int8Wire):
+        _encode_array_header(out, _T_ARRAY_I8, obj.orig_dtype.str,
+                             obj.q, obj.q.nbytes)
+        out += _F64.pack(obj.scale)
+        _emit_payload(out, obj.q)
     else:
         # arrays last: jax/np duck-typed values normalize through asarray
         arr = np.ascontiguousarray(np.asarray(obj))
         if arr.dtype.kind not in _DTYPE_KINDS:
             raise TypeError(
                 "rpc cannot ship dtype %s (kind %r)" % (arr.dtype, arr.dtype.kind))
-        ds = arr.dtype.str.encode("ascii")
-        out += _T_ARRAY + _U32.pack(len(ds)) + ds + bytes([arr.ndim])
-        for d in arr.shape:
-            out += _I64.pack(d)
-        out += _LEN.pack(arr.nbytes)  # u64: param blocks can exceed 4 GiB
-        out += arr.tobytes()
+        _encode_array_header(out, _T_ARRAY, arr.dtype.str, arr, arr.nbytes)
+        _emit_payload(out, arr)
     return out
 
 
@@ -232,39 +420,127 @@ class _Reader:
                 out[k] = self.decode()
             return out
         if tag == _T_ARRAY:
-            (dn,) = _U32.unpack(self.take(4))
-            dtype = np.dtype(bytes(self.take(dn)).decode("ascii"))
-            if dtype.kind not in _DTYPE_KINDS:
-                raise ValueError("rpc refuses dtype %s" % dtype)
-            ndim = bytes(self.take(1))[0]
-            shape = tuple(_I64.unpack(self.take(8))[0] for _ in range(ndim))
-            (nbytes,) = _LEN.unpack(self.take(8))
+            dtype, shape, nbytes = self._array_header(_DTYPE_KINDS)
             expect = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
             if nbytes != expect:
                 raise ValueError("rpc array payload size mismatch")
             data = self.take(nbytes)
             return np.frombuffer(data, dtype=dtype).reshape(shape).copy()
+        if tag == _T_ARRAY_BF16:
+            # bf16-cast payload decoding back to the declared float dtype
+            bf = _bf16()
+            if bf is None:
+                raise ValueError("rpc bf16 frame but ml_dtypes unavailable")
+            dtype, shape, nbytes = self._array_header("f")
+            expect = int(np.prod(shape, dtype=np.int64)) * 2
+            if nbytes != expect:
+                raise ValueError("rpc array payload size mismatch")
+            data = self.take(nbytes)
+            return np.frombuffer(data, dtype=bf).astype(dtype).reshape(shape)
+        if tag == _T_ARRAY_I8:
+            # int8 payload + per-array scale: decodes to scale * q
+            dtype, shape, nbytes = self._array_header("f")
+            expect = int(np.prod(shape, dtype=np.int64))
+            if nbytes != expect:
+                raise ValueError("rpc array payload size mismatch")
+            (scale,) = _F64.unpack(self.take(8))
+            data = self.take(nbytes)
+            q = np.frombuffer(data, dtype=np.int8)
+            return (q.astype(dtype) * dtype.type(scale)).reshape(shape)
         raise ValueError("rpc unknown type tag %r" % tag)
+
+    def _array_header(self, kinds):
+        """Shared array-tag header: dtype string (restricted to `kinds`),
+        ndim, shape, payload byte count.  A garbage dtype string is a
+        parse error, never an exception escape."""
+        (dn,) = _U32.unpack(self.take(4))
+        try:
+            dtype = np.dtype(bytes(self.take(dn)).decode("ascii"))
+        except TypeError:
+            raise ValueError("rpc unparseable array dtype")
+        if dtype.kind not in kinds:
+            raise ValueError("rpc refuses dtype %s" % dtype)
+        ndim = bytes(self.take(1))[0]
+        shape = tuple(_I64.unpack(self.take(8))[0] for _ in range(ndim))
+        (nbytes,) = _LEN.unpack(self.take(8))
+        return dtype, shape, nbytes
+
+
+_HAS_SENDMSG = hasattr(socket.socket, "sendmsg")
+
+
+def _sendall_segments(sock, segments):
+    """sendall for a scatter-gather buffer list: hands iovec batches to
+    ``socket.sendmsg`` (no joining copy), resuming mid-segment on short
+    writes; platforms without sendmsg fall back to per-segment sendall."""
+    views = []
+    for s in segments:
+        mv = s if isinstance(s, memoryview) else memoryview(s)
+        if mv.nbytes:
+            views.append(mv)
+    if not _HAS_SENDMSG:  # pragma: no cover - POSIX always has sendmsg
+        for v in views:
+            sock.sendall(v)
+        return
+    i = 0
+    while i < len(views):
+        sent = sock.sendmsg(views[i:i + _IOV_BATCH])
+        while i < len(views) and sent >= views[i].nbytes:
+            sent -= views[i].nbytes
+            i += 1
+        if i < len(views) and sent:
+            views[i] = views[i][sent:]
 
 
 def _send_msg(sock, obj):
-    payload = bytes(_encode(obj, bytearray()))
+    from .. import profiler as _prof
+
+    if _prof._enabled:
+        with _prof.RecordEvent("rpc_serialize", cat="serialize"):
+            segs = _encode(obj, _SegWriter()).segments()
+    else:
+        segs = _encode(obj, _SegWriter()).segments()
+    total = sum(len(s) for s in segs)
     key = _hmac_key()
-    mac = hmac_mod.new(key, payload, hashlib.sha256).digest() if key else b""
-    head = bytes([PROTO_VERSION]) + mac
-    frame = _LEN.pack(len(head) + len(payload)) + head + payload
-    sock.sendall(frame)
-    return len(frame)
+    if key:
+        h = hmac_mod.new(key, digestmod=hashlib.sha256)
+        for s in segs:
+            h.update(s)
+        mac = h.digest()
+    else:
+        mac = b""
+    head = _LEN.pack(1 + len(mac) + total) + bytes([PROTO_VERSION]) + mac
+    _sendall_segments(sock, [head] + segs)
+    return len(head) + total
+
+
+# upfront recv buffer cap: the frame length is PEER-CONTROLLED, and
+# zero-filling an 8 GiB claim (MAX_FRAME) before a single payload byte
+# arrives would be a memory bomb — beyond this, the buffer doubles only
+# as data actually lands, so memory stays proportional to received bytes
+_RECV_PREALLOC = 16 << 20
 
 
 def _recv_exact(sock, n):
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
+    """Read exactly n bytes via recv_into on ONE preallocated buffer (no
+    chunk-list join).  The preallocation is capped: a length header
+    claiming gigabytes commits nothing until the peer actually delivers
+    (the buffer grows by doubling, bounded by bytes received)."""
+    buf = bytearray(min(n, _RECV_PREALLOC))
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        if got == len(buf):
+            view.release()  # a bytearray with an exported view can't grow
+            new = bytearray(min(n, len(buf) * 2))
+            new[:got] = buf
+            buf = new
+            view = memoryview(buf)
+        r = sock.recv_into(view[got:])
+        if not r:
             raise ConnectionError("peer closed connection")
-        buf.extend(chunk)
-    return bytes(buf)
+        got += r
+    return buf
 
 
 def _recv_msg(sock):
@@ -275,7 +551,7 @@ def _recv_msg_sized(sock):
     (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
     if n < 1 or n > MAX_FRAME:
         raise ValueError("rpc frame length %d out of bounds" % n)
-    frame = _recv_exact(sock, n)
+    frame = memoryview(_recv_exact(sock, n))
     version = frame[0]
     if version != PROTO_VERSION:
         raise ValueError(

@@ -149,6 +149,32 @@ DEFINE_flag("comm_bucket_bytes", 4 * 1024 * 1024,
             "and each bucket ships as ONE rpc frame per pserver instead "
             "of one round trip per variable (0 restores the legacy "
             "per-variable send/recv ops)")
+DEFINE_flag("comm_wire_dtype", "float32",
+            "wire dtype for dense bucket grads and fetched params on the "
+            "pserver path: 'float32' (default — byte-identical legacy "
+            "frames, bit-exact dist-vs-local parity) or 'bfloat16' (halves "
+            "comm bytes; the trainer casts grads at the RPC boundary and "
+            "the pserver casts fetched params in its replies, both "
+            "decompressed back to the original dtype at decode).  The "
+            "transpiler stamps the value into the bucket plan so both "
+            "ends agree; the legacy per-variable ops "
+            "(FLAGS_comm_bucket_bytes=0) always ship full precision")
+DEFINE_flag("comm_grad_int8", False,
+            "int8 + error-feedback wire compression for dense bucket "
+            "grads (quarter-size frames): each block ships as int8 with a "
+            "per-block scale, the quantization residual is kept "
+            "TRAINER-side and added into the same block's grad next "
+            "round, so the quantization error is corrected over time "
+            "instead of accumulating (an approximation — see "
+            "docs/PERFORMANCE.md).  Applies to grads only; fetched "
+            "params follow FLAGS_comm_wire_dtype")
+DEFINE_flag("ps_fused_apply", True,
+            "pserver sync rounds apply the optimizer with ONE jitted "
+            "fused call per (optimizer, dtype) group of shard blocks "
+            "(blocks padded + stacked, lr read once per round) instead "
+            "of one executor program run per block; shard programs the "
+            "fuser cannot prove equivalent fall back to the per-block "
+            "path automatically (0 disables the fused path entirely)")
 DEFINE_flag("comm_inflight", 4,
             "window of in-flight bucket RPCs per pserver endpoint: bucket "
             "N+1 serializes and sends while bucket N is on the wire; "

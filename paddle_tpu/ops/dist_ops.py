@@ -10,6 +10,8 @@ surrounding compute, giving exactly the reference's op-order semantics
 fetch_barrier) without leaving the compiled step.
 """
 
+import contextlib
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -17,6 +19,8 @@ from jax.experimental import io_callback
 
 from .common import jdt
 from ..core.registry import register
+
+_null_ctx = contextlib.nullcontext
 
 
 def _client(ep, trainer_id=None):
@@ -86,6 +90,21 @@ _BLOCKING_TIMEOUT = 1200.0
 _fences = {}  # endpoint -> {"inc", "step", "fstep", "sends", "sparse"}
 _MAX_ROUND_REPLAYS = 6
 
+# ---- wire compression (FLAGS_comm_wire_dtype / FLAGS_comm_grad_int8) ---
+# int8 error-feedback residuals, TRAINER-side per (endpoint, block):
+# each round quantizes (grad + residual) and keeps the quantization
+# error for the NEXT round, so the error is corrected over time instead
+# of accumulating (the 1-bit/TernGrad error-feedback rule).  The fenced
+# replay records store the already-quantized blocks, so a pserver
+# restart re-ships identical bytes and the residual stays consistent.
+_ef_residuals = {}  # (endpoint, block_name) -> np.ndarray
+
+
+def reset_fences():
+    """Test isolation hook (mirrors rpc.reset_comm_stats)."""
+    _fences.clear()
+    _ef_residuals.clear()
+
 
 def _fence(ep):
     st = _fences.get(ep)
@@ -95,9 +114,39 @@ def _fence(ep):
     return st
 
 
-def reset_fences():
-    """Test isolation hook (mirrors rpc.reset_comm_stats)."""
-    _fences.clear()
+def _quantize_i8(g):
+    """Symmetric per-block int8 quantization: q = round(g / scale) with
+    scale = amax/127; returns (q, scale, dequantized)."""
+    amax = float(np.max(np.abs(g))) if g.size else 0.0
+    scale = amax / 127.0
+    if scale == 0.0:
+        q = np.zeros(g.shape, np.int8)
+        return q, 0.0, np.zeros_like(g)
+    q = np.clip(np.rint(g / scale), -127, 127).astype(np.int8)
+    return q, scale, (q.astype(g.dtype) * g.dtype.type(scale))
+
+
+def _compress_block(ep, bname, seg, wire_dtype, grad_int8):
+    """Wrap one dense grad block for the wire per the plan's compression
+    metadata; returns the value to ship and notes the saved bytes in the
+    comm counters (rpc.get_comm_stats comm_bytes_saved)."""
+    from ..distributed import rpc as _rpc
+
+    if seg.dtype.kind != "f":
+        return seg
+    if grad_int8:
+        key = (ep, bname)
+        res = _ef_residuals.get(key)
+        g = seg + res if res is not None else seg
+        q, scale, deq = _quantize_i8(np.ascontiguousarray(g))
+        _ef_residuals[key] = g - deq
+        _rpc.note_bytes_saved(seg.nbytes - q.nbytes)
+        return _rpc.Int8Wire(q, scale, seg.dtype.str)
+    if wire_dtype == "bfloat16":
+        # bf16 wire is 2 bytes/element whatever the source float width
+        _rpc.note_bytes_saved(seg.nbytes - 2 * seg.size)
+        return _rpc.Bf16Wire(seg)
+    return seg
 
 
 def _stale_endpoints(eps):
@@ -170,6 +219,11 @@ def _send(ctx, ins, attrs):
     block_names = list(attrs["block_names"])
     trainer_id = int(attrs.get("trainer_id", 0))
     cli = _client_map(trainer_id)
+    # the legacy per-variable path ALWAYS ships full precision — tag the
+    # counters accordingly even when FLAGS_comm_wire_dtype says bf16
+    from ..distributed import rpc as _rpc_mod
+
+    _rpc_mod.note_wire_dtype("float32")
 
     def host_send(x):
         flat = np.asarray(x).reshape(-1)
@@ -275,14 +329,32 @@ def _send_bucket(ctx, ins, attrs):
     # barrier into the arrival of the LAST bucket (ps_server), so that
     # submit may block round-long and gets the blocking timeout
     totals = {ep: int(n) for ep, n in (attrs.get("sync_totals") or {}).items()}
+    # wire-compression metadata from the transpiler's bucket plan: both
+    # ends agree because the requester's plan declares the wire form
+    wire_dtype = str(attrs.get("wire_dtype") or "float32")
+    grad_int8 = bool(attrs.get("grad_int8"))
+    compressing = grad_int8 or wire_dtype != "float32"
+    # the COUNTERS tag must describe the PLANNED wire, which may differ
+    # from the global flag (DistributeTranspilerConfig override)
+    from ..distributed import rpc as _rpc_mod
+
+    _rpc_mod.note_wire_dtype(wire_dtype)
     pipe = _pipelined(trainer_id)
 
     def host_send(*grads):
+        from ..profiler import RecordEvent
+
         flats = [np.asarray(g).reshape(-1) for g in grads]
         per_ep = {}
-        for ep, entries in plan:
-            blocks = {bn: flats[xi][b:e] for xi, b, e, bn in entries}
-            per_ep.setdefault(ep, []).append(blocks)
+        with RecordEvent("wire_compress", cat="compress") \
+                if compressing else _null_ctx():
+            for ep, entries in plan:
+                blocks = {
+                    bn: _compress_block(ep, bn, flats[xi][b:e],
+                                        wire_dtype, grad_int8)
+                    if compressing else flats[xi][b:e]
+                    for xi, b, e, bn in entries}
+                per_ep.setdefault(ep, []).append(blocks)
         for ep, blist in per_ep.items():
             total = totals.get(ep)
             if not total:
@@ -342,6 +414,10 @@ def _recv_bucket(ctx, ins, attrs):
     # sync mode: the server folds the fetch barrier into the last served
     # bucket per endpoint (see ps_server._h_get_bucket)
     totals = {ep: int(n) for ep, n in (attrs.get("fetch_totals") or {}).items()}
+    # param-side wire compression: the request DECLARES the wire dtype
+    # (from the transpiler plan) and the server compresses its reply;
+    # the decoder hands back the original dtype transparently
+    wire_dtype = str(attrs.get("wire_dtype") or "float32")
     pipe = _pipelined(trainer_id)
     out_structs = [
         jax.ShapeDtypeStruct(tuple(shape), jdt(dtype))
@@ -376,17 +452,28 @@ def _recv_bucket(ctx, ins, attrs):
             futs = []
             for ep in to_fetch:
                 for i, names in enumerate(per_ep_names.get(ep, [])):
+                    kw = dict(names=names, trainer_id=trainer_id,
+                              fetch_total=totals.get(ep),
+                              step=_fence(ep)["fstep"] if fenced else None,
+                              seq_idx=i)
+                    if wire_dtype != "float32":
+                        kw["wire_dtype"] = wire_dtype
                     futs.append((ep, pipe(ep).submit(
-                        "get_bucket", timeout_s=_BLOCKING_TIMEOUT,
-                        names=names, trainer_id=trainer_id,
-                        fetch_total=totals.get(ep),
-                        step=_fence(ep)["fstep"] if fenced else None,
-                        seq_idx=i)))
+                        "get_bucket", timeout_s=_BLOCKING_TIMEOUT, **kw)))
             for ep, f in futs:
                 got = f.result()
                 if not isinstance(got, dict):
                     raise RuntimeError(
                         "get_bucket from %s returned %r" % (ep, type(got)))
+                if wire_dtype == "bfloat16":
+                    from ..distributed import rpc as _rpc
+
+                    # bf16 wire = 2 bytes/element regardless of the
+                    # block's float width (f64 saves 3/4, not 1/2)
+                    _rpc.note_bytes_saved(sum(
+                        v.nbytes - 2 * v.size for v in got.values()
+                        if getattr(v, "dtype", None) is not None
+                        and v.dtype.kind == "f"))
                 block_vals.update(got)
             for ep in to_fetch:
                 pipe(ep).drain()  # clear resolved futures off the window

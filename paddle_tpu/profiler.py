@@ -27,6 +27,7 @@ __all__ = [
     "per_op_timeline",
     "comm_compute_split",
     "COMM_OPS",
+    "PHASE_CATS",
 ]
 
 _events = []
@@ -255,19 +256,41 @@ def per_op_timeline(program, feed, scope=None, path=None, warmup=1,
     return sorted(rows, key=lambda r: -r[3])
 
 
-def comm_compute_split(rows):
+# RecordEvent categories that refine the comm bucket: wire
+# serialization (rpc._send_msg), grad compression (dist_ops
+# wire_compress) and the pserver's fused optimize apply
+# (ps_server._run_round).  Spans with these cats are attributed to
+# their own phase by comm_compute_split instead of lumping into comm.
+PHASE_CATS = ("serialize", "compress", "apply")
+
+
+def comm_compute_split(rows, events=None):
     """Attribute per_op_timeline rows to DCN communication vs compute:
     returns {"comm_ms", "compute_ms", "comm_fraction"} over the host
     track — where the step's wall time actually goes when deciding
-    whether bucketing/overlap or kernels are the bottleneck."""
+    whether bucketing/overlap or kernels are the bottleneck.
+
+    When cat-tagged phase spans were recorded (`events`; defaults to the
+    profiler's captured span list), the split additionally reports
+    serialize/compress/apply milliseconds — the wire-compression and
+    fused-apply phases — so those show up as their own lines instead of
+    disappearing into comm."""
     comm = sum(r[2] for r in rows if r[0] in COMM_OPS)
     compute = sum(r[2] for r in rows if r[0] not in COMM_OPS)
     total = comm + compute
-    return {
+    out = {
         "comm_ms": round(comm, 3),
         "compute_ms": round(compute, 3),
         "comm_fraction": round(comm / total, 4) if total else 0.0,
     }
+    if events is None:
+        with _events_lock:
+            events = list(_events)
+    for cat in PHASE_CATS:
+        ms = sum(e["dur"] for e in events if e.get("cat") == cat) / 1e3
+        if ms:
+            out[cat + "_ms"] = round(ms, 3)
+    return out
 
 
 @contextlib.contextmanager

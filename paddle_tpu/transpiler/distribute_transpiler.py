@@ -43,6 +43,14 @@ class DistributeTranspilerConfig:
     # byte cap per coalesced comm bucket; None defers to
     # FLAGS_comm_bucket_bytes, 0 restores per-variable send/recv ops
     comm_bucket_bytes = None
+    # wire dtype for dense bucket grads + fetched params ("float32" |
+    # "bfloat16"); None defers to FLAGS_comm_wire_dtype.  Stamped into
+    # the bucket ops so both ends agree per bucket plan; the legacy
+    # per-variable path always ships full precision.
+    comm_wire_dtype = None
+    # int8 + error-feedback compression for dense bucket grads; None
+    # defers to FLAGS_comm_grad_int8 (see ops/dist_ops.py)
+    comm_grad_int8 = None
 
 
 class VarBlock:
@@ -394,6 +402,25 @@ class DistributeTranspiler:
 
             bucket_bytes = get_flag("comm_bucket_bytes")
         self.comm_bucket_bytes = int(bucket_bytes)
+        # compression metadata riding the bucket plan: resolved HERE so
+        # every role (trainer ops, pserver replies via the request's
+        # declaration) agrees on the wire form for this job
+        from ..flags import get_flag as _gf
+
+        wire_dtype = self.config.comm_wire_dtype
+        if wire_dtype is None:
+            wire_dtype = _gf("comm_wire_dtype")
+        wire_dtype = str(wire_dtype)
+        if wire_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                "comm_wire_dtype must be 'float32' or 'bfloat16', got %r "
+                "(int8 grads are the separate FLAGS_comm_grad_int8 gate)"
+                % (wire_dtype,))
+        self.comm_wire_dtype = wire_dtype
+        grad_int8 = self.config.comm_grad_int8
+        if grad_int8 is None:
+            grad_int8 = _gf("comm_grad_int8")
+        self.comm_grad_int8 = bool(grad_int8)
 
         with self.origin_program._op_role_guard("rpc"):
             scaled_names = []
@@ -431,6 +458,8 @@ class DistributeTranspiler:
                         "buckets": self.send_bucket_plan,
                         "sync_totals": sync_totals if self.sync_mode
                         else {},
+                        "wire_dtype": self.comm_wire_dtype,
+                        "grad_int8": self.comm_grad_int8,
                         "trainer_id": self.trainer_id,
                     },
                 )
@@ -474,6 +503,7 @@ class DistributeTranspiler:
                         "buckets": recv_buckets,
                         "fetch_totals": fetch_totals if self.sync_mode
                         else {},
+                        "wire_dtype": self.comm_wire_dtype,
                         "trainer_id": self.trainer_id,
                     },
                 )

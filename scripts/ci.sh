@@ -28,6 +28,23 @@ else
     python -m pytest tests/ -q         # pytest.ini addopts: -m "not slow"
 fi
 
+echo "== compressed-wire pass (FLAGS_comm_wire_dtype=bfloat16) =="
+# the bf16 wire must keep the whole fault story intact: the fast run
+# covers the wire codec + transpiler plan under compression; --full
+# re-runs the dist-parity-adjacent + chaos suites (kill/restore/replay,
+# incarnation fencing) with compressed buckets end to end
+if [ "${1:-}" = "--full" ]; then
+    FLAGS_comm_wire_dtype=bfloat16 python -m pytest \
+        tests/test_rpc_wire.py tests/test_dist_transpiler.py \
+        tests/test_fault_tolerance.py -q -m ""
+else
+    # -m "": also runs the slow-marked compression parity tests (bf16
+    # tolerance parity + >=40% bytes cut, int8 error feedback, fused==
+    # per-block) that tier-1's time budget keeps out of the fast suite
+    FLAGS_comm_wire_dtype=bfloat16 python -m pytest \
+        tests/test_rpc_wire.py tests/test_dist_transpiler.py -q -m ""
+fi
+
 echo "== orphaned-child check =="
 # chaos tests SIGKILL cluster children; a leaked pserver/trainer would
 # keep ports + fds alive and poison later runs — fail fast instead

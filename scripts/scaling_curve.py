@@ -277,12 +277,22 @@ def main():
         ep = ep_leg(n)
         print("| %d | %.2f | %.2f | %.2f | %.2f |" % (n, dp, pp, sp, ep),
               flush=True)
-    ps_rate, counters = pserver_leg()
+    ps_steps = 12
+    ps_rate, counters = pserver_leg(steps=ps_steps)
     print("\npserver mode (REAL subprocesses, localhost rpc): "
           "2 pservers x 2 trainers sync = %.2f steps/s "
           "(wall-clock incl. transport + barriers)" % ps_rate, flush=True)
     if counters:
         print("pserver trainer-0 comm counters: %s" % counters, flush=True)
+        # wire-compression evidence: bytes/step at the configured wire
+        # dtype (FLAGS_comm_wire_dtype), incl. what compression saved
+        bps = counters.get("bytes_per_step",
+                           counters.get("comm_bytes_sent", 0) / ps_steps)
+        print("pserver trainer-0 wire: dtype=%s %.1f KiB sent/step, "
+              "%.1f KiB saved total by compression"
+              % (counters.get("wire_dtype", "float32"), bps / 1024.0,
+                 counters.get("comm_bytes_saved", 0) / 1024.0),
+              flush=True)
 
 
 if __name__ == "__main__":

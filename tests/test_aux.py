@@ -447,6 +447,42 @@ def test_per_op_timeline_correlated_tracks(tmp_path):
     assert rows == sorted(rows, key=lambda r: -r[3])
 
 
+def test_comm_compute_split_attributes_phase_spans():
+    """Wire-compression observability: cat-tagged serialize/compress/
+    apply spans surface as their own phase lines in comm_compute_split
+    instead of lumping into comm — and stay absent when no such spans
+    were recorded."""
+    from paddle_tpu import profiler
+
+    rows = [("send_bucket", 0, 4.0, 4.0), ("mul", 1, 6.0, 6.0)]
+    base = profiler.comm_compute_split(rows, events=[])
+    assert base["comm_ms"] == 4.0 and base["compute_ms"] == 6.0
+    assert not any(k.endswith("_ms") and k not in ("comm_ms", "compute_ms")
+                   for k in base)
+    events = [
+        {"name": "rpc_serialize", "cat": "serialize", "dur": 1500.0},
+        {"name": "wire_compress", "cat": "compress", "dur": 250.0},
+        {"name": "ps_apply_round", "cat": "apply", "dur": 3000.0},
+        {"name": "rpc_send", "cat": "comm", "dur": 9000.0},  # not a phase
+    ]
+    out = profiler.comm_compute_split(rows, events=events)
+    assert out["serialize_ms"] == 1.5
+    assert out["compress_ms"] == 0.25
+    assert out["apply_ms"] == 3.0
+    # real spans: the profiler's captured events feed the split by default
+    profiler.reset_profiler()
+    profiler.start_profiler("CPU", None)
+    try:
+        with profiler.RecordEvent("rpc_serialize", cat="serialize"):
+            import time as _time
+
+            _time.sleep(0.002)
+    finally:
+        profiler.stop_profiler(profile_path=None)
+    assert "serialize_ms" in profiler.comm_compute_split(rows)
+    profiler.reset_profiler()
+
+
 def test_timeline_tool_merges_worker_profiles(tmp_path):
     """tools/timeline.py (reference tools/timeline.py:160 role): merge
     per-worker profiler JSONs into one trace with per-process lanes."""

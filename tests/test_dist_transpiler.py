@@ -252,13 +252,31 @@ def no_heartbeats():
     set_flags({"heartbeat_interval": prev})
 
 
-def _run_inprocess_cluster(bucket_bytes, steps=3, n_pservers=2):
+def _make_optimizer(kind):
+    if kind == "sgd":
+        return fluid.optimizer.SGD(0.1)
+    if kind == "momentum":
+        return fluid.optimizer.Momentum(0.05, momentum=0.9)
+    if kind == "adagrad":
+        return fluid.optimizer.Adagrad(0.1)
+    if kind == "adam":
+        return fluid.optimizer.Adam(0.01)
+    raise ValueError(kind)
+
+
+def _run_inprocess_cluster(bucket_bytes, steps=3, n_pservers=2,
+                           wire_dtype="float32", grad_int8=False,
+                           hidden=8, optimizer="sgd"):
     """Build the 4-param MLP, transpile for `n_pservers` in-process
     VarServer threads, train `steps` sync steps, return (losses,
-    comm_stats, transpiler)."""
+    comm_stats, transpiler).  `wire_dtype`/`grad_int8` pin the wire
+    compression per run (config beats the flag), so the bit-exact
+    legacy-parity assertion stays meaningful under a compressed-wire CI
+    pass (scripts/ci.sh FLAGS_comm_wire_dtype=bfloat16)."""
     from paddle_tpu import framework, unique_name
     from paddle_tpu.core.scope import Scope
     from paddle_tpu.distributed import rpc
+    from paddle_tpu.ops import dist_ops
 
     # two cluster runs share one test: each needs virgin default programs
     framework.switch_main_program(fluid.Program())
@@ -271,17 +289,23 @@ def _run_inprocess_cluster(bucket_bytes, steps=3, n_pservers=2):
     with fluid.program_guard(main, startup):
         x = layers.data("x", shape=[4])
         y = layers.data("y", shape=[1])
-        h = layers.fc(x, size=8, act="relu")
-        pred = layers.fc(h, size=1)
+        h = layers.fc(x, size=hidden, act="relu")
+        # per-param lr exercises the optimize-role `scale` chain the
+        # fused-apply analyzer folds into a factor
+        pred = layers.fc(h, size=1,
+                         param_attr=fluid.ParamAttr(learning_rate=0.5))
         loss = layers.mean(layers.square_error_cost(pred, y))
-        fluid.optimizer.SGD(0.1).minimize(loss)
+        _make_optimizer(optimizer).minimize(loss)
     config = fluid.DistributeTranspilerConfig()
     config.min_block_size = 4
     config.comm_bucket_bytes = bucket_bytes
+    config.comm_wire_dtype = wire_dtype
+    config.comm_grad_int8 = grad_int8
     t = fluid.DistributeTranspiler(config=config)
     eps = ["127.0.0.1:%d" % _free_port() for _ in range(n_pservers)]
     t.transpile(0, program=main, pservers=",".join(eps), trainers=1,
                 sync_mode=True, startup_program=startup)
+    dist_ops.reset_fences()  # fresh fence + error-feedback state per run
     threads = []
     for ep in eps:
         psprog = t.get_pserver_program(ep)
@@ -338,6 +362,85 @@ def test_bucketed_e2e_matches_legacy_and_cuts_round_trips(no_heartbeats):
     assert sl["rpc_round_trips"] >= 4 * sb["rpc_round_trips"], (sl, sb)
     # coalescing also cuts framing bytes, not just frame count
     assert sb["comm_bytes_sent"] < sl["comm_bytes_sent"]
+
+
+@pytest.mark.slow  # tier-1 runs at the edge of its time budget; this
+# rides scripts/ci.sh's compressed-wire pass (-m "") and --full instead
+def test_bf16_wire_parity_within_tolerance_and_bytes_cut(no_heartbeats):
+    """Wire compression acceptance: the SAME workload over a bfloat16
+    wire stays within bf16 rounding of the float32 run (grads and
+    fetched params round to 8 mantissa bits; server state stays f32)
+    and ships >= 40% fewer bytes per step — the counters are plan
+    properties, so the reduction asserts exactly, no wall clock."""
+    steps = 3
+    # wide enough that array payloads dominate framing (the tiny default
+    # model is envelope-bound and no wire dtype could cut 40% there)
+    f32, s32, _t = _run_inprocess_cluster(4 << 20, steps=steps,
+                                          hidden=512)
+    bf, sbf, tb = _run_inprocess_cluster(4 << 20, steps=steps,
+                                         wire_dtype="bfloat16",
+                                         hidden=512)
+    assert np.isfinite(bf).all()
+    np.testing.assert_allclose(bf, f32, rtol=0.05, atol=1e-3)
+    # the acceptance threshold: >= 40% fewer bytes on the wire
+    assert sbf["comm_bytes_sent"] <= 0.6 * s32["comm_bytes_sent"], \
+        (sbf["comm_bytes_sent"], s32["comm_bytes_sent"])
+    assert sbf["comm_bytes_recv"] < s32["comm_bytes_recv"]
+    assert sbf["comm_bytes_saved"] > 0 and s32["comm_bytes_saved"] == 0
+    # same round-trip count: compression changes bytes, never the plan
+    assert sbf["rpc_round_trips"] == s32["rpc_round_trips"]
+    assert tb.comm_wire_dtype == "bfloat16"
+    # the COUNTERS tag reflects the PLANNED wire (the config override),
+    # not the untouched global flag (still float32 here)
+    assert sbf["wire_dtype"] == "bfloat16", sbf
+    assert s32["wire_dtype"] == "float32", s32
+
+
+@pytest.mark.slow  # see test_bf16_wire_parity_within_tolerance_and_bytes_cut
+def test_int8_error_feedback_wire_tracks_f32(no_heartbeats):
+    """FLAGS_comm_grad_int8: dense grads ship as int8 + per-block scale
+    with the quantization residual kept trainer-side and folded into
+    the next round (error feedback) — the loss must track the f32 run
+    and the grad leg of the wire shrinks to ~1/4."""
+    steps = 4
+    f32, s32, _t = _run_inprocess_cluster(4 << 20, steps=steps)
+    i8, si8, _t8 = _run_inprocess_cluster(4 << 20, steps=steps,
+                                          grad_int8=True)
+    assert np.isfinite(i8).all()
+    np.testing.assert_allclose(i8, f32, rtol=0.2, atol=5e-2)
+    assert si8["comm_bytes_sent"] < s32["comm_bytes_sent"]
+    assert si8["comm_bytes_saved"] > 0
+    from paddle_tpu.ops.dist_ops import _ef_residuals
+
+    assert _ef_residuals, "error-feedback residuals never recorded"
+
+
+@pytest.mark.slow  # see test_bf16_wire_parity_within_tolerance_and_bytes_cut
+@pytest.mark.parametrize("optimizer",
+                         ["sgd", "momentum", "adagrad", "adam"])
+def test_fused_apply_matches_per_block_executor(no_heartbeats, optimizer):
+    """FLAGS_ps_fused_apply: the jitted stacked update must be
+    BIT-identical to the per-block executor programs it replaces — the
+    rules are the same elementwise math, so fused on/off may not differ
+    in a single float.  Parametrized over every fusable rule, with a
+    per-param lr so the scale-chain factor fold and (for adam) the
+    beta-pow scalar-slot write-back are all under the == assertion."""
+    from paddle_tpu.flags import get_flag, set_flags
+
+    steps = 3
+    fused, sf, _ = _run_inprocess_cluster(4 << 20, steps=steps,
+                                          optimizer=optimizer)
+    prev = get_flag("ps_fused_apply")
+    set_flags({"ps_fused_apply": 0})
+    try:
+        legacy, sl, _ = _run_inprocess_cluster(4 << 20, steps=steps,
+                                               optimizer=optimizer)
+    finally:
+        set_flags({"ps_fused_apply": prev})
+    assert fused == legacy, (optimizer, fused, legacy)
+    # identical wire too: fusion is a server-side dispatch change only
+    assert sf["comm_bytes_sent"] == sl["comm_bytes_sent"]
+    assert sf["rpc_round_trips"] == sl["rpc_round_trips"]
 
 
 def test_zero_block_pserver_gets_empty_bucket_and_terminates(no_heartbeats):

@@ -151,6 +151,130 @@ def test_get_bucket_returns_all_blocks_one_frame():
         srv.shutdown()
 
 
+def test_bf16_wire_array_roundtrip():
+    """Compressed array tag: a Bf16Wire-wrapped float array ships as
+    bf16 payload and decodes back to its ORIGINAL dtype with bf16
+    rounding — half the array bytes of the f32 tag, same shape/dtype on
+    arrival."""
+    from paddle_tpu.distributed.rpc import Bf16Wire
+
+    rng = np.random.RandomState(11)
+    arr = (rng.rand(64, 3).astype("float32") - 0.5) * 8.0
+    buf = bytes(_encode({"g": Bf16Wire(arr)}, bytearray()))
+    plain = bytes(_encode({"g": arr}, bytearray()))
+    assert len(buf) < len(plain) - arr.nbytes // 4  # payload halved
+    out = _Reader(buf).decode()["g"]
+    assert out.dtype == np.float32 and out.shape == arr.shape
+    # bf16 keeps 8 mantissa bits: relative error bounded by 2^-8
+    np.testing.assert_allclose(out, arr, rtol=1 / 256.0, atol=1e-6)
+    # through a live server: the service sees a plain f32 array
+    srv, ep = _mk_server()
+    try:
+        cli = RPCClient(ep, timeout=5, retries=2)
+        echoed = cli.call("echo", value=Bf16Wire(arr))["value"]
+        assert echoed.dtype == np.float32
+        np.testing.assert_allclose(echoed, arr, rtol=1 / 256.0, atol=1e-6)
+        cli.close()
+    finally:
+        srv.shutdown()
+
+
+def test_int8_wire_array_roundtrip_exact_dequant():
+    """Int8 tag: the decoder returns scale * q exactly (the quantization
+    error lives in the CALLER's error-feedback residual, never the
+    wire), in the declared original dtype."""
+    from paddle_tpu.distributed.rpc import Int8Wire
+
+    q = np.array([[-127, 0, 1], [64, -3, 127]], np.int8)
+    scale = 0.0375
+    buf = bytes(_encode([Int8Wire(q, scale, "<f4")], bytearray()))
+    (out,) = _Reader(buf).decode()
+    assert out.dtype == np.float32 and out.shape == q.shape
+    np.testing.assert_array_equal(
+        out, q.astype(np.float32) * np.float32(scale))
+    # wrapper refuses non-int8 payloads and non-float targets
+    with pytest.raises(TypeError):
+        Int8Wire(q.astype(np.int16), scale)
+    with pytest.raises(TypeError):
+        Int8Wire(q, scale, "<i4")
+
+
+def test_compressed_tags_malformed_frames_rejected():
+    """Hostile/truncated compressed-array frames are parse errors:
+    truncation mid-header, mid-payload, size-mismatch, non-float
+    original dtype, and garbage dtype strings all raise ValueError."""
+    from paddle_tpu.distributed.rpc import Bf16Wire, Int8Wire
+
+    good_bf = bytes(_encode(
+        Bf16Wire(np.arange(6, dtype="float32")), bytearray()))
+    good_i8 = bytes(_encode(
+        Int8Wire(np.arange(6, dtype=np.int8), 0.5), bytearray()))
+    for good in (good_bf, good_i8):
+        for cut in (1, 5, len(good) - 3):
+            with pytest.raises(ValueError, match="truncated"):
+                _Reader(good[:cut]).decode()
+    # nbytes disagreeing with shape: refused before any frombuffer
+    for tag in (b"h", b"q"):
+        bad = bytearray()
+        bad += tag + struct.pack(">I", 3) + b"<f4" + bytes([1])
+        bad += struct.pack(">q", 4)  # shape (4,)
+        bad += struct.pack(">Q", 2) + b"\x00" * 16
+        with pytest.raises(ValueError, match="size mismatch"):
+            _Reader(bytes(bad)).decode()
+    # original dtype must be float: an int target is refused
+    bad = bytearray()
+    bad += b"h" + struct.pack(">I", 3) + b"<i4" + bytes([1])
+    bad += struct.pack(">q", 2) + struct.pack(">Q", 4) + b"\x00" * 4
+    with pytest.raises(ValueError, match="refuses dtype"):
+        _Reader(bytes(bad)).decode()
+    # garbage dtype string is a parse error, not a TypeError escape
+    bad = bytearray()
+    bad += b"q" + struct.pack(">I", 3) + b"zz9" + bytes([1])
+    bad += struct.pack(">q", 2) + struct.pack(">Q", 2)
+    bad += struct.pack(">d", 1.0) + b"\x00\x00"
+    with pytest.raises(ValueError):
+        _Reader(bytes(bad)).decode()
+
+
+def test_scatter_gather_segments_match_bytearray_encoder():
+    """Zero-copy framing invariant: joining the _SegWriter segments
+    reproduces the copying encoder's byte stream exactly — for frames
+    with large arrays (own memoryview segment), small arrays (inlined),
+    compressed wrappers and nested containers."""
+    from paddle_tpu.distributed.rpc import Bf16Wire, _SegWriter
+
+    rng = np.random.RandomState(3)
+    obj = {
+        "big": rng.rand(4096).astype("float32"),  # own segment
+        "small": np.arange(7, dtype="int64"),     # inlined
+        "bf": Bf16Wire(rng.rand(2048).astype("float32")),
+        "nest": [1, "two", {"k": np.float64(2.5)}, b"raw"],
+    }
+    segs = _encode(obj, _SegWriter()).segments()
+    joined = b"".join(bytes(s) for s in segs)
+    assert joined == bytes(_encode(obj, bytearray()))
+    assert len(segs) > 1, "large payloads should ride as own segments"
+    out = _Reader(joined).decode()
+    np.testing.assert_array_equal(out["big"], obj["big"])
+
+
+def test_scatter_gather_large_frame_over_live_socket():
+    """A frame big enough to exercise sendmsg short-write resumption
+    round-trips intact through the real transport."""
+    srv, ep = _mk_server()
+    try:
+        cli = RPCClient(ep, timeout=30, retries=2)
+        rng = np.random.RandomState(9)
+        blocks = {"b%d" % i: rng.rand(1 << 16).astype("float32")
+                  for i in range(8)}  # ~2 MiB total, 8 sg segments
+        echoed = cli.call("echo", blocks=blocks)["blocks"]
+        for k, v in blocks.items():
+            np.testing.assert_array_equal(echoed[k], v)
+        cli.close()
+    finally:
+        srv.shutdown()
+
+
 def test_no_pickle_in_rpc_module():
     import inspect
 
