@@ -174,7 +174,13 @@ def analyze_block(program, block_idx, feed_names, fetch_names, keep=None):
     return reads, writes
 
 
-def build_traced_function(program, block_idx, feed_names, fetch_names, scope):
+def build_traced_function(program, block_idx, feed_names, fetch_names, scope,
+                          collective_axis=None):
+    """`collective_axis`: optional ("axis_name", nranks) pair binding the
+    collective-lowering context around the trace — c_allreduce_* ops then
+    lower to jax.lax collectives over that axis instead of identity.  The
+    caller (executor._run_collective) is responsible for actually running
+    the traced fn under a shard_map that binds the axis."""
     keep = dce_mask(program, block_idx, fetch_names)
     reads, writes = analyze_block(program, block_idx, feed_names, fetch_names, keep)
     state_names = [n for n in reads if scope.has_var(n)]
@@ -199,6 +205,14 @@ def build_traced_function(program, block_idx, feed_names, fetch_names, scope):
     is_test = getattr(program, "_is_test", False)
 
     def fn(feeds, ro_state, rw_state, rng_key):
+        if collective_axis is not None:
+            from ..parallel.collective import collective_lowering
+
+            with collective_lowering(*collective_axis):
+                return _fn_body(feeds, ro_state, rw_state, rng_key)
+        return _fn_body(feeds, ro_state, rw_state, rng_key)
+
+    def _fn_body(feeds, ro_state, rw_state, rng_key):
         env = {}
         env.update(ro_state)
         env.update(rw_state)

@@ -93,6 +93,9 @@ def init_collective(trainer_endpoints=None, current_endpoint=None, trainer_id=No
         trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
     if len(trainer_endpoints) <= 1:
         return  # single host: nothing to do
+    from ..parallel.collective import _enable_cpu_cross_process_collectives
+
+    _enable_cpu_cross_process_collectives()
     jax.distributed.initialize(
         coordinator_address=trainer_endpoints[0],
         num_processes=len(trainer_endpoints),

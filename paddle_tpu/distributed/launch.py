@@ -10,7 +10,10 @@ Two modes:
   Each rank gets PADDLE_TRAINER_ID / PADDLE_TRAINERS /
   PADDLE_TRAINER_ENDPOINTS / PADDLE_CURRENT_ENDPOINT; scripts call
   `paddle_tpu.distributed.init_collective()` (rank-0 endpoint is the
-  jax.distributed coordinator).
+  jax.distributed coordinator).  `--pservers N` makes the job HYBRID:
+  pserver roles spawn first (PADDLE_PSERVER_EPS wired for everyone) and
+  carry only sparse/embedding traffic, while dense grads ride the mesh
+  (DistributeTranspiler mode="collective").
 
 - pserver (the transpiler's parameter-server path):
     python -m paddle_tpu.distributed.launch --mode pserver \
@@ -370,16 +373,46 @@ def _arm_chaos(cluster, chaos_kills):
         cluster.schedule_kill(tag, after_s)
 
 
-def launch_collective(script_argv, nproc, base_env=None, chaos_kills=None):
+def launch_collective(script_argv, nproc, base_env=None, chaos_kills=None,
+                      n_pservers=0):
+    """Collective (mesh data-parallel) cluster: nproc trainer processes,
+    one device each, mesh spanning them via jax.distributed.  With
+    `n_pservers` > 0 the job is HYBRID: pserver roles spawn first and
+    carry ONLY sparse/embedding traffic (PADDLE_PSERVER_EPS is wired for
+    both roles); dense grads ride the mesh and never touch them."""
     eps = ",".join("127.0.0.1:%d" % free_port() for _ in range(nproc))
     cluster = _Cluster()
     ep_list = eps.split(",")
-    for rank in range(nproc):
-        env = dict(base_env or os.environ)
+    common = dict(base_env or os.environ)
+    common.update(
+        PADDLE_TRAINERS=str(nproc),
+        PADDLE_TRAINER_ENDPOINTS=eps,
+    )
+    ps_ports = [free_port() for _ in range(n_pservers)]
+    if ps_ports:
+        common["PADDLE_PSERVER_EPS"] = ",".join(
+            "127.0.0.1:%d" % p for p in ps_ports)
+    for i, p in enumerate(ps_ports):
+        env = dict(common)
         env.update(
+            PADDLE_TRAINING_ROLE="PSERVER",
+            PADDLE_CURRENT_ENDPOINT="127.0.0.1:%d" % p,
+        )
+        cluster.spawn(
+            "pserver.%d" % i, [sys.executable, "-u"] + script_argv, env)
+    for p in ps_ports:
+        if not _wait_port("127.0.0.1:%d" % p, cluster=cluster):
+            sys.stderr.write("[launch] pserver port %d never opened\n" % p)
+            dead = [pr.poll() for _, pr, _ in cluster.procs
+                    if pr.poll() is not None]
+            cluster.kill()
+            bad = [rc for rc in dead if rc != 0]
+            return bad[0] if bad else 1
+    for rank in range(nproc):
+        env = dict(common)
+        env.update(
+            PADDLE_TRAINING_ROLE="TRAINER",
             PADDLE_TRAINER_ID=str(rank),
-            PADDLE_TRAINERS=str(nproc),
-            PADDLE_TRAINER_ENDPOINTS=eps,
             PADDLE_CURRENT_ENDPOINT=ep_list[rank],
         )
         cluster.spawn(
@@ -579,7 +612,10 @@ def main(argv=None):
         "--mode", choices=("collective", "pserver"), default="collective"
     )
     parser.add_argument(
-        "--pservers", type=int, default=2, help="pserver count (pserver mode)"
+        "--pservers", type=int, default=None,
+        help="pserver count: defaults to 2 in pserver mode and 0 in "
+        "collective mode (pass a count there for HYBRID jobs — sparse "
+        "embedding traffic rides the pservers, dense grads the mesh)"
     )
     parser.add_argument(
         "--async-mode", action="store_true",
@@ -636,10 +672,13 @@ def main(argv=None):
     script_argv = [args.script] + args.script_args
     if args.mode == "collective":
         rc = launch_collective(script_argv, args.nproc,
-                               chaos_kills=chaos_kills)
+                               chaos_kills=chaos_kills,
+                               n_pservers=args.pservers or 0)
     else:
         rc = launch_pserver(
-            script_argv, args.nproc, args.pservers, sync=not args.async_mode,
+            script_argv, args.nproc,
+            args.pservers if args.pservers is not None else 2,
+            sync=not args.async_mode,
             chaos_kills=chaos_kills, supervise=args.supervise,
             max_restarts=args.max_restarts,
             restart_window=args.restart_window,

@@ -149,13 +149,20 @@ _comm_stats = {"rpc_round_trips": 0, "comm_bytes_sent": 0,
                "comm_bytes_recv": 0, "comm_bytes_saved": 0,
                "pserver_restarts_seen": 0,
                "recoveries": 0, "recovery_ms": 0.0}
+# per-verb round-trip breakdown (rides get_comm_stats as "rpc_verbs"):
+# the collective dense-grad backend is ACCEPTED on this evidence — a
+# hybrid run must show zero send/send_bucket/recv/get_bucket trips while
+# prefetch/send_sparse still flow to the pserver
+_comm_verbs = {}
 
 
-def _bump_comm(trips=0, sent=0, recv=0):
+def _bump_comm(trips=0, sent=0, recv=0, verb=None):
     with _comm_lock:
         _comm_stats["rpc_round_trips"] += trips
         _comm_stats["comm_bytes_sent"] += sent
         _comm_stats["comm_bytes_recv"] += recv
+        if verb is not None and trips:
+            _comm_verbs[verb] = _comm_verbs.get(verb, 0) + trips
 
 
 def note_recovery(ms):
@@ -200,6 +207,7 @@ def get_comm_stats():
     (note_wire_dtype), else the FLAGS_comm_wire_dtype value."""
     with _comm_lock:
         out = dict(_comm_stats)
+        out["rpc_verbs"] = dict(_comm_verbs)
         wd = _wire_dtype_used
     if wd is None:
         try:
@@ -219,6 +227,7 @@ def reset_comm_stats():
         for k in _comm_stats:
             _comm_stats[k] = 0 if not isinstance(_comm_stats[k], float) \
                 else 0.0
+        _comm_verbs.clear()
         _wire_dtype_used = None
 
 
@@ -1003,7 +1012,8 @@ class RPCClient:
                         # "deterministic" counters vary with run
                         # duration / restart history
                         if verb not in ("heartbeat", "register"):
-                            _bump_comm(trips=1, sent=sent, recv=recvd)
+                            _bump_comm(trips=1, sent=sent, recv=recvd,
+                                       verb=verb)
                         break
                     except socket.timeout:
                         drop_sock()

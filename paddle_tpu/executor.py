@@ -7,6 +7,8 @@ Feed dict entries become function arguments; fetch vars become outputs; no
 feed/fetch ops or feed-variable side channel are needed.
 """
 
+import threading
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -23,6 +25,44 @@ global_scope = scope_mod.global_scope
 scope_guard = scope_mod.scope_guard
 
 _FAST_MISS = object()  # sentinel: fast-path preconditions broke, go slow
+
+# jax threads an ordered-io-callback TOKEN from each dispatch into the
+# next, resharding it onto the new computation's devices — and in this
+# jax, resharding a 1-device token onto a multi-device mesh (or back)
+# trips a PjRt layout CHECK and aborts the process.  Ordered-effect
+# tokens are per-thread (dispatch.RuntimeTokenSet is a threading.local),
+# so track each thread's last dispatch topology and DRAIN its tokens when
+# the topology changes: a pure synchronization point (every prior
+# callback completes before the new regime's first one runs), after which
+# the next dispatch mints a fresh token with the right sharding.  This is
+# what lets the collective (mesh) trainer and the pserver (single-device)
+# paths coexist in one process — the hybrid parity tests run both.
+_token_regime = threading.local()
+
+
+def _ensure_token_regime(key):
+    prev = getattr(_token_regime, "key", None)
+    if prev == key:
+        return
+    if prev is not None:
+        # jax-private surface: absent (or reshaped) on newer jax builds,
+        # where tokens are topology-safe and no drain is needed — degrade
+        # to the old no-drain behavior rather than crash every run
+        try:
+            from jax._src import dispatch as _jax_dispatch
+
+            tokens = getattr(_jax_dispatch, "runtime_tokens", None)
+        except ImportError:  # pragma: no cover - jax internals moved
+            tokens = None
+        if tokens is not None:
+            try:
+                tokens.block_until_ready()  # also clears
+            except Exception:
+                try:
+                    tokens.clear()
+                except Exception:  # pragma: no cover - API drift
+                    pass
+    _token_regime.key = key
 
 
 def as_numpy(value):
@@ -175,6 +215,13 @@ class Executor:
         fetch_names = [
             v.name if isinstance(v, framework.Variable) else str(v) for v in fetch_list
         ]
+        # collective-mode program (DistributeTranspiler mode="collective"
+        # stamps program._collective): the step runs under shard_map over
+        # a dp mesh so its c_allreduce_* ops lower to real collectives
+        coll = getattr(program, "_collective", None)
+        if coll is not None:
+            return self._run_collective(program, feed, fetch_names, scope,
+                                        return_numpy, coll)
         # steady-state fast path: everything the slow path re-derives per
         # step — the listen_and_serv/reader op scans, per-feed var lookup
         # + dtype-kind guard, the sorted feed-signature tuple, and the
@@ -324,6 +371,7 @@ class Executor:
                     program, fetch_names, scope, return_numpy):
         from .flags import get_flag
 
+        _ensure_token_regime(("flat", self.place.jax_device().id))
         key = self._rng_key(program)
         import time as _time
 
@@ -361,6 +409,159 @@ class Executor:
 
         if return_numpy:
             return [as_numpy(f) for f in fetches]
+        return list(fetches)
+
+    # ---- collective (mesh data-parallel) run path -----------------------
+    def _run_collective(self, program, feed, fetch_names, scope,
+                        return_numpy, coll):
+        """Run a collective-mode trainer program: the traced step is
+        wrapped in ``shard_map`` over a ``parallel/mesh.dp_mesh`` so the
+        transpiler's ``c_allreduce_*`` ops lower to ``jax.lax``
+        collectives — XLA overlaps the gradient all-reduce with backward
+        compute, and no Python runs in the dense-grad path.
+
+        Replica semantics: each mesh shard is one logical trainer.
+        Array feeds with a leading batch dim are this PROCESS's shard of
+        the global batch and split over the axis (multi-process via
+        jax.distributed: one feed shard per process — every process MUST
+        feed equal-size shards, since the global shape is derived as
+        local_rows * process_count; single-process CPU CI: the full
+        batch splits over the virtual devices); everything else —
+        params, optimizer state, the step RNG key — is replicated.
+        Float fetches return the cross-replica mean (the global-batch
+        loss), so every process reports the same trajectory.  State
+        updates must be replica-invariant (they are, whenever they flow
+        from all-reduced grads; batch-stat ops like BN belong on the
+        DistributedExecutor path instead)."""
+        import time as _time
+
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from .flags import get_flag
+        from .parallel.mesh import shard_map
+
+        axis, nranks = str(coll["axis"]), int(coll["nranks"])
+        if get_flag("prng_impl") != "threefry":
+            raise NotImplementedError(
+                "collective mode replicates the raw threefry step key "
+                "across the mesh; FLAGS_prng_impl=%s is not supported "
+                "here" % get_flag("prng_impl"))
+        if any(op.type == "read" for op in program.global_block().ops):
+            raise ValueError(
+                "collective mode feeds arrays directly; in-program "
+                "py_reader ops are not supported on this path")
+        cache = getattr(self, "_coll_cache", None)
+        if cache is None:
+            cache = self._coll_cache = {}
+        meshes = getattr(self, "_coll_meshes", None)
+        if meshes is None:
+            meshes = self._coll_meshes = {}
+        mesh = meshes.get((axis, nranks))
+        if mesh is None:
+            from .parallel.mesh import dp_mesh
+
+            mesh = meshes[(axis, nranks)] = dp_mesh(nranks, axis)
+        repl = NamedSharding(mesh, PartitionSpec())
+        nproc = jax.process_count()
+        local_per_proc = nranks // max(1, nproc)
+
+        def to_mesh(value, spec):
+            arr = np.asarray(value)
+            sharding = NamedSharding(mesh, spec)
+            gshape = tuple(arr.shape)
+            if spec != PartitionSpec():
+                gshape = (arr.shape[0] * nproc,) + tuple(arr.shape[1:])
+            return jax.make_array_from_process_local_data(
+                sharding, arr, gshape)
+
+        def feed_spec(arr):
+            # a process-local batch shard splits over the axis when every
+            # local device can take an equal slice; anything else (odd
+            # leading dims, scalars) replicates
+            if (arr.ndim and arr.shape[0]
+                    and arr.shape[0] % max(1, local_per_proc) == 0):
+                return PartitionSpec(axis)
+            return PartitionSpec()
+
+        t0 = _time.perf_counter()
+        feed_np = {n: np.asarray(v) for n, v in feed.items()}
+        specs = {n: feed_spec(a) for n, a in feed_np.items()}
+        with RecordEvent("feed_upload", cat="feed"):
+            feed_arrays = {n: to_mesh(a, specs[n])
+                           for n, a in feed_np.items()}
+        self._host_feed_ms += (_time.perf_counter() - t0) * 1e3
+
+        feed_sig = tuple(sorted(
+            (n, tuple(a.shape), str(a.dtype)) for n, a in feed_np.items()))
+        key_id = (id(program), program._version, feed_sig,
+                  tuple(fetch_names), id(scope))
+        entry = cache.get(key_id)
+        if entry is None:
+            from .core.trace import build_traced_function
+
+            traced = build_traced_function(
+                program, 0, tuple(n for n, _, _ in feed_sig), fetch_names,
+                scope, collective_axis=(axis, nranks))
+
+            def stepfn(feeds, ro_state, rw_state, rng_key):
+                fetches, new_state = traced.fn(
+                    feeds, ro_state, rw_state, rng_key)
+                # float fetches -> cross-replica mean: shard-mean losses
+                # average to the global-batch loss, and the P() out_spec
+                # is then genuinely replicated.  Non-float fetches have
+                # no sound merge rule (an int count over the sharded
+                # batch is per-replica, and check_rep=False would hand
+                # back ONE replica's shard as if it were global) — refuse
+                # rather than silently return 1/nranks of the truth.
+                merged = []
+                for name, f in zip(fetch_names, fetches):
+                    if jnp.issubdtype(jnp.result_type(f), jnp.inexact):
+                        merged.append(jax.lax.pmean(f, axis))
+                    else:
+                        raise NotImplementedError(
+                            "collective mode cannot merge non-float "
+                            "fetch %r (dtype %s) across mesh replicas — "
+                            "fetch a float metric (cast counts to f32 "
+                            "in-program) or use the DistributedExecutor "
+                            "path" % (name, jnp.result_type(f)))
+                return merged, new_state
+
+            in_specs = ({n: specs[n] for n in feed_np},
+                        PartitionSpec(), PartitionSpec(), PartitionSpec())
+            wrapped = shard_map(
+                stepfn, mesh=mesh, in_specs=in_specs,
+                out_specs=(PartitionSpec(), PartitionSpec()),
+                check_rep=False)
+            jitted = jax.jit(wrapped, donate_argnums=(2,))
+            entry = cache[key_id] = (traced, jitted, specs)
+        traced, jitted, cached_specs = entry
+        if cached_specs != specs:  # same sig must imply same placement
+            raise RuntimeError(
+                "collective feed sharding changed for a cached signature")
+
+        def commit(n):
+            v = scope.find_var(n)
+            if (isinstance(v, jax.Array)
+                    and getattr(v, "committed", True)
+                    and v.sharding == repl):
+                return v
+            arr = to_mesh(v, PartitionSpec())
+            scope.set(n, arr)
+            return arr
+
+        ro_state = {n: commit(n) for n in traced.ro_names}
+        rw_state = {n: commit(n) for n in traced.rw_names}
+        key = to_mesh(self._rng_key(program), PartitionSpec())
+        _ensure_token_regime(
+            ("mesh", tuple(d.id for d in mesh.devices.flat)))
+        with RecordEvent("executor_run"):
+            fetches, new_state = jitted(feed_arrays, ro_state, rw_state, key)
+        for n, v in new_state.items():
+            scope.set(n, v)
+        if return_numpy:
+            # P() out_specs are fully replicated: np.asarray reads the
+            # local shard even in multi-process runs
+            return [np.asarray(f) for f in fetches]
         return list(fetches)
 
     def run_loop(
@@ -402,6 +603,12 @@ class Executor:
                 "run_loop cannot iterate programs with host-boundary ops "
                 "(py_reader 'read' / listen_and_serv) — their IO happens "
                 "at the executor boundary, outside the compiled loop"
+            )
+        if getattr(program, "_collective", None) is not None:
+            raise ValueError(
+                "run_loop does not drive collective-mode programs (their "
+                "allreduces need the mesh-bound run() path); call run() "
+                "per step"
             )
         feed = feed or {}
         fetch_list = fetch_list or []
@@ -475,6 +682,7 @@ class Executor:
             n: self._commit_state(n, scope.find_var(n), device, scope)
             for n in traced.rw_names
         }
+        _ensure_token_regime(("flat", self.place.jax_device().id))
         # EXACT run() stream parity: iteration i uses fold_in(base,
         # step0 + i) — the same key i sequential run() calls would draw
         base = self._rng_base(program)

@@ -20,6 +20,7 @@ from . import quant_ops  # noqa: F401
 from . import metric_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
 from . import dist_ops  # noqa: F401
+from . import collective_ops  # noqa: F401
 from . import misc_ops  # noqa: F401
 from . import control_ops  # noqa: F401
 from . import compat_ops  # noqa: F401

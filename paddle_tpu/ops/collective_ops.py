@@ -1,0 +1,73 @@
+"""In-step collective op lowerings: the `c_allreduce_*` family.
+
+The reference synchronizes dense gradients in collective ("nccl2") mode
+with runtime NCCL ops (operators/collective/c_allreduce_op.h,
+c_allreduce_sum_op.cc) that ParallelExecutor schedules between the
+backward and the optimizer.  Here the same rewrite — DistributeTranspiler
+``mode="collective"`` inserts one allreduce between each dense ``*_grad``
+output and its optimizer op — lowers to ``jax.lax`` collectives traced
+INTO the one jitted step (core/trace.py), so XLA overlaps the all-reduce
+with backward compute and no Python runs in the dense-grad path at all.
+
+Axis binding: the collective run path (executor._run_collective) traces
+the step under ``shard_map`` over a ``parallel/mesh.dp_mesh`` and enters
+``parallel.collective.collective_lowering`` so these rules see the bound
+axis.  Traced WITHOUT that context (a transpiled program run on a plain
+executor, or a single-device mesh) the ops degrade to single-replica
+semantics — allreduce over a world of one is the identity — so the same
+program trains standalone.
+
+``Deps`` (hybrid pserver mode): sparse ``send_sparse`` tokens are threaded
+through the allreduce via ``lax.optimization_barrier``, making the psum —
+a cross-device rendezvous — wait for every replica's sparse push.  With
+the next step's ``prefetch`` depending on an allreduced-update param
+(its own ``Dep`` input), every replica's step-N sparse rows land on the
+pserver before ANY replica's step-N+1 lookup reads them: the ordering the
+pserver round barrier used to provide, rebuilt from pure data flow.
+"""
+
+import jax.lax as lax
+
+from ..core.registry import register
+from ..parallel import collective
+
+
+def _tie(x, deps):
+    """Data-dependency barrier: make `x` depend on every token in `deps`
+    without changing its value (optimization_barrier outputs depend on
+    ALL inputs — XLA cannot reorder past it or elide the tokens)."""
+    if not deps:
+        return x
+    tied = lax.optimization_barrier(tuple([x] + list(deps)))
+    return tied[0]
+
+
+def _allreduce(ins, attrs, op):
+    x = _tie(ins["X"][0], ins.get("Deps", ()))
+    bound = collective.lowering_axis()
+    if bound is None:
+        # single-replica semantics: sum/mean over a world of one
+        return {"Out": [x]}
+    axis, _nranks = bound
+    want = attrs.get("axis_name")
+    if want and str(want) != axis:
+        raise ValueError(
+            "c_allreduce planned for axis %r but the collective trace "
+            "bound %r — transpile and run over the same mesh axis"
+            % (want, axis))
+    return {"Out": [collective.all_reduce(x, axis, op=op)]}
+
+
+@register("c_allreduce_sum")
+def _c_allreduce_sum(ctx, ins, attrs):
+    """Cross-replica gradient sum (c_allreduce_sum_op.cc analog)."""
+    return _allreduce(ins, attrs, "sum")
+
+
+@register("c_allreduce_mean")
+def _c_allreduce_mean(ctx, ins, attrs):
+    """Cross-replica gradient mean: each replica's grad is its local
+    shard-mean, so the mean across replicas IS the global-batch mean
+    gradient — the transpiler's default dense-grad rewrite (the pserver
+    path's scale-by-1/N-then-sum, fused into one collective)."""
+    return _allreduce(ins, attrs, "mean")

@@ -50,6 +50,45 @@ def _client_map(trainer_id):
     return get
 
 
+def _rank_clients(eps):
+    """Client memo for COLLECTIVE-mode sparse ops, keyed (endpoint,
+    rank): the logical trainer id is the mesh replica's axis_index — a
+    runtime value — so registration/heartbeat wiring happens inside the
+    host callback, once per (endpoint, rank).  The first call registers
+    this rank with EVERY endpoint (not just the ones its ids happen to
+    route to): the pserver's serve loop waits for a `complete` from each
+    registered rank, and a rank whose ids never hashed to some server
+    would otherwise leave that server waiting forever."""
+    cache = {}
+
+    def get(ep, rank):
+        key = (ep, int(rank))
+        cli = cache.get(key)
+        if cli is None:
+            from ..distributed.rpc import RPCClient
+
+            if not any(k[1] == int(rank) for k in cache):
+                from .. import distributed
+
+                for e in eps:
+                    distributed._note_endpoint(e, int(rank))
+            cli = cache[key] = RPCClient.get(ep)
+        return cli
+
+    return get
+
+
+def _replica_rank(fallback_id):
+    """Traced mesh rank of the current replica for collective-mode rpc
+    ops: lax.axis_index when the collective trace bound an axis, else the
+    static trainer id (single-replica degradation)."""
+    from ..parallel.collective import lowering_axis
+
+    bound = lowering_axis()
+    return (jax.lax.axis_index(bound[0]) if bound is not None
+            else jnp.int32(fallback_id))
+
+
 def _pipelined(trainer_id):
     """Like _client_map but for the windowed in-flight client (bucketed
     sends/gets); endpoint registration still runs once so completes and
@@ -507,43 +546,72 @@ def _recv_bucket(ctx, ins, attrs):
     return {"Out": list(outs)}
 
 
-@register("prefetch", no_grad_inputs={"Ids"}, side_effect=True)
+@register("prefetch", no_grad_inputs={"Ids", "Dep"}, side_effect=True)
 def _prefetch(ctx, ins, attrs):
     """Distributed embedding lookup (prefetch_op / split_ids / merge_ids
     analog): route each id to server id%nservers, fetch rows, merge back
     in input order.  Fixed id-array shape keeps XLA happy; routing is
-    host-side."""
+    host-side.
+
+    Collective (hybrid) mode: the op runs once per mesh REPLICA with
+    that replica's id shard; the logical trainer id is the replica's
+    axis_index (a runtime value fed into the callback).  The optional
+    ``Dep`` input — an allreduce-updated param the transpiler wires in —
+    orders this lookup after the PREVIOUS step's update, so every
+    replica's step-N sparse push has landed before any step-N+1 read."""
     ids = ins["Ids"][0]
     epmap = list(attrs["epmap"])
     table_names = list(attrs["table_names"])
     emb_dim = int(attrs["emb_dim"])
     trainer_id = int(attrs.get("trainer_id", 0))
+    collective = bool(attrs.get("collective"))
     n = len(epmap)
-    cli = _client_map(trainer_id)
 
     id_shape = tuple(ids.shape)
     out_shape = id_shape + (emb_dim,)
 
-    def host_prefetch(ids_v):
+    if collective:
+        cli_for = _rank_clients(epmap)
+    else:
+        _cli = _client_map(trainer_id)
+
+        def cli_for(ep, _tid):
+            return _cli(ep)
+
+    def host_prefetch(tid, ids_v):
+        """ONE routing core for both trainer-id sources: ids route to
+        server id%n, rows merge back in input order."""
         flat = np.asarray(ids_v).reshape(-1).astype(np.int64)
         out = np.zeros((flat.size, emb_dim), dtype=np.float32)
         for s in range(n):
             mask = (flat % n) == s
             if not mask.any():
                 continue
-            local = flat[mask] // n
-            rows = np.asarray(
-                cli(epmap[s]).prefetch(table_names[s], local, trainer_id)
-            )
+            rows = np.asarray(cli_for(epmap[s], tid).prefetch(
+                table_names[s], flat[mask] // n, tid))
             out[mask] = rows
         return out.reshape(out_shape)
 
-    out = io_callback(
-        host_prefetch,
-        jax.ShapeDtypeStruct(out_shape, jnp.float32),
-        ids,
-        ordered=True,
-    )
+    struct = jax.ShapeDtypeStruct(out_shape, jnp.float32)
+    if collective:
+        rank = _replica_rank(trainer_id)
+        deps = [v for v in ins.get("Dep", []) if v is not None]
+        if deps:
+            # ordering edge only: tie the (scalar) rank operand to the
+            # allreduce-updated param via an optimization barrier instead
+            # of shipping the whole param to the host as a dead callback
+            # operand — same happens-before, zero extra host traffic
+            from .collective_ops import _tie
+
+            rank = _tie(rank, deps)
+        out = io_callback(
+            lambda rank_v, ids_v: host_prefetch(
+                int(np.asarray(rank_v)), ids_v),
+            struct, rank, ids, ordered=True)
+    else:
+        out = io_callback(
+            lambda ids_v: host_prefetch(trainer_id, ids_v),
+            struct, ids, ordered=True)
     return {"Out": [out]}
 
 
@@ -551,27 +619,62 @@ def _prefetch(ctx, ins, attrs):
 def _send_sparse(ctx, ins, attrs):
     """Push sparse embedding grads (SelectedRows semantics): rows keyed by
     Ids go back to their owning server — applied at the round barrier in
-    sync mode, immediately in async (see ps_server._h_send_sparse)."""
+    sync mode, immediately in async (see ps_server._h_send_sparse).
+
+    ``wire_dtype='bfloat16'`` (stamped from the transpiler plan) ships
+    the row VALUES bf16-compressed under the versioned `h` array tag —
+    ids and row counts stay exact, the payload halves, and the codec
+    hands the server back the original dtype.  The fenced-replay record
+    keeps the already-wrapped rows, so a pserver restart re-ships
+    byte-identical chunks.
+
+    Collective (hybrid) mode: one push per mesh replica, logical trainer
+    id = the replica's axis_index (runtime value), applied per-arrival
+    server-side (the transpiler plans sync_mode=False — there is no
+    dense round barrier in the collective backend)."""
     ids, grad = ins["Ids"][0], ins["Grad"][0]
     epmap = list(attrs["epmap"])
     table_names = list(attrs["table_names"])
     trainer_id = int(attrs.get("trainer_id", 0))
     scale = float(attrs.get("scale", 1.0))
     sync_mode = bool(attrs.get("sync_mode", False))
+    collective = bool(attrs.get("collective"))
+    wire_dtype = str(attrs.get("wire_dtype") or "float32")
     n = len(epmap)
-    cli = _client_map(trainer_id)
 
-    def host_push(ids_v, grad_v):
+    def _wrap_rows(rows):
+        """Row values onto the planned wire: bf16 halves float payloads
+        (the PR 5 f32-only gap for sparse chunks); ids stay exact."""
+        if wire_dtype != "bfloat16" or rows.dtype.kind != "f" \
+                or not rows.size:
+            return rows
+        from ..distributed import rpc as _rpc
+
+        _rpc.note_bytes_saved(rows.nbytes - 2 * rows.size)
+        return _rpc.Bf16Wire(rows)
+
+    if collective:
+        cli_for = _rank_clients(epmap)
+    else:
+        _cli = _client_map(trainer_id)
+
+        def cli_for(ep, _tid):
+            return _cli(ep)
+
+    def host_push(tid, ids_v, grad_v):
+        """ONE routing core for both trainer-id sources: rows route to
+        server id%n.  sync_mode (never set on the collective plan — no
+        dense round exists there) additionally stamps step tokens and
+        records the chunk for incarnation-fenced replay."""
         flat = np.asarray(ids_v).reshape(-1).astype(np.int64)
         g = np.asarray(grad_v).reshape(flat.size, -1) * scale
         for s in range(n):
             mask = (flat % n) == s
             if not mask.any():
                 continue
-            local = flat[mask] // n
             ep = epmap[s]
-            kw = dict(table=table_names[s], ids=local, rows=g[mask],
-                      trainer_id=trainer_id)
+            kw = dict(table=table_names[s], ids=flat[mask] // n,
+                      rows=_wrap_rows(g[mask]), trainer_id=tid)
             if sync_mode:
                 # stamp the chunk with the UPCOMING dense step token
                 # (this training step's send_bucket mints step+1) and
@@ -587,13 +690,20 @@ def _send_sparse(ctx, ins, attrs):
                     st["sparse_step"] = step
                     st["sparse"] = {}
                 st["sparse"][table_names[s]] = kw
-            r = cli(ep).call("send_sparse", **kw)
-            _check_not_evicted(r, ep, trainer_id)
+            r = cli_for(ep, tid).call("send_sparse", **kw)
+            _check_not_evicted(r, ep, tid)
         return np.int32(0)
 
-    tok = io_callback(
-        host_push, jax.ShapeDtypeStruct((), jnp.int32), ids, grad, ordered=True
-    )
+    struct = jax.ShapeDtypeStruct((), jnp.int32)
+    if collective:
+        tok = io_callback(
+            lambda rank_v, ids_v, grad_v: host_push(
+                int(np.asarray(rank_v)), ids_v, grad_v),
+            struct, _replica_rank(trainer_id), ids, grad, ordered=True)
+    else:
+        tok = io_callback(
+            lambda ids_v, grad_v: host_push(trainer_id, ids_v, grad_v),
+            struct, ids, grad, ordered=True)
     return {"Out": [tok]}
 
 

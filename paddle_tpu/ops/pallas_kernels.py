@@ -26,13 +26,17 @@ def _interpret():
     return jax.default_backend() != "tpu"
 
 
-def _vma(*xs):
-    """Union of the inputs' varying-mesh-axes so pallas_call out_shapes
-    type-check inside shard_map (empty set outside)."""
-    out = frozenset()
-    for x in xs:
-        out = out | getattr(jax.typeof(x), "vma", frozenset())
-    return out
+def _sds(shape, dtype, *xs):
+    """ShapeDtypeStruct whose vma (varying-mesh-axes) is the union of the
+    inputs' — so pallas_call out_shapes type-check inside shard_map on
+    jax builds with vma tracking; builds without it (this sandbox's
+    0.4.x) take neither the kwarg nor the tracking, so plain structs."""
+    from ..parallel.mesh import vma_of
+
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return jax.ShapeDtypeStruct(shape, dtype)
+    return jax.ShapeDtypeStruct(shape, dtype, vma=vma_of(*xs))
 
 
 def _cdiv(a, b):
@@ -209,8 +213,8 @@ def _flash_fwd(q, k, v, kbias, causal, scale, block_q, block_k, window=0,
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, T, d), q.dtype, vma=_vma(q, k, v)),
-            jax.ShapeDtypeStruct((BH, 1, T), jnp.float32, vma=_vma(q, k, v)),
+            _sds((BH, T, d), q.dtype, q, k, v),
+            _sds((BH, 1, T), jnp.float32, q, k, v),
         ],  # lse is over q rows; k-side shapes use Tk
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
@@ -378,8 +382,7 @@ def _flash_bwd(q, k, v, kbias, o, lse, do, causal, scale, block_q, block_k,
         in_specs=smem + [q_spec_q, k_spec_q, k_spec_q, kb_spec_q]
         + seg_specs_q + [q_spec_q, row_spec_q, row_spec_q],
         out_specs=q_spec_q,
-        out_shape=jax.ShapeDtypeStruct((BH, T, d), q.dtype,
-                                       vma=_vma(q, k, v, do)),
+        out_shape=_sds((BH, T, d), q.dtype, q, k, v, do),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_interpret(),
     )(*(qoff_arg + [q, k, v, kb3] + seg_args + [do, lse3, delta3]))
@@ -404,10 +407,9 @@ def _flash_bwd(q, k, v, kbias, o, lse, do, causal, scale, block_q, block_k,
         + seg_specs_k + [q_spec_k, row_spec_k, row_spec_k],
         out_specs=[k_spec_k, k_spec_k, kb_spec_k],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, Tk, d), k.dtype, vma=_vma(q, k, v, do)),
-            jax.ShapeDtypeStruct((BH, Tk, d), v.dtype, vma=_vma(q, k, v, do)),
-            jax.ShapeDtypeStruct((BH, 1, Tk), jnp.float32,
-                                 vma=_vma(q, k, v, do)),
+            _sds((BH, Tk, d), k.dtype, q, k, v, do),
+            _sds((BH, Tk, d), v.dtype, q, k, v, do),
+            _sds((BH, 1, Tk), jnp.float32, q, k, v, do),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),

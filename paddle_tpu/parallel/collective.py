@@ -7,7 +7,9 @@ jax.distributed.initialize over DCN; the same env-var contract is honored so
 reference launch scripts keep working.
 """
 
+import contextlib
 import os
+import threading
 
 import jax
 
@@ -20,6 +22,8 @@ __all__ = [
     "barrier",
     "trainer_id",
     "num_trainers",
+    "collective_lowering",
+    "lowering_axis",
 ]
 
 
@@ -29,6 +33,18 @@ def trainer_id():
 
 def num_trainers():
     return int(os.environ.get("PADDLE_TRAINERS", os.environ.get("TRAINERS", 1)))
+
+
+def _enable_cpu_cross_process_collectives():
+    """Multi-process SPMD on the CPU backend needs an explicit
+    cross-process collectives implementation (gloo over TCP) — without it
+    XLA rejects the computation outright ("Multiprocess computations
+    aren't implemented on the CPU backend").  Must run BEFORE the backend
+    initializes; harmless on jax builds without the knob or on TPU."""
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (AttributeError, ValueError):  # pragma: no cover - jax version
+        pass
 
 
 def init_distributed_env(coordinator_address=None, num_processes=None, process_id=None):
@@ -46,6 +62,7 @@ def init_distributed_env(coordinator_address=None, num_processes=None, process_i
         process_id = trainer_id()
     if num_processes <= 1:
         return  # single-process: nothing to do
+    _enable_cpu_cross_process_collectives()
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
@@ -85,3 +102,35 @@ def broadcast(x, axis_name, src=0):
 
 def barrier(axis_name):
     jax.lax.psum(1, axis_name)
+
+
+# ---- collective-lowering context ----------------------------------------
+# The op registry's collective lowerings (ops/collective_ops.py
+# c_allreduce_*) need to know, AT TRACE TIME, whether a mesh axis is bound
+# around the traced step — psum over an unbound axis is a NameError, and a
+# transpiled collective program must still degrade to single-replica
+# semantics (allreduce == identity) when run on a plain executor.  The
+# collective run path (executor._run_collective) enters this context while
+# tracing the step under shard_map; lowering rules consult lowering_axis().
+# Thread-local: pserver threads in in-process tests trace their shard
+# programs concurrently with a collective trainer trace.
+_lowering_state = threading.local()
+
+
+@contextlib.contextmanager
+def collective_lowering(axis_name, nranks):
+    """Bind `axis_name` (size `nranks`) for collective op lowerings during
+    a trace.  Nesting replaces (the inner trace wins, e.g. a pserver-side
+    trace inside a host callback must NOT see the trainer's axis)."""
+    prev = getattr(_lowering_state, "axis", None)
+    _lowering_state.axis = (str(axis_name), int(nranks))
+    try:
+        yield
+    finally:
+        _lowering_state.axis = prev
+
+
+def lowering_axis():
+    """(axis_name, nranks) bound by the active collective trace, or None
+    when tracing outside a collective run (single-replica semantics)."""
+    return getattr(_lowering_state, "axis", None)

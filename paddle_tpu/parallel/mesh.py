@@ -5,7 +5,53 @@ import numpy as np
 import jax
 from jax.sharding import Mesh
 
-__all__ = ["make_mesh", "default_mesh", "mesh_axis_sizes"]
+import functools as _functools
+
+try:  # jax >= 0.5 promoted shard_map to the top level
+    from jax import shard_map as _shard_map
+
+    @_functools.wraps(_shard_map)
+    def shard_map(*args, **kwargs):
+        # the promoted API renamed check_rep -> check_vma; translate so
+        # callers written against either name work on both branches
+        if "check_rep" in kwargs:
+            kwargs["check_vma"] = kwargs.pop("check_rep")
+        return _shard_map(*args, **kwargs)
+except ImportError:  # pre-promotion home (this sandbox's jax 0.4.x)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    @_functools.wraps(_shard_map)
+    def shard_map(*args, **kwargs):
+        # the old replication checker predates vma tracking: it has no
+        # rule for pallas_call and rejects cond branches the new checker
+        # accepts, so bodies written against the promoted API need it off
+        kwargs.setdefault("check_rep", False)
+        kwargs.pop("check_vma", None)
+        return _shard_map(*args, **kwargs)
+
+__all__ = ["make_mesh", "default_mesh", "mesh_axis_sizes", "dp_mesh",
+           "shard_map", "vma_of", "pcast_varying"]
+
+
+def vma_of(*xs):
+    """Union of the inputs' varying-mesh-axes.  ``jax.typeof``/vma
+    tracking is a newer-jax API; on builds without it (this sandbox's
+    0.4.x) nothing is tracked and the set is empty."""
+    typeof = getattr(jax, "typeof", None)
+    out = frozenset()
+    if typeof is None:
+        return out
+    for x in xs:
+        out = out | getattr(typeof(x), "vma", frozenset())
+    return out
+
+
+def pcast_varying(v, axes):
+    """``jax.lax.pcast(v, axes, to="varying")`` where available; identity
+    on jax builds without vma tracking (old shard_map's check_rep model
+    needs no explicit cast for a value to be device-varying)."""
+    pcast = getattr(jax.lax, "pcast", None)
+    return pcast(v, axes, to="varying") if pcast is not None else v
 
 
 def make_mesh(axes, devices=None):
@@ -32,3 +78,20 @@ def default_mesh(axis_name="dp"):
 
 def mesh_axis_sizes(mesh):
     return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+def dp_mesh(nranks, axis_name="dp"):
+    """Data-parallel mesh for the collective dist backend: exactly
+    `nranks` devices on one axis, spanning processes when jax.distributed
+    is initialized (one device per trainer process) or local virtual
+    devices for single-process CPU CI.  Fails loudly on a device deficit
+    — a silent smaller mesh would hang the psum rendezvous."""
+    devices = jax.devices()
+    if len(devices) < nranks:
+        raise ValueError(
+            "collective mode needs %d devices for the %r mesh, but jax "
+            "sees %d — launch %d processes (init_collective) or set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=%d for a "
+            "single-process CPU mesh"
+            % (nranks, axis_name, len(devices), nranks, nranks))
+    return make_mesh({axis_name: nranks}, devices=devices[:nranks])

@@ -126,7 +126,7 @@ def switch_moe(expert_fn, mesh, axis="ep", capacity_factor=1.0, top_k=1):
             dropped = jax.lax.pmean(dropped, axis)
             return yl, aux, dropped
 
-        from jax import shard_map
+        from .mesh import shard_map
 
         spec_params = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
         y, aux, dropped = shard_map(

@@ -88,7 +88,9 @@ def gpipe(stage_fn, mesh, axis="pp", n_microbatches=None,
             # the scan carry crosses ppermute, so its type is
             # device-varying over `axis`; the stable shard_map tracks this
             # in types — cast the replicated init to varying to match
-            init = jax.lax.pcast(zero, axis, to="varying")
+            from .mesh import pcast_varying
+
+            init = pcast_varying(zero, axis)
             _, emitted = jax.lax.scan(tick, init, jnp.arange(ticks))
             # emitted: [ticks, mb, ...]; microbatch m sits at tick m+S-1
             ym = emitted[S - 1 :]
@@ -101,7 +103,7 @@ def gpipe(stage_fn, mesh, axis="pp", n_microbatches=None,
             # the global batch order once batch_axis concatenation applies
             return ym
 
-        from jax import shard_map
+        from .mesh import shard_map
 
         if param_specs is not None:
             for spec in jax.tree_util.tree_leaves(
@@ -173,9 +175,11 @@ def one_f_one_b(stage_fn, loss_fn, mesh, axis="pp", n_microbatches=None):
             bwd_perm = [(i, (i - 1) % S) for i in range(S)]
 
             def vary(v):
-                if axis in getattr(jax.typeof(v), "vma", frozenset()):
+                from .mesh import pcast_varying, vma_of
+
+                if axis in vma_of(v):
                     return v  # already device-varying (e.g. from params)
-                return jax.lax.pcast(v, axis, to="varying")
+                return pcast_varying(v, axis)
 
             grad0 = jax.tree_util.tree_map(jnp.zeros_like, params)
             act_buf0 = jnp.zeros((buf_n,) + zero.shape, zero.dtype)
@@ -232,7 +236,7 @@ def one_f_one_b(stage_fn, loss_fn, mesh, axis="pp", n_microbatches=None):
             grads = jax.tree_util.tree_map(lambda g: g[None], grad_acc)
             return loss, grads
 
-        from jax import shard_map
+        from .mesh import shard_map
 
         spec_params = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
         return shard_map(

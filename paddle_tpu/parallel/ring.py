@@ -92,15 +92,16 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None,
     flash = _use_flash(t_local, use_flash)
     q_pos = my * t_local + jnp.arange(t_local)  # global positions of local q
     # device-varying types for anything a cond/scan branch must produce
-    vma = tuple(getattr(jax.typeof(q), "vma", frozenset()) | {axis_name})
+    from .mesh import pcast_varying, vma_of
+
+    vma = tuple(vma_of(q) | {axis_name})
 
     def skip_piece():
         """A chunk contributing nothing: lse = -1e30 washes out of the
         merge."""
-        return (jax.lax.pcast(jnp.zeros(q.shape, jnp.float32), vma,
-                              to="varying"),
-                jax.lax.pcast(jnp.full(q.shape[:-1], _NEG, jnp.float32),
-                              vma, to="varying"))
+        return (pcast_varying(jnp.zeros(q.shape, jnp.float32), vma),
+                pcast_varying(jnp.full(q.shape[:-1], _NEG, jnp.float32),
+                              vma))
 
     def piece(k_blk, v_blk, src):
         """(o, lse) of local q vs the chunk originating at rank `src`."""
@@ -169,9 +170,9 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None,
     # mark the accumulators device-varying over every axis the inputs vary
     # on (the ring axis, plus e.g. a dp axis on a composite mesh) so the
     # scan carry type matches the body output under shard_map
-    o0 = jax.lax.pcast(jnp.zeros(q.shape, jnp.float32), vma, to="varying")
-    lse0 = jax.lax.pcast(
-        jnp.full(q.shape[:-1], -jnp.inf, jnp.float32), vma, to="varying")
+    o0 = pcast_varying(jnp.zeros(q.shape, jnp.float32), vma)
+    lse0 = pcast_varying(
+        jnp.full(q.shape[:-1], -jnp.inf, jnp.float32), vma)
     (_, _, o_f, _), _ = jax.lax.scan(
         step, (k, v, o0, lse0), jnp.arange(n))
     return o_f.astype(q.dtype)
@@ -181,7 +182,7 @@ def ring_attention_sharded(q, k, v, mesh, axis_name="sp", causal=False,
                            use_flash=None, window=0):
     """Convenience wrapper: shard q/k/v over `axis_name` on the time dim and
     run ring_attention under shard_map.  q,k,v: [B, H, T, D] global."""
-    from jax import shard_map
+    from .mesh import shard_map
 
     spec = P(None, None, axis_name, None)
 

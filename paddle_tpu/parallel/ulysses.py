@@ -78,7 +78,7 @@ def ulysses_attention_sharded(q, k, v, mesh, axis_name="sp", causal=False,
                               window=0):
     """Convenience wrapper mirroring ring_attention_sharded: q/k/v
     [B, H, T, D] global, sharded over `axis_name` on the time dim."""
-    from jax import shard_map
+    from .mesh import shard_map
 
     spec = P(None, None, axis_name, None)
 

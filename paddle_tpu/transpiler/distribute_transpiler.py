@@ -38,7 +38,16 @@ class DistributeTranspilerConfig:
     # not by position (RoundRobin / HashName stay selectable)
     split_method = SizeWeighted
     min_block_size = 8192
-    mode = "pserver"  # "pserver" | "nccl2"
+    # "pserver": dense+sparse round-trip through parameter servers;
+    # "nccl2": program unchanged, layout recorded for init_collective;
+    # "collective": dense grad sync lowers INTO the compiled step as
+    #   c_allreduce_* ops over a parallel/mesh dp mesh (no pserver in the
+    #   dense path), while sparse/embedding traffic — when the model has
+    #   distributed lookup tables — keeps the pserver (hybrid mode)
+    mode = "pserver"  # "pserver" | "nccl2" | "collective"
+    # mesh axis the collective mode's allreduces ride (executor binds the
+    # same axis when it runs the program over the dp mesh)
+    collective_axis = "dp"
     print_log = False
     # byte cap per coalesced comm bucket; None defers to
     # FLAGS_comm_bucket_bytes, 0 restores per-variable send/recv ops
@@ -151,13 +160,44 @@ class DistributeTranspiler:
             self.pserver_endpoints = list(pservers)
 
         if self.config.mode == "nccl2":
-            # collective mode: program unchanged; record layout for
+            # layout-only mode: program unchanged; record layout for
             # distributed.init_collective (gen_nccl_id handshake analog is
             # jax.distributed.initialize over DCN)
             self.nccl2_trainer_endpoints = self.pserver_endpoints
             return
 
+        self._resolve_comm_config()
+        if self.config.mode == "collective":
+            self._transpile_collective_mode()
+            return
+
         self._transpile_pserver_mode()
+
+    def _resolve_comm_config(self):
+        """Resolve the wire-compression knobs ONCE, up front: every role
+        (trainer bucket ops, sparse send ops, pserver replies via the
+        request's declaration) must agree on the wire form for this job,
+        and sparse rewrites run before the dense tail is planned."""
+        from ..flags import get_flag as _gf
+
+        bucket_bytes = self.config.comm_bucket_bytes
+        if bucket_bytes is None:
+            bucket_bytes = _gf("comm_bucket_bytes")
+        self.comm_bucket_bytes = int(bucket_bytes)
+        wire_dtype = self.config.comm_wire_dtype
+        if wire_dtype is None:
+            wire_dtype = _gf("comm_wire_dtype")
+        wire_dtype = str(wire_dtype)
+        if wire_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                "comm_wire_dtype must be 'float32' or 'bfloat16', got %r "
+                "(int8 grads are the separate FLAGS_comm_grad_int8 gate)"
+                % (wire_dtype,))
+        self.comm_wire_dtype = wire_dtype
+        grad_int8 = self.config.comm_grad_int8
+        if grad_int8 is None:
+            grad_int8 = _gf("comm_grad_int8")
+        self.comm_grad_int8 = bool(grad_int8)
 
     # ------------------------------------------------------------------
     def _params_grads_from_roles(self):
@@ -205,6 +245,7 @@ class DistributeTranspiler:
             if op.type == "lookup_table" and op.attrs.get("is_distributed"):
                 tables.add(op.inputs["W"][0])
         self.sparse_tables = {}
+        self.sparse_token_vars = []
         if not tables:
             return
 
@@ -282,6 +323,12 @@ class DistributeTranspiler:
                 "opt": opt,
             }
 
+        # collective (hybrid) mode: the pserver carries ONLY sparse
+        # traffic, applied per-arrival (async semantics — there is no
+        # dense round whose barrier could trigger a merged apply), and
+        # the rpc ops run per mesh REPLICA (dynamic trainer rank from
+        # lax.axis_index instead of the static process-wide id)
+        hybrid = self.config.mode == "collective"
         new_ops = []
         for op in block.ops:
             if (
@@ -300,6 +347,7 @@ class DistributeTranspiler:
                         "table_names": info["shards"],
                         "emb_dim": info["emb_dim"],
                         "trainer_id": self.trainer_id,
+                        "collective": hybrid,
                         "op_role": "rpc",
                     },
                 )
@@ -326,8 +374,15 @@ class DistributeTranspiler:
                         "trainer_id": self.trainer_id,
                         "scale": 1.0 / float(self.trainer_num),
                         # sync rounds fence sparse chunks with the dense
-                        # step token for restart replay (dist_ops)
-                        "sync_mode": self.sync_mode,
+                        # step token for restart replay (dist_ops); the
+                        # hybrid collective path has no dense rounds, so
+                        # its sparse chunks apply on arrival
+                        "sync_mode": self.sync_mode and not hybrid,
+                        "collective": hybrid,
+                        # sparse row VALUES ride the planned wire dtype
+                        # (ids/rows counts stay exact; bf16 halves the
+                        # value payload — PR 5's documented f32-only gap)
+                        "wire_dtype": self.comm_wire_dtype,
                         "op_role": "rpc",
                     },
                 )
@@ -336,6 +391,7 @@ class DistributeTranspiler:
                     "Grad": list(op.inputs["Out@GRAD"]),
                 }
                 ss.outputs = {"Out": [dummy.name]}
+                self.sparse_token_vars.append(dummy.name)
                 new_ops.append(ss)
             elif (
                 op.attrs.get("op_role") == "optimize"
@@ -395,33 +451,9 @@ class DistributeTranspiler:
         # bucketed path (default): one size-capped coalesced frame per
         # bucket per pserver + windowed in-flight RPC, instead of one
         # round trip per variable.  comm_bucket_bytes=0 (config or flag)
-        # restores the legacy per-var send/recv ops.
-        bucket_bytes = self.config.comm_bucket_bytes
-        if bucket_bytes is None:
-            from ..flags import get_flag
-
-            bucket_bytes = get_flag("comm_bucket_bytes")
-        self.comm_bucket_bytes = int(bucket_bytes)
-        # compression metadata riding the bucket plan: resolved HERE so
-        # every role (trainer ops, pserver replies via the request's
-        # declaration) agrees on the wire form for this job
-        from ..flags import get_flag as _gf
-
-        wire_dtype = self.config.comm_wire_dtype
-        if wire_dtype is None:
-            wire_dtype = _gf("comm_wire_dtype")
-        wire_dtype = str(wire_dtype)
-        if wire_dtype not in ("float32", "bfloat16"):
-            raise ValueError(
-                "comm_wire_dtype must be 'float32' or 'bfloat16', got %r "
-                "(int8 grads are the separate FLAGS_comm_grad_int8 gate)"
-                % (wire_dtype,))
-        self.comm_wire_dtype = wire_dtype
-        grad_int8 = self.config.comm_grad_int8
-        if grad_int8 is None:
-            grad_int8 = _gf("comm_grad_int8")
-        self.comm_grad_int8 = bool(grad_int8)
-
+        # restores the legacy per-var send/recv ops.  Wire-compression
+        # metadata (comm_wire_dtype / comm_grad_int8) was resolved by
+        # _resolve_comm_config before the sparse rewrite ran.
         with self.origin_program._op_role_guard("rpc"):
             scaled_names = []
             for p, g in self.params_grads:
@@ -531,6 +563,108 @@ class DistributeTranspiler:
                     outputs={"Out": [tok.name]},
                     attrs={"endpoints": eps, "trainer_id": self.trainer_id},
                 )
+        self.origin_program._bump_version()
+
+    # ------------------------------------------------------------------
+    def _transpile_collective_mode(self):
+        """Collective data-parallel rewrite: dense gradient sync lowers
+        INTO the compiled step as one ``c_allreduce_mean`` per dense grad
+        (inserted between the ``*_grad`` output and the optimizer ops,
+        which STAY on the trainer — every mesh replica applies the same
+        averaged update to its replicated params), so XLA overlaps the
+        all-reduce with backward compute and no Python runs in the dense
+        grad path.  Hybrid: distributed lookup tables keep the pserver
+        (prefetch / send_sparse exactly as today, applied per-arrival);
+        their rows never ride the mesh.
+
+        Replica semantics: each mesh shard is one logical trainer — it
+        computes its shard-mean loss/grads, so the allreduce MEAN is the
+        global-batch mean gradient (the pserver path's scale-by-1/N-then-
+        sum, fused into one collective).  ``trainers`` is the mesh size.
+
+        Hybrid ordering (no round barrier exists to provide it): each
+        allreduce consumes the step's sparse send tokens via ``Deps``
+        (the psum rendezvous then waits for every replica's sparse push),
+        and each prefetch gains a ``Dep`` input on an allreduce-updated
+        param — so step N's sparse rows are all on the pserver before any
+        replica's step-N+1 lookup reads them, from pure data flow."""
+        block = self.origin_program.global_block()
+        self._handle_distributed_lookup()
+        if self.sparse_tables and not self.pserver_endpoints:
+            raise ValueError(
+                "collective mode found distributed lookup tables %s but "
+                "no pserver endpoints — hybrid mode keeps sparse traffic "
+                "on pservers; pass pservers= (or drop is_distributed)"
+                % sorted(self.sparse_tables))
+        self.params_grads = self._params_grads_from_roles()
+        if not self.params_grads:
+            raise ValueError(
+                "no dense optimizer ops found — call "
+                "optimizer.minimize(loss) before transpile()"
+            )
+        # scheduled-lr sparse tables need the pserver-side lr_program,
+        # which only dense rounds trigger — not available in hybrid mode
+        for w, info in sorted(getattr(self, "sparse_tables", {}).items()):
+            opt = info.get("opt") or {}
+            if opt.get("lr_name") and info.get("lr") is None:
+                raise NotImplementedError(
+                    "hybrid collective mode cannot drive table %r's "
+                    "SCHEDULED sparse learning rate: the pserver applies "
+                    "rows per-arrival and runs no lr program (no dense "
+                    "rounds) — use a constant lr for is_distributed "
+                    "embeddings under mode='collective'" % w)
+
+        axis = str(self.config.collective_axis)
+        self.collective_axis = axis
+        self.collective_nranks = int(self.trainer_num)
+        tokens = list(getattr(self, "sparse_token_vars", []))
+        new_ops, inserted = [], False
+        grad_names = {g for _p, g in self.params_grads}
+        allreduce_ops = []
+        for p, g in self.params_grads:
+            ar = framework.Operator(
+                block, "c_allreduce_mean", None, None,
+                {"axis_name": axis,
+                 "nranks": self.collective_nranks,
+                 "op_role": "backward",
+                 "op_role_var": [p, g]},
+            )
+            # in-place on the grad var: every later reader (the
+            # optimizer ops; grad clip ran earlier) sees the
+            # cross-replica mean
+            ar.inputs = {"X": [g]}
+            if tokens:
+                ar.inputs["Deps"] = tokens
+            ar.outputs = {"Out": [g]}
+            allreduce_ops.append(ar)
+        for op in block.ops:
+            if not inserted and op.attrs.get("op_role") == "optimize":
+                new_ops.extend(allreduce_ops)
+                inserted = True
+            if (op.type == "prefetch" and op.attrs.get("collective")
+                    and self.params_grads):
+                # cross-step edge: the lookup waits for the previous
+                # step's (allreduce-gated) param update on this replica
+                op.inputs["Dep"] = [self.params_grads[0][0]]
+            new_ops.append(op)
+        if not inserted:  # defensive: optimize role guaranteed above
+            new_ops.extend(allreduce_ops)
+        # sanity: every grad the rewrite targets is actually produced by
+        # the ORIGINAL ops — the in-place allreduces are excluded, or the
+        # check would see their own Out and could never fire
+        produced = set()
+        for op in block.ops:
+            produced.update(op.output_arg_names())
+        missing = sorted(grad_names - produced)
+        if missing:
+            raise RuntimeError(
+                "collective rewrite: grads %s are consumed by optimizer "
+                "ops but never produced" % missing)
+        block.ops = new_ops
+        # the executor keys its collective run path off this marker (the
+        # mesh axis it must bind with shard_map around the traced step)
+        self.origin_program._collective = {
+            "axis": axis, "nranks": self.collective_nranks}
         self.origin_program._bump_version()
 
     # ------------------------------------------------------------------
@@ -683,6 +817,8 @@ class DistributeTranspiler:
 
     def get_pserver_program(self, endpoint):
         """Program with one listen_and_serv op for this endpoint."""
+        if self.config.mode == "collective":
+            return self._collective_pserver_program(endpoint)
         opt_by_param = {}
         for op in self.optimize_ops:
             rv = op.attrs.get("op_role_var")
@@ -768,6 +904,46 @@ class DistributeTranspiler:
                 "grad_to_shard": grad_to_shard,
                 "slice_plan": slice_plan,
                 "whole_vars": sorted(whole_vars),
+                "sparse_tables": sparse_specs,
+            },
+        )
+        return prog
+
+    # ------------------------------------------------------------------
+    def _collective_pserver_program(self, endpoint):
+        """Hybrid collective pserver: SPARSE shards only.  Dense params
+        never leave the mesh, so the program carries no optimize shard
+        programs, no slice plan, and runs the service in per-arrival
+        (async) application mode — there is no dense round whose barrier
+        could trigger a merged apply.  Each mesh replica registers as its
+        own logical trainer (rank = lax.axis_index), so `trainers` is the
+        mesh size and the serve loop terminates when every replica
+        completes."""
+        if not getattr(self, "sparse_tables", None):
+            raise ValueError(
+                "collective mode has no pserver role for %s: the model "
+                "has no distributed lookup tables, so every gradient "
+                "rides the mesh — launch without pservers" % endpoint)
+        server_idx = self.pserver_endpoints.index(endpoint)
+        n_servers = len(self.pserver_endpoints)
+        sparse_specs = [
+            [info["shards"][server_idx], w, server_idx, n_servers,
+             info["lr"], info.get("opt")]
+            for w, info in sorted(self.sparse_tables.items())
+        ]
+        prog = Program()
+        b = prog.global_block()
+        b.append_op(
+            "listen_and_serv",
+            attrs={
+                "endpoint": endpoint,
+                "trainers": self.collective_nranks,
+                "sync_mode": False,
+                "optimize_programs": [],
+                "lr_program": None,
+                "grad_to_shard": {},
+                "slice_plan": [],
+                "whole_vars": [],
                 "sparse_tables": sparse_specs,
             },
         )

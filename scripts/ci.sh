@@ -45,6 +45,16 @@ else
         tests/test_rpc_wire.py tests/test_dist_transpiler.py -q -m ""
 fi
 
+echo "== collective-backend pass (2-device CPU mesh) =="
+# the collective dense-grad backend must hold its parity story on the
+# MINIMAL mesh (2 virtual devices, not the suite's 8): bit-exact dense
+# trajectory, hybrid sparse parity, zero dense rpc.  -m "" also runs the
+# slow-marked hybrid tests tier-1's time budget keeps out.  Runs before
+# the orphaned-child check so leaked cluster children fail the build.
+XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+    python -m pytest tests/test_dist_transpiler.py -q -m "" \
+    -k "collective or hybrid"
+
 echo "== orphaned-child check =="
 # chaos tests SIGKILL cluster children; a leaked pserver/trainer would
 # keep ports + fds alive and poison later runs — fail fast instead

@@ -176,6 +176,31 @@ def ep_leg(n):
     return STEPS / dt
 
 
+def _watch_trainer(proc, steps, who):
+    """Time trainer-0 stdout from its first STEP line to LOSSES (excludes
+    startup + compile; measures the steady-state loop); returns
+    (steps/sec, COUNTERS dict)."""
+    t_first, saw_losses, counters = None, False, None
+    for line in proc.stdout:
+        if line.startswith("STEP ") and t_first is None:
+            t_first = time.time()
+        if line.startswith("COUNTERS "):
+            import json
+
+            counters = json.loads(line[len("COUNTERS "):])
+        if line.startswith("LOSSES"):
+            saw_losses = True
+            break
+    if t_first is None or not saw_losses:
+        raise RuntimeError(
+            "%s: trainer 0 %s (crashed mid-run?)" % (
+                who,
+                "emitted no STEP line" if t_first is None
+                else "died before its LOSSES line"))
+    dt = time.time() - t_first
+    return (steps - 1) / max(dt, 1e-9), counters
+
+
 def pserver_leg(n_trainers=2, n_pservers=2, steps=12):
     """REAL multi-process pserver throughput (VERDICT r4 #8): spawn
     n_pservers VarServer + n_trainers trainer subprocesses on localhost
@@ -235,34 +260,48 @@ def pserver_leg(n_trainers=2, n_pservers=2, steps=12):
         trainers = [spawn({"PADDLE_TRAINING_ROLE": "TRAINER",
                            "PADDLE_TRAINER_ID": str(i)}, capture=(i == 0))
                     for i in range(n_trainers)]
-        # time from first STEP line to trainer exit: excludes startup +
-        # compile, measures the steady-state round loop
-        t_first, saw_losses, counters = None, False, None
-        for line in trainers[0].stdout:
-            if line.startswith("STEP ") and t_first is None:
-                t_first = time.time()
-            if line.startswith("COUNTERS "):
-                import json
-
-                counters = json.loads(line[len("COUNTERS "):])
-            if line.startswith("LOSSES"):
-                saw_losses = True
-                break
-        if t_first is None or not saw_losses:
-            raise RuntimeError(
-                "pserver_leg: trainer 0 %s (crashed mid-run?)" % (
-                    "emitted no STEP line" if t_first is None
-                    else "died before its LOSSES line"))
-        dt = time.time() - t_first
+        rate, counters = _watch_trainer(trainers[0], steps, "pserver_leg")
         for t in trainers:
             t.wait(timeout=120)
         for ps in pservers:
             ps.wait(timeout=90)
-        return (steps - 1) / max(dt, 1e-9), counters
+        return rate, counters
     finally:
         for proc in pservers + trainers:
             if proc.poll() is None:
                 proc.kill()
+
+
+def collective_leg(n_devices=2, steps=12):
+    """Collective dense-gradient backend (DistributeTranspiler
+    mode="collective") on the SAME dist MLP workload: one trainer
+    process hosting an n-device virtual CPU mesh, dense grad sync as an
+    in-step c_allreduce — zero RPC round trips — so the pserver and
+    collective backends A/B on one curve."""
+    import subprocess
+
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    runner = os.path.join(here, "tests", "dist_mlp.py")
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+        "PADDLE_TRAINING_ROLE": "TRAINER",
+        "DIST_MODE": "collective",
+        "DIST_COLLECTIVE_DEVICES": str(n_devices),
+        "DIST_STEPS": str(steps),
+    })
+    env.pop("PADDLE_PSERVER_EPS", None)
+    env.pop("PADDLE_TRAINER_ENDPOINTS", None)
+    proc = subprocess.Popen(
+        [sys.executable, runner], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+    try:
+        rate, counters = _watch_trainer(proc, steps, "collective_leg")
+        proc.wait(timeout=120)
+        return rate, counters
+    finally:
+        if proc.poll() is None:
+            proc.kill()
 
 
 def main():
@@ -293,6 +332,16 @@ def main():
               % (counters.get("wire_dtype", "float32"), bps / 1024.0,
                  counters.get("comm_bytes_saved", 0) / 1024.0),
               flush=True)
+    # the A/B: SAME workload, dense grads over the mesh instead of rpc
+    co_rate, co_counters = collective_leg(n_devices=2, steps=ps_steps)
+    print("collective mode (in-step c_allreduce over a 2-device CPU "
+          "mesh): %.2f steps/s" % co_rate, flush=True)
+    if co_counters:
+        print("collective trainer comm: %.1f bytes/step sent, "
+              "rpc_round_trips=%d (dense grads never leave the "
+              "compiled step)"
+              % (co_counters.get("bytes_per_step", 0.0),
+                 co_counters.get("rpc_round_trips", 0)), flush=True)
 
 
 if __name__ == "__main__":

@@ -11,6 +11,14 @@ Env contract (fluid_benchmark.py:63-100 analog):
   PADDLE_CURRENT_ENDPOINT (pserver role)
   PADDLE_TRAINERS, PADDLE_TRAINER_ID
   DIST_SYNC_MODE = 1|0, DIST_STEPS, DIST_BATCH
+  DIST_MODE = pserver (default) | collective — collective lowers dense
+    grad sync into the compiled step (c_allreduce over the dp mesh, no
+    pserver round trip for dense params); multi-process when launched
+    with PADDLE_TRAINER_ENDPOINTS (one device per process via
+    jax.distributed), else a single-process CPU mesh of
+    DIST_COLLECTIVE_DEVICES (default 2) virtual devices.  With
+    DIST_MODEL=sparse the run is HYBRID: embedding rows still ride the
+    pserver (PADDLE_PSERVER_EPS), dense grads ride the mesh.
 """
 
 import json
@@ -19,6 +27,20 @@ import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+_COLLECTIVE = os.environ.get("DIST_MODE") == "collective"
+_TRAINER_EPS = [e for e in os.environ.get(
+    "PADDLE_TRAINER_ENDPOINTS", "").split(",") if e.strip()]
+if _COLLECTIVE and os.environ.get("PADDLE_TRAINING_ROLE") != "PSERVER":
+    # device topology must be pinned BEFORE jax loads: multi-process runs
+    # put ONE device in each trainer process (the mesh spans processes);
+    # a single process hosts the whole mesh as virtual CPU devices
+    _n_dev = (1 if len(_TRAINER_EPS) > 1
+              else int(os.environ.get("DIST_COLLECTIVE_DEVICES", "2")))
+    _flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+              if not f.startswith("--xla_force_host_platform_device_count")]
+    _flags.append("--xla_force_host_platform_device_count=%d" % _n_dev)
+    os.environ["XLA_FLAGS"] = " ".join(_flags)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -132,14 +154,23 @@ def main():
         print("LOSSES " + json.dumps(losses))
         return
 
+    collective = os.environ.get("DIST_MODE") == "collective"
+    # collective mode: one logical trainer per mesh replica — processes
+    # when launched multi-process (one device each), virtual CPU devices
+    # when single-process
+    nranks = (len(_TRAINER_EPS) if len(_TRAINER_EPS) > 1
+              else int(os.environ.get("DIST_COLLECTIVE_DEVICES", "2")))
+
     config = fluid.DistributeTranspilerConfig()
     config.min_block_size = 4  # tiny model: force splitting across servers
+    if collective:
+        config.mode = "collective"
     t = fluid.DistributeTranspiler(config=config)
     t.transpile(
         trainer_id,
         program=main_prog,
         pservers=eps,
-        trainers=trainers,
+        trainers=nranks if collective else trainers,
         sync_mode=sync_mode,
     )
 
@@ -156,9 +187,19 @@ def main():
 
     # TRAINER
     trainer_prog = t.get_trainer_program()
+    if collective and len(_TRAINER_EPS) > 1:
+        # mesh spans processes: rank 0's endpoint coordinates
+        from paddle_tpu import distributed as _dist
+
+        _dist.init_collective()
     exe.run(fluid.default_startup_program())
-    # this trainer's shard of the global batch
-    shard = batch // trainers
+    # this PROCESS's shard of the global batch (collective single-process
+    # runs feed the whole batch; the executor splits it over the mesh)
+    if collective:
+        nproc = max(1, len(_TRAINER_EPS))
+        shard = batch // nproc
+    else:
+        shard = batch // trainers
     lo, hi = trainer_id * shard, (trainer_id + 1) * shard
     step_sleep = float(os.environ.get("DIST_STEP_SLEEP", "0"))
     # chaos hook (tests/test_fault_tolerance.py): SIGKILL this rank after

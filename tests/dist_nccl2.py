@@ -56,7 +56,7 @@ def main():
 
     from jax.sharding import Mesh, PartitionSpec as P
 
-    from jax import shard_map
+    from paddle_tpu.parallel.mesh import shard_map
 
     mesh = Mesh(np.array(jax.devices()), ("dp",))
 

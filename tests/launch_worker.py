@@ -34,7 +34,7 @@ def main():
     nproc = int(os.environ["PADDLE_TRAINERS"])
     assert jax.process_count() == nproc, jax.process_count()
 
-    from jax import shard_map
+    from paddle_tpu.parallel.mesh import shard_map
 
     from jax.sharding import NamedSharding
 

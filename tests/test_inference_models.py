@@ -37,6 +37,7 @@ def _op_types(program):
     return [op.type for op in program.global_block().ops]
 
 
+@pytest.mark.slow  # full-train/full-model integration pass (tens of seconds on this 2-core sandbox); rides scripts/ci.sh --full — the fast lane must finish inside tier-1's time budget
 def test_analyzer_resnet50(tmp_path, capsys):
     """analyzer_resnet50_tester.cc:25 cycle on the in-repo ResNet-50:
     2 train steps → save_inference_model → AnalysisConfig (conv+bn fold
@@ -81,6 +82,7 @@ def test_analyzer_resnet50(tmp_path, capsys):
     assert ms > 0
 
 
+@pytest.mark.slow  # full-train/full-model integration pass (tens of seconds on this 2-core sandbox); rides scripts/ci.sh --full — the fast lane must finish inside tier-1's time budget
 def test_analyzer_resnet50_c_abi(tmp_path):
     """The same saved ResNet-50 served from C through the inference ABI
     (inference/capi demo_ci role): outputs must match the Python
@@ -127,6 +129,7 @@ def test_analyzer_resnet50_c_abi(tmp_path):
                                atol=1e-5)
 
 
+@pytest.mark.slow  # full-train/full-model integration pass (tens of seconds on this 2-core sandbox); rides scripts/ci.sh --full — the fast lane must finish inside tier-1's time budget
 def test_analyzer_transformer_encoder(tmp_path, capsys):
     """Transformer-encoder analyzer cycle (analyzer_* role for the
     attention stack): train a 2-layer encoder classifier, save, load via

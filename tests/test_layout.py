@@ -175,6 +175,7 @@ def test_depthwise_and_ceil_pool_nhwc_parity():
     np.testing.assert_allclose(run(True), run(False), rtol=1e-6)
 
 
+@pytest.mark.slow  # full-train/full-model integration pass (tens of seconds on this 2-core sandbox); rides scripts/ci.sh --full — the fast lane must finish inside tier-1's time budget
 def test_nhwc_grouped_conv_se_resnext_parity():
     """The pass generalizes past plain convs: se_resnext's grouped convs
     (cardinality), SE squeeze (global pool -> fc -> scale) and ceil-mode

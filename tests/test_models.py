@@ -51,6 +51,7 @@ class TinyHP(tfm.ModelHyperParams):
     dropout = 0.1
 
 
+@pytest.mark.slow  # full-train/full-model integration pass (tens of seconds on this 2-core sandbox); rides scripts/ci.sh --full — the fast lane must finish inside tier-1's time budget
 def test_transformer_trains():
     main, startup, feeds, fetches = tfm.wmt_transformer_program(
         TinyHP, src_len=8, trg_len=8, warmup_steps=10
@@ -254,6 +255,7 @@ def test_bert_fused_attention_matches_dense():
     np.testing.assert_allclose(fused, dense, rtol=2e-3, atol=2e-4)
 
 
+@pytest.mark.slow  # full-train/full-model integration pass (tens of seconds on this 2-core sandbox); rides scripts/ci.sh --full — the fast lane must finish inside tier-1's time budget
 def test_gpt2_trains():
     """Tiny GPT-2 causal LM trains (fused causal attention, no mask
     tensor in the program)."""
@@ -940,6 +942,7 @@ def test_transformer_sample_translate_cached():
         assert (a[:, 0] == 1).all() and (a >= 0).all() and (a < 30).all()
 
 
+@pytest.mark.slow  # full-train/full-model integration pass (tens of seconds on this 2-core sandbox); rides scripts/ci.sh --full — the fast lane must finish inside tier-1's time budget
 def test_resnet_preprocess_model_trains_uint8():
     """resnet_with_preprocess matrix cell: uint8 HWC feed, in-graph
     random_crop/cast/transpose/normalize, loss moves; the uint8 bytes
@@ -1222,6 +1225,7 @@ def test_gpt2_tied_embeddings_trains_and_decodes():
         np.testing.assert_array_equal(out, ref)
 
 
+@pytest.mark.slow  # full-train/full-model integration pass (tens of seconds on this 2-core sandbox); rides scripts/ci.sh --full — the fast lane must finish inside tier-1's time budget
 def test_gpt2_chunked_prefill_matches_onetoken_prefill():
     """gpt2_decode_step_program(width=W): chunked prefill fills the
     caches in ceil(P/W) offset-causal dispatches (fused_attention
@@ -1452,6 +1456,7 @@ def test_gpt2_speculative_sampling_distribution_and_ceiling():
     assert stats_c["accept_rate"] > 0.9, stats_c
 
 
+@pytest.mark.slow  # full-train/full-model integration pass (tens of seconds on this 2-core sandbox); rides scripts/ci.sh --full — the fast lane must finish inside tier-1's time budget
 def test_gpt2_speculative_trained_draft_high_acceptance():
     """The real-world speculation economics: target AND a smaller draft
     both trained on the same cyclic data — the draft proposes correctly,
@@ -1623,6 +1628,7 @@ def test_gpt2_chunked_prefill_randomized_sweep():
                 got, ref, err_msg="T=%d P=%d W=%d new=%d" % (T, P, W, new))
 
 
+@pytest.mark.slow  # full-train/full-model integration pass (tens of seconds on this 2-core sandbox); rides scripts/ci.sh --full — the fast lane must finish inside tier-1's time budget
 def test_transformer_wide_decode_rescoring_matches_stepwise():
     """transformer_decode_programs(width=W): teacher-forced chunked
     scoring (force_decode_logits_cached) returns per-position logits

@@ -108,6 +108,7 @@ def test_segment_attention_grads_flow():
     assert np.isfinite(np.asarray(lv)).all()
 
 
+@pytest.mark.slow  # full-train/full-model integration pass (tens of seconds on this 2-core sandbox); rides scripts/ci.sh --full — the fast lane must finish inside tier-1's time budget
 def test_flash_segment_ids_match_dense():
     """Flash kernels with segment ids (interpret mode) == dense-XLA
     segment masking: forward and all grads, causal and bidirectional,

@@ -123,7 +123,7 @@ def test_ring_attention_matches_dense():
 
 def test_collective_wrappers():
     mesh = parallel.make_mesh({"x": 8})
-    from jax import shard_map
+    from paddle_tpu.parallel.mesh import shard_map
     from jax.sharding import PartitionSpec as P
 
     xs = jnp.arange(8.0)
@@ -145,6 +145,7 @@ def test_collective_wrappers():
     np.testing.assert_allclose(np.asarray(g(xs)), np.full(8, 3.0))
 
 
+@pytest.mark.slow  # repaired from the seed's broken shard_map import; heavy multi-axis compiles ride scripts/ci.sh --full, keeping tier-1 inside its time budget
 def test_gpipe_pipeline_matches_sequential():
     """4-stage GPipe over the pp axis == sequential single-device apply,
     and jax.grad flows through the schedule (backward pipeline for free)."""
@@ -237,6 +238,7 @@ def test_gpipe_microbatch_count_variants():
         np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=2e-5, atol=2e-6)
 
 
+@pytest.mark.slow  # repaired from the seed's broken shard_map import; heavy multi-axis compiles ride scripts/ci.sh --full, keeping tier-1 inside its time budget
 def test_switch_moe_matches_reference_and_balances():
     """ep=4 expert-parallel Switch MoE == single-device dense reference with
     identical routing; aux loss is near 1 for a uniform router; grads flow
@@ -354,6 +356,7 @@ def test_gpt2_tensor_parallel_on_mesh():
     assert "mp" in str(arr.sharding.spec), arr.sharding
 
 
+@pytest.mark.slow  # repaired from the seed's broken shard_map import; heavy multi-axis compiles ride scripts/ci.sh --full, keeping tier-1 inside its time budget
 def test_ulysses_attention_matches_dense():
     """All-to-all sequence parallelism (Ulysses): sp=4 time-sharded
     attention == dense single-device attention, causal and not; grads
@@ -457,6 +460,7 @@ def test_zero1_optimizer_state_sharding():
     assert all("dp" not in s for s in z_params.values()), z_params
 
 
+@pytest.mark.slow  # repaired from the seed's broken shard_map import; heavy multi-axis compiles ride scripts/ci.sh --full, keeping tier-1 inside its time budget
 def test_ring_attention_flash_path_matches_dense_incl_grads():
     """Ring attention routed through the Pallas flash piece (use_flash=True)
     matches the dense global reference — values and q/k/v gradients — so
@@ -495,6 +499,7 @@ def test_ring_attention_flash_path_matches_dense_incl_grads():
                                        rtol=2e-3, atol=2e-4)
 
 
+@pytest.mark.slow  # repaired from the seed's broken shard_map import; heavy multi-axis compiles ride scripts/ci.sh --full, keeping tier-1 inside its time budget
 def test_ring_attention_grads_dense_path():
     """The scanned ring (lax.scan + ppermute) is reverse-differentiable on
     the dense piece path too."""
@@ -602,6 +607,7 @@ def test_one_f_one_b_lower_activation_memory_than_gpipe():
     assert f1 < 2.0, f1  # flat-ish in M
 
 
+@pytest.mark.slow  # repaired from the seed's broken shard_map import; heavy multi-axis compiles ride scripts/ci.sh --full, keeping tier-1 inside its time budget
 def test_gshard_top2_moe_matches_reference_and_reports_drops():
     """top_k=2 (GShard) routing: expert-parallel output matches the dense
     reference per shard; gates renormalize over the chosen pair; the
@@ -664,6 +670,7 @@ def test_zero3_parameter_sharding_matches_replicated():
     assert any("dp" in s for s in z_params.values()), z_params
 
 
+@pytest.mark.slow  # repaired from the seed's broken shard_map import; heavy multi-axis compiles ride scripts/ci.sh --full, keeping tier-1 inside its time budget
 def test_ring_attention_sliding_window_matches_dense():
     """Global sliding-window attention ACROSS the ring (values + grads):
     each query sees the last `window` global positions; chunks outside
@@ -714,6 +721,7 @@ def test_ulysses_window_matches_ring_window():
                                rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow  # repaired from the seed's broken shard_map import; heavy multi-axis compiles ride scripts/ci.sh --full, keeping tier-1 inside its time budget
 def test_ring_attention_window_flash_path():
     """Windowed ring with the flash kernel on: the diagonal chunk runs
     the banded flash kernel (ring offsets cancel), off-diagonals the
@@ -743,6 +751,7 @@ def test_ring_attention_window_flash_path():
                                rtol=2e-3, atol=2e-4)
 
 
+@pytest.mark.slow  # repaired from the seed's broken shard_map import; heavy multi-axis compiles ride scripts/ci.sh --full, keeping tier-1 inside its time budget
 def test_transformer_block_pipeline_1f1b():
     """A REAL transformer-block pipeline: 4 causal encoder blocks over pp,
     1F1B loss+grads match the sequential reference."""

@@ -236,6 +236,151 @@ def test_compressed_tags_malformed_frames_rejected():
         _Reader(bytes(bad)).decode()
 
 
+def test_sparse_rows_bf16_wire_roundtrip_live_pserver():
+    """Sparse bf16 wire (PR 5's documented f32-only gap, closed):
+    Bf16Wire-wrapped ROW VALUES ride the versioned `h` tag and arrive at
+    the pserver as plain f32 with bf16 rounding — ids stay exact, the
+    service never sees a wire dtype, and the applied update equals the
+    bf16-rounded rows bit for bit."""
+    from paddle_tpu.distributed.ps_server import ParameterServer
+    from paddle_tpu.distributed.rpc import Bf16Wire
+
+    tbl = np.zeros((8, 4), np.float32)
+    ps = ParameterServer(
+        {}, {}, num_trainers=1, sync_mode=False,
+        sparse_tables={"t.shard0": {
+            "tbl": tbl, "lr": 1.0, "opt": {"type": "sgd", "attrs": {}}}})
+    srv = VarServer("127.0.0.1:0", ps).start()
+    try:
+        cli = RPCClient(srv.endpoint, timeout=5, retries=2)
+        rows = (np.random.RandomState(0).rand(3, 4).astype("float32")
+                - 0.5) * 4.0
+        ids = np.array([1, 3, 5], np.int64)
+        r = cli.call("send_sparse", table="t.shard0", ids=ids,
+                     rows=Bf16Wire(rows), trainer_id=0)
+        assert r["ok"] is True
+        import ml_dtypes
+
+        want = -(rows.astype(ml_dtypes.bfloat16).astype(np.float32))
+        np.testing.assert_array_equal(tbl[ids], want)  # lr=1.0 sgd
+        untouched = [i for i in range(8) if i not in ids]
+        assert np.all(tbl[untouched] == 0.0)
+        cli.close()
+    finally:
+        srv.shutdown()
+        rpc.RPCClient.reset_all()
+
+
+def test_sparse_sync_send_records_keep_compressed_rows():
+    """The send_sparse lowering under FLAGS_comm_wire_dtype=bfloat16:
+    the sync-mode fenced-replay record stores the already-WRAPPED rows
+    (compressed form), so a pserver restart re-ships byte-identical
+    chunks; the server's queued pending chunk holds the decoded
+    (bf16-rounded) f32 rows with exact ids; and comm_bytes_saved counts
+    the cut."""
+    import paddle_tpu as fluid
+    from paddle_tpu import framework
+    from paddle_tpu.distributed.ps_server import ParameterServer
+    from paddle_tpu.distributed.rpc import Bf16Wire
+    from paddle_tpu.ops import dist_ops
+
+    tbl = np.zeros((8, 4), np.float32)
+    ps = ParameterServer(
+        {}, {}, num_trainers=1, sync_mode=True,
+        sparse_tables={"t.shard0": {
+            "tbl": tbl, "lr": 1.0, "opt": {"type": "sgd", "attrs": {}}}})
+    srv = VarServer("127.0.0.1:0", ps).start()
+    ep = srv.endpoint
+    try:
+        prog = fluid.Program()
+        b = prog.global_block()
+        b.create_var(name="ids", shape=[3, 1], dtype="int64")
+        b.create_var(name="g", shape=[3, 4], dtype="float32")
+        b.create_var(name="tok", shape=[1])
+        op = framework.Operator(
+            b, "send_sparse", None, None,
+            {"epmap": [ep], "table_names": ["t.shard0"], "trainer_id": 0,
+             "scale": 1.0, "sync_mode": True, "wire_dtype": "bfloat16",
+             "op_role": "rpc"})
+        op.inputs = {"Ids": ["ids"], "Grad": ["g"]}
+        op.outputs = {"Out": ["tok"]}
+        b.ops.append(op)
+        dist_ops.reset_fences()
+        rpc.reset_comm_stats()
+        rows = (np.random.RandomState(1).rand(3, 4).astype("float32")
+                - 0.5) * 4.0
+        ids = np.array([[1], [3], [5]], np.int64)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(prog, feed={"ids": ids, "g": rows}, fetch_list=[])
+        # the replay record holds the WRAPPED (compressed) rows
+        kw = dist_ops._fences[ep]["sparse"]["t.shard0"]
+        assert isinstance(kw["rows"], Bf16Wire)
+        np.testing.assert_array_equal(kw["ids"], ids.reshape(-1))
+        # re-encoding the record reproduces the shipped bytes exactly
+        first = bytes(_encode(kw["rows"], bytearray()))
+        again = bytes(_encode(kw["rows"], bytearray()))
+        assert first == again
+        # server queued the DECODED rounded rows under the step token
+        import ml_dtypes
+
+        (qids, qrows), = [v for (k, _t), v in ps._pending_sparse.items()
+                          if k == 0]
+        np.testing.assert_array_equal(qids, ids.reshape(-1))
+        np.testing.assert_array_equal(
+            qrows, rows.astype(ml_dtypes.bfloat16).astype(np.float32))
+        assert rpc.get_comm_stats()["comm_bytes_saved"] == \
+            rows.nbytes - 2 * rows.size
+    finally:
+        srv.shutdown()
+        dist_ops.reset_fences()
+        rpc.reset_comm_stats()
+        rpc.RPCClient.reset_all()
+
+
+def test_sparse_bf16_malformed_rows_frame_rejected():
+    """A truncated/hostile bf16 rows payload inside a send_sparse frame
+    is a parse error server-side: the connection drops, the server stays
+    alive, and a well-formed sparse send still lands afterwards."""
+    from paddle_tpu.distributed.ps_server import ParameterServer
+    from paddle_tpu.distributed.rpc import Bf16Wire
+
+    tbl = np.zeros((4, 2), np.float32)
+    ps = ParameterServer(
+        {}, {}, num_trainers=1, sync_mode=False,
+        sparse_tables={"t.shard0": {
+            "tbl": tbl, "lr": 1.0, "opt": {"type": "sgd", "attrs": {}}}})
+    srv = VarServer("127.0.0.1:0", ps).start()
+    try:
+        host, port = srv.endpoint.rsplit(":", 1)
+        good = bytes(_encode(
+            ("send_sparse",
+             {"table": "t.shard0", "ids": np.array([0], np.int64),
+              "rows": Bf16Wire(np.ones((1, 2), np.float32)),
+              "trainer_id": 0}, "req-1"), bytearray()))
+        # truncate INSIDE the bf16 payload: the frame length lies, the
+        # decoder sees a short `h` tag body and must refuse
+        cut = good[:-1]
+        s = socket.create_connection((host, int(port)), timeout=5)
+        s.sendall(struct.pack(">Q", 1 + len(cut)) + bytes([PROTO_VERSION])
+                  + cut)
+        s.settimeout(5)
+        assert s.recv(1) == b""  # dropped, not crashed
+        s.close()
+        assert np.all(tbl == 0.0)  # nothing was applied
+        # the server still serves a well-formed sparse send
+        cli = RPCClient(srv.endpoint, timeout=5, retries=2)
+        r = cli.call("send_sparse", table="t.shard0",
+                     ids=np.array([2], np.int64),
+                     rows=Bf16Wire(np.ones((1, 2), np.float32)),
+                     trainer_id=0)
+        assert r["ok"] is True
+        assert tbl[2, 0] == -1.0
+        cli.close()
+    finally:
+        srv.shutdown()
+        rpc.RPCClient.reset_all()
+
+
 def test_scatter_gather_segments_match_bytearray_encoder():
     """Zero-copy framing invariant: joining the _SegWriter segments
     reproduces the copying encoder's byte stream exactly — for frames
