@@ -691,6 +691,16 @@ def _dist_smokes():
         "pserver_2x2": (pserver_cmd, {"DIST_MODEL": ""}),
         # distributed lookup table: prefetch + sparse-update RPC path
         "pserver_sparse_2x2": (pserver_cmd, {"DIST_MODEL": "sparse"}),
+        # durable async sparse at HIGH ROW-CHURN (ctr_deepfm, fresh
+        # uniform ids every step): the async listen_and_serv path with
+        # the write-ahead journal armed (ephemeral ckpt dir) — COUNTERS
+        # carry async_sparse_sends/dedup/resends + recovery_ms, and the
+        # PSERVER-STATS aggregation below reports journal bytes/step
+        "pserver_sparse_async_2x2": (
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--mode", "pserver", "--async-mode", "--nproc", "2",
+             "--pservers", "2", "tests/dist_ctr.py"],
+            {"DIST_EPHEMERAL_CKPT": "1"}),
         "collective_2": ([sys.executable, "-m",
                           "paddle_tpu.distributed.launch",
                           "--nproc", "2", "tests/launch_worker.py"], {}),
@@ -712,7 +722,8 @@ def _dist_smokes():
         leg_env = dict(env)
         # stray shell vars must not silently flip a leg's model
         for k in ("DIST_MODEL", "DIST_SPARSE_IDS", "DIST_OPTIMIZER",
-                  "DIST_MODE", "DIST_COLLECTIVE_DEVICES"):
+                  "DIST_MODE", "DIST_COLLECTIVE_DEVICES",
+                  "DIST_EPHEMERAL_CKPT", "DIST_FIELD_DIM", "DIST_FIELDS"):
             leg_env.pop(k, None)
         leg_env.update({k: v for k, v in overrides.items() if v})
         vals, err, counters = [], None, None
@@ -735,8 +746,26 @@ def _dist_smokes():
                 # summed across trainers, they are a property of the op
                 # plan, so a regression shows without wall-clock noise
                 agg = {}
+                ps_agg = {}
                 for ln in proc.stdout.decode("utf-8", "replace").splitlines():
                     # launch.py prefixes child lines with "[trainer.N] "
+                    # (and "[pserver.N] " for the server-side stats the
+                    # async journal/staleness evidence rides on)
+                    pos = ln.find("PSERVER-STATS ")
+                    if pos >= 0:
+                        try:
+                            s = json.loads(
+                                ln[pos + len("PSERVER-STATS "):])
+                        except ValueError:
+                            continue
+                        for k, v in s.items():
+                            if k in ("journal_records", "journal_bytes",
+                                     "journal_replayed",
+                                     "journal_tail_skips", "dedup_drops",
+                                     "staleness_parks", "parked_ms",
+                                     "async_sends"):
+                                ps_agg[k] = round(ps_agg.get(k, 0) + v, 3)
+                        continue
                     pos = ln.find("COUNTERS ")
                     if pos < 0:
                         continue
@@ -750,6 +779,11 @@ def _dist_smokes():
                         else:
                             # tags (wire_dtype) ride along un-summed
                             agg.setdefault(k, v)
+                if ps_agg.get("journal_bytes"):
+                    agg["journal_bytes_per_step"] = round(
+                        ps_agg["journal_bytes"] / float(steps), 1)
+                if ps_agg:
+                    agg.update({"ps_" + k: v for k, v in ps_agg.items()})
                 if agg:
                     counters = agg
             except subprocess.TimeoutExpired:

@@ -31,6 +31,12 @@ lifetime so reconnects keep the schedule deterministic):
                   server: dedup must execute once and replay the reply)
 * ``truncate``  — forward roughly half the frame, then kill the
                   connection (both directions): a peer dying mid-write
+* ``corrupt``   — forward the frame with one payload byte flipped: the
+                  receiver's closed-type decode (or HMAC) must reject it
+                  as a protocol violation and drop the connection, and
+                  the sender's retry must keep the stream exactly-once —
+                  bit-rot on the wire, the transport sibling of the
+                  journal's crc-framed tail-skip discipline
 
 Process-level chaos (SIGKILL of cluster children) lives in launch.py's
 kill helpers; this module only does wire-level faults.
@@ -42,7 +48,7 @@ import threading
 
 _LEN = struct.Struct(">Q")
 
-ACTIONS = ("pass", "drop", "delay", "dup", "truncate")
+ACTIONS = ("pass", "drop", "delay", "dup", "truncate", "corrupt")
 
 
 class FaultSchedule:
@@ -59,7 +65,7 @@ class FaultSchedule:
     red run reproduces bit-for-bit (scripts/ci.sh)."""
 
     def __init__(self, schedule=None, seed=None, drop=0.0, delay=0.0,
-                 dup=0.0, truncate=0.0):
+                 dup=0.0, truncate=0.0, corrupt=0.0):
         import os
         import random
 
@@ -77,6 +83,7 @@ class FaultSchedule:
         self._rates = (
             ("drop", float(drop)), ("delay", float(delay)),
             ("dup", float(dup)), ("truncate", float(truncate)),
+            ("corrupt", float(corrupt)),
         )
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
@@ -133,11 +140,12 @@ class FaultyChannel:
 
     def __init__(self, target_endpoint, listen="127.0.0.1:0",
                  schedule=None, seed=None, drop=0.0, delay=0.0, dup=0.0,
-                 truncate=0.0, delay_s=0.05):
+                 truncate=0.0, corrupt=0.0, delay_s=0.05):
         self.target = target_endpoint
         self._listen = listen
         self.sched = FaultSchedule(schedule, seed=seed, drop=drop,
-                                   delay=delay, dup=dup, truncate=truncate)
+                                   delay=delay, dup=dup,
+                                   truncate=truncate, corrupt=corrupt)
         self.delay_s = float(delay_s)
         self.stats = {"c2s": {a: 0 for a in ACTIONS},
                       "s2c": {a: 0 for a in ACTIONS}}
@@ -248,6 +256,17 @@ class FaultyChannel:
                     # mid-frame EOF (ConnectionError / dropped conn)
                     dst.sendall(frame[: max(1, len(frame) // 2)])
                     break
+                elif action == "corrupt":
+                    # flip one byte in the PAYLOAD (never the length
+                    # prefix — the framing must survive so the receiver
+                    # reads a whole frame and rejects its content):
+                    # decode/HMAC fails -> protocol violation -> the
+                    # receiver drops the connection
+                    mangled = bytearray(frame)
+                    pos = _LEN.size + max(0, (len(frame) - _LEN.size) // 2)
+                    pos = min(pos, len(mangled) - 1)
+                    mangled[pos] ^= 0xFF
+                    dst.sendall(bytes(mangled))
                 else:
                     dst.sendall(frame)
         except OSError:

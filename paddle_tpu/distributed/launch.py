@@ -424,7 +424,8 @@ def launch_collective(script_argv, nproc, base_env=None, chaos_kills=None,
 
 def launch_pserver(script_argv, nproc, n_pservers, base_env=None, sync=True,
                    chaos_kills=None, supervise=False, max_restarts=3,
-                   restart_window=60.0, restart_backoff=0.5, ckpt_dir=None):
+                   restart_window=60.0, restart_backoff=0.5, ckpt_dir=None,
+                   staleness_bound=None):
     ports = [free_port() for _ in range(n_pservers)]
     eps = ",".join("127.0.0.1:%d" % p for p in ports)
     common = dict(base_env or os.environ)
@@ -433,14 +434,22 @@ def launch_pserver(script_argv, nproc, n_pservers, base_env=None, sync=True,
         PADDLE_TRAINERS=str(nproc),
         DIST_SYNC_MODE="1" if sync else "0",
     )
+    if staleness_bound is not None:
+        # async bounded staleness: arm FLAGS_async_staleness_bound in
+        # every child so pservers park trainers running ahead of the
+        # slowest live peer (sync mode has the round barrier; the flag
+        # is harmless there)
+        common["FLAGS_async_staleness_bound"] = str(int(staleness_bound))
     if ckpt_dir:
         common["PADDLE_PSERVER_CKPT_DIR"] = ckpt_dir
     if supervise and not common.get("PADDLE_PSERVER_CKPT_DIR"):
         sys.stderr.write(
             "[launch] WARNING: --supervise without a checkpoint dir "
             "(--ckpt-dir / PADDLE_PSERVER_CKPT_DIR): a restarted pserver "
-            "comes up COLD and the job's optimizer state on that shard "
-            "is lost\n")
+            "comes up COLD and the job's %s on that shard is lost\n"
+            % ("optimizer state" if sync else
+               "optimizer state AND async journal (updates since the "
+               "last snapshot)"))
 
     def _policy():
         return _RestartPolicy(max_restarts=max_restarts,
@@ -652,7 +661,16 @@ def main(argv=None):
         "--ckpt-dir", default=None,
         help="pserver mode: sets PADDLE_PSERVER_CKPT_DIR for the "
         "children so supervised pserver restarts restore instead of "
-        "starting cold",
+        "starting cold (async mode also homes the write-ahead journal "
+        "here — without it an async restart loses updates since the "
+        "last snapshot)",
+    )
+    parser.add_argument(
+        "--staleness-bound", type=int, default=None, metavar="STEPS",
+        help="async pserver mode: arm FLAGS_async_staleness_bound in "
+        "every child — pservers park pushes/prefetches from a trainer "
+        "running more than STEPS ahead of the slowest live peer "
+        "(eviction/completion frees the bound)",
     )
     parser.add_argument("script", help="training script")
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
@@ -683,6 +701,7 @@ def main(argv=None):
             max_restarts=args.max_restarts,
             restart_window=args.restart_window,
             restart_backoff=args.restart_backoff, ckpt_dir=args.ckpt_dir,
+            staleness_bound=args.staleness_bound,
         )
     return rc
 

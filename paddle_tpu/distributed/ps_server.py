@@ -20,7 +20,17 @@ Sync mode round protocol (reference barrier semantics):
   3. trainers issue get() for updated param blocks, then barrier("fetch")
   4. round resets
 Async mode: each send applies its shard program immediately, gets are
-served from the live scope, no barriers.
+served from the live scope, no barriers.  Durability and ordering come
+from the ASYNC layer instead (docs/FAULT_TOLERANCE.md, "Durable async
+sparse"): every applied sparse chunk / dense bucket is appended to a
+crc-framed fsync'd write-ahead journal BEFORE its ack (rotated at each
+snapshot; a restarted incarnation replays journal-after-snapshot and
+loses zero applied updates, skipping a torn tail record cold);
+per-sender sequence fences (_sparse_fence monotonic, _dense_fence
+contiguous+ahead-set for the pipelined window) turn the client's
+at-least-once re-delivery into exactly-once application across SIGKILL;
+and FLAGS_async_staleness_bound parks pushes/prefetches from a trainer
+running ahead of the slowest live peer until it catches up or departs.
 
 Fault tolerance (docs/FAULT_TOLERANCE.md):
   * liveness — trainers send a ``heartbeat`` verb from a background
@@ -29,8 +39,9 @@ Fault tolerance (docs/FAULT_TOLERANCE.md):
     live set, its unsummed grads and queued sparse rows dropped, and any
     pending barrier re-evaluates against the survivors so the round
     completes instead of deadlocking.  Trainers that never heartbeat are
-    never evicted (exactly the pre-liveness behavior), and eviction only
-    runs in SYNC mode — async has no barrier a ghost can hang.
+    never evicted (exactly the pre-liveness behavior), and eviction runs
+    in SYNC mode — plus ASYNC mode when a staleness bound is armed,
+    where a dead laggard would otherwise park every fast peer forever.
   * checkpoints — atomic tmp+rename snapshots plus a crc-carrying
     manifest; a torn or corrupt snapshot is skipped on restart, never a
     crash.
@@ -51,12 +62,28 @@ order across the in-flight window is free.  Sync mode is unaffected:
 its application order comes from the round barrier, not arrival.
 """
 
+import struct
 import threading
 
 import numpy as np
 
 from .. import framework
 from ..core.scope import Scope
+
+# write-ahead journal record framing (async mode, docs/FAULT_TOLERANCE.md):
+# [8B big-endian payload length][4B crc32][pickled record].  A record is
+# appended + fsync'd BEFORE the apply's reply leaves the server, so an
+# acked update is durable by construction; a kill mid-append leaves a
+# truncated/corrupt TAIL that restore skips cold (counted), exactly like
+# a corrupt snapshot — the unacked update is re-shipped by the client.
+_J_HEAD = struct.Struct(">QI")
+# cap a single journal record's claimed length (corrupt headers must not
+# allocate gigabytes); generous vs any real chunk/bucket
+_J_MAX_RECORD = 1 << 31
+# pure-sparse async streams never bump the dense round counter, so the
+# journal would grow unbounded between snapshots: force a snapshot (and
+# with it a journal rotation) every this many appended records
+_J_ROTATE_RECORDS = 512
 
 
 class ParameterServer:
@@ -76,6 +103,7 @@ class ParameterServer:
         checkpoint_every=1,
         server_idx=0,
         eviction_deadline=None,
+        staleness_bound=None,
     ):
         from ..executor import Executor
         from ..places import CPUPlace
@@ -179,10 +207,55 @@ class ParameterServer:
         self.server_idx = int(server_idx)
         self._async_sends = 0
         self._ckpt_write_lock = threading.Lock()  # serialize writer threads
+        # async crash consistency (docs/FAULT_TOLERANCE.md, async section):
+        # a write-ahead journal of applied updates makes the async stream
+        # replayable across SIGKILL, and per-sender sequence fences make
+        # the client's at-least-once re-delivery exactly-once.
+        #   _sparse_fence[(tid, table)] -> highest seq durably applied
+        #     (sends are serial per trainer, so monotonic drop-if-<= is
+        #     exact; gaps are legal — rowless/empty chunks are acked but
+        #     not journaled, so a restored fence can sit below the
+        #     client's ack high-water without breaking dedup)
+        #   _dense_fence[tid] -> [contiguous fence, set of applied aseqs
+        #     above it] — async dense buckets ride the pipelined window
+        #     and may arrive out of order
+        # Both fences ride the checkpoint snapshot AND are rebuilt by
+        # journal replay, so a re-shipped chunk is dropped whether the
+        # original apply landed in the snapshot or only in the journal.
+        self._sparse_fence = {}
+        self._dense_fence = {}
+        # bounded staleness: per-trainer logical clocks derived from the
+        # seq tokens; a push/prefetch from a trainer more than
+        # _staleness_bound ahead of the slowest LIVE peer parks on _cv
+        # until the laggard catches up or departs
+        self._trainer_clock = {}
+        if staleness_bound is None:
+            from ..flags import get_flag
+
+            staleness_bound = get_flag("async_staleness_bound")
+        self._staleness_bound = int(staleness_bound)
+        from ..flags import get_flag as _gf
+
+        self._journal_on = bool(_gf("async_journal"))
+        self._journal_seg = 0  # current segment id (rotated per snapshot)
+        self._journal_f = None
+        self._journal_err = False  # first append failure warns loudly once
+        self._replaying = False  # journal replay must not re-journal
+        self._j_recs_at_snap = 0
+        self._sends_at_ckpt = 0  # dense cadence marker (post-journal)
+        # stale-writer guard: two snapshot writers can land out of order;
+        # an older round must never overwrite a newer snapshot (its
+        # journal segments may already be deleted)
+        self._ckpt_written_round = -1
         # recovery observability (bench / smoke COUNTERS evidence)
         self.counters = {"evictions": 0, "readmissions": 0,
                          "registrations": 0, "dup_round_drops": 0,
-                         "lost_rounds": 0}
+                         "lost_rounds": 0,
+                         # async durability + staleness evidence
+                         "dedup_drops": 0, "journal_records": 0,
+                         "journal_bytes": 0, "journal_replayed": 0,
+                         "journal_tail_skips": 0, "staleness_parks": 0,
+                         "staleness_timeouts": 0, "parked_ms": 0.0}
         # every pserver start — cold or restored — is a new INCARNATION;
         # the number rides every rpc reply envelope so trainers can fence
         # a restart (see rpc.py incarnation registry)
@@ -216,6 +289,310 @@ class ParameterServer:
                 pass
         return int(time.time() * 1000) & 0x7FFFFFFFFFFF
 
+    # ---- async write-ahead journal (durable async sparse) ----------------
+    def _journal_enabled(self):
+        return bool(self._journal_on and self.checkpoint_dir
+                    and not self.sync_mode)
+
+    def _journal_path(self, seg):
+        import os
+
+        return os.path.join(
+            self.checkpoint_dir,
+            "pserver_%d.journal.seg%06d" % (self.server_idx, int(seg)))
+
+    def _journal_segments(self):
+        """Existing segment ids for this shard, sorted ascending."""
+        import os
+        import re
+
+        if not self.checkpoint_dir:
+            return []
+        pat = re.compile(
+            r"^pserver_%d\.journal\.seg(\d+)$" % self.server_idx)
+        try:
+            names = os.listdir(self.checkpoint_dir)
+        except OSError:
+            return []
+        return sorted(int(m.group(1))
+                      for m in (pat.match(n) for n in names) if m)
+
+    def _journal_append_locked(self, rec):
+        """Append one crc-framed record and fsync — called under the
+        service lock, BEFORE the apply's reply leaves, so an acked update
+        is durable.  A disk failure degrades to the old lose-on-restart
+        behavior, loudly (once), rather than killing the serving loop.
+
+        Known tradeoff: the fsync runs under the service lock, so every
+        concurrent verb (reads included) stalls behind each disk sync.
+        Group commit — append+flush under the lock, fsync the captured
+        file object outside it before the reply — would lift that, but
+        interacts with snapshot-capture rotation closing the file
+        mid-sync; left as future perf work (the apply itself already
+        serializes writers here)."""
+        if not self._journal_enabled() or self._replaying:
+            return
+        import os
+        import pickle
+        import sys
+        import zlib
+
+        try:
+            payload = pickle.dumps(rec, protocol=pickle.HIGHEST_PROTOCOL)
+            frame = _J_HEAD.pack(len(payload),
+                                 zlib.crc32(payload) & 0xFFFFFFFF) + payload
+            if self._journal_f is None:
+                os.makedirs(self.checkpoint_dir, exist_ok=True)
+                self._journal_f = open(
+                    self._journal_path(self._journal_seg), "ab")
+            self._journal_f.write(frame)
+            self._journal_f.flush()
+            os.fsync(self._journal_f.fileno())
+            self.counters["journal_records"] += 1
+            self.counters["journal_bytes"] += len(frame)
+            self._journal_err = False
+        except OSError as e:
+            if not self._journal_err:
+                self._journal_err = True
+                sys.stderr.write(
+                    "PSERVER journal append failed (%s): async updates "
+                    "since the last snapshot are NOT crash-durable until "
+                    "the journal recovers\n" % e)
+
+    def _journal_quarantine(self):
+        """An UNUSABLE snapshot orphans its journal: the segments hold
+        deltas whose base state is gone, so they can never be replayed
+        correctly — and left on disk they would poison the NEXT lineage
+        (the fresh writer would append into / a later restore would
+        replay dead-lineage records on top of new state).  Remove them,
+        loudly, and reseed the writer past their numbering."""
+        import os
+        import sys
+
+        if not self._journal_enabled():
+            return
+        segs = self._journal_segments()
+        if not segs:
+            return
+        sys.stderr.write(
+            "PSERVER journal segments %s belong to the unusable "
+            "snapshot's lineage (deltas without their base); removing "
+            "them — the cold start cannot replay them\n" % segs)
+        self.counters["journal_tail_skips"] += len(segs)
+        for seg in segs:
+            try:
+                os.remove(self._journal_path(seg))
+            except OSError:
+                pass
+        self._journal_seg = max(self._journal_seg, segs[-1] + 1)
+
+    def _journal_rotate_locked(self):
+        """Start a fresh segment (at snapshot capture): everything before
+        the new segment is contained in the snapshot being taken, so once
+        that snapshot lands the older segments can be deleted.  Returns
+        the new segment id (the snapshot's replay-from marker)."""
+        if not self._journal_enabled():
+            return None
+        if self._journal_f is not None:
+            try:
+                self._journal_f.close()
+            except OSError:
+                pass
+            self._journal_f = None
+        self._journal_seg += 1
+        self._j_recs_at_snap = self.counters["journal_records"]
+        return self._journal_seg
+
+    def _journal_maybe_snapshot_locked(self):
+        """Sparse-only async streams never bump the dense round counter,
+        so without this the journal would grow unbounded between
+        snapshots: force a snapshot (and its rotation) every
+        _J_ROTATE_RECORDS appended records."""
+        if (self.checkpoint_dir and not self._replaying
+                and self.counters["journal_records"]
+                - self._j_recs_at_snap >= _J_ROTATE_RECORDS):
+            self._round += 1
+            self._maybe_checkpoint()
+
+    def _replay_journal(self, from_seg):
+        """Apply journal records from segment `from_seg` on, in order,
+        through the SAME application paths the live verbs use (lr
+        triggers, slot state, fences and clocks all advance identically).
+        A corrupt/truncated record ends ITS segment's replay (counted,
+        cold — the kill landed mid-append and the unacked update will be
+        re-shipped); later segments, written by later incarnations, still
+        replay.  New appends then go to a segment PAST everything seen,
+        so a skipped tail is never appended after."""
+        if not self._journal_enabled():
+            return 0
+        import pickle
+        import sys
+        import zlib
+
+        segs = [s for s in self._journal_segments() if s >= int(from_seg)]
+        n = 0
+        self._replaying = True
+        try:
+            for seg in segs:
+                try:
+                    with open(self._journal_path(seg), "rb") as f:
+                        buf = f.read()
+                except OSError as e:
+                    sys.stderr.write(
+                        "PSERVER journal seg %d unreadable (%s); "
+                        "skipped\n" % (seg, e))
+                    self.counters["journal_tail_skips"] += 1
+                    continue
+                off = 0
+                while off < len(buf):
+                    if off + _J_HEAD.size > len(buf):
+                        self.counters["journal_tail_skips"] += 1
+                        break
+                    ln, crc = _J_HEAD.unpack_from(buf, off)
+                    if (ln > _J_MAX_RECORD
+                            or off + _J_HEAD.size + ln > len(buf)):
+                        self.counters["journal_tail_skips"] += 1
+                        break
+                    payload = buf[off + _J_HEAD.size:
+                                  off + _J_HEAD.size + ln]
+                    if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+                        self.counters["journal_tail_skips"] += 1
+                        break
+                    try:
+                        rec = pickle.loads(payload)
+                        self._apply_journal_record(rec)
+                    except Exception as e:
+                        sys.stderr.write(
+                            "PSERVER journal seg %d record unusable (%s); "
+                            "skipping segment tail\n" % (seg, e))
+                        self.counters["journal_tail_skips"] += 1
+                        break
+                    n += 1
+                    off += _J_HEAD.size + ln
+            # new appends must land in a segment future restores WILL
+            # replay: past every segment seen, and never below the
+            # snapshot's replay-from marker — after a snapshot that
+            # deleted all covered segments, an empty journal dir must
+            # not reset the writer to seg 0 (records there would sit
+            # below the marker and a second restart would skip them,
+            # silently losing acked updates)
+            existing = self._journal_segments()
+            self._journal_seg = max(
+                [self._journal_seg, int(from_seg)]
+                + [s + 1 for s in existing])
+        finally:
+            self._replaying = False
+        self.counters["journal_replayed"] = n
+        if n or self.counters["journal_tail_skips"]:
+            print("PSERVER JOURNAL-REPLAY records=%d tail_skips=%d "
+                  "segments=%s" % (n, self.counters["journal_tail_skips"],
+                                   segs), flush=True)
+        return n
+
+    def _apply_journal_record(self, rec):
+        kind = rec.get("k")
+        tid = int(rec.get("tid", 0))
+        if kind == "s":
+            table = rec["t"]
+            if table not in self.sparse_tables:
+                import sys
+
+                sys.stderr.write(
+                    "PSERVER journal names unknown sparse table %r; "
+                    "record skipped\n" % (table,))
+                return
+            ids = np.asarray(rec["i"])
+            if ids.size:
+                self._async_touched.add(table)
+                self._apply_sparse(table, ids, np.asarray(rec["r"]))
+            if rec.get("q") is not None:
+                key = (tid, table)
+                seq = int(rec["q"])
+                self._sparse_fence[key] = max(
+                    self._sparse_fence.get(key, 0), seq)
+                self._clock_update_locked(tid, seq)
+        elif kind == "d":
+            aseq = rec.get("q")
+            if aseq is not None and self._dense_fence_is_dup(tid, aseq):
+                return
+            for name in sorted(rec["b"]):
+                self._apply_async_send_locked(name,
+                                              np.asarray(rec["b"][name]))
+            if aseq is not None:
+                # aseq stays OUT of _trainer_clock (bucket units, not
+                # steps — see _h_send_bucket)
+                self._dense_fence_commit(tid, aseq)
+        elif kind == "v":
+            self._apply_async_send_locked(rec["n"], np.asarray(rec["v"]))
+
+    # ---- async delivery fences + bounded staleness -----------------------
+    def _dense_fence_is_dup(self, tid, aseq):
+        st = self._dense_fence.get(int(tid))
+        if st is None or aseq is None:
+            return False
+        aseq = int(aseq)
+        return aseq <= st[0] or aseq in st[1]
+
+    def _dense_fence_commit(self, tid, aseq):
+        """Contiguous fence + ahead-set: async dense buckets ride the
+        pipelined window, so they may commit out of order — the fence
+        advances through the set as the gaps fill, keeping the set no
+        larger than the in-flight window."""
+        st = self._dense_fence.setdefault(int(tid), [0, set()])
+        st[1].add(int(aseq))
+        while st[0] + 1 in st[1]:
+            st[0] += 1
+            st[1].discard(st[0])
+
+    def _clock_update_locked(self, tid, clock):
+        tid = int(tid)
+        cur = self._trainer_clock.get(tid, 0)
+        if int(clock) > cur:
+            self._trainer_clock[tid] = int(clock)
+            if not self._replaying:
+                self._cv.notify_all()
+
+    def _park_if_stale_locked(self, tid, clock):
+        """Bounded staleness (async mode): hold this push/prefetch while
+        its trainer runs more than _staleness_bound steps ahead of the
+        slowest LIVE peer; released when the laggard's clock advances or
+        it departs (complete / eviction — which is why the reaper also
+        runs on async servers when the bound is armed).  The wait is
+        capped: a bound must throttle, never deadlock — on timeout the
+        call proceeds loudly and the timeout is counted."""
+        bound = self._staleness_bound
+        if bound <= 0 or self.sync_mode or self._replaying or clock is None:
+            return
+        import time
+
+        tid = int(tid)
+        clock = int(clock)
+
+        def clear():
+            if (self._done.is_set() or tid in self._evicted
+                    or tid not in self._live):
+                return True
+            others = [c for t, c in self._trainer_clock.items()
+                      if t != tid and t in self._live]
+            return not others or clock - min(others) <= bound
+
+        if clear():
+            return
+        self.counters["staleness_parks"] += 1
+        print("PSERVER PARK trainer=%d clock=%d bound=%d"
+              % (tid, clock, bound), flush=True)
+        t0 = time.monotonic()
+        limit = max(10.0, 3.0 * self.eviction_deadline)
+        released = self._cv.wait_for(clear, timeout=limit)
+        self.counters["parked_ms"] = round(
+            self.counters["parked_ms"]
+            + (time.monotonic() - t0) * 1e3, 3)
+        if not released:
+            self.counters["staleness_timeouts"] += 1
+            print("PSERVER STALENESS-TIMEOUT trainer=%d clock=%d: laggard "
+                  "made no progress in %.0fs; releasing the park rather "
+                  "than deadlocking" % (tid, clock, limit), flush=True)
+
     # ---- checkpoint (fault tolerance) -----------------------------------
     def _ckpt_path(self, dir=None):
         import os
@@ -229,6 +606,18 @@ class ParameterServer:
         later in-place updates can't tear the snapshot)."""
         return {
             "round": self._round,
+            # async delivery fences + clocks ride the snapshot like the
+            # sync fold fences do: a restored server must drop re-shipped
+            # chunks whose applies are INSIDE the restored state
+            "async_seq": {
+                "sparse": dict(self._sparse_fence),
+                "dense": {t: [st[0], sorted(st[1])]
+                          for t, st in self._dense_fence.items()},
+                "clock": dict(self._trainer_clock)},
+            # journal rotation: records before this segment are contained
+            # in THIS snapshot; restore replays segments >= it, and the
+            # writer deletes segments < it once the snapshot lands
+            "journal_seg": self._journal_rotate_locked(),
             # per-trainer fold fences ride the SAME snapshot as the
             # params: after a restore, replayed buckets for rounds the
             # restored state already contains are dropped, rounds the
@@ -279,10 +668,19 @@ class ParameterServer:
         import zlib
 
         target = dir or self.checkpoint_dir
+        own_home = target == self.checkpoint_dir
         os.makedirs(target, exist_ok=True)
         path = self._ckpt_path(dir=target)
         tmp = path + ".tmp"
         with self._ckpt_write_lock:
+            if own_home:
+                # stale-writer guard: background writers can land out of
+                # order, and an older round must never overwrite a newer
+                # snapshot — its journal segments may already be gone
+                rnd = int(data.get("round", 0))
+                if rnd < self._ckpt_written_round:
+                    return
+                self._ckpt_written_round = rnd
             payload = pickle.dumps(data, protocol=pickle.HIGHEST_PROTOCOL)
             with open(tmp, "wb") as f:
                 f.write(payload)
@@ -295,6 +693,10 @@ class ParameterServer:
                 "nbytes": len(payload),
                 "crc32": zlib.crc32(payload) & 0xFFFFFFFF,
                 "server_idx": self.server_idx,
+                # async journal rotation point: restore replays journal
+                # segments >= this (absent/None for sync snapshots) —
+                # observability for operators and the chaos fences
+                "journal_seg": data.get("journal_seg"),
             }
             mtmp = self._manifest_path(dir=target) + ".tmp"
             with open(mtmp, "w") as f:
@@ -302,6 +704,17 @@ class ParameterServer:
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(mtmp, self._manifest_path(dir=target))
+            # the snapshot is durable: journal segments it contains are
+            # no longer needed for replay (crash BEFORE this point keeps
+            # them, so the previous snapshot still has its full tail)
+            jseg = data.get("journal_seg")
+            if own_home and jseg is not None:
+                for seg in self._journal_segments():
+                    if seg < int(jseg):
+                        try:
+                            os.remove(self._journal_path(seg))
+                        except OSError:
+                            pass
 
     def save_checkpoint(self, dir=None):
         if not (dir or self.checkpoint_dir):
@@ -329,6 +742,11 @@ class ParameterServer:
 
         path = self._ckpt_path()
         if not os.path.exists(path):
+            # no snapshot ever landed: the journal (never rotated without
+            # one) holds the ENTIRE applied-update history since birth —
+            # replaying it from segment 0 is a full recovery
+            if self._replay_journal(0):
+                return self._round
             return None
         try:
             with open(path, "rb") as f:
@@ -358,6 +776,7 @@ class ParameterServer:
             sys.stderr.write(
                 "PSERVER checkpoint %s unusable, starting cold: %s\n"
                 % (path, e))
+            self._journal_quarantine()
             return None
         # legacy bare-array sparse entries (pre-slot-state checkpoints):
         # upgrade in the loaded data itself so the rewrite below lands a
@@ -412,6 +831,22 @@ class ParameterServer:
             # replaying get on a flag only the NEXT round sets — a
             # restart during the fetch phase would deadlock the job.
             self._params_ready = True
+        # async delivery fences + clocks: restore from the snapshot, then
+        # let journal replay advance them past it
+        aseq = data.get("async_seq") or {}
+        self._sparse_fence = {
+            (int(t), str(tb)): int(s)
+            for (t, tb), s in (aseq.get("sparse") or {}).items()}
+        self._dense_fence = {
+            int(t): [int(st[0]), set(int(x) for x in st[1])]
+            for t, st in (aseq.get("dense") or {}).items()}
+        self._trainer_clock = {
+            int(t): int(c) for t, c in (aseq.get("clock") or {}).items()}
+        jseg = data.get("journal_seg")
+        if jseg is not None:
+            # the snapshot coordinated with the journal: replay the
+            # segments it does not contain — zero applied updates lost
+            self._replay_journal(int(jseg))
         return self._round
 
     def _maybe_checkpoint(self):
@@ -492,12 +927,15 @@ class ParameterServer:
             return {"ok": True, "live": len(self._live)}
 
     def _ensure_reaper_locked(self):
-        # eviction is a SYNC-mode concept: async mode has no barrier a
-        # ghost can hang, and evicting a merely-partitioned async trainer
-        # would reject its (harmless) updates when it heals — so the
-        # reaper only runs for sync servers
+        # eviction is historically a SYNC-mode concept: async mode has no
+        # barrier a ghost can hang, and evicting a merely-partitioned
+        # async trainer would reject its (harmless) updates when it
+        # heals.  With a staleness bound ARMED, async grows the same
+        # liveness dependency — a dead laggard would park every fast peer
+        # forever — so the reaper runs there too (eviction frees the
+        # bound, preserving the PR 1 progress guarantee).
         if (self._reaper is not None or self._done.is_set()
-                or not self.sync_mode):
+                or not (self.sync_mode or self._staleness_bound > 0)):
             return
         t = threading.Thread(target=self._reaper_loop, daemon=True,
                              name="pserver-reaper-%d" % self.server_idx)
@@ -609,6 +1047,9 @@ class ParameterServer:
             return
         self._live.discard(tid)
         self._tracked.pop(tid, None)
+        # a departed trainer's clock must not hold the staleness bound:
+        # dropping it (and the notify below) releases parked peers
+        self._trainer_clock.pop(tid, None)
         self._evicted.add(tid)
         self.counters["evictions"] += 1
         print("PSERVER EVICT trainer=%d round=%d: %s"
@@ -708,13 +1149,21 @@ class ParameterServer:
                     "incarnation": self.incarnation}
 
     def _h_stats(self, trainer_id=0):
-        """Recovery observability: incarnation, round, live/evicted sets
-        and the eviction/readmission counters (rpc.get_comm_stats's
-        server-side sibling)."""
+        """Recovery observability: incarnation, round, live/evicted sets,
+        the eviction/readmission counters, and — async mode — the
+        per-trainer logical clocks, staleness bound, async send count and
+        journal/park evidence (rpc.get_comm_stats's server-side
+        sibling)."""
         with self._cv:
             out = {"round": self._round, "incarnation": self.incarnation,
                    "live": sorted(self._live),
-                   "evicted": sorted(self._evicted)}
+                   "evicted": sorted(self._evicted),
+                   "async_sends": self._async_sends,
+                   "staleness_bound": self._staleness_bound,
+                   # rpc dict keys must be strings (closed wire types)
+                   "clocks": {str(t): c
+                              for t, c in sorted(
+                                  self._trainer_clock.items())}}
             out.update(self.counters)
             return out
 
@@ -861,45 +1310,68 @@ class ParameterServer:
         self._cv.notify_all()
 
     # ---- handlers --------------------------------------------------------
+    def _apply_async_send_locked(self, name, value):
+        """One async dense grad application, lr-trigger bookkeeping
+        included — the shared core of the live verbs AND journal replay,
+        so a replayed stream advances the lr schedule and the sparse
+        slot-state catch-up identically to the original arrivals."""
+        if name == self._lr_trigger:
+            if self.lr_program is not None:
+                self.exe.run(
+                    self.lr_program, feed={}, fetch_list=[],
+                    scope=self.scope
+                )
+            # per-step catch-up for sparse tables that saw NO rows
+            # since the last trigger: their adam beta-pows advance
+            # and momentum velocity decays exactly as a sync
+            # rowless round would (ADVICE r5; module docstring
+            # documents the residual approximation)
+            for t, info in sorted(self.sparse_tables.items()):
+                if t in self._async_touched:
+                    continue
+                typ = (info.get("opt") or {}).get("type")
+                if typ == "adam":
+                    self._advance_pows(info)
+                elif typ == "momentum":
+                    self._apply_sparse(
+                        t, np.zeros((0,), np.int64),
+                        np.zeros((0, info["tbl"].shape[1]),
+                                 info["tbl"].dtype),
+                        advance_pows=False)
+            self._async_touched.clear()
+        self._apply_shard(self.grad_to_shard[name], {name: value})
+        self._async_sends += 1
+
+    def _async_dense_ckpt_locked(self):
+        """Checkpoint cadence for async dense traffic, checked ONLY
+        after the triggering bucket's journal record + fence commit are
+        down.  Firing mid-bucket (the old per-send modulo inside the
+        apply) captured a snapshot containing the bucket's effects and
+        rotated the journal BEFORE that bucket's record was appended —
+        the record then sat past the rotation point and a restore
+        replayed it onto state that already contained it (double
+        apply)."""
+        if self._replaying or not self.checkpoint_dir:
+            return
+        cadence = self.checkpoint_every * max(1, len(self.grad_to_shard))
+        if self._async_sends - self._sends_at_ckpt >= cadence:
+            self._sends_at_ckpt = self._async_sends
+            self._round += 1
+            self._maybe_checkpoint()
+
     def _h_send(self, name, value, trainer_id=0):
         value = np.asarray(value)
         if not self.sync_mode:
             with self._lock:
                 self._touch(trainer_id)
-                if name == self._lr_trigger:
-                    if self.lr_program is not None:
-                        self.exe.run(
-                            self.lr_program, feed={}, fetch_list=[],
-                            scope=self.scope
-                        )
-                    # per-step catch-up for sparse tables that saw NO rows
-                    # since the last trigger: their adam beta-pows advance
-                    # and momentum velocity decays exactly as a sync
-                    # rowless round would (ADVICE r5; module docstring
-                    # documents the residual approximation)
-                    for t, info in sorted(self.sparse_tables.items()):
-                        if t in self._async_touched:
-                            continue
-                        typ = (info.get("opt") or {}).get("type")
-                        if typ == "adam":
-                            self._advance_pows(info)
-                        elif typ == "momentum":
-                            self._apply_sparse(
-                                t, np.zeros((0,), np.int64),
-                                np.zeros((0, info["tbl"].shape[1]),
-                                         info["tbl"].dtype),
-                                advance_pows=False)
-                    self._async_touched.clear()
-                self._apply_shard(self.grad_to_shard[name], {name: value})
-                self._async_sends += 1
-                if (
-                    self.checkpoint_dir
-                    and self._async_sends
-                    % (self.checkpoint_every * max(1, len(self.grad_to_shard)))
-                    == 0
-                ):
-                    self._round += 1
-                    self._maybe_checkpoint()
+                self._apply_async_send_locked(name, value)
+                # legacy per-var path: journaled (a restart replays it)
+                # but UNFENCED — only the bucketed path carries aseq
+                # tokens, so exactly-once across SIGKILL needs buckets
+                self._journal_append_locked(
+                    {"k": "v", "n": name, "v": value,
+                     "tid": int(trainer_id)})
+                self._async_dense_ckpt_locked()
             return {"ok": True}
         with self._lock:
             self._touch(trainer_id)
@@ -910,7 +1382,8 @@ class ParameterServer:
         return {"ok": True}
 
     def _h_send_bucket(self, blocks, trainer_id=0, seq_total=None,
-                       step=None, seq_idx=None, sparse_tables=None):
+                       step=None, seq_idx=None, sparse_tables=None,
+                       aseq=None):
         """Coalesced grad frame: `blocks` maps grad block name -> value,
         shipped as ONE rpc round trip (see ops/dist_ops.py send_bucket).
         Server-side the bucket is unpacked into exactly the per-block
@@ -941,10 +1414,40 @@ class ParameterServer:
             # trigger with another bucket's grads — one more term of the
             # documented async approximation (module docstring); sync
             # mode is exact, its ordering comes from the round barrier.
-            for name in sorted(blocks):
-                r = self._h_send(name, blocks[name], trainer_id)
-                if isinstance(r, dict) and r.get("evicted"):
-                    return r
+            with self._cv:
+                self._touch(trainer_id)
+                tid = int(trainer_id)
+                if tid in self._evicted:
+                    return {"ok": False, "evicted": True}
+                if aseq is not None and self._dense_fence_is_dup(tid, aseq):
+                    # at-least-once re-delivery (RPC retry straddling a
+                    # restart, or an incarnation-bump re-ship) of a bucket
+                    # whose apply is already durable: drop, never double
+                    self.counters["dedup_drops"] += 1
+                    return {"ok": True, "dup": True,
+                            "acked": self._dense_fence[tid][0]}
+                # NOTE: aseq never feeds _trainer_clock — it counts
+                # BUCKETS per endpoint, not steps, so a multi-bucket
+                # model would inflate a laggard's clock by the bucket
+                # count and silently defeat the staleness bound.  The
+                # clock is the sparse seq token alone (minted once per
+                # STEP and shipped to every server, empties included).
+                vals = {n: np.asarray(v) for n, v in blocks.items()}
+                for name in sorted(vals):
+                    self._apply_async_send_locked(name, vals[name])
+                if aseq is not None:
+                    # journal + fsync BEFORE the reply: an acked bucket is
+                    # durable, an unacked one is re-shipped — exactly-once
+                    # either way (the fence drops the dup)
+                    self._journal_append_locked(
+                        {"k": "d", "b": vals, "tid": tid, "q": aseq})
+                    self._dense_fence_commit(tid, aseq)
+                    self._async_dense_ckpt_locked()
+                    return {"ok": True,
+                            "acked": self._dense_fence[tid][0]}
+                self._journal_append_locked(
+                    {"k": "d", "b": vals, "tid": tid, "q": None})
+                self._async_dense_ckpt_locked()
             return {"ok": True}
         with self._cv:
             self._touch(trainer_id)
@@ -1183,12 +1686,21 @@ class ParameterServer:
         return np.asarray(var)
 
     # ---- sparse embedding shards (distributed lookup table) -------------
-    def _h_prefetch(self, table, ids, trainer_id=0):
-        """Serve embedding rows by local row id (prefetch_op analog)."""
+    def _h_prefetch(self, table, ids, trainer_id=0, clock=None):
+        """Serve embedding rows by local row id (prefetch_op analog).
+        `clock` (async fenced mode) is the requesting trainer's logical
+        clock: a lookup from a trainer past the staleness bound parks
+        here — the read side of the bound, so a fast trainer cannot even
+        OBSERVE rows more than `bound` steps ahead of the laggard."""
         tbl = self.sparse_tables[table]["tbl"]
         ids = np.asarray(ids).reshape(-1)
         ids = np.clip(ids, 0, tbl.shape[0] - 1)
-        with self._lock:
+        with self._cv:
+            if clock is not None and not self.sync_mode:
+                tid = int(trainer_id)
+                self._touch(tid)
+                self._clock_update_locked(tid, clock)
+                self._park_if_stale_locked(tid, clock)
             return tbl[ids].copy()
 
     def _sparse_lr_value(self, info):
@@ -1291,34 +1803,80 @@ class ParameterServer:
         else:
             raise ValueError("unknown sparse optimizer %r" % typ)
 
-    def _h_send_sparse(self, table, ids, rows, trainer_id=0, step=None):
+    def _h_send_sparse(self, table, ids, rows, trainer_id=0, step=None,
+                       seq=None):
         """Sparse optimizer update on this server's rows (SelectedRows
         grad).  Sync mode queues until the round barrier so the update
         sees this round's scheduled lr and all trainers' rows merge into
         ONE application (the reference's optimizer-sub-block-at-barrier
-        semantics); async applies immediately.  `step` is the dense
+        semantics); async applies immediately.  `step` is the sync dense
         stream's fence token: a fenced replay of a round this server
         already folded (it survived in the restored snapshot) is dropped
-        so its rows cannot leak into the NEXT round."""
+        so its rows cannot leak into the NEXT round.
+
+        `seq` (async fenced delivery, docs/FAULT_TOLERANCE.md): the
+        per-(trainer, table) sequence token the transpiler-stamped async
+        ops mint once per STEP (shipped to every server, empty chunks
+        included, so seq doubles as the trainer's logical clock).  The
+        fence is monotonic — sends are serial per trainer, so a seq at
+        or below the durably-applied high-water is an at-least-once
+        re-delivery and drops (`dup`); the reply acks the high-water so
+        the client can prune its resend queue.  Applied non-empty chunks
+        are journaled + fsync'd BEFORE the ack, making ack == durable.
+        The seq also drives the bounded-staleness park: a trainer
+        running more than FLAGS_async_staleness_bound ahead of the
+        slowest live peer waits here until the laggard advances or
+        departs."""
         ids = np.asarray(ids).reshape(-1)
         rows = np.asarray(rows)
-        with self._lock:
+        with self._cv:
             self._touch(trainer_id)
-            if int(trainer_id) in self._evicted:
+            tid = int(trainer_id)
+            if tid in self._evicted:
                 return {"ok": False, "evicted": True}
             if (self.sync_mode and step is not None
-                    and int(step) <= self._folded_send.get(
-                        int(trainer_id), -1)):
+                    and int(step) <= self._folded_send.get(tid, -1)):
                 self.counters["dup_round_drops"] += 1
                 return {"ok": True, "dup_round": True}
             if self.sync_mode:
                 # keyed overwrite: a fenced replay of this round's chunk
                 # replaces rather than double-queues (dist_ops ships one
                 # chunk per (table, server) per step)
-                self._pending_sparse[(int(trainer_id), table)] = (ids, rows)
-            else:
+                self._pending_sparse[(tid, table)] = (ids, rows)
+                return {"ok": True}
+            # ---- async path ------------------------------------------
+            key = (tid, str(table))
+            if seq is not None:
+                seq = int(seq)
+                fence = self._sparse_fence.get(key, 0)
+                if seq <= fence:
+                    self.counters["dedup_drops"] += 1
+                    return {"ok": True, "dup": True, "acked": fence}
+                self._clock_update_locked(tid, seq)
+                self._park_if_stale_locked(tid, seq)
+                if tid in self._evicted:  # evicted while parked
+                    return {"ok": False, "evicted": True}
+            if ids.size:
                 self._async_touched.add(table)
                 self._apply_sparse(table, ids, rows)
+                # durable BEFORE the ack; empty (clock-only) chunks skip
+                # the journal — the fence is monotonic, so the restored
+                # high-water tolerating their seq gap is safe
+                self._journal_append_locked(
+                    {"k": "s", "t": str(table), "i": ids, "r": rows,
+                     "tid": tid, "q": seq})
+            if seq is not None:
+                # fence commit BEFORE the rotation check: a snapshot
+                # capturing the applied chunk but not its fence would
+                # let a re-delivery through post-restore (double apply)
+                self._sparse_fence[key] = seq
+            # rotation cadence runs for EVERY journaled chunk — unfenced
+            # (hybrid-collective / legacy) streams journal too, and with
+            # dense traffic riding the mesh nothing else would ever
+            # bound the segment's growth
+            self._journal_maybe_snapshot_locked()
+            if seq is not None:
+                return {"ok": True, "acked": seq}
         return {"ok": True}
 
     def _h_checkpoint_notify(self, dir=None, trainer_id=0):
@@ -1348,6 +1906,9 @@ class ParameterServer:
                 self._live.pop()
                 self._completed.add(tid)  # once: repeats must not re-pop
             self._tracked.pop(tid, None)
+            # completion frees the staleness bound exactly like eviction
+            # (the notify_all below wakes any parked fast peer)
+            self._trainer_clock.pop(tid, None)
             # a departing trainer may unblock a pending round.  Its SEND
             # entry is kept (a clean departure's grads still count toward
             # the round it joined) but its FETCH entry is dropped: "I
@@ -1471,6 +2032,14 @@ def run_pserver(program, scope, executor=None):
     if restored is not None:
         print("PSERVER RESTORED round=%d incarnation=%d"
               % (restored, service.incarnation), flush=True)
+    elif service._journal_enabled():
+        # journal armed, cold start: land a BIRTH snapshot (synchronous,
+        # before the listener opens, so no update can precede it).  The
+        # journal records deltas; without a persisted base a restore
+        # before the first cadence snapshot would replay them onto a
+        # freshly re-initialized table — only bit-identical to the dead
+        # incarnation's when the startup init happens to be seeded.
+        service.save_checkpoint()
     server = make_var_server(a["endpoint"], service).start()
     try:
         service.wait_done()
@@ -1483,4 +2052,5 @@ def run_pserver(program, scope, executor=None):
 
         print("PSERVER-STATS " + _json.dumps(
             dict(service.counters, round=service._round,
-                 incarnation=service.incarnation)), flush=True)
+                 incarnation=service.incarnation,
+                 async_sends=service._async_sends)), flush=True)

@@ -145,10 +145,17 @@ def _hmac_key():
 # observability counters (docs/FAULT_TOLERANCE.md): incarnation bumps
 # observed, fenced round replays performed, and total time-to-recover.
 _comm_lock = threading.Lock()
+# async_sparse_sends / async_dedup_drops / async_resends are the async
+# fenced-delivery evidence (docs/FAULT_TOLERANCE.md): chunks shipped with
+# seq tokens, server-side fence drops the client WITNESSED (a dup ack
+# means an at-least-once re-delivery was absorbed exactly-once), and
+# unacked chunks re-shipped after an observed incarnation bump.
 _comm_stats = {"rpc_round_trips": 0, "comm_bytes_sent": 0,
                "comm_bytes_recv": 0, "comm_bytes_saved": 0,
                "pserver_restarts_seen": 0,
-               "recoveries": 0, "recovery_ms": 0.0}
+               "recoveries": 0, "recovery_ms": 0.0,
+               "async_sparse_sends": 0, "async_dedup_drops": 0,
+               "async_resends": 0}
 # per-verb round-trip breakdown (rides get_comm_stats as "rpc_verbs"):
 # the collective dense-grad backend is ACCEPTED on this evidence — a
 # hybrid run must show zero send/send_bucket/recv/get_bucket trips while
@@ -173,6 +180,17 @@ def note_recovery(ms):
         _comm_stats["recoveries"] += 1
         _comm_stats["recovery_ms"] = round(
             _comm_stats["recovery_ms"] + ms, 3)
+
+
+def note_async(**deltas):
+    """Bump the async fenced-delivery counters (trainer-side dist ops):
+    async_sparse_sends / async_dedup_drops / async_resends.  Counted at
+    the CLIENT so the COUNTERS line bench legs aggregate finally sees
+    async traffic — `stats`' server-side async_sends used to be the only
+    record of it."""
+    with _comm_lock:
+        for k, v in deltas.items():
+            _comm_stats[k] += v
 
 
 def note_bytes_saved(n):

@@ -11,7 +11,11 @@ Wired flags: check_nan_inf (executor fetch scan), benchmark (per-run
 timing log), rpc_deadline / max_retry (RPC client), enable_rpc_profiler
 (RecordEvent spans around RPC calls), heartbeat_interval /
 eviction_deadline (trainer liveness + pserver barrier eviction,
-docs/FAULT_TOLERANCE.md).  The remaining knobs are accepted
+docs/FAULT_TOLERANCE.md), async_journal / async_staleness_bound /
+sparse_hot_rows / sparse_hot_ttl (durable async sparse: write-ahead
+journal, bounded staleness, trainer-side hot-row prefetch cache —
+docs/FAULT_TOLERANCE.md "Durable async sparse").  The remaining knobs
+are accepted
 for script compatibility and are no-ops under XLA (their help text says
 so) — memory budgeting belongs to PJRT and fusion to the compiler.
 
@@ -175,6 +179,37 @@ DEFINE_flag("ps_fused_apply", True,
             "of one executor program run per block; shard programs the "
             "fuser cannot prove equivalent fall back to the per-block "
             "path automatically (0 disables the fused path entirely)")
+DEFINE_flag("async_journal", True,
+            "async pserver mode: append every applied sparse chunk / dense "
+            "bucket to a crc-framed, fsync'd write-ahead journal next to "
+            "the checkpoint (rotated at each snapshot).  A restarted "
+            "incarnation replays journal-after-snapshot, so an async "
+            "restart loses ZERO applied updates; corrupt/truncated tail "
+            "records are skipped cold with a counter, like corrupt "
+            "snapshots.  Needs a checkpoint dir; 0 restores the old "
+            "lose-since-last-checkpoint behavior")
+DEFINE_flag("async_staleness_bound", 0,
+            "async pserver mode: park pushes/prefetches from a trainer "
+            "whose logical clock (its per-table send_sparse seq tokens) "
+            "runs more than this many steps ahead of the slowest live "
+            "peer, releasing when the laggard catches up or departs "
+            "(eviction/complete frees the bound).  0 = unbounded — the "
+            "pre-bound fire-and-forget behavior")
+DEFINE_flag("sparse_hot_rows", 0,
+            "async pserver mode: trainer-side hot-row cache capacity (rows "
+            "per table) for distributed-lookup prefetch.  Hits skip the "
+            "prefetch RPC; pushed grads update the cached copy through "
+            "the table's own optimizer rule (sgd mirrors exactly), and "
+            "entries refresh from the server every "
+            "FLAGS_sparse_hot_ttl steps so multi-trainer drift is "
+            "corrected instead of accumulating.  Only engages where the "
+            "mirror is exact: sgd, constant lr, uncompressed f32 sparse "
+            "wire (a bf16 wire means the server applies DECODED grads "
+            "the client does not hold).  0 disables the cache")
+DEFINE_flag("sparse_hot_ttl", 8,
+            "steps a hot-row cache entry may serve before it must be "
+            "re-fetched from its pserver (the drift-correction refresh "
+            "for FLAGS_sparse_hot_rows)")
 DEFINE_flag("comm_inflight", 4,
             "window of in-flight bucket RPCs per pserver endpoint: bucket "
             "N+1 serializes and sends while bucket N is on the wire; "

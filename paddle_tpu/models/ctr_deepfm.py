@@ -11,15 +11,19 @@ from .. import ParamAttr, layers
 
 
 def deepfm(sparse_ids, dense_input, sparse_field_dims, embed_dim=8,
-           dnn_dims=(32, 32), is_sparse=False):
+           dnn_dims=(32, 32), is_sparse=False, is_distributed=False):
     """sparse_ids: list of int64 [batch, 1] vars (one per field);
     dense_input: [batch, D] float var or None.
+    is_distributed routes the embedding tables through the pserver
+    prefetch/send_sparse path when transpiled (the planet-scale sparse
+    scenario: high row-churn over sharded tables).
     Returns sigmoid CTR prediction [batch, 1]."""
     # first order: per-field scalar weight
     first = []
     for i, (ids, dim) in enumerate(zip(sparse_ids, sparse_field_dims)):
         w = layers.embedding(
             ids, size=[dim, 1], dtype="float32", is_sparse=is_sparse,
+            is_distributed=is_distributed,
             param_attr=ParamAttr(name="fm_w1_%d" % i),
         )
         first.append(layers.reshape(w, [-1, 1]))
@@ -30,6 +34,7 @@ def deepfm(sparse_ids, dense_input, sparse_field_dims, embed_dim=8,
     for i, (ids, dim) in enumerate(zip(sparse_ids, sparse_field_dims)):
         e = layers.embedding(
             ids, size=[dim, embed_dim], dtype="float32", is_sparse=is_sparse,
+            is_distributed=is_distributed,
             param_attr=ParamAttr(name="fm_v_%d" % i),
         )
         embs.append(layers.reshape(e, [-1, 1, embed_dim]))
@@ -57,7 +62,8 @@ def deepfm(sparse_ids, dense_input, sparse_field_dims, embed_dim=8,
 
 
 def build_deepfm_train(sparse_field_dims, dense_dim=4, embed_dim=8,
-                       is_sparse=False, with_auc=False):
+                       is_sparse=False, with_auc=False,
+                       is_distributed=False):
     """Returns (feeds, avg_loss, pred) — or, with_auc=True, (feeds,
     avg_loss, pred, auc, batch_auc): the reference CTR-eval workflow
     (dist_ctr.py) with the in-graph streaming layers.auc — global AUC
@@ -69,7 +75,7 @@ def build_deepfm_train(sparse_field_dims, dense_dim=4, embed_dim=8,
     dense = layers.data("dense", shape=[dense_dim]) if dense_dim else None
     label = layers.data("click", shape=[1])
     pred = deepfm(sparse_ids, dense, sparse_field_dims, embed_dim,
-                  is_sparse=is_sparse)
+                  is_sparse=is_sparse, is_distributed=is_distributed)
     loss = layers.mean(layers.log_loss(pred, label, epsilon=1e-6))
     feeds = sparse_ids + ([dense] if dense is not None else []) + [label]
     if with_auc:

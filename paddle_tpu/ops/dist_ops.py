@@ -129,6 +129,195 @@ _BLOCKING_TIMEOUT = 1200.0
 _fences = {}  # endpoint -> {"inc", "step", "fstep", "sends", "sparse"}
 _MAX_ROUND_REPLAYS = 6
 
+# ---- async fenced delivery (durable async sparse) -----------------------
+# Per-endpoint client state for ASYNC mode (transpiler-stamped
+# async_fence attr): every send_sparse chunk carries a per-(table)
+# sequence token (minted once per STEP — empty chunks ship too, so the
+# seq doubles as this trainer's logical clock at every server), the
+# server acks the highest durably-applied seq, un-acked chunks sit in a
+# bounded resend queue, and an observed incarnation bump re-ships them
+# before any new traffic — at-least-once delivery that the server's seq
+# fence + write-ahead journal turn into exactly-once across SIGKILL
+# (docs/FAULT_TOLERANCE.md).  Dense async buckets carry their own aseq
+# token; their re-delivery rides the RPC-layer retry (the reply IS the
+# ack — a call never returns unacked), deduped by the same server fence.
+_ASYNC_RESEND_MAX = 256
+
+
+def _async_st(ep):
+    """Async keys of the per-endpoint fence state (lazily added so the
+    sync-path state dicts stay unchanged)."""
+    st = _fence(ep)
+    if "sseq" not in st:
+        st["ainc"] = None   # async incarnation baseline
+        st["aseq"] = 0      # dense async bucket seq
+        st["sseq"] = {}     # table -> last minted sparse seq
+        st["unacked"] = {}  # table -> {seq: send_sparse kwargs}
+    return st
+
+
+def _async_note_ack(st, table, reply):
+    """Prune the resend queue up to the server's acked high-water; a
+    `dup` reply is a witnessed exactly-once drop (counted)."""
+    if not isinstance(reply, dict):
+        return
+    from ..distributed import rpc as _rpc
+
+    if reply.get("dup"):
+        _rpc.note_async(async_dedup_drops=1)
+    acked = reply.get("acked")
+    if acked is None:
+        return
+    uq = st["unacked"].get(table)
+    if uq:
+        for seq in [s for s in uq if s <= int(acked)]:
+            del uq[seq]
+
+
+def _async_check_replay(cli, ep, trainer_id):
+    """Observed incarnation bump: the pserver restarted — re-ship every
+    un-acked chunk (in seq order) before any new traffic, so nothing the
+    dead incarnation only held in memory is silently lost.  The server's
+    monotonic seq fence absorbs re-delivery of anything its journal
+    replay already restored (`dup`)."""
+    from ..distributed import rpc as _rpc
+
+    st = _async_st(ep)
+    cur = _rpc.incarnation_of(ep)
+    if st["ainc"] is None:
+        st["ainc"] = cur
+        return
+    if cur is None or cur == st["ainc"]:
+        return
+    import time
+
+    t0 = time.perf_counter()
+    n = 0
+    for table in sorted(st["unacked"]):
+        for seq in sorted(st["unacked"][table]):
+            kw = st["unacked"][table].get(seq)
+            if kw is None:
+                continue
+            r = cli.call("send_sparse", **kw)
+            _check_not_evicted(r, ep, trainer_id)
+            _async_note_ack(st, table, r)
+            n += 1
+    st["ainc"] = cur
+    if n:
+        _rpc.note_async(async_resends=n)
+        _rpc.note_recovery((time.perf_counter() - t0) * 1e3)
+
+
+# ---- trainer-side hot-row cache (FLAGS_sparse_hot_rows) -----------------
+class HotRowCache:
+    """LRU row cache for ASYNC distributed-lookup prefetch: hits skip the
+    prefetch RPC entirely.  Pushed grads MIRROR the server's sgd apply on
+    the cached copy (duplicates merged exactly like
+    ps_server._apply_sparse, so a single-trainer cached run matches the
+    cache-off run bit for bit between refreshes), and every entry is
+    re-fetched after `ttl` steps so multi-trainer drift is corrected
+    instead of accumulating.  The refresh keeps a per-row RESIDUAL — the
+    drift other trainers contributed over the last window (the PR 5
+    `_ef_residuals` discipline, keyed per row instead of per block) —
+    and feeds `residual/ttl` forward into each mirrored step as a
+    predictor, so steady cross-trainer traffic is tracked between
+    refreshes rather than ignored until the next one."""
+
+    def __init__(self, capacity, ttl, lr):
+        from collections import OrderedDict
+
+        self.capacity = int(capacity)
+        self.ttl = max(1, int(ttl))
+        self.lr = float(lr)
+        self.rows = OrderedDict()  # gid -> [row, expire_step]
+        self.residuals = {}        # gid -> drift observed at last refresh
+        self.step = 0
+        self.hits = 0
+        self.misses = 0
+
+    def tick(self):
+        self.step += 1
+
+    def lookup(self, gids):
+        """Returns (hit dict gid->row copy, miss mask over gids)."""
+        hits = {}
+        miss = np.ones(len(gids), bool)
+        for i, g in enumerate(gids):
+            g = int(g)
+            ent = self.rows.get(g)
+            if ent is not None and ent[1] > self.step:
+                self.rows.move_to_end(g)
+                hits[g] = ent[0]
+                miss[i] = False
+        self.hits += len(gids) - int(miss.sum())
+        self.misses += int(miss.sum())
+        return hits, miss
+
+    def insert(self, gids, rows):
+        """Fresh server truth: correct the mirror, record the drift
+        residual (truth - local estimate) for the predictor, rearm TTL.
+        A gid repeated within one miss batch records its residual ONCE —
+        the second occurrence carries the same truth the first just
+        stored, and `truth - truth = 0` would wipe the predictor for
+        exactly the hottest rows."""
+        seen = set()
+        for g, row in zip(gids, rows):
+            g = int(g)
+            row = np.array(row)
+            if g not in seen:
+                seen.add(g)
+                ent = self.rows.get(g)
+                if ent is not None:
+                    self.residuals[g] = row - ent[0]
+            self.rows[g] = [row, self.step + self.ttl]
+            self.rows.move_to_end(g)
+        while len(self.rows) > self.capacity:
+            old, _ = self.rows.popitem(last=False)
+            self.residuals.pop(old, None)
+
+    def push(self, gids, grads):
+        """Mirror one push on the cached copies: merged duplicate ids,
+        row -= lr * g (+ the drift predictor) — sgd-exact locally."""
+        gids = np.asarray(gids).reshape(-1)
+        grads = np.asarray(grads).reshape(gids.size, -1)
+        uids, inv = np.unique(gids, return_inverse=True)
+        merged = np.zeros((uids.size, grads.shape[1]), grads.dtype)
+        np.add.at(merged, inv, grads)
+        for g, gm in zip(uids, merged):
+            ent = self.rows.get(int(g))
+            if ent is None:
+                continue
+            dt = ent[0].dtype
+            # compute wide, round back to the row dtype — the exact
+            # rounding numpy's in-place f32 apply does server-side, so
+            # the sgd mirror stays bit-identical between refreshes
+            row = np.asarray(ent[0] - self.lr * gm, dtype=dt)
+            res = self.residuals.get(int(g))
+            if res is not None:
+                row = np.asarray(row + res / float(self.ttl), dtype=dt)
+            ent[0] = row
+
+
+_hot_caches = {}  # tuple(table_names) -> HotRowCache
+
+
+def _hot_cache_for(table_names, hot_opt):
+    """Resolve (or build) the cache shared by a table's prefetch and
+    send_sparse ops.  None when disabled: flag off, non-sgd optimizer,
+    or a scheduled lr the client cannot mirror."""
+    from ..flags import get_flag
+
+    cap = int(get_flag("sparse_hot_rows"))
+    if cap <= 0 or not hot_opt or hot_opt.get("type") != "sgd" \
+            or hot_opt.get("lr") is None:
+        return None
+    key = tuple(table_names)
+    cache = _hot_caches.get(key)
+    if cache is None:
+        cache = _hot_caches[key] = HotRowCache(
+            cap, int(get_flag("sparse_hot_ttl")), float(hot_opt["lr"]))
+    return cache
+
 # ---- wire compression (FLAGS_comm_wire_dtype / FLAGS_comm_grad_int8) ---
 # int8 error-feedback residuals, TRAINER-side per (endpoint, block):
 # each round quantizes (grad + residual) and keeps the quantization
@@ -143,6 +332,7 @@ def reset_fences():
     """Test isolation hook (mirrors rpc.reset_comm_stats)."""
     _fences.clear()
     _ef_residuals.clear()
+    _hot_caches.clear()
 
 
 def _fence(ep):
@@ -364,6 +554,10 @@ def _send_bucket(ctx, ins, attrs):
     plan = [(ep, [(int(xi), int(b), int(e), bn) for xi, b, e, bn in entries])
             for ep, entries in attrs["buckets"]]
     trainer_id = int(attrs.get("trainer_id", 0))
+    # async fenced delivery: each async bucket carries a per-endpoint
+    # aseq token; the server journals the applied bucket and dedupes an
+    # RPC-retry re-delivery straddling a restart (exactly-once)
+    async_fence = bool(attrs.get("async_fence"))
     # sync mode: per-endpoint bucket counts — the server folds the send
     # barrier into the arrival of the LAST bucket (ps_server), so that
     # submit may block round-long and gets the blocking timeout
@@ -397,9 +591,19 @@ def _send_bucket(ctx, ins, attrs):
         for ep, blist in per_ep.items():
             total = totals.get(ep)
             if not total:
-                for blocks in blist:  # async: no folding, no fencing
-                    pipe(ep).submit("send_bucket", blocks=blocks,
-                                    trainer_id=trainer_id, seq_total=None)
+                if async_fence:
+                    st = _async_st(ep)
+                    for blocks in blist:
+                        st["aseq"] += 1
+                        pipe(ep).submit(
+                            "send_bucket", blocks=blocks,
+                            trainer_id=trainer_id, seq_total=None,
+                            aseq=st["aseq"])
+                else:
+                    for blocks in blist:  # async legacy: unfenced
+                        pipe(ep).submit("send_bucket", blocks=blocks,
+                                        trainer_id=trainer_id,
+                                        seq_total=None)
                 continue
             # sync: mint this round's step token, record the stream for
             # incarnation-fenced replay, stamp each bucket's seq_idx so
@@ -565,6 +769,12 @@ def _prefetch(ctx, ins, attrs):
     emb_dim = int(attrs["emb_dim"])
     trainer_id = int(attrs.get("trainer_id", 0))
     collective = bool(attrs.get("collective"))
+    # async fenced mode (transpiler-stamped): lookups carry this
+    # trainer's logical clock so the server can PARK a reader running
+    # past FLAGS_async_staleness_bound, and a hot-row cache
+    # (FLAGS_sparse_hot_rows) serves repeat ids without the RPC
+    async_fence = bool(attrs.get("async_fence"))
+    hot_opt = attrs.get("hot_opt")
     n = len(epmap)
 
     id_shape = tuple(ids.shape)
@@ -583,13 +793,34 @@ def _prefetch(ctx, ins, attrs):
         server id%n, rows merge back in input order."""
         flat = np.asarray(ids_v).reshape(-1).astype(np.int64)
         out = np.zeros((flat.size, emb_dim), dtype=np.float32)
+        cache = (_hot_cache_for(table_names, hot_opt)
+                 if async_fence and not collective else None)
+        want = np.ones(flat.size, bool)
+        if cache is not None:
+            cache.tick()
+            hits, want = cache.lookup(flat)
+            for i, g in enumerate(flat):
+                if not want[i]:
+                    out[i] = hits[int(g)]
+        clock = None
         for s in range(n):
-            mask = (flat % n) == s
+            ep = epmap[s]
+            if async_fence and not collective:
+                cli = cli_for(ep, tid)
+                _async_check_replay(cli, ep, tid)
+                st = _async_st(ep)
+                clock = max(st["sseq"].values()) if st["sseq"] else None
+            mask = want & ((flat % n) == s)
             if not mask.any():
                 continue
-            rows = np.asarray(cli_for(epmap[s], tid).prefetch(
-                table_names[s], flat[mask] // n, tid))
+            kw = dict(table=table_names[s], ids=flat[mask] // n,
+                      trainer_id=tid)
+            if clock is not None:
+                kw["clock"] = clock
+            rows = np.asarray(cli_for(ep, tid).call("prefetch", **kw))
             out[mask] = rows
+            if cache is not None:
+                cache.insert(flat[mask], rows)
         return out.reshape(out_shape)
 
     struct = jax.ShapeDtypeStruct(out_shape, jnp.float32)
@@ -639,6 +870,13 @@ def _send_sparse(ctx, ins, attrs):
     scale = float(attrs.get("scale", 1.0))
     sync_mode = bool(attrs.get("sync_mode", False))
     collective = bool(attrs.get("collective"))
+    # async fenced delivery (transpiler-stamped): chunks carry per-table
+    # seq tokens, ship to EVERY server each step (empty chunks included,
+    # so the seq is a uniform logical clock — rowless routing must not
+    # make a healthy trainer look stalled to some shard), and un-acked
+    # chunks re-ship on an incarnation bump
+    async_fence = bool(attrs.get("async_fence"))
+    hot_opt = attrs.get("hot_opt")
     wire_dtype = str(attrs.get("wire_dtype") or "float32")
     n = len(epmap)
 
@@ -668,11 +906,43 @@ def _send_sparse(ctx, ins, attrs):
         records the chunk for incarnation-fenced replay."""
         flat = np.asarray(ids_v).reshape(-1).astype(np.int64)
         g = np.asarray(grad_v).reshape(flat.size, -1) * scale
+        if async_fence and not collective:
+            cache = _hot_cache_for(table_names, hot_opt)
+            if cache is not None:
+                # mirror the push on the cached copies BEFORE shipping:
+                # the next (cache-hit) lookup sees this step's update
+                cache.push(flat, g)
         for s in range(n):
             mask = (flat % n) == s
+            ep = epmap[s]
+            if async_fence and not collective:
+                from ..distributed import rpc as _rpc
+
+                cli = cli_for(ep, tid)
+                _async_check_replay(cli, ep, tid)
+                st = _async_st(ep)
+                table = table_names[s]
+                seq = st["sseq"].get(table, 0) + 1
+                st["sseq"][table] = seq
+                kw = dict(table=table, ids=flat[mask] // n,
+                          rows=_wrap_rows(g[mask]), trainer_id=tid,
+                          seq=seq)
+                uq = st["unacked"].setdefault(table, {})
+                if len(uq) >= _ASYNC_RESEND_MAX:
+                    raise RuntimeError(
+                        "async resend queue for %s@%s overflowed (%d "
+                        "un-acked chunks): the pserver has not acked in "
+                        "%d steps — failing loudly instead of dropping "
+                        "durability" % (table, ep, len(uq),
+                                        _ASYNC_RESEND_MAX))
+                uq[seq] = kw
+                r = cli.call("send_sparse", **kw)
+                _check_not_evicted(r, ep, tid)
+                _async_note_ack(st, table, r)
+                _rpc.note_async(async_sparse_sends=1)
+                continue
             if not mask.any():
                 continue
-            ep = epmap[s]
             kw = dict(table=table_names[s], ids=flat[mask] // n,
                       rows=_wrap_rows(g[mask]), trainer_id=tid)
             if sync_mode:

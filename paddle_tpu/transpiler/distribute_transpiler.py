@@ -329,6 +329,28 @@ class DistributeTranspiler:
         # the rpc ops run per mesh REPLICA (dynamic trainer rank from
         # lax.axis_index instead of the static process-wide id)
         hybrid = self.config.mode == "collective"
+        # async pserver mode: stamp the fenced-delivery contract
+        # (docs/FAULT_TOLERANCE.md, async section) — per-table seq tokens
+        # on send_sparse (journaled + deduped server-side, re-shipped on
+        # an incarnation bump), logical clocks on prefetch (bounded
+        # staleness), and the hot-row cache's mirror rule when the
+        # table's optimizer is client-mirrorable (sgd, constant lr)
+        async_fence = (not self.sync_mode) and not hybrid
+
+        def hot_opt_for(info):
+            """Mirror spec for the trainer-side hot-row cache — or None
+            when the client CANNOT mirror the server's apply exactly: a
+            compressed sparse wire means the server applies the
+            bf16-DECODED grad, not the values the client holds, so the
+            cache would drift between refreshes and misattribute the
+            rounding error to other trainers via the residual
+            predictor.  (dist_ops additionally requires sgd + a
+            constant lr.)"""
+            if self.comm_wire_dtype != "float32":
+                return None
+            return {"type": info["opt"]["type"],
+                    "lr": info["opt"].get("lr_const")}
+
         new_ops = []
         for op in block.ops:
             if (
@@ -348,6 +370,8 @@ class DistributeTranspiler:
                         "emb_dim": info["emb_dim"],
                         "trainer_id": self.trainer_id,
                         "collective": hybrid,
+                        "async_fence": async_fence,
+                        "hot_opt": hot_opt_for(info),
                         "op_role": "rpc",
                     },
                 )
@@ -379,6 +403,8 @@ class DistributeTranspiler:
                         # its sparse chunks apply on arrival
                         "sync_mode": self.sync_mode and not hybrid,
                         "collective": hybrid,
+                        "async_fence": async_fence,
+                        "hot_opt": hot_opt_for(info),
                         # sparse row VALUES ride the planned wire dtype
                         # (ids/rows counts stay exact; bf16 halves the
                         # value payload — PR 5's documented f32-only gap)
@@ -492,6 +518,9 @@ class DistributeTranspiler:
                         else {},
                         "wire_dtype": self.comm_wire_dtype,
                         "grad_int8": self.comm_grad_int8,
+                        # async mode: aseq-fenced buckets — journaled
+                        # server-side, deduped across a restart
+                        "async_fence": not self.sync_mode,
                         "trainer_id": self.trainer_id,
                     },
                 )

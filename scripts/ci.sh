@@ -45,6 +45,19 @@ else
         tests/test_rpc_wire.py tests/test_dist_transpiler.py -q -m ""
 fi
 
+echo "== durable-async chaos pass (journal + fences + staleness) =="
+# the async-sparse durability story end to end under the SAME pinned
+# fault seed as the rest of the chaos subset: write-ahead journal
+# replay (including the slow-marked pserver-SIGKILL bit-identical E2E
+# that tier-1's time budget keeps out), seq-fence dedup, bounded
+# staleness parking, and the hot-row cache parity.  The staleness bound
+# is armed in the environment so the multi-trainer legs run with the
+# reaper + park machinery live rather than compiled out.
+FLAGS_async_staleness_bound=4 python -m pytest \
+    tests/test_fault_tolerance.py -q -m "" -k "async"
+python -m pytest tests/test_dist_transpiler.py -q -m "" \
+    -k "async or hot_row"
+
 echo "== collective-backend pass (2-device CPU mesh) =="
 # the collective dense-grad backend must hold its parity story on the
 # MINIMAL mesh (2 virtual devices, not the suite's 8): bit-exact dense

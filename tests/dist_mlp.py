@@ -245,6 +245,27 @@ def main():
     # property at fixed step count — the A/B the bf16 wire is judged on)
     counters["bytes_per_step"] = round(
         counters["comm_bytes_sent"] / max(1, steps), 1)
+    if sparse and os.environ.get("DIST_DUMP_TABLE") == "1":
+        # fetch EVERY row of each distributed table back from the
+        # pservers (global row g lives on server g%N at local index
+        # g//N) and print it exactly — the async chaos E2E asserts a
+        # killed-and-restored run's table is BIT-IDENTICAL to an
+        # unkilled run's (journal replay + fenced resend lose nothing)
+        from paddle_tpu.distributed.rpc import RPCClient
+
+        ep_list = [e.strip() for e in eps.split(",") if e.strip()]
+        dump = {}
+        for w, info in sorted(t.sparse_tables.items()):
+            n_rows = 20  # build_sparse_model's table size
+            tbl = np.zeros((n_rows, info["emb_dim"]), np.float32)
+            for s, ep in enumerate(ep_list):
+                gids = np.arange(s, n_rows, len(ep_list), dtype=np.int64)
+                rows = np.asarray(RPCClient.get(ep).prefetch(
+                    info["shards"][s], gids // len(ep_list),
+                    trainer_id=trainer_id))
+                tbl[gids] = rows
+            dump[w] = tbl.tolist()
+        print("TABLE " + json.dumps(dump))
     exe.close()  # SendComplete to pservers
     print("COUNTERS " + json.dumps(counters))
     print("LOSSES " + json.dumps(losses))

@@ -637,11 +637,14 @@ def test_collective_mode_bit_exact_vs_single_process_baseline():
     assert stats["rpc_verbs"] == {}, stats
 
 
-def _run_sparse_cluster(mode, nranks, steps=4, wire_dtype="float32"):
+def _run_sparse_cluster(mode, nranks, steps=4, wire_dtype="float32",
+                        sync=True):
     """Sparse dist MLP (the DIST_MODEL=sparse architecture) over 2
     in-process pserver threads: mode="pserver" is the classic sync path,
     mode="collective" is HYBRID — dense grads ride the mesh, embedding
-    rows still flow prefetch/send_sparse."""
+    rows still flow prefetch/send_sparse.  sync=False runs the ASYNC
+    pserver path (fenced delivery: seq-stamped chunks, clock-stamped
+    prefetches)."""
     from paddle_tpu import framework, unique_name
     from paddle_tpu.core.scope import Scope
     from paddle_tpu.distributed import rpc
@@ -674,7 +677,7 @@ def _run_sparse_cluster(mode, nranks, steps=4, wire_dtype="float32"):
     t = fluid.DistributeTranspiler(config=config)
     eps = ["127.0.0.1:%d" % _free_port() for _ in range(2)]
     t.transpile(0, program=main, pservers=",".join(eps), trainers=nranks,
-                sync_mode=True, startup_program=startup)
+                sync_mode=sync, startup_program=startup)
     dist_ops.reset_fences()
     threads = []
     for ep in eps:
@@ -742,6 +745,139 @@ def test_hybrid_collective_sparse_bf16_wire(no_heartbeats):
     assert sbf["comm_bytes_saved"] > 0
     assert sbf["comm_bytes_sent"] < s32["comm_bytes_sent"]
     assert s32["comm_bytes_saved"] == 0
+
+
+# ---------------------------------------------------------------------------
+# durable async sparse: fenced delivery + trainer-side hot-row cache
+# ---------------------------------------------------------------------------
+
+def test_async_transpile_stamps_fenced_delivery_contract():
+    """Async pserver mode stamps the fenced-delivery attrs: send_sparse
+    and prefetch carry async_fence + the mirrorable optimizer spec,
+    send_bucket carries async_fence; sync mode stamps none of it."""
+    from paddle_tpu import framework, unique_name
+
+    for sync in (True, False):
+        framework.switch_main_program(fluid.Program())
+        framework.switch_startup_program(fluid.Program())
+        unique_name.switch()
+        with fluid.program_guard(fluid.default_main_program(),
+                                 fluid.default_startup_program()):
+            ids = layers.data("ids", shape=[1], dtype="int64")
+            y = layers.data("y", shape=[1])
+            emb = layers.embedding(ids, size=[20, 8], dtype="float32",
+                                   is_distributed=True)
+            pred = layers.fc(layers.reshape(emb, [-1, 8]), size=1)
+            loss = layers.mean(layers.square_error_cost(pred, y))
+            fluid.optimizer.SGD(0.1).minimize(loss)
+        config = fluid.DistributeTranspilerConfig()
+        config.min_block_size = 4
+        t = fluid.DistributeTranspiler(config=config)
+        t.transpile(0, pservers="127.0.0.1:6174,127.0.0.1:6175",
+                    trainers=1, sync_mode=sync)
+        ops = {op.type: op for op in
+               t.get_trainer_program().global_block().ops}
+        for name in ("prefetch", "send_sparse", "send_bucket"):
+            assert ops[name].attrs.get("async_fence") is (not sync), \
+                (name, sync)
+        assert ops["send_sparse"].attrs["hot_opt"] == {
+            "type": "sgd", "lr": 0.1}
+        assert ops["prefetch"].attrs["hot_opt"] == {
+            "type": "sgd", "lr": 0.1}
+
+
+def test_async_fenced_sparse_trains_and_counts(no_heartbeats):
+    """The async fenced path end to end through real ops: training
+    converges, every chunk ships with a seq token exactly once (no dups
+    witnessed on a healthy wire), and the client-side COUNTERS finally
+    see the async traffic (async_sparse_sends — the fix for
+    `_async_sends` being server-internal only)."""
+    steps = 4
+    losses, stats = _run_sparse_cluster("pserver", nranks=1, steps=steps,
+                                        sync=False)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+    # one chunk per (step, server): fenced mode ships to EVERY server
+    # (empty chunks carry the clock), so exactly steps * 2 sends
+    assert stats["async_sparse_sends"] == steps * 2, stats
+    assert stats["async_dedup_drops"] == 0
+    assert stats["async_resends"] == 0
+    assert stats["rpc_verbs"].get("send_sparse", 0) == steps * 2
+
+
+def test_hot_row_cache_matches_cache_off(no_heartbeats):
+    """Satellite acceptance: FLAGS_sparse_hot_rows on vs off — same
+    model, same stream — must match within tolerance (sgd + constant lr
+    mirrors exactly, so the tolerance is tight), while actually cutting
+    prefetch round trips."""
+    from paddle_tpu.flags import get_flag, set_flags
+
+    steps = 5
+    base, bstats = _run_sparse_cluster("pserver", nranks=1, steps=steps,
+                                       sync=False)
+    prev_rows = get_flag("sparse_hot_rows")
+    prev_ttl = get_flag("sparse_hot_ttl")
+    set_flags({"sparse_hot_rows": 32, "sparse_hot_ttl": 3})
+    try:
+        cached, cstats = _run_sparse_cluster("pserver", nranks=1,
+                                             steps=steps, sync=False)
+    finally:
+        set_flags({"sparse_hot_rows": prev_rows,
+                   "sparse_hot_ttl": prev_ttl})
+    np.testing.assert_allclose(cached, base, rtol=1e-6, atol=1e-7)
+    assert cstats["rpc_verbs"].get("prefetch", 0) < \
+        bstats["rpc_verbs"].get("prefetch", 0), \
+        "cache-on run did not cut prefetch round trips"
+
+
+def test_hot_row_cache_mirror_and_refresh_unit():
+    """HotRowCache in isolation: the sgd mirror matches a reference
+    table bit for bit (duplicates merged), TTL expiry forces a refresh,
+    LRU eviction respects capacity, and the refresh residual feeds the
+    drift predictor forward."""
+    from paddle_tpu.ops.dist_ops import HotRowCache
+
+    lr = 0.1
+    tbl = np.arange(12, dtype=np.float32).reshape(4, 3)
+    cache = HotRowCache(capacity=3, ttl=2, lr=lr)
+    cache.tick()
+    gids = np.array([0, 1, 0])  # duplicate id 0: must merge
+    hits, miss = cache.lookup(gids)
+    assert miss.all() and hits == {}
+    cache.insert(gids, tbl[gids])
+    grads = np.array([[1, 1, 1], [2, 2, 2], [3, 3, 3]], np.float32)
+    cache.push(gids, grads)
+    # the reference apply (ps_server._apply_sparse sgd rule)
+    ref = np.array(tbl)
+    uids, inv = np.unique(gids, return_inverse=True)
+    g = np.zeros((uids.size, 3), np.float32)
+    np.add.at(g, inv, grads)
+    ref[uids] -= lr * g
+    hits, miss = cache.lookup(np.array([0, 1]))
+    assert not miss.any()
+    np.testing.assert_array_equal(hits[0], ref[0])
+    np.testing.assert_array_equal(hits[1], ref[1])
+    # TTL expiry: two more ticks -> both entries stale -> misses
+    cache.tick()
+    cache.tick()
+    _, miss = cache.lookup(np.array([0, 1]))
+    assert miss.all(), "TTL never expired the entries"
+    # refresh with DIFFERENT server truth (another trainer moved rows):
+    # the residual records the drift for the predictor
+    truth = ref[[0]] + 0.5
+    cache.insert(np.array([0]), truth)
+    np.testing.assert_allclose(cache.residuals[0], np.full(3, 0.5),
+                               rtol=1e-5)
+    hits, _ = cache.lookup(np.array([0]))
+    np.testing.assert_array_equal(hits[0], truth[0])
+    # the next mirrored push feeds residual/ttl forward
+    cache.push(np.array([0]), np.zeros((1, 3), np.float32))
+    hits, _ = cache.lookup(np.array([0]))
+    np.testing.assert_allclose(hits[0], truth[0] + 0.5 / 2, rtol=1e-5)
+    # LRU capacity: inserting a 4th id evicts the oldest
+    cache.insert(np.array([1, 2, 3]), tbl[[1, 2, 3]])
+    assert len(cache.rows) == 3
+    assert 0 not in cache.rows and 0 not in cache.residuals
 
 
 def test_memory_optimize_plan():
