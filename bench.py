@@ -320,6 +320,14 @@ def _bench_impl():
         except Exception as e:
             sys.stderr.write("decode bench failed: %r\n" % (e,))
             result["decode"] = {"error": repr(e)[:200]}
+    # continuous-batching serving: Poisson trace through the slot-pool
+    # engine vs the same trace served one request at a time
+    if os.environ.get("BENCH_SERVE", "0") == "1":
+        try:
+            result["serve"] = _serve_bench(on_tpu, device)
+        except Exception as e:
+            sys.stderr.write("serve bench failed: %r\n" % (e,))
+            result["serve"] = {"error": repr(e)[:200]}
     # model-breadth diagnostics (fluid_benchmark.py model matrix): off by
     # default — the vgg/se_resnext shapes roughly double tunnel time
     if os.environ.get("BENCH_MODELS", "0") == "1":
@@ -669,6 +677,109 @@ def _decode_bench(on_tpu, device):
             "accept_rate": round(stats["accept_rate"], 3),
             "target_dispatches": stats["rounds"],
         }
+    return out
+
+
+def _serve_bench(on_tpu, device):
+    """Continuous-batching serving leg (BENCH_SERVE=1): a seeded Poisson
+    arrival trace over mixed prompt/output lengths through the
+    slot-pool engine, A/B'd against serve-one-at-a-time on the SAME
+    trace (same compiled pooled program, occupancy 1).  Reports
+    sustained new tokens/s, p50/p99 per-request latency (arrivals map
+    to wall time via the engine's measured mean step seconds for both
+    systems), slot-occupancy %, and the engine's COUNTERS-style
+    aggregates (steps, admit/prefill/decode splits, compile count —
+    which must stay flat across the run: the no-retrace contract)."""
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models import gpt2
+    from paddle_tpu.serving import (
+        ServingEngine,
+        make_poisson_trace,
+        serve_one_at_a_time,
+    )
+
+    class HP(gpt2.GPT2Config):
+        vocab_size = 8000 if on_tpu else 200
+        n_ctx = 256 if on_tpu else 64
+        d_model = 256 if on_tpu else 64
+        n_layer = 4 if on_tpu else 2
+        n_head = 4 if on_tpu else 2
+        dropout = 0.0
+
+    slots = int(os.environ.get("BENCH_SERVE_SLOTS", 8 if on_tpu else 4))
+    width = int(os.environ.get("BENCH_SERVE_WIDTH", 16 if on_tpu else 8))
+    n_req = int(os.environ.get("BENCH_SERVE_REQS", 32 if on_tpu else 16))
+    rate = float(os.environ.get("BENCH_SERVE_RATE", "2.0"))
+    t_max = HP.n_ctx
+    trace = make_poisson_trace(
+        n_req, rate,
+        prompt_len_range=(4, t_max // 4),
+        out_len_range=(4, t_max // 4),
+        vocab_size=HP.vocab_size,
+        seed=int(os.environ.get("BENCH_SERVE_SEED", "0")),
+        sampled_fraction=0.5)
+
+    scope = fluid.Scope()
+    out = {}
+    with fluid.scope_guard(scope):
+        _, lm_startup, _, _ = gpt2.gpt2_logits_program(HP, seq_len=t_max)
+        exe = fluid.Executor(fluid.TPUPlace(0) if on_tpu else fluid.CPUPlace())
+        lm_startup.random_seed = 23
+        exe.run(lm_startup)
+        eng = ServingEngine(exe, HP, n_slots=slots, width=width,
+                            t_max=t_max)
+        eng.run(trace[:2])  # warm compile (step + reset + startup)
+        compiles_warm = exe.compile_count
+        results, stats = eng.run(trace)
+        lat = sorted(r["latency_s"] for r in results.values())
+
+        def pct(sorted_vals, p):
+            return sorted_vals[min(len(sorted_vals) - 1,
+                                   int(p * len(sorted_vals)))]
+
+        out["continuous_batching"] = {
+            "value": stats["tokens_per_s"],
+            "unit": "new tokens/sec" + ("" if on_tpu else " (cpufallback)"),
+            "p50_latency_s": round(pct(lat, 0.50), 4),
+            "p99_latency_s": round(pct(lat, 0.99), 4),
+            "occupancy_pct": stats["occupancy_pct"],
+            "slots": slots,
+            "width": width,
+            "requests": n_req,
+            "steps": stats["steps"],
+            "prefill_steps": stats["prefill_steps"],
+            "decode_steps": stats["decode_steps"],
+            "new_tokens": stats["new_tokens"],
+            "retraces_during_run": exe.compile_count - compiles_warm,
+        }
+        sys.stderr.write("SERVE_RESULT continuous_batching %s\n"
+                         % json.dumps(out["continuous_batching"]))
+
+        base_results, base_stats = serve_one_at_a_time(
+            eng, trace, arrival_step_seconds=stats["step_s_mean"])
+        blat = sorted(r["latency_s"] for r in base_results.values())
+        out["serve_one_at_a_time"] = {
+            "value": base_stats["tokens_per_s"],
+            "unit": "new tokens/sec" + ("" if on_tpu else " (cpufallback)"),
+            "p50_latency_s": round(pct(blat, 0.50), 4),
+            "p99_latency_s": round(pct(blat, 0.99), 4),
+        }
+        sys.stderr.write("SERVE_RESULT serve_one_at_a_time %s\n"
+                         % json.dumps(out["serve_one_at_a_time"]))
+        base_tps = base_stats["tokens_per_s"] or 1.0
+        out["speedup_vs_one_at_a_time"] = round(
+            stats["tokens_per_s"] / base_tps, 2)
+        # exactness spot-check rides the bench: the pooled run's token
+        # streams must equal the solo baseline's, request for request
+        mismatches = sum(
+            0 if np.array_equal(results[r.rid]["tokens"],
+                                base_results[r.rid]["tokens"]) else 1
+            for r in trace)
+        out["exactness_mismatches"] = mismatches
+        sys.stderr.write("SERVE_RESULT speedup %s mismatches %d\n"
+                         % (out["speedup_vs_one_at_a_time"], mismatches))
     return out
 
 

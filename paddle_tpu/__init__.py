@@ -104,5 +104,6 @@ from .io import (
     load_inference_model,
 )
 from .parallel_executor import ParallelExecutor, BuildStrategy, ExecutionStrategy
+from . import serving
 
 __version__ = "0.2.0"

@@ -389,6 +389,10 @@ class ExecutionCache:
 
     def __init__(self):
         self._cache = {}
+        # monotone count of cache MISSES (fresh traces) — the serving
+        # engine's compiles-once contract is asserted against this:
+        # occupancy churn must change feed VALUES only, never keys
+        self.compile_count = 0
 
     def get(self, program, block_idx, feed_sig, fetch_names, scope, donate=True):
         # flags that change lowering decisions are part of the compile key —
@@ -408,6 +412,7 @@ class ExecutionCache:
         hit = self._cache.get(key)
         if hit is not None:
             return hit
+        self.compile_count += 1
         feed_names = tuple(n for n, _, _ in feed_sig)
         traced = build_traced_function(
             program, block_idx, feed_names, fetch_names, scope

@@ -103,6 +103,15 @@ class Executor:
         device (the host_feed_ms bench counter)."""
         return self._host_feed_ms
 
+    @property
+    def compile_count(self):
+        """How many distinct program traces this executor has compiled —
+        the serving engine's no-retrace contract is asserted against
+        this: a continuous-batching step must compile ONCE, and then
+        hold the steady-state memo across every occupancy change (slots
+        going live/free change feed values, never feed signatures)."""
+        return self._cache.compile_count
+
     def _commit_state(self, n, v, device, scope):
         """Normalize state to a COMMITTED on-device array.  Startup
         outputs are uncommitted (no committed inputs feed them) while

@@ -19,6 +19,7 @@ __all__ = [
     "data_norm",
 
     "fused_attention",
+    "slot_cache_write",
     "rotary_embed",
     "log_loss",
     "beam_search",
@@ -1453,7 +1454,9 @@ def fused_attention(q, k, v, causal=False, scale=None, bias=None,
     optional [1] int var (chunked KV-cached decode): query i sits at
     GLOBAL position qstart + i while keys sit at their cache indices —
     causal masking applies in global positions and Tq may differ from
-    Tk (requires causal=True)."""
+    Tk (requires causal=True).  A [batch] qstart keeps PER-ROW offsets
+    (the continuous-batching ragged step: each serving slot gets its
+    own causal cutoff inside one dispatch; dense-XLA path)."""
     window = int(window)
     if window < 0:
         raise ValueError("fused_attention: window must be >= 0")
@@ -1480,11 +1483,30 @@ def fused_attention(q, k, v, causal=False, scale=None, bias=None,
     return out
 
 
+def slot_cache_write(cache, new, pos, width, name=None):
+    """Per-row ragged KV-cache write (continuous-batching serving step):
+    row b of `new` [B, H, W, D] lands in `cache` [B, H, T, D] at time
+    indices pos[b]..pos[b]+width[b]-1; columns beyond width[b] (or past
+    the cache) are dropped, never clamped.  Returns the updated
+    full-length cache tensor (the caller assigns it back to the
+    persistable var, as with seq_cache_write)."""
+    helper = LayerHelper("slot_cache_write", **locals())
+    out = helper.create_variable_for_type_inference(cache.dtype)
+    helper.append_op(
+        "slot_cache_write",
+        inputs={"Cache": [cache], "New": [new], "Pos": [pos],
+                "Width": [width]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
 def rotary_embed(x, pos=None, base=10000.0, name=None):
     """Rotary position embedding over per-head projections [B, H, T, Dh]
     (rotate-half).  pos: optional int positions [T] — the KV-cached
     decode path passes the current position so cached keys are stored
-    pre-rotated; default arange(T)."""
+    pre-rotated; default arange(T).  A [B, T] pos keeps per-row
+    positions (ragged serving step)."""
     helper = LayerHelper("rotary_embed", **locals())
     out = helper.create_variable_for_type_inference(x.dtype)
     inputs = {"X": [x]}

@@ -9,7 +9,8 @@ from .. import layers
 __all__ = ["create_kv_caches", "add_cache_zero_fills", "probe_cache_len",
            "make_cache_reorder_program", "validate_cached_call",
            "probe_cache_dtype", "run_chunked_ids", "sample_from_logits",
-           "filtered_probs", "sample_rows"]
+           "filtered_probs", "sample_rows", "make_slot_reset_program",
+           "fold_in_seed", "sample_rows_keyed", "filtered_probs_rows"]
 
 
 def create_kv_caches(block, prefix, n_layer, batch, n_head, t_max, dh,
@@ -44,6 +45,39 @@ def add_cache_zero_fills(zero_program, named_shapes, dtype="float32"):
                 list(shape), dtype, 0.0,
                 out=blk.create_var(name=cname, shape=list(shape),
                                    dtype=dtype, persistable=True))
+
+
+def make_slot_reset_program(named_shapes, batch, dtype="float32"):
+    """add_cache_zero_fills generalized to PER-SLOT resets (the serving
+    pool's admission step): a program multiplying every named [B, ...]
+    persistable cache by the fed `slot_keep` [B] row mask — 1.0 keeps a
+    slot's rows, 0.0 zeroes them for the incoming request.  ONE compiled
+    program covers every subset of slots (the mask is a feed, so
+    admission churn never retraces).  named_shapes entries: (name,
+    shape) or (name, shape, dtype) — per-var dtype overrides `dtype`
+    (bf16 caches reset in bf16)."""
+    import paddle_tpu as fluid
+
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        keep = layers.data("slot_keep", shape=[batch], dtype="float32",
+                           append_batch_size=False)
+        blk = prog.global_block()
+        for entry in named_shapes:
+            cname, shape = entry[0], entry[1]
+            vdtype = entry[2] if len(entry) > 2 else dtype
+            assert int(shape[0]) == batch, (cname, shape, batch)
+            cvar = blk.create_var(name=cname, shape=list(shape),
+                                  dtype=vdtype, persistable=True)
+            masked = layers.elementwise_mul(cvar, keep, axis=0)
+            if str(vdtype) != "float32":
+                # the f32 mask promotes the product; cast back so the
+                # persistable keeps its declared dtype (bf16 caches
+                # must stay bf16 — assign does not cast)
+                masked = layers.cast(masked, str(vdtype))
+            blk.append_op("assign", inputs={"X": [masked]},
+                          outputs={"Out": [cvar]})
+    return prog
 
 
 def probe_cache_len(step_main, prefix):
@@ -165,3 +199,65 @@ def sample_from_logits(logits, rng, temperature=1.0, top_k=0, top_p=1.0):
     shared by the gpt2 and transformer samplers.  logits [B, V] -> [B]."""
     return sample_rows(
         filtered_probs(logits, temperature, top_k, top_p), rng)
+
+
+# ---------------------------------------------------------------------------
+# per-request keyed sampling (the continuous-batching exactness enabler)
+# ---------------------------------------------------------------------------
+# sample_rows draws every row from ONE shared rng stream, so a request's
+# sample at step t depends on its slot index and on how many neighbors
+# drew before it — under admission churn the same request would sample
+# differently.  The keyed variants below make each draw a PURE FUNCTION
+# of (request seed, request step): fold_in_seed mixes the pair into an
+# independent 32-bit key (splitmix64 finalizer — the numpy analog of
+# jax.random.fold_in) and the row draws from its own RandomState.  A
+# request's sample stream is then identical whether it runs solo or
+# shares a pool with any neighbors, admitted at any time.
+
+_SPLITMIX_GAMMA = 0x9E3779B97F4A7C15
+
+
+def _splitmix64(z):
+    z = (z + _SPLITMIX_GAMMA) & 0xFFFFFFFFFFFFFFFF
+    z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
+    z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF
+    return z ^ (z >> 31)
+
+
+def fold_in_seed(seed, step):
+    """Derive the 32-bit rng key for (request seed, request step) —
+    deterministic, order-free, neighbor-free.  Both inputs pass through
+    the full-width splitmix finalizer BEFORE combining, so every bit of
+    an arbitrary-width seed lands in the key (a shift-based combine
+    would silently alias seeds differing only in high bits)."""
+    m64 = 0xFFFFFFFFFFFFFFFF
+    z = _splitmix64(_splitmix64(int(seed) & m64)
+                    ^ _splitmix64((int(step) & m64) ^ _SPLITMIX_GAMMA))
+    return int(z & 0xFFFFFFFF)
+
+
+def sample_rows_keyed(probs, seeds, steps):
+    """Categorical draw per row of a [B, V] probability matrix where row
+    i draws from RandomState(fold_in_seed(seeds[i], steps[i])) — the
+    vectorized-per-row twin of sample_rows whose output is independent
+    of batch composition and slot order."""
+    probs = np.asarray(probs)
+    seeds = np.asarray(seeds).reshape(-1)
+    steps = np.asarray(steps).reshape(-1)
+    out = np.empty(probs.shape[0], "int64")
+    for i in range(probs.shape[0]):
+        rng = np.random.RandomState(fold_in_seed(seeds[i], steps[i]))
+        out[i] = rng.choice(probs.shape[-1], p=probs[i])
+    return out
+
+
+def filtered_probs_rows(logits, temperatures, top_ks, top_ps):
+    """filtered_probs with PER-ROW sampling params (heterogeneous
+    requests sharing one serving dispatch).  Each row runs through
+    filtered_probs alone, so a row's filtered distribution is bit-
+    identical to its solo run regardless of neighbors."""
+    logits = np.asarray(logits)
+    rows = [filtered_probs(logits[i:i + 1], float(temperatures[i]),
+                           int(top_ks[i]), float(top_ps[i]))
+            for i in range(logits.shape[0])]
+    return np.concatenate(rows, axis=0)

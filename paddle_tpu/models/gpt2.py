@@ -31,6 +31,7 @@ __all__ = [
     "beam_generate_cached",
     "sample_generate_cached",
     "gpt2_decode_step_program",
+    "gpt2_ragged_step_program",
     "prefill_cached_chunked",
     "speculative_generate_cached",
     "speculative_sample_generate_cached",
@@ -302,6 +303,97 @@ def gpt2_decode_step_program(hp=GPT2Config, batch=1, t_max=None, width=1,
             logits = layers.reshape(logits, shape=[batch, hp.vocab_size])
         feeds = ["step_ids", "pos"] + (["pos_vec"] if pos_vec is not None
                                        else [])
+    return main, cache_startup, feeds, [logits], cache_names
+
+
+def gpt2_ragged_step_program(hp=GPT2Config, batch=4, t_max=None, width=8,
+                             cache_dtype="float32"):
+    """The continuous-batching serving step (serving/engine.py's ONE
+    compiled program): width-W decode over a POOL of `batch` slots where
+    every slot sits at its own position.
+
+        feeds:  step_ids   [B, W] int64 — per-slot token columns (a
+                           prefilling slot carries a prompt chunk, a
+                           decoding slot its current token in column 0,
+                           a free slot padding)
+                pos_rows   [B] int64 — each slot's global write/query
+                           base position (qstart)
+                width_rows [B] int64 — how many of the W columns are
+                           REAL for each slot (1 for decode, chunk len
+                           for prefill, 0 for free slots); columns
+                           beyond it are never written to the cache
+                pos_mat    [B, W] int64 — per-slot absolute positions
+                           pos_rows[b] + i (clipped into the position
+                           table) for the position embedding / RoPE
+        fetch:  logits [B, W, vocab] — row b column i predicts position
+                pos_rows[b] + i + 1 for that slot's request
+        state:  the SAME per-layer gpt2_{k,v}cache_* persistables as
+                gpt2_decode_step_program (shared scope, shared names)
+
+    Cache writes go through slot_cache_write (per-row position + width,
+    out-of-width columns dropped) and attention masks per-row offset-
+    causal (fused_attention vector qstart), so ONE dispatch interleaves
+    prompt prefill for newly admitted requests with single-token decode
+    for in-flight ones — occupancy changes only change feed VALUES,
+    never shapes: the step compiles exactly once.  Exactness: row b's
+    logits are bit-identical to the same request running solo in the
+    same program (row-independent math; masked lanes contribute exact
+    zeros), which is the serving engine's per-request contract.
+    Returns (main, cache_startup, feeds, fetches, cache_names)."""
+    import paddle_tpu as fluid
+
+    t_max = t_max or hp.n_ctx
+    assert t_max <= hp.n_ctx, (
+        "t_max %d exceeds the position table n_ctx %d" % (t_max, hp.n_ctx))
+    width = int(width)
+    assert 1 <= width <= t_max, (width, t_max)
+    dh = hp.d_model // hp.n_head
+    main = fluid.Program()
+    cache_startup = fluid.Program()
+    throwaway_startup = fluid.Program()
+    with fluid.program_guard(main, throwaway_startup), unique_name.guard():
+        ids = layers.data("step_ids", shape=[batch, width], dtype="int64",
+                          append_batch_size=False)
+        pos_rows = layers.data("pos_rows", shape=[batch], dtype="int64",
+                               append_batch_size=False)
+        width_rows = layers.data("width_rows", shape=[batch], dtype="int64",
+                                 append_batch_size=False)
+        pos_mat = layers.data("pos_mat", shape=[batch, width],
+                              dtype="int64", append_batch_size=False)
+        emb_attr = _pa("emb.w")
+        tok = layers.embedding(
+            ids, size=[hp.vocab_size, hp.d_model], param_attr=emb_attr
+        )
+        tok = layers.reshape(tok, shape=[batch, width, hp.d_model])
+        if getattr(hp, "use_rotary", False):
+            x = tok  # RoPE rotates q/k by pos_mat inside cached attention
+        else:
+            pos_table = layers.create_parameter(
+                shape=[hp.n_ctx, hp.d_model], dtype="float32",
+                attr=_pa("pos_emb.w", 0.01),
+            )
+            pos_emb = layers.gather(pos_table, pos_mat)  # [B, W, D]
+            x = layers.elementwise_add(tok, pos_emb)
+        from .decode_cache import add_cache_zero_fills, create_kv_caches
+
+        blk = main.global_block()
+        n_kv = getattr(hp, "n_kv_head", None) or hp.n_head
+        kv_caches, cache_names = create_kv_caches(
+            blk, "gpt2", hp.n_layer, batch, n_kv, t_max, dh,
+            dtype=cache_dtype)
+        add_cache_zero_fills(
+            cache_startup,
+            [(n, (batch, n_kv, t_max, dh)) for n in cache_names],
+            dtype=cache_dtype)
+        for cache in kv_caches:
+            cache["pos_rows"] = pos_rows
+            cache["width_rows"] = width_rows
+            if getattr(hp, "use_rotary", False):
+                cache["pos_mat"] = pos_mat
+            x = _block(x, hp, is_test=True, cache=cache)
+        x = layers.layer_norm(x, begin_norm_axis=2)
+        logits = _tied_logits(x, hp, emb_attr.name)
+    feeds = ["step_ids", "pos_rows", "width_rows", "pos_mat"]
     return main, cache_startup, feeds, [logits], cache_names
 
 

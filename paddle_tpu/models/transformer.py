@@ -125,7 +125,17 @@ def multi_head_attention(
     rotary=True applies rotary position embedding (RoPE) to q and k after
     the head split — full-sequence positions arange(T), or the cache's
     current position on the decode path (cached keys store pre-rotated,
-    so relative rotations stay exact across steps)."""
+    so relative rotations stay exact across steps).
+
+    RAGGED cache mode (the continuous-batching serving step): a cache
+    dict carrying "pos_rows" [B] + "width_rows" [B] (and "pos_mat"
+    [B, W] under rotary) instead of the scalar "pos" writes each batch
+    row's K/V at ITS OWN position with ITS OWN valid width
+    (slot_cache_write: a decoding slot writes 1 token, a prefilling
+    slot a chunk, a free slot nothing) and masks attention with
+    per-row offset-causal cutoffs (fused_attention vector qstart) —
+    one dispatch serves a pool of requests at heterogeneous
+    positions."""
     dh = d_model // n_head
     n_kv = n_kv_head or n_head
     if n_head % n_kv:
@@ -157,10 +167,23 @@ def multi_head_attention(
     q = split_heads(q, n_head)
     k, v = split_heads(k, n_kv), split_heads(v, n_kv)
     if rotary:
+        # ragged serving feeds pos_mat [B, W] (per-row positions);
         # chunked decode feeds pos_vec (positions pos..pos+W-1); the
         # one-token step feeds the scalar pos
-        rpos = (cache.get("pos_vec", cache["pos"])
-                if cache is not None else None)
+        rpos = None
+        if cache is not None:
+            if "pos_rows" in cache and "pos_mat" not in cache:
+                raise ValueError(
+                    "ragged cached attention with rotary needs pos_mat "
+                    "(per-row absolute positions [B, W]) — without it "
+                    "every slot would silently rotate at arange(W)")
+            for key in ("pos_mat", "pos_vec", "pos"):
+                if key in cache:
+                    rpos = cache[key]
+                    break
+            if rpos is None:
+                raise KeyError(
+                    "cached rotary attention needs pos/pos_vec/pos_mat")
         q = layers.rotary_embed(q, pos=rpos)
         k = layers.rotary_embed(k, pos=rpos)
     if cache is not None:
@@ -183,16 +206,26 @@ def multi_head_attention(
         from ..layer_helper import LayerHelper
 
         helper = LayerHelper("cached_attention")
+        ragged = "pos_rows" in cache
+        if ragged and "width_rows" not in cache:
+            raise ValueError(
+                "ragged cached attention needs width_rows alongside "
+                "pos_rows (per-row valid write widths)")
 
         def write_cache(cvar, new):
             """Updated full-length cache tensor; also assigns it back into
             the persistable var (state threads through the executor)."""
-            out = helper.create_variable_for_type_inference(cvar.dtype)
-            helper.append_op(
-                "seq_cache_write",
-                inputs={"Cache": [cvar], "New": [new], "Pos": [cache["pos"]]},
-                outputs={"Out": [out]},
-            )
+            if ragged:
+                out = layers.slot_cache_write(
+                    cvar, new, cache["pos_rows"], cache["width_rows"])
+            else:
+                out = helper.create_variable_for_type_inference(cvar.dtype)
+                helper.append_op(
+                    "seq_cache_write",
+                    inputs={"Cache": [cvar], "New": [new],
+                            "Pos": [cache["pos"]]},
+                    outputs={"Out": [out]},
+                )
             helper.append_op("assign", inputs={"X": [out]},
                              outputs={"Out": [cvar]})
             return out
@@ -207,14 +240,27 @@ def multi_head_attention(
         t_max = int(cache["k"].shape[2])
         bsz = int(cache["k"].shape[0])
         width = int(q.shape[2])
-        if width == 1:
+        def pos_bias():
             # one-token steps mask via the rank-1 <=pos key bias
             bias = helper.create_variable_for_type_inference("float32")
             helper.append_op(
                 "decode_pos_mask", inputs={"Pos": [cache["pos"]]},
                 outputs={"Out": [bias]}, attrs={"t_max": t_max, "batch": bsz},
             )
-        if width > 1:
+            return bias
+
+        if ragged:
+            # RAGGED step: every row carries its own global query base
+            # (pos_rows), so the offset-causal mask is per-row — one
+            # dispatch mixes prefill chunks with one-token decodes.  GQA
+            # tiles K/V back to n_head (same accepted tradeoff as the
+            # chunked step: per-row cutoffs cannot share the time axis
+            # with the query-group fold).
+            ctx = layers.fused_attention(
+                q, repeat_kv(k_full), repeat_kv(v_full), causal=True,
+                qstart=cache["pos_rows"], scale=dh ** -0.5,
+            )  # [B, H, W, Dh]
+        elif width > 1:
             # CHUNKED decode/prefill: W queries at global positions
             # pos..pos+W-1 against the whole cache — offset-causal
             # masking (fused_attention qstart) gives each chunk row its
@@ -231,7 +277,7 @@ def multi_head_attention(
             )  # [B, H, W, Dh]
         elif n_kv == n_head:
             ctx = layers.fused_attention(
-                q, k_full, v_full, bias=bias, causal=False,
+                q, k_full, v_full, bias=pos_bias(), causal=False,
                 scale=dh ** -0.5,
             )  # [B, H, 1, Dh]
         else:
@@ -243,7 +289,7 @@ def multi_head_attention(
             g = n_head // n_kv
             q_g = layers.reshape(q, [bsz, n_kv, g, dh])
             ctx = layers.fused_attention(
-                q_g, k_full, v_full, bias=bias, causal=False,
+                q_g, k_full, v_full, bias=pos_bias(), causal=False,
                 scale=dh ** -0.5,
             )  # [B, n_kv, g, Dh]
             ctx = layers.reshape(ctx, [bsz, n_head, 1, dh])

@@ -722,8 +722,13 @@ def _fused_attention(ctx, ins, attrs):
     b, h, t, d = q.shape
     tk = k.shape[2]
     # chunked-decode global query offset: query i at position QStart+i,
-    # keys at their cache indices — Tq may differ from Tk
-    qstart = ins["QStart"][0].reshape(()) if ins.get("QStart") else None
+    # keys at their cache indices — Tq may differ from Tk.  A size-1
+    # QStart is the classic scalar offset (one chunk position for the
+    # whole batch); size B keeps PER-ROW offsets (ragged serving step).
+    qstart = None
+    if ins.get("QStart"):
+        qstart = ins["QStart"][0].reshape(-1)
+        qstart = qstart.reshape(()) if qstart.shape[0] == 1 else qstart
     if qstart is not None:
         if not causal:
             raise ValueError("fused_attention: QStart requires causal=True")
@@ -738,6 +743,36 @@ def _fused_attention(ctx, ins, attrs):
     qf = q.reshape(b * h, t, d)
     kf = k.reshape(b * h, tk, d)
     vf = v.reshape(b * h, tk, d)
+    if qstart is not None and qstart.ndim > 0:
+        # PER-ROW offset-causal (the continuous-batching ragged step):
+        # QStart is [B], row b's query i sits at global position
+        # QStart[b] + i — every slot in the serving pool gets its own
+        # causal cutoff inside ONE dispatch.  Dense-XLA path (the flash
+        # kernels take a scalar qstart; a per-row kernel is future work
+        # — serving's CPU leg and the XLA fallback are exact either way,
+        # and exactness, not kernel speed, is the serving contract).
+        if int(qstart.shape[0]) != b:
+            raise ValueError(
+                "fused_attention: vector QStart must be [batch]=%d, got %s"
+                % (b, tuple(qstart.shape)))
+        if window:
+            raise ValueError(
+                "fused_attention: window is not supported with per-row "
+                "QStart")
+        from .pallas_kernels import NEG_INF
+
+        s = (jnp.einsum("bqd,bkd->bqk", qf, kf).astype(jnp.float32)
+             * float(scale))  # [B*H, Tq, Tk]
+        q_pos = (qstart.reshape(b, 1).astype(jnp.int32)
+                 + jnp.arange(t, dtype=jnp.int32)[None, :])  # [B, Tq]
+        keep = q_pos[:, :, None] >= jnp.arange(tk, dtype=jnp.int32)[
+            None, None, :]  # [B, Tq, Tk]
+        keep = jnp.broadcast_to(keep[:, None], (b, h, t, tk)).reshape(
+            b * h, t, tk)
+        s = jnp.where(keep, s, NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bqk,bkd->bqd", p.astype(qf.dtype), vf)
+        return {"Out": [out.reshape(b, h, t, d)]}
     kbias = None
     if ins.get("Bias"):
         # additive key-padding bias, rank-1 in the key axis: [B, Tk] (or any
@@ -1033,6 +1068,37 @@ def _seq_cache_write(ctx, ins, attrs):
         cache, new.astype(cache.dtype), (zero, zero, pos, zero))]}
 
 
+@register("slot_cache_write", no_grad_inputs=("Pos", "Width"))
+def _slot_cache_write(ctx, ins, attrs):
+    """PER-ROW ragged KV-cache update (the continuous-batching serving
+    step): write New [B, H, W, D] into Cache [B, H, T, D] where row b's
+    column i lands at time index Pos[b] + i, but ONLY for i < Width[b]
+    — a decoding slot writes one token (Width 1), a prefilling slot a
+    whole chunk (Width <= W), a free slot nothing (Width 0).  Invalid
+    columns (beyond Width, or past the cache) are DROPPED, never
+    clamped: a clamp would silently overwrite a neighbor request's live
+    keys, which is exactly the cross-request interference the serving
+    exactness contract forbids."""
+    cache, new = ins["Cache"][0], ins["New"][0]
+    pos = ins["Pos"][0].reshape(-1).astype(jnp.int32)
+    width = ins["Width"][0].reshape(-1).astype(jnp.int32)
+    t_max = cache.shape[2]
+    w = new.shape[2]
+    col = jnp.arange(w, dtype=jnp.int32)
+    idx = pos[:, None] + col[None, :]  # [B, W]
+    valid = (col[None, :] < width[:, None]) & (idx < t_max)
+    # out-of-bounds index == dropped under mode="drop": route every
+    # invalid column to t_max
+    idx = jnp.where(valid, idx, t_max)
+
+    def row(c, n, i):
+        # c [H, T, D], n [H, W, D], i [W]
+        return c.at[:, i, :].set(n, mode="drop")
+
+    out = jax.vmap(row)(cache, new.astype(cache.dtype), idx)
+    return {"Out": [out]}
+
+
 @register("decode_pos_mask", no_grad_inputs=("Pos",))
 def _decode_pos_mask(ctx, ins, attrs):
     """[B, T] additive key bias for cached decode: 0 for key positions
@@ -1061,11 +1127,21 @@ def _rotary_embed(ctx, ins, attrs):
             "rotary_embed: head dim must be even (rotate-half pairs), "
             "got %d" % x.shape[-1])
     half = x.shape[-1] // 2
+    freq = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    if ins.get("Pos") and ins["Pos"][0].ndim == 2:
+        # PER-ROW positions [B, T] (ragged serving step: each pool slot
+        # rotates by its own request's positions)
+        pos = ins["Pos"][0].astype(jnp.float32)
+        ang = pos[:, :, None] * freq[None, None, :]  # [B, T, half]
+        sin = jnp.sin(ang)[:, None].astype(x.dtype)  # [B, 1, T, half]
+        cos = jnp.cos(ang)[:, None].astype(x.dtype)
+        x1, x2 = x[..., :half], x[..., half:]
+        return {"Out": [jnp.concatenate(
+            [x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1)]}
     if ins.get("Pos"):
         pos = ins["Pos"][0].reshape(-1).astype(jnp.float32)
     else:
         pos = jnp.arange(t, dtype=jnp.float32)
-    freq = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
     ang = pos[:, None] * freq[None, :]  # [T, half]
     sin = jnp.sin(ang)[None, None].astype(x.dtype)
     cos = jnp.cos(ang)[None, None].astype(x.dtype)

@@ -261,7 +261,13 @@ def per_op_timeline(program, feed, scope=None, path=None, warmup=1,
 # wire_compress) and the pserver's fused optimize apply
 # (ps_server._run_round).  Spans with these cats are attributed to
 # their own phase by comm_compute_split instead of lumping into comm.
-PHASE_CATS = ("serialize", "compress", "apply")
+# The serving engine's loop phases (serving/engine.py) ride the same
+# mechanism: admit (admission + slot reset), prefill / decode (the
+# pooled model dispatch, tagged by whether any slot is prefilling),
+# sample (host-side per-request token selection) — so
+# comm_compute_split(events=...) shows where serve time goes.
+PHASE_CATS = ("serialize", "compress", "apply",
+              "admit", "prefill", "decode", "sample")
 
 
 def comm_compute_split(rows, events=None):

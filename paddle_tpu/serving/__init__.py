@@ -1,0 +1,17 @@
+"""Continuous-batching LLM serving (docs/SERVING.md §5).
+
+The production serving front-end over the decode-cache stack: a
+request scheduler (engine.ServingEngine) drives ONE compiled ragged
+wide-step program over a slot-based KV-cache pool — admission,
+interleaved prefill/decode, per-request sampling params, immediate
+eviction — with every request's token stream bit-identical to its
+solo run.  trace.make_poisson_trace generates the seeded open-loop
+bench/test workloads.
+"""
+
+from .engine import ServingEngine, serve_one_at_a_time
+from .pool import SlotPool
+from .trace import Request, make_poisson_trace
+
+__all__ = ["ServingEngine", "serve_one_at_a_time", "SlotPool",
+           "Request", "make_poisson_trace"]

@@ -1,0 +1,296 @@
+"""Continuous-batching serving engine (the Orca/vLLM-style scheduler
+over this repo's decode-cache stack).
+
+ONE compiled ragged wide-step program (gpt2_ragged_step_program: width
+W over a fixed pool of B cache slots) serves every request.  Each
+engine step the scheduler
+
+  1. admits queued requests (arrival <= now) into free slots, zeroing
+     just those slots' cache rows via the slot-reset program (the
+     add_cache_zero_fills machinery generalized to per-slot resets —
+     one compiled program for ANY subset of slots),
+  2. dispatches the pooled step: prompt-prefill chunks for newly
+     admitted requests INTERLEAVED with one-token decode for in-flight
+     ones (per-slot pos/width vectors drive slot_cache_write and the
+     per-row offset-causal qstart mask),
+  3. samples each due row host-side with that request's OWN params and
+     rng key (temperature/top-k/top-p vectors + fold_in(seed, step) —
+     decode_cache.filtered_probs_rows / sample_rows_keyed),
+  4. evicts finished/EOS slots immediately (free for next step's
+     admissions).
+
+Exactness contract: every request's emitted tokens are bit-identical
+to its solo run through the SAME engine (greedy, and sampled given the
+same per-request seed), regardless of what shares the batch or when it
+was admitted — row-independent math in the pooled program plus
+per-request sampling keys.  Occupancy changes only change feed VALUES,
+never shapes, so the step compiles exactly once
+(Executor.compile_count pins this in tests).  Boundary: a bf16 KV
+cache stays a documented precision/memory tradeoff — engine-vs-solo
+equality still holds (both run the same bf16 program), but neither
+matches the f32-cache chain bit-for-bit.
+"""
+
+import time
+
+import numpy as np
+
+from ..profiler import RecordEvent
+
+__all__ = ["ServingEngine", "serve_one_at_a_time"]
+
+
+class ServingEngine:
+    """exe: Executor whose scope already holds the model weights (the
+    ragged program shares parameter names with gpt2_lm_program /
+    gpt2_logits_program built in the same process — run one of their
+    startups, or load a checkpoint, before serving)."""
+
+    def __init__(self, exe, hp, n_slots=4, width=8, t_max=None,
+                 cache_dtype="float32", quantize_int8=False):
+        from ..models import gpt2
+        from ..models.decode_cache import make_slot_reset_program
+        from .pool import SlotPool
+
+        self.exe = exe
+        self.hp = hp
+        self.n_slots = int(n_slots)
+        self.width = int(width)
+        self.t_max = int(t_max or hp.n_ctx)
+        self.cache_dtype = cache_dtype
+        (self.step_main, self.cache_startup, self._feeds, self.step_fetch,
+         self.cache_names) = gpt2.gpt2_ragged_step_program(
+            hp, batch=self.n_slots, t_max=self.t_max, width=self.width,
+            cache_dtype=cache_dtype)
+        if quantize_int8:
+            # weight-only int8 serving: per-tensor matmul weights +
+            # per-row embedding tables, dequant fused into the step
+            from ..contrib.quantize.quantize_transpiler import (
+                quantize_weights_int8,
+            )
+
+            quantize_weights_int8(self.step_main)
+        n_kv = getattr(hp, "n_kv_head", None) or hp.n_head
+        dh = hp.d_model // hp.n_head
+        self.reset_prog = make_slot_reset_program(
+            [(n, (self.n_slots, n_kv, self.t_max, dh)) for n in
+             self.cache_names],
+            self.n_slots, dtype=cache_dtype)
+        self.pool = SlotPool(self.n_slots, self.width, self.t_max)
+        self.queue = []  # submitted, not yet admitted (arrival order)
+        self.now = 0
+        self.counters = {"steps": 0, "admitted": 0, "finished": 0,
+                         "new_tokens": 0, "occupancy_sum": 0.0,
+                         "prefill_steps": 0, "decode_steps": 0}
+        self._step_wall = []
+        self._results = {}
+
+    # ---- request intake ------------------------------------------------
+    def submit(self, req):
+        self.pool.validate(req)
+        live = {q.rid for q in self.queue}
+        live.update(s.req.rid for _, s in self.pool.active_slots())
+        if req.rid in live:
+            raise ValueError("duplicate request id %r" % (req.rid,))
+        self.queue.append(req)
+        self.queue.sort(key=lambda r: (r.arrival, r.rid))
+
+    # ---- one scheduler iteration --------------------------------------
+    def step(self):
+        """Admit -> pooled dispatch -> sample -> evict.  Returns the
+        list of request ids that finished this step."""
+        with RecordEvent("serve_admit", cat="admit"):
+            keep = np.ones(self.n_slots, "float32")
+            admitted = False
+            while (self.queue and self.queue[0].arrival <= self.now
+                   and self.pool.free_slots()):
+                req = self.queue.pop(0)
+                slot = self.pool.admit(req, self.now)
+                keep[slot] = 0.0
+                admitted = True
+                self.counters["admitted"] += 1
+            if admitted:
+                # zero exactly the admitted slots' cache rows; one
+                # compiled program regardless of WHICH slots reset
+                self.exe.run(self.reset_prog, feed={"slot_keep": keep},
+                             fetch_list=[])
+        active = self.pool.active_slots()
+        if not active:
+            self.now += 1
+            return []
+        feed, plan = self.pool.build_feed(self.hp.n_ctx)
+        prefilling = self.pool.any_prefilling()
+        phase = "prefill" if prefilling else "decode"
+        self.counters[phase + "_steps"] += 1
+        with RecordEvent("serve_step", cat=phase):
+            (logits,) = self.exe.run(self.step_main, feed=feed,
+                                     fetch_list=self.step_fetch)
+        logits = np.asarray(logits)
+        finished = []
+        with RecordEvent("serve_sample", cat="sample"):
+            # slots whose chunk did not finish a prompt just advance
+            due = {slot for slot, _ in plan}
+            for slot, s in active:
+                if slot not in due:
+                    self.pool.advance_prefill(slot)
+            if plan:
+                rows = np.stack([logits[slot, col] for slot, col in plan])
+                toks = self._pick_tokens(rows, [s for s, _ in plan])
+                for (slot, _), tok in zip(plan, toks):
+                    s = self.pool.slots[slot]
+                    done = self.pool.advance(slot, tok)
+                    self.counters["new_tokens"] += 1
+                    if done:
+                        self._finish(slot)
+                        finished.append(s.req.rid)
+        self.counters["steps"] += 1
+        self.counters["occupancy_sum"] += len(active) / self.n_slots
+        self.now += 1
+        return finished
+
+    def _pick_tokens(self, rows, slots):
+        """Per-row token selection with PER-REQUEST params: greedy rows
+        argmax; sampled rows draw with fold_in(seed, request_step) keys
+        — a pure function of (request, step), neighbors invisible."""
+        from ..models.decode_cache import (
+            filtered_probs_rows,
+            sample_rows_keyed,
+        )
+
+        out = np.zeros(len(slots), "int64")
+        samp = []
+        for j, slot in enumerate(slots):
+            s = self.pool.slots[slot]
+            if s.req.greedy:
+                out[j] = int(np.asarray(rows[j]).argmax())
+            else:
+                samp.append(j)
+        if samp:
+            sl = [self.pool.slots[slots[j]] for j in samp]
+            probs = filtered_probs_rows(
+                rows[samp],
+                [s.req.temperature for s in sl],
+                [s.req.top_k for s in sl],
+                [s.req.top_p for s in sl])
+            toks = sample_rows_keyed(
+                probs,
+                [s.req.seed for s in sl],
+                [len(s.out) for s in sl])  # request_step = token index
+            out[samp] = toks
+        return out
+
+    def _finish(self, slot):
+        s = self.pool.evict(slot)
+        self.counters["finished"] += 1
+        wall = time.time()
+        a = min(s.req.arrival_step, max(0, len(self._step_wall) - 1))
+        self._results[s.req.rid] = {
+            "tokens": np.asarray(s.out, "int64"),
+            "prompt_len": int(s.req.prompt.size),
+            "arrival_step": s.req.arrival_step,
+            "admit_step": s.admit_step,
+            "finish_step": self.now,
+            "latency_steps": self.now - s.req.arrival_step + 1,
+            "latency_s": wall - (self._step_wall[a] if self._step_wall
+                                 else wall),
+        }
+
+    # ---- episode drivers ----------------------------------------------
+    def run(self, requests=None, max_steps=100000):
+        """Serve `requests` (plus anything already queued) to
+        completion: zero the caches, loop step() until drained.
+        Returns (results, stats) — results keyed by request id with the
+        emitted tokens and per-request latency, stats the aggregate
+        COUNTERS-style dict (sustained tokens/s, occupancy %, step
+        phase counts, mean step seconds)."""
+        self.now = 0
+        self._step_wall = []
+        self._results = {}
+        for k in self.counters:
+            self.counters[k] = 0
+        for r in requests or []:
+            self.submit(r)
+        self.exe.run(self.cache_startup)
+        t0 = time.time()
+        while self.queue or self.pool.active_slots():
+            self._step_wall.append(time.time())
+            self.step()
+            if self.now >= max_steps:
+                # drain the wedge before raising: a poisoned episode
+                # must not leave slots occupied (run_solo would forever
+                # see a busy engine and resubmits would look duplicate)
+                n_left = len(self.queue) + len(self.pool.active_slots())
+                self.queue = []
+                for slot, _ in self.pool.active_slots():
+                    self.pool.evict(slot)
+                raise RuntimeError(
+                    "serving engine exceeded max_steps=%d with %d "
+                    "requests unfinished (state cleared; finished "
+                    "results discarded)" % (max_steps, n_left))
+        wall = time.time() - t0
+        c = dict(self.counters)
+        steps = max(1, c.pop("steps"))
+        stats = {
+            "steps": steps,
+            "wall_s": round(wall, 4),
+            "tokens_per_s": round(c["new_tokens"] / wall, 1) if wall else 0.0,
+            "occupancy_pct": round(100.0 * c.pop("occupancy_sum") / steps, 1),
+            "step_s_mean": wall / steps,
+            "compile_count": self.exe.compile_count,
+        }
+        stats.update(c)
+        return self._results, stats
+
+    def run_solo(self, req):
+        """Serve ONE request through the same pooled program with every
+        other slot free — the exactness reference and the
+        serve-one-at-a-time baseline unit.  Returns (tokens, stats)."""
+        if self.queue or self.pool.active_slots():
+            raise RuntimeError("run_solo on a busy engine")
+        from .trace import Request
+
+        solo = Request(rid=req.rid, prompt=req.prompt,
+                       max_new_tokens=req.max_new_tokens,
+                       temperature=req.temperature, top_k=req.top_k,
+                       top_p=req.top_p, seed=req.seed, eos_id=req.eos_id,
+                       arrival=0.0)
+        results, stats = self.run([solo])
+        return results[req.rid]["tokens"], stats
+
+
+def serve_one_at_a_time(engine, requests, arrival_step_seconds=None):
+    """The A/B baseline: the same trace served sequentially, each
+    request owning the whole pool (run_solo) — what serving looked like
+    before the scheduler.  Throughput = total new tokens over total
+    service wall time.  Latency replays the virtual arrival clock:
+    arrivals map to seconds via `arrival_step_seconds` (pass the
+    engine's measured mean step seconds so both systems face the same
+    arrival process), each request starts at max(its arrival, the
+    previous finish) and waits in the FIFO queue — the queueing delay
+    continuous batching exists to remove.  Returns (results, stats)."""
+    results = {}
+    svc_total = 0.0
+    tokens_total = 0
+    step_s = float(arrival_step_seconds or 0.0)
+    finish_v = 0.0
+    for req in sorted(requests, key=lambda r: (r.arrival, r.rid)):
+        t0 = time.time()
+        tokens, _ = engine.run_solo(req)
+        svc = time.time() - t0
+        svc_total += svc
+        tokens_total += int(tokens.size)
+        arrive_v = req.arrival_step * step_s
+        finish_v = max(arrive_v, finish_v) + svc
+        results[req.rid] = {
+            "tokens": tokens,
+            "prompt_len": int(req.prompt.size),
+            "latency_s": finish_v - arrive_v,
+            "service_s": svc,
+        }
+    stats = {
+        "wall_s": round(svc_total, 4),
+        "tokens_per_s": (round(tokens_total / svc_total, 1)
+                         if svc_total else 0.0),
+        "new_tokens": tokens_total,
+    }
+    return results, stats

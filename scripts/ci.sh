@@ -68,6 +68,15 @@ XLA_FLAGS="--xla_force_host_platform_device_count=2" \
     python -m pytest tests/test_dist_transpiler.py -q -m "" \
     -k "collective or hybrid"
 
+echo "== serving pass (continuous-batching churn exactness) =="
+# the slot-pool engine's core contract on a short seeded CPU trace
+# (small GPT2Config, pool B=4): every request's tokens bit-identical
+# to its solo run under admit/evict churn, and the ragged step
+# compiling exactly once across occupancy changes.  -m "" also runs
+# the slow-marked bf16-KV and weight-only-int8 engine variants that
+# tier-1's time budget keeps out of the fast suite.
+python -m pytest tests/test_serving.py -q -m ""
+
 echo "== orphaned-child check =="
 # chaos tests SIGKILL cluster children; a leaked pserver/trainer would
 # keep ports + fds alive and poison later runs — fail fast instead
