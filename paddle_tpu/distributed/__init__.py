@@ -42,6 +42,13 @@ def _note_endpoint(ep, trainer_id):
             if "_h_register" not in str(e):  # real rejection, not
                 raise                        # an unknown-verb service
         else:
+            # the register reply is a fresh joiner's first window on the
+            # plan epoch — seed the registry so its very first step
+            # re-plans for the current world instead of burning a
+            # stale-plan round trip
+            from .rpc import note_plan_reply
+
+            note_plan_reply(ep, r)
             if isinstance(r, dict) and r.get("ok") is False:
                 # parked for a round boundary that never came: the job
                 # completed while this joiner waited.  Terminal — with

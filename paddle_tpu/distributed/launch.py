@@ -90,6 +90,97 @@ class _RestartPolicy:
         return delay
 
 
+class _ScalingPolicy:
+    """Elastic autoscaling decision state (`--elastic MIN:MAX`,
+    docs/FAULT_TOLERANCE.md "Elastic autoscaling"): the supervisor
+    watches per-trainer STEP progress off the child output pump and
+    decides, at most one action at a time,
+
+      * GROW   — spare capacity (live < max) and every live trainer has
+        made step progress for `hysteresis` consecutive observations
+        (a struggling fleet is not helped by more mouths at the same
+        pservers);
+      * SHRINK — live > min and one trainer's step rate has sat below
+        `straggler_frac` of the fleet median for `hysteresis`
+        consecutive observations (retiring a straggler lets the sync
+        round stop pacing itself on it).
+
+    Flap damping rides the SAME _RestartPolicy machinery the supervisor
+    uses for restart budgets: every action draws from an action budget
+    (at most `max_actions` per `window_s`, exponential backoff between
+    them) and a fixed `cooldown_s` separates consecutive actions — a
+    noisy observation cannot thrash the membership."""
+
+    def __init__(self, min_t, max_t, cooldown_s=3.0, hysteresis=2,
+                 straggler_frac=0.5, budget=None):
+        assert 1 <= int(min_t) <= int(max_t), (min_t, max_t)
+        self.min_t = int(min_t)
+        self.max_t = int(max_t)
+        self.cooldown_s = float(cooldown_s)
+        self.hysteresis = max(1, int(hysteresis))
+        self.straggler_frac = float(straggler_frac)
+        self.budget = budget or _RestartPolicy(
+            max_restarts=6, window_s=120.0, backoff_s=0.0)
+        self._last_action = time.monotonic()
+        self._grow_streak = 0
+        self._lag_streaks = {}
+
+    def decide(self, live_tags, rates):
+        """One observation -> one decision.  `rates` maps live tag ->
+        steps/s over the recent window (None = no step seen yet).
+        Returns ("grow", None), ("shrink", tag) or None."""
+        now = time.monotonic()
+        n = len(live_tags)
+        known = {t: r for t, r in rates.items()
+                 if t in live_tags and r is not None}
+        # hysteresis bookkeeping runs every observation (even inside the
+        # cooldown) so a persistent condition acts the moment damping
+        # allows, while a transient one decays away
+        if n < self.max_t and len(known) == n and n > 0 \
+                and all(r > 0 for r in known.values()):
+            self._grow_streak += 1
+        else:
+            self._grow_streak = 0
+        lagger = None
+        if n > self.min_t and len(known) >= 2:
+            # true median: for an even fleet the upper-middle element
+            # would key the straggler threshold off a faster-than-
+            # median rate and over-fire on exactly the 2-trainer fleets
+            # --elastic produces
+            vals = sorted(known.values())
+            mid = len(vals) // 2
+            med = (vals[mid] if len(vals) % 2
+                   else 0.5 * (vals[mid - 1] + vals[mid]))
+            for t, r in known.items():
+                if med > 0 and r < self.straggler_frac * med:
+                    self._lag_streaks[t] = self._lag_streaks.get(t, 0) + 1
+                    if self._lag_streaks[t] >= self.hysteresis:
+                        lagger = t
+                else:
+                    self._lag_streaks.pop(t, None)
+        else:
+            self._lag_streaks.clear()
+        if now - self._last_action < self.cooldown_s:
+            return None
+        action = None
+        if lagger is not None:
+            action = ("shrink", lagger)
+        elif self._grow_streak >= self.hysteresis and n < self.max_t:
+            action = ("grow", None)
+        if action is None:
+            return None
+        if self.budget.next_delay() is None:
+            sys.stderr.write(
+                "[launch] elastic action %r suppressed: action budget "
+                "exhausted (flap damping)\n" % (action[0],))
+            return None
+        self._last_action = now
+        self._grow_streak = 0
+        if action[0] == "shrink":
+            self._lag_streaks.pop(action[1], None)
+        return action
+
+
 class _Cluster:
     """Spawned children with streamed output and fail-fast teardown.
 
@@ -129,6 +220,14 @@ class _Cluster:
         # replacement is still booting.  Returning False cancels the
         # respawn (the job already completed without the child).
         self.on_respawn = None
+        # called as (tag, rc) when a supervised child's restart budget
+        # is EXHAUSTED (the death becomes a real failure) — pserver mode
+        # sends the surviving pservers a TERMINAL evict so they stop
+        # holding the job open for a replacement that will never come
+        self.on_respawn_denied = None
+        # called as (tag, line) for every pumped child output line —
+        # the elastic scaling policy reads trainer STEP progress off it
+        self.on_child_line = None
 
     def spawn(self, tag, cmd, env):
         proc = subprocess.Popen(
@@ -160,11 +259,23 @@ class _Cluster:
             "cmd": list(cmd), "env": dict(env),
             "policy": policy or _RestartPolicy()}
 
+    def unsupervise(self, tag):
+        """Drop `tag` from supervision (elastic retirement: its coming
+        death is deliberate and must NOT be respawned — the death
+        notification then reports it as terminal)."""
+        self._supervised.pop(tag, None)
+
     def _pump(self, tag, proc):
         try:
             for line in proc.stdout:
                 sys.stdout.write("[%s] %s" % (tag, line))
                 sys.stdout.flush()
+                cb = self.on_child_line
+                if cb is not None:
+                    try:
+                        cb(tag, line.rstrip("\n"))
+                    except Exception:
+                        pass  # an observer must never kill the pump
             rc = proc.wait()
         finally:
             try:
@@ -227,6 +338,18 @@ class _Cluster:
                 "(max %d per %.0fs)\n"
                 % (tag, rc, spec["policy"].max_restarts,
                    spec["policy"].window_s))
+            # the earlier death notification promised a respawn
+            # (respawn=True parked the id on every pserver); retract it
+            # with a terminal report so survivors fail NOW instead of
+            # serving a ghost until the eviction deadline
+            hook = self.on_respawn_denied
+            if hook is not None:
+                try:
+                    hook(tag, rc)
+                except Exception as e:
+                    sys.stderr.write(
+                        "[launch] budget-exhaustion notification for %s "
+                        "failed: %s\n" % (tag, e))
             return False
         hook = self.on_respawn
         if hook is not None:
@@ -425,7 +548,16 @@ def launch_collective(script_argv, nproc, base_env=None, chaos_kills=None,
 def launch_pserver(script_argv, nproc, n_pservers, base_env=None, sync=True,
                    chaos_kills=None, supervise=False, max_restarts=3,
                    restart_window=60.0, restart_backoff=0.5, ckpt_dir=None,
-                   staleness_bound=None):
+                   staleness_bound=None, elastic=None, elastic_schedule=None,
+                   elastic_cooldown=3.0):
+    if elastic_schedule and not elastic:
+        # fail BEFORE any child spawns: a dropped schedule would run a
+        # clean "no regression" job in which the membership trace under
+        # test never happened
+        raise ValueError(
+            "--elastic-schedule requires --elastic MIN:MAX: the "
+            "schedule drives the elastic machinery and alone would be "
+            "silently ignored")
     ports = [free_port() for _ in range(n_pservers)]
     eps = ",".join("127.0.0.1:%d" % p for p in ports)
     common = dict(base_env or os.environ)
@@ -570,6 +702,30 @@ def launch_pserver(script_argv, nproc, n_pservers, base_env=None, sync=True,
         return admitted > 0 or reachable == 0
 
     cluster.on_respawn = prepare_respawn
+
+    def respawn_denied(tag, rc):
+        """Restart-budget exhaustion is TERMINAL: the earlier death
+        report promised a respawn (pservers parked the id as a pending
+        join), but no replacement is coming — retract the promise with
+        a respawn=False evict so survivors conclude NOW instead of
+        serving a ghost until the eviction deadline (the whole cluster
+        is about to fail-fast anyway; this makes the failure clean)."""
+        if not tag.startswith("trainer."):
+            return  # a failed pserver takes the cluster down fail-fast
+        from .rpc import RPCClient
+
+        tid = int(tag.split(".", 1)[1])
+        for ep in eps.split(","):
+            cli = RPCClient(ep, timeout=2, retries=2, retry_wait=0.1)
+            try:
+                cli.call("evict", trainer_id=tid, deadline_s=5.0,
+                         respawn=False)
+            except Exception:
+                pass
+            finally:
+                cli.close()
+
+    cluster.on_respawn_denied = respawn_denied
     for i, p in enumerate(ports):
         env = dict(common)
         env.update(
@@ -607,8 +763,165 @@ def launch_pserver(script_argv, nproc, n_pservers, base_env=None, sync=True,
             # round boundary (elastic rejoin)
             cluster.supervise("trainer.%d" % rank, cmd, env, _policy())
         cluster.spawn("trainer.%d" % rank, cmd, env)
+    stop_elastic = threading.Event()
+    if elastic:
+        _start_elastic_loop(cluster, common, script_argv, nproc, elastic,
+                            elastic_schedule, elastic_cooldown,
+                            supervise, _policy, stop_elastic)
     _arm_chaos(cluster, chaos_kills)
-    return cluster.wait()
+    try:
+        return cluster.wait()
+    finally:
+        stop_elastic.set()
+
+
+def _start_elastic_loop(cluster, common, script_argv, nproc, elastic,
+                        elastic_schedule, elastic_cooldown, supervise,
+                        make_restart_policy, stop_evt):
+    """The scaling-policy loop (`--elastic MIN:MAX`): a supervisor
+    thread watches per-trainer STEP progress off the output pump and
+    adds/retires trainer children — the pserver admits/evicts them at
+    round boundaries and mints plan epochs, trainers re-derive their
+    plans (docs/FAULT_TOLERANCE.md "Elastic autoscaling").
+
+    `elastic_schedule` ("T:+N,T:-N", seconds since start) replaces the
+    observational policy with deterministic timed actions — the
+    bench/chaos driver, riding the exact same grow/shrink machinery.
+    Retirement picks the highest-rank live trainer: it is SIGKILLed as
+    an expected failure after being dropped from supervision, so the
+    death notification reports it as terminal (respawn=False) and the
+    pserver evicts for good instead of parking a rejoin."""
+    min_t, max_t = (int(x) for x in str(elastic).split(":"))
+    policy = _ScalingPolicy(min_t, max_t, cooldown_s=elastic_cooldown)
+    schedule = []
+    for spec in (elastic_schedule or "").split(","):
+        spec = spec.strip()
+        if spec:
+            t_s, _, d = spec.partition(":")
+            schedule.append([float(t_s), int(d)])
+    schedule.sort(key=lambda e: e[0])
+    scheduled_only = bool(schedule)
+    step_seen = {}  # tag -> recent STEP wall times
+    seen_lock = threading.Lock()
+
+    def on_line(tag, line):
+        if tag.startswith("trainer.") and line.startswith("STEP "):
+            with seen_lock:
+                step_seen.setdefault(tag, []).append(time.monotonic())
+
+    cluster.on_child_line = on_line
+    t_start = time.monotonic()
+    next_rank = [nproc]
+
+    def live_trainers():
+        with cluster._lock:
+            procs = list(cluster.procs)
+        latest = {}
+        completed = False
+        for tag, p, _ in procs:
+            if tag.startswith("trainer."):
+                latest[tag] = p  # latest incarnation wins
+        live = {}
+        for tag, p in latest.items():
+            if p.poll() is None:
+                live[tag] = p
+            elif p.returncode == 0:
+                completed = True
+        return live, completed
+
+    def grow(reason):
+        rank = next_rank[0]
+        next_rank[0] += 1
+        tag = "trainer.%d" % rank
+        env = dict(common, PADDLE_TRAINING_ROLE="TRAINER",
+                   PADDLE_TRAINER_ID=str(rank))
+        cmd = [sys.executable, "-u"] + script_argv
+        sys.stderr.write("[launch] ELASTIC GROW %s (%s)\n" % (tag, reason))
+        if supervise:
+            cluster.supervise(tag, cmd, env, make_restart_policy())
+        cluster.spawn(tag, cmd, env)
+
+    def shrink(tag, reason):
+        sys.stderr.write("[launch] ELASTIC SHRINK %s (%s)\n"
+                         % (tag, reason))
+        cluster.unsupervise(tag)  # terminal: the evict must not park
+        cluster.kill_one(tag)
+
+    def loop():
+        window = max(2.0, 2.0 * float(elastic_cooldown))
+        while not stop_evt.wait(0.5):
+            if cluster._closing.is_set() or cluster.failed_rc is not None:
+                return
+            live, completed = live_trainers()
+            if completed:
+                # the job is winding down: no more actions — and a
+                # grown trainer that never made a step is booting into
+                # a cluster whose pservers may exit under it (it would
+                # crash-loop on register); retire it cleanly
+                with seen_lock:
+                    for tag in list(live):
+                        if not step_seen.get(tag):
+                            shrink(tag, "job completed before it joined")
+                return
+            now = time.monotonic()
+            if schedule and now - t_start >= schedule[0][0]:
+                delta = schedule.pop(0)[1]
+                if delta > 0:
+                    for _ in range(min(delta, max_t - len(live))):
+                        grow("scheduled")
+                else:
+                    victims = sorted(
+                        live, key=lambda t: -int(t.split(".", 1)[1]))
+                    for tag in victims[:min(-delta,
+                                            len(live) - min_t)]:
+                        shrink(tag, "scheduled")
+                continue
+            if scheduled_only:
+                # deterministic driver: actions come only from the
+                # schedule — but the loop must OUTLIVE it, or the
+                # winddown branch above (retiring a grown trainer that
+                # never joined before the job completed) is unreachable
+                # for schedules ending in a grow
+                continue
+            with seen_lock:
+                rates = {}
+                for tag in live:
+                    ts = [t for t in step_seen.get(tag, [])
+                          if now - t <= window]
+                    step_seen[tag] = ts
+                    # pace over the tag's OWN observed span, not the
+                    # full window: a freshly-grown trainer with a few
+                    # steps at full speed must not read as a straggler
+                    # just because it booted mid-window.  Under 3 steps
+                    # the pace is unknown (None): the tag can be
+                    # neither a straggler nor a grow justification —
+                    # which also keeps the policy from stacking a
+                    # second grow while the last one is still booting.
+                    span = ts[-1] - ts[0] if len(ts) >= 3 else 0.0
+                    rates[tag] = ((len(ts) - 1) / span if span > 0
+                                  else None)
+            act = policy.decide(set(live), rates)
+            if act is None:
+                continue
+            if act[0] == "grow":
+                grow("policy")
+            else:
+                shrink(act[1], "policy")
+
+    def run():
+        try:
+            loop()
+        except Exception:
+            # a dead policy thread must at least say so: silently losing
+            # elasticity mid-job is the failure mode this log line exists
+            # to catch
+            import traceback
+
+            sys.stderr.write("[launch] elastic policy loop died:\n")
+            traceback.print_exc()
+
+    threading.Thread(target=run, daemon=True,
+                     name="elastic-policy").start()
 
 
 def main(argv=None):
@@ -666,6 +979,27 @@ def main(argv=None):
         "last snapshot)",
     )
     parser.add_argument(
+        "--elastic", default=None, metavar="MIN:MAX",
+        help="pserver mode: elastic autoscaling — a supervisor policy "
+        "loop watches per-trainer step progress and adds (up to MAX) or "
+        "retires (down to MIN) trainer children; the pservers admit/"
+        "evict them at round boundaries, mint plan epochs, and trainers "
+        "re-derive their comm plans for the new world size "
+        "(docs/FAULT_TOLERANCE.md).  Usually combined with --supervise",
+    )
+    parser.add_argument(
+        "--elastic-schedule", default=None, metavar="T:+N,T:-N",
+        help="deterministic elastic driver: at T seconds after launch, "
+        "grow (+N) or shrink (-N) the trainer fleet through the same "
+        "machinery the policy loop uses (bench/chaos harness; replaces "
+        "the observational policy)",
+    )
+    parser.add_argument(
+        "--elastic-cooldown", type=float, default=3.0, metavar="SECONDS",
+        help="minimum seconds between elastic policy actions (flap "
+        "damping; the policy also rides a per-window action budget)",
+    )
+    parser.add_argument(
         "--staleness-bound", type=int, default=None, metavar="STEPS",
         help="async pserver mode: arm FLAGS_async_staleness_bound in "
         "every child — pservers park pushes/prefetches from a trainer "
@@ -688,6 +1022,10 @@ def main(argv=None):
         chaos_kills.append((tag, after_s))
 
     script_argv = [args.script] + args.script_args
+    if args.mode == "collective" and (args.elastic or args.elastic_schedule):
+        parser.error("--elastic is pserver-mode only: a collective mesh "
+                     "is shape-compiled, its world cannot change at a "
+                     "round boundary (re-launch with a new --nproc)")
     if args.mode == "collective":
         rc = launch_collective(script_argv, args.nproc,
                                chaos_kills=chaos_kills,
@@ -702,6 +1040,8 @@ def main(argv=None):
             restart_window=args.restart_window,
             restart_backoff=args.restart_backoff, ckpt_dir=args.ckpt_dir,
             staleness_bound=args.staleness_bound,
+            elastic=args.elastic, elastic_schedule=args.elastic_schedule,
+            elastic_cooldown=args.elastic_cooldown,
         )
     return rc
 

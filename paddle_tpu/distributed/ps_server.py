@@ -255,7 +255,24 @@ class ParameterServer:
                          "dedup_drops": 0, "journal_records": 0,
                          "journal_bytes": 0, "journal_replayed": 0,
                          "journal_tail_skips": 0, "staleness_parks": 0,
-                         "staleness_timeouts": 0, "parked_ms": 0.0}
+                         "staleness_timeouts": 0, "parked_ms": 0.0,
+                         # elastic autoscaling evidence
+                         "plan_epochs": 0, "stale_plan_drops": 0}
+        # elastic autoscaling (docs/FAULT_TOLERANCE.md "Elastic
+        # autoscaling"): the plan epoch is bumped — at a ROUND BOUNDARY
+        # in sync mode, never mid-assembly — whenever the live set
+        # changes durably (eviction, admission, clean departure), so
+        # trainers re-derive their comm plan for the new world and
+        # stale-epoch frames are fenced like stale incarnations.  The
+        # membership phase log feeds the "steps/s tracks the trainer
+        # count" bench evidence.
+        self._plan_epoch = 0
+        self._plan_dirty = False
+        import time as _time
+
+        self._phases = []  # closed phases: {epoch, world, rounds, wall_s}
+        self._phase = {"epoch": 0, "world": len(self._live),
+                       "round0": 0, "t0": _time.monotonic()}
         # every pserver start — cold or restored — is a new INCARNATION;
         # the number rides every rpc reply envelope so trainers can fence
         # a restart (see rpc.py incarnation registry)
@@ -618,6 +635,10 @@ class ParameterServer:
             # in THIS snapshot; restore replays segments >= it, and the
             # writer deletes segments < it once the snapshot lands
             "journal_seg": self._journal_rotate_locked(),
+            # the plan epoch rides the snapshot: a restored server must
+            # not fall behind its trainers' epochs (its stale fence
+            # would misread every current-epoch frame as the future)
+            "plan": {"epoch": self._plan_epoch},
             # per-trainer fold fences ride the SAME snapshot as the
             # params: after a restore, replayed buckets for rounds the
             # restored state already contains are dropped, rounds the
@@ -627,9 +648,15 @@ class ParameterServer:
             # departed trainers ride the snapshot too: a restored sync
             # server must not rebuild its live set around ghosts it
             # already evicted — their folds would never arrive and every
-            # restored barrier would hang (register still readmits them)
+            # restored barrier would hang (register still readmits them).
+            # The LIVE set rides as well: an elastic-grown rank (>= the
+            # transpile-time trainer count) is otherwise forgotten by a
+            # restart's range(num_trainers) reconstruction, and the
+            # restored server would declare the job done under it the
+            # moment the original ranks complete
             "departed": {"evicted": sorted(self._evicted),
-                         "completed": sorted(self._completed)},
+                         "completed": sorted(self._completed),
+                         "live": sorted(self._live)},
             "vars": {
                 n: np.array(self.scope.get(n))
                 for n in self.scope.local_var_names()
@@ -820,7 +847,23 @@ class ParameterServer:
         departed = data.get("departed") or {}
         self._evicted |= {int(t) for t in departed.get("evicted", [])}
         self._completed |= {int(t) for t in departed.get("completed", [])}
+        # elastic ranks the dead incarnation had admitted (absent in
+        # pre-elastic snapshots: range(num_trainers) stays the base)
+        self._live |= {int(t) for t in departed.get("live", [])}
+        plan = data.get("plan") or {}
+        self._plan_epoch = max(self._plan_epoch,
+                               int(plan.get("epoch", 0)))
+        import time as _time
+
+        # the open phase restarts at THIS incarnation's round/clock: the
+        # dead incarnation already reported its rounds in its own stats,
+        # and carrying round0=0 forward would double-count every
+        # pre-restart round in the next closed phase (corrupting the
+        # steps/s-per-membership evidence)
+        self._phase.update(epoch=self._plan_epoch, round0=self._round,
+                           t0=_time.monotonic())
         self._live -= (self._evicted | self._completed)
+        self._phase["world"] = len(self._live)
         if not self._live:
             # everyone the snapshot knew is gone: nothing left to serve
             # (a rejoin would re-arm via register/_admit_locked)
@@ -895,7 +938,8 @@ class ParameterServer:
             # an evicted trainer is NOT re-admitted: its grads were
             # dropped mid-round, re-joining would corrupt barrier math —
             # it learns it is dead from live=False and should exit
-            return {"ok": True, "live": live, "round": self._round}
+            return self._plan_reply_locked(
+                {"ok": True, "live": live, "round": self._round})
 
     def _h_evict(self, trainer_id=0, respawn=False):
         """Out-of-band death report (the launcher's supervisor role): a
@@ -919,11 +963,23 @@ class ParameterServer:
                 # park an async sole-trainer death would still empty the
                 # live set and exit the pserver under the replacement
                 self._pending_joins.add(tid)
+            else:
+                # TERMINAL evict (restart budget exhausted, or a policy
+                # retirement): the id is never coming back — unpark any
+                # earlier respawn-optimistic report so the server does
+                # not keep the job alive for a replacement that will
+                # never boot
+                self._pending_joins.discard(tid)
             self._evict_locked(tid, "reported dead")
             # _evict_locked early-returns for an id not in the live set
             # (already evicted / completed): a parked respawn join must
             # still admit if the server sits at a boundary
             self._admit_pending_joins_locked()
+            if not respawn and not self._live and not self._pending_joins:
+                # the terminal evict emptied the world: the job is over
+                # NOW, not at the eviction deadline
+                self._done.set()
+                self._cv.notify_all()
             return {"ok": True, "live": len(self._live)}
 
     def _ensure_reaper_locked(self):
@@ -1055,6 +1111,9 @@ class ParameterServer:
         print("PSERVER EVICT trainer=%d round=%d: %s"
               % (tid, self._round, why), flush=True)
         self._reset_stream_locked(tid)
+        # durable membership shrink: a new plan epoch is due (minted at
+        # the next boundary — or right here when no round is in flight)
+        self._mark_plan_dirty_locked()
         # a joiner parked in `register` is ALIVE: an eviction that
         # exposed a round boundary admits it (and an empty live set must
         # admit rather than declare the job done)
@@ -1065,6 +1124,90 @@ class ParameterServer:
             self._reeval_barriers_locked()
         self._cv.notify_all()
 
+    # ---- elastic autoscaling: plan epochs -------------------------------
+    def _mark_plan_dirty_locked(self):
+        """The live set changed durably: a new plan epoch is due.  The
+        mint itself is deferred to the next round boundary (sync mode) —
+        bumping mid-assembly would stale-fence the survivors' own
+        in-flight frames and hang the round they are completing."""
+        self._plan_dirty = True
+        self._maybe_mint_plan_locked()
+
+    def _maybe_mint_plan_locked(self):
+        """Mint the pending plan epoch if we are at a boundary (async
+        mode has no rounds, so dirty mints immediately).  Closes the
+        current membership phase for the phase log."""
+        if not self._plan_dirty:
+            return
+        if self.sync_mode and not self._at_boundary_locked():
+            return
+        if not self._live:
+            # an empty world has nobody to plan for: stay dirty — if a
+            # parked join readmits, its admission re-triggers the mint
+            # with a real world; if the job is truly over, the flag
+            # dies with the server
+            return
+        import time
+
+        now = time.monotonic()
+        self._phases.append({
+            "epoch": self._phase["epoch"], "world": self._phase["world"],
+            "rounds": self._round - self._phase["round0"],
+            "wall_s": round(now - self._phase["t0"], 3)})
+        self._plan_epoch += 1
+        self._plan_dirty = False
+        self.counters["plan_epochs"] += 1
+        self._phase = {"epoch": self._plan_epoch,
+                       "world": len(self._live),
+                       "round0": self._round, "t0": now}
+        print("PSERVER PLAN-EPOCH epoch=%d world=%d round=%d"
+              % (self._plan_epoch, len(self._live), self._round),
+              flush=True)
+        self._cv.notify_all()
+
+    def _phases_snapshot_locked(self):
+        """Closed phases plus the still-open one — the per-membership
+        steps/s evidence PSERVER-STATS and the bench elastic leg read."""
+        import time
+
+        return self._phases + [{
+            "epoch": self._phase["epoch"], "world": self._phase["world"],
+            "rounds": self._round - self._phase["round0"],
+            "wall_s": round(time.monotonic() - self._phase["t0"], 3)}]
+
+    def _stale_plan_locked(self, pepoch):
+        """True when a frame carries a plan epoch older than the
+        server's — the sender has not yet re-derived its plan for the
+        current world.  Fenced exactly like a stale incarnation: the
+        frame is dropped (counted) and the reply tells the sender which
+        epoch to re-plan for; folding it would mix grad scales from two
+        different worlds into one round (or resurrect a dead round's
+        stream after a membership change)."""
+        if pepoch is None or int(pepoch) >= self._plan_epoch:
+            return False
+        self.counters["stale_plan_drops"] += 1
+        return True
+
+    def _h_plan(self, trainer_id=0):
+        """The re-plan handshake: the current plan epoch and world size.
+        Trainers call this when a reply reveals a newer epoch, then
+        re-derive their plan (transpiler.derive_plan) for the returned
+        world."""
+        with self._cv:
+            return {"epoch": self._plan_epoch,
+                    "world": max(1, len(self._live)),
+                    "live": sorted(self._live),
+                    "trainers": self.num_trainers}
+
+    def _plan_reply_locked(self, reply):
+        """Stamp the current plan epoch into a reply ONCE elasticity has
+        engaged (epoch > 0): trainers note it passively off their normal
+        traffic and re-plan at their next step.  Epoch-0 replies stay
+        byte-identical to the pre-elastic wire."""
+        if self._plan_epoch > 0:
+            reply["pepoch"] = self._plan_epoch
+        return reply
+
     # ---- elastic rejoin --------------------------------------------------
     def _admit_locked(self, tid):
         """Admit a (re)joining trainer into the live set.  ONLY called at
@@ -1072,6 +1215,7 @@ class ParameterServer:
         round is being assembled, or survivors would wait on a joiner
         that was never part of the round."""
         was_evicted = tid in self._evicted
+        grew = tid not in self._live
         self._live.add(tid)
         self._evicted.discard(tid)
         self._completed.discard(tid)
@@ -1081,6 +1225,11 @@ class ParameterServer:
             self.counters["readmissions"] += 1
             print("PSERVER READMIT trainer=%d round=%d" % (tid, self._round),
                   flush=True)
+        if grew:
+            # admission only happens at a boundary, so the epoch mints
+            # immediately: the joiner's very first `plan` fetch (and the
+            # survivors' next-round re-plan) see the grown world
+            self._mark_plan_dirty_locked()
 
     def _admit_pending_joins_locked(self):
         """Admit parked joins IF the server is at a round boundary —
@@ -1145,8 +1294,10 @@ class ParameterServer:
             if tid in self._tracked:
                 self._tracked[tid] = time.monotonic()
             self._cv.notify_all()
-            return {"ok": True, "live": True, "round": self._round,
-                    "incarnation": self.incarnation}
+            return self._plan_reply_locked(
+                {"ok": True, "live": True, "round": self._round,
+                 "world": max(1, len(self._live)),
+                 "incarnation": self.incarnation})
 
     def _h_stats(self, trainer_id=0):
         """Recovery observability: incarnation, round, live/evicted sets,
@@ -1160,6 +1311,11 @@ class ParameterServer:
                    "evicted": sorted(self._evicted),
                    "async_sends": self._async_sends,
                    "staleness_bound": self._staleness_bound,
+                   # elastic autoscaling evidence: the current epoch +
+                   # the per-membership-phase round log
+                   "plan_epoch": self._plan_epoch,
+                   "world": len(self._live),
+                   "phases": self._phases_snapshot_locked(),
                    # rpc dict keys must be strings (closed wire types)
                    "clocks": {str(t): c
                               for t, c in sorted(
@@ -1174,8 +1330,10 @@ class ParameterServer:
         re-evaluation all converge here."""
         self._fetch_barriers.clear()
         self._params_ready = False
-        # fetch drained: a round boundary — parked joins admit
+        # fetch drained: a round boundary — parked joins admit, pending
+        # plan epochs mint
         self._admit_pending_joins_locked()
+        self._maybe_mint_plan_locked()
         self._cv.notify_all()
 
     def _reeval_barriers_locked(self):
@@ -1307,6 +1465,10 @@ class ParameterServer:
         # round boundary: admit trainers parked in `register` — the NEXT
         # round's barrier totals include them from its very first bucket
         self._admit_pending_joins_locked()
+        # ... and mint any pending plan epoch: a membership change that
+        # landed mid-round becomes visible to trainers exactly one round
+        # after it happened (their blocking send replies carry it)
+        self._maybe_mint_plan_locked()
         self._cv.notify_all()
 
     # ---- handlers --------------------------------------------------------
@@ -1383,7 +1545,7 @@ class ParameterServer:
 
     def _h_send_bucket(self, blocks, trainer_id=0, seq_total=None,
                        step=None, seq_idx=None, sparse_tables=None,
-                       aseq=None):
+                       aseq=None, pepoch=None):
         """Coalesced grad frame: `blocks` maps grad block name -> value,
         shipped as ONE rpc round trip (see ops/dist_ops.py send_bucket).
         Server-side the bucket is unpacked into exactly the per-block
@@ -1424,8 +1586,9 @@ class ParameterServer:
                     # restart, or an incarnation-bump re-ship) of a bucket
                     # whose apply is already durable: drop, never double
                     self.counters["dedup_drops"] += 1
-                    return {"ok": True, "dup": True,
-                            "acked": self._dense_fence[tid][0]}
+                    return self._plan_reply_locked(
+                        {"ok": True, "dup": True,
+                         "acked": self._dense_fence[tid][0]})
                 # NOTE: aseq never feeds _trainer_clock — it counts
                 # BUCKETS per endpoint, not steps, so a multi-bucket
                 # model would inflate a laggard's clock by the bucket
@@ -1443,17 +1606,25 @@ class ParameterServer:
                         {"k": "d", "b": vals, "tid": tid, "q": aseq})
                     self._dense_fence_commit(tid, aseq)
                     self._async_dense_ckpt_locked()
-                    return {"ok": True,
-                            "acked": self._dense_fence[tid][0]}
+                    return self._plan_reply_locked(
+                        {"ok": True, "acked": self._dense_fence[tid][0]})
                 self._journal_append_locked(
                     {"k": "d", "b": vals, "tid": tid, "q": None})
                 self._async_dense_ckpt_locked()
+                return self._plan_reply_locked({"ok": True})
             return {"ok": True}
         with self._cv:
             self._touch(trainer_id)
             tid = int(trainer_id)
             if tid in self._evicted:
                 return {"ok": False, "evicted": True}
+            if self._stale_plan_locked(pepoch):
+                # plan-epoch fence (elastic autoscaling): the sender's
+                # world is out of date — its grads carry the OLD scale.
+                # Dropped, never folded; the sender re-plans off the
+                # reply and re-ships the round at the current epoch.
+                return {"ok": True, "stale_plan": True,
+                        "pepoch": self._plan_epoch}
             if seq_total and step is not None:
                 step = int(step)
                 if step <= self._folded_send.get(tid, -1):
@@ -1461,7 +1632,8 @@ class ParameterServer:
                     # contains: the fold record rode the same snapshot as
                     # the params, so applying again would double the round
                     self.counters["dup_round_drops"] += 1
-                    return {"ok": True, "dup_round": True}
+                    return self._plan_reply_locked(
+                        {"ok": True, "dup_round": True})
                 prev = self._folded_send.get(tid)
                 if prev is not None and step > prev + 1:
                     # the trainer replays only its CURRENT round, so any
@@ -1502,12 +1674,12 @@ class ParameterServer:
             for name, value in blocks.items():
                 self._fold_pending_locked(name, tid, np.asarray(value))
             if not seq_total:
-                return {"ok": True}
+                return self._plan_reply_locked({"ok": True})
             if step is not None:
                 seen = self._send_seen[tid]
                 seen.add(int(seq_idx or 0))
                 if len(seen) < int(seq_total):
-                    return {"ok": True}
+                    return self._plan_reply_locked({"ok": True})
                 if sparse_tables:
                     # the trainer declared sparse chunks for this step:
                     # every one must be PENDING before the fold may run
@@ -1528,7 +1700,8 @@ class ParameterServer:
                     missing = [t for t in sparse_tables
                                if (tid, t) not in self._pending_sparse]
                     if missing:
-                        return {"ok": True, "need_sparse": missing}
+                        return self._plan_reply_locked(
+                            {"ok": True, "need_sparse": missing})
                 self._folded_send[tid] = step
                 self._send_step.pop(tid, None)
                 self._send_seen.pop(tid, None)
@@ -1550,6 +1723,11 @@ class ParameterServer:
                 )
                 if tid in self._evicted:
                     return {"ok": False, "evicted": True}
+            # the blocking (folded-barrier) reply is constructed AFTER
+            # the round ran — a boundary-minted epoch rides it, so every
+            # survivor learns the new world exactly one round after the
+            # membership change
+            return self._plan_reply_locked({"ok": True})
         return {"ok": True}
 
     def _h_get_bucket(self, names, trainer_id=0, fetch_total=None,
@@ -1804,7 +1982,7 @@ class ParameterServer:
             raise ValueError("unknown sparse optimizer %r" % typ)
 
     def _h_send_sparse(self, table, ids, rows, trainer_id=0, step=None,
-                       seq=None):
+                       seq=None, pepoch=None):
         """Sparse optimizer update on this server's rows (SelectedRows
         grad).  Sync mode queues until the round barrier so the update
         sees this round's scheduled lr and all trainers' rows merge into
@@ -1834,16 +2012,23 @@ class ParameterServer:
             tid = int(trainer_id)
             if tid in self._evicted:
                 return {"ok": False, "evicted": True}
+            if self.sync_mode and self._stale_plan_locked(pepoch):
+                # plan-epoch fence: rows scaled for a stale world must
+                # not queue into a current-epoch round (the sender
+                # re-plans and re-ships — see _h_send_bucket)
+                return {"ok": True, "stale_plan": True,
+                        "pepoch": self._plan_epoch}
             if (self.sync_mode and step is not None
                     and int(step) <= self._folded_send.get(tid, -1)):
                 self.counters["dup_round_drops"] += 1
-                return {"ok": True, "dup_round": True}
+                return self._plan_reply_locked(
+                    {"ok": True, "dup_round": True})
             if self.sync_mode:
                 # keyed overwrite: a fenced replay of this round's chunk
                 # replaces rather than double-queues (dist_ops ships one
                 # chunk per (table, server) per step)
                 self._pending_sparse[(tid, table)] = (ids, rows)
-                return {"ok": True}
+                return self._plan_reply_locked({"ok": True})
             # ---- async path ------------------------------------------
             key = (tid, str(table))
             if seq is not None:
@@ -1851,7 +2036,8 @@ class ParameterServer:
                 fence = self._sparse_fence.get(key, 0)
                 if seq <= fence:
                     self.counters["dedup_drops"] += 1
-                    return {"ok": True, "dup": True, "acked": fence}
+                    return self._plan_reply_locked(
+                        {"ok": True, "dup": True, "acked": fence})
                 self._clock_update_locked(tid, seq)
                 self._park_if_stale_locked(tid, seq)
                 if tid in self._evicted:  # evicted while parked
@@ -1876,8 +2062,36 @@ class ParameterServer:
             # bound the segment's growth
             self._journal_maybe_snapshot_locked()
             if seq is not None:
-                return {"ok": True, "acked": seq}
+                return self._plan_reply_locked({"ok": True, "acked": seq})
         return {"ok": True}
+
+    def _h_sparse_clocks(self, clocks, trainer_id=0):
+        """Merged clock-only frame (async fenced mode): one RPC carries
+        EVERY table whose chunk this step had no rows for this server —
+        previously each shipped its own empty send_sparse, n_servers *
+        n_tables tiny frames per async step.  Semantics are identical to
+        the empty chunks this replaces: per-table fences advance
+        monotonically (nothing is journaled — there is no data), the
+        trainer's logical clock advances to the newest seq, and the
+        bounded-staleness park applies exactly once for the frame."""
+        with self._cv:
+            self._touch(trainer_id)
+            tid = int(trainer_id)
+            if tid in self._evicted:
+                return {"ok": False, "evicted": True}
+            newest = 0
+            for table, seq in sorted(dict(clocks).items()):
+                key = (tid, str(table))
+                seq = int(seq)
+                if seq > self._sparse_fence.get(key, 0):
+                    self._sparse_fence[key] = seq
+                newest = max(newest, seq)
+            if newest:
+                self._clock_update_locked(tid, newest)
+                self._park_if_stale_locked(tid, newest)
+                if tid in self._evicted:  # evicted while parked
+                    return {"ok": False, "evicted": True}
+            return self._plan_reply_locked({"ok": True, "acked": newest})
 
     def _h_checkpoint_notify(self, dir=None, trainer_id=0):
         """Trainer-initiated checkpoint (checkpoint_notify_op.cc analog).
@@ -1891,9 +2105,11 @@ class ParameterServer:
     def _h_complete(self, trainer_id=0):
         with self._cv:
             tid = int(trainer_id)
+            departed = False
             if tid in self._live:
                 self._live.discard(tid)
                 self._completed.add(tid)
+                departed = True
             elif (tid not in self._evicted and tid not in self._completed
                     and self._live):
                 # genuinely unknown id (legacy callers used a bare
@@ -1905,6 +2121,7 @@ class ParameterServer:
                 # denominator.
                 self._live.pop()
                 self._completed.add(tid)  # once: repeats must not re-pop
+                departed = True
             self._tracked.pop(tid, None)
             # completion frees the staleness bound exactly like eviction
             # (the notify_all below wakes any parked fast peer)
@@ -1926,6 +2143,10 @@ class ParameterServer:
             # done-check: a completing survivor must not declare the job
             # over under a rejoiner
             self._admit_pending_joins_locked()
+            if departed:
+                # clean departure is a durable shrink: the survivors'
+                # next rounds re-scale to the smaller world
+                self._mark_plan_dirty_locked()
             if not self._live:
                 self._done.set()
             if self.sync_mode and self._live:
@@ -2050,7 +2271,11 @@ def run_pserver(program, scope, executor=None):
         # trainer COUNTERS lines and must not fold these in)
         import json as _json
 
+        with service._cv:
+            phases = service._phases_snapshot_locked()
+            plan_epoch = service._plan_epoch
         print("PSERVER-STATS " + _json.dumps(
             dict(service.counters, round=service._round,
                  incarnation=service.incarnation,
-                 async_sends=service._async_sends)), flush=True)
+                 async_sends=service._async_sends,
+                 plan_epoch=plan_epoch, phases=phases)), flush=True)

@@ -155,7 +155,12 @@ _comm_stats = {"rpc_round_trips": 0, "comm_bytes_sent": 0,
                "pserver_restarts_seen": 0,
                "recoveries": 0, "recovery_ms": 0.0,
                "async_sparse_sends": 0, "async_dedup_drops": 0,
-               "async_resends": 0}
+               "async_resends": 0,
+               # elastic autoscaling (docs/FAULT_TOLERANCE.md): plan
+               # re-derivations this trainer performed after observing a
+               # new pserver plan epoch, their total latency, and
+               # clock-only sparse frames merged per (endpoint, step)
+               "replans": 0, "replan_ms": 0.0, "async_clock_merges": 0}
 # per-verb round-trip breakdown (rides get_comm_stats as "rpc_verbs"):
 # the collective dense-grad backend is ACCEPTED on this evidence — a
 # hybrid run must show zero send/send_bucket/recv/get_bucket trips while
@@ -287,6 +292,42 @@ def incarnation_of(endpoint):
 def reset_incarnations():
     with _incar_lock:
         _incarnations.clear()
+
+
+# ---- pserver plan-epoch registry (elastic autoscaling) ------------------
+# A pserver mints a new PLAN EPOCH at the first round boundary after its
+# live trainer set changes durably (eviction, admission, departure —
+# ps_server.py).  Once minted, every service-level reply carries
+# "pepoch"; clients note it here so the trainer-side dist ops know —
+# passively, off their normal traffic — when to re-derive the comm plan
+# (transpiler.derive_plan) for the new world size.  The registry is
+# process-wide like the incarnation registry: heartbeat senders keep it
+# fresh even while a trainer is blocked in compute.
+_plan_epochs = {}  # endpoint -> newest plan epoch observed
+
+
+def note_plan_reply(endpoint, reply):
+    """Record the plan epoch a service reply carried (no-op for replies
+    that predate elasticity or epoch 0)."""
+    if not isinstance(reply, dict):
+        return
+    pe = reply.get("pepoch")
+    if pe is None:
+        return
+    with _incar_lock:
+        if int(pe) > _plan_epochs.get(endpoint, 0):
+            _plan_epochs[endpoint] = int(pe)
+
+
+def plan_epoch_of(endpoint):
+    """Newest plan epoch observed from `endpoint` (0 before any mint)."""
+    with _incar_lock:
+        return _plan_epochs.get(endpoint, 0)
+
+
+def reset_plan_epochs():
+    with _incar_lock:
+        _plan_epochs.clear()
 
 
 class _SegWriter:
@@ -1284,6 +1325,10 @@ def ensure_heartbeat(endpoint, trainer_id=0):
                     try:
                         r = cli.heartbeat(trainer_id=int(trainer_id),
                                           deadline_s=2 * interval)
+                        # beats double as the plan-epoch news feed: a
+                        # trainer blocked in compute still learns a
+                        # membership change before its next send
+                        note_plan_reply(endpoint, r)
                         if isinstance(r, dict) and r.get("live") is False:
                             # the pserver evicted this trainer and will
                             # never re-admit it: stop wasting beats (the

@@ -210,6 +210,19 @@ DEFINE_flag("sparse_hot_ttl", 8,
             "steps a hot-row cache entry may serve before it must be "
             "re-fetched from its pserver (the drift-correction refresh "
             "for FLAGS_sparse_hot_rows)")
+DEFINE_flag("elastic_replan", True,
+            "elastic autoscaling (docs/FAULT_TOLERANCE.md): trainers "
+            "re-derive their bucket/shard plan at runtime (transpiler."
+            "derive_plan over the program-carried plan spec) when a "
+            "pserver mints a new plan epoch — membership changed "
+            "durably — correcting the baked 1/N grad scale to the live "
+            "world and fencing stale-epoch frames like stale "
+            "incarnations.  For an unchanged world the re-derived plan "
+            "is bit-identical to the transpile-time plan and the "
+            "correction is exactly 1.0 (skipped), so static jobs are "
+            "unaffected.  0 pins the transpile-time plan forever (the "
+            "pre-elastic behavior: a dead trainer leaves the job "
+            "under-scaled, an added one cannot contribute)")
 DEFINE_flag("comm_inflight", 4,
             "window of in-flight bucket RPCs per pserver endpoint: bucket "
             "N+1 serializes and sends while bucket N is on the wire; "

@@ -318,6 +318,188 @@ def _hot_cache_for(table_names, hot_opt):
             cap, int(get_flag("sparse_hot_ttl")), float(hot_opt["lr"]))
     return cache
 
+# ---- elastic autoscaling: runtime re-derivable plans --------------------
+# The transpiler stamps every bucket/sparse rpc op with a JSON-able
+# plan SPEC (a pure function input: param set, endpoints, world size,
+# flags) plus a plan group id.  At runtime the ops keep ONE shared plan
+# state per group: when a pserver reply reveals a newer PLAN EPOCH (the
+# server minted one at a round boundary after its live set changed
+# durably), the next step re-derives the whole plan from the spec via
+# transpiler.derive_plan for the new world — bit-identical to the
+# transpile-time plan when the world is unchanged — and corrects the
+# program-baked 1/N grad scale by a host-side factor N0/N_live.  Frames
+# carry the sender's epoch; the server fences stale-epoch frames like
+# stale incarnations and the sender re-plans + re-ships
+# (docs/FAULT_TOLERANCE.md "Elastic autoscaling").
+_plans = {}  # plan_gid -> runtime plan state
+
+
+def _plan_rt(attrs):
+    """Shared runtime plan state for this op's plan group (None when
+    the op predates the plan spec, or FLAGS_elastic_replan is off —
+    legacy static-plan behavior, bit for bit)."""
+    gid = attrs.get("plan_gid")
+    spec = attrs.get("plan_spec")
+    if gid is None or not spec:
+        return None
+    from ..flags import get_flag
+
+    if not get_flag("elastic_replan"):
+        return None
+    st = _plans.get(gid)
+    if st is None:
+        base = int(spec["trainers"])
+        st = _plans[gid] = {
+            "spec": spec, "epoch": 0, "base": base, "world": base,
+            "corr": 1.0, "derived": None, "replans": 0}
+    return st
+
+
+def _maybe_replan(st, eps, trainer_id):
+    """Re-derive the plan if any endpoint's observed plan epoch moved
+    past ours: ONE `plan` handshake fetches the new world, derive_plan
+    rebuilds the bucket layout from the spec, and the scale correction
+    becomes N0/N_live.  Runs at the top of every send host callback —
+    a dict compare when nothing changed."""
+    if st is None:
+        return
+    from ..distributed import rpc as _rpc
+
+    newest, target = st["epoch"], None
+    for ep in eps:
+        pe = _rpc.plan_epoch_of(ep)
+        if pe > newest:
+            newest, target = pe, ep
+    if target is None:
+        return
+    import time
+
+    from ..distributed.rpc import RPCClient
+    from ..transpiler.distribute_transpiler import derive_plan
+
+    t0 = time.perf_counter()
+    r = RPCClient.get(target).call("plan", trainer_id=int(trainer_id))
+    epoch = int(r.get("epoch", newest))
+    world = max(1, int(r.get("world", st["world"])))
+    st["derived"] = derive_plan(st["spec"], world={"trainers": world})
+    st["epoch"] = max(newest, epoch)
+    st["world"] = world
+    st["corr"] = float(st["base"]) / float(world)
+    st["replans"] += 1
+    _rpc.note_async(replans=1,
+                    replan_ms=round((time.perf_counter() - t0) * 1e3, 3))
+    print("TRAINER REPLAN epoch=%d world=%d corr=%.6g"
+          % (st["epoch"], world, st["corr"]), flush=True)
+
+
+def _note_plan(ep, result):
+    from ..distributed import rpc as _rpc
+
+    _rpc.note_plan_reply(ep, result)
+
+
+def _scale_corr(arr, corr):
+    """Host-side elastic grad-scale correction: the program bakes 1/N0,
+    the live world is N_live — multiply by N0/N_live in the arr's own
+    dtype.  corr == 1.0 skips entirely, keeping the unchanged-world
+    path bit-identical to the static plan."""
+    if corr == 1.0 or arr.dtype.kind != "f":
+        return arr
+    return (arr * arr.dtype.type(corr)).astype(arr.dtype, copy=False)
+
+
+def _drain_plan_checked(pipe, ep, trainer_id, stale_plan=None):
+    """Window drain + the three reply inspections every drained result
+    needs: eviction is fatal, pepoch feeds the plan registry, and a
+    stale_plan notice (the server fenced our frames — our world is out
+    of date) is collected for the caller's re-plan + re-ship."""
+    results = pipe(ep).drain()
+    for r in results:
+        _check_not_evicted(r, ep, trainer_id)
+        _note_plan(ep, r)
+        if stale_plan is not None and isinstance(r, dict) \
+                and r.get("stale_plan"):
+            stale_plan.add(ep)
+    return results
+
+
+def _replay_round_plan(pipe, trainer_id, eps, st, stale_plan=None):
+    """Stale-plan recovery: re-stamp the recorded round stream with the
+    freshly re-derived epoch, rescale it from the recorded corr to the
+    current one, then re-ship through the SAME skeleton the incarnation
+    replay uses (_replay_round_sends: sparse first, dense submits,
+    inspected drains) — one re-ship path to keep correct, and a SECOND
+    epoch mint landing mid-recovery surfaces in the caller's
+    `stale_plan` set instead of being silently swallowed.  Raw
+    (uncompressed) blocks rescale exactly; wire-compressed blocks
+    re-ship as recorded — one transition round at the old scale, the
+    documented approximation (membership changed, so no bit-exactness
+    contract exists here)."""
+    for ep in eps:
+        fst = _fence(ep)
+        rec_corr = float(fst.get("corr", 1.0))
+        ratio = st["corr"] / rec_corr if rec_corr else 1.0
+        for kw in fst["sparse"].values():
+            kw["pepoch"] = st["epoch"]
+            rows = kw.get("rows")
+            if isinstance(rows, np.ndarray):
+                kw["rows"] = _scale_corr(rows, ratio)
+        for kw in fst["sends"]:
+            kw["pepoch"] = st["epoch"]
+            kw["blocks"] = {
+                bn: (_scale_corr(v, ratio) if isinstance(v, np.ndarray)
+                     else v)
+                for bn, v in kw["blocks"].items()}
+        fst["corr"] = st["corr"]
+    _replay_round_sends(pipe, trainer_id, eps, stale_plan)
+
+
+# ---- async clock-only frame coalescing ----------------------------------
+# PR 8's fenced delivery ships an EMPTY send_sparse chunk to every
+# server each async step purely to carry the per-step seq clock —
+# n_servers * n_tables tiny RPCs per step.  The transpiler now stamps
+# each async send_sparse op with its clock group (clk_gid) and the
+# program's total op count (clk_ops): rowless chunks buffer their
+# (table, seq) here instead of shipping, and when the step's LAST
+# send_sparse op has run, ONE merged `sparse_clocks` frame per endpoint
+# delivers them all.  Monotonic-fence semantics are identical to the
+# empty chunks this replaces (nothing journaled, fences advance,
+# staleness parks once per frame).
+_clk_groups = {}  # clk_gid -> {"n", "seen", "pending": {ep: {table: seq}}}
+
+
+def _clk_group(attrs):
+    gid = attrs.get("clk_gid")
+    if gid is None:
+        return None
+    st = _clk_groups.get(gid)
+    if st is None:
+        st = _clk_groups[gid] = {"n": int(attrs.get("clk_ops", 1)),
+                                 "seen": 0, "pending": {}}
+    return st
+
+
+def _clk_flush(clk, cli_for, tid):
+    """End of step: ship the merged clock-only frames, one per endpoint
+    that had rowless tables this step.  The incarnation-replay check
+    runs FIRST, exactly like the per-step empty chunks this replaces
+    did: the clock frame advances the per-table seq fence, and letting
+    it move past an un-acked data chunk on a restarted server would
+    make the eventual re-send drop as `dup` — a silently lost update,
+    the one thing the journal/fence/replay machinery exists to
+    prevent."""
+    from ..distributed import rpc as _rpc
+
+    pending, clk["pending"] = clk["pending"], {}
+    for ep, clocks in sorted(pending.items()):
+        cli = cli_for(ep, tid)
+        _async_check_replay(cli, ep, tid)
+        r = cli.call("sparse_clocks", clocks=clocks, trainer_id=tid)
+        _check_not_evicted(r, ep, tid)
+        _note_plan(ep, r)
+        _rpc.note_async(async_clock_merges=1)
+
+
 # ---- wire compression (FLAGS_comm_wire_dtype / FLAGS_comm_grad_int8) ---
 # int8 error-feedback residuals, TRAINER-side per (endpoint, block):
 # each round quantizes (grad + residual) and keeps the quantization
@@ -333,6 +515,11 @@ def reset_fences():
     _fences.clear()
     _ef_residuals.clear()
     _hot_caches.clear()
+    _plans.clear()
+    _clk_groups.clear()
+    from ..distributed import rpc as _rpc
+
+    _rpc.reset_plan_epochs()
 
 
 def _fence(ep):
@@ -395,7 +582,7 @@ def _stale_endpoints(eps):
     return out
 
 
-def _replay_round_sends(pipe, trainer_id, eps):
+def _replay_round_sends(pipe, trainer_id, eps, stale_plan=None):
     """Re-ship the recorded current-round stream to restarted endpoints:
     queued sparse chunks first (they must be pending BEFORE the dense
     fold triggers the round), then the dense buckets.  The submit that
@@ -418,12 +605,15 @@ def _replay_round_sends(pipe, trainer_id, eps):
         for kw in st["sparse"].values():
             r = cli.call("send_sparse", **kw)
             _check_not_evicted(r, ep, trainer_id)
+            _note_plan(ep, r)
+            if stale_plan is not None and isinstance(r, dict) \
+                    and r.get("stale_plan"):
+                stale_plan.add(ep)
         for kw in st["sends"]:
             pipe(ep).submit("send_bucket", timeout_s=_BLOCKING_TIMEOUT,
                             **kw)
     for ep in eps:
-        for r in pipe(ep).drain():
-            _check_not_evicted(r, ep, trainer_id)
+        _drain_plan_checked(pipe, ep, trainer_id, stale_plan)
         _fence(ep)["inc"] = targets[ep]
     _rpc.note_recovery((time.perf_counter() - t0) * 1e3)
 
@@ -572,16 +762,38 @@ def _send_bucket(ctx, ins, attrs):
     from ..distributed import rpc as _rpc_mod
 
     _rpc_mod.note_wire_dtype(wire_dtype)
+    # elastic autoscaling: the declarative plan spec (when stamped)
+    # makes this op's bucket layout + grad scale re-derivable at
+    # runtime; plan_rt is the program's shared runtime plan state
+    plan_rt = _plan_rt(attrs)
+    plan_eps = sorted({ep for ep, _ in plan})
     pipe = _pipelined(trainer_id)
 
     def host_send(*grads):
         from ..profiler import RecordEvent
 
-        flats = [np.asarray(g).reshape(-1) for g in grads]
+        use_plan, use_totals, corr, pepoch = plan, totals, 1.0, None
+        if plan_rt is not None:
+            _maybe_replan(plan_rt, plan_eps, trainer_id)
+            corr = plan_rt["corr"]
+            pepoch = plan_rt["epoch"]
+            if plan_rt["derived"] is not None:
+                # the re-derived plan REPLACES the transpile-time one —
+                # for an unchanged world it is bit-identical (the
+                # derive_plan contract), so this swap is exercised on
+                # every re-plan, not just on layout changes
+                d = plan_rt["derived"]
+                use_plan = [
+                    (ep, [(int(xi), int(b), int(e), bn)
+                          for xi, b, e, bn in entries])
+                    for ep, entries in d["send_buckets"]]
+                use_totals = (d["sync_totals"] if totals else {})
+        flats = [_scale_corr(np.asarray(g).reshape(-1), corr)
+                 for g in grads]
         per_ep = {}
         with RecordEvent("wire_compress", cat="compress") \
                 if compressing else _null_ctx():
-            for ep, entries in plan:
+            for ep, entries in use_plan:
                 blocks = {
                     bn: _compress_block(ep, bn, flats[xi][b:e],
                                         wire_dtype, grad_int8)
@@ -589,7 +801,7 @@ def _send_bucket(ctx, ins, attrs):
                     for xi, b, e, bn in entries}
                 per_ep.setdefault(ep, []).append(blocks)
         for ep, blist in per_ep.items():
-            total = totals.get(ep)
+            total = use_totals.get(ep)
             if not total:
                 if async_fence:
                     st = _async_st(ep)
@@ -616,6 +828,9 @@ def _send_bucket(ctx, ins, attrs):
 
                 st["inc"] = _rpc.incarnation_of(ep)
             st["step"] += 1
+            # the corr the recorded blocks were scaled with: a stale-
+            # plan replay rescales them to the then-current corr
+            st["corr"] = corr
             # declare this step's sparse manifest on every dense bucket:
             # the server must not fold (and run the round) until each
             # declared chunk is pending.  Without this, a crash after
@@ -630,6 +845,9 @@ def _send_bucket(ctx, ins, attrs):
                 dict(blocks=blocks, trainer_id=trainer_id, seq_total=total,
                      step=st["step"], seq_idx=i, sparse_tables=declared)
                 for i, blocks in enumerate(blist)]
+            if pepoch is not None:
+                for kw in st["sends"]:
+                    kw["pepoch"] = pepoch
             for kw in st["sends"]:
                 pipe(ep).submit("send_bucket", timeout_s=_BLOCKING_TIMEOUT,
                                 **kw)
@@ -661,6 +879,7 @@ def _recv_bucket(ctx, ins, attrs):
     # (from the transpiler plan) and the server compresses its reply;
     # the decoder hands back the original dtype transparently
     wire_dtype = str(attrs.get("wire_dtype") or "float32")
+    plan_rt = _plan_rt(attrs)
     pipe = _pipelined(trainer_id)
     out_structs = [
         jax.ShapeDtypeStruct(tuple(shape), jdt(dtype))
@@ -669,9 +888,12 @@ def _recv_bucket(ctx, ins, attrs):
 
     def host_recv():
         eps_here = sorted({ep for ep, _ in buckets})
+        # endpoints whose servers FENCED this round's frames as stale-
+        # plan (our world was out of date): re-plan, then re-ship — the
+        # elastic sibling of the incarnation replay below
+        stale_plan = set()
         for ep in eps_here:
-            for r in pipe(ep).drain():
-                _check_not_evicted(r, ep, trainer_id)
+            _drain_plan_checked(pipe, ep, trainer_id, stale_plan)
         fenced = bool(totals)
         per_ep_names = {}
         for ep, names in buckets:
@@ -685,13 +907,38 @@ def _recv_bucket(ctx, ins, attrs):
         block_vals = {}
         to_fetch = list(eps_here)
         for _attempt in range(_MAX_ROUND_REPLAYS):
+            for _replan_try in range(_MAX_ROUND_REPLAYS):
+                if not (fenced and plan_rt is not None and stale_plan):
+                    break
+                # plan-epoch fence tripped: refresh the plan from the
+                # server's current world, restamp + rescale the recorded
+                # round stream and re-ship it BEFORE any fetch — the
+                # dropped frames mean the round never assembled there,
+                # so fetching first would park on params that are never
+                # coming.  The replay's own drains feed `stale_plan`
+                # back, so a SECOND mint landing mid-recovery loops
+                # (bounded) instead of being swallowed.
+                _maybe_replan(plan_rt, eps_here, trainer_id)
+                targets = sorted(stale_plan)
+                stale_plan.clear()
+                _replay_round_plan(pipe, trainer_id, targets, plan_rt,
+                                   stale_plan)
+            if fenced and plan_rt is not None and stale_plan:
+                # still fenced after the last allowed replay (a for/else
+                # would also fire when the FINAL replay just succeeded)
+                raise RuntimeError(
+                    "sync round could not complete: plan epochs moved "
+                    "faster than %d re-plan replays (membership is "
+                    "flapping beyond the policy's damping)"
+                    % _MAX_ROUND_REPLAYS)
             if fenced:
                 # a bump between this round's sends and here means the
                 # server restarted from its round-boundary checkpoint:
                 # re-ship the round's stream before pulling params
                 stale = _stale_endpoints(eps_here)
                 if stale:
-                    _replay_round_sends(pipe, trainer_id, stale)
+                    _replay_round_sends(pipe, trainer_id, stale,
+                                        stale_plan)
             futs = []
             for ep in to_fetch:
                 for i, names in enumerate(per_ep_names.get(ep, [])):
@@ -719,7 +966,8 @@ def _recv_bucket(ctx, ins, attrs):
                         and v.dtype.kind == "f"))
                 block_vals.update(got)
             for ep in to_fetch:
-                pipe(ep).drain()  # clear resolved futures off the window
+                # clear resolved futures off the window
+                _drain_plan_checked(pipe, ep, trainer_id, stale_plan)
             if not fenced:
                 break
             # a restart DURING the fetch served params from a snapshot
@@ -729,9 +977,9 @@ def _recv_bucket(ctx, ins, attrs):
             # redundant re-pull there would park on a flag only the
             # NEXT round sets
             stale = _stale_endpoints(eps_here)
-            if not stale:
+            if not stale and not stale_plan:
                 break
-            to_fetch = stale
+            to_fetch = stale or sorted(stale_plan)
         else:
             raise RuntimeError(
                 "sync round could not complete: pserver(s) restarted "
@@ -899,13 +1147,25 @@ def _send_sparse(ctx, ins, attrs):
         def cli_for(ep, _tid):
             return _cli(ep)
 
+    # elastic autoscaling: shares the program's runtime plan state with
+    # the bucket ops (the transpiler stamps the same plan_gid), so the
+    # sparse scale correction and plan epoch move in lockstep with dense
+    plan_rt = _plan_rt(attrs) if not collective else None
+
     def host_push(tid, ids_v, grad_v):
         """ONE routing core for both trainer-id sources: rows route to
         server id%n.  sync_mode (never set on the collective plan — no
         dense round exists there) additionally stamps step tokens and
         records the chunk for incarnation-fenced replay."""
+        corr, pepoch = 1.0, None
+        if plan_rt is not None:
+            _maybe_replan(plan_rt, epmap, tid)
+            corr, pepoch = plan_rt["corr"], plan_rt["epoch"]
         flat = np.asarray(ids_v).reshape(-1).astype(np.int64)
         g = np.asarray(grad_v).reshape(flat.size, -1) * scale
+        # elastic scale correction: the transpile-time 1/N0 becomes
+        # 1/N_live (corr == 1.0 for an unchanged world — bit-identical)
+        g = _scale_corr(g, corr)
         if async_fence and not collective:
             cache = _hot_cache_for(table_names, hot_opt)
             if cache is not None:
@@ -918,12 +1178,24 @@ def _send_sparse(ctx, ins, attrs):
             if async_fence and not collective:
                 from ..distributed import rpc as _rpc
 
-                cli = cli_for(ep, tid)
-                _async_check_replay(cli, ep, tid)
                 st = _async_st(ep)
                 table = table_names[s]
                 seq = st["sseq"].get(table, 0) + 1
                 st["sseq"][table] = seq
+                clk = _clk_group(attrs)
+                if clk is not None and not mask.any():
+                    # clock-only chunk: nothing to apply — buffer the
+                    # (table, seq) clock and let the step's ONE merged
+                    # sparse_clocks frame per endpoint deliver it
+                    # (previously each rowless table shipped its own
+                    # empty send_sparse: n_servers * n_tables tiny RPCs
+                    # per async step).  Not queued for resend — the
+                    # fence is monotonic, a lost clock is superseded by
+                    # the next step's.
+                    clk["pending"].setdefault(ep, {})[table] = seq
+                    continue
+                cli = cli_for(ep, tid)
+                _async_check_replay(cli, ep, tid)
                 kw = dict(table=table, ids=flat[mask] // n,
                           rows=_wrap_rows(g[mask]), trainer_id=tid,
                           seq=seq)
@@ -938,6 +1210,7 @@ def _send_sparse(ctx, ins, attrs):
                 uq[seq] = kw
                 r = cli.call("send_sparse", **kw)
                 _check_not_evicted(r, ep, tid)
+                _note_plan(ep, r)
                 _async_note_ack(st, table, r)
                 _rpc.note_async(async_sparse_sends=1)
                 continue
@@ -956,12 +1229,47 @@ def _send_sparse(ctx, ins, attrs):
                 st = _fence(ep)
                 step = st["step"] + 1
                 kw["step"] = step
+                if pepoch is not None:
+                    # the plan-epoch fence covers sparse chunks too: a
+                    # stale-world chunk must not queue into a current-
+                    # epoch round (recv_bucket's recovery re-ships it)
+                    kw["pepoch"] = pepoch
                 if st.get("sparse_step") != step:
                     st["sparse_step"] = step
                     st["sparse"] = {}
                 st["sparse"][table_names[s]] = kw
             r = cli_for(ep, tid).call("send_sparse", **kw)
             _check_not_evicted(r, ep, tid)
+            _note_plan(ep, r)
+            if plan_rt is not None and isinstance(r, dict) \
+                    and r.get("stale_plan"):
+                # fenced at an old epoch (the mint landed between this
+                # step's re-plan check and now): re-plan IMMEDIATELY
+                # and re-ship this chunk at the current epoch — the
+                # step's dense buckets are about to declare it in their
+                # sparse manifest, and a dropped chunk would leave the
+                # fold refusing (need_sparse) forever.  A second mint
+                # racing the retry is caught by the dense path: its
+                # buckets (same refreshed epoch) get fenced too, and
+                # recv_bucket's recovery re-ships the recorded chunk.
+                old_corr = plan_rt["corr"]
+                _maybe_replan(plan_rt, epmap, tid)
+                kw["pepoch"] = plan_rt["epoch"]
+                if isinstance(kw.get("rows"), np.ndarray) and old_corr:
+                    kw["rows"] = _scale_corr(
+                        kw["rows"], plan_rt["corr"] / old_corr)
+                r = cli_for(ep, tid).call("send_sparse", **kw)
+                _check_not_evicted(r, ep, tid)
+                _note_plan(ep, r)
+        if async_fence and not collective:
+            clk = _clk_group(attrs)
+            if clk is not None:
+                clk["seen"] += 1
+                if clk["seen"] >= clk["n"]:
+                    # the step's LAST async sparse op ran: flush the
+                    # merged clock-only frames (one per endpoint)
+                    clk["seen"] = 0
+                    _clk_flush(clk, cli_for, tid)
         return np.int32(0)
 
     struct = jax.ShapeDtypeStruct((), jnp.int32)

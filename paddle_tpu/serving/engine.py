@@ -47,7 +47,8 @@ class ServingEngine:
     startups, or load a checkpoint, before serving)."""
 
     def __init__(self, exe, hp, n_slots=4, width=8, t_max=None,
-                 cache_dtype="float32", quantize_int8=False):
+                 cache_dtype="float32", quantize_int8=False,
+                 queue_depth=None):
         from ..models import gpt2
         from ..models.decode_cache import make_slot_reset_program
         from .pool import SlotPool
@@ -78,10 +79,18 @@ class ServingEngine:
             self.n_slots, dtype=cache_dtype)
         self.pool = SlotPool(self.n_slots, self.width, self.t_max)
         self.queue = []  # submitted, not yet admitted (arrival order)
+        # admission control: an ARRIVAL that finds `queue_depth`
+        # requests already waiting is rejected loudly with a terminal
+        # REJECTED_QUEUE_FULL instead of queueing unboundedly (None =
+        # the legacy unbounded queue).  Requests submitted before their
+        # arrival step don't count — the bound is on the WAIT queue.
+        self.queue_depth = None if queue_depth is None else int(queue_depth)
+        assert self.queue_depth is None or self.queue_depth >= 0
         self.now = 0
         self.counters = {"steps": 0, "admitted": 0, "finished": 0,
                          "new_tokens": 0, "occupancy_sum": 0.0,
-                         "prefill_steps": 0, "decode_steps": 0}
+                         "prefill_steps": 0, "decode_steps": 0,
+                         "rejected": 0, "expired": 0}
         self._step_wall = []
         self._results = {}
 
@@ -96,19 +105,78 @@ class ServingEngine:
         self.queue.sort(key=lambda r: (r.arrival, r.rid))
 
     # ---- one scheduler iteration --------------------------------------
+    def _terminal(self, req, status, slot_state=None):
+        """Record a terminal (non-OK) outcome: rejected at admission or
+        expired while queued/mid-decode.  Loud by design — admission
+        control failing silently is how queues grow unboundedly."""
+        self.counters["rejected" if status == "REJECTED_QUEUE_FULL"
+                      else "expired"] += 1
+        print("SERVE %s rid=%r step=%d" % (status, req.rid, self.now),
+              flush=True)
+        # terminal results carry the SAME shape as OK results (latency
+        # measured to the terminal step): consumers that sweep
+        # results.values() — bench latency percentiles included — must
+        # not need to special-case by status
+        wall = time.time()
+        a = min(req.arrival_step, max(0, len(self._step_wall) - 1))
+        self._results[req.rid] = {
+            "tokens": np.asarray(
+                slot_state.out if slot_state is not None else [],
+                "int64"),
+            "prompt_len": int(req.prompt.size),
+            "arrival_step": req.arrival_step,
+            "admit_step": (slot_state.admit_step
+                           if slot_state is not None else None),
+            "finish_step": self.now,
+            "status": status,
+            "latency_steps": self.now - req.arrival_step + 1,
+            "latency_s": wall - (self._step_wall[a] if self._step_wall
+                                 else wall),
+        }
+
     def step(self):
         """Admit -> pooled dispatch -> sample -> evict.  Returns the
-        list of request ids that finished this step."""
+        list of request ids that reached a TERMINAL state this step:
+        finished, deadline-expired, or rejected at admission — a
+        step-by-step driver harvesting results by this list must see
+        every outcome, not just the happy one."""
+        terminal = []
         with RecordEvent("serve_admit", cat="admit"):
+            # per-request deadlines sweep FIRST: an expired mid-decode
+            # slot frees for THIS step's admissions, and an expired
+            # waiter must not take a slot ahead of live requests
+            for slot, s in self.pool.active_slots():
+                d = s.req.deadline
+                if d is not None and self.now >= s.req.arrival_step + d:
+                    self.pool.evict(slot)
+                    self._terminal(s.req, "DEADLINE_EXPIRED", s)
+                    terminal.append(s.req.rid)
             keep = np.ones(self.n_slots, "float32")
             admitted = False
-            while (self.queue and self.queue[0].arrival <= self.now
-                   and self.pool.free_slots()):
-                req = self.queue.pop(0)
-                slot = self.pool.admit(req, self.now)
-                keep[slot] = 0.0
-                admitted = True
-                self.counters["admitted"] += 1
+            waiting = 0
+            still = []
+            for req in self.queue:  # arrival order (submit keeps it)
+                d = req.deadline
+                if req.arrival > self.now:
+                    still.append(req)
+                elif d is not None and self.now >= req.arrival_step + d:
+                    self._terminal(req, "DEADLINE_EXPIRED")
+                    terminal.append(req.rid)
+                elif self.pool.free_slots():
+                    slot = self.pool.admit(req, self.now)
+                    keep[slot] = 0.0
+                    admitted = True
+                    self.counters["admitted"] += 1
+                elif (self.queue_depth is None
+                      or waiting < self.queue_depth):
+                    waiting += 1
+                    still.append(req)
+                else:
+                    # the wait queue is at depth: this arrival is
+                    # rejected NOW, not queued unboundedly
+                    self._terminal(req, "REJECTED_QUEUE_FULL")
+                    terminal.append(req.rid)
+            self.queue = still
             if admitted:
                 # zero exactly the admitted slots' cache rows; one
                 # compiled program regardless of WHICH slots reset
@@ -117,7 +185,7 @@ class ServingEngine:
         active = self.pool.active_slots()
         if not active:
             self.now += 1
-            return []
+            return terminal
         feed, plan = self.pool.build_feed(self.hp.n_ctx)
         prefilling = self.pool.any_prefilling()
         phase = "prefill" if prefilling else "decode"
@@ -146,7 +214,7 @@ class ServingEngine:
         self.counters["steps"] += 1
         self.counters["occupancy_sum"] += len(active) / self.n_slots
         self.now += 1
-        return finished
+        return terminal + finished
 
     def _pick_tokens(self, rows, slots):
         """Per-row token selection with PER-REQUEST params: greedy rows
@@ -190,6 +258,7 @@ class ServingEngine:
             "arrival_step": s.req.arrival_step,
             "admit_step": s.admit_step,
             "finish_step": self.now,
+            "status": "OK",
             "latency_steps": self.now - s.req.arrival_step + 1,
             "latency_s": wall - (self._step_wall[a] if self._step_wall
                                  else wall),

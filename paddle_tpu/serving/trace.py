@@ -21,7 +21,8 @@ class Request:
     identical solo or pooled (decode_cache.sample_rows_keyed)."""
 
     def __init__(self, rid, prompt, max_new_tokens, temperature=1.0,
-                 top_k=0, top_p=1.0, seed=None, eos_id=None, arrival=0.0):
+                 top_k=0, top_p=1.0, seed=None, eos_id=None, arrival=0.0,
+                 deadline=None):
         self.rid = rid
         self.prompt = np.asarray(prompt, "int64").reshape(-1)
         assert self.prompt.size >= 1, (
@@ -34,6 +35,11 @@ class Request:
         self.seed = None if seed is None else int(seed)
         self.eos_id = None if eos_id is None else int(eos_id)
         self.arrival = float(arrival)
+        # admission control: engine steps from arrival within which the
+        # request must FINISH — expiry while queued or mid-decode evicts
+        # it with a terminal DEADLINE_EXPIRED status (None = no budget)
+        self.deadline = None if deadline is None else int(deadline)
+        assert self.deadline is None or self.deadline >= 1, deadline
 
     @property
     def greedy(self):

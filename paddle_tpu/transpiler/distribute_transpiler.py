@@ -128,6 +128,118 @@ def slice_variable(var_numels, slice_count, min_block_size=8192):
     return out
 
 
+def derive_plan(spec, world=None, split_method=None):
+    """The declarative plan function (elastic autoscaling,
+    docs/FAULT_TOLERANCE.md "Elastic autoscaling"): a PURE function of
+    (param set, world size, endpoints, flags) -> the complete comm plan
+    — block slicing, block->endpoint dispatch, per-endpoint send/recv
+    buckets with their folded-barrier totals, and the grad scale.
+
+    The SAME function runs at transpile time (DistributeTranspiler
+    consumes its output verbatim) and at re-plan time (ops/dist_ops.py
+    re-derives when a pserver mints a new plan epoch), so for an
+    unchanged world the runtime-derived plan is BIT-IDENTICAL to the
+    transpile-time plan — the contract the chaos tests pin.
+
+    `spec` is the JSON-able plan spec the transpiler carries in the
+    program (see DistributeTranspiler.plan_spec):
+      {"params": [[param, shape, dtype, grad], ...],   # ordered
+       "endpoints": [...], "trainers": N,
+       "flags": {"slice_var_up", "min_block_size", "split_method",
+                 "comm_bucket_bytes", "comm_wire_dtype",
+                 "comm_grad_int8"}}
+    `world` overrides {"trainers": ..., "endpoints": [...]} for a
+    re-plan; `split_method` may pass the dispatcher class directly
+    (otherwise it resolves by name from ps_dispatcher — the spec stays
+    declarative)."""
+    from . import ps_dispatcher
+
+    world = world or {}
+    endpoints = [str(e) for e in
+                 (world.get("endpoints") or spec["endpoints"])]
+    trainers = int(world.get("trainers") or spec["trainers"])
+    flags = spec.get("flags") or {}
+    if split_method is None:
+        split_method = getattr(ps_dispatcher,
+                               str(flags.get("split_method",
+                                             "SizeWeighted")))
+    params = [(str(p), [int(d) for d in shape], str(dtype), str(g))
+              for p, shape, dtype, g in spec["params"]]
+
+    numels = []
+    for p, shape, _dt, _g in params:
+        numel = 1
+        for d in shape:
+            numel *= int(d)
+        numels.append((p, numel))
+    slice_count = len(endpoints) if flags.get("slice_var_up", True) else 1
+    blocks = slice_variable(numels, slice_count,
+                            int(flags.get("min_block_size", 8192)))
+    dispatcher = split_method(endpoints)
+    block_eps = {}
+    for p, _shape, _dt, _g in params:
+        for blk, ep in zip(blocks[p], dispatcher.dispatch(blocks[p])):
+            block_eps[(p, blk.idx)] = ep
+
+    plan = {
+        "endpoints": endpoints,
+        "trainers": trainers,
+        # each trainer pre-scales grads by 1/world so the pserver's
+        # per-round sum is the global-batch mean — THE value a re-plan
+        # exists to correct when membership changes durably
+        "grad_scale": 1.0 / float(trainers),
+        "blocks": blocks,
+        "block_eps": block_eps,
+    }
+    bucket_bytes = int(flags.get("comm_bucket_bytes", 0))
+    if bucket_bytes <= 0:
+        return plan
+
+    # ---- send buckets (grad push) — _plan_send_buckets's exact layout
+    per_ep = {ep: [] for ep in endpoints}
+    for xi, (p, _shape, dtype, g) in enumerate(params):
+        isz = _dtype_nbytes(dtype)
+        for blk in blocks[p]:
+            ep = block_eps[(p, blk.idx)]
+            per_ep[ep].append(
+                (blk.size * isz,
+                 [xi, blk.begin, blk.end, "%s.block%d" % (g, blk.idx)]))
+    send_buckets = []
+    for ep in endpoints:
+        got = pack_buckets(per_ep[ep], bucket_bytes)
+        for bucket in got or [[]]:  # empty bucket = folded barrier for
+            send_buckets.append([ep, bucket])  # block-less endpoints
+    sync_totals = {}
+    for ep, _entries in send_buckets:
+        sync_totals[ep] = sync_totals.get(ep, 0) + 1
+    plan["send_buckets"] = send_buckets
+    plan["sync_totals"] = sync_totals
+
+    # ---- recv buckets (param pull) — _plan_recv_buckets's exact layout
+    per_ep = {ep: [] for ep in endpoints}
+    params_spec = []
+    for p, shape, dtype, _g in params:
+        isz = _dtype_nbytes(dtype)
+        bnames = []
+        for blk in blocks[p]:
+            ep = block_eps[(p, blk.idx)]
+            per_ep[ep].append((blk.size * isz, blk.block_name))
+            bnames.append(blk.block_name)
+        params_spec.append([p, list(shape), dtype, bnames])
+    recv_buckets = []
+    for ep in endpoints:
+        got = pack_buckets(per_ep[ep], bucket_bytes)
+        for bucket in got or [[]]:
+            recv_buckets.append([ep, bucket])
+    fetch_totals = {}
+    for ep, _names in recv_buckets:
+        fetch_totals[ep] = fetch_totals.get(ep, 0) + 1
+    plan["params_spec"] = params_spec
+    plan["recv_buckets"] = recv_buckets
+    plan["fetch_totals"] = fetch_totals
+    return plan
+
+
 class DistributeTranspiler:
     def __init__(self, config=None):
         self.config = config or DistributeTranspilerConfig()
@@ -440,28 +552,67 @@ class DistributeTranspiler:
                 "before transpile()"
             )
 
-        # ---- partition ------------------------------------------------
-        numels = []
+        # ---- partition (via the declarative plan spec) -----------------
+        # The whole comm plan — block slicing, dispatch, buckets, grad
+        # scale — is a pure function of this JSON-able spec
+        # (derive_plan), so the runtime can re-derive it when membership
+        # changes (elastic autoscaling): the spec is carried in the
+        # program / stamped onto the rpc ops instead of the plan being
+        # baked-only into attrs.  For the unchanged world derive_plan's
+        # output here IS the stamped plan, bit for bit.
         self._param_vars = {}
-        for p, g in self.params_grads:
-            v = block._find_var_recursive(p)
-            self._param_vars[p] = v
-            numel = 1
-            for d in v.shape:
-                numel *= int(d)
-            numels.append((p, numel))
-        slice_count = len(eps) if self.config.slice_var_up else 1
-        self.param_blocks = slice_variable(
-            numels, slice_count, self.config.min_block_size
-        )
+        for p, _g in self.params_grads:
+            self._param_vars[p] = block._find_var_recursive(p)
+        split_name = (self.config.split_method.__name__
+                      if isinstance(self.config.split_method, type)
+                      else type(self.config.split_method).__name__)
+        self.plan_spec = {
+            "params": [
+                [p, [int(d) for d in self._param_vars[p].shape],
+                 str(self._param_vars[p].dtype), g]
+                for p, g in self.params_grads],
+            "endpoints": list(eps),
+            "trainers": int(self.trainer_num),
+            "flags": {
+                "slice_var_up": bool(self.config.slice_var_up),
+                "min_block_size": int(self.config.min_block_size),
+                "split_method": split_name,
+                "comm_bucket_bytes": int(self.comm_bucket_bytes),
+                "comm_wire_dtype": str(self.comm_wire_dtype),
+                "comm_grad_int8": bool(self.comm_grad_int8),
+            },
+        }
+        self.plan_gid = unique_name.generate("dist_plan")
+        plan = derive_plan(self.plan_spec,
+                           split_method=self.config.split_method)
+        self.param_blocks = plan["blocks"]
+        self.block_eps = plan["block_eps"]  # (param, idx) -> endpoint
+        self.origin_program._dist_plan_spec = self.plan_spec
+        # elasticity needs the spec to be self-contained: a CUSTOM
+        # dispatcher class is not resolvable by name at re-plan time
+        # (derive_plan looks it up in ps_dispatcher), so the plan stays
+        # static for this job rather than crashing the runtime re-plan
+        # mid-round; same for the legacy per-variable wire, which has
+        # no plan-carrying ops at all
+        from .ps_dispatcher import RoundRobin as _rr  # noqa: F401
+        from . import ps_dispatcher as _pd
 
-        # dispatch grad blocks -> endpoints; param blocks follow grads
-        dispatcher = self.config.split_method(eps)
-        self.block_eps = {}  # (param, idx) -> endpoint
-        for p, g in self.params_grads:
-            blocks = self.param_blocks[p]
-            for blk, ep in zip(blocks, dispatcher.dispatch(blocks)):
-                self.block_eps[(p, blk.idx)] = ep
+        self._plan_elastic = (
+            getattr(_pd, split_name, None) is self.config.split_method
+            and self.comm_bucket_bytes > 0)
+        if not self._plan_elastic:
+            import sys
+
+            sys.stderr.write(
+                "WARNING: this job's comm plan is NOT runtime-"
+                "re-derivable (%s) — membership changes will not "
+                "re-scale gradients (docs/FAULT_TOLERANCE.md "
+                "'Elastic autoscaling')\n" % (
+                    "custom split_method %r is not resolvable by name "
+                    "at re-plan time" % split_name
+                    if self.comm_bucket_bytes > 0 else
+                    "the legacy per-variable wire "
+                    "(comm_bucket_bytes=0) carries no plan spec"))
 
         # ---- split optimizer ops off the trainer ----------------------
         self.optimize_ops = [
@@ -498,14 +649,12 @@ class DistributeTranspiler:
                 )
                 scaled_names.append(scaled.name)
             if self.comm_bucket_bytes > 0:
-                self.send_bucket_plan = self._plan_send_buckets()
+                self.send_bucket_plan = plan["send_buckets"]
                 # sync mode folds the barriers into the bucket stream:
                 # the server treats a trainer's LAST send bucket as its
                 # send barrier and the last served get bucket as its
                 # fetch barrier, so no dedicated barrier round trips
-                sync_totals = {}
-                for ep, _entries in self.send_bucket_plan:
-                    sync_totals[ep] = sync_totals.get(ep, 0) + 1
+                sync_totals = plan["sync_totals"]
                 dummy = block.create_var(name="@SEND_BUCKET_TOKEN",
                                          shape=[1])
                 block.append_op(
@@ -521,6 +670,14 @@ class DistributeTranspiler:
                         # async mode: aseq-fenced buckets — journaled
                         # server-side, deduped across a restart
                         "async_fence": not self.sync_mode,
+                        # elastic autoscaling: the declarative spec this
+                        # plan derives from rides the op, so the runtime
+                        # can re-derive it for a new world size when a
+                        # pserver mints a new plan epoch (None when the
+                        # spec is not self-contained — custom dispatcher)
+                        "plan_spec": (self.plan_spec
+                                      if self._plan_elastic else None),
+                        "plan_gid": self.plan_gid,
                         "trainer_id": self.trainer_id,
                     },
                 )
@@ -551,20 +708,19 @@ class DistributeTranspiler:
                     attrs={"endpoints": eps, "trainer_id": self.trainer_id},
                 )
             if self.comm_bucket_bytes > 0:
-                params_spec, recv_buckets = self._plan_recv_buckets()
-                self.recv_bucket_plan = recv_buckets
-                fetch_totals = {}
-                for ep, _names in recv_buckets:
-                    fetch_totals[ep] = fetch_totals.get(ep, 0) + 1
+                self.recv_bucket_plan = plan["recv_buckets"]
                 block.append_op(
                     "recv_bucket",
                     outputs={"Out": [p for p, _g in self.params_grads]},
                     attrs={
-                        "params": params_spec,
-                        "buckets": recv_buckets,
-                        "fetch_totals": fetch_totals if self.sync_mode
-                        else {},
+                        "params": plan["params_spec"],
+                        "buckets": plan["recv_buckets"],
+                        "fetch_totals": plan["fetch_totals"]
+                        if self.sync_mode else {},
                         "wire_dtype": self.comm_wire_dtype,
+                        "plan_spec": (self.plan_spec
+                                      if self._plan_elastic else None),
+                        "plan_gid": self.plan_gid,
                         "trainer_id": self.trainer_id,
                     },
                 )
@@ -592,6 +748,21 @@ class DistributeTranspiler:
                     outputs={"Out": [tok.name]},
                     attrs={"endpoints": eps, "trainer_id": self.trainer_id},
                 )
+        # elastic stamps for the sparse rpc ops (created by the lookup
+        # rewrite before the plan spec existed): the runtime scale
+        # correction keys off the plan group, and async clock-only
+        # chunks coalesce per (trainer, endpoint, step) across ALL the
+        # program's send_sparse ops — clk_ops is the group size the
+        # runtime counts arrivals against
+        n_sparse = sum(1 for op in block.ops if op.type == "send_sparse")
+        for op in block.ops:
+            if op.type == "send_sparse":
+                op.attrs["plan_gid"] = self.plan_gid
+                op.attrs["plan_spec"] = (self.plan_spec
+                                         if self._plan_elastic else None)
+                if op.attrs.get("async_fence"):
+                    op.attrs["clk_gid"] = self.plan_gid
+                    op.attrs["clk_ops"] = n_sparse
         self.origin_program._bump_version()
 
     # ------------------------------------------------------------------
@@ -697,53 +868,12 @@ class DistributeTranspiler:
         self.origin_program._bump_version()
 
     # ------------------------------------------------------------------
-    def _plan_send_buckets(self):
-        """Coalesce grad blocks into size-capped per-endpoint buckets:
-        [[endpoint, [[x_idx, begin, end, grad_block_name], ...]], ...]
-        in deterministic (endpoint, param) order — every role replans the
-        identical layout from the same program."""
-        per_ep = {ep: [] for ep in self.pserver_endpoints}
-        for xi, (p, g) in enumerate(self.params_grads):
-            isz = _dtype_nbytes(self._param_vars[p].dtype)
-            for blk in self.param_blocks[p]:
-                ep = self.block_eps[(p, blk.idx)]
-                per_ep[ep].append(
-                    (blk.size * isz,
-                     [xi, blk.begin, blk.end,
-                      "%s.block%d" % (g, blk.idx)]))
-        plan = []
-        for ep in self.pserver_endpoints:
-            buckets = pack_buckets(per_ep[ep], self.comm_bucket_bytes)
-            # an endpoint that received no blocks still gets one EMPTY
-            # bucket: it carries the folded barrier, registers the
-            # endpoint for heartbeats/complete, and so a zero-block
-            # pserver participates in rounds and terminates at job end
-            # instead of waiting forever on contact that never comes
-            for bucket in buckets or [[]]:
-                plan.append([ep, bucket])
-        return plan
-
-    def _plan_recv_buckets(self):
-        """Param-side bucket plan: per-param reassembly spec plus
-        size-capped per-endpoint name buckets for coalesced gets."""
-        per_ep = {ep: [] for ep in self.pserver_endpoints}
-        params_spec = []
-        for p, _g in self.params_grads:
-            pv = self._param_vars[p]
-            isz = _dtype_nbytes(pv.dtype)
-            bnames = []
-            for blk in self.param_blocks[p]:
-                ep = self.block_eps[(p, blk.idx)]
-                per_ep[ep].append((blk.size * isz, blk.block_name))
-                bnames.append(blk.block_name)
-            params_spec.append(
-                [p, [int(d) for d in pv.shape], str(pv.dtype), bnames])
-        buckets = []
-        for ep in self.pserver_endpoints:
-            got = pack_buckets(per_ep[ep], self.comm_bucket_bytes)
-            for bucket in got or [[]]:  # empty bucket = folded fetch
-                buckets.append([ep, bucket])  # barrier for block-less eps
-        return params_spec, buckets
+    # (bucket planning lives in the module-level derive_plan: the same
+    # pure function serves transpile time and runtime re-plans — an
+    # endpoint that receives no blocks still gets one EMPTY bucket so it
+    # carries the folded barrier, registers for heartbeats/complete, and
+    # terminates at job end instead of waiting on contact that never
+    # comes)
 
     # ------------------------------------------------------------------
     def get_trainer_program(self):

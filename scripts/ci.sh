@@ -68,6 +68,18 @@ XLA_FLAGS="--xla_force_host_platform_device_count=2" \
     python -m pytest tests/test_dist_transpiler.py -q -m "" \
     -k "collective or hybrid"
 
+echo "== elastic autoscaling chaos pass (plan epochs + scaling policy) =="
+# the elastic story end to end under the SAME pinned fault seed:
+# stale-plan fencing + boundary-deferred epoch mints (in-process),
+# SIGKILL scale-down with re-plan (tier-1 E2E), and the slow-marked
+# policy-driven grow, kill-during-re-plan race and restart-budget
+# exhaustion legs that tier-1's time budget keeps out (-m "")
+python -m pytest tests/test_fault_tolerance.py -q -m "" \
+    -k "elastic or plan_epoch or plan_verb or sparse_clocks or \
+terminal_evict or scaling_policy or budget_exhaustion"
+python -m pytest tests/test_dist_transpiler.py -q -m "" \
+    -k "derive_plan or clock_only"
+
 echo "== serving pass (continuous-batching churn exactness) =="
 # the slot-pool engine's core contract on a short seeded CPU trace
 # (small GPT2Config, pool B=4): every request's tokens bit-identical

@@ -198,9 +198,16 @@ def main():
     if collective:
         nproc = max(1, len(_TRAINER_EPS))
         shard = batch // nproc
+        slot = trainer_id
     else:
         shard = batch // trainers
-    lo, hi = trainer_id * shard, (trainer_id + 1) * shard
+        # elastic ranks: a policy-grown trainer gets an id >= the
+        # transpile-time world (PADDLE_TRAINERS) — it reuses a data slot
+        # mod the original shard count (the plan epoch re-scales grads
+        # for the LIVE world, so the extra contribution is weighted
+        # correctly)
+        slot = trainer_id % trainers
+    lo, hi = slot * shard, (slot + 1) * shard
     step_sleep = float(os.environ.get("DIST_STEP_SLEEP", "0"))
     # chaos hook (tests/test_fault_tolerance.py): SIGKILL this rank after
     # step N — a real mid-training process death, no cleanup, no complete.

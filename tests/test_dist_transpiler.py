@@ -638,7 +638,7 @@ def test_collective_mode_bit_exact_vs_single_process_baseline():
 
 
 def _run_sparse_cluster(mode, nranks, steps=4, wire_dtype="float32",
-                        sync=True):
+                        sync=True, feed_ids=None):
     """Sparse dist MLP (the DIST_MODEL=sparse architecture) over 2
     in-process pserver threads: mode="pserver" is the classic sync path,
     mode="collective" is HYBRID — dense grads ride the mesh, embedding
@@ -668,6 +668,8 @@ def _run_sparse_cluster(mode, nranks, steps=4, wire_dtype="float32",
         fluid.optimizer.SGD(0.1).minimize(loss)
     rng = np.random.RandomState(5)
     idv = rng.randint(0, 20, (16, 1)).astype("int64")
+    if feed_ids is not None:  # caller pins the ids (rowless-shard legs)
+        idv = np.asarray(feed_ids, np.int64).reshape(-1, 1)
     yv = (idv.astype("float32") / 10.0) - 1.0
 
     config = fluid.DistributeTranspilerConfig()
@@ -890,3 +892,81 @@ def test_memory_optimize_plan():
     for var, cache in plan["reuse"].items():
         v = block._find_var_recursive(var)
         assert v is not None and not v.persistable
+
+
+# ---------------------------------------------------------------------------
+# elastic autoscaling: runtime re-derivable plans + clock-only coalescing
+# ---------------------------------------------------------------------------
+
+def test_derive_plan_bit_identical_and_matches_stamped_attrs():
+    """THE re-plan contract: derive_plan over the program-carried spec
+    is deterministic (two calls agree exactly) and, for the unchanged
+    world, reproduces the transpile-time plan bit for bit — bucket
+    layouts, folded-barrier totals, reassembly specs, block placement.
+    A changed world only changes the grad scale (endpoints are the
+    pserver set, which does not churn here)."""
+    from paddle_tpu.transpiler.distribute_transpiler import derive_plan
+
+    _build()
+    t = _transpile(comm_bucket_bytes=4 << 20)
+    spec = t.plan_spec
+    p1 = derive_plan(spec)
+    p2 = derive_plan(spec)
+    # deterministic: independent derivations agree exactly
+    assert p1["send_buckets"] == p2["send_buckets"]
+    assert p1["recv_buckets"] == p2["recv_buckets"]
+    assert p1["params_spec"] == p2["params_spec"]
+    assert p1["sync_totals"] == p2["sync_totals"]
+    assert p1["fetch_totals"] == p2["fetch_totals"]
+    assert p1["block_eps"] == p2["block_eps"]
+    assert p1["grad_scale"] == p2["grad_scale"] == 0.5  # trainers=2
+    # ... and reproduce what the transpiler stamped into the ops
+    ops = {op.type: op for op in
+           t.get_trainer_program().global_block().ops}
+    sb, rb = ops["send_bucket"], ops["recv_bucket"]
+    assert sb.attrs["buckets"] == p1["send_buckets"]
+    assert sb.attrs["sync_totals"] == p1["sync_totals"]
+    assert rb.attrs["buckets"] == p1["recv_buckets"]
+    assert rb.attrs["params"] == p1["params_spec"]
+    assert rb.attrs["fetch_totals"] == p1["fetch_totals"]
+    assert sb.attrs["plan_spec"] == spec == rb.attrs["plan_spec"]
+    assert sb.attrs["plan_gid"] == rb.attrs["plan_gid"]
+    assert t.get_trainer_program()._dist_plan_spec == spec
+    # block placement: the derived VarBlock layout IS the transpiler's
+    for p, blks in p1["blocks"].items():
+        tb = t.param_blocks[p]
+        assert [(b.idx, b.begin, b.end) for b in blks] == \
+            [(b.idx, b.begin, b.end) for b in tb]
+    # a re-plan for a CHANGED world: same layout (endpoints fixed),
+    # only the grad scale moves
+    p3 = derive_plan(spec, world={"trainers": 3})
+    assert p3["send_buckets"] == p1["send_buckets"]
+    assert p3["recv_buckets"] == p1["recv_buckets"]
+    assert p3["grad_scale"] == 1.0 / 3.0
+    # the spec is JSON-able (it is CARRIED in the program, not code)
+    import json as _json
+
+    assert _json.loads(_json.dumps(spec)) == spec
+
+
+def test_async_clock_only_chunks_coalesce_into_one_frame(no_heartbeats):
+    """Satellite acceptance (PR 8 known limit closed): with every id
+    EVEN, pserver 1's shard is rowless — its per-step clock used to
+    ride one empty send_sparse per table per step.  Now the rowless
+    clocks buffer and ship as ONE merged sparse_clocks frame per
+    endpoint per step: data sends halve, the merge counter sees every
+    frame, and training still converges (monotonic fence semantics
+    preserved)."""
+    steps = 4
+    rng = np.random.RandomState(5)
+    even = (rng.randint(0, 20, (16, 1)) // 2) * 2
+    losses, stats = _run_sparse_cluster("pserver", nranks=1, steps=steps,
+                                        sync=False, feed_ids=even)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+    # rows only ever reach server 0: one data chunk per step there,
+    # and ONE merged clock frame per step for rowless server 1
+    assert stats["async_sparse_sends"] == steps, stats
+    assert stats["async_clock_merges"] == steps, stats
+    assert stats["rpc_verbs"].get("send_sparse", 0) == steps, stats
+    assert stats["rpc_verbs"].get("sparse_clocks", 0) == steps, stats

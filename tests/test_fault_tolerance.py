@@ -317,7 +317,9 @@ def test_bucketed_sync_round_with_folded_barrier_and_eviction():
         t0 = time.monotonic()
         r = cli.call("send_bucket", blocks={"g1": np.full((2,), 5.0)},
                      trainer_id=0, seq_total=2)
-        assert r == {"ok": True}
+        # the eviction minted a plan epoch at the boundary, and the
+        # post-round reply carries it (elastic autoscaling)
+        assert r == {"ok": True, "pepoch": 1}
         assert time.monotonic() - t0 < 5.0, "folded barrier hung"
         assert ps._round == 1 and ps._live == {0} and 1 in ps._evicted
         merged = {}
@@ -1247,7 +1249,9 @@ def test_restored_server_remembers_departed_trainers(tmp_path):
     # the survivor's next round completes ALONE on the restored server
     r = ps2._h_send_bucket({"g0": np.ones(2)}, trainer_id=0, seq_total=1,
                            step=2, seq_idx=0)
-    assert r == {"ok": True} and ps2._round == 2
+    # the restored eviction re-marks the membership change: the reply
+    # carries the (snapshot-restored, re-minted) plan epoch
+    assert r["ok"] is True and "evicted" not in r and ps2._round == 2
     # and the ghost can still come back through register
     assert ps2._h_register(trainer_id=1)["ok"]
     assert ps2._live == {0, 1}
@@ -1351,8 +1355,10 @@ def test_register_readmits_evicted_trainer_and_barrier_totals_grow():
         th.start()
         th.join(timeout=10)
         th0.join(timeout=10)
-        assert done and done[0] == {"ok": True}
-        assert survivor and survivor[0] == {"ok": True}
+        # eviction + readmission each minted a plan epoch; the post-
+        # round replies carry the latest
+        assert done and done[0] == {"ok": True, "pepoch": 2}
+        assert survivor and survivor[0] == {"ok": True, "pepoch": 2}
         assert ps._round == 1
         cli1.close()
         assert len(applied) == 1
@@ -2253,3 +2259,525 @@ def test_pserver_kill_restart_resumes_from_manifest_checkpoint(tmp_path):
         for p in (ps1, ps2, trainer):
             if p is not None and p.poll() is None:
                 p.kill()
+
+
+# ---------------------------------------------------------------------------
+# elastic autoscaling: plan epochs, stale-plan fence, scaling policy, chaos
+# ---------------------------------------------------------------------------
+
+def test_plan_epoch_fence_drops_stale_world_frames():
+    """ACCEPTANCE (tentpole): a membership change mints a plan epoch at
+    the round boundary; a frame still carrying the OLD epoch is fenced
+    (dropped + told the current epoch) exactly like a stale
+    incarnation — it can neither fold into a current-epoch round nor
+    double-apply after the re-plan re-ships it."""
+    ps = ParameterServer([None], {"g0": 0}, num_trainers=2,
+                         sync_mode=True)
+    applied = []
+    ps._apply_shard = lambda idx, feed: applied.append(
+        np.asarray(feed["g0"]).copy())
+    # epoch 0: no fence — pepoch-less and pepoch=0 frames both flow
+    assert ps._plan_epoch == 0
+    with ps._cv:
+        ps._evict_locked(1, "test")  # boundary: epoch mints immediately
+    assert ps._plan_epoch == 1 and ps.counters["plan_epochs"] == 1
+    # the survivor's next frame still carries epoch 0: FENCED
+    r = ps._h_send_bucket({"g0": np.full(2, 3.0)}, trainer_id=0,
+                          seq_total=1, step=1, seq_idx=0, pepoch=0)
+    assert r.get("stale_plan") and r["pepoch"] == 1, r
+    assert ps._round == 0 and not applied and not ps._pending, \
+        "stale-world frame leaked into the round"
+    assert ps.counters["stale_plan_drops"] == 1
+    # sparse chunks are fenced the same way
+    ps.sparse_tables["t0"] = {"tbl": np.zeros((4, 2), np.float32),
+                              "lr": 0.1,
+                              "opt": {"type": "sgd", "attrs": {}}}
+    r = ps._h_send_sparse("t0", np.array([1]),
+                          np.ones((1, 2), np.float32), trainer_id=0,
+                          step=1, pepoch=0)
+    assert r.get("stale_plan") and not ps._pending_sparse, r
+    # the re-plan re-ships at the current epoch: applied exactly once
+    r = ps._h_send_sparse("t0", np.array([1]),
+                          np.ones((1, 2), np.float32), trainer_id=0,
+                          step=1, pepoch=1)
+    assert r == {"ok": True, "pepoch": 1}
+    r = ps._h_send_bucket({"g0": np.full(2, 3.0)}, trainer_id=0,
+                          seq_total=1, step=1, seq_idx=0, pepoch=1,
+                          sparse_tables=["t0"])
+    assert r == {"ok": True, "pepoch": 1} and ps._round == 1
+    assert len(applied) == 1
+    np.testing.assert_array_equal(applied[0], np.full(2, 3.0))
+    # a FUTURE epoch (server restored from an older snapshot than the
+    # sender's view — transiently possible) is never fenced
+    r = ps._h_send_bucket({"g0": np.full(2, 1.0)}, trainer_id=0,
+                          seq_total=1, step=2, seq_idx=0, pepoch=5)
+    assert r.get("ok") and not r.get("stale_plan")
+
+
+def test_plan_epoch_mint_deferred_to_round_boundary():
+    """An eviction landing MID-ROUND must not bump the epoch under the
+    survivors' in-flight frames (they would all be stale-fenced and the
+    round could never complete): the mint waits for the boundary the
+    round's completion creates."""
+    ps = ParameterServer([None], {"g0": 0}, num_trainers=3,
+                         sync_mode=True)
+    ps._apply_shard = lambda idx, feed: None
+    # trainer 0 contributes: the round is now being assembled
+    r = ps._h_send_bucket({"g0": np.full(2, 1.0)}, trainer_id=0,
+                          seq_total=2, step=1, seq_idx=0, pepoch=0)
+    assert r == {"ok": True}
+    with ps._cv:
+        ps._evict_locked(2, "test")  # mid-round: mint must defer
+    assert ps._plan_epoch == 0 and ps._plan_dirty, \
+        "epoch minted mid-round — survivors' frames would stale-fence"
+    # survivor 0 finishes its stream; survivor 1 folds; round runs;
+    # the epoch mints AT the boundary
+    done = []
+    th = threading.Thread(target=lambda: done.append(
+        ps._h_send_bucket({"g0": np.full(2, 1.0)}, trainer_id=0,
+                          seq_total=2, step=1, seq_idx=1, pepoch=0)),
+        daemon=True)
+    th.start()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and 0 not in ps._send_barriers:
+        time.sleep(0.01)
+    r1 = ps._h_send_bucket({"g0": np.full(2, 5.0)}, trainer_id=1,
+                           seq_total=1, step=1, seq_idx=0, pepoch=0)
+    th.join(timeout=10)
+    assert ps._round == 1
+    assert ps._plan_epoch == 1 and not ps._plan_dirty
+    # the post-round (blocking) replies told both survivors
+    assert r1 == {"ok": True, "pepoch": 1}
+    assert done and done[0] == {"ok": True, "pepoch": 1}
+
+
+def test_plan_verb_reports_world_and_register_seeds_epoch():
+    """The re-plan handshake: `plan` returns the current epoch + live
+    world; a (re)joining trainer's register reply carries both so its
+    first step plans for the world it actually joined."""
+    ps = ParameterServer([None], {"g0": 0}, num_trainers=2,
+                         sync_mode=True)
+    ps._apply_shard = lambda idx, feed: None
+    r = ps._h_plan(trainer_id=0)
+    assert r == {"epoch": 0, "world": 2, "live": [0, 1], "trainers": 2}
+    with ps._cv:
+        ps._evict_locked(1, "test")
+    r = ps._h_plan(trainer_id=0)
+    assert r["epoch"] == 1 and r["world"] == 1 and r["live"] == [0]
+    # a NEW rank (elastic grow) registers: admitted, epoch re-mints,
+    # and the reply carries the grown world
+    r = ps._h_register(trainer_id=2)
+    assert r["ok"] and r["world"] == 2 and r["pepoch"] == 2
+    assert ps._live == {0, 2}
+    assert ps.counters["plan_epochs"] == 2
+
+
+def test_sparse_clocks_verb_advances_fences_and_clock():
+    """The merged clock-only frame: one RPC advances every named
+    table's fence monotonically and the trainer's logical clock to the
+    newest seq — identical semantics to the n empty chunks it
+    replaces."""
+    ps = ParameterServer([], {}, num_trainers=2, sync_mode=False,
+                         sparse_tables={
+                             "t0": {"tbl": np.zeros((4, 2), np.float32)},
+                             "t1": {"tbl": np.zeros((4, 2), np.float32)}})
+    r = ps._h_sparse_clocks({"t0": 3, "t1": 5}, trainer_id=0)
+    assert r == {"ok": True, "acked": 5}
+    assert ps._sparse_fence == {(0, "t0"): 3, (0, "t1"): 5}
+    assert ps._trainer_clock == {0: 5}
+    # monotonic: a late/replayed lower clock cannot move fences back
+    r = ps._h_sparse_clocks({"t0": 2, "t1": 4}, trainer_id=0)
+    assert r == {"ok": True, "acked": 4}
+    assert ps._sparse_fence == {(0, "t0"): 3, (0, "t1"): 5}
+    assert ps._trainer_clock == {0: 5}
+    # an evicted trainer's clocks are refused like its chunks
+    with ps._cv:
+        ps._evicted.add(1)
+    assert ps._h_sparse_clocks({"t0": 9}, trainer_id=1) == {
+        "ok": False, "evicted": True}
+
+
+def test_terminal_evict_unparks_respawn_promise():
+    """Restart-budget exhaustion: the supervisor's earlier respawn=True
+    evict parked the id (job held open for the replacement); the
+    terminal respawn=False evict retracts that promise — the id
+    unparks, and an emptied world concludes the job NOW instead of at
+    the eviction deadline."""
+    ps = ParameterServer([None], {"g0": 0}, num_trainers=1,
+                         sync_mode=True)
+    ps._apply_shard = lambda idx, feed: None
+    # supervised death: evict + park + immediate readmit (sole trainer)
+    r = ps._h_evict(trainer_id=0, respawn=True)
+    assert r["ok"] and ps._live == {0}, \
+        "respawn-optimistic evict should readmit at the boundary"
+    assert not ps._done.is_set()
+    # budget exhausted: the promise is retracted — terminal
+    r = ps._h_evict(trainer_id=0, respawn=False)
+    assert r["ok"] and not ps._live and not ps._pending_joins
+    assert ps._done.is_set(), \
+        "terminal evict of the last id must conclude the job"
+
+
+def test_scaling_policy_grow_shrink_and_damping():
+    """_ScalingPolicy unit: hysteresis gates growth, stragglers shrink
+    after persistent lag, cooldown and the _RestartPolicy action budget
+    both damp flapping."""
+    from paddle_tpu.distributed.launch import (
+        _RestartPolicy,
+        _ScalingPolicy,
+    )
+
+    pol = _ScalingPolicy(1, 3, cooldown_s=0.0, hysteresis=2,
+                         budget=_RestartPolicy(max_restarts=2,
+                                               window_s=60.0,
+                                               backoff_s=0.0))
+    pol._last_action = time.monotonic() - 10  # cooldown already served
+    live = {"trainer.0", "trainer.1"}
+    healthy = {"trainer.0": 3.0, "trainer.1": 3.0}
+    assert pol.decide(live, healthy) is None  # hysteresis: streak 1
+    assert pol.decide(live, healthy) == ("grow", None)
+    # a trainer with UNKNOWN pace (just booted) blocks further growth
+    live3 = live | {"trainer.2"}
+    rates3 = dict(healthy, **{"trainer.2": None})
+    assert pol.decide(live3, rates3) is None
+    assert pol.decide(live3, rates3) is None
+    # persistent straggler: flagged after `hysteresis` observations
+    lagging = dict(healthy, **{"trainer.2": 0.5})
+    assert pol.decide(live3, lagging) is None
+    assert pol.decide(live3, lagging) == ("shrink", "trainer.2")
+    # action budget (2 per window) exhausted: the next action is damped
+    assert pol.decide(live3, lagging) is None
+    assert pol.decide(live3, lagging) is None
+    # cooldown damping: a fresh policy with a long cooldown sits still
+    cold = _ScalingPolicy(1, 3, cooldown_s=3600.0, hysteresis=1)
+    assert cold.decide(live, healthy) is None
+    # shrink never drops below min (at the floor the policy may still
+    # GROW toward max — it just cannot retire the straggler)
+    floor = _ScalingPolicy(2, 3, cooldown_s=0.0, hysteresis=1)
+    floor._last_action = time.monotonic() - 10
+    d = floor.decide(live, {"trainer.0": 3.0, "trainer.1": 0.1})
+    assert d is None or d[0] == "grow", d
+
+
+def test_elastic_scale_down_sigkill_rescales_and_completes(capfd):
+    """ACCEPTANCE (tentpole chaos E2E, scale-down): trainer 1 of 2 is
+    SIGKILLed mid-job; the pservers evict it, mint a plan epoch at the
+    next boundary (steps/s tracks the live count within ONE round of
+    the change — the phase log pins it), the survivor re-derives its
+    plan (grad scale 1/2 -> 1/1) and finishes every step with finite,
+    convergent losses."""
+    from paddle_tpu.distributed.launch import launch_pserver
+
+    env = dict(os.environ)
+    steps = 6
+    env.update({
+        "DIST_STEPS": str(steps),
+        "DIST_STEP_SLEEP": "0.25",
+        "DIST_CRASH_RANK": "1",
+        "DIST_CRASH_AFTER_STEP": "1",
+        "FLAGS_heartbeat_interval": "0.2",
+        "FLAGS_eviction_deadline": "1.5",
+        "FLAGS_max_retry": "120",
+        "JAX_PLATFORMS": "cpu",
+    })
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    # the far-future chaos kill never fires: it marks trainer.1's
+    # self-SIGKILL as the expected failure
+    rc = launch_pserver([_RUNNER], nproc=2, n_pservers=2, base_env=env,
+                        sync=True, chaos_kills=[("trainer.1", 9999.0)])
+    out = capfd.readouterr().out
+    assert rc == 0, out
+    assert "PSERVER EVICT trainer=1" in out, out
+    assert "PSERVER PLAN-EPOCH epoch=1 world=1" in out, out
+    assert "TRAINER REPLAN epoch=1 world=1 corr=2" in out, out
+    losses = _trainer_losses(out, "trainer.0")
+    assert len(losses) == steps and np.isfinite(losses).all(), losses
+    assert losses[-1] < losses[0], losses
+    for ln in out.splitlines():
+        if ln.startswith("[trainer.0] COUNTERS "):
+            c = json.loads(ln[len("[trainer.0] COUNTERS "):])
+            assert c["replans"] >= 1 and c["replan_ms"] > 0, c
+            break
+    else:
+        raise AssertionError("no COUNTERS line:\n%s" % out)
+    # phase log: membership phases moved 2 -> 1 within one round of the
+    # kill (the epoch-1 phase starts at most one round after the
+    # epoch-0 phase's last assembled round)
+    for ln in out.splitlines():
+        if ln.startswith("[pserver.0] PSERVER-STATS "):
+            s = json.loads(ln[len("[pserver.0] PSERVER-STATS "):])
+            worlds = [p["world"] for p in s["phases"]]
+            assert worlds == [2, 1], s["phases"]
+            assert s["plan_epoch"] == 1 and s["plan_epochs"] == 1, s
+            # steps/s tracked the membership: the shrunk phase ran the
+            # remaining rounds (steps - the 2-trainer phase's rounds)
+            assert s["phases"][1]["rounds"] == steps - \
+                s["phases"][0]["rounds"], s["phases"]
+            break
+    else:
+        raise AssertionError("no PSERVER-STATS line:\n%s" % out)
+
+
+@pytest.mark.slow  # two JAX boots + a policy window; rides scripts/ci.sh
+def test_elastic_policy_grow_adds_trainer_and_rescales(capfd):
+    """ACCEPTANCE (tentpole chaos E2E, policy-driven scale-up): a 1:2
+    elastic job starts with ONE trainer; the supervisor's policy loop
+    observes steady step progress, grows trainer.1, the pserver admits
+    it at a round boundary and mints a plan epoch, and BOTH trainers
+    re-derive (corr 1 -> 0.5) and finish with finite losses."""
+    from paddle_tpu.distributed.launch import launch_pserver
+
+    env = dict(os.environ)
+    steps = 14
+    env.update({
+        "DIST_STEPS": str(steps),
+        "DIST_STEP_SLEEP": "0.3",
+        "FLAGS_max_retry": "120",
+        "JAX_PLATFORMS": "cpu",
+    })
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    rc = launch_pserver([_RUNNER], nproc=1, n_pservers=1, base_env=env,
+                        sync=True, supervise=True, restart_backoff=0.2,
+                        elastic="1:2", elastic_cooldown=1.0)
+    cap = capfd.readouterr()
+    out = cap.out
+    assert rc == 0, out
+    assert "ELASTIC GROW trainer.1" in cap.err, cap.err
+    assert "TRAINER REPLAN epoch=1 world=2" in out, out
+    assert "PSERVER PLAN-EPOCH epoch=1 world=2" in out, out
+    assert "TRAINER REPLAN epoch=1 world=2 corr=0.5" in out, out
+    l0 = _trainer_losses(out, "trainer.0")
+    assert len(l0) == steps and np.isfinite(l0).all(), l0
+    # the grown trainer either finished its run or was retired cleanly
+    # at winddown; if it finished, its losses are finite too
+    for ln in out.splitlines():
+        if ln.startswith("[trainer.1] LOSSES "):
+            l1 = json.loads(ln[len("[trainer.1] LOSSES "):])
+            assert np.isfinite(l1).all(), l1
+            break
+
+
+@pytest.mark.slow  # three JAX boots; rides scripts/ci.sh elastic pass
+def test_elastic_kill_during_replan_cannot_hang_round(capfd):
+    """ACCEPTANCE (tentpole chaos E2E, the re-plan race): trainer 2
+    dies at step 1 (epoch mints, survivors re-plan); trainer 1 dies at
+    step 3 — right in the window where the epoch-1 re-plan is
+    propagating.  The sole survivor must keep completing rounds (no
+    hang) and finish every step with finite losses; the plan-epoch
+    fence guarantees no bucket double-applied across the two
+    re-plans."""
+    from paddle_tpu.distributed.launch import _Cluster
+
+    port = _free_port()
+    eps = "127.0.0.1:%d" % port
+    steps = 8
+    common = dict(os.environ)
+    common.update({
+        "PADDLE_PSERVER_EPS": eps,
+        "PADDLE_TRAINERS": "3",
+        "DIST_SYNC_MODE": "1",
+        "DIST_STEPS": str(steps),
+        "DIST_STEP_SLEEP": "0.25",
+        "FLAGS_heartbeat_interval": "0.2",
+        "FLAGS_eviction_deadline": "1.5",
+        "FLAGS_max_retry": "120",
+        "JAX_PLATFORMS": "cpu",
+    })
+    common.pop("PALLAS_AXON_POOL_IPS", None)
+    cmd = [sys.executable, "-u", _RUNNER]
+    cluster = _Cluster()
+
+    def notify(tag, rc):
+        if not tag.startswith("trainer."):
+            return
+        tid = int(tag.split(".", 1)[1])
+        cli = RPCClient(eps, timeout=2, retries=2, retry_wait=0.1)
+        try:
+            cli.call("evict", trainer_id=tid, deadline_s=5.0,
+                     respawn=False)
+        except Exception:
+            pass
+        finally:
+            cli.close()
+
+    cluster.on_child_death = notify
+    cluster.spawn("pserver.0", cmd,
+                  dict(common, PADDLE_TRAINING_ROLE="PSERVER",
+                       PADDLE_CURRENT_ENDPOINT=eps))
+    try:
+        _wait_port(port)
+        cluster.spawn("trainer.0", cmd,
+                      dict(common, PADDLE_TRAINING_ROLE="TRAINER",
+                           PADDLE_TRAINER_ID="0"))
+        for rank, crash_after in ((1, 3), (2, 1)):
+            cluster.expect_failure("trainer.%d" % rank)
+            cluster.spawn(
+                "trainer.%d" % rank, cmd,
+                dict(common, PADDLE_TRAINING_ROLE="TRAINER",
+                     PADDLE_TRAINER_ID=str(rank),
+                     DIST_CRASH_RANK=str(rank),
+                     DIST_CRASH_AFTER_STEP=str(crash_after)))
+        rc = cluster.wait()
+    finally:
+        cluster.kill()
+    out = capfd.readouterr().out
+    assert rc == 0, out
+    assert "PSERVER EVICT trainer=2" in out, out
+    assert "PSERVER EVICT trainer=1" in out, out
+    # two durable shrinks -> two plan epochs, worlds 3 -> 2 -> 1
+    assert "PSERVER PLAN-EPOCH epoch=1 world=2" in out, out
+    assert "PSERVER PLAN-EPOCH epoch=2 world=1" in out, out
+    assert "TRAINER REPLAN epoch=2 world=1 corr=3" in out, out
+    losses = _trainer_losses(out, "trainer.0")
+    assert len(losses) == steps and np.isfinite(losses).all(), losses
+
+
+@pytest.mark.slow  # two supervised respawn cycles; rides scripts/ci.sh
+def test_restart_budget_exhaustion_fails_clean_with_terminal_evict(capfd):
+    """Satellite chaos: a trainer that crashes EVERY incarnation
+    exhausts --max-restarts; the cluster fails the job cleanly —
+    nonzero exit well before any eviction deadline could be waited out,
+    the budget-exhaustion notice printed, and the survivors' pservers
+    told the id is terminal (respawn=False evict — the in-process
+    semantics are pinned by test_terminal_evict_unparks_respawn_
+    promise)."""
+    from paddle_tpu.distributed.launch import launch_pserver
+
+    env = dict(os.environ)
+    env.update({
+        "DIST_STEPS": "30",
+        "DIST_STEP_SLEEP": "0.25",
+        "DIST_CRASH_RANK": "1",
+        "DIST_CRASH_AFTER_STEP": "0",  # crashes at step 0, EVERY life
+        # a deadline far beyond the test budget: only the terminal
+        # evict path can conclude the cluster this fast
+        "FLAGS_eviction_deadline": "120",
+        "FLAGS_heartbeat_interval": "2.0",
+        "FLAGS_max_retry": "120",
+        "JAX_PLATFORMS": "cpu",
+    })
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    t0 = time.monotonic()
+    rc = launch_pserver([_RUNNER], nproc=2, n_pservers=1, base_env=env,
+                        sync=True, supervise=True, max_restarts=1,
+                        restart_window=60.0, restart_backoff=0.2)
+    wall = time.monotonic() - t0
+    out = capfd.readouterr()
+    assert rc != 0, out.out
+    assert "restart budget exhausted" in out.err, out.err
+    assert wall < 110, (
+        "cluster waited out the eviction deadline instead of failing "
+        "on the terminal evict (%.0fs)" % wall)
+
+
+def test_restored_server_remembers_admitted_elastic_rank(tmp_path):
+    """Found by the combined elastic+pserver-kill drive: a restored
+    server used to rebuild its live set from range(num_trainers) minus
+    departed — an elastic-grown rank (>= the transpile-time count) was
+    forgotten, so the job was declared done under it the moment the
+    original ranks completed.  The live set now rides the snapshot."""
+    ps = ParameterServer([None], {"g0": 0}, num_trainers=2,
+                         sync_mode=True, checkpoint_dir=str(tmp_path),
+                         server_idx=0, checkpoint_every=1)
+    ps._apply_shard = lambda idx, feed: None
+    assert ps._h_register(trainer_id=2)["ok"]  # elastic grow: rank 2
+    assert ps._live == {0, 1, 2}
+    # a round lands a snapshot containing the grown world
+    for tid in (0, 1, 2):
+        threading.Thread(
+            target=ps._h_send_bucket,
+            kwargs=dict(blocks={"g0": np.ones(2)}, trainer_id=tid,
+                        seq_total=1, step=1, seq_idx=0),
+            daemon=True).start()
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline and ps._round < 1:
+        time.sleep(0.02)
+    assert ps._round == 1
+    mpath = tmp_path / "pserver_0.manifest.json"
+    while time.monotonic() < deadline and not (
+            mpath.exists()
+            and json.loads(mpath.read_text())["round"] == 1):
+        time.sleep(0.05)
+    ps2 = ParameterServer([None], {"g0": 0}, num_trainers=2,
+                          sync_mode=True, checkpoint_dir=str(tmp_path),
+                          server_idx=0)
+    ps2._apply_shard = lambda idx, feed: None
+    assert ps2.load_checkpoint() == 1
+    assert ps2._live == {0, 1, 2}, \
+        "restored server forgot the admitted elastic rank"
+    # the original ranks completing must NOT conclude the job under the
+    # grown rank
+    ps2._h_complete(trainer_id=0)
+    ps2._h_complete(trainer_id=1)
+    assert not ps2._done.is_set() and ps2._live == {2}
+    ps2._h_complete(trainer_id=2)
+    assert ps2._done.is_set()
+
+
+def test_clock_flush_runs_incarnation_replay_before_fence_advance(
+        tmp_path):
+    """Review finding, pinned: the merged sparse_clocks frame must run
+    the incarnation-replay check BEFORE shipping — the frame advances
+    the per-table seq fence, and letting it move past an un-acked data
+    chunk on a restarted server would make the eventual re-send drop
+    as `dup`: a silently lost update."""
+    from paddle_tpu.distributed import rpc as rpc_mod
+    from paddle_tpu.ops import dist_ops
+
+    rpc_mod.reset_comm_stats()
+    dist_ops.reset_fences()
+    ps = _async_sparse_ps(str(tmp_path))
+    srv = VarServer("127.0.0.1:0", ps).start()
+    ep = srv.endpoint
+    try:
+        cli = RPCClient(ep, timeout=10, retries=5, retry_wait=0.05)
+        st = dist_ops._async_st(ep)
+        cli.call("heartbeat", trainer_id=0)
+        dist_ops._async_check_replay(cli, ep, 0)  # baselines ainc
+        # seq 1 applied + acked normally
+        ids, rows = _chunk(0)
+        st["sseq"]["t0"] = 1
+        kw = dict(table="t0", ids=ids, rows=rows, trainer_id=0, seq=1)
+        st["unacked"].setdefault("t0", {})[1] = kw
+        dist_ops._async_note_ack(st, "t0", cli.call("send_sparse", **kw))
+        # seq 2 is minted and queued but NEVER reaches the server (the
+        # crash ate both the apply and the ack)
+        ids2, rows2 = _chunk(1)
+        st["sseq"]["t0"] = 2
+        st["unacked"]["t0"][2] = dict(table="t0", ids=ids2, rows=rows2,
+                                      trainer_id=0, seq=2)
+        srv.shutdown()
+        cli.close()
+        ps2 = _async_sparse_ps(str(tmp_path))
+        assert ps2.load_checkpoint() is not None
+        ps2.incarnation = ps.incarnation + 1
+        srv2 = VarServer(ep, ps2).start()
+        try:
+            cli.call("heartbeat", trainer_id=0)  # witnesses the bump
+            # next step is rowless for t0: the clock-only path buffers
+            # seq 3 and flushes ONE merged frame — which must re-ship
+            # the lost seq-2 chunk FIRST
+            st["sseq"]["t0"] = 3
+            clk = {"n": 1, "seen": 0, "pending": {ep: {"t0": 3}}}
+            dist_ops._clk_flush(clk, lambda e, t: RPCClient.get(e), 0)
+            assert st["unacked"]["t0"] == {}, \
+                "un-acked chunk not re-shipped before the clock frame"
+            assert ps2._sparse_fence[(0, "t0")] == 3
+            # the seq-2 update LANDED (not dropped as dup past a fence)
+            want = np.array(ps.sparse_tables["t0"]["tbl"])
+            ids2u = np.asarray(ids2).reshape(-1)
+            assert not np.allclose(
+                ps2.sparse_tables["t0"]["tbl"][ids2u], want[ids2u]), \
+                "re-shipped chunk was dropped — update silently lost"
+            stats = rpc_mod.get_comm_stats()
+            assert stats["async_resends"] == 1
+            assert stats["async_clock_merges"] == 1
+        finally:
+            srv2.shutdown()
+        cli.close()
+    finally:
+        srv.shutdown()
+        rpc_mod.reset_comm_stats()
+        dist_ops.reset_fences()
+        with RPCClient._lock:
+            RPCClient._instances.pop(ep, None)

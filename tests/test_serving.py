@@ -292,3 +292,78 @@ def test_engine_weight_only_int8_churn_exactness():
     _, eng = _make_engine(quantize_int8=True)
     reqs = _churn_trace(TinyHP.vocab_size, greedy_only=True, seed=13)
     _assert_churn_exact(eng, reqs)
+
+
+# ---------------------------------------------------------------------------
+# admission control: bounded wait queue + per-request deadlines
+# ---------------------------------------------------------------------------
+def test_admission_queue_depth_rejects_overflow_loudly():
+    """An arrival that finds `queue_depth` requests already waiting is
+    rejected with a terminal REJECTED_QUEUE_FULL — the wait queue can
+    never grow past the bound — while every ADMITTED request's tokens
+    stay bit-identical to its solo run (the exactness contract is
+    untouched by rejections)."""
+    _, eng = _make_engine(n_slots=2)
+    eng.queue_depth = 1
+    # 5 simultaneous arrivals into 2 slots + depth-1 queue: 3 serve,
+    # 2 reject
+    reqs = [Request(i, np.array([1 + i, 2, 3]), 4, arrival=0.0)
+            for i in range(5)]
+    results, stats = eng.run(list(reqs))
+    statuses = {r.rid: results[r.rid]["status"] for r in reqs}
+    assert sorted(statuses.values()) == [
+        "OK", "OK", "OK", "REJECTED_QUEUE_FULL", "REJECTED_QUEUE_FULL"], \
+        statuses
+    # arrival order wins: the first three (two slots + one queue place)
+    assert [statuses[i] for i in range(3)] == ["OK"] * 3
+    assert results[3]["tokens"].size == 0
+    assert stats["rejected"] == 2 and stats["finished"] == 3
+    # admitted requests still match their solo runs exactly
+    for i in range(3):
+        solo, _ = eng.run_solo(reqs[i])
+        np.testing.assert_array_equal(results[i]["tokens"], solo)
+
+
+def test_deadline_expires_queued_request():
+    """A request whose deadline lapses while WAITING is evicted with a
+    terminal status (zero tokens) instead of serving stale work; the
+    slot-holders are untouched."""
+    _, eng = _make_engine(n_slots=1)
+    long_req = Request(0, np.array([1, 2]), 8, arrival=0.0)
+    # arrives at 0 behind a busy slot, must finish within 2 steps —
+    # impossible while queued
+    waiter = Request(1, np.array([3, 4]), 2, arrival=0.0, deadline=2)
+    results, stats = eng.run([long_req, waiter])
+    assert results[0]["status"] == "OK"
+    assert results[1]["status"] == "DEADLINE_EXPIRED"
+    assert results[1]["tokens"].size == 0
+    assert stats["expired"] == 1
+    # the survivor is exact
+    solo, _ = eng.run_solo(long_req)
+    np.testing.assert_array_equal(results[0]["tokens"], solo)
+
+
+def test_deadline_expires_mid_decode_and_frees_the_slot():
+    """A request whose deadline lapses MID-DECODE is evicted with its
+    partial tokens and a terminal status, and the freed slot admits the
+    next waiter the same step — deadlines are how a stuck pool sheds
+    load."""
+    _, eng = _make_engine(n_slots=1)
+    # needs prompt prefill + 8 decode steps but only has budget for ~4
+    doomed = Request(0, np.array([1, 2, 3]), 8, arrival=0.0, deadline=4)
+    follow = Request(1, np.array([4, 5]), 3, arrival=1.0)
+    results, stats = eng.run([doomed, follow])
+    assert results[0]["status"] == "DEADLINE_EXPIRED"
+    assert 0 < results[0]["tokens"].size < 8, results[0]["tokens"]
+    assert results[0]["finish_step"] <= doomed.arrival_step + 4 + 1
+    assert results[1]["status"] == "OK"
+    assert stats["expired"] == 1 and stats["finished"] == 1
+    # the partial stream is a PREFIX of the solo stream (row-
+    # independent math: the eviction changed nothing it emitted)
+    solo, _ = eng.run_solo(Request(0, np.array([1, 2, 3]), 8,
+                                   arrival=0.0))
+    np.testing.assert_array_equal(
+        results[0]["tokens"], solo[:results[0]["tokens"].size])
+    # ... and the follower matches ITS solo run exactly
+    solo1, _ = eng.run_solo(follow)
+    np.testing.assert_array_equal(results[1]["tokens"], solo1)
