@@ -165,6 +165,11 @@ def _bench_impl():
 
     platforms = {d.platform for d in jax.devices()}
     on_tpu = bool(platforms & {"tpu", "axon"})
+    # BENCH_PALLAS=0 disables the hand-kernel layer (default ON on the
+    # chip: the matmul-epilogue/xent/flash kernels ARE the MFU story);
+    # BENCH_TUNE_CACHE points FLAGS_kernel_tune_cache at a persisted
+    # block-size cache so repeat captures skip the block search
+    _pallas_bench_env(on_tpu)
     batch_size = int(os.environ.get("BENCH_BATCH", 128 if on_tpu else 8))
     image_hw = int(os.environ.get("BENCH_IMAGE", 224 if on_tpu else 64))
     steps = max(1, int(os.environ.get("BENCH_STEPS", 20 if on_tpu else 3)))
@@ -276,6 +281,7 @@ def _bench_impl():
     }
     if mfu is not None:
         result["mfu"] = round(mfu, 4)
+    result["kernel_attribution"] = _kernel_attribution()
 
     # BENCH_INNER=K: also time K steps inside ONE compiled lax.scan
     # (Executor.run_loop) — separates device throughput from per-step
@@ -345,6 +351,43 @@ def _bench_impl():
                 sys.stderr.write("%s bench failed: %r\n" % (name, e))
                 result["models"][name] = {"error": repr(e)[:200]}
     print(json.dumps(result))
+
+
+def _pallas_bench_env(on_tpu):
+    """Arm the Pallas kernel layer + tuning cache for this bench run.
+    Returns whether the kernels are on.  Resets the trace-time
+    attribution counters so each leg's snapshot is its own."""
+    use_pallas = os.environ.get("BENCH_PALLAS",
+                                "1" if on_tpu else "0") == "1"
+    from paddle_tpu import flags as _flags
+    from paddle_tpu.ops import kernel_tuning
+
+    if use_pallas:
+        updates = {"use_pallas": True}
+        cache = os.environ.get("BENCH_TUNE_CACHE", "")
+        if cache:
+            updates["kernel_tune_cache"] = cache
+        _flags.set_flags(updates)
+    else:
+        # force OFF: flags.py loads FLAGS_use_pallas from the process
+        # env at import, so the no-kernel baseline of an A/B must not
+        # inherit a stray FLAGS_use_pallas=1
+        _flags.set_flags({"use_pallas": False})
+    kernel_tuning.reset_attribution()
+    return use_pallas
+
+
+def _kernel_attribution():
+    """Per-phase kernel attribution for the result JSON: pallas-hit
+    counters per kernel family (attention / matmul-epilogue / xent /
+    layernorm / recurrent) plus tuning-cache hit/miss/search-ms — the
+    evidence that makes an MFU regression diagnosable ('attention
+    stopped dispatching to flash' vs 'the tuning cache went cold').
+    Counters tick at trace time, so they attribute the compiled step's
+    contents, not per-run dispatch counts."""
+    from paddle_tpu.ops import kernel_tuning
+
+    return kernel_tuning.attribution()
 
 
 def _time_program(exe, prog, feed, fetches, warmup, steps):
@@ -1025,12 +1068,15 @@ def _transformer_bench(on_tpu, device):
     seq = int(os.environ.get("BENCH_TFM_SEQ", 256 if on_tpu else 16))
     steps = max(1, int(os.environ.get("BENCH_TFM_STEPS", 10 if on_tpu else 2)))
     warmup = 2 if on_tpu else 1
-    # bf16 matmuls (MXU) + fused attention by default on the chip; the
-    # fused op runs the flash pallas kernel only under FLAGS_use_pallas
-    # (kept off over the tunnel — remote Mosaic compiles blow the budget),
-    # so here it is the fused-XLA attention path.
+    # bf16 matmuls (MXU) + fused attention by default on the chip; under
+    # FLAGS_use_pallas (BENCH_PALLAS, default ON on the chip) the fused
+    # ops run the pallas kernel layer: flash attention, matmul-epilogue
+    # fc/residual-LN fusions, and the logits-free fused cross-entropy.
     use_bf16 = os.environ.get("BENCH_TFM_BF16", "1" if on_tpu else "0") == "1"
     use_fused = os.environ.get("BENCH_TFM_FUSED", "1") == "1"
+    from paddle_tpu.ops import kernel_tuning as _kt
+
+    _kt.reset_attribution()  # this leg's attribution snapshot is its own
 
     class HP(tfm.ModelHyperParams):
         max_length = max(seq, tfm.ModelHyperParams.max_length)
@@ -1078,6 +1124,12 @@ def _transformer_bench(on_tpu, device):
         "value": round(tokens, 1),
         "unit": "tokens/sec",
         "model_tflops_per_step": round(step_flops / 1e12, 3),
+        "fused_counts": {
+            "fc": getattr(main, "_fc_fused_count", 0),
+            "residual_ln": getattr(main, "_residual_ln_fused_count", 0),
+            "linear_xent": getattr(main, "_linear_xent_fused_count", 0),
+        },
+        "kernel_attribution": _kernel_attribution(),
     }
     if mfu is not None:
         out["mfu"] = round(mfu, 4)

@@ -16,12 +16,23 @@ needed (bf16 has float32's exponent range).
 
 from .. import framework
 
-_BF16_OPS = ("mul", "matmul", "conv2d", "depthwise_conv2d", "fused_attention")
+_BF16_OPS = ("mul", "matmul", "conv2d", "depthwise_conv2d",
+             "fused_attention",
+             # the matmul-epilogue fused ops (fuse_passes): their pallas
+             # kernels/dense paths consume the input dtype and accumulate
+             # f32, so bf16 inputs run the MXU at full rate
+             "fc", "fused_swiglu",
+             # logits-free fused loss: bf16 X/W tiles, f32 online
+             # logsumexp internals — the projection is the single
+             # biggest matmul in the LM programs
+             "fused_linear_xent")
 
 # input slots that must stay float32 even when the op is rewritten
 # (additive -1e9 padding masks lose nothing in bf16, but keeping them f32
-# costs nothing and avoids surprises with user-supplied biases)
-_KEEP_F32_SLOTS = {"fused_attention": ("Bias",)}
+# costs nothing and avoids surprises with user-supplied biases); int
+# label slots must never see a float cast at all
+_KEEP_F32_SLOTS = {"fused_attention": ("Bias",),
+                   "fused_linear_xent": ("Label",)}
 
 # dtype-transparent trunk ops: (data input slots, flippable output slots).
 # When every data input of one of these is available in half precision,
@@ -46,6 +57,10 @@ _TRANSPARENT_OPS = {
     "transpose": (("X",), ("Out",)),
     "scale": (("X",), ("Out",)),
     "elementwise_add": (("X", "Y"), ("Out",)),
+    # fused residual-add+LN: both streams half -> the op runs half
+    # (stats stay f32 internally, like layer_norm); Scale/Bias params
+    # and the Mean/Variance state outputs keep f32
+    "fused_residual_ln": (("X", "Y"), ("Sum", "Y")),
 }
 
 

@@ -248,6 +248,20 @@ DEFINE_flag("flash_block_k", 0,
             "flash-attention k-block columns (0 = default 128); a "
             "multiple of 128 (or the full k length) dividing the k "
             "sequence length")
+DEFINE_flag("kernel_tune_cache", "",
+            "path of the persisted per-(kernel, shape-bucket, dtype, "
+            "device kind) block-size tuning cache consulted by every "
+            "pallas_call site (ops/kernel_tuning.py): searched decisions "
+            "are written back atomically so later processes dispatch "
+            "without searching.  Empty = in-memory only for this process")
+DEFINE_flag("kernel_autotune", True,
+            "allow the measured block-size search at the first "
+            "real-device dispatch of a (kernel, shape-bucket) the tuning "
+            "cache has not seen (synthetic operands, standalone jit — "
+            "compile-time work).  0 = consult-only: misses seed the "
+            "heuristic default and never search (the CI regime, with a "
+            "pinned FLAGS_kernel_tune_cache).  Interpret-mode (CPU) runs "
+            "never search regardless — their timings are meaningless")
 DEFINE_flag("prng_impl", "threefry",
             "JAX PRNG for in-program randomness (dropout, *_random, "
             "sampling): 'threefry' (default; splittable counter stream, "

@@ -144,10 +144,15 @@ def bert_pretrain_program(hp=BertConfig, seq_len=128, lr=1e-4, is_test=False,
         )
         total = layers.elementwise_add(mlm_loss, nsp_loss)
 
-        if use_bf16:
-            from paddle_tpu.contrib.mixed_precision import rewrite_bf16
+        # logits-free MLM loss (the [B, T, V] f32 logits never reach HBM
+        # under FLAGS_use_pallas) + matmul-epilogue fusions, applied
+        # before minimize so grads differentiate through the fused ops
+        from ..transpiler.pass_registry import apply_pass
 
-            rewrite_bf16(main)
+        apply_pass(main, "linear_xent_fuse_pass")
+        apply_pass(main, "matmul_epilogue_fuse_pass")
+        if use_bf16:
+            apply_pass(main, "bf16_amp_pass")
         if not is_test:
             fluid.optimizer.Adam(learning_rate=lr).minimize(total)
 

@@ -181,10 +181,16 @@ def gpt2_lm_program(hp=GPT2Config, seq_len=128, lr=3e-4, is_test=False,
             layers.reduce_sum(cost), layers.clip(tokens, 1e-5, 1e30)
         )
 
-        if use_bf16:
-            from paddle_tpu.contrib.mixed_precision import rewrite_bf16
+        # logits-free fused cross-entropy (the [B, T, V] f32 logits
+        # tensor never reaches HBM under FLAGS_use_pallas) + the
+        # matmul-epilogue layer for the FFN/residual-LN chains — both
+        # BEFORE minimize so grads differentiate through the fused ops
+        from ..transpiler.pass_registry import apply_pass
 
-            rewrite_bf16(main)
+        apply_pass(main, "linear_xent_fuse_pass")
+        apply_pass(main, "matmul_epilogue_fuse_pass")
+        if use_bf16:
+            apply_pass(main, "bf16_amp_pass")
         if not is_test:
             fluid.optimizer.Adam(learning_rate=lr).minimize(loss)
 

@@ -133,9 +133,12 @@ def build_resnet_train_program(
 
             rewrite_nhwc(main)
         if use_bf16:
-            from paddle_tpu.contrib.mixed_precision import rewrite_bf16
+            # AMP rides the pass registry (bf16 MXU compute; master
+            # params and optimizer state stay f32) — applied before
+            # minimize so grads differentiate through the casts
+            from paddle_tpu.transpiler.pass_registry import apply_pass
 
-            rewrite_bf16(main)
+            apply_pass(main, "bf16_amp_pass")
         if optimizer == "momentum":
             opt = fluid.optimizer.Momentum(learning_rate=lr, momentum=0.9)
         else:

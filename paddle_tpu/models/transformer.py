@@ -481,11 +481,18 @@ def wmt_transformer_program(hp=ModelHyperParams, src_len=64, trg_len=64, learnin
         from ..transpiler.pass_registry import apply_pass
 
         apply_pass(main, "smooth_label_xent_fuse_pass")
+        # then fold the [H, V] projection INTO the loss (logits-free
+        # fused cross-entropy: the [B, T, V] f32 logits tensor never
+        # reaches HBM under FLAGS_use_pallas) and collapse the FFN
+        # mul+bias+act / residual-add+layer_norm chains onto the
+        # matmul-epilogue kernel layer
+        apply_pass(main, "linear_xent_fuse_pass")
+        apply_pass(main, "matmul_epilogue_fuse_pass")
 
         if use_bf16:
-            from paddle_tpu.contrib.mixed_precision import rewrite_bf16
-
-            rewrite_bf16(main)
+            # AMP rides the pass registry (bf16 MXU compute, f32 master
+            # params — the optimizer state and param vars stay f32)
+            apply_pass(main, "bf16_amp_pass")
         if not is_test:
             lr = layers.learning_rate_scheduler.noam_decay(hp.d_model, warmup_steps)
             lr = layers.scale(lr, scale=float(learning_rate))

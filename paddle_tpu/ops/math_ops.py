@@ -433,6 +433,50 @@ def _smooth_label_xent(ctx, ins, attrs):
     return {"Loss": [loss.astype(logits.dtype)]}
 
 
+@register("fused_linear_xent", no_grad_inputs=("Label",))
+def _fused_linear_xent_op(ctx, ins, attrs):
+    """Logits-free projected cross entropy — the fused target of
+    linear_xent_fuse_pass (the final [H, V] projection folded INTO
+    softmax_with_cross_entropy / smooth_label_xent).  Inputs: X
+    [..., H] hidden states, W [H, V] (or [V, H] with transpose_w, the
+    tied-embedding form), Label [..., 1] int.  Under FLAGS_use_pallas
+    the [R, V] f32 logits tensor never materializes in HBM: the
+    forward streams vocab tiles through an online logsumexp and the
+    backward recomputes per-tile softmax against W
+    (pallas_kernels.fused_linear_xent); the dense fallback is the
+    closed-form XLA reference.  Label convention matches
+    smooth_label_xent: out-of-range labels contribute the smoothing
+    term only.
+
+    transpose_w (the tied-embedding x @ W^T form) materializes a
+    physical [H, V] transposed copy of W per step — the kernels read
+    [H, V]-layout tiles; a weights-sized copy (~150 MB for gpt2) is
+    still far below the [R, V] logits the fusion eliminates (several
+    GB at bench config), but a [V, H]-layout kernel variant would
+    remove it (documented known limit)."""
+    from .pallas_kernels import (
+        _linear_xent_dense,
+        fused_linear_xent,
+        use_pallas,
+    )
+
+    x = ins["X"][0]
+    w = ins["W"][0]
+    label = ins["Label"][0]
+    eps = float(attrs.get("epsilon", 0.0))
+    if attrs.get("transpose_w", False):
+        w = w.T
+    h = x.shape[-1]
+    x2 = x.reshape(-1, h)
+    lbl = label.reshape(-1).astype(jnp.int32)
+    if use_pallas():
+        loss2 = fused_linear_xent(x2, w, lbl, eps)
+    else:
+        loss2 = _linear_xent_dense(x2, w, lbl, eps)
+    loss = loss2.reshape(tuple(x.shape[:-1]) + (1,)).astype(x.dtype)
+    return {"Loss": [loss]}
+
+
 @register("sigmoid_cross_entropy_with_logits", no_grad_inputs=("Label",))
 def _sigmoid_xent(ctx, ins, attrs):
     x, label = ins["X"][0], ins["Label"][0]

@@ -408,19 +408,99 @@ def _lstm_unit(ctx, ins, attrs):
 @register("fc")
 def _fc(ctx, ins, attrs):
     """Fused fully-connected (fc_op of fc_fuse_pass.cc): mul + bias-add +
-    activation in one op — one MXU matmul with XLA-fused epilogue."""
+    activation in one op.  Under FLAGS_use_pallas the blocked
+    matmul-epilogue kernel applies bias + activation to the accumulator
+    tile in VMEM (matmul_bias_act); otherwise one MXU matmul with an
+    XLA-fused epilogue."""
+    from .pallas_kernels import (
+        _mm_act,
+        matmul_bias_act,
+        mm_epilogue_ok,
+        use_pallas,
+    )
+
     x, w = ins["Input"][0], ins["W"][0]
     k = int(attrs.get("in_num_col_dims", 1))
     x2 = x.reshape((int(np.prod(x.shape[:k])), -1))
+    act = attrs.get("activation_type", "") or ""
+    bias = ins["Bias"][0].reshape(-1) if ins.get("Bias") else None
+    M, K = x2.shape
+    if (
+        use_pallas()
+        and w.ndim == 2
+        and (bias is None or bias.shape[0] == w.shape[-1])
+        and mm_epilogue_ok(M, K, w.shape[-1], act)
+    ):
+        out = matmul_bias_act(x2, w, bias, act)
+        return {"Out": [out.reshape(tuple(x.shape[:k]) + (w.shape[-1],))]}
     out = x2 @ w
     out = out.reshape(tuple(x.shape[:k]) + (w.shape[-1],))
-    if ins.get("Bias"):
-        out = out + ins["Bias"][0].reshape((1,) * k + (-1,))
-    act = attrs.get("activation_type", "")
-    if act:
-        out = {"relu": jax.nn.relu, "tanh": jnp.tanh,
-               "sigmoid": jax.nn.sigmoid}[act](out)
-    return {"Out": [out]}
+    if bias is not None:
+        out = out + bias.reshape((1,) * k + (-1,))
+    # ONE activation table for both paths (the kernel epilogue's):
+    # dense fallback and pallas epilogue can never drift apart
+    return {"Out": [_mm_act(out, act)]}
+
+
+@register("fused_swiglu")
+def _fused_swiglu(ctx, ins, attrs):
+    """Fused SwiGLU gating (swiglu_fuse_pass target): silu(x @ GateW) *
+    (x @ UpW) in one op — the pallas kernel computes both projections of
+    a row tile and the gate product in VMEM (matmul_swiglu); the dense
+    path is the XLA reference."""
+    from .pallas_kernels import (
+        _swiglu_dense,
+        matmul_swiglu,
+        mm_epilogue_ok,
+        use_pallas,
+    )
+
+    x, wg, wu = ins["X"][0], ins["GateW"][0], ins["UpW"][0]
+    k = int(attrs.get("x_num_col_dims", 1))
+    x2 = x.reshape((int(np.prod(x.shape[:k])), -1))
+    M, K = x2.shape
+    N = wg.shape[-1]
+    if use_pallas() and mm_epilogue_ok(M, K, N, extra_w=2):
+        out = matmul_swiglu(x2, wg, wu)
+    else:
+        out = _swiglu_dense(x2, wg, wu)
+    return {"Out": [out.reshape(tuple(x.shape[:k]) + (N,))]}
+
+
+@register("fused_residual_ln")
+def _fused_residual_ln(ctx, ins, attrs):
+    """Residual add + layer norm (residual_ln_fuse_pass target): the add
+    is the LN kernel's prologue — the sum forms on the row tile in VMEM,
+    normalizes in the same pass, and BOTH the sum (the residual stream
+    downstream consumers keep reading under its original name) and the
+    normalized output write out once.  Stats in f32 like layer_norm."""
+    from .pallas_kernels import (
+        _add_ln_dense,
+        fused_add_layer_norm,
+        use_pallas,
+    )
+
+    x, y = ins["X"][0], ins["Y"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    h = x.shape[-1]
+    x2 = x.reshape(-1, h)
+    y2 = y.reshape(-1, h)
+    gamma = ins["Scale"][0].reshape(h)
+    beta = ins["Bias"][0].reshape(h)
+    if use_pallas():
+        s2, o2 = fused_add_layer_norm(x2, y2, gamma, beta, eps)
+    else:
+        s2, o2 = _add_ln_dense(x2, y2, gamma, beta, eps)
+    s = s2.reshape(x.shape)
+    sf = s.astype(jnp.float32)
+    mean = jnp.mean(sf, axis=-1)
+    var = jnp.var(sf, axis=-1)
+    return {
+        "Sum": [s],
+        "Y": [o2.reshape(x.shape)],
+        "Mean": [jax.lax.stop_gradient(mean)],
+        "Variance": [jax.lax.stop_gradient(var)],
+    }
 
 
 @register("fusion_seqconv_eltadd_relu")
@@ -743,14 +823,75 @@ def _fused_attention(ctx, ins, attrs):
     qf = q.reshape(b * h, t, d)
     kf = k.reshape(b * h, tk, d)
     vf = v.reshape(b * h, tk, d)
+    from ..flags import get_flag
+
+    bq_flag = int(get_flag("flash_block_q") or 0)
+    bk_flag = int(get_flag("flash_block_k") or 0)
+
+    # Mosaic BlockSpec rule, per side: a block lands in the MINOR dim of
+    # the lifted [BH, 1, X] lse/delta specs ((1, 1, block_q)) and the
+    # kbias spec ((1, 1, block_k)), where it must be a multiple of 128
+    # or cover the full dimension — and it must divide the dimension.
+    # (Interpret mode does not enforce this; only a real-chip compile
+    # does.)  The ONE statement of the rule: _mosaic_legal and
+    # _legalize_blocks both consult these.
+    def _legal_q(bq):
+        return (bq % 128 == 0 or bq == t) and t % bq == 0
+
+    def _legal_k(bk):
+        return (bk % 128 == 0 or bk == tk) and tk % bk == 0
+
+    def _mosaic_legal(bq, bk):
+        return _legal_q(bq) and _legal_k(bk)
+
+    def _legalize_blocks(bq, bk):
+        """Re-legalize (possibly cached) block params against THIS
+        call's seq lens: the tuning cache buckets row dims by pow2, so
+        an entry seeded at a different Tq/Tk in the same bucket can
+        carry blocks that do not divide these lengths — each illegal
+        side falls back to its heuristic default instead of tripping
+        the kernel's divisibility assert."""
+        if not _legal_q(bq):
+            bq = 128 if t % 128 == 0 else t
+        if not _legal_k(bk):
+            bk = 128 if tk % 128 == 0 else tk
+        return bq, bk
+
+    def _auto_blocks(kernel_tag, build):
+        """Auto block choice through the persisted tuning cache: the
+        legal (Mosaic + VMEM score-tile) candidate set is searched on a
+        real-device miss; the heuristic 128-or-full default seeds
+        interpret-mode entries.  build(params) -> standalone callable
+        over (q, k, v) for the on-chip candidate timing."""
+        from .pallas_kernels import _tuned
+
+        default = {"block_q": 128 if t % 128 == 0 else t,
+                   "block_k": 128 if tk % 128 == 0 else tk}
+        cands = [
+            {"block_q": cq, "block_k": ck}
+            for cq in (128, 256, 512)
+            for ck in (128, 256, 512, 1024)
+            if _mosaic_legal(cq, ck)
+        ]
+        params = _tuned(
+            kernel_tag, [(b * h, t, d), (b * h, tk, d)], q.dtype,
+            cands, default, build=build,
+            arg_specs=[((b * h, t, d), q.dtype),
+                       ((b * h, tk, d), k.dtype),
+                       ((b * h, tk, d), v.dtype)],
+        )
+        return _legalize_blocks(int(params["block_q"]),
+                                int(params["block_k"]))
+
     if qstart is not None and qstart.ndim > 0:
         # PER-ROW offset-causal (the continuous-batching ragged step):
         # QStart is [B], row b's query i sits at global position
         # QStart[b] + i — every slot in the serving pool gets its own
-        # causal cutoff inside ONE dispatch.  Dense-XLA path (the flash
-        # kernels take a scalar qstart; a per-row kernel is future work
-        # — serving's CPU leg and the XLA fallback are exact either way,
-        # and exactness, not kernel speed, is the serving contract).
+        # causal cutoff inside ONE dispatch.  Under FLAGS_use_pallas
+        # this rides the vector-qstart flash kernel (per-row SMEM
+        # bases; row math is row-independent, so the serving
+        # bit-exactness contract — a slot equals its solo run through
+        # the same kernel — holds); dense XLA otherwise.
         if int(qstart.shape[0]) != b:
             raise ValueError(
                 "fused_attention: vector QStart must be [batch]=%d, got %s"
@@ -759,7 +900,44 @@ def _fused_attention(ctx, ins, attrs):
             raise ValueError(
                 "fused_attention: window is not supported with per-row "
                 "QStart")
-        from .pallas_kernels import NEG_INF
+        from .pallas_kernels import NEG_INF, flash_attention_qvec
+
+        if use_pallas():
+            bq = 128 if t % 128 == 0 else t
+            bk = 128 if tk % 128 == 0 else tk
+            if bq_flag or bk_flag:
+                # explicit sweep knobs: validate loudly and ALWAYS
+                # dispatch — the auto path's VMEM-budget gate below
+                # must not silently re-route a requested block size
+                # onto the dense path (misattributed sweep timings)
+                bq, bk = bq_flag or bq, bk_flag or bk
+                if bq <= 0 or bk <= 0 or not _mosaic_legal(bq, bk):
+                    raise ValueError(
+                        "FLAGS_flash_block_q/k (%d, %d) are not "
+                        "Mosaic-legal for the ragged-step shapes Tq=%d, "
+                        "Tk=%d" % (bq, bk, t, tk))
+                dispatch = True
+            else:
+                dispatch = bq <= 512 and bk <= 1024
+                if dispatch:
+                    # auto blocks ride the tuning cache like every other
+                    # pallas_call site (searched at first on-chip
+                    # dispatch)
+                    bq, bk = _auto_blocks(
+                        "flash_attention_qvec",
+                        lambda p: (lambda q_, k_, v_:
+                                   flash_attention_qvec(
+                                       q_, k_, v_,
+                                       jnp.zeros((q_.shape[0],),
+                                                 jnp.int32),
+                                       float(scale), p["block_q"],
+                                       p["block_k"])))
+            if dispatch:
+                # each head row carries its batch row's base
+                qsv = jnp.repeat(qstart.astype(jnp.int32), h)  # [B*H]
+                out = flash_attention_qvec(qf, kf, vf, qsv, float(scale),
+                                           bq, bk)
+                return {"Out": [out.reshape(b, h, t, d)]}
 
         s = (jnp.einsum("bqd,bkd->bqk", qf, kf).astype(jnp.float32)
              * float(scale))  # [B*H, Tq, Tk]
@@ -793,20 +971,6 @@ def _fused_attention(ctx, ins, attrs):
                 "(self-attention over one packed row)")
         seg = ins["SegmentIds"][0].reshape(b, t).astype(jnp.int32)
         seg = jnp.broadcast_to(seg[:, None, :], (b, h, t)).reshape(b * h, t)
-    from ..flags import get_flag
-
-    bq_flag = int(get_flag("flash_block_q") or 0)
-    bk_flag = int(get_flag("flash_block_k") or 0)
-
-    def _mosaic_legal(bq, bk):
-        # Mosaic BlockSpec rule: a block lands in the MINOR dim of the
-        # lifted [BH, 1, X] lse/delta specs ((1, 1, block_q)) and the
-        # kbias spec ((1, 1, block_k)), where it must be a multiple of
-        # 128 or cover the full dimension.  (Interpret mode does not
-        # enforce this; only a real-chip compile does.)
-        return ((bq % 128 == 0 or bq == t) and t % bq == 0
-                and (bk % 128 == 0 or bk == tk) and tk % bk == 0)
-
     if qstart is not None:
         from .pallas_kernels import flash_attention_piece
 
@@ -828,6 +992,12 @@ def _fused_attention(ctx, ins, attrs):
         bq = 128 if t % 128 == 0 else t
         bk = 128 if tk % 128 == 0 else tk
         if use_pallas() and bq <= 512 and bk <= 1024:
+            bq, bk = _auto_blocks(
+                "flash_attention_piece",
+                lambda p: (lambda q_, k_, v_: flash_attention_piece(
+                    q_, k_, v_, True, float(scale), p["block_q"],
+                    p["block_k"], window,
+                    jnp.zeros((1,), jnp.int32))[0]))
             # the ring's offset-causal piece IS chunked decode: the
             # piece is softmax-normalized within its kv, and here the
             # kv is the whole cache
@@ -857,12 +1027,19 @@ def _fused_attention(ctx, ins, attrs):
         # auto path: 128-blocks when the lengths tile; otherwise a
         # single full-dim block is still Mosaic-legal, so short or odd
         # lengths ride flash too as long as the [bq, bk] score tile
-        # stays VMEM-friendly.  Anything else goes dense.
+        # stays VMEM-friendly.  Anything else goes dense.  The choice
+        # among legal candidates goes through the tuning cache (searched
+        # at first real-device dispatch, seeded in interpret mode).
         bq = 128 if t % 128 == 0 else t
         bk = 128 if tk % 128 == 0 else tk
         # this derivation is Mosaic-legal by construction (each block is
         # 128-tiling or full-dim); only the VMEM score-tile budget gates
         if bq <= 512 and bk <= 1024:
+            bq, bk = _auto_blocks(
+                "flash_attention",
+                lambda p: (lambda q_, k_, v_: flash_attention(
+                    q_, k_, v_, None, causal, float(scale),
+                    p["block_q"], p["block_k"], window)))
             out = flash_attention(qf, kf, vf, kbias, causal, float(scale),
                                   block_q=bq, block_k=bk, window=window,
                                   seg=seg)

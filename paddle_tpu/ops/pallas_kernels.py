@@ -19,6 +19,23 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# the public primitive-kernel surface (tools/print_signatures tracks it
+# in API.spec): the closed composable set the hot paths dispatch to
+__all__ = [
+    "flash_attention",
+    "flash_attention_piece",
+    "flash_attention_qvec",
+    "fused_layer_norm",
+    "fused_add_layer_norm",
+    "fused_gru",
+    "fused_lstm",
+    "fused_softmax_xent",
+    "fused_linear_xent",
+    "matmul_bias_act",
+    "matmul_swiglu",
+    "use_pallas",
+]
+
 NEG_INF = -1e30
 
 
@@ -52,6 +69,33 @@ def _row_block(n, default):
     return blk
 
 
+def _note(family, n=1):
+    """Trace-time pallas dispatch counter (bench attribution)."""
+    from .kernel_tuning import note_kernel
+
+    note_kernel(family, n)
+
+
+def _tuned(kernel, shapes, dtype, candidates, default, build=None,
+           arg_specs=None):
+    """Consult the persisted tuning cache for this call site's block
+    sizes; on a real-device miss with FLAGS_kernel_autotune, time the
+    candidates on synthetic operands via `build(params) -> callable over
+    arg_specs arrays`.  Interpret-mode misses seed `default`."""
+    from . import kernel_tuning as kt
+
+    measure = None
+    if build is not None and arg_specs and not _interpret():
+        measure = kt.measure_candidate(build, arg_specs)
+    return kt.tuned_params(kernel, shapes, str(dtype), candidates, default,
+                           measure)
+
+
+def _row_block_candidates(n, sizes=(128, 256, 512, 1024)):
+    """Row-block search space: the legal (dividing) members of `sizes`."""
+    return [{"block_rows": s} for s in sizes if s <= n and n % s == 0]
+
+
 # ---------------------------------------------------------------------------
 # flash attention
 #
@@ -64,18 +108,37 @@ def _row_block(n, default):
 # saved lse — the [T, T] score matrix never exists in HBM in either pass.
 # Role parity: the cuDNN fused-attention kernels of SURVEY §2.6.
 # ---------------------------------------------------------------------------
-def _flash_fwd_kernel(*refs, block_q, block_k, nk,
-                      causal, scale, window=0, has_qoff=False,
-                      has_seg=False):
-    from jax.experimental import pallas as pl
-
+def _unpack_flash_refs(refs, has_qoff, has_seg):
+    """Shared operand unpack for the three flash kernels (fwd/dq/dkv):
+    the optional leading q base — SMEM scalar ([1] whole-array), or
+    per-row [BH, 1] blocked (1, 1) when has_qoff == "vec" (each grid-b
+    cell reads ITS row's base — the vector-qstart ragged serving
+    step) — then q/k/v/kbias and the optional segment-id pair.
+    Returns (qo, q, k, v, kbias, seg_q, seg_k, remaining_refs); ONE
+    copy so a new qstart encoding cannot silently miss a backward
+    kernel's causal base."""
     refs = list(refs)
-    qo = refs.pop(0)[0] if has_qoff else 0  # global q base (SMEM scalar)
+    if has_qoff == "vec":
+        qo = refs.pop(0)[0, 0]
+    elif has_qoff:
+        qo = refs.pop(0)[0]
+    else:
+        qo = 0
     q_ref, k_ref, v_ref, kb_ref = refs[:4]
     del refs[:4]
     sq_ref, sk_ref = (refs[:2] if has_seg else (None, None))
     if has_seg:
         del refs[:2]
+    return qo, q_ref, k_ref, v_ref, kb_ref, sq_ref, sk_ref, refs
+
+
+def _flash_fwd_kernel(*refs, block_q, block_k, nk,
+                      causal, scale, window=0, has_qoff=False,
+                      has_seg=False):
+    from jax.experimental import pallas as pl
+
+    qo, q_ref, k_ref, v_ref, kb_ref, sq_ref, sk_ref, refs = \
+        _unpack_flash_refs(refs, has_qoff, has_seg)
     o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -152,13 +215,16 @@ def _flash_blocks(Tq, Tk, block_q, block_k, causal):
 
 
 def _flash_fwd(q, k, v, kbias, causal, scale, block_q, block_k, window=0,
-               qoff=None, seg=None):
+               qoff=None, seg=None, qvec=None):
     """q: [BH, Tq, d], k/v: [BH, Tk, d], kbias: [BH, Tk] additive key bias.
     window > 0 (causal only): sliding-window attention — each query sees
     only the last `window` key positions.  qoff: optional [1] int32 GLOBAL
     q-position base relative to k's (traced; SMEM scalar) — the ring
     passes its chunk offset so causal/window masks apply in global
-    positions.  seg: optional [BH, T] int32 segment ids (sequence
+    positions.  qvec: optional [BH] int32 PER-ROW q-position bases (the
+    continuous-batching ragged step: every serving slot carries its own
+    causal cutoff) riding as [BH, 1] SMEM blocks — mutually exclusive
+    with qoff.  seg: optional [BH, T] int32 segment ids (sequence
     packing; requires Tq == Tk) — rides as two more [BH, 1, X] rank-1
     operands, compared per score tile.  Returns (o, lse)."""
     from jax.experimental import pallas as pl
@@ -166,15 +232,19 @@ def _flash_fwd(q, k, v, kbias, causal, scale, block_q, block_k, window=0,
 
     BH, T, d = q.shape
     Tk = k.shape[1]
+    assert qoff is None or qvec is None, "qoff and qvec are exclusive"
     block_q, block_k = _flash_blocks(T, Tk, block_q, block_k,
-                                     causal and qoff is None)
+                                     causal and qoff is None
+                                     and qvec is None)
     assert not (window and not causal), "window attention requires causal"
     assert seg is None or T == Tk, "segment ids require Tq == Tk"
+    _note("attention")
     nq, nk = T // block_q, Tk // block_k
     kernel = functools.partial(
         _flash_fwd_kernel, block_q=block_q, block_k=block_k, nk=nk,
         causal=causal, scale=scale, window=int(window),
-        has_qoff=qoff is not None, has_seg=seg is not None,
+        has_qoff=("vec" if qvec is not None else qoff is not None),
+        has_seg=seg is not None,
     )
     # 2D [BH, X] operands ride as [BH, 1, X] so every block keeps a
     # Mosaic-legal last-two-dims shape ((1, blk): second-minor equals the
@@ -202,6 +272,10 @@ def _flash_fwd(q, k, v, kbias, causal, scale, block_q, block_k, window=0,
     if qoff is not None:
         in_specs.insert(0, pl.BlockSpec(memory_space=pltpu.SMEM))
         args.insert(0, qoff.astype(jnp.int32).reshape(1))
+    elif qvec is not None:
+        in_specs.insert(0, pl.BlockSpec((1, 1), lambda b, i, j: (b, 0),
+                                        memory_space=pltpu.SMEM))
+        args.insert(0, qvec.astype(jnp.int32).reshape(BH, 1))
     o, lse = pl.pallas_call(
         kernel,
         grid=(BH, nq, nk),
@@ -230,13 +304,8 @@ def _flash_dq_kernel(*refs, block_q, block_k, nk, causal, scale,
                      window=0, has_qoff=False, has_seg=False):
     from jax.experimental import pallas as pl
 
-    refs = list(refs)
-    qo = refs.pop(0)[0] if has_qoff else 0
-    q_ref, k_ref, v_ref, kb_ref = refs[:4]
-    del refs[:4]
-    sq_ref, sk_ref = (refs[:2] if has_seg else (None, None))
-    if has_seg:
-        del refs[:2]
+    qo, q_ref, k_ref, v_ref, kb_ref, sq_ref, sk_ref, refs = \
+        _unpack_flash_refs(refs, has_qoff, has_seg)
     do_ref, lse_ref, delta_ref, dq_ref, dq_acc = refs
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -280,13 +349,8 @@ def _flash_dkv_kernel(*refs, block_q, block_k, nq, causal, scale,
                       window=0, has_qoff=False, has_seg=False):
     from jax.experimental import pallas as pl
 
-    refs = list(refs)
-    qo = refs.pop(0)[0] if has_qoff else 0
-    q_ref, k_ref, v_ref, kb_ref = refs[:4]
-    del refs[:4]
-    sq_ref, sk_ref = (refs[:2] if has_seg else (None, None))
-    if has_seg:
-        del refs[:2]
+    qo, q_ref, k_ref, v_ref, kb_ref, sq_ref, sk_ref, refs = \
+        _unpack_flash_refs(refs, has_qoff, has_seg)
     (do_ref, lse_ref, delta_ref,
      dk_ref, dv_ref, dkb_ref, dk_acc, dv_acc, dkb_acc) = refs
     ki = pl.program_id(1)
@@ -334,7 +398,7 @@ def _flash_dkv_kernel(*refs, block_q, block_k, nq, causal, scale,
 
 
 def _flash_bwd(q, k, v, kbias, o, lse, do, causal, scale, block_q, block_k,
-               dlse=None, window=0, qoff=None, seg=None):
+               dlse=None, window=0, qoff=None, seg=None, qvec=None):
     """Blocked backward: returns (dq, dk, dv, dkbias[BH,Tk] f32).
 
     dlse: optional cotangent of the lse output (the chunk-merge path of
@@ -345,14 +409,19 @@ def _flash_bwd(q, k, v, kbias, o, lse, do, causal, scale, block_q, block_k,
 
     BH, T, d = q.shape
     Tk = k.shape[1]
+    assert qoff is None or qvec is None, "qoff and qvec are exclusive"
     block_q, block_k = _flash_blocks(T, Tk, block_q, block_k,
-                                     causal and qoff is None)
+                                     causal and qoff is None
+                                     and qvec is None)
     nq, nk = T // block_q, Tk // block_k
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     if dlse is not None:
         delta = delta - dlse.astype(jnp.float32)
     qoff_arg = (
-        [qoff.astype(jnp.int32).reshape(1)] if qoff is not None else [])
+        [qoff.astype(jnp.int32).reshape(1)] if qoff is not None
+        else [qvec.astype(jnp.int32).reshape(BH, 1)]
+        if qvec is not None else [])
+    has_qoff = "vec" if qvec is not None else qoff is not None
     # 2D [BH, X] operands ride as [BH, 1, X] (Mosaic-legal blocks; see
     # _flash_fwd)
     kb3 = kbias.reshape(BH, 1, Tk)
@@ -369,14 +438,16 @@ def _flash_bwd(q, k, v, kbias, o, lse, do, causal, scale, block_q, block_k,
                              memory_space=pltpu.VMEM)
     row_spec_q = pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i),
                               memory_space=pltpu.VMEM)
-    smem = ([pl.BlockSpec(memory_space=pltpu.SMEM)]
-            if qoff is not None else [])
+    smem = ([pl.BlockSpec(memory_space=pltpu.SMEM)] if qoff is not None
+            else [pl.BlockSpec((1, 1), lambda b, i, j: (b, 0),
+                               memory_space=pltpu.SMEM)]
+            if qvec is not None else [])
     seg_specs_q = ([row_spec_q, kb_spec_q] if seg is not None else [])
     seg_args = ([seg3, seg3] if seg is not None else [])
     dq = pl.pallas_call(
         functools.partial(_flash_dq_kernel, block_q=block_q, block_k=block_k,
                           nk=nk, causal=causal, scale=scale,
-                          window=int(window), has_qoff=qoff is not None,
+                          window=int(window), has_qoff=has_qoff,
                           has_seg=seg is not None),
         grid=(BH, nq, nk),
         in_specs=smem + [q_spec_q, k_spec_q, k_spec_q, kb_spec_q]
@@ -400,7 +471,7 @@ def _flash_bwd(q, k, v, kbias, o, lse, do, causal, scale, block_q, block_k,
     dk, dv, dkb = pl.pallas_call(
         functools.partial(_flash_dkv_kernel, block_q=block_q, block_k=block_k,
                           nq=nq, causal=causal, scale=scale,
-                          window=int(window), has_qoff=qoff is not None,
+                          window=int(window), has_qoff=has_qoff,
                           has_seg=seg is not None),
         grid=(BH, nk, nq),
         in_specs=smem + [q_spec_k, k_spec_k, k_spec_k, kb_spec_k]
@@ -546,6 +617,55 @@ def _piece_vjp_bwd(causal, scale, block_q, block_k, window, res, cts):
 flash_attention_piece.defvjp(_piece_vjp_fwd, _piece_vjp_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def flash_attention_qvec(q, k, v, qstart, scale=None, block_q=128,
+                         block_k=128):
+    """PER-ROW-qstart causal flash attention: q [BH, Tq, d] against
+    k/v [BH, Tk, d] where row b's query i sits at global position
+    qstart[b] + i and keys at their cache indices (Tq may differ from
+    Tk).  qstart: [BH] int — rides as [BH, 1] SMEM blocks, so each grid
+    cell reads ITS row's causal cutoff; out-of-band K blocks are still
+    skipped per row.  This is the ragged continuous-batching serving
+    step's attention (PR 9's documented single biggest serving-perf
+    lever): one dispatch serves a pool of requests at heterogeneous
+    positions without the [B, Tq, Tk] mask or score matrix ever
+    existing in HBM.  Row math is row-independent (the serving
+    exactness contract: a slot's output is bit-identical to the same
+    row running solo).  Shares the band machinery (_band) with the
+    training kernels; differentiable in q/k/v for draft-training and
+    prefix-tuning setups that backprop through ragged steps."""
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    kb = jnp.zeros(k.shape[:2], jnp.float32)
+    o, _ = _flash_fwd(q, k, v, kb, True, scale, block_q, block_k,
+                      qvec=qstart)
+    return o
+
+
+def _qvec_vjp_fwd(q, k, v, qstart, scale, block_q, block_k):
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    kb = jnp.zeros(k.shape[:2], jnp.float32)
+    o, lse = _flash_fwd(q, k, v, kb, True, scale, block_q, block_k,
+                        qvec=qstart)
+    return o, (q, k, v, qstart, o, lse)
+
+
+def _qvec_vjp_bwd(scale, block_q, block_k, res, do):
+    q, k, v, qstart, o, lse = res
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    kb = jnp.zeros(k.shape[:2], jnp.float32)
+    dq, dk, dv, _ = _flash_bwd(q, k, v, kb, o, lse, do, True, scale,
+                               block_q, block_k, qvec=qstart)
+    # integer positions get the mandatory float0 cotangent
+    dqs = np.zeros(qstart.shape, dtype=jax.dtypes.float0)
+    return dq, dk, dv, dqs
+
+
+flash_attention_qvec.defvjp(_qvec_vjp_fwd, _qvec_vjp_bwd)
+
+
 # ---------------------------------------------------------------------------
 # fused layer norm
 # ---------------------------------------------------------------------------
@@ -558,11 +678,22 @@ def _ln_kernel(x_ref, g_ref, b_ref, o_ref, *, eps):
                 + b_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
 
 
-def _ln_fwd(x2d, gamma, beta, eps, block_rows=256):
+def _ln_fwd(x2d, gamma, beta, eps, block_rows=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     R, H = x2d.shape
+    if block_rows is None:
+        block_rows = _tuned(
+            "layer_norm", [x2d.shape], x2d.dtype,
+            _row_block_candidates(R),
+            {"block_rows": _row_block(R, 256)},
+            build=lambda p: (lambda x, g, b: _ln_fwd(
+                x, g, b, eps, p["block_rows"])),
+            arg_specs=[(x2d.shape, x2d.dtype), (gamma.shape, gamma.dtype),
+                       (beta.shape, beta.dtype)],
+        )["block_rows"]
+    _note("layernorm")
     block_rows = _row_block(R, block_rows)
     grid = (_cdiv(R, block_rows),)
     return pl.pallas_call(
@@ -652,6 +783,7 @@ def _gru_seq_fwd(xproj, w, h0, lens, block_b=8):
 
     B, T, H3 = xproj.shape
     hid = H3 // 3
+    _note("recurrent")
     block_b = _row_block(B, block_b)
     grid = (_cdiv(B, block_b),)
     return pl.pallas_call(
@@ -760,6 +892,7 @@ def _lstm_seq_fwd(xproj, w, h0, c0, lens, block_b=8):
 
     B, T, H4 = xproj.shape
     hid = H4 // 4
+    _note("recurrent")
     block_b = _row_block(B, block_b)
     grid = (_cdiv(B, block_b),)
     state_spec = pl.BlockSpec((block_b, hid), lambda i: (i, 0),
@@ -850,11 +983,22 @@ def _sxent_kernel(x_ref, lbl_ref, o_ref):
     o_ref[:] = (lse - gold).astype(o_ref.dtype)
 
 
-def _sxent_fwd_call(logits, labels, block_rows=512):
+def _sxent_fwd_call(logits, labels, block_rows=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     R, C = logits.shape
+    if block_rows is None:
+        block_rows = _tuned(
+            "softmax_xent", [logits.shape], logits.dtype,
+            _row_block_candidates(R),
+            {"block_rows": _row_block(R, 512)},
+            build=lambda p: (lambda lg, lb: _sxent_fwd_call(
+                lg, lb, p["block_rows"])),
+            arg_specs=[(logits.shape, logits.dtype),
+                       ((R,), "int32")],
+        )["block_rows"]
+    _note("xent")
     block_rows = _row_block(R, block_rows)
     grid = (_cdiv(R, block_rows),)
     return pl.pallas_call(
@@ -874,21 +1018,722 @@ def _sxent_fwd_call(logits, labels, block_rows=512):
     )(logits, labels.reshape(R, 1))
 
 
+def _sxent_validate(logits, labels):
+    """Loud shape contract: 2-D logits + one int label per row.  A
+    mis-shaped labels array used to broadcast through the gather
+    (plausible wrong losses); now it raises at trace time."""
+    if logits.ndim != 2:
+        raise ValueError(
+            "fused_softmax_xent: logits must be 2-D [rows, classes], got "
+            "shape %s — reshape leading dims into rows first"
+            % (tuple(logits.shape),))
+    lbl_n = int(np.prod(labels.shape)) if labels.ndim else 0
+    if labels.ndim > 2 or lbl_n != int(logits.shape[0]) or (
+            labels.ndim == 2 and labels.shape[1] != 1):
+        raise ValueError(
+            "fused_softmax_xent: labels must be [rows]=%d (or [rows, 1]) "
+            "ints, got shape %s — a mismatched labels array would "
+            "mis-broadcast against the row blocks"
+            % (int(logits.shape[0]), tuple(labels.shape)))
+    if not jnp.issubdtype(labels.dtype, jnp.integer):
+        raise ValueError(
+            "fused_softmax_xent: labels must be integers, got %s"
+            % labels.dtype)
+
+
+def _sxent_bwd_kernel(x_ref, lbl_ref, dy_ref, dx_ref):
+    """Row-blocked analytic backward: dx = (softmax(x) - onehot) * dy.
+    The one-hot is an iota compare inside the tile — no [R, C] one-hot
+    (or separately materialized softmax) array in HBM; dx is the
+    gradient itself and unavoidable."""
+    x = x_ref[:].astype(jnp.float32)
+    m = jnp.max(x, axis=-1, keepdims=True)
+    e = jnp.exp(x - m)
+    p = e / jnp.sum(e, axis=-1, keepdims=True)
+    lbl = lbl_ref[:].astype(jnp.int32).reshape(-1)
+    cols = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    onehot = (cols == lbl[:, None]).astype(jnp.float32)
+    dx_ref[:] = ((p - onehot) * dy_ref[:].astype(jnp.float32)).astype(
+        dx_ref.dtype)
+
+
+def _sxent_bwd_call(logits, labels, dy, block_rows=None):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    R, C = logits.shape
+    if block_rows is None:
+        block_rows = _tuned(
+            "softmax_xent_bwd", [logits.shape], logits.dtype,
+            _row_block_candidates(R),
+            {"block_rows": _row_block(R, 512)},
+            build=lambda p: (lambda lg, lb, g: _sxent_bwd_call(
+                lg, lb, g, p["block_rows"])),
+            arg_specs=[(logits.shape, logits.dtype), ((R,), "int32"),
+                       ((R, 1), "float32")],
+        )["block_rows"]
+    block_rows = _row_block(R, block_rows)
+    row_spec = pl.BlockSpec((block_rows, 1), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        _sxent_bwd_kernel,
+        grid=(_cdiv(R, block_rows),),
+        in_specs=[
+            pl.BlockSpec((block_rows, C), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            row_spec,
+            row_spec,
+        ],
+        out_specs=pl.BlockSpec((block_rows, C), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((R, C), logits.dtype),
+        interpret=_interpret(),
+    )(logits, labels.reshape(R, 1), dy.reshape(R, 1).astype(jnp.float32))
+
+
 @jax.custom_vjp
 def fused_softmax_xent(logits, labels):
     """Per-row -log softmax[label] over [rows, classes] + int labels [rows]."""
+    _sxent_validate(logits, labels)
     return _sxent_fwd_call(logits, labels)
 
 
 def _sxent_vjp_fwd(logits, labels):
+    _sxent_validate(logits, labels)
     return _sxent_fwd_call(logits, labels), (logits, labels)
 
 
 def _sxent_vjp_bwd(res, dy):
     logits, labels = res
-    p = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    onehot = jax.nn.one_hot(labels, logits.shape[-1], dtype=jnp.float32)
-    return ((p - onehot) * dy.astype(jnp.float32)).astype(logits.dtype), None
+    # blocked kernel backward (the dense softmax + one_hot pair this used
+    # to materialize was 2x the [R, C] traffic of the gradient itself)
+    return _sxent_bwd_call(logits, labels.reshape(-1), dy), None
 
 
 fused_softmax_xent.defvjp(_sxent_vjp_fwd, _sxent_vjp_bwd)
+
+
+# ---------------------------------------------------------------------------
+# matmul-epilogue fusions (TPP-style primitive kernels, ROADMAP item 1):
+# a blocked [M, K] @ [K, N] with the bias add + activation (or the SwiGLU
+# gate product) applied to the accumulator TILE in VMEM before it ever
+# reaches HBM — the XLA form writes the pre-activation [M, N] out and
+# reads it back per epilogue op.  Grid (nm, nn), full-K per tile (the
+# bench shapes keep K = d_model-ish, so an x/w tile pair fits VMEM
+# comfortably); dots consume the input dtype (bf16 under AMP runs the
+# MXU at full rate) and accumulate f32.  Backwards recompute through the
+# dense reference (plain MXU matmuls — nothing to hand-fuse there).
+# ---------------------------------------------------------------------------
+_MM_ACTS = ("", "identity", "relu", "tanh", "sigmoid", "gelu", "swish")
+
+
+def _mm_act(z, act):
+    """f32 epilogue activation (exact erf gelu / beta-1 swish: the same
+    defaults as the op lowerings in math_ops.ACTIVATIONS)."""
+    if act in ("", "identity"):
+        return z
+    if act == "relu":
+        return jnp.maximum(z, 0.0)
+    if act == "tanh":
+        return jnp.tanh(z)
+    if act == "sigmoid":
+        return jax.nn.sigmoid(z)
+    if act == "gelu":
+        return jax.nn.gelu(z, approximate=False)
+    if act == "swish":
+        return z * jax.nn.sigmoid(z)
+    raise ValueError("matmul epilogue: unsupported activation %r" % (act,))
+
+
+def _mm_kernel(*refs, act, has_bias):
+    x_ref, w_ref = refs[0], refs[1]
+    b_ref = refs[2] if has_bias else None
+    o_ref = refs[-1]
+    z = jnp.dot(x_ref[:], w_ref[:], preferred_element_type=jnp.float32)
+    if has_bias:
+        z = z + b_ref[:].astype(jnp.float32)  # [1, bn] broadcast
+    o_ref[:] = _mm_act(z, act).astype(o_ref.dtype)
+
+
+def _mm_col_block(n, default):
+    """Lane-dim tiling: a multiple of 128 dividing n, else the full dim
+    (a full minor-dim block is always Mosaic-legal)."""
+    blk = min(default, n)
+    if n % 128 == 0 and blk % 128 == 0 and n % blk == 0:
+        return blk
+    return n
+
+
+def _mm_blocks(M, K, N, dtype, kernel, extra_w=1):
+    """Tuned (block_m, block_n) for an [M, K] @ [K, N] epilogue kernel;
+    extra_w doubles the per-tile weight footprint (SwiGLU reads two)."""
+    cands = []
+    for bm in (128, 256, 512):
+        if M % bm:
+            continue
+        for bn in (128, 256, 512):
+            if N % bn or bn % 128:
+                continue
+            if _mm_vmem_ok(M, K, N, bm, bn, extra_w):
+                cands.append({"block_m": bm, "block_n": bn})
+    default = {"block_m": _row_block(M, 256), "block_n": _mm_col_block(N, 256)}
+    if extra_w == 2:
+        # measure the kernel actually being tuned: SwiGLU runs two dots
+        # plus the gate against each x tile — a plain-matmul timing
+        # would rank candidates by the wrong weight traffic
+        build = lambda p: (lambda x, wg, wu: _swiglu_call(
+            x, wg, wu, p["block_m"], p["block_n"]))
+        arg_specs = [((M, K), dtype), ((K, N), dtype), ((K, N), dtype)]
+    else:
+        build = lambda p: (lambda x, w: _mm_call(
+            x, w, None, "", p["block_m"], p["block_n"]))
+        arg_specs = [((M, K), dtype), ((K, N), dtype)]
+    params = _tuned(
+        kernel, [(M, K), (K, N)], dtype, cands, default,
+        build=build, arg_specs=arg_specs,
+    )
+    bm = _row_block(M, params["block_m"])
+    bn = _mm_col_block(N, params["block_n"])
+    return bm, bn
+
+
+def _mm_vmem_ok(M, K, N, bm, bn, extra_w=1):
+    """x/w/out tiles (f32 upper bound) must sit well inside VMEM."""
+    tile = (bm * K + extra_w * K * bn + 2 * bm * bn + bn) * 4
+    return tile < 12 * 2 ** 20
+
+
+def mm_epilogue_ok(M, K, N, act="", extra_w=1):
+    """THE dispatch gate for the matmul-epilogue kernels (fc /
+    fused_swiglu lowerings call this instead of re-deriving tiling
+    policy): activation supported and the heuristic DEFAULT tile pair
+    fits VMEM — tuned candidates are themselves VMEM-filtered in
+    _mm_blocks, so a True here can never select a tile the kernel
+    rejects."""
+    return (act in _MM_ACTS
+            and _mm_vmem_ok(M, K, N, _row_block(M, 256),
+                            _mm_col_block(N, 256), extra_w))
+
+
+def _mm_call(x2d, w, bias, act, block_m, block_n):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    M, K = x2d.shape
+    N = w.shape[1]
+    _note("matmul_epilogue")
+    grid = (_cdiv(M, block_m), _cdiv(N, block_n))
+    in_specs = [
+        pl.BlockSpec((block_m, K), lambda i, j: (i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((K, block_n), lambda i, j: (0, j),
+                     memory_space=pltpu.VMEM),
+    ]
+    args = [x2d, w]
+    if bias is not None:
+        in_specs.append(pl.BlockSpec((1, block_n), lambda i, j: (0, j),
+                                     memory_space=pltpu.VMEM))
+        args.append(bias.reshape(1, N))
+    return pl.pallas_call(
+        functools.partial(_mm_kernel, act=act, has_bias=bias is not None),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((block_m, block_n), lambda i, j: (i, j),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((M, N), x2d.dtype),
+        interpret=_interpret(),
+    )(*args)
+
+
+def _mm_dense(x2d, w, bias, act):
+    """XLA reference (also the backward recompute path)."""
+    z = jnp.dot(x2d, w, preferred_element_type=jnp.float32)
+    if bias is not None:
+        z = z + bias.reshape(1, -1).astype(jnp.float32)
+    return _mm_act(z, act).astype(x2d.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def matmul_bias_act(x2d, w, bias=None, act="", block_m=None, block_n=None):
+    """Blocked matmul with fused bias + activation epilogue over
+    [M, K] @ [K, N] (+ bias [N]); act in "", relu, tanh, sigmoid, gelu
+    (exact erf), swish.  block_m/block_n default to the tuning cache's
+    decision for this shape bucket."""
+    if block_m is None or block_n is None:
+        block_m, block_n = _mm_blocks(x2d.shape[0], x2d.shape[1],
+                                      w.shape[1], x2d.dtype, "matmul_bias_act")
+    return _mm_call(x2d, w, bias, act, block_m, block_n)
+
+
+def _mm_vjp_fwd(x2d, w, bias, act, block_m, block_n):
+    return (matmul_bias_act(x2d, w, bias, act, block_m, block_n),
+            (x2d, w, bias))
+
+
+def _mm_vjp_bwd(act, block_m, block_n, res, dy):
+    x2d, w, bias = res
+    if bias is None:
+        _, vjp = jax.vjp(lambda x, w_: _mm_dense(x, w_, None, act), x2d, w)
+        dx, dw = vjp(dy)
+        return dx, dw, None
+    _, vjp = jax.vjp(lambda x, w_, b: _mm_dense(x, w_, b, act), x2d, w, bias)
+    return vjp(dy)
+
+
+matmul_bias_act.defvjp(_mm_vjp_fwd, _mm_vjp_bwd)
+
+
+def _swiglu_kernel(x_ref, wg_ref, wu_ref, o_ref):
+    x = x_ref[:]
+    g = jnp.dot(x, wg_ref[:], preferred_element_type=jnp.float32)
+    u = jnp.dot(x, wu_ref[:], preferred_element_type=jnp.float32)
+    o_ref[:] = (g * jax.nn.sigmoid(g) * u).astype(o_ref.dtype)
+
+
+def _swiglu_call(x2d, wg, wu, block_m, block_n):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    M, K = x2d.shape
+    N = wg.shape[1]
+    _note("matmul_epilogue")
+    w_spec = pl.BlockSpec((K, block_n), lambda i, j: (0, j),
+                          memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        _swiglu_kernel,
+        grid=(_cdiv(M, block_m), _cdiv(N, block_n)),
+        in_specs=[
+            pl.BlockSpec((block_m, K), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            w_spec,
+            w_spec,
+        ],
+        out_specs=pl.BlockSpec((block_m, block_n), lambda i, j: (i, j),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((M, N), x2d.dtype),
+        interpret=_interpret(),
+    )(x2d, wg, wu)
+
+
+def _swiglu_dense(x2d, wg, wu):
+    g = jnp.dot(x2d, wg, preferred_element_type=jnp.float32)
+    u = jnp.dot(x2d, wu, preferred_element_type=jnp.float32)
+    return (g * jax.nn.sigmoid(g) * u).astype(x2d.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def matmul_swiglu(x2d, wg, wu, block_m=None, block_n=None):
+    """Fused SwiGLU gating: silu(x @ wg) * (x @ wu) over [M, K] with
+    wg/wu [K, N].  BOTH projections of a tile and the gate product
+    happen against one resident x tile — the gate/up pre-activations
+    never exist in HBM (the unfused form writes and re-reads both)."""
+    if block_m is None or block_n is None:
+        block_m, block_n = _mm_blocks(x2d.shape[0], x2d.shape[1],
+                                      wg.shape[1], x2d.dtype,
+                                      "matmul_swiglu", extra_w=2)
+    return _swiglu_call(x2d, wg, wu, block_m, block_n)
+
+
+def _swiglu_vjp_fwd(x2d, wg, wu, block_m, block_n):
+    return matmul_swiglu(x2d, wg, wu, block_m, block_n), (x2d, wg, wu)
+
+
+def _swiglu_vjp_bwd(block_m, block_n, res, dy):
+    x2d, wg, wu = res
+    _, vjp = jax.vjp(_swiglu_dense, x2d, wg, wu)
+    return vjp(dy)
+
+
+matmul_swiglu.defvjp(_swiglu_vjp_fwd, _swiglu_vjp_bwd)
+
+
+# ---------------------------------------------------------------------------
+# residual-add + layer norm: the transformer pre/post-process pair
+# (x + sublayer -> LN) with the add as the LN kernel's PROLOGUE — the sum
+# is formed on the row tile already in VMEM, normalized in the same pass,
+# and both the sum (the residual stream the next block reads) and the
+# normalized output write out once.
+# ---------------------------------------------------------------------------
+def _add_ln_kernel(x_ref, y_ref, g_ref, b_ref, s_ref, o_ref, *, eps):
+    s = x_ref[:].astype(jnp.float32) + y_ref[:].astype(jnp.float32)
+    s_ref[:] = s.astype(s_ref.dtype)
+    mean = jnp.mean(s, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(s - mean), axis=-1, keepdims=True)
+    yn = (s - mean) * jax.lax.rsqrt(var + eps)
+    o_ref[:] = (yn * g_ref[:].astype(jnp.float32)
+                + b_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def _add_ln_call(x2d, y2d, gamma, beta, eps, block_rows):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    R, H = x2d.shape
+    _note("layernorm")
+    block_rows = _row_block(R, block_rows)
+    row_spec = pl.BlockSpec((block_rows, H), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+    vec_spec = pl.BlockSpec((H,), lambda i: (0,), memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        functools.partial(_add_ln_kernel, eps=eps),
+        grid=(_cdiv(R, block_rows),),
+        in_specs=[row_spec, row_spec, vec_spec, vec_spec],
+        out_specs=[row_spec, row_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((R, H), x2d.dtype),
+            jax.ShapeDtypeStruct((R, H), x2d.dtype),
+        ],
+        interpret=_interpret(),
+    )(x2d, y2d, gamma, beta)
+
+
+def _add_ln_dense(x2d, y2d, gamma, beta, eps):
+    s = x2d.astype(jnp.float32) + y2d.astype(jnp.float32)
+    mean = jnp.mean(s, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(s - mean), axis=-1, keepdims=True)
+    yn = (s - mean) * jax.lax.rsqrt(var + eps)
+    return (s.astype(x2d.dtype),
+            (yn * gamma + beta).astype(x2d.dtype))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def fused_add_layer_norm(x2d, y2d, gamma, beta, eps=1e-5):
+    """Residual add + row layer norm over [rows, hidden]; returns
+    (sum, normalized) — the sum IS the residual stream, so callers that
+    need it downstream read the fused op's first output instead of
+    keeping a separate add."""
+    R, H = x2d.shape
+    block_rows = _tuned(
+        "add_layer_norm", [x2d.shape], x2d.dtype,
+        _row_block_candidates(R),
+        {"block_rows": _row_block(R, 256)},
+        build=lambda p: (lambda x, y, g, b: _add_ln_call(
+            x, y, g, b, eps, p["block_rows"])),
+        arg_specs=[(x2d.shape, x2d.dtype)] * 2
+        + [(gamma.shape, gamma.dtype), (beta.shape, beta.dtype)],
+    )["block_rows"]
+    return _add_ln_call(x2d, y2d, gamma, beta, eps, block_rows)
+
+
+def _add_ln_vjp_fwd(x2d, y2d, gamma, beta, eps):
+    return (fused_add_layer_norm(x2d, y2d, gamma, beta, eps),
+            (x2d, y2d, gamma, beta))
+
+
+def _add_ln_vjp_bwd(eps, res, cts):
+    x2d, y2d, gamma, beta = res
+    _, vjp = jax.vjp(
+        lambda x, y, g, b: _add_ln_dense(x, y, g, b, eps),
+        x2d, y2d, gamma, beta)
+    return vjp(cts)
+
+
+fused_add_layer_norm.defvjp(_add_ln_vjp_fwd, _add_ln_vjp_bwd)
+
+
+# ---------------------------------------------------------------------------
+# logits-free fused cross entropy: the final [H, V] projection fused INTO
+# the loss.  Forward streams V in block_v-sized tiles — each tile's
+# logits exist only as a VMEM [block_r, block_v] accumulator feeding an
+# online logsumexp (flash-attention's trick applied to the vocab axis),
+# the gold logit gather, and the row logit-sum (label smoothing's mean
+# term) — so the [R, V] f32 logits tensor NEVER materializes in HBM (at
+# transformer-base bench config that is a 1.3 GB write + read per step
+# direction, plus its gradient twin).  Backward recomputes each tile's
+# softmax from the saved per-row lse and contracts in-kernel: dx
+# accumulates g @ w_tile^T across the v grid, dw writes one [H, block_v]
+# tile per v index accumulated across row blocks.  The vocab axis is
+# masked in-kernel (cols >= V contribute nothing), so ragged vocab sizes
+# (10000 / 30522 / 50257) need no padding copy of w.
+# ---------------------------------------------------------------------------
+def _lxent_fwd_kernel(x_ref, w_ref, lbl_ref, loss_ref, lse_ref,
+                      m_ref, l_ref, gold_ref, sum_ref,
+                      *, block_v, nv, vocab, eps):
+    from jax.experimental import pallas as pl
+
+    vi = pl.program_id(1)
+
+    @pl.when(vi == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        gold_ref[:] = jnp.zeros_like(gold_ref)
+        sum_ref[:] = jnp.zeros_like(sum_ref)
+
+    cols = vi * block_v + jax.lax.broadcasted_iota(
+        jnp.int32, (1, block_v), 1)  # global vocab columns of this tile
+    vmask = cols < vocab
+    # zero the out-of-vocab tail of the weight tile BEFORE the dot: the
+    # last block may read past [H, V] (padded garbage on-chip)
+    w = jnp.where(vmask, w_ref[:], 0.0)
+    z = jnp.dot(x_ref[:], w, preferred_element_type=jnp.float32)
+    lbl = lbl_ref[:].astype(jnp.int32).reshape(-1)  # [br]
+    gold_ref[:] += jnp.sum(
+        jnp.where(cols == lbl[:, None], z, 0.0), axis=1, keepdims=True)
+    sum_ref[:] += jnp.sum(jnp.where(vmask, z, 0.0), axis=1, keepdims=True)
+    zm = jnp.where(vmask, z, NEG_INF)
+    m_prev = m_ref[:]
+    m_new = jnp.maximum(m_prev, jnp.max(zm, axis=1, keepdims=True))
+    l_ref[:] = (l_ref[:] * jnp.exp(m_prev - m_new)
+                + jnp.sum(jnp.exp(zm - m_new), axis=1, keepdims=True))
+    m_ref[:] = m_new
+
+    @pl.when(vi == nv - 1)
+    def _write():
+        lse = m_ref[:] + jnp.log(l_ref[:])
+        lbl_f = lbl_ref[:].astype(jnp.int32)
+        valid = ((lbl_f >= 0) & (lbl_f < vocab)).astype(jnp.float32)
+        loss = valid * (1.0 - eps) * (lse - gold_ref[:])
+        if eps:
+            loss = loss + eps * (lse - sum_ref[:] / vocab)
+        loss_ref[:] = loss
+        lse_ref[:] = lse
+
+
+def _lxent_grad_tile(x, w, lbl, lse, dy, vi, block_v, vocab, eps):
+    """Shared backward tile math: g = dy * d loss / d z for this
+    [br, block_v] logits tile, recomputed from the saved lse."""
+    cols = vi * block_v + jax.lax.broadcasted_iota(
+        jnp.int32, (1, block_v), 1)
+    vmask = cols < vocab
+    w = jnp.where(vmask, w, 0.0)
+    z = jnp.dot(x, w, preferred_element_type=jnp.float32)
+    p = jnp.where(vmask, jnp.exp(z - lse), 0.0)
+    lbl = lbl.astype(jnp.int32).reshape(-1)
+    onehot = (cols == lbl[:, None]).astype(jnp.float32)
+    valid = ((lbl >= 0) & (lbl < vocab)).astype(jnp.float32)[:, None]
+    g = valid * (1.0 - eps) * (p - onehot)
+    if eps:
+        g = g + eps * (p - jnp.where(vmask, 1.0 / vocab, 0.0))
+    return g * dy, w
+
+
+def _lxent_dx_kernel(x_ref, w_ref, lbl_ref, lse_ref, dy_ref, dx_ref,
+                     dx_acc, *, block_v, nv, vocab, eps):
+    from jax.experimental import pallas as pl
+
+    vi = pl.program_id(1)
+
+    @pl.when(vi == 0)
+    def _init():
+        dx_acc[:] = jnp.zeros_like(dx_acc)
+
+    g, w = _lxent_grad_tile(
+        x_ref[:], w_ref[:], lbl_ref[:], lse_ref[:].astype(jnp.float32),
+        dy_ref[:].astype(jnp.float32), vi, block_v, vocab, eps)
+    dx_acc[:] += jnp.dot(g.astype(x_ref.dtype), w.T,
+                         preferred_element_type=jnp.float32)
+
+    @pl.when(vi == nv - 1)
+    def _write():
+        dx_ref[:] = dx_acc[:].astype(dx_ref.dtype)
+
+
+def _lxent_dw_kernel(x_ref, w_ref, lbl_ref, lse_ref, dy_ref, dw_ref,
+                     dw_acc, *, block_v, nr, vocab, rows, eps):
+    from jax.experimental import pallas as pl
+
+    vi = pl.program_id(0)  # this grid is (nv, nr) — v is OUTER
+    ri = pl.program_id(1)
+
+    @pl.when(ri == 0)
+    def _init():
+        dw_acc[:] = jnp.zeros_like(dw_acc)
+
+    g, _w = _lxent_grad_tile(
+        x_ref[:], w_ref[:], lbl_ref[:], lse_ref[:].astype(jnp.float32),
+        dy_ref[:].astype(jnp.float32), vi, block_v, vocab, eps)
+    # unlike loss/dx (whose padded-row outputs are simply discarded),
+    # dw SUMS over row tiles — zero the tail tile's out-of-range rows
+    # on BOTH dot operands before they reach the accumulator (block_r
+    # need not divide R; padded x rows can be NaN, and NaN * 0 = NaN)
+    br = g.shape[0]
+    rr = ri * br + jax.lax.broadcasted_iota(jnp.int32, (br, 1), 0)
+    rmask = rr < rows
+    g = jnp.where(rmask, g, 0.0)
+    xt = jnp.where(rmask, x_ref[:], 0)
+    dw_acc[:] += jnp.dot(xt.T, g.astype(x_ref.dtype),
+                         preferred_element_type=jnp.float32)
+
+    @pl.when(ri == nr - 1)
+    def _write():
+        dw_ref[:] = dw_acc[:].astype(dw_ref.dtype)
+
+
+def _lx_vmem_ok(H, br, bv):
+    """Worst-pass (dw) resident f32 upper bound: the x row tile
+    [br, H], the w input + dw output + dw_acc scratch tiles [H, bv]
+    each, and the recomputed logits/softmax tile [br, bv] must sit
+    well inside VMEM — the linear-xent twin of _mm_vmem_ok (same
+    12 MB line)."""
+    tile = (br * H + 3 * H * bv + 2 * br * bv) * 4
+    return tile < 12 * 2 ** 20
+
+
+def _lxent_blocks(R, H, V, dtype):
+    cands = []
+    for br in (128, 256, 512):
+        if R % br:
+            continue
+        for bv in (512, 1024, 2048):
+            if _lx_vmem_ok(H, br, bv):
+                cands.append({"block_r": br, "block_v": bv})
+    br0 = _row_block(R, 256)
+    bv0 = min(V, 1024 if V % 128 == 0 else 2048)
+    # shrink the seeded default until the dw pass fits VMEM (consult-
+    # only regimes dispatch it unvalidated); halving keeps bv0 a
+    # multiple of 128 (Mosaic minor-dim rule) — a small non-multiple
+    # bv0 == V full-dim block can't legally shrink and stays put
+    while bv0 % 256 == 0 and bv0 > 128 and not _lx_vmem_ok(H, br0, bv0):
+        bv0 //= 2
+    default = {"block_r": br0, "block_v": bv0}
+    params = _tuned(
+        "linear_xent", [(R, H), (H, V)], dtype, cands, default,
+        build=lambda p: (lambda x, w, lb: _lxent_fwd(
+            x, w, lb, 0.0, p["block_r"], p["block_v"])),
+        arg_specs=[((R, H), dtype), ((H, V), dtype), ((R,), "int32")],
+    )
+    return _row_block(R, params["block_r"]), int(params["block_v"])
+
+
+def _lxent_specs(block_r, block_v, H, dw_grid=False):
+    """(x, w, row...) BlockSpecs; dw_grid flips which grid axis indexes
+    rows vs vocab tiles ((b, vi, ri) instead of (b-less) (ri, vi))."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if dw_grid:
+        x_spec = pl.BlockSpec((block_r, H), lambda i, j: (j, 0),
+                              memory_space=pltpu.VMEM)
+        w_spec = pl.BlockSpec((H, block_v), lambda i, j: (0, i),
+                              memory_space=pltpu.VMEM)
+        row_spec = pl.BlockSpec((block_r, 1), lambda i, j: (j, 0),
+                                memory_space=pltpu.VMEM)
+    else:
+        x_spec = pl.BlockSpec((block_r, H), lambda i, j: (i, 0),
+                              memory_space=pltpu.VMEM)
+        w_spec = pl.BlockSpec((H, block_v), lambda i, j: (0, j),
+                              memory_space=pltpu.VMEM)
+        row_spec = pl.BlockSpec((block_r, 1), lambda i, j: (i, 0),
+                                memory_space=pltpu.VMEM)
+    return x_spec, w_spec, row_spec
+
+
+def _lxent_fwd(x2d, w, labels, eps, block_r, block_v):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    R, H = x2d.shape
+    V = w.shape[1]
+    _note("xent")
+    nr, nv = _cdiv(R, block_r), _cdiv(V, block_v)
+    x_spec, w_spec, row_spec = _lxent_specs(block_r, block_v, H)
+    loss, lse = pl.pallas_call(
+        functools.partial(_lxent_fwd_kernel, block_v=block_v, nv=nv,
+                          vocab=V, eps=float(eps)),
+        grid=(nr, nv),
+        in_specs=[x_spec, w_spec, row_spec],
+        out_specs=[row_spec, row_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((R, 1), jnp.float32),
+            jax.ShapeDtypeStruct((R, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_r, 1), jnp.float32),
+            pltpu.VMEM((block_r, 1), jnp.float32),
+            pltpu.VMEM((block_r, 1), jnp.float32),
+            pltpu.VMEM((block_r, 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(x2d, w, labels.astype(jnp.int32).reshape(R, 1))
+    return loss, lse
+
+
+def _lxent_bwd(x2d, w, labels, lse, dy, eps, block_r, block_v):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    R, H = x2d.shape
+    V = w.shape[1]
+    nr, nv = _cdiv(R, block_r), _cdiv(V, block_v)
+    lbl = labels.astype(jnp.int32).reshape(R, 1)
+    lse2 = lse.reshape(R, 1)
+    dy2 = dy.reshape(R, 1).astype(jnp.float32)
+
+    x_spec, w_spec, row_spec = _lxent_specs(block_r, block_v, H)
+    dx = pl.pallas_call(
+        functools.partial(_lxent_dx_kernel, block_v=block_v, nv=nv,
+                          vocab=V, eps=float(eps)),
+        grid=(nr, nv),
+        in_specs=[x_spec, w_spec, row_spec, row_spec, row_spec],
+        out_specs=x_spec,
+        out_shape=jax.ShapeDtypeStruct((R, H), x2d.dtype),
+        scratch_shapes=[pltpu.VMEM((block_r, H), jnp.float32)],
+        interpret=_interpret(),
+    )(x2d, w, lbl, lse2, dy2)
+
+    x_spec, w_spec, row_spec = _lxent_specs(block_r, block_v, H,
+                                            dw_grid=True)
+    dw = pl.pallas_call(
+        functools.partial(_lxent_dw_kernel, block_v=block_v, nr=nr,
+                          vocab=V, rows=R, eps=float(eps)),
+        grid=(nv, nr),
+        in_specs=[x_spec, w_spec, row_spec, row_spec, row_spec],
+        out_specs=w_spec,
+        out_shape=jax.ShapeDtypeStruct((H, V), w.dtype),
+        scratch_shapes=[pltpu.VMEM((H, block_v), jnp.float32)],
+        interpret=_interpret(),
+    )(x2d, w, lbl, lse2, dy2)
+    return dx, dw
+
+
+def _linear_xent_dense(x2d, w, labels, eps=0.0):
+    """XLA reference: materializes the [R, V] logits (tests + the
+    non-pallas fallback).  Same label convention as the kernel and
+    smooth_label_xent: out-of-range labels contribute the smoothing
+    term only."""
+    lg = jnp.dot(x2d, w, preferred_element_type=jnp.float32)
+    v = lg.shape[-1]
+    lse = jax.scipy.special.logsumexp(lg, axis=-1, keepdims=True)
+    lbl = labels.astype(jnp.int32).reshape(-1)
+    onehot_gold = jnp.sum(
+        jnp.where(jnp.arange(v)[None, :] == lbl[:, None], lg, 0.0),
+        axis=-1, keepdims=True)
+    valid = ((lbl >= 0) & (lbl < v))[:, None]
+    loss = jnp.where(valid, (1.0 - eps) * (lse - onehot_gold), 0.0)
+    if eps:
+        loss = loss + eps * (lse - jnp.mean(lg, axis=-1, keepdims=True))
+    return loss
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def fused_linear_xent(x2d, w, labels, eps=0.0, block_r=None, block_v=None):
+    """Logits-free projected cross entropy: -log softmax(x @ w)[label]
+    per row (label-smoothed by eps against the uniform prior), computed
+    without the [R, V] logits array ever reaching HBM.  x2d [R, H],
+    w [H, V], labels [R] int; returns [R, 1] f32 losses.  Out-of-range
+    labels (pad ids) contribute the smoothing term only (the one_hot
+    convention, matching smooth_label_xent)."""
+    if block_r is None or block_v is None:
+        block_r, block_v = _lxent_blocks(x2d.shape[0], x2d.shape[1],
+                                         w.shape[1], x2d.dtype)
+    loss, _lse = _lxent_fwd(x2d, w, labels, eps, block_r, block_v)
+    return loss
+
+
+def _lxent_vjp_fwd(x2d, w, labels, eps, block_r, block_v):
+    if block_r is None or block_v is None:
+        block_r, block_v = _lxent_blocks(x2d.shape[0], x2d.shape[1],
+                                         w.shape[1], x2d.dtype)
+    loss, lse = _lxent_fwd(x2d, w, labels, eps, block_r, block_v)
+    return loss, (x2d, w, labels, lse, block_r, block_v)
+
+
+def _lxent_vjp_bwd(eps, _block_r, _block_v, res, dy):
+    x2d, w, labels, lse, block_r, block_v = res
+    dx, dw = _lxent_bwd(x2d, w, labels, lse, dy, eps, block_r, block_v)
+    dlbl = np.zeros(labels.shape, dtype=jax.dtypes.float0)
+    return dx, dw, dlbl
+
+
+fused_linear_xent.defvjp(_lxent_vjp_fwd, _lxent_vjp_bwd)

@@ -19,6 +19,20 @@ import paddle_tpu.framework as _fw
 from .pass_registry import OpPattern, Pass, register_pass
 
 _ACTS = ("relu", "tanh", "sigmoid")
+# fc epilogue activations: the fc lowering's matmul-epilogue kernel set
+# (pallas_kernels._MM_ACTS).  gelu fuses only in its exact-erf default
+# form and swish only at beta=1 — _act_fusable checks the attrs.
+_FC_ACTS = ("relu", "tanh", "sigmoid", "gelu", "swish")
+
+
+def _act_fusable(act_op):
+    """True when the activation op's attrs match the fused epilogue's
+    fixed form (exact gelu, beta-1 swish; the plain acts always do)."""
+    if act_op.type == "gelu":
+        return not act_op.attrs.get("approximate", False)
+    if act_op.type == "swish":
+        return float(act_op.attrs.get("beta", 1.0)) == 1.0
+    return True
 
 
 def _mk_op(block, type_, inputs, outputs, attrs):
@@ -98,6 +112,8 @@ class FcFusePass(Pass):
         def fuse(chain):
             mul, add = chain[0], chain[1]
             act = chain[2].type if len(chain) == 3 else ""
+            if len(chain) == 3 and not _act_fusable(chain[2]):
+                return False
             if int(mul.attrs.get("y_num_col_dims", 1)) != 1:
                 return False
             w = block._find_var_recursive(mul.inputs["Y"][0])
@@ -121,7 +137,7 @@ class FcFusePass(Pass):
             return True
 
         n = 0
-        for pat in ([["mul", "elementwise_add", a] for a in _ACTS]
+        for pat in ([["mul", "elementwise_add", a] for a in _FC_ACTS]
                     + [["mul", "elementwise_add"]]):
             n += OpPattern(pat).rewrite(block, fuse)
         program._fc_fused_count = n
@@ -488,17 +504,6 @@ class SmoothLabelXentFusePass(Pass):
     def apply(self, program, scope=None):
         block = program.global_block()
 
-        def consumers_of(name, exclude):
-            # scan EVERY block: a sub-block (While body, cond branch)
-            # reading the var is just as much a consumer as a top-level
-            # op — the while op itself only lists 'Condition' as input
-            return [
-                op
-                for blk in program.blocks
-                for op in blk.ops
-                if op is not exclude and name in op.input_arg_names()
-            ]
-
         def fuse(chain):
             oh, smooth, xent = chain
             if not bool(xent.attrs.get("soft_label", False)):
@@ -512,17 +517,19 @@ class SmoothLabelXentFusePass(Pass):
             softmax_out = xent.outputs.get("Softmax", [None])[0]
             if softmax_out:
                 protected = getattr(program, "_protected_fetch_names", ())
-                if softmax_out in protected or consumers_of(softmax_out,
-                                                            xent):
+                if softmax_out in protected or _consumers_all_blocks(
+                        program, softmax_out, exclude=(xent,)):
                     return False
             # OpPattern's single-consumer scan only covers the global
             # block: a sub-block reading an intermediate would be left
             # dangling by the rewrite
             oh_out = oh.outputs["Out"][0]
             sm_out = smooth.outputs["Out"][0]
-            if any(c is not smooth for c in consumers_of(oh_out, oh)):
+            if _consumers_all_blocks(program, oh_out,
+                                     exclude=(oh, smooth)):
                 return False
-            if any(c is not xent for c in consumers_of(sm_out, smooth)):
+            if _consumers_all_blocks(program, sm_out,
+                                     exclude=(smooth, xent)):
                 return False
             label_name = oh.inputs["X"][0]
             logits_name = xent.inputs["Logits"][0]
@@ -549,3 +556,303 @@ class SmoothLabelXentFusePass(Pass):
         ).rewrite(block, fuse)
         program._smooth_xent_fused_count = n
         return program
+
+
+def _consumers_all_blocks(program, name, exclude=()):
+    """Every op in ANY block reading `name` (sub-block reads count —
+    the shared safety scan of the xent/epilogue passes)."""
+    return [
+        op
+        for blk in program.blocks
+        for op in blk.ops
+        if op not in exclude and name in op.input_arg_names()
+    ]
+
+
+@register_pass("swiglu_fuse_pass")
+class SwigluFusePass(Pass):
+    """mul(x, Wg) -> swish  alongside  mul(x, Wu), joined by
+    elementwise_mul  =>  ONE fused_swiglu op (the gpt2 use_swiglu FFN
+    diamond).  The fused lowering runs both projections of a row tile
+    and the gate product against ONE resident x tile
+    (pallas_kernels.matmul_swiglu under FLAGS_use_pallas), so the gate
+    and up pre-activations never reach HBM.  Conservative: beta-1
+    swish, same x input and flatten dims on both muls, 2-D same-shape
+    weights, single-consumer intermediates (checked across ALL blocks),
+    protected fetches respected."""
+
+    def apply(self, program, scope=None):
+        block = program.global_block()
+        n = 0
+        changed = True
+        while changed:
+            changed = False
+            producers = {}
+            for op in block.ops:
+                for name in op.output_arg_names():
+                    producers[name] = op
+            for emul in list(block.ops):
+                if emul.type != "elementwise_mul":
+                    continue
+                if int(emul.attrs.get("axis", -1)) != -1:
+                    continue
+                xn = emul.inputs.get("X", [None])[0]
+                yn = emul.inputs.get("Y", [None])[0]
+                if xn is None or yn is None:
+                    continue
+                hit = None
+                for gate_out, up_out in ((xn, yn), (yn, xn)):
+                    act = producers.get(gate_out)
+                    umul = producers.get(up_out)
+                    if (act is None or act.type != "swish"
+                            or umul is None or umul.type != "mul"):
+                        continue
+                    if float(act.attrs.get("beta", 1.0)) != 1.0:
+                        continue
+                    gmul = producers.get(act.inputs["X"][0])
+                    if gmul is None or gmul.type != "mul":
+                        continue
+                    if gmul.inputs["X"][0] != umul.inputs["X"][0]:
+                        continue  # both sides must project the SAME x
+                    ncd = int(gmul.attrs.get("x_num_col_dims", 1))
+                    if ncd != int(umul.attrs.get("x_num_col_dims", 1)):
+                        continue
+                    if (int(gmul.attrs.get("y_num_col_dims", 1)) != 1
+                            or int(umul.attrs.get("y_num_col_dims", 1))
+                            != 1):
+                        continue
+                    wg = block._find_var_recursive(gmul.inputs["Y"][0])
+                    wu = block._find_var_recursive(umul.inputs["Y"][0])
+                    if (wg is None or wu is None or wg.shape is None
+                            or wu.shape is None or len(wg.shape) != 2
+                            or list(wg.shape) != list(wu.shape)):
+                        continue
+                    # every intermediate single-consumer, ALL blocks
+                    inter = [(gmul.outputs["Out"][0], act),
+                             (act.outputs["Out"][0], emul),
+                             (umul.outputs["Out"][0], emul)]
+                    if any(
+                        _consumers_all_blocks(program, name) != [consumer]
+                        for name, consumer in inter
+                    ):
+                        continue
+                    chain = [gmul, act, umul, emul]
+                    if not _chain_safe(program, chain):
+                        continue
+                    hit = (gmul, act, umul, ncd)
+                    break
+                if hit is None:
+                    continue
+                gmul, act, umul, ncd = hit
+                fused = _mk_op(
+                    block, "fused_swiglu",
+                    {"X": [gmul.inputs["X"][0]],
+                     "GateW": gmul.inputs["Y"],
+                     "UpW": umul.inputs["Y"]},
+                    {"Out": [emul.outputs["Out"][0]]},
+                    {"x_num_col_dims": ncd},
+                )
+                # insert at the elementwise_mul's slot: every fused
+                # input is defined there; the chain need not be
+                # contiguous
+                block.ops.insert(block.ops.index(emul), fused)
+                for op in (gmul, act, umul, emul):
+                    block.ops.remove(op)
+                program._bump_version()
+                n += 1
+                changed = True
+                break
+        program._swiglu_fused_count = n
+        return program
+
+
+@register_pass("residual_ln_fuse_pass")
+class ResidualLnFusePass(Pass):
+    """elementwise_add(x, y) -> layer_norm  =>  ONE fused_residual_ln op
+    whose lowering forms the sum as the LN kernel's PROLOGUE
+    (pallas_kernels.fused_add_layer_norm under FLAGS_use_pallas).  The
+    SUM stays a real output under its original name, AND the fused op
+    lands at the ADD's position — so every other consumer of the sum
+    (gpt2: the add feeds BOTH the norm and the next residual add) reads
+    a value defined exactly where it used to be, wherever that consumer
+    sits.  Conservative: same-shape known operands (a residual add, not
+    a broadcast bias), trailing-axis norm with Scale+Bias, exactly one
+    layer_norm consumer of the sum in the global block, protected
+    fetches respected."""
+
+    def apply(self, program, scope=None):
+        block = program.global_block()
+        n = 0
+        changed = True
+        while changed:
+            changed = False
+            for add in list(block.ops):
+                if add.type != "elementwise_add":
+                    continue
+                if int(add.attrs.get("axis", -1)) != -1:
+                    continue
+                xn = add.inputs.get("X", [None])[0]
+                yn = add.inputs.get("Y", [None])[0]
+                xv = block._find_var_recursive(xn) if xn else None
+                yv = block._find_var_recursive(yn) if yn else None
+                if (xv is None or yv is None or xv.shape is None
+                        or yv.shape is None
+                        or list(xv.shape) != list(yv.shape)
+                        or any(int(d) < 0 for d in xv.shape[1:])):
+                    continue
+                add_out = add.outputs["Out"][0]
+                cons = _consumers_all_blocks(program, add_out)
+                lns = [c for c in cons if c.type == "layer_norm"
+                       and c.inputs.get("X", [None])[0] == add_out
+                       and c in block.ops]
+                if len(lns) != 1:
+                    continue
+                ln = lns[0]
+                rank = len(xv.shape)
+                if int(ln.attrs.get("begin_norm_axis", 1)) != rank - 1:
+                    continue
+                if not (ln.inputs.get("Scale") and ln.inputs.get("Bias")):
+                    continue
+                chain = [add, ln]
+                if not _chain_safe(program, chain):
+                    continue
+                outputs = {
+                    "Sum": [add_out],
+                    "Y": list(ln.outputs.get("Y", [])),
+                }
+                for slot in ("Mean", "Variance"):
+                    if ln.outputs.get(slot):
+                        outputs[slot] = list(ln.outputs[slot])
+                fused = _mk_op(
+                    block, "fused_residual_ln",
+                    {"X": [xn], "Y": [yn],
+                     "Scale": list(ln.inputs["Scale"]),
+                     "Bias": list(ln.inputs["Bias"])},
+                    outputs,
+                    {"epsilon": float(ln.attrs.get("epsilon", 1e-5)),
+                     "begin_norm_axis": rank - 1},
+                )
+                # land at the ADD's index (inputs defined there; Sum
+                # defined exactly where it used to be)
+                block.ops.insert(block.ops.index(add), fused)
+                block.ops.remove(add)
+                block.ops.remove(ln)
+                program._bump_version()
+                n += 1
+                changed = True
+                break
+        program._residual_ln_fused_count = n
+        return program
+
+
+@register_pass("linear_xent_fuse_pass")
+class LinearXentFusePass(Pass):
+    """The logits-free loss rewrite: the final vocab projection
+    (mul, or matmul(transpose_Y) for tied embeddings) feeding
+    softmax_with_cross_entropy (hard label) or smooth_label_xent
+    becomes ONE fused_linear_xent op — under FLAGS_use_pallas the
+    [R, V] f32 logits tensor (and its gradient twin) never exists in
+    HBM (pallas_kernels.fused_linear_xent streams vocab tiles through
+    an online logsumexp; the backward recomputes per-tile softmax
+    against W).  Conservative: 2-D weight, hard labels, no
+    ignore_index, the xent's Softmax output unused ANYWHERE (all
+    blocks), single-consumer logits, protected fetches respected.
+
+    Label contract: OUT-OF-RANGE hard labels (stray pad ids) get zero
+    loss and zero gradient after fusion — the fused op's documented
+    one_hot convention.  The unfused chains never agreed on this case
+    (dense clamps the gather, the softmax_xent kernel yields lse), so
+    the pass normalizes an undefined behavior rather than changing a
+    defined one; in-range labels are unaffected
+    (test_fused_linear_xent_out_of_range_label_convention)."""
+
+    def apply(self, program, scope=None):
+        block = program.global_block()
+
+        def fuse(chain):
+            proj, xent = chain
+            if proj.type == "mul":
+                if int(proj.attrs.get("y_num_col_dims", 1)) != 1:
+                    return False
+                w_name, transpose_w = proj.inputs["Y"][0], False
+                x_name = proj.inputs["X"][0]
+                # the lowering flattens x as [..., H] -> [R, H]: only a
+                # mul whose row/contraction split is at the LAST axis
+                # matches (x_num_col_dims == rank-1)
+                xv = block._find_var_recursive(x_name)
+                if xv is None or xv.shape is None:
+                    return False
+                if int(proj.attrs.get("x_num_col_dims", 1)) != \
+                        len(xv.shape) - 1:
+                    return False
+            else:  # matmul: only the tied-embedding x @ W^T form
+                if (not proj.attrs.get("transpose_Y", False)
+                        or proj.attrs.get("transpose_X", False)
+                        or float(proj.attrs.get("alpha", 1.0)) != 1.0):
+                    return False
+                w_name, transpose_w = proj.inputs["Y"][0], True
+                x_name = proj.inputs["X"][0]
+            wv = block._find_var_recursive(w_name)
+            if wv is None or wv.shape is None or len(wv.shape) != 2:
+                return False
+            logits_name = proj.outputs["Out"][0]
+            if xent.inputs.get("Logits", [None])[0] != logits_name:
+                return False
+            if xent.type == "softmax_with_cross_entropy":
+                if bool(xent.attrs.get("soft_label", False)):
+                    return False
+                if int(xent.attrs.get("ignore_index", -100)) >= 0:
+                    return False
+                softmax_out = xent.outputs.get("Softmax", [None])[0]
+                if softmax_out:
+                    protected = getattr(
+                        program, "_protected_fetch_names", ())
+                    if softmax_out in protected or _consumers_all_blocks(
+                            program, softmax_out, exclude=(xent,)):
+                        return False
+                eps = 0.0
+            else:  # smooth_label_xent reads raw int labels already
+                eps = float(xent.attrs.get("epsilon", 0.0))
+            # logits single-consumer across ALL blocks (OpPattern only
+            # scans the global block)
+            if _consumers_all_blocks(program, logits_name,
+                                     exclude=(xent,)):
+                return False
+            if not _chain_safe(program, chain):
+                return False
+            fused = _mk_op(
+                block, "fused_linear_xent",
+                {"X": [x_name], "W": [w_name],
+                 "Label": list(xent.inputs["Label"])},
+                {"Loss": list(xent.outputs["Loss"])},
+                {"epsilon": eps, "transpose_w": transpose_w},
+            )
+            _replace_chain(block, program, chain, [fused])
+            return True
+
+        n = 0
+        for head in ("mul", "matmul"):
+            for tail in ("softmax_with_cross_entropy", "smooth_label_xent"):
+                n += OpPattern([head, tail]).rewrite(block, fuse)
+        program._linear_xent_fused_count = n
+        return program
+
+
+@register_pass("matmul_epilogue_fuse_pass")
+def _matmul_epilogue_fuse(program, scope):
+    """The training-program epilogue bundle (ROADMAP item 1): fc
+    (mul+bias+act), SwiGLU diamonds, and residual-add+layer_norm pairs
+    collapse into their fused ops so the model builders get the pallas
+    matmul-epilogue kernels without model edits.  Apply BEFORE
+    Optimizer.minimize (grad ops must differentiate through the fused
+    ops) and before any AMP rewrite."""
+    from .pass_registry import apply_pass
+
+    for name in ("fc_fuse_pass", "swiglu_fuse_pass",
+                 "residual_ln_fuse_pass"):
+        apply_pass(program, name, scope=scope)
+    program._matmul_epilogue_fused_count = (
+        getattr(program, "_fc_fused_count", 0)
+        + getattr(program, "_swiglu_fused_count", 0)
+        + getattr(program, "_residual_ln_fused_count", 0))
+    return program

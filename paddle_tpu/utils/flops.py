@@ -72,15 +72,31 @@ def program_flops(program, batch_hint=1):
             n, cin, hi, wi = inp
             _, co_g, kh, kw = flt
             total += factor * 2.0 * n * cin * hi * wi * co_g * kh * kw
-        elif t == "mul":
-            x = _shape(blk, op.inputs.get("X", [""])[0], batch_hint)
-            y = _shape(blk, op.inputs.get("Y", [""])[0], batch_hint)
+        elif t in ("mul", "fc", "fused_swiglu"):
+            x_slot = "Input" if t == "fc" else "X"
+            y_slot = ("W" if t == "fc"
+                      else "GateW" if t == "fused_swiglu" else "Y")
+            x = _shape(blk, op.inputs.get(x_slot, [""])[0], batch_hint)
+            y = _shape(blk, op.inputs.get(y_slot, [""])[0], batch_hint)
             if not x or not y:
                 continue
-            ncd = int(op.attrs.get("x_num_col_dims", 1))
+            ncd = int(op.attrs.get(
+                "in_num_col_dims" if t == "fc" else "x_num_col_dims", 1))
             m = _prod(x[:ncd])
             k = _prod(x[ncd:])
             n2 = _prod(y[1:]) if len(y) > 1 else 1
+            # SwiGLU runs TWO projections (gate + up) per op
+            total += factor * 2.0 * m * k * n2 * (
+                2.0 if t == "fused_swiglu" else 1.0)
+        elif t == "fused_linear_xent":
+            # the folded final projection: [R, H] @ [H, V]
+            x = _shape(blk, op.inputs.get("X", [""])[0], batch_hint)
+            w = _shape(blk, op.inputs.get("W", [""])[0], batch_hint)
+            if not x or not w or len(w) != 2:
+                continue
+            m = _prod(x[:-1])
+            k = x[-1]
+            n2 = w[0] if op.attrs.get("transpose_w", False) else w[1]
             total += factor * 2.0 * m * k * n2
         elif t == "matmul":
             x = _shape(blk, op.inputs.get("X", [""])[0], batch_hint)

@@ -80,6 +80,21 @@ terminal_evict or scaling_policy or budget_exhaustion"
 python -m pytest tests/test_dist_transpiler.py -q -m "" \
     -k "derive_plan or clock_only"
 
+echo "== pallas kernel pass (FLAGS_use_pallas=1, interpret mode) =="
+# the primitive-kernel layer end to end on the CPU mesh: every kernel's
+# interpret-mode numerics vs its dense reference (matmul-epilogue,
+# swiglu, residual-LN, logits-free xent, vector-qstart flash), the
+# fuse-pass rewrites, the tuning-cache contract, and the serving
+# churn-exactness suite with the ragged step's flash kernel live.
+# FLAGS_kernel_autotune=0 + the committed pinned cache mean CI NEVER
+# searches block sizes (interpret timings would be noise anyway);
+# consult-only misses seed the deterministic defaults.
+FLAGS_use_pallas=1 FLAGS_kernel_autotune=0 \
+FLAGS_kernel_tune_cache=tests/data/ci_tuning_cache.json \
+    python -m pytest tests/test_pallas_kernels.py \
+    tests/test_kernel_tuning.py tests/test_fuse_passes.py \
+    tests/test_serving.py -q -m ""
+
 echo "== serving pass (continuous-batching churn exactness) =="
 # the slot-pool engine's core contract on a short seeded CPU trace
 # (small GPT2Config, pool B=4): every request's tokens bit-identical

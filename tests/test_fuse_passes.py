@@ -436,3 +436,218 @@ def test_smooth_label_xent_out_of_range_labels_match_unfused():
                                       fetch_list=[cost])[0])
 
     np.testing.assert_allclose(run(True), run(False), rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# matmul-epilogue layer passes (swiglu / residual-ln / linear-xent)
+# ---------------------------------------------------------------------------
+def test_swiglu_fuse_pass_fires_and_matches():
+    """The gpt2 use_swiglu diamond — mul+swish alongside mul, joined by
+    elementwise_mul — collapses to ONE fused_swiglu op, same numbers."""
+    main, startup = _fresh()
+    with fluid.framework.program_guard(main, startup):
+        startup.random_seed = 11
+        x = layers.data("x", shape=[4, 8])
+        gate = layers.fc(x, 12, num_flatten_dims=2, act="swish",
+                         bias_attr=False)
+        up = layers.fc(x, 12, num_flatten_dims=2, bias_attr=False)
+        y = layers.elementwise_mul(gate, up)
+    xv = np.random.RandomState(0).rand(2, 4, 8).astype("float32")
+    before, scope = _run(main, startup, {"x": xv}, [y])
+    assert "swish" in _op_types(main)
+
+    apply_pass(main, "swiglu_fuse_pass")
+    assert main._swiglu_fused_count == 1
+    types = _op_types(main)
+    assert "fused_swiglu" in types
+    assert "swish" not in types and "elementwise_mul" not in types
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        after = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    np.testing.assert_allclose(before[0], np.asarray(after[0]),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_swiglu_fuse_pass_leaves_mismatched_inputs_alone():
+    """Two muls over DIFFERENT inputs must not fuse."""
+    main, startup = _fresh()
+    with fluid.framework.program_guard(main, startup):
+        a = layers.data("a", shape=[4, 8])
+        b = layers.data("b", shape=[4, 8])
+        gate = layers.fc(a, 12, num_flatten_dims=2, act="swish",
+                         bias_attr=False)
+        up = layers.fc(b, 12, num_flatten_dims=2, bias_attr=False)
+        layers.elementwise_mul(gate, up)
+    apply_pass(main, "swiglu_fuse_pass")
+    assert main._swiglu_fused_count == 0
+
+
+def test_residual_ln_fuse_pass_fires_and_matches():
+    """add -> layer_norm fuses; the SUM survives under its original name
+    (it is the residual stream — gpt2 reads it again after the norm)."""
+    main, startup = _fresh()
+    with fluid.framework.program_guard(main, startup):
+        startup.random_seed = 12
+        a = layers.data("a", shape=[4, 16])
+        b = layers.data("b", shape=[4, 16])
+        s = layers.elementwise_add(a, b)
+        y = layers.layer_norm(s, begin_norm_axis=2)
+        z = layers.elementwise_add(s, y)  # sum consumed AGAIN post-norm
+    rng = np.random.RandomState(1)
+    av = rng.rand(2, 4, 16).astype("float32")
+    bv = rng.rand(2, 4, 16).astype("float32")
+    before, scope = _run(main, startup, {"a": av, "b": bv}, [s, y, z])
+
+    apply_pass(main, "residual_ln_fuse_pass")
+    assert main._residual_ln_fused_count == 1
+    types = _op_types(main)
+    assert "fused_residual_ln" in types and "layer_norm" not in types
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        after = exe.run(main, feed={"a": av, "b": bv}, fetch_list=[s, y, z])
+    for x, x2 in zip(before, after):
+        np.testing.assert_allclose(x, np.asarray(x2), rtol=1e-5, atol=1e-6)
+
+
+def test_residual_ln_fuse_pass_skips_broadcast_bias_adds():
+    """A [H]-bias add is NOT a residual add — the pass must not fire."""
+    main, startup = _fresh()
+    with fluid.framework.program_guard(main, startup):
+        a = layers.data("a", shape=[4, 16])
+        bias = layers.create_parameter(shape=[16], dtype="float32")
+        s = layers.elementwise_add(a, bias)
+        layers.layer_norm(s, begin_norm_axis=2)
+    apply_pass(main, "residual_ln_fuse_pass")
+    assert main._residual_ln_fused_count == 0
+
+
+def test_fc_fuse_pass_takes_gelu_and_swish_epilogues():
+    """mul+bias+gelu collapses to fc(gelu) — the matmul-epilogue form."""
+    main, startup = _fresh()
+    with fluid.framework.program_guard(main, startup):
+        startup.random_seed = 13
+        x = layers.data("x", shape=[8])
+        y = layers.fc(x, 6, act="gelu")
+    xv = np.random.RandomState(2).rand(4, 8).astype("float32")
+    before, scope = _run(main, startup, {"x": xv}, [y])
+    apply_pass(main, "fc_fuse_pass")
+    assert main._fc_fused_count == 1
+    assert "gelu" not in _op_types(main)
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        after = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    np.testing.assert_allclose(before[0], np.asarray(after[0]),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_linear_xent_fuse_pass_fires_and_matches():
+    """mul -> softmax_with_cross_entropy (hard label, Softmax unused)
+    becomes fused_linear_xent; losses identical (dense path here)."""
+    main, startup = _fresh()
+    with fluid.framework.program_guard(main, startup):
+        startup.random_seed = 14
+        x = layers.data("x", shape=[4, 8])
+        w = layers.create_parameter(shape=[8, 20], dtype="float32")
+        logits = layers.matmul(x, w)
+        lbl = layers.data("lbl", shape=[4, 1], dtype="int64")
+        loss = layers.softmax_with_cross_entropy(logits, lbl)
+    # the builder idiom is mul (layers.fc without bias); matmul without
+    # transpose is NOT matched — build the mul form explicitly
+    main2, startup2 = _fresh()
+    with fluid.framework.program_guard(main2, startup2):
+        startup2.random_seed = 14
+        x = layers.data("x", shape=[4, 8])
+        logits = layers.fc(x, 20, num_flatten_dims=2, bias_attr=False)
+        lbl = layers.data("lbl", shape=[4, 1], dtype="int64")
+        loss = layers.softmax_with_cross_entropy(logits, lbl)
+    rng = np.random.RandomState(3)
+    xv = rng.rand(2, 4, 8).astype("float32")
+    lv = rng.randint(0, 20, (2, 4, 1)).astype("int64")
+    before, scope = _run(main2, startup2, {"x": xv, "lbl": lv}, [loss])
+    apply_pass(main2, "linear_xent_fuse_pass")
+    assert main2._linear_xent_fused_count == 1
+    types = _op_types(main2)
+    assert "fused_linear_xent" in types
+    assert "softmax_with_cross_entropy" not in types and "mul" not in types
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        after = exe.run(main2, feed={"x": xv, "lbl": lv}, fetch_list=[loss])
+    np.testing.assert_allclose(before[0], np.asarray(after[0]),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_linear_xent_fuse_pass_respects_softmax_consumers():
+    """A consumed Softmax output (or soft labels) blocks the rewrite —
+    the fused op cannot provide either."""
+    main, startup = _fresh()
+    with fluid.framework.program_guard(main, startup):
+        x = layers.data("x", shape=[4, 8])
+        logits = layers.fc(x, 20, num_flatten_dims=2, bias_attr=False)
+        lbl = layers.data("lbl", shape=[4, 1], dtype="int64")
+        loss = layers.softmax_with_cross_entropy(logits, lbl)
+        # find and consume the Softmax output
+        xent = [op for op in main.global_block().ops
+                if op.type == "softmax_with_cross_entropy"][0]
+        sm_name = xent.outputs["Softmax"][0]
+        sm_var = main.global_block().var(sm_name)
+        layers.mean(sm_var)
+    apply_pass(main, "linear_xent_fuse_pass")
+    assert main._linear_xent_fused_count == 0
+
+
+def test_linear_xent_fuse_pass_skips_non_last_axis_mul():
+    """A mul whose row/contraction split is NOT at the last axis
+    (x_num_col_dims < rank-1) must not fuse: the fused_linear_xent
+    lowering flattens x as [..., H] -> [R, H], which would mismatch the
+    mul's contraction dims."""
+    main, startup = _fresh()
+    with fluid.framework.program_guard(main, startup):
+        x = layers.data("x", shape=[4, 8])
+        # num_flatten_dims=1 on the rank-3 var: rows=B, contract 4*8
+        logits = layers.fc(x, 20, num_flatten_dims=1, bias_attr=False)
+        lbl = layers.data("lbl", shape=[1], dtype="int64")
+        loss = layers.softmax_with_cross_entropy(logits, lbl)
+    apply_pass(main, "linear_xent_fuse_pass")
+    assert main._linear_xent_fused_count == 0
+    assert "fused_linear_xent" not in _op_types(main)
+
+
+def test_bf16_amp_pass_registry_keeps_f32_master_params():
+    """The AMP satellite contract: bf16_amp_pass applied through the
+    pass registry BEFORE minimize (the gpt2 builder's use_bf16 route)
+    trains with f32 master params — every parameter and optimizer slot
+    in the scope stays float32 while the compiled step computes its
+    matmul-class ops in bf16."""
+    from paddle_tpu.models import gpt2
+    import paddle_tpu.framework as fw
+    from paddle_tpu import unique_name
+
+    fw.switch_main_program(fluid.Program())
+    fw.switch_startup_program(fluid.Program())
+    unique_name.switch()
+
+    class HP(gpt2.GPT2Config):
+        vocab_size = 40
+        n_ctx = 16
+        d_model = 16
+        n_layer = 1
+        n_head = 2
+        dropout = 0.0
+
+    main, startup, feeds, fetches = gpt2.gpt2_lm_program(
+        HP, seq_len=8, lr=1e-3, use_bf16=True)
+    # the AMP rewrite actually engaged (cast ops present)
+    assert any(op.type == "cast" for op in main.global_block().ops)
+    batch = gpt2.make_fake_lm_batch(2, 8, HP, seed=0)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        losses = []
+        for _ in range(2):
+            out = exe.run(main, feed=batch, fetch_list=fetches)
+            losses.append(float(np.ravel(out[0])[0]))
+    assert all(np.isfinite(losses))
+    for p in main.global_block().all_parameters():
+        got = np.asarray(scope.find_var(p.name))
+        assert got.dtype == np.dtype("float32"), (p.name, got.dtype)

@@ -231,6 +231,23 @@ def test_engine_churn_exactness_sampled():
     _assert_churn_exact(eng, reqs)
 
 
+def test_engine_churn_exactness_pallas_kernels():
+    """The exactness contract under FLAGS_use_pallas=1: the ragged
+    step's attention rides the VECTOR-QSTART flash kernel (per-row SMEM
+    cutoff bases; interpret mode on CPU, the same kernel Mosaic
+    compiles on chip) and every pooled stream — greedy and seeded
+    sampled — stays bit-identical to its solo run under churn."""
+    from paddle_tpu import flags
+
+    flags.set_flags({"use_pallas": True})
+    try:
+        _, eng = _make_engine()
+        reqs = _churn_trace(TinyHP.vocab_size, greedy_only=False, seed=3)
+        _assert_churn_exact(eng, reqs)
+    finally:
+        flags.set_flags({"use_pallas": False})
+
+
 def test_engine_compiles_once_across_occupancy():
     """The no-retrace contract: after the first full step (startup +
     reset + step program traced), ANY occupancy change — admission,

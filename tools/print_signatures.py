@@ -36,6 +36,8 @@ MODULES = [
     "paddle_tpu.memory",
     "paddle_tpu.device_info",
     "paddle_tpu.parallel.collective",
+    "paddle_tpu.ops.pallas_kernels",
+    "paddle_tpu.ops.kernel_tuning",
     "paddle_tpu.dataset.mnist",
     "paddle_tpu.dataset.movielens",
     "paddle_tpu.dataset.wmt14",
