@@ -1082,9 +1082,13 @@ def _transformer_bench(on_tpu, device):
         max_length = max(seq, tfm.ModelHyperParams.max_length)
         fused_attn = use_fused
 
-    main, startup, feeds, fetches = tfm.wmt_transformer_program(
-        HP, src_len=seq, trg_len=seq, use_bf16=use_bf16
-    )
+    # BENCH_REMAT=<bytes>: build the leg under an HBM budget — the
+    # builder's remat pass marks checkpoint segments until the estimated
+    # fwd+bwd peak fits (1 = force maximal recompute); the leg reports
+    # the estimator's before/after and trains WITH the recompute cost
+    remat_budget = int(os.environ.get("BENCH_REMAT", "0"))
+    main, startup, feeds, fetches = _build_tfm_leg(
+        HP, seq, use_bf16, remat_budget)
     scope = fluid.Scope()
     with fluid.scope_guard(scope):
         exe = fluid.Executor(fluid.TPUPlace(0) if on_tpu else fluid.CPUPlace())
@@ -1144,7 +1148,160 @@ def _transformer_bench(on_tpu, device):
         m_in = flops_util.mfu(step_flops, inner, dt_in, device)
         if m_in is not None:
             out["inner_loop"]["mfu"] = round(m_in, 4)
+    if remat_budget:
+        # peak-HBM-estimate attribution for the remat leg
+        out["remat"] = dict(getattr(main, "_remat_report", {}) or {})
+    if os.environ.get("BENCH_AUTOTUNE", "0") == "1":
+        out["autotune"] = _transformer_autotune_leg(
+            HP, seq, batch, steps, on_tpu, device, remat_budget)
     return out
+
+
+def _build_tfm_leg(hp, seq, bf16, budget):
+    """Build the transformer leg under an HBM budget flag, restoring
+    the PRIOR flag value (a user-set FLAGS_hbm_budget_bytes survives)."""
+    from paddle_tpu import flags as _flags
+    from paddle_tpu.models import transformer as tfm
+
+    prior = _flags.get_flag("hbm_budget_bytes")
+    _flags.set_flags({"hbm_budget_bytes": int(budget)})
+    try:
+        return tfm.wmt_transformer_program(
+            hp, src_len=seq, trg_len=seq, use_bf16=bf16)
+    finally:
+        _flags.set_flags({"hbm_budget_bytes": prior})
+
+
+def _transformer_autotune_leg(LegHP, seq, batch, steps, on_tpu, device,
+                              remat_budget):
+    """BENCH_AUTOTUNE=1: transpiler.autotune searches the program knob
+    space for a transformer leg (decision cached at
+    BENCH_PROGRAM_TUNE_CACHE / FLAGS_program_tune_cache), then the leg
+    A/Bs the all-defaults config against the tuned one on REAL feeds and
+    reports tuned-vs-default steps/s plus the steady-state retrace
+    count (the no-retrace contract: zero).
+
+    On CPU the A/B defaults to the LATENCY-REGIME transformer
+    (BENCH_AT_DMODEL=128, BENCH_AT_LAYERS=2, BENCH_AT_VOCAB=4000; same
+    batch/seq as the leg): the full transformer-base step on one CPU
+    core is OPTIMIZER-bound (adam over 60M params is ~1.7 GB of memory
+    traffic per 64-token step — no schedule knob can cut it; measured
+    tuned speedup there ~1.04x from the dispatch window alone), while
+    the latency regime is where the steps_per_dispatch knob is the
+    binding constraint.  On a chip the full-size leg is the default
+    (BENCH_AT_DMODEL=0): there use_pallas/AMP enter the search with MXU
+    timings."""
+    import jax
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu import flags as _flags
+    from paddle_tpu.models import transformer as tfm
+    from paddle_tpu.transpiler import autotune as at
+
+    cache_path = os.environ.get("BENCH_PROGRAM_TUNE_CACHE", "")
+    if cache_path:
+        _flags.set_flags({"program_tune_cache": cache_path})
+
+    at_dmodel = int(os.environ.get("BENCH_AT_DMODEL",
+                                   "0" if on_tpu else "128"))
+    if at_dmodel > 0:
+        n_layer = int(os.environ.get("BENCH_AT_LAYERS", "2"))
+        vocab = int(os.environ.get("BENCH_AT_VOCAB", "4000"))
+
+        class HP(LegHP):
+            d_model = at_dmodel
+            d_inner_hid = 4 * at_dmodel
+            n_head = max(1, at_dmodel // 32)
+            src_vocab_size = vocab
+            trg_vocab_size = vocab
+
+        # set outside the body: `n_layer = n_layer` in a class block
+        # resolves the RHS via LOAD_NAME (no closure), not the enclosing
+        # function local
+        HP.n_layer = n_layer
+    else:
+        HP = LegHP
+
+    def rebuild(decision):
+        m, s, _f, fl = _build_tfm_leg(
+            HP, seq, bool(decision.get("bf16_amp")),
+            1 if decision.get("remat") else remat_budget)
+        return m, s, fl
+
+    main, startup, feeds, fetches = _build_tfm_leg(
+        HP, seq, False, remat_budget)
+    batch_np = tfm.make_fake_batch(batch, seq, seq, HP, seed=0)
+    spec = {k: (tuple(v.shape), str(v.dtype)) for k, v in batch_np.items()}
+    t0 = time.time()
+    decision = at.tune(main, spec, startup=startup, fetches=fetches,
+                       rebuild=rebuild, max_trials=8, steps=2, warmup=1)
+    tune_s = time.time() - t0
+
+    def measure(dec):
+        """steps/s of a decision on the leg's REAL feeds, plus the
+        steady-state retrace count across the timed phase."""
+        m, s, fl = (rebuild(dec) if (dec.get("bf16_amp")
+                                     or dec.get("remat")) else
+                    (main, startup, fetches))
+        saved = {k: _flags.get_flag(k) for k in ("prng_impl", "use_pallas")}
+        _flags.set_flags(at.tuned_flags(dec))
+        try:
+            scope = fluid.Scope()
+            with fluid.scope_guard(scope):
+                exe = fluid.Executor(
+                    fluid.TPUPlace(0) if on_tpu else fluid.CPUPlace())
+                s.random_seed = 99
+                exe.run(s)
+                feed = {k: jax.device_put(v, device)
+                        for k, v in batch_np.items()}
+                window = int(dec.get("steps_per_dispatch", 1) or 1)
+                n_win = max(1, steps // window)
+                if window > 1:
+                    o = exe.run_loop(window, m, feed=feed, fetch_list=fl,
+                                     return_numpy=False)
+                    jax.block_until_ready(o)
+                    compiles0 = (exe.compile_count,
+                                 len(getattr(exe, "_loop_cache", {}) or {}))
+                    t0 = time.time()
+                    for _ in range(n_win):
+                        o = exe.run_loop(window, m, feed=feed,
+                                         fetch_list=fl, return_numpy=False)
+                    jax.block_until_ready(o)
+                    dt = time.time() - t0
+                    compiles1 = (exe.compile_count,
+                                 len(getattr(exe, "_loop_cache", {}) or {}))
+                    retraces = (compiles1[0] - compiles0[0]) + (
+                        compiles1[1] - compiles0[1])
+                    return n_win * window / dt, retraces
+                for _ in range(2):
+                    o = exe.run(m, feed=feed, fetch_list=fl,
+                                return_numpy=False)
+                jax.block_until_ready(o)
+                compiles0 = exe.compile_count
+                t0 = time.time()
+                for _ in range(steps):
+                    o = exe.run(m, feed=feed, fetch_list=fl,
+                                return_numpy=False)
+                jax.block_until_ready(o)
+                dt = time.time() - t0
+                return steps / dt, exe.compile_count - compiles0
+        finally:
+            _flags.set_flags(saved)
+
+    default_sps, default_retraces = measure(dict(at.DEFAULT_DECISION))
+    tuned_sps, tuned_retraces = measure(dict(decision))
+    return {
+        "decision": {k: v for k, v in decision.items() if v not in
+                     (None, False, 0, "threefry") or k == "prng_impl"},
+        "default_steps_per_s": round(default_sps, 3),
+        "tuned_steps_per_s": round(tuned_sps, 3),
+        "speedup": round(tuned_sps / max(default_sps, 1e-9), 3),
+        "retraces_steady_state": int(tuned_retraces),
+        "default_retraces_steady_state": int(default_retraces),
+        "tune_seconds": round(tune_s, 1),
+        "cache": at.cache_stats()["stats"],
+    }
 
 
 def _run_child(env, timeout):

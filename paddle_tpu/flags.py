@@ -262,6 +262,32 @@ DEFINE_flag("kernel_autotune", True,
             "heuristic default and never search (the CI regime, with a "
             "pinned FLAGS_kernel_tune_cache).  Interpret-mode (CPU) runs "
             "never search regardless — their timings are meaningless")
+DEFINE_flag("hbm_budget_bytes", 0,
+            "peak-activation HBM budget (bytes) for the rematerialization "
+            "pass (transpiler.remat): model builders partition the forward "
+            "program into checkpoint segments at detected layer boundaries "
+            "and greedily mark segments for recompute (jax.checkpoint) "
+            "until the traced fwd+bwd peak-activation estimate "
+            "(utils.memory_analysis) fits the budget.  Marked segments "
+            "recompute the SAME ops in backward, so losses are "
+            "bit-identical to the unremat program.  0 disables the pass "
+            "(the builders' hp.recompute knob still remats every layer "
+            "unconditionally)")
+DEFINE_flag("program_tune_cache", "",
+            "path of the persisted per-(program-signature, shape-bucket, "
+            "device kind) PROGRAM knob decision cache consulted by "
+            "transpiler.autotune.tune(): searched decisions (AMP on/off, "
+            "remat segments, prng impl, steps-per-dispatch window) are "
+            "written back atomically so later processes apply the tuned "
+            "configuration without re-searching — same bucketing "
+            "discipline as FLAGS_kernel_tune_cache.  Empty = in-memory "
+            "only for this process")
+DEFINE_flag("program_autotune", True,
+            "allow transpiler.autotune.tune() to SEARCH (clone the "
+            "program per candidate knob setting, jit, and time synthetic "
+            "steps) on a decision-cache miss.  0 = consult-only: misses "
+            "return the all-defaults decision and never time anything "
+            "(the CI regime, with a pinned FLAGS_program_tune_cache)")
 DEFINE_flag("prng_impl", "threefry",
             "JAX PRNG for in-program randomness (dropout, *_random, "
             "sampling): 'threefry' (default; splittable counter stream, "

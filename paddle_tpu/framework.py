@@ -515,15 +515,7 @@ class Program:
         target_names = set(
             t.name if isinstance(t, Variable) else t for t in _as_list(targets)
         )
-        block = self.global_block()
-        needed = set(target_names)
-        keep = [False] * len(block.ops)
-        for i in range(len(block.ops) - 1, -1, -1):
-            op = block.ops[i]
-            if any(n in needed for n in op.output_arg_names()):
-                keep[i] = True
-                needed.update(op.input_arg_names())
-                # keep sub-blocks reachable
+        keep = backward_slice_keep(self, target_names)
         p = self.clone()
         pb = p.global_block()
         pb.ops = [op for i, op in enumerate(pb.ops) if keep[i]]
@@ -588,6 +580,30 @@ class Program:
             for op in b.ops:
                 lines.append("  " + str(op))
         return "\n".join(lines)
+
+
+def backward_slice_keep(program, target_names):
+    """Keep-mask of the global block's ancestor ops of `target_names`
+    (prune.cc's reverse walk) — THE shared slicer behind
+    ``Program._prune`` and the inference transpiler's fetch-cut.  An op
+    owning sub-blocks (while / cond / recompute) counts its sub-blocks'
+    external reads as inputs, so a kept control-flow op keeps its
+    producers."""
+    from .core.trace import op_sub_blocks, sub_block_external_reads
+
+    block = program.global_block()
+    needed = set(target_names)
+    keep = [False] * len(block.ops)
+    for i in range(len(block.ops) - 1, -1, -1):
+        op = block.ops[i]
+        if any(n in needed for n in op.output_arg_names()):
+            keep[i] = True
+            needed.update(op.input_arg_names())
+            for sub_idx in op_sub_blocks(op):
+                bound = op.attrs.get("__bound_names__", ())
+                needed.update(sub_block_external_reads(
+                    program, program.block(sub_idx), bound))
+    return keep
 
 
 # ---------------------------------------------------------------------------

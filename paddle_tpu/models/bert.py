@@ -153,6 +153,10 @@ def bert_pretrain_program(hp=BertConfig, seq_len=128, lr=1e-4, is_test=False,
         apply_pass(main, "matmul_epilogue_fuse_pass")
         if use_bf16:
             apply_pass(main, "bf16_amp_pass")
+        # HBM-budgeted remat (FLAGS_hbm_budget_bytes; no-op when unset)
+        from ..transpiler.remat import maybe_remat
+
+        maybe_remat(main, total, is_test)
         if not is_test:
             fluid.optimizer.Adam(learning_rate=lr).minimize(total)
 

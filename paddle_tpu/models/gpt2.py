@@ -191,6 +191,10 @@ def gpt2_lm_program(hp=GPT2Config, seq_len=128, lr=3e-4, is_test=False,
         apply_pass(main, "matmul_epilogue_fuse_pass")
         if use_bf16:
             apply_pass(main, "bf16_amp_pass")
+        # HBM-budgeted remat (FLAGS_hbm_budget_bytes; no-op when unset)
+        from ..transpiler.remat import maybe_remat
+
+        maybe_remat(main, loss, is_test)
         if not is_test:
             fluid.optimizer.Adam(learning_rate=lr).minimize(loss)
 
@@ -309,6 +313,12 @@ def gpt2_decode_step_program(hp=GPT2Config, batch=1, t_max=None, width=1,
             logits = layers.reshape(logits, shape=[batch, hp.vocab_size])
         feeds = ["step_ids", "pos"] + (["pos_vec"] if pos_vec is not None
                                        else [])
+        # PR 11 closed-gap: the matmul-epilogue fuse bundle now rewrites
+        # DECODE programs too (fc bias+act, SwiGLU diamonds, residual-LN
+        # pairs -> the fused ops / pallas kernels).  Row-independent
+        # kernels keep the serving exactness contract intact; the fetch
+        # is protected so no fuse can fold it away.
+        _apply_decode_epilogue_passes(main, logits)
     return main, cache_startup, feeds, [logits], cache_names
 
 
@@ -399,8 +409,24 @@ def gpt2_ragged_step_program(hp=GPT2Config, batch=4, t_max=None, width=8,
             x = _block(x, hp, is_test=True, cache=cache)
         x = layers.layer_norm(x, begin_norm_axis=2)
         logits = _tied_logits(x, hp, emb_attr.name)
+        # the continuous-batching step gets the same matmul-epilogue
+        # bundle as the classic decode step (PR 11's "training programs
+        # only" limit closed); per-row kernels preserve pooled == solo
+        _apply_decode_epilogue_passes(main, logits)
     feeds = ["step_ids", "pos_rows", "width_rows", "pos_mat"]
     return main, cache_startup, feeds, [logits], cache_names
+
+
+def _apply_decode_epilogue_passes(main, logits):
+    """Apply the matmul-epilogue fuse bundle to a decode/serving step
+    program, protecting the logits fetch (a fuse deletes every
+    intermediate of its chain; the fetch must survive)."""
+    from ..transpiler.pass_registry import apply_pass
+
+    prev = tuple(getattr(main, "_protected_fetch_names", ()) or ())
+    main._protected_fetch_names = tuple(
+        dict.fromkeys(prev + (logits.name,)))
+    apply_pass(main, "matmul_epilogue_fuse_pass")
 
 
 def _prefill_cached(exe, step_main, fetches, ids):

@@ -139,6 +139,11 @@ def build_resnet_train_program(
             from paddle_tpu.transpiler.pass_registry import apply_pass
 
             apply_pass(main, "bf16_amp_pass")
+        # HBM-budgeted remat: resnet stage boundaries detected from the
+        # op graph (FLAGS_hbm_budget_bytes; no-op when unset)
+        from paddle_tpu.transpiler.remat import maybe_remat
+
+        maybe_remat(main, avg_cost)
         if optimizer == "momentum":
             opt = fluid.optimizer.Momentum(learning_rate=lr, momentum=0.9)
         else:

@@ -493,6 +493,12 @@ def wmt_transformer_program(hp=ModelHyperParams, src_len=64, trg_len=64, learnin
             # AMP rides the pass registry (bf16 MXU compute, f32 master
             # params — the optimizer state and param vars stay f32)
             apply_pass(main, "bf16_amp_pass")
+        # HBM-budgeted rematerialization (FLAGS_hbm_budget_bytes): after
+        # the fuse/AMP rewrites (segments carry the final op mix), before
+        # minimize (grads differentiate through the recompute ops)
+        from ..transpiler.remat import maybe_remat
+
+        maybe_remat(main, avg_cost, is_test)
         if not is_test:
             lr = layers.learning_rate_scheduler.noam_decay(hp.d_model, warmup_steps)
             lr = layers.scale(lr, scale=float(learning_rate))

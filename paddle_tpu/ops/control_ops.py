@@ -360,17 +360,32 @@ def _recompute(ctx, ins, attrs):
 
     attrs: sub_block_idx, in_names (sub-block names for the X inputs, in
     order — also __bound_names__ for the read analysis), out_names
-    (sub-block names emitted as Out)."""
+    (sub-block names emitted as Out), optional policy (a
+    jax.checkpoint_policies name, e.g. "dots_saveable" /
+    "dots_with_no_batch_dims_saveable" — the remat transpiler's
+    save-the-matmuls middle ground; default saves nothing)."""
     sub = attrs["sub_block_idx"]
     in_names = list(attrs["in_names"])
     out_names = list(attrs["out_names"])
     vals = list(ins["X"])
 
-    @jax.checkpoint
+    policy = None
+    pname = attrs.get("policy")
+    if pname:
+        import jax.ad_checkpoint as adck
+
+        policy = getattr(adck.checkpoint_policies, str(pname), None)
+        if policy is None:
+            raise ValueError(
+                "recompute op: unknown jax.checkpoint policy %r (see "
+                "jax.ad_checkpoint.checkpoint_policies)" % (pname,))
+
     def run(*args):
         env = dict(zip(in_names, args))
         env = ctx.trace_block(sub, env)
         return tuple(env[n] for n in out_names)
 
+    run = (jax.checkpoint(run, policy=policy) if policy is not None
+           else jax.checkpoint(run))
     outs = run(*vals)
     return {"Out": list(outs)}

@@ -26,8 +26,6 @@ an MFU regression can be pinned to "kernel X stopped dispatching" or
 compiled step contains, not how often it runs.
 """
 
-import json
-import os
 import threading
 import time
 
@@ -103,75 +101,31 @@ def _key(kernel, shapes, dtype):
                      _device_kind()])
 
 
+def _entry_valid(v):
+    return isinstance(v.get("params"), dict)
+
+
 def _load_locked():
     global _cache, _cache_path
+    from ..utils.tune_cache import load_entries
+
     path = str(_flag("kernel_tune_cache") or "")
     if _cache is not None and path == _cache_path:
         return
-    _cache, _cache_path = {}, path
-    if path and os.path.exists(path):
-        try:
-            with open(path) as f:
-                raw = json.load(f)
-            entries = raw.get("entries", raw)
-            if isinstance(entries, dict):
-                _cache = {
-                    k: v for k, v in entries.items()
-                    if isinstance(v, dict) and isinstance(
-                        v.get("params"), dict)
-                }
-        except (OSError, ValueError) as e:
-            import sys
-
-            sys.stderr.write(
-                "WARNING: kernel tuning cache %s unreadable (%r); "
-                "starting empty\n" % (path, e))
+    _cache_path = path
+    _cache = load_entries(path, _entry_valid, "kernel tuning cache")
 
 
 def _save_locked():
-    if not _cache_path:
-        return
-    tmp = _cache_path + ".tmp.%d" % os.getpid()
-    try:
-        d = os.path.dirname(_cache_path)
-        if d:
-            os.makedirs(d, exist_ok=True)
-        # persist SEARCHED decisions only: a seeded default (including
-        # one left behind by a search whose candidates all failed) must
-        # stay process-local so the next process re-searches once the
-        # transient failure clears; a pinned cache may still SHIP
-        # seeded entries (they load fine), it just never gains them.
-        # MERGE with what's on disk first: concurrent processes sharing
-        # one cache path each search different kernels — a blind
-        # rewrite of this process's view would drop the other's
-        # searched entries (last writer wins); our keys still override.
-        merged = {}
-        if os.path.exists(_cache_path):
-            try:
-                with open(_cache_path) as f:
-                    raw = json.load(f)
-                entries = raw.get("entries", raw)
-                if isinstance(entries, dict):
-                    merged = {
-                        k: v for k, v in entries.items()
-                        if isinstance(v, dict)
-                        and isinstance(v.get("params"), dict)
-                        and v.get("searched")
-                    }
-            except (OSError, ValueError):
-                pass  # unreadable disk state loses to our fresh view
-        merged.update({k: v for k, v in _cache.items()
-                       if v.get("searched")})
-        with open(tmp, "w") as f:
-            json.dump({"version": 1, "entries": merged},
-                      f, indent=1, sort_keys=True)
-        os.replace(tmp, _cache_path)
-    except OSError as e:
-        import sys
+    # searched decisions only, merged with concurrent writers' searched
+    # entries, atomic replace — the shared utils.tune_cache discipline
+    # (a seeded default, including one left behind by a failed search,
+    # stays process-local so the next process re-searches; a pinned CI
+    # cache never gains entries)
+    from ..utils.tune_cache import save_entries
 
-        sys.stderr.write(
-            "WARNING: kernel tuning cache %s not persisted (%r)\n"
-            % (_cache_path, e))
+    save_entries(_cache_path, _cache, _entry_valid,
+                 "kernel tuning cache")
 
 
 def _search_allowed(measure):

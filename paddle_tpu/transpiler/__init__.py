@@ -10,6 +10,9 @@ from .memory_optimization_transpiler import memory_optimize, release_memory
 from .inference_transpiler import InferenceTranspiler
 from .layout_transpiler import rewrite_nhwc
 from . import fuse_passes  # noqa: F401  (registers the fusion-pass suite)
+from . import remat  # noqa: F401  (registers remat_pass)
+from .remat import detect_segments, remat_program
+from .autotune import tune as autotune_program
 from .pass_registry import (
     OpPattern,
     Pass,
@@ -29,6 +32,9 @@ __all__ = [
     "memory_optimize",
     "release_memory",
     "InferenceTranspiler",
+    "detect_segments",
+    "remat_program",
+    "autotune_program",
     "OpPattern",
     "Pass",
     "apply_pass",

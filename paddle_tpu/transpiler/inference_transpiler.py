@@ -1,25 +1,81 @@
 """Inference-time program rewrites
-(transpiler/inference_transpiler.py:24 analog).
+(transpiler/inference_transpiler.py:24 analog), grown into a registry
+pass PIPELINE (ROADMAP item 2a).
 
-The reference folds conv+bn / conv+relu at the Python program level before
-handing to the executor.  XLA already fuses elementwise chains into the
-conv, so the transforms that still pay here are the *algebraic* ones:
+The reference folds conv+bn / conv+relu at the Python program level
+before handing to the executor.  XLA already fuses elementwise chains
+into the conv, so the transforms that still pay here are the
+*algebraic* and *structural* ones, each registered as its own pass with
+a numerical-parity contract:
 
-* fold batch_norm (inference form) into a preceding conv2d / fc / mul by
-  rewriting the weights and bias in the scope (:70-300 analog);
-* drop dropout ops (is_test identity) and other train-only ops.
+* ``bn_fold_pass`` — fold batch_norm (inference form) into the
+  preceding conv2d / depthwise_conv2d / fc / mul by rewriting the
+  weights and bias in the scope, looking through an optional bias-add
+  and an optional pure ``scale`` link (the BN/scale chain); output
+  matches the unfused program at rtol 1e-5 and drops >= 1 op per folded
+  BN (:70-300 analog).
+* ``train_prune_pass`` — drop train-only ops: dropout rewrites to its
+  is_test identity/scale form, and with a fetch cut
+  (``program._protected_fetch_names``) everything below it — label
+  slots, loss heads, metric accumulators — is sliced away; the kept
+  fetches are value-identical.
+* ``weight_int8_pass`` — weight-only int8 stamping
+  (contrib.quantize.quantize_weights_int8, the serving engine's path,
+  generalized): ANY program's mul/matmul/conv/embedding weights become
+  int8+scale pairs dequantized at compute time.
+
+``InferenceTranspiler.transpile`` runs the pipeline in that order; the
+sub-passes are individually addressable through
+``transpiler.apply_pass`` for custom pipelines.
 """
 
 import numpy as np
 
+# handlers for BN folding: op type -> (weight input slot, output slot,
+# how a per-channel scale vector reshapes onto the weight)
+_BN_FOLD_PRODUCERS = {
+    "conv2d": ("Filter", "Output",
+               lambda s, w: s.reshape((-1,) + (1,) * (w.ndim - 1))),
+    "depthwise_conv2d": ("Filter", "Output",
+                         lambda s, w: s.reshape((-1,) + (1,) * (w.ndim - 1))),
+    # fc / mul: weight is [D_in, C_out] — the channel axis is LAST
+    "fc": ("W", "Out", lambda s, w: s.reshape(1, -1)),
+    "mul": ("Y", "Out", lambda s, w: s.reshape(1, -1)),
+}
+
 
 class InferenceTranspiler:
-    def transpile(self, program, place=None, scope=None):
+    def transpile(self, program, place=None, scope=None, fetches=None,
+                  quantize_int8=False, int8_min_elems=1024):
+        """Run the inference pass pipeline in place and return the
+        program.
+
+        fetches: optional fetch-target names (or Variables) defining the
+        inference cut — ops below it (loss heads, label slots) are
+        pruned; also recorded as ``_protected_fetch_names`` so later
+        passes never fold a fetched value away.
+        quantize_int8: finish with the weight-only int8 stamp."""
         from ..executor import global_scope
 
         scope = scope if scope is not None else global_scope()
+        if fetches:
+            names = tuple(
+                f.name if hasattr(f, "name") else str(f) for f in fetches)
+            existing = tuple(
+                getattr(program, "_protected_fetch_names", ()) or ())
+            program._protected_fetch_names = tuple(
+                dict.fromkeys(existing + names))
+            # prune FIRST: on a cloned train program the backward ops
+            # still consume every forward intermediate, which would make
+            # the BN fold's single-consumer checks refuse everything
+            self._prune_to_fetches(program)
         self._fold_batch_norm(program, scope)
         self._drop_train_ops(program)
+        if quantize_int8:
+            from ..contrib.quantize import quantize_weights_int8
+
+            quantize_weights_int8(program, scope=scope,
+                                  min_elems=int8_min_elems)
         program._is_test = True
         program._bump_version()
         return program
@@ -75,6 +131,29 @@ class InferenceTranspiler:
         block.ops = new_ops
 
     # ------------------------------------------------------------------
+    def _prune_to_fetches(self, program):
+        """Slice the global block to the ancestor ops of the protected
+        fetch names: the inference cut.  Label-slot processing, loss
+        heads and metric ops below the cut disappear; unlike executor
+        DCE this is a PROGRAM rewrite, so the saved/served artifact
+        itself shrinks.  The slice is ``framework.backward_slice_keep``
+        — the same walk behind ``Program._prune``, sub-block reads
+        included."""
+        from ..framework import backward_slice_keep
+
+        targets = set(
+            getattr(program, "_protected_fetch_names", ()) or ())
+        if not targets:
+            return 0
+        block = program.global_block()
+        keep = backward_slice_keep(program, targets)
+        dropped = sum(1 for k in keep if not k)
+        if dropped:
+            block.ops = [op for i, op in enumerate(block.ops) if keep[i]]
+            program._bump_version()
+        return dropped
+
+    # ------------------------------------------------------------------
     def _producer_map(self, block):
         prod = {}
         for i, op in enumerate(block.ops):
@@ -90,31 +169,77 @@ class InferenceTranspiler:
         return cnt
 
     def _fold_batch_norm(self, program, scope):
-        """conv2d (no act) -> batch_norm  ==>  conv2d with W' = W*g/std,
-        b' = (b-mean)*g/std + beta."""
+        """producer (+ bias add) (+ pure scale) -> batch_norm  ==>
+        producer with W' = W*s*g/std, b' = (b*s - mean)*g/std + beta.
+
+        Producers: conv2d / depthwise_conv2d (per-out-channel, axis 0),
+        fc / mul (per-out-column, last axis).  A trailing relu (the
+        conv+BN+relu trunk form) is untouched by the fold and then
+        eligible for conv_eltadd_relu/fuse_relu_into_conv.  The scale
+        link must be a pure multiply (bias == 0).  Default CLOSED: any
+        missing scope value, non-single-consumer link or unknown
+        producer leaves the chain alone."""
         block = program.global_block()
         prod = self._producer_map(block)
         consumers = self._consumer_count(block)
+        protected = set(
+            getattr(program, "_protected_fetch_names", ()) or ())
         drop = set()
 
         for i, op in enumerate(block.ops):
             if op.type != "batch_norm":
                 continue
+            # inference-form BN only: a train-mode BN normalizes by
+            # BATCH statistics (and updates the moving stats) — folding
+            # the moving stats into the weights would silently change
+            # the math.  clone(for_test=True) flips the attr.
+            if not (op.attrs.get("is_test", False)
+                    or getattr(program, "_is_test", False)):
+                continue
             x = op.inputs["X"][0]
             if consumers.get(x, 0) != 1 or x not in prod:
                 continue
-            conv_idx = prod[x]
-            conv = block.ops[conv_idx]
+            if x in protected:
+                continue  # the fold deletes this name's definition
+            cur = block.ops[prod[x]]
+            s_factor = 1.0
+            scale_op_idx = None
+            if cur.type == "scale":
+                # pure-scale link only: a bias would shift the BN input
+                if float(cur.attrs.get("bias", 0.0)) != 0.0:
+                    continue
+                sx = cur.inputs["X"][0]
+                if consumers.get(sx, 0) != 1 or sx not in prod:
+                    continue
+                if sx in protected:
+                    continue  # its definition is rewired away below
+                s_factor = float(cur.attrs.get("scale", 1.0))
+                scale_op_idx = prod[x]
+                cur = block.ops[prod[sx]]
             bias_add = None
-            if conv.type == "elementwise_add":
-                # conv2d -> elementwise_add(bias) -> batch_norm chain (the
+            if cur.type == "elementwise_add":
+                # producer -> elementwise_add(bias) -> [scale ->] bn (the
                 # layer helper emits bias as a separate op)
-                ax = conv.inputs["X"][0]
+                ax = cur.inputs["X"][0]
                 if consumers.get(ax, 0) != 1 or ax not in prod:
                     continue
-                bias_add = conv
-                conv = block.ops[prod[ax]]
-            if conv.type not in ("conv2d", "depthwise_conv2d"):
+                if ax in protected:
+                    continue  # its definition is rewired away below
+                bias_add = cur
+                cur = block.ops[prod[ax]]
+            handler = _BN_FOLD_PRODUCERS.get(cur.type)
+            if handler is None:
+                continue
+            w_slot, out_slot, reshape_scale = handler
+            if cur.type == "fc" and cur.attrs.get("activation_type"):
+                continue  # BN(act(xW+b)) has no affine fold
+            if cur.type == "mul" and int(
+                    cur.attrs.get("y_num_col_dims", 1)) != 1:
+                continue
+            if cur.type == "mul" and bias_add is None:
+                # a bare mul has no Bias slot and no bias add to absorb
+                # the shift — leave it (fc_fuse_pass normalizes the
+                # common chains to fc, which folds)
                 continue
 
             def val(slot):
@@ -128,35 +253,64 @@ class InferenceTranspiler:
             eps = float(op.attrs.get("epsilon", 1e-5))
             std = np.sqrt(var + eps)
 
-            wname = conv.inputs["Filter"][0]
+            wname = cur.inputs[w_slot][0]
             wvar = scope.find_var(wname)
             if wvar is None:
                 continue
             w = np.array(wvar, dtype=np.float32)
-            scope.set(wname, w * (gamma / std).reshape(-1, 1, 1, 1))
+            n_ch = (w.shape[0] if cur.type.endswith("conv2d")
+                    else w.shape[-1])
+            if gamma.shape != (n_ch,):
+                continue  # channel mismatch: leave the defined chain alone
 
-            # fold the affine shift into the bias
+            # resolve the bias BEFORE any scope mutation: every abort
+            # below this point would otherwise leave a half-folded chain
+            # (weight rewritten, BN still in the program) that computes
+            # silently wrong outputs — the default-CLOSED contract means
+            # ALL-or-nothing
+            new_bias_name = None
+            if bias_add is not None and cur.inputs.get("Bias"):
+                # TWO biases (the producer's own Bias slot plus a
+                # separate add): folding only the add's operand would
+                # leave the producer bias unscaled — refuse rather than
+                # compute a silently wrong chain
+                continue
             if bias_add is not None:
                 bname = bias_add.inputs["Y"][0]
-                b = np.array(scope.find_var(bname), dtype=np.float32).reshape(-1)
-            elif conv.inputs.get("Bias"):
-                bname = conv.inputs["Bias"][0]
-                b = np.array(scope.find_var(bname), dtype=np.float32)
+                bv = scope.find_var(bname)
+                if bv is None:
+                    continue
+                b = np.array(bv, dtype=np.float32).reshape(-1)
+            elif cur.inputs.get("Bias"):
+                bname = cur.inputs["Bias"][0]
+                bv = scope.find_var(bname)
+                if bv is None:
+                    continue
+                b = np.array(bv, dtype=np.float32)
             else:
-                bname = wname + "@BN_FOLDED_BIAS"
+                bname = new_bias_name = wname + "@BN_FOLDED_BIAS"
+                b = np.zeros(n_ch, dtype=np.float32)
+
+            # all preconditions hold: mutate weight + bias together
+            scope.set(wname, w * reshape_scale(
+                np.asarray(s_factor * gamma / std, np.float32), w))
+            if new_bias_name is not None:
                 block.create_var(
-                    name=bname, shape=[int(w.shape[0])], dtype="float32",
-                    persistable=True,
+                    name=new_bias_name, shape=[int(n_ch)],
+                    dtype="float32", persistable=True,
                 )
-                b = np.zeros(w.shape[0], dtype=np.float32)
-                conv.inputs["Bias"] = [bname]
-            scope.set(bname, (b - mean) * gamma / std + beta)
+                cur.inputs["Bias"] = [new_bias_name]
+            scope.set(bname, (b * s_factor - mean) * gamma / std + beta)
 
             # the op feeding bn now writes the bn output name directly
-            tail = bias_add if bias_add is not None else conv
-            out_slot = "Out" if tail.type == "elementwise_add" else "Output"
-            tail.outputs[out_slot] = [op.outputs["Y"][0]]
+            tail = bias_add if bias_add is not None else cur
+            t_slot = ("Out" if tail.type in ("elementwise_add", "fc", "mul")
+                      else out_slot)
+            tail.outputs[t_slot] = [op.outputs["Y"][0]]
             drop.add(i)
+            if scale_op_idx is not None:
+                drop.add(scale_op_idx)
 
         if drop:
             block.ops = [op for j, op in enumerate(block.ops) if j not in drop]
+            program._bump_version()

@@ -41,9 +41,16 @@ def _numel(shape):
 
 
 class ControlFlowGraph:
-    """Def/use + liveness over one block's op list."""
+    """Def/use + liveness over one block's op list.
+
+    An op owning sub-blocks (while / cond / recompute / switch) USES
+    everything its sub-blocks read from the outer scope: a var consumed
+    only inside a nested block must stay live until that op runs, or the
+    reuse plan would alias storage a loop body still reads."""
 
     def __init__(self, program, block_idx=0):
+        from ..core.trace import op_sub_blocks, sub_block_external_reads
+
         self.program = program
         self.block = program.block(block_idx)
         self.ops = self.block.ops
@@ -51,7 +58,12 @@ class ControlFlowGraph:
         self.uses = []
         for op in self.ops:
             self.defs.append(set(op.output_arg_names()))
-            self.uses.append(set(op.input_arg_names()))
+            uses = set(op.input_arg_names())
+            for sub_idx in op_sub_blocks(op):
+                bound = op.attrs.get("__bound_names__", ())
+                uses.update(sub_block_external_reads(
+                    program, program.block(sub_idx), bound))
+            self.uses.append(uses)
 
     def live_ranges(self):
         """var -> (first def idx, last use idx)."""
@@ -97,11 +109,26 @@ def memory_optimize(input_program, skip_opt_set=None, print_log=False, level=0):
             return False
         return True
 
+    def var_key(name):
+        """(dtype, shape) aliasing identity.  The seed-era pool matched
+        on BYTES alone, which let an int64 buffer alias a float32 one of
+        equal numel (garbage bits reinterpreted) or a [4, 8] alias a
+        [32] (any consumer relying on layout/strides breaks): aliasing
+        is only sound between identically-typed, identically-shaped
+        slots.  Refused candidates are counted loudly in the plan."""
+        v = block._find_var_recursive(name)
+        if v is None:
+            return None
+        return (str(v.dtype),
+                tuple(int(d) for d in (v.shape or ())))
+
     # greedy first-fit reuse over a free pool, walking ops in order —
-    # the reference's cache-pool algorithm (memory_optimize :456)
+    # the reference's cache-pool algorithm (memory_optimize :456), but
+    # keyed (dtype, shape), never numel
     reuse = {}
     saved = 0
-    free_pool = []  # (name, bytes) dead vars
+    refused_mismatch = 0
+    free_pool = []  # (name, bytes, (dtype, shape)) dead vars
     deaths = {}
     for name, (d, u) in ranges.items():
         deaths.setdefault(u, []).append(name)
@@ -112,24 +139,47 @@ def memory_optimize(input_program, skip_opt_set=None, print_log=False, level=0):
             nbytes, v = _var_bytes(block, name)
             if nbytes == 0:
                 continue
-            for j, (cand, cbytes) in enumerate(free_pool):
-                if cbytes >= nbytes:
+            key = var_key(name)
+            matched = False
+            for j, (cand, cbytes, ckey) in enumerate(free_pool):
+                if ckey == key and key is not None:
                     reuse[name] = cand
                     saved += nbytes
                     free_pool.pop(j)
+                    matched = True
                     break
+            if not matched and any(
+                    cbytes >= nbytes and ckey != key
+                    for _, cbytes, ckey in free_pool):
+                # a seed-era bytes-only match existed: count the refusal
+                refused_mismatch += 1
         for name in deaths.get(i, []):
             if reusable(name) and name not in reuse:
                 nbytes, _ = _var_bytes(block, name)
                 if nbytes:
-                    free_pool.append((name, nbytes))
+                    free_pool.append((name, nbytes, var_key(name)))
+
+    # defense in depth: no plan may ever pair mismatched vars
+    for name, cand in reuse.items():
+        if var_key(name) != var_key(cand):  # pragma: no cover
+            raise AssertionError(
+                "memory_optimize produced a cross-dtype/shape alias "
+                "%r -> %r (%s vs %s)" % (name, cand, var_key(name),
+                                         var_key(cand)))
 
     donate = sorted(
         n
         for n, (d, u) in ranges.items()
         if reusable(n) and u < len(cfg.ops) - 1 and n not in reuse
     )
-    plan = {"reuse": reuse, "saved_bytes": saved}
+    plan = {"reuse": reuse, "saved_bytes": saved,
+            "refused_mismatch": refused_mismatch}
+    if refused_mismatch and print_log:
+        print(
+            "memory_optimize: refused %d numel-compatible but "
+            "dtype/shape-mismatched alias candidates (aliasing is only "
+            "sound between identical slots)" % refused_mismatch
+        )
     input_program._memory_opt_plan = plan
     input_program._donate_vars = donate
     if print_log:

@@ -155,17 +155,11 @@ class OpPattern:
 # ---------------------------------------------------------------------------
 @register_pass("conv_bn_fuse_pass")
 def _conv_bn_fuse(program, scope):
-    from .inference_transpiler import InferenceTranspiler
-
-    if scope is None:
-        raise ValueError(
-            "conv_bn_fuse_pass folds BN statistics into conv weights and "
-            "needs the scope holding them: apply_pass(prog, "
-            "'conv_bn_fuse_pass', scope=...)"
-        )
-    t = InferenceTranspiler()
-    t._fold_batch_norm(program, scope)
-    return program
+    """Back-compat alias of bn_fold_pass (the fold long ago outgrew
+    conv: it now also takes fc/mul producers and scale chains) — one
+    implementation, two names, so a pipeline listing both cannot
+    diverge."""
+    return _bn_fold(program, scope)
 
 
 @register_pass("is_test_pass")
@@ -174,6 +168,56 @@ def _is_test(program, scope):
 
     t = InferenceTranspiler()
     t._drop_train_ops(program)
+    return program
+
+
+@register_pass("bn_fold_pass")
+def _bn_fold(program, scope):
+    """BN/scale-chain fold into conv2d / depthwise_conv2d / fc / mul
+    weights (the generalized inference-transpiler sub-pass; a trailing
+    relu is untouched and stays eligible for the conv fuse passes).
+    Parity contract: rtol 1e-5 vs the unfused program, >= 1 op dropped
+    per folded BN."""
+    from .inference_transpiler import InferenceTranspiler
+
+    if scope is None:
+        raise ValueError(
+            "bn_fold_pass folds BN statistics into producer weights and "
+            "needs the scope holding them: apply_pass(prog, "
+            "'bn_fold_pass', scope=...)")
+    InferenceTranspiler()._fold_batch_norm(program, scope)
+    return program
+
+
+@register_pass("train_prune_pass")
+def _train_prune(program, scope):
+    """Drop train-only ops (dropout -> is_test form) and, when the
+    program carries ``_protected_fetch_names``, slice away everything
+    below the inference cut — label slots, loss heads, metric ops.
+    Parity contract: protected fetches are value-identical."""
+    from .inference_transpiler import InferenceTranspiler
+
+    t = InferenceTranspiler()
+    t._drop_train_ops(program)
+    t._prune_to_fetches(program)
+    return program
+
+
+@register_pass("weight_int8_pass")
+def _weight_int8(program, scope):
+    """Weight-only int8 stamping for ANY program (the serving engine's
+    quantize_weights_int8 generalized into a registry pass): persistable
+    mul/matmul/conv/embedding weights become int8+scale pairs
+    dequantized at compute time, f32 originals dropped when dead.
+    Parity contract: the documented post-training-quant tolerance
+    (tests/test_quant_int8.py)."""
+    from ..contrib.quantize import quantize_weights_int8
+
+    if scope is None:
+        raise ValueError(
+            "weight_int8_pass rewrites weights in the scope: "
+            "apply_pass(prog, 'weight_int8_pass', scope=...)")
+    quantize_weights_int8(program, scope=scope)
     return program
 
 

@@ -1,0 +1,463 @@
+"""HBM-budgeted rematerialization pass (ROADMAP item 2b: the
+BuddyAllocator analog for XLA-land).
+
+``layers.recompute`` lets a model author mark a scope for activation
+recomputation at BUILD time; this pass makes the same trade a
+Program->Program decision: it detects layer boundaries in an
+already-built forward program from the op graph alone, partitions the
+program into checkpoint segments, and greedily marks segments for
+recompute until the ``utils.memory_analysis`` peak-activation estimate
+of the traced fwd+bwd fits an HBM budget
+(``FLAGS_hbm_budget_bytes``).  Marked segments become ``recompute`` ops
+(sub-block + ``jax.checkpoint`` lowering, ops/control_ops.py), so the
+backward pass recomputes the segment's EXACT ops — random ops keep
+their streams (moved ops are stamped with a ``seed`` attr reproducing
+their original op-position RNG fold), losses are bit-identical to the
+same partitioned program with checkpointing disabled
+(policy="everything_saveable": identical vjp, nothing recomputed), the
+forward pass is bit-identical to the unpartitioned original, and
+training trajectories agree with it to float-roundoff (the
+segment-level vjp may reassociate gradient fan-in sums by a ULP) —
+recompute changes scheduling, never math.
+
+Boundary detection: a layer boundary is a position in the op list where
+the crossing activation frontier — non-persistable, non-data values
+defined before and read at-or-after the position — hits a LOCAL minimum
+(the transformer/bert/gpt2 residual stream, a resnet stage's single
+activation; see ``detect_segments``).  Segments under ``min_ops`` merge
+into their neighbor.  This finds transformer blocks and resnet stages
+without model knowledge, which is what lets EVERY builder inherit the
+pass.
+
+Apply AFTER the fuse/AMP passes and BEFORE ``Optimizer.minimize``
+(grads must differentiate through the recompute ops); the builders do
+this when ``FLAGS_hbm_budget_bytes`` > 0.
+"""
+
+from .. import framework
+from ..core.trace import op_sub_blocks, sub_block_external_reads
+from .pass_registry import register_pass
+
+__all__ = [
+    "detect_segments",
+    "pin_rng_streams",
+    "remat_program",
+    "wrap_segment",
+]
+
+# op types a checkpoint segment must never swallow: host/IO boundaries,
+# control-flow whose sub-blocks carry their own env contract, and the
+# rpc layer (side-effecting sends have no recompute semantics)
+_UNWRAPPABLE = frozenset((
+    "feed", "fetch", "read", "create_py_reader", "listen_and_serv",
+    "while", "cond", "switch", "recompute",
+))
+
+
+def _op_reads(program, op):
+    """All names an op reads, including its sub-blocks' external reads."""
+    reads = list(op.input_arg_names())
+    for sub_idx in op_sub_blocks(op):
+        bound = op.attrs.get("__bound_names__", ())
+        reads.extend(sub_block_external_reads(
+            program, program.block(sub_idx), bound))
+    return reads
+
+
+def _is_activation(block, name):
+    v = block._find_var_recursive(name)
+    if v is None:
+        return False
+    return not v.persistable and not getattr(v, "is_data", False)
+
+
+def _activation_bytes(block, name, batch_hint):
+    import numpy as np
+
+    v = block._find_var_recursive(name)
+    if v is None or v.shape is None:
+        return 0
+    n = 1
+    for d in v.shape:
+        n *= batch_hint if int(d) < 0 else int(d)
+    dt = v.dtype or "float32"
+    try:
+        return n * np.dtype(str(dt)).itemsize
+    except TypeError:
+        return n * 2  # bfloat16
+
+
+def detect_segments(program, block_idx=0, min_ops=3):
+    """Partition the block's op list into layer-boundary segments.
+
+    A boundary is a position where the crossing activation frontier (#
+    of non-persistable, non-data values defined before and read at or
+    after the position) is a LOCAL minimum — residual-block seams sit at
+    narrow waists of the def-use graph, while long-lived mask/bias
+    intermediates only raise the floor uniformly (which is why a global
+    minimum rule fails: the floor differs between the encoder, decoder
+    and loss-head regions).  Plateaus of equal width cut once, at their
+    first position.  Segments shorter than min_ops merge into their
+    successor.  Returns a list of (start, end) index pairs."""
+    block = program.block(block_idx)
+    ops = block.ops
+    n = len(ops)
+    if n < 2 * min_ops:
+        return [(0, n)]
+
+    first_def = {}
+    last_use = {}
+    for i, op in enumerate(ops):
+        for name in _op_reads(program, op):
+            if name:
+                last_use[name] = i
+        for name in op.output_arg_names():
+            if name:
+                first_def.setdefault(name, i)
+                last_use[name] = max(last_use.get(name, i), i)
+
+    # frontier(p) = #names with first_def < p <= last_use, for p in
+    # 1..n-1 — one linear difference-array pass, not per-position scans
+    delta = [0] * (n + 2)
+    for name in first_def:
+        if not _is_activation(block, name):
+            continue
+        lo, hi = first_def[name] + 1, last_use[name]
+        if lo <= hi:
+            delta[lo] += 1
+            delta[hi + 1] -= 1
+    counts = []
+    acc = 0
+    for p in range(1, n):
+        acc += delta[p]
+        counts.append(acc)  # counts[i] = frontier at position i+1
+    if not counts:
+        return [(0, n)]
+
+    # plateau-aware local minima: a maximal run of equal counts is a
+    # boundary run when both neighbors are strictly higher; cut at the
+    # run's first position
+    cuts = []
+    i = 0
+    while i < len(counts):
+        j = i
+        while j + 1 < len(counts) and counts[j + 1] == counts[i]:
+            j += 1
+        left_higher = i == 0 or counts[i - 1] > counts[i]
+        right_higher = j == len(counts) - 1 or counts[j + 1] > counts[i]
+        if counts[i] > 0 and left_higher and right_higher and i > 0:
+            cuts.append(i + 1)  # position index
+        i = j + 1
+
+    merged = []
+    prev = 0
+    for p in cuts:
+        if p - prev >= min_ops:
+            merged.append(p)
+            prev = p
+    if merged and n - merged[-1] < min_ops:
+        merged.pop()
+    bounds = [0] + merged + [n]
+    return list(zip(bounds[:-1], bounds[1:]))
+
+
+def _wrappable(program, ops_seg):
+    from ..core.registry import OPS
+
+    block = program.global_block()
+    seg_set = set(id(op) for op in ops_seg)
+    defined = set()
+    for op in ops_seg:
+        if op.type in _UNWRAPPABLE:
+            return False
+        opdef = OPS.get(op.type)
+        if opdef is not None and getattr(opdef, "side_effect", False):
+            return False
+        for name in op.output_arg_names():
+            v = block._find_var_recursive(name)
+            if v is not None and v.persistable:
+                # stateful updates cannot cross a remat boundary
+                # (layers.recompute enforces the same contract)
+                return False
+            defined.add(name)
+    # non-SSA guard: a name this segment defines must have no OTHER
+    # writer — a redefinition across the boundary would change which
+    # value the private sub-block env exports
+    for blk in program.blocks:
+        for op in blk.ops:
+            if id(op) in seg_set:
+                continue
+            if any(name in defined for name in op.output_arg_names()):
+                return False
+    return True
+
+
+def wrap_segment(program, ops_seg, protect=(), policy=None):
+    """Move `ops_seg` (a contiguous run of global-block ops) into a new
+    sub-block behind ONE `recompute` op at the run's position.
+
+    inputs  = external reads (params included — the sub-block env is
+              private, exactly like layers.recompute)
+    outputs = segment-defined names read after the segment anywhere in
+              the program, plus any `protect` names (fetch targets)
+
+    Random ops keep their streams: a moved op with no explicit seed is
+    stamped seed=<original (block<<20)|idx>, which reproduces the
+    op-position RNG fold bit-for-bit (core/registry.LowerCtx.rng).
+    Returns the created recompute Operator."""
+    block = program.global_block()
+    if not ops_seg:
+        raise ValueError("empty segment")
+    start = block.ops.index(ops_seg[0])
+    for j, op in enumerate(ops_seg):
+        if block.ops[start + j] is not op:
+            raise ValueError("segment ops are not contiguous in the block")
+
+    seg_set = set(id(op) for op in ops_seg)
+    defined = set()
+    in_names = []
+    seen_in = set()
+    for op in ops_seg:
+        for name in _op_reads(program, op):
+            if name and name not in defined and name not in seen_in:
+                seen_in.add(name)
+                in_names.append(name)
+        for name in op.output_arg_names():
+            if name:
+                defined.add(name)
+
+    used_after = set()
+    for blk in program.blocks:
+        for op in blk.ops:
+            if id(op) in seg_set:
+                continue
+            for name in _op_reads(program, op):
+                if name in defined:
+                    used_after.add(name)
+    for name in protect:
+        if name in defined:
+            used_after.add(name)
+    out_names = sorted(used_after)
+    if not out_names:
+        raise ValueError(
+            "segment exports nothing — wrapping it would disconnect the "
+            "program (did you forget to protect the fetch targets?)")
+
+    # RNG-stream parity for moved ops (see docstring)
+    for j, op in enumerate(ops_seg):
+        orig_idx = start + j  # (block 0 << 20) | idx
+        if orig_idx > 0 and not int(op.attrs.get("seed", 0) or 0):
+            op.attrs["seed"] = orig_idx
+
+    saved_cur = program.current_block_idx
+    sub = program.create_block(parent_idx=0)
+    program.current_block_idx = saved_cur
+    sub.ops = list(ops_seg)
+    for op in ops_seg:
+        op.block = sub
+
+    rec = framework.Operator(
+        block, "recompute", None, None,
+        {
+            "sub_block_idx": sub.idx,
+            "in_names": list(in_names),
+            "out_names": list(out_names),
+            "__bound_names__": list(in_names),
+            "remat_pass": True,
+        },
+    )
+    if policy:
+        rec.attrs["policy"] = str(policy)
+    rec.inputs = {"X": list(in_names)}
+    rec.outputs = {"Out": list(out_names)}
+    del block.ops[start:start + len(ops_seg)]
+    block.ops.insert(start, rec)
+    program._bump_version()
+    return rec
+
+
+def pin_rng_streams(program, block_idx=0):
+    """Stamp every op's RNG stream to its CURRENT op index via the
+    ``seed`` attr (the fold ``LowerCtx.rng`` computes for seed=n is
+    identical to the op-position fold for op_idx=n).
+
+    Wrapping a segment replaces len(seg) ops with ONE recompute op, so
+    every LATER op's position shifts — a dropout in an UNWRAPPED later
+    layer would silently draw a different mask than the unremat
+    program.  Pinning all streams to the pre-remat indices BEFORE any
+    wrap keeps every random op's draw bit-identical regardless of how
+    many segments end up marked.  (Known edge: op index 0 cannot be
+    pinned — seed 0 means "unseeded" — but position 0 is a
+    feed-adjacent op in every builder, never a random one, and it only
+    moves if a segment starts at 0.)"""
+    ops = program.block(block_idx).ops
+    pinned = 0
+    for idx, op in enumerate(ops):
+        if idx > 0 and not int(op.attrs.get("seed", 0) or 0):
+            op.attrs["seed"] = idx
+            pinned += 1
+    if pinned:
+        program._bump_version()
+    return pinned
+
+
+def _segment_weight(program, seg_ops, batch_hint):
+    block = program.global_block()
+    return sum(
+        _activation_bytes(block, name, batch_hint)
+        for op in seg_ops
+        for name in op.output_arg_names()
+    )
+
+
+def remat_program(program, budget_bytes, loss_name, feed_names=None,
+                  batch_hint=8, policy=None, verbose=False):
+    """Budgeted remat: mark the FEWEST segments (heaviest first) whose
+    recompute brings the estimated fwd+bwd peak activation bytes under
+    `budget_bytes`.  budget_bytes <= 0 means "mark everything" (the
+    maximal-savings structural form).
+
+    Call BEFORE minimize.  Returns the report dict also stamped on the
+    program as ``_remat_report``:
+    {before_bytes, after_bytes, budget_bytes, segments_total,
+     segments_marked, fits}."""
+    from ..utils import memory_analysis as ma
+
+    block = program.global_block()
+    if feed_names is None:
+        feed_names = [v.name for v in block.vars.values()
+                      if getattr(v, "is_data", False)]
+    feed_specs = ma.program_feed_specs(program, feed_names, batch_hint)
+
+    def estimate(prog):
+        return ma.estimate_peak_activation_bytes(
+            prog, feed_specs, loss_name)["peak_bytes"]
+
+    protect = set([loss_name])
+    protect.update(getattr(program, "_protected_fetch_names", ()) or ())
+
+    segments = detect_segments(program)
+    # last segment produces the loss head; never wrap it (its recompute
+    # would save nothing — the loss is the output) and skip unwrappables
+    candidates = []
+    for (a, b) in segments[:-1]:
+        seg_ops = block.ops[a:b]
+        if seg_ops and _wrappable(program, seg_ops):
+            candidates.append(seg_ops)
+    candidates.sort(
+        key=lambda seg: -_segment_weight(program, seg, batch_hint))
+
+    before = estimate(program)
+    report = {
+        "before_bytes": int(before),
+        "after_bytes": int(before),
+        "budget_bytes": int(budget_bytes),
+        "segments_total": len(segments),
+        "segments_marked": 0,
+        "fits": bool(before <= budget_bytes) if budget_bytes > 0
+        else True,
+    }
+    if (budget_bytes > 0 and before <= budget_bytes) or not candidates:
+        program._remat_report = report
+        return report
+
+    # pin EVERY op's RNG stream to its pre-remat index before any wrap:
+    # a partial marking shifts the positions of later UNWRAPPED ops, and
+    # an unpinned dropout there would draw a different mask than the
+    # unremat program (wrap_segment pins the moved ops; this pins the
+    # rest)
+    pin_rng_streams(program)
+
+    def marked_estimate(k):
+        """Estimated peak with the k heaviest candidates wrapped, on a
+        throwaway clone (op object identity maps by position)."""
+        clone = program.clone()
+        cblock = clone.global_block()
+        idx_runs = []
+        for seg in candidates[:k]:
+            a = block.ops.index(seg[0])
+            idx_runs.append((a, len(seg)))
+        # wrap from the highest position down so earlier indices hold
+        for a, ln in sorted(idx_runs, reverse=True):
+            wrap_segment(clone, cblock.ops[a:a + ln], protect=protect,
+                         policy=policy)
+        return estimate(clone)
+
+    # monotone in k: binary search the smallest k that fits; if even
+    # k=all misses the budget, mark all (closest achievable)
+    lo, hi = 1, len(candidates)
+    best_k, best_est = hi, marked_estimate(hi)
+    if budget_bytes > 0 and best_est <= budget_bytes:
+        while lo < hi:
+            mid = (lo + hi) // 2
+            est = marked_estimate(mid)
+            if est <= budget_bytes:
+                hi = mid
+                best_k, best_est = mid, est
+            else:
+                lo = mid + 1
+        best_k = hi
+    # apply for real, highest position first
+    chosen = candidates[:best_k]
+    runs = sorted(
+        ((block.ops.index(seg[0]), seg) for seg in chosen), reverse=True)
+    for _, seg in runs:
+        wrap_segment(program, seg, protect=protect, policy=policy)
+    after = estimate(program)
+    report.update(
+        after_bytes=int(after),
+        segments_marked=best_k,
+        # budget <= 0 is the documented mark-everything mode: there is
+        # no budget to miss, so the result reads as success
+        fits=bool(after <= budget_bytes if budget_bytes > 0 else True),
+    )
+    if verbose or not report["fits"]:
+        import sys
+
+        sys.stderr.write(
+            "remat: peak activation %.2f MB -> %.2f MB (budget %.2f MB, "
+            "%d/%d segments recomputed)%s\n" % (
+                before / 1e6, after / 1e6, budget_bytes / 1e6, best_k,
+                len(segments),
+                "" if report["fits"] else " — BUDGET NOT MET (every "
+                "wrappable segment already recomputes)"))
+    program._remat_report = report
+    return report
+
+
+def maybe_remat(program, loss, is_test=False, batch_hint=8):
+    """Builder hook: budgeted remat under FLAGS_hbm_budget_bytes.
+
+    Called by the model builders between the fuse/AMP passes and
+    ``minimize`` — a no-op unless the flag is set (> 0 bytes), so the
+    default build is untouched.  Returns the remat report or None."""
+    from ..flags import get_flag
+
+    budget = int(get_flag("hbm_budget_bytes"))
+    if is_test or budget <= 0:
+        return None
+    name = loss.name if hasattr(loss, "name") else str(loss)
+    return remat_program(program, budget, name, batch_hint=batch_hint)
+
+
+@register_pass("remat_pass")
+def _remat_pass(program, scope):
+    """Registry form: mark EVERY wrappable detected segment for
+    recompute (the maximal-savings structural rewrite; no estimator).
+    For the budgeted form call ``remat_program`` directly — the model
+    builders do, under FLAGS_hbm_budget_bytes."""
+    block = program.global_block()
+    protect = set(getattr(program, "_protected_fetch_names", ()) or ())
+    segments = detect_segments(program)
+    pin_rng_streams(program)
+    marked = 0
+    for (a, b) in reversed(segments[:-1]):
+        seg_ops = block.ops[a:b]
+        if seg_ops and _wrappable(program, seg_ops):
+            try:
+                wrap_segment(program, seg_ops, protect=protect)
+                marked += 1
+            except ValueError:
+                continue
+    program._remat_marked_count = marked
+    return program

@@ -95,6 +95,19 @@ FLAGS_kernel_tune_cache=tests/data/ci_tuning_cache.json \
     tests/test_kernel_tuning.py tests/test_fuse_passes.py \
     tests/test_serving.py -q -m ""
 
+echo "== transpiler-pass lane (remat + inference pipeline + autotuner) =="
+# the optimization transpiler layer end to end: HBM-budgeted remat
+# (bit-exactness + estimator monotonicity on the transformer builder),
+# the generalized inference pass pipeline (BN fold / train prune /
+# weight int8 parity), memory_optimize aliasing contracts, and the
+# program autotuner run CONSULT-ONLY against the committed pinned
+# decision cache — CI never times candidate programs, exactly like the
+# kernel-tuning lane never searches block sizes.
+FLAGS_program_autotune=0 \
+FLAGS_program_tune_cache=tests/data/ci_program_tune_cache.json \
+    python -m pytest tests/test_optimize_transpiler.py \
+    tests/test_transpilers.py -q -m ""
+
 echo "== serving pass (continuous-batching churn exactness) =="
 # the slot-pool engine's core contract on a short seeded CPU trace
 # (small GPT2Config, pool B=4): every request's tokens bit-identical
