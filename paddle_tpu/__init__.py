@@ -28,6 +28,7 @@ from .memory import apply_memory_fraction as _amf
 _amf()
 
 from . import ops  # registers all op lowerings first
+from . import analysis  # static verifier + infer rules (ops registered them)
 from . import (
     average,
     backward,

@@ -1,0 +1,69 @@
+"""Static program analysis: def-use graph utilities, per-op shape/dtype
+infer rules, and the Program verifier (docs/STATIC_ANALYSIS.md).
+
+The Fluid reference validates a ProgramDesc before execution through each
+op's ``InferShape``/``InferVarType``; here the same role is played by a
+standalone package the transpiler passes, the executor, and the lint CLI
+(``tools/check_program.py``) all share:
+
+- ``analysis.graph`` — THE def-use/consumer-map helpers every program
+  walker consumes (pass_registry.OpPattern, the memory transpiler's
+  ControlFlowGraph, debugger/net_drawer, the verifier itself).
+- ``analysis.infer`` — infer-rule registry + whole-program propagation
+  engine; rules register alongside the op lowerings in ``ops/``.
+- ``analysis.verifier`` — ``verify_program`` producing structured
+  diagnostics, the ``apply_pass`` postcondition hook
+  (``FLAGS_check_program``), and the diagnostic helpers the
+  memory-optimize/remat safety checks delegate to.
+
+Import order note: ``ops`` modules import ``analysis.infer`` to register
+their rules, so nothing in this package may import ``ops``.
+"""
+
+from .graph import (  # noqa: F401
+    consumer_map,
+    consumer_count,
+    consumer_ops,
+    producer_map,
+    producer_ops,
+    op_reads,
+    def_use_lists,
+    block_edges,
+)
+from .infer import (  # noqa: F401
+    VarInfo,
+    register_infer,
+    get_infer_rule,
+    infer_program,
+)
+from .verifier import (  # noqa: F401
+    Diagnostic,
+    ProgramVerifyError,
+    verify_program,
+    check_program,
+    verify_after_pass,
+    segment_diagnostics,
+    alias_plan_diagnostics,
+)
+
+__all__ = [
+    "consumer_map",
+    "consumer_count",
+    "consumer_ops",
+    "producer_map",
+    "producer_ops",
+    "op_reads",
+    "def_use_lists",
+    "block_edges",
+    "VarInfo",
+    "register_infer",
+    "get_infer_rule",
+    "infer_program",
+    "Diagnostic",
+    "ProgramVerifyError",
+    "verify_program",
+    "check_program",
+    "verify_after_pass",
+    "segment_diagnostics",
+    "alias_plan_diagnostics",
+]

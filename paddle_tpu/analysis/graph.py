@@ -1,0 +1,98 @@
+"""Shared def-use graph helpers over Program/Block/Operator.
+
+Before this module existed the repo carried four private copies of the
+same walks: ``pass_registry.OpPattern._consumer_map``, the memory
+transpiler's ``ControlFlowGraph`` def-use construction, the
+``inference_transpiler`` producer/consumer maps, and the
+``debugger``/``net_drawer`` edge iteration.  They now all consume these
+helpers, so a fix to (say) sub-block external-read handling lands in
+every walker at once.
+"""
+
+__all__ = [
+    "consumer_map",
+    "consumer_count",
+    "producer_map",
+    "op_reads",
+    "def_use_lists",
+    "block_edges",
+]
+
+
+def consumer_map(block):
+    """name -> [op indices that read it] over one block's op list
+    (the OpPattern matcher's def-use edge source)."""
+    consumers = {}
+    for i, op in enumerate(block.ops):
+        for name in op.input_arg_names():
+            consumers.setdefault(name, []).append(i)
+    return consumers
+
+
+def consumer_count(block):
+    """name -> number of reading ops (single-consumer checks)."""
+    return {n: len(idxs) for n, idxs in consumer_map(block).items()}
+
+
+def producer_map(block):
+    """name -> index of its LAST writing op (matches the walk order the
+    fold passes rely on: a later redefinition shadows earlier ones)."""
+    prod = {}
+    for i, op in enumerate(block.ops):
+        for n in op.output_arg_names():
+            prod[n] = i
+    return prod
+
+
+def consumer_ops(block):
+    """name -> [Operator objects that read it] (the fuse-pass matchers
+    hold op identities across their own block mutations)."""
+    consumers = {}
+    for op in block.ops:
+        for name in op.input_arg_names():
+            consumers.setdefault(name, []).append(op)
+    return consumers
+
+
+def producer_ops(block):
+    """name -> LAST writing Operator object."""
+    prod = {}
+    for op in block.ops:
+        for n in op.output_arg_names():
+            prod[n] = op
+    return prod
+
+
+def op_reads(program, op):
+    """Every name an op reads: its declared inputs plus its sub-blocks'
+    external reads (a while/cond/recompute op must keep alive whatever
+    its body consumes from the outer scope)."""
+    from ..core.trace import op_sub_blocks, sub_block_external_reads
+
+    reads = list(op.input_arg_names())
+    for sub_idx in op_sub_blocks(op):
+        bound = op.attrs.get("__bound_names__", ())
+        reads.extend(sub_block_external_reads(
+            program, program.block(sub_idx), bound))
+    return reads
+
+
+def def_use_lists(program, block_idx=0):
+    """Per-op (defs, uses) sets over one block, uses including sub-block
+    external reads — the ControlFlowGraph liveness input."""
+    block = program.block(block_idx)
+    defs = []
+    uses = []
+    for op in block.ops:
+        defs.append(set(op.output_arg_names()))
+        uses.append(set(op_reads(program, op)))
+    return defs, uses
+
+
+def block_edges(block):
+    """Yield (op_idx, op, in_names, out_names) per op — the one edge
+    iteration behind the graphviz dumps."""
+    for i, op in enumerate(block.ops):
+        ins = [n for names in op.inputs.values() for n in names if n]
+        outs = [n for names in op.outputs.values() for n in names if n]
+        yield i, op, ins, outs

@@ -1,0 +1,459 @@
+"""Per-op static infer rules + whole-program propagation engine.
+
+The Fluid reference gives every op an ``InferShape``/``InferVarType``
+(operator.h) so a ProgramDesc validates before execution; here the same
+contract is a registry of *infer rules* that live alongside the op
+lowerings in ``ops/`` (``from ..analysis.infer import register_infer``)
+and a propagation engine that walks a Program WITHOUT tracing:
+
+- a rule maps input ``VarInfo`` (shape/dtype/var-type) to output
+  ``VarInfo`` under the op's attrs, mirroring its lowering's shape
+  semantics;
+- the engine threads an env through every block (recursing into
+  while / cond / recompute / switch sub-blocks), applies the generic
+  ``<type>_grad`` convention (grad slots mirror the forward inputs),
+  checks each op's slot arity against the rule's declared schema, and
+  reports inferred-vs-declared disagreements through a callback — the
+  verifier turns those into diagnostics.
+
+Conventions:
+- shapes are tuples of ints with ``-1`` = unknown dim, or ``None`` =
+  fully unknown rank; dtypes are normalized strings or ``None``;
+- a rule RETURNS ``None`` entries (or omits slots) where it cannot
+  infer — unknown is always sound, a wrong guess never is;
+- a rule RAISES ``InferError`` when the op's input edges are
+  inconsistent (rank/contraction mismatch) — the static analog of the
+  shape error XLA would raise at trace time.
+
+Dependency note: this module is imported BY the ops modules, so it must
+not import ``ops`` (or anything that does).
+"""
+
+__all__ = [
+    "VarInfo",
+    "InferError",
+    "register_infer",
+    "get_infer_rule",
+    "infer_program",
+    "same_as",
+    "broadcast_shapes",
+]
+
+FLOAT_DTYPES = ("float16", "bfloat16", "float32", "float64")
+INT_DTYPES = ("int8", "uint8", "int16", "int32", "int64")
+
+
+class InferError(Exception):
+    """An op's input edges are statically inconsistent (shape rank /
+    contraction / dtype contract violation the lowering would also
+    reject, caught before any trace)."""
+
+
+class VarInfo:
+    """Static knowledge about one value: shape (tuple with -1 unknown
+    dims, or None), dtype (normalized string or None), var type."""
+
+    __slots__ = ("shape", "dtype", "var_type")
+
+    def __init__(self, shape=None, dtype=None, var_type="lod_tensor"):
+        self.shape = tuple(int(d) for d in shape) if shape is not None else None
+        self.dtype = dtype
+        self.var_type = var_type
+
+    @property
+    def ndim(self):
+        return None if self.shape is None else len(self.shape)
+
+    def __repr__(self):
+        return "VarInfo(shape=%s, dtype=%s)" % (self.shape, self.dtype)
+
+
+class InferRule:
+    __slots__ = ("fn", "req_ins", "req_outs")
+
+    def __init__(self, fn, req_ins, req_outs):
+        self.fn = fn
+        self.req_ins = tuple(req_ins)
+        self.req_outs = tuple(req_outs)
+
+
+_RULES = {}
+
+
+def register_infer(*types, req_ins=(), req_outs=("Out",)):
+    """Decorator registering an infer rule for one or more op types.
+
+        @register_infer("relu", req_ins=("X",))
+        def _r(op, ins): ...
+
+    ``req_ins`` / ``req_outs`` declare the op's slot schema: the engine
+    reports a ``slot-arity`` diagnostic when a required slot is missing
+    or empty.  The rule fn takes (op, ins) with ins = {slot: [VarInfo]}
+    and returns {slot: [VarInfo or None]}; use ``None`` (or return {})
+    where nothing can be inferred.  Passing fn=None via the schema-only
+    form ``register_infer("t", req_ins=...)(None)`` records arity alone.
+    """
+
+    def deco(fn):
+        for t in types:
+            _RULES[t] = InferRule(fn, req_ins, req_outs)
+        return fn
+
+    return deco
+
+
+def get_infer_rule(type_):
+    return _RULES.get(type_)
+
+
+def list_infer_rules():
+    return sorted(_RULES)
+
+
+# ---------------------------------------------------------------------------
+# rule-building helpers
+# ---------------------------------------------------------------------------
+def same_as(slot, out_slots=("Out",)):
+    """Outputs mirror the first input in `slot` exactly (shape, dtype,
+    AND var type — an identity-through op keeps SelectedRows-ness)."""
+
+    def rule(op, ins):
+        x = _first(ins, slot)
+        return {o: [x] for o in out_slots}
+
+    return rule
+
+
+def slot_info(ins, slot, j=0):
+    """The j-th VarInfo of a slot, or None when absent/short — THE slot
+    accessor every rule body uses (ops modules import it rather than
+    carrying private copies)."""
+    vals = ins.get(slot) or []
+    return vals[j] if j < len(vals) else None
+
+
+def _first(ins, slot):
+    return slot_info(ins, slot)
+
+
+def combine_dim(a, b, what="operand"):
+    """Combine two dims under numpy broadcasting; -1 is a wildcard."""
+    a, b = int(a), int(b)
+    if a == b:
+        return a
+    if a == 1:
+        return b
+    if b == 1:
+        return a
+    if a == -1 or b == -1:
+        return -1
+    raise InferError("%s dims %d vs %d do not broadcast" % (what, a, b))
+
+
+def broadcast_shapes(xs, ys, what="operand"):
+    """Numpy-style trailing-aligned broadcast of two shapes (either may
+    be None = unknown)."""
+    if xs is None or ys is None:
+        return None
+    xs, ys = tuple(xs), tuple(ys)
+    n = max(len(xs), len(ys))
+    xs = (1,) * (n - len(xs)) + xs
+    ys = (1,) * (n - len(ys)) + ys
+    return tuple(combine_dim(a, b, what) for a, b in zip(xs, ys))
+
+
+def elementwise_shape(x, y, axis=-1):
+    """Paddle elementwise broadcast: Y aligns onto X starting at `axis`
+    (ops/common.bcast_y).  Returns the out shape (None if unknown)."""
+    if x is None or x.shape is None:
+        return None
+    if y is None or y.shape is None:
+        return tuple(x.shape)
+    xs, ys = x.shape, y.shape
+    if len(xs) == len(ys) or len(ys) > len(xs):
+        # equal ranks, or Y outranking X: plain numpy broadcasting (the
+        # lowering's reshape is a no-op for equal ranks; a bigger Y only
+        # occurs against numel-1 X and numpy handles it)
+        return broadcast_shapes(xs, ys, "elementwise")
+    a = len(xs) - len(ys) if axis in (-1, None) else int(axis)
+    aligned = (1,) * a + tuple(ys) + (1,) * (len(xs) - a - len(ys))
+    return broadcast_shapes(xs, aligned, "elementwise")
+
+
+def same_dtype(x, y):
+    """Common dtype of two operands, or None when unknown/mixed (mixed
+    operands promote on device; the static level stays agnostic)."""
+    if x is None or y is None:
+        return None
+    if x.dtype is not None and x.dtype == y.dtype:
+        return x.dtype
+    return None
+
+
+def numel_known(shape):
+    if shape is None or any(int(d) < 0 for d in shape):
+        return None
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n
+
+
+def normalize_dtype(dtype):
+    """Any dtype spelling -> the canonical string the IR serializes
+    (framework._to_dtype_str, re-exported here so ops modules and the
+    verifier share one normalizer)."""
+    from ..framework import _to_dtype_str
+
+    return _to_dtype_str(dtype)
+
+
+def attr_dtype(value, default=None):
+    """Resolve a dtype ATTR (string / numpy dtype / framework.proto int
+    id) to the canonical string, or None when unresolvable."""
+    if value is None:
+        return default
+    try:
+        if isinstance(value, int) and not isinstance(value, bool):
+            from ..ops.common import _PROTO_DTYPE  # lazy: no import cycle
+
+            value = _PROTO_DTYPE.get(int(value), None)
+            if value is None:
+                return default
+        return normalize_dtype(value)
+    except Exception:
+        return default
+
+
+# ---------------------------------------------------------------------------
+# propagation engine
+# ---------------------------------------------------------------------------
+# op types the tracer consumes structurally (core/trace.py trace_ops) —
+# they have no lowering and no infer rule but are NOT unknown ops
+STRUCTURAL_OPS = frozenset((
+    "feed", "fetch", "read", "create_py_reader", "while", "cond",
+    "listen_and_serv",
+))
+
+# source ops whose outputs arrive from outside the compiled step (host
+# feeds, staged reader queues) — every walker treats them as defs
+SOURCE_OPS = frozenset(("feed", "read", "create_py_reader"))
+
+
+# device dtype policy (ops/common._DTYPE_MAP): int64 and float64 compute
+# as their 32-bit forms on TPU, so the IR legitimately mixes the two
+# spellings across an edge — statically equivalent, never a mismatch
+_DTYPE_EQUIV = {
+    "int64": "int32", "int32": "int32",
+    "float64": "float32", "float32": "float32",
+}
+
+
+def dtypes_equivalent(a, b):
+    if a == b:
+        return True
+    return _DTYPE_EQUIV.get(a, a) == _DTYPE_EQUIV.get(b, b)
+
+
+def var_static_info(block, name):
+    """VarInfo from a name's declared Variable (or None if undeclared)."""
+    v = block._find_var_recursive(name)
+    if v is None:
+        return None
+    shape = None
+    if v.shape is not None:
+        shape = tuple(int(d) for d in v.shape)
+    dtype = v.dtype if isinstance(v.dtype, str) else None
+    return VarInfo(shape, dtype, getattr(v, "type", "lod_tensor"))
+
+
+def _merge(inferred, declared):
+    """Best static knowledge: inferred dims where known, declared
+    otherwise (ranks must already have been checked by the caller).
+    var_type follows the declaration — SelectedRows-ness is a property
+    of the declared slot, not of the rule result."""
+    if inferred is None:
+        return declared
+    if declared is None or declared.shape is None or inferred.shape is None:
+        shape = inferred.shape if inferred.shape is not None else (
+            declared.shape if declared is not None else None)
+    elif len(inferred.shape) != len(declared.shape):
+        shape = inferred.shape
+    else:
+        shape = tuple(
+            i if i >= 0 else d
+            for i, d in zip(inferred.shape, declared.shape))
+    dtype = inferred.dtype or (declared.dtype if declared else None)
+    var_type = (declared.var_type if declared is not None
+                else inferred.var_type)
+    return VarInfo(shape, dtype, var_type)
+
+
+def _grad_op_infer(op, ins):
+    """Generic `<type>_grad` rule: each `<slot>@GRAD` output mirrors the
+    forward input values in `<slot>` (backward.py's construction feeds
+    the forward inputs through under their own slot names)."""
+    outs = {}
+    for slot in op.outputs:
+        if not slot.endswith("@GRAD"):
+            continue
+        fwd_slot = slot[: -len("@GRAD")]
+        fwd_vals = ins.get(fwd_slot)
+        if fwd_vals is None:
+            continue
+        outs[slot] = list(fwd_vals[: len(op.outputs[slot])])
+    return outs
+
+
+def infer_program(program, feeds=(), report=None, block_idx=0, env=None,
+                  skip=None):
+    """Propagate VarInfo through `program` starting at `block_idx`.
+
+    report(code, severity, block_idx, op_idx, op, message) receives
+    every finding ("slot-arity" / "shape-mismatch" / "dtype-mismatch" /
+    "infer-rule-error"); pass None to propagate silently.  skip(bidx,
+    oidx) -> True drops an op from analysis (the executor's DCE mask:
+    ops that will not trace are not checked).  Returns the final env
+    {name: VarInfo}.
+    """
+    if report is None:
+        def report(code, severity, bidx, oidx, op, msg):
+            return None
+
+    env = {} if env is None else env
+    if feeds:
+        block = program.block(block_idx)
+        for n in feeds:
+            info = var_static_info(block, n)
+            if info is not None:
+                env.setdefault(n, info)
+    _infer_block(program, block_idx, env, report, skip)
+    return env
+
+
+def _lookup(env, block, name):
+    info = env.get(name)
+    if info is not None:
+        return info
+    return var_static_info(block, name)
+
+
+def _check_out(env, block, bidx, oidx, op, name, inferred, report):
+    declared = var_static_info(block, name)
+    if inferred is not None and declared is not None:
+        if (
+            inferred.dtype is not None
+            and declared.dtype is not None
+            and not dtypes_equivalent(inferred.dtype, declared.dtype)
+        ):
+            report(
+                "dtype-mismatch", "error", bidx, oidx, op,
+                "output '%s' is declared %s but the %s rule infers %s"
+                % (name, declared.dtype, op.type, inferred.dtype))
+        if inferred.shape is not None and declared.shape is not None:
+            # fluid scalar convention: () and (1,) interchange freely
+            # (mean reshapes to [1], losses declare (), fill_constant
+            # seeds loss grads as [1]) — numel-1 shapes never conflict
+            if (numel_known(inferred.shape) == 1
+                    and numel_known(declared.shape) == 1):
+                pass
+            elif len(inferred.shape) != len(declared.shape):
+                report(
+                    "shape-mismatch", "error", bidx, oidx, op,
+                    "output '%s' is declared rank %d %s but the %s rule "
+                    "infers rank %d %s"
+                    % (name, len(declared.shape), declared.shape, op.type,
+                       len(inferred.shape), inferred.shape))
+            else:
+                for ax, (i, d) in enumerate(
+                        zip(inferred.shape, declared.shape)):
+                    if i >= 0 and d >= 0 and i != d:
+                        report(
+                            "shape-mismatch", "error", bidx, oidx, op,
+                            "output '%s' dim %d is declared %d but the "
+                            "%s rule infers %d"
+                            % (name, ax, d, op.type, i))
+                        break
+    env[name] = _merge(inferred, declared)
+
+
+def _infer_block(program, bidx, env, report, skip=None):
+    block = program.block(bidx)
+    for oidx, op in enumerate(block.ops):
+        if skip is not None and skip(bidx, oidx):
+            continue
+        if op.type in SOURCE_OPS:
+            for n in op.output_arg_names():
+                env.setdefault(n, var_static_info(block, n) or VarInfo())
+            continue
+        if op.type == "fetch":
+            continue
+
+        is_grad = op.type.endswith("_grad") and "__fwd_type__" in op.attrs
+        rule = _RULES.get(op.type)
+
+        # ---- slot arity vs the declared schema -----------------------
+        if rule is not None:
+            for slot in rule.req_ins:
+                if not any(n for n in op.inputs.get(slot, ())):
+                    report(
+                        "slot-arity", "error", bidx, oidx, op,
+                        "op %s requires input slot '%s' (schema: ins=%s "
+                        "outs=%s)" % (op.type, slot, list(rule.req_ins),
+                                      list(rule.req_outs)))
+            for slot in rule.req_outs:
+                if not any(n for n in op.outputs.get(slot, ())):
+                    report(
+                        "slot-arity", "error", bidx, oidx, op,
+                        "op %s requires output slot '%s' (schema: ins=%s "
+                        "outs=%s)" % (op.type, slot, list(rule.req_ins),
+                                      list(rule.req_outs)))
+
+        # ---- gather input infos --------------------------------------
+        ins = {}
+        for slot, names in op.inputs.items():
+            ins[slot] = [
+                _lookup(env, block, n) if n else None for n in names
+            ]
+
+        # ---- sub-block ops: recurse, then take declared outputs ------
+        from ..core.trace import op_sub_blocks
+
+        subs = op_sub_blocks(op)
+        if subs:
+            for sub_idx in subs:
+                if 0 <= sub_idx < program.num_blocks:
+                    _infer_block(program, sub_idx, env, report, skip)
+            for n in op.output_arg_names():
+                # recompute exports sub-block-computed names: prefer the
+                # env info the recursion just produced
+                env[n] = env.get(n) or var_static_info(block, n) or VarInfo()
+            continue
+
+        # ---- run the rule --------------------------------------------
+        outs = {}
+        if is_grad:
+            outs = _grad_op_infer(op, ins)
+        elif rule is not None and rule.fn is not None:
+            try:
+                outs = rule.fn(op, ins) or {}
+            except InferError as e:
+                report("shape-mismatch", "error", bidx, oidx, op,
+                       "op %s: %s" % (op.type, e))
+                outs = {}
+            except Exception as e:  # a rule bug must never kill analysis
+                report(
+                    "infer-rule-error", "warning", bidx, oidx, op,
+                    "infer rule for %s raised %s: %s"
+                    % (op.type, type(e).__name__, e))
+                outs = {}
+
+        for slot, names in op.outputs.items():
+            infos = outs.get(slot)
+            for j, n in enumerate(names):
+                if not n:
+                    continue
+                inferred = None
+                if infos is not None and j < len(infos):
+                    inferred = infos[j]
+                _check_out(env, block, bidx, oidx, op, n, inferred, report)

@@ -1,0 +1,561 @@
+"""Static Program verifier (docs/STATIC_ANALYSIS.md).
+
+``verify_program`` checks a Program/Block/Operator graph WITHOUT tracing
+and returns structured ``Diagnostic``s; ``verify_after_pass`` is the
+``apply_pass`` postcondition hook (``FLAGS_check_program``) that makes
+verified-in => verified-out a structural property of every registry
+pass.  The memory-optimize plan assertion and the remat segment-refusal
+checks delegate to the diagnostic helpers here instead of carrying
+private re-implementations.
+
+Diagnostic classes (each has a triggering negative test in
+tests/test_program_verifier.py):
+
+  undefined-read             def-before-use, incl. reads crossing
+                             sub-block boundaries (the PR 12 liveness
+                             bug class)
+  ssa-violation              two ops (re)define one non-persistable name
+  slot-arity                 op slots vs the registered infer schema
+  shape-mismatch             declared vs inferred shape at an edge
+  dtype-mismatch             declared vs inferred dtype at an edge
+  dtype-drift                a Variable carries a non-canonical dtype
+  dead-write                 an op no fetch/state/side-effect ever needs
+  persistable-write-in-remat persistable state written inside a
+                             recompute segment
+  protected-fetch            a ``_protected_fetch_names`` entry has no
+                             remaining definition
+  dist-plan                  a param grad reaches neither a collective,
+                             a send, nor an optimizer; orphan send/recv
+  unknown-op                 no lowering, no grad convention, not
+                             structural
+  sub-block                  dangling sub_block index
+  alias-mismatch             a memory plan pairs dtype/shape-unequal vars
+  infer-rule-error           an infer rule itself misbehaved (warning)
+"""
+
+from .graph import consumer_map, op_reads
+from .infer import infer_program, normalize_dtype
+
+__all__ = [
+    "Diagnostic",
+    "ProgramVerifyError",
+    "verify_program",
+    "check_program",
+    "verify_after_pass",
+    "segment_diagnostics",
+    "alias_plan_diagnostics",
+]
+
+# canonical dtype strings the IR serializes (desc_codec closed set)
+_CANONICAL_DTYPES = frozenset((
+    "float16", "bfloat16", "float32", "float64",
+    "int8", "uint8", "int16", "int32", "int64", "bool",
+))
+
+# ops that terminate a gradient's journey in a dist-transpiled program
+_GRAD_SINK_OPS = frozenset((
+    "send_bucket", "send_sparse", "send", "send_barrier",
+    "c_allreduce_mean", "c_allreduce_sum", "c_allreduce_max",
+    "c_allreduce_min", "c_allreduce_prod", "c_reducescatter",
+))
+
+
+class Diagnostic:
+    """One verifier finding, locatable to (block, op) and — when raised
+    from a pass postcondition — the pass that produced the program."""
+
+    __slots__ = ("code", "severity", "block_idx", "op_idx", "op_type",
+                 "message", "pass_name")
+
+    def __init__(self, code, severity, block_idx, op_idx, op_type, message,
+                 pass_name=None):
+        self.code = code
+        self.severity = severity  # "error" | "warning"
+        self.block_idx = block_idx
+        self.op_idx = op_idx
+        self.op_type = op_type
+        self.message = message
+        self.pass_name = pass_name
+
+    @property
+    def is_error(self):
+        return self.severity == "error"
+
+    def __str__(self):
+        where = "block %s op %s" % (self.block_idx, self.op_idx)
+        if self.op_type:
+            where += " (%s)" % self.op_type
+        s = "[%s] %s: %s" % (self.code, where, self.message)
+        if self.pass_name:
+            s = "pass '%s': %s" % (self.pass_name, s)
+        return s
+
+    __repr__ = __str__
+
+
+class ProgramVerifyError(RuntimeError):
+    """Raised by check_program / verify_after_pass; carries the full
+    diagnostic list for programmatic consumers."""
+
+    def __init__(self, message, diagnostics):
+        super().__init__(message)
+        self.diagnostics = diagnostics
+
+
+def _is_optimizer_op(op):
+    """Structural optimizer detection: consumes a Param and a Grad slot
+    (sgd/momentum/adam/... — any rule-based list would rot)."""
+    return bool(op.inputs.get("Param")) and bool(op.inputs.get("Grad"))
+
+
+def _is_grad_op(op):
+    return op.type.endswith("_grad") and "__fwd_type__" in op.attrs
+
+
+def verify_program(program, scope=None, feeds=None, fetches=(),
+                   pass_name=None, check_infer=True, dce_fetches=None):
+    """Statically verify `program`; returns a list of Diagnostics.
+
+    scope:   optional Scope — names resident there count as defined
+             (the executor's state-read contract).
+    feeds:   iterable of fed names; None = every ``is_data`` var feeds;
+             "*" = reads are unconstrained (embedded server shard
+             programs whose inputs arrive from the service loop).
+    fetches: extra names that must stay defined and count as used.
+    dce_fetches: when set, block-0 ops the executor's DCE would drop
+             for these fetch targets are skipped (the verify-before-run
+             regime checks what will actually trace).
+    """
+    diags = []
+    feed_all = feeds == "*"
+    fetch_names = set(
+        f.name if hasattr(f, "name") else str(f) for f in (fetches or ()))
+    protected = set(getattr(program, "_protected_fetch_names", ()) or ())
+
+    def report(code, severity, bidx, oidx, op, msg):
+        diags.append(Diagnostic(
+            code, severity, bidx, oidx,
+            op.type if op is not None else None, msg, pass_name))
+
+    gb = program.global_block()
+
+    # ---- declared-dtype canonicality (the drift audit) ---------------
+    for blk in program.blocks:
+        for v in blk.vars.values():
+            dt = v.dtype
+            if dt is None or (isinstance(dt, str) and dt in _CANONICAL_DTYPES):
+                continue
+            try:
+                canon = normalize_dtype(dt)
+            except Exception:
+                canon = None
+            diags.append(Diagnostic(
+                "dtype-drift", "warning", blk.idx, None, None,
+                "var '%s' carries non-canonical dtype %r%s — normalize at "
+                "append_op time so to_dict/desc_codec round-trips stay "
+                "byte-stable" % (
+                    v.name, dt,
+                    (" (canonical: %r)" % canon) if canon else ""),
+                pass_name))
+
+    # ---- executor-DCE mask for the verify-before-run regime ----------
+    keep = None
+    if dce_fetches is not None:
+        from ..core.trace import dce_mask
+
+        keep = dce_mask(program, 0, list(dce_fetches))
+
+    def skipped(bidx, oidx):
+        return keep is not None and bidx == 0 and not keep[oidx]
+
+    # ---- seed the defined-name universe ------------------------------
+    def is_defined_externally(block, name):
+        if feed_all:
+            return True
+        v = block._find_var_recursive(name)
+        if v is not None and (v.persistable or getattr(v, "is_data", False)
+                              and feeds is None):
+            return True
+        if feeds is not None and name in feed_set:
+            return True
+        if scope is not None and scope.has_var(name):
+            return True
+        return False
+
+    feed_set = set(feeds) if feeds not in (None, "*") else set()
+
+    # ---- structural walk (recursing into sub-blocks) -----------------
+    from ..core.registry import OPS
+    from ..core.trace import op_sub_blocks
+    from .infer import SOURCE_OPS, STRUCTURAL_OPS
+
+    # names legitimately multi-written: loop carries and bound sub-block
+    # names (the while body re-defines its carried vars every iteration)
+    multi_ok = set()
+    for blk in program.blocks:
+        for op in blk.ops:
+            multi_ok.update(op.attrs.get("carried_vars", ()) or ())
+            multi_ok.update(op.attrs.get("__bound_names__", ()) or ())
+
+    def walk(bidx, defined, in_remat):
+        block = program.block(bidx)
+        writers = {}
+        for oidx, op in enumerate(block.ops):
+            if skipped(bidx, oidx):
+                continue
+            if op.type in SOURCE_OPS:
+                for n in op.output_arg_names():
+                    defined.add(n)
+                continue
+            if op.type == "fetch":
+                continue
+
+            # unknown op: nothing will lower it at trace time
+            if (
+                op.type not in OPS
+                and not _is_grad_op(op)
+                and op.type not in STRUCTURAL_OPS
+            ):
+                report(
+                    "unknown-op", "error", bidx, oidx, op,
+                    "op type '%s' has no registered lowering, no "
+                    "<type>_grad convention, and is not structural"
+                    % op.type)
+
+            # def-before-use on the op's own declared inputs
+            for n in op.input_arg_names():
+                if n in defined or is_defined_externally(block, n):
+                    continue
+                report(
+                    "undefined-read", "error", bidx, oidx, op,
+                    "op %s reads '%s' which is neither fed, persistable, "
+                    "in scope, nor defined by an earlier op in this "
+                    "block's scope chain" % (op.type, n))
+
+            # sub-blocks: validate index, recurse with the bound env
+            subs = op_sub_blocks(op)
+            for sub_idx in subs:
+                if not (0 <= sub_idx < program.num_blocks):
+                    report(
+                        "sub-block", "error", bidx, oidx, op,
+                        "op %s references sub_block %d but the program "
+                        "has %d blocks"
+                        % (op.type, sub_idx, program.num_blocks))
+                    continue
+                bound = set(op.attrs.get("__bound_names__", ()) or ())
+                bound.update(op.attrs.get("carried_vars", ()) or ())
+                bound.update(op.input_arg_names())
+                walk(sub_idx, set(defined) | bound,
+                     in_remat or op.type == "recompute")
+
+            # writes: SSA accounting + remat persistable hazard
+            own_reads = set(op.input_arg_names())
+            for n in op.output_arg_names():
+                v = block._find_var_recursive(n)
+                persistable = v is not None and v.persistable
+                if persistable and in_remat:
+                    report(
+                        "persistable-write-in-remat", "error", bidx, oidx,
+                        op,
+                        "op %s writes persistable '%s' inside a recompute "
+                        "segment — the backward re-run would apply the "
+                        "state update twice" % (op.type, n))
+                if not persistable and n not in own_reads \
+                        and n not in multi_ok:
+                    prev = writers.get(n)
+                    if prev is not None and prev[2] is not op:
+                        report(
+                            "ssa-violation", "error", bidx, oidx, op,
+                            "op %s redefines '%s' already written by op %d "
+                            "(%s) — non-persistable names must have one "
+                            "static writer"
+                            % (op.type, n, prev[0], prev[1]))
+                    writers[n] = (oidx, op.type, op)
+                defined.add(n)
+
+            # embedded server programs (listen_and_serv carries its shard
+            # programs as serialized JSON attrs)
+            if op.type == "listen_and_serv":
+                _verify_embedded(program, op, bidx, oidx, diags, pass_name)
+
+    walk(0, set(feed_set), False)
+
+    # ---- dead writes -------------------------------------------------
+    used = set(fetch_names) | protected
+    for blk in program.blocks:
+        for op in blk.ops:
+            try:
+                used.update(op_reads(program, op))
+            except IndexError:
+                # dangling sub_block index: already reported above
+                used.update(op.input_arg_names())
+    for blk in program.blocks:
+        for oidx, op in enumerate(blk.ops):
+            if skipped(blk.idx, oidx):
+                continue
+            if (op.type in SOURCE_OPS
+                    or op.type in ("fetch", "listen_and_serv")):
+                continue
+            opdef = OPS.get(op.type)
+            if opdef is not None and getattr(opdef, "side_effect", False):
+                continue
+            if op_sub_blocks(op):
+                continue
+            outs = [n for n in op.output_arg_names()]
+            if not outs:
+                continue
+            live = False
+            for n in outs:
+                v = blk._find_var_recursive(n)
+                if (v is not None and v.persistable) or n in used:
+                    live = True
+                    break
+            if not live:
+                report(
+                    "dead-write", "warning", blk.idx, oidx, op,
+                    "op %s writes only %s, which nothing reads, fetches "
+                    "or persists — executor DCE will drop it; delete it "
+                    "from the program" % (op.type, outs))
+
+    # ---- protected fetches keep a definition -------------------------
+    produced = set()
+    for blk in program.blocks:
+        for op in blk.ops:
+            produced.update(op.output_arg_names())
+    for name in sorted(protected | fetch_names):
+        if name in produced:
+            continue
+        v = gb._find_var_recursive(name)
+        if v is not None and (v.persistable or getattr(v, "is_data", False)):
+            continue
+        if scope is not None and scope.has_var(name):
+            continue
+        if feed_all or name in feed_set:
+            continue
+        report(
+            "protected-fetch", "error", 0, None, None,
+            "fetch target '%s' has no remaining definition — a pass "
+            "deleted or renamed its producer (the _protected_fetch_names "
+            "contract)" % name)
+
+    # ---- dist-plan consistency ---------------------------------------
+    if (getattr(program, "_dist_plan_spec", None) is not None
+            or getattr(program, "_collective", None) is not None
+            or any(op.type in _GRAD_SINK_OPS for op in gb.ops)):
+        _check_dist_plan(program, report, skipped)
+
+    # ---- shape/dtype/arity inference ---------------------------------
+    if check_infer:
+        seed = list(feeds) if feeds not in (None, "*") else ()
+        infer_program(program, feeds=seed, report=report, skip=skipped)
+
+    return diags
+
+
+def _verify_embedded(program, op, bidx, oidx, diags, pass_name):
+    """Recursively verify listen_and_serv's embedded shard programs.
+    Their non-persistable inputs arrive from the service loop, so reads
+    are unconstrained (feeds="*"); structure and shapes still check."""
+    from ..framework import Program
+
+    blobs = list(op.attrs.get("optimize_programs", ()) or ())
+    lr = op.attrs.get("lr_program")
+    if lr:
+        blobs.append(lr)
+    for i, blob in enumerate(blobs):
+        if not isinstance(blob, str):
+            continue
+        try:
+            sub = Program.from_json(blob)
+        except Exception as e:
+            diags.append(Diagnostic(
+                "sub-block", "error", bidx, oidx, op.type,
+                "listen_and_serv embedded program #%d does not "
+                "deserialize: %s" % (i, e), pass_name))
+            continue
+        for d in verify_program(sub, feeds="*", check_infer=True):
+            if not d.is_error:
+                continue
+            diags.append(Diagnostic(
+                d.code, d.severity, bidx, oidx, op.type,
+                "embedded shard program #%d: %s" % (i, d.message),
+                pass_name))
+
+
+def _check_dist_plan(program, report, skipped=lambda b, i: False):
+    """Every trainable param's grad must reach a collective, a send, or
+    an on-trainer optimizer op; send/recv pairs must not be orphaned.
+    Ops the caller's DCE mask drops neither produce grad roots nor
+    serve as consumers (they will not trace)."""
+    block = program.global_block()
+    consumers = {
+        n: [i for i in idxs if not skipped(0, i)]
+        for n, idxs in consumer_map(block).items()
+    }
+
+    # param-grad pairs from the op_role_var tagging (op_proto_maker
+    # analog the transpilers key off)
+    grads = {}
+    for oidx, op in enumerate(block.ops):
+        if skipped(0, oidx):
+            continue
+        rv = op.attrs.get("op_role_var") or ()
+        for p, g in zip(rv[0::2], rv[1::2]):
+            grads[g] = p
+    if not grads:
+        # fall back to the grad-name convention against trainable params
+        # (backward.py uniquifies: `<param>@GRAD` or `<param>@GRAD_<n>`)
+        from ..framework import Parameter, grad_var_name
+
+        produced = set()
+        for oidx, op in enumerate(block.ops):
+            if skipped(0, oidx):
+                continue
+            produced.update(op.output_arg_names())
+        import re
+
+        for v in block.vars.values():
+            if not (isinstance(v, Parameter)
+                    and getattr(v, "trainable", True)):
+                continue
+            g = grad_var_name(v.name)
+            # exactly `<p>@GRAD` or its uniquified `<p>@GRAD_<n>` — a
+            # derived name (`...@GRAD_0@SEND_TOKEN`) is not a grad root
+            pat = re.compile(re.escape(g) + r"(_\d+)?$")
+            for n in produced:
+                if pat.fullmatch(n):
+                    grads[n] = v.name
+
+    for g, p in sorted(grads.items()):
+        seen = set()
+        frontier = [g]
+        routed = False
+        while frontier and not routed:
+            name = frontier.pop()
+            if name in seen:
+                continue
+            seen.add(name)
+            for ci in consumers.get(name, ()):
+                cop = block.ops[ci]
+                if cop.type in _GRAD_SINK_OPS or _is_optimizer_op(cop):
+                    routed = True
+                    break
+                frontier.extend(cop.output_arg_names())
+        if not routed:
+            gi = next(
+                (i for i, o in enumerate(block.ops)
+                 if g in o.output_arg_names()), None)
+            op = block.ops[gi] if gi is not None else None
+            report(
+                "dist-plan", "error", 0, gi, op,
+                "gradient '%s' of param '%s' reaches neither a collective, "
+                "a send op, nor an optimizer — the dist transpile left an "
+                "orphan gradient" % (g, p))
+
+    has_send = any(op.type == "send_bucket" for op in block.ops)
+    has_recv = any(op.type == "recv_bucket" for op in block.ops)
+    if has_send != has_recv:
+        report(
+            "dist-plan", "warning", 0, None, None,
+            "program has %s without %s — sync pserver rounds pair the "
+            "grad push with the param pull" % (
+                "send_bucket" if has_send else "recv_bucket",
+                "recv_bucket" if has_send else "send_bucket"))
+
+
+# ---------------------------------------------------------------------------
+# raising wrappers
+# ---------------------------------------------------------------------------
+def _raise_on_errors(diags, prefix):
+    """Shared raise discipline: first 8 errors formatted, the rest
+    counted; warnings never raise."""
+    errors = [d for d in diags if d.is_error]
+    if errors:
+        head = "\n  ".join(str(d) for d in errors[:8])
+        more = "" if len(errors) <= 8 else "\n  ... and %d more" % (
+            len(errors) - 8)
+        raise ProgramVerifyError(
+            "%s with %d error(s):\n  %s%s"
+            % (prefix, len(errors), head, more), diags)
+    return diags
+
+
+def check_program(program, **kwargs):
+    """verify_program, raising ProgramVerifyError on any error-severity
+    diagnostic (warnings pass)."""
+    return _raise_on_errors(
+        verify_program(program, **kwargs),
+        "program verification failed")
+
+
+def verify_after_pass(program, name, scope=None):
+    """The apply_pass postcondition (FLAGS_check_program): any registry
+    pass that emits an ill-formed program fails loudly AT THE PASS
+    BOUNDARY with the pass and the offending op named."""
+    return _raise_on_errors(
+        verify_program(program, scope=scope, pass_name=name),
+        "pass '%s' postcondition failed — the pass emitted an "
+        "ill-formed program" % name)
+
+
+# ---------------------------------------------------------------------------
+# diagnostic helpers other subsystems delegate to
+# ---------------------------------------------------------------------------
+def segment_diagnostics(program, ops_seg):
+    """Remat segment-refusal diagnostics: persistable writes inside the
+    candidate segment and non-SSA redefinition across its boundary
+    (transpiler.remat._wrappable delegates here; wrapping proceeds only
+    when this returns [])."""
+    diags = []
+    block = program.global_block()
+    seg_set = set(id(op) for op in ops_seg)
+    defined = set()
+    start = None
+    try:
+        start = block.ops.index(ops_seg[0])
+    except (ValueError, IndexError):
+        pass
+    for j, op in enumerate(ops_seg):
+        for name in op.output_arg_names():
+            v = block._find_var_recursive(name)
+            if v is not None and v.persistable:
+                diags.append(Diagnostic(
+                    "persistable-write-in-remat", "error", 0,
+                    None if start is None else start + j, op.type,
+                    "op %s writes persistable '%s' — stateful updates "
+                    "cannot cross a remat boundary" % (op.type, name)))
+            defined.add(name)
+    for blk in program.blocks:
+        for oidx, op in enumerate(blk.ops):
+            if id(op) in seg_set:
+                continue
+            clash = [n for n in op.output_arg_names() if n in defined]
+            if clash:
+                diags.append(Diagnostic(
+                    "ssa-violation", "error", blk.idx, oidx, op.type,
+                    "op %s redefines %s also written inside the candidate "
+                    "segment — the private sub-block env could not tell "
+                    "which value to export" % (op.type, clash)))
+    return diags
+
+
+def alias_plan_diagnostics(block, reuse):
+    """Memory-plan soundness: every reuse pair must alias identically
+    typed, identically shaped slots (memory_optimize's defense-in-depth
+    assertion delegates here)."""
+
+    def key(name):
+        v = block._find_var_recursive(name)
+        if v is None:
+            return None
+        return (str(v.dtype), tuple(int(d) for d in (v.shape or ())))
+
+    diags = []
+    for name, cand in sorted((reuse or {}).items()):
+        if key(name) != key(cand):
+            diags.append(Diagnostic(
+                "alias-mismatch", "error", block.idx, None, None,
+                "memory plan aliases '%s' -> '%s' but their (dtype, "
+                "shape) identities differ (%s vs %s)"
+                % (name, cand, key(name), key(cand))))
+    return diags

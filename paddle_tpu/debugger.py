@@ -44,7 +44,10 @@ def pprint_program_codes(program, show_backward=True):
 
 def draw_block_graphviz(block, highlights=None, path="./graph.dot"):
     """Emit a graphviz dot file: op nodes (boxes) + var nodes (ellipses),
-    edges by def/use (net_drawer.py / graph_viz_pass analog)."""
+    edges by def/use (net_drawer.py / graph_viz_pass analog).  Edge
+    iteration is the shared ``analysis.graph.block_edges`` walk."""
+    from .analysis.graph import block_edges
+
     highlights = set(highlights or ())
     lines = ["digraph G {", "  rankdir=TB;"]
     var_ids = {}
@@ -59,18 +62,16 @@ def draw_block_graphviz(block, highlights=None, path="./graph.dot"):
             )
         return var_ids[name]
 
-    for i, op in enumerate(block.ops):
+    for i, op, in_names, out_names in block_edges(block):
         op_id = "op_%d" % i
         lines.append(
             '  %s [label="%s" shape=box style=filled fillcolor="lightblue"];'
             % (op_id, op.type)
         )
-        for names in op.inputs.values():
-            for n in names:
-                lines.append("  %s -> %s;" % (vid(n), op_id))
-        for names in op.outputs.values():
-            for n in names:
-                lines.append("  %s -> %s;" % (op_id, vid(n)))
+        for n in in_names:
+            lines.append("  %s -> %s;" % (vid(n), op_id))
+        for n in out_names:
+            lines.append("  %s -> %s;" % (op_id, vid(n)))
     lines.append("}")
     text = "\n".join(lines)
     with open(path, "w") as f:

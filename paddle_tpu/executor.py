@@ -294,6 +294,34 @@ class Executor:
         return self._finish_run(compiled, feed_arrays, ro_state, rw_state,
                                 program, fetch_names, scope, return_numpy)
 
+    def _maybe_verify_program(self, program, feed, fetch_names, scope):
+        """Verify-before-first-run (FLAGS_check_program): the program
+        verifies statically before its first compile — a malformed
+        program fails with an attributable diagnostic instead of a
+        trace-time error (or a silent miscompile).  The verdict depends
+        on the run's feeds, fetches (the DCE mask scopes checks to the
+        ops that will trace) AND scope (scope-resident names count as
+        defined), so the memo keys on all four; flag off is one flag
+        read."""
+        from .flags import get_flag
+
+        if not get_flag("check_program"):
+            return
+        vkey = (program._version, tuple(sorted(feed)),
+                tuple(fetch_names), id(scope))
+        seen = getattr(program, "_verified_keys", None)
+        if seen is not None and vkey in seen:
+            return
+        from .analysis import check_program as _check_program
+
+        _check_program(
+            program, scope=scope, feeds=list(feed),
+            fetches=fetch_names, dce_fetches=fetch_names)
+        if seen is None or len(seen) > 64:
+            seen = set()
+        seen.add(vkey)
+        program._verified_keys = seen
+
     def _run_slow(self, program, feed, fetch_names, scope, return_numpy,
                   fast_key):
         # pserver program: block on the listen_and_serv service loop
@@ -305,6 +333,8 @@ class Executor:
 
             run_pserver(program, scope, self)
             return []
+
+        self._maybe_verify_program(program, feed, fetch_names, scope)
 
         device = self.place.jax_device()
         import time as _time
@@ -448,6 +478,10 @@ class Executor:
 
         from .flags import get_flag
         from .parallel.mesh import shard_map
+
+        # collective programs get the same verify-before-first-run as
+        # the single-device path (they bypass _run_slow)
+        self._maybe_verify_program(program, feed, fetch_names, scope)
 
         axis, nranks = str(coll["axis"]), int(coll["nranks"])
         if get_flag("prng_impl") != "threefry":

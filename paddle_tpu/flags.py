@@ -288,6 +288,18 @@ DEFINE_flag("program_autotune", True,
             "steps) on a decision-cache miss.  0 = consult-only: misses "
             "return the all-defaults decision and never time anything "
             "(the CI regime, with a pinned FLAGS_program_tune_cache)")
+DEFINE_flag("check_program", False,
+            "static program verification (analysis.verify_program): "
+            "apply_pass re-verifies the program after EVERY registry "
+            "pass (verified-in => verified-out, the TVM pass-infra "
+            "contract) and the executor verifies each program version "
+            "once before its first compile — an ill-formed program "
+            "fails loudly at the pass boundary with the pass and the "
+            "offending op named, instead of at JAX trace time (or "
+            "silently, the PR 12 half-applied-fold bug class).  ON in "
+            "tests/CI (conftest + scripts/ci.sh arm it); OFF by default "
+            "in production hot paths — disabled, the check is a single "
+            "flag read, zero per-step cost")
 DEFINE_flag("prng_impl", "threefry",
             "JAX PRNG for in-program randomness (dropout, *_random, "
             "sampling): 'threefry' (default; splittable counter stream, "

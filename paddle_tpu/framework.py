@@ -373,6 +373,25 @@ class Block:
         return [v for v in self.vars.values() if isinstance(v, Parameter)]
 
     # ---- op management --------------------------------------------------
+    def _normalize_output_dtypes(self, op):
+        """Op construction is where dtype drift enters the IR: a layer
+        that creates its output Variable with a raw numpy dtype (or
+        mutates ``var.dtype`` after the fact) would serialize
+        ``to_dict`` values like ``dtype('float32')`` — desc_codec
+        round-trips then stop being byte-stable.  Normalizing at
+        append/insert time keeps every op-attached var canonical."""
+        for names in op.outputs.values():
+            for n in names:
+                v = self._find_var_recursive(n) if n else None
+                if v is None:
+                    continue
+                dt = v.dtype
+                if dt is not None and not isinstance(dt, str):
+                    try:
+                        v.dtype = _to_dtype_str(dt)
+                    except Exception:
+                        pass  # unresolvable: the verifier flags the drift
+
     def append_op(self, type, inputs=None, outputs=None, attrs=None):
         op = Operator(self, type, inputs, outputs, attrs)
         self.ops.append(op)
@@ -381,18 +400,21 @@ class Block:
                 for v in _as_list(vars_):
                     if isinstance(v, Variable):
                         v.op = op
+        self._normalize_output_dtypes(op)
         self.program._bump_version()
         return op
 
     def prepend_op(self, type, inputs=None, outputs=None, attrs=None):
         op = Operator(self, type, inputs, outputs, attrs)
         self.ops.insert(0, op)
+        self._normalize_output_dtypes(op)
         self.program._bump_version()
         return op
 
     def insert_op(self, index, type, inputs=None, outputs=None, attrs=None):
         op = Operator(self, type, inputs, outputs, attrs)
         self.ops.insert(index, op)
+        self._normalize_output_dtypes(op)
         self.program._bump_version()
         return op
 

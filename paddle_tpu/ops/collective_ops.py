@@ -71,3 +71,13 @@ def _c_allreduce_mean(ctx, ins, attrs):
     gradient — the transpiler's default dense-grad rewrite (the pserver
     path's scale-by-1/N-then-sum, fused into one collective)."""
     return _allreduce(ins, attrs, "mean")
+
+
+# ---------------------------------------------------------------------------
+# static infer rules (analysis/infer.py): collectives are shape/dtype
+# transparent — one tensor in, the reduced tensor out
+# ---------------------------------------------------------------------------
+from ..analysis.infer import register_infer, same_as  # noqa: E402
+
+for _name in ("c_allreduce_sum", "c_allreduce_mean"):
+    register_infer(_name, req_ins=("X",))(same_as("X"))

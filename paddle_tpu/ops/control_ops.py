@@ -389,3 +389,13 @@ def _recompute(ctx, ins, attrs):
            else jax.checkpoint(run))
     outs = run(*vals)
     return {"Out": list(outs)}
+
+
+# ---------------------------------------------------------------------------
+# static infer rules (analysis/infer.py): the propagation engine walks
+# sub-block-owning ops itself (while/cond/recompute recursion); the
+# registrations here pin the slot schemas
+# ---------------------------------------------------------------------------
+from ..analysis.infer import register_infer  # noqa: E402
+
+register_infer("recompute", req_ins=("X",))(None)

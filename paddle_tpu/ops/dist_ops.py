@@ -1328,3 +1328,16 @@ def _ref_by_trainer_id(ctx, ins, attrs):
     return {"Out": [lax.switch(
         jnp.clip(tid.reshape(()).astype(jnp.int32), 0, len(xs) - 1),
         [lambda i=i: xs[i] for i in range(len(xs))])]}
+
+
+# ---------------------------------------------------------------------------
+# static infer rules (analysis/infer.py): the rpc ops are side-effecting
+# wire endpoints — schema-only registrations (outputs are tokens or
+# service-delivered params the transpiler declares)
+# ---------------------------------------------------------------------------
+from ..analysis.infer import register_infer  # noqa: E402
+
+register_infer("send_bucket", req_ins=(), req_outs=())(None)
+register_infer("recv_bucket", req_ins=(), req_outs=())(None)
+register_infer("send_sparse", req_ins=("Ids",), req_outs=())(None)
+register_infer("prefetch", req_ins=("Ids",), req_outs=("Out",))(None)

@@ -220,3 +220,16 @@ def _log_loss(ctx, ins, attrs):
     eps = attrs.get("epsilon", 1e-4)
     out = -y * jnp.log(p + eps) - (1.0 - y) * jnp.log(1.0 - p + eps)
     return {"Loss": [out]}
+
+
+# ---------------------------------------------------------------------------
+# static infer rules (analysis/infer.py)
+# ---------------------------------------------------------------------------
+from ..analysis.infer import register_infer, same_as  # noqa: E402
+
+register_infer("hinge_loss", req_ins=("Logits", "Labels"),
+               req_outs=("Loss",))(same_as("Logits", out_slots=("Loss",)))
+register_infer("log_loss", req_ins=("Predicted", "Labels"),
+               req_outs=("Loss",))(same_as("Predicted", out_slots=("Loss",)))
+register_infer("kldiv_loss", req_ins=("X", "Target"),
+               req_outs=("Loss",))(None)  # shape depends on reduction attr

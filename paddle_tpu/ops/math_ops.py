@@ -597,3 +597,243 @@ def _maximum(ctx, ins, attrs):
 @register("minimum")
 def _minimum(ctx, ins, attrs):
     return {"Out": [jnp.minimum(ins["X"][0], ins["Y"][0])]}
+
+
+# ---------------------------------------------------------------------------
+# static infer rules (analysis/infer.py): registered alongside the
+# lowerings so the shape/dtype contract and the kernel live in one file
+# ---------------------------------------------------------------------------
+from ..analysis.infer import (  # noqa: E402
+    InferError,
+    VarInfo,
+    elementwise_shape,
+    register_infer,
+    same_as,
+    same_dtype,
+    slot_info as _i,
+)
+
+
+def _ew_infer(op, ins):
+    x, y = _i(ins, "X"), _i(ins, "Y")
+    shape = elementwise_shape(x, y, op.attrs.get("axis", -1))
+    return {"Out": [VarInfo(shape, same_dtype(x, y))]}
+
+
+for _name in (
+    "elementwise_add", "elementwise_sub", "elementwise_mul",
+    "elementwise_div", "elementwise_max", "elementwise_min",
+    "elementwise_pow", "elementwise_mod", "elementwise_floordiv",
+    "maximum", "minimum",
+):
+    register_infer(_name, req_ins=("X", "Y"))(_ew_infer)
+
+
+def _cmp_infer(op, ins):
+    x, y = _i(ins, "X"), _i(ins, "Y")
+    shape = elementwise_shape(x, y, op.attrs.get("axis", -1))
+    return {"Out": [VarInfo(shape, "bool")]}
+
+
+for _name in (
+    "less_than", "less_equal", "greater_than", "greater_equal",
+    "equal", "not_equal", "logical_and", "logical_or", "logical_xor",
+):
+    register_infer(_name, req_ins=("X", "Y"))(_cmp_infer)
+register_infer("logical_not", req_ins=("X",))(
+    lambda op, ins: {"Out": [VarInfo(
+        _i(ins, "X").shape if _i(ins, "X") else None, "bool")]})
+
+for _name in tuple(_ACTS) + (
+    "pow", "clip", "clip_by_norm", "softmax", "log_softmax", "cumsum",
+):
+    register_infer(_name, req_ins=("X",))(same_as("X"))
+register_infer("scale", req_ins=("X",))(same_as("X"))
+register_infer("prelu", req_ins=("X", "Alpha"))(same_as("X"))
+
+
+def _reduce_infer(op, ins):
+    x = _i(ins, "X")
+    if x is None or x.shape is None:
+        return {"Out": [VarInfo(None, x.dtype if x else None)]}
+    nd = len(x.shape)
+    if op.attrs.get("reduce_all", False):
+        axes = set(range(nd))
+    else:
+        dim = op.attrs.get("dim", [0])
+        dim = dim if isinstance(dim, (list, tuple)) else [dim]
+        axes = set(int(d) % nd for d in dim)
+    keep = bool(op.attrs.get("keep_dim", False))
+    shape = tuple(
+        1 if (i in axes and keep) else d
+        for i, d in enumerate(x.shape)
+        if keep or i not in axes)
+    return {"Out": [VarInfo(shape, x.dtype)]}
+
+
+for _name in ("reduce_sum", "reduce_mean", "reduce_max", "reduce_min",
+              "reduce_prod"):
+    register_infer(_name, req_ins=("X",))(_reduce_infer)
+
+
+@register_infer("mean", req_ins=("X",))
+def _mean_infer(op, ins):
+    x = _i(ins, "X")
+    return {"Out": [VarInfo((1,), x.dtype if x else None)]}
+
+
+@register_infer("sum", req_ins=("X",))
+def _sum_infer(op, ins):
+    x = _i(ins, "X")
+    if x is None:
+        return {}
+    return {"Out": [VarInfo(x.shape, x.dtype)]}
+
+
+def _mm_flat(shape, k):
+    lead, tail = shape[:k], shape[k:]
+    from ..analysis.infer import numel_known
+
+    return numel_known(lead), numel_known(tail)
+
+
+@register_infer("mul", req_ins=("X", "Y"))
+def _mul_infer(op, ins):
+    x, y = _i(ins, "X"), _i(ins, "Y")
+    if x is None or y is None or x.shape is None or y.shape is None:
+        return {"Out": [VarInfo(None, same_dtype(x, y))]}
+    xn = int(op.attrs.get("x_num_col_dims", 1))
+    yn = int(op.attrs.get("y_num_col_dims", 1))
+    if not (0 < xn < len(x.shape) + 1 and 0 < yn < len(y.shape) + 1):
+        raise InferError(
+            "mul num_col_dims (%d, %d) out of range for ranks (%d, %d)"
+            % (xn, yn, len(x.shape), len(y.shape)))
+    _, xk = _mm_flat(x.shape, xn)
+    yk, _ = _mm_flat(y.shape, yn)
+    if xk is not None and yk is not None and xk != yk:
+        raise InferError(
+            "mul contraction mismatch: X%s flattens to K=%d but Y%s "
+            "expects K=%d" % (x.shape, xk, y.shape, yk))
+    shape = tuple(x.shape[:xn]) + tuple(y.shape[yn:])
+    return {"Out": [VarInfo(shape, same_dtype(x, y))]}
+
+
+@register_infer("matmul", req_ins=("X", "Y"))
+def _matmul_infer(op, ins):
+    from ..analysis.infer import broadcast_shapes
+
+    x, y = _i(ins, "X"), _i(ins, "Y")
+    if x is None or y is None or x.shape is None or y.shape is None:
+        return {"Out": [VarInfo(None, same_dtype(x, y))]}
+    xs, ys = list(x.shape), list(y.shape)
+    tx = bool(op.attrs.get("transpose_X", False))
+    ty = bool(op.attrs.get("transpose_Y", False))
+    if len(xs) == 1:
+        xs = [1, xs[0]] if not tx else [xs[0], 1]
+    if len(ys) == 1:
+        ys = [ys[0], 1] if not ty else [1, ys[0]]
+    if tx:
+        xs[-1], xs[-2] = xs[-2], xs[-1]
+    if ty:
+        ys[-1], ys[-2] = ys[-2], ys[-1]
+    if xs[-1] >= 0 and ys[-2] >= 0 and xs[-1] != ys[-2]:
+        raise InferError(
+            "matmul contraction mismatch: %s @ %s (transpose_X=%s, "
+            "transpose_Y=%s)" % (x.shape, y.shape, tx, ty))
+    batch = broadcast_shapes(xs[:-2], ys[:-2], "matmul batch")
+    shape = None if batch is None else tuple(batch) + (xs[-2], ys[-1])
+    return {"Out": [VarInfo(shape, same_dtype(x, y))]}
+
+
+@register_infer("dot", req_ins=("X", "Y"))
+def _dot_infer(op, ins):
+    x = _i(ins, "X")
+    if x is None or x.shape is None:
+        return {}
+    return {"Out": [VarInfo(x.shape[:-1] + (1,), x.dtype)]}
+
+
+def _rowloss_shape(x):
+    if x is None or x.shape is None:
+        return None
+    return x.shape[:-1] + (1,)
+
+
+@register_infer("cross_entropy", req_ins=("X", "Label"), req_outs=("Y",))
+def _xent_infer(op, ins):
+    x = _i(ins, "X")
+    return {"Y": [VarInfo(_rowloss_shape(x), x.dtype if x else None)]}
+
+
+@register_infer("softmax_with_cross_entropy", req_ins=("Logits", "Label"),
+                req_outs=("Loss",))
+def _sxent_infer(op, ins):
+    x = _i(ins, "Logits")
+    return {
+        "Softmax": [VarInfo(x.shape if x else None, x.dtype if x else None)],
+        "Loss": [VarInfo(_rowloss_shape(x), x.dtype if x else None)],
+    }
+
+
+@register_infer("smooth_label_xent", req_ins=("Logits", "Label"),
+                req_outs=("Loss",))
+def _slx_infer(op, ins):
+    x = _i(ins, "Logits")
+    return {"Loss": [VarInfo(_rowloss_shape(x), x.dtype if x else None)]}
+
+
+@register_infer("fused_linear_xent", req_ins=("X", "W", "Label"),
+                req_outs=("Loss",))
+def _flx_infer(op, ins):
+    x, w = _i(ins, "X"), _i(ins, "W")
+    if x is None or x.shape is None:
+        return {}
+    if (w is not None and w.shape is not None and len(w.shape) == 2
+            and x.shape[-1] >= 0):
+        h = w.shape[1] if op.attrs.get("transpose_w", False) else w.shape[0]
+        if h >= 0 and x.shape[-1] != h:
+            raise InferError(
+                "fused_linear_xent hidden-dim mismatch: X%s vs W%s "
+                "(transpose_w=%s)" % (x.shape, w.shape,
+                                      bool(op.attrs.get("transpose_w"))))
+    return {"Loss": [VarInfo(_rowloss_shape(x), x.dtype)]}
+
+
+@register_infer("square_error_cost", req_ins=("X", "Y"))
+def _sec_infer(op, ins):
+    x = _i(ins, "X")
+    return {"Out": [VarInfo(x.shape if x else None, x.dtype if x else None)]}
+
+
+@register_infer("top_k", req_ins=("X",), req_outs=("Out", "Indices"))
+def _topk_infer(op, ins):
+    x = _i(ins, "X")
+    if x is None or x.shape is None:
+        return {}
+    k = int(op.attrs.get("k", 1))
+    shape = x.shape[:-1] + (k,)
+    return {"Out": [VarInfo(shape, x.dtype)],
+            "Indices": [VarInfo(shape, None)]}
+
+
+@register_infer("accuracy", req_ins=("Indices", "Label"),
+                req_outs=("Accuracy",))
+def _acc_infer(op, ins):
+    return {"Accuracy": [VarInfo((1,), "float32")]}
+
+
+def _arg_infer(op, ins):
+    x = _i(ins, "X")
+    if x is None or x.shape is None:
+        return {}
+    nd = len(x.shape)
+    ax = int(op.attrs.get("axis", -1)) % nd
+    keep = bool(op.attrs.get("keepdims", False))
+    shape = tuple(
+        1 if (i == ax and keep) else d
+        for i, d in enumerate(x.shape) if keep or i != ax)
+    return {"Out": [VarInfo(shape, None)]}
+
+
+register_infer("arg_max", req_ins=("X",))(_arg_infer)
+register_infer("arg_min", req_ins=("X",))(_arg_infer)

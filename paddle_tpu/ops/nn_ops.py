@@ -1325,3 +1325,189 @@ def _rotary_embed(ctx, ins, attrs):
     x1, x2 = x[..., :half], x[..., half:]
     out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1)
     return {"Out": [out]}
+
+
+# ---------------------------------------------------------------------------
+# static infer rules (analysis/infer.py)
+# ---------------------------------------------------------------------------
+from ..analysis.infer import (  # noqa: E402
+    InferError,
+    VarInfo,
+    numel_known,
+    register_infer,
+    same_as,
+    same_dtype,
+    slot_info as _vi,
+)
+
+
+def _conv_hw(dim, k, s, p, d, ceil_mode=False):
+    if dim < 0:
+        return -1
+    eff = d * (k - 1) + 1
+    num = dim + 2 * p - eff
+    if num < 0:
+        raise InferError(
+            "conv/pool window (k=%d, dilation=%d) exceeds padded input "
+            "dim %d" % (k, d, dim + 2 * p))
+    if ceil_mode:
+        return -(-num // s) + 1
+    return num // s + 1
+
+
+@register_infer("conv2d", req_ins=("Input", "Filter"), req_outs=("Output",))
+@register_infer("depthwise_conv2d", req_ins=("Input", "Filter"),
+                req_outs=("Output",))
+def _conv2d_infer(op, ins):
+    x, w = _vi(ins, "Input"), _vi(ins, "Filter")
+    if x is None or x.shape is None or w is None or w.shape is None:
+        return {}
+    if len(x.shape) != 4 or len(w.shape) != 4:
+        raise InferError(
+            "conv2d expects rank-4 Input/Filter, got %s / %s"
+            % (x.shape, w.shape))
+    a = op.attrs
+    strides = _pair(a.get("strides", [1, 1]))
+    pads = _pair(a.get("paddings", [0, 0]))
+    dils = _pair(a.get("dilations", [1, 1]))
+    nhwc = a.get("data_format", "NCHW") == "NHWC"
+    h_ax, w_ax, c_ax = (1, 2, 3) if nhwc else (2, 3, 1)
+    groups = a.get("groups", 1) or 1
+    if op.type == "depthwise_conv2d":
+        groups = x.shape[c_ax] if x.shape[c_ax] >= 0 else groups
+    cin = x.shape[c_ax]
+    if cin >= 0 and w.shape[1] >= 0 and groups and cin != w.shape[1] * groups:
+        raise InferError(
+            "conv2d channel mismatch: input C=%d vs Filter[1]*groups=%d*%d"
+            % (cin, w.shape[1], groups))
+    oh = _conv_hw(x.shape[h_ax], w.shape[2], strides[0], pads[0], dils[0])
+    ow = _conv_hw(x.shape[w_ax], w.shape[3], strides[1], pads[1], dils[1])
+    shape = [x.shape[0], 0, 0, 0]
+    shape[h_ax], shape[w_ax], shape[c_ax] = oh, ow, w.shape[0]
+    return {"Output": [VarInfo(tuple(shape), x.dtype)]}
+
+
+@register_infer("pool2d", req_ins=("X",))
+def _pool2d_infer(op, ins):
+    x = _vi(ins, "X")
+    if x is None or x.shape is None:
+        return {}
+    if len(x.shape) != 4:
+        raise InferError("pool2d expects rank-4 input, got %s" % (x.shape,))
+    a = op.attrs
+    nhwc = a.get("data_format", "NCHW") == "NHWC"
+    sp = (1, 2) if nhwc else (2, 3)
+    shape = list(x.shape)
+    if a.get("global_pooling", False) or (
+            a.get("adaptive", False) and list(a.get("ksize")) == [1, 1]):
+        shape[sp[0]] = shape[sp[1]] = 1
+        return {"Out": [VarInfo(tuple(shape), x.dtype)]}
+    ksize = _pair(a.get("ksize", [2, 2]))
+    strides = _pair(a.get("strides", [1, 1]))
+    pads = _pair(a.get("paddings", [0, 0]))
+    ceil = bool(a.get("ceil_mode", False))
+    shape[sp[0]] = _conv_hw(x.shape[sp[0]], ksize[0], strides[0], pads[0],
+                            1, ceil)
+    shape[sp[1]] = _conv_hw(x.shape[sp[1]], ksize[1], strides[1], pads[1],
+                            1, ceil)
+    return {"Out": [VarInfo(tuple(shape), x.dtype)]}
+
+
+@register_infer("batch_norm", req_ins=("X", "Scale", "Bias", "Mean",
+                                       "Variance"), req_outs=("Y",))
+def _bn_infer(op, ins):
+    x, mean = _vi(ins, "X"), _vi(ins, "Mean")
+    xi = VarInfo(x.shape, x.dtype) if x is not None else None
+    stat = VarInfo(mean.shape, None) if mean is not None else None
+    return {
+        "Y": [xi],
+        "MeanOut": [stat], "VarianceOut": [stat],
+        "SavedMean": [stat], "SavedVariance": [stat],
+    }
+
+
+@register_infer("layer_norm", req_ins=("X",), req_outs=("Y",))
+def _ln_infer(op, ins):
+    x = _vi(ins, "X")
+    if x is None:
+        return {}
+    begin = int(op.attrs.get("begin_norm_axis", 1))
+    stat = None
+    if x.shape is not None:
+        stat = VarInfo(x.shape[:begin], None)
+    return {"Y": [VarInfo(x.shape, x.dtype)],
+            "Mean": [stat], "Variance": [stat]}
+
+
+@register_infer("dropout", req_ins=("X",))
+def _dropout_infer(op, ins):
+    x = _vi(ins, "X")
+    xi = VarInfo(x.shape, x.dtype) if x is not None else None
+    return {"Out": [xi], "Mask": [xi]}
+
+
+@register_infer("fc", req_ins=("Input", "W"))
+def _fc_infer(op, ins):
+    x, w = _vi(ins, "Input"), _vi(ins, "W")
+    if x is None or x.shape is None or w is None or w.shape is None:
+        return {"Out": [VarInfo(None, same_dtype(x, w))]}
+    k = int(op.attrs.get("in_num_col_dims", 1))
+    xk = numel_known(x.shape[k:])
+    if (len(w.shape) == 2 and xk is not None and w.shape[0] >= 0
+            and xk != w.shape[0]):
+        raise InferError(
+            "fc contraction mismatch: Input%s flattens to K=%d but W%s "
+            "expects K=%d" % (x.shape, xk, w.shape, w.shape[0]))
+    return {"Out": [VarInfo(tuple(x.shape[:k]) + (w.shape[-1],),
+                            same_dtype(x, w))]}
+
+
+@register_infer("fused_swiglu", req_ins=("X", "GateW", "UpW"))
+def _swiglu_infer(op, ins):
+    x, wg = _vi(ins, "X"), _vi(ins, "GateW")
+    if x is None or x.shape is None or wg is None or wg.shape is None:
+        return {}
+    k = int(op.attrs.get("x_num_col_dims", 1))
+    return {"Out": [VarInfo(tuple(x.shape[:k]) + (wg.shape[-1],),
+                            same_dtype(x, wg))]}
+
+
+@register_infer("fused_residual_ln", req_ins=("X", "Y", "Scale", "Bias"),
+                req_outs=("Y", "Sum"))
+def _frln_infer(op, ins):
+    x = _vi(ins, "X")
+    if x is None:
+        return {}
+    xi = VarInfo(x.shape, x.dtype)
+    stat = VarInfo(x.shape[:-1], None) if x.shape is not None else None
+    return {"Sum": [xi], "Y": [xi], "Mean": [stat], "Variance": [stat]}
+
+
+@register_infer("fused_attention", req_ins=("Q", "K", "V"))
+def _fattn_infer(op, ins):
+    q, k, v = _vi(ins, "Q"), _vi(ins, "K"), _vi(ins, "V")
+    for name, t in (("Q", q), ("K", k), ("V", v)):
+        if t is not None and t.shape is not None and len(t.shape) != 4:
+            raise InferError(
+                "fused_attention %s must be rank-4 [B, H, T, D], got %s"
+                % (name, t.shape))
+    if (q is not None and k is not None and q.shape is not None
+            and k.shape is not None and q.shape[-1] >= 0
+            and k.shape[-1] >= 0 and q.shape[-1] != k.shape[-1]):
+        raise InferError(
+            "fused_attention head-dim mismatch: Q%s vs K%s"
+            % (q.shape, k.shape))
+    return {"Out": [VarInfo(q.shape if q else None, q.dtype if q else None)]}
+
+
+register_infer("seq_cache_write", req_ins=("Cache", "New", "Pos"))(
+    same_as("Cache"))
+register_infer("slot_cache_write",
+               req_ins=("Cache", "New", "Pos", "Width"))(same_as("Cache"))
+register_infer("rotary_embed", req_ins=("X",))(same_as("X"))
+
+
+@register_infer("decode_pos_mask", req_ins=("Pos",))
+def _dpm_infer(op, ins):
+    return {"Out": [VarInfo(
+        (int(op.attrs["batch"]), int(op.attrs["t_max"])), "float32")]}

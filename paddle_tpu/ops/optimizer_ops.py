@@ -310,3 +310,31 @@ def _model_average_accum(ctx, ins, attrs):
     s_out = jnp.where(restart, p.astype(s.dtype), s + p.astype(s.dtype))
     n_out = jnp.where(restart, jnp.ones_like(n), new_n)
     return {"SumOut": [s_out], "NumOut": [n_out], "NumUpdatesOut": [new_nu]}
+
+
+# ---------------------------------------------------------------------------
+# static infer rules (analysis/infer.py): every optimizer out-slot
+# `<X>Out` mirrors its in-slot `<X>` (in-place persistable updates)
+# ---------------------------------------------------------------------------
+from ..analysis.infer import VarInfo, register_infer  # noqa: E402
+
+
+def _opt_infer(op, ins):
+    outs = {}
+    for slot in op.outputs:
+        if not slot.endswith("Out"):
+            continue
+        src = ins.get(slot[:-len("Out")])
+        if src and src[0] is not None:
+            outs[slot] = [VarInfo(src[0].shape, src[0].dtype)]
+    return outs
+
+
+for _name in (
+    "sgd", "momentum", "lars_momentum", "adam", "adamax", "adagrad",
+    "proximal_gd", "proximal_adagrad", "rmsprop", "ftrl",
+    "decayed_adagrad", "adadelta",
+):
+    register_infer(
+        _name, req_ins=("Param", "Grad"), req_outs=("ParamOut",)
+    )(_opt_infer)

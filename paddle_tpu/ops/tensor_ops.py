@@ -480,3 +480,240 @@ def _uniform_random_bsl(ctx, ins, attrs):
         maxval=attrs.get("max", 1.0),
     )
     return {"Out": [out.astype(jdt(attrs.get("dtype", "float32")))]}
+
+
+# ---------------------------------------------------------------------------
+# static infer rules (analysis/infer.py)
+# ---------------------------------------------------------------------------
+from ..analysis.infer import (  # noqa: E402
+    InferError,
+    VarInfo,
+    attr_dtype,
+    numel_known,
+    register_infer,
+    same_as,
+    slot_info as _i,
+)
+
+
+def _shape_attr_infer(op, ins):
+    shape = tuple(int(s) for s in op.attrs.get("shape", [1]))
+    return {"Out": [VarInfo(
+        shape, attr_dtype(op.attrs.get("dtype"), "float32"))]}
+
+
+register_infer("fill_constant", req_ins=())(_shape_attr_infer)
+register_infer("uniform_random", req_ins=())(_shape_attr_infer)
+register_infer("gaussian_random", req_ins=())(_shape_attr_infer)
+register_infer("truncated_gaussian_random", req_ins=())(_shape_attr_infer)
+register_infer("randint", req_ins=())(_shape_attr_infer)
+
+
+@register_infer("assign_value", req_ins=())
+def _assign_value_infer(op, ins):
+    shape = op.attrs.get("shape", None)
+    return {"Out": [VarInfo(
+        tuple(int(s) for s in shape) if shape else None,
+        attr_dtype(op.attrs.get("np_dtype"), "float32"))]}
+
+
+register_infer("assign", req_ins=("X",))(same_as("X"))
+register_infer("fill_zeros_like", req_ins=("X",))(same_as("X"))
+register_infer("fill_any_like", req_ins=("X",))(same_as("X"))
+register_infer("increment", req_ins=("X",))(same_as("X"))
+
+
+@register_infer("shape", req_ins=("Input",))
+def _shape_op_infer(op, ins):
+    x = _i(ins, "Input")
+    nd = None if x is None or x.shape is None else len(x.shape)
+    return {"Out": [VarInfo((nd,) if nd is not None else None, "int32")]}
+
+
+@register_infer("reshape", req_ins=("X",))
+@register_infer("reshape2", req_ins=("X",))
+def _reshape_infer(op, ins):
+    x = _i(ins, "X")
+    target = [int(s) for s in op.attrs["shape"]]
+    xshape = None if x is None else x.shape
+    out = []
+    for i, s in enumerate(target):
+        if s == 0:
+            if xshape is None or i >= len(xshape):
+                out.append(-1)
+            else:
+                out.append(xshape[i])
+        else:
+            out.append(s)
+    if -1 in out:
+        total = numel_known(xshape) if xshape is not None else None
+        known = numel_known([d for d in out if d != -1])
+        if total is not None and known:
+            if out.count(-1) == 1 and total % known == 0:
+                out[out.index(-1)] = total // known
+    else:
+        total = numel_known(xshape) if xshape is not None else None
+        tgt = numel_known(out)
+        if total is not None and tgt is not None and total != tgt:
+            raise InferError(
+                "reshape of %s (%d elements) to %s (%d elements)"
+                % (xshape, total, tuple(out), tgt))
+    return {"Out": [VarInfo(tuple(out), x.dtype if x else None)]}
+
+
+@register_infer("transpose", req_ins=("X",))
+@register_infer("transpose2", req_ins=("X",))
+def _transpose_infer(op, ins):
+    x = _i(ins, "X")
+    if x is None or x.shape is None:
+        return {}
+    perm = [int(a) for a in op.attrs["axis"]]
+    if sorted(perm) != list(range(len(x.shape))):
+        raise InferError(
+            "transpose axis %s is not a permutation of rank %d"
+            % (perm, len(x.shape)))
+    return {"Out": [VarInfo(tuple(x.shape[a] for a in perm), x.dtype)]}
+
+
+@register_infer("squeeze", req_ins=("X",))
+@register_infer("squeeze2", req_ins=("X",))
+def _squeeze_infer(op, ins):
+    x = _i(ins, "X")
+    if x is None or x.shape is None:
+        return {}
+    axes = op.attrs.get("axes", [])
+    if not axes:
+        shape = tuple(d for d in x.shape if d != 1)
+    else:
+        drop = set(int(a) % len(x.shape) for a in axes)
+        shape = tuple(d for i, d in enumerate(x.shape) if i not in drop)
+    return {"Out": [VarInfo(shape, x.dtype)]}
+
+
+@register_infer("unsqueeze", req_ins=("X",))
+@register_infer("unsqueeze2", req_ins=("X",))
+def _unsqueeze_infer(op, ins):
+    x = _i(ins, "X")
+    if x is None or x.shape is None:
+        return {}
+    shape = list(x.shape)
+    for a in sorted(int(a) for a in op.attrs["axes"]):
+        shape.insert(a if a >= 0 else a + len(shape) + 1, 1)
+    return {"Out": [VarInfo(tuple(shape), x.dtype)]}
+
+
+@register_infer("flatten", req_ins=("X",))
+@register_infer("flatten2", req_ins=("X",))
+def _flatten_infer(op, ins):
+    x = _i(ins, "X")
+    if x is None or x.shape is None:
+        return {}
+    axis = int(op.attrs.get("axis", 1))
+    lead = numel_known(x.shape[:axis]) if axis > 0 else 1
+    tail = numel_known(x.shape[axis:])
+    return {"Out": [VarInfo(
+        (lead if lead is not None else -1,
+         tail if tail is not None else -1), x.dtype)]}
+
+
+@register_infer("concat", req_ins=("X",))
+def _concat_infer(op, ins):
+    xs = [v for v in ins.get("X", []) if v is not None]
+    if not xs or any(v.shape is None for v in xs):
+        return {}
+    nd = len(xs[0].shape)
+    if any(len(v.shape) != nd for v in xs):
+        raise InferError(
+            "concat rank mismatch: %s" % [v.shape for v in xs])
+    ax = int(op.attrs.get("axis", 0)) % nd
+    shape = []
+    for i in range(nd):
+        if i == ax:
+            dims = [v.shape[i] for v in xs]
+            shape.append(-1 if any(d < 0 for d in dims) else sum(dims))
+        else:
+            dims = set(v.shape[i] for v in xs if v.shape[i] >= 0)
+            if len(dims) > 1:
+                raise InferError(
+                    "concat non-axis dim %d mismatch: %s"
+                    % (i, [v.shape for v in xs]))
+            shape.append(dims.pop() if dims else -1)
+    return {"Out": [VarInfo(tuple(shape), xs[0].dtype)]}
+
+
+@register_infer("stack", req_ins=("X",), req_outs=("Y",))
+def _stack_infer(op, ins):
+    xs = [v for v in ins.get("X", []) if v is not None]
+    if not xs or xs[0].shape is None:
+        return {}
+    ax = int(op.attrs.get("axis", 0))
+    shape = list(xs[0].shape)
+    shape.insert(ax if ax >= 0 else ax + len(shape) + 1, len(xs))
+    return {"Y": [VarInfo(tuple(shape), xs[0].dtype)]}
+
+
+@register_infer("slice", req_ins=("Input",))
+def _slice_infer(op, ins):
+    x = _i(ins, "Input")
+    if x is None or x.shape is None:
+        return {}
+    shape = list(x.shape)
+    for a, s, e in zip(op.attrs["axes"], op.attrs["starts"],
+                       op.attrs["ends"]):
+        a, s, e = int(a), int(s), int(e)
+        dim = shape[a]
+        if dim < 0:
+            continue
+        s = max(s + dim, 0) if s < 0 else min(s, dim)
+        e = max(e + dim, 0) if e < 0 else min(e, dim)
+        shape[a] = max(e - s, 0)
+    for a in sorted(
+            (int(a) for a in op.attrs.get("decrease_axis", [])),
+            reverse=True):
+        del shape[a]
+    return {"Out": [VarInfo(tuple(shape), x.dtype)]}
+
+
+@register_infer("cast", req_ins=("X",))
+def _cast_infer(op, ins):
+    x = _i(ins, "X")
+    return {"Out": [VarInfo(
+        x.shape if x else None, attr_dtype(op.attrs.get("out_dtype")))]}
+
+
+@register_infer("gather", req_ins=("X", "Index"))
+def _gather_infer(op, ins):
+    x, idx = _i(ins, "X"), _i(ins, "Index")
+    if x is None or x.shape is None or idx is None or idx.shape is None:
+        return {}
+    ax = int(op.attrs.get("axis", 0)) % len(x.shape)
+    shape = x.shape[:ax] + idx.shape + x.shape[ax + 1:]
+    return {"Out": [VarInfo(shape, x.dtype)]}
+
+
+@register_infer("lookup_table", req_ins=("W", "Ids"))
+@register_infer("lookup_table_v2", req_ins=("W", "Ids"))
+def _lookup_infer(op, ins):
+    w, ids = _i(ins, "W"), _i(ins, "Ids")
+    if w is None or w.shape is None or ids is None or ids.shape is None:
+        return {}
+    ishape = ids.shape
+    if len(ishape) >= 2 and ishape[-1] == 1:
+        ishape = ishape[:-1]
+    return {"Out": [VarInfo(ishape + (w.shape[-1],), w.dtype)]}
+
+
+@register_infer("one_hot", req_ins=("X",))
+def _one_hot_infer(op, ins):
+    x = _i(ins, "X")
+    if x is None or x.shape is None:
+        return {}
+    shape = x.shape
+    if len(shape) >= 2 and shape[-1] == 1:
+        shape = shape[:-1]
+    return {"Out": [VarInfo(shape + (int(op.attrs["depth"]),), "float32")]}
+
+
+register_infer("expand", req_ins=("X",))(None)
+register_infer("split", req_ins=("X",))(None)
+register_infer("scatter", req_ins=("X", "Ids", "Updates"))(same_as("X"))

@@ -336,12 +336,9 @@ class SeqexpandConcatFcFusePass(Pass):
         changed = True
         while changed:
             changed = False
-            producers, consumers = {}, {}
-            for op in block.ops:
-                for name in op.input_arg_names():
-                    consumers.setdefault(name, []).append(op)
-                for name in op.output_arg_names():
-                    producers[name] = op
+            from ..analysis.graph import consumer_ops, producer_ops
+
+            producers, consumers = producer_ops(block), consumer_ops(block)
             for cat in list(block.ops):
                 if cat.type != "concat":
                     continue
@@ -587,10 +584,9 @@ class SwigluFusePass(Pass):
         changed = True
         while changed:
             changed = False
-            producers = {}
-            for op in block.ops:
-                for name in op.output_arg_names():
-                    producers[name] = op
+            from ..analysis.graph import producer_ops
+
+            producers = producer_ops(block)
             for emul in list(block.ops):
                 if emul.type != "elementwise_mul":
                     continue

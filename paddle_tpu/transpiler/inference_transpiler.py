@@ -154,19 +154,18 @@ class InferenceTranspiler:
         return dropped
 
     # ------------------------------------------------------------------
+    # producer/consumer maps come from the one shared def-use helper set
+    # (analysis.graph) — the fold logic below keys off the SAME edges the
+    # verifier and the fuse-pass matcher see
     def _producer_map(self, block):
-        prod = {}
-        for i, op in enumerate(block.ops):
-            for n in op.output_arg_names():
-                prod[n] = i
-        return prod
+        from ..analysis.graph import producer_map
+
+        return producer_map(block)
 
     def _consumer_count(self, block):
-        cnt = {}
-        for op in block.ops:
-            for n in op.input_arg_names():
-                cnt[n] = cnt.get(n, 0) + 1
-        return cnt
+        from ..analysis.graph import consumer_count
+
+        return consumer_count(block)
 
     def _fold_batch_norm(self, program, scope):
         """producer (+ bias add) (+ pure scale) -> batch_norm  ==>

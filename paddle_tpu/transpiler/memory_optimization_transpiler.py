@@ -49,21 +49,14 @@ class ControlFlowGraph:
     reuse plan would alias storage a loop body still reads."""
 
     def __init__(self, program, block_idx=0):
-        from ..core.trace import op_sub_blocks, sub_block_external_reads
+        from ..analysis.graph import def_use_lists
 
         self.program = program
         self.block = program.block(block_idx)
         self.ops = self.block.ops
-        self.defs = []
-        self.uses = []
-        for op in self.ops:
-            self.defs.append(set(op.output_arg_names()))
-            uses = set(op.input_arg_names())
-            for sub_idx in op_sub_blocks(op):
-                bound = op.attrs.get("__bound_names__", ())
-                uses.update(sub_block_external_reads(
-                    program, program.block(sub_idx), bound))
-            self.uses.append(uses)
+        # the one shared def-use construction (analysis.graph): uses
+        # include sub-block external reads, per the class contract above
+        self.defs, self.uses = def_use_lists(program, block_idx)
 
     def live_ranges(self):
         """var -> (first def idx, last use idx)."""
@@ -159,13 +152,16 @@ def memory_optimize(input_program, skip_opt_set=None, print_log=False, level=0):
                 if nbytes:
                     free_pool.append((name, nbytes, var_key(name)))
 
-    # defense in depth: no plan may ever pair mismatched vars
-    for name, cand in reuse.items():
-        if var_key(name) != var_key(cand):  # pragma: no cover
-            raise AssertionError(
-                "memory_optimize produced a cross-dtype/shape alias "
-                "%r -> %r (%s vs %s)" % (name, cand, var_key(name),
-                                         var_key(cand)))
+    # defense in depth: no plan may ever pair mismatched vars — the
+    # check is the verifier's alias-plan diagnostic (one implementation
+    # shared with verify_program's consumers)
+    from ..analysis.verifier import alias_plan_diagnostics
+
+    bad = alias_plan_diagnostics(block, reuse)
+    if bad:  # pragma: no cover
+        raise AssertionError(
+            "memory_optimize produced unsound aliases:\n  "
+            + "\n  ".join(str(d) for d in bad))
 
     donate = sorted(
         n

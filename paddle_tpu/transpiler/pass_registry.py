@@ -74,9 +74,23 @@ def list_passes():
 
 
 def apply_pass(program, name, scope=None):
-    """Apply one registered pass; returns the (possibly same) program."""
+    """Apply one registered pass; returns the (possibly same) program.
+
+    Under ``FLAGS_check_program`` the result is statically re-verified
+    (analysis.verify_after_pass): verified-in => verified-out becomes a
+    structural property of every registry pass, and a pass emitting an
+    ill-formed program fails HERE with the pass and offending op named
+    instead of at trace time.  Flag off = one flag read, no other cost.
+    """
     out = get_pass(name).apply(program, scope=scope)
-    return out if out is not None else program
+    out = out if out is not None else program
+    from ..flags import get_flag
+
+    if get_flag("check_program"):
+        from ..analysis import verify_after_pass
+
+        verify_after_pass(out, name, scope=scope)
+    return out
 
 
 class OpPattern:
@@ -95,11 +109,9 @@ class OpPattern:
         self.op_types = list(op_types)
 
     def _consumer_map(self, block):
-        consumers = {}
-        for i, op in enumerate(block.ops):
-            for name in op.input_arg_names():
-                consumers.setdefault(name, []).append(i)
-        return consumers
+        from ..analysis.graph import consumer_map
+
+        return consumer_map(block)
 
     def match(self, block):
         """Yield lists of Operators matching the chain."""

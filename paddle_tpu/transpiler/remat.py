@@ -162,34 +162,19 @@ def detect_segments(program, block_idx=0, min_ops=3):
 
 
 def _wrappable(program, ops_seg):
+    from ..analysis.verifier import segment_diagnostics
     from ..core.registry import OPS
 
-    block = program.global_block()
-    seg_set = set(id(op) for op in ops_seg)
-    defined = set()
     for op in ops_seg:
         if op.type in _UNWRAPPABLE:
             return False
         opdef = OPS.get(op.type)
         if opdef is not None and getattr(opdef, "side_effect", False):
             return False
-        for name in op.output_arg_names():
-            v = block._find_var_recursive(name)
-            if v is not None and v.persistable:
-                # stateful updates cannot cross a remat boundary
-                # (layers.recompute enforces the same contract)
-                return False
-            defined.add(name)
-    # non-SSA guard: a name this segment defines must have no OTHER
-    # writer — a redefinition across the boundary would change which
-    # value the private sub-block env exports
-    for blk in program.blocks:
-        for op in blk.ops:
-            if id(op) in seg_set:
-                continue
-            if any(name in defined for name in op.output_arg_names()):
-                return False
-    return True
+    # persistable-write + non-SSA-redefinition refusals are the
+    # verifier's segment diagnostics (one implementation; the same
+    # hazards verify_program reports when a recompute op already exists)
+    return not segment_diagnostics(program, ops_seg)
 
 
 def wrap_segment(program, ops_seg, protect=(), policy=None):

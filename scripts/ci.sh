@@ -8,9 +8,20 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 export PALLAS_AXON_POOL_IPS=
 export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+# static program verification rides the WHOLE suite: every apply_pass
+# postcondition-checks its result and every program verifies before its
+# first compile (docs/STATIC_ANALYSIS.md; flag off = zero per-step cost)
+export FLAGS_check_program=1
 
 echo "== byte-compile check =="
 python -m compileall -q paddle_tpu tools examples bench.py __graft_entry__.py
+
+echo "== static-analysis lane (tools/check_program.py) =="
+# every model-builder program (train / decode / ragged serving /
+# dist-transpiled / remat'd / AMP'd / fused / int8) built and verified
+# through its full pass pipeline WITHOUT tracing — a miscompiling pass
+# combination fails here, before any test lane spends trace time on it
+python tools/check_program.py
 
 echo "== public API surface check (tools/diff_api.py) =="
 python tools/print_signatures.py paddle_tpu > /tmp/api_actual.spec

@@ -10,6 +10,11 @@ import os
 import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# static program verification is ON for the whole suite (the tests/CI
+# regime of FLAGS_check_program): every apply_pass postcondition-checks
+# its result and every program verifies once before its first compile.
+# An explicit env value (e.g. a lane measuring the flag-off cost) wins.
+os.environ.setdefault("FLAGS_check_program", "1")
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # stop plugin load in subprocesses
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:

@@ -58,7 +58,9 @@ class OpTest:
                 pairs = self._norm_slot(val, slot)
                 names = []
                 for n, arr in pairs:
-                    v = block.create_var(name=n + "@out", dtype="float32", shape=None)
+                    v = block.create_var(
+                        name=n + "@out", dtype=str(arr.dtype),
+                        shape=None)
                     names.append(v.name)
                     out_vars.setdefault(slot, []).append((v, arr))
                 out_names[slot] = names
@@ -198,9 +200,12 @@ def run_single_op(op_type, inputs, attrs, out_slots):
                 suffix = "" if count == 1 else "_%d" % i
                 v = blk.create_var(
                     name="o_" + slot.lower().replace("-", "_") + suffix,
-                    dtype="float32",
                     shape=None,
                 )
+                # the driver has no expected arrays: the output dtype is
+                # genuinely unknown here, and a float32 default would be
+                # a mis-declaration the program verifier rightly flags
+                v.dtype = None
                 names.append(v.name)
                 out_vars.append(v)
             out_names[slot] = names

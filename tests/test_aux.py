@@ -188,10 +188,14 @@ def test_memory_fraction_env_wiring(monkeypatch):
 
 def test_lowering_error_carries_op_context():
     """enforce.h-style error context: a shape error inside the compiled
-    block names the op, block index, and input shapes."""
+    block names the op, block index, and input shapes.  With the static
+    verifier armed (FLAGS_check_program) the same defect is caught
+    BEFORE tracing, as an attributable diagnostic; the trace-time
+    context machinery is exercised with the flag pinned off."""
     import numpy as np
     import paddle_tpu as fluid
-    from paddle_tpu import layers
+    from paddle_tpu import flags, layers
+    from paddle_tpu.analysis import ProgramVerifyError
 
     x = layers.data("ec_x", shape=[3, 4], append_batch_size=False)
     y = layers.data("ec_y", shape=[5, 6], append_batch_size=False)
@@ -199,14 +203,24 @@ def test_lowering_error_carries_op_context():
     exe = fluid.Executor(fluid.CPUPlace())
     import pytest
 
-    with pytest.raises(RuntimeError, match="lowering op 'matmul'.*shapes"):
-        exe.run(
-            feed={
-                "ec_x": np.ones((3, 4), "float32"),
-                "ec_y": np.ones((5, 6), "float32"),
-            },
-            fetch_list=[out],
-        )
+    feed = {
+        "ec_x": np.ones((3, 4), "float32"),
+        "ec_y": np.ones((5, 6), "float32"),
+    }
+    old = flags.get_flag("check_program")
+    flags.set_flags({"check_program": True})
+    try:
+        with pytest.raises(ProgramVerifyError,
+                           match=r"\[shape-mismatch\].*\(matmul\)"):
+            exe.run(feed=feed, fetch_list=[out])
+    finally:
+        flags.set_flags({"check_program": old})
+    flags.set_flags({"check_program": False})
+    try:
+        with pytest.raises(RuntimeError, match="lowering op 'matmul'.*shapes"):
+            exe.run(feed=feed, fetch_list=[out])
+    finally:
+        flags.set_flags({"check_program": old})
 
 
 def test_nested_lod_two_levels():

@@ -1,0 +1,602 @@
+"""Static program verifier (paddle_tpu/analysis): one triggering
+negative test per diagnostic class, the apply_pass postcondition
+contract (FLAGS_check_program), the executor verify-before-first-run
+hook, the shared graph-helper dedup, and the builder x pipeline sweep
+(docs/STATIC_ANALYSIS.md)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import flags, layers
+from paddle_tpu.analysis import (
+    ProgramVerifyError,
+    alias_plan_diagnostics,
+    segment_diagnostics,
+    verify_program,
+)
+
+
+def _codes(diags):
+    return [d.code for d in diags]
+
+
+def _errors(diags):
+    return [d for d in diags if d.is_error]
+
+
+def _find(diags, code):
+    out = [d for d in diags if d.code == code]
+    assert out, "expected a %r diagnostic, got %s" % (code, diags)
+    return out[0]
+
+
+def _prog():
+    return fluid.Program()
+
+
+# ---------------------------------------------------------------------------
+# negative tests: one per diagnostic class, golden message pins the
+# op index and block
+# ---------------------------------------------------------------------------
+def test_diag_undefined_read():
+    p = _prog()
+    b = p.global_block()
+    b.create_var(name="x", shape=[4], dtype="float32")
+    b.create_var(name="y", shape=[4], dtype="float32")
+    b.append_op("relu", inputs={"X": ["ghost"]}, outputs={"Out": ["y"]})
+    d = _find(verify_program(p), "undefined-read")
+    assert d.is_error
+    assert "block 0 op 0 (relu)" in str(d) and "'ghost'" in str(d)
+
+
+def test_diag_undefined_read_across_sub_block_boundary():
+    """The PR 12 liveness bug class: a sub-block reading an outer name
+    that nothing defines."""
+    p = _prog()
+    b = p.global_block()
+    b.create_var(name="x", shape=[4], dtype="float32", is_data=True)
+    sub = p.create_block(parent_idx=0)
+    p.current_block_idx = 0
+    sub.create_var(name="inner_out", shape=[4], dtype="float32")
+    # sub-block op reads an outer name with no definition anywhere
+    op = fluid.Operator(sub, "relu", None, None, {})
+    op.inputs = {"X": ["never_defined"]}
+    op.outputs = {"Out": ["inner_out"]}
+    sub.ops.append(op)
+    rec = fluid.Operator(b, "recompute", None, None, {
+        "sub_block_idx": sub.idx, "in_names": ["x"], "out_names":
+        ["inner_out"], "__bound_names__": ["x"]})
+    rec.inputs = {"X": ["x"]}
+    rec.outputs = {"Out": ["inner_out"]}
+    b.ops.append(rec)
+    d = _find(verify_program(p), "undefined-read")
+    assert d.block_idx == sub.idx and "'never_defined'" in str(d)
+
+
+def test_diag_ssa_violation():
+    p = _prog()
+    b = p.global_block()
+    b.create_var(name="x", shape=[4], dtype="float32", is_data=True)
+    b.create_var(name="t", shape=[4], dtype="float32")
+    b.append_op("relu", inputs={"X": ["x"]}, outputs={"Out": ["t"]})
+    b.append_op("tanh", inputs={"X": ["x"]}, outputs={"Out": ["t"]})
+    d = _find(verify_program(p), "ssa-violation")
+    assert d.is_error
+    assert "block 0 op 1 (tanh)" in str(d) and "op 0" in str(d)
+
+
+def test_diag_slot_arity():
+    p = _prog()
+    b = p.global_block()
+    b.create_var(name="x", shape=[4, 8], dtype="float32", is_data=True)
+    b.create_var(name="o", shape=[4, 8], dtype="float32")
+    b.append_op("mul", inputs={"X": ["x"]}, outputs={"Out": ["o"]})  # no Y
+    d = _find(verify_program(p), "slot-arity")
+    assert d.is_error
+    assert "block 0 op 0 (mul)" in str(d) and "'Y'" in str(d)
+
+
+def test_diag_dtype_mismatch():
+    p = _prog()
+    b = p.global_block()
+    b.create_var(name="x", shape=[4], dtype="float32", is_data=True)
+    # declared float32 but cast produces bool — a real edge-type bug
+    b.create_var(name="o", shape=[4], dtype="float32")
+    b.append_op("cast", inputs={"X": ["x"]}, outputs={"Out": ["o"]},
+                attrs={"out_dtype": "bool"})
+    d = _find(verify_program(p), "dtype-mismatch")
+    assert d.is_error
+    assert "block 0 op 0 (cast)" in str(d)
+    assert "float32" in str(d) and "bool" in str(d)
+
+
+def test_diag_shape_mismatch_declared():
+    p = _prog()
+    b = p.global_block()
+    b.create_var(name="x", shape=[4, 8], dtype="float32", is_data=True)
+    b.create_var(name="w", shape=[8, 2], dtype="float32", persistable=True)
+    b.create_var(name="o", shape=[4, 3], dtype="float32")  # wrong: [4, 2]
+    b.append_op("mul", inputs={"X": ["x"], "Y": ["w"]},
+                outputs={"Out": ["o"]})
+    d = _find(verify_program(p), "shape-mismatch")
+    assert d.is_error and "block 0 op 0 (mul)" in str(d)
+
+
+def test_diag_shape_mismatch_contraction_edge():
+    p = _prog()
+    b = p.global_block()
+    b.create_var(name="x", shape=[4, 8], dtype="float32", is_data=True)
+    b.create_var(name="w", shape=[7, 2], dtype="float32", persistable=True)
+    b.create_var(name="o", shape=[4, 2], dtype="float32")
+    b.append_op("mul", inputs={"X": ["x"], "Y": ["w"]},
+                outputs={"Out": ["o"]})
+    d = _find(verify_program(p), "shape-mismatch")
+    assert "contraction" in str(d) and "block 0 op 0 (mul)" in str(d)
+
+
+def test_diag_dead_write_warning():
+    p = _prog()
+    b = p.global_block()
+    b.create_var(name="x", shape=[4], dtype="float32", is_data=True)
+    b.create_var(name="t", shape=[4], dtype="float32")
+    b.append_op("relu", inputs={"X": ["x"]}, outputs={"Out": ["t"]})
+    diags = verify_program(p)
+    d = _find(diags, "dead-write")
+    assert not d.is_error  # warning: DCE handles it, verification passes
+    assert "block 0 op 0 (relu)" in str(d)
+    # counting it as a fetch silences the warning
+    assert "dead-write" not in _codes(verify_program(p, fetches=["t"]))
+
+
+def test_diag_persistable_write_in_remat():
+    p = _prog()
+    b = p.global_block()
+    b.create_var(name="x", shape=[4], dtype="float32", is_data=True)
+    b.create_var(name="state", shape=[4], dtype="float32", persistable=True)
+    sub = p.create_block(parent_idx=0)
+    p.current_block_idx = 0
+    op = fluid.Operator(sub, "relu", None, None, {})
+    op.inputs = {"X": ["x"]}
+    op.outputs = {"Out": ["state"]}
+    sub.ops.append(op)
+    rec = fluid.Operator(b, "recompute", None, None, {
+        "sub_block_idx": sub.idx, "in_names": ["x"],
+        "out_names": ["state"], "__bound_names__": ["x"]})
+    rec.inputs = {"X": ["x"]}
+    rec.outputs = {"Out": ["state"]}
+    b.ops.append(rec)
+    d = _find(verify_program(p), "persistable-write-in-remat")
+    assert d.is_error and "'state'" in str(d)
+    assert d.block_idx == sub.idx
+
+
+def test_diag_protected_fetch():
+    p = _prog()
+    b = p.global_block()
+    b.create_var(name="x", shape=[4], dtype="float32", is_data=True)
+    b.create_var(name="t", shape=[4], dtype="float32")
+    b.append_op("relu", inputs={"X": ["x"]}, outputs={"Out": ["t"]})
+    p._protected_fetch_names = ("t", "vanished")
+    diags = verify_program(p)
+    d = _find(diags, "protected-fetch")
+    assert d.is_error and "'vanished'" in str(d)
+    # the produced one is fine
+    assert sum(1 for d in diags if d.code == "protected-fetch") == 1
+
+
+def _dist_trainer():
+    x = layers.data(name="x", shape=[8], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="float32")
+    h = layers.fc(x, size=16, act="relu")
+    pred = layers.fc(h, size=1)
+    loss = layers.reduce_mean(layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    t = fluid.DistributeTranspiler()
+    t.transpile(0, program=fluid.default_main_program(),
+                pservers="127.0.0.1:6174,127.0.0.1:6175", trainers=2)
+    return t.get_trainer_program()
+
+
+def test_diag_dist_plan_orphan_grad():
+    prog = _dist_trainer()
+    b = prog.global_block()
+    # delete the grad push: every dense grad is now an orphan
+    b.ops = [op for op in b.ops if op.type != "send_bucket"]
+    diags = verify_program(prog)
+    d = _find(diags, "dist-plan")
+    assert any(d2.is_error and "orphan" in str(d2)
+               for d2 in diags if d2.code == "dist-plan")
+    # and the send/recv pairing warning names the missing half
+    assert any("send_bucket" in str(d2)
+               for d2 in diags if d2.code == "dist-plan")
+    assert d is not None
+
+
+def test_dist_plan_clean_on_transpiled_program():
+    prog = _dist_trainer()
+    assert not _errors(verify_program(prog))
+
+
+def test_diag_unknown_op():
+    p = _prog()
+    b = p.global_block()
+    b.create_var(name="x", shape=[4], dtype="float32", is_data=True)
+    b.create_var(name="o", shape=[4], dtype="float32")
+    b.append_op("totally_bogus_op", inputs={"X": ["x"]},
+                outputs={"Out": ["o"]})
+    d = _find(verify_program(p), "unknown-op")
+    assert d.is_error
+    assert "block 0 op 0 (totally_bogus_op)" in str(d)
+
+
+def test_diag_dangling_sub_block():
+    p = _prog()
+    b = p.global_block()
+    b.create_var(name="x", shape=[4], dtype="float32", is_data=True)
+    rec = fluid.Operator(b, "recompute", None, None, {
+        "sub_block_idx": 99, "in_names": ["x"], "out_names": ["o"]})
+    rec.inputs = {"X": ["x"]}
+    rec.outputs = {"Out": ["o"]}
+    b.ops.append(rec)
+    d = _find(verify_program(p), "sub-block")
+    assert d.is_error and "99" in str(d)
+
+
+def test_diag_dtype_drift_and_append_op_normalization():
+    p = _prog()
+    b = p.global_block()
+    b.create_var(name="x", shape=[4], dtype="float32", is_data=True)
+    v = b.create_var(name="o", shape=[4], dtype="float32")
+    v.dtype = np.dtype("float32")  # raw numpy dtype: serialization drift
+    d = _find(verify_program(p), "dtype-drift")
+    assert not d.is_error and "'o'" in str(d)
+    # append_op normalizes its outputs' declared dtypes back to strings
+    b.append_op("relu", inputs={"X": ["x"]}, outputs={"Out": ["o"]})
+    assert v.dtype == "float32" and isinstance(v.dtype, str)
+    assert "dtype-drift" not in _codes(verify_program(p))
+
+
+def test_diag_alias_mismatch():
+    p = _prog()
+    b = p.global_block()
+    b.create_var(name="a", shape=[4, 8], dtype="float32")
+    b.create_var(name="c", shape=[32], dtype="int64")
+    diags = alias_plan_diagnostics(b, {"a": "c"})
+    assert len(diags) == 1 and diags[0].code == "alias-mismatch"
+    assert diags[0].is_error and "'a'" in str(diags[0])
+    assert not alias_plan_diagnostics(b, {})
+
+
+def test_segment_diagnostics_back_remat_refusal():
+    """remat._wrappable delegates here: persistable writes and cross-
+    boundary redefinition refuse, a clean segment passes."""
+    p = _prog()
+    b = p.global_block()
+    b.create_var(name="x", shape=[4], dtype="float32", is_data=True)
+    b.create_var(name="t", shape=[4], dtype="float32")
+    b.create_var(name="s", shape=[4], dtype="float32", persistable=True)
+    op1 = b.append_op("relu", inputs={"X": ["x"]}, outputs={"Out": ["t"]})
+    op2 = b.append_op("assign", inputs={"X": ["t"]}, outputs={"Out": ["s"]})
+    assert not segment_diagnostics(p, [op1])
+    bad = segment_diagnostics(p, [op1, op2])
+    assert [d.code for d in bad] == ["persistable-write-in-remat"]
+    from paddle_tpu.transpiler.remat import _wrappable
+
+    assert _wrappable(p, [op1])
+    assert not _wrappable(p, [op1, op2])
+
+
+# ---------------------------------------------------------------------------
+# pass postconditions (FLAGS_check_program)
+# ---------------------------------------------------------------------------
+def _fc_chain():
+    x = layers.data(name="x", shape=[8], dtype="float32")
+    h = layers.fc(x, size=4, act="relu")
+    out = layers.fc(h, size=2)
+    return fluid.default_main_program(), out
+
+
+def test_apply_pass_postcondition_names_pass_and_op():
+    from paddle_tpu.transpiler import pass_registry
+
+    @pass_registry.register_pass("_test_breaking_pass")
+    def _breaking(program, scope):
+        # delete the first fc: its output's consumers now read a ghost
+        b = program.global_block()
+        del b.ops[1]
+        program._bump_version()
+        return program
+
+    prog, _ = _fc_chain()
+    old = flags.get_flag("check_program")
+    flags.set_flags({"check_program": True})
+    try:
+        with pytest.raises(ProgramVerifyError) as ei:
+            pass_registry.apply_pass(prog, "_test_breaking_pass")
+    finally:
+        flags.set_flags({"check_program": old})
+        pass_registry._PASSES.pop("_test_breaking_pass", None)
+    msg = str(ei.value)
+    assert "pass '_test_breaking_pass'" in msg
+    assert "undefined-read" in msg and "block 0" in msg
+
+
+def test_apply_pass_flag_off_skips_verification():
+    from paddle_tpu.transpiler import pass_registry
+
+    @pass_registry.register_pass("_test_breaking_pass2")
+    def _breaking(program, scope):
+        del program.global_block().ops[1]
+        program._bump_version()
+        return program
+
+    prog, _ = _fc_chain()
+    old = flags.get_flag("check_program")
+    flags.set_flags({"check_program": False})
+    try:
+        out = pass_registry.apply_pass(prog, "_test_breaking_pass2")
+        assert out is prog  # ill-formed result returned, not raised
+    finally:
+        flags.set_flags({"check_program": old})
+        pass_registry._PASSES.pop("_test_breaking_pass2", None)
+
+
+def test_every_registered_pass_postcondition_clean_on_mlp():
+    """The builders' own pipeline passes keep programs verified: apply
+    each side-effect-free registered pass to a fresh MLP under
+    FLAGS_check_program and none may trip its own postcondition."""
+    from paddle_tpu.transpiler import pass_registry
+
+    runnable = ["memory_optimize_pass", "fuse_relu_into_conv_pass",
+                "attention_fuse_pass", "is_test_pass", "bf16_amp_pass"]
+    old = flags.get_flag("check_program")
+    flags.set_flags({"check_program": True})
+    try:
+        for name in runnable:
+            fluid.framework.switch_main_program(fluid.Program())
+            prog, _ = _fc_chain()
+            pass_registry.apply_pass(prog, name)  # raises on violation
+    finally:
+        flags.set_flags({"check_program": old})
+
+
+# ---------------------------------------------------------------------------
+# executor verify-before-first-run
+# ---------------------------------------------------------------------------
+def test_executor_verifies_before_first_compile():
+    p = _prog()
+    startup = fluid.Program()
+    with fluid.program_guard(p, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        out = layers.relu(x)
+    # corrupt after build: the consumer now reads a deleted name
+    b = p.global_block()
+    b.ops[-1].inputs["X"] = ["missing_input"]
+    p._bump_version()
+    exe = fluid.Executor(fluid.CPUPlace())
+    old = flags.get_flag("check_program")
+    flags.set_flags({"check_program": True})
+    try:
+        with pytest.raises(ProgramVerifyError) as ei:
+            exe.run(p, feed={"x": np.zeros((2, 4), np.float32)},
+                    fetch_list=[out])
+    finally:
+        flags.set_flags({"check_program": old})
+    assert "undefined-read" in str(ei.value)
+
+
+def test_executor_flag_off_skips_verifier_entirely():
+    import paddle_tpu.analysis as analysis_mod
+
+    p = _prog()
+    startup = fluid.Program()
+    with fluid.program_guard(p, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        out = layers.relu(x)
+    exe = fluid.Executor(fluid.CPUPlace())
+
+    def _boom(*a, **kw):
+        raise AssertionError("verifier must not run with the flag off")
+
+    old_fn = analysis_mod.check_program
+    old = flags.get_flag("check_program")
+    flags.set_flags({"check_program": False})
+    analysis_mod.check_program = _boom
+    try:
+        (r,) = exe.run(p, feed={"x": np.ones((2, 4), np.float32)},
+                       fetch_list=[out])
+    finally:
+        analysis_mod.check_program = old_fn
+        flags.set_flags({"check_program": old})
+    assert np.allclose(np.asarray(r), 1.0)
+
+
+def test_executor_verifies_once_per_program_version():
+    import paddle_tpu.analysis as analysis_mod
+
+    calls = []
+    old_fn = analysis_mod.check_program
+
+    def _counting(prog, **kw):
+        calls.append(1)
+        return old_fn(prog, **kw)
+
+    p = _prog()
+    startup = fluid.Program()
+    with fluid.program_guard(p, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        out = layers.relu(x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    old = flags.get_flag("check_program")
+    flags.set_flags({"check_program": True})
+    analysis_mod.check_program = _counting
+    try:
+        feed = {"x": np.ones((2, 4), np.float32)}
+        exe.run(p, feed=feed, fetch_list=[out])
+        exe.run(p, feed=feed, fetch_list=[out])
+        exe.run(p, feed=feed, fetch_list=[out])
+    finally:
+        analysis_mod.check_program = old_fn
+        flags.set_flags({"check_program": old})
+    assert len(calls) == 1  # memoized per program version
+
+
+# ---------------------------------------------------------------------------
+# shared graph helpers (the four-private-copies dedup)
+# ---------------------------------------------------------------------------
+def test_graph_helpers_shared_by_all_walkers():
+    from paddle_tpu.analysis import graph
+    from paddle_tpu.transpiler.pass_registry import OpPattern
+
+    prog, _ = _fc_chain()
+    b = prog.global_block()
+    cm = graph.consumer_map(b)
+    assert OpPattern(["mul"])._consumer_map(b) == cm
+    cc = graph.consumer_count(b)
+    assert {n: len(v) for n, v in cm.items()} == cc
+    pm = graph.producer_map(b)
+    for n, i in pm.items():
+        assert n in b.ops[i].output_arg_names()
+    # ControlFlowGraph consumes def_use_lists
+    from paddle_tpu.transpiler.memory_optimization_transpiler import (
+        ControlFlowGraph,
+    )
+
+    cfg = ControlFlowGraph(prog)
+    defs, uses = graph.def_use_lists(prog, 0)
+    assert cfg.defs == defs and cfg.uses == uses
+
+
+def test_def_use_includes_sub_block_external_reads():
+    p = _prog()
+    b = p.global_block()
+    b.create_var(name="x", shape=[4], dtype="float32", is_data=True)
+    b.create_var(name="ext", shape=[4], dtype="float32")
+    b.create_var(name="t", shape=[4], dtype="float32")
+    b.append_op("relu", inputs={"X": ["x"]}, outputs={"Out": ["ext"]})
+    sub = p.create_block(parent_idx=0)
+    p.current_block_idx = 0
+    op = fluid.Operator(sub, "tanh", None, None, {})
+    op.inputs = {"X": ["ext"]}
+    op.outputs = {"Out": ["t"]}
+    sub.ops.append(op)
+    rec = fluid.Operator(b, "recompute", None, None, {
+        "sub_block_idx": sub.idx, "in_names": [], "out_names": ["t"],
+        "__bound_names__": []})
+    rec.inputs = {"X": []}
+    rec.outputs = {"Out": ["t"]}
+    b.ops.append(rec)
+    from paddle_tpu.analysis.graph import def_use_lists
+
+    _defs, uses = def_use_lists(p, 0)
+    assert "ext" in uses[1]  # the sub-block's external read surfaces
+
+
+# ---------------------------------------------------------------------------
+# positive sweeps: builders x pipelines verify clean
+# ---------------------------------------------------------------------------
+def test_builder_sweep_fast():
+    """Tier-1 subset of the lint CLI matrix (cheap builders)."""
+    import importlib
+
+    mod = importlib.import_module("tools.check_program")
+    n, failed, results = mod.run_matrix(fast=True, quiet=True)
+    assert n >= 5
+    assert failed == 0, results
+
+
+@pytest.mark.slow
+def test_builder_sweep_full_matrix():
+    """ALL builder x pass-pipeline combinations in the lint CLI verify
+    clean (the ci.sh static-analysis lane runs the CLI itself too)."""
+    import importlib
+
+    mod = importlib.import_module("tools.check_program")
+    n, failed, results = mod.run_matrix(quiet=True)
+    assert n >= 14
+    assert failed == 0, results
+
+
+def test_train_builder_with_backward_verifies_clean():
+    """Grad-var conventions: a full fwd+bwd+optimizer program (grad ops
+    carrying the __fwd_* bookkeeping, sum fan-in, @GRAD naming) passes
+    the propagation engine with zero errors."""
+    x = layers.data(name="x", shape=[8], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="float32")
+    h = layers.fc(x, size=16, act="relu")
+    pred = layers.fc(h, size=1)
+    loss = layers.reduce_mean(layers.square_error_cost(pred, y))
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    diags = verify_program(fluid.default_main_program(),
+                           fetches=[loss.name])
+    assert not _errors(diags), diags
+
+
+def test_executor_verify_is_dce_scoped_but_refetch_reverifies():
+    """Review-hardening regressions: (a) ops the executor's DCE drops
+    for THIS run's fetches are not verified (a malformed unfetched
+    branch must not block a healthy fetch); (b) fetching the malformed
+    branch later re-verifies (the memo keys on the fetch set); (c) the
+    same program against a DIFFERENT scope re-verifies too."""
+    p = _prog()
+    startup = fluid.Program()
+    with fluid.program_guard(p, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        good = layers.relu(x)
+    # malformed side branch: matmul with an impossible contraction,
+    # feeding a var nobody fetches by default
+    b = p.global_block()
+    b.create_var(name="badw", shape=[5, 6], dtype="float32",
+                 persistable=True)
+    b.create_var(name="bad_out", shape=[2, 6], dtype="float32")
+    bad = fluid.Operator(b, "matmul", None, None, {})
+    bad.inputs = {"X": [x.name], "Y": ["badw"]}
+    bad.outputs = {"Out": ["bad_out"]}
+    b.ops.append(bad)
+    p._bump_version()
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        import paddle_tpu.initializer  # noqa: F401
+        scope.set("badw", np.zeros((5, 6), np.float32))
+        feed = {"x": np.ones((2, 4), np.float32)}
+        old = flags.get_flag("check_program")
+        flags.set_flags({"check_program": True})
+        try:
+            # (a) healthy fetch: the bad branch is DCE'd, run succeeds
+            (r,) = exe.run(p, feed=feed, fetch_list=[good])
+            assert np.allclose(np.asarray(r), 1.0)
+            # (b) fetching the bad branch re-verifies and raises
+            with pytest.raises(ProgramVerifyError, match="shape-mismatch"):
+                exe.run(p, feed=feed, fetch_list=["bad_out"])
+        finally:
+            flags.set_flags({"check_program": old})
+
+    # (c) a different scope re-verifies: drop a scope-resident read
+    import paddle_tpu.analysis as analysis_mod
+
+    calls = []
+    old_fn = analysis_mod.check_program
+
+    def _counting(prog, **kw):
+        calls.append(1)
+        return old_fn(prog, **kw)
+
+    scope2 = fluid.Scope()
+    analysis_mod.check_program = _counting
+    flags.set_flags({"check_program": True})
+    try:
+        with fluid.scope_guard(scope):
+            exe.run(p, feed=feed, fetch_list=[good])  # memoized: no call
+        assert calls == []
+        with fluid.scope_guard(scope2):
+            # a different scope identity re-verifies (scope-resident
+            # names count as defined, so the verdict is scope-dependent)
+            exe.run(p, feed=feed, fetch_list=[good])
+        assert len(calls) == 1
+    finally:
+        analysis_mod.check_program = old_fn
+        flags.set_flags({"check_program": old})

@@ -38,6 +38,7 @@ MODULES = [
     "paddle_tpu.parallel.collective",
     "paddle_tpu.ops.pallas_kernels",
     "paddle_tpu.ops.kernel_tuning",
+    "paddle_tpu.analysis",
     "paddle_tpu.transpiler.autotune",
     "paddle_tpu.utils.memory_analysis",
     "paddle_tpu.dataset.mnist",
