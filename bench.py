@@ -334,6 +334,14 @@ def _bench_impl():
         except Exception as e:
             sys.stderr.write("serve bench failed: %r\n" % (e,))
             result["serve"] = {"error": repr(e)[:200]}
+    # tensor-parallel serving pool: the same trace through a GSPMD
+    # mesh-sharded engine — pool HBM per device, comm attribution
+    if os.environ.get("BENCH_SERVE_TP", "0") == "1":
+        try:
+            result["serve_tp"] = _serve_tp_bench(on_tpu, device)
+        except Exception as e:
+            sys.stderr.write("serve_tp bench failed: %r\n" % (e,))
+            result["serve_tp"] = {"error": repr(e)[:200]}
     # model-breadth diagnostics (fluid_benchmark.py model matrix): off by
     # default — the vgg/se_resnext shapes roughly double tunnel time
     if os.environ.get("BENCH_MODELS", "0") == "1":
@@ -823,6 +831,117 @@ def _serve_bench(on_tpu, device):
         out["exactness_mismatches"] = mismatches
         sys.stderr.write("SERVE_RESULT speedup %s mismatches %d\n"
                          % (out["speedup_vs_one_at_a_time"], mismatches))
+    return out
+
+
+def _serve_tp_bench(on_tpu, device):
+    """GSPMD tensor-parallel serving leg (BENCH_SERVE_TP=1): the SAME
+    seeded Poisson trace through (a) the single-device engine and (b) a
+    ServingEngine(mesh=...) whose weights + KV slot-pool shard over an
+    `mp` mesh (BENCH_SERVE_TP_WAYS devices, default 2 — on CPU run
+    under XLA_FLAGS=--xla_force_host_platform_device_count=N, the PR 6
+    virtual-device recipe).  Reports tok/s for both, the pool's
+    per-device HBM footprint (the point: max-device bytes drop ~1/N vs
+    the unsharded pool), comm-bytes attribution from the compiled HLO's
+    collectives, which rule-table entries fell back to replication, and
+    a pooled-vs-solo exactness sweep through the SHARDED engine (the
+    PR 9 contract must survive sharding)."""
+    import numpy as np
+
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu.models import gpt2
+    from paddle_tpu.parallel import make_mesh
+    from paddle_tpu.serving import ServingEngine, make_poisson_trace
+
+    ways = int(os.environ.get("BENCH_SERVE_TP_WAYS", "2"))
+    if len(jax.devices()) < ways:
+        return {"skipped":
+                "needs %d devices; run under XLA_FLAGS="
+                "--xla_force_host_platform_device_count=%d"
+                % (ways, ways)}
+
+    class HP(gpt2.GPT2Config):
+        vocab_size = 8000 if on_tpu else 256
+        n_ctx = 256 if on_tpu else 64
+        d_model = 256 if on_tpu else 64
+        n_layer = 4 if on_tpu else 2
+        n_head = 4 if on_tpu else 2
+        dropout = 0.0
+
+    slots = int(os.environ.get("BENCH_SERVE_SLOTS", 8 if on_tpu else 4))
+    width = int(os.environ.get("BENCH_SERVE_WIDTH", 16 if on_tpu else 8))
+    n_req = int(os.environ.get("BENCH_SERVE_REQS", 32 if on_tpu else 16))
+    rate = float(os.environ.get("BENCH_SERVE_RATE", "2.0"))
+    t_max = HP.n_ctx
+    trace = make_poisson_trace(
+        n_req, rate,
+        prompt_len_range=(4, t_max // 4),
+        out_len_range=(4, t_max // 4),
+        vocab_size=HP.vocab_size,
+        seed=int(os.environ.get("BENCH_SERVE_SEED", "0")),
+        sampled_fraction=0.5)
+    out = {"ways": ways, "slots": slots, "width": width,
+           "requests": n_req}
+
+    def run_engine(mesh):
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            _, lm_startup, _, _ = gpt2.gpt2_logits_program(
+                HP, seq_len=t_max)
+            exe = fluid.Executor(
+                fluid.TPUPlace(0) if on_tpu else fluid.CPUPlace())
+            lm_startup.random_seed = 23
+            exe.run(lm_startup)
+            eng = ServingEngine(exe, HP, n_slots=slots, width=width,
+                                t_max=t_max, mesh=mesh)
+            eng.run(trace[:2])  # warm compile
+            warm = exe.compile_count
+            results, stats = eng.run(trace)
+            pool = eng.kv_pool_bytes(scope)
+            leg = {
+                "value": stats["tokens_per_s"],
+                "unit": "new tokens/sec" + ("" if on_tpu
+                                            else " (cpufallback)"),
+                "occupancy_pct": stats["occupancy_pct"],
+                "new_tokens": stats["new_tokens"],
+                "steps": stats["steps"],
+                "pool_bytes_total": pool["total_bytes"],
+                "pool_bytes_max_device": pool["max_device_bytes"],
+                "retraces_during_run": exe.compile_count - warm,
+            }
+            if mesh is not None:
+                # exactness sweep rides the sharded leg: pooled == solo
+                # through the SAME sharded program, request for request
+                mism = 0
+                for r in trace:
+                    solo, _ = eng.run_solo(r)
+                    if not np.array_equal(results[r.rid]["tokens"],
+                                          solo):
+                        mism += 1
+                leg["exactness_mismatches"] = mism
+                leg["comm"] = exe.spmd_comm_stats(eng.step_main)
+                leg["replicated_fallbacks"] = [
+                    list(x) for x in
+                    eng.partition_rules.replicated_log]
+        return leg
+
+    out["unsharded"] = run_engine(None)
+    sys.stderr.write("SERVE_TP_RESULT unsharded %s\n"
+                     % json.dumps(out["unsharded"]))
+    mesh = make_mesh({"mp": ways}, devices=jax.devices()[:ways])
+    out["sharded"] = run_engine(mesh)
+    sys.stderr.write("SERVE_TP_RESULT sharded %s\n"
+                     % json.dumps(out["sharded"]))
+    base = out["unsharded"]["pool_bytes_max_device"] or 1
+    out["pool_bytes_per_device_vs_unsharded"] = round(
+        out["sharded"]["pool_bytes_max_device"] / base, 4)
+    out["tok_s_ratio_vs_unsharded"] = round(
+        out["sharded"]["value"] / (out["unsharded"]["value"] or 1.0), 3)
+    sys.stderr.write(
+        "SERVE_TP_RESULT pool_bytes/device ratio %s tok/s ratio %s\n"
+        % (out["pool_bytes_per_device_vs_unsharded"],
+           out["tok_s_ratio_vs_unsharded"]))
     return out
 
 

@@ -175,12 +175,19 @@ def analyze_block(program, block_idx, feed_names, fetch_names, keep=None):
 
 
 def build_traced_function(program, block_idx, feed_names, fetch_names, scope,
-                          collective_axis=None):
+                          collective_axis=None, spmd=None):
     """`collective_axis`: optional ("axis_name", nranks) pair binding the
     collective-lowering context around the trace — c_allreduce_* ops then
     lower to jax.lax collectives over that axis instead of identity.  The
     caller (executor._run_collective) is responsible for actually running
-    the traced fn under a shard_map that binds the axis."""
+    the traced fn under a shard_map that binds the axis.
+
+    `spmd`: optional (mesh, PartitionRules) pair binding the GSPMD
+    lowering context (parallel.partition_rules.spmd_lowering) around the
+    trace — mesh-aware lowerings (fused_attention's vector-QStart
+    branch, slot_cache_write) then emit shard_map-wrapped kernels /
+    sharding constraints.  The caller (executor._run_spmd) jits the
+    traced fn with the rule table's in/out shardings."""
     keep = dce_mask(program, block_idx, fetch_names)
     reads, writes = analyze_block(program, block_idx, feed_names, fetch_names, keep)
     state_names = [n for n in reads if scope.has_var(n)]
@@ -209,6 +216,11 @@ def build_traced_function(program, block_idx, feed_names, fetch_names, scope,
             from ..parallel.collective import collective_lowering
 
             with collective_lowering(*collective_axis):
+                return _fn_body(feeds, ro_state, rw_state, rng_key)
+        if spmd is not None:
+            from ..parallel.partition_rules import spmd_lowering
+
+            with spmd_lowering(*spmd):
                 return _fn_body(feeds, ro_state, rw_state, rng_key)
         return _fn_body(feeds, ro_state, rw_state, rng_key)
 

@@ -231,6 +231,14 @@ class Executor:
         if coll is not None:
             return self._run_collective(program, feed, fetch_names, scope,
                                         return_numpy, coll)
+        # GSPMD-stamped program (parallel.partition_rules.annotate_spmd):
+        # persistables place per the partition-rule table and the traced
+        # step jits with those shardings — the tensor-parallel serving
+        # pool's execution path
+        spmd = getattr(program, "_spmd", None)
+        if spmd is not None:
+            return self._run_spmd(program, feed, fetch_names, scope,
+                                  return_numpy, spmd)
         # steady-state fast path: everything the slow path re-derives per
         # step — the listen_and_serv/reader op scans, per-feed var lookup
         # + dtype-kind guard, the sorted feed-signature tuple, and the
@@ -449,6 +457,166 @@ class Executor:
         if return_numpy:
             return [as_numpy(f) for f in fetches]
         return list(fetches)
+
+    # ---- GSPMD (tensor-parallel mesh) run path --------------------------
+    def _spmd_state_sharding(self, program, mesh, rules, name, scope):
+        """Placement for one state var: rule-table spec with the scalar/
+        rank/divisibility guards, shape taken from the scope value when
+        present, else the program's var declaration (fresh persistables
+        a startup program is about to create)."""
+        val = scope.find_var(name)
+        shape = getattr(val, "shape", None)
+        if shape is None:
+            var = program.global_block()._find_var_recursive(name)
+            shape = tuple(var.shape) if var is not None else None
+        return rules.sharding_for(mesh, name, shape)
+
+    def _run_spmd(self, program, feed, fetch_names, scope, return_numpy,
+                  spmd):
+        """Run a GSPMD-stamped program: ONE traced step jitted with the
+        partition-rule table's in/out shardings — XLA's SPMD partitioner
+        emits the collectives (qkv/ffn all-reduces, vocab-sharded logits
+        merge) while the KV slot-pool persistables live SHARDED in HBM
+        (heads axis: pool bytes/device drop ~1/N).  Mesh-aware lowerings
+        (fused_attention's vector-QStart pallas kernel under shard_map,
+        slot_cache_write's sharding constraints) bind through the
+        spmd_lowering context during the trace.
+
+        The serving engine's two PR 9 contracts survive unchanged:
+        occupancy churn changes feed VALUES only (one compile per feed
+        signature, counted in compile_count like every other path), and
+        row math stays row-independent under sharding (heads-axis splits
+        never mix slots), so pooled == solo bit-for-bit."""
+        import time as _time
+
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from .flags import get_flag
+
+        mesh, rules = spmd["mesh"], spmd["rules"]
+        self._maybe_verify_program(program, feed, fetch_names, scope)
+        repl = NamedSharding(mesh, PartitionSpec())
+
+        t0 = _time.perf_counter()
+        feed_np = {n: np.asarray(v) for n, v in feed.items()}
+        with RecordEvent("feed_upload", cat="feed"):
+            # feeds replicate: the ragged step's per-slot vectors are
+            # tiny control data every shard needs whole
+            feed_arrays = {n: jax.device_put(a, repl)
+                           for n, a in feed_np.items()}
+        self._host_feed_ms += (_time.perf_counter() - t0) * 1e3
+
+        feed_sig = tuple(sorted(
+            (n, tuple(a.shape), str(a.dtype))
+            for n, a in feed_arrays.items()))
+        cache = getattr(self, "_spmd_cache", None)
+        if cache is None:
+            cache = self._spmd_cache = {}
+        key_id = (id(program), program._version, feed_sig,
+                  tuple(fetch_names), id(scope),
+                  bool(get_flag("use_pallas")), get_flag("prng_impl"))
+        entry = cache.get(key_id)
+        if entry is None:
+            from .core.trace import build_traced_function
+
+            # a fresh trace+compile: count it where the engine's
+            # no-retrace contract looks (Executor.compile_count)
+            self._cache.compile_count += 1
+            traced = build_traced_function(
+                program, 0, tuple(n for n, _, _ in feed_sig), fetch_names,
+                scope, spmd=(mesh, rules))
+            sh = {n: self._spmd_state_sharding(program, mesh, rules, n,
+                                              scope)
+                  for n in set(traced.ro_names) | set(traced.rw_names)
+                  | set(traced.updated)}
+            jitted = jax.jit(
+                traced.fn,
+                in_shardings=(
+                    {n: repl for n in feed_arrays},
+                    {n: sh[n] for n in traced.ro_names},
+                    {n: sh[n] for n in traced.rw_names},
+                    repl,
+                ),
+                out_shardings=(None, {n: sh[n] for n in traced.updated}),
+                donate_argnums=(2,),
+            )
+            # avals[0] records the first call's abstract args so
+            # spmd_comm_stats can AOT-lower the same signature later
+            entry = cache[key_id] = (traced, jitted, sh, [None])
+        traced, jitted, sh, avals = entry
+
+        def commit(n):
+            v = scope.find_var(n)
+            if isinstance(v, jax.Array) and getattr(v, "committed", True) \
+                    and v.sharding == sh[n]:
+                return v
+            arr = jax.device_put(np.asarray(v), sh[n])
+            scope.set(n, arr)
+            return arr
+
+        ro_state = {n: commit(n) for n in traced.ro_names}
+        rw_state = {n: commit(n) for n in traced.rw_names}
+        key = jax.device_put(self._rng_key(program), repl)
+        if avals[0] is None:
+            avals[0] = jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(
+                    x.shape, x.dtype, sharding=x.sharding),
+                (feed_arrays, ro_state, rw_state, key))
+        _ensure_token_regime(
+            ("mesh", tuple(d.id for d in mesh.devices.flat)))
+        with RecordEvent("executor_run"):
+            fetches, new_state = jitted(feed_arrays, ro_state, rw_state,
+                                        key)
+        for n, v in new_state.items():
+            scope.set(n, v)
+        if return_numpy:
+            return [as_numpy(f) for f in fetches]
+        return list(fetches)
+
+    def spmd_comm_stats(self, program):
+        """Comm-bytes attribution for a GSPMD-stamped program's compiled
+        step(s): AOT-lower each cached executable at its recorded call
+        signature and sum the output bytes of collective ops in the
+        optimized HLO — what the SPMD partitioner actually moves per
+        dispatch (qkv/ffn partial-sum all-reduces, vocab-logits merges).
+        Returns {"per_op": {kind: {"count", "bytes"}}, "total_bytes"};
+        best-effort (an HLO surface change degrades to {} rather than
+        failing a bench run)."""
+        import re as _re
+
+        _ELEM = {"f64": 8, "s64": 8, "u64": 8, "f32": 4, "s32": 4,
+                 "u32": 4, "bf16": 2, "f16": 2, "s16": 2, "u16": 2,
+                 "s8": 1, "u8": 1, "pred": 1}
+        # matches both the synchronous form (`all-reduce(`) and the
+        # async form TPU-optimized HLO emits (`all-reduce-start(` — the
+        # paired `-done` re-states the same bytes, so only the start is
+        # counted)
+        pat = _re.compile(
+            r"=\s+(?:\(?)([a-z0-9]+)\[([0-9,]*)\][^=]*?"
+            r"\b(all-reduce|all-gather|reduce-scatter|all-to-all|"
+            r"collective-permute)(?:-start)?\(")
+        out = {}
+        total = 0
+        cache = getattr(self, "_spmd_cache", None) or {}
+        for key, (traced, jitted, sh, avals) in cache.items():
+            if key[0] != id(program) or avals[0] is None:
+                continue
+            try:
+                txt = jitted.lower(*avals[0]).compile().as_text()
+            except Exception:
+                continue
+            for m in pat.finditer(txt):
+                dt, dims, kind = m.group(1), m.group(2), m.group(3)
+                n = 1
+                for d in dims.split(","):
+                    if d:
+                        n *= int(d)
+                b = n * _ELEM.get(dt, 4)
+                ent = out.setdefault(kind, {"count": 0, "bytes": 0})
+                ent["count"] += 1
+                ent["bytes"] += b
+                total += b
+        return {"per_op": out, "total_bytes": total}
 
     # ---- collective (mesh data-parallel) run path -----------------------
     def _run_collective(self, program, feed, fetch_names, scope,
@@ -780,6 +948,8 @@ class Executor:
         self._run_cache.clear()
         if getattr(self, "_loop_cache", None):
             self._loop_cache.clear()
+        if getattr(self, "_spmd_cache", None):
+            self._spmd_cache.clear()
         self._closed = True
 
     # infer_* helpers used by contrib Trainer/Inferencer

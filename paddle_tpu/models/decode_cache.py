@@ -253,11 +253,41 @@ def sample_rows_keyed(probs, seeds, steps):
 
 def filtered_probs_rows(logits, temperatures, top_ks, top_ps):
     """filtered_probs with PER-ROW sampling params (heterogeneous
-    requests sharing one serving dispatch).  Each row runs through
-    filtered_probs alone, so a row's filtered distribution is bit-
-    identical to its solo run regardless of neighbors."""
-    logits = np.asarray(logits)
-    rows = [filtered_probs(logits[i:i + 1], float(temperatures[i]),
-                           int(top_ks[i]), float(top_ps[i]))
-            for i in range(logits.shape[0])]
-    return np.concatenate(rows, axis=0)
+    requests sharing one serving dispatch), VECTORIZED: one pass over
+    the whole [N, V] block instead of PR 9's per-row python loop (the
+    documented "loops per row; vectorize if pools grow" limit).
+
+    Bit-exactness contract: every row's output is BIT-IDENTICAL to
+    ``filtered_probs(logits[i:i+1], t[i], k[i], p[i])`` — the same
+    float64 op sequence runs elementwise, and the top-k / top-p stages
+    apply only to the subset of rows whose solo run would enter those
+    branches (a ``where`` with an all-false mask still perturbs nothing,
+    but the solo path's SKIPPED renormalization must be skipped here
+    too).  top_k must be >= 0 (0 = off), as everywhere else.
+    ``tests/test_serving.py`` pins the row-loop equivalence."""
+    lg = np.asarray(logits, np.float64).copy()
+    n, v = lg.shape
+    t = np.array([max(float(x), 1e-6) for x in temperatures], np.float64)
+    lg /= t[:, None]
+    ks = np.array([int(x) for x in top_ks])
+    kr = np.nonzero(ks)[0]
+    if kr.size:
+        k_eff = np.minimum(ks[kr], v)  # top_k >= vocab: no-op
+        srt = np.sort(lg[kr], axis=-1)
+        kth = np.take_along_axis(srt, (v - k_eff)[:, None], -1)
+        lg[kr] = np.where(lg[kr] < kth, -np.inf, lg[kr])
+    probs = np.exp(lg - lg.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    ps = np.array([float(x) for x in top_ps], np.float64)
+    pr = np.nonzero(ps < 1.0)[0]
+    if pr.size:
+        sub = probs[pr]
+        order = np.argsort(-sub, axis=-1)
+        sorted_p = np.take_along_axis(sub, order, -1)
+        keep_sorted = np.cumsum(sorted_p, -1) - sorted_p < ps[pr][:, None]
+        keep = np.zeros_like(sub, bool)
+        np.put_along_axis(keep, order, keep_sorted, -1)
+        sub = np.where(keep, sub, 0.0)
+        sub /= sub.sum(-1, keepdims=True)
+        probs[pr] = sub
+    return probs

@@ -57,6 +57,10 @@ class GPT2Config:
     tie_embeddings = False  # output logits reuse emb.w (x @ emb.w^T)
     dropout = 0.1
     recompute = False  # rematerialize each block's activations in backward
+    # which parallel.partition_rules family table shards this model's
+    # persistables (weights AND the serving slot-pool caches) on a
+    # tensor-parallel mesh — ServingEngine(mesh=...) resolves it
+    partition_family = "gpt2"
 
 
 def _pa(base, std=0.02):

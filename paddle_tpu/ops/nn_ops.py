@@ -778,6 +778,71 @@ def _pixel_shuffle(ctx, ins, attrs):
     return {"Out": [x.reshape(n, c // (r * r), h * r, w * r)]}
 
 
+def _qvec_attention_mesh(q, k, v, qstart, scale, mesh, axis, bq_flag,
+                         bk_flag, mosaic_legal):
+    """The vector-QStart attention lowered MESH-CLEAN over `axis`
+    (heads): under FLAGS_use_pallas the flash_attention_qvec kernel runs
+    per-device inside shard_map (each shard sees its own [B, H/n, T, D]
+    head slice and the full replicated qstart — per-row causal cutoffs
+    are head-independent); otherwise a 4-D dense einsum bracketed by
+    sharding constraints so the SPMD partitioner keeps the KV pool's
+    heads-axis placement instead of re-laying it out.  q/k/v: rank-4
+    [B, H, Tq|Tk, D]; qstart: [B]."""
+    from ..flags import get_flag
+    from ..parallel.mesh import shard_map
+    from .pallas_kernels import NEG_INF, flash_attention_qvec, use_pallas
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    b, h, t, d = q.shape
+    tk = k.shape[2]
+    p4 = P(None, axis, None, None)
+    if use_pallas():
+        bq = 128 if t % 128 == 0 else t
+        bk = 128 if tk % 128 == 0 else tk
+        if bq_flag or bk_flag:
+            bq, bk = bq_flag or bq, bk_flag or bk
+            if bq <= 0 or bk <= 0 or not mosaic_legal(bq, bk):
+                raise ValueError(
+                    "FLAGS_flash_block_q/k (%d, %d) are not Mosaic-legal "
+                    "for the sharded ragged-step shapes Tq=%d, Tk=%d"
+                    % (bq, bk, t, tk))
+            dispatch = True
+        else:
+            # deterministic defaults under the mesh (the tuning-cache
+            # search times STANDALONE kernels; a per-shard search inside
+            # shard_map would attribute collective time to block sizes)
+            dispatch = bq <= 512 and bk <= 1024
+        if dispatch:
+            def body(q4, k4, v4, qs):
+                lb, lh, lt, ld = q4.shape
+                ltk = k4.shape[2]
+                qsv = jnp.repeat(qs.reshape(-1).astype(jnp.int32), lh)
+                o = flash_attention_qvec(
+                    q4.reshape(lb * lh, lt, ld),
+                    k4.reshape(lb * lh, ltk, ld),
+                    v4.reshape(lb * lh, ltk, ld),
+                    qsv, scale, bq, bk)
+                return o.reshape(lb, lh, lt, ld)
+
+            return shard_map(
+                body, mesh=mesh, in_specs=(p4, p4, p4, P()),
+                out_specs=p4, check_rep=False)(q, k, v, qstart)
+    sh = NamedSharding(mesh, p4)
+    qc = jax.lax.with_sharding_constraint(q, sh)
+    kc = jax.lax.with_sharding_constraint(k, sh)
+    vc = jax.lax.with_sharding_constraint(v, sh)
+    s = (jnp.einsum("bhqd,bhkd->bhqk", qc, kc).astype(jnp.float32)
+         * scale)  # [B, H, Tq, Tk]
+    q_pos = (qstart.reshape(b, 1).astype(jnp.int32)
+             + jnp.arange(t, dtype=jnp.int32)[None, :])  # [B, Tq]
+    keep = (q_pos[:, None, :, None]
+            >= jnp.arange(tk, dtype=jnp.int32)[None, None, None, :])
+    s = jnp.where(keep, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p.astype(qc.dtype), vc)
+    return jax.lax.with_sharding_constraint(out, sh)
+
+
 @register("fused_attention", no_grad_inputs=("QStart",))
 def _fused_attention(ctx, ins, attrs):
     """Fused scaled-dot-product attention (the cuDNN-fused-kernel slot of
@@ -902,6 +967,29 @@ def _fused_attention(ctx, ins, attrs):
                 "QStart")
         from .pallas_kernels import NEG_INF, flash_attention_qvec
 
+        # GSPMD serving mesh (executor._run_spmd binds the context):
+        # heads are embarrassingly parallel under per-row qstart, so the
+        # mesh-clean form shards the HEADS axis — the pallas kernel
+        # under shard_map (pallas_call has no SPMD partition rule; an
+        # unwrapped call would force an all-gather of the sharded KV
+        # pool), the dense form as a 4-D einsum with sharding
+        # constraints (the flattened [B*H] layout would interleave
+        # shards across batch rows).  Row math is untouched either way:
+        # the serving exactness contract (pooled == solo through the
+        # SAME program) rides through sharding.
+        from ..parallel.partition_rules import current_spmd
+
+        spmd = current_spmd()
+        if spmd is not None:
+            from ..parallel.mesh import mesh_axis_sizes
+
+            mesh, rules = spmd
+            axis = rules.mp_axis
+            nsh = mesh_axis_sizes(mesh).get(axis, 1)
+            if nsh > 1 and h % nsh == 0:
+                return {"Out": [_qvec_attention_mesh(
+                    q, k, v, qstart, float(scale), mesh, axis,
+                    bq_flag, bk_flag, _mosaic_legal)]}
         if use_pallas():
             bq = 128 if t % 128 == 0 else t
             bk = 128 if tk % 128 == 0 else tk
@@ -1268,11 +1356,34 @@ def _slot_cache_write(ctx, ins, attrs):
     # invalid column to t_max
     idx = jnp.where(valid, idx, t_max)
 
+    # GSPMD serving mesh: the write indexes the TIME axis only, so a
+    # heads-axis-sharded pool updates shard-locally; the constraints pin
+    # that placement (without them the partitioner may round-trip the
+    # whole pool through a replicated scatter)
+    sh = None
+    from ..parallel.partition_rules import current_spmd
+
+    spmd = current_spmd()
+    if spmd is not None:
+        from ..parallel.mesh import mesh_axis_sizes
+
+        mesh, rules = spmd
+        nsh = mesh_axis_sizes(mesh).get(rules.mp_axis, 1)
+        if nsh > 1 and cache.shape[1] % nsh == 0:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            sh = NamedSharding(mesh,
+                               P(None, rules.mp_axis, None, None))
+            cache = jax.lax.with_sharding_constraint(cache, sh)
+            new = jax.lax.with_sharding_constraint(new, sh)
+
     def row(c, n, i):
         # c [H, T, D], n [H, W, D], i [W]
         return c.at[:, i, :].set(n, mode="drop")
 
     out = jax.vmap(row)(cache, new.astype(cache.dtype), idx)
+    if sh is not None:
+        out = jax.lax.with_sharding_constraint(out, sh)
     return {"Out": [out]}
 
 

@@ -119,6 +119,25 @@ FLAGS_program_tune_cache=tests/data/ci_program_tune_cache.json \
     python -m pytest tests/test_optimize_transpiler.py \
     tests/test_transpilers.py -q -m ""
 
+echo "== sharded-serving lane (2-device GSPMD tensor-parallel mesh) =="
+# the tensor-parallel pool on the MINIMAL mesh (2 virtual devices):
+# partition-rule resolution (precedence / guards / logged replicate
+# fallback) and the sharded engine holding BOTH PR 9 contracts — churn
+# exactness + zero retraces — through the GSPMD executor path, with the
+# full serving exactness suite riding the same 2-device topology.  Both
+# attention variants run: dense XLA (use_pallas=0) and the
+# flash_attention_qvec kernel under shard_map (use_pallas=1, interpret
+# mode, pinned tuning cache — CI never searches block sizes).
+XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+FLAGS_use_pallas=0 \
+    python -m pytest tests/test_serving_tp.py tests/test_serving.py \
+    -q -m ""
+XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+FLAGS_use_pallas=1 FLAGS_kernel_autotune=0 \
+FLAGS_kernel_tune_cache=tests/data/ci_tuning_cache.json \
+    python -m pytest tests/test_serving_tp.py tests/test_serving.py \
+    -q -m ""
+
 echo "== serving pass (continuous-batching churn exactness) =="
 # the slot-pool engine's core contract on a short seeded CPU trace
 # (small GPT2Config, pool B=4): every request's tokens bit-identical

@@ -142,12 +142,18 @@ def test_bf16_amp_rewrite_trains_and_matches_f32():
         fluid.default_startup_program().random_seed = 7
         loss = _mlp()
         n = rewrite_bf16() if amp else 0
-        fluid.optimizer.SGD(0.05).minimize(loss)
+        # lr/steps sized so the halving bar below has real margin: at
+        # SGD(0.05) x 10 steps BOTH precisions only reach ~0.60x (the
+        # old bar failed for f32 and bf16 alike — a convergence-budget
+        # problem, not a precision one); 0.1 x 20 reaches ~0.31x with
+        # the bf16-vs-f32 trajectory gap still ~0.4% << the 15% parity
+        # tolerance
+        fluid.optimizer.SGD(0.1).minimize(loss)
         exe = fluid.Executor(fluid.CPUPlace())
         exe.run(fluid.default_startup_program())
         losses = [
             float(np.ravel(exe.run(feed={"x": xv, "y": yv}, fetch_list=[loss])[0])[0])
-            for _ in range(10)
+            for _ in range(20)
         ]
         return losses, n
 

@@ -26,7 +26,11 @@ def test_mlp_train_loss_decreases():
     pred = fluid.layers.fc(hidden, size=10, act="softmax")
     loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
     acc = fluid.layers.accuracy(pred, label)
-    opt = fluid.optimizer.SGD(learning_rate=0.5)
+    # lr 0.5 overshoots on this near-chance-level task (step-2 loss
+    # spikes to ~5.8, then the trajectory plateaus at ~0.905x first —
+    # deterministically just ABOVE the 0.9 bar); 0.1 descends cleanly
+    # to ~0.85x in the same 30 steps
+    opt = fluid.optimizer.SGD(learning_rate=0.1)
     opt.minimize(loss)
 
     place = fluid.CPUPlace()
@@ -169,7 +173,9 @@ def test_run_loop_matches_sequential_runs():
         return main, startup, loss
 
     K = 5
-    # sequential reference
+    # sequential reference: 2K steps, capturing the loss at step K and
+    # step 2K (the second window is the reference for the REPEATED
+    # run_loop call below)
     main, startup, loss = build()
     s1 = fluid.Scope()
     exe = fluid.Executor(fluid.CPUPlace())
@@ -179,6 +185,10 @@ def test_run_loop_matches_sequential_runs():
             (seq_loss,) = exe.run(main, feed={"rlx": xv, "rly": yv},
                                   fetch_list=[loss])
         w_seq = np.array(s1.get("rl_w1"))
+        for _ in range(K):
+            (seq_loss2,) = exe.run(main, feed={"rlx": xv, "rly": yv},
+                                   fetch_list=[loss])
+        w_seq2 = np.array(s1.get("rl_w1"))
 
     # one compiled loop
     main2, startup2, loss2 = build()
@@ -194,6 +204,7 @@ def test_run_loop_matches_sequential_runs():
         (loop_loss2,) = exe2.run_loop(K, main2,
                                       feed={"rlx": xv, "rly": yv},
                                       fetch_list=[loss2])
+        w_loop2 = np.array(s2.get("rl_w1"))
         assert len(exe2._loop_cache) == 1
         (_, jitted), = exe2._loop_cache.values()
         assert jitted._cache_size() == 1, jitted._cache_size()
@@ -201,7 +212,15 @@ def test_run_loop_matches_sequential_runs():
     np.testing.assert_allclose(np.asarray(loop_loss),
                                np.asarray(seq_loss), rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(w_loop, w_seq, rtol=1e-5, atol=1e-6)
-    assert float(np.asarray(loop_loss2)) < float(np.asarray(loop_loss))
+    # the REPEATED loop continues from the updated state with run()'s
+    # step-6..10 RNG keys: exact parity with steps 6..10 of the
+    # sequential chain.  (This replaces an older "loss still decreases"
+    # proxy that deterministically flaked once the 16-sample memorization
+    # task plateaued inside the second window — parity is the contract,
+    # monotone descent never was.)
+    np.testing.assert_allclose(np.asarray(loop_loss2),
+                               np.asarray(seq_loss2), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(w_loop2, w_seq2, rtol=1e-5, atol=1e-6)
 
     # host-boundary ops are rejected
     import pytest

@@ -10,6 +10,7 @@ import paddle_tpu as fluid
 from paddle_tpu import layers
 from paddle_tpu.models import gpt2
 from paddle_tpu.models.decode_cache import (
+    filtered_probs_rows,
     fold_in_seed,
     make_slot_reset_program,
     sample_rows_keyed,
@@ -136,6 +137,28 @@ def test_keyed_sampling_is_pure_per_request():
     np.testing.assert_array_equal(base, again)
     assert fold_in_seed(1, 2) != fold_in_seed(2, 1)
     assert fold_in_seed(1, 2) == fold_in_seed(1, 2)
+
+
+def test_filtered_probs_rows_vectorized_bit_identical_to_row_loop():
+    """The engine's batched sampler (PR 9's "loops per row; vectorize
+    if pools grow" limit closed): the vectorized filtered_probs_rows is
+    BIT-identical to composing filtered_probs row by row, across
+    heterogeneous temperature/top-k/top-p mixes — including rows whose
+    solo run skips the top-k and/or top-p branches entirely (a skipped
+    renormalization must stay skipped, or bits drift)."""
+    from paddle_tpu.models.decode_cache import filtered_probs
+
+    rng = np.random.RandomState(7)
+    logits = (rng.randn(8, 23) * 3).astype("float32")
+    temps = [1.0, 0.7, 1.3, 1e-9, 1.0, 0.85, 2.0, 1.0]
+    ks = [0, 5, 23, 0, 1, 8, 0, 40]       # off / partial / full / >vocab
+    ps = [1.0, 0.9, 1.0, 0.5, 1.0, 0.95, 0.3, 1.0]
+    got = filtered_probs_rows(logits, temps, ks, ps)
+    for i in range(8):
+        ref = filtered_probs(logits[i:i + 1], float(temps[i]),
+                             int(ks[i]), float(ps[i]))
+        np.testing.assert_array_equal(got[i], ref[0],
+                                      err_msg="row %d diverged" % i)
 
 
 def test_poisson_trace_deterministic():

@@ -167,6 +167,38 @@ def case_gpt2_ragged():
     return out[0], {}
 
 
+def case_gpt2_ragged_tp():
+    """The tensor-parallel serving step: the SAME ragged program
+    GSPMD-stamped (annotate_spmd changes execution placement only — the
+    IR must verify identically to the plain build), with the gpt2
+    family rule table resolving every slot-pool persistable to its
+    heads-axis spec rather than a logged replicate-fallback."""
+    import jax
+
+    from paddle_tpu.models import gpt2
+    from paddle_tpu.parallel import make_mesh
+    from paddle_tpu.parallel.partition_rules import (
+        annotate_spmd,
+        partition_rules_for,
+    )
+
+    hp = _tiny_gpt2_hp()
+    main, _cs, _f, _fetch, cache_names = gpt2.gpt2_ragged_step_program(
+        hp, batch=2, t_max=16, width=4)
+    mesh = make_mesh({"mp": -1}, devices=jax.devices())
+    rules = partition_rules_for(hp.partition_family, mp_axis="mp")
+    annotate_spmd(main, mesh, rules)
+    specs, _repl = rules.match_table(
+        {n: (2, hp.n_head, 16, hp.d_model // hp.n_head)
+         for n in cache_names})
+    unruled = [n for n, s in specs.items() if len(s) == 0]
+    if unruled:
+        raise AssertionError(
+            "slot-pool persistables fell through to replication: %s"
+            % unruled)
+    return main, {}
+
+
 def case_bert_train():
     from paddle_tpu.models import bert
 
@@ -245,6 +277,7 @@ CASES = [
     ("gpt2_train_fused", case_gpt2_train, False),
     ("gpt2_decode_step", case_gpt2_decode, True),
     ("gpt2_ragged_serving", case_gpt2_ragged, True),
+    ("gpt2_ragged_serving_tp", case_gpt2_ragged_tp, True),
     ("bert_train_fused", case_bert_train, False),
     ("resnet_train", case_resnet_train, False),
     ("inference_bn_fold_prune", case_inference_pipeline, False),
