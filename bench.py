@@ -998,6 +998,20 @@ def _dist_smokes():
              "--elastic-schedule", "4:+2,22:-2", "tests/dist_mlp.py"],
             {"DIST_STEPS": "80", "DIST_STEP_SLEEP": "0.25",
              "BENCH_LEG_REPEATS": "1"}),
+        # live pserver shard migration: the pserver SET changes
+        # 2 -> 3 -> 2 mid-run via the two-phase journaled handoff
+        # (migrate_begin/commit); reports per-epoch steps/s (phases —
+        # the handoff's throughput dip is phase-visible), migration_ms
+        # and bytes moved per handoff, plus the server-side
+        # migrated_bytes/shards counters.  Single repeat: the leg IS a
+        # membership trace, not a steady-state median.
+        "pserver_migrate": (
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--mode", "pserver", "--nproc", "2", "--pservers", "2",
+             "--elastic-pservers", "2:3",
+             "--pserver-schedule", "5:+1,13:-1", "tests/dist_mlp.py"],
+            {"DIST_STEPS": "48", "DIST_STEP_SLEEP": "0.25",
+             "DIST_MODEL": "sparse", "BENCH_LEG_REPEATS": "1"}),
     }
     # BENCH_DIST_ONLY=<leg> runs a single dist leg (targeted A/Bs and
     # the elastic-membership trace without the full matrix)
@@ -1030,6 +1044,7 @@ def _dist_smokes():
         leg_repeats = int(overrides.get("BENCH_LEG_REPEATS", repeats))
         leg_env.pop("BENCH_LEG_REPEATS", None)
         vals, err, counters, phases = [], None, None, None
+        migrations = []
         for _rep in range(leg_repeats):
             t0 = _t.time()
             try:
@@ -1054,6 +1069,22 @@ def _dist_smokes():
                     # launch.py prefixes child lines with "[trainer.N] "
                     # (and "[pserver.N] " for the server-side stats the
                     # async journal/staleness evidence rides on)
+                    pos = ln.find("PSERVER MIGRATION ok:")
+                    if pos >= 0:
+                        # the migration driver's summary: world size,
+                        # shards + bytes moved, handoff wall time
+                        import re as _re
+
+                        m = _re.search(
+                            r"world=(\d+) moved=(\d+) bytes=(\d+) "
+                            r"ms=([0-9.]+)", ln)
+                        if m:
+                            migrations.append({
+                                "world": int(m.group(1)),
+                                "moved_shards": int(m.group(2)),
+                                "bytes": int(m.group(3)),
+                                "migration_ms": float(m.group(4))})
+                        continue
                     pos = ln.find("PSERVER-STATS ")
                     if pos >= 0:
                         try:
@@ -1072,7 +1103,14 @@ def _dist_smokes():
                                      "journal_replayed",
                                      "journal_tail_skips", "dedup_drops",
                                      "staleness_parks", "parked_ms",
-                                     "async_sends"):
+                                     "async_sends",
+                                     # live shard migration evidence
+                                     "migrations_out", "migrations_in",
+                                     "migrated_bytes_out",
+                                     "migrated_bytes_in",
+                                     "migrated_shards_out",
+                                     "migrate_aborts",
+                                     "stale_plan_drops"):
                                 ps_agg[k] = round(ps_agg.get(k, 0) + v, 3)
                         continue
                     pos = ln.find("COUNTERS ")
@@ -1130,6 +1168,17 @@ def _dist_smokes():
                 if counters and counters.get("replans"):
                     out[name]["replan_ms_mean"] = round(
                         counters["replan_ms"] / counters["replans"], 2)
+            if migrations:
+                # live shard migration: per-handoff wall time + payload
+                # (steps/s across the handoff rides the phases above —
+                # each migration mints an epoch, so the handoff phase is
+                # its own steps_per_s_by_phase row)
+                out[name]["migrations"] = migrations
+                out[name]["migration_ms_mean"] = round(
+                    sum(m["migration_ms"] for m in migrations)
+                    / len(migrations), 2)
+                out[name]["migrated_bytes_total"] = sum(
+                    m["bytes"] for m in migrations)
     if only:
         return out
     # BASELINE config 5 dist leg: GPT-2 TP+DP step over the 8-device

@@ -72,7 +72,12 @@ def send_complete_all():
     stop_heartbeats()  # fall silent BEFORE complete: no post-exit beats
     for ep, tid in sorted(_active_endpoints):
         try:
-            RPCClient.get(ep).complete(tid)
+            # bounded: a RETIRED pserver (live shard migration) is gone
+            # for good — without a deadline the connect retries here
+            # would stall every trainer's exit for the full
+            # FLAGS_max_retry budget on an endpoint that owes nothing
+            RPCClient.get(ep).call("complete", trainer_id=tid,
+                                   deadline_s=10.0)
         except Exception:
             pass
     _active_endpoints.clear()

@@ -25,8 +25,11 @@ lifetime so reconnects keep the schedule deterministic):
 * ``pass``      — forward unchanged (the default)
 * ``drop``      — swallow the frame; the peer sees silence (client
                   retries on timeout; at-most-once dedup is exercised)
-* ``delay``     — forward after ``delay_s`` (reordering pressure /
-                  deadline pressure)
+* ``delay``     — forward after a SEEDED, BOUNDED per-frame latency in
+                  (0, ``delay_s``]: a deterministic hash of (seed, frame
+                  index) picks each delayed frame's latency, so a slow
+                  network is reproducible frame-for-frame (reordering
+                  pressure / deadline pressure / slow-handoff chaos legs)
 * ``dup``       — forward the frame twice (duplicate req_id at the
                   server: dedup must execute once and replay the reply)
 * ``truncate``  — forward roughly half the frame, then kill the
@@ -85,9 +88,23 @@ class FaultSchedule:
             ("dup", float(dup)), ("truncate", float(truncate)),
             ("corrupt", float(corrupt)),
         )
+        self._seed = int(seed)
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
         self._counters = {"c2s": 0, "s2c": 0}
+
+    def delay_fraction(self, idx):
+        """Deterministic per-frame latency fraction in (0, 1]: a
+        splitmix64-style hash of (seed, frame index), so a delayed
+        frame's latency is a pure function of the schedule — seeded,
+        bounded, reproducible (never a shared-rng draw that would race
+        the pump threads' ordering)."""
+        z = ((self._seed & 0xFFFFFFFFFFFFFFFF) << 32 | (idx & 0xFFFFFFFF))
+        z = (z + 0x9E3779B97F4A7C15) & 0xFFFFFFFFFFFFFFFF
+        z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
+        z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF
+        z ^= z >> 31
+        return ((z & 0xFFFFFF) + 1) / float(1 << 24)
 
     def next_action(self, direction):
         """Consume one frame slot in `direction`, return its action."""
@@ -246,7 +263,12 @@ class FaultyChannel:
                 if action == "drop":
                     continue
                 if action == "delay":
-                    time.sleep(self.delay_s)
+                    # seeded bounded per-frame latency: delay_s is the
+                    # BOUND, the frame's own hash picks the latency —
+                    # delivered late, never lost (slow network, not a
+                    # dead one)
+                    time.sleep(self.delay_s
+                               * self.sched.delay_fraction(idx))
                     dst.sendall(frame)
                 elif action == "dup":
                     dst.sendall(frame)

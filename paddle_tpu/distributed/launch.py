@@ -112,7 +112,8 @@ class _ScalingPolicy:
     noisy observation cannot thrash the membership."""
 
     def __init__(self, min_t, max_t, cooldown_s=3.0, hysteresis=2,
-                 straggler_frac=0.5, budget=None):
+                 straggler_frac=0.5, budget=None, min_ps=None,
+                 max_ps=None, queue_hi=None):
         assert 1 <= int(min_t) <= int(max_t), (min_t, max_t)
         self.min_t = int(min_t)
         self.max_t = int(max_t)
@@ -124,6 +125,83 @@ class _ScalingPolicy:
         self._last_action = time.monotonic()
         self._grow_streak = 0
         self._lag_streaks = {}
+        # ---- load-aware PSERVER scaling (live shard migration) ------
+        # the supervisor polls each live pserver's `stats` verb and
+        # feeds the SERVER-side load here: queue_depth (un-applied
+        # contributions backing up), staleness parks (async servers
+        # pacing the fleet), and stale-plan drops (membership still
+        # settling — an action-suppressing flap signal).  Same
+        # hysteresis / cooldown / action-budget damping as the trainer
+        # axis; pserver actions trigger shard MIGRATIONS, so the budget
+        # matters twice over.
+        self.min_ps = int(min_ps) if min_ps is not None else None
+        self.max_ps = int(max_ps) if max_ps is not None else None
+        # queue_hi: pending contributions at/above this read as "the
+        # server cannot keep up" — default one full round's backlog
+        self.queue_hi = queue_hi
+        self._ps_hi_streak = 0
+        self._ps_lo_streak = 0
+        self._last_parks = None
+        self._last_drops = None
+
+    def observe_ps_load(self, ps_count, load, n_trainers=2):
+        """One pserver-load observation -> optional pserver action.
+        `load` aggregates the live servers' stats: {"queue_depth": max
+        across servers, "staleness_parks": cumulative, and
+        "stale_plan_drops": cumulative}.  Returns ("grow_ps", None),
+        ("shrink_ps", None) or None.  Shares the cooldown + action
+        budget with the trainer axis — one membership change at a
+        time."""
+        if self.min_ps is None or self.max_ps is None or not load:
+            return None
+        now = time.monotonic()
+        qd = int(load.get("queue_depth", 0))
+        parks = int(load.get("staleness_parks", 0))
+        drops = int(load.get("stale_plan_drops", 0))
+        parks_d = parks - (self._last_parks
+                           if self._last_parks is not None else parks)
+        drops_d = drops - (self._last_drops
+                           if self._last_drops is not None else drops)
+        self._last_parks, self._last_drops = parks, drops
+        hi = (self.queue_hi if self.queue_hi is not None
+              else max(2, int(n_trainers)))
+        if drops_d > 0:
+            # stale-plan drops mean a membership change is still
+            # settling: acting on load measured mid-flap would thrash
+            self._ps_hi_streak = 0
+            self._ps_lo_streak = 0
+            return None
+        if qd >= hi or parks_d > 0:
+            self._ps_hi_streak += 1
+            self._ps_lo_streak = 0
+        elif qd == 0:
+            self._ps_lo_streak += 1
+            self._ps_hi_streak = 0
+        else:
+            self._ps_hi_streak = 0
+            self._ps_lo_streak = 0
+        if now - self._last_action < self.cooldown_s:
+            return None
+        action = None
+        if (self._ps_hi_streak >= self.hysteresis
+                and ps_count < self.max_ps):
+            action = ("grow_ps", None)
+        elif (self._ps_lo_streak >= 2 * self.hysteresis
+                and ps_count > self.min_ps):
+            # retiring a server migrates every one of its shards: ask
+            # for twice the evidence a grow needs
+            action = ("shrink_ps", None)
+        if action is None:
+            return None
+        if self.budget.next_delay() is None:
+            sys.stderr.write(
+                "[launch] elastic pserver action %r suppressed: action "
+                "budget exhausted (flap damping)\n" % (action[0],))
+            return None
+        self._last_action = now
+        self._ps_hi_streak = 0
+        self._ps_lo_streak = 0
+        return action
 
     def decide(self, live_tags, rates):
         """One observation -> one decision.  `rates` maps live tag ->
@@ -496,6 +574,103 @@ def _arm_chaos(cluster, chaos_kills):
         cluster.schedule_kill(tag, after_s)
 
 
+def drive_pserver_migration(old_world, new_world, attempts=3,
+                            timeout_s=600.0, retry_wait=1.0):
+    """Two-phase supervisor driver for a pserver-set change
+    (docs/FAULT_TOLERANCE.md "Live shard migration").
+
+    Phase 1 — `migrate_begin(new_world)` on EVERY involved server (old
+    and new): each freezes at a round boundary, serializes the shards it
+    owns under the old dispatch but not the new one as crc-framed
+    journal records, and ships them to their new owners, which apply +
+    fsync BEFORE acking.  Phase 2 — only after every begin acked,
+    `migrate_commit(new_world)` on every server: adopt the world, drop
+    moved state, mint the plan epoch.  The epoch therefore provably
+    never mints before target durability; any failure aborts the whole
+    attempt (old assignment stays authoritative, zero applied updates
+    lost) and the driver retries — a SIGKILLed source or target
+    restores and the next attempt re-captures fresh state.
+
+    Returns {"ok", "attempts", "moved", "bytes", "ms", "epochs"}."""
+    import time as _t
+
+    from .rpc import RPCClient
+
+    old_world = [str(e) for e in old_world]
+    new_world = [str(e) for e in new_world]
+    involved = sorted(set(old_world) | set(new_world))
+    last_err = None
+    for attempt in range(1, int(attempts) + 1):
+        t0 = _t.monotonic()
+        begun, moved, nbytes = [], 0, 0
+        err = None
+        for ep in involved:
+            try:
+                r = RPCClient.get(ep).call(
+                    "migrate_begin", timeout_s=timeout_s,
+                    world=new_world)
+            except Exception as e:
+                err = "begin at %s failed: %s" % (ep, e)
+                break
+            if not (isinstance(r, dict) and r.get("ok")):
+                err = "begin at %s refused: %r" % (ep, r)
+                break
+            begun.append(ep)
+            moved += int(r.get("moved", 0))
+            nbytes += int(r.get("bytes", 0))
+        if err is not None:
+            last_err = err
+            sys.stderr.write(
+                "[launch] pserver migration attempt %d aborted: %s\n"
+                % (attempt, err))
+            for ep in begun:
+                try:
+                    RPCClient.get(ep).call("migrate_abort")
+                except Exception:
+                    pass
+            _t.sleep(retry_wait * attempt)
+            continue
+        # every moving shard is durable at its target: commit (a server
+        # killed between its begin-ack and here restores pre-handoff
+        # state; its commit then reads stale and the WHOLE handoff
+        # retries — the epoch still never minted early)
+        epochs = {}
+        for ep in involved:
+            committed = False
+            for _ in range(3):
+                try:
+                    r = RPCClient.get(ep).call(
+                        "migrate_commit", timeout_s=timeout_s,
+                        world=new_world)
+                except Exception as e:
+                    err = "commit at %s failed: %s" % (ep, e)
+                    _t.sleep(retry_wait)
+                    continue
+                if isinstance(r, dict) and r.get("ok"):
+                    epochs[ep] = int(r.get("epoch", 0))
+                    committed = True
+                    break
+                err = "commit at %s stale: %r" % (ep, r)
+                break
+            if not committed:
+                break
+        if len(epochs) == len(involved):
+            return {"ok": True, "attempts": attempt, "moved": moved,
+                    "bytes": nbytes, "epochs": epochs,
+                    "ms": round((_t.monotonic() - t0) * 1e3, 3)}
+        last_err = err
+        sys.stderr.write(
+            "[launch] pserver migration attempt %d commit failed: %s "
+            "— restarting the handoff\n" % (attempt, err))
+        for ep in involved:
+            try:
+                RPCClient.get(ep).call("migrate_abort")
+            except Exception:
+                pass
+        _t.sleep(retry_wait * attempt)
+    return {"ok": False, "error": last_err}
+
+
 def launch_collective(script_argv, nproc, base_env=None, chaos_kills=None,
                       n_pservers=0):
     """Collective (mesh data-parallel) cluster: nproc trainer processes,
@@ -545,11 +720,209 @@ def launch_collective(script_argv, nproc, base_env=None, chaos_kills=None,
     return cluster.wait()
 
 
+def _start_pserver_elastic_loop(cluster, common, script_argv, base_tags,
+                                spare, min_ps, max_ps, schedule, cooldown,
+                                supervise, make_restart_policy, stop_evt,
+                                nproc, policy=None):
+    """Elastic PSERVER loop (`--elastic-pservers MIN:MAX` /
+    `--pserver-schedule`, docs/FAULT_TOLERANCE.md "Live shard
+    migration"): grows a fresh (empty, PADDLE_PSERVER_ELASTIC=1) pserver
+    child and drives the two-phase journaled shard handoff into it, or
+    retires one by migrating every shard away, waiting for the trainers
+    to complete a round under the new plan, and issuing a clean `retire`.
+    Policy-driven actions read the live servers' `stats` verb — queue
+    depth / staleness parks / stale-plan drops — through
+    _ScalingPolicy.observe_ps_load; `--pserver-schedule T:+N,T:-N` is
+    the deterministic bench/chaos driver on the same machinery."""
+    from .rpc import RPCClient
+
+    world = [ep for _tag, ep in base_tags]  # live pserver endpoints
+    tag_of = {ep: tag for tag, ep in base_tags}
+    grown = []  # (tag, ep), newest last — preferred retirement victims
+    if policy is None:
+        policy = _ScalingPolicy(1, max(1, nproc), cooldown_s=cooldown,
+                                min_ps=min_ps, max_ps=max_ps)
+    sched = []
+    for spec in (schedule or "").split(","):
+        spec = spec.strip()
+        if spec:
+            t_s, _, d = spec.partition(":")
+            sched.append([float(t_s), int(d)])
+    sched.sort(key=lambda e: e[0])
+    scheduled_only = bool(sched)
+    t_start = time.monotonic()
+
+    def poll_stats(ep, timeout=1.5):
+        cli = RPCClient(ep, timeout=1.0, retries=1, retry_wait=0.05)
+        try:
+            s = cli.call("stats", deadline_s=timeout)
+            return s if isinstance(s, dict) else None
+        except Exception:
+            return None
+        finally:
+            cli.close()
+
+    def poll_load():
+        agg = {"queue_depth": 0, "staleness_parks": 0,
+               "stale_plan_drops": 0}
+        seen = False
+        for ep in list(world):
+            s = poll_stats(ep)
+            if s is None:
+                continue
+            seen = True
+            agg["queue_depth"] = max(agg["queue_depth"],
+                                     int(s.get("queue_depth", 0)))
+            agg["staleness_parks"] += int(s.get("staleness_parks", 0))
+            agg["stale_plan_drops"] += int(s.get("stale_plan_drops", 0))
+        return agg if seen else None
+
+    def wait_round_advance(min_rounds=2, timeout=45.0):
+        """Wait until the trainers have re-planned AWAY from the
+        retiree before it disappears.  Sync mode: a surviving server's
+        round counter advancing `min_rounds` past the commit means
+        every live trainer completed a full round under the NEW plan
+        (rounds are all-trainer barriers).  Async mode (no rounds): the
+        survivor fencing the trainers' old-epoch frames
+        (stale_plan_drops moving) is the re-plan witness — wait one
+        cooldown past it for the recovery re-ship to land."""
+        probe = next((e for e in world), None)
+        if probe is None:
+            return
+        s = poll_stats(probe)
+        base = int(s.get("round", 0)) if s else 0
+        base_drops = int(s.get("stale_plan_drops", 0)) if s else 0
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < timeout and not stop_evt.is_set():
+            s = poll_stats(probe)
+            if s and int(s.get("round", 0)) >= base + min_rounds:
+                return
+            if s and int(s.get("stale_plan_drops", 0)) > base_drops:
+                stop_evt.wait(max(1.0, float(cooldown)))
+                return
+            if stop_evt.wait(0.3):
+                return
+
+    def grow_ps(reason):
+        if not spare or len(world) >= max_ps:
+            return
+        tag, ep = spare.pop(0)
+        env = dict(common, PADDLE_TRAINING_ROLE="PSERVER",
+                   PADDLE_CURRENT_ENDPOINT=ep,
+                   PADDLE_PSERVER_ELASTIC="1")
+        cmd = [sys.executable, "-u"] + script_argv
+        sys.stderr.write("[launch] ELASTIC PSERVER GROW %s at %s (%s)\n"
+                         % (tag, ep, reason))
+        if supervise:
+            cluster.supervise(tag, cmd, env, make_restart_policy())
+        cluster.spawn(tag, cmd, env)
+
+        def reap_failed_grow(why):
+            # a failed grow must not leak: unsupervise (a supervised
+            # orphan would respawn forever outside every world), stop
+            # the child, and RETURN the slot so grow capacity is not
+            # permanently burned by a transient failure
+            sys.stderr.write(
+                "[launch] elastic pserver %s at %s abandoned: %s\n"
+                % (tag, ep, why))
+            cluster.unsupervise(tag)
+            try:
+                RPCClient.get(ep).call("retire", deadline_s=10.0)
+            except Exception:
+                cluster.kill_one(tag)
+            spare.append((tag, ep))
+
+        if not _wait_port(ep, timeout=120, cluster=cluster):
+            reap_failed_grow("port never opened")
+            return
+        r = drive_pserver_migration(world, world + [ep])
+        if r.get("ok"):
+            world.append(ep)
+            tag_of[ep] = tag
+            grown.append((tag, ep))
+            sys.stderr.write(
+                "[launch] PSERVER MIGRATION ok: world=%d moved=%d "
+                "bytes=%d ms=%.1f\n"
+                % (len(world), r["moved"], r["bytes"], r["ms"]))
+        else:
+            reap_failed_grow(
+                "migration failed (%s)" % r.get("error"))
+
+    def shrink_ps(reason):
+        if len(world) <= min_ps:
+            return
+        tag, ep = grown.pop() if grown else (tag_of[world[-1]],
+                                             world[-1])
+        sys.stderr.write(
+            "[launch] ELASTIC PSERVER SHRINK %s at %s (%s)\n"
+            % (tag, ep, reason))
+        new_world = [e for e in world if e != ep]
+        r = drive_pserver_migration(world, new_world)
+        if not r.get("ok"):
+            sys.stderr.write(
+                "[launch] PSERVER MIGRATION failed (%s): %s stays\n"
+                % (r.get("error"), tag))
+            if (tag, ep) not in grown and ep in tag_of:
+                grown.append((tag, ep))
+            return
+        world[:] = new_world
+        sys.stderr.write(
+            "[launch] PSERVER MIGRATION ok: world=%d moved=%d bytes=%d "
+            "ms=%.1f\n" % (len(world), r["moved"], r["bytes"], r["ms"]))
+        # drain: every trainer must complete one round under the new
+        # plan (its old-epoch frames got fenced, it re-planned away
+        # from the retiree) before the retiree may disappear
+        wait_round_advance()
+        cluster.unsupervise(tag)
+        try:
+            RPCClient.get(ep).call("retire", deadline_s=10.0)
+        except Exception:
+            cluster.kill_one(tag)
+
+    def loop():
+        while not stop_evt.wait(0.5):
+            if cluster._closing.is_set() or cluster.failed_rc is not None:
+                return
+            now = time.monotonic()
+            if sched and now - t_start >= sched[0][0]:
+                delta = sched.pop(0)[1]
+                for _ in range(abs(delta)):
+                    if delta > 0:
+                        grow_ps("scheduled")
+                    else:
+                        shrink_ps("scheduled")
+                continue
+            if scheduled_only:
+                continue
+            load = poll_load()
+            act = policy.observe_ps_load(len(world), load,
+                                         n_trainers=nproc)
+            if act is None:
+                continue
+            if act[0] == "grow_ps":
+                grow_ps("policy: %s" % load)
+            else:
+                shrink_ps("policy: %s" % load)
+
+    def run():
+        try:
+            loop()
+        except Exception:
+            import traceback
+
+            sys.stderr.write("[launch] elastic pserver loop died:\n")
+            traceback.print_exc()
+
+    threading.Thread(target=run, daemon=True,
+                     name="elastic-pserver-policy").start()
+
+
 def launch_pserver(script_argv, nproc, n_pservers, base_env=None, sync=True,
                    chaos_kills=None, supervise=False, max_restarts=3,
                    restart_window=60.0, restart_backoff=0.5, ckpt_dir=None,
                    staleness_bound=None, elastic=None, elastic_schedule=None,
-                   elastic_cooldown=3.0):
+                   elastic_cooldown=3.0, elastic_pservers=None,
+                   pserver_schedule=None):
     if elastic_schedule and not elastic:
         # fail BEFORE any child spawns: a dropped schedule would run a
         # clean "no regression" job in which the membership trace under
@@ -558,7 +931,26 @@ def launch_pserver(script_argv, nproc, n_pservers, base_env=None, sync=True,
             "--elastic-schedule requires --elastic MIN:MAX: the "
             "schedule drives the elastic machinery and alone would be "
             "silently ignored")
+    if pserver_schedule and not elastic_pservers:
+        raise ValueError(
+            "--pserver-schedule requires --elastic-pservers MIN:MAX: "
+            "the schedule drives the pserver-migration machinery and "
+            "alone would be silently ignored")
+    min_ps = max_ps = None
+    if elastic_pservers:
+        min_ps, max_ps = (int(x) for x in str(elastic_pservers).split(":"))
+        if not (1 <= min_ps <= n_pservers <= max_ps):
+            raise ValueError(
+                "--elastic-pservers MIN:MAX must satisfy MIN <= "
+                "--pservers <= MAX (got %s with --pservers %d)"
+                % (elastic_pservers, n_pservers))
     ports = [free_port() for _ in range(n_pservers)]
+    # elastic pserver headroom: endpoints for growable servers are
+    # reserved up front (the children aren't spawned until the policy
+    # or schedule grows them); PADDLE_PSERVER_EPS stays the BASE list —
+    # it defines the stable shard identity, never the live set
+    spare_ports = [free_port()
+                   for _ in range((max_ps or n_pservers) - n_pservers)]
     eps = ",".join("127.0.0.1:%d" % p for p in ports)
     common = dict(base_env or os.environ)
     common.update(
@@ -764,10 +1156,30 @@ def launch_pserver(script_argv, nproc, n_pservers, base_env=None, sync=True,
             cluster.supervise("trainer.%d" % rank, cmd, env, _policy())
         cluster.spawn("trainer.%d" % rank, cmd, env)
     stop_elastic = threading.Event()
+    # ONE policy instance spans both elastic axes when both are armed:
+    # the cooldown and the action budget are shared, so a trainer
+    # grow/shrink and a pserver shard migration cannot fire in the same
+    # window — one membership change at a time, as the damping promises
+    shared_policy = None
+    if elastic and elastic_pservers:
+        emin, emax = (int(x) for x in str(elastic).split(":"))
+        shared_policy = _ScalingPolicy(
+            emin, emax, cooldown_s=elastic_cooldown,
+            min_ps=min_ps, max_ps=max_ps)
     if elastic:
         _start_elastic_loop(cluster, common, script_argv, nproc, elastic,
                             elastic_schedule, elastic_cooldown,
-                            supervise, _policy, stop_elastic)
+                            supervise, _policy, stop_elastic,
+                            policy=shared_policy)
+    if elastic_pservers:
+        base_tags = [("pserver.%d" % i, "127.0.0.1:%d" % p)
+                     for i, p in enumerate(ports)]
+        spare = [("pserver.%d" % (n_pservers + i), "127.0.0.1:%d" % p)
+                 for i, p in enumerate(spare_ports)]
+        _start_pserver_elastic_loop(
+            cluster, common, script_argv, base_tags, spare, min_ps,
+            max_ps, pserver_schedule, elastic_cooldown, supervise,
+            _policy, stop_elastic, nproc, policy=shared_policy)
     _arm_chaos(cluster, chaos_kills)
     try:
         return cluster.wait()
@@ -777,7 +1189,7 @@ def launch_pserver(script_argv, nproc, n_pservers, base_env=None, sync=True,
 
 def _start_elastic_loop(cluster, common, script_argv, nproc, elastic,
                         elastic_schedule, elastic_cooldown, supervise,
-                        make_restart_policy, stop_evt):
+                        make_restart_policy, stop_evt, policy=None):
     """The scaling-policy loop (`--elastic MIN:MAX`): a supervisor
     thread watches per-trainer STEP progress off the output pump and
     adds/retires trainer children — the pserver admits/evicts them at
@@ -792,7 +1204,8 @@ def _start_elastic_loop(cluster, common, script_argv, nproc, elastic,
     death notification reports it as terminal (respawn=False) and the
     pserver evicts for good instead of parking a rejoin."""
     min_t, max_t = (int(x) for x in str(elastic).split(":"))
-    policy = _ScalingPolicy(min_t, max_t, cooldown_s=elastic_cooldown)
+    if policy is None:
+        policy = _ScalingPolicy(min_t, max_t, cooldown_s=elastic_cooldown)
     schedule = []
     for spec in (elastic_schedule or "").split(","):
         spec = spec.strip()
@@ -1000,6 +1413,23 @@ def main(argv=None):
         "damping; the policy also rides a per-window action budget)",
     )
     parser.add_argument(
+        "--elastic-pservers", default=None, metavar="MIN:MAX",
+        help="pserver mode: elastic PSERVER set — the supervisor polls "
+        "each server's load (queue depth / staleness parks) and grows a "
+        "fresh empty pserver or retires one, driving the two-phase "
+        "journaled shard migration (migrate_begin/commit) so shard "
+        "state MOVES with the membership and the plan epoch flips "
+        "trainer dispatch atomically (docs/FAULT_TOLERANCE.md 'Live "
+        "shard migration')",
+    )
+    parser.add_argument(
+        "--pserver-schedule", default=None, metavar="T:+N,T:-N",
+        help="deterministic pserver-migration driver: at T seconds "
+        "after launch, grow (+N) or retire (-N) pservers through the "
+        "same migration machinery the load policy uses (bench/chaos "
+        "harness)",
+    )
+    parser.add_argument(
         "--staleness-bound", type=int, default=None, metavar="STEPS",
         help="async pserver mode: arm FLAGS_async_staleness_bound in "
         "every child — pservers park pushes/prefetches from a trainer "
@@ -1022,12 +1452,30 @@ def main(argv=None):
         chaos_kills.append((tag, after_s))
 
     script_argv = [args.script] + args.script_args
+    base_env = None
     if args.mode == "collective" and (args.elastic or args.elastic_schedule):
-        parser.error("--elastic is pserver-mode only: a collective mesh "
-                     "is shape-compiled, its world cannot change at a "
-                     "round boundary (re-launch with a new --nproc)")
+        # elastic collective (docs/FAULT_TOLERANCE.md "Elastic
+        # autoscaling", collective mode): a SINGLE-process virtual-device
+        # mesh re-traces on resize — the trainer drains its ordered-io
+        # tokens, rebuilds the shard_map over the new dp mesh, and
+        # rescales host-side like the pserver path.  Multi-process
+        # meshes still need a relaunch (one device per process is
+        # pinned at jax.distributed init).
+        if args.nproc != 1:
+            parser.error(
+                "--elastic with --mode collective needs --nproc 1: the "
+                "elastic mesh resizes VIRTUAL devices inside one "
+                "process (multi-process meshes pin one device per "
+                "process at jax.distributed init — relaunch to resize)")
+        if not args.elastic:
+            parser.error("--elastic-schedule requires --elastic MIN:MAX")
+        base_env = dict(os.environ)
+        base_env["DIST_COLLECTIVE_ELASTIC"] = args.elastic
+        if args.elastic_schedule:
+            base_env["DIST_COLLECTIVE_SCHEDULE"] = args.elastic_schedule
     if args.mode == "collective":
         rc = launch_collective(script_argv, args.nproc,
+                               base_env=base_env,
                                chaos_kills=chaos_kills,
                                n_pservers=args.pservers or 0)
     else:
@@ -1042,6 +1490,8 @@ def main(argv=None):
             staleness_bound=args.staleness_bound,
             elastic=args.elastic, elastic_schedule=args.elastic_schedule,
             elastic_cooldown=args.elastic_cooldown,
+            elastic_pservers=args.elastic_pservers,
+            pserver_schedule=args.pserver_schedule,
         )
     return rc
 

@@ -104,6 +104,10 @@ class ParameterServer:
         server_idx=0,
         eviction_deadline=None,
         staleness_bound=None,
+        plan_spec=None,
+        endpoint=None,
+        ps_world=None,
+        sparse_shard_idx=None,
     ):
         from ..executor import Executor
         from ..places import CPUPlace
@@ -273,6 +277,42 @@ class ParameterServer:
         self._phases = []  # closed phases: {epoch, world, rounds, wall_s}
         self._phase = {"epoch": 0, "world": len(self._live),
                        "round0": 0, "t0": _time.monotonic()}
+        # ---- live pserver shard migration (docs/FAULT_TOLERANCE.md
+        # "Live shard migration"): the declarative plan spec lets this
+        # server re-derive shard->endpoint dispatch for a changed pserver
+        # world and compute which of ITS shards must move.  The handoff
+        # is two-phase (migrate_begin freezes + serializes + ships to the
+        # targets, which journal/fsync BEFORE acking; migrate_commit
+        # adopts the new world, drops the moved state, and mints the plan
+        # epoch) so the epoch provably never mints before target
+        # durability — a SIGKILL of source or target mid-handoff leaves
+        # the OLD assignment authoritative and loses zero applied
+        # updates.
+        self.plan_spec = plan_spec
+        self.endpoint = endpoint
+        self._ps_world = [str(e) for e in (
+            ps_world or (plan_spec or {}).get("endpoints")
+            or ([endpoint] if endpoint else []))]
+        # sparse shard name -> BASE shard index (rows hash g % n_base;
+        # the index is the shard's stable identity across migrations)
+        self._sparse_shard_idx = dict(sparse_shard_idx or {})
+        self._frozen = False
+        self._mig = None      # in-flight migrate_begin capture
+        self._mig_gen = 0     # generation: a timed-out freeze self-aborts
+        # adopted-state registry: shard programs / sparse specs /
+        # lr_program this server acquired via migrate_in — they must ride
+        # the snapshot, because a restarted server rebuilds everything
+        # else from its (transpile-time) listen_and_serv attrs
+        self._adopted = {"programs": {}, "sparse": {}, "lr_program": None,
+                         "dropped": []}
+        self._dropped_vars = set()  # migrated-away param-block var names
+        # runtime-surfaced reduced-guarantee flag (the legacy per-var
+        # async path is journaled but UNFENCED): set on first such apply
+        self._unfenced_async = False
+        self.counters.update({
+            "migrations_out": 0, "migrations_in": 0, "migrate_aborts": 0,
+            "migrated_bytes_out": 0, "migrated_bytes_in": 0,
+            "migrated_shards_out": 0, "migrated_shards_in": 0})
         # every pserver start — cold or restored — is a new INCARNATION;
         # the number rides every rpc reply envelope so trainers can fence
         # a restart (see rpc.py incarnation registry)
@@ -541,6 +581,71 @@ class ParameterServer:
                 self._dense_fence_commit(tid, aseq)
         elif kind == "v":
             self._apply_async_send_locked(rec["n"], np.asarray(rec["v"]))
+        # ---- live shard migration records (docs/FAULT_TOLERANCE.md
+        # "Live shard migration"): state HANDED OFF from another server,
+        # applied both live (migrate_in) and from journal replay — an
+        # adopted shard survives the target's own SIGKILL either way
+        elif kind == "mshard":
+            g = str(rec["g"])
+            prog = framework.Program.from_json(rec["prog"])
+            si = self.grad_to_shard.get(g)
+            if si is None:
+                self.grad_to_shard[g] = len(self.shard_programs)
+                self.shard_programs.append(prog)
+            else:
+                self.shard_programs[si] = prog  # idempotent retry
+            for n, v in sorted(rec["vars"].items()):
+                self.scope.set(n, np.ascontiguousarray(v))
+                # a shard can move BACK (2 -> 3 -> 2): re-adoption
+                # clears the dropped-var fence for its vars
+                self._dropped_vars.discard(n)
+            if g in self._adopted["dropped"]:
+                self._adopted["dropped"].remove(g)
+            self._adopted["programs"][g] = rec["prog"]
+            self._fused = None
+            self._fused_ready = False
+            self._recalc_lr_trigger_locked()
+        elif kind == "mtable":
+            shard = str(rec["t"])
+            info = {}
+            for kk, vv in rec["info"].items():
+                info[kk] = (np.ascontiguousarray(vv)
+                            if isinstance(vv, np.ndarray) else vv)
+            info.setdefault("opt", {"type": "sgd", "attrs": {}})
+            self.sparse_tables[shard] = info
+            if shard in self._adopted["dropped"]:
+                self._adopted["dropped"].remove(shard)
+            if int(rec.get("s", -1)) >= 0:
+                self._sparse_shard_idx[shard] = int(rec["s"])
+            for t, sq in (rec.get("fences") or {}).items():
+                key = (int(t), shard)
+                self._sparse_fence[key] = max(
+                    self._sparse_fence.get(key, 0), int(sq))
+            self._adopted["sparse"][shard] = {
+                "s": int(rec.get("s", -1)),
+                "lr": info.get("lr"), "opt": info.get("opt")}
+        elif kind == "mwhole":
+            for n, v in sorted((rec.get("vars") or {}).items()):
+                # set-if-absent: an established server's own copies (its
+                # lr decay state advanced by its own rounds) win
+                if self.scope.find_var(n) is None:
+                    self.scope.set(n, np.ascontiguousarray(v))
+            if rec.get("lr_program") and self.lr_program is None:
+                self.lr_program = framework.Program.from_json(
+                    rec["lr_program"])
+                self._adopted["lr_program"] = rec["lr_program"]
+        elif kind == "mfence":
+            # migrated fold fences: rounds the shipped state already
+            # contains must fence here exactly as at the source (sync
+            # rounds are lockstep, so max-merge is exact)
+            for t, s in (rec.get("send") or {}).items():
+                t = int(t)
+                self._folded_send[t] = max(
+                    self._folded_send.get(t, -1), int(s))
+            for t, s in (rec.get("fetch") or {}).items():
+                t = int(t)
+                self._folded_fetch[t] = max(
+                    self._folded_fetch.get(t, -1), int(s))
 
     # ---- async delivery fences + bounded staleness -----------------------
     def _dense_fence_is_dup(self, tid, aseq):
@@ -639,6 +744,21 @@ class ParameterServer:
             # not fall behind its trainers' epochs (its stale fence
             # would misread every current-epoch frame as the future)
             "plan": {"epoch": self._plan_epoch},
+            # live shard migration: the current pserver world plus every
+            # shard program / sparse spec ADOPTED via migrate_in — a
+            # restarted server rebuilds everything else from its
+            # transpile-time listen_and_serv attrs, but adopted shards
+            # exist only here (and in the journal), and dropped shards
+            # must not be resurrected from those same attrs
+            "migration": {
+                "world": list(self._ps_world),
+                "programs": dict(self._adopted["programs"]),
+                "sparse": {k: dict(v) for k, v in
+                           self._adopted["sparse"].items()},
+                "lr_program": self._adopted["lr_program"],
+                "dropped": list(self._adopted["dropped"]),
+                "dropped_vars": sorted(self._dropped_vars),
+                "shard_idx": dict(self._sparse_shard_idx)},
             # per-trainer fold fences ride the SAME snapshot as the
             # params: after a restore, replayed buckets for rounds the
             # restored state already contains are dropped, rounds the
@@ -829,6 +949,46 @@ class ParameterServer:
                 self._write_snapshot(data)
             except OSError:
                 pass
+        # live shard migration: re-adopt handed-off shards BEFORE the
+        # vars/sparse restore (the sparse loop skips tables this server
+        # doesn't know), and re-drop migrated-away shards the transpile-
+        # time attrs would otherwise resurrect into double ownership
+        mig = data.get("migration") or {}
+        if mig.get("world"):
+            self._ps_world = [str(e) for e in mig["world"]]
+        self._sparse_shard_idx.update(
+            {str(k): int(v)
+             for k, v in (mig.get("shard_idx") or {}).items()})
+        for g, pj in sorted((mig.get("programs") or {}).items()):
+            if g not in self.grad_to_shard:
+                self.grad_to_shard[g] = len(self.shard_programs)
+                self.shard_programs.append(framework.Program.from_json(pj))
+            self._adopted["programs"][g] = pj
+        for shard, spec in sorted((mig.get("sparse") or {}).items()):
+            if shard not in self.sparse_tables:
+                self.sparse_tables[shard] = {
+                    "tbl": np.zeros((0, 1), np.float32),  # data["sparse"]
+                    "lr": spec.get("lr"),                 # fills it below
+                    "opt": spec.get("opt") or {"type": "sgd",
+                                               "attrs": {}}}
+            if int(spec.get("s", -1)) >= 0:
+                self._sparse_shard_idx[shard] = int(spec["s"])
+            self._adopted["sparse"][shard] = dict(spec)
+        if mig.get("lr_program") and self.lr_program is None:
+            self.lr_program = framework.Program.from_json(
+                mig["lr_program"])
+            self._adopted["lr_program"] = mig["lr_program"]
+        self._dropped_vars |= set(mig.get("dropped_vars") or [])
+        for name in mig.get("dropped") or []:
+            si = self.grad_to_shard.pop(name, None)
+            if si is not None:
+                self.shard_programs[si] = None
+                self._fused = None
+                self._fused_ready = False
+            self.sparse_tables.pop(name, None)
+            if name not in self._adopted["dropped"]:
+                self._adopted["dropped"].append(name)
+        self._recalc_lr_trigger_locked()
         for n, v in data["vars"].items():
             self.scope.set(n, v)
         for k, v in data["sparse"].items():
@@ -1197,7 +1357,12 @@ class ParameterServer:
             return {"epoch": self._plan_epoch,
                     "world": max(1, len(self._live)),
                     "live": sorted(self._live),
-                    "trainers": self.num_trainers}
+                    "trainers": self.num_trainers,
+                    # live pserver migration: the CURRENT pserver world
+                    # — trainers re-derive block/shard dispatch over it
+                    # (empty for pre-migration servers: the client then
+                    # keeps its spec endpoints)
+                    "endpoints": list(self._ps_world)}
 
     def _plan_reply_locked(self, reply):
         """Stamp the current plan epoch into a reply ONCE elasticity has
@@ -1207,6 +1372,461 @@ class ParameterServer:
         if self._plan_epoch > 0:
             reply["pepoch"] = self._plan_epoch
         return reply
+
+    # ---- live pserver shard migration (journaled handoff) ----------------
+    # docs/FAULT_TOLERANCE.md "Live shard migration".  Two-phase, driven
+    # by the supervisor (or an admin `migrate` client):
+    #   migrate_begin(world) — wait for a round boundary, FREEZE state
+    #     mutation, serialize every shard this server owns under the OLD
+    #     dispatch but not the NEW one as crc-framed journal records, and
+    #     ship them to their new owners (`migrate_in`), which apply them
+    #     through the same live paths journal replay uses and fsync a
+    #     snapshot BEFORE acking.  Any failure aborts: unfreeze, keep
+    #     everything, old assignment stays authoritative.
+    #   migrate_commit(world) — adopt the new pserver world, drop the
+    #     moved state, unfreeze, and mint the plan epoch.  The supervisor
+    #     only commits after EVERY server's begin acked, so the epoch
+    #     provably never mints before target durability.
+    # A timed-out freeze self-aborts (a dead supervisor must throttle the
+    # cluster, never deadlock it); the later commit then reads stale and
+    # the supervisor restarts the whole handoff, re-capturing fresh state
+    # (migrate_in overwrites by name — idempotent).
+    def _recalc_lr_trigger_locked(self):
+        """The async lr-program trigger is keyed to ONE designated grad
+        (min name) — migration adding or removing shards must re-derive
+        it, or a server whose trigger shard moved away stops advancing
+        its lr schedule (and the rowless slot-state catch-up keyed to
+        it), and an elastic-grown server would never start."""
+        self._lr_trigger = (min(self.grad_to_shard)
+                            if self.grad_to_shard else None)
+
+    def _freeze_wait_locked(self):
+        """Park a state-mutating verb while a shard handoff is capturing
+        /shipping.  Bounded like the staleness park: freeze throttles,
+        never deadlocks."""
+        if not self._frozen:
+            return
+        limit = max(10.0, 3.0 * self.eviction_deadline)
+        self._cv.wait_for(
+            lambda: not self._frozen or self._done.is_set(),
+            timeout=limit)
+
+    def _mig_frame(self, rec):
+        """One journal-format frame: [8B len][4B crc32][pickle] — the
+        exact on-disk record framing, reused as the handoff transport so
+        the receiver validates and replays with the same discipline."""
+        import pickle
+        import zlib
+
+        payload = pickle.dumps(rec, protocol=pickle.HIGHEST_PROTOCOL)
+        return _J_HEAD.pack(len(payload),
+                            zlib.crc32(payload) & 0xFFFFFFFF) + payload
+
+    @staticmethod
+    def _mig_unframe(frame):
+        """Validate + decode one handoff frame; raises on length/crc
+        mismatch (a torn frame must fail the handoff loudly, exactly as
+        a torn journal record ends a replay — never apply garbage)."""
+        import pickle
+        import zlib
+
+        if len(frame) < _J_HEAD.size:
+            raise ValueError("migration frame shorter than its header")
+        ln, crc = _J_HEAD.unpack_from(frame, 0)
+        payload = frame[_J_HEAD.size:]
+        if ln != len(payload) or ln > _J_MAX_RECORD:
+            raise ValueError("migration frame length mismatch")
+        if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+            raise ValueError("migration frame crc mismatch")
+        return pickle.loads(payload)
+
+    def _derive_ps_plan(self, endpoints):
+        from ..transpiler.distribute_transpiler import derive_plan
+
+        return derive_plan(self.plan_spec,
+                           world={"endpoints": list(endpoints)})
+
+    def _serialize_dense_shard_locked(self, gblock, idx):
+        """One moving dense shard as a journal record: its optimizer
+        shard program plus every per-block persistable var (param block,
+        sliced moments, private beta pows — everything suffixed with
+        this block's index).  Whole (shared) vars ship separately."""
+        prog = self.shard_programs[self.grad_to_shard[gblock]]
+        suffix = ".block%d" % int(idx)
+        vars_out, whole = {}, {}
+        for name, v in sorted(prog.global_block().vars.items()):
+            if not getattr(v, "persistable", False):
+                continue
+            cur = self.scope.find_var(name)
+            if cur is None:
+                continue
+            if name.endswith(suffix):
+                vars_out[name] = np.array(cur)
+            else:
+                whole[name] = np.array(cur)
+        return ({"k": "mshard", "g": gblock, "i": int(idx),
+                 "prog": prog.to_json(), "vars": vars_out}, whole)
+
+    def _serialize_sparse_shard_locked(self, shard):
+        info = self.sparse_tables[shard]
+        payload = {
+            kk: (np.array(vv) if isinstance(vv, np.ndarray) else vv)
+            for kk, vv in info.items()
+            if kk in ("tbl", "lr", "opt")
+            or kk.startswith(("moment", "beta", "velocity"))}
+        fences = {str(t): int(sq)
+                  for (t, tb), sq in self._sparse_fence.items()
+                  if tb == shard}
+        return {"k": "mtable", "t": str(shard),
+                "s": int(self._sparse_shard_idx.get(shard, -1)),
+                "info": payload, "fences": fences}
+
+    def _moving_sets_locked(self, new_world):
+        """The shards THIS server owns under the old dispatch but not
+        the new: [(gblock, new_ep, idx), ...], [(shard, new_ep), ...].
+        Shared by the begin capture and the restart-recovery commit."""
+        old_plan = self._derive_ps_plan(self._ps_world)
+        new_plan = self._derive_ps_plan(new_world)
+        grads = {str(p): str(g) for p, _s, _d, g in
+                 self.plan_spec["params"]}
+        dense, sparse = [], []
+        for (p, idx), old_ep in sorted(old_plan["block_eps"].items()):
+            if old_ep != self.endpoint:
+                continue
+            new_ep = new_plan["block_eps"][(p, idx)]
+            if new_ep == self.endpoint:
+                continue
+            gblock = "%s.block%d" % (grads[p], idx)
+            if gblock not in self.grad_to_shard:
+                continue  # already handed off (idempotent retry)
+            dense.append((gblock, new_ep, int(idx)))
+        for shard, s in sorted(self._sparse_shard_idx.items()):
+            if shard not in self.sparse_tables:
+                continue
+            old_ep = old_plan["sparse_eps"][s]
+            new_ep = new_plan["sparse_eps"][s]
+            if old_ep != self.endpoint or new_ep == self.endpoint:
+                continue
+            sparse.append((shard, new_ep))
+        return dense, sparse
+
+    def _shard_var_names_locked(self, gblock, idx):
+        """Persistable per-block vars of one dense shard (the state that
+        moves with it)."""
+        prog = self.shard_programs[self.grad_to_shard[gblock]]
+        suffix = ".block%d" % int(idx)
+        return sorted(
+            n for n, v in prog.global_block().vars.items()
+            if getattr(v, "persistable", False) and n.endswith(suffix))
+
+    def _mig_capture_locked(self, new_world):
+        """Compute the moving set (old dispatch vs new) and serialize it
+        into per-target frame lists.  Called frozen, at a boundary."""
+        dense, sparse = self._moving_sets_locked(new_world)
+        targets = {}   # ep -> [frame, ...]
+        whole_all = {}
+        moved_dense, moved_sparse = [], []
+        for gblock, new_ep, idx in dense:
+            rec, whole = self._serialize_dense_shard_locked(gblock, idx)
+            targets.setdefault(new_ep, []).append(self._mig_frame(rec))
+            whole_all.update(whole)
+            moved_dense.append((gblock, new_ep, sorted(rec["vars"])))
+        for shard, new_ep in sparse:
+            rec = self._serialize_sparse_shard_locked(shard)
+            targets.setdefault(new_ep, []).append(self._mig_frame(rec))
+            moved_sparse.append((shard, new_ep))
+        if targets:
+            # shared state a FRESH target needs: whole vars (scheduled
+            # lr values, step counters) + the lr program; applied
+            # set-if-absent so an established server's own copies win
+            if self.lr_program is not None:
+                for name, v in sorted(
+                        self.lr_program.global_block().vars.items()):
+                    if getattr(v, "persistable", False):
+                        cur = self.scope.find_var(name)
+                        if cur is not None:
+                            whole_all.setdefault(name, np.array(cur))
+            wrec = self._mig_frame({
+                "k": "mwhole", "vars": whole_all,
+                "lr_program": (self.lr_program.to_json()
+                               if self.lr_program is not None else None)})
+            # the per-trainer FOLD FENCES travel with the state: the
+            # captured shards already contain every round this server
+            # folded, and a post-flip re-ship of the transition round
+            # must drop as dup_round at the NEW owner exactly as it
+            # would have here — a fresh target without the fences would
+            # apply an already-contained round a second time (the
+            # double-apply race the 2->3 chaos E2E caught)
+            frec = self._mig_frame({
+                "k": "mfence",
+                "send": {str(t): int(s)
+                         for t, s in self._folded_send.items()},
+                "fetch": {str(t): int(s)
+                          for t, s in self._folded_fetch.items()}})
+            for ep in targets:
+                targets[ep].append(wrec)
+                targets[ep].append(frec)
+        return targets, moved_dense, moved_sparse
+
+    def _abort_mig_locked(self, why):
+        if self._mig is None and not self._frozen:
+            return
+        self.counters["migrate_aborts"] += 1
+        print("PSERVER MIGRATE-ABORT ep=%s: %s"
+              % (self.endpoint, why), flush=True)
+        self._mig = None
+        self._mig_gen += 1
+        self._frozen = False
+        self._cv.notify_all()
+
+    def _mig_timeout(self, gen):
+        with self._cv:
+            if self._frozen and self._mig_gen == gen:
+                self._abort_mig_locked(
+                    "freeze timed out waiting for commit — the "
+                    "supervisor died mid-handoff; unfreezing (the old "
+                    "assignment stays authoritative)")
+
+    def _h_migrate_begin(self, world, trainer_id=0):
+        """Phase 1 of the handoff (see section comment)."""
+        import time
+
+        if not self.plan_spec or not self.endpoint:
+            return {"ok": False,
+                    "error": "no re-derivable plan spec: this server "
+                             "cannot compute shard dispatch for a new "
+                             "world (custom dispatcher or legacy "
+                             "per-variable wire) — migration refused"}
+        world = [str(e) for e in world]
+        t0 = time.monotonic()
+        limit = max(10.0, 3.0 * self.eviction_deadline)
+        with self._cv:
+            if self._frozen or self._mig is not None:
+                return {"ok": False, "busy": True}
+            if not self._cv.wait_for(
+                    lambda: self._at_boundary_locked()
+                    or self._done.is_set(), timeout=limit):
+                return {"ok": False, "busy": True,
+                        "error": "no round boundary within %.0fs" % limit}
+            self._frozen = True
+            self._mig_gen += 1
+            gen = self._mig_gen
+            try:
+                targets, moved_dense, moved_sparse = \
+                    self._mig_capture_locked(world)
+            except Exception as e:
+                import traceback
+
+                traceback.print_exc()
+                self._abort_mig_locked("capture failed: %s" % e)
+                return {"ok": False, "error": "capture failed: %s" % e}
+            nbytes = sum(len(f) for frames in targets.values()
+                         for f in frames)
+            self._mig = {"world": world, "gen": gen,
+                         "dense": moved_dense, "sparse": moved_sparse,
+                         "bytes": nbytes}
+            timer = threading.Timer(limit, self._mig_timeout, args=(gen,))
+            timer.daemon = True
+            timer.start()
+        if targets:
+            # chaos hook: SIGKILL the SOURCE mid-serialize (captured,
+            # nothing shipped) — the old assignment must stay
+            # authoritative and the retried handoff re-captures fresh
+            self._maybe_migrate_crash("serialize")
+        # ship OUTSIDE the lock: the freeze keeps captured state
+        # consistent while frames are on the wire, and reads/heartbeats
+        # keep flowing.  Any target failure aborts the whole handoff —
+        # the epoch never mints for a partial transfer.
+        shipped = {}
+        err = None
+        from .rpc import RPCClient
+
+        for ep, frames in sorted(targets.items()):
+            try:
+                r = RPCClient.get(ep).call(
+                    "migrate_in", timeout_s=600.0, frames=frames,
+                    source=self.endpoint)
+                if not (isinstance(r, dict) and r.get("ok")):
+                    err = "target %s refused the handoff: %r" % (ep, r)
+                    break
+                shipped[ep] = int(r.get("applied", 0))
+            except Exception as e:
+                err = "target %s failed mid-handoff: %s" % (ep, e)
+                break
+        with self._cv:
+            if err is not None:
+                self._abort_mig_locked(err)
+                return {"ok": False, "error": err}
+            if self._mig is None or self._mig.get("gen") != gen:
+                # the freeze self-aborted while we were shipping
+                return {"ok": False, "stale": True,
+                        "error": "freeze timed out during shipping"}
+            moved = len(moved_dense) + len(moved_sparse)
+            self.counters["migrated_shards_out"] += moved
+            self.counters["migrated_bytes_out"] += nbytes
+        print("PSERVER MIGRATE-BEGIN ep=%s world=%s moved=%d bytes=%d "
+              "ms=%.1f" % (self.endpoint, world, moved, nbytes,
+                           (time.monotonic() - t0) * 1e3), flush=True)
+        return {"ok": True, "moved": moved, "bytes": nbytes,
+                "targets": shipped,
+                "ms": round((time.monotonic() - t0) * 1e3, 3)}
+
+    def _h_migrate_commit(self, world, trainer_id=0):
+        """Phase 2: adopt the new pserver world, drop moved state, mint.
+        Only called by the driver after EVERY live server's begin acked
+        (i.e. every moving shard is durable at its target)."""
+        world = [str(e) for e in world]
+        with self._cv:
+            if self._mig is not None and self._mig["world"] != world:
+                return {"ok": False, "stale": True}
+            if self._mig is None:
+                # RESTART-RECOVERY commit: this server was killed (and
+                # restored) between its begin-ack and here — the capture
+                # died with the old incarnation, but the driver only
+                # commits after EVERY begin acked, so every moving shard
+                # is already durable at its target.  Recompute the diff
+                # and adopt; dropping our (possibly one-restart-round
+                # stale) copies is the correct direction — the target's
+                # shipped copy is the newer one.  Without this, the
+                # driver would have to abort-and-re-begin AFTER another
+                # server already minted, and the re-shipped stale copy
+                # would overwrite rounds trainers applied at the target
+                # in between (a lost update).
+                if not self.plan_spec or not self.endpoint:
+                    return {"ok": False, "stale": True}
+                if world == self._ps_world:
+                    # already committed before the kill: idempotent ack
+                    return {"ok": True, "epoch": self._plan_epoch,
+                            "retiring": self.endpoint not in world}
+                limit = max(10.0, 3.0 * self.eviction_deadline)
+                self._cv.wait_for(
+                    lambda: self._at_boundary_locked()
+                    or self._done.is_set(), timeout=limit)
+                try:
+                    dense, sparse = self._moving_sets_locked(world)
+                except Exception as e:
+                    return {"ok": False, "stale": True,
+                            "error": "recovery diff failed: %s" % e}
+                self._mig = {
+                    "world": world, "gen": self._mig_gen,
+                    "dense": [(g, ep,
+                               self._shard_var_names_locked(g, idx))
+                              for g, ep, idx in dense],
+                    "sparse": sparse}
+                print("PSERVER MIGRATE-COMMIT-RECOVERY ep=%s world=%s"
+                      % (self.endpoint, world), flush=True)
+            for gblock, _ep, var_names in self._mig["dense"]:
+                si = self.grad_to_shard.pop(gblock, None)
+                if si is not None:
+                    self.shard_programs[si] = None
+                for n in var_names:
+                    self.scope.erase(n)
+                    # a fetch of a dropped var under the old layout must
+                    # answer stale_plan (re-plan + re-pull), never a
+                    # KeyError crash
+                    self._dropped_vars.add(n)
+                self._adopted["programs"].pop(gblock, None)
+                self._adopted["dropped"].append(gblock)
+            for shard, _ep in self._mig["sparse"]:
+                self.sparse_tables.pop(shard, None)
+                for key in [k for k in self._sparse_fence
+                            if k[1] == shard]:
+                    del self._sparse_fence[key]
+                self._adopted["sparse"].pop(shard, None)
+                self._adopted["dropped"].append(shard)
+            moved = len(self._mig["dense"]) + len(self._mig["sparse"])
+            self._fused = None
+            self._fused_ready = False
+            self._recalc_lr_trigger_locked()
+            self._ps_world = world
+            retiring = (self.endpoint is not None
+                        and self.endpoint not in world)
+            self._mig = None
+            self._mig_gen += 1  # disarms the freeze-timeout timer
+            self._frozen = False
+            if moved:
+                self.counters["migrations_out"] += 1
+            # the pserver membership changed durably: mint NOW (the
+            # freeze held the server at a round boundary) so the next
+            # trainer frame learns the new world
+            self._mark_plan_dirty_locked()
+            data = self._snapshot() if self.checkpoint_dir else None
+            epoch = self._plan_epoch
+            self._cv.notify_all()
+        if data is not None:
+            # synchronous: the new world (and the dropped shards) are
+            # durable before the commit acks — a restart cannot
+            # resurrect moved-away shards into double ownership
+            self._write_snapshot(data)
+        print("PSERVER MIGRATE-COMMIT ep=%s world=%s epoch=%d%s"
+              % (self.endpoint, world, epoch,
+                 " RETIRING" if retiring else ""), flush=True)
+        return {"ok": True, "epoch": epoch, "retiring": retiring}
+
+    def _h_migrate_abort(self, trainer_id=0):
+        with self._cv:
+            self._abort_mig_locked("driver requested abort")
+            return {"ok": True}
+
+    def _maybe_migrate_crash(self, point):
+        """Deterministic chaos hook: PADDLE_TPU_MIGRATE_CRASH names the
+        kill point ('recv' = before any record applies, 'ack' = after
+        apply + fsync, before the ack leaves); the marker file (crash
+        once) lets a supervised respawn run clean."""
+        import os
+        import signal
+
+        if os.environ.get("PADDLE_TPU_MIGRATE_CRASH") != point:
+            return
+        marker = os.environ.get("PADDLE_TPU_MIGRATE_CRASH_ONCE")
+        if marker and os.path.exists(marker):
+            return
+        if marker:
+            with open(marker, "w") as f:
+                f.write(point)
+        print("PSERVER MIGRATE-CRASH point=%s" % point, flush=True)
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    def _h_migrate_in(self, frames, source=None, trainer_id=0):
+        """Target side of the handoff: validate each crc-framed journal
+        record, apply it through the SAME paths journal replay uses,
+        append it to this server's own journal (async mode), and fsync a
+        snapshot BEFORE acking — acked == durable, so the source's
+        commit (and the epoch mint behind it) can rely on it."""
+        self._maybe_migrate_crash("recv")
+        with self._cv:
+            n = 0
+            for frame in frames:
+                rec = self._mig_unframe(frame)
+                self._apply_journal_record(rec)
+                self._journal_append_locked(rec)
+                n += 1
+                self.counters["migrated_bytes_in"] += len(frame)
+                if rec.get("k") in ("mshard", "mtable"):
+                    self.counters["migrated_shards_in"] += 1
+            if n:
+                self.counters["migrations_in"] += 1
+            data = self._snapshot() if self.checkpoint_dir else None
+        if data is not None:
+            self._write_snapshot(data)  # fsync'd BEFORE the ack
+        self._maybe_migrate_crash("ack")
+        print("PSERVER MIGRATE-IN ep=%s source=%s records=%d durable=%s"
+              % (self.endpoint, source, n, bool(self.checkpoint_dir)),
+              flush=True)
+        return {"ok": True, "applied": n,
+                "durable": bool(self.checkpoint_dir)}
+
+    def _h_retire(self, trainer_id=0):
+        """Clean shutdown of a drained, migrated-away server: after its
+        commit (all shards handed off, epoch minted, trainers
+        re-planned), the driver retires it — the serve loop concludes
+        and PSERVER-STATS prints, instead of an opaque SIGKILL."""
+        with self._cv:
+            print("PSERVER RETIRE ep=%s round=%d"
+                  % (self.endpoint, self._round), flush=True)
+            self._done.set()
+            self._cv.notify_all()
+            return {"ok": True}
 
     # ---- elastic rejoin --------------------------------------------------
     def _admit_locked(self, tid):
@@ -1306,6 +1926,18 @@ class ParameterServer:
         journal/park evidence (rpc.get_comm_stats's server-side
         sibling)."""
         with self._cv:
+            # load-aware scaling signals (docs/FAULT_TOLERANCE.md "Live
+            # shard migration"): server-side pending work the
+            # supervisor's _ScalingPolicy polls live — queue depth is
+            # the number of un-applied per-trainer contributions +
+            # queued sparse chunks, pending_bytes their payload (the
+            # server-side bytes-in-flight)
+            qd = (sum(len(per) for per in self._pending.values())
+                  + len(self._pending_sparse))
+            pb = (sum(int(v.nbytes) for per in self._pending.values()
+                      for v in per.values())
+                  + sum(int(np.asarray(c[1]).nbytes)
+                        for c in self._pending_sparse.values()))
             out = {"round": self._round, "incarnation": self.incarnation,
                    "live": sorted(self._live),
                    "evicted": sorted(self._evicted),
@@ -1316,6 +1948,12 @@ class ParameterServer:
                    "plan_epoch": self._plan_epoch,
                    "world": len(self._live),
                    "phases": self._phases_snapshot_locked(),
+                   "queue_depth": qd,
+                   "pending_bytes": pb,
+                   "ps_world": list(self._ps_world),
+                   # runtime surface for the reduced legacy guarantee
+                   # (journaled-but-unfenced per-var async path)
+                   "unfenced_async": bool(self._unfenced_async),
                    # rpc dict keys must be strings (closed wire types)
                    "clocks": {str(t): c
                               for t, c in sorted(
@@ -1521,15 +2159,48 @@ class ParameterServer:
             self._round += 1
             self._maybe_checkpoint()
 
+    def _stale_shard_locked(self, names):
+        """True when a frame names a grad shard this server no longer
+        (or does not yet) own — the sender's dispatch predates a
+        committed migration.  Replied like a stale plan: dropped, the
+        sender re-plans and re-ships to the current owner."""
+        if self.plan_spec is None:
+            return False
+        if any(n not in self.grad_to_shard for n in names):
+            self.counters["stale_plan_drops"] += 1
+            return True
+        return False
+
     def _h_send(self, name, value, trainer_id=0):
         value = np.asarray(value)
         if not self.sync_mode:
-            with self._lock:
+            with self._cv:
                 self._touch(trainer_id)
+                self._freeze_wait_locked()
+                if self._stale_shard_locked([name]):
+                    return self._plan_reply_locked(
+                        {"ok": True, "stale_plan": True,
+                         "pepoch": self._plan_epoch})
                 self._apply_async_send_locked(name, value)
                 # legacy per-var path: journaled (a restart replays it)
                 # but UNFENCED — only the bucketed path carries aseq
-                # tokens, so exactly-once across SIGKILL needs buckets
+                # tokens, so exactly-once across SIGKILL needs buckets.
+                # Surface the reduced guarantee at RUNTIME, loudly, the
+                # first time it actually runs journaled (it used to be
+                # documented only)
+                if self._journal_enabled() and not self._unfenced_async:
+                    self._unfenced_async = True
+                    import sys
+
+                    sys.stderr.write(
+                        "PSERVER WARNING: legacy per-variable async "
+                        "path (comm_bucket_bytes=0) is running "
+                        "JOURNALED BUT UNFENCED — applied updates "
+                        "survive SIGKILL, but an RPC retry straddling "
+                        "a restart can double-apply (no aseq dedup).  "
+                        "Use the bucketed wire "
+                        "(FLAGS_comm_bucket_bytes>0) for exactly-once "
+                        "(docs/FAULT_TOLERANCE.md)\n")
                 self._journal_append_locked(
                     {"k": "v", "n": name, "v": value,
                      "tid": int(trainer_id)})
@@ -1578,9 +2249,18 @@ class ParameterServer:
             # mode is exact, its ordering comes from the round barrier.
             with self._cv:
                 self._touch(trainer_id)
+                self._freeze_wait_locked()
                 tid = int(trainer_id)
                 if tid in self._evicted:
                     return {"ok": False, "evicted": True}
+                if self._stale_shard_locked(blocks):
+                    # migrated-away shard under a pre-flip dispatch: the
+                    # async sender must re-plan and re-ship to the new
+                    # owner (dropped here, never applied — and never
+                    # journaled, so replay can't resurrect it either)
+                    return self._plan_reply_locked(
+                        {"ok": True, "stale_plan": True,
+                         "pepoch": self._plan_epoch})
                 if aseq is not None and self._dense_fence_is_dup(tid, aseq):
                     # at-least-once re-delivery (RPC retry straddling a
                     # restart, or an incarnation-bump re-ship) of a bucket
@@ -1615,12 +2295,15 @@ class ParameterServer:
             return {"ok": True}
         with self._cv:
             self._touch(trainer_id)
+            self._freeze_wait_locked()
             tid = int(trainer_id)
             if tid in self._evicted:
                 return {"ok": False, "evicted": True}
-            if self._stale_plan_locked(pepoch):
+            if self._stale_plan_locked(pepoch) \
+                    or self._stale_shard_locked(blocks):
                 # plan-epoch fence (elastic autoscaling): the sender's
-                # world is out of date — its grads carry the OLD scale.
+                # world is out of date — its grads carry the OLD scale,
+                # or name shards a committed migration moved away.
                 # Dropped, never folded; the sender re-plans off the
                 # reply and re-ships the round at the current epoch.
                 return {"ok": True, "stale_plan": True,
@@ -1745,7 +2428,22 @@ class ParameterServer:
         REQUESTER's declaration, stamped into its bucket plan by the
         transpiler) compresses float blocks in the reply —
         'bfloat16' halves every param frame; the client decodes back
-        to the original dtype (rpc.Bf16Wire)."""
+        to the original dtype (rpc.Bf16Wire).
+
+        A fetch naming a MIGRATED-AWAY block (the sender's layout
+        predates a committed handoff) answers stale_plan — the client
+        re-plans and re-pulls from the new owner — instead of a
+        KeyError crash.  Checked BEFORE the params wait: a stale fetch
+        must return now, not park on a round that will never serve
+        it."""
+        if self.plan_spec is not None:
+            gone = [n for n in names if n in self._dropped_vars]
+            if gone:
+                with self._cv:
+                    self.counters["stale_plan_drops"] += 1
+                    return self._plan_reply_locked(
+                        {"stale_plan": True,
+                         "pepoch": self._plan_epoch})
         if self.sync_mode:
             with self._cv:
                 self._touch(trainer_id)
@@ -1869,7 +2567,16 @@ class ParameterServer:
         `clock` (async fenced mode) is the requesting trainer's logical
         clock: a lookup from a trainer past the staleness bound parks
         here — the read side of the bound, so a fast trainer cannot even
-        OBSERVE rows more than `bound` steps ahead of the laggard."""
+        OBSERVE rows more than `bound` steps ahead of the laggard.
+
+        A migrated-away shard answers a stale_plan DICT instead of rows
+        (never a KeyError crash): the client re-plans and re-reads from
+        the shard's new owner."""
+        if self.plan_spec is not None and table not in self.sparse_tables:
+            with self._cv:
+                self.counters["stale_plan_drops"] += 1
+                return self._plan_reply_locked(
+                    {"stale_plan": True, "pepoch": self._plan_epoch})
         tbl = self.sparse_tables[table]["tbl"]
         ids = np.asarray(ids).reshape(-1)
         ids = np.clip(ids, 0, tbl.shape[0] - 1)
@@ -2009,9 +2716,18 @@ class ParameterServer:
         rows = np.asarray(rows)
         with self._cv:
             self._touch(trainer_id)
+            self._freeze_wait_locked()
             tid = int(trainer_id)
             if tid in self._evicted:
                 return {"ok": False, "evicted": True}
+            if self.plan_spec is not None \
+                    and table not in self.sparse_tables:
+                # migrated-away sparse shard: the sender's routing
+                # predates the flip — re-plan and re-ship to the owner
+                self.counters["stale_plan_drops"] += 1
+                return self._plan_reply_locked(
+                    {"ok": True, "stale_plan": True,
+                     "pepoch": self._plan_epoch})
             if self.sync_mode and self._stale_plan_locked(pepoch):
                 # plan-epoch fence: rows scaled for a stale world must
                 # not queue into a current-epoch round (the sender
@@ -2076,6 +2792,7 @@ class ParameterServer:
         bounded-staleness park applies exactly once for the frame."""
         with self._cv:
             self._touch(trainer_id)
+            self._freeze_wait_locked()
             tid = int(trainer_id)
             if tid in self._evicted:
                 return {"ok": False, "evicted": True}
@@ -2234,7 +2951,25 @@ def run_pserver(program, scope, executor=None):
             "PADDLE_PSERVER_EPS", ""
         ).split(",")].index(a["endpoint"])
     except ValueError:
-        server_idx = 0
+        if a.get("elastic"):
+            # elastic-grown server OUTSIDE the base endpoint list: its
+            # checkpoint/journal files must not collide with base
+            # server 0's — key them by port (unique per live server)
+            server_idx = int(a["endpoint"].rsplit(":", 1)[1])
+        else:
+            server_idx = 0
+
+    # live shard migration config: the declarative plan spec (when the
+    # transpiler stamped one) + this server's endpoint + the pserver
+    # world — PADDLE_PSERVER_EPS is the BASE world; a snapshot restore
+    # or a migrate_commit moves it forward
+    plan_spec = a.get("plan_spec")
+    ps_world = [e.strip() for e in _os.environ.get(
+        "PADDLE_PSERVER_EPS", "").split(",") if e.strip()]
+    if not ps_world and plan_spec:
+        ps_world = list(plan_spec.get("endpoints") or [])
+    sparse_shard_idx = {spec[0]: int(spec[2])
+                       for spec in a.get("sparse_tables", [])}
 
     service = ParameterServer(
         shard_programs,
@@ -2248,7 +2983,26 @@ def run_pserver(program, scope, executor=None):
         checkpoint_dir=ckpt_dir,
         checkpoint_every=ckpt_every,
         server_idx=server_idx,
+        plan_spec=plan_spec,
+        endpoint=a["endpoint"],
+        ps_world=ps_world or None,
+        sparse_shard_idx=sparse_shard_idx,
     )
+    if (service._journal_enabled() and plan_spec
+            and int((plan_spec.get("flags") or {})
+                    .get("comm_bucket_bytes", 0)) <= 0):
+        import sys as _sys
+
+        # satellite: surface the reduced guarantee at STARTUP, not just
+        # in the docs — the legacy per-var wire journals but cannot
+        # fence, so exactly-once across SIGKILL does not hold here
+        service._unfenced_async = True
+        _sys.stderr.write(
+            "PSERVER WARNING: async journal armed on the legacy "
+            "per-variable wire (comm_bucket_bytes=0): applied updates "
+            "are crash-durable but UNFENCED — an RPC retry straddling "
+            "a restart can double-apply.  Set FLAGS_comm_bucket_bytes>0 "
+            "for exactly-once delivery (docs/FAULT_TOLERANCE.md)\n")
     restored = service.load_checkpoint()
     if restored is not None:
         print("PSERVER RESTORED round=%d incarnation=%d"

@@ -704,8 +704,14 @@ class Executor:
 
         feed_sig = tuple(sorted(
             (n, tuple(a.shape), str(a.dtype)) for n, a in feed_np.items()))
+        # (axis, nranks) keys the entry: an ELASTIC collective resize
+        # (program._collective["nranks"] rewritten mid-job) must re-trace
+        # over the new mesh, not reuse an executable jitted for the old
+        # one — _ensure_token_regime below drains the ordered-io tokens
+        # across the topology switch, so the resize cannot trip the PjRt
+        # layout abort (docs/FAULT_TOLERANCE.md "Elastic autoscaling")
         key_id = (id(program), program._version, feed_sig,
-                  tuple(fetch_names), id(scope))
+                  tuple(fetch_names), id(scope), axis, nranks)
         entry = cache.get(key_id)
         if entry is None:
             from .core.trace import build_traced_function

@@ -355,12 +355,49 @@ def _plan_rt(attrs):
     return st
 
 
+def _plan_eps_now(st, fallback):
+    """The endpoint set the CURRENT plan dispatches over: the derived
+    plan's (live pserver migration moves it), else the transpile-time
+    list."""
+    if st is not None and st.get("derived") is not None:
+        return [str(e) for e in st["derived"]["endpoints"]]
+    return list(fallback)
+
+
+def _sparse_route(st, s, fallback):
+    """The endpoint owning sparse shard s under the CURRENT plan: rows
+    hash to their stable BASE shard (g % n_base) forever; live pserver
+    migration only moves which endpoint serves the shard."""
+    if st is not None and st.get("derived") is not None:
+        se = st["derived"].get("sparse_eps") or []
+        if s < len(se):
+            return str(se[s])
+    return fallback[s]
+
+
+def _move_async_sparse_state(old_ep, new_ep, table):
+    """Live pserver migration moved a sparse shard: carry the client's
+    per-(endpoint, table) async fence bookkeeping — the minted seq
+    counter and the un-acked resend queue — to the shard's new owner,
+    whose server-side (trainer, table) fence arrived with the migrated
+    state, so seq continuity (and exactly-once) holds across the
+    move."""
+    old_st, new_st = _async_st(old_ep), _async_st(new_ep)
+    if table in old_st["sseq"]:
+        new_st["sseq"][table] = max(new_st["sseq"].get(table, 0),
+                                    old_st["sseq"].pop(table))
+    uq = old_st["unacked"].pop(table, None)
+    if uq:
+        new_st["unacked"].setdefault(table, {}).update(uq)
+
+
 def _maybe_replan(st, eps, trainer_id):
     """Re-derive the plan if any endpoint's observed plan epoch moved
-    past ours: ONE `plan` handshake fetches the new world, derive_plan
-    rebuilds the bucket layout from the spec, and the scale correction
-    becomes N0/N_live.  Runs at the top of every send host callback —
-    a dict compare when nothing changed."""
+    past ours: ONE `plan` handshake fetches the new world — trainer
+    count AND pserver endpoint set (live shard migration moves the
+    latter) — derive_plan rebuilds the bucket layout from the spec, and
+    the scale correction becomes N0/N_live.  Runs at the top of every
+    send host callback — a dict compare when nothing changed."""
     if st is None:
         return
     from ..distributed import rpc as _rpc
@@ -381,15 +418,27 @@ def _maybe_replan(st, eps, trainer_id):
     r = RPCClient.get(target).call("plan", trainer_id=int(trainer_id))
     epoch = int(r.get("epoch", newest))
     world = max(1, int(r.get("world", st["world"])))
-    st["derived"] = derive_plan(st["spec"], world={"trainers": world})
+    ps_eps = [str(e) for e in (r.get("endpoints") or [])]
+    prev_eps = _plan_eps_now(st, st["spec"]["endpoints"])
+    st["derived"] = derive_plan(
+        st["spec"],
+        world={"trainers": world, "endpoints": ps_eps or None})
     st["epoch"] = max(newest, epoch)
     st["world"] = world
+    # a changed PSERVER set invalidates the recorded per-endpoint round
+    # layout: the stale-plan recovery must REBUILD the round from its
+    # recorded raw blocks under the new dispatch, not re-ship in place
+    st["relayout"] = bool(
+        ps_eps and set(ps_eps) != set(prev_eps)) or st.get("relayout",
+                                                           False)
     st["corr"] = float(st["base"]) / float(world)
     st["replans"] += 1
     _rpc.note_async(replans=1,
                     replan_ms=round((time.perf_counter() - t0) * 1e3, 3))
-    print("TRAINER REPLAN epoch=%d world=%d corr=%.6g"
-          % (st["epoch"], world, st["corr"]), flush=True)
+    print("TRAINER REPLAN epoch=%d world=%d corr=%.6g eps=%d"
+          % (st["epoch"], world, st["corr"],
+             len(_plan_eps_now(st, st["spec"]["endpoints"]))),
+          flush=True)
 
 
 def _note_plan(ep, result):
@@ -423,6 +472,24 @@ def _drain_plan_checked(pipe, ep, trainer_id, stale_plan=None):
     return results
 
 
+def _wrap_rows_wire(rows, wire_dtype):
+    """Sparse row values onto the planned wire (the send_sparse wrap,
+    shared with the replay's re-compression)."""
+    rows = np.asarray(rows)
+    if wire_dtype != "bfloat16" or rows.dtype.kind != "f" \
+            or not rows.size:
+        return rows
+    from ..distributed import rpc as _rpc
+
+    return _rpc.Bf16Wire(rows)
+
+
+def _plan_wire(st):
+    flags = (st["spec"].get("flags") or {}) if st else {}
+    return (str(flags.get("comm_wire_dtype") or "float32"),
+            bool(flags.get("comm_grad_int8")))
+
+
 def _replay_round_plan(pipe, trainer_id, eps, st, stale_plan=None):
     """Stale-plan recovery: re-stamp the recorded round stream with the
     freshly re-derived epoch, rescale it from the recorded corr to the
@@ -430,28 +497,162 @@ def _replay_round_plan(pipe, trainer_id, eps, st, stale_plan=None):
     replay uses (_replay_round_sends: sparse first, dense submits,
     inspected drains) — one re-ship path to keep correct, and a SECOND
     epoch mint landing mid-recovery surfaces in the caller's
-    `stale_plan` set instead of being silently swallowed.  Raw
-    (uncompressed) blocks rescale exactly; wire-compressed blocks
-    re-ship as recorded — one transition round at the old scale, the
-    documented approximation (membership changed, so no bit-exactness
-    contract exists here)."""
+    `stale_plan` set instead of being silently swallowed.
+
+    EXACT transition round (closes the PR 10 documented gap): wire-
+    compressed blocks re-compress from their recorded PRE-compression
+    raw values after the rescale — compress(raw * ratio) on the wire,
+    never rescaled-compressed bytes, under bf16 and int8 alike (the
+    int8 error-feedback residual is re-derived from the replacing
+    quantization).  When the PSERVER SET changed (live shard
+    migration), the recorded per-endpoint layout matches no current
+    dispatch: the round REBUILDS from the recorded raw blocks under the
+    derived plan instead (_rebuild_round_plan)."""
+    if st.get("relayout") and st.get("derived") is not None:
+        _rebuild_round_plan(pipe, trainer_id, st, stale_plan)
+        return
+    wire_dtype, grad_int8 = _plan_wire(st)
     for ep in eps:
         fst = _fence(ep)
         rec_corr = float(fst.get("corr", 1.0))
         ratio = st["corr"] / rec_corr if rec_corr else 1.0
-        for kw in fst["sparse"].values():
+        for table, kw in fst["sparse"].items():
             kw["pepoch"] = st["epoch"]
-            rows = kw.get("rows")
-            if isinstance(rows, np.ndarray):
-                kw["rows"] = _scale_corr(rows, ratio)
+            raw = (fst.get("sparse_raw") or {}).get(table)
+            if raw is not None:
+                raw = _scale_corr(np.asarray(raw), ratio)
+                fst.setdefault("sparse_raw", {})[table] = raw
+                kw["rows"] = _wrap_rows_wire(raw, wire_dtype)
+            elif isinstance(kw.get("rows"), np.ndarray):
+                kw["rows"] = _scale_corr(kw["rows"], ratio)
         for kw in fst["sends"]:
             kw["pepoch"] = st["epoch"]
-            kw["blocks"] = {
-                bn: (_scale_corr(v, ratio) if isinstance(v, np.ndarray)
-                     else v)
-                for bn, v in kw["blocks"].items()}
+            newb = {}
+            for bn, v in kw["blocks"].items():
+                raw = (fst.get("raw") or {}).get(bn)
+                if raw is not None:
+                    raw = _scale_corr(np.asarray(raw), ratio)
+                    fst.setdefault("raw", {})[bn] = raw
+                    newb[bn] = _recompress_block(ep, bn, raw,
+                                                 wire_dtype, grad_int8)
+                elif isinstance(v, np.ndarray):
+                    newb[bn] = _scale_corr(v, ratio)
+                else:
+                    newb[bn] = v  # pre-raw-era record: ship as recorded
+            kw["blocks"] = newb
         fst["corr"] = st["corr"]
     _replay_round_sends(pipe, trainer_id, eps, stale_plan)
+
+
+def _rebuild_round_plan(pipe, trainer_id, st, stale_plan=None):
+    """The pserver SET changed mid-round (live shard migration): the
+    recorded per-endpoint stream no longer matches any server's
+    dispatch.  Rebuild the round from the recorded raw blocks under the
+    freshly derived plan — re-bucketed per the NEW block->endpoint map,
+    rescaled exactly, re-compressed fresh — and ship it to the new
+    owners.  Per-trainer fold fences make this exactly-once: an owner
+    that already folded this step (its kept blocks) drops the re-ship
+    as dup_round, while shards that moved carry their pre-capture
+    applies inside the migrated state."""
+    d = st["derived"]
+    wire_dtype, grad_int8 = _plan_wire(st)
+    compressing = grad_int8 or wire_dtype != "float32"
+    # gather the recorded round across EVERY endpoint's fence record —
+    # CURRENT round only (max step token): an endpoint dropped from the
+    # dispatch by an earlier migration may still hold a stale record,
+    # and mixing rounds would re-ship old grads as new
+    rstep = max([int(f.get("step", 0)) for f in _fences.values()
+                 if f.get("sends") or f.get("sparse")] or [0])
+    blocks_raw, sparse_recs, rec_corr = {}, {}, None
+    for ep, fst in sorted(_fences.items()):
+        if not (fst.get("sends") or fst.get("sparse")) \
+                or int(fst.get("step", 0)) != rstep:
+            continue
+        for kw in fst.get("sends") or []:
+            for bn, v in kw["blocks"].items():
+                raw = (fst.get("raw") or {}).get(bn)
+                val = raw if raw is not None else v
+                if not isinstance(val, np.ndarray):
+                    raise RuntimeError(
+                        "cannot rebuild the transition round: block %r "
+                        "was recorded wire-compressed without its raw "
+                        "value (a pre-raw-era record) and the pserver "
+                        "set changed" % bn)
+                blocks_raw[bn] = np.asarray(val)
+        for table, kw in (fst.get("sparse") or {}).items():
+            sparse_recs[table] = (
+                dict(kw),
+                (fst.get("sparse_raw") or {}).get(table),
+                (fst.get("sparse_idx") or {}).get(table),
+                ep)
+        if rec_corr is None:
+            rec_corr = float(fst.get("corr", 1.0)) or 1.0
+    if not blocks_raw and not sparse_recs:
+        st["relayout"] = False
+        return
+    ratio = st["corr"] / (rec_corr or 1.0)
+    new_eps = [str(e) for e in d["endpoints"]]
+    totals = {ep: int(n) for ep, n in (d.get("sync_totals")
+                                       or {}).items()}
+    # reset every fence record, then rebuild per the new dispatch with a
+    # UNIFORM step token (per-endpoint step counters advance in
+    # lockstep; a fresh endpoint adopts the round's token)
+    for fst in _fences.values():
+        fst["sends"] = []
+        fst["sparse"] = {}
+        fst["sparse_raw"] = {}
+        fst["raw"] = {}
+        fst.pop("sparse_step", None)
+    declared = {}  # ep -> [table, ...]
+    for table, (kw, raw, sidx, rec_ep) in sorted(sparse_recs.items()):
+        ep = (str(d["sparse_eps"][sidx])
+              if sidx is not None and sidx < len(d.get("sparse_eps", []))
+              else rec_ep)
+        fst = _fence(ep)
+        fst["step"] = rstep
+        kw = dict(kw, pepoch=st["epoch"], step=rstep)
+        if raw is not None:
+            raw = _scale_corr(np.asarray(raw), ratio)
+            fst.setdefault("sparse_raw", {})[table] = raw
+            kw["rows"] = _wrap_rows_wire(raw, wire_dtype)
+        elif isinstance(kw.get("rows"), np.ndarray):
+            kw["rows"] = _scale_corr(kw["rows"], ratio)
+        fst["sparse_step"] = rstep
+        fst["sparse"][table] = kw
+        fst.setdefault("sparse_idx", {})[table] = sidx
+        declared.setdefault(ep, []).append(table)
+    per_ep = {}
+    for ep, entries in d["send_buckets"]:
+        blocks = {}
+        fst = _fence(ep)
+        for xi, b, e, bn in entries:
+            raw = blocks_raw.get(bn)
+            if raw is None:
+                continue  # an empty-bucket barrier entry
+            raw = _scale_corr(raw, ratio)
+            fst.setdefault("raw", {})[bn] = raw
+            blocks[bn] = (_recompress_block(ep, bn, raw, wire_dtype,
+                                            grad_int8)
+                          if compressing else raw)
+        per_ep.setdefault(str(ep), []).append(blocks)
+    for ep, blist in sorted(per_ep.items()):
+        fst = _fence(ep)
+        fst["step"] = rstep
+        fst["corr"] = st["corr"]
+        fst["sends"] = [
+            dict(blocks=blocks, trainer_id=trainer_id,
+                 seq_total=totals.get(ep), step=rstep, seq_idx=i,
+                 sparse_tables=sorted(declared.get(ep, [])),
+                 pepoch=st["epoch"])
+            for i, blocks in enumerate(blist)]
+    st["relayout"] = False
+    # a grown pserver is contacted here for the first time: register +
+    # heartbeat + complete coverage must start before its first frame
+    from .. import distributed
+
+    for ep in new_eps:
+        distributed._note_endpoint(ep, int(trainer_id))
+    _replay_round_sends(pipe, trainer_id, new_eps, stale_plan)
 
 
 # ---- async clock-only frame coalescing ----------------------------------
@@ -542,10 +743,16 @@ def _quantize_i8(g):
     return q, scale, (q.astype(g.dtype) * g.dtype.type(scale))
 
 
-def _compress_block(ep, bname, seg, wire_dtype, grad_int8):
+def _compress_block(ep, bname, seg, wire_dtype, grad_int8, raw_out=None):
     """Wrap one dense grad block for the wire per the plan's compression
     metadata; returns the value to ship and notes the saved bytes in the
-    comm counters (rpc.get_comm_stats comm_bytes_saved)."""
+    comm counters (rpc.get_comm_stats comm_bytes_saved).
+
+    `raw_out` (a dict) receives the PRE-compression f32 block — for int8
+    the residual-accumulated value that was actually quantized — so a
+    stale-plan recovery can rescale the transition round EXACTLY and
+    re-compress, instead of re-shipping wire-compressed bytes at the old
+    scale (the PR 10 documented gap, closed here)."""
     from ..distributed import rpc as _rpc
 
     if seg.dtype.kind != "f":
@@ -556,13 +763,37 @@ def _compress_block(ep, bname, seg, wire_dtype, grad_int8):
         g = seg + res if res is not None else seg
         q, scale, deq = _quantize_i8(np.ascontiguousarray(g))
         _ef_residuals[key] = g - deq
+        if raw_out is not None:
+            raw_out[bname] = np.array(g)
         _rpc.note_bytes_saved(seg.nbytes - q.nbytes)
         return _rpc.Int8Wire(q, scale, seg.dtype.str)
     if wire_dtype == "bfloat16":
         # bf16 wire is 2 bytes/element whatever the source float width
+        if raw_out is not None:
+            raw_out[bname] = np.array(seg)
         _rpc.note_bytes_saved(seg.nbytes - 2 * seg.size)
         return _rpc.Bf16Wire(seg)
     return seg
+
+
+def _recompress_block(ep, bname, raw, wire_dtype, grad_int8):
+    """Re-compress one RESCALED raw block for a stale-plan replay: the
+    shipped value is exactly compress(raw) at the current scale, and the
+    int8 error-feedback residual is re-derived from this (replacing)
+    quantization so the next round's correction stays consistent.
+    Idempotent at ratio 1: byte-identical to the original wire value."""
+    from ..distributed import rpc as _rpc
+
+    raw = np.asarray(raw)
+    if raw.dtype.kind != "f":
+        return raw
+    if grad_int8:
+        q, scale, deq = _quantize_i8(np.ascontiguousarray(raw))
+        _ef_residuals[(ep, bname)] = raw - deq
+        return _rpc.Int8Wire(q, scale, raw.dtype.str)
+    if wire_dtype == "bfloat16":
+        return _rpc.Bf16Wire(raw)
+    return raw
 
 
 def _stale_endpoints(eps):
@@ -774,7 +1005,8 @@ def _send_bucket(ctx, ins, attrs):
 
         use_plan, use_totals, corr, pepoch = plan, totals, 1.0, None
         if plan_rt is not None:
-            _maybe_replan(plan_rt, plan_eps, trainer_id)
+            _maybe_replan(plan_rt, _plan_eps_now(plan_rt, plan_eps),
+                          trainer_id)
             corr = plan_rt["corr"]
             pepoch = plan_rt["epoch"]
             if plan_rt["derived"] is not None:
@@ -791,15 +1023,25 @@ def _send_bucket(ctx, ins, attrs):
         flats = [_scale_corr(np.asarray(g).reshape(-1), corr)
                  for g in grads]
         per_ep = {}
+        raw_by_ep = {}  # pre-compression blocks (exact plan-replay)
         with RecordEvent("wire_compress", cat="compress") \
                 if compressing else _null_ctx():
             for ep, entries in use_plan:
+                raw_out = raw_by_ep.setdefault(ep, {})
                 blocks = {
                     bn: _compress_block(ep, bn, flats[xi][b:e],
-                                        wire_dtype, grad_int8)
+                                        wire_dtype, grad_int8,
+                                        raw_out=raw_out)
                     if compressing else flats[xi][b:e]
                     for xi, b, e, bn in entries}
                 per_ep.setdefault(ep, []).append(blocks)
+        # uniform step token for the round: per-endpoint counters advance
+        # in lockstep, and an endpoint JOINING mid-job (live pserver
+        # migration) must adopt the round's token — starting it at 1
+        # would collide with the fold fences that migrated with its
+        # adopted shards (its first real rounds would drop as replays)
+        new_step = 1 + max((_fence(ep)["step"] for ep in per_ep),
+                           default=0)
         for ep, blist in per_ep.items():
             total = use_totals.get(ep)
             if not total:
@@ -827,10 +1069,14 @@ def _send_bucket(ctx, ins, attrs):
                 from ..distributed import rpc as _rpc
 
                 st["inc"] = _rpc.incarnation_of(ep)
-            st["step"] += 1
+            st["step"] = new_step
             # the corr the recorded blocks were scaled with: a stale-
-            # plan replay rescales them to the then-current corr
+            # plan replay rescales them to the then-current corr — and
+            # the PRE-compression raw blocks ride alongside, so that
+            # rescale is EXACT under a compressed wire (re-compress
+            # after rescale, never rescaled-compressed bytes)
             st["corr"] = corr
+            st["raw"] = raw_by_ep.get(ep, {})
             # declare this step's sparse manifest on every dense bucket:
             # the server must not fold (and run the round) until each
             # declared chunk is pending.  Without this, a crash after
@@ -851,6 +1097,11 @@ def _send_bucket(ctx, ins, attrs):
             for kw in st["sends"]:
                 pipe(ep).submit("send_bucket", timeout_s=_BLOCKING_TIMEOUT,
                                 **kw)
+        if plan_rt is not None:
+            # this round's records were made under the CURRENT derived
+            # layout: a later fence replays them in place (a further
+            # endpoint-set change re-arms the flag via _maybe_replan)
+            plan_rt["relayout"] = False
         return np.int32(0)
 
     tok = io_callback(
@@ -887,7 +1138,24 @@ def _recv_bucket(ctx, ins, attrs):
     ]
 
     def host_recv():
-        eps_here = sorted({ep for ep, _ in buckets})
+        def layout():
+            """The CURRENT fetch layout: the derived plan's when one
+            exists (live pserver migration moves buckets between
+            endpoints mid-job), else the transpile-time attrs.  Block
+            names and param reassembly are layout-invariant (stable
+            shards) — only the grouping moves."""
+            if plan_rt is not None and plan_rt.get("derived") is not None:
+                d = plan_rt["derived"]
+                lb = [(str(ep), [str(n) for n in names])
+                      for ep, names in d["recv_buckets"]]
+                lt = ({str(ep): int(n)
+                       for ep, n in (d.get("fetch_totals") or {}).items()}
+                      if totals else {})
+                return lb, lt
+            return buckets, totals
+
+        cur_buckets, cur_totals = layout()
+        eps_here = sorted({ep for ep, _ in cur_buckets})
         # endpoints whose servers FENCED this round's frames as stale-
         # plan (our world was out of date): re-plan, then re-ship — the
         # elastic sibling of the incarnation replay below
@@ -895,15 +1163,40 @@ def _recv_bucket(ctx, ins, attrs):
         for ep in eps_here:
             _drain_plan_checked(pipe, ep, trainer_id, stale_plan)
         fenced = bool(totals)
-        per_ep_names = {}
-        for ep, names in buckets:
-            per_ep_names.setdefault(ep, []).append(names)
+        minted = set()
+        round_fstep = [None]
+
+        def mint(eps_list):
+            # ONE fetch step token per logical step, shared across the
+            # endpoints (their counters advance in lockstep); replays
+            # inside this invocation reuse it (the server dedups by set
+            # / fold fence).  A replan can add NEW endpoints
+            # mid-recovery — they adopt the round's token on first
+            # appearance, aligned with the fetch fences that migrated
+            # with their adopted shards.
+            fresh = [ep for ep in eps_list if ep not in minted]
+            if not fresh:
+                return
+            if round_fstep[0] is None:
+                round_fstep[0] = 1 + max(
+                    (_fence(ep)["fstep"] for ep in fresh), default=0)
+            for ep in fresh:
+                minted.add(ep)
+                _fence(ep)["fstep"] = round_fstep[0]
+
         if fenced:
-            # one fetch step token per logical step; replays inside this
-            # invocation reuse it (the server dedups by set / fold fence)
-            for ep in eps_here:
-                st = _fence(ep)
-                st["fstep"] += 1
+            mint(eps_here)
+        elif stale_plan and plan_rt is not None:
+            # async: a drained send reply was fenced (stale shard after
+            # a migration flip) — re-plan NOW so the next step routes to
+            # the new owners.  The dropped bucket itself is not
+            # re-shipped: the async path applies per-arrival with no
+            # round to rebuild (one transition step's contribution to
+            # the moved shards is skipped, loudly, via the server's
+            # stale_plan_drops counter — the freeze keeps this window to
+            # at most one in-flight step).
+            _maybe_replan(plan_rt, eps_here, trainer_id)
+            stale_plan.clear()
         block_vals = {}
         to_fetch = list(eps_here)
         for _attempt in range(_MAX_ROUND_REPLAYS):
@@ -911,10 +1204,11 @@ def _recv_bucket(ctx, ins, attrs):
                 if not (fenced and plan_rt is not None and stale_plan):
                     break
                 # plan-epoch fence tripped: refresh the plan from the
-                # server's current world, restamp + rescale the recorded
-                # round stream and re-ship it BEFORE any fetch — the
-                # dropped frames mean the round never assembled there,
-                # so fetching first would park on params that are never
+                # server's current world, restamp + rescale (exactly —
+                # re-compressed from recorded raws) the recorded round
+                # stream and re-ship it BEFORE any fetch — the dropped
+                # frames mean the round never assembled there, so
+                # fetching first would park on params that are never
                 # coming.  The replay's own drains feed `stale_plan`
                 # back, so a SECOND mint landing mid-recovery loops
                 # (bounded) instead of being swallowed.
@@ -923,6 +1217,9 @@ def _recv_bucket(ctx, ins, attrs):
                 stale_plan.clear()
                 _replay_round_plan(pipe, trainer_id, targets, plan_rt,
                                    stale_plan)
+                cur_buckets, cur_totals = layout()
+                eps_here = sorted({ep for ep, _ in cur_buckets})
+                to_fetch = list(eps_here)
             if fenced and plan_rt is not None and stale_plan:
                 # still fenced after the last allowed replay (a for/else
                 # would also fire when the FINAL replay just succeeded)
@@ -939,11 +1236,16 @@ def _recv_bucket(ctx, ins, attrs):
                 if stale:
                     _replay_round_sends(pipe, trainer_id, stale,
                                         stale_plan)
+            per_ep_names = {}
+            for ep, names in cur_buckets:
+                per_ep_names.setdefault(ep, []).append(names)
+            if fenced:
+                mint(to_fetch)
             futs = []
             for ep in to_fetch:
                 for i, names in enumerate(per_ep_names.get(ep, [])):
                     kw = dict(names=names, trainer_id=trainer_id,
-                              fetch_total=totals.get(ep),
+                              fetch_total=cur_totals.get(ep),
                               step=_fence(ep)["fstep"] if fenced else None,
                               seq_idx=i)
                     if wire_dtype != "float32":
@@ -955,6 +1257,14 @@ def _recv_bucket(ctx, ins, attrs):
                 if not isinstance(got, dict):
                     raise RuntimeError(
                         "get_bucket from %s returned %r" % (ep, type(got)))
+                if got.get("stale_plan") is True and "pepoch" in got:
+                    # the fetch named a migrated-away block: this
+                    # endpoint's layout moved under us — re-plan and
+                    # re-pull under the new dispatch (the replay loop
+                    # above re-ships the round first)
+                    _note_plan(ep, got)
+                    stale_plan.add(ep)
+                    continue
                 if wire_dtype == "bfloat16":
                     from ..distributed import rpc as _rpc
 
@@ -969,6 +1279,18 @@ def _recv_bucket(ctx, ins, attrs):
                 # clear resolved futures off the window
                 _drain_plan_checked(pipe, ep, trainer_id, stale_plan)
             if not fenced:
+                if stale_plan and plan_rt is not None:
+                    # async: a fetch named a migrated-away block (or a
+                    # send was fenced) — re-plan and re-pull the whole
+                    # layout; breaking here would leave the moved
+                    # blocks missing from block_vals and crash the
+                    # reassembly below
+                    _maybe_replan(plan_rt, eps_here, trainer_id)
+                    stale_plan.clear()
+                    cur_buckets, cur_totals = layout()
+                    eps_here = sorted({ep for ep, _ in cur_buckets})
+                    to_fetch = list(eps_here)
+                    continue
                 break
             # a restart DURING the fetch served params from a snapshot
             # that may predate this round: replay + re-pull — but ONLY
@@ -1036,9 +1358,15 @@ def _prefetch(ctx, ins, attrs):
         def cli_for(ep, _tid):
             return _cli(ep)
 
+    # live pserver migration: lookups consult the shared runtime plan so
+    # a moved shard is read from its NEW owner (a stale read answers a
+    # stale_plan dict — re-plan and retry once at the fresh route)
+    plan_rt = _plan_rt(attrs) if not collective else None
+
     def host_prefetch(tid, ids_v):
         """ONE routing core for both trainer-id sources: ids route to
-        server id%n, rows merge back in input order."""
+        their stable shard (id % n_base), whose endpoint the current
+        plan names; rows merge back in input order."""
         flat = np.asarray(ids_v).reshape(-1).astype(np.int64)
         out = np.zeros((flat.size, emb_dim), dtype=np.float32)
         cache = (_hot_cache_for(table_names, hot_opt)
@@ -1051,8 +1379,10 @@ def _prefetch(ctx, ins, attrs):
                 if not want[i]:
                     out[i] = hits[int(g)]
         clock = None
+        if plan_rt is not None:
+            _maybe_replan(plan_rt, _plan_eps_now(plan_rt, epmap), tid)
         for s in range(n):
-            ep = epmap[s]
+            ep = _sparse_route(plan_rt, s, epmap)
             if async_fence and not collective:
                 cli = cli_for(ep, tid)
                 _async_check_replay(cli, ep, tid)
@@ -1065,7 +1395,20 @@ def _prefetch(ctx, ins, attrs):
                       trainer_id=tid)
             if clock is not None:
                 kw["clock"] = clock
-            rows = np.asarray(cli_for(ep, tid).call("prefetch", **kw))
+            rows = cli_for(ep, tid).call("prefetch", **kw)
+            if isinstance(rows, dict):
+                # migrated-away shard: re-plan, retry at the new owner
+                _note_plan(ep, rows)
+                if rows.get("stale_plan") and plan_rt is not None:
+                    _maybe_replan(plan_rt,
+                                  _plan_eps_now(plan_rt, epmap), tid)
+                    ep = _sparse_route(plan_rt, s, epmap)
+                    rows = cli_for(ep, tid).call("prefetch", **kw)
+                if isinstance(rows, dict):
+                    raise RuntimeError(
+                        "prefetch of %s from %s failed: %r"
+                        % (table_names[s], ep, rows))
+            rows = np.asarray(rows)
             out[mask] = rows
             if cache is not None:
                 cache.insert(flat[mask], rows)
@@ -1159,7 +1502,7 @@ def _send_sparse(ctx, ins, attrs):
         records the chunk for incarnation-fenced replay."""
         corr, pepoch = 1.0, None
         if plan_rt is not None:
-            _maybe_replan(plan_rt, epmap, tid)
+            _maybe_replan(plan_rt, _plan_eps_now(plan_rt, epmap), tid)
             corr, pepoch = plan_rt["corr"], plan_rt["epoch"]
         flat = np.asarray(ids_v).reshape(-1).astype(np.int64)
         g = np.asarray(grad_v).reshape(flat.size, -1) * scale
@@ -1174,7 +1517,14 @@ def _send_sparse(ctx, ins, attrs):
                 cache.push(flat, g)
         for s in range(n):
             mask = (flat % n) == s
-            ep = epmap[s]
+            # live pserver migration: shard s ships to its CURRENT owner
+            ep = _sparse_route(plan_rt, s, epmap)
+            if plan_rt is not None:
+                routes = plan_rt.setdefault("sparse_routes", {})
+                prev_ep = routes.get(table_names[s])
+                if prev_ep is not None and prev_ep != ep:
+                    _move_async_sparse_state(prev_ep, ep, table_names[s])
+                routes[table_names[s]] = ep
             if async_fence and not collective:
                 from ..distributed import rpc as _rpc
 
@@ -1211,6 +1561,26 @@ def _send_sparse(ctx, ins, attrs):
                 r = cli.call("send_sparse", **kw)
                 _check_not_evicted(r, ep, tid)
                 _note_plan(ep, r)
+                if plan_rt is not None and isinstance(r, dict) \
+                        and r.get("stale_plan"):
+                    # migrated-away shard (async): re-plan, carry the
+                    # fence state to the new owner, re-ship there — the
+                    # chunk is still in the (moved) resend queue, so a
+                    # crash here re-delivers and the owner's migrated
+                    # (trainer, table) fence dedupes
+                    _maybe_replan(plan_rt,
+                                  _plan_eps_now(plan_rt, epmap), tid)
+                    new_ep = _sparse_route(plan_rt, s, epmap)
+                    if new_ep != ep:
+                        _move_async_sparse_state(ep, new_ep, table)
+                        plan_rt.setdefault("sparse_routes",
+                                           {})[table] = new_ep
+                        ep = new_ep
+                        st = _async_st(ep)
+                        cli = cli_for(ep, tid)
+                    r = cli.call("send_sparse", **kw)
+                    _check_not_evicted(r, ep, tid)
+                    _note_plan(ep, r)
                 _async_note_ack(st, table, r)
                 _rpc.note_async(async_sparse_sends=1)
                 continue
@@ -1227,7 +1597,17 @@ def _send_sparse(ctx, ins, attrs):
                 # legacy per-var path, where no send_bucket advances the
                 # step token and the reset-on-new-step never fires
                 st = _fence(ep)
-                step = st["step"] + 1
+                # the UPCOMING round's token, computed like send_bucket's
+                # uniform mint (1 + max across the plan's endpoints): a
+                # per-endpoint `st["step"] + 1` would stamp a chunk to a
+                # freshly-routed owner with step=1 against fold fences
+                # that migrated at round N — silently dropped as
+                # dup_round and missing from the round's declared
+                # manifest (one round's sparse grads lost)
+                step = 1 + max(
+                    (_fence(e)["step"]
+                     for e in _plan_eps_now(plan_rt, epmap)),
+                    default=st["step"])
                 kw["step"] = step
                 if pepoch is not None:
                     # the plan-epoch fence covers sparse chunks too: a
@@ -1237,7 +1617,16 @@ def _send_sparse(ctx, ins, attrs):
                 if st.get("sparse_step") != step:
                     st["sparse_step"] = step
                     st["sparse"] = {}
+                    st["sparse_raw"] = {}
+                    st["sparse_idx"] = {}
                 st["sparse"][table_names[s]] = kw
+                # the UNWRAPPED rows + the shard's stable index ride the
+                # record, so a stale-plan recovery rescales EXACTLY
+                # (re-wrap after rescale) and can re-route the chunk to
+                # a migrated shard's new owner
+                st.setdefault("sparse_raw", {})[table_names[s]] = \
+                    np.array(g[mask])
+                st.setdefault("sparse_idx", {})[table_names[s]] = s
             r = cli_for(ep, tid).call("send_sparse", **kw)
             _check_not_evicted(r, ep, tid)
             _note_plan(ep, r)
@@ -1253,11 +1642,44 @@ def _send_sparse(ctx, ins, attrs):
                 # buckets (same refreshed epoch) get fenced too, and
                 # recv_bucket's recovery re-ships the recorded chunk.
                 old_corr = plan_rt["corr"]
-                _maybe_replan(plan_rt, epmap, tid)
+                _maybe_replan(plan_rt, _plan_eps_now(plan_rt, epmap),
+                              tid)
                 kw["pepoch"] = plan_rt["epoch"]
-                if isinstance(kw.get("rows"), np.ndarray) and old_corr:
+                st = _fence(ep)
+                raw = (st.get("sparse_raw") or {}).get(table_names[s])
+                if raw is not None and old_corr:
+                    # EXACT rescale: re-wrap the recorded raw rows at
+                    # the fresh corr (never rescale compressed bytes)
+                    raw = _scale_corr(np.asarray(raw),
+                                      plan_rt["corr"] / old_corr)
+                    st["sparse_raw"][table_names[s]] = raw
+                    kw["rows"] = _wrap_rows_wire(raw, wire_dtype)
+                elif isinstance(kw.get("rows"), np.ndarray) and old_corr:
                     kw["rows"] = _scale_corr(
                         kw["rows"], plan_rt["corr"] / old_corr)
+                # the shard may have MOVED (live pserver migration):
+                # re-route the chunk — and its fence record — to the
+                # current owner
+                new_ep = _sparse_route(plan_rt, s, epmap)
+                if new_ep != ep:
+                    st["sparse"].pop(table_names[s], None)
+                    (st.get("sparse_raw") or {}).pop(table_names[s],
+                                                     None)
+                    (st.get("sparse_idx") or {}).pop(table_names[s],
+                                                     None)
+                    nst = _fence(new_ep)
+                    if nst.get("sparse_step") != kw["step"]:
+                        nst["sparse_step"] = kw["step"]
+                        nst["sparse"] = {}
+                        nst["sparse_raw"] = {}
+                        nst["sparse_idx"] = {}
+                    nst["sparse"][table_names[s]] = kw
+                    if raw is not None:
+                        nst.setdefault("sparse_raw",
+                                       {})[table_names[s]] = raw
+                    nst.setdefault("sparse_idx",
+                                   {})[table_names[s]] = s
+                    ep = new_ep
                 r = cli_for(ep, tid).call("send_sparse", **kw)
                 _check_not_evicted(r, ep, tid)
                 _note_plan(ep, r)

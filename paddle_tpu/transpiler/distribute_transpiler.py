@@ -151,10 +151,23 @@ def derive_plan(spec, world=None, split_method=None):
     `world` overrides {"trainers": ..., "endpoints": [...]} for a
     re-plan; `split_method` may pass the dispatcher class directly
     (otherwise it resolves by name from ps_dispatcher — the spec stays
-    declarative)."""
+    declarative).
+
+    Shard stability (live pserver migration, docs/FAULT_TOLERANCE.md
+    "Live shard migration"): block SLICING always uses the spec's BASE
+    endpoint count, so block boundaries and names are invariant under a
+    pserver-set change — only the block->endpoint DISPATCH moves.  A
+    shard is therefore a stable, nameable unit of state that migration
+    can hand whole from one server to another; re-slicing would instead
+    change what a "shard" is and make handoff a global re-scatter.  For
+    an unchanged world this is byte-identical to the old rule (live ==
+    base).  The same rule covers sparse tables: `sparse_eps[s]` maps the
+    BASE shard index s (row g lives in shard g % n_base forever) onto
+    the live endpoint set."""
     from . import ps_dispatcher
 
     world = world or {}
+    base_eps = [str(e) for e in spec["endpoints"]]
     endpoints = [str(e) for e in
                  (world.get("endpoints") or spec["endpoints"])]
     trainers = int(world.get("trainers") or spec["trainers"])
@@ -172,7 +185,8 @@ def derive_plan(spec, world=None, split_method=None):
         for d in shape:
             numel *= int(d)
         numels.append((p, numel))
-    slice_count = len(endpoints) if flags.get("slice_var_up", True) else 1
+    # slicing keys off the BASE world: stable shard identity (see above)
+    slice_count = len(base_eps) if flags.get("slice_var_up", True) else 1
     blocks = slice_variable(numels, slice_count,
                             int(flags.get("min_block_size", 8192)))
     dispatcher = split_method(endpoints)
@@ -190,6 +204,11 @@ def derive_plan(spec, world=None, split_method=None):
         "grad_scale": 1.0 / float(trainers),
         "blocks": blocks,
         "block_eps": block_eps,
+        # sparse shard s (stable: rows hash g % n_base) -> live endpoint.
+        # Identity for an unchanged world (s % n == s), deterministic
+        # round-robin of the stable shards over a changed one.
+        "sparse_eps": [endpoints[s % len(endpoints)]
+                       for s in range(len(base_eps))],
     }
     bucket_bytes = int(flags.get("comm_bucket_bytes", 0))
     if bucket_bytes <= 0:
@@ -763,6 +782,13 @@ class DistributeTranspiler:
                 if op.attrs.get("async_fence"):
                     op.attrs["clk_gid"] = self.plan_gid
                     op.attrs["clk_ops"] = n_sparse
+            elif op.type == "prefetch":
+                # live pserver migration: lookups re-route to a shard's
+                # NEW owner off the same shared plan state (a stale read
+                # gets a stale_plan reply, re-plans, and retries)
+                op.attrs["plan_gid"] = self.plan_gid
+                op.attrs["plan_spec"] = (self.plan_spec
+                                         if self._plan_elastic else None)
         self.origin_program._bump_version()
 
     # ------------------------------------------------------------------
@@ -1064,6 +1090,55 @@ class DistributeTranspiler:
                 "slice_plan": slice_plan,
                 "whole_vars": sorted(whole_vars),
                 "sparse_tables": sparse_specs,
+                # live pserver migration: the declarative plan spec lets
+                # the SERVER re-derive shard->endpoint dispatch for a
+                # changed pserver world and compute which of its shards
+                # must move (None when the plan is not re-derivable —
+                # migration then refuses, loudly, instead of guessing)
+                "plan_spec": (self.plan_spec if self._plan_elastic
+                              else None),
+            },
+        )
+        return prog
+
+    def get_elastic_pserver_program(self, endpoint):
+        """Pserver program for an endpoint OUTSIDE the transpile-time set
+        (elastic pserver grow, docs/FAULT_TOLERANCE.md "Live shard
+        migration"): the server boots EMPTY — no shard programs, no
+        slice plan, no sparse tables — and acquires state exclusively
+        through journaled shard handoff (`migrate_in`).  It carries the
+        plan spec so it can participate in world/commit handshakes, and
+        the trainer/sync config so its round protocol matches the
+        cluster it is joining."""
+        if self.config.mode == "collective":
+            raise ValueError(
+                "elastic pserver programs are pserver-mode only (the "
+                "collective hybrid pserver shards by a fixed table mod)")
+        if endpoint in self.pserver_endpoints:
+            raise ValueError(
+                "%s is in the transpile-time pserver set — use "
+                "get_pserver_program for base endpoints" % endpoint)
+        if not getattr(self, "_plan_elastic", False):
+            raise ValueError(
+                "this job's comm plan is not runtime-re-derivable "
+                "(custom dispatcher or legacy per-variable wire) — an "
+                "elastic pserver could never be assigned shards")
+        prog = Program()
+        b = prog.global_block()
+        b.append_op(
+            "listen_and_serv",
+            attrs={
+                "endpoint": endpoint,
+                "trainers": self.trainer_num,
+                "sync_mode": bool(self.sync_mode),
+                "optimize_programs": [],
+                "lr_program": None,
+                "grad_to_shard": {},
+                "slice_plan": [],
+                "whole_vars": [],
+                "sparse_tables": [],
+                "plan_spec": self.plan_spec,
+                "elastic": True,
             },
         )
         return prog

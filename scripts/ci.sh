@@ -91,6 +91,24 @@ terminal_evict or scaling_policy or budget_exhaustion"
 python -m pytest tests/test_dist_transpiler.py -q -m "" \
     -k "derive_plan or clock_only"
 
+echo "== migration-chaos pass (live pserver shard migration) =="
+# the third leg of the fault-tolerance story end to end under the SAME
+# pinned fault seed: in-process journaled handoff (bit-exact adoption,
+# epoch-mint-after-durability, restart-recovery commit, durable adopted
+# state), the exact transition-round re-compression (bf16 + int8), the
+# seeded bounded delay action + slow-network handoff, the load-aware
+# pserver scaling policy, the runtime unfenced-journal warning, and the
+# slow-marked kill legs (-m ""): pserver set 2->3->2 bit-identical to a
+# static run, SIGKILL-of-source/target mid-handoff bit-identical under
+# the journal, the double-migration flap, and the elastic collective
+# resize (2->4 virtual devices re-traced, parity vs a fresh 4-dev run)
+python -m pytest tests/test_fault_tolerance.py -q -m "" \
+    -k "migration or migrate or mints or transition or fault_delay or \
+delayed_handoff or pserver_load or unfenced or resize_2to4 or \
+launch_accepts"
+python -m pytest tests/test_dist_transpiler.py -q -m "" \
+    -k "stable_shards or elastic_pserver_program"
+
 echo "== pallas kernel pass (FLAGS_use_pallas=1, interpret mode) =="
 # the primitive-kernel layer end to end on the CPU mesh: every kernel's
 # interpret-mode numerics vs its dense reference (matmul-epilogue,

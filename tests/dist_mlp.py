@@ -31,12 +31,35 @@ os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 _COLLECTIVE = os.environ.get("DIST_MODE") == "collective"
 _TRAINER_EPS = [e for e in os.environ.get(
     "PADDLE_TRAINER_ENDPOINTS", "").split(",") if e.strip()]
+
+
+def _parse_resize(spec):
+    """DIST_RESIZE="step:nranks[,step:nranks]" — deterministic elastic
+    collective driver: at training step `step`, resize the virtual mesh
+    to `nranks` (re-trace + token drain happen inside the executor)."""
+    out = []
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if part:
+            a, _, b = part.partition(":")
+            out.append((int(a), int(b)))
+    return sorted(out)
+
+
+_RESIZE_PLAN = _parse_resize(os.environ.get("DIST_RESIZE"))
 if _COLLECTIVE and os.environ.get("PADDLE_TRAINING_ROLE") != "PSERVER":
     # device topology must be pinned BEFORE jax loads: multi-process runs
     # put ONE device in each trainer process (the mesh spans processes);
-    # a single process hosts the whole mesh as virtual CPU devices
+    # a single process hosts the whole mesh as virtual CPU devices.
+    # Elastic collective (--elastic / DIST_RESIZE) pins the MAX mesh the
+    # job can grow to — resizes then only re-trace, never re-boot jax.
     _n_dev = (1 if len(_TRAINER_EPS) > 1
               else int(os.environ.get("DIST_COLLECTIVE_DEVICES", "2")))
+    for _, _to in _RESIZE_PLAN:
+        _n_dev = max(_n_dev, _to)
+    _el = os.environ.get("DIST_COLLECTIVE_ELASTIC", "")
+    if _el:
+        _n_dev = max(_n_dev, int(_el.split(":")[1]))
     _flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
               if not f.startswith("--xla_force_host_platform_device_count")]
     _flags.append("--xla_force_host_platform_device_count=%d" % _n_dev)
@@ -176,7 +199,14 @@ def main():
 
     if role == "PSERVER":
         cur = os.environ["PADDLE_CURRENT_ENDPOINT"]
-        pserver_prog = t.get_pserver_program(cur)
+        if os.environ.get("PADDLE_PSERVER_ELASTIC") == "1":
+            # elastic-grown server at an endpoint OUTSIDE the base set:
+            # boots EMPTY and acquires shards via journaled handoff
+            # (migrate_in) — docs/FAULT_TOLERANCE.md "Live shard
+            # migration"
+            pserver_prog = t.get_elastic_pserver_program(cur)
+        else:
+            pserver_prog = t.get_pserver_program(cur)
         startup = t.get_startup_program(cur, pserver_prog)
         scope = fluid.global_scope()
         exe.run(startup, scope=scope)
@@ -220,8 +250,49 @@ def main():
     crash_once = os.environ.get("DIST_CRASH_ONCE", "")
     if crash_once and os.path.exists(crash_once):
         crash_rank = -1  # this incarnation already died once
+    # elastic collective: DIST_RESIZE pins step-indexed mesh sizes;
+    # DIST_COLLECTIVE_SCHEDULE (launch --elastic-schedule passthrough)
+    # is the wall-clock +N/-N form, applied at step boundaries.  The
+    # resize just rewrites program._collective["nranks"]: the executor
+    # re-traces over the new dp mesh, drains the ordered-io tokens
+    # across the topology switch, and the mesh split re-shards the same
+    # global batch — the mean-gradient trajectory is split-invariant.
+    import time as _time
+
+    resize_plan = list(_RESIZE_PLAN) if collective else []
+    tsched, cur_n = [], nranks
+    if collective and os.environ.get("DIST_COLLECTIVE_SCHEDULE"):
+        lo, hi = (int(x) for x in
+                  os.environ["DIST_COLLECTIVE_ELASTIC"].split(":"))
+        for part in os.environ["DIST_COLLECTIVE_SCHEDULE"].split(","):
+            part = part.strip()
+            if part:
+                t_s, _, d = part.partition(":")
+                tsched.append((float(t_s), int(d)))
+        tsched.sort()
+        cur_n = min(max(cur_n, lo), hi)
+    t0_wall = _time.monotonic()
+
+    def maybe_resize(step_i):
+        nonlocal cur_n
+        new_n = cur_n
+        while resize_plan and step_i >= resize_plan[0][0]:
+            new_n = resize_plan.pop(0)[1]
+        while tsched and _time.monotonic() - t0_wall >= tsched[0][0]:
+            new_n += tsched.pop(0)[1]
+            lo, hi = (int(x) for x in
+                      os.environ["DIST_COLLECTIVE_ELASTIC"].split(":"))
+            new_n = min(max(new_n, lo), hi)
+        if new_n != cur_n:
+            cur_n = new_n
+            trainer_prog._collective["nranks"] = cur_n
+            print("COLLECTIVE RESIZE step=%d nranks=%d" % (step_i, cur_n),
+                  flush=True)
+
     losses = []
     for i in range(steps):
+        if collective and (resize_plan or tsched):
+            maybe_resize(i)
         (lv,) = exe.run(
             program=trainer_prog,
             feed={feed_x: x[lo:hi], "y": y[lo:hi]},
@@ -259,13 +330,19 @@ def main():
         # killed-and-restored run's table is BIT-IDENTICAL to an
         # unkilled run's (journal replay + fenced resend lose nothing)
         from paddle_tpu.distributed.rpc import RPCClient
+        from paddle_tpu.ops import dist_ops
 
         ep_list = [e.strip() for e in eps.split(",") if e.strip()]
+        # live pserver migration: shard s may have MOVED off the base
+        # endpoint — route each read through the CURRENT plan (the
+        # base endpoint may even be retired and gone)
+        plan_st = dist_ops._plans.get(getattr(t, "plan_gid", None))
         dump = {}
         for w, info in sorted(t.sparse_tables.items()):
             n_rows = 20  # build_sparse_model's table size
             tbl = np.zeros((n_rows, info["emb_dim"]), np.float32)
-            for s, ep in enumerate(ep_list):
+            for s in range(len(ep_list)):
+                ep = dist_ops._sparse_route(plan_st, s, ep_list)
                 gids = np.arange(s, n_rows, len(ep_list), dtype=np.int64)
                 rows = np.asarray(RPCClient.get(ep).prefetch(
                     info["shards"][s], gids // len(ep_list),

@@ -782,10 +782,17 @@ def test_async_transpile_stamps_fenced_delivery_contract():
         for name in ("prefetch", "send_sparse", "send_bucket"):
             assert ops[name].attrs.get("async_fence") is (not sync), \
                 (name, sync)
-        assert ops["send_sparse"].attrs["hot_opt"] == {
-            "type": "sgd", "lr": 0.1}
-        assert ops["prefetch"].attrs["hot_opt"] == {
-            "type": "sgd", "lr": 0.1}
+        # the mirror spec is only stamped on an UNCOMPRESSED wire: the
+        # server applies bf16-decoded grads the client doesn't hold, so
+        # a compressed plan must stamp None (PR 8 contract) — this test
+        # runs under both wire regimes (the ci.sh bf16 lane)
+        from paddle_tpu.flags import get_flag
+
+        want_hot = ({"type": "sgd", "lr": 0.1}
+                    if str(get_flag("comm_wire_dtype")) == "float32"
+                    else None)
+        assert ops["send_sparse"].attrs["hot_opt"] == want_hot
+        assert ops["prefetch"].attrs["hot_opt"] == want_hot
 
 
 def test_async_fenced_sparse_trains_and_counts(no_heartbeats):
@@ -970,3 +977,77 @@ def test_async_clock_only_chunks_coalesce_into_one_frame(no_heartbeats):
     assert stats["async_clock_merges"] == steps, stats
     assert stats["rpc_verbs"].get("send_sparse", 0) == steps, stats
     assert stats["rpc_verbs"].get("sparse_clocks", 0) == steps, stats
+
+
+def test_derive_plan_stable_shards_across_endpoint_worlds():
+    """Live pserver migration's plan contract: block SLICING keys off
+    the spec's BASE endpoint count, so shard identity (names +
+    boundaries) is invariant under a pserver-set change — only the
+    dispatch moves.  An unchanged world stays bit-identical to the old
+    rule, and sparse_eps maps each stable shard (rows hash g % n_base
+    forever) onto the live endpoint set — identity when unchanged."""
+    from paddle_tpu.transpiler.distribute_transpiler import derive_plan
+
+    spec = {"params": [["w", [64, 4], "float32", "w@GRAD"],
+                       ["b", [4], "float32", "b@GRAD"]],
+            "endpoints": ["a:1", "b:2"], "trainers": 2,
+            "flags": {"slice_var_up": True, "min_block_size": 4,
+                      "split_method": "SizeWeighted",
+                      "comm_bucket_bytes": 4096,
+                      "comm_wire_dtype": "float32",
+                      "comm_grad_int8": False}}
+    base = derive_plan(spec)
+    same = derive_plan(spec, world={"endpoints": ["a:1", "b:2"]})
+    assert same["block_eps"] == base["block_eps"]
+    assert same["send_buckets"] == base["send_buckets"]
+    assert same["recv_buckets"] == base["recv_buckets"]
+    assert same["sparse_eps"] == ["a:1", "b:2"]  # identity
+    grown = derive_plan(spec, world={"endpoints": ["a:1", "b:2", "c:3"]})
+    # shard identity stable: same (param, idx) keys, same block sizes
+    assert set(grown["block_eps"]) == set(base["block_eps"])
+    for p in ("w", "b"):
+        assert [(blk.begin, blk.end) for blk in grown["blocks"][p]] == \
+            [(blk.begin, blk.end) for blk in base["blocks"][p]]
+    # ...but dispatch now spans the grown world
+    assert set(grown["block_eps"].values()) == {"a:1", "b:2", "c:3"}
+    # shrink below base MOVES a sparse shard (stable shard 1 lands on
+    # the surviving endpoint)
+    shrunk = derive_plan(spec, world={"endpoints": ["a:1"]})
+    assert shrunk["sparse_eps"] == ["a:1", "a:1"]
+    assert set(shrunk["block_eps"].values()) == {"a:1"}
+
+
+def test_elastic_pserver_program_is_empty_and_plan_stamped():
+    """The grown server's program: no shards, no slice plan — state
+    arrives exclusively via journaled handoff — but the plan spec and
+    round config ride along so it can re-derive dispatch and join the
+    protocol.  get_pserver_program also stamps the plan spec now (the
+    server-side diff computation needs it)."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = layers.data("x", shape=[4])
+        y = layers.data("y", shape=[1])
+        loss = layers.mean(layers.square_error_cost(
+            layers.fc(x, size=2), y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    config = fluid.DistributeTranspilerConfig()
+    config.min_block_size = 4
+    t = fluid.DistributeTranspiler(config=config)
+    t.transpile(0, program=prog, startup_program=startup,
+                pservers="127.0.0.1:7001,127.0.0.1:7002", trainers=2)
+    ps = t.get_pserver_program("127.0.0.1:7001")
+    a = ps.global_block().ops[0].attrs
+    assert a["plan_spec"] == t.plan_spec
+    el = t.get_elastic_pserver_program("127.0.0.1:7099")
+    ea = el.global_block().ops[0].attrs
+    assert ea["elastic"] and ea["plan_spec"] == t.plan_spec
+    assert ea["optimize_programs"] == [] and ea["slice_plan"] == []
+    assert ea["trainers"] == 2 and ea["sync_mode"] is True
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        t.get_elastic_pserver_program("127.0.0.1:7001")  # base ep

@@ -2359,7 +2359,8 @@ def test_plan_verb_reports_world_and_register_seeds_epoch():
                          sync_mode=True)
     ps._apply_shard = lambda idx, feed: None
     r = ps._h_plan(trainer_id=0)
-    assert r == {"epoch": 0, "world": 2, "live": [0, 1], "trainers": 2}
+    assert r == {"epoch": 0, "world": 2, "live": [0, 1], "trainers": 2,
+                 "endpoints": []}
     with ps._cv:
         ps._evict_locked(1, "test")
     r = ps._h_plan(trainer_id=0)
@@ -2781,3 +2782,589 @@ def test_clock_flush_runs_incarnation_replay_before_fence_advance(
         dist_ops.reset_fences()
         with RPCClient._lock:
             RPCClient._instances.pop(ep, None)
+
+
+# ---------------------------------------------------------------------------
+# live pserver shard migration: journaled handoff, two-phase commit,
+# load-aware scaling, elastic collective (docs/FAULT_TOLERANCE.md
+# "Live shard migration")
+# ---------------------------------------------------------------------------
+def _mig_spec(eps, trainers=1, wire="float32", grad_int8=False):
+    return {"params": [], "endpoints": [str(e) for e in eps],
+            "trainers": int(trainers),
+            "flags": {"slice_var_up": True, "min_block_size": 4,
+                      "split_method": "SizeWeighted",
+                      "comm_bucket_bytes": 4096,
+                      "comm_wire_dtype": wire,
+                      "comm_grad_int8": bool(grad_int8)}}
+
+
+def _mig_ps(base_eps, endpoint, shards=None, ckpt=None, server_idx=0,
+            with_slots=False, **kw):
+    """Migration-capable in-process pserver: real plan spec + sparse
+    shards keyed by their stable BASE index."""
+    tables, idx = {}, {}
+    for name, s in (shards or {}).items():
+        tbl = (np.arange(24, dtype=np.float32).reshape(6, 4)
+               + 10.0 * (s + 1))
+        info = {"tbl": tbl, "lr": 0.1, "opt": {"type": "sgd",
+                                               "attrs": {}}}
+        if with_slots:
+            info["opt"] = {"type": "adagrad", "attrs": {"epsilon": 1e-6}}
+            info["moment"] = np.full_like(tbl, 0.5)
+        tables[name] = info
+        idx[name] = s
+    ps = ParameterServer(
+        [], {}, num_trainers=1, sync_mode=True, checkpoint_dir=ckpt,
+        server_idx=server_idx, sparse_tables=tables,
+        plan_spec=_mig_spec(base_eps), endpoint=str(endpoint),
+        ps_world=[str(e) for e in base_eps], sparse_shard_idx=idx, **kw)
+    ps._apply_shard = lambda i, f: None
+    ps.eviction_deadline = 1.0  # short freeze/boundary limits in tests
+    return ps
+
+
+def test_migration_handoff_in_process_bit_exact():
+    """ACCEPTANCE (in-process handoff): a sparse shard's table, slot
+    state and seq fences move whole through the crc-framed journal
+    transport and land BIT-IDENTICAL at the target; the plan epoch
+    mints only at commit, and the source drops its copy only then."""
+    base = ["10.9.9.9:1"]
+    src = _mig_ps(base, base[0], shards={"t0.shard0": 0},
+                  with_slots=True)
+    src._sparse_fence[(0, "t0.shard0")] = 7
+    want_tbl = np.array(src.sparse_tables["t0.shard0"]["tbl"])
+    want_m = np.array(src.sparse_tables["t0.shard0"]["moment"])
+    tgt = _mig_ps(base, None)  # endpoint assigned below (listen first)
+    srv = VarServer("127.0.0.1:0", tgt).start()
+    tgt.endpoint = srv.endpoint
+    new_world = [srv.endpoint]
+    try:
+        r = src._h_migrate_begin(world=new_world)
+        assert r["ok"] and r["moved"] == 1 and r["bytes"] > 0, r
+        # begin shipped + target fsynced — but NOTHING minted yet, and
+        # the source still owns (and serves) the shard
+        assert src._plan_epoch == 0 and tgt._plan_epoch == 0
+        assert "t0.shard0" in src.sparse_tables
+        np.testing.assert_array_equal(
+            tgt.sparse_tables["t0.shard0"]["tbl"], want_tbl)
+        np.testing.assert_array_equal(
+            tgt.sparse_tables["t0.shard0"]["moment"], want_m)
+        assert tgt._sparse_fence[(0, "t0.shard0")] == 7
+        assert tgt._sparse_shard_idx["t0.shard0"] == 0
+        r = src._h_migrate_commit(world=new_world)
+        assert r["ok"] and r["retiring"], r
+        assert src._plan_epoch == 1
+        assert "t0.shard0" not in src.sparse_tables
+        assert src._ps_world == new_world
+        # the target learns the world via ITS commit (recovery path —
+        # it never began; nothing moves off it)
+        r = tgt._h_migrate_commit(world=new_world)
+        assert r["ok"] and not r["retiring"], r
+        assert tgt._ps_world == new_world and tgt._plan_epoch == 1
+        np.testing.assert_array_equal(
+            tgt.sparse_tables["t0.shard0"]["tbl"], want_tbl)
+    finally:
+        srv.shutdown()
+        with RPCClient._lock:
+            RPCClient._instances.pop(srv.endpoint, None)
+
+
+def test_epoch_never_mints_before_target_durability():
+    """ACCEPTANCE: the target dies between replay and ack (its
+    migrate_in raises after applying) — the begin ABORTS, the epoch
+    never mints, the old assignment stays authoritative, and the source
+    keeps APPLYING updates with zero drops (trainers keep dispatching
+    to it)."""
+    base = ["10.9.9.8:1"]
+    src = _mig_ps(base, base[0], shards={"t0.shard0": 0})
+    tgt = _mig_ps(base, None)
+    real = tgt._h_migrate_in
+
+    def die_before_ack(frames, source=None, trainer_id=0):
+        real(frames, source=source, trainer_id=trainer_id)
+        raise RuntimeError("SIGKILL between replay and ack")
+
+    tgt._h_migrate_in = die_before_ack
+    srv = VarServer("127.0.0.1:0", tgt).start()
+    tgt.endpoint = srv.endpoint
+    try:
+        before = np.array(src.sparse_tables["t0.shard0"]["tbl"])
+        r = src._h_migrate_begin(world=[srv.endpoint])
+        assert not r["ok"], r
+        # nothing minted, nothing dropped, not frozen
+        assert src._plan_epoch == 0 and src._mig is None
+        assert not src._frozen
+        assert src._ps_world == base
+        assert "t0.shard0" in src.sparse_tables
+        # trainers keep dispatching to the source: the update APPLIES
+        r = src._h_send_sparse(table="t0.shard0",
+                               ids=np.array([1], np.int64),
+                               rows=np.ones((1, 4), np.float32),
+                               trainer_id=0)
+        assert r["ok"] and not r.get("stale_plan"), r
+        with src._cv:
+            src._run_round()  # sync mode queues; the round applies it
+        after = np.array(src.sparse_tables["t0.shard0"]["tbl"])
+        assert not np.array_equal(before, after), \
+            "the applied update was dropped"
+        assert src.counters["migrate_aborts"] == 1
+    finally:
+        srv.shutdown()
+        with RPCClient._lock:
+            RPCClient._instances.pop(srv.endpoint, None)
+
+
+def test_migrate_commit_recovery_after_source_restart(tmp_path):
+    """A source killed between its begin-ack and its commit restores
+    WITHOUT the in-memory capture; the driver's commit retry hits the
+    RECOVERY path: the diff is recomputed, the (already-durable-at-
+    target) shards drop, the world adopts, the epoch mints — no
+    re-begin after a mint, so no stale copy can overwrite the target."""
+    base = ["10.9.9.7:1"]
+    src = _mig_ps(base, base[0], shards={"t0.shard0": 0},
+                  ckpt=str(tmp_path), server_idx=11)
+    src.save_checkpoint()
+    tgt = _mig_ps(base, None)
+    srv = VarServer("127.0.0.1:0", tgt).start()
+    tgt.endpoint = srv.endpoint
+    new_world = [srv.endpoint]
+    try:
+        assert src._h_migrate_begin(world=new_world)["ok"]
+        # "SIGKILL" the source: a fresh incarnation restores from the
+        # pre-handoff snapshot (no _mig capture survives)
+        src2 = _mig_ps(base, base[0], shards={"t0.shard0": 0},
+                       ckpt=str(tmp_path), server_idx=11)
+        assert src2.load_checkpoint() is not None
+        assert src2._mig is None
+        r = src2._h_migrate_commit(world=new_world)
+        assert r["ok"] and r["retiring"], r
+        assert src2._plan_epoch == 1
+        assert "t0.shard0" not in src2.sparse_tables
+        assert src2._ps_world == new_world
+        # idempotent: a second commit (driver retry) acks cleanly
+        r = src2._h_migrate_commit(world=new_world)
+        assert r["ok"], r
+    finally:
+        srv.shutdown()
+        with RPCClient._lock:
+            RPCClient._instances.pop(srv.endpoint, None)
+
+
+def test_migrated_state_survives_target_restart(tmp_path):
+    """Adopted shards are DURABLE before the ack: a target SIGKILLed
+    right after migrate_in restores them (snapshot + adopted-state
+    registry), bit-identical — the epoch-mint-after-durability
+    invariant is meaningful only because of this."""
+    base = ["10.9.9.6:1"]
+    src = _mig_ps(base, base[0], shards={"t0.shard0": 0},
+                  with_slots=True)
+    want = np.array(src.sparse_tables["t0.shard0"]["tbl"])
+    tgt = _mig_ps(base, None, ckpt=str(tmp_path), server_idx=21)
+    srv = VarServer("127.0.0.1:0", tgt).start()
+    tgt.endpoint = srv.endpoint
+    try:
+        assert src._h_migrate_begin(world=[srv.endpoint])["ok"]
+    finally:
+        srv.shutdown()
+        with RPCClient._lock:
+            RPCClient._instances.pop(srv.endpoint, None)
+    tgt2 = _mig_ps(base, tgt.endpoint, ckpt=str(tmp_path),
+                   server_idx=21)
+    assert tgt2.load_checkpoint() is not None
+    np.testing.assert_array_equal(
+        tgt2.sparse_tables["t0.shard0"]["tbl"], want)
+    np.testing.assert_array_equal(
+        tgt2.sparse_tables["t0.shard0"]["moment"],
+        src.sparse_tables["t0.shard0"]["moment"])
+    assert tgt2._sparse_shard_idx["t0.shard0"] == 0
+
+
+class _StubPipe:
+    """Capture-everything stand-in for the PipelinedClient map."""
+
+    def __init__(self):
+        self.shipped = {}  # ep -> [kwargs]
+
+    def __call__(self, ep):
+        pipe = self
+
+        class P:
+            def submit(self, verb, timeout_s=None, **kw):
+                pipe.shipped.setdefault(ep, []).append((verb, kw))
+
+            def drain(self):
+                return []
+
+        return P()
+
+
+@pytest.mark.parametrize("wire", ["bf16", "int8"])
+def test_transition_round_rescales_exactly(wire):
+    """ACCEPTANCE (PR 10 gap closed): the stale-plan replay's transition
+    round is EXACT under a compressed wire — the re-shipped block is
+    compress(raw * ratio), re-compressed from the recorded
+    pre-compression value, never rescaled-compressed bytes; the int8
+    error-feedback residual is re-derived from the replacing
+    quantization."""
+    from paddle_tpu.distributed.rpc import Bf16Wire, Int8Wire
+    from paddle_tpu.ops import dist_ops
+
+    dist_ops.reset_fences()
+    ep = "10.9.9.5:1"
+    wire_dtype = "bfloat16" if wire == "bf16" else "float32"
+    grad_int8 = wire == "int8"
+    rng = np.random.RandomState(3)
+    raw = rng.randn(32).astype(np.float32)
+    raw_out = {}
+    shipped0 = dist_ops._compress_block(ep, "g.block0", raw, wire_dtype,
+                                        grad_int8, raw_out=raw_out)
+    assert "g.block0" in raw_out
+    fst = dist_ops._fence(ep)
+    fst.update(step=1, corr=1.0, raw=dict(raw_out))
+    fst["sends"] = [dict(blocks={"g.block0": shipped0}, trainer_id=0,
+                         seq_total=1, step=1, seq_idx=0,
+                         sparse_tables=[])]
+    st = {"spec": _mig_spec([ep], trainers=2, wire=wire_dtype,
+                            grad_int8=grad_int8),
+          "epoch": 1, "base": 2, "world": 1, "corr": 2.0,
+          "derived": None, "replans": 0}
+    pipe = _StubPipe()
+    try:
+        dist_ops._replay_round_plan(pipe, 0, [ep], st, set())
+        kws = [kw for verb, kw in pipe.shipped[ep]
+               if verb == "send_bucket"]
+        assert len(kws) == 1
+        got = kws[0]["blocks"]["g.block0"]
+        assert kws[0]["pepoch"] == 1
+        want_raw = (raw * np.float32(2.0)).astype(np.float32)
+        if wire == "bf16":
+            assert isinstance(got, Bf16Wire)
+            import ml_dtypes
+
+            np.testing.assert_array_equal(
+                got.arr.astype(ml_dtypes.bfloat16),
+                want_raw.astype(ml_dtypes.bfloat16))
+        else:
+            assert isinstance(got, Int8Wire)
+            q2, scale2, deq2 = dist_ops._quantize_i8(want_raw)
+            np.testing.assert_array_equal(got.q, q2)
+            assert got.scale == scale2
+            # the residual now corresponds to the REPLACING quantization
+            np.testing.assert_allclose(
+                dist_ops._ef_residuals[(ep, "g.block0")],
+                want_raw - deq2, rtol=0, atol=0)
+            # and is NOT the stale original-scale residual
+            _q1, _s1, deq1 = dist_ops._quantize_i8(raw)
+            assert not np.allclose(want_raw - deq2, raw - deq1)
+    finally:
+        dist_ops.reset_fences()
+
+
+def test_transition_round_rescale_is_idempotent_at_ratio_one():
+    """A pserver-set-only change (trainer count unchanged, ratio 1)
+    re-ships BYTE-identical compressed blocks — re-compression of the
+    unchanged raw reproduces the original quantization and residual."""
+    from paddle_tpu.distributed.rpc import Int8Wire
+    from paddle_tpu.ops import dist_ops
+
+    dist_ops.reset_fences()
+    ep = "10.9.9.4:1"
+    raw = np.linspace(-1, 1, 16).astype(np.float32)
+    raw_out = {}
+    shipped0 = dist_ops._compress_block(ep, "g.block0", raw, "float32",
+                                        True, raw_out=raw_out)
+    res0 = np.array(dist_ops._ef_residuals[(ep, "g.block0")])
+    got = dist_ops._recompress_block(ep, "g.block0",
+                                     raw_out["g.block0"], "float32",
+                                     True)
+    assert isinstance(got, Int8Wire)
+    np.testing.assert_array_equal(got.q, shipped0.q)
+    assert got.scale == shipped0.scale
+    np.testing.assert_array_equal(
+        dist_ops._ef_residuals[(ep, "g.block0")], res0)
+    dist_ops.reset_fences()
+
+
+def test_fault_delay_is_seeded_and_bounded():
+    """Satellite: the `delay` action's per-frame latency is a pure
+    function of (seed, frame index) — deterministic across schedules
+    with the same seed, different across seeds, always in (0, 1]."""
+    a = FaultSchedule(seed=5)
+    b = FaultSchedule(seed=5)
+    c = FaultSchedule(seed=6)
+    fr_a = [a.delay_fraction(i) for i in range(64)]
+    assert fr_a == [b.delay_fraction(i) for i in range(64)]
+    assert fr_a != [c.delay_fraction(i) for i in range(64)]
+    assert all(0.0 < f <= 1.0 for f in fr_a)
+    assert len(set(fr_a)) > 32  # actually varies per frame
+
+
+def test_delayed_handoff_still_completes_within_epoch_fence():
+    """Satellite: a SLOW network (every handoff frame delayed, none
+    lost) delivers the migration late but intact — the handoff
+    completes, the table lands bit-identical, and the epoch still only
+    mints at commit (the fence is ordering, not timing)."""
+    base = ["10.9.9.3:1"]
+    src = _mig_ps(base, base[0], shards={"t0.shard0": 0})
+    want = np.array(src.sparse_tables["t0.shard0"]["tbl"])
+    tgt = _mig_ps(base, None)
+    srv = VarServer("127.0.0.1:0", tgt).start()
+    chan = FaultyChannel(srv.endpoint, delay=1.0, seed=5,
+                         delay_s=0.2).start()
+    tgt.endpoint = chan.endpoint
+    new_world = [chan.endpoint]
+    try:
+        t0 = time.monotonic()
+        r = src._h_migrate_begin(world=new_world)
+        assert r["ok"], r
+        assert src._plan_epoch == 0  # delayed, delivered, not yet minted
+        np.testing.assert_array_equal(
+            tgt.sparse_tables["t0.shard0"]["tbl"], want)
+        assert src._h_migrate_commit(world=new_world)["ok"]
+        assert src._plan_epoch == 1
+        assert chan.stats["c2s"]["delay"] >= 1, chan.stats
+        assert time.monotonic() - t0 < 30.0
+    finally:
+        chan.stop()
+        srv.shutdown()
+        with RPCClient._lock:
+            RPCClient._instances.pop(chan.endpoint, None)
+
+
+def test_scaling_policy_pserver_load_signals():
+    """Load-aware pserver scaling: persistent queue-depth pressure grows
+    (after hysteresis), sustained idleness shrinks (double hysteresis),
+    stale-plan drops SUPPRESS actions (a membership change is still
+    settling), and the shared action budget damps flapping."""
+    from paddle_tpu.distributed.launch import _RestartPolicy, \
+        _ScalingPolicy
+
+    pol = _ScalingPolicy(1, 4, cooldown_s=0.0, hysteresis=2,
+                         min_ps=1, max_ps=3,
+                         budget=_RestartPolicy(max_restarts=2,
+                                               window_s=60.0,
+                                               backoff_s=0.0))
+    load_hi = {"queue_depth": 8, "staleness_parks": 0,
+               "stale_plan_drops": 0}
+    assert pol.observe_ps_load(2, load_hi, n_trainers=2) is None
+    assert pol.observe_ps_load(2, load_hi, n_trainers=2) == \
+        ("grow_ps", None)
+    # a settling migration (stale drops moving) suppresses + resets
+    assert pol.observe_ps_load(
+        3, {"queue_depth": 8, "staleness_parks": 0,
+            "stale_plan_drops": 5}, n_trainers=2) is None
+    assert pol.observe_ps_load(3, load_hi, n_trainers=2) is None
+    # parks count as pressure too
+    assert pol.observe_ps_load(
+        3, {"queue_depth": 0, "staleness_parks": 3,
+            "stale_plan_drops": 5}, n_trainers=2) is None  # drops moved
+    load_idle = {"queue_depth": 0, "staleness_parks": 3,
+                 "stale_plan_drops": 5}
+    for _ in range(3):
+        assert pol.observe_ps_load(3, load_idle, n_trainers=2) is None
+    assert pol.observe_ps_load(3, load_idle, n_trainers=2) == \
+        ("shrink_ps", None)
+    # budget exhausted (2 actions in window): the next decision is damped
+    for _ in range(5):
+        pol.observe_ps_load(2, load_hi, n_trainers=2)
+    assert pol._last_parks is not None
+    assert pol.budget.next_delay() is None
+
+
+def test_unfenced_async_journal_warns_loudly(tmp_path, capsys):
+    """Satellite: the legacy per-var async path running journaled-but-
+    unfenced surfaces at RUNTIME — loud stderr on the first such apply
+    and an `unfenced_async` field in the stats verb — instead of living
+    only in the docs."""
+    ps = _async_sparse_ps(str(tmp_path))
+    ps.grad_to_shard = {"g0": 0}
+    assert ps._h_stats()["unfenced_async"] is False
+    ps._h_send(name="g0", value=np.ones(4, np.float32), trainer_id=0)
+    err = capsys.readouterr().err
+    assert "JOURNALED BUT UNFENCED" in err
+    assert ps._h_stats()["unfenced_async"] is True
+    # once: the second apply does not repeat the warning
+    ps._h_send(name="g0", value=np.ones(4, np.float32), trainer_id=0)
+    assert "UNFENCED" not in capsys.readouterr().err
+
+
+def _migration_run(capfd, tmp_path, name, schedule=None, crash=None,
+                   steps=24, supervise=False, elastic="2:3", sync=True,
+                   nproc=2):
+    """One supervised sparse job with (optionally) a scheduled
+    pserver-set trace and (optionally) a deterministic SIGKILL inside
+    the handoff.  Returns (out, losses-by-trainer, tables-by-trainer)."""
+    from paddle_tpu.distributed.launch import launch_pserver
+
+    env = dict(os.environ)
+    env.update({
+        "DIST_STEPS": str(steps), "DIST_STEP_SLEEP": "0.3",
+        "DIST_MODEL": "sparse", "DIST_DUMP_TABLE": "1",
+        "FLAGS_max_retry": "120", "JAX_PLATFORMS": "cpu",
+    })
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    kw = {}
+    if crash:
+        env["PADDLE_TPU_MIGRATE_CRASH"] = crash
+        env["PADDLE_TPU_MIGRATE_CRASH_ONCE"] = str(
+            tmp_path / ("%s.crashed" % name))
+        kw = dict(supervise=True, restart_backoff=0.2,
+                  ckpt_dir=str(tmp_path / ("%s.ckpt" % name)))
+    elif supervise:
+        kw = dict(supervise=True, restart_backoff=0.2,
+                  ckpt_dir=str(tmp_path / ("%s.ckpt" % name)))
+    if schedule:
+        kw.update(elastic_pservers=elastic, pserver_schedule=schedule,
+                  elastic_cooldown=1.0)
+    rc = launch_pserver([_RUNNER], nproc=nproc, n_pservers=2,
+                        base_env=env, sync=sync, **kw)
+    out = capfd.readouterr().out
+    assert rc == 0, out
+    losses, tables = {}, {}
+    for tag in ["trainer.%d" % i for i in range(nproc)]:
+        losses[tag] = _trainer_losses(out, tag)
+        tables[tag] = _table_dump(out, tag)
+    return out, losses, tables
+
+
+@pytest.mark.slow  # two full cluster runs; rides scripts/ci.sh's
+#                    migration-chaos pass (-m "")
+def test_pserver_migration_2to3to2_bit_identical(capfd, tmp_path):
+    """ACCEPTANCE (tentpole E2E): a supervised 2-trainer job whose
+    pserver set changes 2 -> 3 -> 2 mid-run — shard state migrating
+    out to the grown server and back off it before retirement —
+    completes with finite convergent losses, and both the trajectory
+    AND the dumped table are BIT-IDENTICAL to a run with no migration
+    at all (every round folds exactly once at exactly one owner)."""
+    out_m, losses_m, tables_m = _migration_run(
+        capfd, tmp_path, "mig", schedule="5:+1,11:-1", steps=40)
+    assert "PSERVER MIGRATE-COMMIT" in out_m, out_m
+    assert "TRAINER REPLAN" in out_m, out_m
+    # the grown server adopted at least one shard...
+    assert "MIGRATE-IN" in out_m, out_m
+    # ...and was retired cleanly after the shrink migrated it away
+    assert "PSERVER RETIRE" in out_m, out_m
+    for tag in ("trainer.0", "trainer.1"):
+        ls = losses_m[tag]
+        assert len(ls) == 40 and np.isfinite(ls).all(), ls
+        assert ls[-1] < ls[0], ls
+    out_r, losses_r, tables_r = _migration_run(
+        capfd, tmp_path, "ref", schedule=None, steps=40)
+    assert losses_m == losses_r, (
+        "migrated run's trajectory diverged from the static run:\n"
+        "mig=%s\nref=%s" % (losses_m, losses_r))
+    assert tables_m == tables_r, \
+        "migrated run's table is not bit-identical to the static run's"
+
+
+@pytest.mark.slow  # two full cluster runs per point; ci migration pass
+@pytest.mark.parametrize("point", ["serialize", "ack"])
+def test_migration_under_sigkill_bit_identical(capfd, tmp_path, point):
+    """ACCEPTANCE (chaos E2E): SIGKILL of the SOURCE mid-serialize, or
+    of the TARGET between replay and ack — the supervised respawn
+    restores, the handoff rides out the kill (RPC-layer replay +
+    recovery commit), and the run's losses AND dumped table are
+    BIT-IDENTICAL to the unkilled migrated run.
+
+    Runs in the journal-armed ASYNC configuration (the PR 8 discipline
+    this PR reuses as the handoff transport): every applied update is
+    fsync'd before its ack, so the killed server restores EXACTLY —
+    journal discipline, not snapshot luck.  (Sync mode keeps its
+    pre-existing, documented one-round background-snapshot window —
+    lost_rounds — which is orthogonal to the handoff protocol and
+    tolerated there.)  The trace shrinks 2 -> 1, which MOVES a sparse
+    shard (s % n_live) and the dense blocks off the retiring server —
+    the kill lands inside that handoff."""
+    out_k, losses_k, tables_k = _migration_run(
+        capfd, tmp_path, "kill" + point, schedule="5:-1", steps=30,
+        crash=point, sync=False, nproc=1, elastic="1:2")
+    assert "PSERVER MIGRATE-CRASH point=%s" % point in out_k, out_k
+    out_r, losses_r, tables_r = _migration_run(
+        capfd, tmp_path, "nokill" + point, schedule="5:-1", steps=30,
+        supervise=True, sync=False, nproc=1, elastic="1:2")
+    assert "PSERVER MIGRATE-COMMIT" in out_r, out_r
+    assert losses_k == losses_r, (
+        "killed-during-migration run diverged:\nkill=%s\nref=%s"
+        % (losses_k, losses_r))
+    assert tables_k == tables_r, \
+        "killed run's table is not bit-identical to the unkilled run's"
+
+
+@pytest.mark.slow  # one cluster run; ci migration pass
+def test_double_migration_flap_under_budget(capfd, tmp_path):
+    """A grow immediately followed by a shrink (membership flap) rides
+    the same two-phase machinery back-to-back under the action budget:
+    both handoffs complete, every round still folds exactly once, and
+    the job stays bit-identical to a static run."""
+    out_f, losses_f, tables_f = _migration_run(
+        capfd, tmp_path, "flap", schedule="5:+1,7:-1", steps=32)
+    out_r, losses_r, tables_r = _migration_run(
+        capfd, tmp_path, "flapref", schedule=None, steps=32)
+    assert losses_f == losses_r, (
+        "flap run diverged:\nflap=%s\nref=%s" % (losses_f, losses_r))
+    assert tables_f == tables_r
+
+
+@pytest.mark.slow  # two jax subprocess boots; ci migration pass
+def test_elastic_collective_resize_2to4_matches_fresh_run():
+    """ACCEPTANCE (elastic collective): --elastic is accepted in
+    collective mode — a mid-run resize 2 -> 4 virtual devices re-traces
+    over the new dp mesh, drains the ordered-io tokens (no PjRt layout
+    abort), and the post-resize losses match a fresh 4-device run at
+    rtol 1e-5 (the mean gradient is split-invariant)."""
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "PADDLE_TRAINING_ROLE":
+                "TRAINER", "DIST_MODE": "collective", "DIST_STEPS": "6"})
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("XLA_FLAGS", None)
+
+    def run(extra):
+        e = dict(env)
+        e.update(extra)
+        p = subprocess.run([sys.executable, "-u", _RUNNER], env=e,
+                           stdout=subprocess.PIPE,
+                           stderr=subprocess.STDOUT, timeout=300)
+        out = p.stdout.decode("utf-8", "replace")
+        assert p.returncode == 0, out
+        for ln in out.splitlines():
+            if ln.startswith("LOSSES "):
+                return out, json.loads(ln[len("LOSSES "):])
+        raise AssertionError("no LOSSES line:\n%s" % out)
+
+    out_r, resized = run({"DIST_COLLECTIVE_DEVICES": "2",
+                          "DIST_RESIZE": "3:4"})
+    assert "COLLECTIVE RESIZE step=3 nranks=4" in out_r, out_r
+    _, fresh = run({"DIST_COLLECTIVE_DEVICES": "4"})
+    np.testing.assert_allclose(resized, fresh, rtol=1e-5)
+
+
+def test_launch_accepts_collective_elastic_single_process(monkeypatch):
+    """`--elastic` is no longer rejected in collective mode: a
+    single-process launch threads the resize config to the trainer
+    (DIST_COLLECTIVE_ELASTIC / _SCHEDULE); multi-process meshes still
+    refuse with the relaunch guidance."""
+    from paddle_tpu.distributed import launch as launch_mod
+
+    seen = {}
+
+    def fake_collective(script_argv, nproc, base_env=None,
+                        chaos_kills=None, n_pservers=0):
+        seen["env"] = dict(base_env or {})
+        seen["nproc"] = nproc
+        return 0
+
+    monkeypatch.setattr(launch_mod, "launch_collective", fake_collective)
+    rc = launch_mod.main(["--mode", "collective", "--nproc", "1",
+                          "--elastic", "2:4", "--elastic-schedule",
+                          "3:+2", "x.py"])
+    assert rc == 0
+    assert seen["env"]["DIST_COLLECTIVE_ELASTIC"] == "2:4"
+    assert seen["env"]["DIST_COLLECTIVE_SCHEDULE"] == "3:+2"
+    with pytest.raises(SystemExit):
+        launch_mod.main(["--mode", "collective", "--nproc", "2",
+                         "--elastic", "2:4", "x.py"])
+    with pytest.raises(ValueError):
+        # pserver-schedule without the elastic-pservers range: loud
+        launch_mod.launch_pserver(["x.py"], 1, 1,
+                                  pserver_schedule="1:+1")
