@@ -342,6 +342,14 @@ def _bench_impl():
         except Exception as e:
             sys.stderr.write("serve_tp bench failed: %r\n" % (e,))
             result["serve_tp"] = {"error": repr(e)[:200]}
+    # serving fabric: the same trace through a multi-pool router —
+    # static fleet vs the 1->3->1 scale walk vs a mid-stream pool kill
+    if os.environ.get("BENCH_FABRIC", "0") == "1":
+        try:
+            result["fabric"] = _fabric_bench(on_tpu, device)
+        except Exception as e:
+            sys.stderr.write("fabric bench failed: %r\n" % (e,))
+            result["fabric"] = {"error": repr(e)[:200]}
     # model-breadth diagnostics (fluid_benchmark.py model matrix): off by
     # default — the vgg/se_resnext shapes roughly double tunnel time
     if os.environ.get("BENCH_MODELS", "0") == "1":
@@ -942,6 +950,123 @@ def _serve_tp_bench(on_tpu, device):
         "SERVE_TP_RESULT pool_bytes/device ratio %s tok/s ratio %s\n"
         % (out["pool_bytes_per_device_vs_unsharded"],
            out["tok_s_ratio_vs_unsharded"]))
+    return out
+
+
+def _fabric_bench(on_tpu, device):
+    """Serving-fabric leg (BENCH_FABRIC=1): the SAME seeded Poisson
+    trace through a FabricRouter three ways — (a) a static 3-pool
+    fleet, (b) the deterministic 1->3->1 pool-schedule walk, (c) 3
+    pools with one pool_kill mid-stream (pinned PADDLE_TPU_FAULT_SEED)
+    — reporting fleet new-tokens/s, p50/p99 request latency in fabric
+    steps, rejection rate, re-placed-request count, and per-pool
+    occupancy.  The chaos leg also verifies every re-placed stream
+    completed (the failover exactness bar rides the tests; the bench
+    pins the degradation numbers)."""
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu.distributed.faults import FaultSchedule
+    from paddle_tpu.models import gpt2
+    from paddle_tpu.serving import FabricRouter, make_poisson_trace
+
+    class HP(gpt2.GPT2Config):
+        vocab_size = 8000 if on_tpu else 200
+        n_ctx = 256 if on_tpu else 64
+        d_model = 256 if on_tpu else 64
+        n_layer = 4 if on_tpu else 2
+        n_head = 4 if on_tpu else 2
+        dropout = 0.0
+
+    slots = int(os.environ.get("BENCH_FABRIC_SLOTS", 8 if on_tpu else 2))
+    width = int(os.environ.get("BENCH_SERVE_WIDTH", 16 if on_tpu else 8))
+    n_req = int(os.environ.get("BENCH_FABRIC_REQS", 48 if on_tpu else 24))
+    rate = float(os.environ.get("BENCH_FABRIC_RATE", "1.5"))
+    t_max = HP.n_ctx
+
+    def trace():
+        return make_poisson_trace(
+            n_req, rate,
+            prompt_len_range=(4, t_max // 8),
+            out_len_range=(4, t_max // 8),
+            vocab_size=HP.vocab_size,
+            seed=int(os.environ.get("BENCH_SERVE_SEED", "0")),
+            sampled_fraction=0.5)
+
+    from paddle_tpu.serving import ServingEngine
+
+    def factory():
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            _, lm_startup, _, _ = gpt2.gpt2_logits_program(
+                HP, seq_len=t_max)
+            exe = fluid.Executor(
+                fluid.TPUPlace(0) if on_tpu else fluid.CPUPlace())
+            lm_startup.random_seed = 23
+            exe.run(lm_startup)
+            eng = ServingEngine(exe, HP, n_slots=slots, width=width,
+                                t_max=t_max)
+        return eng, scope
+
+    def leg(n_pools, schedule=None, faults=None):
+        # depth sized to the workload: the bench pins latency under
+        # load, the loud-rejection contract is pinned by the tests
+        router = FabricRouter(factory, n_pools=n_pools,
+                              queue_depth=n_req,
+                              fault_schedule=faults)
+        results, stats = router.run(trace(), pool_schedule=schedule)
+        lat = sorted(r["latency_steps"] for r in results.values()
+                     if r["status"] == "OK")
+
+        def pct(vals, p):
+            return vals[min(len(vals) - 1, int(p * len(vals)))]
+
+        ok = sum(r["status"] == "OK" for r in results.values())
+        return {
+            "value": stats["tokens_per_s"],
+            "unit": "new tokens/sec" + ("" if on_tpu
+                                        else " (cpufallback)"),
+            "ok": ok,
+            "requests": n_req,
+            "p50_latency_steps": pct(lat, 0.50) if lat else None,
+            "p99_latency_steps": pct(lat, 0.99) if lat else None,
+            "rejection_rate": stats["rejection_rate"],
+            "replaced": stats["replaced"],
+            "pools_added": stats["pools_added"],
+            "pools_retired": stats["pools_retired"],
+            "pools_died": stats["pools_died"],
+            "occupancy": stats["occupancy"],
+            "per_pool_occupancy": {
+                pid: p["mean_occupancy"]
+                for pid, p in stats["pools"].items()},
+            "fabric_steps": stats["step"],
+        }
+
+    out = {"slots": slots, "width": width, "requests": n_req,
+           "rate": rate}
+    out["static_3_pool"] = leg(3)
+    sys.stderr.write("FABRIC_RESULT static_3_pool %s\n"
+                     % json.dumps(out["static_3_pool"]))
+    grow_t = max(2, int(n_req / (3 * rate)))
+    shrink_t = 4 * grow_t
+    out["scale_1_3_1"] = leg(1, schedule=[(grow_t, +2),
+                                          (shrink_t, -2)])
+    out["scale_1_3_1"]["schedule"] = "%d:+2,%d:-2" % (grow_t, shrink_t)
+    sys.stderr.write("FABRIC_RESULT scale_1_3_1 %s\n"
+                     % json.dumps(out["scale_1_3_1"]))
+    seed = int(os.environ.get("PADDLE_TPU_FAULT_SEED", "0"))
+    kill_t = max(3, grow_t)
+    out["chaos_pool_kill"] = leg(
+        3, faults=FaultSchedule({"fabric": {kill_t: "pool_kill"}},
+                                seed=seed))
+    out["chaos_pool_kill"]["fault_seed"] = seed
+    out["chaos_pool_kill"]["kill_step"] = kill_t
+    sys.stderr.write("FABRIC_RESULT chaos_pool_kill %s\n"
+                     % json.dumps(out["chaos_pool_kill"]))
+    base = out["static_3_pool"]["p99_latency_steps"] or 1
+    if out["scale_1_3_1"]["p99_latency_steps"] is not None:
+        out["p99_ratio_scaled_vs_static"] = round(
+            out["scale_1_3_1"]["p99_latency_steps"] / float(base), 3)
     return out
 
 

@@ -42,7 +42,13 @@ lifetime so reconnects keep the schedule deterministic):
                   journal's crc-framed tail-skip discipline
 
 Process-level chaos (SIGKILL of cluster children) lives in launch.py's
-kill helpers; this module only does wire-level faults.
+kill helpers.  One NON-wire action rides the same schedule machinery:
+``pool_kill`` on the ``fabric`` direction (serving/router.py consumes
+one fabric slot per router step) kills a serving pool's step loop —
+SIGKILL-equivalent death inside the serving fabric — so fabric chaos
+legs pin to the same ``PADDLE_TPU_FAULT_SEED`` as the pserver suite.
+``pool_kill:<pid>`` pins the victim; bare ``pool_kill`` lets the router
+pick one deterministically from ``delay_fraction(idx)``.
 """
 
 import socket
@@ -51,7 +57,19 @@ import threading
 
 _LEN = struct.Struct(">Q")
 
-ACTIONS = ("pass", "drop", "delay", "dup", "truncate", "corrupt")
+ACTIONS = ("pass", "drop", "delay", "dup", "truncate", "corrupt",
+           "pool_kill")
+
+# wire faults make no sense inside the fabric scheduler and vice versa
+_FABRIC_ACTIONS = ("pass", "pool_kill")
+
+
+def _valid_action(action):
+    if action in ACTIONS:
+        return True
+    # explicit victim form: pool_kill:<pid>
+    base, sep, arg = str(action).partition(":")
+    return base == "pool_kill" and sep and arg.isdigit()
 
 
 class FaultSchedule:
@@ -68,30 +86,36 @@ class FaultSchedule:
     red run reproduces bit-for-bit (scripts/ci.sh)."""
 
     def __init__(self, schedule=None, seed=None, drop=0.0, delay=0.0,
-                 dup=0.0, truncate=0.0, corrupt=0.0):
+                 dup=0.0, truncate=0.0, corrupt=0.0, pool_kill=0.0):
         import os
         import random
 
         if seed is None:
             seed = int(os.environ.get("PADDLE_TPU_FAULT_SEED", "0"))
-        self._explicit = {"c2s": {}, "s2c": {}}
+        self._explicit = {"c2s": {}, "s2c": {}, "fabric": {}}
         for direction, frames in (schedule or {}).items():
             if direction not in self._explicit:
-                raise ValueError("direction must be c2s|s2c, got %r"
+                raise ValueError("direction must be c2s|s2c|fabric, got %r"
                                  % direction)
             for idx, action in frames.items():
-                if action not in ACTIONS:
+                if not _valid_action(action):
                     raise ValueError("unknown fault action %r" % action)
+                is_fabric = str(action).partition(":")[0] in _FABRIC_ACTIONS
+                if (direction == "fabric") != is_fabric and action != "pass":
+                    raise ValueError(
+                        "action %r is not valid on direction %r"
+                        % (action, direction))
                 self._explicit[direction][int(idx)] = action
         self._rates = (
             ("drop", float(drop)), ("delay", float(delay)),
             ("dup", float(dup)), ("truncate", float(truncate)),
             ("corrupt", float(corrupt)),
         )
+        self._fabric_rates = (("pool_kill", float(pool_kill)),)
         self._seed = int(seed)
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
-        self._counters = {"c2s": 0, "s2c": 0}
+        self._counters = {"c2s": 0, "s2c": 0, "fabric": 0}
 
     def delay_fraction(self, idx):
         """Deterministic per-frame latency fraction in (0, 1]: a
@@ -119,7 +143,9 @@ class FaultSchedule:
             # reproducible fault sequence
             roll = self._rng.random()
             acc = 0.0
-            for name, rate in self._rates:
+            rates = (self._fabric_rates if direction == "fabric"
+                     else self._rates)
+            for name, rate in rates:
                 acc += rate
                 if roll < acc:
                     return idx, name

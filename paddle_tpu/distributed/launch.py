@@ -113,7 +113,8 @@ class _ScalingPolicy:
 
     def __init__(self, min_t, max_t, cooldown_s=3.0, hysteresis=2,
                  straggler_frac=0.5, budget=None, min_ps=None,
-                 max_ps=None, queue_hi=None):
+                 max_ps=None, queue_hi=None, min_pools=None,
+                 max_pools=None, occ_hi=0.85, occ_lo=0.25):
         assert 1 <= int(min_t) <= int(max_t), (min_t, max_t)
         self.min_t = int(min_t)
         self.max_t = int(max_t)
@@ -143,6 +144,23 @@ class _ScalingPolicy:
         self._ps_lo_streak = 0
         self._last_parks = None
         self._last_drops = None
+        # ---- load-aware SERVING-POOL scaling (serving fabric) -------
+        # third axis of the SAME policy instance: the supervisor polls
+        # the FabricRouter's `stats` verb (the router speaks the same
+        # shape the pservers do) and feeds fabric load here — queue
+        # depth, mean occupancy, rejection and re-placement counters.
+        # One shared cooldown + ONE action budget across trainers,
+        # pservers, and pools: the three axes cannot fight each other,
+        # because every membership change anywhere draws from the same
+        # allowance.
+        self.min_pools = int(min_pools) if min_pools is not None else None
+        self.max_pools = int(max_pools) if max_pools is not None else None
+        self.occ_hi = float(occ_hi)
+        self.occ_lo = float(occ_lo)
+        self._pool_hi_streak = 0
+        self._pool_lo_streak = 0
+        self._last_rejected = None
+        self._last_replaced = None
 
     def observe_ps_load(self, ps_count, load, n_trainers=2):
         """One pserver-load observation -> optional pserver action.
@@ -201,6 +219,65 @@ class _ScalingPolicy:
         self._last_action = now
         self._ps_hi_streak = 0
         self._ps_lo_streak = 0
+        return action
+
+    def observe_pool_load(self, n_pools, load):
+        """One fabric-load observation -> optional serving-pool action.
+        `load` is the FabricRouter's stats(): {"queue_depth": fabric
+        admission queue, "occupancy": mean live-pool slot occupancy,
+        "rejected"/"replaced": cumulative counters (diffed here)}.
+        Returns ("grow_pool", None), ("shrink_pool", None) or None.
+        Shares the cooldown and the action budget with the trainer and
+        pserver axes — ONE membership change at a time, fabric-wide."""
+        if self.min_pools is None or self.max_pools is None or not load:
+            return None
+        now = time.monotonic()
+        qd = int(load.get("queue_depth", 0))
+        occ = float(load.get("occupancy", 0.0))
+        rej = int(load.get("rejected", 0))
+        repl = int(load.get("replaced", 0))
+        rej_d = rej - (self._last_rejected
+                       if self._last_rejected is not None else rej)
+        repl_d = repl - (self._last_replaced
+                         if self._last_replaced is not None else repl)
+        self._last_rejected, self._last_replaced = rej, repl
+        if repl_d > 0:
+            # re-placements mean a pool just died and its requests are
+            # re-decoding on survivors: occupancy/queue measured mid-
+            # failover would read as organic pressure and thrash
+            self._pool_hi_streak = 0
+            self._pool_lo_streak = 0
+            return None
+        if qd > 0 or occ >= self.occ_hi or rej_d > 0:
+            self._pool_hi_streak += 1
+            self._pool_lo_streak = 0
+        elif occ <= self.occ_lo:
+            self._pool_lo_streak += 1
+            self._pool_hi_streak = 0
+        else:
+            self._pool_hi_streak = 0
+            self._pool_lo_streak = 0
+        if now - self._last_action < self.cooldown_s:
+            return None
+        action = None
+        if (self._pool_hi_streak >= self.hysteresis
+                and n_pools < self.max_pools):
+            action = ("grow_pool", None)
+        elif (self._pool_lo_streak >= 2 * self.hysteresis
+                and n_pools > self.min_pools):
+            # retiring a pool drains every in-flight request off it:
+            # ask for twice the evidence a grow needs
+            action = ("shrink_pool", None)
+        if action is None:
+            return None
+        if self.budget.next_delay() is None:
+            sys.stderr.write(
+                "[launch] elastic pool action %r suppressed: action "
+                "budget exhausted (flap damping)\n" % (action[0],))
+            return None
+        self._last_action = now
+        self._pool_hi_streak = 0
+        self._pool_lo_streak = 0
         return action
 
     def decide(self, live_tags, rates):
@@ -917,12 +994,96 @@ def _start_pserver_elastic_loop(cluster, common, script_argv, base_tags,
                      name="elastic-pserver-policy").start()
 
 
+def _start_pool_elastic_loop(cluster, router_ep, min_pools, max_pools,
+                             schedule, cooldown, stop_evt, policy,
+                             nproc=2):
+    """Serving-pool loop of the UNIFIED supervisor (`--serve-pools
+    MIN:MAX` against a `--serve-router` control endpoint): polls the
+    FabricRouter's `stats` verb — the same verb shape the pserver axis
+    polls — and applies grow/shrink through the router's `scale_pools`
+    verb.  `--pool-schedule T:+N,T:-N` (seconds since start) replaces
+    the observational policy with deterministic timed actions, the
+    fabric's chaos/bench driver.  The policy instance is SHARED with
+    the trainer and pserver axes: one cooldown, one action budget —
+    three axes that cannot fight."""
+    from .rpc import RPCClient
+
+    sched = []
+    for spec in (schedule or "").split(","):
+        spec = spec.strip()
+        if spec:
+            t_s, _, d = spec.partition(":")
+            sched.append([float(t_s), int(d)])
+    sched.sort(key=lambda e: e[0])
+    scheduled_only = bool(sched)
+    t_start = time.monotonic()
+
+    def poll_stats(timeout=1.5):
+        cli = RPCClient(router_ep, timeout=1.0, retries=1,
+                        retry_wait=0.05)
+        try:
+            s = cli.call("stats", deadline_s=timeout)
+            return s if isinstance(s, dict) else None
+        except Exception:
+            return None
+        finally:
+            cli.close()
+
+    def scale(delta, reason):
+        sys.stderr.write("[launch] ELASTIC POOL SCALE %+d (%s)\n"
+                         % (delta, reason))
+        cli = RPCClient(router_ep, timeout=2.0, retries=2,
+                        retry_wait=0.1)
+        try:
+            cli.call("scale_pools", delta=int(delta), deadline_s=5.0)
+        except Exception as e:
+            sys.stderr.write("[launch] pool scale failed: %r\n" % (e,))
+        finally:
+            cli.close()
+
+    def loop():
+        while not stop_evt.wait(0.5):
+            if cluster is not None and (cluster._closing.is_set()
+                                        or cluster.failed_rc is not None):
+                return
+            now = time.monotonic()
+            if sched and now - t_start >= sched[0][0]:
+                scale(sched.pop(0)[1], "scheduled")
+                continue
+            if scheduled_only:
+                continue
+            load = poll_stats()
+            if load is None:
+                continue
+            act = policy.observe_pool_load(
+                int(load.get("n_pools", 0)), load)
+            if act is None:
+                continue
+            scale(+1 if act[0] == "grow_pool" else -1,
+                  "policy: qd=%s occ=%s rej=%s"
+                  % (load.get("queue_depth"), load.get("occupancy"),
+                     load.get("rejected")))
+
+    def run():
+        try:
+            loop()
+        except Exception:
+            import traceback
+
+            sys.stderr.write("[launch] elastic pool loop died:\n")
+            traceback.print_exc()
+
+    threading.Thread(target=run, daemon=True,
+                     name="elastic-pool-policy").start()
+
+
 def launch_pserver(script_argv, nproc, n_pservers, base_env=None, sync=True,
                    chaos_kills=None, supervise=False, max_restarts=3,
                    restart_window=60.0, restart_backoff=0.5, ckpt_dir=None,
                    staleness_bound=None, elastic=None, elastic_schedule=None,
                    elastic_cooldown=3.0, elastic_pservers=None,
-                   pserver_schedule=None):
+                   pserver_schedule=None, serve_router=None,
+                   serve_pools=None, pool_schedule=None):
     if elastic_schedule and not elastic:
         # fail BEFORE any child spawns: a dropped schedule would run a
         # clean "no regression" job in which the membership trace under
@@ -936,6 +1097,24 @@ def launch_pserver(script_argv, nproc, n_pservers, base_env=None, sync=True,
             "--pserver-schedule requires --elastic-pservers MIN:MAX: "
             "the schedule drives the pserver-migration machinery and "
             "alone would be silently ignored")
+    if serve_pools and not serve_router:
+        raise ValueError(
+            "--serve-pools MIN:MAX requires --serve-router ENDPOINT: "
+            "the supervisor scales pools through the router's control "
+            "verbs and has nowhere to send them")
+    if pool_schedule and not serve_pools:
+        raise ValueError(
+            "--pool-schedule requires --serve-pools MIN:MAX: the "
+            "schedule drives the fabric-scaling machinery and alone "
+            "would be silently ignored")
+    min_pools = max_pools = None
+    if serve_pools:
+        min_pools, max_pools = (int(x)
+                                for x in str(serve_pools).split(":"))
+        if not (1 <= min_pools <= max_pools):
+            raise ValueError(
+                "--serve-pools MIN:MAX must satisfy 1 <= MIN <= MAX "
+                "(got %s)" % serve_pools)
     min_ps = max_ps = None
     if elastic_pservers:
         min_ps, max_ps = (int(x) for x in str(elastic_pservers).split(":"))
@@ -1156,16 +1335,21 @@ def launch_pserver(script_argv, nproc, n_pservers, base_env=None, sync=True,
             cluster.supervise("trainer.%d" % rank, cmd, env, _policy())
         cluster.spawn("trainer.%d" % rank, cmd, env)
     stop_elastic = threading.Event()
-    # ONE policy instance spans both elastic axes when both are armed:
-    # the cooldown and the action budget are shared, so a trainer
-    # grow/shrink and a pserver shard migration cannot fire in the same
-    # window — one membership change at a time, as the damping promises
+    # ONE policy instance spans every armed elastic axis (trainers,
+    # pservers, serving pools): the cooldown and the action budget are
+    # shared, so a trainer grow/shrink, a pserver shard migration, and
+    # a pool scale cannot fire in the same window — one membership
+    # change at a time, as the damping promises
     shared_policy = None
-    if elastic and elastic_pservers:
-        emin, emax = (int(x) for x in str(elastic).split(":"))
+    n_axes = sum(1 for x in (elastic, elastic_pservers, serve_pools)
+                 if x)
+    if n_axes >= 2:
+        emin, emax = ((int(x) for x in str(elastic).split(":"))
+                      if elastic else (1, max(1, nproc)))
         shared_policy = _ScalingPolicy(
             emin, emax, cooldown_s=elastic_cooldown,
-            min_ps=min_ps, max_ps=max_ps)
+            min_ps=min_ps, max_ps=max_ps,
+            min_pools=min_pools, max_pools=max_pools)
     if elastic:
         _start_elastic_loop(cluster, common, script_argv, nproc, elastic,
                             elastic_schedule, elastic_cooldown,
@@ -1180,6 +1364,13 @@ def launch_pserver(script_argv, nproc, n_pservers, base_env=None, sync=True,
             cluster, common, script_argv, base_tags, spare, min_ps,
             max_ps, pserver_schedule, elastic_cooldown, supervise,
             _policy, stop_elastic, nproc, policy=shared_policy)
+    if serve_pools:
+        pool_policy = shared_policy or _ScalingPolicy(
+            1, max(1, nproc), cooldown_s=elastic_cooldown,
+            min_pools=min_pools, max_pools=max_pools)
+        _start_pool_elastic_loop(
+            cluster, serve_router, min_pools, max_pools, pool_schedule,
+            elastic_cooldown, stop_elastic, pool_policy, nproc)
     _arm_chaos(cluster, chaos_kills)
     try:
         return cluster.wait()
@@ -1430,6 +1621,27 @@ def main(argv=None):
         "harness)",
     )
     parser.add_argument(
+        "--serve-router", default=None, metavar="HOST:PORT",
+        help="serving-fabric control endpoint (a FabricRouter's "
+        "serve_control server): the supervisor polls its `stats` verb "
+        "— the same shape the pserver axis polls — and scales pools "
+        "through `scale_pools`, making serving the THIRD axis of the "
+        "one shared policy/budget (docs/SERVING.md 'Serving fabric')",
+    )
+    parser.add_argument(
+        "--serve-pools", default=None, metavar="MIN:MAX",
+        help="elastic serving-pool bounds against --serve-router: grow "
+        "on fabric pressure (queue depth / occupancy / rejections), "
+        "drain-and-retire on sustained idleness, sharing ONE cooldown "
+        "and action budget with the trainer and pserver axes",
+    )
+    parser.add_argument(
+        "--pool-schedule", default=None, metavar="T:+N,T:-N",
+        help="deterministic serving-pool driver: at T seconds after "
+        "launch, add (+N) or drain (-N) pools through the same router "
+        "verbs the load policy uses (fabric bench/chaos harness)",
+    )
+    parser.add_argument(
         "--staleness-bound", type=int, default=None, metavar="STEPS",
         help="async pserver mode: arm FLAGS_async_staleness_bound in "
         "every child — pservers park pushes/prefetches from a trainer "
@@ -1492,6 +1704,9 @@ def main(argv=None):
             elastic_cooldown=args.elastic_cooldown,
             elastic_pservers=args.elastic_pservers,
             pserver_schedule=args.pserver_schedule,
+            serve_router=args.serve_router,
+            serve_pools=args.serve_pools,
+            pool_schedule=args.pool_schedule,
         )
     return rc
 

@@ -2257,18 +2257,29 @@ class ParameterServer:
                     # migrated-away shard under a pre-flip dispatch: the
                     # async sender must re-plan and re-ship to the new
                     # owner (dropped here, never applied — and never
-                    # journaled, so replay can't resurrect it either)
+                    # journaled, so replay can't resurrect it either).
+                    # dropped_aseq echoes the victim so the trainer's
+                    # dense resend queue re-ships EXACTLY the dropped
+                    # buckets (an applied-but-unacked one must not be
+                    # re-shipped under a fresh aseq — that would bypass
+                    # the dedup fence and double-apply)
                     return self._plan_reply_locked(
                         {"ok": True, "stale_plan": True,
+                         "dropped_aseq": aseq,
                          "pepoch": self._plan_epoch})
                 if aseq is not None and self._dense_fence_is_dup(tid, aseq):
                     # at-least-once re-delivery (RPC retry straddling a
                     # restart, or an incarnation-bump re-ship) of a bucket
                     # whose apply is already durable: drop, never double
                     self.counters["dedup_drops"] += 1
+                    # dense_acked names the DENSE fence explicitly: the
+                    # trainer drains this reply from a pipelined window
+                    # mixed with other verbs' acks, and its dense resend
+                    # queue must only prune on dense high-water
                     return self._plan_reply_locked(
                         {"ok": True, "dup": True,
-                         "acked": self._dense_fence[tid][0]})
+                         "acked": self._dense_fence[tid][0],
+                         "dense_acked": self._dense_fence[tid][0]})
                 # NOTE: aseq never feeds _trainer_clock — it counts
                 # BUCKETS per endpoint, not steps, so a multi-bucket
                 # model would inflate a laggard's clock by the bucket
@@ -2287,7 +2298,8 @@ class ParameterServer:
                     self._dense_fence_commit(tid, aseq)
                     self._async_dense_ckpt_locked()
                     return self._plan_reply_locked(
-                        {"ok": True, "acked": self._dense_fence[tid][0]})
+                        {"ok": True, "acked": self._dense_fence[tid][0],
+                         "dense_acked": self._dense_fence[tid][0]})
                 self._journal_append_locked(
                     {"k": "d", "b": vals, "tid": tid, "q": None})
                 self._async_dense_ckpt_locked()

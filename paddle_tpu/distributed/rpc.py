@@ -156,6 +156,9 @@ _comm_stats = {"rpc_round_trips": 0, "comm_bytes_sent": 0,
                "recoveries": 0, "recovery_ms": 0.0,
                "async_sparse_sends": 0, "async_dedup_drops": 0,
                "async_resends": 0,
+               # dense buckets re-shipped after a plan flip dropped
+               # them as stale (ops/dist_ops.py _async_replay_dense)
+               "async_dense_resends": 0,
                # elastic autoscaling (docs/FAULT_TOLERANCE.md): plan
                # re-derivations this trainer performed after observing a
                # new pserver plan epoch, their total latency, and

@@ -139,8 +139,13 @@ _MAX_ROUND_REPLAYS = 6
 # before any new traffic — at-least-once delivery that the server's seq
 # fence + write-ahead journal turn into exactly-once across SIGKILL
 # (docs/FAULT_TOLERANCE.md).  Dense async buckets carry their own aseq
-# token; their re-delivery rides the RPC-layer retry (the reply IS the
-# ack — a call never returns unacked), deduped by the same server fence.
+# token and sit in their own bounded resend queue (udense) until the
+# drained reply's dense_acked high-water prunes them: restart
+# re-delivery rides the RPC-layer retry (deduped by the server fence),
+# and a PLAN FLIP (live shard migration) re-ships exactly the buckets
+# the old owner dropped as stale — regrouped under the new dispatch, so
+# a mid-flip restart loses zero acked-but-unapplied dense updates (the
+# former sparse-only known limit, closed).
 _ASYNC_RESEND_MAX = 256
 
 
@@ -153,6 +158,8 @@ def _async_st(ep):
         st["aseq"] = 0      # dense async bucket seq
         st["sseq"] = {}     # table -> last minted sparse seq
         st["unacked"] = {}  # table -> {seq: send_sparse kwargs}
+        st["udense"] = {}   # aseq -> un-acked dense bucket blocks
+        st["adropped"] = set()  # aseqs the server dropped as stale_plan
     return st
 
 
@@ -466,10 +473,74 @@ def _drain_plan_checked(pipe, ep, trainer_id, stale_plan=None):
     for r in results:
         _check_not_evicted(r, ep, trainer_id)
         _note_plan(ep, r)
-        if stale_plan is not None and isinstance(r, dict) \
-                and r.get("stale_plan"):
+        if not isinstance(r, dict):
+            continue
+        da = r.get("dense_acked")
+        if da is not None:
+            # dense ack high-water: prune the async dense resend queue
+            # (contiguous fence only — an applied-ahead-of-a-gap bucket
+            # stays queued; re-delivery is deduped server-side)
+            ud = _async_st(ep)["udense"]
+            for q in [q for q in ud if q <= int(da)]:
+                del ud[q]
+        if stale_plan is not None and r.get("stale_plan"):
             stale_plan.add(ep)
+            if r.get("dropped_aseq") is not None:
+                _async_st(ep)["adropped"].add(int(r["dropped_aseq"]))
     return results
+
+
+def _async_replay_dense(pipe, plan_rt, trainer_id, stale_eps):
+    """Plan-flip dense re-ship (closes the PR 15 known limit: only
+    sparse chunks survived a flip).  For each stale-fenced endpoint,
+    every aseq the server REPORTED dropped (adropped — never an
+    applied-but-unacked one, which would double-apply under a fresh
+    aseq) re-ships from the udense record: its blocks regroup by their
+    NEW owner under the freshly derived plan.  The group staying on the
+    old endpoint keeps the ORIGINAL aseq — it fills the fence hole the
+    drop left, unsticking the contiguous ack high-water for both sides
+    — while groups for other owners mint fresh aseqs on those streams.
+    Every re-shipped bucket re-enters its target's udense, so a crash
+    mid-recovery re-delivers and the fences dedup."""
+    from ..distributed import rpc as _rpc
+
+    derived = plan_rt.get("derived") if plan_rt else None
+    owner = {}
+    for ep, entries in (derived["send_buckets"] if derived else []):
+        for _xi, _b, _e, bn in entries:
+            owner[str(bn)] = str(ep)
+    n = 0
+    for old_ep in sorted(stale_eps):
+        st = _async_st(old_ep)
+        dropped = sorted(q for q in st["adropped"] if q in st["udense"])
+        st["adropped"].clear()
+        for q in dropped:
+            blocks = st["udense"].pop(q)
+            regroup = {}
+            for bn, v in blocks.items():
+                regroup.setdefault(owner.get(str(bn), old_ep),
+                                   {})[bn] = v
+            # the old endpoint's group ships even when EMPTY: the
+            # no-op bucket commits aseq q there, filling the hole
+            regroup.setdefault(old_ep, {})
+            for new_ep in sorted(regroup):
+                blk = regroup[new_ep]
+                nst = _async_st(new_ep)
+                if new_ep == old_ep:
+                    aseq = q
+                else:
+                    nst["aseq"] += 1
+                    aseq = nst["aseq"]
+                nst["udense"][aseq] = blk
+                pipe(new_ep).submit("send_bucket", blocks=blk,
+                                    trainer_id=trainer_id,
+                                    seq_total=None, aseq=aseq)
+                n += 1
+    if n:
+        _rpc.note_async(async_dense_resends=n)
+        print("TRAINER DENSE RESEND buckets=%d eps=%d"
+              % (n, len(stale_eps)), flush=True)
+    return n
 
 
 def _wrap_rows_wire(rows, wire_dtype):
@@ -1049,6 +1120,18 @@ def _send_bucket(ctx, ins, attrs):
                     st = _async_st(ep)
                     for blocks in blist:
                         st["aseq"] += 1
+                        if len(st["udense"]) >= _ASYNC_RESEND_MAX:
+                            raise RuntimeError(
+                                "async dense resend queue for %s "
+                                "overflowed (%d un-acked buckets): the "
+                                "pserver has not acked in %d buckets — "
+                                "failing loudly instead of dropping "
+                                "durability" % (ep, len(st["udense"]),
+                                                _ASYNC_RESEND_MAX))
+                        # recorded BEFORE the submit: a plan flip that
+                        # drops this bucket (stale shard) re-ships it
+                        # from here to the new owner
+                        st["udense"][st["aseq"]] = blocks
                         pipe(ep).submit(
                             "send_bucket", blocks=blocks,
                             trainer_id=trainer_id, seq_total=None,
@@ -1189,13 +1272,12 @@ def _recv_bucket(ctx, ins, attrs):
         elif stale_plan and plan_rt is not None:
             # async: a drained send reply was fenced (stale shard after
             # a migration flip) — re-plan NOW so the next step routes to
-            # the new owners.  The dropped bucket itself is not
-            # re-shipped: the async path applies per-arrival with no
-            # round to rebuild (one transition step's contribution to
-            # the moved shards is skipped, loudly, via the server's
-            # stale_plan_drops counter — the freeze keeps this window to
-            # at most one in-flight step).
+            # the new owners, then re-ship the DROPPED dense buckets
+            # from the udense resend queue under the new dispatch
+            # (formerly skipped — only sparse survived a flip).
+            targets = sorted(stale_plan)
             _maybe_replan(plan_rt, eps_here, trainer_id)
+            _async_replay_dense(pipe, plan_rt, trainer_id, targets)
             stale_plan.clear()
         block_vals = {}
         to_fetch = list(eps_here)

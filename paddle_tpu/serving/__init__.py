@@ -1,17 +1,22 @@
-"""Continuous-batching LLM serving (docs/SERVING.md §5).
+"""Continuous-batching LLM serving (docs/SERVING.md §5-§7).
 
 The production serving front-end over the decode-cache stack: a
 request scheduler (engine.ServingEngine) drives ONE compiled ragged
 wide-step program over a slot-based KV-cache pool — admission,
 interleaved prefill/decode, per-request sampling params, immediate
 eviction — with every request's token stream bit-identical to its
-solo run.  trace.make_poisson_trace generates the seeded open-loop
-bench/test workloads.
+solo run.  router.FabricRouter is the multi-pool front door: sticky
+placement over N engine pools, fabric-wide backpressure, drain-and-
+retire, and prefix-replay failover that extends the exactness
+contract across pool death.  trace.make_poisson_trace generates the
+seeded open-loop bench/test workloads.
 """
 
 from .engine import ServingEngine, serve_one_at_a_time
 from .pool import SlotPool
+from .router import FabricRouter, parse_pool_schedule
 from .trace import Request, make_poisson_trace
 
 __all__ = ["ServingEngine", "serve_one_at_a_time", "SlotPool",
+           "FabricRouter", "parse_pool_schedule",
            "Request", "make_poisson_trace"]

@@ -272,7 +272,12 @@ class ServingEngine:
             toks = sample_rows_keyed(
                 probs,
                 [s.req.seed for s in ss],
-                [len(s.out) for s in ss])  # request_step = token index
+                # request_step = GLOBAL token index: a failover-replayed
+                # request (router) carries the dead pool's emitted
+                # prefix inside its prompt and offsets the key base past
+                # it, so the continuation draws the solo run's tokens
+                [len(s.out) + getattr(s.req, "sample_step_base", 0)
+                 for s in ss])
             out[samp] = toks
         return out
 

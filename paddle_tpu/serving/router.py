@@ -1,0 +1,516 @@
+"""Serving fabric: a sticky multi-pool router over N ServingEngines
+(docs/SERVING.md §7) — the multi-pool front door ROADMAP item 3 names.
+
+ONE FabricRouter owns the admission queue and places each incoming
+Request onto exactly one pool (sticky slot placement): the placement
+score orders LIVE pools by (occupancy, engine backlog, pid) and a
+request only leaves its pool through failover.  Every pool is a whole
+ServingEngine in its OWN fluid.Scope (the KV-cache persistable names
+are fixed per model family, so pools sharing a scope would alias their
+slot pools) with its own Executor; the router wraps every engine call
+in scope_guard(pool.scope).  Pools advance in LOCKSTEP — one fabric
+step steps every serving pool once — so the fabric clock, every
+engine's `now`, and Request.arrival all share one virtual time axis.
+
+Degradation contract (chaos-tested, tests/test_serving_fabric.py):
+
+* Backpressure — the admission queue is the FABRIC-wide signal: an
+  arrival that finds `queue_depth` requests already waiting (no pool
+  could take them) is rejected loudly with a terminal
+  REJECTED_QUEUE_FULL at the router.  Never a hang, never an unbounded
+  queue.
+* Drain-and-retire — pool removal stops new placements, lets in-flight
+  requests finish, and only then retires the pool: no orphaned slots.
+* Failover — a pool that misses `miss_beats` health beats (its step
+  loop was killed: the `pool_kill` fault action) or whose step thread
+  DIES (raises) is declared dead; its queued requests re-enter the
+  router queue as-is and each in-flight request is RE-PLACED as a
+  replay: prompt + the emitted-token prefix becomes the new prompt,
+  the token budget shrinks by the prefix, and sample_step_base offsets
+  the sampling keys past it — so the re-decoded stream continues the
+  solo run's token sequence exactly (the PR 9 exactness contract
+  extended across failover).  Survivors see only feed-value changes:
+  zero retraces.
+
+Control plane: stats() speaks the same verb shape launch.py's
+_ScalingPolicy polls on pservers (queue depth / occupancy / rejection
+and re-placement counters), control_service() wraps the router for
+make_var_server so ONE supervisor scales trainers, pservers, and
+serving pools from shared signals, and run(pool_schedule=...) is the
+deterministic chaos/bench driver (`T:+N,T:-N` in fabric steps).
+"""
+
+import threading
+import time
+
+import numpy as np
+
+__all__ = ["FabricRouter", "parse_pool_schedule"]
+
+
+def parse_pool_schedule(spec):
+    """'T:+N,T:-N' -> [(T, delta)] sorted by T.  T is in fabric STEPS
+    for FabricRouter.run and in SECONDS for launch.py's supervisor loop
+    — same grammar as --elastic-schedule / --pserver-schedule."""
+    out = []
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if part:
+            t_s, _, d = part.partition(":")
+            out.append((float(t_s), int(d)))
+    out.sort(key=lambda e: e[0])
+    return out
+
+
+class _PoolHandle:
+    """One serving pool: engine + its private scope + health state."""
+
+    __slots__ = ("pid", "engine", "scope", "state", "killed",
+                 "missed_beats", "compile_baseline")
+
+    def __init__(self, pid, engine, scope):
+        self.pid = int(pid)
+        self.engine = engine
+        self.scope = scope
+        self.state = "live"  # live | draining | dead | retired
+        self.killed = False  # SIGKILL-equivalent: step loop stops beating
+        self.missed_beats = 0
+        self.compile_baseline = None
+
+
+class FabricRouter:
+    """pool_factory() -> (engine, scope): builds a ServingEngine whose
+    scope already holds the model weights.  Every pool must hold
+    IDENTICAL weights (same startup seed / same checkpoint) — failover
+    replays a prefix into a survivor and bit-exact continuation needs
+    the same model on both sides."""
+
+    def __init__(self, pool_factory, n_pools=1, queue_depth=None,
+                 miss_beats=2, fault_schedule=None, max_pools=8):
+        assert int(n_pools) >= 1, n_pools
+        self.pool_factory = pool_factory
+        self.queue_depth = None if queue_depth is None else int(queue_depth)
+        assert self.queue_depth is None or self.queue_depth >= 0
+        self.miss_beats = max(1, int(miss_beats))
+        self.faults = fault_schedule
+        self.max_pools = int(max_pools)
+        self.pools = {}  # pid -> _PoolHandle (dead/retired pruned)
+        self.queue = []  # router admission queue (arrival, rid) order
+        self.now = 0
+        self._next_pid = 0
+        self._step_wall = []  # shared with every engine (latency base)
+        self._results = {}
+        self._prefix = {}  # rid -> emitted tokens carried over failovers
+        self._pending_scale = []  # deltas from the control plane (RPC)
+        self._lock = threading.RLock()
+        self.counters = {"submitted": 0, "finished": 0, "rejected": 0,
+                         "expired": 0, "replaced": 0, "pool_kills": 0,
+                         "pools_added": 0, "pools_retired": 0,
+                         "pools_died": 0}
+        for _ in range(int(n_pools)):
+            self.add_pool()
+
+    # ---- pool membership -----------------------------------------------
+    def add_pool(self):
+        """Grow one pool: build it in its own scope, zero its caches,
+        and fast-forward its clock onto the fabric's step axis (a pool
+        joining at step T must admit arrivals <= T immediately)."""
+        from ..core.scope import scope_guard
+
+        with self._lock:
+            if len(self._routable()) >= self.max_pools:
+                raise RuntimeError(
+                    "fabric at max_pools=%d" % self.max_pools)
+            engine, scope = self.pool_factory()
+            pid = self._next_pid
+            self._next_pid += 1
+            with scope_guard(scope):
+                engine.exe.run(engine.cache_startup)
+            engine.now = self.now
+            engine._step_wall = self._step_wall  # one latency clock
+            self.pools[pid] = _PoolHandle(pid, engine, scope)
+            self.counters["pools_added"] += 1
+            print("FABRIC POOL ADD pid=%d step=%d" % (pid, self.now),
+                  flush=True)
+            return pid
+
+    def drain_pool(self, pid):
+        """Begin drain-and-retire: no new placements; in-flight requests
+        finish on their slots; the empty pool retires at a later step()."""
+        with self._lock:
+            h = self.pools[pid]
+            if h.state == "live":
+                h.state = "draining"
+                print("FABRIC POOL DRAIN pid=%d step=%d"
+                      % (pid, self.now), flush=True)
+
+    def kill_pool(self, pid):
+        """SIGKILL-equivalent: the pool's step loop stops responding
+        (no beats, no steps).  Death is DECLARED by the health check
+        after miss_beats missed beats — the failover path under test."""
+        with self._lock:
+            h = self.pools[pid]
+            h.killed = True
+            self.counters["pool_kills"] += 1
+            print("FABRIC POOL KILL pid=%d step=%d" % (pid, self.now),
+                  flush=True)
+
+    def _routable(self):
+        return [h for h in self.pools.values()
+                if h.state in ("live", "draining")]
+
+    def _live(self):
+        return [h for h in self.pools.values() if h.state == "live"]
+
+    def scale_pools(self, delta):
+        """Apply a pool-count delta NOW (router thread): +N adds pools,
+        -N drains the newest live pools (drain-and-retire, never a
+        kill).  The control plane (RPC verb) uses request_scale instead
+        so mutations stay on the stepping thread."""
+        delta = int(delta)
+        for _ in range(max(0, delta)):
+            if len(self._routable()) < self.max_pools:
+                self.add_pool()
+        if delta < 0:
+            victims = sorted(self._live(), key=lambda h: -h.pid)
+            keep_min = 1  # never drain the last live pool
+            n = min(-delta, max(0, len(self._live()) - keep_min))
+            for h in victims[:n]:
+                self.drain_pool(h.pid)
+
+    def request_scale(self, delta):
+        """Thread-safe scale request: queued and applied at the next
+        fabric step boundary (the supervisor's RPC thread must not
+        mutate pools mid-step)."""
+        with self._lock:
+            self._pending_scale.append(int(delta))
+
+    # ---- request intake ------------------------------------------------
+    def submit(self, req):
+        with self._lock:
+            live = {q.rid for q in self.queue}
+            for h in self._routable():
+                live.update(q.rid for q in h.engine.queue)
+                live.update(s.req.rid
+                            for _, s in h.engine.pool.active_slots())
+            if req.rid in live:
+                raise ValueError("duplicate request id %r" % (req.rid,))
+            # capacity validation against any pool's geometry (all pools
+            # share one config by construction)
+            any_pool = next(iter(self.pools.values()))
+            any_pool.engine.pool.validate(req)
+            self.queue.append(req)
+            self.queue.sort(key=lambda r: (r.arrival, r.rid))
+            self.counters["submitted"] += 1
+
+    # ---- terminal bookkeeping ------------------------------------------
+    def _terminal(self, req, status):
+        """Router-side terminal record, same shape as engine results."""
+        self.counters["rejected" if status == "REJECTED_QUEUE_FULL"
+                      else "expired"] += 1
+        print("FABRIC %s rid=%r step=%d" % (status, req.rid, self.now),
+              flush=True)
+        wall = time.time()
+        a = min(req.arrival_step, max(0, len(self._step_wall) - 1))
+        self._results[req.rid] = {
+            "tokens": np.asarray(self._prefix.get(req.rid, []), "int64"),
+            "prompt_len": int(req.prompt.size),
+            "arrival_step": req.arrival_step,
+            "admit_step": None,
+            "finish_step": self.now,
+            "status": status,
+            "latency_steps": self.now - req.arrival_step + 1,
+            "latency_s": wall - (self._step_wall[a] if self._step_wall
+                                 else wall),
+        }
+
+    def _harvest(self, h, rids):
+        """Pull terminal results out of a pool's engine, stitching the
+        failover prefix back onto replayed streams."""
+        for rid in rids:
+            r = dict(h.engine._results[rid])
+            pref = self._prefix.pop(rid, None)
+            if pref is not None:
+                r["tokens"] = np.concatenate(
+                    [np.asarray(pref, "int64"),
+                     np.asarray(r["tokens"], "int64")])
+                r["replayed"] = True
+            if r["status"] == "OK":
+                self.counters["finished"] += 1
+            else:
+                self.counters["rejected" if r["status"] ==
+                              "REJECTED_QUEUE_FULL" else "expired"] += 1
+            r["pool"] = h.pid
+            self._results[rid] = r
+
+    # ---- failover ------------------------------------------------------
+    def _declare_dead(self, h):
+        """Harvest a dead pool's queued AND in-flight requests and
+        re-place them: queued ones re-enter the router queue verbatim;
+        each in-flight one replays prompt + emitted prefix (original
+        arrival kept, so its deadline budget and queue priority are
+        unchanged)."""
+        from .trace import Request
+
+        h.state = "dead"
+        self.counters["pools_died"] += 1
+        n_q, n_f = len(h.engine.queue), len(h.engine.pool.active_slots())
+        print("FABRIC POOL DEAD pid=%d step=%d requeue=%d replay=%d"
+              % (h.pid, self.now, n_q, n_f), flush=True)
+        for req in h.engine.queue:
+            self.queue.append(req)
+        for slot, s in h.engine.pool.active_slots():
+            req = s.req
+            prior = list(self._prefix.get(req.rid, []))
+            prefix = prior + [int(t) for t in s.out]
+            self._prefix[req.rid] = prefix
+            emitted = len(s.out)
+            replay = Request(
+                rid=req.rid,
+                prompt=np.concatenate(
+                    [req.prompt, np.asarray(s.out, "int64")])
+                if emitted else req.prompt,
+                max_new_tokens=req.max_new_tokens - emitted,
+                temperature=req.temperature, top_k=req.top_k,
+                top_p=req.top_p, seed=req.seed, eos_id=req.eos_id,
+                arrival=req.arrival, deadline=req.deadline,
+                sample_step_base=req.sample_step_base + emitted)
+            self.queue.append(replay)
+            self.counters["replaced"] += 1
+            h.engine.pool.evict(slot)
+        h.engine.queue = []
+        self.queue.sort(key=lambda r: (r.arrival, r.rid))
+        self.pools.pop(h.pid, None)
+
+    # ---- placement -----------------------------------------------------
+    def _score(self, h):
+        """Placement score (lower is better): per-pool health is the
+        gate (only live pools are scored at all), then occupancy, then
+        the pool's own backlog, then pid for a stable tie-break."""
+        active = len(h.engine.pool.active_slots())
+        occ = active / float(h.engine.n_slots)
+        return (occ, len(h.engine.queue), h.pid)
+
+    def _place(self):
+        """Route due arrivals onto pools; reject past the fabric-wide
+        queue depth.  A routed request goes straight into its pool's
+        engine queue against a KNOWN free slot, so pools never build
+        private backlogs — the router's queue IS the fabric queue."""
+        still, waiting = [], 0
+        free = {h.pid: len(h.engine.pool.free_slots())
+                for h in self._live()}
+        for req in self.queue:
+            if req.arrival > self.now:
+                still.append(req)
+                continue
+            d = req.deadline
+            if d is not None and self.now >= req.arrival_step + d:
+                self._terminal(req, "DEADLINE_EXPIRED")
+                continue
+            target = None
+            for h in sorted(self._live(), key=self._score):
+                if free.get(h.pid, 0) > 0:
+                    target = h
+                    break
+            if target is not None:
+                free[target.pid] -= 1
+                target.engine.submit(req)
+            elif self.queue_depth is None or waiting < self.queue_depth:
+                waiting += 1
+                still.append(req)
+            else:
+                self._terminal(req, "REJECTED_QUEUE_FULL")
+        self.queue = still
+
+    # ---- one fabric step -----------------------------------------------
+    def step(self):
+        """Health beats -> failover -> placement -> lockstep pool steps
+        -> drain retirement.  Returns the rids that reached a terminal
+        state this fabric step."""
+        from ..core.scope import scope_guard
+
+        with self._lock:
+            self._step_wall.append(time.time())
+            for delta in self._pending_scale:
+                self.scale_pools(delta)
+            self._pending_scale = []
+            self._maybe_inject_fault()
+            terminal = []
+            # health: a killed step loop stops beating; declare death
+            # after miss_beats consecutive silent fabric steps
+            for h in list(self._routable()):
+                if h.killed:
+                    h.missed_beats += 1
+                    if h.missed_beats >= self.miss_beats:
+                        self._declare_dead(h)
+            self._place()
+            for h in list(self._routable()):
+                if h.killed:
+                    continue
+                try:
+                    with scope_guard(h.scope):
+                        done = h.engine.step()
+                except Exception as e:  # dead step thread: fail over NOW
+                    print("FABRIC POOL STEP DIED pid=%d step=%d: %r"
+                          % (h.pid, self.now, e), flush=True)
+                    self._declare_dead(h)
+                    continue
+                h.missed_beats = 0
+                if done:
+                    self._harvest(h, done)
+                    terminal.extend(done)
+                if (h.state == "draining" and not h.engine.queue
+                        and not h.engine.pool.active_slots()):
+                    h.state = "retired"
+                    self.counters["pools_retired"] += 1
+                    print("FABRIC POOL RETIRED pid=%d step=%d"
+                          % (h.pid, self.now), flush=True)
+                    self.pools.pop(h.pid, None)
+            self.now += 1
+            return terminal
+
+    def _maybe_inject_fault(self):
+        """One fault-schedule slot per fabric step ('fabric' direction):
+        a pool_kill action kills one live pool — an explicit
+        'pool_kill:<pid>' names the victim, a bare 'pool_kill' picks one
+        deterministically from the schedule's seeded per-frame hash."""
+        if self.faults is None:
+            return
+        idx, action = self.faults.next_action("fabric")
+        base, _, arg = str(action).partition(":")
+        if base != "pool_kill":
+            return
+        live = sorted(self._live(), key=lambda h: h.pid)
+        if not live:
+            return
+        if arg:
+            pid = int(arg)
+            if pid not in self.pools:
+                return
+        else:
+            pick = int(self.faults.delay_fraction(idx) * len(live))
+            pid = live[pick % len(live)].pid
+        self.kill_pool(pid)
+
+    # ---- control plane -------------------------------------------------
+    def stats(self):
+        """The supervisor's shared signal set — same verb shape the
+        PR 15 pserver scaler polls: fabric queue depth, mean live-pool
+        occupancy, cumulative rejection / re-placement counters (the
+        poller diffs them), and per-pool detail."""
+        with self._lock:
+            live = self._live()
+            occ = (sum(len(h.engine.pool.active_slots())
+                       / float(h.engine.n_slots) for h in live)
+                   / len(live)) if live else 0.0
+            sub = max(1, self.counters["submitted"])
+            per_pool = {
+                str(h.pid): {
+                    "state": h.state,
+                    "active_slots": len(h.engine.pool.active_slots()),
+                    "n_slots": h.engine.n_slots,
+                    "backlog": len(h.engine.queue),
+                    "compile_count": h.engine.exe.compile_count,
+                    # run-MEAN slot occupancy (the engine accumulates
+                    # per step) — the instantaneous active_slots reads
+                    # 0 at any quiesced boundary
+                    "mean_occupancy": round(
+                        h.engine.counters["occupancy_sum"]
+                        / max(1, h.engine.counters["steps"]), 4),
+                }
+                for h in self.pools.values()}
+            s = dict(self.counters)
+            s.update({
+                "n_pools": len(live),
+                "queue_depth": len([q for q in self.queue
+                                    if q.arrival <= self.now]),
+                "occupancy": round(occ, 4),
+                "rejection_rate": round(
+                    self.counters["rejected"] / float(sub), 4),
+                "step": self.now,
+                "pools": per_pool,
+            })
+            return s
+
+    def control_service(self):
+        """A make_var_server-compatible service: the router side of the
+        unified control plane.  Verbs: stats, scale_pools(delta),
+        drain_pool(pid), kill_pool(pid) — scale/drain/kill mutate via
+        request_scale/flags so the stepping thread applies them at a
+        step boundary."""
+        router = self
+
+        class _Control:
+            def handle(self, verb, **kw):
+                # errors ship to the client as {"__error__": ...} (the
+                # pserver convention): raising here would only drop the
+                # connection and surface as a retry timeout
+                try:
+                    if verb == "stats":
+                        return router.stats()
+                    if verb == "scale_pools":
+                        router.request_scale(int(kw.get("delta", 0)))
+                        return {"ok": True,
+                                "n_pools": len(router._live())}
+                    if verb == "drain_pool":
+                        with router._lock:
+                            router.drain_pool(int(kw["pid"]))
+                        return {"ok": True}
+                    if verb == "kill_pool":
+                        with router._lock:
+                            router.kill_pool(int(kw["pid"]))
+                        return {"ok": True}
+                    raise ValueError(
+                        "unknown fabric verb %r" % (verb,))
+                except Exception as e:
+                    return {"__error__": "%s" % (e,)}
+
+        return _Control()
+
+    def serve_control(self, endpoint="127.0.0.1:0"):
+        """Expose the control plane over RPC (threaded VarServer): the
+        remote half of launch.py's --serve-router supervision."""
+        from ..distributed.rpc import make_var_server
+
+        srv = make_var_server(endpoint, self.control_service())
+        srv.start()
+        return srv
+
+    # ---- episode driver ------------------------------------------------
+    def run(self, requests=None, max_steps=100000, pool_schedule=None):
+        """Serve `requests` to completion (plus anything queued).
+        `pool_schedule` = [(fabric_step, delta)] or a 'T:+N,T:-N'
+        string — the deterministic chaos/bench driver riding the exact
+        scale_pools machinery the supervisor uses.  Returns (results,
+        stats)."""
+        if isinstance(pool_schedule, str):
+            pool_schedule = parse_pool_schedule(pool_schedule)
+        sched = sorted(pool_schedule or [], key=lambda e: e[0])
+        for r in requests or []:
+            self.submit(r)
+        t0 = time.time()
+        while True:
+            with self._lock:
+                busy = bool(self.queue) or any(
+                    h.engine.queue or h.engine.pool.active_slots()
+                    for h in self._routable())
+                pending = bool(sched) or bool(self._pending_scale)
+            if not busy and not pending:
+                break
+            while sched and sched[0][0] <= self.now:
+                self.scale_pools(sched.pop(0)[1])
+            self.step()
+            if self.now >= max_steps:
+                raise RuntimeError(
+                    "fabric exceeded max_steps=%d with work pending"
+                    % max_steps)
+        wall = time.time() - t0
+        stats = self.stats()
+        stats["wall_s"] = round(wall, 4)
+        new_tokens = sum(
+            int(np.asarray(r["tokens"]).size)
+            for r in self._results.values() if r["status"] == "OK")
+        stats["new_tokens"] = new_tokens
+        stats["tokens_per_s"] = (round(new_tokens / wall, 1)
+                                 if wall else 0.0)
+        return dict(self._results), stats

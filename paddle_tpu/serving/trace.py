@@ -22,7 +22,7 @@ class Request:
 
     def __init__(self, rid, prompt, max_new_tokens, temperature=1.0,
                  top_k=0, top_p=1.0, seed=None, eos_id=None, arrival=0.0,
-                 deadline=None):
+                 deadline=None, sample_step_base=0):
         self.rid = rid
         self.prompt = np.asarray(prompt, "int64").reshape(-1)
         assert self.prompt.size >= 1, (
@@ -40,6 +40,13 @@ class Request:
         # it with a terminal DEADLINE_EXPIRED status (None = no budget)
         self.deadline = None if deadline is None else int(deadline)
         assert self.deadline is None or self.deadline >= 1, deadline
+        # failover replay (serving/router.py): a re-placed request's
+        # prompt already CONTAINS the tokens the dead pool emitted, so
+        # its sampling keys must start at the global token index, not 0
+        # — fold_in(seed, base + request_step) keeps the re-decoded
+        # stream on the solo run's sample sequence
+        self.sample_step_base = int(sample_step_base)
+        assert self.sample_step_base >= 0, sample_step_base
 
     @property
     def greedy(self):

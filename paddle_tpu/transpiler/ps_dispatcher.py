@@ -52,6 +52,59 @@ class HashName(PSDispatcher):
                 for v in varlist]
 
 
+class ConsistentHash(PSDispatcher):
+    """Movement-minimizing hash-ring placement for ELASTIC worlds.
+
+    SizeWeighted re-packs from scratch on every world change, shuffling
+    shards between SURVIVING pservers (each shuffle is a live-migration
+    handoff it never needed).  Here every endpoint owns VNODES points on
+    a 32-bit ring (hashed with HashName's python-hash-free djb2, so the
+    ring survives PYTHONHASHSEED and reruns); a block lands on the first
+    vnode clockwise of its name hash.  Adding or removing an endpoint
+    only reassigns the blocks whose arc that endpoint's vnodes cover —
+    in expectation S/N shards move, and the 3->4->3 walk in
+    tests/test_dist_transpiler.py pins moved <= ceil(S/N) per step.
+    Selected like any dispatcher: flags={"split_method":
+    "ConsistentHash"} through transpile/derive_plan."""
+
+    VNODES = 64  # vnodes per endpoint: ring smoothness vs ring size
+
+    @staticmethod
+    def _point(s):
+        # djb2 barely avalanches near-identical strings (endpoints
+        # differ in one digit), which collapses every vnode cluster onto
+        # one endpoint — a murmur3-style 32-bit finalizer spreads them
+        h = HashName._hash(s)
+        h ^= h >> 16
+        h = (h * 0x85EBCA6B) & 0xFFFFFFFF
+        h ^= h >> 13
+        h = (h * 0xC2B2AE35) & 0xFFFFFFFF
+        h ^= h >> 16
+        return h
+
+    def __init__(self, pserver_endpoints):
+        super().__init__(pserver_endpoints)
+        ring = []
+        for ep in self._eps:
+            for v in range(self.VNODES):
+                ring.append((self._point("%s#%d" % (ep, v)), ep))
+        # ties (two vnodes, one hash) break by endpoint order: stable
+        # across roles, independent of the eps list's ordering
+        ring.sort()
+        self._ring = ring
+
+    def dispatch(self, varlist):
+        import bisect
+
+        keys = [h for h, _ in self._ring]
+        out = []
+        for v in varlist:
+            h = self._point(HashName._key(v))
+            i = bisect.bisect_right(keys, h) % len(self._ring)
+            out.append(self._ring[i][1])
+        return out
+
+
 class SizeWeighted(PSDispatcher):
     """Greedy bin-pack by block size: each block lands on the currently
     least-loaded endpoint (stable tie-break = endpoint order), with load

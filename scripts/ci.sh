@@ -156,6 +156,22 @@ FLAGS_kernel_tune_cache=tests/data/ci_tuning_cache.json \
     python -m pytest tests/test_serving_tp.py tests/test_serving.py \
     -q -m ""
 
+echo "== fabric-chaos pass (multi-pool router degradation) =="
+# the serving fabric end to end under the SAME pinned fault seed:
+# kill-a-pool-mid-stream failover (affected requests finish on
+# survivors, streams token-identical to solo, zero survivor retraces),
+# the seeded victim pick, drain-and-retire, fabric backpressure,
+# router-side deadlines, the control-plane RPC verbs, the unified
+# three-axis supervisor (one cooldown + one action budget), the dense
+# aseq resend queue across a plan flip, the consistent-hash shard walk,
+# and the slow-marked 1->3->1 scale walk (-m "") that tier-1's time
+# budget keeps out
+python -m pytest tests/test_serving_fabric.py -q -m ""
+python -m pytest tests/test_fault_tolerance.py -q -m "" \
+    -k "async_dense or plan_flip"
+python -m pytest tests/test_dist_transpiler.py -q -m "" \
+    -k "consistent_hash"
+
 echo "== serving pass (continuous-batching churn exactness) =="
 # the slot-pool engine's core contract on a short seeded CPU trace
 # (small GPT2Config, pool B=4): every request's tokens bit-identical

@@ -1051,3 +1051,71 @@ def test_elastic_pserver_program_is_empty_and_plan_stamped():
 
     with _pytest.raises(ValueError):
         t.get_elastic_pserver_program("127.0.0.1:7001")  # base ep
+
+def test_consistent_hash_dispatcher_stable_and_balanced():
+    """ConsistentHash places by name on a vnode ring: placement is a
+    pure function of (endpoint set, block name) — instance-independent,
+    reset-independent, PYTHONHASHSEED-independent — and the finalized
+    ring spreads realistic near-identical endpoint strings instead of
+    collapsing onto one server."""
+    from paddle_tpu.transpiler.ps_dispatcher import ConsistentHash
+
+    eps = ["10.0.0.%d:6000" % i for i in range(1, 4)]
+
+    class Blk:
+        def __init__(self, name):
+            self.block_name = name
+
+    blocks = [Blk("w%d.block%d" % (p, b))
+              for p in range(4) for b in range(5)]
+    d1, d2 = ConsistentHash(eps), ConsistentHash(list(eps))
+    placed = d1.dispatch(blocks)
+    assert placed == d2.dispatch(blocks)
+    d1.reset()
+    assert placed == d1.dispatch(blocks)
+    # every endpoint gets SOME share (the djb2-only ring collapsed
+    # near-identical endpoint strings onto a single server)
+    assert set(placed) == set(eps)
+
+
+def test_consistent_hash_plan_walk_moves_bounded_and_restores():
+    """ACCEPTANCE (satellite): a 3 -> 4 -> 3 endpoint-world walk under
+    `split_method: "ConsistentHash"` moves at most ceil(S/N) of the S
+    shard blocks per membership step — every 3->4 move lands ON the
+    added endpoint and every 4->3 move comes FROM the removed one (no
+    survivor-to-survivor churn, each such move being a live-migration
+    handoff the fabric never needed) — and removing the added endpoint
+    restores the original placement exactly."""
+    import math
+
+    from paddle_tpu.transpiler.distribute_transpiler import derive_plan
+
+    eps3 = ["10.0.0.%d:6000" % i for i in range(1, 4)]
+    eps4 = eps3 + ["10.0.0.4:6000"]
+    spec = {"params": [["w0", [64, 8], "float32", "w0@GRAD"],
+                       ["w1", [48, 8], "float32", "w1@GRAD"],
+                       ["w2", [32, 4], "float32", "w2@GRAD"],
+                       ["b0", [16], "float32", "b0@GRAD"]],
+            "endpoints": eps3, "trainers": 2,
+            "flags": {"slice_var_up": True, "min_block_size": 4,
+                      "split_method": "ConsistentHash",
+                      "comm_bucket_bytes": 4096,
+                      "comm_wire_dtype": "float32",
+                      "comm_grad_int8": False}}
+    a = derive_plan(spec)["block_eps"]
+    b = derive_plan(spec, world={"endpoints": eps4})["block_eps"]
+    c = derive_plan(spec, world={"endpoints": eps3})["block_eps"]
+    S = len(a)
+    assert S >= 12 and set(a) == set(b) == set(c)  # stable shard ids
+    bound = math.ceil(S / 4.0)
+    moved_up = [k for k in a if a[k] != b[k]]
+    moved_dn = [k for k in b if b[k] != c[k]]
+    assert 1 <= len(moved_up) <= bound, (len(moved_up), bound)
+    assert 1 <= len(moved_dn) <= bound, (len(moved_dn), bound)
+    assert all(b[k] == eps4[3] for k in moved_up), \
+        "a grow moved a shard between SURVIVORS"
+    assert all(b[k] == eps4[3] for k in moved_dn), \
+        "a shrink moved a shard a removal did not force"
+    assert a == c, "3 -> 4 -> 3 must restore the placement exactly"
+    # the walked worlds stay whole: every live endpoint serves blocks
+    assert set(b.values()) == set(eps4)

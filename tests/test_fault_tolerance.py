@@ -1919,9 +1919,12 @@ def test_async_dense_bucket_fence_out_of_order_and_dup(tmp_path):
         lambda name, value, _a=applied: _a.append(
             (name, float(np.asarray(value).reshape(-1)[0])))
     r = ps._h_send_bucket({"g0": np.full(2, 2.0)}, trainer_id=0, aseq=2)
-    assert r == {"ok": True, "acked": 0}  # gap: fence waits for aseq 1
+    # gap: fence waits for aseq 1 (dense_acked names the dense fence
+    # explicitly for the trainer's resend-queue pruner)
+    assert r == {"ok": True, "acked": 0, "dense_acked": 0}
     r = ps._h_send_bucket({"g0": np.full(2, 1.0)}, trainer_id=0, aseq=1)
-    assert r == {"ok": True, "acked": 2}  # gap filled: fence jumps to 2
+    # gap filled: fence jumps to 2
+    assert r == {"ok": True, "acked": 2, "dense_acked": 2}
     assert applied == [("g0", 2.0), ("g0", 1.0)]
     # RPC-retry re-delivery straddling a restart: dropped, counted
     r = ps._h_send_bucket({"g0": np.full(2, 1.0)}, trainer_id=0, aseq=1)
@@ -3368,3 +3371,153 @@ def test_launch_accepts_collective_elastic_single_process(monkeypatch):
         # pserver-schedule without the elastic-pservers range: loud
         launch_mod.launch_pserver(["x.py"], 1, 1,
                                   pserver_schedule="1:+1")
+
+
+# ---------------------------------------------------------------------------
+# async dense buckets across a plan flip (the closed PR 15 known limit)
+# ---------------------------------------------------------------------------
+
+def test_async_dense_stale_drop_echoes_victim_and_fence():
+    """Server side of the dense-resend contract: a migrated-away shard
+    under a pre-flip dispatch is dropped (never applied, never
+    journaled) with the victim `dropped_aseq` echoed; dup and applied
+    replies name the DENSE fence explicitly (`dense_acked`); and an
+    EMPTY bucket at a dropped aseq is the hole-filler that unsticks the
+    contiguous fence."""
+    ps = ParameterServer([None], {"g0": 0}, num_trainers=1,
+                         sync_mode=False,
+                         plan_spec=_mig_spec(["10.9.9.7:1"]))
+    applied = []
+    ps._apply_shard = lambda idx, feed: applied.append(sorted(feed))
+    r = ps._h_send_bucket({"g0": np.ones(2, np.float32)}, trainer_id=0,
+                          seq_total=None, aseq=1)
+    assert r["ok"] and r["dense_acked"] == 1 and r["acked"] == 1
+    # at-least-once re-delivery: dropped, fence named for the pruner
+    r = ps._h_send_bucket({"g0": np.ones(2, np.float32)}, trainer_id=0,
+                          seq_total=None, aseq=1)
+    assert r.get("dup") and r["dense_acked"] == 1
+    # stale shard: dropped loudly with the victim aseq echoed
+    r = ps._h_send_bucket({"g0.gone": np.ones(2, np.float32)},
+                          trainer_id=0, seq_total=None, aseq=2)
+    assert r.get("stale_plan") and r["dropped_aseq"] == 2
+    assert ps.counters["stale_plan_drops"] == 1
+    assert applied == [["g0"]], "stale bucket leaked into a shard"
+    # the drop left a fence hole: aseq 3 applies but the contiguous
+    # high-water stays at 1...
+    r = ps._h_send_bucket({"g0": np.ones(2, np.float32)}, trainer_id=0,
+                          seq_total=None, aseq=3)
+    assert r["ok"] and r["dense_acked"] == 1
+    # ...until the hole-filler (an EMPTY no-op bucket re-committing the
+    # dropped aseq on this stream) lands and the fence jumps past both
+    r = ps._h_send_bucket({}, trainer_id=0, seq_total=None, aseq=2)
+    assert r["ok"] and r["dense_acked"] == 3
+
+
+def test_async_dense_resend_prunes_on_dense_ack_and_collects_drops():
+    """Client side, drain half: `dense_acked` in any drained reply
+    prunes the udense resend queue up to the high-water (contiguous
+    fence only), and a `stale_plan` reply carrying `dropped_aseq` lands
+    in the endpoint's adropped set for the replay pass."""
+    from paddle_tpu.ops import dist_ops
+
+    dist_ops.reset_fences()
+    ep = "10.9.9.8:1"
+    try:
+        st = dist_ops._async_st(ep)
+        st["udense"] = {q: {"w.block0": np.full(2, float(q))}
+                        for q in (1, 2, 3, 5)}
+
+        class _P:
+            def __call__(self, _ep):
+                return self
+
+            def drain(self):
+                return [{"ok": True, "dense_acked": 3},
+                        {"ok": True, "stale_plan": True,
+                         "dropped_aseq": 5, "pepoch": 1}]
+
+        stale = set()
+        dist_ops._drain_plan_checked(_P(), ep, 0, stale_plan=stale)
+        assert sorted(st["udense"]) == [5], "prune must stop at the fence"
+        assert stale == {ep} and st["adropped"] == {5}
+    finally:
+        dist_ops.reset_fences()
+
+
+def test_plan_flip_reships_only_dropped_dense_buckets():
+    """ACCEPTANCE (satellite): the plan-flip replay re-ships EXACTLY
+    the buckets the server reported dropped — regrouped by their new
+    owner under the derived plan, fresh aseqs on the new owners'
+    streams, the ORIGINAL aseq kept on the old endpoint (the hole
+    filler) — and applied-but-unacked buckets are never re-shipped
+    (that would bypass the dedup fence and double-apply)."""
+    from paddle_tpu.ops import dist_ops
+
+    dist_ops.reset_fences()
+    old_ep, new_ep = "10.9.9.10:1", "10.9.9.11:1"
+    try:
+        st = dist_ops._async_st(old_ep)
+        a0 = np.full(4, 1.0, np.float32)
+        a1 = np.full(4, 2.0, np.float32)
+        a2 = np.full(4, 3.0, np.float32)
+        # aseq 1 was REPORTED dropped; aseq 2 is applied-but-unacked
+        st["udense"] = {1: {"w.block0": a0, "w.block1": a1},
+                        2: {"w.block2": a2}}
+        st["adropped"] = {1}
+        # the freshly derived plan moved w.block0 to the new owner and
+        # kept w.block1 on the old one
+        plan_rt = {"derived": {"send_buckets": [
+            [new_ep, [[0, 0, 4, "w.block0"]]],
+            [old_ep, [[1, 0, 4, "w.block1"]]],
+        ]}}
+        pipe = _StubPipe()
+        n = dist_ops._async_replay_dense(pipe, plan_rt, 0, [old_ep])
+        assert n == 2
+        # old endpoint: the staying block under the ORIGINAL aseq
+        (verb, kw), = pipe.shipped[old_ep]
+        assert verb == "send_bucket" and kw["aseq"] == 1
+        assert sorted(kw["blocks"]) == ["w.block1"]
+        np.testing.assert_array_equal(kw["blocks"]["w.block1"], a1)
+        # new owner: the moved block under a FRESH aseq on ITS stream
+        (verb, kw), = pipe.shipped[new_ep]
+        assert verb == "send_bucket" and kw["aseq"] == 1
+        assert sorted(kw["blocks"]) == ["w.block0"]
+        np.testing.assert_array_equal(kw["blocks"]["w.block0"], a0)
+        # both re-shipped buckets re-entered their udense queues (a
+        # crash mid-recovery re-delivers; the fences dedup), the
+        # applied-but-unacked aseq 2 was NOT touched, drops cleared
+        assert sorted(st["udense"]) == [1, 2]
+        assert sorted(st["udense"][1]) == ["w.block1"]
+        assert sorted(dist_ops._async_st(new_ep)["udense"]) == [1]
+        assert st["adropped"] == set()
+    finally:
+        dist_ops.reset_fences()
+
+
+def test_plan_flip_hole_filler_ships_even_when_all_blocks_move():
+    """When EVERY block of a dropped bucket migrates away, the old
+    endpoint still receives an EMPTY bucket at the original aseq — the
+    no-op commit that fills the fence hole on its stream (without it,
+    the contiguous dense fence on both sides sticks forever)."""
+    from paddle_tpu.ops import dist_ops
+
+    dist_ops.reset_fences()
+    old_ep, new_ep = "10.9.9.12:1", "10.9.9.13:1"
+    try:
+        st = dist_ops._async_st(old_ep)
+        a0 = np.full(4, 7.0, np.float32)
+        st["udense"] = {4: {"w.block0": a0}}
+        st["adropped"] = {4}
+        plan_rt = {"derived": {"send_buckets": [
+            [new_ep, [[0, 0, 4, "w.block0"]]],
+        ]}}
+        pipe = _StubPipe()
+        assert dist_ops._async_replay_dense(pipe, plan_rt, 0,
+                                            [old_ep]) == 2
+        (_, kw), = pipe.shipped[old_ep]
+        assert kw["aseq"] == 4 and kw["blocks"] == {}
+        (_, kw), = pipe.shipped[new_ep]
+        assert kw["aseq"] == 1
+        np.testing.assert_array_equal(kw["blocks"]["w.block0"], a0)
+    finally:
+        dist_ops.reset_fences()

@@ -1,0 +1,508 @@
+"""Serving fabric (paddle_tpu/serving/router.py, docs/SERVING.md §7):
+multi-pool routing exactness, chaos-tested degradation, and the unified
+control plane.
+
+The contracts under test:
+* sticky placement keeps every request's stream bit-identical to its
+  solo run (the PR 9 exactness contract, now fabric-wide);
+* pool death (the `pool_kill` fault action) re-places queued AND
+  in-flight requests onto survivors with the emitted prefix replayed —
+  the full stream stays token-identical to solo, and the survivors see
+  zero retraces;
+* drain-and-retire leaves no orphaned slots;
+* the fabric admission queue is the backpressure signal — overflow is a
+  loud REJECTED_QUEUE_FULL at the router, never a hang;
+* ONE _ScalingPolicy instance governs trainers, pservers, and pools
+  under one shared cooldown + action budget (no flap when axes
+  disagree).
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.distributed.faults import FaultSchedule
+from paddle_tpu.distributed.launch import _RestartPolicy, _ScalingPolicy
+from paddle_tpu.models import gpt2
+from paddle_tpu.serving import (
+    FabricRouter,
+    Request,
+    ServingEngine,
+    make_poisson_trace,
+    parse_pool_schedule,
+)
+
+
+class TinyHP(gpt2.GPT2Config):
+    vocab_size = 61
+    n_ctx = 32
+    d_model = 32
+    n_layer = 2
+    n_head = 4
+    dropout = 0.0
+
+
+T_MAX = 24
+
+
+def _pool_factory(n_slots=2, width=4, seed=7, engines=None):
+    """Factory building one pool: tiny-GPT2 weights in a FRESH scope
+    (fixed startup seed -> every pool holds identical weights, the
+    failover-replay precondition).  `engines` collects every engine
+    ever built so tests can assert on RETIRED pools too."""
+
+    def factory():
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            _, lm_startup, _, _ = gpt2.gpt2_logits_program(
+                TinyHP, seq_len=T_MAX)
+            exe = fluid.Executor(fluid.CPUPlace())
+            lm_startup.random_seed = seed
+            exe.run(lm_startup)
+            eng = ServingEngine(exe, TinyHP, n_slots=n_slots,
+                                width=width, t_max=T_MAX)
+        if engines is not None:
+            engines.append(eng)
+        return eng, scope
+
+    return factory
+
+
+def _trace(n, rate, seed, out_hi=10):
+    return make_poisson_trace(
+        n, rate=rate, prompt_len_range=(2, 8), out_len_range=(4, out_hi),
+        vocab_size=TinyHP.vocab_size, seed=seed)
+
+
+def _assert_solo_exact(results, trace_args):
+    """Every OK stream must be BIT-identical to its solo run on a fresh
+    pool (same weights: the factory's fixed startup seed)."""
+    eng, scope = _pool_factory(n_slots=4)()
+    with fluid.scope_guard(scope):
+        for r in _trace(*trace_args):
+            if results[r.rid]["status"] != "OK":
+                continue
+            ref, _ = eng.run_solo(r)
+            got = np.asarray(results[r.rid]["tokens"])
+            assert np.array_equal(np.asarray(ref), got), (
+                "rid %r diverged from solo" % (r.rid,))
+
+
+# ---------------------------------------------------------------------------
+# routing exactness + stickiness (no faults)
+# ---------------------------------------------------------------------------
+def test_fabric_multi_pool_exactness():
+    router = FabricRouter(_pool_factory(n_slots=2), n_pools=3,
+                          queue_depth=16)
+    args = (12, 0.9, 3)
+    results, stats = router.run(_trace(*args))
+    assert {r["status"] for r in results.values()} == {"OK"}
+    assert stats["finished"] == 12 and stats["rejected"] == 0
+    assert stats["replaced"] == 0
+    # sticky: every result names exactly one pool
+    assert all(isinstance(r["pool"], int) for r in results.values())
+    _assert_solo_exact(results, args)
+
+
+def test_fabric_single_pool_matches_engine_run():
+    """One-pool fabric is the engine plus router bookkeeping — the
+    token streams must match engine.run on the same trace exactly."""
+    router = FabricRouter(_pool_factory(n_slots=4), n_pools=1)
+    results, _ = router.run(_trace(10, 0.7, 5))
+    eng, scope = _pool_factory(n_slots=4)()
+    with fluid.scope_guard(scope):
+        ref, _ = eng.run(_trace(10, 0.7, 5))
+    for rid, r in ref.items():
+        assert np.array_equal(np.asarray(r["tokens"]),
+                              np.asarray(results[rid]["tokens"])), rid
+
+
+def test_fabric_duplicate_and_oversized_rejected_at_submit():
+    router = FabricRouter(_pool_factory(), n_pools=1)
+    router.submit(Request(rid=0, prompt=[1, 2], max_new_tokens=3))
+    with pytest.raises(ValueError, match="duplicate"):
+        router.submit(Request(rid=0, prompt=[3], max_new_tokens=2))
+    with pytest.raises(ValueError, match="capacity"):
+        router.submit(Request(rid=1, prompt=[1] * T_MAX,
+                              max_new_tokens=T_MAX))
+
+
+# ---------------------------------------------------------------------------
+# chaos: pool death mid-stream
+# ---------------------------------------------------------------------------
+def test_kill_pool_mid_stream_failover_preserves_solo_stream():
+    """SIGKILL one of 3 pools mid-stream (the `pool_kill` fault action
+    on the pinned-seed FaultSchedule): every affected request finishes
+    on a survivor, the full stream token-identical to its solo run —
+    the replay path (prompt + emitted prefix, sample keys offset past
+    it) reconstructs the exact continuation.  Survivors see ZERO
+    retraces from the failover."""
+    fs = FaultSchedule({"fabric": {8: "pool_kill:0"}})
+    router = FabricRouter(_pool_factory(n_slots=2), n_pools=3,
+                          queue_depth=16, fault_schedule=fs)
+    args = (14, 1.2, 4, 12)
+    for r in _trace(*args):
+        router.submit(r)
+    replays, survivors_warm = [], None
+    while any(h.engine.queue or h.engine.pool.active_slots()
+              for h in router.pools.values()) or router.queue:
+        router.step()
+        if router.counters["pools_died"] and survivors_warm is None:
+            # snapshot immediately after the failover: the replayed
+            # requests sit in the router queue with offset sample keys
+            replays = [q for q in router.queue
+                       if q.sample_step_base > 0]
+            survivors_warm = {
+                pid: h.engine.exe.compile_count
+                for pid, h in router.pools.items()}
+        assert router.now < 3000
+    results = dict(router._results)
+    stats = router.stats()
+    assert stats["pool_kills"] == 1 and stats["pools_died"] == 1
+    assert stats["replaced"] > 0, "kill must catch in-flight requests"
+    assert {r["status"] for r in results.values()} == {"OK"}
+    assert sum(bool(r.get("replayed")) for r in results.values()) \
+        == stats["replaced"]
+    _assert_solo_exact(results, args)
+    # the re-decoded tail alone must equal a solo re-run FROM the
+    # replayed prefix (prefill of prompt+prefix continues the solo
+    # sample sequence): serve each captured replay request solo
+    assert replays, "failover must have enqueued replay requests"
+    eng, scope = _pool_factory(n_slots=4)()
+    with fluid.scope_guard(scope):
+        for rep in replays:
+            tail, _ = eng.run(
+                [Request(rid="replay-%s" % rep.rid, prompt=rep.prompt,
+                         max_new_tokens=rep.max_new_tokens,
+                         temperature=rep.temperature, top_k=rep.top_k,
+                         top_p=rep.top_p, seed=rep.seed,
+                         eos_id=rep.eos_id,
+                         sample_step_base=rep.sample_step_base)])
+            tail = np.asarray(tail["replay-%s" % rep.rid]["tokens"])
+            full = np.asarray(results[rep.rid]["tokens"])
+            assert np.array_equal(full[rep.sample_step_base:], tail), (
+                rep.rid)
+    # zero retraces on survivors: no recompiles after the failover
+    for pid, h in router.pools.items():
+        assert h.engine.exe.compile_count == survivors_warm[pid], (
+            "pool %d retraced during failover" % pid)
+
+
+def test_pool_kill_seeded_pick_is_deterministic():
+    """A bare `pool_kill` picks its victim off the schedule's seeded
+    per-frame hash — two routers with the same seed kill the same
+    pool."""
+    victims = []
+    for _ in range(2):
+        fs = FaultSchedule({"fabric": {6: "pool_kill"}}, seed=11)
+        router = FabricRouter(_pool_factory(n_slots=2), n_pools=3,
+                              queue_depth=16, fault_schedule=fs)
+        results, stats = router.run(_trace(10, 1.0, 4))
+        assert stats["pool_kills"] == 1
+        assert {r["status"] for r in results.values()} == {"OK"}
+        victims.append({int(p) for p in stats["pools"]})
+    assert victims[0] == victims[1]
+
+
+def test_dead_step_thread_fails_over_immediately():
+    """An exception inside a pool's step loop (a dead step thread, not
+    a silent kill) declares the pool dead the SAME step."""
+    router = FabricRouter(_pool_factory(n_slots=2), n_pools=2,
+                          queue_depth=16)
+    args = (8, 1.0, 6)
+    for r in _trace(*args):
+        router.submit(r)
+    for _ in range(4):
+        router.step()
+    victim = sorted(router.pools)[0]
+    router.pools[victim].engine.exe = None  # step() will raise
+    while router.queue or any(h.engine.queue or
+                              h.engine.pool.active_slots()
+                              for h in router.pools.values()):
+        router.step()
+        assert router.now < 3000
+    assert victim not in router.pools
+    assert router.counters["pools_died"] == 1
+    results = dict(router._results)
+    assert {r["status"] for r in results.values()} == {"OK"}
+    _assert_solo_exact(results, args)
+
+
+# ---------------------------------------------------------------------------
+# scaling: 1 -> 3 -> 1 under the seeded trace
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_scale_pools_1_3_1_latency_and_zero_retrace():
+    """The deterministic chaos/bench walk: grow 1->3 at T1, shrink back
+    3->1 at T2 under one seeded Poisson trace.  Bars: zero rejections
+    (capacity exists throughout), every stream OK and solo-exact, p99
+    latency of the 3-pool phase within 2x of a STATIC 3-pool run, and
+    zero retraces per pool (scaling must never recompile anyone)."""
+    args = (24, 1.2, 9, 8)
+    t_grow, t_shrink = 6, 30
+
+    static_engines = []
+    static = FabricRouter(
+        _pool_factory(n_slots=2, engines=static_engines), n_pools=3,
+        queue_depth=64)
+    static_res, _ = static.run(_trace(*args))
+
+    engines = []
+    router = FabricRouter(_pool_factory(n_slots=2, engines=engines),
+                          n_pools=1, queue_depth=64)
+    results, stats = router.run(
+        _trace(*args), pool_schedule=[(t_grow, +2), (t_shrink, -2)])
+    assert stats["rejected"] == 0 and stats["rejection_rate"] == 0.0
+    assert {r["status"] for r in results.values()} == {"OK"}
+    assert stats["pools_added"] == 3 and stats["pools_retired"] == 2
+    assert stats["n_pools"] == 1
+    _assert_solo_exact(results, args)
+
+    def p99(res, lo, hi):
+        lats = sorted(r["latency_steps"] for r in res.values()
+                      if lo <= r["arrival_step"] < hi)
+        return lats[min(len(lats) - 1,
+                        int(math.ceil(0.99 * len(lats)) - 1))]
+
+    # 3-pool phase: arrivals once the grow landed, before the shrink
+    assert p99(results, t_grow, t_shrink) \
+        <= 2 * max(1, p99(static_res, t_grow, t_shrink))
+    # zero retraces per pool, RETIRED pools included: every engine ever
+    # built compiled the same program set as an undisturbed static pool
+    warm = max(e.exe.compile_count for e in static_engines)
+    for e in engines:
+        assert e.exe.compile_count <= warm, "scaling caused a retrace"
+
+
+def test_drain_and_retire_leaves_no_orphans():
+    """drain_pool mid-stream: no new placements, in-flight requests
+    finish on their slots, and the retired pool ends with zero active
+    slots and an empty queue (nothing re-placed, nothing lost)."""
+    engines = []
+    router = FabricRouter(_pool_factory(n_slots=2, engines=engines),
+                          n_pools=2, queue_depth=32)
+    args = (10, 1.0, 7)
+    for r in _trace(*args):
+        router.submit(r)
+    drained = None
+    while router.queue or any(h.engine.queue or
+                              h.engine.pool.active_slots()
+                              for h in router.pools.values()):
+        router.step()
+        if router.now == 5:
+            drained = sorted(router.pools)[0]
+            router.drain_pool(drained)
+        assert router.now < 3000
+    assert drained is not None and drained not in router.pools
+    stats = router.stats()
+    assert stats["pools_retired"] == 1 and stats["replaced"] == 0
+    results = dict(router._results)
+    assert {r["status"] for r in results.values()} == {"OK"}
+    for e in engines:  # no orphaned slots anywhere, retiree included
+        assert not e.pool.active_slots() and not e.queue
+    _assert_solo_exact(results, args)
+
+
+def test_scale_down_never_drains_last_pool():
+    router = FabricRouter(_pool_factory(), n_pools=2, queue_depth=8)
+    router.scale_pools(-5)
+    assert len(router._live()) == 1
+
+
+# ---------------------------------------------------------------------------
+# backpressure + router-side deadlines
+# ---------------------------------------------------------------------------
+def test_router_backpressure_rejects_loudly_at_depth(capsys):
+    """An arrival finding queue_depth requests already waiting is
+    REJECTED_QUEUE_FULL at the router, immediately and loudly — the
+    fabric never hangs and never queues unboundedly."""
+    router = FabricRouter(_pool_factory(n_slots=2), n_pools=1,
+                          queue_depth=2)
+    burst = [Request(rid=i, prompt=np.arange(1, 5), max_new_tokens=6,
+                     arrival=0.0) for i in range(8)]
+    results, stats = router.run(burst)
+    st = [results[i]["status"] for i in range(8)]
+    assert st.count("REJECTED_QUEUE_FULL") == 4  # 2 slots + 2 waiting
+    assert st.count("OK") == 4
+    assert stats["rejected"] == 4 and stats["rejection_rate"] == 0.5
+    for i in range(8):
+        if results[i]["status"] == "OK":
+            assert len(results[i]["tokens"]) == 6
+    assert "REJECTED_QUEUE_FULL" in capsys.readouterr().out
+
+
+def test_router_deadline_expires_waiting_requests():
+    router = FabricRouter(_pool_factory(n_slots=2), n_pools=1,
+                          queue_depth=8)
+    reqs = [Request(rid=i, prompt=np.arange(1, 6), max_new_tokens=8,
+                    arrival=0.0, deadline=3) for i in range(5)]
+    results, _ = router.run(reqs)
+    statuses = sorted(results[i]["status"] for i in range(5))
+    assert "DEADLINE_EXPIRED" in statuses  # the ones stuck waiting
+    # whoever got a slot in time either finished or expired mid-decode;
+    # nobody hung
+    assert set(statuses) <= {"OK", "DEADLINE_EXPIRED"}
+
+
+# ---------------------------------------------------------------------------
+# control plane: stats verb, RPC service, schedule parser
+# ---------------------------------------------------------------------------
+def test_parse_pool_schedule():
+    assert parse_pool_schedule("4:+2,30:-2") == [(4.0, 2), (30.0, -2)]
+    assert parse_pool_schedule(" 9:-1 , 2:+3 ") == [(2.0, 3), (9.0, -1)]
+    assert parse_pool_schedule("") == []
+    assert parse_pool_schedule(None) == []
+
+
+def test_control_service_speaks_stats_and_scale_over_rpc():
+    """The router's control plane rides the SAME VarServer/RPCClient
+    stack the pservers use: `stats` returns the shared signal set, and
+    `scale_pools` lands at the next step boundary."""
+    from paddle_tpu.distributed.rpc import RPCClient
+
+    router = FabricRouter(_pool_factory(), n_pools=1, queue_depth=8)
+    srv = router.serve_control("127.0.0.1:0")
+    try:
+        cli = RPCClient(srv.endpoint, timeout=5, retries=2)
+        try:
+            s = cli.call("stats")
+            assert s["n_pools"] == 1 and "occupancy" in s \
+                and "queue_depth" in s and "rejection_rate" in s
+            r = cli.call("scale_pools", delta=1)
+            assert r["ok"]
+            router.step()  # boundary applies the pending delta
+            assert cli.call("stats")["n_pools"] == 2
+            with pytest.raises(Exception):
+                cli.call("no_such_verb")
+        finally:
+            cli.close()
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# unified supervisor: one policy, three axes, one budget
+# ---------------------------------------------------------------------------
+def _policy(**kw):
+    kw.setdefault("cooldown_s", 0.0)
+    kw.setdefault("hysteresis", 2)
+    kw.setdefault("budget",
+                  _RestartPolicy(max_restarts=8, window_s=60.0,
+                                 backoff_s=0.0))
+    return _ScalingPolicy(1, 4, min_ps=1, max_ps=4, min_pools=1,
+                          max_pools=4, **kw)
+
+
+def test_scaling_policy_pool_axis_signals():
+    """Pool axis of _ScalingPolicy: pressure (queue depth / occupancy /
+    rejections) grows after `hysteresis` observations, sustained idle
+    shrinks after twice that, and a re-placement burst (failover in
+    progress) suppresses and resets — load measured mid-failover must
+    not drive scaling."""
+    p = _policy()
+    hot = {"queue_depth": 3, "occupancy": 0.5, "rejected": 0,
+           "replaced": 0}
+    assert p.observe_pool_load(1, hot) is None
+    assert p.observe_pool_load(1, hot) == ("grow_pool", None)
+    # occupancy alone is pressure too
+    occ = {"queue_depth": 0, "occupancy": 0.95, "rejected": 0,
+           "replaced": 0}
+    assert p.observe_pool_load(2, occ) is None
+    assert p.observe_pool_load(2, occ) == ("grow_pool", None)
+    # a rejection DELTA is pressure (cumulative counter diffed)
+    p2 = _policy()
+    assert p2.observe_pool_load(
+        1, {"queue_depth": 0, "occupancy": 0.4, "rejected": 5,
+            "replaced": 0}) is None  # baseline diff = 0, no streak
+    assert p2.observe_pool_load(
+        1, {"queue_depth": 0, "occupancy": 0.4, "rejected": 9,
+            "replaced": 0}) is None
+    assert p2.observe_pool_load(
+        1, {"queue_depth": 0, "occupancy": 0.4, "rejected": 12,
+            "replaced": 0}) == ("grow_pool", None)
+    # shrink needs twice the evidence
+    p3 = _policy()
+    idle = {"queue_depth": 0, "occupancy": 0.1, "rejected": 0,
+            "replaced": 0}
+    for _ in range(3):
+        assert p3.observe_pool_load(2, idle) is None
+    assert p3.observe_pool_load(2, idle) == ("shrink_pool", None)
+    # never below min_pools
+    p4 = _policy()
+    for _ in range(8):
+        assert p4.observe_pool_load(1, idle) is None
+    # replacement burst suppresses and resets the streaks
+    p5 = _policy()
+    assert p5.observe_pool_load(1, hot) is None
+    assert p5.observe_pool_load(
+        1, {"queue_depth": 3, "occupancy": 0.5, "rejected": 0,
+            "replaced": 2}) is None
+    assert p5.observe_pool_load(1, hot) is None  # streak restarted
+    assert p5.observe_pool_load(1, hot) == ("grow_pool", None)
+
+
+def test_one_action_budget_governs_all_three_axes():
+    """ONE policy instance spans trainers, pservers, and pools: every
+    action draws from the same _RestartPolicy budget, so exhausting it
+    on any mix of axes silences the rest — three loops cannot fight."""
+    p = _ScalingPolicy(1, 4, cooldown_s=0.0, hysteresis=1,
+                       min_ps=1, max_ps=4, min_pools=1, max_pools=4,
+                       budget=_RestartPolicy(max_restarts=2,
+                                             window_s=60.0,
+                                             backoff_s=0.0))
+    hot_pool = {"queue_depth": 3, "occupancy": 0.2, "rejected": 0,
+                "replaced": 0}
+    hot_ps = {"queue_depth": 9, "staleness_parks": 0,
+              "stale_plan_drops": 0}
+    assert p.observe_pool_load(1, hot_pool) == ("grow_pool", None)
+    assert p.observe_ps_load(1, hot_ps, n_trainers=2) == ("grow_ps",
+                                                          None)
+    # budget (2 actions / window) exhausted: the TRAINER axis is
+    # silenced by pool+pserver spend, and vice versa
+    assert p.observe_pool_load(2, hot_pool) is None
+    assert p.decide({"trainer.0", "trainer.1"},
+                    {"trainer.0": 1.0, "trainer.1": 1.0}) is None
+
+
+def test_no_flap_when_two_axes_disagree():
+    """Axes pulling OPPOSITE directions in one window produce at most
+    ONE action: the shared cooldown serializes them, so the fabric
+    cannot grow pools while the pserver axis shrinks servers in the
+    same breath (and re-observation later still works)."""
+    p = _ScalingPolicy(1, 4, cooldown_s=3600.0, hysteresis=1,
+                       min_ps=1, max_ps=4, min_pools=1, max_pools=4,
+                       budget=_RestartPolicy(max_restarts=8,
+                                             window_s=60.0,
+                                             backoff_s=0.0))
+    # manufacture an expired cooldown for the FIRST action only
+    p._last_action -= 7200.0
+    idle_ps = {"queue_depth": 0, "staleness_parks": 0,
+               "stale_plan_drops": 0}
+    hot_pool = {"queue_depth": 5, "occupancy": 0.9, "rejected": 0,
+                "replaced": 0}
+    # pserver axis wants to shrink (sustained idle)...
+    assert p.observe_ps_load(3, idle_ps, n_trainers=2) is None
+    act = p.observe_ps_load(3, idle_ps, n_trainers=2)
+    assert act == ("shrink_ps", None)
+    # ...pool axis wants to grow RIGHT NOW: cooldown says no
+    assert p.observe_pool_load(1, hot_pool) is None
+    assert p.observe_pool_load(1, hot_pool) is None
+
+
+def test_pool_kill_action_validation():
+    """`pool_kill` (and its pinned `pool_kill:<pid>` form) is a
+    fabric-direction action; wire directions reject it, and wire faults
+    reject the fabric direction — a schedule typo fails loudly at
+    construction, not silently mid-chaos."""
+    FaultSchedule({"fabric": {3: "pool_kill"}})
+    FaultSchedule({"fabric": {3: "pool_kill:2", 5: "pass"}})
+    with pytest.raises(ValueError, match="not valid"):
+        FaultSchedule({"c2s": {3: "pool_kill"}})
+    with pytest.raises(ValueError, match="not valid"):
+        FaultSchedule({"fabric": {3: "drop"}})
+    with pytest.raises(ValueError, match="unknown fault action"):
+        FaultSchedule({"fabric": {3: "pool_kill:x"}})
+    with pytest.raises(ValueError, match="direction"):
+        FaultSchedule({"sideways": {0: "pass"}})
