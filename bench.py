@@ -342,6 +342,15 @@ def _bench_impl():
         except Exception as e:
             sys.stderr.write("serve_tp bench failed: %r\n" % (e,))
             result["serve_tp"] = {"error": repr(e)[:200]}
+    # tensor-parallel TRAINING: the gpt2 builder stamped over dp x mp
+    # meshes vs the same program unsharded — step/s, per-device state
+    # bytes (ZeRO), per-device peak-activation estimate, comm bytes
+    if os.environ.get("BENCH_SPMD_TRAIN", "0") == "1":
+        try:
+            result["spmd_train"] = _spmd_train_bench(on_tpu, device)
+        except Exception as e:
+            sys.stderr.write("spmd_train bench failed: %r\n" % (e,))
+            result["spmd_train"] = {"error": repr(e)[:200]}
     # serving fabric: the same trace through a multi-pool router —
     # static fleet vs the 1->3->1 scale walk vs a mid-stream pool kill
     if os.environ.get("BENCH_FABRIC", "0") == "1":
@@ -950,6 +959,131 @@ def _serve_tp_bench(on_tpu, device):
         "SERVE_TP_RESULT pool_bytes/device ratio %s tok/s ratio %s\n"
         % (out["pool_bytes_per_device_vs_unsharded"],
            out["tok_s_ratio_vs_unsharded"]))
+    return out
+
+
+def _spmd_train_bench(on_tpu, device):
+    """GSPMD tensor-parallel TRAINING leg (BENCH_SPMD_TRAIN=1): the gpt2
+    causal-LM builder stamped over dp x mp meshes {(2,1),(1,2),(2,2)}
+    (needs BENCH_SPMD_TRAIN_DEVICES devices, default 4 — on CPU run
+    under XLA_FLAGS=--xla_force_host_platform_device_count=N) vs the
+    same program unstamped.  Per mesh: step/s, final-loss parity vs the
+    unsharded run, the per-DEVICE peak-activation estimate (the global
+    utils.memory_analysis estimate divided by the mesh size — the same
+    scaling maybe_remat applies to the HBM budget), per-device
+    param+optimizer-state bytes (the ZeRO point: matrices split 1/mp),
+    and comm-bytes attribution from the compiled step's collectives."""
+    import numpy as np
+
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu.models import gpt2
+    from paddle_tpu.parallel import make_mesh
+    from paddle_tpu.utils import memory_analysis as ma
+
+    need = int(os.environ.get("BENCH_SPMD_TRAIN_DEVICES", "4"))
+    if len(jax.devices()) < need:
+        return {"skipped":
+                "needs %d devices; run under XLA_FLAGS="
+                "--xla_force_host_platform_device_count=%d"
+                % (need, need)}
+
+    class HP(gpt2.GPT2Config):
+        vocab_size = 8000 if on_tpu else 256
+        n_ctx = 256 if on_tpu else 32
+        d_model = 256 if on_tpu else 64
+        n_layer = 4 if on_tpu else 2
+        n_head = 4
+        d_inner = 1024 if on_tpu else 128
+        dropout = 0.0
+        tie_embeddings = False
+
+    seq = int(os.environ.get("BENCH_SPMD_TRAIN_SEQ",
+                             HP.n_ctx // 2))
+    batch = int(os.environ.get("BENCH_SPMD_TRAIN_BATCH",
+                               16 if on_tpu else 8))
+    steps = int(os.environ.get("BENCH_SPMD_TRAIN_STEPS",
+                               20 if on_tpu else 4))
+
+    def run_leg(mesh_shape):
+        mesh = None
+        n_shards = 1
+        if mesh_shape is not None:
+            dp, mp = mesh_shape
+            n_shards = dp * mp
+            mesh = make_mesh({"dp": dp, "mp": mp},
+                             devices=jax.devices()[:n_shards])
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            main, startup, feeds, fetches = gpt2.gpt2_lm_program(
+                HP, seq_len=seq, lr=3e-4, mesh=mesh)
+            exe = fluid.Executor(
+                fluid.TPUPlace(0) if on_tpu else fluid.CPUPlace())
+            startup.random_seed = 23
+            exe.run(startup)
+            fb = gpt2.make_fake_lm_batch(batch, seq, HP, seed=0)
+            exe.run(main, feed=fb, fetch_list=fetches)  # warm compile
+            t0 = time.time()
+            loss = None
+            for _ in range(steps):
+                out = exe.run(main, feed=fb, fetch_list=fetches)
+                loss = float(np.asarray(out[0]).reshape(-1)[0])
+            dt = time.time() - t0
+            # per-device param + optimizer state (ZeRO leg)
+            per_device = replicated = 0
+            for n in scope.all_var_names():
+                v = scope.find_var(n)
+                if v is None or not hasattr(v, "sharding"):
+                    continue
+                replicated += v.nbytes
+                nb = v.dtype.itemsize
+                for d in v.sharding.shard_shape(v.shape):
+                    nb *= int(d)
+                per_device += nb
+            # activation estimate: the estimator traces the GLOBAL
+            # program, so per-device is the mesh-size scaling
+            try:
+                est = ma.estimate_peak_activation_bytes(
+                    main, ma.program_feed_specs(
+                        main, feeds, batch_hint=batch),
+                    fetches[0].name)
+                peak = est["peak_bytes"]
+            except Exception as e:
+                sys.stderr.write("peak estimate failed: %r\n" % (e,))
+                peak = 0
+            leg = {
+                "value": round(steps / dt, 3),
+                "unit": "steps/sec" + ("" if on_tpu
+                                       else " (cpufallback)"),
+                "final_loss": loss,
+                "state_bytes_per_device": int(per_device),
+                "state_bytes_replicated": int(replicated),
+                "peak_activation_bytes_global": int(peak),
+                "peak_activation_bytes_per_device_est":
+                    int(peak // n_shards),
+            }
+            if mesh is not None:
+                leg["comm"] = exe.spmd_comm_stats(main)
+        return leg
+
+    out = {"batch": batch, "seq_len": seq, "steps": steps}
+    out["unsharded"] = run_leg(None)
+    sys.stderr.write("SPMD_TRAIN_RESULT unsharded %s\n"
+                     % json.dumps(out["unsharded"]))
+    base_loss = out["unsharded"]["final_loss"]
+    base_bytes = out["unsharded"]["state_bytes_per_device"] or 1
+    for dp, mp in ((2, 1), (1, 2), (2, 2)):
+        key = "dp%d_mp%d" % (dp, mp)
+        leg = run_leg((dp, mp))
+        leg["loss_vs_unsharded"] = (
+            None if base_loss in (None, 0.0)
+            else round(abs(leg["final_loss"] - base_loss)
+                       / abs(base_loss), 8))
+        leg["state_bytes_per_device_vs_unsharded"] = round(
+            leg["state_bytes_per_device"] / base_bytes, 4)
+        out[key] = leg
+        sys.stderr.write("SPMD_TRAIN_RESULT %s %s\n"
+                         % (key, json.dumps(leg)))
     return out
 
 

@@ -44,6 +44,7 @@ from .verifier import (  # noqa: F401
     verify_after_pass,
     segment_diagnostics,
     alias_plan_diagnostics,
+    sharding_diagnostics,
 )
 
 __all__ = [
@@ -66,4 +67,5 @@ __all__ = [
     "verify_after_pass",
     "segment_diagnostics",
     "alias_plan_diagnostics",
+    "sharding_diagnostics",
 ]

@@ -338,6 +338,73 @@ def _lookup(env, block, name):
     return var_static_info(block, name)
 
 
+# while bodies feed carried shapes back into themselves; 4 widening
+# passes bound the fixpoint far above any rank's worth of dim churn
+_WHILE_FIXPOINT_MAX = 4
+
+
+def _info_key(info):
+    if info is None:
+        return None
+    return (info.shape, info.dtype, info.var_type)
+
+
+def _join_info(after, before):
+    """Shape join across two while iterations: agreeing dims keep their
+    value, disagreeing dims widen to -1 (unknown), rank or dtype
+    disagreement widens the whole field — monotone loss of knowledge,
+    so the fixpoint below cannot oscillate forever."""
+    if after is None or before is None:
+        return after if before is None else before
+    if after.shape is None or before.shape is None:
+        # unknown joined with known keeps the known value: refinement
+        # is fine, only DISAGREEMENT between two known values widens
+        shape = after.shape if before.shape is None else before.shape
+    elif len(after.shape) != len(before.shape):
+        shape = None
+    else:
+        shape = tuple(a if a == b else -1
+                      for a, b in zip(after.shape, before.shape))
+    dtype = (after.dtype if before.dtype is None else
+             (before.dtype if after.dtype is None else
+              (after.dtype if after.dtype == before.dtype else None)))
+    var_type = (after.var_type if after.var_type == before.var_type
+                else "lod_tensor")
+    return VarInfo(shape, dtype, var_type)
+
+
+def _infer_while_fixpoint(program, subs, env, report, skip):
+    """A ``while``/``bounded_while`` body's carried vars feed back into
+    the next iteration, so one sub-block pass infers shapes that may
+    only hold for iteration 0 (a concat growing a carried dim).  Run
+    the body SILENTLY to a bounded fixpoint — after each pass, join
+    every changed VarInfo with its previous value, widening disagreeing
+    dims to -1 — then make the single reporting pass over the
+    stabilized env, so iteration-0-only shapes never become
+    diagnostics (and never duplicate them)."""
+
+    def mute(code, severity, bidx, oidx, op, msg):
+        return None
+
+    for it in range(_WHILE_FIXPOINT_MAX):
+        before = dict(env)
+        for sub_idx in subs:
+            if 0 <= sub_idx < program.num_blocks:
+                _infer_block(program, sub_idx, env, mute, skip)
+        changed = [
+            n for n, old in before.items()
+            if _info_key(env.get(n)) != _info_key(old)
+        ]
+        # pass 0 populates body-local names — never a reason to stop
+        if it > 0 and not changed:
+            break
+        for n in changed:
+            env[n] = _join_info(env.get(n), before[n])
+    for sub_idx in subs:
+        if 0 <= sub_idx < program.num_blocks:
+            _infer_block(program, sub_idx, env, report, skip)
+
+
 def _check_out(env, block, bidx, oidx, op, name, inferred, report):
     declared = var_static_info(block, name)
     if inferred is not None and declared is not None:
@@ -421,9 +488,12 @@ def _infer_block(program, bidx, env, report, skip=None):
 
         subs = op_sub_blocks(op)
         if subs:
-            for sub_idx in subs:
-                if 0 <= sub_idx < program.num_blocks:
-                    _infer_block(program, sub_idx, env, report, skip)
+            if op.type in ("while", "bounded_while"):
+                _infer_while_fixpoint(program, subs, env, report, skip)
+            else:
+                for sub_idx in subs:
+                    if 0 <= sub_idx < program.num_blocks:
+                        _infer_block(program, sub_idx, env, report, skip)
             for n in op.output_arg_names():
                 # recompute exports sub-block-computed names: prefer the
                 # env info the recursion just produced

@@ -31,6 +31,12 @@ tests/test_program_verifier.py):
   sub-block                  dangling sub_block index
   alias-mismatch             a memory plan pairs dtype/shape-unequal vars
   infer-rule-error           an infer rule itself misbehaved (warning)
+  sharding-coverage          a GSPMD-stamped param matches no partition
+                             rule (replicated-by-default warning)
+  sharding-divisibility      a matched rule's sharded dim does not
+                             divide its mesh axis (warning)
+  sharding-inconsistency     a grad/optimizer-state name resolves to a
+                             different spec than its base param (error)
 """
 
 from .graph import consumer_map, op_reads
@@ -44,6 +50,7 @@ __all__ = [
     "verify_after_pass",
     "segment_diagnostics",
     "alias_plan_diagnostics",
+    "sharding_diagnostics",
 ]
 
 # canonical dtype strings the IR serializes (desc_codec closed set)
@@ -344,6 +351,10 @@ def verify_program(program, scope=None, feeds=None, fetches=(),
             or any(op.type in _GRAD_SINK_OPS for op in gb.ops)):
         _check_dist_plan(program, report, skipped)
 
+    # ---- sharding consistency (GSPMD-stamped programs) ---------------
+    if getattr(program, "_spmd", None) is not None:
+        diags.extend(sharding_diagnostics(program, pass_name=pass_name))
+
     # ---- shape/dtype/arity inference ---------------------------------
     if check_infer:
         seed = list(feeds) if feeds not in (None, "*") else ()
@@ -536,6 +547,103 @@ def segment_diagnostics(program, ops_seg):
                     "op %s redefines %s also written inside the candidate "
                     "segment — the private sub-block env could not tell "
                     "which value to export" % (op.type, clash)))
+    return diags
+
+
+def sharding_diagnostics(program, mesh=None, rules=None, pass_name=None):
+    """Rule-table consistency for a GSPMD-stamped program (the
+    ``annotate_spmd`` contract made checkable):
+
+      sharding-coverage       a multi-element persistable param matches
+                              NO rule — it will replicate by default on
+                              every device (warning: legal, but the
+                              silent form of the failure the registry's
+                              replicated_log exists to surface)
+      sharding-divisibility   a rule matched but a sharded dim does not
+                              divide its mesh axis — sharding_for will
+                              quietly fall back to replicated at run
+      sharding-inconsistency  a TRAINING derived name (<p>@GRAD, Adam
+                              accumulators, @RAW_BF16 casts) resolves to
+                              a DIFFERENT spec than its base param —
+                              grads/optimizer state must shard like the
+                              param or the optimizer update cross-shards
+                              (error: this breaks the ZeRO-state layout)
+
+    mesh/rules default to the program's ``_spmd`` stamp; returns [] for
+    unstamped programs.  Delegated to by verify_program (and therefore
+    by the apply_pass postcondition under FLAGS_check_program) whenever
+    the stamp is present."""
+    import numpy as np
+
+    spmd = getattr(program, "_spmd", None)
+    if mesh is None or rules is None:
+        if spmd is None:
+            return []
+        mesh = mesh if mesh is not None else spmd["mesh"]
+        rules = rules if rules is not None else spmd["rules"]
+    from ..parallel.mesh import mesh_axis_sizes
+
+    sizes = mesh_axis_sizes(mesh)
+    base_name = getattr(rules, "base_name", None)
+    diags = []
+
+    def add(code, severity, msg):
+        diags.append(Diagnostic(code, severity, 0, None, None, msg,
+                                pass_name))
+
+    seen = set()
+    derived = []
+    for blk in program.blocks:
+        for name, v in sorted(blk.vars.items()):
+            if name in seen:
+                continue
+            seen.add(name)
+            shape = tuple(getattr(v, "shape", ()) or ())
+            if not shape or int(np.prod(shape)) <= 1:
+                continue  # the scalar guard replicates these unlogged
+            base = base_name(name) if base_name is not None else name
+            if base != name:
+                derived.append((name, base, shape))
+                continue
+            if not getattr(v, "persistable", False) \
+                    or getattr(v, "is_data", False):
+                continue
+            spec, pat = rules.match(name)
+            if spec is None:
+                if len(shape) >= 2:
+                    # unmatched VECTORS (ln scales, biases) replicate by
+                    # design in every family table — only a matrix
+                    # slipping through the rules is worth surfacing
+                    add("sharding-coverage", "warning",
+                        "persistable '%s' %s matches no partition rule "
+                        "— it replicates on every device"
+                        % (name, list(shape)))
+                continue
+            if len(spec) > len(shape):
+                add("sharding-divisibility", "warning",
+                    "'%s' rank %d < rule %r spec %s — the rank guard "
+                    "replicates it" % (name, len(shape), pat, spec))
+                continue
+            for dim, axes in zip(shape, tuple(spec)):
+                if axes is None:
+                    continue
+                for ax in (axes if isinstance(axes, tuple) else (axes,)):
+                    if int(dim) % int(sizes.get(ax, 1)) != 0:
+                        add("sharding-divisibility", "warning",
+                            "'%s' dim %d does not divide mesh axis "
+                            "%s=%d (rule %r) — sharding_for falls back "
+                            "to replicated"
+                            % (name, dim, ax, sizes.get(ax, 1), pat))
+    for name, base, shape in derived:
+        if base not in seen:
+            continue
+        s_derived = rules.spec_for(name, shape)
+        s_base = rules.spec_for(base, shape)
+        if s_derived != s_base:
+            add("sharding-inconsistency", "error",
+                "derived '%s' resolves to %s but its param '%s' to %s — "
+                "grads and optimizer state must shard like their param"
+                % (name, s_derived, base, s_base))
     return diags
 
 

@@ -137,6 +137,11 @@ def lower_grad_op(ctx, op, ins, attrs):
     sub_ctx = LowerCtx(ctx.rng_key, ctx.is_test, ctx.scope)
     sub_ctx.op_idx = attrs.get("__fwd_op_idx__", ctx.op_idx)
     sub_ctx.trace_block = ctx.trace_block
+    # mesh-aware lowerings resolve the forward OpDesc (weight names ->
+    # partition specs) through ctx.block + op_idx; the grad-side re-run
+    # of the forward rule must see the same block or they fall back to
+    # replicated operands
+    sub_ctx.block = ctx.block
 
     def fwd_fn(diff_vals):
         merged = {s: list(v) for s, v in fwd_ins.items()}

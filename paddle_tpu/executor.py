@@ -497,12 +497,29 @@ class Executor:
         self._maybe_verify_program(program, feed, fetch_names, scope)
         repl = NamedSharding(mesh, PartitionSpec())
 
+        # training rule tables name a dp axis: batch feeds shard their
+        # leading dim over it (the GSPMD global-view batch — the traced
+        # per-batch loss mean IS the PR 6 allreduce-mean, emitted by the
+        # partitioner instead of an explicit c_allreduce).  Serving
+        # tables carry no dp_axis, so the ragged step's per-slot vectors
+        # keep replicating as before.
+        dp_axis = getattr(rules, "dp_axis", None)
+        from .parallel.mesh import mesh_axis_sizes
+
+        dp = mesh_axis_sizes(mesh).get(dp_axis, 1) if dp_axis else 1
+
+        def feed_sharding(a):
+            if dp > 1 and a.ndim >= 1 and a.shape[0] % dp == 0 \
+                    and a.shape[0] > 0:
+                return NamedSharding(
+                    mesh, PartitionSpec(*((dp_axis,)
+                                          + (None,) * (a.ndim - 1))))
+            return repl
+
         t0 = _time.perf_counter()
         feed_np = {n: np.asarray(v) for n, v in feed.items()}
         with RecordEvent("feed_upload", cat="feed"):
-            # feeds replicate: the ragged step's per-slot vectors are
-            # tiny control data every shard needs whole
-            feed_arrays = {n: jax.device_put(a, repl)
+            feed_arrays = {n: jax.device_put(a, feed_sharding(a))
                            for n, a in feed_np.items()}
         self._host_feed_ms += (_time.perf_counter() - t0) * 1e3
 
@@ -532,7 +549,7 @@ class Executor:
             jitted = jax.jit(
                 traced.fn,
                 in_shardings=(
-                    {n: repl for n in feed_arrays},
+                    {n: feed_arrays[n].sharding for n in feed_arrays},
                     {n: sh[n] for n in traced.ro_names},
                     {n: sh[n] for n in traced.rw_names},
                     repl,

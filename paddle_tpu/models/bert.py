@@ -34,6 +34,7 @@ class BertConfig:
     fused_attn = False
     recompute = False  # rematerialize each encoder layer in backward
     label_smooth_eps = 0.0  # encoder reuses tfm blocks; unused here
+    partition_family = "bert"
 
 
 def _emb_table(name):
@@ -81,7 +82,7 @@ def bert_encoder(src_ids, seg_ids, attn_bias, hp, is_test=False, kpad_bias=None)
 
 
 def bert_pretrain_program(hp=BertConfig, seq_len=128, lr=1e-4, is_test=False,
-                          use_bf16=False):
+                          use_bf16=False, mesh=None):
     """Build (main, startup, feeds, [total, mlm, nsp]) for MLM+NSP
     pretraining.  Feeds:
       src_ids/seg_ids [B, T] int64; input_mask [B, T] float (1 = real);
@@ -153,13 +154,23 @@ def bert_pretrain_program(hp=BertConfig, seq_len=128, lr=1e-4, is_test=False,
         apply_pass(main, "matmul_epilogue_fuse_pass")
         if use_bf16:
             apply_pass(main, "bf16_amp_pass")
-        # HBM-budgeted remat (FLAGS_hbm_budget_bytes; no-op when unset)
+        # HBM-budgeted remat (FLAGS_hbm_budget_bytes; no-op when unset);
+        # the flag is a per-device budget, so a mesh scales it
         from ..transpiler.remat import maybe_remat
 
-        maybe_remat(main, total, is_test)
+        maybe_remat(main, total, is_test, mesh=mesh)
         if not is_test:
             fluid.optimizer.Adam(learning_rate=lr).minimize(total)
 
+    if mesh is not None:
+        # GSPMD training stamp: bert-family rules lifted to training
+        # names (grads + Adam moments shard like their param), batch
+        # feeds over the mesh's dp axis
+        from ..parallel.partition_rules import (annotate_spmd,
+                                                train_partition_rules_for)
+
+        annotate_spmd(main, mesh, train_partition_rules_for(
+            getattr(hp, "partition_family", "bert")))
     feeds = ["src_ids", "seg_ids", "input_mask", "mlm_labels", "mlm_weight",
              "nsp_label"]
     return main, startup, feeds, [total, mlm_loss, nsp_loss]

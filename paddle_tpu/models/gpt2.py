@@ -158,13 +158,19 @@ def gpt2_lm(ids, hp=GPT2Config, is_test=False):
 
 
 def gpt2_lm_program(hp=GPT2Config, seq_len=128, lr=3e-4, is_test=False,
-                    use_bf16=False):
+                    use_bf16=False, mesh=None):
     """Build (main, startup, feeds, [loss, token_count]) for causal-LM
     training.  Feeds: ids/labels [B, T] int64, loss_weight [B, T] float.
 
     Built under unique_name.guard(): parameter names are deterministic, so
     a logits program built later in the same process shares weights with
-    this one through the scope by name (the train->generate workflow)."""
+    this one through the scope by name (the train->generate workflow).
+
+    `mesh` stamps the program for GSPMD tensor-parallel training: the
+    gpt2-family rule table lifted to training names (grads + Adam
+    moments shard like their param — ZeRO-style sharded optimizer
+    state), batch feeds over the mesh's dp axis.  No model edits — the
+    executor's _run_spmd path picks the stamp up."""
     import paddle_tpu as fluid
 
     main = fluid.Program()
@@ -195,13 +201,20 @@ def gpt2_lm_program(hp=GPT2Config, seq_len=128, lr=3e-4, is_test=False,
         apply_pass(main, "matmul_epilogue_fuse_pass")
         if use_bf16:
             apply_pass(main, "bf16_amp_pass")
-        # HBM-budgeted remat (FLAGS_hbm_budget_bytes; no-op when unset)
+        # HBM-budgeted remat (FLAGS_hbm_budget_bytes; no-op when unset);
+        # the flag is a per-device budget, so a mesh scales it
         from ..transpiler.remat import maybe_remat
 
-        maybe_remat(main, loss, is_test)
+        maybe_remat(main, loss, is_test, mesh=mesh)
         if not is_test:
             fluid.optimizer.Adam(learning_rate=lr).minimize(loss)
 
+    if mesh is not None:
+        from ..parallel.partition_rules import (annotate_spmd,
+                                                train_partition_rules_for)
+
+        annotate_spmd(main, mesh, train_partition_rules_for(
+            getattr(hp, "partition_family", "gpt2")))
     return main, startup, ["ids", "labels", "loss_weight"], [loss, tokens]
 
 

@@ -49,6 +49,7 @@ class ModelHyperParams:
     dropout = 0.1
     label_smooth_eps = 0.1
     recompute = False  # rematerialize each enc/dec layer in backward
+    partition_family = "transformer"
 
 
 def _pos_encoding_table(max_len, d_model):
@@ -436,7 +437,7 @@ def transformer(
     return logits
 
 
-def wmt_transformer_program(hp=ModelHyperParams, src_len=64, trg_len=64, learning_rate=2.0, warmup_steps=4000, is_test=False, use_bf16=False):
+def wmt_transformer_program(hp=ModelHyperParams, src_len=64, trg_len=64, learning_rate=2.0, warmup_steps=4000, is_test=False, use_bf16=False, mesh=None):
     """Build (main, startup, feed names, [loss]) for training — the analog of
     the reference's transformer train program w/ label smoothing + noam lr.
 
@@ -498,12 +499,21 @@ def wmt_transformer_program(hp=ModelHyperParams, src_len=64, trg_len=64, learnin
         # minimize (grads differentiate through the recompute ops)
         from ..transpiler.remat import maybe_remat
 
-        maybe_remat(main, avg_cost, is_test)
+        maybe_remat(main, avg_cost, is_test, mesh=mesh)
         if not is_test:
             lr = layers.learning_rate_scheduler.noam_decay(hp.d_model, warmup_steps)
             lr = layers.scale(lr, scale=float(learning_rate))
             opt = fluid.optimizer.Adam(learning_rate=lr, beta1=0.9, beta2=0.997, epsilon=1e-9)
             opt.minimize(avg_cost)
+    if mesh is not None:
+        # GSPMD training stamp: transformer-family rules lifted to
+        # training names (grads + Adam moments shard like their param),
+        # batch feeds over the mesh's dp axis — no model edits
+        from ..parallel.partition_rules import (annotate_spmd,
+                                                train_partition_rules_for)
+
+        annotate_spmd(main, mesh, train_partition_rules_for(
+            getattr(hp, "partition_family", "transformer")))
     feeds = [
         "src_word", "trg_word", "lbl_word", "src_slf_attn_bias",
         "trg_slf_attn_bias", "trg_src_attn_bias", "lbl_weight",

@@ -470,7 +470,12 @@ def _fused_linear_xent_op(ctx, ins, attrs):
     x2 = x.reshape(-1, h)
     lbl = label.reshape(-1).astype(jnp.int32)
     if use_pallas():
-        loss2 = fused_linear_xent(x2, w, lbl, eps)
+        from .spmd_epilogue import spmd_linear_xent
+
+        loss2 = spmd_linear_xent(ctx, x2, w, lbl, eps,
+                                 bool(attrs.get("transpose_w", False)))
+        if loss2 is None:
+            loss2 = fused_linear_xent(x2, w, lbl, eps)
     else:
         loss2 = _linear_xent_dense(x2, w, lbl, eps)
     loss = loss2.reshape(tuple(x.shape[:-1]) + (1,)).astype(x.dtype)

@@ -431,7 +431,11 @@ def _fc(ctx, ins, attrs):
         and (bias is None or bias.shape[0] == w.shape[-1])
         and mm_epilogue_ok(M, K, w.shape[-1], act)
     ):
-        out = matmul_bias_act(x2, w, bias, act)
+        from .spmd_epilogue import spmd_matmul_bias_act
+
+        out = spmd_matmul_bias_act(ctx, x2, w, bias, act)
+        if out is None:
+            out = matmul_bias_act(x2, w, bias, act)
         return {"Out": [out.reshape(tuple(x.shape[:k]) + (w.shape[-1],))]}
     out = x2 @ w
     out = out.reshape(tuple(x.shape[:k]) + (w.shape[-1],))
@@ -461,7 +465,11 @@ def _fused_swiglu(ctx, ins, attrs):
     M, K = x2.shape
     N = wg.shape[-1]
     if use_pallas() and mm_epilogue_ok(M, K, N, extra_w=2):
-        out = matmul_swiglu(x2, wg, wu)
+        from .spmd_epilogue import spmd_matmul_swiglu
+
+        out = spmd_matmul_swiglu(ctx, x2, wg, wu)
+        if out is None:
+            out = matmul_swiglu(x2, wg, wu)
     else:
         out = _swiglu_dense(x2, wg, wu)
     return {"Out": [out.reshape(tuple(x.shape[:k]) + (N,))]}
@@ -488,7 +496,11 @@ def _fused_residual_ln(ctx, ins, attrs):
     gamma = ins["Scale"][0].reshape(h)
     beta = ins["Bias"][0].reshape(h)
     if use_pallas():
-        s2, o2 = fused_add_layer_norm(x2, y2, gamma, beta, eps)
+        from .spmd_epilogue import spmd_add_layer_norm
+
+        res = spmd_add_layer_norm(ctx, x2, y2, gamma, beta, eps)
+        s2, o2 = res if res is not None else fused_add_layer_norm(
+            x2, y2, gamma, beta, eps)
     else:
         s2, o2 = _add_ln_dense(x2, y2, gamma, beta, eps)
     s = s2.reshape(x.shape)

@@ -1396,31 +1396,33 @@ def _add_ln_dense(x2d, y2d, gamma, beta, eps):
             (yn * gamma + beta).astype(x2d.dtype))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
-def fused_add_layer_norm(x2d, y2d, gamma, beta, eps=1e-5):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def fused_add_layer_norm(x2d, y2d, gamma, beta, eps=1e-5, block_rows=None):
     """Residual add + row layer norm over [rows, hidden]; returns
     (sum, normalized) — the sum IS the residual stream, so callers that
     need it downstream read the fused op's first output instead of
-    keeping a separate add."""
+    keeping a separate add.  An explicit `block_rows` skips the tuning
+    search (shard_map bodies pin deterministic per-shard blocks)."""
     R, H = x2d.shape
-    block_rows = _tuned(
-        "add_layer_norm", [x2d.shape], x2d.dtype,
-        _row_block_candidates(R),
-        {"block_rows": _row_block(R, 256)},
-        build=lambda p: (lambda x, y, g, b: _add_ln_call(
-            x, y, g, b, eps, p["block_rows"])),
-        arg_specs=[(x2d.shape, x2d.dtype)] * 2
-        + [(gamma.shape, gamma.dtype), (beta.shape, beta.dtype)],
-    )["block_rows"]
+    if block_rows is None:
+        block_rows = _tuned(
+            "add_layer_norm", [x2d.shape], x2d.dtype,
+            _row_block_candidates(R),
+            {"block_rows": _row_block(R, 256)},
+            build=lambda p: (lambda x, y, g, b: _add_ln_call(
+                x, y, g, b, eps, p["block_rows"])),
+            arg_specs=[(x2d.shape, x2d.dtype)] * 2
+            + [(gamma.shape, gamma.dtype), (beta.shape, beta.dtype)],
+        )["block_rows"]
     return _add_ln_call(x2d, y2d, gamma, beta, eps, block_rows)
 
 
-def _add_ln_vjp_fwd(x2d, y2d, gamma, beta, eps):
-    return (fused_add_layer_norm(x2d, y2d, gamma, beta, eps),
+def _add_ln_vjp_fwd(x2d, y2d, gamma, beta, eps, block_rows):
+    return (fused_add_layer_norm(x2d, y2d, gamma, beta, eps, block_rows),
             (x2d, y2d, gamma, beta))
 
 
-def _add_ln_vjp_bwd(eps, res, cts):
+def _add_ln_vjp_bwd(eps, _block_rows, res, cts):
     x2d, y2d, gamma, beta = res
     _, vjp = jax.vjp(
         lambda x, y, g, b: _add_ln_dense(x, y, g, b, eps),
@@ -1490,9 +1492,13 @@ def _lxent_fwd_kernel(x_ref, w_ref, lbl_ref, loss_ref, lse_ref,
         lse_ref[:] = lse
 
 
-def _lxent_grad_tile(x, w, lbl, lse, dy, vi, block_v, vocab, eps):
+def _lxent_grad_tile(x, w, lbl, lse, dy, vi, block_v, vocab, eps,
+                     valid=None, vocab_total=None):
     """Shared backward tile math: g = dy * d loss / d z for this
-    [br, block_v] logits tile, recomputed from the saved lse."""
+    [br, block_v] logits tile, recomputed from the saved lse.  The
+    vocab-SHARDED form passes `valid` (row validity against the GLOBAL
+    vocab — local label coords can't derive it) and `vocab_total` (the
+    smoothing denominator spans every shard's columns)."""
     cols = vi * block_v + jax.lax.broadcasted_iota(
         jnp.int32, (1, block_v), 1)
     vmask = cols < vocab
@@ -1501,10 +1507,12 @@ def _lxent_grad_tile(x, w, lbl, lse, dy, vi, block_v, vocab, eps):
     p = jnp.where(vmask, jnp.exp(z - lse), 0.0)
     lbl = lbl.astype(jnp.int32).reshape(-1)
     onehot = (cols == lbl[:, None]).astype(jnp.float32)
-    valid = ((lbl >= 0) & (lbl < vocab)).astype(jnp.float32)[:, None]
+    if valid is None:
+        valid = ((lbl >= 0) & (lbl < vocab)).astype(jnp.float32)[:, None]
     g = valid * (1.0 - eps) * (p - onehot)
     if eps:
-        g = g + eps * (p - jnp.where(vmask, 1.0 / vocab, 0.0))
+        g = g + eps * (p - jnp.where(
+            vmask, 1.0 / (vocab_total or vocab), 0.0))
     return g * dy, w
 
 
@@ -1570,14 +1578,10 @@ def _lx_vmem_ok(H, br, bv):
     return tile < 12 * 2 ** 20
 
 
-def _lxent_blocks(R, H, V, dtype):
-    cands = []
-    for br in (128, 256, 512):
-        if R % br:
-            continue
-        for bv in (512, 1024, 2048):
-            if _lx_vmem_ok(H, br, bv):
-                cands.append({"block_r": br, "block_v": bv})
+def _lxent_default_blocks(R, H, V):
+    """The deterministic (block_r, block_v) seed — also the FIXED
+    choice inside shard_map (a per-shard tuning search there would
+    attribute collective time to block sizes, the qvec precedent)."""
     br0 = _row_block(R, 256)
     bv0 = min(V, 1024 if V % 128 == 0 else 2048)
     # shrink the seeded default until the dw pass fits VMEM (consult-
@@ -1586,6 +1590,18 @@ def _lxent_blocks(R, H, V, dtype):
     # bv0 == V full-dim block can't legally shrink and stays put
     while bv0 % 256 == 0 and bv0 > 128 and not _lx_vmem_ok(H, br0, bv0):
         bv0 //= 2
+    return br0, bv0
+
+
+def _lxent_blocks(R, H, V, dtype):
+    cands = []
+    for br in (128, 256, 512):
+        if R % br:
+            continue
+        for bv in (512, 1024, 2048):
+            if _lx_vmem_ok(H, br, bv):
+                cands.append({"block_r": br, "block_v": bv})
+    br0, bv0 = _lxent_default_blocks(R, H, V)
     default = {"block_r": br0, "block_v": bv0}
     params = _tuned(
         "linear_xent", [(R, H), (H, V)], dtype, cands, default,
@@ -1737,3 +1753,235 @@ def _lxent_vjp_bwd(eps, _block_r, _block_v, res, dy):
 
 
 fused_linear_xent.defvjp(_lxent_vjp_fwd, _lxent_vjp_bwd)
+
+
+# ---------------------------------------------------------------------------
+# vocab-SHARDED linear xent: the per-shard body the spmd_epilogue layer
+# runs inside shard_map when the rule table vocab-shards the projection
+# (softmax_out.w / tied emb.w).  Each shard streams only its [H, V/n]
+# weight slab; the online-logsumexp state that the unsharded kernel
+# keeps per row across vocab TILES is here combined per row across
+# vocab SHARDS with three scalar-per-row collectives (pmax/psum of
+# lse/gold/sum) — the [R, V] logits still never exist anywhere, now not
+# even per device.
+# ---------------------------------------------------------------------------
+def _lxent_parts_kernel(x_ref, w_ref, lbl_ref, lse_ref, gold_ref, sum_ref,
+                        m_ref, l_ref, g_acc, s_acc, *, block_v, nv, vocab):
+    """The fwd kernel's streaming pass with the LOSS ASSEMBLY removed:
+    outputs the per-row (lse, gold, sum) partials of THIS vocab shard.
+    `lbl` is in LOCAL column coords (label - shard_offset) — an
+    out-of-shard label matches no real column, and a padded-tail column
+    it might alias carries a zeroed weight, so gold accumulates 0."""
+    from jax.experimental import pallas as pl
+
+    vi = pl.program_id(1)
+
+    @pl.when(vi == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        g_acc[:] = jnp.zeros_like(g_acc)
+        s_acc[:] = jnp.zeros_like(s_acc)
+
+    cols = vi * block_v + jax.lax.broadcasted_iota(
+        jnp.int32, (1, block_v), 1)
+    vmask = cols < vocab
+    w = jnp.where(vmask, w_ref[:], 0.0)
+    z = jnp.dot(x_ref[:], w, preferred_element_type=jnp.float32)
+    lbl = lbl_ref[:].astype(jnp.int32).reshape(-1)
+    g_acc[:] += jnp.sum(
+        jnp.where(cols == lbl[:, None], z, 0.0), axis=1, keepdims=True)
+    s_acc[:] += jnp.sum(jnp.where(vmask, z, 0.0), axis=1, keepdims=True)
+    zm = jnp.where(vmask, z, NEG_INF)
+    m_prev = m_ref[:]
+    m_new = jnp.maximum(m_prev, jnp.max(zm, axis=1, keepdims=True))
+    l_ref[:] = (l_ref[:] * jnp.exp(m_prev - m_new)
+                + jnp.sum(jnp.exp(zm - m_new), axis=1, keepdims=True))
+    m_ref[:] = m_new
+
+    @pl.when(vi == nv - 1)
+    def _write():
+        lse_ref[:] = m_ref[:] + jnp.log(l_ref[:])
+        gold_ref[:] = g_acc[:]
+        sum_ref[:] = s_acc[:]
+
+
+def _lxent_parts(x2d, w, lbl_local, block_r, block_v):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    R, H = x2d.shape
+    V = w.shape[1]
+    _note("xent")
+    nr, nv = _cdiv(R, block_r), _cdiv(V, block_v)
+    x_spec, w_spec, row_spec = _lxent_specs(block_r, block_v, H)
+    return pl.pallas_call(
+        functools.partial(_lxent_parts_kernel, block_v=block_v, nv=nv,
+                          vocab=V),
+        grid=(nr, nv),
+        in_specs=[x_spec, w_spec, row_spec],
+        out_specs=[row_spec, row_spec, row_spec],
+        out_shape=[jax.ShapeDtypeStruct((R, 1), jnp.float32)] * 3,
+        scratch_shapes=[pltpu.VMEM((block_r, 1), jnp.float32)] * 4,
+        interpret=_interpret(),
+    )(x2d, w, lbl_local.astype(jnp.int32).reshape(R, 1))
+
+
+def _lxent_dx_kernel_sharded(x_ref, w_ref, lbl_ref, vld_ref, lse_ref,
+                             dy_ref, dx_ref, dx_acc,
+                             *, block_v, nv, vocab, vocab_total, eps):
+    from jax.experimental import pallas as pl
+
+    vi = pl.program_id(1)
+
+    @pl.when(vi == 0)
+    def _init():
+        dx_acc[:] = jnp.zeros_like(dx_acc)
+
+    g, w = _lxent_grad_tile(
+        x_ref[:], w_ref[:], lbl_ref[:], lse_ref[:].astype(jnp.float32),
+        dy_ref[:].astype(jnp.float32), vi, block_v, vocab, eps,
+        valid=vld_ref[:].astype(jnp.float32), vocab_total=vocab_total)
+    dx_acc[:] += jnp.dot(g.astype(x_ref.dtype), w.T,
+                         preferred_element_type=jnp.float32)
+
+    @pl.when(vi == nv - 1)
+    def _write():
+        dx_ref[:] = dx_acc[:].astype(dx_ref.dtype)
+
+
+def _lxent_dw_kernel_sharded(x_ref, w_ref, lbl_ref, vld_ref, lse_ref,
+                             dy_ref, dw_ref, dw_acc,
+                             *, block_v, nr, vocab, vocab_total, rows, eps):
+    from jax.experimental import pallas as pl
+
+    vi = pl.program_id(0)
+    ri = pl.program_id(1)
+
+    @pl.when(ri == 0)
+    def _init():
+        dw_acc[:] = jnp.zeros_like(dw_acc)
+
+    g, _w = _lxent_grad_tile(
+        x_ref[:], w_ref[:], lbl_ref[:], lse_ref[:].astype(jnp.float32),
+        dy_ref[:].astype(jnp.float32), vi, block_v, vocab, eps,
+        valid=vld_ref[:].astype(jnp.float32), vocab_total=vocab_total)
+    br = g.shape[0]
+    rr = ri * br + jax.lax.broadcasted_iota(jnp.int32, (br, 1), 0)
+    rmask = rr < rows
+    g = jnp.where(rmask, g, 0.0)
+    xt = jnp.where(rmask, x_ref[:], 0)
+    dw_acc[:] += jnp.dot(xt.T, g.astype(x_ref.dtype),
+                         preferred_element_type=jnp.float32)
+
+    @pl.when(ri == nr - 1)
+    def _write():
+        dw_ref[:] = dw_acc[:].astype(dw_ref.dtype)
+
+
+def _lxent_bwd_sharded(x2d, w, lbl_local, vld, lse, dy, eps, vocab_total,
+                       block_r, block_v):
+    """(dx_partial, dw_local) for this vocab shard: dx sums only the
+    local columns' contributions (the caller psums it over the vocab
+    axis), dw is the full gradient of the local slab (the shard_map
+    transpose psums it over any axis the weight is replicated on)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    R, H = x2d.shape
+    V = w.shape[1]
+    nr, nv = _cdiv(R, block_r), _cdiv(V, block_v)
+    lbl = lbl_local.astype(jnp.int32).reshape(R, 1)
+    vld2 = vld.reshape(R, 1).astype(jnp.float32)
+    lse2 = lse.reshape(R, 1)
+    dy2 = dy.reshape(R, 1).astype(jnp.float32)
+
+    x_spec, w_spec, row_spec = _lxent_specs(block_r, block_v, H)
+    dx = pl.pallas_call(
+        functools.partial(_lxent_dx_kernel_sharded, block_v=block_v,
+                          nv=nv, vocab=V, vocab_total=vocab_total,
+                          eps=float(eps)),
+        grid=(nr, nv),
+        in_specs=[x_spec, w_spec, row_spec, row_spec, row_spec, row_spec],
+        out_specs=x_spec,
+        out_shape=jax.ShapeDtypeStruct((R, H), x2d.dtype),
+        scratch_shapes=[pltpu.VMEM((block_r, H), jnp.float32)],
+        interpret=_interpret(),
+    )(x2d, w, lbl, vld2, lse2, dy2)
+
+    x_spec, w_spec, row_spec = _lxent_specs(block_r, block_v, H,
+                                            dw_grid=True)
+    dw = pl.pallas_call(
+        functools.partial(_lxent_dw_kernel_sharded, block_v=block_v,
+                          nr=nr, vocab=V, vocab_total=vocab_total,
+                          rows=R, eps=float(eps)),
+        grid=(nv, nr),
+        in_specs=[x_spec, w_spec, row_spec, row_spec, row_spec, row_spec],
+        out_specs=w_spec,
+        out_shape=jax.ShapeDtypeStruct((H, V), w.dtype),
+        scratch_shapes=[pltpu.VMEM((H, block_v), jnp.float32)],
+        interpret=_interpret(),
+    )(x2d, w, lbl, vld2, lse2, dy2)
+    return dx, dw
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def sharded_linear_xent(x2d, w_local, labels, eps, axis, vocab_total,
+                        block_r, block_v):
+    """Per-shard linear xent over the vocab axis `axis` of a live
+    shard_map: x2d [R, H] (this shard's rows), w_local [H, V/n] (this
+    shard's vocab slab), labels [R] in GLOBAL vocab coords.  Collectives
+    are per-row scalars only: pmax/psum combine each shard's online
+    (lse, gold, sum) into the global loss, and the backward psums dx
+    over the vocab shards.  Returns [R, 1] f32 losses on every shard."""
+    loss, _res = _sharded_lxent_fwd(x2d, w_local, labels, eps, axis,
+                                    vocab_total, block_r, block_v)
+    return loss
+
+
+def _sharded_lxent_fwd(x2d, w_local, labels, eps, axis, vocab_total,
+                       block_r, block_v):
+    R = x2d.shape[0]
+    v_local = w_local.shape[1]
+    col0 = jax.lax.axis_index(axis).astype(jnp.int32) * v_local
+    lbl = labels.astype(jnp.int32).reshape(R)
+    lbl_local = lbl - col0
+    lse_j, gold_j, sum_j = _lxent_parts(x2d, w_local, lbl_local,
+                                        block_r, block_v)
+    m = jax.lax.pmax(lse_j, axis)
+    lse = jnp.log(jax.lax.psum(jnp.exp(lse_j - m), axis)) + m
+    gold = jax.lax.psum(gold_j, axis)
+    sz = jax.lax.psum(sum_j, axis)
+    valid = ((lbl >= 0) & (lbl < vocab_total)).astype(
+        jnp.float32)[:, None]
+    loss = valid * (1.0 - eps) * (lse - gold)
+    if eps:
+        loss = loss + eps * (lse - sz / vocab_total)
+    return loss, (x2d, w_local, lbl_local, valid, lse)
+
+
+def _sharded_lxent_vjp_fwd(x2d, w_local, labels, eps, axis, vocab_total,
+                           block_r, block_v):
+    return _sharded_lxent_fwd(x2d, w_local, labels, eps, axis,
+                              vocab_total, block_r, block_v)
+
+
+def _sharded_lxent_vjp_bwd(eps, axis, vocab_total, block_r, block_v,
+                           res, dy):
+    x2d, w_local, lbl_local, valid, lse = res
+    # the loss leaves the enclosing shard_map through an out_spec that
+    # does NOT mention the vocab axis: the transpose SPLITS the global
+    # cotangent across the shards (only sum_j dy_j == dy is guaranteed).
+    # The tile math needs the full dy on every shard — reconstitute it
+    dy = jax.lax.psum(dy, axis)
+    dx_p, dw = _lxent_bwd_sharded(x2d, w_local, lbl_local, valid, lse,
+                                  dy, eps, vocab_total, block_r, block_v)
+    # dx stays the PARTIAL sum of this shard's columns: x enters the
+    # enclosing shard_map with the vocab axis unmentioned, and under
+    # check_rep=False the shard_map transpose itself psums such inputs'
+    # cotangents — an explicit psum here would double-count
+    dlbl = np.zeros(lbl_local.shape, dtype=jax.dtypes.float0)
+    return dx_p, dw, dlbl
+
+
+sharded_linear_xent.defvjp(_sharded_lxent_vjp_fwd, _sharded_lxent_vjp_bwd)

@@ -1,0 +1,298 @@
+"""shard_map dispatch for the matmul-epilogue pallas kernels under a
+GSPMD mesh — closing the PR 14 documented limit that the epilogue
+kernels operand-replicate inside a sharded step.
+
+``pallas_call`` has no SPMD partition rule: inside a GSPMD-stamped
+program an unwrapped kernel forces XLA to all-gather every operand onto
+each device, run the full kernel everywhere, and throw n-1 copies of
+the work away.  The qvec-attention lowering already solved this for the
+ragged serving step (``_qvec_attention_mesh``); this module generalizes
+the recipe to the fc / fused_swiglu / fused_residual_ln /
+fused_linear_xent lowerings:
+
+1. resolve the op's WEIGHT NAMES from the OpDesc being lowered
+   (``ctx.block.ops[ctx.op_idx]`` — the grad-side re-run of a forward
+   rule sees the same block through ``lower_grad_op``),
+2. look the names up in the live rule table (``current_spmd``) to
+   classify the layout — column-parallel, row-parallel, vocab-sharded,
+   or replicated-weights-with-dp-sharded-rows,
+3. run the SAME custom_vjp kernel per shard inside ``shard_map`` with
+   matching in/out specs.  ``check_rep=False`` autodiff supplies the
+   transpose-side psums for replicated operands; the only hand-written
+   collectives are the mathematical ones (the row-parallel epilogue's
+   partial-sum psum, the vocab-sharded xent's lse/gold/sum combine).
+
+Block sizes inside shard_map are the deterministic defaults computed
+from the LOCAL shard shapes — a per-shard tuning search would attribute
+collective time to block sizes (the qvec precedent).
+
+Every wrapper returns None when it declines (no mesh, mp=1 and dp=1,
+weight name unresolvable, layout not divisible) and the caller falls
+back to the unwrapped kernel — at mp=1 that keeps the single-device
+trace BIT-IDENTICAL.
+"""
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "mesh_ctx", "op_weight_name", "spmd_matmul_bias_act",
+    "spmd_matmul_swiglu", "spmd_add_layer_norm", "spmd_linear_xent",
+]
+
+
+def mesh_ctx():
+    """(mesh, rules, mp_axis, nsh, dp_axis, ndp) when tracing under a
+    live spmd_lowering context with something to shard over, else
+    None."""
+    from ..parallel.mesh import mesh_axis_sizes
+    from ..parallel.partition_rules import current_spmd
+
+    spmd = current_spmd()
+    if spmd is None:
+        return None
+    mesh, rules = spmd
+    sizes = mesh_axis_sizes(mesh)
+    mp = rules.mp_axis
+    nsh = int(sizes.get(mp, 1))
+    dp_axis = getattr(rules, "dp_axis", None)
+    ndp = int(sizes.get(dp_axis, 1)) if dp_axis else 1
+    if nsh <= 1 and ndp <= 1:
+        return None
+    return mesh, rules, mp, nsh, dp_axis, ndp
+
+
+def op_weight_name(ctx, expected_type, slot):
+    """The var name feeding `slot` of the op being lowered, resolved
+    through ctx.block + ctx.op_idx ((block_idx << 20) | idx on the
+    forward trace, the plain forward index on the grad-side re-run).
+    None when the context carries no block or the op type disagrees —
+    callers MUST fall back to the unwrapped kernel then."""
+    blk = getattr(ctx, "block", None)
+    if blk is None:
+        return None
+    idx = int(getattr(ctx, "op_idx", 0)) & ((1 << 20) - 1)
+    if idx >= len(blk.ops):
+        return None
+    op = blk.ops[idx]
+    if op.type != expected_type:
+        return None
+    names = op.input(slot)
+    return names[0] if names else None
+
+
+def _dim_has(spec, d, axis):
+    """Does PartitionSpec `spec` place mesh axis `axis` on dim `d`?"""
+    if spec is None or len(spec) <= d:
+        return False
+    e = tuple(spec)[d]
+    return e == axis or (isinstance(e, tuple) and axis in e)
+
+
+def _row_axis(dp_axis, ndp, rows):
+    """The activation-rows mesh axis: the dp axis when it exists and
+    divides the flattened row count, else None (rows replicate)."""
+    return dp_axis if (dp_axis and ndp > 1 and rows % ndp == 0) else None
+
+
+def _shard_map(mesh, body, in_specs, out_specs):
+    from ..parallel.mesh import shard_map
+
+    return shard_map(body, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_rep=False)
+
+
+def spmd_matmul_bias_act(ctx, x2, w, bias, act):
+    """Mesh-aware matmul_bias_act: column-parallel (w P(·, mp): local
+    columns, no collective — bias slices with its column), row-parallel
+    (w P(mp, ·): partial sums psum'd, bias + act applied AFTER the
+    combine), or replicated-w with dp-sharded rows.  None -> unwrapped."""
+    from jax.sharding import PartitionSpec as P
+
+    from .pallas_kernels import _mm_act, _mm_col_block, _row_block, \
+        matmul_bias_act
+
+    mc = mesh_ctx()
+    if mc is None:
+        return None
+    mesh, rules, mp, nsh, dp_axis, ndp = mc
+    wname = op_weight_name(ctx, "fc", "W")
+    if wname is None:
+        return None
+    spec = rules.spec_for(wname, tuple(w.shape))
+    M, K = x2.shape
+    N = w.shape[1]
+    row = _row_axis(dp_axis, ndp, M)
+    nrow = ndp if row else 1
+    col_par = nsh > 1 and _dim_has(spec, 1, mp) and N % nsh == 0
+    row_par = nsh > 1 and _dim_has(spec, 0, mp) and K % nsh == 0
+
+    if col_par:
+        bm = _row_block(M // nrow, 256)
+        bn = _mm_col_block(N // nsh, 256)
+
+        def body(xl, wl, bl):
+            return matmul_bias_act(xl, wl, bl, act, bm, bn)
+
+        in_specs = (P(row, None), P(None, mp), P(mp))
+        out_spec = P(row, mp)
+        if bias is None:
+            body, in_specs = (lambda xl, wl:
+                              matmul_bias_act(xl, wl, None, act, bm, bn)
+                              ), in_specs[:2]
+            return _shard_map(mesh, body, in_specs, out_spec)(x2, w)
+        return _shard_map(mesh, body, in_specs, out_spec)(x2, w, bias)
+
+    if row_par:
+        bm = _row_block(M // nrow, 256)
+        bn = _mm_col_block(N, 256)
+
+        def body(xl, wl, *b):
+            z = matmul_bias_act(xl, wl, None, "", bm, bn)
+            z = jax.lax.psum(z.astype(jnp.float32), mp)
+            if b:
+                z = z + b[0].reshape(1, -1).astype(jnp.float32)
+            return _mm_act(z, act).astype(xl.dtype)
+
+        in_specs = (P(row, mp), P(mp, None))
+        args = (x2, w)
+        if bias is not None:
+            in_specs = in_specs + (P(None),)
+            args = args + (bias,)
+        return _shard_map(mesh, body, in_specs, P(row, None))(*args)
+
+    if row is None:
+        return None
+    bm = _row_block(M // nrow, 256)
+    bn = _mm_col_block(N, 256)
+
+    def body(xl, wl, *b):
+        return matmul_bias_act(xl, wl, b[0] if b else None, act, bm, bn)
+
+    in_specs = (P(row, None), P(None, None))
+    args = (x2, w)
+    if bias is not None:
+        in_specs = in_specs + (P(None),)
+        args = args + (bias,)
+    return _shard_map(mesh, body, in_specs, P(row, None))(*args)
+
+
+def spmd_matmul_swiglu(ctx, x2, wg, wu):
+    """Mesh-aware matmul_swiglu: the gate/up pair is column-parallel
+    when BOTH weights carry P(·, mp) (silu and the product are
+    element-wise in the sharded column space); otherwise rows-only when
+    dp divides."""
+    from jax.sharding import PartitionSpec as P
+
+    from .pallas_kernels import _mm_col_block, _row_block, matmul_swiglu
+
+    mc = mesh_ctx()
+    if mc is None:
+        return None
+    mesh, rules, mp, nsh, dp_axis, ndp = mc
+    gname = op_weight_name(ctx, "fused_swiglu", "GateW")
+    uname = op_weight_name(ctx, "fused_swiglu", "UpW")
+    if gname is None or uname is None:
+        return None
+    gspec = rules.spec_for(gname, tuple(wg.shape))
+    uspec = rules.spec_for(uname, tuple(wu.shape))
+    M, K = x2.shape
+    N = wg.shape[1]
+    row = _row_axis(dp_axis, ndp, M)
+    nrow = ndp if row else 1
+    col_par = (nsh > 1 and N % nsh == 0
+               and _dim_has(gspec, 1, mp) and _dim_has(uspec, 1, mp))
+    if not col_par and (row is None or _dim_has(gspec, 1, mp)
+                        or _dim_has(uspec, 1, mp)):
+        return None
+    wspec = P(None, mp) if col_par else P(None, None)
+    ncol = nsh if col_par else 1
+    bm = _row_block(M // nrow, 256)
+    bn = _mm_col_block(N // ncol, 256)
+
+    def body(xl, wgl, wul):
+        return matmul_swiglu(xl, wgl, wul, bm, bn)
+
+    return _shard_map(
+        mesh, body, (P(row, None), wspec, wspec),
+        P(row, mp) if col_par else P(row, None))(x2, wg, wu)
+
+
+def spmd_add_layer_norm(ctx, x2, y2, gamma, beta, eps):
+    """Mesh-aware fused_add_layer_norm: rows are independent, so the
+    kernel shards over dp rows with gamma/beta replicated.  (The hidden
+    axis never shards in the decoder tables — LN reduces over it.)"""
+    from jax.sharding import PartitionSpec as P
+
+    from .pallas_kernels import _row_block, fused_add_layer_norm
+
+    mc = mesh_ctx()
+    if mc is None:
+        return None
+    mesh, rules, mp, nsh, dp_axis, ndp = mc
+    row = _row_axis(dp_axis, ndp, x2.shape[0])
+    if row is None:
+        return None
+    br = _row_block(x2.shape[0] // ndp, 256)
+
+    def body(xl, yl, g, b):
+        return fused_add_layer_norm(xl, yl, g, b, eps, br)
+
+    rs = P(row, None)
+    return _shard_map(mesh, body, (rs, rs, P(None), P(None)),
+                      (rs, rs))(x2, y2, gamma, beta)
+
+
+def spmd_linear_xent(ctx, x2, w, labels, eps, transpose_w):
+    """Mesh-aware fused_linear_xent: when the projection weight is
+    vocab-sharded (softmax_out.w P(None, mp), or tied emb.w P(mp, None)
+    arriving transposed), each shard streams its own [H, V/n] slab
+    through sharded_linear_xent — per-row scalar collectives combine
+    the shards' online (lse, gold, sum).  Rows additionally shard over
+    dp.  `w` is the value ALREADY transposed to [H, V]; `transpose_w`
+    says which dim of the DECLARED weight the rule table sees as
+    vocab."""
+    from jax.sharding import PartitionSpec as P
+
+    from .pallas_kernels import _lxent_default_blocks, fused_linear_xent, \
+        sharded_linear_xent
+
+    mc = mesh_ctx()
+    if mc is None:
+        return None
+    mesh, rules, mp, nsh, dp_axis, ndp = mc
+    wname = op_weight_name(ctx, "fused_linear_xent", "W")
+    if wname is None:
+        return None
+    decl_shape = tuple(w.shape[::-1]) if transpose_w else tuple(w.shape)
+    spec = rules.spec_for(wname, decl_shape)
+    vdim = 0 if transpose_w else 1
+    R, H = x2.shape
+    V = w.shape[1]
+    if _dim_has(spec, 1 - vdim, mp):
+        return None  # hidden-sharded projection: not a supported layout
+    vocab_sharded = nsh > 1 and _dim_has(spec, vdim, mp) and V % nsh == 0
+    row = _row_axis(dp_axis, ndp, R)
+    nrow = ndp if row else 1
+    if not vocab_sharded and row is None:
+        return None
+
+    if vocab_sharded:
+        br, bv = _lxent_default_blocks(R // nrow, H, V // nsh)
+
+        def body(xl, wl, ll):
+            return sharded_linear_xent(xl, wl, ll.reshape(-1), eps, mp,
+                                       V, br, bv)
+
+        return _shard_map(
+            mesh, body, (P(row, None), P(None, mp), P(row)),
+            P(row, None))(x2, w, labels.reshape(R))
+
+    br, bv = _lxent_default_blocks(R // nrow, H, V)
+
+    def body(xl, wl, ll):
+        return fused_linear_xent(xl, wl, ll.reshape(-1), eps, br, bv)
+
+    return _shard_map(
+        mesh, body, (P(row, None), P(None, None), P(row)),
+        P(row, None))(x2, w, labels.reshape(R))

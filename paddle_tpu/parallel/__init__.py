@@ -16,10 +16,12 @@ from .mesh import make_mesh, default_mesh, mesh_axis_sizes
 from .sharding import (ShardingRules, data_parallel_rules,
                        kv_cache_sp_rules, transformer_tp_rules,
                        zero1_rules, zero3_rules)
-from .partition_rules import (PartitionRules, annotate_spmd,
-                              current_spmd, partition_rules_for,
+from .partition_rules import (PartitionRules, TrainPartitionRules,
+                              annotate_spmd, current_spmd,
+                              partition_rules_for,
                               register_partition_rules,
-                              registered_families, spmd_lowering)
+                              registered_families, spmd_lowering,
+                              train_partition_rules_for)
 from .executor import DistributedExecutor
 from . import ring
 from . import ulysses

@@ -35,7 +35,8 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 __all__ = [
-    "PartitionRules", "register_partition_rules", "partition_rules_for",
+    "PartitionRules", "TrainPartitionRules", "register_partition_rules",
+    "partition_rules_for", "train_partition_rules_for",
     "registered_families", "annotate_spmd", "spmd_lowering",
     "current_spmd", "P",
 ]
@@ -129,6 +130,68 @@ class PartitionRules:
         before = len(self.replicated_log)
         specs = {n: self.spec_for(n, s) for n, s in named_shapes.items()}
         return specs, self.replicated_log[before:]
+
+
+# ---------------------------------------------------------------------------
+# training derived names: grads and optimizer state follow their param
+# ---------------------------------------------------------------------------
+# <param>@GRAD — the backward.py convention the PR 13 verifier models
+_GRAD_SUFFIX = re.compile(r"@GRAD(?:@RENAME@.*)?$")
+# <param>_<kind>_<n> — the exact Optimizer._add_accumulator kinds (the
+# same list parallel/sharding.py's zero1_rules keys on); *_pow_acc
+# scalars are deliberately absent (the scalar guard replicates them)
+_ACC_SUFFIX = re.compile(
+    r"_(moment[12]?|momentum|velocity|inf_norm|_avg_squared_grad|"
+    r"_avg_squared_update|mean_square|mean_grad|squared|linear)"
+    r"(_\d+)?$")
+# bf16 AMP cast intermediates mirror <var>@RAW_BF16; master params keep
+# the param's own name (and therefore its spec) — nothing to strip there
+_CAST_SUFFIX = re.compile(r"@RAW_BF16$")
+
+
+class TrainPartitionRules(PartitionRules):
+    """The training extension of the serving rule table: ONE table
+    covers params AND every name training derives from them —
+
+    - ``<param>@GRAD`` shards like its param (the partial-sum
+      all-reduce the SPMD partitioner emits is the PR 6 allreduce-mean
+      on the dp axis of the same mesh);
+    - optimizer accumulators ``<param>_<kind>_<n>`` shard like their
+      param — ZeRO-style sharded optimizer state as a registry pass
+      (``beta*_pow_acc`` [1]-scalars hit the scalar guard and
+      replicate, unlogged);
+    - bf16 AMP cast mirrors ``<var>@RAW_BF16`` follow the base var;
+      f32 master params carry the param's own name, so they keep its
+      spec with no extra rule.
+
+    ``dp_axis`` names the data-parallel mesh axis the executor shards
+    feed batches over (replicated when absent from the mesh)."""
+
+    def __init__(self, rules=None, mp_axis="mp", dp_axis="dp"):
+        super(TrainPartitionRules, self).__init__(rules, mp_axis=mp_axis)
+        self.dp_axis = dp_axis
+
+    @staticmethod
+    def base_name(name):
+        """Strip the derived-name suffixes down to the param name:
+        grad first (a grad of a cast is <x>@RAW_BF16@GRAD), then the
+        cast mirror, then ONE accumulator suffix."""
+        name = _GRAD_SUFFIX.sub("", name)
+        name = _CAST_SUFFIX.sub("", name)
+        return _ACC_SUFFIX.sub("", name)
+
+    def match(self, name):
+        return super(TrainPartitionRules, self).match(self.base_name(name))
+
+
+def train_partition_rules_for(family, mp_axis="mp", dp_axis="dp"):
+    """The registered family table lifted to TRAINING resolution: the
+    same rule list as ``partition_rules_for`` wrapped so grads and
+    optimizer state resolve through their param's rule."""
+    base = partition_rules_for(family, mp_axis)
+    tr = TrainPartitionRules(mp_axis=base.mp_axis, dp_axis=dp_axis)
+    tr.rules = list(base.rules)
+    return tr
 
 
 # ---------------------------------------------------------------------------

@@ -6,6 +6,15 @@ PR 11's ``ops/kernel_tuning.py`` made every pallas_call's block sizes a
 searched, cached decision; this module lifts the same discipline one
 level up, to knobs that select between whole PROGRAMS:
 
+* ``mesh_shape``        — (dp, mp) GSPMD training mesh, None = no mesh
+                          (a rebuild knob: the builder stamps the
+                          candidate mesh via annotate_spmd + the train
+                          rule table; shapes the visible device count
+                          cannot host are never tried)
+* ``rule_table``        — partition rules under a mesh: the registered
+                          "family" table vs "replicated" (dp-only —
+                          params stay replicated, the batch feeds still
+                          shard); searched only once a mesh is in play
 * ``bf16_amp``          — the bf16_amp_pass rewrite on/off (a rebuild
                           knob: AMP must precede minimize, so searching
                           it needs a ``variants`` builder callback)
@@ -60,6 +69,11 @@ __all__ = [
 ]
 
 DEFAULT_DECISION = {
+    "mesh_shape": None,          # (dp, mp) GSPMD mesh, None = no mesh
+    "rule_table": "family",      # partition rules under a mesh:
+    #                              "family" = the registered table,
+    #                              "replicated" = params stay replicated
+    #                              (dp-only sharding via the batch feeds)
     "bf16_amp": False,
     "remat": 0,
     "prng_impl": "threefry",
@@ -69,9 +83,10 @@ DEFAULT_DECISION = {
 }
 
 # search order: rebuild knobs first (they change the op mix every later
-# flag knob runs under), dispatch-schedule last
-_KNOB_ORDER = ("bf16_amp", "remat", "prng_impl", "use_pallas",
-               "steps_per_dispatch")
+# flag knob runs under) — the mesh before the rewrites that must compose
+# with it — dispatch-schedule last
+_KNOB_ORDER = ("mesh_shape", "rule_table", "bf16_amp", "remat",
+               "prng_impl", "use_pallas", "steps_per_dispatch")
 
 _lock = threading.RLock()
 _cache = None
@@ -163,9 +178,26 @@ def tuned_flags(decision):
     return out
 
 
-def _candidates_for(knob, rebuild, program):
+def _candidates_for(knob, rebuild, program, best=None):
     from .remat import detect_segments
 
+    if knob == "mesh_shape":
+        # rebuild knob: the builder stamps the program for the candidate
+        # dp x mp mesh (annotate_spmd + train rules) — only shapes the
+        # visible device count can host are tried
+        if rebuild is None:
+            return []
+        import jax
+
+        n = len(jax.devices())
+        return [(dp, mp) for dp, mp in ((2, 1), (1, 2), (2, 2))
+                if dp * mp <= n]
+    if knob == "rule_table":
+        # only meaningful once a mesh is in play: without one the table
+        # never resolves, so the candidate would re-time the baseline
+        if rebuild is None or not (best or {}).get("mesh_shape"):
+            return []
+        return ["family", "replicated"]
     if knob == "bf16_amp":
         return [False, True] if rebuild is not None else []
     if knob == "remat":
@@ -197,7 +229,10 @@ def _measure_decision(decision, program, startup, feed_spec, fetches,
 
     main, startup_p, fetch_list = program, startup, fetches
     if rebuild is not None and (decision.get("bf16_amp")
-                                or decision.get("remat")):
+                                or decision.get("remat")
+                                or decision.get("mesh_shape")
+                                or decision.get("rule_table",
+                                                "family") != "family"):
         main, startup_p, fetch_list = rebuild(decision)
     saved = flag_items()
     set_flags(tuned_flags(decision))
@@ -266,6 +301,8 @@ def tune(program, feed_spec, startup=None, fetches=None, rebuild=None,
             _stats["hits"] += 1
             d = dict(DEFAULT_DECISION)
             d.update(hit["decision"])
+            if isinstance(d.get("mesh_shape"), list):  # JSON round-trip
+                d["mesh_shape"] = tuple(d["mesh_shape"])
             return d
         _stats["misses"] += 1
 
@@ -291,7 +328,7 @@ def tune(program, feed_spec, startup=None, fetches=None, rebuild=None,
             for knob in _KNOB_ORDER:
                 if trials >= max_trials:
                     break
-                for cand in _candidates_for(knob, rebuild, program):
+                for cand in _candidates_for(knob, rebuild, program, best):
                     if cand == best.get(knob) or (
                             knob == "use_pallas"
                             and best.get(knob) is None
@@ -337,6 +374,8 @@ def tune(program, feed_spec, startup=None, fetches=None, rebuild=None,
             _save_locked()
     d = dict(DEFAULT_DECISION)
     d.update(entry["decision"])
+    if isinstance(d.get("mesh_shape"), list):  # JSON round-trip
+        d["mesh_shape"] = tuple(d["mesh_shape"])
     return d
 
 

@@ -410,19 +410,35 @@ def remat_program(program, budget_bytes, loss_name, feed_names=None,
     return report
 
 
-def maybe_remat(program, loss, is_test=False, batch_hint=8):
+def maybe_remat(program, loss, is_test=False, batch_hint=8, mesh=None):
     """Builder hook: budgeted remat under FLAGS_hbm_budget_bytes.
 
     Called by the model builders between the fuse/AMP passes and
     ``minimize`` — a no-op unless the flag is set (> 0 bytes), so the
-    default build is untouched.  Returns the remat report or None."""
+    default build is untouched.  Returns the remat report or None.
+
+    The flag is a PER-DEVICE budget.  Under a GSPMD mesh the estimator
+    still sees the global (unsharded) program, but the partitioner
+    splits activations across the mesh — dp shards every row dim, mp
+    shards the ffn/vocab column dims — so the global estimate maps to
+    roughly budget x n_devices.  Scaling the budget (instead of the
+    estimate) keeps the report's before/after numbers in global terms,
+    comparable across mesh shapes."""
     from ..flags import get_flag
 
     budget = int(get_flag("hbm_budget_bytes"))
     if is_test or budget <= 0:
         return None
+    n_shards = 1
+    if mesh is not None:
+        for s in mesh.devices.shape:
+            n_shards *= int(s)
     name = loss.name if hasattr(loss, "name") else str(loss)
-    return remat_program(program, budget, name, batch_hint=batch_hint)
+    report = remat_program(program, budget * n_shards, name,
+                           batch_hint=batch_hint)
+    report["per_device_budget_bytes"] = budget
+    report["mesh_shards"] = n_shards
+    return report
 
 
 @register_pass("remat_pass")

@@ -156,6 +156,20 @@ FLAGS_kernel_tune_cache=tests/data/ci_tuning_cache.json \
     python -m pytest tests/test_serving_tp.py tests/test_serving.py \
     -q -m ""
 
+echo "== spmd-training lane (4-device GSPMD dp x mp mesh) =="
+# tensor-parallel TRAINING on the CI mesh (2x2 virtual devices): the
+# train-lifted rule registry (grads + Adam moments shard like their
+# param — ZeRO-style state, provably sharded by per-device bytes),
+# mp=1 bit-exactness vs the unstamped program, mp=2 rtol parity across
+# all three mesh shapes, the remat / bf16-AMP compose legs, comm-stats
+# reporting, and the shard_map-wrapped epilogue kernels dispatching
+# inside the sharded step (interpret mode, pinned tuning cache — CI
+# never searches block sizes)
+XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+FLAGS_kernel_autotune=0 \
+FLAGS_kernel_tune_cache=tests/data/ci_tuning_cache.json \
+    python -m pytest tests/test_spmd_training.py -q -m ""
+
 echo "== fabric-chaos pass (multi-pool router degradation) =="
 # the serving fabric end to end under the SAME pinned fault seed:
 # kill-a-pool-mid-stream failover (affected requests finish on

@@ -268,6 +268,115 @@ def test_diag_alias_mismatch():
     assert not alias_plan_diagnostics(b, {})
 
 
+def test_diag_sharding_coverage_divisibility_inconsistency():
+    """The GSPMD rule-table classes: an unmatched matrix warns
+    (replicated-by-default), a non-dividing sharded dim warns, and a
+    derived name resolving unlike its base param errors."""
+    import jax
+
+    from paddle_tpu.analysis import sharding_diagnostics
+    from paddle_tpu.parallel import make_mesh
+    from paddle_tpu.parallel.partition_rules import (
+        P, PartitionRules, TrainPartitionRules)
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices for a named mesh axis")
+    mesh = make_mesh({"mp": 2}, devices=jax.devices()[:2])
+    p = _prog()
+    b = p.global_block()
+    b.create_var(name="fc_0.w_0", shape=[8, 6], dtype="float32",
+                 persistable=True)
+    b.create_var(name="odd.w_0", shape=[8, 5], dtype="float32",
+                 persistable=True)
+    b.create_var(name="ln_0.w_0", shape=[8], dtype="float32",
+                 persistable=True)
+
+    # coverage: fc_0.w_0 (a matrix) matches nothing; the 1-D ln scale
+    # also matches nothing but replicating vectors is by design
+    diags = sharding_diagnostics(
+        p, mesh=mesh, rules=PartitionRules([(r"odd\.w", P(None, "mp"))]))
+    cov = [d for d in diags if d.code == "sharding-coverage"]
+    assert [("fc_0.w_0" in str(d)) for d in cov] == [True]
+    assert not any("ln_0.w_0" in str(d) for d in diags)
+    # divisibility: odd.w_0 dim1=5 does not divide mp=2
+    d = _find(diags, "sharding-divisibility")
+    assert not d.is_error and "odd.w_0" in str(d) and "mp=2" in str(d)
+
+    # inconsistency: a PLAIN rule table (no base_name stripping on
+    # spec_for) whose grad rule disagrees with its param rule
+    class SplitRules(PartitionRules):
+        base_name = staticmethod(TrainPartitionRules.base_name)
+
+    bad = SplitRules([
+        (r"fc_0\.w_0@GRAD", P("mp", None)),
+        (r"fc_0\.w_0", P(None, "mp")),
+    ])
+    b.create_var(name="fc_0.w_0@GRAD", shape=[8, 6], dtype="float32")
+    d = _find(sharding_diagnostics(p, mesh=mesh, rules=bad),
+              "sharding-inconsistency")
+    assert d.is_error and "fc_0.w_0@GRAD" in str(d)
+
+    # the TRAIN wrapper resolves derived names via base_name: clean
+    ok = TrainPartitionRules([(r"fc_0\.w_0", P(None, "mp")),
+                              (r"odd\.w", P())])
+    assert not sharding_diagnostics(p, mesh=mesh, rules=ok)
+
+    # stamped programs route through verify_program automatically
+    from paddle_tpu.parallel import annotate_spmd
+
+    annotate_spmd(p, mesh, ok)
+    assert not [d for d in verify_program(p)
+                if d.code.startswith("sharding")]
+
+
+def test_while_carried_shape_fixpoint():
+    """A while body growing a carried dim must widen it to -1 (unknown)
+    instead of pinning iteration 0's value — and must not emit
+    iteration-0-only shape-mismatch diagnostics (bounded fixpoint in
+    analysis/infer.py)."""
+    from paddle_tpu.analysis.infer import infer_program
+
+    p = _prog()
+    b = p.global_block()
+    b.create_var(name="acc", shape=[-1, 4], dtype="float32")
+    b.create_var(name="x0", shape=[1, 4], dtype="float32", is_data=True)
+    b.create_var(name="cond", shape=[1], dtype="bool")
+    b.append_op("fill_constant", inputs={}, outputs={"Out": ["acc"]},
+                attrs={"shape": [2, 4], "value": 0.0, "dtype": "float32"})
+    sub = p.create_block(parent_idx=0)
+    sub.create_var(name="grown", shape=[-1, 4], dtype="float32")
+    sub.append_op("concat", inputs={"X": ["acc", "x0"]},
+                  outputs={"Out": ["grown"]}, attrs={"axis": 0})
+    sub.append_op("assign", inputs={"X": ["grown"]}, outputs={"Out": ["acc"]})
+    b.append_op("while", inputs={"Condition": ["cond"]},
+                outputs={"Out": ["acc"]},
+                attrs={"sub_block_idx": sub.idx, "carried_vars": ["acc"]})
+
+    reports = []
+    env = infer_program(
+        p, feeds=["x0"],
+        report=lambda c, s, bi, oi, op, m: reports.append((c, m)))
+    # iteration 0 would say (3, 4); the fixpoint widens the fed-back dim
+    assert env["acc"].shape == (-1, 4), env["acc"]
+    assert env["grown"].shape == (-1, 4), env["grown"]
+    assert reports == [], reports
+
+    # a shape-STABLE body converges and keeps its concrete dims
+    p2 = _prog()
+    b2 = p2.global_block()
+    b2.create_var(name="s", shape=[2, 4], dtype="float32", is_data=True)
+    b2.create_var(name="cond", shape=[1], dtype="bool")
+    sub2 = p2.create_block(parent_idx=0)
+    sub2.create_var(name="t", shape=[2, 4], dtype="float32")
+    sub2.append_op("relu", inputs={"X": ["s"]}, outputs={"Out": ["t"]})
+    sub2.append_op("assign", inputs={"X": ["t"]}, outputs={"Out": ["s"]})
+    b2.append_op("while", inputs={"Condition": ["cond"]},
+                 outputs={"Out": ["s"]},
+                 attrs={"sub_block_idx": sub2.idx, "carried_vars": ["s"]})
+    env2 = infer_program(p2, feeds=["s"])
+    assert env2["s"].shape == (2, 4)
+
+
 def test_segment_diagnostics_back_remat_refusal():
     """remat._wrappable delegates here: persistable writes and cross-
     boundary redefinition refuse, a clean segment passes."""
