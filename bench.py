@@ -1142,19 +1142,12 @@ def _fabric_bench(on_tpu, device):
                                 t_max=t_max)
         return eng, scope
 
-    def leg(n_pools, schedule=None, faults=None):
-        # depth sized to the workload: the bench pins latency under
-        # load, the loud-rejection contract is pinned by the tests
-        router = FabricRouter(factory, n_pools=n_pools,
-                              queue_depth=n_req,
-                              fault_schedule=faults)
-        results, stats = router.run(trace(), pool_schedule=schedule)
+    def pct(vals, p):
+        return vals[min(len(vals) - 1, int(p * len(vals)))]
+
+    def metrics(results, stats):
         lat = sorted(r["latency_steps"] for r in results.values()
                      if r["status"] == "OK")
-
-        def pct(vals, p):
-            return vals[min(len(vals) - 1, int(p * len(vals)))]
-
         ok = sum(r["status"] == "OK" for r in results.values())
         return {
             "value": stats["tokens_per_s"],
@@ -1175,6 +1168,64 @@ def _fabric_bench(on_tpu, device):
                 for pid, p in stats["pools"].items()},
             "fabric_steps": stats["step"],
         }
+
+    def leg(n_pools, schedule=None, faults=None):
+        # depth sized to the workload: the bench pins latency under
+        # load, the loud-rejection contract is pinned by the tests
+        router = FabricRouter(factory, n_pools=n_pools,
+                              queue_depth=n_req,
+                              fault_schedule=faults)
+        results, stats = router.run(trace(), pool_schedule=schedule)
+        return metrics(results, stats)
+
+    # --- process-mode legs: REAL pool-worker subprocesses over RPC ---
+    proc_hp = {"vocab_size": HP.vocab_size, "n_ctx": HP.n_ctx,
+               "d_model": HP.d_model, "n_layer": HP.n_layer,
+               "n_head": HP.n_head, "dropout": 0.0}
+
+    def proc_factory():
+        from paddle_tpu.serving import spawn_pool_worker
+
+        # workers always decode on CPU: N extra processes must not
+        # contend for the chip the in-process legs are benching
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        return spawn_pool_worker(hp_overrides=proc_hp, n_slots=slots,
+                                 width=width, t_max=t_max, seed=23,
+                                 env=env)
+
+    def proc_leg(n_pools, faults=None):
+        import time as _t
+
+        from paddle_tpu.distributed.rpc import CallPolicy
+
+        router = FabricRouter(
+            proc_factory, n_pools=n_pools, queue_depth=n_req,
+            pool_mode="process",
+            rpc_policy=CallPolicy(timeout_s=5.0, deadline_s=10.0,
+                                  attempts=2,
+                                  verb_deadlines={"submit": 5.0,
+                                                  "shutdown": 2.0}),
+            fault_schedule=faults)
+        # RPC-hop overhead: round-trips of the no-op `results` verb
+        # against one idle worker — the pure wire cost every fabric
+        # step pays per pool on top of the engine step itself
+        h0 = sorted(router.pools.values(), key=lambda h: h.pid)[0]
+        hops = []
+        for _ in range(50):
+            t0 = _t.perf_counter()
+            h0.engine.policy.call(h0.engine._cli, "results", ack=[])
+            hops.append((_t.perf_counter() - t0) * 1e3)
+        hops.sort()
+        try:
+            results, stats = router.run(trace())
+        finally:
+            for h in list(router.pools.values()):
+                h.engine.close(kill=False)
+        m = metrics(results, stats)
+        m["rpc_hop_ms_p50"] = round(pct(hops, 0.50), 3)
+        m["rpc_hop_ms_p99"] = round(pct(hops, 0.99), 3)
+        return m
 
     out = {"slots": slots, "width": width, "requests": n_req,
            "rate": rate}
@@ -1197,10 +1248,29 @@ def _fabric_bench(on_tpu, device):
     out["chaos_pool_kill"]["kill_step"] = kill_t
     sys.stderr.write("FABRIC_RESULT chaos_pool_kill %s\n"
                      % json.dumps(out["chaos_pool_kill"]))
+    # (d) the SAME trace through 3 REAL worker processes (CPU decode)
+    # — tok/s vs the in-process fleet plus the per-hop RPC overhead —
+    # and (e) its chaos twin with ONE worker SIGKILL'd mid-stream
+    # (pool_proc_kill): detection bounded by the CallPolicy deadline,
+    # every stream still completes via the replay path
+    out["process_3_pool"] = proc_leg(3)
+    sys.stderr.write("FABRIC_RESULT process_3_pool %s\n"
+                     % json.dumps(out["process_3_pool"]))
+    out["chaos_proc_kill"] = proc_leg(
+        3, faults=FaultSchedule({"fabric": {kill_t: "pool_proc_kill"}},
+                                seed=seed))
+    out["chaos_proc_kill"]["fault_seed"] = seed
+    out["chaos_proc_kill"]["kill_step"] = kill_t
+    sys.stderr.write("FABRIC_RESULT chaos_proc_kill %s\n"
+                     % json.dumps(out["chaos_proc_kill"]))
     base = out["static_3_pool"]["p99_latency_steps"] or 1
     if out["scale_1_3_1"]["p99_latency_steps"] is not None:
         out["p99_ratio_scaled_vs_static"] = round(
             out["scale_1_3_1"]["p99_latency_steps"] / float(base), 3)
+    if out["static_3_pool"]["value"]:
+        out["process_vs_inproc_tps_ratio"] = round(
+            out["process_3_pool"]["value"]
+            / float(out["static_3_pool"]["value"]), 3)
     return out
 
 
@@ -1336,13 +1406,20 @@ def _dist_smokes():
 
                         m = _re.search(
                             r"world=(\d+) moved=(\d+) bytes=(\d+) "
-                            r"ms=([0-9.]+)", ln)
+                            r"ms=([0-9.]+)"
+                            r"(?: freeze_ms=([0-9.]+))?", ln)
                         if m:
-                            migrations.append({
+                            mig = {
                                 "world": int(m.group(1)),
                                 "moved_shards": int(m.group(2)),
                                 "bytes": int(m.group(3)),
-                                "migration_ms": float(m.group(4))})
+                                "migration_ms": float(m.group(4))}
+                            if m.group(5) is not None:
+                                # delta handoff: the frozen window is
+                                # the tail only, a fraction of the
+                                # full wall time
+                                mig["freeze_ms"] = float(m.group(5))
+                            migrations.append(mig)
                         continue
                     pos = ln.find("PSERVER-STATS ")
                     if pos >= 0:
@@ -1436,6 +1513,11 @@ def _dist_smokes():
                 out[name]["migration_ms_mean"] = round(
                     sum(m["migration_ms"] for m in migrations)
                     / len(migrations), 2)
+                frz = [m["freeze_ms"] for m in migrations
+                       if "freeze_ms" in m]
+                if frz:
+                    out[name]["freeze_ms_mean"] = round(
+                        sum(frz) / len(frz), 2)
                 out[name]["migrated_bytes_total"] = sum(
                     m["bytes"] for m in migrations)
     if only:

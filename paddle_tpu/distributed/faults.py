@@ -49,6 +49,9 @@ SIGKILL-equivalent death inside the serving fabric — so fabric chaos
 legs pin to the same ``PADDLE_TPU_FAULT_SEED`` as the pserver suite.
 ``pool_kill:<pid>`` pins the victim; bare ``pool_kill`` lets the router
 pick one deterministically from ``delay_fraction(idx)``.
+``pool_proc_kill`` (same ``:<pid>`` form) is the process-mode twin: a
+REAL SIGKILL on the pool's worker process (``--pool-mode process``),
+detected by the router's RPC-failure path rather than missed beats.
 """
 
 import socket
@@ -58,18 +61,18 @@ import threading
 _LEN = struct.Struct(">Q")
 
 ACTIONS = ("pass", "drop", "delay", "dup", "truncate", "corrupt",
-           "pool_kill")
+           "pool_kill", "pool_proc_kill")
 
 # wire faults make no sense inside the fabric scheduler and vice versa
-_FABRIC_ACTIONS = ("pass", "pool_kill")
+_FABRIC_ACTIONS = ("pass", "pool_kill", "pool_proc_kill")
 
 
 def _valid_action(action):
     if action in ACTIONS:
         return True
-    # explicit victim form: pool_kill:<pid>
+    # explicit victim form: pool_kill:<pid> / pool_proc_kill:<pid>
     base, sep, arg = str(action).partition(":")
-    return base == "pool_kill" and sep and arg.isdigit()
+    return base in ("pool_kill", "pool_proc_kill") and sep and arg.isdigit()
 
 
 class FaultSchedule:
@@ -86,7 +89,8 @@ class FaultSchedule:
     red run reproduces bit-for-bit (scripts/ci.sh)."""
 
     def __init__(self, schedule=None, seed=None, drop=0.0, delay=0.0,
-                 dup=0.0, truncate=0.0, corrupt=0.0, pool_kill=0.0):
+                 dup=0.0, truncate=0.0, corrupt=0.0, pool_kill=0.0,
+                 pool_proc_kill=0.0):
         import os
         import random
 
@@ -111,7 +115,8 @@ class FaultSchedule:
             ("dup", float(dup)), ("truncate", float(truncate)),
             ("corrupt", float(corrupt)),
         )
-        self._fabric_rates = (("pool_kill", float(pool_kill)),)
+        self._fabric_rates = (("pool_kill", float(pool_kill)),
+                              ("pool_proc_kill", float(pool_proc_kill)))
         self._seed = int(seed)
         self._rng = random.Random(seed)
         self._lock = threading.Lock()

@@ -30,6 +30,7 @@ fail-fast contract, process-level); the launcher returns that code.
 
 import argparse
 import os
+import shlex
 import socket
 import subprocess
 import sys
@@ -364,6 +365,11 @@ class _Cluster:
         self.restarts = {}  # tag -> respawn count (observability)
         self._respawns_pending = 0  # respawn backoffs in flight
         self._closing = threading.Event()
+        # service children (serving-pool workers): they serve RPC until
+        # told to stop, so they are excluded from the job-conclusion
+        # scan, retired when the job concludes, and their deaths never
+        # fail the cluster
+        self.aux_tags = set()
         # called as (tag, rc) when a child exits nonzero — pserver mode
         # uses it to report trainer deaths to the control plane, closing
         # the window where a trainer dies BEFORE its first heartbeat
@@ -384,7 +390,7 @@ class _Cluster:
         # the elastic scaling policy reads trainer STEP progress off it
         self.on_child_line = None
 
-    def spawn(self, tag, cmd, env):
+    def spawn(self, tag, cmd, env, aux=False):
         proc = subprocess.Popen(
             cmd,
             env=env,
@@ -395,6 +401,8 @@ class _Cluster:
         )
         t = threading.Thread(target=self._pump, args=(tag, proc), daemon=True)
         with self._lock:
+            if aux:
+                self.aux_tags.add(tag)
             self.procs.append((tag, proc, t))
             closing = self._closing.is_set()
         if closing:
@@ -462,6 +470,13 @@ class _Cluster:
                 sys.stderr.write(
                     "[launch] %s exited rc=%d (expected chaos kill)\n"
                     % (tag, rc)
+                )
+            elif tag in self.aux_tags:
+                # a service child dying (pool_proc_kill chaos, OOM)
+                # degrades serving; it never fails the training job
+                sys.stderr.write(
+                    "[launch] %s exited rc=%d (service child — job "
+                    "continues)\n" % (tag, rc)
                 )
             elif self.failed_rc is None:
                 self.failed_rc = rc
@@ -555,30 +570,58 @@ class _Cluster:
                 failed = self.failed_rc
                 procs = list(self.procs)
                 respawning = self._respawns_pending
+                aux = set(self.aux_tags)
             if failed is not None:
                 self.kill()
                 return failed
+            # the JOB concludes on its primary children only — service
+            # children (pool workers) serve RPC until told to stop, so
+            # waiting on them would hang the launcher forever
+            primary = [e for e in procs if e[0] not in aux]
             # conclusion needs every pump thread DEAD, not just every
             # child exited: a pump mid death-processing (notification
             # RPCs, respawn decision) hasn't excused its Popen yet, and
             # concluding in that window would misread a supervised death
             # as a cluster failure
             if (not respawning
-                    and all(p.poll() is not None for _, p, _ in procs)
-                    and all(not t.is_alive() for _, _, t in procs)):
-                for _, _, t in procs:
+                    and all(p.poll() is not None for _, p, _ in primary)
+                    and all(not t.is_alive() for _, _, t in primary)):
+                for _, _, t in primary:
                     t.join(timeout=5)
+                self._shutdown_aux()
                 # first nonzero (incl. negative signal-kill codes) wins —
                 # max() would mask a SIGKILLed child behind a clean peer —
                 # but a deliberately killed or respawned child doesn't
                 # count
-                for tag, p, _ in procs:
+                for tag, p, _ in primary:
                     if (p.returncode != 0
                             and tag not in self._expected_failures
                             and p not in self._excused):
                         return p.returncode
                 return 0
             time.sleep(poll)
+
+    def _shutdown_aux(self):
+        """The job has concluded: retire the service children that live
+        exactly as long as it does.  SIGTERM, bounded wait, SIGKILL
+        fallback — their exit codes never count against the job."""
+        self._closing.set()  # retired service children are not respawned
+        with self._lock:
+            aux = [e for e in self.procs if e[0] in self.aux_tags]
+        for tag, p, _ in aux:
+            if p.poll() is None:
+                sys.stderr.write("[launch] POOL WORKER RETIRE %s\n" % tag)
+                p.terminate()
+        for tag, p, t in aux:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    pass
+            t.join(timeout=5)
 
     def kill(self):
         self._closing.set()  # cancel pending supervised respawns
@@ -652,7 +695,8 @@ def _arm_chaos(cluster, chaos_kills):
 
 
 def drive_pserver_migration(old_world, new_world, attempts=3,
-                            timeout_s=600.0, retry_wait=1.0):
+                            timeout_s=600.0, retry_wait=1.0,
+                            delta=True):
     """Two-phase supervisor driver for a pserver-set change
     (docs/FAULT_TOLERANCE.md "Live shard migration").
 
@@ -668,7 +712,14 @@ def drive_pserver_migration(old_world, new_world, attempts=3,
     lost) and the driver retries — a SIGKILLed source or target
     restores and the next attempt re-captures fresh state.
 
-    Returns {"ok", "attempts", "moved", "bytes", "ms", "epochs"}."""
+    `delta=True` (the default): each source ships its bulky sparse
+    tables as an UNFROZEN snapshot first and freezes only for the
+    dirty-row final tail — `freeze_ms` in the result (max over the
+    involved servers) is that frozen window, the serving-visible cost
+    of the handoff, typically a small fraction of `ms`.
+
+    Returns {"ok", "attempts", "moved", "bytes", "ms", "freeze_ms",
+    "epochs"}."""
     import time as _t
 
     from .rpc import RPCClient
@@ -680,12 +731,13 @@ def drive_pserver_migration(old_world, new_world, attempts=3,
     for attempt in range(1, int(attempts) + 1):
         t0 = _t.monotonic()
         begun, moved, nbytes = [], 0, 0
+        freeze_ms = 0.0
         err = None
         for ep in involved:
             try:
                 r = RPCClient.get(ep).call(
                     "migrate_begin", timeout_s=timeout_s,
-                    world=new_world)
+                    world=new_world, delta=bool(delta))
             except Exception as e:
                 err = "begin at %s failed: %s" % (ep, e)
                 break
@@ -695,6 +747,7 @@ def drive_pserver_migration(old_world, new_world, attempts=3,
             begun.append(ep)
             moved += int(r.get("moved", 0))
             nbytes += int(r.get("bytes", 0))
+            freeze_ms = max(freeze_ms, float(r.get("freeze_ms", 0.0)))
         if err is not None:
             last_err = err
             sys.stderr.write(
@@ -734,7 +787,8 @@ def drive_pserver_migration(old_world, new_world, attempts=3,
         if len(epochs) == len(involved):
             return {"ok": True, "attempts": attempt, "moved": moved,
                     "bytes": nbytes, "epochs": epochs,
-                    "ms": round((_t.monotonic() - t0) * 1e3, 3)}
+                    "ms": round((_t.monotonic() - t0) * 1e3, 3),
+                    "freeze_ms": round(freeze_ms, 3)}
         last_err = err
         sys.stderr.write(
             "[launch] pserver migration attempt %d commit failed: %s "
@@ -919,8 +973,9 @@ def _start_pserver_elastic_loop(cluster, common, script_argv, base_tags,
             grown.append((tag, ep))
             sys.stderr.write(
                 "[launch] PSERVER MIGRATION ok: world=%d moved=%d "
-                "bytes=%d ms=%.1f\n"
-                % (len(world), r["moved"], r["bytes"], r["ms"]))
+                "bytes=%d ms=%.1f freeze_ms=%.1f\n"
+                % (len(world), r["moved"], r["bytes"], r["ms"],
+                   r.get("freeze_ms", 0.0)))
         else:
             reap_failed_grow(
                 "migration failed (%s)" % r.get("error"))
@@ -945,7 +1000,9 @@ def _start_pserver_elastic_loop(cluster, common, script_argv, base_tags,
         world[:] = new_world
         sys.stderr.write(
             "[launch] PSERVER MIGRATION ok: world=%d moved=%d bytes=%d "
-            "ms=%.1f\n" % (len(world), r["moved"], r["bytes"], r["ms"]))
+            "ms=%.1f freeze_ms=%.1f\n"
+            % (len(world), r["moved"], r["bytes"], r["ms"],
+               r.get("freeze_ms", 0.0)))
         # drain: every trainer must complete one round under the new
         # plan (its old-epoch frames got fenced, it re-planned away
         # from the retiree) before the retiree may disappear
@@ -994,9 +1051,120 @@ def _start_pserver_elastic_loop(cluster, common, script_argv, base_tags,
                      name="elastic-pserver-policy").start()
 
 
+_CONTROL_POLICY = None
+
+
+def _control_call(control_ep, verb, **kw):
+    """ONE retry/deadline policy for every router/worker control RPC
+    the launcher makes (rpc.CallPolicy — the same helper serving's
+    ProcessPool backend rides): bounded attempts, per-verb deadlines,
+    exponential backoff.  Replaces the ad-hoc hardcoded deadline_s at
+    each call site, so tightening the control-plane budget is one
+    edit, not a grep."""
+    global _CONTROL_POLICY
+    from .rpc import CallPolicy, RPCClient
+
+    if _CONTROL_POLICY is None:
+        _CONTROL_POLICY = CallPolicy(
+            timeout_s=2.0, deadline_s=5.0, attempts=3,
+            backoff_base=0.05, backoff_cap=0.5,
+            verb_deadlines={"stats": 2.0})
+    cli = RPCClient(control_ep, timeout=_CONTROL_POLICY.timeout_s,
+                    retries=1, retry_wait=0.05)
+    try:
+        return _CONTROL_POLICY.call(cli, verb, **kw)
+    finally:
+        cli.close()
+
+
+def _start_pool_workers(cluster, router_ep, n, worker_opts, supervise,
+                        make_restart_policy):
+    """Process-mode serving pools (`--pool-mode process`): the
+    supervisor spawns N pool-worker CHILDREN (serving/pool_worker.py),
+    parses each one's READY line off the output pump, and attaches its
+    endpoint to the router over the `attach_worker` control verb.  A
+    worker that dies (SIGKILL chaos, OOM) is (a) reported to the
+    router via `report_pool_death` so failover replay starts at the
+    NEXT fabric step instead of burning the RPC deadline discovering
+    it, and (b) respawned under the SAME _RestartPolicy budget the
+    trainer/pserver children use — the fresh incarnation announces
+    READY and re-attaches as a new pool.  Returns spawn_one so the
+    elastic loop can grow the fleet through the same path."""
+    from ..serving.pool_worker import READY_PREFIX
+
+    lock = threading.Lock()
+    state = {"next": 0}
+    endpoints = {}  # tag -> latest incarnation's endpoint
+
+    def spawn_one(reason="initial"):
+        with lock:
+            tag = "pool_worker.%d" % state["next"]
+            state["next"] += 1
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        cmd = [sys.executable, "-u", "-m",
+               "paddle_tpu.serving.pool_worker"] + list(worker_opts or [])
+        sys.stderr.write("[launch] POOL WORKER SPAWN %s (%s)\n"
+                         % (tag, reason))
+        if supervise:
+            cluster.supervise(tag, cmd, env, make_restart_policy())
+        cluster.spawn(tag, cmd, env, aux=True)
+        return tag
+
+    prev_line = cluster.on_child_line
+
+    def on_line(tag, line):
+        if prev_line is not None:
+            prev_line(tag, line)
+        if not (tag.startswith("pool_worker.")
+                and line.startswith(READY_PREFIX)):
+            return
+        ep = None
+        for tok in line.split():
+            if tok.startswith("endpoint="):
+                ep = tok.split("=", 1)[1]
+        if not ep:
+            return
+        with lock:
+            endpoints[tag] = ep
+        try:
+            r = _control_call(router_ep, "attach_worker", endpoint=ep)
+            sys.stderr.write("[launch] POOL WORKER ATTACHED %s %s "
+                             "pid=%s\n" % (tag, ep, r.get("pid")))
+        except Exception as e:
+            sys.stderr.write("[launch] pool worker attach failed for "
+                             "%s (%s): %r\n" % (tag, ep, e))
+
+    cluster.on_child_line = on_line
+
+    prev_death = cluster.on_child_death
+
+    def on_death(tag, rc):
+        if prev_death is not None:
+            try:
+                prev_death(tag, rc)
+            except Exception:
+                pass
+        if not tag.startswith("pool_worker."):
+            return
+        with lock:
+            ep = endpoints.pop(tag, None)
+        if ep is None:
+            return
+        try:
+            _control_call(router_ep, "report_pool_death", endpoint=ep)
+        except Exception:
+            pass  # the router's own RPC deadline still bounds detection
+
+    cluster.on_child_death = on_death
+    for _ in range(int(n)):
+        spawn_one()
+    return spawn_one
+
+
 def _start_pool_elastic_loop(cluster, router_ep, min_pools, max_pools,
                              schedule, cooldown, stop_evt, policy,
-                             nproc=2):
+                             nproc=2, spawn_worker=None):
     """Serving-pool loop of the UNIFIED supervisor (`--serve-pools
     MIN:MAX` against a `--serve-router` control endpoint): polls the
     FabricRouter's `stats` verb — the same verb shape the pserver axis
@@ -1006,8 +1174,6 @@ def _start_pool_elastic_loop(cluster, router_ep, min_pools, max_pools,
     fabric's chaos/bench driver.  The policy instance is SHARED with
     the trainer and pserver axes: one cooldown, one action budget —
     three axes that cannot fight."""
-    from .rpc import RPCClient
-
     sched = []
     for spec in (schedule or "").split(","):
         spec = spec.strip()
@@ -1018,28 +1184,29 @@ def _start_pool_elastic_loop(cluster, router_ep, min_pools, max_pools,
     scheduled_only = bool(sched)
     t_start = time.monotonic()
 
-    def poll_stats(timeout=1.5):
-        cli = RPCClient(router_ep, timeout=1.0, retries=1,
-                        retry_wait=0.05)
+    def poll_stats():
         try:
-            s = cli.call("stats", deadline_s=timeout)
+            s = _control_call(router_ep, "stats")
             return s if isinstance(s, dict) else None
         except Exception:
             return None
-        finally:
-            cli.close()
 
     def scale(delta, reason):
         sys.stderr.write("[launch] ELASTIC POOL SCALE %+d (%s)\n"
                          % (delta, reason))
-        cli = RPCClient(router_ep, timeout=2.0, retries=2,
-                        retry_wait=0.1)
         try:
-            cli.call("scale_pools", delta=int(delta), deadline_s=5.0)
+            if delta > 0 and spawn_worker is not None:
+                # process mode: growth spawns supervised worker
+                # children (their READY lines attach to the router);
+                # shrink still flows through scale_pools — the router
+                # drains and the retiring worker exits 0
+                for _ in range(int(delta)):
+                    spawn_worker(reason)
+            else:
+                _control_call(router_ep, "scale_pools",
+                              delta=int(delta))
         except Exception as e:
             sys.stderr.write("[launch] pool scale failed: %r\n" % (e,))
-        finally:
-            cli.close()
 
     def loop():
         while not stop_evt.wait(0.5):
@@ -1083,7 +1250,8 @@ def launch_pserver(script_argv, nproc, n_pservers, base_env=None, sync=True,
                    staleness_bound=None, elastic=None, elastic_schedule=None,
                    elastic_cooldown=3.0, elastic_pservers=None,
                    pserver_schedule=None, serve_router=None,
-                   serve_pools=None, pool_schedule=None):
+                   serve_pools=None, pool_schedule=None,
+                   pool_mode="inproc", pool_worker_opts=None):
     if elastic_schedule and not elastic:
         # fail BEFORE any child spawns: a dropped schedule would run a
         # clean "no regression" job in which the membership trace under
@@ -1107,6 +1275,14 @@ def launch_pserver(script_argv, nproc, n_pservers, base_env=None, sync=True,
             "--pool-schedule requires --serve-pools MIN:MAX: the "
             "schedule drives the fabric-scaling machinery and alone "
             "would be silently ignored")
+    if pool_mode not in ("inproc", "process"):
+        raise ValueError("--pool-mode must be inproc|process, got %r"
+                         % (pool_mode,))
+    if pool_mode == "process" and not serve_pools:
+        raise ValueError(
+            "--pool-mode process requires --serve-pools MIN:MAX: the "
+            "supervisor owns the worker children and must know how "
+            "many to spawn")
     min_pools = max_pools = None
     if serve_pools:
         min_pools, max_pools = (int(x)
@@ -1368,9 +1544,15 @@ def launch_pserver(script_argv, nproc, n_pservers, base_env=None, sync=True,
         pool_policy = shared_policy or _ScalingPolicy(
             1, max(1, nproc), cooldown_s=elastic_cooldown,
             min_pools=min_pools, max_pools=max_pools)
+        spawn_worker = None
+        if pool_mode == "process":
+            spawn_worker = _start_pool_workers(
+                cluster, serve_router, min_pools, pool_worker_opts,
+                supervise, _policy)
         _start_pool_elastic_loop(
             cluster, serve_router, min_pools, max_pools, pool_schedule,
-            elastic_cooldown, stop_elastic, pool_policy, nproc)
+            elastic_cooldown, stop_elastic, pool_policy, nproc,
+            spawn_worker=spawn_worker)
     _arm_chaos(cluster, chaos_kills)
     try:
         return cluster.wait()
@@ -1642,6 +1824,18 @@ def main(argv=None):
         "verbs the load policy uses (fabric bench/chaos harness)",
     )
     parser.add_argument(
+        "--pool-mode", default="inproc", choices=("inproc", "process"),
+        help="process: the supervisor spawns pool-worker CHILDREN "
+        "(serving/pool_worker.py) and attaches each READY endpoint to "
+        "the --serve-router fabric; a dead worker is death-reported "
+        "and respawned under the shared restart budget (--supervise)",
+    )
+    parser.add_argument(
+        "--pool-worker-opts", default="", metavar="ARGS",
+        help="extra argv passed through to every spawned pool worker "
+        "(--pool-mode process), e.g. '--hp {...} --n-slots 2'",
+    )
+    parser.add_argument(
         "--staleness-bound", type=int, default=None, metavar="STEPS",
         help="async pserver mode: arm FLAGS_async_staleness_bound in "
         "every child — pservers park pushes/prefetches from a trainer "
@@ -1707,6 +1901,8 @@ def main(argv=None):
             serve_router=args.serve_router,
             serve_pools=args.serve_pools,
             pool_schedule=args.pool_schedule,
+            pool_mode=args.pool_mode,
+            pool_worker_opts=shlex.split(args.pool_worker_opts),
         )
     return rc
 

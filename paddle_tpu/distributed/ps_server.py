@@ -299,6 +299,10 @@ class ParameterServer:
         self._frozen = False
         self._mig = None      # in-flight migrate_begin capture
         self._mig_gen = 0     # generation: a timed-out freeze self-aborts
+        # delta handoff (migrate_begin delta=True): shard -> set of row
+        # ids dirtied since the UNFROZEN snapshot shipped (None value =
+        # whole-table mutation, re-ship everything); None when inactive
+        self._mig_dirty = None
         # adopted-state registry: shard programs / sparse specs /
         # lr_program this server acquired via migrate_in — they must ride
         # the snapshot, because a restarted server rebuilds everything
@@ -607,6 +611,12 @@ class ParameterServer:
             self._recalc_lr_trigger_locked()
         elif kind == "mtable":
             shard = str(rec["t"])
+            if (self._mig_dirty is not None
+                    and shard in self._mig_dirty):
+                # a full table landed UNDER our own in-flight delta
+                # handoff of the same shard (shard bouncing back):
+                # row-level tracking is no longer sound — re-ship whole
+                self._mig_dirty[shard] = None
             info = {}
             for kk, vv in rec["info"].items():
                 info[kk] = (np.ascontiguousarray(vv)
@@ -634,6 +644,39 @@ class ParameterServer:
                 self.lr_program = framework.Program.from_json(
                     rec["lr_program"])
                 self._adopted["lr_program"] = rec["lr_program"]
+        elif kind == "mrows":
+            # delta-handoff FINAL TAIL: row-level overwrite of a table
+            # whose full snapshot already landed (an earlier mtable
+            # record in this handoff) — ids carry the rows dirtied
+            # while the source kept serving, `scal` the non-row state
+            # (adam beta pows, lr) whose final frozen values win
+            shard = str(rec["t"])
+            info = self.sparse_tables.get(shard)
+            if info is None:
+                import sys
+
+                sys.stderr.write(
+                    "PSERVER mrows names unknown sparse table %r "
+                    "(no snapshot landed first); record skipped\n"
+                    % (shard,))
+                return
+            ids = np.asarray(rec["i"]).reshape(-1).astype(np.int64)
+            for kk, vv in sorted((rec.get("rows") or {}).items()):
+                vv = np.asarray(vv)
+                arr = info.get(kk)
+                if arr is None:
+                    # a moment/velocity slot first materialized AFTER
+                    # the snapshot (setdefault in _apply_sparse)
+                    arr = info[kk] = np.zeros_like(info["tbl"])
+                if ids.size:
+                    arr[ids] = vv
+            for kk, vv in sorted((rec.get("scal") or {}).items()):
+                info[kk] = (np.ascontiguousarray(vv)
+                            if isinstance(vv, np.ndarray) else vv)
+            for t, sq in (rec.get("fences") or {}).items():
+                key = (int(t), shard)
+                self._sparse_fence[key] = max(
+                    self._sparse_fence.get(key, 0), int(sq))
         elif kind == "mfence":
             # migrated fold fences: rounds the shipped state already
             # contains must fence here exactly as at the source (sync
@@ -1481,6 +1524,35 @@ class ParameterServer:
                 "s": int(self._sparse_shard_idx.get(shard, -1)),
                 "info": payload, "fences": fences}
 
+    def _serialize_sparse_tail_locked(self, shard):
+        """Frozen FINAL TAIL of a delta handoff: only the rows dirtied
+        since the unfrozen snapshot shipped, plus the non-row scalars
+        (adam beta pows, lr) and the fold fences — the target overlays
+        them on the snapshot it already holds, reconstructing the exact
+        frozen state.  Falls back to the full record when row tracking
+        went whole-table (momentum decay, shard bounce-back)."""
+        d = (self._mig_dirty or {}).get(shard, None)
+        if self._mig_dirty is None or shard not in self._mig_dirty \
+                or d is None:
+            return self._serialize_sparse_shard_locked(shard)
+        info = self.sparse_tables[shard]
+        ids = np.asarray(sorted(d), np.int64)
+        rows = {}
+        for kk, vv in info.items():
+            if isinstance(vv, np.ndarray) and (
+                    kk == "tbl"
+                    or kk.startswith(("moment", "velocity"))):
+                rows[kk] = np.array(vv[ids]) if ids.size else \
+                    np.zeros((0,) + vv.shape[1:], vv.dtype)
+        scal = {kk: vv for kk, vv in info.items()
+                if kk == "lr" or (kk.startswith("beta")
+                                  and not isinstance(vv, np.ndarray))}
+        fences = {str(t): int(sq)
+                  for (t, tb), sq in self._sparse_fence.items()
+                  if tb == shard}
+        return {"k": "mrows", "t": str(shard), "i": ids, "rows": rows,
+                "scal": scal, "fences": fences}
+
     def _moving_sets_locked(self, new_world):
         """The shards THIS server owns under the old dispatch but not
         the new: [(gblock, new_ep, idx), ...], [(shard, new_ep), ...].
@@ -1519,9 +1591,12 @@ class ParameterServer:
             n for n, v in prog.global_block().vars.items()
             if getattr(v, "persistable", False) and n.endswith(suffix))
 
-    def _mig_capture_locked(self, new_world):
+    def _mig_capture_locked(self, new_world, delta=False):
         """Compute the moving set (old dispatch vs new) and serialize it
-        into per-target frame lists.  Called frozen, at a boundary."""
+        into per-target frame lists.  Called frozen, at a boundary.
+        `delta`: the sparse tables' full snapshots already shipped
+        unfrozen — serialize only their dirty-row tails (dense shards,
+        whole vars and fences always ship here, in the freeze)."""
         dense, sparse = self._moving_sets_locked(new_world)
         targets = {}   # ep -> [frame, ...]
         whole_all = {}
@@ -1532,7 +1607,8 @@ class ParameterServer:
             whole_all.update(whole)
             moved_dense.append((gblock, new_ep, sorted(rec["vars"])))
         for shard, new_ep in sparse:
-            rec = self._serialize_sparse_shard_locked(shard)
+            rec = (self._serialize_sparse_tail_locked(shard) if delta
+                   else self._serialize_sparse_shard_locked(shard))
             targets.setdefault(new_ep, []).append(self._mig_frame(rec))
             moved_sparse.append((shard, new_ep))
         if targets:
@@ -1575,6 +1651,7 @@ class ParameterServer:
         print("PSERVER MIGRATE-ABORT ep=%s: %s"
               % (self.endpoint, why), flush=True)
         self._mig = None
+        self._mig_dirty = None
         self._mig_gen += 1
         self._frozen = False
         self._cv.notify_all()
@@ -1587,8 +1664,17 @@ class ParameterServer:
                     "supervisor died mid-handoff; unfreezing (the old "
                     "assignment stays authoritative)")
 
-    def _h_migrate_begin(self, world, trainer_id=0):
-        """Phase 1 of the handoff (see section comment)."""
+    def _h_migrate_begin(self, world, trainer_id=0, delta=False):
+        """Phase 1 of the handoff (see section comment).
+
+        ``delta=True`` — incremental delta handoff: the bulky sparse
+        tables ship as an UNFROZEN snapshot first, while this server
+        keeps serving and tracks which rows mutate (_mig_dirty); the
+        freeze then covers only the FINAL TAIL — dirty rows (mrows),
+        dense shards, whole vars, fences.  ``freeze_ms`` in the reply
+        is that frozen window: with a large embedding shard it shrinks
+        from ~the whole handoff to the dirty fraction, which is the
+        point."""
         import time
 
         if not self.plan_spec or not self.endpoint:
@@ -1600,28 +1686,77 @@ class ParameterServer:
         world = [str(e) for e in world]
         t0 = time.monotonic()
         limit = max(10.0, 3.0 * self.eviction_deadline)
+        pre_bytes = 0
+        if delta:
+            # ---- phase 1a: unfrozen sparse snapshot + dirty tracking
+            with self._cv:
+                if self._frozen or self._mig is not None:
+                    return {"ok": False, "busy": True}
+                try:
+                    _dense, snap_sparse = self._moving_sets_locked(world)
+                    pre_targets = {}
+                    for shard, new_ep in snap_sparse:
+                        rec = self._serialize_sparse_shard_locked(shard)
+                        pre_targets.setdefault(new_ep, []).append(
+                            self._mig_frame(rec))
+                except Exception as e:
+                    import traceback
+
+                    traceback.print_exc()
+                    return {"ok": False,
+                            "error": "delta snapshot failed: %s" % e}
+                # arm dirty tracking BEFORE the lock drops: every row
+                # an application touches from here on rides the tail
+                self._mig_dirty = {shard: set()
+                                   for shard, _ in snap_sparse}
+            pre_bytes = sum(len(f) for frames in pre_targets.values()
+                            for f in frames)
+            snap_err = None
+            from .rpc import RPCClient
+
+            for ep, frames in sorted(pre_targets.items()):
+                try:
+                    r = RPCClient.get(ep).call(
+                        "migrate_in", timeout_s=600.0, frames=frames,
+                        source=self.endpoint)
+                    if not (isinstance(r, dict) and r.get("ok")):
+                        snap_err = ("target %s refused the snapshot: %r"
+                                    % (ep, r))
+                        break
+                except Exception as e:
+                    snap_err = ("target %s failed mid-snapshot: %s"
+                                % (ep, e))
+                    break
+            if snap_err is not None:
+                with self._cv:
+                    self._mig_dirty = None
+                return {"ok": False, "error": snap_err}
         with self._cv:
             if self._frozen or self._mig is not None:
+                self._mig_dirty = None
                 return {"ok": False, "busy": True}
             if not self._cv.wait_for(
                     lambda: self._at_boundary_locked()
                     or self._done.is_set(), timeout=limit):
+                self._mig_dirty = None
                 return {"ok": False, "busy": True,
                         "error": "no round boundary within %.0fs" % limit}
             self._frozen = True
+            f0 = time.monotonic()  # the freeze window starts HERE
             self._mig_gen += 1
             gen = self._mig_gen
             try:
                 targets, moved_dense, moved_sparse = \
-                    self._mig_capture_locked(world)
+                    self._mig_capture_locked(world, delta=delta)
             except Exception as e:
                 import traceback
 
                 traceback.print_exc()
                 self._abort_mig_locked("capture failed: %s" % e)
                 return {"ok": False, "error": "capture failed: %s" % e}
-            nbytes = sum(len(f) for frames in targets.values()
-                         for f in frames)
+            nbytes = pre_bytes + sum(len(f)
+                                     for frames in targets.values()
+                                     for f in frames)
             self._mig = {"world": world, "gen": gen,
                          "dense": moved_dense, "sparse": moved_sparse,
                          "bytes": nbytes}
@@ -1664,12 +1799,16 @@ class ParameterServer:
             moved = len(moved_dense) + len(moved_sparse)
             self.counters["migrated_shards_out"] += moved
             self.counters["migrated_bytes_out"] += nbytes
+        freeze_ms = (time.monotonic() - f0) * 1e3
         print("PSERVER MIGRATE-BEGIN ep=%s world=%s moved=%d bytes=%d "
-              "ms=%.1f" % (self.endpoint, world, moved, nbytes,
-                           (time.monotonic() - t0) * 1e3), flush=True)
+              "ms=%.1f freeze_ms=%.1f delta=%d"
+              % (self.endpoint, world, moved, nbytes,
+                 (time.monotonic() - t0) * 1e3, freeze_ms, int(delta)),
+              flush=True)
         return {"ok": True, "moved": moved, "bytes": nbytes,
                 "targets": shipped,
-                "ms": round((time.monotonic() - t0) * 1e3, 3)}
+                "ms": round((time.monotonic() - t0) * 1e3, 3),
+                "freeze_ms": round(freeze_ms, 3)}
 
     def _h_migrate_commit(self, world, trainer_id=0):
         """Phase 2: adopt the new pserver world, drop moved state, mint.
@@ -1742,6 +1881,7 @@ class ParameterServer:
             retiring = (self.endpoint is not None
                         and self.endpoint not in world)
             self._mig = None
+            self._mig_dirty = None
             self._mig_gen += 1  # disarms the freeze-timeout timer
             self._frozen = False
             if moved:
@@ -2650,6 +2790,17 @@ class ParameterServer:
         typ = opt.get("type", "sgd")
         at = opt.get("attrs") or {}
         ids = np.asarray(ids).reshape(-1)
+        dirty = self._mig_dirty
+        if dirty is not None and table in dirty:
+            # delta handoff in flight: record which rows this (still
+            # serving) application touches so the frozen final tail
+            # ships only them.  Momentum's densified rule mutates EVERY
+            # row (whole-table velocity decay) — fall back to a full
+            # re-ship rather than under-ship.
+            if typ == "momentum":
+                dirty[table] = None
+            elif dirty[table] is not None:
+                dirty[table].update(int(x) for x in ids)
         # explicit second dim: -1 is ambiguous (ValueError) for 0 rows,
         # and rowless momentum decay feeds exactly that
         rows = np.asarray(rows, dtype=tbl.dtype).reshape(

@@ -897,6 +897,68 @@ def _backoff_wait(attempt, base, cap=5.0):
     return span * (0.5 + 0.5 * random.random())
 
 
+class CallPolicy:
+    """ONE retry/deadline policy for control-plane RPCs, shared by the
+    fabric's ProcessPool backend (serving/router.py) and launch.py's
+    supervisor loops — previously each caller hardcoded its own
+    `deadline_s` (launch.py's scale loop pinned 5.0s with no retry, so
+    a worker slow under load errored the whole supervisor tick).
+
+    Semantics: each FULL client.call is one attempt (the call already
+    replays its round-trips internally under ONE req_id, so the server's
+    at-most-once dedup makes a retried non-idempotent verb — a pool
+    `step`, a grad fold — execute at most once); between attempts the
+    policy sleeps the half-jitter exponential backoff, and the PER-VERB
+    deadline bounds the total including every backoff.  Transport
+    failures retry; remote application errors ({"__error__": ...} ->
+    RuntimeError) propagate immediately — retrying "unknown verb" only
+    hides the bug.
+    """
+
+    def __init__(self, timeout_s=5.0, deadline_s=15.0, attempts=3,
+                 backoff_base=0.05, backoff_cap=1.0,
+                 verb_deadlines=None):
+        self.timeout_s = float(timeout_s)
+        self.deadline_s = float(deadline_s)
+        self.attempts = max(1, int(attempts))
+        self.backoff_base = float(backoff_base)
+        self.backoff_cap = float(backoff_cap)
+        # per-verb overrides, e.g. {"step": 10.0, "submit": 5.0}
+        self.verb_deadlines = dict(verb_deadlines or {})
+
+    def deadline_for(self, verb):
+        return float(self.verb_deadlines.get(verb, self.deadline_s))
+
+    def call(self, client, verb, **kwargs):
+        import time
+
+        total = self.deadline_for(verb)
+        deadline = time.monotonic() + total
+        last = None
+        for attempt in range(self.attempts):
+            remaining = deadline - time.monotonic()
+            if attempt and remaining <= 0:
+                break
+            try:
+                return client.call(
+                    verb,
+                    timeout_s=min(self.timeout_s, max(0.05, remaining)),
+                    deadline_s=max(0.05, remaining),
+                    **kwargs)
+            except (ConnectionError, TimeoutError, OSError) as e:
+                last = e
+                if attempt + 1 < self.attempts:
+                    wait = _backoff_wait(attempt, self.backoff_base,
+                                         self.backoff_cap)
+                    wait = min(wait, max(0.0,
+                                         deadline - time.monotonic()))
+                    time.sleep(wait)
+        raise ConnectionError(
+            "rpc %s to %s failed within its %.1fs policy deadline "
+            "(%d attempts): %s"
+            % (verb, client.endpoint, total, self.attempts, last))
+
+
 class RPCClient:
     """Blocking client with one cached connection per endpoint
     (GRPCClient analog; retries replace FLAGS_max_retry)."""

@@ -14,9 +14,10 @@ seeded open-loop bench/test workloads.
 
 from .engine import ServingEngine, serve_one_at_a_time
 from .pool import SlotPool
-from .router import FabricRouter, parse_pool_schedule
+from .pool_worker import spawn_pool_worker
+from .router import FabricRouter, ProcessPool, parse_pool_schedule
 from .trace import Request, make_poisson_trace
 
 __all__ = ["ServingEngine", "serve_one_at_a_time", "SlotPool",
-           "FabricRouter", "parse_pool_schedule",
-           "Request", "make_poisson_trace"]
+           "FabricRouter", "ProcessPool", "parse_pool_schedule",
+           "spawn_pool_worker", "Request", "make_poisson_trace"]

@@ -298,6 +298,43 @@ class ServingEngine:
                                  else wall),
         }
 
+    # ---- result serialization (out-of-process pools) -------------------
+    @staticmethod
+    def wire_result(r):
+        """One terminal result coerced onto the RPC wire's closed type
+        system: tokens stay an int64 ndarray (wire-native), every scalar
+        is forced to a plain int/float/str/None — a stray np.int64
+        leaking into finish_step would fail the codec, and the statuses
+        (OK / DEADLINE_EXPIRED / REJECTED_QUEUE_FULL) must cross the
+        wire unchanged for the router's backpressure accounting."""
+
+        def _scalar(v):
+            if v is None or isinstance(v, (str, bool)):
+                return v
+            if isinstance(v, (int, np.integer)):
+                return int(v)
+            return float(v)
+
+        out = {}
+        for k, v in r.items():
+            if k == "tokens":
+                out[k] = np.asarray(v, "int64")
+            else:
+                out[k] = _scalar(v)
+        return out
+
+    def wire_results(self, rids=None):
+        """Terminal results for `rids` (default: all) as wire-safe
+        dicts, each tagged with its "rid" — the pool worker's `step` /
+        `results` reply payload."""
+        keys = self._results.keys() if rids is None else rids
+        out = []
+        for rid in keys:
+            r = self.wire_result(self._results[rid])
+            r["rid"] = rid
+            out.append(r)
+        return out
+
     # ---- pool placement accounting -------------------------------------
     def kv_pool_bytes(self, scope=None):
         """Where the KV slot-pool actually lives: total pool bytes, the

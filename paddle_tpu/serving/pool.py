@@ -51,15 +51,21 @@ class SlotPool:
         return sum(1 for s in self.slots if s is not None) / self.n_slots
 
     # ---- lifecycle ----------------------------------------------------
+    def fits(self, req):
+        """Non-raising capacity check: the router's cross-pool placement
+        keys off this (len(prompt)+max_new vs THIS pool's t_max) when
+        pools of different sizes coexist in one fabric."""
+        return req.prompt.size + req.max_new_tokens <= self.t_max + 1
+
     def validate(self, req):
         """The pool's capacity rule (it owns t_max): the last generated
         token is never fed back, hence the +1 — the single source of
         truth for engine.submit and admit."""
-        p = req.prompt.size
-        if p + req.max_new_tokens > self.t_max + 1:
+        if not self.fits(req):
             raise ValueError(
                 "request %r: prompt %d + new %d exceeds pool capacity %d"
-                % (req.rid, p, req.max_new_tokens, self.t_max))
+                % (req.rid, req.prompt.size, req.max_new_tokens,
+                   self.t_max))
 
     def admit(self, req, admit_step):
         """Place `req` in a free slot; returns the slot index (caller

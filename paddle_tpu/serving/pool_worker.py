@@ -1,0 +1,284 @@
+"""Out-of-process serving pool worker (docs/SERVING.md §7, ROADMAP 3b):
+one ServingEngine behind a VarServer, so the fabric's pools become REAL
+processes — `--pool-schedule` chaos SIGKILLs an actual pid, the
+supervisor's restart budget governs actual respawns, and the failover
+exactness contract is exercised across a true process death.
+
+The worker is purely REACTIVE: it admits and steps only when the
+router's `step` verb says so, which is what keeps the fabric's lockstep
+clock (and with it the exactness contract — a slot's schedule is a pure
+function of its request and the step it was admitted) intact across the
+process boundary.  Verbs:
+
+  submit(req)       admit one wire-encoded Request into the engine
+                    queue; a resent rid (the router's unacked-submit
+                    resend after a lost ack) answers {"ok", "dup"}
+                    instead of double-admitting.
+  step(now, ack)    drop acked results, run ONE engine step at fabric
+                    time `now`, and reply with every still-unacked
+                    terminal result PLUS the post-step slot/queue
+                    mirror the router replays failovers from.
+  results(ack)      the resync half of step's reply (same payload, no
+                    stepping) — a router recovering from a lost reply
+                    re-pulls terminal results here.
+  drain()           stop admitting new submissions (the router already
+                    stopped placing; this makes the worker refuse, too).
+  stats()           engine geometry + counters + compile_count — the
+                    supervisor's scaling signals and the router's
+                    attach-time hello.
+  shutdown()        conclude the serve loop (drain-and-retire's clean
+                    exit; SIGKILL is the chaos path, not the API).
+
+Errors ship as {"__error__": ...} (the pserver convention): raising in
+a handler would only drop the connection and read as a worker death.
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+__all__ = ["PoolWorkerService", "spawn_pool_worker", "main"]
+
+READY_PREFIX = "POOL-WORKER READY "
+
+
+class PoolWorkerService:
+    """make_var_server service wrapping one ServingEngine + its scope.
+    One lock serializes every verb — the engine is single-threaded by
+    design, and the server's at-most-once dedup (same req_id) already
+    keeps a retried `step` from double-stepping."""
+
+    def __init__(self, engine, scope):
+        self.engine = engine
+        self.scope = scope
+        self._lock = threading.RLock()
+        self._unacked = {}   # rid -> wire result, until the router acks
+        self._seen = set()   # every rid ever admitted (worker lifetime)
+        self._draining = False
+        self.done = threading.Event()
+
+    def handle(self, verb, **kw):
+        try:
+            with self._lock:
+                return self._dispatch(verb, **kw)
+        except Exception as e:
+            return {"__error__": "%s" % (e,)}
+
+    # ---- verb dispatch -------------------------------------------------
+    def _dispatch(self, verb, **kw):
+        if verb == "submit":
+            return self._h_submit(kw["req"])
+        if verb == "step":
+            return self._h_step(kw.get("now"), kw.get("ack"))
+        if verb == "results":
+            self._ack(kw.get("ack"))
+            return self._payload()
+        if verb == "drain":
+            self._draining = True
+            return self._payload()
+        if verb == "stats":
+            return self._stats()
+        if verb == "shutdown":
+            self.done.set()
+            return {"ok": True}
+        raise ValueError("unknown pool-worker verb %r" % (verb,))
+
+    def _h_submit(self, wire_req):
+        from .trace import Request
+
+        req = Request.from_wire(wire_req)
+        if req.rid in self._seen or req.rid in self._unacked:
+            # the unacked-submit resend path: the FIRST submit landed
+            # but its ack was lost — admitting again would double-decode
+            return {"ok": True, "dup": True}
+        if self._draining:
+            return {"ok": False, "draining": True}
+        self.engine.submit(req)  # capacity/duplicate errors -> __error__
+        self._seen.add(req.rid)
+        return {"ok": True}
+
+    def _h_step(self, now, ack):
+        from ..core.scope import scope_guard
+
+        self._ack(ack)
+        if now is not None:
+            # the router's fabric clock is authoritative: a step RPC the
+            # worker never saw (transport fault) must not leave its
+            # admission/deadline clock drifting behind the fabric's
+            self.engine.now = int(now)
+        self.engine._step_wall.append(time.time())
+        with scope_guard(self.scope):
+            done = self.engine.step()
+        for r in self.engine.wire_results(done):
+            self._unacked[r["rid"]] = r
+        return self._payload()
+
+    def _ack(self, rids):
+        for rid in rids or []:
+            self._unacked.pop(rid, None)
+
+    def _payload(self):
+        """Step/results/drain reply: every unacked terminal result plus
+        the post-step mirror (active slots with their emitted prefixes,
+        unadmitted queue, free-slot count).  The mirror is what the
+        router rebuilds failover replays from, so `out` must be the
+        slot's TRUE emitted prefix — a stale mirror only costs re-decode
+        work, a wrong one would fork the stream."""
+        eng = self.engine
+        return {
+            "ok": True,
+            "results": list(self._unacked.values()),
+            "slots": [{"rid": s.req.rid, "out": [int(t) for t in s.out]}
+                      for _, s in eng.pool.active_slots()],
+            "queued": [q.rid for q in eng.queue],
+            "free": len(eng.pool.free_slots()),
+            "now": int(eng.now),
+            "draining": self._draining,
+            "compile_count": int(eng.exe.compile_count),
+            "occupancy_sum": float(eng.counters["occupancy_sum"]),
+            "steps": int(eng.counters["steps"]),
+        }
+
+    def _stats(self):
+        eng = self.engine
+        s = self._payload()
+        s.update({
+            "pid": os.getpid(),
+            "n_slots": int(eng.n_slots),
+            "width": int(eng.width),
+            "t_max": int(eng.t_max),
+        })
+        s.update({k: (float(v) if isinstance(v, float) else int(v))
+                  for k, v in eng.counters.items()})
+        return s
+
+
+# ---------------------------------------------------------------------------
+# process entrypoint + spawn helper
+# ---------------------------------------------------------------------------
+def _build_engine(hp_overrides, n_slots, width, t_max, seed,
+                  queue_depth=None):
+    """Tiny-to-real GPT2 engine in a fresh scope with a FIXED startup
+    seed: every pool worker in one fabric must hold IDENTICAL weights
+    (the failover-replay precondition), and the in-process solo
+    reference in the tests rebuilds the same weights from the same
+    (config, seed) pair."""
+    import paddle_tpu as fluid
+    from ..models import gpt2
+    from .engine import ServingEngine
+
+    hp = type("HP", (gpt2.GPT2Config,),
+              {k: (float(v) if k == "dropout" else int(v))
+               for k, v in (hp_overrides or {}).items()})
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        _, lm_startup, _, _ = gpt2.gpt2_logits_program(hp, seq_len=t_max)
+        exe = fluid.Executor(fluid.CPUPlace())
+        lm_startup.random_seed = int(seed)
+        exe.run(lm_startup)
+        eng = ServingEngine(exe, hp, n_slots=int(n_slots),
+                            width=int(width), t_max=int(t_max),
+                            queue_depth=queue_depth)
+        exe.run(eng.cache_startup)
+    return eng, scope
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="serving fabric pool worker (one engine, one "
+                    "process, driven over RPC by FabricRouter)")
+    p.add_argument("--endpoint", default="127.0.0.1:0")
+    p.add_argument("--hp", default="{}",
+                   help="json GPT2Config overrides (vocab_size, n_ctx, "
+                        "d_model, n_layer, n_head, dropout)")
+    p.add_argument("--n-slots", type=int, default=2)
+    p.add_argument("--width", type=int, default=4)
+    p.add_argument("--t-max", type=int, default=24)
+    p.add_argument("--seed", type=int, default=7,
+                   help="startup seed — identical across a fabric's "
+                        "workers, or failover replay forks the stream")
+    p.add_argument("--queue-depth", type=int, default=-1,
+                   help="engine wait-queue bound (-1 = unbounded; the "
+                        "router's fabric-wide depth is the real gate)")
+    args = p.parse_args(argv)
+
+    from ..distributed.rpc import make_var_server
+
+    eng, scope = _build_engine(
+        json.loads(args.hp), args.n_slots, args.width, args.t_max,
+        args.seed,
+        queue_depth=None if args.queue_depth < 0 else args.queue_depth)
+    service = PoolWorkerService(eng, scope)
+    srv = make_var_server(args.endpoint, service)
+    srv.start()
+    # the spawner (tests, bench, launch.py's supervised children) learns
+    # the bound port from this line — keep the format stable
+    print("%sendpoint=%s pid=%d" % (READY_PREFIX, srv.endpoint,
+                                    os.getpid()), flush=True)
+    try:
+        while not service.done.wait(0.2):
+            pass
+    finally:
+        srv.shutdown()
+    c = dict(eng.counters)
+    c["compile_count"] = int(eng.exe.compile_count)
+    print("POOL-WORKER STATS %s" % json.dumps(c, sort_keys=True),
+          flush=True)
+    return 0
+
+
+def spawn_pool_worker(hp_overrides=None, n_slots=2, width=4, t_max=24,
+                      seed=7, queue_depth=None, timeout_s=120.0,
+                      env=None):
+    """Spawn one worker subprocess and wait for its READY line.
+    Returns (endpoint, proc) — the shape FabricRouter's process-mode
+    pool_factory wants.  Stdout after READY drains on a daemon thread
+    (echoed with a [pool-worker.<pid>] prefix) so the child never
+    blocks on a full pipe."""
+    import subprocess
+
+    cmd = [sys.executable, "-m", "paddle_tpu.serving.pool_worker",
+           "--hp", json.dumps(hp_overrides or {}),
+           "--n-slots", str(int(n_slots)), "--width", str(int(width)),
+           "--t-max", str(int(t_max)), "--seed", str(int(seed))]
+    if queue_depth is not None:
+        cmd += ["--queue-depth", str(int(queue_depth))]
+    child_env = dict(os.environ if env is None else env)
+    child_env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, env=child_env)
+    endpoint = None
+    deadline = time.monotonic() + float(timeout_s)
+    lines = []
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        lines.append(line.rstrip())
+        if line.startswith(READY_PREFIX):
+            for tok in line.split():
+                if tok.startswith("endpoint="):
+                    endpoint = tok.split("=", 1)[1]
+            break
+    if endpoint is None:
+        proc.kill()
+        proc.wait()
+        raise RuntimeError(
+            "pool worker never announced READY within %.0fs:\n%s"
+            % (timeout_s, "\n".join(lines[-20:])))
+
+    def _drain():
+        for ln in proc.stdout:
+            print("[pool-worker.%d] %s" % (proc.pid, ln.rstrip()),
+                  flush=True)
+
+    threading.Thread(target=_drain, daemon=True).start()
+    return endpoint, proc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

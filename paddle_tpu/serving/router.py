@@ -32,6 +32,21 @@ Degradation contract (chaos-tested, tests/test_serving_fabric.py):
   extended across failover).  Survivors see only feed-value changes:
   zero retraces.
 
+Process pools (pool_mode="process", docs/SERVING.md §7): each pool is
+a REAL worker process (serving/pool_worker.py) hosting one engine
+behind a VarServer, driven over RPC through the ProcessPool backend —
+per-verb deadlines with bounded exponential backoff (rpc.CallPolicy),
+an unacked-submit resend queue the worker dedups, and a router-side
+mirror of slots/queue rebuilt from each step reply.  A worker death
+surfaces as a BOUNDED RPC failure inside step (or as a supervisor
+death report via report_worker_death) — never a hang — and flows into
+the exact same _declare_dead replay path, so failover stays
+token-exact across a real SIGKILL.  Cross-pool placement lets pools
+of different sizes coexist: a request is placed only on pools whose
+t_max fits len(prompt)+max_new (best-fit), and one that fits NO pool
+is rejected loudly (submit raises; a fit that died mid-queue yields
+terminal REJECTED_NO_FIT), never silently truncated.
+
 Control plane: stats() speaks the same verb shape launch.py's
 _ScalingPolicy polls on pservers (queue depth / occupancy / rejection
 and re-placement counters), control_service() wraps the router for
@@ -45,7 +60,7 @@ import time
 
 import numpy as np
 
-__all__ = ["FabricRouter", "parse_pool_schedule"]
+__all__ = ["FabricRouter", "ProcessPool", "parse_pool_schedule"]
 
 
 def parse_pool_schedule(spec):
@@ -78,6 +93,222 @@ class _PoolHandle:
         self.compile_baseline = None
 
 
+# ---------------------------------------------------------------------------
+# process-pool backend (pool_mode="process"): the pool is a REAL worker
+# process (serving/pool_worker.py) driven over RPC — same interface the
+# router speaks to an in-process ServingEngine, mirrored from the
+# worker's step replies.
+# ---------------------------------------------------------------------------
+class _WireSlot:
+    """Router-side mirror of one active worker slot: the original
+    Request plus the emitted-token prefix from the worker's LAST step
+    reply.  At worker death this is the replay source — `out` may lag
+    the worker by the lost reply, which only costs re-decode work (the
+    keyed sampler re-draws the identical tokens), never exactness."""
+
+    __slots__ = ("req", "out")
+
+    def __init__(self, req, out=()):
+        self.req = req
+        self.out = list(out)
+
+
+class _MirrorPool:
+    """Duck-types the SlotPool surface the router reads (active_slots /
+    free_slots / evict / validate / fits), rebuilt from each step
+    reply.  Slots are keyed by rid — the router never addresses a
+    remote cache row directly."""
+
+    def __init__(self, n_slots, width, t_max):
+        self.n_slots = int(n_slots)
+        self.width = int(width)
+        self.t_max = int(t_max)
+        self.slots = {}  # rid -> _WireSlot (insertion-ordered)
+        self._free = self.n_slots
+
+    # capacity rules are THE SlotPool's, verbatim (they only key off
+    # t_max): one source of truth on both sides of the process boundary
+    from .pool import SlotPool as _SP
+    fits = _SP.fits
+    validate = _SP.validate
+    del _SP
+
+    def active_slots(self):
+        return list(self.slots.items())
+
+    def free_slots(self):
+        return list(range(self._free))
+
+    def evict(self, rid):
+        return self.slots.pop(rid, None)
+
+    def set_state(self, slots, free, reqs):
+        self.slots = {e["rid"]: _WireSlot(reqs[e["rid"]], e["out"])
+                      for e in slots if e["rid"] in reqs}
+        self._free = int(free)
+
+
+class _ExeStats:
+    """Stand-in for the engine's Executor in router stats: the worker
+    reports its compile_count each step (the zero-retrace failover bar
+    applies to process pools unchanged)."""
+
+    __slots__ = ("compile_count",)
+
+    def __init__(self, compile_count=0):
+        self.compile_count = int(compile_count)
+
+
+class ProcessPool:
+    """One out-of-process pool: duck-types the ServingEngine surface
+    FabricRouter drives (queue / pool / submit / step / _results /
+    counters / exe.compile_count) over RPCClient with per-verb
+    deadlines + bounded exponential backoff (rpc.CallPolicy) and an
+    UNACKED-SUBMIT RESEND QUEUE — a submit whose ack was lost resends
+    next step and the worker answers dup instead of double-admitting.
+    A worker death surfaces as an RPC failure inside submit-flush or
+    step (bounded by the policy deadline, never a hang); the router's
+    existing dead-step-thread path then declares the pool dead and
+    replays its mirror."""
+
+    def __init__(self, endpoint, proc=None, policy=None):
+        from ..distributed.rpc import CallPolicy, RPCClient
+
+        self.endpoint = str(endpoint)
+        self.proc = proc  # subprocess handle when the router spawned it
+        self.policy = policy or CallPolicy(
+            timeout_s=5.0, deadline_s=15.0, attempts=3,
+            verb_deadlines={"submit": 5.0, "shutdown": 2.0})
+        # private client (not the shared .get cache): a retired worker's
+        # endpoint must not leave a poisoned cached connection behind
+        self._cli = RPCClient(self.endpoint, timeout=self.policy.timeout_s,
+                              retries=2, retry_wait=0.05)
+        hello = self.policy.call(self._cli, "stats")
+        self.n_slots = int(hello["n_slots"])
+        self.worker_pid = int(hello.get("pid", 0))
+        self.pool = _MirrorPool(hello["n_slots"], hello["width"],
+                                hello["t_max"])
+        self.queue = []        # mirror: submitted, not yet admitted
+        self._reqs = {}        # rid -> Request, until terminal
+        self._unacked = []     # submits with no ack yet (resend queue)
+        self._ack = []         # harvested rids to ack on the next step
+        self._results = {}
+        self.now = int(hello.get("now", 0))
+        self._step_wall = []   # assigned by the router (shared clock)
+        self.counters = {
+            "occupancy_sum": float(hello.get("occupancy_sum", 0.0)),
+            "steps": int(hello.get("steps", 0))}
+        self.exe = _ExeStats(hello.get("compile_count", 0))
+
+    # ---- the engine surface the router drives --------------------------
+    def submit(self, req):
+        self.pool.validate(req)
+        self._reqs[req.rid] = req
+        self.queue.append(req)
+        self._unacked.append(req)
+        self._flush_unacked(raise_on_fail=False)
+
+    def _flush_unacked(self, raise_on_fail):
+        pending = list(self._unacked)
+        still = []
+        while pending:
+            req = pending.pop(0)
+            try:
+                r = self.policy.call(self._cli, "submit",
+                                     req=req.to_wire())
+            except ConnectionError:
+                if raise_on_fail:
+                    # keep everything unsent for the failover requeue
+                    self._unacked = still + [req] + pending
+                    raise
+                still.append(req)
+                continue
+            if not r.get("ok"):
+                raise RuntimeError(
+                    "pool worker %s refused submit rid=%r: %r"
+                    % (self.endpoint, req.rid, r))
+        self._unacked = still
+
+    def step(self):
+        """Flush pending submits, then ONE remote engine step at the
+        fabric clock.  Raises (bounded by the policy deadline) on a
+        dead worker — the router's failover path catches it."""
+        self._flush_unacked(raise_on_fail=True)
+        rep = self.policy.call(self._cli, "step", now=int(self.now),
+                               ack=list(self._ack))
+        self._ack = []
+        return self._apply_reply(rep)
+
+    def _apply_reply(self, rep):
+        self.now = int(rep["now"])
+        self.counters["occupancy_sum"] = float(rep["occupancy_sum"])
+        self.counters["steps"] = int(rep["steps"])
+        self.exe.compile_count = int(rep["compile_count"])
+        done = []
+        for r in rep["results"]:
+            r = dict(r)
+            rid = r.pop("rid")
+            self._results[rid] = r
+            self._reqs.pop(rid, None)
+            self._ack.append(rid)
+            done.append(rid)
+        self.pool.set_state(rep["slots"], rep["free"], self._reqs)
+        worker_q = [self._reqs[rid] for rid in rep["queued"]
+                    if rid in self._reqs]
+        self.queue = sorted(
+            worker_q + [q for q in self._unacked if q.rid in self._reqs],
+            key=lambda r: (r.arrival, str(r.rid)))
+        return done
+
+    # ---- lifecycle -----------------------------------------------------
+    def proc_kill(self):
+        """SIGKILL the live worker (the `pool_proc_kill` fault action).
+        Detection stays with the RPC path: the NEXT step's failure is
+        what declares the pool dead — exactly a real crash."""
+        import signal
+
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.kill()
+            return True
+        if self.worker_pid:
+            try:
+                import os
+
+                os.kill(self.worker_pid, signal.SIGKILL)
+                return True
+            except (OSError, ProcessLookupError):
+                pass
+        return False
+
+    def close(self, kill=False):
+        """Retire the worker: graceful shutdown verb (drain-and-retire)
+        or SIGKILL (failover cleanup — a hung-but-alive worker must not
+        keep decoding an already-replayed stream).  Never leaves an
+        orphan process behind."""
+        if not kill:
+            try:
+                self.policy.call(self._cli, "shutdown")
+            except (ConnectionError, RuntimeError):
+                kill = True
+        if self.proc is not None:
+            try:
+                if kill:
+                    self.proc.kill()
+                self.proc.wait(timeout=10)
+            except Exception:
+                try:
+                    self.proc.kill()
+                    self.proc.wait(timeout=10)
+                except Exception:
+                    pass
+        elif kill:
+            self.proc_kill()
+        try:
+            self._cli.close()
+        except Exception:
+            pass
+
+
 class FabricRouter:
     """pool_factory() -> (engine, scope): builds a ServingEngine whose
     scope already holds the model weights.  Every pool must hold
@@ -86,8 +317,15 @@ class FabricRouter:
     the same model on both sides."""
 
     def __init__(self, pool_factory, n_pools=1, queue_depth=None,
-                 miss_beats=2, fault_schedule=None, max_pools=8):
-        assert int(n_pools) >= 1, n_pools
+                 miss_beats=2, fault_schedule=None, max_pools=8,
+                 pool_mode="inproc", rpc_policy=None):
+        # process mode may start empty and attach workers over the
+        # control plane (launch.py's supervised children)
+        assert int(n_pools) >= (0 if pool_mode == "process" else 1), \
+            n_pools
+        assert pool_mode in ("inproc", "process"), pool_mode
+        self.pool_mode = pool_mode
+        self.rpc_policy = rpc_policy
         self.pool_factory = pool_factory
         self.queue_depth = None if queue_depth is None else int(queue_depth)
         assert self.queue_depth is None or self.queue_depth >= 0
@@ -112,27 +350,52 @@ class FabricRouter:
 
     # ---- pool membership -----------------------------------------------
     def add_pool(self):
-        """Grow one pool: build it in its own scope, zero its caches,
-        and fast-forward its clock onto the fabric's step axis (a pool
-        joining at step T must admit arrivals <= T immediately)."""
+        """Grow one pool.  In-process: build it in its own scope, zero
+        its caches.  Process mode: the factory returns (endpoint, proc)
+        — or a bare endpoint — of a READY pool worker and the router
+        wraps it in a ProcessPool backend.  Either way the pool's clock
+        fast-forwards onto the fabric's step axis (a pool joining at
+        step T must admit arrivals <= T immediately)."""
         from ..core.scope import scope_guard
 
         with self._lock:
             if len(self._routable()) >= self.max_pools:
                 raise RuntimeError(
                     "fabric at max_pools=%d" % self.max_pools)
+            if self.pool_mode == "process":
+                made = self.pool_factory()
+                endpoint, proc = (made if isinstance(made, tuple)
+                                  else (made, None))
+                return self.attach_worker(endpoint, proc=proc)
             engine, scope = self.pool_factory()
-            pid = self._next_pid
-            self._next_pid += 1
             with scope_guard(scope):
                 engine.exe.run(engine.cache_startup)
-            engine.now = self.now
-            engine._step_wall = self._step_wall  # one latency clock
-            self.pools[pid] = _PoolHandle(pid, engine, scope)
-            self.counters["pools_added"] += 1
-            print("FABRIC POOL ADD pid=%d step=%d" % (pid, self.now),
-                  flush=True)
-            return pid
+            return self._register_pool(engine, scope)
+
+    def attach_worker(self, endpoint, proc=None):
+        """Adopt one ALREADY-RUNNING pool worker process (the
+        supervisor's spawn/respawn path attaches its children here over
+        the control plane; the worker ran its own cache startup)."""
+        with self._lock:
+            if len(self._routable()) >= self.max_pools:
+                raise RuntimeError(
+                    "fabric at max_pools=%d" % self.max_pools)
+            engine = ProcessPool(endpoint, proc=proc,
+                                 policy=self.rpc_policy)
+            return self._register_pool(engine, None)
+
+    def _register_pool(self, engine, scope):
+        pid = self._next_pid
+        self._next_pid += 1
+        engine.now = self.now
+        engine._step_wall = self._step_wall  # one latency clock
+        self.pools[pid] = _PoolHandle(pid, engine, scope)
+        self.counters["pools_added"] += 1
+        print("FABRIC POOL ADD pid=%d step=%d%s"
+              % (pid, self.now,
+                 " worker=%s" % engine.endpoint
+                 if scope is None else ""), flush=True)
+        return pid
 
     def drain_pool(self, pid):
         """Begin drain-and-retire: no new placements; in-flight requests
@@ -154,6 +417,41 @@ class FabricRouter:
             self.counters["pool_kills"] += 1
             print("FABRIC POOL KILL pid=%d step=%d" % (pid, self.now),
                   flush=True)
+
+    def proc_kill_pool(self, pid):
+        """REAL SIGKILL of a process pool's worker (the
+        `pool_proc_kill` fault action).  Unlike kill_pool the handle is
+        NOT flagged: detection must ride the RPC failure at the next
+        step — exactly how an unscheduled crash presents.  In-process
+        pools fall back to the cooperative kill."""
+        with self._lock:
+            h = self.pools[pid]
+            if getattr(h.engine, "proc_kill", None) is None:
+                print("FABRIC POOL PROC-KILL pid=%d step=%d: in-process "
+                      "pool, falling back to cooperative kill"
+                      % (pid, self.now), flush=True)
+                return self.kill_pool(pid)
+            h.engine.proc_kill()
+            self.counters["pool_kills"] += 1
+            print("FABRIC POOL PROC-KILL pid=%d step=%d worker_pid=%d"
+                  % (pid, self.now, h.engine.worker_pid), flush=True)
+
+    def report_worker_death(self, pid=None, endpoint=None):
+        """Supervisor death report (launch.py's on_child_death hook):
+        the named pool is declared dead at the NEXT step without
+        spending the RPC policy deadline discovering it."""
+        with self._lock:
+            for h in list(self.pools.values()):
+                if (h.pid == pid
+                        or (endpoint is not None
+                            and getattr(h.engine, "endpoint", None)
+                            == endpoint)):
+                    h.killed = True
+                    h.missed_beats = self.miss_beats
+                    print("FABRIC POOL DEATH-REPORTED pid=%d step=%d"
+                          % (h.pid, self.now), flush=True)
+                    return True
+            return False
 
     def _routable(self):
         return [h for h in self.pools.values()
@@ -195,10 +493,19 @@ class FabricRouter:
                             for _, s in h.engine.pool.active_slots())
             if req.rid in live:
                 raise ValueError("duplicate request id %r" % (req.rid,))
-            # capacity validation against any pool's geometry (all pools
-            # share one config by construction)
-            any_pool = next(iter(self.pools.values()))
-            any_pool.engine.pool.validate(req)
+            # cross-pool capacity: pools of DIFFERENT slot/width/t_max
+            # sizes coexist — the request must fit SOME routable pool
+            # (placement then keys long-context requests to big pools).
+            # Reject-with-reason, never silently truncate.
+            routable = self._routable()
+            if not any(h.engine.pool.fits(req) for h in routable):
+                cap = max((h.engine.pool.t_max for h in routable),
+                          default=0)
+                raise ValueError(
+                    "request %r exceeds every pool's capacity: prompt "
+                    "%d + new %d > largest t_max %d + 1 — no pool fits"
+                    % (req.rid, req.prompt.size, req.max_new_tokens,
+                       cap))
             self.queue.append(req)
             self.queue.sort(key=lambda r: (r.arrival, r.rid))
             self.counters["submitted"] += 1
@@ -206,7 +513,7 @@ class FabricRouter:
     # ---- terminal bookkeeping ------------------------------------------
     def _terminal(self, req, status):
         """Router-side terminal record, same shape as engine results."""
-        self.counters["rejected" if status == "REJECTED_QUEUE_FULL"
+        self.counters["rejected" if status.startswith("REJECTED")
                       else "expired"] += 1
         print("FABRIC %s rid=%r step=%d" % (status, req.rid, self.now),
               flush=True)
@@ -238,8 +545,8 @@ class FabricRouter:
             if r["status"] == "OK":
                 self.counters["finished"] += 1
             else:
-                self.counters["rejected" if r["status"] ==
-                              "REJECTED_QUEUE_FULL" else "expired"] += 1
+                self.counters["rejected" if r["status"].startswith(
+                    "REJECTED") else "expired"] += 1
             r["pool"] = h.pid
             self._results[rid] = r
 
@@ -281,21 +588,33 @@ class FabricRouter:
         h.engine.queue = []
         self.queue.sort(key=lambda r: (r.arrival, r.rid))
         self.pools.pop(h.pid, None)
+        if isinstance(h.engine, ProcessPool):
+            # reap the dead (or hung-but-alive) worker: its streams are
+            # being replayed elsewhere, and orphans are a test failure
+            h.engine.close(kill=True)
 
     # ---- placement -----------------------------------------------------
     def _score(self, h):
         """Placement score (lower is better): per-pool health is the
         gate (only live pools are scored at all), then occupancy, then
-        the pool's own backlog, then pid for a stable tie-break."""
+        the pool's own backlog, then CAPACITY (best-fit: among fitting
+        pools a short request prefers the smallest, keeping big pools
+        free for the long-context requests only they can hold), then
+        pid for a stable tie-break."""
         active = len(h.engine.pool.active_slots())
         occ = active / float(h.engine.n_slots)
-        return (occ, len(h.engine.queue), h.pid)
+        return (occ, len(h.engine.queue), h.engine.pool.t_max, h.pid)
 
     def _place(self):
         """Route due arrivals onto pools; reject past the fabric-wide
         queue depth.  A routed request goes straight into its pool's
         engine queue against a KNOWN free slot, so pools never build
-        private backlogs — the router's queue IS the fabric queue."""
+        private backlogs — the router's queue IS the fabric queue.
+        Cross-pool placement keys off len(prompt)+max_new vs each
+        pool's t_max: a request no LIVE pool can hold (the big pool
+        died or drained since submit) terminates loudly with
+        REJECTED_NO_FIT — reject-with-reason, never a silent truncate
+        and never an unbounded wait."""
         still, waiting = [], 0
         free = {h.pid: len(h.engine.pool.free_slots())
                 for h in self._live()}
@@ -307,8 +626,13 @@ class FabricRouter:
             if d is not None and self.now >= req.arrival_step + d:
                 self._terminal(req, "DEADLINE_EXPIRED")
                 continue
+            fitting = [h for h in self._live()
+                       if h.engine.pool.fits(req)]
+            if not fitting:
+                self._terminal(req, "REJECTED_NO_FIT")
+                continue
             target = None
-            for h in sorted(self._live(), key=self._score):
+            for h in sorted(fitting, key=self._score):
                 if free.get(h.pid, 0) > 0:
                     target = h
                     break
@@ -327,6 +651,8 @@ class FabricRouter:
         """Health beats -> failover -> placement -> lockstep pool steps
         -> drain retirement.  Returns the rids that reached a terminal
         state this fabric step."""
+        from contextlib import nullcontext
+
         from ..core.scope import scope_guard
 
         with self._lock:
@@ -348,7 +674,10 @@ class FabricRouter:
                 if h.killed:
                     continue
                 try:
-                    with scope_guard(h.scope):
+                    # a process pool has no local scope — its engine
+                    # state lives across the RPC boundary
+                    with (scope_guard(h.scope) if h.scope is not None
+                          else nullcontext()):
                         done = h.engine.step()
                 except Exception as e:  # dead step thread: fail over NOW
                     print("FABRIC POOL STEP DIED pid=%d step=%d: %r"
@@ -366,6 +695,10 @@ class FabricRouter:
                     print("FABRIC POOL RETIRED pid=%d step=%d"
                           % (h.pid, self.now), flush=True)
                     self.pools.pop(h.pid, None)
+                    if isinstance(h.engine, ProcessPool):
+                        # graceful worker shutdown: drain-and-retire
+                        # must not leave an orphan process behind
+                        h.engine.close(kill=False)
             self.now += 1
             return terminal
 
@@ -373,12 +706,14 @@ class FabricRouter:
         """One fault-schedule slot per fabric step ('fabric' direction):
         a pool_kill action kills one live pool — an explicit
         'pool_kill:<pid>' names the victim, a bare 'pool_kill' picks one
-        deterministically from the schedule's seeded per-frame hash."""
+        deterministically from the schedule's seeded per-frame hash.
+        `pool_proc_kill` is the process-mode twin: a REAL SIGKILL on
+        the pool's worker process, detected by the RPC failure path."""
         if self.faults is None:
             return
         idx, action = self.faults.next_action("fabric")
         base, _, arg = str(action).partition(":")
-        if base != "pool_kill":
+        if base not in ("pool_kill", "pool_proc_kill"):
             return
         live = sorted(self._live(), key=lambda h: h.pid)
         if not live:
@@ -390,7 +725,10 @@ class FabricRouter:
         else:
             pick = int(self.faults.delay_fraction(idx) * len(live))
             pid = live[pick % len(live)].pid
-        self.kill_pool(pid)
+        if base == "pool_proc_kill":
+            self.proc_kill_pool(pid)
+        else:
+            self.kill_pool(pid)
 
     # ---- control plane -------------------------------------------------
     def stats(self):
@@ -435,9 +773,11 @@ class FabricRouter:
     def control_service(self):
         """A make_var_server-compatible service: the router side of the
         unified control plane.  Verbs: stats, scale_pools(delta),
-        drain_pool(pid), kill_pool(pid) — scale/drain/kill mutate via
+        drain_pool(pid), kill_pool(pid), attach_worker(endpoint),
+        report_pool_death(pid|endpoint) — scale/drain/kill mutate via
         request_scale/flags so the stepping thread applies them at a
-        step boundary."""
+        step boundary; attach/death-report are the supervisor's
+        process-mode spawn and on_child_death hooks."""
         router = self
 
         class _Control:
@@ -460,6 +800,14 @@ class FabricRouter:
                         with router._lock:
                             router.kill_pool(int(kw["pid"]))
                         return {"ok": True}
+                    if verb == "attach_worker":
+                        pid = router.attach_worker(kw["endpoint"])
+                        return {"ok": True, "pid": pid}
+                    if verb == "report_pool_death":
+                        hit = router.report_worker_death(
+                            pid=kw.get("pid"),
+                            endpoint=kw.get("endpoint"))
+                        return {"ok": True, "found": bool(hit)}
                     raise ValueError(
                         "unknown fabric verb %r" % (verb,))
                 except Exception as e:

@@ -52,6 +52,31 @@ class Request:
     def greedy(self):
         return self.seed is None
 
+    # ---- wire codec (out-of-process pools, serving/pool_worker.py) ----
+    def to_wire(self):
+        """Flatten to the RPC wire's closed type system (ints / floats /
+        None / ndarray) — a ProcessPool submit ships exactly this dict,
+        and from_wire must rebuild a Request whose schedule AND sampling
+        keys are identical, or the cross-process exactness contract
+        breaks at the serialization boundary."""
+        return {
+            "rid": self.rid,
+            "prompt": self.prompt,
+            "max_new_tokens": self.max_new_tokens,
+            "temperature": self.temperature,
+            "top_k": self.top_k,
+            "top_p": self.top_p,
+            "seed": self.seed,
+            "eos_id": self.eos_id,
+            "arrival": self.arrival,
+            "deadline": self.deadline,
+            "sample_step_base": self.sample_step_base,
+        }
+
+    @classmethod
+    def from_wire(cls, d):
+        return cls(**d)
+
     @property
     def arrival_step(self):
         """First engine step at which this request is admittable."""

@@ -179,7 +179,13 @@ echo "== fabric-chaos pass (multi-pool router degradation) =="
 # three-axis supervisor (one cooldown + one action budget), the dense
 # aseq resend queue across a plan flip, the consistent-hash shard walk,
 # and the slow-marked 1->3->1 scale walk (-m "") that tier-1's time
-# budget keeps out
+# budget keeps out.  The SAME -m "" also runs the PROCESS-MODE legs
+# against real pool-worker subprocesses: SIGKILL-mid-stream failover
+# via the pool_proc_kill fault action (greedy + seeded-sampled streams
+# token-identical to solo), supervisor death-report + respawn within
+# the restart budget over the control-plane RPC verbs, drain-and-
+# retire with a clean worker exit, and REJECTED_QUEUE_FULL
+# backpressure across the RPC hop
 python -m pytest tests/test_serving_fabric.py -q -m ""
 python -m pytest tests/test_fault_tolerance.py -q -m "" \
     -k "async_dense or plan_flip"
@@ -196,9 +202,10 @@ echo "== serving pass (continuous-batching churn exactness) =="
 python -m pytest tests/test_serving.py -q -m ""
 
 echo "== orphaned-child check =="
-# chaos tests SIGKILL cluster children; a leaked pserver/trainer would
+# chaos tests SIGKILL cluster children; a leaked pserver/trainer (or a
+# pool worker the fabric failed to reap after a pool_proc_kill) would
 # keep ports + fds alive and poison later runs — fail fast instead
-orphans="$(pgrep -f 'tests/dist_mlp.py|tests/launch_worker.py' || true)"
+orphans="$(pgrep -f 'tests/dist_mlp.py|tests/launch_worker.py|paddle_tpu.serving.pool_worker' || true)"
 if [ -n "$orphans" ]; then
     echo "FAIL: orphaned dist children survived the suite:"
     # pgrep emits one pid per line; ps -p wants a comma-joined list

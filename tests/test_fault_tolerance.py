@@ -733,6 +733,62 @@ def test_cluster_kill_one_is_expected_failure():
     assert cluster.proc("victim").returncode != 0
 
 
+def test_control_call_passes_endpoint_kwarg_through():
+    """Regression: _control_call's own first parameter was named
+    `endpoint`, shadowing the attach_worker/report_pool_death verbs'
+    `endpoint` kwarg (TypeError: multiple values for argument) — the
+    launcher could never attach a process-mode pool worker."""
+    from paddle_tpu.distributed.launch import _control_call
+
+    class _Ctl:
+        def handle(self, verb, **kw):
+            return {"verb": verb, "echo": kw.get("endpoint")}
+
+    srv = VarServer("127.0.0.1:0", _Ctl()).start()
+    try:
+        r = _control_call(srv.endpoint, "attach_worker",
+                          endpoint="10.0.0.1:99")
+        assert r == {"verb": "attach_worker", "echo": "10.0.0.1:99"}
+    finally:
+        srv.shutdown()
+        with RPCClient._lock:
+            RPCClient._instances.pop(srv.endpoint, None)
+
+
+def test_cluster_aux_children_do_not_hold_job_open():
+    """Regression: process-mode pool workers serve RPC until told to
+    stop, so cluster.wait() used to hang forever once the training job
+    completed.  Aux children are excluded from the conclusion scan and
+    retired when the job concludes."""
+    from paddle_tpu.distributed.launch import _Cluster
+
+    cluster = _Cluster()
+    env = dict(os.environ)
+    cluster.spawn("pool_worker.0", [sys.executable, "-c",
+                  "import time; time.sleep(120)"], env, aux=True)
+    cluster.spawn("trainer.0", [sys.executable, "-c",
+                  "print('done')"], env)
+    t0 = time.monotonic()
+    assert cluster.wait() == 0
+    assert time.monotonic() - t0 < 60, "wait() hung on the aux child"
+    p = cluster.proc("pool_worker.0")
+    assert p.poll() is not None, "aux child not retired at conclusion"
+
+
+def test_cluster_aux_death_never_fails_the_job():
+    """A service child dying (pool_proc_kill chaos, OOM) degrades
+    serving; it must not take the training job down with it."""
+    from paddle_tpu.distributed.launch import _Cluster
+
+    cluster = _Cluster()
+    env = dict(os.environ)
+    cluster.spawn("pool_worker.0", [sys.executable, "-c",
+                  "import sys; sys.exit(3)"], env, aux=True)
+    cluster.spawn("trainer.0", [sys.executable, "-c",
+                  "import time; time.sleep(1.0); print('done')"], env)
+    assert cluster.wait() == 0
+
+
 def test_launcher_reports_trainer_death_to_pserver():
     """The pre-heartbeat kill window: a trainer that dies BEFORE its
     first pserver contact was never tracked, so liveness eviction can't
@@ -2981,6 +3037,89 @@ def test_migrated_state_survives_target_restart(tmp_path):
         tgt2.sparse_tables["t0.shard0"]["moment"],
         src.sparse_tables["t0.shard0"]["moment"])
     assert tgt2._sparse_shard_idx["t0.shard0"] == 0
+
+
+def test_delta_migration_dirty_tail_and_freeze_shrink():
+    """ACCEPTANCE (incremental delta handoff, ROADMAP 3a): a LARGE
+    embedding shard ships as an UNFROZEN snapshot while the source
+    keeps applying updates; only the rows dirtied in between ride the
+    frozen final tail (an `mrows` record, a tiny fraction of the
+    snapshot bytes), land bit-exact at the target — and the frozen
+    window shrinks versus the full-copy handoff of the same shard,
+    where the freeze spans the whole serialize+ship."""
+    n, dim = 20000, 32
+
+    def big_src(base):
+        s = _mig_ps(base, base[0], shards={"emb.shard0": 0},
+                    with_slots=True)
+        info = s.sparse_tables["emb.shard0"]
+        rng = np.random.RandomState(3)
+        info["tbl"] = rng.rand(n, dim).astype(np.float32)
+        info["moment"] = np.full((n, dim), 0.5, np.float32)
+        return s
+
+    def run_leg(base, delta, mutate_between=False):
+        src = big_src(base)
+        tgt = _mig_ps(base, None)
+        srv = VarServer("127.0.0.1:0", tgt).start()
+        tgt.endpoint = srv.endpoint
+        ship = {"frames": []}
+        real = tgt._h_migrate_in
+
+        def spy(frames, source=None, trainer_id=0):
+            r = real(frames, source=source, trainer_id=trainer_id)
+            ship["frames"].append([bytes(f) for f in frames])
+            if mutate_between and len(ship["frames"]) == 1:
+                # between the unfrozen snapshot and the freeze: the
+                # source is still serving — this application must ride
+                # the dirty-row tail, not be lost
+                with src._cv:
+                    src._apply_sparse(
+                        "emb.shard0", np.array([1, 5, 9], np.int64),
+                        np.ones((3, dim), np.float32))
+            return r
+
+        tgt._h_migrate_in = spy
+        try:
+            r = src._h_migrate_begin(world=[srv.endpoint], delta=delta)
+            assert r["ok"], r
+            assert src._h_migrate_commit(world=[srv.endpoint])["ok"]
+        finally:
+            srv.shutdown()
+            with RPCClient._lock:
+                RPCClient._instances.pop(srv.endpoint, None)
+        return src, tgt, r, ship["frames"]
+
+    # full-copy reference: ONE migrate_in, inside the freeze
+    _, tgt_f, r_full, ships_f = run_leg(["10.9.9.5:1"], delta=False)
+    assert len(ships_f) == 1
+    # delta: snapshot ships first (unfrozen), the tail second (frozen)
+    src_d, tgt_d, r_delta, ships_d = run_leg(
+        ["10.9.9.4:1"], delta=True, mutate_between=True)
+    assert len(ships_d) == 2, "expected snapshot + frozen tail"
+    kinds = [ParameterServer._mig_unframe(f)["k"] for f in ships_d[1]]
+    assert "mrows" in kinds, kinds
+    # the mid-handoff update landed bit-exact (rows 1/5/9 overlaid):
+    # the target must equal a reference server that saw the SAME apply
+    assert src_d.sparse_tables.get("emb.shard0") is None  # committed away
+    ref_src = big_src(["10.9.9.3:1"])
+    with ref_src._cv:
+        ref_src._apply_sparse("emb.shard0",
+                              np.array([1, 5, 9], np.int64),
+                              np.ones((3, dim), np.float32))
+    for field in ("tbl", "moment"):
+        np.testing.assert_array_equal(
+            tgt_d.sparse_tables["emb.shard0"][field],
+            ref_src.sparse_tables["emb.shard0"][field])
+    np.testing.assert_array_equal(
+        tgt_f.sparse_tables["emb.shard0"]["tbl"],
+        big_src(["10.9.9.2:1"]).sparse_tables["emb.shard0"]["tbl"])
+    # the frozen tail is a tiny fraction of the snapshot bytes...
+    tail = sum(len(f) for f in ships_d[1])
+    snap = sum(len(f) for f in ships_d[0])
+    assert tail < 0.05 * snap, (tail, snap)
+    # ...and the frozen WINDOW shrinks vs the full-copy handoff
+    assert r_delta["freeze_ms"] < r_full["freeze_ms"], (r_delta, r_full)
 
 
 class _StubPipe:

@@ -506,3 +506,328 @@ def test_pool_kill_action_validation():
         FaultSchedule({"fabric": {3: "pool_kill:x"}})
     with pytest.raises(ValueError, match="direction"):
         FaultSchedule({"sideways": {0: "pass"}})
+
+
+# ---------------------------------------------------------------------------
+# cross-pool placement: heterogeneous capacities (in-process, fast)
+# ---------------------------------------------------------------------------
+def _sized_pool_factory(n_slots=2, width=4, seed=7, t_max=T_MAX):
+    """Like _pool_factory but with a per-pool t_max: heterogeneous
+    capacities are what cross-pool placement keys off."""
+
+    def factory():
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            _, lm_startup, _, _ = gpt2.gpt2_logits_program(
+                TinyHP, seq_len=t_max)
+            exe = fluid.Executor(fluid.CPUPlace())
+            lm_startup.random_seed = seed
+            exe.run(lm_startup)
+            eng = ServingEngine(exe, TinyHP, n_slots=n_slots,
+                                width=width, t_max=t_max)
+        return eng, scope
+
+    return factory
+
+
+def _hetero_router(queue_depth=8):
+    """One SMALL pool (t_max=12) + one BIG pool (t_max=24)."""
+    router = FabricRouter(_sized_pool_factory(t_max=12), n_pools=1,
+                          queue_depth=queue_depth)
+    router.pool_factory = _sized_pool_factory(t_max=T_MAX)
+    big = router.add_pool()
+    return router, big
+
+
+@pytest.mark.slow  # ~8s engine builds; rides the ci.sh fabric lane
+def test_cross_pool_placement_long_request_keys_to_big_pool():
+    """A long-context request fits ONLY the big pool and lands there;
+    a short one prefers the SMALLEST fitting pool (best-fit keeps the
+    big pool free for requests only it can hold)."""
+    router, big = _hetero_router()
+    small = [pid for pid in router.pools if pid != big][0]
+    long_req = Request(rid="L", prompt=np.arange(1, 13),
+                       max_new_tokens=12, arrival=0.0)  # 24 > 12+1
+    short_req = Request(rid="S", prompt=np.arange(1, 5),
+                        max_new_tokens=4, arrival=0.0)
+    router.submit(long_req)
+    router.submit(short_req)
+    router.step()
+    placed = {pid: {s.req.rid for _, s in
+                    h.engine.pool.active_slots()}
+              for pid, h in router.pools.items()}
+    assert "L" in placed[big] and "L" not in placed[small]
+    assert "S" in placed[small]
+
+
+@pytest.mark.slow  # ~8s engine builds; rides the ci.sh fabric lane
+def test_cross_pool_submit_rejects_when_no_pool_fits():
+    """A request bigger than EVERY pool is rejected at submit with the
+    reason in the error — never silently truncated, never queued to
+    wait for a pool that cannot exist."""
+    router, _ = _hetero_router()
+    too_big = Request(rid="XXL", prompt=np.arange(1, 20),
+                      max_new_tokens=20, arrival=0.0)
+    with pytest.raises(ValueError, match="capacity"):
+        router.submit(too_big)
+    assert not router.queue
+
+
+@pytest.mark.slow  # ~8s engine builds; rides the ci.sh fabric lane
+def test_cross_pool_no_fit_after_big_pool_dies_is_loud():
+    """The ONLY pool that could hold a queued long request dies before
+    placement: the request terminates REJECTED_NO_FIT at the next
+    placement pass — reject-with-reason, not an unbounded wait."""
+    router, big = _hetero_router()
+    long_req = Request(rid="L", prompt=np.arange(1, 13),
+                       max_new_tokens=12, arrival=0.0)
+    router.submit(long_req)
+    router.kill_pool(big)
+    for _ in range(8):  # death declared after miss_beats, then place
+        router.step()
+        if "L" in router._results:
+            break
+    assert router._results["L"]["status"] == "REJECTED_NO_FIT"
+    assert router.counters["rejected"] == 1
+
+
+def test_call_policy_bounded_retry_and_verb_deadlines():
+    """CallPolicy: per-verb deadlines override the default; transport
+    failures retry up to `attempts` within the deadline and surface as
+    ONE ConnectionError naming the policy; remote application errors
+    (RuntimeError from {"__error__": ...}) are NEVER retried."""
+    from paddle_tpu.distributed.rpc import CallPolicy
+
+    pol = CallPolicy(timeout_s=1.0, deadline_s=0.5, attempts=3,
+                     backoff_base=0.01, backoff_cap=0.02,
+                     verb_deadlines={"submit": 0.1})
+    assert pol.deadline_for("submit") == 0.1
+    assert pol.deadline_for("step") == 0.5
+    calls = []
+
+    class _Down:
+        endpoint = "10.0.0.1:9"
+
+        def call(self, verb, timeout_s=None, deadline_s=None, **kw):
+            calls.append(verb)
+            raise ConnectionError("refused")
+
+    with pytest.raises(ConnectionError, match="policy deadline"):
+        pol.call(_Down(), "step")
+    assert len(calls) == 3  # bounded: exactly `attempts`, then done
+
+    class _Remote:
+        endpoint = "10.0.0.1:9"
+
+        def call(self, verb, timeout_s=None, deadline_s=None, **kw):
+            calls.append("remote")
+            raise RuntimeError("unknown verb")
+
+    calls.clear()
+    with pytest.raises(RuntimeError, match="unknown verb"):
+        pol.call(_Remote(), "step")
+    assert calls == ["remote"]  # retrying a bug only hides it
+
+
+def test_request_wire_round_trip_preserves_schedule_and_sampling():
+    """Request.to_wire/from_wire: the ProcessPool submit boundary must
+    preserve every schedule AND sampling key bit-exact, or the
+    cross-process exactness contract breaks at serialization."""
+    r = Request(rid="w1", prompt=np.arange(1, 6), max_new_tokens=4,
+                temperature=0.9, top_k=8, top_p=0.9, seed=11,
+                eos_id=2, arrival=1.5, deadline=9, sample_step_base=3)
+    r2 = Request.from_wire(r.to_wire())
+    np.testing.assert_array_equal(r2.prompt, r.prompt)
+    for k in ("rid", "max_new_tokens", "temperature", "top_k", "top_p",
+              "seed", "eos_id", "arrival", "deadline",
+              "sample_step_base"):
+        assert getattr(r2, k) == getattr(r, k), k
+    g = Request(rid="w2", prompt=np.arange(1, 3), max_new_tokens=2)
+    assert Request.from_wire(g.to_wire()).greedy
+
+
+# ---------------------------------------------------------------------------
+# process-pool mode: REAL worker processes over RPC (docs/SERVING.md §7)
+# ---------------------------------------------------------------------------
+_HP_WIRE = {"vocab_size": 61, "n_ctx": 32, "d_model": 32, "n_layer": 2,
+            "n_head": 4, "dropout": 0.0}
+
+
+def _proc_policy():
+    from paddle_tpu.distributed.rpc import CallPolicy
+
+    return CallPolicy(timeout_s=2.0, deadline_s=4.0, attempts=2,
+                      verb_deadlines={"submit": 2.0, "shutdown": 1.0})
+
+
+def _worker_factory(n_slots=2):
+    from paddle_tpu.serving import spawn_pool_worker
+
+    def factory():
+        return spawn_pool_worker(hp_overrides=_HP_WIRE, n_slots=n_slots,
+                                 width=4, t_max=T_MAX, seed=7)
+
+    return factory
+
+
+def _close_procs(router):
+    """Retire every remaining worker (shutdown verb, not SIGKILL) and
+    return the Popen handles so tests can assert clean exits."""
+    procs = [h.engine.proc for h in router.pools.values()
+             if getattr(h.engine, "proc", None) is not None]
+    for h in list(router.pools.values()):
+        h.engine.close(kill=False)
+    return procs
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mode", ["greedy", "sampled"])
+def test_process_pool_sigkill_midstream_stream_stays_solo_exact(mode):
+    """ACCEPTANCE: a request in flight on a REAL worker process when
+    that worker is SIGKILL'd finishes token-identical to its solo run —
+    greedy and seeded-sampled.  Death is detected by the bounded RPC
+    policy (never a hang); the emitted prefix replays on a survivor."""
+    rng = np.random.RandomState(5 if mode == "greedy" else 6)
+    reqs = [Request(rid=i,
+                    prompt=rng.randint(1, 61, 5).astype("int64"),
+                    max_new_tokens=10,
+                    temperature=1.0 if mode == "greedy" else 0.9,
+                    top_k=0 if mode == "greedy" else 8,
+                    seed=None if mode == "greedy" else 1000 + i,
+                    arrival=0.0)
+            for i in range(4)]
+    faults = FaultSchedule(schedule={"fabric": {4: "pool_proc_kill"}},
+                           seed=5)
+    router = FabricRouter(_worker_factory(), n_pools=2, queue_depth=16,
+                          pool_mode="process",
+                          rpc_policy=_proc_policy(),
+                          fault_schedule=faults, miss_beats=2)
+    try:
+        results, stats = router.run(list(reqs))
+    finally:
+        _close_procs(router)
+    assert stats["pools_died"] == 1 and stats["replaced"] >= 1
+    assert stats["finished"] == 4 and stats["rejected"] == 0
+    eng, scope = _pool_factory(n_slots=4)()
+    with fluid.scope_guard(scope):
+        for r in reqs:
+            ref, _ = eng.run_solo(r)
+            got = np.asarray(results[r.rid]["tokens"])
+            assert np.array_equal(np.asarray(ref), got), (
+                "rid %r (%s) diverged from solo after SIGKILL failover"
+                % (r.rid, mode))
+
+
+@pytest.mark.slow
+def test_process_pool_drain_and_retire_no_orphan_worker():
+    """drain_pool on a REAL worker: in-flight requests finish on their
+    slots, retirement sends the shutdown verb, and the worker process
+    EXITS cleanly — no orphan to leak past the test run."""
+    router = FabricRouter(_worker_factory(), n_pools=2, queue_depth=32,
+                          pool_mode="process",
+                          rpc_policy=_proc_policy())
+    procs = {h.pid: h.engine.proc for h in router.pools.values()}
+    args = (8, 1.0, 7)
+    for r in _trace(*args):
+        router.submit(r)
+    drained = None
+    while router.queue or any(h.engine.queue
+                              or h.engine.pool.active_slots()
+                              for h in router.pools.values()):
+        router.step()
+        if router.now == 3:
+            drained = sorted(router.pools)[0]
+            router.drain_pool(drained)
+        assert router.now < 3000
+    assert drained is not None and drained not in router.pools
+    assert procs[drained].wait(timeout=30) == 0, \
+        "retired worker did not exit cleanly"
+    results = dict(router._results)
+    assert {r["status"] for r in results.values()} == {"OK"}
+    _assert_solo_exact(results, args)
+    for p in _close_procs(router):
+        assert p.wait(timeout=30) == 0
+
+
+@pytest.mark.slow
+def test_process_pool_backpressure_rejects_loudly_over_rpc(capsys):
+    """Fabric backpressure in process mode: overflow past queue_depth
+    is a loud REJECTED_QUEUE_FULL even though admission now crosses an
+    RPC hop — the router's queue is still THE fabric queue, and the
+    worker's own queue never buffers past known-free slots."""
+    router = FabricRouter(_worker_factory(n_slots=2), n_pools=1,
+                          queue_depth=2, pool_mode="process",
+                          rpc_policy=_proc_policy())
+    burst = [Request(rid=i, prompt=np.arange(1, 5), max_new_tokens=6,
+                     arrival=0.0) for i in range(8)]
+    try:
+        results, stats = router.run(burst)
+    finally:
+        _close_procs(router)
+    st = [results[i]["status"] for i in range(8)]
+    assert st.count("REJECTED_QUEUE_FULL") == 4  # 2 slots + 2 waiting
+    assert st.count("OK") == 4
+    assert stats["rejected"] == 4
+    for i in range(8):
+        if results[i]["status"] == "OK":
+            assert len(results[i]["tokens"]) == 6
+    assert "REJECTED_QUEUE_FULL" in capsys.readouterr().out
+
+
+@pytest.mark.slow
+def test_process_pool_supervisor_respawn_within_budget():
+    """The supervisor loop in miniature over the REAL control plane: a
+    worker SIGKILL'd from outside is death-reported over RPC (beating
+    the detection deadline), ONE respawn is drawn from the
+    _RestartPolicy budget, and the replacement attaches via the
+    attach_worker verb — every stream still finishes solo-exact."""
+    import os
+    import signal
+
+    from paddle_tpu.distributed.rpc import RPCClient
+
+    factory = _worker_factory()
+    router = FabricRouter(factory, n_pools=2, queue_depth=32,
+                          pool_mode="process",
+                          rpc_policy=_proc_policy())
+    srv = router.serve_control("127.0.0.1:0")
+    budget = _RestartPolicy(max_restarts=2, window_s=60.0,
+                            backoff_s=0.0)
+    args = (10, 1.0, 9)
+    for r in _trace(*args):
+        router.submit(r)
+    cli = RPCClient(srv.endpoint, timeout=5, retries=2)
+    respawned = False
+    try:
+        while router.queue or any(h.engine.queue
+                                  or h.engine.pool.active_slots()
+                                  for h in router.pools.values()):
+            router.step()
+            if router.now == 3 and not respawned:
+                victim = sorted(router.pools)[0]
+                h = router.pools[victim]
+                os.kill(h.engine.worker_pid, signal.SIGKILL)
+                assert budget.next_delay() is not None  # draw 1 of 2
+                r = cli.call("report_pool_death",
+                             endpoint=h.engine.endpoint)
+                assert r["ok"] and r["found"]
+                new_ep, proc = factory()
+                r2 = cli.call("attach_worker", endpoint=new_ep)
+                assert r2["ok"]
+                # launch.py holds the child Popen itself; tests park it
+                # on the handle so cleanup can assert a clean exit
+                router.pools[r2["pid"]].engine.proc = proc
+                respawned = True
+            assert router.now < 3000
+    finally:
+        cli.close()
+        srv.shutdown()
+        _close_procs(router)
+    assert respawned
+    stats = router.stats()
+    assert stats["pools_died"] == 1
+    results = dict(router._results)
+    assert {r["status"] for r in results.values()} == {"OK"}
+    _assert_solo_exact(results, args)
+    assert budget.next_delay() is not None  # draw 2 of 2...
+    assert budget.next_delay() is None      # ...budget exhausted
