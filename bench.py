@@ -334,6 +334,22 @@ def _bench_impl():
         except Exception as e:
             sys.stderr.write("serve bench failed: %r\n" % (e,))
             result["serve"] = {"error": repr(e)[:200]}
+    # in-pool speculative decoding: the same Poisson trace with a draft
+    # model proposing k-1 tokens per round, one widened verify dispatch
+    if os.environ.get("BENCH_SERVE_SPEC", "0") == "1":
+        try:
+            result["serve_spec"] = _serve_spec_bench(on_tpu, device)
+        except Exception as e:
+            sys.stderr.write("serve_spec bench failed: %r\n" % (e,))
+            result["serve_spec"] = {"error": repr(e)[:200]}
+    # prefix-cache KV reuse: the prefix-heavy trace cold vs registered
+    # templates vs prefix+spec combined (the serving fast path A/B)
+    if os.environ.get("BENCH_SERVE_PREFIX", "0") == "1":
+        try:
+            result["serve_prefix"] = _serve_prefix_bench(on_tpu, device)
+        except Exception as e:
+            sys.stderr.write("serve_prefix bench failed: %r\n" % (e,))
+            result["serve_prefix"] = {"error": repr(e)[:200]}
     # tensor-parallel serving pool: the same trace through a GSPMD
     # mesh-sharded engine — pool HBM per device, comm attribution
     if os.environ.get("BENCH_SERVE_TP", "0") == "1":
@@ -848,6 +864,289 @@ def _serve_bench(on_tpu, device):
         out["exactness_mismatches"] = mismatches
         sys.stderr.write("SERVE_RESULT speedup %s mismatches %d\n"
                          % (out["speedup_vs_one_at_a_time"], mismatches))
+    return out
+
+
+def _serve_spec_bench(on_tpu, device):
+    """In-pool speculative decoding leg (BENCH_SERVE_SPEC=1): the SAME
+    seeded Poisson trace through (a) the plain pooled engine and (b) a
+    ServingEngine(draft=..., spec_k=K) — per round the draft proposes
+    k-1 tokens and ONE widened target dispatch verifies anchor+drafts.
+    Draft flavor via BENCH_SERVE_SPEC_DRAFT: "half" (default) truncates
+    the target to n_layer//2 layers with the surviving weights copied
+    by name into the draft's own scope (the separate-draft path);
+    "self" re-hosts the target's weights over a second KV pool (the
+    pool-worker failover mode — exact but compute-neutral).  Reports
+    tok/s for both, the acceptance rate (aggregate + per-request p50),
+    target-dispatch counts, and the always-on exactness checks: greedy
+    pooled spec streams vs the plain engine AND vs the solo
+    greedy_generate_cached chain; sampled pooled spec streams vs
+    run_solo on the same spec engine (the keyed-resolver contract)."""
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models import gpt2
+    from paddle_tpu.serving import ServingEngine, make_poisson_trace
+
+    class HP(gpt2.GPT2Config):
+        vocab_size = 8000 if on_tpu else 200
+        n_ctx = 256 if on_tpu else 64
+        d_model = 256 if on_tpu else 64
+        n_layer = 4 if on_tpu else 2
+        n_head = 4 if on_tpu else 2
+        dropout = 0.0
+
+    slots = int(os.environ.get("BENCH_SERVE_SLOTS", 8 if on_tpu else 4))
+    width = int(os.environ.get("BENCH_SERVE_WIDTH", 16 if on_tpu else 8))
+    n_req = int(os.environ.get("BENCH_SERVE_REQS", 32 if on_tpu else 16))
+    rate = float(os.environ.get("BENCH_SERVE_RATE", "2.0"))
+    spec_k = int(os.environ.get("BENCH_SERVE_SPEC_K", "4"))
+    flavor = os.environ.get("BENCH_SERVE_SPEC_DRAFT", "self")
+    t_max = HP.n_ctx
+    trace = make_poisson_trace(
+        n_req, rate,
+        prompt_len_range=(4, t_max // 4),
+        out_len_range=(4, t_max // 4),
+        vocab_size=HP.vocab_size,
+        seed=int(os.environ.get("BENCH_SERVE_SEED", "0")),
+        sampled_fraction=0.5)
+
+    def pct(sorted_vals, p):
+        return sorted_vals[min(len(sorted_vals) - 1,
+                               int(p * len(sorted_vals)))]
+
+    scope = fluid.Scope()
+    out = {"spec_k": spec_k, "draft": flavor}
+    with fluid.scope_guard(scope):
+        _, lm_startup, _, _ = gpt2.gpt2_logits_program(HP, seq_len=t_max)
+        exe = fluid.Executor(fluid.TPUPlace(0) if on_tpu else fluid.CPUPlace())
+        lm_startup.random_seed = 23
+        exe.run(lm_startup)
+
+        base = ServingEngine(exe, HP, n_slots=slots, width=width,
+                             t_max=t_max)
+        base.run(trace[:2])  # warm compile
+        base_res, base_stats = base.run(trace)
+
+        if flavor == "self":
+            draft = "self"
+        else:
+            # truncated draft: first half of the target's blocks + the
+            # shared embeddings/final-ln, weights copied by NAME into
+            # the draft's own scope (same builder => same param names)
+            class DraftHP(HP):
+                n_layer = max(1, HP.n_layer // 2)
+
+            draft_scope = fluid.Scope()
+            with fluid.scope_guard(draft_scope):
+                d_main, d_startup, _, _ = gpt2.gpt2_logits_program(
+                    DraftHP, seq_len=t_max)
+                d_startup.random_seed = 23
+                exe.run(d_startup, scope=draft_scope)
+            copied = 0
+            for p in d_main.global_block().all_parameters():
+                src = scope.find_var(p.name)
+                if src is not None:
+                    draft_scope.set(p.name, src)
+                    copied += 1
+            out["draft_params_copied"] = copied
+            out["draft_layers"] = int(DraftHP.n_layer)
+            draft = (DraftHP, draft_scope)
+
+        eng = ServingEngine(exe, HP, n_slots=slots, width=width,
+                            t_max=t_max, draft=draft, spec_k=spec_k)
+        eng.run(trace[:2])  # warm compile (step + draft + spec resolve)
+        compiles_warm = exe.compile_count
+        results, stats = eng.run(trace)
+        acc = sorted(r["accept_rate"] for r in results.values()
+                     if r["spec_proposed"])
+        out["speculative"] = {
+            "value": stats["tokens_per_s"],
+            "unit": "new tokens/sec" + ("" if on_tpu else " (cpufallback)"),
+            "accept_rate": round(stats["accept_rate"], 4),
+            "accept_rate_p50": round(pct(acc, 0.50), 4) if acc else 1.0,
+            "spec_rounds": stats["spec_rounds"],
+            "spec_proposed": stats["spec_proposed"],
+            "spec_accepted": stats["spec_accepted"],
+            "draft_steps": stats["draft_steps"],
+            "target_dispatches": stats["prefill_chunks"]
+            + stats["spec_rounds"],
+            "new_tokens": stats["new_tokens"],
+            "retraces_during_run": exe.compile_count - compiles_warm,
+        }
+        out["plain"] = {
+            "value": base_stats["tokens_per_s"],
+            "unit": "new tokens/sec" + ("" if on_tpu else " (cpufallback)"),
+            "target_dispatches": base_stats["prefill_chunks"]
+            + base_stats["decode_steps"],
+            "new_tokens": base_stats["new_tokens"],
+        }
+        out["speedup_vs_plain"] = round(
+            stats["tokens_per_s"] / (base_stats["tokens_per_s"] or 1.0), 2)
+        # the number that transfers to a real (cheap-draft) deployment:
+        # how many TARGET dispatches each emitted token costs
+        out["target_dispatches_per_token"] = round(
+            out["speculative"]["target_dispatches"]
+            / max(1, stats["new_tokens"]), 3)
+        out["target_dispatches_per_token_plain"] = round(
+            out["plain"]["target_dispatches"]
+            / max(1, base_stats["new_tokens"]), 3)
+
+        # exactness rides the bench: greedy pooled spec == plain pooled
+        # == solo cached chain; sampled pooled spec == its own run_solo
+        mismatches = 0
+        for r in trace:
+            if r.greedy and not np.array_equal(
+                    results[r.rid]["tokens"], base_res[r.rid]["tokens"]):
+                mismatches += 1
+        step_main, cst, _, sfetch, _ = gpt2.gpt2_decode_step_program(
+            HP, batch=1, t_max=t_max)
+        solo_budget = 4
+        for r in trace:
+            if solo_budget == 0:
+                break
+            if r.greedy:
+                ref = gpt2.greedy_generate_cached(
+                    exe, step_main, cst, sfetch, r.prompt[None, :],
+                    r.max_new_tokens)[0, r.prompt.size:]
+            else:
+                ref, _ = eng.run_solo(r)
+            got = np.asarray(results[r.rid]["tokens"])
+            ref = np.asarray(ref)[:got.size]
+            if not np.array_equal(got, ref):
+                mismatches += 1
+            solo_budget -= 1
+        out["exactness_mismatches"] = mismatches
+        sys.stderr.write(
+            "SERVE_RESULT speculative %s\n" % json.dumps(out["speculative"]))
+        sys.stderr.write(
+            "SERVE_RESULT spec_speedup %s mismatches %d\n"
+            % (out["speedup_vs_plain"], mismatches))
+    return out
+
+
+def _serve_prefix_bench(on_tpu, device):
+    """Prefix-cache KV reuse leg (BENCH_SERVE_PREFIX=1): the
+    prefix-heavy open-loop trace (make_prefix_trace — shared system-
+    prompt templates + fresh tails, 90% reuse) through (a) the plain
+    engine (spec-off/prefix-off: every prompt prefills cold), (b) the
+    SAME engine shape with the templates registered in a PrefixCache
+    (admission longest-matches and prefill resumes AT the boundary),
+    and (c) prefix + self-draft speculation combined (the full fast
+    path every pool inherits).  Reports tok/s for all three, prefill
+    dispatches saved (the ISSUE's >=50% bar), prefix hit counters, the
+    compile-count pin, and the always-on exactness checks: prefix-hit
+    streams bit-identical to cold streams for EVERY request; the
+    combined engine's greedy streams vs cold and sampled streams vs
+    its own run_solo."""
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models import gpt2
+    from paddle_tpu.serving import ServingEngine, make_prefix_trace
+
+    class HP(gpt2.GPT2Config):
+        vocab_size = 8000 if on_tpu else 200
+        n_ctx = 256 if on_tpu else 128
+        d_model = 256 if on_tpu else 64
+        n_layer = 4 if on_tpu else 2
+        n_head = 4 if on_tpu else 2
+        dropout = 0.0
+
+    slots = int(os.environ.get("BENCH_SERVE_SLOTS", 8 if on_tpu else 4))
+    width = int(os.environ.get("BENCH_SERVE_WIDTH", 16 if on_tpu else 8))
+    n_req = int(os.environ.get("BENCH_SERVE_PREFIX_REQS",
+                               48 if on_tpu else 24))
+    rate = float(os.environ.get("BENCH_SERVE_RATE", "2.0"))
+    n_pfx = int(os.environ.get("BENCH_SERVE_PREFIXES", "2"))
+    t_max = HP.n_ctx
+    trace, prefixes = make_prefix_trace(
+        n_req, rate, n_prefixes=n_pfx, prefix_len=t_max // 2,
+        tail_len_range=(2, 6), out_len_range=(4, 8),
+        vocab_size=HP.vocab_size,
+        seed=int(os.environ.get("BENCH_SERVE_SEED", "0")),
+        reuse_fraction=0.9, sampled_fraction=0.5)
+
+    scope = fluid.Scope()
+    out = {"requests": n_req, "prefixes": n_pfx,
+           "prefix_len": t_max // 2}
+    with fluid.scope_guard(scope):
+        _, lm_startup, _, _ = gpt2.gpt2_logits_program(HP, seq_len=t_max)
+        exe = fluid.Executor(fluid.TPUPlace(0) if on_tpu else fluid.CPUPlace())
+        lm_startup.random_seed = 23
+        exe.run(lm_startup)
+
+        def leg(key, eng, register):
+            if register:
+                for p in prefixes:
+                    row = eng.register_prefix(p)
+                    assert row is not None, "template shorter than chunk"
+            eng.run(trace[:2])  # warm compile
+            compiles_warm = exe.compile_count
+            results, stats = eng.run(trace)
+            out[key] = {
+                "value": stats["tokens_per_s"],
+                "unit": "new tokens/sec"
+                + ("" if on_tpu else " (cpufallback)"),
+                "prefill_chunks": stats["prefill_chunks"],
+                "steps": stats["steps"],
+                "prefix_hit_rate": round(stats["prefix_hit_rate"], 4),
+                "prefix_tokens_reused": stats["prefix_tokens_reused"],
+                "retraces_during_run": exe.compile_count - compiles_warm,
+            }
+            sys.stderr.write(
+                "SERVE_RESULT %s %s\n" % (key, json.dumps(out[key])))
+            return results, stats
+
+        cold_res, cold_stats = leg(
+            "cold", ServingEngine(exe, HP, n_slots=slots, width=width,
+                                  t_max=t_max), register=False)
+        warm = ServingEngine(exe, HP, n_slots=slots, width=width,
+                             t_max=t_max, prefix_rows=n_pfx)
+        warm_res, warm_stats = leg("prefix", warm, register=True)
+        both = ServingEngine(exe, HP, n_slots=slots, width=width,
+                             t_max=t_max, prefix_rows=n_pfx,
+                             draft="self",
+                             spec_k=int(os.environ.get(
+                                 "BENCH_SERVE_SPEC_K", "4")))
+        both_res, both_stats = leg("prefix_plus_spec", both, register=True)
+        out["prefix"]["accept_rate"] = 1.0
+        out["prefix_plus_spec"]["accept_rate"] = round(
+            both_stats["accept_rate"], 4)
+
+        cold_tps = cold_stats["tokens_per_s"] or 1.0
+        out["speedup_prefix_vs_cold"] = round(
+            warm_stats["tokens_per_s"] / cold_tps, 2)
+        out["speedup_prefix_plus_spec_vs_cold"] = round(
+            both_stats["tokens_per_s"] / cold_tps, 2)
+        out["prefill_chunks_saved_pct"] = round(
+            100.0 * (1.0 - warm_stats["prefill_chunks"]
+                     / max(1, cold_stats["prefill_chunks"])), 1)
+
+        # exactness rides the bench: a prefix hit must be invisible in
+        # the tokens (same KV bytes), for every request in the trace;
+        # the combined engine holds the same contract for greedy rows
+        # and the keyed run_solo contract for sampled rows
+        mismatches = sum(
+            0 if np.array_equal(warm_res[r.rid]["tokens"],
+                                cold_res[r.rid]["tokens"]) else 1
+            for r in trace)
+        solo_budget = 4
+        for r in trace:
+            got = np.asarray(both_res[r.rid]["tokens"])
+            if r.greedy:
+                if not np.array_equal(got, cold_res[r.rid]["tokens"]):
+                    mismatches += 1
+            elif solo_budget > 0:
+                ref, _ = both.run_solo(r)
+                if not np.array_equal(got, np.asarray(ref)):
+                    mismatches += 1
+                solo_budget -= 1
+        out["exactness_mismatches"] = mismatches
+        sys.stderr.write(
+            "SERVE_RESULT prefix_speedup %s saved_pct %s mismatches %d\n"
+            % (out["speedup_prefix_vs_cold"],
+               out["prefill_chunks_saved_pct"], mismatches))
     return out
 
 

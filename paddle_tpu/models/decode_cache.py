@@ -10,7 +10,10 @@ __all__ = ["create_kv_caches", "add_cache_zero_fills", "probe_cache_len",
            "make_cache_reorder_program", "validate_cached_call",
            "probe_cache_dtype", "run_chunked_ids", "sample_from_logits",
            "filtered_probs", "sample_rows", "make_slot_reset_program",
-           "fold_in_seed", "sample_rows_keyed", "filtered_probs_rows"]
+           "fold_in_seed", "sample_rows_keyed", "filtered_probs_rows",
+           "make_row_copy_program", "greedy_accept_len", "residual_probs",
+           "spec_key", "spec_propose_keyed", "spec_accept_keyed",
+           "spec_token_keyed"]
 
 
 def create_kv_caches(block, prefix, n_layer, batch, n_head, t_max, dh,
@@ -118,6 +121,50 @@ def make_cache_reorder_program(named_shapes, batch):
             g = layers.gather(cvar, parents)
             blk.append_op("assign", inputs={"X": [g]},
                           outputs={"Out": [cvar]})
+    return prog
+
+
+def make_row_copy_program(named_pairs, n_dst, dtype="float32"):
+    """make_slot_reset_program generalized to CROSS-POOL row copies (the
+    prefix-cache load/store step): for every (src_name, src_shape,
+    dst_name, dst_shape) pair, gather `n_dst` rows of the [R, ...] src
+    persistable by the fed `copy_src_rows` ids and lerp them into the
+    [n_dst, ...] dst persistable under the fed `copy_take` / `copy_keep`
+    [n_dst] row masks (callers pass keep = 1 - take; take=0 rows keep
+    dst bytes untouched).  ONE compiled program covers every row
+    assignment — the ids and masks are feeds, so admission churn and
+    prefix registration never retrace.  Pair entries may append a
+    per-pair dtype overriding `dtype` (bf16 caches copy in bf16: the
+    f32 masks promote, the cast restores)."""
+    import paddle_tpu as fluid
+
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        rows = layers.data("copy_src_rows", shape=[n_dst], dtype="int64",
+                           append_batch_size=False)
+        take = layers.data("copy_take", shape=[n_dst], dtype="float32",
+                           append_batch_size=False)
+        keep = layers.data("copy_keep", shape=[n_dst], dtype="float32",
+                           append_batch_size=False)
+        blk = prog.global_block()
+        for entry in named_pairs:
+            src_name, src_shape, dst_name, dst_shape = entry[:4]
+            vdtype = entry[4] if len(entry) > 4 else dtype
+            assert int(dst_shape[0]) == n_dst, (dst_name, dst_shape, n_dst)
+            assert list(src_shape[1:]) == list(dst_shape[1:]), (
+                src_name, src_shape, dst_name, dst_shape)
+            src = blk.create_var(name=src_name, shape=list(src_shape),
+                                 dtype=vdtype, persistable=True)
+            dst = blk.create_var(name=dst_name, shape=list(dst_shape),
+                                 dtype=vdtype, persistable=True)
+            g = layers.gather(src, rows)
+            mixed = layers.elementwise_add(
+                layers.elementwise_mul(g, take, axis=0),
+                layers.elementwise_mul(dst, keep, axis=0))
+            if str(vdtype) != "float32":
+                mixed = layers.cast(mixed, str(vdtype))
+            blk.append_op("assign", inputs={"X": [mixed]},
+                          outputs={"Out": [dst]})
     return prog
 
 
@@ -291,3 +338,86 @@ def filtered_probs_rows(logits, temperatures, top_ks, top_ps):
         sub /= sub.sum(-1, keepdims=True)
         probs[pr] = sub
     return probs
+
+
+# ---------------------------------------------------------------------------
+# speculative-decoding resolver primitives (shared by solo and pooled)
+# ---------------------------------------------------------------------------
+# gpt2's solo speculative loops and the serving engine's in-pool rounds
+# resolve draft-vs-target with the SAME math, hoisted here.  The greedy
+# rule (longest draft==argmax prefix) and the residual distribution are
+# direct refactors of the former inline closures — bit-identical.
+#
+# The KEYED accept rule is the pooled twin of solo rejection sampling:
+# every sub-draw (draft proposal, accept uniform, residual pick) is
+# keyed by (request seed, stream tag, GLOBAL token index), so the token
+# emitted at index t is a pure function of (seed, t, token prefix) —
+# independent of round boundaries, batch neighbors, admission order,
+# and failover replay restarts.  The price of that purity: a fully
+# accepted round emits NO free bonus token (the bonus has no draft
+# proposal, so it would leak round structure into the stream).  Greedy
+# keeps its bonus — argmax is already prefix-pure.
+
+_SPEC_TAG_DRAFT = 0x5D01
+_SPEC_TAG_ACCEPT = 0x5D02
+_SPEC_TAG_RESID = 0x5D03
+
+
+def greedy_accept_len(tgt_next, drafts):
+    """Longest prefix j such that every batch row's draft token equals
+    the target argmax at every position < j.  tgt_next [B, K] int64,
+    drafts: list of [B] arrays (may be shorter than K)."""
+    j = 0
+    while j < len(drafts) and bool((drafts[j] == tgt_next[:, j]).all()):
+        j += 1
+    return j
+
+
+def residual_probs(pt, pd):
+    """The rejection-sampling residual normalize(max(pt - pd, 0)) per
+    row ([..., V] in, same shape out); degenerate rows (pt <= pd
+    everywhere, residual mass ~0) fall back to pt."""
+    resid = np.maximum(np.asarray(pt, np.float64)
+                       - np.asarray(pd, np.float64), 0.0)
+    rs = resid.sum(-1, keepdims=True)
+    return np.where(rs > 1e-12, resid / np.maximum(rs, 1e-12), pt)
+
+
+def spec_key(seed, tag, step):
+    """Key for ONE speculative sub-draw at global token index `step`:
+    a distinct fold_in_seed stream per tag, so the three draws at one
+    index are independent of each other and none collides with the
+    plain sampler's fold_in_seed(seed, step) stream."""
+    return fold_in_seed(fold_in_seed(seed, tag), step)
+
+
+def spec_propose_keyed(pd_row, seed, step):
+    """The draft proposal at global token index `step`: one categorical
+    draw from the filtered draft row, keyed — re-derivable anywhere."""
+    rng = np.random.RandomState(spec_key(seed, _SPEC_TAG_DRAFT, step))
+    return int(rng.choice(pd_row.shape[-1], p=pd_row))
+
+
+def spec_accept_keyed(d, pt_row, pd_row, seed, step):
+    """Resolve proposal `d` at global token index `step` against the
+    filtered target row: accept with probability min(1, pt[d]/pd[d]),
+    else draw the residual.  Returns (token, accepted).  Output
+    distribution is exactly the target row (standard per-token
+    rejection sampling)."""
+    u = np.random.RandomState(
+        spec_key(seed, _SPEC_TAG_ACCEPT, step)).rand()
+    ratio = float(pt_row[d]) / max(float(pd_row[d]), 1e-12)
+    if u <= ratio:
+        return int(d), True
+    resid = residual_probs(pt_row[None, :], pd_row[None, :])[0]
+    rng = np.random.RandomState(spec_key(seed, _SPEC_TAG_RESID, step))
+    return int(rng.choice(resid.shape[-1], p=resid)), False
+
+
+def spec_token_keyed(pt_row, pd_row, seed, step):
+    """Propose + resolve in one call — the per-index token rule used
+    wherever a round structure is NOT available (first token after
+    prefill, capacity-tail width-1 steps).  Identical composition to a
+    round's propose-then-accept, so streams never fork on path."""
+    d = spec_propose_keyed(pd_row, seed, step)
+    return spec_accept_keyed(d, pt_row, pd_row, seed, step)

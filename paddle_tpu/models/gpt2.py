@@ -340,7 +340,7 @@ def gpt2_decode_step_program(hp=GPT2Config, batch=1, t_max=None, width=1,
 
 
 def gpt2_ragged_step_program(hp=GPT2Config, batch=4, t_max=None, width=8,
-                             cache_dtype="float32"):
+                             cache_dtype="float32", cache_prefix="gpt2"):
     """The continuous-batching serving step (serving/engine.py's ONE
     compiled program): width-W decode over a POOL of `batch` slots where
     every slot sits at its own position.
@@ -361,7 +361,10 @@ def gpt2_ragged_step_program(hp=GPT2Config, batch=4, t_max=None, width=8,
         fetch:  logits [B, W, vocab] — row b column i predicts position
                 pos_rows[b] + i + 1 for that slot's request
         state:  the SAME per-layer gpt2_{k,v}cache_* persistables as
-                gpt2_decode_step_program (shared scope, shared names)
+                gpt2_decode_step_program (shared scope, shared names);
+                `cache_prefix` renames them — a DRAFT model's step
+                program sharing the target's scope (self-draft
+                speculation) must keep its own KV pool
 
     Cache writes go through slot_cache_write (per-row position + width,
     out-of-width columns dropped) and attention masks per-row offset-
@@ -412,7 +415,7 @@ def gpt2_ragged_step_program(hp=GPT2Config, batch=4, t_max=None, width=8,
         blk = main.global_block()
         n_kv = getattr(hp, "n_kv_head", None) or hp.n_head
         kv_caches, cache_names = create_kv_caches(
-            blk, "gpt2", hp.n_layer, batch, n_kv, t_max, dh,
+            blk, cache_prefix, hp.n_layer, batch, n_kv, t_max, dh,
             dtype=cache_dtype)
         add_cache_zero_fills(
             cache_startup,
@@ -619,14 +622,14 @@ def speculative_generate_cached(
         return np.asarray(logits).argmax(-1).astype("int64"), None
 
     def resolve(wl, drafts, aux):
+        # the shared resolver rule (decode_cache.greedy_accept_len) —
+        # the serving engine's in-pool rounds resolve with the same one
+        from .decode_cache import greedy_accept_len
+
         tgt_next = wl.argmax(-1).astype("int64")  # [B, spec_k]
-        j, acc = 0, []
-        while j < len(drafts) and bool(
-                (drafts[j] == tgt_next[:, j]).all()):
-            acc.append(drafts[j])
-            j += 1
+        j = greedy_accept_len(tgt_next, drafts)
         # bonus (all accepted) or correction (first mismatch)
-        return acc, tgt_next[:, j], j
+        return list(drafts[:j]), tgt_next[:, j], j
 
     return _speculative_core(
         exe, tgt_step_main, tgt_cache_startup, tgt_step_fetch,
@@ -652,7 +655,7 @@ def speculative_sample_generate_cached(
     regardless of other rows); at the stop index accepted rows keep
     their draft token and rejected rows draw the residual.  Returns
     (tokens [B, P+new], accept_stats dict)."""
-    from .decode_cache import filtered_probs, sample_rows
+    from .decode_cache import filtered_probs, residual_probs, sample_rows
 
     rng = np.random.RandomState(seed)
     b = np.asarray(prompt_ids).shape[0]
@@ -682,13 +685,11 @@ def speculative_sample_generate_cached(
                 acc.append(d)
                 j += 1
                 continue
-            # stop: rejected rows draw from normalize(max(pt - pd, 0));
-            # accepted rows keep d (a valid draw regardless of others)
-            resid = np.maximum(pt - pd, 0.0)
-            rs = resid.sum(-1, keepdims=True)
-            # pt == pd exactly -> empty residual; fall back to pt
-            resid = np.where(rs > 1e-12, resid / np.maximum(rs, 1e-12), pt)
-            repl = sample_rows(resid, rng)
+            # stop: rejected rows draw the shared residual rule
+            # (decode_cache.residual_probs — the serving engine's keyed
+            # resolver computes the same distribution); accepted rows
+            # keep d (a valid draw regardless of others)
+            repl = sample_rows(residual_probs(pt, pd), rng)
             return acc, np.where(reject, repl, d).astype("int64"), j
         # every draft accepted: bonus from the target's last row
         return acc, sample_rows(probs(wl[:, len(drafts)]), rng), j

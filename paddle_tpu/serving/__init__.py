@@ -1,23 +1,31 @@
-"""Continuous-batching LLM serving (docs/SERVING.md §5-§7).
+"""Continuous-batching LLM serving (docs/SERVING.md §5-§8).
 
 The production serving front-end over the decode-cache stack: a
 request scheduler (engine.ServingEngine) drives ONE compiled ragged
 wide-step program over a slot-based KV-cache pool — admission,
 interleaved prefill/decode, per-request sampling params, immediate
 eviction — with every request's token stream bit-identical to its
-solo run.  router.FabricRouter is the multi-pool front door: sticky
-placement over N engine pools, fabric-wide backpressure, drain-and-
-retire, and prefix-replay failover that extends the exactness
-contract across pool death.  trace.make_poisson_trace generates the
-seeded open-loop bench/test workloads.
+solo run.  The decode/prefill fast path rides inside the same loop:
+in-pool speculative decoding (a draft model's ragged step over the
+same slot layout, one widened target dispatch verifying anchor+drafts
+per slot) and prefix-cache KV reuse (prefix.PrefixCache — registered
+common prompt prefixes copied row-wise into admitted slots so prefill
+starts at the match boundary).  router.FabricRouter is the multi-pool
+front door: sticky placement over N engine pools, fabric-wide
+backpressure, drain-and-retire, and prefix-replay failover that
+extends the exactness contract across pool death.
+trace.make_poisson_trace / make_prefix_trace generate the seeded
+open-loop bench/test workloads.
 """
 
 from .engine import ServingEngine, serve_one_at_a_time
 from .pool import SlotPool
 from .pool_worker import spawn_pool_worker
+from .prefix import PrefixCache
 from .router import FabricRouter, ProcessPool, parse_pool_schedule
-from .trace import Request, make_poisson_trace
+from .trace import Request, make_poisson_trace, make_prefix_trace
 
 __all__ = ["ServingEngine", "serve_one_at_a_time", "SlotPool",
            "FabricRouter", "ProcessPool", "parse_pool_schedule",
-           "spawn_pool_worker", "Request", "make_poisson_trace"]
+           "spawn_pool_worker", "Request", "make_poisson_trace",
+           "make_prefix_trace", "PrefixCache"]

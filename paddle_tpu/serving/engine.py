@@ -36,8 +36,15 @@ import time
 import numpy as np
 
 from ..profiler import RecordEvent
+from .pool import DECODE, PREFILL
 
 __all__ = ["ServingEngine", "serve_one_at_a_time"]
+
+
+def _accept_rate(accepted, proposed):
+    """Acceptance rate with the solo core's convention: no proposals
+    (spec off / pure-prefill request) reads 1.0."""
+    return (accepted / proposed) if proposed else 1.0
 
 
 class ServingEngine:
@@ -49,7 +56,8 @@ class ServingEngine:
     def __init__(self, exe, hp, n_slots=4, width=8, t_max=None,
                  cache_dtype="float32", quantize_int8=False,
                  queue_depth=None, mesh=None, partition_rules=None,
-                 mp_axis=None):
+                 mp_axis=None, draft=None, spec_k=None, prefix_rows=0,
+                 prefix_chunk=None):
         from ..models import gpt2
         from ..models.decode_cache import make_slot_reset_program
         from .pool import SlotPool
@@ -78,9 +86,74 @@ class ServingEngine:
             [(n, (self.n_slots, n_kv, self.t_max, dh)) for n in
              self.cache_names],
             self.n_slots, dtype=cache_dtype)
+        # ---- in-pool speculative decoding ----------------------------
+        # draft = "self" hosts the TARGET model's own ragged step over a
+        # SECOND KV pool in the same scope (cache_prefix renames the
+        # persistables) — spec_k-token verify chunks with zero extra
+        # weights; draft = (draft_hp, draft_scope) hosts a SMALL draft
+        # model (its own weights + caches in its own fluid.Scope) over
+        # the SAME slot layout.  Either way the draft program's feed
+        # contract is the target's, so the engine's pooled feed drives
+        # both and the slot lifecycle (admit/reset/evict) covers the
+        # draft pool for free.
+        self.draft_hp = None
+        self.draft_scope = None
+        self.spec_k = 0
+        if draft is not None:
+            if isinstance(draft, str):
+                assert draft == "self", draft
+                self.draft_hp, self.draft_scope = hp, None
+                dprefix = "gpt2sd"
+            else:
+                self.draft_hp, self.draft_scope = draft
+                dprefix = "gpt2"
+            self.spec_k = int(spec_k or min(4, self.width))
+            assert 2 <= self.spec_k <= self.width, (
+                "spec_k must be in [2, width]", self.spec_k, self.width)
+            assert self.draft_hp.n_ctx >= self.t_max, (
+                self.draft_hp.n_ctx, self.t_max)
+            (self.draft_main, self.draft_startup, _df, self.draft_fetch,
+             self.draft_cache_names) = gpt2.gpt2_ragged_step_program(
+                self.draft_hp, batch=self.n_slots, t_max=self.t_max,
+                width=self.width, cache_dtype=cache_dtype,
+                cache_prefix=dprefix)
+            dn_kv = (getattr(self.draft_hp, "n_kv_head", None)
+                     or self.draft_hp.n_head)
+            ddh = self.draft_hp.d_model // self.draft_hp.n_head
+            self._draft_slot_shape = (self.n_slots, dn_kv, self.t_max, ddh)
+            self.draft_reset = make_slot_reset_program(
+                [(n, self._draft_slot_shape)
+                 for n in self.draft_cache_names],
+                self.n_slots, dtype=cache_dtype)
+        # ---- prefix-cache KV reuse -----------------------------------
+        # A row pool of registered common prompt prefixes; admission
+        # longest-matches on token ids and a compiled row-copy program
+        # moves the matched KV into the slot so prefill starts AT the
+        # boundary.  chunk must be a multiple of the dispatch width so
+        # a resumed prefill replays the cold chunk schedule bit-exactly.
+        # A speculative engine mirrors every prefix row in a DRAFT bank:
+        # the draft distribution must resume exactly too, or sampled
+        # accept/reject draws would fork prefix-hit streams from cold.
+        self.prefix = None
+        self.prefix_chunk = 0
+        if prefix_rows:
+            from .prefix import PrefixCache
+
+            chunk = int(prefix_chunk or self.width)
+            assert chunk % self.width == 0, (chunk, self.width)
+            self.prefix = PrefixCache(int(prefix_rows), chunk)
+            self.prefix_chunk = chunk
+            self.prefix.add_bank(
+                self.cache_names, (self.n_slots, n_kv, self.t_max, dh),
+                cache_dtype, tag="target")
+            if self.spec_k:
+                self.prefix.add_bank(
+                    self.draft_cache_names, self._draft_slot_shape,
+                    cache_dtype, tag="draft", scope=self.draft_scope)
         # tensor-parallel pool (GSPMD over `mesh`): stamp EVERY program
         # touching the slot-pool persistables — step, per-slot reset,
-        # cache startup — with the partition-rule table, so the pool
+        # cache startup, the draft pool's trio, and the prefix-cache
+        # copy programs — with the partition-rule table, so the pool
         # lives sharded in HBM end to end (a single unstamped program
         # would pull the sharded caches back onto one device).  The
         # rule table resolves from the model config's partition_family
@@ -100,9 +173,20 @@ class ServingEngine:
                 partition_rules = partition_rules_for(
                     getattr(hp, "partition_family", "gpt2"), mp_axis=axis)
             self.partition_rules = partition_rules
-            for prog in (self.step_main, self.cache_startup,
-                         self.reset_prog):
+            progs = [self.step_main, self.cache_startup, self.reset_prog]
+            if self.spec_k:
+                progs += [self.draft_main, self.draft_startup,
+                          self.draft_reset]
+            if self.prefix is not None:
+                for bank in self.prefix.banks:
+                    progs += [bank.load_prog, bank.store_prog,
+                              bank.startup]
+            for prog in progs:
                 annotate_spmd(prog, mesh, partition_rules)
+        if self.prefix is not None:
+            # zero-fill the prefix pools ONCE — registered rows persist
+            # across serving episodes (run() only re-zeroes slot pools)
+            self.prefix.startup(self.exe)
         self.pool = SlotPool(self.n_slots, self.width, self.t_max)
         self.queue = []  # submitted, not yet admitted (arrival order)
         # admission control: an ARRIVAL that finds `queue_depth`
@@ -116,7 +200,11 @@ class ServingEngine:
         self.counters = {"steps": 0, "admitted": 0, "finished": 0,
                          "new_tokens": 0, "occupancy_sum": 0.0,
                          "prefill_steps": 0, "decode_steps": 0,
-                         "rejected": 0, "expired": 0}
+                         "rejected": 0, "expired": 0,
+                         "prefill_chunks": 0, "draft_steps": 0,
+                         "spec_rounds": 0, "spec_proposed": 0,
+                         "spec_accepted": 0, "prefix_hits": 0,
+                         "prefix_misses": 0, "prefix_tokens_reused": 0}
         self._step_wall = []
         self._results = {}
 
@@ -158,6 +246,12 @@ class ServingEngine:
             "latency_steps": self.now - req.arrival_step + 1,
             "latency_s": wall - (self._step_wall[a] if self._step_wall
                                  else wall),
+            "prefix_len": getattr(slot_state, "prefix_len", 0),
+            "spec_proposed": getattr(slot_state, "spec_proposed", 0),
+            "spec_accepted": getattr(slot_state, "spec_accepted", 0),
+            "accept_rate": _accept_rate(
+                getattr(slot_state, "spec_accepted", 0),
+                getattr(slot_state, "spec_proposed", 0)),
         }
 
     def step(self):
@@ -179,6 +273,7 @@ class ServingEngine:
                     terminal.append(s.req.rid)
             keep = np.ones(self.n_slots, "float32")
             admitted = False
+            loads = {}  # slot -> prefix row (this wave's prefix hits)
             waiting = 0
             still = []
             for req in self.queue:  # arrival order (submit keeps it)
@@ -189,10 +284,22 @@ class ServingEngine:
                     self._terminal(req, "DEADLINE_EXPIRED")
                     terminal.append(req.rid)
                 elif self.pool.free_slots():
-                    slot = self.pool.admit(req, self.now)
+                    pfx_row, pfx_len = (None, 0)
+                    if self.prefix is not None:
+                        pfx_row, pfx_len = self.prefix.match(req.prompt)
+                    slot = self.pool.admit(req, self.now,
+                                           prefix_len=pfx_len)
                     keep[slot] = 0.0
                     admitted = True
                     self.counters["admitted"] += 1
+                    if pfx_row is not None:
+                        loads[slot] = pfx_row
+                        self.prefix.touch(pfx_row, pfx_len)
+                        self.counters["prefix_hits"] += 1
+                        self.counters["prefix_tokens_reused"] += pfx_len
+                    elif self.prefix is not None:
+                        self.prefix.miss()
+                        self.counters["prefix_misses"] += 1
                 elif (self.queue_depth is None
                       or waiting < self.queue_depth):
                     waiting += 1
@@ -205,17 +312,41 @@ class ServingEngine:
             self.queue = still
             if admitted:
                 # zero exactly the admitted slots' cache rows; one
-                # compiled program regardless of WHICH slots reset
+                # compiled program regardless of WHICH slots reset —
+                # the draft pool's rows reset in lockstep (same mask)
                 self.exe.run(self.reset_prog, feed={"slot_keep": keep},
                              fetch_list=[])
+                if self.spec_k:
+                    self.exe.run(self.draft_reset,
+                                 feed={"slot_keep": keep}, fetch_list=[],
+                                 scope=self.draft_scope)
+                if loads:
+                    # prefix hits: copy the matched KV rows into the
+                    # freshly reset slots (target + draft banks), so
+                    # build_feed starts prefill AT the match boundary
+                    self.prefix.load(self.exe, loads)
         active = self.pool.active_slots()
         if not active:
             self.now += 1
             return terminal
         feed, plan = self.pool.build_feed(self.hp.n_ctx)
+        self.counters["prefill_chunks"] += sum(
+            1 for _, s in active if s.state == PREFILL)
         prefilling = self.pool.any_prefilling()
         phase = "prefill" if prefilling else "decode"
         self.counters[phase + "_steps"] += 1
+        # speculative round: draft k_s tokens per decoding slot through
+        # the draft pool's ragged program (dispatch #1 rides the as-built
+        # feed, so prompt chunks prefill the draft cache in lockstep),
+        # then WIDEN the spec rows of the one target dispatch to
+        # anchor+drafts verify chunks — feed VALUES change, shapes never
+        spec, drafts, daux, draft_due = [], {}, {}, None
+        if self.spec_k:
+            spec, drafts, daux, draft_due = self._draft_round(feed, plan)
+            for slot, k_s in spec:
+                s = self.pool.slots[slot]
+                feed["step_ids"][slot, 1:1 + k_s] = drafts[slot]
+                feed["width_rows"][slot] = 1 + k_s
         with RecordEvent("serve_step", cat=phase):
             (logits,) = self.exe.run(self.step_main, feed=feed,
                                      fetch_list=self.step_fetch)
@@ -227,32 +358,209 @@ class ServingEngine:
             for slot, s in active:
                 if slot not in due:
                     self.pool.advance_prefill(slot)
-            if plan:
-                rows = np.stack([logits[slot, col] for slot, col in plan])
-                toks = self._pick_tokens(rows, [s for s, _ in plan])
-                for (slot, _), tok in zip(plan, toks):
+            spec_set = {slot for slot, _ in spec}
+            plain = [(slot, col) for slot, col in plan
+                     if slot not in spec_set]
+            if plain:
+                rows = np.stack([logits[slot, col] for slot, col in plain])
+                drows = (np.stack([draft_due[slot] for slot, _ in plain])
+                         if draft_due is not None else None)
+                toks = self._pick_tokens(rows, [s for s, _ in plain],
+                                         draft_rows=drows)
+                for (slot, _), tok in zip(plain, toks):
                     s = self.pool.slots[slot]
                     done = self.pool.advance(slot, tok)
                     self.counters["new_tokens"] += 1
                     if done:
                         self._finish(slot)
                         finished.append(s.req.rid)
+            for slot, k_s in spec:
+                s = self.pool.slots[slot]
+                emit, accepted = self._resolve_spec_row(
+                    s, logits[slot], drafts[slot], daux.get(slot), k_s)
+                self.counters["spec_rounds"] += 1
+                self.counters["spec_proposed"] += k_s
+                self.counters["spec_accepted"] += accepted
+                s.spec_proposed += k_s
+                s.spec_accepted += accepted
+                for tok in emit:
+                    done = self.pool.advance(slot, tok)
+                    self.counters["new_tokens"] += 1
+                    if done:
+                        # budget/EOS mid-round: later tokens discarded
+                        # (a solo run would never have emitted them)
+                        self._finish(slot)
+                        finished.append(s.req.rid)
+                        break
         self.counters["steps"] += 1
         self.counters["occupancy_sum"] += len(active) / self.n_slots
         self.now += 1
         return terminal + finished
 
-    def _pick_tokens(self, rows, slots):
+    # ---- speculative round machinery -----------------------------------
+    def _spec_eligible(self, slot, s):
+        """How many tokens the draft may propose for this slot THIS
+        round: spec_k-1, capped by the remaining budget (a round emits
+        at most k_s+1 tokens; never draft past the budget) and by cache
+        capacity (the verify chunk writes anchor+drafts at
+        pos..pos+k_s — the tail falls back to plain one-token decode,
+        the solo core's capacity-tail rule)."""
+        if s.state != DECODE:
+            return 0
+        remaining = s.req.max_new_tokens - len(s.out)
+        return max(0, min(self.spec_k - 1, remaining - 1,
+                          self.t_max - s.pos - 1))
+
+    def _run_draft(self, feed):
+        self.counters["draft_steps"] += 1
+        dfeed = dict(feed)
+        # the draft's position table may be shorter than the target's;
+        # clip the (never-read) out-of-width columns into it
+        dfeed["pos_mat"] = np.minimum(feed["pos_mat"],
+                                      self.draft_hp.n_ctx - 1)
+        (dl,) = self.exe.run(self.draft_main, feed=dfeed,
+                             fetch_list=self.draft_fetch,
+                             scope=self.draft_scope)
+        return np.asarray(dl)
+
+    def _draft_pick(self, s, pd_row, token_index):
+        """One draft proposal: greedy rows argmax; sampled rows draw
+        from the FILTERED draft row with the keyed DRAFT stream at the
+        global token index — re-derivable by the resolver and by any
+        replay (pure function of seed + index + prefix)."""
+        from ..models.decode_cache import spec_propose_keyed
+
+        if s.req.greedy:
+            return int(pd_row.argmax())
+        return spec_propose_keyed(pd_row, s.req.seed, token_index)
+
+    def _filtered_row(self, s, logits_row):
+        from ..models.decode_cache import filtered_probs_rows
+
+        return filtered_probs_rows(
+            np.asarray(logits_row)[None, :], [s.req.temperature],
+            [s.req.top_k], [s.req.top_p])[0]
+
+    def _draft_round(self, feed, plan):
+        """The per-step draft phase.  Dispatch #1 runs the AS-BUILT
+        pooled feed through the draft program — prompt chunks prefill
+        the draft cache in lockstep with the target's, and every
+        decoding slot's anchor keeps the draft cache position-current
+        (free: one dispatch covers all rows).  Spec-eligible slots then
+        draft k_s-1 more tokens one dispatch at a time (dispatch count =
+        max k_s, values-only feeds — zero retraces).  Returns
+        (spec rows [(slot, k_s)], drafts {slot: [token]},
+        daux {slot: [filtered draft rows]} for sampled slots,
+        draft_due {slot: raw draft logits row} for the due plan rows —
+        the unified keyed rule needs the draft distribution even on
+        plain-decode and prefill-finish rows)."""
+        active = self.pool.active_slots()
+        spec = [(slot, k) for slot, s in active
+                for k in (self._spec_eligible(slot, s),) if k >= 1]
+        dl = self._run_draft(feed)
+        draft_due = {slot: dl[slot, col] for slot, col in plan}
+        drafts, daux = {}, {}
+        live = []
+        for slot, k_s in spec:
+            s = self.pool.slots[slot]
+            base = (len(s.out)
+                    + getattr(s.req, "sample_step_base", 0))
+            pd = (None if s.req.greedy
+                  else self._filtered_row(s, dl[slot, 0]))
+            raw = dl[slot, 0] if s.req.greedy else pd
+            tok = self._draft_pick(s, raw, base)
+            drafts[slot] = [tok]
+            daux[slot] = [pd]
+            live.append((slot, k_s, base))
+        max_k = max((k for _, k in spec), default=0)
+        b, w = self.n_slots, self.width
+        for j in range(1, max_k):
+            ids = np.zeros((b, w), "int64")
+            pos_rows = np.zeros(b, "int64")
+            width_rows = np.zeros(b, "int64")
+            rows = [(slot, k_s, base) for slot, k_s, base in live
+                    if k_s > j]
+            if not rows:
+                break
+            for slot, k_s, base in rows:
+                s = self.pool.slots[slot]
+                ids[slot, 0] = drafts[slot][-1]
+                pos_rows[slot] = s.pos + j
+                width_rows[slot] = 1
+            pos_mat = np.clip(
+                pos_rows[:, None] + np.arange(w, dtype="int64")[None, :],
+                0, self.hp.n_ctx - 1)
+            dl = self._run_draft({"step_ids": ids, "pos_rows": pos_rows,
+                                  "width_rows": width_rows,
+                                  "pos_mat": pos_mat})
+            for slot, k_s, base in rows:
+                s = self.pool.slots[slot]
+                pd = (None if s.req.greedy
+                      else self._filtered_row(s, dl[slot, 0]))
+                raw = dl[slot, 0] if s.req.greedy else pd
+                tok = self._draft_pick(s, raw, base + j)
+                drafts[slot].append(tok)
+                daux[slot].append(pd)
+        return spec, drafts, daux, draft_due
+
+    def _resolve_spec_row(self, s, logits_row, d_list, pd_list, k_s):
+        """Resolve one slot's verify chunk: logits_row [W, vocab] from
+        the widened target dispatch, columns 0..k_s scoring
+        anchor+drafts.  Greedy: the SOLO resolver rule
+        (decode_cache.greedy_accept_len) — longest draft==argmax prefix
+        plus the bonus/correction column, bit-identical to the
+        non-speculative argmax chain.  Sampled: per-index keyed
+        rejection sampling (decode_cache.spec_accept_keyed) — accepted
+        tokens ARE the emitted prefix, the first rejection emits the
+        residual draw and stops; NO bonus on full acceptance (a bonus
+        has no draft proposal, so it would make the emitted token at
+        that index depend on round structure and break replay/solo
+        equality).  Rollback is free: pool.advance only moves `pos`
+        over EMITTED tokens — rejected drafts' K/V sit beyond it,
+        masked (<= pos) until overwritten by the next round's writes.
+        Returns (emit list, accepted draft count)."""
+        from ..models.decode_cache import (greedy_accept_len,
+                                           spec_accept_keyed)
+
+        r = s.req
+        if r.greedy:
+            tgt_next = np.asarray(logits_row[:k_s + 1]).argmax(-1)
+            tgt_next = tgt_next.astype("int64")[None, :]
+            j = greedy_accept_len(
+                tgt_next, [np.asarray([d], "int64") for d in d_list])
+            return d_list[:j] + [int(tgt_next[0, j])], j
+        base = len(s.out) + getattr(r, "sample_step_base", 0)
+        emit, accepted = [], 0
+        for jj in range(k_s):
+            pt = self._filtered_row(s, logits_row[jj])
+            tok, ok = spec_accept_keyed(
+                d_list[jj], pt, pd_list[jj], r.seed, base + jj)
+            emit.append(tok)
+            if not ok:
+                break
+            accepted += 1
+        return emit, accepted
+
+    def _pick_tokens(self, rows, slots, draft_rows=None):
         """Per-row token selection with PER-REQUEST params, VECTORIZED
         over the due rows (PR 9's documented "loops per row" limit
         closed): greedy rows argmax in one batched pass, sampled rows
         run ONE batched filtered_probs_rows (itself vectorized, bit-
         identical to the per-row chain) and draw with
         fold_in(seed, request_step) keys — a pure function of
-        (request, step), neighbors invisible."""
+        (request, step), neighbors invisible.
+
+        draft_rows (speculative engines only): the matching raw DRAFT
+        logits rows.  Sampled rows then emit via the per-index keyed
+        propose/accept/residual rule instead of the plain keyed draw —
+        the SAME rule the in-round resolver applies, so a request's
+        token at index t is one pure function of (seed, t, prefix)
+        whether it was emitted by a verify round, the first-token
+        prefill path, or a capacity-tail plain step."""
         from ..models.decode_cache import (
             filtered_probs_rows,
             sample_rows_keyed,
+            spec_token_keyed,
         )
 
         rows = np.asarray(rows)
@@ -269,16 +577,29 @@ class ServingEngine:
                 [s.req.temperature for s in ss],
                 [s.req.top_k for s in ss],
                 [s.req.top_p for s in ss])
-            toks = sample_rows_keyed(
-                probs,
-                [s.req.seed for s in ss],
-                # request_step = GLOBAL token index: a failover-replayed
-                # request (router) carries the dead pool's emitted
-                # prefix inside its prompt and offsets the key base past
-                # it, so the continuation draws the solo run's tokens
-                [len(s.out) + getattr(s.req, "sample_step_base", 0)
-                 for s in ss])
-            out[samp] = toks
+            # request_step = GLOBAL token index: a failover-replayed
+            # request (router) carries the dead pool's emitted
+            # prefix inside its prompt and offsets the key base past
+            # it, so the continuation draws the solo run's tokens
+            steps = [len(s.out) + getattr(s.req, "sample_step_base", 0)
+                     for s in ss]
+            if draft_rows is None:
+                out[samp] = sample_rows_keyed(
+                    probs, [s.req.seed for s in ss], steps)
+            else:
+                pds = filtered_probs_rows(
+                    np.asarray(draft_rows)[samp],
+                    [s.req.temperature for s in ss],
+                    [s.req.top_k for s in ss],
+                    [s.req.top_p for s in ss])
+                for i, s in enumerate(ss):
+                    tok, ok = spec_token_keyed(
+                        probs[i], pds[i], s.req.seed, steps[i])
+                    out[samp[i]] = tok
+                    self.counters["spec_proposed"] += 1
+                    self.counters["spec_accepted"] += int(ok)
+                    s.spec_proposed += 1
+                    s.spec_accepted += int(ok)
         return out
 
     def _finish(self, slot):
@@ -296,6 +617,10 @@ class ServingEngine:
             "latency_steps": self.now - s.req.arrival_step + 1,
             "latency_s": wall - (self._step_wall[a] if self._step_wall
                                  else wall),
+            "prefix_len": s.prefix_len,
+            "spec_proposed": s.spec_proposed,
+            "spec_accepted": s.spec_accepted,
+            "accept_rate": _accept_rate(s.spec_accepted, s.spec_proposed),
         }
 
     # ---- result serialization (out-of-process pools) -------------------
@@ -373,6 +698,110 @@ class ServingEngine:
             "max_device_bytes": max(per_dev.values()) if per_dev else 0,
         }
 
+    # ---- prefix registration -------------------------------------------
+    def register_prefix(self, tokens):
+        """Make the KV of a common prompt prefix resident: prefill slot
+        0 with `tokens` (chunk-floored) through the REAL step program(s)
+        — target and, on a speculative engine, the draft, so both banks
+        hold exactly the bytes a cold prefill would have produced — then
+        copy slot 0's rows into a prefix-pool row via the compiled store
+        program.  The engine must be idle (registration borrows slot 0).
+        Returns the prefix row, or None when tokens are shorter than one
+        chunk.  Re-registering identical tokens dedups to the resident
+        row without re-prefilling."""
+        if self.prefix is None:
+            raise RuntimeError("engine built without prefix_rows")
+        if self.queue or self.pool.active_slots():
+            raise RuntimeError("register_prefix on a busy engine")
+        tokens = np.asarray(tokens, "int64").reshape(-1)
+        ln = (int(tokens.size) // self.prefix_chunk) * self.prefix_chunk
+        if ln < self.prefix_chunk:
+            return None
+        tokens = tokens[:ln]
+        row, fresh = self.prefix.assign(tokens)
+        if not fresh:
+            return row
+        # full cache startups (not the per-slot reset): the engine is
+        # idle, and registration may precede the first run() — the slot
+        # pools must exist in scope before the copy programs touch them
+        self.exe.run(self.cache_startup)
+        if self.spec_k:
+            self.exe.run(self.draft_startup, scope=self.draft_scope)
+        b, w = self.n_slots, self.width
+        for c0 in range(0, ln, w):
+            chunk = tokens[c0:c0 + w]
+            ids = np.zeros((b, w), "int64")
+            ids[0, :chunk.size] = chunk
+            pos_rows = np.zeros(b, "int64")
+            pos_rows[0] = c0
+            width_rows = np.zeros(b, "int64")
+            width_rows[0] = chunk.size
+            pos_mat = np.clip(
+                pos_rows[:, None] + np.arange(w, dtype="int64")[None, :],
+                0, self.hp.n_ctx - 1)
+            feed = {"step_ids": ids, "pos_rows": pos_rows,
+                    "width_rows": width_rows, "pos_mat": pos_mat}
+            # fetch the (discarded) logits so the dispatch reuses the
+            # serving executable — a fetch-less variant would compile a
+            # second one and break the pinned compile count
+            self.exe.run(self.step_main, feed=feed,
+                         fetch_list=self.step_fetch)
+            if self.spec_k:
+                self._run_draft(feed)
+        self.prefix.store(self.exe, row, slot=0)
+        return row
+
+    def observe_prefixes(self, requests, min_count=2):
+        """The "observed" registration path: find chunk-floored prompt
+        prefixes SHARED by >= min_count of `requests` (grouped by first
+        chunk, longest common prefix per group) and register them.
+        Host-side analysis + register_prefix — run while idle, e.g.
+        between serving episodes on a recent trace sample.  Returns the
+        registered rows."""
+        if self.prefix is None:
+            raise RuntimeError("engine built without prefix_rows")
+        chunk = self.prefix_chunk
+        groups = {}
+        for r in requests:
+            p = np.asarray(r.prompt, "int64").reshape(-1)
+            if p.size - 1 < chunk:
+                continue
+            groups.setdefault(tuple(p[:chunk].tolist()), []).append(p)
+        rows = []
+        for ps in groups.values():
+            if len(ps) < min_count:
+                continue
+            base = ps[0]
+            lcp = min(int(q.size) for q in ps)
+            for q in ps[1:]:
+                n = min(lcp, int(base.size), int(q.size))
+                eq = base[:n] == q[:n]
+                lcp = n if eq.all() else int(np.argmax(~eq))
+            ln = (lcp // chunk) * chunk
+            if ln >= chunk:
+                row = self.register_prefix(base[:ln])
+                if row is not None:
+                    rows.append(row)
+        return rows
+
+    # ---- control-plane snapshot ----------------------------------------
+    def stats(self):
+        """Counters snapshot + derived rates — the shape the fabric's
+        `stats` control verb and `launch.py --supervise` surface
+        per pool (acceptance rate and prefix-hit counters included)."""
+        c = dict(self.counters)
+        c["compile_count"] = int(self.exe.compile_count)
+        c["accept_rate"] = _accept_rate(c["spec_accepted"],
+                                        c["spec_proposed"])
+        c["spec_on"] = bool(self.spec_k)
+        c["spec_k"] = int(self.spec_k)
+        if self.prefix is not None:
+            c["prefix_hit_rate"] = (
+                c["prefix_hits"]
+                / max(1, c["prefix_hits"] + c["prefix_misses"]))
+            c.update(self.prefix.counters())
+        return c
+
     # ---- episode drivers ----------------------------------------------
     def run(self, requests=None, max_steps=100000):
         """Serve `requests` (plus anything already queued) to
@@ -389,6 +818,8 @@ class ServingEngine:
         for r in requests or []:
             self.submit(r)
         self.exe.run(self.cache_startup)
+        if self.spec_k:
+            self.exe.run(self.draft_startup, scope=self.draft_scope)
         t0 = time.time()
         while self.queue or self.pool.active_slots():
             self._step_wall.append(time.time())
@@ -415,6 +846,12 @@ class ServingEngine:
             "occupancy_pct": round(100.0 * c.pop("occupancy_sum") / steps, 1),
             "step_s_mean": wall / steps,
             "compile_count": self.exe.compile_count,
+            "accept_rate": _accept_rate(c["spec_accepted"],
+                                        c["spec_proposed"]),
+            "prefix_hit_rate": (c["prefix_hits"]
+                                / max(1, c["prefix_hits"]
+                                      + c["prefix_misses"])
+                                if self.prefix is not None else 0.0),
         }
         stats.update(c)
         return self._results, stats

@@ -21,16 +21,23 @@ DECODE = "decode"
 
 class _Slot:
     __slots__ = ("req", "state", "prefill_pos", "pos", "last_token",
-                 "out", "admit_step")
+                 "out", "admit_step", "prefix_len", "spec_proposed",
+                 "spec_accepted")
 
-    def __init__(self, req, admit_step):
+    def __init__(self, req, admit_step, prefix_len=0):
         self.req = req
         self.state = PREFILL
-        self.prefill_pos = 0     # next prompt chunk starts here
-        self.pos = 0             # tokens currently resident in the cache
+        # a prefix-cache hit starts prefill AT the match boundary: the
+        # first prefix_len cache rows were copied in, not dispatched
+        self.prefill_pos = int(prefix_len)  # next prompt chunk starts here
+        self.pos = int(prefix_len)  # tokens currently resident in the cache
         self.last_token = None   # decode input for the next step
         self.out = []            # generated tokens (int)
         self.admit_step = admit_step
+        self.prefix_len = int(prefix_len)
+        # per-request speculative-decoding acceptance accounting
+        self.spec_proposed = 0
+        self.spec_accepted = 0
 
 
 class SlotPool:
@@ -67,15 +74,24 @@ class SlotPool:
                 % (req.rid, req.prompt.size, req.max_new_tokens,
                    self.t_max))
 
-    def admit(self, req, admit_step):
+    def admit(self, req, admit_step, prefix_len=0):
         """Place `req` in a free slot; returns the slot index (caller
-        zero-resets that slot's cache rows before the next dispatch)."""
+        zero-resets that slot's cache rows before the next dispatch).
+        prefix_len > 0 (a prefix-cache hit): the caller copies the
+        matched KV rows in AFTER the reset, and prefill resumes at that
+        boundary — it must be a multiple of the pool width and leave at
+        least one prompt token to dispatch (the finishing chunk's logits
+        emit the first token)."""
         free = self.free_slots()
         if not free:
             raise RuntimeError("admit with no free slot")
         self.validate(req)
+        if prefix_len:
+            assert prefix_len % self.width == 0, (prefix_len, self.width)
+            assert 0 < prefix_len < req.prompt.size, (
+                prefix_len, req.prompt.size)
         slot = free[0]
-        self.slots[slot] = _Slot(req, admit_step)
+        self.slots[slot] = _Slot(req, admit_step, prefix_len=prefix_len)
         return slot
 
     def evict(self, slot):

@@ -25,7 +25,13 @@ process boundary.  Verbs:
                     stopped placing; this makes the worker refuse, too).
   stats()           engine geometry + counters + compile_count — the
                     supervisor's scaling signals and the router's
-                    attach-time hello.
+                    attach-time hello (now carrying spec_k /
+                    prefix_rows / prefix_chunk plus the acceptance and
+                    prefix-reuse counters).
+  register_prefix(tokens)
+                    prefill `tokens` into the engine's prefix cache
+                    (the fabric-wide router.register_prefix, one
+                    pool's leg); row=None when the pool has none.
   shutdown()        conclude the serve loop (drain-and-retire's clean
                     exit; SIGKILL is the chaos path, not the API).
 
@@ -81,6 +87,8 @@ class PoolWorkerService:
             return self._payload()
         if verb == "stats":
             return self._stats()
+        if verb == "register_prefix":
+            return self._h_register_prefix(kw["tokens"])
         if verb == "shutdown":
             self.done.set()
             return {"ok": True}
@@ -116,6 +124,19 @@ class PoolWorkerService:
             self._unacked[r["rid"]] = r
         return self._payload()
 
+    def _h_register_prefix(self, tokens):
+        """Prefill `tokens` into the engine's prefix cache (the router's
+        fabric-wide register_prefix, one pool's leg).  A worker built
+        without a prefix cache answers row=None — the fabric may be
+        mixed and the router degrades that pool to cold prefill."""
+        from ..core.scope import scope_guard
+
+        if self.engine.prefix is None:
+            return {"ok": True, "row": None}
+        with scope_guard(self.scope):
+            row = self.engine.register_prefix(tokens)
+        return {"ok": True, "row": None if row is None else int(row)}
+
     def _ack(self, rids):
         for rid in rids or []:
             self._unacked.pop(rid, None)
@@ -140,6 +161,14 @@ class PoolWorkerService:
             "compile_count": int(eng.exe.compile_count),
             "occupancy_sum": float(eng.counters["occupancy_sum"]),
             "steps": int(eng.counters["steps"]),
+            # the fast-path counters the router mirrors into its stats
+            # verb (speculative acceptance + prefix reuse per pool)
+            "spec_proposed": int(eng.counters.get("spec_proposed", 0)),
+            "spec_accepted": int(eng.counters.get("spec_accepted", 0)),
+            "prefix_hits": int(eng.counters.get("prefix_hits", 0)),
+            "prefix_misses": int(eng.counters.get("prefix_misses", 0)),
+            "prefix_tokens_reused": int(
+                eng.counters.get("prefix_tokens_reused", 0)),
         }
 
     def _stats(self):
@@ -150,6 +179,12 @@ class PoolWorkerService:
             "n_slots": int(eng.n_slots),
             "width": int(eng.width),
             "t_max": int(eng.t_max),
+            # fast-path geometry for the router's attach-time hello:
+            # prefix_chunk drives the router-side match estimate in
+            # prefix-aware placement; 0 = the knob is off on this pool
+            "spec_k": int(eng.spec_k),
+            "prefix_rows": int(eng.prefix.rows if eng.prefix else 0),
+            "prefix_chunk": int(eng.prefix_chunk),
         })
         s.update({k: (float(v) if isinstance(v, float) else int(v))
                   for k, v in eng.counters.items()})
@@ -160,12 +195,17 @@ class PoolWorkerService:
 # process entrypoint + spawn helper
 # ---------------------------------------------------------------------------
 def _build_engine(hp_overrides, n_slots, width, t_max, seed,
-                  queue_depth=None):
+                  queue_depth=None, spec_k=0, prefix_rows=0,
+                  prefix_chunk=None):
     """Tiny-to-real GPT2 engine in a fresh scope with a FIXED startup
     seed: every pool worker in one fabric must hold IDENTICAL weights
     (the failover-replay precondition), and the in-process solo
     reference in the tests rebuilds the same weights from the same
-    (config, seed) pair."""
+    (config, seed) pair.  spec_k > 0 arms SELF-draft speculation (the
+    draft shares the target's weights, so every worker's draft is
+    identical by the same precondition — a separate draft checkpoint
+    would need its own seed/config shipped here); prefix_rows > 0 arms
+    the prefix KV cache."""
     import paddle_tpu as fluid
     from ..models import gpt2
     from .engine import ServingEngine
@@ -181,8 +221,14 @@ def _build_engine(hp_overrides, n_slots, width, t_max, seed,
         exe.run(lm_startup)
         eng = ServingEngine(exe, hp, n_slots=int(n_slots),
                             width=int(width), t_max=int(t_max),
-                            queue_depth=queue_depth)
+                            queue_depth=queue_depth,
+                            draft="self" if int(spec_k) else None,
+                            spec_k=int(spec_k) or None,
+                            prefix_rows=int(prefix_rows),
+                            prefix_chunk=prefix_chunk)
         exe.run(eng.cache_startup)
+        if eng.spec_k:
+            exe.run(eng.draft_startup, scope=eng.draft_scope)
     return eng, scope
 
 
@@ -203,6 +249,16 @@ def main(argv=None):
     p.add_argument("--queue-depth", type=int, default=-1,
                    help="engine wait-queue bound (-1 = unbounded; the "
                         "router's fabric-wide depth is the real gate)")
+    p.add_argument("--spec-k", type=int, default=0,
+                   help="speculative chunk width (0 = off; >0 arms "
+                        "SELF-draft speculation — identical across "
+                        "workers because the draft shares the target "
+                        "weights)")
+    p.add_argument("--prefix-rows", type=int, default=0,
+                   help="prefix KV cache rows (0 = off)")
+    p.add_argument("--prefix-chunk", type=int, default=-1,
+                   help="prefix match granularity, a multiple of "
+                        "--width (-1 = the engine default, == width)")
     args = p.parse_args(argv)
 
     from ..distributed.rpc import make_var_server
@@ -210,7 +266,9 @@ def main(argv=None):
     eng, scope = _build_engine(
         json.loads(args.hp), args.n_slots, args.width, args.t_max,
         args.seed,
-        queue_depth=None if args.queue_depth < 0 else args.queue_depth)
+        queue_depth=None if args.queue_depth < 0 else args.queue_depth,
+        spec_k=args.spec_k, prefix_rows=args.prefix_rows,
+        prefix_chunk=None if args.prefix_chunk < 0 else args.prefix_chunk)
     service = PoolWorkerService(eng, scope)
     srv = make_var_server(args.endpoint, service)
     srv.start()
@@ -231,8 +289,8 @@ def main(argv=None):
 
 
 def spawn_pool_worker(hp_overrides=None, n_slots=2, width=4, t_max=24,
-                      seed=7, queue_depth=None, timeout_s=120.0,
-                      env=None):
+                      seed=7, queue_depth=None, spec_k=0, prefix_rows=0,
+                      prefix_chunk=None, timeout_s=120.0, env=None):
     """Spawn one worker subprocess and wait for its READY line.
     Returns (endpoint, proc) — the shape FabricRouter's process-mode
     pool_factory wants.  Stdout after READY drains on a daemon thread
@@ -246,6 +304,12 @@ def spawn_pool_worker(hp_overrides=None, n_slots=2, width=4, t_max=24,
            "--t-max", str(int(t_max)), "--seed", str(int(seed))]
     if queue_depth is not None:
         cmd += ["--queue-depth", str(int(queue_depth))]
+    if spec_k:
+        cmd += ["--spec-k", str(int(spec_k))]
+    if prefix_rows:
+        cmd += ["--prefix-rows", str(int(prefix_rows))]
+    if prefix_chunk is not None:
+        cmd += ["--prefix-chunk", str(int(prefix_chunk))]
     child_env = dict(os.environ if env is None else env)
     child_env.setdefault("JAX_PLATFORMS", "cpu")
     proc = subprocess.Popen(

@@ -1,0 +1,241 @@
+"""Prefix-cache KV reuse for the serving engine: a KV ROW POOL of
+registered common prompt prefixes (shared system prompts, few-shot
+templates) plus the compiled copy programs that move whole cache rows
+between the prefix pool and the slot pool.
+
+Design (the ROADMAP item 4 "radix/prefix KV reuse" lever, flattened to
+the common case):
+
+- The pool holds R rows per cache persistable, named ``pfx_<cache>``
+  ([R, n_kv, t_max, dh] — the slot pool's shape with R rows).  The
+  names keep the ``_{k,v}cache_<layer>`` suffix, so the GSPMD partition
+  rule that shards slot caches on the heads axis shards the prefix pool
+  identically — the row copy is then a per-shard copy with no
+  resharding.
+- Matching is HOST-side on token ids: longest common prefix between a
+  request's prompt and each registered row, floored to a multiple of
+  ``chunk`` and capped at prompt_len - 1 (at least one real token must
+  go through prefill to produce the first logits).  The chunk floor is
+  what makes prefix-hit streams BIT-identical to cold streams: the
+  engine prefills in width-W chunks from position 0, so resuming at a
+  multiple of W replays the exact chunk schedule a cold run would have
+  used from that boundary on (same feed values, same writes, same
+  logits bytes).
+- Copying is DEVICE-side through one compiled program per direction
+  (decode_cache.make_row_copy_program, the slot-reset program
+  generalized to gathers): load = prefix rows -> admitted slots' rows,
+  store = a freshly prefilled slot's rows -> a prefix row.  Row ids and
+  masks are feeds, so any assignment reuses the one executable — the
+  zero-retrace serving contract extends to prefix traffic.
+- A speculative engine registers a second BANK over the draft model's
+  caches: with spec + sampling + prefix all on, the draft distribution
+  must also resume bit-exactly, or accept/reject draws fork the stream.
+
+Invalidation: rows are invalidated by re-registration (same tokens
+dedup to the same row; new tokens evict the least-recently-matched row
+when full).  Weights changing invalidates everything — call
+``invalidate()`` (drops the host index; stale KV rows are never matched
+again and get overwritten by later registrations)."""
+
+import numpy as np
+
+__all__ = ["PrefixCache"]
+
+
+class _Bank:
+    """One cache family's copy machinery (target bank, draft bank)."""
+
+    __slots__ = ("load_prog", "store_prog", "startup", "scope", "tag")
+
+    def __init__(self, load_prog, store_prog, startup, scope, tag):
+        self.load_prog = load_prog
+        self.store_prog = store_prog
+        self.startup = startup
+        self.scope = scope
+        self.tag = tag
+
+
+class PrefixCache:
+    """Host index + compiled copy programs for prefix KV reuse.
+
+    rows:  prefix pool capacity (registered prefixes resident at once)
+    chunk: match granularity — MUST be a multiple of the engine's
+           dispatch width W (the engine enforces ==/multiple), so a
+           resumed prefill replays the cold chunk schedule exactly
+    """
+
+    def __init__(self, rows, chunk):
+        self.rows = int(rows)
+        self.chunk = int(chunk)
+        assert self.rows >= 1 and self.chunk >= 1, (rows, chunk)
+        self._tokens = [None] * self.rows  # np int64 arrays (host index)
+        self._tick = 0
+        self._last_used = [-1] * self.rows
+        self._banks = []
+        # lifetime counters (the engine's per-episode counters reset per
+        # run; these survive across runs for the control plane)
+        self.hits = 0
+        self.misses = 0
+        self.tokens_reused = 0
+        self.registrations = 0
+        self.evictions = 0
+
+    # -- bank wiring (engine-side setup) --------------------------------
+
+    def add_bank(self, cache_names, slot_shape, dtype, tag="target",
+                 scope=None):
+        """Build the prefix persistables + load/store/startup programs
+        for one cache family.  cache_names: the slot-pool persistable
+        names ([B, n_kv, t_max, dh] each, shape == slot_shape); the
+        prefix twins are ``pfx_<name>`` with R rows.  `scope`: the
+        fluid.Scope the family lives in (None = ambient scope — the
+        target family and self-draft; a separate-scope draft passes its
+        own).  Returns the bank (also retained internally)."""
+        import paddle_tpu as fluid
+        from ..models.decode_cache import (add_cache_zero_fills,
+                                           make_row_copy_program)
+
+        b = int(slot_shape[0])
+        tail = list(slot_shape[1:])
+        pfx_shape = [self.rows] + tail
+        startup = fluid.Program()
+        add_cache_zero_fills(
+            startup, [("pfx_" + n, pfx_shape) for n in cache_names],
+            dtype=dtype)
+        load_prog = make_row_copy_program(
+            [("pfx_" + n, pfx_shape, n, list(slot_shape))
+             for n in cache_names], b, dtype=dtype)
+        store_prog = make_row_copy_program(
+            [(n, list(slot_shape), "pfx_" + n, pfx_shape)
+             for n in cache_names], self.rows, dtype=dtype)
+        bank = _Bank(load_prog, store_prog, startup, scope, tag)
+        self._banks.append(bank)
+        return bank
+
+    @property
+    def banks(self):
+        return list(self._banks)
+
+    def startup(self, exe):
+        """Zero-fill every bank's prefix pool (run once at engine
+        construction — NOT per engine.run(): registered rows persist
+        across serving episodes)."""
+        for bank in self._banks:
+            exe.run(bank.startup, feed={}, fetch_list=[],
+                    scope=bank.scope)
+
+    # -- host index -----------------------------------------------------
+
+    def match(self, prompt):
+        """Longest-match against the registered rows: returns (row, L)
+        with L a positive multiple of `chunk` (capped at len(prompt)-1),
+        or (None, 0) on a miss.  Ties prefer the lower row id —
+        deterministic, traffic-independent."""
+        prompt = np.asarray(prompt, "int64").reshape(-1)
+        best_row, best_len = None, 0
+        for r, toks in enumerate(self._tokens):
+            if toks is None:
+                continue
+            n = min(int(toks.size), int(prompt.size) - 1)
+            if n < self.chunk:
+                continue
+            eq = prompt[:n] == toks[:n]
+            lcp = n if eq.all() else int(np.argmax(~eq))
+            length = (lcp // self.chunk) * self.chunk
+            if length > best_len:
+                best_row, best_len = r, length
+        if best_len >= self.chunk:
+            return best_row, best_len
+        return None, 0
+
+    def touch(self, row, reused_tokens):
+        """Record a hit on `row` (LRU bump + counters)."""
+        self._tick += 1
+        self._last_used[row] = self._tick
+        self.hits += 1
+        self.tokens_reused += int(reused_tokens)
+
+    def miss(self):
+        self.misses += 1
+
+    def assign(self, tokens):
+        """Pick the row for `tokens` (already chunk-floored): an exact
+        resident match reuses its row (returns (row, False) — KV bytes
+        already present), else a free row, else the LRU row is evicted.
+        Returns (row, fresh)."""
+        tokens = np.asarray(tokens, "int64").reshape(-1)
+        for r, toks in enumerate(self._tokens):
+            if toks is not None and toks.size == tokens.size \
+                    and bool((toks == tokens).all()):
+                self._tick += 1
+                self._last_used[r] = self._tick
+                return r, False
+        for r, toks in enumerate(self._tokens):
+            if toks is None:
+                row = r
+                break
+        else:
+            row = min(range(self.rows), key=lambda r: self._last_used[r])
+            self.evictions += 1
+        self._tokens[row] = tokens.copy()
+        self._tick += 1
+        self._last_used[row] = self._tick
+        self.registrations += 1
+        return row, True
+
+    def invalidate(self):
+        """Drop the host index (e.g. after a weight update): stale KV
+        rows are never matched again."""
+        self._tokens = [None] * self.rows
+        self._last_used = [-1] * self.rows
+
+    def registered(self):
+        """The resident prefixes as {row: token array} (diagnostics)."""
+        return {r: t.copy() for r, t in enumerate(self._tokens)
+                if t is not None}
+
+    # -- device copies --------------------------------------------------
+
+    def load(self, exe, slot_rows):
+        """Copy prefix rows into slot rows: slot_rows = {slot: prefix
+        row} for this admission wave.  One dispatch per bank, any
+        assignment (the ids/masks are feeds)."""
+        if not slot_rows or not self._banks:
+            return
+        b = int(self._banks[0].load_prog.global_block()
+                .vars["copy_take"].shape[0])
+        src = np.zeros(b, "int64")
+        take = np.zeros(b, "float32")
+        for slot, row in slot_rows.items():
+            src[slot] = row
+            take[slot] = 1.0
+        feed = {"copy_src_rows": src, "copy_take": take,
+                "copy_keep": 1.0 - take}
+        for bank in self._banks:
+            exe.run(bank.load_prog, feed=feed, fetch_list=[],
+                    scope=bank.scope)
+
+    def store(self, exe, row, slot):
+        """Copy slot `slot`'s freshly prefilled cache rows into prefix
+        row `row` (the registration step), every bank."""
+        src = np.full(self.rows, int(slot), "int64")
+        take = np.zeros(self.rows, "float32")
+        take[row] = 1.0
+        feed = {"copy_src_rows": src, "copy_take": take,
+                "copy_keep": 1.0 - take}
+        for bank in self._banks:
+            exe.run(bank.store_prog, feed=feed, fetch_list=[],
+                    scope=bank.scope)
+
+    # -- reporting ------------------------------------------------------
+
+    def counters(self):
+        return {"prefix_lifetime_hits": self.hits,
+                "prefix_lifetime_misses": self.misses,
+                "prefix_lifetime_tokens_reused": self.tokens_reused,
+                "prefix_registrations": self.registrations,
+                "prefix_evictions": self.evictions,
+                "prefix_rows": self.rows,
+                "prefix_rows_used": sum(
+                    1 for t in self._tokens if t is not None),
+                "prefix_chunk": self.chunk}

@@ -188,6 +188,12 @@ class ProcessPool:
         self.worker_pid = int(hello.get("pid", 0))
         self.pool = _MirrorPool(hello["n_slots"], hello["width"],
                                 hello["t_max"])
+        # fast-path config mirrored from the worker: the router's
+        # prefix-aware placement needs the pool's match granularity
+        # (0 = pool has no prefix cache / no draft)
+        self.prefix_rows = int(hello.get("prefix_rows", 0))
+        self.prefix_chunk = int(hello.get("prefix_chunk", 0))
+        self.spec_k = int(hello.get("spec_k", 0))
         self.queue = []        # mirror: submitted, not yet admitted
         self._reqs = {}        # rid -> Request, until terminal
         self._unacked = []     # submits with no ack yet (resend queue)
@@ -197,7 +203,13 @@ class ProcessPool:
         self._step_wall = []   # assigned by the router (shared clock)
         self.counters = {
             "occupancy_sum": float(hello.get("occupancy_sum", 0.0)),
-            "steps": int(hello.get("steps", 0))}
+            "steps": int(hello.get("steps", 0)),
+            "spec_proposed": int(hello.get("spec_proposed", 0)),
+            "spec_accepted": int(hello.get("spec_accepted", 0)),
+            "prefix_hits": int(hello.get("prefix_hits", 0)),
+            "prefix_misses": int(hello.get("prefix_misses", 0)),
+            "prefix_tokens_reused": int(
+                hello.get("prefix_tokens_reused", 0))}
         self.exe = _ExeStats(hello.get("compile_count", 0))
 
     # ---- the engine surface the router drives --------------------------
@@ -243,6 +255,9 @@ class ProcessPool:
         self.now = int(rep["now"])
         self.counters["occupancy_sum"] = float(rep["occupancy_sum"])
         self.counters["steps"] = int(rep["steps"])
+        for k in ("spec_proposed", "spec_accepted", "prefix_hits",
+                  "prefix_misses", "prefix_tokens_reused"):
+            self.counters[k] = int(rep.get(k, self.counters.get(k, 0)))
         self.exe.compile_count = int(rep["compile_count"])
         done = []
         for r in rep["results"]:
@@ -259,6 +274,17 @@ class ProcessPool:
             worker_q + [q for q in self._unacked if q.rid in self._reqs],
             key=lambda r: (r.arrival, str(r.rid)))
         return done
+
+    def register_prefix(self, tokens):
+        """Register a common prompt prefix in the worker's prefix cache
+        (engine.register_prefix over the wire).  Returns the prefix row
+        id, or None when the worker has no prefix cache / the tokens
+        are shorter than one chunk."""
+        rep = self.policy.call(
+            self._cli, "register_prefix",
+            tokens=np.asarray(tokens, "int64").reshape(-1))
+        row = rep.get("row") if isinstance(rep, dict) else None
+        return None if row is None else int(row)
 
     # ---- lifecycle -----------------------------------------------------
     def proc_kill(self):
@@ -339,6 +365,12 @@ class FabricRouter:
         self._step_wall = []  # shared with every engine (latency base)
         self._results = {}
         self._prefix = {}  # rid -> emitted tokens carried over failovers
+        # fabric-wide prefix-cache registry: the token arrays registered
+        # via register_prefix, kept so (a) placement can estimate a
+        # PROCESS pool's match length without an RPC and (b) pools that
+        # join later (scale-up, failover respawn) get every registered
+        # prefix replayed into their cache
+        self._prefixes = []
         self._pending_scale = []  # deltas from the control plane (RPC)
         self._lock = threading.RLock()
         self.counters = {"submitted": 0, "finished": 0, "rejected": 0,
@@ -395,7 +427,77 @@ class FabricRouter:
               % (pid, self.now,
                  " worker=%s" % engine.endpoint
                  if scope is None else ""), flush=True)
+        # replay every fabric-registered prefix into the new pool's
+        # cache: a pool joining after registration (scale-up, failover
+        # respawn) must serve prefix-hit traffic identically to the
+        # pools that were present at registration time
+        for toks in self._prefixes:
+            self._register_prefix_on(self.pools[pid], toks)
         return pid
+
+    # ---- prefix-cache registration -------------------------------------
+    def _register_prefix_on(self, h, tokens):
+        """Register `tokens` on one pool (skipped when the pool carries
+        no prefix cache).  Returns the pool's prefix row id or None."""
+        from contextlib import nullcontext
+
+        from ..core.scope import scope_guard
+
+        eng = h.engine
+        if getattr(eng, "register_prefix", None) is None:
+            return None
+        if (getattr(eng, "prefix", None) is None
+                and not getattr(eng, "prefix_rows", 0)):
+            return None
+        with (scope_guard(h.scope) if h.scope is not None
+              else nullcontext()):
+            return eng.register_prefix(tokens)
+
+    def register_prefix(self, tokens):
+        """Register one common prompt prefix FABRIC-wide: every
+        routable pool with a prefix cache prefills and stores it, and
+        the router records the tokens so placement can estimate match
+        lengths for process pools and so late-joining pools get the
+        prefix replayed (see _register_pool).  Pools without a prefix
+        cache are skipped — a mixed fabric degrades to cold prefill on
+        them, never to a wrong stream.  Call while the fabric is idle
+        (engines refuse registration with slots busy).  Returns
+        {pid: row} for the pools that took it."""
+        tokens = np.asarray(tokens, "int64").reshape(-1)
+        with self._lock:
+            rows = {}
+            for h in self._routable():
+                row = self._register_prefix_on(h, tokens)
+                if row is not None:
+                    rows[h.pid] = row
+            self._prefixes.append(tokens.copy())
+            return rows
+
+    def _prefix_match_len(self, h, req):
+        """Expected prefix-cache reuse for `req` on pool `h` in tokens
+        (0 = no prefix cache or no match).  In-process pools answer
+        from the engine's own host index (exact, counter-free —
+        match() doesn't bump hit/miss); process pools are estimated
+        from the router's registry floored to the worker's chunk, which
+        matches the worker's own admission-time match for every prefix
+        registered THROUGH the router."""
+        eng = h.engine
+        pfx = getattr(eng, "prefix", None)
+        if pfx is not None:
+            return int(pfx.match(req.prompt)[1])
+        chunk = int(getattr(eng, "prefix_chunk", 0) or 0)
+        if chunk <= 0 or not self._prefixes:
+            return 0
+        best = 0
+        p = req.prompt
+        for toks in self._prefixes:
+            n = min(int(toks.size), int(p.size) - 1)
+            if n < chunk:
+                continue
+            eq = p[:n] == toks[:n]
+            lcp = n if eq.all() else int(np.argmax(~eq))
+            best = max(best, (lcp // chunk) * chunk)
+        return best
 
     def drain_pool(self, pid):
         """Begin drain-and-retire: no new placements; in-flight requests
@@ -594,16 +696,27 @@ class FabricRouter:
             h.engine.close(kill=True)
 
     # ---- placement -----------------------------------------------------
-    def _score(self, h):
+    def _score(self, h, req):
         """Placement score (lower is better): per-pool health is the
         gate (only live pools are scored at all), then occupancy, then
-        the pool's own backlog, then CAPACITY (best-fit: among fitting
+        the pool's own backlog, then the request's REMAINING WORK on
+        this pool — (prompt - prefix match) + max_new.  The raw PR 18
+        best-fit key len(prompt)+max_new OVERESTIMATES footprint for
+        prefix-hit requests: a long-template request whose prefix is
+        resident would spill to the big pools even though most of its
+        prompt never prefills.  Scoring remaining work keeps
+        long-template traffic on the pools holding its prefix; on a
+        fabric with no prefix caches the term is pool-independent and
+        the ordering falls through to CAPACITY (best-fit: among fitting
         pools a short request prefers the smallest, keeping big pools
-        free for the long-context requests only they can hold), then
-        pid for a stable tie-break."""
+        free for the long-context requests only they can hold) then pid
+        for a stable tie-break — the pre-prefix ordering, unchanged."""
         active = len(h.engine.pool.active_slots())
         occ = active / float(h.engine.n_slots)
-        return (occ, len(h.engine.queue), h.engine.pool.t_max, h.pid)
+        est_work = (int(req.prompt.size) - self._prefix_match_len(h, req)
+                    + int(req.max_new_tokens))
+        return (occ, len(h.engine.queue), est_work,
+                h.engine.pool.t_max, h.pid)
 
     def _place(self):
         """Route due arrivals onto pools; reject past the fabric-wide
@@ -632,7 +745,8 @@ class FabricRouter:
                 self._terminal(req, "REJECTED_NO_FIT")
                 continue
             target = None
-            for h in sorted(fitting, key=self._score):
+            for h in sorted(fitting,
+                            key=lambda h: self._score(h, req)):
                 if free.get(h.pid, 0) > 0:
                     target = h
                     break
@@ -742,8 +856,12 @@ class FabricRouter:
                        / float(h.engine.n_slots) for h in live)
                    / len(live)) if live else 0.0
             sub = max(1, self.counters["submitted"])
-            per_pool = {
-                str(h.pid): {
+            per_pool = {}
+            for h in self.pools.values():
+                c = h.engine.counters
+                prop = int(c.get("spec_proposed", 0))
+                acc = int(c.get("spec_accepted", 0))
+                per_pool[str(h.pid)] = {
                     "state": h.state,
                     "active_slots": len(h.engine.pool.active_slots()),
                     "n_slots": h.engine.n_slots,
@@ -753,10 +871,19 @@ class FabricRouter:
                     # per step) — the instantaneous active_slots reads
                     # 0 at any quiesced boundary
                     "mean_occupancy": round(
-                        h.engine.counters["occupancy_sum"]
-                        / max(1, h.engine.counters["steps"]), 4),
+                        c["occupancy_sum"] / max(1, c["steps"]), 4),
+                    # the fast-path signal set: draft acceptance and
+                    # prefix reuse per pool (the supervisor's scaler
+                    # and the bench read these through the same verb)
+                    "spec_proposed": prop,
+                    "spec_accepted": acc,
+                    "accept_rate": round(acc / float(prop), 4)
+                    if prop else 1.0,
+                    "prefix_hits": int(c.get("prefix_hits", 0)),
+                    "prefix_misses": int(c.get("prefix_misses", 0)),
+                    "prefix_tokens_reused": int(
+                        c.get("prefix_tokens_reused", 0)),
                 }
-                for h in self.pools.values()}
             s = dict(self.counters)
             s.update({
                 "n_pools": len(live),
@@ -766,6 +893,7 @@ class FabricRouter:
                 "rejection_rate": round(
                     self.counters["rejected"] / float(sub), 4),
                 "step": self.now,
+                "prefixes_registered": len(self._prefixes),
                 "pools": per_pool,
             })
             return s
@@ -803,6 +931,11 @@ class FabricRouter:
                     if verb == "attach_worker":
                         pid = router.attach_worker(kw["endpoint"])
                         return {"ok": True, "pid": pid}
+                    if verb == "register_prefix":
+                        rows = router.register_prefix(kw["tokens"])
+                        return {"ok": True,
+                                "rows": {str(k): int(v)
+                                         for k, v in rows.items()}}
                     if verb == "report_pool_death":
                         hit = router.report_worker_death(
                             pid=kw.get("pid"),

@@ -38,6 +38,17 @@ level up, to knobs that select between whole PROGRAMS:
 * ``comm_bucket_bytes`` — consult-only: a distributed bench can deposit
                           a searched value, the tuner itself never
                           times multi-process candidates
+* ``spec_k``            — consult-only serving knob: the speculative
+                          chunk width a serve bench measured best for
+                          this (model, shape) — acceptance rate is
+                          workload-dependent, so the tuner never times
+                          it on synthetic feeds; None = engine default
+* ``use_draft``         — consult-only serving knob: arm the draft
+                          model at all ("self" / True / False / None);
+                          deposited by BENCH_SERVE_SPEC, never searched
+* ``prefix_chunk``      — consult-only serving knob: prefix-cache match
+                          granularity (a multiple of the engine width);
+                          None = engine default (== width)
 
 Search is greedy coordinate descent (knob order as listed, best value
 kept before moving on) bounded by ``max_trials`` timings; each timing
@@ -64,6 +75,7 @@ __all__ = [
     "program_signature",
     "tune",
     "tuned_flags",
+    "serving_knobs",
     "cache_stats",
     "clear_cache",
 ]
@@ -80,6 +92,14 @@ DEFAULT_DECISION = {
     "use_pallas": None,          # None = inherit FLAGS_use_pallas
     "steps_per_dispatch": 1,
     "comm_bucket_bytes": None,   # consult-only knob
+    # consult-only SERVING knobs (ServingEngine fast path): deposited by
+    # the serve bench, merged under cached decisions like every new knob
+    # (a committed CI cache predating them keeps validating), and never
+    # searched — acceptance rate and prefix locality are properties of
+    # the TRAFFIC, which synthetic feeds cannot represent
+    "spec_k": None,              # None = engine default (min(4, width))
+    "use_draft": None,           # None = off; "self" | True = self-draft
+    "prefix_chunk": None,        # None = engine default (== width)
 }
 
 # search order: rebuild knobs first (they change the op mix every later
@@ -175,6 +195,23 @@ def tuned_flags(decision):
     out = {"prng_impl": decision.get("prng_impl", "threefry")}
     if decision.get("use_pallas") is not None:
         out["use_pallas"] = bool(decision["use_pallas"])
+    return out
+
+
+def serving_knobs(decision):
+    """The ServingEngine keyword mapping for a decision's consult-only
+    serving knobs — the serve-side twin of tuned_flags.  Only knobs the
+    decision actually pins appear (None stays with the engine default),
+    so ``ServingEngine(exe, hp, **serving_knobs(d), ...)`` composes with
+    explicit call-site overrides."""
+    out = {}
+    if decision.get("spec_k") is not None:
+        out["spec_k"] = int(decision["spec_k"])
+    ud = decision.get("use_draft")
+    if ud:  # "self" / True -> self-draft; False/None -> leave off
+        out["draft"] = "self"
+    if decision.get("prefix_chunk") is not None:
+        out["prefix_chunk"] = int(decision["prefix_chunk"])
     return out
 
 

@@ -201,6 +201,25 @@ echo "== serving pass (continuous-batching churn exactness) =="
 # tier-1's time budget keeps out of the fast suite.
 python -m pytest tests/test_serving.py -q -m ""
 
+echo "== speculative + prefix serving pass (decode/prefill fast path) =="
+# the in-pool fast path end to end, explicitly: greedy + keyed-sampled
+# speculative churn exactness (pooled == solo == plain engine), the
+# compile-count pin across occupancy with the draft program live,
+# prefix-hit streams bit-identical to cold with the prefill-chunk
+# saving asserted, spec+prefix composed, and the consult-only autotune
+# knobs.  The same subset then re-runs under FLAGS_use_pallas=1 so the
+# vector-qstart flash kernel verifies width-k anchor+draft chunks and
+# prefix-resumed prefill offsets (interpret mode, pinned tuning cache
+# — CI never searches block sizes).  The process-mode spec+prefix
+# SIGKILL failover and prefix-aware placement legs ride the fabric
+# pass above (test_serving_fabric.py -m "").
+python -m pytest tests/test_serving.py -q -m "" \
+    -k "spec or prefix or row_copy"
+FLAGS_use_pallas=1 FLAGS_kernel_autotune=0 \
+FLAGS_kernel_tune_cache=tests/data/ci_tuning_cache.json \
+    python -m pytest tests/test_serving.py -q -m "" \
+    -k "spec or prefix or row_copy"
+
 echo "== orphaned-child check =="
 # chaos tests SIGKILL cluster children; a leaked pserver/trainer (or a
 # pool worker the fabric failed to reap after a pool_proc_kill) would

@@ -1,7 +1,7 @@
 """Continuous-batching serving engine (paddle_tpu/serving, docs/SERVING.md
-§5): slot-pool churn exactness, the compiles-once contract, per-slot
-machinery unit tests, and the slow-marked bf16-KV / weight-only-int8
-engine variants."""
+§5, §8): slot-pool churn exactness, the compiles-once contract, per-slot
+machinery unit tests, the speculative-decoding + prefix-cache fast path,
+and the slow-marked bf16-KV / weight-only-int8 engine variants."""
 
 import numpy as np
 import pytest
@@ -12,13 +12,16 @@ from paddle_tpu.models import gpt2
 from paddle_tpu.models.decode_cache import (
     filtered_probs_rows,
     fold_in_seed,
+    make_row_copy_program,
     make_slot_reset_program,
     sample_rows_keyed,
 )
 from paddle_tpu.serving import (
+    PrefixCache,
     Request,
     ServingEngine,
     make_poisson_trace,
+    make_prefix_trace,
     serve_one_at_a_time,
 )
 
@@ -32,15 +35,60 @@ class TinyHP(gpt2.GPT2Config):
     dropout = 0.0
 
 
+_ENGINE_CACHE = {}
+
+
+class _PinnedScopeExecutor(fluid.Executor):
+    """Executor that defaults to a dedicated persistent scope instead of
+    the global one.  The conftest `fresh_programs` fixture swaps the
+    GLOBAL scope per test, and the XLA compile cache is keyed on the
+    scope id — pinning keeps a memoized engine's weights AND its
+    compiled executables valid across tests."""
+
+    def __init__(self, place, scope):
+        super().__init__(place)
+        self._pinned_scope = scope
+
+    def run(self, *args, **kw):
+        if kw.get("scope") is None:
+            kw["scope"] = self._pinned_scope
+        return super().run(*args, **kw)
+
+
 def _make_engine(hp=TinyHP, n_slots=4, width=4, t_max=24, seed=7, **kw):
     """Engine over randomly initialized tiny-GPT2 weights (the logits
-    program's startup provides them through the shared names)."""
+    program's startup provides them through the shared names).
+
+    MEMOIZED per config: run() fully resets an engine (counters,
+    results, cache startups), so tests with the same (hp, shape, seed,
+    kwargs, pallas flag) share one compiled engine — living in its own
+    pinned scope, see _PinnedScopeExecutor — instead of paying ~4s of
+    tracing each, the single biggest cost in this file.  Not cached:
+    engines with `prefix_rows` (a PrefixCache keeps registered rows
+    ACROSS runs by design, so sharing would leak registrations between
+    tests)."""
+    from paddle_tpu import flags
+
+    key = (hp.__name__, n_slots, width, t_max, seed,
+           bool(flags.get_flag("use_pallas")),
+           tuple(sorted(kw.items())))
+    cacheable = not kw.get("prefix_rows")
+    if cacheable and key in _ENGINE_CACHE:
+        exe, eng = _ENGINE_CACHE[key]
+        eng.queue_depth = kw.get("queue_depth")  # undo test mutations
+        return exe, eng
     _, lm_startup, _, _ = gpt2.gpt2_logits_program(hp, seq_len=t_max)
-    exe = fluid.Executor(fluid.CPUPlace())
+    if cacheable:
+        exe = _PinnedScopeExecutor(fluid.CPUPlace(), fluid.Scope())
+    else:
+        exe = fluid.Executor(fluid.CPUPlace())
     lm_startup.random_seed = seed
     exe.run(lm_startup)
-    return exe, ServingEngine(exe, hp, n_slots=n_slots, width=width,
-                              t_max=t_max, **kw)
+    eng = ServingEngine(exe, hp, n_slots=n_slots, width=width,
+                        t_max=t_max, **kw)
+    if cacheable:
+        _ENGINE_CACHE[key] = (exe, eng)
+    return exe, eng
 
 
 def _churn_trace(vocab, greedy_only=False, seed=0):
@@ -309,6 +357,293 @@ def test_engine_rejects_oversized_request():
     _, eng = _make_engine(t_max=16)
     with pytest.raises(ValueError):
         eng.submit(Request(0, np.arange(1, 10), 10))  # 9 + 10 > 17
+
+
+# ---------------------------------------------------------------------------
+# the decode/prefill fast path: speculative decoding + prefix KV reuse
+# (docs/SERVING.md §8)
+# ---------------------------------------------------------------------------
+def test_row_copy_program_gathers_only_taken_rows():
+    """make_row_copy_program: dst row i <- src[copy_src_rows[i]] where
+    copy_take[i]=1, untouched where copy_keep[i]=1 — any assignment
+    through ONE executable (ids/masks are feeds)."""
+    R, B, H, T, D = 3, 4, 2, 6, 3
+    prog = make_row_copy_program(
+        [("pfx_c", (R, H, T, D), "slot_c", (B, H, T, D))], B)
+    scope = fluid.Scope()
+    rng = np.random.RandomState(2)
+    src = rng.rand(R, H, T, D).astype("float32")
+    dst = rng.rand(B, H, T, D).astype("float32")
+    with fluid.scope_guard(scope):
+        scope.set("pfx_c", src.copy())
+        scope.set("slot_c", dst.copy())
+        exe = fluid.Executor(fluid.CPUPlace())
+        take = np.array([1.0, 0.0, 1.0, 0.0], "float32")
+        exe.run(prog, feed={
+            "copy_src_rows": np.array([2, 0, 1, 0], "int64"),
+            "copy_take": take, "copy_keep": 1.0 - take}, fetch_list=[])
+        got = np.asarray(scope.find_var("slot_c"))
+    np.testing.assert_array_equal(got[0], src[2])
+    np.testing.assert_array_equal(got[1], dst[1])
+    np.testing.assert_array_equal(got[2], src[1])
+    np.testing.assert_array_equal(got[3], dst[3])
+
+
+def test_prefix_cache_match_chunk_floor_dedup_and_lru():
+    """PrefixCache host index: longest-match floored to the chunk and
+    capped at len(prompt)-1; ties prefer the lower row; exact
+    re-registration dedups to the same row; a full pool evicts the
+    least-recently-matched row."""
+    pc = PrefixCache(rows=2, chunk=4)
+    a = np.arange(100, 112, dtype="int64")      # 12 tokens = 3 chunks
+    b = np.arange(200, 208, dtype="int64")      # 8 tokens = 2 chunks
+    ra, fresh_a = pc.assign(a)
+    rb, fresh_b = pc.assign(b)
+    assert fresh_a and fresh_b and ra != rb
+    # exact dedup: same tokens -> same row, no new registration
+    assert pc.assign(a.copy()) == (ra, False)
+    # longest match, chunk-floored: 10 shared tokens -> 8
+    prompt = np.concatenate([a[:10], np.array([7, 7, 7], "int64")])
+    row, L = pc.match(prompt)
+    assert (row, L) == (ra, 8)
+    # cap at len(prompt)-1: a prompt that IS the prefix must still
+    # dispatch its last token through prefill (chunk floor: 12 -> 8)
+    row, L = pc.match(a)
+    assert (row, L) == (ra, 8)
+    # sub-chunk overlap is a miss
+    assert pc.match(np.array([100, 101, 9, 9, 9], "int64")) == (None, 0)
+    # LRU eviction: touch row a, then a third registration evicts b
+    pc.touch(ra, 8)
+    c = np.arange(300, 308, dtype="int64")
+    rc, fresh_c = pc.assign(c)
+    assert fresh_c and rc == rb and pc.evictions == 1
+    assert pc.match(np.concatenate([b, b[:1]]))[0] is None
+    assert pc.match(np.concatenate([c, c[:1]])) == (rc, 8)
+
+
+def _spec_kwargs():
+    """SELF-draft speculation: the draft shares the target's weights —
+    the machinery under test (draft rounds, widened verify, keyed
+    accept/reject) is identical to a separate draft checkpoint's."""
+    return dict(draft="self", spec_k=3)
+
+
+def test_spec_churn_exactness_greedy_and_early_eos():
+    """Speculation on, greedy churn (8 reqs > 4 slots, staggered):
+    pooled == solo on the SPEC engine, and greedy spec == the plain
+    non-spec engine bit-for-bit (verify-chunk argmax is prefix-pure, so
+    acceptance/rejection cannot move the stream).  Early-EOS leg: an
+    accepted token hitting eos mid-round discards the rest of the round
+    and frees the slot."""
+    _, eng = _make_engine(**_spec_kwargs())
+    reqs = _churn_trace(TinyHP.vocab_size, greedy_only=True)
+    results, stats = _assert_churn_exact(eng, reqs)
+    assert stats["spec_rounds"] > 0 and stats["spec_proposed"] > 0
+    assert 0.0 < stats["accept_rate"] <= 1.0
+    # greedy spec == the plain engine's streams (fresh weights, same
+    # seed) — speculation is a scheduling change, never a math change
+    _, plain = _make_engine()
+    for r in reqs:
+        solo, _ = plain.run_solo(r)
+        np.testing.assert_array_equal(
+            results[r.rid]["tokens"], solo,
+            err_msg="rid %r: greedy spec diverged from non-spec" % r.rid)
+    # early-EOS mid-round: stop request 0 at its own second token
+    base = results[0]["tokens"]
+    eos = int(base[1])
+    r0 = Request(100, reqs[0].prompt, reqs[0].max_new_tokens,
+                 eos_id=eos, arrival=0.0)
+    res2, _ = eng.run([r0] + [Request(101, reqs[1].prompt, 4,
+                                      arrival=0.0)])
+    assert res2[100]["tokens"].size < base.size
+    assert int(res2[100]["tokens"][-1]) == eos
+    solo0, _ = eng.run_solo(r0)
+    np.testing.assert_array_equal(res2[100]["tokens"], solo0)
+
+
+def test_spec_churn_exactness_sampled():
+    """Speculation on, per-request seeded sampling: every token is a
+    pure function of (seed, global token index, token prefix) via the
+    tag-keyed propose/accept/residual draws — so pooled == solo under
+    churn, independent of neighbors, admission order, or which step of
+    a draft round emitted it."""
+    _, eng = _make_engine(**_spec_kwargs())
+    reqs = _churn_trace(TinyHP.vocab_size, greedy_only=False, seed=5)
+    assert any(not r.greedy for r in reqs)
+    results, stats = _assert_churn_exact(eng, reqs)
+    assert stats["spec_proposed"] > 0
+    # per-request acceptance counters ride the results
+    for r in reqs:
+        assert 0.0 <= results[r.rid]["accept_rate"] <= 1.0
+        if results[r.rid]["spec_proposed"]:
+            assert results[r.rid]["spec_accepted"] <= \
+                results[r.rid]["spec_proposed"]
+    # deterministic replay: the same trace re-serves byte-identically
+    again, _ = eng.run([Request(
+        rid=r.rid, prompt=r.prompt, max_new_tokens=r.max_new_tokens,
+        temperature=r.temperature, top_k=r.top_k, top_p=r.top_p,
+        seed=r.seed, arrival=r.arrival) for r in reqs])
+    for r in reqs:
+        np.testing.assert_array_equal(results[r.rid]["tokens"],
+                                      again[r.rid]["tokens"])
+
+
+def test_spec_compiles_once_across_occupancy():
+    """The no-retrace contract with speculation armed: draft rounds,
+    widened verify chunks, and acceptance-dependent advance are all
+    feed-VALUE changes over the same executables (draft program, target
+    program, resets) — occupancy churn never retraces."""
+    exe, eng = _make_engine(**_spec_kwargs())
+    warm = [Request(900, np.array([1, 2, 3]), 3, arrival=0.0),
+            Request(901, np.array([4, 5]), 2, arrival=0.0)]
+    eng.run(warm)
+    baseline = exe.compile_count
+    reqs = _churn_trace(TinyHP.vocab_size, greedy_only=False, seed=9)
+    results, stats = eng.run(reqs)
+    assert stats["finished"] == len(reqs)
+    assert exe.compile_count == baseline, (
+        "speculative churn retraced: %d -> %d"
+        % (baseline, exe.compile_count))
+
+
+def _prefix_trace_and_template(n=6, seed=21):
+    """n requests, 4 sharing one 8-token template prefix (2 chunks at
+    width 4), mixed greedy/sampled — the engine-level prefix A/B."""
+    rng = np.random.RandomState(seed)
+    tmpl = rng.randint(1, TinyHP.vocab_size, 8).astype("int64")
+    reqs = []
+    for i in range(n):
+        tail = rng.randint(1, TinyHP.vocab_size,
+                           int(rng.randint(2, 5))).astype("int64")
+        prompt = (np.concatenate([tmpl, tail]) if i < 4
+                  else rng.randint(1, TinyHP.vocab_size,
+                                   6 + tail.size).astype("int64"))
+        reqs.append(Request(
+            rid=i, prompt=prompt, max_new_tokens=int(rng.randint(3, 7)),
+            temperature=0.9 if i % 2 else 1.0,
+            top_k=8 if i % 2 else 0,
+            seed=500 + i if i % 2 else None,
+            arrival=float(i) * 0.5))
+    return reqs, tmpl
+
+
+def test_prefix_hit_stream_bit_identical_to_cold_with_fewer_chunks():
+    """ACCEPTANCE: registering the template changes WHICH cache rows
+    prefill dispatches (load-then-resume at the match boundary) but not
+    one byte of any stream — prefix-hit == cold, with the hit requests'
+    prefill chunks gone from the dispatch count.  The cold leg uses the
+    shared PLAIN engine (prefix counters exist on every engine), and the
+    register-time validation rules (chunk flooring, dedup, mining) are
+    checked on the same warm engine after its run — one engine build
+    instead of three."""
+    _, cold = _make_engine()
+    reqs, tmpl = _prefix_trace_and_template()
+    cold_res, cold_stats = cold.run(list(reqs))
+    assert cold_stats["prefix_hits"] == 0  # no cache at all
+
+    _, warm = _make_engine(prefix_rows=2)
+    row = warm.register_prefix(tmpl)
+    assert row is not None
+    assert warm.register_prefix(tmpl) == row  # dedup, no re-prefill
+    warm_res, warm_stats = warm.run(list(reqs))
+    assert warm_stats["prefix_hits"] == 4
+    assert warm_stats["prefix_misses"] == 2
+    assert warm_stats["prefix_tokens_reused"] == 4 * 8
+    # 2 chunks of the template skipped per hit request
+    assert cold_stats["prefill_chunks"] - warm_stats["prefill_chunks"] \
+        == 4 * 2
+    for r in reqs:
+        np.testing.assert_array_equal(
+            cold_res[r.rid]["tokens"], warm_res[r.rid]["tokens"],
+            err_msg="rid %r: prefix-hit stream != cold stream" % r.rid)
+        assert warm_res[r.rid]["prefix_len"] == (8 if r.rid < 4 else 0)
+    # solo exactness holds on the prefix engine too
+    for r in reqs:
+        solo, _ = warm.run_solo(r)
+        np.testing.assert_array_equal(warm_res[r.rid]["tokens"], solo)
+
+    # -- register_prefix floors to chunk and validates ------------------
+    # (same engine, now idle; width 4 -> chunk 4)
+    # shorter than one chunk: nothing to register
+    assert warm.register_prefix(np.array([1, 2, 3], "int64")) is None
+    # 10 tokens floor to 8; matching reflects the floored registration
+    row = warm.register_prefix(np.arange(1, 11, dtype="int64"))
+    assert row is not None
+    m_row, L = warm.prefix.match(np.arange(1, 13, dtype="int64"))
+    assert (m_row, L) == (row, 8)
+    # observe_prefixes mines shared openings from a request batch
+    # (2 rows already resident: mining the third exercises LRU eviction)
+    reqs33, tmpl33 = _prefix_trace_and_template(seed=33)
+    got = warm.observe_prefixes(reqs33, min_count=2)
+    assert got, "4 requests share the template: it must be mined"
+    assert any(np.array_equal(t, tmpl33)
+               for t in warm.prefix.registered().values())
+
+
+def test_spec_plus_prefix_churn_exactness():
+    """The whole fast path at once: self-draft speculation + prefix KV
+    reuse (both banks: a prefix hit must resume the DRAFT distribution
+    bit-exactly too, or sampled accept/reject draws fork) under churn —
+    every stream equals its solo run, zero retraces after warmup."""
+    exe, eng = _make_engine(prefix_rows=2, **_spec_kwargs())
+    reqs, tmpl = _prefix_trace_and_template(n=8, seed=17)
+    eng.register_prefix(tmpl)
+    results, stats = eng.run(list(reqs))
+    assert stats["finished"] == len(reqs)
+    assert stats["prefix_hits"] == 4 and stats["spec_proposed"] > 0
+    baseline = exe.compile_count
+    for r in reqs:
+        solo, _ = eng.run_solo(r)
+        np.testing.assert_array_equal(
+            results[r.rid]["tokens"], solo,
+            err_msg="rid %r: spec+prefix pooled != solo" % r.rid)
+    assert exe.compile_count == baseline, "solo replays retraced"
+
+
+def test_prefix_trace_generator_deterministic_and_prefix_heavy():
+    reqs, prefixes = make_prefix_trace(
+        20, rate=1.0, n_prefixes=2, prefix_len=8, tail_len_range=(2, 5),
+        out_len_range=(3, 6), vocab_size=61, seed=9, reuse_fraction=0.8)
+    reqs2, prefixes2 = make_prefix_trace(
+        20, rate=1.0, n_prefixes=2, prefix_len=8, tail_len_range=(2, 5),
+        out_len_range=(3, 6), vocab_size=61, seed=9, reuse_fraction=0.8)
+    assert len(reqs) == 20 and len(prefixes) == 2
+    for a, b in zip(reqs, reqs2):
+        np.testing.assert_array_equal(a.prompt, b.prompt)
+        assert (a.arrival, a.seed, a.max_new_tokens) == \
+            (b.arrival, b.seed, b.max_new_tokens)
+    for p, q in zip(prefixes, prefixes2):
+        np.testing.assert_array_equal(p, q)
+    hits = sum(any(np.array_equal(r.prompt[:8], p) for p in prefixes)
+               for r in reqs)
+    assert hits >= 10, "trace is not prefix-heavy"
+
+
+def test_autotune_serving_knobs_consult_only():
+    """The serving knobs ride the program-tuner's decision record as
+    CONSULT-ONLY values: defaults are None (engine defaults), they are
+    never searched, a cached decision predating them merges them in,
+    and serving_knobs() maps a pinned decision onto ServingEngine
+    kwargs."""
+    from paddle_tpu.transpiler.autotune import (DEFAULT_DECISION,
+                                                _KNOB_ORDER,
+                                                serving_knobs)
+
+    for k in ("spec_k", "use_draft", "prefix_chunk"):
+        assert k in DEFAULT_DECISION and DEFAULT_DECISION[k] is None
+        assert k not in _KNOB_ORDER  # never searched
+    assert serving_knobs(dict(DEFAULT_DECISION)) == {}
+    d = dict(DEFAULT_DECISION)
+    d.update({"spec_k": 3, "use_draft": "self", "prefix_chunk": 8})
+    assert serving_knobs(d) == {"spec_k": 3, "draft": "self",
+                                "prefix_chunk": 8}
+    # an OLD cached decision (no serving keys) still resolves: the
+    # merge-under-defaults discipline keeps committed caches valid
+    old = {k: v for k, v in DEFAULT_DECISION.items()
+           if k not in ("spec_k", "use_draft", "prefix_chunk")}
+    merged = dict(DEFAULT_DECISION)
+    merged.update(old)
+    assert serving_knobs(merged) == {}
 
 
 # ---------------------------------------------------------------------------

@@ -591,6 +591,67 @@ def test_cross_pool_no_fit_after_big_pool_dies_is_loud():
     assert router.counters["rejected"] == 1
 
 
+@pytest.mark.slow  # ~8s engine builds; rides the ci.sh fabric lane
+def test_prefix_aware_placement_prefers_resident_pool():
+    """The placement fix (docs/SERVING.md §8): the raw best-fit key
+    len(prompt)+max_new overestimates footprint for prefix-hit
+    requests, so the score now consults the prefix match — a request
+    opening with a registered template lands on the pool HOLDING that
+    prefix (less remaining work) even when tie-breaks would otherwise
+    send it elsewhere; cold traffic keeps the old ordering."""
+
+    def plain():
+        return _pool_factory(n_slots=2)()
+
+    def with_prefix():
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            _, lm_startup, _, _ = gpt2.gpt2_logits_program(
+                TinyHP, seq_len=T_MAX)
+            exe = fluid.Executor(fluid.CPUPlace())
+            lm_startup.random_seed = 7
+            exe.run(lm_startup)
+            eng = ServingEngine(exe, TinyHP, n_slots=2, width=4,
+                                t_max=T_MAX, prefix_rows=2)
+        return eng, scope
+
+    router = FabricRouter(plain, n_pools=1, queue_depth=8)
+    router.pool_factory = with_prefix
+    pfx_pid = router.add_pool()
+    plain_pid = [p for p in router.pools if p != pfx_pid][0]
+    tmpl = np.arange(2, 10).astype("int64")  # 8 tokens = 2 chunks
+    rows = router.register_prefix(tmpl)
+    assert list(rows) == [pfx_pid]  # the plain pool has no cache
+    assert router.stats()["prefixes_registered"] == 1
+    hit = Request(rid="hit", prompt=np.concatenate(
+        [tmpl, np.array([11, 12, 13], "int64")]), max_new_tokens=4,
+        arrival=0.0)
+    cold = Request(rid="cold", prompt=np.arange(20, 26).astype("int64"),
+                   max_new_tokens=4, arrival=0.0)
+    router.submit(hit)
+    router.submit(cold)
+    router.step()
+    placed = {pid: {s.req.rid for _, s in h.engine.pool.active_slots()}
+              for pid, h in router.pools.items()}
+    # the template request followed its prefix; the cold one kept the
+    # old pid tie-break (equal est_work everywhere)
+    assert "hit" in placed[pfx_pid], placed
+    assert "cold" in placed[plain_pid], placed
+    results, stats = router.run([])
+    assert {r["status"] for r in results.values()} == {"OK"}
+    # the stats verb surfaces the per-pool fast-path counters
+    pp = stats["pools"][str(pfx_pid)]
+    assert pp["prefix_hits"] == 1 and pp["prefix_tokens_reused"] == 8
+    assert "accept_rate" in pp and "spec_proposed" in pp
+    # a pool added AFTER registration gets the prefix replayed
+    router.pool_factory = with_prefix
+    late_pid = router.add_pool()
+    late = router.pools[late_pid]
+    with fluid.scope_guard(late.scope):
+        assert any(np.array_equal(t, tmpl) for t in
+                   late.engine.prefix.registered().values())
+
+
 def test_call_policy_bounded_retry_and_verb_deadlines():
     """CallPolicy: per-verb deadlines override the default; transport
     failures retry up to `attempts` within the deadline and surface as
@@ -831,3 +892,83 @@ def test_process_pool_supervisor_respawn_within_budget():
     _assert_solo_exact(results, args)
     assert budget.next_delay() is not None  # draw 2 of 2...
     assert budget.next_delay() is None      # ...budget exhausted
+
+
+@pytest.mark.slow
+def test_process_pool_sigkill_with_spec_and_prefix_stays_solo_exact():
+    """ACCEPTANCE (docs/SERVING.md §8): the fast path survives chaos —
+    REAL worker processes with self-draft speculation AND a prefix
+    cache armed (registered fabric-wide over the register_prefix verb),
+    one worker SIGKILL'd mid-stream.  Every stream — greedy and seeded
+    sampled, template-opening and cold — finishes token-identical to
+    its solo run on a spec engine, greedy streams also identical to the
+    plain non-spec engine, and the surviving pool's stats report the
+    acceptance/prefix counters through the stats verb."""
+
+    def factory():
+        from paddle_tpu.serving import spawn_pool_worker
+
+        return spawn_pool_worker(hp_overrides=_HP_WIRE, n_slots=2,
+                                 width=4, t_max=T_MAX, seed=7,
+                                 spec_k=3, prefix_rows=2)
+
+    rng = np.random.RandomState(13)
+    tmpl = rng.randint(1, 61, 8).astype("int64")
+    reqs = []
+    for i in range(4):
+        tail = rng.randint(1, 61, 3).astype("int64")
+        prompt = (np.concatenate([tmpl, tail]) if i < 3
+                  else rng.randint(1, 61, 6).astype("int64"))
+        reqs.append(Request(
+            rid=i, prompt=prompt, max_new_tokens=8,
+            temperature=1.0 if i % 2 == 0 else 0.9,
+            top_k=0 if i % 2 == 0 else 8,
+            seed=None if i % 2 == 0 else 1000 + i, arrival=0.0))
+    faults = FaultSchedule(schedule={"fabric": {1: "pool_proc_kill"}},
+                           seed=5)
+    router = FabricRouter(factory, n_pools=2, queue_depth=16,
+                          pool_mode="process",
+                          rpc_policy=_proc_policy(),
+                          fault_schedule=faults, miss_beats=2)
+    rows = router.register_prefix(tmpl)
+    assert sorted(rows) == sorted(router.pools)  # both workers took it
+    try:
+        results, stats = router.run(list(reqs))
+    finally:
+        _close_procs(router)
+    assert stats["pools_died"] == 1 and stats["replaced"] >= 1
+    assert stats["finished"] == 4 and stats["rejected"] == 0
+    assert stats["prefixes_registered"] == 1
+    # the survivor's fast-path counters flow through the stats verb
+    # (mirrored from the worker's step replies)
+    (survivor,) = stats["pools"].values()
+    assert survivor["prefix_hits"] >= 1
+    assert survivor["spec_proposed"] > 0
+    assert 0.0 < survivor["accept_rate"] <= 1.0
+    # solo reference: an in-process engine with the SAME spec config
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        _, lm_startup, _, _ = gpt2.gpt2_logits_program(
+            TinyHP, seq_len=T_MAX)
+        exe = fluid.Executor(fluid.CPUPlace())
+        lm_startup.random_seed = 7
+        exe.run(lm_startup)
+        eng = ServingEngine(exe, TinyHP, n_slots=4, width=4,
+                            t_max=T_MAX, draft="self", spec_k=3)
+        for r in reqs:
+            ref, _ = eng.run_solo(r)
+            got = np.asarray(results[r.rid]["tokens"])
+            assert np.array_equal(np.asarray(ref), got), (
+                "rid %r diverged from spec solo after SIGKILL failover"
+                % (r.rid,))
+        # greedy spec == the plain engine too (argmax is prefix-pure)
+        plain = ServingEngine(exe, TinyHP, n_slots=4, width=4,
+                              t_max=T_MAX)
+        for r in reqs:
+            if not r.greedy:
+                continue
+            ref, _ = plain.run_solo(r)
+            assert np.array_equal(
+                np.asarray(ref),
+                np.asarray(results[r.rid]["tokens"])), (
+                "rid %r: greedy spec diverged from non-spec" % (r.rid,))

@@ -37,6 +37,7 @@ MODULES = [
     "paddle_tpu.device_info",
     "paddle_tpu.parallel.collective",
     "paddle_tpu.parallel.partition_rules",
+    "paddle_tpu.serving",
     "paddle_tpu.serving.router",
     "paddle_tpu.ops.pallas_kernels",
     "paddle_tpu.ops.kernel_tuning",
