@@ -367,6 +367,15 @@ def _bench_impl():
         except Exception as e:
             sys.stderr.write("spmd_train bench failed: %r\n" % (e,))
             result["spmd_train"] = {"error": repr(e)[:200]}
+    # pipeline-parallel TRAINING: the same builder stage-sliced over a
+    # pp mesh, both schedules vs unpipelined — step/s, loss parity,
+    # per-device state bytes (the 1/S point), activation residency
+    if os.environ.get("BENCH_SPMD_PP", "0") == "1":
+        try:
+            result["spmd_pp"] = _spmd_pp_bench(on_tpu, device)
+        except Exception as e:
+            sys.stderr.write("spmd_pp bench failed: %r\n" % (e,))
+            result["spmd_pp"] = {"error": repr(e)[:200]}
     # serving fabric: the same trace through a multi-pool router —
     # static fleet vs the 1->3->1 scale walk vs a mid-stream pool kill
     if os.environ.get("BENCH_FABRIC", "0") == "1":
@@ -1383,6 +1392,144 @@ def _spmd_train_bench(on_tpu, device):
         out[key] = leg
         sys.stderr.write("SPMD_TRAIN_RESULT %s %s\n"
                          % (key, json.dumps(leg)))
+    return out
+
+
+def _pp_bench_program(on_tpu, seq):
+    """The pp bench builder, split out so the pinned-cache test can
+    reconstruct the exact program signature the BENCH_SPMD_PP leg
+    consults the program tuner with."""
+    from paddle_tpu.models import gpt2
+
+    class HP(gpt2.GPT2Config):
+        vocab_size = 8000 if on_tpu else 256
+        n_ctx = 256 if on_tpu else 32
+        d_model = 256 if on_tpu else 64
+        n_layer = 6          # deep enough that 4 stages stay balanced
+        n_head = 4
+        d_inner = 1024 if on_tpu else 128
+        dropout = 0.0
+        tie_embeddings = False
+
+    main, startup, feeds, fetches = gpt2.gpt2_lm_program(
+        HP, seq_len=seq, lr=3e-4)
+    return HP, main, startup, feeds, fetches
+
+
+def _spmd_pp_bench(on_tpu, device):
+    """Pipeline-parallel TRAINING leg (BENCH_SPMD_PP=1): the gpt2
+    causal-LM builder stage-sliced over a (dp, mp, pp) mesh — default
+    (1, 1, 4), needs BENCH_SPMD_PP_DEVICES devices (4; on CPU run under
+    XLA_FLAGS=--xla_force_host_platform_device_count=N) — under BOTH
+    microbatch schedules vs the same program unpipelined.  Per
+    schedule: step/s, final-loss parity, per-device param+opt-state
+    bytes from pipeline_state_report (the 1/S memory point the
+    acceptance bar reads), and the schedule's peak activation residency
+    from pipeline_activation_report (the O(M) GPipe vs O(S) 1F1B
+    claim, measured).  M consults the program tuning cache
+    (n_microbatches, a consult-only knob BENCH_SPMD_PP itself
+    deposits); BENCH_SPMD_PP_MICROBATCHES overrides."""
+    import numpy as np
+
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu.models import gpt2
+    from paddle_tpu.transpiler import autotune as at
+    from paddle_tpu.transpiler.pipeline import (
+        pipeline_activation_report, pipeline_program,
+        pipeline_state_report)
+    from paddle_tpu.parallel import make_mesh
+    from paddle_tpu.utils import memory_analysis as ma
+
+    need = int(os.environ.get("BENCH_SPMD_PP_DEVICES", "4"))
+    if len(jax.devices()) < need:
+        return {"skipped":
+                "needs %d devices; run under XLA_FLAGS="
+                "--xla_force_host_platform_device_count=%d"
+                % (need, need)}
+
+    mesh_shape = tuple(int(x) for x in os.environ.get(
+        "BENCH_SPMD_PP_MESH", "1,1,4").split(","))
+    dp, mp, pp = mesh_shape
+    seq = int(os.environ.get("BENCH_SPMD_PP_SEQ",
+                             (256 if on_tpu else 32) // 2))
+    batch = int(os.environ.get("BENCH_SPMD_PP_BATCH",
+                               16 if on_tpu else 8))
+    steps = int(os.environ.get("BENCH_SPMD_PP_STEPS",
+                               20 if on_tpu else 4))
+
+    def run_leg(schedule, M):
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            HP, main, startup, feeds, fetches = _pp_bench_program(
+                on_tpu, seq)
+            if schedule is not None:
+                axes = {"pp": pp}
+                if dp > 1:
+                    axes = {"dp": dp, "pp": pp}
+                mesh = make_mesh(axes,
+                                 devices=jax.devices()[:dp * mp * pp])
+                main = pipeline_program(main, mesh, n_microbatches=M,
+                                        schedule=schedule)
+            exe = fluid.Executor(
+                fluid.TPUPlace(0) if on_tpu else fluid.CPUPlace())
+            startup.random_seed = 23
+            exe.run(startup)
+            fb = gpt2.make_fake_lm_batch(batch, seq, HP, seed=0)
+            exe.run(main, feed=fb, fetch_list=fetches)  # warm compile
+            t0 = time.time()
+            loss = None
+            for _ in range(steps):
+                out = exe.run(main, feed=fb, fetch_list=fetches)
+                loss = float(np.asarray(out[0]).reshape(-1)[0])
+            dt = time.time() - t0
+            leg = {
+                "value": round(steps / dt, 3),
+                "unit": "steps/sec" + ("" if on_tpu
+                                       else " (cpufallback)"),
+                "final_loss": loss,
+            }
+            if schedule is not None:
+                srep = pipeline_state_report(main)
+                arep = pipeline_activation_report(main)
+                leg["state_bytes_per_device"] = int(
+                    srep["per_device_peak_bytes"])
+                leg["state_bytes_single_device"] = int(
+                    srep["single_device_bytes"])
+                leg["state_ratio_vs_single_device"] = round(
+                    srep["peak_ratio"], 4)
+                leg["peak_activation_bytes"] = int(
+                    arep[schedule]["peak_bytes"])
+        return leg
+
+    # the tuner pins M per (program signature, shape bucket): consult
+    # it the way a training driver would (CI: the pinned cache entry)
+    _, probe, _, feeds, _ = _pp_bench_program(on_tpu, seq)
+    spec = ma.program_feed_specs(probe, feeds, batch_hint=batch)
+    decision = at.tune(probe, spec)
+    M = int(os.environ.get(
+        "BENCH_SPMD_PP_MICROBATCHES",
+        at.pipeline_knobs(decision).get("n_microbatches", 8)))
+
+    out = {"batch": batch, "seq_len": seq, "steps": steps,
+           "mesh_shape": list(mesh_shape), "n_microbatches": M}
+    out["unpipelined"] = run_leg(None, M)
+    sys.stderr.write("SPMD_PP_RESULT unpipelined %s\n"
+                     % json.dumps(out["unpipelined"]))
+    base_loss = out["unpipelined"]["final_loss"]
+    for sched in ("gpipe", "1f1b"):
+        leg = run_leg(sched, M)
+        leg["loss_vs_unpipelined"] = (
+            None if base_loss in (None, 0.0)
+            else round(abs(leg["final_loss"] - base_loss)
+                       / abs(base_loss), 8))
+        out[sched] = leg
+        sys.stderr.write("SPMD_PP_RESULT %s %s\n"
+                         % (sched, json.dumps(leg)))
+    # deposit the consult-only knobs for the next consult (a searched=
+    # False entry never lands on disk, so only note the decision here)
+    out["tuned_decision"] = {
+        "mesh_shape": list(mesh_shape), "n_microbatches": M}
     return out
 
 

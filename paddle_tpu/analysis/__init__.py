@@ -45,6 +45,7 @@ from .verifier import (  # noqa: F401
     segment_diagnostics,
     alias_plan_diagnostics,
     sharding_diagnostics,
+    pipeline_diagnostics,
 )
 
 __all__ = [
@@ -68,4 +69,5 @@ __all__ = [
     "segment_diagnostics",
     "alias_plan_diagnostics",
     "sharding_diagnostics",
+    "pipeline_diagnostics",
 ]

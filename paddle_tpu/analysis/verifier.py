@@ -37,6 +37,11 @@ tests/test_program_verifier.py):
                              divide its mesh axis (warning)
   sharding-inconsistency     a grad/optimizer-state name resolves to a
                              different spec than its base param (error)
+  pipeline-slice             a pipeline stage slice is ill-formed: a
+                             cross-stage read does not resolve through
+                             the previous stage's hop vars, a param is
+                             read outside its owning stage, or the
+                             stage's own slice fails structural verify
 """
 
 from .graph import consumer_map, op_reads
@@ -51,6 +56,7 @@ __all__ = [
     "segment_diagnostics",
     "alias_plan_diagnostics",
     "sharding_diagnostics",
+    "pipeline_diagnostics",
 ]
 
 # canonical dtype strings the IR serializes (desc_codec closed set)
@@ -120,7 +126,8 @@ def _is_grad_op(op):
 
 
 def verify_program(program, scope=None, feeds=None, fetches=(),
-                   pass_name=None, check_infer=True, dce_fetches=None):
+                   pass_name=None, check_infer=True, dce_fetches=None,
+                   keep=None):
     """Statically verify `program`; returns a list of Diagnostics.
 
     scope:   optional Scope — names resident there count as defined
@@ -132,6 +139,9 @@ def verify_program(program, scope=None, feeds=None, fetches=(),
     dce_fetches: when set, block-0 ops the executor's DCE would drop
              for these fetch targets are skipped (the verify-before-run
              regime checks what will actually trace).
+    keep:    explicit block-0 keep mask (bool per op) overriding the
+             dce_fetches-derived mask — pipeline stage slices verify
+             with the plan's own masks instead of a DCE frontier.
     """
     diags = []
     feed_all = feeds == "*"
@@ -166,8 +176,8 @@ def verify_program(program, scope=None, feeds=None, fetches=(),
                 pass_name))
 
     # ---- executor-DCE mask for the verify-before-run regime ----------
-    keep = None
-    if dce_fetches is not None:
+    explicit_keep = keep is not None
+    if keep is None and dce_fetches is not None:
         from ..core.trace import dce_mask
 
         keep = dce_mask(program, 0, list(dce_fetches))
@@ -354,6 +364,13 @@ def verify_program(program, scope=None, feeds=None, fetches=(),
     # ---- sharding consistency (GSPMD-stamped programs) ---------------
     if getattr(program, "_spmd", None) is not None:
         diags.extend(sharding_diagnostics(program, pass_name=pass_name))
+
+    # ---- pipeline stage-boundary consistency -------------------------
+    # explicit_keep guards recursion: pipeline_diagnostics re-enters
+    # verify_program once per stage with keep=<that stage's mask>
+    if not explicit_keep and getattr(program, "_pipeline", None) is not None:
+        diags.extend(pipeline_diagnostics(
+            program, scope=scope, pass_name=pass_name))
 
     # ---- shape/dtype/arity inference ---------------------------------
     if check_infer:
@@ -644,6 +661,102 @@ def sharding_diagnostics(program, mesh=None, rules=None, pass_name=None):
                 "derived '%s' resolves to %s but its param '%s' to %s — "
                 "grads and optimizer state must shard like their param"
                 % (name, s_derived, base, s_base))
+    return diags
+
+
+def pipeline_diagnostics(program, plan=None, scope=None, pass_name=None):
+    """Stage-boundary diagnostics for a pipeline-sliced program (the
+    ``pipeline_program`` contract made checkable):
+
+      1. hop resolution — every cross-stage activation a stage reads
+         (``plan.boundary_in[s]``) must be carried by the previous
+         stage's hop vars (``plan.boundary_out[s-1]``); a mis-sliced
+         program yields an error naming the stage and the boundary op
+         that cannot resolve its input
+      2. param exclusivity — a stage's forward slice must only read
+         params its stage owns (the packed per-stage state layout has
+         no row for a foreign param)
+      3. per-stage structural verify — each stage slice re-enters
+         ``verify_program`` with ``keep=<that stage's mask>``, feeds =
+         the stage's hop + data names, fetches = its hop outputs (loss
+         on the last stage); any error surfaces as ``pipeline-slice``
+         prefixed with the stage index
+
+    ``plan`` defaults to the program's ``_pipeline`` stamp; returns []
+    for unstamped programs.  Delegated to by verify_program (and the
+    executor's verify-before-first-run) whenever the stamp is present.
+    """
+    if plan is None:
+        pp = getattr(program, "_pipeline", None)
+        if pp is None:
+            return []
+        plan = pp["plan"]
+    diags = []
+    block = program.global_block()
+    S = plan.n_stages
+
+    def first_reader(mask, name):
+        for oidx, op in enumerate(block.ops):
+            if oidx < len(mask) and mask[oidx] \
+                    and name in op.input_arg_names():
+                return oidx, op
+        return None, None
+
+    # 1. hop resolution
+    for s in range(S):
+        prev_out = set(plan.boundary_out[s - 1]) if s > 0 else set()
+        for name in sorted(plan.boundary_in[s]):
+            if name in prev_out:
+                continue
+            oidx, op = first_reader(plan.fwd_masks[s], name)
+            diags.append(Diagnostic(
+                "pipeline-slice", "error", 0, oidx,
+                op.type if op is not None else None,
+                "stage %d boundary op %s reads '%s' across the stage "
+                "boundary but stage %d's hop vars %s do not carry it — "
+                "the activation cannot resolve through the pipeline"
+                % (s, "?" if oidx is None else oidx, name, s - 1,
+                   sorted(plan.boundary_out[s - 1]) if s > 0 else []),
+                pass_name))
+
+    # 2. param exclusivity
+    from ..framework import Parameter
+
+    for s in range(S):
+        mask = plan.fwd_masks[s]
+        for oidx, op in enumerate(block.ops):
+            if oidx >= len(mask) or not mask[oidx]:
+                continue
+            for n in op.input_arg_names():
+                v = block._find_var_recursive(n)
+                if not isinstance(v, Parameter):
+                    continue
+                owner = plan.resolution.stage_for(n)
+                if owner is not None and owner != s:
+                    diags.append(Diagnostic(
+                        "pipeline-slice", "error", 0, oidx, op.type,
+                        "stage %d op %d (%s) reads param '%s' owned by "
+                        "stage %d — the per-stage packed state has no "
+                        "row for a foreign param"
+                        % (s, oidx, op.type, n, owner), pass_name))
+
+    # 3. per-stage structural verify (errors only; warnings like
+    # dead-write are a property of the full program, not the slice)
+    for s in range(S):
+        fetches = ([plan.loss_name] if s == S - 1
+                   else sorted(plan.boundary_out[s]))
+        stage_diags = verify_program(
+            program, scope=scope,
+            feeds=sorted(plan.stage_feed_names[s]),
+            fetches=fetches, keep=plan.fwd_masks[s],
+            check_infer=False, pass_name=pass_name)
+        for d in stage_diags:
+            if not d.is_error:
+                continue
+            diags.append(Diagnostic(
+                "pipeline-slice", "error", d.block_idx, d.op_idx,
+                d.op_type, "stage %d slice: %s" % (s, d.message),
+                pass_name))
     return diags
 
 

@@ -15,9 +15,39 @@ forward with the original, so no double compute survives compilation.
 """
 
 
+import contextlib
+import threading
+
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+# --- microbatch-rows context -------------------------------------------------
+# Pipeline stage tracing sets this around each stage application so
+# row-wise randomness (dropout) stays bit-identical to the unpipelined
+# program: the op draws its mask over the FULL global batch rows and
+# slices out the local microbatch's window.  threefry is counter-based
+# per array position, so the full-batch draw is the same no matter which
+# device traces it.  Only meaningful for batch-leading tensors; it is
+# only ever set during pipeline stage traces.
+_MB_ROWS = threading.local()
+
+
+@contextlib.contextmanager
+def microbatch_rows(total_rows, row_offset):
+    """Bind (total global batch rows, this microbatch's first row) for the
+    enclosed trace.  `row_offset` may be a traced value."""
+    prev = getattr(_MB_ROWS, "ctx", None)
+    _MB_ROWS.ctx = (total_rows, row_offset)
+    try:
+        yield
+    finally:
+        _MB_ROWS.ctx = prev
+
+
+def current_microbatch_rows():
+    """(total_rows, row_offset) when inside microbatch_rows(), else None."""
+    return getattr(_MB_ROWS, "ctx", None)
 
 
 class OpDef:

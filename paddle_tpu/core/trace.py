@@ -175,7 +175,7 @@ def analyze_block(program, block_idx, feed_names, fetch_names, keep=None):
 
 
 def build_traced_function(program, block_idx, feed_names, fetch_names, scope,
-                          collective_axis=None, spmd=None):
+                          collective_axis=None, spmd=None, keep=None):
     """`collective_axis`: optional ("axis_name", nranks) pair binding the
     collective-lowering context around the trace — c_allreduce_* ops then
     lower to jax.lax collectives over that axis instead of identity.  The
@@ -187,8 +187,14 @@ def build_traced_function(program, block_idx, feed_names, fetch_names, scope,
     trace — mesh-aware lowerings (fused_attention's vector-QStart
     branch, slot_cache_write) then emit shard_map-wrapped kernels /
     sharding constraints.  The caller (executor._run_spmd) jits the
-    traced fn with the rule table's in/out shardings."""
-    keep = dce_mask(program, block_idx, fetch_names)
+    traced fn with the rule table's in/out shardings.
+
+    `keep`: optional explicit per-op keep mask for `block_idx`, replacing
+    the internal DCE mask.  Pipeline stage slicing passes its own masks so
+    a stage traces exactly its op range — DCE would otherwise drag the
+    whole optimizer chain in through persistable writes."""
+    if keep is None:
+        keep = dce_mask(program, block_idx, fetch_names)
     reads, writes = analyze_block(program, block_idx, feed_names, fetch_names, keep)
     state_names = [n for n in reads if scope.has_var(n)]
     missing = [n for n in reads if not scope.has_var(n)]

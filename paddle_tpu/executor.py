@@ -231,6 +231,13 @@ class Executor:
         if coll is not None:
             return self._run_collective(program, feed, fetch_names, scope,
                                         return_numpy, coll)
+        # pipeline-stamped program (transpiler.pipeline.pipeline_program):
+        # the stage-sliced schedule runs as one jitted shard_map step over
+        # the dp×mp×pp mesh, params/optimizer state packed per-stage
+        pp = getattr(program, "_pipeline", None)
+        if pp is not None:
+            return self._run_pipeline(program, feed, fetch_names, scope,
+                                      return_numpy, pp)
         # GSPMD-stamped program (parallel.partition_rules.annotate_spmd):
         # persistables place per the partition-rule table and the traced
         # step jits with those shardings — the tensor-parallel serving
@@ -586,6 +593,102 @@ class Executor:
                                         key)
         for n, v in new_state.items():
             scope.set(n, v)
+        if return_numpy:
+            return [as_numpy(f) for f in fetches]
+        return list(fetches)
+
+    def _run_pipeline(self, program, feed, fetch_names, scope, return_numpy,
+                      pp):
+        """Run a pipeline-stamped program: the stage-sliced GPipe/1F1B
+        schedule compiled as ONE jitted shard_map step over the dp×mp×pp
+        mesh.  Stage params + Adam state live packed in [S, L] buffers
+        sharded P(pp) (per-device bytes = max stage, not the sum); the
+        buffers are donated every step and owned by the cache entry —
+        ``transpiler.pipeline.flush_pipeline_state`` writes them back to
+        the scope for checkpointing.  Shared state (learning rate,
+        schedule counters) stays replicated and mirrors to the scope each
+        step like every other path.  One compile per feed signature
+        (compile_count accounts it); steady-state steps never retrace."""
+        import time as _time
+
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from .flags import get_flag
+        from .parallel.mesh import mesh_axis_sizes
+
+        mesh, plan = pp["mesh"], pp["plan"]
+        self._maybe_verify_program(program, feed, fetch_names, scope)
+        repl = NamedSharding(mesh, PartitionSpec())
+        dp_axis = plan.dp_axis
+        dp = mesh_axis_sizes(mesh).get(dp_axis, 1) if dp_axis else 1
+
+        def feed_sharding(a):
+            if dp > 1 and a.ndim >= 1 and a.shape[0] % dp == 0 \
+                    and a.shape[0] > 0:
+                return NamedSharding(
+                    mesh, PartitionSpec(*((dp_axis,)
+                                          + (None,) * (a.ndim - 1))))
+            return repl
+
+        t0 = _time.perf_counter()
+        feed_np = {n: np.asarray(v) for n, v in feed.items()}
+        with RecordEvent("feed_upload", cat="feed"):
+            feed_arrays = {n: jax.device_put(a, feed_sharding(a))
+                           for n, a in feed_np.items()}
+        self._host_feed_ms += (_time.perf_counter() - t0) * 1e3
+
+        feed_sig = tuple(sorted(
+            (n, tuple(a.shape), str(a.dtype))
+            for n, a in feed_arrays.items()))
+        cache = getattr(self, "_pipeline_cache", None)
+        if cache is None:
+            cache = self._pipeline_cache = {}
+        key_id = (id(program), program._version, feed_sig,
+                  tuple(fetch_names), id(scope),
+                  bool(get_flag("use_pallas")), get_flag("prng_impl"))
+        entry = cache.get(key_id)
+        if entry is None:
+            from .transpiler.pipeline import (build_pipeline_runtime,
+                                              flush_pipeline_state)
+
+            # a previous entry's packed buffers are authoritative for
+            # stage-owned state — flush them to the scope before the new
+            # signature re-packs, or it would train from stale weights
+            flush_pipeline_state(program, scope)
+            self._cache.compile_count += 1
+            runtime = build_pipeline_runtime(
+                program, plan, mesh, scope, feed_arrays, fetch_names)
+            entry = cache[key_id] = {
+                "runtime": runtime,
+                "state": runtime.pack_state(scope),
+            }
+            for n in runtime.shared_rw:
+                entry["state"][n] = jax.device_put(
+                    np.asarray(scope.find_var(n)), repl)
+        runtime = entry["runtime"]
+
+        def commit(n):
+            v = scope.find_var(n)
+            if isinstance(v, jax.Array) and getattr(v, "committed", True) \
+                    and v.sharding == repl:
+                return v
+            arr = jax.device_put(np.asarray(v), repl)
+            scope.set(n, arr)
+            return arr
+
+        feeds = {n: feed_arrays[n] for n in runtime.feed_shardings}
+        ro_state = {n: commit(n) for n in runtime.shared_ro}
+        rw_state = entry["state"]
+        key = jax.device_put(self._rng_key(program), repl)
+        _ensure_token_regime(
+            ("mesh", tuple(d.id for d in mesh.devices.flat)))
+        with RecordEvent("executor_run"):
+            fetches, new_state = runtime.jitted(feeds, ro_state, rw_state,
+                                                key)
+        entry["state"] = new_state
+        program._pipeline_runtime = entry
+        for n in runtime.shared_rw:
+            scope.set(n, new_state[n])
         if return_numpy:
             return [as_numpy(f) for f in fetches]
         return list(fetches)

@@ -13,7 +13,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core.registry import register
+from ..core.registry import current_microbatch_rows, register
 from .common import jdt
 
 
@@ -376,7 +376,18 @@ def _dropout(ctx, ins, attrs):
         if impl == "upscale_in_train":
             return {"Out": [x], "Mask": [jnp.ones_like(x)]}
         return {"Out": [x * (1.0 - p)], "Mask": [jnp.ones_like(x)]}
-    keep = jax.random.bernoulli(ctx.rng(attrs), 1.0 - p, x.shape)
+    mb = current_microbatch_rows()
+    if mb is not None and x.ndim >= 1:
+        # pipeline microbatch: draw the mask over the FULL global batch
+        # rows (bit-identical to the unpipelined trace — threefry is
+        # counter-based per position) and slice this microbatch's window
+        total_rows, row_offset = mb
+        keep = jax.random.bernoulli(
+            ctx.rng(attrs), 1.0 - p, (total_rows,) + tuple(x.shape[1:])
+        )
+        keep = jax.lax.dynamic_slice_in_dim(keep, row_offset, x.shape[0], 0)
+    else:
+        keep = jax.random.bernoulli(ctx.rng(attrs), 1.0 - p, x.shape)
     mask = keep.astype(x.dtype)
     if impl == "upscale_in_train":
         out = jnp.where(keep, x / (1.0 - p), 0.0)

@@ -35,7 +35,8 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 __all__ = [
-    "PartitionRules", "TrainPartitionRules", "register_partition_rules",
+    "PartitionRules", "TrainPartitionRules", "StageResolution",
+    "register_partition_rules",
     "partition_rules_for", "train_partition_rules_for",
     "registered_families", "annotate_spmd", "spmd_lowering",
     "current_spmd", "P",
@@ -182,6 +183,58 @@ class TrainPartitionRules(PartitionRules):
 
     def match(self, name):
         return super(TrainPartitionRules, self).match(self.base_name(name))
+
+    def stage_resolution(self, stage_of_param, n_stages):
+        """Stage-scoped resolution for pipeline parallelism: lift this
+        table's derived-name discipline (grads / Adam moments / bf16 cast
+        mirrors resolve through their param) to stage ownership, so the
+        WHOLE optimizer-state family of a param lands on that param's
+        pipeline stage.  `stage_of_param` maps raw param names to stage
+        ids in [0, n_stages)."""
+        return StageResolution(stage_of_param, n_stages)
+
+
+# Adam's beta-power accumulators are deliberately absent from _ACC_SUFFIX
+# (the scalar guard replicates them for GSPMD sharding, so stripping was
+# never needed) — stage ownership DOES need them to follow their param.
+_POW_SUFFIX = re.compile(r"_beta[12]_pow_acc(_\d+)?$")
+# backward.py's un-merged grad contributions (`<p>@GRAD_0`) feed the
+# optimizer directly when a param has a single contribution; stage
+# ownership must resolve those too, where GSPMD never sees them (grads
+# are internal activations there, not placed state)
+_GRAD_N_SUFFIX = re.compile(r"@GRAD(_\d+)?(@RENAME@.*)?$")
+
+
+class StageResolution:
+    """Maps params and every training-derived name (grads, Adam moments,
+    beta-pow accumulators, bf16 cast mirrors) to a pipeline stage id.
+    Names whose base resolves to no known param return None — callers
+    treat those as shared/replicated state (learning rate, counters)."""
+
+    def __init__(self, stage_of_param, n_stages):
+        self.stage_of_param = dict(stage_of_param)
+        self.n_stages = int(n_stages)
+
+    def base_name(self, name):
+        name = _GRAD_N_SUFFIX.sub("", name)
+        name = _CAST_SUFFIX.sub("", name)
+        name = _POW_SUFFIX.sub("", name)
+        return _ACC_SUFFIX.sub("", name)
+
+    def stage_for(self, name):
+        if name in self.stage_of_param:
+            return self.stage_of_param[name]
+        return self.stage_of_param.get(self.base_name(name))
+
+    def names_by_stage(self, names):
+        """Partition `names` into ([stage0_names, ...], shared_names),
+        preserving input order within each bucket."""
+        staged = [[] for _ in range(self.n_stages)]
+        shared = []
+        for n in names:
+            s = self.stage_for(n)
+            (shared if s is None else staged[s]).append(n)
+        return staged, shared
 
 
 def train_partition_rules_for(family, mp_axis="mp", dp_axis="dp"):

@@ -21,6 +21,15 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+__all__ = [
+    "stack_stage_params",
+    "gpipe",
+    "one_f_one_b",
+    "pipeline_mlp_stages",
+    "pipeline_transformer_stages",
+    "sequential_reference",
+]
+
 
 def stack_stage_params(params_list):
     """[per-stage pytree, ...] -> one pytree with a leading stage dim,

@@ -12,6 +12,13 @@ from .layout_transpiler import rewrite_nhwc
 from . import fuse_passes  # noqa: F401  (registers the fusion-pass suite)
 from . import remat  # noqa: F401  (registers remat_pass)
 from .remat import detect_segments, remat_program
+from .pipeline import (
+    PipelinePlan,
+    build_pipeline_plan,
+    pipeline_activation_report,
+    pipeline_program,
+    pipeline_state_report,
+)
 from .autotune import tune as autotune_program
 from .pass_registry import (
     OpPattern,
@@ -34,6 +41,11 @@ __all__ = [
     "InferenceTranspiler",
     "detect_segments",
     "remat_program",
+    "PipelinePlan",
+    "build_pipeline_plan",
+    "pipeline_activation_report",
+    "pipeline_program",
+    "pipeline_state_report",
     "autotune_program",
     "OpPattern",
     "Pass",

@@ -6,10 +6,12 @@ PR 11's ``ops/kernel_tuning.py`` made every pallas_call's block sizes a
 searched, cached decision; this module lifts the same discipline one
 level up, to knobs that select between whole PROGRAMS:
 
-* ``mesh_shape``        — (dp, mp) GSPMD training mesh, None = no mesh
-                          (a rebuild knob: the builder stamps the
-                          candidate mesh via annotate_spmd + the train
-                          rule table; shapes the visible device count
+* ``mesh_shape``        — (dp, mp) or (dp, mp, pp) training mesh, None
+                          = no mesh (a rebuild knob: the builder stamps
+                          the candidate mesh via annotate_spmd + the
+                          train rule table, and slices it with
+                          ``pipeline_program`` when a pp extent > 1 is
+                          present; shapes the visible device count
                           cannot host are never tried)
 * ``rule_table``        — partition rules under a mesh: the registered
                           "family" table vs "replicated" (dp-only —
@@ -49,6 +51,14 @@ level up, to knobs that select between whole PROGRAMS:
 * ``prefix_chunk``      — consult-only serving knob: prefix-cache match
                           granularity (a multiple of the engine width);
                           None = engine default (== width)
+* ``n_microbatches``    — consult-only pipeline knob: the microbatch
+                          count M a pipeline bench measured best for
+                          this (model, shape) under a pp mesh; the
+                          bubble fraction (S-1)/(M+S-1) vs per-tick
+                          efficiency trade is batch- and
+                          schedule-dependent, so the tuner never times
+                          it on synthetic feeds; None = S (one
+                          microbatch per stage)
 
 Search is greedy coordinate descent (knob order as listed, best value
 kept before moving on) bounded by ``max_trials`` timings; each timing
@@ -76,6 +86,7 @@ __all__ = [
     "tune",
     "tuned_flags",
     "serving_knobs",
+    "pipeline_knobs",
     "cache_stats",
     "clear_cache",
 ]
@@ -100,6 +111,9 @@ DEFAULT_DECISION = {
     "spec_k": None,              # None = engine default (min(4, width))
     "use_draft": None,           # None = off; "self" | True = self-draft
     "prefix_chunk": None,        # None = engine default (== width)
+    # consult-only PIPELINE knob (pp mesh legs): deposited by
+    # BENCH_SPMD_PP, consumed via pipeline_knobs(decision)
+    "n_microbatches": None,      # None = pipeline default (M == S)
 }
 
 # search order: rebuild knobs first (they change the op mix every later
@@ -215,20 +229,40 @@ def serving_knobs(decision):
     return out
 
 
+def pipeline_knobs(decision):
+    """The ``pipeline_program`` keyword mapping for a decision's
+    consult-only pipeline knobs — the pp-side twin of serving_knobs.
+    Only knobs the decision pins appear (None stays with the pipeline
+    default M == S), so ``pipeline_program(main, mesh,
+    **pipeline_knobs(d))`` composes with explicit call-site
+    overrides."""
+    out = {}
+    if decision.get("n_microbatches") is not None:
+        out["n_microbatches"] = int(decision["n_microbatches"])
+    return out
+
+
 def _candidates_for(knob, rebuild, program, best=None):
     from .remat import detect_segments
 
     if knob == "mesh_shape":
         # rebuild knob: the builder stamps the program for the candidate
-        # dp x mp mesh (annotate_spmd + train rules) — only shapes the
-        # visible device count can host are tried
+        # dp x mp mesh (annotate_spmd + train rules), or slices it with
+        # pipeline_program for a (dp, mp, pp) triple — only shapes the
+        # visible device count can host are tried.  Builders that
+        # predate the pp axis raise on a 3-tuple; the search skips the
+        # failed candidate (the _measure_decision exception path)
         if rebuild is None:
             return []
         import jax
 
         n = len(jax.devices())
-        return [(dp, mp) for dp, mp in ((2, 1), (1, 2), (2, 2))
+        flat = [(dp, mp) for dp, mp in ((2, 1), (1, 2), (2, 2))
                 if dp * mp <= n]
+        pp3 = [(dp, mp, pp)
+               for dp, mp, pp in ((1, 1, 2), (2, 1, 2), (1, 1, 4))
+               if dp * mp * pp <= n]
+        return flat + pp3
     if knob == "rule_table":
         # only meaningful once a mesh is in play: without one the table
         # never resolves, so the candidate would re-time the baseline

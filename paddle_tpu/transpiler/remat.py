@@ -87,7 +87,7 @@ def _activation_bytes(block, name, batch_hint):
         return n * 2  # bfloat16
 
 
-def detect_segments(program, block_idx=0, min_ops=3):
+def detect_segments(program, block_idx=0, min_ops=3, op_range=None):
     """Partition the block's op list into layer-boundary segments.
 
     A boundary is a position where the crossing activation frontier (#
@@ -98,12 +98,22 @@ def detect_segments(program, block_idx=0, min_ops=3):
     minimum rule fails: the floor differs between the encoder, decoder
     and loss-head regions).  Plateaus of equal width cut once, at their
     first position.  Segments shorter than min_ops merge into their
-    successor.  Returns a list of (start, end) index pairs."""
+    successor.  Returns a list of (start, end) index pairs.
+
+    `op_range`: optional (lo, hi) restricting detection to ops[lo:hi] —
+    uses outside the window are ignored, so a forward-only window finds
+    the forward graph's waists even though every activation also lives
+    into the backward region (which would make the full frontier
+    monotone).  Returned pairs are absolute op indices covering [lo, hi)."""
     block = program.block(block_idx)
     ops = block.ops
+    base, hi = (0, len(ops)) if op_range is None else op_range
+    base = max(0, base)
+    hi = min(len(ops), hi)
+    ops = ops[base:hi]
     n = len(ops)
     if n < 2 * min_ops:
-        return [(0, n)]
+        return [(base, base + n)]
 
     first_def = {}
     last_use = {}
@@ -132,7 +142,7 @@ def detect_segments(program, block_idx=0, min_ops=3):
         acc += delta[p]
         counts.append(acc)  # counts[i] = frontier at position i+1
     if not counts:
-        return [(0, n)]
+        return [(base, base + n)]
 
     # plateau-aware local minima: a maximal run of equal counts is a
     # boundary run when both neighbors are strictly higher; cut at the
@@ -157,7 +167,7 @@ def detect_segments(program, block_idx=0, min_ops=3):
             prev = p
     if merged and n - merged[-1] < min_ops:
         merged.pop()
-    bounds = [0] + merged + [n]
+    bounds = [base + b for b in [0] + merged + [n]]
     return list(zip(bounds[:-1], bounds[1:]))
 
 

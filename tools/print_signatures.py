@@ -37,6 +37,8 @@ MODULES = [
     "paddle_tpu.device_info",
     "paddle_tpu.parallel.collective",
     "paddle_tpu.parallel.partition_rules",
+    "paddle_tpu.parallel.pipeline",
+    "paddle_tpu.transpiler.pipeline",
     "paddle_tpu.serving",
     "paddle_tpu.serving.router",
     "paddle_tpu.ops.pallas_kernels",
